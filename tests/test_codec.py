@@ -1,0 +1,127 @@
+"""Codec unit tests mirroring the reference's codec test vectors
+(components/codec/src/byte.rs, number.rs tests)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from tikv_tpu.util import codec
+
+
+# Test vectors from the reference's byte.rs tests (same wire format).
+BYTES_VECTORS = [
+    (b"", bytes([0, 0, 0, 0, 0, 0, 0, 0, 0xF7])),
+    (b"\x00", bytes([0, 0, 0, 0, 0, 0, 0, 0, 0xF8])),
+    (b"\x01\x02\x03", bytes([1, 2, 3, 0, 0, 0, 0, 0, 0xFA])),
+    (
+        b"\x01\x02\x03\x04\x05\x06\x07\x08",
+        bytes([1, 2, 3, 4, 5, 6, 7, 8, 0xFF, 0, 0, 0, 0, 0, 0, 0, 0, 0xF7]),
+    ),
+]
+
+
+@pytest.mark.parametrize("raw,enc", BYTES_VECTORS)
+def test_encode_bytes_vectors(raw, enc):
+    assert codec.encode_bytes(raw) == enc
+    got, consumed = codec.decode_bytes(enc)
+    assert got == raw
+    assert consumed == len(enc)
+
+
+def test_encode_bytes_desc_roundtrip():
+    for raw in [b"", b"a", b"hello world", b"\xff" * 20, bytes(range(256))]:
+        enc = codec.encode_bytes(raw, desc=True)
+        got, consumed = codec.decode_bytes(enc, desc=True)
+        assert got == raw and consumed == len(enc)
+
+
+def test_encode_bytes_ordering():
+    keys = [b"", b"\x00", b"\x00\x00", b"a", b"ab", b"b", b"\xff", b"\xff\x00"]
+    encs = [codec.encode_bytes(k) for k in keys]
+    assert encs == sorted(encs)
+    desc = [codec.encode_bytes(k, desc=True) for k in keys]
+    assert desc == sorted(desc, reverse=True)
+
+
+def test_encoded_bytes_len():
+    for raw in [b"", b"abc", b"12345678", b"x" * 17]:
+        enc = codec.encode_bytes(raw) + b"trailing"
+        assert codec.encoded_bytes_len(enc) == len(codec.encode_bytes(raw))
+
+
+U64_CASES = [0, 1, 2**8, 2**16 - 1, 2**32, 2**63, 2**64 - 1]
+I64_CASES = [-(2**63), -(2**31), -1, 0, 1, 2**31, 2**63 - 1]
+F64_CASES = [float("-inf"), -1e300, -1.5, -0.0, 0.0, 1e-300, 1.0, 3.14159, 1e300, float("inf")]
+
+
+def test_u64_roundtrip_and_order():
+    encs = [codec.encode_u64(v) for v in U64_CASES]
+    assert encs == sorted(encs)
+    for v, e in zip(U64_CASES, encs):
+        assert codec.decode_u64(e) == v
+    descs = [codec.encode_u64_desc(v) for v in U64_CASES]
+    assert descs == sorted(descs, reverse=True)
+    for v, e in zip(U64_CASES, descs):
+        assert codec.decode_u64_desc(e) == v
+
+
+def test_i64_roundtrip_and_order():
+    encs = [codec.encode_i64(v) for v in I64_CASES]
+    assert encs == sorted(encs)
+    for v, e in zip(I64_CASES, encs):
+        assert codec.decode_i64(e) == v
+
+
+def test_f64_roundtrip_and_order():
+    encs = [codec.encode_f64(v) for v in F64_CASES]
+    assert encs == sorted(encs)
+    for v, e in zip(F64_CASES, encs):
+        got = codec.decode_f64(e)
+        assert got == v or (got != got and v != v)
+
+
+def test_varint_roundtrip():
+    for v in U64_CASES:
+        b = codec.encode_var_u64(v)
+        got, off = codec.decode_var_u64(b)
+        assert got == v and off == len(b)
+    for v in I64_CASES:
+        b = codec.encode_var_i64(v)
+        got, off = codec.decode_var_i64(b)
+        assert got == v and off == len(b)
+
+
+def test_compact_bytes():
+    for raw in [b"", b"abc", b"x" * 300]:
+        b = codec.encode_compact_bytes(raw)
+        got, off = codec.decode_compact_bytes(b)
+        assert got == raw and off == len(b)
+
+
+def test_batch_codecs_match_scalar():
+    rng = np.random.default_rng(0)
+    u = rng.integers(0, 2**63, size=100, dtype=np.uint64) * 2 + rng.integers(0, 2, 100).astype(np.uint64)
+    enc = codec.encode_u64_batch(u)
+    scalar = np.frombuffer(b"".join(codec.encode_u64(int(v)) for v in u), dtype=np.uint8).reshape(-1, 8)
+    assert np.array_equal(enc, scalar)
+    assert np.array_equal(codec.decode_u64_batch(enc), u)
+
+    i = u.view(np.int64)
+    enci = codec.encode_i64_batch(i)
+    scalari = np.frombuffer(b"".join(codec.encode_i64(int(v)) for v in i), dtype=np.uint8).reshape(-1, 8)
+    assert np.array_equal(enci, scalari)
+    assert np.array_equal(codec.decode_i64_batch(enci), i)
+
+    f = rng.standard_normal(100) * 1e10
+    encf = np.frombuffer(b"".join(codec.encode_f64(float(v)) for v in f), dtype=np.uint8).reshape(-1, 8)
+    assert np.array_equal(codec.decode_f64_batch(encf), f)
+
+
+def test_decode_errors():
+    with pytest.raises(ValueError):
+        codec.decode_bytes(b"\x01\x02")
+    with pytest.raises(ValueError):
+        codec.decode_var_u64(b"\xff" * 11)
+    with pytest.raises(ValueError):
+        codec.decode_compact_bytes(codec.encode_var_i64(100) + b"xx")

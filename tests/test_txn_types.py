@@ -1,0 +1,103 @@
+"""txn_types data model tests (reference: components/txn_types tests)."""
+
+import pytest
+
+from tikv_tpu.storage import txn_types as t
+from tikv_tpu.storage.txn_types import Key, Lock, LockType, Mutation, Write, WriteType
+
+
+def test_timestamp_compose():
+    ts = t.compose_ts(423456789, 1024)
+    assert t.ts_physical(ts) == 423456789
+    assert t.ts_logical(ts) == 1024
+    assert t.ts_next(ts) == ts + 1
+    assert t.ts_prev(ts) == ts - 1
+
+
+def test_key_roundtrip_and_ts():
+    k = Key.from_raw(b"hello")
+    assert k.to_raw() == b"hello"
+    kt = k.append_ts(42)
+    assert kt.decode_ts() == 42
+    assert kt.truncate_ts() == k
+    base, ts = kt.split_on_ts()
+    assert base == k and ts == 42
+    assert k.is_encoded_from(b"hello")
+    assert not k.is_encoded_from(b"world")
+
+
+def test_key_ts_ordering():
+    """Newer timestamps must sort *before* older ones under the same key."""
+    k = Key.from_raw(b"k")
+    v1 = k.append_ts(100).encoded
+    v2 = k.append_ts(200).encoded
+    v3 = k.append_ts(300).encoded
+    assert v3 < v2 < v1
+    # and all versions of 'k' sort before any version of the next key
+    assert v1 < Key.from_raw(b"k\x00").append_ts(2**63).encoded
+
+
+@pytest.mark.parametrize(
+    "w",
+    [
+        Write(WriteType.PUT, 100),
+        Write(WriteType.PUT, 100, short_value=b"short"),
+        Write(WriteType.DELETE, 5),
+        Write(WriteType.LOCK, 2**60),
+        Write(WriteType.ROLLBACK, 7),
+        Write.new_rollback(7, protected=True),
+        Write(WriteType.PUT, 100, short_value=b"", has_overlapped_rollback=True),
+        Write(WriteType.PUT, 100, gc_fence=0),
+        Write(WriteType.PUT, 100, short_value=b"v", has_overlapped_rollback=True, gc_fence=999),
+    ],
+)
+def test_write_roundtrip(w):
+    assert Write.from_bytes(w.to_bytes()) == w
+
+
+def test_write_protected():
+    assert Write.new_rollback(1, True).is_protected()
+    assert not Write.new_rollback(1, False).is_protected()
+    assert not Write(WriteType.PUT, 1, short_value=b"P").is_protected()
+
+
+@pytest.mark.parametrize(
+    "lock",
+    [
+        Lock(LockType.PUT, b"pk", 100),
+        Lock(LockType.PUT, b"pk", 100, ttl=3000, short_value=b"sv"),
+        Lock(LockType.DELETE, b"pk", 100, for_update_ts=120, txn_size=5),
+        Lock(LockType.PESSIMISTIC, b"pk", 100, for_update_ts=120),
+        Lock(LockType.LOCK, b"pk", 100, min_commit_ts=101),
+        Lock(
+            LockType.PUT, b"pk", 100, ttl=1, min_commit_ts=103,
+            use_async_commit=True, secondaries=[b"s1", b"s2"], rollback_ts=[99, 98],
+        ),
+    ],
+)
+def test_lock_roundtrip(lock):
+    assert Lock.from_bytes(lock.to_bytes()) == lock
+
+
+def test_lock_visibility():
+    lock = Lock(LockType.PUT, b"pk", ts=100, ttl=10)
+    assert lock.is_visible_to(99)
+    assert not lock.is_visible_to(100)
+    assert not lock.is_visible_to(150)
+    assert lock.is_visible_to(150, bypass_locks=frozenset([100]))
+    # Lock/Pessimistic never block reads
+    assert Lock(LockType.LOCK, b"pk", 100).is_visible_to(200)
+    assert Lock(LockType.PESSIMISTIC, b"pk", 100).is_visible_to(200)
+    # min_commit_ts pushed above the reader
+    assert Lock(LockType.PUT, b"pk", 100, min_commit_ts=201).is_visible_to(200)
+
+
+def test_mutation_helpers():
+    k = Key.from_raw(b"k")
+    assert Mutation.put(k, b"v").lock_type() == LockType.PUT
+    assert Mutation.insert(k, b"v").lock_type() == LockType.PUT
+    assert Mutation.delete(k).lock_type() == LockType.DELETE
+    assert Mutation.lock(k).lock_type() == LockType.LOCK
+    assert Mutation.insert(k, b"v").should_not_exists()
+    assert Mutation.check_not_exists(k).should_not_exists()
+    assert not Mutation.put(k, b"v").should_not_exists()

@@ -1,0 +1,9 @@
+"""tikv_tpu — a TPU-native distributed transactional KV framework.
+
+Re-expresses the capabilities of TiKV (multi-Raft regions, Percolator MVCC
+transactions, raw KV, and a pushdown coprocessor) with the coprocessor's
+vectorized columnar execution compiled by XLA onto TPU.  See SURVEY.md at the
+repo root for the layer map this package follows.
+"""
+
+__version__ = "0.1.0"

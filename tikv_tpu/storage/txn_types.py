@@ -1,0 +1,427 @@
+"""MVCC data model: timestamps, keys, locks, write records, mutations.
+
+Re-expression of the reference's ``components/txn_types/src/{timestamp,types,
+lock,write}.rs``.  The on-disk layouts keep the reference's *structure* (flag
+byte + varint fields + optional tagged extensions) so that every capability —
+short-value inlining, overlapped rollback, gc fence, async commit secondaries,
+rollback-ts protection — has a place, but the exact byte tags are this
+framework's own.
+
+Physical layout of the three MVCC column families (same as the reference):
+
+* ``CF_DEFAULT``: ``encoded_user_key + desc(start_ts)`` → value
+* ``CF_LOCK``:    ``encoded_user_key``                  → Lock record
+* ``CF_WRITE``:   ``encoded_user_key + desc(commit_ts)`` → Write record
+
+``desc(ts)`` is the bit-flipped big-endian u64 so newer versions sort first.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from ..util import codec
+
+# ---------------------------------------------------------------------------
+# TimeStamp  (txn_types/src/timestamp.rs:9 — physical<<18 | logical)
+# ---------------------------------------------------------------------------
+
+TSO_PHYSICAL_SHIFT_BITS = 18
+MAX_TS = 0xFFFFFFFFFFFFFFFF
+
+
+def compose_ts(physical_ms: int, logical: int) -> int:
+    return (physical_ms << TSO_PHYSICAL_SHIFT_BITS) + logical
+
+
+def ts_physical(ts: int) -> int:
+    return ts >> TSO_PHYSICAL_SHIFT_BITS
+
+
+def ts_logical(ts: int) -> int:
+    return ts & ((1 << TSO_PHYSICAL_SHIFT_BITS) - 1)
+
+
+def ts_next(ts: int) -> int:
+    assert ts < MAX_TS
+    return ts + 1
+
+
+def ts_prev(ts: int) -> int:
+    assert ts > 0
+    return ts - 1
+
+
+# ---------------------------------------------------------------------------
+# Key  (txn_types/src/types.rs:42 — memcomparable-encoded user key)
+# ---------------------------------------------------------------------------
+
+class Key:
+    """A memcomparable-encoded key, optionally suffixed with a desc timestamp."""
+
+    __slots__ = ("encoded",)
+
+    def __init__(self, encoded: bytes):
+        self.encoded = encoded
+
+    @classmethod
+    def from_raw(cls, raw: bytes) -> "Key":
+        return cls(codec.encode_bytes(raw))
+
+    @classmethod
+    def from_encoded(cls, encoded: bytes) -> "Key":
+        return cls(encoded)
+
+    def to_raw(self) -> bytes:
+        data, consumed = codec.decode_bytes(self.encoded)
+        if consumed != len(self.encoded):
+            raise ValueError("key has trailing bytes (timestamp suffix?)")
+        return data
+
+    def append_ts(self, ts: int) -> "Key":
+        return Key(self.encoded + codec.encode_u64_desc(ts))
+
+    def decode_ts(self) -> int:
+        if len(self.encoded) < 8:
+            raise ValueError("key too short for ts")
+        return codec.decode_u64_desc(self.encoded, len(self.encoded) - 8)
+
+    def truncate_ts(self) -> "Key":
+        if len(self.encoded) < 8:
+            raise ValueError("key too short for ts")
+        return Key(self.encoded[:-8])
+
+    def split_on_ts(self) -> tuple["Key", int]:
+        return self.truncate_ts(), self.decode_ts()
+
+    def is_encoded_from(self, raw: bytes) -> bool:
+        try:
+            return self.to_raw() == raw
+        except ValueError:
+            return False
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Key) and self.encoded == other.encoded
+
+    def __lt__(self, other: "Key") -> bool:
+        return self.encoded < other.encoded
+
+    def __hash__(self) -> int:
+        return hash(self.encoded)
+
+    def __repr__(self) -> str:
+        return f"Key({self.encoded.hex()})"
+
+
+def append_ts(encoded_key: bytes, ts: int) -> bytes:
+    return encoded_key + codec.encode_u64_desc(ts)
+
+
+def split_ts(encoded_key_with_ts: bytes) -> tuple[bytes, int]:
+    if len(encoded_key_with_ts) < 8:
+        raise ValueError("key too short for ts suffix")
+    return (
+        encoded_key_with_ts[:-8],
+        codec.decode_u64_desc(encoded_key_with_ts, len(encoded_key_with_ts) - 8),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Write records  (txn_types/src/write.rs:13,63,224)
+# ---------------------------------------------------------------------------
+
+SHORT_VALUE_MAX_LEN = 255
+_SHORT_VALUE_PREFIX = 0x76  # b'v'
+_FLAG_OVERLAPPED_ROLLBACK = 0x52  # b'R'
+_GC_FENCE_PREFIX = 0x46  # b'F'
+
+
+class WriteType(enum.IntEnum):
+    PUT = 0x50  # b'P'
+    DELETE = 0x44  # b'D'
+    LOCK = 0x4C  # b'L'
+    ROLLBACK = 0x52  # b'R'
+
+
+@dataclass
+class Write:
+    """A committed version record stored in CF_WRITE under key+commit_ts."""
+
+    write_type: WriteType
+    start_ts: int
+    short_value: bytes | None = None
+    has_overlapped_rollback: bool = False
+    # gc_fence semantics (write.rs:78-129): None = not set; 0 = deleted/
+    # rewritten tail version; >0 = next version's commit ts after a rewrite.
+    gc_fence: int | None = None
+
+    def to_bytes(self) -> bytes:
+        out = bytearray()
+        out.append(int(self.write_type))
+        out += codec.encode_var_u64(self.start_ts)
+        if self.short_value is not None:
+            if len(self.short_value) > SHORT_VALUE_MAX_LEN:
+                raise ValueError("short value too long")
+            out.append(_SHORT_VALUE_PREFIX)
+            out.append(len(self.short_value))
+            out += self.short_value
+        if self.has_overlapped_rollback:
+            out.append(_FLAG_OVERLAPPED_ROLLBACK)
+        if self.gc_fence is not None:
+            out.append(_GC_FENCE_PREFIX)
+            out += codec.encode_u64(self.gc_fence)
+        return bytes(out)
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "Write":
+        if not b:
+            raise ValueError("empty write record")
+        try:
+            wt = WriteType(b[0])
+        except ValueError as e:
+            raise ValueError(str(e)) from None
+        start_ts, off = codec.decode_var_u64(b, 1)
+        short_value = None
+        overlapped = False
+        gc_fence = None
+        while off < len(b):
+            tag = b[off]
+            off += 1
+            if tag == _SHORT_VALUE_PREFIX:
+                if off >= len(b):
+                    raise ValueError("write record truncated in short value length")
+                n = b[off]
+                off += 1
+                if off + n > len(b):
+                    raise ValueError("write record truncated in short value")
+                short_value = b[off : off + n]
+                off += n
+            elif tag == _FLAG_OVERLAPPED_ROLLBACK:
+                overlapped = True
+            elif tag == _GC_FENCE_PREFIX:
+                if off + 8 > len(b):
+                    raise ValueError("write record truncated in gc fence")
+                gc_fence = codec.decode_u64(b, off)
+                off += 8
+            else:
+                raise ValueError(f"unknown write tag {tag:#x}")
+        return cls(wt, start_ts, short_value, overlapped, gc_fence)
+
+    def is_protected(self) -> bool:
+        """A protected rollback must not be collapsed (write.rs:186)."""
+        return self.write_type == WriteType.ROLLBACK and self.short_value == b"P"
+
+    @classmethod
+    def new_rollback(cls, start_ts: int, protected: bool) -> "Write":
+        return cls(WriteType.ROLLBACK, start_ts, b"P" if protected else None)
+
+
+# ---------------------------------------------------------------------------
+# Locks  (txn_types/src/lock.rs:13,62)
+# ---------------------------------------------------------------------------
+
+_TAG_SHORT_VALUE = 0x76  # b'v'
+_TAG_FOR_UPDATE_TS = 0x66  # b'f'
+_TAG_TXN_SIZE = 0x74  # b't'
+_TAG_MIN_COMMIT_TS = 0x63  # b'c'
+_TAG_ASYNC_COMMIT = 0x61  # b'a'
+_TAG_ROLLBACK_TS = 0x72  # b'r'
+
+
+class LockType(enum.IntEnum):
+    PUT = 0x50  # b'P'
+    DELETE = 0x44  # b'D'
+    LOCK = 0x4C  # b'L'
+    PESSIMISTIC = 0x53  # b'S'
+
+
+@dataclass
+class Lock:
+    """An uncommitted lock stored in CF_LOCK under the user key."""
+
+    lock_type: LockType
+    primary: bytes
+    ts: int  # start_ts of the locking txn
+    ttl: int = 0
+    short_value: bytes | None = None
+    for_update_ts: int = 0  # >0 ⇒ pessimistic txn
+    txn_size: int = 0
+    min_commit_ts: int = 0
+    use_async_commit: bool = False
+    secondaries: list[bytes] = field(default_factory=list)
+    rollback_ts: list[int] = field(default_factory=list)
+
+    def to_bytes(self) -> bytes:
+        out = bytearray()
+        out.append(int(self.lock_type))
+        out += codec.encode_compact_bytes(self.primary)
+        out += codec.encode_var_u64(self.ts)
+        out += codec.encode_var_u64(self.ttl)
+        if self.short_value is not None:
+            if len(self.short_value) > SHORT_VALUE_MAX_LEN:
+                raise ValueError("short value too long")
+            out.append(_TAG_SHORT_VALUE)
+            out.append(len(self.short_value))
+            out += self.short_value
+        if self.for_update_ts:
+            out.append(_TAG_FOR_UPDATE_TS)
+            out += codec.encode_u64(self.for_update_ts)
+        if self.txn_size:
+            out.append(_TAG_TXN_SIZE)
+            out += codec.encode_u64(self.txn_size)
+        if self.min_commit_ts:
+            out.append(_TAG_MIN_COMMIT_TS)
+            out += codec.encode_u64(self.min_commit_ts)
+        if self.use_async_commit:
+            out.append(_TAG_ASYNC_COMMIT)
+            out += codec.encode_var_u64(len(self.secondaries))
+            for s in self.secondaries:
+                out += codec.encode_compact_bytes(s)
+        if self.rollback_ts:
+            out.append(_TAG_ROLLBACK_TS)
+            out += codec.encode_var_u64(len(self.rollback_ts))
+            for ts in self.rollback_ts:
+                out += codec.encode_u64(ts)
+        return bytes(out)
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "Lock":
+        if not b:
+            raise ValueError("empty lock record")
+        try:
+            lt = LockType(b[0])
+        except ValueError as e:
+            raise ValueError(str(e)) from None
+        primary, off = codec.decode_compact_bytes(b, 1)
+        ts, off = codec.decode_var_u64(b, off)
+        ttl, off = codec.decode_var_u64(b, off)
+        lock = cls(lt, primary, ts, ttl)
+
+        def need(n: int) -> None:
+            if off + n > len(b):
+                raise ValueError("lock record truncated")
+
+        while off < len(b):
+            tag = b[off]
+            off += 1
+            if tag == _TAG_SHORT_VALUE:
+                need(1)
+                n = b[off]
+                off += 1
+                need(n)
+                lock.short_value = b[off : off + n]
+                off += n
+            elif tag == _TAG_FOR_UPDATE_TS:
+                need(8)
+                lock.for_update_ts = codec.decode_u64(b, off)
+                off += 8
+            elif tag == _TAG_TXN_SIZE:
+                need(8)
+                lock.txn_size = codec.decode_u64(b, off)
+                off += 8
+            elif tag == _TAG_MIN_COMMIT_TS:
+                need(8)
+                lock.min_commit_ts = codec.decode_u64(b, off)
+                off += 8
+            elif tag == _TAG_ASYNC_COMMIT:
+                lock.use_async_commit = True
+                n, off = codec.decode_var_u64(b, off)
+                for _ in range(n):
+                    s, off = codec.decode_compact_bytes(b, off)
+                    lock.secondaries.append(s)
+            elif tag == _TAG_ROLLBACK_TS:
+                n, off = codec.decode_var_u64(b, off)
+                need(8 * n)
+                for _ in range(n):
+                    lock.rollback_ts.append(codec.decode_u64(b, off))
+                    off += 8
+            else:
+                raise ValueError(f"unknown lock tag {tag:#x}")
+        return lock
+
+    def is_pessimistic(self) -> bool:
+        return self.lock_type == LockType.PESSIMISTIC
+
+    def is_visible_to(self, read_ts: int, bypass_locks: frozenset[int] = frozenset()) -> bool:
+        """True if a read at ``read_ts`` is NOT blocked by this lock.
+
+        Mirrors ``Lock::check_ts_conflict`` (lock.rs:192): Lock/Pessimistic
+        locks never block reads; a read below the lock ts passes; MAX_TS reads
+        block (latest read must see pending writes) unless bypassed.
+        """
+        if self.lock_type in (LockType.LOCK, LockType.PESSIMISTIC):
+            return True
+        if self.ts > read_ts:
+            return True
+        if self.ts in bypass_locks:
+            return True
+        if self.min_commit_ts > read_ts:
+            return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Mutations  (txn_types/src/types.rs:258)
+# ---------------------------------------------------------------------------
+
+class MutationType(enum.Enum):
+    PUT = "put"
+    DELETE = "delete"
+    LOCK = "lock"
+    INSERT = "insert"  # put + must-not-exist constraint
+    CHECK_NOT_EXISTS = "check_not_exists"
+
+
+@dataclass
+class Mutation:
+    mutation_type: MutationType
+    key: Key
+    value: bytes | None = None
+
+    @classmethod
+    def put(cls, key: Key, value: bytes) -> "Mutation":
+        return cls(MutationType.PUT, key, value)
+
+    @classmethod
+    def delete(cls, key: Key) -> "Mutation":
+        return cls(MutationType.DELETE, key)
+
+    @classmethod
+    def lock(cls, key: Key) -> "Mutation":
+        return cls(MutationType.LOCK, key)
+
+    @classmethod
+    def insert(cls, key: Key, value: bytes) -> "Mutation":
+        return cls(MutationType.INSERT, key, value)
+
+    @classmethod
+    def check_not_exists(cls, key: Key) -> "Mutation":
+        return cls(MutationType.CHECK_NOT_EXISTS, key)
+
+    def should_not_exists(self) -> bool:
+        return self.mutation_type in (MutationType.INSERT, MutationType.CHECK_NOT_EXISTS)
+
+    def lock_type(self) -> LockType:
+        return {
+            MutationType.PUT: LockType.PUT,
+            MutationType.INSERT: LockType.PUT,
+            MutationType.DELETE: LockType.DELETE,
+            MutationType.LOCK: LockType.LOCK,
+            MutationType.CHECK_NOT_EXISTS: LockType.LOCK,
+        }[self.mutation_type]
+
+
+class TsSet:
+    """Cheap set of timestamps for bypass/committing lock checks (timestamp.rs:111)."""
+
+    __slots__ = ("_set",)
+
+    def __init__(self, tss: list[int] | None = None):
+        self._set = frozenset(tss or ())
+
+    def contains(self, ts: int) -> bool:
+        return ts in self._set
+
+    def as_frozenset(self) -> frozenset[int]:
+        return self._set

@@ -1,0 +1,84 @@
+"""Physical key-space layout.
+
+Mirrors the reference's ``components/keys/src/lib.rs:22-39``: user data lives
+under a ``z`` prefix so that store-local metadata (``0x01`` prefix) sorts before
+all data and never collides with it.  Raft metadata per region lives under
+``0x01 0x02`` / ``0x01 0x03`` prefixes keyed by the region id.
+"""
+
+from __future__ import annotations
+
+from .codec import decode_u64, encode_u64
+
+# store-local keys
+LOCAL_PREFIX = b"\x01"
+LOCAL_MIN_KEY = LOCAL_PREFIX
+LOCAL_MAX_KEY = b"\x02"
+
+DATA_PREFIX = b"z"
+DATA_PREFIX_KEY = DATA_PREFIX
+DATA_MIN_KEY = DATA_PREFIX
+DATA_MAX_KEY = b"{"  # DATA_PREFIX + 1
+
+MIN_KEY = b""
+MAX_KEY = b"\xff" * 9
+
+# local sub-prefixes (under LOCAL_PREFIX)
+STORE_IDENT_KEY = LOCAL_PREFIX + b"\x01"
+PREPARE_BOOTSTRAP_KEY = LOCAL_PREFIX + b"\x02"
+REGION_RAFT_PREFIX = b"\x02"  # 0x01 0x02 region_id suffix
+REGION_META_PREFIX = b"\x03"  # 0x01 0x03 region_id suffix
+
+RAFT_LOG_SUFFIX = b"\x01"
+RAFT_STATE_SUFFIX = b"\x02"
+APPLY_STATE_SUFFIX = b"\x03"
+SNAPSHOT_RAFT_STATE_SUFFIX = b"\x04"
+REGION_STATE_SUFFIX = b"\x01"
+
+
+def data_key(key: bytes) -> bytes:
+    return DATA_PREFIX + key
+
+
+def origin_key(data_key_: bytes) -> bytes:
+    if not data_key_.startswith(DATA_PREFIX):
+        raise ValueError(f"invalid data key {data_key_!r}")
+    return data_key_[len(DATA_PREFIX) :]
+
+
+def data_end_key(region_end_key: bytes) -> bytes:
+    """Region end key '' means +inf: map to the end of the data range."""
+    if not region_end_key:
+        return DATA_MAX_KEY
+    return data_key(region_end_key)
+
+
+def region_raft_prefix(region_id: int) -> bytes:
+    return LOCAL_PREFIX + REGION_RAFT_PREFIX + encode_u64(region_id)
+
+
+def raft_log_key(region_id: int, log_index: int) -> bytes:
+    return region_raft_prefix(region_id) + RAFT_LOG_SUFFIX + encode_u64(log_index)
+
+
+def raft_state_key(region_id: int) -> bytes:
+    return region_raft_prefix(region_id) + RAFT_STATE_SUFFIX
+
+
+def apply_state_key(region_id: int) -> bytes:
+    return region_raft_prefix(region_id) + APPLY_STATE_SUFFIX
+
+
+def region_meta_prefix(region_id: int) -> bytes:
+    return LOCAL_PREFIX + REGION_META_PREFIX + encode_u64(region_id)
+
+
+def region_state_key(region_id: int) -> bytes:
+    return region_meta_prefix(region_id) + REGION_STATE_SUFFIX
+
+
+def raft_log_index(key: bytes) -> int:
+    expect = 2 + 8 + 1 + 8  # prefixes + region id + suffix + index
+    if len(key) != expect:
+        raise ValueError(f"invalid raft log key {key!r}")
+    return decode_u64(key, 11)
