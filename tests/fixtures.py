@@ -1,0 +1,72 @@
+"""Test fixtures: write MVCC-shaped data directly into an engine.
+
+Stands in for the reference's must_prewrite/must_commit test helpers until the
+txn layer exists; afterwards these remain the low-level way to construct
+arbitrary (including pathological) CF states.
+"""
+
+from tikv_tpu.storage.btree_engine import BTreeEngine
+from tikv_tpu.storage.engine import CF_DEFAULT, CF_LOCK, CF_WRITE, WriteBatch
+from tikv_tpu.storage.txn_types import (
+    Key,
+    Lock,
+    LockType,
+    Write,
+    WriteType,
+)
+
+SHORT_VALUE_MAX_LEN = 255
+
+
+def put_committed(
+    engine: BTreeEngine,
+    raw_key: bytes,
+    value: bytes,
+    start_ts: int,
+    commit_ts: int,
+) -> None:
+    k = Key.from_raw(raw_key)
+    wb = WriteBatch()
+    if len(value) <= SHORT_VALUE_MAX_LEN:
+        w = Write(WriteType.PUT, start_ts, short_value=value)
+    else:
+        w = Write(WriteType.PUT, start_ts)
+        wb.put_cf(CF_DEFAULT, k.append_ts(start_ts).encoded, value)
+    wb.put_cf(CF_WRITE, k.append_ts(commit_ts).encoded, w.to_bytes())
+    engine.write(wb)
+
+
+def put_committed_large(engine, raw_key, value, start_ts, commit_ts):
+    """Force the value into CF_DEFAULT even if short."""
+    k = Key.from_raw(raw_key)
+    wb = WriteBatch()
+    wb.put_cf(CF_DEFAULT, k.append_ts(start_ts).encoded, value)
+    wb.put_cf(CF_WRITE, k.append_ts(commit_ts).encoded, Write(WriteType.PUT, start_ts).to_bytes())
+    engine.write(wb)
+
+
+def delete_committed(engine, raw_key, start_ts, commit_ts):
+    k = Key.from_raw(raw_key)
+    engine.put_cf(CF_WRITE, k.append_ts(commit_ts).encoded, Write(WriteType.DELETE, start_ts).to_bytes())
+
+
+def rollback(engine, raw_key, start_ts, protected=False):
+    k = Key.from_raw(raw_key)
+    engine.put_cf(
+        CF_WRITE, k.append_ts(start_ts).encoded, Write.new_rollback(start_ts, protected).to_bytes()
+    )
+
+
+def lock_key(
+    engine,
+    raw_key,
+    primary: bytes,
+    start_ts: int,
+    lock_type: LockType = LockType.PUT,
+    ttl: int = 0,
+    **kwargs,
+) -> Lock:
+    k = Key.from_raw(raw_key)
+    lock = Lock(lock_type, primary, start_ts, ttl, **kwargs)
+    engine.put_cf(CF_LOCK, k.encoded, lock.to_bytes())
+    return lock

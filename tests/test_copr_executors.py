@@ -1,0 +1,239 @@
+"""Coprocessor executor-pipeline tests.
+
+Mirrors the reference's tests/integrations/coprocessor/test_select.rs coverage
+(select, selection, aggregation, topN, limit) over both the fixture leaf and a
+real MVCC snapshot leaf.
+"""
+
+import numpy as np
+import pytest
+
+from tikv_tpu.copr.aggr import AggDescriptor
+from tikv_tpu.copr.dag import (
+    Aggregation,
+    BatchExecutorsRunner,
+    DagRequest,
+    Limit,
+    Selection,
+    TableScan,
+    TopN,
+    check_supported,
+)
+from tikv_tpu.copr.executors import FixtureScanSource, MvccScanSource
+from tikv_tpu.copr.rpn import call, col, const_decimal, const_int
+from tikv_tpu.copr.table import record_range
+
+from copr_fixtures import PRODUCT_COLUMNS, PRODUCT_ROWS, TABLE_ID, product_engine, product_kvs
+
+
+def run_dag(executors, source=None, output_offsets=None):
+    dag = DagRequest(executors=executors, output_offsets=output_offsets)
+    if source is None:
+        source = FixtureScanSource(product_kvs())
+    return BatchExecutorsRunner(dag, source).handle_request()
+
+
+def test_full_table_scan():
+    resp = run_dag([TableScan(TABLE_ID, PRODUCT_COLUMNS)])
+    rows = resp.iter_rows()
+    assert len(rows) == len(PRODUCT_ROWS)
+    assert rows[0] == [1, b"apple", 10, (150, 2)]
+    assert rows[3][1] is None
+    assert rows[5][3] is None
+
+
+def test_table_scan_output_offsets():
+    resp = run_dag([TableScan(TABLE_ID, PRODUCT_COLUMNS)], output_offsets=[2, 0])
+    rows = resp.iter_rows()
+    assert rows[0] == [10, 1]
+
+
+def test_mvcc_leaf_matches_fixture():
+    eng = product_engine()
+    start, end = record_range(TABLE_ID)
+    src = MvccScanSource(eng.snapshot(), ts=200, ranges=[(start, end)])
+    resp_mvcc = run_dag([TableScan(TABLE_ID, PRODUCT_COLUMNS)], source=src)
+    resp_fix = run_dag([TableScan(TABLE_ID, PRODUCT_COLUMNS)])
+    assert resp_mvcc.encode() == resp_fix.encode()
+
+
+def test_mvcc_leaf_respects_ts():
+    eng = product_engine(commit_ts=100)
+    start, end = record_range(TABLE_ID)
+    src = MvccScanSource(eng.snapshot(), ts=50, ranges=[(start, end)])
+    resp = run_dag([TableScan(TABLE_ID, PRODUCT_COLUMNS)], source=src)
+    assert resp.iter_rows() == []
+
+
+def test_selection():
+    # count > 9 AND count < 25
+    cond = call("and", call("gt", col(2), const_int(9)), call("lt", col(2), const_int(25)))
+    resp = run_dag([TableScan(TABLE_ID, PRODUCT_COLUMNS), Selection([cond])])
+    ids = [r[0] for r in resp.iter_rows()]
+    assert ids == [1, 2, 5]
+
+
+def test_selection_decimal_predicate():
+    # price < 2.00 (scaled 200); NULL price row must not pass
+    cond = call("lt", col(3), const_decimal(200, 2))
+    resp = run_dag([TableScan(TABLE_ID, PRODUCT_COLUMNS), Selection([cond])])
+    ids = [r[0] for r in resp.iter_rows()]
+    assert ids == [1, 2, 5]
+
+
+def test_simple_aggregation():
+    resp = run_dag(
+        [
+            TableScan(TABLE_ID, PRODUCT_COLUMNS),
+            Aggregation(
+                group_by=[],
+                agg_funcs=[
+                    AggDescriptor("count", None),
+                    AggDescriptor("sum", col(2)),
+                    AggDescriptor("avg", col(3)),
+                    AggDescriptor("min", col(2)),
+                    AggDescriptor("max", col(3)),
+                ],
+            ),
+        ]
+    )
+    rows = resp.iter_rows()
+    assert len(rows) == 1
+    count, sum_cnt, avg_n, avg_sum, min_cnt, max_price = rows[0]
+    assert count == 6
+    assert sum_cnt == 10 + 20 + 30 + 5 + 15 + 8
+    assert avg_n == 5  # one NULL price
+    assert avg_sum == (150 + 75 + 1250 + 200 + 150, 2)
+    assert min_cnt == 5
+    assert max_price == (1250, 2)
+
+
+def test_hash_aggregation_group_by_name():
+    resp = run_dag(
+        [
+            TableScan(TABLE_ID, PRODUCT_COLUMNS),
+            Aggregation(
+                group_by=[col(1)],
+                agg_funcs=[AggDescriptor("count", None), AggDescriptor("sum", col(2))],
+            ),
+        ]
+    )
+    rows = {tuple(r[2:][0:1])[0]: (r[0], r[1]) for r in resp.iter_rows()}
+    assert rows[b"apple"] == (2, 25)
+    assert rows[b"banana"] == (2, 28)
+    assert rows[b"cherry"] == (1, 30)
+    assert rows[None] == (1, 5)
+
+
+def test_stream_aggregation_same_result():
+    mk = lambda streamed: run_dag(
+        [
+            TableScan(TABLE_ID, PRODUCT_COLUMNS),
+            Aggregation(group_by=[col(1)], agg_funcs=[AggDescriptor("count", None)], streamed=streamed),
+        ]
+    )
+    assert mk(True).encode() == mk(False).encode()
+
+
+def test_topn():
+    resp = run_dag(
+        [TableScan(TABLE_ID, PRODUCT_COLUMNS), TopN(order_by=[(col(2), True)], limit=3)]
+    )
+    ids = [r[0] for r in resp.iter_rows()]
+    assert ids == [3, 2, 5]  # count desc: 30, 20, 15
+
+
+def test_topn_nulls_first_asc():
+    resp = run_dag(
+        [TableScan(TABLE_ID, PRODUCT_COLUMNS), TopN(order_by=[(col(3), False)], limit=2)]
+    )
+    rows = resp.iter_rows()
+    assert rows[0][3] is None  # NULL price first ascending
+    assert rows[1][3] == (75, 2)
+
+
+def test_topn_desc_nulls_last():
+    resp = run_dag(
+        [TableScan(TABLE_ID, PRODUCT_COLUMNS), TopN(order_by=[(col(3), True)], limit=6)]
+    )
+    rows = resp.iter_rows()
+    assert rows[0][3] == (1250, 2)
+    assert rows[-1][3] is None
+
+
+def test_limit():
+    resp = run_dag([TableScan(TABLE_ID, PRODUCT_COLUMNS), Limit(2)])
+    assert len(resp.iter_rows()) == 2
+    resp = run_dag([TableScan(TABLE_ID, PRODUCT_COLUMNS), Limit(100)])
+    assert len(resp.iter_rows()) == 6
+
+
+def test_selection_then_agg_then_topn():
+    cond = call("ge", col(2), const_int(8))
+    resp = run_dag(
+        [
+            TableScan(TABLE_ID, PRODUCT_COLUMNS),
+            Selection([cond]),
+            Aggregation(group_by=[col(1)], agg_funcs=[AggDescriptor("sum", col(2))]),
+            TopN(order_by=[(col(0), True)], limit=2),
+        ]
+    )
+    rows = resp.iter_rows()
+    assert rows == [[30, b"cherry"], [28, b"banana"]]
+
+
+def test_check_supported_rejects_bad_plans():
+    with pytest.raises(ValueError):
+        check_supported(DagRequest(executors=[]))
+    with pytest.raises(ValueError):
+        check_supported(DagRequest(executors=[Limit(1)]))
+    with pytest.raises(ValueError):
+        check_supported(
+            DagRequest(executors=[TableScan(TABLE_ID, PRODUCT_COLUMNS), TableScan(1, [])])
+        )
+
+
+def test_batch_growth_over_large_fixture():
+    # >1024 rows to exercise batch growth and chunk flushing
+    rows = [(i, b"x", i % 7, i) for i in range(1, 3001)]
+    resp = run_dag(
+        [TableScan(TABLE_ID, PRODUCT_COLUMNS), Selection([call("ne", col(2), const_int(3))])],
+        source=FixtureScanSource(product_kvs(rows)),
+    )
+    got = [r[0] for r in resp.iter_rows()]
+    expect = [i for i in range(1, 3001) if i % 7 != 3]
+    assert got == expect
+    assert len(resp.chunks) > 1
+
+
+def test_decimal_divide_real_unscales():
+    """divide_real over DECIMAL(2) must divide the numeric value, not the scaled int."""
+    from tikv_tpu.copr.rpn import compile_expr, eval_expr_on_chunk
+    from tikv_tpu.copr.datatypes import Chunk, Column, EvalType
+
+    price = Column.from_values(EvalType.DECIMAL, [150, 250], frac=2)  # 1.50, 2.50
+    qty = Column.from_values(EvalType.INT, [3, 5])
+    chunk = Chunk.full([price, qty])
+    schema = [(EvalType.DECIMAL, 2), (EvalType.INT, 0)]
+    rpn = compile_expr(call("divide_real", col(0), col(1)), schema)
+    data, nulls = eval_expr_on_chunk(rpn, chunk)
+    assert data[0] == pytest.approx(0.5)
+    assert data[1] == pytest.approx(0.5)
+    # decimal / decimal
+    rpn2 = compile_expr(call("divide_real", col(0), col(0)), schema)
+    data2, _ = eval_expr_on_chunk(rpn2, chunk)
+    assert data2[0] == pytest.approx(1.0)
+
+
+def test_int_divide_truncates_toward_zero():
+    from tikv_tpu.copr.rpn import compile_expr, eval_expr_on_chunk
+    from tikv_tpu.copr.datatypes import Chunk, Column, EvalType
+
+    a = Column.from_values(EvalType.INT, [7, -7, 7, -7, 1])
+    b = Column.from_values(EvalType.INT, [2, 2, -2, -2, 0])
+    chunk = Chunk.full([a, b])
+    schema = [(EvalType.INT, 0), (EvalType.INT, 0)]
+    rpn = compile_expr(call("int_divide", col(0), col(1)), schema)
+    data, nulls = eval_expr_on_chunk(rpn, chunk)
+    assert list(data[:4]) == [3, -3, -3, 3]
+    assert bool(nulls[4])  # x DIV 0 = NULL

@@ -1,0 +1,164 @@
+"""MVCC read-path tests (reference: mvcc/reader/{point_getter,scanner} tests)."""
+
+import pytest
+
+from tikv_tpu.storage.btree_engine import BTreeEngine
+from tikv_tpu.storage.mvcc import (
+    BackwardScanner,
+    ForwardScanner,
+    IsolationLevel,
+    KeyIsLockedError,
+    MvccReader,
+    PointGetter,
+)
+from tikv_tpu.storage.txn_types import Key, LockType
+
+from fixtures import delete_committed, lock_key, put_committed, put_committed_large, rollback
+
+
+@pytest.fixture
+def engine():
+    e = BTreeEngine()
+    # k1: v1@(5,10), v2@(15,20), deleted@(25,30)
+    put_committed(e, b"k1", b"v1", 5, 10)
+    put_committed(e, b"k1", b"v2", 15, 20)
+    delete_committed(e, b"k1", 25, 30)
+    # k2: large value in CF_DEFAULT
+    put_committed_large(e, b"k2", b"big" * 200, 6, 12)
+    # k3: only a rollback
+    rollback(e, b"k3", 8)
+    # k4: committed then rolled-back attempt on top
+    put_committed(e, b"k4", b"v4", 5, 9)
+    rollback(e, b"k4", 14)
+    return e
+
+
+def get(e, key, ts, **kw):
+    return PointGetter(e.snapshot(), ts, **kw).get(Key.from_raw(key))
+
+
+def test_point_get_versions(engine):
+    assert get(engine, b"k1", 9) is None
+    assert get(engine, b"k1", 10) == b"v1"
+    assert get(engine, b"k1", 19) == b"v1"
+    assert get(engine, b"k1", 20) == b"v2"
+    assert get(engine, b"k1", 29) == b"v2"
+    assert get(engine, b"k1", 30) is None
+    assert get(engine, b"k1", 100) is None
+
+
+def test_point_get_large_value(engine):
+    assert get(engine, b"k2", 12) == b"big" * 200
+    assert get(engine, b"k2", 11) is None
+
+
+def test_point_get_skips_rollback(engine):
+    assert get(engine, b"k3", 100) is None
+    assert get(engine, b"k4", 100) == b"v4"  # rollback@14 skipped to PUT@9
+
+
+def test_point_get_missing_key(engine):
+    assert get(engine, b"nope", 100) is None
+
+
+def test_locked_key_blocks_si_read(engine):
+    lock_key(engine, b"k1", b"k1", start_ts=40)
+    with pytest.raises(KeyIsLockedError):
+        get(engine, b"k1", 50)
+    # read below lock ts passes
+    assert get(engine, b"k1", 25) == b"v2"
+    # bypassing the lock passes
+    assert get(engine, b"k1", 50, bypass_locks=frozenset([40])) is None
+    # RC ignores locks
+    assert get(engine, b"k1", 50, isolation=IsolationLevel.RC) is None
+
+
+def test_lock_and_pessimistic_locks_do_not_block(engine):
+    lock_key(engine, b"k1", b"k1", start_ts=40, lock_type=LockType.LOCK)
+    assert get(engine, b"k1", 50) is None
+    lock_key(engine, b"k4", b"k4", start_ts=40, lock_type=LockType.PESSIMISTIC)
+    assert get(engine, b"k4", 50) == b"v4"
+
+
+def scan_fwd(e, ts, start=b"", end=None, **kw):
+    s = None if start == b"" else Key.from_raw(start)
+    en = Key.from_raw(end) if end is not None else None
+    return list(ForwardScanner(e.snapshot(), ts, s, en, **kw))
+
+
+def scan_bwd(e, ts, start=b"", end=None, **kw):
+    s = None if start == b"" else Key.from_raw(start)
+    en = Key.from_raw(end) if end is not None else None
+    return list(BackwardScanner(e.snapshot(), ts, s, en, **kw))
+
+
+def test_forward_scan(engine):
+    assert scan_fwd(engine, 100) == [(b"k2", b"big" * 200), (b"k4", b"v4")]
+    assert scan_fwd(engine, 25) == [(b"k1", b"v2"), (b"k2", b"big" * 200), (b"k4", b"v4")]
+    assert scan_fwd(engine, 10) == [(b"k1", b"v1"), (b"k4", b"v4")]
+    assert scan_fwd(engine, 5) == []
+
+
+def test_forward_scan_range(engine):
+    assert scan_fwd(engine, 25, start=b"k2") == [(b"k2", b"big" * 200), (b"k4", b"v4")]
+    assert scan_fwd(engine, 25, end=b"k2") == [(b"k1", b"v2")]
+    assert scan_fwd(engine, 25, start=b"k1", end=b"k2") == [(b"k1", b"v2")]
+
+
+def test_forward_scan_key_only(engine):
+    assert scan_fwd(engine, 25, key_only=True) == [(b"k1", b""), (b"k2", b""), (b"k4", b"")]
+
+
+def test_forward_scan_lock_check(engine):
+    lock_key(engine, b"k2", b"k2", start_ts=40)
+    with pytest.raises(KeyIsLockedError):
+        scan_fwd(engine, 50)
+    assert scan_fwd(engine, 50, isolation=IsolationLevel.RC) == [(b"k2", b"big" * 200), (b"k4", b"v4")]
+    # range not covering the locked key is unaffected
+    assert scan_fwd(engine, 50, start=b"k3") == [(b"k4", b"v4")]
+
+
+def test_backward_scan(engine):
+    assert scan_bwd(engine, 100) == [(b"k4", b"v4"), (b"k2", b"big" * 200)]
+    assert scan_bwd(engine, 25) == [(b"k4", b"v4"), (b"k2", b"big" * 200), (b"k1", b"v2")]
+    assert scan_bwd(engine, 25, end=b"k2") == [(b"k1", b"v2")]
+    assert scan_bwd(engine, 25, start=b"k2") == [(b"k4", b"v4"), (b"k2", b"big" * 200)]
+
+
+def test_mvcc_reader_helpers(engine):
+    r = MvccReader(engine.snapshot())
+    k1 = Key.from_raw(b"k1")
+    # seek_write finds newest <= ts
+    commit_ts, w = r.seek_write(k1, 25)
+    assert commit_ts == 20 and w.start_ts == 15
+    assert r.seek_write(k1, 9) is None
+    # txn commit record search
+    recs = r.get_txn_commit_record(k1, 15)
+    assert [(c, w.write_type.name) for c, w in recs] == [(20, "PUT")]
+    lock_key(engine, b"k9", b"k9", start_ts=77)
+    r2 = MvccReader(engine.snapshot())
+    assert r2.load_lock(Key.from_raw(b"k9")).ts == 77
+    locks = r2.scan_locks(None, None)
+    assert [k.to_raw() for k, _ in locks] == [b"k9"]
+    assert r2.stats.lock.get == 1
+
+
+def test_statistics_tracked(engine):
+    from tikv_tpu.storage.mvcc import Statistics
+
+    stats = Statistics()
+    PointGetter(engine.snapshot(), 100, statistics=stats).get(Key.from_raw(b"k1"))
+    assert stats.write.seek >= 1
+    assert stats.total_ops() > 0
+
+
+def test_scan_blocked_by_lock_on_writeless_key(engine):
+    """A prewritten brand-new key (lock, no write record) must block scans."""
+    lock_key(engine, b"k15", b"k15", start_ts=40)  # no CF_WRITE entry for k15
+    with pytest.raises(KeyIsLockedError):
+        scan_fwd(engine, 50)
+    with pytest.raises(KeyIsLockedError):
+        scan_bwd(engine, 50)
+    # below the lock ts, or bypassing it, the scan proceeds
+    assert scan_fwd(engine, 25) == [(b"k1", b"v2"), (b"k2", b"big" * 200), (b"k4", b"v4")]
+    assert len(scan_fwd(engine, 50, bypass_locks=frozenset([40]))) == 2

@@ -1,0 +1,182 @@
+"""Vectorized aggregate functions with partial states.
+
+Re-expression of ``tidb_query_aggr`` (``src/lib.rs:46,63,232`` and
+``impl_{count,sum,avg,first,max_min,bit_op,variance}.rs``).  Like the
+reference's pushdown protocol, AVG emits **two** result columns (count, sum)
+and VAR_POP emits three (count, sum, sum_sq) — the client (TiDB) finishes the
+division, which keeps every state mergeable across partial aggregations (and,
+here, across device shards via ``psum``-style reductions).
+
+Updates are segment reductions: ``update(states, group_ids, data, nulls)``
+with ``np.add.at``/``np.minimum.at`` on CPU; the JAX path implements the same
+states with ``jax.ops.segment_*`` (see jax_eval.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .datatypes import Column, EvalType
+from .rpn import Expr, RpnExpression
+
+_I64_MIN = np.iinfo(np.int64).min
+_I64_MAX = np.iinfo(np.int64).max
+
+
+@dataclass
+class AggDescriptor:
+    """One aggregate call: op over an expression (tipb aggregate Expr)."""
+
+    op: str  # count | sum | avg | min | max | first | bit_and | bit_or | bit_xor | var_pop
+    expr: Expr | None  # None for count(1)
+
+    def n_result_columns(self) -> int:
+        return {"avg": 2, "var_pop": 3}.get(self.op, 1)
+
+
+class AggState:
+    """Per-group vectorized state for one aggregate over one compiled expr."""
+
+    def __init__(self, op: str, input_type: EvalType, frac: int):
+        self.op = op
+        self.input_type = input_type
+        self.frac = frac
+        n0 = 0
+        self.count = np.zeros(n0, dtype=np.int64)
+        if op in ("sum", "avg", "var_pop"):
+            dtype = np.float64 if input_type == EvalType.REAL else np.int64
+            self.sum = np.zeros(n0, dtype=dtype)
+        if op == "var_pop":
+            self.sum_sq = np.zeros(n0, dtype=np.float64)
+        if op in ("min", "max", "first"):
+            if input_type == EvalType.BYTES:
+                self.value = np.empty(n0, dtype=object)
+            else:
+                dtype = np.float64 if input_type == EvalType.REAL else np.int64
+                self.value = np.zeros(n0, dtype=dtype)
+            self.has_value = np.zeros(n0, dtype=bool)
+        if op in ("bit_and", "bit_or", "bit_xor"):
+            init = -1 if op == "bit_and" else 0
+            self.value = np.full(n0, init, dtype=np.int64)
+
+    def grow(self, n_groups: int) -> None:
+        cur = len(self.count)
+        if n_groups <= cur:
+            return
+        add = n_groups - cur
+        self.count = np.concatenate([self.count, np.zeros(add, dtype=np.int64)])
+        if hasattr(self, "sum"):
+            self.sum = np.concatenate([self.sum, np.zeros(add, dtype=self.sum.dtype)])
+        if hasattr(self, "sum_sq"):
+            self.sum_sq = np.concatenate([self.sum_sq, np.zeros(add, dtype=np.float64)])
+        if hasattr(self, "value"):
+            if self.value.dtype == object:
+                ext = np.empty(add, dtype=object)
+            elif self.op == "bit_and":
+                ext = np.full(add, -1, dtype=np.int64)
+            else:
+                ext = np.zeros(add, dtype=self.value.dtype)
+            self.value = np.concatenate([self.value, ext])
+        if hasattr(self, "has_value"):
+            self.has_value = np.concatenate([self.has_value, np.zeros(add, dtype=bool)])
+
+    def update(self, group_ids: np.ndarray, data: np.ndarray | None, nulls: np.ndarray | None) -> None:
+        """Accumulate one batch. group_ids: int array, one per logical row."""
+        op = self.op
+        if op == "count":
+            if nulls is None:  # count(1)
+                np.add.at(self.count, group_ids, 1)
+            else:
+                np.add.at(self.count, group_ids, (~nulls).astype(np.int64))
+            return
+        mask = ~nulls
+        if not mask.any():
+            return
+        g = group_ids[mask]
+        d = data[mask]
+        np.add.at(self.count, g, 1)
+        if op in ("sum", "avg"):
+            np.add.at(self.sum, g, d)
+        elif op == "var_pop":
+            np.add.at(self.sum, g, d)
+            np.add.at(self.sum_sq, g, d.astype(np.float64) ** 2)
+        elif op == "min":
+            self._minmax(g, d, is_min=True)
+        elif op == "max":
+            self._minmax(g, d, is_min=False)
+        elif op == "first":
+            # first non-null value per group in stream order: only groups not
+            # yet seen can take a value, and np.unique(return_index) yields
+            # each new group's earliest row in this batch
+            new_mask = ~self.has_value[g]
+            if new_mask.any():
+                g_new = g[new_mask]
+                d_new = d[new_mask]
+                uniq, first_idx = np.unique(g_new, return_index=True)
+                self.value[uniq] = d_new[first_idx]
+                self.has_value[uniq] = True
+        elif op == "bit_and":
+            np.bitwise_and.at(self.value, g, d)
+        elif op == "bit_or":
+            np.bitwise_or.at(self.value, g, d)
+        elif op == "bit_xor":
+            np.bitwise_xor.at(self.value, g, d)
+        else:
+            raise ValueError(f"unknown aggregate {op}")
+
+    def _minmax(self, g, d, is_min: bool) -> None:
+        if self.value.dtype == object:
+            for gi, di in zip(g, d):
+                if not self.has_value[gi]:
+                    self.value[gi] = di
+                elif (di < self.value[gi]) == is_min and di != self.value[gi]:
+                    self.value[gi] = di
+            self.has_value[g] = True
+            return
+        # seed never-seen groups with the identity sentinel, then accumulate
+        if d.dtype.kind == "f":
+            sentinel = np.inf if is_min else -np.inf
+        else:
+            sentinel = _I64_MAX if is_min else _I64_MIN
+        unseen = np.unique(g[~self.has_value[g]])
+        self.value[unseen] = sentinel
+        self.has_value[g] = True
+        (np.minimum if is_min else np.maximum).at(self.value, g, d)
+
+    def result_columns(self, n_groups: int) -> list[Column]:
+        """Finalize into result columns (count/sum layouts per class docstring)."""
+        op = self.op
+        zeros = np.zeros(n_groups, dtype=bool)
+        if op == "count":
+            return [Column(EvalType.INT, self.count[:n_groups], zeros)]
+        if op == "sum":
+            et = EvalType.REAL if self.input_type == EvalType.REAL else self.input_type
+            return [
+                Column(et, self.sum[:n_groups], self.count[:n_groups] == 0, self.frac)
+            ]
+        if op == "avg":
+            et = EvalType.REAL if self.input_type == EvalType.REAL else self.input_type
+            return [
+                Column(EvalType.INT, self.count[:n_groups], zeros),
+                Column(et, self.sum[:n_groups], self.count[:n_groups] == 0, self.frac),
+            ]
+        if op == "var_pop":
+            return [
+                Column(EvalType.INT, self.count[:n_groups], zeros),
+                Column(EvalType.REAL, self.sum[:n_groups].astype(np.float64), self.count[:n_groups] == 0),
+                Column(EvalType.REAL, self.sum_sq[:n_groups], self.count[:n_groups] == 0),
+            ]
+        if op in ("min", "max", "first"):
+            return [
+                Column(
+                    self.input_type,
+                    self.value[:n_groups],
+                    ~self.has_value[:n_groups],
+                    self.frac,
+                )
+            ]
+        if op in ("bit_and", "bit_or", "bit_xor"):
+            return [Column(EvalType.INT, self.value[:n_groups], zeros)]
+        raise ValueError(op)

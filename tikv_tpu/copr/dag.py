@@ -1,0 +1,250 @@
+"""DAG request model + executor-chain runner + response encoding.
+
+Re-expression of tipb's ``DagRequest``/executor descriptors and the
+``BatchExecutorsRunner`` (``tidb_query_executors/src/runner.rs:41``):
+
+* descriptors (dataclasses standing in for the tipb protos) describe the
+  executor chain: scan leaf → selection → aggregation/topN → limit
+* ``build_executors`` (runner.rs:150) assembles the chain
+* ``handle_request`` (runner.rs:399) drives ``next_batch`` with the 32→×2→1024
+  growing batch size and encodes output rows into datum-encoded chunks
+  (``SelectResponse``-equivalent), chunked every 1024 rows
+
+Response bytes are produced by a deterministic encoder so the CPU oracle and
+the TPU path can be compared byte-for-byte (the BASELINE.json contract).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..util import codec
+from . import datum as datum_mod
+from .aggr import AggDescriptor
+from .datatypes import Chunk, Column, ColumnInfo, EvalType
+from .executors import (
+    BATCH_GROW_FACTOR,
+    BATCH_INITIAL_SIZE,
+    BATCH_MAX_SIZE,
+    BatchExecutor,
+    BatchHashAggregationExecutor,
+    BatchIndexScanExecutor,
+    BatchLimitExecutor,
+    BatchSelectionExecutor,
+    BatchSimpleAggregationExecutor,
+    BatchStreamAggregationExecutor,
+    BatchTableScanExecutor,
+    BatchTopNExecutor,
+    FixtureScanSource,
+    MvccScanSource,
+    ScanSource,
+)
+from .rpn import Expr
+
+# ---------------------------------------------------------------------------
+# Executor descriptors (tipb::Executor equivalents)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TableScan:
+    table_id: int
+    columns_info: list[ColumnInfo]
+
+
+@dataclass
+class IndexScan:
+    table_id: int
+    index_id: int
+    columns_info: list[ColumnInfo]
+
+
+@dataclass
+class Selection:
+    conditions: list[Expr]
+
+
+@dataclass
+class Aggregation:
+    group_by: list[Expr]
+    agg_funcs: list[AggDescriptor]
+    streamed: bool = False
+
+
+@dataclass
+class TopN:
+    order_by: list[tuple[Expr, bool]]  # (expr, desc)
+    limit: int
+
+
+@dataclass
+class Limit:
+    limit: int
+
+
+ExecutorDescriptor = TableScan | IndexScan | Selection | Aggregation | TopN | Limit
+
+
+@dataclass
+class DagRequest:
+    """The pushed-down plan (tipb::DagRequest equivalent)."""
+
+    executors: list[ExecutorDescriptor]
+    output_offsets: list[int] | None = None  # None = all columns
+    chunk_rows: int = 1024
+
+
+@dataclass
+class ExecSummary:
+    """Per-executor execution summary (tidb_query_common/src/execute_stats.rs)."""
+
+    num_produced_rows: int = 0
+    num_iterations: int = 0
+
+
+@dataclass
+class SelectResponse:
+    chunks: list[bytes]
+    exec_summaries: list[ExecSummary] = field(default_factory=list)
+    warnings: list[str] = field(default_factory=list)
+
+    def encode(self) -> bytes:
+        """Deterministic wire encoding — the byte-identity contract surface."""
+        out = bytearray()
+        out += codec.encode_var_u64(len(self.chunks))
+        for c in self.chunks:
+            out += codec.encode_var_u64(len(c))
+            out += c
+        out += codec.encode_var_u64(len(self.warnings))
+        for w in self.warnings:
+            wb = w.encode()
+            out += codec.encode_var_u64(len(wb))
+            out += wb
+        return bytes(out)
+
+    def iter_rows(self) -> list[list]:
+        """Decode all chunks back into python rows (test convenience)."""
+        rows = []
+        for chunk in self.chunks:
+            off = 0
+            while off < len(chunk):
+                ncols, off = codec.decode_var_u64(chunk, off)
+                row = []
+                for _ in range(ncols):
+                    d, off = datum_mod.decode_datum(chunk, off)
+                    row.append(d.value)
+                rows.append(row)
+        return rows
+
+
+# ---------------------------------------------------------------------------
+# Runner
+# ---------------------------------------------------------------------------
+
+def check_supported(dag: DagRequest) -> None:
+    """Raise ValueError for plans the batch pipeline cannot run
+    (runner.rs:75 check_supported; Join/Projection/Exchange unsupported there
+    too — they are TiDB/TiFlash-side operators)."""
+    if not dag.executors:
+        raise ValueError("empty executor list")
+    if not isinstance(dag.executors[0], (TableScan, IndexScan)):
+        raise ValueError("first executor must be a scan")
+    for e in dag.executors[1:]:
+        if isinstance(e, (TableScan, IndexScan)):
+            raise ValueError("scan executor must be the leaf")
+        if not isinstance(e, (Selection, Aggregation, TopN, Limit)):
+            raise ValueError(f"unsupported executor {type(e).__name__}")
+
+
+def build_executors(dag: DagRequest, source: ScanSource) -> BatchExecutor:
+    """runner.rs:150 build_executors equivalent."""
+    check_supported(dag)
+    head = dag.executors[0]
+    if isinstance(head, TableScan):
+        ex: BatchExecutor = BatchTableScanExecutor(source, head.columns_info)
+    else:
+        from .table import index_range
+
+        prefix_len = len(index_range(head.table_id, head.index_id)[0])
+        ex = BatchIndexScanExecutor(source, head.columns_info, prefix_len)
+    for desc in dag.executors[1:]:
+        if isinstance(desc, Selection):
+            ex = BatchSelectionExecutor(ex, desc.conditions)
+        elif isinstance(desc, Aggregation):
+            if not desc.group_by:
+                ex = BatchSimpleAggregationExecutor(ex, desc.agg_funcs)
+            elif desc.streamed:
+                ex = BatchStreamAggregationExecutor(ex, desc.group_by, desc.agg_funcs)
+            else:
+                ex = BatchHashAggregationExecutor(ex, desc.group_by, desc.agg_funcs)
+        elif isinstance(desc, TopN):
+            ex = BatchTopNExecutor(ex, desc.order_by, desc.limit)
+        elif isinstance(desc, Limit):
+            ex = BatchLimitExecutor(ex, desc.limit)
+        else:
+            raise AssertionError(desc)
+    return ex
+
+
+class ResponseEncoder:
+    """Row-exact chunk framer: a new chunk starts every ``chunk_rows`` rows,
+    independent of producer batch boundaries — so the CPU and device paths
+    emit byte-identical framing for identical row streams."""
+
+    def __init__(self, chunk_rows: int):
+        self.chunk_rows = chunk_rows
+        self.chunks: list[bytes] = []
+        self._cur = bytearray()
+        self._rows = 0
+
+    def add_chunk(self, chunk: Chunk, output_offsets: list[int] | None) -> int:
+        cols = (
+            chunk.columns
+            if output_offsets is None
+            else [chunk.columns[i] for i in output_offsets]
+        )
+        n = 0
+        for row in chunk.logical_rows:
+            self._cur += codec.encode_var_u64(len(cols))
+            for c in cols:
+                flag, value = c.datum_at(int(row))
+                datum_mod.encode_datum(self._cur, flag, value)
+            n += 1
+            self._rows += 1
+            if self._rows == self.chunk_rows:
+                self.chunks.append(bytes(self._cur))
+                self._cur = bytearray()
+                self._rows = 0
+        return n
+
+    def finish(self) -> list[bytes]:
+        if self._rows:
+            self.chunks.append(bytes(self._cur))
+            self._cur = bytearray()
+            self._rows = 0
+        return self.chunks
+
+
+class BatchExecutorsRunner:
+    """Drive loop (runner.rs:399)."""
+
+    def __init__(self, dag: DagRequest, source: ScanSource):
+        self.dag = dag
+        self.executor = build_executors(dag, source)
+        self.summary = ExecSummary()
+
+    def handle_request(self) -> SelectResponse:
+        enc = ResponseEncoder(self.dag.chunk_rows)
+        batch_size = BATCH_INITIAL_SIZE
+        while True:
+            r = self.executor.next_batch(batch_size)
+            self.summary.num_iterations += 1
+            if r.chunk.num_rows:
+                enc.add_chunk(r.chunk, self.dag.output_offsets)
+                self.summary.num_produced_rows += r.chunk.num_rows
+            if r.is_drained:
+                break
+            if batch_size < BATCH_MAX_SIZE:
+                batch_size = min(batch_size * BATCH_GROW_FACTOR, BATCH_MAX_SIZE)
+        return SelectResponse(chunks=enc.finish(), exec_summaries=[self.summary])

@@ -1,0 +1,232 @@
+"""Columnar type system for the query engine.
+
+Re-expression of ``tidb_query_datatype``: the ``EvalType`` lattice
+(``src/def/eval_type.rs:11``), ``FieldType``, and the columnar containers
+(``src/codec/data_type/vector.rs`` ``VectorValue``/``ChunkedVec*``).
+
+TPU-first design decisions:
+
+* Every numeric column is a dense numpy array + a boolean null mask — the
+  exact layout device transfer wants (two host buffers → two device arrays),
+  instead of the reference's per-type chunked vectors.
+* ``DECIMAL`` is fixed-point: int64 scaled by ``10^frac`` (frac carried on the
+  FieldType).  Exact, orderable, and vectorizes onto integer lanes.
+* ``BYTES`` columns are numpy object arrays on host.  For device execution the
+  group-by path dictionary-encodes them to int32 codes first (see jax_eval).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from . import datum as datum_mod
+
+
+class EvalType(enum.Enum):
+    INT = "int"
+    REAL = "real"
+    DECIMAL = "decimal"
+    BYTES = "bytes"
+    DATETIME = "datetime"  # packed int64 (μs since epoch)
+    DURATION = "duration"  # int64 nanoseconds
+    JSON = "json"
+
+
+# MySQL type codes (subset; tidb_query_datatype/src/def/field_type.rs)
+class FieldTypeTp(enum.IntEnum):
+    TINY = 1
+    SHORT = 2
+    LONG = 3
+    FLOAT = 4
+    DOUBLE = 5
+    NULL = 6
+    TIMESTAMP = 7
+    LONGLONG = 8
+    INT24 = 9
+    DATE = 10
+    DURATION = 11
+    DATETIME = 12
+    NEW_DECIMAL = 246
+    BLOB = 252
+    VAR_STRING = 253
+    STRING = 254
+
+
+UNSIGNED_FLAG = 1 << 5
+NOT_NULL_FLAG = 1 << 0
+PRI_KEY_FLAG = 1 << 1
+
+
+_TP_TO_EVAL = {
+    FieldTypeTp.TINY: EvalType.INT,
+    FieldTypeTp.SHORT: EvalType.INT,
+    FieldTypeTp.LONG: EvalType.INT,
+    FieldTypeTp.LONGLONG: EvalType.INT,
+    FieldTypeTp.INT24: EvalType.INT,
+    FieldTypeTp.FLOAT: EvalType.REAL,
+    FieldTypeTp.DOUBLE: EvalType.REAL,
+    FieldTypeTp.NEW_DECIMAL: EvalType.DECIMAL,
+    FieldTypeTp.TIMESTAMP: EvalType.DATETIME,
+    FieldTypeTp.DATE: EvalType.DATETIME,
+    FieldTypeTp.DATETIME: EvalType.DATETIME,
+    FieldTypeTp.DURATION: EvalType.DURATION,
+    FieldTypeTp.BLOB: EvalType.BYTES,
+    FieldTypeTp.VAR_STRING: EvalType.BYTES,
+    FieldTypeTp.STRING: EvalType.BYTES,
+}
+
+
+@dataclass
+class FieldType:
+    tp: FieldTypeTp = FieldTypeTp.LONGLONG
+    flag: int = 0
+    flen: int = -1
+    decimal: int = 0  # frac digits for NEW_DECIMAL
+    collation: str = "binary"
+
+    @property
+    def eval_type(self) -> EvalType:
+        return _TP_TO_EVAL[self.tp]
+
+    @property
+    def is_unsigned(self) -> bool:
+        return bool(self.flag & UNSIGNED_FLAG)
+
+    @classmethod
+    def int64(cls, unsigned: bool = False) -> "FieldType":
+        return cls(FieldTypeTp.LONGLONG, UNSIGNED_FLAG if unsigned else 0)
+
+    @classmethod
+    def double(cls) -> "FieldType":
+        return cls(FieldTypeTp.DOUBLE)
+
+    @classmethod
+    def decimal_type(cls, frac: int) -> "FieldType":
+        return cls(FieldTypeTp.NEW_DECIMAL, decimal=frac)
+
+    @classmethod
+    def varchar(cls) -> "FieldType":
+        return cls(FieldTypeTp.VAR_STRING)
+
+
+@dataclass
+class ColumnInfo:
+    """Schema entry for a table/index scan (tipb ColumnInfo equivalent)."""
+
+    col_id: int
+    ftype: FieldType
+    is_pk_handle: bool = False
+    default_value: object = None
+
+
+class Column:
+    """One columnar vector: dense values + null mask (True = NULL).
+
+    The reference keeps NULLs implicit per chunked vec; here the mask is an
+    explicit numpy bool array so that it ships to the device as-is and
+    selection stays a mask operation (never a gather — static shapes).
+    """
+
+    __slots__ = ("eval_type", "data", "nulls", "frac")
+
+    def __init__(self, eval_type: EvalType, data, nulls: np.ndarray, frac: int = 0):
+        self.eval_type = eval_type
+        self.data = data
+        self.nulls = nulls
+        self.frac = frac  # decimal scale
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+    @classmethod
+    def from_values(cls, eval_type: EvalType, values: list, frac: int = 0) -> "Column":
+        """Build from a python list, None meaning NULL."""
+        n = len(values)
+        nulls = np.array([v is None for v in values], dtype=bool)
+        if eval_type in (EvalType.INT, EvalType.DATETIME, EvalType.DURATION, EvalType.DECIMAL):
+            data = np.array([0 if v is None else v for v in values], dtype=np.int64)
+        elif eval_type == EvalType.REAL:
+            data = np.array([0.0 if v is None else v for v in values], dtype=np.float64)
+        elif eval_type == EvalType.BYTES:
+            data = np.empty(n, dtype=object)
+            for i, v in enumerate(values):
+                data[i] = b"" if v is None else v
+        else:
+            raise ValueError(f"unsupported eval type {eval_type}")
+        return cls(eval_type, data, nulls, frac)
+
+    def to_values(self) -> list:
+        return [None if null else _pyval(self.eval_type, v) for v, null in zip(self.data, self.nulls)]
+
+    def take(self, indices: np.ndarray) -> "Column":
+        return Column(self.eval_type, self.data[indices], self.nulls[indices], self.frac)
+
+    def slice(self, start: int, stop: int) -> "Column":
+        return Column(self.eval_type, self.data[start:stop], self.nulls[start:stop], self.frac)
+
+    @classmethod
+    def concat(cls, cols: list["Column"]) -> "Column":
+        assert cols
+        return cls(
+            cols[0].eval_type,
+            np.concatenate([c.data for c in cols]),
+            np.concatenate([c.nulls for c in cols]),
+            cols[0].frac,
+        )
+
+    def datum_at(self, i: int) -> tuple[int, object]:
+        """(flag, value) pair for datum encoding of row ``i``."""
+        if self.nulls[i]:
+            return datum_mod.NIL_FLAG, None
+        if self.eval_type == EvalType.INT:
+            return datum_mod.INT_FLAG, int(self.data[i])
+        if self.eval_type == EvalType.REAL:
+            return datum_mod.FLOAT_FLAG, float(self.data[i])
+        if self.eval_type == EvalType.DECIMAL:
+            return datum_mod.DECIMAL_FLAG, (int(self.data[i]), self.frac)
+        if self.eval_type == EvalType.BYTES:
+            return datum_mod.BYTES_FLAG, bytes(self.data[i])
+        if self.eval_type == EvalType.DURATION:
+            return datum_mod.DURATION_FLAG, int(self.data[i])
+        if self.eval_type == EvalType.DATETIME:
+            return datum_mod.UINT_FLAG, int(self.data[i])
+        raise ValueError(f"unsupported eval type {self.eval_type}")
+
+
+def _pyval(et: EvalType, v):
+    if et == EvalType.REAL:
+        return float(v)
+    if et == EvalType.BYTES:
+        return bytes(v)
+    return int(v)
+
+
+@dataclass
+class Chunk:
+    """A batch of columns with a shared logical row selection.
+
+    ``logical_rows`` mirrors BatchExecuteResult.logical_rows
+    (tidb_query_executors/src/interface.rs:144): executors filter by updating
+    the selection, not by physically compacting — same trick the TPU path uses
+    with masks.
+    """
+
+    columns: list[Column]
+    logical_rows: np.ndarray  # int indices into the physical rows
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.logical_rows)
+
+    @classmethod
+    def full(cls, columns: list[Column]) -> "Chunk":
+        n = len(columns[0]) if columns else 0
+        return cls(columns, np.arange(n))
+
+    def compact(self) -> "Chunk":
+        """Physically apply the selection."""
+        cols = [c.take(self.logical_rows) for c in self.columns]
+        return Chunk(cols, np.arange(len(self.logical_rows)))

@@ -1,0 +1,508 @@
+"""JAX/TPU DAG evaluator — the coprocessor's device execution backend.
+
+This is the subsystem the whole build aims at (BASELINE.json north star): DAGs
+whose shape fits (TableScan → Selection? → Aggregation? → TopN?/Limit?) run as
+ONE jitted XLA program per fixed-size row block, with aggregation carry state
+living on device across blocks:
+
+    host: MVCC scan → RowBatchDecoder → numpy columns → pad to block shape
+    device (jit): RPN predicates → mask; RPN agg args; segment reductions
+    host: finalize via the same AggState/encoder as the CPU path
+
+Design rules (see SURVEY.md §7):
+* fixed block shapes + validity masks — never dynamic shapes, so XLA compiles
+  exactly once per (plan, block, group-capacity bucket)
+* selection = mask, never gather
+* group ids are dictionary codes assigned on host in first-occurrence stream
+  order — which makes group output order *identical* to the CPU hash-agg's
+  insertion order, so responses match byte-for-byte
+* all-int/decimal pipelines are exact on device (int64 lanes); REAL sums are
+  float and may differ from CPU in last-ulp rounding (documented caveat)
+* the per-block step is dispatched asynchronously: block N+1 is decoded on
+  host while block N runs on device (runner.rs's 1ms-yield loop becomes
+  pipelining)
+
+The reference CPU path stays the default and the correctness oracle, exactly
+like the plugin gating described in src/coprocessor/endpoint.rs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+
+# Exact int64 lanes are a correctness requirement (decimal sums, counts over
+# 100M rows): without x64, jnp silently downcasts to int32 and aggregates
+# overflow.  Must be set before any jnp array is created.
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+from .aggr import AggDescriptor, AggState
+from .dag import (
+    Aggregation,
+    DagRequest,
+    Limit,
+    ResponseEncoder,
+    SelectResponse,
+    Selection,
+    TableScan,
+    TopN,
+)
+from .datatypes import Chunk, Column, EvalType
+from .executors import BatchTopNExecutor, ScanSource
+from .rpn import RpnExpression, compile_expr, eval_rpn
+from .table import RowBatchDecoder, decode_record_key
+
+DEFAULT_BLOCK_ROWS = 1 << 16
+_GROUP_CAPACITY_START = 1024
+
+_DEVICE_AGG_OPS = {"count", "sum", "avg", "min", "max", "var_pop"}
+_DEVICE_EVAL_TYPES = {EvalType.INT, EvalType.REAL, EvalType.DECIMAL, EvalType.DATETIME, EvalType.DURATION}
+
+
+# ---------------------------------------------------------------------------
+# Eligibility (the endpoint's routing predicate)
+# ---------------------------------------------------------------------------
+
+def supports(dag: DagRequest) -> bool:
+    """True if this DAG can run on the device path."""
+    try:
+        _analyze(dag)
+        return True
+    except (_Unsupported, ValueError):
+        return False
+
+
+class _Unsupported(Exception):
+    pass
+
+
+@dataclass
+class _Plan:
+    scan: TableScan
+    selection: Selection | None
+    agg: Aggregation | None
+    topn: TopN | None
+    limit: Limit | None
+
+
+def _analyze(dag: DagRequest) -> _Plan:
+    execs = list(dag.executors)
+    if not execs or not isinstance(execs[0], TableScan):
+        raise _Unsupported("leaf must be TableScan")
+    scan = execs[0]
+    rest = execs[1:]
+    plan = _Plan(scan, None, None, None, None)
+    stage = 0  # 0=selection allowed, 1=agg allowed, 2=topn/limit allowed
+    for e in rest:
+        if isinstance(e, Selection) and stage == 0 and plan.selection is None:
+            plan.selection = e
+        elif isinstance(e, Aggregation) and stage <= 1 and plan.agg is None:
+            plan.agg = e
+            stage = 2
+        elif isinstance(e, TopN) and plan.topn is None and plan.limit is None:
+            # TopN over raw scan output would need full row retention on
+            # device; only the post-aggregation (small) case is device-routed
+            if plan.agg is None:
+                raise _Unsupported("TopN without aggregation stays on CPU")
+            plan.topn = e
+            stage = 3
+        elif isinstance(e, Limit) and plan.limit is None:
+            plan.limit = e
+            stage = 3
+        else:
+            raise _Unsupported(f"executor {type(e).__name__} not device-routable here")
+    schema = [(c.ftype.eval_type, c.ftype.decimal) for c in scan.columns_info]
+    for et, _ in schema:
+        if et not in _DEVICE_EVAL_TYPES and et != EvalType.BYTES:
+            raise _Unsupported(f"column type {et}")
+    if plan.selection is not None:
+        for cond in plan.selection.conditions:
+            rpn = compile_expr(cond, schema)
+            _check_rpn_device(rpn, schema)
+    if plan.agg is not None:
+        for a in plan.agg.agg_funcs:
+            if a.op not in _DEVICE_AGG_OPS:
+                raise _Unsupported(f"aggregate {a.op}")
+            if a.expr is not None:
+                rpn = compile_expr(a.expr, schema)
+                _check_rpn_device(rpn, schema)
+        # group-by exprs are evaluated on host (numpy) then dictionary-encoded,
+        # so BYTES group keys are fine; exprs just need compilable kernels
+        for g in plan.agg.group_by:
+            compile_expr(g, schema)
+    return plan
+
+
+def _check_rpn_device(rpn: RpnExpression, schema) -> None:
+    for node in rpn.nodes:
+        if node.eval_type == EvalType.BYTES or node.eval_type == EvalType.JSON:
+            raise _Unsupported("bytes in device expression")
+
+
+# ---------------------------------------------------------------------------
+# Device block step
+# ---------------------------------------------------------------------------
+
+def _np_dtype(et: EvalType):
+    return np.float64 if et == EvalType.REAL else np.int64
+
+
+class _DeviceAgg:
+    """Builds the jitted block update + carry init for one aggregate."""
+
+    def __init__(self, op: str, rpn: RpnExpression | None):
+        self.op = op
+        self.rpn = rpn
+        self.input_type = rpn.eval_type if rpn is not None else EvalType.INT
+        self.frac = rpn.frac if rpn is not None else 0
+        self.dtype = _np_dtype(self.input_type)
+
+    def init_carry(self, capacity: int):
+        z_i = jnp.zeros(capacity, dtype=jnp.int64)
+        if self.op == "count":
+            return (z_i,)
+        z_v = jnp.zeros(capacity, dtype=self.dtype)
+        if self.op in ("sum", "avg"):
+            return (z_i, z_v)
+        if self.op == "var_pop":
+            return (z_i, z_v, jnp.zeros(capacity, dtype=jnp.float64))
+        if self.op in ("min", "max"):
+            if self.dtype == np.float64:
+                ident = jnp.inf if self.op == "min" else -jnp.inf
+            else:
+                info = np.iinfo(np.int64)
+                ident = info.max if self.op == "min" else info.min
+            return (z_i, jnp.full(capacity, ident, dtype=self.dtype))
+        raise AssertionError(self.op)
+
+    def update(self, carry, cols, n_rows, gids, active, capacity):
+        """One block update. ``active``: row mask after selection+validity."""
+        if self.rpn is None:
+            data, nulls = None, None
+            live = active
+        else:
+            data, nulls = eval_rpn(self.rpn, cols, n_rows, xp=jnp)
+            live = active & ~nulls
+        seg = lambda x: jax.ops.segment_sum(x, gids, num_segments=capacity)
+        cnt = carry[0] + seg(live.astype(jnp.int64))
+        if self.op == "count":
+            return (cnt,)
+        vals = jnp.where(live, data, jnp.zeros_like(data))
+        if self.op in ("sum", "avg"):
+            return (cnt, carry[1] + seg(vals))
+        if self.op == "var_pop":
+            f = jnp.where(live, data.astype(jnp.float64), 0.0)
+            return (cnt, carry[1] + seg(vals), carry[2] + seg(f * f))
+        if self.op in ("min", "max"):
+            if self.dtype == np.float64:
+                ident = jnp.inf if self.op == "min" else -jnp.inf
+            else:
+                info = np.iinfo(np.int64)
+                ident = info.max if self.op == "min" else info.min
+            masked = jnp.where(live, data, jnp.full_like(data, ident))
+            segfn = jax.ops.segment_min if self.op == "min" else jax.ops.segment_max
+            blockv = segfn(masked, gids, num_segments=capacity, indices_are_sorted=False)
+            merge = jnp.minimum if self.op == "min" else jnp.maximum
+            return (cnt, merge(carry[1], blockv))
+        raise AssertionError(self.op)
+
+    def to_state(self, carry, n_groups: int) -> AggState:
+        """Fill a CPU AggState from the device carry — finalization then goes
+        through the exact same result_columns code as the CPU path."""
+        st = AggState(self.op, self.input_type, self.frac)
+        st.grow(n_groups)
+        count = np.asarray(carry[0])[:n_groups]
+        st.count = count.astype(np.int64)
+        if self.op in ("sum", "avg"):
+            st.sum = np.asarray(carry[1])[:n_groups].astype(st.sum.dtype if len(st.sum) else self.dtype)
+        elif self.op == "var_pop":
+            st.sum = np.asarray(carry[1])[:n_groups]
+            st.sum_sq = np.asarray(carry[2])[:n_groups]
+        elif self.op in ("min", "max"):
+            st.value = np.asarray(carry[1])[:n_groups]
+            st.has_value = count > 0
+        return st
+
+
+class JaxDagEvaluator:
+    """Run an eligible DAG over a scan source on the device."""
+
+    def __init__(self, dag: DagRequest, block_rows: int = DEFAULT_BLOCK_ROWS):
+        self.dag = dag
+        self.plan = _analyze(dag)
+        self.block_rows = block_rows
+        scan = self.plan.scan
+        self.schema = [(c.ftype.eval_type, c.ftype.decimal) for c in scan.columns_info]
+        self.decoder = RowBatchDecoder(scan.columns_info)
+        self.sel_rpns = (
+            [compile_expr(c, self.schema) for c in self.plan.selection.conditions]
+            if self.plan.selection
+            else []
+        )
+        agg = self.plan.agg
+        if agg is not None:
+            self.group_rpns = [compile_expr(g, self.schema) for g in agg.group_by]
+            self.device_aggs = [
+                _DeviceAgg(a.op, compile_expr(a.expr, self.schema) if a.expr else None)
+                for a in agg.agg_funcs
+            ]
+        else:
+            self.group_rpns = []
+            self.device_aggs = []
+        # which leaf columns must ship to the device
+        need: set[int] = set()
+        for r in self.sel_rpns:
+            need |= r.referenced_columns()
+        for da in self.device_aggs:
+            if da.rpn is not None:
+                need |= da.rpn.referenced_columns()
+        self.device_cols = sorted(need)
+        self._block_fn = None
+        self._capacity = _GROUP_CAPACITY_START if self.group_rpns else 1
+
+    # -- jit construction --------------------------------------------------
+
+    def _build_mask_fn(self):
+        sel_rpns = self.sel_rpns
+        device_cols = self.device_cols
+        n_rows = self.block_rows
+
+        def mask_fn(col_data, col_nulls, valid):
+            cols = {i: (col_data[j], col_nulls[j]) for j, i in enumerate(device_cols)}
+            active = valid
+            for rpn in sel_rpns:
+                d, nl = eval_rpn(rpn, cols, n_rows, xp=jnp)
+                active = active & (d != 0) & ~nl
+            return active
+
+        return jax.jit(mask_fn)
+
+    def _build_agg_fn(self, capacity: int):
+        device_aggs = self.device_aggs
+        device_cols = self.device_cols
+        n_rows = self.block_rows
+
+        def agg_fn(col_data, col_nulls, active, gids, carries):
+            cols = {i: (col_data[j], col_nulls[j]) for j, i in enumerate(device_cols)}
+            new_carries = tuple(
+                da.update(c, cols, n_rows, gids, active, capacity)
+                for da, c in zip(device_aggs, carries)
+            )
+            return new_carries
+
+        return jax.jit(agg_fn, donate_argnums=(4,))
+
+    # -- host loop ---------------------------------------------------------
+
+    def run(self, source: ScanSource) -> SelectResponse:
+        if self.plan.agg is not None:
+            return self._run_aggregated(source)
+        return self._run_scan_filter(source)
+
+    def _decode_blocks(self, source: ScanSource):
+        """Yield (columns, n_valid) blocks of exactly block_rows rows (padded)."""
+        br = self.block_rows
+        pend_handles: list[np.ndarray] = []
+        pend_values: list[bytes] = []
+        drained = False
+        while not drained:
+            keys, values, drained = source.next_batch(br)
+            if keys:
+                h = np.empty(len(keys), dtype=np.int64)
+                for i, k in enumerate(keys):
+                    _, h[i] = decode_record_key(k)
+                pend_handles.append(h)
+                pend_values.extend(values)
+            total = sum(len(x) for x in pend_handles)
+            while total >= br or (drained and total > 0):
+                handles = np.concatenate(pend_handles) if len(pend_handles) > 1 else pend_handles[0]
+                take = min(br, total)
+                block_h, rest_h = handles[:take], handles[take:]
+                block_v, rest_v = pend_values[:take], pend_values[take:]
+                pend_handles = [rest_h] if len(rest_h) else []
+                pend_values = rest_v
+                total = len(rest_h)
+                cols = self.decoder.decode(block_h, block_v)
+                yield cols, take
+
+    def _pad(self, arr: np.ndarray, fill=0) -> np.ndarray:
+        n = len(arr)
+        if n == self.block_rows:
+            return arr
+        pad = self.block_rows - n
+        if arr.dtype == object:
+            ext = np.empty(pad, dtype=object)
+            ext[:] = b""
+            return np.concatenate([arr, ext])
+        return np.concatenate([arr, np.full(pad, fill, dtype=arr.dtype)])
+
+    def _run_aggregated(self, source: ScanSource) -> SelectResponse:
+        group_index: dict = {}
+        group_rows: list[tuple] = []
+        capacity = self._capacity
+        mask_fn = self._build_mask_fn() if self.sel_rpns else None
+        agg_fn = self._build_agg_fn(capacity)
+        carries = tuple(da.init_carry(capacity) for da in self.device_aggs)
+
+        for cols, n_valid in self._decode_blocks(source):
+            valid = np.zeros(self.block_rows, dtype=bool)
+            valid[:n_valid] = True
+            col_data = [self._pad(cols[i].data) for i in self.device_cols]
+            col_nulls = [self._pad(cols[i].nulls, True) for i in self.device_cols]
+            if mask_fn is not None:
+                active = np.asarray(mask_fn(col_data, col_nulls, valid))
+            else:
+                active = valid
+            # group ids: host-evaluated group exprs over rows that SURVIVED the
+            # selection (first-occurrence order == CPU hash-agg insertion order)
+            if self.group_rpns:
+                gids_np, n_groups = self._assign_gids(cols, active, group_index, group_rows)
+                if n_groups > capacity:
+                    # grow to the next bucket and re-jit once; carries migrate
+                    new_capacity = capacity
+                    while n_groups > new_capacity:
+                        new_capacity *= 2
+                    carries = tuple(
+                        _grow_carry(da, c, new_capacity) for da, c in zip(self.device_aggs, carries)
+                    )
+                    capacity = new_capacity
+                    self._capacity = capacity
+                    agg_fn = self._build_agg_fn(capacity)
+            else:
+                gids_np = np.zeros(self.block_rows, dtype=np.int32)
+            carries = agg_fn(col_data, col_nulls, active, gids_np, carries)
+
+        n_groups = len(group_rows) if self.group_rpns else 1
+        states = [da.to_state(jax.tree.map(np.asarray, c), n_groups) for da, c in zip(self.device_aggs, carries)]
+        out_cols: list[Column] = []
+        for st in states:
+            out_cols.extend(st.result_columns(n_groups))
+        for gi, g in enumerate(self.group_rpns):
+            vals = [group_rows[r][gi] for r in range(n_groups)]
+            out_cols.append(Column.from_values(g.eval_type, vals, g.frac))
+        chunk = Chunk.full(out_cols)
+        # post-agg TopN / Limit are tiny — run them via the CPU executors
+        chunk = self._post_agg(chunk)
+        enc = ResponseEncoder(self.dag.chunk_rows)
+        enc.add_chunk(chunk, self.dag.output_offsets)
+        return SelectResponse(chunks=enc.finish())
+
+    def _assign_gids(self, cols, active, group_index, group_rows):
+        np_cols = {i: (c.data, c.nulls) for i, c in enumerate(cols)}
+        n = len(cols[0]) if cols else 0
+        parts = []
+        for g in self.group_rpns:
+            d, nl = eval_rpn(g, np_cols, n, xp=np)
+            parts.append((np.asarray(d), np.asarray(nl)))
+        gids = np.zeros(self.block_rows, dtype=np.int32)
+        live_rows = np.flatnonzero(active[:n])
+        if len(parts) == 1:
+            data, nulls = parts[0]
+            keys = [None if nulls[i] else (bytes(data[i]) if data.dtype == object else data[i].item()) for i in live_rows]
+        else:
+            keys = [
+                tuple(
+                    None if nl[i] else (bytes(d[i]) if d.dtype == object else d[i].item())
+                    for d, nl in parts
+                )
+                for i in live_rows
+            ]
+        for i, key in zip(live_rows, keys):
+            gid = group_index.get(key)
+            if gid is None:
+                gid = len(group_rows)
+                group_index[key] = gid
+                group_rows.append(key if isinstance(key, tuple) else (key,))
+            gids[i] = gid
+        return gids, len(group_rows)
+
+    def _post_agg(self, chunk: Chunk) -> Chunk:
+        """Apply TopN/Limit over the (small) aggregated output on host."""
+        schema = None
+        if self.plan.topn is not None:
+            agg_schema = self._agg_output_schema()
+            ex = BatchTopNExecutor(_ChunkExecutor(chunk, agg_schema), self.plan.topn.order_by, self.plan.topn.limit)
+            chunk = ex.next_batch(len(chunk.logical_rows) or 1).chunk
+        if self.plan.limit is not None:
+            chunk = Chunk(chunk.columns, chunk.logical_rows[: self.plan.limit.limit])
+        return chunk
+
+    def _agg_output_schema(self):
+        out = []
+        for da, a in zip(self.device_aggs, self.plan.agg.agg_funcs):
+            it, frac = da.input_type, da.frac
+            if a.op == "count":
+                out.append((EvalType.INT, 0))
+            elif a.op == "avg":
+                out.append((EvalType.INT, 0))
+                out.append((it, frac))
+            elif a.op == "var_pop":
+                out.extend([(EvalType.INT, 0), (EvalType.REAL, 0), (EvalType.REAL, 0)])
+            else:
+                out.append((it, frac))
+        for g in self.group_rpns:
+            out.append((g.eval_type, g.frac))
+        return out
+
+    # -- selection-only pipeline ------------------------------------------
+
+    def _run_scan_filter(self, source: ScanSource) -> SelectResponse:
+        """TableScan → Selection? → Limit?: device computes the row mask,
+        host compacts + encodes (row encoding is host work either way)."""
+        remaining = self.plan.limit.limit if self.plan.limit else None
+        sel_rpns = self.sel_rpns
+        device_cols = self.device_cols
+        mask_jit = self._build_mask_fn()
+        enc = ResponseEncoder(self.dag.chunk_rows)
+        for cols, n_valid in self._decode_blocks(source):
+            valid = np.zeros(self.block_rows, dtype=bool)
+            valid[:n_valid] = True
+            if sel_rpns:
+                col_data = [self._pad(cols[i].data) for i in device_cols]
+                col_nulls = [self._pad(cols[i].nulls, True) for i in device_cols]
+                mask = np.asarray(mask_jit(col_data, col_nulls, valid))
+            else:
+                mask = valid
+            logical = np.flatnonzero(mask[: n_valid])
+            if remaining is not None:
+                logical = logical[:remaining]
+                remaining -= len(logical)
+            chunk = Chunk(cols, logical)
+            enc.add_chunk(chunk, self.dag.output_offsets)
+            if remaining is not None and remaining <= 0:
+                break
+        return SelectResponse(chunks=enc.finish())
+
+
+class _ChunkExecutor:
+    """Adapter: present an in-memory Chunk as a drained BatchExecutor."""
+
+    def __init__(self, chunk: Chunk, schema):
+        self._chunk = chunk
+        self._schema = schema
+        self._done = False
+
+    def schema(self):
+        return self._schema
+
+    def next_batch(self, scan_rows: int):
+        from .executors import BatchExecuteResult
+
+        if self._done:
+            return BatchExecuteResult(Chunk.full([]), True)
+        self._done = True
+        return BatchExecuteResult(self._chunk, True)
+
+
+def _grow_carry(da: _DeviceAgg, carry, new_capacity: int):
+    grown = list(da.init_carry(new_capacity))
+    out = []
+    for old, new in zip(carry, grown):
+        old = jnp.asarray(old)
+        out.append(new.at[: old.shape[0]].set(old))
+    return tuple(out)

@@ -1,0 +1,244 @@
+"""Vectorized scalar-function kernels, backend-parameterized.
+
+The reference implements ~400 MySQL scalar functions as per-type vectorized
+fns (``tidb_query_expr/src/impl_*.rs``).  Here each kernel is written ONCE
+against the array-API module ``xp`` — ``numpy`` for the CPU oracle path,
+``jax.numpy`` inside ``jit`` for the TPU path — so CPU and TPU semantics can
+not drift apart.  A kernel maps (data, null) operand pairs to a (data, null)
+result; SQL three-valued logic lives in the null masks.
+
+Conventions:
+* data arrays: int64 / float64 / bool promoted to int64 on output
+* null mask: bool array, True = NULL
+* comparisons/logical return INT (0/1) like MySQL
+* decimal values are scaled int64; frac bookkeeping happens in rpn.py
+"""
+
+from __future__ import annotations
+
+import operator
+
+# Each entry: name -> (arity, result_kind, fn(xp, *operand_pairs) -> (data, nulls))
+# result_kind: "int" | "real" | "decimal" | "same" (same as first operand) | "bytes"
+
+KERNELS: dict[str, tuple[int, str, object]] = {}
+
+
+def _reg(name: str, arity: int, rkind: str):
+    def deco(fn):
+        KERNELS[name] = (arity, rkind, fn)
+        return fn
+
+    return deco
+
+
+def _binop_nulls(xp, an, bn):
+    return an | bn
+
+
+# -- comparisons ------------------------------------------------------------
+
+def _cmp(pyop):
+    def fn(xp, a, b):
+        (ad, an), (bd, bn) = a, b
+        return pyop(ad, bd).astype("int64"), _binop_nulls(xp, an, bn)
+
+    return fn
+
+
+for _name, _op in [
+    ("lt", operator.lt),
+    ("le", operator.le),
+    ("gt", operator.gt),
+    ("ge", operator.ge),
+    ("eq", operator.eq),
+    ("ne", operator.ne),
+]:
+    KERNELS[_name] = (2, "int", _cmp(_op))
+
+
+# -- logical (MySQL three-valued) ------------------------------------------
+
+@_reg("and", 2, "int")
+def _and(xp, a, b):
+    (ad, an), (bd, bn) = a, b
+    at = (ad != 0) & ~an
+    bt = (bd != 0) & ~bn
+    af = (ad == 0) & ~an
+    bf = (bd == 0) & ~bn
+    data = (at & bt).astype("int64")
+    # false AND anything = false (not null); null only if neither side false
+    nulls = (an | bn) & ~af & ~bf
+    return data, nulls
+
+
+@_reg("or", 2, "int")
+def _or(xp, a, b):
+    (ad, an), (bd, bn) = a, b
+    at = (ad != 0) & ~an
+    bt = (bd != 0) & ~bn
+    data = (at | bt).astype("int64")
+    nulls = (an | bn) & ~at & ~bt
+    return data, nulls
+
+
+@_reg("xor", 2, "int")
+def _xor(xp, a, b):
+    (ad, an), (bd, bn) = a, b
+    return ((ad != 0) ^ (bd != 0)).astype("int64"), an | bn
+
+
+@_reg("not", 1, "int")
+def _not(xp, a):
+    ad, an = a
+    return (ad == 0).astype("int64"), an
+
+
+# -- null predicates --------------------------------------------------------
+
+@_reg("is_null", 1, "int")
+def _is_null(xp, a):
+    ad, an = a
+    return an.astype("int64"), xp.zeros_like(an)
+
+
+@_reg("is_true", 1, "int")
+def _is_true(xp, a):
+    ad, an = a
+    return ((ad != 0) & ~an).astype("int64"), xp.zeros_like(an)
+
+
+@_reg("is_false", 1, "int")
+def _is_false(xp, a):
+    ad, an = a
+    return ((ad == 0) & ~an).astype("int64"), xp.zeros_like(an)
+
+
+# -- arithmetic -------------------------------------------------------------
+
+@_reg("plus", 2, "same")
+def _plus(xp, a, b):
+    (ad, an), (bd, bn) = a, b
+    return ad + bd, an | bn
+
+
+@_reg("minus", 2, "same")
+def _minus(xp, a, b):
+    (ad, an), (bd, bn) = a, b
+    return ad - bd, an | bn
+
+
+@_reg("multiply", 2, "same")
+def _multiply(xp, a, b):
+    (ad, an), (bd, bn) = a, b
+    return ad * bd, an | bn
+
+
+@_reg("divide_real", 2, "real")
+def _divide_real(xp, a, b):
+    (ad, an), (bd, bn) = a, b
+    zero = bd == 0
+    safe = xp.where(zero, xp.ones_like(bd), bd)
+    return ad / safe, an | bn | zero  # MySQL: x/0 = NULL
+
+
+@_reg("int_divide", 2, "int")
+def _int_divide(xp, a, b):
+    (ad, an), (bd, bn) = a, b
+    zero = bd == 0
+    safe = xp.where(zero, xp.ones_like(bd), bd)
+    # MySQL DIV truncates toward zero; _trunc_div corrects python's floor
+    return _trunc_div(xp, ad, safe), an | bn | zero
+
+
+@_reg("mod", 2, "same")
+def _mod(xp, a, b):
+    (ad, an), (bd, bn) = a, b
+    zero = bd == 0
+    safe = xp.where(zero, xp.ones_like(bd), bd)
+    r = ad - (ad / safe if ad.dtype.kind == "f" else _trunc_div(xp, ad, safe)) * safe
+    if ad.dtype.kind == "f":
+        r = xp.fmod(ad, safe)
+    return r, an | bn | zero
+
+
+def _trunc_div(xp, a, b):
+    q = a // b
+    r = a - q * b
+    return xp.where((r != 0) & ((a < 0) ^ (b < 0)), q + 1, q)
+
+
+@_reg("unary_minus", 1, "same")
+def _unary_minus(xp, a):
+    ad, an = a
+    return -ad, an
+
+
+@_reg("abs", 1, "same")
+def _abs(xp, a):
+    ad, an = a
+    return xp.abs(ad), an
+
+
+# -- real math --------------------------------------------------------------
+
+def _realfn(name, f):
+    @_reg(name, 1, "real")
+    def fn(xp, a, _f=f):
+        ad, an = a
+        return _f(xp)(ad), an
+
+    return fn
+
+
+_realfn("sqrt", lambda xp: xp.sqrt)
+_realfn("exp", lambda xp: xp.exp)
+_realfn("sin", lambda xp: xp.sin)
+_realfn("cos", lambda xp: xp.cos)
+_realfn("tan", lambda xp: xp.tan)
+
+
+@_reg("ln", 1, "real")
+def _ln(xp, a):
+    ad, an = a
+    bad = ad <= 0
+    safe = xp.where(bad, xp.ones_like(ad), ad)
+    return xp.log(safe), an | bad
+
+
+@_reg("ceil", 1, "same")
+def _ceil(xp, a):
+    ad, an = a
+    return (xp.ceil(ad) if ad.dtype.kind == "f" else ad), an
+
+
+@_reg("floor", 1, "same")
+def _floor(xp, a):
+    ad, an = a
+    return (xp.floor(ad) if ad.dtype.kind == "f" else ad), an
+
+
+@_reg("pow", 2, "real")
+def _pow(xp, a, b):
+    (ad, an), (bd, bn) = a, b
+    return ad**bd, an | bn
+
+
+# -- control ----------------------------------------------------------------
+
+@_reg("if", 3, "same_2")
+def _if(xp, c, t, f):
+    (cd, cn), (td, tn), (fd, fn_) = c, t, f
+    cond = (cd != 0) & ~cn
+    return xp.where(cond, td, fd), xp.where(cond, tn, fn_)
+
+
+@_reg("if_null", 2, "same")
+def _if_null(xp, a, b):
+    (ad, an), (bd, bn) = a, b
+    return xp.where(an, bd, ad), xp.where(an, bn, xp.zeros_like(an))
+
+
+@_reg("coalesce2", 2, "same")
+def _coalesce2(xp, a, b):
+    return _if_null(xp, a, b)

@@ -1,0 +1,230 @@
+"""Table / index key-value codec.
+
+Re-expression of ``tidb_query_datatype/src/codec/table.rs:22-29``:
+
+* record key:  ``t{table_id:i64}_r{handle:i64}``   (both memcomparable i64)
+* index key:   ``t{table_id:i64}_i{index_id:i64}{datum values for_key}``
+* record value: datum-v1 row (col_id, value) pairs — see ``datum.py``
+
+Plus the columnar **batch decoder** that turns a block of scanned MVCC rows
+into ``Column`` vectors.  When every row in the block shares one fixed-width
+layout (the overwhelmingly common case for numeric schemas — and detectable in
+O(1) per row), decode is a numpy reshape + per-column slice; otherwise a
+per-row datum walk is the fallback.  This is the host side of the host→TPU
+pipeline, so it must not be a Python-per-row loop on the hot path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..util import codec
+from . import datum as datum_mod
+from .datatypes import Column, ColumnInfo, EvalType
+
+TABLE_PREFIX = b"t"
+RECORD_PREFIX_SEP = b"_r"
+INDEX_PREFIX_SEP = b"_i"
+
+
+def record_key(table_id: int, handle: int) -> bytes:
+    return TABLE_PREFIX + codec.encode_i64(table_id) + RECORD_PREFIX_SEP + codec.encode_i64(handle)
+
+
+def record_range(table_id: int) -> tuple[bytes, bytes]:
+    """[start, end) raw-key range covering all records of a table."""
+    prefix = TABLE_PREFIX + codec.encode_i64(table_id) + RECORD_PREFIX_SEP
+    return prefix, prefix[:-1] + bytes([prefix[-1] + 1])
+
+
+def decode_record_key(key: bytes) -> tuple[int, int]:
+    if len(key) != 19 or key[:1] != TABLE_PREFIX or key[9:11] != RECORD_PREFIX_SEP:
+        raise ValueError(f"not a record key: {key!r}")
+    return codec.decode_i64(key, 1), codec.decode_i64(key, 11)
+
+
+def index_key(table_id: int, index_id: int, values: list[tuple[int, object]]) -> bytes:
+    out = bytearray(TABLE_PREFIX + codec.encode_i64(table_id) + INDEX_PREFIX_SEP + codec.encode_i64(index_id))
+    for flag, value in values:
+        datum_mod.encode_datum(out, flag, value, for_key=True)
+    return bytes(out)
+
+
+def index_range(table_id: int, index_id: int) -> tuple[bytes, bytes]:
+    prefix = TABLE_PREFIX + codec.encode_i64(table_id) + INDEX_PREFIX_SEP + codec.encode_i64(index_id)
+    return prefix, prefix[:-1] + bytes([prefix[-1] + 1])
+
+
+def encode_row(columns: list[ColumnInfo], values: list) -> bytes:
+    """Encode one row's non-handle columns as the record value."""
+    out = bytearray()
+    for info, v in zip(columns, values):
+        datum_mod.encode_datum(out, datum_mod.INT_FLAG, info.col_id)
+        if v is None:
+            datum_mod.encode_datum(out, datum_mod.NIL_FLAG, None)
+            continue
+        et = info.ftype.eval_type
+        if et == EvalType.INT:
+            # fixed-width (for_key) int encoding: row blocks with stable
+            # schemas become one reshape + vectorized byte-slice decode
+            flag = datum_mod.UINT_FLAG if info.ftype.is_unsigned else datum_mod.INT_FLAG
+            datum_mod.encode_datum(out, flag, v, for_key=True)
+        elif et == EvalType.REAL:
+            datum_mod.encode_datum(out, datum_mod.FLOAT_FLAG, v)
+        elif et == EvalType.DECIMAL:
+            datum_mod.encode_datum(out, datum_mod.DECIMAL_FLAG, (v, info.ftype.decimal))
+        elif et == EvalType.BYTES:
+            datum_mod.encode_datum(out, datum_mod.BYTES_FLAG, v)
+        elif et in (EvalType.DATETIME, EvalType.DURATION):
+            datum_mod.encode_datum(out, datum_mod.DURATION_FLAG, v)
+        else:
+            raise ValueError(f"unsupported {et}")
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# Batch row→column decode
+# ---------------------------------------------------------------------------
+
+class RowBatchDecoder:
+    """Decode N record (handle, row_value) pairs into Columns for a schema.
+
+    Column resolution per ``BatchTableScanExecutor`` (table_scan_executor.rs):
+    a column marked ``is_pk_handle`` is filled from the key's handle; others
+    come from the row value by col_id; missing col_id ⇒ default value / NULL.
+    """
+
+    def __init__(self, schema: list[ColumnInfo]):
+        self.schema = schema
+        self.handle_idx = [i for i, c in enumerate(schema) if c.is_pk_handle]
+
+    def decode(self, handles: np.ndarray, row_values: list[bytes]) -> list[Column]:
+        n = len(row_values)
+        fast = self._try_fast_decode(row_values)
+        if fast is not None:
+            cols = fast
+        else:
+            cols = self._slow_decode(row_values)
+        # fill handle columns
+        for i in self.handle_idx:
+            cols[i] = Column(EvalType.INT, handles.astype(np.int64), np.zeros(n, dtype=bool))
+        return cols
+
+    # -- fast path: single fixed layout across the block -------------------
+
+    def _try_fast_decode(self, row_values: list[bytes]) -> list[Column] | None:
+        if not row_values:
+            return None
+        first = row_values[0]
+        nbytes = len(first)
+        layout = self._parse_layout(first)
+        if layout is None:
+            return None
+        for rv in row_values:
+            if len(rv) != nbytes:
+                return None
+        buf = np.frombuffer(b"".join(row_values), dtype=np.uint8).reshape(len(row_values), nbytes)
+        # verify every row matches the layout's fixed flag/colid bytes
+        for off in layout["const_offsets"]:
+            if not (buf[:, off] == first[off]).all():
+                return None
+        n = len(row_values)
+        out: list[Column] = []
+        for info in self.schema:
+            if info.is_pk_handle:
+                out.append(Column(EvalType.INT, np.zeros(n, dtype=np.int64), np.zeros(n, dtype=bool)))
+                continue
+            ent = layout["cols"].get(info.col_id)
+            et = info.ftype.eval_type
+            if ent is None:
+                out.append(_default_column(info, n))
+                continue
+            kind, off = ent
+            if kind == "i64":
+                data = codec.decode_i64_batch(buf[:, off : off + 8])
+                out.append(Column(et, data, np.zeros(n, dtype=bool), info.ftype.decimal))
+            elif kind == "u64":
+                data = codec.decode_u64_batch(buf[:, off : off + 8]).view(np.int64)
+                out.append(Column(et, data, np.zeros(n, dtype=bool), info.ftype.decimal))
+            elif kind == "f64":
+                data = codec.decode_f64_batch(buf[:, off : off + 8])
+                out.append(Column(et, data, np.zeros(n, dtype=bool)))
+            else:
+                raise AssertionError(kind)
+        return out
+
+    def _parse_layout(self, row: bytes) -> dict | None:
+        """Walk one row; return fixed offsets if every datum is fixed-width.
+
+        Fixed-width means: INT/UINT/FLOAT/DURATION flags (8-byte payloads) and
+        single-byte varint col-ids.  DECIMAL (1+varint) and BYTES are variable
+        ⇒ fall back.  NULLs make a column's presence row-dependent ⇒ fall back.
+        """
+        cols: dict[int, tuple[str, int]] = {}
+        const_offsets: list[int] = []
+        off = 0
+        while off < len(row):
+            # col id datum: flag VARINT_FLAG + varint
+            if row[off] != datum_mod.VARINT_FLAG:
+                return None
+            const_offsets.append(off)
+            try:
+                cid, noff = codec.decode_var_i64(row, off + 1)
+            except ValueError:
+                return None
+            for o in range(off + 1, noff):
+                const_offsets.append(o)
+            off = noff
+            if off >= len(row):
+                return None
+            flag = row[off]
+            const_offsets.append(off)
+            if flag == datum_mod.INT_FLAG:
+                cols[cid] = ("i64", off + 1)
+                off += 9
+            elif flag == datum_mod.UINT_FLAG:
+                cols[cid] = ("u64", off + 1)
+                off += 9
+            elif flag == datum_mod.FLOAT_FLAG:
+                cols[cid] = ("f64", off + 1)
+                off += 9
+            elif flag == datum_mod.DURATION_FLAG:
+                cols[cid] = ("i64", off + 1)
+                off += 9
+            elif flag == datum_mod.DECIMAL_FLAG:
+                # frac byte is part of the constant layout; payload is fixed i64
+                const_offsets.append(off + 1)
+                cols[cid] = ("i64", off + 2)
+                off += 10
+            else:
+                return None
+        return {"cols": cols, "const_offsets": const_offsets}
+
+    # -- slow path: per-row datum walk -------------------------------------
+
+    def _slow_decode(self, row_values: list[bytes]) -> list[Column]:
+        n = len(row_values)
+        rows = [datum_mod.decode_row_value(rv) for rv in row_values]
+        out: list[Column] = []
+        for info in self.schema:
+            if info.is_pk_handle:
+                out.append(Column(EvalType.INT, np.zeros(n, dtype=np.int64), np.zeros(n, dtype=bool)))
+                continue
+            et = info.ftype.eval_type
+            values = []
+            for r in rows:
+                d = r.get(info.col_id)
+                if d is None or d.flag == datum_mod.NIL_FLAG:
+                    values.append(None if info.default_value is None else info.default_value)
+                elif d.flag == datum_mod.DECIMAL_FLAG:
+                    values.append(d.value[0])
+                else:
+                    values.append(d.value)
+            out.append(Column.from_values(et, values, info.ftype.decimal))
+        return out
+
+
+def _default_column(info: ColumnInfo, n: int) -> Column:
+    if info.default_value is not None:
+        return Column.from_values(info.ftype.eval_type, [info.default_value] * n, info.ftype.decimal)
+    return Column.from_values(info.ftype.eval_type, [None] * n, info.ftype.decimal)

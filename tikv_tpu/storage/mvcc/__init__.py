@@ -1,0 +1,10 @@
+from .reader import (  # noqa: F401
+    BackwardScanner,
+    ForwardScanner,
+    IsolationLevel,
+    KeyIsLockedError,
+    MvccReader,
+    PointGetter,
+    Statistics,
+    WriteConflictError,
+)
