@@ -1,7 +1,7 @@
 """Test config: force JAX onto a virtual 8-device CPU mesh.
 
-Must run before the first `import jax` anywhere in the test session so the
-sharding tests exercise real multi-device lowering without TPU hardware.
+Setting env vars alone is not reliable (pytest plugins may import jax before
+this conftest), so the platform is also forced through jax.config.
 """
 
 import os
@@ -11,3 +11,8 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -244,3 +244,99 @@ def test_supports_does_not_leak_valueerror():
     assert not supports(
         DagRequest(executors=[TableScan(TABLE_ID, NUMERIC_COLS), Selection([call("lt", col(1))])])
     )
+
+
+def test_warm_cache_paths_identical():
+    """All three warm-cache modes (simple, stable-dict coded, general gids)
+    must match the CPU path byte-for-byte, and repeated cached runs agree."""
+    from tikv_tpu.copr.cache import ColumnBlockCache
+
+    cases = [
+        # simple agg (no groups)
+        [TableScan(TABLE_ID, NUMERIC_COLS), Selection([call("lt", col(1), const_int(500))]),
+         Aggregation([], [AggDescriptor("count", None), AggDescriptor("sum", col(3))])],
+        # general gids path (int group key is not dict-encoded)
+        [TableScan(TABLE_ID, NUMERIC_COLS), Selection([call("lt", col(1), const_int(500))]),
+         Aggregation([col(2)], [AggDescriptor("count", None), AggDescriptor("sum", col(3))])],
+    ]
+    for execs in cases:
+        dag = DagRequest(executors=execs)
+        cpu = BatchExecutorsRunner(dag, FixtureScanSource(NUMERIC_KVS)).handle_request()
+        ev = JaxDagEvaluator(dag, block_rows=256)
+        cache = ColumnBlockCache()
+        first = ev.run(FixtureScanSource(NUMERIC_KVS), cache=cache)  # fills
+        assert cache.filled
+        warm1 = ev.run(None, cache=cache)
+        warm2 = ev.run(None, cache=cache)
+        assert first.encode() == cpu.encode()
+        assert warm1.encode() == cpu.encode()
+        assert warm2.encode() == cpu.encode()
+
+
+def test_warm_cache_stable_dict_group():
+    """Q1 shape through the on-device group-id (stable dictionary) path."""
+    from tikv_tpu.copr.cache import ColumnBlockCache
+
+    kvs = product_kvs([(i, [b"apple", b"banana", b"cherry"][i % 3], i % 7, i * 3) for i in range(1, 900)])
+    aggs = [AggDescriptor("count", None), AggDescriptor("sum", col(2)), AggDescriptor("avg", col(3))]
+    execs = [
+        TableScan(TABLE_ID, PRODUCT_COLUMNS),
+        Selection([call("gt", col(2), const_int(1))]),
+        Aggregation([col(1)], aggs),
+    ]
+    dag = DagRequest(executors=execs)
+    cpu = BatchExecutorsRunner(dag, FixtureScanSource(kvs)).handle_request()
+    ev = JaxDagEvaluator(dag, block_rows=128)
+    cache = ColumnBlockCache()
+    ev.run(FixtureScanSource(kvs), cache=cache)
+    warm = ev.run(None, cache=cache)
+    assert warm.encode() == cpu.encode()
+    # a second evaluator over the same cache also agrees (shared HBM arrays)
+    ev2 = JaxDagEvaluator(dag, block_rows=128)
+    assert ev2.run(None, cache=cache).encode() == cpu.encode()
+
+
+def test_group_keys_with_trailing_nul_stay_distinct():
+    """numpy 'S' arrays equate b'a' and b'a\\x00' — group keys must not."""
+    from tikv_tpu.copr.groupby import GroupDict
+
+    data = np.array([b"a", b"a\x00", b"a", b"b"], dtype=object)
+    nulls = np.zeros(4, dtype=bool)
+    gd = GroupDict()
+    gids = gd.assign([(data, nulls)])
+    assert len(gd) == 3
+    assert gids[0] == gids[2] and gids[0] != gids[1]
+    assert gd.rows[gids[1]][0] == b"a\x00"
+
+
+def test_batch_respects_other_evaluators_null_masks():
+    """A nullable column referenced only by a non-base evaluator must keep
+    its null mask in the fused batch program."""
+    from tikv_tpu.copr.cache import ColumnBlockCache
+    from tikv_tpu.copr.datatypes import ColumnInfo, FieldType, NOT_NULL_FLAG
+    from tikv_tpu.copr.jax_eval import run_batch_cached
+    from tikv_tpu.copr.table import encode_row, record_key
+
+    cols = [
+        ColumnInfo(1, FieldType.int64(), is_pk_handle=True),
+        ColumnInfo(2, FieldType.int64()),  # nullable
+        ColumnInfo(3, FieldType.int64()),  # nullable
+    ]
+    kvs = [
+        (record_key(7, i), encode_row(cols[1:], [None if i % 3 == 0 else i, i]))
+        for i in range(300)
+    ]
+    # base evaluator references only column 2 (never null); column 1 (which
+    # HAS nulls) is referenced only by the second evaluator — its null mask
+    # must still ship in the fused program
+    dag_a = DagRequest(executors=[TableScan(7, cols), Aggregation([], [AggDescriptor("sum", col(2))])])
+    dag_b = DagRequest(executors=[TableScan(7, cols), Aggregation([], [AggDescriptor("count", col(1)), AggDescriptor("sum", col(1))])])
+    ev_a = JaxDagEvaluator(dag_a, block_rows=64)
+    ev_b = JaxDagEvaluator(dag_b, block_rows=64)
+    cache = ColumnBlockCache()
+    ev_a.run(FixtureScanSource(kvs), cache=cache)
+    ra, rb = run_batch_cached([ev_a, ev_b], cache)
+    cpu_a = BatchExecutorsRunner(dag_a, FixtureScanSource(kvs)).handle_request()
+    cpu_b = BatchExecutorsRunner(dag_b, FixtureScanSource(kvs)).handle_request()
+    assert ra.encode() == cpu_a.encode()
+    assert rb.encode() == cpu_b.encode()

@@ -23,6 +23,25 @@ from .rpn import Expr, RpnExpression
 
 _I64_MIN = np.iinfo(np.int64).min
 _I64_MAX = np.iinfo(np.int64).max
+_EXACT_F64 = 1 << 53
+
+
+def _segment_add(acc: np.ndarray, g: np.ndarray, d: np.ndarray) -> None:
+    """acc[g] += d, vectorized.  np.bincount(weights=...) runs ~20x faster
+    than np.add.at but sums in float64; it is used only when every partial sum
+    is exactly representable (|d|·n below 2^53), else the exact ufunc path."""
+    if acc.dtype.kind == "f":
+        acc += np.bincount(g, weights=d, minlength=len(acc))
+        return
+    if len(d):
+        # python-int abs: np.abs(INT64_MIN) overflows back to a negative
+        amax = max(abs(int(d.max())), abs(int(d.min())))
+    else:
+        amax = 0
+    if amax and amax * len(d) < _EXACT_F64:
+        acc += np.bincount(g, weights=d, minlength=len(acc)).astype(np.int64)
+    else:
+        np.add.at(acc, g, d)
 
 
 @dataclass
@@ -85,23 +104,24 @@ class AggState:
     def update(self, group_ids: np.ndarray, data: np.ndarray | None, nulls: np.ndarray | None) -> None:
         """Accumulate one batch. group_ids: int array, one per logical row."""
         op = self.op
+        G = len(self.count)
         if op == "count":
             if nulls is None:  # count(1)
-                np.add.at(self.count, group_ids, 1)
+                self.count += np.bincount(group_ids, minlength=G).astype(np.int64)
             else:
-                np.add.at(self.count, group_ids, (~nulls).astype(np.int64))
+                self.count += np.bincount(group_ids[~nulls], minlength=G).astype(np.int64)
             return
         mask = ~nulls
         if not mask.any():
             return
         g = group_ids[mask]
         d = data[mask]
-        np.add.at(self.count, g, 1)
+        self.count += np.bincount(g, minlength=G).astype(np.int64)
         if op in ("sum", "avg"):
-            np.add.at(self.sum, g, d)
+            _segment_add(self.sum, g, d)
         elif op == "var_pop":
-            np.add.at(self.sum, g, d)
-            np.add.at(self.sum_sq, g, d.astype(np.float64) ** 2)
+            _segment_add(self.sum, g, d)
+            self.sum_sq += np.bincount(g, weights=d.astype(np.float64) ** 2, minlength=len(self.sum_sq))
         elif op == "min":
             self._minmax(g, d, is_min=True)
         elif op == "max":

@@ -157,11 +157,14 @@ def check_supported(dag: DagRequest) -> None:
             raise ValueError(f"unsupported executor {type(e).__name__}")
 
 
-def build_executors(dag: DagRequest, source: ScanSource) -> BatchExecutor:
-    """runner.rs:150 build_executors equivalent."""
+def build_executors(dag: DagRequest, source: ScanSource, leaf: BatchExecutor | None = None) -> BatchExecutor:
+    """runner.rs:150 build_executors equivalent.  ``leaf`` overrides the scan
+    executor (e.g. CachedBlocksExecutor for the warm block-cache path)."""
     check_supported(dag)
     head = dag.executors[0]
-    if isinstance(head, TableScan):
+    if leaf is not None:
+        ex = leaf
+    elif isinstance(head, TableScan):
         ex: BatchExecutor = BatchTableScanExecutor(source, head.columns_info)
     else:
         from .table import index_range
@@ -229,9 +232,9 @@ class ResponseEncoder:
 class BatchExecutorsRunner:
     """Drive loop (runner.rs:399)."""
 
-    def __init__(self, dag: DagRequest, source: ScanSource):
+    def __init__(self, dag: DagRequest, source: ScanSource | None, leaf: BatchExecutor | None = None):
         self.dag = dag
-        self.executor = build_executors(dag, source)
+        self.executor = build_executors(dag, source, leaf)
         self.summary = ExecSummary()
 
     def handle_request(self) -> SelectResponse:

@@ -128,15 +128,38 @@ class Column:
     The reference keeps NULLs implicit per chunked vec; here the mask is an
     explicit numpy bool array so that it ships to the device as-is and
     selection stays a mask operation (never a gather — static shapes).
+
+    BYTES columns may be **dictionary-encoded** (Arrow-style): ``data`` holds
+    int64 codes into ``dictionary`` (an object array of bytes).  This is the
+    TPU-friendly representation — group-bys over such columns become dense
+    segment ids with no per-row Python.
     """
 
-    __slots__ = ("eval_type", "data", "nulls", "frac")
+    __slots__ = ("eval_type", "data", "nulls", "frac", "dictionary")
 
-    def __init__(self, eval_type: EvalType, data, nulls: np.ndarray, frac: int = 0):
+    def __init__(
+        self,
+        eval_type: EvalType,
+        data,
+        nulls: np.ndarray,
+        frac: int = 0,
+        dictionary: np.ndarray | None = None,
+    ):
         self.eval_type = eval_type
         self.data = data
         self.nulls = nulls
         self.frac = frac  # decimal scale
+        self.dictionary = dictionary
+
+    @property
+    def is_dict_encoded(self) -> bool:
+        return self.dictionary is not None
+
+    def decoded(self) -> "Column":
+        """Materialize dictionary codes back into an object array."""
+        if self.dictionary is None:
+            return self
+        return Column(self.eval_type, self.dictionary[self.data], self.nulls, self.frac)
 
     def __len__(self) -> int:
         return len(self.data)
@@ -159,17 +182,20 @@ class Column:
         return cls(eval_type, data, nulls, frac)
 
     def to_values(self) -> list:
-        return [None if null else _pyval(self.eval_type, v) for v, null in zip(self.data, self.nulls)]
+        col = self.decoded()
+        return [None if null else _pyval(col.eval_type, v) for v, null in zip(col.data, col.nulls)]
 
     def take(self, indices: np.ndarray) -> "Column":
-        return Column(self.eval_type, self.data[indices], self.nulls[indices], self.frac)
+        return Column(self.eval_type, self.data[indices], self.nulls[indices], self.frac, self.dictionary)
 
     def slice(self, start: int, stop: int) -> "Column":
-        return Column(self.eval_type, self.data[start:stop], self.nulls[start:stop], self.frac)
+        return Column(self.eval_type, self.data[start:stop], self.nulls[start:stop], self.frac, self.dictionary)
 
     @classmethod
     def concat(cls, cols: list["Column"]) -> "Column":
         assert cols
+        if any(c.is_dict_encoded for c in cols):
+            cols = [c.decoded() for c in cols]
         return cls(
             cols[0].eval_type,
             np.concatenate([c.data for c in cols]),
@@ -188,6 +214,8 @@ class Column:
         if self.eval_type == EvalType.DECIMAL:
             return datum_mod.DECIMAL_FLAG, (int(self.data[i]), self.frac)
         if self.eval_type == EvalType.BYTES:
+            if self.dictionary is not None:
+                return datum_mod.BYTES_FLAG, bytes(self.dictionary[self.data[i]])
             return datum_mod.BYTES_FLAG, bytes(self.data[i])
         if self.eval_type == EvalType.DURATION:
             return datum_mod.DURATION_FLAG, int(self.data[i])

@@ -28,8 +28,9 @@ from ..util import codec
 from . import datum as datum_mod
 from .aggr import AggDescriptor, AggState
 from .datatypes import Chunk, Column, ColumnInfo, EvalType
+from .groupby import GroupDict
 from .rpn import Expr, RpnExpression, compile_expr, eval_rpn
-from .table import RowBatchDecoder, decode_record_key
+from .table import RowBatchDecoder, decode_record_handles
 
 BATCH_INITIAL_SIZE = 32
 BATCH_MAX_SIZE = 1024
@@ -40,6 +41,19 @@ BATCH_GROW_FACTOR = 2
 class BatchExecuteResult:
     chunk: Chunk
     is_drained: bool
+
+
+def cols_for_eval(columns: list[Column], needed=None) -> dict:
+    """(data, nulls) pairs for expression eval; dictionary-encoded bytes
+    columns are materialized only when an expression actually references
+    them."""
+    out = {}
+    for i, c in enumerate(columns):
+        if needed is not None and i not in needed:
+            continue
+        c = c.decoded() if c.is_dict_encoded else c
+        out[i] = (c.data, c.nulls)
+    return out
 
 
 class BatchExecutor:
@@ -125,7 +139,29 @@ class FixtureScanSource(ScanSource):
 # Leaf executors
 # ---------------------------------------------------------------------------
 
-class BatchTableScanExecutor(BatchExecutor):
+class CachedBlocksExecutor(BatchExecutor):
+    """Leaf serving pre-decoded column blocks from a ColumnBlockCache — the
+    CPU pipeline's warm path (same cached data the device path reuses)."""
+
+    def __init__(self, cache, columns_info: list[ColumnInfo]):
+        self.cache = cache
+        self.columns_info = columns_info
+        self._idx = 0
+
+    def schema(self) -> list[tuple[EvalType, int]]:
+        return [(c.ftype.eval_type, c.ftype.decimal) for c in self.columns_info]
+
+    def next_batch(self, scan_rows: int) -> BatchExecuteResult:
+        blocks = self.cache.blocks
+        if self._idx >= len(blocks):
+            return BatchExecuteResult(Chunk.full([]), True)
+        blk = blocks[self._idx]
+        self._idx += 1
+        cols = [c.slice(0, blk.n_valid) for c in blk.cols]
+        return BatchExecuteResult(Chunk.full(cols), self._idx >= len(blocks))
+
+
+class BatchTableScanExecutor(BatchExecutor):  # noqa: E302
     """Decode record rows into columns (table_scan_executor.rs:20)."""
 
     def __init__(self, source: ScanSource, columns_info: list[ColumnInfo]):
@@ -138,9 +174,7 @@ class BatchTableScanExecutor(BatchExecutor):
 
     def next_batch(self, scan_rows: int) -> BatchExecuteResult:
         keys, values, drained = self.source.next_batch(scan_rows)
-        handles = np.empty(len(keys), dtype=np.int64)
-        for i, k in enumerate(keys):
-            _, handles[i] = decode_record_key(k)
+        handles = decode_record_handles(keys)
         cols = self.decoder.decode(handles, values)
         return BatchExecuteResult(Chunk.full(cols), drained)
 
@@ -215,7 +249,10 @@ class BatchSelectionExecutor(BatchExecutor):
             return r
         n = len(chunk.columns[0]) if chunk.columns else 0
         keep = np.ones(n, dtype=bool)
-        cols = {i: (c.data, c.nulls) for i, c in enumerate(chunk.columns)}
+        needed = set()
+        for rpn in self.conditions:
+            needed |= rpn.referenced_columns()
+        cols = cols_for_eval(chunk.columns, needed)
         for rpn in self.conditions:
             data, nulls = eval_rpn(rpn, cols, n)
             keep &= (np.asarray(data) != 0) & ~np.asarray(nulls)
@@ -268,7 +305,11 @@ class _AggBase(BatchExecutor):
 
     def _update_batch(self, chunk: Chunk, group_ids: np.ndarray, n_groups: int) -> None:
         logical = chunk.logical_rows
-        cols = {i: (c.data, c.nulls) for i, c in enumerate(chunk.columns)}
+        needed = set()
+        for rpn in self.compiled:
+            if rpn is not None:
+                needed |= rpn.referenced_columns()
+        cols = cols_for_eval(chunk.columns, needed)
         n = len(chunk.columns[0]) if chunk.columns else 0
         for state, rpn in zip(self.states, self.compiled):
             state.grow(n_groups)
@@ -316,8 +357,7 @@ class BatchHashAggregationExecutor(_AggBase):
     def __init__(self, child: BatchExecutor, group_by: list[Expr], aggs: list[AggDescriptor]):
         super().__init__(child, aggs)
         self.group_by = [compile_expr(g, self.child_schema) for g in group_by]
-        self.group_index: dict = {}
-        self.group_rows: list[tuple] = []
+        self.groups = GroupDict()
 
     def schema(self):
         return self._agg_schema() + [(g.eval_type, g.frac) for g in self.group_by]
@@ -333,43 +373,36 @@ class BatchHashAggregationExecutor(_AggBase):
             if not chunk.num_rows:
                 continue
             n = len(chunk.columns[0]) if chunk.columns else 0
-            cols = {i: (c.data, c.nulls) for i, c in enumerate(chunk.columns)}
             logical = chunk.logical_rows
-            key_parts = []
-            for g in self.group_by:
-                data, nulls = eval_rpn(g, cols, n)
-                key_parts.append((np.asarray(data)[logical], np.asarray(nulls)[logical]))
-            gids = self._assign_group_ids(key_parts, chunk.num_rows)
-            self._update_batch(chunk, gids, len(self.group_rows))
+            gids = self._gids_for_chunk(chunk, n, logical)
+            self._update_batch(chunk, gids, len(self.groups))
         self._done = True
-        n_groups = len(self.group_rows)
+        n_groups = len(self.groups)
         out: list[Column] = []
         for s in self.states:
             s.grow(n_groups)
             out.extend(s.result_columns(n_groups))
         # group-by key columns
         for gi, g in enumerate(self.group_by):
-            vals = [self.group_rows[r][gi] for r in range(n_groups)]
-            pyvals = [None if v is None else v for v in vals]
-            out.append(Column.from_values(g.eval_type, pyvals, g.frac))
+            vals = [self.groups.rows[r][gi] for r in range(n_groups)]
+            out.append(Column.from_values(g.eval_type, vals, g.frac))
         return BatchExecuteResult(Chunk.full(out), True)
 
-    def _assign_group_ids(self, key_parts, n_rows: int) -> np.ndarray:
-        gids = np.empty(n_rows, dtype=np.int64)
-        index = self.group_index
-        rows = self.group_rows
-        for i in range(n_rows):
-            key = tuple(
-                None if nulls[i] else (bytes(data[i]) if data.dtype == object else data[i].item())
-                for data, nulls in key_parts
-            )
-            gid = index.get(key)
-            if gid is None:
-                gid = len(rows)
-                index[key] = gid
-                rows.append(key)
-            gids[i] = gid
-        return gids
+    def _gids_for_chunk(self, chunk: Chunk, n: int, logical: np.ndarray) -> np.ndarray:
+        coded = _coded_group_parts(self.group_by, chunk.columns, logical)
+        if coded is not None:
+            if len(coded) == 1:
+                return self.groups.assign_coded(*coded[0])
+            return self.groups.assign_coded_multi(coded)
+        needed = set()
+        for g in self.group_by:
+            needed |= g.referenced_columns()
+        cols = cols_for_eval(chunk.columns, needed)
+        key_parts = []
+        for g in self.group_by:
+            data, nulls = eval_rpn(g, cols, n)
+            key_parts.append((np.asarray(data)[logical], np.asarray(nulls)[logical]))
+        return self.groups.assign(key_parts)
 
 
 class BatchStreamAggregationExecutor(BatchHashAggregationExecutor):
@@ -413,7 +446,10 @@ class BatchTopNExecutor(BatchExecutor):
             if not chunk.num_rows:
                 continue
             n = len(chunk.columns[0])
-            cols = {i: (c.data, c.nulls) for i, c in enumerate(chunk.columns)}
+            needed = set()
+            for rpn, _ in self.order_by:
+                needed |= rpn.referenced_columns()
+            cols = cols_for_eval(chunk.columns, needed)
             keys = []
             for rpn, desc in self.order_by:
                 data, nulls = eval_rpn(rpn, cols, n)
@@ -438,9 +474,29 @@ class BatchTopNExecutor(BatchExecutor):
         return BatchExecuteResult(Chunk.full(out_cols), True)
 
 
+def _coded_group_parts(group_rpns, columns, rows: np.ndarray):
+    """If every group expr is a bare ref to a dictionary-encoded column (and
+    the product capacity stays small), return [(codes, nulls, dictionary)]."""
+    parts = []
+    cap = 1
+    for g in group_rpns:
+        if len(g.nodes) != 1 or g.nodes[0].kind != "col":
+            return None
+        c = columns[g.nodes[0].index]
+        if not c.is_dict_encoded:
+            return None
+        cap *= len(c.dictionary) + 1
+        if cap > (1 << 20):
+            return None
+        parts.append((np.asarray(c.data)[rows], np.asarray(c.nulls)[rows], c.dictionary))
+    return parts or None
+
+
 def _as_py(c: Column, row: int):
     v = c.data[row]
     if c.eval_type == EvalType.BYTES:
+        if c.dictionary is not None:
+            return bytes(c.dictionary[v])
         return bytes(v)
     if c.eval_type == EvalType.REAL:
         return float(v)

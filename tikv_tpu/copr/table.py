@@ -43,6 +43,19 @@ def decode_record_key(key: bytes) -> tuple[int, int]:
     return codec.decode_i64(key, 1), codec.decode_i64(key, 11)
 
 
+def decode_record_handles(keys: list[bytes]) -> np.ndarray:
+    """Batch handle decode: one reshape + byte-slice for the whole block."""
+    n = len(keys)
+    if n == 0:
+        return np.empty(0, dtype=np.int64)
+    lens = np.fromiter(map(len, keys), dtype=np.int64, count=n)
+    if lens.min() != 19 or lens.max() != 19:
+        # not uniformly record keys; per-key decode surfaces the bad one
+        return np.array([decode_record_key(k)[1] for k in keys], dtype=np.int64)
+    arr = np.frombuffer(b"".join(keys), dtype=np.uint8).reshape(n, 19)
+    return codec.decode_i64_batch(arr[:, 11:19])
+
+
 def index_key(table_id: int, index_id: int, values: list[tuple[int, object]]) -> bytes:
     out = bytearray(TABLE_PREFIX + codec.encode_i64(table_id) + INDEX_PREFIX_SEP + codec.encode_i64(index_id))
     for flag, value in values:
@@ -97,6 +110,10 @@ class RowBatchDecoder:
     def __init__(self, schema: list[ColumnInfo]):
         self.schema = schema
         self.handle_idx = [i for i, c in enumerate(schema) if c.is_pk_handle]
+        # per-column cached dictionary (col_id → sorted uint64 keys + object
+        # values): lets later blocks dictionary-encode with one searchsorted
+        # instead of a fresh np.unique sort
+        self._dict_cache: dict[int, tuple[np.ndarray, np.ndarray]] = {}
 
     def decode(self, handles: np.ndarray, row_values: list[bytes]) -> list[Column]:
         n = len(row_values)
@@ -149,6 +166,10 @@ class RowBatchDecoder:
             elif kind == "f64":
                 data = codec.decode_f64_batch(buf[:, off : off + 8])
                 out.append(Column(et, data, np.zeros(n, dtype=bool)))
+            elif isinstance(kind, tuple) and kind[0] == "bytes":
+                blen = kind[1]
+                codes, dictionary = self._dict_encode(info.col_id, buf, off, blen, n)
+                out.append(Column(et, codes, np.zeros(n, dtype=bool), 0, dictionary))
             else:
                 raise AssertionError(kind)
         return out
@@ -196,9 +217,57 @@ class RowBatchDecoder:
                 const_offsets.append(off + 1)
                 cols[cid] = ("i64", off + 2)
                 off += 10
+            elif flag == datum_mod.COMPACT_BYTES_FLAG:
+                # fixed-length bytes value: varint length must be 1 byte and
+                # identical across the block (checked via const_offsets)
+                try:
+                    blen, noff2 = codec.decode_var_i64(row, off + 1)
+                except ValueError:
+                    return None
+                if blen < 0 or noff2 != off + 2 or off + 2 + blen > len(row):
+                    return None
+                const_offsets.append(off + 1)
+                cols[cid] = (("bytes", blen), off + 2)
+                off += 2 + blen
             else:
                 return None
         return {"cols": cols, "const_offsets": const_offsets}
+
+    def _dict_encode(self, col_id: int, buf: np.ndarray, off: int, blen: int, n: int):
+        """Dictionary-encode a fixed-width bytes column slice.
+
+        Values ≤8 bytes pack into uint64 keys; a per-column cached dictionary
+        turns steady-state blocks into one searchsorted (O(n log D)).  Wider
+        values use the void-view np.unique path.
+        """
+        if blen == 0:
+            return np.zeros(n, dtype=np.int64), np.array([b""], dtype=object)
+        raw = np.ascontiguousarray(buf[:, off : off + blen])
+        if blen <= 8:
+            padded = np.zeros((n, 8), dtype=np.uint8)
+            padded[:, :blen] = raw
+            keys = padded.view(np.uint64).reshape(n)
+            cached = self._dict_cache.get(col_id)
+            if cached is not None:
+                sorted_keys, values = cached
+                pos = np.searchsorted(sorted_keys, keys)
+                pos_c = np.minimum(pos, len(sorted_keys) - 1)
+                if (sorted_keys[pos_c] == keys).all():
+                    return pos_c.astype(np.int64), values
+            uk, codes = np.unique(keys, return_inverse=True)
+            values = np.empty(len(uk), dtype=object)
+            kb = uk.view(np.uint8).reshape(len(uk), 8)
+            for j in range(len(uk)):
+                values[j] = kb[j, :blen].tobytes()
+            self._dict_cache[col_id] = (uk, values)
+            return codes.astype(np.int64), values
+        view = raw.view([("", np.uint8)] * blen).reshape(n)
+        uniq, codes = np.unique(view, return_inverse=True)
+        dictionary = np.empty(len(uniq), dtype=object)
+        ub = uniq.view(np.uint8).reshape(len(uniq), blen)
+        for j in range(len(uniq)):
+            dictionary[j] = ub[j].tobytes()
+        return codes.astype(np.int64), dictionary
 
     # -- slow path: per-row datum walk -------------------------------------
 

@@ -1,0 +1,182 @@
+"""Mesh-sharded coprocessor evaluation.
+
+TiKV scales horizontally by splitting the key space into regions
+(``raftstore/src/coprocessor/split_check/``); the TPU-native re-expression is
+a ``jax.sharding.Mesh`` with two axes:
+
+* ``"regions"`` — row blocks sharded across devices (the data-parallel axis:
+  each device scans/filters/aggregates its own region shard; partial
+  aggregate states merge with ``psum``/``pmin``/``pmax`` over ICI, exactly the
+  mergeable-state design the CPU pipeline uses across batches)
+* ``"groups"`` — the aggregation state (group capacity) sharded across
+  devices (the tensor-parallel axis: each device owns a slice of the
+  group-state vector after the cross-region reduction)
+
+The collectives ride ICI inside a pod; nothing here assumes a host count, so
+the same program runs on a virtual 8-CPU-device mesh (tests / driver dryrun)
+and a real TPU slice.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+from ..copr.dag import DagRequest
+from ..copr.jax_eval import _NO_ROW, JaxDagEvaluator, _seg_extreme, _seg_sum
+from ..copr.rpn import eval_rpn
+
+
+def make_mesh(devices=None, groups: int = 1) -> Mesh:
+    """A (regions × groups) mesh over the given (or all) devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    assert n % groups == 0, "device count must divide into group shards"
+    arr = np.array(devices).reshape(n // groups, groups)
+    return Mesh(arr, axis_names=("regions", "groups"))
+
+
+# per-leaf merge semantics of each aggregate's carry (leaf 0 is always count)
+_MERGE = {
+    "count": ("sum",),
+    "sum": ("sum", "sum"),
+    "avg": ("sum", "sum"),
+    "var_pop": ("sum", "sum", "sum"),
+    "min": ("sum", "min"),
+    "max": ("sum", "max"),
+}
+
+
+def _collective(kind: str, x, axis: str):
+    if kind == "sum":
+        return jax.lax.psum(x, axis)
+    if kind == "min":
+        return jax.lax.pmin(x, axis)
+    return jax.lax.pmax(x, axis)
+
+
+def _combine(kind: str, a, b):
+    if kind == "sum":
+        return a + b
+    if kind == "min":
+        return jnp.minimum(a, b)
+    return jnp.maximum(a, b)
+
+
+class ShardedDagEvaluator:
+    """Multi-device DAG aggregation step for an eligible aggregation DAG.
+
+    ``step(col_data, col_nulls, valid, gids, state)`` consumes one super-block
+    whose rows are sharded over the ``regions`` axis and whose state shards
+    over ``groups``; it returns the updated sharded state.  Finalization uses
+    the same host code as the single-device evaluator.
+    """
+
+    def __init__(self, dag: DagRequest, mesh: Mesh, rows_per_shard: int, capacity: int = 16):
+        self.ev = JaxDagEvaluator(dag, block_rows=rows_per_shard)
+        if self.ev.plan.agg is None:
+            raise ValueError("sharded evaluation requires an aggregation DAG")
+        self.mesh = mesh
+        self.rows_per_shard = rows_per_shard
+        self.n_regions = mesh.shape["regions"]
+        self.n_groups = mesh.shape["groups"]
+        assert capacity % self.n_groups == 0
+        self.capacity = capacity
+        self.total_rows = rows_per_shard * self.n_regions
+        self._step = self._build_step()
+
+    def _build_step(self):
+        ev = self.ev
+        capacity = self.capacity
+        gshard = capacity // self.n_groups
+        n_rows = self.rows_per_shard
+        device_cols = ev.device_cols
+        nullable = ev.nullable_cols
+        sel_rpns = ev.sel_rpns
+        device_aggs = ev.device_aggs
+
+        col_specs = tuple(P("regions") for _ in device_cols)
+        null_specs = tuple(P("regions") for _ in nullable)
+        state_spec = (
+            P("groups"),
+            tuple(
+                tuple(P("groups") for _ in _MERGE[da.op])
+                for da in device_aggs
+            ),
+        )
+        in_specs = (col_specs, null_specs, P("regions"), P("regions"), state_spec)
+
+        @partial(
+            jax.shard_map,
+            mesh=self.mesh,
+            in_specs=in_specs,
+            out_specs=state_spec,
+        )
+        def step(col_data, col_nulls, valid, gids, state):
+            first_shard, carry_shards = state
+            no_nulls = jnp.zeros(n_rows, dtype=bool)
+            nullmap = dict(zip(nullable, col_nulls))
+            cols = {
+                i: (col_data[j], nullmap.get(i, no_nulls))
+                for j, i in enumerate(device_cols)
+            }
+            active = valid
+            for rpn in sel_rpns:
+                d, nl = eval_rpn(rpn, cols, n_rows, xp=jnp)
+                active = active & (d != 0) & ~nl
+            gidx = jax.lax.axis_index("groups")
+            lo = gidx * gshard
+            new_first = first_shard
+            new_carries = []
+            for da, carry_shard in zip(device_aggs, carry_shards):
+                zero = da.init_carry(capacity)
+                partial_full = da.update(zero, cols, n_rows, gids, active, capacity)
+                merged = []
+                for kind, leaf in zip(_MERGE[da.op], partial_full):
+                    # reduce partial states across region shards, then each
+                    # groups-member keeps its slice of the state vector
+                    leaf = _collective(kind, leaf, "regions")
+                    my = jax.lax.dynamic_slice_in_dim(leaf, lo, gshard)
+                    merged.append(my)
+                new_carries.append(
+                    tuple(_combine(k, c, m) for k, c, m in zip(_MERGE[da.op], carry_shard, merged))
+                )
+            # global row index (region shards hold consecutive row ranges), so
+            # group order matches the single-stream first-occurrence order
+            shard_base = jax.lax.axis_index("regions").astype(jnp.int64) * n_rows
+            ridx = jnp.where(
+                active, shard_base + jnp.arange(n_rows, dtype=jnp.int64), _NO_ROW
+            )
+            bf = _seg_extreme(ridx, gids, capacity, True, _NO_ROW)
+            bf = jax.lax.pmin(bf, "regions")
+            my_bf = jax.lax.dynamic_slice_in_dim(bf, lo, gshard)
+            new_first = jnp.minimum(new_first, my_bf)
+            return (new_first, tuple(new_carries))
+
+        return jax.jit(step)
+
+    def init_state(self):
+        gshard = self.capacity // self.n_groups
+        first = jnp.full(self.capacity, _NO_ROW, dtype=jnp.int64)
+        carries = tuple(da.init_carry(self.capacity) for da in self.ev.device_aggs)
+        return (first, carries)
+
+    def step(self, col_data, col_nulls, valid, gids, state):
+        return self._step(col_data, col_nulls, valid, gids, state)
+
+    def run_arrays(self, columns: dict, n_valid: int, gids: np.ndarray):
+        """Evaluate one super-block given per-column numpy (data, nulls)."""
+        col_data = tuple(np.asarray(columns[i][0]) for i in self.ev.device_cols)
+        col_nulls = tuple(np.asarray(columns[i][1]) for i in self.ev.nullable_cols)
+        valid = np.zeros(self.total_rows, dtype=bool)
+        valid[:n_valid] = True
+        state = self.init_state()
+        return self.step(col_data, col_nulls, valid, gids, state)
