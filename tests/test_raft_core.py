@@ -1,0 +1,232 @@
+"""Raft consensus core tests over a deterministic in-memory network."""
+
+import random
+
+import pytest
+
+from tikv_tpu.raft.core import Entry, Message, MsgType, RaftNode, Role, Snapshot
+
+
+class Net:
+    """Deterministic simulator: drives ticks and delivers messages, with
+    per-link drop/partition control (transport_simulate.rs in miniature)."""
+
+    def __init__(self, n, seed=0):
+        self.nodes = {i: RaftNode(i, list(range(1, n + 1)), rng=random.Random(seed * 100 + i)) for i in range(1, n + 1)}
+        self.cut: set[tuple[int, int]] = set()
+        self.applied: dict[int, list[bytes]] = {i: [] for i in self.nodes}
+        self.persisted: dict[int, list[Entry]] = {i: [] for i in self.nodes}
+        self.reads: dict[int, list[tuple[bytes, int]]] = {i: [] for i in self.nodes}
+
+    def partition(self, a: int, b: int):
+        self.cut.add((a, b))
+        self.cut.add((b, a))
+
+    def heal(self):
+        self.cut.clear()
+
+    def drain(self, max_rounds=50):
+        for _ in range(max_rounds):
+            moved = False
+            for i, node in self.nodes.items():
+                rd = node.ready()
+                if rd.entries:
+                    self.persisted[i].extend(rd.entries)
+                if rd.read_states:
+                    self.reads[i].extend(rd.read_states)
+                for e in rd.committed_entries:
+                    if e.conf_change is not None:
+                        node.apply_conf_change(e.conf_change)
+                    elif e.data:
+                        self.applied[i].append(e.data)
+                for m in rd.messages:
+                    if (m.frm, m.to) in self.cut or m.to not in self.nodes:
+                        continue
+                    if m.type == MsgType.SNAPSHOT and m.snapshot is None:
+                        # container duty: materialize a snapshot of applied state
+                        src = self.nodes[m.frm]
+                        m.snapshot = Snapshot(
+                            index=src.applied, term=src.log.term_at(src.applied) or src.term,
+                            data=b"|".join(self.applied[m.frm]), voters=tuple(src.voters),
+                        )
+                    self.nodes[m.to].step(m)
+                    moved = True
+            if not moved:
+                return
+
+    def tick_all(self, n=1):
+        for _ in range(n):
+            for node in self.nodes.values():
+                node.tick()
+            self.drain()
+
+    def leader(self):
+        leaders = [n for n in self.nodes.values() if n.role == Role.LEADER]
+        return leaders[0] if len(leaders) == 1 else None
+
+    def elect(self, node_id=1):
+        self.nodes[node_id].campaign()
+        self.drain()
+        assert self.nodes[node_id].role == Role.LEADER
+        return self.nodes[node_id]
+
+
+def test_single_node_self_elects():
+    net = Net(1)
+    net.tick_all(25)
+    assert net.nodes[1].role == Role.LEADER
+    idx = net.nodes[1].propose(b"x")
+    assert idx is not None
+    net.drain()
+    assert net.applied[1] == [b"x"]
+
+
+def test_election_and_replication():
+    net = Net(3)
+    leader = net.elect(1)
+    for i in range(5):
+        leader.propose(b"cmd%d" % i)
+    net.drain()
+    expect = [b"cmd%d" % i for i in range(5)]
+    for i in net.nodes:
+        assert net.applied[i] == expect
+
+
+def test_leader_failover():
+    net = Net(3)
+    net.elect(1)
+    net.nodes[1].propose(b"a")
+    net.drain()
+    # isolate the leader; remaining two elect a new one
+    net.partition(1, 2)
+    net.partition(1, 3)
+    net.nodes[2].campaign()
+    net.drain()
+    assert net.nodes[2].role == Role.LEADER
+    net.nodes[2].propose(b"b")
+    net.drain()
+    assert net.applied[2] == [b"a", b"b"]
+    assert net.applied[3] == [b"a", b"b"]
+    # healed old leader catches up and steps down
+    net.heal()
+    net.tick_all(3)
+    assert net.nodes[1].role == Role.FOLLOWER
+    assert net.applied[1] == [b"a", b"b"]
+
+
+def test_minority_cannot_commit():
+    net = Net(3)
+    net.elect(1)
+    net.partition(1, 2)
+    net.partition(1, 3)
+    net.nodes[1].propose(b"lost")
+    net.drain()
+    assert net.applied[1] == []  # no quorum, never commits
+    # majority side moves on with a higher term
+    net.nodes[2].campaign()
+    net.drain()
+    net.nodes[2].propose(b"kept")
+    net.drain()
+    net.heal()
+    net.tick_all(3)
+    # the divergent entry is overwritten everywhere
+    for i in net.nodes:
+        assert net.applied[i] == [b"kept"], i
+
+
+def test_log_consistency_check_backtracks():
+    net = Net(3)
+    leader = net.elect(1)
+    for i in range(4):
+        leader.propose(b"x%d" % i)
+    net.drain()
+    # peer 3 misses a batch
+    net.partition(1, 3)
+    for i in range(4, 8):
+        leader.propose(b"x%d" % i)
+    net.drain()
+    net.heal()
+    leader.propose(b"final")
+    net.drain()
+    assert net.applied[3] == [b"x%d" % i for i in range(8)] + [b"final"]
+
+
+def test_conf_change_add_and_remove():
+    net = Net(3)
+    leader = net.elect(1)
+    leader.propose(b"a")
+    net.drain()
+    # add node 4
+    net.nodes[4] = RaftNode(4, [])  # empty config; learns via snapshot/append
+    net.nodes[4].voters = {1, 2, 3, 4}
+    net.applied[4] = []
+    net.persisted[4] = []
+    leader.propose_conf_change(("add", 4))
+    net.drain()
+    assert 4 in leader.voters
+    leader.propose(b"b")
+    net.drain()
+    assert net.applied[4] == [b"a", b"b"]
+    # remove node 3: quorum becomes 2 of {1,2,4}
+    leader.propose_conf_change(("remove", 3))
+    net.drain()
+    assert 3 not in leader.voters
+    net.partition(1, 3)
+    leader.propose(b"c")
+    net.drain()
+    assert net.applied[1][-1] == b"c"
+
+
+def test_snapshot_catchup_after_compaction():
+    net = Net(3)
+    leader = net.elect(1)
+    for i in range(5):
+        leader.propose(b"s%d" % i)
+    net.drain()
+    net.partition(1, 3)
+    net.partition(2, 3)
+    for i in range(5, 10):
+        leader.propose(b"s%d" % i)
+    net.drain()
+    # compact the leader's log beyond peer 3's position
+    leader.log.compact_to(leader.applied, leader.log.term_at(leader.applied))
+    net.heal()
+    net.tick_all(3)
+    leader.propose(b"post")
+    net.drain()
+    assert net.applied[3][-1] == b"post"
+    # node 3 received a snapshot covering the compacted prefix
+    assert net.nodes[3].log.snapshot_index > 0
+
+
+def test_read_index():
+    net = Net(3)
+    leader = net.elect(1)
+    leader.propose(b"v")
+    net.drain()
+    leader.read_index(b"ctx1")
+    net.drain()
+    states = net.reads[leader.id]
+    assert states and states[0][0] == b"ctx1"
+    assert states[0][1] >= 2  # noop + proposal committed
+    # follower-forwarded read index
+    net.nodes[2].read_index(b"ctx2")
+    net.drain()
+    assert net.reads[2] and net.reads[2][0][0] == b"ctx2"
+
+
+def test_stale_term_candidate_rejected():
+    net = Net(3)
+    net.elect(1)
+    # node 3 goes stale and campaigns with an old log
+    net.partition(1, 3)
+    net.partition(2, 3)
+    net.nodes[1].propose(b"new")
+    net.drain()
+    net.heal()
+    net.nodes[3].campaign()
+    net.drain()
+    # 3 cannot win with a shorter log; cluster converges back to a real leader
+    net.tick_all(25)
+    leader = net.leader()
+    assert leader is not None and leader.id in (1, 2)

@@ -1,0 +1,207 @@
+"""Multi-Raft store + cluster harness tests (reference: tests/integrations/
+raftstore + components/test_raftstore)."""
+
+import pytest
+
+from tikv_tpu.raft.cluster import FIRST_REGION_ID, Cluster
+from tikv_tpu.raft.region import EpochError, NotLeaderError
+from tikv_tpu.raft.store import PartitionFilter, RegionPacketFilter
+from tikv_tpu.storage.engine import CF_WRITE
+from tikv_tpu.storage.storage import Storage
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(3)
+    c.run()
+    return c
+
+
+def test_put_get_replicated(cluster):
+    cluster.must_put(b"k1", b"v1")
+    assert cluster.must_get(b"k1") == b"v1"
+    # all three stores applied it
+    for sid in cluster.stores:
+        assert cluster.get_on_store(sid, b"k1") == b"v1"
+    cluster.must_delete(b"k1")
+    assert cluster.must_get(b"k1") is None
+
+
+def test_write_requires_leader(cluster):
+    follower_store = None
+    leader = cluster.wait_leader(FIRST_REGION_ID)
+    for sid in cluster.stores:
+        if sid != leader.store.store_id:
+            follower_store = sid
+            break
+    kv = cluster.raftkv(follower_store)
+    from tikv_tpu.storage.engine import WriteBatch
+
+    wb = WriteBatch()
+    wb.put_cf("default", b"k", b"v")
+    with pytest.raises(NotLeaderError):
+        kv.write({"region_id": FIRST_REGION_ID}, wb)
+
+
+def test_leader_failover_preserves_data(cluster):
+    cluster.must_put(b"k", b"v")
+    leader = cluster.wait_leader(FIRST_REGION_ID)
+    dead = leader.store.store_id
+    cluster.stop_node(dead)
+    other = next(sid for sid in cluster.stores if sid != dead)
+    cluster.elect_leader(FIRST_REGION_ID, other)
+    assert cluster.must_get(b"k") == b"v"
+    cluster.must_put(b"k2", b"v2")
+    # old leader restarts, catches up
+    cluster.restart_node(dead)
+    cluster.tick(5)
+    assert cluster.get_on_store(dead, b"k2") == b"v2"
+
+
+def test_split_region(cluster):
+    cluster.must_put(b"a", b"1")
+    cluster.must_put(b"m", b"2")
+    cluster.must_put(b"z", b"3")
+    new_id = cluster.split_region(FIRST_REGION_ID, b"m")
+    assert cluster.region_for_key(b"a") == FIRST_REGION_ID
+    assert cluster.region_for_key(b"m") == new_id
+    assert cluster.region_for_key(b"z") == new_id
+    # both regions keep serving reads and writes
+    assert cluster.must_get(b"a") == b"1"
+    assert cluster.must_get(b"m") == b"2"
+    assert cluster.must_get(b"z") == b"3"
+    cluster.must_put(b"b", b"4")
+    cluster.must_put(b"x", b"5")
+    assert cluster.must_get(b"b") == b"4"
+    assert cluster.must_get(b"x") == b"5"
+
+
+def test_split_epoch_check(cluster):
+    leader = cluster.wait_leader(FIRST_REGION_ID)
+    stale_epoch = (leader.region.epoch.conf_ver, leader.region.epoch.version)
+    cluster.split_region(FIRST_REGION_ID, b"m")
+    # command with the pre-split epoch must be rejected
+    import threading
+
+    res = []
+    done = threading.Event()
+    leader = cluster.wait_leader(FIRST_REGION_ID)
+    leader.propose_cmd(
+        {"epoch": stale_epoch, "ops": [("put", "default", b"a", b"x")]},
+        lambda r: (res.append(r), done.set()),
+    )
+    while not done.is_set():
+        cluster.process()
+    assert isinstance(res[0], EpochError)
+
+
+def test_conf_change_add_remove_peer():
+    c = Cluster(4)
+    region = c.bootstrap_subset([1, 2, 3])
+    c.elect_leader(region.id, 1)
+    c.must_put(b"k", b"v")
+    # grow to store 4
+    c.add_peer(region.id, 4)
+    c.tick(5)
+    assert c.get_on_store(4, b"k") == b"v"
+    # writes reach the new peer
+    c.must_put(b"k2", b"v2")
+    c.tick(2)
+    assert c.get_on_store(4, b"k2") == b"v2"
+    # shrink: remove the peer on store 2
+    leader = c.wait_leader(region.id)
+    victim = leader.region.peer_on_store(2)
+    c.remove_peer(region.id, victim.peer_id)
+    c.tick(2)
+    assert region.id not in c.stores[2].peers
+    c.must_put(b"k3", b"v3")
+    assert c.must_get(b"k3") == b"v3"
+
+
+def test_partition_minority_stalls_majority_recovers(cluster):
+    cluster.must_put(b"k", b"v1")
+    leader = cluster.wait_leader(FIRST_REGION_ID)
+    lsid = leader.store.store_id
+    others = [sid for sid in cluster.stores if sid != lsid]
+    cluster.transport.filters.append(PartitionFilter({lsid}, set(others)))
+    # majority side elects a new leader and continues
+    cluster.elect_leader(FIRST_REGION_ID, others[0])
+    cluster.must_put(b"k", b"v2")
+    cluster.transport.filters.clear()
+    cluster.tick(5)
+    # old leader converges
+    assert cluster.get_on_store(lsid, b"k") == b"v2"
+
+
+def test_snapshot_filter_blocks_then_catches_up(cluster):
+    from tikv_tpu.raft.core import MsgType
+
+    cluster.must_put(b"a", b"1")
+    leader = cluster.wait_leader(FIRST_REGION_ID)
+    lagging = next(sid for sid in cluster.stores if sid != leader.store.store_id)
+    # drop all append traffic to the lagging store
+    f = RegionPacketFilter(FIRST_REGION_ID, lagging, {MsgType.APPEND, MsgType.SNAPSHOT})
+    cluster.transport.filters.append(f)
+    for i in range(5):
+        cluster.must_put(b"b%d" % i, b"x")
+    assert cluster.get_on_store(lagging, b"b0") is None
+    cluster.transport.filters.clear()
+    cluster.tick(5)
+    assert cluster.get_on_store(lagging, b"b4") == b"x"
+
+
+def test_storage_over_raftkv(cluster):
+    """Full stack: Percolator txn layer over the raft-replicated engine."""
+    from tikv_tpu.storage.txn.commands import Commit, Prewrite
+    from tikv_tpu.storage.txn_types import Key, Mutation
+
+    leader = cluster.wait_leader(FIRST_REGION_ID)
+    store = Storage(engine=cluster.raftkv(leader.store.store_id))
+    ctx = {"region_id": FIRST_REGION_ID}
+    r = store.sched_txn_command(
+        Prewrite([Mutation.put(Key.from_raw(b"k"), b"v")], b"k", 10), ctx
+    )
+    assert "errors" not in r
+    store.sched_txn_command(Commit([Key.from_raw(b"k")], 10, 20), ctx)
+    assert store.get(b"k", 30, ctx) == b"v"
+    # the committed MVCC write replicated to every store
+    for sid in cluster.stores:
+        eng = cluster.stores[sid].engine
+        from tikv_tpu.util import keys as keymod
+
+        found = list(eng.scan_cf(CF_WRITE, b"", None))
+        assert any(k.startswith(keymod.DATA_PREFIX) for k, _ in found)
+
+
+def test_coprocessor_over_raft_region(cluster):
+    """DAG pushdown over a RegionSnapshot — the full read path."""
+    from tikv_tpu.copr.dag import BatchExecutorsRunner, DagRequest, TableScan
+    from tikv_tpu.copr.executors import MvccScanSource
+    from tikv_tpu.copr.mvcc_batch import MvccBatchScanSource
+    from tikv_tpu.copr.table import record_range
+    from tikv_tpu.storage.txn.commands import Commit, Prewrite
+    from tikv_tpu.storage.txn_types import Key, Mutation
+
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__)))
+    from copr_fixtures import PRODUCT_COLUMNS, TABLE_ID, product_kvs
+
+    leader = cluster.wait_leader(FIRST_REGION_ID)
+    kv = cluster.raftkv(leader.store.store_id)
+    store = Storage(engine=kv)
+    ctx = {"region_id": FIRST_REGION_ID}
+    for i, (rk, val) in enumerate(product_kvs()):
+        ts = 10 + 2 * i
+        store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(rk), val)], rk, ts), ctx)
+        store.sched_txn_command(Commit([Key.from_raw(rk)], ts, ts + 1), ctx)
+    snap = kv.snapshot(ctx)
+    dag = DagRequest(executors=[TableScan(TABLE_ID, PRODUCT_COLUMNS)])
+    resp = BatchExecutorsRunner(dag, MvccScanSource(snap, 100, [record_range(TABLE_ID)])).handle_request()
+    rows = resp.iter_rows()
+    assert len(rows) == 6
+    # vectorized MVCC source agrees over the raft snapshot too
+    resp2 = BatchExecutorsRunner(
+        DagRequest(executors=[TableScan(TABLE_ID, PRODUCT_COLUMNS)]),
+        MvccBatchScanSource(snap, 100, [record_range(TABLE_ID)]),
+    ).handle_request()
+    assert resp2.encode() == resp.encode()

@@ -1,0 +1,294 @@
+"""Server layer tests: node bootstrap with PD, KV service over TCP,
+batch multiplexing, coprocessor over the wire (reference:
+tests/integrations/server + kv service tests)."""
+
+import threading
+
+import pytest
+
+from tikv_tpu.copr.dag import BatchExecutorsRunner, DagRequest, SelectResponse, TableScan
+from tikv_tpu.copr.dag_wire import dag_from_wire, dag_to_wire
+from tikv_tpu.copr.endpoint import Endpoint
+from tikv_tpu.pd.client import MockPd
+from tikv_tpu.raft.cluster import FIRST_REGION_ID, Cluster
+from tikv_tpu.raft.raftkv import RaftKv
+from tikv_tpu.server import wire
+from tikv_tpu.server.node import Node
+from tikv_tpu.server.server import Client, Server
+from tikv_tpu.server.service import KvService
+from tikv_tpu.storage.storage import Storage
+
+
+def test_wire_roundtrip():
+    vals = [
+        None, True, False, 0, -1, 2**62, -(2**62), 1.5, b"bytes", "str",
+        [1, [2, [3]]], {"k": b"v", 1: None}, (1, 2), {"nested": {"a": [b"x"]}},
+    ]
+    for v in vals:
+        assert wire.loads(wire.dumps(v)) == v
+    with pytest.raises(ValueError):
+        wire.loads(wire.dumps([1]) + b"x")
+
+
+def test_dag_wire_roundtrip():
+    import sys, os
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from copr_fixtures import PRODUCT_COLUMNS, TABLE_ID, product_kvs
+    from tikv_tpu.copr.aggr import AggDescriptor
+    from tikv_tpu.copr.dag import Aggregation, Selection, TopN
+    from tikv_tpu.copr.executors import FixtureScanSource
+    from tikv_tpu.copr.rpn import call, col, const_int
+
+    dag = DagRequest(
+        executors=[
+            TableScan(TABLE_ID, PRODUCT_COLUMNS),
+            Selection([call("gt", col(2), const_int(5))]),
+            Aggregation([col(1)], [AggDescriptor("count", None), AggDescriptor("sum", col(2))]),
+            TopN([(col(0), True)], 3),
+        ]
+    )
+    d2 = dag_from_wire(wire.loads(wire.dumps(dag_to_wire(dag))))
+    r1 = BatchExecutorsRunner(dag, FixtureScanSource(product_kvs())).handle_request()
+    r2 = BatchExecutorsRunner(d2, FixtureScanSource(product_kvs())).handle_request()
+    assert r1.encode() == r2.encode()
+
+
+@pytest.fixture
+def single_node():
+    """One-node 'cluster' with running background loops + TCP server."""
+    pd = MockPd()
+    from tikv_tpu.raft.store import ChannelTransport
+
+    transport = ChannelTransport()
+    node = Node(pd, transport)
+    transport.register(node.store)
+    region = node.try_bootstrap_cluster([node.store_id])
+    node.create_region_peers()
+    peer = node.store.peers[FIRST_REGION_ID]
+    peer.node.campaign()
+    node.pump()
+    assert peer.node.is_leader()
+    node.start()
+    kv = RaftKv(node.store)  # background loops pump; default pump yields
+    storage = Storage(engine=kv)
+    copr = Endpoint(kv, enable_device=False)
+    service = KvService(storage, copr)
+    server = Server(service)
+    server.start()
+    yield node, server, pd
+    server.stop()
+    node.stop()
+
+
+def test_kv_service_over_tcp(single_node):
+    node, server, pd = single_node
+    client = Client(*server.addr)
+    ctx = {"region_id": FIRST_REGION_ID}
+    ts1 = pd.get_tso()
+    r = client.call(
+        "kv_prewrite",
+        {
+            "mutations": [{"op": "put", "key": b"k", "value": b"v"}],
+            "primary_lock": b"k",
+            "start_version": ts1,
+            "context": ctx,
+        },
+    )
+    assert "error" not in r and "errors" not in r, r
+    ts2 = pd.get_tso()
+    r = client.call("kv_commit", {"keys": [b"k"], "start_version": ts1, "commit_version": ts2, "context": ctx})
+    assert "error" not in r
+    r = client.call("kv_get", {"key": b"k", "version": pd.get_tso(), "context": ctx})
+    assert r["value"] == b"v"
+    # raw API
+    client.call("raw_put", {"key": b"rk", "value": b"rv", "context": ctx})
+    assert client.call("raw_get", {"key": b"rk", "context": ctx})["value"] == b"rv"
+    r = client.call("raw_compare_and_swap", {"key": b"rk", "previous_value": b"rv", "value": b"r2", "context": ctx})
+    assert r["succeed"]
+    client.close()
+
+
+def test_locked_key_error_over_wire(single_node):
+    node, server, pd = single_node
+    client = Client(*server.addr)
+    ctx = {"region_id": FIRST_REGION_ID}
+    ts1 = pd.get_tso()
+    client.call(
+        "kv_prewrite",
+        {"mutations": [{"op": "put", "key": b"L", "value": b"v"}], "primary_lock": b"L",
+         "start_version": ts1, "context": ctx},
+    )
+    r = client.call("kv_get", {"key": b"L", "version": pd.get_tso(), "context": ctx})
+    assert "locked" in r["error"]
+    assert r["error"]["locked"]["lock_ts"] == ts1
+    # resolve by rollback, then visible as absent
+    client.call("kv_batch_rollback", {"keys": [b"L"], "start_version": ts1, "context": ctx})
+    r = client.call("kv_get", {"key": b"L", "version": pd.get_tso(), "context": ctx})
+    assert r.get("not_found")
+    client.close()
+
+
+def test_batch_multiplexing(single_node):
+    node, server, pd = single_node
+    client = Client(*server.addr)
+    ctx = {"region_id": FIRST_REGION_ID}
+    results = {}
+
+    def put(i):
+        ts1 = pd.get_tso()
+        r1 = client.call(
+            "kv_prewrite",
+            {"mutations": [{"op": "put", "key": b"mk%d" % i, "value": b"v%d" % i}],
+             "primary_lock": b"mk%d" % i, "start_version": ts1, "context": ctx},
+        )
+        r2 = client.call(
+            "kv_commit",
+            {"keys": [b"mk%d" % i], "start_version": ts1, "commit_version": pd.get_tso(), "context": ctx},
+        )
+        results[i] = (r1, r2)
+
+    threads = [threading.Thread(target=put, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in range(8):
+        r1, r2 = results[i]
+        assert "error" not in r1 and "errors" not in r1
+        assert "error" not in r2
+    r = client.call("kv_scan", {"start_key": b"mk", "version": pd.get_tso(), "limit": 20, "context": ctx})
+    assert len(r["pairs"]) == 8
+    client.close()
+
+
+def test_coprocessor_over_wire(single_node):
+    import sys, os
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from copr_fixtures import PRODUCT_COLUMNS, TABLE_ID, product_kvs
+    from tikv_tpu.copr.table import record_range
+
+    node, server, pd = single_node
+    client = Client(*server.addr)
+    ctx = {"region_id": FIRST_REGION_ID}
+    for rk, val in product_kvs():
+        ts1 = pd.get_tso()
+        client.call(
+            "kv_prewrite",
+            {"mutations": [{"op": "put", "key": rk, "value": val}], "primary_lock": rk,
+             "start_version": ts1, "context": ctx},
+        )
+        client.call("kv_commit", {"keys": [rk], "start_version": ts1, "commit_version": pd.get_tso(), "context": ctx})
+    dag = DagRequest(executors=[TableScan(TABLE_ID, PRODUCT_COLUMNS)])
+    r = client.call(
+        "coprocessor",
+        {"dag": dag_to_wire(dag), "ranges": [list(record_range(TABLE_ID))],
+         "start_ts": pd.get_tso(), "context": ctx},
+    )
+    assert "error" not in r, r
+    resp = SelectResponse(chunks=[])  # decode via iter_rows on raw bytes
+    # reconstruct response object from bytes for assertion
+    from tikv_tpu.util import codec as c
+
+    data = r["data"]
+    nchunks, off = c.decode_var_u64(data, 0)
+    chunks = []
+    for _ in range(nchunks):
+        ln, off = c.decode_var_u64(data, off)
+        chunks.append(data[off : off + ln])
+        off += ln
+    resp = SelectResponse(chunks=chunks)
+    assert len(resp.iter_rows()) == 6
+    client.close()
+
+
+def test_pd_tso_and_region_routing():
+    pd = MockPd()
+    a, b, c = pd.get_tso(), pd.get_tso(), pd.get_tso()
+    assert a < b < c
+    cluster = Cluster(3, pd=pd)
+    cluster.run()
+    cluster.must_put(b"k", b"v")
+    new_id = cluster.split_region(FIRST_REGION_ID, b"m")
+    r = pd.get_region_by_key(b"a")
+    assert r is not None and r.id == FIRST_REGION_ID
+    r = pd.get_region_by_key(b"z")
+    assert r is not None and r.id == new_id
+
+
+def test_node_auto_split_by_size():
+    """PD-worker style auto split when a region exceeds the key threshold."""
+    pd = MockPd()
+    from tikv_tpu.raft.store import ChannelTransport
+
+    transport = ChannelTransport()
+    node = Node(pd, transport, split_threshold_keys=10)
+    transport.register(node.store)
+    node.try_bootstrap_cluster([node.store_id])
+    node.create_region_peers()
+    peer = node.store.peers[FIRST_REGION_ID]
+    peer.node.campaign()
+    node.pump()
+    kv = RaftKv(node.store, pump=node.pump)
+    storage = Storage(engine=kv)
+    from tikv_tpu.storage.txn.commands import Commit, Prewrite
+    from tikv_tpu.storage.txn_types import Key, Mutation
+
+    ctx = {"region_id": FIRST_REGION_ID}
+    for i in range(30):
+        k = b"key%03d" % i
+        ts = pd.get_tso()
+        storage.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(k), b"v")], k, ts), ctx)
+        storage.sched_txn_command(Commit([Key.from_raw(k)], ts, pd.get_tso()), ctx)
+    # trigger the split check directly (the pd_loop does this periodically)
+    node._maybe_split(peer)
+    node.pump()
+    assert len(node.store.peers) == 2
+    regions = sorted(p.region.id for p in node.store.peers.values())
+    # both regions known to PD after the split report
+    for rid in regions:
+        assert pd.get_region_by_id(rid) is not None
+
+
+def test_endpoint_block_cache_serving():
+    """Repeated identical requests with a data version hit the block cache."""
+    import sys, os
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from copr_fixtures import PRODUCT_COLUMNS, TABLE_ID, product_engine
+    from tikv_tpu.copr.aggr import AggDescriptor
+    from tikv_tpu.copr.dag import Aggregation
+    from tikv_tpu.copr.endpoint import CoprRequest, Endpoint
+    from tikv_tpu.copr.rpn import col
+    from tikv_tpu.copr.table import record_range
+    from tikv_tpu.storage.kv import LocalEngine
+
+    eng = LocalEngine(product_engine())
+    ep = Endpoint(eng, enable_device=True)
+    dag = DagRequest(
+        executors=[
+            TableScan(TABLE_ID, PRODUCT_COLUMNS),
+            Aggregation([], [AggDescriptor("count", None), AggDescriptor("sum", col(2))]),
+        ]
+    )
+    req = lambda: CoprRequest(
+        103, DagRequest(executors=dag.executors), [record_range(TABLE_ID)], 200,
+        context={"region_id": 1, "cache_version": 7},
+    )
+    r1 = ep.handle_request(req())
+    r2 = ep.handle_request(req())
+    r3 = ep.handle_request(req())
+    assert r1.from_device and not r1.from_cache
+    assert r2.from_cache and r3.from_cache
+    assert r1.data == r2.data == r3.data
+    # a new data version is a cold start again
+    r4 = ep.handle_request(
+        CoprRequest(103, DagRequest(executors=dag.executors), [record_range(TABLE_ID)], 200,
+                    context={"region_id": 1, "cache_version": 8})
+    )
+    assert not r4.from_cache and r4.data == r1.data
+    # CPU fallback agrees byte-for-byte
+    ep_cpu = Endpoint(eng, enable_device=False)
+    r5 = ep_cpu.handle_request(req())
+    assert not r5.from_device and r5.data == r1.data

@@ -1,0 +1,283 @@
+"""Percolator transaction tests (reference: src/storage/txn tests,
+components/test_storage SyncTestStorage harness)."""
+
+import pytest
+
+from tikv_tpu.storage.mvcc.reader import KeyIsLockedError, WriteConflictError
+from tikv_tpu.storage.mvcc.txn import AlreadyExistsError, TxnStatusKind
+from tikv_tpu.storage.storage import Storage
+from tikv_tpu.storage.txn.commands import (
+    AcquirePessimisticLock,
+    CheckSecondaryLocks,
+    CheckTxnStatus,
+    Cleanup,
+    Commit,
+    PessimisticRollback,
+    Prewrite,
+    ResolveLock,
+    Rollback,
+    TxnHeartBeat,
+)
+from tikv_tpu.storage.txn_types import Key, Mutation, compose_ts
+
+
+@pytest.fixture
+def store():
+    return Storage()
+
+
+def put(store, key, value, start_ts, commit_ts):
+    r = store.sched_txn_command(
+        Prewrite([Mutation.put(Key.from_raw(key), value)], key, start_ts)
+    )
+    assert "errors" not in r, r
+    store.sched_txn_command(Commit([Key.from_raw(key)], start_ts, commit_ts))
+
+
+def test_prewrite_commit_get(store):
+    put(store, b"k", b"v1", 10, 20)
+    assert store.get(b"k", 25) == b"v1"
+    assert store.get(b"k", 15) is None
+    put(store, b"k", b"v2", 30, 40)
+    assert store.get(b"k", 45) == b"v2"
+    assert store.get(b"k", 39) == b"v1"
+
+
+def test_prewrite_blocks_reads_until_commit(store):
+    store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"k"), b"v")], b"k", 10))
+    with pytest.raises(KeyIsLockedError):
+        store.get(b"k", 50)
+    store.sched_txn_command(Commit([Key.from_raw(b"k")], 10, 20))
+    assert store.get(b"k", 50) == b"v"
+
+
+def test_write_conflict(store):
+    put(store, b"k", b"v1", 10, 20)
+    r = store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"k"), b"x")], b"k", 15))
+    assert isinstance(r["errors"][0], WriteConflictError)
+
+
+def test_rollback_then_retry_prewrite_fails(store):
+    store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"k"), b"v")], b"k", 10))
+    store.sched_txn_command(Rollback([Key.from_raw(b"k")], 10))
+    assert store.get(b"k", 50) is None
+    # late prewrite at the same ts must fail against the rollback record
+    r = store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"k"), b"v")], b"k", 10))
+    assert r.get("errors"), "prewrite after rollback must fail"
+
+
+def test_insert_checks_not_exists(store):
+    put(store, b"k", b"v", 10, 20)
+    r = store.sched_txn_command(Prewrite([Mutation.insert(Key.from_raw(b"k"), b"x")], b"k", 30))
+    assert isinstance(r["errors"][0], AlreadyExistsError)
+    # after a delete, insert succeeds
+    store.sched_txn_command(Prewrite([Mutation.delete(Key.from_raw(b"k"))], b"k", 40))
+    store.sched_txn_command(Commit([Key.from_raw(b"k")], 40, 45))
+    r = store.sched_txn_command(Prewrite([Mutation.insert(Key.from_raw(b"k"), b"x")], b"k", 50))
+    assert "errors" not in r
+
+
+def test_delete(store):
+    put(store, b"k", b"v", 10, 20)
+    store.sched_txn_command(Prewrite([Mutation.delete(Key.from_raw(b"k"))], b"k", 30))
+    store.sched_txn_command(Commit([Key.from_raw(b"k")], 30, 35))
+    assert store.get(b"k", 50) is None
+    assert store.get(b"k", 25) == b"v"
+
+
+def test_batch_and_scan(store):
+    for i, ts in [(1, 10), (2, 30), (3, 50)]:
+        put(store, b"k%d" % i, b"v%d" % i, ts, ts + 5)
+    got = store.batch_get([b"k1", b"k2", b"k3", b"nope"], 100)
+    assert got == [(b"k1", b"v1"), (b"k2", b"v2"), (b"k3", b"v3")]
+    assert store.scan(b"", None, None, 40) == [(b"k1", b"v1"), (b"k2", b"v2")]
+    assert store.scan(b"", None, 2, 100) == [(b"k1", b"v1"), (b"k2", b"v2")]
+    assert store.scan(b"", None, 1, 100, reverse=True) == [(b"k3", b"v3")]
+
+
+def test_pessimistic_flow(store):
+    put(store, b"k", b"v0", 5, 6)
+    k = Key.from_raw(b"k")
+    r = store.sched_txn_command(
+        AcquirePessimisticLock([(k, False)], b"k", 10, 11, return_values=True)
+    )
+    assert r["values"] == [b"v0"]
+    # another txn cannot lock
+    with pytest.raises(KeyIsLockedError):
+        store.sched_txn_command(AcquirePessimisticLock([(k, False)], b"k", 12, 13))
+    # reads are NOT blocked by pessimistic locks
+    assert store.get(b"k", 100) == b"v0"
+    # pessimistic prewrite + commit
+    r = store.sched_txn_command(
+        Prewrite(
+            [Mutation.put(k, b"v1")], b"k", 10,
+            is_pessimistic=True, pessimistic_flags=[True], for_update_ts=11,
+        )
+    )
+    assert "errors" not in r
+    store.sched_txn_command(Commit([k], 10, 20))
+    assert store.get(b"k", 30) == b"v1"
+
+
+def test_pessimistic_write_conflict(store):
+    put(store, b"k", b"v1", 10, 20)
+    k = Key.from_raw(b"k")
+    with pytest.raises(WriteConflictError):
+        store.sched_txn_command(AcquirePessimisticLock([(k, False)], b"k", 5, 15))
+
+
+def test_pessimistic_rollback(store):
+    k = Key.from_raw(b"k")
+    store.sched_txn_command(AcquirePessimisticLock([(k, False)], b"k", 10, 11))
+    store.sched_txn_command(PessimisticRollback([k], 10, 11))
+    # lock is gone — another txn can take it
+    store.sched_txn_command(AcquirePessimisticLock([(k, False)], b"k", 12, 13))
+
+
+def test_check_txn_status_and_heartbeat(store):
+    k = Key.from_raw(b"pk")
+    ts10 = compose_ts(1000, 0)
+    store.sched_txn_command(
+        Prewrite([Mutation.put(k, b"v")], b"pk", ts10, lock_ttl=100)
+    )
+    r = store.sched_txn_command(TxnHeartBeat(k, ts10, 500))
+    assert r["lock_ttl"] == 500
+    # within TTL: still locked (caller below min_commit window)
+    r = store.sched_txn_command(
+        CheckTxnStatus(k, ts10, 0, compose_ts(1100, 0))
+    )
+    assert r["status"].kind in (TxnStatusKind.LOCKED, TxnStatusKind.MIN_COMMIT_PUSHED)
+    # TTL expired: rolled back
+    r = store.sched_txn_command(
+        CheckTxnStatus(k, ts10, 0, compose_ts(9000, 0))
+    )
+    assert r["status"].kind == TxnStatusKind.TTL_EXPIRED
+    assert store.get(b"pk", compose_ts(9999, 0)) is None
+
+
+def test_check_txn_status_committed(store):
+    put(store, b"pk", b"v", 10, 20)
+    r = store.sched_txn_command(CheckTxnStatus(Key.from_raw(b"pk"), 10, 0, 100))
+    assert r["status"].kind == TxnStatusKind.COMMITTED
+    assert r["status"].commit_ts == 20
+
+
+def test_cleanup_and_resolve(store):
+    # secondary locks of a dead txn get resolved by its primary's fate
+    ka, kb = Key.from_raw(b"a"), Key.from_raw(b"b")
+    store.sched_txn_command(Prewrite([Mutation.put(ka, b"va"), Mutation.put(kb, b"vb")], b"a", 10))
+    # primary commits at 15 → resolve commits secondaries
+    store.sched_txn_command(Commit([ka], 10, 15))
+    store.sched_txn_command(ResolveLock(10, 15))
+    assert store.get(b"a", 20) == b"va"
+    assert store.get(b"b", 20) == b"vb"
+    # a dead txn's lock: cleanup rolls it back
+    store.sched_txn_command(Prewrite([Mutation.put(ka, b"x")], b"a", 30))
+    store.sched_txn_command(Cleanup(ka, 30, 0))
+    assert store.get(b"a", 50) == b"va"
+
+
+def test_resolve_rollback_path(store):
+    ka, kb = Key.from_raw(b"a"), Key.from_raw(b"b")
+    store.sched_txn_command(Prewrite([Mutation.put(ka, b"va"), Mutation.put(kb, b"vb")], b"a", 10))
+    store.sched_txn_command(ResolveLock(10, 0))  # roll back everything
+    assert store.get(b"a", 50) is None
+    assert store.get(b"b", 50) is None
+    assert store.scan_lock(None, None, 100) == []
+
+
+def test_check_secondary_locks(store):
+    ka, kb = Key.from_raw(b"a"), Key.from_raw(b"b")
+    store.sched_txn_command(Prewrite([Mutation.put(ka, b"va"), Mutation.put(kb, b"vb")], b"a", 10, use_async_commit=True, secondaries=[b"b"]))
+    r = store.sched_txn_command(CheckSecondaryLocks([kb], 10))
+    assert len(r["locks"]) == 1 and r["commit_ts"] == 0
+    # a key that was never locked -> whole txn must roll back
+    kc = Key.from_raw(b"c")
+    r = store.sched_txn_command(CheckSecondaryLocks([kc], 10))
+    assert r["locks"] == [] and r["commit_ts"] == 0
+
+
+def test_scan_lock(store):
+    store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"x"), b"1")], b"x", 11))
+    store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"y"), b"2")], b"y", 22))
+    locks = store.scan_lock(None, None, 100)
+    assert [(k.to_raw(), l.ts) for k, l in locks] == [(b"x", 11), (b"y", 22)]
+    locks = store.scan_lock(None, None, 15)
+    assert [(k.to_raw(), l.ts) for k, l in locks] == [(b"x", 11)]
+
+
+def test_raw_kv(store):
+    store.raw_put(b"rk", b"rv")
+    assert store.raw_get(b"rk") == b"rv"
+    store.raw_batch_put([(b"a", b"1"), (b"b", b"2")])
+    assert store.raw_batch_get([b"a", b"b", b"zz"]) == [(b"a", b"1"), (b"b", b"2")]
+    assert store.raw_scan(b"", None) == [(b"a", b"1"), (b"b", b"2"), (b"rk", b"rv")]
+    assert store.raw_scan(b"", None, reverse=True, limit=1) == [(b"rk", b"rv")]
+    store.raw_delete(b"a")
+    assert store.raw_get(b"a") is None
+    store.raw_delete_range(b"b", b"c")
+    assert store.raw_get(b"b") is None
+    # raw and txn keyspaces are disjoint
+    put(store, b"rk", b"txn-v", 10, 20)
+    assert store.raw_get(b"rk") == b"rv"
+    assert store.get(b"rk", 50) == b"txn-v"
+
+
+def test_raw_ttl(store):
+    store.raw_put(b"t", b"v", ttl=100)
+    assert store.raw_get(b"t") == b"v"
+    assert 0 < store.raw_get_key_ttl(b"t") <= 100
+    import time as _t
+    future = _t.time() + 1000
+    assert store.raw_get(b"t", now=future) is None
+    store.raw_put(b"t2", b"v2")  # no ttl
+    assert store.raw_get_key_ttl(b"t2") == 0
+    assert store.raw_get(b"t2", now=future) == b"v2"
+
+
+def test_raw_cas(store):
+    ok, prev = store.raw_compare_and_swap(b"c", None, b"v1")
+    assert ok and prev is None
+    ok, prev = store.raw_compare_and_swap(b"c", None, b"v2")
+    assert not ok and prev == b"v1"
+    ok, prev = store.raw_compare_and_swap(b"c", b"v1", b"v2")
+    assert ok
+    assert store.raw_get(b"c") == b"v2"
+
+
+def test_concurrent_transfer_consistency(store):
+    """Bank-transfer style concurrency: latches + MVCC keep totals constant."""
+    import threading
+
+    put(store, b"acc1", b"100", 1, 2)
+    put(store, b"acc2", b"100", 1, 2)
+    errs = []
+
+    def transfer(start_ts, frm, to, amt):
+        try:
+            v1 = int(store.get(frm, start_ts))
+            v2 = int(store.get(to, start_ts))
+            muts = [
+                Mutation.put(Key.from_raw(frm), str(v1 - amt).encode()),
+                Mutation.put(Key.from_raw(to), str(v2 + amt).encode()),
+            ]
+            r = store.sched_txn_command(Prewrite(muts, frm, start_ts))
+            if r.get("errors"):
+                return
+            store.sched_txn_command(
+                Commit([Key.from_raw(frm), Key.from_raw(to)], start_ts, start_ts + 5)
+            )
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=transfer, args=(10 + i * 20, b"acc1", b"acc2", 10))
+        for i in range(5
+        )
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = int(store.get(b"acc1", 10**6) or 0) + int(store.get(b"acc2", 10**6) or 0)
+    assert total == 200

@@ -22,6 +22,9 @@ from dataclasses import dataclass, field
 import numpy as np
 
 
+_MAX_DEVICE_SIGS = 4
+
+
 @dataclass
 class _Block:
     cols: list  # list[Column] (host)
@@ -48,10 +51,18 @@ class ColumnBlockCache:
         return sum(b.n_valid for b in self.blocks)
 
     def device_arrays(self, block: _Block, sig: tuple, build) -> tuple:
-        """Per-block device arrays for a plan signature, pinned on first use."""
+        """Per-block device arrays for a plan signature, pinned on first use.
+        Bounded per block: each distinct signature pins a full copy, so old
+        signatures are dropped LRU-style once _MAX_DEVICE_SIGS accumulate."""
         hit = block.device.get(sig)
         if hit is None:
             hit = build(block)
+            block.device[sig] = hit
+            while len(block.device) > _MAX_DEVICE_SIGS:
+                block.device.pop(next(iter(block.device)))
+        else:
+            # touch for LRU order
+            block.device.pop(sig)
             block.device[sig] = hit
         return hit
 
@@ -73,4 +84,8 @@ class CopCache:
             while len(self._order) > self.max_entries:
                 old = self._order.pop(0)
                 del self._entries[old]
+        else:
+            # LRU touch so hot entries survive cold churn
+            self._order.remove(key)
+            self._order.append(key)
         return e

@@ -1,0 +1,101 @@
+"""DagRequest ⇄ wire dict conversion (the tipb-protobuf role for our RPC)."""
+
+from __future__ import annotations
+
+from .aggr import AggDescriptor
+from .dag import Aggregation, DagRequest, IndexScan, Limit, Selection, TableScan, TopN
+from .datatypes import ColumnInfo, EvalType, FieldType, FieldTypeTp
+from .rpn import ColumnRef, Constant, FuncCall
+
+
+def expr_to_wire(e) -> dict:
+    if isinstance(e, ColumnRef):
+        return {"t": "col", "i": e.index}
+    if isinstance(e, Constant):
+        return {"t": "const", "v": e.value, "et": e.eval_type.value, "frac": e.frac}
+    if isinstance(e, FuncCall):
+        return {"t": "call", "op": e.op, "args": [expr_to_wire(c) for c in e.children]}
+    raise TypeError(e)
+
+
+def expr_from_wire(d: dict):
+    if d["t"] == "col":
+        return ColumnRef(d["i"])
+    if d["t"] == "const":
+        return Constant(d["v"], EvalType(d["et"]), d.get("frac", 0))
+    if d["t"] == "call":
+        return FuncCall(d["op"], [expr_from_wire(a) for a in d["args"]])
+    raise ValueError(d)
+
+
+def _col_info_to_wire(c: ColumnInfo) -> dict:
+    return {
+        "id": c.col_id,
+        "tp": int(c.ftype.tp),
+        "flag": c.ftype.flag,
+        "dec": c.ftype.decimal,
+        "pk": c.is_pk_handle,
+    }
+
+
+def _col_info_from_wire(d: dict) -> ColumnInfo:
+    return ColumnInfo(
+        d["id"],
+        FieldType(FieldTypeTp(d["tp"]), d.get("flag", 0), decimal=d.get("dec", 0)),
+        is_pk_handle=d.get("pk", False),
+    )
+
+
+def dag_to_wire(dag: DagRequest) -> dict:
+    execs = []
+    for e in dag.executors:
+        if isinstance(e, TableScan):
+            execs.append({"t": "table_scan", "table_id": e.table_id,
+                          "cols": [_col_info_to_wire(c) for c in e.columns_info]})
+        elif isinstance(e, IndexScan):
+            execs.append({"t": "index_scan", "table_id": e.table_id, "index_id": e.index_id,
+                          "cols": [_col_info_to_wire(c) for c in e.columns_info]})
+        elif isinstance(e, Selection):
+            execs.append({"t": "selection", "conds": [expr_to_wire(c) for c in e.conditions]})
+        elif isinstance(e, Aggregation):
+            execs.append({
+                "t": "agg",
+                "group_by": [expr_to_wire(g) for g in e.group_by],
+                "aggs": [{"op": a.op, "expr": expr_to_wire(a.expr) if a.expr else None} for a in e.agg_funcs],
+                "streamed": e.streamed,
+            })
+        elif isinstance(e, TopN):
+            execs.append({"t": "topn", "limit": e.limit,
+                          "order_by": [[expr_to_wire(x), desc] for x, desc in e.order_by]})
+        elif isinstance(e, Limit):
+            execs.append({"t": "limit", "limit": e.limit})
+        else:
+            raise TypeError(e)
+    return {"executors": execs, "output_offsets": dag.output_offsets, "chunk_rows": dag.chunk_rows}
+
+
+def dag_from_wire(d: dict) -> DagRequest:
+    execs = []
+    for e in d["executors"]:
+        t = e["t"]
+        if t == "table_scan":
+            execs.append(TableScan(e["table_id"], [_col_info_from_wire(c) for c in e["cols"]]))
+        elif t == "index_scan":
+            execs.append(IndexScan(e["table_id"], e["index_id"], [_col_info_from_wire(c) for c in e["cols"]]))
+        elif t == "selection":
+            execs.append(Selection([expr_from_wire(c) for c in e["conds"]]))
+        elif t == "agg":
+            execs.append(
+                Aggregation(
+                    [expr_from_wire(g) for g in e["group_by"]],
+                    [AggDescriptor(a["op"], expr_from_wire(a["expr"]) if a["expr"] else None) for a in e["aggs"]],
+                    streamed=e.get("streamed", False),
+                )
+            )
+        elif t == "topn":
+            execs.append(TopN([(expr_from_wire(x), desc) for x, desc in e["order_by"]], e["limit"]))
+        elif t == "limit":
+            execs.append(Limit(e["limit"]))
+        else:
+            raise ValueError(t)
+    return DagRequest(executors=execs, output_offsets=d.get("output_offsets"), chunk_rows=d.get("chunk_rows", 1024))

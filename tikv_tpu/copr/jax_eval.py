@@ -183,6 +183,39 @@ def _seg_extreme(x, gids, capacity: int, is_min: bool, identity):
     return f(x, gids, num_segments=capacity)
 
 
+def _build_cols(ship_cols, nullable, col_data, col_nulls, n_rows):
+    """Column map for eval_rpn: NOT NULL columns get a folded constant mask."""
+    no_nulls = jnp.zeros(n_rows, dtype=bool)
+    nullmap = dict(zip(nullable, col_nulls))
+    return {i: (col_data[j], nullmap.get(i, no_nulls)) for j, i in enumerate(ship_cols)}
+
+
+def _mixed_radix_gids(cols, group_cols, dict_lens, n_rows):
+    """Group ids from resident dictionary-code columns (stable radices)."""
+    local = jnp.zeros(n_rows, dtype=jnp.int64)
+    for gi, dlen in zip(group_cols, dict_lens):
+        codes, gnulls = cols[gi]
+        local = local * (dlen + 1) + jnp.where(gnulls, dlen, codes)
+    return local
+
+
+def _fused_step(sel_rpns, device_aggs, capacity, n_rows, cols, n_valid, gids, offset, state):
+    """THE block step, shared by every device program: selection predicates →
+    active mask; aggregate updates; first-active-row tracker."""
+    first_row, carries = state
+    active = jnp.arange(n_rows, dtype=jnp.int64) < n_valid
+    for rpn in sel_rpns:
+        d, nl = eval_rpn(rpn, cols, n_rows, xp=jnp)
+        active = active & (d != 0) & ~nl
+    new_carries = tuple(
+        da.update(c, cols, n_rows, gids, active, capacity)
+        for da, c in zip(device_aggs, carries)
+    )
+    ridx = jnp.where(active, offset + jnp.arange(n_rows, dtype=jnp.int64), _NO_ROW)
+    block_first = _seg_extreme(ridx, gids, capacity, True, _NO_ROW)
+    return (jnp.minimum(first_row, block_first), new_carries)
+
+
 class _DeviceAgg:
     """Builds the jitted block update + carry init for one aggregate."""
 
@@ -388,30 +421,10 @@ class JaxDagEvaluator:
         n_rows = self.block_rows
 
         def agg_fn(col_data, col_nulls, n_valid, gids, block_offset, state):
-            first_row, carries = state
-            no_nulls = jnp.zeros(n_rows, dtype=bool)
-            nullmap = dict(zip(nullable, col_nulls))
-            cols = {
-                i: (col_data[j], nullmap.get(i, no_nulls))
-                for j, i in enumerate(device_cols)
-            }
-            active = jnp.arange(n_rows, dtype=jnp.int64) < n_valid
-            for rpn in sel_rpns:
-                d, nl = eval_rpn(rpn, cols, n_rows, xp=jnp)
-                active = active & (d != 0) & ~nl
-            new_carries = tuple(
-                da.update(c, cols, n_rows, gids, active, capacity)
-                for da, c in zip(device_aggs, carries)
+            cols = _build_cols(device_cols, nullable, col_data, col_nulls, n_rows)
+            return _fused_step(
+                sel_rpns, device_aggs, capacity, n_rows, cols, n_valid, gids, block_offset, state
             )
-            # first active row per group — decides which groups exist and in
-            # what order (first-occurrence over the filtered stream, exactly
-            # the CPU hash-agg's insertion order)
-            ridx = jnp.where(
-                active, block_offset + jnp.arange(n_rows, dtype=jnp.int64), _NO_ROW
-            )
-            block_first = _seg_extreme(ridx, gids, capacity, True, _NO_ROW)
-            new_first = jnp.minimum(first_row, block_first)
-            return (new_first, new_carries)
 
         fn = jax.jit(agg_fn, donate_argnums=(5,))
         self._agg_fn_cache[capacity] = fn
@@ -440,25 +453,8 @@ class JaxDagEvaluator:
 
             def body(st, xs):
                 cd, cn, nv, g, off = xs
-                first_row, carries = st
-                no_nulls = jnp.zeros(n_rows, dtype=bool)
-                nullmap = dict(zip(nullable, cn))
-                cols = {
-                    i: (cd[j], nullmap.get(i, no_nulls)) for j, i in enumerate(device_cols)
-                }
-                active = jnp.arange(n_rows, dtype=jnp.int64) < nv
-                for rpn in sel_rpns:
-                    d, nl = eval_rpn(rpn, cols, n_rows, xp=jnp)
-                    active = active & (d != 0) & ~nl
-                new_carries = tuple(
-                    da.update(c, cols, n_rows, g, active, capacity)
-                    for da, c in zip(device_aggs, carries)
-                )
-                ridx = jnp.where(
-                    active, off + jnp.arange(n_rows, dtype=jnp.int64), _NO_ROW
-                )
-                block_first = jax.ops.segment_min(ridx, g, num_segments=capacity)
-                return (jnp.minimum(first_row, block_first), new_carries), None
+                cols = _build_cols(device_cols, nullable, cd, cn, n_rows)
+                return _fused_step(sel_rpns, device_aggs, capacity, n_rows, cols, nv, g, off, st), None
 
             state, _ = jax.lax.scan(body, state, (col_data, col_nulls, n_valids, gids, offsets))
             # pack everything into ONE int64 matrix: the tunnel charges a flat
@@ -491,31 +487,9 @@ class JaxDagEvaluator:
 
             def body(st, xs):
                 cd, cn, nv, off = xs
-                first_row, carries = st
-                no_nulls = jnp.zeros(n_rows, dtype=bool)
-                nullmap = dict(zip(nullable, cn))
-                cols = {
-                    i: (cd[j], nullmap.get(i, no_nulls)) for j, i in enumerate(ship_cols)
-                }
-                active = jnp.arange(n_rows, dtype=jnp.int64) < nv
-                for rpn in sel_rpns:
-                    d, nl = eval_rpn(rpn, cols, n_rows, xp=jnp)
-                    active = active & (d != 0) & ~nl
-                # mixed-radix group id from the resident code columns
-                local = jnp.zeros(n_rows, dtype=jnp.int64)
-                for gi, dlen in zip(group_cols, dict_lens):
-                    codes, gnulls = cols[gi]
-                    local = local * (dlen + 1) + jnp.where(gnulls, dlen, codes)
-                gids = local
-                new_carries = tuple(
-                    da.update(c, cols, n_rows, gids, active, capacity)
-                    for da, c in zip(device_aggs, carries)
-                )
-                ridx = jnp.where(
-                    active, off + jnp.arange(n_rows, dtype=jnp.int64), _NO_ROW
-                )
-                block_first = _seg_extreme(ridx, gids, capacity, True, _NO_ROW)
-                return (jnp.minimum(first_row, block_first), new_carries), None
+                cols = _build_cols(ship_cols, nullable, cd, cn, n_rows)
+                gids = _mixed_radix_gids(cols, group_cols, dict_lens, n_rows)
+                return _fused_step(sel_rpns, device_aggs, capacity, n_rows, cols, nv, gids, off, st), None
 
             state, _ = jax.lax.scan(body, state, (col_data, col_nulls, n_valids, offsets))
             return _pack_state(state)
@@ -930,7 +904,15 @@ def run_batch_cached(evaluators: list["JaxDagEvaluator"], cache) -> list[SelectR
     col_data, col_nulls = base._stacked_device(cache, blocks, ship, nullable)
     n_rows = base.block_rows
 
-    key = (tuple(id(ev) for ev in evaluators), n_blocks, tuple(ship), n_rows)
+    key = (
+        tuple(id(ev) for ev in evaluators),
+        n_blocks,
+        tuple(ship),
+        n_rows,
+        # dict radices and capacities are baked into the compiled program —
+        # a cache whose dictionaries grew must compile a fresh program
+        tuple((spec[3], spec[4]) for spec in specs),
+    )
     fn = _BATCH_FN_CACHE.get(key)
     if fn is None:
         def batch_fn(col_data, col_nulls, n_valids, offsets):
@@ -944,30 +926,15 @@ def run_batch_cached(evaluators: list["JaxDagEvaluator"], cache) -> list[SelectR
 
             def body(sts, xs):
                 cd, cn, nv, off = xs
-                no_nulls = jnp.zeros(n_rows, dtype=bool)
-                nullmap = dict(zip(nullable, cn))
-                cols = {i: (cd[j], nullmap.get(i, no_nulls)) for j, i in enumerate(ship)}
-                base_active = jnp.arange(n_rows, dtype=jnp.int64) < nv
+                cols = _build_cols(ship, nullable, cd, cn, n_rows)
                 new_sts = []
                 for (ev, group_cols, _dicts, dict_lens, capacity, _ns), st in zip(specs, sts):
-                    first_row, carries = st
-                    active = base_active
-                    for rpn in ev.sel_rpns:
-                        d, nl = eval_rpn(rpn, cols, n_rows, xp=jnp)
-                        active = active & (d != 0) & ~nl
-                    local = jnp.zeros(n_rows, dtype=jnp.int64)
-                    for gi, dlen in zip(group_cols, dict_lens):
-                        codes, gnulls = cols[gi]
-                        local = local * (dlen + 1) + jnp.where(gnulls, dlen, codes)
-                    new_carries = tuple(
-                        da.update(c, cols, n_rows, local, active, capacity)
-                        for da, c in zip(ev.device_aggs, carries)
+                    gids = _mixed_radix_gids(cols, group_cols, dict_lens, n_rows)
+                    new_sts.append(
+                        _fused_step(
+                            ev.sel_rpns, ev.device_aggs, capacity, n_rows, cols, nv, gids, off, st
+                        )
                     )
-                    ridx = jnp.where(
-                        active, off + jnp.arange(n_rows, dtype=jnp.int64), _NO_ROW
-                    )
-                    bf = _seg_extreme(ridx, local, capacity, True, _NO_ROW)
-                    new_sts.append((jnp.minimum(first_row, bf), new_carries))
                 return tuple(new_sts), None
 
             states, _ = jax.lax.scan(body, states, (col_data, col_nulls, n_valids, offsets))

@@ -135,7 +135,7 @@ class MvccBatchScanSource(ScanSource):
                 return None
             off += 1
         off += 1  # the terminating varint byte
-        if off >= vw:
+        if off + 1 >= vw:
             return None
         if not (varr[:, off] == _SHORT_PREFIX).all():
             return None

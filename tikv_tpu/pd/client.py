@@ -1,0 +1,142 @@
+"""Placement driver (PD) client trait + in-process PD implementation.
+
+Re-expression of ``components/pd_client`` (``src/lib.rs:73``: bootstrap,
+get_region, region_heartbeat, ask_batch_split, store_heartbeat, get_tso) and
+``components/test_pd``'s in-process mock.  The in-process PD is authoritative
+for: id allocation, TSO, region routing metadata, store liveness, and split
+scheduling decisions (max region size → ask_split).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..raft.region import Region
+from ..storage.txn_types import compose_ts
+
+
+class PdClient:
+    """The trait surface node/raftstore/GC code programs against."""
+
+    def alloc_id(self) -> int: ...
+
+    def get_tso(self) -> int: ...
+
+    def bootstrap_region(self, region: Region) -> None: ...
+
+    def get_region_by_key(self, key: bytes) -> Region | None: ...
+
+    def get_region_by_id(self, region_id: int) -> Region | None: ...
+
+    def region_heartbeat(self, region: Region, leader_store: int) -> None: ...
+
+    def store_heartbeat(self, store_id: int, stats: dict) -> None: ...
+
+    def report_split(self, left: Region, right: Region) -> None: ...
+
+    def get_gc_safe_point(self) -> int: ...
+
+
+@dataclass
+class StoreInfo:
+    store_id: int
+    last_heartbeat: float = 0.0
+    stats: dict = field(default_factory=dict)
+
+
+class MockPd(PdClient):
+    """In-process PD: single authority, thread-safe (test_pd's TestPdClient)."""
+
+    def __init__(self, start_physical_ms: int | None = None):
+        self._mu = threading.RLock()
+        self._next_id = 1000
+        self._logical = 0
+        self._physical = start_physical_ms or int(time.time() * 1000)
+        self.regions: dict[int, Region] = {}
+        self.leaders: dict[int, int] = {}
+        self.stores: dict[int, StoreInfo] = {}
+        self.gc_safe_point = 0
+        self.max_region_keys: int | None = None  # split trigger for heartbeats
+        self.split_requests: list[int] = []
+
+    # -- ids / tso ---------------------------------------------------------
+
+    def alloc_id(self) -> int:
+        with self._mu:
+            self._next_id += 1
+            return self._next_id
+
+    def get_tso(self) -> int:
+        with self._mu:
+            now_ms = int(time.time() * 1000)
+            if now_ms > self._physical:
+                self._physical = now_ms
+                self._logical = 0
+            self._logical += 1
+            return compose_ts(self._physical, self._logical)
+
+    # -- region metadata ---------------------------------------------------
+
+    def bootstrap_region(self, region: Region) -> None:
+        with self._mu:
+            self.regions[region.id] = region
+
+    def get_region_by_key(self, key: bytes) -> Region | None:
+        with self._mu:
+            for r in self.regions.values():
+                if r.contains(key):
+                    return r.clone()
+        return None
+
+    def get_region_by_id(self, region_id: int) -> Region | None:
+        with self._mu:
+            r = self.regions.get(region_id)
+            return r.clone() if r else None
+
+    def leader_of(self, region_id: int) -> int | None:
+        with self._mu:
+            return self.leaders.get(region_id)
+
+    def region_heartbeat(self, region: Region, leader_store: int) -> None:
+        with self._mu:
+            cur = self.regions.get(region.id)
+            if cur is None or (
+                (region.epoch.version, region.epoch.conf_ver)
+                >= (cur.epoch.version, cur.epoch.conf_ver)
+            ):
+                self.regions[region.id] = region.clone()
+                self.leaders[region.id] = leader_store
+
+    def report_split(self, left: Region, right: Region) -> None:
+        with self._mu:
+            self.regions[left.id] = left.clone()
+            self.regions[right.id] = right.clone()
+
+    # -- stores ------------------------------------------------------------
+
+    def put_store(self, store_id: int) -> None:
+        with self._mu:
+            self.stores[store_id] = StoreInfo(store_id)
+
+    def store_heartbeat(self, store_id: int, stats: dict) -> None:
+        with self._mu:
+            info = self.stores.setdefault(store_id, StoreInfo(store_id))
+            info.last_heartbeat = time.time()
+            info.stats = stats
+
+    def alive_stores(self, within_secs: float = 30.0) -> list[int]:
+        now = time.time()
+        with self._mu:
+            return [s.store_id for s in self.stores.values() if now - s.last_heartbeat < within_secs]
+
+    # -- gc ----------------------------------------------------------------
+
+    def update_gc_safe_point(self, ts: int) -> None:
+        with self._mu:
+            self.gc_safe_point = max(self.gc_safe_point, ts)
+
+    def get_gc_safe_point(self) -> int:
+        with self._mu:
+            return self.gc_safe_point

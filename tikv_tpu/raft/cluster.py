@@ -1,0 +1,236 @@
+"""Multi-node test cluster harness.
+
+Re-expression of ``components/test_raftstore``'s ``Cluster<T: Simulator>``
+(src/cluster.rs:128): N real stores in one process over an in-memory
+ChannelTransport, with deterministic message pumping, fault-injection filters,
+node stop/restart, leader transfer by campaign, and region split.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from ..storage.engine import CF_DEFAULT, WriteBatch
+from .raftkv import RaftKv, RegionSnapshot
+from .region import NotLeaderError, Peer as RegionPeer, Region, RegionEpoch
+from .store import ChannelTransport, RaftMessage, Store, StorePeer
+
+FIRST_REGION_ID = 1
+
+
+class Cluster:
+    def __init__(self, n_stores: int, pd=None):
+        self.transport = ChannelTransport()
+        self.stores: dict[int, Store] = {}
+        self.stopped: set[int] = set()
+        self.pd = pd
+        self._ids = itertools.count(1000)
+        for sid in range(1, n_stores + 1):
+            store = Store(sid, self.transport)
+            self.transport.register(store)
+            self.stores[sid] = store
+
+    def alloc_id(self) -> int:
+        if self.pd is not None:
+            return self.pd.alloc_id()
+        return next(self._ids)
+
+    # -- bootstrap ---------------------------------------------------------
+
+    def bootstrap(self) -> Region:
+        """First region spans the whole key space with one peer per store
+        (node.rs:153 bootstrap semantics)."""
+        peers = [RegionPeer(self.alloc_id(), sid) for sid in self.stores]
+        region = Region(FIRST_REGION_ID, b"", b"", RegionEpoch(), peers)
+        for store in self.stores.values():
+            store.create_peer(region)
+        if self.pd is not None:
+            self.pd.bootstrap_region(region.clone())
+            for s in self.stores.values():
+                s.split_observers.append(self._report_split_to_pd)
+        return region
+
+    def _report_split_to_pd(self, store, old, new):
+        if self.pd is not None:
+            self.pd.report_split(old.clone(), new.clone())
+
+    def bootstrap_subset(self, store_ids: list[int]) -> Region:
+        """First region placed on a subset of stores (conf-change tests)."""
+        peers = [RegionPeer(self.alloc_id(), sid) for sid in store_ids]
+        region = Region(FIRST_REGION_ID, b"", b"", RegionEpoch(), peers)
+        for sid in store_ids:
+            self.stores[sid].create_peer(region)
+        return region
+
+    def run(self) -> None:
+        self.bootstrap()
+        self.elect_leader(FIRST_REGION_ID, 1)
+
+    # -- driving -----------------------------------------------------------
+
+    def process(self, max_rounds: int = 200) -> None:
+        for _ in range(max_rounds):
+            moved = False
+            for sid, store in self.stores.items():
+                if sid in self.stopped:
+                    store._inbox.clear()
+                    continue
+                if store.process_messages():
+                    moved = True
+                if store.handle_readies():
+                    moved = True
+            if not moved:
+                return
+
+    def tick(self, n: int = 1) -> None:
+        for _ in range(n):
+            for sid, store in self.stores.items():
+                if sid not in self.stopped:
+                    store.tick()
+            self.process()
+
+    def elect_leader(self, region_id: int, store_id: int) -> StorePeer:
+        peer = self.stores[store_id].peers[region_id]
+        peer.node.campaign()
+        self.process()
+        assert peer.node.is_leader(), f"store {store_id} failed to take region {region_id}"
+        return peer
+
+    def leader_peer(self, region_id: int) -> StorePeer | None:
+        leaders = []
+        for sid, store in self.stores.items():
+            if sid in self.stopped:
+                continue
+            p = store.peers.get(region_id)
+            if p is not None and p.node.is_leader():
+                leaders.append(p)
+        if not leaders:
+            return None
+        # during partitions a deposed leader may linger at a lower term —
+        # the real leader is the one with the highest term
+        return max(leaders, key=lambda p: p.node.term)
+
+    def wait_leader(self, region_id: int, max_ticks: int = 100) -> StorePeer:
+        for _ in range(max_ticks):
+            p = self.leader_peer(region_id)
+            if p is not None:
+                return p
+            self.tick()
+        raise AssertionError(f"no leader for region {region_id}")
+
+    # -- node lifecycle (Simulator trait) ----------------------------------
+
+    def stop_node(self, store_id: int) -> None:
+        self.stopped.add(store_id)
+
+    def restart_node(self, store_id: int) -> None:
+        self.stopped.discard(store_id)
+
+    # -- KV helpers --------------------------------------------------------
+
+    def raftkv(self, store_id: int) -> RaftKv:
+        return RaftKv(self.stores[store_id], pump=self.process)
+
+    def region_for_key(self, key: bytes) -> int:
+        for store in self.stores.values():
+            p = store.region_for_key(key)
+            if p is not None:
+                return p.region.id
+        raise KeyError(key)
+
+    def must_put(self, key: bytes, value: bytes, cf: str = CF_DEFAULT) -> None:
+        region_id = self.region_for_key(key)
+        leader = self.wait_leader(region_id)
+        kv = self.raftkv(leader.store.store_id)
+        wb = WriteBatch()
+        wb.put_cf(cf, key, value)
+        kv.write({"region_id": region_id}, wb)
+
+    def must_delete(self, key: bytes, cf: str = CF_DEFAULT) -> None:
+        region_id = self.region_for_key(key)
+        leader = self.wait_leader(region_id)
+        kv = self.raftkv(leader.store.store_id)
+        wb = WriteBatch()
+        wb.delete_cf(cf, key)
+        kv.write({"region_id": region_id}, wb)
+
+    def must_get(self, key: bytes, cf: str = CF_DEFAULT) -> bytes | None:
+        region_id = self.region_for_key(key)
+        leader = self.wait_leader(region_id)
+        kv = self.raftkv(leader.store.store_id)
+        snap = kv.snapshot({"region_id": region_id})
+        return snap.get_cf(cf, key)
+
+    def get_on_store(self, store_id: int, key: bytes, cf: str = CF_DEFAULT) -> bytes | None:
+        """Read the store's local applied state directly (follower check)."""
+        from ..util import keys as keymod
+
+        return self.stores[store_id].engine.get_cf(cf, keymod.data_key(key))
+
+    # -- admin -------------------------------------------------------------
+
+    def split_region(self, region_id: int, split_key: bytes) -> int:
+        leader = self.wait_leader(region_id)
+        new_region_id = self.alloc_id()
+        new_pids = [self.alloc_id() for _ in leader.region.peers]
+        cmd = {
+            "epoch": (leader.region.epoch.conf_ver, leader.region.epoch.version),
+            "ops": [],
+            "admin": ("split", split_key, new_region_id, new_pids),
+        }
+        import threading
+
+        done = threading.Event()
+        res: list = []
+
+        def cb(r):
+            res.append(r)
+            done.set()
+
+        leader.propose_cmd(cmd, cb)
+        while not done.is_set():
+            self.process()
+        if isinstance(res[0], Exception):
+            raise res[0]
+        # give the new region a leader
+        self.wait_leader(new_region_id)
+        return new_region_id
+
+    def add_peer(self, region_id: int, store_id: int) -> int:
+        leader = self.wait_leader(region_id)
+        new_pid = self.alloc_id()
+        cmd = {
+            "epoch": (leader.region.epoch.conf_ver, leader.region.epoch.version),
+            "ops": [],
+            "admin": ("conf_change", "add", new_pid, store_id),
+        }
+        self._run_admin(leader, cmd)
+        return new_pid
+
+    def remove_peer(self, region_id: int, peer_id: int) -> None:
+        leader = self.wait_leader(region_id)
+        cmd = {
+            "epoch": (leader.region.epoch.conf_ver, leader.region.epoch.version),
+            "ops": [],
+            "admin": ("conf_change", "remove", peer_id, 0),
+        }
+        self._run_admin(leader, cmd)
+
+    def _run_admin(self, leader: StorePeer, cmd: dict) -> None:
+        import threading
+
+        done = threading.Event()
+        res: list = []
+
+        def cb(r):
+            res.append(r)
+            done.set()
+
+        leader.propose_cmd(cmd, cb)
+        while not done.is_set():
+            self.process()
+        if isinstance(res[0], Exception):
+            raise res[0]
+
+    def transfer_leader(self, region_id: int, to_store: int) -> None:
+        self.elect_leader(region_id, to_store)

@@ -1,0 +1,554 @@
+"""Raft consensus core.
+
+The reference consumes the external ``raft-rs`` crate (``RawNode``/``Ready``;
+pinned in Cargo.toml:184).  This is this framework's own implementation of
+the Raft state machine with the same interaction style:
+
+    node.step(msg)        # feed a message from a peer
+    node.tick()           # advance logical time (elections, heartbeats)
+    node.propose(data)    # leader: append a proposal
+    rd = node.ready()     # drain: entries to persist, messages to send,
+                          #        committed entries to apply
+    node.advance(rd)
+
+Implemented: randomized election timeout, leader election, log replication
+with consistency check, quorum commitment, heartbeats + lease-basis
+(leader_alive quorum tracking), snapshot install for lagging/new peers,
+single-step membership change (AddNode/RemoveNode), ReadIndex.
+Not yet: pre-vote, joint consensus, learners, log compaction scheduling
+(compaction is driven by the store layer).
+"""
+
+from __future__ import annotations
+
+import enum
+import random
+from dataclasses import dataclass, field
+
+
+class Role(enum.Enum):
+    FOLLOWER = "follower"
+    CANDIDATE = "candidate"
+    LEADER = "leader"
+
+
+class MsgType(enum.Enum):
+    VOTE = "vote"
+    VOTE_RESP = "vote_resp"
+    APPEND = "append"
+    APPEND_RESP = "append_resp"
+    HEARTBEAT = "heartbeat"
+    HEARTBEAT_RESP = "heartbeat_resp"
+    SNAPSHOT = "snapshot"
+    READ_INDEX = "read_index"
+    READ_INDEX_RESP = "read_index_resp"
+
+
+@dataclass
+class Entry:
+    term: int
+    index: int
+    data: bytes = b""
+    # conf change entries carry ("add"|"remove", peer_id) instead of data
+    conf_change: tuple[str, int] | None = None
+
+
+@dataclass
+class Snapshot:
+    index: int
+    term: int
+    data: bytes  # opaque state-machine snapshot
+    voters: tuple[int, ...]
+
+
+@dataclass
+class Message:
+    type: MsgType
+    frm: int
+    to: int
+    term: int
+    log_index: int = 0  # prev_log_index for APPEND, candidate last index for VOTE
+    log_term: int = 0
+    entries: list[Entry] = field(default_factory=list)
+    commit: int = 0
+    reject: bool = False
+    reject_hint: int = 0
+    snapshot: Snapshot | None = None
+    context: bytes = b""  # read-index correlation
+
+
+@dataclass
+class Ready:
+    """What the container must do before advancing (raft-rs Ready)."""
+
+    entries: list[Entry] = field(default_factory=list)  # to persist
+    messages: list[Message] = field(default_factory=list)  # to send
+    committed_entries: list[Entry] = field(default_factory=list)  # to apply
+    snapshot: Snapshot | None = None  # to restore
+    hard_state_changed: bool = False
+    read_states: list[tuple[bytes, int]] = field(default_factory=list)  # (ctx, index)
+
+    def is_empty(self) -> bool:
+        return not (
+            self.entries
+            or self.messages
+            or self.committed_entries
+            or self.snapshot
+            or self.hard_state_changed
+            or self.read_states
+        )
+
+
+class RaftLog:
+    """In-memory log with an offset (entries before offset live in snapshots)."""
+
+    def __init__(self):
+        self.entries: list[Entry] = []
+        self.offset = 1  # index of entries[0]
+        self.snapshot_index = 0
+        self.snapshot_term = 0
+
+    def last_index(self) -> int:
+        return self.offset + len(self.entries) - 1 if self.entries else self.snapshot_index
+
+    def term_at(self, index: int) -> int | None:
+        if index == 0:
+            return 0
+        if index == self.snapshot_index:
+            return self.snapshot_term
+        i = index - self.offset
+        if 0 <= i < len(self.entries):
+            return self.entries[i].term
+        return None
+
+    def slice_from(self, index: int) -> list[Entry]:
+        i = index - self.offset
+        if i < 0:
+            return []
+        return self.entries[max(i, 0) :]
+
+    def entry(self, index: int) -> Entry | None:
+        i = index - self.offset
+        if 0 <= i < len(self.entries):
+            return self.entries[i]
+        return None
+
+    def truncate_from(self, index: int) -> None:
+        self.entries = self.entries[: index - self.offset]
+
+    def append(self, entries: list[Entry]) -> None:
+        self.entries.extend(entries)
+
+    def compact_to(self, index: int, term: int) -> None:
+        """Drop entries up to ``index`` (now covered by a snapshot)."""
+        keep = index + 1 - self.offset
+        if keep > 0:
+            self.entries = self.entries[keep:]
+            self.offset = index + 1
+        self.snapshot_index = index
+        self.snapshot_term = term
+
+    def reset_to_snapshot(self, snap: Snapshot) -> None:
+        self.entries = []
+        self.offset = snap.index + 1
+        self.snapshot_index = snap.index
+        self.snapshot_term = snap.term
+
+
+class RaftNode:
+    """One raft participant (raft-rs RawNode equivalent)."""
+
+    def __init__(
+        self,
+        node_id: int,
+        voters: list[int],
+        election_tick: int = 10,
+        heartbeat_tick: int = 2,
+        rng: random.Random | None = None,
+    ):
+        self.id = node_id
+        self.voters: set[int] = set(voters)
+        self.term = 0
+        self.vote: int | None = None
+        self.role = Role.FOLLOWER
+        self.leader_id: int | None = None
+        self.log = RaftLog()
+        self.commit = 0
+        self.applied = 0
+
+        self.election_tick = election_tick
+        self.heartbeat_tick = heartbeat_tick
+        self.rng = rng or random.Random(node_id)
+        self._elapsed = 0
+        self._randomized_timeout = self._rand_timeout()
+
+        # leader state
+        self.next_index: dict[int, int] = {}
+        self.match_index: dict[int, int] = {}
+        # peers that must be seeded by snapshot (fresh conf-change additions)
+        self.force_snapshot: set[int] = set()
+        self._votes: dict[int, bool] = {}
+        # pending read-index requests: ctx -> (index, acks)
+        self._pending_reads: dict[bytes, tuple[int, set[int]]] = {}
+
+        self._ready = Ready()
+
+    # ------------------------------------------------------------------ util
+
+    def _rand_timeout(self) -> int:
+        return self.election_tick + self.rng.randrange(self.election_tick)
+
+    def _quorum(self) -> int:
+        return len(self.voters) // 2 + 1
+
+    def is_leader(self) -> bool:
+        return self.role == Role.LEADER
+
+    def _send(self, msg: Message) -> None:
+        self._ready.messages.append(msg)
+
+    def _become_follower(self, term: int, leader: int | None) -> None:
+        if term > self.term:
+            self.term = term
+            self.vote = None
+            self._ready.hard_state_changed = True
+        self.role = Role.FOLLOWER
+        self.leader_id = leader
+        self._elapsed = 0
+        self._randomized_timeout = self._rand_timeout()
+
+    def _become_candidate(self) -> None:
+        self.term += 1
+        self.role = Role.CANDIDATE
+        self.vote = self.id
+        self.leader_id = None
+        self._votes = {self.id: True}
+        self._elapsed = 0
+        self._randomized_timeout = self._rand_timeout()
+        self._ready.hard_state_changed = True
+        if self._quorum() == 1:
+            self._become_leader()
+            return
+        for peer in self.voters - {self.id}:
+            self._send(
+                Message(
+                    MsgType.VOTE, self.id, peer, self.term,
+                    log_index=self.log.last_index(),
+                    log_term=self.log.term_at(self.log.last_index()) or 0,
+                )
+            )
+
+    def _become_leader(self) -> None:
+        self.role = Role.LEADER
+        self.leader_id = self.id
+        last = self.log.last_index()
+        self.next_index = {p: last + 1 for p in self.voters}
+        self.match_index = {p: 0 for p in self.voters}
+        self.match_index[self.id] = last
+        # noop entry to commit entries from previous terms (§5.4.2)
+        self._append_entries([Entry(self.term, last + 1)])
+        self._broadcast_append()
+
+    # ---------------------------------------------------------------- public
+
+    def tick(self) -> None:
+        self._elapsed += 1
+        if self.role == Role.LEADER:
+            if self._elapsed >= self.heartbeat_tick:
+                self._elapsed = 0
+                self._broadcast_heartbeat()
+        elif self._elapsed >= self._randomized_timeout:
+            self._become_candidate()
+
+    def campaign(self) -> None:
+        self._become_candidate()
+
+    def propose(self, data: bytes) -> int | None:
+        """Leader appends a proposal; returns its index (None if not leader)."""
+        if self.role != Role.LEADER:
+            return None
+        index = self.log.last_index() + 1
+        self._append_entries([Entry(self.term, index, data)])
+        self._broadcast_append()
+        return index
+
+    def propose_conf_change(self, change: tuple[str, int]) -> int | None:
+        if self.role != Role.LEADER:
+            return None
+        index = self.log.last_index() + 1
+        self._append_entries([Entry(self.term, index, b"", conf_change=change)])
+        self._broadcast_append()
+        return index
+
+    def read_index(self, ctx: bytes) -> None:
+        """Linearizable read point (read_queue.rs): leader confirms leadership
+        via a heartbeat round, then releases the read at commit index."""
+        if self.role != Role.LEADER:
+            if self.leader_id is not None:
+                self._send(Message(MsgType.READ_INDEX, self.id, self.leader_id, self.term, context=ctx))
+            return
+        if self._quorum() == 1:
+            self._ready.read_states.append((ctx, self.commit))
+            return
+        self._pending_reads[ctx] = (self.commit, {self.id})
+        self._broadcast_heartbeat(ctx=ctx)
+
+    def apply_conf_change(self, change: tuple[str, int]) -> None:
+        """Called by the container when a conf-change entry is applied."""
+        op, peer = change
+        if op == "add":
+            self.voters.add(peer)
+            if self.role == Role.LEADER and peer not in self.next_index:
+                self.next_index[peer] = self.log.last_index() + 1
+                self.match_index[peer] = 0
+        elif op == "remove":
+            self.voters.discard(peer)
+            self.next_index.pop(peer, None)
+            self.match_index.pop(peer, None)
+            if self.role == Role.LEADER:
+                self._maybe_commit()
+
+    def ready(self) -> Ready:
+        rd = self._ready
+        if self.commit > self.applied:
+            lo = self.applied + 1
+            for idx in range(lo, self.commit + 1):
+                e = self.log.entry(idx)
+                if e is not None:
+                    rd.committed_entries.append(e)
+            self.applied = self.commit
+        self._ready = Ready()
+        return rd
+
+    # -------------------------------------------------------------- messages
+
+    def step(self, m: Message) -> None:
+        if m.term > self.term:
+            leader = m.frm if m.type in (MsgType.APPEND, MsgType.HEARTBEAT, MsgType.SNAPSHOT) else None
+            self._become_follower(m.term, leader)
+        if m.term < self.term:
+            # stale sender: tell it the current term
+            if m.type in (MsgType.APPEND, MsgType.HEARTBEAT, MsgType.VOTE):
+                resp_type = {
+                    MsgType.APPEND: MsgType.APPEND_RESP,
+                    MsgType.HEARTBEAT: MsgType.HEARTBEAT_RESP,
+                    MsgType.VOTE: MsgType.VOTE_RESP,
+                }[m.type]
+                self._send(Message(resp_type, self.id, m.frm, self.term, reject=True))
+            return
+
+        handler = {
+            MsgType.VOTE: self._on_vote,
+            MsgType.VOTE_RESP: self._on_vote_resp,
+            MsgType.APPEND: self._on_append,
+            MsgType.APPEND_RESP: self._on_append_resp,
+            MsgType.HEARTBEAT: self._on_heartbeat,
+            MsgType.HEARTBEAT_RESP: self._on_heartbeat_resp,
+            MsgType.SNAPSHOT: self._on_snapshot,
+            MsgType.READ_INDEX: self._on_read_index,
+            MsgType.READ_INDEX_RESP: self._on_read_index_resp,
+        }[m.type]
+        handler(m)
+
+    # voting ----------------------------------------------------------------
+
+    def _on_vote(self, m: Message) -> None:
+        last_index = self.log.last_index()
+        last_term = self.log.term_at(last_index) or 0
+        up_to_date = (m.log_term, m.log_index) >= (last_term, last_index)
+        can_vote = self.vote in (None, m.frm) and self.leader_id is None
+        if up_to_date and can_vote:
+            self.vote = m.frm
+            self._elapsed = 0
+            self._ready.hard_state_changed = True
+            self._send(Message(MsgType.VOTE_RESP, self.id, m.frm, self.term))
+        else:
+            self._send(Message(MsgType.VOTE_RESP, self.id, m.frm, self.term, reject=True))
+
+    def _on_vote_resp(self, m: Message) -> None:
+        if self.role != Role.CANDIDATE:
+            return
+        self._votes[m.frm] = not m.reject
+        granted = sum(1 for p, ok in self._votes.items() if ok and p in self.voters)
+        if granted >= self._quorum():
+            self._become_leader()
+        elif sum(1 for ok in self._votes.values() if not ok) >= self._quorum():
+            self._become_follower(self.term, None)
+
+    # replication -----------------------------------------------------------
+
+    def _append_entries(self, entries: list[Entry]) -> None:
+        self.log.append(entries)
+        self._ready.entries.extend(entries)
+        self.match_index[self.id] = self.log.last_index()
+        self._maybe_commit()
+
+    def _broadcast_append(self) -> None:
+        for peer in self.voters - {self.id}:
+            self._send_append(peer)
+
+    def _send_append(self, peer: int) -> None:
+        next_idx = self.next_index.get(peer, self.log.last_index() + 1)
+        prev = next_idx - 1
+        prev_term = self.log.term_at(prev)
+        if peer in self.force_snapshot or prev_term is None:
+            # log truncated below next_idx — ship a snapshot (container fills data)
+            self._ready.messages.append(
+                Message(MsgType.SNAPSHOT, self.id, peer, self.term)
+            )
+            return
+        entries = self.log.slice_from(next_idx)
+        self._send(
+            Message(
+                MsgType.APPEND, self.id, peer, self.term,
+                log_index=prev, log_term=prev_term,
+                entries=list(entries), commit=self.commit,
+            )
+        )
+
+    def _on_append(self, m: Message) -> None:
+        self._become_follower(m.term, m.frm)
+        prev_term = self.log.term_at(m.log_index)
+        if prev_term is None or prev_term != m.log_term:
+            hint = min(m.log_index, self.log.last_index())
+            self._send(
+                Message(
+                    MsgType.APPEND_RESP, self.id, m.frm, self.term,
+                    reject=True, reject_hint=hint,
+                )
+            )
+            return
+        # find conflict point, truncate, append the rest
+        new_entries = []
+        for e in m.entries:
+            t = self.log.term_at(e.index)
+            if t is None:
+                new_entries.append(e)
+            elif t != e.term:
+                self.log.truncate_from(e.index)
+                new_entries.append(e)
+        if new_entries:
+            self.log.append(new_entries)
+            self._ready.entries.extend(new_entries)
+        last_new = m.log_index + len(m.entries)
+        if m.commit > self.commit:
+            self.commit = min(m.commit, last_new)
+            self._ready.hard_state_changed = True
+        self._send(
+            Message(MsgType.APPEND_RESP, self.id, m.frm, self.term, log_index=last_new)
+        )
+
+    def _on_append_resp(self, m: Message) -> None:
+        if self.role != Role.LEADER:
+            return
+        if m.reject:
+            self.next_index[m.frm] = max(1, min(m.reject_hint + 1, self.next_index.get(m.frm, 2) - 1))
+            self._send_append(m.frm)
+            return
+        self.match_index[m.frm] = max(self.match_index.get(m.frm, 0), m.log_index)
+        self.next_index[m.frm] = self.match_index[m.frm] + 1
+        self.force_snapshot.discard(m.frm)
+        self._maybe_commit()
+        if self.next_index[m.frm] <= self.log.last_index():
+            self._send_append(m.frm)
+
+    def _maybe_commit(self) -> None:
+        if self.role != Role.LEADER:
+            return
+        matches = sorted(
+            (self.match_index.get(p, 0) for p in self.voters), reverse=True
+        )
+        candidate = matches[self._quorum() - 1]
+        # only commit entries of the current term by counting (§5.4.2)
+        if candidate > self.commit and self.log.term_at(candidate) == self.term:
+            self.commit = candidate
+            self._ready.hard_state_changed = True
+            self._broadcast_append_commit()
+
+    def _broadcast_append_commit(self) -> None:
+        for peer in self.voters - {self.id}:
+            if self.next_index.get(peer, 1) > self.log.last_index():
+                # nothing to replicate; push the commit index via heartbeat
+                self._send(
+                    Message(MsgType.HEARTBEAT, self.id, peer, self.term, commit=min(self.commit, self.match_index.get(peer, 0)))
+                )
+            else:
+                self._send_append(peer)
+
+    # heartbeats ------------------------------------------------------------
+
+    def _broadcast_heartbeat(self, ctx: bytes = b"") -> None:
+        for peer in self.voters - {self.id}:
+            self._send(
+                Message(
+                    MsgType.HEARTBEAT, self.id, peer, self.term,
+                    commit=min(self.commit, self.match_index.get(peer, 0)),
+                    context=ctx,
+                )
+            )
+
+    def _on_heartbeat(self, m: Message) -> None:
+        self._become_follower(m.term, m.frm)
+        if m.commit > self.commit:
+            self.commit = min(m.commit, self.log.last_index())
+            self._ready.hard_state_changed = True
+        self._send(
+            Message(MsgType.HEARTBEAT_RESP, self.id, m.frm, self.term, context=m.context)
+        )
+
+    def _on_heartbeat_resp(self, m: Message) -> None:
+        if self.role != Role.LEADER:
+            return
+        if m.context and m.context in self._pending_reads:
+            index, acks = self._pending_reads[m.context]
+            acks.add(m.frm)
+            if len(acks) >= self._quorum():
+                del self._pending_reads[m.context]
+                origin = getattr(self, "_read_origins", {}).pop(m.context, None)
+                if origin is None:
+                    self._ready.read_states.append((m.context, index))
+                else:
+                    self._send(
+                        Message(
+                            MsgType.READ_INDEX_RESP, self.id, origin, self.term,
+                            log_index=index, context=m.context,
+                        )
+                    )
+        if self.match_index.get(m.frm, 0) < self.log.last_index():
+            self._send_append(m.frm)
+
+    # snapshots -------------------------------------------------------------
+
+    def _on_snapshot(self, m: Message) -> None:
+        snap = m.snapshot
+        if snap is None:
+            return
+        self._become_follower(m.term, m.frm)
+        if snap.index <= self.commit:
+            self._send(Message(MsgType.APPEND_RESP, self.id, m.frm, self.term, log_index=self.commit))
+            return
+        self.log.reset_to_snapshot(snap)
+        self.commit = snap.index
+        self.applied = snap.index
+        self.voters = set(snap.voters)
+        self._ready.snapshot = snap
+        self._ready.hard_state_changed = True
+        self._send(Message(MsgType.APPEND_RESP, self.id, m.frm, self.term, log_index=snap.index))
+
+    # read index ------------------------------------------------------------
+
+    def _on_read_index(self, m: Message) -> None:
+        if self.role != Role.LEADER:
+            return
+        if self._quorum() == 1:
+            self._send(Message(MsgType.READ_INDEX_RESP, self.id, m.frm, self.term, log_index=self.commit, context=m.context))
+            return
+        # piggyback on a heartbeat round keyed by the follower's ctx; remember
+        # the origin so the response routes back when quorum acks arrive
+        self._pending_reads[m.context] = (self.commit, {self.id})
+        self._read_origins = getattr(self, "_read_origins", {})
+        self._read_origins[m.context] = m.frm
+        self._broadcast_heartbeat(ctx=m.context)
+
+    def _on_read_index_resp(self, m: Message) -> None:
+        self._ready.read_states.append((m.context, m.log_index))

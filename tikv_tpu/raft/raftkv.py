@@ -1,0 +1,151 @@
+"""RaftKv: the Engine implementation that routes through raft consensus.
+
+Re-expression of ``src/server/raftkv.rs`` (:214 exec_snapshot, :244
+exec_write_requests, :378/:435): writes become proposed commands applied by
+quorum; snapshots are linearizable views obtained after a ReadIndex barrier
+(leader lease local reads are the fast path in the reference; ReadIndex keeps
+the same correctness with less machinery).
+
+``RegionSnapshot`` exposes the store engine under the region's range with the
+``z`` data prefix applied transparently, so the whole txn/coprocessor stack
+works unchanged over raft-replicated data (store/region_snapshot.rs).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from ..storage.engine import Cursor, Snapshot, WriteBatch
+from ..storage.kv import Engine
+from ..util import keys
+from .region import NotLeaderError, Region
+from .store import Store
+
+
+class _PrefixCursor(Cursor):
+    """Cursor over data keys with the z-prefix stripped (region-bounded)."""
+
+    def __init__(self, inner: Cursor):
+        self._c = inner
+
+    def seek(self, key: bytes) -> bool:
+        return self._c.seek(keys.data_key(key))
+
+    def seek_for_prev(self, key: bytes) -> bool:
+        return self._c.seek_for_prev(keys.data_key(key))
+
+    def seek_to_first(self) -> bool:
+        return self._c.seek_to_first()
+
+    def seek_to_last(self) -> bool:
+        return self._c.seek_to_last()
+
+    def next(self) -> bool:
+        return self._c.next()
+
+    def prev(self) -> bool:
+        return self._c.prev()
+
+    def valid(self) -> bool:
+        return self._c.valid()
+
+    def key(self) -> bytes:
+        return keys.origin_key(self._c.key())
+
+    def value(self) -> bytes:
+        return self._c.value()
+
+
+class RegionSnapshot(Snapshot):
+    def __init__(self, engine_snapshot: Snapshot, region: Region):
+        self._snap = engine_snapshot
+        self.region = region
+        self._lower = keys.data_key(region.start_key)
+        self._upper = keys.data_end_key(region.end_key)
+
+    def get_cf(self, cf: str, key: bytes) -> bytes | None:
+        dkey = keys.data_key(key)
+        if not (self._lower <= dkey < self._upper):
+            return None
+        return self._snap.get_cf(cf, dkey)
+
+    def cursor_cf(self, cf: str, lower: bytes | None = None, upper: bytes | None = None) -> Cursor:
+        lo = keys.data_key(lower) if lower is not None else self._lower
+        hi = keys.data_key(upper) if upper is not None else self._upper
+        lo = max(lo, self._lower)
+        hi = min(hi, self._upper)
+        return _PrefixCursor(self._snap.cursor_cf(cf, lo, hi))
+
+
+class RaftKv(Engine):
+    """Engine over one store's raft peers.  ``pump`` drives the cluster's
+    message loop until a callback fires (test clusters pump synchronously;
+    the server wires a background poller)."""
+
+    def __init__(self, store: Store, pump: Callable[[], None] | None = None):
+        self.store = store
+        # default: yield to the node's background raft loop
+        self.pump = pump or (lambda: time.sleep(0.0005))
+
+    def _peer_for_ctx(self, ctx: dict | None):
+        ctx = ctx or {}
+        region_id = ctx.get("region_id")
+        if region_id is not None:
+            peer = self.store.peers.get(region_id)
+            if peer is None:
+                raise NotLeaderError(region_id, None)
+            return peer
+        key = ctx.get("key", b"")
+        peer = self.store.region_for_key(key)
+        if peer is None:
+            raise NotLeaderError(-1, None)
+        return peer
+
+    def snapshot(self, ctx: dict | None = None) -> RegionSnapshot:
+        peer = self._peer_for_ctx(ctx)
+        if not peer.node.is_leader():
+            raise NotLeaderError(peer.region.id, self.store.leader_store_of(peer.region.id))
+        done = threading.Event()
+        err: list = []
+
+        def cb(e):
+            if e is not None:
+                err.append(e)
+            done.set()
+
+        peer.read_index(cb)
+        self._pump_until(done, peer.region.id)
+        if err:
+            raise err[0]
+        return RegionSnapshot(self.store.engine.snapshot(), peer.region.clone())
+
+    def write(self, ctx: dict | None, batch: WriteBatch) -> None:
+        peer = self._peer_for_ctx(ctx)
+        ops = []
+        for op, cf, key, val in batch.ops:
+            ops.append((op, cf, key, val))
+        cmd = {
+            "epoch": (peer.region.epoch.conf_ver, peer.region.epoch.version),
+            "ops": ops,
+        }
+        done = threading.Event()
+        result: list = []
+
+        def cb(r):
+            result.append(r)
+            done.set()
+
+        peer.propose_cmd(cmd, cb)
+        self._pump_until(done, peer.region.id)
+        r = result[0]
+        if isinstance(r, Exception):
+            raise r
+
+    def _pump_until(self, done, region_id: int, max_rounds: int = 5000) -> None:
+        for _ in range(max_rounds):
+            if done.is_set():
+                return
+            self.pump()
+        raise TimeoutError(f"raft command on region {region_id} did not complete (no quorum?)")

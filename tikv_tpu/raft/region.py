@@ -1,0 +1,79 @@
+"""Region metadata: key ranges, epochs, peer placement.
+
+Re-expression of the kvproto ``metapb.Region`` used throughout raftstore:
+a region owns the half-open raw-key range [start_key, end_key) (empty end =
++inf), carries an epoch (conf_ver bumps on membership change, version bumps
+on split/merge), and lists its peers (peer_id → store_id).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+
+@dataclass
+class Peer:
+    peer_id: int
+    store_id: int
+
+
+@dataclass
+class RegionEpoch:
+    conf_ver: int = 1
+    version: int = 1
+
+
+@dataclass
+class Region:
+    id: int
+    start_key: bytes = b""
+    end_key: bytes = b""  # b"" = +inf
+    epoch: RegionEpoch = field(default_factory=RegionEpoch)
+    peers: list[Peer] = field(default_factory=list)
+
+    def contains(self, key: bytes) -> bool:
+        if key < self.start_key:
+            return False
+        return not self.end_key or key < self.end_key
+
+    def peer_on_store(self, store_id: int) -> Peer | None:
+        for p in self.peers:
+            if p.store_id == store_id:
+                return p
+        return None
+
+    def peer_by_id(self, peer_id: int) -> Peer | None:
+        for p in self.peers:
+            if p.peer_id == peer_id:
+                return p
+        return None
+
+    def voter_ids(self) -> list[int]:
+        return [p.peer_id for p in self.peers]
+
+    def clone(self) -> "Region":
+        return Region(
+            self.id,
+            self.start_key,
+            self.end_key,
+            RegionEpoch(self.epoch.conf_ver, self.epoch.version),
+            [Peer(p.peer_id, p.store_id) for p in self.peers],
+        )
+
+
+class EpochError(Exception):
+    def __init__(self, current: Region):
+        self.current = current
+        super().__init__(f"stale region epoch; current {current.epoch}")
+
+
+class NotLeaderError(Exception):
+    def __init__(self, region_id: int, leader_store: int | None):
+        self.region_id = region_id
+        self.leader_store = leader_store
+        super().__init__(f"not leader of region {region_id}; try store {leader_store}")
+
+
+class KeyNotInRegionError(Exception):
+    def __init__(self, key: bytes, region: Region):
+        super().__init__(f"key {key!r} not in region {region.id} [{region.start_key!r}, {region.end_key!r})")

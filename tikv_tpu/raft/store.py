@@ -1,0 +1,591 @@
+"""Multi-Raft store: one raft group per region, multiplexed on a node.
+
+Re-expression of ``components/raftstore`` (store/fsm/{store,peer}.rs +
+store/fsm/apply.rs + batch-system): a ``Store`` owns every region peer placed
+on one node; peers propose serialized commands through their raft group and
+apply committed entries to the shared engine; the store routes messages,
+drives ticks, and executes admin commands (split, conf change).
+
+Data layout on the shared engine matches keys.py: user data under the ``z``
+prefix, raft log + states under store-local keys — so one engine hosts many
+regions, exactly like the reference's single RocksDB with a raft CF.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..storage.btree_engine import BTreeEngine
+from ..storage.engine import CF_DEFAULT, CF_LOCK, CF_RAFT, CF_WRITE, WriteBatch
+from ..util import codec, keys
+from .core import Entry, Message, MsgType, RaftNode, Role
+from .core import Snapshot as RaftSnapshot
+from .region import EpochError, KeyNotInRegionError, NotLeaderError, Peer as RegionPeer, Region, RegionEpoch
+
+DATA_CFS = (CF_DEFAULT, CF_LOCK, CF_WRITE)
+
+
+# ---------------------------------------------------------------------------
+# Command codec (RaftCmdRequest equivalent, deterministic bytes)
+# ---------------------------------------------------------------------------
+
+def encode_cmd(cmd: dict) -> bytes:
+    """Commands: {"epoch": (cv, v), "ops": [(op, cf, key, val)]} or
+    {"epoch":…, "admin": ("split", split_key, new_region_id, [new_peer_ids])
+                        | ("conf_change", op, peer_id, store_id)}."""
+    out = bytearray()
+    cv, v = cmd["epoch"]
+    out += codec.encode_var_u64(cv)
+    out += codec.encode_var_u64(v)
+    admin = cmd.get("admin")
+    if admin is None:
+        out.append(0)
+        ops = cmd["ops"]
+        out += codec.encode_var_u64(len(ops))
+        for op, cf, key, val in ops:
+            out.append({"put": 1, "delete": 2, "delete_range": 3}[op])
+            out += codec.encode_compact_bytes(cf.encode())
+            out += codec.encode_compact_bytes(key)
+            out += codec.encode_compact_bytes(val if val is not None else b"")
+    elif admin[0] == "split":
+        out.append(1)
+        out += codec.encode_compact_bytes(admin[1])
+        out += codec.encode_var_u64(admin[2])
+        out += codec.encode_var_u64(len(admin[3]))
+        for pid in admin[3]:
+            out += codec.encode_var_u64(pid)
+    elif admin[0] == "conf_change":
+        out.append(2)
+        out += codec.encode_compact_bytes(admin[1].encode())
+        out += codec.encode_var_u64(admin[2])
+        out += codec.encode_var_u64(admin[3])
+    else:
+        raise ValueError(admin)
+    return bytes(out)
+
+
+def decode_cmd(b: bytes) -> dict:
+    cv, off = codec.decode_var_u64(b, 0)
+    v, off = codec.decode_var_u64(b, off)
+    kind = b[off]
+    off += 1
+    cmd: dict = {"epoch": (cv, v)}
+    if kind == 0:
+        n, off = codec.decode_var_u64(b, off)
+        ops = []
+        for _ in range(n):
+            op = {1: "put", 2: "delete", 3: "delete_range"}[b[off]]
+            off += 1
+            cf, off = codec.decode_compact_bytes(b, off)
+            key, off = codec.decode_compact_bytes(b, off)
+            val, off = codec.decode_compact_bytes(b, off)
+            ops.append((op, cf.decode(), key, val))
+        cmd["ops"] = ops
+    elif kind == 1:
+        split_key, off = codec.decode_compact_bytes(b, off)
+        new_id, off = codec.decode_var_u64(b, off)
+        n, off = codec.decode_var_u64(b, off)
+        pids = []
+        for _ in range(n):
+            pid, off = codec.decode_var_u64(b, off)
+            pids.append(pid)
+        cmd["admin"] = ("split", split_key, new_id, pids)
+    elif kind == 2:
+        op, off = codec.decode_compact_bytes(b, off)
+        pid, off = codec.decode_var_u64(b, off)
+        sid, off = codec.decode_var_u64(b, off)
+        cmd["admin"] = ("conf_change", op.decode(), pid, sid)
+    return cmd
+
+
+# ---------------------------------------------------------------------------
+# Transport
+# ---------------------------------------------------------------------------
+
+@dataclass
+class RaftMessage:
+    """Envelope for peer-to-peer raft traffic (kvproto RaftMessage)."""
+
+    region_id: int
+    from_peer: RegionPeer
+    to_peer: RegionPeer
+    msg: Message
+    region_epoch: RegionEpoch = field(default_factory=RegionEpoch)
+    # region carried on snapshot/first-contact messages so the receiver can
+    # bootstrap the peer (raftstore maybe_create_peer)
+    region: Region | None = None
+
+
+class Transport:
+    def send(self, to_store: int, rmsg: RaftMessage) -> None:
+        raise NotImplementedError
+
+
+class Filter:
+    """Message filter for fault injection (transport_simulate.rs:34)."""
+
+    def before(self, rmsg: RaftMessage) -> bool:
+        """False = drop."""
+        return True
+
+
+class DropPacketFilter(Filter):
+    def __init__(self, region_id: int | None = None, rate: float = 1.0, rng=None):
+        import random
+
+        self.region_id = region_id
+        self.rate = rate
+        self.rng = rng or random.Random(0)
+
+    def before(self, rmsg: RaftMessage) -> bool:
+        if self.region_id is not None and rmsg.region_id != self.region_id:
+            return True
+        return self.rng.random() >= self.rate
+
+
+class PartitionFilter(Filter):
+    def __init__(self, stores_a: set[int], stores_b: set[int]):
+        self.a = stores_a
+        self.b = stores_b
+
+    def before(self, rmsg: RaftMessage) -> bool:
+        fa, ta = rmsg.from_peer.store_id, rmsg.to_peer.store_id
+        return not ((fa in self.a and ta in self.b) or (fa in self.b and ta in self.a))
+
+
+class RegionPacketFilter(Filter):
+    def __init__(self, region_id: int, store_id: int | None = None, msg_types: set | None = None):
+        self.region_id = region_id
+        self.store_id = store_id
+        self.msg_types = msg_types
+
+    def before(self, rmsg: RaftMessage) -> bool:
+        if rmsg.region_id != self.region_id:
+            return True
+        if self.store_id is not None and rmsg.to_peer.store_id != self.store_id:
+            return True
+        if self.msg_types is not None and rmsg.msg.type not in self.msg_types:
+            return True
+        return False
+
+
+class ChannelTransport(Transport):
+    """In-memory transport wiring stores directly (test_raftstore NodeCluster)."""
+
+    def __init__(self):
+        self.stores: dict[int, "Store"] = {}
+        self.filters: list[Filter] = []
+        self._mu = threading.Lock()
+
+    def register(self, store: "Store") -> None:
+        self.stores[store.store_id] = store
+
+    def send(self, to_store: int, rmsg: RaftMessage) -> None:
+        for f in self.filters:
+            if not f.before(rmsg):
+                return
+        store = self.stores.get(to_store)
+        if store is not None:
+            store.enqueue_message(rmsg)
+
+
+# ---------------------------------------------------------------------------
+# Peer (region replica)
+# ---------------------------------------------------------------------------
+
+class Proposal:
+    def __init__(self, index: int, term: int, cb: Callable):
+        self.index = index
+        self.term = term
+        self.cb = cb
+
+
+class StorePeer:
+    """One region replica on this store (PeerFsm + ApplyDelegate merged)."""
+
+    def __init__(self, store: "Store", region: Region, peer_id: int):
+        self.store = store
+        self.region = region
+        self.peer_id = peer_id
+        self.node = RaftNode(peer_id, region.voter_ids())
+        self.proposals: list[Proposal] = []
+        self.pending_reads: dict[bytes, Callable] = {}
+        self._read_seq = 0
+
+    # -- raft driving ------------------------------------------------------
+
+    def propose_cmd(self, cmd: dict, cb: Callable) -> None:
+        if not self.node.is_leader():
+            cb(NotLeaderError(self.region.id, self.store.leader_store_of(self.region.id)))
+            return
+        if not self._epoch_ok(cmd):
+            cb(EpochError(self.region.clone()))
+            return
+        admin = cmd.get("admin")
+        if admin is not None and admin[0] == "conf_change":
+            index = self.node.propose_conf_change((admin[1], admin[2]))
+            if index is None:
+                cb(NotLeaderError(self.region.id, None))
+                return
+            # remember placement for when the entry applies
+            self.store.pending_conf_stores[(self.region.id, admin[2])] = admin[3]
+            self.proposals.append(Proposal(index, self.node.term, cb))
+            return
+        index = self.node.propose(encode_cmd(cmd))
+        if index is None:
+            cb(NotLeaderError(self.region.id, None))
+            return
+        self.proposals.append(Proposal(index, self.node.term, cb))
+
+    def _epoch_ok(self, cmd: dict) -> bool:
+        """Data commands only care about the range (version); admin commands
+        also require membership (conf_ver) to be current — the reference's
+        util::check_region_epoch rules."""
+        cv, v = cmd["epoch"]
+        if cmd.get("admin") is not None:
+            return (cv, v) == (self.region.epoch.conf_ver, self.region.epoch.version)
+        return v == self.region.epoch.version
+
+    def read_index(self, cb: Callable) -> None:
+        """Linearizable read barrier; cb() fires once safe to read locally."""
+        self._read_seq += 1
+        ctx = codec.encode_u64(self.region.id) + codec.encode_u64(self._read_seq)
+        self.pending_reads[ctx] = cb
+        self.node.read_index(ctx)
+
+    def handle_ready(self) -> bool:
+        rd = self.node.ready()
+        if rd.is_empty():
+            return False
+        eng = self.store.engine
+        # persist raft log + hard state (PeerStorage)
+        if rd.entries or rd.hard_state_changed:
+            wb = WriteBatch()
+            for e in rd.entries:
+                wb.put_cf(CF_RAFT, keys.raft_log_key(self.region.id, e.index), _encode_entry(e))
+            wb.put_cf(
+                CF_RAFT,
+                keys.raft_state_key(self.region.id),
+                codec.encode_u64(self.node.term)
+                + codec.encode_u64(self.node.vote or 0)
+                + codec.encode_u64(self.node.commit),
+            )
+            eng.write(wb)
+        if rd.snapshot is not None:
+            self._apply_snapshot(rd.snapshot)
+        for e in rd.committed_entries:
+            self._apply_entry(e)
+        for ctx, index in rd.read_states:
+            cb = self.pending_reads.pop(ctx, None)
+            if cb is not None:
+                # safe once applied >= read index (we apply synchronously)
+                cb(None)
+        for m in rd.messages:
+            self._send_raft_msg(m)
+        return True
+
+    def _send_raft_msg(self, m: Message) -> None:
+        to_peer = self.region.peer_by_id(m.to)
+        if to_peer is None:
+            # conf-change in flight: look up the planned placement
+            sid = self.store.pending_conf_stores.get((self.region.id, m.to))
+            if sid is None:
+                return
+            to_peer = RegionPeer(m.to, sid)
+        if m.type == MsgType.SNAPSHOT and m.snapshot is None:
+            m.snapshot = self._generate_snapshot()
+        rmsg = RaftMessage(
+            region_id=self.region.id,
+            from_peer=RegionPeer(self.peer_id, self.store.store_id),
+            to_peer=to_peer,
+            msg=m,
+            region_epoch=RegionEpoch(self.region.epoch.conf_ver, self.region.epoch.version),
+            region=self.region.clone(),
+        )
+        self.store.transport.send(to_peer.store_id, rmsg)
+
+    # -- apply -------------------------------------------------------------
+
+    def _apply_entry(self, e: Entry) -> None:
+        if e.conf_change is not None:
+            self._apply_conf_change(e)
+            self._ack(e, None, None)
+            return
+        if not e.data:
+            return  # leader noop
+        cmd = decode_cmd(e.data)
+        if not self._epoch_ok(cmd):
+            self._ack(e, None, EpochError(self.region.clone()))
+            return
+        admin = cmd.get("admin")
+        if admin is not None and admin[0] == "split":
+            self._apply_split(admin)
+            self._ack(e, {"split": True}, None)
+            return
+        wb = WriteBatch()
+        for op, cf, key, val in cmd["ops"]:
+            dkey = keys.data_key(key)
+            if op == "put":
+                wb.put_cf(cf, dkey, val)
+            elif op == "delete":
+                wb.delete_cf(cf, dkey)
+            elif op == "delete_range":
+                wb.delete_range_cf(cf, dkey, keys.data_key(val))
+        self.store.engine.write(wb)
+        self.store.on_applied(self.region, cmd)
+        self._ack(e, {"applied_index": e.index}, None)
+
+    def _ack(self, e: Entry, result, err) -> None:
+        rest = []
+        for p in self.proposals:
+            if p.index == e.index:
+                if p.term == e.term:
+                    p.cb(err if err is not None else result)
+                else:
+                    p.cb(NotLeaderError(self.region.id, None))  # overwritten entry
+            elif p.index < e.index:
+                p.cb(NotLeaderError(self.region.id, None))
+            else:
+                rest.append(p)
+        self.proposals = rest
+
+    def _apply_conf_change(self, e: Entry) -> None:
+        op, pid = e.conf_change
+        if (
+            op == "remove"
+            and pid != self.peer_id
+            and self.node.is_leader()
+            and self.region.peer_by_id(pid) is not None
+        ):
+            # final notification: the removed peer leaves the voter set now,
+            # so push the commit index covering its own removal first (the
+            # reference relies on PD stale-peer GC as the backstop)
+            self._send_raft_msg(
+                Message(
+                    MsgType.HEARTBEAT, self.peer_id, pid, self.node.term,
+                    commit=min(e.index, self.node.match_index.get(pid, 0)),
+                )
+            )
+        self.node.apply_conf_change(e.conf_change)
+        if op == "add":
+            sid = self.store.pending_conf_stores.get((self.region.id, pid), 0)
+            if self.region.peer_by_id(pid) is None:
+                self.region.peers.append(RegionPeer(pid, sid))
+            if self.node.is_leader() and pid != self.peer_id:
+                # new peers are seeded by snapshot, never by full log replay
+                # (peer_storage.rs: uninitialized peers wait for a snapshot)
+                self.node.force_snapshot.add(pid)
+        else:
+            self.region.peers = [p for p in self.region.peers if p.peer_id != pid]
+            if pid == self.peer_id:
+                self.store.destroy_peer(self.region.id)
+        self.region.epoch.conf_ver += 1
+        self.store.persist_region(self.region)
+
+    def _apply_split(self, admin) -> None:
+        _, split_key, new_region_id, new_pids = admin
+        old = self.region
+        new_peers = [
+            RegionPeer(pid, p.store_id) for pid, p in zip(new_pids, old.peers)
+        ]
+        new_region = Region(
+            id=new_region_id,
+            start_key=split_key,
+            end_key=old.end_key,
+            epoch=RegionEpoch(old.epoch.conf_ver, old.epoch.version + 1),
+            peers=new_peers,
+        )
+        old.end_key = split_key
+        old.epoch.version += 1
+        self.store.persist_region(old)
+        self.store.create_peer(new_region)
+        self.store.on_split(old, new_region)
+
+    # -- snapshots ---------------------------------------------------------
+
+    def _generate_snapshot(self) -> RaftSnapshot:
+        """Full region-range snapshot of the data CFs + region meta
+        (store/snap.rs; meta rides along like SnapshotMeta)."""
+        eng = self.store.engine
+        out = bytearray()
+        out += codec.encode_compact_bytes(encode_region(self.region))
+        start = keys.data_key(self.region.start_key)
+        end = keys.data_end_key(self.region.end_key)
+        for cf in DATA_CFS:
+            items = list(eng.scan_cf(cf, start, end))
+            out += codec.encode_compact_bytes(cf.encode())
+            out += codec.encode_var_u64(len(items))
+            for k, v in items:
+                out += codec.encode_compact_bytes(k)
+                out += codec.encode_compact_bytes(v)
+        return RaftSnapshot(
+            index=self.node.applied,
+            term=self.node.log.term_at(self.node.applied) or self.node.term,
+            data=bytes(out),
+            voters=tuple(self.node.voters),
+        )
+
+    def _apply_snapshot(self, snap: RaftSnapshot) -> None:
+        eng = self.store.engine
+        b = snap.data
+        meta, off = codec.decode_compact_bytes(b, 0)
+        self.region = decode_region(meta)
+        wb = WriteBatch()
+        start = keys.data_key(self.region.start_key)
+        end = keys.data_end_key(self.region.end_key)
+        for cf in DATA_CFS:
+            wb.delete_range_cf(cf, start, end)
+        while off < len(b):
+            cf, off = codec.decode_compact_bytes(b, off)
+            n, off = codec.decode_var_u64(b, off)
+            for _ in range(n):
+                k, off = codec.decode_compact_bytes(b, off)
+                v, off = codec.decode_compact_bytes(b, off)
+                wb.put_cf(cf.decode(), k, v)
+        eng.write(wb)
+        self.store.persist_region(self.region)
+
+
+def encode_region(region: Region) -> bytes:
+    out = bytearray()
+    out += codec.encode_var_u64(region.id)
+    out += codec.encode_compact_bytes(region.start_key)
+    out += codec.encode_compact_bytes(region.end_key)
+    out += codec.encode_var_u64(region.epoch.conf_ver)
+    out += codec.encode_var_u64(region.epoch.version)
+    out += codec.encode_var_u64(len(region.peers))
+    for p in region.peers:
+        out += codec.encode_var_u64(p.peer_id)
+        out += codec.encode_var_u64(p.store_id)
+    return bytes(out)
+
+
+def decode_region(b: bytes) -> Region:
+    rid, off = codec.decode_var_u64(b, 0)
+    start, off = codec.decode_compact_bytes(b, off)
+    end, off = codec.decode_compact_bytes(b, off)
+    cv, off = codec.decode_var_u64(b, off)
+    v, off = codec.decode_var_u64(b, off)
+    n, off = codec.decode_var_u64(b, off)
+    peers = []
+    for _ in range(n):
+        pid, off = codec.decode_var_u64(b, off)
+        sid, off = codec.decode_var_u64(b, off)
+        peers.append(RegionPeer(pid, sid))
+    return Region(rid, start, end, RegionEpoch(cv, v), peers)
+
+
+def _encode_entry(e: Entry) -> bytes:
+    out = bytearray()
+    out += codec.encode_var_u64(e.term)
+    out += codec.encode_var_u64(e.index)
+    out += codec.encode_compact_bytes(e.data)
+    if e.conf_change:
+        out.append(1)
+        out += codec.encode_compact_bytes(e.conf_change[0].encode())
+        out += codec.encode_var_u64(e.conf_change[1])
+    else:
+        out.append(0)
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# Store
+# ---------------------------------------------------------------------------
+
+class Store:
+    """All region peers on one node + message routing (StoreFsm + router)."""
+
+    def __init__(self, store_id: int, transport: Transport, engine: BTreeEngine | None = None):
+        self.store_id = store_id
+        self.transport = transport
+        self.engine = engine or BTreeEngine()
+        self.peers: dict[int, StorePeer] = {}
+        self.pending_conf_stores: dict[tuple[int, int], int] = {}
+        self._inbox: list[RaftMessage] = []
+        self._mu = threading.RLock()
+        self.split_observers: list[Callable] = []
+        self.apply_observers: list[Callable] = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def create_peer(self, region: Region) -> StorePeer:
+        with self._mu:
+            me = region.peer_on_store(self.store_id)
+            assert me is not None, f"store {self.store_id} not in region {region.id}"
+            peer = StorePeer(self, region.clone(), me.peer_id)
+            self.peers[region.id] = peer
+            self.persist_region(peer.region)
+            return peer
+
+    def destroy_peer(self, region_id: int) -> None:
+        self.peers.pop(region_id, None)
+
+    def persist_region(self, region: Region) -> None:
+        self.engine.put_cf(CF_RAFT, keys.region_state_key(region.id), encode_region(region))
+
+    # -- routing -----------------------------------------------------------
+
+    def region_for_key(self, key: bytes) -> StorePeer | None:
+        with self._mu:
+            for peer in self.peers.values():
+                if peer.region.contains(key):
+                    return peer
+        return None
+
+    def leader_store_of(self, region_id: int) -> int | None:
+        peer = self.peers.get(region_id)
+        if peer is None:
+            return None
+        lid = peer.node.leader_id
+        if lid is None:
+            return None
+        p = peer.region.peer_by_id(lid)
+        return p.store_id if p else None
+
+    def enqueue_message(self, rmsg: RaftMessage) -> None:
+        with self._mu:
+            self._inbox.append(rmsg)
+
+    # -- driving -----------------------------------------------------------
+
+    def process_messages(self) -> bool:
+        with self._mu:
+            inbox, self._inbox = self._inbox, []
+        moved = bool(inbox)
+        for rmsg in inbox:
+            peer = self.peers.get(rmsg.region_id)
+            if peer is None and rmsg.region is not None:
+                # first contact for a new peer (conf change / snapshot):
+                # bootstrap it if we're in the carried region
+                if rmsg.region.peer_on_store(self.store_id) is not None or rmsg.to_peer.store_id == self.store_id:
+                    region = rmsg.region.clone()
+                    if region.peer_on_store(self.store_id) is None:
+                        region.peers.append(RegionPeer(rmsg.to_peer.peer_id, self.store_id))
+                    peer = StorePeer(self, region, rmsg.to_peer.peer_id)
+                    peer.node.voters = set(region.voter_ids())
+                    self.peers[rmsg.region_id] = peer
+            if peer is not None and rmsg.to_peer.peer_id == peer.peer_id:
+                peer.node.step(rmsg.msg)
+        return moved
+
+    def handle_readies(self) -> bool:
+        moved = False
+        for peer in list(self.peers.values()):
+            if peer.handle_ready():
+                moved = True
+        return moved
+
+    def tick(self) -> None:
+        for peer in list(self.peers.values()):
+            peer.node.tick()
+
+    def on_split(self, old: Region, new: Region) -> None:
+        for cb in self.split_observers:
+            cb(self, old, new)
+
+    def on_applied(self, region: Region, cmd: dict) -> None:
+        for cb in self.apply_observers:
+            cb(self, region, cmd)

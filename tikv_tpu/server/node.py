@@ -1,0 +1,130 @@
+"""Node lifecycle: bootstrap a store against PD and keep it beating.
+
+Re-expression of ``src/server/node.rs`` (:61 Node, :153 bootstrap: alloc store
+id from PD, bootstrap the first region) and the raftstore PD worker
+(``store/worker/pd.rs:101``): periodic store heartbeats (capacity/usage) and
+per-region heartbeats from leaders, plus PD-driven region split when a region
+grows past the configured size.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..pd.client import PdClient
+from ..raft.region import Peer as RegionPeer, Region, RegionEpoch
+from ..raft.store import Store, Transport
+from ..util import keys
+
+FIRST_REGION_ID = 1
+
+
+class Node:
+    def __init__(
+        self,
+        pd: PdClient,
+        transport: Transport,
+        store_id: int | None = None,
+        split_threshold_keys: int | None = None,
+    ):
+        self.pd = pd
+        self.store_id = store_id or pd.alloc_id()
+        self.store = Store(self.store_id, transport)
+        self.split_threshold_keys = split_threshold_keys
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        pd.put_store(self.store_id)
+        self.store.split_observers.append(self._on_split)
+
+    # -- bootstrap ----------------------------------------------------------
+
+    def try_bootstrap_cluster(self, all_store_ids: list[int]) -> Region | None:
+        """First node up bootstraps region 1 across the given stores."""
+        if self.pd.get_region_by_id(FIRST_REGION_ID) is not None:
+            return None
+        peers = [RegionPeer(self.pd.alloc_id(), sid) for sid in all_store_ids]
+        region = Region(FIRST_REGION_ID, b"", b"", RegionEpoch(), peers)
+        self.pd.bootstrap_region(region)
+        return region
+
+    def create_region_peers(self) -> None:
+        """Create local peers for every PD region placed on this store."""
+        region = self.pd.get_region_by_id(FIRST_REGION_ID)
+        if region is not None and region.peer_on_store(self.store_id) is not None:
+            if region.id not in self.store.peers:
+                self.store.create_peer(region)
+
+    # -- background loops ---------------------------------------------------
+
+    def start(self, tick_interval: float = 0.05, heartbeat_interval: float = 0.5) -> None:
+        def raft_loop():
+            last_tick = 0.0
+            while not self._stop.is_set():
+                moved = self.store.process_messages()
+                moved |= self.store.handle_readies()
+                now = time.monotonic()
+                if now - last_tick >= tick_interval:
+                    self.store.tick()
+                    last_tick = now
+                if not moved:
+                    time.sleep(0.001)
+
+        def pd_loop():
+            while not self._stop.is_set():
+                self.pd.store_heartbeat(self.store_id, {"regions": len(self.store.peers)})
+                for peer in list(self.store.peers.values()):
+                    if peer.node.is_leader():
+                        self.pd.region_heartbeat(peer.region.clone(), self.store_id)
+                        self._maybe_split(peer)
+                self._stop.wait(heartbeat_interval)
+
+        for fn in (raft_loop, pd_loop):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def pump(self) -> None:
+        """Synchronous message pump for RaftKv when loops aren't running."""
+        self.store.process_messages()
+        self.store.handle_readies()
+
+    # -- split checking (split_check worker + AutoSplitController) ----------
+
+    def _maybe_split(self, peer) -> None:
+        if self.split_threshold_keys is None:
+            return
+        eng = self.store.engine
+        start = keys.data_key(peer.region.start_key)
+        end = keys.data_end_key(peer.region.end_key)
+        ks = [k for k, _ in eng.scan_cf("write", start, end, limit=self.split_threshold_keys + 1)]
+        if len(ks) <= self.split_threshold_keys:
+            return
+        split_at = keys.origin_key(ks[len(ks) // 2])
+        # strip any MVCC ts suffix so the split key is a clean user key
+        from ..storage.txn_types import split_ts
+        from ..storage.txn_types import Key as MvccKey
+
+        try:
+            enc, _ = split_ts(split_at)
+            split_at = MvccKey.from_encoded(enc).to_raw()
+        except Exception:  # noqa: BLE001 — raw key already
+            pass
+        if not peer.region.contains(split_at) or split_at == peer.region.start_key:
+            return
+        new_region_id = self.pd.alloc_id()
+        new_pids = [self.pd.alloc_id() for _ in peer.region.peers]
+        cmd = {
+            "epoch": (peer.region.epoch.conf_ver, peer.region.epoch.version),
+            "ops": [],
+            "admin": ("split", split_at, new_region_id, new_pids),
+        }
+        peer.propose_cmd(cmd, lambda r: None)
+
+    def _on_split(self, store, old: Region, new: Region) -> None:
+        self.pd.report_split(old.clone(), new.clone())

@@ -1,0 +1,151 @@
+"""TCP server: the node's RPC front door.
+
+Re-expression of ``src/server/server.rs`` + the ``batch_commands`` stream
+(service/kv.rs:891): one socket per client, length-prefixed frames, each frame
+``[req_id, method, request]`` (wire codec) answered out of order —
+multiplexed like batch_commands.  A thread-pool executes handlers so slow
+commands don't block the socket reader.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+from . import wire
+from .service import KvService
+
+_LEN = struct.Struct(">I")
+_MAX_FRAME = 64 << 20
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def read_frame(sock: socket.socket) -> bytes | None:
+    hdr = _read_exact(sock, 4)
+    if hdr is None:
+        return None
+    (n,) = _LEN.unpack(hdr)
+    if n > _MAX_FRAME:
+        raise ValueError("frame too large")
+    return _read_exact(sock, n)
+
+
+def write_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+class Server:
+    def __init__(self, service: KvService, host: str = "127.0.0.1", port: int = 0, workers: int = 8):
+        self.service = service
+        self._sock = socket.create_server((host, port))
+        self.addr = self._sock.getsockname()
+        self._pool = ThreadPoolExecutor(max_workers=workers)
+        self._stop = threading.Event()
+        self._accept_thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except TimeoutError:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,), daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        send_mu = threading.Lock()
+        try:
+            while not self._stop.is_set():
+                frame = read_frame(conn)
+                if frame is None:
+                    return
+                req_id, method, request = wire.loads(frame)
+
+                def run(req_id=req_id, method=method, request=request):
+                    handler = getattr(self.service, method, None)
+                    if handler is None or method.startswith("_"):
+                        resp = {"error": {"other": f"unknown method {method}"}}
+                    else:
+                        try:
+                            resp = handler(request)
+                        except Exception as e:  # noqa: BLE001 — wire boundary
+                            resp = {"error": {"other": repr(e)}}
+                    payload = wire.dumps([req_id, resp])
+                    with send_mu:
+                        try:
+                            write_frame(conn, payload)
+                        except OSError:
+                            pass
+
+                self._pool.submit(run)
+        except (ConnectionError, ValueError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._sock.close()
+        self._pool.shutdown(wait=False)
+
+
+class Client:
+    """Blocking client with request multiplexing (ReqBatcher flavor)."""
+
+    def __init__(self, host: str, port: int):
+        self._sock = socket.create_connection((host, port))
+        self._mu = threading.Lock()
+        self._next_id = 0
+        self._pending: dict[int, threading.Event] = {}
+        self._results: dict[int, object] = {}
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = read_frame(self._sock)
+                if frame is None:
+                    return
+                req_id, resp = wire.loads(frame)
+                with self._mu:
+                    self._results[req_id] = resp
+                    ev = self._pending.pop(req_id, None)
+                if ev is not None:
+                    ev.set()
+        except (ConnectionError, OSError, ValueError):
+            with self._mu:
+                for ev in self._pending.values():
+                    ev.set()
+
+    def call(self, method: str, request: dict, timeout: float = 30.0):
+        with self._mu:
+            self._next_id += 1
+            req_id = self._next_id
+            ev = threading.Event()
+            self._pending[req_id] = ev
+        write_frame(self._sock, wire.dumps([req_id, method, request]))
+        if not ev.wait(timeout):
+            raise TimeoutError(f"{method} timed out")
+        with self._mu:
+            return self._results.pop(req_id)
+
+    def close(self) -> None:
+        self._sock.close()

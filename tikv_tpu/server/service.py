@@ -1,0 +1,333 @@
+"""The KV service: the store's full external API surface.
+
+Re-expression of the gRPC ``Tikv`` service (``src/server/service/kv.rs``; the
+handler inventory is SURVEY.md Appendix A): transactional KV, raw KV, and
+coprocessor, plus cluster-internal helpers.  Handlers take/return plain
+wire-codable dicts so the same functions serve in-process calls and the TCP
+server's ``batch_commands`` multiplexing.
+
+Errors are returned as ``{"error": {...}}`` region errors / key errors the
+way the reference maps storage errors into kvproto errors.
+"""
+
+from __future__ import annotations
+
+from ..copr.endpoint import CoprRequest, Endpoint, REQ_TYPE_DAG
+from ..raft.region import EpochError, NotLeaderError
+from ..storage.mvcc.reader import KeyIsLockedError, WriteConflictError
+from ..storage.mvcc.txn import AlreadyExistsError, TxnError
+from ..storage.storage import Storage
+from ..storage.txn import commands as cmds
+from ..storage.txn_types import Key, Mutation, MutationType
+
+
+def _mutation_from_wire(m: dict) -> Mutation:
+    op = MutationType(m["op"])
+    return Mutation(op, Key.from_raw(m["key"]), m.get("value"))
+
+
+def _err(e: Exception) -> dict:
+    if isinstance(e, KeyIsLockedError):
+        return {
+            "locked": {
+                "key": e.key,
+                "primary": e.lock.primary,
+                "lock_ts": e.lock.ts,
+                "ttl": e.lock.ttl,
+            }
+        }
+    if isinstance(e, WriteConflictError):
+        return {
+            "conflict": {
+                "key": e.key,
+                "start_ts": e.start_ts,
+                "conflict_start_ts": e.conflict_start_ts,
+                "conflict_commit_ts": e.conflict_commit_ts,
+            }
+        }
+    if isinstance(e, AlreadyExistsError):
+        return {"already_exists": {"key": e.key}}
+    if isinstance(e, NotLeaderError):
+        return {"not_leader": {"region_id": e.region_id, "leader_store": e.leader_store}}
+    if isinstance(e, EpochError):
+        return {"epoch_not_match": {}}
+    return {"other": str(e)}
+
+
+class KvService:
+    """All handlers of one store (kv.rs handler inventory)."""
+
+    def __init__(self, storage: Storage, copr: Endpoint | None = None):
+        self.storage = storage
+        self.copr = copr
+
+    # -- transactional KV ---------------------------------------------------
+
+    def kv_get(self, req: dict) -> dict:
+        try:
+            v = self.storage.get(
+                req["key"], req["version"], req.get("context"),
+                bypass_locks=frozenset(req.get("bypass_locks", ())),
+            )
+            return {"value": v, "not_found": v is None}
+        except Exception as e:  # noqa: BLE001 — mapped to wire errors
+            return {"error": _err(e)}
+
+    def kv_batch_get(self, req: dict) -> dict:
+        try:
+            pairs = self.storage.batch_get(req["keys"], req["version"], req.get("context"))
+            return {"pairs": [list(p) for p in pairs]}
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    def kv_scan(self, req: dict) -> dict:
+        try:
+            pairs = self.storage.scan(
+                req.get("start_key", b""),
+                req.get("end_key"),
+                req.get("limit"),
+                req["version"],
+                req.get("context"),
+                reverse=req.get("reverse", False),
+                key_only=req.get("key_only", False),
+            )
+            return {"pairs": [list(p) for p in pairs]}
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    def kv_prewrite(self, req: dict) -> dict:
+        cmd = cmds.Prewrite(
+            [_mutation_from_wire(m) for m in req["mutations"]],
+            req["primary_lock"],
+            req["start_version"],
+            lock_ttl=req.get("lock_ttl", 3000),
+            use_async_commit=req.get("use_async_commit", False),
+            secondaries=req.get("secondaries", []),
+            is_pessimistic=req.get("is_pessimistic", False),
+            pessimistic_flags=req.get("is_pessimistic_lock", []),
+            for_update_ts=req.get("for_update_ts", 0),
+        )
+        try:
+            r = self.storage.sched_txn_command(cmd, req.get("context"))
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+        if "errors" in r:
+            return {"errors": [_err(e) for e in r["errors"]]}
+        return {"min_commit_ts": r.get("min_commit_ts", 0)}
+
+    def kv_commit(self, req: dict) -> dict:
+        cmd = cmds.Commit(
+            [Key.from_raw(k) for k in req["keys"]],
+            req["start_version"],
+            req["commit_version"],
+        )
+        try:
+            self.storage.sched_txn_command(cmd, req.get("context"))
+            return {"commit_version": req["commit_version"]}
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    def kv_batch_rollback(self, req: dict) -> dict:
+        cmd = cmds.Rollback([Key.from_raw(k) for k in req["keys"]], req["start_version"])
+        try:
+            self.storage.sched_txn_command(cmd, req.get("context"))
+            return {}
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    def kv_cleanup(self, req: dict) -> dict:
+        cmd = cmds.Cleanup(
+            Key.from_raw(req["key"]), req["start_version"], req.get("current_ts", 0)
+        )
+        try:
+            self.storage.sched_txn_command(cmd, req.get("context"))
+            return {}
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    def kv_pessimistic_lock(self, req: dict) -> dict:
+        cmd = cmds.AcquirePessimisticLock(
+            [(Key.from_raw(k), False) for k in req["keys"]],
+            req["primary_lock"],
+            req["start_version"],
+            req["for_update_ts"],
+            lock_ttl=req.get("lock_ttl", 3000),
+            return_values=req.get("return_values", False),
+        )
+        try:
+            r = self.storage.sched_txn_command(cmd, req.get("context"))
+            return {"values": r.get("values")}
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    def kv_pessimistic_rollback(self, req: dict) -> dict:
+        cmd = cmds.PessimisticRollback(
+            [Key.from_raw(k) for k in req["keys"]],
+            req["start_version"],
+            req["for_update_ts"],
+        )
+        try:
+            self.storage.sched_txn_command(cmd, req.get("context"))
+            return {}
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    def kv_txn_heart_beat(self, req: dict) -> dict:
+        cmd = cmds.TxnHeartBeat(
+            Key.from_raw(req["primary_lock"]), req["start_version"], req["advise_lock_ttl"]
+        )
+        try:
+            r = self.storage.sched_txn_command(cmd, req.get("context"))
+            return {"lock_ttl": r["lock_ttl"]}
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    def kv_check_txn_status(self, req: dict) -> dict:
+        cmd = cmds.CheckTxnStatus(
+            Key.from_raw(req["primary_key"]),
+            req["lock_ts"],
+            req.get("caller_start_ts", 0),
+            req.get("current_ts", 0),
+            rollback_if_not_exist=req.get("rollback_if_not_exist", False),
+        )
+        try:
+            r = self.storage.sched_txn_command(cmd, req.get("context"))
+            st = r["status"]
+            return {
+                "kind": st.kind.value,
+                "commit_version": st.commit_ts,
+                "lock_ttl": st.lock_ttl,
+                "min_commit_ts": st.min_commit_ts,
+            }
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    def kv_check_secondary_locks(self, req: dict) -> dict:
+        cmd = cmds.CheckSecondaryLocks(
+            [Key.from_raw(k) for k in req["keys"]], req["start_version"]
+        )
+        try:
+            r = self.storage.sched_txn_command(cmd, req.get("context"))
+            return {
+                "locks": [{"ts": l.ts, "primary": l.primary} for l in r["locks"]],
+                "commit_ts": r["commit_ts"],
+            }
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    def kv_scan_lock(self, req: dict) -> dict:
+        try:
+            locks = self.storage.scan_lock(
+                req.get("start_key"), req.get("end_key"), req["max_version"], req.get("limit")
+            )
+            return {
+                "locks": [
+                    {"key": k.to_raw(), "primary": l.primary, "lock_version": l.ts, "ttl": l.ttl}
+                    for k, l in locks
+                ]
+            }
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    def kv_resolve_lock(self, req: dict) -> dict:
+        cmd = cmds.ResolveLock(
+            req["start_version"],
+            req.get("commit_version", 0),
+            [Key.from_raw(k) for k in req["keys"]] if req.get("keys") else None,
+        )
+        try:
+            r = self.storage.sched_txn_command(cmd, req.get("context"))
+            return {"resolved": r["resolved"]}
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    def kv_delete_range(self, req: dict) -> dict:
+        from ..storage.engine import CF_DEFAULT, CF_LOCK, CF_WRITE, WriteBatch
+        from ..storage.txn_types import Key as K
+
+        wb = WriteBatch()
+        start = K.from_raw(req["start_key"]).encoded
+        end = K.from_raw(req["end_key"]).encoded
+        for cf in (CF_DEFAULT, CF_LOCK, CF_WRITE):
+            wb.delete_range_cf(cf, start, end)
+        try:
+            self.storage.engine.write(req.get("context"), wb)
+            return {}
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    # -- raw KV -------------------------------------------------------------
+
+    def raw_get(self, req: dict) -> dict:
+        v = self.storage.raw_get(req["key"], req.get("context"))
+        return {"value": v, "not_found": v is None}
+
+    def raw_batch_get(self, req: dict) -> dict:
+        return {"pairs": [list(p) for p in self.storage.raw_batch_get(req["keys"], req.get("context"))]}
+
+    def raw_put(self, req: dict) -> dict:
+        self.storage.raw_put(req["key"], req["value"], req.get("context"), ttl=req.get("ttl", 0))
+        return {}
+
+    def raw_batch_put(self, req: dict) -> dict:
+        self.storage.raw_batch_put(
+            [tuple(p) for p in req["pairs"]], req.get("context"), ttl=req.get("ttl", 0)
+        )
+        return {}
+
+    def raw_delete(self, req: dict) -> dict:
+        self.storage.raw_delete(req["key"], req.get("context"))
+        return {}
+
+    def raw_batch_delete(self, req: dict) -> dict:
+        self.storage.raw_batch_delete(req["keys"], req.get("context"))
+        return {}
+
+    def raw_delete_range(self, req: dict) -> dict:
+        self.storage.raw_delete_range(req["start_key"], req["end_key"], req.get("context"))
+        return {}
+
+    def raw_scan(self, req: dict) -> dict:
+        pairs = self.storage.raw_scan(
+            req.get("start_key", b""),
+            req.get("end_key"),
+            req.get("limit"),
+            req.get("context"),
+            reverse=req.get("reverse", False),
+            key_only=req.get("key_only", False),
+        )
+        return {"kvs": [list(p) for p in pairs]}
+
+    def raw_get_key_ttl(self, req: dict) -> dict:
+        ttl = self.storage.raw_get_key_ttl(req["key"], req.get("context"))
+        return {"ttl": ttl, "not_found": ttl is None}
+
+    def raw_compare_and_swap(self, req: dict) -> dict:
+        ok, prev = self.storage.raw_compare_and_swap(
+            req["key"], req.get("previous_value"), req["value"], req.get("context"),
+            ttl=req.get("ttl", 0),
+        )
+        return {"succeed": ok, "previous_value": prev}
+
+    # -- coprocessor --------------------------------------------------------
+
+    def coprocessor(self, req: dict) -> dict:
+        """req: {tp, dag (DagRequest in-process, or wire dict), ranges, start_ts}."""
+        assert self.copr is not None, "coprocessor endpoint not wired"
+        dag = req["dag"]
+        if isinstance(dag, dict):
+            from ..copr.dag_wire import dag_from_wire
+
+            dag = dag_from_wire(dag)
+        creq = CoprRequest(
+            tp=req.get("tp", REQ_TYPE_DAG),
+            dag=dag,
+            ranges=[tuple(r) for r in req["ranges"]],
+            start_ts=req["start_ts"],
+            context=req.get("context") or {},
+        )
+        try:
+            r = self.copr.handle_request(creq)
+            return {"data": r.data, "from_device": r.from_device}
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}

@@ -1,0 +1,105 @@
+"""Self-describing wire codec for the RPC layer.
+
+The reference speaks protobuf (kvproto) over gRPC; this framework's control
+plane speaks a compact tagged encoding over TCP frames.  Supported values:
+None, bool, int (signed 64), float, bytes, str, list, tuple, dict.  Safe to
+decode untrusted bytes (no code execution, bounded recursion).
+"""
+
+from __future__ import annotations
+
+from ..util import codec
+
+_NONE, _TRUE, _FALSE, _INT, _FLOAT, _BYTES, _STR, _LIST, _DICT, _TUPLE = range(10)
+_MAX_DEPTH = 32
+
+
+def dumps(obj) -> bytes:
+    out = bytearray()
+    _enc(out, obj, 0)
+    return bytes(out)
+
+
+def _enc(out: bytearray, obj, depth: int) -> None:
+    if depth > _MAX_DEPTH:
+        raise ValueError("wire value too deep")
+    if obj is None:
+        out.append(_NONE)
+    elif obj is True:
+        out.append(_TRUE)
+    elif obj is False:
+        out.append(_FALSE)
+    elif isinstance(obj, int):
+        out.append(_INT)
+        out += codec.encode_var_i64(obj)
+    elif isinstance(obj, float):
+        out.append(_FLOAT)
+        out += codec.encode_f64(obj)
+    elif isinstance(obj, bytes):
+        out.append(_BYTES)
+        out += codec.encode_var_u64(len(obj))
+        out += obj
+    elif isinstance(obj, str):
+        b = obj.encode()
+        out.append(_STR)
+        out += codec.encode_var_u64(len(b))
+        out += b
+    elif isinstance(obj, (list, tuple)):
+        out.append(_LIST if isinstance(obj, list) else _TUPLE)
+        out += codec.encode_var_u64(len(obj))
+        for v in obj:
+            _enc(out, v, depth + 1)
+    elif isinstance(obj, dict):
+        out.append(_DICT)
+        out += codec.encode_var_u64(len(obj))
+        for k, v in obj.items():
+            _enc(out, k, depth + 1)
+            _enc(out, v, depth + 1)
+    else:
+        raise TypeError(f"not wire-encodable: {type(obj)}")
+
+
+def loads(b: bytes):
+    v, off = _dec(b, 0, 0)
+    if off != len(b):
+        raise ValueError("trailing bytes")
+    return v
+
+
+def _dec(b: bytes, off: int, depth: int):
+    if depth > _MAX_DEPTH:
+        raise ValueError("wire value too deep")
+    tag = b[off]
+    off += 1
+    if tag == _NONE:
+        return None, off
+    if tag == _TRUE:
+        return True, off
+    if tag == _FALSE:
+        return False, off
+    if tag == _INT:
+        return codec.decode_var_i64(b, off)
+    if tag == _FLOAT:
+        return codec.decode_f64(b, off), off + 8
+    if tag in (_BYTES, _STR):
+        n, off = codec.decode_var_u64(b, off)
+        raw = b[off : off + n]
+        if len(raw) != n:
+            raise ValueError("truncated")
+        return (raw if tag == _BYTES else raw.decode()), off + n
+    if tag in (_LIST, _TUPLE):
+        n, off = codec.decode_var_u64(b, off)
+        items = []
+        for _ in range(n):
+            v, off = _dec(b, off, depth + 1)
+            items.append(v)
+        return (items if tag == _LIST else tuple(items)), off
+    if tag == _DICT:
+        n, off = codec.decode_var_u64(b, off)
+        d = {}
+        for _ in range(n):
+            k, off = _dec(b, off, depth + 1)
+            v, off = _dec(b, off, depth + 1)
+            d[k] = v
+        return d, off
+    raise ValueError(f"bad wire tag {tag}")

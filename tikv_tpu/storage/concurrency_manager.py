@@ -1,0 +1,82 @@
+"""In-memory per-key lock table + max_ts tracking.
+
+Re-expression of ``components/concurrency_manager`` (``src/lib.rs:33``):
+async-commit prewrites hold *memory* locks on their keys so point/range reads
+can detect them before the persisted lock is visible, and every read advances
+``max_ts`` so async-commit transactions can compute a safe min_commit_ts.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .mvcc.reader import KeyIsLockedError
+from .txn_types import Key, Lock
+
+
+class KeyHandleGuard:
+    def __init__(self, cm: "ConcurrencyManager", key: Key):
+        self._cm = cm
+        self.key = key
+        self._lock: Lock | None = None
+
+    def with_lock(self, lock: Lock | None) -> None:
+        with self._cm._mu:
+            if lock is None:
+                self._cm._table.pop(self.key.encoded, None)
+            else:
+                self._cm._table[self.key.encoded] = lock
+            self._lock = lock
+
+    def release(self) -> None:
+        self.with_lock(None)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+class ConcurrencyManager:
+    def __init__(self, latest_ts: int = 0):
+        self._mu = threading.RLock()
+        self._max_ts = latest_ts
+        self._table: dict[bytes, Lock] = {}
+
+    def max_ts(self) -> int:
+        with self._mu:
+            return self._max_ts
+
+    def update_max_ts(self, ts: int) -> None:
+        with self._mu:
+            if ts > self._max_ts:
+                self._max_ts = ts
+
+    def lock_key(self, key: Key) -> KeyHandleGuard:
+        return KeyHandleGuard(self, key)
+
+    def read_key_check(self, key: Key, ts: int, bypass: frozenset[int] = frozenset()) -> None:
+        self.update_max_ts(ts)
+        with self._mu:
+            lock = self._table.get(key.encoded)
+        if lock is not None and not lock.is_visible_to(ts, bypass):
+            raise KeyIsLockedError(key.to_raw(), lock)
+
+    def read_range_check(
+        self, start: Key | None, end: Key | None, ts: int, bypass: frozenset[int] = frozenset()
+    ) -> None:
+        self.update_max_ts(ts)
+        with self._mu:
+            items = list(self._table.items())
+        for enc, lock in items:
+            if start is not None and enc < start.encoded:
+                continue
+            if end is not None and enc >= end.encoded:
+                continue
+            if not lock.is_visible_to(ts, bypass):
+                raise KeyIsLockedError(Key.from_encoded(enc).to_raw(), lock)
+
+    def global_min_lock_ts(self) -> int | None:
+        with self._mu:
+            return min((l.ts for l in self._table.values()), default=None)

@@ -1,0 +1,48 @@
+"""The Engine trait the transaction layer runs over.
+
+Re-expression of ``components/tikv_kv`` (``src/lib.rs:155``): storage code
+only needs two operations — get a consistent snapshot, and atomically apply a
+WriteBatch ("modifies").  ``LocalEngine`` runs them against a local KvEngine
+(the reference's ``RocksEngine`` standalone mode / ``BTreeEngine`` tests);
+``RaftKv`` (tikv_tpu.raft.raftkv) routes them through raft consensus.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Callable
+
+from .btree_engine import BTreeEngine
+from .engine import KvEngine, Snapshot, WriteBatch
+
+
+class Engine(abc.ABC):
+    @abc.abstractmethod
+    def snapshot(self, ctx: dict | None = None) -> Snapshot: ...
+
+    @abc.abstractmethod
+    def write(self, ctx: dict | None, batch: WriteBatch) -> None: ...
+
+    def async_snapshot(self, ctx: dict | None, cb: Callable[[Snapshot], None]) -> None:
+        cb(self.snapshot(ctx))
+
+    def async_write(self, ctx: dict | None, batch: WriteBatch, cb: Callable[[Exception | None], None]) -> None:
+        try:
+            self.write(ctx, batch)
+            cb(None)
+        except Exception as e:  # noqa: BLE001 — delivered to callback
+            cb(e)
+
+
+class LocalEngine(Engine):
+    """Single-node engine: raft-free, direct writes (tikv_kv BTreeEngine /
+    RocksEngine standalone)."""
+
+    def __init__(self, kv: KvEngine | None = None):
+        self.kv = kv or BTreeEngine()
+
+    def snapshot(self, ctx: dict | None = None) -> Snapshot:
+        return self.kv.snapshot()
+
+    def write(self, ctx: dict | None, batch: WriteBatch) -> None:
+        self.kv.write(batch)

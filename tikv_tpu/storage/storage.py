@@ -1,0 +1,223 @@
+"""The Storage façade — the transactional + raw KV surface of one store.
+
+Re-expression of ``src/storage/mod.rs:121`` (``Storage<E, L>``): point/range
+MVCC reads (get/batch_get/scan), txn commands via the scheduler
+(``sched_txn_command`` :919), and the raw KV API with TTL and atomic CAS
+(``mod.rs:997+``, ``raw/ttl.rs``, ``commands/{compare_and_swap,
+atomic_store}.rs``).
+
+Raw keys live in CF_DEFAULT under their own encoding (``r`` prefix keeps them
+disjoint from txn data); TTL is an expiry timestamp suffix on the value,
+filtered on read and purged by the GC worker's compaction pass.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..util import codec
+from .concurrency_manager import ConcurrencyManager
+from .engine import CF_DEFAULT, WriteBatch
+from .kv import Engine, LocalEngine
+from .mvcc import ForwardScanner, BackwardScanner, IsolationLevel, PointGetter, Statistics
+from .txn.commands import Command
+from .txn.latches import Latches
+from .txn.scheduler import Scheduler
+from .txn_types import Key
+
+RAW_PREFIX = b"r"
+_NO_TTL = 0xFFFFFFFFFFFFFFFF
+
+
+def _raw_key(key: bytes) -> bytes:
+    return RAW_PREFIX + key
+
+
+def _encode_raw_value(value: bytes, ttl_secs: int, now: float) -> bytes:
+    expire = _NO_TTL if ttl_secs == 0 else int(now) + ttl_secs
+    return value + codec.encode_u64(expire)
+
+
+def _decode_raw_value(stored: bytes, now: float) -> bytes | None:
+    value, expire = stored[:-8], codec.decode_u64(stored, len(stored) - 8)
+    if expire != _NO_TTL and expire <= int(now):
+        return None
+    return value, expire  # type: ignore[return-value]
+
+
+class Storage:
+    def __init__(self, engine: Engine | None = None, concurrency_manager: ConcurrencyManager | None = None):
+        self.engine = engine or LocalEngine()
+        self.cm = concurrency_manager or ConcurrencyManager()
+        self.scheduler = Scheduler(self.engine, self.cm)
+        self._raw_latches = Latches(64)
+
+    # -- transactional reads ----------------------------------------------
+
+    def get(
+        self,
+        key: bytes,
+        ts: int,
+        ctx: dict | None = None,
+        isolation: IsolationLevel = IsolationLevel.SI,
+        bypass_locks: frozenset[int] = frozenset(),
+    ) -> bytes | None:
+        k = Key.from_raw(key)
+        self.cm.read_key_check(k, ts, bypass_locks)
+        snap = self.engine.snapshot(ctx)
+        return PointGetter(snap, ts, isolation, bypass_locks).get(k)
+
+    def batch_get(self, keys: list[bytes], ts: int, ctx: dict | None = None, **kw) -> list[tuple[bytes, bytes]]:
+        out = []
+        snap = self.engine.snapshot(ctx)
+        for key in keys:
+            k = Key.from_raw(key)
+            self.cm.read_key_check(k, ts, kw.get("bypass_locks", frozenset()))
+            v = PointGetter(snap, ts, **kw).get(k)
+            if v is not None:
+                out.append((key, v))
+        return out
+
+    def scan(
+        self,
+        start: bytes,
+        end: bytes | None,
+        limit: int | None,
+        ts: int,
+        ctx: dict | None = None,
+        reverse: bool = False,
+        key_only: bool = False,
+        isolation: IsolationLevel = IsolationLevel.SI,
+        bypass_locks: frozenset[int] = frozenset(),
+    ) -> list[tuple[bytes, bytes]]:
+        ks = Key.from_raw(start) if start else None
+        ke = Key.from_raw(end) if end is not None else None
+        self.cm.read_range_check(ks, ke, ts, bypass_locks)
+        snap = self.engine.snapshot(ctx)
+        cls = BackwardScanner if reverse else ForwardScanner
+        scanner = cls(snap, ts, ks, ke, isolation, bypass_locks, key_only)
+        out = []
+        for kv in scanner:
+            out.append(kv)
+            if limit is not None and len(out) >= limit:
+                break
+        return out
+
+    def scan_lock(self, start: bytes | None, end: bytes | None, max_ts: int, limit: int | None = None):
+        from .mvcc import MvccReader
+
+        snap = self.engine.snapshot(None)
+        reader = MvccReader(snap)
+        return reader.scan_locks(
+            Key.from_raw(start) if start else None,
+            Key.from_raw(end) if end else None,
+            lambda l: l.ts <= max_ts,
+            limit,
+        )
+
+    # -- txn commands -------------------------------------------------------
+
+    def sched_txn_command(self, cmd: Command, ctx: dict | None = None):
+        return self.scheduler.run_command(cmd, ctx)
+
+    # -- raw KV -------------------------------------------------------------
+
+    def raw_get(self, key: bytes, ctx: dict | None = None, now: float | None = None) -> bytes | None:
+        stored = self.engine.snapshot(ctx).get_cf(CF_DEFAULT, _raw_key(key))
+        if stored is None:
+            return None
+        dec = _decode_raw_value(stored, now if now is not None else time.time())
+        return None if dec is None else dec[0]
+
+    def raw_get_key_ttl(self, key: bytes, ctx: dict | None = None, now: float | None = None) -> int | None:
+        stored = self.engine.snapshot(ctx).get_cf(CF_DEFAULT, _raw_key(key))
+        if stored is None:
+            return None
+        now = now if now is not None else time.time()
+        dec = _decode_raw_value(stored, now)
+        if dec is None:
+            return None
+        _, expire = dec
+        return 0 if expire == _NO_TTL else max(0, expire - int(now))
+
+    def raw_batch_get(self, keys: list[bytes], ctx: dict | None = None) -> list[tuple[bytes, bytes]]:
+        snap = self.engine.snapshot(ctx)
+        now = time.time()
+        out = []
+        for key in keys:
+            stored = snap.get_cf(CF_DEFAULT, _raw_key(key))
+            if stored is not None:
+                dec = _decode_raw_value(stored, now)
+                if dec is not None:
+                    out.append((key, dec[0]))
+        return out
+
+    def raw_put(self, key: bytes, value: bytes, ctx: dict | None = None, ttl: int = 0) -> None:
+        wb = WriteBatch()
+        wb.put_cf(CF_DEFAULT, _raw_key(key), _encode_raw_value(value, ttl, time.time()))
+        self.engine.write(ctx, wb)
+
+    def raw_batch_put(self, pairs: list[tuple[bytes, bytes]], ctx: dict | None = None, ttl: int = 0) -> None:
+        wb = WriteBatch()
+        now = time.time()
+        for k, v in pairs:
+            wb.put_cf(CF_DEFAULT, _raw_key(k), _encode_raw_value(v, ttl, now))
+        self.engine.write(ctx, wb)
+
+    def raw_delete(self, key: bytes, ctx: dict | None = None) -> None:
+        wb = WriteBatch()
+        wb.delete_cf(CF_DEFAULT, _raw_key(key))
+        self.engine.write(ctx, wb)
+
+    def raw_batch_delete(self, keys: list[bytes], ctx: dict | None = None) -> None:
+        wb = WriteBatch()
+        for k in keys:
+            wb.delete_cf(CF_DEFAULT, _raw_key(k))
+        self.engine.write(ctx, wb)
+
+    def raw_delete_range(self, start: bytes, end: bytes, ctx: dict | None = None) -> None:
+        wb = WriteBatch()
+        wb.delete_range_cf(CF_DEFAULT, _raw_key(start), _raw_key(end))
+        self.engine.write(ctx, wb)
+
+    def raw_scan(
+        self,
+        start: bytes,
+        end: bytes | None,
+        limit: int | None = None,
+        ctx: dict | None = None,
+        reverse: bool = False,
+        key_only: bool = False,
+    ) -> list[tuple[bytes, bytes]]:
+        snap = self.engine.snapshot(ctx)
+        now = time.time()
+        end_enc = _raw_key(end) if end is not None else RAW_PREFIX + b"\xff" * 64
+        out = []
+        for k, stored in snap.scan_cf(CF_DEFAULT, _raw_key(start), end_enc, None, reverse):
+            dec = _decode_raw_value(stored, now)
+            if dec is None:
+                continue
+            out.append((k[len(RAW_PREFIX):], b"" if key_only else dec[0]))
+            if limit is not None and len(out) >= limit:
+                break
+        return out
+
+    def raw_compare_and_swap(
+        self,
+        key: bytes,
+        previous: bytes | None,
+        value: bytes,
+        ctx: dict | None = None,
+        ttl: int = 0,
+    ) -> tuple[bool, bytes | None]:
+        """Atomic CAS via latches (commands/compare_and_swap.rs)."""
+        cid = self._raw_latches.gen_cid()
+        slots = self._raw_latches.acquire(cid, [key])
+        try:
+            cur = self.raw_get(key, ctx)
+            if cur != previous:
+                return False, cur
+            self.raw_put(key, value, ctx, ttl)
+            return True, cur
+        finally:
+            self._raw_latches.release(cid, slots)

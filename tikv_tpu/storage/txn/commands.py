@@ -1,0 +1,302 @@
+"""Transaction commands — one class per scheduler command.
+
+Re-expression of ``src/storage/txn/commands/`` (one file per command there:
+prewrite, commit, acquire_pessimistic_lock, check_txn_status,
+check_secondary_locks, cleanup, rollback, pessimistic_rollback, resolve_lock,
+txn_heart_beat, mvcc_by_key/start_ts, compare_and_swap, atomic_store).
+
+Each command declares the keys it must latch and a ``process_write(snapshot)``
+producing (WriteBatch, result) — executed by the Scheduler under latches.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..engine import Snapshot
+from ..mvcc.reader import IsolationLevel, KeyIsLockedError, MvccReader, WriteConflictError
+from ..mvcc.txn import (
+    MvccTxn,
+    PrewriteContext,
+    TxnError,
+    TxnStatus,
+    TxnStatusKind,
+    acquire_pessimistic_lock,
+    check_txn_status,
+    commit_key,
+    prewrite_key,
+    rollback_key,
+)
+from ..txn_types import Key, Lock, Mutation, WriteType
+
+
+class Command:
+    def latch_keys(self) -> list[bytes]:
+        raise NotImplementedError
+
+    def process_write(self, snapshot: Snapshot):
+        """Returns (MvccTxn, result)."""
+        raise NotImplementedError
+
+
+@dataclass
+class Prewrite(Command):
+    mutations: list[Mutation]
+    primary: bytes
+    start_ts: int
+    lock_ttl: int = 3000
+    txn_size: int = 0
+    min_commit_ts: int = 0
+    use_async_commit: bool = False
+    secondaries: list[bytes] = field(default_factory=list)
+    # pessimistic variant: per-mutation flags, aligned with mutations
+    is_pessimistic: bool = False
+    pessimistic_flags: list[bool] = field(default_factory=list)
+    for_update_ts: int = 0
+
+    def latch_keys(self) -> list[bytes]:
+        return [m.key.encoded for m in self.mutations]
+
+    def process_write(self, snapshot: Snapshot):
+        txn = MvccTxn(self.start_ts)
+        reader = MvccReader(snapshot)
+        ctx = PrewriteContext(
+            primary=self.primary,
+            start_ts=self.start_ts,
+            lock_ttl=self.lock_ttl,
+            txn_size=self.txn_size,
+            min_commit_ts=self.min_commit_ts,
+            use_async_commit=self.use_async_commit,
+            secondaries=self.secondaries,
+            is_pessimistic=self.is_pessimistic,
+        )
+        min_commit_ts = 0
+        errors: list[Exception] = []
+        for i, m in enumerate(self.mutations):
+            flag = self.pessimistic_flags[i] if i < len(self.pessimistic_flags) else False
+            try:
+                ts = prewrite_key(txn, reader, m, ctx, is_pessimistic_lock=flag)
+                min_commit_ts = max(min_commit_ts, ts)
+            except (KeyIsLockedError, WriteConflictError, TxnError) as e:
+                errors.append(e)
+        if errors:
+            # keys that prewrote fine stay locked (client retries/cleans up),
+            # but report the failure set like the reference's KeyError vec
+            return MvccTxn(self.start_ts), {"errors": errors}
+        return txn, {"min_commit_ts": min_commit_ts}
+
+
+@dataclass
+class Commit(Command):
+    keys: list[Key]
+    start_ts: int
+    commit_ts: int
+
+    def latch_keys(self) -> list[bytes]:
+        return [k.encoded for k in self.keys]
+
+    def process_write(self, snapshot: Snapshot):
+        txn = MvccTxn(self.start_ts)
+        reader = MvccReader(snapshot)
+        for k in self.keys:
+            commit_key(txn, reader, k, self.start_ts, self.commit_ts)
+        return txn, {"commit_ts": self.commit_ts}
+
+
+@dataclass
+class Rollback(Command):
+    keys: list[Key]
+    start_ts: int
+
+    def latch_keys(self) -> list[bytes]:
+        return [k.encoded for k in self.keys]
+
+    def process_write(self, snapshot: Snapshot):
+        txn = MvccTxn(self.start_ts)
+        reader = MvccReader(snapshot)
+        for k in self.keys:
+            rollback_key(txn, reader, k, self.start_ts)
+        return txn, {}
+
+
+@dataclass
+class Cleanup(Command):
+    """Rollback the primary if its TTL expired (or unconditionally when
+    current_ts == 0) — commands/cleanup.rs."""
+
+    key: Key
+    start_ts: int
+    current_ts: int
+
+    def latch_keys(self) -> list[bytes]:
+        return [self.key.encoded]
+
+    def process_write(self, snapshot: Snapshot):
+        from ..txn_types import ts_physical
+
+        txn = MvccTxn(self.start_ts)
+        reader = MvccReader(snapshot)
+        lock = reader.load_lock(self.key)
+        if lock is not None and lock.ts == self.start_ts and self.current_ts:
+            if ts_physical(self.current_ts) - ts_physical(self.start_ts) < lock.ttl:
+                raise KeyIsLockedError(self.key.to_raw(), lock)
+        rollback_key(txn, reader, self.key, self.start_ts, protect=True)
+        return txn, {}
+
+
+@dataclass
+class AcquirePessimisticLock(Command):
+    keys: list[tuple[Key, bool]]  # (key, should_not_exist)
+    primary: bytes
+    start_ts: int
+    for_update_ts: int
+    lock_ttl: int = 3000
+    return_values: bool = False
+
+    def latch_keys(self) -> list[bytes]:
+        return [k.encoded for k, _ in self.keys]
+
+    def process_write(self, snapshot: Snapshot):
+        txn = MvccTxn(self.start_ts)
+        reader = MvccReader(snapshot)
+        values = []
+        for k, sne in self.keys:
+            v = acquire_pessimistic_lock(
+                txn, reader, k, self.primary, self.start_ts, self.for_update_ts,
+                ttl=self.lock_ttl, should_not_exist=sne,
+            )
+            values.append(v)
+        return txn, {"values": values if self.return_values else None}
+
+
+@dataclass
+class PessimisticRollback(Command):
+    keys: list[Key]
+    start_ts: int
+    for_update_ts: int
+
+    def latch_keys(self) -> list[bytes]:
+        return [k.encoded for k in self.keys]
+
+    def process_write(self, snapshot: Snapshot):
+        from ..txn_types import LockType
+
+        txn = MvccTxn(self.start_ts)
+        reader = MvccReader(snapshot)
+        for k in self.keys:
+            lock = reader.load_lock(k)
+            if (
+                lock is not None
+                and lock.lock_type == LockType.PESSIMISTIC
+                and lock.ts == self.start_ts
+                and lock.for_update_ts <= self.for_update_ts
+            ):
+                txn.unlock_key(k)
+        return txn, {}
+
+
+@dataclass
+class TxnHeartBeat(Command):
+    primary_key: Key
+    start_ts: int
+    advise_ttl: int
+
+    def latch_keys(self) -> list[bytes]:
+        return [self.primary_key.encoded]
+
+    def process_write(self, snapshot: Snapshot):
+        from ..mvcc.txn import TxnLockNotFoundError
+
+        txn = MvccTxn(self.start_ts)
+        reader = MvccReader(snapshot)
+        lock = reader.load_lock(self.primary_key)
+        if lock is None or lock.ts != self.start_ts:
+            raise TxnLockNotFoundError(self.primary_key, self.start_ts)
+        if self.advise_ttl > lock.ttl:
+            lock.ttl = self.advise_ttl
+            txn.put_lock(self.primary_key, lock)
+        return txn, {"lock_ttl": lock.ttl}
+
+
+@dataclass
+class CheckTxnStatus(Command):
+    primary_key: Key
+    lock_ts: int
+    caller_start_ts: int
+    current_ts: int
+    rollback_if_not_exist: bool = False
+
+    def latch_keys(self) -> list[bytes]:
+        return [self.primary_key.encoded]
+
+    def process_write(self, snapshot: Snapshot):
+        txn = MvccTxn(self.lock_ts)
+        reader = MvccReader(snapshot)
+        status = check_txn_status(
+            txn, reader, self.primary_key, self.lock_ts,
+            self.caller_start_ts, self.current_ts, self.rollback_if_not_exist,
+        )
+        return txn, {"status": status}
+
+
+@dataclass
+class CheckSecondaryLocks(Command):
+    """Async-commit: determine secondaries' fate (commands/check_secondary_locks.rs)."""
+
+    keys: list[Key]
+    start_ts: int
+
+    def latch_keys(self) -> list[bytes]:
+        return [k.encoded for k in self.keys]
+
+    def process_write(self, snapshot: Snapshot):
+        txn = MvccTxn(self.start_ts)
+        reader = MvccReader(snapshot)
+        locks: list[Lock] = []
+        commit_ts = 0
+        for k in self.keys:
+            lock = reader.load_lock(k)
+            if lock is not None and lock.ts == self.start_ts:
+                if lock.lock_type.name == "PESSIMISTIC":
+                    # pessimistic lock can't decide a commit: roll it back
+                    rollback_key(txn, reader, k, self.start_ts, protect=True)
+                else:
+                    locks.append(lock)
+                continue
+            found = False
+            for cts, w in reader.get_txn_commit_record(k, self.start_ts):
+                found = True
+                if w.write_type != WriteType.ROLLBACK:
+                    commit_ts = max(commit_ts, cts)
+            if not found:
+                rollback_key(txn, reader, k, self.start_ts, protect=True)
+                return txn, {"locks": [], "commit_ts": 0}
+        return txn, {"locks": locks, "commit_ts": commit_ts}
+
+
+@dataclass
+class ResolveLock(Command):
+    """Commit or roll back all keys of txn start_ts per the primary's fate
+    (commands/resolve_lock.rs; the lite variant takes explicit keys)."""
+
+    start_ts: int
+    commit_ts: int  # 0 = roll back
+    keys: list[Key] | None = None  # None = scan all locks of this txn
+
+    def latch_keys(self) -> list[bytes]:
+        return [k.encoded for k in self.keys] if self.keys else []
+
+    def process_write(self, snapshot: Snapshot):
+        txn = MvccTxn(self.start_ts)
+        reader = MvccReader(snapshot)
+        keys = self.keys
+        if keys is None:
+            keys = [
+                k for k, lock in reader.scan_locks(None, None, lambda l: l.ts == self.start_ts)
+            ]
+        for k in keys:
+            if self.commit_ts:
+                commit_key(txn, reader, k, self.start_ts, self.commit_ts)
+            else:
+                rollback_key(txn, reader, k, self.start_ts)
+        return txn, {"resolved": len(keys)}

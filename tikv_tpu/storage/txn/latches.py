@@ -1,0 +1,60 @@
+"""Per-key latches serializing conflicting write commands.
+
+Re-expression of ``src/storage/txn/latch.rs:141,162,188``: commands acquire a
+latch per touched key (hashed into slots); a command runs only when it is at
+the front of every slot's queue, guaranteeing FIFO fairness per key and
+atomic read-modify-write across its snapshot+write window.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+
+class Latches:
+    def __init__(self, size: int = 256):
+        self.size = size
+        self._slots: list[deque[int]] = [deque() for _ in range(size)]
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._next_cid = 0
+
+    def gen_cid(self) -> int:
+        with self._mu:
+            self._next_cid += 1
+            return self._next_cid
+
+    def _slot_ids(self, keys: list[bytes]) -> list[int]:
+        return sorted({hash(k) % self.size for k in keys})
+
+    def acquire(self, cid: int, keys: list[bytes]) -> list[int]:
+        """Enqueue cid on each slot and block until it is at every front."""
+        slots = self._slot_ids(keys)
+        with self._cv:
+            for s in slots:
+                self._slots[s].append(cid)
+            while not all(self._slots[s][0] == cid for s in slots):
+                self._cv.wait()
+        return slots
+
+    def try_acquire(self, cid: int, keys: list[bytes]) -> tuple[bool, list[int]]:
+        """Non-blocking: enqueue and report whether all fronts are ours."""
+        slots = self._slot_ids(keys)
+        with self._cv:
+            for s in slots:
+                if cid not in self._slots[s]:
+                    self._slots[s].append(cid)
+            return all(self._slots[s][0] == cid for s in slots), slots
+
+    def release(self, cid: int, slots: list[int]) -> None:
+        with self._cv:
+            for s in slots:
+                if self._slots[s] and self._slots[s][0] == cid:
+                    self._slots[s].popleft()
+                else:
+                    try:
+                        self._slots[s].remove(cid)
+                    except ValueError:
+                        pass
+            self._cv.notify_all()

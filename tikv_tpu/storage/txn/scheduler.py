@@ -1,0 +1,37 @@
+"""Txn command scheduler: latches → snapshot → process_write → engine write.
+
+Re-expression of ``src/storage/txn/scheduler.rs:277`` (run_cmd:333,
+schedule_command:353, execute:413, process_write:683): commands serialize on
+per-key latches, execute against a fresh snapshot, and their WriteBatch goes
+through the Engine; latches release on completion and queued commands wake.
+
+The reference runs this over a sched thread pool; here execution is
+synchronous per call (thread-safe — callers may be many threads), which keeps
+the same ordering guarantees with Python-level simplicity.
+"""
+
+from __future__ import annotations
+
+from ..kv import Engine
+from .commands import Command
+from .latches import Latches
+
+
+class Scheduler:
+    def __init__(self, engine: Engine, concurrency_manager=None, latch_slots: int = 256):
+        self.engine = engine
+        self.latches = Latches(latch_slots)
+        self.cm = concurrency_manager
+
+    def run_command(self, cmd: Command, ctx: dict | None = None):
+        cid = self.latches.gen_cid()
+        keys = cmd.latch_keys()
+        slots = self.latches.acquire(cid, keys)
+        try:
+            snapshot = self.engine.snapshot(ctx)
+            txn, result = cmd.process_write(snapshot)
+            if not txn.is_empty():
+                self.engine.write(ctx, txn.wb)
+            return result
+        finally:
+            self.latches.release(cid, slots)
