@@ -230,3 +230,39 @@ def test_stale_term_candidate_rejected():
     net.tick_all(25)
     leader = net.leader()
     assert leader is not None and leader.id in (1, 2)
+
+
+def test_read_index_waits_for_current_term_commit():
+    """A fresh leader must not serve ReadIndex before committing in its term
+    (the stale-read scenario from Raft §6.4)."""
+    net = Net(3)
+    net.elect(1)
+    net.nodes[1].propose(b"w")
+    net.drain()
+    # force a fresh election: node 2 takes over
+    net.partition(1, 2)
+    net.partition(1, 3)
+    net.nodes[2].campaign()
+    # don't drain yet — step only the vote exchange so the noop is NOT committed
+    for m in net.nodes[2].ready().messages:
+        if m.to == 3:
+            net.nodes[3].step(m)
+    for m in net.nodes[3].ready().messages:
+        if m.to == 2:
+            net.nodes[2].step(m)
+    assert net.nodes[2].role == Role.LEADER
+    assert not net.nodes[2]._committed_in_term()
+    net.nodes[2].read_index(b"early")
+    # read must NOT be released yet
+    rd = net.nodes[2].ready()
+    assert rd.read_states == []
+    # re-inject its messages and finish the round: noop commits, read releases
+    for m in rd.messages:
+        if (2, m.to) not in net.cut and m.to in net.nodes:
+            net.nodes[m.to].step(m)
+    if rd.entries:
+        net.persisted[2].extend(rd.entries)
+    net.drain()
+    assert net.reads[2] and net.reads[2][0][0] == b"early"
+    idx = net.reads[2][0][1]
+    assert net.nodes[2].log.term_at(idx) is not None

@@ -205,3 +205,32 @@ def test_coprocessor_over_raft_region(cluster):
         MvccBatchScanSource(snap, 100, [record_range(TABLE_ID)]),
     ).handle_request()
     assert resp2.encode() == resp.encode()
+
+
+def test_store_recovery_from_persisted_state(cluster):
+    """Kill a store's process state and rebuild it from the engine
+    (PeerStorage recovery: fsm/store.rs init path)."""
+    from tikv_tpu.raft.store import Store
+
+    cluster.must_put(b"r1", b"v1")
+    cluster.must_put(b"r2", b"v2")
+    victim_id = 2
+    old_store = cluster.stores[victim_id]
+    old_peer = old_store.peers[FIRST_REGION_ID]
+    applied_before = old_peer.node.applied
+    # "crash": fresh Store object over the surviving engine
+    new_store = Store(victim_id, cluster.transport, engine=old_store.engine)
+    n = new_store.recover()
+    assert n == 1
+    peer = new_store.peers[FIRST_REGION_ID]
+    assert peer.peer_id == old_peer.peer_id
+    assert peer.region.voter_ids() == old_peer.region.voter_ids()
+    assert peer.node.applied == applied_before
+    assert peer.node.term == old_peer.node.term
+    assert peer.node.log.last_index() >= applied_before
+    # swap it into the cluster; replication continues to the recovered peer
+    cluster.stores[victim_id] = new_store
+    cluster.transport.register(new_store)
+    cluster.must_put(b"r3", b"v3")
+    cluster.tick(3)
+    assert cluster.get_on_store(victim_id, b"r3") == b"v3"

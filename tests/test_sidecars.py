@@ -1,0 +1,318 @@
+"""Sidecar subsystems: GC, lock manager/deadlock, resolved-ts, CDC, backup,
+config system, metrics, status server."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from tikv_tpu.server.gc_worker import GcManager, GcWorker
+from tikv_tpu.server.lock_manager import DeadlockDetector, DeadlockError, WaiterManager
+from tikv_tpu.sidecar.backup import BackupEndpoint, LocalStorage, SstImporter
+from tikv_tpu.sidecar.cdc import CdcObserver
+from tikv_tpu.sidecar.resolved_ts import ResolvedTsEndpoint, Resolver
+from tikv_tpu.storage.engine import CF_WRITE
+from tikv_tpu.storage.kv import LocalEngine
+from tikv_tpu.storage.storage import Storage
+from tikv_tpu.storage.txn.commands import Commit, Prewrite, Rollback
+from tikv_tpu.storage.txn_types import Key, Mutation
+from tikv_tpu.util.config import ConfigController, TikvConfig
+from tikv_tpu.util.metrics import Registry
+
+
+def put(store, key, value, start_ts, commit_ts):
+    r = store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(key), value)], key, start_ts))
+    assert "errors" not in r
+    store.sched_txn_command(Commit([Key.from_raw(key)], start_ts, commit_ts))
+
+
+# -- GC ---------------------------------------------------------------------
+
+def test_gc_drops_old_versions_keeps_visible():
+    store = Storage()
+    for i, (s, c) in enumerate([(10, 11), (20, 21), (30, 31), (40, 41)]):
+        put(store, b"k", b"v%d" % i, s, c)
+    gc = GcWorker(store.engine)
+    stats = gc.gc_range(None, None, safe_point=25)
+    # versions below the base at safe point 25 (commit 21) are gone
+    assert stats["versions_deleted"] >= 1
+    assert store.get(b"k", 100) == b"v3"
+    assert store.get(b"k", 25) == b"v1"  # base at safe point survives
+    # reads below the dropped versions no longer see them
+    assert store.get(b"k", 11) is None
+
+
+def test_gc_removes_deleted_keys():
+    store = Storage()
+    put(store, b"d", b"v", 10, 11)
+    store.sched_txn_command(Prewrite([Mutation.delete(Key.from_raw(b"d"))], b"d", 20))
+    store.sched_txn_command(Commit([Key.from_raw(b"d")], 20, 21))
+    gc = GcWorker(store.engine)
+    gc.gc_range(None, None, safe_point=50)
+    # the whole key history is physically gone
+    assert list(store.engine.snapshot(None).scan_cf(CF_WRITE, b"", None)) == []
+
+
+def test_gc_rollback_markers_and_manager():
+    store = Storage()
+    put(store, b"k", b"v", 10, 11)
+    store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"r"), b"x")], b"r", 30))
+    store.sched_txn_command(Rollback([Key.from_raw(b"r")], 30))
+    gc = GcWorker(store.engine)
+
+    class FakePd:
+        def get_gc_safe_point(self):
+            return 40
+
+    mgr = GcManager(gc, FakePd(), interval=0.01)
+    mgr.start()
+    import time
+
+    time.sleep(0.1)
+    mgr.stop()
+    assert mgr.last_safe_point == 40
+    assert store.get(b"k", 100) == b"v"
+
+
+def test_gc_physical_scan_lock_and_destroy_range():
+    store = Storage()
+    store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"L1"), b"v")], b"L1", 15))
+    gc = GcWorker(store.engine)
+    locks = gc.physical_scan_lock(max_ts=100)
+    assert [(k, l.ts) for k, l in locks] == [(b"L1", 15)]
+    put(store, b"x1", b"v", 20, 21)
+    gc.unsafe_destroy_range(b"L", b"z")
+    assert gc.physical_scan_lock(100) == []
+    assert store.get(b"x1", 100) is None
+
+
+# -- lock manager / deadlock -------------------------------------------------
+
+def test_deadlock_detection_cycle():
+    det = DeadlockDetector()
+    det.detect(1, 2)  # txn1 waits on txn2
+    det.detect(2, 3)
+    with pytest.raises(DeadlockError) as ei:
+        det.detect(3, 1)  # closes 3→1→2→3
+    assert set(ei.value.cycle) >= {1, 2, 3}
+    # cleanup breaks the graph
+    det.clean_up(1)
+    det.detect(3, 1)
+
+
+def test_waiter_manager_wake_on_release():
+    wm = WaiterManager(default_timeout=5)
+    results = []
+
+    def waiter():
+        results.append(wm.wait_for(start_ts=100, lock_ts=50, key=b"k"))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    import time
+
+    time.sleep(0.05)
+    assert wm.wake_up(b"k", released_ts=50) == 1
+    t.join(timeout=2)
+    assert results == [True]
+
+
+def test_waiter_timeout():
+    wm = WaiterManager(default_timeout=0.05)
+    assert wm.wait_for(1, 2, b"k") is False
+
+
+# -- resolved ts -------------------------------------------------------------
+
+def test_resolver_watermark():
+    r = Resolver(1)
+    assert r.resolve(100) == 100
+    r.track_lock(120, b"a")
+    r.track_lock(150, b"b")
+    assert r.resolve(200) == 119  # min lock - 1
+    r.untrack_lock(b"a")
+    assert r.resolve(200) == 149
+    r.untrack_lock(b"b")
+    assert r.resolve(200) == 200
+    # never regresses
+    assert r.resolve(50) == 200
+
+
+def test_resolved_ts_over_cluster():
+    from tikv_tpu.pd.client import MockPd
+    from tikv_tpu.raft.cluster import FIRST_REGION_ID, Cluster
+
+    pd = MockPd()
+    cluster = Cluster(3, pd=pd)
+    cluster.run()
+    ep = ResolvedTsEndpoint(pd)
+    for s in cluster.stores.values():
+        s.apply_observers.append(ep.observe_apply)
+    leader = cluster.wait_leader(FIRST_REGION_ID)
+    store = Storage(engine=cluster.raftkv(leader.store.store_id))
+    ctx = {"region_id": FIRST_REGION_ID}
+    ts1 = pd.get_tso()
+    store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"k"), b"v")], b"k", ts1), ctx)
+    watermarks = ep.advance_all()
+    # pending lock pins the watermark below ts1
+    assert watermarks[FIRST_REGION_ID] == ts1 - 1
+    store.sched_txn_command(Commit([Key.from_raw(b"k")], ts1, pd.get_tso()), ctx)
+    w2 = ep.advance_all()[FIRST_REGION_ID]
+    assert w2 > ts1
+
+
+# -- CDC ---------------------------------------------------------------------
+
+def test_cdc_captures_committed_changes():
+    from tikv_tpu.pd.client import MockPd
+    from tikv_tpu.raft.cluster import FIRST_REGION_ID, Cluster
+
+    pd = MockPd()
+    cluster = Cluster(1, pd=pd)
+    cluster.run()
+    obs = CdcObserver()
+    for s in cluster.stores.values():
+        s.apply_observers.append(obs.observe_apply)
+    obs.subscribe(FIRST_REGION_ID)
+    leader = cluster.wait_leader(FIRST_REGION_ID)
+    store = Storage(engine=cluster.raftkv(leader.store.store_id))
+    ctx = {"region_id": FIRST_REGION_ID}
+
+    ts1 = pd.get_tso()
+    store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"c1"), b"v1")], b"c1", ts1), ctx)
+    c1 = pd.get_tso()
+    store.sched_txn_command(Commit([Key.from_raw(b"c1")], ts1, c1), ctx)
+    # update with old value
+    ts2 = pd.get_tso()
+    store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"c1"), b"v2")], b"c1", ts2), ctx)
+    store.sched_txn_command(Commit([Key.from_raw(b"c1")], ts2, pd.get_tso()), ctx)
+    # delete
+    ts3 = pd.get_tso()
+    store.sched_txn_command(Prewrite([Mutation.delete(Key.from_raw(b"c1"))], b"c1", ts3), ctx)
+    store.sched_txn_command(Commit([Key.from_raw(b"c1")], ts3, pd.get_tso()), ctx)
+    # rollback produces no event
+    ts4 = pd.get_tso()
+    store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"c2"), b"x")], b"c2", ts4), ctx)
+    store.sched_txn_command(Rollback([Key.from_raw(b"c2")], ts4), ctx)
+
+    evs = obs.sink.events
+    assert [(e.key, e.op, e.value) for e in evs] == [
+        (b"c1", "put", b"v1"),
+        (b"c1", "put", b"v2"),
+        (b"c1", "delete", None),
+    ]
+    assert evs[0].old_value is None
+    assert evs[1].old_value == b"v1"  # old value captured on update
+    assert evs[1].commit_ts > evs[0].commit_ts
+
+
+def test_cdc_incremental_scan():
+    store = Storage()
+    put(store, b"a", b"1", 10, 11)
+    put(store, b"b", b"2", 20, 21)
+    obs = CdcObserver()
+    n = obs.incremental_scan(store.engine.snapshot(None), region_id=1, start_ts=15)
+    assert n == 1  # only 'a' committed before ts 15
+    assert obs.sink.events[0].key == b"a"
+
+
+# -- backup / restore --------------------------------------------------------
+
+def test_backup_restore_roundtrip(tmp_path):
+    store = Storage()
+    for i in range(10):
+        put(store, b"bk%02d" % i, b"val%d" % i, 10 + i, 11 + i)
+    # later write not part of the backup
+    put(store, b"bk00", b"newer", 100, 101)
+    storage = LocalStorage(str(tmp_path))
+    ep = BackupEndpoint(storage)
+    meta = ep.backup_range(store.engine.snapshot(None), "full.bak", backup_ts=50)
+    assert meta["kvs"] == 10
+    # restore into a fresh store
+    store2 = Storage()
+    imp = SstImporter(storage)
+    r = imp.restore(store2.engine, "full.bak", restore_ts=200)
+    assert r["kvs"] == 10
+    assert store2.get(b"bk00", 300) == b"val0"  # backup_ts view, not 'newer'
+    assert store2.get(b"bk09", 300) == b"val9"
+    # rewrite rule
+    store3 = Storage()
+    imp.restore(store3.engine, "full.bak", restore_ts=200, rewrite=(b"bk", b"rk"))
+    assert store3.get(b"rk05", 300) == b"val5"
+    assert store3.get(b"bk05", 300) is None
+
+
+# -- config ------------------------------------------------------------------
+
+def test_config_toml_validate_and_unknown_keys():
+    cfg = TikvConfig.from_toml("""
+[raftstore]
+election-tick = 20
+heartbeat-tick = 4
+[coprocessor]
+enable-device = false
+""")
+    assert cfg.raftstore.election_tick == 20
+    assert cfg.coprocessor.enable_device is False
+    cfg.validate()
+    with pytest.raises(ValueError, match="unknown config keys"):
+        TikvConfig.from_toml("[raftstore]\nbogus-key = 1\n")
+    with pytest.raises(ValueError, match="heartbeat_tick"):
+        TikvConfig.from_toml("[raftstore]\nheartbeat-tick = 50\n").validate()
+
+
+def test_online_reconfig_dispatch():
+    ctl = ConfigController(TikvConfig())
+    seen = {}
+    ctl.register("coprocessor", lambda changed: seen.update(changed))
+    diff = ctl.update({"coprocessor.enable_device": False})
+    assert diff == {"coprocessor": {"enable_device": False}}
+    assert seen == {"enable_device": False}
+    assert ctl.config.coprocessor.enable_device is False
+    # invalid updates change nothing
+    with pytest.raises(ValueError):
+        ctl.update({"raftstore.heartbeat_tick": 99})
+    assert ctl.config.raftstore.heartbeat_tick == 2
+
+
+# -- metrics + status server -------------------------------------------------
+
+def test_metrics_and_status_server():
+    from tikv_tpu.server.status_server import StatusServer
+
+    reg = Registry()
+    reg.counter("copr_requests_total", "requests").inc(3, path="device")
+    reg.gauge("regions", "region count").set(5)
+    reg.histogram("req_duration_seconds", "latency").observe(0.004)
+    ctl = ConfigController(TikvConfig())
+    srv = StatusServer(ctl, registry=reg)
+    srv.start()
+    host, port = srv.addr
+    try:
+        body = urllib.request.urlopen(f"http://{host}:{port}/metrics").read().decode()
+        assert 'copr_requests_total{path="device"} 3' in body
+        assert "regions 5" in body
+        assert "req_duration_seconds_bucket" in body
+        assert urllib.request.urlopen(f"http://{host}:{port}/status").read() == b"ok"
+        cfg = json.loads(urllib.request.urlopen(f"http://{host}:{port}/config").read())
+        assert cfg["raftstore"]["election_tick"] == 10
+        # online reconfig over HTTP
+        req = urllib.request.Request(
+            f"http://{host}:{port}/config",
+            data=json.dumps({"coprocessor.block_rows": 1024}).encode(),
+            method="POST",
+        )
+        diff = json.loads(urllib.request.urlopen(req).read())
+        assert diff == {"coprocessor": {"block_rows": 1024}}
+        assert ctl.config.coprocessor.block_rows == 1024
+        # invalid POST rejected
+        req = urllib.request.Request(
+            f"http://{host}:{port}/config",
+            data=json.dumps({"coprocessor.block_rows": 1000}).encode(),  # not pow2
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(req)
+    finally:
+        srv.stop()

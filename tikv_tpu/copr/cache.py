@@ -17,6 +17,7 @@ version (apply index / max commit ts), so any write produces a new key.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -39,6 +40,7 @@ class ColumnBlockCache:
         self.key = key
         self.blocks: list[_Block] = []
         self.filled = False
+        self._mu = threading.Lock()
 
     def add(self, cols, n_valid: int) -> None:
         self.blocks.append(_Block(cols, n_valid))
@@ -54,17 +56,19 @@ class ColumnBlockCache:
         """Per-block device arrays for a plan signature, pinned on first use.
         Bounded per block: each distinct signature pins a full copy, so old
         signatures are dropped LRU-style once _MAX_DEVICE_SIGS accumulate."""
-        hit = block.device.get(sig)
-        if hit is None:
-            hit = build(block)
-            block.device[sig] = hit
+        with self._mu:
+            hit = block.device.get(sig)
+            if hit is not None:
+                # touch for LRU order
+                block.device.pop(sig)
+                block.device[sig] = hit
+                return hit
+        built = build(block)
+        with self._mu:
+            block.device.setdefault(sig, built)
             while len(block.device) > _MAX_DEVICE_SIGS:
                 block.device.pop(next(iter(block.device)))
-        else:
-            # touch for LRU order
-            block.device.pop(sig)
-            block.device[sig] = hit
-        return hit
+            return block.device[sig]
 
 
 class CopCache:
@@ -74,18 +78,20 @@ class CopCache:
         self.max_entries = max_entries
         self._entries: dict = {}
         self._order: list = []
+        self._mu = threading.Lock()
 
     def get_or_create(self, key) -> ColumnBlockCache:
-        e = self._entries.get(key)
-        if e is None:
-            e = ColumnBlockCache(key)
-            self._entries[key] = e
-            self._order.append(key)
-            while len(self._order) > self.max_entries:
-                old = self._order.pop(0)
-                del self._entries[old]
-        else:
-            # LRU touch so hot entries survive cold churn
-            self._order.remove(key)
-            self._order.append(key)
-        return e
+        with self._mu:
+            e = self._entries.get(key)
+            if e is None:
+                e = ColumnBlockCache(key)
+                self._entries[key] = e
+                self._order.append(key)
+                while len(self._order) > self.max_entries:
+                    old = self._order.pop(0)
+                    del self._entries[old]
+            else:
+                # LRU touch so hot entries survive cold churn
+                self._order.remove(key)
+                self._order.append(key)
+            return e

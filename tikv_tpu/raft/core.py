@@ -190,6 +190,8 @@ class RaftNode:
         self._votes: dict[int, bool] = {}
         # pending read-index requests: ctx -> (index, acks)
         self._pending_reads: dict[bytes, tuple[int, set[int]]] = {}
+        # reads deferred until the leader commits in its own term: (ctx, origin)
+        self._deferred_reads: list[tuple[bytes, int | None]] = []
 
         self._ready = Ready()
 
@@ -280,12 +282,22 @@ class RaftNode:
         self._broadcast_append()
         return index
 
+    def _committed_in_term(self) -> bool:
+        """A new leader may hold a commit index from a previous term that
+        trails entries it acked as follower — reads are only safe once an
+        entry of ITS term commits (§6.4; raft-rs requires the same)."""
+        return self.log.term_at(self.commit) == self.term
+
     def read_index(self, ctx: bytes) -> None:
         """Linearizable read point (read_queue.rs): leader confirms leadership
-        via a heartbeat round, then releases the read at commit index."""
+        via a heartbeat round, then releases the read at commit index —
+        deferred until the leader has committed in its own term."""
         if self.role != Role.LEADER:
             if self.leader_id is not None:
                 self._send(Message(MsgType.READ_INDEX, self.id, self.leader_id, self.term, context=ctx))
+            return
+        if not self._committed_in_term():
+            self._deferred_reads.append((ctx, None))
             return
         if self._quorum() == 1:
             self._ready.read_states.append((ctx, self.commit))
@@ -464,6 +476,13 @@ class RaftNode:
             self.commit = candidate
             self._ready.hard_state_changed = True
             self._broadcast_append_commit()
+            if self._deferred_reads and self._committed_in_term():
+                deferred, self._deferred_reads = self._deferred_reads, []
+                for ctx, origin in deferred:
+                    if origin is None:
+                        self.read_index(ctx)
+                    else:
+                        self._serve_remote_read(ctx, origin)
 
     def _broadcast_append_commit(self) -> None:
         for peer in self.voters - {self.id}:
@@ -540,15 +559,21 @@ class RaftNode:
     def _on_read_index(self, m: Message) -> None:
         if self.role != Role.LEADER:
             return
+        self._serve_remote_read(m.context, m.frm)
+
+    def _serve_remote_read(self, ctx: bytes, origin: int) -> None:
+        if not self._committed_in_term():
+            self._deferred_reads.append((ctx, origin))
+            return
         if self._quorum() == 1:
-            self._send(Message(MsgType.READ_INDEX_RESP, self.id, m.frm, self.term, log_index=self.commit, context=m.context))
+            self._send(Message(MsgType.READ_INDEX_RESP, self.id, origin, self.term, log_index=self.commit, context=ctx))
             return
         # piggyback on a heartbeat round keyed by the follower's ctx; remember
         # the origin so the response routes back when quorum acks arrive
-        self._pending_reads[m.context] = (self.commit, {self.id})
+        self._pending_reads[ctx] = (self.commit, {self.id})
         self._read_origins = getattr(self, "_read_origins", {})
-        self._read_origins[m.context] = m.frm
-        self._broadcast_heartbeat(ctx=m.context)
+        self._read_origins[ctx] = origin
+        self._broadcast_heartbeat(ctx=ctx)
 
     def _on_read_index_resp(self, m: Message) -> None:
         self._ready.read_states.append((m.context, m.log_index))

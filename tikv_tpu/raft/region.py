@@ -25,6 +25,15 @@ class RegionEpoch:
 
 @dataclass
 class Region:
+    """Region metadata.
+
+    ``start_key``/``end_key`` are **opaque engine-space keys** (for
+    transactional data that is the memcomparable-encoded user key, ts-free;
+    for raw mode the raw key) — exactly the reference's convention, where
+    boundaries compare against ``origin_key(engine key)`` and are never
+    decoded.  b"" end_key = +inf.
+    """
+
     id: int
     start_key: bytes = b""
     end_key: bytes = b""  # b"" = +inf

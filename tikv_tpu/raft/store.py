@@ -260,23 +260,22 @@ class StorePeer:
         if rd.is_empty():
             return False
         eng = self.store.engine
-        # persist raft log + hard state (PeerStorage)
+        # persist raft log + hard state (PeerStorage: RaftLocalState)
         if rd.entries or rd.hard_state_changed:
             wb = WriteBatch()
             for e in rd.entries:
                 wb.put_cf(CF_RAFT, keys.raft_log_key(self.region.id, e.index), _encode_entry(e))
-            wb.put_cf(
-                CF_RAFT,
-                keys.raft_state_key(self.region.id),
-                codec.encode_u64(self.node.term)
-                + codec.encode_u64(self.node.vote or 0)
-                + codec.encode_u64(self.node.commit),
-            )
+            wb.put_cf(CF_RAFT, keys.raft_state_key(self.region.id), self._encode_raft_state())
             eng.write(wb)
         if rd.snapshot is not None:
             self._apply_snapshot(rd.snapshot)
         for e in rd.committed_entries:
             self._apply_entry(e)
+        if rd.committed_entries:
+            # ApplyState: recovery resumes application after this index
+            eng.put_cf(
+                CF_RAFT, keys.apply_state_key(self.region.id), codec.encode_u64(self.node.applied)
+            )
         for ctx, index in rd.read_states:
             cb = self.pending_reads.pop(ctx, None)
             if cb is not None:
@@ -403,6 +402,16 @@ class StorePeer:
         self.store.create_peer(new_region)
         self.store.on_split(old, new_region)
 
+    def _encode_raft_state(self) -> bytes:
+        n = self.node
+        return (
+            codec.encode_u64(n.term)
+            + codec.encode_u64(n.vote or 0)
+            + codec.encode_u64(n.commit)
+            + codec.encode_u64(n.log.snapshot_index)
+            + codec.encode_u64(n.log.snapshot_term)
+        )
+
     # -- snapshots ---------------------------------------------------------
 
     def _generate_snapshot(self) -> RaftSnapshot:
@@ -446,6 +455,10 @@ class StorePeer:
                 wb.put_cf(cf.decode(), k, v)
         eng.write(wb)
         self.store.persist_region(self.region)
+        wb2 = WriteBatch()
+        wb2.put_cf(CF_RAFT, keys.raft_state_key(self.region.id), self._encode_raft_state())
+        wb2.put_cf(CF_RAFT, keys.apply_state_key(self.region.id), codec.encode_u64(self.node.applied))
+        eng.write(wb2)
 
 
 def encode_region(region: Region) -> bytes:
@@ -491,6 +504,18 @@ def _encode_entry(e: Entry) -> bytes:
     return bytes(out)
 
 
+def _decode_entry(b: bytes) -> Entry:
+    term, off = codec.decode_var_u64(b, 0)
+    index, off = codec.decode_var_u64(b, off)
+    data, off = codec.decode_compact_bytes(b, off)
+    conf = None
+    if b[off] == 1:
+        op, off2 = codec.decode_compact_bytes(b, off + 1)
+        pid, _ = codec.decode_var_u64(b, off2)
+        conf = (op.decode(), pid)
+    return Entry(term, index, data, conf)
+
+
 # ---------------------------------------------------------------------------
 # Store
 # ---------------------------------------------------------------------------
@@ -525,6 +550,47 @@ class Store:
 
     def persist_region(self, region: Region) -> None:
         self.engine.put_cf(CF_RAFT, keys.region_state_key(region.id), encode_region(region))
+
+    def recover(self) -> int:
+        """Rebuild every peer from persisted state after a restart
+        (fsm/store.rs init: scan region states, restore PeerStorage).
+        Returns the number of recovered peers."""
+        snap = self.engine.snapshot()
+        prefix = keys.LOCAL_PREFIX + keys.REGION_META_PREFIX
+        recovered = 0
+        for k, v in snap.scan_cf(CF_RAFT, prefix, prefix[:-1] + bytes([prefix[-1] + 1])):
+            region = decode_region(v)
+            me = region.peer_on_store(self.store_id)
+            if me is None or region.id in self.peers:
+                continue
+            peer = StorePeer(self, region, me.peer_id)
+            node = peer.node
+            state = snap.get_cf(CF_RAFT, keys.raft_state_key(region.id))
+            if state is not None:
+                node.term = codec.decode_u64(state, 0)
+                vote = codec.decode_u64(state, 8)
+                node.vote = vote or None
+                node.commit = codec.decode_u64(state, 16)
+                node.log.snapshot_index = codec.decode_u64(state, 24)
+                node.log.snapshot_term = codec.decode_u64(state, 32)
+                node.log.offset = node.log.snapshot_index + 1
+            applied_raw = snap.get_cf(CF_RAFT, keys.apply_state_key(region.id))
+            applied = codec.decode_u64(applied_raw) if applied_raw else 0
+            log_prefix = keys.region_raft_prefix(region.id) + keys.RAFT_LOG_SUFFIX
+            entries = []
+            for lk, lv in snap.scan_cf(
+                CF_RAFT, log_prefix, log_prefix[:-1] + bytes([log_prefix[-1] + 1])
+            ):
+                e = _decode_entry(lv)
+                if e.index > node.log.snapshot_index:
+                    entries.append(e)
+            entries.sort(key=lambda e: e.index)
+            node.log.entries = entries
+            node.applied = max(applied, node.log.snapshot_index)
+            node.commit = max(node.commit, node.applied)
+            self.peers[region.id] = peer
+            recovered += 1
+        return recovered
 
     # -- routing -----------------------------------------------------------
 

@@ -1,0 +1,132 @@
+"""MVCC garbage collection below the PD-driven safe point.
+
+Re-expression of ``src/server/gc_worker`` (gc_worker.rs:687, gc_manager.rs,
+compaction_filter.rs:156, applied_lock_collector.rs): versions no longer
+visible at the safe point are dropped — the newest PUT at-or-below the safe
+point survives as the read base, DELETEs at the tail become full removals,
+LOCK/ROLLBACK markers below the safe point vanish (protected rollbacks only
+once superseded).  The reference runs this inside RocksDB compaction; here it
+is a range pass over CF_WRITE with the same retention rules, driven by the
+auto-GC manager loop polling PD's safe point.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..storage.engine import CF_DEFAULT, CF_LOCK, CF_WRITE, WriteBatch
+from ..storage.kv import Engine
+from ..storage.txn_types import Key, Write, WriteType, split_ts
+
+
+class GcWorker:
+    def __init__(self, engine: Engine):
+        self.engine = engine
+        self.safe_point = 0
+        self._mu = threading.Lock()
+
+    # -- core GC pass -------------------------------------------------------
+
+    def gc_range(self, start: bytes | None, end: bytes | None, safe_point: int, ctx: dict | None = None) -> dict:
+        """One GC sweep over [start, end) at ``safe_point``. Returns stats."""
+        with self._mu:
+            self.safe_point = max(self.safe_point, safe_point)
+        snap = self.engine.snapshot(ctx)
+        enc_start = Key.from_raw(start).encoded if start else b""
+        enc_end = Key.from_raw(end).encoded if end else None
+        wb = WriteBatch()
+        stats = {"versions_deleted": 0, "keys_deleted": 0, "rollbacks_deleted": 0}
+
+        cur_user: bytes | None = None
+        base_found = False
+        for wkey, wval in snap.scan_cf(CF_WRITE, enc_start, enc_end):
+            user_key, commit_ts = split_ts(wkey)
+            if user_key != cur_user:
+                cur_user = user_key
+                base_found = False
+            write = Write.from_bytes(wval)
+            if commit_ts > safe_point:
+                continue  # still visible to readers at/below safe point
+            if write.write_type in (WriteType.ROLLBACK, WriteType.LOCK):
+                # markers below the safe point carry no data
+                wb.delete_cf(CF_WRITE, wkey)
+                stats["rollbacks_deleted"] += 1
+                continue
+            if not base_found:
+                # the newest PUT/DELETE at-or-below safe point
+                if write.write_type == WriteType.DELETE:
+                    # a deleted tail: the tombstone itself can go
+                    wb.delete_cf(CF_WRITE, wkey)
+                    stats["keys_deleted"] += 1
+                base_found = True
+                continue
+            # older than the base: drop version and its value
+            wb.delete_cf(CF_WRITE, wkey)
+            if write.short_value is None and write.write_type == WriteType.PUT:
+                wb.delete_cf(CF_DEFAULT, user_key + _ts_suffix(write.start_ts))
+            stats["versions_deleted"] += 1
+        if not wb.is_empty():
+            self.engine.write(ctx, wb)
+        return stats
+
+    # -- green GC support (physical lock scan) ------------------------------
+
+    def physical_scan_lock(self, max_ts: int, start: bytes | None = None, limit: int | None = None):
+        """Scan CF_LOCK directly (bypassing leader reads) — applied_lock_collector."""
+        from ..storage.txn_types import Lock
+
+        snap = self.engine.snapshot(None)
+        out = []
+        enc_start = Key.from_raw(start).encoded if start else b""
+        for k, v in snap.scan_cf(CF_LOCK, enc_start, None):
+            lock = Lock.from_bytes(v)
+            if lock.ts <= max_ts:
+                out.append((Key.from_encoded(k).to_raw(), lock))
+                if limit is not None and len(out) >= limit:
+                    break
+        return out
+
+    def unsafe_destroy_range(self, start: bytes, end: bytes, ctx: dict | None = None) -> None:
+        """Drop ALL versions and locks in [start, end) (gc_worker.rs
+        unsafe_destroy_range — used by drop-table)."""
+        enc_start = Key.from_raw(start).encoded
+        enc_end = Key.from_raw(end).encoded
+        wb = WriteBatch()
+        for cf in (CF_DEFAULT, CF_LOCK, CF_WRITE):
+            wb.delete_range_cf(cf, enc_start, enc_end)
+        self.engine.write(ctx, wb)
+
+
+def _ts_suffix(ts: int) -> bytes:
+    from ..util import codec
+
+    return codec.encode_u64_desc(ts)
+
+
+class GcManager:
+    """Auto-GC: polls PD's safe point and sweeps (gc_manager.rs:92,195)."""
+
+    def __init__(self, gc_worker: GcWorker, pd, interval: float = 1.0):
+        self.gc = gc_worker
+        self.pd = pd
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.last_safe_point = 0
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            sp = self.pd.get_gc_safe_point()
+            if sp > self.last_safe_point:
+                self.gc.gc_range(None, None, sp)
+                self.last_safe_point = sp
+            self._stop.wait(self.interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)

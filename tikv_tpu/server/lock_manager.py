@@ -1,0 +1,126 @@
+"""Pessimistic lock waiting + distributed deadlock detection.
+
+Re-expression of ``src/server/lock_manager`` (waiter_manager.rs wait queues
+with timeouts; deadlock.rs detector).  Waiters blocked on a lock register in
+per-key queues; releases (commit/rollback) wake them in order.  The deadlock
+detector maintains the waits-for graph (txn → txn) and rejects an edge that
+would close a cycle, reporting the cycle's hash like the reference's
+``deadlock_key_hash``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+class DeadlockError(Exception):
+    def __init__(self, waiting_txn: int, blocked_on_txn: int, cycle: list[int]):
+        self.waiting_txn = waiting_txn
+        self.blocked_on_txn = blocked_on_txn
+        self.cycle = cycle
+        super().__init__(f"deadlock: txn {waiting_txn} → {blocked_on_txn} closes cycle {cycle}")
+
+
+class DeadlockDetector:
+    """Waits-for graph with cycle check on edge insertion (deadlock.rs).
+
+    In the reference this is a cluster-wide service hosted by region 1's
+    leader; here it is a store-local authority with the same API, callable
+    over the service layer for remote stores.
+    """
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        # waits_for[a] = set of txns a waits on
+        self.waits_for: dict[int, set[int]] = {}
+
+    def detect(self, waiter_ts: int, lock_ts: int) -> None:
+        """Register edge waiter→lock; raise DeadlockError if it closes a cycle."""
+        with self._mu:
+            cycle = self._path(lock_ts, waiter_ts)
+            if cycle is not None:
+                raise DeadlockError(waiter_ts, lock_ts, cycle + [waiter_ts])
+            self.waits_for.setdefault(waiter_ts, set()).add(lock_ts)
+
+    def _path(self, frm: int, to: int) -> list[int] | None:
+        seen = set()
+        stack = [(frm, [frm])]
+        while stack:
+            node, path = stack.pop()
+            if node == to:
+                return path
+            if node in seen:
+                continue
+            seen.add(node)
+            for nxt in self.waits_for.get(node, ()):
+                stack.append((nxt, path + [nxt]))
+        return None
+
+    def clean_up_wait_for(self, waiter_ts: int, lock_ts: int) -> None:
+        with self._mu:
+            edges = self.waits_for.get(waiter_ts)
+            if edges is not None:
+                edges.discard(lock_ts)
+                if not edges:
+                    del self.waits_for[waiter_ts]
+
+    def clean_up(self, txn_ts: int) -> None:
+        with self._mu:
+            self.waits_for.pop(txn_ts, None)
+
+
+@dataclass
+class Waiter:
+    start_ts: int
+    lock_ts: int
+    key: bytes
+    event: threading.Event = field(default_factory=threading.Event)
+    timed_out: bool = False
+
+
+class WaiterManager:
+    """Per-key wait queues with timeouts (waiter_manager.rs)."""
+
+    def __init__(self, detector: DeadlockDetector | None = None, default_timeout: float = 3.0):
+        self.detector = detector or DeadlockDetector()
+        self.default_timeout = default_timeout
+        self._mu = threading.Lock()
+        self._queues: dict[bytes, list[Waiter]] = {}
+
+    def wait_for(self, start_ts: int, lock_ts: int, key: bytes, timeout: float | None = None) -> bool:
+        """Block until the lock on ``key`` is released.  Returns False on
+        timeout.  Raises DeadlockError if waiting would deadlock."""
+        self.detector.detect(start_ts, lock_ts)
+        w = Waiter(start_ts, lock_ts, key)
+        with self._mu:
+            self._queues.setdefault(key, []).append(w)
+        try:
+            ok = w.event.wait(timeout if timeout is not None else self.default_timeout)
+            return ok
+        finally:
+            self.detector.clean_up_wait_for(start_ts, lock_ts)
+            with self._mu:
+                q = self._queues.get(key)
+                if q and w in q:
+                    q.remove(w)
+
+    def wake_up(self, key: bytes, released_ts: int) -> int:
+        """Release waiters on ``key`` whose blocker was ``released_ts``."""
+        with self._mu:
+            q = self._queues.get(key, [])
+            woken = [w for w in q if w.lock_ts == released_ts]
+        for w in woken:
+            w.event.set()
+        self.detector.clean_up(released_ts)
+        return len(woken)
+
+    def wake_up_all(self, released_ts: int) -> int:
+        """Release every waiter blocked on txn ``released_ts`` (any key)."""
+        with self._mu:
+            woken = [w for q in self._queues.values() for w in q if w.lock_ts == released_ts]
+        for w in woken:
+            w.event.set()
+        self.detector.clean_up(released_ts)
+        return len(woken)

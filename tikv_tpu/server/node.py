@@ -106,15 +106,17 @@ class Node:
         if len(ks) <= self.split_threshold_keys:
             return
         split_at = keys.origin_key(ks[len(ks) // 2])
-        # strip any MVCC ts suffix so the split key is a clean user key
+        # strip the MVCC ts suffix ONLY — region boundaries live in the
+        # opaque engine key space (the memcomparable-encoded form for txn
+        # data), never decoded: a raw-decoded boundary would not be
+        # order-consistent with the stored keys (same rule as the reference,
+        # where split-check emits origin_key(engine key) verbatim)
         from ..storage.txn_types import split_ts
-        from ..storage.txn_types import Key as MvccKey
 
         try:
-            enc, _ = split_ts(split_at)
-            split_at = MvccKey.from_encoded(enc).to_raw()
-        except Exception:  # noqa: BLE001 — raw key already
-            pass
+            split_at, _ = split_ts(split_at)
+        except ValueError:
+            pass  # no ts suffix (raw-mode data)
         if not peer.region.contains(split_at) or split_at == peer.region.start_key:
             return
         new_region_id = self.pd.alloc_id()

@@ -111,6 +111,7 @@ class Client:
 
     def __init__(self, host: str, port: int):
         self._sock = socket.create_connection((host, port))
+        self._dead = False
         self._mu = threading.Lock()
         self._next_id = 0
         self._pending: dict[int, threading.Event] = {}
@@ -132,8 +133,10 @@ class Client:
                     ev.set()
         except (ConnectionError, OSError, ValueError):
             with self._mu:
+                self._dead = True
                 for ev in self._pending.values():
                     ev.set()
+                self._pending.clear()
 
     def call(self, method: str, request: dict, timeout: float = 30.0):
         with self._mu:
@@ -145,6 +148,8 @@ class Client:
         if not ev.wait(timeout):
             raise TimeoutError(f"{method} timed out")
         with self._mu:
+            if req_id not in self._results:
+                raise ConnectionError(f"connection lost during {method}")
             return self._results.pop(req_id)
 
     def close(self) -> None:

@@ -1,0 +1,68 @@
+"""HTTP status server: /metrics, /status, /config (GET + POST reconfig).
+
+Re-expression of ``src/server/status_server/mod.rs:720-745``: the operator
+surface — Prometheus exposition, liveness, config inspection, and online
+reconfiguration via POST /config dispatched through the ConfigController.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..util.metrics import REGISTRY
+from ..util.config import ConfigController
+
+
+class StatusServer:
+    def __init__(self, controller: ConfigController | None = None, host="127.0.0.1", port=0, registry=None):
+        self.controller = controller
+        self.registry = registry or REGISTRY
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, body: bytes, ctype="text/plain"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    self._send(200, outer.registry.render().encode())
+                elif self.path == "/status":
+                    self._send(200, b"ok")
+                elif self.path == "/config":
+                    cfg = outer.controller.config.to_dict() if outer.controller else {}
+                    self._send(200, json.dumps(cfg).encode(), "application/json")
+                else:
+                    self._send(404, b"not found")
+
+            def do_POST(self):
+                if self.path != "/config" or outer.controller is None:
+                    self._send(404, b"not found")
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    changes = json.loads(self.rfile.read(n) or b"{}")
+                    diff = outer.controller.update(changes)
+                    self._send(200, json.dumps(diff).encode(), "application/json")
+                except (ValueError, TypeError) as e:
+                    self._send(400, str(e).encode())
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.addr = self._httpd.server_address
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

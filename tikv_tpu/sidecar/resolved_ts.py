@@ -1,0 +1,102 @@
+"""Resolved-ts tracking: the stale-read / CDC watermark.
+
+Re-expression of ``components/resolved_ts`` (resolver.rs:14 ``Resolver``:
+locks_by_key + ts heap; endpoint.rs advance loop): every applied prewrite
+registers its lock, every commit/rollback untracks it, and
+``resolved_ts = max(resolved, min(pending lock ts) - 1 or advance ts)``:
+reads at or below the watermark never block, which is what enables follower
+stale reads and CDC's consistency guarantee.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+
+
+class Resolver:
+    """Per-region lock tracker (resolver.rs)."""
+
+    def __init__(self, region_id: int):
+        self.region_id = region_id
+        self._mu = threading.Lock()
+        self.locks_by_key: dict[bytes, int] = {}
+        self._ts_heap: list[tuple[int, bytes]] = []
+        self.resolved_ts = 0
+
+    def track_lock(self, start_ts: int, key: bytes) -> None:
+        with self._mu:
+            self.locks_by_key[key] = start_ts
+            heapq.heappush(self._ts_heap, (start_ts, key))
+
+    def untrack_lock(self, key: bytes) -> None:
+        with self._mu:
+            self.locks_by_key.pop(key, None)
+
+    def resolve(self, advance_to: int) -> int:
+        """Advance the watermark toward ``advance_to`` (a fresh TSO)."""
+        with self._mu:
+            # drop stale heap heads (already untracked or re-locked newer)
+            while self._ts_heap:
+                ts, key = self._ts_heap[0]
+                if self.locks_by_key.get(key) != ts:
+                    heapq.heappop(self._ts_heap)
+                    continue
+                break
+            if self._ts_heap:
+                min_lock_ts = self._ts_heap[0][0]
+                candidate = min(advance_to, min_lock_ts - 1)
+            else:
+                candidate = advance_to
+            self.resolved_ts = max(self.resolved_ts, candidate)
+            return self.resolved_ts
+
+
+class ResolvedTsEndpoint:
+    """Store-level advance loop over region resolvers (endpoint.rs:247 +
+    advance.rs): observes applied commands, periodically advances every
+    resolver with a fresh TSO from PD."""
+
+    def __init__(self, pd):
+        self.pd = pd
+        self._mu = threading.Lock()
+        self.resolvers: dict[int, Resolver] = {}
+
+    def resolver(self, region_id: int) -> Resolver:
+        with self._mu:
+            r = self.resolvers.get(region_id)
+            if r is None:
+                r = Resolver(region_id)
+                self.resolvers[region_id] = r
+            return r
+
+    def observe_apply(self, store, region, cmd: dict) -> None:
+        """raftstore apply observer: track/untrack locks from data commands."""
+        from ..storage.engine import CF_LOCK
+
+        r = self.resolver(region.id)
+        for op, cf, key, val in cmd.get("ops", ()):
+            if cf != CF_LOCK:
+                continue
+            if op == "put":
+                from ..storage.txn_types import Lock
+
+                try:
+                    lock = Lock.from_bytes(val)
+                except ValueError:
+                    continue
+                r.track_lock(lock.ts, key)
+            elif op == "delete":
+                r.untrack_lock(key)
+
+    def advance_all(self) -> dict[int, int]:
+        ts = self.pd.get_tso()
+        with self._mu:
+            resolvers = list(self.resolvers.values())
+        return {r.region_id: r.resolve(ts) for r in resolvers}
+
+    def min_resolved_ts(self) -> int:
+        with self._mu:
+            if not self.resolvers:
+                return 0
+            return min(r.resolved_ts for r in self.resolvers.values())

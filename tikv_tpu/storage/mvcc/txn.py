@@ -227,8 +227,12 @@ def acquire_pessimistic_lock(
         for cts, w in reader.get_txn_commit_record(key, start_ts):
             if w.write_type == WriteType.ROLLBACK:
                 raise WriteConflictError(key.to_raw(), start_ts, start_ts, cts)
-        if write.write_type == WriteType.PUT:
-            value = reader.load_data(key, write)
+        # LOCK/ROLLBACK markers hide the live version — walk to the newest
+        # PUT/DELETE (same loop as _check_not_exists)
+        while rec is not None and rec[1].write_type not in (WriteType.PUT, WriteType.DELETE):
+            rec = reader.seek_write(key, rec[0] - 1)
+        if rec is not None and rec[1].write_type == WriteType.PUT:
+            value = reader.load_data(key, rec[1])
             if should_not_exist:
                 raise AlreadyExistsError(key.to_raw())
     lock = Lock(LockType.PESSIMISTIC, primary, start_ts, ttl=ttl, for_update_ts=for_update_ts)

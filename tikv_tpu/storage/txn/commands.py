@@ -80,9 +80,10 @@ class Prewrite(Command):
             except (KeyIsLockedError, WriteConflictError, TxnError) as e:
                 errors.append(e)
         if errors:
-            # keys that prewrote fine stay locked (client retries/cleans up),
-            # but report the failure set like the reference's KeyError vec
-            return MvccTxn(self.start_ts), {"errors": errors}
+            # keys that prewrote fine stay locked (the reference persists the
+            # successful locks alongside the KeyError vec; the client retries
+            # or resolves them) — so the txn buffer is NOT discarded
+            return txn, {"errors": errors, "min_commit_ts": min_commit_ts}
         return txn, {"min_commit_ts": min_commit_ts}
 
 

@@ -1,0 +1,166 @@
+"""Configuration system with validation and online reconfiguration.
+
+Re-expression of ``src/config.rs`` (TiKvConfig :2297, ConfigController :3115)
++ ``components/online_config``: a nested dataclass tree loaded from TOML,
+``validate()`` checks, and a ``ConfigController`` that diffs updates and
+dispatches changed sections to registered per-module ConfigManagers — the
+mechanism behind POST /config online reconfig.
+"""
+
+from __future__ import annotations
+
+import threading
+import tomllib
+from dataclasses import asdict, dataclass, field, fields, is_dataclass
+
+
+@dataclass
+class ReadPoolConfig:
+    unified_max_threads: int = 8
+    batch_max_size: int = 1024
+
+
+@dataclass
+class CoprocessorConfig:
+    enable_device: bool = True
+    block_rows: int = 1 << 16
+    region_split_keys: int = 960000
+    region_max_keys: int = 1440000
+    cache_entries: int = 64
+
+
+@dataclass
+class RaftstoreConfig:
+    election_tick: int = 10
+    heartbeat_tick: int = 2
+    tick_interval_ms: int = 50
+    region_split_check_diff: int = 8
+
+
+@dataclass
+class StorageConfig:
+    scheduler_concurrency: int = 256
+    scheduler_worker_pool_size: int = 4
+    ttl_check_interval_s: int = 60
+
+
+@dataclass
+class GcConfig:
+    batch_keys: int = 512
+    auto_gc_interval_s: float = 1.0
+
+
+@dataclass
+class ServerConfig:
+    addr: str = "127.0.0.1:20160"
+    grpc_concurrency: int = 8
+    status_addr: str = "127.0.0.1:20180"
+
+
+@dataclass
+class TikvConfig:
+    server: ServerConfig = field(default_factory=ServerConfig)
+    raftstore: RaftstoreConfig = field(default_factory=RaftstoreConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+    coprocessor: CoprocessorConfig = field(default_factory=CoprocessorConfig)
+    readpool: ReadPoolConfig = field(default_factory=ReadPoolConfig)
+    gc: GcConfig = field(default_factory=GcConfig)
+
+    def validate(self) -> None:
+        if self.raftstore.heartbeat_tick >= self.raftstore.election_tick:
+            raise ValueError("heartbeat_tick must be < election_tick")
+        if self.coprocessor.block_rows <= 0 or self.coprocessor.block_rows & (self.coprocessor.block_rows - 1):
+            raise ValueError("coprocessor.block_rows must be a power of two")
+        if self.storage.scheduler_concurrency <= 0:
+            raise ValueError("storage.scheduler_concurrency must be positive")
+        if self.coprocessor.region_split_keys > self.coprocessor.region_max_keys:
+            raise ValueError("region_split_keys must be <= region_max_keys")
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict, strict: bool = True) -> "TikvConfig":
+        cfg = cls()
+        unknown: list[str] = []
+        _merge(cfg, d, unknown, "")
+        if strict and unknown:
+            raise ValueError(f"unknown config keys: {unknown}")
+        return cfg
+
+    @classmethod
+    def from_toml(cls, text: str, strict: bool = True) -> "TikvConfig":
+        return cls.from_dict(tomllib.loads(text), strict)
+
+
+def _merge(obj, d: dict, unknown: list[str], prefix: str) -> None:
+    names = {f.name: f for f in fields(obj)}
+    for k, v in d.items():
+        key = k.replace("-", "_")
+        if key not in names:
+            unknown.append(prefix + k)
+            continue
+        cur = getattr(obj, key)
+        if is_dataclass(cur):
+            if not isinstance(v, dict):
+                unknown.append(prefix + k)
+                continue
+            _merge(cur, v, unknown, prefix + k + ".")
+        else:
+            setattr(obj, key, v)
+
+
+class ConfigController:
+    """Online reconfig dispatch (config.rs:3115): diff an update against the
+    current config and notify each module whose section changed."""
+
+    def __init__(self, config: TikvConfig):
+        self._mu = threading.Lock()
+        self.config = config
+        self._managers: dict[str, callable] = {}
+
+    def register(self, section: str, on_change) -> None:
+        """on_change(changed: dict) is called with the section's changed keys."""
+        self._managers[section] = on_change
+
+    def update(self, changes: dict) -> dict:
+        """changes: {"section.key": value} or nested dicts. Returns the diff
+        applied.  Validation runs on a copy first — bad updates change nothing."""
+        with self._mu:
+            nested: dict = {}
+            for k, v in changes.items():
+                if isinstance(v, dict):
+                    nested.setdefault(k, {}).update(v)
+                else:
+                    sect, _, key = k.partition(".")
+                    if not key:
+                        raise ValueError(f"not a section.key path: {k}")
+                    nested.setdefault(sect, {})[key] = v
+            candidate = TikvConfig.from_dict(self.config.to_dict(), strict=False)
+            _merge_known(candidate, nested)
+            candidate.validate()
+            diff = _diff(self.config.to_dict(), candidate.to_dict())
+            self.config = candidate
+            for section, changed in diff.items():
+                cb = self._managers.get(section)
+                if cb is not None:
+                    cb(changed)
+            return diff
+
+
+def _merge_known(cfg: TikvConfig, nested: dict) -> None:
+    unknown: list[str] = []
+    _merge(cfg, nested, unknown, "")
+    if unknown:
+        raise ValueError(f"unknown config keys: {unknown}")
+
+
+def _diff(old: dict, new: dict) -> dict:
+    out: dict = {}
+    for sect, vals in new.items():
+        if not isinstance(vals, dict):
+            continue
+        changed = {k: v for k, v in vals.items() if old.get(sect, {}).get(k) != v}
+        if changed:
+            out[sect] = changed
+    return out

@@ -1,0 +1,126 @@
+"""Metrics registry with Prometheus text exposition.
+
+Re-expression of the reference's prometheus-static-metric usage (every module
+has a metrics.rs; served at /metrics by the status server): counters, gauges,
+and histograms with labels, rendered in the Prometheus text format.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_DEFAULT_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10)
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, kind: str):
+        self.name = name
+        self.help = help_
+        self.kind = kind
+        self._mu = threading.Lock()
+
+
+class Counter(_Metric):
+    def __init__(self, name, help_=""):
+        super().__init__(name, help_, "counter")
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, value: float = 1, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._mu:
+            self._values[key] = self._values.get(key, 0) + value
+
+    def get(self, **labels) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0)
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        for key, v in sorted(self._values.items()):
+            lines.append(f"{self.name}{_fmt_labels(key)} {v}")
+        if not self._values:
+            lines.append(f"{self.name} 0")
+        return "\n".join(lines)
+
+
+class Gauge(Counter):
+    def __init__(self, name, help_=""):
+        super().__init__(name, help_)
+        self.kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._mu:
+            self._values[key] = value
+
+
+class Histogram(_Metric):
+    def __init__(self, name, help_="", buckets=_DEFAULT_BUCKETS):
+        super().__init__(name, help_, "histogram")
+        self.buckets = tuple(buckets)
+        self._counts: dict[tuple, list[int]] = {}
+        self._sum: dict[tuple, float] = {}
+        self._n: dict[tuple, int] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._mu:
+            counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            self._sum[key] = self._sum.get(key, 0) + value
+            self._n[key] = self._n.get(key, 0) + 1
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        for key, counts in sorted(self._counts.items()):
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += counts[i]
+                lines.append(f'{self.name}_bucket{_fmt_labels(key, le=str(b))} {cum}')
+            cum += counts[-1]
+            lines.append(f'{self.name}_bucket{_fmt_labels(key, le="+Inf")} {cum}')
+            lines.append(f"{self.name}_sum{_fmt_labels(key)} {self._sum[key]}")
+            lines.append(f"{self.name}_count{_fmt_labels(key)} {self._n[key]}")
+        return "\n".join(lines)
+
+
+def _fmt_labels(key: tuple, **extra) -> str:
+    items = list(key) + sorted(extra.items())
+    if not items:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in items)
+    return "{" + inner + "}"
+
+
+class Registry:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get_or_create(name, lambda: Counter(name, help_))
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get_or_create(name, lambda: Gauge(name, help_))
+
+    def histogram(self, name: str, help_: str = "", buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(name, lambda: Histogram(name, help_, buckets))
+
+    def _get_or_create(self, name, factory):
+        with self._mu:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            return m
+
+    def render(self) -> str:
+        with self._mu:
+            return "\n".join(m.render() for m in self._metrics.values()) + "\n"
+
+
+REGISTRY = Registry()
