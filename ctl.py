@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""tpu-tikv-ctl — ops CLI (the reference's cmd/tikv-ctl re-expression).
+
+Operates on a live store's TCP endpoint (``--addr``) for KV/raw commands, or
+directly on persisted engine state for offline inspection (debug commands run
+against a store process in this build's in-process harnesses; offline mode
+takes over once the native engine lands).
+
+    ctl.py --addr HOST:PORT raw-get <key>
+    ctl.py --addr HOST:PORT raw-put <key> <value>
+    ctl.py --addr HOST:PORT raw-scan [--start S] [--limit N]
+    ctl.py --addr HOST:PORT mvcc <key> --version TS --region R
+    ctl.py --addr HOST:PORT scan-lock --max-ts TS
+    ctl.py --addr HOST:PORT resolve-lock --start-ts TS [--commit-ts TS]
+    ctl.py --status ADDR metrics|config
+    ctl.py --status ADDR reconfig section.key=value ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+from tikv_tpu.server.server import Client
+
+
+def _client(addr: str) -> Client:
+    host, port = addr.rsplit(":", 1)
+    return Client(host, int(port))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpu-tikv-ctl")
+    p.add_argument("--addr", help="store RPC address host:port")
+    p.add_argument("--status", help="status server address host:port")
+    p.add_argument("--region", type=int, default=1)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    for name in ("raw-get", "mvcc"):
+        sp = sub.add_parser(name)
+        sp.add_argument("key")
+        sp.add_argument("--version", type=int, default=None)
+    sp = sub.add_parser("raw-put")
+    sp.add_argument("key")
+    sp.add_argument("value")
+    sp = sub.add_parser("raw-scan")
+    sp.add_argument("--start", default="")
+    sp.add_argument("--limit", type=int, default=30)
+    sp = sub.add_parser("scan-lock")
+    sp.add_argument("--max-ts", type=int, default=2**63)
+    sp = sub.add_parser("resolve-lock")
+    sp.add_argument("--start-ts", type=int, required=True)
+    sp.add_argument("--commit-ts", type=int, default=0)
+    sub.add_parser("metrics")
+    sub.add_parser("config")
+    sp = sub.add_parser("reconfig")
+    sp.add_argument("changes", nargs="+", help="section.key=value")
+
+    args = p.parse_args(argv)
+    ctx = {"region_id": args.region}
+
+    if args.cmd in ("metrics", "config", "reconfig"):
+        if not args.status:
+            print("--status required", file=sys.stderr)
+            return 2
+        base = f"http://{args.status}"
+        if args.cmd == "metrics":
+            print(urllib.request.urlopen(base + "/metrics").read().decode())
+        elif args.cmd == "config":
+            print(json.dumps(json.loads(urllib.request.urlopen(base + "/config").read()), indent=2))
+        else:
+            changes = {}
+            for ch in args.changes:
+                k, _, v = ch.partition("=")
+                try:
+                    v = json.loads(v)
+                except json.JSONDecodeError:
+                    pass
+                changes[k] = v
+            req = urllib.request.Request(base + "/config", data=json.dumps(changes).encode(), method="POST")
+            try:
+                print(urllib.request.urlopen(req).read().decode())
+            except urllib.error.HTTPError as e:
+                print(f"reconfig rejected: {e.read().decode()}", file=sys.stderr)
+                return 1
+        return 0
+
+    if not args.addr:
+        print("--addr required", file=sys.stderr)
+        return 2
+    c = _client(args.addr)
+    try:
+        if args.cmd == "raw-get":
+            r = c.call("raw_get", {"key": args.key.encode(), "context": ctx})
+        elif args.cmd == "raw-put":
+            r = c.call("raw_put", {"key": args.key.encode(), "value": args.value.encode(), "context": ctx})
+        elif args.cmd == "raw-scan":
+            r = c.call("raw_scan", {"start_key": args.start.encode(), "limit": args.limit, "context": ctx})
+        elif args.cmd == "mvcc":
+            r = c.call("kv_get", {"key": args.key.encode(), "version": args.version or 2**63, "context": ctx})
+        elif args.cmd == "scan-lock":
+            r = c.call("kv_scan_lock", {"max_version": args.max_ts, "context": ctx})
+        elif args.cmd == "resolve-lock":
+            r = c.call(
+                "kv_resolve_lock",
+                {"start_version": args.start_ts, "commit_version": args.commit_ts, "context": ctx},
+            )
+        else:
+            raise AssertionError(args.cmd)
+        print(json.dumps(r, default=lambda b: b.decode("utf8", "replace") if isinstance(b, bytes) else str(b), indent=2))
+        return 0 if "error" not in r else 1
+    finally:
+        c.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -316,3 +316,83 @@ def test_metrics_and_status_server():
             urllib.request.urlopen(req)
     finally:
         srv.stop()
+
+
+# -- debugger + ctl ----------------------------------------------------------
+
+def test_debugger_inspection():
+    from tikv_tpu.raft.cluster import FIRST_REGION_ID, Cluster
+    from tikv_tpu.server.debug import Debugger
+
+    cluster = Cluster(3)
+    cluster.run()
+    leader = cluster.wait_leader(FIRST_REGION_ID)
+    store = Storage(engine=cluster.raftkv(leader.store.store_id))
+    ctx = {"region_id": FIRST_REGION_ID}
+    put_ctx = lambda k, v, s, c: (
+        store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(k), v)], k, s), ctx),
+        store.sched_txn_command(Commit([Key.from_raw(k)], s, c), ctx),
+    )
+    put_ctx(b"dk", b"dv", 10, 20)
+    store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"locked"), b"x")], b"locked", 30), ctx)
+
+    dbg = Debugger(leader.store.engine)
+    assert dbg.all_regions() == [FIRST_REGION_ID]
+    info = dbg.region_info(FIRST_REGION_ID)
+    assert info["region"]["id"] == FIRST_REGION_ID
+    assert len(info["region"]["peers"]) == 3
+    assert info["apply_state"]["applied_index"] > 0
+    size = dbg.region_size(FIRST_REGION_ID)
+    assert size["write"]["keys"] == 1 and size["lock"]["keys"] == 1
+    mvcc = dbg.scan_mvcc()
+    assert mvcc[0]["commit_ts"] == 20 and mvcc[0]["type"] == "PUT"
+    locks = dbg.scan_locks()
+    assert locks[0]["ts"] == 30
+    log = dbg.raft_log(FIRST_REGION_ID, info["apply_state"]["applied_index"])
+    assert log is not None and "cmd" in log
+    assert dbg.bad_regions() == []
+
+
+def test_ctl_cli_over_live_store():
+    import io
+    from contextlib import redirect_stdout
+
+    from tikv_tpu.copr.endpoint import Endpoint
+    from tikv_tpu.pd.client import MockPd
+    from tikv_tpu.raft.raftkv import RaftKv
+    from tikv_tpu.raft.store import ChannelTransport
+    from tikv_tpu.server.node import Node
+    from tikv_tpu.server.server import Server
+    from tikv_tpu.server.service import KvService
+
+    import sys, os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import ctl
+
+    pd = MockPd()
+    transport = ChannelTransport()
+    node = Node(pd, transport)
+    transport.register(node.store)
+    node.try_bootstrap_cluster([node.store_id])
+    node.create_region_peers()
+    peer = node.store.peers[1]
+    peer.node.campaign()
+    node.pump()
+    node.start()
+    service = KvService(Storage(engine=RaftKv(node.store)), None)
+    server = Server(service)
+    server.start()
+    addr = f"{server.addr[0]}:{server.addr[1]}"
+    try:
+        assert ctl.main(["--addr", addr, "raw-put", "ck", "cv"]) == 0
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            assert ctl.main(["--addr", addr, "raw-get", "ck"]) == 0
+        assert json.loads(buf.getvalue())["value"] == "cv"
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            assert ctl.main(["--addr", addr, "raw-scan"]) == 0
+        assert len(json.loads(buf.getvalue())["kvs"]) == 1
+    finally:
+        server.stop()
+        node.stop()

@@ -218,6 +218,10 @@ class RaftNode:
         self.leader_id = leader
         self._elapsed = 0
         self._randomized_timeout = self._rand_timeout()
+        # a deposed leader must not serve (or later flush) reads it queued —
+        # callers time out and retry against the new leader
+        self._deferred_reads.clear()
+        self._pending_reads.clear()
 
     def _become_candidate(self) -> None:
         self.term += 1

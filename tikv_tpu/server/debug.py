@@ -1,0 +1,154 @@
+"""Debugger: offline/online store inspection.
+
+Re-expression of ``src/server/debug.rs:120`` (``Debugger``: get/raft_log/
+region_info/region_size/scan_mvcc/compact/bad_regions/recover) — the engine
+backing ``tikv-ctl`` and the Debug gRPC service.
+"""
+
+from __future__ import annotations
+
+from ..storage.engine import CF_DEFAULT, CF_LOCK, CF_RAFT, CF_WRITE, KvEngine
+from ..storage.txn_types import Key, Lock, Write, split_ts
+from ..util import codec, keys
+
+
+class Debugger:
+    def __init__(self, engine: KvEngine):
+        self.engine = engine
+
+    def get(self, cf: str, raw_key: bytes) -> bytes | None:
+        return self.engine.get_cf(cf, keys.data_key(raw_key))
+
+    def region_info(self, region_id: int) -> dict | None:
+        from ..raft.store import decode_region
+
+        snap = self.engine.snapshot()
+        state = snap.get_cf(CF_RAFT, keys.region_state_key(region_id))
+        if state is None:
+            return None
+        region = decode_region(state)
+        raft_state = snap.get_cf(CF_RAFT, keys.raft_state_key(region_id))
+        apply_raw = snap.get_cf(CF_RAFT, keys.apply_state_key(region_id))
+        info = {
+            "region": {
+                "id": region.id,
+                "start_key": region.start_key.hex(),
+                "end_key": region.end_key.hex(),
+                "epoch": (region.epoch.conf_ver, region.epoch.version),
+                "peers": [(p.peer_id, p.store_id) for p in region.peers],
+            }
+        }
+        if raft_state is not None:
+            info["raft_state"] = {
+                "term": codec.decode_u64(raft_state, 0),
+                "vote": codec.decode_u64(raft_state, 8),
+                "commit": codec.decode_u64(raft_state, 16),
+            }
+        if apply_raw is not None:
+            info["apply_state"] = {"applied_index": codec.decode_u64(apply_raw)}
+        return info
+
+    def all_regions(self) -> list[int]:
+        snap = self.engine.snapshot()
+        prefix = keys.LOCAL_PREFIX + keys.REGION_META_PREFIX
+        out = []
+        for k, _ in snap.scan_cf(CF_RAFT, prefix, prefix[:-1] + bytes([prefix[-1] + 1])):
+            out.append(codec.decode_u64(k, 2))
+        return out
+
+    def raft_log(self, region_id: int, index: int) -> dict | None:
+        from ..raft.store import _decode_entry, decode_cmd
+
+        raw = self.engine.get_cf(CF_RAFT, keys.raft_log_key(region_id, index))
+        if raw is None:
+            return None
+        e = _decode_entry(raw)
+        out = {"term": e.term, "index": e.index, "conf_change": e.conf_change}
+        if e.data:
+            try:
+                out["cmd"] = decode_cmd(e.data)
+            except (ValueError, KeyError, IndexError):
+                out["data"] = e.data.hex()
+        return out
+
+    def region_size(self, region_id: int) -> dict | None:
+        from ..raft.store import decode_region
+
+        state = self.engine.get_cf(CF_RAFT, keys.region_state_key(region_id))
+        if state is None:
+            return None
+        region = decode_region(state)
+        snap = self.engine.snapshot()
+        start = keys.data_key(region.start_key)
+        end = keys.data_end_key(region.end_key)
+        out = {}
+        for cf in (CF_DEFAULT, CF_LOCK, CF_WRITE):
+            n = size = 0
+            for k, v in snap.scan_cf(cf, start, end):
+                n += 1
+                size += len(k) + len(v)
+            out[cf] = {"keys": n, "bytes": size}
+        return out
+
+    def scan_mvcc(self, start: bytes | None = None, end: bytes | None = None, limit: int = 100) -> list[dict]:
+        """Every version of every key in range — the recover-mvcc view."""
+        snap = self.engine.snapshot()
+        enc_start = keys.data_key(Key.from_raw(start).encoded) if start else keys.DATA_MIN_KEY
+        enc_end = keys.data_key(Key.from_raw(end).encoded) if end else keys.DATA_MAX_KEY
+        out: list[dict] = []
+        for k, v in snap.scan_cf(CF_WRITE, enc_start, enc_end, limit=limit):
+            user_enc, commit_ts = split_ts(keys.origin_key(k))
+            w = Write.from_bytes(v)
+            out.append(
+                {
+                    "key": Key.from_encoded(user_enc).to_raw().hex(),
+                    "commit_ts": commit_ts,
+                    "start_ts": w.start_ts,
+                    "type": w.write_type.name,
+                    "short_value": w.short_value.hex() if w.short_value else None,
+                }
+            )
+        return out
+
+    def scan_locks(self, limit: int = 100) -> list[dict]:
+        snap = self.engine.snapshot()
+        out = []
+        for k, v in snap.scan_cf(CF_LOCK, keys.DATA_MIN_KEY, keys.DATA_MAX_KEY, limit=limit):
+            lock = Lock.from_bytes(v)
+            out.append(
+                {
+                    "key": Key.from_encoded(keys.origin_key(k)).to_raw().hex(),
+                    "ts": lock.ts,
+                    "type": lock.lock_type.name,
+                    "primary": lock.primary.hex(),
+                    "ttl": lock.ttl,
+                }
+            )
+        return out
+
+    def bad_regions(self) -> list[tuple[int, str]]:
+        """Regions whose persisted state fails sanity checks (debug.rs bad_regions)."""
+        from ..raft.store import decode_region
+
+        bad = []
+        snap = self.engine.snapshot()
+        prefix = keys.LOCAL_PREFIX + keys.REGION_META_PREFIX
+        for k, v in snap.scan_cf(CF_RAFT, prefix, prefix[:-1] + bytes([prefix[-1] + 1])):
+            rid = codec.decode_u64(k, 2)
+            try:
+                region = decode_region(v)
+            except (ValueError, IndexError) as e:
+                bad.append((rid, f"corrupt region state: {e}"))
+                continue
+            if region.end_key and region.start_key >= region.end_key:
+                bad.append((rid, "empty key range"))
+            if not region.peers:
+                bad.append((rid, "no peers"))
+            raft_state = snap.get_cf(CF_RAFT, keys.raft_state_key(rid))
+            apply_raw = snap.get_cf(CF_RAFT, keys.apply_state_key(rid))
+            if raft_state is not None and apply_raw is not None:
+                commit = codec.decode_u64(raft_state, 16)
+                applied = codec.decode_u64(apply_raw)
+                if applied > commit:
+                    bad.append((rid, f"applied {applied} > commit {commit}"))
+        return bad

@@ -16,7 +16,7 @@ import threading
 
 from ..storage.engine import CF_DEFAULT, CF_LOCK, CF_WRITE, WriteBatch
 from ..storage.kv import Engine
-from ..storage.txn_types import Key, Write, WriteType, split_ts
+from ..storage.txn_types import Key, Write, WriteType, append_ts, split_ts
 
 
 class GcWorker:
@@ -63,7 +63,7 @@ class GcWorker:
             # older than the base: drop version and its value
             wb.delete_cf(CF_WRITE, wkey)
             if write.short_value is None and write.write_type == WriteType.PUT:
-                wb.delete_cf(CF_DEFAULT, user_key + _ts_suffix(write.start_ts))
+                wb.delete_cf(CF_DEFAULT, append_ts(user_key, write.start_ts))
             stats["versions_deleted"] += 1
         if not wb.is_empty():
             self.engine.write(ctx, wb)
@@ -97,12 +97,6 @@ class GcWorker:
         self.engine.write(ctx, wb)
 
 
-def _ts_suffix(ts: int) -> bytes:
-    from ..util import codec
-
-    return codec.encode_u64_desc(ts)
-
-
 class GcManager:
     """Auto-GC: polls PD's safe point and sweeps (gc_manager.rs:92,195)."""
 
@@ -120,10 +114,13 @@ class GcManager:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
-            sp = self.pd.get_gc_safe_point()
-            if sp > self.last_safe_point:
-                self.gc.gc_range(None, None, sp)
-                self.last_safe_point = sp
+            try:
+                sp = self.pd.get_gc_safe_point()
+                if sp > self.last_safe_point:
+                    self.gc.gc_range(None, None, sp)
+                    self.last_safe_point = sp
+            except Exception:  # noqa: BLE001 — transient PD/leader errors must
+                pass  # not kill auto-GC; next poll retries (gc_manager.rs)
             self._stop.wait(self.interval)
 
     def stop(self) -> None:

@@ -140,6 +140,8 @@ class Client:
 
     def call(self, method: str, request: dict, timeout: float = 30.0):
         with self._mu:
+            if self._dead:
+                raise ConnectionError("connection is closed")
             self._next_id += 1
             req_id = self._next_id
             ev = threading.Event()

@@ -60,7 +60,9 @@ class CdcDelegate:
 
     def on_commit(self, key: bytes, write: Write, commit_ts: int) -> None:
         ent = self.pending.pop((key, write.start_ts), None)
-        if write.write_type == WriteType.ROLLBACK:
+        if write.write_type in (WriteType.ROLLBACK, WriteType.LOCK):
+            # LOCK records come from lock-only/pessimistic commits — no data
+            # change, so no event (delegate.rs filters them the same way)
             return
         if ent is None:
             # commit without observed prewrite (e.g. subscribed mid-txn)

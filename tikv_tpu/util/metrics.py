@@ -35,9 +35,11 @@ class Counter(_Metric):
 
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
-        for key, v in sorted(self._values.items()):
+        with self._mu:
+            items = sorted(self._values.items())
+        for key, v in items:
             lines.append(f"{self.name}{_fmt_labels(key)} {v}")
-        if not self._values:
+        if not items:
             lines.append(f"{self.name} 0")
         return "\n".join(lines)
 
@@ -76,15 +78,20 @@ class Histogram(_Metric):
 
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
-        for key, counts in sorted(self._counts.items()):
+        with self._mu:
+            snapshot = [
+                (key, list(counts), self._sum[key], self._n[key])
+                for key, counts in sorted(self._counts.items())
+            ]
+        for key, counts, _s, _n in snapshot:
             cum = 0
             for i, b in enumerate(self.buckets):
                 cum += counts[i]
                 lines.append(f'{self.name}_bucket{_fmt_labels(key, le=str(b))} {cum}')
             cum += counts[-1]
             lines.append(f'{self.name}_bucket{_fmt_labels(key, le="+Inf")} {cum}')
-            lines.append(f"{self.name}_sum{_fmt_labels(key)} {self._sum[key]}")
-            lines.append(f"{self.name}_count{_fmt_labels(key)} {self._n[key]}")
+            lines.append(f"{self.name}_sum{_fmt_labels(key)} {_s}")
+            lines.append(f"{self.name}_count{_fmt_labels(key)} {_n}")
         return "\n".join(lines)
 
 
