@@ -140,7 +140,12 @@ def bench_mvcc_validation(n=200_000):
     from tikv_tpu.storage.txn_types import Key, Write, WriteType
 
     kvs = build_kvs(n, seed=3)
-    eng = BTreeEngine()
+    try:
+        from tikv_tpu.native.engine import NativeEngine, native_available
+
+        eng = NativeEngine() if native_available() else BTreeEngine()
+    except ImportError:
+        eng = BTreeEngine()
     items = []
     for rk, v in kvs:
         k = Key.from_raw(rk)

@@ -129,3 +129,62 @@ def test_bulk_load():
     engine.bulk_load(CF_DEFAULT, items)
     keys = [k for k, _ in engine.scan_cf(CF_DEFAULT, b"", None)]
     assert keys == [bytes([i]) for i in range(5)] + [b"m"]
+
+
+def test_native_engine_full_stack():
+    """The native engine drops in under MVCC + txn + coprocessor unchanged."""
+    pytest.importorskip("tikv_tpu.native.engine")
+    from tikv_tpu.native.engine import NativeEngine, native_available
+
+    if not native_available():
+        pytest.skip("native engine unavailable")
+    import sys, os
+    sys.path.insert(0, os.path.dirname(__file__))
+    from copr_fixtures import PRODUCT_COLUMNS, TABLE_ID, product_kvs
+    from tikv_tpu.copr.dag import BatchExecutorsRunner, DagRequest, TableScan
+    from tikv_tpu.copr.executors import MvccScanSource
+    from tikv_tpu.copr.mvcc_batch import MvccBatchScanSource
+    from tikv_tpu.copr.table import record_range
+    from tikv_tpu.storage.kv import LocalEngine
+    from tikv_tpu.storage.storage import Storage
+    from tikv_tpu.storage.txn.commands import Commit, Prewrite
+    from tikv_tpu.storage.txn_types import Key, Mutation
+
+    store = Storage(engine=LocalEngine(NativeEngine()))
+    for i, (rk, val) in enumerate(product_kvs()):
+        ts = 10 + 2 * i
+        r = store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(rk), val)], rk, ts))
+        assert "errors" not in r
+        store.sched_txn_command(Commit([Key.from_raw(rk)], ts, ts + 1))
+    assert len(store.scan(b"", None, None, 100)) == 6
+    snap = store.engine.snapshot(None)
+    dag = DagRequest(executors=[TableScan(TABLE_ID, PRODUCT_COLUMNS)])
+    r1 = BatchExecutorsRunner(dag, MvccScanSource(snap, 100, [record_range(TABLE_ID)])).handle_request()
+    assert len(r1.iter_rows()) == 6
+    r2 = BatchExecutorsRunner(
+        DagRequest(executors=[TableScan(TABLE_ID, PRODUCT_COLUMNS)]),
+        MvccBatchScanSource(snap, 100, [record_range(TABLE_ID)]),
+    ).handle_request()
+    assert r2.encode() == r1.encode()
+
+
+def test_native_engine_snapshot_sequence_semantics():
+    pytest.importorskip("tikv_tpu.native.engine")
+    from tikv_tpu.native.engine import NativeEngine, native_available
+
+    if not native_available():
+        pytest.skip("native engine unavailable")
+    eng = NativeEngine()
+    eng.put_cf(CF_DEFAULT, b"k", b"v1")
+    s1 = eng.snapshot()
+    eng.put_cf(CF_DEFAULT, b"k", b"v2")
+    s2 = eng.snapshot()
+    eng.delete_cf(CF_DEFAULT, b"k")
+    assert s1.get_cf(CF_DEFAULT, b"k") == b"v1"
+    assert s2.get_cf(CF_DEFAULT, b"k") == b"v2"
+    assert eng.get(b"k") is None
+    s1.release()
+    s2.release()
+    # after releasing snapshots, later writes compact old versions away
+    eng.put_cf(CF_DEFAULT, b"k", b"v3")
+    assert eng.get(b"k") == b"v3"

@@ -94,3 +94,29 @@ def test_multiple_ranges():
     b = drain(MvccBatchScanSource(eng.snapshot(), 200, ranges))
     assert a == b
     assert len(a[0]) == 4  # handles 1,2,5,6
+
+
+def test_native_snapshot_fast_path_identical():
+    """MvccBatchScanSource over a native snapshot must match the generic path."""
+    pytest.importorskip("tikv_tpu.native.engine")
+    from tikv_tpu.native.engine import NativeEngine, native_available
+
+    if not native_available():
+        pytest.skip("native engine unavailable")
+    from tikv_tpu.storage.engine import CF_WRITE
+    from tikv_tpu.storage.txn_types import Key, Write, WriteType
+
+    rng = record_range(TABLE_ID)
+    nat = NativeEngine()
+    py = BTreeEngine()
+    for h in range(500):
+        k = Key.from_raw(record_key(TABLE_ID, h))
+        rec = (k.append_ts(20).encoded, Write(WriteType.PUT, 10, short_value=b"val%03d" % h).to_bytes())
+        for eng in (nat, py):
+            eng.put_cf(CF_WRITE, *rec)
+    a = drain(MvccBatchScanSource(nat.snapshot(), 100, [rng]))
+    b = drain(MvccBatchScanSource(py.snapshot(), 100, [rng]))
+    assert a == b
+    assert len(a[0]) == 500
+    # below the commit ts: both empty
+    assert drain(MvccBatchScanSource(nat.snapshot(), 5, [rng])) == ([], [])

@@ -234,3 +234,78 @@ def test_store_recovery_from_persisted_state(cluster):
     cluster.must_put(b"r3", b"v3")
     cluster.tick(3)
     assert cluster.get_on_store(victim_id, b"r3") == b"v3"
+
+
+def test_merge_regions(cluster):
+    """Split then merge back: data survives, routing heals, source dies."""
+    for k, v in [(b"a", b"1"), (b"m", b"2"), (b"z", b"3")]:
+        cluster.must_put(k, v)
+    right_id = cluster.split_region(FIRST_REGION_ID, b"m")
+    cluster.must_put(b"q", b"4")
+    cluster.merge_regions(FIRST_REGION_ID, right_id)
+    # all keys route to the merged region and read back
+    for k, v in [(b"a", b"1"), (b"m", b"2"), (b"q", b"4"), (b"z", b"3")]:
+        assert cluster.region_for_key(k) == FIRST_REGION_ID
+        assert cluster.must_get(k) == v
+    # source peers destroyed everywhere
+    for s in cluster.stores.values():
+        assert right_id not in s.peers
+    # merged region keeps accepting writes
+    cluster.must_put(b"new", b"5")
+    assert cluster.must_get(b"new") == b"5"
+
+
+def test_merging_region_rejects_writes(cluster):
+    import threading
+
+    right_id = cluster.split_region(FIRST_REGION_ID, b"m")
+    source = cluster.wait_leader(right_id)
+    cmd = {
+        "epoch": (source.region.epoch.conf_ver, source.region.epoch.version),
+        "ops": [],
+        "admin": ("prepare_merge", FIRST_REGION_ID),
+    }
+    cluster._run_admin(source, cmd)
+    res, done = [], threading.Event()
+    source.propose_cmd(
+        {"epoch": (source.region.epoch.conf_ver, source.region.epoch.version),
+         "ops": [("put", "default", b"x", b"y")]},
+        lambda r: (res.append(r), done.set()),
+    )
+    while not done.is_set():
+        cluster.process()
+    assert isinstance(res[0], EpochError)
+
+
+def test_lease_read_fast_path(cluster):
+    """After quorum heartbeats the leader serves reads without ReadIndex."""
+    cluster.must_put(b"k", b"v")
+    leader = cluster.wait_leader(FIRST_REGION_ID)
+    cluster.tick(3)  # heartbeat rounds grant the lease
+    assert leader.node.lease_valid()
+    reads_before = leader._read_seq
+    assert cluster.must_get(b"k") == b"v"
+    assert leader._read_seq == reads_before  # no ReadIndex issued
+    # a deposed leader loses the lease
+    other = next(sid for sid in cluster.stores if sid != leader.store.store_id)
+    cluster.elect_leader(FIRST_REGION_ID, other)
+    assert not leader.node.lease_valid()
+
+
+def test_merging_flag_survives_recovery(cluster):
+    """A restarted source peer must stay frozen mid-merge."""
+    from tikv_tpu.raft.store import Store
+
+    right_id = cluster.split_region(FIRST_REGION_ID, b"m")
+    source = cluster.wait_leader(right_id)
+    cmd = {
+        "epoch": (source.region.epoch.conf_ver, source.region.epoch.version),
+        "ops": [],
+        "admin": ("prepare_merge", FIRST_REGION_ID),
+    }
+    cluster._run_admin(source, cmd)
+    victim = source.store.store_id
+    old = cluster.stores[victim]
+    new_store = Store(victim, cluster.transport, engine=old.engine)
+    new_store.recover()
+    assert new_store.peers[right_id].merging is True

@@ -39,6 +39,43 @@ _PUT = int(WriteType.PUT)
 _SHORT_PREFIX = 0x76  # b'v'
 
 
+def _parse_frames(buf: bytes, n: int) -> list[tuple[bytes, bytes]]:
+    import struct as _struct
+
+    u32 = _struct.Struct("<I")
+    out = []
+    off = 0
+    for _ in range(n):
+        (klen,) = u32.unpack_from(buf, off)
+        off += 4
+        k = buf[off : off + klen]
+        off += klen
+        (vlen,) = u32.unpack_from(buf, off)
+        off += 4
+        v = buf[off : off + vlen]
+        off += vlen
+        out.append((k, v))
+    return out
+
+
+def _decode_user_keys(key_rows: np.ndarray) -> list[bytes]:
+    """Vectorized memcomparable decode of same-width encoded keys: drop the
+    marker byte of each 9-byte group and trim the final group's padding
+    (markers verified uniform; per-row fallback otherwise)."""
+    n, w = key_rows.shape
+    if w % 9 == 0:
+        groups = w // 9
+        markers = key_rows[:, 8::9]
+        if (markers == markers[0]).all():
+            raw0, _ = codec.decode_bytes(key_rows[0].tobytes())
+            data_cols = np.concatenate(
+                [key_rows[:, g * 9 : g * 9 + 8] for g in range(groups)], axis=1
+            )[:, : len(raw0)]
+            data_cols = np.ascontiguousarray(data_cols)
+            return [r.tobytes() for r in data_cols]
+    return [codec.decode_bytes(key_rows[i].tobytes())[0] for i in range(n)]
+
+
 class MvccBatchScanSource(ScanSource):
     """Drop-in ScanSource resolving whole ranges vectorized."""
 
@@ -75,16 +112,28 @@ class MvccBatchScanSource(ScanSource):
             self.stats.lock.next += 1
             _check_lock(v, Key.from_encoded(k).to_raw(), self.ts, self.bypass_locks)
 
-        pairs = list(self.snap.scan_cf(CF_WRITE, enc_start, enc_end))
-        if not pairs:
-            return [], []
-        wkeys = [k for k, _ in pairs]
-        width = len(wkeys[0])
-        if any(len(k) != width for k in wkeys):
-            return self._fallback(start, end)
-
-        n = len(wkeys)
-        arr = np.frombuffer(b"".join(wkeys), dtype=np.uint8).reshape(n, width)
+        native = self._native_range(enc_start, enc_end)
+        if native is not None and not isinstance(native, list):
+            n, width, arr, values_arr = native
+            if n == 0:
+                return [], []
+            wkeys = None
+            pairs = None
+        else:
+            # native may hand back the already-fetched pairs (variable frames)
+            # so the range is never scanned across the FFI twice
+            pairs = native if native is not None else list(
+                self.snap.scan_cf(CF_WRITE, enc_start, enc_end)
+            )
+            if not pairs:
+                return [], []
+            wkeys = [k for k, _ in pairs]
+            width = len(wkeys[0])
+            if any(len(k) != width for k in wkeys):
+                return self._fallback(start, end)
+            n = len(wkeys)
+            arr = np.frombuffer(b"".join(wkeys), dtype=np.uint8).reshape(n, width)
+            values_arr = None
         user = arr[:, : width - _TS_W]
         commit_ts = codec.decode_u64_batch(arr[:, width - _TS_W :]) ^ np.uint64(
             0xFFFFFFFFFFFFFFFF
@@ -108,6 +157,17 @@ class MvccBatchScanSource(ScanSource):
         if len(pick) == 0:
             return [], []
 
+        if values_arr is not None:
+            varr = np.ascontiguousarray(values_arr[pick])
+            vw = varr.shape[1]
+            simple = self._parse_simple_layout(varr, vw)
+            if simple is not None:
+                self.stats.write.processed_keys += len(pick)
+                key_rows = np.ascontiguousarray(arr[pick, : width - _TS_W])
+                out_keys = _decode_user_keys(key_rows)
+                return out_keys, simple
+            return self._fallback(start, end)
+
         values = [pairs[i][1] for i in pick]
         # vectorized write-record parse: common layout check
         vlens = np.fromiter((len(v) for v in values), dtype=np.int64, count=len(values))
@@ -121,6 +181,34 @@ class MvccBatchScanSource(ScanSource):
                 return out_keys, simple
         # mixed/unusual records: exact per-key resolution for the whole range
         return self._fallback(start, end)
+
+    def _native_range(self, enc_start: bytes, enc_end: bytes):
+        """Fixed-stride zero-copy path over a native snapshot's scan buffer:
+        if every (key, value) frame has identical lengths, the whole range
+        reshapes into two byte matrices without per-pair Python."""
+        scan_raw = getattr(self.snap, "scan_raw", None)
+        if scan_raw is None:
+            return None
+        n, buf = scan_raw(CF_WRITE, enc_start, enc_end)
+        if n == 0:
+            return 0, 0, None, None
+        b = np.frombuffer(buf, dtype=np.uint8)
+        klen = int(np.frombuffer(buf[:4], dtype=np.uint32)[0])
+        if len(buf) < 8 + klen:
+            return None
+        vlen = int(np.frombuffer(buf[4 + klen : 8 + klen], dtype=np.uint32)[0])
+        stride = 8 + klen + vlen
+        if len(buf) != n * stride:
+            return _parse_frames(buf, n)  # mixed frame sizes — generic pairs
+        mat = b.reshape(n, stride)
+        # verify the length headers are constant across rows
+        if not (mat[:, :4] == mat[0, :4]).all() or not (
+            mat[:, 4 + klen : 8 + klen] == mat[0, 4 + klen : 8 + klen]
+        ).all():
+            return _parse_frames(buf, n)
+        keys_arr = mat[:, 4 : 4 + klen]
+        values_arr = mat[:, 8 + klen : stride]
+        return n, klen, keys_arr, values_arr
 
     def _parse_simple_layout(self, varr: np.ndarray, vw: int) -> list[bytes] | None:
         """All records = [P][varint start_ts][v][len][short_value]? Verify the

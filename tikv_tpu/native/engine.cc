@@ -1,0 +1,276 @@
+// Native ordered multi-CF storage engine.
+//
+// Plays the role RocksDB plays in the reference (components/engine_rocks):
+// the storage medium under the engine-trait layer.  Design is a versioned
+// ordered memtable (rocksdb-memtable-like): every write carries a sequence
+// number; a snapshot is just a sequence, so snapshots are O(1) and never
+// copy; iterators resolve the newest version <= snapshot per key.  Obsolete
+// versions are compacted away once no live snapshot can see them.
+//
+// Exposed as a C API consumed via ctypes (no pybind11 in this image).  Scans
+// return length-prefixed buffers so one FFI crossing moves a whole range.
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <optional>
+#include <set>
+#include <shared_mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Version {
+  uint64_t seq;
+  bool tombstone;
+  std::string value;
+};
+
+// newest-first version chain per key
+using Chain = std::vector<Version>;
+using Table = std::map<std::string, Chain>;
+
+constexpr int kNumCfs = 4;  // default, lock, write, raft
+
+struct Engine {
+  Table cfs[kNumCfs];
+  uint64_t seq = 0;
+  std::multiset<uint64_t> snapshots;
+  mutable std::shared_mutex mu;
+
+  uint64_t min_live_snapshot() const {
+    return snapshots.empty() ? UINT64_MAX : *snapshots.begin();
+  }
+};
+
+const std::string* resolve(const Chain& chain, uint64_t snap_seq) {
+  for (const auto& v : chain) {  // newest first
+    if (v.seq <= snap_seq) {
+      return v.tombstone ? nullptr : &v.value;
+    }
+  }
+  return nullptr;
+}
+
+void put_version(Table& t, std::string key, uint64_t seq, bool tomb,
+                 std::string value, uint64_t min_snap) {
+  Chain& chain = t[key];
+  chain.insert(chain.begin(), Version{seq, tomb, std::move(value)});
+  // compact: keep the newest version <= min_snap, drop everything older
+  if (chain.size() > 1) {
+    size_t keep = chain.size();
+    for (size_t i = 0; i < chain.size(); i++) {
+      if (chain[i].seq <= min_snap) {
+        keep = i + 1;
+        break;
+      }
+    }
+    if (keep < chain.size()) chain.resize(keep);
+  }
+}
+
+// --- buffer helpers ---------------------------------------------------------
+
+void append_u32(std::string& out, uint32_t v) {
+  char b[4];
+  memcpy(b, &v, 4);
+  out.append(b, 4);
+}
+
+uint32_t read_u32(const uint8_t*& p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  p += 4;
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* eng_open() { return new Engine(); }
+
+void eng_close(void* h) { delete static_cast<Engine*>(h); }
+
+// batch format: repeated records
+//   op u8 (1=put, 2=delete, 3=delete_range) | cf u8 |
+//   klen u32 | key | vlen u32 | val      (val = end key for delete_range)
+int eng_write(void* h, const uint8_t* data, uint64_t len) {
+  Engine* e = static_cast<Engine*>(h);
+  std::unique_lock lk(e->mu);
+  uint64_t seq = ++e->seq;
+  uint64_t min_snap = e->min_live_snapshot();
+  if (min_snap > seq) min_snap = seq;  // nothing older than this write is needed
+  const uint8_t* p = data;
+  const uint8_t* end = data + len;
+  while (p < end) {
+    if (end - p < 2) return -1;
+    uint8_t op = *p++;
+    uint8_t cf = *p++;
+    if (cf >= kNumCfs) return -2;
+    if (end - p < 4) return -1;
+    uint32_t klen = read_u32(p);
+    if (end - p < klen) return -1;
+    std::string key(reinterpret_cast<const char*>(p), klen);
+    p += klen;
+    if (end - p < 4) return -1;
+    uint32_t vlen = read_u32(p);
+    if (end - p < vlen) return -1;
+    std::string val(reinterpret_cast<const char*>(p), vlen);
+    p += vlen;
+    Table& t = e->cfs[cf];
+    if (op == 1) {
+      put_version(t, std::move(key), seq, false, std::move(val), min_snap);
+    } else if (op == 2) {
+      put_version(t, std::move(key), seq, true, "", min_snap);
+    } else if (op == 3) {
+      auto it = t.lower_bound(key);
+      auto stop = t.lower_bound(val);
+      for (; it != stop; ++it) {
+        put_version(t, it->first, seq, true, "", min_snap);
+      }
+    } else {
+      return -3;
+    }
+  }
+  return 0;
+}
+
+uint64_t eng_snapshot(void* h) {
+  Engine* e = static_cast<Engine*>(h);
+  std::unique_lock lk(e->mu);
+  e->snapshots.insert(e->seq);
+  return e->seq;
+}
+
+void eng_release_snapshot(void* h, uint64_t seq) {
+  Engine* e = static_cast<Engine*>(h);
+  std::unique_lock lk(e->mu);
+  auto it = e->snapshots.find(seq);
+  if (it != e->snapshots.end()) e->snapshots.erase(it);
+}
+
+// get: returns 1 + copies value if found, 0 if not, <0 on error.
+// caller frees *out with eng_free.
+int eng_get(void* h, int cf, const uint8_t* key, uint64_t klen,
+            uint64_t snap_seq, uint8_t** out, uint64_t* out_len) {
+  Engine* e = static_cast<Engine*>(h);
+  if (cf < 0 || cf >= kNumCfs) return -2;
+  std::shared_lock lk(e->mu);
+  const Table& t = e->cfs[cf];
+  auto it = t.find(std::string(reinterpret_cast<const char*>(key), klen));
+  if (it == t.end()) return 0;
+  const std::string* v = resolve(it->second, snap_seq);
+  if (v == nullptr) return 0;
+  *out = static_cast<uint8_t*>(malloc(v->size()));
+  memcpy(*out, v->data(), v->size());
+  *out_len = v->size();
+  return 1;
+}
+
+// scan [start, end) visible at snap_seq; limit 0 = unlimited.
+// Output buffer: repeated (klen u32 | key | vlen u32 | val); caller eng_free.
+// Returns number of pairs, or <0 on error.
+long eng_scan(void* h, int cf, uint64_t snap_seq, const uint8_t* start,
+              uint64_t start_len, const uint8_t* end_key, uint64_t end_len,
+              int has_end, uint64_t limit, int reverse, uint8_t** out,
+              uint64_t* out_len) {
+  Engine* e = static_cast<Engine*>(h);
+  if (cf < 0 || cf >= kNumCfs) return -2;
+  std::shared_lock lk(e->mu);
+  const Table& t = e->cfs[cf];
+  std::string s(reinterpret_cast<const char*>(start), start_len);
+  std::string en(reinterpret_cast<const char*>(end_key), end_len);
+  std::string buf;
+  long n = 0;
+  auto emit = [&](const std::string& k, const std::string& v) {
+    append_u32(buf, static_cast<uint32_t>(k.size()));
+    buf.append(k);
+    append_u32(buf, static_cast<uint32_t>(v.size()));
+    buf.append(v);
+    n++;
+  };
+  if (!reverse) {
+    auto it = t.lower_bound(s);
+    auto stop = has_end ? t.lower_bound(en) : t.end();
+    for (; it != stop && (limit == 0 || n < static_cast<long>(limit)); ++it) {
+      const std::string* v = resolve(it->second, snap_seq);
+      if (v != nullptr) emit(it->first, *v);
+    }
+  } else {
+    auto it = has_end ? t.lower_bound(en) : t.end();
+    auto stop = t.lower_bound(s);
+    while (it != stop && (limit == 0 || n < static_cast<long>(limit))) {
+      --it;
+      const std::string* v = resolve(it->second, snap_seq);
+      if (v != nullptr) emit(it->first, *v);
+      if (it == stop) break;
+    }
+  }
+  *out = static_cast<uint8_t*>(malloc(buf.size()));
+  memcpy(*out, buf.data(), buf.size());
+  *out_len = buf.size();
+  return n;
+}
+
+// cursor-style seek: find first key >= target (or last key <= target when
+// for_prev) within [lower, upper); returns 1 + key/value copies, else 0.
+int eng_seek(void* h, int cf, uint64_t snap_seq, const uint8_t* target,
+             uint64_t target_len, const uint8_t* lower, uint64_t lower_len,
+             const uint8_t* upper, uint64_t upper_len, int has_upper,
+             int for_prev, uint8_t** kout, uint64_t* kout_len, uint8_t** vout,
+             uint64_t* vout_len) {
+  Engine* e = static_cast<Engine*>(h);
+  if (cf < 0 || cf >= kNumCfs) return -2;
+  std::shared_lock lk(e->mu);
+  const Table& t = e->cfs[cf];
+  std::string tg(reinterpret_cast<const char*>(target), target_len);
+  std::string lo(reinterpret_cast<const char*>(lower), lower_len);
+  std::string up(reinterpret_cast<const char*>(upper), upper_len);
+  if (!for_prev) {
+    auto it = t.lower_bound(tg < lo ? lo : tg);
+    auto stop = has_upper ? t.lower_bound(up) : t.end();
+    for (; it != stop; ++it) {
+      const std::string* v = resolve(it->second, snap_seq);
+      if (v == nullptr) continue;
+      *kout = static_cast<uint8_t*>(malloc(it->first.size()));
+      memcpy(*kout, it->first.data(), it->first.size());
+      *kout_len = it->first.size();
+      *vout = static_cast<uint8_t*>(malloc(v->size()));
+      memcpy(*vout, v->data(), v->size());
+      *vout_len = v->size();
+      return 1;
+    }
+    return 0;
+  }
+  // seek_for_prev: last visible key <= target within [lower, upper)
+  auto it = t.upper_bound(tg);
+  while (it != t.begin()) {
+    --it;
+    if (it->first < lo) return 0;
+    if (has_upper && it->first >= up) continue;
+    const std::string* v = resolve(it->second, snap_seq);
+    if (v == nullptr) continue;
+    *kout = static_cast<uint8_t*>(malloc(it->first.size()));
+    memcpy(*kout, it->first.data(), it->first.size());
+    *kout_len = it->first.size();
+    *vout = static_cast<uint8_t*>(malloc(v->size()));
+    memcpy(*vout, v->data(), v->size());
+    *vout_len = v->size();
+    return 1;
+  }
+  return 0;
+}
+
+void eng_free(uint8_t* p) { free(p); }
+
+uint64_t eng_stats_keys(void* h, int cf) {
+  Engine* e = static_cast<Engine*>(h);
+  std::shared_lock lk(e->mu);
+  return e->cfs[cf].size();
+}
+
+}  // extern "C"

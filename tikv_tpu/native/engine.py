@@ -1,0 +1,308 @@
+"""ctypes binding for the native C++ engine.
+
+Implements the ``KvEngine``/``Snapshot``/``Cursor`` trait surface over
+``engine.cc`` (the RocksDB role from components/engine_rocks, as a versioned
+ordered memtable with O(1) sequence-number snapshots).  The shared library is
+built on first use with the baked-in g++ (no pip deps; pybind11 unavailable —
+plain C ABI via ctypes).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+from typing import Iterator
+
+from ..storage.engine import ALL_CFS, Cursor, KvEngine, Snapshot, WriteBatch
+
+_CF_IDS = {cf: i for i, cf in enumerate(ALL_CFS)}
+_U32 = struct.Struct("<I")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "engine.cc")
+_SO = os.path.join(_HERE, "libtikv_engine.so")
+
+_lib = None
+_lib_err: str | None = None
+_build_mu = threading.Lock()
+
+
+def _build() -> None:
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", _SO, _SRC],
+        check=True,
+        capture_output=True,
+    )
+
+
+def _load():
+    global _lib, _lib_err
+    with _build_mu:
+        if _lib is not None or _lib_err is not None:
+            return _lib
+        try:
+            if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+                _build()
+            lib = ctypes.CDLL(_SO)
+        except (OSError, subprocess.CalledProcessError) as e:
+            _lib_err = str(e)
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.eng_open.restype = ctypes.c_void_p
+        lib.eng_close.argtypes = [ctypes.c_void_p]
+        lib.eng_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+        lib.eng_write.restype = ctypes.c_int
+        lib.eng_snapshot.argtypes = [ctypes.c_void_p]
+        lib.eng_snapshot.restype = ctypes.c_uint64
+        lib.eng_release_snapshot.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.eng_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.eng_get.restype = ctypes.c_int
+        lib.eng_scan.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+            ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.eng_scan.restype = ctypes.c_long
+        lib.eng_seek.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.eng_seek.restype = ctypes.c_int
+        lib.eng_free.argtypes = [u8p]
+        lib.eng_stats_keys.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.eng_stats_keys.restype = ctypes.c_uint64
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _take(lib, ptr, length) -> bytes:
+    try:
+        return ctypes.string_at(ptr, length)
+    finally:
+        lib.eng_free(ptr)
+
+
+class _NativeCursor(Cursor):
+    """Cursor via repeated bounded seeks (each seek resolves MVCC versions
+    natively; next/prev re-seek from the current key)."""
+
+    def __init__(self, snap: "NativeSnapshot", cf: int, lower: bytes | None, upper: bytes | None):
+        self._snap = snap
+        self._cf = cf
+        self._lower = lower or b""
+        self._upper = upper
+        self._key: bytes | None = None
+        self._value: bytes | None = None
+
+    def _do_seek(self, target: bytes, for_prev: bool) -> bool:
+        lib = self._snap._lib
+        kout = ctypes.POINTER(ctypes.c_uint8)()
+        klen = ctypes.c_uint64()
+        vout = ctypes.POINTER(ctypes.c_uint8)()
+        vlen = ctypes.c_uint64()
+        upper = self._upper
+        r = lib.eng_seek(
+            self._snap._handle, self._cf, self._snap._seq,
+            target, len(target),
+            self._lower, len(self._lower),
+            upper or b"", len(upper or b""), 1 if upper is not None else 0,
+            1 if for_prev else 0,
+            ctypes.byref(kout), ctypes.byref(klen),
+            ctypes.byref(vout), ctypes.byref(vlen),
+        )
+        if r == 1:
+            self._key = _take(lib, kout, klen.value)
+            self._value = _take(lib, vout, vlen.value)
+            return True
+        self._key = self._value = None
+        return False
+
+    def seek(self, key: bytes) -> bool:
+        return self._do_seek(key, False)
+
+    def seek_for_prev(self, key: bytes) -> bool:
+        return self._do_seek(key, True)
+
+    def seek_to_first(self) -> bool:
+        return self._do_seek(self._lower, False)
+
+    def seek_to_last(self) -> bool:
+        if self._upper is not None:
+            # upper is exclusive; for_prev at upper then step below it
+            if self._do_seek(self._upper, True) and self._key < self._upper:
+                return True
+            return self.prev() if self._key is not None else False
+        return self._do_seek(b"\xff" * 64, True)
+
+    def next(self) -> bool:
+        if self._key is None:
+            return False
+        return self._do_seek(self._key + b"\x00", False)
+
+    def prev(self) -> bool:
+        """Step to the largest visible key strictly below the current one.
+
+        Byte-string order has no exact predecessor, so seek_for_prev targets
+        the tightest constructible bound: for ...X00 the prefix itself, else
+        decrement the last byte and pad with 0xff (safe for keys shorter than
+        the pad — true for all key layouts in this system).
+        """
+        if self._key is None:
+            return False
+        k = self._key
+        if len(k) == 0:
+            self._key = self._value = None
+            return False
+        if k.endswith(b"\x00"):
+            target = k[:-1]
+        else:
+            target = k[:-1] + bytes([k[-1] - 1]) + b"\xff" * 64
+        ok = self._do_seek(target, True)
+        if ok and self._key >= k:
+            self._key = self._value = None
+            return False
+        return ok
+
+    def valid(self) -> bool:
+        return self._key is not None
+
+    def key(self) -> bytes:
+        return self._key
+
+    def value(self) -> bytes:
+        return self._value
+
+
+class NativeSnapshot(Snapshot):
+    def __init__(self, engine: "NativeEngine"):
+        self._lib = engine._lib
+        self._handle = engine._handle
+        self._engine = engine
+        self._seq = self._lib.eng_snapshot(self._handle)
+        self._released = False
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:  # noqa: BLE001 — interpreter shutdown
+            pass
+
+    def release(self) -> None:
+        if not self._released and self._engine._handle is not None:
+            self._lib.eng_release_snapshot(self._handle, self._seq)
+            self._released = True
+
+    def get_cf(self, cf: str, key: bytes) -> bytes | None:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_uint64()
+        r = self._lib.eng_get(
+            self._handle, _CF_IDS[cf], key, len(key), self._seq,
+            ctypes.byref(out), ctypes.byref(out_len),
+        )
+        if r == 1:
+            return _take(self._lib, out, out_len.value)
+        return None
+
+    def cursor_cf(self, cf: str, lower: bytes | None = None, upper: bytes | None = None) -> Cursor:
+        return _NativeCursor(self, _CF_IDS[cf], lower, upper)
+
+    def scan_raw(self, cf: str, start: bytes, end: bytes | None, limit=None, reverse=False) -> tuple[int, bytes]:
+        """One FFI crossing for a whole range: (n_pairs, framed buffer).
+        Frame: repeated (klen u32le | key | vlen u32le | val)."""
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_uint64()
+        n = self._lib.eng_scan(
+            self._handle, _CF_IDS[cf], self._seq,
+            start, len(start), end or b"", len(end or b""), 1 if end is not None else 0,
+            limit or 0, 1 if reverse else 0,
+            ctypes.byref(out), ctypes.byref(out_len),
+        )
+        if n < 0:
+            raise RuntimeError(f"eng_scan failed: {n}")
+        return n, _take(self._lib, out, out_len.value)
+
+    def scan_cf(self, cf, start, end, limit=None, reverse=False) -> Iterator[tuple[bytes, bytes]]:
+        n, buf = self.scan_raw(cf, start, end, limit, reverse)
+        off = 0
+        for _ in range(n):
+            (klen,) = _U32.unpack_from(buf, off)
+            off += 4
+            k = buf[off : off + klen]
+            off += klen
+            (vlen,) = _U32.unpack_from(buf, off)
+            off += 4
+            v = buf[off : off + vlen]
+            off += vlen
+            yield k, v
+
+
+class NativeEngine(KvEngine):
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native engine unavailable: {_lib_err}")
+        self._lib = lib
+        self._handle = lib.eng_open()
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.eng_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter shutdown
+            pass
+
+    def write(self, batch: WriteBatch) -> None:
+        out = bytearray()
+        for op, cf, key, val in batch.ops:
+            out.append({"put": 1, "delete": 2, "delete_range": 3}[op])
+            out.append(_CF_IDS[cf])
+            out += _U32.pack(len(key))
+            out += key
+            v = val if val is not None else b""
+            out += _U32.pack(len(v))
+            out += v
+        r = self._lib.eng_write(self._handle, bytes(out), len(out))
+        if r != 0:
+            raise RuntimeError(f"eng_write failed: {r}")
+
+    def bulk_load(self, cf: str, items: list[tuple[bytes, bytes]]) -> None:
+        wb = WriteBatch()
+        for k, v in items:
+            wb.put_cf(cf, k, v)
+        self.write(wb)
+
+    def snapshot(self) -> NativeSnapshot:
+        return NativeSnapshot(self)
+
+    def get_cf(self, cf: str, key: bytes) -> bytes | None:
+        snap = self.snapshot()
+        try:
+            return snap.get_cf(cf, key)
+        finally:
+            snap.release()
+
+    def scan_cf(self, cf, start, end, limit=None, reverse=False):
+        snap = self.snapshot()
+        try:
+            return list(snap.scan_cf(cf, start, end, limit, reverse))
+        finally:
+            snap.release()

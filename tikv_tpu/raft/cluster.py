@@ -234,3 +234,42 @@ class Cluster:
 
     def transfer_leader(self, region_id: int, to_store: int) -> None:
         self.elect_leader(region_id, to_store)
+
+    def merge_regions(self, target_id: int, source_id: int) -> None:
+        """Merge source (right neighbor) into target (left neighbor):
+        PrepareMerge freezes the source, all source peers quiesce, then
+        CommitMerge on the target absorbs the range."""
+        target = self.wait_leader(target_id)
+        source = self.wait_leader(source_id)
+        assert target.region.end_key == source.region.start_key, "regions must be adjacent"
+        src_region_id = source.region.id
+        cmd = {
+            "epoch": (source.region.epoch.conf_ver, source.region.epoch.version),
+            "ops": [],
+            "admin": ("prepare_merge", target_id),
+        }
+        self._run_admin(source, cmd)
+        # quiesce: every source peer fully applied — CommitMerge over a
+        # lagging source replica would destroy state it never applied
+        for attempt in range(50):
+            self.process()
+            peers = [s.peers.get(src_region_id) for s in self.stores.values()]
+            live = [p for p in peers if p is not None]
+            if all(p.node.applied == source.node.commit for p in live):
+                break
+            self.tick()
+        else:
+            raise AssertionError(
+                f"source region {src_region_id} replicas did not quiesce; refusing CommitMerge"
+            )
+        src_end = source.region.end_key
+        src_version = source.region.epoch.version
+        cmd = {
+            "epoch": (target.region.epoch.conf_ver, target.region.epoch.version),
+            "ops": [],
+            "admin": ("commit_merge", src_region_id, src_end, src_version),
+        }
+        self._run_admin(target, cmd)
+        if self.pd is not None:
+            self.pd.regions.pop(src_region_id, None)
+            self.pd.region_heartbeat(target.region.clone(), target.store.store_id)

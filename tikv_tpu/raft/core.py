@@ -75,6 +75,7 @@ class Message:
     reject_hint: int = 0
     snapshot: Snapshot | None = None
     context: bytes = b""  # read-index correlation
+    hb_round: int = 0  # heartbeat round tag (lease accounting)
 
 
 @dataclass
@@ -181,6 +182,15 @@ class RaftNode:
         self.rng = rng or random.Random(node_id)
         self._elapsed = 0
         self._randomized_timeout = self._rand_timeout()
+        self._tick_count = 0
+        # lease: leader may serve local reads until this tick.  Granted ONLY
+        # from a complete heartbeat round, measured from the round's
+        # *broadcast* tick (granting at response time would let the lease
+        # outlive follower election timers under message delay)
+        self._lease_until = 0
+        self._hb_round = 0
+        self._hb_round_tick = 0
+        self._hb_acks: set[int] = set()
 
         # leader state
         self.next_index: dict[int, int] = {}
@@ -258,6 +268,7 @@ class RaftNode:
     # ---------------------------------------------------------------- public
 
     def tick(self) -> None:
+        self._tick_count += 1
         self._elapsed += 1
         if self.role == Role.LEADER:
             if self._elapsed >= self.heartbeat_tick:
@@ -500,13 +511,25 @@ class RaftNode:
 
     # heartbeats ------------------------------------------------------------
 
+    def lease_valid(self) -> bool:
+        """Leader lease for local reads (worker/read.rs LocalReader): valid
+        while a quorum acknowledged us within the last election timeout."""
+        return (
+            self.role == Role.LEADER
+            and self._committed_in_term()
+            and (self._quorum() == 1 or self._tick_count < self._lease_until)
+        )
+
     def _broadcast_heartbeat(self, ctx: bytes = b"") -> None:
+        self._hb_round += 1
+        self._hb_round_tick = self._tick_count
+        self._hb_acks = {self.id}
         for peer in self.voters - {self.id}:
             self._send(
                 Message(
                     MsgType.HEARTBEAT, self.id, peer, self.term,
                     commit=min(self.commit, self.match_index.get(peer, 0)),
-                    context=ctx,
+                    context=ctx, hb_round=self._hb_round,
                 )
             )
 
@@ -516,12 +539,21 @@ class RaftNode:
             self.commit = min(m.commit, self.log.last_index())
             self._ready.hard_state_changed = True
         self._send(
-            Message(MsgType.HEARTBEAT_RESP, self.id, m.frm, self.term, context=m.context)
+            Message(
+                MsgType.HEARTBEAT_RESP, self.id, m.frm, self.term,
+                context=m.context, hb_round=m.hb_round,
+            )
         )
 
     def _on_heartbeat_resp(self, m: Message) -> None:
         if self.role != Role.LEADER:
             return
+        if m.hb_round == self._hb_round:
+            self._hb_acks.add(m.frm)
+            if len(self._hb_acks & self.voters) >= self._quorum():
+                self._lease_until = max(
+                    self._lease_until, self._hb_round_tick + self.election_tick
+                )
         if m.context and m.context in self._pending_reads:
             index, acks = self._pending_reads[m.context]
             acks.add(m.frm)

@@ -107,6 +107,11 @@ class RaftKv(Engine):
         peer = self._peer_for_ctx(ctx)
         if not peer.node.is_leader():
             raise NotLeaderError(peer.region.id, self.store.leader_store_of(peer.region.id))
+        # lease fast path (LocalReader, read.rs:342): while the leader holds a
+        # quorum-granted lease and has applied everything committed, reads
+        # skip the ReadIndex round entirely
+        if peer.node.lease_valid() and peer.node.applied == peer.node.commit:
+            return RegionSnapshot(self.store.engine.snapshot(), peer.region.clone())
         done = threading.Event()
         err: list = []
 

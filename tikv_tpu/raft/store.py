@@ -61,6 +61,14 @@ def encode_cmd(cmd: dict) -> bytes:
         out += codec.encode_compact_bytes(admin[1].encode())
         out += codec.encode_var_u64(admin[2])
         out += codec.encode_var_u64(admin[3])
+    elif admin[0] == "prepare_merge":
+        out.append(3)
+        out += codec.encode_var_u64(admin[1])  # target region id
+    elif admin[0] == "commit_merge":
+        out.append(4)
+        out += codec.encode_var_u64(admin[1])  # source region id
+        out += codec.encode_compact_bytes(admin[2])  # source end key
+        out += codec.encode_var_u64(admin[3])  # source epoch version
     else:
         raise ValueError(admin)
     return bytes(out)
@@ -97,6 +105,14 @@ def decode_cmd(b: bytes) -> dict:
         pid, off = codec.decode_var_u64(b, off)
         sid, off = codec.decode_var_u64(b, off)
         cmd["admin"] = ("conf_change", op.decode(), pid, sid)
+    elif kind == 3:
+        tid, off = codec.decode_var_u64(b, off)
+        cmd["admin"] = ("prepare_merge", tid)
+    elif kind == 4:
+        sid, off = codec.decode_var_u64(b, off)
+        end, off = codec.decode_compact_bytes(b, off)
+        sv, off = codec.decode_var_u64(b, off)
+        cmd["admin"] = ("commit_merge", sid, end, sv)
     return cmd
 
 
@@ -213,6 +229,7 @@ class StorePeer:
         self.proposals: list[Proposal] = []
         self.pending_reads: dict[bytes, Callable] = {}
         self._read_seq = 0
+        self.merging = False  # PrepareMerge applied: no more data proposals
 
     # -- raft driving ------------------------------------------------------
 
@@ -221,6 +238,11 @@ class StorePeer:
             cb(NotLeaderError(self.region.id, self.store.leader_store_of(self.region.id)))
             return
         if not self._epoch_ok(cmd):
+            cb(EpochError(self.region.clone()))
+            return
+        if self.merging:
+            # a merging region rejects ALL proposals (data, split, conf
+            # change) until CommitMerge resolves it — raftstore's rule
             cb(EpochError(self.region.clone()))
             return
         admin = cmd.get("admin")
@@ -323,6 +345,16 @@ class StorePeer:
             self._apply_split(admin)
             self._ack(e, {"split": True}, None)
             return
+        if admin is not None and admin[0] == "prepare_merge":
+            self.merging = True
+            self.region.epoch.version += 1
+            self.store.persist_region(self.region, merging=True)
+            self._ack(e, {"prepare_merge": True}, None)
+            return
+        if admin is not None and admin[0] == "commit_merge":
+            self._apply_commit_merge(admin)
+            self._ack(e, {"commit_merge": True}, None)
+            return
         wb = WriteBatch()
         for op, cf, key, val in cmd["ops"]:
             dkey = keys.data_key(key)
@@ -412,6 +444,27 @@ class StorePeer:
             + codec.encode_u64(n.log.snapshot_term)
         )
 
+    def _apply_commit_merge(self, admin) -> None:
+        """Absorb the (frozen, fully-applied) right-neighbor source region:
+        extend our range, bump version above both, destroy the local source
+        peer (raftstore's CommitMerge; the harness guarantees the source is
+        quiesced — the reference's CatchUpLogs machinery is future work)."""
+        _, source_id, source_end, source_version = admin
+        self.region.end_key = source_end
+        self.region.epoch.version = max(self.region.epoch.version, source_version) + 1
+        self.store.persist_region(self.region)
+        src = self.store.peers.get(source_id)
+        if src is not None:
+            self.store.destroy_peer(source_id)
+        wb = WriteBatch()
+        wb.delete_cf(CF_RAFT, keys.region_state_key(source_id))
+        wb.delete_cf(CF_RAFT, keys.raft_state_key(source_id))
+        wb.delete_cf(CF_RAFT, keys.apply_state_key(source_id))
+        log_prefix = keys.region_raft_prefix(source_id) + keys.RAFT_LOG_SUFFIX
+        wb.delete_range_cf(CF_RAFT, log_prefix, log_prefix[:-1] + bytes([log_prefix[-1] + 1]))
+        self.store.engine.write(wb)
+        self.store.on_merge(self.region, source_id)
+
     # -- snapshots ---------------------------------------------------------
 
     def _generate_snapshot(self) -> RaftSnapshot:
@@ -440,7 +493,7 @@ class StorePeer:
         eng = self.store.engine
         b = snap.data
         meta, off = codec.decode_compact_bytes(b, 0)
-        self.region = decode_region(meta)
+        self.region, self.merging = decode_region(meta)
         wb = WriteBatch()
         start = keys.data_key(self.region.start_key)
         end = keys.data_end_key(self.region.end_key)
@@ -461,7 +514,7 @@ class StorePeer:
         eng.write(wb2)
 
 
-def encode_region(region: Region) -> bytes:
+def encode_region(region: Region, merging: bool = False) -> bytes:
     out = bytearray()
     out += codec.encode_var_u64(region.id)
     out += codec.encode_compact_bytes(region.start_key)
@@ -472,10 +525,12 @@ def encode_region(region: Region) -> bytes:
     for p in region.peers:
         out += codec.encode_var_u64(p.peer_id)
         out += codec.encode_var_u64(p.store_id)
+    out.append(1 if merging else 0)
     return bytes(out)
 
 
-def decode_region(b: bytes) -> Region:
+def decode_region(b: bytes) -> tuple[Region, bool]:
+    """Returns (region, merging)."""
     rid, off = codec.decode_var_u64(b, 0)
     start, off = codec.decode_compact_bytes(b, off)
     end, off = codec.decode_compact_bytes(b, off)
@@ -487,7 +542,8 @@ def decode_region(b: bytes) -> Region:
         pid, off = codec.decode_var_u64(b, off)
         sid, off = codec.decode_var_u64(b, off)
         peers.append(RegionPeer(pid, sid))
-    return Region(rid, start, end, RegionEpoch(cv, v), peers)
+    merging = off < len(b) and b[off] == 1
+    return Region(rid, start, end, RegionEpoch(cv, v), peers), merging
 
 
 def _encode_entry(e: Entry) -> bytes:
@@ -532,6 +588,7 @@ class Store:
         self._inbox: list[RaftMessage] = []
         self._mu = threading.RLock()
         self.split_observers: list[Callable] = []
+        self.merge_observers: list[Callable] = []
         self.apply_observers: list[Callable] = []
 
     # -- lifecycle ---------------------------------------------------------
@@ -548,8 +605,10 @@ class Store:
     def destroy_peer(self, region_id: int) -> None:
         self.peers.pop(region_id, None)
 
-    def persist_region(self, region: Region) -> None:
-        self.engine.put_cf(CF_RAFT, keys.region_state_key(region.id), encode_region(region))
+    def persist_region(self, region: Region, merging: bool = False) -> None:
+        self.engine.put_cf(
+            CF_RAFT, keys.region_state_key(region.id), encode_region(region, merging)
+        )
 
     def recover(self) -> int:
         """Rebuild every peer from persisted state after a restart
@@ -559,11 +618,12 @@ class Store:
         prefix = keys.LOCAL_PREFIX + keys.REGION_META_PREFIX
         recovered = 0
         for k, v in snap.scan_cf(CF_RAFT, prefix, prefix[:-1] + bytes([prefix[-1] + 1])):
-            region = decode_region(v)
+            region, merging = decode_region(v)
             me = region.peer_on_store(self.store_id)
             if me is None or region.id in self.peers:
                 continue
             peer = StorePeer(self, region, me.peer_id)
+            peer.merging = merging
             node = peer.node
             state = snap.get_cf(CF_RAFT, keys.raft_state_key(region.id))
             if state is not None:
@@ -651,6 +711,10 @@ class Store:
     def on_split(self, old: Region, new: Region) -> None:
         for cb in self.split_observers:
             cb(self, old, new)
+
+    def on_merge(self, target: Region, source_id: int) -> None:
+        for cb in self.merge_observers:
+            cb(self, target, source_id)
 
     def on_applied(self, region: Region, cmd: dict) -> None:
         for cb in self.apply_observers:

@@ -26,7 +26,7 @@ class Debugger:
         state = snap.get_cf(CF_RAFT, keys.region_state_key(region_id))
         if state is None:
             return None
-        region = decode_region(state)
+        region, _merging = decode_region(state)
         raft_state = snap.get_cf(CF_RAFT, keys.raft_state_key(region_id))
         apply_raw = snap.get_cf(CF_RAFT, keys.apply_state_key(region_id))
         info = {
@@ -77,7 +77,7 @@ class Debugger:
         state = self.engine.get_cf(CF_RAFT, keys.region_state_key(region_id))
         if state is None:
             return None
-        region = decode_region(state)
+        region, _merging = decode_region(state)
         snap = self.engine.snapshot()
         start = keys.data_key(region.start_key)
         end = keys.data_end_key(region.end_key)
@@ -136,7 +136,7 @@ class Debugger:
         for k, v in snap.scan_cf(CF_RAFT, prefix, prefix[:-1] + bytes([prefix[-1] + 1])):
             rid = codec.decode_u64(k, 2)
             try:
-                region = decode_region(v)
+                region, _merging = decode_region(v)
             except (ValueError, IndexError) as e:
                 bad.append((rid, f"corrupt region state: {e}"))
                 continue
