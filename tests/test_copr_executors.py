@@ -237,3 +237,95 @@ def test_int_divide_truncates_toward_zero():
     data, nulls = eval_expr_on_chunk(rpn, chunk)
     assert list(data[:4]) == [3, -3, -3, 3]
     assert bool(nulls[4])  # x DIV 0 = NULL
+
+
+def test_string_kernels():
+    from tikv_tpu.copr.datatypes import Chunk, Column, EvalType
+    from tikv_tpu.copr.rpn import compile_expr, const_bytes, eval_expr_on_chunk
+
+    names = Column.from_values(EvalType.BYTES, [b"  Apple ", b"banana", None, b""])
+    chunk = Chunk.full([names])
+    schema = [(EvalType.BYTES, 0)]
+
+    def run(expr):
+        return eval_expr_on_chunk(compile_expr(expr, schema), chunk)
+
+    d, n = run(call("length", col(0)))
+    assert list(d[:2]) == [8, 6] and bool(n[2])
+    d, n = run(call("upper", call("trim", col(0))))
+    assert d[0] == b"APPLE" and d[1] == b"BANANA"
+    d, n = run(call("substr3", col(0), const_int(3), const_int(4)))
+    assert d[0] == b"Appl"
+    d, n = run(call("concat", col(0), const_bytes(b"!"), col(0)))
+    assert d[1] == b"banana!banana"
+    d, n = run(call("replace", col(0), const_bytes(b"a"), const_bytes(b"_")))
+    assert d[1] == b"b_n_n_"
+    d, n = run(call("left", col(0), const_int(3)))
+    assert d[1] == b"ban"
+    d, n = run(call("locate", const_bytes(b"nan"), col(0)))
+    assert d[1] == 3
+    d, n = run(call("reverse", col(0)))
+    assert d[1] == b"ananab"
+    d, n = run(call("hex", col(0)))
+    assert d[3] == b""
+
+
+def test_like_kernel():
+    from tikv_tpu.copr.datatypes import Chunk, Column, EvalType
+    from tikv_tpu.copr.rpn import compile_expr, const_bytes, eval_expr_on_chunk
+
+    names = Column.from_values(EvalType.BYTES, [b"apple", b"banana", b"grape", b"a%b"])
+    chunk = Chunk.full([names])
+    schema = [(EvalType.BYTES, 0)]
+
+    def like(pat):
+        d, _ = eval_expr_on_chunk(
+            compile_expr(call("like", col(0), const_bytes(pat)), schema), chunk
+        )
+        return list(d)
+
+    assert like(b"%an%") == [0, 1, 0, 0]
+    assert like(b"a%") == [1, 0, 0, 1]
+    assert like(b"_rape") == [0, 0, 1, 0]
+    assert like(b"a\\%b") == [0, 0, 0, 1]  # escaped % is literal
+
+
+def test_in_case_coalesce_casts():
+    from tikv_tpu.copr.datatypes import Chunk, Column, EvalType
+    from tikv_tpu.copr.rpn import compile_expr, eval_expr_on_chunk
+
+    a = Column.from_values(EvalType.INT, [1, 5, None, 9])
+    r = Column.from_values(EvalType.REAL, [1.4, 2.5, -2.5, 0.0])
+    chunk = Chunk.full([a, r])
+    schema = [(EvalType.INT, 0), (EvalType.REAL, 0)]
+
+    def run(expr):
+        return eval_expr_on_chunk(compile_expr(expr, schema), chunk)
+
+    d, n = run(call("in", col(0), const_int(1), const_int(9)))
+    assert list(d[[0, 1, 3]]) == [1, 0, 1] and bool(n[2])
+    d, n = run(
+        call("case_when", call("gt", col(0), const_int(4)), const_int(100), const_int(-1))
+    )
+    assert list(d[[0, 1, 3]]) == [-1, 100, 100]
+    d, n = run(call("coalesce", col(0), const_int(42)))
+    assert d[2] == 42 and not n[2]
+    d, n = run(call("cast_real_int", col(1)))
+    assert list(d) == [1, 3, -3, 0]  # MySQL half-away-from-zero
+    d, n = run(call("cast_int_real", col(0)))
+    assert d[0] == 1.0 and d.dtype.kind == "f"
+
+
+def test_device_rejects_new_bytes_kernels():
+    """String kernels stay CPU-only; supports() must still say no."""
+    from tikv_tpu.copr.dag import DagRequest, Selection, TableScan
+    from tikv_tpu.copr.jax_eval import supports
+    from tikv_tpu.copr.rpn import const_bytes
+
+    dag = DagRequest(
+        executors=[
+            TableScan(TABLE_ID, PRODUCT_COLUMNS),
+            Selection([call("like", col(1), const_bytes(b"a%"))]),
+        ]
+    )
+    assert not supports(dag)

@@ -266,3 +266,20 @@ def test_read_index_waits_for_current_term_commit():
     assert net.reads[2] and net.reads[2][0][0] == b"early"
     idx = net.reads[2][0][1]
     assert net.nodes[2].log.term_at(idx) is not None
+
+
+def test_vote_stickiness_protects_leases():
+    """A follower that recently heard from its leader rejects natural
+    (timeout) campaigns; explicit transfers still go through."""
+    net = Net(3)
+    net.elect(1)
+    net.tick_all(2)  # fresh heartbeats
+    # node 3 campaigns WITHOUT the transfer override (natural timeout)
+    net.nodes[3].campaign(force=False)
+    net.drain()
+    assert net.nodes[3].role != Role.LEADER  # rejected by sticky followers
+    assert net.nodes[1].role == Role.LEADER
+    # explicit transfer (force) succeeds
+    net.nodes[2].campaign(force=True)
+    net.drain()
+    assert net.nodes[2].role == Role.LEADER

@@ -1,0 +1,212 @@
+"""ANALYZE + CHECKSUM support: table statistics and integrity checksums.
+
+Re-expression of ``src/coprocessor/statistics/{histogram,cmsketch,fmsketch}.rs``
+and ``checksum.rs``:
+
+* Histogram — equi-depth buckets over sorted sampled values (lower/upper/
+  count/repeats per bucket), the optimizer's selectivity backbone
+* CMSketch — count-min sketch (d×w counters) for point-frequency estimates
+* FMSketch — Flajolet-Martin distinct-count estimator (mask doubling)
+* checksum — crc64-ECMA over the raw kv pairs of a range
+
+Sampling is reservoir-based like analyze.rs; the DAG table-scan leaf feeds
+decoded columns in, so device-decoded blocks can be analyzed too.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+from ..util import codec
+
+# ---------------------------------------------------------------------------
+# crc64-ECMA (checksum.rs uses crc64fast; table-driven here)
+# ---------------------------------------------------------------------------
+
+_CRC64_POLY = 0xC96C5795D7870F42
+_crc64_table: list[int] = []
+
+
+def _crc64_init() -> None:
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            if crc & 1:
+                crc = (crc >> 1) ^ _CRC64_POLY
+            else:
+                crc >>= 1
+        _crc64_table.append(crc)
+
+
+_crc64_init()
+
+
+def crc64(data: bytes, crc: int = 0) -> int:
+    crc ^= 0xFFFFFFFFFFFFFFFF
+    for b in data:
+        crc = _crc64_table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFFFFFFFFFF
+
+
+def checksum_range(kvs) -> dict:
+    """Order-independent crc64 over kv pairs (XOR-combined like the
+    reference's checksum so region splits can be merged)."""
+    total = 0
+    total_kvs = 0
+    total_bytes = 0
+    for k, v in kvs:
+        entry = crc64(codec.encode_compact_bytes(k) + codec.encode_compact_bytes(v))
+        total ^= entry
+        total_kvs += 1
+        total_bytes += len(k) + len(v)
+    return {"checksum": total, "total_kvs": total_kvs, "total_bytes": total_bytes}
+
+
+# ---------------------------------------------------------------------------
+# FMSketch (fmsketch.rs)
+# ---------------------------------------------------------------------------
+
+class FmSketch:
+    def __init__(self, max_size: int = 10000):
+        self.mask = 0
+        self.max_size = max_size
+        self.hash_set: set[int] = set()
+
+    def insert(self, value: bytes) -> None:
+        h = crc64(value)
+        if (h & self.mask) != 0:
+            return
+        self.hash_set.add(h)
+        while len(self.hash_set) > self.max_size:
+            self.mask = (self.mask << 1) | 1
+            self.hash_set = {x for x in self.hash_set if (x & self.mask) == 0}
+
+    def ndv(self) -> int:
+        return (self.mask + 1) * len(self.hash_set)
+
+
+# ---------------------------------------------------------------------------
+# CMSketch (cmsketch.rs)
+# ---------------------------------------------------------------------------
+
+class CmSketch:
+    def __init__(self, depth: int = 5, width: int = 2048):
+        self.depth = depth
+        self.width = width
+        self.count = 0
+        self.table = [[0] * width for _ in range(depth)]
+
+    def insert(self, value: bytes) -> None:
+        self.count += 1
+        h = crc64(value)
+        h1, h2 = h & 0xFFFFFFFF, h >> 32
+        for i in range(self.depth):
+            j = (h1 + i * h2) % self.width
+            self.table[i][j] += 1
+
+    def query(self, value: bytes) -> int:
+        h = crc64(value)
+        h1, h2 = h & 0xFFFFFFFF, h >> 32
+        return min(self.table[i][(h1 + i * h2) % self.width] for i in range(self.depth))
+
+
+# ---------------------------------------------------------------------------
+# Histogram (histogram.rs)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Bucket:
+    lower: bytes
+    upper: bytes
+    count: int  # cumulative
+    repeats: int
+
+
+@dataclass
+class Histogram:
+    ndv: int = 0
+    buckets: list[Bucket] = field(default_factory=list)
+
+    @classmethod
+    def build(cls, sorted_values: list[bytes], max_buckets: int = 256) -> "Histogram":
+        """Equi-depth histogram from sorted (possibly repeated) values."""
+        h = cls()
+        n = len(sorted_values)
+        if n == 0:
+            return h
+        per_bucket = max(1, (n + max_buckets - 1) // max_buckets)
+        cum = 0
+        for v in sorted_values:
+            cum += 1
+            if h.buckets and h.buckets[-1].upper == v:
+                h.buckets[-1].count = cum
+                h.buckets[-1].repeats += 1
+            elif h.buckets and (h.buckets[-1].count - (h.buckets[-2].count if len(h.buckets) > 1 else 0)) < per_bucket:
+                b = h.buckets[-1]
+                b.upper = v
+                b.count = cum
+                b.repeats = 1
+                h.ndv += 1
+            else:
+                h.buckets.append(Bucket(v, v, cum, 1))
+                h.ndv += 1
+        return h
+
+    def total_count(self) -> int:
+        return self.buckets[-1].count if self.buckets else 0
+
+
+# ---------------------------------------------------------------------------
+# Analyze runner (statistics/analyze.rs)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class AnalyzeColumnsResult:
+    histograms: list[Histogram]
+    cm_sketches: list[CmSketch]
+    fm_sketches: list[FmSketch]
+    sampled_rows: int
+
+
+def analyze_columns(
+    executor,
+    n_columns: int,
+    sample_size: int = 10000,
+    max_buckets: int = 256,
+    seed: int = 0,
+) -> AnalyzeColumnsResult:
+    """Drive a batch executor, reservoir-sample rows, build per-column stats."""
+    rng = random.Random(seed)
+    samples: list[list[bytes]] = [[] for _ in range(n_columns)]
+    cms = [CmSketch() for _ in range(n_columns)]
+    fms = [FmSketch() for _ in range(n_columns)]
+    seen = 0
+    while True:
+        r = executor.next_batch(1024)
+        chunk = r.chunk
+        for row in chunk.logical_rows:
+            row = int(row)
+            encoded = []
+            for ci in range(n_columns):
+                c = chunk.columns[ci]
+                flag, value = c.datum_at(row)
+                out = bytearray()
+                from . import datum as datum_mod
+
+                datum_mod.encode_datum(out, flag, value)
+                encoded.append(bytes(out))
+            for ci in range(n_columns):
+                cms[ci].insert(encoded[ci])
+                fms[ci].insert(encoded[ci])
+                if len(samples[ci]) < sample_size:
+                    samples[ci].append(encoded[ci])
+                else:
+                    j = rng.randrange(seen + 1)
+                    if j < sample_size:
+                        samples[ci][j] = encoded[ci]
+            seen += 1
+        if r.is_drained:
+            break
+    hists = [Histogram.build(sorted(s), max_buckets) for s in samples]
+    return AnalyzeColumnsResult(hists, cms, fms, seen)

@@ -251,3 +251,30 @@ class BatchExecutorsRunner:
             if batch_size < BATCH_MAX_SIZE:
                 batch_size = min(batch_size * BATCH_GROW_FACTOR, BATCH_MAX_SIZE)
         return SelectResponse(chunks=enc.finish(), exec_summaries=[self.summary])
+
+    def handle_streaming_request(self, rows_per_stream: int = 1024):
+        """Streaming path (runner.rs:471 + endpoint.rs:508-584): yield one
+        SelectResponse per ~rows_per_stream output rows so unbounded scans
+        never buffer whole results."""
+        enc = ResponseEncoder(self.dag.chunk_rows)
+        batch_size = BATCH_INITIAL_SIZE
+        emitted = 0
+        while True:
+            r = self.executor.next_batch(batch_size)
+            self.summary.num_iterations += 1
+            if r.chunk.num_rows:
+                enc.add_chunk(r.chunk, self.dag.output_offsets)
+                self.summary.num_produced_rows += r.chunk.num_rows
+            # flush whole chunks as soon as a frame's worth accumulated
+            per_frame = max(1, rows_per_stream // self.dag.chunk_rows)
+            while len(enc.chunks) >= per_frame:
+                flushed = enc.chunks[:per_frame]
+                enc.chunks = enc.chunks[per_frame:]
+                emitted += 1
+                yield SelectResponse(chunks=flushed)
+            if r.is_drained:
+                break
+            if batch_size < BATCH_MAX_SIZE:
+                batch_size = min(batch_size * BATCH_GROW_FACTOR, BATCH_MAX_SIZE)
+        # final response always carries the exec summaries, like the unary path
+        yield SelectResponse(chunks=enc.finish(), exec_summaries=[self.summary])

@@ -59,6 +59,10 @@ class Endpoint:
         self._evaluators: dict = {}
 
     def handle_request(self, req: CoprRequest) -> CoprResponse:
+        if req.tp == REQ_TYPE_ANALYZE:
+            return self._handle_analyze(req)
+        if req.tp == REQ_TYPE_CHECKSUM:
+            return self._handle_checksum(req)
         if req.tp != REQ_TYPE_DAG:
             raise ValueError(f"unsupported coprocessor request type {req.tp}")
         if self.cm is not None:
@@ -82,6 +86,80 @@ class Endpoint:
         src = MvccScanSource(snap, req.start_ts, req.ranges, statistics=Statistics())
         resp = BatchExecutorsRunner(req.dag, src).handle_request()
         return CoprResponse(resp.encode(), from_device=False)
+
+    def handle_streaming_request(self, req: CoprRequest, rows_per_stream: int = 1024):
+        """Yield CoprResponse frames (endpoint.rs streaming path — always the
+        CPU pipeline; the device path answers whole queries)."""
+        if req.tp != REQ_TYPE_DAG:
+            raise ValueError("streaming supports DAG requests only")
+        snap = self.engine.snapshot(req.context or None)
+        src = MvccScanSource(snap, req.start_ts, req.ranges, statistics=Statistics())
+        # frames flush at whole response chunks — align the chunk size so
+        # streams actually split at the requested granularity (on a copy:
+        # the caller's DagRequest framing must not change)
+        dag = DagRequest(
+            executors=req.dag.executors,
+            output_offsets=req.dag.output_offsets,
+            chunk_rows=min(req.dag.chunk_rows, rows_per_stream),
+        )
+        runner = BatchExecutorsRunner(dag, src)
+        for resp in runner.handle_streaming_request(rows_per_stream):
+            yield CoprResponse(resp.encode(), from_device=False)
+
+    def _handle_analyze(self, req: CoprRequest) -> CoprResponse:
+        from . import analyze as az
+        from .dag import build_executors
+
+        snap = self.engine.snapshot(req.context or None)
+        src = MvccBatchScanSource(snap, req.start_ts, req.ranges)
+        executor = build_executors(req.dag, src)
+        n_cols = len(executor.schema())
+        params = req.context.get("analyze", {}) if req.context else {}
+        result = az.analyze_columns(
+            executor,
+            n_cols,
+            sample_size=params.get("sample_size", 10000),
+            max_buckets=params.get("max_buckets", 256),
+        )
+        out = bytearray()
+        from ..util import codec as c
+
+        out += c.encode_var_u64(result.sampled_rows)
+        out += c.encode_var_u64(n_cols)
+        for ci in range(n_cols):
+            h = result.histograms[ci]
+            out += c.encode_var_u64(h.ndv)
+            out += c.encode_var_u64(len(h.buckets))
+            for b in h.buckets:
+                out += c.encode_compact_bytes(b.lower)
+                out += c.encode_compact_bytes(b.upper)
+                out += c.encode_var_u64(b.count)
+                out += c.encode_var_u64(b.repeats)
+            out += c.encode_var_u64(result.fm_sketches[ci].ndv())
+            out += c.encode_var_u64(result.cm_sketches[ci].count)
+        return CoprResponse(bytes(out))
+
+    def _handle_checksum(self, req: CoprRequest) -> CoprResponse:
+        from . import analyze as az
+        from ..storage.txn_types import Key
+
+        snap = self.engine.snapshot(req.context or None)
+        kvs = []
+        from ..storage.engine import CF_WRITE
+
+        for start, end in req.ranges:
+            kvs.extend(
+                snap.scan_cf(CF_WRITE, Key.from_raw(start).encoded, Key.from_raw(end).encoded)
+            )
+        r = az.checksum_range(kvs)
+        from ..util import codec as c
+
+        out = (
+            c.encode_u64(r["checksum"])
+            + c.encode_var_u64(r["total_kvs"])
+            + c.encode_var_u64(r["total_bytes"])
+        )
+        return CoprResponse(out)
 
     def _evaluator_for(self, dag: DagRequest) -> "jax_eval.JaxDagEvaluator":
         """Reuse compiled evaluators across requests, keyed by plan bytes

@@ -1,5 +1,8 @@
 """Vectorized scalar-function kernels, backend-parameterized.
 
+Arity -1 marks variadic kernels (concat, coalesce, in, case_when) — the RPN
+compiler records the actual child count per call site.
+
 The reference implements ~400 MySQL scalar functions as per-type vectorized
 fns (``tidb_query_expr/src/impl_*.rs``).  Here each kernel is written ONCE
 against the array-API module ``xp`` — ``numpy`` for the CPU oracle path,
@@ -242,3 +245,192 @@ def _if_null(xp, a, b):
 @_reg("coalesce2", 2, "same")
 def _coalesce2(xp, a, b):
     return _if_null(xp, a, b)
+
+
+@_reg("coalesce", -1, "same")
+def _coalesce(xp, *args):
+    data, nulls = args[0]
+    for d, nl in args[1:]:
+        data = xp.where(nulls, d, data)
+        nulls = nulls & nl
+    return data, nulls
+
+
+@_reg("case_when", -1, "same_case")
+def _case_when(xp, *args):
+    """case_when(c1, r1, c2, r2, ..., [else]) — first true condition wins."""
+    has_else = len(args) % 2 == 1
+    pairs = args[: len(args) - 1] if has_else else args
+    if has_else:
+        data, nulls = args[-1]
+    else:
+        d0 = pairs[1][0]
+        data = xp.zeros_like(d0)
+        nulls = xp.ones_like(pairs[1][1])
+    # apply in reverse so earlier conditions take precedence
+    for i in range(len(pairs) - 2, -1, -2):
+        cd, cn = pairs[i]
+        rd, rn = pairs[i + 1]
+        cond = (cd != 0) & ~cn
+        data = xp.where(cond, rd, data)
+        nulls = xp.where(cond, rn, nulls)
+    return data, nulls
+
+
+@_reg("in", -1, "int")
+def _in(xp, *args):
+    """a IN (v1, v2, ...) with MySQL NULL semantics: NULL if no match and
+    any operand NULL."""
+    (ad, an) = args[0]
+    found = None
+    any_null = an
+    for vd, vn in args[1:]:
+        eq = (ad == vd) & ~vn & ~an
+        found = eq if found is None else (found | eq)
+        any_null = any_null | vn
+    data = found.astype("int64")
+    nulls = ~found & any_null
+    return data, nulls
+
+
+# -- casts ------------------------------------------------------------------
+
+@_reg("cast_int_real", 1, "real")
+def _cast_int_real(xp, a):
+    ad, an = a
+    return ad.astype("float64"), an
+
+
+@_reg("cast_real_int", 1, "int")
+def _cast_real_int(xp, a):
+    ad, an = a
+    # MySQL rounds half away from zero
+    return xp.where(ad >= 0, xp.floor(ad + 0.5), xp.ceil(ad - 0.5)).astype("int64"), an
+
+
+@_reg("cast_decimal_real", 1, "real")
+def _cast_decimal_real(xp, a):
+    # decimal operands reach real-kind kernels already unscaled (rpn planning)
+    ad, an = a
+    return ad * 1.0, an
+
+
+@_reg("truncate_int", 1, "int")
+def _truncate_int(xp, a):
+    ad, an = a
+    return xp.trunc(ad).astype("int64") if ad.dtype.kind == "f" else ad, an
+
+
+# -- bytes/string family (CPU-only: BYTES exprs never route to the device) --
+
+import numpy as _np
+
+
+def _bytes_op(name, arity, rkind):
+    def deco(fn):
+        def wrapped(xp, *args):
+            datas = [a[0] for a in args]
+            nulls = args[0][1]
+            for _, nl in args[1:]:
+                nulls = nulls | nl
+            n = len(datas[0])
+            out = _np.empty(n, dtype=object)
+            for i in range(n):
+                out[i] = fn(*[d[i] for d in datas])
+            return out, nulls
+
+        KERNELS[name] = (arity, rkind, wrapped)
+        return fn
+
+    return deco
+
+
+def _int_bytes_op(name, arity):
+    """bytes-input kernels returning INT."""
+
+    def deco(fn):
+        def wrapped(xp, *args):
+            datas = [a[0] for a in args]
+            nulls = args[0][1]
+            for _, nl in args[1:]:
+                nulls = nulls | nl
+            n = len(datas[0])
+            out = _np.fromiter((fn(*[d[i] for d in datas]) for i in range(n)), dtype=_np.int64, count=n)
+            return out, nulls
+
+        KERNELS[name] = (arity, "int", wrapped)
+        return fn
+
+    return deco
+
+
+_int_bytes_op("length", 1)(lambda s: len(s))
+_int_bytes_op("bit_length", 1)(lambda s: len(s) * 8)
+_int_bytes_op("ascii", 1)(lambda s: s[0] if s else 0)
+_int_bytes_op("locate", 2)(lambda sub, s: s.find(sub) + 1)
+_bytes_op("upper", 1, "bytes")(lambda s: s.upper())
+_bytes_op("lower", 1, "bytes")(lambda s: s.lower())
+_bytes_op("reverse", 1, "bytes")(lambda s: s[::-1])
+_bytes_op("ltrim", 1, "bytes")(lambda s: s.lstrip(b" "))
+_bytes_op("rtrim", 1, "bytes")(lambda s: s.rstrip(b" "))
+_bytes_op("trim", 1, "bytes")(lambda s: s.strip(b" "))
+_bytes_op("hex", 1, "bytes")(lambda s: s.hex().upper().encode())
+_bytes_op("replace", 3, "bytes")(lambda s, frm, to: s.replace(frm, to) if frm else s)
+_bytes_op("concat", -1, "bytes")(lambda *parts: b"".join(parts))
+_bytes_op("left", 2, "bytes")(lambda s, n: s[: max(int(n), 0)])
+_bytes_op("right", 2, "bytes")(lambda s, n: s[len(s) - max(int(n), 0):] if int(n) > 0 else b"")
+
+
+def _substr(s, pos, length=None):
+    pos = int(pos)
+    if pos == 0:
+        return b""
+    if pos < 0:
+        pos = len(s) + pos
+        if pos < 0:
+            return b""
+    else:
+        pos -= 1
+    if length is None:
+        return s[pos:]
+    length = int(length)
+    if length <= 0:
+        return b""
+    return s[pos : pos + length]
+
+
+_bytes_op("substr2", 2, "bytes")(lambda s, p: _substr(s, p))
+_bytes_op("substr3", 3, "bytes")(lambda s, p, l: _substr(s, p, l))
+
+# MySQL LIKE: % any run, _ single char, backslash escape; pattern regexes cached
+import re as _re
+
+_like_cache: dict[bytes, "_re.Pattern"] = {}
+
+
+def _like_regex(pattern: bytes):
+    rx = _like_cache.get(pattern)
+    if rx is None:
+        out = []
+        i = 0
+        while i < len(pattern):
+            ch = pattern[i : i + 1]
+            if ch == b"\\" and i + 1 < len(pattern):
+                out.append(_re.escape(pattern[i + 1 : i + 2]))
+                i += 2
+                continue
+            if ch == b"%":
+                out.append(b".*")
+            elif ch == b"_":
+                out.append(b".")
+            else:
+                out.append(_re.escape(ch))
+            i += 1
+        rx = _re.compile(rb"\A" + b"".join(out) + rb"\Z", _re.DOTALL)
+        if len(_like_cache) > 1024:
+            _like_cache.clear()
+        _like_cache[pattern] = rx
+    return rx
+
+
+_int_bytes_op("like", 2)(lambda s, pat: 1 if _like_regex(pat).match(s) else 0)

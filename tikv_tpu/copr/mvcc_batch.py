@@ -40,22 +40,9 @@ _SHORT_PREFIX = 0x76  # b'v'
 
 
 def _parse_frames(buf: bytes, n: int) -> list[tuple[bytes, bytes]]:
-    import struct as _struct
+    from ..native.engine import parse_frames
 
-    u32 = _struct.Struct("<I")
-    out = []
-    off = 0
-    for _ in range(n):
-        (klen,) = u32.unpack_from(buf, off)
-        off += 4
-        k = buf[off : off + klen]
-        off += klen
-        (vlen,) = u32.unpack_from(buf, off)
-        off += 4
-        v = buf[off : off + vlen]
-        off += vlen
-        out.append((k, v))
-    return out
+    return list(parse_frames(buf, n))
 
 
 def _decode_user_keys(key_rows: np.ndarray) -> list[bytes]:

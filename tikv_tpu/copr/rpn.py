@@ -84,6 +84,7 @@ class RpnExpression:
 
 
 DIVIDE_FRAC_INCR = 4  # MySQL: decimal division adds 4 frac digits
+_VARIADIC_MIN = {"in": 2, "case_when": 2, "concat": 1, "coalesce": 1}
 
 
 def compile_expr(expr: Expr, schema: list[tuple[EvalType, int]]) -> RpnExpression:
@@ -105,7 +106,12 @@ def _compile(expr: Expr, schema, nodes: list[RpnNode]) -> tuple[EvalType, int]:
         if expr.op not in KERNELS:
             raise ValueError(f"unsupported scalar function {expr.op!r}")
         arity, rkind, _ = KERNELS[expr.op]
-        if arity != len(expr.children):
+        if arity == -1:
+            min_arity = _VARIADIC_MIN.get(expr.op, 1)
+            if len(expr.children) < min_arity:
+                raise ValueError(f"{expr.op} needs at least {min_arity} arguments")
+            arity = len(expr.children)
+        elif arity != len(expr.children):
             raise ValueError(f"{expr.op} expects {arity} args, got {len(expr.children)}")
         child_types = [_compile(c, schema, nodes) for c in expr.children]
         et, frac, scale_by = _infer(expr.op, rkind, child_types)
@@ -151,6 +157,8 @@ def _infer(op: str, rkind: str, child_types) -> tuple[EvalType, int, tuple[int, 
                 for t, f in child_types
             )
         return EvalType.REAL, 0, scale_by
+    if rkind == "bytes":
+        return EvalType.BYTES, 0, scale_by
     if rkind == "same":
         return types[0], fracs[0], scale_by
     if rkind == "same_2":
@@ -160,6 +168,20 @@ def _infer(op: str, rkind: str, child_types) -> tuple[EvalType, int, tuple[int, 
             scale_by = (1, 10 ** (f - fracs[1]), 10 ** (f - fracs[2]))
             return EvalType.DECIMAL, f, scale_by
         return types[1], fracs[1], scale_by
+    if rkind == "same_case":
+        # case_when(c1, r1, ..., [else]): typed like the result operands
+        result_positions = [i for i in range(1, len(child_types), 2)]
+        if len(child_types) % 2 == 1:
+            result_positions.append(len(child_types) - 1)
+        rtypes = [types[i] for i in result_positions]
+        rfracs = [fracs[i] for i in result_positions]
+        if EvalType.DECIMAL in rtypes:
+            f = max(rfracs)
+            sb = [1] * len(child_types)
+            for i in result_positions:
+                sb[i] = 10 ** (f - fracs[i])
+            return EvalType.DECIMAL, f, tuple(sb)
+        return rtypes[0], rfracs[0], scale_by
     raise AssertionError(rkind)
 
 

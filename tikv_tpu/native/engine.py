@@ -90,6 +90,22 @@ def native_available() -> bool:
     return _load() is not None
 
 
+def parse_frames(buf: bytes, n: int):
+    """Iterate (key, value) pairs of the native scan frame format
+    (klen u32le | key | vlen u32le | val) — THE decoder for this layout."""
+    off = 0
+    for _ in range(n):
+        (klen,) = _U32.unpack_from(buf, off)
+        off += 4
+        k = buf[off : off + klen]
+        off += klen
+        (vlen,) = _U32.unpack_from(buf, off)
+        off += 4
+        v = buf[off : off + vlen]
+        off += vlen
+        yield k, v
+
+
 def _take(lib, ptr, length) -> bytes:
     try:
         return ctypes.string_at(ptr, length)
@@ -238,17 +254,7 @@ class NativeSnapshot(Snapshot):
 
     def scan_cf(self, cf, start, end, limit=None, reverse=False) -> Iterator[tuple[bytes, bytes]]:
         n, buf = self.scan_raw(cf, start, end, limit, reverse)
-        off = 0
-        for _ in range(n):
-            (klen,) = _U32.unpack_from(buf, off)
-            off += 4
-            k = buf[off : off + klen]
-            off += klen
-            (vlen,) = _U32.unpack_from(buf, off)
-            off += 4
-            v = buf[off : off + vlen]
-            off += vlen
-            yield k, v
+        yield from parse_frames(buf, n)
 
 
 class NativeEngine(KvEngine):

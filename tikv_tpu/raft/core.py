@@ -76,6 +76,7 @@ class Message:
     snapshot: Snapshot | None = None
     context: bytes = b""  # read-index correlation
     hb_round: int = 0  # heartbeat round tag (lease accounting)
+    force: bool = False  # leadership-transfer vote (bypasses stickiness)
 
 
 @dataclass
@@ -233,7 +234,7 @@ class RaftNode:
         self._deferred_reads.clear()
         self._pending_reads.clear()
 
-    def _become_candidate(self) -> None:
+    def _become_candidate(self, force: bool = False) -> None:
         self.term += 1
         self.role = Role.CANDIDATE
         self.vote = self.id
@@ -251,6 +252,7 @@ class RaftNode:
                     MsgType.VOTE, self.id, peer, self.term,
                     log_index=self.log.last_index(),
                     log_term=self.log.term_at(self.log.last_index()) or 0,
+                    force=force,
                 )
             )
 
@@ -277,8 +279,11 @@ class RaftNode:
         elif self._elapsed >= self._randomized_timeout:
             self._become_candidate()
 
-    def campaign(self) -> None:
-        self._become_candidate()
+    def campaign(self, force: bool = True) -> None:
+        """Explicit campaign = leadership transfer (MsgTimeoutNow semantics):
+        its votes bypass leader stickiness.  Timeout campaigns (tick) stay
+        sticky so natural disruptions cannot break an active lease."""
+        self._become_candidate(force=force)
 
     def propose(self, data: bytes) -> int | None:
         """Leader appends a proposal; returns its index (None if not leader)."""
@@ -350,6 +355,18 @@ class RaftNode:
     # -------------------------------------------------------------- messages
 
     def step(self, m: Message) -> None:
+        if (
+            m.type == MsgType.VOTE
+            and not m.force
+            and m.term > self.term
+            and self.leader_id is not None
+            and self._elapsed < self.election_tick
+        ):
+            # leader stickiness (raft §6 / raft-rs check_quorum): a node that
+            # recently heard from a live leader ignores disruptive campaigns —
+            # this is what makes leader leases sound
+            self._send(Message(MsgType.VOTE_RESP, self.id, m.frm, self.term, reject=True))
+            return
         if m.term > self.term:
             leader = m.frm if m.type in (MsgType.APPEND, MsgType.HEARTBEAT, MsgType.SNAPSHOT) else None
             self._become_follower(m.term, leader)

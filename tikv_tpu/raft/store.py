@@ -472,7 +472,7 @@ class StorePeer:
         (store/snap.rs; meta rides along like SnapshotMeta)."""
         eng = self.store.engine
         out = bytearray()
-        out += codec.encode_compact_bytes(encode_region(self.region))
+        out += codec.encode_compact_bytes(encode_region(self.region, self.merging))
         start = keys.data_key(self.region.start_key)
         end = keys.data_end_key(self.region.end_key)
         for cf in DATA_CFS:

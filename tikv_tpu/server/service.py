@@ -312,22 +312,56 @@ class KvService:
     # -- coprocessor --------------------------------------------------------
 
     def coprocessor(self, req: dict) -> dict:
-        """req: {tp, dag (DagRequest in-process, or wire dict), ranges, start_ts}."""
+        """req: {tp, dag (DagRequest in-process, or wire dict; optional for
+        CHECKSUM), ranges, start_ts}."""
         assert self.copr is not None, "coprocessor endpoint not wired"
-        dag = req["dag"]
-        if isinstance(dag, dict):
-            from ..copr.dag_wire import dag_from_wire
-
-            dag = dag_from_wire(dag)
-        creq = CoprRequest(
-            tp=req.get("tp", REQ_TYPE_DAG),
-            dag=dag,
-            ranges=[tuple(r) for r in req["ranges"]],
-            start_ts=req["start_ts"],
-            context=req.get("context") or {},
-        )
         try:
+            dag = req.get("dag")
+            if isinstance(dag, dict):
+                from ..copr.dag_wire import dag_from_wire
+
+                dag = dag_from_wire(dag)
+            tp = req.get("tp", REQ_TYPE_DAG)
+            if dag is None and tp != 105:
+                return {"error": {"other": "dag required for this request type"}}
+            creq = CoprRequest(
+                tp=tp,
+                dag=dag,
+                ranges=[tuple(r) for r in req["ranges"]],
+                start_ts=req["start_ts"],
+                context=req.get("context") or {},
+            )
             r = self.copr.handle_request(creq)
             return {"data": r.data, "from_device": r.from_device}
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    def coprocessor_stream(self, req: dict) -> dict:
+        """Streamed DAG execution: one wire response carrying ordered frames
+        (the TCP layer multiplexes whole responses; chunked frames preserve
+        the reference's bounded-memory property server-side)."""
+        assert self.copr is not None, "coprocessor endpoint not wired"
+        try:
+            dag = req.get("dag")
+            if isinstance(dag, dict):
+                from ..copr.dag_wire import dag_from_wire
+
+                dag = dag_from_wire(dag)
+            if dag is None:
+                return {"error": {"other": "dag required"}}
+            creq = CoprRequest(
+                tp=req.get("tp", REQ_TYPE_DAG),
+                dag=dag,
+                ranges=[tuple(r) for r in req["ranges"]],
+                start_ts=req["start_ts"],
+                context=req.get("context") or {},
+            )
+            frames = [
+                r.data
+                for r in self.copr.handle_streaming_request(
+                    creq, req.get("rows_per_stream", 1024)
+                )
+            ]
+            return {"frames": frames}
         except Exception as e:  # noqa: BLE001
             return {"error": _err(e)}
