@@ -92,16 +92,18 @@ def test_checksum_endpoint_detects_change():
     eng = LocalEngine(product_engine())
     ep = Endpoint(eng, enable_device=False)
     dag = DagRequest(executors=[TableScan(TABLE_ID, PRODUCT_COLUMNS)])
-    req = lambda: CoprRequest(REQ_TYPE_CHECKSUM, dag, [record_range(TABLE_ID)], 200, context={})
-    c1 = ep.handle_request(req()).data
-    c2 = ep.handle_request(req()).data
+    req = lambda ts: CoprRequest(REQ_TYPE_CHECKSUM, dag, [record_range(TABLE_ID)], ts, context={})
+    c1 = ep.handle_request(req(200)).data
+    c2 = ep.handle_request(req(200)).data
     assert c1 == c2
-    # mutate one key → checksum changes
+    # mutate one key → checksum changes ABOVE the write's commit ts, and the
+    # snapshot at the old ts is unaffected (MVCC-consistent checksum)
     from fixtures import put_committed
     from tikv_tpu.copr.table import record_key
 
     put_committed(eng.kv, record_key(TABLE_ID, 1), b"tampered", 300, 301)
-    c3 = ep.handle_request(req()).data
+    assert ep.handle_request(req(200)).data == c1
+    c3 = ep.handle_request(req(400)).data
     assert c3 != c1
 
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 import random
 from dataclasses import dataclass, field
 
+from . import datum as datum_mod
 from ..util import codec
 
 # ---------------------------------------------------------------------------
@@ -192,9 +193,9 @@ def analyze_columns(
                 c = chunk.columns[ci]
                 flag, value = c.datum_at(row)
                 out = bytearray()
-                from . import datum as datum_mod
-
-                datum_mod.encode_datum(out, flag, value)
+                # memcomparable (for_key) encoding: histogram bucket bounds
+                # sort by VALUE order, not varint byte order
+                datum_mod.encode_datum(out, flag, value, for_key=True)
                 encoded.append(bytes(out))
             for ci in range(n_columns):
                 cms[ci].insert(encoded[ci])

@@ -140,16 +140,19 @@ class Endpoint:
         return CoprResponse(bytes(out))
 
     def _handle_checksum(self, req: CoprRequest) -> CoprResponse:
+        """MVCC-consistent checksum: the logical rows visible at start_ts
+        (checksum.rs scans through the snapshot store), so large values in
+        CF_DEFAULT are covered and replicas with different physical version
+        histories but identical logical data agree."""
         from . import analyze as az
+        from ..storage.mvcc import ForwardScanner
         from ..storage.txn_types import Key
 
         snap = self.engine.snapshot(req.context or None)
         kvs = []
-        from ..storage.engine import CF_WRITE
-
         for start, end in req.ranges:
             kvs.extend(
-                snap.scan_cf(CF_WRITE, Key.from_raw(start).encoded, Key.from_raw(end).encoded)
+                ForwardScanner(snap, req.start_ts, Key.from_raw(start), Key.from_raw(end))
             )
         r = az.checksum_range(kvs)
         from ..util import codec as c

@@ -378,7 +378,7 @@ _bytes_op("hex", 1, "bytes")(lambda s: s.hex().upper().encode())
 _bytes_op("replace", 3, "bytes")(lambda s, frm, to: s.replace(frm, to) if frm else s)
 _bytes_op("concat", -1, "bytes")(lambda *parts: b"".join(parts))
 _bytes_op("left", 2, "bytes")(lambda s, n: s[: max(int(n), 0)])
-_bytes_op("right", 2, "bytes")(lambda s, n: s[len(s) - max(int(n), 0):] if int(n) > 0 else b"")
+_bytes_op("right", 2, "bytes")(lambda s, n: s[max(len(s) - int(n), 0):] if int(n) > 0 else b"")
 
 
 def _substr(s, pos, length=None):

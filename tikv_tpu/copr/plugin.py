@@ -1,0 +1,161 @@
+"""Coprocessor V2: pluggable raw-KV coprocessors.
+
+Re-expression of ``src/coprocessor_v2`` + ``components/coprocessor_plugin_api``
+(plugin_api.rs:20 ``CoprocessorPlugin``, storage_api.rs:21 ``RawStorage``,
+plugin_registry.rs:74/:218 dylib registry with hot reload): plugins are
+versioned handlers operating on raw KV through a narrow storage API, routed
+by ``copr_name`` + a semver requirement.  The reference loads Rust dylibs;
+here plugins are Python classes registered programmatically or loaded from a
+plugin directory (one module per plugin, hot-reloadable by mtime).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import threading
+
+
+class PluginError(Exception):
+    pass
+
+
+class RawStorage:
+    """The narrow storage surface handed to plugins (storage_api.rs:21)."""
+
+    def __init__(self, storage, ctx: dict | None = None):
+        self._storage = storage
+        self._ctx = ctx
+
+    def get(self, key: bytes) -> bytes | None:
+        return self._storage.raw_get(key, self._ctx)
+
+    def batch_get(self, keys: list[bytes]) -> list[tuple[bytes, bytes]]:
+        return self._storage.raw_batch_get(keys, self._ctx)
+
+    def scan(self, start: bytes, end: bytes | None, limit: int | None = None):
+        return self._storage.raw_scan(start, end, limit, self._ctx)
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self._storage.raw_put(key, value, self._ctx)
+
+    def batch_put(self, pairs: list[tuple[bytes, bytes]]) -> None:
+        self._storage.raw_batch_put(pairs, self._ctx)
+
+    def delete(self, key: bytes) -> None:
+        self._storage.raw_delete(key, self._ctx)
+
+    def delete_range(self, start: bytes, end: bytes) -> None:
+        self._storage.raw_delete_range(start, end, self._ctx)
+
+
+class CoprocessorPlugin:
+    """Plugin ABI (plugin_api.rs:20): subclass and implement on_request."""
+
+    NAME: str = ""
+    VERSION: tuple[int, int, int] = (0, 0, 0)
+
+    def on_raw_coprocessor_request(self, ranges, request: bytes, storage: RawStorage) -> bytes:
+        raise NotImplementedError
+
+
+def _semver_match(version: tuple[int, int, int], req: str) -> bool:
+    """Caret-style requirement: "1", "1.2", "1.2.3" match per semver caret."""
+    if not req or req == "*":
+        return True
+    parts = [int(x) for x in req.split(".")]
+    if parts[0] != version[0]:
+        return False
+    return tuple(parts) <= version[: len(parts)]
+
+
+class PluginRegistry:
+    """Versioned registry + directory hot-reload (plugin_registry.rs:74)."""
+
+    def __init__(self, plugin_dir: str | None = None):
+        self._mu = threading.RLock()
+        self._plugins: dict[str, CoprocessorPlugin] = {}
+        self.plugin_dir = plugin_dir
+        self._mtimes: dict[str, float] = {}
+        self.load_errors: dict[str, str] = {}
+
+    def register(self, plugin: CoprocessorPlugin) -> None:
+        if not plugin.NAME:
+            raise PluginError("plugin must define NAME")
+        with self._mu:
+            self._plugins[plugin.NAME] = plugin
+
+    def unregister(self, name: str) -> None:
+        with self._mu:
+            self._plugins.pop(name, None)
+
+    def get(self, name: str, version_req: str = "*") -> CoprocessorPlugin:
+        self._maybe_reload()
+        with self._mu:
+            p = self._plugins.get(name)
+        if p is None:
+            raise PluginError(f"no such plugin {name!r}")
+        if not _semver_match(p.VERSION, version_req):
+            raise PluginError(
+                f"plugin {name!r} version {'.'.join(map(str, p.VERSION))} "
+                f"does not satisfy {version_req!r}"
+            )
+        return p
+
+    def list_plugins(self) -> dict[str, tuple[int, int, int]]:
+        self._maybe_reload()
+        with self._mu:
+            return {n: p.VERSION for n, p in self._plugins.items()}
+
+    # -- directory loading (dylib hot-reload equivalent) --------------------
+
+    def _maybe_reload(self) -> None:
+        if self.plugin_dir is None or not os.path.isdir(self.plugin_dir):
+            return
+        for fn in os.listdir(self.plugin_dir):
+            if not fn.endswith(".py") or fn.startswith("_"):
+                continue
+            path = os.path.join(self.plugin_dir, fn)
+            mtime = os.path.getmtime(path)
+            if self._mtimes.get(path) == mtime:
+                continue
+            self._mtimes[path] = mtime
+            try:
+                self._load_file(path)
+                self.load_errors.pop(path, None)
+            except Exception as e:  # noqa: BLE001 — one bad plugin file must
+                # not break dispatch for the healthy ones (registry parity)
+                self.load_errors[path] = repr(e)
+
+    def _load_file(self, path: str) -> None:
+        name = "tikv_tpu_plugin_" + os.path.basename(path)[:-3]
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        # a plugin module exposes PLUGIN (instance) or declare_plugin()
+        plugin = getattr(mod, "PLUGIN", None)
+        if plugin is None and hasattr(mod, "declare_plugin"):
+            plugin = mod.declare_plugin()
+        if plugin is not None:
+            self.register(plugin)
+
+
+class CoprV2Endpoint:
+    """Route RawCoprocessorRequests to plugins (src/coprocessor_v2/endpoint.rs:52)."""
+
+    def __init__(self, storage, registry: PluginRegistry | None = None):
+        self.storage = storage
+        self.registry = registry or PluginRegistry()
+
+    def handle_request(self, req: dict) -> dict:
+        """req: {copr_name, copr_version_req, data, ranges, context}."""
+        try:
+            plugin = self.registry.get(req["copr_name"], req.get("copr_version_req", "*"))
+            storage = RawStorage(self.storage, req.get("context"))
+            ranges = [tuple(r) for r in req.get("ranges", [])]
+            data = plugin.on_raw_coprocessor_request(ranges, req.get("data", b""), storage)
+            return {"data": data}
+        except PluginError as e:
+            return {"error": {"other": str(e)}}
+        except Exception as e:  # noqa: BLE001 — plugin faults stay contained
+            return {"error": {"other": f"plugin error: {e!r}"}}

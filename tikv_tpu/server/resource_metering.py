@@ -1,0 +1,90 @@
+"""Per-request resource attribution.
+
+Re-expression of ``components/resource_metering`` (cpu/future_ext.rs tagging,
+cpu/recorder sampling, reporter.rs top-N): requests tagged with a resource
+group accumulate CPU time; a reporter surfaces the top consumers per window.
+The reference samples /proc per-thread; here attribution wraps handler
+execution with thread-CPU clocks — same accounting surface.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+
+class ResourceTagFactory:
+    """Accumulates CPU seconds and op counts per resource-group tag."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._cpu: dict[bytes, float] = {}
+        self._ops: dict[bytes, int] = {}
+
+    @contextmanager
+    def attach(self, tag: bytes):
+        t0 = time.thread_time()
+        try:
+            yield
+        finally:
+            dt = time.thread_time() - t0
+            with self._mu:
+                self._cpu[tag] = self._cpu.get(tag, 0.0) + dt
+                self._ops[tag] = self._ops.get(tag, 0) + 1
+
+    def snapshot(self) -> dict[bytes, dict]:
+        with self._mu:
+            return {
+                tag: {"cpu_secs": self._cpu[tag], "ops": self._ops.get(tag, 0)}
+                for tag in self._cpu
+            }
+
+    def reset(self) -> dict[bytes, dict]:
+        with self._mu:
+            out = {
+                tag: {"cpu_secs": self._cpu[tag], "ops": self._ops.get(tag, 0)}
+                for tag in self._cpu
+            }
+            self._cpu.clear()
+            self._ops.clear()
+            return out
+
+
+class Reporter:
+    """Windowed top-N reporting (reporter.rs): collect per interval, keep the
+    heaviest groups, ship them to a receiver callback."""
+
+    def __init__(self, tags: ResourceTagFactory, top_n: int = 10, interval: float = 1.0, receiver=None):
+        self.tags = tags
+        self.top_n = top_n
+        self.interval = interval
+        self.receiver = receiver or (lambda report: None)
+        self.reports: deque[dict] = deque(maxlen=256)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._stop.wait(self.interval)
+            self.tick()
+
+    def tick(self) -> dict:
+        window = self.tags.reset()
+        top = dict(
+            sorted(window.items(), key=lambda kv: kv[1]["cpu_secs"], reverse=True)[: self.top_n]
+        )
+        report = {"top": top, "groups": len(window)}
+        self.reports.append(report)
+        self.receiver(report)
+        return report
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)

@@ -79,14 +79,10 @@ class Server:
                 req_id, method, request = wire.loads(frame)
 
                 def run(req_id=req_id, method=method, request=request):
-                    handler = getattr(self.service, method, None)
-                    if handler is None or method.startswith("_"):
-                        resp = {"error": {"other": f"unknown method {method}"}}
-                    else:
-                        try:
-                            resp = handler(request)
-                        except Exception as e:  # noqa: BLE001 — wire boundary
-                            resp = {"error": {"other": repr(e)}}
+                    try:
+                        resp = self.service.dispatch(method, request)
+                    except Exception as e:  # noqa: BLE001 — wire boundary
+                        resp = {"error": {"other": repr(e)}}
                     payload = wire.dumps([req_id, resp])
                     with send_mu:
                         try:

@@ -12,7 +12,7 @@ way the reference maps storage errors into kvproto errors.
 
 from __future__ import annotations
 
-from ..copr.endpoint import CoprRequest, Endpoint, REQ_TYPE_DAG
+from ..copr.endpoint import CoprRequest, Endpoint, REQ_TYPE_CHECKSUM, REQ_TYPE_DAG
 from ..raft.region import EpochError, NotLeaderError
 from ..storage.mvcc.reader import KeyIsLockedError, WriteConflictError
 from ..storage.mvcc.txn import AlreadyExistsError, TxnError
@@ -57,9 +57,29 @@ def _err(e: Exception) -> dict:
 class KvService:
     """All handlers of one store (kv.rs handler inventory)."""
 
-    def __init__(self, storage: Storage, copr: Endpoint | None = None):
+    def __init__(self, storage: Storage, copr: Endpoint | None = None, copr_v2=None, resource_tags=None):
         self.storage = storage
         self.copr = copr
+        self.copr_v2 = copr_v2
+        self.resource_tags = resource_tags
+
+    def dispatch(self, method: str, req: dict):
+        """Invoke a handler with resource-group attribution (the tagged-future
+        wrapper from resource_metering/cpu/future_ext.rs)."""
+        handler = getattr(self, method, None)
+        if handler is None or method.startswith("_") or method == "dispatch":
+            return {"error": {"other": f"unknown method {method}"}}
+        tag = (req.get("context") or {}).get("resource_group", b"default")
+        if self.resource_tags is not None:
+            with self.resource_tags.attach(tag):
+                return handler(req)
+        return handler(req)
+
+    def raw_coprocessor(self, req: dict) -> dict:
+        """Coprocessor V2 plugin dispatch (kv.rs:330 raw_coprocessor)."""
+        if self.copr_v2 is None:
+            return {"error": {"other": "coprocessor v2 not enabled"}}
+        return self.copr_v2.handle_request(req)
 
     # -- transactional KV ---------------------------------------------------
 
@@ -322,7 +342,7 @@ class KvService:
 
                 dag = dag_from_wire(dag)
             tp = req.get("tp", REQ_TYPE_DAG)
-            if dag is None and tp != 105:
+            if dag is None and tp != REQ_TYPE_CHECKSUM:
                 return {"error": {"other": "dag required for this request type"}}
             creq = CoprRequest(
                 tp=tp,
