@@ -1,0 +1,139 @@
+"""Follower stale reads (resolved-ts gated) + MySQL time types."""
+
+import pytest
+
+from tikv_tpu.copr import mysql_time as mt
+from tikv_tpu.pd.client import MockPd
+from tikv_tpu.raft.cluster import FIRST_REGION_ID, Cluster
+from tikv_tpu.raft.raftkv import RaftKv
+from tikv_tpu.sidecar.resolved_ts import ResolvedTsEndpoint
+from tikv_tpu.storage.mvcc import PointGetter
+from tikv_tpu.storage.storage import Storage
+from tikv_tpu.storage.txn.commands import Commit, Prewrite
+from tikv_tpu.storage.txn_types import Key, Mutation
+
+
+def test_follower_stale_read():
+    pd = MockPd()
+    cluster = Cluster(3, pd=pd)
+    cluster.run()
+    rts = ResolvedTsEndpoint(pd)
+    for s in cluster.stores.values():
+        rts.attach_store(s)
+    leader = cluster.wait_leader(FIRST_REGION_ID)
+    store = Storage(engine=cluster.raftkv(leader.store.store_id))
+    ctx = {"region_id": FIRST_REGION_ID}
+    ts = pd.get_tso()
+    store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"sk"), b"sv")], b"sk", ts), ctx)
+    store.sched_txn_command(Commit([Key.from_raw(b"sk")], ts, pd.get_tso()), ctx)
+    watermark = rts.advance_all()[FIRST_REGION_ID]
+
+    follower_sid = next(s for s in cluster.stores if s != leader.store.store_id)
+    fkv = RaftKv(cluster.stores[follower_sid], pump=cluster.process, resolved_ts=rts)
+    # read on the FOLLOWER at the watermark — no leader involved
+    snap = fkv.snapshot({"region_id": FIRST_REGION_ID, "stale_read": True, "read_ts": watermark})
+    assert PointGetter(snap, watermark).get(Key.from_raw(b"sk")) == b"sv"
+    # above the watermark → DataNotReady (client must retry/fall back)
+    with pytest.raises(RaftKv.DataNotReadyError):
+        fkv.snapshot({"region_id": FIRST_REGION_ID, "stale_read": True, "read_ts": watermark + 10})
+    # pending txn pins the watermark; stale read at old watermark still works
+    ts2 = pd.get_tso()
+    store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"p"), b"x")], b"p", ts2), ctx)
+    w2 = rts.advance_all()[FIRST_REGION_ID]
+    assert w2 == ts2 - 1
+    snap = fkv.snapshot({"region_id": FIRST_REGION_ID, "stale_read": True, "read_ts": w2})
+    assert PointGetter(snap, w2).get(Key.from_raw(b"sk")) == b"sv"
+
+
+def test_datetime_pack_order_and_roundtrip():
+    a = mt.parse_datetime("2024-03-15 10:30:45.123456")
+    b = mt.parse_datetime("2024-03-15 10:30:46")
+    c = mt.parse_datetime("2025-01-01")
+    assert a < b < c  # chronological == integer order
+    assert mt.unpack_datetime(a) == (2024, 3, 15, 10, 30, 45, 123456)
+    assert mt.format_datetime(a) == "2024-03-15 10:30:45.123456"
+    assert mt.format_datetime(c) == "2025-01-01 00:00:00"
+    with pytest.raises(ValueError):
+        mt.parse_datetime("2024-13-01")
+
+
+def test_duration_roundtrip():
+    d = mt.parse_duration("-12:34:56.789000")
+    assert d < 0
+    assert mt.format_duration(d) == "-12:34:56.789000"
+    assert mt.parse_duration("01:02:03") == mt.duration_nanos(1, 2, 3)
+    assert mt.format_duration(mt.duration_nanos(100, 0, 0)) == "100:00:00"
+
+
+def test_time_kernels_cpu_and_device_identical():
+    """year/month/day kernels are pure int ops — device-eligible, and the
+    device path matches the CPU path byte-for-byte."""
+    import sys, os
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from copr_fixtures import TABLE_ID
+    from tikv_tpu.copr.aggr import AggDescriptor
+    from tikv_tpu.copr.dag import Aggregation, BatchExecutorsRunner, DagRequest, Selection, TableScan
+    from tikv_tpu.copr.datatypes import ColumnInfo, FieldType, FieldTypeTp
+    from tikv_tpu.copr.executors import FixtureScanSource
+    from tikv_tpu.copr.jax_eval import JaxDagEvaluator, supports
+    from tikv_tpu.copr.rpn import call, col, const_int
+    from tikv_tpu.copr.table import encode_row, record_key
+
+    cols = [
+        ColumnInfo(1, FieldType.int64(), is_pk_handle=True),
+        ColumnInfo(2, FieldType(FieldTypeTp.DATETIME)),
+    ]
+    kvs = []
+    for i in range(200):
+        packed = mt.pack_datetime(2020 + (i % 5), 1 + (i % 12), 1 + (i % 28), i % 24)
+        kvs.append((record_key(TABLE_ID, i), encode_row(cols[1:], [packed])))
+    dag = DagRequest(
+        executors=[
+            TableScan(TABLE_ID, cols),
+            Selection([call("ge", call("year", col(1)), const_int(2022))]),
+            Aggregation([], [AggDescriptor("count", None), AggDescriptor("max", call("month", col(1)))]),
+        ]
+    )
+    assert supports(dag)
+    cpu = BatchExecutorsRunner(dag, FixtureScanSource(kvs)).handle_request()
+    dev = JaxDagEvaluator(DagRequest(executors=dag.executors), block_rows=64).run(FixtureScanSource(kvs))
+    assert cpu.encode() == dev.encode()
+    count, max_month = cpu.iter_rows()[0]
+    expect = [i for i in range(200) if 2020 + (i % 5) >= 2022]
+    assert count == len(expect)
+
+
+def test_lagging_follower_refuses_stale_read():
+    """RegionReadProgress: a follower that hasn't applied the watermark's
+    paired index must refuse rather than serve missing data."""
+    from tikv_tpu.raft.store import RegionPacketFilter
+    from tikv_tpu.raft.core import MsgType
+
+    pd = MockPd()
+    cluster = Cluster(3, pd=pd)
+    cluster.run()
+    rts = ResolvedTsEndpoint(pd)
+    for s in cluster.stores.values():
+        rts.attach_store(s)
+    leader = cluster.wait_leader(FIRST_REGION_ID)
+    lagging = next(s for s in cluster.stores if s != leader.store.store_id)
+    # cut replication to the lagging follower, then commit new data
+    cluster.transport.filters.append(
+        RegionPacketFilter(FIRST_REGION_ID, lagging, {MsgType.APPEND, MsgType.SNAPSHOT, MsgType.HEARTBEAT})
+    )
+    store = Storage(engine=cluster.raftkv(leader.store.store_id))
+    ctx = {"region_id": FIRST_REGION_ID}
+    ts = pd.get_tso()
+    store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"lk"), b"lv")], b"lk", ts), ctx)
+    store.sched_txn_command(Commit([Key.from_raw(b"lk")], ts, pd.get_tso()), ctx)
+    w = rts.advance_all()[FIRST_REGION_ID]
+    fkv = RaftKv(cluster.stores[lagging], pump=cluster.process, resolved_ts=rts)
+    # the lagging follower must REFUSE (its applied < required index)
+    with pytest.raises(RaftKv.DataNotReadyError):
+        fkv.snapshot({"region_id": FIRST_REGION_ID, "stale_read": True, "read_ts": w})
+    # heal; once caught up, the same read succeeds
+    cluster.transport.filters.clear()
+    cluster.tick(5)
+    snap = fkv.snapshot({"region_id": FIRST_REGION_ID, "stale_read": True, "read_ts": w})
+    assert PointGetter(snap, w).get(Key.from_raw(b"lk")) == b"lv"

@@ -434,3 +434,7 @@ def _like_regex(pattern: bytes):
 
 
 _int_bytes_op("like", 2)(lambda s, pat: 1 if _like_regex(pat).match(s) else 0)
+
+
+# time-type kernels register themselves into KERNELS on import
+from . import mysql_time as _mysql_time  # noqa: E402,F401

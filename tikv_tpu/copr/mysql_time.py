@@ -1,0 +1,142 @@
+"""MySQL DATETIME/DURATION value semantics.
+
+Re-expression of ``tidb_query_datatype/src/codec/mysql/{time/,duration.rs}``:
+DATETIME is the packed-u64 layout TiDB uses on the wire —
+
+    ((year*13 + month) << 46) | (day << 41) | (hour << 36)
+      | (minute << 30) | (second << 24) | microsecond
+
+which keeps chronological order == integer order, so packed times flow
+through the INT comparison/min/max kernels (and the TPU path) unchanged.
+DURATION is signed nanoseconds.  Field-extraction kernels are pure bit
+arithmetic — vectorizable on both backends, registered into the shared
+kernel table.
+"""
+
+from __future__ import annotations
+
+from .kernels import KERNELS, _reg
+
+_MICRO_BITS = 24
+_SECOND_BITS = 6
+_MINUTE_BITS = 6
+_HOUR_BITS = 5
+_DAY_BITS = 5
+
+_SEC_SHIFT = _MICRO_BITS
+_MIN_SHIFT = _SEC_SHIFT + _SECOND_BITS
+_HOUR_SHIFT = _MIN_SHIFT + _MINUTE_BITS
+_DAY_SHIFT = _HOUR_SHIFT + _HOUR_BITS
+_YM_SHIFT = _DAY_SHIFT + _DAY_BITS  # == 46
+
+
+def pack_datetime(
+    year: int, month: int, day: int, hour: int = 0, minute: int = 0,
+    second: int = 0, micro: int = 0,
+) -> int:
+    if not (1 <= month <= 12 and 1 <= day <= 31):
+        raise ValueError(f"invalid date {year}-{month}-{day}")
+    if not (0 <= hour < 24 and 0 <= minute < 60 and 0 <= second < 60 and 0 <= micro < 1_000_000):
+        raise ValueError("invalid time component")
+    ym = year * 13 + month
+    return (
+        (ym << _YM_SHIFT)
+        | (day << _DAY_SHIFT)
+        | (hour << _HOUR_SHIFT)
+        | (minute << _MIN_SHIFT)
+        | (second << _SEC_SHIFT)
+        | micro
+    )
+
+
+def unpack_datetime(packed: int) -> tuple[int, int, int, int, int, int, int]:
+    ym = packed >> _YM_SHIFT
+    return (
+        ym // 13,
+        ym % 13,
+        (packed >> _DAY_SHIFT) & 0x1F,
+        (packed >> _HOUR_SHIFT) & 0x1F,
+        (packed >> _MIN_SHIFT) & 0x3F,
+        (packed >> _SEC_SHIFT) & 0x3F,
+        packed & 0xFFFFFF,
+    )
+
+
+def parse_datetime(text: str) -> int:
+    """'YYYY-MM-DD[ HH:MM:SS[.ffffff]]' → packed."""
+    date_part, _, time_part = text.strip().partition(" ")
+    y, m, d = (int(x) for x in date_part.split("-"))
+    hh = mm = ss = micro = 0
+    if time_part:
+        hms, _, frac = time_part.partition(".")
+        hh, mm, ss = (int(x) for x in hms.split(":"))
+        if frac:
+            micro = int(frac.ljust(6, "0")[:6])
+    return pack_datetime(y, m, d, hh, mm, ss, micro)
+
+
+def format_datetime(packed: int) -> str:
+    y, m, d, hh, mm, ss, micro = unpack_datetime(packed)
+    base = f"{y:04d}-{m:02d}-{d:02d} {hh:02d}:{mm:02d}:{ss:02d}"
+    return f"{base}.{micro:06d}" if micro else base
+
+
+# -- duration ---------------------------------------------------------------
+
+NANOS_PER_SEC = 1_000_000_000
+
+
+def duration_nanos(hours: int = 0, minutes: int = 0, seconds: int = 0, micro: int = 0, neg: bool = False) -> int:
+    total = ((hours * 60 + minutes) * 60 + seconds) * NANOS_PER_SEC + micro * 1000
+    return -total if neg else total
+
+
+def parse_duration(text: str) -> int:
+    text = text.strip()
+    neg = text.startswith("-")
+    if neg:
+        text = text[1:]
+    hms, _, frac = text.partition(".")
+    parts = [int(x) for x in hms.split(":")]
+    # MySQL left-aligns: '11:30' is HH:MM (11:30:00), not MM:SS
+    while len(parts) < 3:
+        parts.append(0)
+    micro = int(frac.ljust(6, "0")[:6]) if frac else 0
+    return duration_nanos(parts[0], parts[1], parts[2], micro, neg)
+
+
+def format_duration(nanos: int) -> str:
+    neg = nanos < 0
+    nanos = abs(nanos)
+    total_sec, sub = divmod(nanos, NANOS_PER_SEC)
+    hh, rem = divmod(total_sec, 3600)
+    mm, ss = divmod(rem, 60)
+    micro = sub // 1000
+    out = f"{'-' if neg else ''}{hh:02d}:{mm:02d}:{ss:02d}"
+    return f"{out}.{micro:06d}" if micro else out
+
+
+# -- field-extraction kernels (device-eligible: pure int arithmetic) --------
+
+def _dt_field(name: str, fn):
+    @_reg(name, 1, "int")
+    def kernel(xp, a, _fn=fn):
+        ad, an = a
+        return _fn(xp, ad), an
+
+    return kernel
+
+
+_dt_field("year", lambda xp, v: (v >> _YM_SHIFT) // 13)
+_dt_field("month", lambda xp, v: (v >> _YM_SHIFT) % 13)
+_dt_field("day", lambda xp, v: (v >> _DAY_SHIFT) & 0x1F)
+_dt_field("hour", lambda xp, v: (v >> _HOUR_SHIFT) & 0x1F)
+_dt_field("minute", lambda xp, v: (v >> _MIN_SHIFT) & 0x3F)
+_dt_field("second", lambda xp, v: (v >> _SEC_SHIFT) & 0x3F)
+_dt_field("micro_second", lambda xp, v: v & 0xFFFFFF)
+
+
+@_reg("duration_hours", 1, "int")
+def _duration_hours(xp, a):
+    ad, an = a
+    return xp.abs(ad) // (3600 * NANOS_PER_SEC), an

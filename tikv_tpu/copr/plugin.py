@@ -60,12 +60,19 @@ class CoprocessorPlugin:
 
 
 def _semver_match(version: tuple[int, int, int], req: str) -> bool:
-    """Caret-style requirement: "1", "1.2", "1.2.3" match per semver caret."""
+    """Caret-style requirement: the leftmost NON-ZERO component is the
+    compatibility boundary (semver caret: ^1.2 = >=1.2 <2; ^0.1 = 0.1.x;
+    ^0.0.3 = exactly 0.0.3)."""
     if not req or req == "*":
         return True
     parts = [int(x) for x in req.split(".")]
     if parts[0] != version[0]:
         return False
+    if parts[0] == 0:
+        if len(parts) >= 2 and parts[1] != version[1]:
+            return False
+        if parts[0] == 0 and len(parts) >= 2 and parts[1] == 0:
+            return len(parts) < 3 or parts[2] == version[2]
     return tuple(parts) <= version[: len(parts)]
 
 
@@ -77,6 +84,7 @@ class PluginRegistry:
         self._plugins: dict[str, CoprocessorPlugin] = {}
         self.plugin_dir = plugin_dir
         self._mtimes: dict[str, float] = {}
+        self._path_names: dict[str, str] = {}
         self.load_errors: dict[str, str] = {}
 
     def register(self, plugin: CoprocessorPlugin) -> None:
@@ -112,10 +120,12 @@ class PluginRegistry:
     def _maybe_reload(self) -> None:
         if self.plugin_dir is None or not os.path.isdir(self.plugin_dir):
             return
+        present = set()
         for fn in os.listdir(self.plugin_dir):
             if not fn.endswith(".py") or fn.startswith("_"):
                 continue
             path = os.path.join(self.plugin_dir, fn)
+            present.add(path)
             mtime = os.path.getmtime(path)
             if self._mtimes.get(path) == mtime:
                 continue
@@ -126,6 +136,11 @@ class PluginRegistry:
             except Exception as e:  # noqa: BLE001 — one bad plugin file must
                 # not break dispatch for the healthy ones (registry parity)
                 self.load_errors[path] = repr(e)
+        # deleted files unload their plugins (the reference unloads dylibs)
+        for path in list(self._path_names):
+            if path not in present:
+                self.unregister(self._path_names.pop(path))
+                self._mtimes.pop(path, None)
 
     def _load_file(self, path: str) -> None:
         name = "tikv_tpu_plugin_" + os.path.basename(path)[:-3]
@@ -138,6 +153,7 @@ class PluginRegistry:
             plugin = mod.declare_plugin()
         if plugin is not None:
             self.register(plugin)
+            self._path_names[path] = plugin.NAME
 
 
 class CoprV2Endpoint:

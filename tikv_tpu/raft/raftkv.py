@@ -84,10 +84,13 @@ class RaftKv(Engine):
     message loop until a callback fires (test clusters pump synchronously;
     the server wires a background poller)."""
 
-    def __init__(self, store: Store, pump: Callable[[], None] | None = None):
+    def __init__(self, store: Store, pump: Callable[[], None] | None = None, resolved_ts=None):
         self.store = store
         # default: yield to the node's background raft loop
         self.pump = pump or (lambda: time.sleep(0.0005))
+        # ResolvedTsEndpoint enabling follower stale reads (kv.rs stale-read
+        # path gated by RegionReadProgress/resolved-ts)
+        self.resolved_ts = resolved_ts
 
     def _peer_for_ctx(self, ctx: dict | None):
         ctx = ctx or {}
@@ -103,8 +106,34 @@ class RaftKv(Engine):
             raise NotLeaderError(-1, None)
         return peer
 
+    class DataNotReadyError(Exception):
+        def __init__(self, region_id: int, read_ts: int, resolved: int):
+            self.region_id = region_id
+            self.read_ts = read_ts
+            self.resolved = resolved
+            super().__init__(
+                f"region {region_id}: stale read at {read_ts} above resolved ts {resolved}"
+            )
+
     def snapshot(self, ctx: dict | None = None) -> RegionSnapshot:
         peer = self._peer_for_ctx(ctx)
+        ctx = ctx or {}
+        if ctx.get("stale_read"):
+            # follower stale read: safe at/below the region's resolved-ts
+            # watermark on ANY replica — no leadership or ReadIndex involved
+            if self.resolved_ts is None:
+                raise ValueError("stale reads need a resolved-ts endpoint")
+            read_ts = ctx.get("read_ts")
+            if read_ts is None:
+                raise ValueError("stale reads need read_ts in the context")
+            resolved, required_idx = self.resolved_ts.progress_of(peer.region.id)
+            # RegionReadProgress pairing: the watermark is only meaningful on
+            # a replica that has applied at least the index it was computed
+            # at — a lagging follower must refuse rather than serve a
+            # snapshot missing committed data
+            if read_ts > resolved or peer.node.applied < required_idx:
+                raise RaftKv.DataNotReadyError(peer.region.id, read_ts, resolved)
+            return RegionSnapshot(self.store.engine.snapshot(), peer.region.clone())
         if not peer.node.is_leader():
             raise NotLeaderError(peer.region.id, self.store.leader_store_of(peer.region.id))
         # lease fast path (LocalReader, read.rs:342): while the leader holds a

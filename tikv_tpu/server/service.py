@@ -63,11 +63,17 @@ class KvService:
         self.copr_v2 = copr_v2
         self.resource_tags = resource_tags
 
+    _HANDLER_PREFIXES = ("kv_", "raw_", "coprocessor", "mvcc_")
+
     def dispatch(self, method: str, req: dict):
         """Invoke a handler with resource-group attribution (the tagged-future
-        wrapper from resource_metering/cpu/future_ext.rs)."""
+        wrapper from resource_metering/cpu/future_ext.rs).  Only methods with
+        handler prefixes are reachable from the wire — attributes like
+        ``storage`` can never be called remotely."""
+        if not method.startswith(self._HANDLER_PREFIXES):
+            return {"error": {"other": f"unknown method {method}"}}
         handler = getattr(self, method, None)
-        if handler is None or method.startswith("_") or method == "dispatch":
+        if handler is None:
             return {"error": {"other": f"unknown method {method}"}}
         tag = (req.get("context") or {}).get("resource_group", b"default")
         if self.resource_tags is not None:

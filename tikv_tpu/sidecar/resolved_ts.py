@@ -55,12 +55,24 @@ class Resolver:
 class ResolvedTsEndpoint:
     """Store-level advance loop over region resolvers (endpoint.rs:247 +
     advance.rs): observes applied commands, periodically advances every
-    resolver with a fresh TSO from PD."""
+    resolver with a fresh TSO from PD.
+
+    Watermarks are published as RegionReadProgress pairs —
+    (resolved_ts, required_apply_index) computed on the LEADER — and a
+    follower may serve a stale read only once its own applied index reaches
+    the paired index (store/util.rs RegionReadProgress)."""
 
     def __init__(self, pd):
         self.pd = pd
         self._mu = threading.Lock()
         self.resolvers: dict[int, Resolver] = {}
+        self.stores: list = []
+        # region_id -> (resolved_ts, required_apply_index)
+        self.read_progress: dict[int, tuple[int, int]] = {}
+
+    def attach_store(self, store) -> None:
+        store.apply_observers.append(self.observe_apply)
+        self.stores.append(store)
 
     def resolver(self, region_id: int) -> Resolver:
         with self._mu:
@@ -90,10 +102,29 @@ class ResolvedTsEndpoint:
                 r.untrack_lock(key)
 
     def advance_all(self) -> dict[int, int]:
+        """Advance watermarks from leader peers, pairing each with the
+        leader's applied index at resolution time."""
         ts = self.pd.get_tso()
+        out: dict[int, int] = {}
         with self._mu:
             resolvers = list(self.resolvers.values())
-        return {r.region_id: r.resolve(ts) for r in resolvers}
+        leader_peers: dict[int, object] = {}
+        for store in self.stores:
+            for rid, peer in list(store.peers.items()):
+                if peer.node.is_leader():
+                    leader_peers[rid] = peer
+        for r in resolvers:
+            resolved = r.resolve(ts)
+            out[r.region_id] = resolved
+            leader = leader_peers.get(r.region_id)
+            if leader is not None:
+                with self._mu:
+                    self.read_progress[r.region_id] = (resolved, leader.node.applied)
+        return out
+
+    def progress_of(self, region_id: int) -> tuple[int, int]:
+        with self._mu:
+            return self.read_progress.get(region_id, (0, 0))
 
     def min_resolved_ts(self) -> int:
         with self._mu:
