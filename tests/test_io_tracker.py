@@ -1,0 +1,76 @@
+"""IO rate limiter + request tracker/slow log."""
+
+import threading
+import time
+
+from tikv_tpu.copr.tracker import SlowLog, Tracker
+from tikv_tpu.util.io_limiter import IoRateLimiter, IoType, get_io_type, set_io_type
+
+
+def test_io_limiter_unlimited_and_tagging():
+    lim = IoRateLimiter(0)
+    assert lim.request(10**9, IoType.COMPACTION) == 10**9
+    set_io_type(IoType.GC)
+    assert get_io_type() == IoType.GC
+    lim.request(100)
+    assert lim.stats[IoType.GC] == 100
+
+
+def test_io_limiter_throttles_background_not_foreground():
+    lim = IoRateLimiter(bytes_per_sec=10_000, refill_period=0.02)
+    # foreground never blocks
+    t0 = time.monotonic()
+    for _ in range(20):
+        lim.request(5_000, IoType.FOREGROUND_WRITE)
+    assert time.monotonic() - t0 < 0.05
+    # background must wait for refills: 5 requests of one epoch-budget each
+    t0 = time.monotonic()
+    for _ in range(5):
+        lim.request(200, IoType.COMPACTION)
+    elapsed = time.monotonic() - t0
+    assert elapsed >= 0.02  # at least one refill wait
+    assert lim.stats[IoType.COMPACTION] == 1000
+
+
+def test_tracker_phases_and_slowlog():
+    tr = Tracker("copr")
+    time.sleep(0.01)
+    tr.on_schedule()
+    tr.on_snapshot_finished()
+    time.sleep(0.01)
+    m = tr.on_finish(scanned_keys=42, from_device=True)
+    assert m.schedule_wait_s >= 0.009
+    assert m.handle_s >= 0.009
+    assert m.total_s >= m.schedule_wait_s + m.handle_s - 1e-6
+    d = m.to_dict()
+    assert d["scanned_keys"] == 42 and d["from_device"] is True
+
+    slow = SlowLog(threshold_s=0.015)
+    assert slow.observe(tr) is True
+    fast = Tracker("fast")
+    fast.on_schedule()
+    fast.on_snapshot_finished()
+    fast.on_finish()
+    assert slow.observe(fast) is False
+    assert len(slow.tail()) == 1 and slow.tail()[0]["tag"] == "copr"
+
+
+def test_endpoint_carries_metrics():
+    import sys, os
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from copr_fixtures import PRODUCT_COLUMNS, TABLE_ID, product_engine
+    from tikv_tpu.copr.dag import DagRequest, TableScan
+    from tikv_tpu.copr.endpoint import CoprRequest, Endpoint
+    from tikv_tpu.copr.table import record_range
+    from tikv_tpu.copr.tracker import SlowLog
+    from tikv_tpu.storage.kv import LocalEngine
+
+    slow = SlowLog(threshold_s=0.0)  # record everything
+    ep = Endpoint(LocalEngine(product_engine()), enable_device=False, slow_log=slow)
+    dag = DagRequest(executors=[TableScan(TABLE_ID, PRODUCT_COLUMNS)])
+    r = ep.handle_request(CoprRequest(103, dag, [record_range(TABLE_ID)], 200, context={"region_id": 1}))
+    assert r.metrics["scanned_keys"] == 6
+    assert r.metrics["total_ms"] >= r.metrics["handle_ms"]
+    assert not r.metrics["from_device"]
+    assert slow.tail()[0]["tag"].startswith("copr tp=103")

@@ -283,3 +283,58 @@ def test_vote_stickiness_protects_leases():
     net.nodes[2].campaign(force=True)
     net.drain()
     assert net.nodes[2].role == Role.LEADER
+
+
+def test_hibernation_cycle():
+    """Idle groups stop exchanging messages; any proposal wakes them."""
+    net = Net(3)
+    for n in net.nodes.values():
+        n.hibernate_after = 5
+    leader = net.elect(1)
+    leader.propose(b"x")
+    net.drain()
+    net.tick_all(10)  # idle: hibernate round happens in here
+    assert all(n.hibernated for n in net.nodes.values())
+    # hibernated: ticks produce NO messages
+    for n in net.nodes.values():
+        n.tick()
+    msgs = sum(len(n.ready().messages) for n in net.nodes.values())
+    assert msgs == 0
+    # a new proposal wakes the group and commits normally
+    idx = leader.propose(b"y")
+    assert idx is not None and not leader.hibernated
+    net.drain()
+    assert net.applied[2][-1] == b"y"
+    assert not net.nodes[2].hibernated
+    # followers did not campaign while frozen
+    assert leader.role == Role.LEADER
+
+
+def test_stale_hibernate_heartbeat_cannot_freeze_higher_term():
+    net = Net(3)
+    net.elect(1)
+    net.drain()
+    from tikv_tpu.raft.core import _HIBERNATE_CTX
+
+    stale = Message(MsgType.HEARTBEAT, frm=1, to=3, term=net.nodes[3].term - 1,
+                    context=_HIBERNATE_CTX)
+    net.nodes[3].step(stale)
+    assert not net.nodes[3].hibernated  # stale term rejected, no freeze
+
+
+def test_hibernated_leader_lease_dies_and_read_wakes():
+    net = Net(3)
+    for n in net.nodes.values():
+        n.hibernate_after = 3
+    leader = net.elect(1)
+    leader.propose(b"x")
+    net.drain()
+    net.tick_all(3)  # heartbeats grant a lease while awake
+    net.tick_all(8)  # then the group hibernates
+    assert leader.hibernated
+    assert not leader.lease_valid()  # frozen clock must not preserve leases
+    # a read on the hibernated leader wakes it and completes
+    leader.read_index(b"r")
+    net.drain()
+    assert not leader.hibernated
+    assert net.reads[1] and net.reads[1][-1][0] == b"r"

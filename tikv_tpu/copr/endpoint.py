@@ -42,6 +42,7 @@ class CoprResponse:
     data: bytes
     from_device: bool = False
     from_cache: bool = False
+    metrics: dict = field(default_factory=dict)  # tracker.rs phase breakdown
 
 
 class Endpoint:
@@ -51,18 +52,25 @@ class Endpoint:
         enable_device: bool = True,
         block_cache: CopCache | None = None,
         concurrency_manager=None,
+        slow_log=None,
     ):
+        from .tracker import SlowLog
+
         self.engine = engine
         self.enable_device = enable_device
         self.cop_cache = block_cache or CopCache()
         self.cm = concurrency_manager
+        self.slow_log = slow_log or SlowLog()
         self._evaluators: dict = {}
 
     def handle_request(self, req: CoprRequest) -> CoprResponse:
+        from .tracker import Tracker
+
+        tracker = Tracker(f"copr tp={req.tp} region={req.context.get('region_id') if req.context else None}")
         if req.tp == REQ_TYPE_ANALYZE:
-            return self._handle_analyze(req)
+            return self._tracked(tracker, self._handle_analyze, req)
         if req.tp == REQ_TYPE_CHECKSUM:
-            return self._handle_checksum(req)
+            return self._tracked(tracker, self._handle_checksum, req)
         if req.tp != REQ_TYPE_DAG:
             raise ValueError(f"unsupported coprocessor request type {req.tp}")
         if self.cm is not None:
@@ -70,7 +78,9 @@ class Endpoint:
 
             for start, end in req.ranges:
                 self.cm.read_range_check(Key.from_raw(start), Key.from_raw(end), req.start_ts)
+        tracker.on_schedule()
         snap = self.engine.snapshot(req.context or None)
+        tracker.on_snapshot_finished()
         use_device = self.enable_device and jax_eval.supports(req.dag)
         if use_device:
             ev = self._evaluator_for(req.dag)
@@ -79,13 +89,26 @@ class Endpoint:
             if cache is None or not cache.filled:
                 src = MvccBatchScanSource(snap, req.start_ts, req.ranges)
             resp = ev.run(src, cache=cache)
+            scanned = src.stats.write.processed_keys if src is not None else 0
+            m = tracker.on_finish(scanned_keys=scanned, from_device=True)
+            self.slow_log.observe(tracker)
             return CoprResponse(
                 resp.encode(), from_device=True,
                 from_cache=cache is not None and cache.filled and src is None,
+                metrics=m.to_dict(),
             )
-        src = MvccScanSource(snap, req.start_ts, req.ranges, statistics=Statistics())
+        stats = Statistics()
+        src = MvccScanSource(snap, req.start_ts, req.ranges, statistics=stats)
         resp = BatchExecutorsRunner(req.dag, src).handle_request()
-        return CoprResponse(resp.encode(), from_device=False)
+        m = tracker.on_finish(scanned_keys=stats.write.processed_keys, from_device=False)
+        self.slow_log.observe(tracker)
+        return CoprResponse(resp.encode(), from_device=False, metrics=m.to_dict())
+
+    def _tracked(self, tracker, handler, req: CoprRequest) -> CoprResponse:
+        resp = handler(req, tracker)
+        resp.metrics = tracker.on_finish(scanned_keys=tracker.metrics.scanned_keys).to_dict()
+        self.slow_log.observe(tracker)
+        return resp
 
     def handle_streaming_request(self, req: CoprRequest, rows_per_stream: int = 1024):
         """Yield CoprResponse frames (endpoint.rs streaming path — always the
@@ -106,11 +129,15 @@ class Endpoint:
         for resp in runner.handle_streaming_request(rows_per_stream):
             yield CoprResponse(resp.encode(), from_device=False)
 
-    def _handle_analyze(self, req: CoprRequest) -> CoprResponse:
+    def _handle_analyze(self, req: CoprRequest, tracker=None) -> CoprResponse:
         from . import analyze as az
         from .dag import build_executors
+        from .tracker import Tracker
 
+        tracker = tracker or Tracker()
+        tracker.on_schedule()
         snap = self.engine.snapshot(req.context or None)
+        tracker.on_snapshot_finished()
         src = MvccBatchScanSource(snap, req.start_ts, req.ranges)
         executor = build_executors(req.dag, src)
         n_cols = len(executor.schema())
@@ -121,6 +148,7 @@ class Endpoint:
             sample_size=params.get("sample_size", 10000),
             max_buckets=params.get("max_buckets", 256),
         )
+        tracker.metrics.scanned_keys = result.sampled_rows
         out = bytearray()
         from ..util import codec as c
 
@@ -139,7 +167,7 @@ class Endpoint:
             out += c.encode_var_u64(result.cm_sketches[ci].count)
         return CoprResponse(bytes(out))
 
-    def _handle_checksum(self, req: CoprRequest) -> CoprResponse:
+    def _handle_checksum(self, req: CoprRequest, tracker=None) -> CoprResponse:
         """MVCC-consistent checksum: the logical rows visible at start_ts
         (checksum.rs scans through the snapshot store), so large values in
         CF_DEFAULT are covered and replicas with different physical version
@@ -147,14 +175,19 @@ class Endpoint:
         from . import analyze as az
         from ..storage.mvcc import ForwardScanner
         from ..storage.txn_types import Key
+        from .tracker import Tracker
 
+        tracker = tracker or Tracker()
+        tracker.on_schedule()
         snap = self.engine.snapshot(req.context or None)
+        tracker.on_snapshot_finished()
         kvs = []
         for start, end in req.ranges:
             kvs.extend(
                 ForwardScanner(snap, req.start_ts, Key.from_raw(start), Key.from_raw(end))
             )
         r = az.checksum_range(kvs)
+        tracker.metrics.scanned_keys = r["total_kvs"]
         from ..util import codec as c
 
         out = (

@@ -1,0 +1,86 @@
+"""Per-request execution tracker + slow log.
+
+Re-expression of ``src/coprocessor/tracker.rs:46``: each request records its
+phase durations (schedule wait, snapshot, handle) and scan statistics; slow
+requests (over a threshold) are surfaced to the slow-log sink, and every
+response can carry the breakdown back to the client like
+``ExecutorExecutionSummary``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TrackedMetrics:
+    schedule_wait_s: float = 0.0
+    snapshot_s: float = 0.0
+    handle_s: float = 0.0
+    total_s: float = 0.0
+    scanned_keys: int = 0
+    from_device: bool = False
+
+    def to_dict(self) -> dict:
+        return {
+            "schedule_wait_ms": round(self.schedule_wait_s * 1000, 3),
+            "snapshot_ms": round(self.snapshot_s * 1000, 3),
+            "handle_ms": round(self.handle_s * 1000, 3),
+            "total_ms": round(self.total_s * 1000, 3),
+            "scanned_keys": self.scanned_keys,
+            "from_device": self.from_device,
+        }
+
+
+class Tracker:
+    """Phase stopwatch for one request."""
+
+    def __init__(self, req_tag: str = ""):
+        self.req_tag = req_tag
+        self.metrics = TrackedMetrics()
+        self._created = time.perf_counter()
+        self._phase_start = self._created
+
+    def on_schedule(self) -> None:
+        now = time.perf_counter()
+        self.metrics.schedule_wait_s = now - self._created
+        self._phase_start = now
+
+    def on_snapshot_finished(self) -> None:
+        now = time.perf_counter()
+        self.metrics.snapshot_s = now - self._phase_start
+        self._phase_start = now
+
+    def on_finish(self, scanned_keys: int = 0, from_device: bool = False) -> TrackedMetrics:
+        now = time.perf_counter()
+        self.metrics.handle_s = now - self._phase_start
+        self.metrics.total_s = now - self._created
+        self.metrics.scanned_keys = scanned_keys
+        self.metrics.from_device = from_device
+        return self.metrics
+
+
+class SlowLog:
+    """Bounded ring of slow-request records (the slow-log file analog)."""
+
+    def __init__(self, threshold_s: float = 0.3, capacity: int = 256):
+        self.threshold_s = threshold_s
+        self.capacity = capacity
+        self._mu = threading.Lock()
+        self.entries: list[dict] = []
+
+    def observe(self, tracker: Tracker) -> bool:
+        if tracker.metrics.total_s < self.threshold_s:
+            return False
+        entry = {"tag": tracker.req_tag, **tracker.metrics.to_dict()}
+        with self._mu:
+            self.entries.append(entry)
+            if len(self.entries) > self.capacity:
+                del self.entries[: len(self.entries) - self.capacity]
+        return True
+
+    def tail(self, n: int = 20) -> list[dict]:
+        with self._mu:
+            return self.entries[-n:]

@@ -26,6 +26,9 @@ import random
 from dataclasses import dataclass, field
 
 
+_HIBERNATE_CTX = b"\x00hibernate"
+
+
 class Role(enum.Enum):
     FOLLOWER = "follower"
     CANDIDATE = "candidate"
@@ -167,6 +170,7 @@ class RaftNode:
         election_tick: int = 10,
         heartbeat_tick: int = 2,
         rng: random.Random | None = None,
+        hibernate_after: int = 0,
     ):
         self.id = node_id
         self.voters: set[int] = set(voters)
@@ -184,6 +188,12 @@ class RaftNode:
         self._elapsed = 0
         self._randomized_timeout = self._rand_timeout()
         self._tick_count = 0
+        # hibernation (store/hibernate_state.rs): after this many idle leader
+        # ticks with every follower caught up, the group stops exchanging
+        # heartbeats until any message or proposal wakes it.  0 = disabled.
+        self.hibernate_after = hibernate_after
+        self.hibernated = False
+        self._idle_ticks = 0
         # lease: leader may serve local reads until this tick.  Granted ONLY
         # from a complete heartbeat round, measured from the round's
         # *broadcast* tick (granting at response time would let the lease
@@ -270,23 +280,50 @@ class RaftNode:
     # ---------------------------------------------------------------- public
 
     def tick(self) -> None:
+        if self.hibernated:
+            return  # frozen clock: no heartbeats, no election timeout
         self._tick_count += 1
         self._elapsed += 1
         if self.role == Role.LEADER:
+            if (
+                self.hibernate_after
+                and self._idle_ticks >= self.hibernate_after
+                and self.commit == self.log.last_index()
+                and all(
+                    self.match_index.get(p, 0) == self.log.last_index()
+                    for p in self.voters
+                )
+            ):
+                # final round tells followers to freeze their election timers;
+                # the lease dies with the clock — a frozen tick counter must
+                # not keep lease_valid() true indefinitely
+                self._broadcast_heartbeat(ctx=_HIBERNATE_CTX)
+                self.hibernated = True
+                self._lease_until = 0
+                return
+            self._idle_ticks += 1
             if self._elapsed >= self.heartbeat_tick:
                 self._elapsed = 0
                 self._broadcast_heartbeat()
         elif self._elapsed >= self._randomized_timeout:
             self._become_candidate()
 
+    def _wake(self) -> None:
+        if self.hibernated:
+            self.hibernated = False
+            self._elapsed = 0  # fresh timer: no instant campaigns on wake
+        self._idle_ticks = 0
+
     def campaign(self, force: bool = True) -> None:
         """Explicit campaign = leadership transfer (MsgTimeoutNow semantics):
         its votes bypass leader stickiness.  Timeout campaigns (tick) stay
         sticky so natural disruptions cannot break an active lease."""
+        self._wake()
         self._become_candidate(force=force)
 
     def propose(self, data: bytes) -> int | None:
         """Leader appends a proposal; returns its index (None if not leader)."""
+        self._wake()
         if self.role != Role.LEADER:
             return None
         index = self.log.last_index() + 1
@@ -295,6 +332,7 @@ class RaftNode:
         return index
 
     def propose_conf_change(self, change: tuple[str, int]) -> int | None:
+        self._wake()
         if self.role != Role.LEADER:
             return None
         index = self.log.last_index() + 1
@@ -312,6 +350,7 @@ class RaftNode:
         """Linearizable read point (read_queue.rs): leader confirms leadership
         via a heartbeat round, then releases the read at commit index —
         deferred until the leader has committed in its own term."""
+        self._wake()
         if self.role != Role.LEADER:
             if self.leader_id is not None:
                 self._send(Message(MsgType.READ_INDEX, self.id, self.leader_id, self.term, context=ctx))
@@ -355,6 +394,20 @@ class RaftNode:
     # -------------------------------------------------------------- messages
 
     def step(self, m: Message) -> None:
+        if m.type == MsgType.HEARTBEAT and m.context == _HIBERNATE_CTX:
+            pass  # freeze decision happens in _on_heartbeat, AFTER term checks
+        elif m.type in (
+            MsgType.APPEND,
+            MsgType.SNAPSHOT,
+            MsgType.VOTE,
+            MsgType.READ_INDEX,
+            MsgType.READ_INDEX_RESP,
+        ):
+            self._wake()  # real activity
+        elif m.type == MsgType.HEARTBEAT and self.hibernated:
+            self._wake()  # an awake leader pulls the group out of hibernation
+        # heartbeat/vote responses are not activity — they must not keep
+        # resetting the idle counter that leads into hibernation
         if (
             m.type == MsgType.VOTE
             and not m.force
@@ -552,6 +605,10 @@ class RaftNode:
 
     def _on_heartbeat(self, m: Message) -> None:
         self._become_follower(m.term, m.frm)
+        if m.context == _HIBERNATE_CTX:
+            # current-term leader's hibernate round (stale leaders were
+            # already rejected by step()'s term check)
+            self.hibernated = True
         if m.commit > self.commit:
             self.commit = min(m.commit, self.log.last_index())
             self._ready.hard_state_changed = True
@@ -565,7 +622,9 @@ class RaftNode:
     def _on_heartbeat_resp(self, m: Message) -> None:
         if self.role != Role.LEADER:
             return
-        if m.hb_round == self._hb_round:
+        if m.hb_round == self._hb_round and not self.hibernated:
+            # hibernate-round acks must not re-grant a lease the frozen clock
+            # could never expire
             self._hb_acks.add(m.frm)
             if len(self._hb_acks & self.voters) >= self._quorum():
                 self._lease_until = max(

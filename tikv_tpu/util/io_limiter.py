@@ -1,0 +1,117 @@
+"""IO rate limiting with priorities.
+
+Re-expression of ``components/file_system`` (rate_limiter.rs:425
+``IORateLimiter``: priority token budget with periodic refill; IO-type
+tagging): callers request bytes before doing IO; high-priority requests are
+served first, low priority waits when the epoch's budget is exhausted.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+
+
+class IoPriority(enum.IntEnum):
+    LOW = 0
+    MEDIUM = 1
+    HIGH = 2
+
+
+class IoType(enum.Enum):
+    FOREGROUND_READ = "foreground_read"
+    FOREGROUND_WRITE = "foreground_write"
+    FLUSH = "flush"
+    COMPACTION = "compaction"
+    REPLICATION = "replication"
+    GC = "gc"
+    IMPORT = "import"
+    EXPORT = "export"
+
+
+_DEFAULT_PRIORITY = {
+    IoType.FOREGROUND_READ: IoPriority.HIGH,
+    IoType.FOREGROUND_WRITE: IoPriority.HIGH,
+    IoType.REPLICATION: IoPriority.HIGH,
+    IoType.FLUSH: IoPriority.MEDIUM,
+    IoType.COMPACTION: IoPriority.LOW,
+    IoType.GC: IoPriority.LOW,
+    IoType.IMPORT: IoPriority.MEDIUM,
+    IoType.EXPORT: IoPriority.LOW,
+}
+
+_tls = threading.local()
+
+
+def set_io_type(io_type: IoType) -> None:
+    """Per-thread IO tag (the reference's set_io_type TLS)."""
+    _tls.io_type = io_type
+
+
+def get_io_type() -> IoType:
+    return getattr(_tls, "io_type", IoType.FOREGROUND_WRITE)
+
+
+class IoRateLimiter:
+    """Token bucket refilled per epoch; HIGH priority is never throttled
+    (foreground traffic), lower priorities wait for budget."""
+
+    def __init__(self, bytes_per_sec: int = 0, refill_period: float = 0.05):
+        self.bytes_per_sec = bytes_per_sec  # 0 = unlimited
+        self.refill_period = refill_period
+        self._mu = threading.Condition()
+        self._budget = self._epoch_budget()
+        self._epoch_start = time.monotonic()
+        self.stats: dict[IoType, int] = {}
+
+    def _epoch_budget(self) -> int:
+        return int(self.bytes_per_sec * self.refill_period)
+
+    def set_rate(self, bytes_per_sec: int) -> None:
+        with self._mu:
+            self.bytes_per_sec = bytes_per_sec
+            self._budget = self._epoch_budget()
+            self._mu.notify_all()
+
+    def request(self, nbytes: int, io_type: IoType | None = None, timeout: float = 5.0) -> int:
+        """Block until ``nbytes`` of budget is granted (or HIGH priority).
+        Returns the granted bytes."""
+        io_type = io_type or get_io_type()
+        with self._mu:
+            self.stats[io_type] = self.stats.get(io_type, 0) + nbytes
+            if self.bytes_per_sec <= 0:
+                return nbytes
+            if _DEFAULT_PRIORITY[io_type] == IoPriority.HIGH:
+                # high priority consumes budget but never blocks
+                self._refill_locked()
+                self._budget -= nbytes
+                return nbytes
+            deadline = time.monotonic() + timeout
+            while True:
+                self._refill_locked()
+                # debt model (RocksDB-style): a request only needs the bucket
+                # to be non-negative, then takes the whole grant — the bucket
+                # goes into debt and later refills pay it back, so requests
+                # larger than one epoch's budget still flow at the target rate
+                if self._budget > 0:
+                    self._budget -= nbytes
+                    return nbytes
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    # timed out: grant anyway (the reference degrades rather
+                    # than starving background work forever)
+                    self._budget -= nbytes
+                    return nbytes
+                self._mu.wait(min(self.refill_period, remaining))
+
+    def _refill_locked(self) -> None:
+        now = time.monotonic()
+        if now - self._epoch_start >= self.refill_period:
+            epochs = int((now - self._epoch_start) / self.refill_period)
+            self._epoch_start += epochs * self.refill_period
+            # refills pay back debt; credit caps at one epoch's budget
+            self._budget = min(
+                self._budget + epochs * self._epoch_budget(), self._epoch_budget()
+            )
+            self._mu.notify_all()
