@@ -338,3 +338,50 @@ def test_hibernated_leader_lease_dies_and_read_wakes():
     net.drain()
     assert not leader.hibernated
     assert net.reads[1] and net.reads[1][-1][0] == b"r"
+
+
+def test_hibernated_group_elects_after_leader_death():
+    """Pre-vote requests must wake hibernated peers, or a dead leader leaves
+    the group leaderless forever."""
+    net = Net(3)
+    for n in net.nodes.values():
+        n.hibernate_after = 3
+    net.elect(1)
+    net.nodes[1].propose(b"x")
+    net.drain()
+    net.tick_all(10)
+    assert all(n.hibernated for n in net.nodes.values())
+    # leader dies; a client request wakes follower 2 which must eventually win
+    del net.nodes[1]
+    net.nodes[2]._wake()
+    for _ in range(80):
+        for n in net.nodes.values():
+            n.tick()
+        net.drain()
+        if any(n.role == Role.LEADER for n in net.nodes.values()):
+            break
+    assert any(n.role == Role.LEADER for n in net.nodes.values())
+
+
+def test_read_index_ignores_learner_acks():
+    """Learner heartbeat acks carry no read-quorum weight."""
+    net = Net(3)
+    leader = net.elect(1)
+    leader.propose(b"v")
+    net.drain()
+    # add learner 4
+    net.nodes[4] = RaftNode(4, [])
+    net.nodes[4].voters = {1, 2, 3}
+    net.nodes[4].learners = {4}
+    net.applied[4] = []
+    net.persisted[4] = []
+    net.reads[4] = []
+    leader.propose_conf_change(("add_learner", 4))
+    net.drain()
+    assert 4 in leader.learners
+    # partition leader+learner away from the voters
+    net.partition(1, 2)
+    net.partition(1, 3)
+    leader.read_index(b"stale?")
+    net.drain()  # learner acks flow, voters don't
+    assert net.reads[1] == []  # must NOT serve with only a learner ack

@@ -309,3 +309,67 @@ def test_merging_flag_survives_recovery(cluster):
     new_store = Store(victim, cluster.transport, engine=old.engine)
     new_store.recover()
     assert new_store.peers[right_id].merging is True
+
+
+def test_learner_replicates_but_does_not_vote():
+    """Learner flow (raft-rs learners): replicate → no quorum weight →
+    promote → full voter."""
+    c = Cluster(4)
+    region = c.bootstrap_subset([1, 2])
+    c.elect_leader(region.id, 1)
+    c.must_put(b"k", b"v")
+    pid = c.add_learner(region.id, 3)
+    c.tick(5)
+    # data reaches the learner
+    assert c.get_on_store(3, b"k") == b"v"
+    leader = c.wait_leader(region.id)
+    assert pid in leader.node.learners and pid not in leader.node.voters
+    # quorum is still 2-of-2 voters: stopping ONE voter stalls writes even
+    # though the learner is alive
+    c.stop_node(2)
+    import pytest as _pytest
+
+    with _pytest.raises(TimeoutError):
+        kv = c.raftkv(leader.store.store_id)
+        from tikv_tpu.storage.engine import WriteBatch
+
+        wb = WriteBatch()
+        wb.put_cf("default", b"stall", b"x")
+        kv.write({"region_id": region.id}, wb)
+    c.restart_node(2)
+    c.tick(3)
+    # promote: now 3 voters, quorum 2 — the learner counts
+    c.promote_learner(region.id, pid)
+    c.tick(2)
+    leader = c.wait_leader(region.id)
+    assert pid in leader.node.voters
+    c.stop_node(2)
+    c.must_put(b"after", b"y")  # 2-of-3 quorum via the promoted learner
+    assert c.must_get(b"after") == b"y"
+
+
+def test_pre_vote_prevents_term_inflation():
+    """A partitioned node running election timeouts must not inflate the
+    cluster term (pre-vote)."""
+    c = Cluster(3)
+    c.run()
+    c.must_put(b"k", b"v")
+    leader = c.wait_leader(FIRST_REGION_ID)
+    term_before = leader.node.term
+    isolated = next(s for s in c.stores if s != leader.store.store_id)
+    from tikv_tpu.raft.store import PartitionFilter
+
+    others = {s for s in c.stores if s != isolated}
+    c.transport.filters.append(PartitionFilter({isolated}, others))
+    # the isolated node times out many times — pre-vote keeps failing, term
+    # must NOT grow
+    iso_peer = c.stores[isolated].peers[FIRST_REGION_ID]
+    for _ in range(60):
+        iso_peer.node.tick()
+        c.process()
+    assert iso_peer.node.term == term_before
+    c.transport.filters.clear()
+    c.tick(3)
+    # leader undisturbed on heal (no term churn)
+    assert leader.node.is_leader()
+    assert leader.node.term == term_before

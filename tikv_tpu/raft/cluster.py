@@ -207,6 +207,26 @@ class Cluster:
         self._run_admin(leader, cmd)
         return new_pid
 
+    def add_learner(self, region_id: int, store_id: int) -> int:
+        leader = self.wait_leader(region_id)
+        pid = self.alloc_id()
+        cmd = {
+            "epoch": (leader.region.epoch.conf_ver, leader.region.epoch.version),
+            "ops": [],
+            "admin": ("conf_change", "add_learner", pid, store_id),
+        }
+        self._run_admin(leader, cmd)
+        return pid
+
+    def promote_learner(self, region_id: int, peer_id: int) -> None:
+        leader = self.wait_leader(region_id)
+        cmd = {
+            "epoch": (leader.region.epoch.conf_ver, leader.region.epoch.version),
+            "ops": [],
+            "admin": ("conf_change", "promote", peer_id, 0),
+        }
+        self._run_admin(leader, cmd)
+
     def remove_peer(self, region_id: int, peer_id: int) -> None:
         leader = self.wait_leader(region_id)
         cmd = {

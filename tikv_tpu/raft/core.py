@@ -11,12 +11,12 @@ the Raft state machine with the same interaction style:
                           #        committed entries to apply
     node.advance(rd)
 
-Implemented: randomized election timeout, leader election, log replication
-with consistency check, quorum commitment, heartbeats + lease-basis
-(leader_alive quorum tracking), snapshot install for lagging/new peers,
-single-step membership change (AddNode/RemoveNode), ReadIndex.
-Not yet: pre-vote, joint consensus, learners, log compaction scheduling
-(compaction is driven by the store layer).
+Implemented: randomized election timeout, pre-vote, leader election, log
+replication with consistency check, quorum commitment, heartbeats + leases
+(broadcast-tick granted, sticky votes), learners (non-voting replicas with
+promote), snapshot install for lagging/new peers, single-step membership
+change, hibernation, ReadIndex.
+Not yet: joint consensus; log compaction is driven by the store layer.
 """
 
 from __future__ import annotations
@@ -36,6 +36,8 @@ class Role(enum.Enum):
 
 
 class MsgType(enum.Enum):
+    PRE_VOTE = "pre_vote"
+    PRE_VOTE_RESP = "pre_vote_resp"
     VOTE = "vote"
     VOTE_RESP = "vote_resp"
     APPEND = "append"
@@ -62,6 +64,7 @@ class Snapshot:
     term: int
     data: bytes  # opaque state-machine snapshot
     voters: tuple[int, ...]
+    learners: tuple[int, ...] = ()
 
 
 @dataclass
@@ -174,6 +177,8 @@ class RaftNode:
     ):
         self.id = node_id
         self.voters: set[int] = set(voters)
+        self.learners: set[int] = set()
+        self.pre_vote = True
         self.term = 0
         self.vote: int | None = None
         self.role = Role.FOLLOWER
@@ -209,6 +214,7 @@ class RaftNode:
         # peers that must be seeded by snapshot (fresh conf-change additions)
         self.force_snapshot: set[int] = set()
         self._votes: dict[int, bool] = {}
+        self._pre_votes: dict[int, bool] | None = None
         # pending read-index requests: ctx -> (index, acks)
         self._pending_reads: dict[bytes, tuple[int, set[int]]] = {}
         # reads deferred until the leader commits in its own term: (ctx, origin)
@@ -223,6 +229,9 @@ class RaftNode:
 
     def _quorum(self) -> int:
         return len(self.voters) // 2 + 1
+
+    def _replicas(self) -> set[int]:
+        return (self.voters | self.learners) - {self.id}
 
     def is_leader(self) -> bool:
         return self.role == Role.LEADER
@@ -243,6 +252,9 @@ class RaftNode:
         # callers time out and retry against the new leader
         self._deferred_reads.clear()
         self._pending_reads.clear()
+        # abandon any in-flight pre-vote round: delayed grants must not
+        # trigger a campaign after we've acknowledged a leader
+        self._pre_votes = None
 
     def _become_candidate(self, force: bool = False) -> None:
         self.term += 1
@@ -270,8 +282,9 @@ class RaftNode:
         self.role = Role.LEADER
         self.leader_id = self.id
         last = self.log.last_index()
-        self.next_index = {p: last + 1 for p in self.voters}
-        self.match_index = {p: 0 for p in self.voters}
+        members = self.voters | self.learners
+        self.next_index = {p: last + 1 for p in members}
+        self.match_index = {p: 0 for p in members}
         self.match_index[self.id] = last
         # noop entry to commit entries from previous terms (§5.4.2)
         self._append_entries([Entry(self.term, last + 1)])
@@ -306,13 +319,61 @@ class RaftNode:
                 self._elapsed = 0
                 self._broadcast_heartbeat()
         elif self._elapsed >= self._randomized_timeout:
-            self._become_candidate()
+            if self.id in self.learners or self.id not in self.voters:
+                self._elapsed = 0  # learners/removed peers never campaign
+            elif self.pre_vote:
+                self._start_pre_vote()
+            else:
+                self._become_candidate()
 
     def _wake(self) -> None:
         if self.hibernated:
             self.hibernated = False
             self._elapsed = 0  # fresh timer: no instant campaigns on wake
         self._idle_ticks = 0
+
+    def _start_pre_vote(self) -> None:
+        """Pre-vote (raft thesis 9.6 / raft-rs pre_vote): ask for votes at
+        term+1 WITHOUT bumping our term — a partitioned node cannot inflate
+        cluster terms, and disruptions only happen when a quorum agrees the
+        leader is gone."""
+        self._pre_votes = {self.id: True}
+        self.leader_id = None
+        self._elapsed = 0
+        self._randomized_timeout = self._rand_timeout()
+        if self._quorum() == 1:
+            self._become_candidate()
+            return
+        for peer in self.voters - {self.id}:
+            self._send(
+                Message(
+                    MsgType.PRE_VOTE, self.id, peer, self.term + 1,
+                    log_index=self.log.last_index(),
+                    log_term=self.log.term_at(self.log.last_index()) or 0,
+                )
+            )
+
+    def _on_pre_vote(self, m: Message) -> None:
+        last_index = self.log.last_index()
+        last_term = self.log.term_at(last_index) or 0
+        up_to_date = (m.log_term, m.log_index) >= (last_term, last_index)
+        # sticky rule applies to pre-votes too; granting changes NO state
+        fresh_leader = self.leader_id is not None and self._elapsed < self.election_tick
+        grant = up_to_date and not fresh_leader and m.term > self.term
+        self._send(
+            Message(MsgType.PRE_VOTE_RESP, self.id, m.frm, m.term, reject=not grant)
+        )
+
+    def _on_pre_vote_resp(self, m: Message) -> None:
+        if self.role == Role.LEADER or m.term <= self.term:
+            return
+        votes = getattr(self, "_pre_votes", None)
+        if votes is None:
+            return
+        votes[m.frm] = not m.reject
+        if sum(1 for p, ok in votes.items() if ok and p in self.voters) >= self._quorum():
+            self._pre_votes = None
+            self._become_candidate()
 
     def campaign(self, force: bool = True) -> None:
         """Explicit campaign = leadership transfer (MsgTimeoutNow semantics):
@@ -369,11 +430,24 @@ class RaftNode:
         op, peer = change
         if op == "add":
             self.voters.add(peer)
+            self.learners.discard(peer)
             if self.role == Role.LEADER and peer not in self.next_index:
                 self.next_index[peer] = self.log.last_index() + 1
                 self.match_index[peer] = 0
+        elif op == "add_learner":
+            if peer not in self.voters:
+                self.learners.add(peer)
+            if self.role == Role.LEADER and peer not in self.next_index:
+                self.next_index[peer] = self.log.last_index() + 1
+                self.match_index[peer] = 0
+        elif op == "promote":
+            self.learners.discard(peer)
+            self.voters.add(peer)
+            if self.role == Role.LEADER:
+                self._maybe_commit()
         elif op == "remove":
             self.voters.discard(peer)
+            self.learners.discard(peer)
             self.next_index.pop(peer, None)
             self.match_index.pop(peer, None)
             if self.role == Role.LEADER:
@@ -400,6 +474,7 @@ class RaftNode:
             MsgType.APPEND,
             MsgType.SNAPSHOT,
             MsgType.VOTE,
+            MsgType.PRE_VOTE,
             MsgType.READ_INDEX,
             MsgType.READ_INDEX_RESP,
         ):
@@ -419,6 +494,14 @@ class RaftNode:
             # recently heard from a live leader ignores disruptive campaigns —
             # this is what makes leader leases sound
             self._send(Message(MsgType.VOTE_RESP, self.id, m.frm, self.term, reject=True))
+            return
+        if m.type in (MsgType.PRE_VOTE, MsgType.PRE_VOTE_RESP):
+            # pre-vote rounds run ABOVE our term without mutating it
+            handler = {
+                MsgType.PRE_VOTE: self._on_pre_vote,
+                MsgType.PRE_VOTE_RESP: self._on_pre_vote_resp,
+            }[m.type]
+            handler(m)
             return
         if m.term > self.term:
             leader = m.frm if m.type in (MsgType.APPEND, MsgType.HEARTBEAT, MsgType.SNAPSHOT) else None
@@ -481,7 +564,7 @@ class RaftNode:
         self._maybe_commit()
 
     def _broadcast_append(self) -> None:
-        for peer in self.voters - {self.id}:
+        for peer in self._replicas():
             self._send_append(peer)
 
     def _send_append(self, peer: int) -> None:
@@ -570,7 +653,7 @@ class RaftNode:
                         self._serve_remote_read(ctx, origin)
 
     def _broadcast_append_commit(self) -> None:
-        for peer in self.voters - {self.id}:
+        for peer in self._replicas():
             if self.next_index.get(peer, 1) > self.log.last_index():
                 # nothing to replicate; push the commit index via heartbeat
                 self._send(
@@ -594,7 +677,7 @@ class RaftNode:
         self._hb_round += 1
         self._hb_round_tick = self._tick_count
         self._hb_acks = {self.id}
-        for peer in self.voters - {self.id}:
+        for peer in self._replicas():
             self._send(
                 Message(
                     MsgType.HEARTBEAT, self.id, peer, self.term,
@@ -633,7 +716,8 @@ class RaftNode:
         if m.context and m.context in self._pending_reads:
             index, acks = self._pending_reads[m.context]
             acks.add(m.frm)
-            if len(acks) >= self._quorum():
+            # learner acks carry no quorum weight (same rule as the lease path)
+            if len(acks & self.voters) >= self._quorum():
                 del self._pending_reads[m.context]
                 origin = getattr(self, "_read_origins", {}).pop(m.context, None)
                 if origin is None:
@@ -662,6 +746,7 @@ class RaftNode:
         self.commit = snap.index
         self.applied = snap.index
         self.voters = set(snap.voters)
+        self.learners = set(snap.learners)
         self._ready.snapshot = snap
         self._ready.hard_state_changed = True
         self._send(Message(MsgType.APPEND_RESP, self.id, m.frm, self.term, log_index=snap.index))

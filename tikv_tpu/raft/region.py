@@ -15,6 +15,7 @@ from dataclasses import dataclass, field, replace
 class Peer:
     peer_id: int
     store_id: int
+    role: str = "voter"  # "voter" | "learner"
 
 
 @dataclass
@@ -58,7 +59,10 @@ class Region:
         return None
 
     def voter_ids(self) -> list[int]:
-        return [p.peer_id for p in self.peers]
+        return [p.peer_id for p in self.peers if p.role == "voter"]
+
+    def learner_ids(self) -> list[int]:
+        return [p.peer_id for p in self.peers if p.role == "learner"]
 
     def clone(self) -> "Region":
         return Region(
@@ -66,7 +70,7 @@ class Region:
             self.start_key,
             self.end_key,
             RegionEpoch(self.epoch.conf_ver, self.epoch.version),
-            [Peer(p.peer_id, p.store_id) for p in self.peers],
+            [Peer(p.peer_id, p.store_id, p.role) for p in self.peers],
         )
 
 

@@ -226,6 +226,7 @@ class StorePeer:
         self.region = region
         self.peer_id = peer_id
         self.node = RaftNode(peer_id, region.voter_ids())
+        self.node.learners = set(region.learner_ids())
         self.proposals: list[Proposal] = []
         self.pending_reads: dict[bytes, Callable] = {}
         self._read_seq = 0
@@ -400,14 +401,22 @@ class StorePeer:
                 )
             )
         self.node.apply_conf_change(e.conf_change)
-        if op == "add":
+        if op in ("add", "add_learner"):
             sid = self.store.pending_conf_stores.get((self.region.id, pid), 0)
-            if self.region.peer_by_id(pid) is None:
-                self.region.peers.append(RegionPeer(pid, sid))
+            existing = self.region.peer_by_id(pid)
+            role = "learner" if op == "add_learner" else "voter"
+            if existing is None:
+                self.region.peers.append(RegionPeer(pid, sid, role))
+            else:
+                existing.role = role
             if self.node.is_leader() and pid != self.peer_id:
                 # new peers are seeded by snapshot, never by full log replay
                 # (peer_storage.rs: uninitialized peers wait for a snapshot)
                 self.node.force_snapshot.add(pid)
+        elif op == "promote":
+            existing = self.region.peer_by_id(pid)
+            if existing is not None:
+                existing.role = "voter"
         else:
             self.region.peers = [p for p in self.region.peers if p.peer_id != pid]
             if pid == self.peer_id:
@@ -487,6 +496,7 @@ class StorePeer:
             term=self.node.log.term_at(self.node.applied) or self.node.term,
             data=bytes(out),
             voters=tuple(self.node.voters),
+            learners=tuple(self.node.learners),
         )
 
     def _apply_snapshot(self, snap: RaftSnapshot) -> None:
@@ -525,6 +535,7 @@ def encode_region(region: Region, merging: bool = False) -> bytes:
     for p in region.peers:
         out += codec.encode_var_u64(p.peer_id)
         out += codec.encode_var_u64(p.store_id)
+        out.append(1 if p.role == "learner" else 0)
     out.append(1 if merging else 0)
     return bytes(out)
 
@@ -541,7 +552,9 @@ def decode_region(b: bytes) -> tuple[Region, bool]:
     for _ in range(n):
         pid, off = codec.decode_var_u64(b, off)
         sid, off = codec.decode_var_u64(b, off)
-        peers.append(RegionPeer(pid, sid))
+        role = "learner" if b[off] == 1 else "voter"
+        off += 1
+        peers.append(RegionPeer(pid, sid, role))
     merging = off < len(b) and b[off] == 1
     return Region(rid, start, end, RegionEpoch(cv, v), peers), merging
 
@@ -691,7 +704,6 @@ class Store:
                     if region.peer_on_store(self.store_id) is None:
                         region.peers.append(RegionPeer(rmsg.to_peer.peer_id, self.store_id))
                     peer = StorePeer(self, region, rmsg.to_peer.peer_id)
-                    peer.node.voters = set(region.voter_ids())
                     self.peers[rmsg.region_id] = peer
             if peer is not None and rmsg.to_peer.peer_id == peer.peer_id:
                 peer.node.step(rmsg.msg)
