@@ -373,3 +373,62 @@ def test_pre_vote_prevents_term_inflation():
     # leader undisturbed on heal (no term churn)
     assert leader.node.is_leader()
     assert leader.node.term == term_before
+
+
+def test_raft_log_gc_and_snapshot_catchup(cluster):
+    """Logs compact past the threshold; a peer lagging beyond the slack is
+    snapshot-seeded (store/worker/raftlog_gc.rs)."""
+    from tikv_tpu.raft.core import MsgType
+    from tikv_tpu.storage.engine import CF_RAFT
+    from tikv_tpu.util import keys as keymod
+
+    for i in range(60):
+        cluster.must_put(b"lg%03d" % i, b"v")
+    leader = cluster.wait_leader(FIRST_REGION_ID)
+    lagging = next(sid for sid in cluster.stores if sid != leader.store.store_id)
+    f = RegionPacketFilter(FIRST_REGION_ID, lagging, {MsgType.APPEND, MsgType.SNAPSHOT})
+    cluster.transport.filters.append(f)
+    for i in range(60, 120):
+        cluster.must_put(b"lg%03d" % i, b"v")
+    # compact every store's logs aggressively
+    for s in cluster.stores.values():
+        s.compact_raft_logs(threshold=20, slack=5)
+    # leader kept at most ~threshold entries in memory and on disk
+    assert leader.node.log.last_index() - leader.node.log.offset < 40
+    log_prefix = keymod.region_raft_prefix(FIRST_REGION_ID) + keymod.RAFT_LOG_SUFFIX
+    persisted = list(
+        leader.store.engine.scan_cf(
+            CF_RAFT, log_prefix, log_prefix[:-1] + bytes([log_prefix[-1] + 1])
+        )
+    )
+    assert len(persisted) < 80
+    # heal: the lagging peer catches up via SNAPSHOT (its gap was compacted)
+    cluster.transport.filters.clear()
+    cluster.tick(6)
+    assert cluster.get_on_store(lagging, b"lg119") == b"v"
+    lag_peer = cluster.stores[lagging].peers[FIRST_REGION_ID]
+    assert lag_peer.node.log.snapshot_index > 0
+
+
+def test_add_learner_on_existing_voter_is_noop(cluster):
+    """add_learner targeting a voter must not demote it (views stay in
+    lockstep with the raft node, which ignores such changes)."""
+    leader = cluster.wait_leader(FIRST_REGION_ID)
+    victim = next(p for p in leader.region.peers if p.peer_id != leader.peer_id)
+    cmd = {
+        "epoch": (leader.region.epoch.conf_ver, leader.region.epoch.version),
+        "ops": [],
+        "admin": ("conf_change", "add_learner", victim.peer_id, victim.store_id),
+    }
+    cluster._run_admin(leader, cmd)
+    cluster.process()
+    assert victim.peer_id in leader.node.voters
+    assert leader.region.peer_by_id(victim.peer_id).role == "voter"
+    # quorum still needs 2 of 3: stop one OTHER store and writes proceed
+    other = next(
+        p.store_id for p in leader.region.peers
+        if p.peer_id not in (leader.peer_id, victim.peer_id)
+    )
+    cluster.stop_node(other)
+    cluster.must_put(b"still", b"writes")
+    assert cluster.must_get(b"still") == b"writes"

@@ -404,12 +404,16 @@ class StorePeer:
         if op in ("add", "add_learner"):
             sid = self.store.pending_conf_stores.get((self.region.id, pid), 0)
             existing = self.region.peer_by_id(pid)
-            role = "learner" if op == "add_learner" else "voter"
-            if existing is None:
+            is_new = existing is None
+            # keep region metadata in lockstep with the raft node's view:
+            # add_learner on an existing VOTER is a no-op there, so it must
+            # be a no-op here too (demotion goes remove → add_learner)
+            role = "learner" if pid in self.node.learners else "voter"
+            if is_new:
                 self.region.peers.append(RegionPeer(pid, sid, role))
             else:
                 existing.role = role
-            if self.node.is_leader() and pid != self.peer_id:
+            if self.node.is_leader() and pid != self.peer_id and is_new:
                 # new peers are seeded by snapshot, never by full log replay
                 # (peer_storage.rs: uninitialized peers wait for a snapshot)
                 self.node.force_snapshot.add(pid)
@@ -599,6 +603,7 @@ class Store:
         self.peers: dict[int, StorePeer] = {}
         self.pending_conf_stores: dict[tuple[int, int], int] = {}
         self._inbox: list[RaftMessage] = []
+        self._compact_requested = threading.Event()
         self._mu = threading.RLock()
         self.split_observers: list[Callable] = []
         self.merge_observers: list[Callable] = []
@@ -719,6 +724,61 @@ class Store:
     def tick(self) -> None:
         for peer in list(self.peers.values()):
             peer.node.tick()
+        if self._compact_requested.is_set():
+            self._compact_requested.clear()
+            self.compact_raft_logs()
+
+    def request_log_compaction(self) -> None:
+        """Ask the raft-driving thread to compact at its next tick — log
+        state is single-writer (the raft loop); other threads must not
+        mutate it concurrently."""
+        self._compact_requested.set()
+
+    # -- raft log GC (store/worker/raftlog_gc.rs) ---------------------------
+
+    def compact_raft_logs(self, threshold: int = 1024, slack: int = 64) -> int:
+        """Truncate each region's applied log prefix once it exceeds
+        ``threshold`` entries.  ``slack`` recent entries always stay for
+        cheap catch-up; followers lagging more than ``threshold`` behind are
+        abandoned to snapshot seeding (which the append path already
+        handles).  Must run on the raft-driving thread (see
+        request_log_compaction).  Returns entries dropped."""
+        dropped = 0
+        for peer in list(self.peers.values()):
+            node = peer.node
+            applied = node.applied
+            first = node.log.offset
+            if applied - first + 1 <= threshold:
+                continue
+            compact_to = applied - slack
+            if node.is_leader():
+                # don't compact below followers that are close enough to catch
+                # up from the log; stragglers further behind than the
+                # threshold are abandoned to snapshot seeding (raftlog_gc.rs)
+                near_matches = [
+                    m
+                    for p in node._replicas()
+                    if (m := node.match_index.get(p, 0)) >= applied - threshold
+                ]
+                if near_matches:
+                    compact_to = min(compact_to, min(near_matches))
+            if compact_to <= first - 1:
+                continue
+            term = node.log.term_at(compact_to)
+            if term is None:
+                continue
+            node.log.compact_to(compact_to, term)
+            wb = WriteBatch()
+            log_prefix = keys.region_raft_prefix(peer.region.id) + keys.RAFT_LOG_SUFFIX
+            wb.delete_range_cf(
+                CF_RAFT,
+                log_prefix + codec.encode_u64(0),
+                log_prefix + codec.encode_u64(compact_to + 1),
+            )
+            wb.put_cf(CF_RAFT, keys.raft_state_key(peer.region.id), peer._encode_raft_state())
+            self.engine.write(wb)
+            dropped += compact_to - first + 1
+        return dropped
 
     def on_split(self, old: Region, new: Region) -> None:
         for cb in self.split_observers:

@@ -77,6 +77,7 @@ class Node:
                     if peer.node.is_leader():
                         self.pd.region_heartbeat(peer.region.clone(), self.store_id)
                         self._maybe_split(peer)
+                self.store.request_log_compaction()
                 self._stop.wait(heartbeat_interval)
 
         for fn in (raft_loop, pd_loop):
