@@ -1,0 +1,163 @@
+"""Failpoint fault-injection cases (reference: tests/failpoints/cases/,
+fail_point! sites like coprocessor_parse_request, scheduler paths)."""
+
+import threading
+
+import pytest
+
+from tikv_tpu.util import failpoint
+from tikv_tpu.util.failpoint import FailpointError, cfg, fail_point, teardown
+from tikv_tpu.storage.storage import Storage
+from tikv_tpu.storage.txn.commands import Commit, Prewrite
+from tikv_tpu.storage.txn_types import Key, Mutation
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    teardown()
+    yield
+    teardown()
+
+
+def test_failpoint_actions():
+    fail_point("nope")  # unconfigured: no-op
+    cfg("p1", "return")
+    with pytest.raises(FailpointError):
+        fail_point("p1")
+    cfg("p1", "off")
+    fail_point("p1")
+    cfg("p2", "2*return")
+    for _ in range(2):
+        with pytest.raises(FailpointError):
+            fail_point("p2")
+    fail_point("p2")  # count exhausted
+    cfg("p3", "panic")
+    with pytest.raises(RuntimeError, match="panic"):
+        fail_point("p3")
+    assert failpoint.list_active() == {"p3": "panic"}
+
+
+def test_scheduler_failpoint_blocks_write_atomically():
+    """A fault before the engine write must leave no partial state."""
+    store = Storage()
+    cfg("scheduler_before_write", "return")
+    with pytest.raises(FailpointError):
+        store.sched_txn_command(
+            Prewrite([Mutation.put(Key.from_raw(b"k"), b"v")], b"k", 10)
+        )
+    teardown()
+    # nothing was written — and the latch was released (no deadlock)
+    assert store.scan_lock(None, None, 100) == []
+    r = store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"k"), b"v")], b"k", 10))
+    assert "errors" not in r
+    store.sched_txn_command(Commit([Key.from_raw(b"k")], 10, 20))
+    assert store.get(b"k", 30) == b"v"
+
+
+def test_pause_failpoint_creates_race_window():
+    """pause holds a thread mid-command; writes resume when released."""
+    store = Storage()
+    cfg("scheduler_before_write", "pause")
+    done = threading.Event()
+
+    def writer():
+        store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"p"), b"v")], b"p", 10))
+        done.set()
+
+    t = threading.Thread(target=writer)
+    t.start()
+    assert not done.wait(0.1)  # held at the failpoint
+    failpoint.remove("scheduler_before_write")
+    assert done.wait(2)
+    t.join()
+
+
+def test_coprocessor_failpoint_over_endpoint():
+    import sys, os
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from copr_fixtures import PRODUCT_COLUMNS, TABLE_ID, product_engine
+    from tikv_tpu.copr.dag import DagRequest, TableScan
+    from tikv_tpu.copr.endpoint import CoprRequest, Endpoint
+    from tikv_tpu.copr.table import record_range
+    from tikv_tpu.storage.kv import LocalEngine
+
+    ep = Endpoint(LocalEngine(product_engine()), enable_device=False)
+    dag = DagRequest(executors=[TableScan(TABLE_ID, PRODUCT_COLUMNS)])
+    req = lambda: CoprRequest(103, DagRequest(executors=dag.executors), [record_range(TABLE_ID)], 200, context={})
+    cfg("coprocessor_parse_request", "1*return")
+    with pytest.raises(FailpointError):
+        ep.handle_request(req())
+    r = ep.handle_request(req())  # next request fine
+    assert len(r.data) > 0
+
+
+def test_snapshot_generation_failpoint_in_cluster():
+    """A failed snapshot generation is retried on later ticks (the catch-up
+    path survives transient snapshot faults)."""
+    from tikv_tpu.raft.cluster import FIRST_REGION_ID, Cluster
+
+    c = Cluster(4)
+    region = c.bootstrap_subset([1, 2, 3])
+    c.elect_leader(region.id, 1)
+    c.must_put(b"k", b"v")
+    cfg("region_gen_snapshot", "2*panic")
+    try:
+        c.add_peer(region.id, 4)
+        for _ in range(10):
+            try:
+                c.tick(1)
+            except RuntimeError:
+                pass  # snapshot generation faulted this round
+        teardown()
+        c.tick(5)
+        assert c.get_on_store(4, b"k") == b"v"
+    finally:
+        teardown()
+
+
+def test_counted_pause_actually_pauses():
+    """'1*pause' must hold arriving threads; the window ends on reconfigure,
+    counts never decrement it."""
+    cfg("cp", "1*pause")
+    released = threading.Event()
+
+    def waiter():
+        fail_point("cp")
+        released.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    assert not released.wait(0.15)  # actually held
+    failpoint.remove("cp")
+    assert released.wait(2)
+    t.join()
+
+
+def test_apply_failpoint_does_not_lose_committed_entries():
+    """A fault between commit and apply must re-deliver the entry, not drop
+    it: ready() pre-advances applied, so handle_ready rewinds on failure."""
+    from tikv_tpu.raft.cluster import FIRST_REGION_ID, Cluster
+
+    c = Cluster(3)
+    c.bootstrap_subset([1, 2, 3])
+    c.elect_leader(FIRST_REGION_ID, 1)
+    c.must_put(b"a", b"1")
+    cfg("apply_before_exec", "3*return")  # one fault per store
+    faults = 0
+    for _ in range(30):
+        try:
+            c.tick(1)
+        except FailpointError:
+            faults += 1
+        try:
+            c.must_put(b"b", b"2")
+            break
+        except FailpointError:
+            faults += 1
+    teardown()
+    c.tick(5)
+    assert faults > 0  # the failpoint did fire
+    for sid in (1, 2, 3):
+        assert c.get_on_store(sid, b"a") == b"1"
+        assert c.get_on_store(sid, b"b") == b"2"

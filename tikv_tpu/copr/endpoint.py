@@ -66,6 +66,9 @@ class Endpoint:
     def handle_request(self, req: CoprRequest) -> CoprResponse:
         from .tracker import Tracker
 
+        from ..util.failpoint import fail_point
+
+        fail_point("coprocessor_parse_request")
         tracker = Tracker(f"copr tp={req.tp} region={req.context.get('region_id') if req.context else None}")
         if req.tp == REQ_TYPE_ANALYZE:
             return self._tracked(tracker, self._handle_analyze, req)

@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 from typing import Callable
 
 from ..storage.btree_engine import BTreeEngine
+from ..util.failpoint import fail_point
 from ..storage.engine import CF_DEFAULT, CF_LOCK, CF_RAFT, CF_WRITE, WriteBatch
 from ..util import codec, keys
 from .core import Entry, Message, MsgType, RaftNode, Role
@@ -292,8 +293,22 @@ class StorePeer:
             eng.write(wb)
         if rd.snapshot is not None:
             self._apply_snapshot(rd.snapshot)
-        for e in rd.committed_entries:
-            self._apply_entry(e)
+        if rd.committed_entries:
+            applied = rd.committed_entries[0].index - 1
+            try:
+                for e in rd.committed_entries:
+                    self._apply_entry(e)
+                    applied = e.index
+            except BaseException:
+                # a fault mid-apply (e.g. an injected failpoint) must not
+                # lose committed entries: ready() advanced node.applied to
+                # commit when it drained them, so rewind to the last entry
+                # actually applied — the next ready() re-delivers the rest
+                self.node.applied = applied
+                eng.put_cf(
+                    CF_RAFT, keys.apply_state_key(self.region.id), codec.encode_u64(applied)
+                )
+                raise
         if rd.committed_entries:
             # ApplyState: recovery resumes application after this index
             eng.put_cf(
@@ -356,6 +371,7 @@ class StorePeer:
             self._apply_commit_merge(admin)
             self._ack(e, {"commit_merge": True}, None)
             return
+        fail_point("apply_before_exec")
         wb = WriteBatch()
         for op, cf, key, val in cmd["ops"]:
             dkey = keys.data_key(key)
@@ -483,6 +499,7 @@ class StorePeer:
     def _generate_snapshot(self) -> RaftSnapshot:
         """Full region-range snapshot of the data CFs + region meta
         (store/snap.rs; meta rides along like SnapshotMeta)."""
+        fail_point("region_gen_snapshot")
         eng = self.store.engine
         out = bytearray()
         out += codec.encode_compact_bytes(encode_region(self.region, self.merging))

@@ -34,6 +34,10 @@ class Node:
         self.split_threshold_keys = split_threshold_keys
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        # faults escaping the raft loop (e.g. injected failpoints) land here
+        # instead of silently killing the daemon thread; apply re-delivery is
+        # handled by the store (Peer.handle_ready rewinds on failure)
+        self.thread_errors: list[Exception] = []
         pd.put_store(self.store_id)
         self.store.split_observers.append(self._on_split)
 
@@ -61,12 +65,17 @@ class Node:
         def raft_loop():
             last_tick = 0.0
             while not self._stop.is_set():
-                moved = self.store.process_messages()
-                moved |= self.store.handle_readies()
-                now = time.monotonic()
-                if now - last_tick >= tick_interval:
-                    self.store.tick()
-                    last_tick = now
+                try:
+                    moved = self.store.process_messages()
+                    moved |= self.store.handle_readies()
+                    now = time.monotonic()
+                    if now - last_tick >= tick_interval:
+                        self.store.tick()
+                        last_tick = now
+                except Exception as exc:  # keep the store beating on faults
+                    if len(self.thread_errors) < 128:
+                        self.thread_errors.append(exc)
+                    moved = False
                 if not moved:
                     time.sleep(0.001)
 

@@ -12,6 +12,7 @@ the same ordering guarantees with Python-level simplicity.
 
 from __future__ import annotations
 
+from ...util.failpoint import fail_point
 from ..kv import Engine
 from .commands import Command
 from .latches import Latches
@@ -28,8 +29,10 @@ class Scheduler:
         keys = cmd.latch_keys()
         slots = self.latches.acquire(cid, keys)
         try:
+            fail_point("scheduler_async_snapshot")
             snapshot = self.engine.snapshot(ctx)
             txn, result = cmd.process_write(snapshot)
+            fail_point("scheduler_before_write")
             if not txn.is_empty():
                 self.engine.write(ctx, txn.wb)
             return result

@@ -1,0 +1,111 @@
+"""Failpoints: deterministic fault injection for tests.
+
+Re-expression of the ``fail`` crate the reference leans on (179 fail_point!
+sites; tests/failpoints/cases/): named points compiled into the code are
+no-ops until a test configures an action —
+
+    "off"          do nothing (default)
+    "return"       make the site raise FailpointError (callers see a fault)
+    "panic"        raise RuntimeError (unrecoverable-path testing)
+    "pause"        block until the point is reconfigured (race windows)
+    "sleep(ms)"    delay the thread
+    "N*action"     apply the action only N times, then off (pause excepted:
+                   a pause ends only when reconfigured, so counts never
+                   decrement it)
+
+``fail_point("name")`` at a call site; ``cfg()/remove()/teardown()`` from
+tests (also honors the FAILPOINTS env var, "name=action;name2=action").
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+
+class FailpointError(Exception):
+    def __init__(self, name: str):
+        self.name = name
+        super().__init__(f"failpoint {name!r} triggered")
+
+
+_mu = threading.Condition()
+_actions: dict[str, tuple[str, int | None]] = {}  # name -> (action, remaining)
+
+
+def _load_env() -> None:
+    spec = os.environ.get("FAILPOINTS", "")
+    for part in spec.split(";"):
+        if "=" in part:
+            name, action = part.split("=", 1)
+            cfg(name.strip(), action.strip())
+
+
+def cfg(name: str, action: str) -> None:
+    """Configure a failpoint: e.g. cfg("apply_before_write", "return") or
+    cfg("snap_gen", "2*return")."""
+    count: int | None = None
+    if "*" in action:
+        n, action = action.split("*", 1)
+        count = int(n)
+    with _mu:
+        if action == "off":
+            _actions.pop(name, None)
+        else:
+            _actions[name] = (action, count)
+        _mu.notify_all()
+
+
+def remove(name: str) -> None:
+    cfg(name, "off")
+
+
+def teardown() -> None:
+    with _mu:
+        _actions.clear()
+        _mu.notify_all()
+
+
+def list_active() -> dict[str, str]:
+    with _mu:
+        return {n: a for n, (a, _c) in _actions.items()}
+
+
+def fail_point(name: str) -> None:
+    """The injected call site. No-op unless the point is configured."""
+    if not _actions:
+        # disabled fast path: hot call sites (apply loop, scheduler,
+        # coprocessor entry) must not contend on _mu when nothing is
+        # configured — a bare dict-truthiness read is atomic under the GIL
+        return
+    with _mu:
+        ent = _actions.get(name)
+        if ent is None:
+            return
+        action, count = ent
+        if action == "pause":
+            # a pause window ends when the point is reconfigured (cfg/remove
+            # replaces the entry), so counts never decrement it — every
+            # arriving thread blocks until release
+            while True:
+                cur = _actions.get(name)
+                if cur is None or cur[0] != "pause":
+                    return
+                _mu.wait(0.01)
+        if count is not None:
+            if count <= 1:
+                _actions.pop(name, None)
+            else:
+                _actions[name] = (action, count - 1)
+    if action == "return":
+        raise FailpointError(name)
+    if action == "panic":
+        raise RuntimeError(f"failpoint panic: {name}")
+    if action.startswith("sleep("):
+        time.sleep(float(action[6:-1]) / 1000.0)
+        return
+    raise ValueError(f"unknown failpoint action {action!r}")
+
+
+_load_env()
