@@ -1,0 +1,296 @@
+"""Joint-consensus membership changes (raft thesis 4.3; raft-rs ConfChangeV2;
+reference: tests/integrations/raftstore/test_joint_consensus.rs).
+
+Core rule under test: while in the joint config C_old,new every decision —
+commit, election, lease, read quorum — needs a majority of BOTH configs."""
+
+import random
+
+import pytest
+
+from tikv_tpu.raft.core import Message, MsgType, RaftNode, Role, Snapshot
+from tikv_tpu.raft.cluster import FIRST_REGION_ID, Cluster
+
+from test_raft_core import Net
+
+
+class JNet(Net):
+    """Net over an explicit (node_ids, initial_voters) membership."""
+
+    def __init__(self, ids, voters, seed=0):
+        self.nodes = {
+            i: RaftNode(i, list(voters), rng=random.Random(seed * 100 + i)) for i in ids
+        }
+        self.cut = set()
+        self.applied = {i: [] for i in self.nodes}
+        self.persisted = {i: [] for i in self.nodes}
+        self.reads = {i: [] for i in self.nodes}
+
+
+def _enter_joint(net, leader, changes):
+    idx = leader.propose_conf_change(("enter_joint", tuple(changes)))
+    assert idx is not None
+    net.drain()
+    return idx
+
+
+def test_joint_commit_needs_both_majorities():
+    """C_old={1,2,3} -> C_new={1,4,5}: with the old majority unreachable,
+    entries must NOT commit even though the new config has a majority."""
+    net = JNet([1, 2, 3, 4, 5], [1, 2, 3])
+    leader = net.elect(1)
+    _enter_joint(net, leader, [("add", 4), ("add", 5), ("remove", 2), ("remove", 3)])
+    assert leader.outgoing == {1, 2, 3} and leader.voters == {1, 4, 5}
+    for p in (2, 3):
+        net.partition(1, p)
+        net.partition(4, p)
+        net.partition(5, p)
+    commit_before = leader.commit
+    leader.propose(b"joint-data")
+    net.drain()
+    assert leader.commit == commit_before  # new-majority acks alone are not enough
+    net.heal()
+    net.tick_all(3)  # heartbeat round retransmits to the lagging old majority
+    assert leader.commit > commit_before
+    assert b"joint-data" in net.applied[4] and b"joint-data" in net.applied[2]
+    # leave: C_new alone rules; old-only peers drop out of the config
+    leader.propose_conf_change(("leave_joint", ()))
+    net.drain()
+    assert leader.outgoing is None
+    for p in (2, 3):
+        net.partition(1, p)
+        net.partition(4, p)
+        net.partition(5, p)
+    leader.propose(b"after-leave")
+    net.drain()
+    assert b"after-leave" in net.applied[5]
+
+
+def test_joint_election_needs_both_majorities():
+    """A candidate in the joint config cannot win with one config's votes."""
+    net = JNet([1, 2, 3, 4, 5], [1, 2, 3])
+    leader = net.elect(1)
+    _enter_joint(net, leader, [("add", 4), ("add", 5), ("remove", 2), ("remove", 3)])
+    net.drain()
+    # depose and cut node 1 off from the NEW peers only
+    for p in (4, 5):
+        net.partition(1, p)
+    net.nodes[1].campaign()
+    net.drain()
+    assert net.nodes[1].role != Role.LEADER  # old majority {1,2,3} granted, new did not
+    net.heal()
+    net.nodes[1].campaign()
+    net.drain()
+    assert net.nodes[1].role == Role.LEADER
+
+
+def test_joint_proposal_ordering_guards():
+    net = JNet([1, 2, 3], [1, 2, 3])
+    leader = net.elect(1)
+    assert leader.propose_conf_change(("leave_joint", ())) is None  # not joint
+    _enter_joint(net, leader, [("remove", 3)])
+    assert leader.propose_conf_change(("enter_joint", (("add", 4),))) is None  # already joint
+    assert leader.propose_conf_change(("leave_joint", ())) is not None
+
+
+def test_snapshot_carries_joint_config():
+    net = JNet([1, 2, 3], [1, 2, 3])
+    leader = net.elect(1)
+    _enter_joint(net, leader, [("remove", 3), ("add", 4)])
+    snap = Snapshot(
+        index=leader.applied, term=leader.term, data=b"",
+        voters=tuple(leader.voters), learners=(), outgoing=tuple(leader.outgoing),
+    )
+    fresh = RaftNode(4, [])
+    fresh.step(Message(MsgType.SNAPSHOT, 1, 4, leader.term, snapshot=snap))
+    assert fresh.voters == {1, 2, 4}
+    assert fresh.outgoing == {1, 2, 3}
+
+
+# ---------------------------------------------------------------------------
+# cluster level (store + region metadata + auto-leave)
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(5)
+    c.bootstrap_subset([1, 2, 3])
+    c.elect_leader(FIRST_REGION_ID, 1)
+    return c
+
+
+def test_replace_peer_atomically(cluster):
+    """add+remove in ONE change: no intermediate 2-voter or 4-voter config
+    window (the availability hole single-step changes have)."""
+    cluster.must_put(b"jk", b"jv")
+    leader = cluster.leader_peer(FIRST_REGION_ID)
+    victim = next(p.peer_id for p in leader.region.peers if p.store_id == 3)
+    conf_ver_before = leader.region.epoch.conf_ver
+    (new_pid,) = cluster.joint_conf_change(
+        FIRST_REGION_ID, [("add", 4), ("remove", victim)]
+    )
+    leader = cluster.leader_peer(FIRST_REGION_ID)
+    assert leader.node.outgoing is None
+    assert {p.store_id for p in leader.region.peers} == {1, 2, 4}
+    assert new_pid in leader.node.voters and victim not in leader.node.voters
+    # enter + leave each bump conf_ver
+    assert leader.region.epoch.conf_ver >= conf_ver_before + 2
+    cluster.tick(5)
+    assert cluster.get_on_store(4, b"jk") == b"jv"  # snapshot-seeded
+    assert FIRST_REGION_ID not in cluster.stores[3].peers  # destroyed
+    cluster.must_put(b"jk2", b"jv2")
+    cluster.tick(3)
+    assert cluster.get_on_store(4, b"jk2") == b"jv2"
+
+
+def test_joint_demote_with_replacement(cluster):
+    """Demote a voter to learner while adding its replacement — the
+    reference's safe way to shrink without a no-quorum window."""
+    leader = cluster.leader_peer(FIRST_REGION_ID)
+    demoted = next(p.peer_id for p in leader.region.peers if p.store_id == 2)
+    (new_pid,) = cluster.joint_conf_change(
+        FIRST_REGION_ID, [("add", 5), ("demote", demoted)]
+    )
+    leader = cluster.leader_peer(FIRST_REGION_ID)
+    assert demoted in leader.node.learners and demoted not in leader.node.voters
+    assert new_pid in leader.node.voters
+    role = next(p.role for p in leader.region.peers if p.peer_id == demoted)
+    assert role == "learner"
+    cluster.must_put(b"dk", b"dv")
+    cluster.tick(3)
+    assert cluster.get_on_store(2, b"dk") == b"dv"  # learners still replicate
+
+
+def test_joint_config_survives_crash_recovery(cluster):
+    """A store restarted mid-joint must come back with the joint config —
+    region roles alone cannot reconstruct C_old ∩ C_new."""
+    from tikv_tpu.raft.store import Store
+    from tikv_tpu.storage.engine import CF_RAFT
+    from tikv_tpu.util import keys
+
+    cluster.must_put(b"ck", b"cv")
+    old_store = cluster.stores[2]
+    peer = old_store.peers[FIRST_REGION_ID]
+    # freeze the peer mid-joint and persist, as if it crashed between
+    # enter_joint and leave_joint
+    peer.node.outgoing = set(peer.node.voters)
+    peer.node.voters = (peer.node.voters - {peer.peer_id}) | {999}
+    peer.node.learners = {peer.peer_id}
+    old_store.engine.put_cf(
+        CF_RAFT, keys.raft_state_key(FIRST_REGION_ID), peer._encode_raft_state()
+    )
+    new_store = Store(2, cluster.transport, engine=old_store.engine)
+    assert new_store.recover() == 1
+    node = new_store.peers[FIRST_REGION_ID].node
+    assert node.outgoing == peer.node.outgoing
+    assert node.voters == peer.node.voters
+    assert node.learners == {peer.peer_id}
+
+
+def test_new_leader_reproposes_leave_joint():
+    """If the leader dies between enter_joint applying and leave_joint
+    committing, the next leader must finish the transition on its own."""
+    net = JNet([1, 2, 3, 4], [1, 2, 3])
+    leader = net.elect(1)
+    _enter_joint(net, leader, [("add", 4), ("remove", 3)])
+    net.tick_all(3)  # heartbeat rounds bring the new peer up to date
+    assert all(net.nodes[i].outgoing == {1, 2, 3} for i in (1, 2, 3, 4))
+    # old leader crashes before proposing leave (core has no auto-leave —
+    # that's the store's job — so the joint config is still active here)
+    for p in (2, 3, 4):
+        net.partition(1, p)
+    net.nodes[2].campaign()
+    net.drain()
+    assert net.nodes[2].role == Role.LEADER
+    net.tick_all(3)
+    assert net.nodes[2].outgoing is None  # re-proposed leave committed
+    assert net.nodes[4].outgoing is None
+    assert net.nodes[2].voters == {1, 2, 4}
+
+
+def test_no_overlapping_conf_changes():
+    """has_pending_conf: a second membership change is rejected until the
+    first one's entry is applied; simple ops are rejected mid-joint."""
+    net = JNet([1, 2, 3], [1, 2, 3])
+    leader = net.elect(1)
+    idx = leader.propose_conf_change(("enter_joint", (("remove", 3),)))
+    assert idx is not None
+    # not yet applied: everything else bounces
+    assert leader.propose_conf_change(("add", 9)) is None
+    assert leader.propose_conf_change(("enter_joint", (("add", 9),))) is None
+    net.drain()  # enter_joint applies; joint active
+    assert leader.propose_conf_change(("add", 9)) is None  # simple op mid-joint
+    assert leader.propose_conf_change(("leave_joint", ())) is not None
+    net.drain()
+    assert leader.outgoing is None
+    assert leader.propose_conf_change(("add", 9)) is not None  # back to normal
+
+
+def test_conf_state_persisted_at_apply_time(cluster):
+    """Recovery right after a conf change applies must see the POST-change
+    membership — the raft-state blob written earlier in the same ready
+    carries the pre-change config and must have been rewritten."""
+    from tikv_tpu.raft.store import Store
+
+    new_pid = cluster.add_peer(FIRST_REGION_ID, 4)
+    cluster.tick(3)
+    for sid in (1, 2):
+        old_store = cluster.stores[sid]
+        new_store = Store(sid, cluster.transport, engine=old_store.engine)
+        assert new_store.recover() == 1
+        node = new_store.peers[FIRST_REGION_ID].node
+        assert new_pid in node.voters, f"store {sid} recovered stale ConfState"
+        assert node.outgoing is None
+
+
+def test_bogus_joint_op_rejected(cluster):
+    with pytest.raises(ValueError, match="frobnicate"):
+        cluster.joint_conf_change(FIRST_REGION_ID, [("frobnicate", 2)])
+
+
+def test_leader_crash_mid_joint_completes_at_cluster_level(cluster):
+    """Peer placement rides in the conf entry, so a NEW leader (which never
+    saw the proposal) can still reach the added peer and finish the joint
+    transition after the old leader dies."""
+    cluster.must_put(b"a", b"1")
+    lead = cluster.leader_peer(FIRST_REGION_ID)
+    victim = next(p.peer_id for p in lead.region.peers if p.store_id == 3)
+    wire = (("add", cluster.alloc_id(), 4), ("remove", victim, 0))
+    cmd = {
+        "epoch": (lead.region.epoch.conf_ver, lead.region.epoch.version),
+        "ops": [],
+        "admin": ("conf_change_v2", wire),
+    }
+    lead.propose_cmd(cmd, lambda r: None)
+    cluster.process()
+    cluster.stop_node(1)  # dies before driving leave_joint
+    cluster.tick(30)
+    nl = cluster.leader_peer(FIRST_REGION_ID)
+    assert nl is not None, "no leader elected after crash mid-joint"
+    assert nl.node.outgoing is None, "stuck in joint config"
+    cluster.must_put(b"b", b"2")
+    cluster.tick(3)
+    assert cluster.get_on_store(4, b"b") == b"2"
+
+
+def test_no_conf_replay_after_recovery(cluster):
+    """ConfState + apply index persist in one batch at conf-change apply, so
+    recovery can never replay the entry against post-change membership (which
+    would double-bump conf_ver and corrupt outgoing to C_new)."""
+    from tikv_tpu.raft.store import Store
+
+    victim = next(
+        p.peer_id
+        for p in cluster.leader_peer(FIRST_REGION_ID).region.peers
+        if p.store_id == 3
+    )
+    cluster.joint_conf_change(FIRST_REGION_ID, [("add", 4), ("remove", victim)])
+    for sid in (1, 2, 4):
+        pre = cluster.stores[sid].peers[FIRST_REGION_ID]
+        ns = Store(sid, cluster.transport, engine=cluster.stores[sid].engine)
+        assert ns.recover() == 1
+        p = ns.peers[FIRST_REGION_ID]
+        assert p.node.outgoing is None
+        assert p.node.voters == pre.node.voters
+        assert p.region.epoch.conf_ver == pre.region.epoch.conf_ver, "conf entry replayed"
+        assert p.node.applied >= 1

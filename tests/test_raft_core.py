@@ -385,3 +385,25 @@ def test_read_index_ignores_learner_acks():
     leader.read_index(b"stale?")
     net.drain()  # learner acks flow, voters don't
     assert net.reads[1] == []  # must NOT serve with only a learner ack
+
+
+def test_stale_append_below_snapshot_is_ignored():
+    """A late retransmit of pre-snapshot entries must not splice them into
+    the log (offset-based index arithmetic would corrupt) or regress commit."""
+    n = RaftNode(2, [1, 2, 3])
+    n.term = 1
+    n.log.reset_to_snapshot(Snapshot(index=4, term=1, data=b"", voters=(1, 2, 3)))
+    n.commit = n.applied = 4
+    n.step(
+        Message(
+            MsgType.APPEND, 1, 2, 1, log_index=0, log_term=0,
+            entries=[Entry(1, 1, b"a"), Entry(1, 2, b"b"), Entry(1, 3, b"c")],
+            commit=4,
+        )
+    )
+    assert n.log.entries == []
+    assert n.log.last_index() == 4
+    assert n.commit == 4
+    rd = n.ready()
+    resps = [m for m in rd.messages if m.type == MsgType.APPEND_RESP]
+    assert resps and not resps[0].reject and resps[0].log_index >= 4

@@ -227,6 +227,41 @@ class Cluster:
         }
         self._run_admin(leader, cmd)
 
+    def joint_conf_change(self, region_id: int, changes: list[tuple[str, int]]) -> list[int]:
+        """Atomic multi-peer membership change via joint consensus
+        (ConfChangeV2 — pd_client uses this for e.g. replace-peer).
+
+        ``changes``: ("add"|"add_learner", store_id) or
+        ("promote"|"demote"|"remove", peer_id).  Returns the new peer ids for
+        the add ops, after the automatic leave_joint completes."""
+        leader = self.wait_leader(region_id)
+        wire: list[tuple[str, int, int]] = []
+        new_pids: list[int] = []
+        for op, _arg in changes:
+            if op not in ("add", "add_learner", "promote", "demote", "remove"):
+                raise ValueError(f"unknown conf change op {op!r}")
+        for op, arg in changes:
+            if op in ("add", "add_learner"):
+                pid = self.alloc_id()
+                new_pids.append(pid)
+                wire.append((op, pid, arg))
+            elif op == "demote":
+                wire.append(("add_learner", arg, 0))
+            else:
+                wire.append((op, arg, 0))
+        cmd = {
+            "epoch": (leader.region.epoch.conf_ver, leader.region.epoch.version),
+            "ops": [],
+            "admin": ("conf_change_v2", tuple(wire)),
+        }
+        self._run_admin(leader, cmd)
+        for _ in range(100):
+            self.tick()
+            lp = self.leader_peer(region_id)
+            if lp is not None and lp.node.outgoing is None:
+                return new_pids
+        raise AssertionError(f"joint change on region {region_id} never left the joint config")
+
     def remove_peer(self, region_id: int, peer_id: int) -> None:
         leader = self.wait_leader(region_id)
         cmd = {

@@ -15,8 +15,9 @@ Implemented: randomized election timeout, pre-vote, leader election, log
 replication with consistency check, quorum commitment, heartbeats + leases
 (broadcast-tick granted, sticky votes), learners (non-voting replicas with
 promote), snapshot install for lagging/new peers, single-step membership
-change, hibernation, ReadIndex.
-Not yet: joint consensus; log compaction is driven by the store layer.
+change AND joint consensus (ConfChangeV2: dual-quorum commit/election/lease
+while in C_old,new, auto-leave re-proposed on leadership change), hibernation,
+ReadIndex.  Log compaction is driven by the store layer.
 """
 
 from __future__ import annotations
@@ -54,8 +55,10 @@ class Entry:
     term: int
     index: int
     data: bytes = b""
-    # conf change entries carry ("add"|"remove", peer_id) instead of data
-    conf_change: tuple[str, int] | None = None
+    # conf change entries carry (op, peer_id[, store_id]) for single-step
+    # changes or ("enter_joint", ((op, peer_id[, store_id]), ...)) /
+    # ("leave_joint", ()) for joint consensus, instead of data
+    conf_change: tuple | None = None
 
 
 @dataclass
@@ -65,6 +68,7 @@ class Snapshot:
     data: bytes  # opaque state-machine snapshot
     voters: tuple[int, ...]
     learners: tuple[int, ...] = ()
+    outgoing: tuple[int, ...] = ()  # non-empty while a joint change is in flight
 
 
 @dataclass
@@ -178,6 +182,10 @@ class RaftNode:
         self.id = node_id
         self.voters: set[int] = set(voters)
         self.learners: set[int] = set()
+        # joint consensus (raft thesis 4.3 / raft-rs ConfChangeV2): while not
+        # None this is the OUTGOING voter config C_old; self.voters is the
+        # incoming C_new, and every quorum decision needs a majority of BOTH
+        self.outgoing: set[int] | None = None
         self.pre_vote = True
         self.term = 0
         self.vote: int | None = None
@@ -186,6 +194,10 @@ class RaftNode:
         self.log = RaftLog()
         self.commit = 0
         self.applied = 0
+        # index of the newest conf-change entry in the log; while it trails
+        # applied, no further conf change may be proposed (raft-rs
+        # has_pending_conf) — overlapping changes would corrupt the config
+        self._pending_conf_index = 0
 
         self.election_tick = election_tick
         self.heartbeat_tick = heartbeat_tick
@@ -227,11 +239,26 @@ class RaftNode:
     def _rand_timeout(self) -> int:
         return self.election_tick + self.rng.randrange(self.election_tick)
 
-    def _quorum(self) -> int:
-        return len(self.voters) // 2 + 1
+    def _all_voters(self) -> set[int]:
+        return self.voters | (self.outgoing or set())
+
+    def _has_quorum(self, acks: set[int]) -> bool:
+        """Joint-aware quorum test: a majority of the incoming config, AND —
+        while a joint membership change is in flight — of the outgoing one."""
+        if len(acks & self.voters) < len(self.voters) // 2 + 1:
+            return False
+        if self.outgoing is not None:
+            return len(acks & self.outgoing) >= len(self.outgoing) // 2 + 1
+        return True
+
+    def _quorum_lost(self, rejects: set[int]) -> bool:
+        """An election is unwinnable once either config's majority rejected."""
+        if len(rejects & self.voters) >= len(self.voters) // 2 + 1:
+            return True
+        return self.outgoing is not None and len(rejects & self.outgoing) >= len(self.outgoing) // 2 + 1
 
     def _replicas(self) -> set[int]:
-        return (self.voters | self.learners) - {self.id}
+        return (self.voters | self.learners | (self.outgoing or set())) - {self.id}
 
     def is_leader(self) -> bool:
         return self.role == Role.LEADER
@@ -265,10 +292,10 @@ class RaftNode:
         self._elapsed = 0
         self._randomized_timeout = self._rand_timeout()
         self._ready.hard_state_changed = True
-        if self._quorum() == 1:
+        if self._has_quorum({self.id}):
             self._become_leader()
             return
-        for peer in self.voters - {self.id}:
+        for peer in self._all_voters() - {self.id}:
             self._send(
                 Message(
                     MsgType.VOTE, self.id, peer, self.term,
@@ -282,12 +309,23 @@ class RaftNode:
         self.role = Role.LEADER
         self.leader_id = self.id
         last = self.log.last_index()
-        members = self.voters | self.learners
+        members = self.voters | self.learners | (self.outgoing or set())
         self.next_index = {p: last + 1 for p in members}
         self.match_index = {p: 0 for p in members}
         self.match_index[self.id] = last
-        # noop entry to commit entries from previous terms (§5.4.2)
-        self._append_entries([Entry(self.term, last + 1)])
+        # inherit in-flight conf entries appended by a previous leader — they
+        # re-arm the no-overlap guard until applied
+        for e in self.log.slice_from(self.applied + 1):
+            if e.conf_change is not None:
+                self._pending_conf_index = max(self._pending_conf_index, e.index)
+        entries = [Entry(self.term, last + 1)]  # noop commits prior terms (§5.4.2)
+        if self.outgoing is not None and self._pending_conf_index <= self.applied:
+            # the previous leader died between enter_joint applying and
+            # leave_joint committing: re-propose auto-leave (raft-rs keeps
+            # joint exit leader-driven the same way)
+            entries.append(Entry(self.term, last + 2, b"", conf_change=("leave_joint", ())))
+            self._pending_conf_index = last + 2
+        self._append_entries(entries)
         self._broadcast_append()
 
     # ---------------------------------------------------------------- public
@@ -304,7 +342,7 @@ class RaftNode:
                 and self.commit == self.log.last_index()
                 and all(
                     self.match_index.get(p, 0) == self.log.last_index()
-                    for p in self.voters
+                    for p in self._all_voters()
                 )
             ):
                 # final round tells followers to freeze their election timers;
@@ -319,7 +357,7 @@ class RaftNode:
                 self._elapsed = 0
                 self._broadcast_heartbeat()
         elif self._elapsed >= self._randomized_timeout:
-            if self.id in self.learners or self.id not in self.voters:
+            if self.id in self.learners or self.id not in self._all_voters():
                 self._elapsed = 0  # learners/removed peers never campaign
             elif self.pre_vote:
                 self._start_pre_vote()
@@ -341,10 +379,10 @@ class RaftNode:
         self.leader_id = None
         self._elapsed = 0
         self._randomized_timeout = self._rand_timeout()
-        if self._quorum() == 1:
+        if self._has_quorum({self.id}):
             self._become_candidate()
             return
-        for peer in self.voters - {self.id}:
+        for peer in self._all_voters() - {self.id}:
             self._send(
                 Message(
                     MsgType.PRE_VOTE, self.id, peer, self.term + 1,
@@ -371,7 +409,7 @@ class RaftNode:
         if votes is None:
             return
         votes[m.frm] = not m.reject
-        if sum(1 for p, ok in votes.items() if ok and p in self.voters) >= self._quorum():
+        if self._has_quorum({p for p, ok in votes.items() if ok}):
             self._pre_votes = None
             self._become_candidate()
 
@@ -392,11 +430,23 @@ class RaftNode:
         self._broadcast_append()
         return index
 
-    def propose_conf_change(self, change: tuple[str, int]) -> int | None:
+    def propose_conf_change(self, change: tuple) -> int | None:
         self._wake()
         if self.role != Role.LEADER:
             return None
+        # one membership change in flight at a time (raft-rs has_pending_conf):
+        # overlapping conf entries would both commit and the second apply
+        # would clobber the joint config
+        if self._pending_conf_index > self.applied:
+            return None
+        # joint transitions are strictly ordered: only leave_joint may be
+        # proposed while the joint config is active
+        if self.outgoing is not None and change[0] != "leave_joint":
+            return None
+        if change[0] == "leave_joint" and self.outgoing is None:
+            return None
         index = self.log.last_index() + 1
+        self._pending_conf_index = index
         self._append_entries([Entry(self.term, index, b"", conf_change=change)])
         self._broadcast_append()
         return index
@@ -419,15 +469,54 @@ class RaftNode:
         if not self._committed_in_term():
             self._deferred_reads.append((ctx, None))
             return
-        if self._quorum() == 1:
+        if self._has_quorum({self.id}):
             self._ready.read_states.append((ctx, self.commit))
             return
         self._pending_reads[ctx] = (self.commit, {self.id})
         self._broadcast_heartbeat(ctx=ctx)
 
-    def apply_conf_change(self, change: tuple[str, int]) -> None:
-        """Called by the container when a conf-change entry is applied."""
-        op, peer = change
+    def apply_conf_change(self, change: tuple) -> None:
+        """Called by the container when a conf-change entry is applied.
+
+        Simple ops mirror ConfChange (single-step, one peer); "enter_joint"
+        carries a tuple of simple (op, peer[, store]) changes applied
+        atomically with the prior voter set retained as the outgoing config,
+        and "leave_joint" drops it (raft thesis 4.3; raft-rs ConfChangeV2 +
+        apply_conf_change in components/raftstore/src/store/peer.rs).  Extra
+        elements (the container's placement info) are opaque here — like the
+        Peer message riding in the reference's ConfChange — so they replicate
+        with the entry instead of living only on the proposing node."""
+        op, peer = change[0], change[1]
+        if op == "enter_joint":
+            self.outgoing = set(self.voters)
+            for ch in peer:
+                sop, pid = ch[0], ch[1]
+                if sop in ("add", "promote"):
+                    self.voters.add(pid)
+                    self.learners.discard(pid)
+                elif sop == "add_learner":
+                    # inside a joint change this doubles as voter demotion —
+                    # safe because the peer keeps voting via the outgoing
+                    # config until leave_joint
+                    self.voters.discard(pid)
+                    self.learners.add(pid)
+                elif sop == "remove":
+                    self.voters.discard(pid)
+                    self.learners.discard(pid)
+                if self.role == Role.LEADER and sop != "remove" and pid not in self.next_index:
+                    self.next_index[pid] = self.log.last_index() + 1
+                    self.match_index[pid] = 0
+            if self.role == Role.LEADER:
+                self._maybe_commit()
+            return
+        if op == "leave_joint":
+            for pid in (self.outgoing or set()) - self.voters - self.learners:
+                self.next_index.pop(pid, None)
+                self.match_index.pop(pid, None)
+            self.outgoing = None
+            if self.role == Role.LEADER:
+                self._maybe_commit()
+            return
         if op == "add":
             self.voters.add(peer)
             self.learners.discard(peer)
@@ -549,10 +638,9 @@ class RaftNode:
         if self.role != Role.CANDIDATE:
             return
         self._votes[m.frm] = not m.reject
-        granted = sum(1 for p, ok in self._votes.items() if ok and p in self.voters)
-        if granted >= self._quorum():
+        if self._has_quorum({p for p, ok in self._votes.items() if ok}):
             self._become_leader()
-        elif sum(1 for ok in self._votes.values() if not ok) >= self._quorum():
+        elif self._quorum_lost({p for p, ok in self._votes.items() if not ok}):
             self._become_follower(self.term, None)
 
     # replication -----------------------------------------------------------
@@ -601,6 +689,11 @@ class RaftNode:
         # find conflict point, truncate, append the rest
         new_entries = []
         for e in m.entries:
+            if e.index < self.log.offset:
+                # already covered by our snapshot (committed state) — a late
+                # retransmit must not splice pre-snapshot entries into the
+                # list, which would corrupt offset-based index arithmetic
+                continue
             t = self.log.term_at(e.index)
             if t is None:
                 new_entries.append(e)
@@ -610,10 +703,12 @@ class RaftNode:
         if new_entries:
             self.log.append(new_entries)
             self._ready.entries.extend(new_entries)
-        last_new = m.log_index + len(m.entries)
+        last_new = max(m.log_index + len(m.entries), self.log.snapshot_index)
         if m.commit > self.commit:
-            self.commit = min(m.commit, last_new)
-            self._ready.hard_state_changed = True
+            new_commit = min(m.commit, last_new)
+            if new_commit > self.commit:
+                self.commit = new_commit
+                self._ready.hard_state_changed = True
         self._send(
             Message(MsgType.APPEND_RESP, self.id, m.frm, self.term, log_index=last_new)
         )
@@ -632,13 +727,18 @@ class RaftNode:
         if self.next_index[m.frm] <= self.log.last_index():
             self._send_append(m.frm)
 
+    def _quorum_index(self, cfg: set[int]) -> int:
+        matches = sorted((self.match_index.get(p, 0) for p in cfg), reverse=True)
+        return matches[len(cfg) // 2] if cfg else 0
+
     def _maybe_commit(self) -> None:
         if self.role != Role.LEADER:
             return
-        matches = sorted(
-            (self.match_index.get(p, 0) for p in self.voters), reverse=True
-        )
-        candidate = matches[self._quorum() - 1]
+        candidate = self._quorum_index(self.voters)
+        if self.outgoing is not None:
+            # joint rule: an entry commits only when replicated to a majority
+            # of BOTH configs
+            candidate = min(candidate, self._quorum_index(self.outgoing))
         # only commit entries of the current term by counting (§5.4.2)
         if candidate > self.commit and self.log.term_at(candidate) == self.term:
             self.commit = candidate
@@ -670,7 +770,7 @@ class RaftNode:
         return (
             self.role == Role.LEADER
             and self._committed_in_term()
-            and (self._quorum() == 1 or self._tick_count < self._lease_until)
+            and (self._has_quorum({self.id}) or self._tick_count < self._lease_until)
         )
 
     def _broadcast_heartbeat(self, ctx: bytes = b"") -> None:
@@ -709,7 +809,7 @@ class RaftNode:
             # hibernate-round acks must not re-grant a lease the frozen clock
             # could never expire
             self._hb_acks.add(m.frm)
-            if len(self._hb_acks & self.voters) >= self._quorum():
+            if self._has_quorum(self._hb_acks):
                 self._lease_until = max(
                     self._lease_until, self._hb_round_tick + self.election_tick
                 )
@@ -717,7 +817,7 @@ class RaftNode:
             index, acks = self._pending_reads[m.context]
             acks.add(m.frm)
             # learner acks carry no quorum weight (same rule as the lease path)
-            if len(acks & self.voters) >= self._quorum():
+            if self._has_quorum(acks):
                 del self._pending_reads[m.context]
                 origin = getattr(self, "_read_origins", {}).pop(m.context, None)
                 if origin is None:
@@ -747,6 +847,8 @@ class RaftNode:
         self.applied = snap.index
         self.voters = set(snap.voters)
         self.learners = set(snap.learners)
+        self.outgoing = set(snap.outgoing) if snap.outgoing else None
+        self._pending_conf_index = min(self._pending_conf_index, snap.index)
         self._ready.snapshot = snap
         self._ready.hard_state_changed = True
         self._send(Message(MsgType.APPEND_RESP, self.id, m.frm, self.term, log_index=snap.index))
@@ -762,7 +864,7 @@ class RaftNode:
         if not self._committed_in_term():
             self._deferred_reads.append((ctx, origin))
             return
-        if self._quorum() == 1:
+        if self._has_quorum({self.id}):
             self._send(Message(MsgType.READ_INDEX_RESP, self.id, origin, self.term, log_index=self.commit, context=ctx))
             return
         # piggyback on a heartbeat round keyed by the follower's ctx; remember

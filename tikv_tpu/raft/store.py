@@ -248,13 +248,24 @@ class StorePeer:
             cb(EpochError(self.region.clone()))
             return
         admin = cmd.get("admin")
-        if admin is not None and admin[0] == "conf_change":
-            index = self.node.propose_conf_change((admin[1], admin[2]))
+        if admin is not None and admin[0] == "conf_change_v2":
+            # atomic multi-peer change via joint consensus: admin carries
+            # [(op, peer_id, store_id), ...] — placement rides IN the entry
+            # so any future leader knows where new peers live, not just the
+            # proposing store
+            index = self.node.propose_conf_change(("enter_joint", tuple(admin[1])))
             if index is None:
                 cb(NotLeaderError(self.region.id, None))
                 return
-            # remember placement for when the entry applies
-            self.store.pending_conf_stores[(self.region.id, admin[2])] = admin[3]
+            self.proposals.append(Proposal(index, self.node.term, cb))
+            return
+        if admin is not None and admin[0] == "conf_change":
+            # placement (store id) rides in the entry, like the reference's
+            # ConfChange carrying the full Peer message
+            index = self.node.propose_conf_change((admin[1], admin[2], admin[3]))
+            if index is None:
+                cb(NotLeaderError(self.region.id, None))
+                return
             self.proposals.append(Proposal(index, self.node.term, cb))
             return
         index = self.node.propose(encode_cmd(cmd))
@@ -325,12 +336,10 @@ class StorePeer:
 
     def _send_raft_msg(self, m: Message) -> None:
         to_peer = self.region.peer_by_id(m.to)
-        if to_peer is None:
-            # conf-change in flight: look up the planned placement
-            sid = self.store.pending_conf_stores.get((self.region.id, m.to))
-            if sid is None:
-                return
-            to_peer = RegionPeer(m.to, sid)
+        if to_peer is None or to_peer.store_id == 0:
+            # placement unknown (region metadata lags the conf entry that
+            # carries it) — drop; retries resolve once the entry applies
+            return
         if m.type == MsgType.SNAPSHOT and m.snapshot is None:
             m.snapshot = self._generate_snapshot()
         rmsg = RaftMessage(
@@ -399,40 +408,65 @@ class StorePeer:
                 rest.append(p)
         self.proposals = rest
 
-    def _apply_conf_change(self, e: Entry) -> None:
-        op, pid = e.conf_change
-        if (
-            op == "remove"
-            and pid != self.peer_id
-            and self.node.is_leader()
-            and self.region.peer_by_id(pid) is not None
-        ):
-            # final notification: the removed peer leaves the voter set now,
-            # so push the commit index covering its own removal first (the
-            # reference relies on PD stale-peer GC as the backstop)
+    def _notify_removed_peer(self, pid: int, applied_index: int) -> None:
+        """Final notification to a peer leaving the config: push the commit
+        index covering its own removal before it stops hearing from us (the
+        reference relies on PD stale-peer GC as the backstop)."""
+        if pid != self.peer_id and self.node.is_leader() and self.region.peer_by_id(pid) is not None:
             self._send_raft_msg(
                 Message(
                     MsgType.HEARTBEAT, self.peer_id, pid, self.node.term,
-                    commit=min(e.index, self.node.match_index.get(pid, 0)),
+                    commit=min(applied_index, self.node.match_index.get(pid, 0)),
                 )
             )
+
+    def _sync_added_peer(self, pid: int, sid: int = 0) -> None:
+        """Region bookkeeping for a peer entering the config: record its
+        placement (from the replicated entry) and role, and seed brand-new
+        peers by snapshot, never by full log replay (peer_storage.rs:
+        uninitialized peers wait for one).
+
+        Keeps region metadata in lockstep with the raft node's view:
+        add_learner on an existing VOTER is a role no-op there, so it is
+        here too (single-step demotion goes remove → add_learner; joint
+        demotion flips the node's sets first, so the role follows)."""
+        existing = self.region.peer_by_id(pid)
+        role = "learner" if pid in self.node.learners else "voter"
+        if existing is None:
+            self.region.peers.append(RegionPeer(pid, sid, role))
+            if self.node.is_leader() and pid != self.peer_id:
+                self.node.force_snapshot.add(pid)
+        else:
+            existing.role = role
+
+    def _persist_conf_change_state(self, e: Entry) -> None:
+        """Membership changed at apply time: region meta, the raft-state blob
+        (which embeds the ConfState — the copy written earlier in this ready
+        is PRE-change), and the apply index covering this entry go down in
+        ONE WriteBatch.  Atomicity matters: a new ConfState persisted with a
+        stale apply index would replay the conf entry on recovery against the
+        already-updated voter set (enter_joint replay would corrupt outgoing
+        to C_new and double-bump conf_ver)."""
+        wb = WriteBatch()
+        wb.put_cf(
+            CF_RAFT, keys.region_state_key(self.region.id), encode_region(self.region, self.merging)
+        )
+        wb.put_cf(CF_RAFT, keys.raft_state_key(self.region.id), self._encode_raft_state())
+        wb.put_cf(CF_RAFT, keys.apply_state_key(self.region.id), codec.encode_u64(e.index))
+        self.store.engine.write(wb)
+
+    def _apply_conf_change(self, e: Entry) -> None:
+        op, pid = e.conf_change[0], e.conf_change[1]
+        if op in ("enter_joint", "leave_joint"):
+            self._apply_conf_change_v2(e, op, pid)
+            self.region.epoch.conf_ver += 1
+            self._persist_conf_change_state(e)
+            return
+        if op == "remove":
+            self._notify_removed_peer(pid, e.index)
         self.node.apply_conf_change(e.conf_change)
         if op in ("add", "add_learner"):
-            sid = self.store.pending_conf_stores.get((self.region.id, pid), 0)
-            existing = self.region.peer_by_id(pid)
-            is_new = existing is None
-            # keep region metadata in lockstep with the raft node's view:
-            # add_learner on an existing VOTER is a no-op there, so it must
-            # be a no-op here too (demotion goes remove → add_learner)
-            role = "learner" if pid in self.node.learners else "voter"
-            if is_new:
-                self.region.peers.append(RegionPeer(pid, sid, role))
-            else:
-                existing.role = role
-            if self.node.is_leader() and pid != self.peer_id and is_new:
-                # new peers are seeded by snapshot, never by full log replay
-                # (peer_storage.rs: uninitialized peers wait for a snapshot)
-                self.node.force_snapshot.add(pid)
+            self._sync_added_peer(pid, e.conf_change[2] if len(e.conf_change) > 2 else 0)
         elif op == "promote":
             existing = self.region.peer_by_id(pid)
             if existing is not None:
@@ -442,7 +476,41 @@ class StorePeer:
             if pid == self.peer_id:
                 self.store.destroy_peer(self.region.id)
         self.region.epoch.conf_ver += 1
-        self.store.persist_region(self.region)
+        self._persist_conf_change_state(e)
+
+    def _apply_conf_change_v2(self, e: Entry, op: str, changes) -> None:
+        """Joint membership change (raft thesis 4.3; raft-rs ConfChangeV2,
+        applied by components/raftstore/src/store/peer.rs on_admin): the
+        enter_joint entry reshapes the incoming config atomically while the
+        old voters remain a second quorum; leave_joint retires them.  The
+        leader auto-proposes leave_joint as soon as enter_joint applies
+        (raft-rs auto_leave); if leadership changes in between, the NEW
+        leader re-proposes it from _become_leader.  Region metadata mirrors
+        the node's view; peers absent from both configs after leaving are
+        destroyed."""
+        node = self.node
+        if op == "enter_joint":
+            node.apply_conf_change(e.conf_change)
+            for ch in changes:
+                sop, pid = ch[0], ch[1]
+                if sop != "remove":
+                    self._sync_added_peer(pid, ch[2] if len(ch) > 2 else 0)
+                # peers removed-in-joint stay listed as voters: they still
+                # vote via the outgoing config until leave_joint
+            if node.is_leader():
+                node.propose_conf_change(("leave_joint", ()))
+            return
+        # leave_joint
+        dropped = (node.outgoing or set()) - node.voters - node.learners
+        for pid in dropped:
+            self._notify_removed_peer(pid, e.index)
+        node.apply_conf_change(e.conf_change)
+        members = node.voters | node.learners
+        self.region.peers = [p for p in self.region.peers if p.peer_id in members]
+        for p in self.region.peers:
+            p.role = "learner" if p.peer_id in node.learners else "voter"
+        if self.peer_id in dropped:
+            self.store.destroy_peer(self.region.id)
 
     def _apply_split(self, admin) -> None:
         _, split_key, new_region_id, new_pids = admin
@@ -465,13 +533,21 @@ class StorePeer:
 
     def _encode_raft_state(self) -> bytes:
         n = self.node
-        return (
+        out = bytearray(
             codec.encode_u64(n.term)
             + codec.encode_u64(n.vote or 0)
             + codec.encode_u64(n.commit)
             + codec.encode_u64(n.log.snapshot_index)
             + codec.encode_u64(n.log.snapshot_term)
         )
+        # membership (ConfState): region roles alone can't reconstruct a
+        # joint config after a crash — C_old ∩ C_new is ambiguous — so the
+        # three sets ride in RaftLocalState
+        for group in (n.voters, n.learners, n.outgoing or set()):
+            out += codec.encode_var_u64(len(group))
+            for pid in sorted(group):
+                out += codec.encode_u64(pid)
+        return bytes(out)
 
     def _apply_commit_merge(self, admin) -> None:
         """Absorb the (frozen, fully-applied) right-neighbor source region:
@@ -518,6 +594,7 @@ class StorePeer:
             data=bytes(out),
             voters=tuple(self.node.voters),
             learners=tuple(self.node.learners),
+            outgoing=tuple(self.node.outgoing or ()),
         )
 
     def _apply_snapshot(self, snap: RaftSnapshot) -> None:
@@ -585,10 +662,20 @@ def _encode_entry(e: Entry) -> bytes:
     out += codec.encode_var_u64(e.term)
     out += codec.encode_var_u64(e.index)
     out += codec.encode_compact_bytes(e.data)
-    if e.conf_change:
-        out.append(1)
+    if e.conf_change and e.conf_change[0] in ("enter_joint", "leave_joint"):
+        out.append(2)
+        out += codec.encode_compact_bytes(e.conf_change[0].encode())
+        changes = e.conf_change[1]
+        out += codec.encode_var_u64(len(changes))
+        for ch in changes:
+            out += codec.encode_compact_bytes(ch[0].encode())
+            out += codec.encode_var_u64(ch[1])
+            out += codec.encode_var_u64(ch[2] if len(ch) > 2 else 0)
+    elif e.conf_change:
+        out.append(3)  # (op, peer_id, store_id) — placement rides in the log
         out += codec.encode_compact_bytes(e.conf_change[0].encode())
         out += codec.encode_var_u64(e.conf_change[1])
+        out += codec.encode_var_u64(e.conf_change[2] if len(e.conf_change) > 2 else 0)
     else:
         out.append(0)
     return bytes(out)
@@ -603,6 +690,21 @@ def _decode_entry(b: bytes) -> Entry:
         op, off2 = codec.decode_compact_bytes(b, off + 1)
         pid, _ = codec.decode_var_u64(b, off2)
         conf = (op.decode(), pid)
+    elif b[off] == 3:
+        op, off2 = codec.decode_compact_bytes(b, off + 1)
+        pid, off2 = codec.decode_var_u64(b, off2)
+        sid, _ = codec.decode_var_u64(b, off2)
+        conf = (op.decode(), pid, sid)
+    elif b[off] == 2:
+        op, off2 = codec.decode_compact_bytes(b, off + 1)
+        n, off2 = codec.decode_var_u64(b, off2)
+        changes = []
+        for _ in range(n):
+            sop, off2 = codec.decode_compact_bytes(b, off2)
+            pid, off2 = codec.decode_var_u64(b, off2)
+            sid, off2 = codec.decode_var_u64(b, off2)
+            changes.append((sop.decode(), pid, sid))
+        conf = (op.decode(), tuple(changes))
     return Entry(term, index, data, conf)
 
 
@@ -618,7 +720,6 @@ class Store:
         self.transport = transport
         self.engine = engine or BTreeEngine()
         self.peers: dict[int, StorePeer] = {}
-        self.pending_conf_stores: dict[tuple[int, int], int] = {}
         self._inbox: list[RaftMessage] = []
         self._compact_requested = threading.Event()
         self._mu = threading.RLock()
@@ -669,6 +770,18 @@ class Store:
                 node.log.snapshot_index = codec.decode_u64(state, 24)
                 node.log.snapshot_term = codec.decode_u64(state, 32)
                 node.log.offset = node.log.snapshot_index + 1
+                if len(state) > 40:  # persisted ConfState (incl. joint config)
+                    off = 40
+                    groups = []
+                    for _ in range(3):
+                        cnt, off = codec.decode_var_u64(state, off)
+                        ids = set()
+                        for _ in range(cnt):
+                            ids.add(codec.decode_u64(state, off))
+                            off += 8
+                        groups.append(ids)
+                    node.voters, node.learners = groups[0], groups[1]
+                    node.outgoing = groups[2] or None
             applied_raw = snap.get_cf(CF_RAFT, keys.apply_state_key(region.id))
             applied = codec.decode_u64(applied_raw) if applied_raw else 0
             log_prefix = keys.region_raft_prefix(region.id) + keys.RAFT_LOG_SUFFIX
