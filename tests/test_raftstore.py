@@ -432,3 +432,98 @@ def test_add_learner_on_existing_voter_is_noop(cluster):
     cluster.stop_node(other)
     cluster.must_put(b"still", b"writes")
     assert cluster.must_get(b"still") == b"writes"
+
+
+def test_merge_with_lagging_source_replica(cluster):
+    """CatchUpLogs: CommitMerge carries the source leader's committed log
+    tail, so a source replica that missed appends catches up from the payload
+    instead of blocking the merge on quiesce (peer.rs CatchUpLogs)."""
+    from tikv_tpu.raft.core import MsgType
+
+    for k, v in [(b"a", b"1"), (b"m", b"2"), (b"z", b"3")]:
+        cluster.must_put(k, v)
+    right_id = cluster.split_region(FIRST_REGION_ID, b"m")
+    src_leader = cluster.wait_leader(right_id)
+    lagging = next(
+        sid for sid in cluster.stores if sid != src_leader.store.store_id
+    )
+    # starve one source replica of ALL source-region replication
+    f = RegionPacketFilter(right_id, lagging, {MsgType.APPEND, MsgType.SNAPSHOT})
+    cluster.transport.filters.append(f)
+    for i in range(5):
+        cluster.must_put(b"q%d" % i, b"v%d" % i)  # source range (>= m)
+    assert cluster.get_on_store(lagging, b"q0") is None  # genuinely lagging
+    cluster.merge_regions(FIRST_REGION_ID, right_id)
+    cluster.tick(5)  # commit_merge rides the (unfiltered) target region
+    # still starved of source-region traffic: the data below can ONLY have
+    # come from the CatchUpLogs payload inside the CommitMerge entry
+    for i in range(5):
+        assert cluster.get_on_store(lagging, b"q%d" % i) == b"v%d" % i, i
+    cluster.transport.filters.clear()
+    cluster.tick(3)
+    assert cluster.get_on_store(lagging, b"z") == b"3"
+    for s in cluster.stores.values():
+        assert right_id not in s.peers
+    cluster.must_put(b"post_merge", b"ok")
+    assert cluster.must_get(b"post_merge") == b"ok"
+
+
+def test_catch_up_applies_through_epoch_checks(cluster):
+    """A committed-but-epoch-stale entry in the catch-up window must be
+    rejected by the lagging replica exactly like every live replica rejected
+    it — catch-up runs the NORMAL apply path, not a raw op executor."""
+    from tikv_tpu.raft.core import MsgType
+    from tikv_tpu.raft.store import encode_cmd
+
+    cluster.must_put(b"m", b"2")
+    right_id = cluster.split_region(FIRST_REGION_ID, b"m")
+    src_leader = cluster.wait_leader(right_id)
+    lagging = next(sid for sid in cluster.stores if sid != src_leader.store.store_id)
+    f = RegionPacketFilter(right_id, lagging, {MsgType.APPEND, MsgType.SNAPSHOT})
+    cluster.transport.filters.append(f)
+    # a proposal that raced an epoch change: bypasses the propose-time check
+    # (as a real in-flight proposal would) and commits, then every replica
+    # rejects it at apply
+    ep = src_leader.region.epoch
+    stale = {
+        "epoch": (ep.conf_ver, ep.version - 1),
+        "ops": [("put", "default", b"q_stale", b"bad")],
+    }
+    src_leader.node.propose(encode_cmd(stale))
+    cluster.process()
+    cluster.must_put(b"q_good", b"ok")  # source range, current epoch
+    cluster.merge_regions(FIRST_REGION_ID, right_id)
+    cluster.tick(5)  # filter still on: catch-up comes from the payload
+    assert cluster.get_on_store(lagging, b"q_good") == b"ok"
+    for sid in cluster.stores:
+        if FIRST_REGION_ID in cluster.stores[sid].peers or sid == lagging:
+            assert cluster.get_on_store(sid, b"q_stale") is None, sid
+    cluster.transport.filters.clear()
+
+
+def test_merge_refused_before_freeze_when_straggler_needs_snapshot(cluster):
+    """If the source log no longer reaches a straggler's applied index, the
+    merge is refused BEFORE PrepareMerge freezes the source — a post-freeze
+    refusal would wedge the region (the reference needs RollbackMerge for
+    that; we make it unnecessary)."""
+    from tikv_tpu.raft.core import MsgType
+
+    cluster.must_put(b"m", b"x")
+    right = cluster.split_region(FIRST_REGION_ID, b"m")
+    lead = cluster.wait_leader(right)
+    lag = next(sid for sid in cluster.stores if sid != lead.store.store_id)
+    cluster.transport.filters.append(
+        RegionPacketFilter(right, lag, {MsgType.APPEND, MsgType.SNAPSHOT})
+    )
+    for i in range(4):
+        cluster.must_put(b"r%d" % i, b"y")
+    # raft-log GC compacted the source leader's log above the straggler
+    lead.node.log.compact_to(lead.node.commit - 1, lead.node.term)
+    with pytest.raises(AssertionError, match="compacted below"):
+        cluster.merge_regions(FIRST_REGION_ID, right)
+    # source was never frozen: it keeps serving once the straggler heals
+    cluster.transport.filters.clear()
+    cluster.tick(5)
+    cluster.must_put(b"still", b"alive")
+    assert cluster.must_get(b"still") == b"alive"
+    assert cluster.get_on_store(lag, b"r3") == b"y"

@@ -292,37 +292,51 @@ class Cluster:
 
     def merge_regions(self, target_id: int, source_id: int) -> None:
         """Merge source (right neighbor) into target (left neighbor):
-        PrepareMerge freezes the source, all source peers quiesce, then
-        CommitMerge on the target absorbs the range."""
+        PrepareMerge freezes the source, then CommitMerge on the target
+        absorbs the range.  The CommitMerge command carries the source
+        leader's committed log tail, so lagging source replicas catch up
+        from the payload (CatchUpLogs) — no quiesce requirement."""
+        from .store import _encode_entry
+
         target = self.wait_leader(target_id)
         source = self.wait_leader(source_id)
         assert target.region.end_key == source.region.start_key, "regions must be adjacent"
         src_region_id = source.region.id
+        # feasibility BEFORE freezing the source: carrying entries requires
+        # the source log to reach back to the laggiest live replica's applied
+        # index — refuse up front (the straggler needs a snapshot first; the
+        # reference's PD gates merges on replica health, with RollbackMerge
+        # as the escape hatch we deliberately make unnecessary here)
+        live = [
+            s.peers[src_region_id]
+            for sid, s in self.stores.items()
+            if sid not in self.stopped and src_region_id in s.peers
+        ]
+        floor = min((p.node.applied for p in live), default=source.node.commit)
+        if floor < source.node.commit and source.node.log.term_at(floor + 1) is None:
+            raise AssertionError(
+                f"source region {src_region_id} log compacted below a lagging "
+                f"replica (applied {floor}); seed it with a snapshot before merging"
+            )
         cmd = {
             "epoch": (source.region.epoch.conf_ver, source.region.epoch.version),
             "ops": [],
             "admin": ("prepare_merge", target_id),
         }
         self._run_admin(source, cmd)
-        # quiesce: every source peer fully applied — CommitMerge over a
-        # lagging source replica would destroy state it never applied
-        for attempt in range(50):
-            self.process()
-            peers = [s.peers.get(src_region_id) for s in self.stores.values()]
-            live = [p for p in peers if p is not None]
-            if all(p.node.applied == source.node.commit for p in live):
-                break
-            self.tick()
-        else:
-            raise AssertionError(
-                f"source region {src_region_id} replicas did not quiesce; refusing CommitMerge"
-            )
         src_end = source.region.end_key
         src_version = source.region.epoch.version
+        src_commit = source.node.commit
+        # carry only what the laggiest live replica actually needs
+        carried = [
+            _encode_entry(e)
+            for e in source.node.log.entries
+            if floor < e.index <= src_commit
+        ]
         cmd = {
             "epoch": (target.region.epoch.conf_ver, target.region.epoch.version),
             "ops": [],
-            "admin": ("commit_merge", src_region_id, src_end, src_version),
+            "admin": ("commit_merge", src_region_id, src_end, src_version, src_commit, carried),
         }
         self._run_admin(target, cmd)
         if self.pd is not None:

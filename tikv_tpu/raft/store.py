@@ -70,6 +70,11 @@ def encode_cmd(cmd: dict) -> bytes:
         out += codec.encode_var_u64(admin[1])  # source region id
         out += codec.encode_compact_bytes(admin[2])  # source end key
         out += codec.encode_var_u64(admin[3])  # source epoch version
+        out += codec.encode_var_u64(admin[4])  # source commit index
+        entries = admin[5]  # CatchUpLogs payload: encoded source entries
+        out += codec.encode_var_u64(len(entries))
+        for eb in entries:
+            out += codec.encode_compact_bytes(eb)
     else:
         raise ValueError(admin)
     return bytes(out)
@@ -113,7 +118,13 @@ def decode_cmd(b: bytes) -> dict:
         sid, off = codec.decode_var_u64(b, off)
         end, off = codec.decode_compact_bytes(b, off)
         sv, off = codec.decode_var_u64(b, off)
-        cmd["admin"] = ("commit_merge", sid, end, sv)
+        scommit, off = codec.decode_var_u64(b, off)
+        n, off = codec.decode_var_u64(b, off)
+        entries = []
+        for _ in range(n):
+            eb, off = codec.decode_compact_bytes(b, off)
+            entries.append(eb)
+        cmd["admin"] = ("commit_merge", sid, end, sv, scommit, entries)
     return cmd
 
 
@@ -381,6 +392,12 @@ class StorePeer:
             self._ack(e, {"commit_merge": True}, None)
             return
         fail_point("apply_before_exec")
+        self._exec_data_cmd(cmd, self.region)
+        self._ack(e, {"applied_index": e.index}, None)
+
+    def _exec_data_cmd(self, cmd: dict, region: Region) -> None:
+        """Execute a data command's write ops against the engine (shared by
+        the normal apply path and commit-merge catch-up)."""
         wb = WriteBatch()
         for op, cf, key, val in cmd["ops"]:
             dkey = keys.data_key(key)
@@ -391,8 +408,7 @@ class StorePeer:
             elif op == "delete_range":
                 wb.delete_range_cf(cf, dkey, keys.data_key(val))
         self.store.engine.write(wb)
-        self.store.on_applied(self.region, cmd)
-        self._ack(e, {"applied_index": e.index}, None)
+        self.store.on_applied(region, cmd)
 
     def _ack(self, e: Entry, result, err) -> None:
         rest = []
@@ -550,15 +566,18 @@ class StorePeer:
         return bytes(out)
 
     def _apply_commit_merge(self, admin) -> None:
-        """Absorb the (frozen, fully-applied) right-neighbor source region:
-        extend our range, bump version above both, destroy the local source
-        peer (raftstore's CommitMerge; the harness guarantees the source is
-        quiesced — the reference's CatchUpLogs machinery is future work)."""
-        _, source_id, source_end, source_version = admin
+        """Absorb the (frozen) right-neighbor source region: catch a lagging
+        local source replica up from the entries carried in the command
+        (raftstore's CatchUpLogs — peer.rs on_catch_up_logs_for_merge), then
+        extend our range, bump version above both, and destroy the local
+        source peer (CommitMerge)."""
+        _, source_id, source_end, source_version, source_commit, carried = admin
+        src = self.store.peers.get(source_id)
+        if src is not None:
+            self._catch_up_source(src, source_commit, carried)
         self.region.end_key = source_end
         self.region.epoch.version = max(self.region.epoch.version, source_version) + 1
         self.store.persist_region(self.region)
-        src = self.store.peers.get(source_id)
         if src is not None:
             self.store.destroy_peer(source_id)
         wb = WriteBatch()
@@ -569,6 +588,50 @@ class StorePeer:
         wb.delete_range_cf(CF_RAFT, log_prefix, log_prefix[:-1] + bytes([log_prefix[-1] + 1]))
         self.store.engine.write(wb)
         self.store.on_merge(self.region, source_id)
+
+    def _catch_up_source(self, src: "StorePeer", source_commit: int, carried: list) -> None:
+        """CatchUpLogs: a source replica that trails source_commit splices the
+        carried (canonical, committed) entries into its OWN raft log and
+        applies them through its normal apply path — epoch checks, admin
+        entries (splits committed before the freeze), acks and observers all
+        behave exactly as they would have without the lag, so the replica
+        cannot diverge from the ones that applied these entries live.  This
+        removes the quiesce-before-CommitMerge requirement
+        (peer.rs on_catch_up_logs_for_merge)."""
+        # drain what the replica itself knows to be committed first
+        src.handle_ready()
+        node = src.node
+        if node.applied >= source_commit:
+            return
+        for eb in carried:
+            e = _decode_entry(eb)
+            if e.index <= node.commit or e.index > source_commit:
+                continue  # below: already canonical locally; above: not needed
+            t = node.log.term_at(e.index)
+            if t is None:
+                if e.index > node.log.last_index() + 1:
+                    raise AssertionError(
+                        f"catch-up gap on region {src.region.id}: log ends at "
+                        f"{node.log.last_index()}, next carried entry {e.index} "
+                        "(source log compacted below this replica — needs snapshot)"
+                    )
+                node.log.append([e])
+            elif t != e.term:
+                # local uncommitted leftovers of an old term lose to the
+                # committed history
+                node.log.truncate_from(e.index)
+                node.log.append([e])
+        if node.log.last_index() < source_commit:
+            raise AssertionError(
+                f"catch-up incomplete on region {src.region.id}: log reaches "
+                f"{node.log.last_index()} of {source_commit}"
+            )
+        node.commit = max(node.commit, source_commit)
+        src.handle_ready()  # normal apply: epoch checks, splits, observers
+        if node.applied < source_commit:
+            raise AssertionError(
+                f"catch-up applied {node.applied} of {source_commit} on region {src.region.id}"
+            )
 
     # -- snapshots ---------------------------------------------------------
 
