@@ -1,0 +1,299 @@
+"""Cloud external-storage backends against in-process fake servers
+(reference: components/cloud/{aws,gcp} + external_storage; the fakes stand in
+for MinIO/fake-gcs-server so the real wire protocol is exercised offline)."""
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tikv_tpu.sidecar.backup import BackupEndpoint, LocalStorage, SstImporter
+from tikv_tpu.sidecar.cloud import CloudError, GcsStorage, S3Storage, create_storage
+
+
+class _FakeS3(BaseHTTPRequestHandler):
+    """Minimal S3 wire protocol: PUT/GET/ListV2 + multipart upload, with a
+    SigV4 Authorization check on every request."""
+
+    store: dict[str, bytes] = {}
+    uploads: dict[str, dict[int, bytes]] = {}
+    fail_next: list[int] = []  # status codes to inject, consumed FIFO
+
+    def log_message(self, *a):
+        pass
+
+    def _check_auth(self) -> bool:
+        auth = self.headers.get("Authorization", "")
+        ok = (
+            auth.startswith("AWS4-HMAC-SHA256 Credential=AKID/")
+            and "SignedHeaders=host;x-amz-content-sha256;x-amz-date" in auth
+            and "Signature=" in auth
+            and self.headers.get("x-amz-content-sha256")
+            and self.headers.get("x-amz-date")
+        )
+        if not ok:
+            self.send_response(403)
+            self.end_headers()
+            self.wfile.write(b"<Error>SignatureDoesNotMatch</Error>")
+        return ok
+
+    def _inject(self) -> bool:
+        if _FakeS3.fail_next:
+            st = _FakeS3.fail_next.pop(0)
+            self.send_response(st)
+            self.end_headers()
+            self.wfile.write(b"<Error>injected</Error>")
+            return True
+        return False
+
+    def do_PUT(self):
+        if not self._check_auth() or self._inject():
+            return
+        u = urllib.parse.urlparse(self.path)
+        q = dict(urllib.parse.parse_qsl(u.query, keep_blank_values=True))
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        key = urllib.parse.unquote(u.path.lstrip("/"))
+        if "partNumber" in q:
+            _FakeS3.uploads.setdefault(q["uploadId"], {})[int(q["partNumber"])] = body
+            self.send_response(200)
+            self.send_header("ETag", f'"part{q["partNumber"]}"')
+            self.end_headers()
+            return
+        _FakeS3.store[key] = body
+        self.send_response(200)
+        self.end_headers()
+
+    def do_POST(self):
+        if not self._check_auth() or self._inject():
+            return
+        u = urllib.parse.urlparse(self.path)
+        q = dict(urllib.parse.parse_qsl(u.query, keep_blank_values=True))
+        key = urllib.parse.unquote(u.path.lstrip("/"))
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        if "uploads" in q:
+            uid = f"up{len(_FakeS3.uploads)}"
+            _FakeS3.uploads[uid] = {}
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(f"<UploadId>{uid}</UploadId>".encode())
+            return
+        if "uploadId" in q:  # complete: stitch parts in order
+            parts = _FakeS3.uploads.pop(q["uploadId"])
+            _FakeS3.store[key] = b"".join(parts[i] for i in sorted(parts))
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(b"<CompleteMultipartUploadResult/>")
+            return
+        self.send_response(400)
+        self.end_headers()
+
+    def do_GET(self):
+        if not self._check_auth() or self._inject():
+            return
+        u = urllib.parse.urlparse(self.path)
+        q = dict(urllib.parse.parse_qsl(u.query, keep_blank_values=True))
+        key = urllib.parse.unquote(u.path.lstrip("/"))
+        if "list-type" in q:  # ListObjectsV2 on the bucket, paged at 2 keys
+            bucket = key.rstrip("/")
+            pre = f"{bucket}/" + q.get("prefix", "")
+            keys = sorted(k[len(bucket) + 1 :] for k in _FakeS3.store if k.startswith(pre))
+            start = int(q.get("continuation-token", "0"))
+            page = keys[start : start + 2]
+            xml = "".join(f"<Key>{k}</Key>" for k in page)
+            if start + 2 < len(keys):
+                xml += (
+                    "<IsTruncated>true</IsTruncated>"
+                    f"<NextContinuationToken>{start + 2}</NextContinuationToken>"
+                )
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(f"<ListBucketResult>{xml}</ListBucketResult>".encode())
+            return
+        if key not in _FakeS3.store:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(_FakeS3.store[key])
+
+
+class _FakeGcs(BaseHTTPRequestHandler):
+    store: dict[str, bytes] = {}
+
+    def log_message(self, *a):
+        pass
+
+    def _authed(self) -> bool:
+        if self.headers.get("Authorization") != "Bearer tok123":
+            self.send_response(401)
+            self.end_headers()
+            return False
+        return True
+
+    def do_POST(self):
+        if not self._authed():
+            return
+        q = dict(urllib.parse.parse_qsl(urllib.parse.urlparse(self.path).query))
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        _FakeGcs.store[urllib.parse.unquote(q["name"])] = body
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(b"{}")
+
+    def do_GET(self):
+        if not self._authed():
+            return
+        u = urllib.parse.urlparse(self.path)
+        if u.path.endswith("/o"):  # list, paged at 2 items
+            q = dict(urllib.parse.parse_qsl(u.query))
+            pre = urllib.parse.unquote(q.get("prefix", ""))
+            names = [k for k in sorted(_FakeGcs.store) if k.startswith(pre)]
+            start = int(q.get("pageToken", "0"))
+            doc = {"items": [{"name": k} for k in names[start : start + 2]]}
+            if start + 2 < len(names):
+                doc["nextPageToken"] = str(start + 2)
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(json.dumps(doc).encode())
+            return
+        obj = urllib.parse.unquote(u.path.rsplit("/o/", 1)[1])
+        if obj not in _FakeGcs.store:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(_FakeGcs.store[obj])
+
+
+@pytest.fixture
+def s3():
+    _FakeS3.store, _FakeS3.uploads, _FakeS3.fail_next = {}, {}, []
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeS3)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield S3Storage(
+        "bkt", prefix="backups", access_key="AKID", secret_key="SECRET",
+        endpoint=f"http://127.0.0.1:{srv.server_port}", multipart_threshold=1024,
+    )
+    srv.shutdown()
+
+
+@pytest.fixture
+def gcs():
+    _FakeGcs.store = {}
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeGcs)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield GcsStorage(
+        "bkt", prefix="backups", token_provider=lambda: "tok123",
+        endpoint=f"http://127.0.0.1:{srv.server_port}",
+    )
+    srv.shutdown()
+
+
+def test_s3_roundtrip_and_list(s3):
+    s3.write("f1.sst", b"alpha")
+    s3.write("f2.sst", b"beta")
+    assert s3.read("f1.sst") == b"alpha"
+    assert s3.list() == ["f1.sst", "f2.sst"]
+    with pytest.raises(FileNotFoundError):
+        s3.read("missing.sst")
+
+
+def test_s3_sigv4_rejected_on_bad_secret(s3):
+    # the fake validates the Authorization header SHAPE; prove a client that
+    # skips signing entirely is rejected
+    import http.client
+
+    conn = http.client.HTTPConnection(s3.host, s3.port)
+    conn.request("PUT", "/bkt/backups/x", body=b"d")
+    assert conn.getresponse().status == 403
+    conn.close()
+
+
+def test_s3_multipart_upload(s3):
+    big = bytes(range(256)) * 20  # 5120 bytes > 1024 threshold -> 5 parts
+    s3.write("big.sst", big)
+    assert s3.read("big.sst") == big
+    assert not _FakeS3.uploads  # completed (no dangling upload state)
+
+
+def test_s3_retries_on_5xx_but_not_4xx(s3):
+    _FakeS3.fail_next = [500]
+    s3.write("r.sst", b"ok")  # one 500 then success
+    assert s3.read("r.sst") == b"ok"
+    _FakeS3.fail_next = [500, 500, 500]
+    with pytest.raises(CloudError, match="retries exhausted"):
+        s3.read("r.sst")
+    # 429 backs off like a 5xx (GCS/S3 throttle signal)
+    _FakeS3.fail_next = [429]
+    assert s3.read("r.sst") == b"ok"
+    # a permanent 4xx fails on the FIRST attempt — no retry burns
+    _FakeS3.fail_next = [400, 500]
+    with pytest.raises(CloudError, match="HTTP 400"):
+        s3.read("r.sst")
+    assert _FakeS3.fail_next == [500]  # the second injection was never consumed
+    _FakeS3.fail_next = []
+
+
+def test_s3_and_gcs_list_pagination(s3, gcs):
+    """Both fakes page at 2 keys: listing 5 objects must follow
+    continuation/page tokens instead of silently truncating."""
+    for i in range(5):
+        s3.write(f"p{i}.sst", b"x")
+        gcs.write(f"p{i}.sst", b"x")
+    expect = [f"p{i}.sst" for i in range(5)]
+    assert s3.list() == expect
+    assert gcs.list() == expect
+
+
+def test_gcs_roundtrip_and_list(gcs):
+    gcs.write("a.sst", b"one")
+    gcs.write("b.sst", b"two")
+    assert gcs.read("a.sst") == b"one"
+    assert gcs.list() == ["a.sst", "b.sst"]
+    with pytest.raises(FileNotFoundError):
+        gcs.read("zzz")
+
+
+def test_create_storage_urls(tmp_path, s3):
+    st = create_storage(f"local://{tmp_path}")
+    assert isinstance(st, LocalStorage)
+    st.write("x", b"1")
+    assert st.read("x") == b"1"
+    s = create_storage("s3://mybucket/some/prefix", access_key="a", secret_key="b")
+    assert isinstance(s, S3Storage) and s.bucket == "mybucket" and s.prefix == "some/prefix"
+    g = create_storage("gcs://gbkt/p")
+    assert isinstance(g, GcsStorage) and g.bucket == "gbkt"
+    from tikv_tpu.sidecar.backup import NoopStorage
+
+    assert isinstance(create_storage("noop://"), NoopStorage)
+    with pytest.raises(ValueError):
+        create_storage("ftp://nope")
+
+
+def test_backup_restore_over_s3(s3):
+    """The full backup->S3->restore cycle (BR's actual shape)."""
+    from tikv_tpu.storage.btree_engine import BTreeEngine
+    from tikv_tpu.storage.kv import LocalEngine
+    from tikv_tpu.storage.storage import Storage
+    from tikv_tpu.storage.txn.commands import Commit, Prewrite
+    from tikv_tpu.storage.txn_types import Key, Mutation
+
+    eng = BTreeEngine()
+    st = Storage(engine=LocalEngine(eng))
+    for i in range(5):
+        k = b"k%d" % i
+        st.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(k), b"v%d" % i)], k, 10 + i))
+        st.sched_txn_command(Commit([Key.from_raw(k)], 10 + i, 20 + i))
+    ep = BackupEndpoint(s3)
+    meta = ep.backup_range(eng.snapshot(), "full.bak", backup_ts=100)
+    assert meta["kvs"] == 5 and "full.bak" in s3.list()
+    eng2 = BTreeEngine()
+    SstImporter(s3).restore(LocalEngine(eng2), "full.bak", restore_ts=150)
+    st2 = Storage(engine=LocalEngine(eng2))
+    for i in range(5):
+        assert st2.get(b"k%d" % i, 200) == b"v%d" % i
