@@ -12,6 +12,8 @@ takes over once the native engine lands).
     ctl.py --addr HOST:PORT mvcc <key> --version TS --region R
     ctl.py --addr HOST:PORT scan-lock --max-ts TS
     ctl.py --addr HOST:PORT resolve-lock --start-ts TS [--commit-ts TS]
+    ctl.py --addr HOST:PORT region-info|region-properties [--region R]
+    ctl.py --addr HOST:PORT bad-regions|all-regions
     ctl.py --status ADDR metrics|config
     ctl.py --status ADDR reconfig section.key=value ...
 """
@@ -53,6 +55,13 @@ def main(argv=None) -> int:
     sp = sub.add_parser("resolve-lock")
     sp.add_argument("--start-ts", type=int, required=True)
     sp.add_argument("--commit-ts", type=int, default=0)
+    for name in ("region-info", "region-properties"):
+        sp = sub.add_parser(name)
+        # SUPPRESS: a value given after the subcommand wins; otherwise the
+        # parent-level --region (or its default) stays in effect
+        sp.add_argument("--region", type=int, default=argparse.SUPPRESS)
+    sub.add_parser("bad-regions")
+    sub.add_parser("all-regions")
     sub.add_parser("metrics")
     sub.add_parser("config")
     sp = sub.add_parser("reconfig")
@@ -107,6 +116,14 @@ def main(argv=None) -> int:
                 "kv_resolve_lock",
                 {"start_version": args.start_ts, "commit_version": args.commit_ts, "context": ctx},
             )
+        elif args.cmd == "region-info":
+            r = c.call("debug_region_info", {"region_id": args.region})
+        elif args.cmd == "region-properties":
+            r = c.call("debug_region_properties", {"region_id": args.region})
+        elif args.cmd == "bad-regions":
+            r = c.call("debug_bad_regions", {})
+        elif args.cmd == "all-regions":
+            r = c.call("debug_all_regions", {})
         else:
             raise AssertionError(args.cmd)
         print(json.dumps(r, default=lambda b: b.decode("utf8", "replace") if isinstance(b, bytes) else str(b), indent=2))

@@ -527,3 +527,73 @@ def test_merge_refused_before_freeze_when_straggler_needs_snapshot(cluster):
     cluster.must_put(b"still", b"alive")
     assert cluster.must_get(b"still") == b"alive"
     assert cluster.get_on_store(lag, b"r3") == b"y"
+
+
+def test_unsafe_recover_restores_quorum(cluster):
+    """tikv-ctl unsafe-recover remove-fail-stores: two of three stores die
+    permanently; rewriting the survivor's persisted membership lets it elect
+    itself and serve again (debug.rs remove_failed_stores)."""
+    from tikv_tpu.raft.store import Store
+    from tikv_tpu.server.debug import Debugger
+
+    cluster.must_put(b"k", b"v")
+    survivor = cluster.wait_leader(FIRST_REGION_ID).store.store_id
+    dead = [sid for sid in cluster.stores if sid != survivor]
+    for sid in dead:
+        cluster.stop_node(sid)
+    # the survivor alone cannot commit (2/3 quorum unreachable)
+    import threading
+
+    res, done = [], threading.Event()
+    lead = cluster.stores[survivor].peers[FIRST_REGION_ID]
+    lead.propose_cmd(
+        {"epoch": (lead.region.epoch.conf_ver, lead.region.epoch.version),
+         "ops": [("put", "default", b"stuck", b"x")]},
+        lambda r: (res.append(r), done.set()),
+    )
+    cluster.tick(5)
+    assert not done.is_set()  # stuck without quorum
+    # offline surgery on the stopped store's engine, then restart
+    eng = cluster.stores[survivor].engine
+    modified = Debugger(eng).unsafe_recover(set(dead))
+    assert FIRST_REGION_ID in modified
+    new_store = Store(survivor, cluster.transport, engine=eng)
+    assert new_store.recover() == 1
+    peer = new_store.peers[FIRST_REGION_ID]
+    assert peer.node.voters == {peer.peer_id}  # sole voter now
+    cluster.stores[survivor] = new_store
+    cluster.transport.register(new_store)
+    cluster.elect_leader(FIRST_REGION_ID, survivor)
+    cluster.must_put(b"recovered", b"yes")
+    assert cluster.must_get(b"recovered") == b"yes"
+    assert cluster.must_get(b"k") == b"v"  # old data intact
+
+
+def test_region_properties(cluster):
+    from tikv_tpu.server.debug import Debugger
+    from tikv_tpu.storage.txn.commands import Commit, Prewrite
+    from tikv_tpu.storage.txn_types import Key, Mutation
+
+    leader = cluster.wait_leader(FIRST_REGION_ID)
+    store = Storage(engine=cluster.raftkv(leader.store.store_id))
+    ctx = {"region_id": FIRST_REGION_ID}
+    for i in range(6):
+        k = b"pk%d" % i
+        store.sched_txn_command(
+            Prewrite([Mutation.put(Key.from_raw(k), b"pv%d" % i)], k, 10 + 2 * i), ctx
+        )
+        store.sched_txn_command(Commit([Key.from_raw(k)], 10 + 2 * i, 11 + 2 * i), ctx)
+    store.sched_txn_command(
+        Prewrite([Mutation.delete(Key.from_raw(b"pk0"))], b"pk0", 50), ctx
+    )
+    store.sched_txn_command(Commit([Key.from_raw(b"pk0")], 50, 51), ctx)
+    props = Debugger(leader.store.engine).region_properties(FIRST_REGION_ID)
+    assert props["mvcc"]["num_puts"] == 6
+    assert props["mvcc"]["num_deletes"] == 1
+    assert props["mvcc"]["num_rows"] == 6  # distinct user keys
+    assert props["mvcc"]["num_versions"] == 7  # pk0 has two versions
+    assert props["mvcc"]["num_locks"] == 0
+    assert props["mvcc"]["max_commit_ts"] >= props["mvcc"]["min_commit_ts"] > 0
+    assert props["size"]["write"]["keys"] == 7
+    assert props["middle_key"] is not None
+    assert Debugger(leader.store.engine).region_properties(9999) is None

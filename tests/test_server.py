@@ -73,7 +73,9 @@ def single_node():
     kv = RaftKv(node.store)  # background loops pump; default pump yields
     storage = Storage(engine=kv)
     copr = Endpoint(kv, enable_device=False)
-    service = KvService(storage, copr)
+    from tikv_tpu.server.debug import Debugger
+
+    service = KvService(storage, copr, debugger=Debugger(node.store.engine))
     server = Server(service)
     server.start()
     yield node, server, pd
@@ -292,3 +294,20 @@ def test_endpoint_block_cache_serving():
     ep_cpu = Endpoint(eng, enable_device=False)
     r5 = ep_cpu.handle_request(req())
     assert not r5.from_device and r5.data == r1.data
+
+
+def test_debug_service_over_wire(single_node):
+    """tikv-ctl's debug commands ride the same RPC surface (debug.rs gRPC)."""
+    node, server, pd = single_node
+    client = Client(*server.addr)
+    r = client.call("debug_region_info", {"region_id": FIRST_REGION_ID})
+    assert r["info"]["region"]["id"] == FIRST_REGION_ID
+    r = client.call("debug_region_properties", {"region_id": FIRST_REGION_ID})
+    assert "mvcc" in r["props"]
+    r = client.call("debug_bad_regions", {})
+    assert r["bad"] == []
+    r = client.call("debug_all_regions", {})
+    assert FIRST_REGION_ID in r["regions"]
+    r = client.call("debug_region_info", {"region_id": 777})
+    assert "error" in r
+    client.close()

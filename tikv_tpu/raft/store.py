@@ -559,10 +559,7 @@ class StorePeer:
         # membership (ConfState): region roles alone can't reconstruct a
         # joint config after a crash — C_old ∩ C_new is ambiguous — so the
         # three sets ride in RaftLocalState
-        for group in (n.voters, n.learners, n.outgoing or set()):
-            out += codec.encode_var_u64(len(group))
-            for pid in sorted(group):
-                out += codec.encode_u64(pid)
+        out += encode_conf_state(n.voters, n.learners, n.outgoing)
         return bytes(out)
 
     def _apply_commit_merge(self, admin) -> None:
@@ -720,6 +717,32 @@ def decode_region(b: bytes) -> tuple[Region, bool]:
     return Region(rid, start, end, RegionEpoch(cv, v), peers), merging
 
 
+def encode_conf_state(voters, learners, outgoing) -> bytes:
+    """The ConfState tail of the raft-state blob: 3 varint-counted u64 groups
+    (voters, learners, outgoing).  Shared by persistence, recovery, and the
+    Debugger's unsafe-recover so the layout has exactly one definition."""
+    out = bytearray()
+    for group in (voters, learners, outgoing or set()):
+        out += codec.encode_var_u64(len(group))
+        for pid in sorted(group):
+            out += codec.encode_u64(pid)
+    return bytes(out)
+
+
+def decode_conf_state(state: bytes, off: int = 40) -> tuple[set, set, set]:
+    """Inverse of encode_conf_state, reading at ``off`` (after the 40-byte
+    fixed term/vote/commit/snapshot header)."""
+    groups = []
+    for _ in range(3):
+        cnt, off = codec.decode_var_u64(state, off)
+        ids = set()
+        for _ in range(cnt):
+            ids.add(codec.decode_u64(state, off))
+            off += 8
+        groups.append(ids)
+    return groups[0], groups[1], groups[2]
+
+
 def _encode_entry(e: Entry) -> bytes:
     out = bytearray()
     out += codec.encode_var_u64(e.term)
@@ -834,17 +857,9 @@ class Store:
                 node.log.snapshot_term = codec.decode_u64(state, 32)
                 node.log.offset = node.log.snapshot_index + 1
                 if len(state) > 40:  # persisted ConfState (incl. joint config)
-                    off = 40
-                    groups = []
-                    for _ in range(3):
-                        cnt, off = codec.decode_var_u64(state, off)
-                        ids = set()
-                        for _ in range(cnt):
-                            ids.add(codec.decode_u64(state, off))
-                            off += 8
-                        groups.append(ids)
-                    node.voters, node.learners = groups[0], groups[1]
-                    node.outgoing = groups[2] or None
+                    voters, learners, outgoing = decode_conf_state(state)
+                    node.voters, node.learners = voters, learners
+                    node.outgoing = outgoing or None
             applied_raw = snap.get_cf(CF_RAFT, keys.apply_state_key(region.id))
             applied = codec.decode_u64(applied_raw) if applied_raw else 0
             log_prefix = keys.region_raft_prefix(region.id) + keys.RAFT_LOG_SUFFIX

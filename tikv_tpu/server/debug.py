@@ -126,6 +126,105 @@ class Debugger:
             )
         return out
 
+    def region_properties(self, region_id: int) -> dict | None:
+        """MVCC + size properties for a region (debug.rs region_properties:
+        mvcc.num_rows/num_puts/num_deletes, min/max commit ts, middle key for
+        approximate splits)."""
+        from ..raft.store import decode_region
+
+        state = self.engine.get_cf(CF_RAFT, keys.region_state_key(region_id))
+        if state is None:
+            return None
+        region, _merging = decode_region(state)
+        snap = self.engine.snapshot()  # ONE snapshot: mvcc and size agree
+        start = keys.data_key(region.start_key)
+        end = keys.data_end_key(region.end_key)
+        num_puts = num_deletes = num_versions = num_rows = 0
+        min_ts = max_ts = None
+        last_user = None
+        wkeys = []
+        sizes = {}
+        wn = wsize = 0
+        for k, v in snap.scan_cf(CF_WRITE, start, end):
+            wkeys.append(k)
+            wn += 1
+            wsize += len(k) + len(v)
+            user, commit_ts = split_ts(keys.origin_key(k))
+            w = Write.from_bytes(v)
+            if w.write_type.name == "PUT":
+                num_puts += 1
+            elif w.write_type.name == "DELETE":
+                num_deletes += 1
+            num_versions += 1
+            if user != last_user:  # rows = distinct user keys
+                num_rows += 1
+                last_user = user
+            min_ts = commit_ts if min_ts is None else min(min_ts, commit_ts)
+            max_ts = commit_ts if max_ts is None else max(max_ts, commit_ts)
+        sizes[CF_WRITE] = {"keys": wn, "bytes": wsize}
+        for cf in (CF_DEFAULT, CF_LOCK):
+            n = size = 0
+            for k, v in snap.scan_cf(cf, start, end):
+                n += 1
+                size += len(k) + len(v)
+            sizes[cf] = {"keys": n, "bytes": size}
+        middle = None
+        if wkeys:
+            middle = Key.from_encoded(
+                split_ts(keys.origin_key(wkeys[len(wkeys) // 2]))[0]
+            ).to_raw().hex()
+        return {
+            "mvcc": {
+                "num_rows": num_rows,
+                "num_versions": num_versions,
+                "num_puts": num_puts,
+                "num_deletes": num_deletes,
+                "num_locks": sizes[CF_LOCK]["keys"],
+                "min_commit_ts": min_ts,
+                "max_commit_ts": max_ts,
+            },
+            "size": sizes,
+            "middle_key": middle,
+        }
+
+    def unsafe_recover(self, failed_stores: set[int]) -> list[int]:
+        """Force-remove peers on permanently failed stores from every
+        persisted region state so the survivors can form a quorum again
+        (debug.rs remove_failed_stores / tikv-ctl unsafe-recover
+        remove-fail-stores).  MUST run with the store process stopped — it
+        rewrites region metadata AND the ConfState embedded in the raft-state
+        blob (voters/learners/outgoing), then the next recover() comes up
+        with the shrunken membership.  Returns the modified region ids."""
+        from ..raft.store import decode_region, encode_region
+
+        snap = self.engine.snapshot()
+        prefix = keys.LOCAL_PREFIX + keys.REGION_META_PREFIX
+        modified = []
+        for k, v in snap.scan_cf(CF_RAFT, prefix, prefix[:-1] + bytes([prefix[-1] + 1])):
+            rid = codec.decode_u64(k, 2)
+            region, merging = decode_region(v)
+            dead = [p for p in region.peers if p.store_id in failed_stores]
+            if not dead:
+                continue
+            dead_ids = {p.peer_id for p in dead}
+            region.peers = [p for p in region.peers if p.peer_id not in dead_ids]
+            region.epoch.conf_ver += len(dead_ids)
+            self.engine.put_cf(CF_RAFT, keys.region_state_key(rid), encode_region(region, merging))
+            state = self.engine.get_cf(CF_RAFT, keys.raft_state_key(rid))
+            if state is not None and len(state) > 40:
+                # rewrite the persisted ConfState minus the dead peers
+                from ..raft.store import decode_conf_state, encode_conf_state
+
+                voters, learners, outgoing = decode_conf_state(state)
+                self.engine.put_cf(
+                    CF_RAFT,
+                    keys.raft_state_key(rid),
+                    state[:40]
+                    + encode_conf_state(voters - dead_ids, learners - dead_ids, outgoing - dead_ids),
+                )
+            modified.append(rid)
+        return modified
+
     def bad_regions(self) -> list[tuple[int, str]]:
         """Regions whose persisted state fails sanity checks (debug.rs bad_regions)."""
         from ..raft.store import decode_region

@@ -57,13 +57,39 @@ def _err(e: Exception) -> dict:
 class KvService:
     """All handlers of one store (kv.rs handler inventory)."""
 
-    def __init__(self, storage: Storage, copr: Endpoint | None = None, copr_v2=None, resource_tags=None):
+    def __init__(
+        self, storage: Storage, copr: Endpoint | None = None, copr_v2=None,
+        resource_tags=None, debugger=None,
+    ):
         self.storage = storage
         self.copr = copr
         self.copr_v2 = copr_v2
         self.resource_tags = resource_tags
+        self.debugger = debugger
 
-    _HANDLER_PREFIXES = ("kv_", "raw_", "coprocessor", "mvcc_")
+    _HANDLER_PREFIXES = ("kv_", "raw_", "coprocessor", "mvcc_", "debug_")
+
+    # -- Debug service (debug.rs over gRPC; read-only surface -- the
+    # destructive commands like unsafe-recover are offline-only by design) --
+
+    def _debug(self):
+        if self.debugger is None:
+            raise RuntimeError("debug service not enabled")
+        return self.debugger
+
+    def debug_region_info(self, req: dict) -> dict:
+        info = self._debug().region_info(req["region_id"])
+        return {"info": info} if info is not None else {"error": {"other": "region not found"}}
+
+    def debug_region_properties(self, req: dict) -> dict:
+        props = self._debug().region_properties(req["region_id"])
+        return {"props": props} if props is not None else {"error": {"other": "region not found"}}
+
+    def debug_bad_regions(self, req: dict) -> dict:
+        return {"bad": self._debug().bad_regions()}
+
+    def debug_all_regions(self, req: dict) -> dict:
+        return {"regions": self._debug().all_regions()}
 
     def dispatch(self, method: str, req: dict):
         """Invoke a handler with resource-group attribution (the tagged-future
