@@ -1,0 +1,277 @@
+"""MySQL JSON: binary codec, paths, scalar functions, DAG integration
+(reference: tidb_query_datatype/src/codec/mysql/json + impl_json.rs)."""
+
+import numpy as np
+import pytest
+
+from tikv_tpu.copr import json_value as jv
+from tikv_tpu.copr.datatypes import Column, EvalType, FieldType, FieldTypeTp
+from tikv_tpu.copr.kernels import KERNELS
+from tikv_tpu.copr.rpn import call, col, compile_expr, const_bytes, const_json, eval_rpn
+
+
+# -- binary codec -----------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "v",
+    [
+        None, True, False, 0, 42, -7, 2**62, jv.JsonU64(2**63 + 5), 3.25, 1.0,
+        "", "hello", "unié", [], [1, 2, 3], ["a", None, True, 2.5],
+        {}, {"a": 1}, {"bb": [1, {"c": None}], "a": "x", "ccc": 2.5},
+        [[1, [2, [3]]]], {"k": {"k": {"k": "deep"}}},
+    ],
+)
+def test_json_binary_roundtrip(v):
+    b = jv.json_encode(v)
+    assert jv.json_decode(b) == v
+    assert jv.json_binary_len(b + b"garbage", 0) == len(b)
+
+
+def test_json_binary_layout_stable():
+    # spot-check the wire layout (type codes from json/mod.rs)
+    assert jv.json_encode(None) == b"\x04\x00"
+    assert jv.json_encode(True) == b"\x04\x01"
+    assert jv.json_encode(7) == b"\x09" + (7).to_bytes(8, "little")
+    assert jv.json_encode("hi") == b"\x0c\x02hi"
+    arr = jv.json_encode([1])
+    assert arr[0] == 0x03 and int.from_bytes(arr[1:5], "little") == 1
+
+
+def test_object_keys_sorted_mysql_style():
+    # shorter keys first, then byte order — independent of insert order
+    b1 = jv.json_encode({"bb": 1, "a": 2, "c": 3})
+    b2 = jv.json_encode({"c": 3, "bb": 1, "a": 2})
+    assert b1 == b2
+    assert list(jv.json_decode(b1)) == ["a", "c", "bb"]
+
+
+# -- paths ------------------------------------------------------------------
+
+
+def test_path_extract():
+    doc = {"a": {"b": [10, 20, {"c": 30}]}, "x": [1, 2]}
+    assert jv.extract(doc, ["$.a.b[2].c"]) == 30
+    assert jv.extract(doc, ["$.a.b[0]"]) == 10
+    assert jv.extract(doc, ["$.x"]) == [1, 2]
+    assert jv.extract(doc, ["$"]) == doc
+    assert jv.extract(doc, ["$.missing"]) is jv._NO_MATCH
+    # wildcard → array of matches
+    assert jv.extract(doc, ["$.a.b[*]"]) == [10, 20, {"c": 30}]
+    assert jv.extract({"p": {"q": 1}, "r": {"q": 2}}, ["$.*.q"]) == [1, 2]
+    # ** finds at any depth
+    assert sorted(jv.extract(doc, ["$**.c"])) == [30]
+    # multiple paths → array
+    assert jv.extract(doc, ["$.a.b[0]", "$.a.b[1]"]) == [10, 20]
+    # scalar auto-wrap: $[0] of a scalar is the scalar
+    assert jv.extract(5, ["$[0]"]) == 5
+    # quoted member
+    assert jv.extract({"odd key": 1}, ['$."odd key"']) == 1
+    with pytest.raises(ValueError):
+        jv.parse_path("a.b")
+    with pytest.raises(ValueError):
+        jv.parse_path("$**")
+
+
+def test_modify_and_remove():
+    doc = {"a": 1, "b": [1, 2]}
+    assert jv.modify(doc, [("$.c", 3)], "set") == {"a": 1, "b": [1, 2], "c": 3}
+    assert jv.modify(doc, [("$.a", 9)], "insert") == doc  # exists: no-op
+    assert jv.modify(doc, [("$.a", 9)], "replace")["a"] == 9
+    assert jv.modify(doc, [("$.c", 9)], "replace") == doc  # missing: no-op
+    assert jv.modify(doc, [("$.b[5]", 9)], "set")["b"] == [1, 2, 9]  # append
+    assert jv.remove(doc, ["$.b[0]"]) == {"a": 1, "b": [2]}
+    assert jv.remove(doc, ["$.a"]) == {"b": [1, 2]}
+    with pytest.raises(ValueError):
+        jv.modify(doc, [("$.*", 1)], "set")
+
+
+def test_merge_contains_type_depth():
+    assert jv.merge([[1], [2, 3]]) == [1, 2, 3]
+    assert jv.merge([{"a": 1}, {"b": 2}]) == {"a": 1, "b": 2}
+    assert jv.merge([{"a": 1}, {"a": 2}]) == {"a": [1, 2]}
+    assert jv.merge([1, "x"]) == [1, "x"]
+    assert jv.contains([1, 2, [3, 4]], [3])
+    assert jv.contains({"a": 1, "b": 2}, {"a": 1})
+    assert not jv.contains({"a": 1}, {"a": 2})
+    assert not jv.contains([1, 2], 3)
+    assert jv.contains([1, 2], 2.0)  # numeric cross-type equality
+    assert not jv.contains([1], True)  # but bool is not 1
+    assert jv.json_type_name(jv.JsonU64(2**63)) == "UNSIGNED INTEGER"
+    assert jv.depth({"a": [1, [2]]}) == 4
+    assert jv.depth("x") == 1
+
+
+def test_text_serialization():
+    assert jv.json_to_text({"b": 1, "a": [1.5, None, "q\"uote"]}) == '{"a": [1.5, null, "q\\"uote"], "b": 1}'
+    assert jv.json_to_text(1.0) == "1.0"  # doubles keep .0, MySQL-style
+
+
+# -- kernels through RPN ----------------------------------------------------
+
+
+def _run(expr, columns=None, n=1):
+    schema = []
+    rpn = compile_expr(expr, schema)
+    return eval_rpn(rpn, columns or {}, n, xp=np)
+
+
+def test_json_kernels_rpn():
+    doc = const_json({"a": {"b": 2}, "list": [1, 2, 3]})
+    d, nl = _run(call("json_extract", doc, const_bytes(b"$.a.b")))
+    assert not nl[0] and jv.json_decode(d[0]) == 2
+    d, nl = _run(call("json_unquote", call("json_extract", const_json({"s": "text"}), const_bytes(b"$.s"))))
+    assert d[0] == b"text"
+    d, _ = _run(call("json_type", doc))
+    assert d[0] == b"OBJECT"
+    d, _ = _run(call("json_length", doc, const_bytes(b"$.list")))
+    assert d[0] == 3
+    d, _ = _run(call("json_depth", doc))
+    assert d[0] == 3
+    d, _ = _run(call("json_valid", const_bytes(b'{"ok": 1}')))
+    assert d[0] == 1
+    d, _ = _run(call("json_valid", const_bytes(b"nope{")))
+    assert d[0] == 0
+    d, _ = _run(call("json_keys", doc))
+    assert jv.json_decode(d[0]) == ["a", "list"]
+    d, _ = _run(call("json_contains", doc, const_json({"a": {"b": 2}})))
+    assert d[0] == 1
+    d, _ = _run(call("json_set", doc, const_bytes(b"$.new"), const_json(5)))
+    assert jv.json_decode(d[0])["new"] == 5
+    d, _ = _run(call("json_remove", doc, const_bytes(b"$.list[0]")))
+    assert jv.json_decode(d[0])["list"] == [2, 3]
+    d, _ = _run(call("json_merge", const_json([1]), const_json([2])))
+    assert jv.json_decode(d[0]) == [1, 2]
+    d, _ = _run(call("json_array", const_json(1), const_json("x")))
+    assert jv.json_decode(d[0]) == [1, "x"]
+    d, _ = _run(call("json_object", const_bytes(b"k"), const_json(9)))
+    assert jv.json_decode(d[0]) == {"k": 9}
+    d, _ = _run(call("json_quote", const_bytes(b'say "hi"')))
+    assert d[0] == b'"say \\"hi\\""'
+    # missing path → SQL NULL
+    d, nl = _run(call("json_extract", doc, const_bytes(b"$.nope")))
+    assert nl[0]
+    # casts
+    d, _ = _run(call("cast_json_int", const_json(7.9)))
+    assert d[0] == 8  # MySQL rounds half away from zero
+    d, _ = _run(call("cast_json_int", const_json(-7.5)))
+    assert d[0] == -8
+    d, _ = _run(call("cast_json_real", doc.__class__(jv.json_encode("2.5"), EvalType.JSON)))
+    assert d[0] == 2.5
+    d, _ = _run(call("cast_string_json", const_bytes(b"[1, 2]")))
+    assert jv.json_decode(d[0]) == [1, 2]
+    d, _ = _run(call("cast_json_string", const_json({"a": 1})))
+    assert d[0] == b'{"a": 1}'
+
+
+# -- full DAG over a JSON column -------------------------------------------
+
+
+def test_json_column_through_dag():
+    """TableScan over a JSON column → selection on json_length → response:
+    the full executor pipeline with JSON datums in the row codec."""
+    from tikv_tpu.copr.dag import BatchExecutorsRunner, DagRequest, Selection, TableScan
+    from tikv_tpu.copr.datatypes import ColumnInfo
+    from tikv_tpu.copr.executors import FixtureScanSource
+    from tikv_tpu.copr.rpn import const_int
+    from tikv_tpu.copr.table import record_key, encode_row
+
+    TABLE = 99
+    cols = [
+        ColumnInfo(col_id=1, ftype=FieldType.int64(), is_pk_handle=True),
+        ColumnInfo(col_id=2, ftype=FieldType(FieldTypeTp.JSON)),
+    ]
+    docs = [
+        {"name": "a", "tags": [1, 2]},
+        {"name": "b", "tags": [3]},
+        None,
+        {"name": "d", "nested": {"deep": True}},
+    ]
+    items = []
+    for h, doc in enumerate(docs):
+        payload = None if doc is None else jv.json_encode(doc)
+        items.append((record_key(TABLE, h + 1), encode_row([cols[1]], [payload])))
+    dag = DagRequest(
+        executors=[
+            TableScan(TABLE, cols),
+            Selection(
+                [call("ge", call("json_length", col(1), const_bytes(b"$.tags")), const_int(1))]
+            ),
+        ]
+    )
+    resp = BatchExecutorsRunner(dag, FixtureScanSource(items)).handle_request()
+    rows = resp.iter_rows()
+    assert len(rows) == 2  # docs a and b have tags; NULL and no-tags filtered
+    # the surviving JSON datums round-trip to the original documents
+    for row, expect in zip(rows, docs[:2]):
+        assert jv.json_decode(row[1]) == expect
+
+
+def test_json_plan_falls_back_to_cpu():
+    """supports() must reject JSON expressions so the endpoint routes them to
+    the CPU pipeline rather than the device."""
+    from tikv_tpu.copr import jax_eval
+    from tikv_tpu.copr.dag import DagRequest, Selection, TableScan
+    from tikv_tpu.copr.datatypes import ColumnInfo
+
+    cols = [
+        ColumnInfo(col_id=1, ftype=FieldType.int64(), is_pk_handle=True),
+        ColumnInfo(col_id=2, ftype=FieldType(FieldTypeTp.JSON)),
+    ]
+    dag = DagRequest(
+        executors=[
+            TableScan(5, cols),
+            Selection([call("json_valid", call("cast_json_string", col(1)))]),
+        ]
+    )
+    assert not jax_eval.supports(dag)
+
+
+def test_json_min_max_orders_by_value_not_payload():
+    """MIN/MAX over JSON must use MySQL JSON ordering, not payload bytes
+    (little-endian ints order bytewise wrong: 256 < 1)."""
+    from tikv_tpu.copr.aggr import AggState
+
+    st = AggState("min", EvalType.JSON, 0)
+    st.grow(1)
+    data = np.array([jv.json_encode(256), jv.json_encode(1), jv.json_encode(-5)], dtype=object)
+    st.update(np.zeros(3, dtype=np.int64), data, np.zeros(3, dtype=bool))
+    assert jv.json_decode(st.value[0]) == -5
+    st2 = AggState("max", EvalType.JSON, 0)
+    st2.grow(1)
+    st2.update(np.zeros(3, dtype=np.int64), data, np.zeros(3, dtype=bool))
+    assert jv.json_decode(st2.value[0]) == 256
+    # precedence: booleans above arrays above strings above numbers
+    vals = [True, [1], "z", 99]
+    data = np.array([jv.json_encode(v) for v in vals], dtype=object)
+    st3 = AggState("max", EvalType.JSON, 0)
+    st3.grow(1)
+    st3.update(np.zeros(4, dtype=np.int64), data, np.zeros(4, dtype=bool))
+    assert jv.json_decode(st3.value[0]) is True
+
+
+def test_json_pairwise_arity_and_bad_paths():
+    with pytest.raises(ValueError, match="parameter count"):
+        _run(call("json_object", const_bytes(b"k"), const_json(1), const_bytes(b"odd")))
+    with pytest.raises(ValueError, match="parameter count"):
+        _run(call("json_set", const_json({}), const_bytes(b"$.a"), const_json(1), const_bytes(b"$.b")))
+    with pytest.raises(ValueError, match="invalid json path"):
+        jv.parse_path('$."unterminated')
+    with pytest.raises(ValueError, match="invalid json path"):
+        jv.parse_path('$."trailing\\')
+
+
+def test_bytes_min_max_within_single_batch():
+    """Regression: has_value must update per row — min/max over BYTES with
+    several rows of one group in ONE batch used to keep the LAST value."""
+    from tikv_tpu.copr.aggr import AggState
+
+    st = AggState("min", EvalType.BYTES, 0)
+    st.grow(1)
+    data = np.array([b"mm", b"zz", b"aa"], dtype=object)
+    st.update(np.zeros(3, dtype=np.int64), data, np.zeros(3, dtype=bool))
+    assert bytes(st.value[0]) == b"aa"
+    st2 = AggState("max", EvalType.BYTES, 0)
+    st2.grow(1)
+    st2.update(np.zeros(3, dtype=np.int64), data, np.zeros(3, dtype=bool))
+    assert bytes(st2.value[0]) == b"zz"

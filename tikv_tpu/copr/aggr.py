@@ -70,7 +70,7 @@ class AggState:
         if op == "var_pop":
             self.sum_sq = np.zeros(n0, dtype=np.float64)
         if op in ("min", "max", "first"):
-            if input_type == EvalType.BYTES:
+            if input_type in (EvalType.BYTES, EvalType.JSON):
                 self.value = np.empty(n0, dtype=object)
             else:
                 dtype = np.float64 if input_type == EvalType.REAL else np.int64
@@ -148,12 +148,29 @@ class AggState:
 
     def _minmax(self, g, d, is_min: bool) -> None:
         if self.value.dtype == object:
+            if self.input_type == EvalType.JSON:
+                # binary-JSON payload bytes do NOT order like the values
+                # (little-endian ints, type-code prefixes) — compare by
+                # MySQL JSON ordering
+                from .json_value import json_cmp
+
+                for gi, di in zip(g, d):
+                    if not self.has_value[gi]:
+                        # mark per row, not after the loop: a later row of the
+                        # same group IN THIS BATCH must compare, not overwrite
+                        self.value[gi] = di
+                        self.has_value[gi] = True
+                    else:
+                        c = json_cmp(bytes(di), bytes(self.value[gi]))
+                        if c != 0 and (c < 0) == is_min:
+                            self.value[gi] = di
+                return
             for gi, di in zip(g, d):
                 if not self.has_value[gi]:
                     self.value[gi] = di
+                    self.has_value[gi] = True
                 elif (di < self.value[gi]) == is_min and di != self.value[gi]:
                     self.value[gi] = di
-            self.has_value[g] = True
             return
         # seed never-seen groups with the identity sentinel, then accumulate
         if d.dtype.kind == "f":

@@ -49,6 +49,7 @@ class FieldTypeTp(enum.IntEnum):
     DATE = 10
     DURATION = 11
     DATETIME = 12
+    JSON = 245
     NEW_DECIMAL = 246
     BLOB = 252
     VAR_STRING = 253
@@ -73,6 +74,7 @@ _TP_TO_EVAL = {
     FieldTypeTp.DATE: EvalType.DATETIME,
     FieldTypeTp.DATETIME: EvalType.DATETIME,
     FieldTypeTp.DURATION: EvalType.DURATION,
+    FieldTypeTp.JSON: EvalType.JSON,
     FieldTypeTp.BLOB: EvalType.BYTES,
     FieldTypeTp.VAR_STRING: EvalType.BYTES,
     FieldTypeTp.STRING: EvalType.BYTES,
@@ -173,7 +175,7 @@ class Column:
             data = np.array([0 if v is None else v for v in values], dtype=np.int64)
         elif eval_type == EvalType.REAL:
             data = np.array([0.0 if v is None else v for v in values], dtype=np.float64)
-        elif eval_type == EvalType.BYTES:
+        elif eval_type in (EvalType.BYTES, EvalType.JSON):
             data = np.empty(n, dtype=object)
             for i, v in enumerate(values):
                 data[i] = b"" if v is None else v
@@ -213,10 +215,11 @@ class Column:
             return datum_mod.FLOAT_FLAG, float(self.data[i])
         if self.eval_type == EvalType.DECIMAL:
             return datum_mod.DECIMAL_FLAG, (int(self.data[i]), self.frac)
-        if self.eval_type == EvalType.BYTES:
+        if self.eval_type in (EvalType.BYTES, EvalType.JSON):
+            flag = datum_mod.JSON_FLAG if self.eval_type == EvalType.JSON else datum_mod.BYTES_FLAG
             if self.dictionary is not None:
-                return datum_mod.BYTES_FLAG, bytes(self.dictionary[self.data[i]])
-            return datum_mod.BYTES_FLAG, bytes(self.data[i])
+                return flag, bytes(self.dictionary[self.data[i]])
+            return flag, bytes(self.data[i])
         if self.eval_type == EvalType.DURATION:
             return datum_mod.DURATION_FLAG, int(self.data[i])
         if self.eval_type == EvalType.DATETIME:
@@ -227,7 +230,7 @@ class Column:
 def _pyval(et: EvalType, v):
     if et == EvalType.REAL:
         return float(v)
-    if et == EvalType.BYTES:
+    if et in (EvalType.BYTES, EvalType.JSON):
         return bytes(v)
     return int(v)
 

@@ -85,6 +85,10 @@ def encode_datum(out: bytearray, flag: int, value, for_key: bool = False) -> Non
     elif flag == DURATION_FLAG:
         out.append(DURATION_FLAG)
         out += codec.encode_i64(value)
+    elif flag == JSON_FLAG:
+        # value is the self-delimiting binary JSON payload (type byte + body)
+        out.append(JSON_FLAG)
+        out += value
     elif flag == MAX_FLAG:
         out.append(MAX_FLAG)
     else:
@@ -120,6 +124,11 @@ def decode_datum(b: bytes, offset: int = 0) -> tuple[Datum, int]:
         return Datum(DECIMAL_FLAG, (scaled, frac)), offset + 9
     if flag == DURATION_FLAG:
         return Datum(DURATION_FLAG, codec.decode_i64(b, offset)), offset + 8
+    if flag == JSON_FLAG:
+        from .json_value import json_binary_len
+
+        n = json_binary_len(b, offset)
+        return Datum(JSON_FLAG, b[offset : offset + n]), offset + n
     if flag == MAX_FLAG:
         return Datum(MAX_FLAG, None), offset
     raise ValueError(f"unknown datum flag {flag}")

@@ -494,7 +494,7 @@ def _coded_group_parts(group_rpns, columns, rows: np.ndarray):
 
 def _as_py(c: Column, row: int):
     v = c.data[row]
-    if c.eval_type == EvalType.BYTES:
+    if c.eval_type in (EvalType.BYTES, EvalType.JSON):
         if c.dictionary is not None:
             return bytes(c.dictionary[v])
         return bytes(v)

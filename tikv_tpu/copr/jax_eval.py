@@ -121,7 +121,10 @@ def _analyze(dag: DagRequest) -> _Plan:
             raise _Unsupported(f"executor {type(e).__name__} not device-routable here")
     schema = [(c.ftype.eval_type, c.ftype.decimal) for c in scan.columns_info]
     for et, _ in schema:
-        if et not in _DEVICE_EVAL_TYPES and et != EvalType.BYTES:
+        if et not in _DEVICE_EVAL_TYPES and et not in (EvalType.BYTES, EvalType.JSON):
+            # BYTES/JSON columns may exist in the schema (group keys are
+            # dictionary-encoded host-side); _check_rpn_device rejects them
+            # inside device expressions
             raise _Unsupported(f"column type {et}")
     if plan.selection is not None:
         for cond in plan.selection.conditions:

@@ -1,0 +1,594 @@
+"""MySQL JSON: binary codec, path expressions, and value operations.
+
+Re-expression of ``tidb_query_datatype/src/codec/mysql/json`` (mod.rs type
+codes, binary.rs layout, path_expr.rs legs, json_extract.rs /
+json_modify.rs / json_merge.rs semantics).  Values round-trip through the
+TiDB binary JSON layout:
+
+    datum  = type_code(1B) + value
+    object = elem_count(u32le) size(u32le) key_entries value_entries keys vals
+             key_entry   = key_offset(u32le) key_len(u16le)
+             value_entry = type_code(1B) + offset_or_inlined_literal(u32le)
+    array  = elem_count(u32le) size(u32le) value_entries vals
+    string = leb128 length + utf8 bytes ;  i64/u64/f64 = 8B little-endian
+    literal= 0x00 NULL | 0x01 TRUE | 0x02 FALSE
+
+Python-side values: None, bool, int, float, str, list, dict (a thin
+``JsonU64`` wrapper marks explicit u64).  Object keys sort MySQL-style:
+shorter first, then byte order.
+"""
+
+from __future__ import annotations
+
+import json as _pyjson
+import struct
+
+TYPE_OBJECT = 0x01
+TYPE_ARRAY = 0x03
+TYPE_LITERAL = 0x04
+TYPE_I64 = 0x09
+TYPE_U64 = 0x0A
+TYPE_F64 = 0x0B
+TYPE_STRING = 0x0C
+
+LIT_NULL = 0x00
+LIT_TRUE = 0x01
+LIT_FALSE = 0x02
+
+_U32 = struct.Struct("<I")
+_U16 = struct.Struct("<H")
+_I64 = struct.Struct("<q")
+_U64 = struct.Struct("<Q")
+_F64 = struct.Struct("<d")
+
+
+class JsonU64(int):
+    """Marks an int as an explicit UNSIGNED INTEGER json value."""
+
+
+def _key_sort(k: bytes):
+    return (len(k), k)  # MySQL: shorter keys first, then binary order
+
+
+def _leb128(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_leb128(b: bytes, off: int) -> tuple[int, int]:
+    n = shift = 0
+    while True:
+        c = b[off]
+        off += 1
+        n |= (c & 0x7F) << shift
+        if not c & 0x80:
+            return n, off
+        shift += 7
+
+
+def _type_of(v) -> int:
+    if v is None or isinstance(v, bool):
+        return TYPE_LITERAL
+    if isinstance(v, JsonU64):
+        return TYPE_U64
+    if isinstance(v, int):
+        return TYPE_U64 if v >= 2**63 else TYPE_I64
+    if isinstance(v, float):
+        return TYPE_F64
+    if isinstance(v, str):
+        return TYPE_STRING
+    if isinstance(v, list):
+        return TYPE_ARRAY
+    if isinstance(v, dict):
+        return TYPE_OBJECT
+    raise TypeError(f"not a json value: {type(v)}")
+
+
+def _encode_value(v) -> bytes:
+    t = _type_of(v)
+    if t == TYPE_LITERAL:
+        return bytes([LIT_NULL if v is None else (LIT_TRUE if v else LIT_FALSE)])
+    if t == TYPE_I64:
+        return _I64.pack(v)
+    if t == TYPE_U64:
+        return _U64.pack(v)
+    if t == TYPE_F64:
+        return _F64.pack(v)
+    if t == TYPE_STRING:
+        raw = v.encode("utf-8")
+        return _leb128(len(raw)) + raw
+    if t == TYPE_ARRAY:
+        entries = bytearray()
+        data = bytearray()
+        header = 8 + 5 * len(v)
+        for el in v:
+            et = _type_of(el)
+            if et == TYPE_LITERAL:
+                entries.append(et)
+                entries += _U32.pack(_encode_value(el)[0])
+            else:
+                entries.append(et)
+                entries += _U32.pack(header + len(data))
+                data += _encode_value(el)
+        total = header + len(data)
+        return _U32.pack(len(v)) + _U32.pack(total) + bytes(entries) + bytes(data)
+    # object
+    items = sorted(((k.encode("utf-8"), val) for k, val in v.items()), key=lambda kv: _key_sort(kv[0]))
+    header = 8 + 6 * len(items) + 5 * len(items)
+    key_entries = bytearray()
+    value_entries = bytearray()
+    keys = bytearray()
+    data = bytearray()
+    for k, _val in items:
+        key_entries += _U32.pack(header + len(keys))
+        key_entries += _U16.pack(len(k))
+        keys += k
+    for _k, val in items:
+        vt = _type_of(val)
+        if vt == TYPE_LITERAL:
+            value_entries.append(vt)
+            value_entries += _U32.pack(_encode_value(val)[0])
+        else:
+            value_entries.append(vt)
+            value_entries += _U32.pack(header + len(keys) + len(data))
+            data += _encode_value(val)
+    total = header + len(keys) + len(data)
+    return (
+        _U32.pack(len(items)) + _U32.pack(total)
+        + bytes(key_entries) + bytes(value_entries) + bytes(keys) + bytes(data)
+    )
+
+
+def json_encode(v) -> bytes:
+    """Python value → binary JSON datum (type byte + value)."""
+    return bytes([_type_of(v)]) + _encode_value(v)
+
+
+def _decode_value(t: int, b: bytes, off: int):
+    if t == TYPE_LITERAL:
+        lit = b[off]
+        return None if lit == LIT_NULL else (lit == LIT_TRUE)
+    if t == TYPE_I64:
+        return _I64.unpack_from(b, off)[0]
+    if t == TYPE_U64:
+        u = _U64.unpack_from(b, off)[0]
+        return JsonU64(u) if u >= 2**63 else u
+    if t == TYPE_F64:
+        return _F64.unpack_from(b, off)[0]
+    if t == TYPE_STRING:
+        n, p = _read_leb128(b, off)
+        return b[p : p + n].decode("utf-8")
+    count = _U32.unpack_from(b, off)[0]
+    if t == TYPE_ARRAY:
+        out = []
+        for i in range(count):
+            et = b[off + 8 + 5 * i]
+            val_off = _U32.unpack_from(b, off + 8 + 5 * i + 1)[0]
+            if et == TYPE_LITERAL:
+                out.append(None if (val_off & 0xFF) == LIT_NULL else ((val_off & 0xFF) == LIT_TRUE))
+            else:
+                out.append(_decode_value(et, b, off + val_off))
+        return out
+    if t == TYPE_OBJECT:
+        obj = {}
+        ve_base = off + 8 + 6 * count
+        for i in range(count):
+            key_off = _U32.unpack_from(b, off + 8 + 6 * i)[0]
+            key_len = _U16.unpack_from(b, off + 8 + 6 * i + 4)[0]
+            key = b[off + key_off : off + key_off + key_len].decode("utf-8")
+            et = b[ve_base + 5 * i]
+            val_off = _U32.unpack_from(b, ve_base + 5 * i + 1)[0]
+            if et == TYPE_LITERAL:
+                obj[key] = None if (val_off & 0xFF) == LIT_NULL else ((val_off & 0xFF) == LIT_TRUE)
+            else:
+                obj[key] = _decode_value(et, b, off + val_off)
+        return obj
+    raise ValueError(f"bad json type code {t:#x}")
+
+
+def json_decode(b: bytes):
+    """Binary JSON datum → Python value."""
+    return _decode_value(b[0], b, 1)
+
+
+def json_binary_len(b: bytes, off: int) -> int:
+    """Length of the binary JSON datum starting at ``off`` (for the datum
+    codec: JSON payloads are self-delimiting)."""
+    t = b[off]
+    p = off + 1
+    if t == TYPE_LITERAL:
+        return 2
+    if t in (TYPE_I64, TYPE_U64, TYPE_F64):
+        return 9
+    if t == TYPE_STRING:
+        n, q = _read_leb128(b, p)
+        return (q - off) + n
+    if t in (TYPE_ARRAY, TYPE_OBJECT):
+        return 1 + _U32.unpack_from(b, p + 4)[0]
+    raise ValueError(f"bad json type code {t:#x}")
+
+
+# ---------------------------------------------------------------------------
+# text form (MySQL serialization: ", " / ": " separators)
+# ---------------------------------------------------------------------------
+
+
+def json_to_text(v) -> str:
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+            return repr(v)
+        return _pyjson.dumps(v)
+    if isinstance(v, str):
+        return _pyjson.dumps(v, ensure_ascii=False)
+    if isinstance(v, list):
+        return "[" + ", ".join(json_to_text(e) for e in v) + "]"
+    items = sorted(((k.encode(), k, val) for k, val in v.items()), key=lambda kv: _key_sort(kv[0]))
+    return "{" + ", ".join(f"{_pyjson.dumps(k, ensure_ascii=False)}: {json_to_text(val)}" for _kb, k, val in items) + "}"
+
+
+def json_parse_text(s: str):
+    """JSON text → Python value (cast_string_json / JSON_VALID)."""
+    return _pyjson.loads(s)
+
+
+# ---------------------------------------------------------------------------
+# path expressions (path_expr.rs): $, .key, ."quoted", [N], [*], .*, **
+# ---------------------------------------------------------------------------
+
+MEMBER, INDEX, WILD_MEMBER, WILD_INDEX, DOUBLE_WILD = "m", "i", "wm", "wi", "**"
+
+
+def parse_path(path: str) -> list[tuple]:
+    s = path.strip()
+    if not s.startswith("$"):
+        raise ValueError(f"invalid json path {path!r}")
+    i = 1
+    legs: list[tuple] = []
+    while i < len(s):
+        c = s[i]
+        if c.isspace():
+            i += 1
+        elif c == ".":
+            i += 1
+            while i < len(s) and s[i].isspace():
+                i += 1
+            if i < len(s) and s[i] == "*":
+                legs.append((WILD_MEMBER,))
+                i += 1
+            elif i < len(s) and s[i] == '"':
+                j = i + 1
+                buf = []
+                while j < len(s) and s[j] != '"':
+                    if s[j] == "\\":
+                        j += 1
+                        if j >= len(s):
+                            raise ValueError(f"invalid json path {path!r}")
+                    buf.append(s[j])
+                    j += 1
+                if j >= len(s):
+                    raise ValueError(f"invalid json path {path!r}")
+                legs.append((MEMBER, "".join(buf)))
+                i = j + 1
+            else:
+                j = i
+                while j < len(s) and (s[j].isalnum() or s[j] in "_$"):
+                    j += 1
+                if j == i:
+                    raise ValueError(f"invalid json path {path!r}")
+                legs.append((MEMBER, s[i:j]))
+                i = j
+        elif c == "[":
+            j = s.index("]", i)
+            inner = s[i + 1 : j].strip()
+            if inner == "*":
+                legs.append((WILD_INDEX,))
+            else:
+                legs.append((INDEX, int(inner)))
+            i = j + 1
+        elif c == "*" and s[i : i + 2] == "**":
+            legs.append((DOUBLE_WILD,))
+            i += 2
+        else:
+            raise ValueError(f"invalid json path {path!r}")
+    if legs and legs[-1][0] == DOUBLE_WILD:
+        raise ValueError(f"path {path!r} must not end with **")
+    return legs
+
+
+def path_has_wildcard(legs: list[tuple]) -> bool:
+    return any(leg[0] in (WILD_MEMBER, WILD_INDEX, DOUBLE_WILD) for leg in legs)
+
+
+def _match(v, legs: list[tuple], out: list) -> None:
+    if not legs:
+        out.append(v)
+        return
+    leg, rest = legs[0], legs[1:]
+    kind = leg[0]
+    if kind == MEMBER:
+        if isinstance(v, dict) and leg[1] in v:
+            _match(v[leg[1]], rest, out)
+    elif kind == INDEX:
+        if isinstance(v, list):
+            if 0 <= leg[1] < len(v):
+                _match(v[leg[1]], rest, out)
+        elif leg[1] == 0:
+            _match(v, rest, out)  # scalar acts as single-element array
+    elif kind == WILD_MEMBER:
+        if isinstance(v, dict):
+            for val in v.values():
+                _match(val, rest, out)
+    elif kind == WILD_INDEX:
+        if isinstance(v, list):
+            for el in v:
+                _match(el, rest, out)
+    elif kind == DOUBLE_WILD:
+        # ** : any depth ≥ 1 below the current value
+        def walk(node):
+            if isinstance(node, dict):
+                for val in node.values():
+                    _match(val, rest, out)
+                    walk(val)
+            elif isinstance(node, list):
+                for el in node:
+                    _match(el, rest, out)
+                    walk(el)
+
+        walk(v)
+
+
+def extract(v, paths: list[str]):
+    """JSON_EXTRACT semantics: one non-wildcard path → the value itself;
+    otherwise an array of every match; no matches → None sentinel."""
+    all_legs = [parse_path(p) for p in paths]
+    matches: list = []
+    for legs in all_legs:
+        _match(v, legs, matches)
+    if not matches:
+        return _NO_MATCH
+    if len(paths) == 1 and not path_has_wildcard(all_legs[0]):
+        return matches[0]
+    return matches
+
+
+_NO_MATCH = object()
+
+
+def modify(v, updates: list[tuple[str, object]], mode: str):
+    """JSON_SET / JSON_INSERT / JSON_REPLACE (json_modify.rs).  Wildcards are
+    rejected, matching MySQL."""
+    for path, new in updates:
+        legs = parse_path(path)
+        if path_has_wildcard(legs):
+            raise ValueError("wildcards not allowed in this json function")
+        v = _modify_one(v, legs, new, mode)
+    return v
+
+
+def _modify_one(v, legs, new, mode):
+    if not legs:
+        return new if mode in ("set", "replace") else v
+    leg, rest = legs[0], legs[1:]
+    if leg[0] == MEMBER and isinstance(v, dict):
+        key = leg[1]
+        if key in v:
+            out = dict(v)
+            out[key] = _modify_one(v[key], rest, new, mode)
+            return out
+        if not rest and mode in ("set", "insert"):
+            out = dict(v)
+            out[key] = new
+            return out
+        return v
+    if leg[0] == INDEX:
+        arr = v if isinstance(v, list) else [v]
+        idx = leg[1]
+        if 0 <= idx < len(arr):
+            out = list(arr)
+            out[idx] = _modify_one(arr[idx], rest, new, mode)
+            return out if isinstance(v, list) else (out[0] if len(out) == 1 else out)
+        if not rest and mode in ("set", "insert"):
+            return list(arr) + [new]  # append past the end, MySQL-style
+        return v
+    return v
+
+
+def remove(v, paths: list[str]):
+    """JSON_REMOVE.  Wildcards and '$' itself are rejected."""
+    for path in paths:
+        legs = parse_path(path)
+        if not legs:
+            raise ValueError("cannot remove the document root")
+        if path_has_wildcard(legs):
+            raise ValueError("wildcards not allowed in json_remove")
+        v = _remove_one(v, legs)
+    return v
+
+
+def _remove_one(v, legs):
+    leg, rest = legs[0], legs[1:]
+    if leg[0] == MEMBER and isinstance(v, dict) and leg[1] in v:
+        out = dict(v)
+        if rest:
+            out[leg[1]] = _remove_one(v[leg[1]], rest)
+        else:
+            del out[leg[1]]
+        return out
+    if leg[0] == INDEX and isinstance(v, list) and 0 <= leg[1] < len(v):
+        out = list(v)
+        if rest:
+            out[leg[1]] = _remove_one(v[leg[1]], rest)
+        else:
+            del out[leg[1]]
+        return out
+    return v
+
+
+# ---------------------------------------------------------------------------
+# value operations
+# ---------------------------------------------------------------------------
+
+
+def json_type_name(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "BOOLEAN"
+    if isinstance(v, JsonU64):
+        return "UNSIGNED INTEGER"
+    if isinstance(v, int):
+        return "INTEGER"
+    if isinstance(v, float):
+        return "DOUBLE"
+    if isinstance(v, str):
+        return "STRING"
+    if isinstance(v, list):
+        return "ARRAY"
+    return "OBJECT"
+
+
+def depth(v) -> int:
+    if isinstance(v, dict):
+        return 1 + max((depth(x) for x in v.values()), default=0)
+    if isinstance(v, list):
+        return 1 + max((depth(x) for x in v), default=0)
+    return 1
+
+
+def length(v) -> int:
+    if isinstance(v, dict):
+        return len(v)
+    if isinstance(v, list):
+        return len(v)
+    return 1
+
+
+def merge(values: list):
+    """JSON_MERGE (merge-preserving, json_merge.rs): arrays concatenate,
+    objects union with recursive merge, scalars wrap into arrays."""
+    out = values[0]
+    for nxt in values[1:]:
+        out = _merge2(out, nxt)
+    return out
+
+
+def _merge2(a, b):
+    a_arr, b_arr = isinstance(a, list), isinstance(b, list)
+    if isinstance(a, dict) and isinstance(b, dict):
+        out = dict(a)
+        for k, v in b.items():
+            out[k] = _merge2(out[k], v) if k in out else v
+        return out
+    left = a if a_arr else [a]
+    right = b if b_arr else [b]
+    return left + right
+
+
+def _json_eq(a, b) -> bool:
+    if isinstance(a, bool) or isinstance(b, bool):
+        return isinstance(a, bool) and isinstance(b, bool) and a == b
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        return float(a) == float(b)
+    if type(a) is not type(b) and not (isinstance(a, type(b)) or isinstance(b, type(a))):
+        return False
+    return a == b
+
+
+def contains(target, candidate) -> bool:
+    """JSON_CONTAINS containment rules (json_contains.rs)."""
+    if isinstance(target, dict):
+        if not isinstance(candidate, dict):
+            return False
+        return all(k in target and contains(target[k], v) for k, v in candidate.items())
+    if isinstance(target, list):
+        if isinstance(candidate, list):
+            return all(contains(target, el) for el in candidate)
+        return any(contains(el, candidate) for el in target)
+    if isinstance(candidate, (dict, list)):
+        return False
+    return _json_eq(target, candidate)
+
+
+def quote(raw: bytes) -> bytes:
+    """JSON_QUOTE: utf8 text → JSON string literal text."""
+    return _pyjson.dumps(raw.decode("utf-8"), ensure_ascii=False).encode("utf-8")
+
+
+def unquote(v) -> bytes:
+    """JSON_UNQUOTE: string values yield their text; other values their
+    serialization."""
+    if isinstance(v, str):
+        return v.encode("utf-8")
+    return json_to_text(v).encode("utf-8")
+
+
+# ---------------------------------------------------------------------------
+# ordering (json/comparer: precedence groups, then within-group rules)
+# ---------------------------------------------------------------------------
+
+def _precedence(v) -> int:
+    if v is None:
+        return 0
+    if isinstance(v, bool):
+        return 5
+    if isinstance(v, (int, float)):
+        return 1
+    if isinstance(v, str):
+        return 2
+    if isinstance(v, dict):
+        return 3
+    return 4  # array
+
+
+def json_cmp_values(a, b) -> int:
+    """Total order over decoded JSON values: precedence NULL < NUMBER <
+    STRING < OBJECT < ARRAY < BOOLEAN; numbers numeric, strings byte order,
+    arrays elementwise then length, objects by size then sorted pairs."""
+    pa, pb = _precedence(a), _precedence(b)
+    if pa != pb:
+        return -1 if pa < pb else 1
+    if pa == 0:
+        return 0
+    if pa == 5:
+        return (a > b) - (a < b)
+    if pa == 1:
+        fa, fb = float(a), float(b)
+        return (fa > fb) - (fa < fb)
+    if pa == 2:
+        ab, bb = a.encode("utf-8"), b.encode("utf-8")
+        return (ab > bb) - (ab < bb)
+    if pa == 4:
+        for x, y in zip(a, b):
+            c = json_cmp_values(x, y)
+            if c:
+                return c
+        return (len(a) > len(b)) - (len(a) < len(b))
+    # objects: size, then MySQL-sorted (key, value) pairs
+    if len(a) != len(b):
+        return -1 if len(a) < len(b) else 1
+    ka = sorted(a, key=lambda k: _key_sort(k.encode()))
+    kb = sorted(b, key=lambda k: _key_sort(k.encode()))
+    for x, y in zip(ka, kb):
+        xb, yb = x.encode(), y.encode()
+        if xb != yb:
+            return -1 if _key_sort(xb) < _key_sort(yb) else 1
+        c = json_cmp_values(a[x], b[y])
+        if c:
+            return c
+    return 0
+
+
+def json_cmp(a: bytes, b: bytes) -> int:
+    """Compare two binary JSON payloads by value."""
+    return json_cmp_values(json_decode(a), json_decode(b))

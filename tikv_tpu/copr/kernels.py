@@ -436,5 +436,217 @@ def _like_regex(pattern: bytes):
 _int_bytes_op("like", 2)(lambda s, pat: 1 if _like_regex(pat).match(s) else 0)
 
 
+# -- MySQL JSON family (CPU-only like the bytes family; the reference's
+# impl_json.rs — values travel as binary JSON payloads in object arrays) ----
+
+from . import json_value as _jv  # noqa: E402
+
+
+def _json_op(name, arity, rkind):
+    """Per-row JSON kernel: each fn receives raw per-row operand values
+    (binary-JSON payloads for JSON operands, bytes for paths/text, numbers
+    for numerics); result re-encoded by rkind ("json" payload, "bytes" raw,
+    "int"/"real" numeric).  A per-row result of None means SQL NULL."""
+
+    def deco(fn):
+        def wrapped(xp, *args):
+            datas = [a[0] for a in args]
+            nulls = args[0][1].copy()
+            for _, nl in args[1:]:
+                nulls = nulls | nl
+            n = len(datas[0])
+            out = _np.empty(n, dtype=object)
+            rnull = _np.asarray(nulls).copy()
+            for i in range(n):
+                if rnull[i]:
+                    out[i] = b"" if rkind in ("json", "bytes") else 0
+                    continue
+                r = fn(*[d[i] for d in datas])
+                if r is None:
+                    rnull[i] = True
+                    out[i] = b"" if rkind in ("json", "bytes") else 0
+                else:
+                    out[i] = r
+            if rkind == "int":
+                return out.astype(_np.int64), rnull
+            if rkind == "real":
+                return out.astype(_np.float64), rnull
+            return out, rnull
+
+        KERNELS[name] = (arity, rkind, wrapped)
+        return fn
+
+    return deco
+
+
+def _jd(b):
+    return _jv.json_decode(bytes(b))
+
+
+@_json_op("json_extract", -1, "json")
+def _json_extract(doc, *paths):
+    r = _jv.extract(_jd(doc), [p.decode() for p in paths])
+    return None if r is _jv._NO_MATCH else _jv.json_encode(r)
+
+
+@_json_op("json_unquote", 1, "bytes")
+def _json_unquote(doc):
+    return _jv.unquote(_jd(doc))
+
+
+@_json_op("json_type", 1, "bytes")
+def _json_type(doc):
+    return _jv.json_type_name(_jd(doc)).encode()
+
+
+@_json_op("json_length", -1, "int")
+def _json_length(doc, *path):
+    v = _jd(doc)
+    if path:
+        v = _jv.extract(v, [path[0].decode()])
+        if v is _jv._NO_MATCH:
+            return None
+    return _jv.length(v)
+
+
+@_json_op("json_depth", 1, "int")
+def _json_depth(doc):
+    return _jv.depth(_jd(doc))
+
+
+@_json_op("json_valid", 1, "int")
+def _json_valid(raw):
+    try:
+        _jv.json_parse_text(raw.decode("utf-8"))
+        return 1
+    except (ValueError, UnicodeDecodeError):
+        return 0
+
+
+@_json_op("json_keys", -1, "json")
+def _json_keys(doc, *path):
+    v = _jd(doc)
+    if path:
+        v = _jv.extract(v, [path[0].decode()])
+        if v is _jv._NO_MATCH:
+            return None
+    if not isinstance(v, dict):
+        return None
+    return _jv.json_encode(sorted(v.keys(), key=lambda k: (len(k.encode()), k.encode())))
+
+
+@_json_op("json_array", -1, "json")
+def _json_array(*elems):
+    return _jv.json_encode([_jd(e) for e in elems])
+
+
+@_json_op("json_object", -1, "json")
+def _json_object(*kv):
+    if len(kv) % 2:
+        raise ValueError("json_object: incorrect parameter count (key/value pairs)")
+    obj = {}
+    for i in range(0, len(kv), 2):
+        obj[bytes(kv[i]).decode("utf-8")] = _jd(kv[i + 1])
+    return _jv.json_encode(obj)
+
+
+@_json_op("json_merge", -1, "json")
+def _json_merge(*docs):
+    return _jv.json_encode(_jv.merge([_jd(d) for d in docs]))
+
+
+@_json_op("json_contains", 2, "int")
+def _json_contains(target, candidate):
+    return 1 if _jv.contains(_jd(target), _jd(candidate)) else 0
+
+
+def _json_modify_fn(mode):
+    def fn(doc, *rest):
+        if len(rest) % 2:
+            raise ValueError(f"json_{mode}: incorrect parameter count (path/value pairs)")
+        updates = [
+            (rest[i].decode(), _jd(rest[i + 1])) for i in range(0, len(rest), 2)
+        ]
+        return _jv.json_encode(_jv.modify(_jd(doc), updates, mode))
+
+    return fn
+
+
+_json_op("json_set", -1, "json")(_json_modify_fn("set"))
+_json_op("json_insert", -1, "json")(_json_modify_fn("insert"))
+_json_op("json_replace", -1, "json")(_json_modify_fn("replace"))
+
+
+@_json_op("json_remove", -1, "json")
+def _json_remove(doc, *paths):
+    return _jv.json_encode(_jv.remove(_jd(doc), [p.decode() for p in paths]))
+
+
+@_json_op("json_quote", 1, "bytes")
+def _json_quote(raw):
+    return _jv.quote(bytes(raw))
+
+
+# casts between JSON and base types (impl_cast.rs json arms)
+@_json_op("cast_int_json", 1, "json")
+def _cast_int_json(v):
+    return _jv.json_encode(int(v))
+
+
+@_json_op("cast_real_json", 1, "json")
+def _cast_real_json(v):
+    return _jv.json_encode(float(v))
+
+
+@_json_op("cast_string_json", 1, "json")
+def _cast_string_json(raw):
+    try:
+        return _jv.json_encode(_jv.json_parse_text(bytes(raw).decode("utf-8")))
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
+@_json_op("cast_json_string", 1, "bytes")
+def _cast_json_string(doc):
+    return _jv.json_to_text(_jd(doc)).encode("utf-8")
+
+
+@_json_op("cast_json_int", 1, "int")
+def _cast_json_int(doc):
+    import math
+
+    def _round(f):  # MySQL rounds half away from zero
+        return int(math.floor(f + 0.5)) if f >= 0 else int(math.ceil(f - 0.5))
+
+    v = _jd(doc)
+    if isinstance(v, bool):
+        return int(v)
+    if isinstance(v, int):
+        return int(v)
+    if isinstance(v, float):
+        return _round(v)
+    if isinstance(v, str):
+        try:
+            return _round(float(v))
+        except ValueError:
+            return 0
+    return 0
+
+
+@_json_op("cast_json_real", 1, "real")
+def _cast_json_real(doc):
+    v = _jd(doc)
+    if isinstance(v, bool):
+        return float(v)
+    if isinstance(v, (int, float)):
+        return float(v)
+    if isinstance(v, str):
+        try:
+            return float(v)
+        except ValueError:
+            return 0.0
+    return 0.0
+
+
 # time-type kernels register themselves into KERNELS on import
 from . import mysql_time as _mysql_time  # noqa: E402,F401

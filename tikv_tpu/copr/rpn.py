@@ -84,7 +84,12 @@ class RpnExpression:
 
 
 DIVIDE_FRAC_INCR = 4  # MySQL: decimal division adds 4 frac digits
-_VARIADIC_MIN = {"in": 2, "case_when": 2, "concat": 1, "coalesce": 1}
+_VARIADIC_MIN = {
+    "in": 2, "case_when": 2, "concat": 1, "coalesce": 1,
+    "json_extract": 2, "json_length": 1, "json_keys": 1, "json_array": 1,
+    "json_object": 2, "json_merge": 2, "json_set": 3, "json_insert": 3,
+    "json_replace": 3, "json_remove": 2,
+}
 
 
 def compile_expr(expr: Expr, schema: list[tuple[EvalType, int]]) -> RpnExpression:
@@ -159,6 +164,8 @@ def _infer(op: str, rkind: str, child_types) -> tuple[EvalType, int, tuple[int, 
         return EvalType.REAL, 0, scale_by
     if rkind == "bytes":
         return EvalType.BYTES, 0, scale_by
+    if rkind == "json":
+        return EvalType.JSON, 0, scale_by
     if rkind == "same":
         return types[0], fracs[0], scale_by
     if rkind == "same_2":
@@ -213,7 +220,7 @@ def eval_rpn(rpn: RpnExpression, columns: list, n_rows: int, xp=np):
             if node.value is None:
                 data = xp.zeros(n_rows, dtype=dtype if dtype is not object else np.int64)
                 nulls = xp.ones(n_rows, dtype=bool)
-            elif node.eval_type == EvalType.BYTES:
+            elif node.eval_type in (EvalType.BYTES, EvalType.JSON):
                 data = np.empty(n_rows, dtype=object)
                 data[:] = node.value
                 nulls = xp.zeros(n_rows, dtype=bool)
@@ -265,6 +272,13 @@ def const_decimal(scaled: int | None, frac: int) -> Constant:
 
 def const_bytes(v: bytes | None) -> Constant:
     return Constant(v, EvalType.BYTES)
+
+
+def const_json(v) -> Constant:
+    """Constant from a Python JSON value (encoded to binary JSON)."""
+    from .json_value import json_encode
+
+    return Constant(None if v is None else json_encode(v), EvalType.JSON)
 
 
 def call(op: str, *children) -> FuncCall:

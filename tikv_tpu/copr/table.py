@@ -88,6 +88,8 @@ def encode_row(columns: list[ColumnInfo], values: list) -> bytes:
             datum_mod.encode_datum(out, datum_mod.DECIMAL_FLAG, (v, info.ftype.decimal))
         elif et == EvalType.BYTES:
             datum_mod.encode_datum(out, datum_mod.BYTES_FLAG, v)
+        elif et == EvalType.JSON:
+            datum_mod.encode_datum(out, datum_mod.JSON_FLAG, v)
         elif et in (EvalType.DATETIME, EvalType.DURATION):
             datum_mod.encode_datum(out, datum_mod.DURATION_FLAG, v)
         else:

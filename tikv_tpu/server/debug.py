@@ -142,11 +142,9 @@ class Debugger:
         num_puts = num_deletes = num_versions = num_rows = 0
         min_ts = max_ts = None
         last_user = None
-        wkeys = []
         sizes = {}
         wn = wsize = 0
         for k, v in snap.scan_cf(CF_WRITE, start, end):
-            wkeys.append(k)
             wn += 1
             wsize += len(k) + len(v)
             user, commit_ts = split_ts(keys.origin_key(k))
@@ -169,10 +167,13 @@ class Debugger:
                 size += len(k) + len(v)
             sizes[cf] = {"keys": n, "bytes": size}
         middle = None
-        if wkeys:
-            middle = Key.from_encoded(
-                split_ts(keys.origin_key(wkeys[len(wkeys) // 2]))[0]
-            ).to_raw().hex()
+        if wn:
+            # second bounded pass over the same snapshot instead of holding
+            # every key: O(1) memory for a debug RPC on a big region
+            for i, (k, _v) in enumerate(snap.scan_cf(CF_WRITE, start, end)):
+                if i == wn // 2:
+                    middle = Key.from_encoded(split_ts(keys.origin_key(k))[0]).to_raw().hex()
+                    break
         return {
             "mvcc": {
                 "num_rows": num_rows,
