@@ -1,0 +1,100 @@
+"""Collations (reference: tidb_query_datatype/src/codec/collation): sort-key
+equivalence, PAD SPACE, case folding, and kernel/group-by integration."""
+
+import numpy as np
+import pytest
+
+from tikv_tpu.copr.collation import get_collator
+from tikv_tpu.copr.rpn import call, col, compile_expr, const_bytes, eval_rpn
+from tikv_tpu.copr.datatypes import EvalType
+
+
+def test_binary_collator_is_identity():
+    c = get_collator("binary")
+    assert c.sort_key(b"Abc ") == b"Abc "  # NO PAD: trailing space significant
+    assert c.compare(b"a", b"B") > 0
+
+
+def test_utf8mb4_bin_pad_space():
+    c = get_collator("utf8mb4_bin")
+    assert c.eq("abc".encode(), "abc   ".encode())  # PAD SPACE
+    assert not c.eq(b"abc", b"Abc")  # case-sensitive
+    assert c.compare("a".encode(), "b".encode()) < 0
+    # codepoint order beyond ASCII
+    assert c.compare("é".encode(), "z".encode()) > 0
+
+
+def test_general_ci_semantics():
+    c = get_collator("utf8mb4_general_ci")
+    assert c.eq(b"HELLO", b"hello")
+    assert c.eq(b"Hello  ", b"hello")  # PAD SPACE too
+    assert c.eq("Ä".encode(), "ä".encode())
+    assert not c.eq(b"a", b"b")
+    # sort keys order case-insensitively: 'apple' < 'Banana' < 'cherry'
+    keys = sorted([b"cherry", b"Banana", b"apple"], key=c.sort_key)
+    assert keys == [b"apple", b"Banana", b"cherry"]
+    # supplementary plane collapses, BMP compares by uppercased codepoint
+    assert c.compare("😀".encode(), "😁".encode()) == 0
+
+
+def test_collator_lookup_by_tidb_id():
+    assert get_collator(-45).name == "utf8mb4_general_ci"
+    assert get_collator(63).name == "binary"
+    with pytest.raises(ValueError):
+        get_collator("utf8mb4_unicode_ci")
+    with pytest.raises(ValueError):
+        get_collator(999)
+
+
+def _run(expr, columns, n):
+    rpn = compile_expr(expr, [(EvalType.BYTES, 0)])
+    return eval_rpn(rpn, columns, n, xp=np)
+
+
+def test_collation_kernels():
+    vals = np.array([b"Widget", b"WIDGET  ", b"gadget", b"widgeta"], dtype=object)
+    cols = {0: (vals, np.zeros(4, dtype=bool))}
+    d, _ = _run(call("eq_utf8mb4_general_ci", col(0), const_bytes(b"widget")), cols, 4)
+    assert list(d) == [1, 1, 0, 0]
+    d, _ = _run(call("eq_utf8mb4_bin", col(0), const_bytes(b"WIDGET")), cols, 4)
+    assert list(d) == [0, 1, 0, 0]  # pad space, case-sensitive
+    d, _ = _run(call("like_ci", col(0), const_bytes(b"widget%")), cols, 4)
+    assert list(d) == [1, 1, 0, 1]
+    # sort_key feeds ordinary byte comparisons
+    d, _ = _run(
+        call(
+            "eq",
+            call("sort_key_utf8mb4_general_ci", col(0)),
+            call("sort_key_utf8mb4_general_ci", const_bytes(b"WiDgEt   ")),
+        ),
+        cols,
+        4,
+    )
+    assert list(d) == [1, 1, 0, 0]
+
+
+def test_ci_group_by_via_sort_key():
+    """GROUP BY a CI column: group on sort_key(col), output first(col) —
+    the executor composition the collation framework is designed for."""
+    from tikv_tpu.copr.groupby import GroupDict
+    from tikv_tpu.copr.collation import get_collator
+
+    c = get_collator("utf8mb4_general_ci")
+    vals = [b"Apple", b"APPLE", b"pear", b"apple  ", b"Pear"]
+    keys = np.array([c.sort_key(v) for v in vals], dtype=object)
+    gd = GroupDict()
+    gids = gd.assign([(keys, np.zeros(len(vals), dtype=bool))])
+    assert len(gd) == 2
+    assert list(gids) == [0, 0, 1, 0, 1]
+    # first-occurrence ordering preserves the original first spellings
+    first = {}
+    for v, g in zip(vals, gids):
+        first.setdefault(int(g), v)
+    assert first == {0: b"Apple", 1: b"pear"}
+
+
+def test_like_ci_folds_unicode():
+    vals = np.array(["Äpfel".encode(), "äpfel".encode(), b"apfel"], dtype=object)
+    cols = {0: (vals, np.zeros(3, dtype=bool))}
+    d, _ = _run(call("like_ci", col(0), const_bytes("ä%".encode())), cols, 3)
+    assert list(d) == [1, 1, 0]

@@ -275,3 +275,20 @@ def test_bytes_min_max_within_single_batch():
     st2.grow(1)
     st2.update(np.zeros(3, dtype=np.int64), data, np.zeros(3, dtype=bool))
     assert bytes(st2.value[0]) == b"zz"
+
+
+def test_review_fixes_round2():
+    # saturating cast of u64 / huge values
+    d, _ = _run(call("cast_json_int", const_json(jv.JsonU64(2**63 + 5))))
+    assert d[0] == 2**63 - 1
+    d, _ = _run(call("cast_json_int", const_json(1e30)))
+    assert d[0] == 2**63 - 1
+    d, _ = _run(call("cast_json_int", const_json(-1e30)))
+    assert d[0] == -(2**63)
+    # exact large-int ordering
+    assert jv.json_cmp_values(2**63 - 1, 2**63 - 2) > 0
+    assert jv.json_cmp_values(2**62, 2**62 + 1) < 0
+    assert jv.json_cmp_values(1, 1.5) < 0  # mixed int/float still numeric
+    # negative array index rejected
+    with pytest.raises(ValueError, match="negative index"):
+        jv.parse_path("$.b[-1]")

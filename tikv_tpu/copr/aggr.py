@@ -151,19 +151,29 @@ class AggState:
             if self.input_type == EvalType.JSON:
                 # binary-JSON payload bytes do NOT order like the values
                 # (little-endian ints, type-code prefixes) — compare by
-                # MySQL JSON ordering
-                from .json_value import json_cmp
+                # MySQL JSON ordering.  The running best is cached decoded
+                # so each incoming row decodes once, not the accumulator
+                # again per row.
+                from .json_value import json_cmp_values, json_decode
 
+                best = getattr(self, "_json_best", None)
+                if best is None:
+                    best = self._json_best = {}
                 for gi, di in zip(g, d):
+                    dv = json_decode(bytes(di))
                     if not self.has_value[gi]:
                         # mark per row, not after the loop: a later row of the
                         # same group IN THIS BATCH must compare, not overwrite
                         self.value[gi] = di
                         self.has_value[gi] = True
+                        best[gi] = dv
                     else:
-                        c = json_cmp(bytes(di), bytes(self.value[gi]))
+                        if gi not in best:
+                            best[gi] = json_decode(bytes(self.value[gi]))
+                        c = json_cmp_values(dv, best[gi])
                         if c != 0 and (c < 0) == is_min:
                             self.value[gi] = di
+                            best[gi] = dv
                 return
             for gi, di in zip(g, d):
                 if not self.has_value[gi]:

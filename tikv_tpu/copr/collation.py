@@ -1,0 +1,97 @@
+"""String collations: binary, utf8mb4_bin, utf8mb4_general_ci.
+
+Re-expression of ``tidb_query_datatype/src/codec/collation`` (collator/mod.rs
++ collator/{binary,utf8mb4_binary,utf8mb4_general_ci}.rs): each collation
+produces a **sort key** such that bytewise comparison of sort keys equals
+collated comparison of the strings.  That shape is deliberately TPU-friendly:
+collation happens once per value on the host (sort keys are just bytes), and
+everything downstream — comparisons, group-by dictionaries, min/max — stays
+the byte machinery it already was.
+
+Semantics mirrored from the reference:
+* ``binary``: raw bytes, NO PAD.
+* ``utf8mb4_bin``: codepoint order with PAD SPACE (trailing spaces ignored,
+  like the reference's trimmed utf8mb4_bin).
+* ``utf8mb4_general_ci``: per-BMP-character weight = uppercased codepoint
+  (supplementary planes collapse to 0xFFFD), PAD SPACE — the same
+  plane-table outcome as general_ci for the common cases; full UCA
+  (unicode_ci) is out of scope and rejected by name.
+"""
+
+from __future__ import annotations
+
+PADDING_SPACE = ord(" ")
+
+
+def _general_ci_weight(ch: str) -> int:
+    cp = ord(ch)
+    if cp > 0xFFFF:
+        return 0xFFFD
+    up = ch.upper()
+    # multi-char expansions (ß→SS) collapse to their first char, matching
+    # general_ci's single-weight-per-character model
+    return ord(up[0]) if up else cp
+
+
+class Collator:
+    name = "binary"
+    is_ci = False
+
+    def sort_key(self, raw: bytes) -> bytes:
+        return raw
+
+    def compare(self, a: bytes, b: bytes) -> int:
+        ka, kb = self.sort_key(a), self.sort_key(b)
+        return (ka > kb) - (ka < kb)
+
+    def eq(self, a: bytes, b: bytes) -> bool:
+        return self.sort_key(a) == self.sort_key(b)
+
+
+class BinaryCollator(Collator):
+    name = "binary"
+
+
+class Utf8Mb4BinCollator(Collator):
+    name = "utf8mb4_bin"
+
+    def sort_key(self, raw: bytes) -> bytes:
+        # PAD SPACE: trailing spaces carry no weight
+        text = raw.decode("utf-8", "replace").rstrip(" ")
+        out = bytearray()
+        for ch in text:
+            out += ord(ch).to_bytes(3, "big")
+        return bytes(out)
+
+
+class Utf8Mb4GeneralCiCollator(Collator):
+    name = "utf8mb4_general_ci"
+    is_ci = True
+
+    def sort_key(self, raw: bytes) -> bytes:
+        text = raw.decode("utf-8", "replace").rstrip(" ")
+        out = bytearray()
+        for ch in text:
+            out += _general_ci_weight(ch).to_bytes(2, "big")
+        return bytes(out)
+
+
+_COLLATORS: dict[str, Collator] = {
+    c.name: c
+    for c in (BinaryCollator(), Utf8Mb4BinCollator(), Utf8Mb4GeneralCiCollator())
+}
+# TiDB collation ids (mysql/consts: 63 binary, 46 utf8mb4_bin, 45 general_ci);
+# negative ids are how tipb marks "new collation enabled"
+_BY_ID = {63: "binary", 46: "utf8mb4_bin", 45: "utf8mb4_general_ci"}
+
+
+def get_collator(name_or_id) -> Collator:
+    if isinstance(name_or_id, int):
+        name = _BY_ID.get(abs(name_or_id))
+        if name is None:
+            raise ValueError(f"unsupported collation id {name_or_id}")
+        return _COLLATORS[name]
+    c = _COLLATORS.get(name_or_id)
+    if c is None:
+        raise ValueError(f"unsupported collation {name_or_id!r}")
+    return c

@@ -294,7 +294,10 @@ def parse_path(path: str) -> list[tuple]:
             if inner == "*":
                 legs.append((WILD_INDEX,))
             else:
-                legs.append((INDEX, int(inner)))
+                idx = int(inner)
+                if idx < 0:
+                    raise ValueError(f"invalid json path {path!r} (negative index)")
+                legs.append((INDEX, idx))
             i = j + 1
         elif c == "*" and s[i : i + 2] == "**":
             legs.append((DOUBLE_WILD,))
@@ -563,6 +566,8 @@ def json_cmp_values(a, b) -> int:
     if pa == 5:
         return (a > b) - (a < b)
     if pa == 1:
+        if isinstance(a, int) and isinstance(b, int):
+            return (a > b) - (a < b)  # exact: floats lose ints above 2^53
         fa, fb = float(a), float(b)
         return (fa > fb) - (fa < fb)
     if pa == 2:

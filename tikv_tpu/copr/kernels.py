@@ -436,6 +436,30 @@ def _like_regex(pattern: bytes):
 _int_bytes_op("like", 2)(lambda s, pat: 1 if _like_regex(pat).match(s) else 0)
 
 
+# -- collation-aware string kernels (collation.py sort keys) ---------------
+
+from .collation import get_collator as _get_collator
+
+for _coll in ("binary", "utf8mb4_bin", "utf8mb4_general_ci"):
+    _c = _get_collator(_coll)
+    # sort_key_<collation>: bytes → sort-key bytes; comparisons, group-bys,
+    # and min/max over the result behave as collated operations on the input
+    _bytes_op(f"sort_key_{_coll}", 1, "bytes")(_c.sort_key)
+    _int_bytes_op(f"eq_{_coll}", 2)(
+        lambda a, b, _c=_c: 1 if _c.eq(a, b) else 0
+    )
+
+def _utf8_fold(b):
+    # case folding must be unicode-aware: bytes.lower() is ASCII-only and
+    # would disagree with general_ci on any non-ASCII letter
+    return b.decode("utf-8", "replace").lower().encode("utf-8")
+
+
+_int_bytes_op("like_ci", 2)(
+    lambda s_, pat: 1 if _like_regex(_utf8_fold(pat)).match(_utf8_fold(s_)) else 0
+)
+
+
 # -- MySQL JSON family (CPU-only like the bytes family; the reference's
 # impl_json.rs — values travel as binary JSON payloads in object arrays) ----
 
@@ -532,7 +556,7 @@ def _json_keys(doc, *path):
             return None
     if not isinstance(v, dict):
         return None
-    return _jv.json_encode(sorted(v.keys(), key=lambda k: (len(k.encode()), k.encode())))
+    return _jv.json_encode(sorted(v.keys(), key=lambda k: _jv._key_sort(k.encode())))
 
 
 @_json_op("json_array", -1, "json")
@@ -618,17 +642,20 @@ def _cast_json_int(doc):
     def _round(f):  # MySQL rounds half away from zero
         return int(math.floor(f + 0.5)) if f >= 0 else int(math.ceil(f - 0.5))
 
+    def _sat(n):  # saturate to i64 (MySQL CAST semantics; u64 values clamp)
+        return max(-(2**63), min(2**63 - 1, n))
+
     v = _jd(doc)
     if isinstance(v, bool):
         return int(v)
     if isinstance(v, int):
-        return int(v)
+        return _sat(int(v))
     if isinstance(v, float):
-        return _round(v)
+        return _sat(_round(v))
     if isinstance(v, str):
         try:
-            return _round(float(v))
-        except ValueError:
+            return _sat(_round(float(v)))
+        except (ValueError, OverflowError):
             return 0
     return 0
 
