@@ -1,0 +1,203 @@
+"""Scalar-function catalog tranche (reference: tidb_query_expr impl_math.rs /
+impl_op.rs / impl_string.rs / impl_compare.rs / impl_misc.rs): CPU oracle
+checks, and device agreement for the xp-generic (numeric) kernels."""
+
+import numpy as np
+import pytest
+
+from tikv_tpu.copr.datatypes import EvalType
+from tikv_tpu.copr.kernels import KERNELS
+from tikv_tpu.copr.rpn import call, col, compile_expr, const_bytes, const_int, const_real, eval_rpn
+
+
+def _run(expr, columns=None, n=1, schema=()):
+    rpn = compile_expr(expr, list(schema))
+    return eval_rpn(rpn, columns or {}, n, xp=np)
+
+
+def test_math_tranche():
+    d, _ = _run(call("log2", const_real(8.0)))
+    assert d[0] == 3.0
+    d, _ = _run(call("log10", const_real(1000.0)))
+    assert d[0] == 3.0
+    d, _ = _run(call("atan2", const_real(1.0), const_real(1.0)))
+    assert abs(d[0] - 0.7853981633974483) < 1e-12
+    d, nl = _run(call("cot", const_real(0.0)))
+    assert nl[0]  # cot(0) -> NULL (division by zero)
+    d, _ = _run(call("radians", const_real(180.0)))
+    assert abs(d[0] - 3.141592653589793) < 1e-12
+    d, _ = _run(call("degrees", const_real(3.141592653589793)))
+    assert abs(d[0] - 180.0) < 1e-9
+    d, _ = _run(call("sign", const_real(-2.5)))
+    assert d[0] == -1
+    # MySQL ROUND: half away from zero, also for negatives
+    d, _ = _run(call("round_real", const_real(2.5)))
+    assert d[0] == 3.0
+    d, _ = _run(call("round_real", const_real(-2.5)))
+    assert d[0] == -3.0
+    d, _ = _run(call("round_real_frac", const_real(3.14159), const_int(2)))
+    assert d[0] == 3.14
+    d, _ = _run(call("truncate_real_frac", const_real(3.199), const_int(2)))
+    assert abs(d[0] - 3.19) < 1e-12
+
+
+def test_bit_ops():
+    d, _ = _run(call("bit_and", const_int(0b1100), const_int(0b1010)))
+    assert d[0] == 0b1000
+    d, _ = _run(call("bit_or", const_int(0b1100), const_int(0b1010)))
+    assert d[0] == 0b1110
+    d, _ = _run(call("bit_xor", const_int(0b1100), const_int(0b1010)))
+    assert d[0] == 0b0110
+    d, _ = _run(call("bit_neg", const_int(0)))
+    assert d[0] == -1  # ~0 = u64 max bit pattern
+    d, _ = _run(call("left_shift", const_int(1), const_int(10)))
+    assert d[0] == 1024
+    d, _ = _run(call("left_shift", const_int(1), const_int(64)))
+    assert d[0] == 0  # MySQL: shift >= 64 -> 0
+    d, _ = _run(call("right_shift", const_int(-1), const_int(60)))
+    assert d[0] == 15  # logical shift on the u64 pattern
+
+
+def test_greatest_least():
+    d, _ = _run(call("greatest", const_int(3), const_int(9), const_int(5)))
+    assert d[0] == 9
+    d, _ = _run(call("least", const_real(3.5), const_real(-1.0)))
+    assert d[0] == -1.0
+    d, nl = _run(call("greatest", const_int(3), const_int(None)))
+    assert nl[0]  # NULL if any operand NULL
+
+
+def test_string_tranche():
+    d, _ = _run(call("lpad", const_bytes(b"5"), const_int(3), const_bytes(b"0")))
+    assert d[0] == b"005"
+    d, _ = _run(call("rpad", const_bytes(b"ab"), const_int(5), const_bytes(b"xy")))
+    assert d[0] == b"abxyx"
+    d, nl = _run(call("lpad", const_bytes(b"a"), const_int(5), const_bytes(b"")))
+    assert nl[0]  # empty pad, n > len -> NULL
+    d, _ = _run(call("repeat", const_bytes(b"ab"), const_int(3)))
+    assert d[0] == b"ababab"
+    d, _ = _run(call("space", const_int(4)))
+    assert d[0] == b"    "
+    d, _ = _run(call("strcmp", const_bytes(b"a"), const_bytes(b"b")))
+    assert d[0] == -1
+    d, _ = _run(call("instr", const_bytes(b"foobar"), const_bytes(b"bar")))
+    assert d[0] == 4
+    d, _ = _run(call("char_length", const_bytes("héllo".encode())))
+    assert d[0] == 6  # binary-collation semantics: byte length (reference)
+    d, _ = _run(call("char_length_utf8", const_bytes("héllo".encode())))
+    assert d[0] == 5  # character count
+    d, _ = _run(call("crc32", const_bytes(b"MySQL")))
+    assert d[0] == 3259397556  # known MySQL doc value
+    d, _ = _run(call("find_in_set", const_bytes(b"b"), const_bytes(b"a,b,c")))
+    assert d[0] == 2
+    d, _ = _run(call("substring_index", const_bytes(b"www.mysql.com"), const_bytes(b"."), const_int(2)))
+    assert d[0] == b"www.mysql"
+    d, _ = _run(call("substring_index", const_bytes(b"www.mysql.com"), const_bytes(b"."), const_int(-2)))
+    assert d[0] == b"mysql.com"
+    d, _ = _run(call("elt", const_int(2), const_bytes(b"x"), const_bytes(b"y")))
+    assert d[0] == b"y"
+    d, nl = _run(call("elt", const_int(5), const_bytes(b"x"), const_bytes(b"y")))
+    assert nl[0]
+    d, _ = _run(call("field", const_bytes(b"b"), const_bytes(b"a"), const_bytes(b"b")))
+    assert d[0] == 2
+    d, _ = _run(call("oct_int", const_int(12)))
+    assert d[0] == b"14"
+    d, _ = _run(call("bin_int", const_int(12)))
+    assert d[0] == b"1100"
+    d, _ = _run(call("unhex", const_bytes(b"4D7953514C")))
+    assert d[0] == b"MySQL"
+    d, nl = _run(call("unhex", const_bytes(b"zz")))
+    assert nl[0]  # invalid hex -> NULL
+    d, _ = _run(call("to_base64", const_bytes(b"abc")))
+    assert d[0] == b"YWJj"
+    d, _ = _run(call("from_base64", const_bytes(b"YWJj")))
+    assert d[0] == b"abc"
+    d, _ = _run(call("md5", const_bytes(b"testing")))
+    assert d[0] == b"ae2b1fca515949e5d54fb22b8ed95575"
+    d, _ = _run(call("sha1", const_bytes(b"abc")))
+    assert d[0] == b"a9993e364706816aba3e25717850c26c9cd0d89d"
+    d, _ = _run(call("sha2", const_bytes(b"abc"), const_int(256)))
+    assert d[0] == b"ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad"
+    d, nl = _run(call("sha2", const_bytes(b"abc"), const_int(123)))
+    assert nl[0]  # invalid length -> NULL
+
+
+def test_inet():
+    d, _ = _run(call("inet_aton", const_bytes(b"10.0.5.9")))
+    assert d[0] == 167773449
+    d, _ = _run(call("inet_aton", const_bytes(b"127.1")))  # MySQL short form
+    assert d[0] == (127 << 24) | 1
+    d, nl = _run(call("inet_aton", const_bytes(b"not.an.ip")))
+    assert nl[0]
+    d, _ = _run(call("inet_ntoa", const_int(167773449)))
+    assert d[0] == b"10.0.5.9"
+    d, nl = _run(call("inet_ntoa", const_int(2**40)))
+    assert nl[0]
+
+
+def test_numeric_tranche_device_agrees_with_cpu():
+    """The xp-generic kernels must produce identical results under jax.numpy
+    (CPU backend) — the one-kernel-table invariant."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    vals = np.array([-3.7, -0.5, 0.0, 0.5, 2.5, 9.99], dtype=np.float64)
+    ints = np.array([-8, -1, 0, 1, 7, 63], dtype=np.int64)
+    fcols = (vals, np.zeros(6, dtype=bool))
+    icols = (ints, np.zeros(6, dtype=bool))
+    for op, args in [
+        ("round_real", [fcols]),
+        ("sign", [fcols]),
+        ("radians", [fcols]),
+        ("degrees", [fcols]),
+        ("bit_neg", [icols]),
+        ("left_shift", [icols, (np.full(6, 3, dtype=np.int64), np.zeros(6, dtype=bool))]),
+        ("greatest", [icols, (np.full(6, 2, dtype=np.int64), np.zeros(6, dtype=bool))]),
+    ]:
+        _, _, fn = KERNELS[op]
+        dc, nc = fn(np, *args)
+        jargs = [(jnp.asarray(d), jnp.asarray(nl)) for d, nl in args]
+        dj, nj = fn(jnp, *jargs)
+        np.testing.assert_array_equal(np.asarray(dc), np.asarray(dj), err_msg=op)
+        np.testing.assert_array_equal(np.asarray(nc), np.asarray(nj), err_msg=op)
+
+
+def test_catalog_review_fixes():
+    # FIELD never NULL; NULL candidates skipped
+    d, nl = _run(call("field", const_bytes(None), const_bytes(b"a")))
+    assert d[0] == 0 and not nl[0]
+    d, nl = _run(call("field", const_bytes(b"b"), const_bytes(b"a"), const_bytes(None), const_bytes(b"b")))
+    assert d[0] == 3 and not nl[0]
+    # ELT: unselected NULL candidate doesn't null the row
+    d, nl = _run(call("elt", const_int(1), const_bytes(b"x"), const_bytes(None)))
+    assert d[0] == b"x" and not nl[0]
+    d, nl = _run(call("elt", const_int(2), const_bytes(b"x"), const_bytes(None)))
+    assert nl[0]
+    # pads/space/repeat refuse blob-width bombs with NULL, no allocation
+    d, nl = _run(call("space", const_int(10**12)))
+    assert nl[0]
+    d, nl = _run(call("lpad", const_bytes(b"a"), const_int(10**9), const_bytes(b" ")))
+    assert nl[0]
+    d, nl = _run(call("repeat", const_bytes(b"ab"), const_int(10**9)))
+    assert nl[0]
+    # from_base64 reference semantics
+    d, nl = _run(call("from_base64", const_bytes(b"abc")))
+    assert d[0] == b"" and not nl[0]  # bad length -> empty
+    d, _ = _run(call("from_base64", const_bytes(b"YWJj\n")))
+    assert d[0] == b"abc"  # whitespace stripped
+    d, nl = _run(call("from_base64", const_bytes(b"Y!Jj")))
+    assert nl[0]  # invalid chars -> NULL
+    # inet_aton strictness
+    d, nl = _run(call("inet_aton", const_bytes(b"+1.2.3.4")))
+    assert nl[0]
+    d, nl = _run(call("inet_aton", const_bytes(b"1..2")))
+    assert d[0] == 16777218 and not nl[0]
+    d, nl = _run(call("inet_aton", const_bytes(b"1.2.3.")))
+    assert nl[0]
+    # n-ary decimal alignment: greatest over mixed fracs compares VALUES
+    from tikv_tpu.copr.rpn import const_decimal
+
+    d, _ = _run(call("greatest", const_decimal(150, 2), const_decimal(21, 1), const_decimal(33, 2)))
+    assert d[0] == 210  # 2.1 at frac 2

@@ -335,9 +335,14 @@ def _bytes_op(name, arity, rkind):
                 nulls = nulls | nl
             n = len(datas[0])
             out = _np.empty(n, dtype=object)
+            rnull = _np.asarray(nulls).copy()
             for i in range(n):
-                out[i] = fn(*[d[i] for d in datas])
-            return out, nulls
+                r = b"" if rnull[i] else fn(*[d[i] for d in datas])
+                if r is None:  # per-row SQL NULL (e.g. invalid input)
+                    rnull[i] = True
+                    r = b""
+                out[i] = r
+            return out, rnull
 
         KERNELS[name] = (arity, rkind, wrapped)
         return fn
@@ -434,6 +439,331 @@ def _like_regex(pattern: bytes):
 
 
 _int_bytes_op("like", 2)(lambda s, pat: 1 if _like_regex(pat).match(s) else 0)
+
+
+# -- math catalog (impl_math.rs / impl_op.rs) ------------------------------
+
+_realfn("log2", lambda xp: xp.log2)
+_realfn("log10", lambda xp: xp.log10)
+_realfn("asin", lambda xp: xp.arcsin)
+_realfn("acos", lambda xp: xp.arccos)
+_realfn("atan", lambda xp: xp.arctan)
+
+
+@_reg("atan2", 2, "real")
+def _atan2(xp, a, b):
+    (ad, an), (bd, bn) = a, b
+    return xp.arctan2(ad, bd), an | bn
+
+
+@_reg("cot", 1, "real")
+def _cot(xp, a):
+    ad, an = a
+    t = xp.tan(ad)
+    zero = t == 0
+    safe = xp.where(zero, xp.ones_like(t), t)
+    return 1.0 / safe, an | zero
+
+
+@_reg("radians", 1, "real")
+def _radians(xp, a):
+    ad, an = a
+    return ad * (3.141592653589793 / 180.0), an
+
+
+@_reg("degrees", 1, "real")
+def _degrees(xp, a):
+    ad, an = a
+    return ad * (180.0 / 3.141592653589793), an
+
+
+@_reg("sign", 1, "int")
+def _sign(xp, a):
+    ad, an = a
+    return xp.sign(ad).astype("int64"), an
+
+
+@_reg("round_real", 1, "real")
+def _round_real(xp, a):
+    ad, an = a
+    # MySQL rounds half away from zero (NOT banker's rounding)
+    return xp.where(ad >= 0, xp.floor(ad + 0.5), xp.ceil(ad - 0.5)), an
+
+
+@_reg("round_real_frac", 2, "real")
+def _round_real_frac(xp, a, b):
+    (ad, an), (bd, bn) = a, b
+    m = xp.power(10.0, bd.astype("float64"))
+    scaled = ad * m
+    r = xp.where(scaled >= 0, xp.floor(scaled + 0.5), xp.ceil(scaled - 0.5))
+    return r / m, an | bn
+
+
+@_reg("truncate_real_frac", 2, "real")
+def _truncate_real_frac(xp, a, b):
+    (ad, an), (bd, bn) = a, b
+    m = xp.power(10.0, bd.astype("float64"))
+    return xp.trunc(ad * m) / m, an | bn
+
+
+# -- bit operators (impl_op.rs: results are u64 in MySQL; kept as the i64
+# bit pattern on 64-bit lanes) ----------------------------------------------
+
+@_reg("bit_and", 2, "int")
+def _bit_and(xp, a, b):
+    (ad, an), (bd, bn) = a, b
+    return ad & bd, an | bn
+
+
+@_reg("bit_or", 2, "int")
+def _bit_or(xp, a, b):
+    (ad, an), (bd, bn) = a, b
+    return ad | bd, an | bn
+
+
+@_reg("bit_xor", 2, "int")
+def _bit_xor(xp, a, b):
+    (ad, an), (bd, bn) = a, b
+    return ad ^ bd, an | bn
+
+
+@_reg("bit_neg", 1, "int")
+def _bit_neg(xp, a):
+    ad, an = a
+    return ~ad, an
+
+
+@_reg("left_shift", 2, "int")
+def _left_shift(xp, a, b):
+    (ad, an), (bd, bn) = a, b
+    big = (bd >= 64) | (bd < 0)  # MySQL: shift ≥64 yields 0
+    safe = xp.where(big, xp.zeros_like(bd), bd)
+    return xp.where(big, xp.zeros_like(ad), ad << safe), an | bn
+
+
+@_reg("right_shift", 2, "int")
+def _right_shift(xp, a, b):
+    (ad, an), (bd, bn) = a, b
+    big = (bd >= 64) | (bd < 0)
+    safe = xp.where(big, xp.zeros_like(bd), bd)
+    # logical shift on the u64 bit pattern, like MySQL >>
+    shifted = (ad.astype("uint64") >> safe.astype("uint64")).astype("int64")
+    return xp.where(big, xp.zeros_like(ad), shifted), an | bn
+
+
+# -- greatest/least (impl_compare.rs; variadic, null if ANY operand null) ---
+
+def _extreme(is_max):
+    def fn(xp, *args):
+        data, nulls = args[0]
+        for d, nl in args[1:]:
+            data = xp.maximum(data, d) if is_max else xp.minimum(data, d)
+            nulls = nulls | nl
+        return data, nulls
+
+    return fn
+
+
+KERNELS["greatest"] = (-1, "same", _extreme(True))
+KERNELS["least"] = (-1, "same", _extreme(False))
+
+
+# -- string catalog additions (impl_string.rs; CPU-only) --------------------
+
+import base64 as _b64
+import hashlib as _hashlib
+import zlib as _zlib
+
+
+_MAX_BLOB_WIDTH = 16 * 1024 * 1024  # validate_target_len_for_pad / space cap
+
+
+def _pad(left):
+    def fn(s_, n, pad):
+        n = int(n)
+        # NULL on negative/oversize target or empty pad that would be needed
+        if n < 0 or n > _MAX_BLOB_WIDTH or (len(s_) < n and not pad):
+            return None
+        if n <= len(s_):
+            return s_[:n]
+        fill = (pad * ((n - len(s_)) // len(pad) + 1))[: n - len(s_)]
+        return fill + s_ if left else s_ + fill
+
+    return fn
+
+
+_bytes_op("lpad", 3, "bytes")(_pad(True))
+_bytes_op("rpad", 3, "bytes")(_pad(False))
+_bytes_op("repeat", 2, "bytes")(
+    lambda s_, n: None if len(s_) * max(int(n), 0) > _MAX_BLOB_WIDTH else s_ * max(int(n), 0)
+)
+_bytes_op("space", 1, "bytes")(
+    lambda n: None if int(n) > _MAX_BLOB_WIDTH else b" " * max(int(n), 0)
+)
+_int_bytes_op("strcmp", 2)(lambda a, b: (a > b) - (a < b))
+_int_bytes_op("instr", 2)(lambda s_, sub: s_.find(sub) + 1)
+# the reference has TWO signatures: char_length over binary strings is byte
+# length; char_length_utf8 counts characters (impl_string.rs:880)
+_int_bytes_op("char_length", 1)(lambda s_: len(s_))
+_int_bytes_op("char_length_utf8", 1)(lambda s_: len(s_.decode("utf-8", "replace")))
+_int_bytes_op("crc32", 1)(lambda s_: _zlib.crc32(s_))
+_int_bytes_op("find_in_set", 2)(
+    lambda s_, set_: 0 if b"," in s_ else (set_.split(b",").index(s_) + 1 if s_ in set_.split(b",") else 0)
+)
+_bytes_op("oct_int", 1, "bytes")(lambda n: oct(int(n) & (2**64 - 1))[2:].encode())
+_bytes_op("bin_int", 1, "bytes")(lambda n: bin(int(n) & (2**64 - 1))[2:].encode())
+def _unhex(s_):
+    try:
+        t = s_.decode()
+        return bytes.fromhex(t if len(t) % 2 == 0 else "0" + t)
+    except (ValueError, UnicodeDecodeError):
+        return None  # MySQL: invalid hex -> NULL
+
+
+_bytes_op("unhex", 1, "bytes")(_unhex)
+_bytes_op("to_base64", 1, "bytes")(lambda s_: _b64.b64encode(s_))
+
+
+def _from_base64(s_):
+    # reference semantics (impl_string.rs from_base64): whitespace stripped
+    # first; bad length -> empty string; invalid characters -> NULL
+    t = bytes(c for c in s_ if c not in b" \t\r\n")
+    if len(t) % 4 != 0:
+        return b""
+    try:
+        return _b64.b64decode(t, validate=True)
+    except Exception:
+        return None
+
+
+_bytes_op("from_base64", 1, "bytes")(_from_base64)
+_bytes_op("md5", 1, "bytes")(lambda s_: _hashlib.md5(s_).hexdigest().encode())
+_bytes_op("sha1", 1, "bytes")(lambda s_: _hashlib.sha1(s_).hexdigest().encode())
+_bytes_op("sha2", 2, "bytes")(
+    lambda s_, n: {
+        0: _hashlib.sha256, 224: _hashlib.sha224, 256: _hashlib.sha256,
+        384: _hashlib.sha384, 512: _hashlib.sha512,
+    }[int(n)](s_).hexdigest().encode()
+    if int(n) in (0, 224, 256, 384, 512)
+    else None
+)
+
+
+def _substring_index(s_, delim, count):
+    count = int(count)
+    if not delim or count == 0:
+        return b""
+    parts = s_.split(delim)
+    if count > 0:
+        return delim.join(parts[:count])
+    return delim.join(parts[count:])
+
+
+_bytes_op("substring_index", 3, "bytes")(_substring_index)
+
+
+def _elt_kernel(xp, *args):
+    """ELT(n, s1, s2, ...): only the SELECTED candidate's null matters
+    (impl_string.rs elt) — a NULL in an unselected slot must not null the
+    row, so this kernel handles its own masks."""
+    nd, nn = args[0]
+    cnt = len(args) - 1
+    n = len(nd)
+    out = _np.empty(n, dtype=object)
+    rnull = _np.zeros(n, dtype=bool)
+    for i in range(n):
+        out[i] = b""
+        if nn[i]:
+            rnull[i] = True
+            continue
+        k = int(nd[i])
+        if not 1 <= k <= cnt:
+            rnull[i] = True
+            continue
+        cd, cn = args[k]
+        if cn[i]:
+            rnull[i] = True
+        else:
+            out[i] = cd[i]
+    return out, rnull
+
+
+KERNELS["elt"] = (-1, "bytes", _elt_kernel)
+
+
+def _field_kernel(xp, *args):
+    """FIELD(s, c1, c2, ...) never returns NULL: a NULL subject yields 0 and
+    NULL candidates are skipped (impl_string.rs field_bytes)."""
+    sd, sn = args[0]
+    n = len(sd)
+    out = _np.zeros(n, dtype=_np.int64)
+    for i in range(n):
+        if sn[i]:
+            continue
+        for j in range(1, len(args)):
+            cd, cn = args[j]
+            if not cn[i] and cd[i] == sd[i]:
+                out[i] = j
+                break
+    return out, _np.zeros(n, dtype=bool)
+
+
+KERNELS["field"] = (-1, "int", _field_kernel)
+
+# inet helpers (impl_misc.rs)
+import ipaddress as _ip
+
+
+def _inet_aton(s_):
+    # strictly digits and dots (impl_miscellaneous.rs inet_aton): '+1.2.3.4',
+    # ' 1.2.3.4', '1_0.0.0.1' are invalid; empty MIDDLE groups mean 0
+    # ('1..2' = 16777218) but a trailing dot is invalid
+    try:
+        t = s_.decode()
+    except UnicodeDecodeError:
+        return None
+    if not t or t.endswith(".") or any(c not in "0123456789." for c in t):
+        return None
+    parts = t.split(".")
+    if len(parts) > 4:
+        return None
+    nums = [int(x) if x else 0 for x in parts]
+    if any(x > 255 for x in nums):
+        return None
+    # short forms: a.b -> a<<24|b, a.b.c -> a<<24|b<<16|c (MySQL rule)
+    nums = nums[:-1] + [0] * (4 - len(parts)) + [nums[-1]]
+    return (nums[0] << 24) | (nums[1] << 16) | (nums[2] << 8) | nums[3]
+
+
+def _reg_nullable_int(name, arity, fn):
+    """bytes-input kernel returning INT where a per-row None result means
+    SQL NULL (unlike _int_bytes_op, which cannot signal new nulls)."""
+
+    def wrapped(xp, *args):
+        datas = [a[0] for a in args]
+        nulls = args[0][1]
+        for _, nl in args[1:]:
+            nulls = nulls | nl
+        n = len(datas[0])
+        out = _np.zeros(n, dtype=_np.int64)
+        rnull = _np.asarray(nulls).copy()
+        for i in range(n):
+            if rnull[i]:
+                continue
+            r = fn(*[d[i] for d in datas])
+            if r is None:
+                rnull[i] = True
+            else:
+                out[i] = r
+        return out, rnull
+
+    KERNELS[name] = (arity, "int", wrapped)
+
+
+_reg_nullable_int("inet_aton", 1, _inet_aton)
+_bytes_op("inet_ntoa", 1, "bytes")(
+    lambda n: str(_ip.IPv4Address(int(n))).encode() if 0 <= int(n) <= 0xFFFFFFFF else None
+)
 
 
 # -- collation-aware string kernels (collation.py sort keys) ---------------

@@ -143,8 +143,9 @@ def _infer(op: str, rkind: str, child_types) -> tuple[EvalType, int, tuple[int, 
         # scaled(a*b) = scaled(a)*scaled(b), frac adds — no rescale needed
         return EvalType.DECIMAL, sum(f for t, f in child_types if t == EvalType.DECIMAL), scale_by
 
-    if has_decimal and rkind in ("same", "int") and len(child_types) == 2:
-        # align fracs for +,-,comparisons,mod
+    if has_decimal and rkind in ("same", "int") and len(child_types) >= 2:
+        # align fracs for +,-,comparisons,mod — and n-ary value comparisons
+        # (greatest/least/in), where unaligned scaled ints would compare wrong
         f = max(fracs)
         scale_by = tuple(10 ** (f - fi) for fi in fracs)
         if rkind == "int":
