@@ -201,3 +201,27 @@ def test_catalog_review_fixes():
 
     d, _ = _run(call("greatest", const_decimal(150, 2), const_decimal(21, 1), const_decimal(33, 2)))
     assert d[0] == 210  # 2.1 at frac 2
+
+
+def test_catalog_review_fixes_round2():
+    # domain NaN -> NULL
+    d, nl = _run(call("log2", const_real(-1.0)))
+    assert nl[0]
+    d, nl = _run(call("asin", const_real(2.0)))
+    assert nl[0]
+    d, nl = _run(call("asin", const_real(0.5)))
+    assert not nl[0]
+    # f64::round edge: 0.49999999999999994 rounds DOWN (floor(x+0.5) lies)
+    d, _ = _run(call("round_real", const_real(0.49999999999999994)))
+    assert d[0] == 0.0
+    d, _ = _run(call("round_real", const_real(-2.5)))
+    assert d[0] == -3.0
+    # reference divides by 10^-d: ROUND(0.35, 1) = 0.30000000000000004
+    d, _ = _run(call("round_real_frac", const_real(0.35), const_int(1)))
+    assert d[0] == 0.30000000000000004
+    # empty list
+    d, _ = _run(call("find_in_set", const_bytes(b""), const_bytes(b"")))
+    assert d[0] == 0
+    # form feed stripped in from_base64
+    d, _ = _run(call("from_base64", const_bytes(b"YWJj\x0c")))
+    assert d[0] == b"abc"

@@ -159,18 +159,62 @@ def _np_dtype(et: EvalType):
 
 
 _ONEHOT_CAPACITY_MAX = 64
+_MATMUL_CAPACITY_MAX = 4096
+_EXTREME_MASK_CAPACITY_MAX = 1024
+
+
+def _limb_matmul_seg_sum(x, gids, capacity: int):
+    """Exact int64 per-group sums on the MXU: TPU scatter is ~1000× slower
+    than reductions, so instead split each value into b-bit limbs, one-hot
+    matmul every limb in a single (C×n)@(n×L) dot — systolic-array work —
+    and reassemble with two's-complement wraparound.  Logical shifts make
+    the limbs sign-free, so negative values round-trip exactly.
+
+    b ≤ 8 is load-bearing: the TPU MXU's default precision truncates f32
+    operands to bf16 (8 mantissa bits), so limbs must stay ≤ 2^8 to survive
+    that pass bit-exact; products then accumulate in f32, exact while
+    (2^b−1)·n < 2^24.  Callers guarantee n < 2^16 (block sizes)."""
+    n = x.shape[0]
+    bits = 8
+    while bits > 1 and (2**bits - 1) * n >= 2**24:
+        bits -= 1
+    if (2**bits - 1) * n >= 2**24:  # n ≥ 2^23: exactness unattainable
+        return jax.ops.segment_sum(x, gids, num_segments=capacity)
+    n_limbs = -(-64 // bits)
+    mask = jnp.int64((1 << bits) - 1)
+    onehot = (gids[:, None] == jnp.arange(capacity, dtype=gids.dtype)[None, :]).astype(
+        jnp.float32
+    )
+    limbs = jnp.stack(
+        [
+            (jax.lax.shift_right_logical(x, jnp.int64(k * bits)) & mask).astype(jnp.float32)
+            for k in range(n_limbs)
+        ],
+        axis=1,
+    )
+    sums = jax.lax.dot_general(
+        onehot, limbs, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )  # C×L, every entry an exact integer < 2^24
+    acc = jnp.zeros(capacity, dtype=jnp.int64)
+    for k in range(n_limbs):
+        acc = acc + (sums[:, k].astype(jnp.int64) << (k * bits))
+    return acc
 
 
 def _seg_sum(x, gids, capacity: int):
     """Exact per-group sum avoiding TPU scatter: capacity 1 is a plain
     reduction; small capacities use a broadcast-compare mask reduction (VPU
-    work, ~n·C lanes); only large capacities fall back to scatter-based
-    segment_sum."""
+    work, ~n·C lanes); int64 up to 4096 groups rides the MXU via limb
+    matmuls; only float sums at large capacities fall back to scatter-based
+    segment_sum (f32 matmul would diverge from the CPU oracle's f64 sums
+    beyond the last-ulp exemption)."""
     if capacity == 1:
         return jnp.sum(x).reshape(1)
     if capacity <= _ONEHOT_CAPACITY_MAX:
         onehot = gids[:, None] == jnp.arange(capacity, dtype=gids.dtype)[None, :]
         return jnp.sum(jnp.where(onehot, x[:, None], jnp.zeros((), dtype=x.dtype)), axis=0)
+    if x.dtype == jnp.int64 and capacity <= _MATMUL_CAPACITY_MAX:
+        return _limb_matmul_seg_sum(x, gids, capacity)
     return jax.ops.segment_sum(x, gids, num_segments=capacity)
 
 
@@ -178,7 +222,8 @@ def _seg_extreme(x, gids, capacity: int, is_min: bool, identity):
     if capacity == 1:
         f = jnp.min if is_min else jnp.max
         return f(x).reshape(1)
-    if capacity <= _ONEHOT_CAPACITY_MAX:
+    if capacity <= _EXTREME_MASK_CAPACITY_MAX:
+        # n×C masked reduce: pure VPU work, still far cheaper than scatter
         onehot = gids[:, None] == jnp.arange(capacity, dtype=gids.dtype)[None, :]
         masked = jnp.where(onehot, x[:, None], jnp.full((), identity, dtype=x.dtype))
         return (jnp.min if is_min else jnp.max)(masked, axis=0)

@@ -443,10 +443,23 @@ _int_bytes_op("like", 2)(lambda s, pat: 1 if _like_regex(pat).match(s) else 0)
 
 # -- math catalog (impl_math.rs / impl_op.rs) ------------------------------
 
-_realfn("log2", lambda xp: xp.log2)
-_realfn("log10", lambda xp: xp.log10)
-_realfn("asin", lambda xp: xp.arcsin)
-_realfn("acos", lambda xp: xp.arccos)
+def _realfn_dom(name, f):
+    """Real function with a restricted domain: NaN results become SQL NULL
+    (the reference's Real::new(..).ok() mapping)."""
+
+    @_reg(name, 1, "real")
+    def fn(xp, a, _f=f):
+        ad, an = a
+        r = _f(xp)(ad)
+        return r, an | xp.isnan(r)
+
+    return fn
+
+
+_realfn_dom("log2", lambda xp: xp.log2)
+_realfn_dom("log10", lambda xp: xp.log10)
+_realfn_dom("asin", lambda xp: xp.arcsin)
+_realfn_dom("acos", lambda xp: xp.arccos)
 _realfn("atan", lambda xp: xp.arctan)
 
 
@@ -483,27 +496,35 @@ def _sign(xp, a):
     return xp.sign(ad).astype("int64"), an
 
 
+def _round_half_away(xp, v):
+    # MySQL/Rust f64::round: half away from zero — floor(v+0.5) is WRONG at
+    # e.g. 0.49999999999999994 (v+0.5 rounds up to 1.0); use banker's round
+    # for non-halves and fix the exact halves
+    t = xp.trunc(v)
+    is_half = xp.abs(v - t) == 0.5
+    return xp.where(is_half, t + xp.sign(v), xp.round(v))
+
+
 @_reg("round_real", 1, "real")
 def _round_real(xp, a):
     ad, an = a
-    # MySQL rounds half away from zero (NOT banker's rounding)
-    return xp.where(ad >= 0, xp.floor(ad + 0.5), xp.ceil(ad - 0.5)), an
+    return _round_half_away(xp, ad), an
 
 
 @_reg("round_real_frac", 2, "real")
 def _round_real_frac(xp, a, b):
     (ad, an), (bd, bn) = a, b
-    m = xp.power(10.0, bd.astype("float64"))
-    scaled = ad * m
-    r = xp.where(scaled >= 0, xp.floor(scaled + 0.5), xp.ceil(scaled - 0.5))
-    return r / m, an | bn
+    # the reference divides by 10^-d (round_with_frac_real) — multiplying by
+    # 10^d rounds differently in f64 (0.35*10 = 3.5 but 0.35/0.1 = 3.4999…)
+    p = xp.power(10.0, -bd.astype("float64"))
+    return _round_half_away(xp, ad / p) * p, an | bn
 
 
 @_reg("truncate_real_frac", 2, "real")
 def _truncate_real_frac(xp, a, b):
     (ad, an), (bd, bn) = a, b
-    m = xp.power(10.0, bd.astype("float64"))
-    return xp.trunc(ad * m) / m, an | bn
+    p = xp.power(10.0, -bd.astype("float64"))
+    return xp.trunc(ad / p) * p, an | bn
 
 
 # -- bit operators (impl_op.rs: results are u64 in MySQL; kept as the i64
@@ -594,8 +615,11 @@ def _pad(left):
 
 _bytes_op("lpad", 3, "bytes")(_pad(True))
 _bytes_op("rpad", 3, "bytes")(_pad(False))
+# repeat: the reference has no blob cap (clamps count to i32::MAX and
+# allocates); we keep a 64MB max_allowed_packet-style NULL guard — a
+# deliberate deviation so one request cannot allocate unbounded memory
 _bytes_op("repeat", 2, "bytes")(
-    lambda s_, n: None if len(s_) * max(int(n), 0) > _MAX_BLOB_WIDTH else s_ * max(int(n), 0)
+    lambda s_, n: None if len(s_) * max(int(n), 0) > 4 * _MAX_BLOB_WIDTH else s_ * max(int(n), 0)
 )
 _bytes_op("space", 1, "bytes")(
     lambda n: None if int(n) > _MAX_BLOB_WIDTH else b" " * max(int(n), 0)
@@ -608,7 +632,8 @@ _int_bytes_op("char_length", 1)(lambda s_: len(s_))
 _int_bytes_op("char_length_utf8", 1)(lambda s_: len(s_.decode("utf-8", "replace")))
 _int_bytes_op("crc32", 1)(lambda s_: _zlib.crc32(s_))
 _int_bytes_op("find_in_set", 2)(
-    lambda s_, set_: 0 if b"," in s_ else (set_.split(b",").index(s_) + 1 if s_ in set_.split(b",") else 0)
+    lambda s_, set_: 0 if (b"," in s_ or not set_)  # empty list -> 0
+    else (set_.split(b",").index(s_) + 1 if s_ in set_.split(b",") else 0)
 )
 _bytes_op("oct_int", 1, "bytes")(lambda n: oct(int(n) & (2**64 - 1))[2:].encode())
 _bytes_op("bin_int", 1, "bytes")(lambda n: bin(int(n) & (2**64 - 1))[2:].encode())
@@ -627,7 +652,7 @@ _bytes_op("to_base64", 1, "bytes")(lambda s_: _b64.b64encode(s_))
 def _from_base64(s_):
     # reference semantics (impl_string.rs from_base64): whitespace stripped
     # first; bad length -> empty string; invalid characters -> NULL
-    t = bytes(c for c in s_ if c not in b" \t\r\n")
+    t = bytes(c for c in s_ if c not in b" \t\r\n\x0b\x0c")
     if len(t) % 4 != 0:
         return b""
     try:
