@@ -53,9 +53,16 @@ def test_supports_routing():
             ]
         )
     )
-    # TopN without agg stays on CPU
-    assert not supports(
+    # raw TopN over numeric schemas IS device-routable (running top-K merge)
+    assert supports(
         DagRequest(executors=[TableScan(TABLE_ID, NUMERIC_COLS), TopN([(col(1), False)], 5)])
+    )
+    # …but not with bytes payload columns or oversized K
+    assert not supports(
+        DagRequest(executors=[TableScan(TABLE_ID, NUMERIC_COLS), TopN([(col(1), False)], 100000)])
+    )
+    assert not supports(
+        DagRequest(executors=[TableScan(TABLE_ID, PRODUCT_COLUMNS), TopN([(col(0), False)], 5)])
     )
     # bytes predicate stays on CPU
     assert not supports(
@@ -374,3 +381,110 @@ def test_limb_matmul_seg_sum_exact():
     np.add.at(expect2, gids2, vals2)
     got3 = np.asarray(_limb_matmul_seg_sum(jnp.asarray(vals2), jnp.asarray(gids2), 128))
     np.testing.assert_array_equal(got3, expect2)
+
+
+def test_raw_topn_identical():
+    """Device running top-K merge vs CPU BatchTopNExecutor — byte identity
+    across asc/desc, multi-key, selection, ties, and K > matching rows."""
+    for order_by, sel, k in [
+        ([(col(1), False)], None, 10),  # asc int
+        ([(col(1), True)], None, 10),  # desc int
+        ([(col(3), False)], None, 25),  # asc decimal
+        ([(col(2), False), (col(1), True)], None, 50),  # multi-key w/ ties
+        ([(col(1), False)], call("lt", col(2), const_int(30)), 20),  # + filter
+        ([(col(1), False)], call("lt", col(1), const_int(3)), 500),  # K > rows
+        ([(call("mod", col(1), const_int(7)), False)], None, 40),  # expr key
+    ]:
+        execs = [TableScan(TABLE_ID, NUMERIC_COLS)]
+        if sel is not None:
+            execs.append(Selection([sel]))
+        execs.append(TopN(order_by, k))
+        cpu, dev = run_both(execs, NUMERIC_KVS, block_rows=256)
+        assert cpu.encode() == dev.encode(), (order_by, sel, k)
+        if sel is None:
+            assert len(cpu.iter_rows()) == min(k, 5000)
+
+
+def test_raw_topn_with_nulls_identical():
+    """NULLs first ascending / last descending, matching the CPU comparator,
+    with ties among NULLs resolved in stream order."""
+    from tikv_tpu.copr.datatypes import ColumnInfo, FieldType, FieldTypeTp
+    from tikv_tpu.copr.table import encode_row, record_key
+
+    cols = [
+        ColumnInfo(col_id=1, ftype=FieldType.int64(), is_pk_handle=True),
+        ColumnInfo(col_id=2, ftype=FieldType(FieldTypeTp.LONGLONG)),
+        ColumnInfo(col_id=3, ftype=FieldType(FieldTypeTp.DOUBLE)),
+    ]
+    rng = np.random.default_rng(11)
+    kvs = []
+    for h in range(300):
+        iv = None if h % 7 == 0 else int(rng.integers(-50, 50))
+        fv = None if h % 11 == 0 else float(rng.normal())
+        kvs.append((record_key(TABLE_ID, h + 1), encode_row(cols[1:], [iv, fv])))
+    for order_by in [
+        [(col(1), False)],
+        [(col(1), True)],
+        [(col(2), False)],  # real key with nulls
+        [(col(2), True)],
+        [(col(1), False), (col(2), True)],
+    ]:
+        cpu, dev = run_both(
+            [TableScan(TABLE_ID, cols), TopN(order_by, 37)], kvs, block_rows=64
+        )
+        assert cpu.encode() == dev.encode(), order_by
+
+
+def test_raw_topn_extreme_values_identical():
+    """±inf / huge int64 keys survive the monotone sort-key encoding."""
+    from tikv_tpu.copr.datatypes import ColumnInfo, FieldType, FieldTypeTp
+    from tikv_tpu.copr.table import encode_row, record_key
+
+    cols = [
+        ColumnInfo(col_id=1, ftype=FieldType.int64(), is_pk_handle=True),
+        ColumnInfo(col_id=2, ftype=FieldType(FieldTypeTp.LONGLONG)),
+        ColumnInfo(col_id=3, ftype=FieldType(FieldTypeTp.DOUBLE)),
+    ]
+    vals = [
+        (2**63 - 1, float("inf")),
+        (-(2**63), float("-inf")),
+        (0, 0.0),
+        (1, 1.5),
+        (-1, -1.5),
+        (2**62, 1e308),
+        (-(2**62), -1e308),
+    ]
+    kvs = [
+        (record_key(TABLE_ID, h + 1), encode_row(cols[1:], [iv, fv]))
+        for h, (iv, fv) in enumerate(vals)
+    ]
+    for order_by in [[(col(1), False)], [(col(1), True)], [(col(2), False)], [(col(2), True)]]:
+        cpu, dev = run_both([TableScan(TABLE_ID, cols), TopN(order_by, 5)], kvs, block_rows=4)
+        assert cpu.encode() == dev.encode(), order_by
+
+
+def test_endpoint_falls_back_to_cpu_on_device_failure(monkeypatch):
+    """A device-path runtime failure (tunnel, compiler, OOM) must re-run on
+    the CPU oracle, not surface an accelerator error to the client."""
+    from tikv_tpu.copr.endpoint import CoprRequest, Endpoint
+    from tikv_tpu.copr.table import record_range
+    from tikv_tpu.storage.btree_engine import BTreeEngine
+    from tikv_tpu.storage.kv import LocalEngine
+    from tikv_tpu.storage.engine import WriteBatch
+    from tikv_tpu.storage.txn_types import Key, Write, WriteType
+
+    eng = BTreeEngine()
+    wb = WriteBatch()
+    for rk, val in NUMERIC_KVS[:50]:
+        wb.put_cf("write", Key.from_raw(rk).append_ts(11).encoded,
+                  Write(WriteType.PUT, 10, short_value=val).to_bytes())
+    eng.write(wb)
+    ep = Endpoint(LocalEngine(eng), enable_device=True)
+    dag = DagRequest(executors=[TableScan(TABLE_ID, NUMERIC_COLS), TopN([(col(1), False)], 5)])
+    req = lambda: CoprRequest(103, DagRequest(executors=dag.executors), [record_range(TABLE_ID)], 100, context={})
+    monkeypatch.setattr(
+        JaxDagEvaluator, "run", lambda self, src, cache=None: (_ for _ in ()).throw(RuntimeError("tunnel down"))
+    )
+    r = ep.handle_request(req())
+    assert not r.from_device
+    assert len(r.data) > 0

@@ -225,3 +225,28 @@ def test_catalog_review_fixes_round2():
     # form feed stripped in from_base64
     d, _ = _run(call("from_base64", const_bytes(b"YWJj\x0c")))
     assert d[0] == b"abc"
+
+
+def test_catalog_review_fixes_round3():
+    # LOG2(0)/LOG10(0): NULL, not -inf (f64_to_real is_finite gate)
+    d, nl = _run(call("log2", const_real(0.0)))
+    assert nl[0]
+    d, nl = _run(call("log10", const_real(0.0)))
+    assert nl[0]
+    # reference TRUNCATE multiplies by 10^d (asymmetric with ROUND's divide)
+    d, _ = _run(call("truncate_real_frac", const_real(0.35), const_int(1)))
+    assert d[0] == 0.2999999999999999889 or abs(d[0] - 0.3) < 1e-15
+    import numpy as _n
+
+    assert _run(call("truncate_real_frac", const_real(0.35), const_int(1)))[0][0] == _n.trunc(0.35 * 10) / 10
+    # overflow passes the value through unchanged
+    d, nl = _run(call("truncate_real_frac", const_real(1e300), const_int(10)))
+    assert d[0] == 1e300 and not nl[0]
+
+
+def test_truncate_underflow_returns_zero():
+    # reference: scaled value underflowing to 0 yields 0.0, overflow passes x
+    d, _ = _run(call("truncate_real_frac", const_real(5.0), const_int(-400)))
+    assert d[0] == 0.0
+    d, _ = _run(call("truncate_real_frac", const_real(1e-200), const_int(-200)))
+    assert d[0] == 0.0

@@ -86,20 +86,27 @@ class Endpoint:
         tracker.on_snapshot_finished()
         use_device = self.enable_device and jax_eval.supports(req.dag)
         if use_device:
-            ev = self._evaluator_for(req.dag)
-            cache = self._block_cache_for(req)
-            src = None
-            if cache is None or not cache.filled:
-                src = MvccBatchScanSource(snap, req.start_ts, req.ranges)
-            resp = ev.run(src, cache=cache)
-            scanned = src.stats.write.processed_keys if src is not None else 0
-            m = tracker.on_finish(scanned_keys=scanned, from_device=True)
-            self.slow_log.observe(tracker)
-            return CoprResponse(
-                resp.encode(), from_device=True,
-                from_cache=cache is not None and cache.filled and src is None,
-                metrics=m.to_dict(),
-            )
+            try:
+                ev = self._evaluator_for(req.dag)
+                cache = self._block_cache_for(req)
+                src = None
+                if cache is None or not cache.filled:
+                    src = MvccBatchScanSource(snap, req.start_ts, req.ranges)
+                resp = ev.run(src, cache=cache)
+                scanned = src.stats.write.processed_keys if src is not None else 0
+                m = tracker.on_finish(scanned_keys=scanned, from_device=True)
+                self.slow_log.observe(tracker)
+                return CoprResponse(
+                    resp.encode(), from_device=True,
+                    from_cache=cache is not None and cache.filled and src is None,
+                    metrics=m.to_dict(),
+                )
+            except Exception:
+                # device/runtime failure (compiler, tunnel, OOM): the CPU
+                # pipeline is the correctness oracle and always available —
+                # re-run there off the same immutable snapshot rather than
+                # surfacing an accelerator error to the client
+                pass
         stats = Statistics()
         src = MvccScanSource(snap, req.start_ts, req.ranges, statistics=stats)
         resp = BatchExecutorsRunner(req.dag, src).handle_request()

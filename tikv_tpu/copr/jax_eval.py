@@ -65,6 +65,7 @@ _ZERO_GIDS: dict[int, np.ndarray] = {}
 
 _DEVICE_AGG_OPS = {"count", "sum", "avg", "min", "max", "var_pop"}
 _DEVICE_EVAL_TYPES = {EvalType.INT, EvalType.REAL, EvalType.DECIMAL, EvalType.DATETIME, EvalType.DURATION}
+_TOPN_DEVICE_MAX = 2048  # raw TopN carries K rows of state per column
 
 
 # ---------------------------------------------------------------------------
@@ -108,10 +109,6 @@ def _analyze(dag: DagRequest) -> _Plan:
             plan.agg = e
             stage = 2
         elif isinstance(e, TopN) and plan.topn is None and plan.limit is None:
-            # TopN over raw scan output would need full row retention on
-            # device; only the post-aggregation (small) case is device-routed
-            if plan.agg is None:
-                raise _Unsupported("TopN without aggregation stays on CPU")
             plan.topn = e
             stage = 3
         elif isinstance(e, Limit) and plan.limit is None:
@@ -141,6 +138,19 @@ def _analyze(dag: DagRequest) -> _Plan:
         # so BYTES group keys are fine; exprs just need compilable kernels
         for g in plan.agg.group_by:
             compile_expr(g, schema)
+    if plan.topn is not None and plan.agg is None:
+        # raw TopN runs a device top-K merge: every schema column ships as
+        # payload, so ALL columns (not just referenced ones) must be numeric
+        if plan.topn.limit > _TOPN_DEVICE_MAX:
+            raise _Unsupported(f"TopN limit {plan.topn.limit} too large for device")
+        for et, _ in schema:
+            if et not in _DEVICE_EVAL_TYPES:
+                raise _Unsupported(f"TopN payload column type {et}")
+        for expr, _desc in plan.topn.order_by:
+            rpn = compile_expr(expr, schema)
+            _check_rpn_device(rpn, schema)
+            if rpn.eval_type not in _DEVICE_EVAL_TYPES:
+                raise _Unsupported(f"TopN key type {rpn.eval_type}")
     return plan
 
 
@@ -354,36 +364,106 @@ class _DeviceAgg:
         return st
 
 
+def _topn_key_operands(data, nulls, desc: bool):
+    """[null_rank, key] sort operands reproducing the CPU comparator
+    (_row_cmp): NULLs first ascending / last descending.  lax.sort takes
+    mixed-dtype operands, so REAL keys stay f64 (negated for desc — exact)
+    while int-family keys are int64 (bit-NOT for desc: negating INT64_MIN
+    would overflow).  No bitcasts — the TPU x64 rewriter behind the tunnel
+    compiler supports neither f64→s64 nor f64→u32.  Null rows pin the key
+    to 0 so ties among NULLs fall through to later keys / stream order,
+    exactly like the comparator's `continue`; −0 is normalized to +0 so it
+    ties +0 the way python float comparison does."""
+    if data.dtype == jnp.float64:
+        x = data + 0.0  # −0 → +0
+        kv = jnp.where(nulls, 0.0, -x if desc else x)
+    else:
+        v = data.astype(jnp.int64)
+        kv = jnp.where(nulls, jnp.int64(0), ~v if desc else v)
+    rank = jnp.where(nulls, jnp.int64(1), jnp.int64(0)) if desc else jnp.where(
+        nulls, jnp.int64(0), jnp.int64(1)
+    )
+    return [rank, kv]
+
+
+def _topn_step(sel_rpns, order_rpns, payload_cols, k, n_rows, cols, n_valid, state):
+    """One block of the running top-K merge: compute sort operands for the
+    block's rows, concatenate with the carried best-K, stable-sort
+    lexicographically (rank, key1-null, key1, key2-null, key2, …) and keep
+    the first K.  lax.sort is stable and state precedes block rows, so ties
+    resolve in global stream order — exactly the CPU executor's seq
+    tie-break.  No scatter, no gather beyond the K-slice."""
+    ridx = jnp.arange(n_rows, dtype=jnp.int64)
+    active = ridx < n_valid
+    for rpn in sel_rpns:
+        d, nl = eval_rpn(rpn, cols, n_rows, xp=jnp)
+        active = active & (d != 0) & ~nl
+    rank_blk = jnp.where(active, jnp.int64(0), jnp.int64(1))
+    operands_blk = [rank_blk]
+    for rpn, desc in order_rpns:
+        d, nl = eval_rpn(rpn, cols, n_rows, xp=jnp)
+        operands_blk += _topn_key_operands(d, nl, desc)
+    n_key_ops = len(operands_blk)
+    merged = [jnp.concatenate([s, b]) for s, b in zip(state, operands_blk)]
+    # sort ONLY the key operands plus a row index — every extra sort operand
+    # multiplies the bitonic comparator's compile cost; the K payload rows
+    # are gathered by index afterwards (tiny gather, not scatter)
+    idx = jnp.arange(k + n_rows, dtype=jnp.int64)
+    sorted_ops = jax.lax.sort(merged + [idx], num_keys=n_key_ops, is_stable=True)
+    top = [op[:k] for op in sorted_ops[:n_key_ops]]
+    top_idx = sorted_ops[n_key_ops][:k]
+    payload = []
+    pbase = n_key_ops
+    for j, ci in enumerate(payload_cols):
+        bd, bn = cols[ci]
+        sd = state[pbase + 2 * j]
+        sn = state[pbase + 2 * j + 1]
+        payload.append(jnp.concatenate([sd, bd])[top_idx])
+        payload.append(jnp.concatenate([sn, bn])[top_idx])
+    return tuple(top + payload)
+
+
+def _pack_leaves(leaves):
+    """Stack arbitrary leaves into (int64 matrix, float64 matrix) for a
+    single-pull finalize; non-float leaves are widened to int64."""
+    ints = [a.astype(jnp.int64) for a in leaves if a.dtype != jnp.float64]
+    flts = [a for a in leaves if a.dtype == jnp.float64]
+    k = leaves[0].shape[0]
+    int_m = jnp.stack(ints) if ints else jnp.zeros((0, k), dtype=jnp.int64)
+    flt_m = jnp.stack(flts) if flts else jnp.zeros((0, k), dtype=jnp.float64)
+    return int_m, flt_m
+
+
+def _unpack_leaves(packed, dtypes):
+    int_m, flt_m = packed
+    int_np, flt_np = np.asarray(int_m), np.asarray(flt_m)
+    out, ii, fi = [], 0, 0
+    for dt in dtypes:
+        if dt == np.float64:
+            out.append(flt_np[fi])
+            fi += 1
+        else:
+            out.append(int_np[ii].astype(dt))
+            ii += 1
+    return out
+
+
 def _pack_state(state):
     """Flatten (first_row, carries) into at most two matrices on device (one
     int64, one float64) — the tunnel charges a flat latency per device→host
     pull, so finalize pulls once for all-integer queries, twice with REAL
-    aggregates (TPU's x64 emulation cannot bitcast f64 to int lanes)."""
+    aggregates (TPU's x64 emulation cannot bitcast f64 to int lanes).
+    Thin wrapper over _pack_leaves so the int/float partition contract has
+    exactly one implementation."""
     first_row, carries = state
-    leaves = [first_row] + jax.tree.leaves(carries)
-    ints = [a for a in leaves if a.dtype != jnp.float64]
-    flts = [a for a in leaves if a.dtype == jnp.float64]
-    int_m = jnp.stack(ints)
-    flt_m = jnp.stack(flts) if flts else jnp.zeros((0, first_row.shape[0]), dtype=jnp.float64)
-    return int_m, flt_m
+    return _pack_leaves([first_row] + jax.tree.leaves(carries))
 
 
 def _unpack_state(packed, state_template):
     """Host-side inverse of _pack_state, restoring the leaf order."""
-    int_m, flt_m = packed
-    int_m = np.asarray(int_m)
     first_t, carries_t = state_template
     leaves_t = [first_t] + jax.tree.leaves(carries_t)
-    flt_np = np.asarray(flt_m) if any(t.dtype == np.float64 for t in leaves_t) else None
-    out = []
-    ii = fi = 0
-    for t in leaves_t:
-        if t.dtype == np.float64:
-            out.append(flt_np[fi])
-            fi += 1
-        else:
-            out.append(int_m[ii])
-            ii += 1
+    out = _unpack_leaves(packed, [t.dtype for t in leaves_t])
     treedef = jax.tree.structure(carries_t)
     return out[0], jax.tree.unflatten(treedef, out[1:])
 
@@ -413,6 +493,12 @@ class JaxDagEvaluator:
         else:
             self.group_rpns = []
             self.device_aggs = []
+        if self.plan.topn is not None and agg is None:
+            self.topn_rpns = [
+                (compile_expr(e, self.schema), desc) for e, desc in self.plan.topn.order_by
+            ]
+        else:
+            self.topn_rpns = []
         # which leaf columns must ship to the device
         need: set[int] = set()
         for r in self.sel_rpns:
@@ -420,6 +506,11 @@ class JaxDagEvaluator:
         for da in self.device_aggs:
             if da.rpn is not None:
                 need |= da.rpn.referenced_columns()
+        if self.topn_rpns:
+            # raw TopN outputs whole rows: every schema column is payload
+            need |= set(range(len(self.schema)))
+            for r, _d in self.topn_rpns:
+                need |= r.referenced_columns()
         self.device_cols = sorted(need)
         # columns declared NOT NULL never ship a null mask — the device step
         # folds a constant all-false mask (XLA constant-propagates it away)
@@ -674,6 +765,8 @@ class JaxDagEvaluator:
                 if cache is not None and cache.filled and cache.blocks:
                     return self._run_aggregated_cached(cache)
                 return self._run_aggregated(source)
+            if self.topn_rpns:
+                return self._run_topn(source)
             return self._run_scan_filter(source)
         finally:
             self._cache = None
@@ -875,6 +968,78 @@ class JaxDagEvaluator:
         for g in self.group_rpns:
             out.append((g.eval_type, g.frac))
         return out
+
+    # -- raw TopN pipeline -------------------------------------------------
+
+    def _topn_key_operand_count(self) -> int:
+        return 1 + 2 * len(self.topn_rpns)  # global rank + (null-rank, key) each
+
+    def _topn_state_dtypes(self):
+        dts = [np.int64]
+        for rpn, _desc in self.topn_rpns:
+            dts += [np.int64, _np_dtype(rpn.eval_type)]
+        for ci in range(len(self.schema)):
+            dts += [_np_dtype(self.schema[ci][0]), np.bool_]
+        return dts
+
+    def _build_topn_fn(self, k: int):
+        key = ("topn", k)
+        cached = self._agg_fn_cache.get(key)
+        if cached is not None:
+            return cached
+        sel_rpns = self.sel_rpns
+        order_rpns = self.topn_rpns
+        device_cols = self.device_cols
+        nullable = self.nullable_cols
+        n_rows = self.block_rows
+        payload_cols = list(range(len(self.schema)))
+
+        def step(col_data, col_nulls, n_valid, state):
+            cols = _build_cols(device_cols, nullable, col_data, col_nulls, n_rows)
+            return _topn_step(
+                sel_rpns, order_rpns, payload_cols, k, n_rows, cols, n_valid, state
+            )
+
+        fn = jax.jit(step, donate_argnums=(3,))
+        self._agg_fn_cache[key] = fn
+        return fn
+
+    def _run_topn(self, source: ScanSource) -> SelectResponse:
+        """TableScan → Selection? → TopN (no aggregation): a running top-K
+        lives ON the device — per block one fused dispatch computes selection
+        + sort operands and stable-sort-merges the carried best K, so the
+        whole query is async dispatches plus ONE packed pull of K rows.
+        The sort-operand encoding reproduces the CPU executor's comparator
+        bit-for-bit, so responses stay byte-identical."""
+        k = self.plan.topn.limit
+        if self.plan.limit is not None:
+            k = min(k, self.plan.limit.limit)
+        if k == 0:
+            enc = ResponseEncoder(self.dag.chunk_rows)
+            return SelectResponse(chunks=enc.finish())
+        step = self._build_topn_fn(k)
+        dtypes = self._topn_state_dtypes()
+        jdt = {np.float64: jnp.float64, np.bool_: jnp.bool_}
+        state = tuple(
+            # empty slots carry rank 1 (sorted last, excluded at finalize)
+            (jnp.ones if i == 0 else jnp.zeros)(k, dtype=jdt.get(dt, jnp.int64))
+            for i, dt in enumerate(dtypes)
+        )
+        for cols, n_valid in self._blocks(source):
+            col_data, col_nulls = self._device_block(cols, n_valid)
+            state = step(col_data, col_nulls, n_valid, state)
+        leaves = _unpack_leaves(_pack_leaves(list(state)), dtypes)
+        rank = leaves[0]
+        n_out = int((rank == 0).sum())
+        base = self._topn_key_operand_count()
+        out_cols: list[Column] = []
+        for ci, (et, frac) in enumerate(self.schema):
+            data = leaves[base + 2 * ci][:n_out]
+            nulls = leaves[base + 2 * ci + 1][:n_out]
+            out_cols.append(Column(et, data, nulls.astype(bool), frac))
+        enc = ResponseEncoder(self.dag.chunk_rows)
+        enc.add_chunk(Chunk.full(out_cols), self.dag.output_offsets)
+        return SelectResponse(chunks=enc.finish())
 
     # -- selection-only pipeline ------------------------------------------
 

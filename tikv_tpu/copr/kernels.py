@@ -444,14 +444,15 @@ _int_bytes_op("like", 2)(lambda s, pat: 1 if _like_regex(pat).match(s) else 0)
 # -- math catalog (impl_math.rs / impl_op.rs) ------------------------------
 
 def _realfn_dom(name, f):
-    """Real function with a restricted domain: NaN results become SQL NULL
-    (the reference's Real::new(..).ok() mapping)."""
+    """Real function with a restricted domain: any non-finite result becomes
+    SQL NULL (the reference's f64_to_real is_finite gate — LOG2(0) must be
+    NULL, not −inf, and NaN likewise)."""
 
     @_reg(name, 1, "real")
     def fn(xp, a, _f=f):
         ad, an = a
         r = _f(xp)(ad)
-        return r, an | xp.isnan(r)
+        return r, an | ~xp.isfinite(r)
 
     return fn
 
@@ -523,8 +524,17 @@ def _round_real_frac(xp, a, b):
 @_reg("truncate_real_frac", 2, "real")
 def _truncate_real_frac(xp, a, b):
     (ad, an), (bd, bn) = a, b
-    p = xp.power(10.0, -bd.astype("float64"))
-    return xp.trunc(ad / p) * p, an | bn
+    # unlike ROUND, the reference's truncate MULTIPLIES by 10^d
+    # (impl_math.rs truncate_real): overflowed scaling passes x through,
+    # but an underflow to 0 returns 0.0
+    m = xp.power(10.0, bd.astype("float64"))
+    tmp = ad * m
+    out = xp.where(
+        xp.isfinite(tmp),
+        xp.where(tmp == 0, xp.zeros_like(ad), xp.trunc(tmp) / m),
+        ad,
+    )
+    return out, an | bn
 
 
 # -- bit operators (impl_op.rs: results are u64 in MySQL; kept as the i64
