@@ -488,3 +488,46 @@ def test_endpoint_falls_back_to_cpu_on_device_failure(monkeypatch):
     r = ep.handle_request(req())
     assert not r.from_device
     assert len(r.data) > 0
+
+
+def test_device_failure_does_not_poison_block_cache(monkeypatch):
+    """A transient failure during cache fill must invalidate the partial
+    cache — retrying used to double-append blocks and serve wrong data."""
+    from tikv_tpu.copr.endpoint import CoprRequest, Endpoint
+    from tikv_tpu.copr.table import record_range
+    from tikv_tpu.storage.btree_engine import BTreeEngine
+    from tikv_tpu.storage.kv import LocalEngine
+    from tikv_tpu.storage.engine import WriteBatch
+    from tikv_tpu.storage.txn_types import Key, Write, WriteType
+    from tikv_tpu.copr.aggr import AggDescriptor
+
+    eng = BTreeEngine()
+    wb = WriteBatch()
+    for rk, val in NUMERIC_KVS[:500]:
+        wb.put_cf("write", Key.from_raw(rk).append_ts(11).encoded,
+                  Write(WriteType.PUT, 10, short_value=val).to_bytes())
+    eng.write(wb)
+    ep = Endpoint(LocalEngine(eng), enable_device=True)
+    dag = DagRequest(executors=[
+        TableScan(TABLE_ID, NUMERIC_COLS),
+        Aggregation([], [AggDescriptor("count", None), AggDescriptor("sum", col(1))]),
+    ])
+    ctx = {"region_id": 1, "cache_version": 7}
+    req = lambda: CoprRequest(103, DagRequest(executors=dag.executors), [record_range(TABLE_ID)], 100, context=ctx)
+    # fail mid-fill: the evaluator dies after the cache got partial blocks
+    orig_run = JaxDagEvaluator.run
+
+    def failing_run(self, src, cache=None):
+        if cache is not None:
+            cache.add([None], 1)  # simulate partial fill before the fault
+        raise RuntimeError("transient device fault")
+
+    monkeypatch.setattr(JaxDagEvaluator, "run", failing_run)
+    r1 = ep.handle_request(req())
+    assert not r1.from_device
+    assert ep.device_fallbacks == 1 and "transient" in ep.last_device_error
+    monkeypatch.setattr(JaxDagEvaluator, "run", orig_run)
+    r2 = ep.handle_request(req())  # refills the cache from scratch
+    r3 = ep.handle_request(req())  # served from the (clean) cache
+    cpu = Endpoint(LocalEngine(eng), enable_device=False).handle_request(req())
+    assert r2.data == r3.data == cpu.data == r1.data

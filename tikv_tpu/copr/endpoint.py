@@ -62,6 +62,10 @@ class Endpoint:
         self.cm = concurrency_manager
         self.slow_log = slow_log or SlowLog()
         self._evaluators: dict = {}
+        # device-path failures observed (CPU fallback taken): a permanently
+        # broken device shows up here instead of only as from_device=False
+        self.device_fallbacks = 0
+        self.last_device_error: str | None = None
 
     def handle_request(self, req: CoprRequest) -> CoprResponse:
         from .tracker import Tracker
@@ -86,6 +90,7 @@ class Endpoint:
         tracker.on_snapshot_finished()
         use_device = self.enable_device and jax_eval.supports(req.dag)
         if use_device:
+            cache = None
             try:
                 ev = self._evaluator_for(req.dag)
                 cache = self._block_cache_for(req)
@@ -101,12 +106,17 @@ class Endpoint:
                     from_cache=cache is not None and cache.filled and src is None,
                     metrics=m.to_dict(),
                 )
-            except Exception:
+            except Exception as exc:
                 # device/runtime failure (compiler, tunnel, OOM): the CPU
                 # pipeline is the correctness oracle and always available —
                 # re-run there off the same immutable snapshot rather than
                 # surfacing an accelerator error to the client
-                pass
+                if cache is not None and not cache.filled:
+                    # a partially-filled block cache would double-append on
+                    # the next request and serve wrong data forever
+                    cache.blocks.clear()
+                self.device_fallbacks += 1
+                self.last_device_error = repr(exc)
         stats = Statistics()
         src = MvccScanSource(snap, req.start_ts, req.ranges, statistics=stats)
         resp = BatchExecutorsRunner(req.dag, src).handle_request()

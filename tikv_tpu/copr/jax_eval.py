@@ -436,7 +436,9 @@ def _pack_leaves(leaves):
 
 def _unpack_leaves(packed, dtypes):
     int_m, flt_m = packed
-    int_np, flt_np = np.asarray(int_m), np.asarray(flt_m)
+    int_np = np.asarray(int_m)
+    # all-integer states must stay ONE pull (the tunnel charges per RPC)
+    flt_np = np.asarray(flt_m) if flt_m.shape[0] else None
     out, ii, fi = [], 0, 0
     for dt in dtypes:
         if dt == np.float64:
@@ -1028,7 +1030,13 @@ class JaxDagEvaluator:
         for cols, n_valid in self._blocks(source):
             col_data, col_nulls = self._device_block(cols, n_valid)
             state = step(col_data, col_nulls, n_valid, state)
-        leaves = _unpack_leaves(_pack_leaves(list(state)), dtypes)
+        pack_key = ("packtopn", k)
+        pack_fn = self._agg_fn_cache.get(pack_key)
+        if pack_fn is None:
+            pack_fn = self._agg_fn_cache[pack_key] = jax.jit(
+                lambda st: _pack_leaves(list(st))
+            )
+        leaves = _unpack_leaves(pack_fn(state), dtypes)
         rank = leaves[0]
         n_out = int((rank == 0).sum())
         base = self._topn_key_operand_count()

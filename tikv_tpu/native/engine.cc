@@ -55,9 +55,8 @@ const std::string* resolve(const Chain& chain, uint64_t snap_seq) {
   return nullptr;
 }
 
-void put_version(Table& t, std::string key, uint64_t seq, bool tomb,
-                 std::string value, uint64_t min_snap) {
-  Chain& chain = t[key];
+void push_version(Chain& chain, uint64_t seq, bool tomb, std::string value,
+                  uint64_t min_snap) {
   chain.insert(chain.begin(), Version{seq, tomb, std::move(value)});
   // compact: keep the newest version <= min_snap, drop everything older
   if (chain.size() > 1) {
@@ -70,6 +69,25 @@ void put_version(Table& t, std::string key, uint64_t seq, bool tomb,
     }
     if (keep < chain.size()) chain.resize(keep);
   }
+}
+
+void put_version(Table& t, std::string key, uint64_t seq, bool tomb,
+                 std::string value, uint64_t min_snap) {
+  // bulk ingestion (restore, snapshot apply, bench load) streams keys in
+  // ascending order: appending past the current max is O(1) with an end
+  // hint instead of a full O(log n) descent + key copy per record
+  Chain* chain;
+  if (t.empty() || t.rbegin()->first < key) {
+    chain = &t.emplace_hint(t.end(), std::move(key), Chain{})->second;
+  } else {
+    auto it = t.lower_bound(key);
+    if (it != t.end() && it->first == key) {
+      chain = &it->second;
+    } else {
+      chain = &t.emplace_hint(it, std::move(key), Chain{})->second;
+    }
+  }
+  push_version(*chain, seq, tomb, std::move(value), min_snap);
 }
 
 // --- buffer helpers ---------------------------------------------------------
@@ -130,7 +148,8 @@ int eng_write(void* h, const uint8_t* data, uint64_t len) {
       auto it = t.lower_bound(key);
       auto stop = t.lower_bound(val);
       for (; it != stop; ++it) {
-        put_version(t, it->first, seq, true, "", min_snap);
+        // the iterator already holds the chain: no per-key re-lookup
+        push_version(it->second, seq, true, "", min_snap);
       }
     } else {
       return -3;

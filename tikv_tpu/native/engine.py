@@ -19,6 +19,30 @@ from typing import Iterator
 from ..storage.engine import ALL_CFS, Cursor, KvEngine, Snapshot, WriteBatch
 
 _CF_IDS = {cf: i for i, cf in enumerate(ALL_CFS)}
+
+def _serialize_ops(ops) -> bytes:
+    """The native wire format (op u8 | cf u8 | klen u32 | key | vlen u32 |
+    val) has exactly ONE encoder — write() and bulk_load() both come here.
+    Join-based with precomputed 2-byte prefixes: this loop is the Python
+    side of the ingestion hot path."""
+    parts = []
+    ap = parts.append
+    pack = _U32.pack
+    for op, cf, key, val in ops:
+        v = val if val is not None else b""
+        ap(_OP_CF_PREFIX[op, cf])
+        ap(pack(len(key)))
+        ap(key)
+        ap(pack(len(v)))
+        ap(v)
+    return b"".join(parts)
+
+
+_OP_CF_PREFIX = {
+    (op, cf): bytes([opc, cfc])
+    for op, opc in (("put", 1), ("delete", 2), ("delete_range", 3))
+    for cf, cfc in _CF_IDS.items()
+}
 _U32 = struct.Struct("<I")
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -276,25 +300,23 @@ class NativeEngine(KvEngine):
         except Exception:  # noqa: BLE001 — interpreter shutdown
             pass
 
-    def write(self, batch: WriteBatch) -> None:
-        out = bytearray()
-        for op, cf, key, val in batch.ops:
-            out.append({"put": 1, "delete": 2, "delete_range": 3}[op])
-            out.append(_CF_IDS[cf])
-            out += _U32.pack(len(key))
-            out += key
-            v = val if val is not None else b""
-            out += _U32.pack(len(v))
-            out += v
-        r = self._lib.eng_write(self._handle, bytes(out), len(out))
+    def _write_buf(self, out: bytes) -> None:
+        r = self._lib.eng_write(self._handle, out, len(out))
         if r != 0:
             raise RuntimeError(f"eng_write failed: {r}")
 
+    def write(self, batch: WriteBatch) -> None:
+        self._write_buf(_serialize_ops(batch.ops))
+
     def bulk_load(self, cf: str, items: list[tuple[bytes, bytes]]) -> None:
-        wb = WriteBatch()
-        for k, v in items:
-            wb.put_cf(cf, k, v)
-        self.write(wb)
+        # chunked so the parts list and joined buffer stay allocator-friendly
+        CH = 32768
+        for off in range(0, len(items), CH):
+            self._write_buf(
+                _serialize_ops(
+                    ("put", cf, k, v) for k, v in items[off : off + CH]
+                )
+            )
 
     def snapshot(self) -> NativeSnapshot:
         return NativeSnapshot(self)
