@@ -250,3 +250,96 @@ def test_truncate_underflow_returns_zero():
     assert d[0] == 0.0
     d, _ = _run(call("truncate_real_frac", const_real(1e-200), const_int(-200)))
     assert d[0] == 0.0
+
+
+def test_date_time_formatting_family():
+    from tikv_tpu.copr.mysql_time import pack_datetime
+
+    dt = pack_datetime(2026, 7, 29, 14, 5, 9, 123456)
+    dtc = lambda: __import__("tikv_tpu.copr.rpn", fromlist=["Constant"]).Constant(
+        dt, __import__("tikv_tpu.copr.datatypes", fromlist=["EvalType"]).EvalType.DATETIME
+    )
+    d, _ = _run(call("date_format", dtc(), const_bytes(b"%Y-%m-%d %H:%i:%s.%f")))
+    assert d[0] == b"2026-07-29 14:05:09.123456"
+    d, _ = _run(call("date_format", dtc(), const_bytes(b"%W %M %e, %y at %l:%i %p")))
+    assert d[0] == b"Wednesday July 29, 26 at 2:05 PM"
+    d, _ = _run(call("date_format", dtc(), const_bytes(b"%j day, %r, 100%%")))
+    assert d[0] == b"210 day, 02:05:09 PM, 100%"
+    d, _ = _run(call("month_name", dtc()))
+    assert d[0] == b"July"
+    d, _ = _run(call("day_name", dtc()))
+    assert d[0] == b"Wednesday"
+    d, _ = _run(call("day_of_week", dtc()))
+    assert d[0] == 4  # Wednesday, 1=Sunday
+    d, _ = _run(call("week_day", dtc()))
+    assert d[0] == 2  # 0=Monday
+    d, _ = _run(call("day_of_year", dtc()))
+    assert d[0] == 210
+    d, _ = _run(call("quarter", dtc()))
+    assert d[0] == 3
+    # TO_DAYS('2026-07-29') per MySQL; FROM_DAYS round-trips
+    d, _ = _run(call("to_days", dtc()))
+    todays = int(d[0])
+    import datetime
+
+    assert todays == datetime.date(2026, 7, 29).toordinal() + 365
+    d, _ = _run(call("from_days", const_int(todays)))
+    from tikv_tpu.copr.mysql_time import unpack_datetime
+
+    assert unpack_datetime(int(d[0]))[:3] == (2026, 7, 29)
+    d, _ = _run(call("last_day", dtc()))
+    assert unpack_datetime(int(d[0]))[:3] == (2026, 7, 31)
+    # datediff
+    from tikv_tpu.copr.rpn import Constant
+    from tikv_tpu.copr.datatypes import EvalType as ET
+
+    other = Constant(pack_datetime(2026, 7, 1), ET.DATETIME)
+    d, _ = _run(call("date_diff", dtc(), other))
+    assert d[0] == 28
+
+
+def test_str_to_date():
+    from tikv_tpu.copr.mysql_time import unpack_datetime
+
+    d, nl = _run(call("str_to_date", const_bytes(b"29/07/2026 14:05"), const_bytes(b"%d/%m/%Y %H:%i")))
+    assert not nl[0] and unpack_datetime(int(d[0]))[:5] == (2026, 7, 29, 14, 5)
+    d, nl = _run(call("str_to_date", const_bytes(b"Jul 29 2026"), const_bytes(b"%b %d %Y")))
+    assert unpack_datetime(int(d[0]))[:3] == (2026, 7, 29)
+    d, nl = _run(call("str_to_date", const_bytes(b"not-a-date"), const_bytes(b"%Y-%m-%d")))
+    assert nl[0]
+    d, nl = _run(call("str_to_date", const_bytes(b"2026-13-45"), const_bytes(b"%Y-%m-%d")))
+    assert nl[0]  # out-of-range components -> NULL
+
+
+def test_regexp_family():
+    d, _ = _run(call("regexp", const_bytes(b"hello world"), const_bytes(b"wor.d")))
+    assert d[0] == 1
+    d, _ = _run(call("regexp", const_bytes(b"hello"), const_bytes(b"^x")))
+    assert d[0] == 0
+    d, _ = _run(call("regexp_like_ci", const_bytes(b"HELLO"), const_bytes(b"hel+o")))
+    assert d[0] == 1
+    d, nl = _run(call("regexp", const_bytes(b"x"), const_bytes(b"[unclosed")))
+    assert nl[0]  # invalid pattern -> NULL (loud would also be fine; stable choice)
+    d, _ = _run(call("regexp_substr", const_bytes(b"abc123def"), const_bytes(b"[0-9]+")))
+    assert d[0] == b"123"
+    d, nl = _run(call("regexp_substr", const_bytes(b"abc"), const_bytes(b"[0-9]+")))
+    assert nl[0]  # no match -> NULL
+    d, _ = _run(call("regexp_instr", const_bytes(b"abc123"), const_bytes(b"[0-9]")))
+    assert d[0] == 4
+    d, _ = _run(call("regexp_replace", const_bytes(b"a1b2"), const_bytes(b"[0-9]"), const_bytes(b"_")))
+    assert d[0] == b"a_b_"
+
+
+def test_date_review_fixes():
+    from tikv_tpu.copr.rpn import Constant
+    from tikv_tpu.copr.datatypes import EvalType as ET
+    from tikv_tpu.copr.mysql_time import pack_datetime
+
+    zero = Constant(0, ET.DATETIME)
+    d, nl = _run(call("day_name", zero))
+    assert nl[0]  # zero date -> NULL, not a crash
+    dt = Constant(pack_datetime(2026, 7, 29), ET.DATETIME)
+    d, _ = _run(call("date_format", dt, const_bytes(b"%x-%v")))
+    assert d[0] == b"2026-31"  # ISO year-week
+    d, _ = _run(call("date_format", dt, const_bytes(b"%X week %V")))
+    assert b"week" in d[0] and not d[0].startswith(b"X")

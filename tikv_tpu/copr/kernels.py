@@ -1040,5 +1040,71 @@ def _cast_json_real(doc):
     return 0.0
 
 
+# -- regexp family (impl_regexp.rs; MySQL uses ICU — python `re` covers the
+# common POSIX-ish subset; case-sensitivity follows the binary collation,
+# with _ci variants for case-insensitive columns) ---------------------------
+
+_rx_cache: dict = {}
+
+
+def _rx(pat: bytes, flags: int = 0):
+    key = (pat, flags)
+    rx = _rx_cache.get(key)
+    if rx is None:
+        if len(_rx_cache) > 512:
+            _rx_cache.clear()
+        rx = _rx_cache[key] = _re.compile(pat, flags)
+    return rx
+
+
+def _reg_regexp(name, flags):
+    def fn(s_, pat):
+        try:
+            return 1 if _rx(pat, flags).search(s_) else 0
+        except _re.error:
+            return None
+
+    _reg_nullable_int(name, 2, fn)
+
+
+_reg_regexp("regexp", 0)
+_reg_regexp("regexp_like", 0)
+_reg_regexp("regexp_like_ci", _re.IGNORECASE)
+
+
+def _regexp_substr(s_, pat):
+    try:
+        m = _rx(pat).search(s_)
+    except _re.error:
+        return None
+    return m.group(0) if m else None
+
+
+_bytes_op("regexp_substr", 2, "bytes")(_regexp_substr)
+
+
+def _regexp_instr(s_, pat):
+    try:
+        m = _rx(pat).search(s_)
+    except _re.error:
+        return None
+    return (m.start() + 1) if m else 0
+
+
+_reg_nullable_int("regexp_instr", 2, _regexp_instr)
+
+
+def _regexp_replace(s_, pat, repl):
+    # replacement is literal (no $N backrefs yet — MySQL/ICU's $N would need
+    # translation to python's \N); a lambda sidesteps re's escape handling
+    try:
+        return _rx(pat).sub(lambda _m: repl, s_)
+    except _re.error:
+        return None
+
+
+_bytes_op("regexp_replace", 3, "bytes")(_regexp_replace)
+
+
 # time-type kernels register themselves into KERNELS on import
 from . import mysql_time as _mysql_time  # noqa: E402,F401

@@ -140,3 +140,284 @@ _dt_field("micro_second", lambda xp, v: v & 0xFFFFFF)
 def _duration_hours(xp, a):
     ad, an = a
     return xp.abs(ad) // (3600 * NANOS_PER_SEC), an
+
+
+# -- calendar kernels (impl_time.rs: weekday/dayofyear/quarter/to_days…) ----
+
+import datetime as _dt
+
+from .kernels import _bytes_op, _reg_nullable_int
+
+
+def _ymd(packed: int):
+    y, m, d, *_ = unpack_datetime(int(packed))
+    return y, m, d
+
+
+def _as_date(packed: int) -> _dt.date:
+    y, m, d = _ymd(packed)
+    return _dt.date(y, m, d)
+
+
+def _nullable_dt_int(name, fn):
+    """DATETIME→INT kernel where invalid dates (e.g. zero date) yield NULL."""
+
+    def wrapped(v):
+        try:
+            return fn(int(v))
+        except ValueError:
+            return None
+
+    _reg_nullable_int(name, 1, wrapped)
+
+
+_nullable_dt_int("day_of_week", lambda p: _as_date(p).toordinal() % 7 + 1)  # 1=Sunday
+_nullable_dt_int("week_day", lambda p: _as_date(p).weekday())  # 0=Monday
+_nullable_dt_int("day_of_year", lambda p: _as_date(p).timetuple().tm_yday)
+_nullable_dt_int("quarter", lambda p: (_ymd(p)[1] + 2) // 3)
+_nullable_dt_int("to_days", lambda p: _as_date(p).toordinal() + 365)
+_nullable_dt_int(
+    "last_day",
+    lambda p: pack_datetime(
+        _ymd(p)[0], _ymd(p)[1],
+        ((_dt.date(_ymd(p)[0] + (_ymd(p)[1] == 12), _ymd(p)[1] % 12 + 1, 1)) - _dt.timedelta(days=1)).day,
+    ),
+)
+
+
+def _from_days(n):
+    n = int(n) - 365
+    if n < 1:
+        return None
+    d = _dt.date.fromordinal(n)
+    return pack_datetime(d.year, d.month, d.day)
+
+
+_reg_nullable_int("from_days", 1, _from_days)
+
+
+def _datediff(a, b):
+    try:
+        return (_as_date(a) - _as_date(b)).days
+    except ValueError:
+        return None
+
+
+def _dd(xp, a, b):
+    import numpy as _np
+
+    (ad, an), (bd, bn) = a, b
+    nulls = _np.asarray(an | bn).copy()
+    out = _np.zeros(len(ad), dtype=_np.int64)
+    for i in range(len(ad)):
+        if nulls[i]:
+            continue
+        r = _datediff(ad[i], bd[i])
+        if r is None:
+            nulls[i] = True
+        else:
+            out[i] = r
+    return out, nulls
+
+
+KERNELS["date_diff"] = (2, "int", _dd)
+
+
+# -- DATE_FORMAT / STR_TO_DATE (impl_time.rs date_format; the %-specifier
+# table is MySQL's own) ------------------------------------------------------
+
+_MONTHS = ["January", "February", "March", "April", "May", "June", "July",
+           "August", "September", "October", "November", "December"]
+_DAYS = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday", "Saturday", "Sunday"]
+
+
+def date_format(packed: int, fmt: str) -> str:
+    y, mo, d, hh, mi, ss, us = unpack_datetime(packed)
+    date = _dt.date(y, mo, d)
+    h12 = hh % 12 or 12
+    out = []
+    i = 0
+    while i < len(fmt):
+        c = fmt[i]
+        if c != "%":
+            out.append(c)
+            i += 1
+            continue
+        i += 1
+        if i >= len(fmt):
+            out.append("%")
+            break
+        s = fmt[i]
+        i += 1
+        if s == "Y":
+            out.append(f"{y:04d}")
+        elif s == "y":
+            out.append(f"{y % 100:02d}")
+        elif s == "m":
+            out.append(f"{mo:02d}")
+        elif s == "c":
+            out.append(str(mo))
+        elif s == "d":
+            out.append(f"{d:02d}")
+        elif s == "e":
+            out.append(str(d))
+        elif s == "H":
+            out.append(f"{hh:02d}")
+        elif s == "k":
+            out.append(str(hh))
+        elif s in ("h", "I"):
+            out.append(f"{h12:02d}")
+        elif s == "l":
+            out.append(str(h12))
+        elif s == "i":
+            out.append(f"{mi:02d}")
+        elif s in ("s", "S"):
+            out.append(f"{ss:02d}")
+        elif s == "f":
+            out.append(f"{us:06d}")
+        elif s == "p":
+            out.append("AM" if hh < 12 else "PM")
+        elif s == "r":
+            out.append(f"{h12:02d}:{mi:02d}:{ss:02d} " + ("AM" if hh < 12 else "PM"))
+        elif s == "T":
+            out.append(f"{hh:02d}:{mi:02d}:{ss:02d}")
+        elif s == "M":
+            out.append(_MONTHS[mo - 1])
+        elif s == "b":
+            out.append(_MONTHS[mo - 1][:3])
+        elif s == "W":
+            out.append(_DAYS[date.weekday()])
+        elif s == "a":
+            out.append(_DAYS[date.weekday()][:3])
+        elif s == "j":
+            out.append(f"{date.timetuple().tm_yday:03d}")
+        elif s == "w":
+            out.append(str(date.toordinal() % 7))  # 0=Sunday
+        elif s in ("u",):
+            # %u: week 1..53, Monday-start, ISO-like (mode 1)
+            out.append(f"{date.isocalendar()[1]:02d}")
+        elif s in ("V", "v", "U", "X", "x"):
+            # week-mode specifiers: %v/%x are ISO (mode 3); %U/%V/%X
+            # (Sunday-start modes) approximate with the Sunday-week count
+            if s in ("v", "V"):
+                out.append(f"{date.isocalendar()[1]:02d}")
+            elif s in ("x", "X"):
+                out.append(f"{date.isocalendar()[0]:04d}")
+            else:  # %U: Sunday-start week 0..53
+                jan1 = _dt.date(y, 1, 1)
+                out.append(f"{(date.timetuple().tm_yday + jan1.toordinal() % 7 - 1) // 7:02d}")
+        elif s == "%":
+            out.append("%")
+        else:
+            out.append(s)  # MySQL: unknown specifier passes through
+    return "".join(out)
+
+
+def _k_date_format(v, fmt):
+    try:
+        return date_format(int(v), fmt.decode("utf-8", "replace")).encode()
+    except (ValueError, IndexError):
+        return None
+
+
+_bytes_op("date_format", 2, "bytes")(_k_date_format)
+_bytes_op("month_name", 1, "bytes")(
+    lambda v: _MONTHS[unpack_datetime(int(v))[1] - 1].encode()
+    if 1 <= unpack_datetime(int(v))[1] <= 12
+    else None
+)
+def _k_day_name(v):
+    try:
+        return _DAYS[_as_date(int(v)).weekday()].encode()
+    except ValueError:
+        return None  # zero/invalid date -> NULL, like the sibling kernels
+
+
+_bytes_op("day_name", 1, "bytes")(_k_day_name)
+
+
+def str_to_date(text: str, fmt: str) -> int | None:
+    """Inverse of date_format for the numeric/name specifiers MySQL's
+    STR_TO_DATE accepts; None on mismatch (MySQL returns NULL)."""
+    vals = {"y": 0, "mo": 1, "d": 1, "hh": 0, "mi": 0, "ss": 0, "us": 0}
+    ti = 0
+    fi = 0
+    try:
+        while fi < len(fmt):
+            c = fmt[fi]
+            if c != "%":
+                if ti >= len(text) or text[ti] != c:
+                    return None
+                ti += 1
+                fi += 1
+                continue
+            fi += 1
+            s = fmt[fi]
+            fi += 1
+
+            def num(maxlen):
+                nonlocal ti
+                j = ti
+                while j < len(text) and j - ti < maxlen and text[j].isdigit():
+                    j += 1
+                if j == ti:
+                    raise ValueError
+                v = int(text[ti:j])
+                ti = j
+                return v
+
+            if s == "Y":
+                vals["y"] = num(4)
+            elif s == "y":
+                v = num(2)
+                vals["y"] = 2000 + v if v < 70 else 1900 + v
+            elif s in ("m", "c"):
+                vals["mo"] = num(2)
+            elif s in ("d", "e"):
+                vals["d"] = num(2)
+            elif s in ("H", "k", "h", "I", "l"):
+                vals["hh"] = num(2)
+            elif s == "i":
+                vals["mi"] = num(2)
+            elif s in ("s", "S"):
+                vals["ss"] = num(2)
+            elif s == "f":
+                j = ti
+                while j < len(text) and j - ti < 6 and text[j].isdigit():
+                    j += 1
+                vals["us"] = int(text[ti:j].ljust(6, "0")) if j > ti else 0
+                ti = j
+            elif s == "b":
+                for k, name in enumerate(_MONTHS):
+                    if text[ti : ti + 3].lower() == name[:3].lower():
+                        vals["mo"] = k + 1
+                        ti += 3
+                        break
+                else:
+                    return None
+            elif s == "M":
+                for k, name in enumerate(_MONTHS):
+                    if text[ti : ti + len(name)].lower() == name.lower():
+                        vals["mo"] = k + 1
+                        ti += len(name)
+                        break
+                else:
+                    return None
+            elif s == "%":
+                if ti >= len(text) or text[ti] != "%":
+                    return None
+                ti += 1
+            else:
+                return None
+        return pack_datetime(
+            vals["y"], vals["mo"], vals["d"], vals["hh"], vals["mi"], vals["ss"], vals["us"]
+        )
+    except (ValueError, IndexError):
+        return None
+
+
+def _k_str_to_date(raw, fmt):
+    return str_to_date(raw.decode("utf-8", "replace"), fmt.decode("utf-8", "replace"))
+
+
+_reg_nullable_int("str_to_date", 2, _k_str_to_date)
