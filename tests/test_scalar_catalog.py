@@ -343,3 +343,20 @@ def test_date_review_fixes():
     assert d[0] == b"2026-31"  # ISO year-week
     d, _ = _run(call("date_format", dt, const_bytes(b"%X week %V")))
     assert b"week" in d[0] and not d[0].startswith(b"X")
+
+
+def test_date_review_fixes_round2():
+    from tikv_tpu.copr.rpn import Constant
+    from tikv_tpu.copr.datatypes import EvalType as ET
+    from tikv_tpu.copr.mysql_time import pack_datetime
+
+    # impossible calendar dates -> NULL
+    d, nl = _run(call("str_to_date", const_bytes(b"2026-02-31"), const_bytes(b"%Y-%m-%d")))
+    assert nl[0]
+    # %U on a Sunday-starting year: 2023-01-01 is week 01, Dec 31 week 53
+    jan1 = Constant(pack_datetime(2023, 1, 1), ET.DATETIME)
+    d, _ = _run(call("date_format", jan1, const_bytes(b"%U")))
+    assert d[0] == b"01"
+    dec31 = Constant(pack_datetime(2023, 12, 31), ET.DATETIME)
+    d, _ = _run(call("date_format", dec31, const_bytes(b"%U")))
+    assert d[0] == b"53"

@@ -203,24 +203,7 @@ def _datediff(a, b):
         return None
 
 
-def _dd(xp, a, b):
-    import numpy as _np
-
-    (ad, an), (bd, bn) = a, b
-    nulls = _np.asarray(an | bn).copy()
-    out = _np.zeros(len(ad), dtype=_np.int64)
-    for i in range(len(ad)):
-        if nulls[i]:
-            continue
-        r = _datediff(ad[i], bd[i])
-        if r is None:
-            nulls[i] = True
-        else:
-            out[i] = r
-    return out, nulls
-
-
-KERNELS["date_diff"] = (2, "int", _dd)
+_reg_nullable_int("date_diff", 2, _datediff)
 
 
 # -- DATE_FORMAT / STR_TO_DATE (impl_time.rs date_format; the %-specifier
@@ -305,7 +288,9 @@ def date_format(packed: int, fmt: str) -> str:
                 out.append(f"{date.isocalendar()[0]:04d}")
             else:  # %U: Sunday-start week 0..53
                 jan1 = _dt.date(y, 1, 1)
-                out.append(f"{(date.timetuple().tm_yday + jan1.toordinal() % 7 - 1) // 7:02d}")
+                # days from week-start: Sunday jan1 must count as 7, not 0
+                off = jan1.toordinal() % 7 or 7
+                out.append(f"{(date.timetuple().tm_yday + off - 1) // 7:02d}")
         elif s == "%":
             out.append("%")
         else:
@@ -409,6 +394,7 @@ def str_to_date(text: str, fmt: str) -> int | None:
                 ti += 1
             else:
                 return None
+        _dt.date(vals["y"], vals["mo"], vals["d"])  # reject Feb 31 etc.
         return pack_datetime(
             vals["y"], vals["mo"], vals["d"], vals["hh"], vals["mi"], vals["ss"], vals["us"]
         )

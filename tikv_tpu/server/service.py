@@ -59,15 +59,34 @@ class KvService:
 
     def __init__(
         self, storage: Storage, copr: Endpoint | None = None, copr_v2=None,
-        resource_tags=None, debugger=None,
+        resource_tags=None, debugger=None, cdc=None,
     ):
         self.storage = storage
         self.copr = copr
         self.copr_v2 = copr_v2
         self.resource_tags = resource_tags
         self.debugger = debugger
+        self.cdc = cdc
 
-    _HANDLER_PREFIXES = ("kv_", "raw_", "coprocessor", "mvcc_", "debug_")
+    _HANDLER_PREFIXES = ("kv_", "raw_", "coprocessor", "mvcc_", "debug_", "cdc_")
+
+    # -- ChangeData service (cdcpb over the multiplexed transport) ----------
+
+    def _cdc(self):
+        if self.cdc is None:
+            raise RuntimeError("cdc service not enabled")
+        return self.cdc
+
+    def cdc_register(self, req: dict) -> dict:
+        return self._cdc().register(req["region_id"], req.get("checkpoint_ts", 0))
+
+    def cdc_events(self, req: dict) -> dict:
+        return self._cdc().events(
+            req["sub_id"], req.get("after_seq", 0), req.get("limit", 1024)
+        )
+
+    def cdc_deregister(self, req: dict) -> dict:
+        return self._cdc().deregister(req["sub_id"])
 
     # -- Debug service (debug.rs over gRPC; read-only surface -- the
     # destructive commands like unsafe-recover are offline-only by design) --

@@ -194,3 +194,129 @@ class _DataView:
         from ..storage.engine import Snapshot
 
         return Snapshot.scan_cf(self, cf, start, end, limit, reverse)
+
+
+# ---------------------------------------------------------------------------
+# Wire service (cdcpb ChangeData: service.rs register_region/EventFeed)
+# ---------------------------------------------------------------------------
+
+
+class SeqSink(Sink):
+    """Sink with per-event sequence numbers so wire clients pull-resume
+    (the push EventFeed stream adapted to the request/response transport:
+    register → pull events after a seq → deregister)."""
+
+    def __init__(self):
+        super().__init__()
+        self._seq = 0
+        self.items: list[tuple[int, str, object]] = []  # (seq, kind, payload)
+
+    def emit(self, event: ChangeEvent) -> None:
+        with self._mu:
+            self._seq += 1
+            self.items.append((self._seq, "event", event))
+
+    def emit_resolved(self, region_id: int, ts: int) -> None:
+        with self._mu:
+            self._seq += 1
+            self.items.append((self._seq, "resolved", (region_id, ts)))
+
+    def drain_after(self, after_seq: int, limit: int) -> list[tuple[int, str, object]]:
+        with self._mu:
+            # drop everything at or below the client's ack: memory stays
+            # bounded by the client's pull cadence
+            while self.items and self.items[0][0] <= after_seq:
+                self.items.pop(0)
+            return list(self.items[:limit])
+
+
+class CdcService:
+    """The ChangeData service surface: one observer shared by the store's
+    apply pipeline, per-subscription SeqSinks, pull-based event feed."""
+
+    def __init__(self, store, snapshot_fn=None):
+        from ..util import keys as keymod
+
+        self.store = store
+        # the store engine speaks the z-prefixed data keyspace; scans must see
+        # user keys, exactly like the observer's old-value reads
+        self._snapshot_fn = snapshot_fn or (
+            lambda: _DataView(store.engine.snapshot(), keymod)
+        )
+        self._mu = threading.Lock()
+        self._subs: dict[int, tuple[int, CdcObserver]] = {}  # sub_id -> (region, obs)
+        self._next_id = 0
+        store.apply_observers.append(self._observe)
+
+    def _observe(self, store, region, cmd):
+        with self._mu:
+            observers = [obs for _rid, obs in self._subs.values()]
+        for obs in observers:
+            obs.observe_apply(store, region, cmd)
+
+    def register(self, region_id: int, checkpoint_ts: int) -> dict:
+        """register_region: subscribe + incremental scan from the checkpoint
+        (delta changes after checkpoint_ts stream via the observer)."""
+        peer = self.store.peers.get(region_id)
+        if peer is None:
+            return {"error": {"other": f"region {region_id} not on this store"}}
+        if not peer.node.is_leader():
+            return {"error": {"not_leader": region_id}}
+        obs = CdcObserver(sink=SeqSink())
+        # install the delegate BEFORE taking the scan snapshot (the reference
+        # does the same): an apply landing in between shows up as a delta
+        # event — possibly duplicating a scan row, which is the documented
+        # at-least-once overlap — instead of being silently lost
+        with self._mu:
+            self._next_id += 1
+            sub_id = self._next_id
+            self._subs[sub_id] = (region_id, obs)
+        scanned = obs.incremental_scan(self._snapshot_fn(), region_id, checkpoint_ts)
+        return {"sub_id": sub_id, "scanned": scanned}
+
+    def events(self, sub_id: int, after_seq: int = 0, limit: int = 1024) -> dict:
+        with self._mu:
+            ent = self._subs.get(sub_id)
+        if ent is None:
+            return {"error": {"other": f"unknown cdc subscription {sub_id}"}}
+        region_id, obs = ent
+        peer = self.store.peers.get(region_id)
+        if peer is None or not peer.node.is_leader():
+            # role changed: the reference tears the delegate down and the
+            # client re-registers against the new leader
+            self.deregister(sub_id)
+            return {"error": {"not_leader": region_id}}
+        out = []
+        last = after_seq
+        for seq, kind, payload in obs.sink.drain_after(after_seq, limit):
+            last = seq
+            if kind == "event":
+                e: ChangeEvent = payload
+                out.append({
+                    "seq": seq, "type": e.op, "key": e.key,
+                    "value": e.value if e.value is not None else b"",
+                    "old_value": e.old_value if e.old_value is not None else b"",
+                    "start_ts": e.start_ts, "commit_ts": e.commit_ts,
+                })
+            else:
+                rid, ts = payload
+                out.append({"seq": seq, "type": "resolved", "region_id": rid, "ts": ts})
+        return {"events": out, "last_seq": last}
+
+    def resolved(self, sub_id: int, ts: int) -> dict:
+        """Advance the subscription's resolved-ts watermark (the resolved-ts
+        worker calls this; clients see it interleaved in the event feed)."""
+        with self._mu:
+            ent = self._subs.get(sub_id)
+        if ent is None:
+            return {"error": {"other": f"unknown cdc subscription {sub_id}"}}
+        region_id, obs = ent
+        obs.emit_resolved(region_id, ts)
+        return {}
+
+    def deregister(self, sub_id: int) -> dict:
+        with self._mu:
+            ent = self._subs.pop(sub_id, None)
+        if ent is not None:
+            ent[1].unsubscribe(ent[0])
+        return {}
