@@ -375,3 +375,27 @@ def test_cdc_over_wire(single_node):
     client.call("cdc_deregister", {"sub_id": sub})
     assert "error" in client.call("cdc_events", {"sub_id": sub})
     client.close()
+
+
+def test_flashback_over_wire(single_node):
+    node, server, pd = single_node
+    client = Client(*server.addr)
+    ctx = {"region_id": FIRST_REGION_ID}
+
+    def txn(key, value):
+        ts = pd.get_tso()
+        client.call("kv_prewrite", {"mutations": [{"op": "put", "key": key, "value": value}],
+                                    "primary_lock": key, "start_version": ts, "context": ctx})
+        client.call("kv_commit", {"keys": [key], "start_version": ts,
+                                  "commit_version": pd.get_tso(), "context": ctx})
+
+    txn(b"fb", b"good")
+    point = pd.get_tso()
+    txn(b"fb", b"bad")
+    r = client.call("kv_flashback_to_version", {
+        "version": point, "start_ts": pd.get_tso(), "commit_ts": pd.get_tso(), "context": ctx,
+    })
+    assert r.get("flashback_keys") == 1
+    r = client.call("kv_get", {"key": b"fb", "version": pd.get_tso(), "context": ctx})
+    assert r["value"] == b"good"
+    client.close()

@@ -281,3 +281,113 @@ def test_concurrent_transfer_consistency(store):
         t.join()
     total = int(store.get(b"acc1", 10**6) or 0) + int(store.get(b"acc2", 10**6) or 0)
     assert total == 200
+
+
+def test_flashback_to_version():
+    """FlashbackToVersion: append-only restore of a range to an earlier
+    version — history intact, locks cleared, later reads see the old state
+    (commands/flashback_to_version.rs)."""
+    from tikv_tpu.storage.txn.commands import FlashbackToVersion
+
+    store = Storage()
+
+    def txn(key, value, ts, cts, op="put"):
+        mut = Mutation.put(Key.from_raw(key), value) if op == "put" else Mutation.delete(Key.from_raw(key))
+        store.sched_txn_command(Prewrite([mut], key, ts))
+        store.sched_txn_command(Commit([Key.from_raw(key)], ts, cts))
+
+    txn(b"a", b"old-a", 10, 11)
+    txn(b"b", b"old-b", 12, 13)
+    # mutations after the flashback point (version=20):
+    txn(b"a", b"new-a", 30, 31)      # update
+    txn(b"b", None, 32, 33, "delete")  # delete
+    txn(b"c", b"new-c", 34, 35)      # created after version
+    big = b"x" * 5000
+    txn(b"d", big, 36, 37)           # long value created after version
+    # a dangling lock in range
+    store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"e"), b"locked")], b"e", 40))
+    assert store.scan_lock(None, None, 100)
+
+    r = store.sched_txn_command(FlashbackToVersion(version=20, start_ts=50, commit_ts=51))
+    assert r["flashback_keys"] == 4  # a, b, c, d all diverged from v20
+
+    # post-flashback reads = state at version 20
+    assert store.get(b"a", 60) == b"old-a"
+    assert store.get(b"b", 60) == b"old-b"
+    assert store.get(b"c", 60) is None
+    assert store.get(b"d", 60) is None
+    assert store.scan_lock(None, None, 100) == []  # locks cleared
+    # MVCC history below the flashback commit is intact
+    assert store.get(b"a", 31) == b"new-a"
+    assert store.get(b"b", 33) is None
+    assert store.get(b"d", 38) == big
+    # idempotent-ish: a second flashback to the same version changes nothing
+    r2 = store.sched_txn_command(FlashbackToVersion(version=20, start_ts=70, commit_ts=71))
+    assert r2["flashback_keys"] == 0
+
+
+def test_flashback_range_bounds():
+    from tikv_tpu.storage.txn.commands import FlashbackToVersion
+
+    store = Storage()
+    for i, k in enumerate([b"k1", b"k2", b"k3"]):
+        ts = 10 + 2 * i
+        store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(k), b"v1")], k, ts))
+        store.sched_txn_command(Commit([Key.from_raw(k)], ts, ts + 1))
+    for i, k in enumerate([b"k1", b"k2", b"k3"]):
+        ts = 30 + 2 * i
+        store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(k), b"v2")], k, ts))
+        store.sched_txn_command(Commit([Key.from_raw(k)], ts, ts + 1))
+    # flashback only [k2, k3)
+    r = store.sched_txn_command(
+        FlashbackToVersion(
+            version=20, start_ts=50, commit_ts=51,
+            start_key=Key.from_raw(b"k2"), end_key=Key.from_raw(b"k3"),
+        )
+    )
+    assert r["flashback_keys"] == 1
+    assert store.get(b"k1", 60) == b"v2"  # outside range: untouched
+    assert store.get(b"k2", 60) == b"v1"  # flashed back
+    assert store.get(b"k3", 60) == b"v2"
+
+
+def test_flashback_review_fixes():
+    """Dangling lock on a key WITH history must not abort the flashback; the
+    superseded txn cannot commit afterwards; concurrent writers serialize."""
+    from tikv_tpu.storage.txn.commands import FlashbackToVersion
+
+    store = Storage()
+    store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"a"), b"v1")], b"a", 10))
+    store.sched_txn_command(Commit([Key.from_raw(b"a")], 10, 11))
+    store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"a"), b"v2")], b"a", 30))
+    store.sched_txn_command(Commit([Key.from_raw(b"a")], 30, 31))
+    # dangling lock ON a key that also has post-version writes, with a LONG
+    # value (CF_DEFAULT orphan candidate)
+    big = b"L" * 1000
+    store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"a"), big)], b"a", 40))
+    r = store.sched_txn_command(FlashbackToVersion(version=20, start_ts=50, commit_ts=51))
+    assert "errors" not in r
+    assert store.get(b"a", 60) == b"v1"
+    # the superseded txn's commit must fail loudly (its lock was rolled
+    # back with a protected marker)
+    from tikv_tpu.storage.mvcc.txn import TxnLockNotFoundError
+
+    with pytest.raises(TxnLockNotFoundError):
+        store.sched_txn_command(Commit([Key.from_raw(b"a")], 40, 70))
+    assert store.get(b"a", 80) == b"v1"  # v40's big value never lands
+
+
+def test_flashback_rejects_racing_commit():
+    """A write committed at/after the flashback's commit_ts fails the command
+    loudly — the restore record would otherwise be silently shadowed."""
+    from tikv_tpu.storage.mvcc.reader import WriteConflictError
+    from tikv_tpu.storage.txn.commands import FlashbackToVersion
+
+    store = Storage()
+    store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"r"), b"v1")], b"r", 10))
+    store.sched_txn_command(Commit([Key.from_raw(b"r")], 10, 11))
+    # a commit that lands AFTER the flashback's TSOs were fetched
+    store.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"r"), b"late")], b"r", 60))
+    store.sched_txn_command(Commit([Key.from_raw(b"r")], 60, 61))
+    with pytest.raises(WriteConflictError):
+        store.sched_txn_command(FlashbackToVersion(version=20, start_ts=50, commit_ts=51))

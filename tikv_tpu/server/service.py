@@ -300,6 +300,20 @@ class KvService:
         except Exception as e:  # noqa: BLE001
             return {"error": _err(e)}
 
+    def kv_flashback_to_version(self, req: dict) -> dict:
+        """FlashbackToVersion (kvproto kvrpcpb.FlashbackToVersionRequest)."""
+        cmd = cmds.FlashbackToVersion(
+            version=req["version"],
+            start_ts=req["start_ts"],
+            commit_ts=req["commit_ts"],
+            start_key=Key.from_raw(req["start_key"]) if req.get("start_key") else None,
+            end_key=Key.from_raw(req["end_key"]) if req.get("end_key") else None,
+        )
+        try:
+            return self.storage.sched_txn_command(cmd, req.get("context"))
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
     def kv_resolve_lock(self, req: dict) -> dict:
         cmd = cmds.ResolveLock(
             req["start_version"],

@@ -301,3 +301,80 @@ class ResolveLock(Command):
             else:
                 rollback_key(txn, reader, k, self.start_ts)
         return txn, {"resolved": len(keys)}
+
+
+@dataclass
+class FlashbackToVersion(Command):
+    """Restore a key range to its state as of ``version``
+    (commands/flashback_to_version.rs + flashback_to_version_read_phase.rs,
+    folded into one command for the in-process scheduler): every key whose
+    newest write landed after ``version`` gets a NEW record at ``commit_ts``
+    reinstating the old value (or a DELETE if the key didn't exist) — MVCC
+    history below ``commit_ts`` stays intact, so this is an append-only,
+    replayable operation.  All locks in the range are cleared first, exactly
+    like the reference's prepare phase."""
+
+    version: int
+    start_ts: int
+    commit_ts: int
+    start_key: Key | None = None
+    end_key: Key | None = None
+
+    # flashback's correctness depends on its snapshot being the write-time
+    # state: take every latch slot (the reference serializes via an
+    # exclusive prepare phase)
+    exclusive = True
+
+    def latch_keys(self) -> list[bytes]:
+        return []
+
+    def process_write(self, snapshot: Snapshot):
+        from ..engine import CF_WRITE
+        from ..txn_types import SHORT_VALUE_MAX_LEN, Write, split_ts
+
+        txn = MvccTxn(self.start_ts)
+        reader = MvccReader(snapshot)
+        # 1. ROLL BACK every lock in range (flashback supersedes in-flight
+        # txns): rollback_key also removes orphaned CF_DEFAULT prewrite
+        # values and leaves a protected rollback marker so the superseded
+        # txn cannot re-prewrite + commit after the flashback
+        for k, lock in reader.scan_locks(self.start_key, self.end_key):
+            rollback_key(txn, reader, k, lock.ts, protect=True)
+        # 2. every user key with any write newer than `version` gets reset
+        start_enc = self.start_key.encoded if self.start_key else b""
+        end_enc = self.end_key.encoded if self.end_key else None
+        changed = 0
+        last_user: bytes | None = None
+        for wkey, _wval in snapshot.scan_cf(CF_WRITE, start_enc, end_enc):
+            user_enc, commit_ts = split_ts(wkey)
+            if user_enc == last_user:
+                continue  # CF_WRITE is newest-first per key
+            last_user = user_enc
+            if commit_ts >= self.commit_ts:
+                # a write committed after our TSOs were fetched: the restore
+                # record would be silently shadowed — fail loudly so the
+                # client retries with fresh timestamps (the reference closes
+                # this window with its blocking prepare phase)
+                raise WriteConflictError(
+                    Key.from_encoded(user_enc).to_raw(), self.start_ts, 0, commit_ts
+                )
+            if commit_ts <= self.version:
+                continue  # newest write predates the flashback point: keep
+            key = Key.from_encoded(user_enc)
+            # RC isolation: in-range locks are being rolled back in this very
+            # batch, so the snapshot's lock records must not abort the reads
+            old_value = reader.get(key, self.version, isolation=IsolationLevel.RC)
+            current = reader.get(key, self.start_ts, isolation=IsolationLevel.RC)
+            if old_value == current:
+                continue
+            if old_value is None:
+                txn.put_write(key, self.commit_ts, Write(WriteType.DELETE, self.start_ts))
+            else:
+                w = Write(WriteType.PUT, self.start_ts)
+                if len(old_value) <= SHORT_VALUE_MAX_LEN:
+                    w.short_value = old_value
+                else:
+                    txn.put_value(key, self.start_ts, old_value)
+                txn.put_write(key, self.commit_ts, w)
+            changed += 1
+        return txn, {"flashback_keys": changed}

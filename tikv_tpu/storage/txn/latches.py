@@ -28,9 +28,16 @@ class Latches:
     def _slot_ids(self, keys: list[bytes]) -> list[int]:
         return sorted({hash(k) % self.size for k in keys})
 
+    def acquire_all(self, cid: int) -> list[int]:
+        """Exclusive acquisition of EVERY slot — range commands (flashback)
+        that must serialize against all per-key writers."""
+        return self._acquire_slots(cid, list(range(self.size)))
+
     def acquire(self, cid: int, keys: list[bytes]) -> list[int]:
         """Enqueue cid on each slot and block until it is at every front."""
-        slots = self._slot_ids(keys)
+        return self._acquire_slots(cid, self._slot_ids(keys))
+
+    def _acquire_slots(self, cid: int, slots: list[int]) -> list[int]:
         with self._cv:
             for s in slots:
                 self._slots[s].append(cid)

@@ -26,8 +26,12 @@ class Scheduler:
 
     def run_command(self, cmd: Command, ctx: dict | None = None):
         cid = self.latches.gen_cid()
-        keys = cmd.latch_keys()
-        slots = self.latches.acquire(cid, keys)
+        if getattr(cmd, "exclusive", False):
+            # range commands whose snapshot must BE the write-time state
+            # (flashback) take every latch slot — full mutual exclusion
+            slots = self.latches.acquire_all(cid)
+        else:
+            slots = self.latches.acquire(cid, cmd.latch_keys())
         try:
             fail_point("scheduler_async_snapshot")
             snapshot = self.engine.snapshot(ctx)
