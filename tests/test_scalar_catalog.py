@@ -360,3 +360,57 @@ def test_date_review_fixes_round2():
     dec31 = Constant(pack_datetime(2023, 12, 31), ET.DATETIME)
     d, _ = _run(call("date_format", dec31, const_bytes(b"%U")))
     assert d[0] == b"53"
+
+
+def test_interval_and_unix_timestamp():
+    from tikv_tpu.copr.rpn import Constant
+    from tikv_tpu.copr.datatypes import EvalType as ET
+    from tikv_tpu.copr.mysql_time import pack_datetime, unpack_datetime
+
+    dt = lambda *a: Constant(pack_datetime(*a), ET.DATETIME)
+    d, _ = _run(call("date_add", dt(2026, 1, 31), const_int(1), const_bytes(b"MONTH")))
+    assert unpack_datetime(int(d[0]))[:3] == (2026, 2, 28)  # day clamped
+    d, _ = _run(call("date_add", dt(2024, 1, 31), const_int(1), const_bytes(b"MONTH")))
+    assert unpack_datetime(int(d[0]))[:3] == (2024, 2, 29)  # leap year
+    d, _ = _run(call("date_add", dt(2026, 7, 29, 23, 30), const_int(45), const_bytes(b"MINUTE")))
+    assert unpack_datetime(int(d[0]))[:5] == (2026, 7, 30, 0, 15)  # day rollover
+    d, _ = _run(call("date_sub", dt(2026, 1, 1), const_int(1), const_bytes(b"DAY")))
+    assert unpack_datetime(int(d[0]))[:3] == (2025, 12, 31)
+    d, _ = _run(call("date_add", dt(2026, 3, 15), const_int(-2), const_bytes(b"QUARTER")))
+    assert unpack_datetime(int(d[0]))[:3] == (2025, 9, 15)
+    d, nl = _run(call("date_add", dt(9999, 12, 31), const_int(1), const_bytes(b"DAY")))
+    assert nl[0]  # out of range -> NULL
+    # unknown unit -> loud error at eval
+    with pytest.raises(ValueError, match="unknown interval unit"):
+        _run(call("date_add", dt(2026, 1, 1), const_int(1), const_bytes(b"FORTNIGHT")))
+    # unix timestamp round trip (UTC session tz)
+    d, _ = _run(call("unix_timestamp", dt(2026, 7, 29, 12, 0, 0)))
+    import datetime
+    expect = int((datetime.datetime(2026, 7, 29, 12) - datetime.datetime(1970, 1, 1)).total_seconds())
+    assert d[0] == expect
+    d, _ = _run(call("from_unixtime", const_int(expect)))
+    assert unpack_datetime(int(d[0]))[:4] == (2026, 7, 29, 12)
+    d, _ = _run(call("unix_timestamp", dt(1960, 1, 1)))
+    assert d[0] == 0  # pre-epoch -> 0 (MySQL)
+    d, nl = _run(call("from_unixtime", const_int(-5)))
+    assert nl[0]
+
+
+def test_interval_boundary_fixes():
+    from tikv_tpu.copr.rpn import Constant
+    from tikv_tpu.copr.datatypes import EvalType as ET
+    from tikv_tpu.copr.mysql_time import pack_datetime, unpack_datetime
+
+    dt = lambda *a: Constant(pack_datetime(*a), ET.DATETIME)
+    # December 9999 month arithmetic must not construct year 10000
+    d, nl = _run(call("date_add", dt(9999, 11, 15), const_int(1), const_bytes(b"MONTH")))
+    assert not nl[0] and unpack_datetime(int(d[0]))[:3] == (9999, 12, 15)
+    # underflow below year 1 -> NULL, not a crash
+    d, nl = _run(call("date_add", dt(1, 1, 15), const_int(-1), const_bytes(b"MONTH")))
+    assert nl[0]
+    # huge second offsets -> NULL, not OverflowError mid-dict
+    d, nl = _run(call("date_add", dt(2026, 1, 1), const_int(2_000_000_000_000), const_bytes(b"SECOND")))
+    assert nl[0]
+    # TIMESTAMP cap second with microseconds still converts
+    d, _ = _run(call("unix_timestamp", dt(2038, 1, 19, 3, 14, 7, 1)))
+    assert d[0] == 2147483647

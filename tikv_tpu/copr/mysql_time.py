@@ -175,13 +175,18 @@ _nullable_dt_int("day_of_week", lambda p: _as_date(p).toordinal() % 7 + 1)  # 1=
 _nullable_dt_int("week_day", lambda p: _as_date(p).weekday())  # 0=Monday
 _nullable_dt_int("day_of_year", lambda p: _as_date(p).timetuple().tm_yday)
 _nullable_dt_int("quarter", lambda p: (_ymd(p)[1] + 2) // 3)
+
+
+def _last_dom(y: int, m: int) -> int:
+    """Last day of month (shared by last_day and the month-arithmetic
+    clamp); December 9999 must not construct year 10000."""
+    if m == 12:
+        return 31
+    return (_dt.date(y, m + 1, 1) - _dt.timedelta(days=1)).day
 _nullable_dt_int("to_days", lambda p: _as_date(p).toordinal() + 365)
 _nullable_dt_int(
     "last_day",
-    lambda p: pack_datetime(
-        _ymd(p)[0], _ymd(p)[1],
-        ((_dt.date(_ymd(p)[0] + (_ymd(p)[1] == 12), _ymd(p)[1] % 12 + 1, 1)) - _dt.timedelta(days=1)).day,
-    ),
+    lambda p: pack_datetime(_ymd(p)[0], _ymd(p)[1], _last_dom(_ymd(p)[0], _ymd(p)[1])),
 )
 
 
@@ -407,3 +412,95 @@ def _k_str_to_date(raw, fmt):
 
 
 _reg_nullable_int("str_to_date", 2, _k_str_to_date)
+
+
+# -- interval arithmetic + unix-timestamp family (impl_time.rs date_add /
+# date_sub / unix_timestamp / from_unixtime) --------------------------------
+
+_INTERVAL_UNITS = {
+    "MICROSECOND", "SECOND", "MINUTE", "HOUR", "DAY", "WEEK",
+    "MONTH", "QUARTER", "YEAR",
+}
+
+
+def date_add(packed: int, n: int, unit: str):
+    """DATE_ADD/DATE_SUB (negative n).  Returns None (SQL NULL) when the
+    result leaves MySQL's supported range, like the reference."""
+    unit = unit.upper()
+    if unit not in _INTERVAL_UNITS:
+        raise ValueError(f"unknown interval unit {unit!r}")
+    y, mo, d, hh, mi, ss, us = unpack_datetime(packed)
+    try:
+        base = _dt.datetime(y, mo, d, hh, mi, ss, us)
+    except ValueError:
+        return None
+    if unit in ("YEAR", "QUARTER", "MONTH"):
+        months = n * {"YEAR": 12, "QUARTER": 3, "MONTH": 1}[unit]
+        total = (base.year * 12 + base.month - 1) + months
+        ny, nm = divmod(total, 12)
+        nm += 1
+        if not 1 <= ny <= 9999:
+            return None
+        try:
+            # clamp the day to the target month's length (MySQL rule)
+            base = base.replace(year=ny, month=nm, day=min(base.day, _last_dom(ny, nm)))
+        except (ValueError, OverflowError):
+            return None
+    else:
+        kw = {
+            "MICROSECOND": "microseconds", "SECOND": "seconds",
+            "MINUTE": "minutes", "HOUR": "hours", "DAY": "days", "WEEK": "weeks",
+        }[unit]
+        try:
+            base = base + _dt.timedelta(**{kw: n})
+        except (OverflowError, ValueError):
+            return None
+    if not 1 <= base.year <= 9999:
+        return None
+    return pack_datetime(
+        base.year, base.month, base.day, base.hour, base.minute, base.second,
+        base.microsecond,
+    )
+
+
+def _k_date_add(v, n, unit):
+    return date_add(int(v), int(n), unit.decode("utf-8", "replace"))
+
+
+def _k_date_sub(v, n, unit):
+    return date_add(int(v), -int(n), unit.decode("utf-8", "replace"))
+
+
+_reg_nullable_int("date_add", 3, _k_date_add)
+_reg_nullable_int("date_sub", 3, _k_date_sub)
+
+_EPOCH = _dt.datetime(1970, 1, 1)
+# MySQL TIMESTAMP cap second is 2038-01-19 03:14:07 with ANY microseconds
+_TS_MAX = _dt.datetime(2038, 1, 19, 3, 14, 7, 999999)
+
+
+def _k_unix_timestamp(v):
+    """UNIX_TIMESTAMP(dt): seconds since epoch, 0 outside the TIMESTAMP
+    range (MySQL semantics; session timezone = UTC here)."""
+    y, mo, d, hh, mi, ss, us = unpack_datetime(int(v))
+    try:
+        t = _dt.datetime(y, mo, d, hh, mi, ss, us)
+    except ValueError:
+        return 0
+    if t < _EPOCH or t > _TS_MAX:
+        return 0
+    return int((t - _EPOCH).total_seconds())
+
+
+_reg_nullable_int("unix_timestamp", 1, _k_unix_timestamp)
+
+
+def _k_from_unixtime(n):
+    n = int(n)
+    if n < 0 or n > int((_TS_MAX - _EPOCH).total_seconds()):
+        return None
+    t = _EPOCH + _dt.timedelta(seconds=n)
+    return pack_datetime(t.year, t.month, t.day, t.hour, t.minute, t.second)
+
+
+_reg_nullable_int("from_unixtime", 1, _k_from_unixtime)
