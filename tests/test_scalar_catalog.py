@@ -414,3 +414,26 @@ def test_interval_boundary_fixes():
     # TIMESTAMP cap second with microseconds still converts
     d, _ = _run(call("unix_timestamp", dt(2038, 1, 19, 3, 14, 7, 1)))
     assert d[0] == 2147483647
+
+
+def test_regexp_replace_backrefs():
+    # $N group references (MySQL/ICU syntax)
+    d, _ = _run(call("regexp_replace", const_bytes(b"John Smith"),
+                     const_bytes(rb"(\w+) (\w+)"), const_bytes(b"$2, $1")))
+    assert d[0] == b"Smith, John"
+    # \$ escapes a literal dollar; backslash escapes pass through literally
+    d, _ = _run(call("regexp_replace", const_bytes(b"price 42"),
+                     const_bytes(rb"(\d+)"), const_bytes(rb"\$$1.00")))
+    assert d[0] == b"price $42.00"
+    # backslash consumes the next char (ICU rule): backslash-t -> literal t,
+    # double backslash -> one literal backslash (never a python \g escape)
+    d, _ = _run(call("regexp_replace", const_bytes(b"ab"),
+                     const_bytes(b"a"), const_bytes(rb"c:\temp")))
+    assert d[0] == b"c:tempb"
+    d, _ = _run(call("regexp_replace", const_bytes(b"ab"),
+                     const_bytes(b"a"), const_bytes(b"c:\\\\temp")))
+    assert d[0] == b"c:\\tempb"
+    # invalid group -> NULL (pattern has 1 group, $2 invalid)
+    d, nl = _run(call("regexp_replace", const_bytes(b"x"),
+                      const_bytes(b"(x)"), const_bytes(b"$2")))
+    assert nl[0]

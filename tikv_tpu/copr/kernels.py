@@ -1094,11 +1094,33 @@ def _regexp_instr(s_, pat):
 _reg_nullable_int("regexp_instr", 2, _regexp_instr)
 
 
+def _icu_repl_to_py(repl: bytes) -> bytes:
+    """MySQL/ICU replacement syntax → python re replacement: $N becomes a
+    group reference, backslash escapes the next character literally, and
+    everything else (incl. python-special backslashes) is literal."""
+    out = bytearray()
+    i = 0
+    while i < len(repl):
+        c = repl[i]
+        if c == 0x5C and i + 1 < len(repl):  # backslash: next char literal
+            nxt = repl[i + 1]
+            out += b"\\\\" if nxt == 0x5C else bytes([nxt])
+            i += 2
+        elif c == 0x24 and i + 1 < len(repl) and 0x30 <= repl[i + 1] <= 0x39:
+            out += b"\\g<" + bytes([repl[i + 1]]) + b">"
+            i += 2
+        elif c == 0x5C:
+            out += b"\\\\"  # trailing backslash: literal
+            i += 1
+        else:
+            out += bytes([c])
+            i += 1
+    return bytes(out)
+
+
 def _regexp_replace(s_, pat, repl):
-    # replacement is literal (no $N backrefs yet — MySQL/ICU's $N would need
-    # translation to python's \N); a lambda sidesteps re's escape handling
     try:
-        return _rx(pat).sub(lambda _m: repl, s_)
+        return _rx(pat).sub(_icu_repl_to_py(repl), s_)
     except _re.error:
         return None
 
