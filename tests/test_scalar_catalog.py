@@ -437,3 +437,10 @@ def test_regexp_replace_backrefs():
     d, nl = _run(call("regexp_replace", const_bytes(b"x"),
                       const_bytes(b"(x)"), const_bytes(b"$2")))
     assert nl[0]
+
+
+def test_regexp_replace_multidigit_groups():
+    pat = b"(" + b")(".join(b"abcdefghijkl"[i:i+1] for i in range(12)) + b")"
+    # 12 groups: $12 must reference group 12, not group 1 + literal '2'
+    d, _ = _run(call("regexp_replace", const_bytes(b"abcdefghijkl"), const_bytes(pat), const_bytes(b"$12$1")))
+    assert d[0] == b"la"

@@ -1095,9 +1095,14 @@ _reg_nullable_int("regexp_instr", 2, _regexp_instr)
 
 
 def _icu_repl_to_py(repl: bytes) -> bytes:
-    """MySQL/ICU replacement syntax → python re replacement: $N becomes a
-    group reference, backslash escapes the next character literally, and
-    everything else (incl. python-special backslashes) is literal."""
+    """MySQL/ICU replacement syntax → python re replacement: $N (greedy
+    multi-digit, like ICU) becomes a group reference, backslash escapes the
+    next character literally, and everything else (incl. python-special
+    backslashes) is literal.  Cached per replacement bytes — this runs on
+    the per-row hot path."""
+    cached = _repl_cache.get(repl)
+    if cached is not None:
+        return cached
     out = bytearray()
     i = 0
     while i < len(repl):
@@ -1107,15 +1112,25 @@ def _icu_repl_to_py(repl: bytes) -> bytes:
             out += b"\\\\" if nxt == 0x5C else bytes([nxt])
             i += 2
         elif c == 0x24 and i + 1 < len(repl) and 0x30 <= repl[i + 1] <= 0x39:
-            out += b"\\g<" + bytes([repl[i + 1]]) + b">"
-            i += 2
+            j = i + 1
+            while j < len(repl) and 0x30 <= repl[j] <= 0x39:
+                j += 1
+            out += b"\\g<" + repl[i + 1 : j] + b">"
+            i = j
         elif c == 0x5C:
             out += b"\\\\"  # trailing backslash: literal
             i += 1
         else:
             out += bytes([c])
             i += 1
-    return bytes(out)
+    result = bytes(out)
+    if len(_repl_cache) > 512:
+        _repl_cache.clear()
+    _repl_cache[repl] = result
+    return result
+
+
+_repl_cache: dict = {}
 
 
 def _regexp_replace(s_, pat, repl):
