@@ -597,3 +597,100 @@ def test_region_properties(cluster):
     assert props["size"]["write"]["keys"] == 7
     assert props["middle_key"] is not None
     assert Debugger(leader.store.engine).region_properties(9999) is None
+
+
+def test_witness_replica():
+    """Witness (raftstore witness feature): a log-only voter — counts toward
+    quorum and elections, stores NO data, never campaigns, never serves
+    stale reads, and receives meta-only snapshots."""
+    from tikv_tpu.raft.core import MsgType
+
+    c = Cluster(4)
+    c.bootstrap_subset([1, 2])
+    c.elect_leader(FIRST_REGION_ID, 1)
+    c.must_put(b"w1", b"v1")
+    wpid = c.add_witness(FIRST_REGION_ID, 3)
+    c.tick(5)
+    leader = c.wait_leader(FIRST_REGION_ID)
+    assert wpid in leader.node.voters and wpid in leader.node.witnesses
+    # witness peer exists, advances its applied index, but stores NO data
+    wpeer = c.stores[3].peers[FIRST_REGION_ID]
+    assert wpeer.node.applied > 0
+    assert c.get_on_store(3, b"w1") is None
+    c.must_put(b"w2", b"v2")
+    c.tick(3)
+    assert c.get_on_store(3, b"w2") is None  # still no data
+    assert c.get_on_store(2, b"w2") == b"v2"  # data replica has it
+    # quorum arithmetic: data replica 2 dies; leader + witness = 2/3 quorum
+    c.stop_node(2)
+    c.must_put(b"w3", b"v3")
+    assert c.must_get(b"w3") == b"v3"
+    c.restart_node(2)
+    c.tick(5)
+    assert c.get_on_store(2, b"w3") == b"v3"
+    # witness never campaigns on timeout
+    c.stop_node(1)
+    c.tick(60)
+    lp = c.leader_peer(FIRST_REGION_ID)
+    assert lp is None or lp.store.store_id != 3
+    c.restart_node(1)
+    c.tick(10)
+    # witness role survives crash recovery of the witness store
+    from tikv_tpu.raft.store import Store
+
+    ns = Store(3, c.transport, engine=c.stores[3].engine)
+    assert ns.recover() == 1
+    assert ns.peers[FIRST_REGION_ID].peer_id in ns.peers[FIRST_REGION_ID].node.witnesses
+
+
+def test_witness_rejects_stale_reads():
+    from tikv_tpu.raft.region import NotLeaderError
+    from tikv_tpu.sidecar.resolved_ts import ResolvedTsEndpoint
+
+    c = Cluster(4)
+    c.bootstrap_subset([1, 2])
+    c.elect_leader(FIRST_REGION_ID, 1)
+    c.add_witness(FIRST_REGION_ID, 3)
+    c.tick(5)
+    kv = c.raftkv(3)
+    kv.resolved_ts = type("RT", (), {"progress_of": staticmethod(lambda rid: (10**18, 0))})()
+    with pytest.raises(NotLeaderError):
+        kv.snapshot({"region_id": FIRST_REGION_ID, "stale_read": True, "read_ts": 5})
+
+
+def test_witness_review_fixes():
+    """Split inherits the witness role; leadership transfer to a witness is
+    refused; witness->data conversion reseeds with a full snapshot."""
+    c = Cluster(4)
+    c.bootstrap_subset([1, 2])
+    c.elect_leader(FIRST_REGION_ID, 1)
+    c.must_put(b"a", b"1")
+    c.must_put(b"m", b"2")
+    wpid = c.add_witness(FIRST_REGION_ID, 3)
+    c.tick(5)
+    # split: both children keep the witness role on store 3
+    right = c.split_region(FIRST_REGION_ID, b"m")
+    for rid in (FIRST_REGION_ID, right):
+        p3 = c.stores[3].peers[rid]
+        assert p3.peer_id in p3.node.witnesses, rid
+        me = p3.region.peer_by_id(p3.peer_id)
+        assert me.role == "witness"
+    c.must_put(b"z", b"3")
+    c.tick(3)
+    assert c.get_on_store(3, b"z") is None  # child witness still log-only
+    # transfer to the witness is refused: it never becomes candidate
+    w = c.stores[3].peers[right]
+    w.node.campaign()
+    c.process()
+    assert not w.node.is_leader()
+    # witness -> data voter conversion reseeds via snapshot
+    leader = c.wait_leader(right)
+    cmd = {
+        "epoch": (leader.region.epoch.conf_ver, leader.region.epoch.version),
+        "ops": [],
+        "admin": ("conf_change", "add", w.peer_id, 3),
+    }
+    c._run_admin(leader, cmd)
+    c.tick(8)
+    assert w.peer_id not in c.wait_leader(right).node.witnesses
+    assert c.get_on_store(3, b"z") == b"3"  # data arrived with the reseed

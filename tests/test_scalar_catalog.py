@@ -444,3 +444,12 @@ def test_regexp_replace_multidigit_groups():
     # 12 groups: $12 must reference group 12, not group 1 + literal '2'
     d, _ = _run(call("regexp_replace", const_bytes(b"abcdefghijkl"), const_bytes(pat), const_bytes(b"$12$1")))
     assert d[0] == b"la"
+
+
+def test_regexp_group_number_bounding():
+    # "$12" with one group: ICU takes the longest VALID group -> group 1 + "2"
+    d, nl = _run(call("regexp_replace", const_bytes(b"ab"), const_bytes(b"(a)"), const_bytes(b"$12")))
+    assert not nl[0] and d[0] == b"a2b"
+    # single-digit invalid group still errors to NULL
+    d, nl = _run(call("regexp_replace", const_bytes(b"x"), const_bytes(b"(x)"), const_bytes(b"$9")))
+    assert nl[0]

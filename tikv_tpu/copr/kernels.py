@@ -1094,13 +1094,14 @@ def _regexp_instr(s_, pat):
 _reg_nullable_int("regexp_instr", 2, _regexp_instr)
 
 
-def _icu_repl_to_py(repl: bytes) -> bytes:
-    """MySQL/ICU replacement syntax → python re replacement: $N (greedy
-    multi-digit, like ICU) becomes a group reference, backslash escapes the
-    next character literally, and everything else (incl. python-special
-    backslashes) is literal.  Cached per replacement bytes — this runs on
-    the per-row hot path."""
-    cached = _repl_cache.get(repl)
+def _icu_repl_to_py(repl: bytes, n_groups: int) -> bytes:
+    """MySQL/ICU replacement syntax → python re replacement: $N consumes
+    the LONGEST digit run that is still a valid group number (ICU rule:
+    "$12" with one group means group 1 + literal '2'), backslash escapes
+    the next character literally, and everything else (incl. python-special
+    backslashes) is literal.  Cached per (replacement, group count) — this
+    runs on the per-row hot path."""
+    cached = _repl_cache.get((repl, n_groups))
     if cached is not None:
         return cached
     out = bytearray()
@@ -1115,8 +1116,12 @@ def _icu_repl_to_py(repl: bytes) -> bytes:
             j = i + 1
             while j < len(repl) and 0x30 <= repl[j] <= 0x39:
                 j += 1
-            out += b"\\g<" + repl[i + 1 : j] + b">"
-            i = j
+            digits = repl[i + 1 : j]
+            # trim trailing digits until the group number is valid
+            while len(digits) > 1 and int(digits) > n_groups:
+                digits = digits[:-1]
+            out += b"\\g<" + digits + b">"
+            i = i + 1 + len(digits)
         elif c == 0x5C:
             out += b"\\\\"  # trailing backslash: literal
             i += 1
@@ -1126,7 +1131,7 @@ def _icu_repl_to_py(repl: bytes) -> bytes:
     result = bytes(out)
     if len(_repl_cache) > 512:
         _repl_cache.clear()
-    _repl_cache[repl] = result
+    _repl_cache[repl, n_groups] = result
     return result
 
 
@@ -1135,7 +1140,8 @@ _repl_cache: dict = {}
 
 def _regexp_replace(s_, pat, repl):
     try:
-        return _rx(pat).sub(_icu_repl_to_py(repl), s_)
+        rx = _rx(pat)
+        return rx.sub(_icu_repl_to_py(repl, rx.groups), s_)
     except _re.error:
         return None
 

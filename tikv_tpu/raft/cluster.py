@@ -207,6 +207,18 @@ class Cluster:
         self._run_admin(leader, cmd)
         return new_pid
 
+    def add_witness(self, region_id: int, store_id: int) -> int:
+        """Add a log-only voting replica (the raftstore witness feature)."""
+        leader = self.wait_leader(region_id)
+        pid = self.alloc_id()
+        cmd = {
+            "epoch": (leader.region.epoch.conf_ver, leader.region.epoch.version),
+            "ops": [],
+            "admin": ("conf_change", "add_witness", pid, store_id),
+        }
+        self._run_admin(leader, cmd)
+        return pid
+
     def add_learner(self, region_id: int, store_id: int) -> int:
         leader = self.wait_leader(region_id)
         pid = self.alloc_id()

@@ -69,6 +69,7 @@ class Snapshot:
     voters: tuple[int, ...]
     learners: tuple[int, ...] = ()
     outgoing: tuple[int, ...] = ()  # non-empty while a joint change is in flight
+    witnesses: tuple[int, ...] = ()
 
 
 @dataclass
@@ -186,6 +187,14 @@ class RaftNode:
         # None this is the OUTGOING voter config C_old; self.voters is the
         # incoming C_new, and every quorum decision needs a majority of BOTH
         self.outgoing: set[int] | None = None
+        # witnesses (raftstore-v2 witness feature): full voters for quorum
+        # and elections, but they store the LOG only — no data — so they
+        # must never become leader themselves
+        self.witnesses: set[int] = set()
+        # witness->data conversion: the peer is log-caught-up but has NO
+        # data, so the next snapshot must be applied even at an index the
+        # staleness guard would normally skip
+        self.force_accept_snapshot = False
         self.pre_vote = True
         self.term = 0
         self.vote: int | None = None
@@ -357,8 +366,12 @@ class RaftNode:
                 self._elapsed = 0
                 self._broadcast_heartbeat()
         elif self._elapsed >= self._randomized_timeout:
-            if self.id in self.learners or self.id not in self._all_voters():
-                self._elapsed = 0  # learners/removed peers never campaign
+            if (
+                self.id in self.learners
+                or self.id in self.witnesses
+                or self.id not in self._all_voters()
+            ):
+                self._elapsed = 0  # learners/witnesses/removed never campaign
             elif self.pre_vote:
                 self._start_pre_vote()
             else:
@@ -416,7 +429,11 @@ class RaftNode:
     def campaign(self, force: bool = True) -> None:
         """Explicit campaign = leadership transfer (MsgTimeoutNow semantics):
         its votes bypass leader stickiness.  Timeout campaigns (tick) stay
-        sticky so natural disruptions cannot break an active lease."""
+        sticky so natural disruptions cannot break an active lease.
+        Witnesses hold no data and must never lead — transfer attempts are
+        refused here, not just the timeout path."""
+        if self.id in self.witnesses:
+            return
         self._wake()
         self._become_candidate(force=force)
 
@@ -513,13 +530,23 @@ class RaftNode:
             for pid in (self.outgoing or set()) - self.voters - self.learners:
                 self.next_index.pop(pid, None)
                 self.match_index.pop(pid, None)
+            self.witnesses &= self.voters  # dropped witnesses lose the marker
             self.outgoing = None
             if self.role == Role.LEADER:
                 self._maybe_commit()
             return
+        if op == "add_witness":
+            self.voters.add(peer)
+            self.witnesses.add(peer)
+            self.learners.discard(peer)
+            if self.role == Role.LEADER and peer not in self.next_index:
+                self.next_index[peer] = self.log.last_index() + 1
+                self.match_index[peer] = 0
+            return
         if op == "add":
             self.voters.add(peer)
             self.learners.discard(peer)
+            self.witnesses.discard(peer)  # witness->data conversion
             if self.role == Role.LEADER and peer not in self.next_index:
                 self.next_index[peer] = self.log.last_index() + 1
                 self.match_index[peer] = 0
@@ -537,6 +564,7 @@ class RaftNode:
         elif op == "remove":
             self.voters.discard(peer)
             self.learners.discard(peer)
+            self.witnesses.discard(peer)
             self.next_index.pop(peer, None)
             self.match_index.pop(peer, None)
             if self.role == Role.LEADER:
@@ -839,15 +867,17 @@ class RaftNode:
         if snap is None:
             return
         self._become_follower(m.term, m.frm)
-        if snap.index <= self.commit:
+        if snap.index <= self.commit and not self.force_accept_snapshot:
             self._send(Message(MsgType.APPEND_RESP, self.id, m.frm, self.term, log_index=self.commit))
             return
+        self.force_accept_snapshot = False
         self.log.reset_to_snapshot(snap)
         self.commit = snap.index
         self.applied = snap.index
         self.voters = set(snap.voters)
         self.learners = set(snap.learners)
         self.outgoing = set(snap.outgoing) if snap.outgoing else None
+        self.witnesses = set(snap.witnesses)
         self._pending_conf_index = min(self._pending_conf_index, snap.index)
         self._ready.snapshot = snap
         self._ready.hard_state_changed = True

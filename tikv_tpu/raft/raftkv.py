@@ -120,7 +120,9 @@ class RaftKv(Engine):
         ctx = ctx or {}
         if ctx.get("stale_read"):
             # follower stale read: safe at/below the region's resolved-ts
-            # watermark on ANY replica — no leadership or ReadIndex involved
+            # watermark on any DATA replica — witnesses store no data
+            if peer.peer_id in peer.node.witnesses:
+                raise NotLeaderError(peer.region.id, self.store.leader_store_of(peer.region.id))
             if self.resolved_ts is None:
                 raise ValueError("stale reads need a resolved-ts endpoint")
             read_ts = ctx.get("read_ts")

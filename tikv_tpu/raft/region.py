@@ -59,10 +59,14 @@ class Region:
         return None
 
     def voter_ids(self) -> list[int]:
-        return [p.peer_id for p in self.peers if p.role == "voter"]
+        # witnesses ARE voters (log-only ones) — quorum membership includes them
+        return [p.peer_id for p in self.peers if p.role in ("voter", "witness")]
 
     def learner_ids(self) -> list[int]:
         return [p.peer_id for p in self.peers if p.role == "learner"]
+
+    def witness_ids(self) -> list[int]:
+        return [p.peer_id for p in self.peers if p.role == "witness"]
 
     def clone(self) -> "Region":
         return Region(

@@ -239,6 +239,7 @@ class StorePeer:
         self.peer_id = peer_id
         self.node = RaftNode(peer_id, region.voter_ids())
         self.node.learners = set(region.learner_ids())
+        self.node.witnesses = set(region.witness_ids())
         self.proposals: list[Proposal] = []
         self.pending_reads: dict[bytes, Callable] = {}
         self._read_seq = 0
@@ -352,7 +353,7 @@ class StorePeer:
             # carries it) — drop; retries resolve once the entry applies
             return
         if m.type == MsgType.SNAPSHOT and m.snapshot is None:
-            m.snapshot = self._generate_snapshot()
+            m.snapshot = self._generate_snapshot(for_witness=m.to in self.node.witnesses)
         rmsg = RaftMessage(
             region_id=self.region.id,
             from_peer=RegionPeer(self.peer_id, self.store.store_id),
@@ -392,6 +393,11 @@ class StorePeer:
             self._ack(e, {"commit_merge": True}, None)
             return
         fail_point("apply_before_exec")
+        if self.peer_id in self.node.witnesses:
+            # witnesses replicate and vote on the LOG but never materialize
+            # data (raftstore witness feature); acking keeps apply advancing
+            self._ack(e, {"applied_index": e.index}, None)
+            return
         self._exec_data_cmd(cmd, self.region)
         self._ack(e, {"applied_index": e.index}, None)
 
@@ -447,7 +453,12 @@ class StorePeer:
         here too (single-step demotion goes remove → add_learner; joint
         demotion flips the node's sets first, so the role follows)."""
         existing = self.region.peer_by_id(pid)
-        role = "learner" if pid in self.node.learners else "voter"
+        if pid in self.node.witnesses:
+            role = "witness"
+        elif pid in self.node.learners:
+            role = "learner"
+        else:
+            role = "voter"
         if existing is None:
             self.region.peers.append(RegionPeer(pid, sid, role))
             if self.node.is_leader() and pid != self.peer_id:
@@ -480,9 +491,20 @@ class StorePeer:
             return
         if op == "remove":
             self._notify_removed_peer(pid, e.index)
+        was_witness = pid in self.node.witnesses
         self.node.apply_conf_change(e.conf_change)
-        if op in ("add", "add_learner"):
+        if op in ("add", "add_learner", "add_witness"):
             self._sync_added_peer(pid, e.conf_change[2] if len(e.conf_change) > 2 else 0)
+            if op == "add" and was_witness:
+                # witness -> data voter conversion: the peer has NO data and
+                # must be reseeded with a full snapshot before serving
+                if self.node.is_leader() and pid != self.peer_id:
+                    self.node.force_snapshot.add(pid)
+                    self.node._send_append(pid)  # queue the snapshot now
+                elif pid == self.peer_id:
+                    # we are the converted peer: accept the reseed snapshot
+                    # even though our log/commit look fully caught up
+                    self.node.force_accept_snapshot = True
         elif op == "promote":
             existing = self.region.peer_by_id(pid)
             if existing is not None:
@@ -524,7 +546,12 @@ class StorePeer:
         members = node.voters | node.learners
         self.region.peers = [p for p in self.region.peers if p.peer_id in members]
         for p in self.region.peers:
-            p.role = "learner" if p.peer_id in node.learners else "voter"
+            if p.peer_id in node.witnesses:
+                p.role = "witness"
+            elif p.peer_id in node.learners:
+                p.role = "learner"
+            else:
+                p.role = "voter"
         if self.peer_id in dropped:
             self.store.destroy_peer(self.region.id)
 
@@ -532,7 +559,7 @@ class StorePeer:
         _, split_key, new_region_id, new_pids = admin
         old = self.region
         new_peers = [
-            RegionPeer(pid, p.store_id) for pid, p in zip(new_pids, old.peers)
+            RegionPeer(pid, p.store_id, p.role) for pid, p in zip(new_pids, old.peers)
         ]
         new_region = Region(
             id=new_region_id,
@@ -559,7 +586,7 @@ class StorePeer:
         # membership (ConfState): region roles alone can't reconstruct a
         # joint config after a crash — C_old ∩ C_new is ambiguous — so the
         # three sets ride in RaftLocalState
-        out += encode_conf_state(n.voters, n.learners, n.outgoing)
+        out += encode_conf_state(n.voters, n.learners, n.outgoing, n.witnesses)
         return bytes(out)
 
     def _apply_commit_merge(self, admin) -> None:
@@ -632,22 +659,24 @@ class StorePeer:
 
     # -- snapshots ---------------------------------------------------------
 
-    def _generate_snapshot(self) -> RaftSnapshot:
+    def _generate_snapshot(self, for_witness: bool = False) -> RaftSnapshot:
         """Full region-range snapshot of the data CFs + region meta
-        (store/snap.rs; meta rides along like SnapshotMeta)."""
+        (store/snap.rs; meta rides along like SnapshotMeta).  Witness
+        targets get META ONLY — they vote but never store data."""
         fail_point("region_gen_snapshot")
         eng = self.store.engine
         out = bytearray()
         out += codec.encode_compact_bytes(encode_region(self.region, self.merging))
-        start = keys.data_key(self.region.start_key)
-        end = keys.data_end_key(self.region.end_key)
-        for cf in DATA_CFS:
-            items = list(eng.scan_cf(cf, start, end))
-            out += codec.encode_compact_bytes(cf.encode())
-            out += codec.encode_var_u64(len(items))
-            for k, v in items:
-                out += codec.encode_compact_bytes(k)
-                out += codec.encode_compact_bytes(v)
+        if not for_witness:
+            start = keys.data_key(self.region.start_key)
+            end = keys.data_end_key(self.region.end_key)
+            for cf in DATA_CFS:
+                items = list(eng.scan_cf(cf, start, end))
+                out += codec.encode_compact_bytes(cf.encode())
+                out += codec.encode_var_u64(len(items))
+                for k, v in items:
+                    out += codec.encode_compact_bytes(k)
+                    out += codec.encode_compact_bytes(v)
         return RaftSnapshot(
             index=self.node.applied,
             term=self.node.log.term_at(self.node.applied) or self.node.term,
@@ -655,6 +684,7 @@ class StorePeer:
             voters=tuple(self.node.voters),
             learners=tuple(self.node.learners),
             outgoing=tuple(self.node.outgoing or ()),
+            witnesses=tuple(self.node.witnesses),
         )
 
     def _apply_snapshot(self, snap: RaftSnapshot) -> None:
@@ -693,7 +723,7 @@ def encode_region(region: Region, merging: bool = False) -> bytes:
     for p in region.peers:
         out += codec.encode_var_u64(p.peer_id)
         out += codec.encode_var_u64(p.store_id)
-        out.append(1 if p.role == "learner" else 0)
+        out.append({"voter": 0, "learner": 1, "witness": 2}.get(p.role, 0))
     out.append(1 if merging else 0)
     return bytes(out)
 
@@ -710,37 +740,42 @@ def decode_region(b: bytes) -> tuple[Region, bool]:
     for _ in range(n):
         pid, off = codec.decode_var_u64(b, off)
         sid, off = codec.decode_var_u64(b, off)
-        role = "learner" if b[off] == 1 else "voter"
+        role = {0: "voter", 1: "learner", 2: "witness"}.get(b[off], "voter")
         off += 1
         peers.append(RegionPeer(pid, sid, role))
     merging = off < len(b) and b[off] == 1
     return Region(rid, start, end, RegionEpoch(cv, v), peers), merging
 
 
-def encode_conf_state(voters, learners, outgoing) -> bytes:
-    """The ConfState tail of the raft-state blob: 3 varint-counted u64 groups
-    (voters, learners, outgoing).  Shared by persistence, recovery, and the
-    Debugger's unsafe-recover so the layout has exactly one definition."""
+def encode_conf_state(voters, learners, outgoing, witnesses=()) -> bytes:
+    """The ConfState tail of the raft-state blob: varint-counted u64 groups
+    (voters, learners, outgoing, witnesses).  Shared by persistence,
+    recovery, and the Debugger's unsafe-recover so the layout has exactly
+    one definition."""
     out = bytearray()
-    for group in (voters, learners, outgoing or set()):
+    for group in (voters, learners, outgoing or set(), witnesses or set()):
         out += codec.encode_var_u64(len(group))
         for pid in sorted(group):
             out += codec.encode_u64(pid)
     return bytes(out)
 
 
-def decode_conf_state(state: bytes, off: int = 40) -> tuple[set, set, set]:
+def decode_conf_state(state: bytes, off: int = 40) -> tuple[set, set, set, set]:
     """Inverse of encode_conf_state, reading at ``off`` (after the 40-byte
-    fixed term/vote/commit/snapshot header)."""
+    fixed term/vote/commit/snapshot header).  The witness group is optional
+    for blobs persisted before it existed."""
     groups = []
-    for _ in range(3):
+    for gi in range(4):
+        if gi == 3 and off >= len(state):
+            groups.append(set())
+            break
         cnt, off = codec.decode_var_u64(state, off)
         ids = set()
         for _ in range(cnt):
             ids.add(codec.decode_u64(state, off))
             off += 8
         groups.append(ids)
-    return groups[0], groups[1], groups[2]
+    return groups[0], groups[1], groups[2], groups[3]
 
 
 def _encode_entry(e: Entry) -> bytes:
@@ -857,9 +892,10 @@ class Store:
                 node.log.snapshot_term = codec.decode_u64(state, 32)
                 node.log.offset = node.log.snapshot_index + 1
                 if len(state) > 40:  # persisted ConfState (incl. joint config)
-                    voters, learners, outgoing = decode_conf_state(state)
+                    voters, learners, outgoing, witnesses = decode_conf_state(state)
                     node.voters, node.learners = voters, learners
                     node.outgoing = outgoing or None
+                    node.witnesses = witnesses
             applied_raw = snap.get_cf(CF_RAFT, keys.apply_state_key(region.id))
             applied = codec.decode_u64(applied_raw) if applied_raw else 0
             log_prefix = keys.region_raft_prefix(region.id) + keys.RAFT_LOG_SUFFIX

@@ -216,12 +216,15 @@ class Debugger:
                 # rewrite the persisted ConfState minus the dead peers
                 from ..raft.store import decode_conf_state, encode_conf_state
 
-                voters, learners, outgoing = decode_conf_state(state)
+                voters, learners, outgoing, witnesses = decode_conf_state(state)
                 self.engine.put_cf(
                     CF_RAFT,
                     keys.raft_state_key(rid),
                     state[:40]
-                    + encode_conf_state(voters - dead_ids, learners - dead_ids, outgoing - dead_ids),
+                    + encode_conf_state(
+                        voters - dead_ids, learners - dead_ids,
+                        outgoing - dead_ids, witnesses - dead_ids,
+                    ),
                 )
             modified.append(rid)
         return modified
