@@ -399,3 +399,77 @@ def test_flashback_over_wire(single_node):
     r = client.call("kv_get", {"key": b"fb", "version": pd.get_tso(), "context": ctx})
     assert r["value"] == b"good"
     client.close()
+
+
+def test_split_readindex_checkleader_over_wire(single_node):
+    """Appendix-A surface: split_region, read_index, check_leader handlers."""
+    node, server, pd = single_node
+    server.service.pd = pd
+    client = Client(*server.addr)
+    ctx = {"region_id": FIRST_REGION_ID}
+    for k in (b"a", b"m", b"z"):
+        client.call("raw_put", {"key": k, "value": b"v", "context": ctx})
+    r = client.call("kv_read_index", {"context": ctx})
+    assert "error" not in r and r["read_index"] > 0
+    r = client.call("kv_check_leader", {"regions": [FIRST_REGION_ID, 999]})
+    assert r["regions"] == [FIRST_REGION_ID]
+    # raw-mode split: boundaries in raw key space
+    r = client.call("kv_split_region", {"split_key": b"m", "is_raw_kv": True, "context": ctx})
+    assert "error" not in r, r
+    new_id = r["new_region_id"]
+    import time
+
+    deadline = time.time() + 5
+    while time.time() < deadline and new_id not in node.store.peers:
+        time.sleep(0.02)
+    assert new_id in node.store.peers
+    # probe: split at a key now outside the left region
+    r2 = client.call("kv_split_region", {"split_key": b"a", "is_raw_kv": True,
+                                         "context": {"region_id": new_id}})
+    assert "error" in r2  # 'a' not in the right-hand region
+    client.close()
+
+
+def test_txn_split_region_encodes_boundary(single_node):
+    """Txn-mode splits memcomparable-encode the boundary, so user keys on
+    either side keep routing to the correct region."""
+    from tikv_tpu.storage.txn_types import Key as TKey
+
+    node, server, pd = single_node
+    server.service.pd = pd
+    client = Client(*server.addr)
+    ctx = {"region_id": FIRST_REGION_ID}
+
+    def txn(key, value):
+        ts = pd.get_tso()
+        client.call("kv_prewrite", {"mutations": [{"op": "put", "key": key, "value": value}],
+                                    "primary_lock": key, "start_version": ts, "context": ctx})
+        client.call("kv_commit", {"keys": [key], "start_version": ts,
+                                  "commit_version": pd.get_tso(), "context": ctx})
+
+    txn(b"l", b"1")
+    txn(b"m", b"2")
+    r = client.call("kv_split_region", {"split_key": b"m", "context": ctx})
+    assert "error" not in r, r
+    new_id = r["new_region_id"]
+    import time
+
+    deadline = time.time() + 5
+    while time.time() < deadline and new_id not in node.store.peers:
+        time.sleep(0.02)
+    left = node.store.peers[FIRST_REGION_ID].region
+    right = node.store.peers[new_id].region
+    # the encoded user key b"m" is the boundary: b"l" routes left, b"m" right
+    assert left.contains(TKey.from_raw(b"l").encoded)
+    assert right.contains(TKey.from_raw(b"m").encoded)
+    # the new region elects a leader under the background loops
+    deadline = time.time() + 8
+    r = {}
+    while time.time() < deadline:
+        r = client.call("kv_get", {"key": b"m", "version": pd.get_tso(),
+                                   "context": {"region_id": new_id}})
+        if r.get("value") == b"2":
+            break
+        time.sleep(0.1)
+    assert r.get("value") == b"2", r
+    client.close()

@@ -173,11 +173,6 @@ class Cluster:
         leader = self.wait_leader(region_id)
         new_region_id = self.alloc_id()
         new_pids = [self.alloc_id() for _ in leader.region.peers]
-        cmd = {
-            "epoch": (leader.region.epoch.conf_ver, leader.region.epoch.version),
-            "ops": [],
-            "admin": ("split", split_key, new_region_id, new_pids),
-        }
         import threading
 
         done = threading.Event()
@@ -187,7 +182,7 @@ class Cluster:
             res.append(r)
             done.set()
 
-        leader.propose_cmd(cmd, cb)
+        leader.propose_split(split_key, new_region_id, new_pids, cb)
         while not done.is_set():
             self.process()
         if isinstance(res[0], Exception):

@@ -295,6 +295,20 @@ class StorePeer:
             return (cv, v) == (self.region.epoch.conf_ver, self.region.epoch.version)
         return v == self.region.epoch.version
 
+    def propose_split(self, split_key: bytes, new_region_id: int, new_pids: list[int], cb: Callable) -> None:
+        """Propose the split admin command (shared by auto-split, the
+        cluster harness, and the split_region RPC — ONE definition of the
+        admin tuple shape + epoch capture).  ``split_key`` must already be
+        in engine key space (memcomparable-encoded for txn data)."""
+        self.propose_cmd(
+            {
+                "epoch": (self.region.epoch.conf_ver, self.region.epoch.version),
+                "ops": [],
+                "admin": ("split", split_key, new_region_id, new_pids),
+            },
+            cb,
+        )
+
     def read_index(self, cb: Callable) -> None:
         """Linearizable read barrier; cb() fires once safe to read locally."""
         self._read_seq += 1

@@ -131,12 +131,7 @@ class Node:
             return
         new_region_id = self.pd.alloc_id()
         new_pids = [self.pd.alloc_id() for _ in peer.region.peers]
-        cmd = {
-            "epoch": (peer.region.epoch.conf_ver, peer.region.epoch.version),
-            "ops": [],
-            "admin": ("split", split_at, new_region_id, new_pids),
-        }
-        peer.propose_cmd(cmd, lambda r: None)
+        peer.propose_split(split_at, new_region_id, new_pids, lambda r: None)
 
     def _on_split(self, store, old: Region, new: Region) -> None:
         self.pd.report_split(old.clone(), new.clone())

@@ -12,6 +12,8 @@ way the reference maps storage errors into kvproto errors.
 
 from __future__ import annotations
 
+import threading
+
 from ..copr.endpoint import CoprRequest, Endpoint, REQ_TYPE_CHECKSUM, REQ_TYPE_DAG
 from ..raft.region import EpochError, NotLeaderError
 from ..storage.mvcc.reader import KeyIsLockedError, WriteConflictError
@@ -59,7 +61,7 @@ class KvService:
 
     def __init__(
         self, storage: Storage, copr: Endpoint | None = None, copr_v2=None,
-        resource_tags=None, debugger=None, cdc=None,
+        resource_tags=None, debugger=None, cdc=None, pd=None,
     ):
         self.storage = storage
         self.copr = copr
@@ -67,6 +69,7 @@ class KvService:
         self.resource_tags = resource_tags
         self.debugger = debugger
         self.cdc = cdc
+        self.pd = pd
 
     _HANDLER_PREFIXES = ("kv_", "raw_", "coprocessor", "mvcc_", "debug_", "cdc_")
 
@@ -299,6 +302,82 @@ class KvService:
             }
         except Exception as e:  # noqa: BLE001
             return {"error": _err(e)}
+
+    def _raft_store(self):
+        st = getattr(self.storage.engine, "store", None)
+        if st is None:
+            raise RuntimeError("not serving over a raft store")
+        return st
+
+    def kv_split_region(self, req: dict) -> dict:
+        """Manual region split (kv.rs:710 split_region): allocate ids from
+        PD and propose the split admin command on the region leader."""
+        if self.pd is None:
+            return {"error": {"other": "split_region needs a PD client"}}
+        try:
+            store = self._raft_store()
+            region_id = (req.get("context") or {}).get("region_id")
+            peer = store.peers.get(region_id)
+            if peer is None or not peer.node.is_leader():
+                return {"error": {"not_leader": {"region_id": region_id}}}
+            # region boundaries live in ENGINE key space: txn-mode user keys
+            # must be memcomparable-encoded first (kv.rs split_region does
+            # Key::from_raw for non-raw mode) or the boundary would not sort
+            # consistently with the stored keys
+            split_key = req["split_key"]
+            if not req.get("is_raw_kv", False):
+                split_key = Key.from_raw(split_key).encoded
+            if not peer.region.contains(split_key) or split_key == peer.region.start_key:
+                return {"error": {"other": "split key out of region range"}}
+            new_region_id = self.pd.alloc_id()
+            new_pids = [self.pd.alloc_id() for _ in peer.region.peers]
+            done = threading.Event()
+            res: list = []
+            peer.propose_split(
+                split_key, new_region_id, new_pids,
+                lambda r: (res.append(r), done.set()),
+            )
+            if not done.wait(5):
+                return {"error": {"other": "split timed out"}}
+            if isinstance(res[0], Exception):
+                return {"error": _err(res[0])}
+            return {"new_region_id": new_region_id}
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    def kv_read_index(self, req: dict) -> dict:
+        """Linearizable read barrier (kv.rs:796 read_index): returns once a
+        quorum confirms leadership; callers may then read locally."""
+        try:
+            store = self._raft_store()
+            region_id = (req.get("context") or {}).get("region_id") or req.get("region_id")
+            peer = store.peers.get(region_id)
+            if peer is None or not peer.node.is_leader():
+                return {"error": {"not_leader": {"region_id": region_id}}}
+            done = threading.Event()
+            err: list = []
+            peer.read_index(lambda e: (err.append(e) if e is not None else None, done.set()))
+            if not done.wait(5):
+                return {"error": {"other": "read_index timed out"}}
+            if err:
+                return {"error": _err(err[0])}
+            return {"read_index": peer.node.commit}
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    def kv_check_leader(self, req: dict) -> dict:
+        """Leadership confirmation for resolved-ts advance (kv.rs:1005
+        check_leader): of the requested regions, which does this store lead?"""
+        try:
+            store = self._raft_store()
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+        leading = []
+        for rid in req.get("regions", []):
+            peer = store.peers.get(rid)
+            if peer is not None and peer.node.is_leader():
+                leading.append(rid)
+        return {"regions": leading}
 
     def kv_flashback_to_version(self, req: dict) -> dict:
         """FlashbackToVersion (kvproto kvrpcpb.FlashbackToVersionRequest)."""
