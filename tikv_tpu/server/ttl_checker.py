@@ -1,0 +1,102 @@
+"""Raw-KV TTL reclamation worker.
+
+Re-expression of ``src/server/ttl/ttl_checker.rs:32`` +
+``ttl_compaction_filter.rs:14``: the reference reclaims expired raw entries
+during RocksDB compactions (the checker schedules compactions over ranges
+whose TTL properties say they hold expired data).  Without compactions to
+piggyback on, this build sweeps actively: a periodic scan over the raw
+keyspace deletes entries whose expiry timestamp has passed.  Reads already
+filter expired values lazily (storage.py) — the sweeper reclaims the space
+and keeps scans from walking dead entries forever.
+
+The reference's API-V1 rule applies verbatim: TTL-enabled raw KV must not
+coexist with transactional data on the same store (the raw prefix byte can
+collide with memcomparable-encoded txn keys).  The sweeper enforces it by
+refusing to run while CF_WRITE holds any transactional records.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..storage.engine import CF_DEFAULT, CF_WRITE, WriteBatch
+from ..storage.storage import RAW_PREFIX, _NO_TTL
+from ..util import codec
+
+
+class TtlChecker:
+    def __init__(self, storage, interval: float = 5.0, batch: int = 1024):
+        self.storage = storage
+        self.interval = interval
+        self.batch = batch
+        self.reclaimed = 0
+        self.errors = 0
+        self.last_error: str | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def run_once(self, now: float | None = None, ctx: dict | None = None) -> int:
+        """One sweep: delete every expired raw entry.  Returns the count."""
+        now = int(now if now is not None else time.time())
+        snap = self.storage.engine.snapshot(ctx)
+        for _k, _v in snap.scan_cf(CF_WRITE, b"", None, limit=1):
+            raise RuntimeError(
+                "TTL checker requires a raw-mode store: transactional data "
+                "present (API-V1 rule — RawKV TTL must not coexist with txn data)"
+            )
+        end = RAW_PREFIX[:-1] + bytes([RAW_PREFIX[-1] + 1])
+        expired: list[bytes] = []
+        for k, v in snap.scan_cf(CF_DEFAULT, RAW_PREFIX, end):
+            if len(v) < 8:
+                continue  # not a raw-codec value; never touch it
+            expire = codec.decode_u64(v, len(v) - 8)
+            if expire != _NO_TTL and expire <= now:
+                expired.append(k)
+        n = 0
+        latches = self.storage._raw_latches
+        for off in range(0, len(expired), self.batch):
+            chunk = expired[off : off + self.batch]
+            # serialize against concurrent raw writers and RE-CHECK expiry at
+            # delete time — a key re-put after the snapshot must survive (the
+            # reference's compaction filter checks expiry at filter time)
+            cid = latches.gen_cid()
+            slots = latches.acquire(cid, chunk)
+            try:
+                cur = self.storage.engine.snapshot(ctx)
+                wb = WriteBatch()
+                for k in chunk:
+                    v = cur.get_cf(CF_DEFAULT, k)
+                    if v is None or len(v) < 8:
+                        continue
+                    expire = codec.decode_u64(v, len(v) - 8)
+                    if expire != _NO_TTL and expire <= now:
+                        wb.delete_cf(CF_DEFAULT, k)
+                        n += 1
+                if wb.ops:
+                    self.storage.engine.write(ctx, wb)
+            finally:
+                latches.release(cid, slots)
+        self.reclaimed += n
+        return n
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return  # already running — don't orphan the live loop
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.run_once()
+            except Exception as exc:  # noqa: BLE001 — record, don't die
+                self.errors += 1
+                self.last_error = repr(exc)
+            self._stop.wait(self.interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
