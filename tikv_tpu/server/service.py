@@ -28,6 +28,12 @@ def _mutation_from_wire(m: dict) -> Mutation:
     return Mutation(op, Key.from_raw(m["key"]), m.get("value"))
 
 
+def _rewrite_from_wire(req: dict) -> tuple[bytes, bytes] | None:
+    if req.get("rewrite_old") is None:
+        return None
+    return (req["rewrite_old"], req["rewrite_new"])
+
+
 def _err(e: Exception) -> dict:
     if isinstance(e, KeyIsLockedError):
         return {
@@ -61,7 +67,7 @@ class KvService:
 
     def __init__(
         self, storage: Storage, copr: Endpoint | None = None, copr_v2=None,
-        resource_tags=None, debugger=None, cdc=None, pd=None,
+        resource_tags=None, debugger=None, cdc=None, pd=None, importer=None,
     ):
         self.storage = storage
         self.copr = copr
@@ -70,8 +76,34 @@ class KvService:
         self.debugger = debugger
         self.cdc = cdc
         self.pd = pd
+        self.importer = importer
 
-    _HANDLER_PREFIXES = ("kv_", "raw_", "coprocessor", "mvcc_", "debug_", "cdc_")
+    _HANDLER_PREFIXES = ("kv_", "raw_", "coprocessor", "mvcc_", "debug_", "cdc_", "import_")
+
+    # -- ImportSST service (sst_service.rs: download + ingest) --------------
+
+    def _importer(self):
+        if self.importer is None:
+            raise RuntimeError("import service not enabled")
+        return self.importer
+
+    def import_download(self, req: dict) -> dict:
+        try:
+            return self._importer().download(req["name"], _rewrite_from_wire(req))
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    def import_ingest(self, req: dict) -> dict:
+        """Ingest a (downloaded) backup file as committed writes at
+        restore_ts — through the raft propose path when the engine is a
+        RaftKv, exactly like the reference's IngestSst command."""
+        try:
+            return self._importer().restore(
+                self.storage.engine, req["name"], req["restore_ts"],
+                req.get("context"), _rewrite_from_wire(req),
+            )
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
 
     # -- ChangeData service (cdcpb over the multiplexed transport) ----------
 

@@ -97,8 +97,40 @@ class SstImporter:
     fresh ts (sst_importer download:308 + ingest:158; ranges may be rewritten
     by a key-prefix mapping like the reference's rewrite rules)."""
 
+    _STAGE_MAX = 16  # staged files are bounded; oldest evicted (ingest pops)
+
     def __init__(self, storage: ExternalStorage):
         self.storage = storage
+        import threading
+
+        self._mu = threading.Lock()
+        self._staged: dict[str, bytes] = {}
+
+    def download(self, name: str, rewrite: tuple[bytes, bytes] | None = None) -> dict:
+        """Fetch + validate + REWRITE a backup file ahead of ingest
+        (sst_service.rs download:308 applies the rewrite rules at download
+        time): the staged bytes are final, so ingest is a pure engine
+        write."""
+        data = self.storage.read(name)
+        if not data.startswith(MAGIC):
+            raise ValueError(f"{name}: not a backup file")
+        off = len(MAGIC)
+        backup_ts, off = codec.decode_var_u64(data, off)
+        out = bytearray(data[:off])
+        n = 0
+        while off < len(data):
+            raw_key, off = codec.decode_compact_bytes(data, off)
+            value, off = codec.decode_compact_bytes(data, off)
+            if rewrite is not None and raw_key.startswith(rewrite[0]):
+                raw_key = rewrite[1] + raw_key[len(rewrite[0]):]
+            out += codec.encode_compact_bytes(raw_key)
+            out += codec.encode_compact_bytes(value)
+            n += 1
+        with self._mu:
+            while len(self._staged) >= self._STAGE_MAX:
+                self._staged.pop(next(iter(self._staged)))
+            self._staged[name] = bytes(out)
+        return {"file": name, "kvs": n, "backup_ts": backup_ts}
 
     def restore(
         self,
@@ -108,7 +140,10 @@ class SstImporter:
         ctx: dict | None = None,
         rewrite: tuple[bytes, bytes] | None = None,
     ) -> dict:
-        data = self.storage.read(name)
+        with self._mu:
+            data = self._staged.pop(name, None)
+        if data is None:
+            data = self.storage.read(name)
         if not data.startswith(MAGIC):
             raise ValueError(f"{name}: not a backup file")
         off = len(MAGIC)
