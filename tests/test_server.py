@@ -513,3 +513,79 @@ def test_import_sst_over_wire(single_node, tmp_path):
     r = client.call("import_download", {"name": "nope.bak"})
     assert "error" in r
     client.close()
+
+
+def test_cdc_long_poll(single_node):
+    """cdc_events with timeout_ms blocks until an event arrives (long-poll)
+    instead of returning empty immediately."""
+    import threading
+    import time
+
+    from tikv_tpu.sidecar.cdc import CdcService
+
+    node, server, pd = single_node
+    server.service.cdc = CdcService(node.store)
+    client = Client(*server.addr)
+    ctx = {"region_id": FIRST_REGION_ID}
+    sub = client.call("cdc_register", {"region_id": FIRST_REGION_ID,
+                                       "checkpoint_ts": pd.get_tso()})["sub_id"]
+    # empty feed + no timeout: immediate return
+    t0 = time.time()
+    r = client.call("cdc_events", {"sub_id": sub})
+    assert r["events"] == [] and time.time() - t0 < 0.5
+    # long-poll: a write during the wait unblocks the pull
+    got: list = []
+
+    def puller():
+        c2 = Client(*server.addr)
+        got.append(c2.call("cdc_events", {"sub_id": sub, "timeout_ms": 5000}))
+        c2.close()
+
+    t = threading.Thread(target=puller)
+    t.start()
+    time.sleep(0.2)
+    ts = pd.get_tso()
+    client.call("kv_prewrite", {"mutations": [{"op": "put", "key": b"lp", "value": b"x"}],
+                                "primary_lock": b"lp", "start_version": ts, "context": ctx})
+    client.call("kv_commit", {"keys": [b"lp"], "start_version": ts,
+                              "commit_version": pd.get_tso(), "context": ctx})
+    t.join(timeout=6)
+    assert got and any(e["type"] == "put" for e in got[0]["events"])
+    client.close()
+
+
+def test_import_ingest_retry_uses_staged_bytes(single_node, tmp_path):
+    """A failed ingest retried must consume the SAME rewritten staged bytes,
+    and supplying the rewrite on both calls must not double-apply."""
+    from tikv_tpu.sidecar.backup import BackupEndpoint, LocalStorage, SstImporter
+    from tikv_tpu.storage.btree_engine import BTreeEngine
+    from tikv_tpu.storage.kv import LocalEngine
+    from tikv_tpu.storage.storage import Storage as St
+    from tikv_tpu.storage.txn.commands import Commit, Prewrite
+    from tikv_tpu.storage.txn_types import Key, Mutation
+
+    node, server, pd = single_node
+    ext = LocalStorage(str(tmp_path))
+    imp = SstImporter(ext)
+    server.service.importer = imp
+    src_eng = BTreeEngine()
+    src = St(engine=LocalEngine(src_eng))
+    src.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"a-key"), b"v")], b"a-key", 10))
+    src.sched_txn_command(Commit([Key.from_raw(b"a-key")], 10, 11))
+    BackupEndpoint(ext).backup_range(src_eng.snapshot(), "r.bak", backup_ts=100)
+    client = Client(*server.addr)
+    ctx = {"region_id": FIRST_REGION_ID}
+    client.call("import_download", {"name": "r.bak", "rewrite_old": b"a-", "rewrite_new": b"ab-"})
+    # first ingest fails (bad region) -> staged bytes retained
+    r = client.call("import_ingest", {"name": "r.bak", "restore_ts": pd.get_tso(),
+                                      "context": {"region_id": 777}})
+    assert "error" in r
+    # retry WITH the rewrite repeated: staged bytes win, no double-apply
+    r = client.call("import_ingest", {"name": "r.bak", "restore_ts": pd.get_tso(), "context": ctx,
+                                      "rewrite_old": b"a-", "rewrite_new": b"ab-"})
+    assert r.get("kvs") == 1, r
+    g = client.call("kv_get", {"key": b"ab-key", "version": pd.get_tso(), "context": ctx})
+    assert g["value"] == b"v"
+    g = client.call("kv_get", {"key": b"abb-key", "version": pd.get_tso(), "context": ctx})
+    assert g.get("value") is None  # double-applied prefix never exists
+    client.close()

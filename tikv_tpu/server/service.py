@@ -115,10 +115,24 @@ class KvService:
     def cdc_register(self, req: dict) -> dict:
         return self._cdc().register(req["region_id"], req.get("checkpoint_ts", 0))
 
+    _CDC_LONGPOLL_SLOTS = threading.Semaphore(2)
+
     def cdc_events(self, req: dict) -> dict:
-        return self._cdc().events(
-            req["sub_id"], req.get("after_seq", 0), req.get("limit", 1024)
-        )
+        # timeout_ms: long-poll — block until events arrive or the deadline.
+        # The wait parks a shared worker thread, so concurrent long-pollers
+        # are bounded; excess pollers degrade to an immediate (empty) return
+        # instead of starving every other RPC on the store
+        timeout = min(int(req.get("timeout_ms", 0)), 10_000) / 1000.0
+        if timeout > 0:
+            if not KvService._CDC_LONGPOLL_SLOTS.acquire(blocking=False):
+                timeout = 0.0
+        try:
+            return self._cdc().events(
+                req["sub_id"], req.get("after_seq", 0), req.get("limit", 1024), timeout
+            )
+        finally:
+            if timeout > 0:
+                KvService._CDC_LONGPOLL_SLOTS.release()
 
     def cdc_deregister(self, req: dict) -> dict:
         return self._cdc().deregister(req["sub_id"])

@@ -106,23 +106,36 @@ class SstImporter:
         self._mu = threading.Lock()
         self._staged: dict[str, bytes] = {}
 
+    @staticmethod
+    def _iter_entries(data: bytes, rewrite: tuple[bytes, bytes] | None):
+        """Parse a backup payload: yields (raw_key, value) with the rewrite
+        rule applied — the ONE definition of the file format + rewrite
+        semantics, shared by download and restore."""
+        if not data.startswith(MAGIC):
+            raise ValueError("not a backup file")
+        off = len(MAGIC)
+        backup_ts, off = codec.decode_var_u64(data, off)
+        while off < len(data):
+            raw_key, off = codec.decode_compact_bytes(data, off)
+            value, off = codec.decode_compact_bytes(data, off)
+            if rewrite is not None and raw_key.startswith(rewrite[0]):
+                raw_key = rewrite[1] + raw_key[len(rewrite[0]):]
+            yield raw_key, value
+
     def download(self, name: str, rewrite: tuple[bytes, bytes] | None = None) -> dict:
         """Fetch + validate + REWRITE a backup file ahead of ingest
         (sst_service.rs download:308 applies the rewrite rules at download
         time): the staged bytes are final, so ingest is a pure engine
         write."""
         data = self.storage.read(name)
+        out = bytearray(MAGIC)
+        off = len(MAGIC)
         if not data.startswith(MAGIC):
             raise ValueError(f"{name}: not a backup file")
-        off = len(MAGIC)
-        backup_ts, off = codec.decode_var_u64(data, off)
-        out = bytearray(data[:off])
+        backup_ts, hoff = codec.decode_var_u64(data, off)
+        out += codec.encode_var_u64(backup_ts)
         n = 0
-        while off < len(data):
-            raw_key, off = codec.decode_compact_bytes(data, off)
-            value, off = codec.decode_compact_bytes(data, off)
-            if rewrite is not None and raw_key.startswith(rewrite[0]):
-                raw_key = rewrite[1] + raw_key[len(rewrite[0]):]
+        for raw_key, value in self._iter_entries(data, rewrite):
             out += codec.encode_compact_bytes(raw_key)
             out += codec.encode_compact_bytes(value)
             n += 1
@@ -141,22 +154,19 @@ class SstImporter:
         rewrite: tuple[bytes, bytes] | None = None,
     ) -> dict:
         with self._mu:
-            data = self._staged.pop(name, None)
-        if data is None:
+            data = self._staged.get(name)  # read, don't pop: a failed
+            # ingest must retry against the SAME (rewritten) staged bytes,
+            # never silently fall back to the un-rewritten source
+        staged = data is not None
+        if staged:
+            rewrite = None  # staged bytes were rewritten at download time
+        else:
             data = self.storage.read(name)
         if not data.startswith(MAGIC):
             raise ValueError(f"{name}: not a backup file")
-        off = len(MAGIC)
-        backup_ts, off = codec.decode_var_u64(data, off)
         wb = WriteBatch()
         n = 0
-        while off < len(data):
-            raw_key, off = codec.decode_compact_bytes(data, off)
-            value, off = codec.decode_compact_bytes(data, off)
-            if rewrite is not None:
-                old_prefix, new_prefix = rewrite
-                if raw_key.startswith(old_prefix):
-                    raw_key = new_prefix + raw_key[len(old_prefix):]
+        for raw_key, value in self._iter_entries(data, rewrite):
             k = Key.from_raw(raw_key)
             if len(value) <= 255:
                 w = Write(WriteType.PUT, restore_ts, short_value=value)
@@ -166,4 +176,7 @@ class SstImporter:
             wb.put_cf(CF_WRITE, k.append_ts(restore_ts + 1).encoded, w.to_bytes())
             n += 1
         engine.write(ctx, wb)
+        if staged:
+            with self._mu:
+                self._staged.pop(name, None)  # drop only after success
         return {"file": name, "kvs": n, "restored_at": restore_ts + 1}

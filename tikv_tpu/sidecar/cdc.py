@@ -209,24 +209,34 @@ class SeqSink(Sink):
     def __init__(self):
         super().__init__()
         self._seq = 0
+        self._cv = threading.Condition(self._mu)
         self.items: list[tuple[int, str, object]] = []  # (seq, kind, payload)
 
     def emit(self, event: ChangeEvent) -> None:
-        with self._mu:
+        with self._cv:
             self._seq += 1
             self.items.append((self._seq, "event", event))
+            self._cv.notify_all()
 
     def emit_resolved(self, region_id: int, ts: int) -> None:
-        with self._mu:
+        with self._cv:
             self._seq += 1
             self.items.append((self._seq, "resolved", (region_id, ts)))
+            self._cv.notify_all()
 
-    def drain_after(self, after_seq: int, limit: int) -> list[tuple[int, str, object]]:
-        with self._mu:
+    def drain_after(
+        self, after_seq: int, limit: int, timeout: float = 0.0
+    ) -> list[tuple[int, str, object]]:
+        with self._cv:
             # drop everything at or below the client's ack: memory stays
             # bounded by the client's pull cadence
             while self.items and self.items[0][0] <= after_seq:
                 self.items.pop(0)
+            if not self.items and timeout > 0:
+                # long-poll: the push EventFeed's latency without its stream
+                self._cv.wait(timeout)
+                while self.items and self.items[0][0] <= after_seq:
+                    self.items.pop(0)
             return list(self.items[:limit])
 
 
@@ -274,7 +284,9 @@ class CdcService:
         scanned = obs.incremental_scan(self._snapshot_fn(), region_id, checkpoint_ts)
         return {"sub_id": sub_id, "scanned": scanned}
 
-    def events(self, sub_id: int, after_seq: int = 0, limit: int = 1024) -> dict:
+    def events(
+        self, sub_id: int, after_seq: int = 0, limit: int = 1024, timeout: float = 0.0
+    ) -> dict:
         with self._mu:
             ent = self._subs.get(sub_id)
         if ent is None:
@@ -288,7 +300,7 @@ class CdcService:
             return {"error": {"not_leader": region_id}}
         out = []
         last = after_seq
-        for seq, kind, payload in obs.sink.drain_after(after_seq, limit):
+        for seq, kind, payload in obs.sink.drain_after(after_seq, limit, timeout):
             last = seq
             if kind == "event":
                 e: ChangeEvent = payload
