@@ -589,3 +589,39 @@ def test_import_ingest_retry_uses_staged_bytes(single_node, tmp_path):
     g = client.call("kv_get", {"key": b"abb-key", "version": pd.get_tso(), "context": ctx})
     assert g.get("value") is None  # double-applied prefix never exists
     client.close()
+
+
+def test_import_ingest_after_staged_eviction_reapplies_rewrite(single_node, tmp_path):
+    """If staged (rewritten) bytes were evicted before ingest, the fallback
+    source re-read must re-apply the rewrite registered at download time —
+    never silently ingest un-rewritten keys."""
+    from tikv_tpu.sidecar.backup import BackupEndpoint, LocalStorage, SstImporter
+    from tikv_tpu.storage.btree_engine import BTreeEngine
+    from tikv_tpu.storage.kv import LocalEngine
+    from tikv_tpu.storage.storage import Storage as St
+    from tikv_tpu.storage.txn.commands import Commit, Prewrite
+    from tikv_tpu.storage.txn_types import Key, Mutation
+
+    node, server, pd = single_node
+    ext = LocalStorage(str(tmp_path))
+    imp = SstImporter(ext)
+    server.service.importer = imp
+    src_eng = BTreeEngine()
+    src = St(engine=LocalEngine(src_eng))
+    src.sched_txn_command(Prewrite([Mutation.put(Key.from_raw(b"a-key"), b"v")], b"a-key", 10))
+    src.sched_txn_command(Commit([Key.from_raw(b"a-key")], 10, 11))
+    BackupEndpoint(ext).backup_range(src_eng.snapshot(), "ev.bak", backup_ts=100)
+    client = Client(*server.addr)
+    ctx = {"region_id": FIRST_REGION_ID}
+    client.call("import_download", {"name": "ev.bak", "rewrite_old": b"a-", "rewrite_new": b"ab-"})
+    # simulate eviction of the staged bytes (keeps the rewrite record)
+    with imp._mu:
+        imp._staged.pop("ev.bak")
+    r = client.call("import_ingest", {"name": "ev.bak", "restore_ts": pd.get_tso(),
+                                      "context": ctx})
+    assert r.get("kvs") == 1, r
+    g = client.call("kv_get", {"key": b"ab-key", "version": pd.get_tso(), "context": ctx})
+    assert g["value"] == b"v"  # rewrite applied despite eviction
+    g = client.call("kv_get", {"key": b"a-key", "version": pd.get_tso(), "context": ctx})
+    assert g.get("value") is None  # un-rewritten key never ingested
+    client.close()

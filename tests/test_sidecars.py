@@ -162,6 +162,45 @@ def test_resolved_ts_over_cluster():
     assert w2 > ts1
 
 
+def test_resolved_ts_leadership_gate():
+    """read_progress is published only under quorum-confirmed leadership:
+    via a valid lease, or a CheckLeader-style (term, leader_id) quorum count
+    — so hibernated groups (frozen clock, zeroed lease) keep advancing,
+    while an isolated deposed leader never publishes (advance.rs)."""
+    from tikv_tpu.pd.client import MockPd
+    from tikv_tpu.raft.cluster import FIRST_REGION_ID, Cluster
+
+    pd = MockPd()
+    cluster = Cluster(3, pd=pd)
+    cluster.run()
+    ep = ResolvedTsEndpoint(pd)
+    for s in cluster.stores.values():
+        ep.attach_store(s)
+    ep.resolver(FIRST_REGION_ID)
+    for st in cluster.stores.values():
+        p = st.peers.get(FIRST_REGION_ID)
+        if p is not None:
+            p.node.hibernate_after = 3
+    cluster.tick(40)
+    leader = cluster.leader_peer(FIRST_REGION_ID)
+    assert leader.node.hibernated and not leader.node.lease_valid()
+    ep.advance_all()
+    resolved, _ = ep.progress_of(FIRST_REGION_ID)
+    assert resolved > 0  # hibernation must not freeze the watermark
+    # a leader whose followers no longer recognize it must NOT publish
+    before = resolved
+    for st in cluster.stores.values():
+        p = st.peers.get(FIRST_REGION_ID)
+        if p is not None and p.node is not leader.node:
+            p.node.term = leader.node.term + 5  # saw a newer election
+            p.node.leader_id = None
+    leader.node._lease_until = 0
+    leader.node.hibernated = True  # frozen: no quorum self-check passes
+    ep.advance_all()
+    after, _ = ep.progress_of(FIRST_REGION_ID)
+    assert after == before  # watermark must not move for a deposed leader
+
+
 # -- CDC ---------------------------------------------------------------------
 
 def test_cdc_captures_committed_changes():

@@ -155,6 +155,40 @@ def test_check_txn_status_and_heartbeat(store):
     assert store.get(b"pk", compose_ts(9999, 0)) is None
 
 
+def test_check_txn_status_async_commit_never_rolled_back(store):
+    """An expired async-commit primary must NOT be rolled back or pushed:
+    the txn may already be committed through its secondaries
+    (check_txn_status.rs:26 returns uncommitted for use_async_commit)."""
+    k = Key.from_raw(b"pk")
+    ts10 = compose_ts(1000, 0)
+    store.sched_txn_command(
+        Prewrite(
+            [Mutation.put(k, b"v")], b"pk", ts10, lock_ttl=100,
+            use_async_commit=True, secondaries=[],
+        )
+    )
+    # far past TTL: still LOCKED, not TTL_EXPIRED
+    r = store.sched_txn_command(CheckTxnStatus(k, ts10, 0, compose_ts(9000, 0)))
+    assert r["status"].kind == TxnStatusKind.LOCKED
+    # min_commit_ts must not be pushed either
+    caller = compose_ts(9500, 0)
+    r = store.sched_txn_command(CheckTxnStatus(k, ts10, caller, compose_ts(9500, 1)))
+    assert r["status"].kind == TxnStatusKind.LOCKED
+    # commit still possible — the lock survived
+    store.sched_txn_command(Commit([k], ts10, compose_ts(9600, 0)))
+    assert store.get(b"pk", compose_ts(9999, 0)) == b"v"
+    # force_sync_commit overrides the guard (client knows commit never happened)
+    ts2 = compose_ts(20000, 0)
+    store.sched_txn_command(
+        Prewrite([Mutation.put(k, b"w")], b"pk", ts2, lock_ttl=100,
+                 use_async_commit=True, secondaries=[])
+    )
+    r = store.sched_txn_command(
+        CheckTxnStatus(k, ts2, 0, compose_ts(99000, 0), force_sync_commit=True)
+    )
+    assert r["status"].kind == TxnStatusKind.TTL_EXPIRED
+
+
 def test_check_txn_status_committed(store):
     put(store, b"pk", b"v", 10, 20)
     r = store.sched_txn_command(CheckTxnStatus(Key.from_raw(b"pk"), 10, 0, 100))

@@ -77,6 +77,10 @@ class KvService:
         self.cdc = cdc
         self.pd = pd
         self.importer = importer
+        # Per-instance: the 2-slot long-poll bound must not be shared across
+        # stores in one process (a poller on one store would degrade
+        # cdc_events long-polls on unrelated stores to immediate returns).
+        self._cdc_longpoll_slots = threading.Semaphore(2)
 
     _HANDLER_PREFIXES = ("kv_", "raw_", "coprocessor", "mvcc_", "debug_", "cdc_", "import_")
 
@@ -115,8 +119,6 @@ class KvService:
     def cdc_register(self, req: dict) -> dict:
         return self._cdc().register(req["region_id"], req.get("checkpoint_ts", 0))
 
-    _CDC_LONGPOLL_SLOTS = threading.Semaphore(2)
-
     def cdc_events(self, req: dict) -> dict:
         # timeout_ms: long-poll — block until events arrive or the deadline.
         # The wait parks a shared worker thread, so concurrent long-pollers
@@ -124,7 +126,7 @@ class KvService:
         # instead of starving every other RPC on the store
         timeout = min(int(req.get("timeout_ms", 0)), 10_000) / 1000.0
         if timeout > 0:
-            if not KvService._CDC_LONGPOLL_SLOTS.acquire(blocking=False):
+            if not self._cdc_longpoll_slots.acquire(blocking=False):
                 timeout = 0.0
         try:
             return self._cdc().events(
@@ -132,7 +134,7 @@ class KvService:
             )
         finally:
             if timeout > 0:
-                KvService._CDC_LONGPOLL_SLOTS.release()
+                self._cdc_longpoll_slots.release()
 
     def cdc_deregister(self, req: dict) -> dict:
         return self._cdc().deregister(req["sub_id"])
@@ -309,6 +311,7 @@ class KvService:
             req.get("caller_start_ts", 0),
             req.get("current_ts", 0),
             rollback_if_not_exist=req.get("rollback_if_not_exist", False),
+            force_sync_commit=req.get("force_sync_commit", False),
         )
         try:
             r = self.storage.sched_txn_command(cmd, req.get("context"))
@@ -318,6 +321,7 @@ class KvService:
                 "commit_version": st.commit_ts,
                 "lock_ttl": st.lock_ttl,
                 "min_commit_ts": st.min_commit_ts,
+                "use_async_commit": st.use_async_commit,
             }
         except Exception as e:  # noqa: BLE001
             return {"error": _err(e)}

@@ -105,6 +105,11 @@ class SstImporter:
 
         self._mu = threading.Lock()
         self._staged: dict[str, bytes] = {}
+        # Rewrite rule registered at download time, kept (bounded, but far
+        # larger than the staged-bytes cap) even after the staged bytes are
+        # evicted: a fallback re-read of the source must re-apply the same
+        # rewrite, never silently ingest un-rewritten keys.
+        self._rewrites: dict[str, tuple[bytes, bytes] | None] = {}
 
     @staticmethod
     def _iter_entries(data: bytes, rewrite: tuple[bytes, bytes] | None):
@@ -140,9 +145,16 @@ class SstImporter:
             out += codec.encode_compact_bytes(value)
             n += 1
         with self._mu:
+            # pop-then-insert: eviction order is by latest download, so a
+            # re-downloaded name moves to the back of the FIFO
+            self._staged.pop(name, None)
             while len(self._staged) >= self._STAGE_MAX:
                 self._staged.pop(next(iter(self._staged)))
             self._staged[name] = bytes(out)
+            self._rewrites.pop(name, None)
+            while len(self._rewrites) >= 64 * self._STAGE_MAX:
+                self._rewrites.pop(next(iter(self._rewrites)))
+            self._rewrites[name] = rewrite
         return {"file": name, "kvs": n, "backup_ts": backup_ts}
 
     def restore(
@@ -157,10 +169,17 @@ class SstImporter:
             data = self._staged.get(name)  # read, don't pop: a failed
             # ingest must retry against the SAME (rewritten) staged bytes,
             # never silently fall back to the un-rewritten source
+            recorded_rewrite = self._rewrites.get(name)
         staged = data is not None
         if staged:
             rewrite = None  # staged bytes were rewritten at download time
         else:
+            if recorded_rewrite is not None:
+                # Staged bytes were evicted after download: re-read the
+                # source and re-apply the rewrite registered at download
+                # time, so an eviction can never ingest un-rewritten keys.
+                # (A None record keeps honoring any ingest-time rewrite.)
+                rewrite = recorded_rewrite
             data = self.storage.read(name)
         if not data.startswith(MAGIC):
             raise ValueError(f"{name}: not a backup file")

@@ -101,6 +101,30 @@ class ResolvedTsEndpoint:
             elif op == "delete":
                 r.untrack_lock(key)
 
+    def _leader_confirmed(self, rid: int, peer) -> bool:
+        """CheckLeader-equivalent leadership confirmation (advance.rs).
+
+        A valid lease is already a quorum ack within an election timeout.
+        Without one (e.g. the group hibernated, which freezes the tick
+        clock and zeroes the lease), fall back to counting peers that
+        recognize this leader at its current term — a quorum of matching
+        (term, leader_id) views is exactly what CheckLeader RPCs collect,
+        and it lets hibernated regions keep advancing without being woken.
+        """
+        node = peer.node
+        if node.lease_valid():
+            return True
+        if not node.is_leader():
+            return False
+        votes = {node.id}
+        for store in self.stores:
+            p = store.peers.get(rid)
+            if p is None or p.node is node:
+                continue
+            if p.node.term == node.term and p.node.leader_id == node.id:
+                votes.add(p.node.id)
+        return node._has_quorum(votes)
+
     def advance_all(self) -> dict[int, int]:
         """Advance watermarks from leader peers, pairing each with the
         leader's applied index at resolution time."""
@@ -111,7 +135,11 @@ class ResolvedTsEndpoint:
         leader_peers: dict[int, object] = {}
         for store in self.stores:
             for rid, peer in list(store.peers.items()):
-                if peer.node.is_leader():
+                # Quorum-confirmed leadership, not bare is_leader(): a
+                # deposed leader that hasn't heard the new term must never
+                # publish a watermark past locks it never applied
+                # (resolved_ts advance.rs confirms via CheckLeader RPCs).
+                if self._leader_confirmed(rid, peer):
                     leader_peers[rid] = peer
         for r in resolvers:
             resolved = r.resolve(ts)

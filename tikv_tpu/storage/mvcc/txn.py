@@ -309,6 +309,11 @@ class TxnStatus:
     commit_ts: int = 0
     lock_ttl: int = 0
     min_commit_ts: int = 0
+    # Set on LOCKED results for async-commit locks so the client knows to
+    # resolve via check_secondary_locks / force_sync_commit instead of
+    # retrying check_txn_status forever (the reference returns the full
+    # LockInfo in TxnStatus::uncommitted for this purpose).
+    use_async_commit: bool = False
 
 
 def check_txn_status(
@@ -320,12 +325,25 @@ def check_txn_status(
     current_ts: int,
     rollback_if_not_exist: bool = False,
     now_ms: int | None = None,
+    force_sync_commit: bool = False,
 ) -> TxnStatus:
-    """Primary-key liveness check (actions/check_txn_status.rs)."""
+    """Primary-key liveness check (actions/check_txn_status.rs).
+
+    Async-commit locks are never rolled back or pushed here, regardless of
+    TTL: the transaction may already be decided committed through its
+    secondaries, so resolution is CheckSecondaryLocks/ResolveLock's job
+    (actions/check_txn_status.rs:26 returns uncommitted for
+    use_async_commit locks unless the client set force_sync_commit).
+    """
     from ..txn_types import ts_physical
 
     lock = reader.load_lock(primary_key)
     if lock is not None and lock.ts == lock_ts:
+        if lock.use_async_commit and not force_sync_commit:
+            return TxnStatus(
+                TxnStatusKind.LOCKED, lock_ttl=lock.ttl,
+                min_commit_ts=lock.min_commit_ts, use_async_commit=True,
+            )
         lock_elapsed = ts_physical(current_ts) - ts_physical(lock_ts)
         if lock_elapsed >= lock.ttl:
             rollback_key(txn, reader, primary_key, lock_ts, protect=True)

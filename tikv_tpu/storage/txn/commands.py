@@ -123,7 +123,12 @@ class Rollback(Command):
 @dataclass
 class Cleanup(Command):
     """Rollback the primary if its TTL expired (or unconditionally when
-    current_ts == 0) — commands/cleanup.rs."""
+    current_ts == 0) — commands/cleanup.rs.
+
+    Deliberately rolls back async-commit locks too, matching the reference
+    (actions/cleanup.rs calls rollback_lock with no use_async_commit check):
+    Cleanup is the txn owner's own path, unlike CheckTxnStatus which other
+    txns invoke and which must not roll back async-commit primaries."""
 
     key: Key
     start_ts: int
@@ -226,6 +231,7 @@ class CheckTxnStatus(Command):
     caller_start_ts: int
     current_ts: int
     rollback_if_not_exist: bool = False
+    force_sync_commit: bool = False
 
     def latch_keys(self) -> list[bytes]:
         return [self.primary_key.encoded]
@@ -236,6 +242,7 @@ class CheckTxnStatus(Command):
         status = check_txn_status(
             txn, reader, self.primary_key, self.lock_ts,
             self.caller_start_ts, self.current_ts, self.rollback_if_not_exist,
+            force_sync_commit=self.force_sync_commit,
         )
         return txn, {"status": status}
 
