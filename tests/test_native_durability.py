@@ -1,0 +1,309 @@
+"""Durability tests for the native engine: WAL commit/replay, checkpoint
+spill + truncation, torn-tail recovery, crash (kill -9) recovery across a
+real process boundary, and raft-store recovery over a durable engine.
+
+Reference contracts re-expressed: components/engine_rocks/src/engine.rs:1
+(WAL + memtable flush), components/raft_log_engine/src/engine.rs:25
+(purpose-built durable log), raftstore/src/store/peer_storage.rs:1
+(RaftLocalState/ApplyState recovery on boot).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from tikv_tpu.storage.engine import CF_DEFAULT, CF_LOCK, CF_RAFT, WriteBatch
+
+native = pytest.importorskip("tikv_tpu.native.engine")
+if not native.native_available():  # pragma: no cover
+    pytest.skip("native engine unavailable", allow_module_level=True)
+
+from tikv_tpu.native.engine import NativeEngine  # noqa: E402
+
+
+def test_reopen_recovers_writes_and_tombstones(tmp_path):
+    d = str(tmp_path / "db")
+    e = NativeEngine(path=d)
+    wb = WriteBatch()
+    wb.put_cf(CF_DEFAULT, b"a", b"1")
+    wb.put_cf(CF_RAFT, b"rs", b"hardstate")
+    e.write(wb)
+    wb = WriteBatch()
+    wb.put_cf(CF_DEFAULT, b"b", b"2")
+    wb.delete_cf(CF_DEFAULT, b"a")
+    e.write(wb)
+    seq = e.seq()
+    e.close()
+    e2 = NativeEngine(path=d)
+    assert e2.seq() == seq
+    assert e2.get_cf(CF_DEFAULT, b"a") is None
+    assert e2.get_cf(CF_DEFAULT, b"b") == b"2"
+    assert e2.get_cf(CF_RAFT, b"rs") == b"hardstate"
+    e2.close()
+
+
+def test_delete_range_is_durable(tmp_path):
+    d = str(tmp_path / "db")
+    e = NativeEngine(path=d)
+    wb = WriteBatch()
+    for i in range(10):
+        wb.put_cf(CF_DEFAULT, b"k%02d" % i, b"v%d" % i)
+    e.write(wb)
+    wb = WriteBatch()
+    wb.delete_range_cf(CF_DEFAULT, b"k03", b"k07")
+    e.write(wb)
+    e.close()
+    e2 = NativeEngine(path=d)
+    got = [k for k, _ in e2.scan_cf(CF_DEFAULT, b"", None)]
+    assert got == [b"k00", b"k01", b"k02", b"k07", b"k08", b"k09"]
+    e2.close()
+
+
+def test_checkpoint_truncates_wal_and_recovers(tmp_path):
+    d = str(tmp_path / "db")
+    e = NativeEngine(path=d)
+    for i in range(50):
+        wb = WriteBatch()
+        wb.put_cf(CF_DEFAULT, b"k%03d" % i, b"v" * 100)
+        e.write(wb)
+    assert e.wal_bytes() > 0
+    e.checkpoint()
+    assert e.wal_bytes() == 0
+    files = os.listdir(d)
+    assert sum(f.startswith("ckpt-") for f in files) == 1
+    assert sum(f.startswith("wal-") for f in files) == 1
+    # post-checkpoint writes land in the fresh WAL segment
+    wb = WriteBatch()
+    wb.put_cf(CF_DEFAULT, b"after", b"x")
+    e.write(wb)
+    e.close()
+    e2 = NativeEngine(path=d)
+    assert e2.get_cf(CF_DEFAULT, b"k000") == b"v" * 100
+    assert e2.get_cf(CF_DEFAULT, b"k049") == b"v" * 100
+    assert e2.get_cf(CF_DEFAULT, b"after") == b"x"
+    e2.close()
+
+
+def test_auto_checkpoint_on_wal_limit(tmp_path):
+    d = str(tmp_path / "db")
+    e = NativeEngine(path=d, wal_limit=4096)
+    for i in range(100):
+        wb = WriteBatch()
+        wb.put_cf(CF_DEFAULT, b"k%03d" % i, b"v" * 200)
+        e.write(wb)
+    assert any(f.startswith("ckpt-") for f in os.listdir(d))
+    assert e.wal_bytes() < 4096 + 4096  # truncated at least once
+    e.close()
+    e2 = NativeEngine(path=d)
+    assert e2.get_cf(CF_DEFAULT, b"k000") == b"v" * 200
+    assert e2.get_cf(CF_DEFAULT, b"k099") == b"v" * 200
+    e2.close()
+
+
+def test_torn_wal_tail_keeps_committed_prefix(tmp_path):
+    d = str(tmp_path / "db")
+    e = NativeEngine(path=d)
+    for i in range(5):
+        wb = WriteBatch()
+        wb.put_cf(CF_DEFAULT, b"k%d" % i, b"v%d" % i)
+        e.write(wb)
+    e.close()
+    wal = [f for f in os.listdir(d) if f.startswith("wal-")]
+    assert len(wal) == 1
+    # simulate a torn append: garbage bytes at the tail
+    with open(os.path.join(d, wal[0]), "ab") as f:
+        f.write(b"\x13\x00\x00\x00GARBAGE-TORN-RECORD")
+    e2 = NativeEngine(path=d)
+    for i in range(5):
+        assert e2.get_cf(CF_DEFAULT, b"k%d" % i) == b"v%d" % i
+    # the engine keeps accepting writes after recovery
+    wb = WriteBatch()
+    wb.put_cf(CF_DEFAULT, b"new", b"nv")
+    e2.write(wb)
+    e2.close()
+    e3 = NativeEngine(path=d)
+    assert e3.get_cf(CF_DEFAULT, b"new") == b"nv"
+    e3.close()
+
+
+def test_corrupt_checkpoint_falls_back_to_older(tmp_path):
+    d = str(tmp_path / "db")
+    e = NativeEngine(path=d)
+    wb = WriteBatch()
+    wb.put_cf(CF_DEFAULT, b"base", b"1")
+    e.write(wb)
+    e.checkpoint()
+    wb = WriteBatch()
+    wb.put_cf(CF_DEFAULT, b"later", b"2")
+    e.write(wb)
+    e.close()
+    # forge a newer-but-corrupt checkpoint: recovery must skip it and use
+    # the valid one + WAL
+    with open(os.path.join(d, "ckpt-ffffffffffffffff"), "wb") as f:
+        f.write(b"TKCK1\n" + b"\xff" * 40)
+    e2 = NativeEngine(path=d)
+    assert e2.get_cf(CF_DEFAULT, b"base") == b"1"
+    assert e2.get_cf(CF_DEFAULT, b"later") == b"2"
+    e2.close()
+
+
+def test_mem_accounting_moves_both_ways(tmp_path):
+    e = NativeEngine()
+    base = e.mem_bytes()
+    wb = WriteBatch()
+    wb.put_cf(CF_DEFAULT, b"k", b"x" * 10_000)
+    e.write(wb)
+    grown = e.mem_bytes()
+    assert grown >= base + 10_000
+    # overwrite with a small value: old version compacted away (no snapshot)
+    wb = WriteBatch()
+    wb.put_cf(CF_DEFAULT, b"k", b"y")
+    e.write(wb)
+    assert e.mem_bytes() < grown
+    e.close()
+
+
+_CRASH_WRITER = textwrap.dedent(
+    """
+    import sys
+    from tikv_tpu.native.engine import NativeEngine
+    from tikv_tpu.storage.engine import CF_DEFAULT, WriteBatch
+
+    e = NativeEngine(path=sys.argv[1])
+    i = 0
+    while True:
+        wb = WriteBatch()
+        wb.put_cf(CF_DEFAULT, b"key-%08d" % i, b"value-%d" % i)
+        e.write(wb)
+        # the write returned: it is ACKED — print AFTER, so every acked
+        # index the parent observes must survive the kill -9
+        print(i, flush=True)
+        i += 1
+    """
+)
+
+
+def test_kill9_mid_workload_recovers_all_acked_writes(tmp_path):
+    """The VERDICT's durability contract: kill -9 a process mid-workload,
+    reopen the engine directory, every acknowledged write is recovered."""
+    d = str(tmp_path / "db")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CRASH_WRITER, d],
+        stdout=subprocess.PIPE,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    acked = -1
+    deadline = time.time() + 30
+    while acked < 25 and time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        acked = int(line)
+    assert acked >= 25, f"writer too slow or died early (acked={acked})"
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait()
+    e = NativeEngine(path=d)
+    for i in range(acked + 1):
+        assert e.get_cf(CF_DEFAULT, b"key-%08d" % i) == b"value-%d" % i, i
+    e.close()
+
+
+def test_raft_store_recovery_across_process_boundary(tmp_path):
+    """Boot a raft store over a durable engine in a CHILD process, commit
+    writes through the raft propose/apply path, kill -9 the process, then
+    recover the store here: region meta, raft state and applied data all
+    come back (peer_storage.rs recovery semantics)."""
+    d = str(tmp_path / "store")
+    child = textwrap.dedent(
+        """
+        import sys
+        from tikv_tpu.native.engine import NativeEngine
+        from tikv_tpu.raft.cluster import FIRST_REGION_ID
+        from tikv_tpu.raft.store import ChannelTransport, Store
+        from tikv_tpu.raft.raftkv import RaftKv
+        from tikv_tpu.storage.storage import Storage
+        from tikv_tpu.storage.txn.commands import Commit, Prewrite
+        from tikv_tpu.storage.txn_types import Key, Mutation
+        from tikv_tpu.server.node import Node
+        from tikv_tpu.pd.client import MockPd
+
+        eng = NativeEngine(path=sys.argv[1])
+        transport = ChannelTransport()
+        pd = MockPd()
+        node = Node(pd, transport, engine=eng)
+        transport.register(node.store)
+        node.try_bootstrap_cluster([node.store_id])
+        node.create_region_peers()
+        peer = node.store.peers[FIRST_REGION_ID]
+        peer.node.campaign()
+        node.pump()
+        assert peer.node.is_leader()
+
+        def pump():
+            node.store.process_messages()
+            node.store.handle_readies()
+
+        storage = Storage(engine=RaftKv(node.store, pump=pump))
+        ctx = {"region_id": FIRST_REGION_ID}
+        ts = 10
+        for i in range(20):
+            k = b"rk-%04d" % i
+            storage.sched_txn_command(
+                Prewrite([Mutation.put(Key.from_raw(k), b"rv-%d" % i)], k, ts), ctx)
+            storage.sched_txn_command(Commit([Key.from_raw(k)], ts, ts + 1), ctx)
+            node.pump()
+            ts += 10
+            print(i, flush=True)
+        print("READY %d" % node.store_id, flush=True)
+        import time
+        time.sleep(60)  # parent kills us here
+        """
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", child, d],
+        stdout=subprocess.PIPE,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    deadline = time.time() + 60
+    store_id = None
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if line.startswith(b"READY"):
+            store_id = int(line.split()[1])
+            break
+    assert store_id is not None, "child store never finished its workload"
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait()
+
+    from tikv_tpu.pd.client import MockPd
+    from tikv_tpu.raft.cluster import FIRST_REGION_ID
+    from tikv_tpu.raft.raftkv import RaftKv
+    from tikv_tpu.raft.store import ChannelTransport, Store
+    from tikv_tpu.storage.storage import Storage
+
+    eng = NativeEngine(path=d)
+    transport = ChannelTransport()
+    store = Store(store_id, transport, engine=eng)
+    transport.register(store)
+    n = store.recover()
+    assert n == 1  # the bootstrapped region came back from RegionLocalState
+    peer = store.peers[FIRST_REGION_ID]
+    peer.node.campaign()
+    store.process_messages()
+    assert peer.node.is_leader()
+
+    def pump():
+        store.process_messages()
+        store.handle_readies()
+
+    storage = Storage(engine=RaftKv(store, pump=pump))
+    ctx = {"region_id": FIRST_REGION_ID}
+    for i in range(20):
+        assert storage.get(b"rk-%04d" % i, 10_000, ctx) == b"rv-%d" % i, i

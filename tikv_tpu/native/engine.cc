@@ -7,10 +7,26 @@
 // copy; iterators resolve the newest version <= snapshot per key.  Obsolete
 // versions are compacted away once no live snapshot can see them.
 //
+// Durability (engine_rocks WAL + memtable flush, raft_log_engine's purpose
+// built log): when opened on a directory, every committed write batch is
+// appended to a CRC-framed write-ahead log (group commit: the batch IS the
+// group) and fdatasync'd before the write call returns; a checkpoint spills
+// the full visible state to an SST-like immutable file via atomic
+// tmp+rename, after which older WAL segments are deleted.  Open() recovers
+// the newest valid checkpoint then replays WAL segments, stopping at the
+// first torn record (standard WAL semantics).
+//
 // Exposed as a C API consumed via ctypes (no pybind11 in this image).  Scans
 // return length-prefixed buffers so one FFI crossing moves a whole range.
 
+#include <dirent.h>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <map>
 #include <memory>
@@ -35,11 +51,41 @@ using Table = std::map<std::string, Chain>;
 
 constexpr int kNumCfs = 4;  // default, lock, write, raft
 
+// crc32c (Castagnoli), table-driven — integrity check for WAL records and
+// checkpoint bodies (the role rocksdb's kCRC32c block checksums play)
+uint32_t crc32c_table[256];
+struct CrcInit {
+  CrcInit() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = c & 1 ? 0x82f63b78u ^ (c >> 1) : c >> 1;
+      crc32c_table[i] = c;
+    }
+  }
+} crc_init;
+
+uint32_t crc32c(const uint8_t* p, size_t n, uint32_t crc = 0) {
+  crc = ~crc;
+  for (size_t i = 0; i < n; i++) crc = crc32c_table[(crc ^ p[i]) & 0xff] ^ (crc >> 8);
+  return ~crc;
+}
+
 struct Engine {
   Table cfs[kNumCfs];
   uint64_t seq = 0;
   std::multiset<uint64_t> snapshots;
   mutable std::shared_mutex mu;
+
+  // --- durability state (empty dir => pure in-memory engine) ---
+  std::string dir;        // "" = in-memory
+  int wal_fd = -1;
+  int sync_mode = 1;      // 0 = buffered, 1 = fdatasync per commit
+  uint64_t wal_bytes = 0;         // bytes in the live WAL segment
+  uint64_t wal_limit = 64ull << 20;  // auto-checkpoint threshold; 0 = manual
+  uint64_t mem_bytes = 0;         // approximate key+value bytes resident
+  bool failed = false;  // a WAL append failed mid-record: the log tail is
+                        // torn, so further appends could shadow-lose acked
+                        // writes — refuse everything (rocksdb read-only mode)
 
   uint64_t min_live_snapshot() const {
     return snapshots.empty() ? UINT64_MAX : *snapshots.begin();
@@ -55,8 +101,12 @@ const std::string* resolve(const Chain& chain, uint64_t snap_seq) {
   return nullptr;
 }
 
-void push_version(Chain& chain, uint64_t seq, bool tomb, std::string value,
-                  uint64_t min_snap) {
+constexpr uint64_t kVersionOverhead = 48;  // Version struct + string header
+constexpr uint64_t kKeyOverhead = 80;      // map node + key string header
+
+void push_version(Engine* e, Chain& chain, uint64_t seq, bool tomb,
+                  std::string value, uint64_t min_snap) {
+  e->mem_bytes += value.size() + kVersionOverhead;
   chain.insert(chain.begin(), Version{seq, tomb, std::move(value)});
   // compact: keep the newest version <= min_snap, drop everything older
   if (chain.size() > 1) {
@@ -67,27 +117,35 @@ void push_version(Chain& chain, uint64_t seq, bool tomb, std::string value,
         break;
       }
     }
-    if (keep < chain.size()) chain.resize(keep);
+    if (keep < chain.size()) {
+      for (size_t i = keep; i < chain.size(); i++)
+        e->mem_bytes -= std::min(e->mem_bytes,
+                                 chain[i].value.size() + kVersionOverhead);
+      chain.resize(keep);
+    }
   }
 }
 
-void put_version(Table& t, std::string key, uint64_t seq, bool tomb,
+void put_version(Engine* e, Table& t, std::string key, uint64_t seq, bool tomb,
                  std::string value, uint64_t min_snap) {
   // bulk ingestion (restore, snapshot apply, bench load) streams keys in
   // ascending order: appending past the current max is O(1) with an end
   // hint instead of a full O(log n) descent + key copy per record
   Chain* chain;
+  size_t key_size = key.size();
   if (t.empty() || t.rbegin()->first < key) {
     chain = &t.emplace_hint(t.end(), std::move(key), Chain{})->second;
+    e->mem_bytes += key_size + kKeyOverhead;
   } else {
     auto it = t.lower_bound(key);
     if (it != t.end() && it->first == key) {
       chain = &it->second;
     } else {
       chain = &t.emplace_hint(it, std::move(key), Chain{})->second;
+      e->mem_bytes += key_size + kKeyOverhead;
     }
   }
-  push_version(*chain, seq, tomb, std::move(value), min_snap);
+  push_version(e, *chain, seq, tomb, std::move(value), min_snap);
 }
 
 // --- buffer helpers ---------------------------------------------------------
@@ -105,21 +163,35 @@ uint32_t read_u32(const uint8_t*& p) {
   return v;
 }
 
-}  // namespace
-
-extern "C" {
-
-void* eng_open() { return new Engine(); }
-
-void eng_close(void* h) { delete static_cast<Engine*>(h); }
-
 // batch format: repeated records
 //   op u8 (1=put, 2=delete, 3=delete_range) | cf u8 |
 //   klen u32 | key | vlen u32 | val      (val = end key for delete_range)
-int eng_write(void* h, const uint8_t* data, uint64_t len) {
-  Engine* e = static_cast<Engine*>(h);
-  std::unique_lock lk(e->mu);
-  uint64_t seq = ++e->seq;
+
+// Structural validation WITHOUT applying: a malformed batch must be
+// rejected before it reaches the WAL — once fsync'd, a bad record would
+// poison replay and shadow-lose every later acked write.
+int validate_batch(const uint8_t* data, uint64_t len) {
+  const uint8_t* p = data;
+  const uint8_t* end = data + len;
+  while (p < end) {
+    if (end - p < 2) return -1;
+    uint8_t op = *p++;
+    uint8_t cf = *p++;
+    if (cf >= kNumCfs) return -2;
+    if (op < 1 || op > 3) return -3;
+    if (end - p < 4) return -1;
+    uint32_t klen = read_u32(p);
+    if (static_cast<uint64_t>(end - p) < klen + 4) return -1;
+    p += klen;
+    uint32_t vlen = read_u32(p);
+    if (static_cast<uint64_t>(end - p) < vlen) return -1;
+    p += vlen;
+  }
+  return 0;
+}
+
+// THE one batch applier: the live write path and WAL replay both come here.
+int apply_batch(Engine* e, const uint8_t* data, uint64_t len, uint64_t seq) {
   uint64_t min_snap = e->min_live_snapshot();
   if (min_snap > seq) min_snap = seq;  // nothing older than this write is needed
   const uint8_t* p = data;
@@ -141,21 +213,349 @@ int eng_write(void* h, const uint8_t* data, uint64_t len) {
     p += vlen;
     Table& t = e->cfs[cf];
     if (op == 1) {
-      put_version(t, std::move(key), seq, false, std::move(val), min_snap);
+      put_version(e, t, std::move(key), seq, false, std::move(val), min_snap);
     } else if (op == 2) {
-      put_version(t, std::move(key), seq, true, "", min_snap);
+      put_version(e, t, std::move(key), seq, true, "", min_snap);
     } else if (op == 3) {
       auto it = t.lower_bound(key);
       auto stop = t.lower_bound(val);
       for (; it != stop; ++it) {
         // the iterator already holds the chain: no per-key re-lookup
-        push_version(it->second, seq, true, "", min_snap);
+        push_version(e, it->second, seq, true, "", min_snap);
       }
     } else {
       return -3;
     }
   }
   return 0;
+}
+
+// --- durability: WAL segments + checkpoint files ----------------------------
+//
+// Layout in e->dir:
+//   wal-<start_seq:016x>   CRC-framed log; records carry seq > start_seq
+//   ckpt-<seq:016x>        immutable full-state spill, atomic tmp+rename
+//
+// WAL record: u32 payload_len | u32 crc32c(seq||payload) | u64 seq | payload
+// Checkpoint: "TKCK1\n" | u64 seq | repeated (cf u8|klen u32|key|vlen u32|
+// val) | "KCE1" u32 crc32c(body)   — only live values spill (tombstones and
+// version history die at the checkpoint boundary, like a full compaction).
+
+constexpr char kCkptMagic[] = "TKCK1\n";
+constexpr char kCkptFoot[] = "KCE1";
+
+std::string seg_name(const char* prefix, uint64_t seq) {
+  char buf[64];
+  snprintf(buf, sizeof buf, "%s-%016llx", prefix,
+           static_cast<unsigned long long>(seq));
+  return buf;
+}
+
+bool parse_seg(const std::string& name, const char* prefix, uint64_t* seq) {
+  size_t plen = strlen(prefix);
+  if (name.size() != plen + 17 || name.compare(0, plen, prefix) != 0 ||
+      name[plen] != '-')
+    return false;
+  *seq = strtoull(name.c_str() + plen + 1, nullptr, 16);
+  return true;
+}
+
+void list_segs(const std::string& dir, const char* prefix,
+               std::vector<uint64_t>* out) {
+  DIR* d = opendir(dir.c_str());
+  if (!d) return;
+  struct dirent* ent;
+  uint64_t seq;
+  while ((ent = readdir(d)) != nullptr) {
+    if (parse_seg(ent->d_name, prefix, &seq)) out->push_back(seq);
+  }
+  closedir(d);
+  std::sort(out->begin(), out->end());
+}
+
+int fsync_dir(const std::string& dir) {
+  int fd = open(dir.c_str(), O_RDONLY | O_DIRECTORY);
+  if (fd < 0) return -1;
+  int r = fsync(fd);
+  close(fd);
+  return r;
+}
+
+int wal_open_segment(Engine* e, uint64_t start_seq) {
+  if (e->wal_fd >= 0) close(e->wal_fd);
+  std::string path = e->dir + "/" + seg_name("wal", start_seq);
+  e->wal_fd = open(path.c_str(), O_CREAT | O_WRONLY | O_APPEND, 0644);
+  e->wal_bytes = 0;
+  if (e->wal_fd < 0) return -1;
+  fsync_dir(e->dir);  // the new segment name must survive a crash
+  return 0;
+}
+
+int wal_append(Engine* e, uint64_t seq, const uint8_t* payload, uint64_t len) {
+  if (e->dir.empty()) return 0;  // pure in-memory engine: no WAL
+  if (e->wal_fd < 0) return -1;  // durable engine with a dead log fd
+  std::string rec;
+  rec.reserve(16 + len);
+  append_u32(rec, static_cast<uint32_t>(len));
+  uint8_t seq_le[8];
+  memcpy(seq_le, &seq, 8);
+  uint32_t crc = crc32c(seq_le, 8);
+  crc = crc32c(payload, len, crc);
+  append_u32(rec, crc);
+  rec.append(reinterpret_cast<const char*>(seq_le), 8);
+  rec.append(reinterpret_cast<const char*>(payload), len);
+  const char* p = rec.data();
+  size_t left = rec.size();
+  while (left > 0) {
+    ssize_t n = ::write(e->wal_fd, p, left);
+    if (n <= 0) return -1;
+    p += n;
+    left -= n;
+  }
+  e->wal_bytes += rec.size();
+  if (e->sync_mode == 1 && fdatasync(e->wal_fd) != 0) return -1;
+  return 0;
+}
+
+// replay one WAL segment; stops cleanly at the first torn/corrupt record.
+void wal_replay(Engine* e, const std::string& path) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) return;
+  std::string buf;
+  fseek(f, 0, SEEK_END);
+  long sz = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  buf.resize(sz);
+  if (sz > 0 && fread(&buf[0], 1, sz, f) != static_cast<size_t>(sz)) {
+    fclose(f);
+    return;
+  }
+  fclose(f);
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(buf.data());
+  const uint8_t* end = p + buf.size();
+  while (end - p >= 16) {
+    uint32_t len = read_u32(p);
+    uint32_t crc = read_u32(p);
+    if (static_cast<uint64_t>(end - p) < 8 + static_cast<uint64_t>(len)) break;
+    uint64_t seq;
+    memcpy(&seq, p, 8);
+    uint32_t actual = crc32c(p, 8 + len);
+    if (actual != crc) break;  // torn tail: stop, later records unreachable
+    p += 8;
+    if (seq > e->seq) {  // records <= checkpoint seq are already folded in
+      // CRC-valid records were individually acked (validated before the
+      // append), so an apply failure skips just this record
+      if (apply_batch(e, p, len, seq) == 0) e->seq = seq;
+    }
+    p += len;
+  }
+}
+
+int ckpt_write(Engine* e) {
+  // caller holds the write lock; spill everything visible at e->seq.
+  // Streamed straight to the file with a chained crc32c — never a full
+  // in-memory copy of the dataset (the engine already holds the data once;
+  // doubling residency under the write lock is the one thing this spill
+  // must not do).
+  uint64_t at = e->seq;
+  std::string tmp = e->dir + "/ckpt.tmp";
+  std::string fin = e->dir + "/" + seg_name("ckpt", at);
+  FILE* f = fopen(tmp.c_str(), "wb");
+  if (!f) return -1;
+  setvbuf(f, nullptr, _IOFBF, 1 << 20);
+  uint64_t at_le = at;
+  bool ok = fwrite(kCkptMagic, 1, 6, f) == 6 && fwrite(&at_le, 1, 8, f) == 8;
+  uint32_t crc = 0;
+  std::string hdr;
+  for (int cf = 0; cf < kNumCfs && ok; cf++) {
+    for (const auto& [key, chain] : e->cfs[cf]) {
+      const std::string* v = resolve(chain, at);
+      if (v == nullptr) continue;
+      hdr.clear();
+      hdr.push_back(static_cast<char>(cf));
+      append_u32(hdr, static_cast<uint32_t>(key.size()));
+      hdr.append(key);
+      append_u32(hdr, static_cast<uint32_t>(v->size()));
+      crc = crc32c(reinterpret_cast<const uint8_t*>(hdr.data()), hdr.size(), crc);
+      crc = crc32c(reinterpret_cast<const uint8_t*>(v->data()), v->size(), crc);
+      ok = fwrite(hdr.data(), 1, hdr.size(), f) == hdr.size() &&
+           (v->empty() || fwrite(v->data(), 1, v->size(), f) == v->size());
+      if (!ok) break;
+    }
+  }
+  ok = ok && fwrite(kCkptFoot, 1, 4, f) == 4 && fwrite(&crc, 1, 4, f) == 4;
+  ok = ok && fflush(f) == 0 && fsync(fileno(f)) == 0;
+  fclose(f);
+  if (!ok || rename(tmp.c_str(), fin.c_str()) != 0) {
+    unlink(tmp.c_str());
+    return -1;
+  }
+  fsync_dir(e->dir);
+  // new WAL segment BEFORE deleting the old ones: if the open fails the
+  // previous log remains intact and the engine can refuse further writes
+  // without having lost anything
+  if (wal_open_segment(e, at) != 0) return -1;
+  std::vector<uint64_t> old;
+  list_segs(e->dir, "ckpt", &old);
+  for (uint64_t s : old)
+    if (s < at) unlink((e->dir + "/" + seg_name("ckpt", s)).c_str());
+  old.clear();
+  list_segs(e->dir, "wal", &old);
+  for (uint64_t s : old)
+    if (s < at) unlink((e->dir + "/" + seg_name("wal", s)).c_str());
+  return 0;
+}
+
+// load the newest structurally-valid checkpoint; returns its seq (0 = none)
+uint64_t ckpt_load(Engine* e) {
+  std::vector<uint64_t> cks;
+  list_segs(e->dir, "ckpt", &cks);
+  for (auto it = cks.rbegin(); it != cks.rend(); ++it) {
+    std::string path = e->dir + "/" + seg_name("ckpt", *it);
+    FILE* f = fopen(path.c_str(), "rb");
+    if (!f) continue;
+    fseek(f, 0, SEEK_END);
+    long sz = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    if (sz < 22) { fclose(f); continue; }
+    std::string buf;
+    buf.resize(sz);
+    bool rok = fread(&buf[0], 1, sz, f) == static_cast<size_t>(sz);
+    fclose(f);
+    if (!rok || buf.compare(0, 6, kCkptMagic) != 0) continue;
+    if (buf.compare(sz - 8, 4, kCkptFoot) != 0) continue;
+    uint32_t crc;
+    memcpy(&crc, buf.data() + sz - 4, 4);
+    const uint8_t* body = reinterpret_cast<const uint8_t*>(buf.data()) + 14;
+    size_t body_len = sz - 22;
+    if (crc32c(body, body_len) != crc) continue;
+    uint64_t at;
+    memcpy(&at, buf.data() + 6, 8);
+    const uint8_t* p = body;
+    const uint8_t* end = body + body_len;
+    while (p < end) {
+      uint8_t cf = *p++;
+      if (cf >= kNumCfs || end - p < 4) break;
+      uint32_t klen = read_u32(p);
+      if (static_cast<uint64_t>(end - p) < klen + 4) break;
+      std::string key(reinterpret_cast<const char*>(p), klen);
+      p += klen;
+      uint32_t vlen = read_u32(p);
+      if (static_cast<uint64_t>(end - p) < vlen) break;
+      // checkpoints are written in cf-then-key order: O(1) hinted appends
+      put_version(e, e->cfs[cf], std::move(key), at, false,
+                  std::string(reinterpret_cast<const char*>(p), vlen), at);
+      p += vlen;
+    }
+    e->seq = at;
+    return at;
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* eng_open() { return new Engine(); }
+
+// Open (or create) a durable engine on a directory.  sync_mode: 1 = WAL
+// fdatasync on every commit (crash-durable), 0 = OS-buffered (fast, loses
+// the tail on power loss — still consistent via WAL framing).
+void* eng_open_at(const char* path, int sync_mode) {
+  Engine* e = new Engine();
+  e->dir = path;
+  e->sync_mode = sync_mode;
+  mkdir(path, 0755);
+  uint64_t ck = ckpt_load(e);
+  std::vector<uint64_t> wals;
+  list_segs(e->dir, "wal", &wals);
+  for (uint64_t s : wals) {
+    if (s < ck) continue;  // fully folded into the checkpoint
+    wal_replay(e, e->dir + "/" + seg_name("wal", s));
+  }
+  // recovered WAL segments are re-folded on the next checkpoint; append to a
+  // fresh segment so replay order stays strictly by start-seq
+  if (wal_open_segment(e, e->seq) != 0) {
+    delete e;
+    return nullptr;
+  }
+  return e;
+}
+
+void eng_close(void* h) {
+  Engine* e = static_cast<Engine*>(h);
+  if (e->wal_fd >= 0) close(e->wal_fd);
+  delete e;
+}
+
+int eng_write(void* h, const uint8_t* data, uint64_t len) {
+  Engine* e = static_cast<Engine*>(h);
+  std::unique_lock lk(e->mu);
+  if (e->failed) return -5;
+  // validate BEFORE logging: a malformed batch must never reach the WAL
+  int r = validate_batch(data, len);
+  if (r != 0) return r;
+  uint64_t seq = e->seq + 1;
+  // WAL first: a batch is committed iff its record is durable (fsync'd
+  // before apply, exactly rocksdb's WriteBatch-then-memtable order)
+  if (wal_append(e, seq, data, len) != 0) {
+    e->failed = true;
+    return -4;
+  }
+  r = apply_batch(e, data, len, seq);
+  if (r != 0) return r;  // unreachable after validate; defensive
+  e->seq = seq;
+  if (e->wal_limit > 0 && e->wal_bytes >= e->wal_limit && !e->dir.empty()) {
+    // inline auto-spill (memtable-full flush equivalent); a failed spill
+    // that lost its log fd must stop acking writes, not go silently
+    // non-durable
+    if (ckpt_write(e) != 0 && e->wal_fd < 0) e->failed = true;
+  }
+  return 0;
+}
+
+int eng_checkpoint(void* h) {
+  Engine* e = static_cast<Engine*>(h);
+  std::unique_lock lk(e->mu);
+  if (e->dir.empty()) return -1;
+  int r = ckpt_write(e);
+  if (r != 0 && e->wal_fd < 0) e->failed = true;  // log fd lost: stop acking
+  return r;
+}
+
+void eng_set_wal_limit(void* h, uint64_t bytes) {
+  Engine* e = static_cast<Engine*>(h);
+  std::unique_lock lk(e->mu);
+  e->wal_limit = bytes;
+}
+
+// import-mode tuning (sst_importer/src/import_mode.rs): bulk loads drop to
+// buffered WAL writes, then restore sync + checkpoint when done
+void eng_set_sync(void* h, int sync_mode) {
+  Engine* e = static_cast<Engine*>(h);
+  std::unique_lock lk(e->mu);
+  if (e->sync_mode == 0 && sync_mode == 1 && e->wal_fd >= 0)
+    fdatasync(e->wal_fd);  // close the unsynced window before promising sync
+  e->sync_mode = sync_mode;
+}
+
+uint64_t eng_seq(void* h) {
+  Engine* e = static_cast<Engine*>(h);
+  std::shared_lock lk(e->mu);
+  return e->seq;
+}
+
+uint64_t eng_mem_bytes(void* h) {
+  Engine* e = static_cast<Engine*>(h);
+  std::shared_lock lk(e->mu);
+  return e->mem_bytes;
+}
+
+uint64_t eng_wal_bytes(void* h) {
+  Engine* e = static_cast<Engine*>(h);
+  std::shared_lock lk(e->mu);
+  return e->wal_bytes;
 }
 
 uint64_t eng_snapshot(void* h) {

@@ -106,6 +106,15 @@ def _load():
         lib.eng_free.argtypes = [u8p]
         lib.eng_stats_keys.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.eng_stats_keys.restype = ctypes.c_uint64
+        lib.eng_open_at.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.eng_open_at.restype = ctypes.c_void_p
+        lib.eng_checkpoint.argtypes = [ctypes.c_void_p]
+        lib.eng_checkpoint.restype = ctypes.c_int
+        lib.eng_set_wal_limit.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.eng_set_sync.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        for fn in (lib.eng_seq, lib.eng_mem_bytes, lib.eng_wal_bytes):
+            fn.argtypes = [ctypes.c_void_p]
+            fn.restype = ctypes.c_uint64
         _lib = lib
         return _lib
 
@@ -282,12 +291,50 @@ class NativeSnapshot(Snapshot):
 
 
 class NativeEngine(KvEngine):
-    def __init__(self):
+    """In-memory by default; pass ``path`` for a durable engine: every
+    committed WriteBatch is WAL-appended + fdatasync'd before the write
+    returns (``sync=False`` keeps OS-buffered appends), checkpoints spill
+    full state via atomic tmp+rename, and open() recovers checkpoint + WAL
+    (engine_rocks WAL/flush + raft_log_engine recovery semantics)."""
+
+    def __init__(self, path: str | None = None, sync: bool = True,
+                 wal_limit: int | None = None):
         lib = _load()
         if lib is None:
             raise RuntimeError(f"native engine unavailable: {_lib_err}")
         self._lib = lib
-        self._handle = lib.eng_open()
+        self.path = path
+        if path is None:
+            self._handle = lib.eng_open()
+        else:
+            self._handle = lib.eng_open_at(
+                os.fsencode(path), 1 if sync else 0
+            )
+            if not self._handle:
+                raise RuntimeError(f"cannot open engine dir {path!r}")
+        if wal_limit is not None:
+            lib.eng_set_wal_limit(self._handle, wal_limit)
+
+    def checkpoint(self) -> None:
+        """Spill full visible state; truncates the WAL (flush + compaction)."""
+        r = self._lib.eng_checkpoint(self._handle)
+        if r != 0:
+            raise RuntimeError(f"eng_checkpoint failed: {r}")
+
+    def set_sync(self, sync: bool) -> None:
+        """Import-mode tuning (import_mode.rs): buffered WAL during bulk
+        load, fdatasync restored (and the window closed) when done."""
+        self._lib.eng_set_sync(self._handle, 1 if sync else 0)
+
+    def seq(self) -> int:
+        return self._lib.eng_seq(self._handle)
+
+    def mem_bytes(self) -> int:
+        """Approximate resident key+value bytes (tikv_alloc-style accounting)."""
+        return self._lib.eng_mem_bytes(self._handle)
+
+    def wal_bytes(self) -> int:
+        return self._lib.eng_wal_bytes(self._handle)
 
     def close(self) -> None:
         if self._handle is not None:

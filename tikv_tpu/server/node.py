@@ -27,10 +27,11 @@ class Node:
         transport: Transport,
         store_id: int | None = None,
         split_threshold_keys: int | None = None,
+        engine=None,
     ):
         self.pd = pd
         self.store_id = store_id or pd.alloc_id()
-        self.store = Store(self.store_id, transport)
+        self.store = Store(self.store_id, transport, engine=engine)
         self.split_threshold_keys = split_threshold_keys
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []

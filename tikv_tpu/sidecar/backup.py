@@ -174,11 +174,12 @@ class SstImporter:
         if staged:
             rewrite = None  # staged bytes were rewritten at download time
         else:
-            if recorded_rewrite is not None:
+            if rewrite is None and recorded_rewrite is not None:
                 # Staged bytes were evicted after download: re-read the
                 # source and re-apply the rewrite registered at download
                 # time, so an eviction can never ingest un-rewritten keys.
-                # (A None record keeps honoring any ingest-time rewrite.)
+                # An EXPLICIT ingest-time rewrite still wins — the caller
+                # may deliberately re-ingest under a different prefix.
                 rewrite = recorded_rewrite
             data = self.storage.read(name)
         if not data.startswith(MAGIC):

@@ -117,12 +117,23 @@ class ResolvedTsEndpoint:
         if not node.is_leader():
             return False
         votes = {node.id}
+        visible = {node.id}
         for store in self.stores:
             p = store.peers.get(rid)
             if p is None or p.node is node:
                 continue
+            visible.add(p.node.id)
             if p.node.term == node.term and p.node.leader_id == node.id:
                 votes.add(p.node.id)
+        if not node._has_quorum(visible):
+            # This endpoint cannot see a voter majority (per-store
+            # deployment): it cannot run the CheckLeader count locally, so
+            # a hibernated leader would freeze the watermark forever.  Wake
+            # the group — the next heartbeat round re-grants the lease and
+            # a later advance pass publishes under it.
+            if node.hibernated:
+                node._wake()
+            return False
         return node._has_quorum(votes)
 
     def advance_all(self) -> dict[int, int]:
