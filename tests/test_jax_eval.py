@@ -463,6 +463,43 @@ def test_raw_topn_extreme_values_identical():
         assert cpu.encode() == dev.encode(), order_by
 
 
+def test_endpoint_topn_stays_on_device_with_zero_fallbacks():
+    """Eligible TopN/agg plans driven through Endpoint.handle_request must run
+    on the device path — a silent permanent fallback (device_fallbacks > 0 or
+    from_device=False) would still produce correct bytes, so only this
+    assertion catches a broken device route (endpoint.rs:392 analog)."""
+    from tikv_tpu.copr.endpoint import CoprRequest, Endpoint
+    from tikv_tpu.copr.table import record_range
+    from tikv_tpu.storage.btree_engine import BTreeEngine
+    from tikv_tpu.storage.kv import LocalEngine
+    from tikv_tpu.storage.engine import WriteBatch
+    from tikv_tpu.storage.txn_types import Key, Write, WriteType
+
+    eng = BTreeEngine()
+    wb = WriteBatch()
+    for rk, val in NUMERIC_KVS[:500]:
+        wb.put_cf("write", Key.from_raw(rk).append_ts(11).encoded,
+                  Write(WriteType.PUT, 10, short_value=val).to_bytes())
+    eng.write(wb)
+    ep = Endpoint(LocalEngine(eng), enable_device=True)
+    ep_cpu = Endpoint(LocalEngine(eng), enable_device=False)
+    plans = [
+        [TableScan(TABLE_ID, NUMERIC_COLS), TopN([(col(1), True)], 7)],
+        [TableScan(TABLE_ID, NUMERIC_COLS),
+         Selection([call("lt", col(2), const_int(40))]),
+         TopN([(col(2), False), (col(1), True)], 5)],
+        [TableScan(TABLE_ID, NUMERIC_COLS),
+         Aggregation([col(2)], [AggDescriptor("sum", col(1)), AggDescriptor("count", None)])],
+    ]
+    for execs in plans:
+        req = lambda: CoprRequest(103, DagRequest(executors=execs), [record_range(TABLE_ID)], 100, context={})
+        r_dev = ep.handle_request(req())
+        r_cpu = ep_cpu.handle_request(req())
+        assert r_dev.from_device, f"plan {execs} fell off the device path: {ep.last_device_error}"
+        assert r_dev.data == r_cpu.data
+    assert ep.device_fallbacks == 0, ep.last_device_error
+
+
 def test_endpoint_falls_back_to_cpu_on_device_failure(monkeypatch):
     """A device-path runtime failure (tunnel, compiler, OOM) must re-run on
     the CPU oracle, not surface an accelerator error to the client."""

@@ -131,6 +131,36 @@ def test_torn_wal_tail_keeps_committed_prefix(tmp_path):
     e3.close()
 
 
+def test_torn_tail_in_reused_segment_does_not_hide_new_writes(tmp_path):
+    """A torn record at the head of the CURRENT segment (seq == segment start,
+    i.e. right after a checkpoint) must be truncated on recovery — otherwise
+    reopening the same file with O_APPEND puts acked post-recovery writes
+    BEHIND the torn bytes, unreachable by every later replay."""
+    d = str(tmp_path / "db")
+    e = NativeEngine(path=d)
+    wb = WriteBatch()
+    wb.put_cf(CF_DEFAULT, b"base", b"bv")
+    e.write(wb)
+    e.checkpoint()  # fresh wal-<seq> segment, empty
+    e.close()
+    wal = [f for f in os.listdir(d) if f.startswith("wal-")]
+    assert len(wal) == 1
+    with open(os.path.join(d, wal[0]), "ab") as f:
+        f.write(b"\x40\x00\x00\x00TORN-FIRST-RECORD")  # torn at offset 0
+    e2 = NativeEngine(path=d)  # seq == segment start: segment is REUSED
+    assert e2.get_cf(CF_DEFAULT, b"base") == b"bv"
+    wb = WriteBatch()
+    wb.put_cf(CF_DEFAULT, b"after", b"av")
+    e2.write(wb)
+    e2.close()
+    e3 = NativeEngine(path=d)
+    assert e3.get_cf(CF_DEFAULT, b"after") == b"av", (
+        "acked post-recovery write lost behind a torn record"
+    )
+    assert e3.get_cf(CF_DEFAULT, b"base") == b"bv"
+    e3.close()
+
+
 def test_corrupt_checkpoint_falls_back_to_older(tmp_path):
     d = str(tmp_path / "db")
     e = NativeEngine(path=d)

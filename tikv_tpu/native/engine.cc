@@ -181,7 +181,8 @@ int validate_batch(const uint8_t* data, uint64_t len) {
     if (op < 1 || op > 3) return -3;
     if (end - p < 4) return -1;
     uint32_t klen = read_u32(p);
-    if (static_cast<uint64_t>(end - p) < klen + 4) return -1;
+    if (static_cast<uint64_t>(end - p) < static_cast<uint64_t>(klen) + 4)
+      return -1;
     p += klen;
     uint32_t vlen = read_u32(p);
     if (static_cast<uint64_t>(end - p) < vlen) return -1;
@@ -317,7 +318,11 @@ int wal_append(Engine* e, uint64_t seq, const uint8_t* payload, uint64_t len) {
   return 0;
 }
 
-// replay one WAL segment; stops cleanly at the first torn/corrupt record.
+// replay one WAL segment; stops cleanly at the first torn/corrupt record and
+// TRUNCATES the file to its valid prefix.  Without the truncate, reopening
+// the same segment with O_APPEND (eng_open_at when e->seq equals the segment
+// start) would append acked records BEHIND the torn bytes — unreachable by
+// every later replay, i.e. silent loss of post-recovery writes.
 void wal_replay(Engine* e, const std::string& path) {
   FILE* f = fopen(path.c_str(), "rb");
   if (!f) return;
@@ -331,16 +336,28 @@ void wal_replay(Engine* e, const std::string& path) {
     return;
   }
   fclose(f);
-  const uint8_t* p = reinterpret_cast<const uint8_t*>(buf.data());
+  const uint8_t* base = reinterpret_cast<const uint8_t*>(buf.data());
+  const uint8_t* p = base;
   const uint8_t* end = p + buf.size();
+  uint64_t valid_end = buf.size();  // offset just past the last whole record
+  bool torn = false;
   while (end - p >= 16) {
+    const uint8_t* rec_start = p;
     uint32_t len = read_u32(p);
     uint32_t crc = read_u32(p);
-    if (static_cast<uint64_t>(end - p) < 8 + static_cast<uint64_t>(len)) break;
+    if (static_cast<uint64_t>(end - p) < 8 + static_cast<uint64_t>(len)) {
+      valid_end = rec_start - base;
+      torn = true;
+      break;
+    }
     uint64_t seq;
     memcpy(&seq, p, 8);
     uint32_t actual = crc32c(p, 8 + len);
-    if (actual != crc) break;  // torn tail: stop, later records unreachable
+    if (actual != crc) {  // torn tail: stop, later records unreachable
+      valid_end = rec_start - base;
+      torn = true;
+      break;
+    }
     p += 8;
     if (seq > e->seq) {  // records <= checkpoint seq are already folded in
       // CRC-valid records were individually acked (validated before the
@@ -349,6 +366,10 @@ void wal_replay(Engine* e, const std::string& path) {
     }
     p += len;
   }
+  // a partial header at the tail (loop exhausted, <16 bytes left) is torn too
+  if (!torn && end - p > 0) valid_end = p - base;
+  if (valid_end < static_cast<uint64_t>(sz))
+    truncate(path.c_str(), static_cast<off_t>(valid_end));
 }
 
 int ckpt_write(Engine* e) {
@@ -437,7 +458,8 @@ uint64_t ckpt_load(Engine* e) {
       uint8_t cf = *p++;
       if (cf >= kNumCfs || end - p < 4) break;
       uint32_t klen = read_u32(p);
-      if (static_cast<uint64_t>(end - p) < klen + 4) break;
+      if (static_cast<uint64_t>(end - p) < static_cast<uint64_t>(klen) + 4)
+        break;
       std::string key(reinterpret_cast<const char*>(p), klen);
       p += klen;
       uint32_t vlen = read_u32(p);
@@ -531,13 +553,21 @@ void eng_set_wal_limit(void* h, uint64_t bytes) {
 }
 
 // import-mode tuning (sst_importer/src/import_mode.rs): bulk loads drop to
-// buffered WAL writes, then restore sync + checkpoint when done
-void eng_set_sync(void* h, int sync_mode) {
+// buffered WAL writes, then restore sync + checkpoint when done.  Returns
+// non-zero if the flush that closes the unsynced window fails — in that case
+// the buffered tail is NOT durable and the engine stops acking writes rather
+// than promising per-commit durability it cannot deliver.
+int eng_set_sync(void* h, int sync_mode) {
   Engine* e = static_cast<Engine*>(h);
   std::unique_lock lk(e->mu);
-  if (e->sync_mode == 0 && sync_mode == 1 && e->wal_fd >= 0)
-    fdatasync(e->wal_fd);  // close the unsynced window before promising sync
+  if (e->sync_mode == 0 && sync_mode == 1 && e->wal_fd >= 0) {
+    if (fdatasync(e->wal_fd) != 0) {
+      e->failed = true;
+      return -4;
+    }
+  }
   e->sync_mode = sync_mode;
+  return 0;
 }
 
 uint64_t eng_seq(void* h) {

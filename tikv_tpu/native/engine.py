@@ -112,6 +112,7 @@ def _load():
         lib.eng_checkpoint.restype = ctypes.c_int
         lib.eng_set_wal_limit.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.eng_set_sync.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.eng_set_sync.restype = ctypes.c_int
         for fn in (lib.eng_seq, lib.eng_mem_bytes, lib.eng_wal_bytes):
             fn.argtypes = [ctypes.c_void_p]
             fn.restype = ctypes.c_uint64
@@ -324,7 +325,11 @@ class NativeEngine(KvEngine):
     def set_sync(self, sync: bool) -> None:
         """Import-mode tuning (import_mode.rs): buffered WAL during bulk
         load, fdatasync restored (and the window closed) when done."""
-        self._lib.eng_set_sync(self._handle, 1 if sync else 0)
+        r = self._lib.eng_set_sync(self._handle, 1 if sync else 0)
+        if r != 0:
+            # the flush closing the unsynced window failed: the buffered tail
+            # is not durable and the engine has latched into refuse-writes
+            raise RuntimeError(f"eng_set_sync failed: {r}")
 
     def seq(self) -> int:
         return self._lib.eng_seq(self._handle)
