@@ -322,10 +322,12 @@ int wal_append(Engine* e, uint64_t seq, const uint8_t* payload, uint64_t len) {
 // TRUNCATES the file to its valid prefix.  Without the truncate, reopening
 // the same segment with O_APPEND (eng_open_at when e->seq equals the segment
 // start) would append acked records BEHIND the torn bytes — unreachable by
-// every later replay, i.e. silent loss of post-recovery writes.
-void wal_replay(Engine* e, const std::string& path) {
+// every later replay, i.e. silent loss of post-recovery writes.  Returns
+// non-zero when a needed truncate FAILED — the caller must not open the
+// engine for writing over a segment it could not repair.
+int wal_replay(Engine* e, const std::string& path) {
   FILE* f = fopen(path.c_str(), "rb");
-  if (!f) return;
+  if (!f) return 0;
   std::string buf;
   fseek(f, 0, SEEK_END);
   long sz = ftell(f);
@@ -333,7 +335,7 @@ void wal_replay(Engine* e, const std::string& path) {
   buf.resize(sz);
   if (sz > 0 && fread(&buf[0], 1, sz, f) != static_cast<size_t>(sz)) {
     fclose(f);
-    return;
+    return -1;  // unreadable segment: do not trust the directory for writes
   }
   fclose(f);
   const uint8_t* base = reinterpret_cast<const uint8_t*>(buf.data());
@@ -368,8 +370,11 @@ void wal_replay(Engine* e, const std::string& path) {
   }
   // a partial header at the tail (loop exhausted, <16 bytes left) is torn too
   if (!torn && end - p > 0) valid_end = p - base;
-  if (valid_end < static_cast<uint64_t>(sz))
-    truncate(path.c_str(), static_cast<off_t>(valid_end));
+  if (valid_end < static_cast<uint64_t>(sz)) {
+    if (truncate(path.c_str(), static_cast<off_t>(valid_end)) != 0)
+      return -1;  // unrepaired torn tail would hide acked writes appended later
+  }
+  return 0;
 }
 
 int ckpt_write(Engine* e) {
@@ -494,7 +499,10 @@ void* eng_open_at(const char* path, int sync_mode) {
   list_segs(e->dir, "wal", &wals);
   for (uint64_t s : wals) {
     if (s < ck) continue;  // fully folded into the checkpoint
-    wal_replay(e, e->dir + "/" + seg_name("wal", s));
+    if (wal_replay(e, e->dir + "/" + seg_name("wal", s)) != 0) {
+      delete e;  // could not repair a torn segment: refuse the open
+      return nullptr;
+    }
   }
   // recovered WAL segments are re-folded on the next checkpoint; append to a
   // fresh segment so replay order stays strictly by start-seq

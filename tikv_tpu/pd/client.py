@@ -44,6 +44,7 @@ class StoreInfo:
     store_id: int
     last_heartbeat: float = 0.0
     stats: dict = field(default_factory=dict)
+    addr: tuple | None = None  # (host, port) — the resolve.rs address book
 
 
 class MockPd(PdClient):
@@ -116,9 +117,18 @@ class MockPd(PdClient):
 
     # -- stores ------------------------------------------------------------
 
-    def put_store(self, store_id: int) -> None:
+    def put_store(self, store_id: int, addr: tuple | None = None) -> None:
         with self._mu:
-            self.stores[store_id] = StoreInfo(store_id)
+            info = self.stores.get(store_id)
+            if info is None:
+                self.stores[store_id] = StoreInfo(store_id, addr=addr)
+            elif addr is not None:
+                info.addr = addr
+
+    def get_store_addr(self, store_id: int) -> tuple | None:
+        with self._mu:
+            info = self.stores.get(store_id)
+            return info.addr if info else None
 
     def store_heartbeat(self, store_id: int, stats: dict) -> None:
         with self._mu:

@@ -1,0 +1,195 @@
+"""PD over the wire: the placement driver as a TCP service.
+
+Re-expression of the PD gRPC surface that ``components/pd_client`` consumes
+(src/lib.rs:87 bootstrap, :147 get_region, :180 region_heartbeat, :208
+ask_batch_split, :217 store_heartbeat, :255 get_tso) plus the address book
+(``src/server/resolve.rs``: store id -> socket addr resolves through PD's
+store records).  ``PdService`` exposes an in-process ``MockPd`` behind the
+framed-TCP server; ``RemotePd`` is the ``PdClient`` implementation store
+processes use — together they let a cluster span real OS processes.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..raft.region import Region
+from ..raft.store import decode_region, encode_region
+from .client import MockPd, PdClient
+
+
+class PdService:
+    """Dispatch-compatible wrapper (server.Server speaks to anything with a
+    ``dispatch``).  Only pd_-prefixed methods are reachable."""
+
+    def __init__(self, pd: MockPd):
+        self.pd = pd
+
+    def dispatch(self, method: str, req: dict):
+        if not method.startswith("pd_"):
+            return {"error": {"other": f"unknown method {method}"}}
+        handler = getattr(self, method, None)
+        if handler is None:
+            return {"error": {"other": f"unknown method {method}"}}
+        try:
+            return handler(req)
+        except Exception as e:  # noqa: BLE001 — wire boundary
+            return {"error": {"other": repr(e)}}
+
+    def pd_alloc_id(self, req: dict) -> dict:
+        return {"id": self.pd.alloc_id()}
+
+    def pd_get_tso(self, req: dict) -> dict:
+        return {"ts": self.pd.get_tso()}
+
+    def pd_bootstrap_region(self, req: dict) -> dict:
+        region, _ = decode_region(req["region"])
+        # first-wins: concurrent bootstrappers race benignly
+        if self.pd.get_region_by_id(region.id) is None:
+            self.pd.bootstrap_region(region)
+            return {"bootstrapped": True}
+        return {"bootstrapped": False}
+
+    def pd_get_region_by_key(self, req: dict) -> dict:
+        r = self.pd.get_region_by_key(req["key"])
+        return {"region": encode_region(r) if r else None}
+
+    def pd_get_region_by_id(self, req: dict) -> dict:
+        r = self.pd.get_region_by_id(req["region_id"])
+        leader = self.pd.leader_of(req["region_id"]) if r else None
+        return {"region": encode_region(r) if r else None, "leader_store": leader}
+
+    def pd_region_heartbeat(self, req: dict) -> dict:
+        region, _ = decode_region(req["region"])
+        self.pd.region_heartbeat(region, req["leader_store"])
+        return {}
+
+    def pd_store_heartbeat(self, req: dict) -> dict:
+        self.pd.store_heartbeat(req["store_id"], req.get("stats", {}))
+        return {}
+
+    def pd_report_split(self, req: dict) -> dict:
+        left, _ = decode_region(req["left"])
+        right, _ = decode_region(req["right"])
+        self.pd.report_split(left, right)
+        return {}
+
+    def pd_put_store(self, req: dict) -> dict:
+        self.pd.put_store(req["store_id"], addr=tuple(req["addr"]) if req.get("addr") else None)
+        return {}
+
+    def pd_get_store_addr(self, req: dict) -> dict:
+        addr = self.pd.get_store_addr(req["store_id"])
+        return {"addr": list(addr) if addr else None}
+
+    def pd_alive_stores(self, req: dict) -> dict:
+        return {"stores": self.pd.alive_stores(req.get("within_secs", 30.0))}
+
+    def pd_update_gc_safe_point(self, req: dict) -> dict:
+        self.pd.update_gc_safe_point(req["ts"])
+        return {}
+
+    def pd_get_gc_safe_point(self, req: dict) -> dict:
+        return {"ts": self.pd.get_gc_safe_point()}
+
+
+class RemotePd(PdClient):
+    """PdClient over the wire (pd_client's RpcClient with reconnect,
+    util.rs): one multiplexed connection, re-dialed on failure."""
+
+    def __init__(self, host: str, port: int):
+        self.addr = (host, port)
+        self._mu = threading.Lock()
+        self._client = None
+
+    def _call(self, method: str, req: dict) -> dict:
+        from ..server.server import Client
+
+        last: Exception | None = None
+        for attempt in (0, 1):
+            try:
+                # dial outside the mutex: a slow connect must not block every
+                # concurrent PD caller, and a refused dial is as retryable as
+                # a broken call (pd_client reconnect, util.rs)
+                with self._mu:
+                    client = self._client
+                if client is None:
+                    client = Client(*self.addr)
+                    with self._mu:
+                        if self._client is None:
+                            self._client = client
+                        elif self._client is not client:
+                            client.close()
+                            client = self._client
+                resp = client.call(method, req, timeout=10.0)
+            except (ConnectionError, TimeoutError, OSError) as e:
+                last = e
+                with self._mu:
+                    if self._client is not None:
+                        self._client.close()
+                        self._client = None
+                continue
+            if isinstance(resp, dict) and "error" in resp:
+                raise RuntimeError(f"pd {method}: {resp['error']}")
+            return resp
+        raise ConnectionError(f"pd {method} unreachable: {last!r}")
+
+    def alloc_id(self) -> int:
+        return self._call("pd_alloc_id", {})["id"]
+
+    def get_tso(self) -> int:
+        return self._call("pd_get_tso", {})["ts"]
+
+    def bootstrap_region(self, region: Region) -> bool:
+        return self._call("pd_bootstrap_region", {"region": encode_region(region)})["bootstrapped"]
+
+    def get_region_by_key(self, key: bytes) -> Region | None:
+        raw = self._call("pd_get_region_by_key", {"key": key})["region"]
+        return decode_region(raw)[0] if raw else None
+
+    def get_region_by_id(self, region_id: int) -> Region | None:
+        raw = self._call("pd_get_region_by_id", {"region_id": region_id})["region"]
+        return decode_region(raw)[0] if raw else None
+
+    def leader_of(self, region_id: int) -> int | None:
+        return self._call("pd_get_region_by_id", {"region_id": region_id})["leader_store"]
+
+    def region_heartbeat(self, region: Region, leader_store: int) -> None:
+        self._call(
+            "pd_region_heartbeat",
+            {"region": encode_region(region), "leader_store": leader_store},
+        )
+
+    def store_heartbeat(self, store_id: int, stats: dict) -> None:
+        self._call("pd_store_heartbeat", {"store_id": store_id, "stats": stats})
+
+    def report_split(self, left: Region, right: Region) -> None:
+        self._call(
+            "pd_report_split",
+            {"left": encode_region(left), "right": encode_region(right)},
+        )
+
+    def put_store(self, store_id: int, addr: tuple[str, int] | None = None) -> None:
+        self._call(
+            "pd_put_store",
+            {"store_id": store_id, "addr": list(addr) if addr else None},
+        )
+
+    def get_store_addr(self, store_id: int) -> tuple[str, int] | None:
+        raw = self._call("pd_get_store_addr", {"store_id": store_id})["addr"]
+        return (raw[0], raw[1]) if raw else None
+
+    def alive_stores(self, within_secs: float = 30.0) -> list[int]:
+        return self._call("pd_alive_stores", {"within_secs": within_secs})["stores"]
+
+    def update_gc_safe_point(self, ts: int) -> None:
+        self._call("pd_update_gc_safe_point", {"ts": ts})
+
+    def get_gc_safe_point(self) -> int:
+        return self._call("pd_get_gc_safe_point", {})["ts"]
+
+    def close(self) -> None:
+        with self._mu:
+            if self._client is not None:
+                self._client.close()
+                self._client = None

@@ -1,0 +1,203 @@
+"""RaftMessage wire codec: peer raft traffic as self-describing frames.
+
+Re-expression of the peer-transport message surface of
+``src/server/raft_client.rs`` (:588 BatchRaftMessage streaming, :844 send)
+and ``src/server/snap.rs`` (:41 send_snap chunking, :260 recv task): the
+reference ships kvproto ``RaftMessage`` protobufs over a dedicated gRPC
+client-stream; this framework ships the same envelope as wire-codec tuples
+over its framed TCP transport.
+
+Snapshot-bearing messages are the one special case, exactly as in the
+reference: snapshot data can be arbitrarily large, so it never rides the
+batched raft stream.  ``split_snapshot`` / ``join_snapshot`` cut the encoded
+message into chunk frames with a transfer id; the receiving store re-joins
+them and injects the completed message (snap.rs's RecvSnapContext).
+"""
+
+from __future__ import annotations
+
+from .core import Entry, Message, MsgType, Snapshot
+from .region import Peer as RegionPeer, Region, RegionEpoch
+from .store import RaftMessage, _decode_entry, _encode_entry, decode_region, encode_region
+
+SNAP_CHUNK_BYTES = 1 << 20  # snap.rs SNAP_CHUNK_LEN is 1MB
+
+
+def _peer_to_wire(p: RegionPeer) -> tuple:
+    return (p.peer_id, p.store_id, p.role)
+
+
+def _peer_from_wire(t) -> RegionPeer:
+    return RegionPeer(t[0], t[1], t[2])
+
+
+def _snapshot_to_wire(s: Snapshot | None):
+    if s is None:
+        return None
+    return (
+        s.index,
+        s.term,
+        s.data,
+        tuple(s.voters),
+        tuple(s.learners),
+        tuple(s.outgoing),
+        tuple(s.witnesses),
+    )
+
+
+def _snapshot_from_wire(t) -> Snapshot | None:
+    if t is None:
+        return None
+    return Snapshot(
+        index=t[0], term=t[1], data=t[2], voters=tuple(t[3]),
+        learners=tuple(t[4]), outgoing=tuple(t[5]), witnesses=tuple(t[6]),
+    )
+
+
+def msg_to_wire(m: Message) -> tuple:
+    return (
+        m.type.value,
+        m.frm,
+        m.to,
+        m.term,
+        m.log_index,
+        m.log_term,
+        [_encode_entry(e) for e in m.entries],
+        m.commit,
+        m.reject,
+        m.reject_hint,
+        _snapshot_to_wire(m.snapshot),
+        m.context,
+        m.hb_round,
+        m.force,
+    )
+
+
+def msg_from_wire(t) -> Message:
+    return Message(
+        type=MsgType(t[0]),
+        frm=t[1],
+        to=t[2],
+        term=t[3],
+        log_index=t[4],
+        log_term=t[5],
+        entries=[_decode_entry(b) for b in t[6]],
+        commit=t[7],
+        reject=bool(t[8]),
+        reject_hint=t[9],
+        snapshot=_snapshot_from_wire(t[10]),
+        context=t[11],
+        hb_round=t[12],
+        force=bool(t[13]),
+    )
+
+
+def rmsg_to_wire(rmsg: RaftMessage) -> tuple:
+    return (
+        rmsg.region_id,
+        _peer_to_wire(rmsg.from_peer),
+        _peer_to_wire(rmsg.to_peer),
+        msg_to_wire(rmsg.msg),
+        (rmsg.region_epoch.conf_ver, rmsg.region_epoch.version),
+        encode_region(rmsg.region) if rmsg.region is not None else None,
+    )
+
+
+def rmsg_from_wire(t) -> RaftMessage:
+    region = None
+    if t[5] is not None:
+        region, _merging = decode_region(t[5])
+    return RaftMessage(
+        region_id=t[0],
+        from_peer=_peer_from_wire(t[1]),
+        to_peer=_peer_from_wire(t[2]),
+        msg=msg_from_wire(t[3]),
+        region_epoch=RegionEpoch(t[4][0], t[4][1]),
+        region=region,
+    )
+
+
+# -- snapshot chunking (snap.rs:41 SnapChunk stream) -------------------------
+
+def split_snapshot(rmsg: RaftMessage, xfer_id: int, chunk_bytes: int = SNAP_CHUNK_BYTES):
+    """Yield ``snap_chunk`` request dicts for one snapshot-bearing message.
+
+    The header (everything except snapshot data) rides in the first chunk;
+    data is cut into ``chunk_bytes`` pieces.  The last chunk is marked so the
+    receiver knows when to join + inject."""
+    assert rmsg.msg.snapshot is not None
+    snap = rmsg.msg.snapshot
+    header_msg = Message(
+        type=rmsg.msg.type, frm=rmsg.msg.frm, to=rmsg.msg.to, term=rmsg.msg.term,
+        log_index=rmsg.msg.log_index, log_term=rmsg.msg.log_term,
+        commit=rmsg.msg.commit,
+        snapshot=Snapshot(
+            index=snap.index, term=snap.term, data=b"", voters=snap.voters,
+            learners=snap.learners, outgoing=snap.outgoing, witnesses=snap.witnesses,
+        ),
+        context=rmsg.msg.context,
+    )
+    header = rmsg_to_wire(
+        RaftMessage(
+            region_id=rmsg.region_id, from_peer=rmsg.from_peer, to_peer=rmsg.to_peer,
+            msg=header_msg, region_epoch=rmsg.region_epoch, region=rmsg.region,
+        )
+    )
+    data = snap.data
+    n_chunks = max(1, (len(data) + chunk_bytes - 1) // chunk_bytes)
+    for i in range(n_chunks):
+        chunk = data[i * chunk_bytes : (i + 1) * chunk_bytes]
+        yield {
+            "xfer_id": xfer_id,
+            "seq": i,
+            "last": i == n_chunks - 1,
+            "header": header if i == 0 else None,
+            "data": chunk,
+        }
+
+
+class SnapshotAssembler:
+    """Receiver side of the snapshot stream: joins chunk frames back into a
+    complete snapshot-bearing RaftMessage (snap.rs recv_snap)."""
+
+    def __init__(self, max_transfers: int = 16):
+        import threading
+
+        self._xfers: dict[int, dict] = {}
+        self.max_transfers = max_transfers
+        self._mu = threading.Lock()
+
+    def add_chunk(self, req: dict) -> RaftMessage | None:
+        """Returns the completed message when the last chunk arrives.
+        Thread-safe: different peer stores stream on different connections."""
+        with self._mu:
+            return self._add_chunk(req)
+
+    def _add_chunk(self, req: dict) -> RaftMessage | None:
+        xid = req["xfer_id"]
+        st = self._xfers.get(xid)
+        if st is None:
+            if req["seq"] != 0 or req.get("header") is None:
+                return None  # mid-transfer chunk for an unknown/aborted xfer
+            while len(self._xfers) >= self.max_transfers:
+                self._xfers.pop(next(iter(self._xfers)))
+            st = {"header": req["header"], "chunks": {}, "next": 0}
+            self._xfers[xid] = st
+        st["chunks"][req["seq"]] = req["data"]
+        if not req["last"]:
+            return None
+        # join in seq order; a gap aborts the transfer (sender will re-send
+        # the snapshot: raft re-queues it when the follower stays behind)
+        n = req["seq"] + 1
+        if any(i not in st["chunks"] for i in range(n)):
+            del self._xfers[xid]
+            return None
+        data = b"".join(st["chunks"][i] for i in range(n))
+        del self._xfers[xid]
+        rmsg = rmsg_from_wire(st["header"])
+        snap = rmsg.msg.snapshot
+        rmsg.msg.snapshot = Snapshot(
+            index=snap.index, term=snap.term, data=data, voters=snap.voters,
+            learners=snap.learners, outgoing=snap.outgoing, witnesses=snap.witnesses,
+        )
+        return rmsg

@@ -1,0 +1,272 @@
+"""ServerCluster: N real stores wired over real TCP sockets.
+
+Re-expression of ``components/test_raftstore``'s ``ServerCluster``
+(src/server.rs:601): unlike the in-memory ``raft.cluster.Cluster`` (the
+NodeCluster analog, which pumps messages deterministically through a
+ChannelTransport), every node here runs its own background raft loop and all
+peer traffic — raft batches AND chunked snapshots — rides the framed-TCP
+transport through ``RaftClient``/``KvService.raft_*``.  Scenario tests
+(failover, partition, snapshot catch-up, split/merge) therefore exercise the
+actual networked stack.
+
+Fault injection keeps the ``Filter`` API: filters attach to a node's
+RemoteTransport (outbound), mirroring transport_simulate.rs.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+from ..pd.client import MockPd
+from ..raft.raftkv import RaftKv
+from ..raft.region import NotLeaderError, Peer as RegionPeer, Region, RegionEpoch
+from ..raft.store import StorePeer
+from ..storage.engine import CF_DEFAULT, WriteBatch
+from ..util import keys as keymod
+from .node import Node
+from .raft_client import RemoteTransport
+from .server import Server
+from .service import KvService
+
+FIRST_REGION_ID = 1
+
+
+class StoreNode:
+    """One store: engine + Store + raft loops + TCP server (a TiKVServer)."""
+
+    def __init__(self, cluster: "ServerCluster", store_id: int, engine=None):
+        self.cluster = cluster
+        self.transport = RemoteTransport(cluster.resolve)
+        self.node = Node(cluster.pd, self.transport, store_id=store_id, engine=engine)
+        self.store = self.node.store
+        self.service = KvService(storage=None, raft_router=self.store)
+        self.server = Server(self.service)
+        self.running = False
+
+    def start(self) -> None:
+        self.server.start()
+        self.cluster.addrs[self.store.store_id] = self.server.addr
+        self.node.start(tick_interval=0.02, heartbeat_interval=0.2)
+        self.running = True
+
+    def stop(self) -> None:
+        self.running = False
+        self.cluster.addrs.pop(self.store.store_id, None)
+        self.node.stop()
+        self.server.stop()
+        self.transport.close()
+
+
+class ServerCluster:
+    def __init__(self, n_stores: int, pd: MockPd | None = None, engines: dict | None = None):
+        self.pd = pd or MockPd()
+        self.addrs: dict[int, tuple[str, int]] = {}
+        self.nodes: dict[int, StoreNode] = {}
+        self._ids = itertools.count(5000)
+        self._engines = engines or {}
+        for sid in range(1, n_stores + 1):
+            self.nodes[sid] = StoreNode(self, sid, engine=self._engines.get(sid))
+
+    # -- addressing (resolve.rs: store id -> socket addr through PD) --------
+
+    def resolve(self, store_id: int) -> tuple[str, int] | None:
+        return self.addrs.get(store_id)
+
+    def alloc_id(self) -> int:
+        return self.pd.alloc_id()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        for node in self.nodes.values():
+            if not node.running:
+                node.start()
+
+    def bootstrap(self, store_ids: list[int] | None = None) -> Region:
+        sids = store_ids or list(self.nodes)
+        peers = [RegionPeer(self.alloc_id(), sid) for sid in sids]
+        region = Region(FIRST_REGION_ID, b"", b"", RegionEpoch(), peers)
+        self.pd.bootstrap_region(region.clone())
+        for sid in sids:
+            self.nodes[sid].store.create_peer(region)
+        return region
+
+    def run(self) -> None:
+        """start + bootstrap + elect a first leader (Cluster::run)."""
+        self.start()
+        self.bootstrap()
+        first = self.nodes[min(self.nodes)]
+        first.store.peers[FIRST_REGION_ID].node.campaign()
+        self.wait_leader(FIRST_REGION_ID)
+
+    def shutdown(self) -> None:
+        for node in self.nodes.values():
+            if node.running:
+                node.stop()
+
+    def stop_node(self, store_id: int) -> None:
+        self.nodes[store_id].stop()
+
+    def restart_node(self, store_id: int) -> None:
+        """Reboot a store over the SAME engine (state survives like a real
+        restart over a durable engine; fsm/store.rs init recovers peers)."""
+        old = self.nodes[store_id]
+        assert not old.running, f"store {store_id} still running"
+        node = StoreNode(self, store_id, engine=old.store.engine)
+        node.store.recover()
+        self.nodes[store_id] = node
+        node.start()
+
+    # -- observation --------------------------------------------------------
+
+    def leader_peer(self, region_id: int) -> StorePeer | None:
+        leaders = []
+        for node in self.nodes.values():
+            if not node.running:
+                continue
+            p = node.store.peers.get(region_id)
+            if p is not None and p.node.is_leader():
+                leaders.append(p)
+        if not leaders:
+            return None
+        return max(leaders, key=lambda p: p.node.term)
+
+    def wait_leader(self, region_id: int, timeout: float = 10.0) -> StorePeer:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            p = self.leader_peer(region_id)
+            if p is not None:
+                return p
+            time.sleep(0.02)
+        raise AssertionError(f"no leader for region {region_id} within {timeout}s")
+
+    def wait_applied_on(self, store_id: int, region_id: int, index: int, timeout: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            node = self.nodes[store_id]
+            p = node.store.peers.get(region_id)
+            if p is not None and p.node.applied >= index:
+                return
+            time.sleep(0.02)
+        raise AssertionError(f"store {store_id} region {region_id} never reached index {index}")
+
+    def get_on_store(self, store_id: int, key: bytes, cf: str = CF_DEFAULT) -> bytes | None:
+        return self.nodes[store_id].store.engine.get_cf(cf, keymod.data_key(key))
+
+    def wait_get_on_store(self, store_id: int, key: bytes, value: bytes, timeout: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.get_on_store(store_id, key) == value:
+                return
+            time.sleep(0.02)
+        raise AssertionError(f"store {store_id} never saw {key!r}={value!r}")
+
+    # -- KV (leader-routed, with NotLeader retry like a real client) --------
+
+    def region_for_key(self, key: bytes) -> int:
+        for node in self.nodes.values():
+            if not node.running:
+                continue
+            p = node.store.region_for_key(key)
+            if p is not None:
+                return p.region.id
+        raise KeyError(key)
+
+    def must_put(self, key: bytes, value: bytes, cf: str = CF_DEFAULT, timeout: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout
+        last: Exception | None = None
+        while time.monotonic() < deadline:
+            try:
+                region_id = self.region_for_key(key)
+                leader = self.wait_leader(region_id, timeout=2.0)
+                kv = RaftKv(leader.store)
+                wb = WriteBatch()
+                wb.put_cf(cf, key, value)
+                kv.write({"region_id": region_id}, wb)
+                return
+            except (NotLeaderError, TimeoutError, AssertionError, KeyError) as e:
+                last = e
+                time.sleep(0.05)
+        raise AssertionError(f"must_put {key!r} failed: {last!r}")
+
+    def must_get(self, key: bytes, cf: str = CF_DEFAULT, timeout: float = 10.0) -> bytes | None:
+        deadline = time.monotonic() + timeout
+        last: Exception | None = None
+        while time.monotonic() < deadline:
+            try:
+                region_id = self.region_for_key(key)
+                leader = self.wait_leader(region_id, timeout=2.0)
+                kv = RaftKv(leader.store)
+                snap = kv.snapshot({"region_id": region_id})
+                return snap.get_cf(cf, key)
+            except (NotLeaderError, TimeoutError, AssertionError, KeyError) as e:
+                last = e
+                time.sleep(0.05)
+        raise AssertionError(f"must_get {key!r} failed: {last!r}")
+
+    # -- admin --------------------------------------------------------------
+
+    def _run_admin(self, leader: StorePeer, cmd: dict, timeout: float = 10.0) -> None:
+        done = threading.Event()
+        res: list = []
+
+        def cb(r):
+            res.append(r)
+            done.set()
+
+        leader.propose_cmd(cmd, cb)
+        if not done.wait(timeout):
+            raise TimeoutError(f"admin command on region {leader.region.id} timed out")
+        if isinstance(res[0], Exception):
+            raise res[0]
+
+    def split_region(self, region_id: int, split_key: bytes) -> int:
+        leader = self.wait_leader(region_id)
+        new_region_id = self.alloc_id()
+        new_pids = [self.alloc_id() for _ in leader.region.peers]
+        done = threading.Event()
+        res: list = []
+
+        def cb(r):
+            res.append(r)
+            done.set()
+
+        leader.propose_split(split_key, new_region_id, new_pids, cb)
+        if not done.wait(10.0):
+            raise TimeoutError("split timed out")
+        if isinstance(res[0], Exception):
+            raise res[0]
+        self.wait_leader(new_region_id)
+        return new_region_id
+
+    def add_peer(self, region_id: int, store_id: int) -> int:
+        leader = self.wait_leader(region_id)
+        new_pid = self.alloc_id()
+        cmd = {
+            "epoch": (leader.region.epoch.conf_ver, leader.region.epoch.version),
+            "ops": [],
+            "admin": ("conf_change", "add", new_pid, store_id),
+        }
+        self._run_admin(leader, cmd)
+        return new_pid
+
+    def remove_peer(self, region_id: int, peer_id: int) -> None:
+        leader = self.wait_leader(region_id)
+        cmd = {
+            "epoch": (leader.region.epoch.conf_ver, leader.region.epoch.version),
+            "ops": [],
+            "admin": ("conf_change", "remove", peer_id, 0),
+        }
+        self._run_admin(leader, cmd)
+
+    def transfer_leader(self, region_id: int, to_store: int, timeout: float = 10.0) -> None:
+        peer = self.nodes[to_store].store.peers[region_id]
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            peer.node.campaign()
+            time.sleep(0.1)
+            if peer.node.is_leader():
+                return
+        raise AssertionError(f"store {to_store} never took region {region_id}")

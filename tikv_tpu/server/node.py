@@ -82,12 +82,16 @@ class Node:
 
         def pd_loop():
             while not self._stop.is_set():
-                self.pd.store_heartbeat(self.store_id, {"regions": len(self.store.peers)})
-                for peer in list(self.store.peers.values()):
-                    if peer.node.is_leader():
-                        self.pd.region_heartbeat(peer.region.clone(), self.store_id)
-                        self._maybe_split(peer)
-                self.store.request_log_compaction()
+                try:
+                    self.pd.store_heartbeat(self.store_id, {"regions": len(self.store.peers)})
+                    for peer in list(self.store.peers.values()):
+                        if peer.node.is_leader():
+                            self.pd.region_heartbeat(peer.region.clone(), self.store_id)
+                            self._maybe_split(peer)
+                    self.store.request_log_compaction()
+                except Exception as exc:  # PD briefly unreachable: keep beating
+                    if len(self.thread_errors) < 128:
+                        self.thread_errors.append(exc)
                 self._stop.wait(heartbeat_interval)
 
         for fn in (raft_loop, pd_loop):

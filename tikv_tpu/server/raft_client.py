@@ -1,0 +1,220 @@
+"""Peer raft transport over the framed-TCP wire.
+
+Re-expression of ``src/server/raft_client.rs`` (:759 RaftClient, :844 send,
+:934 flush, :479 per-store connection pool with backoff) and the snapshot
+sender of ``src/server/snap.rs`` (:41): raft messages are buffered per target
+store and flushed as ONE ``raft_batch`` frame (BatchRaftMessage), fire and
+forget — raft tolerates a lossy channel, so a send failure drops the buffer
+and backs off rather than blocking the raft loop.  Snapshot-bearing messages
+bypass the batch stream and go as chunked ``snap_chunk`` frames.
+
+``RemoteTransport`` adapts this to the raftstore ``Transport`` interface and
+keeps the fault-injection ``Filter`` API of the in-memory transport, so the
+scenario suite (partitions, drops) runs unchanged over real sockets.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+import time
+from typing import Callable
+
+from ..raft import net as raft_net
+from ..raft.store import Filter, RaftMessage, Transport
+from . import wire
+from .server import write_frame
+
+_BACKOFF_S = 0.5
+_MAX_BUFFERED = 4096
+
+
+class _StoreConn:
+    """One connection to a peer store (raft_client.rs per-store queue).
+    The address re-resolves on every reconnect: a restarted store comes back
+    on a different port and the resolver (PD in the reference, resolve.rs)
+    is the source of truth.
+
+    Locking: ``mu`` guards the pending-message buffer (held briefly by the
+    raft thread); ``send_mu`` serializes ALL socket I/O including connect —
+    the flusher thread and snapshot sender threads share this socket, and
+    interleaved ``write_frame`` bytes would desync the receiver's framing."""
+
+    def __init__(self, store_id: int, resolver, owner: "RaftClient"):
+        self.store_id = store_id
+        self.resolver = resolver
+        self.owner = owner
+        self.sock: socket.socket | None = None
+        self.mu = threading.Lock()
+        self.send_mu = threading.Lock()
+        self.buf: list = []  # wire-encoded raft messages pending flush
+        self.down_until = 0.0
+        self.snap_inflight = False  # one snapshot transfer at a time per store
+
+    def _connect_locked(self) -> bool:
+        if self.sock is not None:
+            return True
+        if time.monotonic() < self.down_until:
+            return False
+        addr = self.resolver(self.store_id)
+        if addr is None:
+            self.owner.dropped_unresolved += 1
+            self.down_until = time.monotonic() + _BACKOFF_S
+            return False
+        try:
+            self.sock = socket.create_connection((addr[0], addr[1]), timeout=2.0)
+            self.sock.settimeout(5.0)
+            return True
+        except OSError:
+            self.sock = None
+            self.down_until = time.monotonic() + _BACKOFF_S
+            return False
+
+    def send_oneway(self, method: str, req) -> bool:
+        """Fire-and-forget frame (req_id 0 = no response expected)."""
+        with self.send_mu:
+            if not self._connect_locked():
+                return False
+            try:
+                write_frame(self.sock, wire.dumps([0, method, req]))
+                return True
+            except OSError:
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+                self.sock = None
+                self.down_until = time.monotonic() + _BACKOFF_S
+                return False
+
+    def close(self) -> None:
+        with self.send_mu:
+            if self.sock is not None:
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+                self.sock = None
+
+
+class RaftClient:
+    """Buffers outgoing raft messages per store; a flusher thread ships them
+    as batched frames.  ``resolver`` maps store_id -> (host, port) (the
+    reference resolves through PD, resolve.rs:145)."""
+
+    def __init__(self, resolver: Callable[[int], tuple[str, int] | None]):
+        import random
+
+        self.resolver = resolver
+        self._conns: dict[int, _StoreConn] = {}
+        self._mu = threading.Lock()
+        # transfer ids must be unique across every sending store feeding one
+        # receiver's assembler: start at a random 62-bit offset per client
+        self._xfer_ids = itertools.count(random.getrandbits(62) | (1 << 62))
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._flusher = threading.Thread(target=self._flush_loop, daemon=True)
+        self._flusher.start()
+        # lost-message accounting (metrics.rs raft_client counters)
+        self.dropped_unresolved = 0
+        self.dropped_send = 0
+        self.dropped_full = 0
+
+    def _conn_for(self, store_id: int) -> _StoreConn:
+        with self._mu:
+            conn = self._conns.get(store_id)
+            if conn is None:
+                conn = _StoreConn(store_id, self.resolver, self)
+                self._conns[store_id] = conn
+            return conn
+
+    def evict(self, store_id: int) -> None:
+        """Forget a (re-addressed or dead) store's connection."""
+        with self._mu:
+            conn = self._conns.pop(store_id, None)
+        if conn is not None:
+            conn.close()
+
+    def send(self, store_id: int, rmsg: RaftMessage) -> None:
+        conn = self._conn_for(store_id)
+        if rmsg.msg.snapshot is not None and rmsg.msg.snapshot.data:
+            # big payload: dedicated chunk stream on its own sender thread —
+            # a multi-MB transfer on the raft thread would stall ticks and
+            # heartbeats for every region on the store (the reference runs a
+            # snap-sender task per transfer, snap.rs:41)
+            with conn.mu:
+                if conn.snap_inflight:
+                    return  # raft re-queues the snapshot if the target stays behind
+                conn.snap_inflight = True
+            xid = next(self._xfer_ids)
+            t = threading.Thread(
+                target=self._send_snapshot, args=(conn, rmsg, xid), daemon=True
+            )
+            t.start()
+            return
+        with conn.mu:
+            if len(conn.buf) >= _MAX_BUFFERED:
+                self.dropped_full += 1
+                return
+            conn.buf.append(raft_net.rmsg_to_wire(rmsg))
+        self._wake.set()
+
+    def _send_snapshot(self, conn: _StoreConn, rmsg: RaftMessage, xid: int) -> None:
+        try:
+            for chunk in raft_net.split_snapshot(rmsg, xid):
+                if not conn.send_oneway("raft_snapshot_chunk", chunk):
+                    self.dropped_send += 1
+                    return
+        finally:
+            with conn.mu:
+                conn.snap_inflight = False
+
+    def flush(self) -> None:
+        """Ship every buffered message now (raft_client.rs:934)."""
+        with self._mu:
+            conns = list(self._conns.values())
+        for conn in conns:
+            with conn.mu:
+                batch, conn.buf = conn.buf, []
+            if not batch:
+                continue
+            if not conn.send_oneway("raft_batch", {"msgs": batch}):
+                self.dropped_send += len(batch)
+
+    def _flush_loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(0.05)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            # tiny linger so messages produced in one ready batch coalesce
+            time.sleep(0.0005)
+            self.flush()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._flusher.join(timeout=2)
+        with self._mu:
+            conns, self._conns = list(self._conns.values()), {}
+        for c in conns:
+            c.close()
+
+
+class RemoteTransport(Transport):
+    """raftstore Transport over RaftClient, with the in-memory transport's
+    Filter hook retained for fault injection (transport_simulate.rs)."""
+
+    def __init__(self, resolver: Callable[[int], tuple[str, int] | None]):
+        self.client = RaftClient(resolver)
+        self.filters: list[Filter] = []
+
+    def send(self, to_store: int, rmsg: RaftMessage) -> None:
+        for f in self.filters:
+            if not f.before(rmsg):
+                return
+        self.client.send(to_store, rmsg)
+
+    def close(self) -> None:
+        self.client.close()

@@ -78,6 +78,18 @@ class Server:
                     return
                 req_id, method, request = wire.loads(frame)
 
+                if req_id == 0:
+                    # oneway frame (peer raft traffic): no response, and run
+                    # INLINE so frames keep the connection's FIFO order —
+                    # snapshot chunks and raft messages must not be reordered
+                    # by pool scheduling (the reference's peer stream is
+                    # likewise ordered per connection)
+                    try:
+                        self.service.dispatch(method, request)
+                    except Exception:  # noqa: BLE001 — lossy channel
+                        pass
+                    continue
+
                 def run(req_id=req_id, method=method, request=request):
                     try:
                         resp = self.service.dispatch(method, request)
@@ -109,6 +121,9 @@ class Client:
         self._sock = socket.create_connection((host, port))
         self._dead = False
         self._mu = threading.Lock()
+        # writes serialize separately from bookkeeping: concurrent callers
+        # interleaving sendall bytes mid-frame would desync the server
+        self._send_mu = threading.Lock()
         self._next_id = 0
         self._pending: dict[int, threading.Event] = {}
         self._results: dict[int, object] = {}
@@ -142,7 +157,8 @@ class Client:
             req_id = self._next_id
             ev = threading.Event()
             self._pending[req_id] = ev
-        write_frame(self._sock, wire.dumps([req_id, method, request]))
+        with self._send_mu:
+            write_frame(self._sock, wire.dumps([req_id, method, request]))
         if not ev.wait(timeout):
             raise TimeoutError(f"{method} timed out")
         with self._mu:

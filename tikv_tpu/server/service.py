@@ -68,6 +68,7 @@ class KvService:
     def __init__(
         self, storage: Storage, copr: Endpoint | None = None, copr_v2=None,
         resource_tags=None, debugger=None, cdc=None, pd=None, importer=None,
+        raft_router=None,
     ):
         self.storage = storage
         self.copr = copr
@@ -77,12 +78,56 @@ class KvService:
         self.cdc = cdc
         self.pd = pd
         self.importer = importer
+        # peer raft ingress: the local Store messages are routed into
+        # (service/kv.rs raft:612 / batch_raft:649 / snapshot:692).
+        # The assembler is built eagerly: lazy init would race between
+        # connection threads and orphan a concurrent transfer's first chunk.
+        self.raft_router = raft_router
+        from ..raft.net import SnapshotAssembler
+
+        self._snap_assembler = SnapshotAssembler()
         # Per-instance: the 2-slot long-poll bound must not be shared across
         # stores in one process (a poller on one store would degrade
         # cdc_events long-polls on unrelated stores to immediate returns).
         self._cdc_longpoll_slots = threading.Semaphore(2)
 
-    _HANDLER_PREFIXES = ("kv_", "raw_", "coprocessor", "mvcc_", "debug_", "cdc_", "import_")
+    _HANDLER_PREFIXES = ("kv_", "raw_", "coprocessor", "mvcc_", "debug_", "cdc_", "import_", "raft_")
+
+    # -- peer raft ingress (kv.rs raft/batch_raft/snapshot handlers) --------
+
+    def _router(self):
+        if self.raft_router is None:
+            raise RuntimeError("peer raft service not enabled on this node")
+        return self.raft_router
+
+    def raft_message(self, req: dict) -> dict:
+        """Single RaftMessage ingress (kv.rs:612)."""
+        from ..raft import net as raft_net
+
+        self._router().enqueue_message(raft_net.rmsg_from_wire(req["msg"]))
+        return {}
+
+    def raft_batch(self, req: dict) -> dict:
+        """BatchRaftMessage ingress (kv.rs:649): the peer stream's one frame
+        shape — every buffered message of a flush interval together."""
+        from ..raft import net as raft_net
+
+        router = self._router()
+        for t in req["msgs"]:
+            router.enqueue_message(raft_net.rmsg_from_wire(t))
+        return {}
+
+    def raft_snapshot_chunk(self, req: dict) -> dict:
+        """Chunked snapshot stream ingress (kv.rs snapshot:692, snap.rs:260):
+        chunks joined per transfer id; the completed snapshot message enters
+        the store like any other raft message."""
+        from ..raft import net as raft_net
+
+        router = self._router()
+        rmsg = self._snap_assembler.add_chunk(req)
+        if rmsg is not None:
+            router.enqueue_message(rmsg)
+        return {}
 
     # -- ImportSST service (sst_service.rs: download + ingest) --------------
 

@@ -1,0 +1,151 @@
+"""Standalone store process: the ``run_tikv`` assembly entrypoint.
+
+Re-expression of ``components/server/src/server.rs:105`` (run_tikv) +
+``cmd/tikv-server/src/main.rs``: one OS process = one store.  Connects to PD
+over TCP, opens (or recovers) the durable native engine, assembles
+transport -> raftstore -> RaftKv -> Storage -> coprocessor -> KvService,
+registers its address with PD, bootstraps region 1 if the cluster is virgin,
+and serves until signalled.
+
+Run:  python -m tikv_tpu.server.standalone \
+          --store-id 1 --pd 127.0.0.1:2379 --dir /data/store1 --expect-stores 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from ..copr.endpoint import Endpoint
+from ..pd.service import RemotePd
+from ..raft.raftkv import RaftKv
+from ..raft.region import Peer as RegionPeer, Region, RegionEpoch
+from ..storage.storage import Storage
+from .debug import Debugger
+from .node import FIRST_REGION_ID, Node
+from .raft_client import RemoteTransport
+from .server import Server
+from .service import KvService
+
+
+def open_engine(path: str | None):
+    if path is None:
+        from ..storage.btree_engine import BTreeEngine
+
+        return BTreeEngine()
+    from ..native.engine import NativeEngine, native_available
+
+    if not native_available():
+        raise RuntimeError("native engine unavailable; cannot open a durable store")
+    return NativeEngine(path=path)
+
+
+class StoreServer:
+    """The assembled store (TiKVServer, components/server/src/server.rs:168)."""
+
+    def __init__(
+        self,
+        store_id: int,
+        pd: RemotePd,
+        data_dir: str | None = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        enable_device: bool = False,
+    ):
+        self.pd = pd
+        self.engine = open_engine(data_dir)
+        self.transport = RemoteTransport(self._resolve)
+        self.node = Node(pd, self.transport, store_id=store_id, engine=self.engine)
+        self.store = self.node.store
+        recovered = self.store.recover()
+        self.raftkv = RaftKv(self.store)
+        self.storage = Storage(engine=self.raftkv)
+        self.copr = Endpoint(self.raftkv, enable_device=enable_device)
+        self.service = KvService(
+            self.storage,
+            self.copr,
+            debugger=Debugger(self.engine),
+            pd=pd,
+            raft_router=self.store,
+        )
+        self.server = Server(self.service, host=host, port=port)
+        self.recovered_peers = recovered
+
+    def _resolve(self, store_id: int):
+        try:
+            return self.pd.get_store_addr(store_id)
+        except Exception:  # noqa: BLE001 — PD briefly unreachable
+            return None
+
+    def start(self) -> None:
+        self.server.start()
+        self.pd.put_store(self.store.store_id, addr=self.server.addr)
+        self.node.start()
+
+    def bootstrap_or_join(self, expect_stores: int, timeout: float = 30.0) -> None:
+        """Cluster formation (node.rs:153 try_bootstrap): wait until
+        ``expect_stores`` stores registered; the lowest id bootstraps region
+        1 spanning all of them; everyone creates local peers placed here.
+        A recovered store skips formation — its peers came off disk."""
+        if self.recovered_peers:
+            return
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            region = self.pd.get_region_by_id(FIRST_REGION_ID)
+            if region is not None:
+                me = region.peer_on_store(self.store.store_id)
+                if me is not None and region.id not in self.store.peers:
+                    self.store.create_peer(region)
+                    if self.store.store_id == min(p.store_id for p in region.peers):
+                        self.store.peers[region.id].node.campaign()
+                return
+            stores = sorted(self.pd.alive_stores())
+            if len(stores) >= expect_stores:
+                if self.store.store_id == stores[0]:
+                    peers = [RegionPeer(self.pd.alloc_id(), sid) for sid in stores[:expect_stores]]
+                    region = Region(FIRST_REGION_ID, b"", b"", RegionEpoch(), peers)
+                    self.pd.bootstrap_region(region)
+                    continue  # next loop iteration takes the join path
+            time.sleep(0.1)
+        raise TimeoutError("cluster never formed")
+
+    def stop(self) -> None:
+        self.node.stop()
+        self.server.stop()
+        self.transport.close()
+        close = getattr(self.engine, "close", None)
+        if close is not None:
+            close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="tikv_tpu store server")
+    ap.add_argument("--store-id", type=int, required=True)
+    ap.add_argument("--pd", required=True, help="host:port of the PD service")
+    ap.add_argument("--dir", default=None, help="durable engine directory")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--expect-stores", type=int, default=1)
+    ap.add_argument("--enable-device", action="store_true")
+    args = ap.parse_args(argv)
+
+    host, port = args.pd.rsplit(":", 1)
+    pd = RemotePd(host, int(port))
+    srv = StoreServer(
+        args.store_id, pd, data_dir=args.dir,
+        host=args.host, port=args.port, enable_device=args.enable_device,
+    )
+    srv.start()
+    srv.bootstrap_or_join(args.expect_stores)
+    print(f"READY store={args.store_id} addr={srv.server.addr[0]}:{srv.server.addr[1]}", flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
