@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Raft write-path micro-bench: inline apply vs the apply pipeline.
+
+Measures proposals/sec through a single-store raft group over the durable
+native engine (WAL fsync per append — the reference's
+tests/benches/hierarchy/ engine→raft write costs).  Two configurations:
+
+  inline    — append + apply serialized on the raft thread (round-1 shape)
+  pipeline  — append on the raft thread, apply on workers (batch-system
+              shape, apply.rs): fsync of entry N+1 overlaps apply of N
+
+Prints one JSON line with both rates.  BENCH_RAFT_N controls ops (default
+2000), BENCH_RAFT_BATCH the concurrent in-flight proposals (default 64).
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from tikv_tpu.pd.client import MockPd
+from tikv_tpu.raft.raftkv import RaftKv
+from tikv_tpu.raft.store import ChannelTransport
+from tikv_tpu.server.node import FIRST_REGION_ID, Node
+from tikv_tpu.storage.engine import WriteBatch
+
+
+def run_config(pipelined: bool, n_ops: int, batch: int) -> float:
+    from tikv_tpu.native.engine import NativeEngine, native_available
+
+    tmp = tempfile.mkdtemp()
+    engine = NativeEngine(path=f"{tmp}/db") if native_available() else None
+    pd = MockPd()
+    transport = ChannelTransport()
+    node = Node(pd, transport, engine=engine)
+    if not pipelined:
+        node.store.stop_apply_pipeline()
+    transport.register(node.store)
+    node.try_bootstrap_cluster([node.store_id])
+    node.create_region_peers()
+    peer = node.store.peers[FIRST_REGION_ID]
+    peer.node.campaign()
+    node.pump()
+    assert peer.node.is_leader()
+    node.start(tick_interval=0.05)
+    kv = RaftKv(node.store)
+    ctx = {"region_id": FIRST_REGION_ID}
+
+    # warmup
+    wb = WriteBatch()
+    wb.put_cf("default", b"warm", b"w")
+    kv.write(ctx, wb)
+
+    done = threading.Semaphore(0)
+    inflight = threading.Semaphore(batch)
+    errors = []
+
+    def propose(i: int) -> None:
+        wb = WriteBatch()
+        wb.put_cf("default", b"bench-%08d" % i, b"v" * 64)
+        cmd = {
+            "epoch": (peer.region.epoch.conf_ver, peer.region.epoch.version),
+            "ops": list(wb.ops),
+        }
+
+        def cb(r):
+            if isinstance(r, Exception):
+                errors.append(r)
+            inflight.release()
+            done.release()
+
+        peer.propose_cmd(cmd, cb)
+
+    t0 = time.perf_counter()
+    for i in range(n_ops):
+        inflight.acquire()
+        propose(i)
+    for _ in range(n_ops):
+        done.acquire()
+    dt = time.perf_counter() - t0
+    assert not errors, errors[0]
+    assert peer.apply_index >= n_ops, (peer.apply_index, n_ops)
+    node.stop()
+    close = getattr(node.store.engine, "close", None)
+    if close:
+        close()
+    return n_ops / dt
+
+
+def main() -> None:
+    n = int(os.environ.get("BENCH_RAFT_N", "2000"))
+    batch = int(os.environ.get("BENCH_RAFT_BATCH", "64"))
+    inline = run_config(False, n, batch)
+    pipe = run_config(True, n, batch)
+    print(
+        json.dumps(
+            {
+                "metric": "raft_write_path_proposals_per_sec",
+                "value": round(pipe, 1),
+                "unit": "proposals/sec",
+                "inline_per_sec": round(inline, 1),
+                "pipeline_speedup": round(pipe / inline, 3),
+                "ops": n,
+                "inflight": batch,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

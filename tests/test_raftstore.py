@@ -118,6 +118,62 @@ def test_conf_change_add_remove_peer():
     assert c.must_get(b"k3") == b"v3"
 
 
+def test_lagging_removed_peer_is_tombstoned():
+    """A peer that never RECEIVES its own removal entry (the leader stops
+    replicating to it the moment the remove commits via the other replicas)
+    must still be destroyed: the leader sends an explicit tombstone at the
+    post-change epoch, and any later stale contact is answered with one
+    (raftstore stale-peer GC)."""
+    from tikv_tpu.raft.core import MsgType
+    from tikv_tpu.raft.store import RegionPacketFilter
+
+    c = Cluster(3)
+    region = c.bootstrap()
+    c.elect_leader(region.id, 1)
+    c.must_put(b"k", b"v")
+    # cut APPENDs to store 3 so it lags behind the removal entry
+    filt = RegionPacketFilter(region.id, store_id=3, msg_types={MsgType.APPEND})
+    c.transport.filters.append(filt)
+    leader = c.wait_leader(region.id)
+    victim = leader.region.peer_on_store(3)
+    c.remove_peer(region.id, victim.peer_id)
+    c.transport.filters.remove(filt)
+    c.tick(3)
+    assert region.id not in c.stores[3].peers, (
+        "removed-but-lagging peer survived (tombstone lost AND no contact GC)"
+    )
+    # persisted identity erased too: a restart must not resurrect it
+    c.stores[3].recover()
+    assert region.id not in c.stores[3].peers
+
+
+def test_stale_contact_draws_tombstone():
+    """Backstop for a LOST removal-time tombstone: when the stale peer later
+    campaigns, members answer the contact itself with a tombstone."""
+    from tikv_tpu.raft.core import MsgType
+    from tikv_tpu.raft.store import RegionPacketFilter
+
+    c = Cluster(3)
+    region = c.bootstrap()
+    c.elect_leader(region.id, 1)
+    c.must_put(b"k", b"v")
+    # drop appends AND heartbeats to store 3: it learns nothing of its
+    # removal, and the removal-time tombstone is dropped too
+    filt = RegionPacketFilter(region.id, store_id=3)
+    c.transport.filters.append(filt)
+    leader = c.wait_leader(region.id)
+    victim = leader.region.peer_on_store(3)
+    c.remove_peer(region.id, victim.peer_id)
+    c.tick(3)
+    assert region.id in c.stores[3].peers  # fully isolated: still alive
+    c.transport.filters.remove(filt)
+    # the stale peer campaigns after silence; the contact draws a tombstone
+    c.stores[3].peers[region.id].node.campaign()
+    c.process()
+    c.tick(3)
+    assert region.id not in c.stores[3].peers
+
+
 def test_partition_minority_stalls_majority_recovers(cluster):
     cluster.must_put(b"k", b"v1")
     leader = cluster.wait_leader(FIRST_REGION_ID)

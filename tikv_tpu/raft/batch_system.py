@@ -1,0 +1,82 @@
+"""Apply batch system: committed entries applied OFF the raft thread.
+
+Re-expression of the reference's two-pool write path
+(``components/batch-system/src/batch.rs:284`` Poller,
+``raftstore/src/store/fsm/apply.rs:3120`` ApplyBatchSystem + :920
+handle_raft_committed_entries): the store thread persists log appends and
+sends messages, while committed DATA entries flow through per-region ordered
+queues to apply workers.  Append of entry N+1 (WAL fsync) overlaps apply of
+entry N (engine write) — both release the GIL in the native engine, so the
+pipeline is real parallelism, not just interleaving.
+
+Ordering contract: one region's tasks always run on the same worker
+(region_id -> worker hash), FIFO — exactly the reference's one-ApplyFsm-per-
+region rule.  Admin entries (split/merge/conf change) do NOT come through
+here: they mutate raft/store state owned by the raft thread, so the store
+flushes the region's queue and applies them inline (apply.rs takes the same
+barrier through its own message ordering).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable
+
+
+class ApplySystem:
+    """N workers, per-region FIFO ordering, flush barriers."""
+
+    def __init__(self, workers: int = 2, name: str = "apply"):
+        self.n = max(1, workers)
+        self._queues: list[deque] = [deque() for _ in range(self.n)]
+        self._cvs = [threading.Condition() for _ in range(self.n)]
+        self._stop = False
+        self._threads = []
+        # faults escaping a task land here (the store surfaces them)
+        self.errors: list[Exception] = []
+        for i in range(self.n):
+            t = threading.Thread(target=self._worker, args=(i,), daemon=True, name=f"{name}-{i}")
+            t.start()
+            self._threads.append(t)
+
+    def _slot(self, region_id: int) -> int:
+        return region_id % self.n
+
+    def submit(self, region_id: int, task: Callable[[], None]) -> None:
+        i = self._slot(region_id)
+        with self._cvs[i]:
+            self._queues[i].append((region_id, task))
+            self._cvs[i].notify()
+
+    def flush(self, region_id: int, timeout: float = 30.0) -> None:
+        """Barrier: returns once every task for ``region_id`` submitted
+        before this call has completed (admin-entry / snapshot-gen gate)."""
+        done = threading.Event()
+        self.submit(region_id, done.set)
+        if not done.wait(timeout):
+            raise TimeoutError(f"apply queue for region {region_id} stalled")
+
+    def _worker(self, i: int) -> None:
+        cv = self._cvs[i]
+        q = self._queues[i]
+        while True:
+            with cv:
+                while not q and not self._stop:
+                    cv.wait(0.2)
+                if self._stop and not q:
+                    return
+                region_id, task = q.popleft()
+            try:
+                task()
+            except Exception as exc:  # noqa: BLE001 — worker must survive
+                if len(self.errors) < 128:
+                    self.errors.append(exc)
+
+    def stop(self) -> None:
+        self._stop = True
+        for cv in self._cvs:
+            with cv:
+                cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=2)

@@ -129,7 +129,9 @@ class Cluster:
     # -- KV helpers --------------------------------------------------------
 
     def raftkv(self, store_id: int) -> RaftKv:
-        return RaftKv(self.stores[store_id], pump=self.process)
+        # synchronous pump converges in a few rounds when quorum exists, so
+        # a short deadline keeps expected-stall tests fast
+        return RaftKv(self.stores[store_id], pump=self.process, propose_timeout=2.0)
 
     def region_for_key(self, key: bytes) -> int:
         for store in self.stores.values():

@@ -100,6 +100,7 @@ def rmsg_to_wire(rmsg: RaftMessage) -> tuple:
         msg_to_wire(rmsg.msg),
         (rmsg.region_epoch.conf_ver, rmsg.region_epoch.version),
         encode_region(rmsg.region) if rmsg.region is not None else None,
+        rmsg.is_tombstone,
     )
 
 
@@ -114,6 +115,7 @@ def rmsg_from_wire(t) -> RaftMessage:
         msg=msg_from_wire(t[3]),
         region_epoch=RegionEpoch(t[4][0], t[4][1]),
         region=region,
+        is_tombstone=bool(t[6]),
     )
 
 

@@ -84,13 +84,20 @@ class RaftKv(Engine):
     message loop until a callback fires (test clusters pump synchronously;
     the server wires a background poller)."""
 
-    def __init__(self, store: Store, pump: Callable[[], None] | None = None, resolved_ts=None):
+    def __init__(
+        self,
+        store: Store,
+        pump: Callable[[], None] | None = None,
+        resolved_ts=None,
+        propose_timeout: float = 10.0,
+    ):
         self.store = store
         # default: yield to the node's background raft loop
         self.pump = pump or (lambda: time.sleep(0.0005))
         # ResolvedTsEndpoint enabling follower stale reads (kv.rs stale-read
         # path gated by RegionReadProgress/resolved-ts)
         self.resolved_ts = resolved_ts
+        self.propose_timeout = propose_timeout
 
     def _peer_for_ctx(self, ctx: dict | None):
         ctx = ctx or {}
@@ -130,18 +137,20 @@ class RaftKv(Engine):
                 raise ValueError("stale reads need read_ts in the context")
             resolved, required_idx = self.resolved_ts.progress_of(peer.region.id)
             # RegionReadProgress pairing: the watermark is only meaningful on
-            # a replica that has applied at least the index it was computed
-            # at — a lagging follower must refuse rather than serve a
-            # snapshot missing committed data
-            if read_ts > resolved or peer.node.applied < required_idx:
+            # a replica whose ENGINE contains at least the index it was
+            # computed at (apply_index — node.applied may run ahead of the
+            # apply pipeline) — a lagging follower must refuse rather than
+            # serve a snapshot missing committed data
+            if read_ts > resolved or peer.apply_index < required_idx:
                 raise RaftKv.DataNotReadyError(peer.region.id, read_ts, resolved)
             return RegionSnapshot(self.store.engine.snapshot(), peer.region.clone())
         if not peer.node.is_leader():
             raise NotLeaderError(peer.region.id, self.store.leader_store_of(peer.region.id))
         # lease fast path (LocalReader, read.rs:342): while the leader holds a
-        # quorum-granted lease and has applied everything committed, reads
-        # skip the ReadIndex round entirely
-        if peer.node.lease_valid() and peer.node.applied == peer.node.commit:
+        # quorum-granted lease and the ENGINE contains everything committed
+        # (apply_index, not node.applied — the pipeline may still be writing),
+        # reads skip the ReadIndex round entirely
+        if peer.node.lease_valid() and peer.apply_index >= peer.node.commit:
             return RegionSnapshot(self.store.engine.snapshot(), peer.region.clone())
         done = threading.Event()
         err: list = []
@@ -179,8 +188,12 @@ class RaftKv(Engine):
         if isinstance(r, Exception):
             raise r
 
-    def _pump_until(self, done, region_id: int, max_rounds: int = 5000) -> None:
-        for _ in range(max_rounds):
+    def _pump_until(self, done, region_id: int) -> None:
+        """Wall-clock deadline, not a round count: completion may come from
+        the apply pipeline's worker threads, which need real time regardless
+        of how fast the caller's pump spins."""
+        deadline = time.monotonic() + self.propose_timeout
+        while time.monotonic() < deadline:
             if done.is_set():
                 return
             self.pump()

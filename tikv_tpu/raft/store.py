@@ -144,6 +144,12 @@ class RaftMessage:
     # region carried on snapshot/first-contact messages so the receiver can
     # bootstrap the peer (raftstore maybe_create_peer)
     region: Region | None = None
+    # kvproto RaftMessage.is_tombstone: "you have been removed by a committed
+    # conf change at this epoch — destroy yourself".  Sent by the leader on
+    # applying RemovePeer, and by any member contacted by a peer that a newer
+    # epoch excludes (raftstore's stale-peer GC), so a lagging removed peer
+    # is destroyed even though it never receives its own removal entry.
+    is_tombstone: bool = False
 
 
 class Transport:
@@ -244,6 +250,20 @@ class StorePeer:
         self.pending_reads: dict[bytes, Callable] = {}
         self._read_seq = 0
         self.merging = False  # PrepareMerge applied: no more data proposals
+        # Completed apply progress.  node.applied advances when ready()
+        # DRAINS committed entries (they are handed to the apply pipeline);
+        # apply_index advances when their effects are IN the engine.  Reads,
+        # snapshot generation, and log GC gate on apply_index (the
+        # reference's ApplyState vs RaftLocalState split, peer_storage.rs).
+        self.apply_index = 0
+        # a failed apply latches the region: advancing past the gap would
+        # persist an ApplyState covering entries the engine never saw
+        self.apply_broken = False
+        # guards proposals / pending_reads / pending_read_states: proposers
+        # run on service threads, acks on apply workers, reads on the raft
+        # thread
+        self._cb_mu = threading.Lock()
+        self.pending_read_states: list[tuple[bytes, int]] = []
 
     # -- raft driving ------------------------------------------------------
 
@@ -265,26 +285,32 @@ class StorePeer:
             # [(op, peer_id, store_id), ...] — placement rides IN the entry
             # so any future leader knows where new peers live, not just the
             # proposing store
-            index = self.node.propose_conf_change(("enter_joint", tuple(admin[1])))
+            # propose + register atomically under _cb_mu: an apply worker's
+            # ack sweep (which takes the same lock) must not observe the
+            # entry committed before its proposal is in self.proposals
+            with self._cb_mu:
+                index = self.node.propose_conf_change(("enter_joint", tuple(admin[1])))
+                if index is not None:
+                    self.proposals.append(Proposal(index, self.node.term, cb))
             if index is None:
                 cb(NotLeaderError(self.region.id, None))
-                return
-            self.proposals.append(Proposal(index, self.node.term, cb))
             return
         if admin is not None and admin[0] == "conf_change":
             # placement (store id) rides in the entry, like the reference's
             # ConfChange carrying the full Peer message
-            index = self.node.propose_conf_change((admin[1], admin[2], admin[3]))
+            with self._cb_mu:
+                index = self.node.propose_conf_change((admin[1], admin[2], admin[3]))
+                if index is not None:
+                    self.proposals.append(Proposal(index, self.node.term, cb))
             if index is None:
                 cb(NotLeaderError(self.region.id, None))
-                return
-            self.proposals.append(Proposal(index, self.node.term, cb))
             return
-        index = self.node.propose(encode_cmd(cmd))
+        with self._cb_mu:
+            index = self.node.propose(encode_cmd(cmd))
+            if index is not None:
+                self.proposals.append(Proposal(index, self.node.term, cb))
         if index is None:
             cb(NotLeaderError(self.region.id, None))
-            return
-        self.proposals.append(Proposal(index, self.node.term, cb))
 
     def _epoch_ok(self, cmd: dict) -> bool:
         """Data commands only care about the range (version); admin commands
@@ -311,12 +337,13 @@ class StorePeer:
 
     def read_index(self, cb: Callable) -> None:
         """Linearizable read barrier; cb() fires once safe to read locally."""
-        self._read_seq += 1
-        ctx = codec.encode_u64(self.region.id) + codec.encode_u64(self._read_seq)
-        self.pending_reads[ctx] = cb
+        with self._cb_mu:
+            self._read_seq += 1
+            ctx = codec.encode_u64(self.region.id) + codec.encode_u64(self._read_seq)
+            self.pending_reads[ctx] = cb
         self.node.read_index(ctx)
 
-    def handle_ready(self) -> bool:
+    def handle_ready(self, sync_apply: bool = False) -> bool:
         rd = self.node.ready()
         if rd.is_empty():
             return False
@@ -329,36 +356,172 @@ class StorePeer:
             wb.put_cf(CF_RAFT, keys.raft_state_key(self.region.id), self._encode_raft_state())
             eng.write(wb)
         if rd.snapshot is not None:
+            if self.store.apply_system is not None:
+                # queued runs reference the pre-snapshot region: drain them
+                # before the snapshot swaps region/engine state underneath
+                self.store.apply_system.flush(self.region.id)
             self._apply_snapshot(rd.snapshot)
+        apply_sys = None if sync_apply else self.store.apply_system
         if rd.committed_entries:
-            applied = rd.committed_entries[0].index - 1
-            try:
-                for e in rd.committed_entries:
-                    self._apply_entry(e)
-                    applied = e.index
-            except BaseException:
-                # a fault mid-apply (e.g. an injected failpoint) must not
-                # lose committed entries: ready() advanced node.applied to
-                # commit when it drained them, so rewind to the last entry
-                # actually applied — the next ready() re-delivers the rest
-                self.node.applied = applied
-                eng.put_cf(
-                    CF_RAFT, keys.apply_state_key(self.region.id), codec.encode_u64(applied)
-                )
-                raise
-        if rd.committed_entries:
-            # ApplyState: recovery resumes application after this index
-            eng.put_cf(
-                CF_RAFT, keys.apply_state_key(self.region.id), codec.encode_u64(self.node.applied)
-            )
-        for ctx, index in rd.read_states:
-            cb = self.pending_reads.pop(ctx, None)
-            if cb is not None:
-                # safe once applied >= read index (we apply synchronously)
-                cb(None)
+            if apply_sys is None:
+                self._apply_entries_inline(rd.committed_entries)
+            else:
+                self._schedule_apply(rd.committed_entries, apply_sys)
+        if rd.read_states:
+            # enqueue under the lock FIRST, then sweep: checking apply_index
+            # before appending loses the wakeup if the apply worker advances
+            # and sweeps in between (_flush_pending_reads re-checks under
+            # the same lock, so one of the two sweeps always fires the cb)
+            with self._cb_mu:
+                self.pending_read_states.extend(rd.read_states)
+            self._flush_pending_reads()
         for m in rd.messages:
             self._send_raft_msg(m)
         return True
+
+    def _apply_entries_inline(self, entries: list[Entry]) -> None:
+        eng = self.store.engine
+        applied = entries[0].index - 1
+        try:
+            for e in entries:
+                self._apply_entry(e)
+                applied = e.index
+        except BaseException:
+            # a fault mid-apply (e.g. an injected failpoint) must not
+            # lose committed entries: ready() advanced node.applied to
+            # commit when it drained them, so rewind to the last entry
+            # actually applied — the next ready() re-delivers the rest
+            self.node.applied = applied
+            self.apply_index = max(self.apply_index, applied)
+            eng.put_cf(
+                CF_RAFT, keys.apply_state_key(self.region.id), codec.encode_u64(applied)
+            )
+            raise
+        # ApplyState: recovery resumes application after this index
+        self.apply_index = max(self.apply_index, self.node.applied)
+        eng.put_cf(
+            CF_RAFT, keys.apply_state_key(self.region.id), codec.encode_u64(self.node.applied)
+        )
+        self._flush_pending_reads()
+
+    def _schedule_apply(self, entries: list[Entry], apply_sys) -> None:
+        """Route committed entries into the apply pipeline (apply.rs:920).
+
+        Plain data entries stream to the region's apply worker in FIFO runs;
+        admin / conf-change entries are a BARRIER: they mutate raft and store
+        state owned by this thread, so the queue drains, then they apply
+        inline.  Decode happens once, here, and the decoded command rides
+        into the worker."""
+        run: list = []
+        for e in entries:
+            cmd = None
+            if e.conf_change is None and e.data:
+                cmd = decode_cmd(e.data)
+            if e.conf_change is None and (cmd is None or cmd.get("admin") is None):
+                run.append((e, cmd))
+                continue
+            # admin or conf entry: flush the pipeline, apply inline
+            if run:
+                self._submit_run(run, apply_sys)
+                run = []
+            apply_sys.flush(self.region.id)
+            self._apply_entry(e)
+            self.apply_index = max(self.apply_index, e.index)
+            self.store.engine.put_cf(
+                CF_RAFT, keys.apply_state_key(self.region.id), codec.encode_u64(e.index)
+            )
+            self._flush_pending_reads()  # reads waiting on this admin index
+        if run:
+            self._submit_run(run, apply_sys)
+
+    def _submit_run(self, run: list, apply_sys) -> None:
+        apply_sys.submit(self.region.id, lambda run=run: self._apply_run(run))
+
+    def _apply_run(self, run: list) -> None:
+        """Executed on an apply worker: data commands only (no admin, no
+        conf change — those applied inline under the barrier).
+
+        The whole run — data ops AND the ApplyState advance — folds into ONE
+        engine WriteBatch (apply.rs likewise commits a committed-entry batch
+        as one atomic RocksDB write): acks fire after the combined write
+        lands, observers see each command in order.
+
+        A failure LATCHES the peer broken (apply_broken): later runs must
+        not advance apply_index past a gap whose effects never reached the
+        engine — that would persist an ApplyState recovery believes, silently
+        diverging the replica from its log (the reference panics the store
+        here, apply.rs; we stop the region and surface the error)."""
+        if self.apply_broken:
+            return
+        try:
+            self._apply_run_inner(run)
+        except BaseException as exc:
+            self.apply_broken = True
+            errs = self.store.apply_system.errors if self.store.apply_system else []
+            if len(errs) < 128:
+                errs.append(exc)
+            raise
+
+    def _apply_run_inner(self, run: list) -> None:
+        eng = self.store.engine
+        applied = None
+        is_witness = self.peer_id in self.node.witnesses
+        wb = WriteBatch()
+        executed: list = []  # (entry, cmd) whose ops are in wb
+        acks: list = []  # deferred until the batch is durable in the engine
+        for e, cmd in run:
+            if cmd is None:
+                applied = e.index  # leader noop: nothing to execute
+                continue
+            if not self._epoch_ok(cmd):
+                acks.append((e, None, EpochError(self.region.clone())))
+                applied = e.index
+                continue
+            fail_point("apply_before_exec")
+            if is_witness:
+                # witnesses replicate and vote on the LOG but never
+                # materialize data
+                acks.append((e, {"applied_index": e.index}, None))
+                applied = e.index
+                continue
+            for op, cf, key, val in cmd["ops"]:
+                dkey = keys.data_key(key)
+                if op == "put":
+                    wb.put_cf(cf, dkey, val)
+                elif op == "delete":
+                    wb.delete_cf(cf, dkey)
+                elif op == "delete_range":
+                    wb.delete_range_cf(cf, dkey, keys.data_key(val))
+            executed.append((e, cmd))
+            acks.append((e, {"applied_index": e.index}, None))
+            applied = e.index
+        if applied is not None:
+            new_apply = max(self.apply_index, applied)
+            wb.put_cf(CF_RAFT, keys.apply_state_key(self.region.id), codec.encode_u64(new_apply))
+            eng.write(wb)
+            self.apply_index = new_apply
+        elif wb.ops:
+            eng.write(wb)
+        for _e, cmd in executed:
+            self.store.on_applied(self.region, cmd)
+        for e, result, err in acks:
+            self._ack(e, result, err)
+        self._flush_pending_reads()
+
+    def _flush_pending_reads(self) -> None:
+        fire = []
+        with self._cb_mu:
+            rest = []
+            for ctx, index in self.pending_read_states:
+                if self.apply_index >= index:
+                    cb = self.pending_reads.pop(ctx, None)
+                    if cb is not None:
+                        fire.append(cb)
+                else:
+                    rest.append((ctx, index))
+            self.pending_read_states = rest
+        for cb in fire:
+            cb(None)
 
     def _send_raft_msg(self, m: Message) -> None:
         to_peer = self.region.peer_by_id(m.to)
@@ -431,18 +594,22 @@ class StorePeer:
         self.store.on_applied(region, cmd)
 
     def _ack(self, e: Entry, result, err) -> None:
-        rest = []
-        for p in self.proposals:
-            if p.index == e.index:
-                if p.term == e.term:
-                    p.cb(err if err is not None else result)
+        fire = []
+        with self._cb_mu:
+            rest = []
+            for p in self.proposals:
+                if p.index == e.index:
+                    if p.term == e.term:
+                        fire.append((p.cb, err if err is not None else result))
+                    else:
+                        fire.append((p.cb, NotLeaderError(self.region.id, None)))  # overwritten
+                elif p.index < e.index:
+                    fire.append((p.cb, NotLeaderError(self.region.id, None)))
                 else:
-                    p.cb(NotLeaderError(self.region.id, None))  # overwritten entry
-            elif p.index < e.index:
-                p.cb(NotLeaderError(self.region.id, None))
-            else:
-                rest.append(p)
-        self.proposals = rest
+                    rest.append(p)
+            self.proposals = rest
+        for cb, arg in fire:
+            cb(arg)
 
     def _notify_removed_peer(self, pid: int, applied_index: int) -> None:
         """Final notification to a peer leaving the config: push the commit
@@ -455,6 +622,26 @@ class StorePeer:
                     commit=min(applied_index, self.node.match_index.get(pid, 0)),
                 )
             )
+
+    def _send_tombstone(self, to_peer: RegionPeer) -> None:
+        """Explicit destroy order for a peer a committed conf change removed
+        (kvproto is_tombstone; raftstore gc of stale peers).  Carries the
+        POST-change epoch, which excludes the target — the receiver verifies
+        before destroying.  Lossy delivery is fine: a surviving stale peer
+        campaigns eventually, and members answer those contacts with fresh
+        tombstones (Store.process_messages)."""
+        self.store.transport.send(
+            to_peer.store_id,
+            RaftMessage(
+                region_id=self.region.id,
+                from_peer=RegionPeer(self.peer_id, self.store.store_id),
+                to_peer=to_peer,
+                msg=Message(MsgType.HEARTBEAT, self.peer_id, to_peer.peer_id, self.node.term),
+                region_epoch=RegionEpoch(self.region.epoch.conf_ver, self.region.epoch.version),
+                region=self.region.clone(),
+                is_tombstone=True,
+            ),
+        )
 
     def _sync_added_peer(self, pid: int, sid: int = 0) -> None:
         """Region bookkeeping for a peer entering the config: record its
@@ -499,10 +686,15 @@ class StorePeer:
     def _apply_conf_change(self, e: Entry) -> None:
         op, pid = e.conf_change[0], e.conf_change[1]
         if op in ("enter_joint", "leave_joint"):
-            self._apply_conf_change_v2(e, op, pid)
+            to_tombstone = self._apply_conf_change_v2(e, op, pid)
+            if to_tombstone is None:
+                return  # we left the config and erased our own state
             self.region.epoch.conf_ver += 1
             self._persist_conf_change_state(e)
+            for p in to_tombstone:
+                self._send_tombstone(p)  # after the bump: epoch must exclude them
             return
+        removed_peer = self.region.peer_by_id(pid) if op == "remove" else None
         if op == "remove":
             self._notify_removed_peer(pid, e.index)
         was_witness = pid in self.node.witnesses
@@ -526,9 +718,17 @@ class StorePeer:
         else:
             self.region.peers = [p for p in self.region.peers if p.peer_id != pid]
             if pid == self.peer_id:
-                self.store.destroy_peer(self.region.id)
+                # applying our own removal: erase persisted identity — a
+                # plain destroy would let recover() resurrect the replica
+                self.store.destroy_peer_tombstone(self.region.id)
+                return
         self.region.epoch.conf_ver += 1
         self._persist_conf_change_state(e)
+        if removed_peer is not None and self.node.is_leader() and removed_peer.peer_id != self.peer_id:
+            # the removed peer may never receive its own removal entry (the
+            # leader stops replicating to it the moment it leaves the
+            # config) — an explicit tombstone at the NEW epoch destroys it
+            self._send_tombstone(removed_peer)
 
     def _apply_conf_change_v2(self, e: Entry, op: str, changes) -> None:
         """Joint membership change (raft thesis 4.3; raft-rs ConfChangeV2,
@@ -551,9 +751,10 @@ class StorePeer:
                 # vote via the outgoing config until leave_joint
             if node.is_leader():
                 node.propose_conf_change(("leave_joint", ()))
-            return
+            return []
         # leave_joint
         dropped = (node.outgoing or set()) - node.voters - node.learners
+        dropped_peers = [p for p in self.region.peers if p.peer_id in dropped]
         for pid in dropped:
             self._notify_removed_peer(pid, e.index)
         node.apply_conf_change(e.conf_change)
@@ -567,7 +768,9 @@ class StorePeer:
             else:
                 p.role = "voter"
         if self.peer_id in dropped:
-            self.store.destroy_peer(self.region.id)
+            self.store.destroy_peer_tombstone(self.region.id)
+            return None  # self-destroyed: caller must not re-persist us
+        return dropped_peers if node.is_leader() else []
 
     def _apply_split(self, admin) -> None:
         _, split_key, new_region_id, new_pids = admin
@@ -618,13 +821,7 @@ class StorePeer:
         self.store.persist_region(self.region)
         if src is not None:
             self.store.destroy_peer(source_id)
-        wb = WriteBatch()
-        wb.delete_cf(CF_RAFT, keys.region_state_key(source_id))
-        wb.delete_cf(CF_RAFT, keys.raft_state_key(source_id))
-        wb.delete_cf(CF_RAFT, keys.apply_state_key(source_id))
-        log_prefix = keys.region_raft_prefix(source_id) + keys.RAFT_LOG_SUFFIX
-        wb.delete_range_cf(CF_RAFT, log_prefix, log_prefix[:-1] + bytes([log_prefix[-1] + 1]))
-        self.store.engine.write(wb)
+        self.store.erase_region_state(source_id)
         self.store.on_merge(self.region, source_id)
 
     def _catch_up_source(self, src: "StorePeer", source_commit: int, carried: list) -> None:
@@ -636,8 +833,11 @@ class StorePeer:
         cannot diverge from the ones that applied these entries live.  This
         removes the quiesce-before-CommitMerge requirement
         (peer.rs on_catch_up_logs_for_merge)."""
-        # drain what the replica itself knows to be committed first
-        src.handle_ready()
+        # drain what the replica itself knows to be committed first —
+        # synchronously: the assertions below need the engine caught up
+        if self.store.apply_system is not None:
+            self.store.apply_system.flush(src.region.id)
+        src.handle_ready(sync_apply=True)
         node = src.node
         if node.applied >= source_commit:
             return
@@ -665,7 +865,7 @@ class StorePeer:
                 f"{node.log.last_index()} of {source_commit}"
             )
         node.commit = max(node.commit, source_commit)
-        src.handle_ready()  # normal apply: epoch checks, splits, observers
+        src.handle_ready(sync_apply=True)  # normal apply: epoch checks, splits, observers
         if node.applied < source_commit:
             raise AssertionError(
                 f"catch-up applied {node.applied} of {source_commit} on region {src.region.id}"
@@ -678,6 +878,11 @@ class StorePeer:
         (store/snap.rs; meta rides along like SnapshotMeta).  Witness
         targets get META ONLY — they vote but never store data."""
         fail_point("region_gen_snapshot")
+        if self.store.apply_system is not None:
+            # the engine scan below must contain every entry the snapshot
+            # index claims — drain in-flight applies first (apply.rs
+            # observes the same barrier through its FSM ordering)
+            self.store.apply_system.flush(self.region.id)
         eng = self.store.engine
         out = bytearray()
         out += codec.encode_compact_bytes(encode_region(self.region, self.merging))
@@ -692,8 +897,10 @@ class StorePeer:
                     out += codec.encode_compact_bytes(k)
                     out += codec.encode_compact_bytes(v)
         return RaftSnapshot(
-            index=self.node.applied,
-            term=self.node.log.term_at(self.node.applied) or self.node.term,
+            # apply_index, not node.applied: the data scanned above is only
+            # guaranteed complete up to what actually finished applying
+            index=self.apply_index,
+            term=self.node.log.term_at(self.apply_index) or self.node.term,
             data=bytes(out),
             voters=tuple(self.node.voters),
             learners=tuple(self.node.learners),
@@ -724,6 +931,7 @@ class StorePeer:
         wb2.put_cf(CF_RAFT, keys.raft_state_key(self.region.id), self._encode_raft_state())
         wb2.put_cf(CF_RAFT, keys.apply_state_key(self.region.id), codec.encode_u64(self.node.applied))
         eng.write(wb2)
+        self.apply_index = max(self.apply_index, self.node.applied)
 
 
 def encode_region(region: Region, merging: bool = False) -> bytes:
@@ -861,6 +1069,22 @@ class Store:
         self.split_observers: list[Callable] = []
         self.merge_observers: list[Callable] = []
         self.apply_observers: list[Callable] = []
+        # apply pipeline (batch-system shape): None = inline apply on the
+        # raft thread (deterministic test clusters); enabled by server nodes
+        self.apply_system = None
+
+    def enable_apply_pipeline(self, workers: int = 2) -> None:
+        """Apply committed data entries off the raft thread (apply.rs
+        ApplyBatchSystem): append of entry N+1 overlaps apply of entry N."""
+        from .batch_system import ApplySystem
+
+        if self.apply_system is None:
+            self.apply_system = ApplySystem(workers, name=f"apply-{self.store_id}")
+
+    def stop_apply_pipeline(self) -> None:
+        if self.apply_system is not None:
+            self.apply_system.stop()
+            self.apply_system = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -875,6 +1099,28 @@ class Store:
 
     def destroy_peer(self, region_id: int) -> None:
         self.peers.pop(region_id, None)
+
+    def destroy_peer_tombstone(self, region_id: int) -> None:
+        """Destroy a peer AND erase its persisted identity (the reference
+        writes PeerState::Tombstone): recovery must not resurrect a replica
+        the config no longer contains."""
+        self.peers.pop(region_id, None)
+        self.erase_region_state(region_id)
+
+    def erase_region_state(self, region_id: int, wb: WriteBatch | None = None) -> None:
+        """THE one definition of wiping a region's persisted identity
+        (region meta, raft state, apply state, log) — shared by tombstone
+        destruction and the commit-merge source cleanup."""
+        own_wb = wb is None
+        if own_wb:
+            wb = WriteBatch()
+        wb.delete_cf(CF_RAFT, keys.region_state_key(region_id))
+        wb.delete_cf(CF_RAFT, keys.raft_state_key(region_id))
+        wb.delete_cf(CF_RAFT, keys.apply_state_key(region_id))
+        log_prefix = keys.region_raft_prefix(region_id) + keys.RAFT_LOG_SUFFIX
+        wb.delete_range_cf(CF_RAFT, log_prefix, log_prefix[:-1] + bytes([log_prefix[-1] + 1]))
+        if own_wb:
+            self.engine.write(wb)
 
     def persist_region(self, region: Region, merging: bool = False) -> None:
         self.engine.put_cf(
@@ -924,6 +1170,7 @@ class Store:
             node.log.entries = entries
             node.applied = max(applied, node.log.snapshot_index)
             node.commit = max(node.commit, node.applied)
+            peer.apply_index = node.applied
             self.peers[region.id] = peer
             recovered += 1
         return recovered
@@ -959,6 +1206,17 @@ class Store:
         moved = bool(inbox)
         for rmsg in inbox:
             peer = self.peers.get(rmsg.region_id)
+            if rmsg.is_tombstone:
+                # a committed conf change removed us at this epoch: verify
+                # and self-destruct (raftstore handling of is_tombstone)
+                if (
+                    peer is not None
+                    and peer.peer_id == rmsg.to_peer.peer_id
+                    and rmsg.region_epoch.conf_ver >= peer.region.epoch.conf_ver
+                    and (rmsg.region is None or rmsg.region.peer_by_id(peer.peer_id) is None)
+                ):
+                    self.destroy_peer_tombstone(rmsg.region_id)
+                continue
             if peer is None and rmsg.region is not None:
                 # first contact for a new peer (conf change / snapshot):
                 # bootstrap it if we're in the carried region
@@ -969,6 +1227,17 @@ class Store:
                     peer = StorePeer(self, region, rmsg.to_peer.peer_id)
                     self.peers[rmsg.region_id] = peer
             if peer is not None and rmsg.to_peer.peer_id == peer.peer_id:
+                # stale-peer GC by contact: a sender a NEWER committed epoch
+                # excludes gets a tombstone back instead of a vote/step —
+                # this is the retry path when the removal-time tombstone was
+                # lost (raftstore is_msg_stale -> gc sender peer)
+                if (
+                    rmsg.region_epoch.conf_ver < peer.region.epoch.conf_ver
+                    and peer.region.peer_by_id(rmsg.from_peer.peer_id) is None
+                    and rmsg.from_peer.peer_id != peer.peer_id
+                ):
+                    peer._send_tombstone(rmsg.from_peer)
+                    continue
                 peer.node.step(rmsg.msg)
         return moved
 
@@ -1004,7 +1273,10 @@ class Store:
         dropped = 0
         for peer in list(self.peers.values()):
             node = peer.node
-            applied = node.applied
+            # compact at COMPLETED apply: with the pipeline, node.applied may
+            # run ahead of the engine — compacting past apply_index would
+            # strand recovery (persisted ApplyState behind a truncated log)
+            applied = min(node.applied, peer.apply_index)
             first = node.log.offset
             if applied - first + 1 <= threshold:
                 continue

@@ -32,6 +32,9 @@ class Node:
         self.pd = pd
         self.store_id = store_id or pd.alloc_id()
         self.store = Store(self.store_id, transport, engine=engine)
+        # server nodes run the apply pipeline (apply.rs ApplyBatchSystem):
+        # committed data entries apply off the raft thread
+        self.store.enable_apply_pipeline()
         self.split_threshold_keys = split_threshold_keys
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -103,6 +106,7 @@ class Node:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=2)
+        self.store.stop_apply_pipeline()
 
     def pump(self) -> None:
         """Synchronous message pump for RaftKv when loops aren't running."""
