@@ -455,12 +455,9 @@ class StorePeer:
             return
         try:
             self._apply_run_inner(run)
-        except BaseException as exc:
+        except BaseException:
             self.apply_broken = True
-            errs = self.store.apply_system.errors if self.store.apply_system else []
-            if len(errs) < 128:
-                errs.append(exc)
-            raise
+            raise  # the worker records the error (batch_system errors list)
 
     def _apply_run_inner(self, run: list) -> None:
         eng = self.store.engine
@@ -730,7 +727,7 @@ class StorePeer:
             # config) — an explicit tombstone at the NEW epoch destroys it
             self._send_tombstone(removed_peer)
 
-    def _apply_conf_change_v2(self, e: Entry, op: str, changes) -> None:
+    def _apply_conf_change_v2(self, e: Entry, op: str, changes) -> "list[RegionPeer] | None":
         """Joint membership change (raft thesis 4.3; raft-rs ConfChangeV2,
         applied by components/raftstore/src/store/peer.rs on_admin): the
         enter_joint entry reshapes the incoming config atomically while the
@@ -1104,6 +1101,11 @@ class Store:
         """Destroy a peer AND erase its persisted identity (the reference
         writes PeerState::Tombstone): recovery must not resurrect a replica
         the config no longer contains."""
+        if self.apply_system is not None:
+            # an in-flight apply run would re-write data + apply_state for
+            # the region AFTER the erase, leaving orphaned keys recovery
+            # could mistake for live state — drain first
+            self.apply_system.flush(region_id)
         self.peers.pop(region_id, None)
         self.erase_region_state(region_id)
 

@@ -1,0 +1,114 @@
+"""Diagnostics service: log search + server info.
+
+Re-expression of ``src/server/service/diagnostics/`` (registered at
+components/server/src/server.rs:907): `search_log` greps the store's log
+file(s) with level/pattern/time filters and `server_info` reports hardware,
+system and process facts — what tidb's `SELECT * FROM information_schema
+.cluster_log / .cluster_hardware` pulls from each store.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import re
+import time
+
+LEVELS = ("DEBUG", "INFO", "WARN", "ERROR", "CRITICAL")
+
+
+class Diagnostics:
+    def __init__(self, log_path: str | None = None):
+        self.log_path = log_path
+        self.start_time = time.time()
+
+    # -- log search (diagnostics/log.rs) ------------------------------------
+
+    def search_log(
+        self,
+        patterns: list[str] | None = None,
+        levels: list[str] | None = None,
+        start_time: float | None = None,
+        end_time: float | None = None,
+        limit: int = 1024,
+    ) -> list[dict]:
+        """Scan the log file; a line matches when every regex pattern hits,
+        its level is in ``levels`` (if given), and its leading ISO timestamp
+        falls inside [start_time, end_time] (lines without a parseable
+        timestamp pass the time filter)."""
+        if self.log_path is None or not os.path.exists(self.log_path):
+            return []
+        regexes = [re.compile(p) for p in (patterns or [])]
+        lvl = {l.upper() for l in levels} if levels else None
+        out: list[dict] = []
+        with open(self.log_path, "r", errors="replace") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if regexes and not all(r.search(line) for r in regexes):
+                    continue
+                level = next((l for l in LEVELS if l in line[:64]), "INFO")
+                if lvl is not None and level not in lvl:
+                    continue
+                ts = _parse_line_time(line)
+                if ts is not None:
+                    if start_time is not None and ts < start_time:
+                        continue
+                    if end_time is not None and ts > end_time:
+                        continue
+                out.append({"time": ts, "level": level, "message": line})
+                if len(out) >= limit:
+                    break
+        return out
+
+    # -- server info (diagnostics/sys.rs) -----------------------------------
+
+    def server_info(self) -> dict:
+        info: dict = {
+            "hostname": platform.node(),
+            "os": platform.system(),
+            "kernel": platform.release(),
+            "arch": platform.machine(),
+            "python": platform.python_version(),
+            "pid": os.getpid(),
+            "uptime_secs": round(time.time() - self.start_time, 1),
+            "cpu_count": os.cpu_count(),
+        }
+        try:
+            info["load_avg"] = list(os.getloadavg())
+        except OSError:
+            pass
+        mem = _meminfo()
+        if mem:
+            info["memory"] = mem
+        try:
+            st = os.statvfs("/")
+            info["disk"] = {
+                "total_bytes": st.f_blocks * st.f_frsize,
+                "available_bytes": st.f_bavail * st.f_frsize,
+            }
+        except OSError:
+            pass
+        return info
+
+
+def _parse_line_time(line: str) -> float | None:
+    m = re.match(r"^[\[]?(\d{4}-\d{2}-\d{2}[ T]\d{2}:\d{2}:\d{2})", line)
+    if m is None:
+        return None
+    try:
+        return time.mktime(time.strptime(m.group(1).replace("T", " "), "%Y-%m-%d %H:%M:%S"))
+    except ValueError:
+        return None
+
+
+def _meminfo() -> dict:
+    out = {}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                k, _, rest = line.partition(":")
+                if k in ("MemTotal", "MemAvailable"):
+                    out[k] = int(rest.strip().split()[0]) * 1024
+    except OSError:
+        return {}
+    return out

@@ -88,13 +88,92 @@ class GcWorker:
 
     def unsafe_destroy_range(self, start: bytes, end: bytes, ctx: dict | None = None) -> None:
         """Drop ALL versions and locks in [start, end) (gc_worker.rs
-        unsafe_destroy_range — used by drop-table)."""
+        unsafe_destroy_range:525 — used by drop-table).  Like the reference,
+        this writes DIRECTLY to the local engine, bypassing raft: the range
+        may span many regions and PD orders the call on every store."""
         enc_start = Key.from_raw(start).encoded
         enc_end = Key.from_raw(end).encoded
+        store = getattr(self.engine, "store", None)
         wb = WriteBatch()
-        for cf in (CF_DEFAULT, CF_LOCK, CF_WRITE):
-            wb.delete_range_cf(cf, enc_start, enc_end)
-        self.engine.write(ctx, wb)
+        if store is not None:
+            from ..util import keys as keymod
+
+            for cf in (CF_DEFAULT, CF_LOCK, CF_WRITE):
+                wb.delete_range_cf(cf, keymod.data_key(enc_start), keymod.data_key(enc_end))
+            store.engine.write(wb)
+        else:
+            for cf in (CF_DEFAULT, CF_LOCK, CF_WRITE):
+                wb.delete_range_cf(cf, enc_start, enc_end)
+            self.engine.write(ctx, wb)
+
+    # -- applied lock collector (applied_lock_collector.rs) -----------------
+    #
+    # Green GC: instead of pausing writes to scan every store's CF_LOCK, PD
+    # registers an observer at max_ts; stores collect locks they APPLY below
+    # that ts while PD physical-scans existing locks.  check returns the
+    # collected set + whether the collector stayed within bounds (clean).
+
+    MAX_COLLECTED_LOCKS = 1024
+
+    def register_lock_observer(self, max_ts: int) -> None:
+        with self._mu:
+            self._observer_max_ts = max_ts
+            self._observer_locks: list[tuple[bytes, object]] = []
+            self._observer_clean = True
+        store = getattr(self.engine, "store", None)
+        if store is not None and self._on_applied not in store.apply_observers:
+            store.apply_observers.append(self._on_applied)
+
+    def check_lock_observer(self) -> dict:
+        from ..storage.txn_types import Key as TKey
+
+        with self._mu:
+            if getattr(self, "_observer_max_ts", None) is None:
+                return {"error": {"other": "no lock observer registered"}}
+            return {
+                "is_clean": self._observer_clean,
+                "locks": [
+                    {
+                        "key": TKey.from_encoded(k).to_raw(),
+                        "lock_ts": lock.ts,
+                        "primary": lock.primary,
+                        "ttl": lock.ttl,
+                    }
+                    for k, lock in self._observer_locks
+                ],
+            }
+
+    def remove_lock_observer(self) -> None:
+        with self._mu:
+            self._observer_max_ts = None
+            self._observer_locks = []
+        store = getattr(self.engine, "store", None)
+        if store is not None and self._on_applied in store.apply_observers:
+            store.apply_observers.remove(self._on_applied)
+
+    def _on_applied(self, store, region, cmd) -> None:
+        """Apply observer: collect CF_LOCK puts below the observer ts."""
+        from ..storage.txn_types import Lock
+
+        with self._mu:
+            max_ts = getattr(self, "_observer_max_ts", None)
+            if max_ts is None:
+                return
+            for op, cf, key, val in cmd.get("ops", ()):
+                if cf != CF_LOCK or op != "put":
+                    continue
+                try:
+                    lock = Lock.from_bytes(val)
+                except Exception:  # noqa: BLE001 — foreign CF_LOCK payload
+                    self._observer_clean = False
+                    continue
+                if lock.ts > max_ts:
+                    continue
+                if len(self._observer_locks) >= self.MAX_COLLECTED_LOCKS:
+                    # bounded memory: the client falls back to physical scan
+                    self._observer_clean = False
+                    return
+                self._observer_locks.append((key, lock))
 
 
 class GcManager:

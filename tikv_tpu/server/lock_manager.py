@@ -106,6 +106,16 @@ class WaiterManager:
                 if q and w in q:
                     q.remove(w)
 
+    def wait_info(self) -> list[dict]:
+        """Current waits: who waits on whom for which key (the
+        get_lock_wait_info RPC view, kv.rs:1061)."""
+        with self._mu:
+            return [
+                {"key": w.key, "start_ts": w.start_ts, "lock_ts": w.lock_ts}
+                for q in self._queues.values()
+                for w in q
+            ]
+
     def wake_up(self, key: bytes, released_ts: int) -> int:
         """Release waiters on ``key`` whose blocker was ``released_ts``."""
         with self._mu:

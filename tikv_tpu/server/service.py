@@ -68,7 +68,8 @@ class KvService:
     def __init__(
         self, storage: Storage, copr: Endpoint | None = None, copr_v2=None,
         resource_tags=None, debugger=None, cdc=None, pd=None, importer=None,
-        raft_router=None,
+        raft_router=None, gc_worker=None, lock_manager=None, resolved_ts=None,
+        diagnostics=None,
     ):
         self.storage = storage
         self.copr = copr
@@ -78,6 +79,10 @@ class KvService:
         self.cdc = cdc
         self.pd = pd
         self.importer = importer
+        self.gc_worker = gc_worker
+        self.lock_manager = lock_manager
+        self.resolved_ts = resolved_ts
+        self.diagnostics = diagnostics
         # peer raft ingress: the local Store messages are routed into
         # (service/kv.rs raft:612 / batch_raft:649 / snapshot:692).
         # The assembler is built eagerly: lazy init would race between
@@ -91,7 +96,18 @@ class KvService:
         # cdc_events long-polls on unrelated stores to immediate returns).
         self._cdc_longpoll_slots = threading.Semaphore(2)
 
-    _HANDLER_PREFIXES = ("kv_", "raw_", "coprocessor", "mvcc_", "debug_", "cdc_", "import_", "raft_")
+    _HANDLER_PREFIXES = (
+        "kv_", "raw_", "coprocessor", "mvcc_", "debug_", "cdc_", "import_", "raft_",
+        "backup", "diagnostics_",
+    )
+    # RPCs whose reference names carry no family prefix (kv.rs:358-1061)
+    _EXTRA_HANDLERS = frozenset(
+        {
+            "register_lock_observer", "check_lock_observer", "remove_lock_observer",
+            "physical_scan_lock", "unsafe_destroy_range", "get_store_safe_ts",
+            "get_lock_wait_info",
+        }
+    )
 
     # -- peer raft ingress (kv.rs raft/batch_raft/snapshot handlers) --------
 
@@ -211,7 +227,7 @@ class KvService:
         wrapper from resource_metering/cpu/future_ext.rs).  Only methods with
         handler prefixes are reachable from the wire — attributes like
         ``storage`` can never be called remotely."""
-        if not method.startswith(self._HANDLER_PREFIXES):
+        if not method.startswith(self._HANDLER_PREFIXES) and method not in self._EXTRA_HANDLERS:
             return {"error": {"other": f"unknown method {method}"}}
         handler = getattr(self, method, None)
         if handler is None:
@@ -290,6 +306,7 @@ class KvService:
         )
         try:
             self.storage.sched_txn_command(cmd, req.get("context"))
+            self._wake_lock_waiters(req["start_version"])
             return {"commit_version": req["commit_version"]}
         except Exception as e:  # noqa: BLE001
             return {"error": _err(e)}
@@ -298,6 +315,7 @@ class KvService:
         cmd = cmds.Rollback([Key.from_raw(k) for k in req["keys"]], req["start_version"])
         try:
             self.storage.sched_txn_command(cmd, req.get("context"))
+            self._wake_lock_waiters(req["start_version"])
             return {}
         except Exception as e:  # noqa: BLE001
             return {"error": _err(e)}
@@ -308,24 +326,62 @@ class KvService:
         )
         try:
             self.storage.sched_txn_command(cmd, req.get("context"))
+            self._wake_lock_waiters(req["start_version"])
             return {}
         except Exception as e:  # noqa: BLE001
             return {"error": _err(e)}
 
     def kv_pessimistic_lock(self, req: dict) -> dict:
-        cmd = cmds.AcquirePessimisticLock(
-            [(Key.from_raw(k), False) for k in req["keys"]],
-            req["primary_lock"],
-            req["start_version"],
-            req["for_update_ts"],
-            lock_ttl=req.get("lock_ttl", 3000),
-            return_values=req.get("return_values", False),
-        )
+        """Acquire pessimistic locks; on conflict, WAIT through the lock
+        manager (waiter_manager.rs) for up to wait_timeout_ms and retry —
+        the reference's WaitForLock flow, with deadlock detection."""
+        from .lock_manager import DeadlockError
+
+        def attempt():
+            cmd = cmds.AcquirePessimisticLock(
+                [(Key.from_raw(k), False) for k in req["keys"]],
+                req["primary_lock"],
+                req["start_version"],
+                req["for_update_ts"],
+                lock_ttl=req.get("lock_ttl", 3000),
+                return_values=req.get("return_values", False),
+            )
+            return self.storage.sched_txn_command(cmd, req.get("context"))
+
         try:
-            r = self.storage.sched_txn_command(cmd, req.get("context"))
-            return {"values": r.get("values")}
+            return {"values": attempt().get("values")}
+        except KeyIsLockedError as e:
+            wait_ms = req.get("wait_timeout_ms", 0)
+            if self.lock_manager is None or not wait_ms:
+                return {"error": _err(e)}
+            try:
+                woken = self.lock_manager.wait_for(
+                    req["start_version"], e.lock.ts, e.key, timeout=wait_ms / 1000.0
+                )
+            except DeadlockError as de:
+                return {
+                    "error": {
+                        "deadlock": {
+                            "waiting_txn": de.waiting_txn,
+                            "blocked_on_txn": de.blocked_on_txn,
+                            "cycle": de.cycle,
+                        }
+                    }
+                }
+            if not woken:
+                return {"error": _err(e)}  # wait timed out: surface the lock
+            try:
+                return {"values": attempt().get("values")}
+            except Exception as e2:  # noqa: BLE001
+                return {"error": _err(e2)}
         except Exception as e:  # noqa: BLE001
             return {"error": _err(e)}
+
+    def _wake_lock_waiters(self, released_ts: int) -> None:
+        """Commit/rollback/resolve released this txn's locks: wake waiters
+        (scheduler.rs on_release_locks -> lock_mgr.wake_up)."""
+        if self.lock_manager is not None:
+            self.lock_manager.wake_up_all(released_ts)
 
     def kv_pessimistic_rollback(self, req: dict) -> dict:
         cmd = cmds.PessimisticRollback(
@@ -335,6 +391,7 @@ class KvService:
         )
         try:
             self.storage.sched_txn_command(cmd, req.get("context"))
+            self._wake_lock_waiters(req["start_version"])
             return {}
         except Exception as e:  # noqa: BLE001
             return {"error": _err(e)}
@@ -496,6 +553,7 @@ class KvService:
         )
         try:
             r = self.storage.sched_txn_command(cmd, req.get("context"))
+            self._wake_lock_waiters(req["start_version"])
             return {"resolved": r["resolved"]}
         except Exception as e:  # noqa: BLE001
             return {"error": _err(e)}
@@ -557,6 +615,22 @@ class KvService:
         )
         return {"kvs": [list(p) for p in pairs]}
 
+    def raw_batch_scan(self, req: dict) -> dict:
+        """Multiple ranges, each capped at each_limit (kv.rs raw_batch_scan)."""
+        out = []
+        for rng in req["ranges"]:
+            start, end = rng[0], rng[1]
+            pairs = self.storage.raw_scan(
+                start,
+                end if end else None,
+                req.get("each_limit"),
+                req.get("context"),
+                reverse=req.get("reverse", False),
+                key_only=req.get("key_only", False),
+            )
+            out.extend(list(p) for p in pairs)
+        return {"kvs": out}
+
     def raw_get_key_ttl(self, req: dict) -> dict:
         ttl = self.storage.raw_get_key_ttl(req["key"], req.get("context"))
         return {"ttl": ttl, "not_found": ttl is None}
@@ -569,6 +643,203 @@ class KvService:
         return {"succeed": ok, "previous_value": prev}
 
     # -- coprocessor --------------------------------------------------------
+
+    # -- MVCC debug reads (kv.rs:229-240, debug.rs mvcc_by_key) --------------
+
+    def _mvcc_info_for_key(self, snap, raw_key: bytes) -> dict:
+        from ..storage.engine import CF_DEFAULT, CF_LOCK, CF_WRITE
+        from ..storage.txn_types import Key as K, Lock, Write, split_ts
+
+        key = K.from_raw(raw_key)
+        info: dict = {"lock": None, "writes": [], "values": []}
+        raw_lock = snap.get_cf(CF_LOCK, key.encoded)
+        if raw_lock is not None:
+            lock = Lock.from_bytes(raw_lock)
+            info["lock"] = {
+                "type": lock.lock_type.name,
+                "start_ts": lock.ts,
+                "primary": lock.primary,
+                "ttl": lock.ttl,
+                "short_value": lock.short_value,
+            }
+        hi = key.append_ts(2**64 - 1).encoded
+        for k, v in snap.scan_cf(CF_WRITE, hi, None):
+            user, commit_ts = split_ts(k)
+            if user != key.encoded:
+                break
+            w = Write.from_bytes(v)
+            info["writes"].append(
+                {
+                    "type": w.write_type.name,
+                    "start_ts": w.start_ts,
+                    "commit_ts": commit_ts,
+                    "short_value": w.short_value,
+                }
+            )
+        for k, v in snap.scan_cf(CF_DEFAULT, hi, None):
+            user, start_ts = split_ts(k)
+            if user != key.encoded:
+                break
+            info["values"].append({"start_ts": start_ts, "value": v})
+        return info
+
+    def mvcc_get_by_key(self, req: dict) -> dict:
+        """Every MVCC trace of one key: lock, write versions, large values
+        (kv.rs:229 mvcc_get_by_key)."""
+        try:
+            snap = self.storage.engine.snapshot(req.get("context"))
+            return {"key": req["key"], "info": self._mvcc_info_for_key(snap, req["key"])}
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    def mvcc_get_by_start_ts(self, req: dict) -> dict:
+        """Find the key a txn (start_ts) touched, then its MVCC info
+        (kv.rs:235 mvcc_get_by_start_ts) — scans CF_WRITE + CF_LOCK for the
+        first trace of the txn inside the requested region/range."""
+        from ..storage.engine import CF_LOCK, CF_WRITE
+        from ..storage.txn_types import Key as K, Lock, Write, split_ts
+
+        start_ts = req["start_ts"]
+        try:
+            snap = self.storage.engine.snapshot(req.get("context"))
+            found: bytes | None = None
+            for k, v in snap.scan_cf(CF_WRITE, b"", None):
+                user, _commit = split_ts(k)
+                if Write.from_bytes(v).start_ts == start_ts:
+                    found = K.from_encoded(user).to_raw()
+                    break
+            if found is None:
+                for k, v in snap.scan_cf(CF_LOCK, b"", None):
+                    if Lock.from_bytes(v).ts == start_ts:
+                        found = K.from_encoded(k).to_raw()
+                        break
+            if found is None:
+                return {"key": None, "info": None}
+            return {"key": found, "info": self._mvcc_info_for_key(snap, found)}
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    # -- GC support (kv.rs:349-525) ------------------------------------------
+
+    def kv_gc(self, req: dict) -> dict:
+        """Deliberate stub, like the reference (kv.rs:349 returns
+        unimplemented): GC is driven by the PD safe point through the
+        GcManager loop, never by a client RPC."""
+        return {"error": {"other": "kv_gc is deprecated: GC is safe-point driven (gc_manager)"}}
+
+    def _gc(self):
+        if self.gc_worker is None:
+            raise RuntimeError("gc worker not enabled on this node")
+        return self.gc_worker
+
+    def unsafe_destroy_range(self, req: dict) -> dict:
+        try:
+            self._gc().unsafe_destroy_range(req["start_key"], req["end_key"], req.get("context"))
+            return {}
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    def physical_scan_lock(self, req: dict) -> dict:
+        try:
+            locks = self._gc().physical_scan_lock(
+                req["max_ts"], req.get("start_key"), req.get("limit")
+            )
+            return {
+                "locks": [
+                    {"key": k, "lock_ts": lock.ts, "primary": lock.primary, "ttl": lock.ttl}
+                    for k, lock in locks
+                ]
+            }
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    def register_lock_observer(self, req: dict) -> dict:
+        try:
+            self._gc().register_lock_observer(req["max_ts"])
+            return {}
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    def check_lock_observer(self, req: dict) -> dict:
+        try:
+            return self._gc().check_lock_observer()
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    def remove_lock_observer(self, req: dict) -> dict:
+        try:
+            self._gc().remove_lock_observer()
+            return {}
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    # -- cluster status RPCs (kv.rs:1034,1061) -------------------------------
+
+    def get_store_safe_ts(self, req: dict) -> dict:
+        """Minimum resolved-ts across this store's regions: the floor below
+        which any stale read on this store is safe (kv.rs:1034)."""
+        if self.resolved_ts is None:
+            return {"safe_ts": 0}
+        return {"safe_ts": self.resolved_ts.min_resolved_ts()}
+
+    def get_lock_wait_info(self, req: dict) -> dict:
+        """Current pessimistic lock waits (kv.rs:1061): who waits on whom."""
+        if self.lock_manager is None:
+            return {"entries": []}
+        waiters = self.lock_manager.wait_info()
+        return {
+            "entries": [
+                {"key": w["key"], "txn": w["start_ts"], "wait_for_txn": w["lock_ts"]}
+                for w in waiters
+            ]
+        }
+
+    # -- Backup service (backup/src/service.rs, server.rs:955-984) -----------
+
+    def backup(self, req: dict) -> dict:
+        """Run a consistent backup of the requested ranges at backup_ts into
+        the external storage named by a URL (local:///, s3://, gcs://...),
+        one file per range."""
+        from ..sidecar.backup import BackupEndpoint
+        from ..sidecar.cloud import create_storage
+
+        try:
+            storage = create_storage(req["storage"])
+            ep = BackupEndpoint(storage)
+            snap = self.storage.engine.snapshot(req.get("context"))
+            files = []
+            for i, rng in enumerate(req["ranges"]):
+                start, end = rng[0], rng[1]
+                name = req.get("name_prefix", "backup") + f"-{i:04d}"
+                files.append(
+                    ep.backup_range(snap, name, req["backup_ts"], start or None, end or None)
+                )
+            return {"files": files}
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
+
+    # -- Diagnostics service (service/diagnostics/, server.rs:907) -----------
+
+    def _diag(self):
+        if self.diagnostics is None:
+            from .diagnostics import Diagnostics
+
+            self.diagnostics = Diagnostics()
+        return self.diagnostics
+
+    def diagnostics_search_log(self, req: dict) -> dict:
+        return {
+            "lines": self._diag().search_log(
+                patterns=req.get("patterns"),
+                levels=req.get("levels"),
+                start_time=req.get("start_time"),
+                end_time=req.get("end_time"),
+                limit=req.get("limit", 1024),
+            )
+        }
+
+    def diagnostics_server_info(self, req: dict) -> dict:
+        return self._diag().server_info()
 
     def coprocessor(self, req: dict) -> dict:
         """req: {tp, dag (DagRequest in-process, or wire dict; optional for

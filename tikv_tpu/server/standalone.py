@@ -59,15 +59,28 @@ class StoreServer:
         self.node = Node(pd, self.transport, store_id=store_id, engine=self.engine)
         self.store = self.node.store
         recovered = self.store.recover()
-        self.raftkv = RaftKv(self.store)
+        from ..sidecar.resolved_ts import ResolvedTsEndpoint
+        from .diagnostics import Diagnostics
+        from .gc_worker import GcWorker
+        from .lock_manager import WaiterManager
+
+        self.resolved_ts = ResolvedTsEndpoint(pd)
+        self.resolved_ts.attach_store(self.store)
+        self.raftkv = RaftKv(self.store, resolved_ts=self.resolved_ts)
         self.storage = Storage(engine=self.raftkv)
         self.copr = Endpoint(self.raftkv, enable_device=enable_device)
+        self.gc_worker = GcWorker(self.raftkv)
+        self.lock_manager = WaiterManager()
         self.service = KvService(
             self.storage,
             self.copr,
             debugger=Debugger(self.engine),
             pd=pd,
             raft_router=self.store,
+            gc_worker=self.gc_worker,
+            lock_manager=self.lock_manager,
+            resolved_ts=self.resolved_ts,
+            diagnostics=Diagnostics(),
         )
         self.server = Server(self.service, host=host, port=port)
         self.recovered_peers = recovered
