@@ -6,10 +6,15 @@ import pytest
 import jax
 
 from tikv_tpu.copr.aggr import AggDescriptor
-from tikv_tpu.copr.dag import Aggregation, DagRequest, Selection, TableScan
+from tikv_tpu.copr.dag import Aggregation, DagRequest, Selection, TableScan, TopN
 from tikv_tpu.copr.jax_eval import _NO_ROW
 from tikv_tpu.copr.rpn import call, col, const_int
-from tikv_tpu.parallel.mesh import ShardedDagEvaluator, make_mesh
+from tikv_tpu.parallel.mesh import (
+    ShardedDagEvaluator,
+    ShardedGroupedEvaluator,
+    ShardedTopNEvaluator,
+    make_mesh,
+)
 
 from copr_fixtures import TABLE_ID, numeric_table_kvs
 
@@ -80,3 +85,214 @@ def test_sharded_group_agg_matches_numpy(groups):
         assert carries[1][1][g] == C[m].sum()
         if m.any():
             assert first[g] != _NO_ROW
+
+
+def _columns(n, cols_map):
+    return {i: (v.astype(np.int64), np.zeros(n, dtype=bool)) for i, v in cols_map.items()}
+
+
+def test_multi_block_carry_simple_agg():
+    """Aggregate state stays on device across super-blocks (long-scan carry)."""
+    mesh = make_mesh(groups=2)
+    rows = 4096 // mesh.shape["regions"] // 4  # 4 super-blocks
+    ev = ShardedDagEvaluator(q6ish(), mesh, rows, capacity=16)
+    total = ev.total_rows
+    blocks = []
+    for b in range(4):
+        sl = slice(b * total, (b + 1) * total)
+        blocks.append(
+            (_columns(total, {1: A[sl], 2: B[sl], 3: C[sl]}), total, np.zeros(total, np.int32))
+        )
+    first, carries = jax.tree.map(np.asarray, ev.run_blocks(blocks))
+    mask = A < 500
+    assert carries[0][0][0] == mask.sum()
+    assert carries[1][1][0] == C[mask].sum()
+    assert carries[2][1][0] == A[mask].min()
+    assert carries[3][1][0] == B[mask].max()
+    assert first[0] == int(np.flatnonzero(mask)[0])
+
+
+def grouped_dag():
+    return DagRequest(
+        executors=[
+            TableScan(TABLE_ID, COLS),
+            Selection([call("lt", col(1), const_int(800))]),
+            Aggregation(
+                [col(2)],
+                [
+                    AggDescriptor("count", None),
+                    AggDescriptor("sum", col(3)),
+                    AggDescriptor("min", col(1)),
+                ],
+            ),
+        ]
+    )
+
+
+def _grouped_oracle(mask, gkey):
+    """numpy oracle: per-group count/sum/min in first-occurrence order."""
+    order, seen = [], set()
+    for i in np.flatnonzero(mask):
+        g = int(gkey[i])
+        if g not in seen:
+            seen.add(g)
+            order.append(g)
+    return order
+
+
+@pytest.mark.parametrize("groups", [1, 2])
+def test_device_group_dict_matches_oracle(groups):
+    """The group DICTIONARY is built on device across shards; results come
+    back in first-occurrence order, matching the host dict-coded path."""
+    mesh = make_mesh(groups=groups)
+    rows_per_shard = 4096 // mesh.shape["regions"]
+    ev = ShardedGroupedEvaluator(grouped_dag(), mesh, rows_per_shard, capacity=64)
+    n = 4096
+    gkey = (B % 13).astype(np.int64)
+    columns = _columns(n, {1: A, 2: gkey, 3: C})
+    out = ev.finalize(ev.run_blocks([(columns, n)]))
+    assert not out["overflow"]
+    mask = A < 800
+    order = _grouped_oracle(mask, gkey)
+    assert list(out["keys"]) == order
+    for pos, g in enumerate(order):
+        m = mask & (gkey == g)
+        assert out["aggs"][0][0][pos] == m.sum()
+        assert out["aggs"][1][1][pos] == C[m].sum()
+        assert out["aggs"][2][1][pos] == A[m].min()
+
+
+def test_device_group_dict_multi_block_carry():
+    """New groups appearing in LATER blocks reshuffle the sorted dictionary;
+    carried per-slot states must be remapped, and first-occurrence order must
+    use the global stream index."""
+    mesh = make_mesh(groups=2)
+    rows = 4096 // mesh.shape["regions"] // 4
+    ev = ShardedGroupedEvaluator(grouped_dag(), mesh, rows, capacity=64)
+    total = ev.total_rows
+    # force new (smaller-sorting) keys to appear only in later blocks
+    gkey = (B % 7).astype(np.int64) + 20
+    gkey[2 * total :] = (B[2 * total :] % 5).astype(np.int64)  # keys 0..4 late
+    blocks = []
+    for b in range(4):
+        sl = slice(b * total, (b + 1) * total)
+        blocks.append((_columns(total, {1: A[sl], 2: gkey[sl], 3: C[sl]}), total))
+    out = ev.finalize(ev.run_blocks(blocks))
+    assert not out["overflow"]
+    mask = A < 800
+    order = _grouped_oracle(mask, gkey)
+    assert list(out["keys"]) == order
+    for pos, g in enumerate(order):
+        m = mask & (gkey == g)
+        assert out["aggs"][0][0][pos] == m.sum()
+        assert out["aggs"][1][1][pos] == C[m].sum()
+        assert out["aggs"][2][1][pos] == A[m].min()
+
+
+def test_group_dict_overflow_is_detected():
+    mesh = make_mesh(groups=1)
+    rows_per_shard = 4096 // mesh.shape["regions"]
+    ev = ShardedGroupedEvaluator(grouped_dag(), mesh, rows_per_shard, capacity=8)
+    n = 4096
+    gkey = (np.arange(n) % 50).astype(np.int64)  # 50 groups > capacity 8
+    columns = _columns(n, {1: A, 2: gkey, 3: C})
+    out = ev.finalize(ev.run_blocks([(columns, n)]))
+    assert out["overflow"], "50 groups into capacity 8 must flag overflow"
+
+
+def topn_dag(k=10):
+    return DagRequest(
+        executors=[
+            TableScan(TABLE_ID, COLS),
+            Selection([call("lt", col(1), const_int(700))]),
+            TopN([(col(2), True), (col(3), False)], k),
+        ]
+    )
+
+
+def _topn_oracle(mask, k):
+    """numpy oracle: rows sorted by (B desc, C asc, stream order), top k."""
+    idx = np.flatnonzero(mask)
+    order = np.lexsort((idx, C[idx], -B[idx]))
+    return idx[order][:k]
+
+
+@pytest.mark.parametrize("n_blocks", [1, 4])
+def test_sharded_topn_matches_oracle(n_blocks):
+    """Per-shard running top-K + collective merge == single-stream top-K,
+    including cross-shard tie-breaks by global stream order."""
+    mesh = make_mesh(groups=2)
+    rows = 4096 // mesh.shape["regions"] // n_blocks
+    ev = ShardedTopNEvaluator(topn_dag(10), mesh, rows)
+    total = ev.total_rows
+    blocks = []
+    for b in range(n_blocks):
+        sl = slice(b * total, (b + 1) * total)
+        h = np.arange(b * total, (b + 1) * total)
+        blocks.append((_columns(total, {0: h, 1: A[sl], 2: B[sl], 3: C[sl]}), total))
+    out = ev.finalize(ev.run_blocks(blocks))
+    expect = _topn_oracle(A < 700, 10)
+    assert out["rows"] == len(expect)
+    assert list(out["gidx"]) == list(expect)
+    # payload columns carry the right rows (0=handle, 1=A, 2=B, 3=C)
+    np.testing.assert_array_equal(out["payload"][0][0], expect)
+    np.testing.assert_array_equal(out["payload"][2][0], B[expect])
+    np.testing.assert_array_equal(out["payload"][3][0], C[expect])
+
+
+def test_sharded_topn_ties_resolve_in_stream_order():
+    """Rows with IDENTICAL keys across different shards must come back in
+    global stream order (the CPU executor's seq tie-break)."""
+    mesh = make_mesh(groups=1)
+    rows = 512 // mesh.shape["regions"]
+    dag = DagRequest(executors=[TableScan(TABLE_ID, COLS), TopN([(col(2), False)], 6)])
+    ev = ShardedTopNEvaluator(dag, mesh, rows)
+    n = ev.total_rows
+    const_b = np.full(n, 42, dtype=np.int64)  # every key ties
+    columns = _columns(n, {0: np.arange(n), 1: A[:n], 2: const_b, 3: C[:n]})
+    out = ev.finalize(ev.run_blocks([(columns, n)]))
+    assert list(out["gidx"]) == [0, 1, 2, 3, 4, 5]
+
+
+def test_sharded_topn_fewer_rows_than_k():
+    mesh = make_mesh(groups=1)
+    rows = 512 // mesh.shape["regions"]
+    dag = DagRequest(
+        executors=[
+            TableScan(TABLE_ID, COLS),
+            Selection([call("lt", col(1), const_int(3))]),
+            TopN([(col(1), False)], 50),
+        ]
+    )
+    ev = ShardedTopNEvaluator(dag, mesh, rows)
+    n = ev.total_rows
+    columns = _columns(n, {0: np.arange(n), 1: A[:n], 2: B[:n], 3: C[:n]})
+    out = ev.finalize(ev.run_blocks([(columns, n)]))
+    assert out["rows"] == int((A[:n] < 3).sum())
+
+
+def test_group_key_out_of_range_flags_overflow():
+    """Values that cannot pack losslessly into the key lane (negative, or
+    >= the NULL lane) must flag overflow — truncation would silently merge
+    distinct groups."""
+    mesh = make_mesh(groups=1)
+    rows_per_shard = 512 // mesh.shape["regions"]
+    ev = ShardedGroupedEvaluator(grouped_dag(), mesh, rows_per_shard, capacity=8)
+    n = ev.total_rows
+    gkey = np.zeros(n, dtype=np.int64)
+    gkey[: n // 2] = -1                # negative: cannot pack
+    gkey[n // 2 :] = (1 << 31) - 1     # collides with the NULL lane
+    columns = _columns(n, {1: np.zeros(n, np.int64), 2: gkey, 3: C[:n]})
+    out = ev.finalize(ev.run_blocks([(columns, n)]))
+    assert out["overflow"], "out-of-range group keys must flag overflow"
+
+
+def test_too_many_group_keys_rejected_at_init():
+    with pytest.raises(ValueError):
+        dag = DagRequest(
+            executors=[
+                TableScan(TABLE_ID, COLS),
+                Aggregation([col(1), col(2), col(3)], [AggDescriptor("count", None)]),
+            ]
+        )
+        ShardedGroupedEvaluator(dag, make_mesh(groups=1), 64, capacity=8)

@@ -642,6 +642,24 @@ class JaxDagEvaluator:
     def _ship_cols(self, extra: list) -> list:
         return self.device_cols + [i for i in extra if i not in self.device_cols]
 
+    def ship_extra_columns(self, extra) -> None:
+        """Permanently extend the shipped column set (mesh evaluators build
+        group dictionaries ON device, so group-by columns must ship even
+        though the single-device path codes them on the host).  Keeps
+        nullable_cols consistent — the NOT_NULL rule lives only here."""
+        from .datatypes import NOT_NULL_FLAG
+
+        need = set(self.device_cols) | set(extra)
+        self.device_cols = sorted(need)
+        scan = self.plan.scan
+        self.nullable_cols = [
+            i for i in self.device_cols
+            if not (scan.columns_info[i].ftype.flag & NOT_NULL_FLAG)
+        ]
+        # derived jit caches keyed on the column set are now stale
+        self._mask_fn_cache = None
+        self._agg_fn_cache = {}
+
     def _stable_dict_group_cols(self, blocks):
         """If every group expr is a bare ref to a dict-encoded column whose
         dictionary object is shared by ALL cached blocks, return (col_idx
