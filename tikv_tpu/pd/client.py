@@ -30,7 +30,9 @@ class PdClient:
 
     def get_region_by_id(self, region_id: int) -> Region | None: ...
 
-    def region_heartbeat(self, region: Region, leader_store: int) -> None: ...
+    def region_heartbeat(self, region: Region, leader_store: int) -> dict | None:
+        """Returns at most one scheduling operator for the leader to run."""
+        ...
 
     def store_heartbeat(self, store_id: int, stats: dict) -> None: ...
 
@@ -61,6 +63,11 @@ class MockPd(PdClient):
         self.gc_safe_point = 0
         self.max_region_keys: int | None = None  # split trigger for heartbeats
         self.split_requests: list[int] = []
+        # scheduling (pd-server schedulers): None disables every policy
+        self.replication_factor: int | None = None
+        self.balance_threshold = 2
+        self.store_down_secs = 10.0
+        self.operators: dict[int, dict] = {}  # region_id -> pending operator
 
     # -- ids / tso ---------------------------------------------------------
 
@@ -100,7 +107,11 @@ class MockPd(PdClient):
         with self._mu:
             return self.leaders.get(region_id)
 
-    def region_heartbeat(self, region: Region, leader_store: int) -> None:
+    def region_heartbeat(self, region: Region, leader_store: int) -> dict | None:
+        """Record the heartbeat and answer with at most ONE operator (the
+        reference's heartbeat-response scheduling, pd_client lib.rs:180 —
+        PD drives the cluster by piggybacking add/remove-peer and
+        transfer-leader orders on region heartbeat responses)."""
         with self._mu:
             cur = self.regions.get(region.id)
             if cur is None or (
@@ -109,6 +120,70 @@ class MockPd(PdClient):
             ):
                 self.regions[region.id] = region.clone()
                 self.leaders[region.id] = leader_store
+            # only the CURRENT leader consumes operators: a just-deposed
+            # ex-leader's heartbeat must not pop (and lose) one it cannot run
+            if self.leaders.get(region.id) == leader_store:
+                pending = self.operators.pop(region.id, None)
+                if pending is not None:
+                    return pending
+            return self._schedule(region, leader_store)
+
+    # -- scheduling policies (the pd-server scheduler equivalents) ----------
+
+    def add_operator(self, region_id: int, op: dict) -> None:
+        """Manual operator injection (pd-ctl operator add ...)."""
+        with self._mu:
+            self.operators[region_id] = op
+
+    def _schedule(self, region: Region, leader_store: int) -> dict | None:
+        """Called under self._mu.  Policies, in priority order:
+        1. replica repair — fewer voters than replication_factor and a spare
+           alive store exists -> add_peer
+        2. excess replica  — more voters than replication_factor ->
+           remove_peer (never the leader's)
+        3. leader balance  — this store leads >= balance_threshold more
+           regions than the least-loaded peer store -> transfer_leader
+        All disabled while replication_factor is None."""
+        if self.replication_factor is None:
+            return None
+        now = time.time()
+        alive = {
+            s.store_id
+            for s in self.stores.values()
+            if now - s.last_heartbeat < self.store_down_secs
+        }
+        voters = [p for p in region.peers if p.role == "voter"]
+        hosting = {p.store_id for p in region.peers}
+        if len(voters) < self.replication_factor:
+            spare = sorted(alive - hosting)
+            if spare:
+                return {"type": "add_peer", "store_id": spare[0]}
+        # a voter on a permanently-down store must be REPLACED even when the
+        # count still equals the factor (the reference removes down peers
+        # after max-store-down-time, which then triggers the add path) —
+        # but only while the live voters alone can still form quorum
+        dead_voters = [p for p in voters if p.store_id not in alive]
+        live_voters = len(voters) - len(dead_voters)
+        if dead_voters and len(voters) == self.replication_factor and live_voters > len(voters) // 2:
+            return {"type": "remove_peer", "peer_id": dead_voters[0].peer_id}
+        if len(voters) > self.replication_factor:
+            # prefer dropping replicas on dead stores, then non-leaders
+            dead = [p for p in voters if p.store_id not in alive]
+            candidates = dead or [p for p in voters if p.store_id != leader_store]
+            if candidates:
+                return {"type": "remove_peer", "peer_id": candidates[0].peer_id}
+        # leader balance over the stores hosting this region
+        counts = {sid: 0 for sid in alive}
+        for rid, lsid in self.leaders.items():
+            if lsid in counts:
+                counts[lsid] += 1
+        peer_stores = [p.store_id for p in voters if p.store_id in alive and p.store_id != leader_store]
+        if peer_stores and leader_store in counts:
+            target = min(peer_stores, key=lambda s: counts[s])
+            if counts[leader_store] - counts[target] >= self.balance_threshold:
+                tp = region.peer_on_store(target)
+                return {"type": "transfer_leader", "peer_id": tp.peer_id, "store_id": target}
+        return None
 
     def report_split(self, left: Region, right: Region) -> None:
         with self._mu:

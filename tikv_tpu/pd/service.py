@@ -61,8 +61,8 @@ class PdService:
 
     def pd_region_heartbeat(self, req: dict) -> dict:
         region, _ = decode_region(req["region"])
-        self.pd.region_heartbeat(region, req["leader_store"])
-        return {}
+        op = self.pd.region_heartbeat(region, req["leader_store"])
+        return {"operator": op}
 
     def pd_store_heartbeat(self, req: dict) -> dict:
         self.pd.store_heartbeat(req["store_id"], req.get("stats", {}))
@@ -91,6 +91,10 @@ class PdService:
 
     def pd_get_gc_safe_point(self, req: dict) -> dict:
         return {"ts": self.pd.get_gc_safe_point()}
+
+    def pd_add_operator(self, req: dict) -> dict:
+        self.pd.add_operator(req["region_id"], req["operator"])
+        return {}
 
 
 class RemotePd(PdClient):
@@ -154,11 +158,12 @@ class RemotePd(PdClient):
     def leader_of(self, region_id: int) -> int | None:
         return self._call("pd_get_region_by_id", {"region_id": region_id})["leader_store"]
 
-    def region_heartbeat(self, region: Region, leader_store: int) -> None:
-        self._call(
+    def region_heartbeat(self, region: Region, leader_store: int) -> dict | None:
+        r = self._call(
             "pd_region_heartbeat",
             {"region": encode_region(region), "leader_store": leader_store},
         )
+        return r.get("operator")
 
     def store_heartbeat(self, store_id: int, stats: dict) -> None:
         self._call("pd_store_heartbeat", {"store_id": store_id, "stats": stats})
@@ -184,6 +189,9 @@ class RemotePd(PdClient):
 
     def update_gc_safe_point(self, ts: int) -> None:
         self._call("pd_update_gc_safe_point", {"ts": ts})
+
+    def add_operator(self, region_id: int, op: dict) -> None:
+        self._call("pd_add_operator", {"region_id": region_id, "operator": op})
 
     def get_gc_safe_point(self) -> int:
         return self._call("pd_get_gc_safe_point", {})["ts"]

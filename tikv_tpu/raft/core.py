@@ -48,6 +48,9 @@ class MsgType(enum.Enum):
     SNAPSHOT = "snapshot"
     READ_INDEX = "read_index"
     READ_INDEX_RESP = "read_index_resp"
+    # leadership transfer (raft-rs MsgTimeoutNow): the leader tells the
+    # transfer target to campaign immediately with stickiness bypassed
+    TIMEOUT_NOW = "timeout_now"
 
 
 @dataclass
@@ -644,8 +647,17 @@ class RaftNode:
             MsgType.SNAPSHOT: self._on_snapshot,
             MsgType.READ_INDEX: self._on_read_index,
             MsgType.READ_INDEX_RESP: self._on_read_index_resp,
+            MsgType.TIMEOUT_NOW: self._on_timeout_now,
         }[m.type]
         handler(m)
+
+    def _on_timeout_now(self, m: Message) -> None:
+        """PD-ordered leadership transfer target (MsgTimeoutNow): campaign
+        immediately, bypassing leader stickiness.  Witnesses and learners
+        never lead, so they ignore the order."""
+        if self.id in self.witnesses or self.id in self.learners:
+            return
+        self.campaign(force=True)
 
     # voting ----------------------------------------------------------------
 

@@ -62,6 +62,12 @@ def encode_cmd(cmd: dict) -> bytes:
         out += codec.encode_compact_bytes(admin[1].encode())
         out += codec.encode_var_u64(admin[2])
         out += codec.encode_var_u64(admin[3])
+    elif admin[0] == "compute_hash":
+        out.append(5)
+    elif admin[0] == "verify_hash":
+        out.append(6)
+        out += codec.encode_var_u64(admin[1])  # apply index of the hash
+        out += codec.encode_var_u64(admin[2])  # expected hash
     elif admin[0] == "prepare_merge":
         out.append(3)
         out += codec.encode_var_u64(admin[1])  # target region id
@@ -111,6 +117,12 @@ def decode_cmd(b: bytes) -> dict:
         pid, off = codec.decode_var_u64(b, off)
         sid, off = codec.decode_var_u64(b, off)
         cmd["admin"] = ("conf_change", op.decode(), pid, sid)
+    elif kind == 5:
+        cmd["admin"] = ("compute_hash",)
+    elif kind == 6:
+        idx, off = codec.decode_var_u64(b, off)
+        h, off = codec.decode_var_u64(b, off)
+        cmd["admin"] = ("verify_hash", idx, h)
     elif kind == 3:
         tid, off = codec.decode_var_u64(b, off)
         cmd["admin"] = ("prepare_merge", tid)
@@ -556,6 +568,18 @@ class StorePeer:
             self._apply_split(admin)
             self._ack(e, {"split": True}, None)
             return
+        if admin is not None and admin[0] == "compute_hash":
+            # witnesses hold no data: they ack but never hash or verify —
+            # their empty-range hash would flag a bogus divergence
+            if self.peer_id not in self.node.witnesses:
+                self._apply_compute_hash(e)
+            self._ack(e, {"compute_hash": True}, None)
+            return
+        if admin is not None and admin[0] == "verify_hash":
+            if self.peer_id not in self.node.witnesses:
+                self._apply_verify_hash(admin[1], admin[2])
+            self._ack(e, {"verify_hash": True}, None)
+            return
         if admin is not None and admin[0] == "prepare_merge":
             self.merging = True
             self.region.epoch.version += 1
@@ -619,6 +643,86 @@ class StorePeer:
                     commit=min(applied_index, self.node.match_index.get(pid, 0)),
                 )
             )
+
+    # -- consistency check (coprocessor/consistency_check.rs + mvcc) --------
+
+    def _region_hash(self) -> int:
+        """crc64 over every (cf, key, value) of the region's data range at
+        the CURRENT apply point — every replica applying the compute_hash
+        entry at the same log index must produce the same value (the raw +
+        mvcc hash of consistency_check.rs, one pass over the data CFs)."""
+        from ..copr.analyze import crc64
+
+        eng = self.store.engine
+        start = keys.data_key(self.region.start_key)
+        end = keys.data_end_key(self.region.end_key)
+        h = 0
+        for cf in DATA_CFS:
+            for k, v in eng.scan_cf(cf, start, end):
+                h = crc64(cf.encode(), h)
+                h = crc64(k, h)
+                h = crc64(v, h)
+        return h
+
+    def _apply_compute_hash(self, e: Entry) -> None:
+        """Every replica hashes its region data at this entry's apply point
+        (ConsistencyCheckObserver).  The LEADER follows up by replicating
+        its own hash in a verify_hash entry, so replicas compare against
+        the leader at the exact same index."""
+        h = self._region_hash()
+        self.store.consistency_hashes[self.region.id] = (e.index, h)
+        if self.node.is_leader():
+            self.propose_cmd(
+                {
+                    "epoch": (self.region.epoch.conf_ver, self.region.epoch.version),
+                    "ops": [],
+                    "admin": ("verify_hash", e.index, h),
+                },
+                lambda r: None,
+            )
+
+    def _apply_verify_hash(self, index: int, expected: int) -> None:
+        rec = self.store.consistency_hashes.get(self.region.id)
+        if rec is None or rec[0] != index:
+            return  # this replica joined after the compute entry (snapshot)
+        if rec[1] != expected:
+            # divergence: the reference panics the store; we record the
+            # region as inconsistent and surface it via the debug service
+            self.store.inconsistent_regions[self.region.id] = {
+                "index": index,
+                "local_hash": rec[1],
+                "leader_hash": expected,
+            }
+
+    def schedule_consistency_check(self, cb: Callable | None = None) -> None:
+        """Leader-side: replicate a compute_hash point (the periodic
+        CONSISTENCY_CHECK tick of raftstore)."""
+        self.propose_cmd(
+            {
+                "epoch": (self.region.epoch.conf_ver, self.region.epoch.version),
+                "ops": [],
+                "admin": ("compute_hash",),
+            },
+            cb or (lambda r: None),
+        )
+
+    def transfer_leader_to(self, target_peer_id: int) -> bool:
+        """PD-ordered transfer (MsgTransferLeader -> MsgTimeoutNow): tell the
+        target to campaign with stickiness bypassed."""
+        if not self.node.is_leader():
+            return False
+        target = self.region.peer_by_id(target_peer_id)
+        if target is None or target.role != "voter":
+            return False
+        # only transfer to a fully caught-up target (raft-rs gates
+        # MsgTimeoutNow on matched progress): a lagging target would lose
+        # the forced election and cost a leaderless round for nothing
+        if self.node.match_index.get(target_peer_id, 0) < self.node.log.last_index():
+            return False
+        self._send_raft_msg(
+            Message(MsgType.TIMEOUT_NOW, self.peer_id, target_peer_id, self.node.term)
+        )
+        return True
 
     def _send_tombstone(self, to_peer: RegionPeer) -> None:
         """Explicit destroy order for a peer a committed conf change removed
@@ -1069,6 +1173,11 @@ class Store:
         # apply pipeline (batch-system shape): None = inline apply on the
         # raft thread (deterministic test clusters); enabled by server nodes
         self.apply_system = None
+        # consistency check (consistency_check.rs): per-region (index, hash)
+        # recorded at compute_hash apply; divergences land in
+        # inconsistent_regions for the debug service / operator
+        self.consistency_hashes: dict[int, tuple[int, int]] = {}
+        self.inconsistent_regions: dict[int, dict] = {}
 
     def enable_apply_pipeline(self, workers: int = 2) -> None:
         """Apply committed data entries off the raft thread (apply.rs

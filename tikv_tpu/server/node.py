@@ -28,6 +28,8 @@ class Node:
         store_id: int | None = None,
         split_threshold_keys: int | None = None,
         engine=None,
+        split_qps_threshold: float | None = None,
+        consistency_check_interval: float | None = None,
     ):
         self.pd = pd
         self.store_id = store_id or pd.alloc_id()
@@ -36,6 +38,14 @@ class Node:
         # committed data entries apply off the raft thread
         self.store.enable_apply_pipeline()
         self.split_threshold_keys = split_threshold_keys
+        # load-based auto split (store/worker/split_controller.rs): write
+        # ops per region per heartbeat; sustained load above the threshold
+        # for two consecutive beats splits the region at its middle key
+        self.split_qps_threshold = split_qps_threshold
+        self._write_ops: dict[int, int] = {}
+        self._hot_beats: dict[int, int] = {}
+        self.consistency_check_interval = consistency_check_interval
+        self._last_consistency = 0.0
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         # faults escaping the raft loop (e.g. injected failpoints) land here
@@ -44,6 +54,14 @@ class Node:
         self.thread_errors: list[Exception] = []
         pd.put_store(self.store_id)
         self.store.split_observers.append(self._on_split)
+        if split_qps_threshold is not None:
+            # only pay the per-apply observer cost when load splitting is on
+            self.store.apply_observers.append(self._count_writes)
+
+    def _count_writes(self, store, region, cmd) -> None:
+        ops = cmd.get("ops")
+        if ops:
+            self._write_ops[region.id] = self._write_ops.get(region.id, 0) + len(ops)
 
     # -- bootstrap ----------------------------------------------------------
 
@@ -87,10 +105,22 @@ class Node:
             while not self._stop.is_set():
                 try:
                     self.pd.store_heartbeat(self.store_id, {"regions": len(self.store.peers)})
+                    led = set()
                     for peer in list(self.store.peers.values()):
                         if peer.node.is_leader():
-                            self.pd.region_heartbeat(peer.region.clone(), self.store_id)
+                            led.add(peer.region.id)
+                            op = self.pd.region_heartbeat(peer.region.clone(), self.store_id)
+                            if op:
+                                self._execute_operator(peer, op)
                             self._maybe_split(peer)
+                            self._maybe_load_split(peer, heartbeat_interval)
+                    # counts accrued while FOLLOWING must not look like load
+                    # the moment this store wins leadership
+                    for rid in list(self._write_ops):
+                        if rid not in led:
+                            self._write_ops.pop(rid, None)
+                            self._hot_beats.pop(rid, None)
+                    self._maybe_consistency_check()
                     self.store.request_log_compaction()
                 except Exception as exc:  # PD briefly unreachable: keep beating
                     if len(self.thread_errors) < 128:
@@ -118,18 +148,27 @@ class Node:
     def _maybe_split(self, peer) -> None:
         if self.split_threshold_keys is None:
             return
+        ks = self._scan_region_keys(peer, self.split_threshold_keys + 1)
+        if len(ks) <= self.split_threshold_keys:
+            return
+        self._propose_middle_split(peer, ks)
+
+    def _scan_region_keys(self, peer, limit: int) -> list:
         eng = self.store.engine
         start = keys.data_key(peer.region.start_key)
         end = keys.data_end_key(peer.region.end_key)
-        ks = [k for k, _ in eng.scan_cf("write", start, end, limit=self.split_threshold_keys + 1)]
-        if len(ks) <= self.split_threshold_keys:
+        return [k for k, _ in eng.scan_cf("write", start, end, limit=limit)]
+
+    def _propose_middle_split(self, peer, ks: list) -> None:
+        """THE split-point rule, shared by size- and load-based splitting:
+        strip the MVCC ts suffix ONLY — region boundaries live in the opaque
+        engine key space (the memcomparable-encoded form for txn data),
+        never decoded: a raw-decoded boundary would not be order-consistent
+        with the stored keys (same rule as the reference, where split-check
+        emits origin_key(engine key) verbatim)."""
+        if len(ks) < 2:
             return
         split_at = keys.origin_key(ks[len(ks) // 2])
-        # strip the MVCC ts suffix ONLY — region boundaries live in the
-        # opaque engine key space (the memcomparable-encoded form for txn
-        # data), never decoded: a raw-decoded boundary would not be
-        # order-consistent with the stored keys (same rule as the reference,
-        # where split-check emits origin_key(engine key) verbatim)
         from ..storage.txn_types import split_ts
 
         try:
@@ -144,3 +183,66 @@ class Node:
 
     def _on_split(self, store, old: Region, new: Region) -> None:
         self.pd.report_split(old.clone(), new.clone())
+
+    # -- PD operator execution (heartbeat-response scheduling) ---------------
+
+    def _execute_operator(self, peer, op: dict) -> None:
+        """Run ONE scheduling order from the PD heartbeat response (the
+        raftstore pd worker executing pdpb::RegionHeartbeatResponse)."""
+        kind = op.get("type")
+        if kind == "transfer_leader":
+            if not peer.transfer_leader_to(op["peer_id"]):
+                # target not caught up yet (the MsgTimeoutNow gate): put the
+                # operator back so a later heartbeat retries it
+                add_op = getattr(self.pd, "add_operator", None)
+                if add_op is not None:
+                    add_op(peer.region.id, op)
+        elif kind == "add_peer":
+            peer.propose_cmd(
+                {
+                    "epoch": (peer.region.epoch.conf_ver, peer.region.epoch.version),
+                    "ops": [],
+                    "admin": ("conf_change", "add", self.pd.alloc_id(), op["store_id"]),
+                },
+                lambda r: None,
+            )
+        elif kind == "remove_peer":
+            peer.propose_cmd(
+                {
+                    "epoch": (peer.region.epoch.conf_ver, peer.region.epoch.version),
+                    "ops": [],
+                    "admin": ("conf_change", "remove", op["peer_id"], 0),
+                },
+                lambda r: None,
+            )
+
+    def _maybe_load_split(self, peer, interval: float) -> None:
+        """AutoSplitController: a region whose sustained write rate exceeds
+        the threshold for two consecutive heartbeats splits at its middle
+        key (split_controller.rs, simplified to write QPS)."""
+        if self.split_qps_threshold is None:
+            return
+        rid = peer.region.id
+        ops = self._write_ops.pop(rid, 0)
+        if ops / max(interval, 1e-6) >= self.split_qps_threshold:
+            self._hot_beats[rid] = self._hot_beats.get(rid, 0) + 1
+        else:
+            self._hot_beats.pop(rid, None)
+            return
+        if self._hot_beats[rid] < 2:
+            return
+        self._hot_beats.pop(rid, None)
+        self._propose_middle_split(peer, self._scan_region_keys(peer, 2048))
+
+    def _maybe_consistency_check(self) -> None:
+        """Periodic compute_hash proposals on led regions
+        (CONSISTENCY_CHECK tick)."""
+        if self.consistency_check_interval is None:
+            return
+        now = time.monotonic()
+        if now - self._last_consistency < self.consistency_check_interval:
+            return
+        self._last_consistency = now
+        for peer in list(self.store.peers.values()):
+            if peer.node.is_leader():
+                peer.schedule_consistency_check()

@@ -145,6 +145,18 @@ class KvService:
             router.enqueue_message(rmsg)
         return {}
 
+    def debug_consistency(self, req: dict) -> dict:
+        """Consistency-check results (tikv-ctl consistency-check view):
+        recorded region hashes and any detected divergences."""
+        router = self._router()
+        return {
+            "hashes": {
+                rid: {"index": idx, "hash": h}
+                for rid, (idx, h) in list(router.consistency_hashes.items())
+            },
+            "inconsistent": dict(router.inconsistent_regions),
+        }
+
     # -- ImportSST service (sst_service.rs: download + ingest) --------------
 
     def _importer(self):
