@@ -1151,3 +1151,7 @@ _bytes_op("regexp_replace", 3, "bytes")(_regexp_replace)
 
 # time-type kernels register themselves into KERNELS on import
 from . import mysql_time as _mysql_time  # noqa: E402,F401
+
+# catalog extension (conversion / control / string / time / json / misc
+# breadth) — also self-registering
+from . import kernels_ext as _kernels_ext  # noqa: E402,F401

@@ -1,0 +1,1344 @@
+"""Scalar-function catalog extension: the remaining reference families.
+
+Closes the gap toward the reference dispatch table
+(``tidb_query_expr/src/lib.rs:300``, ~371 arms): conversion/cast breadth
+(impl_cast.rs), CONVERT_TZ and the remaining time arithmetic
+(impl_time.rs), string breadth (impl_string.rs), control (impl_control.rs),
+math conv/log/round variants (impl_math.rs), compress/uncompress
+(impl_encryption.rs), JSON datetime/search/merge-patch (impl_json.rs), and
+miscellaneous IPv6/network helpers (impl_miscellaneous.rs).
+
+Registered through the same ``KERNELS`` table — one backend-parameterized
+definition per function, CPU/device semantics shared — imported from
+kernels.py at the end of its own registrations.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import ipaddress as _ip
+import struct as _struct
+import zlib as _zlib
+
+import numpy as _np
+
+from .kernels import (
+    KERNELS,
+    _bytes_op,
+    _int_bytes_op,
+    _json_op,
+    _reg,
+    _reg_nullable_int,
+)
+from . import mysql_time as _mt
+
+
+# -- conversion / cast family (impl_cast.rs) --------------------------------
+#
+# decimal values are scaled int64 (frac bookkeeping in rpn.py); these kernels
+# implement the value transform, rpn.py routes frac metadata.
+
+@_reg("cast_int_decimal", 1, "decimal")
+def _cast_int_decimal(xp, a):
+    ad, an = a
+    return ad.astype("int64"), an
+
+
+@_reg("cast_decimal_int", 1, "int")
+def _cast_decimal_int(xp, a):
+    # rpn.py divides by the scale before this kernel sees the value when the
+    # operand's frac > 0; here we only materialize the int
+    ad, an = a
+    return ad.astype("int64"), an
+
+
+@_reg("cast_real_decimal", 1, "decimal")
+def _cast_real_decimal(xp, a):
+    ad, an = a
+    return xp.round(ad).astype("int64"), an
+
+
+def _parse_num_prefix(s_: bytes) -> float:
+    """MySQL string->number: longest numeric prefix, else 0."""
+    t = s_.decode("utf-8", "replace").strip()
+    n = len(t)
+    for end in range(n, 0, -1):
+        try:
+            return float(t[:end])
+        except ValueError:
+            continue
+    return 0.0
+
+
+def _cast_string_real_impl(xp, a):
+    ad, an = a
+    out = _np.fromiter(
+        (_parse_num_prefix(v) for v in ad), dtype=_np.float64, count=len(ad)
+    )
+    return out, _np.asarray(an)
+
+
+KERNELS["cast_string_real"] = (1, "real", _cast_string_real_impl)
+
+
+def _parse_int_prefix(s_: bytes) -> int:
+    """Integer strings parse EXACTLY (no float round-trip: 2^53+ literals
+    must not lose precision); non-integer numerics truncate via float."""
+    t = s_.decode("utf-8", "replace").strip()
+    import re as _re
+
+    m = _re.match(r"[+-]?\d+", t)
+    if m is not None and (len(m.group(0)) == len(t) or not t[len(m.group(0))] in ".eE"):
+        v = int(m.group(0))
+        return max(min(v, 2**63 - 1), -(2**63))  # MySQL clamps at int64 range
+    return int(_parse_num_prefix(s_))
+
+
+def _cast_string_int_impl(xp, a):
+    ad, an = a
+    out = _np.fromiter(
+        (_parse_int_prefix(v) for v in ad), dtype=_np.int64, count=len(ad)
+    )
+    return out, _np.asarray(an)
+
+
+KERNELS["cast_string_int"] = (1, "int", _cast_string_int_impl)
+
+_bytes_op("cast_int_string", 1, "bytes")(lambda n: b"%d" % int(n))
+
+
+def _fmt_real(x: float) -> bytes:
+    if x == int(x) and abs(x) < 1e15:
+        return b"%d" % int(x)
+    return repr(float(x)).encode()
+
+
+_bytes_op("cast_real_string", 1, "bytes")(_fmt_real)
+_bytes_op("cast_datetime_string", 1, "bytes")(
+    lambda p: _mt.format_datetime(int(p)).encode()
+)
+_bytes_op("cast_duration_string", 1, "bytes")(
+    lambda n: _mt.format_duration(int(n)).encode()
+)
+
+
+def _cast_string_datetime(s_: bytes):
+    try:
+        return _mt.parse_datetime(s_.decode("utf-8", "replace"))
+    except ValueError:
+        return None
+
+
+_reg_nullable_int("cast_string_datetime", 1, _cast_string_datetime)
+
+
+def _cast_string_duration(s_: bytes):
+    try:
+        return _mt.parse_duration(s_.decode("utf-8", "replace"))
+    except ValueError:
+        return None
+
+
+_reg_nullable_int("cast_string_duration", 1, _cast_string_duration)
+
+
+# -- control (impl_control.rs) ----------------------------------------------
+
+@_reg("null_eq", 2, "int")
+def _null_eq(xp, a, b):
+    """MySQL <=> : NULL-safe equality, never NULL itself."""
+    (ad, an), (bd, bn) = a, b
+    eq = (ad == bd) & ~an & ~bn
+    both_null = an & bn
+    data = (eq | both_null).astype("int64")
+    return data, xp.zeros(data.shape, dtype=bool)
+
+
+@_reg("nullif", 2, "same")
+def _nullif(xp, a, b):
+    """NULLIF(a, b): NULL when a == b, else a."""
+    (ad, an), (bd, bn) = a, b
+    eq = (ad == bd) & ~an & ~bn
+    return ad, an | eq
+
+
+@_reg("interval_int", -1, "int")
+def _interval_int(xp, *args):
+    """INTERVAL(N, N1, N2, ...): index of the last Ni <= N (impl_compare).
+    NULL N -> -1 (MySQL quirk); NULL thresholds count as +inf."""
+    (nd, nn) = args[0]
+    big = xp.int64(2**62)
+    count = xp.zeros(nd.shape, dtype="int64")
+    for td, tn in args[1:]:
+        t = xp.where(tn, big, td.astype("int64"))
+        count = count + (t <= nd).astype("int64")
+    data = xp.where(nn, xp.int64(-1), count)
+    return data, xp.zeros(nd.shape, dtype=bool)
+
+
+# -- math (impl_math.rs) ----------------------------------------------------
+
+@_reg("log_base", 2, "real")
+def _log_base(xp, a, b):
+    """LOG(b, x): NULL for x <= 0 or b <= 0 or b == 1."""
+    (bd, bn), (ad, an) = a, b
+    base = bd.astype("float64")
+    x = ad.astype("float64")
+    bad = (x <= 0) | (base <= 0) | (base == 1.0)
+    safe_x = xp.where(bad, 1.0, x)
+    safe_b = xp.where(bad, 2.0, base)
+    return xp.log(safe_x) / xp.log(safe_b), an | bn | bad
+
+
+def _conv(s_: bytes, frm: int, to: int):
+    frm, to = int(frm), int(to)
+    if not (2 <= abs(frm) <= 36 and 2 <= abs(to) <= 36):
+        return None
+    t = s_.decode("utf-8", "replace").strip()
+    neg = t.startswith("-")
+    if neg:
+        t = t[1:]
+    digits = "0123456789abcdefghijklmnopqrstuvwxyz"[: abs(frm)]
+    val = 0
+    for ch in t.lower():
+        if ch not in digits:
+            break
+        val = val * abs(frm) + digits.index(ch)
+    if neg:
+        val = -val
+    if val == 0:
+        return b"0"
+    if to < 0:
+        v, sign = (abs(val), "-" if val < 0 else "")
+    else:
+        v, sign = (val & (2**64 - 1), "")
+    out = ""
+    alldig = "0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+    while v:
+        out = alldig[v % abs(to)] + out
+        v //= abs(to)
+    return (sign + out).encode()
+
+
+_bytes_op("conv", 3, "bytes")(_conv)
+_int_bytes_op("bit_count", 1)(lambda n: bin(int(n) & (2**64 - 1)).count("1"))
+
+
+@_reg("round_int_frac", 2, "int")
+def _round_int_frac(xp, a, b):
+    """ROUND(int, frac): negative frac rounds to powers of ten (half away
+    from zero, like MySQL)."""
+    (ad, an), (fd, fn) = a, b
+    frac = xp.clip(-fd.astype("int64"), 0, 18)
+    p = xp.power(xp.int64(10), frac)
+    half = p // 2
+    sign = xp.where(ad < 0, xp.int64(-1), xp.int64(1))
+    data = xp.where(frac > 0, ((xp.abs(ad) + half) // p) * p * sign, ad)
+    return data.astype("int64"), an | fn
+
+
+@_reg("truncate_int_frac", 2, "int")
+def _truncate_int_frac(xp, a, b):
+    (ad, an), (fd, fn) = a, b
+    frac = xp.clip(-fd.astype("int64"), 0, 18)
+    p = xp.power(xp.int64(10), frac)
+    data = xp.where(frac > 0, (ad // p) * p + xp.where((ad % p != 0) & (ad < 0), p, 0), ad)
+    return data.astype("int64"), an | fn
+
+
+# -- string breadth (impl_string.rs) ----------------------------------------
+
+def _insert_str(s_: bytes, pos: int, ln: int, new: bytes):
+    pos, ln = int(pos), int(ln)
+    if pos < 1 or pos > len(s_):
+        return s_
+    if ln < 0 or pos + ln - 1 > len(s_):
+        ln = len(s_) - pos + 1
+    return s_[: pos - 1] + new + s_[pos - 1 + ln :]
+
+
+_bytes_op("insert_str", 4, "bytes")(_insert_str)
+_int_bytes_op("ord", 1)(
+    lambda s_: 0 if not s_ else int.from_bytes(
+        s_[: max(1, _utf8_len(s_[0]))], "big"
+    )
+)
+
+
+def _utf8_len(lead: int) -> int:
+    if lead < 0x80:
+        return 1
+    if lead >> 5 == 0b110:
+        return 2
+    if lead >> 4 == 0b1110:
+        return 3
+    if lead >> 3 == 0b11110:
+        return 4
+    return 1
+
+
+def _quote(s_: bytes) -> bytes:
+    out = bytearray(b"'")
+    for b in s_:
+        if b in (0x27, 0x5C):  # ' and backslash
+            out += b"\\" + bytes([b])
+        elif b == 0:
+            out += b"\\0"
+        elif b == 0x1A:
+            out += b"\\Z"
+        else:
+            out.append(b)
+    out += b"'"
+    return bytes(out)
+
+
+_bytes_op("quote", 1, "bytes")(_quote)
+_bytes_op("soundex", 1, "bytes")(lambda s_: _soundex(s_))
+
+
+def _soundex(s_: bytes) -> bytes:
+    codes = {
+        **dict.fromkeys("BFPV", "1"), **dict.fromkeys("CGJKQSXZ", "2"),
+        **dict.fromkeys("DT", "3"), "L": "4", **dict.fromkeys("MN", "5"), "R": "6",
+    }
+    t = "".join(c for c in s_.decode("utf-8", "replace").upper() if c.isalpha())
+    if not t:
+        return b""
+    out = t[0]
+    last = codes.get(t[0], "")
+    for ch in t[1:]:
+        c = codes.get(ch, "")
+        if c and c != last:
+            out += c
+        last = c
+    return (out + "000")[: max(4, len(out))].encode()
+
+
+def _make_set(bits: int, *strs):
+    out = [s for i, s in enumerate(strs) if s is not None and (int(bits) >> i) & 1]
+    return b",".join(out)
+
+
+def _make_set_wrapped(xp, *args):
+    (bd, bn) = args[0]
+    n = len(bd)
+    out = _np.empty(n, dtype=object)
+    rnull = _np.asarray(bn).copy()
+    for i in range(n):
+        if rnull[i]:
+            out[i] = b""
+            continue
+        strs = [
+            None if args[j][1][i] else args[j][0][i] for j in range(1, len(args))
+        ]
+        out[i] = _make_set(bd[i], *strs)
+    return out, rnull
+
+
+KERNELS["make_set"] = (-1, "bytes", _make_set_wrapped)
+
+
+def _export_set(bits, on, off, sep, count):
+    count = min(max(int(count), 0), 64)
+    return sep.join((on if (int(bits) >> i) & 1 else off) for i in range(count))
+
+
+_bytes_op("export_set5", 5, "bytes")(_export_set)
+_bytes_op("export_set4", 4, "bytes")(lambda b, on, off, sep: _export_set(b, on, off, sep, 64))
+_bytes_op("export_set3", 3, "bytes")(lambda b, on, off: _export_set(b, on, off, b",", 64))
+
+
+def _char_fn(*codes):
+    out = bytearray()
+    for c in codes:
+        if c is None:
+            continue
+        v = int(c) & 0xFFFFFFFF
+        if v == 0:
+            out.append(0)
+            continue
+        chunk = bytearray()
+        while v:
+            chunk.insert(0, v & 0xFF)
+            v >>= 8
+        out += chunk
+    return bytes(out)
+
+
+def _char_wrapped(xp, *args):
+    n = len(args[0][0])
+    out = _np.empty(n, dtype=object)
+    rnull = _np.zeros(n, dtype=bool)  # CHAR() skips NULL args, never NULL itself
+    for i in range(n):
+        codes = [None if nl[i] else d[i] for d, nl in args]
+        out[i] = _char_fn(*codes)
+    return out, rnull
+
+
+KERNELS["char_fn"] = (-1, "bytes", _char_wrapped)
+
+
+def _format_number(x: float, d: int) -> bytes:
+    d = min(max(int(d), 0), 30)
+    s = f"{float(x):,.{d}f}"
+    return s.encode()
+
+
+_bytes_op("format", 2, "bytes")(_format_number)
+
+
+def _locate3(sub: bytes, s_: bytes, pos: int):
+    if int(pos) < 1:
+        return 0  # MySQL LOCATE with pos < 1
+    idx = s_.find(sub, int(pos) - 1)
+    return idx + 1
+
+
+_int_bytes_op("locate3", 3)(_locate3)
+_bytes_op("mid", 3, "bytes")(
+    lambda s_, pos, ln: _mid(s_, int(pos), int(ln))
+)
+
+
+def _mid(s_: bytes, pos: int, ln: int) -> bytes:
+    if pos < 0:
+        pos = len(s_) + pos + 1
+    if pos < 1 or ln <= 0:
+        return b""
+    return s_[pos - 1 : pos - 1 + ln]
+
+
+_bytes_op("lcase", 1, "bytes")(lambda s_: s_.decode("utf-8", "replace").lower().encode())
+_bytes_op("ucase", 1, "bytes")(lambda s_: s_.decode("utf-8", "replace").upper().encode())
+
+
+def _concat_ws(sep, *parts):
+    return sep.join(p for p in parts if p is not None)
+
+
+def _concat_ws_wrapped(xp, *args):
+    (sd, sn) = args[0]
+    n = len(sd)
+    out = _np.empty(n, dtype=object)
+    rnull = _np.asarray(sn).copy()  # NULL separator -> NULL; NULL parts skipped
+    for i in range(n):
+        if rnull[i]:
+            out[i] = b""
+            continue
+        parts = [None if nl[i] else d[i] for d, nl in args[1:]]
+        out[i] = _concat_ws(sd[i], *parts)
+    return out, rnull
+
+
+KERNELS["concat_ws"] = (-1, "bytes", _concat_ws_wrapped)
+
+
+# -- encryption/compression (impl_encryption.rs) ----------------------------
+
+def _compress(s_: bytes) -> bytes:
+    if not s_:
+        return b""
+    return _struct.pack("<I", len(s_)) + _zlib.compress(s_)
+
+
+def _uncompress(s_: bytes):
+    if not s_:
+        return b""
+    if len(s_) < 4:
+        return None
+    (ln,) = _struct.unpack("<I", s_[:4])
+    try:
+        out = _zlib.decompress(s_[4:])
+    except _zlib.error:
+        return None
+    return out if len(out) == ln else None
+
+
+_bytes_op("compress", 1, "bytes")(_compress)
+_bytes_op("uncompress", 1, "bytes")(_uncompress)
+
+
+def _uncompressed_length(s_: bytes) -> int:
+    if len(s_) < 4:
+        return 0
+    return _struct.unpack("<I", s_[:4])[0]
+
+
+_int_bytes_op("uncompressed_length", 1)(_uncompressed_length)
+
+
+# -- time breadth (impl_time.rs) --------------------------------------------
+
+def _safe_dt(fn):
+    def wrapped(*args):
+        try:
+            return fn(*args)
+        except (ValueError, OverflowError):
+            return None
+
+    return wrapped
+
+
+_reg_nullable_int(
+    "makedate", 2,
+    _safe_dt(lambda y, d: None if int(d) <= 0 else _mt.pack_datetime(
+        *((_dt.date(int(y) if int(y) >= 100 else int(y) + (2000 if int(y) < 70 else 1900), 1, 1)
+           + _dt.timedelta(days=int(d) - 1)).timetuple()[:3]), 0, 0, 0, 0
+    )),
+)
+_reg_nullable_int(
+    "maketime", 3,
+    _safe_dt(lambda h, m, s: None if not (0 <= int(m) < 60 and 0 <= s < 60) else
+             _mt.duration_nanos(abs(int(h)), int(m), int(s), neg=int(h) < 0)),
+)
+_reg_nullable_int("period_add", 2, _safe_dt(lambda p, n: _period_from_months(_period_to_months(int(p)) + int(n))))
+_reg_nullable_int("period_diff", 2, _safe_dt(lambda a, b: _period_to_months(int(a)) - _period_to_months(int(b))))
+
+
+def _period_to_months(p: int) -> int:
+    if p == 0:
+        return 0
+    y, m = divmod(p, 100)
+    if y < 70:
+        y += 2000
+    elif y < 100:
+        y += 1900
+    return y * 12 + m - 1
+
+
+def _period_from_months(n: int) -> int:
+    y, m = divmod(n, 12)
+    return y * 100 + m + 1
+
+
+_reg_nullable_int("time_to_sec", 1, lambda nanos: abs(int(nanos)) // _mt.NANOS_PER_SEC * (1 if int(nanos) >= 0 else -1))
+_reg_nullable_int("sec_to_time", 1, lambda s: int(s) * _mt.NANOS_PER_SEC)
+_reg_nullable_int(
+    "to_seconds", 1,
+    # +365: MySQL day counting from year 0 (same convention as to_days)
+    _safe_dt(lambda p: (_mt._as_date(p).toordinal() + 365) * 86400
+             + _mt.unpack_datetime(int(p))[3] * 3600
+             + _mt.unpack_datetime(int(p))[4] * 60
+             + _mt.unpack_datetime(int(p))[5]),
+)
+_reg_nullable_int("day_of_month", 1, _safe_dt(lambda p: _mt.unpack_datetime(int(p))[2]))
+_reg_nullable_int(
+    "week_of_year", 1, _safe_dt(lambda p: _mt._as_date(p).isocalendar()[1])
+)
+def _yearweek0(p: int) -> int:
+    """YEARWEEK mode 0 (MySQL default): Sunday-first weeks counted from the
+    year's first Sunday; dates before it belong to the PREVIOUS year's last
+    week (week never 0 in YEARWEEK — it rolls back)."""
+    d = _mt._as_date(p)
+    for y in (d.year, d.year - 1):
+        jan1 = _dt.date(y, 1, 1)
+        offset = (jan1.weekday() + 1) % 7  # days from Sunday to jan1
+        wk = ((d - jan1).days + offset) // 7
+        if wk > 0 or y < d.year:
+            return y * 100 + wk
+    raise ValueError(p)
+
+
+_reg_nullable_int("year_week", 1, _safe_dt(_yearweek0))
+_reg_nullable_int(
+    "timestamp_diff_days", 2,
+    _safe_dt(lambda a, b: (_mt._as_date(b) - _mt._as_date(a)).days),
+)
+
+
+def _tz_offset_minutes(tz: bytes):
+    """'+HH:MM' / '-HH:MM' offsets; named zones unsupported -> None (the
+    reference resolves named zones through the tz database; offset syntax
+    covers the wire-compatible subset)."""
+    t = tz.decode("utf-8", "replace").strip()
+    if len(t) >= 6 and t[0] in "+-" and t[3] == ":":
+        try:
+            sign = -1 if t[0] == "-" else 1
+            hh, mm = int(t[1:3]), int(t[4:6])
+            if hh > 13 or mm > 59:
+                return None
+            return sign * (hh * 60 + mm)
+        except ValueError:
+            return None
+    if t.upper() in ("UTC", "GMT"):
+        return 0
+    return None
+
+
+def _convert_tz(packed, from_tz: bytes, to_tz: bytes):
+    f = _tz_offset_minutes(from_tz)
+    t = _tz_offset_minutes(to_tz)
+    if f is None or t is None:
+        return None
+    return _mt.date_add(int(packed), t - f, "MINUTE")
+
+
+def _convert_tz_wrapped(xp, a, b, c):
+    (pd, pn), (fd, fn), (td, tn) = a, b, c
+    n = len(pd)
+    out = _np.zeros(n, dtype=_np.int64)
+    rnull = _np.asarray(pn | fn | tn).copy()
+    for i in range(n):
+        if rnull[i]:
+            continue
+        r = _convert_tz(pd[i], fd[i], td[i])
+        if r is None:
+            rnull[i] = True
+        else:
+            out[i] = r
+    return out, rnull
+
+
+KERNELS["convert_tz"] = (3, "int", _convert_tz_wrapped)
+
+_bytes_op("time_format", 2, "bytes")(
+    lambda nanos, fmt: _time_format(int(nanos), fmt)
+)
+
+
+def _time_format(nanos: int, fmt: bytes):
+    # durations format through a synthetic datetime (hours may exceed 23:
+    # %H shows the full count, like MySQL TIME_FORMAT)
+    neg = nanos < 0
+    nanos = abs(nanos)
+    secs, sub = divmod(nanos, _mt.NANOS_PER_SEC)
+    hh, rem = divmod(secs, 3600)
+    mm, ss = divmod(rem, 60)
+    t = fmt.decode("utf-8", "replace")
+    out = (
+        t.replace("%H", f"{hh:02d}").replace("%k", str(hh))
+        .replace("%i", f"{mm:02d}").replace("%s", f"{ss:02d}")
+        .replace("%S", f"{ss:02d}").replace("%f", f"{sub // 1000:06d}")
+        .replace("%p", "AM" if hh % 24 < 12 else "PM")
+    )
+    return (("-" if neg else "") + out).encode()
+
+
+def _get_format(kind: bytes, loc: bytes):
+    table = {
+        (b"DATE", b"USA"): b"%m.%d.%Y", (b"DATE", b"JIS"): b"%Y-%m-%d",
+        (b"DATE", b"ISO"): b"%Y-%m-%d", (b"DATE", b"EUR"): b"%d.%m.%Y",
+        (b"DATE", b"INTERNAL"): b"%Y%m%d",
+        (b"DATETIME", b"USA"): b"%Y-%m-%d %H.%i.%s",
+        (b"DATETIME", b"JIS"): b"%Y-%m-%d %H:%i:%s",
+        (b"DATETIME", b"ISO"): b"%Y-%m-%d %H:%i:%s",
+        (b"DATETIME", b"EUR"): b"%Y-%m-%d %H.%i.%s",
+        (b"DATETIME", b"INTERNAL"): b"%Y%m%d%H%i%s",
+        (b"TIME", b"USA"): b"%h:%i:%s %p", (b"TIME", b"JIS"): b"%H:%i:%s",
+        (b"TIME", b"ISO"): b"%H:%i:%s", (b"TIME", b"EUR"): b"%H.%i.%s",
+        (b"TIME", b"INTERNAL"): b"%H%i%s",
+    }
+    return table.get((kind.upper(), loc.upper()))
+
+
+_bytes_op("get_format", 2, "bytes")(_get_format)
+
+
+# -- JSON breadth (impl_json.rs) --------------------------------------------
+#
+# JSON values travel as the binary codec bytes; json_value decodes them into
+# plain python values (dict / list / str / int / JsonU64 / float / bool /
+# None) — the same representation the existing json kernels use.
+
+from . import json_value as _jv
+
+
+def _jd(b: bytes):
+    return _jv.json_decode(bytes(b))
+
+
+def _json_merge_patch_impl(a: bytes, b: bytes):
+    def patch(x, y):
+        if not isinstance(y, dict):
+            return y
+        out = dict(x) if isinstance(x, dict) else {}
+        for k, v in y.items():
+            if v is None:
+                out.pop(k, None)
+            else:
+                out[k] = patch(out.get(k), v)
+        return out
+
+    return _jv.json_encode(patch(_jd(a), _jd(b)))
+
+
+_json_op("json_merge_patch", 2, "bytes")(_json_merge_patch_impl)
+
+
+def _json_pretty_impl(a: bytes):
+    import json as _json
+
+    v = _jd(a)
+
+    def plain(x):
+        if isinstance(x, dict):
+            return {k: plain(v2) for k, v2 in x.items()}
+        if isinstance(x, list):
+            return [plain(e) for e in x]
+        return x
+
+    return _json.dumps(plain(v), indent=2).encode()
+
+
+_json_op("json_pretty", 1, "bytes")(_json_pretty_impl)
+_json_op("json_storage_size", 1, "int")(lambda a: len(a))
+
+
+def _like_match(pat: str, s: str) -> bool:
+    import re
+
+    rx = "^" + "".join(
+        ".*" if c == "%" else "." if c == "_" else re.escape(c) for c in pat
+    ) + "$"
+    return re.match(rx, s, re.S) is not None
+
+
+def _json_search_impl(doc: bytes, one_all: bytes, target: bytes):
+    v = _jd(doc)
+    one = one_all.lower() == b"one"
+    pat = target.decode("utf-8", "replace")
+    found: list[str] = []
+
+    def walk(node, path) -> bool:
+        if isinstance(node, str):
+            if _like_match(pat, node):
+                found.append(path or "$")
+                return not one
+        elif isinstance(node, list):
+            for i, el in enumerate(node):
+                if not walk(el, f"{path}[{i}]"):
+                    return False
+        elif isinstance(node, dict):
+            for k, el in node.items():
+                if not walk(el, f"{path}.{k}"):
+                    return False
+        return True
+
+    walk(v, "$")
+    if not found:
+        return None
+    return _jv.json_encode(found[0] if len(found) == 1 else found)
+
+
+_json_op("json_search", 3, "bytes")(_json_search_impl)
+
+
+def _json_member_of(target: bytes, arr: bytes) -> int:
+    va, vt = _jd(arr), _jd(target)
+    if isinstance(va, list):
+        return int(any(_jv._json_eq(el, vt) for el in va))
+    return int(_jv._json_eq(va, vt))
+
+
+_json_op("json_member_of", 2, "int")(_json_member_of)
+
+
+def _json_overlaps(a: bytes, b: bytes) -> int:
+    va, vb = _jd(a), _jd(b)
+    aa = va if isinstance(va, list) else [va]
+    bb = vb if isinstance(vb, list) else [vb]
+    return int(any(_jv._json_eq(x, y) for x in aa for y in bb))
+
+
+_json_op("json_overlaps", 2, "int")(_json_overlaps)
+
+
+def _json_array_append(doc: bytes, path: bytes, val: bytes):
+    v = _jd(doc)
+    target = _jv.extract(v, [path.decode()])
+    if target is _jv._NO_MATCH:
+        return _jv.json_encode(v)
+    new = target + [_jd(val)] if isinstance(target, list) else [target, _jd(val)]
+    return _jv.json_encode(_jv.modify(v, [(path.decode(), new)], "set"))
+
+
+_json_op("json_array_append", 3, "bytes")(_json_array_append)
+
+
+# cast JSON <-> datetime/duration (opaque time values inside JSON)
+
+_bytes_op("cast_datetime_json", 1, "bytes")(
+    lambda p: _jv.json_encode(_mt.format_datetime(int(p)))
+)
+_bytes_op("cast_duration_json", 1, "bytes")(
+    lambda n: _jv.json_encode(_mt.format_duration(int(n)))
+)
+
+
+# -- miscellaneous (impl_miscellaneous.rs) ----------------------------------
+
+def _is_ipv4(s_: bytes) -> int:
+    try:
+        _ip.IPv4Address(s_.decode())
+        return 1
+    except (ValueError, UnicodeDecodeError):
+        return 0
+
+
+def _is_ipv6(s_: bytes) -> int:
+    try:
+        _ip.IPv6Address(s_.decode())
+        return 1
+    except (ValueError, UnicodeDecodeError):
+        return 0
+
+
+_int_bytes_op("is_ipv4", 1)(_is_ipv4)
+_int_bytes_op("is_ipv6", 1)(_is_ipv6)
+
+
+def _inet6_aton(s_: bytes):
+    try:
+        return _ip.ip_address(s_.decode()).packed
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
+_bytes_op("inet6_aton", 1, "bytes")(_inet6_aton)
+
+
+def _inet6_ntoa(b: bytes):
+    try:
+        if len(b) == 4:
+            return str(_ip.IPv4Address(b)).encode()
+        if len(b) == 16:
+            return str(_ip.IPv6Address(b)).encode()
+    except ValueError:
+        pass
+    return None
+
+
+_bytes_op("inet6_ntoa", 1, "bytes")(_inet6_ntoa)
+_int_bytes_op("is_ipv4_compat", 1)(
+    lambda b: int(len(b) == 16 and b[:12] == b"\x00" * 12 and b[12:] != b"\x00" * 4)
+)
+_int_bytes_op("is_ipv4_mapped", 1)(
+    lambda b: int(len(b) == 16 and b[:10] == b"\x00" * 10 and b[10:12] == b"\xff\xff")
+)
+
+
+@_reg("any_value", 1, "same")
+def _any_value(xp, a):
+    return a
+
+
+@_reg("is_not_null", 1, "int")
+def _is_not_null(xp, a):
+    ad, an = a
+    return (~an).astype("int64"), xp.zeros(an.shape, dtype=bool)
+
+
+# -- trim family breadth (impl_string.rs TRIM(remstr FROM str)) -------------
+
+def _trim_ends(s_: bytes, rem: bytes, leading: bool, trailing: bool) -> bytes:
+    if not rem:
+        return s_
+    if leading:
+        while s_.startswith(rem):
+            s_ = s_[len(rem):]
+    if trailing:
+        while s_.endswith(rem):
+            s_ = s_[: -len(rem)]
+    return s_
+
+
+_bytes_op("trim2", 2, "bytes")(lambda s_, rem: _trim_ends(s_, rem, True, True))
+_bytes_op("trim_leading", 2, "bytes")(lambda s_, rem: _trim_ends(s_, rem, True, False))
+_bytes_op("trim_trailing", 2, "bytes")(lambda s_, rem: _trim_ends(s_, rem, False, True))
+_int_bytes_op("position", 2)(lambda sub, s_: s_.find(sub) + 1)
+
+
+# -- utf8 character-based variants (byte-based siblings exist) --------------
+
+def _u(s_: bytes) -> str:
+    return s_.decode("utf-8", "replace")
+
+
+_bytes_op("left_utf8", 2, "bytes")(lambda s_, n: _u(s_)[: max(int(n), 0)].encode())
+_bytes_op("right_utf8", 2, "bytes")(
+    lambda s_, n: _u(s_)[-int(n):].encode() if int(n) > 0 else b""
+)
+_bytes_op("reverse_utf8", 1, "bytes")(lambda s_: _u(s_)[::-1].encode())
+
+
+def _substr_utf8(s_: bytes, pos: int, ln: int | None = None) -> bytes:
+    t = _u(s_)
+    pos = int(pos)
+    if pos < 0:
+        pos = len(t) + pos + 1
+    if pos < 1:
+        return b""
+    sub = t[pos - 1 :]
+    if ln is not None:
+        if int(ln) <= 0:
+            return b""
+        sub = sub[: int(ln)]
+    return sub.encode()
+
+
+_bytes_op("substr_utf8_2", 2, "bytes")(lambda s_, p: _substr_utf8(s_, p))
+_bytes_op("substr_utf8_3", 3, "bytes")(lambda s_, p, ln: _substr_utf8(s_, p, ln))
+
+
+# -- greatest/least string + real variants (impl_compare.rs) ----------------
+
+def _extreme_bytes(name, pick):
+    def fn(xp, *args):
+        n = len(args[0][0])
+        out = _np.empty(n, dtype=object)
+        nulls = args[0][1]
+        for _, nl in args[1:]:
+            nulls = nulls | nl
+        rnull = _np.asarray(nulls).copy()
+        for i in range(n):
+            out[i] = b"" if rnull[i] else pick(d[i] for d, _ in args)
+        return out, rnull
+
+    KERNELS[name] = (-1, "bytes", fn)
+
+
+_extreme_bytes("greatest_string", max)
+_extreme_bytes("least_string", min)
+
+
+@_reg("greatest_real", -1, "real")
+def _greatest_real(xp, *args):
+    data = args[0][0].astype("float64")
+    nulls = args[0][1]
+    for d, nl in args[1:]:
+        data = xp.maximum(data, d.astype("float64"))
+        nulls = nulls | nl
+    return data, nulls
+
+
+@_reg("least_real", -1, "real")
+def _least_real(xp, *args):
+    data = args[0][0].astype("float64")
+    nulls = args[0][1]
+    for d, nl in args[1:]:
+        data = xp.minimum(data, d.astype("float64"))
+        nulls = nulls | nl
+    return data, nulls
+
+
+# -- duration / datetime arithmetic (impl_time.rs add/sub family) -----------
+
+@_reg("add_duration", 2, "int")
+def _add_duration(xp, a, b):
+    (ad, an), (bd, bn) = a, b
+    return ad.astype("int64") + bd.astype("int64"), an | bn
+
+
+@_reg("sub_duration", 2, "int")
+def _sub_duration(xp, a, b):
+    (ad, an), (bd, bn) = a, b
+    return ad.astype("int64") - bd.astype("int64"), an | bn
+
+
+_reg_nullable_int(
+    "add_datetime_duration", 2,
+    _safe_dt(lambda p, nanos: _mt.date_add(int(p), int(nanos) // 1000, "MICROSECOND")),
+)
+_reg_nullable_int(
+    "sub_datetime_duration", 2,
+    _safe_dt(lambda p, nanos: _mt.date_add(int(p), -(int(nanos) // 1000), "MICROSECOND")),
+)
+
+
+def _timestamp_add(unit: bytes, n: int, packed: int):
+    return _mt.date_add(int(packed), int(n), unit.decode().upper())
+
+
+def _timestamp_add_wrapped(xp, a, b, c):
+    (ud, un), (nd, nn), (pd, pn) = a, b, c
+    n = len(nd)
+    out = _np.zeros(n, dtype=_np.int64)
+    rnull = _np.asarray(un | nn | pn).copy()
+    for i in range(n):
+        if rnull[i]:
+            continue
+        try:
+            out[i] = _timestamp_add(ud[i], nd[i], pd[i])
+        except (ValueError, KeyError, OverflowError):
+            rnull[i] = True
+    return out, rnull
+
+
+KERNELS["timestamp_add"] = (3, "int", _timestamp_add_wrapped)
+
+_EXTRACT_UNITS = {
+    b"YEAR": lambda p: _mt.unpack_datetime(p)[0],
+    b"QUARTER": lambda p: (_mt.unpack_datetime(p)[1] + 2) // 3,
+    b"MONTH": lambda p: _mt.unpack_datetime(p)[1],
+    b"DAY": lambda p: _mt.unpack_datetime(p)[2],
+    b"HOUR": lambda p: _mt.unpack_datetime(p)[3],
+    b"MINUTE": lambda p: _mt.unpack_datetime(p)[4],
+    b"SECOND": lambda p: _mt.unpack_datetime(p)[5],
+    b"MICROSECOND": lambda p: _mt.unpack_datetime(p)[6],
+    b"YEAR_MONTH": lambda p: _mt.unpack_datetime(p)[0] * 100 + _mt.unpack_datetime(p)[1],
+    b"DAY_HOUR": lambda p: _mt.unpack_datetime(p)[2] * 100 + _mt.unpack_datetime(p)[3],
+}
+
+
+def _extract_datetime_wrapped(xp, a, b):
+    (ud, un), (pd, pn) = a, b
+    n = len(pd)
+    out = _np.zeros(n, dtype=_np.int64)
+    rnull = _np.asarray(un | pn).copy()
+    for i in range(n):
+        if rnull[i]:
+            continue
+        fn = _EXTRACT_UNITS.get(bytes(ud[i]).upper())
+        if fn is None:
+            rnull[i] = True
+        else:
+            out[i] = fn(int(pd[i]))
+    return out, rnull
+
+
+KERNELS["extract_datetime"] = (2, "int", _extract_datetime_wrapped)
+
+_reg_nullable_int(
+    "timediff", 2,
+    _safe_dt(
+        lambda a, b: (
+            (_mt._as_date(a).toordinal() - _mt._as_date(b).toordinal()) * 86400
+            + (_mt.unpack_datetime(int(a))[3] - _mt.unpack_datetime(int(b))[3]) * 3600
+            + (_mt.unpack_datetime(int(a))[4] - _mt.unpack_datetime(int(b))[4]) * 60
+            + (_mt.unpack_datetime(int(a))[5] - _mt.unpack_datetime(int(b))[5])
+        ) * _mt.NANOS_PER_SEC
+    ),
+)
+
+
+def _week_mode(p: int, mode: int) -> int:
+    d = _mt._as_date(p)
+    mode = int(mode) & 7
+    if mode in (1, 3):  # ISO-like: Monday first, week 1 has >3 days
+        return d.isocalendar()[1]
+    # Sunday-first variants: week 0..53, counted from the first Sunday
+    jan1 = _dt.date(d.year, 1, 1)
+    days = (d - jan1).days
+    offset = (jan1.weekday() + 1) % 7  # days since Sunday
+    return (days + offset) // 7 if mode in (0, 2, 4, 6) else d.isocalendar()[1]
+
+
+_reg_nullable_int("week_with_mode", 2, _safe_dt(lambda p, m: _week_mode(int(p), m)))
+
+
+# -- password / sha aliases (impl_encryption.rs) ----------------------------
+
+import hashlib as _hl
+
+
+def _password(s_: bytes) -> bytes:
+    if not s_:
+        return b""
+    return b"*" + _hl.sha1(_hl.sha1(s_).digest()).hexdigest().upper().encode()
+
+
+_bytes_op("password", 1, "bytes")(_password)
+_bytes_op("sha", 1, "bytes")(lambda s_: _hl.sha1(s_).hexdigest().encode())
+
+
+# -- uuid helpers (impl_miscellaneous.rs) -----------------------------------
+
+import uuid as _uuid
+
+
+def _is_uuid(s_: bytes) -> int:
+    try:
+        _uuid.UUID(s_.decode())
+        return 1
+    except (ValueError, UnicodeDecodeError):
+        return 0
+
+
+_int_bytes_op("is_uuid", 1)(_is_uuid)
+
+
+def _uuid_to_bin(s_: bytes):
+    try:
+        return _uuid.UUID(s_.decode()).bytes
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
+_bytes_op("uuid_to_bin", 1, "bytes")(_uuid_to_bin)
+
+
+def _bin_to_uuid(b: bytes):
+    if len(b) != 16:
+        return None
+    return str(_uuid.UUID(bytes=bytes(b))).encode()
+
+
+_bytes_op("bin_to_uuid", 1, "bytes")(_bin_to_uuid)
+
+
+# -- json path predicates (impl_json.rs) ------------------------------------
+
+def _json_contains_path(xp, *args):
+    (dd, dn), (od, on) = args[0], args[1]
+    n = len(dd)
+    out = _np.zeros(n, dtype=_np.int64)
+    rnull = _np.asarray(dn | on).copy()
+    for _, nl in args[2:]:
+        rnull |= _np.asarray(nl)
+    for i in range(n):
+        if rnull[i]:
+            continue
+        v = _jd(dd[i])
+        one = bytes(od[i]).lower() == b"one"
+        hits = []
+        for pd, _pn in args[2:]:
+            r = _jv.extract(v, [bytes(pd[i]).decode()])
+            hits.append(r is not _jv._NO_MATCH)
+        out[i] = int(any(hits) if one else all(hits))
+    return out, rnull
+
+
+KERNELS["json_contains_path"] = (-1, "int", _json_contains_path)
+
+
+def _json_array_insert(doc: bytes, path: bytes, val: bytes):
+    p = path.decode()
+    if not p.endswith("]"):
+        return None
+    v = _jd(doc)
+    base, _, idx_part = p.rpartition("[")
+    try:
+        idx = int(idx_part[:-1])
+    except ValueError:
+        return None
+    target = _jv.extract(v, [base]) if base != "$" else v
+    if base != "$" and target is _jv._NO_MATCH:
+        return _jv.json_encode(v)
+    if not isinstance(target, list):
+        return _jv.json_encode(v)
+    new = list(target)
+    new.insert(min(idx, len(new)), _jd(val))
+    if base == "$":
+        return _jv.json_encode(new)
+    return _jv.json_encode(_jv.modify(v, [(base, new)], "set"))
+
+
+_json_op("json_array_insert", 3, "bytes")(_json_array_insert)
+
+
+# -- cast matrix completion (impl_cast.rs) ----------------------------------
+
+def _identity_cast(name, rkind):
+    @_reg(name, 1, rkind)
+    def fn(xp, a):
+        ad, an = a
+        return ad, an
+
+    return fn
+
+
+_identity_cast("cast_int_int", "int")
+_identity_cast("cast_real_real", "real")
+_identity_cast("cast_decimal_decimal", "decimal")
+_identity_cast("cast_duration_duration", "int")
+_bytes_op("cast_string_string", 1, "bytes")(lambda s_: s_)
+_bytes_op("cast_json_json", 1, "bytes")(lambda s_: s_)
+
+
+def _num_to_datetime(n: int):
+    """MySQL numeric datetime literal: YYYYMMDD or YYYYMMDDHHMMSS."""
+    def fix_year(y: int) -> int:
+        # MySQL 2-digit-year rule for YYMMDD-form literals
+        if y < 70:
+            return y + 2000
+        if y < 100:
+            return y + 1900
+        return y
+
+    n = int(n)
+    if n < 10**8:
+        y, md = divmod(n, 10**4)
+        m, d = divmod(md, 100)
+        return _mt.pack_datetime(fix_year(y), m, d)
+    dpart, tpart = divmod(n, 10**6)
+    y, md = divmod(dpart, 10**4)
+    m, d = divmod(md, 100)
+    hh, ms = divmod(tpart, 10**4)
+    mm, ss = divmod(ms, 100)
+    return _mt.pack_datetime(fix_year(y), m, d, hh, mm, ss)
+
+
+_reg_nullable_int("cast_int_datetime", 1, _safe_dt(_num_to_datetime))
+_reg_nullable_int("cast_real_datetime", 1, _safe_dt(lambda x: _num_to_datetime(int(round(x)))))
+_reg_nullable_int("cast_decimal_datetime", 1, _safe_dt(_num_to_datetime))
+
+
+def _num_to_duration(n: int):
+    """MySQL numeric duration literal: [H]HMMSS (sign carried)."""
+    n = int(n)
+    neg = n < 0
+    n = abs(n)
+    hh, ms = divmod(n, 10**4)
+    mm, ss = divmod(ms, 100)
+    if mm >= 60 or ss >= 60:
+        return None
+    return _mt.duration_nanos(hh, mm, ss, neg=neg)
+
+
+_reg_nullable_int("cast_int_duration", 1, _num_to_duration)
+_reg_nullable_int("cast_real_duration", 1, lambda x: _num_to_duration(int(round(x))))
+_reg_nullable_int("cast_decimal_duration", 1, _num_to_duration)
+
+
+def _dt_to_num(p: int) -> int:
+    y, m, d, hh, mm, ss, _us = _mt.unpack_datetime(int(p))
+    return ((y * 100 + m) * 100 + d) * 10**6 + (hh * 100 + mm) * 100 + ss
+
+
+_reg_nullable_int("cast_datetime_int", 1, _safe_dt(_dt_to_num))
+
+
+@_reg("cast_datetime_real", 1, "real")
+def _cast_datetime_real(xp, a):
+    ad, an = a
+    out = _np.fromiter(
+        (float(_dt_to_num(v)) if not nl else 0.0 for v, nl in zip(ad, _np.asarray(an))),
+        dtype=_np.float64, count=len(ad),
+    )
+    return out, an
+
+
+_reg_nullable_int("cast_datetime_decimal", 1, _safe_dt(_dt_to_num))
+_reg_nullable_int(
+    "cast_datetime_duration", 1,
+    _safe_dt(lambda p: _mt.duration_nanos(
+        _mt.unpack_datetime(int(p))[3], _mt.unpack_datetime(int(p))[4],
+        _mt.unpack_datetime(int(p))[5], _mt.unpack_datetime(int(p))[6],
+    )),
+)
+_reg_nullable_int(
+    "cast_datetime_date", 1,
+    _safe_dt(lambda p: _mt.pack_datetime(*_mt.unpack_datetime(int(p))[:3])),
+)
+
+
+def _dur_to_num(nanos: int) -> int:
+    neg = int(nanos) < 0
+    secs = abs(int(nanos)) // _mt.NANOS_PER_SEC
+    hh, rem = divmod(secs, 3600)
+    mm, ss = divmod(rem, 60)
+    v = (hh * 100 + mm) * 100 + ss
+    return -v if neg else v
+
+
+_reg_nullable_int("cast_duration_int", 1, _dur_to_num)
+
+
+@_reg("cast_duration_real", 1, "real")
+def _cast_duration_real(xp, a):
+    ad, an = a
+    out = _np.fromiter(
+        (float(_dur_to_num(v)) for v in ad), dtype=_np.float64, count=len(ad)
+    )
+    return out, an
+
+
+_reg_nullable_int("cast_duration_decimal", 1, _dur_to_num)
+
+
+def _cast_string_decimal_impl(xp, a):
+    # parses to REAL then lets rpn's frac scaling materialize the target
+    # scale (same shape as cast_string_real; scaled-int64 decimals)
+    return _cast_string_real_impl(xp, a)
+
+
+KERNELS["cast_string_decimal"] = (1, "real", _cast_string_decimal_impl)
+
+_bytes_op("cast_json_datetime", 1, "bytes")(lambda b: b)  # opaque passthrough
+
+
+def _cast_json_duration_impl(xp, a):
+    ad, an = a
+    n = len(ad)
+    out = _np.zeros(n, dtype=_np.int64)
+    rnull = _np.asarray(an).copy()
+    for i in range(n):
+        if rnull[i]:
+            continue
+        v = _jd(ad[i])
+        if isinstance(v, str):
+            try:
+                out[i] = _mt.parse_duration(v)
+                continue
+            except ValueError:
+                pass
+        rnull[i] = True
+    return out, rnull
+
+
+KERNELS["cast_json_duration"] = (1, "int", _cast_json_duration_impl)
+
+
+def _cast_json_decimal_impl(xp, a):
+    ad, an = a
+    n = len(ad)
+    out = _np.zeros(n, dtype=_np.float64)
+    rnull = _np.asarray(an).copy()
+    for i in range(n):
+        if rnull[i]:
+            continue
+        v = _jd(ad[i])
+        if isinstance(v, bool):
+            out[i] = float(v)
+        elif isinstance(v, (int, float)):
+            out[i] = float(v)
+        elif isinstance(v, str):
+            out[i] = _parse_num_prefix(v.encode())
+        else:
+            out[i] = 0.0
+    return out, rnull
+
+
+KERNELS["cast_json_decimal"] = (1, "real", _cast_json_decimal_impl)
+
+
+_identity_cast("cast_datetime_datetime", "int")
+
+
+def _cast_decimal_json_impl(xp, a):
+    # decimal rides as scaled int64; rpn's scale plumbing normalizes to the
+    # unscaled value before a "real"-input kernel, so encode as number
+    ad, an = a
+    n = len(ad)
+    out = _np.empty(n, dtype=object)
+    rnull = _np.asarray(an).copy()
+    for i in range(n):
+        v = float(ad[i]) if not rnull[i] else 0.0
+        out[i] = _jv.json_encode(int(v) if v == int(v) else v)
+    return out, rnull
+
+
+KERNELS["cast_decimal_json"] = (1, "bytes", _cast_decimal_json_impl)
+
+
+def _cast_decimal_string_impl(xp, a):
+    ad, an = a
+    n = len(ad)
+    out = _np.empty(n, dtype=object)
+    rnull = _np.asarray(an).copy()
+    for i in range(n):
+        v = float(ad[i]) if not rnull[i] else 0.0
+        out[i] = (b"%d" % int(v)) if v == int(v) else repr(v).encode()
+    return out, rnull
+
+
+KERNELS["cast_decimal_string"] = (1, "bytes", _cast_decimal_string_impl)
+
+# duration -> datetime needs the session's current date (reference combines
+# with ctx time); anchor on the epoch date like our duration-only pipeline
+_reg_nullable_int(
+    "cast_duration_datetime", 1,
+    _safe_dt(lambda nanos: _mt.date_add(
+        _mt.pack_datetime(1970, 1, 1), int(nanos) // 1000, "MICROSECOND"
+    )),
+)
