@@ -1,0 +1,358 @@
+"""Wide MySQL decimal + Enum/Set eval types.
+
+Mirrors the reference's decimal unit-test strategy
+(tidb_query_datatype/src/codec/mysql/decimal.rs test module): string
+round-trips, rounding modes, arithmetic result scales, and binary-codec
+memcomparability; plus eval_type.rs Enum/Set columns.
+"""
+
+import numpy as np
+import pytest
+
+from tikv_tpu.copr import datum
+from tikv_tpu.copr.datatypes import (
+    Column,
+    EvalType,
+    FieldType,
+    FieldTypeTp,
+    enum_column,
+    enum_names,
+    set_column,
+    set_names,
+)
+from tikv_tpu.copr.mydecimal import (
+    CEILING,
+    HALF_EVEN,
+    MAX_DIGITS,
+    TRUNCATE,
+    DecimalOverflow,
+    MyDecimal,
+)
+
+
+# ---------------------------------------------------------------- parse/print
+
+@pytest.mark.parametrize(
+    "s,out",
+    [
+        ("0", "0"),
+        ("-0", "0"),
+        ("123.45", "123.45"),
+        ("-123.45", "-123.45"),
+        (".5", "0.5"),
+        ("5.", "5"),
+        ("+7", "7"),
+        ("1e3", "1000"),
+        ("1.5e2", "150"),
+        ("1.5e-2", "0.015"),
+        ("00012.3400", "12.3400"),
+        ("99999999999999999999999999999999999999", "99999999999999999999999999999999999999"),
+    ],
+)
+def test_parse_roundtrip(s, out):
+    assert MyDecimal.from_str(s).to_string() == out
+
+
+def test_parse_errors():
+    with pytest.raises(ValueError):
+        MyDecimal.from_str("")
+    with pytest.raises(ValueError):
+        MyDecimal.from_str("abc")
+    with pytest.raises(DecimalOverflow):
+        MyDecimal.from_str("1" + "0" * MAX_DIGITS)
+
+
+def test_frac_beyond_30_rounds():
+    d = MyDecimal.from_str("0." + "3" * 29 + "35")  # 31 frac digits, tail 35
+    assert d.frac == 30
+    assert d.to_string().endswith("4")  # rounded half away from zero
+
+
+# ------------------------------------------------------------------- rounding
+
+@pytest.mark.parametrize(
+    "v,frac,mode,out",
+    [
+        ("2.345", 2, HALF_EVEN, "2.35"),
+        ("-2.345", 2, HALF_EVEN, "-2.35"),
+        ("2.344", 2, HALF_EVEN, "2.34"),
+        ("2.349", 2, TRUNCATE, "2.34"),
+        ("-2.349", 2, TRUNCATE, "-2.34"),
+        ("2.341", 2, CEILING, "2.35"),
+        ("-2.349", 2, CEILING, "-2.34"),
+        ("15.1", 0, HALF_EVEN, "15"),
+        ("15.5", 0, HALF_EVEN, "16"),
+        ("-15.5", 0, HALF_EVEN, "-16"),
+        ("153", -2, HALF_EVEN, "200"),
+        ("5.45", 1, HALF_EVEN, "5.5"),
+    ],
+)
+def test_round(v, frac, mode, out):
+    assert MyDecimal.from_str(v).round(frac, mode).to_string() == out
+
+
+def test_round_widens_scale():
+    assert MyDecimal.from_str("1.5").round(3).to_string() == "1.500"
+
+
+# ----------------------------------------------------------------- arithmetic
+
+def test_add_sub_result_scale():
+    a, b = MyDecimal.from_str("1.25"), MyDecimal.from_str("3.1")
+    assert (a + b).to_string() == "4.35"
+    assert (a - b).to_string() == "-1.85"
+    assert (b - a).frac == 2  # max of operand fracs
+
+
+def test_mul_scale_adds():
+    a, b = MyDecimal.from_str("1.5"), MyDecimal.from_str("2.05")
+    c = a * b
+    assert c.to_string() == "3.075"
+    assert c.frac == 3
+
+
+def test_mul_scale_capped_at_30():
+    a = MyDecimal.from_str("0." + "1" * 20)
+    c = a * a
+    assert c.frac == 30
+
+
+def test_div_adds_four_frac_digits():
+    a, b = MyDecimal.from_str("1"), MyDecimal.from_str("3")
+    assert a.div(b).to_string() == "0.3333"
+    assert MyDecimal.from_str("10.0").div(MyDecimal.from_str("4")).to_string() == "2.50000"
+
+
+def test_div_by_zero_none():
+    assert MyDecimal.from_str("1").div(MyDecimal.zero()) is None
+    assert MyDecimal.from_str("1") % MyDecimal.zero() is None
+
+
+def test_mod_sign_follows_dividend():
+    assert (MyDecimal.from_str("7.5") % MyDecimal.from_str("2")).to_string() == "1.5"
+    assert (MyDecimal.from_str("-7.5") % MyDecimal.from_str("2")).to_string() == "-1.5"
+
+
+def test_shift():
+    d = MyDecimal.from_str("12.34")
+    assert d.shift(2).to_string() == "1234"
+    assert d.shift(-1).to_string() == "1.234"
+    assert d.shift(0) is d
+
+
+def test_overflow_clamps():
+    big = MyDecimal.from_str("9" * (MAX_DIGITS - 1))
+    c = big + big
+    assert c.int_digits() <= MAX_DIGITS
+
+
+def test_compare_across_scales():
+    assert MyDecimal.from_str("1.50") == MyDecimal.from_str("1.5")
+    assert MyDecimal.from_str("1.49") < MyDecimal.from_str("1.5")
+    assert MyDecimal.from_str("-2") < MyDecimal.from_str("-1.99")
+
+
+def test_device_bridge():
+    d = MyDecimal.from_str("123.45")
+    assert d.to_i64_scaled() == (12345, 2)
+    assert MyDecimal.from_i64_scaled(12345, 2) == d
+    with pytest.raises(DecimalOverflow):
+        MyDecimal.from_str("9" * 40).to_i64_scaled()
+
+
+# -------------------------------------------------------------- binary codec
+
+@pytest.mark.parametrize(
+    "s,prec,frac",
+    [
+        ("0", 1, 0),
+        ("1234567890.1234", 14, 4),
+        ("-1234567890.1234", 14, 4),
+        ("0.00012345000098765", 22, 20),
+        ("-0.00012345000098765", 22, 20),
+        ("12345", 5, 0),
+        ("-12345", 5, 0),
+        ("0.333", 5, 3),
+        ("98765432109876543210.123456789", 29, 9),
+    ],
+)
+def test_bin_roundtrip(s, prec, frac):
+    d = MyDecimal.from_str(s)
+    raw = d.encode_bin(prec, frac)
+    assert len(raw) == MyDecimal.bin_size(prec, frac)
+    back, used = MyDecimal.decode_bin(raw, prec, frac)
+    assert used == len(raw)
+    assert back == d
+
+
+def test_bin_known_layout():
+    # 1234567890.1234 @ (14,4): int part = 1 digit + 1 word, frac = 4 digits
+    assert MyDecimal.bin_size(14, 4) == 1 + 4 + 2
+
+
+def test_bin_memcomparable():
+    vals = ["-999.99", "-1.5", "-0.01", "0", "0.01", "1.5", "2.49", "999.99"]
+    encoded = [MyDecimal.from_str(v).encode_bin(10, 2) for v in vals]
+    assert encoded == sorted(encoded)
+
+
+def test_bin_rounds_to_target_frac():
+    d = MyDecimal.from_str("1.999")
+    back, _ = MyDecimal.decode_bin(d.encode_bin(10, 2), 10, 2)
+    assert back.to_string() == "2.00"
+
+
+def test_bin_overflow_clamps_to_max():
+    d = MyDecimal.from_str("12345")
+    back, _ = MyDecimal.decode_bin(d.encode_bin(3, 1), 3, 1)
+    assert back.to_string() == "99.9"
+
+
+# ------------------------------------------------------------------ enum/set
+
+def test_enum_field_type():
+    ft = FieldType.enum_type([b"red", b"green", b"blue"])
+    assert ft.tp == FieldTypeTp.ENUM
+    assert ft.eval_type == EvalType.ENUM
+    assert ft.elems == (b"red", b"green", b"blue")
+
+
+def test_enum_column_names_and_codes():
+    elems = (b"red", b"green", b"blue")
+    col = enum_column([1, 3, 0, 2, None], elems)
+    assert col.eval_type == EvalType.ENUM
+    assert col.data.dtype == np.int64
+    names = enum_names(col)
+    assert names.to_values() == [b"red", b"blue", b"", b"green", None]
+    # logical values stay the dictionary codes (ORDER BY semantics)
+    assert col.to_values() == [1, 3, 0, 2, None]
+
+
+def test_enum_datum_is_uint_index():
+    col = enum_column([2], (b"a", b"b"))
+    flag, v = col.datum_at(0)
+    assert (flag, v) == (datum.UINT_FLAG, 2)
+
+
+def test_set_column_mask_and_names():
+    elems = (b"a", b"b", b"c")
+    col = set_column([0b101, 0b010, 0, None], elems)
+    assert col.eval_type == EvalType.SET
+    names = set_names(col)
+    assert names.to_values() == [b"a,c", b"b", b"", None]
+
+
+def test_set_limit_64():
+    with pytest.raises(ValueError):
+        FieldType.set_type([b"x%d" % k for k in range(65)])
+
+
+def test_enum_rpn_int_context():
+    """Enum codes flow through RPN comparisons as plain ints."""
+    from tikv_tpu.copr import rpn
+
+    col = enum_column([1, 2, 3, 2], (b"s", b"m", b"l"))
+    expr = rpn.call("eq", rpn.col(0), rpn.const_int(2))
+    compiled = rpn.compile_expr(expr, [(EvalType.ENUM, 0)])
+    data, nulls = rpn.eval_rpn(compiled, {0: (col.data, col.nulls)}, 4)
+    assert list(data) == [0, 1, 0, 1]
+
+
+def test_mul_excess_scale_exact_truncation():
+    a = MyDecimal.from_str("1." + "1" * 25)
+    c = a * a
+    assert c.frac == 30
+    exact = (a.unscaled * a.unscaled) // 10 ** (50 - 30)
+    assert c.unscaled == exact
+
+
+def test_enum_concat_keeps_dictionary():
+    elems = (b"a", b"b")
+    c = Column.concat([enum_column([1], elems), enum_column([2], elems)])
+    assert enum_names(c).to_values() == [b"a", b"b"]
+    with pytest.raises(ValueError):
+        Column.concat([enum_column([1], elems), enum_column([1], (b"x", b"y"))])
+
+
+def test_set_bit63_representable():
+    col = set_column([1 << 63], tuple(b"x%d" % k for k in range(64)))
+    assert int(col.data[0]) == 1 << 63
+    assert set_names(col).to_values() == [b"x63"]
+
+
+def test_enum_names_out_of_range_is_invalid_empty():
+    col = enum_column([5, -1], (b"a", b"b"))
+    assert enum_names(col).to_values() == [b"", b""]
+
+
+def test_enum_row_codec_roundtrip():
+    from tikv_tpu.copr.table import RowBatchDecoder, encode_row
+    from tikv_tpu.copr.datatypes import ColumnInfo
+    import numpy as np
+
+    infos = [
+        ColumnInfo(1, FieldType.int64(), is_pk_handle=True),
+        ColumnInfo(2, FieldType.enum_type([b"red", b"blue"])),
+        ColumnInfo(3, FieldType.set_type([b"r", b"w"])),
+    ]
+    rows = [encode_row(infos[1:], [2, 0b11]), encode_row(infos[1:], [None, 1 << 1])]
+    cols = RowBatchDecoder(infos).decode(np.array([7, 8]), rows)
+    assert cols[1].eval_type == EvalType.ENUM
+    assert enum_names(cols[1]).to_values() == [b"blue", None]
+    assert set_names(cols[2]).to_values() == [b"r,w", b"w"]
+
+
+def test_bin_zero_int_part_prec_eq_frac():
+    d = MyDecimal.from_str("0.50")
+    back, _ = MyDecimal.decode_bin(d.encode_bin(2, 2), 2, 2)
+    assert back.to_string() == "0.50"
+
+
+def test_set_const_bit63_comparison():
+    from tikv_tpu.copr import rpn
+
+    col = set_column([0b11, (1 << 63) + 3], tuple(b"x%d" % k for k in range(64)))
+    expr = rpn.call("eq", rpn.col(0), rpn.const_set((1 << 63) + 3))
+    compiled = rpn.compile_expr(expr, [(EvalType.SET, 0)])
+    data, _ = rpn.eval_rpn(compiled, {0: (col.data, col.nulls)}, 2)
+    assert list(data) == [0, 1]
+
+
+def test_groupby_enum_keeps_dictionary():
+    from tikv_tpu.copr.executors import (
+        BatchExecuteResult,
+        BatchExecutor,
+        BatchHashAggregationExecutor,
+    )
+    from tikv_tpu.copr.aggr import AggDescriptor
+    from tikv_tpu.copr.datatypes import Chunk
+    from tikv_tpu.copr import rpn
+
+    elems = (b"red", b"green")
+    chunk = Chunk.full([
+        enum_column([1, 2, 1, 1], elems),
+        Column.from_values(EvalType.INT, [10, 20, 30, 40]),
+    ])
+
+    class _Stub(BatchExecutor):
+        def __init__(self):
+            self._sent = False
+
+        def schema(self):
+            return [(EvalType.ENUM, 0), (EvalType.INT, 0)]
+
+        def next_batch(self, scan_rows):
+            if self._sent:
+                return BatchExecuteResult(Chunk.full([]), True)
+            self._sent = True
+            return BatchExecuteResult(chunk, True)
+
+    child = _Stub()
+    agg = BatchHashAggregationExecutor(
+        child, [rpn.col(0)], [AggDescriptor("sum", rpn.col(1))]
+    )
+    r = agg.next_batch(1024)
+    key_col = r.chunk.columns[-1]
+    assert key_col.eval_type == EvalType.ENUM
+    got = dict(zip(enum_names(key_col).to_values(), r.chunk.columns[0].to_values()))
+    assert got == {b"red": 80, b"green": 20}

@@ -33,6 +33,12 @@ class EvalType(enum.Enum):
     DATETIME = "datetime"  # packed int64 (μs since epoch)
     DURATION = "duration"  # int64 nanoseconds
     JSON = "json"
+    # Enum/Set (eval_type.rs:11 lists both as first-class eval types).
+    # ENUM columns hold the 1-based element index (0 = MySQL's invalid '')
+    # — already a dense dictionary code, which is exactly the device layout;
+    # SET columns hold the u64 element bitmask.
+    ENUM = "enum"
+    SET = "set"
 
 
 # MySQL type codes (subset; tidb_query_datatype/src/def/field_type.rs)
@@ -51,6 +57,8 @@ class FieldTypeTp(enum.IntEnum):
     DATETIME = 12
     JSON = 245
     NEW_DECIMAL = 246
+    ENUM = 247
+    SET = 248
     BLOB = 252
     VAR_STRING = 253
     STRING = 254
@@ -75,6 +83,8 @@ _TP_TO_EVAL = {
     FieldTypeTp.DATETIME: EvalType.DATETIME,
     FieldTypeTp.DURATION: EvalType.DURATION,
     FieldTypeTp.JSON: EvalType.JSON,
+    FieldTypeTp.ENUM: EvalType.ENUM,
+    FieldTypeTp.SET: EvalType.SET,
     FieldTypeTp.BLOB: EvalType.BYTES,
     FieldTypeTp.VAR_STRING: EvalType.BYTES,
     FieldTypeTp.STRING: EvalType.BYTES,
@@ -88,6 +98,7 @@ class FieldType:
     flen: int = -1
     decimal: int = 0  # frac digits for NEW_DECIMAL
     collation: str = "binary"
+    elems: tuple = ()  # element names (bytes) for ENUM/SET
 
     @property
     def eval_type(self) -> EvalType:
@@ -112,6 +123,18 @@ class FieldType:
     @classmethod
     def varchar(cls) -> "FieldType":
         return cls(FieldTypeTp.VAR_STRING)
+
+    @classmethod
+    def enum_type(cls, elems: list[bytes]) -> "FieldType":
+        if len(elems) > 65535:
+            raise ValueError("ENUM supports at most 65535 elements")
+        return cls(FieldTypeTp.ENUM, elems=tuple(elems))
+
+    @classmethod
+    def set_type(cls, elems: list[bytes]) -> "FieldType":
+        if len(elems) > 64:
+            raise ValueError("SET supports at most 64 elements")
+        return cls(FieldTypeTp.SET, elems=tuple(elems))
 
 
 @dataclass
@@ -158,8 +181,12 @@ class Column:
         return self.dictionary is not None
 
     def decoded(self) -> "Column":
-        """Materialize dictionary codes back into an object array."""
-        if self.dictionary is None:
+        """Materialize dictionary codes back into an object array.
+
+        ENUM/SET columns are *not* decoded here: their dictionary is a name
+        table and their logical value is the index/bitmask itself (use
+        ``enum_names``/``set_names`` for the string cast)."""
+        if self.dictionary is None or self.eval_type in (EvalType.ENUM, EvalType.SET):
             return self
         return Column(self.eval_type, self.dictionary[self.data], self.nulls, self.frac)
 
@@ -171,7 +198,16 @@ class Column:
         """Build from a python list, None meaning NULL."""
         n = len(values)
         nulls = np.array([v is None for v in values], dtype=bool)
-        if eval_type in (EvalType.INT, EvalType.DATETIME, EvalType.DURATION, EvalType.DECIMAL):
+        if eval_type == EvalType.SET:
+            # u64 bitmask: bit 63 (a 64-element SET) must be representable
+            data = np.array([0 if v is None else v for v in values], dtype=np.uint64)
+        elif eval_type in (
+            EvalType.INT,
+            EvalType.DATETIME,
+            EvalType.DURATION,
+            EvalType.DECIMAL,
+            EvalType.ENUM,
+        ):
             data = np.array([0 if v is None else v for v in values], dtype=np.int64)
         elif eval_type == EvalType.REAL:
             data = np.array([0.0 if v is None else v for v in values], dtype=np.float64)
@@ -196,13 +232,21 @@ class Column:
     @classmethod
     def concat(cls, cols: list["Column"]) -> "Column":
         assert cols
-        if any(c.is_dict_encoded for c in cols):
+        dictionary = None
+        if cols[0].eval_type in (EvalType.ENUM, EvalType.SET):
+            # codes are only meaningful against one shared name table
+            dictionary = cols[0].dictionary
+            for c in cols[1:]:
+                if not np.array_equal(c.dictionary, dictionary):
+                    raise ValueError("cannot concat ENUM/SET columns with different elems")
+        elif any(c.is_dict_encoded for c in cols):
             cols = [c.decoded() for c in cols]
         return cls(
             cols[0].eval_type,
             np.concatenate([c.data for c in cols]),
             np.concatenate([c.nulls for c in cols]),
             cols[0].frac,
+            dictionary,
         )
 
     def datum_at(self, i: int) -> tuple[int, object]:
@@ -222,9 +266,55 @@ class Column:
             return flag, bytes(self.data[i])
         if self.eval_type == EvalType.DURATION:
             return datum_mod.DURATION_FLAG, int(self.data[i])
-        if self.eval_type == EvalType.DATETIME:
+        if self.eval_type in (EvalType.DATETIME, EvalType.ENUM, EvalType.SET):
             return datum_mod.UINT_FLAG, int(self.data[i])
         raise ValueError(f"unsupported eval type {self.eval_type}")
+
+
+def enum_dictionary(elems: tuple) -> np.ndarray:
+    """Name dictionary for an ENUM column: slot 0 is MySQL's invalid ''."""
+    d = np.empty(len(elems) + 1, dtype=object)
+    d[0] = b""
+    for i, e in enumerate(elems):
+        d[i + 1] = bytes(e)
+    return d
+
+
+def enum_column(indices: list, elems: tuple) -> Column:
+    """ENUM column: int codes + name dictionary — device-ready as-is."""
+    col = Column.from_values(EvalType.ENUM, indices)
+    col.dictionary = enum_dictionary(elems)
+    return col
+
+
+def set_dictionary(elems: tuple) -> np.ndarray:
+    """Name dictionary for a SET column: slot b = name of bitmask bit b."""
+    return np.array([bytes(e) for e in elems], dtype=object)
+
+
+def set_column(masks: list, elems: tuple) -> Column:
+    col = Column.from_values(EvalType.SET, masks)
+    col.dictionary = set_dictionary(elems)
+    return col
+
+
+def enum_names(col: Column) -> Column:
+    """Materialize an ENUM column's names as a BYTES column (cast enum→string)."""
+    assert col.eval_type == EvalType.ENUM and col.dictionary is not None
+    # out-of-range codes are MySQL's invalid '' (slot 0), not the last element
+    idx = np.where((col.data >= 0) & (col.data < len(col.dictionary)), col.data, 0)
+    return Column(EvalType.BYTES, col.dictionary[idx], col.nulls.copy())
+
+
+def set_names(col: Column) -> Column:
+    """Materialize a SET column as comma-joined names (cast set→string)."""
+    assert col.eval_type == EvalType.SET and col.dictionary is not None
+    elems = col.dictionary
+    out = np.empty(len(col.data), dtype=object)
+    for i, mask in enumerate(col.data):
+        m = int(mask)
+        out[i] = b",".join(elems[b] for b in range(len(elems)) if m >> b & 1)
+    return Column(EvalType.BYTES, out, col.nulls.copy())
 
 
 def _pyval(et: EvalType, v):

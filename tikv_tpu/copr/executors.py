@@ -358,6 +358,8 @@ class BatchHashAggregationExecutor(_AggBase):
         super().__init__(child, aggs)
         self.group_by = [compile_expr(g, self.child_schema) for g in group_by]
         self.groups = GroupDict()
+        # group index → (eval_type, name dictionary) for ENUM/SET key columns
+        self._group_dicts: dict[int, tuple[EvalType, np.ndarray]] = {}
 
     def schema(self):
         return self._agg_schema() + [(g.eval_type, g.frac) for g in self.group_by]
@@ -374,6 +376,11 @@ class BatchHashAggregationExecutor(_AggBase):
                 continue
             n = len(chunk.columns[0]) if chunk.columns else 0
             logical = chunk.logical_rows
+            for gi, g in enumerate(self.group_by):
+                if len(g.nodes) == 1 and g.nodes[0].kind == "col":
+                    c = chunk.columns[g.nodes[0].index]
+                    if c.eval_type in (EvalType.ENUM, EvalType.SET) and c.dictionary is not None:
+                        self._group_dicts.setdefault(gi, (c.eval_type, c.dictionary))
             gids = self._gids_for_chunk(chunk, n, logical)
             self._update_batch(chunk, gids, len(self.groups))
         self._done = True
@@ -385,7 +392,12 @@ class BatchHashAggregationExecutor(_AggBase):
         # group-by key columns
         for gi, g in enumerate(self.group_by):
             vals = [self.groups.rows[r][gi] for r in range(n_groups)]
-            out.append(Column.from_values(g.eval_type, vals, g.frac))
+            col = Column.from_values(g.eval_type, vals, g.frac)
+            if gi in self._group_dicts:
+                et, d = self._group_dicts[gi]
+                if et == g.eval_type:
+                    col.dictionary = d
+            out.append(col)
         return BatchExecuteResult(Chunk.full(out), True)
 
     def _gids_for_chunk(self, chunk: Chunk, n: int, logical: np.ndarray) -> np.ndarray:
@@ -439,12 +451,18 @@ class BatchTopNExecutor(BatchExecutor):
         buf: list[tuple] = []  # (sort_key, seq, row_values)
         seq = 0
         drained = False
+        enum_dicts: dict[int, np.ndarray] = {}
         while not drained:
             r = self.child.next_batch(scan_rows)
             drained = r.is_drained
             chunk = r.chunk
             if not chunk.num_rows:
                 continue
+            for ci, c in enumerate(chunk.columns):
+                # ENUM/SET codes are only meaningful with their name table —
+                # carry it through the row rebuild below
+                if c.eval_type in (EvalType.ENUM, EvalType.SET) and c.dictionary is not None:
+                    enum_dicts.setdefault(ci, c.dictionary)
             n = len(chunk.columns[0])
             needed = set()
             for rpn, _ in self.order_by:
@@ -470,7 +488,10 @@ class BatchTopNExecutor(BatchExecutor):
         out_cols: list[Column] = []
         for col_idx, (et, frac) in enumerate(self._schema):
             vals = [values[col_idx] for _, _, values in buf]
-            out_cols.append(Column.from_values(et, vals, frac))
+            col = Column.from_values(et, vals, frac)
+            if col_idx in enum_dicts:
+                col.dictionary = enum_dicts[col_idx]
+            out_cols.append(col)
         return BatchExecuteResult(Chunk.full(out_cols), True)
 
 
@@ -484,6 +505,10 @@ def _coded_group_parts(group_rpns, columns, rows: np.ndarray):
             return None
         c = columns[g.nodes[0].index]
         if not c.is_dict_encoded:
+            return None
+        if c.eval_type in (EvalType.ENUM, EvalType.SET):
+            # their dictionary is a name table, not a code table: ENUM codes
+            # ARE the group value (generic int path), SET masks aren't codes
             return None
         cap *= len(c.dictionary) + 1
         if cap > (1 << 20):

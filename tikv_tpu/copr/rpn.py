@@ -203,6 +203,9 @@ _DTYPE = {
     EvalType.DATETIME: np.int64,
     EvalType.DURATION: np.int64,
     EvalType.REAL: np.float64,
+    # enum index / set bitmask ride integer lanes directly
+    EvalType.ENUM: np.int64,
+    EvalType.SET: np.uint64,
 }
 
 
@@ -273,6 +276,12 @@ def const_decimal(scaled: int | None, frac: int) -> Constant:
 
 def const_bytes(v: bytes | None) -> Constant:
     return Constant(v, EvalType.BYTES)
+
+
+def const_set(mask: int | None) -> Constant:
+    """SET bitmask constant — uint64 lanes, so bit 63 survives (a plain
+    const_int would wrap negative against a 64-element SET column)."""
+    return Constant(mask, EvalType.SET)
 
 
 def const_json(v) -> Constant:

@@ -19,6 +19,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..util import codec
+from . import datatypes
 from . import datum as datum_mod
 from .datatypes import Column, ColumnInfo, EvalType
 
@@ -92,6 +93,9 @@ def encode_row(columns: list[ColumnInfo], values: list) -> bytes:
             datum_mod.encode_datum(out, datum_mod.JSON_FLAG, v)
         elif et in (EvalType.DATETIME, EvalType.DURATION):
             datum_mod.encode_datum(out, datum_mod.DURATION_FLAG, v)
+        elif et in (EvalType.ENUM, EvalType.SET):
+            # stored form is the index / bitmask (row::v2 stores the same)
+            datum_mod.encode_datum(out, datum_mod.UINT_FLAG, int(v))
         else:
             raise ValueError(f"unsupported {et}")
     return bytes(out)
@@ -291,11 +295,21 @@ class RowBatchDecoder:
                     values.append(d.value[0])
                 else:
                     values.append(d.value)
-            out.append(Column.from_values(et, values, info.ftype.decimal))
+            out.append(_typed_column(info, values))
         return out
+
+
+def _typed_column(info: ColumnInfo, values: list) -> Column:
+    """Column.from_values + the ENUM/SET name dictionary from the schema."""
+    col = Column.from_values(info.ftype.eval_type, values, info.ftype.decimal)
+    if info.ftype.eval_type == datatypes.EvalType.ENUM:
+        col.dictionary = datatypes.enum_dictionary(info.ftype.elems)
+    elif info.ftype.eval_type == datatypes.EvalType.SET:
+        col.dictionary = datatypes.set_dictionary(info.ftype.elems)
+    return col
 
 
 def _default_column(info: ColumnInfo, n: int) -> Column:
     if info.default_value is not None:
-        return Column.from_values(info.ftype.eval_type, [info.default_value] * n, info.ftype.decimal)
-    return Column.from_values(info.ftype.eval_type, [None] * n, info.ftype.decimal)
+        return _typed_column(info, [info.default_value] * n)
+    return _typed_column(info, [None] * n)
