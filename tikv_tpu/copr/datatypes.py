@@ -317,6 +317,22 @@ def set_names(col: Column) -> Column:
     return Column(EvalType.BYTES, out, col.nulls.copy())
 
 
+def attach_schema_dictionary(info: "ColumnInfo", col: Column) -> Column:
+    """Attach the ENUM/SET name table declared by the schema entry."""
+    if col.eval_type == EvalType.ENUM:
+        col.dictionary = enum_dictionary(info.ftype.elems)
+    elif col.eval_type == EvalType.SET:
+        col.dictionary = set_dictionary(info.ftype.elems)
+    return col
+
+
+def typed_column(info: "ColumnInfo", values: list) -> Column:
+    """Column.from_values typed by a schema entry (shared by the v1 and v2
+    row decoders so the construction rule lives in exactly one place)."""
+    col = Column.from_values(info.ftype.eval_type, values, info.ftype.decimal)
+    return attach_schema_dictionary(info, col)
+
+
 def _pyval(et: EvalType, v):
     if et == EvalType.REAL:
         return float(v)

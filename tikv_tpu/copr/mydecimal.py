@@ -300,7 +300,13 @@ class MyDecimal:
         """MySQL/TiKV binary decimal (decimal.rs write_bin): memcomparable."""
         if frac > prec:
             raise ValueError("frac > prec")
-        d = self.round(frac, HALF_EVEN)
+        try:
+            d = self.round(frac, HALF_EVEN)
+        except DecimalOverflow:
+            # widening the scale overran the 81-digit buffer: the value can't
+            # fit (prec, frac) anyway — clamp to the max representable
+            mag = 10**prec - 1
+            d = MyDecimal(-mag if self.unscaled < 0 else mag, frac)
         int_cnt = prec - frac
         mag = abs(d.unscaled)
         ip, fp = divmod(mag, 10**frac) if frac else (mag, 0)

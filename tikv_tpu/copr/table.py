@@ -21,6 +21,7 @@ import numpy as np
 from ..util import codec
 from . import datatypes
 from . import datum as datum_mod
+from . import rowv2
 from .datatypes import Column, ColumnInfo, EvalType
 
 TABLE_PREFIX = b"t"
@@ -123,11 +124,13 @@ class RowBatchDecoder:
 
     def decode(self, handles: np.ndarray, row_values: list[bytes]) -> list[Column]:
         n = len(row_values)
-        fast = self._try_fast_decode(row_values)
-        if fast is not None:
-            cols = fast
+        if row_values and all(rowv2.is_v2_row(rv) for rv in row_values):
+            cols = rowv2.decode_rows_v2(self.schema, row_values)
+        elif row_values and any(rowv2.is_v2_row(rv) for rv in row_values):
+            cols = self._mixed_decode(row_values)
         else:
-            cols = self._slow_decode(row_values)
+            fast = self._try_fast_decode(row_values)
+            cols = fast if fast is not None else self._slow_decode(row_values)
         # fill handle columns
         for i in self.handle_idx:
             cols[i] = Column(EvalType.INT, handles.astype(np.int64), np.zeros(n, dtype=bool))
@@ -275,6 +278,22 @@ class RowBatchDecoder:
             dictionary[j] = ub[j].tobytes()
         return codes.astype(np.int64), dictionary
 
+    def _mixed_decode(self, row_values: list[bytes]) -> list[Column]:
+        """A block mixing v1 and v2 rows (mid-migration): decode each format
+        batch-wise, then interleave back into row order."""
+        v2_idx = [i for i, rv in enumerate(row_values) if rowv2.is_v2_row(rv)]
+        v1_idx = [i for i, rv in enumerate(row_values) if not rowv2.is_v2_row(rv)]
+        v2_cols = rowv2.decode_rows_v2(self.schema, [row_values[i] for i in v2_idx])
+        v1_cols = self._slow_decode([row_values[i] for i in v1_idx])
+        n = len(row_values)
+        order = np.empty(n, dtype=np.int64)
+        order[np.array(v2_idx, dtype=np.int64)] = np.arange(len(v2_idx))
+        order[np.array(v1_idx, dtype=np.int64)] = len(v2_idx) + np.arange(len(v1_idx))
+        out = []
+        for c2, c1 in zip(v2_cols, v1_cols):
+            out.append(Column.concat([c2, c1]).take(order))
+        return out
+
     # -- slow path: per-row datum walk -------------------------------------
 
     def _slow_decode(self, row_values: list[bytes]) -> list[Column]:
@@ -299,14 +318,7 @@ class RowBatchDecoder:
         return out
 
 
-def _typed_column(info: ColumnInfo, values: list) -> Column:
-    """Column.from_values + the ENUM/SET name dictionary from the schema."""
-    col = Column.from_values(info.ftype.eval_type, values, info.ftype.decimal)
-    if info.ftype.eval_type == datatypes.EvalType.ENUM:
-        col.dictionary = datatypes.enum_dictionary(info.ftype.elems)
-    elif info.ftype.eval_type == datatypes.EvalType.SET:
-        col.dictionary = datatypes.set_dictionary(info.ftype.elems)
-    return col
+_typed_column = datatypes.typed_column
 
 
 def _default_column(info: ColumnInfo, n: int) -> Column:
