@@ -1,0 +1,238 @@
+"""TLS wire security, log redaction, and stable error codes.
+
+Mirrors the reference's security/log_wrappers/error_code unit strategy
+(components/security/src/lib.rs tests, log_wrappers/src/lib.rs tests).
+"""
+
+import subprocess
+
+import pytest
+
+from tikv_tpu.server import wire
+from tikv_tpu.server.security import SecurityConfig, SecurityError
+from tikv_tpu.server.server import Client, Server
+from tikv_tpu.util import error_code, logger
+from tikv_tpu.util.config import TikvConfig
+
+
+class _EchoService:
+    def dispatch(self, method, request):
+        if method == "boom":
+            from tikv_tpu.raft.region import NotLeaderError
+
+            raise NotLeaderError(1, 2)
+        return {"echo": [method, request]}
+
+
+def _gen_ca_and_cert(tmp, name, cn):
+    """Self-signed CA + a CA-signed cert for ``cn`` via the openssl CLI."""
+    ca_key, ca_pem = tmp / "ca.key", tmp / "ca.pem"
+    if not ca_pem.exists():
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(ca_key), "-out", str(ca_pem), "-days", "1",
+             "-subj", "/CN=tikv-tpu-test-ca"],
+            check=True, capture_output=True,
+        )
+    key, csr, pem = tmp / f"{name}.key", tmp / f"{name}.csr", tmp / f"{name}.pem"
+    subprocess.run(
+        ["openssl", "req", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(csr), "-subj", f"/CN={cn}"],
+        check=True, capture_output=True,
+    )
+    subprocess.run(
+        ["openssl", "x509", "-req", "-in", str(csr), "-CA", str(ca_pem),
+         "-CAkey", str(ca_key), "-CAcreateserial", "-out", str(pem), "-days", "1"],
+        check=True, capture_output=True,
+    )
+    return SecurityConfig(ca_path=str(ca_pem), cert_path=str(pem), key_path=str(key))
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("tls")
+    return {
+        "server": _gen_ca_and_cert(tmp, "server", "tikv-server"),
+        "client": _gen_ca_and_cert(tmp, "client", "tikv-client"),
+        "dir": tmp,
+    }
+
+
+def test_partial_config_rejected():
+    with pytest.raises(SecurityError):
+        SecurityConfig(ca_path="/x").validate()
+    with pytest.raises(SecurityError):
+        SecurityConfig(cert_allowed_cn={"a"}).validate()
+    SecurityConfig().validate()  # plaintext is fine
+    assert not SecurityConfig().enabled
+
+
+def test_mutual_tls_roundtrip(certs):
+    srv = Server(_EchoService(), security=certs["server"])
+    srv.start()
+    try:
+        cli = Client(*srv.addr, security=certs["client"])
+        assert cli.call("ping", {"k": 1}) == {"echo": ["ping", {"k": 1}]}
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_plaintext_client_rejected_by_tls_server(certs):
+    srv = Server(_EchoService(), security=certs["server"])
+    srv.start()
+    try:
+        cli = Client(*srv.addr)  # no TLS
+        with pytest.raises((TimeoutError, ConnectionError)):
+            cli.call("ping", {}, timeout=1.0)
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_cert_allowed_cn_enforced(certs):
+    sec = SecurityConfig(
+        ca_path=certs["server"].ca_path,
+        cert_path=certs["server"].cert_path,
+        key_path=certs["server"].key_path,
+        cert_allowed_cn={"some-other-cn"},
+    )
+    srv = Server(_EchoService(), security=sec)
+    srv.start()
+    try:
+        # rejection may surface during the client handshake (EOF) or the call
+        with pytest.raises((TimeoutError, OSError)):
+            cli = Client(*srv.addr, security=certs["client"])
+            try:
+                cli.call("ping", {}, timeout=1.0)
+            finally:
+                cli.close()
+    finally:
+        srv.stop()
+    # and the right CN passes
+    sec_ok = SecurityConfig(
+        ca_path=sec.ca_path, cert_path=sec.cert_path, key_path=sec.key_path,
+        cert_allowed_cn={"tikv-client"},
+    )
+    srv = Server(_EchoService(), security=sec_ok)
+    srv.start()
+    try:
+        cli = Client(*srv.addr, security=certs["client"])
+        assert cli.call("ping", {})["echo"][0] == "ping"
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_tikv_config_security_section(certs):
+    cfg = TikvConfig()
+    cfg.security.ca_path = certs["server"].ca_path
+    with pytest.raises(SecurityError):
+        cfg.validate()  # partial
+    cfg.security.cert_path = certs["server"].cert_path
+    cfg.security.key_path = certs["server"].key_path
+    cfg.validate()
+    assert cfg.security_config().enabled
+
+
+# ------------------------------------------------------------- log redaction
+
+def test_redact_modes():
+    try:
+        logger.set_redact_info_log(True)
+        assert logger.key(b"secret") == "?"
+        logger.set_redact_info_log("marker")
+        assert logger.key(b"\x01ab") == "‹016162›"
+        logger.set_redact_info_log(False)
+        assert logger.key(b"\xff") == "FF"
+        with pytest.raises(ValueError):
+            logger.set_redact_info_log("nope")
+    finally:
+        logger.set_redact_info_log(False)
+
+
+def test_structured_log_line_format():
+    import io
+    import logging as stdlog
+
+    log = logger.get_logger("testmod")
+    buf = io.StringIO()
+    handler = stdlog.StreamHandler(buf)
+    handler.setFormatter(logger._Formatter())
+    stdlog.getLogger("tikv_tpu.testmod").addHandler(handler)
+    logger.set_redact_info_log(True)
+    try:
+        log.info("something happened", region=7, key=logger.key(b"user-key"))
+    finally:
+        logger.set_redact_info_log(False)
+        stdlog.getLogger("tikv_tpu.testmod").removeHandler(handler)
+    out = buf.getvalue()
+    assert "[INFO] [tikv_tpu.testmod] [something happened] [region=7] [key=?]" in out
+    assert "user-key" not in out and "757365" not in out.lower()
+
+
+# --------------------------------------------------------------- error codes
+
+def test_error_codes_resolve():
+    from tikv_tpu.raft.region import EpochError, NotLeaderError, Region
+    from tikv_tpu.storage.mvcc.reader import KeyIsLockedError
+
+    error_code.register_builtin()
+    assert error_code.code_of(NotLeaderError(1, 2)) == "KV:Raftstore:NotLeader"
+    assert error_code.code_of(EpochError(Region(id=1))) == "KV:Raftstore:EpochNotMatch"
+    from tikv_tpu.storage.txn_types import Lock
+
+    lk = KeyIsLockedError(b"k", Lock(lock_type="put", primary=b"k", ts=1, ttl=1))
+    assert error_code.code_of(lk) == "KV:Storage:KeyIsLocked"
+    assert error_code.code_of(RuntimeError("x")) == "KV:Unknown"
+
+
+def test_error_code_instance_override():
+    e = RuntimeError("x")
+    e.error_code = "KV:Custom:Thing"
+    assert error_code.code_of(e) == "KV:Custom:Thing"
+
+
+def test_error_code_spec_artifact():
+    spec = error_code.spec()
+    assert "KV:Raftstore:NotLeader" in spec
+    assert all(code.startswith("KV:") for code in spec)
+
+
+def test_error_code_on_the_wire():
+    srv = Server(_EchoService())
+    srv.start()
+    try:
+        cli = Client(*srv.addr)
+        resp = cli.call("boom", {})
+        assert resp["error"]["code"] == "KV:Raftstore:NotLeader"
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_apply_security_sets_redaction():
+    cfg = TikvConfig()
+    cfg.security.redact_info_log = "on"
+    try:
+        assert cfg.apply_security() is None  # plaintext, but redaction applied
+        assert logger.redact_mode() == "on"
+        assert logger.key(b"x") == "?"
+    finally:
+        logger.set_redact_info_log(False)
+
+
+def test_v1_explicit_null_stays_null():
+    """An explicitly stored NULL must not resurrect as the column default
+    (matches row v2; only an *absent* column takes the default)."""
+    import numpy as np
+
+    from tikv_tpu.copr.datatypes import ColumnInfo, FieldType
+    from tikv_tpu.copr.table import RowBatchDecoder, encode_row
+
+    info = ColumnInfo(2, FieldType.int64(), default_value=42)
+    pk = ColumnInfo(1, FieldType.int64(), is_pk_handle=True)
+    stored_null = encode_row([info], [None])
+    absent = b""  # no columns stored at all
+    cols = RowBatchDecoder([pk, info]).decode(np.array([1, 2]), [stored_null, absent])
+    assert cols[1].to_values() == [None, 42]

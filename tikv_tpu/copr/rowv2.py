@@ -290,15 +290,13 @@ def _fast_decode(schema, first: RowSliceV2, row_values, n) -> list[Column]:
         elif et in (EvalType.INT, EvalType.DATETIME, EvalType.ENUM, EvalType.SET):
             data = _le_unsigned_batch(raw, w)
             dtype = np.uint64 if et == EvalType.SET else np.int64
-            out.append(Column(et, data.astype(dtype), nulls))
+            out.append(attach_schema_dictionary(info, Column(et, data.astype(dtype), nulls)))
         elif et == EvalType.REAL:
             data = codec.decode_f64_batch(np.ascontiguousarray(raw))
             out.append(Column(et, data, nulls))
         else:
             vals = [decode_cell(info, bytes(raw[r])) for r in range(n)]
             out.append(typed_column(info, vals))
-    for info, col in zip(schema, out):
-        attach_schema_dictionary(info, col)
     return out
 
 

@@ -308,8 +308,12 @@ class RowBatchDecoder:
             values = []
             for r in rows:
                 d = r.get(info.col_id)
-                if d is None or d.flag == datum_mod.NIL_FLAG:
-                    values.append(None if info.default_value is None else info.default_value)
+                if d is None:
+                    # column absent from the row (schema evolution) ⇒ default
+                    values.append(info.default_value)
+                elif d.flag == datum_mod.NIL_FLAG:
+                    # explicitly stored NULL stays NULL (row v2 agrees)
+                    values.append(None)
                 elif d.flag == datum_mod.DECIMAL_FLAG:
                     values.append(d.value[0])
                 else:

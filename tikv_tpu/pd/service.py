@@ -101,8 +101,9 @@ class RemotePd(PdClient):
     """PdClient over the wire (pd_client's RpcClient with reconnect,
     util.rs): one multiplexed connection, re-dialed on failure."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, security=None):
         self.addr = (host, port)
+        self.security = security
         self._mu = threading.Lock()
         self._client = None
 
@@ -118,7 +119,7 @@ class RemotePd(PdClient):
                 with self._mu:
                     client = self._client
                 if client is None:
-                    client = Client(*self.addr)
+                    client = Client(*self.addr, security=self.security)
                     with self._mu:
                         if self._client is None:
                             self._client = client

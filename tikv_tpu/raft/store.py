@@ -21,8 +21,11 @@ from ..storage.btree_engine import BTreeEngine
 from ..util.failpoint import fail_point
 from ..storage.engine import CF_DEFAULT, CF_LOCK, CF_RAFT, CF_WRITE, WriteBatch
 from ..util import codec, keys
+from ..util import logger as slog
 from .core import Entry, Message, MsgType, RaftNode, Role
 from .core import Snapshot as RaftSnapshot
+
+_LOG = slog.get_logger("raftstore")
 from .region import EpochError, KeyNotInRegionError, NotLeaderError, Peer as RegionPeer, Region, RegionEpoch
 
 DATA_CFS = (CF_DEFAULT, CF_LOCK, CF_WRITE)
@@ -875,6 +878,12 @@ class StorePeer:
 
     def _apply_split(self, admin) -> None:
         _, split_key, new_region_id, new_pids = admin
+        _LOG.info(
+            "region split applied",
+            region=self.region.id,
+            new_region=new_region_id,
+            split_key=slog.key(split_key),
+        )
         old = self.region
         new_peers = [
             RegionPeer(pid, p.store_id, p.role) for pid, p in zip(new_pids, old.peers)

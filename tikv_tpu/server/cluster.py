@@ -38,11 +38,12 @@ class StoreNode:
 
     def __init__(self, cluster: "ServerCluster", store_id: int, engine=None):
         self.cluster = cluster
-        self.transport = RemoteTransport(cluster.resolve)
+        security = cluster.security
+        self.transport = RemoteTransport(cluster.resolve, security=security)
         self.node = Node(cluster.pd, self.transport, store_id=store_id, engine=engine)
         self.store = self.node.store
         self.service = KvService(storage=None, raft_router=self.store)
-        self.server = Server(self.service)
+        self.server = Server(self.service, security=security)
         self.running = False
 
     def start(self) -> None:
@@ -60,7 +61,14 @@ class StoreNode:
 
 
 class ServerCluster:
-    def __init__(self, n_stores: int, pd: MockPd | None = None, engines: dict | None = None):
+    def __init__(
+        self,
+        n_stores: int,
+        pd: MockPd | None = None,
+        engines: dict | None = None,
+        security=None,
+    ):
+        self.security = security
         self.pd = pd or MockPd()
         self.addrs: dict[int, tuple[str, int]] = {}
         self.nodes: dict[int, StoreNode] = {}

@@ -14,6 +14,10 @@ from __future__ import annotations
 
 import threading
 
+from ..util import logger as slog
+
+_LOG = slog.get_logger("gc_worker")
+
 from ..storage.engine import CF_DEFAULT, CF_LOCK, CF_WRITE, WriteBatch
 from ..storage.kv import Engine
 from ..storage.txn_types import Key, Write, WriteType, append_ts, split_ts
@@ -30,6 +34,8 @@ class GcWorker:
     def gc_range(self, start: bytes | None, end: bytes | None, safe_point: int, ctx: dict | None = None) -> dict:
         """One GC sweep over [start, end) at ``safe_point``. Returns stats."""
         with self._mu:
+            if safe_point > self.safe_point:
+                _LOG.info("gc safe point advanced", safe_point=safe_point)
             self.safe_point = max(self.safe_point, safe_point)
         snap = self.engine.snapshot(ctx)
         enc_start = Key.from_raw(start).encoded if start else b""

@@ -45,6 +45,7 @@ class _StoreConn:
         self.store_id = store_id
         self.resolver = resolver
         self.owner = owner
+        self.security = owner.security
         self.sock: socket.socket | None = None
         self.mu = threading.Lock()
         self.send_mu = threading.Lock()
@@ -63,8 +64,11 @@ class _StoreConn:
             self.down_until = time.monotonic() + _BACKOFF_S
             return False
         try:
-            self.sock = socket.create_connection((addr[0], addr[1]), timeout=2.0)
-            self.sock.settimeout(5.0)
+            sock = socket.create_connection((addr[0], addr[1]), timeout=2.0)
+            if self.security is not None and self.security.enabled:
+                sock = self.security.client_context().wrap_socket(sock)
+            sock.settimeout(5.0)
+            self.sock = sock
             return True
         except OSError:
             self.sock = None
@@ -103,10 +107,11 @@ class RaftClient:
     as batched frames.  ``resolver`` maps store_id -> (host, port) (the
     reference resolves through PD, resolve.rs:145)."""
 
-    def __init__(self, resolver: Callable[[int], tuple[str, int] | None]):
+    def __init__(self, resolver: Callable[[int], tuple[str, int] | None], security=None):
         import random
 
         self.resolver = resolver
+        self.security = security
         self._conns: dict[int, _StoreConn] = {}
         self._mu = threading.Lock()
         # transfer ids must be unique across every sending store feeding one
@@ -206,8 +211,8 @@ class RemoteTransport(Transport):
     """raftstore Transport over RaftClient, with the in-memory transport's
     Filter hook retained for fault injection (transport_simulate.rs)."""
 
-    def __init__(self, resolver: Callable[[int], tuple[str, int] | None]):
-        self.client = RaftClient(resolver)
+    def __init__(self, resolver: Callable[[int], tuple[str, int] | None], security=None):
+        self.client = RaftClient(resolver, security=security)
         self.filters: list[Filter] = []
 
     def send(self, to_store: int, rmsg: RaftMessage) -> None:

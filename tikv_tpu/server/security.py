@@ -1,0 +1,72 @@
+"""TLS for the framed-TCP wire.
+
+Re-expression of ``components/security/src/lib.rs``: a SecurityConfig names a
+CA plus the node's cert/key; when all three are set every server and client
+socket is wrapped in **mutual** TLS (both sides verify against the CA, like
+the reference's gRPC channel credentials), and the server can additionally
+restrict accepted client certificates to an allow-list of Common Names
+(``cert_allowed_cn``, lib.rs ``check_common_name``).
+
+All-or-nothing validation matches the reference: setting only some of the
+three paths is a config error rather than silent plaintext.
+"""
+
+from __future__ import annotations
+
+import ssl
+from dataclasses import dataclass, field
+
+
+class SecurityError(Exception):
+    pass
+
+
+@dataclass
+class SecurityConfig:
+    ca_path: str = ""
+    cert_path: str = ""
+    key_path: str = ""
+    cert_allowed_cn: set[str] = field(default_factory=set)
+
+    def validate(self) -> None:
+        paths = (self.ca_path, self.cert_path, self.key_path)
+        if any(paths) and not all(paths):
+            raise SecurityError("ca_path, cert_path and key_path must be set together")
+        if self.cert_allowed_cn and not self.ca_path:
+            raise SecurityError("cert_allowed_cn requires TLS to be configured")
+
+    @property
+    def enabled(self) -> bool:
+        self.validate()
+        return bool(self.ca_path)
+
+    def server_context(self) -> ssl.SSLContext | None:
+        if not self.enabled:
+            return None
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.cert_path, self.key_path)
+        ctx.load_verify_locations(self.ca_path)
+        ctx.verify_mode = ssl.CERT_REQUIRED  # mutual TLS
+        return ctx
+
+    def client_context(self) -> ssl.SSLContext | None:
+        if not self.enabled:
+            return None
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.load_cert_chain(self.cert_path, self.key_path)
+        ctx.load_verify_locations(self.ca_path)
+        # peers are addressed by ip:port, identity comes from the shared CA
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_REQUIRED
+        return ctx
+
+    def check_common_name(self, sock: ssl.SSLSocket) -> None:
+        """Reject client certs whose CN is outside the allow-list."""
+        if not self.cert_allowed_cn:
+            return
+        cert = sock.getpeercert()
+        for rdn in (cert or {}).get("subject", ()):
+            for k, v in rdn:
+                if k == "commonName" and v in self.cert_allowed_cn:
+                    return
+        raise SecurityError("client certificate CN not in cert_allowed_cn")

@@ -14,8 +14,11 @@ import struct
 import threading
 from concurrent.futures import ThreadPoolExecutor
 
+from ..util import error_code
 from . import wire
 from .service import KvService
+
+error_code.register_builtin()
 
 _LEN = struct.Struct(">I")
 _MAX_FRAME = 64 << 20
@@ -46,8 +49,17 @@ def write_frame(sock: socket.socket, payload: bytes) -> None:
 
 
 class Server:
-    def __init__(self, service: KvService, host: str = "127.0.0.1", port: int = 0, workers: int = 8):
+    def __init__(
+        self,
+        service: KvService,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        workers: int = 8,
+        security=None,
+    ):
         self.service = service
+        self.security = security
+        self._ssl_ctx = security.server_context() if security is not None else None
         self._sock = socket.create_server((host, port))
         self.addr = self._sock.getsockname()
         self._pool = ThreadPoolExecutor(max_workers=workers)
@@ -67,7 +79,17 @@ class Server:
                 continue
             except OSError:
                 return
-            threading.Thread(target=self._serve_conn, args=(conn,), daemon=True).start()
+            threading.Thread(target=self._handshake_and_serve, args=(conn,), daemon=True).start()
+
+    def _handshake_and_serve(self, conn: socket.socket) -> None:
+        if self._ssl_ctx is not None:
+            try:
+                conn = self._ssl_ctx.wrap_socket(conn, server_side=True)
+                self.security.check_common_name(conn)
+            except Exception:  # noqa: BLE001 — failed handshake, drop the peer
+                conn.close()
+                return
+        self._serve_conn(conn)
 
     def _serve_conn(self, conn: socket.socket) -> None:
         send_mu = threading.Lock()
@@ -94,7 +116,7 @@ class Server:
                     try:
                         resp = self.service.dispatch(method, request)
                     except Exception as e:  # noqa: BLE001 — wire boundary
-                        resp = {"error": {"other": repr(e)}}
+                        resp = {"error": {"other": repr(e), "code": error_code.code_of(e)}}
                     payload = wire.dumps([req_id, resp])
                     with send_mu:
                         try:
@@ -117,8 +139,10 @@ class Server:
 class Client:
     """Blocking client with request multiplexing (ReqBatcher flavor)."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, security=None):
         self._sock = socket.create_connection((host, port))
+        if security is not None and security.enabled:
+            self._sock = security.client_context().wrap_socket(self._sock)
         self._dead = False
         self._mu = threading.Lock()
         # writes serialize separately from bookkeeping: concurrent callers

@@ -52,10 +52,12 @@ class StoreServer:
         host: str = "127.0.0.1",
         port: int = 0,
         enable_device: bool = False,
+        security=None,
     ):
         self.pd = pd
+        self.security = security
         self.engine = open_engine(data_dir)
-        self.transport = RemoteTransport(self._resolve)
+        self.transport = RemoteTransport(self._resolve, security=security)
         self.node = Node(pd, self.transport, store_id=store_id, engine=self.engine)
         self.store = self.node.store
         recovered = self.store.recover()
@@ -82,7 +84,7 @@ class StoreServer:
             resolved_ts=self.resolved_ts,
             diagnostics=Diagnostics(),
         )
-        self.server = Server(self.service, host=host, port=port)
+        self.server = Server(self.service, host=host, port=port, security=security)
         self.recovered_peers = recovered
 
     def _resolve(self, store_id: int):
@@ -141,13 +143,29 @@ def main(argv=None) -> int:
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--expect-stores", type=int, default=1)
     ap.add_argument("--enable-device", action="store_true")
+    ap.add_argument("--ca-path", default="")
+    ap.add_argument("--cert-path", default="")
+    ap.add_argument("--key-path", default="")
+    ap.add_argument("--redact-info-log", default="off", choices=["off", "on", "marker"])
     args = ap.parse_args(argv)
 
+    from ..util import logger as slog
+    from .security import SecurityConfig
+
+    slog.set_redact_info_log(args.redact_info_log)
+    security = SecurityConfig(
+        ca_path=args.ca_path, cert_path=args.cert_path, key_path=args.key_path
+    )
+    security.validate()
+    if not security.enabled:
+        security = None
+
     host, port = args.pd.rsplit(":", 1)
-    pd = RemotePd(host, int(port))
+    pd = RemotePd(host, int(port), security=security)
     srv = StoreServer(
         args.store_id, pd, data_dir=args.dir,
         host=args.host, port=args.port, enable_device=args.enable_device,
+        security=security,
     )
     srv.start()
     srv.bootstrap_or_join(args.expect_stores)

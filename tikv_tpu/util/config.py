@@ -58,6 +58,17 @@ class ServerConfig:
 
 
 @dataclass
+class SecuritySection:
+    """security.* (components/security/src/lib.rs SecurityConfig)."""
+
+    ca_path: str = ""
+    cert_path: str = ""
+    key_path: str = ""
+    cert_allowed_cn: list = field(default_factory=list)
+    redact_info_log: str = "off"  # off | on | marker
+
+
+@dataclass
 class TikvConfig:
     server: ServerConfig = field(default_factory=ServerConfig)
     raftstore: RaftstoreConfig = field(default_factory=RaftstoreConfig)
@@ -65,10 +76,35 @@ class TikvConfig:
     coprocessor: CoprocessorConfig = field(default_factory=CoprocessorConfig)
     readpool: ReadPoolConfig = field(default_factory=ReadPoolConfig)
     gc: GcConfig = field(default_factory=GcConfig)
+    security: SecuritySection = field(default_factory=SecuritySection)
+
+    def apply_security(self):
+        """Make the [security] section take effect process-wide: returns the
+        SecurityConfig (or None for plaintext) and applies redact_info_log."""
+        from . import logger as slog
+
+        slog.set_redact_info_log(self.security.redact_info_log)
+        sc = self.security_config()
+        return sc if sc.enabled else None
+
+    def security_config(self):
+        from ..server.security import SecurityConfig
+
+        sc = SecurityConfig(
+            ca_path=self.security.ca_path,
+            cert_path=self.security.cert_path,
+            key_path=self.security.key_path,
+            cert_allowed_cn=set(self.security.cert_allowed_cn),
+        )
+        sc.validate()
+        return sc
 
     def validate(self) -> None:
         if self.raftstore.heartbeat_tick >= self.raftstore.election_tick:
             raise ValueError("heartbeat_tick must be < election_tick")
+        self.security_config()
+        if self.security.redact_info_log not in ("off", "on", "marker"):
+            raise ValueError("security.redact_info_log must be off|on|marker")
         if self.coprocessor.block_rows <= 0 or self.coprocessor.block_rows & (self.coprocessor.block_rows - 1):
             raise ValueError("coprocessor.block_rows must be a power of two")
         if self.storage.scheduler_concurrency <= 0:

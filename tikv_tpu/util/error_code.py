@@ -1,0 +1,116 @@
+"""Stable error codes for the wire boundary.
+
+Re-expression of ``error_code/src/`` in the reference: every user-visible
+error carries a spec-stable code ``KV:<Module>:<Name>`` so clients, logs, and
+dashboards can match on codes instead of message strings.  The reference
+generates a ``error_code.toml`` spec from the registered codes
+(``error_code/src/lib.rs:87`` define_error_codes!); ``spec()`` here serves the
+same artifact.
+
+Codes attach to exceptions two ways:
+
+* by *type*: ``register(exc_type, code)`` — used for the framework's own
+  exception classes, resolved via ``code_of`` (walks the MRO so subclasses
+  inherit their family's code);
+* by *instance*: exceptions may set ``.error_code`` to override.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ErrorCode:
+    code: str  # "KV:Raftstore:NotLeader"
+    description: str
+
+    @property
+    def module(self) -> str:
+        return self.code.split(":")[1]
+
+
+_CODES: dict[str, ErrorCode] = {}
+_BY_TYPE: dict[type, ErrorCode] = {}
+
+UNKNOWN = ErrorCode("KV:Unknown", "unclassified error")
+
+
+def define(code: str, description: str = "") -> ErrorCode:
+    ec = ErrorCode(code, description)
+    if code in _CODES:
+        return _CODES[code]
+    _CODES[code] = ec
+    return ec
+
+
+def register(exc_type: type, ec: ErrorCode) -> None:
+    _BY_TYPE[exc_type] = ec
+
+
+def code_of(exc: BaseException) -> str:
+    override = getattr(exc, "error_code", None)
+    if isinstance(override, ErrorCode):
+        return override.code
+    if isinstance(override, str):
+        return override
+    for klass in type(exc).__mro__:
+        ec = _BY_TYPE.get(klass)
+        if ec is not None:
+            return ec.code
+    return UNKNOWN.code
+
+
+def spec() -> dict[str, str]:
+    """code → description, the error_code.toml equivalent artifact."""
+    return {c.code: c.description for c in _CODES.values()}
+
+
+# --- the registry (error_code/src/{raftstore,storage,coprocessor}.rs) -------
+
+RAFTSTORE_NOT_LEADER = define("KV:Raftstore:NotLeader", "peer is not the region leader")
+RAFTSTORE_EPOCH_NOT_MATCH = define("KV:Raftstore:EpochNotMatch", "region epoch is stale")
+RAFTSTORE_KEY_NOT_IN_REGION = define("KV:Raftstore:KeyNotInRegion", "key outside region range")
+RAFTSTORE_DATA_NOT_READY = define("KV:Raftstore:DataIsNotReady", "safe-ts not advanced for stale read")
+STORAGE_KEY_IS_LOCKED = define("KV:Storage:KeyIsLocked", "key locked by another transaction")
+STORAGE_WRITE_CONFLICT = define("KV:Storage:WriteConflict", "write conflict at commit ts")
+STORAGE_TXN_LOCK_NOT_FOUND = define("KV:Storage:TxnLockNotFound", "lock vanished before commit")
+STORAGE_ALREADY_EXISTS = define("KV:Storage:AlreadyExist", "insert found an existing key")
+STORAGE_COMMIT_EXPIRED = define("KV:Storage:CommitTsExpired", "commit ts below lock min_commit_ts")
+STORAGE_PESSIMISTIC_LOCK_NOT_FOUND = define(
+    "KV:Storage:PessimisticLockNotFound", "pessimistic lock missing at prewrite"
+)
+STORAGE_DEADLOCK = define("KV:Storage:Deadlock", "waits-for cycle detected")
+COPR_PLUGIN = define("KV:Coprocessor:Plugin", "coprocessor plugin failure")
+ENGINE_FAILPOINT = define("KV:Engine:Failpoint", "injected failure")
+CLOUD_IO = define("KV:Cloud:Io", "external storage failure")
+
+
+def register_builtin() -> None:
+    """Bind the framework's exception families to their codes (idempotent)."""
+    from ..copr.plugin import PluginError
+    from ..raft.region import EpochError, KeyNotInRegionError, NotLeaderError
+    from ..server.lock_manager import DeadlockError
+    from ..sidecar.cloud import CloudError
+    from ..storage.mvcc.reader import KeyIsLockedError, WriteConflictError
+    from ..storage.mvcc.txn import (
+        AlreadyExistsError,
+        CommitTsExpiredError,
+        PessimisticLockNotFoundError,
+        TxnLockNotFoundError,
+    )
+    from .failpoint import FailpointError
+
+    register(NotLeaderError, RAFTSTORE_NOT_LEADER)
+    register(EpochError, RAFTSTORE_EPOCH_NOT_MATCH)
+    register(KeyNotInRegionError, RAFTSTORE_KEY_NOT_IN_REGION)
+    register(KeyIsLockedError, STORAGE_KEY_IS_LOCKED)
+    register(WriteConflictError, STORAGE_WRITE_CONFLICT)
+    register(TxnLockNotFoundError, STORAGE_TXN_LOCK_NOT_FOUND)
+    register(AlreadyExistsError, STORAGE_ALREADY_EXISTS)
+    register(CommitTsExpiredError, STORAGE_COMMIT_EXPIRED)
+    register(PessimisticLockNotFoundError, STORAGE_PESSIMISTIC_LOCK_NOT_FOUND)
+    register(DeadlockError, STORAGE_DEADLOCK)
+    register(PluginError, COPR_PLUGIN)
+    register(FailpointError, ENGINE_FAILPOINT)
+    register(CloudError, CLOUD_IO)

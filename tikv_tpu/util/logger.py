@@ -1,0 +1,110 @@
+"""Structured logging with user-data redaction.
+
+Re-expression of ``log_wrappers/src/lib.rs`` + the TiKV log format RFC
+(``components/tikv_util/src/logger``): log lines are
+``[time] [LEVEL] [module] [event] [k=v] ...`` and **user keys/values never
+reach the log verbatim unless the operator opts in**:
+
+* redaction ON  → every key/value logged through ``key()``/``value()``
+  prints as ``?``
+* redaction "marker" → wrapped as ``‹hex›`` so support bundles can strip
+  them later (lib.rs ``REDACT_INFO_LOG`` tri-state)
+* redaction OFF → hex of the raw bytes (still never raw control bytes)
+
+Use ``get_logger(module)`` and pass pre-wrapped values; plain fields are the
+caller's responsibility to keep free of user data.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+_redact = "off"  # "on" | "off" | "marker"
+_mu = threading.Lock()
+
+
+def set_redact_info_log(mode) -> None:
+    """True/'on', False/'off', or 'marker'."""
+    global _redact
+    if mode is True:
+        mode = "on"
+    elif mode is False:
+        mode = "off"
+    if mode not in ("on", "off", "marker"):
+        raise ValueError(f"bad redact mode {mode!r}")
+    with _mu:
+        _redact = mode
+
+
+def redact_mode() -> str:
+    return _redact
+
+
+def key(k: bytes) -> str:
+    """Render a user key for logging, honoring the redaction mode."""
+    if _redact == "on":
+        return "?"
+    h = bytes(k).hex().upper()
+    if _redact == "marker":
+        return f"‹{h}›"  # ‹…›
+    return h
+
+
+value = key  # user values redact identically
+
+
+class _Formatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        t = time.strftime("%Y/%m/%d %H:%M:%S", time.localtime(record.created))
+        ms = int(record.msecs)
+        fields = getattr(record, "kv", None) or {}
+        tail = "".join(f" [{k}={v}]" for k, v in fields.items())
+        return (
+            f"[{t}.{ms:03d}] [{record.levelname}] [{record.name}] "
+            f"[{record.getMessage()}]{tail}"
+        )
+
+
+_configured = False
+
+
+def get_logger(module: str) -> "StructuredLogger":
+    global _configured
+    with _mu:
+        if not _configured:
+            root = logging.getLogger("tikv_tpu")
+            handler = logging.StreamHandler()
+            handler.setFormatter(_Formatter())
+            root.addHandler(handler)
+            root.setLevel(logging.INFO)
+            root.propagate = False
+            _configured = True
+    return StructuredLogger(logging.getLogger(f"tikv_tpu.{module}"))
+
+
+class StructuredLogger:
+    """``log.info("applied snapshot", region=2, key=key(k))`` →
+    ``[...] [INFO] [tikv_tpu.raftstore] [applied snapshot] [region=2] [key=?]``"""
+
+    __slots__ = ("_log",)
+
+    def __init__(self, log: logging.Logger):
+        self._log = log
+
+    def _emit(self, level: int, event: str, kv: dict) -> None:
+        if self._log.isEnabledFor(level):
+            self._log.log(level, event, extra={"kv": kv})
+
+    def debug(self, event: str, **kv) -> None:
+        self._emit(logging.DEBUG, event, kv)
+
+    def info(self, event: str, **kv) -> None:
+        self._emit(logging.INFO, event, kv)
+
+    def warn(self, event: str, **kv) -> None:
+        self._emit(logging.WARNING, event, kv)
+
+    def error(self, event: str, **kv) -> None:
+        self._emit(logging.ERROR, event, kv)
