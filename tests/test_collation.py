@@ -41,7 +41,7 @@ def test_collator_lookup_by_tidb_id():
     assert get_collator(-45).name == "utf8mb4_general_ci"
     assert get_collator(63).name == "binary"
     with pytest.raises(ValueError):
-        get_collator("utf8mb4_unicode_ci")
+        get_collator("latin1_swedish_ci")
     with pytest.raises(ValueError):
         get_collator(999)
 
@@ -98,3 +98,51 @@ def test_like_ci_folds_unicode():
     cols = {0: (vals, np.zeros(3, dtype=bool))}
     d, _ = _run(call("like_ci", col(0), const_bytes("ä%".encode())), cols, 3)
     assert list(d) == [1, 1, 0]
+
+
+# --------------------------------------------------------- utf8mb4_unicode_ci
+
+def test_unicode_ci_case_insensitive():
+    c = get_collator("utf8mb4_unicode_ci")
+    assert c.eq("Hello".encode(), "hELLO".encode())
+    assert c.compare("abc".encode(), "ABD".encode()) < 0
+
+
+def test_unicode_ci_accent_insensitive():
+    c = get_collator("utf8mb4_unicode_ci")
+    assert c.eq("café".encode(), "cafe".encode())
+    assert c.eq("Ére".encode(), "ere".encode())
+    # general_ci does NOT fold accents the same way (é keeps its codepoint)
+    g = get_collator("utf8mb4_general_ci")
+    assert not g.eq("café".encode(), "cafe".encode())
+
+
+def test_unicode_ci_expansions():
+    c = get_collator("utf8mb4_unicode_ci")
+    assert c.eq("straße".encode(), "STRASSE".encode())  # ß → ss
+    assert c.eq("ﬁne".encode(), "fine".encode())  # ﬁ ligature → fi
+
+
+def test_unicode_ci_supplementary_collapses():
+    c = get_collator("utf8mb4_unicode_ci")
+    assert c.eq("😀".encode(), "😂".encode())  # both weigh 0xFFFD
+
+
+def test_unicode_ci_pad_space():
+    c = get_collator("utf8mb4_unicode_ci")
+    assert c.eq(b"abc  ", b"ABC")
+
+
+def test_unicode_ci_by_tidb_id():
+    assert get_collator(224).name == "utf8mb4_unicode_ci"
+    assert get_collator(-224).name == "utf8mb4_unicode_ci"
+
+
+def test_unicode_ci_sort_key_orders():
+    c = get_collator("utf8mb4_unicode_ci")
+    words = [w.encode() for w in ["Zebra", "åpple", "Apple", "banana", "ÉCLAIR"]]
+    got = sorted(words, key=c.sort_key)
+    # primary weights: apple==åpple group first (stable), then banana, eclair, zebra
+    folded = [w.decode().lower() for w in got]
+    assert folded[-1] == "zebra"
+    assert set(folded[:2]) == {"åpple", "apple"}

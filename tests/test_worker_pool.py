@@ -1,0 +1,271 @@
+"""Worker framework, unified read pool, and profiling surface.
+
+Mirrors tikv_util/src/worker tests (schedule-before-start, stop drains) and
+the yatp multilevel behavior the unified read pool exists for (heavy groups
+demote, light traffic keeps low latency).
+"""
+
+import threading
+import time
+import urllib.request
+
+from tikv_tpu.server.status_server import StatusServer
+from tikv_tpu.util.worker import (
+    Runnable,
+    TaskPriority,
+    UnifiedReadPool,
+    Worker,
+)
+
+
+class _Collect(Runnable):
+    def __init__(self):
+        self.seen = []
+        self.ticks = 0
+        self.shut = False
+
+    def run(self, task):
+        self.seen.append(task)
+
+    def on_timeout(self):
+        self.ticks += 1
+
+    def shutdown(self):
+        self.shut = True
+
+
+def test_worker_schedules_and_drains_on_stop():
+    r = _Collect()
+    w = Worker("test-worker")
+    assert w.schedule("before-start")  # buffered
+    w.start(r)
+    for i in range(10):
+        w.schedule(i)
+    w.stop()
+    assert r.seen[0] == "before-start"
+    assert r.seen[1:] == list(range(10))
+    assert r.shut
+    assert w.handled == 11
+
+
+def test_worker_rejects_after_stop():
+    w = Worker("t2")
+    w.start(_Collect())
+    w.stop()
+    assert not w.schedule("late")
+
+
+def test_worker_timer_ticks():
+    r = _Collect()
+    w = Worker("t3", timer_interval=0.05)
+    w.start(r)
+    time.sleep(0.3)
+    w.stop()
+    assert r.ticks >= 2
+
+
+def test_worker_survives_task_exception():
+    class Boom(Runnable):
+        def __init__(self):
+            self.ok = 0
+
+        def run(self, task):
+            if task == "boom":
+                raise RuntimeError("x")
+            self.ok += 1
+
+    r = Boom()
+    w = Worker("t4")
+    w.start(r)
+    w.schedule("boom")
+    w.schedule("fine")
+    w.stop()
+    assert r.ok == 1
+
+
+# ---------------------------------------------------------------- read pool
+
+def test_read_pool_basic_result_and_error():
+    pool = UnifiedReadPool(workers=2)
+    try:
+        assert pool.submit(lambda a, b: a + b, 2, 3).result(5) == 5
+        fut = pool.submit(lambda: 1 / 0)
+        try:
+            fut.result(5)
+            raise AssertionError("expected ZeroDivisionError")
+        except ZeroDivisionError:
+            pass
+    finally:
+        pool.stop()
+
+
+def test_read_pool_demotes_heavy_groups():
+    pool = UnifiedReadPool(workers=1)
+    try:
+        # burn >100ms of accounted time in one group
+        for _ in range(3):
+            pool.submit(time.sleep, 0.06, group="heavy").result(5)
+        assert pool.level_of("heavy") == 2
+        assert pool.level_of("light") == 0
+        # a new task from the heavy group enqueues at L2, light at L0
+        ev = threading.Event()
+        pool.submit(ev.wait, 0.2, group="heavy")
+        depths_before = pool.queue_depths()
+        ev.set()
+        assert depths_before[0] == 0
+    finally:
+        pool.stop()
+
+
+def test_read_pool_high_priority_pins_l0():
+    pool = UnifiedReadPool(workers=1)
+    try:
+        for _ in range(3):
+            pool.submit(time.sleep, 0.06, group="vip").result(5)
+        assert pool.level_of("vip") == 2
+        # HIGH priority ignores the group's level
+        block = threading.Event()
+        release = threading.Event()
+
+        def gate():
+            block.set()
+            release.wait(5)
+
+        pool.submit(gate)  # occupy the single worker
+        block.wait(5)
+        pool.submit(lambda: "hi", group="vip", priority=TaskPriority.HIGH)
+        assert pool.queue_depths()[0] == 1  # sits in L0, not L2
+        release.set()
+    finally:
+        pool.stop()
+
+
+def test_read_pool_starvation_freedom():
+    pool = UnifiedReadPool(workers=1)
+    try:
+        for _ in range(3):
+            pool.submit(time.sleep, 0.06, group="bg").result(5)
+        # L2 work still completes while L0 is busy
+        results = [pool.submit(lambda i=i: i, group="bg") for i in range(5)]
+        for _ in range(20):
+            pool.submit(lambda: None).result(5)
+        assert [f.result(5) for f in results] == list(range(5))
+    finally:
+        pool.stop()
+
+
+# ----------------------------------------------------------------- profiler
+
+def test_pprof_endpoints():
+    srv = StatusServer()
+    srv.start()
+    host, port = srv.addr
+    try:
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/debug/pprof/profile?seconds=0.1"
+        ) as r:
+            body = r.read()
+        assert b"cumulative" in body  # pstats table header
+
+        with urllib.request.urlopen(f"http://{host}:{port}/debug/pprof/heap?top=5") as r:
+            heap = r.read()
+        assert heap.startswith(b"heap profile:")
+    finally:
+        srv.stop()
+
+
+def test_pprof_raw_is_loadable_pstats():
+    import marshal
+    import pstats
+    import io
+
+    from tikv_tpu.server.profiler import Profiler
+
+    raw = Profiler().cpu_profile(seconds=0.05, raw=True)
+    stats = marshal.loads(raw)
+    assert isinstance(stats, dict)
+
+
+def test_worker_ticks_under_continuous_load():
+    """The periodic tick must fire even when the queue never drains."""
+    r = _Collect()
+    w = Worker("busy", timer_interval=0.05)
+    w.start(r)
+    stop = threading.Event()
+
+    def feed():
+        while not stop.is_set():
+            w.schedule("x")
+            time.sleep(0.002)
+
+    t = threading.Thread(target=feed, daemon=True)
+    t.start()
+    time.sleep(0.4)
+    stop.set()
+    t.join()
+    w.stop()
+    assert r.ticks >= 3
+
+
+def test_group_eviction_keeps_active_groups():
+    pool = UnifiedReadPool(workers=1)
+    try:
+        for _ in range(3):
+            pool.submit(time.sleep, 0.06, group="hot").result(5)
+        assert pool.level_of("hot") == 2
+        # flood with one-shot groups to cross the 4096 bound
+        for i in range(4200):
+            pool.submit(lambda: None, group=f"g{i}").result(5)
+        # the recently-active heavy group survived eviction
+        assert pool.level_of("hot") == 2
+    finally:
+        pool.stop()
+
+
+def test_malformed_context_does_not_kill_connection():
+    from tikv_tpu.server.server import Client, Server
+
+    class Svc:
+        def dispatch(self, method, request):
+            return {"m": method}
+
+    srv = Server(Svc())
+    srv.start()
+    try:
+        cli = Client(*srv.addr)
+        # truthy non-dict context on a read method
+        assert cli.call("kv_get", {"context": [1], "key": b"k"})["m"] == "kv_get"
+        # connection still alive afterwards
+        assert cli.call("kv_get", {"key": b"k"})["m"] == "kv_get"
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_pprof_bad_params_return_400():
+    srv = StatusServer()
+    srv.start()
+    host, port = srv.addr
+    try:
+        import urllib.error
+
+        for path in ("/debug/pprof/profile?seconds=abc", "/debug/pprof/heap?top=x"):
+            try:
+                urllib.request.urlopen(f"http://{host}:{port}{path}")
+                raise AssertionError("expected HTTP 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+    finally:
+        srv.stop()
+
+
+def test_read_pool_lazy_creation():
+    from tikv_tpu.server.server import Server
+
+    class Svc:
+        def dispatch(self, method, request):
+            return {}
+
+    srv = Server(Svc())
+    assert srv._read_pool is None  # no read dispatched yet, no threads
+    srv.stop()

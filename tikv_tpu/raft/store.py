@@ -24,9 +24,9 @@ from ..util import codec, keys
 from ..util import logger as slog
 from .core import Entry, Message, MsgType, RaftNode, Role
 from .core import Snapshot as RaftSnapshot
+from .region import EpochError, KeyNotInRegionError, NotLeaderError, Peer as RegionPeer, Region, RegionEpoch
 
 _LOG = slog.get_logger("raftstore")
-from .region import EpochError, KeyNotInRegionError, NotLeaderError, Peer as RegionPeer, Region, RegionEpoch
 
 DATA_CFS = (CF_DEFAULT, CF_LOCK, CF_WRITE)
 

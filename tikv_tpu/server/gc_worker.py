@@ -14,13 +14,13 @@ from __future__ import annotations
 
 import threading
 
-from ..util import logger as slog
-
-_LOG = slog.get_logger("gc_worker")
-
 from ..storage.engine import CF_DEFAULT, CF_LOCK, CF_WRITE, WriteBatch
 from ..storage.kv import Engine
 from ..storage.txn_types import Key, Write, WriteType, append_ts, split_ts
+
+from ..util import logger as slog
+
+_LOG = slog.get_logger("gc_worker")
 
 
 class GcWorker:

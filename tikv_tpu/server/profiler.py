@@ -1,0 +1,75 @@
+"""Profiling surface: CPU profiles and heap snapshots on demand.
+
+Re-expression of the reference's pprof endpoints
+(``src/server/status_server/profile.rs`` — /debug/pprof/profile samples CPU
+for ``seconds`` and streams a report; /debug/pprof/heap dumps allocator
+stats).  The tpu-native equivalents build on the runtimes we actually have:
+
+* CPU: ``cProfile`` across all request handling for the window, rendered as
+  the classic cumulative-time table (callgrind/flamegraph-ready raw stats
+  available via ``pstats``-format bytes).
+* Heap: ``tracemalloc`` top allocation sites grouped by file:line.
+
+Both are pull-based and allocation-free when idle — profiling only costs
+while a request is in flight, matching the reference's activate/deactivate
+window model.
+"""
+
+from __future__ import annotations
+
+import cProfile
+import io
+import marshal
+import pstats
+import threading
+import time
+import tracemalloc
+
+
+class Profiler:
+    _mu = threading.Lock()  # one profile window at a time, process-wide
+
+    def cpu_profile(self, seconds: float = 1.0, sort: str = "cumulative", raw: bool = False) -> bytes:
+        """Sample CPU for ``seconds`` and return a report.
+
+        ``raw=True`` returns marshalled pstats (loadable by
+        ``pstats.Stats``/snakeviz); otherwise a text table.
+        """
+        if not Profiler._mu.acquire(blocking=False):
+            raise RuntimeError("another profile window is active")
+        try:
+            prof = cProfile.Profile()
+            prof.enable()
+            time.sleep(max(0.0, seconds))
+            prof.disable()
+            if raw:
+                prof.snapshot_stats()
+                return marshal.dumps(prof.stats)
+            out = io.StringIO()
+            pstats.Stats(prof, stream=out).sort_stats(sort).print_stats(50)
+            return out.getvalue().encode()
+        finally:
+            Profiler._mu.release()
+
+    def heap_profile(self, top: int = 50) -> bytes:
+        """Top allocation sites by live bytes (tracemalloc window)."""
+        started_here = not tracemalloc.is_tracing()
+        if started_here:
+            tracemalloc.start()
+            # let in-flight work allocate so the snapshot isn't empty
+            time.sleep(0.1)
+        try:
+            snap = tracemalloc.take_snapshot()
+        finally:
+            if started_here:
+                tracemalloc.stop()
+        lines = []
+        total = 0
+        for stat in snap.statistics("lineno")[:top]:
+            frame = stat.traceback[0]
+            lines.append(
+                f"{stat.size:>12d} B {stat.count:>8d} objs  {frame.filename}:{frame.lineno}"
+            )
+            total += stat.size
+        header = f"heap profile: top {len(lines)} sites, {total} B shown\n"
+        return (header + "\n".join(lines) + "\n").encode()

@@ -15,6 +15,7 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 
 from ..util import error_code
+from ..util.worker import TaskPriority, UnifiedReadPool
 from . import wire
 from .service import KvService
 
@@ -22,6 +23,16 @@ error_code.register_builtin()
 
 _LEN = struct.Struct(">I")
 _MAX_FRAME = 64 << 20
+
+# read-path RPCs go through the unified read pool (src/read_pool.rs routes
+# point gets / scans / coprocessor there); writes keep the plain executor so
+# a saturated analytical workload can't starve the write path's threads
+_READ_METHODS = (
+    "kv_get", "kv_batch_get", "kv_scan", "kv_scan_lock",
+    "raw_get", "raw_batch_get", "raw_scan", "raw_batch_scan", "raw_get_key_ttl",
+    "coprocessor", "coprocessor_stream", "raw_coprocessor",
+    "mvcc_get_by_key", "mvcc_get_by_start_ts",
+)
 
 
 def _read_exact(sock: socket.socket, n: int) -> bytes | None:
@@ -56,6 +67,7 @@ class Server:
         port: int = 0,
         workers: int = 8,
         security=None,
+        read_pool_workers: int | None = None,  # ReadPoolConfig.unified_max_threads
     ):
         self.service = service
         self.security = security
@@ -63,8 +75,22 @@ class Server:
         self._sock = socket.create_server((host, port))
         self.addr = self._sock.getsockname()
         self._pool = ThreadPoolExecutor(max_workers=workers)
+        # created lazily on the first read-method dispatch: PD / raft-only
+        # servers never pay for read-pool threads
+        self._read_pool: UnifiedReadPool | None = None
+        self._read_pool_workers = read_pool_workers or workers
+        self._read_pool_mu = threading.Lock()
         self._stop = threading.Event()
         self._accept_thread: threading.Thread | None = None
+
+    @property
+    def read_pool(self) -> UnifiedReadPool:
+        with self._read_pool_mu:
+            if self._read_pool is None:
+                self._read_pool = UnifiedReadPool(
+                    workers=self._read_pool_workers, name="unified-read-pool"
+                )
+            return self._read_pool
 
     def start(self) -> None:
         self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
@@ -124,7 +150,24 @@ class Server:
                         except OSError:
                             pass
 
-                self._pool.submit(run)
+                if method in _READ_METHODS:
+                    ctx, group = {}, id(conn)
+                    if isinstance(request, dict):
+                        c = request.get("context")
+                        ctx = c if isinstance(c, dict) else {}
+                        # group by caller txn (start_ts); falls back per-conn
+                        group = ctx.get("resource_group") or request.get("start_ts") or id(conn)
+                    prio = (
+                        TaskPriority.HIGH
+                        if ctx.get("priority") == "high"
+                        else TaskPriority.NORMAL
+                    )
+                    try:
+                        self.read_pool.submit(run, group=group, priority=prio)
+                    except RuntimeError:  # pool stopped mid-shutdown
+                        self._pool.submit(run)
+                else:
+                    self._pool.submit(run)
         except (ConnectionError, ValueError, OSError):
             pass
         finally:
@@ -134,6 +177,9 @@ class Server:
         self._stop.set()
         self._sock.close()
         self._pool.shutdown(wait=False)
+        with self._read_pool_mu:
+            if self._read_pool is not None:
+                self._read_pool.stop()
 
 
 class Client:

@@ -1,8 +1,10 @@
-"""HTTP status server: /metrics, /status, /config (GET + POST reconfig).
+"""HTTP status server: /metrics, /status, /config, /debug/pprof/*.
 
 Re-expression of ``src/server/status_server/mod.rs:720-745``: the operator
-surface — Prometheus exposition, liveness, config inspection, and online
-reconfiguration via POST /config dispatched through the ConfigController.
+surface — Prometheus exposition, liveness, config inspection, online
+reconfiguration via POST /config dispatched through the ConfigController,
+and the profiling endpoints (profile.rs): GET /debug/pprof/profile?seconds=N
+(CPU) and GET /debug/pprof/heap (allocation sites).
 """
 
 from __future__ import annotations
@@ -10,15 +12,18 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 
 from ..util.metrics import REGISTRY
 from ..util.config import ConfigController
+from .profiler import Profiler
 
 
 class StatusServer:
     def __init__(self, controller: ConfigController | None = None, host="127.0.0.1", port=0, registry=None):
         self.controller = controller
         self.registry = registry or REGISTRY
+        self.profiler = Profiler()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -33,13 +38,37 @@ class StatusServer:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/metrics":
+                url = urlparse(self.path)
+                if url.path == "/metrics":
                     self._send(200, outer.registry.render().encode())
-                elif self.path == "/status":
+                elif url.path == "/status":
                     self._send(200, b"ok")
-                elif self.path == "/config":
+                elif url.path == "/config":
                     cfg = outer.controller.config.to_dict() if outer.controller else {}
                     self._send(200, json.dumps(cfg).encode(), "application/json")
+                elif url.path == "/debug/pprof/profile":
+                    q = parse_qs(url.query)
+                    try:
+                        seconds = float(q.get("seconds", ["1"])[0])
+                    except ValueError:
+                        self._send(400, b"seconds must be a number")
+                        return
+                    raw = q.get("raw", ["0"])[0] == "1"
+                    try:
+                        body = outer.profiler.cpu_profile(min(seconds, 60.0), raw=raw)
+                    except RuntimeError as e:
+                        self._send(429, str(e).encode())
+                        return
+                    ctype = "application/octet-stream" if raw else "text/plain"
+                    self._send(200, body, ctype)
+                elif url.path == "/debug/pprof/heap":
+                    q = parse_qs(url.query)
+                    try:
+                        top = int(q.get("top", ["50"])[0])
+                    except ValueError:
+                        self._send(400, b"top must be an integer")
+                        return
+                    self._send(200, outer.profiler.heap_profile(top=top))
                 else:
                     self._send(404, b"not found")
 

@@ -70,8 +70,12 @@ class _Formatter(logging.Formatter):
 _configured = False
 
 
-def get_logger(module: str) -> "StructuredLogger":
+def _ensure_configured() -> None:
+    """Install the handler lazily on first *emit*, never at import time —
+    get_logger at module scope must stay side-effect free for embedders."""
     global _configured
+    if _configured:
+        return
     with _mu:
         if not _configured:
             root = logging.getLogger("tikv_tpu")
@@ -81,6 +85,9 @@ def get_logger(module: str) -> "StructuredLogger":
             root.setLevel(logging.INFO)
             root.propagate = False
             _configured = True
+
+
+def get_logger(module: str) -> "StructuredLogger":
     return StructuredLogger(logging.getLogger(f"tikv_tpu.{module}"))
 
 
@@ -94,6 +101,7 @@ class StructuredLogger:
         self._log = log
 
     def _emit(self, level: int, event: str, kv: dict) -> None:
+        _ensure_configured()
         if self._log.isEnabledFor(level):
             self._log.log(level, event, extra={"kv": kv})
 
