@@ -337,3 +337,118 @@ def test_raft_store_recovery_across_process_boundary(tmp_path):
     ctx = {"region_id": FIRST_REGION_ID}
     for i in range(20):
         assert storage.get(b"rk-%04d" % i, 10_000, ctx) == b"rv-%d" % i, i
+
+
+# ------------------------------------------------------- compaction + props
+
+def test_compaction_erases_tombstoned_keys():
+    from tikv_tpu.storage.engine import WriteBatch
+
+    e = NativeEngine()
+    wb = WriteBatch()
+    for i in range(200):
+        wb.put_cf("default", b"k%04d" % i, b"v" * 32)
+    e.write(wb)
+    wb = WriteBatch()
+    for i in range(120):
+        wb.delete_cf("default", b"k%04d" % i)
+    e.write(wb)
+    mem_before = e.mem_bytes()
+    dropped = e.compact(slice_keys=16)  # force many slices
+    assert dropped >= 120
+    assert e._lib.eng_stats_keys(e._handle, 0) == 80
+    assert e.mem_bytes() < mem_before
+    # survivors still readable
+    snap = e.snapshot()
+    assert snap.get_cf("default", b"k0150") == b"v" * 32
+    assert snap.get_cf("default", b"k0000") is None
+    snap.release()
+    e.close()
+
+
+def test_compaction_respects_live_snapshots():
+    from tikv_tpu.storage.engine import WriteBatch
+
+    e = NativeEngine()
+    wb = WriteBatch()
+    wb.put_cf("default", b"a", b"old")
+    e.write(wb)
+    snap = e.snapshot()  # pins the pre-delete state
+    wb = WriteBatch()
+    wb.delete_cf("default", b"a")
+    e.write(wb)
+    e.compact()
+    # the old snapshot still sees the value — compaction must not erase it
+    assert snap.get_cf("default", b"a") == b"old"
+    snap.release()
+    # once the snapshot is gone, compaction erases the key
+    e.compact()
+    assert e._lib.eng_stats_keys(e._handle, 0) == 0
+    e.close()
+
+
+def test_auto_compaction_thread():
+    from tikv_tpu.storage.engine import WriteBatch
+
+    e = NativeEngine()
+    wb = WriteBatch()
+    wb.put_cf("default", b"x", b"1")
+    wb.delete_cf("default", b"x")
+    e.write(wb)
+    e.start_auto_compaction(interval_s=0.05)
+    deadline = time.time() + 5
+    while time.time() < deadline and e._lib.eng_stats_keys(e._handle, 0):
+        time.sleep(0.05)
+    assert e._lib.eng_stats_keys(e._handle, 0) == 0
+    e.stop_auto_compaction()
+    e.close()
+
+
+def test_mvcc_properties_drive_need_gc():
+    from tikv_tpu.storage.engine import WriteBatch
+    from tikv_tpu.storage.txn_types import Write, WriteType, append_ts
+
+    e = NativeEngine()
+    wb = WriteBatch()
+    # 10 rows x 3 versions, newest is a DELETE for half of them
+    for i in range(10):
+        user = b"row%02d" % i
+        for ts in (10, 20, 30):
+            wt = WriteType.DELETE if (ts == 30 and i % 2 == 0) else WriteType.PUT
+            wb.put_cf("write", append_ts(user, ts), Write(wt, ts - 1).to_bytes())
+    e.write(wb)
+    p = e.mvcc_properties()
+    assert p["num_rows"] == 10
+    assert p["num_entries"] == 30
+    assert p["num_deletes"] == 5
+    assert p["num_puts"] == 25
+    assert (p["min_commit_ts"], p["max_commit_ts"]) == (10, 30)
+    assert p["max_row_versions"] == 3
+    assert e.need_gc(safe_point=35)
+    # nothing visible below the safe point → no GC needed
+    assert not e.need_gc(safe_point=5)
+    e.close()
+
+
+def test_durability_survives_compaction():
+    from tikv_tpu.storage.engine import WriteBatch
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        e = NativeEngine(path=d)
+        wb = WriteBatch()
+        wb.put_cf("default", b"keep", b"1")
+        wb.put_cf("default", b"gone", b"2")
+        e.write(wb)
+        wb = WriteBatch()
+        wb.delete_cf("default", b"gone")
+        e.write(wb)
+        e.compact()
+        e.close()
+        e2 = NativeEngine(path=d)
+        snap = e2.snapshot()
+        assert snap.get_cf("default", b"keep") == b"1"
+        assert snap.get_cf("default", b"gone") is None
+        snap.release()
+        e2.close()

@@ -160,30 +160,77 @@ def test_pprof_endpoints():
     srv = StatusServer()
     srv.start()
     host, port = srv.addr
+    # a busy sibling thread the sampler must capture
+    stop = threading.Event()
+
+    def spin():
+        while not stop.is_set():
+            sum(range(1000))
+
+    t = threading.Thread(target=spin, name="spinner", daemon=True)
+    t.start()
     try:
         with urllib.request.urlopen(
-            f"http://{host}:{port}/debug/pprof/profile?seconds=0.1"
+            f"http://{host}:{port}/debug/pprof/profile?seconds=0.3"
         ) as r:
             body = r.read()
-        assert b"cumulative" in body  # pstats table header
+        assert body.startswith(b"cpu profile:")
+        # cross-thread work appears (the whole point of the sampler)
+        assert b"spin" in body
 
         with urllib.request.urlopen(f"http://{host}:{port}/debug/pprof/heap?top=5") as r:
             heap = r.read()
         assert heap.startswith(b"heap profile:")
     finally:
+        stop.set()
+        t.join()
         srv.stop()
 
 
-def test_pprof_raw_is_loadable_pstats():
-    import marshal
-    import pstats
-    import io
-
+def test_pprof_raw_is_collapsed_stacks():
     from tikv_tpu.server.profiler import Profiler
 
-    raw = Profiler().cpu_profile(seconds=0.05, raw=True)
-    stats = marshal.loads(raw)
-    assert isinstance(stats, dict)
+    stop = threading.Event()
+
+    def spin():
+        while not stop.is_set():
+            sum(range(1000))
+
+    t = threading.Thread(target=spin, daemon=True)
+    t.start()
+    try:
+        raw = Profiler().cpu_profile(seconds=0.2, raw=True).decode()
+    finally:
+        stop.set()
+        t.join()
+    lines = [ln for ln in raw.splitlines() if ln]
+    assert lines, "no samples collected"
+    for ln in lines:
+        stack, _, count = ln.rpartition(" ")
+        assert stack and count.isdigit()
+        assert ";" in stack or ":" in stack  # frame;frame format
+
+
+def test_heap_profile_concurrent_requests():
+    from tikv_tpu.server.profiler import Profiler
+
+    p = Profiler()
+    results = []
+    errors = []
+
+    def grab():
+        try:
+            results.append(p.heap_profile(top=5))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=grab) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(results) == 4
 
 
 def test_worker_ticks_under_continuous_load():

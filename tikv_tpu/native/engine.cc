@@ -730,4 +730,138 @@ uint64_t eng_stats_keys(void* h, int cf) {
   return e->cfs[cf].size();
 }
 
+// --- compaction -------------------------------------------------------------
+//
+// The write path only trims a key's version chain when that key is written
+// again; deleted-and-never-touched keys would otherwise hold a tombstone
+// forever (rocksdb removes them in background compaction).  One compaction
+// step walks at most max_keys keys of one CF under the write lock, drops
+// versions no live snapshot can see, and physically erases keys whose
+// newest reachable state is a tombstone.  The caller (a Python driver
+// thread — the GIL is released during the call, so it is genuinely
+// background work) resumes from *resume to bound write-lock hold times,
+// exactly the slice-by-slice shape of rocksdb's per-file compactions.
+//
+// Returns versions dropped (erased keys count their whole chain); sets
+// *done=1 when the CF is exhausted, else *resume/*resume_len (caller
+// eng_free) is the key to continue from.
+long eng_compact_step(void* h, int cf, const uint8_t* from, uint64_t from_len,
+                      uint64_t max_keys, uint8_t** resume,
+                      uint64_t* resume_len, int* done) {
+  Engine* e = static_cast<Engine*>(h);
+  if (cf < 0 || cf >= kNumCfs) return -2;
+  std::unique_lock lk(e->mu);
+  Table& t = e->cfs[cf];
+  uint64_t min_snap = std::min(e->min_live_snapshot(), e->seq);
+  long dropped = 0;
+  uint64_t seen = 0;
+  auto it = t.lower_bound(std::string(reinterpret_cast<const char*>(from), from_len));
+  while (it != t.end() && seen < max_keys) {
+    Chain& chain = it->second;
+    // trim: keep versions newer than min_snap plus the newest one <= min_snap
+    size_t keep = chain.size();
+    for (size_t i = 0; i < chain.size(); i++) {
+      if (chain[i].seq <= min_snap) {
+        keep = i + 1;
+        break;
+      }
+    }
+    for (size_t i = keep; i < chain.size(); i++) {
+      e->mem_bytes -= std::min(e->mem_bytes,
+                               chain[i].value.size() + kVersionOverhead);
+      dropped++;
+    }
+    chain.resize(keep);
+    // erase: the newest version overall is a tombstone no snapshot can miss
+    if (!chain.empty() && chain.front().tombstone &&
+        chain.front().seq <= min_snap) {
+      dropped += static_cast<long>(chain.size());
+      uint64_t key_cost = it->first.size() + kKeyOverhead;
+      for (const auto& v : chain)
+        key_cost += v.value.size() + kVersionOverhead;
+      e->mem_bytes -= std::min(e->mem_bytes, key_cost);
+      it = t.erase(it);
+    } else {
+      ++it;
+    }
+    seen++;
+  }
+  if (it == t.end()) {
+    *done = 1;
+  } else {
+    *done = 0;
+    *resume = static_cast<uint8_t*>(malloc(it->first.size()));
+    memcpy(*resume, it->first.data(), it->first.size());
+    *resume_len = it->first.size();
+  }
+  return dropped;
+}
+
+// --- MVCC range properties --------------------------------------------------
+//
+// The role of engine_rocks' MvccPropertiesCollector (properties.rs): cheap
+// per-range statistics that tell GC whether a sweep is worth it at all.
+// The collector knows this framework's CF_WRITE shape — keys carry an
+// 8-byte descending-encoded commit_ts suffix, values start with the write
+// type byte ('P'ut/'D'elete/'L'ock/'R'ollback).
+//
+// out[0]=num_entries  out[1]=num_rows (distinct user keys)
+// out[2]=num_puts     out[3]=num_deletes
+// out[4]=num_locks_rollbacks       out[5]=min_commit_ts  out[6]=max_commit_ts
+// out[7]=max_row_versions (worst per-key version count)
+int eng_mvcc_props(void* h, int cf, const uint8_t* start, uint64_t start_len,
+                   const uint8_t* end_key, uint64_t end_len, int has_end,
+                   uint64_t snap_seq, uint64_t* out) {
+  Engine* e = static_cast<Engine*>(h);
+  if (cf < 0 || cf >= kNumCfs) return -2;
+  std::shared_lock lk(e->mu);
+  const Table& t = e->cfs[cf];
+  std::string s(reinterpret_cast<const char*>(start), start_len);
+  std::string en(reinterpret_cast<const char*>(end_key), end_len);
+  uint64_t entries = 0, rows = 0, puts = 0, dels = 0, other = 0;
+  uint64_t min_ts = UINT64_MAX, max_ts = 0, max_row = 0, cur_row = 0;
+  std::string cur_user;
+  bool have_user = false;
+  auto it = t.lower_bound(s);
+  auto stop = has_end ? t.lower_bound(en) : t.end();
+  for (; it != stop; ++it) {
+    const std::string* v = resolve(it->second, snap_seq);
+    if (v == nullptr) continue;
+    entries++;
+    const std::string& k = it->first;
+    if (k.size() >= 8) {
+      // commit_ts rides the last 8 key bytes, bit-inverted big-endian
+      uint64_t ts = 0;
+      for (int i = 0; i < 8; i++)
+        ts = (ts << 8) | static_cast<uint8_t>(~k[k.size() - 8 + i]);
+      if (ts < min_ts) min_ts = ts;
+      if (ts > max_ts) max_ts = ts;
+      std::string user = k.substr(0, k.size() - 8);
+      if (!have_user || user != cur_user) {
+        rows++;
+        cur_user = std::move(user);
+        have_user = true;
+        cur_row = 0;
+      }
+      cur_row++;
+      if (cur_row > max_row) max_row = cur_row;
+    }
+    if (!v->empty()) {
+      char wt = (*v)[0];
+      if (wt == 'P') puts++;
+      else if (wt == 'D') dels++;
+      else other++;
+    }
+  }
+  out[0] = entries;
+  out[1] = rows;
+  out[2] = puts;
+  out[3] = dels;
+  out[4] = other;
+  out[5] = min_ts == UINT64_MAX ? 0 : min_ts;
+  out[6] = max_ts;
+  out[7] = max_row;
+  return 0;
+}
+
 }  // extern "C"

@@ -116,6 +116,18 @@ def _load():
         for fn in (lib.eng_seq, lib.eng_mem_bytes, lib.eng_wal_bytes):
             fn.argtypes = [ctypes.c_void_p]
             fn.restype = ctypes.c_uint64
+        lib.eng_compact_step.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.eng_compact_step.restype = ctypes.c_long
+        lib.eng_mvcc_props.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.eng_mvcc_props.restype = ctypes.c_int
         _lib = lib
         return _lib
 
@@ -341,7 +353,107 @@ class NativeEngine(KvEngine):
     def wal_bytes(self) -> int:
         return self._lib.eng_wal_bytes(self._handle)
 
+    # -- compaction ---------------------------------------------------------
+
+    def compact_cf(self, cf: str, slice_keys: int = 4096) -> int:
+        """One full compaction pass over a CF in bounded slices; returns
+        versions dropped.  Each slice holds the engine's write lock for at
+        most ``slice_keys`` keys, so reads/writes interleave between slices
+        (the rocksdb background-compaction property, with the scheduling
+        living here and the work in native code — ctypes releases the GIL
+        for the duration of each step)."""
+        import ctypes
+
+        total = 0
+        cursor = b""
+        while True:
+            resume = ctypes.POINTER(ctypes.c_uint8)()
+            resume_len = ctypes.c_uint64(0)
+            done = ctypes.c_int(0)
+            r = self._lib.eng_compact_step(
+                self._handle, _CF_IDS[cf], cursor, len(cursor), slice_keys,
+                ctypes.byref(resume), ctypes.byref(resume_len), ctypes.byref(done),
+            )
+            if r < 0:
+                raise RuntimeError(f"eng_compact_step failed: {r}")
+            total += r
+            if done.value:
+                return total
+            cursor = _take(self._lib, resume, resume_len.value)
+
+    def compact(self, slice_keys: int = 4096) -> int:
+        """Compact every CF; returns total versions dropped."""
+        return sum(self.compact_cf(cf, slice_keys) for cf in _CF_IDS)
+
+    def start_auto_compaction(self, interval_s: float = 10.0) -> None:
+        """Background compaction loop (rocksdb's background job threads)."""
+        import threading
+
+        if getattr(self, "_compactor", None) is not None:
+            return
+        self._compact_stop = threading.Event()
+
+        def loop():
+            while not self._compact_stop.wait(interval_s):
+                try:
+                    self.compact()
+                except RuntimeError:
+                    return
+
+        self._compactor = threading.Thread(
+            target=loop, name="native-compaction", daemon=True
+        )
+        self._compactor.start()
+
+    def stop_auto_compaction(self) -> None:
+        if getattr(self, "_compactor", None) is not None:
+            self._compact_stop.set()
+            self._compactor.join(timeout=5.0)
+            self._compactor = None
+
+    # -- MVCC properties ----------------------------------------------------
+
+    def mvcc_properties(self, start: bytes = b"", end: bytes | None = None,
+                        cf: str = "write") -> dict:
+        """Range statistics steering GC (engine_rocks properties.rs
+        MvccProperties): whether a sweep over this range can collect
+        anything at all."""
+        import ctypes
+
+        out = (ctypes.c_uint64 * 8)()
+        r = self._lib.eng_mvcc_props(
+            self._handle, _CF_IDS[cf], start, len(start),
+            end or b"", len(end or b""), 0 if end is None else 1,
+            self.seq(), out,
+        )
+        if r != 0:
+            raise RuntimeError(f"eng_mvcc_props failed: {r}")
+        return {
+            "num_entries": out[0],
+            "num_rows": out[1],
+            "num_puts": out[2],
+            "num_deletes": out[3],
+            "num_locks_rollbacks": out[4],
+            "min_commit_ts": out[5],
+            "max_commit_ts": out[6],
+            "max_row_versions": out[7],
+        }
+
+    def need_gc(self, safe_point: int, ratio_threshold: float = 1.1,
+                start: bytes = b"", end: bytes | None = None) -> bool:
+        """The compaction-filter gate (gc_worker check_need_gc): skip ranges
+        where versions/rows is below the threshold and nothing is deleted."""
+        p = self.mvcc_properties(start, end)
+        if p["num_rows"] == 0:
+            return False
+        if p["min_commit_ts"] > safe_point:
+            return False  # every version still visible above the safe point
+        if p["num_deletes"] > 0 or p["num_locks_rollbacks"] > 0:
+            return True
+        return p["num_entries"] >= p["num_rows"] * ratio_threshold
+
     def close(self) -> None:
+        self.stop_auto_compaction()
         if self._handle is not None:
             self._lib.eng_close(self._handle)
             self._handle = None

@@ -5,64 +5,88 @@ Re-expression of the reference's pprof endpoints
 for ``seconds`` and streams a report; /debug/pprof/heap dumps allocator
 stats).  The tpu-native equivalents build on the runtimes we actually have:
 
-* CPU: ``cProfile`` across all request handling for the window, rendered as
-  the classic cumulative-time table (callgrind/flamegraph-ready raw stats
-  available via ``pstats``-format bytes).
+* CPU: a **statistical wall-clock sampler over every thread** —
+  ``sys._current_frames()`` polled at ~100Hz, stacks aggregated like pprof's
+  sample profiles (the reference's pprof-rs works the same way via SIGPROF).
+  A deterministic tracer (cProfile) would only see the calling thread;
+  request handling lives on pool threads, so sampling is the correct shape.
 * Heap: ``tracemalloc`` top allocation sites grouped by file:line.
 
-Both are pull-based and allocation-free when idle — profiling only costs
-while a request is in flight, matching the reference's activate/deactivate
-window model.
+Both are pull-based and cost nothing while idle — profiling only runs inside
+an explicit window, matching the reference's activate/deactivate model.
 """
 
 from __future__ import annotations
 
-import cProfile
-import io
-import marshal
-import pstats
+import sys
 import threading
 import time
 import tracemalloc
+from collections import Counter
 
 
 class Profiler:
     _mu = threading.Lock()  # one profile window at a time, process-wide
 
-    def cpu_profile(self, seconds: float = 1.0, sort: str = "cumulative", raw: bool = False) -> bytes:
-        """Sample CPU for ``seconds`` and return a report.
+    def cpu_profile(self, seconds: float = 1.0, hz: int = 100, raw: bool = False) -> bytes:
+        """Sample all threads for ``seconds``; returns a report.
 
-        ``raw=True`` returns marshalled pstats (loadable by
-        ``pstats.Stats``/snakeviz); otherwise a text table.
+        ``raw=True`` returns collapsed stacks (``frame;frame;frame count``
+        per line — feed straight to a flamegraph renderer); otherwise a
+        self-time table per function.
         """
         if not Profiler._mu.acquire(blocking=False):
             raise RuntimeError("another profile window is active")
         try:
-            prof = cProfile.Profile()
-            prof.enable()
-            time.sleep(max(0.0, seconds))
-            prof.disable()
+            me = threading.get_ident()
+            stacks: Counter = Counter()
+            leaf: Counter = Counter()
+            interval = 1.0 / max(1, hz)
+            deadline = time.monotonic() + max(0.0, seconds)
+            n_samples = 0
+            while time.monotonic() < deadline:
+                for tid, frame in sys._current_frames().items():
+                    if tid == me:
+                        continue  # the sampler's own wait loop is noise
+                    parts = []
+                    f = frame
+                    while f is not None:
+                        code = f.f_code
+                        parts.append(f"{code.co_filename}:{code.co_name}")
+                        f = f.f_back
+                    if not parts:
+                        continue
+                    stacks[";".join(reversed(parts))] += 1
+                    leaf[parts[0]] += 1
+                n_samples += 1
+                time.sleep(interval)
             if raw:
-                prof.snapshot_stats()
-                return marshal.dumps(prof.stats)
-            out = io.StringIO()
-            pstats.Stats(prof, stream=out).sort_stats(sort).print_stats(50)
-            return out.getvalue().encode()
+                lines = [f"{stack} {n}" for stack, n in stacks.most_common()]
+                return ("\n".join(lines) + "\n").encode()
+            out = [
+                f"cpu profile: {n_samples} sampling rounds over "
+                f"{seconds:.2f}s at {hz}Hz (all threads)",
+                f"{'samples':>10}  location",
+            ]
+            for loc, n in leaf.most_common(50):
+                out.append(f"{n:>10}  {loc}")
+            return ("\n".join(out) + "\n").encode()
         finally:
             Profiler._mu.release()
 
     def heap_profile(self, top: int = 50) -> bytes:
         """Top allocation sites by live bytes (tracemalloc window)."""
-        started_here = not tracemalloc.is_tracing()
-        if started_here:
-            tracemalloc.start()
-            # let in-flight work allocate so the snapshot isn't empty
-            time.sleep(0.1)
-        try:
-            snap = tracemalloc.take_snapshot()
-        finally:
+        with Profiler._mu:  # start/snapshot/stop must not interleave
+            started_here = not tracemalloc.is_tracing()
             if started_here:
-                tracemalloc.stop()
+                tracemalloc.start()
+                # let in-flight work allocate so the snapshot isn't empty
+                time.sleep(0.1)
+            try:
+                snap = tracemalloc.take_snapshot()
+            finally:
+                if started_here:
+                    tracemalloc.stop()
         lines = []
         total = 0
         for stat in snap.statistics("lineno")[:top]:

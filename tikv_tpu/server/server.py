@@ -87,6 +87,9 @@ class Server:
     def read_pool(self) -> UnifiedReadPool:
         with self._read_pool_mu:
             if self._read_pool is None:
+                if self._stop.is_set():
+                    # a frame racing shutdown must not birth an unstoppable pool
+                    raise RuntimeError("server is stopped")
                 self._read_pool = UnifiedReadPool(
                     workers=self._read_pool_workers, name="unified-read-pool"
                 )
@@ -164,10 +167,13 @@ class Server:
                     )
                     try:
                         self.read_pool.submit(run, group=group, priority=prio)
-                    except RuntimeError:  # pool stopped mid-shutdown
-                        self._pool.submit(run)
+                    except RuntimeError:  # pool/server stopped mid-shutdown
+                        return
                 else:
-                    self._pool.submit(run)
+                    try:
+                        self._pool.submit(run)
+                    except RuntimeError:  # executor shut down mid-frame
+                        return
         except (ConnectionError, ValueError, OSError):
             pass
         finally:

@@ -57,6 +57,9 @@ class StoreServer:
         self.pd = pd
         self.security = security
         self.engine = open_engine(data_dir)
+        if hasattr(self.engine, "start_auto_compaction"):
+            # background version GC (rocksdb's compaction threads)
+            self.engine.start_auto_compaction(interval_s=30.0)
         self.transport = RemoteTransport(self._resolve, security=security)
         self.node = Node(pd, self.transport, store_id=store_id, engine=self.engine)
         self.store = self.node.store
