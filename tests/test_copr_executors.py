@@ -20,6 +20,7 @@ from tikv_tpu.copr.dag import (
     check_supported,
 )
 from tikv_tpu.copr.executors import FixtureScanSource, MvccScanSource
+from tikv_tpu.copr.datatypes import EvalType
 from tikv_tpu.copr.rpn import call, col, const_decimal, const_int
 from tikv_tpu.copr.table import record_range
 
@@ -126,10 +127,12 @@ def test_hash_aggregation_group_by_name():
 
 
 def test_stream_aggregation_same_result():
+    # stream agg contracts sorted-by-group-key input (stream_aggr_executor.rs
+    # trusts the plan); the scan is ordered by handle, so group on col(0)
     mk = lambda streamed: run_dag(
         [
             TableScan(TABLE_ID, PRODUCT_COLUMNS),
-            Aggregation(group_by=[col(1)], agg_funcs=[AggDescriptor("count", None)], streamed=streamed),
+            Aggregation(group_by=[col(0)], agg_funcs=[AggDescriptor("count", None)], streamed=streamed),
         ]
     )
     assert mk(True).encode() == mk(False).encode()
@@ -329,3 +332,214 @@ def test_device_rejects_new_bytes_kernels():
         ]
     )
     assert not supports(dag)
+
+
+# ----------------------------------------------------- stream aggregation
+
+def _feed_chunks(chunks, schema):
+    from tikv_tpu.copr.executors import BatchExecuteResult, BatchExecutor
+
+    class Feed(BatchExecutor):
+        def __init__(self):
+            self.i = 0
+
+        def schema(self):
+            return schema
+
+        def next_batch(self, n):
+            from tikv_tpu.copr.datatypes import Chunk
+
+            if self.i >= len(chunks):
+                return BatchExecuteResult(Chunk.full([]), True)
+            c = chunks[self.i]
+            self.i += 1
+            return BatchExecuteResult(c, self.i >= len(chunks))
+
+    return Feed()
+
+
+def _drain_rows(ex):
+    rows = []
+    drained = False
+    while not drained:
+        r = ex.next_batch(1024)
+        drained = r.is_drained
+        ch = r.chunk
+        vals = [c.to_values() for c in ch.columns]
+        rows.extend(zip(*vals) if vals else [])
+    return rows
+
+
+def _mk_chunk(keys, vals):
+    from tikv_tpu.copr.datatypes import Chunk, Column
+
+    return Chunk.full([
+        Column.from_values(EvalType.BYTES, keys),
+        Column.from_values(EvalType.INT, vals),
+    ])
+
+
+def test_stream_agg_group_spans_chunks():
+    from tikv_tpu.copr.aggr import AggDescriptor
+    from tikv_tpu.copr.executors import BatchStreamAggregationExecutor
+
+    schema = [(EvalType.BYTES, 0), (EvalType.INT, 0)]
+    chunks = [
+        _mk_chunk([b"a", b"a", b"b"], [1, 2, 3]),
+        _mk_chunk([b"b", b"b", b"c"], [4, 5, 6]),
+        _mk_chunk([b"c"], [7]),
+    ]
+    agg = BatchStreamAggregationExecutor(
+        _feed_chunks(chunks, schema), [col(0)],
+        [AggDescriptor("sum", col(1)), AggDescriptor("count", None)],
+    )
+    assert _drain_rows(agg) == [(3, 2, b"a"), (12, 3, b"b"), (13, 2, b"c")]
+
+
+def test_stream_agg_bounded_state():
+    """Between batches at most ONE group's state is resident."""
+    from tikv_tpu.copr.aggr import AggDescriptor
+    from tikv_tpu.copr.executors import BatchStreamAggregationExecutor
+
+    schema = [(EvalType.BYTES, 0), (EvalType.INT, 0)]
+    chunks = [
+        _mk_chunk([b"g%04d" % i for i in range(k, k + 100)], list(range(100)))
+        for k in range(0, 1000, 100)
+    ]
+    agg = BatchStreamAggregationExecutor(
+        _feed_chunks(chunks, schema), [col(0)], [AggDescriptor("sum", col(1))]
+    )
+    emitted = 0
+    drained = False
+    while not drained:
+        r = agg.next_batch(1024)
+        drained = r.is_drained
+        emitted += r.chunk.num_rows
+        # the carry is at most one group wide
+        assert len(agg.states[0].count) <= 1
+    assert emitted == 1000
+
+
+def test_stream_agg_matches_hash_path():
+    from tikv_tpu.copr.aggr import AggDescriptor
+    from tikv_tpu.copr.executors import (
+        BatchHashAggregationExecutor,
+        BatchStreamAggregationExecutor,
+    )
+
+    rng = np.random.default_rng(7)
+    keys = sorted(b"k%03d" % rng.integers(0, 40) for _ in range(500))
+    vals = [int(v) for v in rng.integers(-100, 100, size=500)]
+    schema = [(EvalType.BYTES, 0), (EvalType.INT, 0)]
+    chunks = [_mk_chunk(keys[i : i + 64], vals[i : i + 64]) for i in range(0, 500, 64)]
+
+    def run(cls):
+        ex = cls(
+            _feed_chunks(chunks, schema), [col(0)],
+            [AggDescriptor("sum", col(1)), AggDescriptor("count", None),
+             AggDescriptor("min", col(1)), AggDescriptor("max", col(1))],
+        )
+        return sorted(_drain_rows(ex), key=lambda r: r[-1])
+
+    assert run(BatchStreamAggregationExecutor) == run(BatchHashAggregationExecutor)
+
+
+def test_stream_agg_nulls_group_together():
+    from tikv_tpu.copr.aggr import AggDescriptor
+    from tikv_tpu.copr.executors import BatchStreamAggregationExecutor
+
+    schema = [(EvalType.BYTES, 0), (EvalType.INT, 0)]
+    chunks = [
+        _mk_chunk([None, None], [1, 2]),
+        _mk_chunk([None, b"z"], [3, 10]),
+    ]
+    agg = BatchStreamAggregationExecutor(
+        _feed_chunks(chunks, schema), [col(0)], [AggDescriptor("sum", col(1))]
+    )
+    assert _drain_rows(agg) == [(6, None), (10, b"z")]
+
+
+def test_stream_agg_empty_input():
+    from tikv_tpu.copr.aggr import AggDescriptor
+    from tikv_tpu.copr.executors import BatchStreamAggregationExecutor
+
+    schema = [(EvalType.BYTES, 0), (EvalType.INT, 0)]
+    agg = BatchStreamAggregationExecutor(
+        _feed_chunks([], schema), [col(0)], [AggDescriptor("count", None)]
+    )
+    assert _drain_rows(agg) == []
+
+
+def test_stream_agg_null_expr_key_spans_chunks():
+    """NULL group keys canonicalize to None: the garbage data a kernel leaves
+    under a null mask must not split the NULL group at a chunk boundary."""
+    from tikv_tpu.copr.aggr import AggDescriptor
+    from tikv_tpu.copr.datatypes import Chunk, Column
+    from tikv_tpu.copr.executors import BatchStreamAggregationExecutor
+
+    def mk(a_vals, b_vals):
+        return Chunk.full([
+            Column.from_values(EvalType.INT, a_vals),
+            Column.from_values(EvalType.INT, b_vals),
+        ])
+
+    schema = [(EvalType.INT, 0), (EvalType.INT, 0)]
+    # group key = a + b; a is NULL with different b values across the boundary
+    chunks = [mk([None, None], [7, 8]), mk([None, 5], [9, 5])]
+    agg = BatchStreamAggregationExecutor(
+        _feed_chunks(chunks, schema),
+        [call("plus", col(0), col(1))],
+        [AggDescriptor("count", None)],
+    )
+    rows = _drain_rows(agg)
+    assert rows == [(3, None), (1, 10)]
+
+
+def test_stream_agg_json_minmax_carry():
+    from tikv_tpu.copr.aggr import AggDescriptor
+    from tikv_tpu.copr.datatypes import Chunk, Column
+    from tikv_tpu.copr.executors import BatchStreamAggregationExecutor
+    from tikv_tpu.copr.json_value import json_decode, json_encode
+
+    def mk(keys, docs):
+        return Chunk.full([
+            Column.from_values(EvalType.BYTES, keys),
+            Column.from_values(EvalType.JSON, [json_encode(d) for d in docs]),
+        ])
+
+    schema = [(EvalType.BYTES, 0), (EvalType.JSON, 0)]
+    # group g1 emitted in batch 1; g2 spans the boundary — its JSON min must
+    # compare against its OWN carried best, not g1's stale cache slot
+    chunks = [mk([b"g1", b"g2"], [100, 50]), mk([b"g2", b"g2"], [30, 70])]
+    agg = BatchStreamAggregationExecutor(
+        _feed_chunks(chunks, schema), [col(0)], [AggDescriptor("min", col(1))]
+    )
+    rows = _drain_rows(agg)
+    assert [(json_decode(v), k) for v, k in rows] == [(100, b"g1"), (30, b"g2")]
+
+
+def test_stream_agg_enum_key_keeps_dictionary():
+    from tikv_tpu.copr.aggr import AggDescriptor
+    from tikv_tpu.copr.datatypes import Chunk, Column, enum_column, enum_names
+    from tikv_tpu.copr.executors import BatchStreamAggregationExecutor
+
+    elems = (b"red", b"green")
+    schema = [(EvalType.ENUM, 0), (EvalType.INT, 0)]
+    chunks = [
+        Chunk.full([enum_column([1, 1], elems), Column.from_values(EvalType.INT, [1, 2])]),
+        Chunk.full([enum_column([2], elems), Column.from_values(EvalType.INT, [5])]),
+    ]
+    agg = BatchStreamAggregationExecutor(
+        _feed_chunks(chunks, schema), [col(0)], [AggDescriptor("sum", col(1))]
+    )
+    out = []
+    drained = False
+    key_cols = []
+    while not drained:
+        r = agg.next_batch(1024)
+        drained = r.is_drained
+        if r.chunk.num_rows:
+            key_cols.append(r.chunk.columns[-1])
+    names = [enum_names(kc).to_values() for kc in key_cols]
+    # the second chunk arrives with is_drained, so both groups emit together
+    assert names == [[b"red", b"green"]]

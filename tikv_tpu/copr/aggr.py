@@ -101,6 +101,23 @@ class AggState:
         if hasattr(self, "has_value"):
             self.has_value = np.concatenate([self.has_value, np.zeros(add, dtype=bool)])
 
+    def rebase(self, keep_idx: int | None) -> None:
+        """Drop all group state except ``keep_idx`` (which becomes group 0),
+        or everything when None — the stream-agg carry.  Owned here so every
+        piece of state (including caches like _json_best) moves together."""
+        for name in ("count", "sum", "sum_sq", "value", "has_value"):
+            if hasattr(self, name):
+                arr = getattr(self, name)
+                if keep_idx is None:
+                    setattr(self, name, arr[:0].copy())
+                else:
+                    setattr(self, name, arr[keep_idx : keep_idx + 1].copy())
+        best = getattr(self, "_json_best", None)
+        if best is not None:
+            self._json_best = (
+                {0: best[keep_idx]} if keep_idx is not None and keep_idx in best else {}
+            )
+
     def update(self, group_ids: np.ndarray, data: np.ndarray | None, nulls: np.ndarray | None) -> None:
         """Accumulate one batch. group_ids: int array, one per logical row."""
         op = self.op

@@ -417,11 +417,133 @@ class BatchHashAggregationExecutor(_AggBase):
         return self.groups.assign(key_parts)
 
 
-class BatchStreamAggregationExecutor(BatchHashAggregationExecutor):
+class BatchStreamAggregationExecutor(_AggBase):
     """Group-by over input already sorted on the group key
-    (stream_aggr_executor.rs:23).  Correctness does not depend on sortedness
-    here (the hash path handles any order); emitting incrementally is a later
-    optimization, so this subclass exists for DAG parity."""
+    (stream_aggr_executor.rs:23).  Memory is bounded by ONE open group: each
+    child batch is segmented at key-change boundaries (a vectorized adjacent
+    compare, no per-row Python), completed segments are emitted immediately,
+    and only the trailing segment's partial state carries to the next batch —
+    the reason stream agg exists next to the hash path.
+    """
+
+    def __init__(self, child: BatchExecutor, group_by: list[Expr], aggs: list[AggDescriptor]):
+        super().__init__(child, aggs)
+        self.group_by = [compile_expr(g, self.child_schema) for g in group_by]
+        # open-group carry: key as ((null, value), ...) or None when no group
+        self._open_key: tuple | None = None
+        # group index → (eval_type, name dictionary) for ENUM/SET key columns
+        self._group_dicts: dict[int, tuple[EvalType, np.ndarray]] = {}
+
+    def schema(self):
+        return self._agg_schema() + [(g.eval_type, g.frac) for g in self.group_by]
+
+    # -- carry management ---------------------------------------------------
+
+    def _rebase_states(self, keep_idx: int | None) -> None:
+        """Shrink every AggState to just the open group (or to empty) —
+        emitted groups' state is dropped, keeping memory O(1) in groups."""
+        for state in self.states:
+            state.rebase(keep_idx)
+
+    def _emit(self, n_groups: int, key_rows: list[tuple]) -> Chunk:
+        out: list[Column] = []
+        for s in self.states:
+            out.extend(s.result_columns(n_groups))
+        for gi, g in enumerate(self.group_by):
+            vals = [None if key[gi][0] else key[gi][1] for key in key_rows]
+            kcol = Column.from_values(g.eval_type, vals, g.frac)
+            if gi in self._group_dicts:
+                et, d = self._group_dicts[gi]
+                if et == g.eval_type:
+                    kcol.dictionary = d
+            out.append(kcol)
+        return Chunk.full(out)
+
+    # -- drive --------------------------------------------------------------
+
+    def next_batch(self, scan_rows: int) -> BatchExecuteResult:
+        if self._done:
+            return BatchExecuteResult(Chunk.full([]), True)
+        r = self.child.next_batch(scan_rows)
+        chunk = r.chunk
+        if not chunk.num_rows:
+            if r.is_drained:
+                self._done = True
+                if self._open_key is not None:
+                    final = self._emit(1, [self._open_key])
+                    self._open_key = None
+                    return BatchExecuteResult(final, True)
+                return BatchExecuteResult(self._emit(0, []), True)
+            return BatchExecuteResult(self._emit(0, []), False)
+
+        logical = chunk.logical_rows
+        m = len(logical)
+        n = len(chunk.columns[0])
+        for gi, g in enumerate(self.group_by):
+            if len(g.nodes) == 1 and g.nodes[0].kind == "col":
+                c = chunk.columns[g.nodes[0].index]
+                if c.eval_type in (EvalType.ENUM, EvalType.SET) and c.dictionary is not None:
+                    self._group_dicts.setdefault(gi, (c.eval_type, c.dictionary))
+        needed = set()
+        for g in self.group_by:
+            needed |= g.referenced_columns()
+        cols = cols_for_eval(chunk.columns, needed)
+        parts = []
+        for g in self.group_by:
+            data, nulls = eval_rpn(g, cols, n)
+            parts.append((np.asarray(data)[logical], np.asarray(nulls)[logical]))
+
+        # segment boundaries: adjacent-rows key change (NULLs group together)
+        new_seg = np.zeros(m, dtype=bool)
+        for d, nl in parts:
+            if m > 1:
+                diff = (nl[1:] != nl[:-1]) | (~nl[1:] & ~nl[:-1] & (d[1:] != d[:-1]))
+                new_seg[1:] |= diff
+        # NULL key cells canonicalize to (True, None): the data under a null
+        # is whatever the kernel happened to compute and must not influence
+        # group identity (GroupDict maps NULLs to None the same way)
+        first_key = tuple(
+            (True, None) if nl[0] else (False, _as_key_val(d[0])) for d, nl in parts
+        )
+        carried = self._open_key is not None
+        continues = carried and first_key == self._open_key
+        new_seg[0] = not continues
+
+        # group id per logical row: the carried group (if any) keeps id 0;
+        # each boundary opens the next id
+        local = np.cumsum(new_seg.astype(np.int64))
+        if not carried:
+            local -= 1  # first chunk segment IS group 0
+        n_local = int(local[-1]) + 1
+
+        # per-group key tuples (carried first, then each segment start)
+        key_rows: list[tuple] = []
+        if carried:
+            key_rows.append(self._open_key)
+        for i in np.flatnonzero(new_seg):
+            key_rows.append(
+                tuple(
+                    (True, None) if nl[i] else (False, _as_key_val(d[i]))
+                    for d, nl in parts
+                )
+            )
+        assert len(key_rows) == n_local, (len(key_rows), n_local)
+
+        self._update_batch(Chunk(chunk.columns, logical), local, n_local)
+
+        done = r.is_drained
+        if done:
+            self._done = True
+            self._open_key = None
+            out = self._emit(n_local, key_rows)
+            self._rebase_states(None)
+            return BatchExecuteResult(out, True)
+        # hold back the trailing group, emit the rest
+        emit_n = n_local - 1
+        out = self._emit(emit_n, key_rows[:emit_n])
+        self._open_key = key_rows[-1]
+        self._rebase_states(n_local - 1)
+        return BatchExecuteResult(out, False)
 
 
 # ---------------------------------------------------------------------------
@@ -515,6 +637,15 @@ def _coded_group_parts(group_rpns, columns, rows: np.ndarray):
             return None
         parts.append((np.asarray(c.data)[rows], np.asarray(c.nulls)[rows], c.dictionary))
     return parts or None
+
+
+def _as_key_val(v):
+    """Hashable python value for a group-key cell (numpy scalar or bytes)."""
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, np.generic):
+        return v.item()
+    return v
 
 
 def _as_py(c: Column, row: int):
