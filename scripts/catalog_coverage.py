@@ -168,13 +168,16 @@ ALIASES = {
     "PlusIntSignedSigned": "plus",
     "Pi": "~const-fold", "Rand": "~nondeterministic",
     "RandWithSeedFirstGen": "~nondeterministic", "RandomBytes": "~nondeterministic",
-    "AddDateAndString": "~string-time", "AddDatetimeAndString": "~string-time",
-    "AddDurationAndString": "~string-time", "AddStringAndDuration": "~string-time",
-    "SubDatetimeAndString": "~string-time", "SubStringAndDuration": "~string-time",
+    "AddDateAndString": "add_date_and_string",
+    "AddDatetimeAndString": "add_datetime_and_string",
+    "AddDurationAndString": "add_duration_and_string",
+    "AddStringAndDuration": "add_string_and_duration",
+    "SubDatetimeAndString": "sub_datetime_and_string",
+    "SubStringAndDuration": "sub_string_and_duration",
     "DurationHour": "duration_hours", "DurationMinute": "minute",
     "DurationSecond": "second", "DurationMicroSecond": "micro_second",
     "TimestampDiff": "timestamp_diff_days", "AddTimeDateTimeNull": "add_datetime_duration",
-    "AddTimeDurationNull": "add_duration", "AddTimeStringNull": "~string-time",
+    "AddTimeDurationNull": "add_duration", "AddTimeStringNull": "add_time_string_null",
     # json
     "JsonArraySig": "json_array", "JsonObjectSig": "json_object",
     "JsonExtractSig": "json_extract", "JsonUnquoteSig": "json_unquote",
@@ -210,7 +213,6 @@ UNSUPPORTED = {
     "~const-fold": "constant; folded by the planner before pushdown",
     "~frac": "needs frac-aware bytes plumbing (decimal formatting)",
     "~nondeterministic": "non-deterministic function",
-    "~string-time": "string-typed time arithmetic (cast first)",
 }
 
 

@@ -568,3 +568,34 @@ def test_device_failure_does_not_poison_block_cache(monkeypatch):
     r3 = ep.handle_request(req())  # served from the (clean) cache
     cpu = Endpoint(LocalEngine(eng), enable_device=False).handle_request(req())
     assert r2.data == r3.data == cpu.data == r1.data
+
+
+def test_float_sums_beyond_onehot_window():
+    """REAL sums with hundreds of groups ride the blocked mask-reduce (not
+    scatter) and match the CPU oracle within float rounding."""
+    from tikv_tpu.copr.datatypes import ColumnInfo, FieldType
+    from tikv_tpu.copr.table import encode_row, record_key
+
+    rng = np.random.default_rng(3)
+    n, n_groups = 4000, 500
+    cols = [
+        ColumnInfo(1, FieldType.int64(), is_pk_handle=True),
+        ColumnInfo(2, FieldType.int64()),
+        ColumnInfo(3, FieldType.double()),
+    ]
+    g = rng.integers(0, n_groups, n)
+    x = rng.normal(size=n) * 100
+    kvs = [
+        (record_key(TABLE_ID, i), encode_row(cols[1:], [int(g[i]), float(x[i])]))
+        for i in range(n)
+    ]
+    aggs = [AggDescriptor("sum", col(2)), AggDescriptor("count", None)]
+    cpu, dev = run_both(
+        [TableScan(TABLE_ID, cols), Aggregation([col(1)], aggs)], kvs, block_rows=512
+    )
+    crows = sorted(cpu.iter_rows(), key=lambda r: r[-1])
+    drows = sorted(dev.iter_rows(), key=lambda r: r[-1])
+    assert len(crows) == n_groups == len(drows)
+    for c, d in zip(crows, drows):
+        assert c[-1] == d[-1] and c[1] == d[1]  # key + count exact
+        assert c[0] == pytest.approx(d[0], rel=1e-9)

@@ -704,3 +704,49 @@ def test_ext_edge_cases_from_review():
     assert d[0] == 0
     d, nl = _run(call("json_member_of", const_bytes(None), const_bytes(None)))
     assert nl[0]  # NULL operand -> NULL, not a crash
+
+
+def test_string_time_arithmetic():
+    """ADDTIME/SUBTIME string arms (impl_time.rs Add*AndString family)."""
+    from tikv_tpu.copr.mysql_time import format_datetime, parse_datetime, parse_duration
+
+    dt = parse_datetime("2024-03-01 10:00:00")
+    # datetime + 'HH:MM:SS' string
+    d, nl = _run(call("add_datetime_and_string", const_int(dt), const_bytes(b"01:30:00")))
+    assert not nl[0] and format_datetime(int(d[0])) == "2024-03-01 11:30:00"
+    d, _ = _run(call("sub_datetime_and_string", const_int(dt), const_bytes(b"11:00:00")))
+    assert format_datetime(int(d[0])) == "2024-02-29 23:00:00"  # leap day
+    # datetime + datetime-string is NULL (MySQL)
+    _, nl = _run(call("add_datetime_and_string", const_int(dt), const_bytes(b"2024-01-01 00:00:00")))
+    assert nl[0]
+    # duration + string
+    d, _ = _run(call("add_duration_and_string", const_int(parse_duration("01:00:00")), const_bytes(b"00:30:15")))
+    assert int(d[0]) == parse_duration("01:30:15")
+    # string + duration → string
+    d, _ = _run(call("add_string_and_duration", const_bytes(b"01:00:00"), const_int(parse_duration("02:15:00"))))
+    assert bytes(d[0]) == b"03:15:00"
+    d, _ = _run(call("sub_string_and_duration", const_bytes(b"2024-03-01 10:00:00"), const_int(parse_duration("10:00:01"))))
+    assert bytes(d[0]) == b"2024-02-29 23:59:59"
+    # garbage strings are NULL, not errors
+    _, nl = _run(call("add_string_and_duration", const_bytes(b"nope"), const_int(0)))
+    assert nl[0]
+    # the statically-NULL arm
+    _, nl = _run(call("add_time_string_null", const_int(1), const_bytes(b"x")))
+    assert nl[0]
+
+
+def test_string_time_numeric_and_date_arms():
+    from tikv_tpu.copr.mysql_time import parse_datetime, parse_duration
+
+    # bare numeric time is RIGHT-aligned HHMMSS: '123' = 00:01:23 (MySQL)
+    d, _ = _run(call("add_string_and_duration", const_bytes(b"123"), const_int(parse_duration("01:00:00"))))
+    assert bytes(d[0]) == b"01:01:23"
+    _, nl = _run(call("add_string_and_duration", const_bytes(b"178"), const_int(0)))
+    assert nl[0]  # 00:01:78 is not a valid time
+    # add_date_and_string: packed date + duration string → formatted string
+    dt = parse_datetime("2024-03-01 00:00:00")
+    d, nl = _run(call("add_date_and_string", const_int(dt), const_bytes(b"26:00:00")))
+    assert not nl[0] and bytes(d[0]) == b"2024-03-02 02:00:00"
+    # datetime-string second operand → NULL
+    _, nl = _run(call("add_date_and_string", const_int(dt), const_bytes(b"2024-01-01 00:00:00")))
+    assert nl[0]

@@ -225,6 +225,24 @@ def _seg_sum(x, gids, capacity: int):
         return jnp.sum(jnp.where(onehot, x[:, None], jnp.zeros((), dtype=x.dtype)), axis=0)
     if x.dtype == jnp.int64 and capacity <= _MATMUL_CAPACITY_MAX:
         return _limb_matmul_seg_sum(x, gids, capacity)
+    if capacity <= _MATMUL_CAPACITY_MAX:
+        # float sums beyond the one-hot window: scan over blocks of 64
+        # groups, each a full-precision f64 mask-reduce (VPU).  Same tree
+        # reduction as the ≤64 path, so the same last-ulp behavior — and
+        # still orders of magnitude cheaper than TPU scatter, which was the
+        # round-1 fallback that knocked Q1-with-REAL shapes off the device.
+        blocks = (capacity + _ONEHOT_CAPACITY_MAX - 1) // _ONEHOT_CAPACITY_MAX
+        starts = jnp.arange(blocks, dtype=gids.dtype) * _ONEHOT_CAPACITY_MAX
+        lane = jnp.arange(_ONEHOT_CAPACITY_MAX, dtype=gids.dtype)
+
+        def one_block(start):
+            onehot = gids[:, None] == (start + lane)[None, :]
+            return jnp.sum(
+                jnp.where(onehot, x[:, None], jnp.zeros((), dtype=x.dtype)), axis=0
+            )
+
+        out = jax.lax.map(one_block, starts)  # (blocks, 64)
+        return out.reshape(blocks * _ONEHOT_CAPACITY_MAX)[:capacity]
     return jax.ops.segment_sum(x, gids, num_segments=capacity)
 
 

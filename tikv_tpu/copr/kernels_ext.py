@@ -945,6 +945,91 @@ _reg_nullable_int(
 )
 
 
+# -- string-typed time arithmetic (impl_time.rs AddTime/SubTime string arms:
+# ADDTIME/SUBTIME accept a time-or-datetime STRING on either side) ----------
+
+def _parse_time_arg(s: bytes):
+    """('dur', nanos) | ('dt', packed) | None — MySQL tries duration first
+    unless the text looks like a date."""
+    text = s.decode("utf-8", "replace").strip()
+    if not text:
+        return None
+    if "-" in text.lstrip("-"):  # date separator (not a leading sign)
+        try:
+            return ("dt", _mt.parse_datetime(text))
+        except ValueError:
+            return None
+    body, _, frac = text.lstrip("+-").partition(".")
+    if body.isdigit() and ":" not in text:
+        # bare numeric time is RIGHT-aligned HHMMSS: '123' = 00:01:23
+        neg = text.lstrip().startswith("-")
+        v = int(body)
+        hh, rem = divmod(v, 10000)
+        mm, ss = divmod(rem, 100)
+        if mm > 59 or ss > 59:
+            return None
+        micro = int(frac.ljust(6, "0")[:6]) if frac and frac.isdigit() else 0
+        return ("dur", _mt.duration_nanos(hh, mm, ss, micro, neg))
+    try:
+        return ("dur", _mt.parse_duration(text))
+    except ValueError:
+        return None
+
+
+def _dt_plus_str(packed: int, s: bytes, sign: int):
+    arg = _parse_time_arg(s)
+    if arg is None or arg[0] != "dur":
+        return None  # datetime + datetime-string is NULL in MySQL
+    return _mt.date_add(int(packed), sign * (arg[1] // 1000), "MICROSECOND")
+
+
+def _dur_plus_str(d: int, s: bytes):
+    arg = _parse_time_arg(s)
+    if arg is None or arg[0] != "dur":
+        return None
+    return int(d) + arg[1]
+
+
+_reg_nullable_int("add_datetime_and_string", 2, lambda p, s: _dt_plus_str(p, s, 1))
+_reg_nullable_int("sub_datetime_and_string", 2, lambda p, s: _dt_plus_str(p, s, -1))
+_reg_nullable_int("add_duration_and_string", 2, _dur_plus_str)
+
+
+def _str_plus_dur(s: bytes, nanos: int, sign: int):
+    """string ADDTIME duration → string (MySQL's result type for this arm)."""
+    arg = _parse_time_arg(s)
+    if arg is None:
+        return None
+    if arg[0] == "dur":
+        return _mt.format_duration(arg[1] + sign * int(nanos)).encode()
+    packed = _mt.date_add(arg[1], sign * (int(nanos) // 1000), "MICROSECOND")
+    if packed is None:
+        return None
+    return _mt.format_datetime(packed).encode()
+
+
+_bytes_op("add_string_and_duration", 2, "bytes")(
+    lambda s, d: _str_plus_dur(s, d, 1)
+)
+_bytes_op("sub_string_and_duration", 2, "bytes")(
+    lambda s, d: _str_plus_dur(s, d, -1)
+)
+def _date_plus_str(p: int, s: bytes):
+    r = _dt_plus_str(p, s, 1)
+    return None if r is None else _mt.format_datetime(r).encode()
+
+
+_bytes_op("add_date_and_string", 2, "bytes")(_date_plus_str)
+
+
+@_reg("add_time_string_null", 2, "int")
+def _add_time_string_null(xp, a, b):
+    """The reference's *Null arm: statically NULL-typed result."""
+    (ad, _), _b = a, b
+    n = len(ad)
+    return _np.zeros(n, dtype=_np.int64), _np.ones(n, dtype=bool)
+
+
 def _timestamp_add(unit: bytes, n: int, packed: int):
     return _mt.date_add(int(packed), int(n), unit.decode().upper())
 
