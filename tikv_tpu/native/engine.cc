@@ -164,8 +164,11 @@ uint32_t read_u32(const uint8_t*& p) {
 }
 
 // batch format: repeated records
-//   op u8 (1=put, 2=delete, 3=delete_range) | cf u8 |
-//   klen u32 | key | vlen u32 | val      (val = end key for delete_range)
+//   op u8 (1=put, 2=delete, 3=delete_range, 4=ingest_sst) | cf u8 |
+//   klen u32 | key | vlen u32 | val      (val = end key for delete_range;
+//   for ingest_sst the key is the SST file name inside the engine dir —
+//   the WAL records the *reference*, rocksdb-manifest style, and replay
+//   reloads the file)
 
 // Structural validation WITHOUT applying: a malformed batch must be
 // rejected before it reaches the WAL — once fsync'd, a bad record would
@@ -178,6 +181,9 @@ int validate_batch(const uint8_t* data, uint64_t len) {
     uint8_t op = *p++;
     uint8_t cf = *p++;
     if (cf >= kNumCfs) return -2;
+    // op 4 (ingest_sst) is NOT accepted from client batches: only
+    // eng_ingest_sst forges it after validating the file, preserving the
+    // "validated batch cannot fail to apply" invariant eng_write relies on
     if (op < 1 || op > 3) return -3;
     if (end - p < 4) return -1;
     uint32_t klen = read_u32(p);
@@ -190,6 +196,21 @@ int validate_batch(const uint8_t* data, uint64_t len) {
   }
   return 0;
 }
+
+// --- SST files --------------------------------------------------------------
+//
+// Immutable sorted ingest file (the role sst_importer's SST plays):
+//   "TKST1\n" | u32 count | repeated (cf u8|klen u32|key|vlen u32|val)
+//   | "KSTE" | u32 crc32c(body)
+// Entries must be sorted by (cf, key).  Ingest copies the file into the
+// engine dir as sst-<seq>, WAL-appends an op-4 record naming it (the
+// reference, not the bytes — rocksdb's manifest AddFile shape), then loads
+// it; recovery replays the op-4 record and reloads from the dir.
+
+constexpr char kSstMagic[] = "TKST1\n";
+constexpr char kSstFoot[] = "KSTE";
+
+int load_sst_file(Engine* e, const std::string& path, uint64_t seq);
 
 // THE one batch applier: the live write path and WAL replay both come here.
 int apply_batch(Engine* e, const uint8_t* data, uint64_t len, uint64_t seq) {
@@ -224,9 +245,87 @@ int apply_batch(Engine* e, const uint8_t* data, uint64_t len, uint64_t seq) {
         // the iterator already holds the chain: no per-key re-lookup
         push_version(e, it->second, seq, true, "", min_snap);
       }
+    } else if (op == 4) {
+      std::string path = e->dir.empty() ? key : e->dir + "/" + key;
+      if (load_sst_file(e, path, seq) != 0) return -6;
     } else {
       return -3;
     }
+  }
+  return 0;
+}
+
+// apply an already-validated SST image's entries at `seq`
+int load_sst_from_buf(Engine* e, const uint8_t* data, uint64_t len, uint64_t seq) {
+  if (len < 18) return -1;
+  uint64_t min_snap = e->min_live_snapshot();
+  if (min_snap > seq) min_snap = seq;
+  const uint8_t* p = data + 10;
+  const uint8_t* end = data + len - 8;
+  while (p < end) {
+    if (end - p < 5) return -1;
+    uint8_t cf = *p++;
+    if (cf >= kNumCfs) return -1;
+    uint32_t klen = read_u32(p);
+    if (static_cast<uint64_t>(end - p) < static_cast<uint64_t>(klen) + 4) return -1;
+    std::string key(reinterpret_cast<const char*>(p), klen);
+    p += klen;
+    uint32_t vlen = read_u32(p);
+    if (static_cast<uint64_t>(end - p) < vlen) return -1;
+    // sorted input streams through the emplace-hint fast path in put_version
+    put_version(e, e->cfs[cf], std::move(key), seq, false,
+                std::string(reinterpret_cast<const char*>(p), vlen), min_snap);
+    p += vlen;
+  }
+  return 0;
+}
+
+int sst_validate(const uint8_t* data, uint64_t len);
+
+int load_sst_file(Engine* e, const std::string& path, uint64_t seq) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) return -1;
+  fseek(f, 0, SEEK_END);
+  long sz = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  if (sz < 18) { fclose(f); return -1; }
+  std::string buf;
+  buf.resize(sz);
+  bool rok = fread(&buf[0], 1, sz, f) == static_cast<size_t>(sz);
+  fclose(f);
+  if (!rok) return -1;
+  const uint8_t* d = reinterpret_cast<const uint8_t*>(buf.data());
+  if (sst_validate(d, buf.size()) != 0) return -1;
+  return load_sst_from_buf(e, d, buf.size(), seq);
+}
+
+// validate an SST byte buffer without applying (used before copy-in)
+int sst_validate(const uint8_t* data, uint64_t len) {
+  if (len < 18) return -1;
+  if (memcmp(data, kSstMagic, 6) != 0) return -1;
+  if (memcmp(data + len - 8, kSstFoot, 4) != 0) return -1;
+  uint32_t crc;
+  memcpy(&crc, data + len - 4, 4);
+  if (crc32c(data + 10, len - 18) != crc) return -1;
+  // entries sorted by (cf, key)?
+  const uint8_t* p = data + 10;
+  const uint8_t* end = data + len - 8;
+  int last_cf = -1;
+  std::string last_key;
+  while (p < end) {
+    if (end - p < 5) return -2;
+    uint8_t cf = *p++;
+    if (cf >= kNumCfs) return -2;
+    uint32_t klen = read_u32(p);
+    if (static_cast<uint64_t>(end - p) < static_cast<uint64_t>(klen) + 4) return -2;
+    std::string key(reinterpret_cast<const char*>(p), klen);
+    p += klen;
+    uint32_t vlen = read_u32(p);
+    if (static_cast<uint64_t>(end - p) < vlen) return -2;
+    p += vlen;
+    if (cf < last_cf || (cf == last_cf && key <= last_key)) return -3;
+    last_cf = cf;
+    last_key = std::move(key);
   }
   return 0;
 }
@@ -429,6 +528,11 @@ int ckpt_write(Engine* e) {
   list_segs(e->dir, "wal", &old);
   for (uint64_t s : old)
     if (s < at) unlink((e->dir + "/" + seg_name("wal", s)).c_str());
+  // ingested SSTs at-or-below the checkpoint are folded in: drop the files
+  old.clear();
+  list_segs(e->dir, "sst", &old);
+  for (uint64_t s : old)
+    if (s <= at) unlink((e->dir + "/" + seg_name("sst", s)).c_str());
   return 0;
 }
 
@@ -542,6 +646,95 @@ int eng_write(void* h, const uint8_t* data, uint64_t len) {
     // non-durable
     if (ckpt_write(e) != 0 && e->wal_fd < 0) e->failed = true;
   }
+  return 0;
+}
+
+// Build an SST file at `path` from a serialized run of (cf|klen|key|vlen|val)
+// records (must be sorted by (cf, key)).  Standalone: no engine handle.
+int eng_build_sst(const char* path, const uint8_t* body, uint64_t len) {
+  // frame it, then validate the full image (sortedness + crc round-trip)
+  std::string img;
+  img.reserve(18 + len);
+  img.append(kSstMagic, 6);
+  append_u32(img, 0);  // count unused (size-delimited records); kept for layout
+  img.append(reinterpret_cast<const char*>(body), len);
+  img.append(kSstFoot, 4);
+  append_u32(img, crc32c(body, len));
+  if (sst_validate(reinterpret_cast<const uint8_t*>(img.data()), img.size()) != 0)
+    return -3;
+  std::string tmp = std::string(path) + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "wb");
+  if (!f) return -1;
+  bool ok = fwrite(img.data(), 1, img.size(), f) == img.size() &&
+            fflush(f) == 0 && fsync(fileno(f)) == 0;
+  fclose(f);
+  if (!ok || rename(tmp.c_str(), path) != 0) {
+    unlink(tmp.c_str());
+    return -1;
+  }
+  return 0;
+}
+
+// Ingest an external SST: validate, copy into the engine dir as sst-<seq>,
+// WAL-log the op-4 reference, load.  For a pure in-memory engine the file
+// is loaded in place (no copy, no WAL).
+int eng_ingest_sst(void* h, const char* src_path) {
+  Engine* e = static_cast<Engine*>(h);
+  std::unique_lock lk(e->mu);
+  if (e->failed) return -5;
+  FILE* f = fopen(src_path, "rb");
+  if (!f) return -1;
+  fseek(f, 0, SEEK_END);
+  long sz = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  if (sz < 18 || sz > (1ll << 40)) {  // bounds BEFORE resize: a directory
+    fclose(f);                         // fopen succeeds and ftell lies
+    return -1;
+  }
+  std::string buf;
+  buf.resize(sz);
+  bool rok = fread(&buf[0], 1, sz, f) == static_cast<size_t>(sz);
+  fclose(f);
+  if (!rok) return -1;
+  int v = sst_validate(reinterpret_cast<const uint8_t*>(buf.data()), buf.size());
+  if (v != 0) return v;
+  uint64_t seq = e->seq + 1;
+  std::string rec_key;
+  if (e->dir.empty()) {
+    rec_key = src_path;  // in-memory: reference the source directly
+  } else {
+    rec_key = seg_name("sst", seq);
+    std::string dst = e->dir + "/" + rec_key;
+    std::string tmp = dst + ".tmp";
+    FILE* out = fopen(tmp.c_str(), "wb");
+    if (!out) return -1;
+    bool ok = fwrite(buf.data(), 1, buf.size(), out) == buf.size() &&
+              fflush(out) == 0 && fsync(fileno(out)) == 0;
+    fclose(out);
+    if (!ok || rename(tmp.c_str(), dst.c_str()) != 0) {
+      unlink(tmp.c_str());
+      return -1;
+    }
+    fsync_dir(e->dir);  // the file must exist before its WAL reference
+  }
+  // op-4 batch record: | op | cf | klen | name | vlen=0 |
+  std::string rec;
+  rec.push_back(4);
+  rec.push_back(0);
+  append_u32(rec, static_cast<uint32_t>(rec_key.size()));
+  rec.append(rec_key);
+  append_u32(rec, 0);
+  const uint8_t* rp = reinterpret_cast<const uint8_t*>(rec.data());
+  if (wal_append(e, seq, rp, rec.size()) != 0) {
+    e->failed = true;
+    return -4;
+  }
+  // apply straight from the validated bytes — no second read/parse of the
+  // copy; WAL replay goes through apply_batch → load_sst_file instead
+  int r = load_sst_from_buf(
+      e, reinterpret_cast<const uint8_t*>(buf.data()), buf.size(), seq);
+  if (r != 0) return r;
+  e->seq = seq;
   return 0;
 }
 

@@ -128,8 +128,33 @@ def _load():
             ctypes.POINTER(ctypes.c_uint64),
         ]
         lib.eng_mvcc_props.restype = ctypes.c_int
+        lib.eng_build_sst.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64]
+        lib.eng_build_sst.restype = ctypes.c_int
+        lib.eng_ingest_sst.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.eng_ingest_sst.restype = ctypes.c_int
         _lib = lib
         return _lib
+
+
+def build_sst(path: str, entries) -> None:
+    """Write an immutable SST file: ``entries`` = iterable of
+    (cf_name, key, value), sorted by (cf, key).  The native side frames it
+    (magic + CRC footer) and re-validates sortedness before the atomic
+    tmp+rename publish."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native engine unavailable: {_lib_err}")
+    parts = []
+    for cf, key, val in entries:
+        parts.append(bytes([_CF_IDS[cf]]))
+        parts.append(_U32.pack(len(key)))
+        parts.append(key)
+        parts.append(_U32.pack(len(val)))
+        parts.append(val)
+    body = b"".join(parts)
+    r = lib.eng_build_sst(os.fsencode(path), body, len(body))
+    if r != 0:
+        raise RuntimeError(f"eng_build_sst failed: {r} (entries must be sorted)")
 
 
 def native_available() -> bool:
@@ -410,6 +435,16 @@ class NativeEngine(KvEngine):
             self._compact_stop.set()
             self._compactor.join(timeout=5.0)
             self._compactor = None
+
+    # -- SST ingest ---------------------------------------------------------
+
+    def ingest_sst(self, path: str) -> None:
+        """Ingest an immutable SST file (sst_importer ingest:158): validated,
+        copied into the engine dir, WAL-referenced (manifest-style), loaded.
+        Survives crash/reopen; folded into the next checkpoint."""
+        r = self._lib.eng_ingest_sst(self._handle, os.fsencode(path))
+        if r != 0:
+            raise RuntimeError(f"eng_ingest_sst failed: {r}")
 
     # -- MVCC properties ----------------------------------------------------
 
