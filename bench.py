@@ -14,8 +14,100 @@ validates the MVCC leaf on a 200k-row engine-backed region.
 
 import json
 import os
+import subprocess
 import sys
 import time
+
+_PROBE_DONE = "BENCH_BACKEND_RESOLVED"
+
+
+def _resolve_backend() -> str:
+    """Probe the configured JAX backend out-of-process with retry/backoff.
+
+    BENCH_r01/BENCH_r02 both died with rc=1 at axon backend init
+    (``Unable to initialize backend 'axon': UNAVAILABLE``) before any bench
+    work ran.  Two properties force the shape of this guard:
+
+    * JAX caches the first backend-init failure for the life of the process,
+      so retrying in-process is useless — the probe runs in a subprocess and
+      the parent only imports device modules after a probe succeeded.
+    * The tunnel backend can also HANG at init (observed: minutes with no
+      error), so each probe attempt carries a hard timeout.
+
+    On unrecoverable failure we force the CPU platform and continue, so the
+    driver still captures a parsed one-line JSON artifact (the metric name is
+    suffixed ``_cpu_fallback``) instead of a raw traceback.  The forcing MUST
+    go through ``jax.config.update('jax_platforms', 'cpu')`` — this image's
+    sitecustomize re-exports JAX_PLATFORMS=axon at every interpreter start,
+    so a shell-level env override is silently clobbered (observed: a
+    JAX_PLATFORMS=cpu run still initializing 'axon' and hanging).
+    """
+    resolved = os.environ.get(_PROBE_DONE)
+    if resolved:
+        if resolved.startswith("cpu"):
+            _force_cpu()
+        return resolved
+    attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
+    timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+    backoff = 10.0
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "x = jnp.ones((256, 256), jnp.float32);"
+        "(x @ x).block_until_ready();"
+        "print('PLATFORM=' + jax.devices()[0].platform)"
+    )
+    import signal
+
+    for i in range(attempts):
+        t0 = time.time()
+        err = ""
+        # start_new_session + killpg: the tunnel plugin may fork helpers that
+        # inherit the pipes; killing only the direct child would leave
+        # communicate() blocked on the helper's copy of the write end.
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            start_new_session=True,
+        )
+        try:
+            out, errtxt = proc.communicate(timeout=timeout)
+            for line in out.splitlines():
+                if line.startswith("PLATFORM="):
+                    plat = line.split("=", 1)[1]
+                    os.environ[_PROBE_DONE] = plat
+                    print(f"bench: backend '{plat}' up after probe {i + 1} "
+                          f"({time.time() - t0:.1f}s)", file=sys.stderr)
+                    return plat
+            tail = (errtxt or "").strip().splitlines()
+            err = tail[-1][:300] if tail else f"rc={proc.returncode}, no output"
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                proc.kill()
+            proc.communicate()
+            err = f"probe hung past {timeout:.0f}s (killed group)"
+        print(f"bench: backend probe {i + 1}/{attempts} failed: {err}",
+              file=sys.stderr)
+        if i + 1 < attempts:
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 90.0)
+    print("bench: device backend unrecoverable — running on CPU", file=sys.stderr)
+    os.environ[_PROBE_DONE] = "cpu_fallback"
+    _force_cpu()
+    return "cpu_fallback"
+
+
+def _force_cpu() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+if __name__ == "__main__":
+    _BACKEND = _resolve_backend()
+else:
+    _BACKEND = os.environ.get(_PROBE_DONE, "")
 
 import numpy as np
 
@@ -230,9 +322,14 @@ def main():
             sys.exit(1)
         cpu_resp, _ = run_cpu(dag_fn(), kvs)
         # warm: both paths read the same decoded block cache (the serving
-        # steady state — TiKV's cop-cache analog); device arrays pinned in HBM
+        # steady state — TiKV's cop-cache analog); device arrays pinned in
+        # HBM.  Like-for-like trials: best-of-3 on BOTH paths.
         run_tpu(ev, kvs, cache=cache)  # fills cache + pins device arrays
-        cpu_w, cpu_warm_t = run_cpu(dag_fn(), kvs, cache=cache)
+        best_cpu_warm = float("inf")
+        for _ in range(3):
+            cpu_w, cpu_warm_t = run_cpu(dag_fn(), kvs, cache=cache)
+            best_cpu_warm = min(best_cpu_warm, cpu_warm_t)
+        cpu_warm_t = best_cpu_warm
         best_warm = float("inf")
         for _ in range(3):
             tpu_w, tpu_warm_t = run_tpu(ev, kvs, cache=cache)
@@ -252,20 +349,31 @@ def main():
 
     # throughput under concurrent load: K queries fused into one device
     # program (the batch_commands / batch_coprocessor serving pattern) vs the
-    # CPU pipeline answering the same K queries serially over the same cache
+    # CPU pipeline answering the same K queries over the same cache on a
+    # thread pool sized to the machine (like-for-like: both sides use their
+    # natural concurrency mechanism, and both take best-of-3 trials).
+    from concurrent.futures import ThreadPoolExecutor
+
     K = int(os.environ.get("BENCH_BATCH", "16"))
+    cpu_workers = min(K, os.cpu_count() or 1)
     evs = []
     for name, dag_fn in (("q6", q6_dag), ("q1", q1_dag)):
         ev = JaxDagEvaluator(dag_fn(), block_rows=block_rows)
         evs.append((name, dag_fn, ev))
     batch = [(n, d, e) for (n, d, e) in evs for _ in range(K // 2)]
     run_batch_cached([e for _, _, e in batch], cache)  # compile warmup
-    t0 = time.perf_counter()
-    resps = run_batch_cached([e for _, _, e in batch], cache)
-    tpu_batch_t = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    cpu_resps = [run_cpu(d(), kvs, cache=cache)[0] for _, d, _ in batch]
-    cpu_batch_t = time.perf_counter() - t0
+    tpu_batch_t = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        resps = run_batch_cached([e for _, _, e in batch], cache)
+        tpu_batch_t = min(tpu_batch_t, time.perf_counter() - t0)
+    cpu_batch_t = float("inf")
+    with ThreadPoolExecutor(max_workers=cpu_workers) as pool:
+        for _ in range(3):
+            t0 = time.perf_counter()
+            cpu_resps = list(pool.map(
+                lambda args: run_cpu(args[1](), kvs, cache=cache)[0], batch))
+            cpu_batch_t = min(cpu_batch_t, time.perf_counter() - t0)
     for r, c in zip(resps, cpu_resps):
         if r.encode() != c.encode():
             print(json.dumps({"metric": "BATCH_MISMATCH", "value": 0, "unit": "rows/sec", "vs_baseline": 0}))
@@ -274,6 +382,7 @@ def main():
     batch_speedup = cpu_batch_t / tpu_batch_t
     results["batch"] = {
         "queries": len(batch),
+        "cpu_workers": cpu_workers,
         "cpu_rows_per_s": total_rows / cpu_batch_t,
         "tpu_rows_per_s": total_rows / tpu_batch_t,
         "speedup": batch_speedup,
@@ -289,7 +398,9 @@ def main():
     tpu_rows = results["batch"]["tpu_rows_per_s"]
     detail = {
         "rows": n,
+        "backend": _BACKEND,
         "build_s": round(build_s, 2),
+        "warm_geo_speedup": round(geo, 3),
         **{f"{k}_{m}": round(v2, 1) for k, r in results.items() for m, v2 in r.items()},
     }
     if mvcc_rows_s:
@@ -297,10 +408,13 @@ def main():
     if topn_rows_s:
         detail["endpoint_topn_device_rows_per_s"] = round(topn_rows_s, 1)
     print(json.dumps(detail), file=sys.stderr)
+    metric = "copr_q1q6_batched_tpu_rows_per_sec"
+    if _BACKEND == "cpu_fallback":
+        metric += "_cpu_fallback"  # device tunnel was down; number is CPU-vs-CPU
     print(
         json.dumps(
             {
-                "metric": "copr_q1q6_batched_tpu_rows_per_sec",
+                "metric": metric,
                 "value": round(tpu_rows, 1),
                 "unit": "rows/sec",
                 "vs_baseline": round(batch_speedup, 3),
@@ -310,4 +424,22 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except SystemExit:
+        raise
+    except Exception as e:  # noqa: BLE001 — the driver needs a parsed JSON line, not a traceback
+        import traceback
+
+        traceback.print_exc()
+        print(
+            json.dumps(
+                {
+                    "metric": f"bench_error_{type(e).__name__}",
+                    "value": 0.0,
+                    "unit": "rows/sec",
+                    "vs_baseline": 0.0,
+                }
+            )
+        )
+        sys.exit(1)

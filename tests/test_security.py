@@ -236,3 +236,48 @@ def test_v1_explicit_null_stays_null():
     absent = b""  # no columns stored at all
     cols = RowBatchDecoder([pk, info]).decode(np.array([1, 2]), [stored_null, absent])
     assert cols[1].to_values() == [None, 42]
+
+
+def test_status_server_tls(certs):
+    """status_server/mod.rs parity: the status listener rides the same TLS
+    config as the KV server — mutual TLS, CN allow-list, and no plaintext."""
+    import urllib.request
+
+    from tikv_tpu.server.status_server import StatusServer
+
+    cfg = certs["server"]
+    cn_cfg = SecurityConfig(
+        ca_path=cfg.ca_path, cert_path=cfg.cert_path, key_path=cfg.key_path,
+        cert_allowed_cn={"tikv-client"},
+    )
+    srv = StatusServer(security=cn_cfg)
+    srv.start()
+    host, port = srv.addr
+    try:
+        ctx = certs["client"].client_context()
+        ctx.check_hostname = False
+        resp = urllib.request.urlopen(
+            f"https://{host}:{port}/status", context=ctx, timeout=5)
+        assert resp.read() == b"ok"
+        # plaintext is rejected
+        with pytest.raises(Exception):
+            urllib.request.urlopen(f"http://{host}:{port}/status", timeout=5)
+        # a CA-signed cert whose CN is not allow-listed is rejected
+        rogue = _gen_ca_and_cert(certs["dir"], "rogue", "rogue-cn")
+        rctx = rogue.client_context()
+        rctx.check_hostname = False
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                f"https://{host}:{port}/status", context=rctx, timeout=5)
+        # a silent client must not wedge the accept loop for others
+        import socket as _socket
+
+        quiet = _socket.create_connection((host, port), timeout=5)
+        try:
+            resp = urllib.request.urlopen(
+                f"https://{host}:{port}/status", context=ctx, timeout=5)
+            assert resp.read() == b"ok"
+        finally:
+            quiet.close()
+    finally:
+        srv.stop()

@@ -141,6 +141,8 @@ class RowSliceV2:
     def __init__(self, raw: bytes):
         if not raw or raw[0] != CODEC_VERSION:
             raise ValueError("not a v2 row")
+        if len(raw) < 6:
+            raise ValueError("truncated v2 row")
         big = bool(raw[1] & FLAG_BIG)
         nn = int.from_bytes(raw[2:4], "little")
         nl = int.from_bytes(raw[4:6], "little")
@@ -164,6 +166,11 @@ class RowSliceV2:
         ]
         pos += nn * off_w
         self.values_start = pos
+        # Truncation check (row_slice.rs returns Error::corrupted on short
+        # input): every header int above decoded from a short slice as 0, so
+        # without this a truncated row yields garbage cells instead of failing.
+        if pos > len(raw) or (self.offsets and pos + self.offsets[-1] > len(raw)):
+            raise ValueError("truncated v2 row")
 
     def header_len(self) -> int:
         return self.values_start

@@ -733,7 +733,13 @@ int eng_ingest_sst(void* h, const char* src_path) {
   // copy; WAL replay goes through apply_batch → load_sst_file instead
   int r = load_sst_from_buf(
       e, reinterpret_cast<const uint8_t*>(buf.data()), buf.size(), seq);
-  if (r != 0) return r;
+  if (r != 0) {
+    // The WAL record for this seq is already durable; failing to apply it
+    // without bumping e->seq would let the next write reuse the seq and make
+    // replay silently drop the second (acked) record.  Stop acking instead.
+    e->failed = true;
+    return r;
+  }
   e->seq = seq;
   return 0;
 }

@@ -20,8 +20,10 @@ from .profiler import Profiler
 
 
 class StatusServer:
-    def __init__(self, controller: ConfigController | None = None, host="127.0.0.1", port=0, registry=None):
+    def __init__(self, controller: ConfigController | None = None, host="127.0.0.1", port=0, registry=None,
+                 security=None):
         self.controller = controller
+        self.security = security
         self.registry = registry or REGISTRY
         self.profiler = Profiler()
         outer = self
@@ -29,6 +31,17 @@ class StatusServer:
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
                 pass
+
+            def setup(self):
+                # TLS: the listener wraps with do_handshake_on_connect=False
+                # so accept() never blocks on a silent client; the handshake
+                # (+ CN allow-list, same as Server._handshake_and_serve) runs
+                # here, on this connection's own thread, under a timeout.
+                if outer.security is not None and outer.security.enabled:
+                    self.request.settimeout(10.0)
+                    self.request.do_handshake()
+                    outer.security.check_common_name(self.request)
+                super().setup()
 
             def _send(self, code: int, body: bytes, ctype="text/plain"):
                 self.send_response(code)
@@ -85,6 +98,14 @@ class StatusServer:
                     self._send(400, str(e).encode())
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
+        # status_server/mod.rs wires the same TLS config into the status
+        # listener; when [security] is set we serve mutual-TLS HTTPS here too.
+        # Handshake is deferred to the per-connection thread (Handler.setup)
+        # so one silent client can't wedge the accept loop.
+        if security is not None and security.enabled:
+            self._httpd.socket = security.server_context().wrap_socket(
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
         self.addr = self._httpd.server_address
         self._thread: threading.Thread | None = None
 

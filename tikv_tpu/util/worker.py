@@ -107,6 +107,11 @@ class Worker:
             self._cv.notify_all()
         if wait and self._thread is not None:
             self._thread.join(timeout=5.0)
+            if self._thread.is_alive():
+                # A wedged task still owns run(); calling shutdown() now would
+                # race with it.  Leave the runnable alive and let the daemon
+                # thread die with the process.
+                return
         if self._runnable is not None:
             self._runnable.shutdown()
 
