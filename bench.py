@@ -409,8 +409,10 @@ def main():
         detail["endpoint_topn_device_rows_per_s"] = round(topn_rows_s, 1)
     print(json.dumps(detail), file=sys.stderr)
     metric = "copr_q1q6_batched_tpu_rows_per_sec"
-    if _BACKEND == "cpu_fallback":
-        metric += "_cpu_fallback"  # device tunnel was down; number is CPU-vs-CPU
+    if _BACKEND.startswith("cpu"):
+        # no device backend (tunnel down or CPU-only host): CPU-vs-CPU number,
+        # never attested under the TPU metric name
+        metric += "_cpu_fallback"
     print(
         json.dumps(
             {

@@ -1,0 +1,312 @@
+"""End-to-end tipb wire contract: protobuf DAG request in, protobuf
+SelectResponse out (VERDICT r2 item 2's differential test).
+
+A reference-format DAGRequest is built with the tipb message classes (whose
+encodings are pinned byte-identical to the real protobuf runtime by
+test_proto_wire.py), decoded through the bridge, executed by the internal
+batch pipeline, and the response is re-encoded as tipb.SelectResponse in both
+encode types, then decoded back and checked value-for-value.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tikv_tpu.copr import datum as datum_mod
+from tikv_tpu.copr.chunk_codec import (
+    ChunkColumn,
+    column_values,
+    decode_chunk,
+    decode_decimal_cell,
+    encode_chunk,
+    encode_decimal_cell,
+)
+from tikv_tpu.copr.dag import BatchExecutorsRunner
+from tikv_tpu.copr.datatypes import ColumnInfo, FieldType, FieldTypeTp
+from tikv_tpu.copr.executors import FixtureScanSource
+from tikv_tpu.copr.mydecimal import MyDecimal
+from tikv_tpu.copr.table import encode_row, record_key
+from tikv_tpu.copr.tipb_bridge import (
+    decode_dag_request,
+    dag_from_pb,
+    decode_ref_datum,
+    encode_select_response,
+    expr_from_pb,
+)
+from tikv_tpu.proto import tipb_pb as tp
+from tikv_tpu.util import codec
+
+TABLE_ID = 77
+
+COLS = [
+    ColumnInfo(1, FieldType.int64(), is_pk_handle=True),
+    ColumnInfo(2, FieldType.int64()),
+    ColumnInfo(3, FieldType.decimal_type(2)),
+    ColumnInfo(4, FieldType.varchar()),
+]
+
+
+def fixture_kvs(n=50):
+    kvs = []
+    for h in range(n):
+        v = encode_row(COLS[1:], [h % 7, h * 100 + h % 3, f"s{h % 5}".encode()])
+        kvs.append((record_key(TABLE_ID, h), v))
+    return kvs
+
+
+def pb_col(ci: ColumnInfo) -> tp.ColumnInfoPb:
+    out = tp.ColumnInfoPb(column_id=ci.col_id, tp=int(ci.ftype.tp),
+                          decimal=ci.ftype.decimal)
+    if ci.is_pk_handle:
+        out.pk_handle = True
+    return out
+
+
+def colref(i: int) -> tp.Expr:
+    return tp.Expr(tp=tp.ExprType.ColumnRef, val=codec.encode_i64(i))
+
+
+def int_const(v: int) -> tp.Expr:
+    return tp.Expr(tp=tp.ExprType.Int64, val=codec.encode_i64(v))
+
+
+def scalar(sig: str, *children) -> tp.Expr:
+    return tp.Expr(tp=tp.ExprType.ScalarFunc, sig=tp.SCALAR_FUNC_SIG[sig],
+                   children=list(children))
+
+
+def wire_dag(executors, output_offsets) -> bytes:
+    return tp.DAGRequest(
+        start_ts_fallback=100,
+        executors=executors,
+        output_offsets=output_offsets,
+        encode_type=tp.EncodeType.TypeDefault,
+    ).encode()
+
+
+def run_wire_request(data: bytes):
+    dag, pb = decode_dag_request(data)
+    resp = BatchExecutorsRunner(dag, FixtureScanSource(fixture_kvs())).handle_request()
+    return dag, pb, resp
+
+
+def decode_default_rows(select_resp_bytes: bytes, n_cols: int):
+    """Parse reference-format SelectResponse (TypeDefault) into rows."""
+    pb = tp.SelectResponse.decode(select_resp_bytes)
+    rows = []
+    for ch in pb.chunks:
+        buf = ch.rows_data
+        off = 0
+        row = []
+        while off < len(buf):
+            d, off = decode_ref_datum(buf, off)
+            row.append(d)
+            if len(row) == n_cols:
+                rows.append(row)
+                row = []
+        assert not row, "trailing partial row"
+    return pb, rows
+
+
+def test_scan_selection_wire_roundtrip():
+    data = wire_dag(
+        [
+            tp.ExecutorPb(tp=tp.ExecType.TypeTableScan,
+                          tbl_scan=tp.TableScanPb(table_id=TABLE_ID,
+                                                  columns=[pb_col(c) for c in COLS])),
+            tp.ExecutorPb(tp=tp.ExecType.TypeSelection, selection=tp.SelectionPb(
+                conditions=[scalar("LtInt", colref(1), int_const(3))])),
+        ],
+        output_offsets=[0, 1, 3],
+    )
+    dag, pbreq, resp = run_wire_request(data)
+    assert pbreq.start_ts_fallback == 100
+    out = encode_select_response(resp)
+    pb, rows = decode_default_rows(out, 3)
+    assert pb.encode_type == tp.EncodeType.TypeDefault
+    # col2 (= h % 7) < 3 filter over h in [0,50)
+    expected = [h for h in range(50) if h % 7 < 3]
+    assert [r[0].value for r in rows] == expected
+    assert all(r[1].value == h % 7 for r, h in zip(rows, expected))
+    assert [r[2].value for r in rows] == [f"s{h % 5}".encode() for h in expected]
+
+
+def test_agg_decimal_reencoded_as_mysql_binary():
+    data = wire_dag(
+        [
+            tp.ExecutorPb(tp=tp.ExecType.TypeTableScan,
+                          tbl_scan=tp.TableScanPb(table_id=TABLE_ID,
+                                                  columns=[pb_col(c) for c in COLS])),
+            tp.ExecutorPb(tp=tp.ExecType.TypeAggregation, aggregation=tp.AggregationPb(
+                group_by=[colref(1)],
+                agg_func=[tp.Expr(tp=tp.ExprType.Sum, children=[colref(2)])])),
+        ],
+        output_offsets=[0, 1],
+    )
+    dag, _, resp = run_wire_request(data)
+    out = encode_select_response(resp)
+    _, rows = decode_default_rows(out, 2)
+    # reference decimal datum: flag 6 + prec + frac + write_bin payload; our
+    # decoder yields (scaled, frac) back — cross-check against plain python
+    sums = {}
+    for h in range(50):
+        sums.setdefault(h % 7, 0)
+        sums[h % 7] += h * 100 + h % 3
+    got = {}
+    for r in rows:
+        scaled, frac = r[0].value
+        assert frac == 2
+        got[r[1].value] = scaled
+    assert got == sums
+
+
+def test_topn_limit_stream_agg_wire():
+    data = wire_dag(
+        [
+            tp.ExecutorPb(tp=tp.ExecType.TypeTableScan,
+                          tbl_scan=tp.TableScanPb(table_id=TABLE_ID,
+                                                  columns=[pb_col(c) for c in COLS])),
+            tp.ExecutorPb(tp=tp.ExecType.TypeTopN, top_n=tp.TopNPb(
+                order_by=[tp.ByItem(expr=colref(0), desc=True)], limit=5)),
+        ],
+        output_offsets=[0],
+    )
+    _, _, resp = run_wire_request(data)
+    _, rows = decode_default_rows(encode_select_response(resp), 1)
+    assert [r[0].value for r in rows] == [49, 48, 47, 46, 45]
+
+    data = wire_dag(
+        [
+            tp.ExecutorPb(tp=tp.ExecType.TypeTableScan,
+                          tbl_scan=tp.TableScanPb(table_id=TABLE_ID,
+                                                  columns=[pb_col(c) for c in COLS])),
+            tp.ExecutorPb(tp=tp.ExecType.TypeLimit, limit=tp.LimitPb(limit=3)),
+        ],
+        output_offsets=[0],
+    )
+    _, _, resp = run_wire_request(data)
+    _, rows = decode_default_rows(encode_select_response(resp), 1)
+    assert [r[0].value for r in rows] == [0, 1, 2]
+
+    # stream agg arrives as ExecType 6 and maps onto the streamed executor
+    data = wire_dag(
+        [
+            tp.ExecutorPb(tp=tp.ExecType.TypeTableScan,
+                          tbl_scan=tp.TableScanPb(table_id=TABLE_ID,
+                                                  columns=[pb_col(c) for c in COLS])),
+            tp.ExecutorPb(tp=tp.ExecType.TypeStreamAgg, aggregation=tp.AggregationPb(
+                group_by=[colref(0)],
+                agg_func=[tp.Expr(tp=tp.ExprType.Count, children=[int_const(1)])])),
+        ],
+        output_offsets=[0, 1],
+    )
+    dag, _, resp = run_wire_request(data)
+    assert dag.executors[1].streamed
+    _, rows = decode_default_rows(encode_select_response(resp), 2)
+    assert len(rows) == 50 and all(r[0].value == 1 for r in rows)
+
+
+def test_type_chunk_encoding():
+    data = wire_dag(
+        [
+            tp.ExecutorPb(tp=tp.ExecType.TypeTableScan,
+                          tbl_scan=tp.TableScanPb(table_id=TABLE_ID,
+                                                  columns=[pb_col(c) for c in COLS])),
+        ],
+        output_offsets=[0, 1, 2, 3],
+    )
+    _, _, resp = run_wire_request(data)
+    fts = [c.ftype for c in COLS]
+    out = encode_select_response(resp, encode_type=tp.EncodeType.TypeChunk,
+                                 field_types=fts)
+    pb = tp.SelectResponse.decode(out)
+    assert pb.encode_type == tp.EncodeType.TypeChunk
+    cols = decode_chunk(pb.chunks[0].rows_data, fts)
+    assert cols[0].rows == 50
+    assert column_values(cols[0]) == list(range(50))
+    assert column_values(cols[1]) == [h % 7 for h in range(50)]
+    assert column_values(cols[2]) == [(h * 100 + h % 3, 2) for h in range(50)]
+    assert column_values(cols[3]) == [f"s{h % 5}".encode() for h in range(50)]
+
+
+# ---------------------------------------------------------------------------
+# chunk codec units
+# ---------------------------------------------------------------------------
+
+def test_chunk_column_nulls_and_bitmap():
+    ft = FieldType.int64()
+    c = ChunkColumn(ft)
+    vals = [1, None, -5, None, 2**62, 0, None]
+    for v in vals:
+        c.append(v)
+    enc = c.encode()
+    # layout: rows, null_cnt, bitmap present (null_cnt>0)
+    import struct
+
+    rows, nulls = struct.unpack_from("<II", enc, 0)
+    assert (rows, nulls) == (7, 3)
+    [out] = decode_chunk(enc, [ft])
+    assert column_values(out) == vals
+
+
+def test_chunk_no_nulls_omits_bitmap():
+    ft = FieldType.int64()
+    c = ChunkColumn(ft)
+    for v in (1, 2, 3):
+        c.append(v)
+    assert len(c.encode()) == 8 + 3 * 8  # header + data, no bitmap
+    [out] = decode_chunk(c.encode(), [ft])
+    assert column_values(out) == [1, 2, 3]
+
+
+def test_chunk_varlen_offsets():
+    ft = FieldType.varchar()
+    c = ChunkColumn(ft)
+    vals = [b"", b"abc", None, b"x" * 100]
+    for v in vals:
+        c.append(v)
+    [out] = decode_chunk(c.encode(), [ft])
+    assert column_values(out) == vals
+
+
+@pytest.mark.parametrize("unscaled,frac", [
+    (0, 0), (0, 2), (1, 0), (-1, 0), (12345, 2), (-12345, 2),
+    (10**17, 4), (-(10**17), 4), (999999999, 0), (1000000000, 0),
+    (123456789012345678, 9), (5, 5),
+])
+def test_decimal_struct_roundtrip(unscaled, frac):
+    cell = encode_decimal_cell(unscaled, frac)
+    assert len(cell) == 40
+    got = decode_decimal_cell(cell)
+    assert got == (unscaled, frac)
+
+
+def test_decimal_struct_layout_vector():
+    # 1234567890123.45: int words [1234, 567890123], frac word 450000000
+    import struct
+
+    cell = encode_decimal_cell(123456789012345, 2)
+    int_cnt, frac_cnt, rf, neg, *words = struct.unpack("<BBBB9I", cell)
+    assert (int_cnt, frac_cnt, rf, neg) == (13, 2, 2, 0)
+    assert words[:3] == [1234, 567890123, 450000000]
+    assert all(w == 0 for w in words[3:])
+
+
+def test_chunk_float32_width():
+    ft = FieldType(tp=FieldTypeTp.FLOAT)
+    c = ChunkColumn(ft)
+    c.append(1.5)
+    c.append(-2.25)
+    assert len(c.encode()) == 8 + 2 * 4
+    [out] = decode_chunk(c.encode(), [ft])
+    assert column_values(out) == [1.5, -2.25]
+
+
+def test_chunk_time_duration_fixed8():
+    for tp_, v in ((FieldTypeTp.DATETIME, 2**40 + 5), (FieldTypeTp.DURATION, -3_600_000_000_000)):
+        ft = FieldType(tp=tp_)
+        c = ChunkColumn(ft)
+        c.append(v)
+        c.append(None)
+        [out] = decode_chunk(c.encode(), [ft])
+        assert column_values(out) == [v, None]

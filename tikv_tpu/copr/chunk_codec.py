@@ -1,0 +1,246 @@
+"""Arrow-like chunk response codec (tipb EncodeType::TypeChunk).
+
+Byte layout per column, re-expressed from the reference's
+``tidb_query_datatype/src/codec/chunk/column.rs:938`` (write_chunk_column) and
+``:910`` (decode):
+
+    u32le row_count | u32le null_cnt
+    | null bitmap ((rows+7)/8 bytes, bit=1 ⇒ NOT null, LSB-first)   iff null_cnt>0
+    | (rows+1) × i64le end-offsets                                  iff var-len
+    | cell data (fixed_len × rows for fixed-width columns)
+
+Fixed widths follow ``column.rs:47-63`` Column::new: 8 bytes for ints,
+doubles, duration and packed times, 4 for float32, 40 for the decimal struct
+(``decimal.rs:887`` DECIMAL_STRUCT_SIZE); strings/bytes/json/enum/set are
+var-len.  The decimal cell is the reference's in-memory ``Decimal`` struct
+(int_cnt, frac_cnt, result_frac_cnt, negative, 9 base-1e9 words); times ride
+their packed-u64 wire form and durations are i64 nanoseconds
+(``duration.rs:614``).
+"""
+
+from __future__ import annotations
+
+import struct
+
+from .datatypes import EvalType, FieldType, FieldTypeTp
+
+DECIMAL_STRUCT_SIZE = 40
+_DIGITS_PER_WORD = 9
+_WORD_BUF_LEN = 9
+
+_FIXED_LEN = {
+    FieldTypeTp.TINY: 8,
+    FieldTypeTp.SHORT: 8,
+    FieldTypeTp.INT24: 8,
+    FieldTypeTp.LONG: 8,
+    FieldTypeTp.LONGLONG: 8,
+    FieldTypeTp.DOUBLE: 8,
+    FieldTypeTp.FLOAT: 4,
+    FieldTypeTp.DURATION: 8,
+    FieldTypeTp.DATE: 8,
+    FieldTypeTp.DATETIME: 8,
+    FieldTypeTp.TIMESTAMP: 8,
+    FieldTypeTp.NEW_DECIMAL: DECIMAL_STRUCT_SIZE,
+}
+
+
+def fixed_len(ft: FieldType) -> int:
+    """0 means var-len."""
+    return _FIXED_LEN.get(ft.tp, 0)
+
+
+# ---------------------------------------------------------------------------
+# decimal struct cells
+# ---------------------------------------------------------------------------
+
+def encode_decimal_cell(unscaled: int, frac: int, result_frac: int | None = None) -> bytes:
+    """(unscaled, frac) -> the 40-byte Decimal struct."""
+    neg = unscaled < 0
+    digits = str(-unscaled if neg else unscaled)
+    if frac:
+        digits = digits.rjust(frac + 1, "0")
+        int_part, frac_part = digits[:-frac], digits[-frac:]
+    else:
+        int_part, frac_part = digits, ""
+    int_part = int_part.lstrip("0")
+    int_cnt = len(int_part) if (int_part or frac_part) else 1
+    words = []
+    if int_part:
+        first = len(int_part) % _DIGITS_PER_WORD or _DIGITS_PER_WORD
+        words.append(int(int_part[:first]))
+        for i in range(first, len(int_part), _DIGITS_PER_WORD):
+            words.append(int(int_part[i:i + _DIGITS_PER_WORD]))
+    for i in range(0, len(frac_part), _DIGITS_PER_WORD):
+        words.append(int(frac_part[i:i + _DIGITS_PER_WORD].ljust(_DIGITS_PER_WORD, "0")))
+    if len(words) > _WORD_BUF_LEN:
+        raise ValueError("decimal exceeds 81 digits")
+    words += [0] * (_WORD_BUF_LEN - len(words))
+    rf = frac if result_frac is None else result_frac
+    return struct.pack("<BBBB9I", int_cnt, frac, rf, 1 if neg else 0, *words)
+
+
+def decode_decimal_cell(cell: bytes) -> tuple[int, int]:
+    """40-byte Decimal struct -> (unscaled, frac)."""
+    int_cnt, frac_cnt, _rf, neg, *words = struct.unpack("<BBBB9I", cell)
+    int_words = (int_cnt + _DIGITS_PER_WORD - 1) // _DIGITS_PER_WORD
+    frac_words = (frac_cnt + _DIGITS_PER_WORD - 1) // _DIGITS_PER_WORD
+    int_val = 0
+    for w in words[:int_words]:
+        int_val = int_val * 10**_DIGITS_PER_WORD + w
+    frac_str = "".join(
+        str(w).rjust(_DIGITS_PER_WORD, "0") for w in words[int_words:int_words + frac_words]
+    )[:frac_cnt]
+    unscaled = int_val * 10**frac_cnt + int(frac_str or "0")
+    return (-unscaled if neg else unscaled), frac_cnt
+
+
+# ---------------------------------------------------------------------------
+# column encode / decode
+# ---------------------------------------------------------------------------
+
+class ChunkColumn:
+    """Append-oriented builder mirroring column.rs Column."""
+
+    def __init__(self, ft: FieldType):
+        self.ft = ft
+        self.fixed = fixed_len(ft)
+        self.rows = 0
+        self.null_cnt = 0
+        self.bitmap = bytearray()
+        self.offsets = [0]  # var-len only
+        self.data = bytearray()
+
+    def _bit(self, on: bool) -> None:
+        idx, pos = divmod(self.rows, 8)
+        if idx >= len(self.bitmap):
+            self.bitmap.append(0)
+        if on:
+            self.bitmap[idx] |= 1 << pos
+
+    def append_null(self) -> None:
+        self._bit(False)
+        self.null_cnt += 1
+        if self.fixed:
+            self.data += b"\x00" * self.fixed
+        else:
+            self.offsets.append(self.offsets[-1])
+        self.rows += 1
+
+    def append_raw(self, cell: bytes) -> None:
+        self._bit(True)
+        if self.fixed and len(cell) != self.fixed:
+            raise ValueError(f"cell width {len(cell)} != {self.fixed}")
+        self.data += cell
+        if not self.fixed:
+            self.offsets.append(len(self.data))
+        self.rows += 1
+
+    def append(self, value) -> None:
+        """Append a python-domain value for this column's field type."""
+        if value is None:
+            self.append_null()
+            return
+        et = self.ft.eval_type
+        if et == EvalType.INT:
+            self.append_raw(struct.pack("<q", value) if not self.ft.is_unsigned
+                            else struct.pack("<Q", value & (1 << 64) - 1))
+        elif et == EvalType.REAL:
+            self.append_raw(struct.pack("<f" if self.fixed == 4 else "<d", value))
+        elif et == EvalType.DECIMAL:
+            unscaled, frac = value if isinstance(value, tuple) else (value, self.ft.decimal)
+            self.append_raw(encode_decimal_cell(unscaled, frac))
+        elif et == EvalType.DATETIME:
+            self.append_raw(struct.pack("<Q", value & (1 << 64) - 1))
+        elif et == EvalType.DURATION:
+            self.append_raw(struct.pack("<q", value))
+        elif et == EvalType.ENUM:
+            # u64 1-based index + name bytes (TiDB enum chunk layout)
+            idx = int(value)
+            name = self.ft.elems[idx - 1] if 0 < idx <= len(self.ft.elems) else b""
+            self.append_raw(struct.pack("<Q", idx) + name)
+        else:  # BYTES / JSON / SET ride their binary payloads
+            self.append_raw(bytes(value))
+
+    def encode(self) -> bytes:
+        out = bytearray()
+        out += struct.pack("<II", self.rows, self.null_cnt)
+        if self.null_cnt > 0:
+            out += self.bitmap[: (self.rows + 7) // 8]
+        if not self.fixed:
+            for off in self.offsets:
+                out += struct.pack("<q", off)
+        out += self.data
+        return bytes(out)
+
+
+def decode_column(buf: bytes, pos: int, ft: FieldType) -> tuple["ChunkColumn", int]:
+    rows, null_cnt = struct.unpack_from("<II", buf, pos)
+    pos += 8
+    col = ChunkColumn(ft)
+    col.rows = rows
+    col.null_cnt = null_cnt
+    nbytes = (rows + 7) // 8
+    if null_cnt > 0:
+        col.bitmap = bytearray(buf[pos:pos + nbytes])
+        pos += nbytes
+    else:
+        col.bitmap = bytearray(b"\xff" * nbytes)
+    if col.fixed:
+        dl = col.fixed * rows
+        col.offsets = []
+    else:
+        col.offsets = [
+            struct.unpack_from("<q", buf, pos + 8 * i)[0] for i in range(rows + 1)
+        ]
+        pos += 8 * (rows + 1)
+        dl = col.offsets[-1] if col.offsets else 0
+    if pos + dl > len(buf):
+        raise ValueError("truncated chunk column")
+    col.data = bytearray(buf[pos:pos + dl])
+    return col, pos + dl
+
+
+def column_values(col: ChunkColumn) -> list:
+    """Decode a column back to python-domain values (None for nulls)."""
+    out = []
+    ft = col.ft
+    et = ft.eval_type
+    for i in range(col.rows):
+        if not (col.bitmap[i >> 3] >> (i & 7)) & 1:
+            out.append(None)
+            continue
+        if col.fixed:
+            cell = bytes(col.data[i * col.fixed:(i + 1) * col.fixed])
+        else:
+            cell = bytes(col.data[col.offsets[i]:col.offsets[i + 1]])
+        if et == EvalType.INT:
+            out.append(struct.unpack("<Q" if ft.is_unsigned else "<q", cell)[0])
+        elif et == EvalType.REAL:
+            out.append(struct.unpack("<f" if col.fixed == 4 else "<d", cell)[0])
+        elif et == EvalType.DECIMAL:
+            out.append(decode_decimal_cell(cell))
+        elif et == EvalType.DATETIME:
+            out.append(struct.unpack("<Q", cell)[0])
+        elif et == EvalType.DURATION:
+            out.append(struct.unpack("<q", cell)[0])
+        elif et == EvalType.ENUM:
+            out.append(struct.unpack_from("<Q", cell)[0])
+        else:
+            out.append(cell)
+    return out
+
+
+def encode_chunk(columns: list[ChunkColumn]) -> bytes:
+    """chunk.rs:98 write_chunk — columns back to back."""
+    return b"".join(c.encode() for c in columns)
+
+
+def decode_chunk(buf: bytes, field_types: list[FieldType]) -> list[ChunkColumn]:
+    pos = 0
+    cols = []
+    for ft in field_types:
+        col, pos = decode_column(buf, pos, ft)
+        cols.append(col)
+    if pos != len(buf):
+        raise ValueError(f"trailing {len(buf) - pos} bytes after chunk")
+    return cols

@@ -1,0 +1,225 @@
+"""ScalarFuncSig -> kernel-name mapping (single source of truth).
+
+The reference dispatches ~386 `ScalarFuncSig` arms
+(tidb_query_expr/src/lib.rs:300); this framework's dtype-generic kernels fold
+those families many-to-one.  Used by scripts/catalog_coverage.py to generate
+CATALOG.md and by copr.tipb_bridge to translate wire-format sig numbers into
+kernel calls.
+"""
+
+from __future__ import annotations
+
+import re as _re
+
+ALIASES = {
+    # type-variant folds (dtype-generic kernels)
+    "AbsInt": "abs", "AbsUInt": "abs", "AbsReal": "abs", "AbsDecimal": "abs",
+    "CeilReal": "ceil", "CeilIntToInt": "ceil", "CeilIntToDec": "ceil",
+    "CeilDecToInt": "ceil", "CeilDecToDec": "ceil",
+    "FloorReal": "floor", "FloorIntToInt": "floor", "FloorIntToDec": "floor",
+    "FloorDecToInt": "floor", "FloorDecToDec": "floor",
+    "RoundReal": "round_real", "RoundInt": "round_int_frac", "RoundDec": "round_real_frac",
+    "RoundWithFracReal": "round_real_frac", "RoundWithFracInt": "round_int_frac",
+    "RoundWithFracDec": "round_real_frac",
+    "TruncateInt": "truncate_int_frac", "TruncateReal": "truncate_real_frac",
+    "TruncateDecimal": "truncate_real_frac", "TruncateUint": "truncate_int_frac",
+    "Atan1Arg": "atan", "Atan2Args": "atan2",
+    "Log1Arg": "ln", "Log2Args": "log_base", "Log2": "log2", "Log10": "log10",
+    "Pow": "pow", "Conv": "conv", "CRC32": "crc32", "Sign": "sign", "Sqrt": "sqrt",
+    "Degrees": "degrees", "Radians": "radians", "Exp": "exp",
+    "Sin": "sin", "Cos": "cos", "Tan": "tan", "Cot": "cot",
+    "Asin": "asin", "Acos": "acos",
+    # comparison folds (per-type Lt/Le/...)
+    **{f"{op}{t}": op.lower()
+       for op in ("Lt", "Le", "Gt", "Ge", "Eq", "Ne")
+       for t in ("Int", "Real", "Decimal", "String", "Time", "Duration", "Json")},
+    **{f"NullEq{t}": "null_eq"
+       for t in ("Int", "Real", "Decimal", "String", "Time", "Duration", "Json")},
+    **{f"Coalesce{t}": "coalesce"
+       for t in ("Int", "Real", "Decimal", "String", "Time", "Duration", "Json")},
+    **{f"Greatest{t}": k for t, k in [
+        ("Int", "greatest"), ("Real", "greatest_real"), ("Decimal", "greatest"),
+        ("String", "greatest_string"), ("Time", "greatest"), ("Datetime", "greatest"),
+        ("Date", "greatest"), ("Duration", "greatest"), ("CmpStringAsTime", "greatest_string"),
+        ("CmpStringAsDate", "greatest_string"),
+    ]},
+    **{f"Least{t}": k for t, k in [
+        ("Int", "least"), ("Real", "least_real"), ("Decimal", "least"),
+        ("String", "least_string"), ("Time", "least"), ("Datetime", "least"),
+        ("Date", "least"), ("Duration", "least"), ("CmpStringAsTime", "least_string"),
+        ("CmpStringAsDate", "least_string"),
+    ]},
+    **{f"Interval{t}": "interval_int" for t in ("Int", "Real")},
+    **{f"In{t}": "in" for t in ("Int", "Real", "Decimal", "String", "Time", "Duration", "Json")},
+    # arithmetic folds
+    **{f"{a}{t}": k for a, k in [
+        ("Plus", "plus"), ("Minus", "minus"), ("Multiply", "multiply"),
+    ] for t in ("Int", "IntUnsigned", "Real", "Decimal",
+                "IntUnsignedUnsigned", "IntUnsignedSigned", "IntSignedUnsigned")},
+    "DivideReal": "divide_real", "DivideDecimal": "divide_real",
+    "IntDivideInt": "int_divide", "IntDivideDecimal": "int_divide",
+    "ModInt": "mod", "ModIntUnsignedSigned": "mod", "ModIntSignedUnsigned": "mod",
+    "ModIntUnsignedUnsigned": "mod", "ModReal": "mod", "ModDecimal": "mod",
+    "UnaryMinusInt": "unary_minus", "UnaryMinusReal": "unary_minus",
+    "UnaryMinusDecimal": "unary_minus", "UnaryNot": "not", "UnaryNotInt": "not",
+    "UnaryNotReal": "not", "UnaryNotDecimal": "not", "UnaryNotJson": "not",
+    # logical / bit
+    "LogicalAnd": "and", "LogicalOr": "or", "LogicalXor": "xor",
+    "BitAndSig": "bit_and", "BitOrSig": "bit_or", "BitXorSig": "bit_xor",
+    "BitNegSig": "bit_neg", "LeftShift": "left_shift", "RightShift": "right_shift",
+    # is-null / truth tests
+    **{f"{t}IsNull": "is_null"
+       for t in ("Int", "Real", "Decimal", "String", "Time", "Duration", "Json")},
+    "IntIsTrue": "is_true", "RealIsTrue": "is_true", "DecimalIsTrue": "is_true",
+    "IntIsTrueWithNull": "is_true", "RealIsTrueWithNull": "is_true",
+    "DecimalIsTrueWithNull": "is_true",
+    "IntIsFalse": "is_false", "RealIsFalse": "is_false", "DecimalIsFalse": "is_false",
+    "IntIsFalseWithNull": "is_false", "RealIsFalseWithNull": "is_false",
+    "DecimalIsFalseWithNull": "is_false",
+    # control
+    **{f"If{t}": "if" for t in ("Int", "Real", "Decimal", "String", "Time", "Duration", "Json")},
+    **{f"IfNull{t}": "if_null"
+       for t in ("Int", "Real", "Decimal", "String", "Time", "Duration", "Json")},
+    **{f"CaseWhen{t}": "case_when"
+       for t in ("Int", "Real", "Decimal", "String", "Time", "Duration", "Json")},
+    # casts: 13 source x target families fold onto the cast_* kernels
+    **{f"Cast{a}As{b}": f"cast_{a.lower()}_{b.lower()}".replace("time", "datetime")
+       for a in ("Int", "Real", "Decimal", "String", "Time", "Duration", "Json")
+       for b in ("Int", "Real", "Decimal", "String", "Time", "Duration", "Json")},
+    # string family names
+    "Length": "length", "BitLength": "bit_length", "Ascii": "ascii",
+    "Reverse": "reverse", "ReverseUtf8": "reverse_utf8",
+    "Upper": "upper", "UpperUtf8": "upper", "Lower": "lower", "LowerUtf8": "lower",
+    "Left": "left", "LeftUtf8": "left_utf8", "Right": "right", "RightUtf8": "right_utf8",
+    "LTrim": "ltrim", "RTrim": "rtrim",
+    "Trim1Arg": "trim", "Trim2Args": "trim2", "Trim3Args": "trim2",
+    "Repeat": "repeat", "Replace": "replace", "Space": "space",
+    "Strcmp": "strcmp", "Instr": "instr", "InstrUtf8": "instr",
+    "Locate2Args": "locate", "Locate3Args": "locate3",
+    "LocateBinary2Args": "locate", "LocateBinary3Args": "locate3",
+    "Concat": "concat", "ConcatWs": "concat_ws", "Elt": "elt", "Field": "field",
+    "FieldInt": "field", "FieldReal": "field", "FieldString": "field",
+    "FindInSet": "find_in_set", "HexStrArg": "hex", "HexIntArg": "hex",
+    "UnHex": "unhex", "Bin": "bin_int", "OctInt": "oct_int", "OctString": "oct_int",
+    "CharLength": "char_length", "CharLengthUtf8": "char_length_utf8",
+    "ToBase64": "to_base64", "FromBase64": "from_base64",
+    "Lpad": "lpad", "LpadUtf8": "lpad", "Rpad": "rpad", "RpadUtf8": "rpad",
+    "Substring2Args": "substr2", "Substring3Args": "substr3",
+    "Substring2ArgsUtf8": "substr_utf8_2", "Substring3ArgsUtf8": "substr_utf8_3",
+    "SubstringIndex": "substring_index", "MakeSet": "make_set",
+    "InsertStr": "insert_str", "Insert": "insert_str", "InsertUtf8": "insert_str",
+    "Ord": "ord", "Quote": "quote", "FormatWithLocale": "format", "Format": "format",
+    "ExportSet3Arg": "export_set3", "ExportSet4Arg": "export_set4",
+    "ExportSet5Arg": "export_set5", "CharFunc": "char_fn", "Soundex": "soundex",
+    "Mid": "mid", "Position": "position",
+    "LikeSig": "like", "RegexpSig": "regexp", "RegexpUtf8Sig": "regexp",
+    "RegexpLikeSig": "regexp_like", "RegexpInStrSig": "regexp_instr",
+    "RegexpReplaceSig": "regexp_replace", "RegexpSubstrSig": "regexp_substr",
+    # encryption
+    "Md5": "md5", "Sha1": "sha1", "Sha2": "sha2", "Compress": "compress",
+    "Uncompress": "uncompress", "UncompressedLength": "uncompressed_length",
+    "Password": "password",
+    # time
+    "Year": "year", "Month": "month", "DayOfMonth": "day_of_month",
+    "DayOfWeek": "day_of_week", "DayOfYear": "day_of_year", "Hour": "hour",
+    "Minute": "minute", "Second": "second", "MicroSecond": "micro_second",
+    "DayName": "day_name", "MonthName": "month_name", "LastDay": "last_day",
+    "WeekDay": "week_day", "WeekOfYear": "week_of_year",
+    "WeekWithMode": "week_with_mode", "WeekWithoutMode": "week_of_year",
+    "YearWeekWithMode": "year_week", "YearWeekWithoutMode": "year_week",
+    "Quarter": "quarter", "ToDays": "to_days", "ToSeconds": "to_seconds",
+    "FromDays": "from_days", "MakeDate": "makedate", "MakeTime": "maketime",
+    "PeriodAdd": "period_add", "PeriodDiff": "period_diff",
+    "DateDiff": "date_diff", "NullTimeDiff": "timediff",
+    "TimeToSec": "time_to_sec", "SecToTime": "sec_to_time",
+    "AddDatetimeAndDuration": "add_datetime_duration",
+    "SubDatetimeAndDuration": "sub_datetime_duration",
+    "AddDurationAndDuration": "add_duration",
+    "SubDurationAndDuration": "sub_duration",
+    "AddDateAndDuration": "add_datetime_duration",
+    "SubDateAndDuration": "sub_datetime_duration",
+    "ConvertTz": "convert_tz", "GetFormat": "get_format",
+    "DateFormatSig": "date_format", "TimeFormat": "time_format",
+    "StrToDateDate": "str_to_date", "StrToDateDatetime": "str_to_date",
+    "StrToDateDuration": "str_to_date",
+    "UnixTimestampInt": "unix_timestamp", "UnixTimestampDec": "unix_timestamp",
+    "UnixTimestampCurrent": "~ctx", "FromUnixTime1Arg": "from_unixtime",
+    "FromUnixTime2Arg": "from_unixtime", "ExtractDatetime": "extract_datetime",
+    "ExtractDatetimeFromString": "extract_datetime", "ExtractDuration": "extract_datetime",
+    "AddDateStringInt": "date_add", "AddDateStringString": "date_add",
+    "AddDateIntString": "date_add", "AddDateIntInt": "date_add",
+    "AddDateDatetimeInt": "date_add", "AddDateDatetimeString": "date_add",
+    "SubDateStringInt": "date_sub", "SubDateStringString": "date_sub",
+    "SubDateIntString": "date_sub", "SubDateIntInt": "date_sub",
+    "SubDateDatetimeInt": "date_sub", "SubDateDatetimeString": "date_sub",
+    "Date": "cast_datetime_date", "DurationDurationTimeDiff": "sub_duration",
+    "Locate2ArgsUtf8": "locate", "Locate3ArgsUtf8": "locate3",
+    "PlusIntSignedSigned": "plus",
+    "Pi": "~const-fold", "Rand": "~nondeterministic",
+    "RandWithSeedFirstGen": "~nondeterministic", "RandomBytes": "~nondeterministic",
+    "AddDateAndString": "add_date_and_string",
+    "AddDatetimeAndString": "add_datetime_and_string",
+    "AddDurationAndString": "add_duration_and_string",
+    "AddStringAndDuration": "add_string_and_duration",
+    "SubDatetimeAndString": "sub_datetime_and_string",
+    "SubStringAndDuration": "sub_string_and_duration",
+    "DurationHour": "duration_hours", "DurationMinute": "minute",
+    "DurationSecond": "second", "DurationMicroSecond": "micro_second",
+    "TimestampDiff": "timestamp_diff_days", "AddTimeDateTimeNull": "add_datetime_duration",
+    "AddTimeDurationNull": "add_duration", "AddTimeStringNull": "add_time_string_null",
+    # json
+    "JsonArraySig": "json_array", "JsonObjectSig": "json_object",
+    "JsonExtractSig": "json_extract", "JsonUnquoteSig": "json_unquote",
+    "JsonTypeSig": "json_type", "JsonSetSig": "json_set",
+    "JsonInsertSig": "json_insert", "JsonReplaceSig": "json_replace",
+    "JsonRemoveSig": "json_remove", "JsonMergeSig": "json_merge",
+    "JsonMergePatchSig": "json_merge_patch", "JsonMergePreserveSig": "json_merge",
+    "JsonContainsSig": "json_contains", "JsonContainsPathSig": "json_contains_path",
+    "JsonLengthSig": "json_length", "JsonDepthSig": "json_depth",
+    "JsonKeysSig": "json_keys", "JsonKeys2ArgsSig": "json_keys",
+    "JsonValidJsonSig": "json_valid", "JsonValidStringSig": "json_valid",
+    "JsonValidOthersSig": "json_valid", "JsonQuoteSig": "json_quote",
+    "JsonSearchSig": "json_search", "JsonStorageSizeSig": "json_storage_size",
+    "JsonPrettySig": "json_pretty", "JsonArrayAppendSig": "json_array_append",
+    "JsonArrayInsertSig": "json_array_insert", "JsonMemberOfSig": "json_member_of",
+    "JsonOverlapsSig": "json_overlaps",
+    # miscellaneous
+    "InetAton": "inet_aton", "InetNtoa": "inet_ntoa",
+    "Inet6Aton": "inet6_aton", "Inet6Ntoa": "inet6_ntoa",
+    "IsIPv4": "is_ipv4", "IsIPv6": "is_ipv6",
+    "IsIPv4Compat": "is_ipv4_compat", "IsIPv4Mapped": "is_ipv4_mapped",
+    "AnyValue": "any_value", "UUID": "~nondeterministic", "Uuid": "~nondeterministic",
+    "CoalesceBytes": "coalesce", "GreatestCmpStringAsTime": "greatest_string",
+    "IntAnyValue": "any_value", "RealAnyValue": "any_value",
+    "StringAnyValue": "any_value", "DecimalAnyValue": "any_value",
+    "TimeAnyValue": "any_value", "DurationAnyValue": "any_value",
+    "JsonAnyValue": "any_value",
+}
+
+# sigs deliberately out of scope, with reasons (the honest "no" column)
+UNSUPPORTED = {
+    "~ctx": "needs evaluation-context wall clock (non-deterministic pushdown)",
+    "~const-fold": "constant; folded by the planner before pushdown",
+    "~frac": "needs frac-aware bytes plumbing (decimal formatting)",
+    "~nondeterministic": "non-deterministic function",
+}
+
+
+def camel_to_snake(name: str) -> str:
+    s = _re.sub(r"(.)([A-Z][a-z]+)", r"\1_\2", name)
+    return _re.sub(r"([a-z0-9])([A-Z])", r"\1_\2", s).lower()
+
+
+def resolve_sig(sig_name: str, kernels=None) -> str | None:
+    """Map a reference ScalarFuncSig name to this framework's kernel name.
+
+    Returns None when unmapped; a "~"-prefixed result means deliberately
+    unsupported (see UNSUPPORTED for the reason).
+    """
+    mapped = ALIASES.get(sig_name)
+    if mapped is not None:
+        return mapped
+    if kernels is None:
+        from .kernels import KERNELS as kernels
+    snake = camel_to_snake(sig_name)
+    return snake if snake in kernels else None

@@ -1,0 +1,307 @@
+"""tipb wire <-> internal plan/response bridge.
+
+Decodes a protobuf ``tipb.DAGRequest`` (the bytes TiDB puts in
+``coprocessor.Request.data``, src/coprocessor/mod.rs parse path) into this
+framework's internal ``DagRequest``, and encodes internal ``SelectResponse``s
+back into protobuf ``tipb.SelectResponse`` bytes in either encode type:
+
+* ``TypeDefault`` — datum-encoded rows.  Internal chunks already hold
+  reference-format datums except decimals (internally a compact
+  frac+i64-scaled pair); those are re-encoded as MySQL binary decimals
+  (decimal.rs write_bin) so the wire bytes follow the reference contract.
+* ``TypeChunk`` — the Arrow-like column layout (chunk_codec), which needs the
+  output schema's field types.
+
+Expression trees translate through the ScalarFuncSig tables: wire sig number
+-> CamelCase name (proto.tipb_pb.SIG_NAME) -> kernel (copr.sig_map).
+"""
+
+from __future__ import annotations
+
+from ..proto import tipb_pb as tp
+from ..util import codec
+from . import datum as datum_mod
+from .aggr import AggDescriptor
+from .chunk_codec import ChunkColumn, encode_chunk
+from .dag import (
+    Aggregation,
+    DagRequest,
+    IndexScan,
+    Limit,
+    Selection,
+    SelectResponse,
+    TableScan,
+    TopN,
+)
+from .datatypes import ColumnInfo, FieldType, FieldTypeTp
+from .mydecimal import MyDecimal
+from .rpn import FuncCall, call, col, const_bytes, const_decimal, const_int, const_real
+from .sig_map import resolve_sig
+
+# MySQL collation id -> this framework's collator name (negative ids are the
+# "new collation" namespace TiDB uses on the wire; same collation either way)
+_COLLATION_IDS = {
+    63: "binary",
+    46: "utf8mb4_bin",
+    45: "utf8mb4_general_ci",
+    224: "utf8mb4_unicode_ci",
+    33: "utf8mb4_general_ci",   # utf8_general_ci folds
+    83: "utf8mb4_bin",          # utf8_bin folds
+    192: "utf8mb4_unicode_ci",  # utf8_unicode_ci folds
+}
+
+_AGG_OPS = {
+    tp.ExprType.Count: "count",
+    tp.ExprType.Sum: "sum",
+    tp.ExprType.Avg: "avg",
+    tp.ExprType.Min: "min",
+    tp.ExprType.Max: "max",
+    tp.ExprType.First: "first",
+    tp.ExprType.AggBitAnd: "bit_and",
+    tp.ExprType.AggBitOr: "bit_or",
+    tp.ExprType.AggBitXor: "bit_xor",
+    tp.ExprType.VarPop: "var_pop",
+}
+
+
+class TipbError(ValueError):
+    pass
+
+
+def field_type_from_pb(ci: tp.ColumnInfoPb) -> FieldType:
+    collation = _COLLATION_IDS.get(abs(getattr(ci, "collation", 0) or 0), "binary")
+    return FieldType(
+        tp=FieldTypeTp(ci.tp),
+        flag=getattr(ci, "flag", 0) or 0,
+        flen=getattr(ci, "column_len", -1) or -1,
+        decimal=getattr(ci, "decimal", 0) or 0,
+        collation=collation,
+    )
+
+
+def column_info_from_pb(ci: tp.ColumnInfoPb) -> ColumnInfo:
+    return ColumnInfo(
+        col_id=ci.column_id,
+        ftype=field_type_from_pb(ci),
+        is_pk_handle=bool(getattr(ci, "pk_handle", False)),
+    )
+
+
+def expr_from_pb(e: tp.Expr):
+    """tipb Expr tree -> internal expression (rpn builders)."""
+    t = e.tp
+    val = e.val or b""
+    if t == tp.ExprType.ColumnRef:
+        return col(codec.decode_i64(val, 0))
+    if t == tp.ExprType.Int64:
+        return const_int(codec.decode_i64(val, 0))
+    if t == tp.ExprType.Uint64:
+        return const_int(codec.decode_u64(val, 0))
+    if t == tp.ExprType.Null:
+        return const_int(None)
+    if t in (tp.ExprType.Float64, tp.ExprType.Float32):
+        return const_real(codec.decode_f64(val, 0))
+    if t in (tp.ExprType.String, tp.ExprType.Bytes):
+        return const_bytes(val)
+    if t == tp.ExprType.MysqlDecimal:
+        prec, frac = val[0], val[1]
+        d, _ = MyDecimal.decode_bin(val[2:], prec, frac)
+        scaled, dfrac = d.to_i64_scaled()
+        return const_decimal(scaled, dfrac)
+    if t == tp.ExprType.MysqlDuration:
+        from .rpn import Constant
+        from .datatypes import EvalType
+
+        return Constant(codec.decode_i64(val, 0), EvalType.DURATION)
+    if t == tp.ExprType.MysqlTime:
+        from .rpn import Constant
+        from .datatypes import EvalType
+
+        return Constant(codec.decode_u64(val, 0), EvalType.DATETIME)
+    if t == tp.ExprType.MysqlJson:
+        from .rpn import const_json
+        from .json_value import decode_json_binary
+
+        return const_json(decode_json_binary(val))
+    if t == tp.ExprType.ScalarFunc:
+        name = tp.SIG_NAME.get(e.sig)
+        if name is None:
+            raise TipbError(f"unknown ScalarFuncSig {e.sig}")
+        kernel = resolve_sig(name)
+        if kernel is None or kernel.startswith("~"):
+            raise TipbError(f"unsupported sig {name}")
+        return call(kernel, *[expr_from_pb(c) for c in e.children])
+    raise TipbError(f"unsupported ExprType {t}")
+
+
+def agg_from_pb(e: tp.Expr) -> AggDescriptor:
+    op = _AGG_OPS.get(e.tp)
+    if op is None:
+        raise TipbError(f"unsupported aggregate ExprType {e.tp}")
+    arg = None
+    if e.children:
+        arg = expr_from_pb(e.children[0])
+        if op == "count" and not isinstance(arg, FuncCall) and getattr(arg, "value", 1) is not None \
+                and not hasattr(arg, "index"):
+            arg = None  # count(const) == count(1) == count(*)
+    return AggDescriptor(op, arg)
+
+
+def dag_from_pb(pb: tp.DAGRequest) -> DagRequest:
+    execs = []
+    for ex in pb.executors:
+        t = ex.tp
+        if t == tp.ExecType.TypeTableScan:
+            s = ex.tbl_scan
+            execs.append(TableScan(s.table_id, [column_info_from_pb(c) for c in s.columns]))
+        elif t == tp.ExecType.TypeIndexScan:
+            s = ex.idx_scan
+            execs.append(IndexScan(s.table_id, s.index_id,
+                                   [column_info_from_pb(c) for c in s.columns]))
+        elif t == tp.ExecType.TypeSelection:
+            execs.append(Selection([expr_from_pb(c) for c in ex.selection.conditions]))
+        elif t in (tp.ExecType.TypeAggregation, tp.ExecType.TypeStreamAgg):
+            a = ex.aggregation
+            execs.append(Aggregation(
+                [expr_from_pb(g) for g in a.group_by],
+                [agg_from_pb(f) for f in a.agg_func],
+                streamed=(t == tp.ExecType.TypeStreamAgg),
+            ))
+        elif t == tp.ExecType.TypeTopN:
+            n = ex.top_n
+            execs.append(TopN([(expr_from_pb(b.expr), bool(b.desc)) for b in n.order_by],
+                              n.limit))
+        elif t == tp.ExecType.TypeLimit:
+            execs.append(Limit(ex.limit.limit))
+        else:
+            raise TipbError(f"unsupported ExecType {t}")
+    offsets = list(pb.output_offsets) or None
+    return DagRequest(executors=execs, output_offsets=offsets)
+
+
+def decode_dag_request(data: bytes) -> tuple[DagRequest, tp.DAGRequest]:
+    pb = tp.DAGRequest.decode(data)
+    return dag_from_pb(pb), pb
+
+
+# ---------------------------------------------------------------------------
+# responses
+# ---------------------------------------------------------------------------
+
+def _reencode_rows_data(chunk: bytes) -> bytes:
+    """Internal chunk (ncols-prefixed rows of datums) -> reference rows_data.
+
+    Datums are copied verbatim except decimals, whose internal compact form
+    (flag 6, frac u8, i64 scaled) becomes the reference's
+    flag+prec+frac+write_bin form (codec/datum.rs).
+    """
+    out = bytearray()
+    off = 0
+    n = len(chunk)
+    while off < n:
+        ncols, off = codec.decode_var_u64(chunk, off)
+        for _ in range(ncols):
+            start = off
+            d, off = datum_mod.decode_datum(chunk, start)
+            if d.flag == datum_mod.DECIMAL_FLAG:
+                scaled, frac = d.value
+                dec = MyDecimal(scaled, frac)
+                prec = max(dec.precision, frac + 1)
+                out.append(datum_mod.DECIMAL_FLAG)
+                out.append(prec)
+                out.append(frac)
+                out += dec.encode_bin(prec, frac)
+            else:
+                out += chunk[start:off]
+    return bytes(out)
+
+
+def _chunk_columns(chunk: bytes, field_types: list[FieldType]) -> bytes:
+    """Internal chunk -> TypeChunk column block."""
+    cols = [ChunkColumn(ft) for ft in field_types]
+    off = 0
+    n = len(chunk)
+    while off < n:
+        ncols, off = codec.decode_var_u64(chunk, off)
+        if ncols != len(field_types):
+            raise TipbError(f"row has {ncols} cols, schema has {len(field_types)}")
+        for c in cols:
+            d, off = datum_mod.decode_datum(chunk, off)
+            c.append(d.value if d.flag != datum_mod.NIL_FLAG else None)
+    return encode_chunk(cols)
+
+
+def encode_select_response(
+    resp: SelectResponse,
+    encode_type: int = tp.EncodeType.TypeDefault,
+    field_types: list[FieldType] | None = None,
+    output_counts: list[int] | None = None,
+) -> bytes:
+    """Internal SelectResponse -> protobuf tipb.SelectResponse bytes."""
+    pb = tp.SelectResponse()
+    if encode_type == tp.EncodeType.TypeChunk:
+        if field_types is None:
+            raise TipbError("TypeChunk needs the output schema's field types")
+        pb.chunks = [tp.ChunkPb(rows_data=_chunk_columns(c, field_types))
+                     for c in resp.chunks]
+    else:
+        pb.chunks = [tp.ChunkPb(rows_data=_reencode_rows_data(c))
+                     for c in resp.chunks]
+    pb.encode_type = encode_type
+    if resp.warnings:
+        pb.warnings = [tp.ErrorPb(code=1105, msg=w) for w in resp.warnings]
+        pb.warning_count = len(resp.warnings)
+    if output_counts:
+        pb.output_counts = list(output_counts)
+    if resp.exec_summaries:
+        pb.execution_summaries = [
+            tp.ExecutorExecutionSummary(
+                num_produced_rows=s.num_produced_rows,
+                num_iterations=s.num_iterations,
+            )
+            for s in resp.exec_summaries
+        ]
+    return pb.encode()
+
+
+def internal_response_to_tipb(data: bytes, encode_type: int = tp.EncodeType.TypeDefault,
+                              field_types: list[FieldType] | None = None) -> bytes:
+    """Re-frame an internal SelectResponse.encode() payload as tipb bytes.
+
+    The internal framing is var_u64 chunk count, then len-prefixed chunks,
+    then len-prefixed warning strings (dag.py SelectResponse.encode)."""
+    from .dag import SelectResponse as InternalResp
+
+    off = 0
+    nchunks, off = codec.decode_var_u64(data, off)
+    chunks = []
+    for _ in range(nchunks):
+        ln, off = codec.decode_var_u64(data, off)
+        chunks.append(data[off:off + ln])
+        off += ln
+    warnings = []
+    if off < len(data):
+        nw, off = codec.decode_var_u64(data, off)
+        for _ in range(nw):
+            ln, off = codec.decode_var_u64(data, off)
+            warnings.append(data[off:off + ln].decode())
+            off += ln
+    resp = InternalResp(chunks=chunks, warnings=warnings)
+    return encode_select_response(resp, encode_type, field_types)
+
+
+def decode_ref_datum(buf: bytes, off: int = 0):
+    """Decode one reference-format datum (codec/datum.rs) — like the internal
+    decoder except decimals carry prec+frac+write_bin payloads."""
+    flag = buf[off]
+    if flag == datum_mod.DECIMAL_FLAG:
+        prec, frac = buf[off + 1], buf[off + 2]
+        d, used = MyDecimal.decode_bin(buf[off + 3:], prec, frac)
+        scaled, dfrac = d.to_i64_scaled()
+        return datum_mod.Datum(flag, (scaled, dfrac)), off + 3 + used
+    return datum_mod.decode_datum(buf, off)
+
+
+def error_response(msg: str, code: int = 1105) -> bytes:
+    pb = tp.SelectResponse(error=tp.ErrorPb(code=code, msg=msg))
+    return pb.encode()

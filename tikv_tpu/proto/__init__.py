@@ -1,0 +1,19 @@
+"""Protobuf-compatible wire layer (kvproto / tipb contract).
+
+The reference's entire external contract is protobuf over gRPC: TiDB sends
+``tipb.DAGRequest`` inside ``coprocessor.Request.data`` and expects
+``tipb.SelectResponse`` bytes back inside ``coprocessor.Response.data``
+(src/server/service/kv.rs:129-303, Cargo.toml:165,220).  This package
+implements that contract with a hand-rolled, dependency-free protobuf codec:
+
+* ``wire``       — varint / tag / length-delimited primitives and a
+                   declarative ``PbMessage`` base (proto2 + proto3 semantics)
+* ``tipb_pb``    — the tipb messages the coprocessor speaks
+* ``kvproto_pb`` — coprocessor.Request/Response, kvrpcpb txn/raw messages,
+                   errorpb subset
+
+Field numbers are reconstructed from the public pingcap/kvproto and
+pingcap/tipb protos the reference pins; differential tests compile the
+reconstructed ``.proto`` files with the baked-in protoc and assert
+byte-identical encodings against the real protobuf runtime.
+"""

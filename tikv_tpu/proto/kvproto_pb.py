@@ -1,0 +1,763 @@
+"""kvproto message definitions (pingcap/kvproto contract, proto3).
+
+The request/response pairs for every handler the reference's gRPC service
+exposes in src/server/service/kv.rs:129-303: txn KV (get/scan/prewrite/
+commit/…), raw KV, coprocessor, and the shared metapb/errorpb submessages.
+
+Field numbers are reconstructed from the public pingcap/kvproto protos the
+reference pins (Cargo.toml:165); see tipb_pb.py's docstring for the fidelity
+caveat and tests/test_proto_wire.py for the protoc differential tests.
+"""
+
+from __future__ import annotations
+
+from .wire import (
+    Field as F,
+    K_BOOL,
+    K_BYTES,
+    K_INT,
+    K_MSG,
+    K_STR,
+    PbMessage,
+)
+
+
+class Kv(PbMessage):
+    SYNTAX = 3
+
+
+def U(n, name, **kw):
+    return F(n, name, K_INT, signed=False, **kw)
+
+
+def I64(n, name, **kw):
+    return F(n, name, K_INT, **kw)
+
+
+def B(n, name, **kw):
+    return F(n, name, K_BOOL, **kw)
+
+
+def Y(n, name, **kw):
+    return F(n, name, K_BYTES, **kw)
+
+
+def S(n, name, **kw):
+    return F(n, name, K_STR, **kw)
+
+
+def M(n, name, mt, **kw):
+    return F(n, name, K_MSG, msg_type=mt, **kw)
+
+
+# ---------------------------------------------------------------------------
+# metapb.proto
+# ---------------------------------------------------------------------------
+
+class PeerRole:
+    Voter = 0
+    Learner = 1
+    IncomingVoter = 2
+    DemotingVoter = 3
+
+
+class RegionEpoch(Kv):
+    FIELDS = (U(1, "conf_ver"), U(2, "version"))
+
+
+class Peer(Kv):
+    FIELDS = (U(1, "id"), U(2, "store_id"), U(3, "role"))
+
+
+class Region(Kv):
+    FIELDS = (
+        U(1, "id"),
+        Y(2, "start_key"),
+        Y(3, "end_key"),
+        M(4, "region_epoch", lambda: RegionEpoch),
+        M(5, "peers", lambda: Peer, repeated=True),
+    )
+
+
+class Store(Kv):
+    FIELDS = (
+        U(1, "id"),
+        S(2, "address"),
+        U(3, "state"),
+        S(21, "status_address"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# errorpb.proto
+# ---------------------------------------------------------------------------
+
+class NotLeader(Kv):
+    FIELDS = (U(1, "region_id"), M(2, "leader", lambda: Peer))
+
+
+class RegionNotFound(Kv):
+    FIELDS = (U(1, "region_id"),)
+
+
+class KeyNotInRegion(Kv):
+    FIELDS = (Y(1, "key"), U(2, "region_id"), Y(3, "start_key"), Y(4, "end_key"))
+
+
+class EpochNotMatch(Kv):
+    FIELDS = (M(1, "current_regions", lambda: Region, repeated=True),)
+
+
+class ServerIsBusy(Kv):
+    FIELDS = (S(1, "reason"), U(2, "backoff_ms"))
+
+
+class StaleCommand(Kv):
+    FIELDS = ()
+
+
+class StoreNotMatch(Kv):
+    FIELDS = (U(1, "request_store_id"), U(2, "actual_store_id"))
+
+
+class RaftEntryTooLarge(Kv):
+    FIELDS = (U(1, "region_id"), U(2, "entry_size"))
+
+
+class RegionError(Kv):
+    """errorpb.Error."""
+
+    FIELDS = (
+        S(1, "message"),
+        M(2, "not_leader", lambda: NotLeader),
+        M(3, "region_not_found", lambda: RegionNotFound),
+        M(4, "key_not_in_region", lambda: KeyNotInRegion),
+        M(5, "epoch_not_match", lambda: EpochNotMatch),
+        M(6, "server_is_busy", lambda: ServerIsBusy),
+        M(7, "stale_command", lambda: StaleCommand),
+        M(8, "store_not_match", lambda: StoreNotMatch),
+        M(9, "raft_entry_too_large", lambda: RaftEntryTooLarge),
+    )
+
+
+# ---------------------------------------------------------------------------
+# kvrpcpb.proto — shared
+# ---------------------------------------------------------------------------
+
+class CommandPri:
+    Normal = 0
+    Low = 1
+    High = 2
+
+
+class IsolationLevel:
+    SI = 0
+    RC = 1
+
+
+class Op:
+    Put = 0
+    Del = 1
+    Lock = 2
+    Rollback = 3
+    PessimisticLock = 4
+    CheckNotExists = 5
+
+
+class Action:
+    NoAction = 0
+    TTLExpireRollback = 1
+    LockNotExistRollback = 2
+    MinCommitTSPushed = 3
+    LockNotExistDoNothing = 4
+
+
+class Context(Kv):
+    FIELDS = (
+        U(1, "region_id"),
+        M(2, "region_epoch", lambda: RegionEpoch),
+        M(3, "peer", lambda: Peer),
+        U(5, "term"),
+        U(6, "priority"),
+        U(7, "isolation_level"),
+        B(8, "not_fill_cache"),
+        B(9, "sync_log"),
+        B(10, "record_time_stat"),
+        B(11, "record_scan_stat"),
+        B(12, "replica_read"),
+        U(13, "resolved_locks", repeated=True, packed=True),
+        U(14, "max_execution_duration_ms"),
+        U(15, "applied_index"),
+        U(16, "task_id"),
+        B(17, "stale_read"),
+    )
+
+
+class LockInfo(Kv):
+    FIELDS = (
+        Y(1, "primary_lock"),
+        U(2, "lock_version"),
+        Y(3, "key"),
+        U(4, "lock_ttl"),
+        U(5, "txn_size"),
+        U(6, "lock_type"),
+        U(7, "lock_for_update_ts"),
+        B(8, "use_async_commit"),
+        U(9, "min_commit_ts"),
+        Y(10, "secondaries", repeated=True),
+    )
+
+
+class WriteConflict(Kv):
+    FIELDS = (
+        U(1, "start_ts"),
+        U(2, "conflict_ts"),
+        Y(3, "key"),
+        Y(4, "primary"),
+        U(5, "conflict_commit_ts"),
+    )
+
+
+class AlreadyExist(Kv):
+    FIELDS = (Y(1, "key"),)
+
+
+class Deadlock(Kv):
+    FIELDS = (U(1, "lock_ts"), Y(2, "lock_key"), U(3, "deadlock_key_hash"))
+
+
+class CommitTsExpired(Kv):
+    FIELDS = (U(1, "start_ts"), U(2, "attempted_commit_ts"), Y(3, "key"),
+              U(4, "min_commit_ts"))
+
+
+class TxnNotFound(Kv):
+    FIELDS = (U(1, "start_ts"), Y(2, "primary_key"))
+
+
+class CommitTsTooLarge(Kv):
+    FIELDS = (U(1, "commit_ts"),)
+
+
+class KeyError(Kv):
+    FIELDS = (
+        M(1, "locked", lambda: LockInfo),
+        S(2, "retryable"),
+        S(3, "abort"),
+        M(4, "conflict", lambda: WriteConflict),
+        M(5, "already_exist", lambda: AlreadyExist),
+        M(6, "deadlock", lambda: Deadlock),
+        M(7, "commit_ts_expired", lambda: CommitTsExpired),
+        M(8, "txn_not_found", lambda: TxnNotFound),
+        M(9, "commit_ts_too_large", lambda: CommitTsTooLarge),
+    )
+
+
+class KvPair(Kv):
+    FIELDS = (M(1, "error", lambda: KeyError), Y(2, "key"), Y(3, "value"))
+
+
+class Mutation(Kv):
+    FIELDS = (U(1, "op"), Y(2, "key"), Y(3, "value"), U(4, "assertion"))
+
+
+class TimeDetail(Kv):
+    FIELDS = (I64(1, "wait_wall_time_ms"), I64(2, "process_wall_time_ms"),
+              I64(3, "total_rpc_wall_time_ns"))
+
+
+class ScanInfo(Kv):
+    FIELDS = (I64(1, "total"), I64(2, "processed"), I64(3, "read_bytes"))
+
+
+class ScanDetail(Kv):
+    FIELDS = (M(1, "write", lambda: ScanInfo), M(2, "lock", lambda: ScanInfo),
+              M(3, "data", lambda: ScanInfo))
+
+
+class ScanDetailV2(Kv):
+    FIELDS = (
+        U(1, "processed_versions"),
+        U(2, "total_versions"),
+        U(3, "rocksdb_delete_skipped_count"),
+        U(4, "rocksdb_key_skipped_count"),
+        U(5, "rocksdb_block_cache_hit_count"),
+        U(6, "rocksdb_block_read_count"),
+        U(7, "rocksdb_block_read_byte"),
+        U(8, "processed_versions_size"),
+    )
+
+
+class ExecDetails(Kv):
+    FIELDS = (M(1, "time_detail", lambda: TimeDetail),
+              M(2, "scan_detail", lambda: ScanDetail))
+
+
+class ExecDetailsV2(Kv):
+    FIELDS = (M(1, "time_detail", lambda: TimeDetail),
+              M(2, "scan_detail_v2", lambda: ScanDetailV2))
+
+
+# ---------------------------------------------------------------------------
+# kvrpcpb.proto — txn KV request/response pairs (kv.rs:159-240)
+# ---------------------------------------------------------------------------
+
+class GetRequest(Kv):
+    FIELDS = (M(1, "context", lambda: Context), Y(2, "key"), U(3, "version"))
+
+
+class GetResponse(Kv):
+    FIELDS = (
+        M(1, "region_error", lambda: RegionError),
+        M(2, "error", lambda: KeyError),
+        Y(3, "value"),
+        B(4, "not_found"),
+        M(6, "exec_details_v2", lambda: ExecDetailsV2),
+    )
+
+
+class ScanRequest(Kv):
+    FIELDS = (
+        M(1, "context", lambda: Context),
+        Y(2, "start_key"),
+        U(3, "limit"),
+        U(4, "version"),
+        B(5, "key_only"),
+        B(6, "reverse"),
+        Y(7, "end_key"),
+        U(8, "sample_step"),
+    )
+
+
+class ScanResponse(Kv):
+    FIELDS = (
+        M(1, "region_error", lambda: RegionError),
+        M(2, "pairs", lambda: KvPair, repeated=True),
+        M(3, "error", lambda: KeyError),
+    )
+
+
+class PrewriteRequest(Kv):
+    FIELDS = (
+        M(1, "context", lambda: Context),
+        M(2, "mutations", lambda: Mutation, repeated=True),
+        Y(3, "primary_lock"),
+        U(4, "start_version"),
+        U(5, "lock_ttl"),
+        B(6, "skip_constraint_check"),
+        B(7, "is_pessimistic_lock", repeated=True, packed=True),
+        U(8, "txn_size"),
+        U(9, "for_update_ts"),
+        U(10, "min_commit_ts"),
+        B(11, "use_async_commit"),
+        Y(12, "secondaries", repeated=True),
+        B(13, "try_one_pc"),
+        U(14, "max_commit_ts"),
+    )
+
+
+class PrewriteResponse(Kv):
+    FIELDS = (
+        M(1, "region_error", lambda: RegionError),
+        M(2, "errors", lambda: KeyError, repeated=True),
+        U(3, "min_commit_ts"),
+        U(4, "one_pc_commit_ts"),
+    )
+
+
+class CommitRequest(Kv):
+    FIELDS = (
+        M(1, "context", lambda: Context),
+        U(2, "start_version"),
+        Y(3, "keys", repeated=True),
+        U(4, "commit_version"),
+    )
+
+
+class CommitResponse(Kv):
+    FIELDS = (
+        M(1, "region_error", lambda: RegionError),
+        M(2, "error", lambda: KeyError),
+        U(3, "commit_version"),
+    )
+
+
+class BatchGetRequest(Kv):
+    FIELDS = (M(1, "context", lambda: Context), Y(2, "keys", repeated=True),
+              U(3, "version"))
+
+
+class BatchGetResponse(Kv):
+    FIELDS = (
+        M(1, "region_error", lambda: RegionError),
+        M(2, "pairs", lambda: KvPair, repeated=True),
+        M(4, "error", lambda: KeyError),
+    )
+
+
+class BatchRollbackRequest(Kv):
+    FIELDS = (M(1, "context", lambda: Context), U(2, "start_version"),
+              Y(3, "keys", repeated=True))
+
+
+class BatchRollbackResponse(Kv):
+    FIELDS = (M(1, "region_error", lambda: RegionError),
+              M(2, "error", lambda: KeyError))
+
+
+class CleanupRequest(Kv):
+    FIELDS = (M(1, "context", lambda: Context), Y(2, "key"),
+              U(3, "start_version"), U(4, "current_ts"))
+
+
+class CleanupResponse(Kv):
+    FIELDS = (M(1, "region_error", lambda: RegionError),
+              M(2, "error", lambda: KeyError), U(3, "commit_version"))
+
+
+class ScanLockRequest(Kv):
+    FIELDS = (
+        M(1, "context", lambda: Context),
+        U(2, "max_version"),
+        Y(3, "start_key"),
+        U(4, "limit"),
+        Y(5, "end_key"),
+    )
+
+
+class ScanLockResponse(Kv):
+    FIELDS = (
+        M(1, "region_error", lambda: RegionError),
+        M(2, "error", lambda: KeyError),
+        M(3, "locks", lambda: LockInfo, repeated=True),
+    )
+
+
+class TxnInfo(Kv):
+    FIELDS = (U(1, "txn"), U(2, "status"))
+
+
+class ResolveLockRequest(Kv):
+    FIELDS = (
+        M(1, "context", lambda: Context),
+        U(2, "start_version"),
+        U(3, "commit_version"),
+        M(4, "txn_infos", lambda: TxnInfo, repeated=True),
+        Y(5, "keys", repeated=True),
+    )
+
+
+class ResolveLockResponse(Kv):
+    FIELDS = (M(1, "region_error", lambda: RegionError),
+              M(2, "error", lambda: KeyError))
+
+
+class TxnHeartBeatRequest(Kv):
+    FIELDS = (M(1, "context", lambda: Context), Y(2, "primary_lock"),
+              U(3, "start_version"), U(4, "advise_lock_ttl"))
+
+
+class TxnHeartBeatResponse(Kv):
+    FIELDS = (M(1, "region_error", lambda: RegionError),
+              M(2, "error", lambda: KeyError), U(3, "lock_ttl"))
+
+
+class CheckTxnStatusRequest(Kv):
+    FIELDS = (
+        M(1, "context", lambda: Context),
+        Y(2, "primary_key"),
+        U(3, "lock_ts"),
+        U(4, "caller_start_ts"),
+        U(5, "current_ts"),
+        B(6, "rollback_if_not_exist"),
+        B(7, "force_sync_commit"),
+        B(8, "resolving_pessimistic_lock"),
+    )
+
+
+class CheckTxnStatusResponse(Kv):
+    FIELDS = (
+        M(1, "region_error", lambda: RegionError),
+        M(2, "error", lambda: KeyError),
+        U(3, "lock_ttl"),
+        U(4, "commit_version"),
+        U(5, "action"),
+        M(6, "lock_info", lambda: LockInfo),
+    )
+
+
+class CheckSecondaryLocksRequest(Kv):
+    FIELDS = (M(1, "context", lambda: Context), Y(2, "keys", repeated=True),
+              U(3, "start_version"))
+
+
+class CheckSecondaryLocksResponse(Kv):
+    FIELDS = (
+        M(1, "region_error", lambda: RegionError),
+        M(2, "error", lambda: KeyError),
+        M(3, "locks", lambda: LockInfo, repeated=True),
+        U(4, "commit_ts"),
+    )
+
+
+class PessimisticLockRequest(Kv):
+    FIELDS = (
+        M(1, "context", lambda: Context),
+        M(2, "mutations", lambda: Mutation, repeated=True),
+        Y(3, "primary_lock"),
+        U(4, "start_version"),
+        U(5, "lock_ttl"),
+        U(6, "for_update_ts"),
+        B(7, "is_first_lock"),
+        I64(8, "wait_timeout"),
+        B(9, "force"),
+        B(10, "return_values"),
+        U(11, "min_commit_ts"),
+        B(12, "check_existence"),
+    )
+
+
+class PessimisticLockResponse(Kv):
+    FIELDS = (
+        M(1, "region_error", lambda: RegionError),
+        M(2, "errors", lambda: KeyError, repeated=True),
+        U(3, "commit_ts"),
+        Y(4, "values", repeated=True),
+        B(5, "not_founds", repeated=True, packed=True),
+    )
+
+
+class PessimisticRollbackRequest(Kv):
+    FIELDS = (M(1, "context", lambda: Context), U(2, "start_version"),
+              U(3, "for_update_ts"), Y(4, "keys", repeated=True))
+
+
+class PessimisticRollbackResponse(Kv):
+    FIELDS = (M(1, "region_error", lambda: RegionError),
+              M(2, "errors", lambda: KeyError, repeated=True))
+
+
+class DeleteRangeRequest(Kv):
+    FIELDS = (M(1, "context", lambda: Context), Y(2, "start_key"),
+              Y(3, "end_key"), B(4, "notify_only"))
+
+
+class DeleteRangeResponse(Kv):
+    FIELDS = (M(1, "region_error", lambda: RegionError), S(2, "error"))
+
+
+class GCRequest(Kv):
+    FIELDS = (M(1, "context", lambda: Context), U(2, "safe_point"))
+
+
+class GCResponse(Kv):
+    FIELDS = (M(1, "region_error", lambda: RegionError),
+              M(2, "error", lambda: KeyError))
+
+
+# ---------------------------------------------------------------------------
+# kvrpcpb.proto — raw KV
+# ---------------------------------------------------------------------------
+
+class RawGetRequest(Kv):
+    FIELDS = (M(1, "context", lambda: Context), Y(2, "key"), S(3, "cf"))
+
+
+class RawGetResponse(Kv):
+    FIELDS = (M(1, "region_error", lambda: RegionError), S(2, "error"),
+              Y(3, "value"), B(4, "not_found"))
+
+
+class RawPutRequest(Kv):
+    FIELDS = (M(1, "context", lambda: Context), Y(2, "key"), Y(3, "value"),
+              S(4, "cf"), U(5, "ttl"), B(6, "for_cas"))
+
+
+class RawPutResponse(Kv):
+    FIELDS = (M(1, "region_error", lambda: RegionError), S(2, "error"))
+
+
+class RawDeleteRequest(Kv):
+    FIELDS = (M(1, "context", lambda: Context), Y(2, "key"), S(3, "cf"),
+              B(4, "for_cas"))
+
+
+class RawDeleteResponse(Kv):
+    FIELDS = (M(1, "region_error", lambda: RegionError), S(2, "error"))
+
+
+class RawScanRequest(Kv):
+    FIELDS = (
+        M(1, "context", lambda: Context),
+        Y(2, "start_key"),
+        U(3, "limit"),
+        B(4, "key_only"),
+        S(5, "cf"),
+        B(6, "reverse"),
+        Y(7, "end_key"),
+    )
+
+
+class RawScanResponse(Kv):
+    FIELDS = (M(1, "region_error", lambda: RegionError),
+              M(2, "kvs", lambda: KvPair, repeated=True))
+
+
+class RawBatchGetRequest(Kv):
+    FIELDS = (M(1, "context", lambda: Context), Y(2, "keys", repeated=True),
+              S(3, "cf"))
+
+
+class RawBatchGetResponse(Kv):
+    FIELDS = (M(1, "region_error", lambda: RegionError),
+              M(2, "pairs", lambda: KvPair, repeated=True))
+
+
+class RawBatchPutRequest(Kv):
+    FIELDS = (
+        M(1, "context", lambda: Context),
+        M(2, "pairs", lambda: KvPair, repeated=True),
+        S(3, "cf"),
+        U(4, "ttl"),
+        B(5, "for_cas"),
+        U(6, "ttls", repeated=True, packed=True),
+    )
+
+
+class RawBatchPutResponse(Kv):
+    FIELDS = (M(1, "region_error", lambda: RegionError), S(2, "error"))
+
+
+class RawBatchDeleteRequest(Kv):
+    FIELDS = (M(1, "context", lambda: Context), Y(2, "keys", repeated=True),
+              S(3, "cf"), B(4, "for_cas"))
+
+
+class RawBatchDeleteResponse(Kv):
+    FIELDS = (M(1, "region_error", lambda: RegionError), S(2, "error"))
+
+
+class RawDeleteRangeRequest(Kv):
+    FIELDS = (M(1, "context", lambda: Context), Y(2, "start_key"),
+              Y(3, "end_key"), S(4, "cf"))
+
+
+class RawDeleteRangeResponse(Kv):
+    FIELDS = (M(1, "region_error", lambda: RegionError), S(2, "error"))
+
+
+class RawCasRequest(Kv):
+    FIELDS = (
+        M(1, "context", lambda: Context),
+        Y(2, "key"),
+        Y(3, "value"),
+        B(4, "previous_not_exist"),
+        Y(5, "previous_value"),
+        S(6, "cf"),
+        U(7, "ttl"),
+    )
+
+
+class RawCasResponse(Kv):
+    FIELDS = (
+        M(1, "region_error", lambda: RegionError),
+        S(2, "error"),
+        B(3, "succeed"),
+        Y(4, "previous_value"),
+        B(5, "previous_not_exist"),
+    )
+
+
+class RawGetKeyTtlRequest(Kv):
+    FIELDS = (M(1, "context", lambda: Context), Y(2, "key"), S(3, "cf"))
+
+
+class RawGetKeyTtlResponse(Kv):
+    FIELDS = (M(1, "region_error", lambda: RegionError), S(2, "error"),
+              U(3, "ttl"), B(4, "not_found"))
+
+
+# ---------------------------------------------------------------------------
+# kvrpcpb.proto — debug (MVCC introspection)
+# ---------------------------------------------------------------------------
+
+class MvccValue(Kv):
+    FIELDS = (U(1, "start_ts"), Y(2, "value"))
+
+
+class MvccLock(Kv):
+    FIELDS = (U(1, "type"), U(2, "start_ts"), Y(3, "primary"), Y(4, "short_value"))
+
+
+class MvccWrite(Kv):
+    FIELDS = (U(1, "type"), U(2, "start_ts"), U(3, "commit_ts"), Y(4, "short_value"))
+
+
+class MvccInfo(Kv):
+    FIELDS = (
+        M(1, "lock", lambda: MvccLock),
+        M(2, "writes", lambda: MvccWrite, repeated=True),
+        M(3, "values", lambda: MvccValue, repeated=True),
+    )
+
+
+class MvccGetByKeyRequest(Kv):
+    FIELDS = (M(1, "context", lambda: Context), Y(2, "key"))
+
+
+class MvccGetByKeyResponse(Kv):
+    FIELDS = (M(1, "region_error", lambda: RegionError), S(2, "error"),
+              M(3, "info", lambda: MvccInfo))
+
+
+class MvccGetByStartTsRequest(Kv):
+    FIELDS = (M(1, "context", lambda: Context), U(2, "start_ts"))
+
+
+class MvccGetByStartTsResponse(Kv):
+    FIELDS = (M(1, "region_error", lambda: RegionError), S(2, "error"),
+              Y(3, "key"), M(4, "info", lambda: MvccInfo))
+
+
+# ---------------------------------------------------------------------------
+# coprocessor.proto
+# ---------------------------------------------------------------------------
+
+class KeyRange(Kv):
+    FIELDS = (Y(1, "start"), Y(2, "end"))
+
+
+class CoprRequestPb(Kv):
+    """coprocessor.Request — tp 103 = DAG, 104 = Analyze, 105 = Checksum."""
+
+    FIELDS = (
+        M(1, "context", lambda: Context),
+        I64(2, "tp"),
+        Y(3, "data"),
+        M(4, "ranges", lambda: KeyRange, repeated=True),
+        B(5, "is_cache_enabled"),
+        U(6, "cache_if_match_version"),
+        U(7, "start_ts"),
+    )
+
+
+class CoprResponsePb(Kv):
+    """coprocessor.Response."""
+
+    FIELDS = (
+        Y(1, "data"),
+        M(2, "region_error", lambda: RegionError),
+        M(3, "locked", lambda: LockInfo),
+        S(4, "other_error"),
+        M(5, "range", lambda: KeyRange),
+        M(6, "exec_details", lambda: ExecDetails),
+        B(7, "is_cache_hit"),
+        U(8, "cache_last_version"),
+        B(9, "can_be_cached"),
+        M(11, "exec_details_v2", lambda: ExecDetailsV2),
+    )
+
+
+REQ_DAG = 103
+REQ_ANALYZE = 104
+REQ_CHECKSUM = 105

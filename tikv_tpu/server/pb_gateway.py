@@ -1,0 +1,741 @@
+"""kvproto protobuf gateway: the reference's external wire contract.
+
+Adapts protobuf request/response pairs (proto.kvproto_pb — the messages the
+reference's gRPC service speaks, src/server/service/kv.rs:129-303) onto the
+in-process ``KvService`` dict dispatch.  The transport stays this framework's
+length-framed TCP (SURVEY §2 "protocol crates" note); what rides it for a
+protobuf-mode peer is kvproto bytes:
+
+    frame = method name + kvproto Request bytes  ->  kvproto Response bytes
+
+Coprocessor requests carry a real ``tipb.DAGRequest`` in ``Request.data`` and
+return ``tipb.SelectResponse`` bytes in ``Response.data`` via copr.tipb_bridge.
+"""
+
+from __future__ import annotations
+
+from ..proto import kvproto_pb as kp
+from ..proto import tipb_pb as tp
+
+
+class PbGatewayError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# shared converters
+# ---------------------------------------------------------------------------
+
+_OP_TO_WIRE = {
+    kp.Op.Put: "put",
+    kp.Op.Del: "delete",
+    kp.Op.Lock: "lock",
+    kp.Op.CheckNotExists: "check_not_exists",
+    6: "insert",  # kvrpcpb Op::Insert
+}
+
+
+def ctx_to_dict(ctx: kp.Context | None) -> dict:
+    if ctx is None:
+        return {}
+    out = {"region_id": ctx.region_id, "term": ctx.term}
+    if ctx.region_epoch is not None:
+        out["region_epoch"] = {
+            "conf_ver": ctx.region_epoch.conf_ver,
+            "version": ctx.region_epoch.version,
+        }
+    if ctx.peer is not None:
+        out["peer"] = {"id": ctx.peer.id, "store_id": ctx.peer.store_id}
+    if ctx.replica_read:
+        out["replica_read"] = True
+    if ctx.stale_read:
+        out["stale_read"] = True
+    if ctx.priority == kp.CommandPri.High:
+        out["priority"] = "high"
+    elif ctx.priority == kp.CommandPri.Low:
+        out["priority"] = "low"
+    if ctx.task_id:
+        out["resource_group"] = ctx.task_id
+    return out
+
+
+def sched_hints(payload: bytes) -> tuple[object | None, str | None]:
+    """Cheap pre-dispatch peek at a kvproto request's Context for read-pool
+    scheduling (group, priority) — parses only the leading context field."""
+    try:
+        from ..proto.wire import read_varint
+
+        key, pos = read_varint(payload, 0)
+        if key != (1 << 3) | 2:  # field 1, LEN = Context on every request
+            return None, None
+        ln, pos = read_varint(payload, pos)
+        ctx = kp.Context.decode(payload[pos:pos + ln])
+        group = ctx.task_id or None
+        prio = "high" if ctx.priority == kp.CommandPri.High else None
+        return group, prio
+    except Exception:  # noqa: BLE001 — scheduling hint only, never fail a frame
+        return None, None
+
+
+def _key_error(err: dict) -> kp.KeyError:
+    ke = kp.KeyError()
+    if "locked" in err:
+        l = err["locked"]
+        ke.locked = kp.LockInfo(
+            primary_lock=l.get("primary", b""),
+            lock_version=l.get("lock_ts", 0),
+            key=l.get("key", b""),
+            lock_ttl=l.get("ttl", 0),
+        )
+    elif "conflict" in err:
+        c = err["conflict"]
+        ke.conflict = kp.WriteConflict(
+            start_ts=c.get("start_ts", 0),
+            conflict_ts=c.get("conflict_start_ts", 0),
+            conflict_commit_ts=c.get("conflict_commit_ts", 0),
+            key=c.get("key", b""),
+        )
+    elif "already_exists" in err:
+        ke.already_exist = kp.AlreadyExist(key=err["already_exists"].get("key", b""))
+    elif "deadlock" in err:
+        d = err["deadlock"]
+        ke.deadlock = kp.Deadlock(
+            lock_ts=d.get("blocked_on_txn", 0),
+            deadlock_key_hash=abs(hash(tuple(d.get("cycle", ())))) & (1 << 63) - 1,
+        )
+    else:
+        ke.abort = str(err.get("other", err))
+    return ke
+
+
+def _region_error(err: dict) -> kp.RegionError | None:
+    if "not_leader" in err:
+        nl = err["not_leader"]
+        out = kp.RegionError(message="not leader")
+        leader_store = nl.get("leader_store")
+        out.not_leader = kp.NotLeader(region_id=nl.get("region_id", 0) or 0)
+        if leader_store:
+            out.not_leader.leader = kp.Peer(store_id=leader_store)
+        return out
+    if "epoch_not_match" in err:
+        return kp.RegionError(message="epoch not match", epoch_not_match=kp.EpochNotMatch())
+    if "region_not_found" in err:
+        return kp.RegionError(
+            message="region not found",
+            region_not_found=kp.RegionNotFound(region_id=err["region_not_found"].get("region_id", 0)),
+        )
+    return None
+
+
+def _apply_error(resp, err: dict | None, key_error_field: str = "error",
+                 repeated: bool = False) -> None:
+    if not err:
+        return
+    re = _region_error(err)
+    if re is not None:
+        resp.region_error = re
+        return
+    ke = _key_error(err)
+    if repeated:
+        getattr(resp, key_error_field).append(ke)
+    else:
+        setattr(resp, key_error_field, ke)
+
+
+def _pairs(pairs) -> list[kp.KvPair]:
+    return [kp.KvPair(key=k, value=v) for k, v in pairs]
+
+
+# ---------------------------------------------------------------------------
+# per-RPC converters: (ReqCls, to_dict, RespCls, fill_resp)
+# ---------------------------------------------------------------------------
+
+def _r_get(q: kp.GetRequest) -> dict:
+    return {"key": q.key, "version": q.version, "context": ctx_to_dict(q.context),
+            "bypass_locks": list(q.context.resolved_locks) if q.context else []}
+
+
+def _w_get(r: dict) -> kp.GetResponse:
+    out = kp.GetResponse()
+    _apply_error(out, r.get("error"))
+    if r.get("value") is not None:
+        out.value = r["value"]
+    if r.get("not_found"):
+        out.not_found = True
+    return out
+
+
+def _r_scan(q: kp.ScanRequest) -> dict:
+    return {
+        "start_key": q.start_key, "end_key": q.end_key or None,
+        "limit": q.limit or None, "version": q.version,
+        "key_only": q.key_only, "reverse": q.reverse,
+        "context": ctx_to_dict(q.context),
+    }
+
+
+def _w_scan(r: dict) -> kp.ScanResponse:
+    out = kp.ScanResponse()
+    _apply_error(out, r.get("error"))
+    out.pairs = _pairs(r.get("pairs", []))
+    return out
+
+
+def _r_prewrite(q: kp.PrewriteRequest) -> dict:
+    muts = []
+    for m in q.mutations:
+        op = _OP_TO_WIRE.get(m.op)
+        if op is None:
+            raise PbGatewayError(f"unsupported mutation op {m.op}")
+        muts.append({"op": op, "key": m.key, "value": m.value or None})
+    return {
+        "mutations": muts,
+        "primary_lock": q.primary_lock,
+        "start_version": q.start_version,
+        "lock_ttl": q.lock_ttl or 3000,
+        "use_async_commit": q.use_async_commit,
+        "secondaries": list(q.secondaries),
+        "is_pessimistic": bool(q.for_update_ts),
+        "is_pessimistic_lock": list(q.is_pessimistic_lock),
+        "for_update_ts": q.for_update_ts,
+        "context": ctx_to_dict(q.context),
+    }
+
+
+def _w_prewrite(r: dict) -> kp.PrewriteResponse:
+    out = kp.PrewriteResponse()
+    if r.get("errors"):
+        for e in r["errors"]:
+            _apply_error(out, e, "errors", repeated=True)
+    elif r.get("error"):
+        _apply_error(out, r["error"], "errors", repeated=True)
+    if r.get("min_commit_ts"):
+        out.min_commit_ts = r["min_commit_ts"]
+    return out
+
+
+def _r_commit(q: kp.CommitRequest) -> dict:
+    return {"keys": list(q.keys), "start_version": q.start_version,
+            "commit_version": q.commit_version, "context": ctx_to_dict(q.context)}
+
+
+def _w_commit(r: dict) -> kp.CommitResponse:
+    out = kp.CommitResponse()
+    _apply_error(out, r.get("error"))
+    if r.get("commit_version"):
+        out.commit_version = r["commit_version"]
+    return out
+
+
+def _r_batch_get(q: kp.BatchGetRequest) -> dict:
+    return {"keys": list(q.keys), "version": q.version, "context": ctx_to_dict(q.context)}
+
+
+def _w_batch_get(r: dict) -> kp.BatchGetResponse:
+    out = kp.BatchGetResponse()
+    _apply_error(out, r.get("error"))
+    out.pairs = _pairs(r.get("pairs", []))
+    return out
+
+
+def _r_batch_rollback(q: kp.BatchRollbackRequest) -> dict:
+    return {"keys": list(q.keys), "start_version": q.start_version,
+            "context": ctx_to_dict(q.context)}
+
+
+def _w_simple_keyerr(cls):
+    def w(r: dict):
+        out = cls()
+        _apply_error(out, r.get("error"))
+        return out
+    return w
+
+
+def _r_cleanup(q: kp.CleanupRequest) -> dict:
+    return {"key": q.key, "start_version": q.start_version,
+            "current_ts": q.current_ts, "context": ctx_to_dict(q.context)}
+
+
+def _w_cleanup(r: dict) -> kp.CleanupResponse:
+    out = kp.CleanupResponse()
+    _apply_error(out, r.get("error"))
+    if r.get("commit_version"):
+        out.commit_version = r["commit_version"]
+    return out
+
+
+def _r_pessimistic_lock(q: kp.PessimisticLockRequest) -> dict:
+    return {
+        "keys": [m.key for m in q.mutations],
+        "primary_lock": q.primary_lock,
+        "start_version": q.start_version,
+        "for_update_ts": q.for_update_ts,
+        "lock_ttl": q.lock_ttl or 3000,
+        "return_values": q.return_values,
+        # WaitTimeout::from_encoded (reference): 0 = no wait, <0 = default
+        # wait (wait-for-lock-timeout, 1s), >0 = that many ms
+        "wait_timeout_ms": 1000 if q.wait_timeout < 0 else q.wait_timeout,
+        "context": ctx_to_dict(q.context),
+    }
+
+
+def _w_pessimistic_lock(r: dict) -> kp.PessimisticLockResponse:
+    out = kp.PessimisticLockResponse()
+    if r.get("error"):
+        _apply_error(out, r["error"], "errors", repeated=True)
+    vals = r.get("values")
+    if vals:
+        out.values = [v if v is not None else b"" for v in vals]
+        out.not_founds = [v is None for v in vals]
+    return out
+
+
+def _r_pessimistic_rollback(q: kp.PessimisticRollbackRequest) -> dict:
+    return {"keys": list(q.keys), "start_version": q.start_version,
+            "for_update_ts": q.for_update_ts, "context": ctx_to_dict(q.context)}
+
+
+def _w_pessimistic_rollback(r: dict) -> kp.PessimisticRollbackResponse:
+    out = kp.PessimisticRollbackResponse()
+    if r.get("error"):
+        _apply_error(out, r["error"], "errors", repeated=True)
+    return out
+
+
+def _r_txn_heart_beat(q: kp.TxnHeartBeatRequest) -> dict:
+    return {"primary_lock": q.primary_lock, "start_version": q.start_version,
+            "advise_lock_ttl": q.advise_lock_ttl, "context": ctx_to_dict(q.context)}
+
+
+def _w_txn_heart_beat(r: dict) -> kp.TxnHeartBeatResponse:
+    out = kp.TxnHeartBeatResponse()
+    _apply_error(out, r.get("error"))
+    if r.get("lock_ttl"):
+        out.lock_ttl = r["lock_ttl"]
+    return out
+
+
+# check_txn_status kind -> kvrpcpb Action (reference maps TxnStatus to action)
+_KIND_TO_ACTION = {
+    "ttl_expire_rollback": kp.Action.TTLExpireRollback,
+    "lock_not_exist_rollback": kp.Action.LockNotExistRollback,
+    "min_commit_ts_pushed": kp.Action.MinCommitTSPushed,
+    "lock_not_exist_do_nothing": kp.Action.LockNotExistDoNothing,
+}
+
+
+def _r_check_txn_status(q: kp.CheckTxnStatusRequest) -> dict:
+    return {
+        "primary_key": q.primary_key, "lock_ts": q.lock_ts,
+        "caller_start_ts": q.caller_start_ts, "current_ts": q.current_ts,
+        "rollback_if_not_exist": q.rollback_if_not_exist,
+        "force_sync_commit": q.force_sync_commit,
+        "context": ctx_to_dict(q.context),
+    }
+
+
+def _w_check_txn_status(r: dict) -> kp.CheckTxnStatusResponse:
+    out = kp.CheckTxnStatusResponse()
+    _apply_error(out, r.get("error"))
+    if r.get("lock_ttl"):
+        out.lock_ttl = r["lock_ttl"]
+    if r.get("commit_version"):
+        out.commit_version = r["commit_version"]
+    action = _KIND_TO_ACTION.get(r.get("kind"))
+    if action:
+        out.action = action
+    return out
+
+
+def _r_check_secondary(q: kp.CheckSecondaryLocksRequest) -> dict:
+    return {"keys": list(q.keys), "start_version": q.start_version,
+            "context": ctx_to_dict(q.context)}
+
+
+def _w_check_secondary(r: dict) -> kp.CheckSecondaryLocksResponse:
+    out = kp.CheckSecondaryLocksResponse()
+    _apply_error(out, r.get("error"))
+    out.locks = [kp.LockInfo(lock_version=l["ts"], primary_lock=l["primary"])
+                 for l in r.get("locks", [])]
+    if r.get("commit_ts"):
+        out.commit_ts = r["commit_ts"]
+    return out
+
+
+def _r_scan_lock(q: kp.ScanLockRequest) -> dict:
+    return {"start_key": q.start_key or None, "end_key": q.end_key or None,
+            "max_version": q.max_version, "limit": q.limit or None,
+            "context": ctx_to_dict(q.context)}
+
+
+def _w_scan_lock(r: dict) -> kp.ScanLockResponse:
+    out = kp.ScanLockResponse()
+    _apply_error(out, r.get("error"))
+    out.locks = [
+        kp.LockInfo(key=l["key"], primary_lock=l["primary"],
+                    lock_version=l["lock_version"], lock_ttl=l.get("ttl", 0))
+        for l in r.get("locks", [])
+    ]
+    return out
+
+
+def _r_resolve_lock(q: kp.ResolveLockRequest) -> dict:
+    return {"start_version": q.start_version, "commit_version": q.commit_version,
+            "keys": list(q.keys) or None, "context": ctx_to_dict(q.context)}
+
+
+def _r_delete_range(q: kp.DeleteRangeRequest) -> dict:
+    return {"start_key": q.start_key, "end_key": q.end_key,
+            "context": ctx_to_dict(q.context)}
+
+
+def _w_delete_range(r: dict) -> kp.DeleteRangeResponse:
+    out = kp.DeleteRangeResponse()
+    err = r.get("error")
+    if err:
+        re = _region_error(err)
+        if re is not None:
+            out.region_error = re
+        else:
+            out.error = str(err.get("other", err))
+    return out
+
+
+# -- raw KV -----------------------------------------------------------------
+
+def _raw_err(out, r: dict):
+    err = r.get("error")
+    if err:
+        re = _region_error(err)
+        if re is not None:
+            out.region_error = re
+        else:
+            out.error = str(err.get("other", err))
+    return out
+
+
+def _r_raw_get(q: kp.RawGetRequest) -> dict:
+    return {"key": q.key, "context": ctx_to_dict(q.context)}
+
+
+def _w_raw_get(r: dict) -> kp.RawGetResponse:
+    out = _raw_err(kp.RawGetResponse(), r)
+    if r.get("value") is not None:
+        out.value = r["value"]
+    if r.get("not_found"):
+        out.not_found = True
+    return out
+
+
+def _r_raw_put(q: kp.RawPutRequest) -> dict:
+    return {"key": q.key, "value": q.value, "ttl": q.ttl,
+            "context": ctx_to_dict(q.context)}
+
+
+def _r_raw_delete(q: kp.RawDeleteRequest) -> dict:
+    return {"key": q.key, "context": ctx_to_dict(q.context)}
+
+
+def _r_raw_scan(q: kp.RawScanRequest) -> dict:
+    return {"start_key": q.start_key, "end_key": q.end_key or None,
+            "limit": q.limit or None, "key_only": q.key_only,
+            "reverse": q.reverse, "context": ctx_to_dict(q.context)}
+
+
+def _w_raw_scan(r: dict) -> kp.RawScanResponse:
+    out = _raw_err(kp.RawScanResponse(), r)
+    out.kvs = _pairs(r.get("kvs", []))
+    return out
+
+
+def _r_raw_batch_get(q: kp.RawBatchGetRequest) -> dict:
+    return {"keys": list(q.keys), "context": ctx_to_dict(q.context)}
+
+
+def _w_raw_batch_get(r: dict) -> kp.RawBatchGetResponse:
+    out = _raw_err(kp.RawBatchGetResponse(), r)
+    out.pairs = _pairs(r.get("pairs", []))
+    return out
+
+
+def _r_raw_batch_put(q: kp.RawBatchPutRequest) -> dict:
+    return {"pairs": [(p.key, p.value) for p in q.pairs], "ttl": q.ttl,
+            "context": ctx_to_dict(q.context)}
+
+
+def _r_raw_batch_delete(q: kp.RawBatchDeleteRequest) -> dict:
+    return {"keys": list(q.keys), "context": ctx_to_dict(q.context)}
+
+
+def _r_raw_delete_range(q: kp.RawDeleteRangeRequest) -> dict:
+    return {"start_key": q.start_key, "end_key": q.end_key,
+            "context": ctx_to_dict(q.context)}
+
+
+def _r_raw_cas(q: kp.RawCasRequest) -> dict:
+    return {
+        "key": q.key, "value": q.value,
+        "previous_value": None if q.previous_not_exist else q.previous_value,
+        "ttl": q.ttl, "context": ctx_to_dict(q.context),
+    }
+
+
+def _w_raw_cas(r: dict) -> kp.RawCasResponse:
+    out = _raw_err(kp.RawCasResponse(), r)
+    out.succeed = bool(r.get("succeed"))
+    prev = r.get("previous_value")
+    if prev is None:
+        out.previous_not_exist = True
+    else:
+        out.previous_value = prev
+    return out
+
+
+def _r_raw_get_key_ttl(q: kp.RawGetKeyTtlRequest) -> dict:
+    return {"key": q.key, "context": ctx_to_dict(q.context)}
+
+
+def _w_raw_get_key_ttl(r: dict) -> kp.RawGetKeyTtlResponse:
+    out = _raw_err(kp.RawGetKeyTtlResponse(), r)
+    if r.get("ttl") is not None:
+        out.ttl = r["ttl"]
+    if r.get("not_found"):
+        out.not_found = True
+    return out
+
+
+# -- MVCC debug -------------------------------------------------------------
+
+def _mvcc_info(info: dict | None) -> kp.MvccInfo | None:
+    if not info:
+        return None
+    out = kp.MvccInfo()
+    lk = info.get("lock")
+    if lk:
+        out.lock = kp.MvccLock(start_ts=lk["start_ts"], primary=lk["primary"],
+                               short_value=lk.get("short_value") or b"")
+    out.writes = [
+        kp.MvccWrite(start_ts=w["start_ts"], commit_ts=w["commit_ts"],
+                     short_value=w.get("short_value") or b"")
+        for w in info.get("writes", [])
+    ]
+    out.values = [kp.MvccValue(start_ts=v["start_ts"], value=v["value"])
+                  for v in info.get("values", [])]
+    return out
+
+
+def _r_mvcc_by_key(q: kp.MvccGetByKeyRequest) -> dict:
+    return {"key": q.key, "context": ctx_to_dict(q.context)}
+
+
+def _w_mvcc_by_key(r: dict) -> kp.MvccGetByKeyResponse:
+    out = kp.MvccGetByKeyResponse()
+    if r.get("error"):
+        out.error = str(r["error"].get("other", r["error"]))
+    info = _mvcc_info(r.get("info"))
+    if info is not None:
+        out.info = info
+    return out
+
+
+def _r_mvcc_by_start_ts(q: kp.MvccGetByStartTsRequest) -> dict:
+    return {"start_ts": q.start_ts, "context": ctx_to_dict(q.context)}
+
+
+def _w_mvcc_by_start_ts(r: dict) -> kp.MvccGetByStartTsResponse:
+    out = kp.MvccGetByStartTsResponse()
+    if r.get("error"):
+        out.error = str(r["error"].get("other", r["error"]))
+    if r.get("key"):
+        out.key = r["key"]
+    info = _mvcc_info(r.get("info"))
+    if info is not None:
+        out.info = info
+    return out
+
+
+# -- coprocessor ------------------------------------------------------------
+
+def _r_coprocessor(q: kp.CoprRequestPb) -> dict:
+    from ..copr.tipb_bridge import decode_dag_request
+
+    if q.tp != kp.REQ_DAG:
+        raise PbGatewayError(f"unsupported coprocessor tp {q.tp}")
+    dag, pb = decode_dag_request(q.data)
+    return {
+        "tp": q.tp,
+        "dag": dag,
+        "ranges": [(r.start, r.end) for r in q.ranges],
+        "start_ts": q.start_ts or pb.start_ts_fallback,
+        "context": ctx_to_dict(q.context),
+        "_pb": pb,
+    }
+
+
+def _output_field_types(pb: tp.DAGRequest):
+    """Output schema for TypeChunk encoding, derived from the plan like
+    runner.rs: scan columns flow through Selection/TopN/Limit unchanged;
+    aggregation outputs have no wire-declared types, so return None there
+    (the response legally downgrades to TypeDefault, which is self-typed)."""
+    from ..copr.tipb_bridge import field_type_from_pb
+
+    schema = None
+    for ex in pb.executors:
+        if ex.tp == tp.ExecType.TypeTableScan:
+            schema = [field_type_from_pb(c) for c in ex.tbl_scan.columns]
+        elif ex.tp == tp.ExecType.TypeIndexScan:
+            schema = [field_type_from_pb(c) for c in ex.idx_scan.columns]
+        elif ex.tp in (tp.ExecType.TypeAggregation, tp.ExecType.TypeStreamAgg):
+            return None
+    if schema is None:
+        return None
+    offsets = list(pb.output_offsets) or range(len(schema))
+    return [schema[i] for i in offsets]
+
+
+def _w_coprocessor(r: dict, pb: tp.DAGRequest | None = None) -> kp.CoprResponsePb:
+    out = kp.CoprResponsePb()
+    err = r.get("error")
+    if err:
+        re = _region_error(err)
+        if re is not None:
+            out.region_error = re
+        elif "locked" in err:
+            l = err["locked"]
+            out.locked = kp.LockInfo(
+                primary_lock=l.get("primary", b""), lock_version=l.get("lock_ts", 0),
+                key=l.get("key", b""), lock_ttl=l.get("ttl", 0))
+        else:
+            out.other_error = str(err.get("other", err))
+        return out
+    from ..copr.tipb_bridge import internal_response_to_tipb
+
+    encode_type = tp.EncodeType.TypeDefault
+    field_types = None
+    if pb is not None and pb.encode_type == tp.EncodeType.TypeChunk:
+        field_types = _output_field_types(pb)
+        if field_types is not None:
+            encode_type = tp.EncodeType.TypeChunk
+    out.data = internal_response_to_tipb(r["data"], encode_type, field_types)
+    return out
+
+
+HANDLERS: dict[str, tuple] = {
+    "kv_get": (kp.GetRequest, _r_get, _w_get),
+    "kv_scan": (kp.ScanRequest, _r_scan, _w_scan),
+    "kv_prewrite": (kp.PrewriteRequest, _r_prewrite, _w_prewrite),
+    "kv_commit": (kp.CommitRequest, _r_commit, _w_commit),
+    "kv_batch_get": (kp.BatchGetRequest, _r_batch_get, _w_batch_get),
+    "kv_batch_rollback": (kp.BatchRollbackRequest, _r_batch_rollback,
+                          _w_simple_keyerr(kp.BatchRollbackResponse)),
+    "kv_cleanup": (kp.CleanupRequest, _r_cleanup, _w_cleanup),
+    "kv_pessimistic_lock": (kp.PessimisticLockRequest, _r_pessimistic_lock,
+                            _w_pessimistic_lock),
+    "kv_pessimistic_rollback": (kp.PessimisticRollbackRequest,
+                                _r_pessimistic_rollback, _w_pessimistic_rollback),
+    "kv_txn_heart_beat": (kp.TxnHeartBeatRequest, _r_txn_heart_beat, _w_txn_heart_beat),
+    "kv_check_txn_status": (kp.CheckTxnStatusRequest, _r_check_txn_status,
+                            _w_check_txn_status),
+    "kv_check_secondary_locks": (kp.CheckSecondaryLocksRequest, _r_check_secondary,
+                                 _w_check_secondary),
+    "kv_scan_lock": (kp.ScanLockRequest, _r_scan_lock, _w_scan_lock),
+    "kv_resolve_lock": (kp.ResolveLockRequest, _r_resolve_lock,
+                        _w_simple_keyerr(kp.ResolveLockResponse)),
+    "kv_delete_range": (kp.DeleteRangeRequest, _r_delete_range, _w_delete_range),
+    "raw_get": (kp.RawGetRequest, _r_raw_get, _w_raw_get),
+    "raw_put": (kp.RawPutRequest, _r_raw_put,
+                lambda r: _raw_err(kp.RawPutResponse(), r)),
+    "raw_delete": (kp.RawDeleteRequest, _r_raw_delete,
+                   lambda r: _raw_err(kp.RawDeleteResponse(), r)),
+    "raw_scan": (kp.RawScanRequest, _r_raw_scan, _w_raw_scan),
+    "raw_batch_get": (kp.RawBatchGetRequest, _r_raw_batch_get, _w_raw_batch_get),
+    "raw_batch_put": (kp.RawBatchPutRequest, _r_raw_batch_put,
+                      lambda r: _raw_err(kp.RawBatchPutResponse(), r)),
+    "raw_batch_delete": (kp.RawBatchDeleteRequest, _r_raw_batch_delete,
+                         lambda r: _raw_err(kp.RawBatchDeleteResponse(), r)),
+    "raw_delete_range": (kp.RawDeleteRangeRequest, _r_raw_delete_range,
+                         lambda r: _raw_err(kp.RawDeleteRangeResponse(), r)),
+    "raw_compare_and_swap": (kp.RawCasRequest, _r_raw_cas, _w_raw_cas),
+    "raw_get_key_ttl": (kp.RawGetKeyTtlRequest, _r_raw_get_key_ttl,
+                        _w_raw_get_key_ttl),
+    "mvcc_get_by_key": (kp.MvccGetByKeyRequest, _r_mvcc_by_key, _w_mvcc_by_key),
+    "mvcc_get_by_start_ts": (kp.MvccGetByStartTsRequest, _r_mvcc_by_start_ts,
+                             _w_mvcc_by_start_ts),
+    "coprocessor": (kp.CoprRequestPb, _r_coprocessor, _w_coprocessor),
+}
+
+
+RESPONSE_TYPES = {
+    "kv_get": kp.GetResponse,
+    "kv_scan": kp.ScanResponse,
+    "kv_prewrite": kp.PrewriteResponse,
+    "kv_commit": kp.CommitResponse,
+    "kv_batch_get": kp.BatchGetResponse,
+    "kv_batch_rollback": kp.BatchRollbackResponse,
+    "kv_cleanup": kp.CleanupResponse,
+    "kv_pessimistic_lock": kp.PessimisticLockResponse,
+    "kv_pessimistic_rollback": kp.PessimisticRollbackResponse,
+    "kv_txn_heart_beat": kp.TxnHeartBeatResponse,
+    "kv_check_txn_status": kp.CheckTxnStatusResponse,
+    "kv_check_secondary_locks": kp.CheckSecondaryLocksResponse,
+    "kv_scan_lock": kp.ScanLockResponse,
+    "kv_resolve_lock": kp.ResolveLockResponse,
+    "kv_delete_range": kp.DeleteRangeResponse,
+    "raw_get": kp.RawGetResponse,
+    "raw_put": kp.RawPutResponse,
+    "raw_delete": kp.RawDeleteResponse,
+    "raw_scan": kp.RawScanResponse,
+    "raw_batch_get": kp.RawBatchGetResponse,
+    "raw_batch_put": kp.RawBatchPutResponse,
+    "raw_batch_delete": kp.RawBatchDeleteResponse,
+    "raw_delete_range": kp.RawDeleteRangeResponse,
+    "raw_compare_and_swap": kp.RawCasResponse,
+    "raw_get_key_ttl": kp.RawGetKeyTtlResponse,
+    "mvcc_get_by_key": kp.MvccGetByKeyResponse,
+    "mvcc_get_by_start_ts": kp.MvccGetByStartTsResponse,
+    "coprocessor": kp.CoprResponsePb,
+}
+
+
+class PbClient:
+    """Protobuf-mode client: kvproto messages over the framed transport.
+
+    The reference analog is a TiDB/client-go peer speaking kvproto over gRPC
+    (kv.rs service surface); here the same messages ride ``pb/<rpc>`` frames.
+    """
+
+    def __init__(self, host: str, port: int, security=None):
+        from .server import Client
+
+        self._client = Client(host, port, security=security)
+
+    def call(self, method: str, req_msg, timeout: float = 30.0):
+        raw = self._client.call(f"pb/{method}", req_msg.encode(), timeout=timeout)
+        if isinstance(raw, dict):  # transport/gateway-level failure
+            raise PbGatewayError(str(raw.get("error", raw)))
+        return RESPONSE_TYPES[method].decode(raw)
+
+    def close(self) -> None:
+        self._client.close()
+
+
+class PbGateway:
+    """Decode kvproto request bytes, dispatch, encode kvproto response bytes."""
+
+    def __init__(self, service):
+        self.service = service
+
+    def methods(self) -> list[str]:
+        return sorted(HANDLERS)
+
+    def handle(self, method: str, payload: bytes) -> bytes:
+        entry = HANDLERS.get(method)
+        if entry is None:
+            raise PbGatewayError(f"no protobuf handler for {method!r}")
+        req_cls, to_dict, fill = entry
+        req = to_dict(req_cls.decode(payload))
+        pb = req.pop("_pb", None)
+        resp = self.service.dispatch(method, req)
+        if method == "coprocessor":
+            return _w_coprocessor(resp, pb).encode()
+        return fill(resp).encode()

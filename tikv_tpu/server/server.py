@@ -82,6 +82,16 @@ class Server:
         self._read_pool_mu = threading.Lock()
         self._stop = threading.Event()
         self._accept_thread: threading.Thread | None = None
+        self._pb_gateway_inst = None
+        self._pb_gateway_mu = threading.Lock()
+
+    def _pb_gateway(self):
+        with self._pb_gateway_mu:
+            if self._pb_gateway_inst is None:
+                from .pb_gateway import PbGateway
+
+                self._pb_gateway_inst = PbGateway(self.service)
+            return self._pb_gateway_inst
 
     @property
     def read_pool(self) -> UnifiedReadPool:
@@ -143,7 +153,12 @@ class Server:
 
                 def run(req_id=req_id, method=method, request=request):
                     try:
-                        resp = self.service.dispatch(method, request)
+                        if method.startswith("pb/"):
+                            # kvproto mode: request/response are protobuf
+                            # bytes (pb_gateway), framing unchanged
+                            resp = self._pb_gateway().handle(method[3:], request)
+                        else:
+                            resp = self.service.dispatch(method, request)
                     except Exception as e:  # noqa: BLE001 — wire boundary
                         resp = {"error": {"other": repr(e), "code": error_code.code_of(e)}}
                     payload = wire.dumps([req_id, resp])
@@ -153,16 +168,24 @@ class Server:
                         except OSError:
                             pass
 
-                if method in _READ_METHODS:
+                if method.removeprefix("pb/") in _READ_METHODS:
                     ctx, group = {}, id(conn)
+                    prio_hint = None
                     if isinstance(request, dict):
                         c = request.get("context")
                         ctx = c if isinstance(c, dict) else {}
                         # group by caller txn (start_ts); falls back per-conn
                         group = ctx.get("resource_group") or request.get("start_ts") or id(conn)
+                    elif isinstance(request, bytes):
+                        # pb mode: peek at Context (task_id, priority) without
+                        # a full request decode
+                        from .pb_gateway import sched_hints
+
+                        g, prio_hint = sched_hints(request)
+                        group = g or id(conn)
                     prio = (
                         TaskPriority.HIGH
-                        if ctx.get("priority") == "high"
+                        if ctx.get("priority") == "high" or prio_hint == "high"
                         else TaskPriority.NORMAL
                     )
                     try:

@@ -676,7 +676,10 @@ class KvService:
             }
         hi = key.append_ts(2**64 - 1).encoded
         for k, v in snap.scan_cf(CF_WRITE, hi, None):
-            user, commit_ts = split_ts(k)
+            try:
+                user, commit_ts = split_ts(k)
+            except ValueError:
+                break  # unversioned neighbor (raw-KV key): past this key's versions
             if user != key.encoded:
                 break
             w = Write.from_bytes(v)
@@ -689,7 +692,10 @@ class KvService:
                 }
             )
         for k, v in snap.scan_cf(CF_DEFAULT, hi, None):
-            user, start_ts = split_ts(k)
+            try:
+                user, start_ts = split_ts(k)
+            except ValueError:
+                break  # unversioned neighbor (raw-KV key)
             if user != key.encoded:
                 break
             info["values"].append({"start_ts": start_ts, "value": v})
