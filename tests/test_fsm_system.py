@@ -1,0 +1,206 @@
+"""Generic FSM batch system: router/mailbox semantics, poller exclusivity,
+hot-FSM fairness, and the 1,000-regions-over-4-pollers bound
+(batch-system/src/batch.rs Poller::poll, src/mailbox.rs FsmState).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tikv_tpu.raft.fsm_system import BatchSystem, PollHandler, Router
+
+
+class CountingHandler(PollHandler):
+    """Shared-state handler that also asserts per-FSM exclusivity."""
+
+    def __init__(self, state):
+        self.state = state
+
+    def handle(self, addr, msgs):
+        st = self.state
+        # exclusivity: no two pollers may hold the same FSM concurrently
+        with st["mu"]:
+            assert addr not in st["active"], f"fsm {addr} entered twice"
+            st["active"].add(addr)
+        if st.get("work_s"):
+            time.sleep(st["work_s"])
+        with st["mu"]:
+            st["counts"][addr] = st["counts"].get(addr, 0) + len(msgs)
+            st["active"].discard(addr)
+            for m in msgs:
+                if isinstance(m, tuple) and m[0] == "ts":
+                    st["latencies"].append(time.monotonic() - m[1])
+
+    def handle_control(self, msgs):
+        with self.state["mu"]:
+            self.state["control"] += len(msgs)
+
+
+def make_system(pollers=4, **kw):
+    router = Router()
+    state = {"mu": threading.Lock(), "counts": {}, "active": set(),
+             "control": 0, "latencies": [], **kw}
+    system = BatchSystem(router, lambda: CountingHandler(state), pollers=pollers,
+                         name="test-bs")
+    return router, system, state
+
+
+def test_thousand_fsms_over_four_pollers():
+    """1,000 FSMs, 4 pollers: every message lands exactly once, exclusivity
+    holds, and per-message latency stays bounded."""
+    router, system, state = make_system(pollers=4)
+    n_fsm, per_fsm = 1000, 20
+    for i in range(n_fsm):
+        router.register(i)
+    system.spawn()
+    t0 = time.monotonic()
+    for round_ in range(per_fsm):
+        for i in range(n_fsm):
+            router.send(i, ("ts", time.monotonic()))
+    deadline = time.monotonic() + 30
+    total = n_fsm * per_fsm
+    while time.monotonic() < deadline:
+        with state["mu"]:
+            if sum(state["counts"].values()) == total:
+                break
+        time.sleep(0.01)
+    system.shutdown()
+    assert not system.errors, system.errors[:3]
+    with state["mu"]:
+        assert sum(state["counts"].values()) == total
+        assert len(state["counts"]) == n_fsm          # every FSM ran
+        assert all(c == per_fsm for c in state["counts"].values())
+        lats = sorted(state["latencies"])
+    wall = time.monotonic() - t0
+    p99 = lats[int(len(lats) * 0.99)]
+    assert p99 < 10.0, f"p99 latency {p99:.2f}s over {wall:.2f}s wall"
+
+
+def test_idle_fsms_cost_nothing():
+    """Only notified FSMs reach a poller: 10k idle registrations generate
+    zero handler calls."""
+    router, system, state = make_system(pollers=2)
+    for i in range(10_000):
+        router.register(i)
+    system.spawn()
+    router.send(42, "only-this-one")
+    time.sleep(0.3)
+    system.shutdown()
+    assert state["counts"] == {42: 1}
+
+
+def test_hot_fsm_does_not_starve_others():
+    """A flooding FSM is capped per round (messages_per_round) and must not
+    keep quieter FSMs from being served promptly."""
+    router = Router()
+    state = {"mu": threading.Lock(), "counts": {}, "active": set(),
+             "control": 0, "latencies": [], "work_s": 0.0005}
+    system = BatchSystem(router, lambda: CountingHandler(state), pollers=1,
+                         messages_per_round=16, name="hot-bs")
+    router.register("hot")
+    router.register("quiet")
+    system.spawn()
+    for _ in range(2000):
+        router.send("hot", "x")
+    t0 = time.monotonic()
+    router.send("quiet", ("ts", t0))
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        with state["mu"]:
+            if state["counts"].get("quiet"):
+                break
+        time.sleep(0.005)
+    quiet_latency = time.monotonic() - t0
+    system.shutdown()
+    assert state["counts"].get("quiet") == 1
+    # with a 16-message round cap the quiet FSM gets service long before the
+    # 2000-message flood drains (which would take ~1s of handler work)
+    assert quiet_latency < 0.5, f"quiet FSM waited {quiet_latency:.2f}s"
+
+
+def test_release_renotifies_on_racing_send():
+    """Messages sent while a poller holds the FSM are not lost: release()
+    re-enqueues (mailbox.rs notify/release edge)."""
+    router, system, state = make_system(pollers=1, work_s=0.02)
+    router.register("a")
+    system.spawn()
+    router.send("a", "first")
+    time.sleep(0.005)  # poller is now (likely) inside handle()
+    router.send("a", "second")
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        with state["mu"]:
+            if state["counts"].get("a") == 2:
+                break
+        time.sleep(0.005)
+    system.shutdown()
+    assert state["counts"]["a"] == 2
+
+
+def test_closed_mailbox_rejects_and_drops():
+    router, system, state = make_system(pollers=1)
+    router.register("x")
+    assert router.send("x", 1)
+    router.close("x")
+    assert not router.send("x", 2)
+    system.spawn()
+    time.sleep(0.2)
+    system.shutdown()
+    assert state["counts"].get("x") is None  # queued msg dropped at close
+
+
+def test_control_fsm():
+    router, system, state = make_system(pollers=2)
+    system.spawn()
+    for _ in range(10):
+        router.send_control("ctl")
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        with state["mu"]:
+            if state["control"] == 10:
+                break
+        time.sleep(0.01)
+    system.shutdown()
+    assert state["control"] == 10
+
+
+def test_store_cluster_many_regions_bounded_latency():
+    """Real stores: 3 server nodes, dozens of raft regions driven by the
+    poller pool, concurrent writes to every region complete with bounded
+    latency (the VERDICT r2 'batch system' acceptance shape, scaled to
+    CI time; the 1,000-FSM bound above covers the generic mechanism)."""
+    from tikv_tpu.raft.region import Peer as RegionPeer, Region, RegionEpoch
+    from tikv_tpu.server.cluster import ServerCluster
+
+    n_regions = 24
+    cluster = ServerCluster(3)
+    try:
+        cluster.start()
+        # carve the keyspace into n_regions ranges, all replicated 3-way
+        bounds = [b"" if i == 0 else b"k%03d" % i for i in range(n_regions)] + [b""]
+        for i in range(n_regions):
+            rid = 1 if i == 0 else cluster.alloc_id()
+            peers = [RegionPeer(cluster.alloc_id(), sid) for sid in (1, 2, 3)]
+            region = Region(rid, bounds[i], bounds[i + 1], RegionEpoch(), peers)
+            cluster.pd.bootstrap_region(region.clone())
+            for sid in (1, 2, 3):
+                cluster.nodes[sid].store.create_peer(region)
+            cluster.nodes[1].store.peers[rid].node.campaign()
+        for i in range(n_regions):
+            cluster.wait_leader(cluster.region_for_key(b"k%03d" % i if i else b"a"))
+        lat = []
+        t_all = time.monotonic()
+        for round_ in range(3):
+            for i in range(n_regions):
+                key = (b"k%03dw" % i) if i else b"a-w"
+                t0 = time.monotonic()
+                cluster.must_put(key + str(round_).encode(), b"v", timeout=10)
+                lat.append(time.monotonic() - t0)
+        wall = time.monotonic() - t_all
+        lat.sort()
+        assert lat[int(len(lat) * 0.99)] < 5.0, f"p99 {lat[-1]:.2f}s, wall {wall:.1f}s"
+        for node in cluster.nodes.values():
+            assert not node.node.thread_errors, node.node.thread_errors[:3]
+    finally:
+        cluster.shutdown()

@@ -126,7 +126,22 @@ _COLLATORS: dict[str, Collator] = {
 }
 # TiDB collation ids (mysql/consts: 63 binary, 46 utf8mb4_bin, 45 general_ci,
 # 224 unicode_ci); negative ids are how tipb marks "new collation enabled"
-_BY_ID = {63: "binary", 46: "utf8mb4_bin", 45: "utf8mb4_general_ci", 224: "utf8mb4_unicode_ci"}
+_BY_ID = {
+    63: "binary",
+    46: "utf8mb4_bin",
+    45: "utf8mb4_general_ci",
+    224: "utf8mb4_unicode_ci",
+    # utf8 ids fold onto their utf8mb4 collators (same ordering rules here)
+    33: "utf8mb4_general_ci",
+    83: "utf8mb4_bin",
+    192: "utf8mb4_unicode_ci",
+}
+
+
+def collation_name(coll_id: int, default: str = "binary") -> str:
+    """MySQL collation id (negative = new-collation namespace) -> collator
+    name; unknown ids fall back to ``default``."""
+    return _BY_ID.get(abs(coll_id), default)
 
 
 def get_collator(name_or_id) -> Collator:

@@ -38,17 +38,7 @@ from .mydecimal import MyDecimal
 from .rpn import FuncCall, call, col, const_bytes, const_decimal, const_int, const_real
 from .sig_map import resolve_sig
 
-# MySQL collation id -> this framework's collator name (negative ids are the
-# "new collation" namespace TiDB uses on the wire; same collation either way)
-_COLLATION_IDS = {
-    63: "binary",
-    46: "utf8mb4_bin",
-    45: "utf8mb4_general_ci",
-    224: "utf8mb4_unicode_ci",
-    33: "utf8mb4_general_ci",   # utf8_general_ci folds
-    83: "utf8mb4_bin",          # utf8_bin folds
-    192: "utf8mb4_unicode_ci",  # utf8_unicode_ci folds
-}
+from .collation import collation_name
 
 _AGG_OPS = {
     tp.ExprType.Count: "count",
@@ -69,7 +59,7 @@ class TipbError(ValueError):
 
 
 def field_type_from_pb(ci: tp.ColumnInfoPb) -> FieldType:
-    collation = _COLLATION_IDS.get(abs(getattr(ci, "collation", 0) or 0), "binary")
+    collation = collation_name(getattr(ci, "collation", 0) or 0)
     return FieldType(
         tp=FieldTypeTp(ci.tp),
         flag=getattr(ci, "flag", 0) or 0,

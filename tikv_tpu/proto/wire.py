@@ -14,6 +14,8 @@ generated code (Cargo.toml:52-99); this is the from-scratch equivalent.
 
 from __future__ import annotations
 
+import struct
+
 # Wire types (encoding spec)
 WT_VARINT = 0
 WT_FIX64 = 1
@@ -158,9 +160,17 @@ class PbMessage:
 
     # -- encode ------------------------------------------------------------
 
+    @classmethod
+    def _sorted_fields(cls):
+        fs = cls.__dict__.get("_PbMessage__sorted")
+        if fs is None:
+            fs = tuple(sorted(cls.FIELDS, key=lambda f: f.number))
+            setattr(cls, "_PbMessage__sorted", fs)
+        return fs
+
     def encode(self) -> bytes:
         out = bytearray()
-        for f in sorted(self.FIELDS, key=lambda f: f.number):
+        for f in self._sorted_fields():
             self._encode_field(out, f)
         return bytes(out)
 
@@ -202,8 +212,6 @@ class PbMessage:
 
     @staticmethod
     def _encode_scalar(out: bytearray, f: Field, v) -> None:
-        import struct
-
         if f.kind == K_INT:
             write_varint(out, int(v))
         elif f.kind == K_SINT:
@@ -251,8 +259,6 @@ class PbMessage:
 
     @classmethod
     def _decode_into(cls, msg, buf: bytes) -> None:
-        import struct
-
         idx = cls._index()
         pos = 0
         n = len(buf)
@@ -271,7 +277,7 @@ class PbMessage:
                 end = pos + ln
                 vals = getattr(msg, f.name)
                 while pos < end:
-                    v, pos = cls._decode_scalar_at(buf, pos, f, struct)
+                    v, pos = cls._decode_scalar_at(buf, pos, f)
                     vals.append(v)
                 continue
             if f.kind == K_MSG:
@@ -285,14 +291,14 @@ class PbMessage:
                 else:
                     setattr(msg, f.name, sub)
                 continue
-            v, pos = cls._decode_scalar_at(buf, pos, f, struct, wt)
+            v, pos = cls._decode_scalar_at(buf, pos, f, wt)
             if f.repeated:
                 getattr(msg, f.name).append(v)
             else:
                 setattr(msg, f.name, v)
 
     @staticmethod
-    def _decode_scalar_at(buf, pos, f: Field, struct, wt=None):
+    def _decode_scalar_at(buf, pos, f: Field, wt=None):
         kind = f.kind
         if kind in (K_INT, K_SINT, K_BOOL):
             raw, pos = read_varint(buf, pos)

@@ -1,0 +1,229 @@
+"""Generic FSM batch system: router + mailboxes + a small poller pool.
+
+The mechanism that lets one store host thousands of raft regions without
+O(regions) work per loop iteration (re-expression of the reference's
+``batch-system/src/batch.rs:284`` Poller::poll, ``src/router.rs`` and
+``src/mailbox.rs:18``): every FSM owns a mailbox; senders enqueue a message
+and, on the mailbox's IDLE -> NOTIFIED edge, push the FSM onto a shared ready
+queue; N poller threads pop ready FSMs in batches and run the handler.  An
+FSM with no traffic costs nothing; a hot FSM is rescheduled to the back of
+the queue after a per-round message cap so it cannot starve the rest
+(batch.rs's hot-FSM reschedule).
+
+Exclusivity: a mailbox is handed to at most one poller at a time — the
+IDLE/NOTIFIED state gates entry to the ready queue, and release() re-notifies
+only if messages arrived while the poller held the FSM.  Per-FSM state
+therefore stays single-threaded without any per-FSM lock.
+
+The control FSM (address None) models store-level work (router.rs
+control_box): messages that need cross-region coordination.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Hashable
+
+_IDLE = 0
+_NOTIFIED = 1
+_CLOSED = 2
+
+CONTROL = None  # the control FSM's address
+
+
+class Mailbox:
+    __slots__ = ("addr", "_mu", "_queue", "_state")
+
+    def __init__(self, addr: Hashable):
+        self.addr = addr
+        self._mu = threading.Lock()
+        self._queue: list = []
+        self._state = _IDLE
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._queue)
+
+
+class Router:
+    """Address -> mailbox map plus the shared ready queue."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._mailboxes: dict[Hashable, Mailbox] = {CONTROL: Mailbox(CONTROL)}
+        self.ready: queue.SimpleQueue[Mailbox] = queue.SimpleQueue()
+
+    def register(self, addr: Hashable) -> None:
+        with self._mu:
+            if addr not in self._mailboxes:
+                self._mailboxes[addr] = Mailbox(addr)
+
+    def close(self, addr: Hashable) -> None:
+        """Close an FSM's mailbox; queued messages are dropped (router.rs
+        close marks the state DROP so senders see closure)."""
+        with self._mu:
+            mb = self._mailboxes.pop(addr, None)
+        if mb is not None:
+            with mb._mu:
+                mb._state = _CLOSED
+                mb._queue.clear()
+
+    def addrs(self) -> list[Hashable]:
+        with self._mu:
+            return [a for a in self._mailboxes if a is not CONTROL]
+
+    def send(self, addr: Hashable, msg) -> bool:
+        """Enqueue for ``addr``; False if the mailbox is closed/unknown."""
+        with self._mu:
+            mb = self._mailboxes.get(addr)
+        if mb is None:
+            return False
+        with mb._mu:
+            if mb._state == _CLOSED:
+                return False
+            mb._queue.append(msg)
+            if mb._state == _IDLE:
+                mb._state = _NOTIFIED
+                notify = True
+            else:
+                notify = False
+        if notify:
+            self.ready.put(mb)
+        return True
+
+    def send_control(self, msg) -> bool:
+        return self.send(CONTROL, msg)
+
+    def broadcast(self, msg_fn: Callable[[Hashable], object]) -> None:
+        """Send msg_fn(addr) to every registered normal FSM (router.rs
+        broadcast_normal) — used for ticks."""
+        for addr in self.addrs():
+            self.send(addr, msg_fn(addr))
+
+    # -- poller side -------------------------------------------------------
+
+    def _take(self, mb: Mailbox, cap: int) -> list:
+        with mb._mu:
+            if cap >= len(mb._queue):
+                msgs, mb._queue = mb._queue, []
+            else:
+                msgs, mb._queue = mb._queue[:cap], mb._queue[cap:]
+            return msgs
+
+    def _release(self, mb: Mailbox) -> None:
+        """Poller is done with this FSM: back to IDLE, or straight back onto
+        the ready queue if traffic arrived while it was held."""
+        with mb._mu:
+            if mb._state == _CLOSED:
+                return
+            if mb._queue:
+                renotify = True  # stay NOTIFIED
+            else:
+                mb._state = _IDLE
+                renotify = False
+        if renotify:
+            self.ready.put(mb)
+
+
+class PollHandler:
+    """One instance per poller thread (batch.rs HandlerBuilder::build)."""
+
+    def begin(self, batch_size: int) -> None:  # noqa: B027
+        pass
+
+    def handle(self, addr: Hashable, msgs: list) -> None:
+        raise NotImplementedError
+
+    def handle_control(self, msgs: list) -> None:
+        raise NotImplementedError
+
+    def end(self, addrs: list[Hashable]) -> None:  # noqa: B027
+        pass
+
+
+class BatchSystem:
+    """N poller threads batch-polling ready FSMs off one router."""
+
+    def __init__(
+        self,
+        router: Router,
+        handler_factory: Callable[[], PollHandler],
+        pollers: int = 2,
+        max_batch_size: int = 32,
+        messages_per_round: int = 256,
+        name: str = "batch-system",
+    ):
+        self.router = router
+        self._factory = handler_factory
+        self._pollers = pollers
+        self._max_batch = max_batch_size
+        self._per_round = messages_per_round
+        self._name = name
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self.errors: list[Exception] = []
+
+    def spawn(self) -> None:
+        for i in range(self._pollers):
+            t = threading.Thread(
+                target=self._poll_loop, args=(self._factory(),),
+                name=f"{self._name}-{i}", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        # wake every poller blocked on ready.get
+        for _ in self._threads:
+            self.router.ready.put(None)  # type: ignore[arg-type]
+        for t in self._threads:
+            t.join(timeout=timeout)
+
+    def _poll_loop(self, handler: PollHandler) -> None:
+        router = self.router
+        while not self._stop.is_set():
+            try:
+                mb = router.ready.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if mb is None:
+                continue
+            batch = [mb]
+            while len(batch) < self._max_batch:
+                try:
+                    nxt = router.ready.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is not None:
+                    batch.append(nxt)
+            try:
+                handler.begin(len(batch))
+            except Exception as e:  # noqa: BLE001
+                self._record(e)
+            for mb in batch:
+                # cap per round: a hot FSM yields the poller after
+                # messages_per_round and re-enters via _release's renotify
+                msgs = router._take(mb, self._per_round)
+                if not msgs:
+                    # closed-mailbox race (close() cleared the queue after
+                    # the notify): nothing to do, don't invoke the handler
+                    router._release(mb)
+                    continue
+                try:
+                    if mb.addr is CONTROL:
+                        handler.handle_control(msgs)
+                    else:
+                        handler.handle(mb.addr, msgs)
+                except Exception as e:  # noqa: BLE001 — one FSM must not kill the poller
+                    self._record(e)
+                router._release(mb)
+            try:
+                handler.end([mb.addr for mb in batch])
+            except Exception as e:  # noqa: BLE001
+                self._record(e)
+
+    def _record(self, e: Exception) -> None:
+        if len(self.errors) < 128:
+            self.errors.append(e)

@@ -309,6 +309,8 @@ class StorePeer:
                     self.proposals.append(Proposal(index, self.node.term, cb))
             if index is None:
                 cb(NotLeaderError(self.region.id, None))
+            else:
+                self.store.notify_region(self.region.id)
             return
         if admin is not None and admin[0] == "conf_change":
             # placement (store id) rides in the entry, like the reference's
@@ -319,6 +321,8 @@ class StorePeer:
                     self.proposals.append(Proposal(index, self.node.term, cb))
             if index is None:
                 cb(NotLeaderError(self.region.id, None))
+            else:
+                self.store.notify_region(self.region.id)
             return
         with self._cb_mu:
             index = self.node.propose(encode_cmd(cmd))
@@ -326,6 +330,8 @@ class StorePeer:
                 self.proposals.append(Proposal(index, self.node.term, cb))
         if index is None:
             cb(NotLeaderError(self.region.id, None))
+        else:
+            self.store.notify_region(self.region.id)
 
     def _epoch_ok(self, cmd: dict) -> bool:
         """Data commands only care about the range (version); admin commands
@@ -357,6 +363,7 @@ class StorePeer:
             ctx = codec.encode_u64(self.region.id) + codec.encode_u64(self._read_seq)
             self.pending_reads[ctx] = cb
         self.node.read_index(ctx)
+        self.store.notify_region(self.region.id)
 
     def handle_ready(self, sync_apply: bool = False) -> bool:
         rd = self.node.ready()
@@ -1182,6 +1189,10 @@ class Store:
         # apply pipeline (batch-system shape): None = inline apply on the
         # raft thread (deterministic test clusters); enabled by server nodes
         self.apply_system = None
+        # generic FSM batch system (fsm_system.Router): when attached, raft
+        # messages route to per-region mailboxes and poller threads drive
+        # step/ready — the synchronous inbox path is only for test clusters
+        self.fsm_router = None
         # consistency check (consistency_check.rs): per-region (index, hash)
         # recorded at compute_hash apply; divergences land in
         # inconsistent_regions for the debug service / operator
@@ -1210,10 +1221,15 @@ class Store:
             peer = StorePeer(self, region.clone(), me.peer_id)
             self.peers[region.id] = peer
             self.persist_region(peer.region)
+            if self.fsm_router is not None:
+                self.fsm_router.register(region.id)
+                self.fsm_router.send(region.id, ("ready",))
             return peer
 
     def destroy_peer(self, region_id: int) -> None:
         self.peers.pop(region_id, None)
+        if self.fsm_router is not None:
+            self.fsm_router.close(region_id)
 
     def destroy_peer_tombstone(self, region_id: int) -> None:
         """Destroy a peer AND erase its persisted identity (the reference
@@ -1225,6 +1241,8 @@ class Store:
             # could mistake for live state — drain first
             self.apply_system.flush(region_id)
         self.peers.pop(region_id, None)
+        if self.fsm_router is not None:
+            self.fsm_router.close(region_id)
         self.erase_region_state(region_id)
 
     def erase_region_state(self, region_id: int, wb: WriteBatch | None = None) -> None:
@@ -1315,50 +1333,94 @@ class Store:
         return p.store_id if p else None
 
     def enqueue_message(self, rmsg: RaftMessage) -> None:
+        router = self.fsm_router
+        if router is None:
+            with self._mu:
+                self._inbox.append(rmsg)
+            return
+        # batch-system mode: peer traffic lands in the region mailbox; store-
+        # level work (tombstones, first contact for an unknown region) goes
+        # to the control FSM (router.rs send vs control_box)
+        if not rmsg.is_tombstone and rmsg.region_id in self.peers:
+            if router.send(rmsg.region_id, ("raft", rmsg)):
+                return
+        router.send_control(("route", rmsg))
+
+    def notify_region(self, region_id: int) -> None:
+        """Wake a region FSM (propose/read just added work for its poller)."""
+        if self.fsm_router is not None:
+            self.fsm_router.send(region_id, ("ready",))
+
+    def attach_fsm_router(self, router) -> None:
+        """Enter batch-system mode: register every live peer's mailbox and
+        hand any messages that arrived pre-attach to the control FSM."""
+        self.fsm_router = router
         with self._mu:
-            self._inbox.append(rmsg)
+            for rid in self.peers:
+                router.register(rid)
+                router.send(rid, ("ready",))
+            backlog, self._inbox = self._inbox, []
+        for rmsg in backlog:
+            router.send_control(("route", rmsg))
 
     # -- driving -----------------------------------------------------------
+
+    def _route_one(self, rmsg: RaftMessage) -> "StorePeer | None":
+        """Store-level routing (fsm/store.rs maybe_create_peer): tombstone
+        destruction and first-contact bootstrap.  Returns the peer the
+        message should be stepped into, or None if consumed/dropped."""
+        peer = self.peers.get(rmsg.region_id)
+        if rmsg.is_tombstone:
+            # a committed conf change removed us at this epoch: verify
+            # and self-destruct (raftstore handling of is_tombstone)
+            if (
+                peer is not None
+                and peer.peer_id == rmsg.to_peer.peer_id
+                and rmsg.region_epoch.conf_ver >= peer.region.epoch.conf_ver
+                and (rmsg.region is None or rmsg.region.peer_by_id(peer.peer_id) is None)
+            ):
+                self.destroy_peer_tombstone(rmsg.region_id)
+            return None
+        if peer is None and rmsg.region is not None:
+            # first contact for a new peer (conf change / snapshot):
+            # bootstrap it if we're in the carried region
+            if rmsg.region.peer_on_store(self.store_id) is not None or rmsg.to_peer.store_id == self.store_id:
+                region = rmsg.region.clone()
+                if region.peer_on_store(self.store_id) is None:
+                    region.peers.append(RegionPeer(rmsg.to_peer.peer_id, self.store_id))
+                with self._mu:
+                    peer = self.peers.get(rmsg.region_id)
+                    if peer is None:
+                        peer = StorePeer(self, region, rmsg.to_peer.peer_id)
+                        self.peers[rmsg.region_id] = peer
+                if self.fsm_router is not None:
+                    self.fsm_router.register(rmsg.region_id)
+        if peer is not None and rmsg.to_peer.peer_id == peer.peer_id:
+            return peer
+        return None
+
+    def _step_checked(self, peer: "StorePeer", rmsg: RaftMessage) -> None:
+        """Step with the stale-sender GC check (raftstore is_msg_stale):
+        a sender a NEWER committed epoch excludes gets a tombstone back
+        instead of a vote/step — the retry path when the removal-time
+        tombstone was lost."""
+        if (
+            rmsg.region_epoch.conf_ver < peer.region.epoch.conf_ver
+            and peer.region.peer_by_id(rmsg.from_peer.peer_id) is None
+            and rmsg.from_peer.peer_id != peer.peer_id
+        ):
+            peer._send_tombstone(rmsg.from_peer)
+            return
+        peer.node.step(rmsg.msg)
 
     def process_messages(self) -> bool:
         with self._mu:
             inbox, self._inbox = self._inbox, []
         moved = bool(inbox)
         for rmsg in inbox:
-            peer = self.peers.get(rmsg.region_id)
-            if rmsg.is_tombstone:
-                # a committed conf change removed us at this epoch: verify
-                # and self-destruct (raftstore handling of is_tombstone)
-                if (
-                    peer is not None
-                    and peer.peer_id == rmsg.to_peer.peer_id
-                    and rmsg.region_epoch.conf_ver >= peer.region.epoch.conf_ver
-                    and (rmsg.region is None or rmsg.region.peer_by_id(peer.peer_id) is None)
-                ):
-                    self.destroy_peer_tombstone(rmsg.region_id)
-                continue
-            if peer is None and rmsg.region is not None:
-                # first contact for a new peer (conf change / snapshot):
-                # bootstrap it if we're in the carried region
-                if rmsg.region.peer_on_store(self.store_id) is not None or rmsg.to_peer.store_id == self.store_id:
-                    region = rmsg.region.clone()
-                    if region.peer_on_store(self.store_id) is None:
-                        region.peers.append(RegionPeer(rmsg.to_peer.peer_id, self.store_id))
-                    peer = StorePeer(self, region, rmsg.to_peer.peer_id)
-                    self.peers[rmsg.region_id] = peer
-            if peer is not None and rmsg.to_peer.peer_id == peer.peer_id:
-                # stale-peer GC by contact: a sender a NEWER committed epoch
-                # excludes gets a tombstone back instead of a vote/step —
-                # this is the retry path when the removal-time tombstone was
-                # lost (raftstore is_msg_stale -> gc sender peer)
-                if (
-                    rmsg.region_epoch.conf_ver < peer.region.epoch.conf_ver
-                    and peer.region.peer_by_id(rmsg.from_peer.peer_id) is None
-                    and rmsg.from_peer.peer_id != peer.peer_id
-                ):
-                    peer._send_tombstone(rmsg.from_peer)
-                    continue
-                peer.node.step(rmsg.msg)
+            peer = self._route_one(rmsg)
+            if peer is not None:
+                self._step_checked(peer, rmsg)
         return moved
 
     def handle_readies(self) -> bool:
@@ -1389,46 +1451,52 @@ class Store:
         cheap catch-up; followers lagging more than ``threshold`` behind are
         abandoned to snapshot seeding (which the append path already
         handles).  Must run on the raft-driving thread (see
-        request_log_compaction).  Returns entries dropped."""
+        request_log_compaction) — or per region on its own poller in
+        batch-system mode.  Returns entries dropped."""
         dropped = 0
         for peer in list(self.peers.values()):
-            node = peer.node
-            # compact at COMPLETED apply: with the pipeline, node.applied may
-            # run ahead of the engine — compacting past apply_index would
-            # strand recovery (persisted ApplyState behind a truncated log)
-            applied = min(node.applied, peer.apply_index)
-            first = node.log.offset
-            if applied - first + 1 <= threshold:
-                continue
-            compact_to = applied - slack
-            if node.is_leader():
-                # don't compact below followers that are close enough to catch
-                # up from the log; stragglers further behind than the
-                # threshold are abandoned to snapshot seeding (raftlog_gc.rs)
-                near_matches = [
-                    m
-                    for p in node._replicas()
-                    if (m := node.match_index.get(p, 0)) >= applied - threshold
-                ]
-                if near_matches:
-                    compact_to = min(compact_to, min(near_matches))
-            if compact_to <= first - 1:
-                continue
-            term = node.log.term_at(compact_to)
-            if term is None:
-                continue
-            node.log.compact_to(compact_to, term)
-            wb = WriteBatch()
-            log_prefix = keys.region_raft_prefix(peer.region.id) + keys.RAFT_LOG_SUFFIX
-            wb.delete_range_cf(
-                CF_RAFT,
-                log_prefix + codec.encode_u64(0),
-                log_prefix + codec.encode_u64(compact_to + 1),
-            )
-            wb.put_cf(CF_RAFT, keys.raft_state_key(peer.region.id), peer._encode_raft_state())
-            self.engine.write(wb)
-            dropped += compact_to - first + 1
+            dropped += self.compact_peer_log(peer, threshold, slack)
         return dropped
+
+    def compact_peer_log(self, peer: "StorePeer", threshold: int = 1024, slack: int = 64) -> int:
+        """One region's log truncation; must run on whatever thread owns the
+        region's raft state (raft loop, or its FSM poller)."""
+        node = peer.node
+        # compact at COMPLETED apply: with the pipeline, node.applied may
+        # run ahead of the engine — compacting past apply_index would
+        # strand recovery (persisted ApplyState behind a truncated log)
+        applied = min(node.applied, peer.apply_index)
+        first = node.log.offset
+        if applied - first + 1 <= threshold:
+            return 0
+        compact_to = applied - slack
+        if node.is_leader():
+            # don't compact below followers that are close enough to catch
+            # up from the log; stragglers further behind than the
+            # threshold are abandoned to snapshot seeding (raftlog_gc.rs)
+            near_matches = [
+                m
+                for p in node._replicas()
+                if (m := node.match_index.get(p, 0)) >= applied - threshold
+            ]
+            if near_matches:
+                compact_to = min(compact_to, min(near_matches))
+        if compact_to <= first - 1:
+            return 0
+        term = node.log.term_at(compact_to)
+        if term is None:
+            return 0
+        node.log.compact_to(compact_to, term)
+        wb = WriteBatch()
+        log_prefix = keys.region_raft_prefix(peer.region.id) + keys.RAFT_LOG_SUFFIX
+        wb.delete_range_cf(
+            CF_RAFT,
+            log_prefix + codec.encode_u64(0),
+            log_prefix + codec.encode_u64(compact_to + 1),
+        )
+        wb.put_cf(CF_RAFT, keys.raft_state_key(peer.region.id), peer._encode_raft_state())
+        self.engine.write(wb)
+        return compact_to - first + 1
 
     def on_split(self, old: Region, new: Region) -> None:
         for cb in self.split_observers:
@@ -1441,3 +1509,77 @@ class Store:
     def on_applied(self, region: Region, cmd: dict) -> None:
         for cb in self.apply_observers:
             cb(self, region, cmd)
+
+
+class StoreFsmDelegate:
+    """PollHandler driving one store's region FSMs (fsm/peer.rs PeerFsmDelegate
+    + fsm/store.rs StoreFsmDelegate, on fsm_system.BatchSystem).
+
+    Region mailbox messages: ("raft", rmsg) step + ready, ("tick",) election/
+    heartbeat timers, ("ready",) wake after a propose/read, ("compact",) log
+    GC.  Control mailbox: ("route", rmsg) store-level routing (bootstrap /
+    tombstone), after which the message is forwarded to the now-live region.
+    """
+
+    def __init__(self, store: Store):
+        self.store = store
+
+    def begin(self, batch_size: int) -> None:
+        pass
+
+    def end(self, addrs: list) -> None:
+        pass
+
+    def handle(self, region_id: int, msgs: list) -> None:
+        store = self.store
+        peer = store.peers.get(region_id)
+        if peer is None:
+            return
+        for m in msgs:
+            kind = m[0]
+            if kind == "raft":
+                rmsg = m[1]
+                if rmsg.to_peer.peer_id == peer.peer_id:
+                    store._step_checked(peer, rmsg)
+            elif kind == "tick":
+                peer.node.tick()
+            elif kind == "compact":
+                store.compact_peer_log(peer)
+            elif kind == "tombstone":
+                # destruction runs HERE, on the poller that owns this FSM —
+                # never on the control poller, which could otherwise erase
+                # region state concurrently with a handle_ready persist
+                rmsg = m[1]
+                if (
+                    peer.peer_id == rmsg.to_peer.peer_id
+                    and rmsg.region_epoch.conf_ver >= peer.region.epoch.conf_ver
+                    and (rmsg.region is None or rmsg.region.peer_by_id(peer.peer_id) is None)
+                ):
+                    store.destroy_peer_tombstone(region_id)
+                    return
+            # ("ready",) carries no action: the unconditional ready sweep
+            # below is the point of the wakeup
+        while peer.handle_ready():
+            if store.peers.get(region_id) is not peer:
+                break  # destroyed mid-sweep (merge source / tombstone)
+
+    def handle_control(self, msgs: list) -> None:
+        store = self.store
+        for m in msgs:
+            if m[0] != "route":
+                continue
+            rmsg = m[1]
+            if rmsg.is_tombstone:
+                # forward to the owning FSM: destruction must not run on the
+                # control poller (it would race the region poller's persists)
+                if rmsg.region_id in store.peers:
+                    store.fsm_router.send(rmsg.region_id, ("tombstone", rmsg))
+                continue
+            peer = store._route_one(rmsg)
+            if peer is None:
+                continue
+            # peer now exists (possibly just bootstrapped): its own FSM
+            # processes the message so per-region state stays single-owner
+            if not store.fsm_router.send(rmsg.region_id, ("raft", rmsg)):
+                # mailbox raced closed — drop, sender will retry
+                pass

@@ -83,23 +83,47 @@ class Node:
 
     # -- background loops ---------------------------------------------------
 
-    def start(self, tick_interval: float = 0.05, heartbeat_interval: float = 0.5) -> None:
-        def raft_loop():
-            last_tick = 0.0
-            while not self._stop.is_set():
-                try:
-                    moved = self.store.process_messages()
-                    moved |= self.store.handle_readies()
-                    now = time.monotonic()
-                    if now - last_tick >= tick_interval:
-                        self.store.tick()
-                        last_tick = now
-                except Exception as exc:  # keep the store beating on faults
-                    if len(self.thread_errors) < 128:
-                        self.thread_errors.append(exc)
-                    moved = False
-                if not moved:
-                    time.sleep(0.001)
+    def start(self, tick_interval: float = 0.05, heartbeat_interval: float = 0.5,
+              pollers: int = 2, use_batch_system: bool = True) -> None:
+        if use_batch_system:
+            # batch-system mode (batch.rs Poller pool): per-region mailboxes,
+            # N pollers, a tick broadcaster — no O(all-regions) loop body
+            from ..raft.fsm_system import BatchSystem, Router as FsmRouter
+            from ..raft.store import StoreFsmDelegate
+
+            router = FsmRouter()
+            self.store.attach_fsm_router(router)
+            self._batch_system = BatchSystem(
+                router, lambda: StoreFsmDelegate(self.store),
+                pollers=pollers, name=f"raftstore-{self.store_id}",
+            )
+            self._batch_system.errors = self.thread_errors  # share the sink
+            self._batch_system.spawn()
+
+            def raft_loop():  # tick broadcaster only
+                while not self._stop.is_set():
+                    router.broadcast(lambda a: ("tick",))
+                    if self.store._compact_requested.is_set():
+                        self.store._compact_requested.clear()
+                        router.broadcast(lambda a: ("compact",))
+                    self._stop.wait(tick_interval)
+        else:
+            def raft_loop():
+                last_tick = 0.0
+                while not self._stop.is_set():
+                    try:
+                        moved = self.store.process_messages()
+                        moved |= self.store.handle_readies()
+                        now = time.monotonic()
+                        if now - last_tick >= tick_interval:
+                            self.store.tick()
+                            last_tick = now
+                    except Exception as exc:  # keep the store beating on faults
+                        if len(self.thread_errors) < 128:
+                            self.thread_errors.append(exc)
+                        moved = False
+                    if not moved:
+                        time.sleep(0.001)
 
         def pd_loop():
             while not self._stop.is_set():
@@ -134,12 +158,18 @@ class Node:
 
     def stop(self) -> None:
         self._stop.set()
+        bs = getattr(self, "_batch_system", None)
+        if bs is not None:
+            bs.shutdown()
         for t in self._threads:
             t.join(timeout=2)
         self.store.stop_apply_pipeline()
 
     def pump(self) -> None:
-        """Synchronous message pump for RaftKv when loops aren't running."""
+        """Synchronous message pump for RaftKv when loops aren't running.
+        Not valid in batch-system mode (pollers own per-region state)."""
+        if self.store.fsm_router is not None:
+            return  # pollers are driving; a sync sweep here would race them
         self.store.process_messages()
         self.store.handle_readies()
 

@@ -187,7 +187,10 @@ def _r_prewrite(q: kp.PrewriteRequest) -> dict:
         op = _OP_TO_WIRE.get(m.op)
         if op is None:
             raise PbGatewayError(f"unsupported mutation op {m.op}")
-        muts.append({"op": op, "key": m.key, "value": m.value or None})
+        # empty bytes is a legal Put value (protobuf can't distinguish unset
+        # from empty) — only valueless op kinds drop the field
+        value = m.value if op in ("put", "insert") else None
+        muts.append({"op": op, "key": m.key, "value": value})
     return {
         "mutations": muts,
         "primary_lock": q.primary_lock,

@@ -675,11 +675,14 @@ class KvService:
                 "short_value": lock.short_value,
             }
         hi = key.append_ts(2**64 - 1).encoded
-        for k, v in snap.scan_cf(CF_WRITE, hi, None):
+        # bounded to this key's version run: ts 0 sorts last under the desc
+        # ts encoding, so the exclusive end is just past it
+        lo_excl = key.append_ts(0).encoded + b"\x00"
+        for k, v in snap.scan_cf(CF_WRITE, hi, lo_excl):
             try:
                 user, commit_ts = split_ts(k)
             except ValueError:
-                break  # unversioned neighbor (raw-KV key): past this key's versions
+                continue  # unversioned neighbor (raw-KV key) interleaved in the run
             if user != key.encoded:
                 break
             w = Write.from_bytes(v)
@@ -691,11 +694,11 @@ class KvService:
                     "short_value": w.short_value,
                 }
             )
-        for k, v in snap.scan_cf(CF_DEFAULT, hi, None):
+        for k, v in snap.scan_cf(CF_DEFAULT, hi, lo_excl):
             try:
                 user, start_ts = split_ts(k)
             except ValueError:
-                break  # unversioned neighbor (raw-KV key)
+                continue  # unversioned neighbor (raw-KV key)
             if user != key.encoded:
                 break
             info["values"].append({"start_ts": start_ts, "value": v})
