@@ -75,8 +75,13 @@ def test_checkpoint_truncates_wal_and_recovers(tmp_path):
     e.checkpoint()
     assert e.wal_bytes() == 0
     files = os.listdir(d)
-    assert sum(f.startswith("ckpt-") for f in files) == 1
+    # the flush produced a sorted run + its completion marker, no legacy ckpt
+    assert sum(f.startswith("run0-") for f in files) == 1
+    assert sum(f.startswith("mark-") for f in files) == 1
+    assert not any(f.startswith("ckpt-") for f in files)
     assert sum(f.startswith("wal-") for f in files) == 1
+    assert e.run_count("default") == 1
+    assert e.mem_bytes() == 0  # memtable cleared: memory stays flat
     # post-checkpoint writes land in the fresh WAL segment
     wb = WriteBatch()
     wb.put_cf(CF_DEFAULT, b"after", b"x")
@@ -89,14 +94,14 @@ def test_checkpoint_truncates_wal_and_recovers(tmp_path):
     e2.close()
 
 
-def test_auto_checkpoint_on_wal_limit(tmp_path):
+def test_auto_flush_on_wal_limit(tmp_path):
     d = str(tmp_path / "db")
     e = NativeEngine(path=d, wal_limit=4096)
     for i in range(100):
         wb = WriteBatch()
         wb.put_cf(CF_DEFAULT, b"k%03d" % i, b"v" * 200)
         e.write(wb)
-    assert any(f.startswith("ckpt-") for f in os.listdir(d))
+    assert any(f.startswith("run0-") for f in os.listdir(d))
     assert e.wal_bytes() < 4096 + 4096  # truncated at least once
     e.close()
     e2 = NativeEngine(path=d)

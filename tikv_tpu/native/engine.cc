@@ -25,6 +25,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -70,11 +71,76 @@ uint32_t crc32c(const uint8_t* p, size_t n, uint32_t crc = 0) {
   return ~crc;
 }
 
+// a range delete as a first-class record (rocksdb DeleteRange shape): keys
+// in [start, end) with version seq < this are masked.  Lives in the
+// memtable's side list until flushed into a run; dies at a bottom-level
+// merge once no snapshot can see below it.  Never expanded into per-key
+// tombstones — a range delete is O(1) on the write path regardless of how
+// many flushed keys it covers.
+struct RangeTomb {
+  std::string start, end;  // end exclusive
+  uint64_t seq = 0;
+};
+
+// newest covering range-tombstone seq <= snap for `key`, 0 if none
+uint64_t rtomb_covering(const std::vector<RangeTomb>& v, const std::string& key,
+                        uint64_t snap) {
+  uint64_t best = 0;
+  for (const auto& rt : v)
+    if (rt.seq <= snap && rt.seq > best && rt.start <= key && key < rt.end)
+      best = rt.seq;
+  return best;
+}
+
+// one immutable sorted-run file on disk (the LSM level structure rocksdb's
+// SSTs provide, engine_rocks/src/ + properties.rs): block-partitioned sorted
+// (key, seq, tomb, value) entries with a first-key block index and a bloom
+// filter, loaded at open; data blocks pread on demand (OS page cache is the
+// block cache)
+struct Run {
+  std::string path;
+  int fd = -1;
+  int cf = 0;
+  int kind = 0;  // 0 = memtable flush, 1 = full-cf merge output
+  uint64_t max_seq = 0;   // every version in this run has seq <= max_seq
+  uint64_t n_entries = 0;
+  struct Block {
+    uint64_t off;
+    uint32_t len;
+    uint32_t crc;
+    std::string first_key;
+  };
+  std::vector<Block> blocks;
+  std::vector<uint64_t> bloom;  // bit words; empty = no filter
+  uint32_t bloom_k = 0;
+  std::vector<RangeTomb> rtombs;  // range deletes flushed with this run
+  ~Run() { if (fd >= 0) close(fd); }
+};
+
+// per-read statistics (engine_rocks/src/perf_context.rs role)
+struct Perf {
+  std::atomic<uint64_t> gets{0};
+  std::atomic<uint64_t> memtable_hits{0};
+  std::atomic<uint64_t> run_probes{0};   // run consulted for a point read
+  std::atomic<uint64_t> bloom_skips{0};  // run skipped by its bloom filter
+  std::atomic<uint64_t> blocks_read{0};  // data blocks pread + crc-checked
+  std::atomic<uint64_t> flushes{0};
+  std::atomic<uint64_t> run_merges{0};
+};
+
 struct Engine {
   Table cfs[kNumCfs];
   uint64_t seq = 0;
   std::multiset<uint64_t> snapshots;
   mutable std::shared_mutex mu;
+  // sorted runs per CF, NEWEST FIRST: all versions in runs[cf][i] are newer
+  // than any in runs[cf][i+1], and the memtable is newer than every run
+  std::vector<std::shared_ptr<Run>> runs[kNumCfs];
+  std::vector<RangeTomb> mem_rtombs[kNumCfs];  // unflushed range deletes
+  uint64_t flushed_seq = 0;          // all state <= this lives in runs
+  uint64_t mem_limit = 256ull << 20; // memtable flush threshold; 0 = manual
+  std::mutex compact_mu;             // one run-merge at a time
+  Perf perf;
 
   // --- durability state (empty dir => pure in-memory engine) ---
   std::string dir;        // "" = in-memory
@@ -90,15 +156,35 @@ struct Engine {
   uint64_t min_live_snapshot() const {
     return snapshots.empty() ? UINT64_MAX : *snapshots.begin();
   }
+
+  // newest range-tombstone seq <= snap covering `key` across memtable + runs
+  uint64_t rtomb_seq(int cf, const std::string& key, uint64_t snap) const {
+    uint64_t best = rtomb_covering(mem_rtombs[cf], key, snap);
+    for (const auto& run : runs[cf]) {
+      uint64_t s = rtomb_covering(run->rtombs, key, snap);
+      if (s > best) best = s;
+    }
+    return best;
+  }
 };
 
-const std::string* resolve(const Chain& chain, uint64_t snap_seq) {
-  for (const auto& v : chain) {  // newest first
+// tri-state resolve: MISS means "no version visible here, consult older
+// sources (runs)"; TOMB stops the lookup (the delete masks older sources).
+// out_seq carries the hit's version so callers can test range-tombstone
+// masking (a range delete at a later seq covers the value).
+enum class Res { MISS, HIT, TOMB };
+
+Res resolve3(const Chain& chain, uint64_t snap_seq, const std::string** out,
+             uint64_t* out_seq) {
+  for (const auto& v : chain) {
     if (v.seq <= snap_seq) {
-      return v.tombstone ? nullptr : &v.value;
+      if (v.tombstone) return Res::TOMB;
+      *out = &v.value;
+      *out_seq = v.seq;
+      return Res::HIT;
     }
   }
-  return nullptr;
+  return Res::MISS;
 }
 
 constexpr uint64_t kVersionOverhead = 48;  // Version struct + string header
@@ -239,11 +325,13 @@ int apply_batch(Engine* e, const uint8_t* data, uint64_t len, uint64_t seq) {
     } else if (op == 2) {
       put_version(e, t, std::move(key), seq, true, "", min_snap);
     } else if (op == 3) {
-      auto it = t.lower_bound(key);
-      auto stop = t.lower_bound(val);
-      for (; it != stop; ++it) {
-        // the iterator already holds the chain: no per-key re-lookup
-        push_version(e, it->second, seq, true, "", min_snap);
+      // range delete: O(1) on the write path no matter how many keys —
+      // memtable and flushed alike — it covers.  Masking happens at read /
+      // merge time (ties: a range delete at the same seq as a put in one
+      // batch wins, matching per-key tombstone ordering)
+      if (key < val) {
+        e->mem_bytes += key.size() + val.size() + kVersionOverhead;
+        e->mem_rtombs[cf].push_back(RangeTomb{std::move(key), std::move(val), seq});
       }
     } else if (op == 4) {
       std::string path = e->dir.empty() ? key : e->dir + "/" + key;
@@ -476,64 +564,896 @@ int wal_replay(Engine* e, const std::string& path) {
   return 0;
 }
 
-int ckpt_write(Engine* e) {
-  // caller holds the write lock; spill everything visible at e->seq.
-  // Streamed straight to the file with a chained crc32c — never a full
-  // in-memory copy of the dataset (the engine already holds the data once;
-  // doubling residency under the write lock is the one thing this spill
-  // must not do).
-  uint64_t at = e->seq;
-  std::string tmp = e->dir + "/ckpt.tmp";
-  std::string fin = e->dir + "/" + seg_name("ckpt", at);
-  FILE* f = fopen(tmp.c_str(), "wb");
-  if (!f) return -1;
-  setvbuf(f, nullptr, _IOFBF, 1 << 20);
-  uint64_t at_le = at;
-  bool ok = fwrite(kCkptMagic, 1, 6, f) == 6 && fwrite(&at_le, 1, 8, f) == 8;
-  uint32_t crc = 0;
-  std::string hdr;
-  for (int cf = 0; cf < kNumCfs && ok; cf++) {
-    for (const auto& [key, chain] : e->cfs[cf]) {
-      const std::string* v = resolve(chain, at);
-      if (v == nullptr) continue;
-      hdr.clear();
-      hdr.push_back(static_cast<char>(cf));
-      append_u32(hdr, static_cast<uint32_t>(key.size()));
-      hdr.append(key);
-      append_u32(hdr, static_cast<uint32_t>(v->size()));
-      crc = crc32c(reinterpret_cast<const uint8_t*>(hdr.data()), hdr.size(), crc);
-      crc = crc32c(reinterpret_cast<const uint8_t*>(v->data()), v->size(), crc);
-      ok = fwrite(hdr.data(), 1, hdr.size(), f) == hdr.size() &&
-           (v->empty() || fwrite(v->data(), 1, v->size(), f) == v->size());
-      if (!ok) break;
+// --- LSM sorted runs --------------------------------------------------------
+//
+// run<cf>-<max_seq:016x>: immutable sorted run flushed from the memtable (or
+// produced by a merge).  Layout:
+//   "TKRN2\n" | u8 cf | u8 kind (0 flush, 1 merged) | u64 max_seq
+//   data blocks: repeated (klen u32 | key | seq u64 | tomb u8 | vlen u32 | val)
+//   index: u32 n_blocks | per block (off u64 | len u32 | crc u32 |
+//          first_klen u32 | first_key)
+//   bloom: u64 n_bits | u32 k | u32 pad | words u64[]
+//   rtombs: u32 count | per rt (slen u32 | start | elen u32 | end | seq u64)
+//   footer: u64 index_off | u64 bloom_off | u64 n_entries |
+//           u32 crc32c(index..rtombs) | "TKRE"
+// Entries are sorted by key; a key's versions are adjacent, newest first.
+// Tombstones (point and range alike) are real entries: they mask older runs
+// and die only when a merge reaches the oldest run.
+
+constexpr char kRunMagic[] = "TKRN2\n";
+constexpr char kRunFoot[] = "TKRE";
+constexpr size_t kRunBlockTarget = 32 << 10;
+
+const char* run_prefix(int cf) {
+  static const char* names[kNumCfs] = {"run0", "run1", "run2", "run3"};
+  return names[cf];
+}
+
+uint64_t hash64(const uint8_t* p, size_t n, uint64_t seed) {
+  uint64_t h = 1469598103934665603ull ^ seed;
+  for (size_t i = 0; i < n; i++) {
+    h ^= p[i];
+    h *= 1099511628211ull;
+  }
+  h ^= h >> 33;
+  h *= 0xff51afd7ed558ccdull;
+  h ^= h >> 33;
+  return h;
+}
+
+struct RunWriter {
+  FILE* f = nullptr;
+  std::string tmp, fin;
+  uint64_t off = 0;
+  uint64_t n_entries = 0;
+  std::string block;
+  std::string block_first;
+  std::vector<Run::Block> index;
+  std::vector<uint64_t> key_hashes;  // one per distinct key
+  std::string last_key;
+  std::vector<RangeTomb> rtombs;  // set before finish(); written after bloom
+  bool ok = true;
+
+  int open(const std::string& dir, int cf, uint64_t max_seq, int kind) {
+    fin = dir + "/" + seg_name(run_prefix(cf), max_seq);
+    // a flush (under the engine lock) and a merge (without it) may write
+    // concurrently: the temp name must be private to this writer.  Final
+    // names never collide — a flush's max_seq is the current seq, a merge
+    // reuses its newest input's (older) name — so fin-derived is unique.
+    tmp = fin + (kind == 1 ? ".mrg.tmp" : ".tmp");
+    f = fopen(tmp.c_str(), "wb");
+    if (!f) return -1;
+    setvbuf(f, nullptr, _IOFBF, 1 << 20);
+    std::string hdr(kRunMagic, 6);
+    hdr.push_back(static_cast<char>(cf));
+    hdr.push_back(static_cast<char>(kind));
+    hdr.append(reinterpret_cast<const char*>(&max_seq), 8);
+    ok = fwrite(hdr.data(), 1, hdr.size(), f) == hdr.size();
+    off = hdr.size();
+    return ok ? 0 : -1;
+  }
+
+  void flush_block() {
+    if (block.empty()) return;
+    Run::Block b;
+    b.off = off;
+    b.len = static_cast<uint32_t>(block.size());
+    b.crc = crc32c(reinterpret_cast<const uint8_t*>(block.data()), block.size());
+    b.first_key = block_first;
+    ok = ok && fwrite(block.data(), 1, block.size(), f) == block.size();
+    off += block.size();
+    index.push_back(std::move(b));
+    block.clear();
+  }
+
+  void add(const std::string& key, uint64_t seq, bool tomb, const std::string& val) {
+    if (block.empty()) block_first = key;
+    append_u32(block, static_cast<uint32_t>(key.size()));
+    block.append(key);
+    block.append(reinterpret_cast<const char*>(&seq), 8);
+    block.push_back(tomb ? 1 : 0);
+    append_u32(block, static_cast<uint32_t>(val.size()));
+    block.append(val);
+    n_entries++;
+    if (key != last_key) {
+      key_hashes.push_back(
+          hash64(reinterpret_cast<const uint8_t*>(key.data()), key.size(), 0));
+      last_key = key;
+    }
+    // never split one key's version group across blocks: close only when the
+    // NEXT key starts (callers add all versions of a key consecutively), so
+    // flush at add() time happens on key boundaries via maybe_rotate()
+  }
+
+  void maybe_rotate(const std::string& next_key) {
+    if (block.size() >= kRunBlockTarget && next_key != last_key) flush_block();
+  }
+
+  // returns a loaded Run (fd open) or nullptr
+  std::shared_ptr<Run> finish(int cf, uint64_t max_seq, int kind = 0) {
+    flush_block();
+    auto run = std::make_shared<Run>();
+    run->cf = cf;
+    run->kind = kind;
+    run->max_seq = max_seq;
+    run->n_entries = n_entries;
+    run->path = fin;
+    // index section
+    std::string sec;
+    uint64_t index_off = off;
+    append_u32(sec, static_cast<uint32_t>(index.size()));
+    for (const auto& b : index) {
+      sec.append(reinterpret_cast<const char*>(&b.off), 8);
+      append_u32(sec, b.len);
+      append_u32(sec, b.crc);
+      append_u32(sec, static_cast<uint32_t>(b.first_key.size()));
+      sec.append(b.first_key);
+    }
+    // bloom section (10 bits/key, 6 probes)
+    uint64_t n_bits = key_hashes.empty() ? 64 : key_hashes.size() * 10;
+    n_bits = (n_bits + 63) / 64 * 64;
+    std::vector<uint64_t> bloom(n_bits / 64, 0);
+    uint32_t k = 6;
+    for (uint64_t h : key_hashes) {
+      uint64_t h2 = h * 0x9e3779b97f4a7c15ull + 1;
+      for (uint32_t i = 0; i < k; i++) {
+        uint64_t bit = (h + i * h2) % n_bits;
+        bloom[bit / 64] |= 1ull << (bit % 64);
+      }
+    }
+    uint64_t bloom_off = index_off + sec.size();
+    sec.append(reinterpret_cast<const char*>(&n_bits), 8);
+    append_u32(sec, k);
+    append_u32(sec, 0);
+    sec.append(reinterpret_cast<const char*>(bloom.data()), bloom.size() * 8);
+    // range-tombstone section
+    append_u32(sec, static_cast<uint32_t>(rtombs.size()));
+    for (const auto& rt : rtombs) {
+      append_u32(sec, static_cast<uint32_t>(rt.start.size()));
+      sec.append(rt.start);
+      append_u32(sec, static_cast<uint32_t>(rt.end.size()));
+      sec.append(rt.end);
+      sec.append(reinterpret_cast<const char*>(&rt.seq), 8);
+    }
+    uint32_t sec_crc = crc32c(reinterpret_cast<const uint8_t*>(sec.data()), sec.size());
+    std::string foot;
+    foot.append(reinterpret_cast<const char*>(&index_off), 8);
+    foot.append(reinterpret_cast<const char*>(&bloom_off), 8);
+    foot.append(reinterpret_cast<const char*>(&n_entries), 8);
+    append_u32(foot, sec_crc);
+    foot.append(kRunFoot, 4);
+    ok = ok && fwrite(sec.data(), 1, sec.size(), f) == sec.size() &&
+         fwrite(foot.data(), 1, foot.size(), f) == foot.size() &&
+         fflush(f) == 0 && fsync(fileno(f)) == 0;
+    fclose(f);
+    f = nullptr;
+    if (!ok || rename(tmp.c_str(), fin.c_str()) != 0) {
+      unlink(tmp.c_str());
+      return nullptr;
+    }
+    run->blocks = std::move(index);
+    run->bloom = std::move(bloom);
+    run->bloom_k = k;
+    run->rtombs = std::move(rtombs);
+    run->fd = ::open(fin.c_str(), O_RDONLY);
+    if (run->fd < 0) return nullptr;
+    return run;
+  }
+};
+
+// open + validate an existing run file; nullptr on structural damage
+std::shared_ptr<Run> run_open(const std::string& path) {
+  int fd = ::open(path.c_str(), O_RDONLY);
+  if (fd < 0) return nullptr;
+  off_t sz = lseek(fd, 0, SEEK_END);
+  if (sz < 16 + 32) { close(fd); return nullptr; }
+  char foot[32];
+  if (pread(fd, foot, 32, sz - 32) != 32 || memcmp(foot + 28, kRunFoot, 4) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  uint64_t index_off, bloom_off, n_entries;
+  uint32_t sec_crc;
+  memcpy(&index_off, foot, 8);
+  memcpy(&bloom_off, foot + 8, 8);
+  memcpy(&n_entries, foot + 16, 8);
+  memcpy(&sec_crc, foot + 24, 4);
+  if (index_off < 16 || index_off > static_cast<uint64_t>(sz) ||
+      bloom_off < index_off || bloom_off > static_cast<uint64_t>(sz)) {
+    close(fd);
+    return nullptr;
+  }
+  char hdr[16];
+  if (pread(fd, hdr, 16, 0) != 16 || memcmp(hdr, kRunMagic, 6) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  auto run = std::make_shared<Run>();
+  run->path = path;
+  run->cf = static_cast<uint8_t>(hdr[6]);
+  run->kind = static_cast<uint8_t>(hdr[7]);
+  memcpy(&run->max_seq, hdr + 8, 8);
+  run->n_entries = n_entries;
+  size_t sec_len = sz - 32 - index_off;
+  std::string sec(sec_len, '\0');
+  if (pread(fd, &sec[0], sec_len, index_off) != static_cast<ssize_t>(sec_len) ||
+      crc32c(reinterpret_cast<const uint8_t*>(sec.data()), sec_len) != sec_crc) {
+    close(fd);
+    return nullptr;
+  }
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(sec.data());
+  const uint8_t* end = p + sec_len;
+  if (end - p < 4) { close(fd); return nullptr; }
+  uint32_t n_blocks = read_u32(p);
+  for (uint32_t i = 0; i < n_blocks; i++) {
+    if (end - p < 20) { close(fd); return nullptr; }
+    Run::Block b;
+    memcpy(&b.off, p, 8);
+    p += 8;
+    b.len = read_u32(p);
+    b.crc = read_u32(p);
+    uint32_t klen = read_u32(p);
+    if (static_cast<uint64_t>(end - p) < klen) { close(fd); return nullptr; }
+    b.first_key.assign(reinterpret_cast<const char*>(p), klen);
+    p += klen;
+    run->blocks.push_back(std::move(b));
+  }
+  if (end - p < 16) { close(fd); return nullptr; }
+  uint64_t n_bits;
+  memcpy(&n_bits, p, 8);
+  p += 8;
+  run->bloom_k = read_u32(p);
+  p += 4;  // pad
+  if (static_cast<uint64_t>(end - p) < n_bits / 8) { close(fd); return nullptr; }
+  run->bloom.resize(n_bits / 64);
+  memcpy(run->bloom.data(), p, n_bits / 8);
+  p += n_bits / 8;
+  if (end - p < 4) { close(fd); return nullptr; }
+  uint32_t n_rt = read_u32(p);
+  for (uint32_t i = 0; i < n_rt; i++) {
+    RangeTomb rt;
+    if (end - p < 4) { close(fd); return nullptr; }
+    uint32_t slen = read_u32(p);
+    if (static_cast<uint64_t>(end - p) < static_cast<uint64_t>(slen) + 4) {
+      close(fd);
+      return nullptr;
+    }
+    rt.start.assign(reinterpret_cast<const char*>(p), slen);
+    p += slen;
+    uint32_t elen = read_u32(p);
+    if (static_cast<uint64_t>(end - p) < static_cast<uint64_t>(elen) + 8) {
+      close(fd);
+      return nullptr;
+    }
+    rt.end.assign(reinterpret_cast<const char*>(p), elen);
+    p += elen;
+    memcpy(&rt.seq, p, 8);
+    p += 8;
+    run->rtombs.push_back(std::move(rt));
+  }
+  run->fd = fd;
+  return run;
+}
+
+bool bloom_may_contain(const Run& r, const std::string& key) {
+  if (r.bloom.empty()) return true;
+  uint64_t n_bits = r.bloom.size() * 64;
+  uint64_t h = hash64(reinterpret_cast<const uint8_t*>(key.data()), key.size(), 0);
+  uint64_t h2 = h * 0x9e3779b97f4a7c15ull + 1;
+  for (uint32_t i = 0; i < r.bloom_k; i++) {
+    uint64_t bit = (h + i * h2) % n_bits;
+    if (!(r.bloom[bit / 64] & (1ull << (bit % 64)))) return false;
+  }
+  return true;
+}
+
+int run_read_block(const Run& r, size_t bi, std::string* out, Perf* perf) {
+  const Run::Block& b = r.blocks[bi];
+  out->resize(b.len);
+  if (pread(r.fd, &(*out)[0], b.len, b.off) != static_cast<ssize_t>(b.len))
+    return -1;
+  if (crc32c(reinterpret_cast<const uint8_t*>(out->data()), b.len) != b.crc)
+    return -1;
+  if (perf) perf->blocks_read.fetch_add(1, std::memory_order_relaxed);
+  return 0;
+}
+
+// last block whose first_key <= key (the block that could hold it)
+long run_block_for(const Run& r, const std::string& key) {
+  long lo = 0, hi = static_cast<long>(r.blocks.size()) - 1, ans = -1;
+  while (lo <= hi) {
+    long mid = (lo + hi) / 2;
+    if (r.blocks[mid].first_key <= key) {
+      ans = mid;
+      lo = mid + 1;
+    } else {
+      hi = mid - 1;
     }
   }
-  ok = ok && fwrite(kCkptFoot, 1, 4, f) == 4 && fwrite(&crc, 1, 4, f) == 4;
-  ok = ok && fflush(f) == 0 && fsync(fileno(f)) == 0;
-  fclose(f);
-  if (!ok || rename(tmp.c_str(), fin.c_str()) != 0) {
-    unlink(tmp.c_str());
-    return -1;
+  return ans;
+}
+
+// point lookup in one run: 0 = absent, 1 = value, 2 = tombstone, <0 = error
+int run_get(const Run& r, const std::string& key, uint64_t snap_seq,
+            std::string* val, uint64_t* out_seq, Perf* perf) {
+  if (!bloom_may_contain(r, key)) {
+    if (perf) perf->bloom_skips.fetch_add(1, std::memory_order_relaxed);
+    return 0;
   }
-  fsync_dir(e->dir);
-  // new WAL segment BEFORE deleting the old ones: if the open fails the
-  // previous log remains intact and the engine can refuse further writes
-  // without having lost anything
+  long bi = run_block_for(r, key);
+  if (bi < 0) return 0;
+  if (perf) perf->run_probes.fetch_add(1, std::memory_order_relaxed);
+  std::string block;
+  if (run_read_block(r, bi, &block, perf) != 0) return -1;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(block.data());
+  const uint8_t* end = p + block.size();
+  while (p < end) {
+    if (end - p < 4) return -1;
+    uint32_t klen = read_u32(p);
+    if (static_cast<uint64_t>(end - p) < static_cast<uint64_t>(klen) + 13) return -1;
+    int cmp = memcmp(p, key.data(), std::min<size_t>(klen, key.size()));
+    if (cmp == 0) cmp = (klen < key.size()) ? -1 : (klen > key.size() ? 1 : 0);
+    p += klen;
+    uint64_t seq;
+    memcpy(&seq, p, 8);
+    p += 8;
+    uint8_t tomb = *p++;
+    uint32_t vlen = read_u32(p);
+    if (static_cast<uint64_t>(end - p) < vlen) return -1;
+    if (cmp > 0) return 0;  // past the key: absent in this run
+    if (cmp == 0 && seq <= snap_seq) {
+      if (tomb) return 2;
+      val->assign(reinterpret_cast<const char*>(p), vlen);
+      *out_seq = seq;
+      return 1;
+    }
+    p += vlen;
+  }
+  return 0;
+}
+
+// sequential cursor over one run's per-key version groups, range-aware
+struct RunCursor {
+  const Run* run;
+  Perf* perf;
+  std::string block;
+  size_t bi = 0;          // next block index to load
+  const uint8_t* p = nullptr;
+  const uint8_t* end = nullptr;
+  std::string key;
+  std::vector<Version> versions;  // newest first (run entry order)
+  bool valid = false;
+
+  void seek(const Run* r, const std::string& start, Perf* pf) {
+    run = r;
+    perf = pf;
+    long b = run_block_for(*r, start);
+    bi = b < 0 ? 0 : static_cast<size_t>(b);
+    p = end = nullptr;
+    valid = true;
+    next_group();
+    while (valid && key < start) next_group();
+  }
+
+  bool load_next_block() {
+    while (bi < run->blocks.size()) {
+      if (run_read_block(*run, bi, &block, perf) != 0) { valid = false; return false; }
+      bi++;
+      p = reinterpret_cast<const uint8_t*>(block.data());
+      end = p + block.size();
+      if (p < end) return true;
+    }
+    return false;
+  }
+
+  // parse one entry at p (advances); false on exhaustion/corruption
+  bool parse(std::string* k, uint64_t* seq, bool* tomb, std::string* v) {
+    if (p >= end && !load_next_block()) return false;
+    if (end - p < 4) { valid = false; return false; }
+    uint32_t klen = read_u32(p);
+    if (static_cast<uint64_t>(end - p) < static_cast<uint64_t>(klen) + 13) {
+      valid = false;
+      return false;
+    }
+    k->assign(reinterpret_cast<const char*>(p), klen);
+    p += klen;
+    memcpy(seq, p, 8);
+    p += 8;
+    *tomb = *p++ != 0;
+    uint32_t vlen = read_u32(p);
+    if (static_cast<uint64_t>(end - p) < vlen) { valid = false; return false; }
+    v->assign(reinterpret_cast<const char*>(p), vlen);
+    p += vlen;
+    return true;
+  }
+
+  std::string pending_key;
+  std::vector<Version> pending;
+  bool have_pending = false;
+
+  void next_group() {
+    if (!valid) return;
+    key.clear();
+    versions.clear();
+    bool have_key = false;
+    if (have_pending) {
+      key = std::move(pending_key);
+      versions = std::move(pending);
+      pending.clear();
+      have_pending = false;
+      have_key = true;
+    }
+    std::string k, v;
+    uint64_t seq;
+    bool tomb;
+    while (parse(&k, &seq, &tomb, &v)) {
+      if (!have_key) {
+        key = k;
+        have_key = true;
+      }
+      if (k == key) {
+        versions.push_back(Version{seq, tomb, std::move(v)});
+        continue;
+      }
+      // next key's first version: stash it
+      pending_key = std::move(k);
+      pending.clear();
+      pending.push_back(Version{seq, tomb, std::move(v)});
+      have_pending = true;
+      return;
+    }
+    if (versions.empty()) valid = false;
+  }
+};
+
+// forward merged iterator over memtable + all runs of one CF, resolving
+// versions at a snapshot and filtering tombstones.  Caller holds (at least)
+// the shared engine lock for the iterator's whole lifetime.
+struct MergeIter {
+  const Table* t;
+  Table::const_iterator mit, mend;
+  std::vector<RunCursor> cursors;
+  uint64_t snap;
+  std::string upper;  // exclusive; empty + !has_upper = unbounded
+  bool has_upper = false;
+
+  std::vector<RangeTomb> rts;  // tombstones visible at snap touching range
+
+  void init(Engine* e, int cf, uint64_t snap_seq, const std::string& start,
+            const std::string& end, bool bounded) {
+    t = &e->cfs[cf];
+    snap = snap_seq;
+    upper = end;
+    has_upper = bounded;
+    mit = t->lower_bound(start);
+    if (bounded && end <= start) {
+      mend = mit;  // empty range: never walk past the map's real bounds
+      return;
+    }
+    mend = bounded ? t->lower_bound(end) : t->end();
+    cursors.resize(e->runs[cf].size());
+    for (size_t i = 0; i < cursors.size(); i++)
+      cursors[i].seek(e->runs[cf][i].get(), start, &e->perf);
+    // hoist the relevant range tombstones once: per-key masking below walks
+    // only this (usually empty) filtered list, not every run's full set
+    auto want = [&](const RangeTomb& rt) {
+      return rt.seq <= snap_seq && rt.end > start && (!bounded || rt.start < end);
+    };
+    for (const auto& rt : e->mem_rtombs[cf])
+      if (want(rt)) rts.push_back(rt);
+    for (const auto& run : e->runs[cf])
+      for (const auto& rt : run->rtombs)
+        if (want(rt)) rts.push_back(rt);
+  }
+
+  // next visible (key, value); false when exhausted
+  bool next(std::string* out_k, std::string* out_v) {
+    while (true) {
+      const std::string* min_key = nullptr;
+      bool from_mem = false;
+      if (mit != mend) {
+        min_key = &mit->first;
+        from_mem = true;
+      }
+      for (auto& c : cursors) {
+        if (!c.valid) continue;
+        if (has_upper && c.key >= upper) { c.valid = false; continue; }
+        if (min_key == nullptr || c.key < *min_key) {
+          min_key = &c.key;
+          from_mem = false;
+        }
+      }
+      if (min_key == nullptr) return false;
+      std::string key = *min_key;
+      // resolve newest-source-first: memtable, then runs in list order
+      Res r = Res::MISS;
+      const std::string* v = nullptr;
+      uint64_t v_seq = 0;
+      if (from_mem || (mit != mend && mit->first == key))
+        r = resolve3(mit->second, snap, &v, &v_seq);
+      std::string run_val;
+      if (r == Res::MISS) {
+        for (auto& c : cursors) {
+          if (!c.valid || c.key != key) continue;
+          for (const auto& ver : c.versions) {
+            if (ver.seq <= snap) {
+              if (ver.tombstone) {
+                r = Res::TOMB;
+              } else {
+                run_val = ver.value;
+                v_seq = ver.seq;
+                r = Res::HIT;
+                v = &run_val;
+              }
+              break;
+            }
+          }
+          if (r != Res::MISS) break;
+        }
+      }
+      // advance every source positioned at this key
+      if (mit != mend && mit->first == key) ++mit;
+      for (auto& c : cursors)
+        if (c.valid && c.key == key) c.next_group();
+      if (r == Res::HIT && rtomb_covering(rts, key, snap) < v_seq) {
+        *out_k = std::move(key);
+        *out_v = *v;
+        return true;
+      }
+      // MISS (all newer than snap), TOMB, or range-delete-masked: skip
+    }
+  }
+};
+
+// reverse merged iteration materializes per-key resolution walking backward:
+// run blocks are forward-parsed but visited in reverse block order
+struct ReverseRunCursor {
+  const Run* run = nullptr;
+  Perf* perf;
+  long bi = -1;  // block currently loaded
+  std::vector<std::pair<std::string, std::vector<Version>>> groups;
+  long gi = -1;  // current group (descending)
+  bool valid = false;
+
+  void seek_last_below(const Run* r, const std::string& upper, bool bounded,
+                       Perf* pf) {
+    run = r;
+    perf = pf;
+    bi = static_cast<long>(r->blocks.size()) - 1;
+    if (bounded) {
+      long b = run_block_for(*r, upper);
+      bi = b < 0 ? -1 : b;
+    }
+    valid = bi >= 0;
+    groups.clear();
+    gi = -1;
+    if (valid) load(bounded ? &upper : nullptr);
+  }
+
+  void load(const std::string* upper) {
+    groups.clear();
+    gi = -1;
+    while (bi >= 0 && groups.empty()) {
+      std::string block;
+      if (run_read_block(*run, bi, &block, perf) != 0) { valid = false; return; }
+      const uint8_t* p = reinterpret_cast<const uint8_t*>(block.data());
+      const uint8_t* end = p + block.size();
+      while (p < end) {
+        if (end - p < 4) { valid = false; return; }
+        uint32_t klen = read_u32(p);
+        if (static_cast<uint64_t>(end - p) < static_cast<uint64_t>(klen) + 13) {
+          valid = false;
+          return;
+        }
+        std::string k(reinterpret_cast<const char*>(p), klen);
+        p += klen;
+        uint64_t seq;
+        memcpy(&seq, p, 8);
+        p += 8;
+        bool tomb = *p++ != 0;
+        uint32_t vlen = read_u32(p);
+        if (static_cast<uint64_t>(end - p) < vlen) { valid = false; return; }
+        if (upper == nullptr || k < *upper) {
+          if (groups.empty() || groups.back().first != k)
+            groups.emplace_back(std::move(k), std::vector<Version>{});
+          groups.back().second.push_back(
+              Version{seq, tomb, std::string(reinterpret_cast<const char*>(p), vlen)});
+        }
+        p += vlen;
+      }
+      bi--;
+    }
+    if (groups.empty()) {
+      valid = false;
+      return;
+    }
+    gi = static_cast<long>(groups.size()) - 1;
+  }
+
+  const std::string& key() const { return groups[gi].first; }
+  const std::vector<Version>& versions() const { return groups[gi].second; }
+
+  void prev_group() {
+    // a key can span a block boundary (its versions split across blocks) —
+    // the writer prevents that (maybe_rotate splits only at key boundaries),
+    // so stepping is purely positional
+    gi--;
+    if (gi < 0 && valid) load(nullptr);
+    if (gi < 0) valid = false;
+  }
+};
+
+struct ReverseMergeIter {
+  const Table* t;
+  Table::const_iterator mit, mbegin;  // mit points PAST the current candidate
+  bool mem_valid = false;
+  std::string mem_key;
+  std::vector<ReverseRunCursor> cursors;
+  uint64_t snap;
+  std::string lower;  // inclusive bound
+
+  std::vector<RangeTomb> rts;  // tombstones visible at snap touching range
+
+  void init(Engine* e, int cf, uint64_t snap_seq, const std::string& start,
+            const std::string& end, bool bounded) {
+    t = &e->cfs[cf];
+    snap = snap_seq;
+    lower = start;
+    mem_valid = false;
+    if (bounded && end <= start) return;  // empty range: lower_bound(end)
+    // could sit BEFORE mbegin and --it below would walk out of the range
+    // (or decrement begin())
+    mbegin = t->lower_bound(start);
+    auto it = bounded ? t->lower_bound(end) : t->end();
+    mem_valid = it != mbegin;
+    if (mem_valid) {
+      --it;
+      mem_key = it->first;
+    }
+    mit = it;
+    cursors.resize(e->runs[cf].size());
+    for (size_t i = 0; i < cursors.size(); i++) {
+      cursors[i].seek_last_below(e->runs[cf][i].get(), end, bounded, &e->perf);
+      while (cursors[i].valid && cursors[i].key() < lower) cursors[i].valid = false;
+    }
+    auto want = [&](const RangeTomb& rt) {
+      return rt.seq <= snap_seq && rt.end > start && (!bounded || rt.start < end);
+    };
+    for (const auto& rt : e->mem_rtombs[cf])
+      if (want(rt)) rts.push_back(rt);
+    for (const auto& run : e->runs[cf])
+      for (const auto& rt : run->rtombs)
+        if (want(rt)) rts.push_back(rt);
+  }
+
+  bool next(std::string* out_k, std::string* out_v) {
+    while (true) {
+      const std::string* max_key = nullptr;
+      if (mem_valid) max_key = &mem_key;
+      for (auto& c : cursors) {
+        if (!c.valid) continue;
+        if (c.key() < lower) { c.valid = false; continue; }
+        if (max_key == nullptr || c.key() > *max_key) max_key = &c.key();
+      }
+      if (max_key == nullptr) return false;
+      std::string key = *max_key;
+      Res r = Res::MISS;
+      const std::string* v = nullptr;
+      uint64_t v_seq = 0;
+      if (mem_valid && mem_key == key)
+        r = resolve3(mit->second, snap, &v, &v_seq);
+      std::string run_val;
+      if (r == Res::MISS) {
+        for (auto& c : cursors) {
+          if (!c.valid || c.key() != key) continue;
+          for (const auto& ver : c.versions()) {
+            if (ver.seq <= snap) {
+              if (ver.tombstone) {
+                r = Res::TOMB;
+              } else {
+                run_val = ver.value;
+                v_seq = ver.seq;
+                r = Res::HIT;
+                v = &run_val;
+              }
+              break;
+            }
+          }
+          if (r != Res::MISS) break;
+        }
+      }
+      if (mem_valid && mem_key == key) {
+        if (mit == mbegin) {
+          mem_valid = false;
+        } else {
+          --mit;
+          mem_key = mit->first;
+        }
+      }
+      for (auto& c : cursors) {
+        if (c.valid && c.key() == key) {
+          c.prev_group();
+          if (c.valid && c.key() < lower) c.valid = false;
+        }
+      }
+      if (r == Res::HIT && rtomb_covering(rts, key, snap) < v_seq) {
+        *out_k = std::move(key);
+        *out_v = *v;
+        return true;
+      }
+    }
+  }
+};
+
+// write the whole memtable of one CF (chains + range tombstones) as a run
+std::shared_ptr<Run> run_from_table(Engine* e, int cf, uint64_t max_seq) {
+  RunWriter w;
+  if (w.open(e->dir, cf, max_seq, 0) != 0) return nullptr;
+  for (const auto& [key, chain] : e->cfs[cf]) {
+    w.maybe_rotate(key);
+    for (const auto& v : chain) w.add(key, v.seq, v.tombstone, v.value);
+  }
+  w.rtombs = e->mem_rtombs[cf];
+  return w.finish(cf, max_seq);
+}
+
+// spill the whole memtable to per-CF runs, clear it, rotate the WAL — the
+// incremental replacement for the O(DB) checkpoint spill: each flush costs
+// O(memtable), never O(database).  Caller holds the write lock.
+int flush_memtable(Engine* e) {
+  if (e->dir.empty()) return -1;
+  uint64_t at = e->seq;
+  std::vector<std::shared_ptr<Run>> created;
+  if (at > e->flushed_seq) {
+    for (int cf = 0; cf < kNumCfs; cf++) {
+      if (e->cfs[cf].empty() && e->mem_rtombs[cf].empty()) continue;
+      auto run = run_from_table(e, cf, at);
+      if (!run) {
+        for (auto& r : created) unlink(r->path.c_str());
+        return -1;
+      }
+      created.push_back(run);
+    }
+    fsync_dir(e->dir);
+    // completion marker: a flush is visible to recovery only once ALL its
+    // per-CF runs are durable (multi-file atomicity).  Written even when
+    // no run was produced (every record since the last flush was a no-op):
+    // the marker is what tells recovery the older WAL is fully covered, so
+    // it must advance whenever the WAL is about to be truncated — deleting
+    // mark-N without a successor would make recovery distrust every run.
+    std::string mark = e->dir + "/" + seg_name("mark", at);
+    int mfd = ::open(mark.c_str(), O_CREAT | O_WRONLY, 0644);
+    if (mfd < 0) {
+      for (auto& r : created) unlink(r->path.c_str());
+      return -1;
+    }
+    fsync(mfd);
+    close(mfd);
+    fsync_dir(e->dir);
+  }
+  // new WAL segment BEFORE deleting old ones: if the open fails the previous
+  // log remains intact and the engine refuses further writes, losing nothing
   if (wal_open_segment(e, at) != 0) return -1;
+  for (auto& r : created)
+    e->runs[r->cf].insert(e->runs[r->cf].begin(), r);
+  if (at > e->flushed_seq) {
+    for (int cf = 0; cf < kNumCfs; cf++) {
+      e->cfs[cf].clear();
+      e->mem_rtombs[cf].clear();
+    }
+    e->mem_bytes = 0;
+    e->flushed_seq = at;
+    e->perf.flushes.fetch_add(1, std::memory_order_relaxed);
+  }
   std::vector<uint64_t> old;
-  list_segs(e->dir, "ckpt", &old);
-  for (uint64_t s : old)
-    if (s < at) unlink((e->dir + "/" + seg_name("ckpt", s)).c_str());
-  old.clear();
   list_segs(e->dir, "wal", &old);
   for (uint64_t s : old)
     if (s < at) unlink((e->dir + "/" + seg_name("wal", s)).c_str());
-  // ingested SSTs at-or-below the checkpoint are folded in: drop the files
+  // legacy checkpoints and folded ingests are superseded: the flush captured
+  // the whole memtable, which included anything they had loaded
+  old.clear();
+  list_segs(e->dir, "ckpt", &old);
+  for (uint64_t s : old)
+    if (s <= at) unlink((e->dir + "/" + seg_name("ckpt", s)).c_str());
   old.clear();
   list_segs(e->dir, "sst", &old);
   for (uint64_t s : old)
     if (s <= at) unlink((e->dir + "/" + seg_name("sst", s)).c_str());
+  old.clear();
+  list_segs(e->dir, "mark", &old);
+  for (uint64_t s : old)
+    if (s < at) unlink((e->dir + "/" + seg_name("mark", s)).c_str());
   return 0;
+}
+
+// k-way merge of every current run of one CF into a single run, dropping
+// version history below the snapshot horizon and bottom-level tombstones.
+// Runs are immutable, so the merge reads WITHOUT the engine lock; the swap
+// takes it briefly (rocksdb compaction's locking shape).
+int merge_runs_cf(Engine* e, int cf) {
+  std::unique_lock cl(e->compact_mu);
+  std::vector<std::shared_ptr<Run>> inputs;
+  uint64_t min_snap;
+  {
+    std::shared_lock lk(e->mu);
+    if (e->runs[cf].size() < 2) return 0;
+    inputs = e->runs[cf];
+    min_snap = std::min(e->min_live_snapshot(), e->seq);
+  }
+  uint64_t max_seq = inputs.front()->max_seq;
+  RunWriter w;
+  if (w.open(e->dir, cf, max_seq, 1) != 0) return -1;
+  // range tombstones: ones no snapshot can see below fold into the output
+  // now (applied to the merged versions, then dropped — this is the only
+  // level, so nothing older remains for them to mask; memtable versions are
+  // all newer than any run seq, out of reach by construction).  Newer ones
+  // ride along into the output run.
+  std::vector<RangeTomb> dying_rtombs, kept_rtombs;
+  for (const auto& r : inputs)
+    for (const auto& rt : r->rtombs)
+      (rt.seq <= min_snap ? dying_rtombs : kept_rtombs).push_back(rt);
+  w.rtombs = kept_rtombs;
+  std::vector<RunCursor> cur(inputs.size());
+  for (size_t i = 0; i < inputs.size(); i++)
+    cur[i].seek(inputs[i].get(), std::string(), &e->perf);
+  std::vector<Version> merged;
+  while (true) {
+    const std::string* min_key = nullptr;
+    for (auto& c : cur)
+      if (c.valid && (min_key == nullptr || c.key < *min_key)) min_key = &c.key;
+    if (min_key == nullptr) break;
+    std::string key = *min_key;
+    merged.clear();
+    for (auto& c : cur) {  // newest source first: global newest-first order
+      if (c.valid && c.key == key) {
+        for (auto& v : c.versions) merged.push_back(std::move(v));
+        c.next_group();
+      }
+    }
+    // trim: versions > min_snap plus the newest <= min_snap
+    size_t keep = merged.size();
+    for (size_t i = 0; i < merged.size(); i++) {
+      if (merged[i].seq <= min_snap) {
+        keep = i + 1;
+        break;
+      }
+    }
+    merged.resize(keep);
+    // apply dying range tombstones now: a version at/below a folded range
+    // delete is invisible to every future snapshot (all >= min_snap)
+    uint64_t rts = 0;
+    for (const auto& rt : dying_rtombs)
+      if (rt.seq > rts && rt.start <= key && key < rt.end) rts = rt.seq;
+    while (!merged.empty() && merged.back().seq <= rts) merged.pop_back();
+    if (merged.empty()) continue;
+    // bottom level: a tombstone no snapshot can miss masks nothing anymore
+    if (merged.size() == 1 && merged[0].tombstone && merged[0].seq <= min_snap)
+      continue;
+    w.maybe_rotate(key);
+    for (const auto& v : merged) w.add(key, v.seq, v.tombstone, v.value);
+  }
+  // the output keeps inputs.front()'s name: rename clobbers that path (old
+  // readers keep their fd; POSIX keeps the old inode alive), so it must NOT
+  // be unlinked below
+  auto out = w.finish(cf, max_seq, 1);
+  if (!out) return -1;
+  // the rename must be on disk before the input unlinks below can be:
+  // otherwise a crash could persist the unlinks but not the rename, leaving
+  // only the stale pre-merge run at the output's path
+  fsync_dir(e->dir);
+  {
+    std::unique_lock lk(e->mu);
+    auto& rs = e->runs[cf];
+    // inputs occupy a contiguous tail (flushes only prepend); replace it
+    size_t pos = 0;
+    while (pos < rs.size() && rs[pos] != inputs.front()) pos++;
+    if (pos == rs.size()) { unlink(out->path.c_str()); return -1; }  // raced
+    rs.resize(pos);
+    rs.push_back(out);
+  }
+  for (size_t i = 1; i < inputs.size(); i++) unlink(inputs[i]->path.c_str());
+  e->perf.run_merges.fetch_add(1, std::memory_order_relaxed);
+  return 1;
 }
 
 // load the newest structurally-valid checkpoint; returns its seq (0 = none)
@@ -598,11 +1518,60 @@ void* eng_open_at(const char* path, int sync_mode) {
   e->dir = path;
   e->sync_mode = sync_mode;
   mkdir(path, 0755);
-  uint64_t ck = ckpt_load(e);
+  // drop temp files of crashed flushes/merges (never renamed = never trusted)
+  if (DIR* d = opendir(path)) {
+    struct dirent* ent;
+    while ((ent = readdir(d)) != nullptr) {
+      std::string n = ent->d_name;
+      if (n.size() > 4 && n.compare(n.size() - 4, 4, ".tmp") == 0)
+        unlink((e->dir + "/" + n).c_str());
+    }
+    closedir(d);
+  }
+  // sorted runs first (newest list position = highest seq).  Only runs at or
+  // below the newest completion marker are trusted: runs above it belong to
+  // a flush that crashed mid-way (its data is still in the WAL), and once a
+  // merged-kind run is seen, everything older in that CF was its input.
+  std::vector<uint64_t> marks;
+  list_segs(e->dir, "mark", &marks);
+  uint64_t mark = marks.empty() ? 0 : marks.back();
+  bool have_runs = false;
+  for (int cf = 0; cf < kNumCfs; cf++) {
+    std::vector<uint64_t> seqs;
+    list_segs(e->dir, run_prefix(cf), &seqs);
+    for (auto it = seqs.rbegin(); it != seqs.rend(); ++it) {
+      std::string rp = e->dir + "/" + seg_name(run_prefix(cf), *it);
+      if (*it > mark) {
+        unlink(rp.c_str());  // partial flush: WAL still covers these records
+        continue;
+      }
+      if (!e->runs[cf].empty() && e->runs[cf].back()->kind == 1) {
+        unlink(rp.c_str());  // leftover input of a completed full-cf merge
+        continue;
+      }
+      auto run = run_open(rp);
+      if (!run) {
+        // a trusted run (at/below the marker) is damaged and the WAL that
+        // covered it is gone: opening would silently lose acked writes —
+        // refuse, like a torn WAL segment
+        delete e;
+        return nullptr;
+      }
+      e->runs[cf].push_back(run);
+      have_runs = true;
+    }
+  }
+  e->flushed_seq = mark;
+  e->seq = e->flushed_seq;
+  // legacy full-state checkpoints load only when no runs exist (runs always
+  // supersede them: a flush deletes folded checkpoints, and loading an older
+  // checkpoint into the memtable would break the memtable-newest invariant)
+  uint64_t ck = have_runs ? e->flushed_seq : ckpt_load(e);
+  if (ck > e->seq) e->seq = ck;
   std::vector<uint64_t> wals;
   list_segs(e->dir, "wal", &wals);
   for (uint64_t s : wals) {
-    if (s < ck) continue;  // fully folded into the checkpoint
+    if (s < ck) continue;  // fully folded into the checkpoint/runs
     if (wal_replay(e, e->dir + "/" + seg_name("wal", s)) != 0) {
       delete e;  // could not repair a torn segment: refuse the open
       return nullptr;
@@ -640,11 +1609,13 @@ int eng_write(void* h, const uint8_t* data, uint64_t len) {
   r = apply_batch(e, data, len, seq);
   if (r != 0) return r;  // unreachable after validate; defensive
   e->seq = seq;
-  if (e->wal_limit > 0 && e->wal_bytes >= e->wal_limit && !e->dir.empty()) {
-    // inline auto-spill (memtable-full flush equivalent); a failed spill
-    // that lost its log fd must stop acking writes, not go silently
-    // non-durable
-    if (ckpt_write(e) != 0 && e->wal_fd < 0) e->failed = true;
+  if (!e->dir.empty() &&
+      ((e->wal_limit > 0 && e->wal_bytes >= e->wal_limit) ||
+       (e->mem_limit > 0 && e->mem_bytes >= e->mem_limit))) {
+    // inline memtable flush (rocksdb's memtable-full write stall, bounded
+    // by memtable size — never O(database)); a failed flush that lost its
+    // log fd must stop acking writes, not go silently non-durable
+    if (flush_memtable(e) != 0 && e->wal_fd < 0) e->failed = true;
   }
   return 0;
 }
@@ -745,12 +1716,53 @@ int eng_ingest_sst(void* h, const char* src_path) {
 }
 
 int eng_checkpoint(void* h) {
+  // checkpoint == memtable flush: durable sorted runs + WAL truncation.
+  // (The legacy O(DB) full-state spill is gone; ckpt_load remains for
+  // reading directories written by it.)
   Engine* e = static_cast<Engine*>(h);
   std::unique_lock lk(e->mu);
   if (e->dir.empty()) return -1;
-  int r = ckpt_write(e);
+  int r = flush_memtable(e);
   if (r != 0 && e->wal_fd < 0) e->failed = true;  // log fd lost: stop acking
   return r;
+}
+
+int eng_flush(void* h) { return eng_checkpoint(h); }
+
+void eng_set_mem_limit(void* h, uint64_t bytes) {
+  Engine* e = static_cast<Engine*>(h);
+  std::unique_lock lk(e->mu);
+  e->mem_limit = bytes;
+}
+
+// number of on-disk sorted runs for one CF
+int eng_run_count(void* h, int cf) {
+  Engine* e = static_cast<Engine*>(h);
+  if (cf < 0 || cf >= kNumCfs) return -2;
+  std::shared_lock lk(e->mu);
+  return static_cast<int>(e->runs[cf].size());
+}
+
+// merge all runs of one CF into a single run (background compaction step);
+// returns 1 when a merge happened, 0 when <2 runs, <0 on error
+int eng_merge_runs(void* h, int cf) {
+  Engine* e = static_cast<Engine*>(h);
+  if (cf < 0 || cf >= kNumCfs) return -2;
+  return merge_runs_cf(e, cf);
+}
+
+// perf context (engine_rocks/src/perf_context.rs):
+// out[0]=gets out[1]=memtable_hits out[2]=run_probes out[3]=bloom_skips
+// out[4]=blocks_read out[5]=flushes out[6]=run_merges
+void eng_perf(void* h, uint64_t* out) {
+  Engine* e = static_cast<Engine*>(h);
+  out[0] = e->perf.gets.load(std::memory_order_relaxed);
+  out[1] = e->perf.memtable_hits.load(std::memory_order_relaxed);
+  out[2] = e->perf.run_probes.load(std::memory_order_relaxed);
+  out[3] = e->perf.bloom_skips.load(std::memory_order_relaxed);
+  out[4] = e->perf.blocks_read.load(std::memory_order_relaxed);
+  out[5] = e->perf.flushes.load(std::memory_order_relaxed);
+  out[6] = e->perf.run_merges.load(std::memory_order_relaxed);
 }
 
 void eng_set_wal_limit(void* h, uint64_t bytes) {
@@ -816,11 +1828,33 @@ int eng_get(void* h, int cf, const uint8_t* key, uint64_t klen,
   Engine* e = static_cast<Engine*>(h);
   if (cf < 0 || cf >= kNumCfs) return -2;
   std::shared_lock lk(e->mu);
+  e->perf.gets.fetch_add(1, std::memory_order_relaxed);
   const Table& t = e->cfs[cf];
-  auto it = t.find(std::string(reinterpret_cast<const char*>(key), klen));
-  if (it == t.end()) return 0;
-  const std::string* v = resolve(it->second, snap_seq);
-  if (v == nullptr) return 0;
+  std::string k(reinterpret_cast<const char*>(key), klen);
+  const std::string* v = nullptr;
+  uint64_t v_seq = 0;
+  Res r = Res::MISS;
+  auto it = t.find(k);
+  if (it != t.end()) r = resolve3(it->second, snap_seq, &v, &v_seq);
+  if (r == Res::HIT)
+    e->perf.memtable_hits.fetch_add(1, std::memory_order_relaxed);
+  std::string run_val;
+  if (r == Res::MISS) {
+    // newest run first; a hit or tombstone in a newer run masks older ones
+    for (const auto& run : e->runs[cf]) {
+      int rr = run_get(*run, k, snap_seq, &run_val, &v_seq, &e->perf);
+      if (rr < 0) return -3;
+      if (rr == 2) return 0;  // tombstone
+      if (rr == 1) {
+        v = &run_val;
+        r = Res::HIT;
+        break;
+      }
+    }
+  }
+  if (r != Res::HIT) return 0;
+  // a range delete at or after the value's version masks it
+  if (e->rtomb_seq(cf, k, snap_seq) >= v_seq) return 0;
   *out = static_cast<uint8_t*>(malloc(v->size()));
   memcpy(*out, v->data(), v->size());
   *out_len = v->size();
@@ -837,7 +1871,6 @@ long eng_scan(void* h, int cf, uint64_t snap_seq, const uint8_t* start,
   Engine* e = static_cast<Engine*>(h);
   if (cf < 0 || cf >= kNumCfs) return -2;
   std::shared_lock lk(e->mu);
-  const Table& t = e->cfs[cf];
   std::string s(reinterpret_cast<const char*>(start), start_len);
   std::string en(reinterpret_cast<const char*>(end_key), end_len);
   std::string buf;
@@ -849,22 +1882,17 @@ long eng_scan(void* h, int cf, uint64_t snap_seq, const uint8_t* start,
     buf.append(v);
     n++;
   };
+  std::string k, v;
   if (!reverse) {
-    auto it = t.lower_bound(s);
-    auto stop = has_end ? t.lower_bound(en) : t.end();
-    for (; it != stop && (limit == 0 || n < static_cast<long>(limit)); ++it) {
-      const std::string* v = resolve(it->second, snap_seq);
-      if (v != nullptr) emit(it->first, *v);
-    }
+    MergeIter mi;
+    mi.init(e, cf, snap_seq, s, en, has_end != 0);
+    while ((limit == 0 || n < static_cast<long>(limit)) && mi.next(&k, &v))
+      emit(k, v);
   } else {
-    auto it = has_end ? t.lower_bound(en) : t.end();
-    auto stop = t.lower_bound(s);
-    while (it != stop && (limit == 0 || n < static_cast<long>(limit))) {
-      --it;
-      const std::string* v = resolve(it->second, snap_seq);
-      if (v != nullptr) emit(it->first, *v);
-      if (it == stop) break;
-    }
+    ReverseMergeIter mi;
+    mi.init(e, cf, snap_seq, s, en, has_end != 0);
+    while ((limit == 0 || n < static_cast<long>(limit)) && mi.next(&k, &v))
+      emit(k, v);
   }
   *out = static_cast<uint8_t*>(malloc(buf.size()));
   memcpy(*out, buf.data(), buf.size());
@@ -882,43 +1910,32 @@ int eng_seek(void* h, int cf, uint64_t snap_seq, const uint8_t* target,
   Engine* e = static_cast<Engine*>(h);
   if (cf < 0 || cf >= kNumCfs) return -2;
   std::shared_lock lk(e->mu);
-  const Table& t = e->cfs[cf];
   std::string tg(reinterpret_cast<const char*>(target), target_len);
   std::string lo(reinterpret_cast<const char*>(lower), lower_len);
   std::string up(reinterpret_cast<const char*>(upper), upper_len);
+  std::string k, v;
+  bool found;
   if (!for_prev) {
-    auto it = t.lower_bound(tg < lo ? lo : tg);
-    auto stop = has_upper ? t.lower_bound(up) : t.end();
-    for (; it != stop; ++it) {
-      const std::string* v = resolve(it->second, snap_seq);
-      if (v == nullptr) continue;
-      *kout = static_cast<uint8_t*>(malloc(it->first.size()));
-      memcpy(*kout, it->first.data(), it->first.size());
-      *kout_len = it->first.size();
-      *vout = static_cast<uint8_t*>(malloc(v->size()));
-      memcpy(*vout, v->data(), v->size());
-      *vout_len = v->size();
-      return 1;
-    }
-    return 0;
+    MergeIter mi;
+    mi.init(e, cf, snap_seq, tg < lo ? lo : tg, up, has_upper != 0);
+    found = mi.next(&k, &v);
+  } else {
+    // last visible key <= target within [lower, upper): the reverse bound is
+    // exclusive, so extend the inclusive target by one zero byte
+    std::string end_incl = tg + std::string(1, '\0');
+    if (has_upper && up < end_incl) end_incl = up;
+    ReverseMergeIter mi;
+    mi.init(e, cf, snap_seq, lo, end_incl, true);
+    found = mi.next(&k, &v);
   }
-  // seek_for_prev: last visible key <= target within [lower, upper)
-  auto it = t.upper_bound(tg);
-  while (it != t.begin()) {
-    --it;
-    if (it->first < lo) return 0;
-    if (has_upper && it->first >= up) continue;
-    const std::string* v = resolve(it->second, snap_seq);
-    if (v == nullptr) continue;
-    *kout = static_cast<uint8_t*>(malloc(it->first.size()));
-    memcpy(*kout, it->first.data(), it->first.size());
-    *kout_len = it->first.size();
-    *vout = static_cast<uint8_t*>(malloc(v->size()));
-    memcpy(*vout, v->data(), v->size());
-    *vout_len = v->size();
-    return 1;
-  }
-  return 0;
+  if (!found) return 0;
+  *kout = static_cast<uint8_t*>(malloc(k.size()));
+  memcpy(*kout, k.data(), k.size());
+  *kout_len = k.size();
+  *vout = static_cast<uint8_t*>(malloc(v.size()));
+  memcpy(*vout, v.data(), v.size());
+  *vout_len = v.size();
+  return 1;
 }
 
 void eng_free(uint8_t* p) { free(p); }
@@ -953,6 +1970,42 @@ long eng_compact_step(void* h, int cf, const uint8_t* from, uint64_t from_len,
   Table& t = e->cfs[cf];
   uint64_t min_snap = std::min(e->min_live_snapshot(), e->seq);
   long dropped = 0;
+  // deferred range-delete application: with no runs (in-memory engines, or
+  // durable CFs before their first flush) the memtable is the whole store,
+  // so a range tombstone no snapshot can see below is applied here and
+  // reclaimed — compaction is where deferred deletes get paid for.  With
+  // runs present the tombstone still masks flushed data and must stay
+  // until flush carries it into a run and a merge folds it.
+  if (e->runs[cf].empty() && !e->mem_rtombs[cf].empty()) {
+    std::vector<RangeTomb> still_needed;
+    for (auto& rt : e->mem_rtombs[cf]) {
+      if (rt.seq > min_snap) {
+        still_needed.push_back(std::move(rt));
+        continue;
+      }
+      auto rit = t.lower_bound(rt.start);
+      auto stop = t.lower_bound(rt.end);
+      while (rit != stop) {
+        Chain& ch = rit->second;
+        while (!ch.empty() && ch.back().seq <= rt.seq) {
+          e->mem_bytes -= std::min(e->mem_bytes,
+                                   ch.back().value.size() + kVersionOverhead);
+          ch.pop_back();
+          dropped++;
+        }
+        if (ch.empty()) {
+          e->mem_bytes -= std::min(e->mem_bytes,
+                                   rit->first.size() + kKeyOverhead);
+          rit = t.erase(rit);
+        } else {
+          ++rit;
+        }
+      }
+      e->mem_bytes -= std::min(
+          e->mem_bytes, rt.start.size() + rt.end.size() + kVersionOverhead);
+    }
+    e->mem_rtombs[cf] = std::move(still_needed);
+  }
   uint64_t seen = 0;
   auto it = t.lower_bound(std::string(reinterpret_cast<const char*>(from), from_len));
   while (it != t.end() && seen < max_keys) {
@@ -972,8 +2025,11 @@ long eng_compact_step(void* h, int cf, const uint8_t* from, uint64_t from_len,
     }
     chain.resize(keep);
     // erase: the newest version overall is a tombstone no snapshot can miss
+    // — but only when no sorted run could hold an older value it still
+    // masks; with runs present the tombstone must survive in the memtable
+    // (and later in a run) until a bottom-level merge drops it
     if (!chain.empty() && chain.front().tombstone &&
-        chain.front().seq <= min_snap) {
+        chain.front().seq <= min_snap && e->runs[cf].empty()) {
       dropped += static_cast<long>(chain.size());
       uint64_t key_cost = it->first.size() + kKeyOverhead;
       for (const auto& v : chain)
@@ -1014,20 +2070,18 @@ int eng_mvcc_props(void* h, int cf, const uint8_t* start, uint64_t start_len,
   Engine* e = static_cast<Engine*>(h);
   if (cf < 0 || cf >= kNumCfs) return -2;
   std::shared_lock lk(e->mu);
-  const Table& t = e->cfs[cf];
   std::string s(reinterpret_cast<const char*>(start), start_len);
   std::string en(reinterpret_cast<const char*>(end_key), end_len);
   uint64_t entries = 0, rows = 0, puts = 0, dels = 0, other = 0;
   uint64_t min_ts = UINT64_MAX, max_ts = 0, max_row = 0, cur_row = 0;
   std::string cur_user;
   bool have_user = false;
-  auto it = t.lower_bound(s);
-  auto stop = has_end ? t.lower_bound(en) : t.end();
-  for (; it != stop; ++it) {
-    const std::string* v = resolve(it->second, snap_seq);
-    if (v == nullptr) continue;
+  MergeIter mi;
+  mi.init(e, cf, snap_seq, s, en, has_end != 0);
+  std::string k, val;
+  while (mi.next(&k, &val)) {
+    const std::string* v = &val;
     entries++;
-    const std::string& k = it->first;
     if (k.size() >= 8) {
       // commit_ts rides the last 8 key bytes, bit-inverted big-endian
       uint64_t ts = 0;

@@ -20,6 +20,9 @@ from ..storage.engine import ALL_CFS, Cursor, KvEngine, Snapshot, WriteBatch
 
 _CF_IDS = {cf: i for i, cf in enumerate(ALL_CFS)}
 
+# background compaction folds a CF's sorted runs once this many accumulate
+MERGE_FANIN = 4
+
 def _serialize_ops(ops) -> bytes:
     """The native wire format (op u8 | cf u8 | klen u32 | key | vlen u32 |
     val) has exactly ONE encoder — write() and bulk_load() both come here.
@@ -132,6 +135,14 @@ def _load():
         lib.eng_build_sst.restype = ctypes.c_int
         lib.eng_ingest_sst.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.eng_ingest_sst.restype = ctypes.c_int
+        lib.eng_flush.argtypes = [ctypes.c_void_p]
+        lib.eng_flush.restype = ctypes.c_int
+        lib.eng_set_mem_limit.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.eng_run_count.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.eng_run_count.restype = ctypes.c_int
+        lib.eng_merge_runs.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.eng_merge_runs.restype = ctypes.c_int
+        lib.eng_perf.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
         _lib = lib
         return _lib
 
@@ -329,14 +340,16 @@ class NativeSnapshot(Snapshot):
 
 
 class NativeEngine(KvEngine):
-    """In-memory by default; pass ``path`` for a durable engine: every
+    """In-memory by default; pass ``path`` for a durable LSM engine: every
     committed WriteBatch is WAL-appended + fdatasync'd before the write
-    returns (``sync=False`` keeps OS-buffered appends), checkpoints spill
-    full state via atomic tmp+rename, and open() recovers checkpoint + WAL
-    (engine_rocks WAL/flush + raft_log_engine recovery semantics)."""
+    returns (``sync=False`` keeps OS-buffered appends); memtable flushes
+    write immutable block-indexed, bloom-filtered sorted runs and truncate
+    the WAL; reads merge memtable + runs; background merges fold runs and
+    drop bottom-level tombstones (engine_rocks over rocksdb: WAL + memtable
+    flush + SST levels + compaction + perf context, re-derived)."""
 
     def __init__(self, path: str | None = None, sync: bool = True,
-                 wal_limit: int | None = None):
+                 wal_limit: int | None = None, mem_limit: int | None = None):
         lib = _load()
         if lib is None:
             raise RuntimeError(f"native engine unavailable: {_lib_err}")
@@ -352,12 +365,43 @@ class NativeEngine(KvEngine):
                 raise RuntimeError(f"cannot open engine dir {path!r}")
         if wal_limit is not None:
             lib.eng_set_wal_limit(self._handle, wal_limit)
+        if mem_limit is not None:
+            lib.eng_set_mem_limit(self._handle, mem_limit)
 
     def checkpoint(self) -> None:
-        """Spill full visible state; truncates the WAL (flush + compaction)."""
+        """Flush the memtable to sorted runs; truncates the WAL.  O(memtable),
+        never O(database) — the incremental successor of the full spill."""
         r = self._lib.eng_checkpoint(self._handle)
         if r != 0:
             raise RuntimeError(f"eng_checkpoint failed: {r}")
+
+    flush = checkpoint
+
+    def set_mem_limit(self, limit: int) -> None:
+        """Memtable flush threshold in bytes (0 = manual flush only)."""
+        self._lib.eng_set_mem_limit(self._handle, limit)
+
+    def run_count(self, cf: str = "default") -> int:
+        """On-disk sorted runs for one CF."""
+        return self._lib.eng_run_count(self._handle, _CF_IDS[cf])
+
+    def merge_runs(self, cf: str) -> int:
+        """Merge every run of a CF into one (background compaction step);
+        returns 1 if a merge happened."""
+        r = self._lib.eng_merge_runs(self._handle, _CF_IDS[cf])
+        if r < 0:
+            raise RuntimeError(f"eng_merge_runs failed: {r}")
+        return r
+
+    def perf_context(self) -> dict:
+        """Per-read statistics (engine_rocks perf_context.rs role)."""
+        import ctypes
+
+        out = (ctypes.c_uint64 * 7)()
+        self._lib.eng_perf(self._handle, out)
+        names = ("gets", "memtable_hits", "run_probes", "bloom_skips",
+                 "blocks_read", "flushes", "run_merges")
+        return dict(zip(names, out))
 
     def set_sync(self, sync: bool) -> None:
         """Import-mode tuning (import_mode.rs): buffered WAL during bulk
@@ -422,6 +466,12 @@ class NativeEngine(KvEngine):
             while not self._compact_stop.wait(interval_s):
                 try:
                     self.compact()
+                    # fold accumulated runs (leveled-compaction role): merge
+                    # whenever a CF's run count reaches the fan-in
+                    if self.path is not None:
+                        for cf in _CF_IDS:
+                            if self.run_count(cf) >= MERGE_FANIN:
+                                self.merge_runs(cf)
                 except RuntimeError:
                     return
 
