@@ -296,3 +296,109 @@ def test_too_many_group_keys_rejected_at_init():
             ]
         )
         ShardedGroupedEvaluator(dag, make_mesh(groups=1), 64, capacity=8)
+
+
+# --- serving-path mesh integration (BASELINE config #5 shape) ---------------
+
+
+def _mvcc_engine(n=3000):
+    """Committed MVCC rows of the numeric table inside a BTreeEngine."""
+    from tikv_tpu.storage.btree_engine import BTreeEngine
+    from tikv_tpu.storage.engine import WriteBatch
+    from tikv_tpu.storage.txn_types import Key, Write, WriteType
+
+    cols, kvs, _ = numeric_table_kvs(n, seed=7)
+    eng = BTreeEngine()
+    wb = WriteBatch()
+    for rk, val in kvs:
+        wb.put_cf("write", Key.from_raw(rk).append_ts(11).encoded,
+                  Write(WriteType.PUT, 10, short_value=val).to_bytes())
+    eng.write(wb)
+    return cols, eng
+
+
+@pytest.mark.parametrize("groups", [1, 2])
+def test_endpoint_mesh_serving_byte_identical(groups):
+    """Endpoint.handle_request over an MVCC-decoded region must return
+    byte-identical responses on 1 device and on the full 8-device mesh."""
+    from tikv_tpu.copr.endpoint import CoprRequest, Endpoint
+    from tikv_tpu.copr.table import record_range
+    from tikv_tpu.storage.kv import LocalEngine
+
+    cols, eng = _mvcc_engine()
+    mesh = make_mesh(groups=groups)
+    ep_mesh = Endpoint(LocalEngine(eng), enable_device=True, mesh=mesh)
+    ep_one = Endpoint(LocalEngine(eng), enable_device=True)
+    ep_cpu = Endpoint(LocalEngine(eng), enable_device=False)
+    plans = [
+        # scalar aggregation with selection (Q6 shape)
+        [TableScan(TABLE_ID, cols),
+         Selection([call("lt", col(2), const_int(40))]),
+         Aggregation([], [AggDescriptor("count", None),
+                          AggDescriptor("sum", col(3)),
+                          AggDescriptor("min", col(2)),
+                          AggDescriptor("max", col(3))])],
+        # grouped aggregation (Q1 shape)
+        [TableScan(TABLE_ID, cols),
+         Aggregation([col(2)], [AggDescriptor("count", None),
+                                AggDescriptor("sum", col(3)),
+                                AggDescriptor("avg", col(3))])],
+    ]
+    for execs in plans:
+        req = lambda: CoprRequest(
+            103, DagRequest(executors=execs), [record_range(TABLE_ID)], 100, context={})
+        r_mesh = ep_mesh.handle_request(req())
+        r_one = ep_one.handle_request(req())
+        r_cpu = ep_cpu.handle_request(req())
+        assert r_mesh.from_device, f"mesh path fell back: {ep_mesh.last_device_error}"
+        assert r_mesh.data == r_one.data == r_cpu.data
+    assert ep_mesh.device_fallbacks == 0, ep_mesh.last_device_error
+    # the mesh runners were actually used for these aggregation DAGs
+    assert len(ep_mesh._mesh_runners) == len(plans)
+
+
+def test_endpoint_mesh_group_growth():
+    """More groups than the initial sharded capacity: state migrates to a
+    larger capacity mid-scan and the answer stays byte-identical."""
+    from tikv_tpu.copr.endpoint import CoprRequest, Endpoint
+    from tikv_tpu.copr.table import record_range
+    from tikv_tpu.storage.kv import LocalEngine
+
+    cols, eng = _mvcc_engine(2500)
+    ep_mesh = Endpoint(LocalEngine(eng), enable_device=True, mesh=make_mesh(groups=2))
+    ep_cpu = Endpoint(LocalEngine(eng), enable_device=False)
+    # group by id % large modulus → dozens of groups (> capacity 16)
+    execs = [TableScan(TABLE_ID, cols),
+             Aggregation([call("mod", col(1), const_int(97))],
+                         [AggDescriptor("count", None), AggDescriptor("sum", col(2))])]
+    req = lambda: CoprRequest(
+        103, DagRequest(executors=execs), [record_range(TABLE_ID)], 100, context={})
+    r_mesh = ep_mesh.handle_request(req())
+    r_cpu = ep_cpu.handle_request(req())
+    assert r_mesh.from_device, ep_mesh.last_device_error
+    assert r_mesh.data == r_cpu.data
+
+
+def test_mesh_serving_runner_non_pow2_groups():
+    """A groups axis of 3 must yield a divisible capacity, not an infinite
+    capacity-search loop."""
+    from tikv_tpu.parallel.mesh import MeshServingRunner
+
+    mesh = make_mesh(jax.devices()[:6], groups=3)
+    runner = MeshServingRunner(q6ish(), mesh, rows_per_shard=64)
+    assert runner.sharded.capacity % 3 == 0
+
+
+def test_mesh_rejects_non_agg_dag_cheaply():
+    """Scan/TopN DAGs route to the single-device evaluator, and the negative
+    outcome is cached so repeat requests skip re-probing."""
+    from tikv_tpu.copr.endpoint import Endpoint
+    from tikv_tpu.storage.btree_engine import BTreeEngine
+    from tikv_tpu.storage.kv import LocalEngine
+
+    ep = Endpoint(LocalEngine(BTreeEngine()), mesh=make_mesh(groups=2))
+    dag = DagRequest(executors=[TableScan(TABLE_ID, COLS), TopN([(col(1), False)], 5)])
+    assert ep._mesh_evaluator_for(dag) is None
+    key = next(iter(ep._mesh_runners))
+    assert ep._mesh_runners[key] is None  # cached negative
+    assert ep._mesh_evaluator_for(dag) is None

@@ -25,6 +25,8 @@ REQ_TYPE_DAG = 103
 REQ_TYPE_ANALYZE = 104
 REQ_TYPE_CHECKSUM = 105
 
+_MESH_UNCHECKED = object()  # sentinel: DAG not yet probed for mesh eligibility
+
 
 @dataclass
 class CoprRequest:
@@ -53,6 +55,7 @@ class Endpoint:
         block_cache: CopCache | None = None,
         concurrency_manager=None,
         slow_log=None,
+        mesh=None,
     ):
         from .tracker import SlowLog
 
@@ -62,6 +65,11 @@ class Endpoint:
         self.cm = concurrency_manager
         self.slow_log = slow_log or SlowLog()
         self._evaluators: dict = {}
+        # multi-device serving: a (regions × groups) jax.sharding.Mesh shards
+        # eligible aggregation DAGs' row blocks across devices (scale-out
+        # analog of region sharding); single-device when None or 1 device
+        self.mesh = mesh
+        self._mesh_runners: dict = {}
         # device-path failures observed (CPU fallback taken): a permanently
         # broken device shows up here instead of only as from_device=False
         self.device_fallbacks = 0
@@ -92,8 +100,12 @@ class Endpoint:
         if use_device:
             cache = None
             try:
-                ev = self._evaluator_for(req.dag)
                 cache = self._block_cache_for(req)
+                # mesh path only when no block cache is in play: the cache's
+                # HBM-pinned entries are a single-device structure
+                ev = self._mesh_evaluator_for(req.dag) if cache is None else None
+                if ev is None:
+                    ev = self._evaluator_for(req.dag)
                 src = None
                 if cache is None or not cache.filled:
                     src = MvccBatchScanSource(snap, req.start_ts, req.ranges)
@@ -232,6 +244,28 @@ class Endpoint:
             while len(self._evaluators) > 64:
                 self._evaluators.pop(next(iter(self._evaluators)))
         return ev
+
+    def _mesh_evaluator_for(self, dag: DagRequest):
+        """A MeshServingRunner when the mesh has >1 device and the DAG is an
+        eligible aggregation; None routes to the single-device evaluator."""
+        if self.mesh is None or self.mesh.size <= 1:
+            return None
+        from ..parallel.mesh import MeshServingRunner
+        from ..server import wire
+        from .dag_wire import dag_to_wire
+
+        key = wire.dumps(dag_to_wire(dag))
+        runner = self._mesh_runners.get(key, _MESH_UNCHECKED)
+        if runner is _MESH_UNCHECKED:
+            try:
+                runner = MeshServingRunner(dag, self.mesh)
+            except ValueError:
+                runner = None  # not an aggregation DAG — cached so repeat
+                # requests skip re-probing (single-device path)
+            self._mesh_runners[key] = runner
+            while len(self._mesh_runners) > 16:
+                self._mesh_runners.pop(next(iter(self._mesh_runners)))
+        return runner
 
     def _block_cache_for(self, req: CoprRequest):
         """Decoded-block cache, valid only while the region data is unchanged:

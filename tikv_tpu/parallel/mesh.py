@@ -603,3 +603,86 @@ class ShardedTopNEvaluator:
             "gidx": out[self.n_key_ops - 1][:live],
             "payload": payload,
         }
+
+
+class MeshServingRunner:
+    """Endpoint-facing mesh execution of an eligible aggregation DAG.
+
+    The scale-out analog of region sharding (``raftstore/src/coprocessor/
+    split_check/``): ``Endpoint`` hands this runner the same MVCC scan source
+    the single-device path uses; rows are decoded on host into super-blocks,
+    sharded over the ``regions`` axis, and the group state stays sharded over
+    ``groups`` between blocks.  Group-id assignment and finalization reuse the
+    single-device evaluator's host code, so the encoded ``SelectResponse`` is
+    byte-identical to the one-device (and CPU) answer.
+    """
+
+    def __init__(self, dag: DagRequest, mesh: Mesh, rows_per_shard: int = 1024):
+        from math import gcd
+
+        from ..copr.jax_eval import _analyze
+
+        # eligibility first, before any evaluator construction: the rejection
+        # path must stay cheap (Endpoint probes every device-eligible DAG)
+        if _analyze(dag).agg is None:
+            raise ValueError("mesh serving requires an aggregation DAG")
+        self.mesh = mesh
+        self.rows_per_shard = rows_per_shard
+        self.n_groups = mesh.shape["groups"]
+        # smallest multiple of n_groups >= 16 (doubling alone never reaches
+        # divisibility for a non-power-of-two groups axis)
+        cap = 16 * self.n_groups // gcd(16, self.n_groups)
+        self.sharded = ShardedDagEvaluator(dag, mesh, rows_per_shard, capacity=cap)
+        self.total_rows = self.sharded.total_rows
+        # decode/gid/finalize machinery at super-block granularity
+        self.decode_ev = JaxDagEvaluator(dag, block_rows=self.total_rows)
+
+    def _grow(self, state, n_groups: int):
+        from ..copr.jax_eval import _grow_carry
+
+        cap = self.sharded.capacity
+        while n_groups > cap:
+            cap *= 2
+        first, carries = jax.tree.map(np.asarray, state)
+        new_first = np.full(cap, _NO_ROW, dtype=np.int64)
+        new_first[: len(first)] = first
+        new_carries = tuple(
+            _grow_carry(da, c, cap)
+            for da, c in zip(self.sharded.ev.device_aggs, carries)
+        )
+        self.sharded = ShardedDagEvaluator(
+            self.decode_ev.dag, self.mesh, self.rows_per_shard, capacity=cap
+        )
+        return (jnp.asarray(new_first), new_carries)
+
+    def run(self, source, cache=None) -> "SelectResponse":
+        """Same signature as JaxDagEvaluator.run; the block cache is a
+        single-device HBM concept and is ignored here (Endpoint routes cached
+        requests down the single-device path)."""
+        from ..copr.groupby import GroupDict
+        from ..copr.jax_eval import _ZERO_GIDS
+
+        ev = self.decode_ev
+        total = self.total_rows
+        groups = GroupDict()
+        state = self.sharded.init_state()
+        block_base = 0
+        for cols, n_valid in ev._decode_blocks(source):
+            if ev.group_rpns:
+                gids, n_groups = ev._assign_gids(cols, n_valid, groups)
+                if n_groups > self.sharded.capacity:
+                    state = self._grow(state, n_groups)
+            else:
+                gids = _ZERO_GIDS.setdefault(total, np.zeros(total, dtype=np.int32))
+            need = set(ev.device_cols) | set(ev.nullable_cols)
+            columns = {
+                i: (ev._pad(cols[i].data), ev._pad(cols[i].nulls, True))
+                for i in need
+            }
+            col_data, col_nulls, valid = _marshal_block(ev, columns, n_valid, total)
+            state = self.sharded.step(col_data, col_nulls, valid, gids, state,
+                                      block_base=block_base)
+            block_base += total
+        n_slots = len(groups) if ev.group_rpns else 1
+        state_np = jax.tree.map(np.asarray, state)
+        return ev._finalize_agg(state_np, n_slots, lambda r: groups.rows[r])
