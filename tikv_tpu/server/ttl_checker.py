@@ -61,7 +61,7 @@ class TtlChecker:
             # delete time — a key re-put after the snapshot must survive (the
             # reference's compaction filter checks expiry at filter time)
             cid = latches.gen_cid()
-            slots = latches.acquire(cid, chunk)
+            slots = latches.acquire_blocking(cid, chunk)
             try:
                 cur = self.storage.engine.snapshot(ctx)
                 wb = WriteBatch()

@@ -212,7 +212,7 @@ class Storage:
     ) -> tuple[bool, bytes | None]:
         """Atomic CAS via latches (commands/compare_and_swap.rs)."""
         cid = self._raw_latches.gen_cid()
-        slots = self._raw_latches.acquire(cid, [key])
+        slots = self._raw_latches.acquire_blocking(cid, [key])
         try:
             cur = self.raw_get(key, ctx)
             if cur != previous:

@@ -1,15 +1,30 @@
-"""Per-key latches serializing conflicting write commands.
+"""Per-key latches with wake-up chains serializing conflicting commands.
 
-Re-expression of ``src/storage/txn/latch.rs:141,162,188``: commands acquire a
-latch per touched key (hashed into slots); a command runs only when it is at
-the front of every slot's queue, guaranteeing FIFO fairness per key and
-atomic read-modify-write across its snapshot+write window.
+Re-expression of ``src/storage/txn/latch.rs:141,162,188``: each touched key
+hashes into a slot holding a FIFO queue of command ids.  A command owns the
+latch set once it is at the front of every slot it enqueued on.  Acquisition
+is NON-BLOCKING: a command that is not at every front parks, and the
+releasing command's ``release()`` returns the ids that just completed their
+acquisition — the wake-up chain the scheduler uses to re-schedule parked
+commands onto its pool (scheduler.rs release_lock → try_to_wake_up).  No
+thread ever sleeps inside the latch table.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 from collections import deque
+from dataclasses import dataclass
+
+
+@dataclass
+class _Waiting:
+    """A parked command: which slots it needs and how many fronts it holds."""
+
+    slots: list[int]
+    fronts: int = 0
+    payload: object = None  # the scheduler's task, handed back at wake-up
 
 
 class Latches:
@@ -17,51 +32,82 @@ class Latches:
         self.size = size
         self._slots: list[deque[int]] = [deque() for _ in range(size)]
         self._mu = threading.Lock()
-        self._cv = threading.Condition(self._mu)
-        self._next_cid = 0
+        self._cids = itertools.count(1)
+        self._waiting: dict[int, _Waiting] = {}
 
     def gen_cid(self) -> int:
-        with self._mu:
-            self._next_cid += 1
-            return self._next_cid
+        return next(self._cids)
 
-    def _slot_ids(self, keys: list[bytes]) -> list[int]:
+    def slot_ids(self, keys: list[bytes]) -> list[int]:
+        """The slots a key set hashes to — exposed so a caller can record
+        them on its task BEFORE publishing the task as an acquire payload
+        (the wake-up chain may run the task the instant the table sees it)."""
         return sorted({hash(k) % self.size for k in keys})
 
-    def acquire_all(self, cid: int) -> list[int]:
+    _slot_ids = slot_ids
+
+    def acquire(self, cid: int, keys: list[bytes], payload=None):
+        """Enqueue on every slot for ``keys``.  Returns ``(granted, slots)``:
+        granted means the command is at every front and may run NOW;
+        otherwise it is parked and its payload will be handed back by the
+        ``release()`` call that completes its acquisition."""
+        return self._acquire_slots(cid, self._slot_ids(keys), payload)
+
+    def acquire_all(self, cid: int, payload=None):
         """Exclusive acquisition of EVERY slot — range commands (flashback)
         that must serialize against all per-key writers."""
-        return self._acquire_slots(cid, list(range(self.size)))
+        return self._acquire_slots(cid, list(range(self.size)), payload)
 
-    def acquire(self, cid: int, keys: list[bytes]) -> list[int]:
-        """Enqueue cid on each slot and block until it is at every front."""
-        return self._acquire_slots(cid, self._slot_ids(keys))
-
-    def _acquire_slots(self, cid: int, slots: list[int]) -> list[int]:
-        with self._cv:
-            for s in slots:
-                self._slots[s].append(cid)
-            while not all(self._slots[s][0] == cid for s in slots):
-                self._cv.wait()
+    def acquire_blocking(self, cid: int, keys: list[bytes]) -> list[int]:
+        """Block the calling thread until the latches are owned — for users
+        outside the sched pool (raw CAS, TTL sweeps) that run on their own
+        thread and want the old blocking semantics."""
+        ev = threading.Event()
+        granted, slots = self.acquire(cid, keys, payload=ev)
+        if not granted:
+            ev.wait()
         return slots
 
-    def try_acquire(self, cid: int, keys: list[bytes]) -> tuple[bool, list[int]]:
-        """Non-blocking: enqueue and report whether all fronts are ours."""
-        slots = self._slot_ids(keys)
-        with self._cv:
-            for s in slots:
-                if cid not in self._slots[s]:
-                    self._slots[s].append(cid)
-            return all(self._slots[s][0] == cid for s in slots), slots
+    def acquire_slots(self, cid: int, slots: list[int], payload=None):
+        """Acquire pre-computed slots (from ``slot_ids``)."""
+        return self._acquire_slots(cid, slots, payload)
 
-    def release(self, cid: int, slots: list[int]) -> None:
-        with self._cv:
+    def _acquire_slots(self, cid: int, slots: list[int], payload):
+        with self._mu:
+            fronts = 0
             for s in slots:
-                if self._slots[s] and self._slots[s][0] == cid:
-                    self._slots[s].popleft()
-                else:
+                self._slots[s].append(cid)
+                if self._slots[s][0] == cid:
+                    fronts += 1
+            if fronts == len(slots):
+                return True, slots
+            self._waiting[cid] = _Waiting(slots, fronts, payload)
+            return False, slots
+
+    def release(self, cid: int, slots: list[int]) -> list[object]:
+        """Remove ``cid`` (which owned every slot in ``slots``) and return the
+        payloads of commands whose acquisition just completed — the wake-up
+        chain.  The caller re-schedules them; nothing blocks in here."""
+        woken: list[object] = []
+        with self._mu:
+            for s in slots:
+                q = self._slots[s]
+                if q and q[0] == cid:
+                    q.popleft()
+                else:  # defensive: command errored before owning this slot
                     try:
-                        self._slots[s].remove(cid)
+                        q.remove(cid)
                     except ValueError:
                         pass
-            self._cv.notify_all()
+                    continue  # no new front exposed
+                if q:
+                    w = self._waiting.get(q[0])
+                    if w is not None:
+                        w.fronts += 1
+                        if w.fronts == len(w.slots):
+                            del self._waiting[q[0]]
+                            if isinstance(w.payload, threading.Event):
+                                w.payload.set()  # blocking acquirer wakes here
+                            else:
+                                woken.append(w.payload)
+        return woken

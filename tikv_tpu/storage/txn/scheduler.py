@@ -1,44 +1,179 @@
-"""Txn command scheduler: latches → snapshot → process_write → engine write.
+"""Txn command scheduler: latches → sched pool → process_write → engine.
 
-Re-expression of ``src/storage/txn/scheduler.rs:277`` (run_cmd:333,
-schedule_command:353, execute:413, process_write:683): commands serialize on
-per-key latches, execute against a fresh snapshot, and their WriteBatch goes
-through the Engine; latches release on completion and queued commands wake.
+Re-expression of ``src/storage/txn/scheduler.rs:277-683`` (run_cmd:333,
+schedule_command:353, execute:413, process_write:683, release_lock wake-up
+chains, too_busy flow control):
 
-The reference runs this over a sched thread pool; here execution is
-synchronous per call (thread-safe — callers may be many threads), which keeps
-the same ordering guarantees with Python-level simplicity.
+* commands try their per-key latches NON-blocking; a loser parks in the
+  latch queue and is re-scheduled by the releasing command's wake-up chain —
+  no pool thread ever sleeps holding nothing
+* execution happens on a bounded sched pool (``sched-worker-N`` threads);
+  high-priority commands jump the run queue (the reference's separate
+  high-priority pool, expressed as strict two-level dispatch)
+* flow control: when queued+running commands exceed
+  ``pending_write_threshold``, new normal-priority commands fail fast with
+  ``SchedTooBusy`` (scheduler.rs too_busy → ServerIsBusy) instead of growing
+  the queue without bound; high-priority commands bypass the check
+* ``run_command`` stays a synchronous facade (submit + wait) so every
+  existing caller keeps its ordering guarantees
 """
 
 from __future__ import annotations
 
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+
+from ...util import error_code
 from ...util.failpoint import fail_point
 from ..kv import Engine
 from .commands import Command
 from .latches import Latches
 
+SCHED_TOO_BUSY = error_code.define(
+    "KV:Storage:SchedTooBusy", "txn scheduler write queue is full"
+)
+
+
+class SchedTooBusy(Exception):
+    """Raised at submission when the scheduler is over its pending-write
+    threshold (the client should back off and retry — ServerIsBusy)."""
+
+
+error_code.register(SchedTooBusy, SCHED_TOO_BUSY)
+
+
+@dataclass
+class _Task:
+    cmd: Command
+    ctx: dict | None
+    cid: int
+    high: bool
+    slots: list[int] = field(default_factory=list)
+    done: threading.Event = field(default_factory=threading.Event)
+    result: object = None
+    exc: BaseException | None = None
+
 
 class Scheduler:
-    def __init__(self, engine: Engine, concurrency_manager=None, latch_slots: int = 256):
+    def __init__(
+        self,
+        engine: Engine,
+        concurrency_manager=None,
+        latch_slots: int = 256,
+        pool_size: int = 4,
+        pending_write_threshold: int = 256,
+    ):
         self.engine = engine
         self.latches = Latches(latch_slots)
         self.cm = concurrency_manager
+        self.pool_size = pool_size
+        self.pending_write_threshold = pending_write_threshold
+        self._mu = threading.Lock()
+        self._ready = threading.Condition(self._mu)
+        self._high: deque[_Task] = deque()
+        self._normal: deque[_Task] = deque()
+        self._inflight = 0  # submitted, not yet finished (queued or running)
+        self._threads: list[threading.Thread] = []
+        self._stopped = False
+        # observability (scheduler.rs metrics role)
+        self.stats = {"scheduled": 0, "woken": 0, "too_busy": 0}
+
+    # --- submission ---------------------------------------------------------
 
     def run_command(self, cmd: Command, ctx: dict | None = None):
-        cid = self.latches.gen_cid()
-        if getattr(cmd, "exclusive", False):
-            # range commands whose snapshot must BE the write-time state
-            # (flashback) take every latch slot — full mutual exclusion
-            slots = self.latches.acquire_all(cid)
-        else:
-            slots = self.latches.acquire(cid, cmd.latch_keys())
+        """Synchronous facade: submit, wait, raise the command's error."""
+        task = self.submit(cmd, ctx)
+        task.done.wait()
+        if task.exc is not None:
+            raise task.exc
+        return task.result
+
+    def submit(self, cmd: Command, ctx: dict | None = None) -> _Task:
+        high = bool(ctx and ctx.get("priority") == "high")
+        with self._mu:
+            if self._stopped:
+                raise RuntimeError("scheduler is stopped")
+            if not high and self._inflight >= self.pending_write_threshold:
+                self.stats["too_busy"] += 1
+                raise SchedTooBusy(
+                    f"{self._inflight} commands pending "
+                    f"(threshold {self.pending_write_threshold})"
+                )
+            self._inflight += 1
+            self._ensure_threads()
+        try:
+            cid = self.latches.gen_cid()
+            task = _Task(cmd, ctx, cid, high)
+            # slots go on the task BEFORE the latch table sees it: a parked
+            # task can be woken and executed the moment acquire publishes it,
+            # and release() needs task.slots populated by then
+            if getattr(cmd, "exclusive", False):
+                task.slots = list(range(self.latches.size))
+            else:
+                task.slots = self.latches.slot_ids(cmd.latch_keys())
+            granted, _ = self.latches.acquire_slots(cid, task.slots, task)
+        except BaseException:
+            with self._mu:
+                self._inflight -= 1  # never reached _execute's decrement
+            raise
+        if granted:
+            self._enqueue(task)
+        # else: parked — some release() will hand the task back
+        return task
+
+    def _enqueue(self, task: _Task) -> None:
+        with self._mu:
+            (self._high if task.high else self._normal).append(task)
+            self.stats["scheduled"] += 1
+            self._ready.notify()
+
+    def _ensure_threads(self) -> None:
+        # lazily grown to pool_size; caller holds self._mu
+        while len(self._threads) < self.pool_size and not self._stopped:
+            t = threading.Thread(
+                target=self._worker,
+                name=f"sched-worker-{len(self._threads)}",
+                daemon=True,
+            )
+            self._threads.append(t)
+            t.start()
+
+    # --- execution ----------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            with self._ready:
+                while not self._high and not self._normal and not self._stopped:
+                    self._ready.wait()
+                if self._stopped and not self._high and not self._normal:
+                    return
+                task = (self._high or self._normal).popleft()
+            self._execute(task)
+
+    def _execute(self, task: _Task) -> None:
         try:
             fail_point("scheduler_async_snapshot")
-            snapshot = self.engine.snapshot(ctx)
-            txn, result = cmd.process_write(snapshot)
+            snapshot = self.engine.snapshot(task.ctx)
+            txn, result = task.cmd.process_write(snapshot)
             fail_point("scheduler_before_write")
             if not txn.is_empty():
-                self.engine.write(ctx, txn.wb)
-            return result
+                self.engine.write(task.ctx, txn.wb)
+            task.result = result
+        except BaseException as exc:  # surfaced to the submitting thread
+            task.exc = exc
         finally:
-            self.latches.release(cid, slots)
+            woken = self.latches.release(task.cid, task.slots)
+            with self._mu:
+                self._inflight -= 1
+                self.stats["woken"] += len(woken)
+            for t in woken:
+                self._enqueue(t)
+            task.done.set()
+
+    def stop(self) -> None:
+        with self._mu:
+            self._stopped = True
+            self._ready.notify_all()
+        for t in self._threads:
+            t.join(timeout=5)
