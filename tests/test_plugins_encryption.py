@@ -119,12 +119,14 @@ def test_seal_unseal_roundtrip_and_tamper():
     for msg in [b"", b"x", b"hello world" * 100]:
         blob = seal(key, msg)
         assert unseal(key, blob) == msg
-        assert blob[16:-16] != msg or msg == b""  # actually encrypted
+        # actually encrypted (skip tiny msgs: a 1-byte needle matches a
+        # random nonce/tag byte with ~10% probability)
+        assert len(msg) < 4 or msg not in blob
     blob = bytearray(seal(key, b"secret"))
     blob[20] ^= 1
-    with pytest.raises(ValueError, match="MAC"):
+    with pytest.raises(ValueError, match="mismatch"):
         unseal(key, bytes(blob))
-    with pytest.raises(ValueError, match="MAC"):
+    with pytest.raises(ValueError, match="mismatch"):
         unseal(b"wrong-key-wrong-key-wrong-key!!!", seal(key, b"secret"))
 
 
@@ -217,3 +219,57 @@ def test_raw_coprocessor_and_metering_over_tcp():
         c.close()
     finally:
         server.stop()
+
+
+def test_aes_gcm_is_the_active_cipher():
+    """With the cryptography package present the sealed format must be real
+    AES-256-GCM, not the fallback keystream."""
+    from tikv_tpu.storage import encryption as enc
+
+    assert enc.AESGCM is not None
+    blob = seal(b"k" * 32, b"payload")
+    assert blob[0] == enc._METHOD_AESGCM
+    # independently decryptable with the library primitive
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+    assert AESGCM(b"k" * 32).decrypt(blob[1:13], blob[13:], None) == b"payload"
+
+
+def test_master_key_rotation_keeps_old_data_readable(tmp_path):
+    """master_key/file.rs semantics: rotating the MASTER key re-seals only
+    the key dictionary; values written under old data keys decrypt fine."""
+    from tikv_tpu.storage.btree_engine import BTreeEngine
+    from tikv_tpu.storage.engine import WriteBatch
+
+    dict_path = str(tmp_path / "file.dict")
+    mgr = DataKeyManager.open(MasterKey.mem(), dict_path)
+    eng = EncryptedEngine(BTreeEngine(), mgr)
+    wb = WriteBatch()
+    wb.put_cf("default", b"old-key", b"written-under-data-key-1")
+    eng.write(wb)
+    mgr.rotate()  # new data key for new writes
+    wb = WriteBatch()
+    wb.put_cf("default", b"new-key", b"written-under-data-key-2")
+    eng.write(wb)
+    new_master = MasterKey.mem(b"rotated-master-key-9999")
+    mgr.rotate_master(new_master)
+    # a fresh process opening with the NEW master reads everything
+    mgr2 = DataKeyManager.open(new_master, dict_path)
+    eng2 = EncryptedEngine(eng.inner, mgr2)
+    assert eng2.get_cf("default", b"old-key") == b"written-under-data-key-1"
+    assert eng2.get_cf("default", b"new-key") == b"written-under-data-key-2"
+    # the OLD master no longer opens the dictionary
+    with pytest.raises(ValueError):
+        DataKeyManager.open(MasterKey.mem(), dict_path)
+
+
+def test_dict_persistence_atomic_and_recoverable(tmp_path):
+    dict_path = str(tmp_path / "file.dict")
+    mgr = DataKeyManager.open(MasterKey.mem(), dict_path)
+    ids = [mgr.rotate() for _ in range(3)]
+    mgr2 = DataKeyManager.open(MasterKey.mem(), dict_path)
+    assert mgr2.current_id == ids[-1]
+    assert mgr2.keys == mgr.keys
+    # values sealed before the reload decrypt after it
+    blob = seal(mgr.current()[1], b"v")
+    assert unseal(mgr2.by_id(mgr2.current_id), blob) == b"v"

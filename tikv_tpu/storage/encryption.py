@@ -1,13 +1,14 @@
-"""Encryption at rest: two-level keys + encrypting engine wrapper.
+"""Encryption at rest: AES-GCM, two-level keys, encrypting engine wrapper.
 
 Re-expression of ``components/encryption`` (master_key/{file,mem}.rs,
-manager/, crypter.rs, file_dict_file.rs): a master key encrypts rotating
-*data keys*; every value is encrypted under the current data key with a
-per-value random IV; the key dictionary itself is stored encrypted under the
-master key.  The reference wires AES-CTR through OpenSSL into RocksDB's Env;
-this build has no cipher library, so the stream cipher is a keyed BLAKE2b
-keystream in counter mode with a BLAKE2b MAC (encrypt-then-MAC) — same
-architecture, swappable primitive, honest about the difference.
+manager/, crypter.rs, file_dict_file.rs): a master key seals rotating *data
+keys*; every value is encrypted under the current data key with a random
+per-value nonce; the key dictionary itself is persisted sealed under the
+master key, so rotating the MASTER key only re-seals the dictionary — data
+written under old data keys stays readable without rewriting a byte.  The
+cipher is AES-256-GCM (the reference's crypter.rs AEAD choice) via the
+``cryptography`` package, with a keyed-BLAKE2b AEAD fallback when that
+package is absent (same architecture, honest about the primitive).
 """
 
 from __future__ import annotations
@@ -19,6 +20,14 @@ import threading
 
 from ..util import codec
 from .engine import Cursor, KvEngine, Snapshot, WriteBatch
+
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:  # pragma: no cover - baked into this image
+    AESGCM = None
+
+_METHOD_BLAKE2 = 0  # keyed-keystream + MAC fallback
+_METHOD_AESGCM = 1  # AES-256-GCM (crypter.rs EncryptionMethod::Aes256Gcm)
 
 _BLOCK = 64  # blake2b digest size
 
@@ -43,21 +52,41 @@ def _xor(data: bytes, stream: bytes) -> bytes:
 
 
 def seal(key: bytes, plaintext: bytes) -> bytes:
-    """iv(16) | ciphertext | mac(16) — encrypt-then-MAC."""
+    """method(1) | nonce | ciphertext+tag — AEAD under the given 32-byte key."""
+    if AESGCM is not None:
+        nonce = os.urandom(12)
+        ct = AESGCM(key).encrypt(nonce, plaintext, None)
+        return bytes([_METHOD_AESGCM]) + nonce + ct
     iv = os.urandom(16)
     ct = _xor(plaintext, _keystream(key, iv, len(plaintext)))
     mac = hmac.new(key, iv + ct, hashlib.blake2b).digest()[:16]
-    return iv + ct + mac
+    return bytes([_METHOD_BLAKE2]) + iv + ct + mac
 
 
 def unseal(key: bytes, sealed: bytes) -> bytes:
-    if len(sealed) < 32:
-        raise ValueError("sealed blob too short")
-    iv, ct, mac = sealed[:16], sealed[16:-16], sealed[-16:]
-    want = hmac.new(key, iv + ct, hashlib.blake2b).digest()[:16]
-    if not hmac.compare_digest(mac, want):
-        raise ValueError("MAC mismatch: wrong key or corrupted data")
-    return _xor(ct, _keystream(key, iv, len(ct)))
+    if not sealed:
+        raise ValueError("empty sealed blob")
+    method, body = sealed[0], sealed[1:]
+    if method == _METHOD_AESGCM:
+        if AESGCM is None:
+            raise ValueError("AES-GCM sealed data but no cipher library")
+        if len(body) < 12 + 16:
+            raise ValueError("sealed blob too short")
+        from cryptography.exceptions import InvalidTag
+
+        try:
+            return AESGCM(key).decrypt(body[:12], body[12:], None)
+        except InvalidTag as e:
+            raise ValueError("AEAD tag mismatch: wrong key or corrupted data") from e
+    if method == _METHOD_BLAKE2:
+        if len(body) < 32:
+            raise ValueError("sealed blob too short")
+        iv, ct, mac = body[:16], body[16:-16], body[-16:]
+        want = hmac.new(key, iv + ct, hashlib.blake2b).digest()[:16]
+        if not hmac.compare_digest(mac, want):
+            raise ValueError("MAC mismatch: wrong key or corrupted data")
+        return _xor(ct, _keystream(key, iv, len(ct)))
+    raise ValueError(f"unknown seal method {method}")
 
 
 class MasterKey:
@@ -79,20 +108,69 @@ class MasterKey:
 
 
 class DataKeyManager:
-    """Rotating data keys sealed under the master key (manager/)."""
+    """Rotating data keys sealed under the master key (manager/), with the
+    key dictionary persisted to disk (file_dict_file.rs role: atomic
+    tmp+rename snapshots of the sealed dict)."""
 
-    def __init__(self, master: MasterKey):
+    def __init__(self, master: MasterKey, dict_path: str | None = None):
         self.master = master
         self._mu = threading.Lock()
+        self._persist_mu = threading.Lock()
         self.keys: dict[int, bytes] = {}
         self.current_id = 0
+        self.dict_path = dict_path
         self.rotate()
 
     def rotate(self) -> int:
+        """Mint a new data key; new writes use it, old keys stay for reads."""
         with self._mu:
             self.current_id += 1
             self.keys[self.current_id] = os.urandom(32)
-            return self.current_id
+            kid = self.current_id
+        self._persist()
+        return kid
+
+    def rotate_master(self, new_master: MasterKey) -> None:
+        """Master-key rotation (master_key/file.rs:10-47 semantics): the data
+        keys are unchanged — only the dictionary is re-sealed — so every file
+        written under an old data key stays readable without rewriting."""
+        with self._mu:
+            self.master = new_master
+        self._persist()
+
+    def _persist(self) -> None:
+        if self.dict_path is None:
+            return
+        # one persist at a time, export INSIDE the persist lock: two
+        # concurrent rotations must not race a stale dict over a newer one
+        # (or interleave bytes in the shared tmp file)
+        with self._persist_mu:
+            blob = self.export_dict()
+            tmp = self.dict_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.dict_path)
+            # the rename itself must survive a crash (file_dict_file.rs
+            # guarantee): fsync the containing directory
+            dfd = os.open(os.path.dirname(os.path.abspath(self.dict_path)), os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+
+    @classmethod
+    def open(cls, master: MasterKey, dict_path: str) -> "DataKeyManager":
+        """Load the persisted dictionary, or create a fresh manager when the
+        path does not exist yet.  A wrong master key fails loudly here — the
+        reference likewise refuses to start with an undecryptable dict."""
+        if os.path.exists(dict_path):
+            with open(dict_path, "rb") as f:
+                mgr = cls.import_dict(master, f.read())
+            mgr.dict_path = dict_path
+            return mgr
+        return cls(master, dict_path=dict_path)
 
     def current(self) -> tuple[int, bytes]:
         with self._mu:
@@ -122,7 +200,9 @@ class DataKeyManager:
         mgr = cls.__new__(cls)
         mgr.master = master
         mgr._mu = threading.Lock()
+        mgr._persist_mu = threading.Lock()
         mgr.keys = {}
+        mgr.dict_path = None
         cur, off = codec.decode_var_u64(raw, 0)
         n, off = codec.decode_var_u64(raw, off)
         for _ in range(n):
@@ -142,8 +222,8 @@ class EncryptedEngine(KvEngine):
         self.inner = inner
         self.keys = keys_mgr
 
-    def _enc(self, value: bytes) -> bytes:
-        kid, key = self.keys.current()
+    def _enc(self, value: bytes, cur: tuple[int, bytes] | None = None) -> bytes:
+        kid, key = cur if cur is not None else self.keys.current()
         return codec.encode_var_u64(kid) + seal(key, value)
 
     def _dec(self, stored: bytes) -> bytes:
@@ -151,10 +231,13 @@ class EncryptedEngine(KvEngine):
         return unseal(self.keys.by_id(kid), stored[off:])
 
     def write(self, batch: WriteBatch) -> None:
+        # one key fetch per batch: cheaper, and a batch racing a rotation
+        # never straddles two data keys
+        cur = self.keys.current()
         enc = WriteBatch()
         for op, cf, key, val in batch.ops:
             if op == "put":
-                enc.put_cf(cf, key, self._enc(val))
+                enc.put_cf(cf, key, self._enc(val, cur))
             elif op == "delete":
                 enc.delete_cf(cf, key)
             else:
@@ -173,7 +256,8 @@ class EncryptedEngine(KvEngine):
             yield k, self._dec(v)
 
     def bulk_load(self, cf: str, items):
-        self.inner.bulk_load(cf, [(k, self._enc(v)) for k, v in items])
+        cur = self.keys.current()
+        self.inner.bulk_load(cf, [(k, self._enc(v, cur)) for k, v in items])
 
 
 class _DecCursor(Cursor):
