@@ -1,0 +1,139 @@
+"""SST import pipeline: disk staging, duplicate detection, raft-replicated
+ingest with a replica restarting mid-ingest (sst_importer.rs +
+src/import/duplicate_detect.rs + fsm/apply.rs exec_ingest_sst behaviors)."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from tikv_tpu.pd.client import MockPd
+from tikv_tpu.server.cluster import FIRST_REGION_ID, ServerCluster
+from tikv_tpu.sidecar.backup import MAGIC, LocalStorage
+from tikv_tpu.sidecar.importer import SstImporter
+from tikv_tpu.storage.btree_engine import BTreeEngine
+from tikv_tpu.storage.engine import CF_WRITE
+from tikv_tpu.storage.kv import LocalEngine
+from tikv_tpu.storage.txn_types import Key
+from tikv_tpu.util import codec
+
+
+def _backup_file(pairs, backup_ts=50) -> bytes:
+    out = bytearray(MAGIC)
+    out += codec.encode_var_u64(backup_ts)
+    for k, v in pairs:
+        out += codec.encode_compact_bytes(k)
+        out += codec.encode_compact_bytes(v)
+    return bytes(out)
+
+
+def test_staging_is_unbounded_and_disk_backed(tmp_path):
+    """100 downloaded files stay staged simultaneously — no eviction."""
+    storage = LocalStorage(str(tmp_path / "ext"))
+    imp = SstImporter(storage, workdir=str(tmp_path / "stage"))
+    for i in range(100):
+        storage.write("f%03d.bak" % i, _backup_file([(b"k%03d" % i, b"v")]))
+        imp.download("f%03d.bak" % i)
+    assert imp.staged_count() == 100
+    # ingest consumes the staged copy; the others remain
+    eng = LocalEngine(BTreeEngine())
+    imp.restore(eng, "f007.bak", restore_ts=100)
+    assert imp.staged_count() == 99
+    assert eng.snapshot(None).get_cf(CF_WRITE, Key.from_raw(b"k007").append_ts(101).encoded)
+
+
+def test_duplicate_detection(tmp_path):
+    from fixtures import put_committed
+
+    storage = LocalStorage(str(tmp_path / "ext"))
+    imp = SstImporter(storage, workdir=str(tmp_path / "stage"))
+    eng = BTreeEngine()
+    put_committed(eng, b"dup1", b"old", 10, 20)
+    put_committed(eng, b"dup2", b"old", 10, 30)
+    storage.write("in.bak", _backup_file([(b"dup1", b"new"), (b"dup2", b"new"),
+                                          (b"fresh", b"new")]))
+    imp.download("in.bak")
+    dups = imp.duplicate_detect(eng.snapshot(), "in.bak")
+    assert sorted(d["key"] for d in dups) == [b"dup1", b"dup2"]
+    assert all(d["type"] == "PUT" for d in dups)
+    # min_commit_ts filters out older-than-threshold collisions
+    dups = imp.duplicate_detect(eng.snapshot(), "in.bak", min_commit_ts=25)
+    assert [d["key"] for d in dups] == [b"dup2"]
+
+
+def test_raft_ingest_100_files_with_replica_restart(tmp_path):
+    """The VERDICT's done-bar: a 3-node cluster ingests 100 files through the
+    raft ingest_sst command while one replica restarts mid-ingest; every
+    store converges to identical data."""
+    storage = LocalStorage(str(tmp_path / "ext"))
+    imp = SstImporter(storage, workdir=str(tmp_path / "stage"))
+    for i in range(100):
+        storage.write(
+            "chunk%03d.bak" % i,
+            _backup_file([(b"imp%03d-%d" % (i, j), b"val%03d-%d" % (i, j))
+                          for j in range(5)]))
+        imp.download("chunk%03d.bak" % i)
+    c = ServerCluster(3, pd=MockPd())
+    c.run()
+    try:
+        c.must_put(b"seed", b"x")  # elect a leader first
+        restarted = threading.Event()
+
+        def ingest_all():
+            for i in range(100):
+                if i == 40:
+                    restarted.set()
+                imp.ingest_via_raft(
+                    lambda blob: c.ingest_sst(FIRST_REGION_ID, blob),
+                    "chunk%03d.bak" % i, restore_ts=1000 + 2 * i)
+
+        t = threading.Thread(target=ingest_all)
+        t.start()
+        restarted.wait(30)
+        c.stop_node(3)   # replica down mid-ingest
+        c.restart_node(3)
+        t.join(timeout=120)
+        assert not t.is_alive(), "ingest stalled"
+        assert imp.staged_count() == 0
+        # every replica holds every imported key (store 3 caught up from its
+        # log / snapshot — the ingest payload rides the raft log)
+        import time
+
+        probe = [(0, 0), (39, 4), (40, 0), (70, 2), (99, 4)]
+        for i, j in probe:
+            wkey = Key.from_raw(b"imp%03d-%d" % (i, j)).append_ts(1000 + 2 * i + 1)
+            for sid in (1, 2, 3):
+                t0 = time.time()
+                v = None
+                while time.time() - t0 < 30:
+                    v = c.get_on_store(sid, wkey.encoded, cf=CF_WRITE)
+                    if v is not None:
+                        break
+                    time.sleep(0.1)
+                assert v is not None, f"store {sid} missing imported key {i},{j}"
+    finally:
+        c.shutdown()
+
+
+def test_ingest_rejects_out_of_range_keys(tmp_path):
+    """exec_ingest_sst range rule: a payload with keys outside the target
+    region is rejected at propose time (out-of-range keys in region A's log
+    would be invisible to A's range-bounded snapshots — replica divergence)."""
+    from tikv_tpu.sidecar.importer import encode_ingest_entries
+
+    c = ServerCluster(3, pd=MockPd())
+    c.run()
+    try:
+        c.must_put(b"a-seed", b"x")
+        new_rid = c.split_region(FIRST_REGION_ID, b"m")
+        # FIRST_REGION now covers [, m); a payload with a key >= m must fail
+        payload = encode_ingest_entries([("default", b"zzz", b"v")])
+        with pytest.raises(Exception, match="outside region"):
+            c.ingest_sst(FIRST_REGION_ID, payload, timeout=3.0)
+        # and an in-range payload still lands
+        c.ingest_sst(FIRST_REGION_ID, encode_ingest_entries([("default", b"abc", b"v")]))
+        for sid in (1, 2, 3):
+            c.wait_get_on_store(sid, b"abc", b"v")
+    finally:
+        c.shutdown()

@@ -84,6 +84,12 @@ def encode_cmd(cmd: dict) -> bytes:
         out += codec.encode_var_u64(len(entries))
         for eb in entries:
             out += codec.encode_compact_bytes(eb)
+    elif admin[0] == "ingest_sst":
+        # the staged file's entries ride in the log entry itself, so every
+        # replica — current and future (log/snapshot catch-up) — applies the
+        # same bytes (fsm/apply.rs:1427-1445 exec_ingest_sst role)
+        out.append(7)
+        out += codec.encode_compact_bytes(admin[1])
     else:
         raise ValueError(admin)
     return bytes(out)
@@ -140,7 +146,29 @@ def decode_cmd(b: bytes) -> dict:
             eb, off = codec.decode_compact_bytes(b, off)
             entries.append(eb)
         cmd["admin"] = ("commit_merge", sid, end, sv, scommit, entries)
+    elif kind == 7:
+        blob, off = codec.decode_compact_bytes(b, off)
+        cmd["admin"] = ("ingest_sst", blob)
     return cmd
+
+
+def _decode_ingest_entries(blob: bytes):
+    """Yield (cf, key, value) from an ingest_sst admin payload."""
+    off = 0
+    n, off = codec.decode_var_u64(blob, off)
+    for _ in range(n):
+        cf, off = codec.decode_compact_bytes(blob, off)
+        key, off = codec.decode_compact_bytes(blob, off)
+        val, off = codec.decode_compact_bytes(blob, off)
+        yield cf.decode(), key, val
+
+
+def _ingest_key_outside(blob: bytes, region) -> bytes | None:
+    """First payload key outside the region's range, or None."""
+    for _cf, key, _val in _decode_ingest_entries(blob):
+        if not region.contains(key):
+            return key
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -295,6 +323,16 @@ class StorePeer:
             cb(EpochError(self.region.clone()))
             return
         admin = cmd.get("admin")
+        if admin is not None and admin[0] == "ingest_sst":
+            # range check at propose time (exec_ingest_sst rejects SSTs whose
+            # range exceeds the region): out-of-range keys would ride this
+            # region's log but be excluded from its range-bounded snapshots,
+            # silently diverging any replica that catches up via snapshot
+            bad = _ingest_key_outside(admin[1], self.region)
+            if bad is not None:
+                cb(KeyError(f"ingest key {bad!r} outside region "
+                            f"{self.region.start_key!r}..{self.region.end_key!r}"))
+                return
         if admin is not None and admin[0] == "conf_change_v2":
             # atomic multi-peer change via joint consensus: admin carries
             # [(op, peer_id, store_id), ...] — placement rides IN the entry
@@ -600,6 +638,15 @@ class StorePeer:
             self._apply_commit_merge(admin)
             self._ack(e, {"commit_merge": True}, None)
             return
+        if admin is not None and admin[0] == "ingest_sst":
+            # every non-witness replica materializes the staged entries from
+            # the log payload (fsm/apply.rs exec_ingest_sst): a replica that
+            # was down replays this entry (or receives it in a snapshot) and
+            # converges without any side-channel file transfer
+            if self.peer_id not in self.node.witnesses:
+                self._apply_ingest_sst(admin[1])
+            self._ack(e, {"ingest_sst": True, "applied_index": e.index}, None)
+            return
         fail_point("apply_before_exec")
         if self.peer_id in self.node.witnesses:
             # witnesses replicate and vote on the LOG but never materialize
@@ -608,6 +655,25 @@ class StorePeer:
             return
         self._exec_data_cmd(cmd, self.region)
         self._ack(e, {"applied_index": e.index}, None)
+
+    def _apply_ingest_sst(self, blob: bytes) -> None:
+        """Write the ingest payload — encoded (cf, key, value) entries, keys
+        already in their final (rewritten) form — under the region prefix.
+        Keys outside the region range are dropped identically on every
+        replica (the propose-time check rejects them; this keeps a replayed
+        entry deterministic even across a racing split)."""
+        wb = WriteBatch()
+        ops = []
+        for cf, key, val in _decode_ingest_entries(blob):
+            if not self.region.contains(key):
+                continue
+            wb.put_cf(cf, keys.data_key(key), val)
+            ops.append(("put", cf, key, val))
+        self.store.engine.write(wb)
+        # apply observers (CDC, resolved-ts) must see ingested writes like
+        # any other applied command — a change feed that silently misses an
+        # imported batch is data loss downstream
+        self.store.on_applied(self.region, {"ops": ops, "ingest_sst": True})
 
     def _exec_data_cmd(self, cmd: dict, region: Region) -> None:
         """Execute a data command's write ops against the engine (shared by

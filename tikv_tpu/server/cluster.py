@@ -230,6 +230,29 @@ class ServerCluster:
         if isinstance(res[0], Exception):
             raise res[0]
 
+    def ingest_sst(self, region_id: int, payload: bytes, timeout: float = 30.0) -> None:
+        """Propose a raft ingest_sst admin command: the staged entries ride
+        the log entry, so every replica (and any catching-up one) applies
+        them (fsm/apply.rs exec_ingest_sst shape).  Retries leadership
+        churn the way a real import client does (must_put discipline)."""
+        deadline = time.monotonic() + timeout
+        last: Exception | None = None
+        while time.monotonic() < deadline:
+            try:
+                leader = self.wait_leader(region_id)
+                cmd = {
+                    "epoch": (leader.region.epoch.conf_ver, leader.region.epoch.version),
+                    "admin": ("ingest_sst", payload),
+                }
+                self._run_admin(leader, cmd, timeout=2.0)
+                return
+            except KeyError:
+                raise  # permanent: payload outside the region range
+            except Exception as e:  # NotLeader / Epoch / timeout: re-route
+                last = e
+                time.sleep(0.05)
+        raise TimeoutError(f"ingest_sst on region {region_id} never landed: {last}")
+
     def split_region(self, region_id: int, split_key: bytes) -> int:
         leader = self.wait_leader(region_id)
         new_region_id = self.alloc_id()

@@ -11,12 +11,13 @@ from __future__ import annotations
 
 import os
 
-from ..storage.engine import CF_DEFAULT, CF_WRITE, WriteBatch
 from ..storage.mvcc import ForwardScanner
-from ..storage.txn_types import Key, Write, WriteType
+from ..storage.txn_types import Key
 from ..util import codec
-
-MAGIC = b"TPUBK1\n"
+from .importer import MAGIC, SstImporter  # noqa: F401 - SstImporter moved to
+# importer.py (unbounded disk staging, raft ingest, duplicate detection);
+# re-imported here because backup and restore share the file format and
+# callers historically import both from this module
 
 
 class ExternalStorage:
@@ -92,167 +93,3 @@ class BackupEndpoint:
         return {"file": name, "kvs": n, "backup_ts": backup_ts}
 
 
-class SstImporter:
-    """Restore: download backup files and ingest as committed writes at a
-    fresh ts (sst_importer download:308 + ingest:158; ranges may be rewritten
-    by a key-prefix mapping like the reference's rewrite rules)."""
-
-    _STAGE_MAX = 16  # staged files are bounded; oldest evicted (ingest pops)
-
-    def __init__(self, storage: ExternalStorage):
-        self.storage = storage
-        import threading
-
-        self._mu = threading.Lock()
-        self._staged: dict[str, bytes] = {}
-        # Rewrite rule registered at download time, kept (bounded, but far
-        # larger than the staged-bytes cap) even after the staged bytes are
-        # evicted: a fallback re-read of the source must re-apply the same
-        # rewrite, never silently ingest un-rewritten keys.
-        self._rewrites: dict[str, tuple[bytes, bytes] | None] = {}
-
-    @staticmethod
-    def _iter_entries(data: bytes, rewrite: tuple[bytes, bytes] | None):
-        """Parse a backup payload: yields (raw_key, value) with the rewrite
-        rule applied — the ONE definition of the file format + rewrite
-        semantics, shared by download and restore."""
-        if not data.startswith(MAGIC):
-            raise ValueError("not a backup file")
-        off = len(MAGIC)
-        backup_ts, off = codec.decode_var_u64(data, off)
-        while off < len(data):
-            raw_key, off = codec.decode_compact_bytes(data, off)
-            value, off = codec.decode_compact_bytes(data, off)
-            if rewrite is not None and raw_key.startswith(rewrite[0]):
-                raw_key = rewrite[1] + raw_key[len(rewrite[0]):]
-            yield raw_key, value
-
-    def download(self, name: str, rewrite: tuple[bytes, bytes] | None = None) -> dict:
-        """Fetch + validate + REWRITE a backup file ahead of ingest
-        (sst_service.rs download:308 applies the rewrite rules at download
-        time): the staged bytes are final, so ingest is a pure engine
-        write."""
-        data = self.storage.read(name)
-        out = bytearray(MAGIC)
-        off = len(MAGIC)
-        if not data.startswith(MAGIC):
-            raise ValueError(f"{name}: not a backup file")
-        backup_ts, hoff = codec.decode_var_u64(data, off)
-        out += codec.encode_var_u64(backup_ts)
-        n = 0
-        for raw_key, value in self._iter_entries(data, rewrite):
-            out += codec.encode_compact_bytes(raw_key)
-            out += codec.encode_compact_bytes(value)
-            n += 1
-        with self._mu:
-            # pop-then-insert: eviction order is by latest download, so a
-            # re-downloaded name moves to the back of the FIFO
-            self._staged.pop(name, None)
-            while len(self._staged) >= self._STAGE_MAX:
-                self._staged.pop(next(iter(self._staged)))
-            self._staged[name] = bytes(out)
-            self._rewrites.pop(name, None)
-            while len(self._rewrites) >= 64 * self._STAGE_MAX:
-                self._rewrites.pop(next(iter(self._rewrites)))
-            self._rewrites[name] = rewrite
-        return {"file": name, "kvs": n, "backup_ts": backup_ts}
-
-    def restore(
-        self,
-        engine,
-        name: str,
-        restore_ts: int,
-        ctx: dict | None = None,
-        rewrite: tuple[bytes, bytes] | None = None,
-    ) -> dict:
-        with self._mu:
-            data = self._staged.get(name)  # read, don't pop: a failed
-            # ingest must retry against the SAME (rewritten) staged bytes,
-            # never silently fall back to the un-rewritten source
-            recorded_rewrite = self._rewrites.get(name)
-        staged = data is not None
-        if staged:
-            rewrite = None  # staged bytes were rewritten at download time
-        else:
-            if rewrite is None and recorded_rewrite is not None:
-                # Staged bytes were evicted after download: re-read the
-                # source and re-apply the rewrite registered at download
-                # time, so an eviction can never ingest un-rewritten keys.
-                # An EXPLICIT ingest-time rewrite still wins — the caller
-                # may deliberately re-ingest under a different prefix.
-                rewrite = recorded_rewrite
-            data = self.storage.read(name)
-        if not data.startswith(MAGIC):
-            raise ValueError(f"{name}: not a backup file")
-        wb = WriteBatch()
-        n = 0
-        for raw_key, value in self._iter_entries(data, rewrite):
-            k = Key.from_raw(raw_key)
-            if len(value) <= 255:
-                w = Write(WriteType.PUT, restore_ts, short_value=value)
-            else:
-                w = Write(WriteType.PUT, restore_ts)
-                wb.put_cf(CF_DEFAULT, k.append_ts(restore_ts).encoded, value)
-            wb.put_cf(CF_WRITE, k.append_ts(restore_ts + 1).encoded, w.to_bytes())
-            n += 1
-        engine.write(ctx, wb)
-        if staged:
-            with self._mu:
-                self._staged.pop(name, None)  # drop only after success
-        return {"file": name, "kvs": n, "restored_at": restore_ts + 1}
-
-    def restore_via_sst(
-        self,
-        engine,
-        name: str,
-        restore_ts: int,
-        rewrite: tuple[bytes, bytes] | None = None,
-        workdir: str | None = None,
-    ) -> dict:
-        """Bulk restore straight into a NATIVE engine via SST ingest
-        (sst_importer's real shape: build sorted immutable files, AddFile
-        them) — bypasses the per-record WriteBatch path, so a large restore
-        costs one file copy + one WAL reference instead of N WAL records.
-        Only for engine-local loads (bench/bootstrap); replicated restores
-        keep the raft propose path in ``restore``."""
-        import tempfile
-
-        from ..native.engine import build_sst
-
-        # same staged-bytes discipline as restore(): staged data was already
-        # rewritten at download time; if evicted, the rewrite recorded at
-        # download is re-applied so eviction can never ingest un-rewritten
-        # keys (an explicit caller rewrite still wins)
-        with self._mu:
-            data = self._staged.get(name)
-            recorded_rewrite = self._rewrites.get(name)
-        if data is not None:
-            rewrite = None
-        else:
-            if rewrite is None and recorded_rewrite is not None:
-                rewrite = recorded_rewrite
-            data = self.storage.read(name)
-        if not data.startswith(MAGIC):
-            raise ValueError(f"{name}: not a backup file")
-        default_rows: list[tuple[bytes, bytes]] = []
-        write_rows: list[tuple[bytes, bytes]] = []
-        n = 0
-        for raw_key, value in self._iter_entries(data, rewrite):
-            k = Key.from_raw(raw_key)
-            if len(value) <= 255:
-                w = Write(WriteType.PUT, restore_ts, short_value=value)
-            else:
-                w = Write(WriteType.PUT, restore_ts)
-                default_rows.append((k.append_ts(restore_ts).encoded, value))
-            write_rows.append((k.append_ts(restore_ts + 1).encoded, w.to_bytes()))
-            n += 1
-        entries = [("default", k, v) for k, v in sorted(default_rows)]
-        entries += [("write", k, v) for k, v in sorted(write_rows)]
-        fd, path = tempfile.mkstemp(suffix=".sst", dir=workdir)
-        os.close(fd)
-        try:
-            build_sst(path, entries)
-            engine.ingest_sst(path)
-        finally:
-            os.unlink(path)
-        return {"file": name, "kvs": n, "restored_at": restore_ts + 1, "via": "sst"}
