@@ -16,6 +16,17 @@ takes over once the native engine lands).
     ctl.py --addr HOST:PORT bad-regions|all-regions
     ctl.py --status ADDR metrics|config
     ctl.py --status ADDR reconfig section.key=value ...
+
+Offline (destructive) commands operate on a STOPPED store's engine directory
+(cmd/tikv-ctl/src/main.rs:1513-1642 unsafe-recover / recover-mvcc /
+recreate-region / tombstone / compact — these rewrite persisted state and
+must never run against a live process):
+
+    ctl.py --db PATH unsafe-recover --stores 2,3
+    ctl.py --db PATH recover-mvcc [--apply] [--safe-ts TS]
+    ctl.py --db PATH tombstone --region R
+    ctl.py --db PATH recreate-region --region R --store S --peer P
+    ctl.py --db PATH compact [--cf CF]
 """
 
 from __future__ import annotations
@@ -37,6 +48,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpu-tikv-ctl")
     p.add_argument("--addr", help="store RPC address host:port")
     p.add_argument("--status", help="status server address host:port")
+    p.add_argument("--db", help="engine dir of a STOPPED store (offline mode)")
     p.add_argument("--region", type=int, default=1)
     sub = p.add_subparsers(dest="cmd", required=True)
 
@@ -66,9 +78,59 @@ def main(argv=None) -> int:
     sub.add_parser("config")
     sp = sub.add_parser("reconfig")
     sp.add_argument("changes", nargs="+", help="section.key=value")
+    # offline (destructive) commands: --db required
+    sp = sub.add_parser("unsafe-recover")
+    sp.add_argument("--stores", required=True, help="failed store ids, comma-separated")
+    sp = sub.add_parser("recover-mvcc")
+    sp.add_argument("--apply", action="store_true", help="write fixes (default: dry run)")
+    sp.add_argument("--safe-ts", type=int, default=0,
+                    help="GC safe point; locks below it are orphans (default 0: none)")
+    sp = sub.add_parser("tombstone")
+    sp.add_argument("--region", type=int, required=True)
+    sp = sub.add_parser("recreate-region")
+    sp.add_argument("--region", type=int, required=True)
+    sp.add_argument("--store", type=int, required=True)
+    sp.add_argument("--peer", type=int, required=True)
+    sp.add_argument("--start", default="")
+    sp.add_argument("--end", default="")
+    sp = sub.add_parser("compact")
+    sp.add_argument("--cf", default=None)
 
     args = p.parse_args(argv)
     ctx = {"region_id": args.region}
+
+    offline_cmds = ("unsafe-recover", "recover-mvcc", "tombstone",
+                    "recreate-region", "compact")
+    if args.cmd in offline_cmds:
+        if not args.db:
+            print("--db required (offline commands run on a stopped store)",
+                  file=sys.stderr)
+            return 2
+        from tikv_tpu.native.engine import NativeEngine
+        from tikv_tpu.server.debug import Debugger
+
+        eng = NativeEngine(path=args.db)
+        try:
+            dbg = Debugger(eng)
+            if args.cmd == "unsafe-recover":
+                failed = {int(s) for s in args.stores.split(",")}
+                modified = dbg.unsafe_recover(failed)
+                out = {"modified_regions": modified, "removed_stores": sorted(failed)}
+            elif args.cmd == "recover-mvcc":
+                out = dbg.recover_mvcc(dry_run=not args.apply, safe_ts=args.safe_ts)
+            elif args.cmd == "tombstone":
+                out = {"tombstoned": dbg.tombstone_region(args.region)}
+            elif args.cmd == "recreate-region":
+                dbg.recreate_region(args.region, args.start.encode(),
+                                    args.end.encode(), args.store, args.peer)
+                out = {"recreated": args.region}
+            else:
+                out = dbg.compact(args.cf)
+            eng.flush()
+            print(json.dumps(out, indent=2))
+            return 0
+        finally:
+            eng.close()
 
     if args.cmd in ("metrics", "config", "reconfig"):
         if not args.status:

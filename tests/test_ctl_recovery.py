@@ -1,0 +1,150 @@
+"""Operator disaster tooling: wreck a 3-node cluster (kill 2 of 3 stores)
+and recover quorum via ctl's offline unsafe-recover, plus recover-mvcc,
+tombstone, recreate-region, compact (cmd/tikv-ctl/src/main.rs:1513-1642)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+import ctl
+from tikv_tpu.native.engine import NativeEngine, native_available
+from tikv_tpu.pd.client import MockPd
+from tikv_tpu.server.cluster import FIRST_REGION_ID, ServerCluster, StoreNode
+from tikv_tpu.server.debug import Debugger
+from tikv_tpu.storage.btree_engine import BTreeEngine
+from tikv_tpu.storage.engine import CF_DEFAULT, CF_LOCK, CF_WRITE, WriteBatch
+from tikv_tpu.storage.txn_types import Key, Lock, LockType, Write, WriteType
+from tikv_tpu.util import keys as keymod
+
+pytestmark = pytest.mark.skipif(not native_available(), reason="no native engine")
+
+
+def test_unsafe_recover_restores_quorum_via_ctl(tmp_path, capsys):
+    """Two of three stores die for good; ctl unsafe-recover on the survivor's
+    (stopped) engine dir strips the dead peers; the survivor reboots as a
+    single-voter region and serves reads AND writes again."""
+    dirs = {sid: str(tmp_path / f"store{sid}") for sid in (1, 2, 3)}
+    engines = {sid: NativeEngine(path=dirs[sid], sync=False) for sid in (1, 2, 3)}
+    c = ServerCluster(3, pd=MockPd(), engines=engines)
+    c.run()
+    for i in range(20):
+        c.must_put(b"key%02d" % i, b"val%02d" % i)
+    for sid in (1, 2, 3):
+        c.wait_get_on_store(sid, b"key00", b"val00")
+    # catastrophe: stores 2 and 3 die permanently; stop 1 for offline surgery
+    c.stop_node(2)
+    c.stop_node(3)
+    c.stop_node(1)
+    engines[1].close()
+
+    rc = ctl.main(["--db", dirs[1], "unsafe-recover", "--stores", "2,3"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert FIRST_REGION_ID in out["modified_regions"]
+
+    # reboot the survivor over its repaired engine dir
+    eng1 = NativeEngine(path=dirs[1], sync=False)
+    node = StoreNode(c, 1, engine=eng1)
+    node.store.recover()
+    c.nodes[1] = node
+    node.start()
+    peer = node.store.peers[FIRST_REGION_ID]
+    assert [p.store_id for p in peer.region.peers] == [1]  # dead peers gone
+    peer.node.campaign()
+    c.wait_leader(FIRST_REGION_ID)
+    # old data survived; new writes commit with the single-voter quorum
+    assert c.must_get(b"key07") == b"val07"
+    c.must_put(b"after-recovery", b"alive")
+    assert c.must_get(b"after-recovery") == b"alive"
+    c.shutdown()
+    eng1.close()
+
+
+def test_recover_mvcc_repairs_cross_cf_state(tmp_path, capsys):
+    d = str(tmp_path / "db")
+    eng = NativeEngine(path=d, sync=False)
+    wb = WriteBatch()
+    # healthy committed row
+    k1 = Key.from_raw(b"good")
+    wb.put_cf(CF_DEFAULT, keymod.data_key(k1.append_ts(10).encoded), b"v" * 300)
+    wb.put_cf(CF_WRITE, keymod.data_key(k1.append_ts(11).encoded),
+              Write(WriteType.PUT, 10).to_bytes())
+    # orphan lock from a long-dead txn
+    k2 = Key.from_raw(b"locked")
+    wb.put_cf(CF_LOCK, keymod.data_key(k2.encoded),
+              Lock(LockType.PUT, b"locked", 5, 3000).to_bytes())
+    # dangling default: no write record references ts 7
+    k3 = Key.from_raw(b"dangling")
+    wb.put_cf(CF_DEFAULT, keymod.data_key(k3.append_ts(7).encoded), b"junk")
+    eng.write(wb)
+    eng.close()
+
+    # without --safe-ts nothing counts as an orphan lock (destructive
+    # filters default to removing nothing) and the locked txn's value is
+    # protected by its lock reference
+    rc = ctl.main(["--db", d, "recover-mvcc"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out == {"orphan_locks": 0, "dangling_defaults": 1, "applied": False}
+
+    rc = ctl.main(["--db", d, "recover-mvcc", "--safe-ts", "50"])  # dry run
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out == {"orphan_locks": 1, "dangling_defaults": 1, "applied": False}
+
+    rc = ctl.main(["--db", d, "recover-mvcc", "--apply", "--safe-ts", "50"])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out)["applied"] is True
+
+    eng = NativeEngine(path=d, sync=False)
+    dbg = Debugger(eng)
+    assert eng.get_cf(CF_LOCK, keymod.data_key(k2.encoded)) is None
+    assert eng.get_cf(CF_DEFAULT, keymod.data_key(k3.append_ts(7).encoded)) is None
+    # the healthy row is untouched
+    assert eng.get_cf(CF_DEFAULT, keymod.data_key(k1.append_ts(10).encoded)) is not None
+    eng.close()
+
+
+def test_tombstone_and_recreate_region_via_ctl(tmp_path, capsys):
+    d = str(tmp_path / "db")
+    eng = NativeEngine(path=d, sync=False)
+    Debugger(eng).recreate_region(77, b"a", b"z", store_id=1, peer_id=701)
+    eng.flush()
+    eng.close()
+
+    rc = ctl.main(["--db", d, "tombstone", "--region", "77"])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out)["tombstoned"] is True
+
+    rc = ctl.main(["--db", d, "recreate-region", "--region", "77",
+                   "--store", "1", "--peer", "702", "--start", "a", "--end", "z"])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out)["recreated"] == 77
+
+    eng = NativeEngine(path=d, sync=False)
+    info = Debugger(eng).region_info(77)
+    assert info["region"]["peers"] == [(702, 1)]
+    eng.close()
+
+
+def test_compact_via_ctl(tmp_path, capsys):
+    d = str(tmp_path / "db")
+    eng = NativeEngine(path=d, sync=False)
+    for i in range(50):
+        wb = WriteBatch()
+        wb.put_cf(CF_DEFAULT, b"c%02d" % i, b"v" * 100)
+        eng.write(wb)
+        if i % 10 == 9:
+            eng.flush()
+    assert eng.run_count("default") >= 2
+    eng.close()
+    rc = ctl.main(["--db", d, "compact"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["supported"] and out["merged_runs"] >= 1
+    eng = NativeEngine(path=d, sync=False)
+    assert eng.run_count("default") == 1
+    assert eng.get_cf(CF_DEFAULT, b"c42") == b"v" * 100
+    eng.close()

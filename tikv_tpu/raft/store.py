@@ -152,6 +152,22 @@ def decode_cmd(b: bytes) -> dict:
     return cmd
 
 
+def erase_region_state(engine, region_id: int, wb: WriteBatch | None = None) -> None:
+    """THE one definition of wiping a region's persisted identity (region
+    meta, raft state, apply state, log) — shared by tombstone destruction,
+    commit-merge source cleanup, and the debugger's offline tombstone."""
+    own_wb = wb is None
+    if own_wb:
+        wb = WriteBatch()
+    wb.delete_cf(CF_RAFT, keys.region_state_key(region_id))
+    wb.delete_cf(CF_RAFT, keys.raft_state_key(region_id))
+    wb.delete_cf(CF_RAFT, keys.apply_state_key(region_id))
+    log_prefix = keys.region_raft_prefix(region_id) + keys.RAFT_LOG_SUFFIX
+    wb.delete_range_cf(CF_RAFT, log_prefix, log_prefix[:-1] + bytes([log_prefix[-1] + 1]))
+    if own_wb:
+        engine.write(wb)
+
+
 def _decode_ingest_entries(blob: bytes):
     """Yield (cf, key, value) from an ingest_sst admin payload."""
     off = 0
@@ -1312,19 +1328,7 @@ class Store:
         self.erase_region_state(region_id)
 
     def erase_region_state(self, region_id: int, wb: WriteBatch | None = None) -> None:
-        """THE one definition of wiping a region's persisted identity
-        (region meta, raft state, apply state, log) — shared by tombstone
-        destruction and the commit-merge source cleanup."""
-        own_wb = wb is None
-        if own_wb:
-            wb = WriteBatch()
-        wb.delete_cf(CF_RAFT, keys.region_state_key(region_id))
-        wb.delete_cf(CF_RAFT, keys.raft_state_key(region_id))
-        wb.delete_cf(CF_RAFT, keys.apply_state_key(region_id))
-        log_prefix = keys.region_raft_prefix(region_id) + keys.RAFT_LOG_SUFFIX
-        wb.delete_range_cf(CF_RAFT, log_prefix, log_prefix[:-1] + bytes([log_prefix[-1] + 1]))
-        if own_wb:
-            self.engine.write(wb)
+        erase_region_state(self.engine, region_id, wb)
 
     def persist_region(self, region: Region, merging: bool = False) -> None:
         self.engine.put_cf(

@@ -255,3 +255,112 @@ class Debugger:
                 if applied > commit:
                     bad.append((rid, f"applied {applied} > commit {commit}"))
         return bad
+
+    def compact(self, cf: str | None = None) -> dict:
+        """Trigger engine compaction (debug.rs compact / tikv-ctl compact):
+        native engines fold memtable garbage and merge sorted runs; engines
+        without a compaction surface report so instead of failing."""
+        all_cfs = ("default", "lock", "write", "raft")
+        if cf is not None and cf not in all_cfs:
+            raise ValueError(f"unknown cf {cf!r} (expected one of {all_cfs})")
+        dropped = 0
+        merged = 0
+        eng = self.engine
+        if cf is not None and hasattr(eng, "compact_cf"):
+            dropped = eng.compact_cf(cf)
+        elif hasattr(eng, "compact"):
+            dropped = eng.compact()
+        if hasattr(eng, "merge_runs"):
+            for c in [cf] if cf else list(all_cfs):
+                try:
+                    merged += eng.merge_runs(c)
+                except RuntimeError:
+                    pass  # engine-level merge failure; count stays honest
+        return {"dropped_versions": dropped, "merged_runs": merged,
+                "supported": hasattr(eng, "compact")}
+
+    def tombstone_region(self, region_id: int) -> bool:
+        """Erase a region's persisted identity on THIS store (tikv-ctl
+        tombstone): a wrecked replica must not resurrect at next boot.
+        Offline-only — run with the store process stopped."""
+        snap = self.engine.snapshot()
+        if snap.get_cf(CF_RAFT, keys.region_state_key(region_id)) is None:
+            return False
+        from ..raft.store import erase_region_state
+
+        erase_region_state(self.engine, region_id)
+        return True
+
+    def recreate_region(self, region_id: int, start: bytes, end: bytes,
+                        store_id: int, peer_id: int) -> None:
+        """Write a fresh single-peer region state (tikv-ctl recreate-region):
+        disaster recovery when every replica of a range is gone — the new
+        empty region serves the key range again.  Offline-only."""
+        from ..raft.region import Peer, Region, RegionEpoch
+        from ..raft.store import encode_region, erase_region_state
+
+        # wipe stale raft state / apply state / log first: recover() would
+        # otherwise restore the OLD ConfState (dead voters) and old entries
+        # alongside the new region — an unelectable peer and replayed garbage
+        erase_region_state(self.engine, region_id)
+        region = Region(region_id, start, end, RegionEpoch(1, 1),
+                        [Peer(peer_id, store_id)])
+        self.engine.put_cf(CF_RAFT, keys.region_state_key(region_id),
+                           encode_region(region, False))
+
+    def recover_mvcc(self, dry_run: bool = True, safe_ts: int = 0) -> dict:
+        """Cross-CF MVCC consistency repair (debug.rs MvccChecker /
+        tikv-ctl recover-mvcc):
+
+        * orphan locks with start_ts below ``safe_ts`` (their txn can no
+          longer commit) — removed.  ``safe_ts`` defaults to 0 — i.e. remove
+          NOTHING until the operator supplies the GC safe point: a
+          destructive filter must not default to "everything"
+        * dangling CF_DEFAULT values referenced by neither a CF_WRITE record
+          nor a live CF_LOCK entry (an uncommitted prewrite's value is NOT
+          dangling) — removed
+        With ``dry_run`` the report is produced and nothing is written."""
+        from ..storage.engine import WriteBatch
+
+        snap = self.engine.snapshot()
+        wb = WriteBatch()
+        orphan_locks: list[bytes] = []
+        dangling_defaults: list[bytes] = []
+        for lk, lv in snap.scan_cf(CF_LOCK, keys.DATA_PREFIX, keys.DATA_MAX_KEY):
+            lock = Lock.from_bytes(lv)
+            if lock.ts < safe_ts:
+                orphan_locks.append(lk)
+                wb.delete_cf(CF_LOCK, lk)
+        # every CF_DEFAULT entry must be referenced by a CF_WRITE record (or
+        # a surviving lock) whose start_ts matches the default key's suffix.
+        # One reference-set pass per user key, not per version: a hot key
+        # with V versions costs O(V), not O(V^2).
+        orphaned = set(orphan_locks)
+        cur_user: bytes | None = None
+        refs: set[int] = set()
+        for dk, _dv in snap.scan_cf(CF_DEFAULT, keys.DATA_PREFIX, keys.DATA_MAX_KEY):
+            user, start_ts = split_ts(dk)
+            if user != cur_user:
+                cur_user = user
+                refs = set()
+                # NB: the ts suffix is DESC-encoded (leading 0xff bytes), so
+                # a `user + 0xff` bound would exclude the user's own versions
+                # — scan open-ended and stop at the first different user key
+                for wk, wv in snap.scan_cf(CF_WRITE, user, keys.DATA_MAX_KEY):
+                    wuser, _commit = split_ts(wk)
+                    if wuser != user:
+                        break
+                    refs.add(Write.from_bytes(wv).start_ts)
+                lv = snap.get_cf(CF_LOCK, user)
+                if lv is not None and user not in orphaned:
+                    refs.add(Lock.from_bytes(lv).ts)
+            if start_ts not in refs:
+                dangling_defaults.append(dk)
+                wb.delete_cf(CF_DEFAULT, dk)
+        if not dry_run and (orphan_locks or dangling_defaults):
+            self.engine.write(wb)
+        return {
+            "orphan_locks": len(orphan_locks),
+            "dangling_defaults": len(dangling_defaults),
+            "applied": not dry_run,
+        }
