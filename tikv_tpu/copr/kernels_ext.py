@@ -1241,9 +1241,13 @@ def _num_to_datetime(n: int):
         return y
 
     n = int(n)
+    if n == 0:
+        return 0  # CAST(0 AS DATETIME) is the zero date '0000-00-00'
     if n < 10**8:
         y, md = divmod(n, 10**4)
         m, d = divmod(md, 100)
+        if m == 0 or d == 0:
+            raise ValueError("zero month/day in datetime literal")  # NULL
         return _mt.pack_datetime(fix_year(y), m, d)
     dpart, tpart = divmod(n, 10**6)
     y, md = divmod(dpart, 10**4)

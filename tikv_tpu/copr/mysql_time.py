@@ -34,7 +34,9 @@ def pack_datetime(
     year: int, month: int, day: int, hour: int = 0, minute: int = 0,
     second: int = 0, micro: int = 0,
 ) -> int:
-    if not (1 <= month <= 12 and 1 <= day <= 31):
+    # month/day 0 are legal: MySQL's zero date '0000-00-00' and zero-part
+    # dates like '2021-00-00' are representable values (time/mod.rs)
+    if not (0 <= month <= 12 and 0 <= day <= 31):
         raise ValueError(f"invalid date {year}-{month}-{day}")
     if not (0 <= hour < 24 and 0 <= minute < 60 and 0 <= second < 60 and 0 <= micro < 1_000_000):
         raise ValueError("invalid time component")
@@ -180,6 +182,8 @@ _nullable_dt_int("quarter", lambda p: (_ymd(p)[1] + 2) // 3)
 def _last_dom(y: int, m: int) -> int:
     """Last day of month (shared by last_day and the month-arithmetic
     clamp); December 9999 must not construct year 10000."""
+    if m == 0:
+        raise ValueError("zero month has no last day")  # LAST_DAY → NULL
     if m == 12:
         return 31
     return (_dt.date(y, m + 1, 1) - _dt.timedelta(days=1)).day
