@@ -1,0 +1,61 @@
+"""Streamed (sorted-input) aggregation rides the device path: for sorted
+input, the device hash path's first-active-row group ordering IS the stream
+order, so responses are byte-identical to BatchStreamAggregationExecutor
+(VERDICT weak #6: Q1-sorted plans must not be CPU-only)."""
+
+from __future__ import annotations
+
+import pytest
+
+from copr_fixtures import TABLE_ID, numeric_table_kvs
+from tikv_tpu.copr import jax_eval
+from tikv_tpu.copr.aggr import AggDescriptor
+from tikv_tpu.copr.dag import Aggregation, DagRequest, TableScan
+from tikv_tpu.copr.endpoint import CoprRequest, Endpoint
+from tikv_tpu.copr.rpn import call, col, const_int
+from tikv_tpu.copr.table import record_range
+from tikv_tpu.storage.btree_engine import BTreeEngine
+from tikv_tpu.storage.engine import WriteBatch
+from tikv_tpu.storage.kv import LocalEngine
+from tikv_tpu.storage.txn_types import Key, Write, WriteType
+
+
+def _engine(n=2500):
+    cols, kvs, _ = numeric_table_kvs(n, seed=3)
+    eng = BTreeEngine()
+    wb = WriteBatch()
+    for rk, val in kvs:
+        wb.put_cf("write", Key.from_raw(rk).append_ts(11).encoded,
+                  Write(WriteType.PUT, 10, short_value=val).to_bytes())
+    eng.write(wb)
+    return cols, eng
+
+
+@pytest.mark.parametrize("group_expr", ["pk", "mod"])
+def test_streamed_agg_rides_device_byte_identical(group_expr):
+    cols, eng = _engine()
+    group = col(0) if group_expr == "pk" else call("mod", col(0), const_int(7))
+    dag = lambda: DagRequest(executors=[
+        TableScan(TABLE_ID, cols),
+        Aggregation([group],
+                    [AggDescriptor("count", None), AggDescriptor("sum", col(2)),
+                     AggDescriptor("avg", col(1))],
+                    streamed=True),
+    ])
+    ep_dev = Endpoint(LocalEngine(eng), enable_device=True)
+    ep_cpu = Endpoint(LocalEngine(eng), enable_device=False)
+    req = lambda: CoprRequest(103, dag(), [record_range(TABLE_ID)], 100, context={})
+    r_dev = ep_dev.handle_request(req())
+    r_cpu = ep_cpu.handle_request(req())
+    if group_expr == "pk":
+        # scan order sorts by the group key: device may merge, output equals
+        # the stream executor's byte-for-byte
+        assert jax_eval.supports(dag())
+        assert r_dev.from_device, ep_dev.last_device_error
+    else:
+        # NOT sorted by group key: per-run stream semantics are not the
+        # device hash output, so the gate must route this to the CPU
+        assert not jax_eval.supports(dag())
+        assert not r_dev.from_device
+    assert r_dev.data == r_cpu.data
+    assert len(r_dev.data) > 50

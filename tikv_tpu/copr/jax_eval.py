@@ -55,7 +55,7 @@ from .dag import (
 from .datatypes import Chunk, Column, EvalType
 from .executors import BatchTopNExecutor, ScanSource
 from .groupby import GroupDict
-from .rpn import RpnExpression, compile_expr, eval_rpn
+from .rpn import ColumnRef, RpnExpression, compile_expr, eval_rpn
 from .table import RowBatchDecoder, decode_record_handles
 
 DEFAULT_BLOCK_ROWS = 1 << 16
@@ -128,6 +128,22 @@ def _analyze(dag: DagRequest) -> _Plan:
             rpn = compile_expr(cond, schema)
             _check_rpn_device(rpn, schema)
     if plan.agg is not None:
+        if plan.agg.streamed:
+            # stream agg emits one row per CONSECUTIVE run of the group key;
+            # that equals hash-agg output (what the device computes) only
+            # when the scan order sorts by the group key — guaranteed here
+            # just for grouping on the HANDLE column (scan order is handle
+            # order, wherever it sits in the schema).  Anything else takes
+            # the CPU stream executor (stream_aggr_executor.rs semantics).
+            cols_info = scan.columns_info
+            ok = len(plan.agg.group_by) <= 1 and all(
+                isinstance(g, ColumnRef)
+                and g.index < len(cols_info)
+                and cols_info[g.index].is_pk_handle
+                for g in plan.agg.group_by
+            )
+            if not ok:
+                raise _Unsupported("streamed agg not sorted by group key")
         for a in plan.agg.agg_funcs:
             if a.op not in _DEVICE_AGG_OPS:
                 raise _Unsupported(f"aggregate {a.op}")
