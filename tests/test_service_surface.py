@@ -302,3 +302,29 @@ def test_diagnostics_service(node_client, tmp_path):
     assert len(r["lines"]) == 1 and "disk failure" in r["lines"][0]["message"]
     info = client.call("diagnostics_server_info", {})
     assert info["cpu_count"] >= 1 and info["pid"] > 0 and "memory" in info
+
+
+def test_standalone_builds_mesh_endpoint_on_multidevice(tmp_path):
+    """Under the 8-virtual-device test mesh, the ASSEMBLED store serves the
+    coprocessor through a (regions × groups) mesh (BASELINE config #5: the
+    copr scale-out path is reachable from the real serving assembly)."""
+    import jax
+
+    from tikv_tpu.pd.client import MockPd
+    from tikv_tpu.pd.service import PdService
+    from tikv_tpu.server.server import Server
+    from tikv_tpu.server.standalone import StoreServer
+
+    assert jax.device_count() == 8
+    pds = Server(PdService(MockPd()))
+    pds.start()
+    from tikv_tpu.pd.service import RemotePd
+
+    srv = StoreServer(1, RemotePd(*pds.addr), enable_device=True)
+    try:
+        mesh = srv.copr.mesh
+        assert mesh is not None and mesh.size == 8
+        assert dict(mesh.shape) == {"regions": 4, "groups": 2}
+    finally:
+        srv.stop()
+        pds.stop()

@@ -29,6 +29,26 @@ from .server import Server
 from .service import KvService
 
 
+def _default_mesh():
+    """A (regions × groups) mesh over every visible device when more than one
+    is present — the serving-path scale-out of BASELINE config #5.  Single
+    device (or an unreachable backend) serves single-device; the Endpoint's
+    CPU oracle remains the fallback either way."""
+    try:
+        import jax
+
+        from ..parallel.mesh import make_mesh
+
+        n = jax.device_count()
+        if n <= 1:
+            return None
+        return make_mesh(groups=2 if n % 2 == 0 else 1)
+    except Exception as exc:  # backend init failure must not block serving
+        print(f"[standalone] device mesh unavailable, serving single-device: "
+              f"{exc!r}", file=sys.stderr)
+        return None
+
+
 def open_engine(path: str | None):
     if path is None:
         from ..storage.btree_engine import BTreeEngine
@@ -73,7 +93,10 @@ class StoreServer:
         self.resolved_ts.attach_store(self.store)
         self.raftkv = RaftKv(self.store, resolved_ts=self.resolved_ts)
         self.storage = Storage(engine=self.raftkv)
-        self.copr = Endpoint(self.raftkv, enable_device=enable_device)
+        self.copr = Endpoint(
+            self.raftkv, enable_device=enable_device,
+            mesh=_default_mesh() if enable_device else None,
+        )
         self.gc_worker = GcWorker(self.raftkv)
         self.lock_manager = WaiterManager()
         self.service = KvService(
