@@ -389,3 +389,35 @@ def test_in_memory_engine_reclaims_range_deletes_on_compact():
     assert e.get_cf(CF_DEFAULT, b"g0500") is None
     assert e.get_cf(CF_DEFAULT, b"g0950") == b"v" * 100
     e.close()
+
+
+def test_io_classification_and_throttle(tmp_path):
+    """Engine IO is tagged per type (file_system role): foreground writes,
+    flushes, and compaction each account their bytes, and an attached rate
+    limiter sees the requests."""
+    from tikv_tpu.util.io_limiter import IoRateLimiter, IoType
+
+    lim = IoRateLimiter(bytes_per_sec=0)  # unlimited, but counts requests
+    seen = []
+    orig = lim.request
+
+    def spy(nbytes, io_type=None, timeout=5.0):
+        seen.append((io_type, nbytes))
+        return orig(nbytes, io_type, timeout)
+
+    lim.request = spy
+    e = NativeEngine(path=str(tmp_path / "db"), sync=False, io_limiter=lim)
+    for i in range(100):
+        put(e, b"io%03d" % i, b"v" * 50)
+    e.flush()
+    for i in range(100, 200):
+        put(e, b"io%03d" % i, b"v" * 50)
+    e.flush()
+    e.merge_runs("default")
+    stats = e.io_stats()
+    assert stats.get("foreground_write", 0) > 0
+    assert stats.get("flush", 0) > 0
+    assert stats.get("compaction", 0) > 0
+    types = {t for t, _ in seen}
+    assert {IoType.FOREGROUND_WRITE, IoType.FLUSH, IoType.COMPACTION} <= types
+    e.close()

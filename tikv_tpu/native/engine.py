@@ -17,6 +17,7 @@ import threading
 from typing import Iterator
 
 from ..storage.engine import ALL_CFS, Cursor, KvEngine, Snapshot, WriteBatch
+from ..util.io_limiter import IoType
 
 _CF_IDS = {cf: i for i, cf in enumerate(ALL_CFS)}
 
@@ -313,7 +314,9 @@ class NativeSnapshot(Snapshot):
             ctypes.byref(out), ctypes.byref(out_len),
         )
         if r == 1:
-            return _take(self._lib, out, out_len.value)
+            val = _take(self._lib, out, out_len.value)
+            self._engine._io(IoType.FOREGROUND_READ, len(val))
+            return val
         return None
 
     def cursor_cf(self, cf: str, lower: bytes | None = None, upper: bytes | None = None) -> Cursor:
@@ -332,7 +335,9 @@ class NativeSnapshot(Snapshot):
         )
         if n < 0:
             raise RuntimeError(f"eng_scan failed: {n}")
-        return n, _take(self._lib, out, out_len.value)
+        buf = _take(self._lib, out, out_len.value)
+        self._engine._io(IoType.FOREGROUND_READ, len(buf))
+        return n, buf
 
     def scan_cf(self, cf, start, end, limit=None, reverse=False) -> Iterator[tuple[bytes, bytes]]:
         n, buf = self.scan_raw(cf, start, end, limit, reverse)
@@ -349,12 +354,20 @@ class NativeEngine(KvEngine):
     flush + SST levels + compaction + perf context, re-derived)."""
 
     def __init__(self, path: str | None = None, sync: bool = True,
-                 wal_limit: int | None = None, mem_limit: int | None = None):
+                 wal_limit: int | None = None, mem_limit: int | None = None,
+                 io_limiter=None):
         lib = _load()
         if lib is None:
             raise RuntimeError(f"native engine unavailable: {_lib_err}")
         self._lib = lib
         self.path = path
+        # per-IO-type classification + throttling (components/file_system:
+        # every engine IO is tagged foreground_write / flush / compaction /
+        # foreground_read and, when a limiter is attached, pays its byte
+        # budget — background compaction is the one the budget exists for)
+        self._io_limiter = io_limiter
+        self._io_bytes = {t: 0 for t in IoType}
+        self._io_mu = threading.Lock()
         if path is None:
             self._handle = lib.eng_open()
         else:
@@ -368,12 +381,27 @@ class NativeEngine(KvEngine):
         if mem_limit is not None:
             lib.eng_set_mem_limit(self._handle, mem_limit)
 
+    def _io(self, io_type, nbytes: int) -> None:
+        if nbytes <= 0 or self.path is None:
+            return  # in-memory engines do no file IO: nothing to classify
+        with self._io_mu:
+            self._io_bytes[io_type] += nbytes
+        if self._io_limiter is not None:
+            self._io_limiter.request(nbytes, io_type)
+
+    def io_stats(self) -> dict:
+        """Bytes moved per IO type (file_system IOStats role)."""
+        with self._io_mu:
+            return {t.value: n for t, n in self._io_bytes.items() if n}
+
     def checkpoint(self) -> None:
         """Flush the memtable to sorted runs; truncates the WAL.  O(memtable),
         never O(database) — the incremental successor of the full spill."""
+        nbytes = self.mem_bytes() if self.path is not None else 0
         r = self._lib.eng_checkpoint(self._handle)
         if r != 0:
             raise RuntimeError(f"eng_checkpoint failed: {r}")
+        self._io(IoType.FLUSH, nbytes)
 
     flush = checkpoint
 
@@ -388,9 +416,27 @@ class NativeEngine(KvEngine):
     def merge_runs(self, cf: str) -> int:
         """Merge every run of a CF into one (background compaction step);
         returns 1 if a merge happened."""
+        nbytes = 0
+        if self.path is not None and self.run_count(cf) >= 2:
+            # compaction reads every input run and writes one output of
+            # roughly the same size: charge the run bytes on disk (skip
+            # in-flight .tmp files; a file unlinked mid-scan just drops out)
+            prefix = f"run{_CF_IDS[cf]}-"
+            try:
+                names = os.listdir(self.path)
+            except OSError:
+                names = []
+            for f in names:
+                if f.startswith(prefix) and not f.endswith(".tmp"):
+                    try:
+                        nbytes += os.path.getsize(os.path.join(self.path, f))
+                    except OSError:
+                        pass
         r = self._lib.eng_merge_runs(self._handle, _CF_IDS[cf])
         if r < 0:
             raise RuntimeError(f"eng_merge_runs failed: {r}")
+        if r:
+            self._io(IoType.COMPACTION, nbytes)
         return r
 
     def perf_context(self) -> dict:
@@ -550,6 +596,7 @@ class NativeEngine(KvEngine):
             pass
 
     def _write_buf(self, out: bytes) -> None:
+        self._io(IoType.FOREGROUND_WRITE, len(out))
         r = self._lib.eng_write(self._handle, out, len(out))
         if r != 0:
             raise RuntimeError(f"eng_write failed: {r}")
