@@ -74,3 +74,22 @@ def test_endpoint_carries_metrics():
     assert r.metrics["total_ms"] >= r.metrics["handle_ms"]
     assert not r.metrics["from_device"]
     assert slow.tail()[0]["tag"].startswith("copr tp=103")
+
+
+def test_slow_log_file_sink(tmp_path):
+    """Slow requests append one JSON line each to the slow-log file (the
+    reference's separate slow-log stream), in addition to the ring."""
+    import json
+
+    from tikv_tpu.copr.tracker import SlowLog, Tracker
+
+    path = str(tmp_path / "slow.log")
+    slow = SlowLog(threshold_s=0.0, path=path)
+    t = Tracker("copr tp=103 region=7")
+    t.on_schedule()
+    t.on_snapshot_finished()
+    t.on_finish(scanned_keys=5, from_device=False)
+    assert slow.observe(t)
+    lines = [json.loads(x) for x in open(path).read().splitlines()]
+    assert len(lines) == 1 and lines[0]["tag"] == "copr tp=103 region=7"
+    assert "ts" in lines[0] and lines[0]["scanned_keys"] == 5

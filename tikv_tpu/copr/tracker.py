@@ -10,6 +10,7 @@ response can carry the breakdown back to the client like
 from __future__ import annotations
 
 import threading
+import json
 import time
 from dataclasses import dataclass, field
 
@@ -63,11 +64,15 @@ class Tracker:
 
 
 class SlowLog:
-    """Bounded ring of slow-request records (the slow-log file analog)."""
+    """Bounded ring of slow-request records, optionally appended to a
+    slow-log FILE as one JSON line per entry (TiKV's slow-log file: a
+    separate, grep-able stream from the main log)."""
 
-    def __init__(self, threshold_s: float = 0.3, capacity: int = 256):
+    def __init__(self, threshold_s: float = 0.3, capacity: int = 256,
+                 path: str | None = None):
         self.threshold_s = threshold_s
         self.capacity = capacity
+        self.path = path
         self._mu = threading.Lock()
         self.entries: list[dict] = []
 
@@ -79,6 +84,16 @@ class SlowLog:
             self.entries.append(entry)
             if len(self.entries) > self.capacity:
                 del self.entries[: len(self.entries) - self.capacity]
+            if self.path is not None:
+                import json
+                import time as _time
+
+                line = json.dumps({"ts": _time.time(), **entry})
+                try:
+                    with open(self.path, "a") as f:
+                        f.write(line + "\n")
+                except OSError:
+                    pass  # a full disk must not fail the request
         return True
 
     def tail(self, n: int = 20) -> list[dict]:
