@@ -156,16 +156,6 @@ struct Engine {
   uint64_t min_live_snapshot() const {
     return snapshots.empty() ? UINT64_MAX : *snapshots.begin();
   }
-
-  // newest range-tombstone seq <= snap covering `key` across memtable + runs
-  uint64_t rtomb_seq(int cf, const std::string& key, uint64_t snap) const {
-    uint64_t best = rtomb_covering(mem_rtombs[cf], key, snap);
-    for (const auto& run : runs[cf]) {
-      uint64_t s = rtomb_covering(run->rtombs, key, snap);
-      if (s > best) best = s;
-    }
-    return best;
-  }
 };
 
 // tri-state resolve: MISS means "no version visible here, consult older
@@ -1827,21 +1817,46 @@ int eng_get(void* h, int cf, const uint8_t* key, uint64_t klen,
             uint64_t snap_seq, uint8_t** out, uint64_t* out_len) {
   Engine* e = static_cast<Engine*>(h);
   if (cf < 0 || cf >= kNumCfs) return -2;
-  std::shared_lock lk(e->mu);
-  e->perf.gets.fetch_add(1, std::memory_order_relaxed);
-  const Table& t = e->cfs[cf];
   std::string k(reinterpret_cast<const char*>(key), klen);
-  const std::string* v = nullptr;
+  std::string mem_val;
   uint64_t v_seq = 0;
+  uint64_t rts = 0;  // newest covering range-delete seq <= snap
   Res r = Res::MISS;
-  auto it = t.find(k);
-  if (it != t.end()) r = resolve3(it->second, snap_seq, &v, &v_seq);
-  if (r == Res::HIT)
-    e->perf.memtable_hits.fetch_add(1, std::memory_order_relaxed);
+  std::vector<std::shared_ptr<Run>> runs_copy;
+  {
+    // short critical section: memtable resolve + the (memory-only) range-
+    // tombstone check + a shared_ptr copy of the run list.  Run probing
+    // does file IO (pread + crc) and must NOT hold the engine lock — runs
+    // are immutable and the copied shared_ptrs keep their files alive
+    // across a concurrent merge swap.  A memtable MISS stays valid after
+    // unlock: only versions newer than snap can appear, and a flush moving
+    // versions to a run moves none visible at snap (they would have
+    // resolved HIT/TOMB here).
+    std::shared_lock lk(e->mu);
+    e->perf.gets.fetch_add(1, std::memory_order_relaxed);
+    const Table& t = e->cfs[cf];
+    const std::string* v = nullptr;
+    auto it = t.find(k);
+    if (it != t.end()) r = resolve3(it->second, snap_seq, &v, &v_seq);
+    if (r == Res::TOMB) return 0;
+    rts = rtomb_covering(e->mem_rtombs[cf], k, snap_seq);
+    for (const auto& run : e->runs[cf]) {
+      uint64_t s = rtomb_covering(run->rtombs, k, snap_seq);
+      if (s > rts) rts = s;
+    }
+    if (r == Res::HIT) {
+      e->perf.memtable_hits.fetch_add(1, std::memory_order_relaxed);
+      if (rts >= v_seq) return 0;  // range delete masks the memtable value
+      mem_val = *v;  // copy under the lock; the chain may mutate after
+    } else {
+      runs_copy = e->runs[cf];
+    }
+  }
   std::string run_val;
+  const std::string* v = (r == Res::HIT) ? &mem_val : nullptr;
   if (r == Res::MISS) {
     // newest run first; a hit or tombstone in a newer run masks older ones
-    for (const auto& run : e->runs[cf]) {
+    for (const auto& run : runs_copy) {
       int rr = run_get(*run, k, snap_seq, &run_val, &v_seq, &e->perf);
       if (rr < 0) return -3;
       if (rr == 2) return 0;  // tombstone
@@ -1853,8 +1868,7 @@ int eng_get(void* h, int cf, const uint8_t* key, uint64_t klen,
     }
   }
   if (r != Res::HIT) return 0;
-  // a range delete at or after the value's version masks it
-  if (e->rtomb_seq(cf, k, snap_seq) >= v_seq) return 0;
+  if (rts >= v_seq) return 0;  // range delete masks the run value
   *out = static_cast<uint8_t*>(malloc(v->size()));
   memcpy(*out, v->data(), v->size());
   *out_len = v->size();
@@ -1864,6 +1878,10 @@ int eng_get(void* h, int cf, const uint8_t* key, uint64_t klen,
 // scan [start, end) visible at snap_seq; limit 0 = unlimited.
 // Output buffer: repeated (klen u32 | key | vlen u32 | val); caller eng_free.
 // Returns number of pairs, or <0 on error.
+// NB: unlike eng_get, scans keep the shared lock across their run-block IO:
+// MergeIter walks live memtable iterators that a concurrent writer would
+// invalidate.  Lifting that needs the memtable subrange materialized under
+// the lock first (bounded by the output size) — a known follow-up.
 long eng_scan(void* h, int cf, uint64_t snap_seq, const uint8_t* start,
               uint64_t start_len, const uint8_t* end_key, uint64_t end_len,
               int has_end, uint64_t limit, int reverse, uint8_t** out,
