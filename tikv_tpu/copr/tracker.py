@@ -84,16 +84,16 @@ class SlowLog:
             self.entries.append(entry)
             if len(self.entries) > self.capacity:
                 del self.entries[: len(self.entries) - self.capacity]
-            if self.path is not None:
-                import json
-                import time as _time
-
-                line = json.dumps({"ts": _time.time(), **entry})
-                try:
-                    with open(self.path, "a") as f:
-                        f.write(line + "\n")
-                except OSError:
-                    pass  # a full disk must not fail the request
+        if self.path is not None:
+            # File IO happens outside the ring lock: a slow disk must not
+            # serialize other request threads or tail() readers. A single
+            # O_APPEND write of one line is atomic at these sizes.
+            line = json.dumps({"ts": time.time(), **entry})
+            try:
+                with open(self.path, "a") as f:
+                    f.write(line + "\n")
+            except OSError:
+                pass  # a full disk must not fail the request
         return True
 
     def tail(self, n: int = 20) -> list[dict]:

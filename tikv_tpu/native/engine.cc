@@ -996,34 +996,77 @@ struct RunCursor {
   }
 };
 
+// One materialized memtable resolution: the key's visible state at the
+// snapshot, captured under the engine lock so the merge can run without it.
+struct MemEntry {
+  std::string key;
+  bool tomb;
+  uint64_t seq;
+  std::string value;
+};
+
 // forward merged iterator over memtable + all runs of one CF, resolving
-// versions at a snapshot and filtering tombstones.  Caller holds (at least)
-// the shared engine lock for the iterator's whole lifetime.
+// versions at a snapshot and filtering tombstones.  init() is called under
+// (at least) the shared engine lock and copies everything it needs — the
+// memtable subrange resolved at the snapshot, run shared_ptrs (which pin
+// the files across a concurrent merge swap), and the relevant range
+// tombstones — so next(), which does run-block file IO (pread + crc),
+// runs with NO engine lock held: range scans no longer serialize writers
+// behind disk IO (the eng_get treatment, extended to ranges).
 struct MergeIter {
-  const Table* t;
-  Table::const_iterator mit, mend;
+  std::vector<MemEntry> mem;  // resolved memtable subrange, ascending
+  size_t mpos = 0;
+  std::vector<std::shared_ptr<Run>> runs_keep;
   std::vector<RunCursor> cursors;
+  Perf* perf = nullptr;
   uint64_t snap;
+  std::string lower;  // run-cursor seek start
   std::string upper;  // exclusive; empty + !has_upper = unbounded
   bool has_upper = false;
+  bool seeked = false;  // run cursors positioned (deferred: seeking reads)
+  // mem_cap / mem_bytes_cap bound how many memtable entries (and copied
+  // bytes) init may walk under the lock (0 = unlimited).  When hit,
+  // `truncated` is set and `resume_key` names the first un-walked key: the
+  // whole merge is clamped below it and the caller continues from there
+  // with a fresh init (ChunkedMerge).
+  uint64_t mem_cap = 0;
+  uint64_t mem_bytes_cap = 0;
+  bool truncated = false;
+  std::string resume_key;
 
   std::vector<RangeTomb> rts;  // tombstones visible at snap touching range
 
   void init(Engine* e, int cf, uint64_t snap_seq, const std::string& start,
             const std::string& end, bool bounded) {
-    t = &e->cfs[cf];
     snap = snap_seq;
+    lower = start;
     upper = end;
     has_upper = bounded;
-    mit = t->lower_bound(start);
     if (bounded && end <= start) {
-      mend = mit;  // empty range: never walk past the map's real bounds
+      seeked = true;  // empty range: nothing to position
       return;
     }
-    mend = bounded ? t->lower_bound(end) : t->end();
-    cursors.resize(e->runs[cf].size());
-    for (size_t i = 0; i < cursors.size(); i++)
-      cursors[i].seek(e->runs[cf][i].get(), start, &e->perf);
+    const Table& t = e->cfs[cf];
+    auto endit = bounded ? t.lower_bound(end) : t.end();
+    uint64_t walked = 0, bytes = 0;
+    for (auto it = t.lower_bound(start); it != endit; ++it) {
+      if ((mem_cap != 0 && walked == mem_cap) ||
+          (mem_bytes_cap != 0 && bytes >= mem_bytes_cap)) {
+        truncated = true;
+        resume_key = it->first;
+        break;
+      }
+      walked++;
+      const std::string* v = nullptr;
+      uint64_t v_seq = 0;
+      Res r = resolve3(it->second, snap_seq, &v, &v_seq);
+      if (r == Res::MISS) continue;  // runs decide, same as key-absent
+      bytes += it->first.size() + (r == Res::HIT ? v->size() : 0);
+      mem.push_back(MemEntry{it->first, r == Res::TOMB, v_seq,
+                             r == Res::HIT ? *v : std::string()});
+    }
+    runs_keep = e->runs[cf];
+    perf = &e->perf;
     // hoist the relevant range tombstones once: per-key masking below walks
     // only this (usually empty) filtered list, not every run's full set
     auto want = [&](const RangeTomb& rt) {
@@ -1031,36 +1074,41 @@ struct MergeIter {
     };
     for (const auto& rt : e->mem_rtombs[cf])
       if (want(rt)) rts.push_back(rt);
-    for (const auto& run : e->runs[cf])
+    for (const auto& run : runs_keep)
       for (const auto& rt : run->rtombs)
         if (want(rt)) rts.push_back(rt);
   }
 
-  // next visible (key, value); false when exhausted
+  // next visible (key, value); false when exhausted.  Run-block IO happens
+  // here, after init's lock is released.
   bool next(std::string* out_k, std::string* out_v) {
+    if (!seeked) {
+      seeked = true;
+      cursors.resize(runs_keep.size());
+      for (size_t i = 0; i < cursors.size(); i++)
+        cursors[i].seek(runs_keep[i].get(), lower, perf);
+    }
     while (true) {
       const std::string* min_key = nullptr;
-      bool from_mem = false;
-      if (mit != mend) {
-        min_key = &mit->first;
-        from_mem = true;
-      }
+      if (mpos < mem.size()) min_key = &mem[mpos].key;
       for (auto& c : cursors) {
         if (!c.valid) continue;
         if (has_upper && c.key >= upper) { c.valid = false; continue; }
-        if (min_key == nullptr || c.key < *min_key) {
-          min_key = &c.key;
-          from_mem = false;
-        }
+        if (min_key == nullptr || c.key < *min_key) min_key = &c.key;
       }
       if (min_key == nullptr) return false;
+      if (truncated && *min_key >= resume_key) return false;  // chunk edge
       std::string key = *min_key;
       // resolve newest-source-first: memtable, then runs in list order
       Res r = Res::MISS;
       const std::string* v = nullptr;
       uint64_t v_seq = 0;
-      if (from_mem || (mit != mend && mit->first == key))
-        r = resolve3(mit->second, snap, &v, &v_seq);
+      bool mem_here = mpos < mem.size() && mem[mpos].key == key;
+      if (mem_here) {
+        r = mem[mpos].tomb ? Res::TOMB : Res::HIT;
+        v = &mem[mpos].value;
+        v_seq = mem[mpos].seq;
+      }
       std::string run_val;
       if (r == Res::MISS) {
         for (auto& c : cursors) {
@@ -1082,7 +1130,7 @@ struct MergeIter {
         }
       }
       // advance every source positioned at this key
-      if (mit != mend && mit->first == key) ++mit;
+      if (mem_here) mpos++;
       for (auto& c : cursors)
         if (c.valid && c.key == key) c.next_group();
       if (r == Res::HIT && rtomb_covering(rts, key, snap) < v_seq) {
@@ -1174,64 +1222,103 @@ struct ReverseRunCursor {
 };
 
 struct ReverseMergeIter {
-  const Table* t;
-  Table::const_iterator mit, mbegin;  // mit points PAST the current candidate
-  bool mem_valid = false;
-  std::string mem_key;
+  std::vector<MemEntry> mem;  // resolved memtable subrange, DESCENDING
+  size_t mpos = 0;
+  std::vector<std::shared_ptr<Run>> runs_keep;
   std::vector<ReverseRunCursor> cursors;
+  Perf* perf = nullptr;
   uint64_t snap;
-  std::string lower;  // inclusive bound
+  std::string lower;   // inclusive bound
+  std::string upper_;  // exclusive cursor-seek bound
+  bool bounded_ = false;
+  bool seeked = false;
+  // bounded memtable walk, mirroring MergeIter: when the cap is hit,
+  // resume_key is the key at which the descending walk stopped (NOT
+  // materialized).  The merge is clamped to keys strictly above it, and the
+  // next chunk's exclusive upper bound is resume_key + one zero byte so the
+  // stopped-at key itself is included there.
+  uint64_t mem_cap = 0;
+  uint64_t mem_bytes_cap = 0;
+  bool truncated = false;
+  std::string resume_key;
 
   std::vector<RangeTomb> rts;  // tombstones visible at snap touching range
 
+  // Same locking contract as MergeIter: init under the shared engine lock
+  // (no file IO), next() unlocked.
   void init(Engine* e, int cf, uint64_t snap_seq, const std::string& start,
             const std::string& end, bool bounded) {
-    t = &e->cfs[cf];
     snap = snap_seq;
     lower = start;
-    mem_valid = false;
-    if (bounded && end <= start) return;  // empty range: lower_bound(end)
-    // could sit BEFORE mbegin and --it below would walk out of the range
-    // (or decrement begin())
-    mbegin = t->lower_bound(start);
-    auto it = bounded ? t->lower_bound(end) : t->end();
-    mem_valid = it != mbegin;
-    if (mem_valid) {
+    upper_ = end;
+    bounded_ = bounded;
+    if (bounded && end <= start) {
+      seeked = true;  // empty range
+      return;
+    }
+    const Table& t = e->cfs[cf];
+    auto mbegin = t.lower_bound(start);
+    auto it = bounded ? t.lower_bound(end) : t.end();
+    uint64_t walked = 0, bytes = 0;
+    while (it != mbegin) {
       --it;
-      mem_key = it->first;
+      if ((mem_cap != 0 && walked == mem_cap) ||
+          (mem_bytes_cap != 0 && bytes >= mem_bytes_cap)) {
+        truncated = true;
+        resume_key = it->first;  // un-materialized; next chunk includes it
+        break;
+      }
+      walked++;
+      const std::string* v = nullptr;
+      uint64_t v_seq = 0;
+      Res r = resolve3(it->second, snap_seq, &v, &v_seq);
+      if (r == Res::MISS) continue;
+      bytes += it->first.size() + (r == Res::HIT ? v->size() : 0);
+      mem.push_back(MemEntry{it->first, r == Res::TOMB, v_seq,
+                             r == Res::HIT ? *v : std::string()});
     }
-    mit = it;
-    cursors.resize(e->runs[cf].size());
-    for (size_t i = 0; i < cursors.size(); i++) {
-      cursors[i].seek_last_below(e->runs[cf][i].get(), end, bounded, &e->perf);
-      while (cursors[i].valid && cursors[i].key() < lower) cursors[i].valid = false;
-    }
+    runs_keep = e->runs[cf];
+    perf = &e->perf;
     auto want = [&](const RangeTomb& rt) {
       return rt.seq <= snap_seq && rt.end > start && (!bounded || rt.start < end);
     };
     for (const auto& rt : e->mem_rtombs[cf])
       if (want(rt)) rts.push_back(rt);
-    for (const auto& run : e->runs[cf])
+    for (const auto& run : runs_keep)
       for (const auto& rt : run->rtombs)
         if (want(rt)) rts.push_back(rt);
   }
 
   bool next(std::string* out_k, std::string* out_v) {
+    if (!seeked) {
+      seeked = true;
+      cursors.resize(runs_keep.size());
+      for (size_t i = 0; i < cursors.size(); i++) {
+        cursors[i].seek_last_below(runs_keep[i].get(), upper_, bounded_, perf);
+        if (cursors[i].valid && cursors[i].key() < lower)
+          cursors[i].valid = false;
+      }
+    }
     while (true) {
       const std::string* max_key = nullptr;
-      if (mem_valid) max_key = &mem_key;
+      if (mpos < mem.size()) max_key = &mem[mpos].key;
       for (auto& c : cursors) {
         if (!c.valid) continue;
         if (c.key() < lower) { c.valid = false; continue; }
         if (max_key == nullptr || c.key() > *max_key) max_key = &c.key();
       }
       if (max_key == nullptr) return false;
+      if (truncated && *max_key <= resume_key) return false;  // chunk edge
       std::string key = *max_key;
       Res r = Res::MISS;
       const std::string* v = nullptr;
       uint64_t v_seq = 0;
-      if (mem_valid && mem_key == key)
-        r = resolve3(mit->second, snap, &v, &v_seq);
+      bool mem_here = mpos < mem.size() && mem[mpos].key == key;
+      if (mem_here) {
+        r = mem[mpos].tomb ? Res::TOMB : Res::HIT;
+        v = &mem[mpos].value;
+        v_seq = mem[mpos].seq;
+      }
       std::string run_val;
       if (r == Res::MISS) {
         for (auto& c : cursors) {
@@ -1252,14 +1339,7 @@ struct ReverseMergeIter {
           if (r != Res::MISS) break;
         }
       }
-      if (mem_valid && mem_key == key) {
-        if (mit == mbegin) {
-          mem_valid = false;
-        } else {
-          --mit;
-          mem_key = mit->first;
-        }
-      }
+      if (mem_here) mpos++;
       for (auto& c : cursors) {
         if (c.valid && c.key() == key) {
           c.prev_group();
@@ -1271,6 +1351,88 @@ struct ReverseMergeIter {
         *out_v = *v;
         return true;
       }
+    }
+  }
+};
+
+// Drives MergeIter in bounded-memtable chunks.  Each chunk takes a fresh
+// shared-lock view at the SAME registered snapshot — safe, because versions
+// visible at a live snapshot can neither disappear (the snapshot pins them
+// against compaction and version-chain trimming; a flush only moves them
+// into a run the fresh view includes) nor appear (new writes carry seqs
+// above it).  So no lock is ever held across run-block IO and no single
+// init walks more than `cap` memtable entries.
+constexpr uint64_t kScanMemChunk = 65536;     // memtable entries / locked walk
+constexpr uint64_t kMemChunkBytes = 4 << 20;  // copied bytes / locked walk
+
+struct ChunkedMerge {
+  Engine* e;
+  int cf;
+  uint64_t snap;
+  std::string cur, upper;
+  bool has_upper;
+  uint64_t cap;  // grows ×4 per re-init: single-row seeks start tiny
+  MergeIter mi;
+
+  ChunkedMerge(Engine* e_, int cf_, uint64_t snap_, std::string start,
+               std::string end, bool bounded, uint64_t cap_)
+      : e(e_), cf(cf_), snap(snap_), cur(std::move(start)),
+        upper(std::move(end)), has_upper(bounded), cap(cap_) {
+    open();
+  }
+
+  void open() {
+    mi = MergeIter{};
+    mi.mem_cap = cap;
+    mi.mem_bytes_cap = kMemChunkBytes;
+    std::shared_lock lk(e->mu);
+    mi.init(e, cf, snap, cur, upper, has_upper);
+  }
+
+  bool next(std::string* k, std::string* v) {
+    while (true) {
+      if (mi.next(k, v)) return true;
+      if (!mi.truncated) return false;
+      cur = mi.resume_key;  // strictly advances: ≥1 entry walked per chunk
+      cap = std::min<uint64_t>(cap * 4, kScanMemChunk);
+      open();
+    }
+  }
+};
+
+struct ReverseChunkedMerge {
+  Engine* e;
+  int cf;
+  uint64_t snap;
+  std::string lower, cur_upper;
+  bool has_upper;
+  uint64_t cap;
+  ReverseMergeIter mi;
+
+  ReverseChunkedMerge(Engine* e_, int cf_, uint64_t snap_, std::string start,
+                      std::string end, bool bounded, uint64_t cap_)
+      : e(e_), cf(cf_), snap(snap_), lower(std::move(start)),
+        cur_upper(std::move(end)), has_upper(bounded), cap(cap_) {
+    open();
+  }
+
+  void open() {
+    mi = ReverseMergeIter{};
+    mi.mem_cap = cap;
+    mi.mem_bytes_cap = kMemChunkBytes;
+    std::shared_lock lk(e->mu);
+    mi.init(e, cf, snap, lower, cur_upper, has_upper);
+  }
+
+  bool next(std::string* k, std::string* v) {
+    while (true) {
+      if (mi.next(k, v)) return true;
+      if (!mi.truncated) return false;
+      // stopped-at key was not materialized: include it in the next chunk
+      cur_upper = mi.resume_key + std::string(1, '\0');
+      has_upper = true;
+      cap = std::min<uint64_t>(cap * 4, kScanMemChunk);
+      open();
     }
   }
 };
@@ -1878,17 +2040,17 @@ int eng_get(void* h, int cf, const uint8_t* key, uint64_t klen,
 // scan [start, end) visible at snap_seq; limit 0 = unlimited.
 // Output buffer: repeated (klen u32 | key | vlen u32 | val); caller eng_free.
 // Returns number of pairs, or <0 on error.
-// NB: unlike eng_get, scans keep the shared lock across their run-block IO:
-// MergeIter walks live memtable iterators that a concurrent writer would
-// invalidate.  Lifting that needs the memtable subrange materialized under
-// the lock first (bounded by the output size) — a known follow-up.
+// The shared lock covers only MergeIter::init (a bounded memtable
+// materialization + run shared_ptr copies — memory-only); the run-block
+// pread+crc IO runs unlocked, so a cold range scan never stalls writers,
+// and ChunkedMerge re-inits keep any single locked walk ≤ kScanMemChunk
+// memtable entries.
 long eng_scan(void* h, int cf, uint64_t snap_seq, const uint8_t* start,
               uint64_t start_len, const uint8_t* end_key, uint64_t end_len,
               int has_end, uint64_t limit, int reverse, uint8_t** out,
               uint64_t* out_len) {
   Engine* e = static_cast<Engine*>(h);
   if (cf < 0 || cf >= kNumCfs) return -2;
-  std::shared_lock lk(e->mu);
   std::string s(reinterpret_cast<const char*>(start), start_len);
   std::string en(reinterpret_cast<const char*>(end_key), end_len);
   std::string buf;
@@ -1900,16 +2062,17 @@ long eng_scan(void* h, int cf, uint64_t snap_seq, const uint8_t* start,
     buf.append(v);
     n++;
   };
+  // a limited scan caps its locked walk proportionally to the output it can
+  // produce (tombstone-heavy ranges continue via chunk re-init)
+  uint64_t cap = limit ? std::max<uint64_t>(2 * limit, 4096) : kScanMemChunk;
   std::string k, v;
   if (!reverse) {
-    MergeIter mi;
-    mi.init(e, cf, snap_seq, s, en, has_end != 0);
-    while ((limit == 0 || n < static_cast<long>(limit)) && mi.next(&k, &v))
+    ChunkedMerge cm(e, cf, snap_seq, s, en, has_end != 0, cap);
+    while ((limit == 0 || n < static_cast<long>(limit)) && cm.next(&k, &v))
       emit(k, v);
   } else {
-    ReverseMergeIter mi;
-    mi.init(e, cf, snap_seq, s, en, has_end != 0);
-    while ((limit == 0 || n < static_cast<long>(limit)) && mi.next(&k, &v))
+    ReverseChunkedMerge cm(e, cf, snap_seq, s, en, has_end != 0, cap);
+    while ((limit == 0 || n < static_cast<long>(limit)) && cm.next(&k, &v))
       emit(k, v);
   }
   *out = static_cast<uint8_t*>(malloc(buf.size()));
@@ -1927,24 +2090,26 @@ int eng_seek(void* h, int cf, uint64_t snap_seq, const uint8_t* target,
              uint64_t* vout_len) {
   Engine* e = static_cast<Engine*>(h);
   if (cf < 0 || cf >= kNumCfs) return -2;
-  std::shared_lock lk(e->mu);
   std::string tg(reinterpret_cast<const char*>(target), target_len);
   std::string lo(reinterpret_cast<const char*>(lower), lower_len);
   std::string up(reinterpret_cast<const char*>(upper), upper_len);
   std::string k, v;
   bool found;
+  // single-row seeks start with a tiny locked walk (cursor stepping issues
+  // one seek per row); a run of snapshot-invisible or tombstoned entries
+  // continues via chunk re-init with ×4 growth
+  constexpr uint64_t kSeekMemChunk = 16;
   if (!for_prev) {
-    MergeIter mi;
-    mi.init(e, cf, snap_seq, tg < lo ? lo : tg, up, has_upper != 0);
-    found = mi.next(&k, &v);
+    ChunkedMerge cm(e, cf, snap_seq, tg < lo ? lo : tg, up, has_upper != 0,
+                    kSeekMemChunk);
+    found = cm.next(&k, &v);
   } else {
     // last visible key <= target within [lower, upper): the reverse bound is
     // exclusive, so extend the inclusive target by one zero byte
     std::string end_incl = tg + std::string(1, '\0');
     if (has_upper && up < end_incl) end_incl = up;
-    ReverseMergeIter mi;
-    mi.init(e, cf, snap_seq, lo, end_incl, true);
-    found = mi.next(&k, &v);
+    ReverseChunkedMerge cm(e, cf, snap_seq, lo, end_incl, true, kSeekMemChunk);
+    found = cm.next(&k, &v);
   }
   if (!found) return 0;
   *kout = static_cast<uint8_t*>(malloc(k.size()));
@@ -2087,15 +2252,21 @@ int eng_mvcc_props(void* h, int cf, const uint8_t* start, uint64_t start_len,
                    uint64_t snap_seq, uint64_t* out) {
   Engine* e = static_cast<Engine*>(h);
   if (cf < 0 || cf >= kNumCfs) return -2;
-  std::shared_lock lk(e->mu);
   std::string s(reinterpret_cast<const char*>(start), start_len);
   std::string en(reinterpret_cast<const char*>(end_key), end_len);
   uint64_t entries = 0, rows = 0, puts = 0, dels = 0, other = 0;
   uint64_t min_ts = UINT64_MAX, max_ts = 0, max_row = 0, cur_row = 0;
   std::string cur_user;
   bool have_user = false;
-  MergeIter mi;
-  mi.init(e, cf, snap_seq, s, en, has_end != 0);
+  // Callers pass the CURRENT seq, not a registered snapshot; ChunkedMerge's
+  // chunk re-inits are only consistent at a *pinned* seq (otherwise version
+  // chains visible at snap_seq can be trimmed between chunks), so register
+  // it for the duration of the walk.
+  {
+    std::unique_lock lk(e->mu);
+    e->snapshots.insert(snap_seq);
+  }
+  ChunkedMerge mi(e, cf, snap_seq, s, en, has_end != 0, kScanMemChunk);
   std::string k, val;
   while (mi.next(&k, &val)) {
     const std::string* v = &val;
@@ -2123,6 +2294,11 @@ int eng_mvcc_props(void* h, int cf, const uint8_t* start, uint64_t start_len,
       else if (wt == 'D') dels++;
       else other++;
     }
+  }
+  {
+    std::unique_lock lk(e->mu);
+    auto sit = e->snapshots.find(snap_seq);
+    if (sit != e->snapshots.end()) e->snapshots.erase(sit);
   }
   out[0] = entries;
   out[1] = rows;

@@ -291,6 +291,13 @@ class PbMessage:
                 else:
                     setattr(msg, f.name, sub)
                 continue
+            if wt != _WIRE_TYPE[f.kind]:
+                # Wire type disagrees with the declared kind (e.g. a varint
+                # field sent as FIX64). Decoding per the declared kind would
+                # read the wrong width and silently misparse everything after;
+                # protoc-generated decoders skip such fields — do the same.
+                pos = skip_field(buf, pos, wt)
+                continue
             v, pos = cls._decode_scalar_at(buf, pos, f, wt)
             if f.repeated:
                 getattr(msg, f.name).append(v)

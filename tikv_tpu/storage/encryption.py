@@ -64,6 +64,12 @@ def seal(key: bytes, plaintext: bytes) -> bytes:
 
 
 def unseal(key: bytes, sealed: bytes) -> bytes:
+    """Inverse of :func:`seal`.
+
+    Format note: the leading method byte was introduced before any release
+    shipped; there is no deployed data in the legacy headerless ``iv|ct|mac``
+    layout, so no fallback parse is attempted for it.
+    """
     if not sealed:
         raise ValueError("empty sealed blob")
     method, body = sealed[0], sealed[1:]

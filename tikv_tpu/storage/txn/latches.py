@@ -90,6 +90,10 @@ class Latches:
         chain.  The caller re-schedules them; nothing blocks in here."""
         woken: list[object] = []
         with self._mu:
+            # a parked command being torn down (scheduler shutdown) must also
+            # drop its _waiting record — with its cid purged from every queue
+            # no future release could ever complete the acquisition
+            self._waiting.pop(cid, None)
             for s in slots:
                 q = self._slots[s]
                 if q and q[0] == cid:

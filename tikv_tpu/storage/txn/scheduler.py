@@ -43,7 +43,7 @@ class SchedTooBusy(Exception):
 error_code.register(SchedTooBusy, SCHED_TOO_BUSY)
 
 
-@dataclass
+@dataclass(eq=False)  # identity hash: tasks live in the inflight set
 class _Task:
     cmd: Command
     ctx: dict | None
@@ -53,6 +53,10 @@ class _Task:
     done: threading.Event = field(default_factory=threading.Event)
     result: object = None
     exc: BaseException | None = None
+    # exactly-once completion: set (under the scheduler lock) by whichever of
+    # a worker's _execute or shutdown's _fail_task gets the task first; the
+    # loser must not touch latches/_inflight again
+    claimed: bool = False
 
 
 class Scheduler:
@@ -74,6 +78,7 @@ class Scheduler:
         self._high: deque[_Task] = deque()
         self._normal: deque[_Task] = deque()
         self._inflight = 0  # submitted, not yet finished (queued or running)
+        self._tasks: set = set()  # every inflight task, incl. latch-parked ones
         self._threads: list[threading.Thread] = []
         self._stopped = False
         # observability (scheduler.rs metrics role)
@@ -102,6 +107,7 @@ class Scheduler:
                 )
             self._inflight += 1
             self._ensure_threads()
+        task = None
         try:
             cid = self.latches.gen_cid()
             task = _Task(cmd, ctx, cid, high)
@@ -112,21 +118,41 @@ class Scheduler:
                 task.slots = list(range(self.latches.size))
             else:
                 task.slots = self.latches.slot_ids(cmd.latch_keys())
+            with self._mu:
+                self._tasks.add(task)
             granted, _ = self.latches.acquire_slots(cid, task.slots, task)
         except BaseException:
             with self._mu:
                 self._inflight -= 1  # never reached _execute's decrement
+                if task is not None:
+                    self._tasks.discard(task)
             raise
-        if granted:
+        with self._mu:
+            failed_by_stop = task.claimed
+        if failed_by_stop:
+            # stop()'s drain claimed the task between _tasks.add and the
+            # latch acquisition above: the dead cid is now queued in the
+            # latch table with nobody left to release it — undo that here
+            # (release is idempotent for a cid stop already purged)
+            for t in self.latches.release(cid, task.slots):
+                self._enqueue(t)
+        elif granted:
             self._enqueue(task)
         # else: parked — some release() will hand the task back
         return task
 
     def _enqueue(self, task: _Task) -> None:
         with self._mu:
-            (self._high if task.high else self._normal).append(task)
-            self.stats["scheduled"] += 1
-            self._ready.notify()
+            if self._stopped:
+                # no workers remain to run it; fail it so waiters unblock
+                stopped = True
+            else:
+                stopped = False
+                (self._high if task.high else self._normal).append(task)
+                self.stats["scheduled"] += 1
+                self._ready.notify()
+        if stopped:
+            self._fail_task(task, RuntimeError("scheduler stopped"))
 
     def _ensure_threads(self) -> None:
         # lazily grown to pool_size; caller holds self._mu
@@ -149,6 +175,9 @@ class Scheduler:
                 if self._stopped and not self._high and not self._normal:
                     return
                 task = (self._high or self._normal).popleft()
+                if task.claimed:  # shutdown already failed it
+                    continue
+                task.claimed = True
             self._execute(task)
 
     def _execute(self, task: _Task) -> None:
@@ -166,10 +195,24 @@ class Scheduler:
             woken = self.latches.release(task.cid, task.slots)
             with self._mu:
                 self._inflight -= 1
+                self._tasks.discard(task)
                 self.stats["woken"] += len(woken)
             for t in woken:
                 self._enqueue(t)
             task.done.set()
+
+    def _fail_task(self, task: _Task, exc: BaseException) -> None:
+        with self._mu:
+            if task.claimed:  # a worker owns it (or it already finished)
+                return
+            task.claimed = True
+            self._inflight -= 1
+            self._tasks.discard(task)
+        woken = self.latches.release(task.cid, task.slots)
+        task.exc = exc
+        task.done.set()
+        for t in woken:
+            self._enqueue(t)  # re-entrant: fails the chain one by one
 
     def stop(self) -> None:
         with self._mu:
@@ -177,3 +220,15 @@ class Scheduler:
             self._ready.notify_all()
         for t in self._threads:
             t.join(timeout=5)
+        # Fail whatever is still queued or parked in the latch table, so no
+        # caller blocked in run_command's done.wait() hangs past shutdown.
+        # Tasks a live worker claimed are left alone — the worker's _execute
+        # completes them with their real outcome.
+        while True:
+            with self._mu:
+                self._high.clear()
+                self._normal.clear()
+                task = next((t for t in self._tasks if not t.claimed), None)
+            if task is None:
+                break
+            self._fail_task(task, RuntimeError("scheduler stopped"))
