@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Driver benchmark: TPC-H Q1/Q6-shaped coprocessor pushdown.
+"""Driver benchmark: TPC-H Q1/Q6-shaped coprocessor pushdown at 100M rows.
 
 Measures the JAX/TPU DAG evaluator against the CPU read-pool pipeline
 (BatchExecutorsRunner) on a lineitem-shaped table, asserting byte-identical
@@ -7,166 +7,106 @@ SelectResponses, and prints ONE JSON line:
 
     {"metric": ..., "value": <tpu rows/sec>, "unit": "rows/sec", "vs_baseline": <speedup>}
 
-vs_baseline = geometric mean over {Q1, Q6} of (TPU rows/s) / (CPU rows/s).
-Row count via BENCH_ROWS (default 2,000,000); BENCH_MVCC=1 additionally
-validates the MVCC leaf on a 200k-row engine-backed region.
+vs_baseline = (TPU rows/s) / (CPU rows/s) on the K-query batched serving
+shape; per-query Q1/Q6 warm/cold speedups ride the stderr detail JSON.
+
+Backend acquisition (the part that failed rounds 1-3): ONE persistent device
+worker subprocess is spawned at start and given a long init budget
+(BENCH_INIT_BUDGET, default 900s — the tunnel backend is known to HANG at
+init rather than fail fast, so the worker heartbeats while it waits and the
+parent overlaps ALL CPU-side measurement with the wait).  Every device trial
+runs through that worker over a line-JSON pipe; the parent never initializes
+the device backend itself (JAX caches the first backend-init failure for the
+process lifetime).  Only after the budget expires is the run demoted to an
+in-process CPU fallback, and the full probe timeline is emitted in the
+detail JSON so a hang is diagnosable from BENCH_rN.json alone.
+
+Row count via BENCH_ROWS (default 100,000,000 — BASELINE.md config 4 scale).
+The 100M-row warm fixture is built columnar (the decoded image of
+``build_kvs``, validated block-for-block against a real decode in
+``fixture_selfcheck``); cold trials decode real KV bytes at BENCH_COLD_ROWS
+(default 1M).  BENCH_MVCC=1 (default) adds an engine-backed MVCC region
+validation and an endpoint-driven device TopN.
 """
 
 import json
 import os
+import queue
+import signal
 import subprocess
 import sys
+import threading
 import time
 
-_PROBE_DONE = "BENCH_BACKEND_RESOLVED"
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
 
+import numpy as np
 
-def _resolve_backend() -> str:
-    """Probe the configured JAX backend out-of-process with retry/backoff.
-
-    BENCH_r01/BENCH_r02 both died with rc=1 at axon backend init
-    (``Unable to initialize backend 'axon': UNAVAILABLE``) before any bench
-    work ran.  Two properties force the shape of this guard:
-
-    * JAX caches the first backend-init failure for the life of the process,
-      so retrying in-process is useless — the probe runs in a subprocess and
-      the parent only imports device modules after a probe succeeded.
-    * The tunnel backend can also HANG at init (observed: minutes with no
-      error), so each probe attempt carries a hard timeout.
-
-    On unrecoverable failure we force the CPU platform and continue, so the
-    driver still captures a parsed one-line JSON artifact (the metric name is
-    suffixed ``_cpu_fallback``) instead of a raw traceback.  The forcing MUST
-    go through ``jax.config.update('jax_platforms', 'cpu')`` — this image's
-    sitecustomize re-exports JAX_PLATFORMS=axon at every interpreter start,
-    so a shell-level env override is silently clobbered (observed: a
-    JAX_PLATFORMS=cpu run still initializing 'axon' and hanging).
-    """
-    resolved = os.environ.get(_PROBE_DONE)
-    if resolved:
-        if resolved.startswith("cpu"):
-            _force_cpu()
-        return resolved
-    attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
-    timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
-    backoff = 10.0
-    code = (
-        "import jax, jax.numpy as jnp;"
-        "x = jnp.ones((256, 256), jnp.float32);"
-        "(x @ x).block_until_ready();"
-        "print('PLATFORM=' + jax.devices()[0].platform)"
-    )
-    import signal
-
-    for i in range(attempts):
-        t0 = time.time()
-        err = ""
-        # start_new_session + killpg: the tunnel plugin may fork helpers that
-        # inherit the pipes; killing only the direct child would leave
-        # communicate() blocked on the helper's copy of the write end.
-        proc = subprocess.Popen(
-            [sys.executable, "-c", code],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            start_new_session=True,
-        )
-        try:
-            out, errtxt = proc.communicate(timeout=timeout)
-            for line in out.splitlines():
-                if line.startswith("PLATFORM="):
-                    plat = line.split("=", 1)[1]
-                    os.environ[_PROBE_DONE] = plat
-                    print(f"bench: backend '{plat}' up after probe {i + 1} "
-                          f"({time.time() - t0:.1f}s)", file=sys.stderr)
-                    return plat
-            tail = (errtxt or "").strip().splitlines()
-            err = tail[-1][:300] if tail else f"rc={proc.returncode}, no output"
-        except subprocess.TimeoutExpired:
-            try:
-                os.killpg(proc.pid, signal.SIGKILL)
-            except OSError:
-                proc.kill()
-            proc.communicate()
-            err = f"probe hung past {timeout:.0f}s (killed group)"
-        print(f"bench: backend probe {i + 1}/{attempts} failed: {err}",
-              file=sys.stderr)
-        if i + 1 < attempts:
-            time.sleep(backoff)
-            backoff = min(backoff * 2, 90.0)
-    print("bench: device backend unrecoverable — running on CPU", file=sys.stderr)
-    os.environ[_PROBE_DONE] = "cpu_fallback"
-    _force_cpu()
-    return "cpu_fallback"
+TABLE_ID = 101
+_JAX_CACHE_DIR = os.path.join(_HERE, ".jax_cache")
 
 
 def _force_cpu() -> None:
+    """Must go through jax.config: this image's sitecustomize re-exports
+    JAX_PLATFORMS=axon at every interpreter start, so a shell-level env
+    override is silently clobbered."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
 
 
-if __name__ == "__main__":
-    _BACKEND = _resolve_backend()
-else:
-    _BACKEND = os.environ.get(_PROBE_DONE, "")
+def _lineitem():
+    from tikv_tpu.copr.datatypes import ColumnInfo, FieldType
 
-import numpy as np
+    return [
+        ColumnInfo(1, FieldType.int64(), is_pk_handle=True),
+        ColumnInfo(2, FieldType.int64()),  # l_quantity
+        ColumnInfo(3, FieldType.decimal_type(2)),  # l_extendedprice
+        ColumnInfo(4, FieldType.decimal_type(2)),  # l_discount
+        ColumnInfo(5, FieldType.int64()),  # l_shipdate (days)
+        ColumnInfo(6, FieldType.varchar()),  # l_returnflag
+        ColumnInfo(7, FieldType.varchar()),  # l_linestatus
+    ]
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from tikv_tpu.copr.aggr import AggDescriptor
-from tikv_tpu.copr.dag import (
-    Aggregation,
-    BatchExecutorsRunner,
-    DagRequest,
-    Selection,
-    TableScan,
-)
-from tikv_tpu.copr.datatypes import ColumnInfo, FieldType
-from tikv_tpu.copr.cache import ColumnBlockCache
-from tikv_tpu.copr.executors import CachedBlocksExecutor, FixtureScanSource
-from tikv_tpu.copr.jax_eval import JaxDagEvaluator, run_batch_cached, supports
-from tikv_tpu.copr.rpn import call, col, const_decimal, const_int
-from tikv_tpu.copr.table import encode_row, record_key
-
-TABLE_ID = 101
-
-LINEITEM = [
-    ColumnInfo(1, FieldType.int64(), is_pk_handle=True),
-    ColumnInfo(2, FieldType.int64()),  # l_quantity
-    ColumnInfo(3, FieldType.decimal_type(2)),  # l_extendedprice
-    ColumnInfo(4, FieldType.decimal_type(2)),  # l_discount
-    ColumnInfo(5, FieldType.int64()),  # l_shipdate (days)
-    ColumnInfo(6, FieldType.varchar()),  # l_returnflag
-    ColumnInfo(7, FieldType.varchar()),  # l_linestatus
-]
+def build_arrays(n: int, seed: int = 0) -> dict:
+    """The raw column draws — the single source of randomness, shared by the
+    KV-bytes fixture and the columnar fixture so both processes see the same
+    table for a given (n, seed)."""
+    rng = np.random.default_rng(seed)
+    return {
+        "qty": rng.integers(1, 51, n),
+        "price": rng.integers(90000, 10500000, n),  # 900.00 .. 105000.00
+        "disc": rng.integers(0, 11, n),  # 0.00 .. 0.10
+        "ship": rng.integers(8400, 10600, n),
+        "rf": rng.integers(0, 3, n),
+        "ls": rng.integers(0, 2, n),
+    }
 
 
 def build_kvs(n: int, seed: int = 0):
-    """Vectorized fixture builder: rows share one fixed layout, so the whole
-    table is a byte matrix filled by batch codecs."""
-    from tikv_tpu.copr.table import RowBatchDecoder
+    """Vectorized KV fixture: rows share one fixed layout, so the whole
+    table is a byte matrix filled by batch codecs.  Used for cold trials
+    (real decode) and engine-region validations — bounded row counts."""
+    from tikv_tpu.copr.table import RowBatchDecoder, encode_row, record_key
     from tikv_tpu.util.codec import encode_i64_batch
 
-    rng = np.random.default_rng(seed)
-    qty = rng.integers(1, 51, n)
-    price = rng.integers(90000, 10500000, n)  # 900.00 .. 105000.00
-    disc = rng.integers(0, 11, n)  # 0.00 .. 0.10
-    ship = rng.integers(8400, 10600, n)
-    rf = rng.integers(0, 3, n)
-    ls = rng.integers(0, 2, n)
+    a = build_arrays(n, seed)
+    schema = _lineitem()
     flags = np.frombuffer(b"ANR", dtype=np.uint8)
     stats = np.frombuffer(b"FO", dtype=np.uint8)
-    non_handle = LINEITEM[1:]
+    non_handle = schema[1:]
     row0 = encode_row(non_handle, [1, 1, 1, 1, b"A", b"F"])
-    layout = RowBatchDecoder(LINEITEM)._parse_layout(row0)
+    layout = RowBatchDecoder(schema)._parse_layout(row0)
     mat = np.tile(np.frombuffer(row0, dtype=np.uint8), (n, 1))
-    for col_id, arr in ((2, qty), (3, price), (4, disc), (5, ship)):
+    for col_id, arr in ((2, a["qty"]), (3, a["price"]), (4, a["disc"]), (5, a["ship"])):
         _kind, off = layout["cols"][col_id]
         mat[:, off : off + 8] = encode_i64_batch(arr)
     _k, off_rf = layout["cols"][6]
     _k, off_ls = layout["cols"][7]
-    mat[:, off_rf] = flags[rf]
-    mat[:, off_ls] = stats[ls]
+    mat[:, off_rf] = flags[a["rf"]]
+    mat[:, off_ls] = stats[a["ls"]]
     values = [r.tobytes() for r in mat]
     kmat = np.tile(np.frombuffer(record_key(TABLE_ID, 0), dtype=np.uint8), (n, 1))
     kmat[:, 11:19] = encode_i64_batch(np.arange(n, dtype=np.int64))
@@ -174,9 +114,73 @@ def build_kvs(n: int, seed: int = 0):
     return list(zip(keys, values))
 
 
-def q6_dag() -> DagRequest:
+def build_cache(n: int, block_rows: int, seed: int = 0):
+    """The decoded-column image of build_kvs(n, seed) as a filled
+    ColumnBlockCache, WITHOUT materializing n Python byte objects — this is
+    what makes the 100M-row warm configuration buildable.  Layout must match
+    RowBatchDecoder exactly (fixture_selfcheck proves it block-for-block):
+    ints/decimals as int64 data, varchar as dictionary codes with ONE shared
+    dictionary object across blocks (the decoder's per-column dict cache
+    does the same — the device group-by fast path keys on identity)."""
+    from tikv_tpu.copr.cache import ColumnBlockCache
+    from tikv_tpu.copr.datatypes import Column, EvalType
+
+    a = build_arrays(n, seed)
+    # sorted unique byte values, as the decoder's np.unique produces them
+    dict_rf = np.empty(3, dtype=object)
+    dict_rf[:] = [b"A", b"N", b"R"]
+    dict_ls = np.empty(2, dtype=object)
+    dict_ls[:] = [b"F", b"O"]
+    handles = np.arange(n, dtype=np.int64)
+    cache = ColumnBlockCache()
+    for s in range(0, n, block_rows):
+        e = min(s + block_rows, n)
+        m = e - s
+        nz = [np.zeros(m, dtype=bool) for _ in range(7)]
+        cols = [
+            Column(EvalType.INT, handles[s:e], nz[0]),
+            Column(EvalType.INT, a["qty"][s:e], nz[1]),
+            Column(EvalType.DECIMAL, a["price"][s:e], nz[2], 2),
+            Column(EvalType.DECIMAL, a["disc"][s:e], nz[3], 2),
+            Column(EvalType.INT, a["ship"][s:e], nz[4]),
+            Column(EvalType.BYTES, a["rf"][s:e], nz[5], 0, dict_rf),
+            Column(EvalType.BYTES, a["ls"][s:e], nz[6], 0, dict_ls),
+        ]
+        cache.add(cols, m)
+    cache.filled = True
+    return cache
+
+
+def fixture_selfcheck(n: int = 65536) -> None:
+    """Prove build_cache == decode(build_kvs) column-for-column at one block,
+    so the 100M columnar fixture is a faithful stand-in for real decode."""
+    from tikv_tpu.copr.table import RowBatchDecoder, decode_record_handles
+
+    kvs = build_kvs(n, seed=0)
+    dec = RowBatchDecoder(_lineitem())
+    handles = decode_record_handles([k for k, _ in kvs])
+    decoded = dec.decode(handles, [v for _, v in kvs])
+    built = build_cache(n, block_rows=n, seed=0).blocks[0].cols
+    assert len(decoded) == len(built)
+    for i, (c, d) in enumerate(zip(decoded, built)):
+        assert c.eval_type == d.eval_type, i
+        assert np.array_equal(np.asarray(c.data), np.asarray(d.data)), i
+        assert np.array_equal(np.asarray(c.nulls), np.asarray(d.nulls)), i
+        assert c.frac == d.frac, i
+        cd = c.dictionary
+        dd = d.dictionary
+        assert (cd is None) == (dd is None), i
+        if cd is not None:
+            assert list(cd) == list(dd), i
+
+
+def q6_dag():
     # sum(l_extendedprice * l_discount) where shipdate in [y, y+365) and
     # discount between 0.02 and 0.04 and quantity < 24
+    from tikv_tpu.copr.aggr import AggDescriptor
+    from tikv_tpu.copr.dag import Aggregation, DagRequest, Selection, TableScan
+    from tikv_tpu.copr.rpn import call, col, const_decimal, const_int
+
     conds = [
         call("ge", col(4), const_int(9000)),
         call("lt", col(4), const_int(9365)),
@@ -185,12 +189,18 @@ def q6_dag() -> DagRequest:
         call("lt", col(1), const_int(24)),
     ]
     aggs = [AggDescriptor("sum", call("multiply", col(2), col(3)))]
-    return DagRequest(executors=[TableScan(TABLE_ID, LINEITEM), Selection(conds), Aggregation([], aggs)])
+    return DagRequest(
+        executors=[TableScan(TABLE_ID, _lineitem()), Selection(conds), Aggregation([], aggs)]
+    )
 
 
-def q1_dag() -> DagRequest:
+def q1_dag():
     # group by returnflag, linestatus: sum(qty), sum(price), avg(price),
     # avg(disc), count(*) where shipdate <= cutoff
+    from tikv_tpu.copr.aggr import AggDescriptor
+    from tikv_tpu.copr.dag import Aggregation, DagRequest, Selection, TableScan
+    from tikv_tpu.copr.rpn import call, col, const_int
+
     conds = [call("le", col(4), const_int(10500))]
     aggs = [
         AggDescriptor("sum", col(1)),
@@ -201,35 +211,150 @@ def q1_dag() -> DagRequest:
     ]
     return DagRequest(
         executors=[
-            TableScan(TABLE_ID, LINEITEM),
+            TableScan(TABLE_ID, _lineitem()),
             Selection(conds),
             Aggregation([col(5), col(6)], aggs),
         ]
     )
 
 
-def run_cpu(dag, kvs, cache=None):
+_DAGS = {"q6": q6_dag, "q1": q1_dag}
+
+
+def run_cpu(dag, kvs=None, cache=None):
+    """The CPU read-pool pipeline (BatchExecutorsRunner) over either real KV
+    bytes or the shared block cache."""
+    from tikv_tpu.copr.dag import BatchExecutorsRunner
+    from tikv_tpu.copr.executors import CachedBlocksExecutor, FixtureScanSource
+
     t0 = time.perf_counter()
-    leaf = CachedBlocksExecutor(cache, LINEITEM) if cache is not None else None
+    leaf = CachedBlocksExecutor(cache, _lineitem()) if cache is not None else None
     src = None if cache is not None else FixtureScanSource(kvs)
     resp = BatchExecutorsRunner(dag, src, leaf=leaf).handle_request()
     return resp, time.perf_counter() - t0
 
 
-def run_tpu(ev, kvs, cache=None):
+# ---------------------------------------------------------------------------
+# Device-side operations.  These run inside the worker subprocess when the
+# device backend is up, or in-process (after _force_cpu) on fallback — same
+# code either way, so the fallback measures exactly what the device would.
+# ---------------------------------------------------------------------------
+
+
+def _op_build(req, state):
+    from tikv_tpu.copr.jax_eval import JaxDagEvaluator, supports
+
+    n = req["rows"]
+    block_rows = req["block_rows"]
     t0 = time.perf_counter()
-    src = None if (cache is not None and cache.filled) else FixtureScanSource(kvs)
-    resp = ev.run(src, cache=cache)
-    return resp, time.perf_counter() - t0
+    if state.get("cache_key") != (n, block_rows, req.get("seed", 0)):
+        # the in-process CPU fallback pre-seeds the parent's cache under
+        # this key so the 100M-row fixture is never built twice in one RSS
+        state["cache"] = build_cache(n, block_rows, seed=req.get("seed", 0))
+        state["cache_key"] = (n, block_rows, req.get("seed", 0))
+    build_s = time.perf_counter() - t0
+    state["rows"] = n
+    state["block_rows"] = block_rows
+    state["evs"] = {}
+    for name, dag_fn in _DAGS.items():
+        dag = dag_fn()
+        assert supports(dag), f"{name} must be device-eligible"
+        state["evs"][name] = JaxDagEvaluator(dag, block_rows=block_rows)
+    return {"build_s": round(build_s, 2)}
 
 
-def bench_endpoint_topn(n=200_000):
-    """Endpoint-driven device TopN over a real MVCC region: proves the device
-    top-K merge runs on the actual accelerator behind the full request path
-    (handle_request → MvccBatchScanSource → JaxDagEvaluator), with zero CPU
-    fallbacks and bytes identical to the CPU pipeline."""
-    from tikv_tpu.copr.dag import TopN
+def _op_warm(req, state):
+    """Best-of-N warm trials over the HBM-pinned block cache."""
+    ev = state["evs"][req["q"]]
+    cache = state["cache"]
+    ev.run(None, cache=cache)  # compile + pin device arrays
+    ts = []
+    for _ in range(req.get("trials", 3)):
+        t0 = time.perf_counter()
+        resp = ev.run(None, cache=cache)
+        ts.append(time.perf_counter() - t0)
+    return {"ts": ts, "resp": resp.encode().hex()}
+
+
+def _op_batch(req, state):
+    """K queries fused into one device program (the batch_commands /
+    batch_coprocessor serving pattern)."""
+    from tikv_tpu.copr.jax_eval import JaxDagEvaluator, run_batch_cached
+
+    k = req["k"]
+    cache = state["cache"]
+    block_rows = state["block_rows"]
+    evs = []
+    for name, dag_fn in _DAGS.items():
+        for _ in range(k // 2):
+            evs.append(JaxDagEvaluator(dag_fn(), block_rows=block_rows))
+    run_batch_cached(evs, cache)  # compile warmup
+    ts = []
+    for _ in range(req.get("trials", 2)):
+        t0 = time.perf_counter()
+        resps = run_batch_cached(evs, cache)
+        ts.append(time.perf_counter() - t0)
+    return {"ts": ts, "resps": [r.encode().hex() for r in resps], "queries": len(evs)}
+
+
+def _op_cold(req, state):
+    """Scan + decode + execute from real KV bytes (no cache)."""
+    from tikv_tpu.copr.executors import FixtureScanSource
+    from tikv_tpu.copr.jax_eval import JaxDagEvaluator
+
+    n = req["rows"]
+    kvs = state.get("cold_kvs")
+    if kvs is None or state.get("cold_rows") != n:
+        kvs = state["cold_kvs"] = build_kvs(n, seed=req.get("seed", 1))
+        state["cold_rows"] = n
+    ev = JaxDagEvaluator(_DAGS[req["q"]](), block_rows=state["block_rows"])
+    if req.get("warmup"):
+        ev.run(FixtureScanSource(kvs[: state["block_rows"]]))
+    t0 = time.perf_counter()
+    resp = ev.run(FixtureScanSource(kvs))
+    return {"t": time.perf_counter() - t0, "resp": resp.encode().hex()}
+
+
+def _op_mvcc(req, state):
+    """BASELINE config-4 flavor: Q6 over a real MVCC region on the native
+    engine, through the batched MVCC decode leaf."""
+    from tikv_tpu.copr.jax_eval import JaxDagEvaluator
+    from tikv_tpu.copr.mvcc_batch import MvccBatchScanSource
+    from tikv_tpu.copr.table import record_range
+    from tikv_tpu.storage.engine import CF_WRITE
+    from tikv_tpu.storage.txn_types import Key, Write, WriteType
+
+    n = req["rows"]
+    kvs = build_kvs(n, seed=3)
+    try:
+        from tikv_tpu.native.engine import NativeEngine, native_available
+
+        eng = NativeEngine() if native_available() else None
+    except ImportError:
+        eng = None
+    if eng is None:
+        from tikv_tpu.storage.btree_engine import BTreeEngine
+
+        eng = BTreeEngine()
+    items = []
+    for rk, v in kvs:
+        items.append(
+            (Key.from_raw(rk).append_ts(20).encoded, Write(WriteType.PUT, 10, short_value=v).to_bytes())
+        )
+    eng.bulk_load(CF_WRITE, items)
+    ev = JaxDagEvaluator(q6_dag(), block_rows=state.get("block_rows", 1 << 17))
+    src = MvccBatchScanSource(eng.snapshot(), ts=100, ranges=[record_range(TABLE_ID)])
+    t0 = time.perf_counter()
+    resp = ev.run(src)
+    return {"t": time.perf_counter() - t0, "resp": resp.encode().hex()}
+
+
+def _topn_endpoint(n: int, enable_device: bool):
+    """ONE definition of the TopN validation fixture + plan, shared by the
+    device op and the CPU oracle so they can never drift apart."""
+    from tikv_tpu.copr.dag import DagRequest, Selection, TableScan, TopN
     from tikv_tpu.copr.endpoint import CoprRequest, Endpoint
+    from tikv_tpu.copr.rpn import call, col, const_int
     from tikv_tpu.copr.table import record_range
     from tikv_tpu.storage.btree_engine import BTreeEngine
     from tikv_tpu.storage.engine import CF_WRITE
@@ -240,197 +365,453 @@ def bench_endpoint_topn(n=200_000):
     eng = BTreeEngine()
     items = []
     for rk, v in kvs:
-        items.append((Key.from_raw(rk).append_ts(20).encoded,
-                      Write(WriteType.PUT, 10, short_value=v).to_bytes()))
+        items.append(
+            (Key.from_raw(rk).append_ts(20).encoded, Write(WriteType.PUT, 10, short_value=v).to_bytes())
+        )
     eng.bulk_load(CF_WRITE, items)
-    # order by price desc, qty asc, top 100 — raw TopN device merge path.
-    # Numeric columns only: the device TopN ships every schema column as
-    # payload state and bytes columns are (correctly) gated off-device.
-    dag = lambda: DagRequest(executors=[
-        TableScan(TABLE_ID, LINEITEM[:5]),
-        Selection([call("le", col(4), const_int(10500))]),
-        TopN([(col(2), True), (col(1), False)], 100),
-    ])
+    schema = _lineitem()
+
+    def dag():
+        return DagRequest(
+            executors=[
+                TableScan(TABLE_ID, schema[:5]),
+                Selection([call("le", col(4), const_int(10500))]),
+                TopN([(col(2), True), (col(1), False)], 100),
+            ]
+        )
+
+    ep = Endpoint(LocalEngine(eng), enable_device=enable_device)
+    return ep, dag, lambda: CoprRequest(103, dag(), [record_range(TABLE_ID)], 100)
+
+
+def _op_topn(req, state):
+    """Endpoint-driven device TopN over a real MVCC region: proves the
+    device top-K merge runs behind the full request path with zero CPU
+    fallbacks."""
+    from tikv_tpu.copr.jax_eval import supports
+
+    ep, dag, req_of = _topn_endpoint(req["rows"], enable_device=True)
     assert supports(dag()), "TopN plan must be device-eligible"
-    ep = Endpoint(LocalEngine(eng), enable_device=True)
-    ep_cpu = Endpoint(LocalEngine(eng), enable_device=False)
-    req = lambda: CoprRequest(103, dag(), [record_range(TABLE_ID)], ts := 100)
-    r_warm = ep.handle_request(req())  # compile warmup
+    r_warm = ep.handle_request(req_of())  # compile warmup
     t0 = time.perf_counter()
-    r_dev = ep.handle_request(req())
+    r_dev = ep.handle_request(req_of())
     dt = time.perf_counter() - t0
-    r_cpu = ep_cpu.handle_request(req())
-    assert r_dev.from_device, f"TopN fell off device: {ep.last_device_error}"
-    assert ep.device_fallbacks == 0, ep.last_device_error
-    assert r_dev.data == r_cpu.data == r_warm.data, "TopN device/CPU mismatch"
-    return n / dt
+    return {
+        "t": dt,
+        "resp": r_dev.data.hex(),
+        "warm_resp": r_warm.data.hex(),
+        "from_device": bool(r_dev.from_device),
+        "fallbacks": ep.device_fallbacks,
+        "err": str(ep.last_device_error or ""),
+    }
 
 
-def bench_mvcc_validation(n=200_000):
-    """BASELINE config-4 flavor: the same DAG over a real MVCC region."""
-    from tikv_tpu.copr.mvcc_batch import MvccBatchScanSource
-    from tikv_tpu.copr.table import record_range
-    from tikv_tpu.storage.btree_engine import BTreeEngine
-    from tikv_tpu.storage.engine import CF_WRITE, WriteBatch
-    from tikv_tpu.storage.txn_types import Key, Write, WriteType
+_OPS = {
+    "build": _op_build,
+    "warm": _op_warm,
+    "batch": _op_batch,
+    "cold": _op_cold,
+    "mvcc": _op_mvcc,
+    "topn": _op_topn,
+}
 
-    kvs = build_kvs(n, seed=3)
+
+# ---------------------------------------------------------------------------
+# Worker subprocess
+# ---------------------------------------------------------------------------
+
+
+def _worker_main() -> None:
+    t0 = time.time()
+
+    def emit(obj):
+        sys.stdout.write(json.dumps(obj) + "\n")
+        sys.stdout.flush()
+
+    stop_hb = threading.Event()
+
+    def hb():
+        while not stop_hb.wait(10.0):
+            emit({"ev": "init_wait", "t": round(time.time() - t0, 1)})
+
+    threading.Thread(target=hb, daemon=True).start()
+    import jax
+
     try:
-        from tikv_tpu.native.engine import NativeEngine, native_available
+        # AOT persistence: compiled programs survive across bench runs, so
+        # cold trials stop paying XLA compilation on every invocation
+        jax.config.update("jax_compilation_cache_dir", _JAX_CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # noqa: BLE001 — older jax: cache is an optimization
+        pass
+    import jax.numpy as jnp
 
-        eng = NativeEngine() if native_available() else BTreeEngine()
-    except ImportError:
-        eng = BTreeEngine()
-    items = []
-    for rk, v in kvs:
-        k = Key.from_raw(rk)
-        items.append((k.append_ts(20).encoded, Write(WriteType.PUT, 10, short_value=v).to_bytes()))
-    eng.bulk_load(CF_WRITE, items)
-    rng = record_range(TABLE_ID)
-    dag = q6_dag()
-    src = MvccBatchScanSource(eng.snapshot(), ts=100, ranges=[rng])
-    t0 = time.perf_counter()
-    resp = JaxDagEvaluator(dag).run(src)
-    dt = time.perf_counter() - t0
-    cpu_resp, _ = run_cpu(q6_dag(), kvs)
-    assert resp.encode() == cpu_resp.encode(), "MVCC-leaf response mismatch"
-    return n / dt
+    x = jnp.ones((256, 256), jnp.float32)
+    (x @ x).block_until_ready()  # backend init — the step that hangs/fails
+    stop_hb.set()
+    emit({"ev": "ready", "platform": jax.devices()[0].platform, "t": round(time.time() - t0, 1)})
+    state: dict = {}
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        req = json.loads(line)
+        if req.get("op") == "quit":
+            emit({"id": req.get("id"), "ok": True})
+            break
+        try:
+            out = _OPS[req["op"]](req, state)
+            out["id"] = req.get("id")
+            out["ok"] = True
+        except Exception as e:  # noqa: BLE001 — parent decides what is fatal
+            import traceback
+
+            out = {
+                "id": req.get("id"),
+                "ok": False,
+                "err": f"{type(e).__name__}: {e}",
+                "tb": traceback.format_exc()[-2000:],
+            }
+        emit(out)
 
 
-def main():
-    n = int(os.environ.get("BENCH_ROWS", "8000000"))
+class WorkerDied(RuntimeError):
+    pass
+
+
+class DeviceWorker:
+    """Parent-side handle on the persistent device worker."""
+
+    def __init__(self, timeline: list):
+        self.timeline = timeline
+        self.t0 = time.time()
+        env = {k: v for k, v in os.environ.items()}
+        env.pop("JAX_PLATFORMS", None)  # sitecustomize re-exports the device
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=None,  # worker stderr goes straight to ours
+            text=True,
+            start_new_session=True,
+            env=env,
+        )
+        self._mark("spawn")
+        self.platform = None
+        self._q: queue.Queue = queue.Queue()
+        self._seq = 0
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def _mark(self, ev, **kw):
+        entry = {"t": round(time.time() - self.t0, 1), "ev": ev, **kw}
+        self.timeline.append(entry)
+        print(f"bench: [{entry['t']:7.1f}s] {ev} {kw if kw else ''}", file=sys.stderr)
+
+    def _read_loop(self):
+        for line in self.proc.stdout:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                self._q.put(json.loads(line))
+            except ValueError:
+                continue
+        self._q.put({"ev": "eof"})
+
+    def wait_ready(self, budget_s: float) -> str:
+        """'ready' | 'died' (respawnable: init failed fast or slow) |
+        'timeout' (budget gone)."""
+        deadline = time.time() + budget_s
+        while True:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                self._mark("init_budget_exhausted", budget_s=budget_s)
+                return "timeout"
+            try:
+                msg = self._q.get(timeout=min(remaining, 30.0))
+            except queue.Empty:
+                continue
+            ev = msg.get("ev")
+            if ev == "init_wait":
+                self._mark("worker_init_wait", worker_t=msg.get("t"))
+            elif ev == "ready":
+                self.platform = msg.get("platform")
+                self._mark("ready", platform=self.platform, worker_t=msg.get("t"))
+                return "ready"
+            elif ev == "eof":
+                self._mark("worker_died_at_init", rc=self.proc.poll())
+                return "died"
+
+    def call(self, op: str, timeout: float | None = None, **kw) -> dict:
+        if timeout is None:
+            timeout = float(os.environ.get("BENCH_OP_TIMEOUT", "1800"))
+        self._seq += 1
+        req = {"op": op, "id": self._seq, **kw}
+        try:
+            self.proc.stdin.write(json.dumps(req) + "\n")
+            self.proc.stdin.flush()
+        except (BrokenPipeError, OSError) as e:
+            raise WorkerDied(f"worker stdin closed: {e}") from e
+        deadline = time.time() + timeout
+        while True:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                self.kill()
+                raise WorkerDied(f"op {op!r} timed out after {timeout:.0f}s")
+            try:
+                msg = self._q.get(timeout=min(remaining, 30.0))
+            except queue.Empty:
+                continue
+            if msg.get("ev") == "eof":
+                raise WorkerDied(f"worker exited during op {op!r} (rc={self.proc.poll()})")
+            if msg.get("ev") == "init_wait":
+                continue
+            if msg.get("id") != self._seq:
+                continue
+            if not msg.get("ok"):
+                raise WorkerDied(f"op {op!r} failed in worker: {msg.get('err')}\n{msg.get('tb', '')}")
+            return msg
+
+    def kill(self):
+        try:
+            os.killpg(self.proc.pid, signal.SIGKILL)
+        except OSError:
+            try:
+                self.proc.kill()
+            except OSError:
+                pass
+        self._mark("worker_killed")
+
+
+class LocalDevice:
+    """In-process fallback: the same ops on the CPU backend.  Keeps the two
+    code paths identical so a fallback run still measures JAX-vs-pipeline —
+    just labeled cpu_fallback, never attested under the TPU metric name."""
+
+    platform = "cpu_fallback"
+
+    def __init__(self):
+        self.state: dict = {}
+
+    def call(self, op: str, timeout: float | None = None, **kw) -> dict:
+        out = _OPS[op]({"op": op, **kw}, self.state)
+        out["ok"] = True
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Parent driver
+# ---------------------------------------------------------------------------
+
+
+def main() -> None:
+    timeline: list = [{"t": 0.0, "ev": "start"}]
+    n = int(os.environ.get("BENCH_ROWS", "100000000"))
     n_cold = min(n, int(os.environ.get("BENCH_COLD_ROWS", "1000000")))
-    block_rows = int(os.environ.get("BENCH_BLOCK_ROWS", str(1 << 17)))
+    block_rows = int(os.environ.get("BENCH_BLOCK_ROWS", str(1 << 21)))
+    n_mvcc = int(os.environ.get("BENCH_MVCC_ROWS", "200000"))
+    K = int(os.environ.get("BENCH_BATCH", "16"))
+    budget_s = float(os.environ.get("BENCH_INIT_BUDGET", "900"))
+    force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
+
+    worker = None if force_cpu else DeviceWorker(timeline)
+
+    # ---- CPU side, fully overlapped with worker backend init -------------
+    _force_cpu()
+    t0 = time.time()
+    fixture_selfcheck()
+    timeline.append({"t": round(time.time() - t0, 1), "ev": "selfcheck_ok"})
     t_build = time.perf_counter()
-    kvs = build_kvs(n)
+    cache = build_cache(n, block_rows)
     build_s = time.perf_counter() - t_build
+    timeline.append({"t": round(time.time() - t0, 1), "ev": "cpu_cache_built", "s": round(build_s, 1)})
 
-    results = {}
-    speedups = []
-    cache = ColumnBlockCache()
-    for name, dag_fn in (("q6", q6_dag), ("q1", q1_dag)):
-        dag = dag_fn()
-        assert supports(dag), f"{name} must be device-eligible"
-        ev = JaxDagEvaluator(dag, block_rows=block_rows)
-        # warmup/compile on a small prefix
-        run_tpu(ev, kvs[:block_rows])
-        # cold: scan + decode + execute, both paths (bounded subset)
-        cpu_resp_c, cpu_cold_t = run_cpu(dag_fn(), kvs[:n_cold])
-        tpu_resp_c, tpu_cold_t = run_tpu(ev, kvs[:n_cold])
-        if tpu_resp_c.encode() != cpu_resp_c.encode():
-            print(json.dumps({"metric": f"{name}_COLD_MISMATCH", "value": 0, "unit": "rows/sec", "vs_baseline": 0}))
-            sys.exit(1)
-        cpu_resp, _ = run_cpu(dag_fn(), kvs)
-        # warm: both paths read the same decoded block cache (the serving
-        # steady state — TiKV's cop-cache analog); device arrays pinned in
-        # HBM.  Like-for-like trials: best-of-3 on BOTH paths.
-        run_tpu(ev, kvs, cache=cache)  # fills cache + pins device arrays
-        best_cpu_warm = float("inf")
+    cpu = {}
+    for name in ("q6", "q1"):
+        best = float("inf")
         for _ in range(3):
-            cpu_w, cpu_warm_t = run_cpu(dag_fn(), kvs, cache=cache)
-            best_cpu_warm = min(best_cpu_warm, cpu_warm_t)
-        cpu_warm_t = best_cpu_warm
-        best_warm = float("inf")
-        for _ in range(3):
-            tpu_w, tpu_warm_t = run_tpu(ev, kvs, cache=cache)
-            best_warm = min(best_warm, tpu_warm_t)
-        if tpu_w.encode() != cpu_w.encode() or tpu_w.encode() != cpu_resp.encode():
-            print(json.dumps({"metric": f"{name}_WARM_MISMATCH", "value": 0, "unit": "rows/sec", "vs_baseline": 0}))
-            sys.exit(1)
-        results[name] = {
-            "cpu_cold_rows_per_s": n_cold / cpu_cold_t,
-            "tpu_cold_rows_per_s": n_cold / tpu_cold_t,
-            "cold_speedup": cpu_cold_t / tpu_cold_t,
-            "cpu_warm_rows_per_s": n / cpu_warm_t,
-            "tpu_warm_rows_per_s": n / best_warm,
-            "warm_speedup": cpu_warm_t / best_warm,
-        }
-        speedups.append(cpu_warm_t / best_warm)
-
-    # throughput under concurrent load: K queries fused into one device
-    # program (the batch_commands / batch_coprocessor serving pattern) vs the
-    # CPU pipeline answering the same K queries over the same cache on a
-    # thread pool sized to the machine (like-for-like: both sides use their
-    # natural concurrency mechanism, and both take best-of-3 trials).
+            resp, dt = run_cpu(_DAGS[name](), cache=cache)
+            best = min(best, dt)
+        cpu[f"{name}_warm"] = (resp.encode(), best)
+    kvs_cold = build_kvs(n_cold, seed=1)
+    for name in ("q6", "q1"):
+        resp, dt = run_cpu(_DAGS[name](), kvs=kvs_cold)
+        cpu[f"{name}_cold"] = (resp.encode(), dt)
+    # K-query serving batch on the CPU pipeline (1 worker per core)
     from concurrent.futures import ThreadPoolExecutor
 
-    K = int(os.environ.get("BENCH_BATCH", "16"))
     cpu_workers = min(K, os.cpu_count() or 1)
-    evs = []
-    for name, dag_fn in (("q6", q6_dag), ("q1", q1_dag)):
-        ev = JaxDagEvaluator(dag_fn(), block_rows=block_rows)
-        evs.append((name, dag_fn, ev))
-    batch = [(n, d, e) for (n, d, e) in evs for _ in range(K // 2)]
-    run_batch_cached([e for _, _, e in batch], cache)  # compile warmup
-    tpu_batch_t = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        resps = run_batch_cached([e for _, _, e in batch], cache)
-        tpu_batch_t = min(tpu_batch_t, time.perf_counter() - t0)
+    batch_dags = [name for name in ("q6", "q1") for _ in range(K // 2)]
     cpu_batch_t = float("inf")
     with ThreadPoolExecutor(max_workers=cpu_workers) as pool:
-        for _ in range(3):
-            t0 = time.perf_counter()
-            cpu_resps = list(pool.map(
-                lambda args: run_cpu(args[1](), kvs, cache=cache)[0], batch))
-            cpu_batch_t = min(cpu_batch_t, time.perf_counter() - t0)
-    for r, c in zip(resps, cpu_resps):
-        if r.encode() != c.encode():
-            print(json.dumps({"metric": "BATCH_MISMATCH", "value": 0, "unit": "rows/sec", "vs_baseline": 0}))
-            sys.exit(1)
-    total_rows = n * len(batch)
+        for _ in range(2):
+            bt0 = time.perf_counter()
+            cpu_batch_resps = list(
+                pool.map(lambda name: run_cpu(_DAGS[name](), cache=cache)[0].encode(), batch_dags)
+            )
+            cpu_batch_t = min(cpu_batch_t, time.perf_counter() - bt0)
+    timeline.append({"t": round(time.time() - t0, 1), "ev": "cpu_trials_done"})
+    # CPU checks for the engine-backed validations
+    kvs_mvcc = build_kvs(n_mvcc, seed=3)
+    mvcc_cpu = run_cpu(q6_dag(), kvs=kvs_mvcc)[0].encode()
+    del kvs_mvcc
+    timeline.append({"t": round(time.time() - t0, 1), "ev": "cpu_mvcc_oracle_done"})
+
+    # ---- device side -----------------------------------------------------
+    # the tunnel backend is known to either hang for many minutes or die
+    # with UNAVAILABLE after a long stall; a dead worker is respawned (JAX
+    # caches the init failure per-process) until the budget is spent
+    backend = "cpu_fallback"
+    if worker is not None:
+        deadline = worker.t0 + budget_s
+        while True:
+            outcome = worker.wait_ready(max(deadline - time.time(), 60.0))
+            if outcome == "ready":
+                backend = worker.platform or "unknown"
+                break
+            worker.kill()
+            if outcome == "died" and time.time() < deadline:
+                worker = DeviceWorker(timeline)
+                worker.t0 = deadline - budget_s  # keep the global deadline
+                continue
+            worker = None
+            break
+    dev = worker if worker is not None else LocalDevice()
+    if isinstance(dev, LocalDevice):
+        print("bench: device backend unrecoverable — running on CPU", file=sys.stderr)
+        # share the parent's fixtures instead of rebuilding them in-process
+        dev.state["cache"] = cache
+        dev.state["cache_key"] = (n, block_rows, 0)
+        dev.state["cold_kvs"] = kvs_cold
+        dev.state["cold_rows"] = n_cold
+    else:
+        # the worker builds its own copies; drop the parent's (~GBs at 100M
+        # rows) so the two processes don't both hold the full fixture
+        del cache, kvs_cold
+
+    results: dict = {}
+
+    def _mark(ev, **kw):
+        entry = {"t": round(time.time() - t0, 1), "ev": ev, **kw}
+        timeline.append(entry)
+        print(f"bench: [{entry['t']:7.1f}s] {ev} {kw if kw else ''}", file=sys.stderr)
+
+    r = dev.call("build", rows=n, block_rows=block_rows)
+    _mark("device_cache_built", s=r.get("build_s"))
+    for name in ("q6", "q1"):
+        r = dev.call("warm", q=name, trials=3)
+        want, cpu_t = cpu[f"{name}_warm"]
+        if bytes.fromhex(r["resp"]) != want:
+            _fail(f"{name}_WARM_MISMATCH")
+        dev_t = min(r["ts"])
+        results[f"{name}_cpu_warm_rows_per_s"] = n / cpu_t
+        results[f"{name}_tpu_warm_rows_per_s"] = n / dev_t
+        results[f"{name}_warm_speedup"] = cpu_t / dev_t
+        _mark(f"warm_{name}", speedup=round(cpu_t / dev_t, 2))
+    for name in ("q6", "q1"):
+        # both queries get a one-block compile warmup so cold numbers
+        # measure scan+decode+execute, not XLA compilation, symmetrically
+        r = dev.call("cold", q=name, rows=n_cold, warmup=True)
+        want, cpu_t = cpu[f"{name}_cold"]
+        if bytes.fromhex(r["resp"]) != want:
+            _fail(f"{name}_COLD_MISMATCH")
+        results[f"{name}_cpu_cold_rows_per_s"] = n_cold / cpu_t
+        results[f"{name}_tpu_cold_rows_per_s"] = n_cold / r["t"]
+        results[f"{name}_cold_speedup"] = cpu_t / r["t"]
+        _mark(f"cold_{name}", speedup=round(cpu_t / r["t"], 2))
+    r = dev.call("batch", k=K, trials=2)
+    for got_hex, want in zip(r["resps"], cpu_batch_resps):
+        if bytes.fromhex(got_hex) != want:
+            _fail("BATCH_MISMATCH")
+    tpu_batch_t = min(r["ts"])
+    total_rows = n * r["queries"]
     batch_speedup = cpu_batch_t / tpu_batch_t
-    results["batch"] = {
-        "queries": len(batch),
-        "cpu_workers": cpu_workers,
-        "cpu_rows_per_s": total_rows / cpu_batch_t,
-        "tpu_rows_per_s": total_rows / tpu_batch_t,
-        "speedup": batch_speedup,
-    }
+    results["batch_queries"] = r["queries"]
+    results["batch_cpu_workers"] = cpu_workers
+    results["batch_cpu_rows_per_s"] = total_rows / cpu_batch_t
+    results["batch_tpu_rows_per_s"] = total_rows / tpu_batch_t
+    results["batch_speedup"] = batch_speedup
+    _mark("batch", speedup=round(batch_speedup, 2))
 
-    mvcc_rows_s = None
-    topn_rows_s = None
     if os.environ.get("BENCH_MVCC", "1") != "0":
-        mvcc_rows_s = bench_mvcc_validation()
-        topn_rows_s = bench_endpoint_topn()
+        try:
+            r = dev.call("mvcc", rows=n_mvcc)
+            if bytes.fromhex(r["resp"]) != mvcc_cpu:
+                _fail("MVCC_MISMATCH")
+            results["mvcc_q6_rows_per_s"] = n_mvcc / r["t"]
+            _mark("mvcc_ok")
+            r = dev.call("topn", rows=n_mvcc)
+            assert r["from_device"] and r["fallbacks"] == 0, r.get("err")
+            assert r["resp"] == r["warm_resp"], "TopN warm/steady mismatch"
+            # CPU endpoint oracle
+            topn_cpu = _topn_cpu_oracle(n_mvcc)
+            if bytes.fromhex(r["resp"]) != topn_cpu:
+                _fail("TOPN_MISMATCH")
+            results["endpoint_topn_device_rows_per_s"] = n_mvcc / r["t"]
+            _mark("topn_ok")
+        except (WorkerDied, AssertionError) as e:
+            # auxiliary validations must not zero out the headline metric
+            results["aux_error"] = str(e)[:300]
+            _mark("aux_error", err=str(e)[:120])
 
-    geo = float(np.exp(np.mean(np.log(speedups))))
-    tpu_rows = results["batch"]["tpu_rows_per_s"]
+    if worker is not None:
+        try:
+            worker.call("quit", timeout=10)
+        except WorkerDied:
+            pass
+
+    geo = float(
+        np.exp(np.mean(np.log([results["q6_warm_speedup"], results["q1_warm_speedup"]])))
+    )
     detail = {
         "rows": n,
-        "backend": _BACKEND,
+        "cold_rows": n_cold,
+        "block_rows": block_rows,
+        "backend": backend,
         "build_s": round(build_s, 2),
         "warm_geo_speedup": round(geo, 3),
-        **{f"{k}_{m}": round(v2, 1) for k, r in results.items() for m, v2 in r.items()},
+        **{k: (round(v, 1) if isinstance(v, float) else v) for k, v in results.items()},
+        "probe_timeline": timeline,
     }
-    if mvcc_rows_s:
-        detail["mvcc_q6_rows_per_s"] = round(mvcc_rows_s, 1)
-    if topn_rows_s:
-        detail["endpoint_topn_device_rows_per_s"] = round(topn_rows_s, 1)
     print(json.dumps(detail), file=sys.stderr)
     metric = "copr_q1q6_batched_tpu_rows_per_sec"
-    if _BACKEND.startswith("cpu"):
-        # no device backend (tunnel down or CPU-only host): CPU-vs-CPU number,
-        # never attested under the TPU metric name
+    if backend.startswith("cpu"):
+        # no device backend (tunnel down or CPU-only host): CPU-vs-CPU
+        # number, never attested under the TPU metric name
         metric += "_cpu_fallback"
     print(
         json.dumps(
             {
                 "metric": metric,
-                "value": round(tpu_rows, 1),
+                "value": round(results["batch_tpu_rows_per_s"], 1),
                 "unit": "rows/sec",
-                "vs_baseline": round(batch_speedup, 3),
+                "vs_baseline": round(results["batch_speedup"], 3),
             }
         )
     )
 
 
+def _topn_cpu_oracle(n: int) -> bytes:
+    """CPU endpoint result for the TopN validation (same fixture as _op_topn)."""
+    ep, _dag, req_of = _topn_endpoint(n, enable_device=False)
+    return ep.handle_request(req_of()).data
+
+
+def _fail(tag: str) -> None:
+    print(json.dumps({"metric": tag, "value": 0, "unit": "rows/sec", "vs_baseline": 0}))
+    sys.exit(1)
+
+
 if __name__ == "__main__":
+    if "--worker" in sys.argv:
+        _worker_main()
+        sys.exit(0)
     try:
         main()
     except SystemExit:
         raise
-    except Exception as e:  # noqa: BLE001 — the driver needs a parsed JSON line, not a traceback
+    except Exception as e:  # noqa: BLE001 — the driver needs a parsed JSON line
         import traceback
 
         traceback.print_exc()

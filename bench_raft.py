@@ -29,14 +29,25 @@ from tikv_tpu.server.node import FIRST_REGION_ID, Node
 from tikv_tpu.storage.engine import WriteBatch
 
 
-def run_config(pipelined: bool, n_ops: int, batch: int) -> float:
+def run_config(pipelined: bool, n_ops: int, batch: int, raft_log: bool = False) -> float:
     from tikv_tpu.native.engine import NativeEngine, native_available
 
     tmp = tempfile.mkdtemp()
     engine = NativeEngine(path=f"{tmp}/db") if native_available() else None
+    rl = None
+    if raft_log:
+        from tikv_tpu.native.raftlog import NativeRaftLog, raftlog_available
+
+        if raftlog_available():
+            rl = NativeRaftLog(f"{tmp}/raftlog")
     pd = MockPd()
     transport = ChannelTransport()
-    node = Node(pd, transport, engine=engine)
+    node = Node(pd, transport, engine=engine, raft_log=rl)
+    if rl is not None and engine is not None:
+        # reference sync-log split: entries durable in the log engine,
+        # apply writes buffered, kvdb flushed before purge (store.py)
+        engine.set_sync(False)
+        node.store.kv_buffered = True
     if not pipelined:
         node.store.stop_apply_pipeline()
     transport.register(node.store)
@@ -94,16 +105,25 @@ def run_config(pipelined: bool, n_ops: int, batch: int) -> float:
 def main() -> None:
     n = int(os.environ.get("BENCH_RAFT_N", "2000"))
     batch = int(os.environ.get("BENCH_RAFT_BATCH", "64"))
+    from tikv_tpu.native.raftlog import raftlog_available
+
     inline = run_config(False, n, batch)
     pipe = run_config(True, n, batch)
+    have_rlog = raftlog_available()
+    # never attest the raftlog configuration when it silently fell back
+    rlog = run_config(True, n, batch, raft_log=True) if have_rlog else pipe
     print(
         json.dumps(
             {
-                "metric": "raft_write_path_proposals_per_sec",
-                "value": round(pipe, 1),
+                "metric": "raft_write_path_proposals_per_sec"
+                + ("" if have_rlog else "_no_raftlog"),
+                "value": round(rlog, 1),
                 "unit": "proposals/sec",
                 "inline_per_sec": round(inline, 1),
+                "pipeline_per_sec": round(pipe, 1),
+                "raftlog_per_sec": round(rlog, 1),
                 "pipeline_speedup": round(pipe / inline, 3),
+                "raftlog_speedup_vs_pipeline": round(rlog / pipe, 3),
                 "ops": n,
                 "inflight": batch,
             }

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import urllib.request
 
@@ -110,8 +111,16 @@ def main(argv=None) -> int:
         from tikv_tpu.server.debug import Debugger
 
         eng = NativeEngine(path=args.db)
+        rlog = None
+        rlog_dir = os.path.join(args.db, "raftlog")
+        if os.path.isdir(rlog_dir):
+            # the store ran with the log engine: region surgery must reach it
+            from tikv_tpu.native.raftlog import NativeRaftLog, raftlog_available
+
+            if raftlog_available():
+                rlog = NativeRaftLog(rlog_dir)
         try:
-            dbg = Debugger(eng)
+            dbg = Debugger(eng, raft_log=rlog)
             if args.cmd == "unsafe-recover":
                 failed = {int(s) for s in args.stores.split(",")}
                 modified = dbg.unsafe_recover(failed)
@@ -131,6 +140,8 @@ def main(argv=None) -> int:
             return 0
         finally:
             eng.close()
+            if rlog is not None:
+                rlog.close()
 
     if args.cmd in ("metrics", "config", "reconfig"):
         if not args.status:

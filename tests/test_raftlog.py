@@ -1,0 +1,326 @@
+"""Native raft log engine (native/raftlog.cc): the purpose-built WAL store
+for raft entries — segmented appends, conflict truncation, purge + segment
+GC, rewrite of live tails, crash recovery (raft_log_engine/src/engine.rs)."""
+
+import os
+import threading
+
+import pytest
+
+from tikv_tpu.native.raftlog import NativeRaftLog, raftlog_available
+
+pytestmark = pytest.mark.skipif(not raftlog_available(), reason="g++/native unavailable")
+
+
+def _open(tmp_path, **kw):
+    kw.setdefault("sync", False)  # fdatasync off for speed; durability test opts in
+    return NativeRaftLog(str(tmp_path / "rlog"), **kw)
+
+
+def _entries(lo, hi, tag=b"e"):
+    return [tag + b"-%d" % i for i in range(lo, hi)]
+
+
+class TestBasics:
+    def test_append_fetch_roundtrip(self, tmp_path):
+        log = _open(tmp_path)
+        log.append(7, 1, _entries(1, 11), state=b"hs1")
+        assert log.first_index(7) == 1
+        assert log.last_index(7) == 10
+        got = log.entries(7)
+        assert [i for i, _ in got] == list(range(1, 11))
+        assert got[3][1] == b"e-4"
+        assert log.state(7) == b"hs1"
+        assert log.entries(7, 4, 7) == [(4, b"e-4"), (5, b"e-5"), (6, b"e-6")]
+        log.close()
+
+    def test_missing_region_is_empty(self, tmp_path):
+        log = _open(tmp_path)
+        assert log.first_index(99) == 0
+        assert log.last_index(99) == 0
+        assert log.entries(99) == []
+        assert log.state(99) is None
+        log.close()
+
+    def test_state_only_append(self, tmp_path):
+        log = _open(tmp_path)
+        log.put_state(3, b"only-state")
+        assert log.state(3) == b"only-state"
+        assert log.last_index(3) == 0
+        assert 3 in log.regions()
+        log.close()
+
+    def test_conflict_truncation(self, tmp_path):
+        """A new leader's append at index k replaces the old suffix >= k —
+        the raft rule, applied at the storage layer (replay applies it too)."""
+        log = _open(tmp_path)
+        log.append(1, 1, _entries(1, 10, b"old"))
+        log.append(1, 6, _entries(6, 8, b"new"))
+        assert log.last_index(1) == 7
+        got = dict(log.entries(1))
+        assert got[5] == b"old-5"
+        assert got[6] == b"new-6"
+        assert got[7] == b"new-7"
+        log.close()
+
+    def test_multi_region_isolation(self, tmp_path):
+        log = _open(tmp_path)
+        log.append(1, 1, _entries(1, 5, b"r1"), state=b"s1")
+        log.append(2, 100, _entries(100, 105, b"r2"), state=b"s2")
+        assert log.first_index(2) == 100
+        assert dict(log.entries(1))[4] == b"r1-4"
+        assert dict(log.entries(2))[104] == b"r2-104"
+        assert sorted(log.regions()) == [1, 2]
+        log.clean(1)
+        assert log.entries(1) == []
+        assert log.state(1) is None
+        assert log.regions() == [2]
+        log.close()
+
+
+class TestPurgeAndGc:
+    def test_purge_drops_prefix(self, tmp_path):
+        log = _open(tmp_path)
+        log.append(1, 1, _entries(1, 101))
+        log.purge(1, 60)
+        assert log.first_index(1) == 61
+        assert log.last_index(1) == 100
+        assert log.entries(1, 0, 62) == [(61, b"e-61")]
+        log.close()
+
+    def test_dead_segments_unlinked(self, tmp_path):
+        # tiny segments force rolls; purging everything must delete files
+        log = _open(tmp_path, segment_bytes=2048)
+        for batch in range(20):
+            log.append(1, 1 + batch * 50, _entries(1 + batch * 50, 51 + batch * 50))
+        assert log.stats()["segments"] > 3
+        log.purge(1, 990)
+        # state of region 1 was never written; all old segments are dead
+        s = log.stats()
+        assert s["segments"] <= 3, s
+        files = os.listdir(log.path)
+        assert len(files) == s["segments"]
+        assert dict(log.entries(1))[1000] == b"e-1000"
+        log.close()
+
+    def test_rewrite_relocates_live_tail(self, tmp_path):
+        """A laggard region's few live entries in an old segment get copied
+        forward so the segment can be unlinked (engine.rs rewrite)."""
+        log = _open(tmp_path, segment_bytes=4096, rewrite_max=64)
+        log.append(2, 1, _entries(1, 4, b"laggard"), state=b"s2")  # tiny, old
+        for batch in range(30):
+            log.append(1, 1 + batch * 50, _entries(1 + batch * 50, 51 + batch * 50))
+        log.purge(1, 1400)
+        s = log.stats()
+        assert s["rewrites"] >= 1, s
+        assert s["segments"] <= 3, s
+        # the laggard's entries and state survived the relocation
+        assert dict(log.entries(2)) == {1: b"laggard-1", 2: b"laggard-2", 3: b"laggard-3"}
+        assert log.state(2) == b"s2"
+        log.close()
+
+    def test_purge_everything_then_append(self, tmp_path):
+        # snapshot-install pattern: all entries purged, append resumes at a gap
+        log = _open(tmp_path)
+        log.append(1, 1, _entries(1, 10))
+        log.purge(1, 9)
+        assert log.entries(1) == []
+        log.append(1, 500, _entries(500, 503))
+        assert log.first_index(1) == 500
+        assert log.last_index(1) == 502
+        log.close()
+
+
+class TestRecovery:
+    def test_reopen_restores_everything(self, tmp_path):
+        log = _open(tmp_path, segment_bytes=4096)
+        log.append(1, 1, _entries(1, 200), state=b"hs-old")
+        log.append(1, 150, _entries(150, 180, b"new"), state=b"hs-new")
+        log.append(2, 7, _entries(7, 9, b"r2"))
+        log.purge(1, 20)
+        log.clean(2)
+        log.append(3, 1, _entries(1, 3, b"r3"))
+        log.close()
+
+        log2 = _open(tmp_path, segment_bytes=4096)
+        assert log2.first_index(1) == 21
+        assert log2.last_index(1) == 179
+        got = dict(log2.entries(1))
+        assert got[149] == b"e-149"
+        assert got[150] == b"new-150"
+        assert log2.state(1) == b"hs-new"
+        assert log2.entries(2) == [] and log2.state(2) is None
+        assert dict(log2.entries(3)) == {1: b"r3-1", 2: b"r3-2"}
+        assert sorted(log2.regions()) == [1, 3]
+        log2.close()
+
+    def test_reopen_after_rewrite(self, tmp_path):
+        log = _open(tmp_path, segment_bytes=4096, rewrite_max=64)
+        log.append(2, 1, _entries(1, 4, b"laggard"), state=b"s2")
+        for batch in range(30):
+            log.append(1, 1 + batch * 50, _entries(1 + batch * 50, 51 + batch * 50))
+        log.purge(1, 1400)
+        assert log.stats()["rewrites"] >= 1
+        log.close()
+        log2 = _open(tmp_path, segment_bytes=4096)
+        assert dict(log2.entries(2))[3] == b"laggard-3"
+        assert log2.state(2) == b"s2"
+        assert log2.last_index(1) == 1500
+        log2.close()
+
+    def test_torn_tail_truncated(self, tmp_path):
+        log = _open(tmp_path)
+        log.append(1, 1, _entries(1, 6))
+        log.close()
+        # simulate a crash mid-append: garbage half-record at the tail
+        files = sorted(os.listdir(tmp_path / "rlog"))
+        with open(tmp_path / "rlog" / files[-1], "ab") as f:
+            f.write(b"\x99\x12\x34half-a-record")
+        log2 = _open(tmp_path)
+        assert log2.last_index(1) == 5
+        assert dict(log2.entries(1))[5] == b"e-5"
+        # and the tail was physically truncated so new appends are clean
+        log2.append(1, 6, _entries(6, 8))
+        log2.close()
+        log3 = _open(tmp_path)
+        assert log3.last_index(1) == 7
+        log3.close()
+
+    def test_durable_sync_mode(self, tmp_path):
+        log = NativeRaftLog(str(tmp_path / "rlog"), sync=True)
+        log.append(1, 1, _entries(1, 4), state=b"hs")
+        log.close()
+        log2 = NativeRaftLog(str(tmp_path / "rlog"), sync=True)
+        assert log2.last_index(1) == 3
+        assert log2.state(1) == b"hs"
+        log2.close()
+
+
+class TestConcurrency:
+    def test_parallel_appends_group_commit(self, tmp_path):
+        """Many threads appending distinct regions with sync=1: every append
+        must be indexed, and grouped fsync must not lose or dup anything."""
+        log = NativeRaftLog(str(tmp_path / "rlog"), sync=True, segment_bytes=1 << 20)
+        n_threads, per = 8, 50
+        errs = []
+
+        def run(rid):
+            try:
+                for i in range(1, per + 1):
+                    log.append(rid, i, [b"r%d-%d" % (rid, i)])
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        ts = [threading.Thread(target=run, args=(r,)) for r in range(1, n_threads + 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        for r in range(1, n_threads + 1):
+            assert log.last_index(r) == per
+            assert dict(log.entries(r))[per] == b"r%d-%d" % (r, per)
+        log.close()
+        log2 = _open(tmp_path)
+        for r in range(1, n_threads + 1):
+            assert log2.last_index(r) == per
+        log2.close()
+
+    def test_concurrent_reads_during_appends(self, tmp_path):
+        log = _open(tmp_path)
+        stop = threading.Event()
+        errs = []
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    es = log.entries(1)
+                    for i, b in es:
+                        assert b == b"e-%d" % i
+                except Exception as e:  # pragma: no cover
+                    errs.append(e)
+                    return
+
+        t = threading.Thread(target=reader)
+        t.start()
+        for batch in range(50):
+            log.append(1, 1 + batch * 20, _entries(1 + batch * 20, 21 + batch * 20))
+            if batch % 10 == 9:
+                log.purge(1, batch * 20 - 100)
+        stop.set()
+        t.join()
+        assert not errs
+        log.close()
+
+
+class TestStoreIntegration:
+    """The log engine behind the multi-raft store: entries route to the
+    segmented log (not CF_RAFT), recovery reads them back, and log GC purges
+    instead of range-deleting (store.py handle_ready/recover/compact)."""
+
+    @pytest.fixture
+    def rl_cluster(self, tmp_path):
+        from tikv_tpu.raft.cluster import Cluster
+
+        c = Cluster(3)
+        for sid, store in c.stores.items():
+            store.raft_log = NativeRaftLog(str(tmp_path / f"rl-{sid}"), sync=False)
+        c.run()
+        yield c, tmp_path
+
+    def test_entries_live_in_log_engine_not_cf_raft(self, rl_cluster):
+        from tikv_tpu.storage.engine import CF_RAFT
+        from tikv_tpu.util import keys
+
+        c, _ = rl_cluster
+        c.must_put(b"k1", b"v1")
+        c.must_put(b"k2", b"v2")
+        for sid, store in c.stores.items():
+            assert store.raft_log.last_index(1) >= 2, sid
+            # CF_RAFT holds region meta + apply state but NO log entries
+            log_prefix = keys.region_raft_prefix(1) + keys.RAFT_LOG_SUFFIX
+            snap = store.engine.snapshot()
+            logged = list(snap.scan_cf(
+                CF_RAFT, log_prefix, log_prefix[:-1] + bytes([log_prefix[-1] + 1])
+            ))
+            assert logged == [], sid
+
+    def test_recovery_from_log_engine(self, rl_cluster, tmp_path):
+        from tikv_tpu.raft.cluster import FIRST_REGION_ID
+        from tikv_tpu.raft.store import Store
+
+        c, base = rl_cluster
+        c.must_put(b"r1", b"v1")
+        c.must_put(b"r2", b"v2")
+        victim = 2
+        old = c.stores[victim]
+        applied_before = old.peers[FIRST_REGION_ID].node.applied
+        old.raft_log.close()
+        # "crash": fresh Store over the surviving engine + reopened log dir
+        new_store = Store(
+            victim, c.transport, engine=old.engine,
+            raft_log=NativeRaftLog(str(base / f"rl-{victim}"), sync=False),
+        )
+        assert new_store.recover() == 1
+        peer = new_store.peers[FIRST_REGION_ID]
+        assert peer.node.applied == applied_before
+        assert peer.node.log.last_index() >= applied_before
+        c.stores[victim] = new_store
+        c.transport.register(new_store)
+        c.must_put(b"r3", b"v3")
+        c.tick(3)
+        assert c.get_on_store(victim, b"r3") == b"v3"
+
+    def test_log_gc_purges_log_engine(self, rl_cluster):
+        c, _ = rl_cluster
+        for i in range(60):
+            c.must_put(b"k%d" % i, b"v")
+        for sid, store in c.stores.items():
+            before = store.raft_log.first_index(1)
+            dropped = store.compact_raft_logs(threshold=20, slack=5)
+            assert dropped > 0, sid
+            assert store.raft_log.first_index(1) > before, sid
+            assert store.raft_log.last_index(1) >= 60, sid
+        # the cluster still works after purge
+        c.must_put(b"after-gc", b"v")
+        assert c.must_get(b"after-gc") == b"v"

@@ -426,11 +426,21 @@ class StorePeer:
         eng = self.store.engine
         # persist raft log + hard state (PeerStorage: RaftLocalState)
         if rd.entries or rd.hard_state_changed:
-            wb = WriteBatch()
-            for e in rd.entries:
-                wb.put_cf(CF_RAFT, keys.raft_log_key(self.region.id, e.index), _encode_entry(e))
-            wb.put_cf(CF_RAFT, keys.raft_state_key(self.region.id), self._encode_raft_state())
-            eng.write(wb)
+            rl = self.store.raft_log
+            if rl is not None:
+                # one group-committed batch: entries + state (raftlog.cc)
+                rl.append(
+                    self.region.id,
+                    rd.entries[0].index if rd.entries else 0,
+                    [_encode_entry(e) for e in rd.entries],
+                    state=self._encode_raft_state(),
+                )
+            else:
+                wb = WriteBatch()
+                for e in rd.entries:
+                    wb.put_cf(CF_RAFT, keys.raft_log_key(self.region.id, e.index), _encode_entry(e))
+                wb.put_cf(CF_RAFT, keys.raft_state_key(self.region.id), self._encode_raft_state())
+                eng.write(wb)
         if rd.snapshot is not None:
             if self.store.apply_system is not None:
                 # queued runs reference the pre-snapshot region: drain them
@@ -458,9 +468,12 @@ class StorePeer:
     def _apply_entries_inline(self, entries: list[Entry]) -> None:
         eng = self.store.engine
         applied = entries[0].index - 1
+        saw_admin = False
         try:
             for e in entries:
-                self._apply_entry(e)
+                cmd = self._apply_entry(e)
+                if e.conf_change is not None or (cmd or {}).get("admin") is not None:
+                    saw_admin = True
                 applied = e.index
         except BaseException:
             # a fault mid-apply (e.g. an injected failpoint) must not
@@ -478,6 +491,8 @@ class StorePeer:
         eng.put_cf(
             CF_RAFT, keys.apply_state_key(self.region.id), codec.encode_u64(self.node.applied)
         )
+        if saw_admin:
+            self.store.sync_kv_wal()  # see _schedule_apply's admin barrier
         self._flush_pending_reads()
 
     def _schedule_apply(self, entries: list[Entry], apply_sys) -> None:
@@ -506,6 +521,10 @@ class StorePeer:
             self.store.engine.put_cf(
                 CF_RAFT, keys.apply_state_key(self.region.id), codec.encode_u64(e.index)
             )
+            # admin mutations (split/merge/conf) rewrite region meta that
+            # recovery cannot re-derive from the raft log alone — close any
+            # buffered-apply window immediately (no-op otherwise)
+            self.store.sync_kv_wal()
             self._flush_pending_reads()  # reads waiting on this admin index
         if run:
             self._submit_run(run, apply_sys)
@@ -616,44 +635,46 @@ class StorePeer:
 
     # -- apply -------------------------------------------------------------
 
-    def _apply_entry(self, e: Entry) -> None:
+    def _apply_entry(self, e: Entry):
+        """Apply one committed entry; returns the decoded cmd (None for conf
+        changes / noops) so callers can inspect it without re-decoding."""
         if e.conf_change is not None:
             self._apply_conf_change(e)
             self._ack(e, None, None)
-            return
+            return None
         if not e.data:
-            return  # leader noop
+            return None  # leader noop
         cmd = decode_cmd(e.data)
         if not self._epoch_ok(cmd):
             self._ack(e, None, EpochError(self.region.clone()))
-            return
+            return cmd
         admin = cmd.get("admin")
         if admin is not None and admin[0] == "split":
             self._apply_split(admin)
             self._ack(e, {"split": True}, None)
-            return
+            return cmd
         if admin is not None and admin[0] == "compute_hash":
             # witnesses hold no data: they ack but never hash or verify —
             # their empty-range hash would flag a bogus divergence
             if self.peer_id not in self.node.witnesses:
                 self._apply_compute_hash(e)
             self._ack(e, {"compute_hash": True}, None)
-            return
+            return cmd
         if admin is not None and admin[0] == "verify_hash":
             if self.peer_id not in self.node.witnesses:
                 self._apply_verify_hash(admin[1], admin[2])
             self._ack(e, {"verify_hash": True}, None)
-            return
+            return cmd
         if admin is not None and admin[0] == "prepare_merge":
             self.merging = True
             self.region.epoch.version += 1
             self.store.persist_region(self.region, merging=True)
             self._ack(e, {"prepare_merge": True}, None)
-            return
+            return cmd
         if admin is not None and admin[0] == "commit_merge":
             self._apply_commit_merge(admin)
             self._ack(e, {"commit_merge": True}, None)
-            return
+            return cmd
         if admin is not None and admin[0] == "ingest_sst":
             # every non-witness replica materializes the staged entries from
             # the log payload (fsm/apply.rs exec_ingest_sst): a replica that
@@ -662,15 +683,16 @@ class StorePeer:
             if self.peer_id not in self.node.witnesses:
                 self._apply_ingest_sst(admin[1])
             self._ack(e, {"ingest_sst": True, "applied_index": e.index}, None)
-            return
+            return cmd
         fail_point("apply_before_exec")
         if self.peer_id in self.node.witnesses:
             # witnesses replicate and vote on the LOG but never materialize
             # data (raftstore witness feature); acking keeps apply advancing
             self._ack(e, {"applied_index": e.index}, None)
-            return
+            return cmd
         self._exec_data_cmd(cmd, self.region)
         self._ack(e, {"applied_index": e.index}, None)
+        return cmd
 
     def _apply_ingest_sst(self, blob: bytes) -> None:
         """Write the ingest payload — encoded (cf, key, value) entries, keys
@@ -872,7 +894,11 @@ class StorePeer:
         wb.put_cf(
             CF_RAFT, keys.region_state_key(self.region.id), encode_region(self.region, self.merging)
         )
-        wb.put_cf(CF_RAFT, keys.raft_state_key(self.region.id), self._encode_raft_state())
+        rl = self.store.raft_log
+        if rl is not None:
+            rl.put_state(self.region.id, self._encode_raft_state())
+        else:
+            wb.put_cf(CF_RAFT, keys.raft_state_key(self.region.id), self._encode_raft_state())
         wb.put_cf(CF_RAFT, keys.apply_state_key(self.region.id), codec.encode_u64(e.index))
         self.store.engine.write(wb)
 
@@ -1127,7 +1153,16 @@ class StorePeer:
         eng.write(wb)
         self.store.persist_region(self.region)
         wb2 = WriteBatch()
-        wb2.put_cf(CF_RAFT, keys.raft_state_key(self.region.id), self._encode_raft_state())
+        rl = self.store.raft_log
+        if rl is not None:
+            rl.put_state(self.region.id, self._encode_raft_state())
+            # log below the snapshot point is obsolete; purge lets the log
+            # engine drop/unlink dead segments (engine.rs gc on snapshot).
+            # The snapshot data itself must outlive the purged entries.
+            self.store.sync_kv_wal()
+            rl.purge(self.region.id, self.node.log.snapshot_index)
+        else:
+            wb2.put_cf(CF_RAFT, keys.raft_state_key(self.region.id), self._encode_raft_state())
         wb2.put_cf(CF_RAFT, keys.apply_state_key(self.region.id), codec.encode_u64(self.node.applied))
         eng.write(wb2)
         self.apply_index = max(self.apply_index, self.node.applied)
@@ -1257,10 +1292,22 @@ def _decode_entry(b: bytes) -> Entry:
 class Store:
     """All region peers on one node + message routing (StoreFsm + router)."""
 
-    def __init__(self, store_id: int, transport: Transport, engine: BTreeEngine | None = None):
+    def __init__(self, store_id: int, transport: Transport, engine: BTreeEngine | None = None,
+                 raft_log=None):
         self.store_id = store_id
         self.transport = transport
         self.engine = engine or BTreeEngine()
+        # optional purpose-built raft log engine (native/raftlog.cc — the
+        # raft_log_engine role, selected per-store like the reference at
+        # components/server/src/server.rs:153-157).  When set, raft entries +
+        # hard state live there; region meta + apply state stay in CF_RAFT of
+        # the KV engine so they remain crash-atomic with applied data.
+        self.raft_log = raft_log
+        # True when the KV engine's WAL runs buffered because the raft log is
+        # the durable source of truth (the reference applies with sync=false
+        # and flushes kvdb before purging raft logs).  Set by the server
+        # assembly; gates the sync_kv_wal() barriers below.
+        self.kv_buffered = False
         self.peers: dict[int, StorePeer] = {}
         self._inbox: list[RaftMessage] = []
         self._compact_requested = threading.Event()
@@ -1303,6 +1350,12 @@ class Store:
             peer = StorePeer(self, region.clone(), me.peer_id)
             self.peers[region.id] = peer
             self.persist_region(peer.region)
+            # under buffered apply the meta write above is not yet durable,
+            # but the peer may durably VOTE (raft log) before any admin
+            # barrier flushes it — recovery only enumerates KV region meta,
+            # so a crash would forget the vote.  Region creation is rare;
+            # pay one fdatasync here.
+            self.sync_kv_wal()
             if self.fsm_router is not None:
                 self.fsm_router.register(region.id)
                 self.fsm_router.send(region.id, ("ready",))
@@ -1327,8 +1380,24 @@ class Store:
             self.fsm_router.close(region_id)
         self.erase_region_state(region_id)
 
-    def erase_region_state(self, region_id: int, wb: WriteBatch | None = None) -> None:
-        erase_region_state(self.engine, region_id, wb)
+    def erase_region_state(self, region_id: int) -> None:
+        erase_region_state(self.engine, region_id)
+        if self.raft_log is not None:
+            # ordering matters under buffered apply: the CF_RAFT tombstone
+            # deletes must be durable BEFORE the log engine forgets the
+            # region's vote/term — a crash in between would otherwise
+            # resurrect the peer with term=0 and let it double-vote
+            self.sync_kv_wal()
+            self.raft_log.clean(region_id)
+
+    def sync_kv_wal(self) -> None:
+        """Make every buffered apply write durable (kvdb flush before raft-log
+        purge, and after rare admin mutations whose loss recovery could not
+        re-derive).  No-op unless the server opted into buffered apply."""
+        if self.kv_buffered:
+            # closing the unsynced window = one fdatasync of the engine WAL
+            self.engine.set_sync(True)
+            self.engine.set_sync(False)
 
     def persist_region(self, region: Region, merging: bool = False) -> None:
         self.engine.put_cf(
@@ -1350,7 +1419,16 @@ class Store:
             peer = StorePeer(self, region, me.peer_id)
             peer.merging = merging
             node = peer.node
-            state = snap.get_cf(CF_RAFT, keys.raft_state_key(region.id))
+            if self.raft_log is not None:
+                state = self.raft_log.state(region.id)
+                if state is None:
+                    # store predates the log engine (or it was switched on):
+                    # migrate this region's CF_RAFT log + state into the log
+                    # engine, or recovery would come up amnesiac (term=0,
+                    # no entries) while the real state sits in CF_RAFT
+                    state = self._migrate_region_log(snap, region.id)
+            else:
+                state = snap.get_cf(CF_RAFT, keys.raft_state_key(region.id))
             if state is not None:
                 node.term = codec.decode_u64(state, 0)
                 vote = codec.decode_u64(state, 8)
@@ -1366,15 +1444,21 @@ class Store:
                     node.witnesses = witnesses
             applied_raw = snap.get_cf(CF_RAFT, keys.apply_state_key(region.id))
             applied = codec.decode_u64(applied_raw) if applied_raw else 0
-            log_prefix = keys.region_raft_prefix(region.id) + keys.RAFT_LOG_SUFFIX
             entries = []
-            for lk, lv in snap.scan_cf(
-                CF_RAFT, log_prefix, log_prefix[:-1] + bytes([log_prefix[-1] + 1])
-            ):
-                e = _decode_entry(lv)
-                if e.index > node.log.snapshot_index:
-                    entries.append(e)
-            entries.sort(key=lambda e: e.index)
+            if self.raft_log is not None:
+                for _idx, blob in self.raft_log.entries(region.id):
+                    e = _decode_entry(blob)
+                    if e.index > node.log.snapshot_index:
+                        entries.append(e)
+            else:
+                log_prefix = keys.region_raft_prefix(region.id) + keys.RAFT_LOG_SUFFIX
+                for lk, lv in snap.scan_cf(
+                    CF_RAFT, log_prefix, log_prefix[:-1] + bytes([log_prefix[-1] + 1])
+                ):
+                    e = _decode_entry(lv)
+                    if e.index > node.log.snapshot_index:
+                        entries.append(e)
+                entries.sort(key=lambda e: e.index)
             node.log.entries = entries
             node.applied = max(applied, node.log.snapshot_index)
             node.commit = max(node.commit, node.applied)
@@ -1382,6 +1466,43 @@ class Store:
             self.peers[region.id] = peer
             recovered += 1
         return recovered
+
+    def _migrate_region_log(self, snap, region_id: int) -> bytes | None:
+        """One-shot CF_RAFT -> log-engine migration for a region persisted
+        before the raft log engine was enabled.  Returns the legacy raft
+        state blob (also written into the log engine), or None if the region
+        never persisted one."""
+        state = snap.get_cf(CF_RAFT, keys.raft_state_key(region_id))
+        log_prefix = keys.region_raft_prefix(region_id) + keys.RAFT_LOG_SUFFIX
+        legacy = []
+        for lk, lv in snap.scan_cf(
+            CF_RAFT, log_prefix, log_prefix[:-1] + bytes([log_prefix[-1] + 1])
+        ):
+            e = _decode_entry(lv)
+            legacy.append((e.index, lv))
+        legacy.sort()
+        if state is None and not legacy:
+            return None
+        # contiguous runs (splits/compactions can leave gaps in CF_RAFT)
+        run_start = 0
+        for i in range(1, len(legacy) + 1):
+            if i == len(legacy) or legacy[i][0] != legacy[i - 1][0] + 1:
+                run = legacy[run_start:i]
+                if run:
+                    self.raft_log.append(region_id, run[0][0], [b for _, b in run])
+                run_start = i
+        if state is not None:
+            self.raft_log.put_state(region_id, state)
+        # drop the legacy copies so the two stores never diverge
+        wb = WriteBatch()
+        wb.delete_range_cf(
+            CF_RAFT,
+            log_prefix + codec.encode_u64(0),
+            log_prefix + codec.encode_u64(1 << 62),
+        )
+        wb.delete_cf(CF_RAFT, keys.raft_state_key(region_id))
+        self.engine.write(wb)
+        return state
 
     # -- routing -----------------------------------------------------------
 
@@ -1557,15 +1678,28 @@ class Store:
         if term is None:
             return 0
         node.log.compact_to(compact_to, term)
-        wb = WriteBatch()
-        log_prefix = keys.region_raft_prefix(peer.region.id) + keys.RAFT_LOG_SUFFIX
-        wb.delete_range_cf(
-            CF_RAFT,
-            log_prefix + codec.encode_u64(0),
-            log_prefix + codec.encode_u64(compact_to + 1),
-        )
-        wb.put_cf(CF_RAFT, keys.raft_state_key(peer.region.id), peer._encode_raft_state())
-        self.engine.write(wb)
+        if self.raft_log is not None:
+            # applied data must be durable before the entries that produced
+            # it disappear (the reference flushes kvdb before raft-engine
+            # purge), and the raft state carrying the new truncated index
+            # must be durable before purge unlinks segments — recovery with
+            # the OLD snapshot_index against a purged log would misalign
+            # RaftLog's positional entry indexing (core.py:135).  Then a
+            # logical purge marker, not a range delete — the log engine
+            # unlinks whole dead segments (raftlog.cc gc/rewrite).
+            self.sync_kv_wal()
+            self.raft_log.put_state(peer.region.id, peer._encode_raft_state())
+            self.raft_log.purge(peer.region.id, compact_to)
+        else:
+            wb = WriteBatch()
+            log_prefix = keys.region_raft_prefix(peer.region.id) + keys.RAFT_LOG_SUFFIX
+            wb.delete_range_cf(
+                CF_RAFT,
+                log_prefix + codec.encode_u64(0),
+                log_prefix + codec.encode_u64(compact_to + 1),
+            )
+            wb.put_cf(CF_RAFT, keys.raft_state_key(peer.region.id), peer._encode_raft_state())
+            self.engine.write(wb)
         return compact_to - first + 1
 
     def on_split(self, old: Region, new: Region) -> None:

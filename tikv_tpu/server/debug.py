@@ -13,8 +13,16 @@ from ..util import codec, keys
 
 
 class Debugger:
-    def __init__(self, engine: KvEngine):
+    def __init__(self, engine: KvEngine, raft_log=None):
         self.engine = engine
+        # the store's log engine (native/raftlog.py) when enabled: region
+        # surgery must wipe entries + hard state there too, or recover()
+        # would restore stale votes/entries beside freshly written meta
+        self.raft_log = raft_log
+
+    def _clean_raft_log(self, region_id: int) -> None:
+        if self.raft_log is not None:
+            self.raft_log.clean(region_id)
 
     def get(self, cf: str, raw_key: bytes) -> bytes | None:
         return self.engine.get_cf(cf, keys.data_key(raw_key))
@@ -289,6 +297,7 @@ class Debugger:
         from ..raft.store import erase_region_state
 
         erase_region_state(self.engine, region_id)
+        self._clean_raft_log(region_id)
         return True
 
     def recreate_region(self, region_id: int, start: bytes, end: bytes,
@@ -303,6 +312,7 @@ class Debugger:
         # otherwise restore the OLD ConfState (dead voters) and old entries
         # alongside the new region — an unelectable peer and replayed garbage
         erase_region_state(self.engine, region_id)
+        self._clean_raft_log(region_id)
         region = Region(region_id, start, end, RegionEpoch(1, 1),
                         [Peer(peer_id, store_id)])
         self.engine.put_cf(CF_RAFT, keys.region_state_key(region_id),

@@ -30,10 +30,11 @@ class Node:
         engine=None,
         split_qps_threshold: float | None = None,
         consistency_check_interval: float | None = None,
+        raft_log=None,
     ):
         self.pd = pd
         self.store_id = store_id or pd.alloc_id()
-        self.store = Store(self.store_id, transport, engine=engine)
+        self.store = Store(self.store_id, transport, engine=engine, raft_log=raft_log)
         # server nodes run the apply pipeline (apply.rs ApplyBatchSystem):
         # committed data entries apply off the raft thread
         self.store.enable_apply_pipeline()

@@ -61,6 +61,21 @@ def open_engine(path: str | None):
     return NativeEngine(path=path)
 
 
+def open_raft_log(data_dir: str | None, enable: bool = True):
+    """The raft_log_engine selection (components/server/src/server.rs:153-157):
+    durable stores get the purpose-built segmented log by default; in-memory
+    test stores keep the log in CF_RAFT."""
+    if data_dir is None or not enable:
+        return None
+    import os
+
+    from ..native.raftlog import NativeRaftLog, raftlog_available
+
+    if not raftlog_available():
+        return None
+    return NativeRaftLog(os.path.join(data_dir, "raftlog"))
+
+
 class StoreServer:
     """The assembled store (TiKVServer, components/server/src/server.rs:168)."""
 
@@ -73,6 +88,7 @@ class StoreServer:
         port: int = 0,
         enable_device: bool = False,
         security=None,
+        raft_engine: bool = True,
     ):
         self.pd = pd
         self.security = security
@@ -80,8 +96,15 @@ class StoreServer:
         if hasattr(self.engine, "start_auto_compaction"):
             # background version GC (rocksdb's compaction threads)
             self.engine.start_auto_compaction(interval_s=30.0)
+        self.raft_log = open_raft_log(data_dir, enable=raft_engine)
         self.transport = RemoteTransport(self._resolve, security=security)
-        self.node = Node(pd, self.transport, store_id=store_id, engine=self.engine)
+        self.node = Node(pd, self.transport, store_id=store_id, engine=self.engine,
+                         raft_log=self.raft_log)
+        if self.raft_log is not None and hasattr(self.engine, "set_sync"):
+            # the raft log is the durable source of truth: apply writes run
+            # buffered, flushed before log purge (reference sync-log split)
+            self.engine.set_sync(False)
+            self.node.store.kv_buffered = True
         self.store = self.node.store
         recovered = self.store.recover()
         from ..sidecar.resolved_ts import ResolvedTsEndpoint
@@ -102,7 +125,7 @@ class StoreServer:
         self.service = KvService(
             self.storage,
             self.copr,
-            debugger=Debugger(self.engine),
+            debugger=Debugger(self.engine, raft_log=self.raft_log),
             pd=pd,
             raft_router=self.store,
             gc_worker=self.gc_worker,
@@ -158,6 +181,8 @@ class StoreServer:
         close = getattr(self.engine, "close", None)
         if close is not None:
             close()
+        if self.raft_log is not None:
+            self.raft_log.close()
 
 
 def main(argv=None) -> int:
@@ -169,6 +194,8 @@ def main(argv=None) -> int:
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--expect-stores", type=int, default=1)
     ap.add_argument("--enable-device", action="store_true")
+    ap.add_argument("--no-raft-engine", action="store_true",
+                    help="keep the raft log in CF_RAFT instead of the segmented log engine")
     ap.add_argument("--ca-path", default="")
     ap.add_argument("--cert-path", default="")
     ap.add_argument("--key-path", default="")
@@ -191,7 +218,7 @@ def main(argv=None) -> int:
     srv = StoreServer(
         args.store_id, pd, data_dir=args.dir,
         host=args.host, port=args.port, enable_device=args.enable_device,
-        security=security,
+        security=security, raft_engine=not args.no_raft_engine,
     )
     srv.start()
     srv.bootstrap_or_join(args.expect_stores)
