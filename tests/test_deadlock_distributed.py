@@ -1,0 +1,123 @@
+"""Distributed deadlock detection (src/server/lock_manager/deadlock.rs:343-391):
+wait-for edges from every store forward to the detector leader — the store
+holding region 1's leadership — so a lock cycle SPANNING stores breaks by
+DeadlockError, not by waiter timeout."""
+
+import threading
+import time
+
+import pytest
+
+from tikv_tpu.server.cluster import FIRST_REGION_ID, ServerCluster
+from tikv_tpu.server.server import Client
+
+
+@pytest.fixture
+def cluster3f():
+    c = ServerCluster(3, full_service=True)
+    c.run()
+    yield c
+    c.shutdown()
+
+
+def _lock_client(cluster, region_id):
+    leader = cluster.wait_leader(region_id)
+    sid = leader.store.store_id
+    return Client(*cluster.resolve(sid)), sid, leader
+
+
+def _plock(client, region_id, key, start_ts, wait_ms=0, timeout=30.0):
+    return client.call(
+        "kv_pessimistic_lock",
+        {
+            "keys": [key],
+            "primary_lock": key,
+            "start_version": start_ts,
+            "for_update_ts": start_ts,
+            "wait_timeout_ms": wait_ms,
+            "context": {"region_id": region_id},
+        },
+        timeout=timeout,
+    )
+
+
+def test_cross_store_cycle_broken_by_error_not_timeout(cluster3f):
+    c = cluster3f
+    # two regions with leaders on DIFFERENT stores
+    right_id = c.split_region(FIRST_REGION_ID, b"m")
+    left_leader = c.wait_leader(FIRST_REGION_ID)
+    detector_sid = left_leader.store.store_id
+    other = next(s for s in (1, 2, 3) if s != detector_sid)
+    c.transfer_leader(right_id, other)
+
+    cl_left, sid_left, _ = _lock_client(c, FIRST_REGION_ID)
+    cl_right, sid_right, _ = _lock_client(c, right_id)
+    assert sid_left != sid_right, "cycle must span two stores"
+
+    # txn 10 locks "a" (left region), txn 20 locks "z" (right region)
+    r = _plock(cl_left, FIRST_REGION_ID, b"a", 10)
+    assert not r.get("error"), r
+    r = _plock(cl_right, right_id, b"z", 20)
+    assert not r.get("error"), r
+
+    # txn 10 now waits for "z" at the right store (edge 10 -> 20 forwarded)
+    waiter_result = {}
+
+    def waiter():
+        waiter_result["r"] = _plock(cl_right, right_id, b"z", 10, wait_ms=20_000)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        info = cl_right.call("get_lock_wait_info", {})
+        if info.get("entries"):
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError("txn 10 never started waiting")
+
+    # txn 20 asks for "a" at the LEFT store: edge 20 -> 10 closes the cycle.
+    # wait_ms is generous — detection, not timeout, must break the cycle.
+    t0 = time.monotonic()
+    r = _plock(cl_left, FIRST_REGION_ID, b"a", 20, wait_ms=20_000)
+    dt = time.monotonic() - t0
+    err = r.get("error") or {}
+    assert "deadlock" in err, f"expected deadlock error, got {r}"
+    assert dt < 5.0, f"cycle broken by timeout ({dt:.1f}s), not detection"
+    dl = err["deadlock"]
+    assert {dl["waiting_txn"], dl["blocked_on_txn"]} == {10, 20}
+
+    # unwind: roll back txn 20, which wakes txn 10's waiter
+    cl_right.call("kv_pessimistic_rollback",
+                  {"keys": [b"z"], "start_version": 20, "for_update_ts": 20,
+                   "context": {"region_id": right_id}})
+    t.join(timeout=25)
+    assert not t.is_alive(), "txn 10's waiter never finished"
+    cl_left.close()
+    cl_right.close()
+
+
+def test_local_cycle_still_detected_on_leader_store(cluster3f):
+    """Same-store cycles keep working through the forwarding handle."""
+    c = cluster3f
+    cl, sid, _ = _lock_client(c, FIRST_REGION_ID)
+    assert not _plock(cl, FIRST_REGION_ID, b"k1", 100).get("error")
+    assert not _plock(cl, FIRST_REGION_ID, b"k2", 200).get("error")
+    waiter_result = {}
+    t = threading.Thread(target=lambda: waiter_result.update(
+        r=_plock(cl, FIRST_REGION_ID, b"k2", 100, wait_ms=15_000)))
+    t.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if cl.call("get_lock_wait_info", {}).get("entries"):
+            break
+        time.sleep(0.1)
+    r = _plock(cl, FIRST_REGION_ID, b"k1", 200, wait_ms=15_000)
+    assert "deadlock" in (r.get("error") or {}), r
+    cl.call("kv_pessimistic_rollback",
+            {"keys": [b"k2"], "start_version": 200, "for_update_ts": 200,
+             "context": {"region_id": FIRST_REGION_ID}})
+    t.join(timeout=20)
+    assert not t.is_alive()
+    cl.close()

@@ -1,0 +1,183 @@
+"""True server-streaming coprocessor responses over the wire
+(src/coprocessor/endpoint.rs:508-584, kv.rs coprocessor_stream:574):
+frames ride the TCP connection one at a time with the request's id, the
+server holds O(one frame) of memory, and a slow client back-pressures the
+executor instead of ballooning a server-side buffer."""
+
+import threading
+import time
+
+import pytest
+
+from tikv_tpu.copr.dag import DagRequest, TableScan
+from tikv_tpu.copr.dag_wire import dag_to_wire
+from tikv_tpu.copr.endpoint import Endpoint
+from tikv_tpu.copr.table import record_range
+from tikv_tpu.server.server import Client, Server
+from tikv_tpu.server.service import KvService
+from tikv_tpu.storage.kv import LocalEngine
+from tikv_tpu.storage.storage import Storage
+
+from copr_fixtures import PRODUCT_COLUMNS, TABLE_ID, product_engine
+
+
+@pytest.fixture
+def served():
+    eng = LocalEngine(product_engine())
+    ep = Endpoint(eng, enable_device=False)
+    svc = KvService(Storage(engine=eng), ep)
+    srv = Server(svc)
+    srv.start()
+    client = Client(*srv.addr)
+    yield client, svc, ep
+    client.close()
+    srv.stop()
+
+
+def _stream_req(rows_per_stream=2):
+    dag = DagRequest(executors=[TableScan(TABLE_ID, PRODUCT_COLUMNS)])
+    return {
+        "dag": dag_to_wire(dag),
+        "ranges": [list(record_range(TABLE_ID))],
+        "start_ts": 200,
+        "rows_per_stream": rows_per_stream,
+    }
+
+
+def test_streamed_frames_match_inprocess(served):
+    """Wire frames are byte-identical to the endpoint's in-process streaming
+    output, and more than one frame actually crosses the wire."""
+    client, svc, ep = served
+    frames = [f["data"] for f in client.call_stream("coprocessor_stream", _stream_req())]
+    assert len(frames) > 1, "scan must split into multiple frames"
+    from tikv_tpu.copr.dag_wire import dag_from_wire
+    from tikv_tpu.copr.endpoint import CoprRequest
+
+    req = _stream_req()
+    creq = CoprRequest(103, dag_from_wire(req["dag"]),
+                       [tuple(r) for r in req["ranges"]], req["start_ts"])
+    want = [r.data for r in ep.handle_streaming_request(creq, 2)]
+    assert frames == want
+
+
+def _big_bytes_engine(n_rows=8_000, payload=1_000):
+    """~8MB of row data committed at ts=100, split into enough frames that a
+    stalled consumer is clearly distinguishable from a drained stream."""
+    from tikv_tpu.copr.datatypes import ColumnInfo, FieldType
+    from tikv_tpu.copr.table import encode_row, record_key
+    from tikv_tpu.storage.btree_engine import BTreeEngine
+    from tikv_tpu.storage.engine import CF_WRITE
+    from tikv_tpu.storage.txn_types import Key, Write, WriteType
+
+    cols = [
+        ColumnInfo(1, FieldType.int64(), is_pk_handle=True),
+        ColumnInfo(2, FieldType.varchar()),
+    ]
+    from tikv_tpu.storage.engine import CF_DEFAULT
+
+    blob = b"x" * payload
+    writes, values = [], []
+    wrec = Write(WriteType.PUT, 90).to_bytes()
+    for i in range(n_rows):
+        k = Key.from_raw(record_key(TABLE_ID, i))
+        values.append((k.append_ts(90).encoded, encode_row(cols[1:], [blob])))
+        writes.append((k.append_ts(100).encoded, wrec))
+    eng = BTreeEngine()
+    eng.bulk_load(CF_DEFAULT, values)
+    eng.bulk_load(CF_WRITE, writes)
+    return LocalEngine(eng), cols, n_rows
+
+
+def test_backpressure_bounds_server_memory():
+    """A stalled consumer must stall PRODUCTION at the credit window
+    (server.py STREAM_WINDOW), proving both sides hold O(window) frames —
+    the frames=[...] regression this guards against buffered the whole
+    response before the first byte left."""
+    from tikv_tpu.server.server import STREAM_WINDOW
+
+    eng, cols, n_rows = _big_bytes_engine()
+    ep = Endpoint(eng, enable_device=False)
+    svc = KvService(Storage(engine=eng), ep)
+    srv = Server(svc)
+    srv.start()
+    client = Client(*srv.addr)
+    produced = []
+    orig = ep.handle_streaming_request
+
+    def tracking(req, rows_per_stream=1024):
+        for r in orig(req, rows_per_stream):
+            produced.append(len(r.data))
+            yield r
+
+    ep.handle_streaming_request = tracking
+    try:
+        dag = DagRequest(executors=[TableScan(TABLE_ID, cols)])
+        it = client.call_stream("coprocessor_stream", {
+            "dag": dag_to_wire(dag),
+            "ranges": [list(record_range(TABLE_ID))],
+            "start_ts": 200,
+            "rows_per_stream": 256,
+        }, timeout=120)
+        total_frames = (n_rows + 255) // 256
+        assert total_frames > 3 * STREAM_WINDOW  # stall must be observable
+        # consume NOTHING: production must stall at the credit window
+        deadline = time.monotonic() + 30
+        stalled_at = None
+        while time.monotonic() < deadline:
+            time.sleep(0.4)
+            cur = len(produced)
+            time.sleep(0.4)
+            if len(produced) == cur and cur > 0:
+                stalled_at = cur
+                break
+        assert stalled_at is not None, "production never stalled"
+        assert stalled_at <= STREAM_WINDOW + 1, (
+            f"server produced {stalled_at}/{total_frames} frames with no "
+            f"consumer — credit flow control is not bounding the stream"
+        )
+        # now drain: everything arrives and production resumes to completion
+        frames = list(it)
+        assert len(frames) == total_frames
+        assert len(produced) == total_frames
+    finally:
+        ep.handle_streaming_request = orig
+        client.close()
+        srv.stop()
+
+
+def test_unary_calls_interleave_with_open_stream(served):
+    """A long stream must not monopolize the connection: frames take the
+    send lock one at a time, so a unary response can slot in between."""
+    client, svc, _ep = served
+    it = client.call_stream("coprocessor_stream", _stream_req(rows_per_stream=1))
+    next(it)  # stream is open with frames still pending
+    r = client.call("kv_get", {"key": b"nonexistent", "version": 200,
+                               "context": {}}, timeout=10)
+    assert isinstance(r, dict)
+    assert list(it)  # stream still completes
+
+
+def test_validation_error_surfaces(served):
+    client, _svc, _ep = served
+    with pytest.raises(RuntimeError, match="dag required"):
+        list(client.call_stream("coprocessor_stream",
+                                {"ranges": [], "start_ts": 1}))
+
+
+def test_mid_stream_error_carried_on_final_frame(served):
+    client, svc, ep = served
+    orig = ep.handle_streaming_request
+
+    def exploding(req, rows_per_stream=1024):
+        it = orig(req, rows_per_stream)
+        yield next(it)
+        raise RuntimeError("boom mid-stream")
+
+    ep.handle_streaming_request = exploding
+    try:
+        it = client.call_stream("coprocessor_stream", _stream_req(rows_per_stream=1))
+        assert next(it)["data"]
+        with pytest.raises(RuntimeError, match="boom mid-stream"):
+            list(it)
+    finally:
+        ep.handle_streaming_request = orig

@@ -66,8 +66,19 @@ class MockPd(PdClient):
         # scheduling (pd-server schedulers): None disables every policy
         self.replication_factor: int | None = None
         self.balance_threshold = 2
+        # balance-region: move a replica when the most-loaded voter store
+        # hosts this many more replicas than the least-loaded spare store
+        self.balance_region_threshold = 4
+        self.operator_ttl = 30.0
         self.store_down_secs = 10.0
         self.operators: dict[int, dict] = {}  # region_id -> pending operator
+        # in-flight replica moves: region_id -> [src, dst, deadline, done_at]
+        # done_at None while the move runs; set when remove_peer was issued,
+        # after which the entry LINGERS so its influence keeps adjusting
+        # load estimates until region heartbeats catch up (the reference
+        # PD's operator-influence accounting)
+        self._moves: dict[int, list] = {}
+        self._move_linger = 10.0
 
     # -- ids / tso ---------------------------------------------------------
 
@@ -154,6 +165,14 @@ class MockPd(PdClient):
         }
         voters = [p for p in region.peers if p.role == "voter"]
         hosting = {p.store_id for p in region.peers}
+        mv = self._moves.get(region.id)
+        if mv is not None and mv[3] is None:
+            # an ACTIVE balance move owns this region's scheduling: the
+            # generic excess-replica rule below must not fire mid-move (it
+            # could remove the replica the move just added).  A lingering
+            # completed move only contributes influence — repair and leader
+            # balance keep running for the region.
+            return self._balance_region(region, leader_store, alive, now)
         if len(voters) < self.replication_factor:
             spare = sorted(alive - hosting)
             if spare:
@@ -166,12 +185,24 @@ class MockPd(PdClient):
         live_voters = len(voters) - len(dead_voters)
         if dead_voters and len(voters) == self.replication_factor and live_voters > len(voters) // 2:
             return {"type": "remove_peer", "peer_id": dead_voters[0].peer_id}
-        if len(voters) > self.replication_factor:
+        if len(voters) > self.replication_factor and region.id not in self._moves:
+            # (a lingering move means the extra replica is already being
+            # removed — firing here could target the WRONG peer off a stale
+            # region view)
             # prefer dropping replicas on dead stores, then non-leaders
             dead = [p for p in voters if p.store_id not in alive]
             candidates = dead or [p for p in voters if p.store_id != leader_store]
             if candidates:
                 return {"type": "remove_peer", "peer_id": candidates[0].peer_id}
+        # balance-region (the pd-server balance-region scheduler,
+        # pd_client lib.rs:180-217 operator surface): two-phase replica move
+        # tracked in self._moves — add_peer on the target first, then once
+        # the target is a voter, remove_peer on the source; expired moves
+        # are abandoned (operator TTL), so a wedged conf change can't pin
+        # the region forever
+        op = self._balance_region(region, leader_store, alive, now)
+        if op is not None:
+            return op
         # leader balance over the stores hosting this region
         counts = {sid: 0 for sid in alive}
         for rid, lsid in self.leaders.items():
@@ -184,6 +215,81 @@ class MockPd(PdClient):
                 tp = region.peer_on_store(target)
                 return {"type": "transfer_leader", "peer_id": tp.peer_id, "store_id": target}
         return None
+
+    def _store_load(self, sid: int, replica_counts: dict[int, int]) -> tuple:
+        """Ordering key for balance decisions: replica count first, reported
+        used bytes as the size-weighted tiebreak (store_heartbeat stats)."""
+        info = self.stores.get(sid)
+        used = (info.stats or {}).get("used_bytes", 0) if info else 0
+        return (replica_counts.get(sid, 0), used)
+
+    def _gc_moves(self, now: float) -> None:
+        for rid in list(self._moves):
+            src, dst, deadline, done_at = self._moves[rid]
+            if (done_at is None and now > deadline) or \
+                    (done_at is not None and now - done_at > self._move_linger):
+                del self._moves[rid]
+
+    def _balance_region(self, region: Region, leader_store: int,
+                        alive: set, now: float) -> dict | None:
+        self._gc_moves(now)
+        voters = [p for p in region.peers if p.role == "voter"]
+        hosting = {p.store_id for p in region.peers}
+        # phase 2 / retry of an in-flight move for THIS region
+        mv = self._moves.get(region.id)
+        if mv is not None and mv[3] is None:
+            src, dst, _deadline, _done = mv
+            if src not in hosting:
+                mv[3] = now  # source already gone: done, linger
+                return None
+            dstp = region.peer_on_store(dst)
+            if dstp is None:
+                # add not applied yet (or lost): re-issue
+                return {"type": "add_peer", "store_id": dst}
+            if dstp.role != "voter":
+                return None  # learner still catching up
+            srcp = region.peer_on_store(src)
+            if srcp is not None and src == leader_store:
+                # can't remove the leader's replica: move leadership off
+                return {"type": "transfer_leader", "peer_id": dstp.peer_id,
+                        "store_id": dst}
+            mv[3] = now  # linger for influence until heartbeats catch up
+            if srcp is not None:
+                return {"type": "remove_peer", "peer_id": srcp.peer_id}
+            return None
+        if mv is not None:
+            return None  # completed move lingering: no new decisions here
+        # phase 1: trigger a move when this region's most loaded voter
+        # store dwarfs the least loaded spare store.  One move at a time —
+        # every pending/lingering move's influence is folded into the load
+        # estimate, so stale heartbeat views can't trigger a stampede.
+        if any(m[3] is None for m in self._moves.values()):
+            return None
+        if len(voters) != self.replication_factor:
+            return None  # repair rules own abnormal replica counts
+        replica_counts: dict[int, int] = {sid: 0 for sid in alive}
+        for r in self.regions.values():
+            for p in r.peers:
+                if p.store_id in replica_counts:
+                    replica_counts[p.store_id] += 1
+        for rid, (src, dst, _dl, _done) in self._moves.items():
+            view = self.regions.get(rid)
+            if view is not None and view.peer_on_store(src) is not None \
+                    and src in replica_counts:
+                replica_counts[src] -= 1  # removal decided, view stale
+            if (view is None or view.peer_on_store(dst) is None) \
+                    and dst in replica_counts:
+                replica_counts[dst] += 1  # addition decided, view stale
+        spare = sorted(alive - hosting)
+        live_voter_sids = [p.store_id for p in voters if p.store_id in alive]
+        if not spare or not live_voter_sids:
+            return None
+        src = max(live_voter_sids, key=lambda s: self._store_load(s, replica_counts))
+        dst = min(spare, key=lambda s: self._store_load(s, replica_counts))
+        if replica_counts.get(src, 0) - replica_counts.get(dst, 0) < self.balance_region_threshold:
+            return None
+        self._moves[region.id] = [src, dst, now + self.operator_ttl, None]
+        return {"type": "add_peer", "store_id": dst}
 
     def report_split(self, left: Region, right: Region) -> None:
         with self._mu:

@@ -34,15 +34,35 @@ FIRST_REGION_ID = 1
 
 
 class StoreNode:
-    """One store: engine + Store + raft loops + TCP server (a TiKVServer)."""
+    """One store: engine + Store + raft loops + TCP server (a TiKVServer).
 
-    def __init__(self, cluster: "ServerCluster", store_id: int, engine=None):
+    ``full_service`` additionally assembles the txn stack — RaftKv, Storage,
+    and a WaiterManager whose detector forwards wait-for edges to the
+    cluster's detector leader — so scenario tests can drive transactional
+    RPCs (pessimistic locks, deadlocks) across real stores."""
+
+    def __init__(self, cluster: "ServerCluster", store_id: int, engine=None,
+                 full_service: bool = False):
         self.cluster = cluster
         security = cluster.security
         self.transport = RemoteTransport(cluster.resolve, security=security)
         self.node = Node(cluster.pd, self.transport, store_id=store_id, engine=engine)
         self.store = self.node.store
-        self.service = KvService(storage=None, raft_router=self.store)
+        if full_service:
+            from ..storage.storage import Storage
+            from .lock_manager import DetectorHandle, WaiterManager
+
+            self.raftkv = RaftKv(self.store)
+            self.lock_manager = WaiterManager(
+                detector=DetectorHandle(self.store, cluster.resolve, security=security)
+            )
+            self.service = KvService(
+                Storage(engine=self.raftkv), raft_router=self.store,
+                lock_manager=self.lock_manager, pd=cluster.pd,
+            )
+        else:
+            self.lock_manager = None
+            self.service = KvService(storage=None, raft_router=self.store)
         self.server = Server(self.service, security=security)
         self.running = False
 
@@ -58,6 +78,8 @@ class StoreNode:
         self.node.stop()
         self.server.stop()
         self.transport.close()
+        if self.lock_manager is not None:
+            self.lock_manager.close()
 
 
 class ServerCluster:
@@ -67,6 +89,7 @@ class ServerCluster:
         pd: MockPd | None = None,
         engines: dict | None = None,
         security=None,
+        full_service: bool = False,
     ):
         self.security = security
         self.pd = pd or MockPd()
@@ -75,7 +98,8 @@ class ServerCluster:
         self._ids = itertools.count(5000)
         self._engines = engines or {}
         for sid in range(1, n_stores + 1):
-            self.nodes[sid] = StoreNode(self, sid, engine=self._engines.get(sid))
+            self.nodes[sid] = StoreNode(self, sid, engine=self._engines.get(sid),
+                                        full_service=full_service)
 
     # -- addressing (resolve.rs: store id -> socket addr through PD) --------
 

@@ -17,12 +17,13 @@ class Debugger:
         self.engine = engine
         # the store's log engine (native/raftlog.py) when enabled: region
         # surgery must wipe entries + hard state there too, or recover()
-        # would restore stale votes/entries beside freshly written meta
-        self.raft_log = raft_log
+        # would restore stale votes/entries beside freshly written meta.
+        # (named *_engine: `raft_log` is already this class's inspection RPC)
+        self.raft_log_engine = raft_log
 
     def _clean_raft_log(self, region_id: int) -> None:
-        if self.raft_log is not None:
-            self.raft_log.clean(region_id)
+        if self.raft_log_engine is not None:
+            self.raft_log_engine.clean(region_id)
 
     def get(self, cf: str, raw_key: bytes) -> bytes | None:
         return self.engine.get_cf(cf, keys.data_key(raw_key))

@@ -71,6 +71,113 @@ class DeadlockDetector:
             self.waits_for.pop(txn_ts, None)
 
 
+FIRST_REGION_ID = 1
+
+_LEADER_UNSET = object()
+
+
+class DetectorHandle:
+    """Cluster-wide deadlock detection (deadlock.rs:343-391): the store
+    holding REGION 1's leadership is the detector authority; every other
+    store forwards its wait-for edges there over the wire (client.rs role).
+
+    Role tracking is lazy: each call re-reads region 1's leader from the
+    local raft store; when the observed leader changes, the local graph is
+    reset (the reference clears on role-change callbacks — same effect, no
+    observer plumbing).  When the leader is unknown or unreachable the edge
+    degrades to the LOCAL graph: cross-store cycles then resolve by waiter
+    timeout instead of detection — never a false positive."""
+
+    def __init__(self, store, resolve, security=None):
+        self.store = store          # raft store: leadership lookups
+        self.resolve = resolve      # store_id -> (host, port) | None
+        self.security = security
+        self.local = DeadlockDetector()
+        self._mu = threading.Lock()
+        self._clients: dict[int, object] = {}
+        self._last_leader: object = _LEADER_UNSET
+
+    # -- leadership --------------------------------------------------------
+
+    def _leader(self) -> int | None:
+        leader = self.store.leader_store_of(FIRST_REGION_ID)
+        with self._mu:
+            if self._last_leader is not _LEADER_UNSET and leader != self._last_leader:
+                # role CHANGED (not merely first observed — edges forwarded
+                # to us before our first local detect must survive): the
+                # graph we held is stale authority
+                self.local = DeadlockDetector()
+            self._last_leader = leader
+        return leader
+
+    def _call_leader(self, leader: int, payload: dict) -> dict | None:
+        """One forwarded detector RPC; None = unreachable (degrade local)."""
+        from .server import Client
+
+        with self._mu:
+            c = self._clients.get(leader)
+        if c is None:
+            addr = self.resolve(leader)
+            if addr is None:
+                return None
+            try:
+                c = Client(addr[0], addr[1], security=self.security)
+            except OSError:
+                return None
+            with self._mu:
+                self._clients[leader] = c
+        try:
+            return c.call("deadlock_detect", payload, timeout=2.0)
+        except (ConnectionError, TimeoutError, OSError):
+            with self._mu:
+                self._clients.pop(leader, None)
+            return None
+
+    # -- DeadlockDetector surface (duck-typed for WaiterManager) -----------
+
+    def detect(self, waiter_ts: int, lock_ts: int) -> None:
+        leader = self._leader()
+        if leader is None or leader == self.store.store_id:
+            self.local.detect(waiter_ts, lock_ts)
+            return
+        resp = self._call_leader(
+            leader, {"tp": "detect", "waiter_ts": waiter_ts, "lock_ts": lock_ts}
+        )
+        if resp is None or resp.get("not_leader") or resp.get("error"):
+            # unreachable, stale leadership, or a leader that cannot serve
+            # the detector RPC: degrade to the local graph (the edge must be
+            # recorded SOMEWHERE or the cycle check silently disappears)
+            self.local.detect(waiter_ts, lock_ts)
+            return
+        dl = resp.get("deadlock")
+        if dl:
+            raise DeadlockError(dl["waiting_txn"], dl["blocked_on_txn"], dl["cycle"])
+
+    def _forward_cleanup(self, payload: dict) -> None:
+        leader = self._leader()
+        if leader is not None and leader != self.store.store_id:
+            self._call_leader(leader, payload)
+
+    def clean_up_wait_for(self, waiter_ts: int, lock_ts: int) -> None:
+        self.local.clean_up_wait_for(waiter_ts, lock_ts)
+        self._forward_cleanup(
+            {"tp": "clean_up_wait_for", "waiter_ts": waiter_ts, "lock_ts": lock_ts}
+        )
+
+    def clean_up(self, txn_ts: int) -> None:
+        self.local.clean_up(txn_ts)
+        self._forward_cleanup({"tp": "clean_up", "txn_ts": txn_ts})
+
+    def close(self) -> None:
+        with self._mu:
+            for c in self._clients.values():
+                try:
+                    c.close()
+                except OSError:
+                    pass
+            self._clients.clear()
+
+
 @dataclass
 class Waiter:
     start_ts: int
@@ -105,6 +212,12 @@ class WaiterManager:
                 q = self._queues.get(key)
                 if q and w in q:
                     q.remove(w)
+
+    def close(self) -> None:
+        """Release detector resources (forwarding sockets + reader threads)."""
+        close = getattr(self.detector, "close", None)
+        if close is not None:
+            close()
 
     def wait_info(self) -> list[dict]:
         """Current waits: who waits on whom for which key (the

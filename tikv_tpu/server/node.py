@@ -129,7 +129,13 @@ class Node:
         def pd_loop():
             while not self._stop.is_set():
                 try:
-                    self.pd.store_heartbeat(self.store_id, {"regions": len(self.store.peers)})
+                    stats = {"regions": len(self.store.peers)}
+                    mem_bytes = getattr(self.store.engine, "mem_bytes", None)
+                    if mem_bytes is not None:
+                        # size-weighted balance input (store_heartbeat
+                        # capacity/used stats, pd.rs:101)
+                        stats["used_bytes"] = mem_bytes()
+                    self.pd.store_heartbeat(self.store_id, stats)
                     led = set()
                     for peer in list(self.store.peers.values()):
                         if peer.node.is_leader():

@@ -9,6 +9,8 @@ commands don't block the socket reader.
 
 from __future__ import annotations
 
+import inspect
+import queue
 import socket
 import struct
 import threading
@@ -27,6 +29,13 @@ _MAX_FRAME = 64 << 20
 # read-path RPCs go through the unified read pool (src/read_pool.rs routes
 # point gets / scans / coprocessor there); writes keep the plain executor so
 # a saturated analytical workload can't starve the write path's threads
+# max unacked streamed frames in flight per stream (gRPC window analog);
+# both sides hold at most this many frames regardless of consumer speed
+STREAM_WINDOW = 8
+# a stream whose consumer sends no ack (and no cancel) for this long is
+# dropped so it cannot pin a read-pool worker indefinitely
+STREAM_IDLE_TIMEOUT = 300.0
+
 _READ_METHODS = (
     "kv_get", "kv_batch_get", "kv_scan", "kv_scan_lock",
     "raw_get", "raw_batch_get", "raw_scan", "raw_batch_scan", "raw_get_key_ttl",
@@ -132,12 +141,36 @@ class Server:
 
     def _serve_conn(self, conn: socket.socket) -> None:
         send_mu = threading.Lock()
+        # per-stream flow-control credits (the gRPC window role): a stream's
+        # writer may have at most STREAM_WINDOW unacked frames in flight;
+        # the client acks as its consumer drains, so memory is O(window)
+        # on BOTH sides no matter how slow the consumer is
+        stream_credits: dict[int, threading.Semaphore] = {}
+        stream_cancelled: set[int] = set()
+        conn_dead = threading.Event()
         try:
             while not self._stop.is_set():
                 frame = read_frame(conn)
                 if frame is None:
                     return
                 req_id, method, request = wire.loads(frame)
+
+                if method == "_stream_ack":
+                    sem = stream_credits.get(request.get("id"))
+                    if sem is not None:
+                        for _ in range(int(request.get("n", 1))):
+                            sem.release()
+                    continue
+                if method == "_stream_cancel":
+                    sid = request.get("id")
+                    # record the cancel even when the stream's writer has
+                    # not registered yet (request still queued in the pool):
+                    # the writer checks this set right after registering
+                    stream_cancelled.add(sid)
+                    sem = stream_credits.get(sid)
+                    if sem is not None:
+                        sem.release()  # wake the parked writer to notice
+                    continue
 
                 if req_id == 0:
                     # oneway frame (peer raft traffic): no response, and run
@@ -161,7 +194,45 @@ class Server:
                             resp = self.service.dispatch(method, request)
                     except Exception as e:  # noqa: BLE001 — wire boundary
                         resp = {"error": {"other": repr(e), "code": error_code.code_of(e)}}
-                    payload = wire.dumps([req_id, resp])
+                    if inspect.isgenerator(resp):
+                        # server-streaming response (endpoint.rs:508): one
+                        # wire frame per yielded item, same req_id, closed by
+                        # a stream_end frame.  send_mu is taken PER FRAME so
+                        # a long stream interleaves with other responses on
+                        # the connection; the credit window caps in-flight
+                        # frames so neither side buffers more than O(window).
+                        sem = threading.Semaphore(STREAM_WINDOW)
+                        stream_credits[req_id] = sem
+                        final = {"stream_end": True}
+                        try:
+                            if req_id in stream_cancelled:
+                                return  # cancelled before we even started
+                            for item in resp:
+                                # bounded park: a consumer that neither acks
+                                # nor cancels must not pin this pool worker
+                                # forever (STREAM_IDLE_TIMEOUT)
+                                stalled = 0.0
+                                while not sem.acquire(timeout=1.0):
+                                    stalled += 1.0
+                                    if (conn_dead.is_set() or self._stop.is_set()
+                                            or stalled >= STREAM_IDLE_TIMEOUT):
+                                        return  # consumer gone; drop stream
+                                if req_id in stream_cancelled:
+                                    return  # consumer abandoned the stream
+                                payload = wire.dumps([req_id, {"stream": item}])
+                                with send_mu:
+                                    write_frame(conn, payload)
+                        except OSError:
+                            return  # client went away mid-stream
+                        except Exception as e:  # noqa: BLE001 — wire boundary
+                            final["error"] = {"other": repr(e),
+                                              "code": error_code.code_of(e)}
+                        finally:
+                            stream_credits.pop(req_id, None)
+                            stream_cancelled.discard(req_id)
+                        payload = wire.dumps([req_id, final])
+                    else:
+                        payload = wire.dumps([req_id, resp])
                     with send_mu:
                         try:
                             write_frame(conn, payload)
@@ -200,6 +271,7 @@ class Server:
         except (ConnectionError, ValueError, OSError):
             pass
         finally:
+            conn_dead.set()  # wake any stream writer parked on credits
             conn.close()
 
     def stop(self) -> None:
@@ -209,6 +281,9 @@ class Server:
         with self._read_pool_mu:
             if self._read_pool is not None:
                 self._read_pool.stop()
+
+
+_STREAM_DEAD = object()  # sentinel: connection died under an open stream
 
 
 class Client:
@@ -226,6 +301,9 @@ class Client:
         self._next_id = 0
         self._pending: dict[int, threading.Event] = {}
         self._results: dict[int, object] = {}
+        # server-streaming calls: req_id -> bounded frame queue; the reader
+        # pushes each same-id frame, the consumer iterates (call_stream)
+        self._streams: dict[int, queue.Queue] = {}
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 
@@ -237,16 +315,26 @@ class Client:
                     return
                 req_id, resp = wire.loads(frame)
                 with self._mu:
-                    self._results[req_id] = resp
+                    q = self._streams.get(req_id)
+                    if q is not None:
+                        if isinstance(resp, dict) and resp.get("stream_end"):
+                            del self._streams[req_id]
+                        q.put(resp)
+                        continue
                     ev = self._pending.pop(req_id, None)
-                if ev is not None:
-                    ev.set()
+                    if ev is None:
+                        continue  # late frame for a cancelled/timed-out call
+                    self._results[req_id] = resp
+                ev.set()
         except (ConnectionError, OSError, ValueError):
             with self._mu:
                 self._dead = True
                 for ev in self._pending.values():
                     ev.set()
                 self._pending.clear()
+                for q in self._streams.values():
+                    q.put(_STREAM_DEAD)
+                self._streams.clear()
 
     def call(self, method: str, request: dict, timeout: float = 30.0):
         with self._mu:
@@ -259,11 +347,84 @@ class Client:
         with self._send_mu:
             write_frame(self._sock, wire.dumps([req_id, method, request]))
         if not ev.wait(timeout):
+            with self._mu:
+                # deregister so a late response is dropped, not leaked
+                self._pending.pop(req_id, None)
+                self._results.pop(req_id, None)
             raise TimeoutError(f"{method} timed out")
         with self._mu:
             if req_id not in self._results:
                 raise ConnectionError(f"connection lost during {method}")
             return self._results.pop(req_id)
+
+    def call_stream(self, method: str, request: dict, timeout: float = 30.0):
+        """Server-streaming call: returns an iterator yielding each streamed
+        item as the server produces it (kv.rs coprocessor_stream:574).  The
+        request is sent EAGERLY (before the first next()); in-flight frames
+        are capped by the server-side credit window, and the final
+        stream_end frame may carry a mid-stream execution error, raised on
+        the consumer."""
+        with self._mu:
+            if self._dead:
+                raise ConnectionError("connection is closed")
+            self._next_id += 1
+            req_id = self._next_id
+            q: queue.Queue = queue.Queue()
+            self._streams[req_id] = q
+        with self._send_mu:
+            write_frame(self._sock, wire.dumps([req_id, method, request]))
+        return self._stream_iter(method, req_id, q, timeout)
+
+    def _stream_iter(self, method: str, req_id: int, q: "queue.Queue", timeout: float):
+        finished = False
+        try:
+            while True:
+                try:
+                    resp = q.get(timeout=timeout)
+                except queue.Empty:
+                    raise TimeoutError(f"{method} stream timed out") from None
+                if resp is _STREAM_DEAD:
+                    finished = True
+                    raise ConnectionError(f"connection lost during {method}")
+                if isinstance(resp, dict) and resp.get("stream_end"):
+                    finished = True
+                    if resp.get("error"):
+                        raise RuntimeError(f"{method} failed mid-stream: {resp['error']}")
+                    return
+                if isinstance(resp, dict) and "stream" in resp:
+                    yield resp["stream"]
+                    # consumer drained one frame: grant the server one
+                    # credit (oneway ack — no response expected)
+                    try:
+                        with self._send_mu:
+                            write_frame(self._sock, wire.dumps(
+                                [0, "_stream_ack", {"id": req_id, "n": 1}]))
+                    except OSError:
+                        finished = True
+                        raise ConnectionError(f"connection lost during {method}")
+                else:
+                    # unary shape: pre-stream validation error (or a non-
+                    # streaming server) — no stream_end will follow, so the
+                    # registration must be dropped here, not by _read_loop
+                    finished = True
+                    with self._mu:
+                        self._streams.pop(req_id, None)
+                    if isinstance(resp, dict) and resp.get("error"):
+                        raise RuntimeError(f"{method} failed: {resp['error']}")
+                    yield resp
+                    return
+        finally:
+            if not finished:
+                # consumer abandoned the stream early: tell the server so
+                # its writer doesn't stay parked waiting for credits
+                with self._mu:
+                    self._streams.pop(req_id, None)
+                try:
+                    with self._send_mu:
+                        write_frame(self._sock, wire.dumps(
+                            [0, "_stream_cancel", {"id": req_id}]))
+                except OSError:
+                    pass
 
     def close(self) -> None:
         self._sock.close()

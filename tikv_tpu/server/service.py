@@ -105,7 +105,7 @@ class KvService:
         {
             "register_lock_observer", "check_lock_observer", "remove_lock_observer",
             "physical_scan_lock", "unsafe_destroy_range", "get_store_safe_ts",
-            "get_lock_wait_info",
+            "get_lock_wait_info", "deadlock_detect",
         }
     )
 
@@ -388,6 +388,42 @@ class KvService:
                 return {"error": _err(e2)}
         except Exception as e:  # noqa: BLE001
             return {"error": _err(e)}
+
+    def deadlock_detect(self, req: dict) -> dict:
+        """Detector-leader ingress (the reference's separate Deadlock gRPC
+        service, deadlock.rs:343-391): remote stores forward wait-for edges
+        here; only the store holding region 1's leadership answers with
+        authority."""
+        from .lock_manager import DeadlockError, DetectorHandle, FIRST_REGION_ID
+
+        if self.lock_manager is None:
+            return {"error": {"other": "lock manager not enabled"}}
+        det = self.lock_manager.detector
+        if isinstance(det, DetectorHandle):
+            router = self.raft_router
+            if router is not None and \
+                    router.leader_store_of(FIRST_REGION_ID) != router.store_id:
+                return {"not_leader": True}
+            det = det.local
+        tp = req.get("tp")
+        try:
+            if tp == "detect":
+                det.detect(req["waiter_ts"], req["lock_ts"])
+            elif tp == "clean_up_wait_for":
+                det.clean_up_wait_for(req["waiter_ts"], req["lock_ts"])
+            elif tp == "clean_up":
+                det.clean_up(req["txn_ts"])
+            else:
+                return {"error": {"other": f"unknown detect tp {tp!r}"}}
+        except DeadlockError as de:
+            return {
+                "deadlock": {
+                    "waiting_txn": de.waiting_txn,
+                    "blocked_on_txn": de.blocked_on_txn,
+                    "cycle": de.cycle,
+                }
+            }
+        return {"ok": True}
 
     def _wake_lock_waiters(self, released_ts: int) -> None:
         """Commit/rollback/resolve released this txn's locks: wake waiters
@@ -887,10 +923,14 @@ class KvService:
         except Exception as e:  # noqa: BLE001
             return {"error": _err(e)}
 
-    def coprocessor_stream(self, req: dict) -> dict:
-        """Streamed DAG execution: one wire response carrying ordered frames
-        (the TCP layer multiplexes whole responses; chunked frames preserve
-        the reference's bounded-memory property server-side)."""
+    def coprocessor_stream(self, req: dict):
+        """Streamed DAG execution (endpoint.rs:508-584): returns a GENERATOR
+        of per-frame dicts.  The server writes each frame to the wire as it
+        is produced (same req_id, terminated by a stream_end frame), so
+        server-side memory stays O(one frame) and a slow client back-
+        pressures the executor through TCP instead of ballooning a buffer.
+        Validation errors before the first frame return a plain error dict
+        (the unary shape)."""
         assert self.copr is not None, "coprocessor endpoint not wired"
         try:
             dag = req.get("dag")
@@ -907,12 +947,12 @@ class KvService:
                 start_ts=req["start_ts"],
                 context=req.get("context") or {},
             )
-            frames = [
-                r.data
-                for r in self.copr.handle_streaming_request(
-                    creq, req.get("rows_per_stream", 1024)
-                )
-            ]
-            return {"frames": frames}
+            rows_per_stream = req.get("rows_per_stream", 1024)
         except Exception as e:  # noqa: BLE001
             return {"error": _err(e)}
+
+        def frames():
+            for r in self.copr.handle_streaming_request(creq, rows_per_stream):
+                yield {"data": r.data}
+
+        return frames()

@@ -110,7 +110,7 @@ class StoreServer:
         from ..sidecar.resolved_ts import ResolvedTsEndpoint
         from .diagnostics import Diagnostics
         from .gc_worker import GcWorker
-        from .lock_manager import WaiterManager
+        from .lock_manager import DetectorHandle, WaiterManager
 
         self.resolved_ts = ResolvedTsEndpoint(pd)
         self.resolved_ts.attach_store(self.store)
@@ -121,7 +121,11 @@ class StoreServer:
             mesh=_default_mesh() if enable_device else None,
         )
         self.gc_worker = GcWorker(self.raftkv)
-        self.lock_manager = WaiterManager()
+        # wait-for edges route to the cluster detector leader (region 1's
+        # leader store); cross-store lock cycles break by error, not timeout
+        self.lock_manager = WaiterManager(
+            detector=DetectorHandle(self.store, self._resolve, security=security)
+        )
         self.service = KvService(
             self.storage,
             self.copr,
@@ -178,6 +182,7 @@ class StoreServer:
         self.node.stop()
         self.server.stop()
         self.transport.close()
+        self.lock_manager.close()
         close = getattr(self.engine, "close", None)
         if close is not None:
             close()
