@@ -48,7 +48,7 @@ def test_cross_store_cycle_broken_by_error_not_timeout(cluster3f):
     left_leader = c.wait_leader(FIRST_REGION_ID)
     detector_sid = left_leader.store.store_id
     other = next(s for s in (1, 2, 3) if s != detector_sid)
-    c.transfer_leader(right_id, other)
+    c.transfer_leader(right_id, other, timeout=30.0)
 
     cl_left, sid_left, _ = _lock_client(c, FIRST_REGION_ID)
     cl_right, sid_right, _ = _lock_client(c, right_id)

@@ -133,6 +133,13 @@ struct Engine {
   uint64_t seq = 0;
   std::multiset<uint64_t> snapshots;
   mutable std::shared_mutex mu;
+  // Writer serialization, SEPARATE from mu: the WAL append + fdatasync —
+  // the slow part of every commit — runs under write_mu only, so readers
+  // (shared mu) never stall behind a disk sync; mu is then taken unique
+  // just for the in-memory apply + seq publish.  Lock order: write_mu
+  // before mu, always.  WAL state (wal_fd/sync_mode/failed) is guarded by
+  // write_mu; memtables/runs/seq/snapshots stay under mu.
+  std::mutex write_mu;
   // sorted runs per CF, NEWEST FIRST: all versions in runs[cf][i] are newer
   // than any in runs[cf][i+1], and the memtable is newer than every run
   std::vector<std::shared_ptr<Run>> runs[kNumCfs];
@@ -1746,27 +1753,36 @@ void eng_close(void* h) {
 
 int eng_write(void* h, const uint8_t* data, uint64_t len) {
   Engine* e = static_cast<Engine*>(h);
-  std::unique_lock lk(e->mu);
+  std::unique_lock wlk(e->write_mu);
   if (e->failed) return -5;
   // validate BEFORE logging: a malformed batch must never reach the WAL
   int r = validate_batch(data, len);
   if (r != 0) return r;
+  // seq is only mutated by writers, and writers serialize on write_mu —
+  // reading it here without mu races nothing
   uint64_t seq = e->seq + 1;
   // WAL first: a batch is committed iff its record is durable (fsync'd
-  // before apply, exactly rocksdb's WriteBatch-then-memtable order)
+  // before apply, exactly rocksdb's WriteBatch-then-memtable order).
+  // Deliberately OUTSIDE mu: the fdatasync must not stall readers.
   if (wal_append(e, seq, data, len) != 0) {
     e->failed = true;
     return -4;
   }
-  r = apply_batch(e, data, len, seq);
-  if (r != 0) return r;  // unreachable after validate; defensive
-  e->seq = seq;
-  if (!e->dir.empty() &&
-      ((e->wal_limit > 0 && e->wal_bytes >= e->wal_limit) ||
-       (e->mem_limit > 0 && e->mem_bytes >= e->mem_limit))) {
+  bool need_flush;
+  {
+    std::unique_lock lk(e->mu);
+    r = apply_batch(e, data, len, seq);
+    if (r != 0) return r;  // unreachable after validate; defensive
+    e->seq = seq;
+    need_flush = !e->dir.empty() &&
+        ((e->wal_limit > 0 && e->wal_bytes >= e->wal_limit) ||
+         (e->mem_limit > 0 && e->mem_bytes >= e->mem_limit));
+  }
+  if (need_flush) {
     // inline memtable flush (rocksdb's memtable-full write stall, bounded
     // by memtable size — never O(database)); a failed flush that lost its
     // log fd must stop acking writes, not go silently non-durable
+    std::unique_lock lk(e->mu);
     if (flush_memtable(e) != 0 && e->wal_fd < 0) e->failed = true;
   }
   return 0;
@@ -1803,6 +1819,7 @@ int eng_build_sst(const char* path, const uint8_t* body, uint64_t len) {
 // is loaded in place (no copy, no WAL).
 int eng_ingest_sst(void* h, const char* src_path) {
   Engine* e = static_cast<Engine*>(h);
+  std::unique_lock wlk(e->write_mu);  // WAL writer: ahead of mu (lock order)
   std::unique_lock lk(e->mu);
   if (e->failed) return -5;
   FILE* f = fopen(src_path, "rb");
@@ -1872,6 +1889,7 @@ int eng_checkpoint(void* h) {
   // (The legacy O(DB) full-state spill is gone; ckpt_load remains for
   // reading directories written by it.)
   Engine* e = static_cast<Engine*>(h);
+  std::unique_lock wlk(e->write_mu);  // flush rotates the WAL segment
   std::unique_lock lk(e->mu);
   if (e->dir.empty()) return -1;
   int r = flush_memtable(e);
@@ -1930,7 +1948,7 @@ void eng_set_wal_limit(void* h, uint64_t bytes) {
 // than promising per-commit durability it cannot deliver.
 int eng_set_sync(void* h, int sync_mode) {
   Engine* e = static_cast<Engine*>(h);
-  std::unique_lock lk(e->mu);
+  std::unique_lock wlk(e->write_mu);  // WAL state lives under write_mu
   if (e->sync_mode == 0 && sync_mode == 1 && e->wal_fd >= 0) {
     if (fdatasync(e->wal_fd) != 0) {
       e->failed = true;
@@ -1955,7 +1973,7 @@ uint64_t eng_mem_bytes(void* h) {
 
 uint64_t eng_wal_bytes(void* h) {
   Engine* e = static_cast<Engine*>(h);
-  std::shared_lock lk(e->mu);
+  std::lock_guard<std::mutex> wlk(e->write_mu);  // wal state's guard
   return e->wal_bytes;
 }
 

@@ -53,6 +53,9 @@ class Node:
         # instead of silently killing the daemon thread; apply re-delivery is
         # handled by the store (Peer.handle_ready rewinds on failure)
         self.thread_errors: list[Exception] = []
+        # callables invoked once per store heartbeat (memory-trace polling,
+        # CDC idle reaping, ...); exceptions land in thread_errors
+        self.heartbeat_hooks: list = []
         pd.put_store(self.store_id)
         self.store.split_observers.append(self._on_split)
         if split_qps_threshold is not None:
@@ -153,6 +156,8 @@ class Node:
                             self._hot_beats.pop(rid, None)
                     self._maybe_consistency_check()
                     self.store.request_log_compaction()
+                    for hook in self.heartbeat_hooks:
+                        hook()
                 except Exception as exc:  # PD briefly unreachable: keep beating
                     if len(self.thread_errors) < 128:
                         self.thread_errors.append(exc)

@@ -14,6 +14,7 @@ Run:  python -m tikv_tpu.server.standalone \
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -126,6 +127,40 @@ class StoreServer:
         self.lock_manager = WaiterManager(
             detector=DetectorHandle(self.store, self._resolve, security=security)
         )
+        # store-wide memory attribution (tikv_util memory.rs MemoryTrace +
+        # the server's memory-usage high-water): engine memtables, raft log
+        # segments and CDC sink buffers report in; crossing the high-water
+        # flushes the memtable — shedding instead of growing
+        from ..sidecar.cdc import CdcService
+        from ..util.memory import StoreMemoryTrace
+
+        self.memory_trace = StoreMemoryTrace(f"store-{store_id}")
+        if hasattr(self.engine, "mem_bytes"):
+            self.memory_trace.child("engine_memtables", provider=self.engine.mem_bytes)
+        if hasattr(self.engine, "wal_bytes"):
+            self.memory_trace.child("engine_wal", provider=self.engine.wal_bytes)
+        if self.raft_log is not None:
+            self.memory_trace.child(
+                "raft_log", provider=lambda: self.raft_log.stats()["active_size"]
+            )
+        self.cdc = CdcService(self.store, memory_trace=self.memory_trace)
+        if hasattr(self.engine, "flush"):
+            self.memory_trace.set_high_water(
+                int(os.environ.get("TIKV_TPU_MEMORY_HIGH_WATER", str(4 << 30))),
+                lambda total: self.engine.flush(),
+            )
+        # provider-backed trace nodes grow without add() calls: the heartbeat
+        # re-evaluates the high-water condition, and reaps CDC subscriptions
+        # whose client vanished (their buffers pin the shared quota)
+        self.node.heartbeat_hooks.append(self.memory_trace.poll)
+        self.node.heartbeat_hooks.append(lambda: self.cdc.reap_idle())
+        # operator HTTP surface (status_server/mod.rs): /metrics, /status,
+        # /debug/pprof/*, /debug/memory (the attribution tree above)
+        from .status_server import StatusServer
+
+        self.status_server = StatusServer(
+            security=security, memory_trace=self.memory_trace
+        )
         self.service = KvService(
             self.storage,
             self.copr,
@@ -136,6 +171,7 @@ class StoreServer:
             lock_manager=self.lock_manager,
             resolved_ts=self.resolved_ts,
             diagnostics=Diagnostics(),
+            cdc=self.cdc,
         )
         self.server = Server(self.service, host=host, port=port, security=security)
         self.recovered_peers = recovered
@@ -148,6 +184,7 @@ class StoreServer:
 
     def start(self) -> None:
         self.server.start()
+        self.status_server.start()
         self.pd.put_store(self.store.store_id, addr=self.server.addr)
         self.node.start()
 
@@ -181,6 +218,7 @@ class StoreServer:
     def stop(self) -> None:
         self.node.stop()
         self.server.stop()
+        self.status_server.stop()
         self.transport.close()
         self.lock_manager.close()
         close = getattr(self.engine, "close", None)

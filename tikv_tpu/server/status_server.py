@@ -21,11 +21,12 @@ from .profiler import Profiler
 
 class StatusServer:
     def __init__(self, controller: ConfigController | None = None, host="127.0.0.1", port=0, registry=None,
-                 security=None):
+                 security=None, memory_trace=None):
         self.controller = controller
         self.security = security
         self.registry = registry or REGISTRY
         self.profiler = Profiler()
+        self.memory_trace = memory_trace
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -74,6 +75,13 @@ class StatusServer:
                         return
                     ctype = "application/octet-stream" if raw else "text/plain"
                     self._send(200, body, ctype)
+                elif url.path == "/debug/memory":
+                    # the store's memory-attribution tree (MemoryTrace)
+                    if outer.memory_trace is None:
+                        self._send(404, b"no memory trace wired")
+                        return
+                    self._send(200, json.dumps(outer.memory_trace.snapshot()).encode(),
+                               "application/json")
                 elif url.path == "/debug/pprof/heap":
                     q = parse_qs(url.query)
                     try:
@@ -114,5 +122,8 @@ class StatusServer:
         self._thread.start()
 
     def stop(self) -> None:
-        self._httpd.shutdown()
+        # shutdown() BLOCKS until serve_forever acknowledges — which never
+        # happens when the server was constructed but not started
+        if self._thread is not None:
+            self._httpd.shutdown()
         self._httpd.server_close()

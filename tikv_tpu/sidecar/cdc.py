@@ -11,6 +11,7 @@ live events gated by the resolver's resolved-ts watermark.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 
 from ..storage.engine import CF_DEFAULT, CF_LOCK, CF_WRITE
@@ -28,6 +29,17 @@ class ChangeEvent:
     commit_ts: int
 
 
+def _event_bytes(event: ChangeEvent) -> int:
+    """Approximate resident size of one buffered event (channel.rs
+    CdcEvent::size role): payload bytes + fixed object overhead."""
+    n = 96 + len(event.key)
+    if event.value is not None:
+        n += len(event.value)
+    if event.old_value is not None:
+        n += len(event.old_value)
+    return n
+
+
 class Sink:
     """Downstream consumer (channel.rs's memory-quota sink, simplified)."""
 
@@ -39,6 +51,12 @@ class Sink:
     def emit(self, event: ChangeEvent) -> None:
         with self._mu:
             self.events.append(event)
+
+    def emit_scan(self, event: ChangeEvent) -> bool:
+        """Incremental-scan emission; quota-bounded sinks override this to
+        PAUSE the scanner when full.  True = accepted, keep scanning."""
+        self.emit(event)
+        return True
 
     def emit_resolved(self, region_id: int, ts: int) -> None:
         with self._mu:
@@ -101,15 +119,19 @@ class CdcObserver:
             self.delegates.pop(region_id, None)
 
     def incremental_scan(self, snapshot, region_id: int, start_ts: int) -> int:
-        """Emit existing committed data up to ``start_ts`` (scanner.rs)."""
+        """Emit existing committed data up to ``start_ts`` (scanner.rs).
+        Quota-bounded sinks PAUSE the scan while the buffer is full — the
+        client's drains release quota and the scan resumes; a sink that
+        stays full past its patience aborts the scan (congested)."""
         from ..storage.mvcc import ForwardScanner
 
         d = self.subscribe(region_id)
         n = 0
         for raw_key, value in ForwardScanner(snapshot, start_ts, None, None):
-            self.sink.emit(
+            if not self.sink.emit_scan(
                 ChangeEvent(region_id, raw_key, "put", value, None, 0, start_ts)
-            )
+            ):
+                break  # congested beyond patience: subscription is torn down
             n += 1
         return n
 
@@ -204,24 +226,79 @@ class _DataView:
 class SeqSink(Sink):
     """Sink with per-event sequence numbers so wire clients pull-resume
     (the push EventFeed stream adapted to the request/response transport:
-    register → pull events after a seq → deregister)."""
+    register → pull events after a seq → deregister).
 
-    def __init__(self):
+    Flow control (channel.rs memory-quota sink): buffered bytes charge the
+    shared ``quota``.  Delta events from the APPLY path must never block the
+    apply worker — when the quota is exhausted the sink turns CONGESTED and
+    the subscription is torn down on the next pull (the reference cancels
+    the downstream, which re-registers and re-scans).  Incremental-scan
+    emission instead PAUSES until the client drains.  Acked items release
+    their reservation in drain_after."""
+
+    def __init__(self, quota=None):
         super().__init__()
         self._seq = 0
         self._cv = threading.Condition(self._mu)
-        self.items: list[tuple[int, str, object]] = []  # (seq, kind, payload)
+        self.quota = quota
+        self.congested = False
+        self.closed = False
+        self.items: list[tuple[int, str, object, int]] = []  # (+byte size)
+
+    def _push(self, kind: str, payload, size: int) -> bool:
+        """Append under the sink lock, RE-CHECKING closed: close() freed the
+        quota of everything it saw — an allocation pushed after that must be
+        returned here or it leaks from the store-wide quota forever."""
+        with self._cv:
+            if self.closed:
+                if self.quota is not None:
+                    self.quota.free(size)
+                return False
+            self._seq += 1
+            self.items.append((self._seq, kind, payload, size))
+            self._cv.notify_all()
+            return True
 
     def emit(self, event: ChangeEvent) -> None:
-        with self._cv:
-            self._seq += 1
-            self.items.append((self._seq, "event", event))
-            self._cv.notify_all()
+        if self.congested or self.closed:
+            return  # tear-down already decided; rescan will recover these
+        size = _event_bytes(event)
+        if self.quota is not None and not self.quota.alloc(size):
+            self.congested = True
+            with self._cv:
+                self._cv.notify_all()
+            return
+        self._push("event", event, size)
+
+    def emit_scan(self, event: ChangeEvent) -> bool:
+        size = _event_bytes(event)
+        if self.quota is not None:
+            # alloc OUTSIDE the sink lock: drain_after needs the lock to
+            # free quota, so waiting under it would deadlock the pipeline
+            ok = self.quota.alloc_wait(
+                size, timeout=60.0,
+                cancelled=lambda: self.closed or self.congested,
+            )
+            if not ok:
+                self.congested = True
+                return False
+        return self._push("event", event, size)
 
     def emit_resolved(self, region_id: int, ts: int) -> None:
+        if self.congested or self.closed:
+            return
+        if self.quota is not None:
+            # watermarks are tiny and must not be dropped (force variant)
+            self.quota.alloc_force(32)
+        self._push("resolved", (region_id, ts), 32)
+
+    def close(self) -> None:
         with self._cv:
-            self._seq += 1
-            self.items.append((self._seq, "resolved", (region_id, ts)))
+            self.closed = True
+            if self.quota is not None:
+                for _seq, _kind, _payload, size in self.items:
+                    self.quota.free(size)
+            self.items.clear()
             self._cv.notify_all()
 
     def drain_after(
@@ -229,23 +306,32 @@ class SeqSink(Sink):
     ) -> list[tuple[int, str, object]]:
         with self._cv:
             # drop everything at or below the client's ack: memory stays
-            # bounded by the client's pull cadence
+            # bounded by the client's pull cadence, quota freed with it
+            freed = 0
             while self.items and self.items[0][0] <= after_seq:
-                self.items.pop(0)
-            if not self.items and timeout > 0:
+                freed += self.items.pop(0)[3]
+            if not self.items and timeout > 0 and not self.congested:
                 # long-poll: the push EventFeed's latency without its stream
+                if freed and self.quota is not None:
+                    self.quota.free(freed)
+                    freed = 0
                 self._cv.wait(timeout)
                 while self.items and self.items[0][0] <= after_seq:
-                    self.items.pop(0)
-            return list(self.items[:limit])
+                    freed += self.items.pop(0)[3]
+            out = [(s, k, p) for s, k, p, _sz in self.items[:limit]]
+        if freed and self.quota is not None:
+            self.quota.free(freed)
+        return out
 
 
 class CdcService:
     """The ChangeData service surface: one observer shared by the store's
     apply pipeline, per-subscription SeqSinks, pull-based event feed."""
 
-    def __init__(self, store, snapshot_fn=None):
+    def __init__(self, store, snapshot_fn=None, memory_quota_bytes: int = 64 << 20,
+                 memory_trace=None):
         from ..util import keys as keymod
+        from ..util.memory import MemoryQuota
 
         self.store = store
         # the store engine speaks the z-prefixed data keyspace; scans must see
@@ -255,7 +341,13 @@ class CdcService:
         )
         self._mu = threading.Lock()
         self._subs: dict[int, tuple[int, CdcObserver]] = {}  # sub_id -> (region, obs)
+        self._last_pull: dict[int, float] = {}  # sub_id -> monotonic of last events()
         self._next_id = 0
+        # ONE quota across every subscription's sink (channel.rs
+        # MemoryQuota): a slow downstream cannot balloon this store
+        self.quota = MemoryQuota(memory_quota_bytes)
+        if memory_trace is not None:
+            memory_trace.child("cdc_sinks", provider=self.quota.in_use)
         store.apply_observers.append(self._observe)
 
     def _observe(self, store, region, cmd):
@@ -272,7 +364,7 @@ class CdcService:
             return {"error": {"other": f"region {region_id} not on this store"}}
         if not peer.node.is_leader():
             return {"error": {"not_leader": region_id}}
-        obs = CdcObserver(sink=SeqSink())
+        obs = CdcObserver(sink=SeqSink(quota=self.quota))
         # install the delegate BEFORE taking the scan snapshot (the reference
         # does the same): an apply landing in between shows up as a delta
         # event — possibly duplicating a scan row, which is the documented
@@ -281,6 +373,7 @@ class CdcService:
             self._next_id += 1
             sub_id = self._next_id
             self._subs[sub_id] = (region_id, obs)
+            self._last_pull[sub_id] = time.monotonic()
         scanned = obs.incremental_scan(self._snapshot_fn(), region_id, checkpoint_ts)
         return {"sub_id": sub_id, "scanned": scanned}
 
@@ -289,6 +382,8 @@ class CdcService:
     ) -> dict:
         with self._mu:
             ent = self._subs.get(sub_id)
+            if ent is not None:
+                self._last_pull[sub_id] = time.monotonic()
         if ent is None:
             return {"error": {"other": f"unknown cdc subscription {sub_id}"}}
         region_id, obs = ent
@@ -298,6 +393,12 @@ class CdcService:
             # client re-registers against the new leader
             self.deregister(sub_id)
             return {"error": {"not_leader": region_id}}
+        if getattr(obs.sink, "congested", False):
+            # the downstream fell too far behind and the buffer hit its
+            # memory quota: cancel the subscription (the reference's
+            # congested error) — the client re-registers and re-scans
+            self.deregister(sub_id)
+            return {"error": {"congested": region_id}}
         out = []
         last = after_seq
         for seq, kind, payload in obs.sink.drain_after(after_seq, limit, timeout):
@@ -329,6 +430,24 @@ class CdcService:
     def deregister(self, sub_id: int) -> dict:
         with self._mu:
             ent = self._subs.pop(sub_id, None)
+            self._last_pull.pop(sub_id, None)
         if ent is not None:
             ent[1].unsubscribe(ent[0])
+            close = getattr(ent[1].sink, "close", None)
+            if close is not None:
+                close()  # release the sink's quota reservation
         return {}
+
+    def reap_idle(self, max_idle_s: float = 300.0) -> int:
+        """Tear down subscriptions whose client stopped pulling: a vanished
+        downstream must not hold its buffered bytes against the store-wide
+        quota forever (the reference detects this via its gRPC stream
+        closing; the pull transport needs an idle clock).  Call from the
+        store heartbeat."""
+        now = time.monotonic()
+        with self._mu:
+            stale = [sid for sid, t in self._last_pull.items()
+                     if now - t > max_idle_s]
+        for sid in stale:
+            self.deregister(sid)
+        return len(stale)

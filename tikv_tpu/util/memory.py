@@ -1,0 +1,175 @@
+"""Memory accounting: quotas and a hierarchical usage trace.
+
+Re-expression of ``components/tikv_util/src/memory.rs`` (``MemoryQuota``,
+``HeapSize``/``MemoryTrace``) and the server's memory-usage high-water check
+(``components/server/src/server.rs:129-131``): subsystems attribute their
+resident bytes to named nodes of a tree rooted at the store, quotas bound
+individual consumers (CDC sinks, apply batches), and a high-water callback
+fires when the tracked total crosses the configured mark so the store can
+shed load (flush memtables, drop caches) instead of growing unboundedly.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+
+class MemoryQuotaExceeded(RuntimeError):
+    pass
+
+
+class MemoryQuota:
+    """A byte budget shared by one consumer class (memory.rs MemoryQuota):
+    ``alloc`` either reserves or reports failure — the caller decides whether
+    to block, shed, or error.  ``free`` returns capacity and wakes blocked
+    allocators."""
+
+    def __init__(self, capacity: int):
+        self.capacity = int(capacity)
+        self._used = 0
+        self._cv = threading.Condition()
+
+    def in_use(self) -> int:
+        with self._cv:
+            return self._used
+
+    def alloc(self, n: int) -> bool:
+        with self._cv:
+            if self._used + n > self.capacity:
+                return False
+            self._used += n
+            return True
+
+    def alloc_force(self, n: int) -> None:
+        """Reserve even past capacity (the reference's force variant for
+        records that must not be dropped, e.g. resolved-ts events)."""
+        with self._cv:
+            self._used += n
+
+    def alloc_wait(self, n: int, timeout: float | None = None,
+                   cancelled: Callable[[], bool] | None = None) -> bool:
+        """Block until the reservation fits (producer pacing).  Returns False
+        on timeout or when ``cancelled()`` turns true."""
+        deadline = None if timeout is None else (threading.TIMEOUT_MAX
+                                                 if timeout < 0 else timeout)
+        with self._cv:
+            waited = 0.0
+            while self._used + n > self.capacity:
+                if cancelled is not None and cancelled():
+                    return False
+                step = 0.05
+                if deadline is not None and waited + step > deadline:
+                    return False
+                self._cv.wait(step)
+                waited += step
+            self._used += n
+            return True
+
+    def free(self, n: int) -> None:
+        with self._cv:
+            self._used = max(0, self._used - n)
+            self._cv.notify_all()
+
+
+class MemoryTrace:
+    """A named node in the store's memory-attribution tree (memory.rs
+    MemoryTrace): leaves accumulate bytes via add/sub or a ``provider``
+    callable (for subsystems that already track their own residency, e.g.
+    the native engine's mem_bytes); ``sum`` aggregates the subtree."""
+
+    def __init__(self, name: str, provider: Callable[[], int] | None = None):
+        self.name = name
+        self._provider = provider
+        self._bytes = 0
+        self._mu = threading.Lock()
+        self.children: dict[str, MemoryTrace] = {}
+        self._root: StoreMemoryTrace | None = None
+
+    def child(self, name: str, provider: Callable[[], int] | None = None) -> "MemoryTrace":
+        with self._mu:
+            c = self.children.get(name)
+            if c is None:
+                c = MemoryTrace(name, provider)
+                c._root = self._root
+                self.children[name] = c
+            return c
+
+    def add(self, n: int) -> None:
+        with self._mu:
+            self._bytes += n
+        root = self._root
+        if root is not None and n > 0:
+            root._maybe_high_water()
+
+    def sub(self, n: int) -> None:
+        with self._mu:
+            self._bytes = max(0, self._bytes - n)
+
+    def local(self) -> int:
+        with self._mu:
+            own = self._bytes
+        if self._provider is not None:
+            try:
+                own += int(self._provider())
+            except Exception:  # noqa: BLE001 — a dead provider reports 0
+                pass
+        return own
+
+    def sum(self) -> int:
+        total = self.local()
+        with self._mu:
+            kids = list(self.children.values())
+        return total + sum(c.sum() for c in kids)
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            kids = list(self.children.values())
+        out = {"name": self.name, "bytes": self.local(), "total": self.sum()}
+        if kids:
+            out["children"] = [c.snapshot() for c in kids]
+        return out
+
+
+class StoreMemoryTrace(MemoryTrace):
+    """The tree root, owning the high-water trigger: when the aggregated
+    total first crosses ``high_water_bytes`` the callback fires (once per
+    excursion — re-arms after usage falls below the mark), mirroring the
+    reference's memory-usage-limit check at server assembly."""
+
+    def __init__(self, name: str = "store"):
+        super().__init__(name)
+        self._root = self
+        self.high_water_bytes: int | None = None
+        self._on_high_water: Callable[[int], None] | None = None
+        self._armed = True
+        self._hw_mu = threading.Lock()
+
+    def set_high_water(self, bytes_: int, callback: Callable[[int], None]) -> None:
+        self.high_water_bytes = int(bytes_)
+        self._on_high_water = callback
+        self._armed = True
+
+    def _maybe_high_water(self) -> None:
+        hw = self.high_water_bytes
+        cb = self._on_high_water
+        if hw is None or cb is None:
+            return
+        with self._hw_mu:
+            total = self.sum()
+            if total >= hw and self._armed:
+                self._armed = False
+            elif total < hw:
+                self._armed = True
+                return
+            else:
+                return
+        try:
+            cb(total)
+        except Exception:  # noqa: BLE001 — shedding must not break the adder
+            pass
+
+    def poll(self) -> None:
+        """Re-evaluate the high-water condition for provider-driven growth
+        (providers change without add() calls); call from a heartbeat."""
+        self._maybe_high_water()
