@@ -755,6 +755,26 @@ def main() -> None:
             results["aux_error"] = str(e)[:300]
             _mark("aux_error", err=str(e)[:120])
 
+    if os.environ.get("BENCH_CLUSTER", "1") != "0":
+        # BASELINE config #5: 3 store processes + PD over TCP serving
+        # YCSB-E scans and Q1 pushdown (bench_cluster.py); auxiliary — a
+        # cluster failure must not zero the headline device metric
+        try:
+            import bench_cluster
+
+            _mark("cluster_start")
+            c = bench_cluster.run(
+                rows=int(os.environ.get("BENCH_CLUSTER_ROWS", "60000")),
+                scan_seconds=float(os.environ.get("BENCH_CLUSTER_SCAN_SECONDS", "8")),
+            )
+            for k in ("load_rows_per_s", "ycsb_e_scans_per_s", "ycsb_e_rows_per_s",
+                      "q1_pushdown_rows_per_s", "regions", "leader_stores"):
+                results[f"cluster_{k}"] = c.get(k)
+            _mark("cluster_ok", q1=c.get("q1_pushdown_rows_per_s"))
+        except Exception as e:  # noqa: BLE001
+            results["cluster_error"] = str(e)[:300]
+            _mark("cluster_error", err=str(e)[:120])
+
     if worker is not None:
         try:
             worker.call("quit", timeout=10)

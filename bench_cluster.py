@@ -1,0 +1,385 @@
+#!/usr/bin/env python
+"""BASELINE config #5: a real 3-process cluster serving YCSB-E range scans
+and TPC-H Q1-shaped coprocessor pushdown over TCP.
+
+Reuses the multiprocess deployment shape proven by
+tests/test_multiprocess_cluster.py (reference: test_raftstore ServerCluster,
+src/server.rs:601): one PD service + three `tikv_tpu.server.standalone`
+store PROCESSES over durable engine dirs (native LSM + raft log engine).
+The lineitem-shaped table loads through MVCC transactions, splits into three
+regions whose leaders spread across the stores, then:
+
+  * YCSB-E — fixed-length range scans (kv_scan, 50 rows) at uniform-random
+    starts against every region leader; metric = scanned rows/sec.
+  * Q1 pushdown — the Q1 selection + group-by (sums/counts — the mergeable
+    shape TiDB pushes down) runs per region leader through the REAL
+    coprocessor service path; partials merge client-side and are verified
+    against a numpy oracle over the generated arrays; metric = rows/sec
+    through the executors.
+
+Importable: ``run(...)`` returns the metrics dict (bench.py embeds it in the
+driver detail JSON); ``python bench_cluster.py`` prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+
+import numpy as np
+
+TABLE_ID = 101
+FIRST_REGION_ID = 1
+
+
+def _spawn_store(store_id: int, pd_addr, data_dir: str):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = _HERE
+    return subprocess.Popen(
+        [sys.executable, "-m", "tikv_tpu.server.standalone",
+         "--store-id", str(store_id), "--pd", f"{pd_addr[0]}:{pd_addr[1]}",
+         "--dir", data_dir, "--expect-stores", "3"],
+        env=env, cwd=_HERE,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+    )
+
+
+def _wait_ready(proc, timeout=120.0):
+    # readline() blocks with no deadline of its own: a silent hung startup
+    # must still fail the bench (not freeze the driver) — the watchdog kills
+    # the process, which EOFs the pipe and breaks the loop
+    watchdog = threading.Timer(timeout, lambda: os.kill(proc.pid, signal.SIGKILL))
+    watchdog.daemon = True
+    watchdog.start()
+    try:
+        while True:
+            line = proc.stdout.readline()
+            if not line:
+                raise RuntimeError(
+                    f"store process exited/killed rc={proc.poll()} before READY")
+            if line.startswith(b"READY"):
+                return
+    finally:
+        watchdog.cancel()
+
+
+class _Cluster:
+    def __init__(self, tmp: str):
+        from tikv_tpu.pd.client import MockPd
+        from tikv_tpu.pd.service import PdService
+        from tikv_tpu.server.server import Client, Server
+
+        self.Client = Client
+        self.pd = MockPd()
+        self.pd_server = Server(PdService(self.pd))
+        self.pd_server.start()
+        self.procs = [
+            _spawn_store(sid, self.pd_server.addr, os.path.join(tmp, f"s{sid}"))
+            for sid in (1, 2, 3)
+        ]
+        for p in self.procs:
+            _wait_ready(p)
+        self._clients: dict[int, object] = {}
+
+    def client_for_store(self, sid: int):
+        c = self._clients.get(sid)
+        if c is None:
+            addr = self.pd.get_store_addr(sid)
+            c = self._clients[sid] = self.Client(addr[0], addr[1])
+        return c
+
+    def leader_client(self, region_id: int, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            sid = self.pd.leaders.get(region_id)
+            if sid is not None:
+                return self.client_for_store(sid), sid
+            time.sleep(0.1)
+        raise RuntimeError(f"no leader reported for region {region_id}")
+
+    def call_leader(self, region_id: int, method: str, req: dict, timeout=60.0):
+        """Leader-following call with NotLeader/epoch retry."""
+        deadline = time.monotonic() + timeout
+        last = None
+        while time.monotonic() < deadline:
+            try:
+                c, _sid = self.leader_client(region_id)
+                r = c.call(method, dict(req, context={"region_id": region_id}),
+                           timeout=20.0)
+            except (ConnectionError, TimeoutError, OSError, RuntimeError) as e:
+                last = e
+                time.sleep(0.2)
+                continue
+            if isinstance(r, dict) and (r.get("error") or r.get("errors")):
+                last = r
+                time.sleep(0.2)
+                continue
+            return r
+        raise RuntimeError(f"{method} on region {region_id} never succeeded: {last!r}")
+
+    def shutdown(self):
+        for c in self._clients.values():
+            try:
+                c.close()
+            except OSError:
+                pass
+        for p in self.procs:
+            try:
+                os.kill(p.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            p.wait()
+        self.pd_server.stop()
+
+
+def _lineitem_cols():
+    from tikv_tpu.copr.datatypes import ColumnInfo, FieldType
+
+    return [
+        ColumnInfo(1, FieldType.int64(), is_pk_handle=True),
+        ColumnInfo(2, FieldType.int64()),          # quantity
+        ColumnInfo(3, FieldType.decimal_type(2)),  # extendedprice
+        ColumnInfo(4, FieldType.decimal_type(2)),  # discount
+        ColumnInfo(5, FieldType.int64()),          # shipdate
+        ColumnInfo(6, FieldType.varchar()),        # returnflag
+        ColumnInfo(7, FieldType.varchar()),        # linestatus
+    ]
+
+
+def run(rows: int = 60_000, scan_seconds: float = 8.0, scan_len: int = 50) -> dict:
+    from tikv_tpu.copr.dag import Aggregation, DagRequest, SelectResponse, Selection, TableScan
+    from tikv_tpu.copr.aggr import AggDescriptor
+    from tikv_tpu.copr.dag_wire import dag_to_wire
+    from tikv_tpu.copr.rpn import call as rpn_call, col, const_int
+    from tikv_tpu.copr.table import encode_row, record_key, record_range
+    from tikv_tpu.storage.txn_types import Key
+
+    tmp = tempfile.mkdtemp(prefix="bench-cluster-")
+    out: dict = {"rows": rows}
+    cluster = _Cluster(tmp)
+    try:
+        # ---- load the table through MVCC transactions --------------------
+        rng = np.random.default_rng(11)
+        qty = rng.integers(1, 51, rows)
+        price = rng.integers(90000, 10500000, rows)
+        disc = rng.integers(0, 11, rows)
+        ship = rng.integers(8400, 10600, rows)
+        rf = rng.integers(0, 3, rows)
+        ls = rng.integers(0, 2, rows)
+        flags, stats = (b"A", b"N", b"R"), (b"F", b"O")
+        cols = _lineitem_cols()
+        non_handle = cols[1:]
+        t0 = time.perf_counter()
+        batch = int(os.environ.get("BENCH_CLUSTER_TXN_BATCH", "500"))
+        loaded = 0
+        for s in range(0, rows, batch):
+            e = min(s + batch, rows)
+            muts = []
+            for i in range(s, e):
+                rk = record_key(TABLE_ID, i)
+                val = encode_row(non_handle, [
+                    int(qty[i]), int(price[i]), int(disc[i]), int(ship[i]),
+                    flags[rf[i]], stats[ls[i]],
+                ])
+                muts.append({"op": "put", "key": rk, "value": val})
+            # a batch can straddle a region boundary after the split: group
+            # by region, one txn per group (the leader rejects foreign keys)
+            by_region: dict[int, list] = {}
+            for m in muts:
+                by_region.setdefault(_region_for(cluster, m["key"]), []).append(m)
+            for region_id, group in by_region.items():
+                ts = cluster.pd.get_tso()
+                cluster.call_leader(region_id, "kv_prewrite", {
+                    "mutations": group, "primary_lock": group[0]["key"],
+                    "start_version": ts,
+                })
+                cluster.call_leader(region_id, "kv_commit", {
+                    "keys": [m["key"] for m in group], "start_version": ts,
+                    "commit_version": cluster.pd.get_tso(),
+                })
+            loaded = e
+            # split into three regions once enough data exists, so the rest
+            # of the load and both workloads spread across all stores
+            if loaded == batch * 2:
+                _split_and_spread(cluster, rows)
+        out["load_s"] = round(time.perf_counter() - t0, 1)
+        out["load_rows_per_s"] = round(rows / (time.perf_counter() - t0), 1)
+
+        regions = sorted(
+            rid for rid, r in cluster.pd.regions.items()
+            if _overlaps_table(r)
+        )
+        leaders = {rid: cluster.pd.leaders.get(rid) for rid in regions}
+        out["regions"] = len(regions)
+        out["leader_stores"] = sorted(set(leaders.values()))
+
+        # ---- YCSB-E: fixed-length range scans ----------------------------
+        read_ts = cluster.pd.get_tso()
+        stop_at = time.monotonic() + scan_seconds
+        scans = 0
+        scanned_rows = 0
+        starts = rng.integers(0, max(rows - scan_len, 1), 100_000)
+        i = 0
+        while time.monotonic() < stop_at:
+            h = int(starts[i % len(starts)])
+            i += 1
+            rk = record_key(TABLE_ID, h)
+            region_id = _region_for(cluster, rk)
+            r = cluster.call_leader(region_id, "kv_scan", {
+                "start_key": rk, "limit": scan_len, "version": read_ts,
+            }, timeout=20.0)
+            scans += 1
+            scanned_rows += len(r.get("pairs", ()))
+        out["ycsb_e_scans_per_s"] = round(scans / scan_seconds, 1)
+        out["ycsb_e_rows_per_s"] = round(scanned_rows / scan_seconds, 1)
+
+        # ---- Q1 pushdown: mergeable sums/counts per region ---------------
+        def q1_dag():
+            aggs = [
+                AggDescriptor("sum", col(1)),                        # sum(qty)
+                AggDescriptor("sum", col(2)),                        # sum(price)
+                AggDescriptor("sum", col(3)),                        # sum(disc)
+                AggDescriptor("count", None),
+            ]
+            return DagRequest(executors=[
+                TableScan(TABLE_ID, cols),
+                Selection([rpn_call("le", col(4), const_int(10500))]),
+                Aggregation([col(5), col(6)], aggs),
+            ])
+
+        wire_dag = dag_to_wire(q1_dag())
+        results: dict[int, bytes] = {}
+        errs: list = []
+
+        def push(rid):
+            try:
+                r = cluster.call_leader(rid, "coprocessor", {
+                    "dag": wire_dag, "ranges": [list(record_range(TABLE_ID))],
+                    "start_ts": read_ts,
+                })
+                results[rid] = r["data"]
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=push, args=(rid,)) for rid in regions]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        q1_t = time.perf_counter() - t0
+        if errs:
+            raise errs[0]
+        # client-side partial merge + oracle check (row layout: aggregates
+        # first, then the group-by keys — dag.py Aggregation encoding)
+        merged: dict[tuple, list] = {}
+        for rid, blob in results.items():
+            for row in SelectResponse.decode(blob).iter_rows():
+                key = (row[4], row[5])
+                acc = merged.setdefault(key, [0, 0])
+                acc[0] += int(row[0])   # sum(qty)
+                acc[1] += int(row[3])   # count
+        mask = ship <= 10500
+        want_count = int(mask.sum())
+        got_count = sum(v[1] for v in merged.values())
+        if got_count != want_count:
+            raise AssertionError(f"Q1 merge mismatch: {got_count} != {want_count}")
+        want_qty = int(qty[mask].sum())
+        got_qty = sum(v[0] for v in merged.values())
+        if got_qty != want_qty:
+            raise AssertionError(f"Q1 sum(qty) mismatch: {got_qty} != {want_qty}")
+        out["q1_pushdown_rows_per_s"] = round(rows / q1_t, 1)
+        out["q1_groups"] = len(merged)
+        out["ok"] = True
+        return out
+    finally:
+        cluster.shutdown()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _region_for(cluster, raw_key: bytes):
+    from tikv_tpu.storage.txn_types import Key
+    from tikv_tpu.util import keys as keymod
+
+    enc = keymod.data_key(Key.from_raw(raw_key).encoded)
+    best = None
+    for rid, region in cluster.pd.regions.items():
+        start = keymod.data_key(region.start_key) if region.start_key else b""
+        end = keymod.data_key(region.end_key) if region.end_key else None
+        if enc >= start and (end is None or enc < end):
+            best = rid
+    return best if best is not None else FIRST_REGION_ID
+
+
+def _overlaps_table(region) -> bool:
+    from tikv_tpu.copr.table import record_range
+    from tikv_tpu.storage.txn_types import Key
+
+    lo_raw, hi_raw = record_range(TABLE_ID)
+    # region boundaries live in ENCODED (memcomparable) key space
+    lo = Key.from_raw(lo_raw).encoded
+    hi = Key.from_raw(hi_raw).encoded
+    start = region.start_key or b""
+    end = region.end_key or None
+    return (end is None or end > lo) and start < hi
+
+
+def _split_and_spread(cluster, rows: int) -> None:
+    """Split the table range into 3 regions and move leaders apart."""
+    from tikv_tpu.copr.table import record_key
+    from tikv_tpu.storage.txn_types import Key
+
+    for frac in (1 / 3, 2 / 3):
+        split_raw = record_key(TABLE_ID, int(rows * frac))
+        region_id = _region_for(cluster, split_raw)
+        # the service memcomparable-encodes user keys itself (kv.rs
+        # split_region Key::from_raw) — pass the RAW record key
+        cluster.call_leader(region_id, "kv_split_region", {"split_key": split_raw})
+    # leader spread: one region leader per store via PD operators
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        regions = sorted(
+            rid for rid, r in cluster.pd.regions.items() if _overlaps_table(r))
+        leaders = {rid: cluster.pd.leaders.get(rid) for rid in regions}
+        if len(regions) >= 3 and None not in leaders.values():
+            break
+        time.sleep(0.2)
+    want = dict(zip(regions, (1, 2, 3)))
+    for rid, sid in want.items():
+        if cluster.pd.leaders.get(rid) != sid:
+            region = cluster.pd.regions.get(rid)
+            peer = region.peer_on_store(sid) if region is not None else None
+            if peer is not None:
+                cluster.pd.add_operator(
+                    rid, {"type": "transfer_leader", "peer_id": peer.peer_id,
+                          "store_id": sid})
+    time.sleep(1.5)  # let heartbeats deliver the operators
+
+
+def main() -> None:
+    rows = int(os.environ.get("BENCH_CLUSTER_ROWS", "60000"))
+    secs = float(os.environ.get("BENCH_CLUSTER_SCAN_SECONDS", "8"))
+    out = run(rows, secs)
+    print(json.dumps({
+        "metric": "cluster3_q1_pushdown_rows_per_sec",
+        "value": out["q1_pushdown_rows_per_s"],
+        "unit": "rows/sec",
+        "vs_baseline": 0.0,
+        **out,
+    }))
+
+
+if __name__ == "__main__":
+    main()

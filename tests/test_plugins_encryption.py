@@ -273,3 +273,52 @@ def test_dict_persistence_atomic_and_recoverable(tmp_path):
     # values sealed before the reload decrypt after it
     blob = seal(mgr.current()[1], b"v")
     assert unseal(mgr2.by_id(mgr2.current_id), blob) == b"v"
+
+
+def test_thread_cpu_recorder_samples_proc():
+    """Per-thread CPU sampling from /proc/self/task (the reference's
+    cpu/recorder/linux.rs): tagged work attributes to its tag; untagged
+    background threads land under the empty tag; per-thread comm totals
+    accumulate."""
+    import threading
+
+    from tikv_tpu.server.resource_metering import ThreadCpuRecorder
+
+    tags = ResourceTagFactory()
+    rec = ThreadCpuRecorder(tags, interval=0.2)
+    rec.sample()  # baseline
+
+    stop = threading.Event()
+
+    def tagged_burn():
+        with tags.attach(b"heavy-group"):
+            while not stop.is_set():
+                sum(i * i for i in range(2000))
+
+    def untagged_burn():
+        while not stop.is_set():
+            sum(i * i for i in range(2000))
+
+    t1 = threading.Thread(target=tagged_burn, name="burner-tagged")
+    t2 = threading.Thread(target=untagged_burn, name="burner-bg")
+    t1.start()
+    t2.start()
+    try:
+        import time as _t
+
+        deadline = _t.monotonic() + 10
+        snap = {}
+        while _t.monotonic() < deadline:
+            _t.sleep(0.3)
+            rec.sample()
+            snap = rec.snapshot()
+            if snap["by_tag"].get(b"heavy-group", 0) > 0 and \
+                    snap["by_tag"].get(rec.UNTAGGED, 0) > 0:
+                break
+    finally:
+        stop.set()
+        t1.join()
+        t2.join()
+    assert snap["by_tag"].get(b"heavy-group", 0) > 0, snap
+    assert snap["by_tag"].get(rec.UNTAGGED, 0) > 0, snap
+    assert snap["by_thread"], snap

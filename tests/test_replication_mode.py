@@ -1,0 +1,112 @@
+"""DR auto-sync replication mode (raftstore/src/store/replication_mode.rs +
+PD's ReplicationStatus state machine): in ``sync`` state an entry commits
+only when every label group holds it; losing a whole group drops the
+cluster to ``async`` (majority commit) and its return passes through
+``sync_recover`` back to ``sync``."""
+
+import time
+
+import pytest
+
+from tikv_tpu.pd.client import MockPd
+from tikv_tpu.raft.cluster import FIRST_REGION_ID, Cluster
+from tikv_tpu.raft.region import NotLeaderError
+
+
+@pytest.fixture
+def dr_cluster():
+    """3 stores: stores 1+2 = group 'east', store 3 = group 'west'."""
+    c = Cluster(3)
+    c.run()
+    status = {"mode": "dr_auto_sync", "state": "sync",
+              "labels": {1: "east", 2: "east", 3: "west"}}
+    for s in c.stores.values():
+        s.set_replication_mode(status)
+    return c
+
+
+def _commit_index(cluster, sid=None):
+    leader = cluster.wait_leader(FIRST_REGION_ID)
+    return leader.node.commit
+
+
+def test_sync_state_requires_every_group(dr_cluster):
+    c = dr_cluster
+    c.must_put(b"k0", b"v0")  # all groups healthy: commits normally
+    assert c.must_get(b"k0") == b"v0"
+    leader = c.wait_leader(FIRST_REGION_ID)
+    committed_before = leader.node.commit
+    # the WHOLE west group (store 3) goes dark
+    c.stop_node(3)
+    kv = c.raftkv(leader.store.store_id)
+    with pytest.raises((TimeoutError, NotLeaderError)):
+        from tikv_tpu.storage.engine import WriteBatch
+
+        wb = WriteBatch()
+        wb.put_cf("default", b"k1", b"v1")
+        kv.write({"region_id": FIRST_REGION_ID}, wb)
+    # majority (east) held the entry but it must NOT have committed
+    assert leader.node.commit == committed_before
+
+
+def test_async_state_restores_majority_commit(dr_cluster):
+    c = dr_cluster
+    c.must_put(b"k0", b"v0")
+    c.stop_node(3)
+    # PD decides west is gone: state drops to async
+    status = {"mode": "dr_auto_sync", "state": "async",
+              "labels": {1: "east", 2: "east", 3: "west"}}
+    for sid in (1, 2):
+        c.stores[sid].set_replication_mode(status)
+    c.must_put(b"k1", b"v1")  # 2/3 majority commits again
+    assert c.must_get(b"k1") == b"v1"
+    # west returns; sync restored — commits require west once more AND the
+    # log it missed replicates over
+    c.restart_node(3)
+    sync = dict(status, state="sync")
+    for s in c.stores.values():
+        s.set_replication_mode(sync)
+    c.must_put(b"k2", b"v2")
+    c.tick(5)
+    assert c.get_on_store(3, b"k1") == b"v1"
+    assert c.get_on_store(3, b"k2") == b"v2"
+
+
+def test_pd_replication_state_machine():
+    pd = MockPd()
+    pd.store_down_secs = 1.0
+    pd.enable_dr_auto_sync({1: "east", 2: "east", 3: "west"})
+    # fresh enablement settles through the recovery path once every group
+    # has heartbeated (the machine never trusts a group it hasn't seen)
+    deadline = time.monotonic() + 5
+    st = {}
+    while st.get("state") != "sync" and time.monotonic() < deadline:
+        for sid in (1, 2, 3):
+            st = pd.store_heartbeat(sid, {})
+        time.sleep(0.2)
+    assert st["state"] == "sync"
+    # west stops beating: next east heartbeat observes the dead group
+    time.sleep(1.2)
+    st = pd.store_heartbeat(1, {})
+    assert st["state"] == "async"
+    # west returns: async -> sync_recover -> (grace) -> sync
+    st = pd.store_heartbeat(3, {})
+    assert st["state"] == "sync_recover"
+    deadline = time.monotonic() + 5
+    while st["state"] != "sync" and time.monotonic() < deadline:
+        time.sleep(0.2)
+        st = pd.store_heartbeat(1, {})
+        pd.store_heartbeat(3, {})
+    assert st["state"] == "sync"
+
+
+def test_unlabeled_mode_unchanged():
+    """Majority mode (the default) must behave exactly as before."""
+    c = Cluster(3)
+    c.run()
+    for s in c.stores.values():
+        s.set_replication_mode({"mode": "majority", "state": "sync", "labels": {}})
+    c.must_put(b"m0", b"v")
+    c.stop_node(3)
+    c.must_put(b"m1", b"v")  # plain majority: 2/3 commits
+    assert c.must_get(b"m1") == b"v"

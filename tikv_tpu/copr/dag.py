@@ -123,6 +123,25 @@ class SelectResponse:
             out += wb
         return bytes(out)
 
+    @classmethod
+    def decode(cls, blob: bytes) -> "SelectResponse":
+        """Parse the wire encoding back (client-side partial merges and
+        tests; the inverse of :meth:`encode`)."""
+        n, off = codec.decode_var_u64(blob, 0)
+        chunks = []
+        for _ in range(n):
+            ln, off = codec.decode_var_u64(blob, off)
+            chunks.append(bytes(blob[off:off + ln]))
+            off += ln
+        warnings = []
+        if off < len(blob):
+            nw, off = codec.decode_var_u64(blob, off)
+            for _ in range(nw):
+                ln, off = codec.decode_var_u64(blob, off)
+                warnings.append(blob[off:off + ln].decode())
+                off += ln
+        return cls(chunks, warnings=warnings)
+
     def iter_rows(self) -> list[list]:
         """Decode all chunks back into python rows (test convenience)."""
         rows = []

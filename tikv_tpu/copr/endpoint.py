@@ -76,6 +76,24 @@ class Endpoint:
         self.last_device_error: str | None = None
 
     def handle_request(self, req: CoprRequest) -> CoprResponse:
+        """Instrumented entry: every path (device, CPU fallback, analyze,
+        checksum) lands in tikv_coprocessor_request_* exactly once."""
+        import time as _time
+
+        from ..util.metrics import REGISTRY
+
+        t0 = _time.perf_counter()
+        resp = self._handle_request_inner(req)
+        md = resp.metrics or {}
+        REGISTRY.counter(
+            "tikv_coprocessor_request_total", "Coprocessor requests, by type/path"
+        ).inc(tp=str(req.tp), path="device" if resp.from_device else "cpu")
+        REGISTRY.histogram(
+            "tikv_coprocessor_request_duration_seconds", "Coprocessor latency"
+        ).observe(md.get("total_s", _time.perf_counter() - t0), tp=str(req.tp))
+        return resp
+
+    def _handle_request_inner(self, req: CoprRequest) -> CoprResponse:
         from .tracker import Tracker
 
         from ..util.failpoint import fail_point

@@ -72,6 +72,9 @@ class MockPd(PdClient):
         self.operator_ttl = 30.0
         self.store_down_secs = 10.0
         self.operators: dict[int, dict] = {}  # region_id -> pending operator
+        # cluster replication status (replication_mode.rs ReplicationStatus)
+        self.replication: dict = {"mode": "majority", "state": "sync", "labels": {}}
+        self._groups_alive_since: dict = {}
         # in-flight replica moves: region_id -> [src, dst, deadline, done_at]
         # done_at None while the move runs; set when remove_peer was issued,
         # after which the entry LINGERS so its influence keeps adjusting
@@ -311,11 +314,60 @@ class MockPd(PdClient):
             info = self.stores.get(store_id)
             return info.addr if info else None
 
-    def store_heartbeat(self, store_id: int, stats: dict) -> None:
+    def store_heartbeat(self, store_id: int, stats: dict) -> dict:
+        """Record liveness + stats; returns the cluster replication status
+        (pd.rs store heartbeat response carries ReplicationStatus)."""
         with self._mu:
             info = self.stores.setdefault(store_id, StoreInfo(store_id))
             info.last_heartbeat = time.time()
             info.stats = stats
+            self._update_replication_state()
+            return dict(self.replication)
+
+    # -- replication mode (DR auto-sync) ------------------------------------
+
+    def enable_dr_auto_sync(self, labels: dict[int, str]) -> None:
+        """Switch to DrAutoSync (replication_mode.rs): ``labels`` maps
+        store_id -> label group (e.g. availability zone).  Commit then
+        requires every group to hold the entry while state is ``sync``."""
+        with self._mu:
+            self.replication = {
+                "mode": "dr_auto_sync",
+                "state": "sync",
+                "labels": dict(labels),
+            }
+            self._groups_alive_since: dict = {}
+
+    def _update_replication_state(self) -> None:
+        """The DR state machine (caller holds _mu): a label group losing all
+        its stores drops the cluster to ``async`` (majority-only commit —
+        availability over cross-DC integrity); when the group returns, the
+        cluster passes through ``sync_recover`` until every group has been
+        continuously alive for a grace period, then re-enters ``sync``."""
+        rep = self.replication
+        if rep.get("mode") != "dr_auto_sync":
+            return
+        now = time.time()
+        labels = rep["labels"]
+        alive = {
+            s.store_id for s in self.stores.values()
+            if now - s.last_heartbeat < self.store_down_secs
+        }
+        group_alive: dict[str, bool] = {}
+        for sid, g in labels.items():
+            group_alive[g] = group_alive.get(g, False) or sid in alive
+        if not all(group_alive.values()):
+            rep["state"] = "async"
+            self._groups_alive_since = {}
+            return
+        if rep["state"] == "async":
+            rep["state"] = "sync_recover"
+            self._groups_alive_since = {"t": now}
+        if rep["state"] == "sync_recover":
+            # grace: one liveness window with every group healthy
+            if now - self._groups_alive_since.get("t", now) >= min(
+                    2.0, self.store_down_secs / 2):
+                rep["state"] = "sync"
 
     def alive_stores(self, within_secs: float = 30.0) -> list[int]:
         now = time.time()

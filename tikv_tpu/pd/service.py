@@ -65,8 +65,8 @@ class PdService:
         return {"operator": op}
 
     def pd_store_heartbeat(self, req: dict) -> dict:
-        self.pd.store_heartbeat(req["store_id"], req.get("stats", {}))
-        return {}
+        status = self.pd.store_heartbeat(req["store_id"], req.get("stats", {}))
+        return {"replication": status}
 
     def pd_report_split(self, req: dict) -> dict:
         left, _ = decode_region(req["left"])
@@ -166,8 +166,9 @@ class RemotePd(PdClient):
         )
         return r.get("operator")
 
-    def store_heartbeat(self, store_id: int, stats: dict) -> None:
-        self._call("pd_store_heartbeat", {"store_id": store_id, "stats": stats})
+    def store_heartbeat(self, store_id: int, stats: dict):
+        r = self._call("pd_store_heartbeat", {"store_id": store_id, "stats": stats})
+        return r.get("replication") if isinstance(r, dict) else None
 
     def report_split(self, left: Region, right: Region) -> None:
         self._call(

@@ -232,6 +232,14 @@ class RaftNode:
         self._hb_round_tick = 0
         self._hb_acks: set[int] = set()
 
+        # DR auto-sync (raftstore/src/store/replication_mode.rs): when
+        # group_commit is on, an entry commits only once SOME member of
+        # EVERY label group holds it — majority alone is not enough, so a
+        # whole-datacenter loss can never lose committed data.  peer_groups
+        # maps peer id -> label group; unlabeled peers don't constrain.
+        self.group_commit = False
+        self.peer_groups: dict[int, object] = {}
+
         # leader state
         self.next_index: dict[int, int] = {}
         self.match_index: dict[int, int] = {}
@@ -348,6 +356,10 @@ class RaftNode:
         self._tick_count += 1
         self._elapsed += 1
         if self.role == Role.LEADER:
+            # replication-mode flips (sync -> async) can unblock commit
+            # without any new append traffic; re-evaluating here keeps the
+            # group stable-state-driven (runs on the raft-driving thread)
+            self._maybe_commit()
             if (
                 self.hibernate_after
                 and self._idle_ticks >= self.hibernate_after
@@ -771,6 +783,22 @@ class RaftNode:
         matches = sorted((self.match_index.get(p, 0) for p in cfg), reverse=True)
         return matches[len(cfg) // 2] if cfg else 0
 
+    def _group_index(self) -> int:
+        """Highest index present in EVERY label group (replication_mode.rs
+        IntegrityOverLabel): per group, the best match among its voters;
+        the constraint is the min across groups.  One known group (or none)
+        imposes nothing."""
+        groups: dict[object, int] = {}
+        for p in self.voters:
+            g = self.peer_groups.get(p)
+            if g is None:
+                continue
+            cur = groups.get(g, 0)
+            groups[g] = max(cur, self.match_index.get(p, 0))
+        if len(groups) <= 1:
+            return self.log.last_index()
+        return min(groups.values())
+
     def _maybe_commit(self) -> None:
         if self.role != Role.LEADER:
             return
@@ -779,6 +807,8 @@ class RaftNode:
             # joint rule: an entry commits only when replicated to a majority
             # of BOTH configs
             candidate = min(candidate, self._quorum_index(self.outgoing))
+        if self.group_commit:
+            candidate = min(candidate, self._group_index())
         # only commit entries of the current term by counting (§5.4.2)
         if candidate > self.commit and self.log.term_at(candidate) == self.term:
             self.commit = candidate

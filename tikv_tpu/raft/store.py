@@ -1349,6 +1349,11 @@ class Store:
             assert me is not None, f"store {self.store_id} not in region {region.id}"
             peer = StorePeer(self, region.clone(), me.peer_id)
             self.peers[region.id] = peer
+            # a peer born after the replication status arrived still needs
+            # the label-group config (set_replication_mode no-ops on repeats)
+            last_repl = getattr(self, "_last_repl_status", None)
+            if last_repl is not None:
+                self._apply_repl_to_peer(peer, last_repl)
             self.persist_region(peer.region)
             # under buffered apply the meta write above is not yet durable,
             # but the peer may durably VOTE (raft log) before any admin
@@ -1389,6 +1394,35 @@ class Store:
             # resurrect the peer with term=0 and let it double-vote
             self.sync_kv_wal()
             self.raft_log.clean(region_id)
+
+    def set_replication_mode(self, status: dict) -> None:
+        """Apply the PD ReplicationStatus (replication_mode.rs) to every
+        peer's raft node: DrAutoSync in ``sync`` state turns label-group
+        commit on; ``async``/``sync_recover`` (or Majority mode) turn it
+        off.  Safe to call from the heartbeat thread — flag/dict swaps are
+        atomic under the GIL and the raft thread re-evaluates commit on its
+        next tick.  No-ops when the status is unchanged (it rides EVERY
+        heartbeat) so the common majority-mode path costs one comparison."""
+        if status == getattr(self, "_last_repl_status", None):
+            return
+        self._last_repl_status = dict(status)
+        with self._mu:
+            peers = list(self.peers.values())
+        for peer in peers:
+            self._apply_repl_to_peer(peer, status)
+            self.notify_region(peer.region.id)
+
+    def _apply_repl_to_peer(self, peer, status: dict) -> None:
+        node = peer.node
+        if status.get("mode") == "dr_auto_sync":
+            labels = status.get("labels") or {}
+            node.peer_groups = {
+                p.peer_id: labels.get(p.store_id) for p in peer.region.peers
+            }
+            node.group_commit = status.get("state") == "sync"
+        else:
+            node.group_commit = False
+            node.peer_groups = {}
 
     def sync_kv_wal(self) -> None:
         """Make every buffered apply write durable (kvdb flush before raft-log

@@ -33,6 +33,11 @@ class GcWorker:
 
     def gc_range(self, start: bytes | None, end: bytes | None, safe_point: int, ctx: dict | None = None) -> dict:
         """One GC sweep over [start, end) at ``safe_point``. Returns stats."""
+        from ..util.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "tikv_gcworker_gc_tasks_total", "GC sweeps run"
+        ).inc(task="gc")
         with self._mu:
             if safe_point > self.safe_point:
                 _LOG.info("gc safe point advanced", safe_point=safe_point)

@@ -16,6 +16,14 @@ from ..pd.client import PdClient
 from ..raft.region import Peer as RegionPeer, Region, RegionEpoch
 from ..raft.store import Store, Transport
 from ..util import keys
+from ..util.metrics import REGISTRY
+
+REGION_COUNT = REGISTRY.gauge(
+    "tikv_raftstore_region_count", "Regions hosted by this store")
+LEADER_COUNT = REGISTRY.gauge(
+    "tikv_raftstore_leader_count", "Regions this store leads")
+STORE_USED_BYTES = REGISTRY.gauge(
+    "tikv_store_size_bytes", "Engine resident bytes, by type")
 
 FIRST_REGION_ID = 1
 
@@ -138,7 +146,17 @@ class Node:
                         # size-weighted balance input (store_heartbeat
                         # capacity/used stats, pd.rs:101)
                         stats["used_bytes"] = mem_bytes()
-                    self.pd.store_heartbeat(self.store_id, stats)
+                    REGION_COUNT.set(len(self.store.peers))
+                    if "used_bytes" in stats:
+                        STORE_USED_BYTES.set(stats["used_bytes"], type="memtable")
+                    wal_bytes = getattr(self.store.engine, "wal_bytes", None)
+                    if wal_bytes is not None:
+                        STORE_USED_BYTES.set(wal_bytes(), type="wal")
+                    repl = self.pd.store_heartbeat(self.store_id, stats)
+                    if isinstance(repl, dict):
+                        # DrAutoSync state rides the heartbeat response
+                        # (replication_mode.rs); majority mode clears it
+                        self.store.set_replication_mode(repl)
                     led = set()
                     for peer in list(self.store.peers.values()):
                         if peer.node.is_leader():
@@ -150,6 +168,7 @@ class Node:
                             self._maybe_load_split(peer, heartbeat_interval)
                     # counts accrued while FOLLOWING must not look like load
                     # the moment this store wins leadership
+                    LEADER_COUNT.set(len(led))
                     for rid in list(self._write_ops):
                         if rid not in led:
                             self._write_ops.pop(rid, None)

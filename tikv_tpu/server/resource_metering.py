@@ -9,6 +9,7 @@ execution with thread-CPU clocks — same accounting surface.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -16,20 +17,31 @@ from contextlib import contextmanager
 
 
 class ResourceTagFactory:
-    """Accumulates CPU seconds and op counts per resource-group tag."""
+    """Accumulates CPU seconds and op counts per resource-group tag, and
+    exposes which tag each OS thread is currently serving (the shared
+    thread→tag registry the sampling recorder attributes against)."""
 
     def __init__(self):
         self._mu = threading.Lock()
         self._cpu: dict[bytes, float] = {}
         self._ops: dict[bytes, int] = {}
+        # native thread id -> tag currently attached on that thread
+        self.current: dict[int, bytes] = {}
 
     @contextmanager
     def attach(self, tag: bytes):
+        tid = threading.get_native_id()
+        prev = self.current.get(tid)
+        self.current[tid] = tag
         t0 = time.thread_time()
         try:
             yield
         finally:
             dt = time.thread_time() - t0
+            if prev is None:
+                self.current.pop(tid, None)
+            else:
+                self.current[tid] = prev
             with self._mu:
                 self._cpu[tag] = self._cpu.get(tag, 0.0) + dt
                 self._ops[tag] = self._ops.get(tag, 0) + 1
@@ -50,6 +62,94 @@ class ResourceTagFactory:
             self._cpu.clear()
             self._ops.clear()
             return out
+
+
+class ThreadCpuRecorder:
+    """Per-thread CPU sampling from /proc (cpu/recorder/linux.rs): reads
+    utime+stime of every thread in /proc/self/task/*/stat on an interval,
+    attributes each delta to the tag the thread is CURRENTLY serving (via
+    the factory's thread→tag registry) or to ``b""`` for untagged
+    background work.  Unlike the attach() clocks this sees every thread in
+    the process — pollers, compaction, appliers — whether or not a handler
+    wrapped it."""
+
+    UNTAGGED = b""
+
+    def __init__(self, tags: ResourceTagFactory, interval: float = 1.0):
+        self.tags = tags
+        self.interval = interval
+        self._clk = os.sysconf("SC_CLK_TCK")
+        self._mu = threading.Lock()
+        self._last: dict[int, float] = {}  # tid -> cumulative cpu secs seen
+        self._by_tag: dict[bytes, float] = {}
+        self._by_thread: dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    @staticmethod
+    def _read_stat(tid: int) -> tuple[str, float] | None:
+        try:
+            with open(f"/proc/self/task/{tid}/stat", "rb") as f:
+                raw = f.read()
+        except OSError:
+            return None
+        # comm may contain spaces/parens: fields resume after the LAST ')'
+        close = raw.rfind(b")")
+        comm = raw[raw.find(b"(") + 1:close].decode(errors="replace")
+        rest = raw[close + 2:].split()
+        utime, stime = int(rest[11]), int(rest[12])
+        return comm, utime + stime
+
+    def sample(self) -> None:
+        """One sampling pass (the recorder loop body; callable directly in
+        tests)."""
+        try:
+            tids = [int(d) for d in os.listdir("/proc/self/task")]
+        except OSError:
+            return
+        current = self.tags.current
+        with self._mu:
+            seen = set()
+            for tid in tids:
+                st = self._read_stat(tid)
+                if st is None:
+                    continue
+                comm, ticks = st
+                cpu = ticks / self._clk
+                seen.add(tid)
+                prev = self._last.get(tid)
+                self._last[tid] = cpu
+                if prev is None or cpu <= prev:
+                    continue
+                delta = cpu - prev
+                tag = current.get(tid, self.UNTAGGED)
+                self._by_tag[tag] = self._by_tag.get(tag, 0.0) + delta
+                self._by_thread[comm] = self._by_thread.get(comm, 0.0) + delta
+            for tid in list(self._last):
+                if tid not in seen:  # thread exited
+                    del self._last[tid]
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "by_tag": dict(self._by_tag),
+                "by_thread": dict(self._by_thread),
+            }
+
+    def start(self) -> None:
+        self.sample()  # baseline
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="res-cpu-recorder")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.sample()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
 
 
 class Reporter:

@@ -14,12 +14,23 @@ import queue
 import socket
 import struct
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 from ..util import error_code
+from ..util.metrics import REGISTRY
 from ..util.worker import TaskPriority, UnifiedReadPool
 from . import wire
 from .service import KvService
+
+# the reference's grpc request metrics (tikv_grpc_msg_* in metrics.rs):
+# per-method counts + latency over the framed-TCP transport
+GRPC_MSG_TOTAL = REGISTRY.counter(
+    "tikv_grpc_msg_total", "RPCs served, by method")
+GRPC_MSG_DURATION = REGISTRY.histogram(
+    "tikv_grpc_msg_duration_seconds", "RPC handling latency, by method")
+GRPC_MSG_FAIL = REGISTRY.counter(
+    "tikv_grpc_msg_fail_total", "RPCs that returned an error, by method")
 
 error_code.register_builtin()
 
@@ -185,6 +196,7 @@ class Server:
                     continue
 
                 def run(req_id=req_id, method=method, request=request):
+                    t0 = time.perf_counter()
                     try:
                         if method.startswith("pb/"):
                             # kvproto mode: request/response are protobuf
@@ -194,6 +206,10 @@ class Server:
                             resp = self.service.dispatch(method, request)
                     except Exception as e:  # noqa: BLE001 — wire boundary
                         resp = {"error": {"other": repr(e), "code": error_code.code_of(e)}}
+                    GRPC_MSG_TOTAL.inc(method=method)
+                    GRPC_MSG_DURATION.observe(time.perf_counter() - t0, method=method)
+                    if isinstance(resp, dict) and resp.get("error"):
+                        GRPC_MSG_FAIL.inc(method=method)
                     if inspect.isgenerator(resp):
                         # server-streaming response (endpoint.rs:508): one
                         # wire frame per yielded item, same req_id, closed by

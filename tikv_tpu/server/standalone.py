@@ -154,6 +154,12 @@ class StoreServer:
         # whose client vanished (their buffers pin the shared quota)
         self.node.heartbeat_hooks.append(self.memory_trace.poll)
         self.node.heartbeat_hooks.append(lambda: self.cdc.reap_idle())
+        from ..util.metrics import REGISTRY
+
+        _mem_gauge = REGISTRY.gauge(
+            "tikv_memory_usage_bytes", "Store memory-trace total")
+        self.node.heartbeat_hooks.append(
+            lambda: _mem_gauge.set(self.memory_trace.sum()))
         # operator HTTP surface (status_server/mod.rs): /metrics, /status,
         # /debug/pprof/*, /debug/memory (the attribution tree above)
         from .status_server import StatusServer

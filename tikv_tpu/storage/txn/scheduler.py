@@ -26,8 +26,12 @@ from dataclasses import dataclass, field
 
 from ...util import error_code
 from ...util.failpoint import fail_point
+from ...util.metrics import REGISTRY
 from ..kv import Engine
 from .commands import Command
+
+_SCHED_COMMANDS = REGISTRY.counter(
+    "tikv_scheduler_commands_total", "Txn commands by type and outcome")
 from .latches import Latches
 
 SCHED_TOO_BUSY = error_code.define(
@@ -90,6 +94,8 @@ class Scheduler:
         """Synchronous facade: submit, wait, raise the command's error."""
         task = self.submit(cmd, ctx)
         task.done.wait()
+        status = "done" if task.exc is None else "error"
+        _SCHED_COMMANDS.inc(type=type(cmd).__name__, status=status)
         if task.exc is not None:
             raise task.exc
         return task.result
