@@ -28,6 +28,7 @@ like the plugin gating described in src/coprocessor/endpoint.rs.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 
 import numpy as np
@@ -189,6 +190,54 @@ _MATMUL_CAPACITY_MAX = 4096
 _EXTREME_MASK_CAPACITY_MAX = 1024
 
 
+_PREFETCH_END = object()
+
+
+def _prefetch(it, depth: int = 1):
+    """Run ``it`` on a worker thread, buffering ``depth`` items ahead: the
+    producer (host decode — numpy-heavy, releases the GIL) overlaps the
+    consumer (device dispatch).  Exceptions re-raise at the consumption
+    point; an abandoned consumer unblocks the producer via queue timeout."""
+    import queue as _queue
+
+    q: _queue.Queue = _queue.Queue(maxsize=depth)
+    done = threading.Event()
+
+    def put_or_abandon(entry) -> bool:
+        # EVERY put must observe `done`: an early-abandoned consumer (e.g.
+        # a Limit satisfied mid-scan) never drains the queue, and a plain
+        # blocking put would pin this thread + its decoded block forever
+        while not done.is_set():
+            try:
+                q.put(entry, timeout=0.5)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def produce():
+        try:
+            for item in it:
+                if not put_or_abandon(("item", item)):
+                    return
+            put_or_abandon((None, _PREFETCH_END))
+        except BaseException as exc:  # noqa: BLE001 — re-raised on consume
+            put_or_abandon(("exc", exc))
+
+    t = threading.Thread(target=produce, daemon=True, name="decode-prefetch")
+    t.start()
+    try:
+        while True:
+            kind, payload = q.get()
+            if payload is _PREFETCH_END:
+                return
+            if kind == "exc":
+                raise payload
+            yield payload
+    finally:
+        done.set()
+
+
 def _limb_matmul_seg_sum(x, gids, capacity: int):
     """Exact int64 per-group sums on the MXU: TPU scatter is ~1000× slower
     than reductions, so instead split each value into b-bit limbs, one-hot
@@ -227,15 +276,28 @@ def _limb_matmul_seg_sum(x, gids, capacity: int):
     return acc
 
 
+def _scatter_ok() -> bool:
+    """The one-hot/mask/limb-matmul shapes below exist because TPU scatter
+    is ~1000× slower than MXU/VPU work — but on a CPU (or GPU) backend the
+    trade INVERTS: XLA-CPU lowers the n×C broadcast compares to dreadful
+    code while native scatter-adds are fast.  Decided at trace time, so
+    each backend compiles its own best shape and results stay exact
+    (segment ops are exact integer/f64 adds)."""
+    return jax.default_backend() != "tpu"
+
+
 def _seg_sum(x, gids, capacity: int):
     """Exact per-group sum avoiding TPU scatter: capacity 1 is a plain
     reduction; small capacities use a broadcast-compare mask reduction (VPU
     work, ~n·C lanes); int64 up to 4096 groups rides the MXU via limb
     matmuls; only float sums at large capacities fall back to scatter-based
     segment_sum (f32 matmul would diverge from the CPU oracle's f64 sums
-    beyond the last-ulp exemption)."""
+    beyond the last-ulp exemption).  Non-TPU backends take the scatter path
+    directly (_scatter_ok)."""
     if capacity == 1:
         return jnp.sum(x).reshape(1)
+    if _scatter_ok():
+        return jax.ops.segment_sum(x, gids, num_segments=capacity)
     if capacity <= _ONEHOT_CAPACITY_MAX:
         onehot = gids[:, None] == jnp.arange(capacity, dtype=gids.dtype)[None, :]
         return jnp.sum(jnp.where(onehot, x[:, None], jnp.zeros((), dtype=x.dtype)), axis=0)
@@ -266,6 +328,9 @@ def _seg_extreme(x, gids, capacity: int, is_min: bool, identity):
     if capacity == 1:
         f = jnp.min if is_min else jnp.max
         return f(x).reshape(1)
+    if _scatter_ok():
+        f = jax.ops.segment_min if is_min else jax.ops.segment_max
+        return f(x, gids, num_segments=capacity)
     if capacity <= _EXTREME_MASK_CAPACITY_MAX:
         # n×C masked reduce: pure VPU work, still far cheaper than scatter
         onehot = gids[:, None] == jnp.arange(capacity, dtype=gids.dtype)[None, :]
@@ -826,17 +891,21 @@ class JaxDagEvaluator:
             self._cache = None
 
     def _blocks(self, source: ScanSource | None):
-        """Decoded blocks, through the block cache when one is provided."""
+        """Decoded blocks, through the block cache when one is provided.
+        Cold scans (no cache) run the host MVCC decode ONE BLOCK AHEAD on a
+        worker thread (SURVEY §7's double-buffering): block N executes on
+        the device while block N+1 decodes — the decode cost hides behind
+        device time instead of adding to it."""
         cache = getattr(self, "_cache", None)
         if cache is None:
             if source is None:
                 raise ValueError("no scan source and no filled block cache")
-            yield from self._decode_blocks(source)
+            yield from _prefetch(self._decode_blocks(source))
             return
         if not cache.filled:
             if source is None:
                 raise ValueError("block cache is not filled and no source given")
-            for cols, n_valid in self._decode_blocks(source):
+            for cols, n_valid in _prefetch(self._decode_blocks(source)):
                 cache.add(cols, n_valid)
             cache.filled = True
         yield from cache
