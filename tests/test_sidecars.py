@@ -564,3 +564,65 @@ def test_region_driven_backup_with_checksums(tmp_path):
     assert r["kvs"] == 40
     assert store2.get(b"bk-000", pd.get_tso()) == b"val-000"
     assert store2.get(b"bk-900", pd.get_tso()) is None  # post-backup write
+
+
+def test_ttl_checker_reclaims_expired_raw_entries():
+    """ttl_checker.rs role: expired raw values physically disappear via the
+    replicated delete path; live ones survive; reads were already filtered."""
+    from tikv_tpu.server.ttl import TtlChecker
+    from tikv_tpu.storage.storage import RAW_PREFIX
+    from tikv_tpu.storage.engine import CF_DEFAULT
+
+    store = Storage()
+    now = 1_000_000.0
+    import time as _time
+    real_time = _time.time
+    _time.time = lambda: now
+    try:
+        store.raw_put(b"ttl-a", b"va", ttl=10)
+        store.raw_put(b"ttl-b", b"vb", ttl=10_000)
+        store.raw_put(b"ttl-c", b"vc")  # no TTL
+    finally:
+        _time.time = real_time
+    later = now + 100
+    # reads filter, but the bytes are still resident pre-sweep
+    assert store.raw_get(b"ttl-a", now=later) is None
+    snap = store.engine.snapshot(None)
+    resident = [k for k, _ in snap.scan_cf(CF_DEFAULT, RAW_PREFIX, b"s")]
+    assert len(resident) == 3
+    checker = TtlChecker(store)
+    removed = checker.sweep(now=later)
+    assert removed == 1
+    snap = store.engine.snapshot(None)
+    resident = [k for k, _ in snap.scan_cf(CF_DEFAULT, RAW_PREFIX, b"s")]
+    assert len(resident) == 2
+    assert store.raw_get(b"ttl-b", now=later) == b"vb"
+    assert store.raw_get(b"ttl-c", now=later) == b"vc"
+    assert checker.sweep(now=later) == 0  # idempotent
+
+
+def test_ttl_sweep_never_destroys_fresh_writes():
+    """The sweep's delete re-checks expiry under the raw latches: a value
+    re-written (live) after the scan snapshot must survive the delete that
+    was queued for its expired predecessor."""
+    store = Storage()
+    now = 2_000_000.0
+    import time as _time
+    real_time = _time.time
+    _time.time = lambda: now
+    try:
+        store.raw_put(b"race-k", b"old", ttl=5)
+    finally:
+        _time.time = real_time
+    later = now + 100
+    # the sweep scanned and queued b"race-k"... then a client writes fresh:
+    _time.time = lambda: later
+    try:
+        store.raw_put(b"race-k", b"fresh")  # no TTL
+    finally:
+        _time.time = real_time
+    from tikv_tpu.server.ttl import TtlChecker  # noqa: F401 (path parity)
+
+    removed = store.raw_delete_if_expired([b"race-k"], now=later)
+    assert removed == 0
+    assert store.raw_get(b"race-k", now=later + 1) == b"fresh"

@@ -420,6 +420,24 @@ class StorePeer:
         self.store.notify_region(self.region.id)
 
     def handle_ready(self, sync_apply: bool = False) -> bool:
+        if (self.proposals or self.pending_reads) and not self.node.is_leader():
+            # stepped down: fail every pending proposal AND read-index
+            # waiter NOW (the reference notifies on leader change rather
+            # than leaving callers to time out — a deposed leader never
+            # produces the awaited read states either).  This also keeps
+            # self.proposals sorted by index — the invariant _ack's
+            # front-pop relies on — because a re-election on this store
+            # starts from an empty list.
+            with self._cb_mu:
+                stale, self.proposals = self.proposals, []
+                stale_reads = list(self.pending_reads.values())
+                self.pending_reads.clear()
+                self.pending_read_states.clear()
+            leader = self.store.leader_store_of(self.region.id)
+            for p in stale:
+                p.cb(NotLeaderError(self.region.id, leader))
+            for cb in stale_reads:
+                cb(NotLeaderError(self.region.id, leader))
         rd = self.node.ready()
         if rd.is_empty():
             return False
@@ -729,20 +747,26 @@ class StorePeer:
         self.store.on_applied(region, cmd)
 
     def _ack(self, e: Entry, result, err) -> None:
+        # proposals append in index order, so everything relevant to this
+        # entry sits at the FRONT: pop while index <= e.index instead of
+        # rescanning the whole in-flight window per committed entry (that
+        # rescan made the ack path O(window²) across a batch)
         fire = []
         with self._cb_mu:
-            rest = []
-            for p in self.proposals:
-                if p.index == e.index:
-                    if p.term == e.term:
-                        fire.append((p.cb, err if err is not None else result))
-                    else:
-                        fire.append((p.cb, NotLeaderError(self.region.id, None)))  # overwritten
-                elif p.index < e.index:
-                    fire.append((p.cb, NotLeaderError(self.region.id, None)))
+            props = self.proposals
+            i = 0
+            n = len(props)
+            while i < n and props[i].index <= e.index:
+                p = props[i]
+                if p.index == e.index and p.term == e.term:
+                    fire.append((p.cb, err if err is not None else result))
                 else:
-                    rest.append(p)
-            self.proposals = rest
+                    # behind the applied index, or overwritten by a
+                    # different term's entry at the same index
+                    fire.append((p.cb, NotLeaderError(self.region.id, None)))
+                i += 1
+            if i:
+                del props[:i]
         for cb, arg in fire:
             cb(arg)
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import threading
 import time
 
 from ..copr.endpoint import Endpoint
@@ -160,6 +161,28 @@ class StoreServer:
             "tikv_memory_usage_bytes", "Store memory-trace total")
         self.node.heartbeat_hooks.append(
             lambda: _mem_gauge.set(self.memory_trace.sum()))
+        # raw-KV TTL reclamation (ttl_checker.rs): a slow-cadence sweep of
+        # expired raw entries through the replicated delete path, on its OWN
+        # worker thread (the GcWorker AutoGc shape) — a large expired
+        # backlog's raft round-trips must never stall the PD heartbeat loop
+        from .ttl import TtlChecker
+
+        self.ttl_checker = TtlChecker(self.storage)
+        self._ttl_stop = threading.Event()
+
+        def _ttl_loop(interval=float(os.environ.get("TIKV_TPU_TTL_SWEEP_SECS", "60"))):
+            while not self._ttl_stop.wait(interval):
+                for peer in list(self.store.peers.values()):
+                    if self._ttl_stop.is_set():
+                        return
+                    if peer.node.is_leader():
+                        try:
+                            self.ttl_checker.sweep({"region_id": peer.region.id})
+                        except Exception:  # noqa: BLE001 — next sweep retries
+                            pass
+
+        self._ttl_thread = threading.Thread(target=_ttl_loop, daemon=True,
+                                            name="ttl-checker")
         # operator HTTP surface (status_server/mod.rs): /metrics, /status,
         # /debug/pprof/*, /debug/memory (the attribution tree above)
         from .status_server import StatusServer
@@ -191,6 +214,7 @@ class StoreServer:
     def start(self) -> None:
         self.server.start()
         self.status_server.start()
+        self._ttl_thread.start()
         self.pd.put_store(self.store.store_id, addr=self.server.addr)
         self.node.start()
 
@@ -222,6 +246,7 @@ class StoreServer:
         raise TimeoutError("cluster never formed")
 
     def stop(self) -> None:
+        self._ttl_stop.set()
         self.node.stop()
         self.server.stop()
         self.status_server.stop()

@@ -1,0 +1,54 @@
+"""Raw-KV TTL reclamation.
+
+Re-expression of ``src/server/ttl`` (``ttl_checker.rs:32`` periodic checker +
+``ttl_compaction_filter.rs:14``): reads already filter expired raw values
+(storage.py `_decode_raw_value`), but the bytes stay resident until something
+physically deletes them.  The reference drops them inside RocksDB compaction
+— a per-store local delete.  Here the sweep goes through the REPLICATED
+delete path instead (raw_batch_delete → raft), so replicas stay byte-
+identical and the consistency-check observer never flags TTL reclamation as
+divergence.  Expiry is a deterministic function of the stored expire stamp,
+so leader-driven deletion loses nothing a replica-local filter would keep.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..storage.engine import CF_DEFAULT
+from ..storage.storage import _NO_TTL, RAW_PREFIX
+from ..util import codec
+
+
+class TtlChecker:
+    """Periodic expired-raw-entry sweeper over one store's storage."""
+
+    def __init__(self, storage, batch: int = 512):
+        self.storage = storage
+        self.batch = batch
+        self.swept = 0
+
+    def sweep(self, ctx: dict | None = None, now: float | None = None) -> int:
+        """One pass: scan the raw keyspace for expired candidates, then
+        delete them in bounded batches via ``raw_delete_if_expired`` —
+        which RE-CHECKS each key under the raw latches, so a raw_put racing
+        the sweep (fresh live value landing after this scan's snapshot)
+        is never destroyed.  Returns entries reclaimed."""
+        now = now if now is not None else time.time()
+        snap = self.storage.engine.snapshot(ctx)
+        end = RAW_PREFIX[:-1] + bytes([RAW_PREFIX[-1] + 1])
+        expired: list[bytes] = []
+        removed = 0
+        for k, stored in snap.scan_cf(CF_DEFAULT, RAW_PREFIX, end):
+            if len(stored) < 8:
+                continue
+            expire = codec.decode_u64(stored, len(stored) - 8)
+            if expire != _NO_TTL and expire <= int(now):
+                expired.append(k[len(RAW_PREFIX):])
+                if len(expired) >= self.batch:
+                    removed += self.storage.raw_delete_if_expired(expired, ctx, now)
+                    expired = []
+        if expired:
+            removed += self.storage.raw_delete_if_expired(expired, ctx, now)
+        self.swept += removed
+        return removed

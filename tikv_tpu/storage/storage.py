@@ -202,6 +202,33 @@ class Storage:
                 break
         return out
 
+    def raw_delete_if_expired(self, keys: list[bytes], ctx: dict | None = None,
+                              now: float | None = None) -> int:
+        """TTL reclamation primitive (ttl_checker.rs): delete each key ONLY
+        if its current value is still expired, under the raw latches — a
+        concurrent raw_put serializes against this, so a fresh live value
+        can never be destroyed by a sweep that saw the old expired one."""
+        now = now if now is not None else time.time()
+        cid = self._raw_latches.gen_cid()
+        slots = self._raw_latches.acquire_blocking(cid, keys)
+        try:
+            snap = self.engine.snapshot(ctx)
+            wb = WriteBatch()
+            n = 0
+            for k in keys:
+                stored = snap.get_cf(CF_DEFAULT, _raw_key(k))
+                if stored is None or len(stored) < 8:
+                    continue
+                expire = codec.decode_u64(stored, len(stored) - 8)
+                if expire != _NO_TTL and expire <= int(now):
+                    wb.delete_cf(CF_DEFAULT, _raw_key(k))
+                    n += 1
+            if n:
+                self.engine.write(ctx, wb)
+            return n
+        finally:
+            self._raw_latches.release(cid, slots)
+
     def raw_compare_and_swap(
         self,
         key: bytes,
