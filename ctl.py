@@ -45,6 +45,32 @@ def _client(addr: str) -> Client:
     return Client(host, int(port))
 
 
+class _DataKeyEngine:
+    """Engine-trait adapter for offline restore: writes land under the z
+    data-key prefix — where RegionSnapshot reads look — instead of at raw
+    encoded keys (which only a prefixless wrapper could ever see again)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def write(self, ctx, wb) -> None:
+        from tikv_tpu.storage.engine import WriteBatch
+        from tikv_tpu.util import keys as keymod
+
+        out = WriteBatch()
+        for op, cf, key, val in wb.ops:
+            if op == "put":
+                out.put_cf(cf, keymod.data_key(key), val)
+            elif op == "delete":
+                out.delete_cf(cf, keymod.data_key(key))
+            else:
+                out.delete_range_cf(cf, keymod.data_key(key), keymod.data_key(val))
+        self.inner.write(out)
+
+    def snapshot(self, ctx=None):
+        return self.inner.snapshot()
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpu-tikv-ctl")
     p.add_argument("--addr", help="store RPC address host:port")
@@ -96,12 +122,37 @@ def main(argv=None) -> int:
     sp.add_argument("--end", default="")
     sp = sub.add_parser("compact")
     sp.add_argument("--cf", default=None)
+    # BR-style offline backup/restore over a stopped store (--db required)
+    sp = sub.add_parser("backup")
+    sp.add_argument("--out", required=True, help="backup storage directory")
+    sp.add_argument("--name", default="full")
+    sp.add_argument("--backup-ts", type=int, required=True)
+    sp = sub.add_parser("backup-verify")
+    sp.add_argument("--out", required=True)
+    sp.add_argument("--name", default="full")
+    sp = sub.add_parser("restore")
+    sp.add_argument("--out", required=True, help="backup storage directory")
+    sp.add_argument("--name", default="full")
+    sp.add_argument("--restore-ts", type=int, required=True)
+    sp.add_argument("--region-id", type=int, default=1,
+                    help="region id for the restored whole-range region")
+    sp.add_argument("--store", type=int, default=1)
+    sp.add_argument("--peer", type=int, default=1)
 
     args = p.parse_args(argv)
     ctx = {"region_id": args.region}
 
+    if args.cmd == "backup-verify":
+        # pure storage-side validation: no engine, no --db (BR validate
+        # runs wherever the backup lives)
+        from tikv_tpu.sidecar.backup import BackupEndpoint, LocalStorage
+
+        out = BackupEndpoint(LocalStorage(args.out)).verify(args.name)
+        print(json.dumps(out, indent=2))
+        return 0
+
     offline_cmds = ("unsafe-recover", "recover-mvcc", "tombstone",
-                    "recreate-region", "compact")
+                    "recreate-region", "compact", "backup", "restore")
     if args.cmd in offline_cmds:
         if not args.db:
             print("--db required (offline commands run on a stopped store)",
@@ -133,6 +184,25 @@ def main(argv=None) -> int:
                 dbg.recreate_region(args.region, args.start.encode(),
                                     args.end.encode(), args.store, args.peer)
                 out = {"recreated": args.region}
+            elif args.cmd in ("backup", "restore"):
+                from tikv_tpu.sidecar.backup import BackupEndpoint, LocalStorage
+
+                ep = BackupEndpoint(LocalStorage(args.out))
+                if args.cmd == "backup":
+                    meta = ep.backup_offline(eng, args.name, args.backup_ts)
+                    out = {"name": args.name, "regions": len(meta["regions"]),
+                           "total_kvs": meta["total_kvs"],
+                           "crc64xor": meta["crc64xor"]}
+                else:
+                    # restore must produce a BOOTABLE store dir: data under
+                    # the z data-key prefix (where region reads look) plus a
+                    # whole-range region meta the next recover() finds —
+                    # recreate-region semantics with the data already in
+                    out = ep.restore(_DataKeyEngine(eng), args.name,
+                                     args.restore_ts)
+                    dbg.recreate_region(args.region_id, b"", b"",
+                                        args.store, args.peer)
+                    out["region"] = args.region_id
             else:
                 out = dbg.compact(args.cf)
             eng.flush()

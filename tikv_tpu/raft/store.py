@@ -95,6 +95,17 @@ def encode_cmd(cmd: dict) -> bytes:
     return bytes(out)
 
 
+def scan_region_states(snapshot):
+    """Yield (region_id, raw_state_bytes) for every persisted region meta —
+    THE region-enumeration idiom (fsm/store.rs init scan), shared by
+    recovery, the debugger and offline tooling instead of each re-deriving
+    the prefix arithmetic."""
+    prefix = keys.LOCAL_PREFIX + keys.REGION_META_PREFIX
+    for k, v in snapshot.scan_cf(CF_RAFT, prefix,
+                                 prefix[:-1] + bytes([prefix[-1] + 1])):
+        yield codec.decode_u64(k, 2), v
+
+
 def decode_cmd(b: bytes) -> dict:
     cv, off = codec.decode_var_u64(b, 0)
     v, off = codec.decode_var_u64(b, off)

@@ -48,6 +48,12 @@ class LocalStorage(ExternalStorage):
         with open(os.path.join(self.base, name), "rb") as f:
             return f.read()
 
+    def delete(self, name: str) -> None:
+        try:
+            os.unlink(os.path.join(self.base, name))
+        except FileNotFoundError:
+            pass
+
     def list(self) -> list[str]:
         return sorted(n for n in os.listdir(self.base) if not n.endswith(".tmp"))
 
@@ -171,37 +177,81 @@ class BackupEndpoint:
         LEADER region consistently at backup_ts through its own region
         snapshot, and emit size-split, checksummed files plus a backupmeta
         the restore side drives from."""
-        import json as _json
+        from ..raft.raftkv import RegionSnapshot
 
         accessor = RegionInfoAccessor(store)
-        regions_meta = []
-        total = {"kvs": 0, "bytes": 0, "crc64xor": 0}
+        jobs = []
         for region, peer, is_leader in accessor.regions_in_range(start, end):
             if not is_leader:
                 continue  # that region's leader store backs it up
-            writer = BackupWriter(self.storage, f"{name}_r{region.id}",
-                                  backup_ts, max_file_bytes)
             if snapshot_fn is not None:
                 snap = snapshot_fn(peer)
             else:
-                from ..raft.raftkv import RegionSnapshot
-
                 snap = RegionSnapshot(store.engine.snapshot(), region.clone())
-            lo = Key.from_raw(start) if start else None
-            hi = Key.from_raw(end) if end else None
-            for raw_key, value in ForwardScanner(snap, backup_ts, lo, hi):
-                writer.add(raw_key, value)
-            writer.flush()
-            for f in writer.files:
-                total["kvs"] += f["total_kvs"]
-                total["bytes"] += f["total_bytes"]
-                total["crc64xor"] ^= f["crc64xor"]
-            regions_meta.append({
-                "region_id": region.id,
-                "start_key": (region.start_key or b"").hex(),
-                "end_key": (region.end_key or b"").hex(),
-                "files": writer.files,
-            })
+            jobs.append((region, snap))
+        lo = Key.from_raw(start) if start else None
+        hi = Key.from_raw(end) if end else None
+        return self._backup_regions(jobs, name, backup_ts, max_file_bytes, lo, hi)
+
+    def backup_offline(self, engine, name: str, backup_ts: int,
+                       max_file_bytes: int = 64 << 20) -> dict:
+        """Backup a STOPPED store's engine directly (the tikv-ctl / BR
+        offline flow): regions enumerate from persisted CF_RAFT meta —
+        leadership is irrelevant with no live traffic — and each scans
+        through its own RegionSnapshot exactly like the online path.
+        A dir with NO region meta is refused: it is not a store."""
+        from ..raft.raftkv import RegionSnapshot
+        from ..raft.store import decode_region, scan_region_states
+
+        regions = [decode_region(v)[0] for _rid, v in
+                   scan_region_states(engine.snapshot())]
+        if not regions:
+            raise ValueError(
+                "no region metadata found — not a (bootstrapped) store dir")
+        regions.sort(key=lambda r: r.start_key)
+        jobs = [(r, RegionSnapshot(engine.snapshot(), r.clone())) for r in regions]
+        return self._backup_regions(jobs, name, backup_ts, max_file_bytes, None, None)
+
+    def _backup_regions(self, jobs, name: str, backup_ts: int,
+                        max_file_bytes: int, lo, hi) -> dict:
+        """ONE definition of the per-region write loop + meta accumulation,
+        shared by the online and offline flows.  Leftover prewrite locks
+        abort with a clear remedy and every partial file is removed — a
+        backup without its meta must not masquerade as one."""
+        import json as _json
+
+        from ..storage.mvcc.reader import KeyIsLockedError
+
+        regions_meta = []
+        total = {"kvs": 0, "bytes": 0, "crc64xor": 0}
+        written: list[str] = []
+        try:
+            for region, snap in jobs:
+                writer = BackupWriter(self.storage, f"{name}_r{region.id}",
+                                      backup_ts, max_file_bytes)
+                for raw_key, value in ForwardScanner(snap, backup_ts, lo, hi):
+                    writer.add(raw_key, value)
+                writer.flush()
+                written.extend(f["file"] for f in writer.files)
+                for f in writer.files:
+                    total["kvs"] += f["total_kvs"]
+                    total["bytes"] += f["total_bytes"]
+                    total["crc64xor"] ^= f["crc64xor"]
+                regions_meta.append({
+                    "region_id": region.id,
+                    "start_key": (region.start_key or b"").hex(),
+                    "end_key": (region.end_key or b"").hex(),
+                    "files": writer.files,
+                })
+        except KeyIsLockedError as e:
+            for fname in written:
+                delete = getattr(self.storage, "delete", None)
+                if delete is not None:
+                    delete(fname)
+            raise ValueError(
+                f"backup aborted: prewrite lock below backup_ts on "
+                f"{getattr(e, 'key', b'?')!r} — resolve locks first "
+                f"(ctl resolve-lock / recover-mvcc)") from e
         meta = {
             "name": name,
             "backup_ts": backup_ts,
