@@ -217,3 +217,31 @@ def test_pb_priority_hint_parses(store):
     assert group == 42 and prio == "high"
     # a request with no context yields no hints, without raising
     assert sched_hints(kp.GetRequest(key=b"k").encode()) == (None, None)
+
+
+def test_deadlock_service_over_pb(store):
+    """deadlock.proto over the wire: Detect edges through the pb gateway,
+    cycle answered as DeadlockResponse with entry + wait chain."""
+    from tikv_tpu.proto import kvproto_pb as kp
+
+    srv, cli = store
+    det = kp.DeadlockRequest(
+        tp=kp.DEADLOCK_DETECT, entry=kp.WaitForEntry(txn=910, wait_for_txn=920))
+    resp = cli.call("deadlock_detect", det)
+    assert resp.entry is None  # no cycle yet
+    det2 = kp.DeadlockRequest(
+        tp=kp.DEADLOCK_DETECT,
+        entry=kp.WaitForEntry(txn=920, wait_for_txn=910, key_hash=7777))
+    resp = cli.call("deadlock_detect", det2)
+    # the response echoes the REQUEST entry (key_hash preserved)
+    assert resp.entry is not None and resp.entry.txn == 920
+    assert resp.entry.key_hash == 7777
+    assert resp.deadlock_key_hash == 7777
+    chain = [(e.txn, e.wait_for_txn) for e in resp.wait_chain]
+    # a well-formed cycle: no self-edges, and it closes back on itself
+    assert chain == [(910, 920), (920, 910)], chain
+    # cleanup clears the waiter's edges
+    cu = kp.DeadlockRequest(tp=kp.DEADLOCK_CLEAN_UP, entry=kp.WaitForEntry(txn=910))
+    cli.call("deadlock_detect", cu)
+    resp = cli.call("deadlock_detect", det2)
+    assert resp.entry is None  # edge 910->920 gone: no cycle

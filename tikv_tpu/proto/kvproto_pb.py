@@ -761,3 +761,36 @@ class CoprResponsePb(Kv):
 REQ_DAG = 103
 REQ_ANALYZE = 104
 REQ_CHECKSUM = 105
+
+
+# -- deadlock.proto (the Deadlock detector service, deadlock.rs:343-391) ----
+
+DEADLOCK_DETECT = 0
+DEADLOCK_CLEAN_UP_WAIT_FOR = 1
+DEADLOCK_CLEAN_UP = 2
+
+
+class WaitForEntry(Kv):
+    FIELDS = (
+        U(1, "txn"),
+        U(2, "wait_for_txn"),
+        U(3, "key_hash"),
+        Y(4, "key"),
+        Y(5, "resource_group_tag"),
+        U(6, "wait_time"),
+    )
+
+
+class DeadlockRequest(Kv):
+    FIELDS = (
+        U(1, "tp"),  # DeadlockRequestType enum
+        M(2, "entry", lambda: WaitForEntry),
+    )
+
+
+class DeadlockResponse(Kv):
+    FIELDS = (
+        M(1, "entry", lambda: WaitForEntry),
+        U(2, "deadlock_key_hash"),
+        M(3, "wait_chain", lambda: WaitForEntry, repeated=True),
+    )

@@ -37,11 +37,15 @@ class DeadlockDetector:
         self.waits_for: dict[int, set[int]] = {}
 
     def detect(self, waiter_ts: int, lock_ts: int) -> None:
-        """Register edge waiter→lock; raise DeadlockError if it closes a cycle."""
+        """Register edge waiter→lock; raise DeadlockError if it closes a
+        cycle.  The cycle lists each member ONCE, [lock_ts..waiter_ts] —
+        the closing edge waiter→lock is implicit (wire encoders add it)."""
         with self._mu:
             cycle = self._path(lock_ts, waiter_ts)
             if cycle is not None:
-                raise DeadlockError(waiter_ts, lock_ts, cycle + [waiter_ts])
+                if cycle[-1] != waiter_ts:
+                    cycle = cycle + [waiter_ts]
+                raise DeadlockError(waiter_ts, lock_ts, cycle)
             self.waits_for.setdefault(waiter_ts, set()).add(lock_ts)
 
     def _path(self, frm: int, to: int) -> list[int] | None:

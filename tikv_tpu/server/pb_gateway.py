@@ -596,6 +596,54 @@ def _output_field_types(pb: tp.DAGRequest):
     return [schema[i] for i in offsets]
 
 
+def _r_deadlock(q: kp.DeadlockRequest) -> dict:
+    """deadlock.proto DeadlockRequest → the detector service's dict shape
+    (tp enum → the service's string tags).  The request entry rides the _pb
+    side-channel so the response can echo it faithfully (the reference's
+    DeadlockResponse carries the original entry + its key hash)."""
+    tps = {kp.DEADLOCK_DETECT: "detect",
+           kp.DEADLOCK_CLEAN_UP_WAIT_FOR: "clean_up_wait_for",
+           kp.DEADLOCK_CLEAN_UP: "clean_up"}
+    tp_name = tps.get(q.tp)
+    if tp_name is None:
+        raise PbGatewayError(f"unknown deadlock request tp {q.tp}")
+    entry = q.entry
+    if entry is None:
+        # detect(0,0) would fabricate a txn-0 self-deadlock; reject instead
+        raise PbGatewayError("deadlock request missing its WaitForEntry")
+    out = {"tp": tp_name, "waiter_ts": entry.txn, "lock_ts": entry.wait_for_txn,
+           "_pb": entry}
+    if tp_name == "clean_up":
+        out["txn_ts"] = entry.txn
+    return out
+
+
+def _w_deadlock(r: dict, entry: "kp.WaitForEntry | None" = None) -> kp.DeadlockResponse:
+    if r.get("error") or r.get("not_leader"):
+        # an empty DeadlockResponse reads as "edge registered, no cycle" —
+        # a dropped edge must fail loudly, never silently
+        raise PbGatewayError(
+            f"deadlock detect not served: {r.get('error') or 'not the detector leader'}")
+    out = kp.DeadlockResponse()
+    dl = r.get("deadlock")
+    if dl:
+        # echo the REQUEST entry (with its key/key_hash) like the reference;
+        # deadlock_key_hash identifies the conflicting lock the caller must
+        # resolve — the waiter's own key hash is the closest we track
+        out.entry = entry if entry is not None else kp.WaitForEntry(
+            txn=dl["waiting_txn"], wait_for_txn=dl["blocked_on_txn"])
+        out.deadlock_key_hash = out.entry.key_hash
+        cycle = list(dl.get("cycle") or [])
+        if len(cycle) >= 2:
+            # cycle = [lock, ..., waiter]: consecutive edges + the closing
+            # edge back to the head — no self-edges, nothing dropped
+            out.wait_chain = [
+                kp.WaitForEntry(txn=a, wait_for_txn=b)
+                for a, b in zip(cycle, cycle[1:] + cycle[:1])
+            ]
+    return out
+
+
 def _w_coprocessor(r: dict, pb: tp.DAGRequest | None = None) -> kp.CoprResponsePb:
     out = kp.CoprResponsePb()
     err = r.get("error")
@@ -665,6 +713,7 @@ HANDLERS: dict[str, tuple] = {
     "mvcc_get_by_start_ts": (kp.MvccGetByStartTsRequest, _r_mvcc_by_start_ts,
                              _w_mvcc_by_start_ts),
     "coprocessor": (kp.CoprRequestPb, _r_coprocessor, _w_coprocessor),
+    "deadlock_detect": (kp.DeadlockRequest, _r_deadlock, _w_deadlock),
 }
 
 
@@ -697,6 +746,7 @@ RESPONSE_TYPES = {
     "mvcc_get_by_key": kp.MvccGetByKeyResponse,
     "mvcc_get_by_start_ts": kp.MvccGetByStartTsResponse,
     "coprocessor": kp.CoprResponsePb,
+    "deadlock_detect": kp.DeadlockResponse,
 }
 
 
@@ -741,4 +791,6 @@ class PbGateway:
         resp = self.service.dispatch(method, req)
         if method == "coprocessor":
             return _w_coprocessor(resp, pb).encode()
+        if method == "deadlock_detect":
+            return _w_deadlock(resp, pb).encode()
         return fill(resp).encode()
