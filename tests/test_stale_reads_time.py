@@ -137,3 +137,35 @@ def test_lagging_follower_refuses_stale_read():
     cluster.tick(5)
     snap = fkv.snapshot({"region_id": FIRST_REGION_ID, "stale_read": True, "read_ts": w})
     assert PointGetter(snap, w).get(Key.from_raw(b"lk")) == b"lv"
+
+
+def test_replica_read_linearizable_from_follower():
+    """Replica read (read.rs replica-read): a FOLLOWER serves a snapshot
+    after a ReadIndex round trip to the leader + apply catch-up — it must
+    observe every write committed before the read was issued."""
+    from tikv_tpu.raft.cluster import FIRST_REGION_ID, Cluster
+    from tikv_tpu.raft.raftkv import RaftKv
+    from tikv_tpu.raft.region import NotLeaderError
+    from tikv_tpu.storage.engine import CF_DEFAULT
+
+    c = Cluster(3)
+    c.run()
+    c.must_put(b"rr-1", b"v1")
+    leader = c.wait_leader(FIRST_REGION_ID)
+    follower_sid = next(
+        sid for sid, s in c.stores.items() if sid != leader.store.store_id)
+    kv = c.raftkv(follower_sid)
+    # plain read on a follower refuses (leader-only)
+    try:
+        kv.snapshot({"region_id": FIRST_REGION_ID})
+        raise AssertionError("follower served a non-replica read")
+    except NotLeaderError:
+        pass
+    # replica read serves, and sees the committed write
+    snap = kv.snapshot({"region_id": FIRST_REGION_ID, "replica_read": True})
+    assert snap.get_cf(CF_DEFAULT, b"rr-1") == b"v1"
+    # linearizability: a NEW write committed on the leader is visible to a
+    # replica read issued afterwards
+    c.must_put(b"rr-2", b"v2")
+    snap = kv.snapshot({"region_id": FIRST_REGION_ID, "replica_read": True})
+    assert snap.get_cf(CF_DEFAULT, b"rr-2") == b"v2"

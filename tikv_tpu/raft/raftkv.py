@@ -145,6 +145,13 @@ class RaftKv(Engine):
                 raise RaftKv.DataNotReadyError(peer.region.id, read_ts, resolved)
             return RegionSnapshot(self.store.engine.snapshot(), peer.region.clone())
         if not peer.node.is_leader():
+            if ctx.get("replica_read") and peer.peer_id not in peer.node.witnesses:
+                # replica read (read.rs replica-read + ReplicaReadLockChecker
+                # role): the FOLLOWER serves a linearizable snapshot by
+                # asking the leader for a ReadIndex over the wire and waiting
+                # until its own apply catches up to it — the raft core's
+                # READ_INDEX forward/RESP machinery does the round trip
+                return self._read_index_barrier(peer)
             raise NotLeaderError(peer.region.id, self.store.leader_store_of(peer.region.id))
         # lease fast path (LocalReader, read.rs:342): while the leader holds a
         # quorum-granted lease and the ENGINE contains everything committed
@@ -152,6 +159,12 @@ class RaftKv(Engine):
         # reads skip the ReadIndex round entirely
         if peer.node.lease_valid() and peer.apply_index >= peer.node.commit:
             return RegionSnapshot(self.store.engine.snapshot(), peer.region.clone())
+        return self._read_index_barrier(peer)
+
+    def _read_index_barrier(self, peer) -> RegionSnapshot:
+        """ONE definition of the ReadIndex wait (leader slow path AND
+        follower replica reads): block until the read point is applied
+        locally, then snapshot."""
         done = threading.Event()
         err: list = []
 

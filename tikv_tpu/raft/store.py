@@ -14,6 +14,7 @@ regions, exactly like the reference's single RocksDB with a raft CF.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -317,7 +318,9 @@ class StorePeer:
         self.node.learners = set(region.learner_ids())
         self.node.witnesses = set(region.witness_ids())
         self.proposals: list[Proposal] = []
-        self.pending_reads: dict[bytes, Callable] = {}
+        # ctx -> (cb, expiry deadline); see read_index / _expire_stale_reads
+        self.pending_reads: dict[bytes, tuple[Callable, float]] = {}
+        self._was_leader = False  # stepdown-transition detector (handle_ready)
         self._read_seq = 0
         self.merging = False  # PrepareMerge applied: no more data proposals
         # Completed apply progress.  node.applied advances when ready()
@@ -421,27 +424,61 @@ class StorePeer:
             cb,
         )
 
+    # follower replica-read waiters whose READ_INDEX (or its RESP) vanished
+    # — leader stepdown mid-round, partition — are failed after this long
+    # so pending_reads can never grow without bound on a live follower
+    READ_WAIT_TTL = 15.0
+
     def read_index(self, cb: Callable) -> None:
-        """Linearizable read barrier; cb() fires once safe to read locally."""
+        """Linearizable read barrier; cb() fires once safe to read locally.
+        Works on followers too (replica read): the ctx forwards to the
+        leader and the RESP releases it here."""
+        if not self.node.is_leader() and self.node.leader_id is None:
+            # no known leader (election window): the raft core would drop
+            # the forward on the floor — fail fast so the caller retries
+            # instead of burning its whole timeout
+            cb(NotLeaderError(self.region.id, None))
+            return
         with self._cb_mu:
             self._read_seq += 1
-            ctx = codec.encode_u64(self.region.id) + codec.encode_u64(self._read_seq)
-            self.pending_reads[ctx] = cb
+            # ctx must be unique CLUSTER-wide: every peer starts its seq at
+            # 0, so without the peer id two forwarding followers collide in
+            # the leader's pending-read table and one waiter never fires
+            ctx = (codec.encode_u64(self.region.id)
+                   + codec.encode_u64(self.peer_id)
+                   + codec.encode_u64(self._read_seq))
+            self.pending_reads[ctx] = (cb, time.monotonic() + self.READ_WAIT_TTL)
         self.node.read_index(ctx)
         self.store.notify_region(self.region.id)
 
+    def _expire_stale_reads(self) -> None:
+        if not self.pending_reads:
+            return
+        now = time.monotonic()
+        fire = []
+        with self._cb_mu:
+            for ctx, (cb, deadline) in list(self.pending_reads.items()):
+                if now >= deadline:
+                    del self.pending_reads[ctx]
+                    fire.append(cb)
+        for cb in fire:
+            cb(NotLeaderError(self.region.id, self.store.leader_store_of(self.region.id)))
+
     def handle_ready(self, sync_apply: bool = False) -> bool:
-        if (self.proposals or self.pending_reads) and not self.node.is_leader():
-            # stepped down: fail every pending proposal AND read-index
-            # waiter NOW (the reference notifies on leader change rather
-            # than leaving callers to time out — a deposed leader never
-            # produces the awaited read states either).  This also keeps
-            # self.proposals sorted by index — the invariant _ack's
-            # front-pop relies on — because a re-election on this store
-            # starts from an empty list.
+        is_leader = self.node.is_leader()
+        self._expire_stale_reads()
+        if (self._was_leader or self.proposals) and not is_leader:
+            # stepped DOWN (transition, not merely "is a follower" — a
+            # follower legitimately parks replica-read waiters here): fail
+            # every pending proposal and read-index waiter NOW (the
+            # reference notifies on leader change rather than leaving
+            # callers to time out — a deposed leader never produces the
+            # awaited read states either).  This also keeps self.proposals
+            # sorted by index — the invariant _ack's front-pop relies on —
+            # because a re-election on this store starts from an empty list.
             with self._cb_mu:
                 stale, self.proposals = self.proposals, []
-                stale_reads = list(self.pending_reads.values())
+                stale_reads = [cb for cb, _dl in self.pending_reads.values()]
                 self.pending_reads.clear()
                 self.pending_read_states.clear()
             leader = self.store.leader_store_of(self.region.id)
@@ -449,6 +486,7 @@ class StorePeer:
                 p.cb(NotLeaderError(self.region.id, leader))
             for cb in stale_reads:
                 cb(NotLeaderError(self.region.id, leader))
+        self._was_leader = is_leader
         rd = self.node.ready()
         if rd.is_empty():
             return False
@@ -635,9 +673,9 @@ class StorePeer:
             rest = []
             for ctx, index in self.pending_read_states:
                 if self.apply_index >= index:
-                    cb = self.pending_reads.pop(ctx, None)
-                    if cb is not None:
-                        fire.append(cb)
+                    ent = self.pending_reads.pop(ctx, None)
+                    if ent is not None:
+                        fire.append(ent[0])
                 else:
                     rest.append((ctx, index))
             self.pending_read_states = rest
