@@ -317,11 +317,35 @@ class ServerCluster:
         self._run_admin(leader, cmd)
 
     def transfer_leader(self, region_id: int, to_store: int, timeout: float = 10.0) -> None:
+        """Prefer the proper leader-side transfer (TIMEOUT_NOW once the
+        target's log is caught up); fall back to target-side campaigns only
+        at a slow cadence — a 0.1s campaign loop bumps terms faster than a
+        loaded cluster can replicate, livelocking the very catch-up the
+        election needs."""
         peer = self.nodes[to_store].store.peers[region_id]
         deadline = time.monotonic() + timeout
+        ordered_at = 0.0   # last ACCEPTED leader-side order (True return)
+        forced_at = 0.0    # last target-side forced campaign
         while time.monotonic() < deadline:
-            peer.node.campaign()
-            time.sleep(0.1)
             if peer.node.is_leader():
                 return
+            now = time.monotonic()
+            cur = self.leader_peer(region_id)
+            ordered = False
+            if (cur is not None and cur.store.store_id != to_store
+                    and now - ordered_at > 1.0):
+                # leader-side order at most 1/s: TIMEOUT_NOW re-sent every
+                # loop tick would force-campaign (and term-bump) the target
+                # per delayed delivery, churning the very election it runs
+                ordered = cur.transfer_leader_to(peer.peer_id)
+                if ordered:
+                    ordered_at = now
+            if not ordered and now - max(ordered_at, forced_at) > 1.0:
+                # the polite path is refused (learner target, or match never
+                # equals last_index under a concurrent writer) or there is
+                # no leader: fall back to the forced campaign — at a slow
+                # cadence so replication can still outrun the term bumps
+                peer.node.campaign()
+                forced_at = now
+            time.sleep(0.05)
         raise AssertionError(f"store {to_store} never took region {region_id}")
