@@ -270,3 +270,41 @@ def test_pd_balance_region_converges():
             assert c.must_get(k) == b"v"
     finally:
         c.shutdown()
+
+
+def test_leader_balance_weighs_region_load():
+    """Hot-region-aware leader balance (pd-server hot scheduler role): equal
+    leader COUNTS still rebalance when one store leads all the load; zero
+    load everywhere keeps the old pure-count behavior."""
+    from tikv_tpu.raft.region import Peer as RegionPeer, Region, RegionEpoch
+
+    pd = MockPd()
+    pd.replication_factor = 2
+    pd.balance_threshold = 2
+    pd.balance_region_threshold = 10**9  # isolate leader balance
+
+    def mk_region(rid):
+        return Region(rid, b"%d-a" % rid, b"%d-z" % rid, RegionEpoch(),
+                      [RegionPeer(rid * 10 + 1, 1), RegionPeer(rid * 10 + 2, 2)])
+
+    regions = {rid: mk_region(rid) for rid in (1, 2, 3, 4)}
+    pd.store_heartbeat(1, {})
+    pd.store_heartbeat(2, {})
+    # equal counts: stores 1 and 2 lead two regions each — no load, balanced
+    # (interleaved registration so the count delta never crosses the
+    # threshold transiently)
+    for rid, lsid in ((1, 1), (3, 2), (2, 1), (4, 2)):
+        pd.region_heartbeat(regions[rid], lsid)
+    for rid, lsid in ((1, 1), (3, 2), (2, 1), (4, 2)):
+        op = pd.region_heartbeat(regions[rid], lsid)
+        assert op is None, (rid, op)
+    # store 1's regions run hot; store 2's stay idle — several beats build
+    # the EWMA past the threshold (2 weight units = 200 load at unit=100)
+    for _ in range(6):
+        pd.region_heartbeat(regions[1], 1, load=400)
+        pd.region_heartbeat(regions[2], 1, load=400)
+        pd.region_heartbeat(regions[3], 2, load=0)
+        pd.region_heartbeat(regions[4], 2, load=0)
+    op = pd.region_heartbeat(regions[1], 1, load=400)
+    assert op is not None and op["type"] == "transfer_leader", op
+    assert op["store_id"] == 2

@@ -30,7 +30,8 @@ class PdClient:
 
     def get_region_by_id(self, region_id: int) -> Region | None: ...
 
-    def region_heartbeat(self, region: Region, leader_store: int) -> dict | None:
+    def region_heartbeat(self, region: Region, leader_store: int,
+                         load: float = 0.0) -> dict | None:
         """Returns at most one scheduling operator for the leader to run."""
         ...
 
@@ -72,6 +73,11 @@ class MockPd(PdClient):
         self.operator_ttl = 30.0
         self.store_down_secs = 10.0
         self.operators: dict[int, dict] = {}  # region_id -> pending operator
+        # per-region leader write-load EWMA (pd-server hot-region statistics)
+        self.region_load: dict[int, float] = {}
+        # one leader-balance weight unit per this many load units: blends
+        # counts with load (load 0 everywhere == pure count balance)
+        self.load_weight_unit = 100.0
         # cluster replication status (replication_mode.rs ReplicationStatus)
         self.replication: dict = {"mode": "majority", "state": "sync", "labels": {}}
         self._groups_alive_since: dict = {}
@@ -121,12 +127,19 @@ class MockPd(PdClient):
         with self._mu:
             return self.leaders.get(region_id)
 
-    def region_heartbeat(self, region: Region, leader_store: int) -> dict | None:
+    def region_heartbeat(self, region: Region, leader_store: int,
+                         load: float = 0.0) -> dict | None:
         """Record the heartbeat and answer with at most ONE operator (the
         reference's heartbeat-response scheduling, pd_client lib.rs:180 —
         PD drives the cluster by piggybacking add/remove-peer and
-        transfer-leader orders on region heartbeat responses)."""
+        transfer-leader orders on region heartbeat responses).  ``load`` is
+        the leader's write ops since its last beat; an EWMA of it weights
+        the leader-balance scheduler (pd-server's hot-region awareness) so
+        one store leading all the hot regions counts as imbalanced even at
+        equal leader counts."""
         with self._mu:
+            prev = self.region_load.get(region.id, 0.0)
+            self.region_load[region.id] = 0.5 * prev + 0.5 * float(load)
             cur = self.regions.get(region.id)
             if cur is None or (
                 (region.epoch.version, region.epoch.conf_ver)
@@ -206,15 +219,25 @@ class MockPd(PdClient):
         op = self._balance_region(region, leader_store, alive, now)
         if op is not None:
             return op
-        # leader balance over the stores hosting this region
-        counts = {sid: 0 for sid in alive}
+        # leader balance over the stores hosting this region: each led
+        # region weighs 1 + load_ewma/unit, so equal COUNTS still rebalance
+        # when one store leads all the hot regions (and with no load
+        # reported the weights reduce to plain counts — the old behavior)
+        weights = {sid: 0.0 for sid in alive}
         for rid, lsid in self.leaders.items():
-            if lsid in counts:
-                counts[lsid] += 1
+            if lsid in weights:
+                weights[lsid] += 1.0 + self.region_load.get(rid, 0.0) / self.load_weight_unit
         peer_stores = [p.store_id for p in voters if p.store_id in alive and p.store_id != leader_store]
-        if peer_stores and leader_store in counts:
-            target = min(peer_stores, key=lambda s: counts[s])
-            if counts[leader_store] - counts[target] >= self.balance_threshold:
+        if peer_stores and leader_store in weights:
+            target = min(peer_stores, key=lambda s: weights[s])
+            w_region = 1.0 + self.region_load.get(region.id, 0.0) / self.load_weight_unit
+            delta = weights[leader_store] - weights[target]
+            # transferring THIS region moves w_region across, changing the
+            # delta to delta − 2·w_region: fire only when that IMPROVES
+            # balance (delta > w_region ⇒ |delta − 2w| < delta), or a hot
+            # region ping-pongs — each transfer overshoots the imbalance the
+            # other way and immediately re-triggers in reverse
+            if delta >= self.balance_threshold and delta > w_region:
                 tp = region.peer_on_store(target)
                 return {"type": "transfer_leader", "peer_id": tp.peer_id, "store_id": target}
         return None

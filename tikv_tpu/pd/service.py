@@ -61,7 +61,8 @@ class PdService:
 
     def pd_region_heartbeat(self, req: dict) -> dict:
         region, _ = decode_region(req["region"])
-        op = self.pd.region_heartbeat(region, req["leader_store"])
+        op = self.pd.region_heartbeat(region, req["leader_store"],
+                                      load=req.get("load", 0))
         return {"operator": op}
 
     def pd_store_heartbeat(self, req: dict) -> dict:
@@ -159,10 +160,12 @@ class RemotePd(PdClient):
     def leader_of(self, region_id: int) -> int | None:
         return self._call("pd_get_region_by_id", {"region_id": region_id})["leader_store"]
 
-    def region_heartbeat(self, region: Region, leader_store: int) -> dict | None:
+    def region_heartbeat(self, region: Region, leader_store: int,
+                         load: float = 0.0) -> dict | None:
         r = self._call(
             "pd_region_heartbeat",
-            {"region": encode_region(region), "leader_store": leader_store},
+            {"region": encode_region(region), "leader_store": leader_store,
+             "load": load},
         )
         return r.get("operator")
 

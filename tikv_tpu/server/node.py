@@ -66,9 +66,10 @@ class Node:
         self.heartbeat_hooks: list = []
         pd.put_store(self.store_id)
         self.store.split_observers.append(self._on_split)
-        if split_qps_threshold is not None:
-            # only pay the per-apply observer cost when load splitting is on
-            self.store.apply_observers.append(self._count_writes)
+        # always counted (one dict increment per applied command): the
+        # region-heartbeat load that feeds PD's hot-region leader balance
+        # needs real numbers whether or not load SPLITTING is enabled
+        self.store.apply_observers.append(self._count_writes)
 
     def _count_writes(self, store, region, cmd) -> None:
         ops = cmd.get("ops")
@@ -161,7 +162,9 @@ class Node:
                     for peer in list(self.store.peers.values()):
                         if peer.node.is_leader():
                             led.add(peer.region.id)
-                            op = self.pd.region_heartbeat(peer.region.clone(), self.store_id)
+                            op = self.pd.region_heartbeat(
+                                peer.region.clone(), self.store_id,
+                                load=self._write_ops.get(peer.region.id, 0))
                             if op:
                                 self._execute_operator(peer, op)
                             self._maybe_split(peer)
