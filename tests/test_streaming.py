@@ -181,3 +181,64 @@ def test_mid_stream_error_carried_on_final_frame(served):
             list(it)
     finally:
         ep.handle_streaming_request = orig
+
+
+def test_coprocessor_batch_fuses_on_device():
+    """batch_coprocessor serving shape: K eligible aggregation DAGs over the
+    same cached region view answer from ONE fused device program, byte-
+    identical to per-request CPU answers."""
+    from tikv_tpu.copr.aggr import AggDescriptor
+    from tikv_tpu.copr.dag import Aggregation
+    from tikv_tpu.copr.dag_wire import dag_to_wire
+    from tikv_tpu.copr.endpoint import CoprRequest
+    from tikv_tpu.copr.rpn import col
+
+    eng = LocalEngine(product_engine())
+    ep_dev = Endpoint(eng, enable_device=True)
+    ep_cpu = Endpoint(eng, enable_device=False)
+
+    def agg_dag(fn, target):
+        return DagRequest(executors=[
+            TableScan(TABLE_ID, PRODUCT_COLUMNS),
+            Aggregation([], [AggDescriptor(fn, col(target))]),
+        ])
+
+    dags = [agg_dag("count", 0), agg_dag("sum", 0), agg_dag("max", 0),
+            agg_dag("min", 0)]
+    ctx = {"region_id": 1, "cache_version": 7}
+    reqs = [CoprRequest(103, d, [record_range(TABLE_ID)], 200, dict(ctx))
+            for d in dags]
+    resps = ep_dev.handle_batch(reqs)
+    assert all(r.from_device for r in resps), [r.from_device for r in resps]
+    for d, got in zip(dags, resps):
+        want = ep_cpu.handle_request(
+            CoprRequest(103, d, [record_range(TABLE_ID)], 200, dict(ctx)))
+        assert got.data == want.data
+    from tikv_tpu.util.metrics import REGISTRY
+
+    assert REGISTRY.counter("tikv_coprocessor_batch_total", "").get() >= 1
+    assert REGISTRY.counter("tikv_coprocessor_batch_queries_total", "").get() >= 4
+
+
+def test_coprocessor_batch_over_wire(served):
+    """The RPC surface: one coprocessor_batch call, ordered responses."""
+    from tikv_tpu.copr.aggr import AggDescriptor
+    from tikv_tpu.copr.dag import Aggregation
+    from tikv_tpu.copr.rpn import col
+
+    client, svc, _ep = served
+
+    def sub(fn):
+        dag = DagRequest(executors=[
+            TableScan(TABLE_ID, PRODUCT_COLUMNS),
+            Aggregation([], [AggDescriptor(fn, col(0))]),
+        ])
+        return {"dag": dag_to_wire(dag), "ranges": [list(record_range(TABLE_ID))],
+                "start_ts": 200, "context": {}}
+
+    r = client.call("coprocessor_batch", {"requests": [sub("count"), sub("sum")]})
+    assert "error" not in r, r
+    assert len(r["responses"]) == 2
+    for s, got in zip([sub("count"), sub("sum")], r["responses"]):
+        want = client.call("coprocessor", {k: v for k, v in s.items()})
+        assert got["data"] == want["data"]

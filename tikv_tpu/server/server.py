@@ -50,7 +50,7 @@ STREAM_IDLE_TIMEOUT = 300.0
 _READ_METHODS = (
     "kv_get", "kv_batch_get", "kv_scan", "kv_scan_lock",
     "raw_get", "raw_batch_get", "raw_scan", "raw_batch_scan", "raw_get_key_ttl",
-    "coprocessor", "coprocessor_stream", "raw_coprocessor",
+    "coprocessor", "coprocessor_stream", "coprocessor_batch", "raw_coprocessor",
     "mvcc_get_by_key", "mvcc_get_by_start_ts",
 )
 

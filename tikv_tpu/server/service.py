@@ -898,30 +898,51 @@ class KvService:
     def diagnostics_server_info(self, req: dict) -> dict:
         return self._diag().server_info()
 
+    @staticmethod
+    def _parse_copr_request(req: dict) -> CoprRequest:
+        """ONE definition of the coprocessor sub-request parse (unary and
+        batch must accept identical payloads — including dag-less CHECKSUM)."""
+        dag = req.get("dag")
+        if isinstance(dag, dict):
+            from ..copr.dag_wire import dag_from_wire
+
+            dag = dag_from_wire(dag)
+        tp = req.get("tp", REQ_TYPE_DAG)
+        if dag is None and tp != REQ_TYPE_CHECKSUM:
+            raise ValueError("dag required for this request type")
+        return CoprRequest(
+            tp=tp,
+            dag=dag,
+            ranges=[tuple(r) for r in req["ranges"]],
+            start_ts=req["start_ts"],
+            context=req.get("context") or {},
+        )
+
     def coprocessor(self, req: dict) -> dict:
         """req: {tp, dag (DagRequest in-process, or wire dict; optional for
         CHECKSUM), ranges, start_ts}."""
         assert self.copr is not None, "coprocessor endpoint not wired"
         try:
-            dag = req.get("dag")
-            if isinstance(dag, dict):
-                from ..copr.dag_wire import dag_from_wire
-
-                dag = dag_from_wire(dag)
-            tp = req.get("tp", REQ_TYPE_DAG)
-            if dag is None and tp != REQ_TYPE_CHECKSUM:
-                return {"error": {"other": "dag required for this request type"}}
-            creq = CoprRequest(
-                tp=tp,
-                dag=dag,
-                ranges=[tuple(r) for r in req["ranges"]],
-                start_ts=req["start_ts"],
-                context=req.get("context") or {},
-            )
-            r = self.copr.handle_request(creq)
+            r = self.copr.handle_request(self._parse_copr_request(req))
             return {"data": r.data, "from_device": r.from_device}
         except Exception as e:  # noqa: BLE001
             return {"error": _err(e)}
+
+    def coprocessor_batch(self, req: dict) -> dict:
+        """K coprocessor requests in one RPC (batch_coprocessor surface):
+        device-eligible aggregations over the same region view fuse into ONE
+        device program; everything else answers per-request.  Response order
+        matches request order; a bad sub-request fails ONLY its own slot."""
+        assert self.copr is not None, "coprocessor endpoint not wired"
+        subs = req.get("requests") or []
+        try:
+            creqs = [self._parse_copr_request(sub) for sub in subs]
+            resps = self.copr.handle_batch(creqs)
+            return {"responses": [
+                {"data": r.data, "from_device": r.from_device} for r in resps
+            ]}
+        except Exception:  # noqa: BLE001 — isolate the failure per slot
+            return {"responses": [self.coprocessor(sub) for sub in subs]}
 
     def coprocessor_stream(self, req: dict):
         """Streamed DAG execution (endpoint.rs:508-584): returns a GENERATOR
