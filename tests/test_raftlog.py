@@ -324,3 +324,90 @@ class TestStoreIntegration:
         # the cluster still works after purge
         c.must_put(b"after-gc", b"v")
         assert c.must_get(b"after-gc") == b"v"
+
+
+class TestMigration:
+    def test_cf_raft_store_migrates_into_log_engine(self, tmp_path):
+        """A store persisted BEFORE the log engine was enabled (raft state +
+        entries in CF_RAFT) must recover with its term/vote/entries intact —
+        migrated into the log engine, legacy copies removed — not amnesiac
+        (store.py _migrate_region_log)."""
+        from tikv_tpu.raft.cluster import FIRST_REGION_ID, Cluster
+        from tikv_tpu.raft.store import Store
+        from tikv_tpu.storage.engine import CF_RAFT
+        from tikv_tpu.util import keys
+
+        c = Cluster(3)  # legacy mode: no raft_log anywhere
+        c.run()
+        for i in range(12):
+            c.must_put(b"mig-%02d" % i, b"v%d" % i)
+        victim = 2
+        old = c.stores[victim]
+        old_peer = old.peers[FIRST_REGION_ID]
+        applied_before = old_peer.node.applied
+        term_before = old_peer.node.term
+        vote_before = old_peer.node.vote
+        # legacy CF_RAFT holds the log
+        log_prefix = keys.region_raft_prefix(1) + keys.RAFT_LOG_SUFFIX
+        snap = old.engine.snapshot()
+        legacy = list(snap.scan_cf(CF_RAFT, log_prefix,
+                                   log_prefix[:-1] + bytes([log_prefix[-1] + 1])))
+        assert legacy, "fixture must have CF_RAFT log entries"
+
+        # "upgrade": restart the store WITH the log engine over the same kv
+        rl = NativeRaftLog(str(tmp_path / "mig-rl"), sync=False)
+        new_store = Store(victim, c.transport, engine=old.engine, raft_log=rl)
+        assert new_store.recover() == 1
+        peer = new_store.peers[FIRST_REGION_ID]
+        assert peer.node.applied == applied_before
+        assert peer.node.term == term_before
+        assert peer.node.vote == vote_before  # double-vote safety survives
+        assert peer.node.log.last_index() >= applied_before
+        # migrated: the log engine holds the entries + state...
+        assert rl.last_index(FIRST_REGION_ID) >= applied_before
+        assert rl.state(FIRST_REGION_ID) is not None
+        # ...and the legacy CF_RAFT copies are gone (no split brain)
+        snap = old.engine.snapshot()
+        leftover = list(snap.scan_cf(CF_RAFT, log_prefix,
+                                     log_prefix[:-1] + bytes([log_prefix[-1] + 1])))
+        assert leftover == []
+        assert snap.get_cf(CF_RAFT, keys.raft_state_key(FIRST_REGION_ID)) is None
+        # the migrated peer keeps participating
+        c.stores[victim] = new_store
+        c.transport.register(new_store)
+        c.must_put(b"post-migration", b"pv")
+        c.tick(3)
+        assert c.get_on_store(victim, b"post-migration") == b"pv"
+
+    def test_migration_preserves_noncontiguous_runs(self, tmp_path):
+        """Legacy stores can hold a GAPPED CF_RAFT log (compaction artifacts);
+        migration's run-splitting must keep the live contiguous SUFFIX the
+        raft node needs, never feed the log engine an impossible gap."""
+        from tikv_tpu.raft.cluster import FIRST_REGION_ID, Cluster
+        from tikv_tpu.raft.store import Store
+        from tikv_tpu.storage.engine import CF_RAFT, WriteBatch
+        from tikv_tpu.util import codec, keys
+
+        c = Cluster(1)
+        c.run()
+        for i in range(20):
+            c.must_put(b"gap-%02d" % i, b"v")
+        old = c.stores[1]
+        peer = old.peers[FIRST_REGION_ID]
+        applied_before = peer.node.applied
+        # punch a hole in the middle of the legacy log (indexes 5..8)
+        log_prefix = keys.region_raft_prefix(1) + keys.RAFT_LOG_SUFFIX
+        wb = WriteBatch()
+        wb.delete_range_cf(CF_RAFT, log_prefix + codec.encode_u64(5),
+                           log_prefix + codec.encode_u64(9))
+        old.engine.write(wb)
+
+        rl = NativeRaftLog(str(tmp_path / "gap-rl"), sync=False)
+        new_store = Store(1, c.transport, engine=old.engine, raft_log=rl)
+        assert new_store.recover() == 1
+        # the contiguous suffix after the gap survived in the log engine
+        assert rl.last_index(FIRST_REGION_ID) >= applied_before
+        assert rl.first_index(FIRST_REGION_ID) >= 9
+        got = dict(rl.entries(FIRST_REGION_ID))
+        assert sorted(got) == list(range(rl.first_index(FIRST_REGION_ID),
+                                         rl.last_index(FIRST_REGION_ID) + 1))
