@@ -47,6 +47,17 @@ TABLE_ID = 101
 _JAX_CACHE_DIR = os.path.join(_HERE, ".jax_cache")
 
 
+def _mem_available_gb() -> float:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable"):
+                    return int(line.split()[1]) / 2**20
+    except OSError:
+        pass
+    return 0.0
+
+
 def _force_cpu() -> None:
     """Must go through jax.config: this image's sitecustomize re-exports
     JAX_PLATFORMS=axon at every interpreter start, so a shell-level env
@@ -57,16 +68,22 @@ def _force_cpu() -> None:
 
 
 def _lineitem():
-    from tikv_tpu.copr.datatypes import ColumnInfo, FieldType
+    from tikv_tpu.copr.datatypes import NOT_NULL_FLAG, ColumnInfo, FieldType
+
+    def nn(ft):
+        # TPC-H lineitem columns are all NOT NULL; declaring it lets both
+        # pipelines skip null-mask work honestly
+        ft.flag |= NOT_NULL_FLAG
+        return ft
 
     return [
-        ColumnInfo(1, FieldType.int64(), is_pk_handle=True),
-        ColumnInfo(2, FieldType.int64()),  # l_quantity
-        ColumnInfo(3, FieldType.decimal_type(2)),  # l_extendedprice
-        ColumnInfo(4, FieldType.decimal_type(2)),  # l_discount
-        ColumnInfo(5, FieldType.int64()),  # l_shipdate (days)
-        ColumnInfo(6, FieldType.varchar()),  # l_returnflag
-        ColumnInfo(7, FieldType.varchar()),  # l_linestatus
+        ColumnInfo(1, nn(FieldType.int64()), is_pk_handle=True),
+        ColumnInfo(2, nn(FieldType.int64())),  # l_quantity
+        ColumnInfo(3, nn(FieldType.decimal_type(2))),  # l_extendedprice
+        ColumnInfo(4, nn(FieldType.decimal_type(2))),  # l_discount
+        ColumnInfo(5, nn(FieldType.int64())),  # l_shipdate (days)
+        ColumnInfo(6, nn(FieldType.varchar())),  # l_returnflag
+        ColumnInfo(7, nn(FieldType.varchar())),  # l_linestatus
     ]
 
 
@@ -628,12 +645,14 @@ def main() -> None:
     timeline.append({"t": round(time.time() - t0, 1), "ev": "cpu_cache_built", "s": round(build_s, 1)})
 
     cpu = {}
+    cpu_warm_ts: dict = {}
     for name in ("q6", "q1"):
-        best = float("inf")
+        ts = []
         for _ in range(3):
             resp, dt = run_cpu(_DAGS[name](), cache=cache)
-            best = min(best, dt)
-        cpu[f"{name}_warm"] = (resp.encode(), best)
+            ts.append(dt)
+        cpu_warm_ts[name] = ts
+        cpu[f"{name}_warm"] = (resp.encode(), min(ts))
     kvs_cold = build_kvs(n_cold, seed=1)
     for name in ("q6", "q1"):
         resp, dt = run_cpu(_DAGS[name](), kvs=kvs_cold)
@@ -643,14 +662,14 @@ def main() -> None:
 
     cpu_workers = min(K, os.cpu_count() or 1)
     batch_dags = [name for name in ("q6", "q1") for _ in range(K // 2)]
-    cpu_batch_t = float("inf")
+    cpu_batch_ts: list = []
     with ThreadPoolExecutor(max_workers=cpu_workers) as pool:
-        for _ in range(2):
+        for _ in range(3):  # same trial count as the device side: median vs median
             bt0 = time.perf_counter()
             cpu_batch_resps = list(
                 pool.map(lambda name: run_cpu(_DAGS[name](), cache=cache)[0].encode(), batch_dags)
             )
-            cpu_batch_t = min(cpu_batch_t, time.perf_counter() - bt0)
+            cpu_batch_ts.append(time.perf_counter() - bt0)
     timeline.append({"t": round(time.time() - t0, 1), "ev": "cpu_trials_done"})
     # CPU checks for the engine-backed validations
     kvs_mvcc = build_kvs(n_mvcc, seed=3)
@@ -685,10 +704,15 @@ def main() -> None:
         dev.state["cache_key"] = (n, block_rows, 0)
         dev.state["cold_kvs"] = kvs_cold
         dev.state["cold_rows"] = n_cold
+    elif _mem_available_gb() > n * 7 * 8 * 2.5 / 2**30 + 8:
+        # enough RAM for the worker's copy AND ours: keep the parent cache so
+        # CPU and device warm trials can interleave (machine drift hits both)
+        del kvs_cold
     else:
         # the worker builds its own copies; drop the parent's (~GBs at 100M
         # rows) so the two processes don't both hold the full fixture
         del cache, kvs_cold
+        cache = None
 
     results: dict = {}
 
@@ -699,16 +723,38 @@ def main() -> None:
 
     r = dev.call("build", rows=n, block_rows=block_rows)
     _mark("device_cache_built", s=r.get("build_s"))
+    interleave = cache is not None
     for name in ("q6", "q1"):
-        r = dev.call("warm", q=name, trials=3)
-        want, cpu_t = cpu[f"{name}_warm"]
-        if bytes.fromhex(r["resp"]) != want:
-            _fail(f"{name}_WARM_MISMATCH")
-        dev_t = min(r["ts"])
+        # median-of-N with CPU trials interleaved between device trials when
+        # the parent kept its cache: single-core baseline variance (commit
+        # 91511b1) then hits both sides, and the headline is a median, not a
+        # best-of-N racing that variance
+        want, _ = cpu[f"{name}_warm"]
+        dev_ts: list = []
+        for t in range(3):
+            r = dev.call("warm", q=name, trials=1)
+            if bytes.fromhex(r["resp"]) != want:
+                _fail(f"{name}_WARM_MISMATCH")
+            dev_ts += r["ts"]
+            if interleave:
+                _, dt = run_cpu(_DAGS[name](), cache=cache)
+                cpu_warm_ts[name].append(dt)
+        cpu_ts = cpu_warm_ts[name]
+        cpu_t = float(np.median(cpu_ts))
+        dev_t = float(np.median(dev_ts))
         results[f"{name}_cpu_warm_rows_per_s"] = n / cpu_t
         results[f"{name}_tpu_warm_rows_per_s"] = n / dev_t
         results[f"{name}_warm_speedup"] = cpu_t / dev_t
-        _mark(f"warm_{name}", speedup=round(cpu_t / dev_t, 2))
+        results[f"{name}_cpu_warm_ts"] = [round(x, 4) for x in cpu_ts]
+        results[f"{name}_tpu_warm_ts"] = [round(x, 4) for x in dev_ts]
+        spread = max(max(cpu_ts) / min(cpu_ts), max(dev_ts) / min(dev_ts))
+        results[f"{name}_warm_spread"] = round(spread, 2)
+        if spread > 2.0:
+            results[f"{name}_warm_spread_warning"] = (
+                f"trial spread {spread:.1f}x > 2x — single-core machine drift; "
+                "median shown, individual trials in *_warm_ts"
+            )
+        _mark(f"warm_{name}", speedup=round(cpu_t / dev_t, 2), spread=round(spread, 2))
     for name in ("q6", "q1"):
         # both queries get a one-block compile warmup so cold numbers
         # measure scan+decode+execute, not XLA compilation, symmetrically
@@ -720,11 +766,12 @@ def main() -> None:
         results[f"{name}_tpu_cold_rows_per_s"] = n_cold / r["t"]
         results[f"{name}_cold_speedup"] = cpu_t / r["t"]
         _mark(f"cold_{name}", speedup=round(cpu_t / r["t"], 2))
-    r = dev.call("batch", k=K, trials=2)
+    r = dev.call("batch", k=K, trials=3)
     for got_hex, want in zip(r["resps"], cpu_batch_resps):
         if bytes.fromhex(got_hex) != want:
             _fail("BATCH_MISMATCH")
-    tpu_batch_t = min(r["ts"])
+    tpu_batch_t = float(np.median(r["ts"]))
+    cpu_batch_t = float(np.median(cpu_batch_ts))
     total_rows = n * r["queries"]
     batch_speedup = cpu_batch_t / tpu_batch_t
     results["batch_queries"] = r["queries"]
@@ -732,7 +779,17 @@ def main() -> None:
     results["batch_cpu_rows_per_s"] = total_rows / cpu_batch_t
     results["batch_tpu_rows_per_s"] = total_rows / tpu_batch_t
     results["batch_speedup"] = batch_speedup
-    _mark("batch", speedup=round(batch_speedup, 2))
+    results["batch_cpu_ts"] = [round(x, 3) for x in cpu_batch_ts]
+    results["batch_tpu_ts"] = [round(x, 3) for x in r["ts"]]
+    bspread = max(
+        max(cpu_batch_ts) / min(cpu_batch_ts), max(r["ts"]) / min(r["ts"])
+    )
+    results["batch_spread"] = round(bspread, 2)
+    if bspread > 2.0:
+        results["batch_spread_warning"] = (
+            f"trial spread {bspread:.1f}x > 2x — median shown, trials recorded"
+        )
+    _mark("batch", speedup=round(batch_speedup, 2), spread=round(bspread, 2))
 
     if os.environ.get("BENCH_MVCC", "1") != "0":
         try:

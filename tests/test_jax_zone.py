@@ -1,0 +1,305 @@
+"""Differential tests for the zone-tiled clustered warm path (jax_zone.py).
+
+Every case runs a DAG through the device warm-cache path with small tiles (so
+full / empty / partial tiles all occur) and asserts the encoded response is
+byte-identical to the CPU pipeline — the same oracle contract as
+test_jax_eval.py, plus assertions that the zone path (not the generic scan)
+actually served the query where expected.
+"""
+
+import numpy as np
+import pytest
+
+from tikv_tpu.copr import jax_zone
+from tikv_tpu.copr.aggr import AggDescriptor
+from tikv_tpu.copr.cache import ColumnBlockCache
+from tikv_tpu.copr.dag import (
+    Aggregation,
+    BatchExecutorsRunner,
+    DagRequest,
+    Limit,
+    Selection,
+    TableScan,
+    TopN,
+)
+from tikv_tpu.copr.datatypes import ColumnInfo, FieldType
+from tikv_tpu.copr.executors import FixtureScanSource
+from tikv_tpu.copr.jax_eval import JaxDagEvaluator
+from tikv_tpu.copr.rpn import call, col, const_bytes, const_decimal, const_int
+from tikv_tpu.copr.table import encode_row, record_key
+
+from copr_fixtures import TABLE_ID
+
+
+@pytest.fixture(autouse=True)
+def small_tiles(monkeypatch):
+    """Small tiles so a few thousand rows produce many tiles with mixed
+    full/empty/partial classifications."""
+    monkeypatch.setattr(jax_zone, "TILE_ROWS", 64)
+
+
+def mixed_table_kvs(n, seed=0, with_nulls=False):
+    """id, v int (sortable range col), d decimal(2), tag varchar (dict-coded
+    group key), w int.  Optional NULLs in v and tag.
+
+    Returns (cols, kvs, cache): kvs feed the CPU oracle; the pre-filled
+    ColumnBlockCache is the decoded image with dict-coded varchars sharing
+    ONE dictionary object across blocks (the stable-dictionary contract the
+    zone path keys on — built directly, the same way bench.build_cache does,
+    because the row decoder only dictionary-encodes fixed-layout rows)."""
+    rng = np.random.default_rng(seed)
+    cols = [
+        ColumnInfo(1, FieldType.int64(), is_pk_handle=True),
+        ColumnInfo(2, FieldType.int64()),
+        ColumnInfo(3, FieldType.decimal_type(2)),
+        ColumnInfo(4, FieldType.varchar()),
+        ColumnInfo(5, FieldType.int64()),
+    ]
+    v = rng.integers(0, 10_000, n)
+    d = rng.integers(0, 5_000, n)
+    tags = [b"alpha", b"beta", b"gamma"]
+    t = rng.integers(0, 3, n)
+    w = rng.integers(-50, 50, n)
+    null_v = rng.random(n) < 0.05 if with_nulls else np.zeros(n, dtype=bool)
+    null_t = rng.random(n) < 0.05 if with_nulls else np.zeros(n, dtype=bool)
+    non_handle = cols[1:]
+    kvs = []
+    for i in range(n):
+        row = [
+            None if null_v[i] else int(v[i]),
+            int(d[i]),
+            None if null_t[i] else tags[t[i]],
+            int(w[i]),
+        ]
+        kvs.append((record_key(TABLE_ID, i), encode_row(non_handle, row)))
+
+    from tikv_tpu.copr.datatypes import Column, EvalType
+
+    dictionary = np.empty(3, dtype=object)
+    dictionary[:] = sorted(tags)
+    code_of = {tag: j for j, tag in enumerate(sorted(tags))}
+    codes = np.array([code_of[tags[ti]] for ti in t], dtype=np.int64)
+    handles = np.arange(n, dtype=np.int64)
+    cache = ColumnBlockCache()
+    block = 2048  # long group runs so boundary/pad tiles stay a small fraction
+    for s in range(0, n, block):
+        e = min(s + block, n)
+        m = e - s
+        z = np.zeros(m, dtype=bool)
+        cache.add(
+            [
+                Column(EvalType.INT, handles[s:e], z.copy()),
+                Column(EvalType.INT, np.where(null_v[s:e], 0, v[s:e]), null_v[s:e].copy()),
+                Column(EvalType.DECIMAL, d[s:e].copy(), z.copy(), 2),
+                Column(EvalType.BYTES, codes[s:e].copy(), null_t[s:e].copy(), 0, dictionary),
+                Column(EvalType.INT, w[s:e].copy(), z.copy()),
+            ],
+            m,
+        )
+    cache.filled = True
+    return cols, kvs, cache
+
+
+def run_warm(executors, fixture, output_offsets=None):
+    cols, kvs, cache = fixture
+    dag = DagRequest(executors=executors, output_offsets=output_offsets)
+    cpu = BatchExecutorsRunner(dag, FixtureScanSource(kvs)).handle_request()
+    ev = JaxDagEvaluator(dag, block_rows=2048)
+    warm = ev.run(None, cache=cache)
+    return cpu, warm, ev
+
+
+def zone_served(ev) -> bool:
+    zone = getattr(ev, "_zone", None)
+    return bool(zone) and zone.served > 0
+
+
+FIX = mixed_table_kvs(6000)
+NFIX = mixed_table_kvs(6000, seed=1, with_nulls=True)
+COLS, KVS, CACHE = FIX
+NCOLS, NKVS, NCACHE = NFIX
+
+
+def test_zone_grouped_range_predicate():
+    """Grouped agg with a recognized range conjunct: the bench Q1 shape."""
+    cpu, warm, ev = run_warm(
+        [
+            TableScan(TABLE_ID, COLS),
+            Selection([call("le", col(1), const_int(7000))]),
+            Aggregation(
+                group_by=[col(3)],
+                agg_funcs=[
+                    AggDescriptor("sum", col(1)),
+                    AggDescriptor("avg", col(2)),
+                    AggDescriptor("count", None),
+                ],
+            ),
+        ],
+        FIX,
+    )
+    assert zone_served(ev)
+    assert warm.encode() == cpu.encode()
+
+
+def test_zone_ungrouped_multi_conjunct():
+    """Q6 shape: several conjuncts, expression aggregate, no grouping."""
+    cpu, warm, ev = run_warm(
+        [
+            TableScan(TABLE_ID, COLS),
+            Selection(
+                [
+                    call("ge", col(1), const_int(2000)),
+                    call("lt", col(1), const_int(3000)),
+                    call("ge", col(2), const_decimal(500, 2)),
+                ]
+            ),
+            Aggregation(group_by=[], agg_funcs=[AggDescriptor("sum", call("multiply", col(2), col(4)))]),
+        ],
+        FIX,
+    )
+    assert zone_served(ev)
+    assert warm.encode() == cpu.encode()
+
+
+def test_zone_min_max_and_negative_values():
+    cpu, warm, ev = run_warm(
+        [
+            TableScan(TABLE_ID, COLS),
+            Selection([call("gt", col(1), const_int(1000))]),
+            Aggregation(
+                group_by=[col(3)],
+                agg_funcs=[
+                    AggDescriptor("min", col(4)),
+                    AggDescriptor("max", col(4)),
+                    AggDescriptor("sum", col(4)),
+                ],
+            ),
+        ],
+        FIX,
+    )
+    assert zone_served(ev)
+    assert warm.encode() == cpu.encode()
+
+
+def test_zone_nulls_in_group_key_and_values():
+    """NULLs force tiles partial; NULL group keys form their own group."""
+    cpu, warm, ev = run_warm(
+        [
+            TableScan(TABLE_ID, NCOLS),
+            Selection([call("le", col(1), const_int(8000))]),
+            Aggregation(
+                group_by=[col(3)],
+                agg_funcs=[
+                    AggDescriptor("sum", col(1)),
+                    AggDescriptor("count", col(1)),
+                    AggDescriptor("avg", col(1)),
+                    AggDescriptor("count", None),
+                ],
+            ),
+        ],
+        NFIX,
+    )
+    assert zone_served(ev)
+    assert warm.encode() == cpu.encode()
+
+
+def test_zone_unrecognized_conjunct_still_exact():
+    """A non col-vs-const conjunct classifies everything partial; with the
+    partial fraction at 100% the zone path declines and the generic warm
+    path serves — response must still match."""
+    cpu, warm, ev = run_warm(
+        [
+            TableScan(TABLE_ID, COLS),
+            Selection([call("lt", col(1), call("plus", col(4), const_int(5000)))]),
+            Aggregation(group_by=[col(3)], agg_funcs=[AggDescriptor("count", None)]),
+        ],
+        FIX,
+    )
+    assert warm.encode() == cpu.encode()
+
+
+def test_zone_all_tiles_empty():
+    """A predicate no row satisfies: zero groups, empty response."""
+    cpu, warm, ev = run_warm(
+        [
+            TableScan(TABLE_ID, COLS),
+            Selection([call("gt", col(1), const_int(10_000_000))]),
+            Aggregation(group_by=[col(3)], agg_funcs=[AggDescriptor("sum", col(1))]),
+        ],
+        FIX,
+    )
+    assert zone_served(ev)
+    assert warm.encode() == cpu.encode()
+
+
+def test_zone_eq_and_flipped_conjuncts():
+    cpu, warm, ev = run_warm(
+        [
+            TableScan(TABLE_ID, COLS),
+            # const-on-the-left flavors exercise the flipped recognition
+            Selection([call("ge", const_int(9000), col(1)), call("ne", col(2), const_decimal(600000, 2))]),
+            Aggregation(group_by=[col(3)], agg_funcs=[AggDescriptor("sum", col(4))]),
+        ],
+        FIX,
+    )
+    assert zone_served(ev)
+    assert warm.encode() == cpu.encode()
+
+
+def test_zone_post_agg_topn_limit():
+    cpu, warm, ev = run_warm(
+        [
+            TableScan(TABLE_ID, COLS),
+            Selection([call("le", col(1), const_int(9500))]),
+            Aggregation(group_by=[col(3)], agg_funcs=[AggDescriptor("sum", col(1))]),
+            TopN([(col(0), True)], 2),
+        ],
+        FIX,
+    )
+    assert zone_served(ev)
+    assert warm.encode() == cpu.encode()
+
+
+def test_zone_no_selection():
+    """No conjuncts at all: every tile is full (minus pad tiles)."""
+    cpu, warm, ev = run_warm(
+        [
+            TableScan(TABLE_ID, COLS),
+            Aggregation(group_by=[col(3)], agg_funcs=[AggDescriptor("sum", col(1)), AggDescriptor("count", None)]),
+        ],
+        FIX,
+    )
+    assert zone_served(ev)
+    assert warm.encode() == cpu.encode()
+
+
+def test_zone_var_pop_falls_back():
+    """var_pop is outside the zone op set; generic warm path must serve."""
+    cpu, warm, ev = run_warm(
+        [
+            TableScan(TABLE_ID, COLS),
+            Selection([call("le", col(1), const_int(7000))]),
+            Aggregation(group_by=[col(3)], agg_funcs=[AggDescriptor("var_pop", col(1))]),
+        ],
+        FIX,
+    )
+    zone = getattr(ev, "_zone", None)
+    assert zone in (None, False) or zone.served == 0
+    assert warm.encode() == cpu.encode()
+
+
+def test_zone_repeat_and_second_evaluator_share_layout():
+    dag = DagRequest(
+        executors=[
+            TableScan(TABLE_ID, COLS),
+            Selection([call("le", col(1), const_int(7000))]),
+            Aggregation(group_by=[col(3)], agg_funcs=[AggDescriptor("sum", col(1))]),
+        ]
+    )
+    cpu = BatchExecutorsRunner(dag, FixtureScanSource(KVS)).handle_request()
+    ev = JaxDagEvaluator(dag, block_rows=2048)
+    w1 = ev.run(None, cache=CACHE)
+    w2 = ev.run(None, cache=CACHE)
+    assert w1.encode() == w2.encode() == cpu.encode()
+    ev2 = JaxDagEvaluator(dag, block_rows=512)
+    assert ev2.run(None, cache=CACHE).encode() == cpu.encode()

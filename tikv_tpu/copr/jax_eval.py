@@ -356,9 +356,14 @@ def _mixed_radix_gids(cols, group_cols, dict_lens, n_rows):
     return local
 
 
-def _fused_step(sel_rpns, device_aggs, capacity, n_rows, cols, n_valid, gids, offset, state):
+def _fused_step(sel_rpns, device_aggs, capacity, n_rows, cols, n_valid, gids, offset, state,
+                track_first: bool = True):
     """THE block step, shared by every device program: selection predicates →
-    active mask; aggregate updates; first-active-row tracker."""
+    active mask; aggregate updates; first-active-row tracker.
+
+    ``track_first=False`` skips the per-block first-active-row segment-min:
+    with no group-by, finalize outputs the single slot unconditionally, so
+    the tracker is dead work (a whole extra reduction pass per block)."""
     first_row, carries = state
     active = jnp.arange(n_rows, dtype=jnp.int64) < n_valid
     for rpn in sel_rpns:
@@ -368,6 +373,8 @@ def _fused_step(sel_rpns, device_aggs, capacity, n_rows, cols, n_valid, gids, of
         da.update(c, cols, n_rows, gids, active, capacity)
         for da, c in zip(device_aggs, carries)
     )
+    if not track_first:
+        return (first_row, new_carries)
     ridx = jnp.where(active, offset + jnp.arange(n_rows, dtype=jnp.int64), _NO_ROW)
     block_first = _seg_extreme(ridx, gids, capacity, True, _NO_ROW)
     return (jnp.minimum(first_row, block_first), new_carries)
@@ -659,11 +666,13 @@ class JaxDagEvaluator:
         nullable = self.nullable_cols
         sel_rpns = self.sel_rpns
         n_rows = self.block_rows
+        track_first = bool(self.group_rpns)
 
         def agg_fn(col_data, col_nulls, n_valid, gids, block_offset, state):
             cols = _build_cols(device_cols, nullable, col_data, col_nulls, n_rows)
             return _fused_step(
-                sel_rpns, device_aggs, capacity, n_rows, cols, n_valid, gids, block_offset, state
+                sel_rpns, device_aggs, capacity, n_rows, cols, n_valid, gids, block_offset, state,
+                track_first=track_first,
             )
 
         fn = jax.jit(agg_fn, donate_argnums=(5,))
@@ -684,6 +693,7 @@ class JaxDagEvaluator:
         nullable = self.nullable_cols
         sel_rpns = self.sel_rpns
         n_rows = self.block_rows
+        track_first = bool(self.group_rpns)
 
         def scan_fn(col_data, col_nulls, n_valids, gids, offsets):
             state = (
@@ -694,7 +704,8 @@ class JaxDagEvaluator:
             def body(st, xs):
                 cd, cn, nv, g, off = xs
                 cols = _build_cols(device_cols, nullable, cd, cn, n_rows)
-                return _fused_step(sel_rpns, device_aggs, capacity, n_rows, cols, nv, g, off, st), None
+                return _fused_step(sel_rpns, device_aggs, capacity, n_rows, cols, nv, g, off, st,
+                                   track_first=track_first), None
 
             state, _ = jax.lax.scan(body, state, (col_data, col_nulls, n_valids, gids, offsets))
             # pack everything into ONE int64 matrix: the tunnel charges a flat
@@ -718,6 +729,7 @@ class JaxDagEvaluator:
         nullable = self.nullable_cols
         sel_rpns = self.sel_rpns
         n_rows = self.block_rows
+        track_first = bool(self.group_rpns)
 
         def scan_fn(col_data, col_nulls, n_valids, offsets):
             state = (
@@ -729,7 +741,8 @@ class JaxDagEvaluator:
                 cd, cn, nv, off = xs
                 cols = _build_cols(ship_cols, nullable, cd, cn, n_rows)
                 gids = _mixed_radix_gids(cols, group_cols, dict_lens, n_rows)
-                return _fused_step(sel_rpns, device_aggs, capacity, n_rows, cols, nv, gids, off, st), None
+                return _fused_step(sel_rpns, device_aggs, capacity, n_rows, cols, nv, gids, off, st,
+                                   track_first=track_first), None
 
             state, _ = jax.lax.scan(body, state, (col_data, col_nulls, n_valids, offsets))
             return _pack_state(state)
@@ -789,9 +802,22 @@ class JaxDagEvaluator:
         return idxs, dicts
 
     def _run_aggregated_cached(self, cache) -> SelectResponse:
-        """Warm path: every block resident on device, one dispatch total."""
+        """Warm path: every block resident on device, one dispatch total.
+
+        Tries the zone-tiled clustered layout first (jax_zone.py): group-
+        clustered, range-sorted, narrowed tiles whose full/empty/partial
+        classification turns most of the work into pure unmasked reductions.
+        Falls back to the generic stacked-block scan when the plan or the
+        data shape isn't zone-eligible."""
         blocks = cache.blocks
         n_blocks = len(blocks)
+
+        zone = self._zone_evaluator()
+        if zone is not None:
+            out = zone.try_run(cache)
+            if out is not None:
+                state_np, n_slots, key_of = out
+                return self._finalize_agg(state_np, n_slots, key_of)
 
         stable = self._stable_dict_group_cols(blocks)
         if stable is not None:
@@ -838,6 +864,22 @@ class JaxDagEvaluator:
         packed = scan_fn(col_data, col_nulls, nv_dev, all_gids, off_dev)
         state_np = _unpack_state(packed, self._host_state_template())
         return self._finalize_agg(state_np, n_slots, lambda r: groups.rows[r])
+
+    def _zone_evaluator(self):
+        """Lazily constructed zone-path runner (None when plainly ineligible)."""
+        zone = getattr(self, "_zone", None)
+        if zone is False:
+            return None
+        if zone is None:
+            from .jax_zone import ZoneEvaluator, _ZONE_AGG_OPS
+
+            if self.plan.agg is None or any(
+                da.op not in _ZONE_AGG_OPS for da in self.device_aggs
+            ):
+                self._zone = False
+                return None
+            zone = self._zone = ZoneEvaluator(self)
+        return zone
 
     def _host_state_template(self):
         return (
@@ -1274,7 +1316,8 @@ def run_batch_cached(evaluators: list["JaxDagEvaluator"], cache) -> list[SelectR
                     gids = _mixed_radix_gids(cols, group_cols, dict_lens, n_rows)
                     new_sts.append(
                         _fused_step(
-                            ev.sel_rpns, ev.device_aggs, capacity, n_rows, cols, nv, gids, off, st
+                            ev.sel_rpns, ev.device_aggs, capacity, n_rows, cols, nv, gids, off, st,
+                            track_first=bool(ev.group_rpns),
                         )
                     )
                 return tuple(new_sts), None
