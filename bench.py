@@ -652,7 +652,7 @@ def main() -> None:
             resp, dt = run_cpu(_DAGS[name](), cache=cache)
             ts.append(dt)
         cpu_warm_ts[name] = ts
-        cpu[f"{name}_warm"] = (resp.encode(), min(ts))
+        cpu[f"{name}_warm"] = resp.encode()
     kvs_cold = build_kvs(n_cold, seed=1)
     for name in ("q6", "q1"):
         resp, dt = run_cpu(_DAGS[name](), kvs=kvs_cold)
@@ -729,7 +729,7 @@ def main() -> None:
         # the parent kept its cache: single-core baseline variance (commit
         # 91511b1) then hits both sides, and the headline is a median, not a
         # best-of-N racing that variance
-        want, _ = cpu[f"{name}_warm"]
+        want = cpu[f"{name}_warm"]
         dev_ts: list = []
         for t in range(3):
             r = dev.call("warm", q=name, trials=1)
@@ -812,10 +812,21 @@ def main() -> None:
             results["aux_error"] = str(e)[:300]
             _mark("aux_error", err=str(e)[:120])
 
+    if worker is not None:
+        # free the (single) device before the cluster phase: the device
+        # store process must be able to initialize the same chip
+        try:
+            worker.call("quit", timeout=10)
+        except WorkerDied:
+            pass
+        worker = None
+
     if os.environ.get("BENCH_CLUSTER", "1") != "0":
         # BASELINE config #5: 3 store processes + PD over TCP serving
-        # YCSB-E scans and Q1 pushdown (bench_cluster.py); auxiliary — a
-        # cluster failure must not zero the headline device metric
+        # YCSB-E scans and Q1 pushdown (bench_cluster.py) — store 1 runs with
+        # --enable-device on whatever backend this run captured, and the Q1
+        # device phase routes every region there via replica reads; auxiliary
+        # — a cluster failure must not zero the headline device metric
         try:
             import bench_cluster
 
@@ -823,20 +834,18 @@ def main() -> None:
             c = bench_cluster.run(
                 rows=int(os.environ.get("BENCH_CLUSTER_ROWS", "60000")),
                 scan_seconds=float(os.environ.get("BENCH_CLUSTER_SCAN_SECONDS", "8")),
+                device_platform=backend,
             )
             for k in ("load_rows_per_s", "ycsb_e_scans_per_s", "ycsb_e_rows_per_s",
-                      "q1_pushdown_rows_per_s", "regions", "leader_stores"):
+                      "q1_pushdown_rows_per_s", "q1_device_rows_per_s",
+                      "q1_device_from_device", "q1_device_platform",
+                      "regions", "leader_stores"):
                 results[f"cluster_{k}"] = c.get(k)
-            _mark("cluster_ok", q1=c.get("q1_pushdown_rows_per_s"))
+            _mark("cluster_ok", q1=c.get("q1_pushdown_rows_per_s"),
+                  q1_dev=c.get("q1_device_rows_per_s"))
         except Exception as e:  # noqa: BLE001
             results["cluster_error"] = str(e)[:300]
             _mark("cluster_error", err=str(e)[:120])
-
-    if worker is not None:
-        try:
-            worker.call("quit", timeout=10)
-        except WorkerDied:
-            pass
 
     geo = float(
         np.exp(np.mean(np.log([results["q6_warm_speedup"], results["q1_warm_speedup"]])))

@@ -42,16 +42,26 @@ TABLE_ID = 101
 FIRST_REGION_ID = 1
 
 
-def _spawn_store(store_id: int, pd_addr, data_dir: str):
+def _spawn_store(store_id: int, pd_addr, data_dir: str,
+                 enable_device: bool = False, device_platform: str = "cpu"):
     env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
+    if enable_device and device_platform not in ("cpu", "cpu_fallback", "", None):
+        # BASELINE config 5's "TPU copr plugin" role: this store owns the
+        # accelerator — let the platform default (the tunnel device) stand.
+        # Only reached when the caller has already observed a READY backend
+        # this run; a hung tunnel init would otherwise eat the whole budget.
+        env.pop("JAX_PLATFORMS", None)
+    else:
+        env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
     env["PYTHONPATH"] = _HERE
+    argv = [sys.executable, "-m", "tikv_tpu.server.standalone",
+            "--store-id", str(store_id), "--pd", f"{pd_addr[0]}:{pd_addr[1]}",
+            "--dir", data_dir, "--expect-stores", "3"]
+    if enable_device:
+        argv.append("--enable-device")
     return subprocess.Popen(
-        [sys.executable, "-m", "tikv_tpu.server.standalone",
-         "--store-id", str(store_id), "--pd", f"{pd_addr[0]}:{pd_addr[1]}",
-         "--dir", data_dir, "--expect-stores", "3"],
-        env=env, cwd=_HERE,
+        argv, env=env, cwd=_HERE,
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
     )
 
@@ -75,8 +85,11 @@ def _wait_ready(proc, timeout=120.0):
         watchdog.cancel()
 
 
+DEVICE_STORE = 1  # the store that owns the accelerator (config 5's TPU plugin)
+
+
 class _Cluster:
-    def __init__(self, tmp: str):
+    def __init__(self, tmp: str, device_platform: str = "cpu"):
         from tikv_tpu.pd.client import MockPd
         from tikv_tpu.pd.service import PdService
         from tikv_tpu.server.server import Client, Server
@@ -86,11 +99,16 @@ class _Cluster:
         self.pd_server = Server(PdService(self.pd))
         self.pd_server.start()
         self.procs = [
-            _spawn_store(sid, self.pd_server.addr, os.path.join(tmp, f"s{sid}"))
+            _spawn_store(
+                sid, self.pd_server.addr, os.path.join(tmp, f"s{sid}"),
+                enable_device=sid == DEVICE_STORE, device_platform=device_platform,
+            )
             for sid in (1, 2, 3)
         ]
         for p in self.procs:
-            _wait_ready(p)
+            # a real accelerator init (tunnel) can take minutes on top of the
+            # normal bootstrap; the CPU path stays on the short clock
+            _wait_ready(p, timeout=300.0 if device_platform not in ("cpu", "", None) else 120.0)
         self._clients: dict[int, object] = {}
 
     def client_for_store(self, sid: int):
@@ -158,7 +176,8 @@ def _lineitem_cols():
     ]
 
 
-def run(rows: int = 60_000, scan_seconds: float = 8.0, scan_len: int = 50) -> dict:
+def run(rows: int = 60_000, scan_seconds: float = 8.0, scan_len: int = 50,
+        device_platform: str = "cpu") -> dict:
     from tikv_tpu.copr.dag import Aggregation, DagRequest, SelectResponse, Selection, TableScan
     from tikv_tpu.copr.aggr import AggDescriptor
     from tikv_tpu.copr.dag_wire import dag_to_wire
@@ -168,7 +187,7 @@ def run(rows: int = 60_000, scan_seconds: float = 8.0, scan_len: int = 50) -> di
 
     tmp = tempfile.mkdtemp(prefix="bench-cluster-")
     out: dict = {"rows": rows}
-    cluster = _Cluster(tmp)
+    cluster = _Cluster(tmp, device_platform=device_platform)
     try:
         # ---- load the table through MVCC transactions --------------------
         rng = np.random.default_rng(11)
@@ -302,6 +321,54 @@ def run(rows: int = 60_000, scan_seconds: float = 8.0, scan_len: int = 50) -> di
             raise AssertionError(f"Q1 sum(qty) mismatch: {got_qty} != {want_qty}")
         out["q1_pushdown_rows_per_s"] = round(rows / q1_t, 1)
         out["q1_groups"] = len(merged)
+
+        # ---- Q1 via the device store -------------------------------------
+        # One accelerator per deployment: every region's device-eligible DAG
+        # routes to the store that owns it, using follower replica reads
+        # (raftkv.py ReadIndex barrier) for regions whose leader is
+        # elsewhere — so a single chip serves the whole keyspace while
+        # leaders stay spread for writes.  One coprocessor_batch RPC carries
+        # all region sub-requests.
+        dev_client = cluster.client_for_store(DEVICE_STORE)
+
+        def device_round():
+            reqs = [
+                {"dag": wire_dag, "ranges": [list(record_range(TABLE_ID))],
+                 "start_ts": read_ts,
+                 "context": {"region_id": rid, "replica_read": True}}
+                for rid in regions
+            ]
+            t0 = time.perf_counter()
+            r = dev_client.call("coprocessor_batch", {"requests": reqs},
+                                timeout=180.0)
+            return r, time.perf_counter() - t0
+
+        def check(r):
+            for sub in r["responses"]:
+                if sub.get("error"):
+                    raise RuntimeError(f"device-store coprocessor error: {sub['error']}")
+            return r
+
+        check(device_round()[0])  # compile + block-cache fill (untimed)
+        ts = []
+        for _ in range(3):
+            r, dt = device_round()
+            check(r)  # a failed round must fail the metric, not speed it up
+            ts.append(dt)
+        merged_dev: dict[tuple, list] = {}
+        for sub in r["responses"]:
+            for row in SelectResponse.decode(sub["data"]).iter_rows():
+                key = (row[4], row[5])
+                acc = merged_dev.setdefault(key, [0, 0])
+                acc[0] += int(row[0])
+                acc[1] += int(row[3])
+        if merged_dev != merged:
+            raise AssertionError("device-store Q1 merge differs from leader-path merge")
+        out["q1_device_rows_per_s"] = round(rows / float(np.median(ts)), 1)
+        out["q1_device_from_device"] = all(
+            bool(sub.get("from_device")) for sub in r["responses"]
+        )
+        out["q1_device_platform"] = device_platform
         out["ok"] = True
         return out
     finally:
@@ -371,7 +438,8 @@ def _split_and_spread(cluster, rows: int) -> None:
 def main() -> None:
     rows = int(os.environ.get("BENCH_CLUSTER_ROWS", "60000"))
     secs = float(os.environ.get("BENCH_CLUSTER_SCAN_SECONDS", "8"))
-    out = run(rows, secs)
+    out = run(rows, secs,
+              device_platform=os.environ.get("BENCH_CLUSTER_DEVICE", "cpu"))
     print(json.dumps({
         "metric": "cluster3_q1_pushdown_rows_per_sec",
         "value": out["q1_pushdown_rows_per_s"],

@@ -65,10 +65,6 @@ _NULLSAFE_OPS = {
 }
 
 
-def _np_dtype(et: EvalType):
-    return np.float64 if et == EvalType.REAL else np.int64
-
-
 def _narrow_dtype(lo: int, hi: int):
     """Smallest signed int dtype that holds [lo, hi] (and 0, the null fill)."""
     lo, hi = min(lo, 0), max(hi, 0)
@@ -112,6 +108,41 @@ def _tile_sum(x2d, max_abs: int):
 # Conjunct recognition (interval arithmetic against tile zones)
 # ---------------------------------------------------------------------------
 
+def _rpn_sig(rpn: RpnExpression | None) -> tuple:
+    if rpn is None:
+        return ()
+    return tuple(
+        (n.kind, n.eval_type, n.frac, n.index, n.value, n.op, n.arity, tuple(n.scale_by or ()))
+        for n in rpn.nodes
+    )
+
+
+def _plan_sig(ev) -> tuple:
+    """Everything a zone device program depends on: selection RPNs (with
+    constants), aggregate ops + argument RPNs, and whether grouping is on.
+    Two evaluators with equal signatures compile to identical programs, so
+    they share one cached jitted fn per layout instead of pinning one each."""
+    return (
+        tuple(_rpn_sig(r) for r in ev.sel_rpns),
+        tuple((da.op, _rpn_sig(da.rpn)) for da in ev.device_aggs),
+        bool(ev.group_rpns),
+    )
+
+
+_ZONE_FNS_MAX = 32  # distinct plan shapes cached per layout
+
+
+def _layout_fn_cache(layout) -> dict:
+    return layout.__dict__.setdefault("_zone_fns", {})
+
+
+def _fn_cache_put(fns: dict, key, jfn):
+    fns[key] = jfn
+    while len(fns) > _ZONE_FNS_MAX:
+        fns.pop(next(iter(fns)))
+    return jfn
+
+
 def _recognize_conjunct(rpn: RpnExpression):
     """(col_index, op, col_scale, const_value_scaled) for `cmp(col, const)` /
     `cmp(const, col)` RPNs, with the comparison flipped so the column is
@@ -147,7 +178,7 @@ class ZoneLayout:
     (group_cols, sort_col) signature.  Device arrays are flat over all tiles;
     zone stats stay host-side numpy."""
 
-    def __init__(self, blocks, group_cols, dicts, sort_col, needed_cols, schema, col_infos):
+    def __init__(self, blocks, group_cols, dicts, sort_col, needed_cols, schema):
         self.group_cols = list(group_cols)
         self.sort_col = sort_col
         dict_lens = [len(d) for d in dicts]
@@ -290,12 +321,12 @@ class ZoneLayout:
 
 
 
-def build_layout(cache, group_cols, dicts, sort_col, needed_cols, schema, col_infos):
+def build_layout(cache, group_cols, dicts, sort_col, needed_cols, schema):
     sig = ("zone_layout", tuple(group_cols), sort_col, tuple(sorted(needed_cols)), TILE_ROWS)
     blocks = cache.blocks
 
     def build(_blk):
-        return ZoneLayout(blocks, group_cols, dicts, sort_col, sorted(needed_cols), schema, col_infos)
+        return ZoneLayout(blocks, group_cols, dicts, sort_col, sorted(needed_cols), schema)
 
     return cache.device_arrays(blocks[0], sig, build)
 
@@ -411,9 +442,11 @@ class ZoneEvaluator:
         # jitted fns live ON the layout: they close over it, so storing them
         # anywhere longer-lived would pin evicted layouts (and their device
         # arrays) forever; with the cache pin gone, layout + fns + compiled
-        # programs all drop together
-        fns = layout.__dict__.setdefault("_zone_fns", {})
-        key = ("full", id(self.ev), capacity)
+        # programs all drop together.  Plan-signature keys let equivalent
+        # evaluators share one compiled program (the endpoint's evaluator
+        # LRU churns instances), and the dict is bounded.
+        fns = _layout_fn_cache(layout)
+        key = ("full", _plan_sig(self.ev), capacity)
         if key in fns:
             return fns[key]
         ev = self.ev
@@ -444,36 +477,27 @@ class ZoneEvaluator:
                     # columns, so all valid rows are live
                     carries.append((counts,))
                     continue
-                if len(da.rpn.nodes) == 1 and da.rpn.nodes[0].kind == "col":
+                bare = len(da.rpn.nodes) == 1 and da.rpn.nodes[0].kind == "col"
+                if bare:
                     ci = da.rpn.nodes[0].index
                     arr2 = dev["cols"][ci].reshape(T, TILE_ROWS)
                     max_abs = max(abs(ranges[ci][0]), abs(ranges[ci][1]))
-                    if da.op in ("sum", "avg"):
-                        ts = _tile_sum(arr2, max_abs)
-                        carries.append((counts, seg(jnp.where(wf, ts, 0))))
-                    else:  # min / max — same-dtype tile reduce, then widen T-wise
-                        red = arr2.min(axis=1) if da.op == "min" else arr2.max(axis=1)
-                        red = red.astype(jnp.int64)
-                        info = np.iinfo(np.int64)
-                        ident = info.max if da.op == "min" else info.min
-                        red = jnp.where(wf, red, ident)
-                        f = jax.ops.segment_min if da.op == "min" else jax.ops.segment_max
-                        carries.append((counts, f(red, tg, num_segments=capacity)))
                 else:
                     if lazy_cols is None:
                         lazy_cols = widen_cols(dev)
                     d, _nl = eval_rpn(da.rpn, lazy_cols, layout.n_rows, xp=jnp)
-                    ts = d.reshape(T, TILE_ROWS).sum(axis=1)  # already int64
-                    if da.op in ("sum", "avg"):
-                        carries.append((counts, seg(jnp.where(wf, ts, 0))))
-                    else:
-                        red2 = d.reshape(T, TILE_ROWS)
-                        red = red2.min(axis=1) if da.op == "min" else red2.max(axis=1)
-                        info = np.iinfo(np.int64)
-                        ident = info.max if da.op == "min" else info.min
-                        red = jnp.where(wf, red, ident)
-                        f = jax.ops.segment_min if da.op == "min" else jax.ops.segment_max
-                        carries.append((counts, f(red, tg, num_segments=capacity)))
+                    arr2 = d.reshape(T, TILE_ROWS)
+                    max_abs = None  # already int64: _tile_sum sums directly
+                if da.op in ("sum", "avg"):
+                    ts = _tile_sum(arr2, max_abs if bare else 0)
+                    carries.append((counts, seg(jnp.where(wf, ts, 0))))
+                else:  # min / max — same-dtype tile reduce, then widen T-wise
+                    red = (arr2.min(axis=1) if da.op == "min" else arr2.max(axis=1)).astype(jnp.int64)
+                    info = np.iinfo(np.int64)
+                    ident = info.max if da.op == "min" else info.min
+                    red = jnp.where(wf, red, ident)
+                    f = jax.ops.segment_min if da.op == "min" else jax.ops.segment_max
+                    carries.append((counts, f(red, tg, num_segments=capacity)))
             if track_first:
                 tmin = dev["ridx"].reshape(T, TILE_ROWS).min(axis=1)
                 tmin = jnp.where(wf, tmin, _RIDX_INF)
@@ -483,15 +507,13 @@ class ZoneEvaluator:
                 first = jnp.full(capacity, _NO_ROW_J, dtype=jnp.int64)
             return first, tuple(carries)
 
-        jfn = jax.jit(fn)
-        fns[key] = jfn
-        return jfn
+        return _fn_cache_put(fns, key, jax.jit(fn))
 
     def _partial_fn(self, layout, capacity, pcap):
         """Gathered partial tiles: full row-level RPN evaluation over a
         (pcap, TILE_ROWS) bucket, padded entries weighted out."""
-        fns = layout.__dict__.setdefault("_zone_fns", {})
-        key = ("partial", id(self.ev), capacity, pcap)
+        fns = _layout_fn_cache(layout)
+        key = ("partial", _plan_sig(self.ev), capacity, pcap)
         if key in fns:
             return fns[key]
         ev = self.ev
@@ -554,9 +576,7 @@ class ZoneEvaluator:
                 first = jnp.full(capacity, _NO_ROW_J, dtype=jnp.int64)
             return first, tuple(carries)
 
-        jfn = jax.jit(fn)
-        fns[key] = jfn
-        return jfn
+        return _fn_cache_put(fns, key, jax.jit(fn))
 
     # -- merge + run -------------------------------------------------------
 
@@ -583,9 +603,7 @@ class ZoneEvaluator:
             if rec is not None and rec[0] not in group_cols and ev.schema[rec[0]][0] != EvalType.REAL:
                 sort_col = rec[0]
                 break
-        layout = build_layout(
-            cache, group_cols, dicts, sort_col, needed, ev.schema, ev.plan.scan.columns_info
-        )
+        layout = build_layout(cache, group_cols, dicts, sort_col, needed, ev.schema)
         full, partial_idx = self._classify_tiles(layout)
         if layout.n_tiles and len(partial_idx) / layout.n_tiles > PARTIAL_FALLBACK:
             self._declined.add(cache)
