@@ -61,8 +61,13 @@ def test_supports_routing():
     assert not supports(
         DagRequest(executors=[TableScan(TABLE_ID, NUMERIC_COLS), TopN([(col(1), False)], 100000)])
     )
-    assert not supports(
+    # bytes PAYLOAD columns now ride as dictionary codes (round 5) — but a
+    # bytes sort KEY still routes to CPU
+    assert supports(
         DagRequest(executors=[TableScan(TABLE_ID, PRODUCT_COLUMNS), TopN([(col(0), False)], 5)])
+    )
+    assert not supports(
+        DagRequest(executors=[TableScan(TABLE_ID, PRODUCT_COLUMNS), TopN([(col(1), False)], 5)])
     )
     # bytes predicate stays on CPU
     assert not supports(
@@ -599,3 +604,177 @@ def test_float_sums_beyond_onehot_window():
     for c, d in zip(crows, drows):
         assert c[-1] == d[-1] and c[1] == d[1]  # key + count exact
         assert c[0] == pytest.approx(d[0], rel=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# Round-5 eligibility widening: first/bit_* aggregates, dict-coded varchar
+# TopN payloads, index-scan leaves (VERDICT r4 item 6)
+# ---------------------------------------------------------------------------
+
+
+def test_first_and_bit_aggs_device():
+    """first/bit_and/bit_or/bit_xor ride the device path and match CPU."""
+    execs = [
+        TableScan(TABLE_ID, NUMERIC_COLS),
+        Selection([call("lt", col(1), const_int(800))]),
+        Aggregation(
+            group_by=[col(2)],
+            agg_funcs=[
+                AggDescriptor("first", col(1)),
+                AggDescriptor("bit_and", col(1)),
+                AggDescriptor("bit_or", col(1)),
+                AggDescriptor("bit_xor", col(1)),
+                AggDescriptor("count", None),
+            ],
+        ),
+    ]
+    assert supports(DagRequest(executors=execs))
+    cpu, dev = run_both(execs, NUMERIC_KVS)
+    assert dev.encode() == cpu.encode()
+
+
+def test_first_bit_aggs_ungrouped_device():
+    execs = [
+        TableScan(TABLE_ID, NUMERIC_COLS),
+        Aggregation(
+            group_by=[],
+            agg_funcs=[
+                AggDescriptor("first", col(1)),
+                AggDescriptor("bit_xor", col(2)),
+                AggDescriptor("bit_and", col(2)),
+            ],
+        ),
+    ]
+    assert supports(DagRequest(executors=execs))
+    cpu, dev = run_both(execs, NUMERIC_KVS)
+    assert dev.encode() == cpu.encode()
+
+
+def test_first_agg_with_nulls_device():
+    """first skips NULLs (CPU semantics); all-NULL groups output NULL."""
+    from tikv_tpu.copr.datatypes import ColumnInfo, FieldType
+    from tikv_tpu.copr.table import encode_row, record_key
+
+    cols = [
+        ColumnInfo(1, FieldType.int64(), is_pk_handle=True),
+        ColumnInfo(2, FieldType.int64()),
+        ColumnInfo(3, FieldType.int64()),
+    ]
+    rng = np.random.default_rng(3)
+    kvs = []
+    for i in range(4000):
+        v = None if rng.random() < 0.3 else int(rng.integers(0, 50))
+        g = int(rng.integers(0, 5))
+        kvs.append((record_key(TABLE_ID, i), encode_row(cols[1:], [v, g])))
+    execs = [
+        TableScan(TABLE_ID, cols),
+        Aggregation(group_by=[col(2)], agg_funcs=[AggDescriptor("first", col(1))]),
+    ]
+    cpu, dev = run_both(execs, kvs)
+    assert dev.encode() == cpu.encode()
+
+
+def test_topn_varchar_payload_device():
+    """Dict-coded varchar payload columns ship as codes through the device
+    top-K merge and decode back byte-identically."""
+    from tikv_tpu.copr.datatypes import ColumnInfo, FieldType
+    from tikv_tpu.copr.table import encode_row, record_key
+
+    cols = [
+        ColumnInfo(1, FieldType.int64(), is_pk_handle=True),
+        ColumnInfo(2, FieldType.int64()),
+        ColumnInfo(3, FieldType.varchar()),   # payload, never a sort key
+        ColumnInfo(4, FieldType.int64()),
+    ]
+    tags = [b"aaaa", b"bbbb", b"cccc", b"dddd", b"eeee"]  # fixed-length rows
+    rng = np.random.default_rng(5)
+    kvs = []
+    for i in range(5000):
+        kvs.append((record_key(TABLE_ID, i), encode_row(cols[1:], [
+            int(rng.integers(0, 10_000)), tags[int(rng.integers(0, 5))],
+            int(rng.integers(-100, 100)),
+        ])))
+    execs = [
+        TableScan(TABLE_ID, cols),
+        Selection([call("lt", col(1), const_int(9000))]),
+        TopN([(col(1), True), (col(3), False)], 40),
+    ]
+    assert supports(DagRequest(executors=execs))
+    cpu, dev = run_both(execs, kvs)
+    assert dev.encode() == cpu.encode()
+
+
+def _index_fixture(n=6000, seed=9):
+    """Two-column index (a, b) with handle; entries sorted in index order."""
+    from tikv_tpu.copr import datum as datum_mod
+    from tikv_tpu.copr.datatypes import ColumnInfo, FieldType
+    from tikv_tpu.copr.table import index_key
+    from tikv_tpu.util import codec
+
+    rng = np.random.default_rng(seed)
+    a = rng.integers(0, 8, n)
+    b = rng.integers(0, 10_000, n)
+    cols = [
+        ColumnInfo(1, FieldType.int64()),
+        ColumnInfo(2, FieldType.int64()),
+        ColumnInfo(3, FieldType.int64(), is_pk_handle=True),
+    ]
+    kvs = []
+    for i in range(n):
+        k = index_key(TABLE_ID, 7, [
+            (datum_mod.INT_FLAG, int(a[i])), (datum_mod.INT_FLAG, int(b[i])),
+        ]) + codec.encode_i64(i)  # unique suffix keeps keys distinct
+        kvs.append((k, codec.encode_u64(i)))
+    kvs.sort(key=lambda kv: kv[0])
+    return cols, kvs
+
+
+def test_index_scan_leaf_device():
+    from tikv_tpu.copr.dag import IndexScan
+
+    cols, kvs = _index_fixture()
+    execs = [
+        IndexScan(TABLE_ID, 7, cols),
+        Selection([call("lt", col(1), const_int(9000))]),
+        Aggregation(
+            group_by=[col(0)],
+            agg_funcs=[AggDescriptor("sum", col(1)), AggDescriptor("count", None)],
+        ),
+    ]
+    assert supports(DagRequest(executors=execs))
+    cpu, dev = run_both(execs, kvs, block_rows=512)
+    assert dev.encode() == cpu.encode()
+
+
+def test_index_scan_streamed_prefix_device():
+    """Stream agg grouped on the index-column prefix: scan order sorts by it,
+    so the device hash output equals the CPU stream executor's."""
+    from tikv_tpu.copr.dag import IndexScan
+
+    cols, kvs = _index_fixture()
+    execs = [
+        IndexScan(TABLE_ID, 7, cols),
+        Aggregation(
+            group_by=[col(0)],
+            agg_funcs=[AggDescriptor("sum", col(1)), AggDescriptor("max", col(1))],
+            streamed=True,
+        ),
+    ]
+    assert supports(DagRequest(executors=execs))
+    cpu, dev = run_both(execs, kvs, block_rows=512)
+    assert dev.encode() == cpu.encode()
+
+
+def test_index_scan_bytes_column_stays_cpu():
+    from tikv_tpu.copr.dag import IndexScan
+    from tikv_tpu.copr.datatypes import ColumnInfo, FieldType
+
+    cols = [
+        ColumnInfo(1, FieldType.varchar()),
+        ColumnInfo(2, FieldType.int64(), is_pk_handle=True),
+    ]
+    dag = DagRequest(executors=[
+        IndexScan(TABLE_ID, 7, cols),
+        Aggregation(group_by=[], agg_funcs=[AggDescriptor("count", None)]),
+    ])
+    assert not supports(dag)

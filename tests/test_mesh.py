@@ -402,3 +402,51 @@ def test_mesh_rejects_non_agg_dag_cheaply():
     key = next(iter(ep._mesh_runners))
     assert ep._mesh_runners[key] is None  # cached negative
     assert ep._mesh_evaluator_for(dag) is None
+
+
+def test_mesh_bit_aggs_and_first_decline():
+    """bit_and/or/xor merge across region shards; 'first' (paired argmin
+    carry) declines mesh construction so the endpoint memoizes the
+    single-device route instead of re-probing."""
+    import numpy as np
+    import pytest
+
+    from tikv_tpu.copr.aggr import AggDescriptor
+    from tikv_tpu.copr.dag import Aggregation, DagRequest, TableScan
+    from tikv_tpu.copr.datatypes import ColumnInfo, FieldType
+    from tikv_tpu.copr.rpn import col
+    from tikv_tpu.parallel.mesh import ShardedDagEvaluator, make_mesh
+
+    cols_info = [
+        ColumnInfo(1, FieldType.int64(), is_pk_handle=True),
+        ColumnInfo(2, FieldType.int64()),
+    ]
+    dag = DagRequest(executors=[
+        TableScan(1, cols_info),
+        Aggregation(group_by=[], agg_funcs=[
+            AggDescriptor("bit_and", col(1)),
+            AggDescriptor("bit_or", col(1)),
+            AggDescriptor("bit_xor", col(1)),
+            AggDescriptor("count", None),
+        ]),
+    ])
+    mesh = make_mesh(jax.devices()[:8], groups=2)
+    ev = ShardedDagEvaluator(dag, mesh, rows_per_shard=64, capacity=4)
+    n = ev.total_rows
+    rng = np.random.default_rng(2)
+    vals = rng.integers(0, 1 << 20, n).astype(np.int64)
+    columns = {i: (vals, np.zeros(n, dtype=bool)) for i in ev.ev.device_cols}
+    gids = rng.integers(0, 4, n).astype(np.int32)
+    state = jax.tree.map(np.asarray, ev.run_arrays(columns, n, gids))
+    for slot in range(4):
+        m = gids == slot
+        assert int(state[1][0][1][slot]) == int(np.bitwise_and.reduce(vals[m])) if m.any() else True
+        assert int(state[1][1][1][slot]) == int(np.bitwise_or.reduce(vals[m], initial=0))
+        assert int(state[1][2][1][slot]) == int(np.bitwise_xor.reduce(vals[m], initial=0))
+
+    first_dag = DagRequest(executors=[
+        TableScan(1, cols_info),
+        Aggregation(group_by=[], agg_funcs=[AggDescriptor("first", col(1))]),
+    ])
+    with pytest.raises(ValueError, match="mesh merge"):
+        ShardedDagEvaluator(first_dag, mesh, rows_per_shard=64, capacity=4)

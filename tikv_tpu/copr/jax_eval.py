@@ -46,6 +46,7 @@ from .aggr import AggDescriptor, AggState
 from .dag import (
     Aggregation,
     DagRequest,
+    IndexScan,
     Limit,
     ResponseEncoder,
     SelectResponse,
@@ -64,7 +65,10 @@ _GROUP_CAPACITY_START = 1024
 _NO_ROW = 1 << 62  # first-active-row sentinel: "no row of this group survived"
 _ZERO_GIDS: dict[int, np.ndarray] = {}
 
-_DEVICE_AGG_OPS = {"count", "sum", "avg", "min", "max", "var_pop"}
+_DEVICE_AGG_OPS = {
+    "count", "sum", "avg", "min", "max", "var_pop",
+    "first", "bit_and", "bit_or", "bit_xor",
+}
 _DEVICE_EVAL_TYPES = {EvalType.INT, EvalType.REAL, EvalType.DECIMAL, EvalType.DATETIME, EvalType.DURATION}
 _TOPN_DEVICE_MAX = 2048  # raw TopN carries K rows of state per column
 
@@ -97,8 +101,8 @@ class _Plan:
 
 def _analyze(dag: DagRequest) -> _Plan:
     execs = list(dag.executors)
-    if not execs or not isinstance(execs[0], TableScan):
-        raise _Unsupported("leaf must be TableScan")
+    if not execs or not isinstance(execs[0], (TableScan, IndexScan)):
+        raise _Unsupported("leaf must be a scan")
     scan = execs[0]
     rest = execs[1:]
     plan = _Plan(scan, None, None, None, None)
@@ -124,6 +128,10 @@ def _analyze(dag: DagRequest) -> _Plan:
             # dictionary-encoded host-side); _check_rpn_device rejects them
             # inside device expressions
             raise _Unsupported(f"column type {et}")
+        if isinstance(scan, IndexScan) and et not in _DEVICE_EVAL_TYPES:
+            # index entries decode through datum lists (object arrays), so
+            # BYTES never arrives dictionary-coded on this leaf
+            raise _Unsupported(f"index column type {et}")
     if plan.selection is not None:
         for cond in plan.selection.conditions:
             rpn = compile_expr(cond, schema)
@@ -137,12 +145,24 @@ def _analyze(dag: DagRequest) -> _Plan:
             # order, wherever it sits in the schema).  Anything else takes
             # the CPU stream executor (stream_aggr_executor.rs semantics).
             cols_info = scan.columns_info
-            ok = len(plan.agg.group_by) <= 1 and all(
-                isinstance(g, ColumnRef)
-                and g.index < len(cols_info)
-                and cols_info[g.index].is_pk_handle
-                for g in plan.agg.group_by
-            )
+            if isinstance(scan, IndexScan):
+                # index scan order sorts by the index column prefix
+                # (index_scan_executor.rs:29 + stream_aggr_executor.rs:23's
+                # common sorted-by-index shape): grouping on a PREFIX of the
+                # index columns keeps stream output == hash output
+                ok = all(
+                    isinstance(g, ColumnRef) and g.index == gi
+                    and g.index < len(cols_info)
+                    and not cols_info[g.index].is_pk_handle
+                    for gi, g in enumerate(plan.agg.group_by)
+                )
+            else:
+                ok = len(plan.agg.group_by) <= 1 and all(
+                    isinstance(g, ColumnRef)
+                    and g.index < len(cols_info)
+                    and cols_info[g.index].is_pk_handle
+                    for g in plan.agg.group_by
+                )
             if not ok:
                 raise _Unsupported("streamed agg not sorted by group key")
         for a in plan.agg.agg_funcs:
@@ -157,11 +177,15 @@ def _analyze(dag: DagRequest) -> _Plan:
             compile_expr(g, schema)
     if plan.topn is not None and plan.agg is None:
         # raw TopN runs a device top-K merge: every schema column ships as
-        # payload, so ALL columns (not just referenced ones) must be numeric
+        # payload — numeric columns as values, BYTES as dictionary codes
+        # (decoded back to bytes host-side at finalize; non-dict layouts
+        # raise at run time and take the CPU fallback)
         if plan.topn.limit > _TOPN_DEVICE_MAX:
             raise _Unsupported(f"TopN limit {plan.topn.limit} too large for device")
         for et, _ in schema:
-            if et not in _DEVICE_EVAL_TYPES:
+            if et not in _DEVICE_EVAL_TYPES and not (
+                et == EvalType.BYTES and isinstance(scan, TableScan)
+            ):
                 raise _Unsupported(f"TopN payload column type {et}")
         for expr, _desc in plan.topn.order_by:
             rpn = compile_expr(expr, schema)
@@ -340,6 +364,37 @@ def _seg_extreme(x, gids, capacity: int, is_min: bool, identity):
     return f(x, gids, num_segments=capacity)
 
 
+_BIT_IDENT = {"bit_and": -1, "bit_or": 0, "bit_xor": 0}
+_BIT_FN = {
+    "bit_and": jax.lax.bitwise_and,
+    "bit_or": jax.lax.bitwise_or,
+    "bit_xor": jax.lax.bitwise_xor,
+}
+
+
+def _seg_bitop(x, gids, capacity: int, op: str):
+    """Per-group bitwise AND/OR/XOR via lax.reduce (XLA has native and/or/
+    xor reduction monoids on every backend — no scatter exists for them).
+    Masked n×C reduction in group-blocks of 64, same shape as _seg_sum's
+    mid path; these aggregates are rare enough that the extra lanes are
+    acceptable on either backend."""
+    ident = jnp.int64(_BIT_IDENT[op])
+    fn = _BIT_FN[op]
+    if capacity == 1:
+        return jax.lax.reduce(x, ident, fn, (0,)).reshape(1)
+    blocks = (capacity + _ONEHOT_CAPACITY_MAX - 1) // _ONEHOT_CAPACITY_MAX
+    starts = jnp.arange(blocks, dtype=gids.dtype) * _ONEHOT_CAPACITY_MAX
+    lane = jnp.arange(_ONEHOT_CAPACITY_MAX, dtype=gids.dtype)
+
+    def one_block(start):
+        onehot = gids[:, None] == (start + lane)[None, :]
+        masked = jnp.where(onehot, x[:, None], ident)
+        return jax.lax.reduce(masked, ident, fn, (0,))
+
+    out = jax.lax.map(one_block, starts)
+    return out.reshape(blocks * _ONEHOT_CAPACITY_MAX)[:capacity]
+
+
 def _build_cols(ship_cols, nullable, col_data, col_nulls, n_rows):
     """Column map for eval_rpn: NOT NULL columns get a folded constant mask."""
     no_nulls = jnp.zeros(n_rows, dtype=bool)
@@ -370,7 +425,7 @@ def _fused_step(sel_rpns, device_aggs, capacity, n_rows, cols, n_valid, gids, of
         d, nl = eval_rpn(rpn, cols, n_rows, xp=jnp)
         active = active & (d != 0) & ~nl
     new_carries = tuple(
-        da.update(c, cols, n_rows, gids, active, capacity)
+        da.update(c, cols, n_rows, gids, active, capacity, offset)
         for da, c in zip(device_aggs, carries)
     )
     if not track_first:
@@ -394,9 +449,14 @@ class _DeviceAgg:
         z_i = jnp.zeros(capacity, dtype=jnp.int64)
         if self.op == "count":
             return (z_i,)
+        if self.op in ("bit_and", "bit_or", "bit_xor"):
+            return (z_i, jnp.full(capacity, _BIT_IDENT[self.op], dtype=jnp.int64))
         z_v = jnp.zeros(capacity, dtype=self.dtype)
         if self.op in ("sum", "avg"):
             return (z_i, z_v)
+        if self.op == "first":
+            # (count, first value, global row index that supplied it)
+            return (z_i, z_v, jnp.full(capacity, _NO_ROW, dtype=jnp.int64))
         if self.op == "var_pop":
             return (z_i, z_v, jnp.zeros(capacity, dtype=jnp.float64))
         if self.op in ("min", "max"):
@@ -414,16 +474,22 @@ class _DeviceAgg:
         zv = np.zeros(0, dtype=self.dtype)
         if self.op == "count":
             return (zi,)
+        if self.op in ("bit_and", "bit_or", "bit_xor"):
+            return (zi, zi)
         if self.op in ("sum", "avg"):
             return (zi, zv)
+        if self.op == "first":
+            return (zi, zv, zi)
         if self.op == "var_pop":
             return (zi, zv, np.zeros(0, dtype=np.float64))
         if self.op in ("min", "max"):
             return (zi, zv)
         raise AssertionError(self.op)
 
-    def update(self, carry, cols, n_rows, gids, active, capacity):
-        """One block update. ``active``: row mask after selection+validity."""
+    def update(self, carry, cols, n_rows, gids, active, capacity, offset=0):
+        """One block update. ``active``: row mask after selection+validity;
+        ``offset``: the block's global first-valid-row index (used only by
+        order-sensitive aggregates like ``first``)."""
         if self.rpn is None:
             data, nulls = None, None
             live = active
@@ -434,9 +500,30 @@ class _DeviceAgg:
         cnt = carry[0] + seg(live.astype(jnp.int64))
         if self.op == "count":
             return (cnt,)
+        if self.op in ("bit_and", "bit_or", "bit_xor"):
+            ident = jnp.int64(_BIT_IDENT[self.op])
+            masked = jnp.where(live, data, ident)
+            blockv = _seg_bitop(masked, gids, capacity, self.op)
+            return (cnt, _BIT_FN[self.op](carry[1], blockv))
         vals = jnp.where(live, data, jnp.zeros_like(data))
         if self.op in ("sum", "avg"):
             return (cnt, carry[1] + seg(vals))
+        if self.op == "first":
+            # first live row's value per group, in stream order: per block a
+            # segment-min of the live local row index picks the candidate, a
+            # capacity-sized gather reads its value, and the carry keeps
+            # whichever global index is smaller
+            lidx = jnp.where(live, jnp.arange(n_rows, dtype=jnp.int64), jnp.int64(n_rows))
+            blk_local = _seg_extreme(lidx, gids, capacity, True, n_rows)
+            safe = jnp.clip(blk_local, 0, n_rows - 1)
+            blk_val = data[safe]
+            blk_global = jnp.where(blk_local < n_rows, offset + blk_local, _NO_ROW)
+            better = blk_global < carry[2]
+            return (
+                cnt,
+                jnp.where(better, blk_val, carry[1]),
+                jnp.where(better, blk_global, carry[2]),
+            )
         if self.op == "var_pop":
             f = jnp.where(live, data.astype(jnp.float64), 0.0)
             return (cnt, carry[1] + seg(vals), carry[2] + seg(f * f))
@@ -464,6 +551,11 @@ class _DeviceAgg:
         elif self.op == "var_pop":
             st.sum = np.asarray(carry[1])[:n_groups]
             st.sum_sq = np.asarray(carry[2])[:n_groups]
+        elif self.op == "first":
+            st.value = np.asarray(carry[1])[:n_groups]
+            st.has_value = np.asarray(carry[2])[:n_groups] != _NO_ROW
+        elif self.op in ("bit_and", "bit_or", "bit_xor"):
+            st.value = np.asarray(carry[1])[:n_groups]
         elif self.op in ("min", "max"):
             st.value = np.asarray(carry[1])[:n_groups]
             st.has_value = count > 0
@@ -585,7 +677,9 @@ class JaxDagEvaluator:
         self.block_rows = block_rows
         scan = self.plan.scan
         self.schema = [(c.ftype.eval_type, c.ftype.decimal) for c in scan.columns_info]
-        self.decoder = RowBatchDecoder(scan.columns_info)
+        self.decoder = (
+            RowBatchDecoder(scan.columns_info) if isinstance(scan, TableScan) else None
+        )
         self.sel_rpns = (
             [compile_expr(c, self.schema) for c in self.plan.selection.conditions]
             if self.plan.selection
@@ -971,6 +1065,9 @@ class JaxDagEvaluator:
 
     def _decode_blocks(self, source: ScanSource):
         """Yield (columns, n_valid) blocks of exactly block_rows rows (padded)."""
+        if isinstance(self.plan.scan, IndexScan):
+            yield from self._decode_blocks_index(source)
+            return
         br = self.block_rows
         pend_handles: list[np.ndarray] = []
         pend_values: list[bytes] = []
@@ -990,6 +1087,48 @@ class JaxDagEvaluator:
                 pend_values = rest_v
                 total = len(rest_h)
                 cols = self.decoder.decode(block_h, block_v)
+                yield cols, take
+
+    def _decode_blocks_index(self, source: ScanSource):
+        """Index-scan leaf (index_scan_executor.rs:29): decode index entries
+        through the same BatchIndexScanExecutor the CPU pipeline uses, then
+        re-block its chunks to exactly block_rows rows so the device step
+        sees the fixed shapes it compiled for."""
+        from .executors import BatchIndexScanExecutor
+        from .table import index_range
+
+        scan = self.plan.scan
+        prefix_len = len(index_range(scan.table_id, scan.index_id)[0])
+        ex = BatchIndexScanExecutor(source, scan.columns_info, prefix_len)
+        br = self.block_rows
+        pend: list = []  # list of column lists
+        total = 0
+        drained = False
+        while not drained:
+            r = ex.next_batch(br)
+            drained = r.is_drained
+            chunk = r.chunk
+            n = len(chunk.columns[0]) if chunk.columns else 0
+            if n:
+                pend.append(chunk.columns)
+                total += n
+            while total >= br or (drained and total > 0):
+                take = min(br, total)
+                cols: list[Column] = []
+                rest: list[Column] = []
+                for ci in range(len(scan.columns_info)):
+                    parts = [p[ci] for p in pend]
+                    data = np.concatenate([np.asarray(c.data) for c in parts])
+                    nulls = np.concatenate([np.asarray(c.nulls) for c in parts])
+                    cols.append(
+                        Column(parts[0].eval_type, data[:take], nulls[:take], parts[0].frac)
+                    )
+                    if total > take:
+                        rest.append(
+                            Column(parts[0].eval_type, data[take:], nulls[take:], parts[0].frac)
+                        )
+                pend = [rest] if total > take else []
+                total -= take
                 yield cols, take
 
     def _pad(self, arr: np.ndarray, fill=0) -> np.ndarray:
@@ -1128,6 +1267,8 @@ class JaxDagEvaluator:
                 out.append((it, frac))
             elif a.op == "var_pop":
                 out.extend([(EvalType.INT, 0), (EvalType.REAL, 0), (EvalType.REAL, 0)])
+            elif a.op in ("bit_and", "bit_or", "bit_xor"):
+                out.append((EvalType.INT, 0))
             else:
                 out.append((it, frac))
         for g in self.group_rpns:
@@ -1190,7 +1331,23 @@ class JaxDagEvaluator:
             (jnp.ones if i == 0 else jnp.zeros)(k, dtype=jdt.get(dt, jnp.int64))
             for i, dt in enumerate(dtypes)
         )
+        bytes_cols = [
+            ci for ci, (et, _f) in enumerate(self.schema) if et == EvalType.BYTES
+        ]
+        payload_dicts: dict[int, np.ndarray] = {}
         for cols, n_valid in self._blocks(source):
+            for ci in bytes_cols:
+                # BYTES payloads ride as dictionary codes; every block must
+                # agree on the dictionary or the codes are meaningless (the
+                # endpoint's CPU fallback catches this raise)
+                d = cols[ci].dictionary
+                if d is None:
+                    raise ValueError(f"TopN BYTES payload column {ci} not dict-coded")
+                seen = payload_dicts.setdefault(ci, d)
+                if seen is not d and (
+                    len(seen) != len(d) or any(a != b for a, b in zip(seen, d))
+                ):
+                    raise ValueError(f"TopN BYTES payload column {ci}: unstable dictionary")
             col_data, col_nulls = self._device_block(cols, n_valid)
             state = step(col_data, col_nulls, n_valid, state)
         pack_key = ("packtopn", k)
@@ -1207,7 +1364,9 @@ class JaxDagEvaluator:
         for ci, (et, frac) in enumerate(self.schema):
             data = leaves[base + 2 * ci][:n_out]
             nulls = leaves[base + 2 * ci + 1][:n_out]
-            out_cols.append(Column(et, data, nulls.astype(bool), frac))
+            out_cols.append(
+                Column(et, data, nulls.astype(bool), frac, payload_dicts.get(ci))
+            )
         enc = ResponseEncoder(self.dag.chunk_rows)
         enc.add_chunk(Chunk.full(out_cols), self.dag.output_offsets)
         return SelectResponse(chunks=enc.finish())

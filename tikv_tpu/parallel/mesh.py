@@ -52,7 +52,11 @@ def make_mesh(devices=None, groups: int = 1) -> Mesh:
     return Mesh(arr, axis_names=("regions", "groups"))
 
 
-# per-leaf merge semantics of each aggregate's carry (leaf 0 is always count)
+# per-leaf merge semantics of each aggregate's carry (leaf 0 is always count).
+# bitwise ops are associative+commutative, so they merge across region shards
+# like min/max; ``first`` is NOT here — its carry is a paired (value, row
+# index) argmin that a leaf-wise merge cannot express, so mesh construction
+# declines it (ValueError) and the endpoint memoizes the single-device route.
 _MERGE = {
     "count": ("sum",),
     "sum": ("sum", "sum"),
@@ -60,7 +64,16 @@ _MERGE = {
     "var_pop": ("sum", "sum", "sum"),
     "min": ("sum", "min"),
     "max": ("sum", "max"),
+    "bit_and": ("sum", "bit_and"),
+    "bit_or": ("sum", "bit_or"),
+    "bit_xor": ("sum", "bit_xor"),
 }
+
+
+def _require_mesh_mergeable(device_aggs) -> None:
+    for da in device_aggs:
+        if da.op not in _MERGE:
+            raise ValueError(f"aggregate {da.op!r} has no mesh merge rule")
 
 
 def _marshal_block(ev: JaxDagEvaluator, columns: dict, n_valid: int, total_rows: int):
@@ -94,7 +107,19 @@ def _collective(kind: str, x, axis: str):
         return jax.lax.psum(x, axis)
     if kind == "min":
         return jax.lax.pmin(x, axis)
-    return jax.lax.pmax(x, axis)
+    if kind == "max":
+        return jax.lax.pmax(x, axis)
+    # bitwise monoids: no dedicated collective exists, so gather the shard
+    # partials and fold them with the XLA and/or/xor reduction.  The fold's
+    # result is identical on every member but shard_map cannot infer that
+    # statically, so a final psum (member 0 contributes, others add zero)
+    # re-establishes provable replication.
+    from ..copr.jax_eval import _BIT_FN, _BIT_IDENT
+
+    g = jax.lax.all_gather(x, axis)
+    folded = jax.lax.reduce(g, jnp.int64(_BIT_IDENT[kind]), _BIT_FN[kind], (0,))
+    mine = jnp.where(jax.lax.axis_index(axis) == 0, folded, jnp.zeros_like(folded))
+    return jax.lax.psum(mine, axis)
 
 
 def _combine(kind: str, a, b):
@@ -102,7 +127,11 @@ def _combine(kind: str, a, b):
         return a + b
     if kind == "min":
         return jnp.minimum(a, b)
-    return jnp.maximum(a, b)
+    if kind == "max":
+        return jnp.maximum(a, b)
+    from ..copr.jax_eval import _BIT_FN
+
+    return _BIT_FN[kind](a, b)
 
 
 class ShardedDagEvaluator:
@@ -118,6 +147,7 @@ class ShardedDagEvaluator:
         self.ev = JaxDagEvaluator(dag, block_rows=rows_per_shard)
         if self.ev.plan.agg is None:
             raise ValueError("sharded evaluation requires an aggregation DAG")
+        _require_mesh_mergeable(self.ev.device_aggs)
         self.mesh = mesh
         self.rows_per_shard = rows_per_shard
         self.n_regions = mesh.shape["regions"]
@@ -253,6 +283,7 @@ class ShardedGroupedEvaluator:
         plan = self.ev.plan
         if plan.agg is None or not plan.agg.group_by:
             raise ValueError("grouped evaluation requires GROUP BY aggregation")
+        _require_mesh_mergeable(self.ev.device_aggs)
         self.group_rpns = self.ev.group_rpns
         # the single-device path group-codes on the HOST, so the evaluator
         # does not ship group-by columns; here the dictionary builds on
