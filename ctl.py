@@ -76,6 +76,9 @@ def main(argv=None) -> int:
     p.add_argument("--addr", help="store RPC address host:port")
     p.add_argument("--status", help="status server address host:port")
     p.add_argument("--db", help="engine dir of a STOPPED store (offline mode)")
+    p.add_argument("--encryption-master-key", default=None,
+                   help="master key file of an encrypted store (reads "
+                        "<db>/keys.dict)")
     p.add_argument("--region", type=int, default=1)
     sub = p.add_subparsers(dest="cmd", required=True)
 
@@ -161,7 +164,16 @@ def main(argv=None) -> int:
         from tikv_tpu.native.engine import NativeEngine
         from tikv_tpu.server.debug import Debugger
 
-        eng = NativeEngine(path=args.db)
+        keys_mgr = None
+        if args.encryption_master_key:
+            from tikv_tpu.storage.encryption import DataKeyManager, MasterKey
+
+            os.makedirs(args.db, exist_ok=True)
+            keys_mgr = DataKeyManager.open(
+                MasterKey.from_file(args.encryption_master_key),
+                os.path.join(args.db, "keys.dict"),
+            )
+        eng = NativeEngine(path=args.db, keys_mgr=keys_mgr)
         rlog = None
         rlog_dir = os.path.join(args.db, "raftlog")
         if os.path.isdir(rlog_dir):
@@ -169,7 +181,7 @@ def main(argv=None) -> int:
             from tikv_tpu.native.raftlog import NativeRaftLog, raftlog_available
 
             if raftlog_available():
-                rlog = NativeRaftLog(rlog_dir)
+                rlog = NativeRaftLog(rlog_dir, keys_mgr=keys_mgr)
         try:
             dbg = Debugger(eng, raft_log=rlog)
             if args.cmd == "unsafe-recover":
@@ -199,7 +211,7 @@ def main(argv=None) -> int:
                     # whole-range region meta the next recover() finds —
                     # recreate-region semantics with the data already in
                     out = ep.restore(_DataKeyEngine(eng), args.name,
-                                     args.restore_ts)
+                                     args.restore_ts, keys_mgr=keys_mgr)
                     dbg.recreate_region(args.region_id, b"", b"",
                                         args.store, args.peer)
                     out["region"] = args.region_id

@@ -1,0 +1,222 @@
+// File-at-rest encryption primitives shared by the LSM engine and the raft
+// log engine — the native half of the reference's encryption env
+// (components/encryption/src/manager/mod.rs:398 DataKeyManager +
+// engine_rocks/src/encryption.rs:30 env wrapper), re-expressed for this
+// framework's file formats:
+//
+//   * cipher: ChaCha20 (RFC 7539 block function) used as an offset-
+//     addressable keystream — functionally the reference's AES-CTR choice
+//     (crypter.rs) with a primitive this toolchain can carry dependency-free.
+//     The keystream is seekable by 64-byte block, so whole files XOR in place
+//     and pread-at-offset reads decrypt exactly the bytes they fetched;
+//     formats and offsets stay byte-identical to the plaintext layout.
+//   * per-file metadata: a `<file>.enc` sidecar holding (key id, nonce) —
+//     the per-file form of the reference's file dictionary
+//     (file_dict_file.rs).  Sidecars carry NO key material; raw data keys
+//     arrive over the FFI from the Python DataKeyManager, whose persisted
+//     dictionary is sealed under the master key.
+//   * migration: a data file without a sidecar is plaintext and stays
+//     readable; encryption applies to files written after it is enabled.
+//
+// Crash ordering contract: the sidecar is written and fsynced BEFORE its
+// data file becomes visible (rename / first append), so an encrypted file
+// can never exist without the metadata needed to read it.
+#pragma once
+
+#include <fcntl.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+#include <unistd.h>
+
+#include <map>
+#include <array>
+#include <string>
+#include <vector>
+
+namespace enc {
+
+inline uint32_t rotl32(uint32_t x, int n) { return (x << n) | (x >> (32 - n)); }
+
+inline void chacha_block(const uint8_t key[32], const uint8_t nonce[12],
+                         uint32_t counter, uint8_t out[64]) {
+  static const uint32_t c[4] = {0x61707865, 0x3320646e, 0x79622d32, 0x6b206574};
+  uint32_t st[16];
+  st[0] = c[0]; st[1] = c[1]; st[2] = c[2]; st[3] = c[3];
+  for (int i = 0; i < 8; i++) memcpy(&st[4 + i], key + 4 * i, 4);
+  st[12] = counter;
+  memcpy(&st[13], nonce, 4);
+  memcpy(&st[14], nonce + 4, 4);
+  memcpy(&st[15], nonce + 8, 4);
+  uint32_t x[16];
+  memcpy(x, st, sizeof(x));
+#define QR(a, b, c, d)                                        \
+  x[a] += x[b]; x[d] ^= x[a]; x[d] = rotl32(x[d], 16);        \
+  x[c] += x[d]; x[b] ^= x[c]; x[b] = rotl32(x[b], 12);        \
+  x[a] += x[b]; x[d] ^= x[a]; x[d] = rotl32(x[d], 8);         \
+  x[c] += x[d]; x[b] ^= x[c]; x[b] = rotl32(x[b], 7)
+  for (int i = 0; i < 10; i++) {
+    QR(0, 4, 8, 12); QR(1, 5, 9, 13); QR(2, 6, 10, 14); QR(3, 7, 11, 15);
+    QR(0, 5, 10, 15); QR(1, 6, 11, 12); QR(2, 7, 8, 13); QR(3, 4, 9, 14);
+  }
+#undef QR
+  for (int i = 0; i < 16; i++) {
+    uint32_t v = x[i] + st[i];
+    memcpy(out + 4 * i, &v, 4);
+  }
+}
+
+// XOR `len` bytes at absolute file offset `off` with the (key, nonce)
+// keystream.  Counter 0 corresponds to file offset 0; any suffix/slice of a
+// file decrypts independently.
+inline void xor_at(const uint8_t key[32], const uint8_t nonce[12],
+                   uint64_t off, uint8_t* buf, size_t len) {
+  uint8_t ks[64];
+  size_t done = 0;
+  while (done < len) {
+    uint64_t block = (off + done) / 64;
+    size_t skip = (off + done) % 64;
+    chacha_block(key, nonce, static_cast<uint32_t>(block), ks);
+    size_t take = 64 - skip;
+    if (take > len - done) take = len - done;
+    for (size_t i = 0; i < take; i++) buf[done + i] ^= ks[skip + i];
+    done += take;
+  }
+}
+
+struct FileKey {
+  bool on = false;
+  uint32_t key_id = 0;
+  std::array<uint8_t, 32> key{};
+  std::array<uint8_t, 12> nonce{};
+};
+
+// engine-wide key registry, fed from the Python DataKeyManager over the FFI
+struct State {
+  bool on = false;
+  uint32_t current = 0;
+  std::map<uint32_t, std::array<uint8_t, 32>> keys;
+};
+
+static const char kSidecarMagic[4] = {'E', 'N', 'C', '1'};
+static const size_t kSidecarEntry = 16;  // key_id u32 + nonce 12
+static const size_t kSidecarMaxEntries = 4;
+
+inline std::string sidecar_path(const std::string& path) { return path + ".enc"; }
+
+// Write + fsync a sidecar holding `entries` (key_id, nonce) pairs, NEWEST
+// first.  A sidecar may describe more than one cipher identity for its data
+// file: when a compaction reuses an input run's final name, the new entry is
+// PREPENDED and the old one kept, so whichever generation of the file a
+// crash leaves behind stays decryptable — the run reader validates each
+// candidate against the file's own magic/CRC and picks the one that fits.
+inline int sidecar_write(const std::string& path,
+                         const FileKey* entries, size_t n) {
+  std::string sp = sidecar_path(path);
+  std::string tmp = sp + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "wb");
+  if (!f) return -1;
+  std::string buf(kSidecarMagic, 4);
+  for (size_t i = 0; i < n && i < kSidecarMaxEntries; i++) {
+    char e[kSidecarEntry];
+    memcpy(e, &entries[i].key_id, 4);
+    memcpy(e + 4, entries[i].nonce.data(), 12);
+    buf.append(e, kSidecarEntry);
+  }
+  bool ok = fwrite(buf.data(), 1, buf.size(), f) == buf.size() &&
+            fflush(f) == 0 && fsync(fileno(f)) == 0;
+  fclose(f);
+  if (!ok || rename(tmp.c_str(), sp.c_str()) != 0) {
+    unlink(tmp.c_str());
+    return -1;
+  }
+  return 0;
+}
+
+// Every entry of the sidecar, newest first.  0 = found (out filled; entries
+// whose key id is unknown are skipped UNLESS that leaves none — then -1),
+// 1 = absent (plaintext file), -1 = damaged/undecryptable.
+inline int sidecar_read_all(const State& st, const std::string& path,
+                            std::vector<FileKey>* out) {
+  out->clear();
+  FILE* f = fopen(sidecar_path(path).c_str(), "rb");
+  if (!f) return 1;
+  char buf[4 + kSidecarMaxEntries * kSidecarEntry];
+  size_t got = fread(buf, 1, sizeof(buf), f);
+  fclose(f);
+  if (got < 4 || memcmp(buf, kSidecarMagic, 4) != 0 ||
+      (got - 4) % kSidecarEntry != 0) {
+    return -1;
+  }
+  size_t n = (got - 4) / kSidecarEntry;
+  bool any_entry = n > 0;
+  for (size_t i = 0; i < n; i++) {
+    const char* e = buf + 4 + i * kSidecarEntry;
+    FileKey fk;
+    memcpy(&fk.key_id, e, 4);
+    memcpy(fk.nonce.data(), e + 4, 12);
+    auto it = st.keys.find(fk.key_id);
+    if (it == st.keys.end()) continue;  // rotated-away key: try the others
+    fk.key = it->second;
+    fk.on = true;
+    out->push_back(fk);
+  }
+  if (any_entry && out->empty()) return -1;  // keys unknown: fail loudly
+  return 0;
+}
+
+// Newest-entry convenience for files whose names are never reused (WAL and
+// raft-log segments): exactly one cipher identity can apply.
+inline int sidecar_read(const State& st, const std::string& path, FileKey* fk) {
+  std::vector<FileKey> all;
+  int r = sidecar_read_all(st, path, &all);
+  if (r != 0) {
+    fk->on = false;
+    return r;
+  }
+  if (all.empty()) {
+    fk->on = false;
+    return 1;
+  }
+  *fk = all.front();
+  return 0;
+}
+
+// Create the FileKey for a file about to be (re)written under the current
+// data key with a fresh random nonce, persisting the sidecar FIRST.  Any
+// existing entries for the path are kept behind the new one (name-reuse
+// safety, see sidecar_write).  Returns 0 on success.
+inline int file_begin(const State& st, const std::string& path, FileKey* fk) {
+  if (!st.on) {
+    fk->on = false;
+    return 0;
+  }
+  int rfd = open("/dev/urandom", O_RDONLY);
+  if (rfd < 0) return -1;
+  bool ok = read(rfd, fk->nonce.data(), 12) == 12;
+  close(rfd);
+  if (!ok) return -1;
+  auto it = st.keys.find(st.current);
+  if (it == st.keys.end()) return -1;
+  fk->key_id = st.current;
+  fk->key = it->second;
+  fk->on = true;
+  std::vector<FileKey> entries;
+  entries.push_back(*fk);
+  std::vector<FileKey> prior;
+  if (sidecar_read_all(st, path, &prior) == 0) {
+    for (const FileKey& p : prior) {
+      if (entries.size() >= kSidecarMaxEntries) break;
+      entries.push_back(p);
+    }
+  }
+  return sidecar_write(path, entries.data(), entries.size());
+}
+
+inline void maybe_xor(const FileKey& fk, uint64_t off, void* buf, size_t len) {
+  if (fk.on && len) {
+    xor_at(fk.key.data(), fk.nonce.data(), off, static_cast<uint8_t*>(buf), len);
+  }
+}
+
+}  // namespace enc

@@ -38,6 +38,8 @@
 #include <string>
 #include <vector>
 
+#include "crypt.h"
+
 namespace {
 
 struct Version {
@@ -114,6 +116,7 @@ struct Run {
   std::vector<uint64_t> bloom;  // bit words; empty = no filter
   uint32_t bloom_k = 0;
   std::vector<RangeTomb> rtombs;  // range deletes flushed with this run
+  enc::FileKey fk;                // per-file encryption (sidecar-derived)
   ~Run() { if (fd >= 0) close(fd); }
 };
 
@@ -154,6 +157,17 @@ struct Engine {
   int wal_fd = -1;
   int sync_mode = 1;      // 0 = buffered, 1 = fdatasync per commit
   uint64_t wal_bytes = 0;         // bytes in the live WAL segment
+  uint64_t wal_off = 0;           // absolute file offset (encryption stream)
+  // data keys (fed by the DataKeyManager FFI).  Guarded by enc_mu: rotation
+  // runs concurrently with background compaction's writer setup
+  enc::State enc;
+  mutable std::mutex enc_mu;
+  enc::FileKey wal_key;           // live WAL segment's file key
+
+  enc::State enc_snapshot() const {
+    std::lock_guard<std::mutex> lk(enc_mu);
+    return enc;
+  }
   uint64_t wal_limit = 64ull << 20;  // auto-checkpoint threshold; 0 = manual
   uint64_t mem_bytes = 0;         // approximate key+value bytes resident
   bool failed = false;  // a WAL append failed mid-record: the log tail is
@@ -458,6 +472,12 @@ void list_segs(const std::string& dir, const char* prefix,
   std::sort(out->begin(), out->end());
 }
 
+// data files and their encryption sidecars leave together
+void unlink_with_sidecar(const std::string& path) {
+  unlink(path.c_str());
+  unlink(enc::sidecar_path(path).c_str());
+}
+
 int fsync_dir(const std::string& dir) {
   int fd = open(dir.c_str(), O_RDONLY | O_DIRECTORY);
   if (fd < 0) return -1;
@@ -468,10 +488,26 @@ int fsync_dir(const std::string& dir) {
 
 int wal_open_segment(Engine* e, uint64_t start_seq) {
   if (e->wal_fd >= 0) close(e->wal_fd);
+  e->wal_fd = -1;  // callers latch `failed` on wal_fd < 0: no stale fd here
   std::string path = e->dir + "/" + seg_name("wal", start_seq);
+  bool existed = access(path.c_str(), F_OK) == 0;
+  enc::State est = e->enc_snapshot();
+  if (existed) {
+    // reopening a recovered segment for append: its cipher identity is
+    // whatever it was written with (plaintext when the sidecar is absent —
+    // encryption then starts at the next rotation)
+    if (enc::sidecar_read(est, path, &e->wal_key) < 0) return -1;
+  } else if (est.on) {
+    // sidecar persists (fsynced) BEFORE the segment becomes visible
+    if (enc::file_begin(est, path, &e->wal_key) != 0) return -1;
+  } else {
+    e->wal_key.on = false;
+  }
   e->wal_fd = open(path.c_str(), O_CREAT | O_WRONLY | O_APPEND, 0644);
   e->wal_bytes = 0;
   if (e->wal_fd < 0) return -1;
+  off_t sz = lseek(e->wal_fd, 0, SEEK_END);
+  e->wal_off = sz < 0 ? 0 : static_cast<uint64_t>(sz);
   fsync_dir(e->dir);  // the new segment name must survive a crash
   return 0;
 }
@@ -489,6 +525,7 @@ int wal_append(Engine* e, uint64_t seq, const uint8_t* payload, uint64_t len) {
   append_u32(rec, crc);
   rec.append(reinterpret_cast<const char*>(seq_le), 8);
   rec.append(reinterpret_cast<const char*>(payload), len);
+  enc::maybe_xor(e->wal_key, e->wal_off, &rec[0], rec.size());
   const char* p = rec.data();
   size_t left = rec.size();
   while (left > 0) {
@@ -498,6 +535,7 @@ int wal_append(Engine* e, uint64_t seq, const uint8_t* payload, uint64_t len) {
     left -= n;
   }
   e->wal_bytes += rec.size();
+  e->wal_off += rec.size();
   if (e->sync_mode == 1 && fdatasync(e->wal_fd) != 0) return -1;
   return 0;
 }
@@ -522,6 +560,9 @@ int wal_replay(Engine* e, const std::string& path) {
     return -1;  // unreadable segment: do not trust the directory for writes
   }
   fclose(f);
+  enc::FileKey fk;
+  if (enc::sidecar_read(e->enc_snapshot(), path, &fk) < 0) return -1;
+  if (sz > 0) enc::maybe_xor(fk, 0, &buf[0], buf.size());
   const uint8_t* base = reinterpret_cast<const uint8_t*>(buf.data());
   const uint8_t* p = base;
   const uint8_t* end = p + buf.size();
@@ -602,6 +643,7 @@ struct RunWriter {
   FILE* f = nullptr;
   std::string tmp, fin;
   uint64_t off = 0;
+  enc::FileKey fk;
   uint64_t n_entries = 0;
   std::string block;
   std::string block_first;
@@ -611,8 +653,22 @@ struct RunWriter {
   std::vector<RangeTomb> rtombs;  // set before finish(); written after bloom
   bool ok = true;
 
-  int open(const std::string& dir, int cf, uint64_t max_seq, int kind) {
+  // encrypt-then-write at the current offset (no-op when encryption is off)
+  bool wr(const void* data, size_t len) {
+    if (!fk.on) return fwrite(data, 1, len, f) == len;
+    std::string tmpbuf(static_cast<const char*>(data), len);
+    enc::maybe_xor(fk, off, &tmpbuf[0], len);
+    return fwrite(tmpbuf.data(), 1, len, f) == len;
+  }
+
+  int open(const std::string& dir, const enc::State& est, int cf,
+           uint64_t max_seq, int kind) {
     fin = dir + "/" + seg_name(run_prefix(cf), max_seq);
+    // the sidecar for the FINAL name is durable before finish() renames the
+    // data file into visibility — an encrypted run can never appear without
+    // its metadata.  (Final names are unique per directory lifetime, see
+    // below, so a sidecar never describes two generations of a file.)
+    if (enc::file_begin(est, fin, &fk) != 0) return -1;
     // a flush (under the engine lock) and a merge (without it) may write
     // concurrently: the temp name must be private to this writer.  Final
     // names never collide — a flush's max_seq is the current seq, a merge
@@ -625,7 +681,8 @@ struct RunWriter {
     hdr.push_back(static_cast<char>(cf));
     hdr.push_back(static_cast<char>(kind));
     hdr.append(reinterpret_cast<const char*>(&max_seq), 8);
-    ok = fwrite(hdr.data(), 1, hdr.size(), f) == hdr.size();
+    off = 0;
+    ok = wr(hdr.data(), hdr.size());
     off = hdr.size();
     return ok ? 0 : -1;
   }
@@ -637,7 +694,7 @@ struct RunWriter {
     b.len = static_cast<uint32_t>(block.size());
     b.crc = crc32c(reinterpret_cast<const uint8_t*>(block.data()), block.size());
     b.first_key = block_first;
-    ok = ok && fwrite(block.data(), 1, block.size(), f) == block.size();
+    ok = ok && wr(block.data(), block.size());
     off += block.size();
     index.push_back(std::move(b));
     block.clear();
@@ -719,9 +776,11 @@ struct RunWriter {
     foot.append(reinterpret_cast<const char*>(&n_entries), 8);
     append_u32(foot, sec_crc);
     foot.append(kRunFoot, 4);
-    ok = ok && fwrite(sec.data(), 1, sec.size(), f) == sec.size() &&
-         fwrite(foot.data(), 1, foot.size(), f) == foot.size() &&
-         fflush(f) == 0 && fsync(fileno(f)) == 0;
+    bool w1 = wr(sec.data(), sec.size());
+    off += sec.size();
+    bool w2 = wr(foot.data(), foot.size());
+    off += foot.size();
+    ok = ok && w1 && w2 && fflush(f) == 0 && fsync(fileno(f)) == 0;
     fclose(f);
     f = nullptr;
     if (!ok || rename(tmp.c_str(), fin.c_str()) != 0) {
@@ -732,6 +791,7 @@ struct RunWriter {
     run->bloom = std::move(bloom);
     run->bloom_k = k;
     run->rtombs = std::move(rtombs);
+    run->fk = fk;
     run->fd = ::open(fin.c_str(), O_RDONLY);
     if (run->fd < 0) return nullptr;
     return run;
@@ -739,13 +799,15 @@ struct RunWriter {
 };
 
 // open + validate an existing run file; nullptr on structural damage
-std::shared_ptr<Run> run_open(const std::string& path) {
+std::shared_ptr<Run> run_open_with(const std::string& path, const enc::FileKey& fk) {
   int fd = ::open(path.c_str(), O_RDONLY);
   if (fd < 0) return nullptr;
   off_t sz = lseek(fd, 0, SEEK_END);
   if (sz < 16 + 32) { close(fd); return nullptr; }
   char foot[32];
-  if (pread(fd, foot, 32, sz - 32) != 32 || memcmp(foot + 28, kRunFoot, 4) != 0) {
+  if (pread(fd, foot, 32, sz - 32) != 32) { close(fd); return nullptr; }
+  enc::maybe_xor(fk, sz - 32, foot, 32);
+  if (memcmp(foot + 28, kRunFoot, 4) != 0) {
     close(fd);
     return nullptr;
   }
@@ -761,20 +823,27 @@ std::shared_ptr<Run> run_open(const std::string& path) {
     return nullptr;
   }
   char hdr[16];
-  if (pread(fd, hdr, 16, 0) != 16 || memcmp(hdr, kRunMagic, 6) != 0) {
+  if (pread(fd, hdr, 16, 0) != 16) { close(fd); return nullptr; }
+  enc::maybe_xor(fk, 0, hdr, 16);
+  if (memcmp(hdr, kRunMagic, 6) != 0) {
     close(fd);
     return nullptr;
   }
   auto run = std::make_shared<Run>();
   run->path = path;
+  run->fk = fk;
   run->cf = static_cast<uint8_t>(hdr[6]);
   run->kind = static_cast<uint8_t>(hdr[7]);
   memcpy(&run->max_seq, hdr + 8, 8);
   run->n_entries = n_entries;
   size_t sec_len = sz - 32 - index_off;
   std::string sec(sec_len, '\0');
-  if (pread(fd, &sec[0], sec_len, index_off) != static_cast<ssize_t>(sec_len) ||
-      crc32c(reinterpret_cast<const uint8_t*>(sec.data()), sec_len) != sec_crc) {
+  if (pread(fd, &sec[0], sec_len, index_off) != static_cast<ssize_t>(sec_len)) {
+    close(fd);
+    return nullptr;
+  }
+  enc::maybe_xor(fk, index_off, &sec[0], sec_len);
+  if (crc32c(reinterpret_cast<const uint8_t*>(sec.data()), sec_len) != sec_crc) {
     close(fd);
     return nullptr;
   }
@@ -832,6 +901,22 @@ std::shared_ptr<Run> run_open(const std::string& path) {
   return run;
 }
 
+// Open + validate a run, trying every cipher identity its sidecar lists
+// (newest first) and finally plaintext: a compaction that crashed between
+// sidecar update and data rename leaves the OLD file behind the NEW entry,
+// and the file's own magic + section CRC identify which candidate fits.
+std::shared_ptr<Run> run_open(const std::string& path, const enc::State& est) {
+  std::vector<enc::FileKey> cands;
+  int r = enc::sidecar_read_all(est, path, &cands);
+  if (r < 0) return nullptr;  // sidecar damaged or its keys unknown
+  cands.push_back(enc::FileKey{});  // plaintext fallback (migration / crash)
+  for (const enc::FileKey& fk : cands) {
+    auto run = run_open_with(path, fk);
+    if (run) return run;
+  }
+  return nullptr;
+}
+
 bool bloom_may_contain(const Run& r, const std::string& key) {
   if (r.bloom.empty()) return true;
   uint64_t n_bits = r.bloom.size() * 64;
@@ -849,6 +934,7 @@ int run_read_block(const Run& r, size_t bi, std::string* out, Perf* perf) {
   out->resize(b.len);
   if (pread(r.fd, &(*out)[0], b.len, b.off) != static_cast<ssize_t>(b.len))
     return -1;
+  if (b.len) enc::maybe_xor(r.fk, b.off, &(*out)[0], b.len);
   if (crc32c(reinterpret_cast<const uint8_t*>(out->data()), b.len) != b.crc)
     return -1;
   if (perf) perf->blocks_read.fetch_add(1, std::memory_order_relaxed);
@@ -1447,7 +1533,7 @@ struct ReverseChunkedMerge {
 // write the whole memtable of one CF (chains + range tombstones) as a run
 std::shared_ptr<Run> run_from_table(Engine* e, int cf, uint64_t max_seq) {
   RunWriter w;
-  if (w.open(e->dir, cf, max_seq, 0) != 0) return nullptr;
+  if (w.open(e->dir, e->enc_snapshot(), cf, max_seq, 0) != 0) return nullptr;
   for (const auto& [key, chain] : e->cfs[cf]) {
     w.maybe_rotate(key);
     for (const auto& v : chain) w.add(key, v.seq, v.tombstone, v.value);
@@ -1468,7 +1554,7 @@ int flush_memtable(Engine* e) {
       if (e->cfs[cf].empty() && e->mem_rtombs[cf].empty()) continue;
       auto run = run_from_table(e, cf, at);
       if (!run) {
-        for (auto& r : created) unlink(r->path.c_str());
+        for (auto& r : created) unlink_with_sidecar(r->path);
         return -1;
       }
       created.push_back(run);
@@ -1483,7 +1569,7 @@ int flush_memtable(Engine* e) {
     std::string mark = e->dir + "/" + seg_name("mark", at);
     int mfd = ::open(mark.c_str(), O_CREAT | O_WRONLY, 0644);
     if (mfd < 0) {
-      for (auto& r : created) unlink(r->path.c_str());
+      for (auto& r : created) unlink_with_sidecar(r->path);
       return -1;
     }
     fsync(mfd);
@@ -1507,7 +1593,7 @@ int flush_memtable(Engine* e) {
   std::vector<uint64_t> old;
   list_segs(e->dir, "wal", &old);
   for (uint64_t s : old)
-    if (s < at) unlink((e->dir + "/" + seg_name("wal", s)).c_str());
+    if (s < at) unlink_with_sidecar(e->dir + "/" + seg_name("wal", s));
   // legacy checkpoints and folded ingests are superseded: the flush captured
   // the whole memtable, which included anything they had loaded
   old.clear();
@@ -1541,7 +1627,7 @@ int merge_runs_cf(Engine* e, int cf) {
   }
   uint64_t max_seq = inputs.front()->max_seq;
   RunWriter w;
-  if (w.open(e->dir, cf, max_seq, 1) != 0) return -1;
+  if (w.open(e->dir, e->enc_snapshot(), cf, max_seq, 1) != 0) return -1;
   // range tombstones: ones no snapshot can see below fold into the output
   // now (applied to the merged versions, then dropped — this is the only
   // level, so nothing older remains for them to mask; memtable versions are
@@ -1606,11 +1692,11 @@ int merge_runs_cf(Engine* e, int cf) {
     // inputs occupy a contiguous tail (flushes only prepend); replace it
     size_t pos = 0;
     while (pos < rs.size() && rs[pos] != inputs.front()) pos++;
-    if (pos == rs.size()) { unlink(out->path.c_str()); return -1; }  // raced
+    if (pos == rs.size()) { unlink_with_sidecar(out->path); return -1; }  // raced
     rs.resize(pos);
     rs.push_back(out);
   }
-  for (size_t i = 1; i < inputs.size(); i++) unlink(inputs[i]->path.c_str());
+  for (size_t i = 1; i < inputs.size(); i++) unlink_with_sidecar(inputs[i]->path);
   e->perf.run_merges.fetch_add(1, std::memory_order_relaxed);
   return 1;
 }
@@ -1667,15 +1753,31 @@ uint64_t ckpt_load(Engine* e) {
 
 extern "C" {
 
+static thread_local const enc::State* g_pending_enc = nullptr;
+
 void* eng_open() { return new Engine(); }
 
 // Open (or create) a durable engine on a directory.  sync_mode: 1 = WAL
 // fdatasync on every commit (crash-durable), 0 = OS-buffered (fast, loses
 // the tail on power loss — still consistent via WAL framing).
+static enc::State make_enc_state(uint32_t current_id, const uint32_t* ids,
+                                 const uint8_t* keys32, int n) {
+  enc::State st;
+  for (int i = 0; i < n; i++) {
+    std::array<uint8_t, 32> k;
+    memcpy(k.data(), keys32 + 32 * i, 32);
+    st.keys[ids[i]] = k;
+  }
+  st.current = current_id;
+  st.on = n > 0;
+  return st;
+}
+
 void* eng_open_at(const char* path, int sync_mode) {
   Engine* e = new Engine();
   e->dir = path;
   e->sync_mode = sync_mode;
+  if (g_pending_enc) e->enc = *g_pending_enc;
   mkdir(path, 0755);
   // drop temp files of crashed flushes/merges (never renamed = never trusted)
   if (DIR* d = opendir(path)) {
@@ -1701,14 +1803,14 @@ void* eng_open_at(const char* path, int sync_mode) {
     for (auto it = seqs.rbegin(); it != seqs.rend(); ++it) {
       std::string rp = e->dir + "/" + seg_name(run_prefix(cf), *it);
       if (*it > mark) {
-        unlink(rp.c_str());  // partial flush: WAL still covers these records
+        unlink_with_sidecar(rp);  // partial flush: WAL still covers these records
         continue;
       }
       if (!e->runs[cf].empty() && e->runs[cf].back()->kind == 1) {
-        unlink(rp.c_str());  // leftover input of a completed full-cf merge
+        unlink_with_sidecar(rp);  // leftover input of a completed full-cf merge
         continue;
       }
-      auto run = run_open(rp);
+      auto run = run_open(rp, e->enc_snapshot());
       if (!run) {
         // a trusted run (at/below the marker) is damaged and the WAL that
         // covered it is gone: opening would silently lose acked writes —
@@ -1743,6 +1845,36 @@ void* eng_open_at(const char* path, int sync_mode) {
     return nullptr;
   }
   return e;
+}
+
+// Durable open with encryption at rest: (ids, keys32) is the data-key
+// registry from the Python DataKeyManager (manager/mod.rs:398 role); files
+// written from here on encrypt under `current_id`, existing files decrypt
+// under whichever key their sidecar names, and sidecar-less files read as
+// plaintext (migration).  An unknown key id in any sidecar fails the open.
+void* eng_open_at_enc(const char* path, int sync_mode, uint32_t current_id,
+                      const uint32_t* ids, const uint8_t* keys32, int n) {
+  // recovery must decrypt, so the key registry has to exist before the
+  // directory scan — stage it on a throwaway engine, then hand it to the
+  // real open through a thread-local (the open path stays ONE function)
+  enc::State st = make_enc_state(current_id, ids, keys32, n);
+  g_pending_enc = &st;
+  void* e = eng_open_at(path, sync_mode);
+  g_pending_enc = nullptr;
+  return e;
+}
+
+// Rotate the data-key registry on a RUNNING engine: new runs/WAL segments
+// use `current_id`; files already on disk keep their sidecar key.
+int eng_set_encryption(void* h, uint32_t current_id, const uint32_t* ids,
+                       const uint8_t* keys32, int n) {
+  Engine* e = static_cast<Engine*>(h);
+  // write_mu keeps the live WAL segment's identity stable; enc_mu covers
+  // concurrent readers of the registry (background compaction writers)
+  std::lock_guard<std::mutex> wl(e->write_mu);
+  std::lock_guard<std::mutex> el(e->enc_mu);
+  e->enc = make_enc_state(current_id, ids, keys32, n);
+  return 0;
 }
 
 void eng_close(void* h) {

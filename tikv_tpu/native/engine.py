@@ -112,6 +112,16 @@ def _load():
         lib.eng_stats_keys.restype = ctypes.c_uint64
         lib.eng_open_at.argtypes = [ctypes.c_char_p, ctypes.c_int]
         lib.eng_open_at.restype = ctypes.c_void_p
+        lib.eng_open_at_enc.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.eng_open_at_enc.restype = ctypes.c_void_p
+        lib.eng_set_encryption.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.eng_set_encryption.restype = ctypes.c_int
         lib.eng_checkpoint.argtypes = [ctypes.c_void_p]
         lib.eng_checkpoint.restype = ctypes.c_int
         lib.eng_set_wal_limit.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
@@ -344,6 +354,15 @@ class NativeSnapshot(Snapshot):
         yield from parse_frames(buf, n)
 
 
+def _key_registry(keys_mgr):
+    """(ids_array, keys_blob, current_id) for the FFI from a DataKeyManager."""
+    items = sorted(keys_mgr.all_keys().items())
+    ids = (ctypes.c_uint32 * len(items))(*[i for i, _k in items])
+    keys = b"".join(k for _i, k in items)
+    current, _ = keys_mgr.current()
+    return ids, keys, current
+
+
 class NativeEngine(KvEngine):
     """In-memory by default; pass ``path`` for a durable LSM engine: every
     committed WriteBatch is WAL-appended + fdatasync'd before the write
@@ -355,7 +374,7 @@ class NativeEngine(KvEngine):
 
     def __init__(self, path: str | None = None, sync: bool = True,
                  wal_limit: int | None = None, mem_limit: int | None = None,
-                 io_limiter=None):
+                 io_limiter=None, keys_mgr=None):
         lib = _load()
         if lib is None:
             raise RuntimeError(f"native engine unavailable: {_lib_err}")
@@ -368,18 +387,49 @@ class NativeEngine(KvEngine):
         self._io_limiter = io_limiter
         self._io_bytes = {t: 0 for t in IoType}
         self._io_mu = threading.Lock()
+        # encryption at rest (manager/mod.rs:398 + engine_rocks/src/
+        # encryption.rs:30 role): the DataKeyManager's raw keys cross the FFI
+        # once; every file written from here on is ChaCha20-encrypted with a
+        # per-file sidecar naming its key id, so master/data-key rotation
+        # never rewrites data files
+        self._keys_mgr = keys_mgr
         if path is None:
             self._handle = lib.eng_open()
         else:
-            self._handle = lib.eng_open_at(
-                os.fsencode(path), 1 if sync else 0
-            )
+            if keys_mgr is not None:
+                ids, keys, current = _key_registry(keys_mgr)
+                self._handle = lib.eng_open_at_enc(
+                    os.fsencode(path), 1 if sync else 0, current, ids, keys,
+                    len(ids),
+                )
+            else:
+                self._handle = lib.eng_open_at(
+                    os.fsencode(path), 1 if sync else 0
+                )
             if not self._handle:
                 raise RuntimeError(f"cannot open engine dir {path!r}")
         if wal_limit is not None:
             lib.eng_set_wal_limit(self._handle, wal_limit)
         if mem_limit is not None:
             lib.eng_set_mem_limit(self._handle, mem_limit)
+
+    def refresh_encryption(self) -> None:
+        """Re-read the key registry from the DataKeyManager (after an
+        external rotate): files written from now on use the new current key
+        while existing files keep their sidecar key."""
+        if self._keys_mgr is None:
+            raise RuntimeError("engine opened without encryption")
+        ids, keys, current = _key_registry(self._keys_mgr)
+        if self._lib.eng_set_encryption(self._handle, current, ids, keys, len(ids)) != 0:
+            raise RuntimeError("eng_set_encryption failed")
+
+    def rotate_data_key(self) -> int:
+        """Mint a new data key and refresh the engine registry."""
+        if self._keys_mgr is None:
+            raise RuntimeError("engine opened without encryption")
+        new_id = self._keys_mgr.rotate()
+        self.refresh_encryption()
+        return new_id
 
     def _io(self, io_type, nbytes: int) -> None:
         if nbytes <= 0 or self.path is None:

@@ -62,6 +62,8 @@
 #include <sys/types.h>
 #include <unistd.h>
 
+#include "crypt.h"
+
 namespace {
 
 // ---------------------------------------------------------------------------
@@ -105,6 +107,7 @@ constexpr size_t REC_HDR = 9;  // crc(4) + len(4) + type(1)
 struct Seg {
   uint32_t id;
   int fd;
+  enc::FileKey fk;  // per-segment encryption (sidecar-derived)
   explicit Seg(uint32_t i, int f) : id(i), fd(f) {}
   ~Seg() {
     if (fd >= 0) close(fd);
@@ -137,6 +140,7 @@ int fsync_dir(const std::string& dir) {
 }
 
 struct RaftLogEng {
+  enc::State enc;  // data-key registry (DataKeyManager over the FFI)
   std::string dir;
   uint64_t seg_bytes;
   int sync_default;          // 1 = grouped fdatasync per append, 0 = buffered
@@ -181,6 +185,11 @@ struct RaftLogEng {
       sync_done = append_seq;
     }
     uint32_t id = active + 1;
+    enc::FileKey fk;
+    if (enc::file_begin(enc, seg_path(id), &fk) != 0) {
+      err = "encryption sidecar write failed: " + seg_path(id);
+      return false;
+    }
     int fd = open(seg_path(id).c_str(), O_CREAT | O_RDWR | O_APPEND, 0644);
     if (fd < 0) {
       err = "open segment failed: " + seg_path(id);
@@ -188,7 +197,9 @@ struct RaftLogEng {
     }
     fsync_dir(dir);
     std::unique_lock<std::shared_mutex> lk(mu);
-    segs.emplace(id, std::make_shared<Seg>(id, fd));
+    auto seg = std::make_shared<Seg>(id, fd);
+    seg->fk = fk;
+    segs.emplace(id, seg);
     active = id;
     active_size = 0;
     return true;
@@ -209,12 +220,15 @@ struct RaftLogEng {
     frame.push_back(static_cast<char>(type));
     frame += payload;
     int fd;
+    enc::FileKey fk;
     {
       // gc can erase other map nodes under mu concurrently; the active
       // segment itself is never a gc victim, but the map needs the lock
       std::shared_lock<std::shared_mutex> lk(mu);
       fd = segs[active]->fd;
+      fk = segs[active]->fk;
     }
+    enc::maybe_xor(fk, active_size, &frame[0], frame.size());
     const char* p = frame.data();
     size_t left = frame.size();
     while (left > 0) {
@@ -411,6 +425,7 @@ struct RaftLogEng {
       live.erase(victim);
       lk.unlock();
       unlink(path.c_str());
+      unlink(enc::sidecar_path(path).c_str());
       fsync_dir(dir);
       return 1;
     }
@@ -440,7 +455,9 @@ struct RaftLogEng {
 
   bool pread_exact(const std::shared_ptr<Seg>& s, uint64_t off, uint32_t len, uint8_t* out) {
     ssize_t r = pread(s->fd, out, len, static_cast<off_t>(off));
-    return r == static_cast<ssize_t>(len);
+    if (r != static_cast<ssize_t>(len)) return false;
+    enc::maybe_xor(s->fk, off, out, len);
+    return true;
   }
 
   // run the GC loop after a purge/clean.  Never holds mu across file IO.
@@ -545,7 +562,7 @@ struct RaftLogEng {
 
   // ---- replay ----
 
-  bool replay_segment(uint32_t id, int fd, bool is_last) {
+  bool replay_segment(uint32_t id, int fd, const enc::FileKey& fk, bool is_last) {
     struct stat st;
     if (fstat(fd, &st) != 0) {
       err = "fstat failed";
@@ -559,6 +576,7 @@ struct RaftLogEng {
         err = "segment read failed";
         return false;
       }
+      enc::maybe_xor(fk, 0, buf.data(), size);
     }
     uint64_t pos = 0;
     while (pos + REC_HDR <= size) {
@@ -644,13 +662,20 @@ struct RaftLogEng {
     closedir(d);
     std::sort(ids.begin(), ids.end());
     for (size_t i = 0; i < ids.size(); i++) {
+      enc::FileKey fk;
+      if (enc::sidecar_read(enc, seg_path(ids[i]), &fk) < 0) {
+        err = "unreadable encryption sidecar: " + seg_path(ids[i]);
+        return false;
+      }
       int fd = open(seg_path(ids[i]).c_str(), O_RDWR | O_APPEND);
       if (fd < 0) {
         err = "open segment failed: " + seg_path(ids[i]);
         return false;
       }
-      segs.emplace(ids[i], std::make_shared<Seg>(ids[i], fd));
-      if (!replay_segment(ids[i], fd, i + 1 == ids.size())) return false;
+      auto seg = std::make_shared<Seg>(ids[i], fd);
+      seg->fk = fk;
+      segs.emplace(ids[i], seg);
+      if (!replay_segment(ids[i], fd, fk, i + 1 == ids.size())) return false;
     }
     if (!ids.empty()) active = ids.back();
     return true;
@@ -665,13 +690,44 @@ struct RaftLogEng {
 
 extern "C" {
 
+static enc::State rl_make_enc(uint32_t current_id, const uint32_t* ids,
+                              const uint8_t* keys32, int n) {
+  enc::State st;
+  for (int i = 0; i < n; i++) {
+    std::array<uint8_t, 32> k;
+    memcpy(k.data(), keys32 + 32 * i, 32);
+    st.keys[ids[i]] = k;
+  }
+  st.current = current_id;
+  st.on = n > 0;
+  return st;
+}
+
+void* rl_open_enc(const char* dir, uint64_t seg_bytes, int sync_default,
+                  uint32_t rewrite_max, uint32_t current_id,
+                  const uint32_t* ids, const uint8_t* keys32, int n,
+                  char* errbuf, int errcap);
+
 void* rl_open(const char* dir, uint64_t seg_bytes, int sync_default,
               uint32_t rewrite_max, char* errbuf, int errcap) {
+  return rl_open_enc(dir, seg_bytes, sync_default, rewrite_max, 0, nullptr,
+                     nullptr, 0, errbuf, errcap);
+}
+
+// Encrypted open (and the ONE open path — rl_open delegates with an empty
+// registry): segments written from here on encrypt under current_id;
+// existing segments decrypt per their sidecar; sidecar-less files read as
+// plaintext (CF_RAFT-era migration continues to work).
+void* rl_open_enc(const char* dir, uint64_t seg_bytes, int sync_default,
+                  uint32_t rewrite_max, uint32_t current_id,
+                  const uint32_t* ids, const uint8_t* keys32, int n,
+                  char* errbuf, int errcap) {
   auto* e = new RaftLogEng();
   e->dir = dir;
   e->seg_bytes = seg_bytes ? seg_bytes : (64ull << 20);
   e->sync_default = sync_default;
   e->rewrite_max = rewrite_max ? rewrite_max : 4096;
+  e->enc = rl_make_enc(current_id, ids, keys32, n);
   if (!e->open_dir()) {
     if (errbuf != nullptr && errcap > 0) {
       snprintf(errbuf, static_cast<size_t>(errcap), "%s", e->err.c_str());
@@ -680,6 +736,16 @@ void* rl_open(const char* dir, uint64_t seg_bytes, int sync_default,
     return nullptr;
   }
   return e;
+}
+
+// Data-key rotation on a running log: new segments use current_id.
+int rl_set_encryption(void* h, uint32_t current_id, const uint32_t* ids,
+                      const uint8_t* keys32, int n) {
+  auto* e = static_cast<RaftLogEng*>(h);
+  std::lock_guard<std::mutex> wlk(e->wmu);
+  std::unique_lock<std::shared_mutex> lk(e->mu);
+  e->enc = rl_make_enc(current_id, ids, keys32, n);
+  return 0;
 }
 
 void rl_close(void* h) { delete static_cast<RaftLogEng*>(h); }
@@ -799,10 +865,12 @@ int64_t rl_fetch(void* h, uint64_t region, uint64_t lo, uint64_t hi, uint8_t* ou
   for (const Piece& pc : pieces) {
     memcpy(p, &pc.idx, 8);
     memcpy(p + 8, &pc.len, 4);
-    if (pc.len > 0 &&
-        pread(pc.seg->fd, p + 12, pc.len, static_cast<off_t>(pc.off)) !=
-            static_cast<ssize_t>(pc.len)) {
-      return -2;
+    if (pc.len > 0) {
+      if (pread(pc.seg->fd, p + 12, pc.len, static_cast<off_t>(pc.off)) !=
+          static_cast<ssize_t>(pc.len)) {
+        return -2;
+      }
+      enc::maybe_xor(pc.seg->fk, pc.off, p + 12, pc.len);
     }
     p += 12 + pc.len;
   }

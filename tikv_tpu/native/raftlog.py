@@ -51,6 +51,15 @@ def _load():
         c = ctypes
         lib.rl_open.argtypes = [c.c_char_p, c.c_uint64, c.c_int, c.c_uint32, c.c_char_p, c.c_int]
         lib.rl_open.restype = c.c_void_p
+        lib.rl_open_enc.argtypes = [
+            c.c_char_p, c.c_uint64, c.c_int, c.c_uint32, c.c_uint32,
+            c.POINTER(c.c_uint32), c.c_char_p, c.c_int, c.c_char_p, c.c_int,
+        ]
+        lib.rl_open_enc.restype = c.c_void_p
+        lib.rl_set_encryption.argtypes = [
+            c.c_void_p, c.c_uint32, c.POINTER(c.c_uint32), c.c_char_p, c.c_int,
+        ]
+        lib.rl_set_encryption.restype = c.c_int
         lib.rl_close.argtypes = [c.c_void_p]
         lib.rl_append.argtypes = [
             c.c_void_p, c.c_uint64, c.c_uint64, c.c_uint32,
@@ -87,6 +96,15 @@ def raftlog_available() -> bool:
     return _load() is not None
 
 
+def _key_registry(keys_mgr):
+    """(ids_array, keys_blob, current_id) for the FFI (engine.py twin)."""
+    items = sorted(keys_mgr.all_keys().items())
+    ids = (ctypes.c_uint32 * len(items))(*[i for i, _k in items])
+    keys = b"".join(k for _i, k in items)
+    current, _ = keys_mgr.current()
+    return ids, keys, current
+
+
 class NativeRaftLog:
     """One store's raft log: entries + hard-state blobs keyed by region id.
 
@@ -95,19 +113,44 @@ class NativeRaftLog:
     """
 
     def __init__(self, path: str, segment_bytes: int = 64 << 20,
-                 sync: bool = True, rewrite_max: int = 4096):
+                 sync: bool = True, rewrite_max: int = 4096, keys_mgr=None):
         lib = _load()
         if lib is None:
             raise ImportError(f"native raftlog unavailable: {_lib_err}")
         self._lib = lib
+        self._keys_mgr = keys_mgr
         err = ctypes.create_string_buffer(256)
-        self._h = lib.rl_open(
-            os.fsencode(path), segment_bytes, 1 if sync else 0, rewrite_max, err, 256
-        )
+        if keys_mgr is not None:
+            ids, keys, current = _key_registry(keys_mgr)
+            self._h = lib.rl_open_enc(
+                os.fsencode(path), segment_bytes, 1 if sync else 0,
+                rewrite_max, current, ids, keys, len(ids), err, 256,
+            )
+        else:
+            self._h = lib.rl_open(
+                os.fsencode(path), segment_bytes, 1 if sync else 0, rewrite_max, err, 256
+            )
         if not self._h:
             raise RuntimeError(f"raftlog open failed: {err.value.decode()}")
         self.path = path
         self._closed = False
+
+    def refresh_encryption(self) -> None:
+        """Re-read the key registry after an external rotate."""
+        if self._keys_mgr is None:
+            raise RuntimeError("raftlog opened without encryption")
+        ids, keys, current = _key_registry(self._keys_mgr)
+        if self._lib.rl_set_encryption(self._h, current, ids, keys, len(ids)) != 0:
+            raise RuntimeError("rl_set_encryption failed")
+
+    def rotate_data_key(self) -> int:
+        """Mint a new data key and refresh the registry; new segments
+        encrypt under it."""
+        if self._keys_mgr is None:
+            raise RuntimeError("raftlog opened without encryption")
+        new_id = self._keys_mgr.rotate()
+        self.refresh_encryption()
+        return new_id
 
     # -- write path ---------------------------------------------------------
 

@@ -69,7 +69,7 @@ class KvService:
         self, storage: Storage, copr: Endpoint | None = None, copr_v2=None,
         resource_tags=None, debugger=None, cdc=None, pd=None, importer=None,
         raft_router=None, gc_worker=None, lock_manager=None, resolved_ts=None,
-        diagnostics=None,
+        diagnostics=None, keys_rotator=None,
     ):
         self.storage = storage
         self.copr = copr
@@ -83,6 +83,7 @@ class KvService:
         self.lock_manager = lock_manager
         self.resolved_ts = resolved_ts
         self.diagnostics = diagnostics
+        self.keys_rotator = keys_rotator
         # peer raft ingress: the local Store messages are routed into
         # (service/kv.rs raft:612 / batch_raft:649 / snapshot:692).
         # The assembler is built eagerly: lazy init would race between
@@ -144,6 +145,17 @@ class KvService:
         if rmsg is not None:
             router.enqueue_message(rmsg)
         return {}
+
+    def debug_rotate_data_key(self, req: dict) -> dict:
+        """Encryption-at-rest data-key rotation on a RUNNING store
+        (manager/mod.rs rotation surface): new engine/raft-log files encrypt
+        under the fresh key; nothing on disk is rewritten."""
+        if self.keys_rotator is None:
+            return {"error": {"other": "encryption at rest not enabled"}}
+        try:
+            return self.keys_rotator()
+        except Exception as e:  # noqa: BLE001
+            return {"error": _err(e)}
 
     def debug_consistency(self, req: dict) -> dict:
         """Consistency-check results (tikv-ctl consistency-check view):

@@ -51,7 +51,7 @@ def _default_mesh():
         return None
 
 
-def open_engine(path: str | None):
+def open_engine(path: str | None, keys_mgr=None):
     if path is None:
         from ..storage.btree_engine import BTreeEngine
 
@@ -60,10 +60,10 @@ def open_engine(path: str | None):
 
     if not native_available():
         raise RuntimeError("native engine unavailable; cannot open a durable store")
-    return NativeEngine(path=path)
+    return NativeEngine(path=path, keys_mgr=keys_mgr)
 
 
-def open_raft_log(data_dir: str | None, enable: bool = True):
+def open_raft_log(data_dir: str | None, enable: bool = True, keys_mgr=None):
     """The raft_log_engine selection (components/server/src/server.rs:153-157):
     durable stores get the purpose-built segmented log by default; in-memory
     test stores keep the log in CF_RAFT."""
@@ -75,7 +75,7 @@ def open_raft_log(data_dir: str | None, enable: bool = True):
 
     if not raftlog_available():
         return None
-    return NativeRaftLog(os.path.join(data_dir, "raftlog"))
+    return NativeRaftLog(os.path.join(data_dir, "raftlog"), keys_mgr=keys_mgr)
 
 
 class StoreServer:
@@ -91,14 +91,31 @@ class StoreServer:
         enable_device: bool = False,
         security=None,
         raft_engine: bool = True,
+        encryption_master_key: str | None = None,
     ):
         self.pd = pd
         self.security = security
-        self.engine = open_engine(data_dir)
+        # encryption at rest (manager/mod.rs:398): ONE DataKeyManager per
+        # store seals the key dictionary under the master key; the raw data
+        # keys feed both native engines' file IO and the importer's staged
+        # files.  Every persistent byte the store writes is then encrypted.
+        self.keys_mgr = None
+        if encryption_master_key is not None:
+            if data_dir is None:
+                raise ValueError("encryption at rest requires a durable --dir")
+            from ..storage.encryption import DataKeyManager, MasterKey
+
+            os.makedirs(data_dir, exist_ok=True)
+            self.keys_mgr = DataKeyManager.open(
+                MasterKey.from_file(encryption_master_key),
+                os.path.join(data_dir, "keys.dict"),
+            )
+        self.engine = open_engine(data_dir, keys_mgr=self.keys_mgr)
         if hasattr(self.engine, "start_auto_compaction"):
             # background version GC (rocksdb's compaction threads)
             self.engine.start_auto_compaction(interval_s=30.0)
-        self.raft_log = open_raft_log(data_dir, enable=raft_engine)
+        self.raft_log = open_raft_log(data_dir, enable=raft_engine,
+                                      keys_mgr=self.keys_mgr)
         self.transport = RemoteTransport(self._resolve, security=security)
         self.node = Node(pd, self.transport, store_id=store_id, engine=self.engine,
                          raft_log=self.raft_log)
@@ -201,9 +218,20 @@ class StoreServer:
             resolved_ts=self.resolved_ts,
             diagnostics=Diagnostics(),
             cdc=self.cdc,
+            keys_rotator=self.rotate_data_keys if self.keys_mgr is not None else None,
         )
         self.server = Server(self.service, host=host, port=port, security=security)
         self.recovered_peers = recovered
+
+    def rotate_data_keys(self) -> dict:
+        """Mint ONE new data key and refresh every native engine's registry:
+        files written from now on use it, existing files keep their sidecar
+        key (debug_rotate_data_key RPC surface)."""
+        new_id = self.keys_mgr.rotate()
+        self.engine.refresh_encryption()
+        if self.raft_log is not None:
+            self.raft_log.refresh_encryption()
+        return {"key_id": new_id}
 
     def _resolve(self, store_id: int):
         try:
@@ -274,6 +302,10 @@ def main(argv=None) -> int:
     ap.add_argument("--cert-path", default="")
     ap.add_argument("--key-path", default="")
     ap.add_argument("--redact-info-log", default="off", choices=["off", "on", "marker"])
+    ap.add_argument("--encryption-master-key", default=None,
+                    help="path to a 32-byte master key file: encrypt every "
+                         "engine/raft-log file at rest (data keys sealed "
+                         "under it in <dir>/keys.dict)")
     args = ap.parse_args(argv)
 
     from ..util import logger as slog
@@ -293,6 +325,7 @@ def main(argv=None) -> int:
         args.store_id, pd, data_dir=args.dir,
         host=args.host, port=args.port, enable_device=args.enable_device,
         security=security, raft_engine=not args.no_raft_engine,
+        encryption_master_key=args.encryption_master_key,
     )
     srv.start()
     srv.bootstrap_or_join(args.expect_stores)

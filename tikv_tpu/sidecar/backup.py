@@ -295,13 +295,15 @@ class BackupEndpoint:
         return {"files": checked, "total_kvs": meta["total_kvs"],
                 "crc64xor": meta["crc64xor"]}
 
-    def restore(self, engine, name: str, restore_ts: int) -> dict:
+    def restore(self, engine, name: str, restore_ts: int, keys_mgr=None) -> dict:
         """Meta-driven restore of every file (BR restore loop): each file
         re-enters the store as committed writes at restore_ts."""
         import json as _json
 
         meta = _json.loads(self.storage.read(f"{name}.backupmeta"))
-        imp = SstImporter(self.storage)
+        # staged restore files are encryption-at-rest surface: on an
+        # encrypted store they seal under its DataKeyManager
+        imp = SstImporter(self.storage, keys_mgr=keys_mgr)
         restored = 0
         for region in meta["regions"]:
             for f in region["files"]:

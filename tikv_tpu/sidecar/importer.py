@@ -46,7 +46,11 @@ class SstImporter:
     download time, sst_importer.rs:99), stage them on disk, ingest as
     committed writes at a fresh ts."""
 
-    def __init__(self, storage, workdir: str | None = None):
+    def __init__(self, storage, workdir: str | None = None, keys_mgr=None):
+        # staged files are encryption-at-rest surface (import/sst_importer's
+        # temp SSTs): sealed under the store's current data key when a
+        # DataKeyManager is attached, with the key id framed for rotation
+        self.keys_mgr = keys_mgr
         self.storage = storage
         self.workdir = workdir or tempfile.mkdtemp(prefix="tikv-import-")
         os.makedirs(self.workdir, exist_ok=True)
@@ -73,6 +77,26 @@ class SstImporter:
                 raw_key = rewrite[1] + raw_key[len(rewrite[0]):]
             yield raw_key, value
 
+    _STAGED_ENC = b"ENCS"
+
+    def _seal_staged(self, data: bytes) -> bytes:
+        if self.keys_mgr is None:
+            return data
+        from ..storage.encryption import seal
+
+        kid, key = self.keys_mgr.current()
+        return self._STAGED_ENC + codec.encode_var_u64(kid) + seal(key, data)
+
+    def _unseal_staged(self, data: bytes) -> bytes:
+        if not data.startswith(self._STAGED_ENC):
+            return data  # staged before encryption was enabled
+        if self.keys_mgr is None:
+            raise ValueError("encrypted staged file but no key manager")
+        from ..storage.encryption import unseal
+
+        kid, off = codec.decode_var_u64(data, len(self._STAGED_ENC))
+        return unseal(self.keys_mgr.by_id(kid), data[off:])
+
     def _staged_name(self, name: str) -> str:
         # a digest suffix keeps distinct names distinct ("a/b" vs "a_b"
         # must never collide on one staged path)
@@ -97,7 +121,7 @@ class SstImporter:
         path = self._staged_name(name)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
-            f.write(out)
+            f.write(self._seal_staged(bytes(out)))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
@@ -115,7 +139,7 @@ class SstImporter:
             recorded = self._rewrites.get(name)
         if path is not None and os.path.exists(path):
             with open(path, "rb") as f:
-                return f.read(), None
+                return self._unseal_staged(f.read()), None
         if rewrite is None and recorded is not None:
             rewrite = recorded
         return self.storage.read(name), rewrite

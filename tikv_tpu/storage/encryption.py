@@ -105,8 +105,26 @@ class MasterKey:
 
     @classmethod
     def from_file(cls, path: str) -> "MasterKey":
+        """Hex text (master_key/file.rs format) or raw key bytes.  A file
+        that LOOKS like hex but fails to parse is an error, never silently
+        reinterpreted as raw bytes — a typo'd key file must not mint a store
+        under an unintended key."""
         with open(path, "rb") as f:
-            return cls(bytes.fromhex(f.read().strip().decode()))
+            raw = f.read()
+        try:
+            text = raw.decode("ascii")
+        except UnicodeDecodeError:
+            return cls(raw)  # binary key material
+        stripped = text.strip()
+        hexish = sum(c in "0123456789abcdefABCDEF" for c in stripped)
+        if stripped and hexish == len(stripped):
+            if len(stripped) % 2:
+                raise ValueError(f"{path}: odd-length hex master key")
+            return cls(bytes.fromhex(stripped))
+        if len(stripped) >= 32 and hexish >= 0.9 * len(stripped):
+            # almost-hex: a corrupted hex key file, not deliberate raw bytes
+            raise ValueError(f"{path}: looks like hex but fails to parse")
+        return cls(raw)
 
     @classmethod
     def mem(cls, seed: bytes = b"test-master-key-0000") -> "MasterKey":
@@ -188,6 +206,12 @@ class DataKeyManager:
         if k is None:
             raise ValueError(f"unknown data key {key_id}")
         return k
+
+    def all_keys(self) -> dict[int, bytes]:
+        """Snapshot of every data key, for handing the registry to a native
+        engine over the FFI (old keys keep old files readable)."""
+        with self._mu:
+            return dict(self.keys)
 
     def export_dict(self) -> bytes:
         """The encrypted key dictionary (file_dict_file.rs)."""
