@@ -1,0 +1,110 @@
+"""FeatureGate: version-gated feature rollout (feature_gate.rs:14 parity)
+and the online device knob (POST /config coprocessor.enable_device)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tikv_tpu.pd.feature_gate import (
+    BATCH_FUSION,
+    DEVICE_COPROCESSOR,
+    Feature,
+    FeatureGate,
+    MESH_SERVING,
+    parse_version,
+)
+
+
+def test_gate_monotonic_and_thresholds():
+    g = FeatureGate()
+    assert not g.can_enable(DEVICE_COPROCESSOR)
+    assert g.set_version("4.9.9")
+    assert not g.can_enable(DEVICE_COPROCESSOR)
+    assert g.set_version("5.0.0")
+    assert g.can_enable(DEVICE_COPROCESSOR)
+    assert not g.can_enable(MESH_SERVING)  # needs 5.1
+    # stale heartbeat must not regress the gate (CAS-loop semantics)
+    assert not g.set_version("4.0.0")
+    assert g.can_enable(DEVICE_COPROCESSOR)
+    assert g.set_version("5.1.2-beta+build")
+    assert g.can_enable(MESH_SERVING) and g.can_enable(BATCH_FUSION)
+
+
+def test_parse_version_rejects_garbage():
+    for bad in ("5.1", "a.b.c", "5.1.70000", ""):
+        with pytest.raises(ValueError):
+            parse_version(bad)
+    assert parse_version("v5.1.0") == parse_version("5.1.0")
+    assert parse_version("5.1.1") > parse_version("5.1.0")
+    assert Feature(5, 1, 0).ver == parse_version("5.1.0")
+
+
+def _endpoint(gate):
+    from tikv_tpu.copr.endpoint import Endpoint
+    from tikv_tpu.storage.btree_engine import BTreeEngine
+    from tikv_tpu.storage.kv import LocalEngine
+
+    return Endpoint(LocalEngine(BTreeEngine()), enable_device=True,
+                    feature_gate=gate)
+
+
+def test_endpoint_respects_gate_and_online_toggle():
+    g = FeatureGate("4.0.0")
+    ep = _endpoint(g)
+    assert not ep.device_enabled()  # gated off below 5.0
+    g.set_version("5.0.0")
+    assert ep.device_enabled()
+    ep.set_enable_device(False)  # the online knob still wins
+    assert not ep.device_enabled()
+    ep.set_enable_device(True)
+    assert ep.device_enabled()
+
+
+def test_mockpd_cluster_version_monotonic():
+    from tikv_tpu.pd.client import MockPd
+
+    pd = MockPd()
+    assert pd.get_cluster_version() == "5.1.0"
+    pd.set_cluster_version("5.2.0")
+    with pytest.raises(ValueError):
+        pd.set_cluster_version("5.1.0")
+
+
+def test_online_device_knob_over_http(tmp_path):
+    """POST /config toggles device serving on a RUNNING store; the config
+    readback reflects it (online_config surface)."""
+    from tikv_tpu.pd.client import MockPd
+    from tikv_tpu.pd.service import PdService, RemotePd
+    from tikv_tpu.server.server import Server
+    from tikv_tpu.server.standalone import StoreServer
+
+    pd = MockPd()
+    pd_server = Server(PdService(pd))
+    pd_server.start()
+    srv = None
+    try:
+        rpd = RemotePd(*pd_server.addr)
+        srv = StoreServer(1, rpd, data_dir=None, enable_device=True)
+        srv.start()
+        srv.bootstrap_or_join(1)
+        assert srv.copr.enable_device
+        host, port = srv.status_server.addr
+        req = urllib.request.Request(
+            f"http://{host}:{port}/config",
+            data=json.dumps({"coprocessor.enable_device": False}).encode(),
+            method="POST", headers={"Content-Type": "application/json"},
+        )
+        resp = json.loads(urllib.request.urlopen(req, timeout=10).read())
+        assert "coprocessor" in resp, resp
+        assert srv.copr.enable_device is False
+        cfg = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/config", timeout=10).read())
+        assert cfg["coprocessor"]["enable_device"] is False
+        # feature gate synced from PD's cluster version at construction
+        assert srv.feature_gate.can_enable(DEVICE_COPROCESSOR)
+    finally:
+        if srv is not None:
+            srv.stop()
+        pd_server.stop()

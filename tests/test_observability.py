@@ -22,8 +22,19 @@ import tikv_tpu.storage.txn.scheduler  # noqa: F401,E402
 LAZY_SERIES = {
     "tikv_coprocessor_request_total",
     "tikv_coprocessor_request_duration_seconds",
+    "tikv_coprocessor_device_fallback_total",
+    "tikv_coprocessor_cache_hit_total",
+    "tikv_coprocessor_batch_total",
+    "tikv_coprocessor_batch_queries_total",
     "tikv_gcworker_gc_tasks_total",
     "tikv_memory_usage_bytes",
+    "tikv_raftstore_proposal_total",
+    "tikv_raftstore_apply_duration_seconds",
+    "tikv_raftstore_apply_batch_entries",
+    "tikv_engine_wal_bytes",
+    "tikv_engine_memtable_bytes",
+    "tikv_engine_run_count",
+    "tikv_engine_perf_events",
 }
 
 _METRIC_RE = re.compile(r"\btikv_[a-z0-9_]+")
@@ -38,19 +49,24 @@ def _known_series() -> set:
 
 
 def test_dashboard_panels_reference_real_series():
-    path = os.path.join(REPO, "metrics", "grafana", "tikv_tpu_summary.json")
-    dash = json.loads(open(path).read())
+    """EVERY dashboard in metrics/grafana must only reference series the
+    store actually emits (summary + raft + engine + coprocessor)."""
+    gdir = os.path.join(REPO, "metrics", "grafana")
+    dashes = sorted(f for f in os.listdir(gdir) if f.endswith(".json"))
+    assert len(dashes) >= 4, "expected summary + raft + engine + copr dashboards"
     known = _known_series()
-    exprs = [
-        t["expr"]
-        for p in dash["panels"]
-        for t in p.get("targets", [])
-        if "expr" in t
-    ]
-    assert len(exprs) >= 10, "summary dashboard lost its panels"
-    for expr in exprs:
-        for name in _METRIC_RE.findall(expr):
-            assert name in known, f"dashboard references unknown series {name}"
+    for fn in dashes:
+        dash = json.loads(open(os.path.join(gdir, fn)).read())
+        exprs = [
+            t["expr"]
+            for p in dash["panels"]
+            for t in p.get("targets", [])
+            if "expr" in t
+        ]
+        assert len(exprs) >= 6, f"{fn} lost its panels"
+        for expr in exprs:
+            for name in _METRIC_RE.findall(expr):
+                assert name in known, f"{fn} references unknown series {name}"
 
 
 def test_alert_rules_reference_real_series():

@@ -1,0 +1,156 @@
+"""resolved-ts advance over the wire (VERDICT r4 item 5).
+
+Three OS-process stores with LEASES DISABLED (TIKV_TPU_DISABLE_LEASES=1):
+watermark liveness then rests entirely on the check_leader RPC fan-out
+(advance.rs:75,211 role) — the leader store confirms its claim against a
+peer-store quorum and disseminates (resolved_ts, apply_index) pairs, which
+is what lets a FOLLOWER store serve stale reads.
+
+The scenario is the reference's core promise: hold a lock on the leader,
+watch follower stale reads advance to lock_ts-1 (reads below succeed, reads
+above refuse with DataNotReady), then commit and watch the watermark resume
+past it.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIRST_REGION_ID = 1
+
+
+def _spawn(store_id: int, pd_addr, data_dir: str, disable_leases: bool = True):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO
+    if disable_leases:
+        env["TIKV_TPU_DISABLE_LEASES"] = "1"
+    env["TIKV_TPU_RESOLVED_TS_INTERVAL"] = "0.3"
+    return subprocess.Popen(
+        [sys.executable, "-m", "tikv_tpu.server.standalone",
+         "--store-id", str(store_id), "--pd", f"{pd_addr[0]}:{pd_addr[1]}",
+         "--dir", data_dir, "--expect-stores", "3"],
+        env=env, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+
+
+def test_follower_stale_reads_via_check_leader(tmp_path):
+    _run_scenario(tmp_path, disable_leases=True)
+
+
+def test_follower_stale_reads_with_leases_on(tmp_path):
+    """Same scenario in the DEFAULT configuration: leases confirm
+    leadership, but the watermark still reaches follower stores because the
+    check_leader round also runs as the dissemination carrier."""
+    _run_scenario(tmp_path, disable_leases=False)
+
+
+def _run_scenario(tmp_path, disable_leases: bool):
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_multiprocess_cluster import _ClusterClient, _wait_ready
+
+    from tikv_tpu.pd.client import MockPd
+    from tikv_tpu.pd.service import PdService
+    from tikv_tpu.server.server import Client, Server
+
+    pd = MockPd()
+    pd_server = Server(PdService(pd))
+    pd_server.start()
+    procs, client, fol_client = {}, None, None
+    try:
+        for sid in (1, 2, 3):
+            procs[sid] = _spawn(sid, pd_server.addr, str(tmp_path / f"s{sid}"),
+                                disable_leases=disable_leases)
+        for sid in (1, 2, 3):
+            _wait_ready(procs[sid])
+        client = _ClusterClient(pd)
+        client.put(b"row1", b"v1")
+        assert client.get(b"row1") == b"v1"
+
+        leader_sid = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and leader_sid is None:
+            leader_sid = pd.leader_of(FIRST_REGION_ID)
+            time.sleep(0.1)
+        follower_sid = next(s for s in (1, 2, 3) if s != leader_sid)
+        addr = pd.get_store_addr(follower_sid)
+        fol_client = Client(addr[0], addr[1])
+
+        def stale_get(key: bytes, ts: int) -> dict:
+            return fol_client.call("kv_get", {
+                "key": key, "version": ts,
+                "context": {"region_id": FIRST_REGION_ID,
+                            "stale_read": True, "read_ts": ts},
+            }, timeout=10.0)
+
+        # watermark must reach a committed-read ts WITHOUT leases: only the
+        # check_leader quorum + dissemination can get it to the follower
+        ts0 = pd.get_tso()
+        deadline = time.monotonic() + 20
+        r = None
+        while time.monotonic() < deadline:
+            r = stale_get(b"row1", ts0)
+            if not r.get("error"):
+                break
+            time.sleep(0.3)
+        assert r is not None and not r.get("error"), f"stale read never unblocked: {r}"
+        assert r["value"] == b"v1"
+
+        # hold a lock (prewrite without commit) on the leader
+        lock_ts = pd.get_tso()
+        pr = client.call("kv_prewrite", {
+            "mutations": [{"op": "put", "key": b"row2", "value": b"v2"}],
+            "primary_lock": b"row2", "start_version": lock_ts,
+        })
+        assert not pr.get("errors") and not pr.get("error"), pr
+
+        # the watermark advances to lock_ts-1 and PINS: reads below the lock
+        # keep succeeding on the follower, reads above refuse (DataNotReady)
+        below = lock_ts - 1
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            r = stale_get(b"row1", below)
+            if not r.get("error"):
+                break
+            time.sleep(0.3)
+        assert not r.get("error"), f"read below lock_ts never unblocked: {r}"
+        above = pd.get_tso()
+        r = stale_get(b"row1", above)
+        assert r.get("error"), "read above a held lock must refuse (DataNotReady)"
+        # ... and stays refused while the lock is held (the watermark is
+        # pinned by min-lock-ts, not merely lagging)
+        time.sleep(1.5)
+        r = stale_get(b"row1", above)
+        assert r.get("error"), "watermark advanced past a held lock"
+
+        # commit: the watermark resumes past the old `above` ts
+        cm = client.call("kv_commit", {
+            "keys": [b"row2"], "start_version": lock_ts,
+            "commit_version": pd.get_tso(),
+        })
+        assert not cm.get("error") and not cm.get("errors"), cm
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            r = stale_get(b"row1", above)
+            if not r.get("error"):
+                break
+            time.sleep(0.3)
+        assert not r.get("error"), f"stale read never resumed after commit: {r}"
+        assert r["value"] == b"v1"
+    finally:
+        if client is not None:
+            client.close()
+        if fol_client is not None:
+            fol_client.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+                p.wait()
+        pd_server.stop()

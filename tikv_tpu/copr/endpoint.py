@@ -56,11 +56,17 @@ class Endpoint:
         concurrency_manager=None,
         slow_log=None,
         mesh=None,
+        feature_gate=None,
     ):
         from .tracker import SlowLog
 
         self.engine = engine
         self.enable_device = enable_device
+        # version-gated rollout (feature_gate.rs:14): the gate is the hard
+        # floor under the enable_device/mesh/batch-fusion switches — a
+        # mixed-version cluster keeps device serving off until every store
+        # can speak it.  None = ungated (tests, embedded use).
+        self.feature_gate = feature_gate
         self.cop_cache = block_cache or CopCache()
         self.cm = concurrency_manager
         self.slow_log = slow_log or SlowLog()
@@ -91,6 +97,11 @@ class Endpoint:
         REGISTRY.histogram(
             "tikv_coprocessor_request_duration_seconds", "Coprocessor latency"
         ).observe(md.get("total_s", _time.perf_counter() - t0), tp=str(req.tp))
+        if resp.from_cache:
+            REGISTRY.counter(
+                "tikv_coprocessor_cache_hit_total",
+                "Requests answered from the HBM-pinned block cache",
+            ).inc()
         return resp
 
     def _handle_request_inner(self, req: CoprRequest) -> CoprResponse:
@@ -114,7 +125,7 @@ class Endpoint:
         tracker.on_schedule()
         snap = self.engine.snapshot(req.context or None)
         tracker.on_snapshot_finished()
-        use_device = self.enable_device and jax_eval.supports(req.dag)
+        use_device = self.device_enabled() and jax_eval.supports(req.dag)
         if use_device:
             cache = None
             try:
@@ -147,6 +158,12 @@ class Endpoint:
                     cache.blocks.clear()
                 self.device_fallbacks += 1
                 self.last_device_error = repr(exc)
+                from ..util.metrics import REGISTRY
+
+                REGISTRY.counter(
+                    "tikv_coprocessor_device_fallback_total",
+                    "Device-path failures that re-ran on the CPU pipeline",
+                ).inc()
         stats = Statistics()
         src = MvccScanSource(snap, req.start_ts, req.ranges, statistics=stats)
         resp = BatchExecutorsRunner(req.dag, src).handle_request()
@@ -256,7 +273,7 @@ class Endpoint:
         once for the whole batch — the serving-path form of the headline
         benchmark.  Anything ineligible falls back to per-request handling;
         responses are byte-identical either way."""
-        if len(reqs) >= 2 and self.enable_device:
+        if len(reqs) >= 2 and self.device_enabled() and self._gate_ok("batch"):
             fused = self._try_fused_batch(reqs)
             if fused is not None:
                 return fused
@@ -354,10 +371,26 @@ class Endpoint:
                 self._evaluators.pop(next(iter(self._evaluators)))
         return ev
 
+    def device_enabled(self) -> bool:
+        return self.enable_device and self._gate_ok("device")
+
+    def set_enable_device(self, on: bool) -> None:
+        """Online toggle (POST /config coprocessor.enable_device)."""
+        self.enable_device = bool(on)
+
+    def _gate_ok(self, what: str) -> bool:
+        if self.feature_gate is None:
+            return True
+        from ..pd.feature_gate import BATCH_FUSION, DEVICE_COPROCESSOR, MESH_SERVING
+
+        feat = {"device": DEVICE_COPROCESSOR, "mesh": MESH_SERVING,
+                "batch": BATCH_FUSION}[what]
+        return self.feature_gate.can_enable(feat)
+
     def _mesh_evaluator_for(self, dag: DagRequest):
         """A MeshServingRunner when the mesh has >1 device and the DAG is an
         eligible aggregation; None routes to the single-device evaluator."""
-        if self.mesh is None or self.mesh.size <= 1:
+        if self.mesh is None or self.mesh.size <= 1 or not self._gate_ok("mesh"):
             return None
         from ..parallel.mesh import MeshServingRunner
         from ..server import wire

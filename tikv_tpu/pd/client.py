@@ -41,6 +41,9 @@ class PdClient:
 
     def get_gc_safe_point(self) -> int: ...
 
+    def get_cluster_version(self) -> str:
+        return "0.0.0"
+
 
 @dataclass
 class StoreInfo:
@@ -78,6 +81,9 @@ class MockPd(PdClient):
         # one leader-balance weight unit per this many load units: blends
         # counts with load (load 0 everywhere == pure count balance)
         self.load_weight_unit = 100.0
+        # cluster version driving FeatureGate rollout (feature_gate.rs:14);
+        # rolling upgrades raise it once every store runs the new release
+        self.cluster_version = "5.1.0"
         # cluster replication status (replication_mode.rs ReplicationStatus)
         self.replication: dict = {"mode": "majority", "state": "sync", "labels": {}}
         self._groups_alive_since: dict = {}
@@ -398,6 +404,17 @@ class MockPd(PdClient):
             return [s.store_id for s in self.stores.values() if now - s.last_heartbeat < within_secs]
 
     # -- gc ----------------------------------------------------------------
+
+    def get_cluster_version(self) -> str:
+        return self.cluster_version
+
+    def set_cluster_version(self, version: str) -> None:
+        from .feature_gate import parse_version
+
+        # monotonic, like every consumer gate: a downgrade request is a bug
+        if parse_version(version) < parse_version(self.cluster_version):
+            raise ValueError(f"cluster version cannot decrease to {version}")
+        self.cluster_version = version
 
     def update_gc_safe_point(self, ts: int) -> None:
         with self._mu:

@@ -1,0 +1,84 @@
+"""Version-gated feature rollout (components/pd_client/src/feature_gate.rs:14).
+
+PD tracks the CLUSTER version — the minimum version across stores during a
+rolling upgrade — and every store's FeatureGate follows it monotonically.
+A feature turns on only once the whole cluster passes its required version,
+so mixed-version clusters never run protocol the oldest member can't speak.
+
+This framework's gated features are its device-serving surfaces: single-chip
+coprocessor execution, multi-device mesh serving, and fused batch serving —
+each may be further toggled at runtime through POST /config (the online
+reconfiguration path), but the gate is the hard floor.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+def _ver_to_val(major: int, minor: int, patch: int) -> int:
+    # feature_gate.rs:9 ver_to_val: u16 fields packed into one comparable int
+    return (major << 32) | (minor << 16) | patch
+
+
+def parse_version(version: str) -> int:
+    """'5.1.0' (optionally with a -suffix or leading v) → comparable value."""
+    v = version.strip().lstrip("v")
+    core = v.split("-", 1)[0].split("+", 1)[0]
+    parts = core.split(".")
+    if len(parts) != 3:
+        raise ValueError(f"not a semver triple: {version!r}")
+    major, minor, patch = (int(p) for p in parts)
+    if not all(0 <= x < 1 << 16 for x in (major, minor, patch)):
+        raise ValueError(f"version component out of range: {version!r}")
+    return _ver_to_val(major, minor, patch)
+
+
+class Feature:
+    """A capability requiring a minimum cluster version (feature_gate.rs:56)."""
+
+    __slots__ = ("ver", "name")
+
+    def __init__(self, major: int, minor: int, patch: int, name: str = ""):
+        self.ver = _ver_to_val(major, minor, patch)
+        self.name = name
+
+
+# The framework's own gated features.  Versions follow this project's
+# release line: device serving shipped in 5.0, mesh + fused batches in 5.1.
+DEVICE_COPROCESSOR = Feature(5, 0, 0, "device-coprocessor")
+MESH_SERVING = Feature(5, 1, 0, "mesh-serving")
+BATCH_FUSION = Feature(5, 1, 0, "batch-fusion")
+
+RESOLVED_TS_CHECK_LEADER = Feature(5, 0, 0, "resolved-ts-check-leader")
+
+
+class FeatureGate:
+    """Monotonic cluster-version latch (feature_gate.rs:14).
+
+    ``set_version`` only ever raises the stored version — a stale heartbeat
+    from a lagging PD follower must not re-disable features — and returns
+    True when it actually advanced, mirroring the reference's CAS loop.
+    """
+
+    def __init__(self, version: str | None = None):
+        self._val = 0
+        self._mu = threading.Lock()
+        if version:
+            self.set_version(version)
+
+    def set_version(self, version: str) -> bool:
+        val = parse_version(version)
+        with self._mu:
+            if val <= self._val:
+                return False
+            self._val = val
+            return True
+
+    def can_enable(self, feature: Feature) -> bool:
+        with self._mu:
+            return self._val >= feature.ver
+
+    def version_value(self) -> int:
+        with self._mu:
+            return self._val

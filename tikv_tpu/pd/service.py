@@ -93,6 +93,13 @@ class PdService:
     def pd_get_gc_safe_point(self, req: dict) -> dict:
         return {"ts": self.pd.get_gc_safe_point()}
 
+    def pd_get_cluster_version(self, req: dict) -> dict:
+        return {"version": self.pd.get_cluster_version()}
+
+    def pd_set_cluster_version(self, req: dict) -> dict:
+        self.pd.set_cluster_version(req["version"])
+        return {"ok": True}
+
     def pd_add_operator(self, req: dict) -> dict:
         self.pd.add_operator(req["region_id"], req["operator"])
         return {}
@@ -139,6 +146,12 @@ class RemotePd(PdClient):
                 raise RuntimeError(f"pd {method}: {resp['error']}")
             return resp
         raise ConnectionError(f"pd {method} unreachable: {last!r}")
+
+    def get_cluster_version(self) -> str:
+        return self._call("pd_get_cluster_version", {})["version"]
+
+    def set_cluster_version(self, version: str) -> None:
+        self._call("pd_set_cluster_version", {"version": version})
 
     def alloc_id(self) -> int:
         return self._call("pd_alloc_id", {})["id"]

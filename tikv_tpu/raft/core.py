@@ -30,6 +30,13 @@ from dataclasses import dataclass, field
 _HIBERNATE_CTX = b"\x00hibernate"
 
 
+
+# read once at import: stores are spawned with the knob fixed, and
+# lease_valid() sits on the local-read hot path
+import os as _os
+
+_LEASES_OFF = _os.environ.get("TIKV_TPU_DISABLE_LEASES") == "1"
+
 class Role(enum.Enum):
     FOLLOWER = "follower"
     CANDIDATE = "candidate"
@@ -836,7 +843,13 @@ class RaftNode:
 
     def lease_valid(self) -> bool:
         """Leader lease for local reads (worker/read.rs LocalReader): valid
-        while a quorum acknowledged us within the last election timeout."""
+        while a quorum acknowledged us within the last election timeout.
+        TIKV_TPU_DISABLE_LEASES=1 turns leases off everywhere (reads take
+        ReadIndex; resolved-ts advance must confirm via check_leader) — the
+        clock-skew-paranoid deployment mode, and what lets tests prove the
+        quorum paths carry the system on their own."""
+        if _LEASES_OFF:
+            return False
         return (
             self.role == Role.LEADER
             and self._committed_in_term()

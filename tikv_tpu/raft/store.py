@@ -341,6 +341,11 @@ class StorePeer:
     # -- raft driving ------------------------------------------------------
 
     def propose_cmd(self, cmd: dict, cb: Callable) -> None:
+        from ..util.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "tikv_raftstore_proposal_total", "Proposals entering raft, by kind"
+        ).inc(kind=cmd.get("type", "data"))
         if not self.node.is_leader():
             cb(NotLeaderError(self.region.id, self.store.leader_store_of(self.region.id)))
             return
@@ -615,11 +620,24 @@ class StorePeer:
         here, apply.rs; we stop the region and surface the error)."""
         if self.apply_broken:
             return
+        import time as _time
+
+        from ..util.metrics import REGISTRY
+
+        t0 = _time.perf_counter()
         try:
             self._apply_run_inner(run)
         except BaseException:
             self.apply_broken = True
             raise  # the worker records the error (batch_system errors list)
+        REGISTRY.histogram(
+            "tikv_raftstore_apply_duration_seconds",
+            "Committed-entry batch apply latency",
+        ).observe(_time.perf_counter() - t0)
+        REGISTRY.histogram(
+            "tikv_raftstore_apply_batch_entries", "Entries per apply batch",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+        ).observe(len(run))
 
     def _apply_run_inner(self, run: list) -> None:
         eng = self.store.engine

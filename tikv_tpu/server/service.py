@@ -146,6 +146,13 @@ class KvService:
             router.enqueue_message(rmsg)
         return {}
 
+    def raft_check_leader(self, req: dict) -> dict:
+        """resolved-ts CheckLeader (advance.rs:211 service side): acknowledge
+        matching (term, leader) claims and adopt disseminated watermarks."""
+        if self.resolved_ts is None:
+            return {"accepted": []}
+        return self.resolved_ts.handle_check_leader(req)
+
     def debug_rotate_data_key(self, req: dict) -> dict:
         """Encryption-at-rest data-key rotation on a RUNNING store
         (manager/mod.rs rotation surface): new engine/raft-log files encrypt

@@ -95,6 +95,12 @@ class StoreServer:
     ):
         self.pd = pd
         self.security = security
+        self._peer_clients: dict[int, object] = {}
+        from ..pd.feature_gate import FeatureGate
+
+        # gate follows PD's cluster version (rolling-upgrade safety); synced
+        # from the heartbeat loop below
+        self.feature_gate = FeatureGate()
         # encryption at rest (manager/mod.rs:398): ONE DataKeyManager per
         # store seals the key dictionary under the master key; the raw data
         # keys feed both native engines' file IO and the importer's staged
@@ -131,13 +137,17 @@ class StoreServer:
         from .gc_worker import GcWorker
         from .lock_manager import DetectorHandle, WaiterManager
 
-        self.resolved_ts = ResolvedTsEndpoint(pd)
+        self.resolved_ts = ResolvedTsEndpoint(
+            pd, store_id=store_id, check_leader_send=self._check_leader_send,
+            feature_gate=self.feature_gate,
+        )
         self.resolved_ts.attach_store(self.store)
         self.raftkv = RaftKv(self.store, resolved_ts=self.resolved_ts)
         self.storage = Storage(engine=self.raftkv)
         self.copr = Endpoint(
             self.raftkv, enable_device=enable_device,
             mesh=_default_mesh() if enable_device else None,
+            feature_gate=self.feature_gate,
         )
         self.gc_worker = GcWorker(self.raftkv)
         # wait-for edges route to the cluster detector leader (region 1's
@@ -171,6 +181,15 @@ class StoreServer:
         # re-evaluates the high-water condition, and reaps CDC subscriptions
         # whose client vanished (their buffers pin the shared quota)
         self.node.heartbeat_hooks.append(self.memory_trace.poll)
+
+        def _sync_cluster_version():
+            try:
+                self.feature_gate.set_version(self.pd.get_cluster_version())
+            except Exception:  # noqa: BLE001 — PD briefly unreachable
+                pass
+
+        _sync_cluster_version()
+        self.node.heartbeat_hooks.append(_sync_cluster_version)
         self.node.heartbeat_hooks.append(lambda: self.cdc.reap_idle())
         from ..util.metrics import REGISTRY
 
@@ -178,6 +197,11 @@ class StoreServer:
             "tikv_memory_usage_bytes", "Store memory-trace total")
         self.node.heartbeat_hooks.append(
             lambda: _mem_gauge.set(self.memory_trace.sum()))
+        # engine internals for the operator dashboards (metrics/grafana/
+        # tikv_tpu_engine.json): WAL size, memtable size, run counts per CF,
+        # and the native perf counters (flushes, merges, block reads, bloom
+        # skips) published as monotonic gauges each heartbeat
+        self.node.heartbeat_hooks.append(self._publish_engine_metrics)
         # raw-KV TTL reclamation (ttl_checker.rs): a slow-cadence sweep of
         # expired raw entries through the replicated delete path, on its OWN
         # worker thread (the GcWorker AutoGc shape) — a large expired
@@ -200,11 +224,38 @@ class StoreServer:
 
         self._ttl_thread = threading.Thread(target=_ttl_loop, daemon=True,
                                             name="ttl-checker")
+        # resolved-ts advance loop (endpoint.rs:247 advance-ts-interval):
+        # periodic watermark advance with check_leader fan-out — what keeps
+        # follower stale reads moving in the multi-process deployment
+        self._rts_stop = threading.Event()
+
+        def _rts_loop(interval=float(os.environ.get(
+                "TIKV_TPU_RESOLVED_TS_INTERVAL", "1.0"))):
+            while not self._rts_stop.wait(interval):
+                try:
+                    self.resolved_ts.advance_all()
+                except Exception:  # noqa: BLE001 — next tick retries
+                    pass
+
+        self._rts_thread = threading.Thread(target=_rts_loop, daemon=True,
+                                            name="resolved-ts-advance")
         # operator HTTP surface (status_server/mod.rs): /metrics, /status,
         # /debug/pprof/*, /debug/memory (the attribution tree above)
         from .status_server import StatusServer
 
+        from ..util.config import ConfigController, CoprocessorConfig, TikvConfig
+
+        self.config_controller = ConfigController(
+            TikvConfig(coprocessor=CoprocessorConfig(enable_device=enable_device))
+        )
+        # online device knob: POST /config {"coprocessor.enable_device": x}
+        self.config_controller.register(
+            "coprocessor",
+            lambda changed: self.copr.set_enable_device(changed["enable_device"])
+            if "enable_device" in changed else None,
+        )
         self.status_server = StatusServer(
+            controller=self.config_controller,
             security=security, memory_trace=self.memory_trace
         )
         self.service = KvService(
@@ -222,6 +273,56 @@ class StoreServer:
         )
         self.server = Server(self.service, host=host, port=port, security=security)
         self.recovered_peers = recovered
+
+    def _publish_engine_metrics(self) -> None:
+        from ..util.metrics import REGISTRY
+
+        eng = self.engine
+        if hasattr(eng, "wal_bytes"):
+            REGISTRY.gauge(
+                "tikv_engine_wal_bytes", "Live WAL segment bytes"
+            ).set(eng.wal_bytes())
+        if hasattr(eng, "mem_bytes"):
+            REGISTRY.gauge(
+                "tikv_engine_memtable_bytes", "Memtable resident bytes"
+            ).set(eng.mem_bytes())
+        if hasattr(eng, "run_count"):
+            g = REGISTRY.gauge("tikv_engine_run_count", "Sorted runs per CF")
+            for cf in ("default", "write", "lock", "raft"):
+                try:
+                    g.set(eng.run_count(cf), cf=cf)
+                except (ValueError, OSError):
+                    pass
+        if hasattr(eng, "perf_context"):
+            g = REGISTRY.gauge(
+                "tikv_engine_perf_events",
+                "Native engine perf counters (monotonic; rate() in panels)",
+            )
+            for k, v in eng.perf_context().items():
+                g.set(v, event=k)
+
+    def _check_leader_send(self, store_id: int, payload: dict):
+        """One check_leader RPC to a peer store (short timeout: a dead peer
+        simply contributes no vote this round)."""
+        addr = self._resolve(store_id)
+        if addr is None:
+            return None
+        cl = self._peer_clients.get(store_id)
+        try:
+            if cl is None:
+                from .server import Client
+
+                cl = Client(addr[0], addr[1], security=self.security)
+                self._peer_clients[store_id] = cl
+            return cl.call("raft_check_leader", payload, timeout=2.0)
+        except (OSError, ConnectionError, TimeoutError, RuntimeError):
+            self._peer_clients.pop(store_id, None)
+            try:
+                if cl is not None:
+                    cl.close()
+            except OSError:
+                pass
+            return None
 
     def rotate_data_keys(self) -> dict:
         """Mint ONE new data key and refresh every native engine's registry:
@@ -243,6 +344,7 @@ class StoreServer:
         self.server.start()
         self.status_server.start()
         self._ttl_thread.start()
+        self._rts_thread.start()
         self.pd.put_store(self.store.store_id, addr=self.server.addr)
         self.node.start()
 
@@ -275,6 +377,16 @@ class StoreServer:
 
     def stop(self) -> None:
         self._ttl_stop.set()
+        self._rts_stop.set()
+        # the advance thread inserts into _peer_clients: join it BEFORE
+        # closing/iterating the clients
+        if self._rts_thread.is_alive():
+            self._rts_thread.join(timeout=10.0)
+        for cl in list(self._peer_clients.values()):
+            try:
+                cl.close()
+            except OSError:
+                pass
         self.node.stop()
         self.server.stop()
         self.status_server.stop()

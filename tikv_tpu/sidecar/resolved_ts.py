@@ -62,13 +62,27 @@ class ResolvedTsEndpoint:
     follower may serve a stale read only once its own applied index reaches
     the paired index (store/util.rs RegionReadProgress)."""
 
-    def __init__(self, pd):
+    def __init__(self, pd, store_id: int | None = None, check_leader_send=None,
+                 feature_gate=None):
         self.pd = pd
         self._mu = threading.Lock()
         self.resolvers: dict[int, Resolver] = {}
         self.stores: list = []
         # region_id -> (resolved_ts, required_apply_index)
         self.read_progress: dict[int, tuple[int, int]] = {}
+        # cross-process mode (advance.rs:75,211): this store's id plus a
+        # sender ``(store_id, payload) -> response dict | None`` that carries
+        # one check_leader RPC to a peer store.  The same RPC confirms
+        # leadership (a quorum of matching (term, leader) views) AND
+        # disseminates the previous round's confirmed watermarks, so
+        # follower stale reads advance without leases and without waking
+        # hibernated groups.
+        self.store_id = store_id
+        self._check_leader_send = check_leader_send
+        # version gate (feature_gate.rs): the RPC fan-out stays off until
+        # every store in the cluster can answer raft_check_leader
+        self.feature_gate = feature_gate
+        self._pending_progress: dict[int, tuple[int, int]] = {}
 
     def attach_store(self, store) -> None:
         store.apply_observers.append(self.observe_apply)
@@ -138,28 +152,155 @@ class ResolvedTsEndpoint:
 
     def advance_all(self) -> dict[int, int]:
         """Advance watermarks from leader peers, pairing each with the
-        leader's applied index at resolution time."""
+        leader's applied index at resolution time.  Leadership is confirmed
+        by lease, by the in-process peer census (single-process clusters),
+        or by a check_leader RPC quorum across stores (the deployment
+        shape)."""
         ts = self.pd.get_tso()
         out: dict[int, int] = {}
         with self._mu:
             resolvers = list(self.resolvers.values())
+        rpc_on = self._check_leader_send is not None and (
+            self.feature_gate is None or self._gate_ok()
+        )
         leader_peers: dict[int, object] = {}
+        rpc_candidates: dict[int, object] = {}
+        rpc_leaders: dict[int, object] = {}
         for store in self.stores:
             for rid, peer in list(store.peers.items()):
                 # Quorum-confirmed leadership, not bare is_leader(): a
                 # deposed leader that hasn't heard the new term must never
                 # publish a watermark past locks it never applied
                 # (resolved_ts advance.rs confirms via CheckLeader RPCs).
-                if self._leader_confirmed(rid, peer):
+                if peer.node.lease_valid() and peer.node.is_leader():
                     leader_peers[rid] = peer
+                    rpc_leaders[rid] = peer
+                elif rpc_on:
+                    if peer.node.is_leader():
+                        rpc_candidates[rid] = peer
+                        rpc_leaders[rid] = peer
+                elif self._leader_confirmed(rid, peer):
+                    leader_peers[rid] = peer
+        confirmed_rpc: set[int] = set()
+        if rpc_on and rpc_leaders:
+            # ONE fan-out per round even when every lease is valid: the RPC
+            # is what carries the previous round's confirmed watermarks to
+            # follower stores — without it their RegionReadProgress never
+            # moves and follower stale reads never serve
+            confirmed_rpc = self._check_leader_round(rpc_candidates, rpc_leaders)
+        for rid in confirmed_rpc:
+            leader_peers[rid] = rpc_candidates[rid]
+        progress_batch: dict[int, tuple[int, int]] = {}
         for r in resolvers:
             resolved = r.resolve(ts)
             out[r.region_id] = resolved
             leader = leader_peers.get(r.region_id)
             if leader is not None:
+                pair = (resolved, leader.apply_index)
                 with self._mu:
-                    self.read_progress[r.region_id] = (resolved, leader.node.applied)
+                    self.read_progress[r.region_id] = pair
+                progress_batch[r.region_id] = pair
+        with self._mu:
+            # confirmed pairs ride the NEXT round's check_leader RPCs out to
+            # follower stores (their RegionReadProgress update)
+            self._pending_progress = dict(progress_batch)
         return out
+
+    def _gate_ok(self) -> bool:
+        from ..pd.feature_gate import RESOLVED_TS_CHECK_LEADER
+
+        return self.feature_gate.can_enable(RESOLVED_TS_CHECK_LEADER)
+
+    def _check_leader_round(self, candidates: dict[int, object],
+                            all_leaders: dict[int, object]) -> set[int]:
+        """check_leader fan-out (advance.rs:211): one RPC per peer store,
+        sent CONCURRENTLY (a dead peer costs one timeout, not one per
+        store), carrying (a) every lease-less candidate region's (term,
+        leader) claim for quorum confirmation and (b) the last round's
+        confirmed watermarks for every led region — the follower
+        RegionReadProgress update.  Hibernated groups on either side answer
+        from their frozen term — nobody wakes."""
+        by_store: dict[int, list] = {}
+        votes: dict[int, set] = {}
+        voters: dict[int, set] = {}
+        peer_stores: set[int] = set()
+        for rid, peer in all_leaders.items():
+            for p in peer.region.peers:
+                if p.store_id != self.store_id:
+                    peer_stores.add(p.store_id)
+        for rid, peer in candidates.items():
+            node = peer.node
+            votes[rid] = {self.store_id}
+            voters[rid] = set()
+            for p in peer.region.peers:
+                if p.role == "learner":
+                    continue  # learners don't vote; witnesses do
+                voters[rid].add(p.store_id)
+                if p.store_id != self.store_id:
+                    by_store.setdefault(p.store_id, []).append(
+                        {"region_id": rid, "term": node.term, "leader_id": node.id}
+                    )
+        with self._mu:
+            pending = dict(self._pending_progress)
+        if not peer_stores:
+            return set()
+
+        def one(sid):
+            payload = {
+                "regions": by_store.get(sid, []),
+                "progress": {str(rid): list(pair) for rid, pair in pending.items()},
+            }
+            try:
+                return sid, self._check_leader_send(sid, payload)
+            except Exception:  # noqa: BLE001 — peer store down: no vote
+                return sid, None
+
+        import concurrent.futures as _fut
+
+        with _fut.ThreadPoolExecutor(max_workers=min(len(peer_stores), 8)) as pool:
+            results = list(pool.map(one, sorted(peer_stores)))
+        for sid, resp in results:
+            if not isinstance(resp, dict):
+                continue
+            for rid in resp.get("accepted", ()):
+                if rid in votes:
+                    votes[rid].add(sid)
+        confirmed: set[int] = set()
+        for rid in candidates:
+            n_voters = max(len(voters[rid]), 1)
+            if len(votes[rid]) * 2 > n_voters:
+                confirmed.add(rid)
+        return confirmed
+
+    def handle_check_leader(self, req: dict) -> dict:
+        """Peer-store side of the fan-out: acknowledge regions whose local
+        raft state matches the claimed (term, leader) — readable WITHOUT
+        waking a hibernated group — and adopt the disseminated watermarks
+        (the follower RegionReadProgress update that makes stale reads on
+        this store advance while the leader lives elsewhere)."""
+        accepted: list[int] = []
+        store = self.stores[0] if self.stores else None
+        if store is None:
+            return {"accepted": []}
+        for entry in req.get("regions", ()):
+            rid = entry.get("region_id")
+            p = store.peers.get(rid)
+            if p is None:
+                continue
+            node = p.node
+            if node.term == entry.get("term") and node.leader_id == entry.get("leader_id"):
+                accepted.append(rid)
+        for rid_s, pair in (req.get("progress") or {}).items():
+            rid = int(rid_s)
+            p = store.peers.get(rid)
+            if p is None or len(pair) != 2:
+                continue
+            rts, ridx = int(pair[0]), int(pair[1])
+            with self._mu:
+                cur = self.read_progress.get(rid, (0, 0))
+                if rts > cur[0]:
+                    self.read_progress[rid] = (rts, ridx)
+        return {"accepted": accepted}
 
     def progress_of(self, region_id: int) -> tuple[int, int]:
         with self._mu:
