@@ -906,12 +906,9 @@ class JaxDagEvaluator:
         blocks = cache.blocks
         n_blocks = len(blocks)
 
-        zone = self._zone_evaluator()
-        if zone is not None:
-            out = zone.try_run(cache)
-            if out is not None:
-                state_np, n_slots, key_of = out
-                return self._finalize_agg(state_np, n_slots, key_of)
+        zone_resp = self._try_zone(cache)
+        if zone_resp is not None:
+            return zone_resp
 
         stable = self._stable_dict_group_cols(blocks)
         if stable is not None:
@@ -958,6 +955,17 @@ class JaxDagEvaluator:
         packed = scan_fn(col_data, col_nulls, nv_dev, all_gids, off_dev)
         state_np = _unpack_state(packed, self._host_state_template())
         return self._finalize_agg(state_np, n_slots, lambda r: groups.rows[r])
+
+    def _try_zone(self, cache) -> SelectResponse | None:
+        """ONE definition of the zone-path protocol: probe, run, finalize."""
+        zone = self._zone_evaluator()
+        if zone is None:
+            return None
+        out = zone.try_run(cache)
+        if out is None:
+            return None
+        state_np, n_slots, key_of = out
+        return self._finalize_agg(state_np, n_slots, key_of)
 
     def _zone_evaluator(self):
         """Lazily constructed zone-path runner (None when plainly ineligible)."""
@@ -1420,6 +1428,34 @@ def run_batch_cached(evaluators: list["JaxDagEvaluator"], cache) -> list[SelectR
     if not blocks:
         raise ValueError("batched evaluation over an empty block cache")
     n_blocks = len(blocks)
+
+    # Zone-tiled fast path: when EVERY query rides the clustered layout the
+    # per-query cost is a handful of pure tile reductions — far below the
+    # fused program's shared full-data pass — and the layouts themselves are
+    # shared across queries with the same (group, sort) signature.  Cheap
+    # eligibility pre-probe first (no device work), then all-or-nothing
+    # execution with finalize deferred until every query served — a decline
+    # falls back to the fused program with no wasted zone passes.
+    def _zone_probe(ev):
+        zone = ev._zone_evaluator()
+        if zone is None or cache in zone._declined:
+            return None
+        return zone if zone.eligible(blocks) is not None else None
+
+    zones = [_zone_probe(ev) for ev in evaluators]
+    if all(z is not None for z in zones):
+        outs = []
+        for ev, zone in zip(evaluators, zones):
+            out = zone.try_run(cache)
+            if out is None:  # late decline (partial-fraction fallback)
+                outs = None
+                break
+            outs.append((ev, out))
+        if outs is not None:
+            return [
+                ev._finalize_agg(state_np, n_slots, key_of)
+                for ev, (state_np, n_slots, key_of) in outs
+            ]
 
     specs = []  # (ev, group_cols, dicts, dict_lens, capacity)
     ship: list[int] = []
