@@ -838,6 +838,7 @@ def main() -> None:
             )
             for k in ("load_rows_per_s", "ycsb_e_scans_per_s", "ycsb_e_rows_per_s",
                       "q1_pushdown_rows_per_s", "q1_device_rows_per_s",
+                      "q1_device_cold_rows_per_s",
                       "q1_device_from_device", "q1_device_platform",
                       "regions", "leader_stores"):
                 results[f"cluster_{k}"] = c.get(k)
