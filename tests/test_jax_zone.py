@@ -303,3 +303,89 @@ def test_zone_repeat_and_second_evaluator_share_layout():
     assert w1.encode() == w2.encode() == cpu.encode()
     ev2 = JaxDagEvaluator(dag, block_rows=512)
     assert ev2.run(None, cache=CACHE).encode() == cpu.encode()
+
+
+@pytest.mark.parametrize("seed", [11, 22, 33, 44, 55, 66])
+def test_zone_differential_fuzz(seed):
+    """Randomized plans over randomized tables: every response must match
+    the CPU pipeline byte-for-byte whichever path (zone / generic / fused)
+    serves it.  Seeded — failures reproduce exactly."""
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(3000, 9000))
+    from tikv_tpu.copr.datatypes import Column, EvalType
+
+    cols_info = [
+        ColumnInfo(1, FieldType.int64(), is_pk_handle=True),
+        ColumnInfo(2, FieldType.int64()),
+        ColumnInfo(3, FieldType.decimal_type(2)),
+        ColumnInfo(4, FieldType.varchar()),
+        ColumnInfo(5, FieldType.varchar()),
+        ColumnInfo(6, FieldType.int64()),
+    ]
+    v = rng.integers(-5000, 5000, n)
+    d = rng.integers(0, 100000, n)
+    tags_a = [b"aa", b"bb", b"cc"]
+    tags_b = [b"xx", b"yy"]
+    ta = rng.integers(0, 3, n)
+    tb = rng.integers(0, 2, n)
+    w = rng.integers(0, 1 << 30, n)
+    null_v = rng.random(n) < float(rng.choice([0.0, 0.05, 0.3]))
+    kvs = [
+        (record_key(TABLE_ID, i), encode_row(cols_info[1:], [
+            None if null_v[i] else int(v[i]), int(d[i]),
+            tags_a[ta[i]], tags_b[tb[i]], int(w[i]),
+        ]))
+        for i in range(n)
+    ]
+    da = np.empty(3, dtype=object); da[:] = tags_a
+    db = np.empty(2, dtype=object); db[:] = tags_b
+    cache = ColumnBlockCache()
+    B = int(rng.choice([1024, 2048, 4096]))
+    handles = np.arange(n, dtype=np.int64)
+    for s in range(0, n, B):
+        e = min(s + B, n); m = e - s
+        z = lambda: np.zeros(m, dtype=bool)
+        cache.add([
+            Column(EvalType.INT, handles[s:e], z()),
+            Column(EvalType.INT, np.where(null_v[s:e], 0, v[s:e]), null_v[s:e].copy()),
+            Column(EvalType.DECIMAL, d[s:e].copy(), z(), 2),
+            Column(EvalType.BYTES, ta[s:e].astype(np.int64), z(), 0, da),
+            Column(EvalType.BYTES, tb[s:e].astype(np.int64), z(), 0, db),
+            Column(EvalType.INT, w[s:e].copy(), z()),
+        ], m)
+    cache.filled = True
+
+    conj_pool = [
+        lambda: call("le", col(1), const_int(int(rng.integers(-4000, 6000)))),
+        lambda: call("gt", col(1), const_int(int(rng.integers(-6000, 4000)))),
+        lambda: call("ge", col(2), const_decimal(int(rng.integers(0, 90000)), 2)),
+        lambda: call("ne", col(1), const_int(int(rng.integers(-5000, 5000)))),
+        lambda: call("lt", col(1), call("plus", col(5), const_int(100))),  # unrecognized
+    ]
+    agg_pool = [
+        lambda: AggDescriptor("sum", col(1)),
+        lambda: AggDescriptor("count", None),
+        lambda: AggDescriptor("avg", col(2)),
+        lambda: AggDescriptor("min", col(1)),
+        lambda: AggDescriptor("max", col(2)),
+        lambda: AggDescriptor("count", col(1)),
+        lambda: AggDescriptor("sum", call("multiply", col(2), col(1))),
+    ]
+    for _case in range(6):
+        n_conj = int(rng.integers(0, 3))
+        conds = [conj_pool[int(rng.integers(0, len(conj_pool)))]() for _ in range(n_conj)]
+        group = [[], [col(3)], [col(3), col(4)]][int(rng.integers(0, 3))]
+        aggs = [agg_pool[int(rng.integers(0, len(agg_pool)))]()
+                for _ in range(int(rng.integers(1, 4)))]
+        execs = [TableScan(TABLE_ID, cols_info)]
+        if conds:
+            execs.append(Selection(conds))
+        execs.append(Aggregation(group_by=group, agg_funcs=aggs))
+        dag = DagRequest(executors=execs)
+        cpu = BatchExecutorsRunner(dag, FixtureScanSource(kvs)).handle_request()
+        ev = JaxDagEvaluator(dag, block_rows=B)
+        warm = ev.run(None, cache=cache)
+        assert warm.encode() == cpu.encode(), (
+            f"seed={seed} case={_case} conds={n_conj} group={len(group)} "
+            f"aggs={[a.op for a in aggs]}"
+        )
