@@ -370,6 +370,12 @@ def test_zone_differential_fuzz(seed):
         lambda: AggDescriptor("max", col(2)),
         lambda: AggDescriptor("count", col(1)),
         lambda: AggDescriptor("sum", call("multiply", col(2), col(1))),
+        # outside the zone op set: exercises the generic warm paths' byte
+        # parity under the same randomized tables
+        lambda: AggDescriptor("first", col(1)),
+        lambda: AggDescriptor("bit_xor", col(5)),
+        lambda: AggDescriptor("bit_and", col(5)),
+        lambda: AggDescriptor("bit_or", col(5)),
     ]
     for _case in range(6):
         n_conj = int(rng.integers(0, 3))
@@ -389,3 +395,16 @@ def test_zone_differential_fuzz(seed):
             f"seed={seed} case={_case} conds={n_conj} group={len(group)} "
             f"aggs={[a.op for a in aggs]}"
         )
+
+    # raw TopN with a varchar payload over the same cache (device top-K merge)
+    for _t in range(2):
+        desc = bool(rng.integers(0, 2))
+        execs = [
+            TableScan(TABLE_ID, cols_info),
+            Selection([call("gt", col(1), const_int(int(rng.integers(-4000, 2000))))]),
+            TopN([(col(1), desc), (col(0), not desc)], int(rng.integers(1, 60))),
+        ]
+        dag = DagRequest(executors=execs)
+        cpu = BatchExecutorsRunner(dag, FixtureScanSource(kvs)).handle_request()
+        dev = JaxDagEvaluator(dag, block_rows=B).run(None, cache=cache)
+        assert dev.encode() == cpu.encode(), f"seed={seed} topn case={_t}"
