@@ -72,7 +72,12 @@ def _load():
         if _lib is not None or _lib_err is not None:
             return _lib
         try:
-            if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            hdr = os.path.join(_HERE, "crypt.h")  # shared cipher header
+            newest_src = max(
+                os.path.getmtime(_SRC),
+                os.path.getmtime(hdr) if os.path.exists(hdr) else 0,
+            )
+            if not os.path.exists(_SO) or os.path.getmtime(_SO) < newest_src:
                 _build()
             lib = ctypes.CDLL(_SO)
         except (OSError, subprocess.CalledProcessError) as e:
