@@ -423,6 +423,49 @@ def _op_topn(req, state):
     }
 
 
+def _filter_dag(kind: str):
+    """ONE definition of the BASELINE config 1-2 plans (the _topn_endpoint
+    rule: device op and CPU oracle share the fixture so they can never
+    drift apart).  The Limit bounds the response so the metric measures
+    scan+mask plumbing, not gigabytes of response encoding (the reference's
+    criterion bench likewise consumes batches without a response)."""
+    from tikv_tpu.copr.dag import DagRequest, Limit, Selection, TableScan
+    from tikv_tpu.copr.rpn import call, col, const_int
+
+    if kind == "scan":
+        return DagRequest(executors=[
+            TableScan(TABLE_ID, _lineitem()), Limit(100_000),
+        ])
+    return DagRequest(executors=[
+        TableScan(TABLE_ID, _lineitem()),
+        Selection([
+            call("lt", col(4), const_int(10500)),
+            call("gt", col(1), const_int(5)),
+            call("ge", col(2), const_int(100000)),
+        ]),
+        Limit(100_000),
+    ])
+
+
+def _op_filter(req, state):
+    """BASELINE configs 1-2: pure table scan (no predicate) and a
+    3-predicate selection filter, through the device mask path over the
+    shared block cache."""
+    from tikv_tpu.copr.jax_eval import JaxDagEvaluator, supports
+
+    cache = state["cache"]
+    dag = _filter_dag(req["kind"])
+    assert supports(dag)
+    ev = JaxDagEvaluator(dag, block_rows=state["block_rows"])
+    ev.run(None, cache=cache)  # compile
+    ts = []
+    for _ in range(req.get("trials", 3)):
+        t0 = time.perf_counter()
+        resp = ev.run(None, cache=cache)
+        ts.append(time.perf_counter() - t0)
+    return {"ts": ts, "resp": resp.encode().hex()}
+
+
 _OPS = {
     "build": _op_build,
     "warm": _op_warm,
@@ -430,6 +473,7 @@ _OPS = {
     "cold": _op_cold,
     "mvcc": _op_mvcc,
     "topn": _op_topn,
+    "filter": _op_filter,
 }
 
 
@@ -790,6 +834,35 @@ def main() -> None:
             f"trial spread {bspread:.1f}x > 2x — median shown, trials recorded"
         )
     _mark("batch", speedup=round(batch_speedup, 2), spread=round(bspread, 2))
+
+    # BASELINE configs 1-2 (scan passthrough + 3-predicate selection):
+    # AFTER the headline ops — an infra failure here must not strand a dead
+    # worker for batch/cold, and a tolerated WorkerDied only loses these
+    # auxiliary rows.  Data mismatches stay fatal (_fail), like mvcc/topn.
+    if interleave:
+        for kind in ("scan", "selection"):
+            try:
+                r = dev.call("filter", kind=kind, trials=3)
+                cpu_ts = []
+                for _ in range(3):
+                    cresp, dt = run_cpu(_filter_dag(kind), cache=cache)
+                    cpu_ts.append(dt)
+                if bytes.fromhex(r["resp"]) != cresp.encode():
+                    _fail(f"{kind.upper()}_MISMATCH")
+                cpu_t = float(np.median(cpu_ts))
+                dev_t = float(np.median(r["ts"]))
+                results[f"{kind}_cpu_s"] = round(cpu_t, 4)
+                results[f"{kind}_tpu_s"] = round(dev_t, 4)
+                results[f"{kind}_speedup"] = round(cpu_t / dev_t, 2)
+                _mark(kind, speedup=round(cpu_t / dev_t, 2))
+            except (WorkerDied, AssertionError) as e:
+                results[f"{kind}_error"] = str(e)[:200]
+                _mark(f"{kind}_error", err=str(e)[:120])
+    else:
+        # parent cache was dropped (low-RAM branch): record the skip so the
+        # attested JSON distinguishes 'skipped' from 'not implemented'
+        _mark("filter_skipped_no_parent_cache")
+        results["filter_skipped"] = "no parent cache for the CPU oracle"
 
     if os.environ.get("BENCH_MVCC", "1") != "0":
         try:

@@ -58,6 +58,17 @@ _lib_err: str | None = None
 _build_mu = threading.Lock()
 
 
+def _so_stale(so: str, *srcs: str) -> bool:
+    """True when the shared object predates ANY of its sources (the .cc
+    plus shared headers) — the one place the dependency list lives."""
+    if not os.path.exists(so):
+        return True
+    newest = max(
+        (os.path.getmtime(p) for p in srcs if os.path.exists(p)), default=0
+    )
+    return os.path.getmtime(so) < newest
+
+
 def _build() -> None:
     subprocess.run(
         ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", _SO, _SRC],
@@ -72,12 +83,7 @@ def _load():
         if _lib is not None or _lib_err is not None:
             return _lib
         try:
-            hdr = os.path.join(_HERE, "crypt.h")  # shared cipher header
-            newest_src = max(
-                os.path.getmtime(_SRC),
-                os.path.getmtime(hdr) if os.path.exists(hdr) else 0,
-            )
-            if not os.path.exists(_SO) or os.path.getmtime(_SO) < newest_src:
+            if _so_stale(_SO, _SRC, os.path.join(_HERE, "crypt.h")):
                 _build()
             lib = ctypes.CDLL(_SO)
         except (OSError, subprocess.CalledProcessError) as e:
