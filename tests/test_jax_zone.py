@@ -408,3 +408,29 @@ def test_zone_differential_fuzz(seed):
         cpu = BatchExecutorsRunner(dag, FixtureScanSource(kvs)).handle_request()
         dev = JaxDagEvaluator(dag, block_rows=B).run(None, cache=cache)
         assert dev.encode() == cpu.encode(), f"seed={seed} topn case={_t}"
+
+
+def test_zone_failure_falls_through_to_generic(monkeypatch):
+    """A zone-path exception (backend/compiler failure on a new accelerator)
+    must fall through to the generic warm path and be remembered — never
+    surface to the caller."""
+    dag = DagRequest(executors=[
+        TableScan(TABLE_ID, COLS),
+        Selection([call("le", col(1), const_int(7000))]),
+        Aggregation(group_by=[col(3)], agg_funcs=[AggDescriptor("sum", col(1))]),
+    ])
+    cpu = BatchExecutorsRunner(dag, FixtureScanSource(KVS)).handle_request()
+    ev = JaxDagEvaluator(dag, block_rows=2048)
+    zone = ev._zone_evaluator()
+    calls = {"n": 0}
+
+    def boom(cache):
+        calls["n"] += 1
+        raise RuntimeError("simulated backend failure")
+
+    monkeypatch.setattr(zone, "_try_run_inner", boom)
+    assert ev.run(None, cache=CACHE).encode() == cpu.encode()
+    assert CACHE in zone._declined  # remembered: no retry storm
+    assert zone.failed >= 1 and "simulated" in zone.last_error
+    assert ev.run(None, cache=CACHE).encode() == cpu.encode()
+    assert calls["n"] >= 1

@@ -957,7 +957,11 @@ class JaxDagEvaluator:
         return self._finalize_agg(state_np, n_slots, lambda r: groups.rows[r])
 
     def _try_zone(self, cache) -> SelectResponse | None:
-        """ONE definition of the zone-path protocol: probe, run, finalize."""
+        """ONE definition of the zone-path protocol: probe, run, finalize.
+
+        try_run owns the crash-fallback protocol (failures recorded and
+        remembered inside ZoneEvaluator), so a None here simply means
+        "serve through the generic warm path"."""
         zone = self._zone_evaluator()
         if zone is None:
             return None
@@ -1446,8 +1450,8 @@ def run_batch_cached(evaluators: list["JaxDagEvaluator"], cache) -> list[SelectR
     if all(z is not None for z in zones):
         outs = []
         for ev, zone in zip(evaluators, zones):
-            out = zone.try_run(cache)
-            if out is None:  # late decline (partial-fraction fallback)
+            out = zone.try_run(cache)  # crash-fallback lives inside try_run
+            if out is None:  # late decline (partial-fraction or failure)
                 outs = None
                 break
             outs.append((ev, out))

@@ -347,6 +347,8 @@ class ZoneEvaluator:
         # the layout work on every later query against the same cache
         self._declined = weakref.WeakSet()
         self.served = 0  # queries answered by the zone path (observability)
+        self.failed = 0  # zone-path crashes that fell through (observability)
+        self.last_error: str | None = None
 
     # -- eligibility -------------------------------------------------------
 
@@ -581,6 +583,20 @@ class ZoneEvaluator:
     # -- merge + run -------------------------------------------------------
 
     def try_run(self, cache):
+        """Zone-serve the plan over ``cache``, or None to fall back.  A
+        zone-path FAILURE (unexpected compiler/backend error — e.g. the
+        first run on a new accelerator) is caught, recorded, and remembered
+        per cache: the fast layer must never take down a query the slower
+        layers can serve, and must not retry a crash on every request."""
+        try:
+            return self._try_run_inner(cache)
+        except Exception as exc:  # noqa: BLE001 — generic path always serves
+            self.failed += 1
+            self.last_error = repr(exc)
+            self._declined.add(cache)
+            return None
+
+    def _try_run_inner(self, cache):
         ev = self.ev
         blocks = cache.blocks
         if cache in self._declined:
