@@ -911,7 +911,8 @@ def main() -> None:
             )
             for k in ("load_rows_per_s", "ycsb_e_scans_per_s", "ycsb_e_rows_per_s",
                       "q1_pushdown_rows_per_s", "q1_device_rows_per_s",
-                      "q1_device_cold_rows_per_s",
+                      "q1_device_cold_rows_per_s", "q1_device_round_ms",
+                      "ycsb_e_p50_ms", "ycsb_e_p99_ms",
                       "q1_device_from_device", "q1_device_platform",
                       "regions", "leader_stores"):
                 results[f"cluster_{k}"] = c.get(k)
