@@ -273,18 +273,43 @@ def test_zone_no_selection():
     assert warm.encode() == cpu.encode()
 
 
-def test_zone_var_pop_falls_back():
-    """var_pop is outside the zone op set; generic warm path must serve."""
+def test_zone_var_pop_served():
+    """var_pop rides the zone path: int sums + f64 sum-of-squares per tile
+    (the same carry layout as the CPU AggState) — covering bare int and
+    NEGATIVE-valued columns, a DECIMAL column, and an EXPRESSION argument."""
     cpu, warm, ev = run_warm(
         [
             TableScan(TABLE_ID, COLS),
             Selection([call("le", col(1), const_int(7000))]),
-            Aggregation(group_by=[col(3)], agg_funcs=[AggDescriptor("var_pop", col(1))]),
+            Aggregation(group_by=[col(3)], agg_funcs=[
+                AggDescriptor("var_pop", col(1)),
+                AggDescriptor("var_pop", col(4)),
+                AggDescriptor("var_pop", col(2)),  # decimal(2)
+                AggDescriptor("var_pop", call("multiply", col(1), col(4))),
+                AggDescriptor("count", None),
+            ]),
         ],
         FIX,
     )
-    zone = getattr(ev, "_zone", None)
-    assert zone in (None, False) or zone.served == 0
+    assert zone_served(ev)
+    assert warm.encode() == cpu.encode()
+
+
+def test_zone_var_pop_with_nulls():
+    """NULL-bearing argument column: null tiles are forced partial and the
+    partial path's live-mask gates the sum-of-squares."""
+    cpu, warm, ev = run_warm(
+        [
+            TableScan(TABLE_ID, NCOLS),
+            Selection([call("le", col(1), const_int(8000))]),
+            Aggregation(group_by=[col(3)], agg_funcs=[
+                AggDescriptor("var_pop", col(1)),
+                AggDescriptor("count", col(1)),
+            ]),
+        ],
+        NFIX,
+    )
+    assert zone_served(ev)
     assert warm.encode() == cpu.encode()
 
 
@@ -370,6 +395,7 @@ def test_zone_differential_fuzz(seed):
         lambda: AggDescriptor("max", col(2)),
         lambda: AggDescriptor("count", col(1)),
         lambda: AggDescriptor("sum", call("multiply", col(2), col(1))),
+        lambda: AggDescriptor("var_pop", col(1)),
         # outside the zone op set: exercises the generic warm paths' byte
         # parity under the same randomized tables
         lambda: AggDescriptor("first", col(1)),

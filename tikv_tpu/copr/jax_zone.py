@@ -31,7 +31,9 @@ order would differ from the CPU oracle beyond the last ulp); everything else
 is int64-lane arithmetic, so responses stay byte-identical to the CPU
 pipeline, including group output order (tracked as the minimum original row
 index among each group's active rows — the CPU hash-agg's insertion order,
-matching jax_eval's `_fused_step` semantics).
+matching jax_eval's `_fused_step` semantics).  One carve-out shared with the
+generic device path: var_pop's sum-of-squares accumulates in f64, exact
+while Σx² < 2^53 and last-ulp-exempt beyond (the documented REAL caveat).
 
 Layouts are built once per (group columns, sort column) signature and pinned
 on the ColumnBlockCache; queries whose partial fraction exceeds
@@ -53,7 +55,7 @@ TILE_ROWS = 4096
 PARTIAL_FALLBACK = 0.6  # > this fraction of partial tiles → generic path
 _RIDX_INF = np.int32(2**31 - 1)
 
-_ZONE_AGG_OPS = {"count", "sum", "avg", "min", "max"}
+_ZONE_AGG_OPS = {"count", "sum", "avg", "min", "max", "var_pop"}
 # null-preserving kernels: non-null operands can never produce a NULL result,
 # so an expression's null mask is exactly the OR of its operands' — which lets
 # has-null tiles be forced partial instead of tracked per row on full tiles
@@ -493,6 +495,18 @@ class ZoneEvaluator:
                 if da.op in ("sum", "avg"):
                     ts = _tile_sum(arr2, max_abs if bare else 0)
                     carries.append((counts, seg(jnp.where(wf, ts, 0))))
+                elif da.op == "var_pop":
+                    # sumsq rides f64 (the CPU state's own dtype), fused
+                    # square + same-dtype tile sum — vectorizes like the
+                    # pure passes because nothing widens inside the reduce
+                    ts = _tile_sum(arr2, max_abs if bare else 0)
+                    f2 = arr2.astype(jnp.float64)
+                    tsq = (f2 * f2).sum(axis=1)
+                    carries.append((
+                        counts,
+                        seg(jnp.where(wf, ts, 0)),
+                        seg(jnp.where(wf, tsq, 0.0)),
+                    ))
                 else:  # min / max — same-dtype tile reduce, then widen T-wise
                     red = (arr2.min(axis=1) if da.op == "min" else arr2.max(axis=1)).astype(jnp.int64)
                     info = np.iinfo(np.int64)
@@ -561,6 +575,14 @@ class ZoneEvaluator:
                 elif da.op in ("sum", "avg"):
                     vals = jnp.where(live, data, 0)
                     carries.append((cnt, seg(tile_red(vals, jnp.sum))))
+                elif da.op == "var_pop":
+                    vals = jnp.where(live, data, 0)
+                    f = jnp.where(live, data.astype(jnp.float64), 0.0)
+                    carries.append((
+                        cnt,
+                        seg(tile_red(vals, jnp.sum)),
+                        seg(tile_red(f * f, jnp.sum)),
+                    ))
                 else:
                     info = np.iinfo(np.int64)
                     ident = info.max if da.op == "min" else info.min
@@ -682,6 +704,8 @@ def _merge_states(device_aggs, a, b):
             carries.append((cnt,))
         elif da.op in ("sum", "avg"):
             carries.append((cnt, ca[1] + cb[1]))
+        elif da.op == "var_pop":
+            carries.append((cnt, ca[1] + cb[1], ca[2] + cb[2]))
         else:
             merge = jnp.minimum if da.op == "min" else jnp.maximum
             carries.append((cnt, merge(ca[1], cb[1])))
