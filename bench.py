@@ -423,18 +423,20 @@ def _op_topn(req, state):
     }
 
 
-def _filter_dag(kind: str):
+def _filter_dag(kind: str, limit: int = 100_000):
     """ONE definition of the BASELINE config 1-2 plans (the _topn_endpoint
     rule: device op and CPU oracle share the fixture so they can never
     drift apart).  The Limit bounds the response so the metric measures
     scan+mask plumbing, not gigabytes of response encoding (the reference's
-    criterion bench likewise consumes batches without a response)."""
+    criterion bench likewise consumes batches without a response); the
+    region-cache events tighten it further for the same reason — they
+    isolate the decode+MVCC cost the cache removes."""
     from tikv_tpu.copr.dag import DagRequest, Limit, Selection, TableScan
     from tikv_tpu.copr.rpn import call, col, const_int
 
     if kind == "scan":
         return DagRequest(executors=[
-            TableScan(TABLE_ID, _lineitem()), Limit(100_000),
+            TableScan(TABLE_ID, _lineitem()), Limit(limit),
         ])
     return DagRequest(executors=[
         TableScan(TABLE_ID, _lineitem()),
@@ -443,7 +445,7 @@ def _filter_dag(kind: str):
             call("gt", col(1), const_int(5)),
             call("ge", col(2), const_int(100000)),
         ]),
-        Limit(100_000),
+        Limit(limit),
     ])
 
 
@@ -466,6 +468,85 @@ def _op_filter(req, state):
     return {"ts": ts, "resp": resp.encode().hex()}
 
 
+def _op_region_cache(req, state):
+    """scan_cached / selection_cached events: endpoint-served scan and
+    selection DAGs over a real MVCC region, cold (region cache off — full
+    vectorized MVCC resolve + batch decode EVERY request, today's production
+    path) vs warm through the device-resident region column cache.  An
+    update delta rides the sequence to prove byte-identity survives the
+    incremental apply.  Both endpoints answer from the same engine, so any
+    divergence is a correctness failure, not noise."""
+    from tikv_tpu.copr.endpoint import CoprRequest, Endpoint
+    from tikv_tpu.copr.table import record_key, record_range
+    from tikv_tpu.storage.btree_engine import BTreeEngine
+    from tikv_tpu.storage.engine import CF_WRITE
+    from tikv_tpu.storage.kv import LocalEngine
+    from tikv_tpu.storage.txn_types import Key, Write, WriteType
+
+    n = req["rows"]
+    trials = req.get("trials", 3)
+    kvs = build_kvs(n, seed=11)
+    eng = BTreeEngine()
+    items = []
+    for rk, v in kvs:
+        items.append(
+            (Key.from_raw(rk).append_ts(20).encoded, Write(WriteType.PUT, 10, short_value=v).to_bytes())
+        )
+    eng.bulk_load(CF_WRITE, items)
+    ep_warm = Endpoint(LocalEngine(eng), enable_device=True)
+    ep_cold = Endpoint(LocalEngine(eng), enable_device=True, enable_region_cache=False)
+    ctx = {"region_id": 1, "region_epoch": (1, 1)}
+
+    limit = req.get("limit", 10_000)
+
+    def mk(kind, ts, apply_index):
+        return CoprRequest(103, _filter_dag(kind, limit=limit),
+                           [record_range(TABLE_ID)], ts,
+                           context=dict(ctx, apply_index=apply_index))
+
+    out = {"match": True}
+    for kind in ("scan", "selection"):
+        r_cold = ep_cold.handle_request(mk(kind, 100, 7))  # compile warmup
+        r_fill = ep_warm.handle_request(mk(kind, 100, 7))  # fills the image
+        out["match"] &= r_fill.data == r_cold.data
+        cold_ts, warm_ts = [], []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            rc = ep_cold.handle_request(mk(kind, 100, 7))
+            cold_ts.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            rw = ep_warm.handle_request(mk(kind, 100, 7))
+            warm_ts.append(time.perf_counter() - t0)
+            out["match"] &= rw.data == rc.data
+        out[kind] = {
+            "cold_ts": cold_ts,
+            "warm_ts": warm_ts,
+            "outcome": rw.metrics.get("region_cache"),
+        }
+    # delta apply: update ~0.5% of rows at a later commit, bump apply_index
+    n_delta = max(n // 200, 1)
+    upd = build_kvs(n_delta, seed=12)
+    wb_items = []
+    for i, (_rk, v) in enumerate(upd):
+        rk = record_key(TABLE_ID, i * (n // n_delta))
+        wb_items.append(
+            (Key.from_raw(rk).append_ts(40).encoded, Write(WriteType.PUT, 30, short_value=v).to_bytes())
+        )
+    eng.bulk_load(CF_WRITE, wb_items)
+    delta_match = True
+    for kind in ("scan", "selection"):
+        rw = ep_warm.handle_request(mk(kind, 200, 8))
+        rc = ep_cold.handle_request(mk(kind, 200, 8))
+        delta_match &= rw.data == rc.data
+        out.setdefault("delta", {})[kind] = {
+            "outcome": rw.metrics.get("region_cache"),
+            "delta_rows": rw.metrics.get("region_cache_delta_rows"),
+        }
+    out["match"] = bool(out["match"] and delta_match)
+    out["stats"] = ep_warm.region_cache.stats.to_dict()
+    return out
+
+
 _OPS = {
     "build": _op_build,
     "warm": _op_warm,
@@ -474,6 +555,7 @@ _OPS = {
     "mvcc": _op_mvcc,
     "topn": _op_topn,
     "filter": _op_filter,
+    "region_cache": _op_region_cache,
 }
 
 
@@ -863,6 +945,32 @@ def main() -> None:
         # attested JSON distinguishes 'skipped' from 'not implemented'
         _mark("filter_skipped_no_parent_cache")
         results["filter_skipped"] = "no parent cache for the CPU oracle"
+
+    if os.environ.get("BENCH_REGION_CACHE", "1") != "0":
+        # region column cache events (ISSUE 1): cached scan/selection vs the
+        # per-request cold path over a real MVCC region, with a delta apply
+        # mid-sequence.  Auxiliary like mvcc/topn — infra failures don't zero
+        # the headline — but a byte mismatch is fatal.
+        try:
+            r = dev.call(
+                "region_cache",
+                rows=int(os.environ.get("BENCH_REGION_CACHE_ROWS", "200000")),
+            )
+            if not r["match"]:
+                _fail("REGION_CACHE_MISMATCH")
+            for kind in ("scan", "selection"):
+                cold_t = float(np.median(r[kind]["cold_ts"]))
+                warm_t = float(np.median(r[kind]["warm_ts"]))
+                results[f"{kind}_cached_cold_s"] = round(cold_t, 4)
+                results[f"{kind}_cached_s"] = round(warm_t, 4)
+                results[f"{kind}_cached_speedup"] = round(cold_t / warm_t, 2)
+                _mark(f"{kind}_cached", speedup=round(cold_t / warm_t, 2),
+                      outcome=r[kind]["outcome"])
+            results["region_cache_delta"] = r.get("delta")
+            results["region_cache_stats"] = r.get("stats")
+        except WorkerDied as e:
+            results["region_cache_error"] = str(e)[:200]
+            _mark("region_cache_error", err=str(e)[:120])
 
     if os.environ.get("BENCH_MVCC", "1") != "0":
         try:

@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Bench smoke: the region column cache must hold its win.
+
+Runs the mock-table region-cache configuration (bench.py's ``region_cache``
+op — endpoint-served scan/selection over a real MVCC region, cold vs cached,
+with a delta apply mid-sequence) on the CPU backend and FAILS when:
+
+* any cached response diverges byte-wise from the cold path, or
+* the cached-scan or cached-selection speedup regresses below the 2x floor
+  (ISSUE 1 acceptance: scan/selection must stay off the 1.0x floor).
+
+Exit code 0 = healthy; 1 = regression.  One JSON line on stdout either way,
+so CI logs stay grep-able:
+
+    python scripts/bench_smoke.py [--rows N] [--trials K]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+MIN_SPEEDUP = 2.0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=int(os.environ.get("SMOKE_ROWS", "60000")))
+    ap.add_argument("--trials", type=int, default=3)
+    args = ap.parse_args()
+
+    import bench
+
+    bench._force_cpu()
+    import numpy as np
+
+    r = bench._op_region_cache({"rows": args.rows, "trials": args.trials}, {})
+    out = {"rows": args.rows, "match": bool(r["match"])}
+    ok = r["match"]
+    for kind in ("scan", "selection"):
+        cold = float(np.median(r[kind]["cold_ts"]))
+        warm = float(np.median(r[kind]["warm_ts"]))
+        speedup = cold / warm
+        out[f"{kind}_cached_speedup"] = round(speedup, 2)
+        out[f"{kind}_outcome"] = r[kind]["outcome"]
+        if speedup < MIN_SPEEDUP:
+            ok = False
+            out[f"{kind}_regression"] = f"{speedup:.2f}x < {MIN_SPEEDUP}x floor"
+    out["delta"] = r.get("delta")
+    out["ok"] = bool(ok)
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

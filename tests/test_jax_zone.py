@@ -460,3 +460,36 @@ def test_zone_failure_falls_through_to_generic(monkeypatch):
     assert zone.failed >= 1 and "simulated" in zone.last_error
     assert ev.run(None, cache=CACHE).encode() == cpu.encode()
     assert calls["n"] >= 1
+
+
+def test_full_tile_program_shared_across_selection_constants():
+    """Distinct selection CONSTANTS must reuse one compiled full-tile
+    program: the full-tile fn never evaluates selection row-wise (the
+    classification arrives as w_full), so keying its cache on the full plan
+    signature churned the per-layout cache and recompiled identical XLA
+    (advisor round 5)."""
+    fix = mixed_table_kvs(6000, seed=7)
+    _cols, _kvs, cache = fix
+    consts = [3000, 4000, 5000, 6000]
+    for c in consts:
+        cpu, warm, ev = run_warm(
+            [
+                TableScan(TABLE_ID, fix[0]),
+                Selection([call("le", col(1), const_int(c))]),
+                Aggregation(group_by=[col(3)], agg_funcs=[AggDescriptor("sum", col(1))]),
+            ],
+            fix,
+        )
+        assert zone_served(ev)
+        assert warm.encode() == cpu.encode()
+    layout_fns = cache.blocks[0].device
+    for sig, entry in layout_fns.items():
+        if sig[0] == "zone_layout":
+            fns = entry.__dict__.get("_zone_fns", {})
+            full_keys = [k for k in fns if k[0] == "full"]
+            assert len(full_keys) == 1, full_keys  # shared across constants
+            partial_keys = [k for k in fns if k[0] == "partial"]
+            assert len(partial_keys) >= 2  # partial programs DO depend on constants
+            break
+    else:
+        raise AssertionError("no zone layout pinned")

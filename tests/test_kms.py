@@ -89,3 +89,30 @@ def test_rotate_master_via_kms(kms, tmp_path):
     dkm2 = DataKeyManager.open(
         KmsMasterKey.open(p, str(tmp_path / "wrapped-2.key")), dict_path)
     assert unseal(dkm2.by_id(kid_old), sealed) == b"pre-rotation"
+
+
+def test_master_key_file_hex_only_at_exact_key_length(tmp_path):
+    """Only a 64-char all-hex file decodes as hex (exactly 32 key bytes);
+    all-hex content of any other length is deliberate raw key material."""
+    from tikv_tpu.storage.encryption import MasterKey
+
+    hex64 = "ab" * 32
+    p = tmp_path / "k1"
+    p.write_text(hex64)
+    assert MasterKey.from_file(str(p)).key == MasterKey(bytes.fromhex(hex64)).key
+
+    # 32 ASCII-hex chars: a legitimate 32-byte raw key that HAPPENS to look
+    # like hex — must be used as raw bytes, not silently re-decoded
+    rawish = "deadbeef" * 4
+    p2 = tmp_path / "k2"
+    p2.write_text(rawish)
+    assert MasterKey.from_file(str(p2)).key == MasterKey(rawish.encode()).key
+
+    # near-hex at the exact key length: corrupted hex, loud error
+    import pytest
+
+    bad = "ab" * 31 + "zz"
+    p3 = tmp_path / "k3"
+    p3.write_text(bad)
+    with pytest.raises(ValueError, match="hex"):
+        MasterKey.from_file(str(p3))

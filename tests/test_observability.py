@@ -26,6 +26,11 @@ LAZY_SERIES = {
     "tikv_coprocessor_cache_hit_total",
     "tikv_coprocessor_batch_total",
     "tikv_coprocessor_batch_queries_total",
+    "tikv_coprocessor_region_cache_total",
+    "tikv_coprocessor_region_cache_delta_rows_total",
+    "tikv_coprocessor_region_cache_evict_total",
+    "tikv_coprocessor_region_cache_invalidate_total",
+    "tikv_coprocessor_region_cache_bytes",
     "tikv_gcworker_gc_tasks_total",
     "tikv_memory_usage_bytes",
     "tikv_raftstore_proposal_total",

@@ -1,0 +1,283 @@
+"""Region column cache: delta apply, invalidation, budget, fallbacks.
+
+The contract under test is the ISSUE 1 acceptance list: byte-identical
+DAGResponses across insert/update/delete deltas (vs a cold endpoint with the
+cache off), invalidation on real region epoch changes (a raft split), LRU
+eviction under a small byte budget, and the stale-``start_ts`` fallback.
+"""
+
+import numpy as np
+import pytest
+
+from copr_fixtures import PRODUCT_COLUMNS, TABLE_ID
+from fixtures import delete_committed, lock_key, put_committed
+
+from tikv_tpu.copr.dag import Aggregation, DagRequest, Limit, Selection, TableScan
+from tikv_tpu.copr.aggr import AggDescriptor
+from tikv_tpu.copr.endpoint import CoprRequest, Endpoint
+from tikv_tpu.copr.region_cache import RegionColumnCache, notify_region_epoch_change
+from tikv_tpu.copr.rpn import call, col, const_int
+from tikv_tpu.copr.rowv2 import encode_row_v2
+from tikv_tpu.copr.table import encode_row, record_key, record_range
+from tikv_tpu.storage.btree_engine import BTreeEngine
+from tikv_tpu.storage.kv import LocalEngine
+
+NON_HANDLE = [c for c in PRODUCT_COLUMNS if not c.is_pk_handle]
+N_ROWS = 64
+
+
+def _engine(n=N_ROWS, v2=False, table_id=TABLE_ID):
+    eng = BTreeEngine()
+    enc = encode_row_v2 if v2 else encode_row
+    for i in range(n):
+        name = [b"apple", b"banana", b"cherry"][i % 3]
+        val = enc(NON_HANDLE, [name, i * 7 % 23, 100 + i])
+        put_committed(eng, record_key(table_id, i), val, 90, 100)
+    return eng
+
+
+def _scan_dag(table_id=TABLE_ID):
+    return DagRequest(executors=[TableScan(table_id, PRODUCT_COLUMNS), Limit(1 << 20)])
+
+
+def _sel_dag(table_id=TABLE_ID):
+    return DagRequest(executors=[
+        TableScan(table_id, PRODUCT_COLUMNS),
+        Selection([call("gt", col(2), const_int(5))]),
+    ])
+
+
+def _agg_dag(table_id=TABLE_ID):
+    aggs = [AggDescriptor("sum", col(2)), AggDescriptor("count", None)]
+    return DagRequest(executors=[
+        TableScan(table_id, PRODUCT_COLUMNS), Aggregation([col(1)], aggs),
+    ])
+
+
+def _req(dag, ts, apply_index, region_id=7, epoch=(1, 1), table_id=TABLE_ID):
+    return CoprRequest(
+        103, dag, [record_range(table_id)], ts,
+        context={"region_id": region_id, "region_epoch": epoch,
+                 "apply_index": apply_index},
+    )
+
+
+def _pair(eng, **kw):
+    warm = Endpoint(LocalEngine(eng), enable_device=True, **kw)
+    cold = Endpoint(LocalEngine(eng), enable_device=True, enable_region_cache=False)
+    return warm, cold
+
+
+@pytest.mark.parametrize("v2", [False, True], ids=["rowv1", "rowv2"])
+@pytest.mark.parametrize("mk_dag", [_scan_dag, _sel_dag, _agg_dag],
+                         ids=["scan", "selection", "aggregation"])
+def test_delta_apply_byte_identical(v2, mk_dag):
+    """Insert + update + delete between two apply_indexes must serve the
+    exact cold-decode bytes through the incremental delta path."""
+    eng = _engine(v2=v2)
+    warm, cold = _pair(eng)
+
+    r0 = warm.handle_request(_req(mk_dag(), 200, 3))
+    assert r0.metrics["region_cache"] == "miss"
+    assert r0.data == cold.handle_request(_req(mk_dag(), 200, 3)).data
+    r1 = warm.handle_request(_req(mk_dag(), 200, 3))
+    assert r1.metrics["region_cache"] == "hit"
+    assert r1.data == r0.data
+
+    enc = encode_row_v2 if v2 else encode_row
+    # update 2 rows (one with a NEW dictionary value), insert 1, delete 1
+    put_committed(eng, record_key(TABLE_ID, 5),
+                  enc(NON_HANDLE, [b"durian", 999, 5]), 210, 220)
+    put_committed(eng, record_key(TABLE_ID, 11),
+                  enc(NON_HANDLE, [b"apple", 1000, 6]), 210, 220)
+    put_committed(eng, record_key(TABLE_ID, 500),
+                  enc(NON_HANDLE, [b"elderberry", 7, 1]), 210, 220)
+    delete_committed(eng, record_key(TABLE_ID, 0), 210, 220)
+
+    r2 = warm.handle_request(_req(mk_dag(), 300, 4))
+    assert r2.metrics["region_cache"] == "delta"
+    assert r2.metrics["region_cache_delta_rows"] == 4
+    assert r2.data == cold.handle_request(_req(mk_dag(), 300, 4)).data
+    # and the post-delta image keeps serving hits byte-identically
+    r3 = warm.handle_request(_req(mk_dag(), 300, 4))
+    assert r3.metrics["region_cache"] == "hit"
+    assert r3.data == r2.data
+
+
+def test_update_only_delta_scatters_into_pinned_arrays():
+    """An update-only delta takes the in-place scatter path (device pins are
+    patched, not dropped) and later requests stay byte-identical."""
+    eng = _engine()
+    warm, cold = _pair(eng)
+    warm.handle_request(_req(_agg_dag(), 200, 3))  # build + pin
+    warm.handle_request(_req(_agg_dag(), 200, 3))  # warm agg pins stacked arrays
+    for i in (2, 9, 30):
+        put_committed(eng, record_key(TABLE_ID, i),
+                      encode_row(NON_HANDLE, [b"banana", 4, 4]), 210, 220)
+    r = warm.handle_request(_req(_agg_dag(), 300, 4))
+    assert r.metrics["region_cache"] == "delta"
+    assert r.data == cold.handle_request(_req(_agg_dag(), 300, 4)).data
+    # host blocks and device pins agree on the next pure hit
+    r2 = warm.handle_request(_req(_sel_dag(), 300, 4))
+    assert r2.metrics["region_cache"] == "hit"
+    assert r2.data == cold.handle_request(_req(_sel_dag(), 300, 4)).data
+
+
+def test_stale_start_ts_falls_back():
+    """A read below the image's snapshot ts must not serve from the image
+    (it would see too-new data) — it reports 'stale' and answers through
+    the per-request path, byte-identical to the cache-off endpoint."""
+    eng = _engine()
+    warm, cold = _pair(eng)
+    warm.handle_request(_req(_scan_dag(), 200, 3))
+    put_committed(eng, record_key(TABLE_ID, 1),
+                  encode_row(NON_HANDLE, [b"apple", 1, 1]), 110, 120)
+    r = warm.handle_request(_req(_scan_dag(), 150, 4))
+    assert r.metrics["region_cache"] == "stale"
+    assert r.data == cold.handle_request(_req(_scan_dag(), 150, 4)).data
+    assert warm.region_cache.stats.stale == 1
+
+
+def test_epoch_change_in_context_invalidates():
+    eng = _engine()
+    warm, cold = _pair(eng)
+    warm.handle_request(_req(_scan_dag(), 200, 3, epoch=(1, 1)))
+    assert len(warm.region_cache) == 1
+    # a split bumped the version: same region id, new epoch
+    r = warm.handle_request(_req(_scan_dag(), 300, 4, epoch=(1, 2)))
+    assert r.metrics["region_cache"] == "miss"
+    assert warm.region_cache.stats.invalidations == 1
+    assert r.data == cold.handle_request(_req(_scan_dag(), 300, 4, epoch=(1, 2))).data
+
+
+def test_raft_split_invalidates_cache():
+    """A real region split through the raft apply path must invalidate the
+    cached images of both sides via the store.py epoch-change hook."""
+    from tikv_tpu.raft.cluster import FIRST_REGION_ID, Cluster
+
+    eng = _engine()
+    warm, _cold = _pair(eng)
+    warm.handle_request(_req(_scan_dag(), 200, 3, region_id=FIRST_REGION_ID))
+    assert len(warm.region_cache) == 1
+
+    c = Cluster(3)
+    c.run()
+    c.must_put(b"a", b"1")
+    c.must_put(b"z", b"2")
+    c.split_region(FIRST_REGION_ID, b"m")
+    assert len(warm.region_cache) == 0
+    assert warm.region_cache.stats.invalidations >= 1
+
+
+def test_notify_hook_is_region_scoped():
+    eng = _engine()
+    warm, _cold = _pair(eng)
+    warm.handle_request(_req(_scan_dag(), 200, 3, region_id=7))
+    notify_region_epoch_change(8)  # some other region
+    assert len(warm.region_cache) == 1
+    notify_region_epoch_change(7, reason="merge")
+    assert len(warm.region_cache) == 0
+
+
+def test_lru_eviction_under_byte_budget():
+    """Three regions under a budget that fits ~one image: LRU evicts, the
+    endpoint keeps answering correctly, and nothing OOMs."""
+    eng = _engine(n=128)
+    small = RegionColumnCache(byte_budget=1 << 14, max_regions=8)
+    warm = Endpoint(LocalEngine(eng), enable_device=True, region_cache=small)
+    cold = Endpoint(LocalEngine(eng), enable_device=True, enable_region_cache=False)
+    for rid in (1, 2, 3):
+        r = warm.handle_request(_req(_scan_dag(), 200, 3, region_id=rid))
+        assert r.data == cold.handle_request(_req(_scan_dag(), 200, 3)).data
+    assert small.stats.evictions >= 2
+    assert small.total_bytes() <= (1 << 14) or len(small) == 1
+    # the survivor still serves hits
+    r = warm.handle_request(_req(_scan_dag(), 200, 3, region_id=3))
+    assert r.metrics["region_cache"] == "hit"
+
+
+def test_region_too_big_for_budget_degrades():
+    eng = _engine(n=128)
+    tiny = RegionColumnCache(byte_budget=64, max_regions=8)
+    warm = Endpoint(LocalEngine(eng), enable_device=True, region_cache=tiny)
+    cold = Endpoint(LocalEngine(eng), enable_device=True, enable_region_cache=False)
+    r = warm.handle_request(_req(_scan_dag(), 200, 3))
+    assert r.metrics["region_cache"] == "too_big"
+    assert len(tiny) == 0  # never pinned
+    assert r.data == cold.handle_request(_req(_scan_dag(), 200, 3)).data
+
+
+def test_locked_range_still_blocks_cached_reads():
+    """A pending lock below the read ts must surface through the cached path
+    exactly like the scanners (the CPU fallback re-raises it)."""
+    eng = _engine()
+    warm, cold = _pair(eng)
+    warm.handle_request(_req(_scan_dag(), 200, 3))
+    lock_key(eng, record_key(TABLE_ID, 4), record_key(TABLE_ID, 4), 250)
+    with pytest.raises(Exception, match="locked"):
+        warm.handle_request(_req(_scan_dag(), 300, 4))
+    with pytest.raises(Exception, match="locked"):
+        cold.handle_request(_req(_scan_dag(), 300, 4))
+
+
+def test_counters_and_tracker_exposure():
+    from tikv_tpu.util.metrics import REGISTRY
+
+    eng = _engine()
+    warm, _cold = _pair(eng)
+    before = REGISTRY.counter(
+        "tikv_coprocessor_region_cache_total", "").get(outcome="hit")
+    r0 = warm.handle_request(_req(_scan_dag(), 200, 3))
+    r1 = warm.handle_request(_req(_scan_dag(), 200, 3))
+    assert r0.metrics["region_cache"] == "miss"
+    assert r1.metrics["region_cache"] == "hit"
+    assert REGISTRY.counter(
+        "tikv_coprocessor_region_cache_total", "").get(outcome="hit") == before + 1
+    st = warm.region_cache.stats.to_dict()
+    assert st["hits"] >= 1 and st["misses"] >= 1 and st["bytes_pinned"] > 0
+
+
+def test_missing_context_is_off():
+    eng = _engine()
+    warm, cold = _pair(eng)
+    req = CoprRequest(103, _scan_dag(), [record_range(TABLE_ID)], 200,
+                      context={"region_id": 7})  # no epoch / apply_index
+    r = warm.handle_request(req)
+    assert "region_cache" not in r.metrics
+    assert r.data == cold.handle_request(req).data
+    assert len(warm.region_cache) == 0
+
+
+def test_delta_update_with_large_value_resolves_exactly():
+    """A changed key whose new value lives in CF_DEFAULT (no inline short
+    value) must re-resolve through the exact path — regression for the
+    encoded-key double-encoding that misclassified such updates as deletes."""
+    from fixtures import put_committed_large
+
+    eng = _engine()
+    warm, cold = _pair(eng)
+    warm.handle_request(_req(_scan_dag(), 200, 3))
+    # a real encoded row forced into CF_DEFAULT (no inline short value)
+    row = encode_row(NON_HANDLE, [b"fig", 77, 88])
+    put_committed_large(eng, record_key(TABLE_ID, 9), row, 210, 220)
+    r = warm.handle_request(_req(_scan_dag(), 300, 4))
+    assert r.metrics["region_cache"] == "delta"
+    assert r.metrics["region_cache_delta_rows"] == 1
+    assert r.data == cold.handle_request(_req(_scan_dag(), 300, 4)).data
+
+
+def test_delta_rollback_pick_resolves_older_version():
+    """A rollback record newer than the cached fingerprint must re-resolve
+    to the surviving older version, not delete the row."""
+    from fixtures import rollback
+
+    eng = _engine()
+    warm, cold = _pair(eng)
+    warm.handle_request(_req(_scan_dag(), 200, 3))
+    rollback(eng, record_key(TABLE_ID, 9), 150)
+    r = warm.handle_request(_req(_scan_dag(), 300, 4))
+    assert r.metrics["region_cache"] == "delta"
+    assert r.data == cold.handle_request(_req(_scan_dag(), 300, 4)).data
+    # row 9 must still be present (update fingerprint, keep old value)
+    r2 = warm.handle_request(_req(_sel_dag(), 300, 4))
+    assert r2.data == cold.handle_request(_req(_sel_dag(), 300, 4)).data

@@ -167,3 +167,63 @@ def test_encode_bin_clamps_when_widening_overflows():
     raw = d.encode_bin(65, 2)  # widening to frac=2 would need 82 digits
     back, _ = MyDecimal.decode_bin(raw, 65, 2)
     assert back.to_string() == "9" * 63 + "." + "99"
+
+
+# ---------------------------------------------------------------------------
+# Grouped mixed-layout batch decode
+# ---------------------------------------------------------------------------
+
+
+def _col_values(cols, schema):
+    out = []
+    n = len(cols[0])
+    for r in range(n):
+        row = []
+        for ci, info in enumerate(schema):
+            c = cols[ci]
+            if c.nulls[r]:
+                row.append(None)
+            elif c.is_dict_encoded:
+                row.append(c.dictionary[c.data[r]])
+            else:
+                row.append(c.data[r])
+        out.append(row)
+    return out
+
+
+def test_grouped_decode_mixed_layouts_matches_per_row():
+    """Rows with different layouts (NULL patterns, value widths, varchar
+    lengths) must decode identically to the per-row walk, in row order."""
+    schema = _schema()
+    rows = [
+        [7, 1.5, b"xy", 1234, 2],
+        [1 << 40, 2.5, b"longer-string", 5678, 1],  # wider int, longer bytes
+        [None, 3.5, b"xy", 91, 2],                  # NULL int
+        [7, 1.5, b"xy", 1234, 2],                   # same layout as row 0
+        [3, None, None, None, 1],                   # mostly NULL
+        [1 << 40, 2.5, b"longer-string", 5678, 1],  # same layout as row 1
+    ]
+    encoded = [encode_row_v2(schema[1:], r) for r in rows]
+    cols = decode_rows_v2(schema, encoded)
+    per_row = [decode_rows_v2(schema, [e]) for e in encoded]
+    for r, cols1 in enumerate(per_row):
+        got = _col_values(cols, schema)[r]
+        want = _col_values(cols1, schema)[0]
+        assert got[1:] == want[1:], f"row {r}"
+
+
+def test_grouped_decode_layout_explosion_falls_back():
+    """One distinct layout per row (> _MAX_LAYOUT_GROUPS) must still decode
+    correctly through the slow path."""
+    schema = [
+        ColumnInfo(1, FieldType.int64(), is_pk_handle=True),
+        ColumnInfo(2, FieldType.varchar()),
+        ColumnInfo(3, FieldType.int64()),
+    ]
+    rows = [[b"x" * (i + 1), i] for i in range(40)]
+    encoded = [encode_row_v2(schema[1:], r) for r in rows]
+    cols = decode_rows_v2(schema, encoded)
+    vals = _col_values(cols, schema)
+    for i in range(40):
+        assert vals[i][1] == b"x" * (i + 1)
+        assert vals[i][2] == i

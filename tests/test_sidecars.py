@@ -626,3 +626,33 @@ def test_ttl_sweep_never_destroys_fresh_writes():
     removed = store.raw_delete_if_expired([b"race-k"], now=later)
     assert removed == 0
     assert store.raw_get(b"race-k", now=later + 1) == b"fresh"
+
+
+def test_check_leader_single_replica_self_vote():
+    """RPC-mode leadership confirmation with NO peer stores (single-replica
+    region, or all other replicas colocated): the self-vote alone is a
+    majority of one voter and the region must confirm — an empty fan-out
+    used to return nothing and stall read_progress forever."""
+    from types import SimpleNamespace
+
+    from tikv_tpu.sidecar.resolved_ts import ResolvedTsEndpoint
+
+    ep = ResolvedTsEndpoint(pd=None, store_id=1,
+                            check_leader_send=lambda sid, payload: None)
+    region = SimpleNamespace(peers=[SimpleNamespace(store_id=1, role="voter")])
+    peer = SimpleNamespace(region=region, node=SimpleNamespace(term=3, id=11))
+    confirmed = ep._check_leader_round({42: peer}, {42: peer})
+    assert confirmed == {42}
+
+    # two-replica region with the peer store unreachable: 1 of 2 votes is
+    # NOT a majority — must stay unconfirmed (the fix only tallies, it must
+    # not loosen the quorum rule)
+    region2 = SimpleNamespace(peers=[
+        SimpleNamespace(store_id=1, role="voter"),
+        SimpleNamespace(store_id=2, role="voter"),
+    ])
+    peer2 = SimpleNamespace(region=region2, node=SimpleNamespace(term=3, id=11))
+    ep2 = ResolvedTsEndpoint(pd=None, store_id=1,
+                             check_leader_send=lambda sid, payload: None)
+    confirmed2 = ep2._check_leader_round({42: peer2}, {42: peer2})
+    assert confirmed2 == set()

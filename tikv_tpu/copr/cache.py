@@ -70,6 +70,88 @@ class ColumnBlockCache:
                 block.device.pop(next(iter(block.device)))
             return block.device[sig]
 
+    def nbytes(self) -> int:
+        """Host-side byte footprint of the decoded blocks (device pins cost
+        about the same again per pinned signature; budgets use this figure)."""
+        total = 0
+        for b in self.blocks:
+            for c in b.cols:
+                data = np.asarray(c.data)
+                total += data.nbytes if data.dtype != object else 32 * len(data)
+                total += np.asarray(c.nulls).nbytes
+                if c.dictionary is not None:
+                    total += 64 * len(c.dictionary)
+        return total
+
+    def drop_device(self) -> None:
+        """Unpin every device copy; host blocks stay.  The next query
+        re-transfers from host (no decode)."""
+        with self._mu:
+            for b in self.blocks:
+                b.device.clear()
+
+    def scatter_update(self, updates: dict) -> None:
+        """Patch pinned device arrays in place after an in-place host update.
+
+        ``updates``: block_idx -> (row_positions int array, {col_idx:
+        (values ndarray, nulls ndarray)}).  Host column arrays must already
+        hold the new values.  Understands the two pinned layouts the
+        evaluators build — the per-cache stacked arrays and per-block column
+        lists — and patches them with ``.at[].set`` scatters (a device-side
+        op; the base arrays never round-trip to host).  Any other signature
+        (zone layouts, nvoff is kept — row counts are unchanged) is dropped
+        so it rebuilds from the updated host blocks."""
+        with self._mu:
+            for bi, blk in enumerate(self.blocks):
+                upd = updates.get(bi)
+                for sig in list(blk.device):
+                    kind = sig[0]
+                    if kind == "nvoff":
+                        continue  # in-place updates never change row counts
+                    if kind == "stacked":
+                        blk.device[sig] = self._patch_stacked(blk.device[sig], sig, updates)
+                    elif isinstance(kind, tuple):
+                        if upd is None:
+                            continue
+                        blk.device[sig] = self._patch_block(blk.device[sig], sig, upd)
+                    else:
+                        blk.device.pop(sig)
+
+    @staticmethod
+    def _patch_stacked(entry, sig, updates):
+        """sig = ("stacked", ship_cols, nullable, block_rows); entry =
+        (data_tuple[(B, rows)] per ship col, nulls_tuple per nullable col)."""
+        _, ship_cols, nullable, _rows = sig
+        data, nulls = entry
+        data = list(data)
+        nulls = list(nulls)
+        for bi, (pos, cols) in updates.items():
+            for ci, (vals, nl) in cols.items():
+                if ci in ship_cols:
+                    j = ship_cols.index(ci)
+                    vals = np.asarray(vals).astype(data[j].dtype, copy=False)
+                    data[j] = data[j].at[bi, pos].set(vals)
+                if ci in nullable:
+                    j = nullable.index(ci)
+                    nulls[j] = nulls[j].at[bi, pos].set(np.asarray(nl))
+        return tuple(data), tuple(nulls)
+
+    @staticmethod
+    def _patch_block(entry, sig, upd):
+        """sig = (device_cols, nullable_cols, block_rows); entry =
+        ([data per device col], [nulls per nullable col]) for ONE block."""
+        dev_cols, nullable, _rows = sig
+        pos, cols = upd
+        data, nulls = list(entry[0]), list(entry[1])
+        for ci, (vals, nl) in cols.items():
+            if ci in dev_cols:
+                j = dev_cols.index(ci)
+                data[j] = data[j].at[pos].set(np.asarray(vals))
+            if ci in nullable:
+                j = nullable.index(ci)
+                nulls[j] = nulls[j].at[pos].set(np.asarray(nl))
+        return data, nulls
+
 
 class CopCache:
     """Top-level cache registry keyed by (region_id, range, version)."""

@@ -57,11 +57,24 @@ class Endpoint:
         slow_log=None,
         mesh=None,
         feature_gate=None,
+        enable_region_cache: bool = True,
+        region_cache=None,
     ):
         from .tracker import SlowLog
 
         self.engine = engine
         self.enable_device = enable_device
+        # device-resident per-region column cache with delta apply (region
+        # requests carrying region_epoch + apply_index in the context skip
+        # scan+decode entirely on repeat reads); None = disabled
+        if region_cache is not None:
+            self.region_cache = region_cache
+        elif enable_region_cache:
+            from .region_cache import RegionColumnCache
+
+            self.region_cache = RegionColumnCache()
+        else:
+            self.region_cache = None
         # version-gated rollout (feature_gate.rs:14): the gate is the hard
         # floor under the enable_device/mesh/batch-fusion switches — a
         # mixed-version cluster keeps device serving off until every store
@@ -129,7 +142,9 @@ class Endpoint:
         if use_device:
             cache = None
             try:
-                cache = self._block_cache_for(req)
+                cache, rc_outcome = self._region_cache_for(req, snap, tracker)
+                if cache is None:
+                    cache = self._block_cache_for(req)
                 # mesh path only when no block cache is in play: the cache's
                 # HBM-pinned entries are a single-device structure
                 ev = self._mesh_evaluator_for(req.dag) if cache is None else None
@@ -142,9 +157,11 @@ class Endpoint:
                 scanned = src.stats.write.processed_keys if src is not None else 0
                 m = tracker.on_finish(scanned_keys=scanned, from_device=True)
                 self.slow_log.observe(tracker)
+                from_cache = (cache is not None and cache.filled and src is None
+                              and rc_outcome not in ("miss", "too_big"))
                 return CoprResponse(
                     resp.encode(), from_device=True,
-                    from_cache=cache is not None and cache.filled and src is None,
+                    from_cache=from_cache,
                     metrics=m.to_dict(),
                 )
             except Exception as exc:
@@ -408,6 +425,38 @@ class Endpoint:
             while len(self._mesh_runners) > 16:
                 self._mesh_runners.pop(next(iter(self._mesh_runners)))
         return runner
+
+    def _region_cache_for(self, req: CoprRequest, snap, tracker):
+        """Resolve the request against the region column cache.  Returns
+        (filled block cache | None, outcome) and stamps the tracker with the
+        outcome + delta size so responses carry the cache behavior."""
+        if self.region_cache is None:
+            return None, ""
+        from .dag import TableScan
+
+        execs = req.dag.executors if req.dag is not None else []
+        if not execs or type(execs[0]) is not TableScan:
+            return None, ""
+        # a raft RegionSnapshot carries its own identity and data version —
+        # serving paths need no context plumbing; explicit context still wins
+        # (tests, embedded use over plain engines)
+        context = dict(req.context or {})
+        region = getattr(snap, "region", None)
+        if region is not None:
+            context.setdefault("region_id", region.id)
+            context.setdefault(
+                "region_epoch", (region.epoch.conf_ver, region.epoch.version)
+            )
+        apply_index = getattr(snap, "apply_index", None)
+        if apply_index is not None:
+            context.setdefault("apply_index", apply_index)
+        cache, outcome, delta_rows = self.region_cache.serve(
+            snap, context, execs[0].columns_info, req.ranges, req.start_ts
+        )
+        if outcome != "off":
+            tracker.metrics.region_cache = outcome
+            tracker.metrics.region_cache_delta_rows = delta_rows
+        return cache, outcome
 
     def _block_cache_for(self, req: CoprRequest):
         """Decoded-block cache, valid only while the region data is unchanged:

@@ -733,10 +733,11 @@ class JaxDagEvaluator:
             return self._mask_fn_cache
         sel_rpns = self.sel_rpns
         device_cols = self.device_cols
+        nullable = self.nullable_cols
         n_rows = self.block_rows
 
         def mask_fn(col_data, col_nulls, valid):
-            cols = {i: (col_data[j], col_nulls[j]) for j, i in enumerate(device_cols)}
+            cols = _build_cols(device_cols, nullable, col_data, col_nulls, n_rows)
             active = valid
             for rpn in sel_rpns:
                 d, nl = eval_rpn(rpn, cols, n_rows, xp=jnp)
@@ -1390,15 +1391,15 @@ class JaxDagEvaluator:
         host compacts + encodes (row encoding is host work either way)."""
         remaining = self.plan.limit.limit if self.plan.limit else None
         sel_rpns = self.sel_rpns
-        device_cols = self.device_cols
         mask_jit = self._build_mask_fn()
         enc = ResponseEncoder(self.dag.chunk_rows)
         for cols, n_valid in self._blocks(source):
             valid = np.zeros(self.block_rows, dtype=bool)
             valid[:n_valid] = True
             if sel_rpns:
-                col_data = [self._pad(cols[i].data) for i in device_cols]
-                col_nulls = [self._pad(cols[i].nulls, True) for i in device_cols]
+                # served from the block cache's HBM-pinned arrays when one is
+                # active — warm selections ship only the valid mask per block
+                col_data, col_nulls = self._device_block(cols, n_valid)
                 mask = np.asarray(mask_jit(col_data, col_nulls, valid))
             else:
                 mask = valid

@@ -126,6 +126,18 @@ def _plan_sig(ev) -> tuple:
     they share one cached jitted fn per layout instead of pinning one each."""
     return (
         tuple(_rpn_sig(r) for r in ev.sel_rpns),
+        _agg_sig(ev),
+    )
+
+
+def _agg_sig(ev) -> tuple:
+    """The aggregate/grouping part of the plan signature ALONE.  The
+    full-tile program never evaluates selection row-wise — selection lives
+    entirely in the tile classification, which arrives as the w_full
+    argument — so keying its cache on the full _plan_sig made every distinct
+    selection constant recompile an identical XLA program and churn the
+    32-entry per-layout cache."""
+    return (
         tuple((da.op, _rpn_sig(da.rpn)) for da in ev.device_aggs),
         bool(ev.group_rpns),
     )
@@ -450,7 +462,7 @@ class ZoneEvaluator:
         # evaluators share one compiled program (the endpoint's evaluator
         # LRU churns instances), and the dict is bounded.
         fns = _layout_fn_cache(layout)
-        key = ("full", _plan_sig(self.ev), capacity)
+        key = ("full", _agg_sig(self.ev), capacity)
         if key in fns:
             return fns[key]
         ev = self.ev

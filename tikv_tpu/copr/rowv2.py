@@ -244,13 +244,19 @@ def is_v2_row(raw: bytes) -> bool:
     return bool(raw) and raw[0] == CODEC_VERSION
 
 
+_MAX_LAYOUT_GROUPS = 32
+
+
 def decode_rows_v2(schema: list[ColumnInfo], row_values: list[bytes]) -> list[Column]:
     """Decode a block of v2 rows into Columns (handle columns left zeroed).
 
     Fast path: every row shares the first row's exact header bytes (ids +
     offsets) ⇒ each cell lives at one fixed [start, end) for the whole block,
     so fixed-width columns decode as a reshape + byte-slice with no per-row
-    Python.  Mixed layouts fall back to the per-row walk.
+    Python.  Mixed layouts are *grouped* by identical (length, header) and
+    each group fast-decodes the same way (delta blocks and mid-migration
+    blocks typically hold a handful of layouts, not one per row); only a
+    pathological layout explosion takes the per-row walk.
     """
     n = len(row_values)
     first = RowSliceV2(row_values[0])
@@ -262,7 +268,50 @@ def decode_rows_v2(schema: list[ColumnInfo], row_values: list[bytes]) -> list[Co
     )
     if same:
         return _fast_decode(schema, first, row_values, n)
-    return _slow_decode(schema, row_values, n)
+    return _grouped_decode(schema, row_values, n)
+
+
+def _grouped_decode(schema, row_values, n) -> list[Column]:
+    """Partition rows into identical-layout groups and fast-decode each.
+
+    Grouping is vectorized per byte-length bucket: rows of one length stack
+    into a byte matrix, the first unclaimed row's header selects every row
+    matching it with one matrix compare, and the group decodes via
+    ``_fast_decode``.  Output columns stitch back into original row order.
+    """
+    lens = np.fromiter((len(rv) for rv in row_values), dtype=np.int64, count=n)
+    groups: list[tuple[np.ndarray, list[Column]]] = []  # (orig indices, cols)
+    n_groups = 0
+    for ln in np.unique(lens):
+        idx = np.flatnonzero(lens == ln)
+        sub = [row_values[i] for i in idx]
+        mat = np.frombuffer(b"".join(sub), dtype=np.uint8).reshape(len(sub), int(ln))
+        todo = np.arange(len(sub))
+        while len(todo):
+            n_groups += 1
+            if n_groups > _MAX_LAYOUT_GROUPS:
+                return _slow_decode(schema, row_values, n)
+            lead = sub[todo[0]]
+            h = RowSliceV2(lead).header_len()
+            match = (mat[todo, :h] == np.frombuffer(lead[:h], dtype=np.uint8)).all(axis=1)
+            take = todo[match]
+            grp_rows = [sub[i] for i in take]
+            cols = (
+                _fast_decode(schema, RowSliceV2(lead), grp_rows, len(grp_rows))
+                if len(grp_rows) > 1
+                else _slow_decode(schema, grp_rows, 1)
+            )
+            groups.append((idx[take], cols))
+            todo = todo[~match]
+    order = np.empty(n, dtype=np.int64)
+    pos = 0
+    for gidx, _cols in groups:
+        order[gidx] = pos + np.arange(len(gidx))
+        pos += len(gidx)
+    out: list[Column] = []
+    for ci in range(len(schema)):
+        out.append(Column.concat([cols[ci] for _gidx, cols in groups]).take(order))
+    return out
 
 
 def _fast_decode(schema, first: RowSliceV2, row_values, n) -> list[Column]:

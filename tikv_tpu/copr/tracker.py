@@ -23,9 +23,14 @@ class TrackedMetrics:
     total_s: float = 0.0
     scanned_keys: int = 0
     from_device: bool = False
+    # region column cache outcome for this request ("" = cache not consulted;
+    # hit / miss / delta / stale / uncacheable / too_big / off) and how many
+    # rows the incremental delta apply re-decoded
+    region_cache: str = ""
+    region_cache_delta_rows: int = 0
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "schedule_wait_ms": round(self.schedule_wait_s * 1000, 3),
             "snapshot_ms": round(self.snapshot_s * 1000, 3),
             "handle_ms": round(self.handle_s * 1000, 3),
@@ -33,6 +38,10 @@ class TrackedMetrics:
             "scanned_keys": self.scanned_keys,
             "from_device": self.from_device,
         }
+        if self.region_cache:
+            d["region_cache"] = self.region_cache
+            d["region_cache_delta_rows"] = self.region_cache_delta_rows
+        return d
 
 
 class Tracker:

@@ -69,6 +69,14 @@ inline void chacha_block(const uint8_t key[32], const uint8_t nonce[12],
 // XOR `len` bytes at absolute file offset `off` with the (key, nonce)
 // keystream.  Counter 0 corresponds to file offset 0; any suffix/slice of a
 // file decrypts independently.
+//
+// The RFC 7539 block counter is 32 bits, which runs out at 2^32 blocks =
+// 256 GiB — past that a bare truncation would REUSE the first keystream
+// blocks (two-time pad).  XChaCha-style, the high 32 bits of the 64-bit
+// block index fold into the first nonce word instead: offsets below the
+// boundary are byte-identical to the plain construction (high bits are 0),
+// and every 256 GiB segment beyond it runs under a distinct effective
+// nonce, so the keystream never repeats within a file.
 inline void xor_at(const uint8_t key[32], const uint8_t nonce[12],
                    uint64_t off, uint8_t* buf, size_t len) {
   uint8_t ks[64];
@@ -76,7 +84,18 @@ inline void xor_at(const uint8_t key[32], const uint8_t nonce[12],
   while (done < len) {
     uint64_t block = (off + done) / 64;
     size_t skip = (off + done) % 64;
-    chacha_block(key, nonce, static_cast<uint32_t>(block), ks);
+    uint32_t hi = static_cast<uint32_t>(block >> 32);
+    if (hi == 0) {
+      chacha_block(key, nonce, static_cast<uint32_t>(block), ks);
+    } else {
+      uint8_t n2[12];
+      memcpy(n2, nonce, 12);
+      uint32_t w0;
+      memcpy(&w0, n2, 4);
+      w0 ^= hi;
+      memcpy(n2, &w0, 4);
+      chacha_block(key, n2, static_cast<uint32_t>(block), ks);
+    }
     size_t take = 64 - skip;
     if (take > len - done) take = len - done;
     for (size_t i = 0; i < take; i++) buf[done + i] ^= ks[skip + i];

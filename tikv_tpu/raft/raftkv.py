@@ -59,9 +59,15 @@ class _PrefixCursor(Cursor):
 
 
 class RegionSnapshot(Snapshot):
-    def __init__(self, engine_snapshot: Snapshot, region: Region):
+    def __init__(self, engine_snapshot: Snapshot, region: Region,
+                 apply_index: int | None = None):
         self._snap = engine_snapshot
         self.region = region
+        # data version this snapshot reflects (the peer's apply_index at
+        # snapshot time): the coprocessor's region column cache keys on
+        # (region epoch, apply_index) and reads both straight off the
+        # snapshot, so serving paths need no extra context plumbing
+        self.apply_index = apply_index
         self._lower = keys.data_key(region.start_key)
         self._upper = keys.data_end_key(region.end_key)
 
@@ -143,7 +149,8 @@ class RaftKv(Engine):
             # serve a snapshot missing committed data
             if read_ts > resolved or peer.apply_index < required_idx:
                 raise RaftKv.DataNotReadyError(peer.region.id, read_ts, resolved)
-            return RegionSnapshot(self.store.engine.snapshot(), peer.region.clone())
+            return RegionSnapshot(self.store.engine.snapshot(), peer.region.clone(),
+                                  apply_index=peer.apply_index)
         if not peer.node.is_leader():
             if ctx.get("replica_read") and peer.peer_id not in peer.node.witnesses:
                 # replica read (read.rs replica-read + ReplicaReadLockChecker
@@ -158,7 +165,8 @@ class RaftKv(Engine):
         # (apply_index, not node.applied — the pipeline may still be writing),
         # reads skip the ReadIndex round entirely
         if peer.node.lease_valid() and peer.apply_index >= peer.node.commit:
-            return RegionSnapshot(self.store.engine.snapshot(), peer.region.clone())
+            return RegionSnapshot(self.store.engine.snapshot(), peer.region.clone(),
+                                  apply_index=peer.apply_index)
         return self._read_index_barrier(peer)
 
     def _read_index_barrier(self, peer) -> RegionSnapshot:
@@ -177,7 +185,8 @@ class RaftKv(Engine):
         self._pump_until(done, peer.region.id)
         if err:
             raise err[0]
-        return RegionSnapshot(self.store.engine.snapshot(), peer.region.clone())
+        return RegionSnapshot(self.store.engine.snapshot(), peer.region.clone(),
+                                  apply_index=peer.apply_index)
 
     def write(self, ctx: dict | None, batch: WriteBatch) -> None:
         peer = self._peer_for_ctx(ctx)

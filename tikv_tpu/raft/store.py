@@ -23,6 +23,17 @@ from ..util.failpoint import fail_point
 from ..storage.engine import CF_DEFAULT, CF_LOCK, CF_RAFT, CF_WRITE, WriteBatch
 from ..util import codec, keys
 from ..util import logger as slog
+
+
+def _notify_region_cache(region_id: int, reason: str) -> None:
+    """Coprocessor region-column-cache invalidation on epoch change (split /
+    merge / conf change).  Lazy import: the raft layer must stay importable
+    without the coprocessor stack."""
+    try:
+        from ..copr.region_cache import notify_region_epoch_change
+    except ImportError:
+        return
+    notify_region_epoch_change(region_id, reason=reason)
 from .core import Entry, Message, MsgType, RaftNode, Role
 from .core import Snapshot as RaftSnapshot
 from .region import EpochError, KeyNotInRegionError, NotLeaderError, Peer as RegionPeer, Region, RegionEpoch
@@ -754,6 +765,7 @@ class StorePeer:
             self.merging = True
             self.region.epoch.version += 1
             self.store.persist_region(self.region, merging=True)
+            _notify_region_cache(self.region.id, "prepare_merge")
             self._ack(e, {"prepare_merge": True}, None)
             return cmd
         if admin is not None and admin[0] == "commit_merge":
@@ -1001,6 +1013,7 @@ class StorePeer:
                 return  # we left the config and erased our own state
             self.region.epoch.conf_ver += 1
             self._persist_conf_change_state(e)
+            _notify_region_cache(self.region.id, "conf_change")
             for p in to_tombstone:
                 self._send_tombstone(p)  # after the bump: epoch must exclude them
             return
@@ -1034,6 +1047,7 @@ class StorePeer:
                 return
         self.region.epoch.conf_ver += 1
         self._persist_conf_change_state(e)
+        _notify_region_cache(self.region.id, "conf_change")
         if removed_peer is not None and self.node.is_leader() and removed_peer.peer_id != self.peer_id:
             # the removed peer may never receive its own removal entry (the
             # leader stops replicating to it the moment it leaves the
@@ -1105,6 +1119,8 @@ class StorePeer:
         old.epoch.version += 1
         self.store.persist_region(old)
         self.store.create_peer(new_region)
+        _notify_region_cache(old.id, "split")
+        _notify_region_cache(new_region.id, "split")
         self.store.on_split(old, new_region)
 
     def _encode_raft_state(self) -> bytes:
@@ -1138,6 +1154,8 @@ class StorePeer:
         if src is not None:
             self.store.destroy_peer(source_id)
         self.store.erase_region_state(source_id)
+        _notify_region_cache(self.region.id, "merge")
+        _notify_region_cache(source_id, "merge")
         self.store.on_merge(self.region, source_id)
 
     def _catch_up_source(self, src: "StorePeer", source_commit: int, carried: list) -> None:

@@ -243,7 +243,16 @@ class ResolvedTsEndpoint:
         with self._mu:
             pending = dict(self._pending_progress)
         if not peer_stores:
-            return set()
+            # no peer stores to ask (single-replica regions, or every other
+            # replica lives on this store): the self-vote alone must still be
+            # tallied against each region's voter set, or single-replica
+            # regions never confirm and read_progress stalls in RPC mode
+            confirmed: set[int] = set()
+            for rid in candidates:
+                n_voters = max(len(voters[rid]), 1)
+                if len(votes[rid]) * 2 > n_voters:
+                    confirmed.add(rid)
+            return confirmed
 
         def one(sid):
             payload = {

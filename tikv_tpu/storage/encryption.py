@@ -105,10 +105,14 @@ class MasterKey:
 
     @classmethod
     def from_file(cls, path: str) -> "MasterKey":
-        """Hex text (master_key/file.rs format) or raw key bytes.  A file
-        that LOOKS like hex but fails to parse is an error, never silently
-        reinterpreted as raw bytes — a typo'd key file must not mint a store
-        under an unintended key."""
+        """Hex text (master_key/file.rs format) or raw key bytes.
+
+        The reference's file backend holds exactly one 256-bit key as 64 hex
+        chars, so ONLY that shape takes the hex interpretation — an all-hex
+        file of any other length is deliberate raw key material (e.g. a
+        16-byte binary key that happens to decode as ASCII hex) and must not
+        be silently re-encoded into a different key.  A 64-char near-hex
+        file is a corrupted hex key, not raw bytes: error loudly."""
         with open(path, "rb") as f:
             raw = f.read()
         try:
@@ -117,13 +121,13 @@ class MasterKey:
             return cls(raw)  # binary key material
         stripped = text.strip()
         hexish = sum(c in "0123456789abcdefABCDEF" for c in stripped)
-        if stripped and hexish == len(stripped):
-            if len(stripped) % 2:
-                raise ValueError(f"{path}: odd-length hex master key")
-            return cls(bytes.fromhex(stripped))
-        if len(stripped) >= 32 and hexish >= 0.9 * len(stripped):
-            # almost-hex: a corrupted hex key file, not deliberate raw bytes
-            raise ValueError(f"{path}: looks like hex but fails to parse")
+        if len(stripped) == 64:
+            if hexish == 64:
+                return cls(bytes.fromhex(stripped))  # exactly 32 key bytes
+            if hexish >= 0.9 * 64:
+                # almost-hex at the exact key length: a corrupted hex key
+                # file, not deliberate raw bytes
+                raise ValueError(f"{path}: looks like hex but fails to parse")
         return cls(raw)
 
     @classmethod

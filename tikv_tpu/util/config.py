@@ -10,8 +10,56 @@ mechanism behind POST /config online reconfig.
 from __future__ import annotations
 
 import threading
-import tomllib
 from dataclasses import asdict, dataclass, field, fields, is_dataclass
+
+try:  # tomllib is 3.11+; this image runs 3.10 and bakes no tomli
+    import tomllib
+except ImportError:  # pragma: no cover - depends on interpreter version
+    tomllib = None
+
+
+def _toml_loads_minimal(text: str) -> dict:
+    """Subset TOML parser used only when ``tomllib`` is unavailable: flat
+    ``[section]`` tables and ``key = value`` scalars (strings, booleans,
+    ints, floats) — exactly the shape of this project's config files."""
+    def strip_comment(line: str) -> str:
+        # only strip a '#' that sits outside quoted strings
+        quote = None
+        for i, ch in enumerate(line):
+            if quote is None:
+                if ch in "\"'":
+                    quote = ch
+                elif ch == "#":
+                    return line[:i]
+            elif ch == quote:
+                quote = None
+        return line
+
+    out: dict = {}
+    table = out
+    for raw in text.splitlines():
+        line = strip_comment(raw).strip()
+        if not line:
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            table = out
+            for part in line[1:-1].strip().split("."):
+                table = table.setdefault(part.strip(), {})
+            continue
+        if "=" not in line:
+            raise ValueError(f"unsupported TOML line: {raw!r}")
+        key, val = (s.strip() for s in line.split("=", 1))
+        key = key.strip('"')
+        if val.startswith(('"', "'")) and val.endswith(val[0]) and len(val) >= 2:
+            table[key] = val[1:-1]
+        elif val in ("true", "false"):
+            table[key] = val == "true"
+        else:
+            try:
+                table[key] = int(val, 0)
+            except ValueError:
+                table[key] = float(val)
+    return out
 
 
 @dataclass
@@ -126,7 +174,8 @@ class TikvConfig:
 
     @classmethod
     def from_toml(cls, text: str, strict: bool = True) -> "TikvConfig":
-        return cls.from_dict(tomllib.loads(text), strict)
+        loads = tomllib.loads if tomllib is not None else _toml_loads_minimal
+        return cls.from_dict(loads(text), strict)
 
 
 def _merge(obj, d: dict, unknown: list[str], prefix: str) -> None:
