@@ -547,6 +547,113 @@ def _op_region_cache(req, state):
     return out
 
 
+def _xregion_q6(cut: int):
+    """A Q6-shaped selection+aggregation (no group-by): the dispatch-bound
+    serving shape where cross-region batching pays off on every backend."""
+    from tikv_tpu.copr.aggr import AggDescriptor
+    from tikv_tpu.copr.dag import Aggregation, DagRequest, Selection, TableScan
+    from tikv_tpu.copr.rpn import call, col, const_int
+
+    return DagRequest(executors=[
+        TableScan(TABLE_ID, _lineitem()),
+        Selection([call("le", col(4), const_int(cut)),
+                   call("lt", col(1), const_int(30))]),
+        Aggregation([], [AggDescriptor("sum", call("multiply", col(2), col(3))),
+                         AggDescriptor("count", None)]),
+    ])
+
+
+def _op_xregion(req, state):
+    """xregion_batch event: the unified read scheduler's cross-region
+    continuous batching (copr/scheduler.py) vs per-request device serving.
+
+    An 8-region table serves a mixed workload — a Q6-shaped selection
+    aggregate, a second Q6 variant (different signature), and the Q1
+    group-by — issued by ``clients`` concurrent clients per region, the
+    batch_commands fan-in shape.  Serial = one handle_request per request
+    (today's per-request device path, warm region-cache hits throughout);
+    batched = ONE handle_batch, which the scheduler collapses into one
+    cross-region program per plan signature (identical requests from
+    different clients share an execution slot).  Responses must be
+    byte-identical to the serial path AND the CPU pipeline."""
+    import numpy as _np
+
+    from tikv_tpu.copr.endpoint import CoprRequest, Endpoint
+    from tikv_tpu.copr.table import record_key
+    from tikv_tpu.storage.btree_engine import BTreeEngine
+    from tikv_tpu.storage.engine import CF_WRITE
+    from tikv_tpu.storage.kv import LocalEngine
+    from tikv_tpu.storage.txn_types import Key, Write, WriteType
+
+    regions = req.get("regions", 8)
+    rows_per = req.get("rows", 32000) // regions
+    clients = req.get("clients", 3)
+    trials = req.get("trials", 5)
+    n = regions * rows_per
+    kvs = build_kvs(n, seed=17)
+    eng = BTreeEngine()
+    items = []
+    for rk, v in kvs:
+        items.append(
+            (Key.from_raw(rk).append_ts(20).encoded, Write(WriteType.PUT, 10, short_value=v).to_bytes())
+        )
+    eng.bulk_load(CF_WRITE, items)
+    # block geometry sized to the region: padding a 4k-row region to the 64k
+    # default would spend 16x the compute per dispatch and bury the win
+    block_rows = 1 << max(10, (rows_per - 1).bit_length())
+    ep = Endpoint(LocalEngine(eng), enable_device=True, block_rows=block_rows)
+    ep_cpu = Endpoint(LocalEngine(eng), enable_device=False)
+
+    dags = [lambda: _xregion_q6(10500), lambda: _xregion_q6(9000), q1_dag]
+
+    def mk(region, dag_fn):
+        lo = record_key(TABLE_ID, region * rows_per)
+        hi = record_key(TABLE_ID, (region + 1) * rows_per)
+        return CoprRequest(103, dag_fn(), [(lo, hi)], 100,
+                           context={"region_id": region + 1,
+                                    "region_epoch": (1, 1), "apply_index": 7})
+
+    def sweep():
+        return [mk(r, d) for d in dags for r in range(regions)
+                for _ in range(clients)]
+
+    # warmup: fill region images, compile both paths
+    for _ in range(2):
+        serial = [ep.handle_request(q) for q in sweep()]
+        batched = ep.handle_batch(sweep())
+    oracle = [ep_cpu.handle_request(q) for q in sweep()]
+    match = all(s.data == b.data == o.data
+                for s, b, o in zip(serial, batched, oracle))
+    from_device = all(b.from_device for b in batched)
+    serial_ts, batch_ts = [], []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        for q in sweep():
+            ep.handle_request(q)
+        serial_ts.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        ep.handle_batch(sweep())
+        batch_ts.append(time.perf_counter() - t0)
+    n_reqs = len(sweep())
+    stats = {}
+    from tikv_tpu.util.metrics import REGISTRY
+
+    stats["xregion_batches"] = REGISTRY.counter(
+        "tikv_coprocessor_sched_batches_total", "").get(kind="xregion")
+    return {
+        "match": bool(match),
+        "from_device": bool(from_device),
+        "regions": regions,
+        "clients": clients,
+        "requests": n_reqs,
+        "rows_per_region": rows_per,
+        "serial_ts": [round(x, 4) for x in serial_ts],
+        "batch_ts": [round(x, 4) for x in batch_ts],
+        "total_rows": n_reqs * rows_per,
+        **stats,
+    }
+
+
 _OPS = {
     "build": _op_build,
     "warm": _op_warm,
@@ -556,6 +663,7 @@ _OPS = {
     "topn": _op_topn,
     "filter": _op_filter,
     "region_cache": _op_region_cache,
+    "xregion": _op_xregion,
 }
 
 
@@ -664,7 +772,17 @@ class DeviceWorker:
 
     def wait_ready(self, budget_s: float) -> str:
         """'ready' | 'died' (respawnable: init failed fast or slow) |
-        'timeout' (budget gone)."""
+        'timeout' (budget gone or worker wedged).
+
+        Wedge detection (the BENCH_r05 failure shape): a worker that only
+        ever heartbeats — backend init hung, zero progress — polled for the
+        FULL budget before the run demoted to CPU.  The heartbeats carry the
+        worker's own uptime; once that exceeds BENCH_INIT_STALL (default
+        300s) with nothing but init_wait events seen, the worker is declared
+        wedged and killed immediately: five rounds of evidence say a tunnel
+        that silent for that long never comes up, and the budget only exists
+        for inits that are *progressing slowly*, not stuck."""
+        stall_s = float(os.environ.get("BENCH_INIT_STALL", "300"))
         deadline = time.time() + budget_s
         while True:
             remaining = deadline - time.time()
@@ -678,6 +796,10 @@ class DeviceWorker:
             ev = msg.get("ev")
             if ev == "init_wait":
                 self._mark("worker_init_wait", worker_t=msg.get("t"))
+                if float(msg.get("t") or 0.0) >= stall_s:
+                    self._mark("worker_wedged", worker_t=msg.get("t"),
+                               stall_s=stall_s)
+                    return "timeout"
             elif ev == "ready":
                 self.platform = msg.get("platform")
                 self._mark("ready", platform=self.platform, worker_t=msg.get("t"))
@@ -848,7 +970,13 @@ def main() -> None:
         print(f"bench: [{entry['t']:7.1f}s] {ev} {kw if kw else ''}", file=sys.stderr)
 
     r = dev.call("build", rows=n, block_rows=block_rows)
-    _mark("device_cache_built", s=r.get("build_s"))
+    if isinstance(dev, LocalDevice):
+        # the CPU fallback shares the parent's pre-built fixture, so the
+        # op's own build_s is ~0 — report the REAL build cost (measured at
+        # cpu_cache_built) instead of attesting a free cache build
+        _mark("device_cache_built", s=round(build_s, 2), shared_parent_cache=True)
+    else:
+        _mark("device_cache_built", s=r.get("build_s"))
     interleave = cache is not None
     for name in ("q6", "q1"):
         # median-of-N with CPU trials interleaved between device trials when
@@ -971,6 +1099,37 @@ def main() -> None:
         except WorkerDied as e:
             results["region_cache_error"] = str(e)[:200]
             _mark("region_cache_error", err=str(e)[:120])
+
+    if os.environ.get("BENCH_XREGION", "1") != "0":
+        # cross-region continuous batching (ISSUE 2): the read scheduler's
+        # handle_batch vs per-request device serving on an 8-region mixed
+        # workload with 3 clients per (region, query).  Auxiliary for infra
+        # failures; a byte mismatch is fatal.
+        try:
+            r = dev.call(
+                "xregion",
+                regions=int(os.environ.get("BENCH_XREGION_REGIONS", "8")),
+                rows=int(os.environ.get("BENCH_XREGION_ROWS", "64000")),
+                clients=int(os.environ.get("BENCH_XREGION_CLIENTS", "3")),
+            )
+            if not r["match"]:
+                _fail("XREGION_MISMATCH")
+            serial_t = float(np.median(r["serial_ts"]))
+            batch_t = float(np.median(r["batch_ts"]))
+            results["xregion_requests"] = r["requests"]
+            results["xregion_regions"] = r["regions"]
+            results["xregion_clients"] = r["clients"]
+            results["xregion_serial_rows_per_s"] = r["total_rows"] / serial_t
+            results["xregion_batch_rows_per_s"] = r["total_rows"] / batch_t
+            results["xregion_speedup"] = serial_t / batch_t
+            results["xregion_from_device"] = r["from_device"]
+            results["xregion_serial_ts"] = r["serial_ts"]
+            results["xregion_batch_ts"] = r["batch_ts"]
+            _mark("xregion_batch", speedup=round(serial_t / batch_t, 2),
+                  requests=r["requests"], from_device=r["from_device"])
+        except WorkerDied as e:
+            results["xregion_error"] = str(e)[:200]
+            _mark("xregion_error", err=str(e)[:120])
 
     if os.environ.get("BENCH_MVCC", "1") != "0":
         try:

@@ -69,7 +69,12 @@ def _spawn_store(store_id: int, pd_addr, data_dir: str,
 def _wait_ready(proc, timeout=120.0):
     # readline() blocks with no deadline of its own: a silent hung startup
     # must still fail the bench (not freeze the driver) — the watchdog kills
-    # the process, which EOFs the pipe and breaks the loop
+    # the process, which EOFs the pipe and breaks the loop.  The error names
+    # the wedge (vs a fast crash) and how long the store stalled, so a
+    # BENCH_rN tail alone distinguishes "device init hung at startup" from
+    # "store crashed": rc=-9 with elapsed≈timeout is the watchdog's kill.
+    timeout = float(os.environ.get("BENCH_CLUSTER_READY_TIMEOUT", str(timeout)))
+    t0 = time.monotonic()
     watchdog = threading.Timer(timeout, lambda: os.kill(proc.pid, signal.SIGKILL))
     watchdog.daemon = True
     watchdog.start()
@@ -77,8 +82,14 @@ def _wait_ready(proc, timeout=120.0):
         while True:
             line = proc.stdout.readline()
             if not line:
+                elapsed = time.monotonic() - t0
+                rc = proc.poll()
+                kind = ("wedged at startup (watchdog kill)"
+                        if rc == -signal.SIGKILL and elapsed >= timeout - 1.0
+                        else "exited before READY")
                 raise RuntimeError(
-                    f"store process exited/killed rc={proc.poll()} before READY")
+                    f"store process {kind}: rc={rc} after {elapsed:.1f}s "
+                    f"(timeout {timeout:.0f}s) argv={proc.args}")
             if line.startswith(b"READY"):
                 return
     finally:

@@ -1,13 +1,18 @@
 #!/usr/bin/env python
-"""Bench smoke: the region column cache must hold its win.
+"""Bench smoke: the region column cache AND the read scheduler must hold
+their wins.
 
-Runs the mock-table region-cache configuration (bench.py's ``region_cache``
-op — endpoint-served scan/selection over a real MVCC region, cold vs cached,
-with a delta apply mid-sequence) on the CPU backend and FAILS when:
+Runs two mock-table configurations on the CPU backend and FAILS when either
+regresses:
 
-* any cached response diverges byte-wise from the cold path, or
-* the cached-scan or cached-selection speedup regresses below the 2x floor
-  (ISSUE 1 acceptance: scan/selection must stay off the 1.0x floor).
+* ``region_cache`` (ISSUE 1): endpoint-served scan/selection over a real
+  MVCC region, cold vs cached, with a delta apply mid-sequence.  Fails on
+  any byte divergence or a cached speedup below the 2x floor.
+* ``xregion`` (ISSUE 2): the unified read scheduler's cross-region batched
+  serving vs per-request device serving on an 8-region synthetic workload
+  (mixed plan signatures, multiple clients per region).  Fails on any byte
+  divergence from the serial path / CPU oracle or a batched-vs-serial
+  speedup below the 2x floor.
 
 Exit code 0 = healthy; 1 = regression.  One JSON line on stdout either way,
 so CI logs stay grep-able:
@@ -24,12 +29,16 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_HERE))
 
 MIN_SPEEDUP = 2.0
+MIN_XREGION_SPEEDUP = 2.0
 
 
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=int(os.environ.get("SMOKE_ROWS", "60000")))
     ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--xregion-rows", type=int,
+                    default=int(os.environ.get("SMOKE_XREGION_ROWS", "32000")))
+    ap.add_argument("--xregion-regions", type=int, default=8)
     args = ap.parse_args()
 
     import bench
@@ -50,6 +59,25 @@ def main() -> int:
             ok = False
             out[f"{kind}_regression"] = f"{speedup:.2f}x < {MIN_SPEEDUP}x floor"
     out["delta"] = r.get("delta")
+
+    # cross-region batched-vs-serial (scheduler regression tripwire)
+    rx = bench._op_xregion({
+        "regions": args.xregion_regions, "rows": args.xregion_rows,
+        "clients": 3, "trials": max(args.trials, 3),
+    }, {})
+    out["xregion_match"] = bool(rx["match"])
+    out["xregion_from_device"] = bool(rx["from_device"])
+    ok = ok and rx["match"] and rx["from_device"]
+    serial_t = float(np.median(rx["serial_ts"]))
+    batch_t = float(np.median(rx["batch_ts"]))
+    xspeed = serial_t / batch_t
+    out["xregion_requests"] = rx["requests"]
+    out["xregion_speedup"] = round(xspeed, 2)
+    if xspeed < MIN_XREGION_SPEEDUP:
+        ok = False
+        out["xregion_regression"] = (
+            f"{xspeed:.2f}x < {MIN_XREGION_SPEEDUP}x floor")
+
     out["ok"] = bool(ok)
     print(json.dumps(out))
     return 0 if ok else 1

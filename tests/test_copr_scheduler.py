@@ -1,0 +1,512 @@
+"""Unified coprocessor read scheduler (copr/scheduler.py): cross-region
+continuous batching, mixed-eligibility handle_batch, admission control,
+and the fused-batch metrics contract.
+
+Every batched response must be byte-identical to the per-request CPU
+pipeline — the scheduler only ever removes dispatches, never changes bytes.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tikv_tpu.copr import jax_eval
+from tikv_tpu.copr.aggr import AggDescriptor
+from tikv_tpu.copr.dag import Aggregation, DagRequest, Limit, Selection, TableScan
+from tikv_tpu.copr.datatypes import ColumnInfo, FieldType
+from tikv_tpu.copr.endpoint import CoprRequest, Endpoint
+from tikv_tpu.copr.rpn import call, col, const_int
+from tikv_tpu.copr.scheduler import SchedulerConfig, plan_signature
+from tikv_tpu.copr.table import encode_row, record_key
+from tikv_tpu.storage.btree_engine import BTreeEngine
+from tikv_tpu.storage.engine import CF_WRITE
+from tikv_tpu.storage.kv import LocalEngine
+from tikv_tpu.storage.txn_types import Key, Write, WriteType
+from tikv_tpu.util.metrics import REGISTRY
+
+from copr_fixtures import PRODUCT_COLUMNS, TABLE_ID as PRODUCT_TABLE, product_engine
+from tikv_tpu.copr.table import record_range
+
+TABLE_ID = 77
+
+COLS = [
+    ColumnInfo(1, FieldType.int64(), is_pk_handle=True),
+    ColumnInfo(2, FieldType.int64()),
+    ColumnInfo(3, FieldType.varchar()),
+    ColumnInfo(4, FieldType.decimal_type(2)),
+]
+
+
+def _engine(n: int, seed: int = 0) -> BTreeEngine:
+    rng = np.random.default_rng(seed)
+    a = rng.integers(0, 100, n)
+    price = rng.integers(100, 100000, n)
+    names = (b"x", b"y", b"z")
+    eng = BTreeEngine()
+    items = []
+    for i in range(n):
+        rk = record_key(TABLE_ID, i)
+        val = encode_row(COLS[1:], [int(a[i]), names[i % 3], int(price[i])])
+        items.append((Key.from_raw(rk).append_ts(20).encoded,
+                      Write(WriteType.PUT, 10, short_value=val).to_bytes()))
+    eng.bulk_load(CF_WRITE, items)
+    return eng
+
+
+def _sum_dag(cut: int) -> DagRequest:
+    return DagRequest(executors=[
+        TableScan(TABLE_ID, COLS),
+        Selection([call("lt", col(1), const_int(cut))]),
+        Aggregation([], [AggDescriptor("sum", col(3)),
+                         AggDescriptor("count", None)]),
+    ])
+
+
+def _group_dag() -> DagRequest:
+    return DagRequest(executors=[
+        TableScan(TABLE_ID, COLS),
+        Aggregation([col(2)], [AggDescriptor("sum", col(1)),
+                               AggDescriptor("count", None)]),
+    ])
+
+
+def _scan_dag() -> DagRequest:
+    return DagRequest(executors=[TableScan(TABLE_ID, COLS), Limit(10)])
+
+
+def _region_req(region: int, rows_per: int, dag: DagRequest,
+                priority: str | None = None, apply_index: int = 7) -> CoprRequest:
+    lo = record_key(TABLE_ID, region * rows_per)
+    hi = record_key(TABLE_ID, (region + 1) * rows_per)
+    ctx = {"region_id": region + 1, "region_epoch": (1, 1),
+           "apply_index": apply_index}
+    if priority is not None:
+        ctx["priority"] = priority
+    return CoprRequest(103, dag, [(lo, hi)], 100, context=ctx)
+
+
+ROWS_PER = 600
+N_REGIONS = 4
+
+
+@pytest.fixture(scope="module")
+def engines():
+    eng = _engine(ROWS_PER * N_REGIONS, seed=5)
+    dev = Endpoint(LocalEngine(eng), enable_device=True, block_rows=1024)
+    cpu = Endpoint(LocalEngine(eng), enable_device=False)
+    return dev, cpu
+
+
+def test_plan_signature_groups_same_plans():
+    assert plan_signature(_sum_dag(50)) == plan_signature(_sum_dag(50))
+    assert plan_signature(_sum_dag(50)) != plan_signature(_sum_dag(51))
+    assert plan_signature(_sum_dag(50)) != plan_signature(_group_dag())
+
+
+def test_plan_signature_normalizes_wire_sigs():
+    """A tipb ScalarFuncSig spelling and its kernel name key identically
+    (sig_map is the single source of truth for the fold)."""
+    a = DagRequest(executors=[
+        TableScan(TABLE_ID, COLS),
+        Selection([call("LtInt", col(1), const_int(9))]),
+        Aggregation([], [AggDescriptor("count", None)]),
+    ])
+    b = DagRequest(executors=[
+        TableScan(TABLE_ID, COLS),
+        Selection([call("lt", col(1), const_int(9))]),
+        Aggregation([], [AggDescriptor("count", None)]),
+    ])
+    assert plan_signature(a) == plan_signature(b)
+
+
+def test_xregion_batch_byte_identical(engines):
+    """Same plan across regions collapses into one cross-region program;
+    responses match the CPU pipeline byte for byte (group order included)."""
+    dev, cpu = engines
+    dags = [lambda: _sum_dag(50), lambda: _sum_dag(80), _group_dag]
+    reqs = [_region_req(r, ROWS_PER, d()) for d in dags for r in range(N_REGIONS)]
+    # warm (fills region images + compiles)
+    dev.handle_batch([_region_req(r, ROWS_PER, d())
+                      for d in dags for r in range(N_REGIONS)])
+    before = REGISTRY.counter("tikv_coprocessor_sched_batches_total", "").get(
+        kind="xregion")
+    got = dev.handle_batch(reqs)
+    after = REGISTRY.counter("tikv_coprocessor_sched_batches_total", "").get(
+        kind="xregion")
+    assert after >= before + 3  # one cross-region batch per signature
+    assert all(r.from_device for r in got)
+    for req, resp in zip(reqs, got):
+        want = cpu.handle_request(
+            CoprRequest(103, req.dag, req.ranges, req.start_ts, dict(req.context)))
+        assert resp.data == want.data
+    # scheduler metadata rides the response
+    assert any(r.metrics.get("sched_batch") == "xregion" for r in got)
+    occ = [r.metrics.get("batch_occupancy") for r in got
+           if r.metrics.get("sched_batch") == "xregion"]
+    assert occ and all(o >= 2 for o in occ)
+
+
+def test_xregion_dedupes_identical_requests(engines):
+    """Identical hot requests from many clients share one execution slot."""
+    dev, cpu = engines
+    reqs = [_region_req(r, ROWS_PER, _sum_dag(42))
+            for r in range(N_REGIONS) for _ in range(3)]
+    got = dev.handle_batch(reqs)
+    want = {r: cpu.handle_request(_region_req(r, ROWS_PER, _sum_dag(42))).data
+            for r in range(N_REGIONS)}
+    for req, resp in zip(reqs, got):
+        assert resp.data == want[req.context["region_id"] - 1]
+    # 12 requests, but the batch occupancy counts the 12 (shared slots serve
+    # every rider), all from one device dispatch
+    assert all(r.from_device for r in got)
+
+
+def test_mixed_eligibility_batch(engines):
+    """Ineligible requests (non-agg DAG, checksum) ride the same batch and
+    answer per-request; order is preserved; eligible ones still fuse."""
+    dev, cpu = engines
+    reqs = [
+        _region_req(0, ROWS_PER, _sum_dag(50)),
+        _region_req(1, ROWS_PER, _scan_dag()),       # no aggregation
+        _region_req(1, ROWS_PER, _sum_dag(50)),
+        CoprRequest(105, None, [record_range(TABLE_ID)], 100, context={}),
+        _region_req(2, ROWS_PER, _sum_dag(50)),
+    ]
+    got = dev.handle_batch(reqs)
+    assert len(got) == len(reqs)
+    for req, resp in zip(reqs, got):
+        want = cpu.handle_request(
+            CoprRequest(req.tp, req.dag, req.ranges, req.start_ts,
+                        dict(req.context or {})))
+        assert resp.data == want.data
+    assert got[0].from_device and got[2].from_device and got[4].from_device
+    assert not got[3].from_device
+
+
+def test_priority_lane_stamped(engines):
+    dev, _cpu = engines
+    reqs = [_region_req(r, ROWS_PER, _sum_dag(50), priority="high")
+            for r in range(N_REGIONS)]
+    got = dev.handle_batch(reqs)
+    lanes = {r.metrics.get("sched_lane") for r in got}
+    assert lanes == {"high"}
+
+
+def test_cold_cache_first_fill_then_fused():
+    """cache_version-keyed block cache, cold: the first request fills the
+    shared cache per-request, the rest fuse — every response byte-identical
+    to the CPU pipeline (the pre-scheduler _try_fused_batch contract)."""
+    eng = LocalEngine(product_engine())
+    dev = Endpoint(eng, enable_device=True)
+    cpu = Endpoint(eng, enable_device=False)
+
+    def agg_dag(fn, target):
+        return DagRequest(executors=[
+            TableScan(PRODUCT_TABLE, PRODUCT_COLUMNS),
+            Aggregation([], [AggDescriptor(fn, col(target))]),
+        ])
+
+    dags = [agg_dag("count", 0), agg_dag("sum", 0), agg_dag("max", 0),
+            agg_dag("min", 2)]
+    ctx = {"region_id": 1, "cache_version": 3}
+    reqs = [CoprRequest(103, d, [record_range(PRODUCT_TABLE)], 200, dict(ctx))
+            for d in dags]
+    resps = dev.handle_batch(reqs)
+    assert all(r.from_device for r in resps)
+    kinds = [r.metrics.get("sched_batch") for r in resps]
+    assert kinds[0] == "fill" and all(k == "fused" for k in kinds[1:]), kinds
+    for d, got in zip(dags, resps):
+        want = cpu.handle_request(
+            CoprRequest(103, d, [record_range(PRODUCT_TABLE)], 200, dict(ctx)))
+        assert got.data == want.data
+
+
+def test_fused_latency_one_observation_per_request():
+    """The duration histogram gets ONE observation per fused request (not a
+    single mean observation), so count-weighted percentiles stay honest
+    against the unary path."""
+    eng = LocalEngine(product_engine())
+    dev = Endpoint(eng, enable_device=True)
+
+    def agg_dag(fn):
+        return DagRequest(executors=[
+            TableScan(PRODUCT_TABLE, PRODUCT_COLUMNS),
+            Aggregation([], [AggDescriptor(fn, col(0))]),
+        ])
+
+    ctx = {"region_id": 1, "cache_version": 9}
+    reqs = [CoprRequest(103, agg_dag(fn), [record_range(PRODUCT_TABLE)], 200,
+                        dict(ctx)) for fn in ("count", "sum", "max")]
+    dev.handle_batch(reqs)  # cold: fill + fuse
+    h = REGISTRY.histogram("tikv_coprocessor_request_duration_seconds", "")
+    key = (("tp", "103"),)
+    before = h._n.get(key, 0)
+    resps = dev.handle_batch(reqs)  # warm: all three fuse
+    assert all(r.from_device for r in resps)
+    assert h._n.get(key, 0) >= before + len(reqs)
+
+
+def test_device_failure_mid_batch_falls_back(engines, monkeypatch):
+    """A device failure during the cross-region program sheds every slot to
+    the per-request path — responses stay correct and nothing is lost."""
+    dev, cpu = engines
+    reqs = [_region_req(r, ROWS_PER, _sum_dag(60)) for r in range(N_REGIONS)]
+    dev.handle_batch([_region_req(r, ROWS_PER, _sum_dag(60))
+                      for r in range(N_REGIONS)])  # warm images
+
+    def boom(*a, **k):
+        raise RuntimeError("device lost mid-batch")
+
+    monkeypatch.setattr(jax_eval, "launch_xregion_cached", boom)
+    fallbacks = dev.device_fallbacks
+    got = dev.handle_batch(reqs)
+    assert dev.device_fallbacks > fallbacks
+    for req, resp in zip(reqs, got):
+        want = cpu.handle_request(
+            CoprRequest(103, req.dag, req.ranges, req.start_ts, dict(req.context)))
+        assert resp.data == want.data
+    monkeypatch.undo()
+    # the region images survived the failure: next batch is fused again
+    got2 = dev.handle_batch(reqs)
+    assert all(r.from_device for r in got2)
+    assert any(r.metrics.get("sched_batch") == "xregion" for r in got2)
+
+
+def test_cold_fill_failure_leaves_no_partial_cache(monkeypatch):
+    """A device failure during the cold fill must not leave a partially
+    filled block cache behind (it would double-append and serve wrong data
+    forever)."""
+    eng = LocalEngine(product_engine())
+    dev = Endpoint(eng, enable_device=True)
+    cpu = Endpoint(eng, enable_device=False)
+
+    def agg_dag(fn):
+        return DagRequest(executors=[
+            TableScan(PRODUCT_TABLE, PRODUCT_COLUMNS),
+            Aggregation([], [AggDescriptor(fn, col(0))]),
+        ])
+
+    ctx = {"region_id": 1, "cache_version": 77}
+    reqs = [CoprRequest(103, agg_dag(fn), [record_range(PRODUCT_TABLE)], 200,
+                        dict(ctx)) for fn in ("count", "sum")]
+
+    calls = {"n": 0}
+    orig = jax_eval.JaxDagEvaluator.run
+
+    def failing_run(self, source, cache=None):
+        calls["n"] += 1
+        if cache is not None and not cache.filled:
+            # crash mid-fill, after blocks were appended
+            for cols, n_valid in self._blocks(source):
+                break
+            raise RuntimeError("device died during fill")
+        return orig(self, source, cache=cache)
+
+    monkeypatch.setattr(jax_eval.JaxDagEvaluator, "run", failing_run)
+    got = dev.handle_batch(reqs)
+    monkeypatch.undo()
+    for req, resp in zip(reqs, got):
+        want = cpu.handle_request(
+            CoprRequest(103, req.dag, req.ranges, req.start_ts, dict(req.context)))
+        assert resp.data == want.data
+    cache = dev._block_cache_for(reqs[0])
+    assert cache.filled or not cache.blocks, "partially-filled cache left behind"
+
+
+def test_padding_budget_sheds_block_count_outlier():
+    """One region with 8x the blocks of its peers sheds to the per-request
+    path instead of padding every peer up to its geometry."""
+    eng = _engine(ROWS_PER * 8, seed=9)
+    # tiny blocks so region 0's wider range spans many blocks
+    dev = Endpoint(LocalEngine(eng), enable_device=True, block_rows=256,
+                   sched_config=SchedulerConfig(padding_budget=0.5))
+    cpu = Endpoint(LocalEngine(eng), enable_device=False)
+    big = CoprRequest(103, _sum_dag(70),
+                      [(record_key(TABLE_ID, 0), record_key(TABLE_ID, 5 * ROWS_PER))],
+                      100, context={"region_id": 1, "region_epoch": (1, 1),
+                                    "apply_index": 7})
+    smalls = [CoprRequest(
+        103, _sum_dag(70),
+        [(record_key(TABLE_ID, (5 + i) * ROWS_PER),
+          record_key(TABLE_ID, (6 + i) * ROWS_PER))],
+        100, context={"region_id": 10 + i, "region_epoch": (1, 1),
+                      "apply_index": 7}) for i in range(3)]
+    reqs = [big] + smalls
+    dev.handle_batch([CoprRequest(r.tp, r.dag, r.ranges, r.start_ts,
+                                  dict(r.context)) for r in reqs])  # warm
+    before = REGISTRY.counter("tikv_coprocessor_sched_shed_total", "").get(
+        reason="padding")
+    got = dev.handle_batch(reqs)
+    after = REGISTRY.counter("tikv_coprocessor_sched_shed_total", "").get(
+        reason="padding")
+    assert after > before
+    assert got[0].metrics.get("sched_batch", "").startswith("shed:padding")
+    assert all(r.metrics.get("sched_batch") == "xregion" for r in got[1:])
+    for req, resp in zip(reqs, got):
+        want = cpu.handle_request(
+            CoprRequest(103, req.dag, req.ranges, req.start_ts, dict(req.context)))
+        assert resp.data == want.data
+
+
+def test_aliased_image_slots_keep_snapshot_isolation():
+    """Two requests over the SAME region at different start_ts around a
+    write: the region cache holds ONE mutable image per (region, ranges,
+    schema), so resolving the later request delta-applies it in place.
+    Only the last resolution may batch; the earlier one must shed and still
+    return the bytes its snapshot demands."""
+    rows = ROWS_PER * 2
+    eng = _engine(rows, seed=13)
+    dev = Endpoint(LocalEngine(eng), enable_device=True, block_rows=1024)
+    cpu = Endpoint(LocalEngine(eng), enable_device=False)
+
+    def rq(ts, apply_index):
+        return CoprRequest(103, _sum_dag(95),
+                           [(record_key(TABLE_ID, 0), record_key(TABLE_ID, rows))],
+                           ts, context={"region_id": 1, "region_epoch": (1, 1),
+                                        "apply_index": apply_index})
+
+    dev.handle_request(rq(100, 7))  # build the image at ts 100
+    # overwrite a row at commit ts 150
+    val = encode_row(COLS[1:], [1, b"zz", 424242])
+    eng.bulk_load(CF_WRITE, [(
+        Key.from_raw(record_key(TABLE_ID, 3)).append_ts(150).encoded,
+        Write(WriteType.PUT, 140, short_value=val).to_bytes())])
+    before = REGISTRY.counter("tikv_coprocessor_sched_shed_total", "").get(
+        reason="aliased_image")
+    got = dev.handle_batch([rq(100, 7), rq(200, 8)])
+    after = REGISTRY.counter("tikv_coprocessor_sched_shed_total", "").get(
+        reason="aliased_image")
+    assert after > before
+    want_old = cpu.handle_request(rq(100, 7))
+    want_new = cpu.handle_request(rq(200, 8))
+    assert got[0].data == want_old.data, "ts-100 reader saw the ts-150 write"
+    assert got[1].data == want_new.data
+    assert want_old.data != want_new.data  # the write is actually visible at 200
+
+
+def test_continuous_mode_coalesces_across_threads(engines):
+    """start() turns on the continuous lanes: concurrent unary submissions
+    coalesce into scheduler batches and every caller gets its own bytes."""
+    dev, cpu = engines
+    sched = dev.scheduler
+    # slow lanes a little so the submissions actually meet in one batch
+    sched.cfg.max_wait_s = 0.05
+    sched.start()
+    try:
+        want = {r: cpu.handle_request(_region_req(r, ROWS_PER, _sum_dag(33))).data
+                for r in range(N_REGIONS)}
+        dev.handle_batch([_region_req(r, ROWS_PER, _sum_dag(33))
+                          for r in range(N_REGIONS)])  # warm images/compile
+        results: dict[int, bytes] = {}
+        errors: list = []
+
+        def client(r):
+            try:
+                resp = sched.execute(_region_req(r, ROWS_PER, _sum_dag(33)),
+                                     timeout=30.0)
+                results[r] = resp.data
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(r,))
+                   for r in range(N_REGIONS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        assert not errors, errors
+        assert results == want
+    finally:
+        sched.stop()
+    assert not sched.running
+
+
+def test_continuous_mode_isolates_per_request_errors(engines, monkeypatch):
+    """One rider's failure (lock conflict, decode error) must not poison the
+    other requests that coalesced into the same dispatcher batch."""
+    dev, cpu = engines
+    sched = dev.scheduler
+    sched.cfg.max_wait_s = 0.05
+    orig = type(dev).handle_request
+
+    def failing(self, req):
+        if (req.context or {}).get("region_id") == 99:
+            raise RuntimeError("injected per-request failure")
+        return orig(self, req)
+
+    monkeypatch.setattr(type(dev), "handle_request", failing)
+    dev.handle_batch([_region_req(r, ROWS_PER, _sum_dag(37))
+                      for r in range(N_REGIONS)])  # warm
+    sched.start()
+    try:
+        results: dict[int, bytes] = {}
+        errs: dict[int, BaseException] = {}
+
+        def client(r, req):
+            try:
+                results[r] = sched.execute(req, timeout=30.0).data
+            except BaseException as e:  # noqa: BLE001
+                errs[r] = e
+
+        bad = CoprRequest(103, _sum_dag(37), [(record_key(TABLE_ID, 0),
+                                               record_key(TABLE_ID, 10))],
+                          100, context={"region_id": 99})  # no cache -> shed
+        reqs = [(r, _region_req(r, ROWS_PER, _sum_dag(37)))
+                for r in range(N_REGIONS)] + [(99, bad)]
+        threads = [threading.Thread(target=client, args=a) for a in reqs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+    finally:
+        sched.stop()
+    assert isinstance(errs.get(99), RuntimeError)
+    for r in range(N_REGIONS):
+        assert r not in errs, f"rider {r} poisoned by region 99's failure: {errs.get(r)}"
+        assert results[r] == cpu.handle_request(
+            _region_req(r, ROWS_PER, _sum_dag(37))).data
+
+
+def test_scheduler_stop_drains_queue(engines):
+    dev, _cpu = engines
+    sched = dev.scheduler
+    sched.start()
+    sched.stop()
+    assert not sched.running
+    # stopped scheduler serves directly
+    resp = sched.execute(_region_req(0, ROWS_PER, _sum_dag(21)))
+    assert resp.data
+
+
+def test_mesh_bypass_counted_when_cache_in_play(engines, monkeypatch):
+    """A filled block/region cache forces single-device serving; the bypass
+    is counted so idle mesh capacity is visible (see endpoint.py for why
+    HBM-pinned entries cannot shard) — but ONLY for DAGs the mesh would
+    actually have served."""
+    from types import SimpleNamespace
+
+    dev, _cpu = engines
+    req = _region_req(0, ROWS_PER, _sum_dag(44))
+    dev.handle_request(_region_req(0, ROWS_PER, _sum_dag(44)))  # warm image
+    dev.mesh = SimpleNamespace(size=4)
+    try:
+        # mesh declines the plan -> no bypass counted
+        monkeypatch.setattr(type(dev), "_mesh_evaluator_for",
+                            lambda self, dag: None)
+        before = REGISTRY.counter("tikv_coprocessor_mesh_bypass_total", "").get(
+            reason="cache")
+        resp = dev.handle_request(req)
+        assert resp.from_device
+        assert REGISTRY.counter("tikv_coprocessor_mesh_bypass_total", "").get(
+            reason="cache") == before
+        # mesh would serve the plan -> the cache bypass is counted
+        monkeypatch.setattr(type(dev), "_mesh_evaluator_for",
+                            lambda self, dag: object())
+        resp = dev.handle_request(req)
+        after = REGISTRY.counter("tikv_coprocessor_mesh_bypass_total", "").get(
+            reason="cache")
+        assert resp.from_device
+        assert after > before
+    finally:
+        dev.mesh = None

@@ -26,6 +26,13 @@ LAZY_SERIES = {
     "tikv_coprocessor_cache_hit_total",
     "tikv_coprocessor_batch_total",
     "tikv_coprocessor_batch_queries_total",
+    "tikv_coprocessor_sched_queue_depth",
+    "tikv_coprocessor_sched_batch_occupancy",
+    "tikv_coprocessor_sched_padding_waste",
+    "tikv_coprocessor_sched_lane_wait_seconds",
+    "tikv_coprocessor_sched_batches_total",
+    "tikv_coprocessor_sched_shed_total",
+    "tikv_coprocessor_mesh_bypass_total",
     "tikv_coprocessor_region_cache_total",
     "tikv_coprocessor_region_cache_delta_rows_total",
     "tikv_coprocessor_region_cache_evict_total",

@@ -59,11 +59,19 @@ class Endpoint:
         feature_gate=None,
         enable_region_cache: bool = True,
         region_cache=None,
+        sched_config=None,
+        block_rows: int | None = None,
     ):
         from .tracker import SlowLog
 
         self.engine = engine
         self.enable_device = enable_device
+        # device block geometry: evaluators pad every block to this row
+        # count, so small-region deployments (many regions per store) should
+        # size it near the region row count — a 4k-row region padded to the
+        # 64k default wastes 16x the compute on every backend.  None keeps
+        # the jax_eval default.
+        self.block_rows = block_rows
         # device-resident per-region column cache with delta apply (region
         # requests carrying region_epoch + apply_index in the context skip
         # scan+decode entirely on repeat reads); None = disabled
@@ -72,7 +80,7 @@ class Endpoint:
         elif enable_region_cache:
             from .region_cache import RegionColumnCache
 
-            self.region_cache = RegionColumnCache()
+            self.region_cache = RegionColumnCache(block_rows=block_rows)
         else:
             self.region_cache = None
         # version-gated rollout (feature_gate.rs:14): the gate is the hard
@@ -93,6 +101,12 @@ class Endpoint:
         # broken device shows up here instead of only as from_device=False
         self.device_fallbacks = 0
         self.last_device_error: str | None = None
+        # unified read scheduler (scheduler.py): cross-region continuous
+        # batching over the region column cache.  handle_batch always routes
+        # through it; start() turns on the continuous unary lanes.
+        from .scheduler import CoprReadScheduler
+
+        self.scheduler = CoprReadScheduler(self, sched_config)
 
     def handle_request(self, req: CoprRequest) -> CoprResponse:
         """Instrumented entry: every path (device, CPU fallback, analyze,
@@ -145,9 +159,27 @@ class Endpoint:
                 cache, rc_outcome = self._region_cache_for(req, snap, tracker)
                 if cache is None:
                     cache = self._block_cache_for(req)
-                # mesh path only when no block cache is in play: the cache's
-                # HBM-pinned entries are a single-device structure
-                ev = self._mesh_evaluator_for(req.dag) if cache is None else None
+                # mesh path only when no block cache is in play.  The cache's
+                # HBM-pinned entries are a single-device structure: each block
+                # pins its arrays on the default device, and MeshServingRunner
+                # marshals its own super-blocks sharded by PartitionSpec across
+                # the mesh — composing them would re-shard every pinned array
+                # through host memory on EVERY query, paying the full transfer
+                # the cache exists to remove.  Sharding the cache itself means
+                # per-device pinning + delta scatters routed per shard (future
+                # work); until then the bypass is counted so operators can see
+                # mesh capacity sitting idle behind a filled cache.
+                ev = None
+                if cache is None:
+                    ev = self._mesh_evaluator_for(req.dag)
+                elif self._mesh_would_serve(req.dag):
+                    from ..util.metrics import REGISTRY
+
+                    REGISTRY.counter(
+                        "tikv_coprocessor_mesh_bypass_total",
+                        "Requests served single-device because a filled "
+                        "block/region cache cannot shard across the mesh",
+                    ).inc(reason="cache")
                 if ev is None:
                     ev = self._evaluator_for(req.dag)
                 src = None
@@ -283,94 +315,20 @@ class Endpoint:
 
     def handle_batch(self, reqs: list[CoprRequest]) -> list["CoprResponse"]:
         """K coprocessor requests answered together (the batch_coprocessor /
-        batch_commands serving shape, kv.rs:891): when every request is a
-        device-eligible aggregation DAG over the SAME cached region view,
-        all K queries fuse into ONE device program (jax_eval
-        run_batch_cached) so the per-dispatch and per-pull costs are paid
-        once for the whole batch — the serving-path form of the headline
-        benchmark.  Anything ineligible falls back to per-request handling;
-        responses are byte-identical either way."""
+        batch_commands serving shape, kv.rs:891), routed through the unified
+        read scheduler (scheduler.py): device-eligible aggregation DAGs fuse
+        into as few XLA dispatches as their plan signatures allow — same
+        plan across regions stacks into ONE cross-region program over the
+        cached region images; different plans over the same region view fuse
+        the old way (jax_eval.run_batch_cached).  Anything ineligible falls
+        back to per-request handling; responses are byte-identical either
+        way."""
         if len(reqs) >= 2 and self.device_enabled() and self._gate_ok("batch"):
-            fused = self._try_fused_batch(reqs)
-            if fused is not None:
-                return fused
+            from ..util.failpoint import fail_point
+
+            fail_point("coprocessor_parse_request")
+            return self.scheduler.run_batch(reqs)
         return [self.handle_request(r) for r in reqs]
-
-    def _try_fused_batch(self, reqs: list[CoprRequest]):
-        first = reqs[0]
-        key_of = lambda r: ((r.context or {}).get("region_id"),
-                            tuple(r.ranges), r.start_ts,
-                            (r.context or {}).get("cache_version"))
-        from .dag import Aggregation
-
-        def eligible(r):
-            return (r.tp == REQ_TYPE_DAG and jax_eval.supports(r.dag)
-                    and any(isinstance(e, Aggregation) for e in r.dag.executors)
-                    and key_of(r) == key_of(first))
-
-        if not all(eligible(r) for r in reqs):
-            return None
-        cache = self._block_cache_for(first)
-        if cache is None:
-            return None
-        if self.cm is not None:
-            # same memory-lock gate the unary path applies (endpoint.rs:107):
-            # a pending async-commit prewrite below start_ts must surface,
-            # not be read around
-            from ..storage.txn_types import Key
-
-            for start, end in first.ranges:
-                self.cm.read_range_check(Key.from_raw(start), Key.from_raw(end),
-                                         first.start_ts)
-        import time as _time
-
-        from ..util.failpoint import fail_point
-        from ..util.metrics import REGISTRY
-
-        fail_point("coprocessor_parse_request")
-        t0 = _time.perf_counter()
-        fill_resp = None
-        try:
-            if not cache.filled:
-                snap = self.engine.snapshot(first.context or None)
-                src = MvccBatchScanSource(snap, first.start_ts, first.ranges)
-                # the first query fills the shared cache AND keeps its own
-                # answer — recomputing it in the fused program would pay a
-                # whole extra query per cold batch
-                fill_resp = self._evaluator_for(first.dag).run(src, cache=cache)
-            evs = [self._evaluator_for(r.dag) for r in reqs]
-            if fill_resp is not None:
-                rest = jax_eval.run_batch_cached(evs[1:], cache) if len(evs) > 1 else []
-                resps = [fill_resp] + rest
-            else:
-                resps = jax_eval.run_batch_cached(evs, cache)
-        except Exception as exc:  # noqa: BLE001 — CPU pipeline is the oracle
-            if cache is not None and not cache.filled:
-                cache.blocks.clear()
-            self.device_fallbacks += 1
-            self.last_device_error = repr(exc)
-            return None
-        dt = _time.perf_counter() - t0
-        # the per-request series stay truthful under batch serving (the
-        # handle_request docstring's exactly-once invariant)
-        REGISTRY.counter(
-            "tikv_coprocessor_request_total", "Coprocessor requests, by type/path"
-        ).inc(len(reqs), tp=str(REQ_TYPE_DAG), path="device")
-        REGISTRY.histogram(
-            "tikv_coprocessor_request_duration_seconds", "Coprocessor latency"
-        ).observe(dt / len(reqs), tp=str(REQ_TYPE_DAG))
-        REGISTRY.counter(
-            "tikv_coprocessor_batch_total", "Fused coprocessor batches"
-        ).inc()
-        REGISTRY.counter(
-            "tikv_coprocessor_batch_queries_total", "Queries served fused"
-        ).inc(len(reqs))
-        out = []
-        for r in resps:
-            out.append(CoprResponse(r.encode(), from_device=True,
-                                    metrics={"total_s": dt / len(reqs),
-                                             "from_device": True}))
-        return out
 
     def _evaluator_for(self, dag: DagRequest) -> "jax_eval.JaxDagEvaluator":
         """Reuse compiled evaluators across requests, keyed by plan bytes
@@ -382,7 +340,10 @@ class Endpoint:
         key = wire.dumps(dag_to_wire(dag))
         ev = self._evaluators.get(key)
         if ev is None:
-            ev = jax_eval.JaxDagEvaluator(dag)
+            if self.block_rows is not None:
+                ev = jax_eval.JaxDagEvaluator(dag, block_rows=self.block_rows)
+            else:
+                ev = jax_eval.JaxDagEvaluator(dag)
             self._evaluators[key] = ev
             while len(self._evaluators) > 64:
                 self._evaluators.pop(next(iter(self._evaluators)))
@@ -403,6 +364,25 @@ class Endpoint:
         feat = {"device": DEVICE_COPROCESSOR, "mesh": MESH_SERVING,
                 "batch": BATCH_FUSION}[what]
         return self.feature_gate.can_enable(feat)
+
+    def _mesh_would_serve(self, dag: DagRequest) -> bool:
+        """True only when the mesh path would actually take this DAG (mesh
+        present, gate open, AND the plan is mesh-runnable) — the bypass
+        counter must not claim idle mesh capacity for traffic the mesh
+        would have declined anyway."""
+        if self.mesh is None or getattr(self.mesh, "size", 1) <= 1:
+            return False
+        from .dag import Aggregation
+
+        # cheap pre-filter: the mesh runner only takes aggregation DAGs, so
+        # cached scan/selection traffic (the common warm path) never pays
+        # the runner-construction probe below
+        if not any(isinstance(e, Aggregation) for e in dag.executors):
+            return False
+        try:
+            return self._mesh_evaluator_for(dag) is not None
+        except Exception:  # noqa: BLE001 — a broken mesh backend is "no"
+            return False
 
     def _mesh_evaluator_for(self, dag: DagRequest):
         """A MeshServingRunner when the mesh has >1 device and the DAG is an

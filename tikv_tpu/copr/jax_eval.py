@@ -1574,6 +1574,193 @@ def run_batch_cached(evaluators: list["JaxDagEvaluator"], cache) -> list[SelectR
     return out
 
 
+# ---------------------------------------------------------------------------
+# Cross-region batched execution (copr/scheduler.py's device backend)
+# ---------------------------------------------------------------------------
+
+
+class XRegionPending:
+    """An in-flight cross-region batch: the device program is dispatched
+    (async), the pull has not happened yet.  The scheduler launches batch
+    N, prepares batch N+1's caches on the host while N executes, and only
+    then calls :meth:`finalize` — double-buffering without threads."""
+
+    def __init__(self, ev: "JaxDagEvaluator", specs, capacity: int, packed,
+                 order=None):
+        self._ev = ev
+        self._specs = specs  # [(dicts, dict_lens, n_slots)] per EXECUTED region
+        self._capacity = capacity
+        self._packed = packed  # (int_m (R,Li,cap), flt_m (R,Lf,cap)) device
+        # executed-position -> caller-position (launch sorts regions by
+        # block count to canonicalize the compile key)
+        self._order = order
+
+    def finalize(self) -> list[SelectResponse]:
+        """Pull the packed states (one transfer per dtype matrix for the
+        WHOLE batch) and finalize each region through the exact same
+        host code as the per-region warm path — so responses stay
+        byte-identical to per-request serving."""
+        ev = self._ev
+        int_m, flt_m = self._packed
+        int_np = np.asarray(int_m)
+        flt_np = np.asarray(flt_m) if flt_m.shape[1] else None
+        template = ev._host_state_template()
+        out = []
+        for r, (dicts, dict_lens, n_slots) in enumerate(self._specs):
+            packed_r = (int_np[r], flt_np[r] if flt_np is not None
+                        else np.zeros((0, self._capacity), dtype=np.float64))
+            state_np = _unpack_state(packed_r, template)
+
+            def key_of(slot: int, dicts=dicts, dict_lens=dict_lens) -> tuple:
+                parts = []
+                rem = int(slot)
+                for d, dl in zip(reversed(dicts), reversed(dict_lens)):
+                    c = rem % (dl + 1)
+                    rem //= dl + 1
+                    parts.append(None if c == dl else bytes(d[c]))
+                return tuple(reversed(parts))
+
+            out.append(ev._finalize_agg(state_np, n_slots, key_of))
+        if self._order is not None:
+            restored = [None] * len(out)
+            for pos, i in enumerate(self._order):
+                restored[i] = out[pos]
+            out = restored
+        return out
+
+
+def launch_xregion_cached(ev: "JaxDagEvaluator", caches) -> XRegionPending:
+    """ONE aggregation plan over R different region images as ONE device
+    program: each region's resident blocks are padded to a shared block
+    geometry, stacked along a new leading region axis, and the per-region
+    block scan is vmapped over that axis — one dispatch and one packed pull
+    amortize the XLA/tunnel round-trip over every region in the batch.
+
+    Correctness relies on the per-block validity masks the single-region
+    step already applies: padded blocks carry ``n_valid == 0`` so padding
+    never reaches an aggregate.  Group capacities are shared (the max
+    region's, rounded to a power of two) while dictionary radices stay
+    per-region DYNAMIC inputs — so regions whose group dictionaries differ
+    still ride one compiled program.
+
+    Raises ValueError when the plan or any region's data shape is not
+    batchable (non-aggregation plan, unstable group dictionaries, empty
+    cache); the scheduler sheds those to the per-request path.
+    """
+    if ev.plan.agg is None:
+        raise ValueError("cross-region batching requires aggregation DAGs")
+    if not caches:
+        raise ValueError("cross-region batching requires at least one region")
+    specs = []
+    n_slots_max = 1
+    for cache in caches:
+        blocks = cache.blocks
+        if not blocks:
+            raise ValueError("cross-region batching over an empty block cache")
+        stable = ev._stable_dict_group_cols(blocks)
+        if ev.group_rpns and stable is None:
+            raise ValueError("cross-region batching requires stable dict group keys")
+        _gc, dicts = stable if stable else ([], [])
+        dict_lens = tuple(len(d) for d in dicts)
+        n_slots = 1
+        for dl in dict_lens:
+            n_slots *= dl + 1
+        n_slots_max = max(n_slots_max, n_slots)
+        specs.append((dicts, dict_lens, n_slots))
+    group_cols = [g.nodes[0].index for g in ev.group_rpns]
+    capacity = 1
+    while capacity < n_slots_max:
+        capacity *= 2
+    ship = ev._ship_cols(group_cols)
+    nullable = ev.nullable_cols
+    n_rows = ev.block_rows
+    # canonicalize region order by block count: the compiled program's cache
+    # key is the block-count tuple, so (2,3) and (3,2) must not compile two
+    # programs — batches differing only in arrival order share one
+    # executable.  finalize restores the caller's order.
+    order = sorted(range(len(caches)), key=lambda i: len(caches[i].blocks),
+                   reverse=True)
+    caches = [caches[i] for i in order]
+    specs = [specs[i] for i in order]
+    n_blocks = tuple(len(c.blocks) for c in caches)
+    B = max(n_blocks)
+    # per-region inputs are the caches' ALREADY-PINNED device arrays (the
+    # same pins the per-request warm path uses, kept fresh by delta
+    # scatter_update / drop_device) — zero per-row host→device traffic, and
+    # no cross-cache pin that could go stale behind a region's back
+    region_inputs = []
+    for cache in caches:
+        data, nulls = ev._stacked_device(cache, cache.blocks, ship)
+        nv, off = ev._nvoff_device(cache, cache.blocks)
+        region_inputs.append((data, nulls, nv, off))
+    dl_arr = np.array([s[1] for s in specs], dtype=np.int64).reshape(
+        len(caches), len(group_cols)
+    )
+
+    key = ("xregion", n_blocks, capacity, tuple(ship), tuple(nullable))
+    fn = ev._agg_fn_cache.get(key)
+    if fn is None:
+        device_aggs = ev.device_aggs
+        sel_rpns = ev.sel_rpns
+        track_first = bool(ev.group_rpns)
+
+        def pad_b(a):
+            pad = B - a.shape[0]
+            if pad == 0:
+                return a
+            return jnp.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
+
+        def xregion_fn(region_inputs, dl_arr):
+            padded = [jax.tree.map(pad_b, ri) for ri in region_inputs]
+            stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *padded)
+
+            def one_region(ri, dlens):
+                cd_r, cn_r, nv_r, off_r = ri
+                state = (
+                    jnp.full(capacity, _NO_ROW, dtype=jnp.int64),
+                    tuple(da.init_carry(capacity) for da in device_aggs),
+                )
+
+                def body(st, xs):
+                    cd, cn, nv, off = xs
+                    cols = _build_cols(ship, nullable, cd, cn, n_rows)
+                    if group_cols:
+                        gids = jnp.zeros(n_rows, dtype=jnp.int64)
+                        for k, gi in enumerate(group_cols):
+                            codes, gnulls = cols[gi]
+                            dlen = dlens[k]
+                            gids = gids * (dlen + 1) + jnp.where(gnulls, dlen, codes)
+                    else:
+                        gids = jnp.zeros(n_rows, dtype=jnp.int64)
+                    return _fused_step(
+                        sel_rpns, device_aggs, capacity, n_rows, cols, nv, gids, off, st,
+                        track_first=track_first,
+                    ), None
+
+                state, _ = jax.lax.scan(body, state, (cd_r, cn_r, nv_r, off_r))
+                return _pack_state(state)
+
+            return jax.vmap(one_region)(stacked, dl_arr)
+
+        fn = jax.jit(xregion_fn)
+        ev._agg_fn_cache[key] = fn
+        # block-count compositions drift (deltas, splits): bound the
+        # executables retained for this plan so compile churn cannot grow
+        # memory without limit
+        xkeys = [k for k in ev._agg_fn_cache if isinstance(k, tuple)
+                 and k and k[0] == "xregion"]
+        while len(xkeys) > 16:
+            ev._agg_fn_cache.pop(xkeys.pop(0))
+
+    packed = fn(tuple(region_inputs), dl_arr)
+    return XRegionPending(ev, specs, capacity, packed, order)
+
+
+def run_xregion_cached(ev: "JaxDagEvaluator", caches) -> list[SelectResponse]:
+    """launch + finalize in one step (tests / single-batch callers)."""
+    return launch_xregion_cached(ev, caches).finalize()
+
+
 class _ChunkExecutor:
     """Adapter: present an in-memory Chunk as a drained BatchExecutor."""
 

@@ -26,6 +26,16 @@ columnar siblings):
   path, over a power-of-two tile-count bucket so shapes stay static;
 * per-group results merge through tiny T-sized segment ops (T = n/TILE_ROWS).
 
+Scope: zone layouts are built and keyed PER CACHE (one region image), so
+they serve the per-request warm path and the same-region fused batch
+(jax_eval.run_batch_cached probes them first).  The read scheduler's
+cross-region batches (scheduler.py → jax_eval.launch_xregion_cached) bypass
+zones: a cross-region program needs one shared geometry across images whose
+cluster permutations and tile statistics differ per region — batching
+zone-tiled execution across regions would need a shared tile classification
+pass and is future work; the scheduler's padding-budget shed keeps the
+bypass bounded to batches that actually profit from stacking.
+
 Exactness contract: REAL (f64) aggregate arguments are rejected (summation
 order would differ from the CPU oracle beyond the last ulp); everything else
 is int64-lane arithmetic, so responses stay byte-identical to the CPU

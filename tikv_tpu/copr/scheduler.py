@@ -1,0 +1,769 @@
+"""Unified coprocessor read scheduler: cross-region continuous batching.
+
+The reference serves coprocessor reads through a unified read pool (yatp,
+``src/read_pool.rs``): many regions' requests multiplex onto shared workers
+with high/normal/low priorities.  This module is the device-serving
+re-expression: instead of sharing CPU workers, concurrent device-eligible
+DAG requests share **XLA dispatches**.
+
+* Requests are keyed by their **plan signature** (:func:`plan_signature` —
+  scalar ops normalized through ``sig_map`` so wire-level ScalarFuncSig
+  spellings and kernel names key identically).  Same signature = same
+  compiled program shape.
+* Requests with the same signature but different regions batch into ONE
+  device program: each region's cached column image (PR 1's
+  ``region_cache.py``) is padded to a shared block geometry and stacked
+  along a new leading region axis (``jax_eval.launch_xregion_cached``),
+  with per-region row-count masks so padding never changes results.
+* Requests over the SAME cached region view with different plans keep the
+  old fused path (``jax_eval.run_batch_cached``), now living here instead
+  of ``endpoint._try_fused_batch``.
+* Everything else — ineligible plans, cold/unresolvable caches, shed
+  requests — serves through ``endpoint.handle_request`` unchanged, so the
+  scheduler only ever *removes* dispatches, never changes bytes.
+
+Continuous-batching semantics:
+
+* three priority lanes (``high`` / ``normal`` / ``low``, mirroring the
+  read-pool priorities) with per-lane max-wait knobs;
+* a bounded queue — beyond ``max_queue`` pending requests, admission
+  control sheds new arrivals straight to the per-request path;
+* ``max_batch`` bounds one program's fan-in; oversize groups chunk;
+* a padding budget sheds block-count outliers from a cross-region batch
+  (one giant region would otherwise pad every small region up to its
+  geometry — the giant serves per-request, where its size already
+  amortizes the dispatch);
+* double-buffering: batch N executes on device (async dispatch) while the
+  host runs batch N+1's cache resolution — the region cache's fill/delta
+  pass — and batch N's pull happens only after N+1 is launched.
+
+Metrics: queue depth, batch occupancy, padding waste, per-lane wait — see
+``docs/copr_scheduler.md`` and the coprocessor Grafana dashboard.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from . import jax_eval
+from .dag import (
+    Aggregation,
+    DagRequest,
+    IndexScan,
+    Limit,
+    Selection,
+    TableScan,
+    TopN,
+)
+from .endpoint import REQ_TYPE_DAG, CoprRequest, CoprResponse
+from .region_cache import _epoch_of, schema_sig
+from .rpn import ColumnRef, Constant, FuncCall
+from .sig_map import resolve_sig
+
+LANES = ("high", "normal", "low")
+
+
+@dataclass
+class SchedulerConfig:
+    """Admission-control knobs (read_pool.rs's pool sizing analog)."""
+
+    max_batch: int = 64            # regions/queries fused into one program
+    max_queue: int = 256           # pending cap before admission sheds
+    padding_budget: float = 0.5    # max wasted fraction of padded block slots
+    max_wait_s: float = 0.004      # normal-lane linger before partial dispatch
+    high_max_wait_s: float = 0.001
+    low_max_wait_s: float = 0.02
+
+    def wait_for(self, lane: str) -> float:
+        if lane == "high":
+            return self.high_max_wait_s
+        if lane == "low":
+            return self.low_max_wait_s
+        return self.max_wait_s
+
+
+def _lane_of(req: CoprRequest) -> str:
+    lane = (req.context or {}).get("priority", "normal")
+    return lane if lane in LANES else "normal"
+
+
+def _expr_sig(e):
+    """Canonical, hashable form of a scalar expression tree."""
+    if e is None:
+        return None
+    if isinstance(e, ColumnRef):
+        return ("col", e.index)
+    if isinstance(e, Constant):
+        v = e.value
+        if not isinstance(v, (int, float, bytes, str, bool, type(None))):
+            v = repr(v)
+        return ("const", e.eval_type, e.frac, v)
+    if isinstance(e, FuncCall):
+        op = e.op
+        # wire-format ScalarFuncSig spellings fold onto kernel names, so a
+        # tipb-bridged DAG and a natively-built DAG with the same plan key
+        # into the same micro-batch (sig_map is the single source of truth)
+        mapped = resolve_sig(op)
+        if mapped is not None and not mapped.startswith("~"):
+            op = mapped
+        return ("fn", op, tuple(_expr_sig(c) for c in e.children))
+    return ("?", repr(e))
+
+
+def plan_signature(dag: DagRequest) -> tuple:
+    """The micro-batch key: two DAGs with equal signatures compile to the
+    same device program shape, so their executions can share one dispatch
+    (over different region images)."""
+    parts = []
+    for ex in dag.executors:
+        if isinstance(ex, TableScan):
+            parts.append(("tablescan", ex.table_id, schema_sig(ex.columns_info)))
+        elif isinstance(ex, IndexScan):
+            parts.append(("indexscan", ex.table_id, ex.index_id,
+                          schema_sig(ex.columns_info)))
+        elif isinstance(ex, Selection):
+            parts.append(("sel", tuple(_expr_sig(c) for c in ex.conditions)))
+        elif isinstance(ex, Aggregation):
+            parts.append(("agg", bool(ex.streamed),
+                          tuple(_expr_sig(g) for g in ex.group_by),
+                          tuple((a.op, _expr_sig(a.expr)) for a in ex.agg_funcs)))
+        elif isinstance(ex, TopN):
+            parts.append(("topn", ex.limit,
+                          tuple((_expr_sig(e), bool(d)) for e, d in ex.order_by)))
+        elif isinstance(ex, Limit):
+            parts.append(("limit", ex.limit))
+        else:
+            parts.append((type(ex).__name__,))
+    parts.append(("out", tuple(dag.output_offsets or ()), dag.chunk_rows))
+    return tuple(parts)
+
+
+@dataclass
+class _Item:
+    req: CoprRequest
+    index: int
+    lane: str = "normal"
+    ticket: "_Ticket | None" = None
+    enqueue_t: float = 0.0
+    sig: tuple | None = None  # plan signature, set once during grouping
+
+
+class _Ticket:
+    """One continuous-mode submission: the caller blocks on ``done`` while
+    the dispatcher batches and serves.  ``direct`` hands the request back
+    to the caller's thread (shed / ineligible work must not serialize the
+    whole dispatcher behind one slow per-request execution)."""
+
+    __slots__ = ("done", "resp", "error", "direct")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.resp: CoprResponse | None = None
+        self.error: BaseException | None = None
+        self.direct = False
+
+
+@dataclass
+class _Slot:
+    """One distinct (plan, region view) execution slot in a micro-batch.
+    Multiple identical requests share the slot (and its response bytes)."""
+
+    items: list = field(default_factory=list)
+    cache: object = None
+    outcome: str = ""
+
+
+class CoprReadScheduler:
+    """The unified read scheduler over one :class:`~.endpoint.Endpoint`."""
+
+    def __init__(self, endpoint, config: SchedulerConfig | None = None):
+        self.ep = endpoint
+        self.cfg = config or SchedulerConfig()
+        self._mu = threading.Condition(threading.Lock())
+        self._queues: dict[str, list[_Item]] = {lane: [] for lane in LANES}
+        self._running = False
+        self._thread: threading.Thread | None = None
+        # per-signature memos: device eligibility (supports() re-analyzes the
+        # whole plan) and the compiled evaluator (endpoint._evaluator_for
+        # keys on serialized plan bytes — ~1ms of wire encoding per lookup
+        # that a batch of identical-signature requests should pay once)
+        self._memo_mu = threading.Lock()
+        self._supports: dict[tuple, bool] = {}
+        self._evs: dict[tuple, object] = {}
+
+    # -- synchronous entry (endpoint.handle_batch / batch_coprocessor) -----
+
+    def run_batch(self, reqs: list[CoprRequest]) -> list[CoprResponse]:
+        items = [_Item(req=r, index=i, lane=_lane_of(r)) for i, r in enumerate(reqs)]
+        results, errors = self._serve(items)
+        first = next((e for e in errors if e is not None), None)
+        if first is not None:
+            # the pre-scheduler handle_batch aborted on the first raising
+            # request; the service layer catches and re-serves per slot —
+            # keep that contract for the synchronous surface
+            raise first
+        return results
+
+    # -- continuous entry (unary requests coalescing across clients) -------
+
+    @property
+    def running(self) -> bool:
+        return self._running
+
+    def start(self) -> None:
+        with self._mu:
+            if self._running:
+                return
+            self._running = True
+            self._thread = threading.Thread(
+                target=self._dispatch_loop, daemon=True, name="copr-sched"
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._mu:
+            if not self._running:
+                return
+            self._running = False
+            self._mu.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def execute(self, req: CoprRequest, timeout: float | None = None) -> CoprResponse:
+        """Continuous-mode unary entry: enqueue into the request's priority
+        lane and wait for the batch that serves it.  Falls back to the
+        direct path when the scheduler is stopped, the request is not
+        batchable, or admission control sheds it."""
+        if (not self._running or not self.ep._gate_ok("batch")
+                or not self._batchable(req)):
+            # the BATCH_FUSION gate guards this path exactly like
+            # handle_batch: a mixed-version cluster keeps fusion off
+            return self.ep.handle_request(req)
+        item = _Item(req=req, index=0, lane=_lane_of(req), ticket=_Ticket(),
+                     enqueue_t=time.perf_counter())
+        with self._mu:
+            # re-check under the lock: a stop() racing this enqueue drains
+            # the queues once — anything appended after that drain would
+            # never be served and the caller would block forever
+            if not self._running:
+                do_direct = True
+            elif sum(len(q) for q in self._queues.values()) >= self.cfg.max_queue:
+                self._count_shed("queue_full")
+                do_direct = True
+            else:
+                do_direct = False
+                self._queues[item.lane].append(item)
+                self._gauge_depth()
+                self._mu.notify_all()
+        if do_direct:
+            return self.ep.handle_request(req)
+        item.ticket.done.wait(timeout)
+        if not item.ticket.done.is_set():
+            raise TimeoutError("scheduler did not serve the request in time")
+        if item.ticket.direct:
+            # the dispatcher shed this request back: serve it on OUR thread
+            # so one slow per-request path cannot stall every lane
+            return self.ep.handle_request(req)
+        if item.ticket.error is not None:
+            raise item.ticket.error
+        return item.ticket.resp
+
+    def _dispatch_loop(self) -> None:
+        cfg = self.cfg
+        while True:
+            with self._mu:
+                while self._running and not any(self._queues.values()):
+                    self._mu.wait(0.5)
+                if not self._running:
+                    # drain whatever is queued so no caller hangs forever
+                    batch = [it for lane in LANES for it in self._queues[lane]]
+                    for lane in LANES:
+                        self._queues[lane].clear()
+                    self._gauge_depth()
+                    if batch:
+                        self._serve_ticketed(batch)
+                    return
+                # linger until the oldest item's lane deadline or max_batch
+                now = time.perf_counter()
+                deadline = min(
+                    it.enqueue_t + cfg.wait_for(lane)
+                    for lane in LANES
+                    for it in self._queues[lane]
+                )
+                total = sum(len(q) for q in self._queues.values())
+                if total < cfg.max_batch and now < deadline:
+                    self._mu.wait(min(deadline - now, 0.05))
+                    continue
+                batch = []
+                for lane in LANES:  # high lane drains first
+                    while self._queues[lane] and len(batch) < cfg.max_batch:
+                        batch.append(self._queues[lane].pop(0))
+                self._gauge_depth()
+            if batch:
+                for it in batch:
+                    self._observe_wait(it)
+                self._serve_ticketed(batch)
+
+    def _serve_ticketed(self, batch: list[_Item]) -> None:
+        for i, it in enumerate(batch):
+            it.index = i
+        try:
+            results, errors = self._serve(batch)
+        except BaseException as exc:  # noqa: BLE001 — scheduler bug: fail all
+            for it in batch:
+                it.ticket.error = exc
+                it.ticket.done.set()
+            return
+        # per-ticket delivery: one request's lock conflict or decode error
+        # must not poison the riders that coalesced into the same batch
+        for it in batch:
+            if it.ticket.done.is_set():
+                continue  # already handed back to its caller (direct)
+            if errors[it.index] is not None:
+                it.ticket.error = errors[it.index]
+            else:
+                it.ticket.resp = results[it.index]
+            it.ticket.done.set()
+
+    # -- the scheduler core -------------------------------------------------
+
+    def _serve(self, items: list[_Item]):
+        """Returns (results, errors), index-aligned with ``items``: exactly
+        one of results[i] / errors[i] is set per item, so callers deliver
+        failures per request instead of poisoning the whole batch."""
+        results: list[CoprResponse | None] = [None] * len(items)
+        errors: list[BaseException | None] = [None] * len(items)
+        # group by plan signature, then by distinct region view within a sig
+        by_sig: dict[tuple, dict[tuple, _Slot]] = {}
+        rest = []
+        for it in items:
+            sig = self._batchable_sig(it.req)
+            if sig is None:
+                rest.append(it)
+                continue
+            it.sig = sig
+            rkey = self._region_key(it.req)
+            by_sig.setdefault(sig, {}).setdefault(rkey, _Slot()).items.append(it)
+
+        exec_groups: list[tuple] = []  # ("xregion", dag, [slots]) | ("fused", key, [items])
+        leftovers: list[_Item] = []
+        for sig, slots in by_sig.items():
+            if len(slots) >= 2:
+                slot_list = list(slots.values())
+                for s in range(0, len(slot_list), self.cfg.max_batch):
+                    exec_groups.append(("xregion", sig,
+                                        slot_list[s:s + self.cfg.max_batch]))
+            else:
+                leftovers.extend(next(iter(slots.values())).items)
+        # same region view, different plans: the old fused batch shape
+        by_cache: dict[tuple, list[_Item]] = {}
+        for it in leftovers:
+            by_cache.setdefault(self._region_key(it.req), []).append(it)
+        for key, group in by_cache.items():
+            if len(group) >= 2:
+                for s in range(0, len(group), self.cfg.max_batch):
+                    exec_groups.append(("fused", key, group[s:s + self.cfg.max_batch]))
+            else:
+                rest.extend(group)
+
+        # high-priority groups launch first
+        lane_rank = {lane: i for i, lane in enumerate(LANES)}
+        exec_groups.sort(key=lambda g: min(
+            lane_rank[it.lane]
+            for it in (sum((s.items for s in g[2]), []) if g[0] == "xregion" else g[2])
+        ))
+
+        # double-buffered pipeline: resolve (host fill/delta) group i while
+        # group i-1 executes on device; pull i-1 only after i is launched
+        pending = None
+        for kind, meta, group in exec_groups:
+            if kind == "xregion":
+                launched = self._launch_xregion(meta, group, results, errors)
+            else:
+                launched = self._run_fused(meta, group, results, errors)
+            if pending is not None:
+                pending(results, errors)
+            pending = launched
+        if pending is not None:
+            pending(results, errors)
+
+        for it in rest:
+            self._per_request(it, results, errors, kind="direct")
+        return results, errors
+
+    # -- eligibility & keying ----------------------------------------------
+
+    def _batchable(self, req: CoprRequest) -> bool:
+        return self._batchable_sig(req) is not None
+
+    def _batchable_sig(self, req: CoprRequest) -> tuple | None:
+        """The request's plan signature when it can join a device batch,
+        else None.  supports() verdicts memoize per signature."""
+        if (req.tp != REQ_TYPE_DAG or req.dag is None
+                or not self.ep.device_enabled()
+                or not any(isinstance(e, Aggregation) for e in req.dag.executors)):
+            return None
+        sig = plan_signature(req.dag)
+        ok = self._supports.get(sig)
+        if ok is None:
+            ok = jax_eval.supports(req.dag)
+            # memo mutation under its own lock: _batchable runs on client
+            # threads AND the dispatcher; racing evictions of the same key
+            # would KeyError
+            with self._memo_mu:
+                self._supports[sig] = ok
+                while len(self._supports) > 256:
+                    self._supports.pop(next(iter(self._supports)))
+        return sig if ok else None
+
+    def _evaluator_for(self, sig: tuple, dag: DagRequest):
+        ev = self._evs.get(sig)
+        if ev is None:
+            ev = self.ep._evaluator_for(dag)
+            with self._memo_mu:
+                self._evs[sig] = ev
+                while len(self._evs) > 64:
+                    self._evs.pop(next(iter(self._evs)))
+        return ev
+
+    def _region_key(self, req: CoprRequest) -> tuple:
+        ctx = req.context or {}
+        return (
+            ctx.get("region_id"),
+            tuple(req.ranges),
+            req.start_ts,
+            ctx.get("cache_version"),
+            ctx.get("apply_index"),
+            _epoch_of(ctx.get("region_epoch")),  # normalizes tuple/list/object
+        )
+
+    # -- cache resolution (the host-side fill/delta pass) -------------------
+
+    def _resolve_slot(self, slot: _Slot) -> bool:
+        """Resolve a slot's region view to a FILLED block cache, running the
+        region cache's build/delta pass if needed.  Returns False when the
+        slot must shed to the per-request path."""
+        from .tracker import Tracker
+
+        req = slot.items[0].req
+        if self.ep.cm is not None:
+            # every item in a slot shares (ranges, start_ts) by construction
+            # of _region_key — one lock-range scan covers the whole slot
+            from ..storage.txn_types import Key
+
+            for start, end in req.ranges:
+                self.ep.cm.read_range_check(
+                    Key.from_raw(start), Key.from_raw(end), req.start_ts
+                )
+        snap = self.ep.engine.snapshot(req.context or None)
+        tracker = Tracker()
+        cache, outcome = self.ep._region_cache_for(req, snap, tracker)
+        if cache is None:
+            cache = self.ep._block_cache_for(req)
+            outcome = ""
+        if cache is None:
+            return False
+        if not cache.filled:
+            # cold block cache: the first request fills it through the
+            # normal per-request path (and keeps its own answer); the rest
+            # of the slot then serves from the filled blocks
+            filler = slot.items[0]
+            resp = self.ep.handle_request(filler.req)
+            self._stamp(resp, filler, kind="fill", occupancy=1)
+            filler._filled_resp = resp  # type: ignore[attr-defined]
+            if not cache.filled or not cache.blocks:
+                return False
+        slot.cache = cache
+        slot.outcome = outcome
+        return True
+
+    # -- execution groups ---------------------------------------------------
+
+    def _launch_xregion(self, sig: tuple, slots: list[_Slot], results, errors):
+        """Resolve every slot's cache (host), shed what cannot batch, and
+        dispatch ONE cross-region program.  Returns the finalize closure."""
+        live: list[_Slot] = []
+        for slot in slots:
+            ok = False
+            try:
+                ok = self._resolve_slot(slot)
+            except Exception:  # noqa: BLE001 — resolution must not kill the batch
+                ok = False
+            if ok:
+                live.append(slot)
+                # a cold-fill answered the slot's first request already
+                for it in slot.items:
+                    resp = getattr(it, "_filled_resp", None)
+                    if resp is not None:
+                        results[it.index] = resp
+            else:
+                self._shed(slot, "no_cache", results, errors)
+        # two slots (different start_ts / apply_index) can resolve to the
+        # SAME region image — the region cache keys images on (region_id,
+        # ranges, schema) only, and resolving the later slot delta-applies
+        # the image IN PLACE, retroactively changing what the earlier slot's
+        # resolution saw.  Only the LAST resolution's view is current, so
+        # only that slot may batch; earlier aliases shed to the per-request
+        # path, where serve() re-resolves them (a now-stale start_ts takes
+        # the stale fallback) — snapshot isolation over bytes saved.
+        by_image: dict[int, _Slot] = {}
+        for slot in live:
+            prev = by_image.get(id(slot.cache))
+            if prev is not None:
+                self._shed(prev, "aliased_image", results, errors)
+            by_image[id(slot.cache)] = slot
+        live = [s for s in live if by_image.get(id(s.cache)) is s]
+        live = self._shed_for_padding(live, results, errors)
+        if len(live) < 2:
+            for slot in live:
+                self._shed(slot, "underfull", results, errors)
+            return None
+        ev = self._evaluator_for(sig, live[0].items[0].req.dag)
+        # cold-fills were answered (and counted) by their own handle_request
+        # — the program serves the rest; occupancy counts the whole fan-in.
+        # Counted over the FINAL live set: a filled slot shed above (alias /
+        # padding) must not deflate this batch's request count.
+        n_batch = sum(len(s.items) for s in live)
+        n_filled = sum(
+            1 for s in live for it in s.items
+            if getattr(it, "_filled_resp", None) is not None
+        )
+        n_reqs = max(n_batch - n_filled, 1)
+        waste = self._padding_waste(live)
+        t0 = time.perf_counter()
+        try:
+            pending = jax_eval.launch_xregion_cached(ev, [s.cache for s in live])
+        except ValueError:
+            # "not batchable" (empty blocks, unstable dictionaries) is a
+            # documented decline, not a device failure — shed without
+            # polluting the fallback counter
+            for slot in live:
+                self._shed(slot, "ineligible", results, errors)
+            return None
+        except Exception as exc:  # noqa: BLE001 — CPU pipeline is the oracle
+            self._device_failed(exc)
+            for slot in live:
+                self._shed(slot, "device_error", results, errors)
+            return None
+        t_launched = time.perf_counter()
+
+        def finalize(results, errors):
+            t_fin = time.perf_counter()
+            try:
+                resps = pending.finalize()
+            except Exception as exc:  # noqa: BLE001
+                self._device_failed(exc)
+                for slot in live:
+                    self._shed(slot, "device_error", results, errors)
+                return
+            # latency = this group's own host work (launch) + the blocking
+            # pull (residual device time).  The gap between launch and
+            # finalize is the NEXT group's prepare pass — double-buffered
+            # overlap, not this batch's cost; attributing it here would
+            # inflate the device-path percentiles with unrelated host work.
+            dt = (t_launched - t0) + (time.perf_counter() - t_fin)
+            self._batch_metrics("xregion", n_reqs, dt, waste, n_batch=n_batch)
+            for slot, resp in zip(live, resps):
+                data = resp.encode()
+                from_cache = slot.outcome not in ("", "miss", "too_big")
+                for it in slot.items:
+                    if results[it.index] is not None:
+                        continue  # the cold-fill already answered this one
+                    r = CoprResponse(data, from_device=True, from_cache=from_cache)
+                    self._stamp(r, it, kind="xregion", occupancy=n_batch,
+                                waste=waste, total_s=dt / n_reqs)
+                    results[it.index] = r
+
+        return finalize
+
+    def _run_fused(self, key, items: list[_Item], results, errors):
+        """Same region view, K different plans: the fused batch inherited
+        from endpoint._try_fused_batch (run_batch_cached fuses all K into
+        one program over the shared cache)."""
+        slot = _Slot(items=items)
+        try:
+            ok = self._resolve_slot(slot)
+        except Exception:  # noqa: BLE001
+            ok = False
+        if not ok:
+            self._shed(slot, "no_cache", results, errors)
+            return None
+        cache = slot.cache
+        # the filler (cold cache) already answered slot.items[0]
+        todo = [it for it in items if getattr(it, "_filled_resp", None) is None]
+        for it in items:
+            resp = getattr(it, "_filled_resp", None)
+            if resp is not None:
+                results[it.index] = resp
+        if not todo:
+            return None
+        n_reqs = len(todo)
+        # identical requests (same signature over this region view) share one
+        # query in the fused program — the cross-client dedupe
+        uniq: dict[tuple, list[_Item]] = {}
+        for it in todo:
+            uniq.setdefault(it.sig, []).append(it)
+        t0 = time.perf_counter()
+        try:
+            evs = [self._evaluator_for(sig, group[0].req.dag)
+                   for sig, group in uniq.items()]
+            resps = jax_eval.run_batch_cached(evs, cache)
+        except ValueError:
+            # a documented decline (non-stable group dictionaries, empty
+            # cache) — per-request path, no device-failure attribution
+            self._shed(_Slot(items=todo), "ineligible", results, errors)
+            return None
+        except Exception as exc:  # noqa: BLE001
+            # _resolve_slot guarantees a filled cache here, so there is no
+            # partial fill to clean up (the cold-fill path owns that)
+            self._device_failed(exc)
+            self._shed(_Slot(items=todo), "device_error", results, errors)
+            return None
+        dt = time.perf_counter() - t0
+        self._batch_metrics("fused", n_reqs, dt, 0.0, n_batch=len(items))
+        from_cache = slot.outcome not in ("", "miss", "too_big")
+        for group, resp in zip(uniq.values(), resps):
+            data = resp.encode()
+            for it in group:
+                r = CoprResponse(data, from_device=True, from_cache=from_cache)
+                self._stamp(r, it, kind="fused", occupancy=n_reqs,
+                            total_s=dt / n_reqs)
+                results[it.index] = r
+        return None
+
+    # -- admission ----------------------------------------------------------
+
+    @staticmethod
+    def _padding_waste(slots: list[_Slot]) -> float:
+        if not slots:
+            return 0.0
+        counts = [len(s.cache.blocks) for s in slots]
+        b = max(counts)
+        return 1.0 - sum(counts) / (len(counts) * b)
+
+    def _shed_for_padding(self, slots: list[_Slot], results, errors) -> list[_Slot]:
+        """Shed block-count outliers until the padded geometry wastes no
+        more than the budget.  The LARGEST region sheds (its per-request
+        dispatch is already amortized over its rows; keeping it would pad
+        every smaller region up to its block count)."""
+        live = list(slots)
+        while len(live) > 1 and self._padding_waste(live) > self.cfg.padding_budget:
+            biggest = max(live, key=lambda s: len(s.cache.blocks))
+            live.remove(biggest)
+            self._shed(biggest, "padding", results, errors)
+        return live
+
+    def _per_request(self, it: _Item, results, errors, kind: str) -> None:
+        """Serve one item on the per-request path, capturing its failure in
+        ``errors`` so it stays its own (old unary semantics per request).
+        Ticketed (continuous-mode) items are handed back to their caller's
+        thread instead — executing them here would serialize every lane
+        behind the dispatcher."""
+        if results[it.index] is not None or errors[it.index] is not None:
+            return
+        if it.ticket is not None and not it.ticket.done.is_set():
+            it.ticket.direct = True
+            it.ticket.done.set()
+            return
+        try:
+            resp = self.ep.handle_request(it.req)
+        except BaseException as exc:  # noqa: BLE001 — delivered per item
+            errors[it.index] = exc
+            return
+        self._stamp(resp, it, kind=kind, occupancy=1)
+        results[it.index] = resp
+
+    def _shed(self, slot: _Slot, reason: str, results, errors) -> None:
+        self._count_shed(reason)
+        for it in slot.items:
+            self._per_request(it, results, errors, kind="shed:" + reason)
+
+    def _device_failed(self, exc: BaseException) -> None:
+        from ..util.metrics import REGISTRY
+
+        self.ep.device_fallbacks += 1
+        self.ep.last_device_error = repr(exc)
+        REGISTRY.counter(
+            "tikv_coprocessor_device_fallback_total",
+            "Device-path failures that re-ran on the CPU pipeline",
+        ).inc()
+
+    # -- metrics ------------------------------------------------------------
+
+    def _stamp(self, resp: CoprResponse, it: _Item, kind: str, occupancy: int,
+               waste: float | None = None, total_s: float | None = None) -> None:
+        from .tracker import stamp_sched
+
+        resp.metrics = stamp_sched(resp.metrics, it.lane, kind, occupancy,
+                                   waste=waste, total_s=total_s)
+
+    def _batch_metrics(self, kind: str, n_reqs: int, dt: float, waste: float,
+                       n_batch: int | None = None) -> None:
+        """``n_reqs``: requests the device program answered (request_total /
+        duration series — exactly-once, so a cold-fill counted by its own
+        handle_request is excluded).  ``n_batch``: the batch's whole fan-in
+        including the fill (batch/occupancy series)."""
+        from ..util.metrics import REGISTRY
+
+        n_batch = n_batch or n_reqs
+        # the per-request series stay truthful under batch serving — one
+        # duration observation PER REQUEST (each at the per-request share),
+        # not a single mean observation, so count-weighted percentiles
+        # compare honestly against the unary path
+        REGISTRY.counter(
+            "tikv_coprocessor_request_total", "Coprocessor requests, by type/path"
+        ).inc(n_reqs, tp=str(REQ_TYPE_DAG), path="device")
+        h = REGISTRY.histogram(
+            "tikv_coprocessor_request_duration_seconds", "Coprocessor latency"
+        )
+        for _ in range(n_reqs):
+            h.observe(dt / n_reqs, tp=str(REQ_TYPE_DAG))
+        REGISTRY.counter(
+            "tikv_coprocessor_batch_total", "Fused coprocessor batches"
+        ).inc()
+        REGISTRY.counter(
+            "tikv_coprocessor_batch_queries_total", "Queries served fused"
+        ).inc(n_batch)
+        REGISTRY.counter(
+            "tikv_coprocessor_sched_batches_total",
+            "Scheduler micro-batches dispatched, by kind",
+        ).inc(kind=kind)
+        REGISTRY.histogram(
+            "tikv_coprocessor_sched_batch_occupancy",
+            "Requests per scheduler micro-batch",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+        ).observe(n_batch, kind=kind)
+        REGISTRY.histogram(
+            "tikv_coprocessor_sched_padding_waste",
+            "Wasted fraction of padded block slots per cross-region batch",
+            buckets=(0.01, 0.05, 0.1, 0.2, 0.3, 0.5, 0.75, 1.0),
+        ).observe(waste, kind=kind)
+
+    def _count_shed(self, reason: str) -> None:
+        from ..util.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "tikv_coprocessor_sched_shed_total",
+            "Requests shed to the per-request path, by reason",
+        ).inc(reason=reason)
+
+    def _gauge_depth(self) -> None:
+        from ..util.metrics import REGISTRY
+
+        g = REGISTRY.gauge(
+            "tikv_coprocessor_sched_queue_depth",
+            "Requests waiting in the scheduler, by priority lane",
+        )
+        for lane in LANES:
+            g.set(len(self._queues[lane]), lane=lane)
+
+    def _observe_wait(self, it: _Item) -> None:
+        from ..util.metrics import REGISTRY
+
+        REGISTRY.histogram(
+            "tikv_coprocessor_sched_lane_wait_seconds",
+            "Queue wait before dispatch, by priority lane",
+        ).observe(time.perf_counter() - it.enqueue_t, lane=it.lane)

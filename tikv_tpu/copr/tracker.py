@@ -44,6 +44,29 @@ class TrackedMetrics:
         return d
 
 
+def stamp_sched(md: dict | None, lane: str, kind: str, occupancy: int,
+                waste: float | None = None,
+                total_s: float | None = None) -> dict:
+    """Read-scheduler placement keys for a response-metrics dict (the same
+    dict :meth:`TrackedMetrics.to_dict` produces for tracked paths):
+    ``sched_lane`` — the priority lane served from; ``sched_batch`` — the
+    micro-batch kind (``xregion`` / ``fused`` / ``fill`` / ``direct`` /
+    ``shed:<reason>``); ``batch_occupancy`` — requests sharing the
+    dispatch; ``padding_waste`` — a cross-region batch's padded-geometry
+    waste fraction.  ``total_s`` overrides the tracked total for requests
+    whose latency was paid inside a shared batch."""
+    d = dict(md or {})
+    d["sched_lane"] = lane
+    d["sched_batch"] = kind
+    d["batch_occupancy"] = occupancy
+    if waste is not None:
+        d["padding_waste"] = round(waste, 4)
+    if total_s is not None:
+        d["total_s"] = total_s
+        d["from_device"] = True
+    return d
+
+
 class Tracker:
     """Phase stopwatch for one request."""
 

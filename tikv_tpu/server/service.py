@@ -939,10 +939,23 @@ class KvService:
 
     def coprocessor(self, req: dict) -> dict:
         """req: {tp, dag (DagRequest in-process, or wire dict; optional for
-        CHECKSUM), ranges, start_ts}."""
+        CHECKSUM), ranges, start_ts}.
+
+        When the endpoint's read scheduler runs in continuous mode, unary
+        requests route through it: concurrent clients' device-eligible DAGs
+        coalesce into cross-region micro-batches (scheduler.py), each thread
+        blocking only until the batch that carries its request completes —
+        the unified-read-pool serving shape with XLA dispatches as the
+        shared resource.  With the scheduler stopped (the default), this is
+        the plain per-request path."""
         assert self.copr is not None, "coprocessor endpoint not wired"
         try:
-            r = self.copr.handle_request(self._parse_copr_request(req))
+            creq = self._parse_copr_request(req)
+            sched = getattr(self.copr, "scheduler", None)
+            if sched is not None and sched.running:
+                r = sched.execute(creq)
+            else:
+                r = self.copr.handle_request(creq)
             return {"data": r.data, "from_device": r.from_device}
         except Exception as e:  # noqa: BLE001
             return {"error": _err(e)}

@@ -92,6 +92,7 @@ class StoreServer:
         security=None,
         raft_engine: bool = True,
         encryption_master_key: str | None = None,
+        sched_continuous: bool = False,
     ):
         self.pd = pd
         self.security = security
@@ -149,6 +150,11 @@ class StoreServer:
             mesh=_default_mesh() if enable_device else None,
             feature_gate=self.feature_gate,
         )
+        if sched_continuous:
+            # continuous cross-region batching: unary coprocessor requests
+            # from concurrent connections coalesce in the read scheduler's
+            # priority lanes (service.coprocessor routes through it)
+            self.copr.scheduler.start()
         self.gc_worker = GcWorker(self.raftkv)
         # wait-for edges route to the cluster detector leader (region 1's
         # leader store); cross-store lock cycles break by error, not timeout
@@ -376,6 +382,7 @@ class StoreServer:
         raise TimeoutError("cluster never formed")
 
     def stop(self) -> None:
+        self.copr.scheduler.stop()
         self._ttl_stop.set()
         self._rts_stop.set()
         # the advance thread inserts into _peer_clients: join it BEFORE
@@ -408,6 +415,9 @@ def main(argv=None) -> int:
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--expect-stores", type=int, default=1)
     ap.add_argument("--enable-device", action="store_true")
+    ap.add_argument("--sched-continuous", action="store_true",
+                    help="coalesce unary coprocessor requests across "
+                         "connections in the read scheduler's priority lanes")
     ap.add_argument("--no-raft-engine", action="store_true",
                     help="keep the raft log in CF_RAFT instead of the segmented log engine")
     ap.add_argument("--ca-path", default="")
@@ -438,6 +448,7 @@ def main(argv=None) -> int:
         host=args.host, port=args.port, enable_device=args.enable_device,
         security=security, raft_engine=not args.no_raft_engine,
         encryption_master_key=args.encryption_master_key,
+        sched_continuous=args.sched_continuous,
     )
     srv.start()
     srv.bootstrap_or_join(args.expect_stores)
