@@ -563,47 +563,29 @@ def _xregion_q6(cut: int):
     ])
 
 
-def _op_xregion(req, state):
-    """xregion_batch event: the unified read scheduler's cross-region
-    continuous batching (copr/scheduler.py) vs per-request device serving.
-
-    An 8-region table serves a mixed workload — a Q6-shaped selection
-    aggregate, a second Q6 variant (different signature), and the Q1
-    group-by — issued by ``clients`` concurrent clients per region, the
-    batch_commands fan-in shape.  Serial = one handle_request per request
-    (today's per-request device path, warm region-cache hits throughout);
-    batched = ONE handle_batch, which the scheduler collapses into one
-    cross-region program per plan signature (identical requests from
-    different clients share an execution slot).  Responses must be
-    byte-identical to the serial path AND the CPU pipeline."""
-    import numpy as _np
-
-    from tikv_tpu.copr.endpoint import CoprRequest, Endpoint
+def _xregion_harness(req, seed: int):
+    """Shared fixture for the xregion events: the loaded engine, the block
+    geometry, and the mixed-workload request sweep (two Q6-shaped
+    signatures + the Q1 group-by, ``clients`` per (region, query))."""
+    from tikv_tpu.copr.endpoint import CoprRequest
     from tikv_tpu.copr.table import record_key
     from tikv_tpu.storage.btree_engine import BTreeEngine
     from tikv_tpu.storage.engine import CF_WRITE
-    from tikv_tpu.storage.kv import LocalEngine
     from tikv_tpu.storage.txn_types import Key, Write, WriteType
 
     regions = req.get("regions", 8)
     rows_per = req.get("rows", 32000) // regions
     clients = req.get("clients", 3)
-    trials = req.get("trials", 5)
-    n = regions * rows_per
-    kvs = build_kvs(n, seed=17)
+    kvs = build_kvs(regions * rows_per, seed=seed)
     eng = BTreeEngine()
-    items = []
-    for rk, v in kvs:
-        items.append(
-            (Key.from_raw(rk).append_ts(20).encoded, Write(WriteType.PUT, 10, short_value=v).to_bytes())
-        )
-    eng.bulk_load(CF_WRITE, items)
+    eng.bulk_load(CF_WRITE, [
+        (Key.from_raw(rk).append_ts(20).encoded,
+         Write(WriteType.PUT, 10, short_value=v).to_bytes())
+        for rk, v in kvs
+    ])
     # block geometry sized to the region: padding a 4k-row region to the 64k
     # default would spend 16x the compute per dispatch and bury the win
     block_rows = 1 << max(10, (rows_per - 1).bit_length())
-    ep = Endpoint(LocalEngine(eng), enable_device=True, block_rows=block_rows)
-    ep_cpu = Endpoint(LocalEngine(eng), enable_device=False)
-
     dags = [lambda: _xregion_q6(10500), lambda: _xregion_q6(9000), q1_dag]
 
     def mk(region, dag_fn):
@@ -617,10 +599,15 @@ def _op_xregion(req, state):
         return [mk(r, d) for d in dags for r in range(regions)
                 for _ in range(clients)]
 
-    # warmup: fill region images, compile both paths
-    for _ in range(2):
-        serial = [ep.handle_request(q) for q in sweep()]
-        batched = ep.handle_batch(sweep())
+    return eng, block_rows, sweep, regions, rows_per, clients
+
+
+def _xregion_trials(ep_serial, ep_batch, ep_cpu, sweep, trials: int):
+    """Warm both endpoints, assert three-way byte-identity (serial path,
+    batched path, CPU oracle), then time serial-vs-batched sweeps."""
+    for _ in range(2):  # warmup: fill region images, compile both paths
+        serial = [ep_serial.handle_request(q) for q in sweep()]
+        batched = ep_batch.handle_batch(sweep())
     oracle = [ep_cpu.handle_request(q) for q in sweep()]
     match = all(s.data == b.data == o.data
                 for s, b, o in zip(serial, batched, oracle))
@@ -629,28 +616,94 @@ def _op_xregion(req, state):
     for _ in range(trials):
         t0 = time.perf_counter()
         for q in sweep():
-            ep.handle_request(q)
+            ep_serial.handle_request(q)
         serial_ts.append(time.perf_counter() - t0)
         t0 = time.perf_counter()
-        ep.handle_batch(sweep())
+        ep_batch.handle_batch(sweep())
         batch_ts.append(time.perf_counter() - t0)
-    n_reqs = len(sweep())
-    stats = {}
-    from tikv_tpu.util.metrics import REGISTRY
-
-    stats["xregion_batches"] = REGISTRY.counter(
-        "tikv_coprocessor_sched_batches_total", "").get(kind="xregion")
     return {
         "match": bool(match),
         "from_device": bool(from_device),
-        "regions": regions,
-        "clients": clients,
-        "requests": n_reqs,
-        "rows_per_region": rows_per,
+        "requests": len(sweep()),
         "serial_ts": [round(x, 4) for x in serial_ts],
         "batch_ts": [round(x, 4) for x in batch_ts],
-        "total_rows": n_reqs * rows_per,
-        **stats,
+    }
+
+
+def _op_xregion(req, state):
+    """xregion_batch event: the unified read scheduler's cross-region
+    continuous batching (copr/scheduler.py) vs per-request device serving.
+
+    An 8-region table serves a mixed workload — a Q6-shaped selection
+    aggregate, a second Q6 variant (different signature), and the Q1
+    group-by — issued by ``clients`` concurrent clients per region, the
+    batch_commands fan-in shape.  Serial = one handle_request per request
+    (today's per-request device path, warm region-cache hits throughout);
+    batched = ONE handle_batch, which the scheduler collapses into one
+    cross-region program per plan signature (identical requests from
+    different clients share an execution slot).  Responses must be
+    byte-identical to the serial path AND the CPU pipeline."""
+    from tikv_tpu.copr.endpoint import Endpoint
+    from tikv_tpu.storage.kv import LocalEngine
+    from tikv_tpu.util.metrics import REGISTRY
+
+    eng, block_rows, sweep, regions, rows_per, clients = _xregion_harness(req, seed=17)
+    ep = Endpoint(LocalEngine(eng), enable_device=True, block_rows=block_rows)
+    ep_cpu = Endpoint(LocalEngine(eng), enable_device=False)
+    out = _xregion_trials(ep, ep, ep_cpu, sweep, req.get("trials", 5))
+    return {
+        **out,
+        "regions": regions,
+        "clients": clients,
+        "rows_per_region": rows_per,
+        "total_rows": out["requests"] * rows_per,
+        "xregion_batches": REGISTRY.counter(
+            "tikv_coprocessor_sched_batches_total", "").get(kind="xregion"),
+    }
+
+
+def _op_sharded_xregion(req, state):
+    """sharded_xregion event (ISSUE 3): the SAME warm cross-region workload
+    as ``xregion``, but over MESH-SHARDED region images — the scheduler
+    packs slots per owner device and dispatches ONE shard_map program over
+    every visible device, partial aggregate states merging with
+    psum/pmin/pmax — vs per-request serving on a single-device endpoint
+    over the same warm images.  Byte-identity is asserted against both the
+    single-device path and the CPU pipeline; per-device slab occupancy and
+    bytes pinned are reported."""
+    import jax
+
+    from tikv_tpu.copr.endpoint import Endpoint
+    from tikv_tpu.parallel.mesh import device_slab_load, make_mesh
+    from tikv_tpu.storage.kv import LocalEngine
+    from tikv_tpu.util.metrics import REGISTRY
+
+    n_dev = jax.device_count()
+    if n_dev < 2:
+        return {"skipped": True, "reason": f"need >1 devices, have {n_dev}"}
+    eng, block_rows, sweep, regions, rows_per, clients = _xregion_harness(req, seed=23)
+    mesh = make_mesh(groups=2 if n_dev % 2 == 0 else 1)
+    ep_shard = Endpoint(LocalEngine(eng), enable_device=True,
+                        block_rows=block_rows, mesh=mesh)
+    ep_single = Endpoint(LocalEngine(eng), enable_device=True,
+                         block_rows=block_rows)
+    ep_cpu = Endpoint(LocalEngine(eng), enable_device=False)
+    out = _xregion_trials(ep_single, ep_shard, ep_cpu, sweep,
+                          req.get("trials", 5))
+    placement = ep_shard.region_cache.placement()
+    caches = ep_shard.region_cache.resident_block_caches()
+    load = device_slab_load(caches, mesh) if caches else {}
+    s_max = max(max(load.values()), 1) if load else 1
+    return {
+        **out,
+        "devices": n_dev,
+        "regions": regions,
+        "clients": clients,
+        "rows_per_region": rows_per,
+        "sharded_batches": REGISTRY.counter(
+            "tikv_coprocessor_sched_batches_total", "").get(kind="xregion_sharded"),
+        "device_bytes_pinned": {str(k): int(v) for k, v in placement.items()},
+        "device_occupancy": {str(k): round(v / s_max, 3) for k, v in load.items()},
     }
 
 
@@ -664,6 +717,7 @@ _OPS = {
     "filter": _op_filter,
     "region_cache": _op_region_cache,
     "xregion": _op_xregion,
+    "sharded_xregion": _op_sharded_xregion,
 }
 
 

@@ -1,8 +1,8 @@
 #!/usr/bin/env python
-"""Bench smoke: the region column cache AND the read scheduler must hold
-their wins.
+"""Bench smoke: the region column cache, the read scheduler AND the
+mesh-sharded warm path must hold their wins.
 
-Runs two mock-table configurations on the CPU backend and FAILS when either
+Runs three mock-table configurations on the CPU backend and FAILS when any
 regresses:
 
 * ``region_cache`` (ISSUE 1): endpoint-served scan/selection over a real
@@ -13,6 +13,12 @@ regresses:
   (mixed plan signatures, multiple clients per region).  Fails on any byte
   divergence from the serial path / CPU oracle or a batched-vs-serial
   speedup below the 2x floor.
+* ``sharded_xregion`` (ISSUE 3): the same warm cross-region workload over a
+  SIMULATED 8-DEVICE CPU MESH — region images sharded over owner devices,
+  one shard_map program per batch — vs single-device serial serving.  Runs
+  in a subprocess (the virtual-device flag must precede jax init).  Fails
+  on byte divergence or a speedup below the 1.5x floor; per-device
+  occupancy is reported.
 
 Exit code 0 = healthy; 1 = regression.  One JSON line on stdout either way,
 so CI logs stay grep-able:
@@ -23,6 +29,7 @@ so CI logs stay grep-able:
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -30,6 +37,51 @@ sys.path.insert(0, os.path.dirname(_HERE))
 
 MIN_SPEEDUP = 2.0
 MIN_XREGION_SPEEDUP = 2.0
+MIN_SHARDED_SPEEDUP = 1.5
+SHARDED_DEVICES = 8
+
+
+def _sharded_child(args) -> int:
+    """Child entry: runs the sharded event under the virtual-device mesh and
+    prints its raw result JSON (parent enforces the floor)."""
+    import bench
+
+    bench._force_cpu()
+    r = bench._op_sharded_xregion({
+        "regions": args.xregion_regions, "rows": args.xregion_rows,
+        "clients": 3, "trials": max(args.trials, 3),
+    }, {})
+    print(json.dumps(r))
+    return 0
+
+
+def _run_sharded(args) -> dict:
+    """Run the sharded event in its 8-virtual-device child; EVERY failure
+    mode (wedge, crash, garbage stdout) folds into {"error": ...} so the
+    parent keeps the one-JSON-line contract."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={SHARDED_DEVICES}"
+    ).strip()
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--sharded-child",
+             "--xregion-rows", str(args.xregion_rows),
+             "--xregion-regions", str(args.xregion_regions),
+             "--trials", str(args.trials)],
+            env=env, capture_output=True, text=True, timeout=900,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "sharded child wedged past 900s (killed)"}
+    if out.returncode != 0:
+        return {"error": f"child rc={out.returncode}: {out.stderr[-500:]}"}
+    try:
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except (IndexError, ValueError) as exc:
+        return {"error": f"child produced no result JSON ({exc}); "
+                         f"stdout tail: {out.stdout[-300:]!r}"}
 
 
 def main() -> int:
@@ -39,7 +91,11 @@ def main() -> int:
     ap.add_argument("--xregion-rows", type=int,
                     default=int(os.environ.get("SMOKE_XREGION_ROWS", "32000")))
     ap.add_argument("--xregion-regions", type=int, default=8)
+    ap.add_argument("--sharded-child", action="store_true",
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
+    if args.sharded_child:
+        return _sharded_child(args)
 
     import bench
 
@@ -77,6 +133,27 @@ def main() -> int:
         ok = False
         out["xregion_regression"] = (
             f"{xspeed:.2f}x < {MIN_XREGION_SPEEDUP}x floor")
+
+    # mesh-sharded warm serving on the 8-virtual-device mesh (ISSUE 3)
+    rs = _run_sharded(args)
+    if rs.get("error") or rs.get("skipped"):
+        ok = False
+        out["sharded_xregion_regression"] = rs.get("error") or rs.get("reason")
+    else:
+        out["sharded_match"] = bool(rs["match"])
+        out["sharded_from_device"] = bool(rs["from_device"])
+        ok = ok and rs["match"] and rs["from_device"]
+        s_t = float(np.median(rs["serial_ts"]))
+        b_t = float(np.median(rs["batch_ts"]))
+        sspeed = s_t / b_t
+        out["sharded_devices"] = rs["devices"]
+        out["sharded_speedup"] = round(sspeed, 2)
+        out["sharded_device_occupancy"] = rs["device_occupancy"]
+        out["sharded_device_bytes"] = rs["device_bytes_pinned"]
+        if sspeed < MIN_SHARDED_SPEEDUP:
+            ok = False
+            out["sharded_xregion_regression"] = (
+                f"{sspeed:.2f}x < {MIN_SHARDED_SPEEDUP}x floor")
 
     out["ok"] = bool(ok)
     print(json.dumps(out))

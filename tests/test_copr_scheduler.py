@@ -479,34 +479,34 @@ def test_scheduler_stop_drains_queue(engines):
     assert resp.data
 
 
-def test_mesh_bypass_counted_when_cache_in_play(engines, monkeypatch):
-    """A filled block/region cache forces single-device serving; the bypass
-    is counted so idle mesh capacity is visible (see endpoint.py for why
-    HBM-pinned entries cannot shard) — but ONLY for DAGs the mesh would
-    actually have served."""
-    from types import SimpleNamespace
+def test_mesh_serves_warm_cache_no_bypass(engines):
+    """The PR-2 cache→mesh bypass is GONE: with a real mesh, a warm cached
+    aggregation request serves THROUGH the sharded launcher
+    (``mesh_cache_hit`` counts it), byte-identical to the meshless path;
+    a plan with no mesh merge rule (``first``) declines to the
+    single-device warm path without touching the counter."""
+    from tikv_tpu.parallel.mesh import make_mesh
 
-    dev, _cpu = engines
-    req = _region_req(0, ROWS_PER, _sum_dag(44))
-    dev.handle_request(_region_req(0, ROWS_PER, _sum_dag(44)))  # warm image
-    dev.mesh = SimpleNamespace(size=4)
-    try:
-        # mesh declines the plan -> no bypass counted
-        monkeypatch.setattr(type(dev), "_mesh_evaluator_for",
-                            lambda self, dag: None)
-        before = REGISTRY.counter("tikv_coprocessor_mesh_bypass_total", "").get(
-            reason="cache")
-        resp = dev.handle_request(req)
-        assert resp.from_device
-        assert REGISTRY.counter("tikv_coprocessor_mesh_bypass_total", "").get(
-            reason="cache") == before
-        # mesh would serve the plan -> the cache bypass is counted
-        monkeypatch.setattr(type(dev), "_mesh_evaluator_for",
-                            lambda self, dag: object())
-        resp = dev.handle_request(req)
-        after = REGISTRY.counter("tikv_coprocessor_mesh_bypass_total", "").get(
-            reason="cache")
-        assert resp.from_device
-        assert after > before
-    finally:
-        dev.mesh = None
+    dev, cpu = engines
+    mesh_ep = Endpoint(LocalEngine(dev.engine.kv), enable_device=True,
+                       block_rows=1024, mesh=make_mesh(groups=2))
+    req = lambda d: _region_req(0, ROWS_PER, d)
+    mesh_ep.handle_request(req(_sum_dag(44)))  # warm image (miss)
+    before = REGISTRY.counter("tikv_coprocessor_mesh_cache_hit_total", "").get()
+    resp = mesh_ep.handle_request(req(_sum_dag(44)))
+    after = REGISTRY.counter("tikv_coprocessor_mesh_cache_hit_total", "").get()
+    assert resp.from_device and resp.from_cache
+    assert after == before + 1
+    assert resp.data == cpu.handle_request(req(_sum_dag(44))).data
+    # no merge rule for `first` -> documented decline, single-device warm
+    first_dag = DagRequest(executors=[
+        TableScan(TABLE_ID, COLS),
+        Aggregation([], [AggDescriptor("first", col(1)),
+                         AggDescriptor("count", None)]),
+    ])
+    mesh_ep.handle_request(req(first_dag))  # warm its image
+    b2 = REGISTRY.counter("tikv_coprocessor_mesh_cache_hit_total", "").get()
+    r2 = mesh_ep.handle_request(req(first_dag))
+    assert r2.from_device
+    assert REGISTRY.counter("tikv_coprocessor_mesh_cache_hit_total", "").get() == b2
+    assert r2.data == cpu.handle_request(req(first_dag)).data

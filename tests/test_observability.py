@@ -32,8 +32,12 @@ LAZY_SERIES = {
     "tikv_coprocessor_sched_lane_wait_seconds",
     "tikv_coprocessor_sched_batches_total",
     "tikv_coprocessor_sched_shed_total",
-    "tikv_coprocessor_mesh_bypass_total",
+    "tikv_coprocessor_sched_device_occupancy",
+    "tikv_coprocessor_sharded_merge_seconds",
+    "tikv_coprocessor_mesh_cache_hit_total",
     "tikv_coprocessor_region_cache_total",
+    "tikv_coprocessor_region_cache_device_bytes",
+    "tikv_storage_batch_size",
     "tikv_coprocessor_region_cache_delta_rows_total",
     "tikv_coprocessor_region_cache_evict_total",
     "tikv_coprocessor_region_cache_invalidate_total",

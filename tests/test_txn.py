@@ -95,6 +95,42 @@ def test_batch_and_scan(store):
     assert store.scan(b"", None, 1, 100, reverse=True) == [(b"k3", b"v3")]
 
 
+def test_batch_ops_single_pass_and_counted(store, monkeypatch):
+    """batch_get takes ONE snapshot and ONE PointGetter for the whole key
+    set (no per-key re-entry), and every batched call observes its size in
+    tikv_storage_batch_size{op}."""
+    from tikv_tpu.storage import storage as storage_mod
+    from tikv_tpu.util.metrics import REGISTRY
+
+    for i, ts in [(1, 10), (2, 30), (3, 50)]:
+        put(store, b"b%d" % i, b"v%d" % i, ts, ts + 5)
+    made = []
+    real = storage_mod.PointGetter
+
+    class CountingGetter(real):
+        def __init__(self, *a, **kw):
+            made.append(1)
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(storage_mod, "PointGetter", CountingGetter)
+    h = REGISTRY.histogram("tikv_storage_batch_size", "")
+    before = h.count(op="batch_get")
+    got = store.batch_get([b"b1", b"b2", b"b3", b"nope"], 100)
+    assert got == [(b"b1", b"v1"), (b"b2", b"v2"), (b"b3", b"v3")]
+    assert len(made) == 1, "batch_get must build exactly one PointGetter"
+    assert h.count(op="batch_get") == before + 1
+    # raw batches count too, one observation per call
+    b_put = h.count(op="raw_batch_put")
+    b_get = h.count(op="raw_batch_get")
+    b_del = h.count(op="raw_batch_delete")
+    store.raw_batch_put([(b"ra", b"1"), (b"rb", b"2")])
+    store.raw_batch_get([b"ra", b"rb"])
+    store.raw_batch_delete([b"ra", b"rb"])
+    assert h.count(op="raw_batch_put") == b_put + 1
+    assert h.count(op="raw_batch_get") == b_get + 1
+    assert h.count(op="raw_batch_delete") == b_del + 1
+
+
 def test_pessimistic_flow(store):
     put(store, b"k", b"v0", 5, 6)
     k = Key.from_raw(b"k")

@@ -23,7 +23,9 @@ from dataclasses import dataclass, field
 import numpy as np
 
 
-_MAX_DEVICE_SIGS = 4
+# per-block pinned signatures: stacked + nvoff + zone layout + sharded slab
+# stacks must coexist on a warm image without evicting each other
+_MAX_DEVICE_SIGS = 6
 
 
 @dataclass
@@ -40,6 +42,11 @@ class ColumnBlockCache:
         self.key = key
         self.blocks: list[_Block] = []
         self.filled = False
+        # sharded placement metadata (RegionColumnCache in mesh mode): one
+        # owner device id per block; None = single-device (default-device
+        # pins).  parallel.mesh.launch_xregion_sharded reads this to pin
+        # each slab on its owner.
+        self.owner_devices: list[int] | None = None
         self._mu = threading.Lock()
 
     def add(self, cols, n_valid: int) -> None:
@@ -99,8 +106,9 @@ class ColumnBlockCache:
         evaluators build — the per-cache stacked arrays and per-block column
         lists — and patches them with ``.at[].set`` scatters (a device-side
         op; the base arrays never round-trip to host).  Any other signature
-        (zone layouts, nvoff is kept — row counts are unchanged) is dropped
-        so it rebuilds from the updated host blocks."""
+        (zone layouts, mesh ``shardslab`` stacks; nvoff is kept — row counts
+        are unchanged) is dropped so it rebuilds from the updated host
+        blocks on its owner device."""
         with self._mu:
             for bi, blk in enumerate(self.blocks):
                 upd = updates.get(bi)

@@ -61,6 +61,7 @@ class Endpoint:
         region_cache=None,
         sched_config=None,
         block_rows: int | None = None,
+        shard_cache: bool = True,
     ):
         from .tracker import SlowLog
 
@@ -74,13 +75,21 @@ class Endpoint:
         self.block_rows = block_rows
         # device-resident per-region column cache with delta apply (region
         # requests carrying region_epoch + apply_index in the context skip
-        # scan+decode entirely on repeat reads); None = disabled
+        # scan+decode entirely on repeat reads); None = disabled.  With a
+        # multi-device mesh the cache runs SHARDED: images placed on owner
+        # devices so warm serving uses every chip (docs/mesh_serving.md).
+        # shard_cache=False is the kill switch: no sharded placement AND no
+        # sharded warm routing (unary or scheduler) — PR-2 behavior exactly
+        self.shard_cache = shard_cache
         if region_cache is not None:
             self.region_cache = region_cache
         elif enable_region_cache:
             from .region_cache import RegionColumnCache
 
-            self.region_cache = RegionColumnCache(block_rows=block_rows)
+            self.region_cache = RegionColumnCache(
+                block_rows=block_rows,
+                mesh=mesh if shard_cache else None,
+            )
         else:
             self.region_cache = None
         # version-gated rollout (feature_gate.rs:14): the gate is the hard
@@ -159,33 +168,25 @@ class Endpoint:
                 cache, rc_outcome = self._region_cache_for(req, snap, tracker)
                 if cache is None:
                     cache = self._block_cache_for(req)
-                # mesh path only when no block cache is in play.  The cache's
-                # HBM-pinned entries are a single-device structure: each block
-                # pins its arrays on the default device, and MeshServingRunner
-                # marshals its own super-blocks sharded by PartitionSpec across
-                # the mesh — composing them would re-shard every pinned array
-                # through host memory on EVERY query, paying the full transfer
-                # the cache exists to remove.  Sharding the cache itself means
-                # per-device pinning + delta scatters routed per shard (future
-                # work); until then the bypass is counted so operators can see
-                # mesh capacity sitting idle behind a filled cache.
+                # cold path with a mesh: MeshServingRunner shards the MVCC
+                # scan's super-blocks; warm path with a mesh: the cache is
+                # ALREADY sharded (RegionColumnCache places images on owner
+                # devices), so cached serving routes through the sharded
+                # cross-region launcher below — the PR-2 "mesh bypass due to
+                # filled cache" is gone
                 ev = None
                 if cache is None:
                     ev = self._mesh_evaluator_for(req.dag)
-                elif self._mesh_would_serve(req.dag):
-                    from ..util.metrics import REGISTRY
-
-                    REGISTRY.counter(
-                        "tikv_coprocessor_mesh_bypass_total",
-                        "Requests served single-device because a filled "
-                        "block/region cache cannot shard across the mesh",
-                    ).inc(reason="cache")
                 if ev is None:
                     ev = self._evaluator_for(req.dag)
                 src = None
                 if cache is None or not cache.filled:
                     src = MvccBatchScanSource(snap, req.start_ts, req.ranges)
-                resp = ev.run(src, cache=cache)
+                resp = None
+                if src is None and self._mesh_would_serve(req.dag):
+                    resp = self._run_sharded_cached(ev, cache)
+                if resp is None:
+                    resp = ev.run(src, cache=cache)
                 scanned = src.stats.write.processed_keys if src is not None else 0
                 m = tracker.on_finish(scanned_keys=scanned, from_device=True)
                 self.slow_log.observe(tracker)
@@ -365,12 +366,47 @@ class Endpoint:
                 "batch": BATCH_FUSION}[what]
         return self.feature_gate.can_enable(feat)
 
+    def _run_sharded_cached(self, ev, cache):
+        """Warm cached serving THROUGH the mesh: run the plan over the
+        image's device-local shards via the sharded cross-region launcher
+        (one region = one slot; a block-spread huge region uses every chip).
+        Returns the SelectResponse, or None on a documented decline — an
+        aggregate with no mesh merge rule, unstable group dictionaries —
+        which serves per-request on the single-device warm path.  Real
+        device failures propagate to the CPU-fallback handler like every
+        other device error."""
+        from ..parallel.mesh import mesh_mergeable
+        from ..util.metrics import REGISTRY
+        from . import jax_eval as _je
+
+        if not self.shard_cache:
+            return None
+        if ev.plan.agg is None or not mesh_mergeable(ev.device_aggs):
+            return None
+        # A single-owner image still routes here on purpose: SPMD means the
+        # other devices scan only zero-pad slabs (same wall time as the
+        # owner) plus a tiny-carry collective — while the single-device
+        # warm path would REBUILD a full default-device pin, paying the
+        # whole-image transfer the owner placement exists to avoid.
+        try:
+            pending = _je.launch_xregion_sharded(ev, [cache], self.mesh)
+            resp = pending.finalize()[0]
+        except ValueError:
+            return None
+        REGISTRY.counter(
+            "tikv_coprocessor_mesh_cache_hit_total",
+            "Warm cached requests served mesh-sharded (replaces the PR-2 "
+            "mesh_bypass{reason=cache})",
+        ).inc()
+        return resp
+
     def _mesh_would_serve(self, dag: DagRequest) -> bool:
         """True only when the mesh path would actually take this DAG (mesh
-        present, gate open, AND the plan is mesh-runnable) — the bypass
-        counter must not claim idle mesh capacity for traffic the mesh
-        would have declined anyway."""
-        if self.mesh is None or getattr(self.mesh, "size", 1) <= 1:
+        present with real devices, gate open, AND the plan is mesh-runnable)
+        — the sharded warm route must not probe plans the mesh would have
+        declined anyway."""
+        if (self.mesh is None or getattr(self.mesh, "size", 1) <= 1
+                or getattr(self.mesh, "devices", None) is None):
             return False
         from .dag import Aggregation
 

@@ -1629,6 +1629,57 @@ class XRegionPending:
         return out
 
 
+def xregion_specs(ev: "JaxDagEvaluator", caches):
+    """Shared eligibility/geometry prologue of BOTH cross-region launchers
+    (the single-device vmapped one below and ``parallel.mesh``'s shard_map
+    twin): validates the plan and every cache, computes the per-region
+    (dicts, dict_lens, n_slots) specs, the group columns, and the shared
+    power-of-two capacity.  Raises ValueError on the documented declines
+    (non-aggregation plan, unstable group dictionaries, empty cache) — ONE
+    implementation so the two launchers can never disagree about what is
+    batchable."""
+    if ev.plan.agg is None:
+        raise ValueError("cross-region batching requires aggregation DAGs")
+    if not caches:
+        raise ValueError("cross-region batching requires at least one region")
+    specs = []
+    n_slots_max = 1
+    for cache in caches:
+        if not cache.blocks:
+            raise ValueError("cross-region batching over an empty block cache")
+        stable = ev._stable_dict_group_cols(cache.blocks)
+        if ev.group_rpns and stable is None:
+            raise ValueError("cross-region batching requires stable dict group keys")
+        _gc, dicts = stable if stable else ([], [])
+        dict_lens = tuple(len(d) for d in dicts)
+        n_slots = 1
+        for dl in dict_lens:
+            n_slots *= dl + 1
+        n_slots_max = max(n_slots_max, n_slots)
+        specs.append((dicts, dict_lens, n_slots))
+    group_cols = [g.nodes[0].index for g in ev.group_rpns]
+    capacity = 1
+    while capacity < n_slots_max:
+        capacity *= 2
+    return specs, group_cols, capacity
+
+
+def _pack_region_leaves(leaves, n_regions: int, capacity: int):
+    """Region-slot-segmented variant of :func:`_pack_leaves`: flat
+    ``(R*C,)`` state leaves → ``((R, Li, C) int64, (R, Lf, C) float64)``
+    matrices under the SAME int/float partition rule, so
+    ``XRegionPending.finalize`` unpacks either launcher's output against
+    the one packing contract."""
+    ints = [l.reshape(n_regions, capacity).astype(jnp.int64)
+            for l in leaves if l.dtype != jnp.float64]
+    flts = [l.reshape(n_regions, capacity)
+            for l in leaves if l.dtype == jnp.float64]
+    int_m = jnp.stack(ints, axis=1)
+    flt_m = (jnp.stack(flts, axis=1) if flts
+             else jnp.zeros((n_regions, 0, capacity), dtype=jnp.float64))
+    return int_m, flt_m
+
+
 def launch_xregion_cached(ev: "JaxDagEvaluator", caches) -> XRegionPending:
     """ONE aggregation plan over R different region images as ONE device
     program: each region's resident blocks are padded to a shared block
@@ -1647,30 +1698,7 @@ def launch_xregion_cached(ev: "JaxDagEvaluator", caches) -> XRegionPending:
     batchable (non-aggregation plan, unstable group dictionaries, empty
     cache); the scheduler sheds those to the per-request path.
     """
-    if ev.plan.agg is None:
-        raise ValueError("cross-region batching requires aggregation DAGs")
-    if not caches:
-        raise ValueError("cross-region batching requires at least one region")
-    specs = []
-    n_slots_max = 1
-    for cache in caches:
-        blocks = cache.blocks
-        if not blocks:
-            raise ValueError("cross-region batching over an empty block cache")
-        stable = ev._stable_dict_group_cols(blocks)
-        if ev.group_rpns and stable is None:
-            raise ValueError("cross-region batching requires stable dict group keys")
-        _gc, dicts = stable if stable else ([], [])
-        dict_lens = tuple(len(d) for d in dicts)
-        n_slots = 1
-        for dl in dict_lens:
-            n_slots *= dl + 1
-        n_slots_max = max(n_slots_max, n_slots)
-        specs.append((dicts, dict_lens, n_slots))
-    group_cols = [g.nodes[0].index for g in ev.group_rpns]
-    capacity = 1
-    while capacity < n_slots_max:
-        capacity *= 2
+    specs, group_cols, capacity = xregion_specs(ev, caches)
     ship = ev._ship_cols(group_cols)
     nullable = ev.nullable_cols
     n_rows = ev.block_rows
@@ -1759,6 +1787,20 @@ def launch_xregion_cached(ev: "JaxDagEvaluator", caches) -> XRegionPending:
 def run_xregion_cached(ev: "JaxDagEvaluator", caches) -> list[SelectResponse]:
     """launch + finalize in one step (tests / single-batch callers)."""
     return launch_xregion_cached(ev, caches).finalize()
+
+
+def launch_xregion_sharded(ev: "JaxDagEvaluator", caches, mesh) -> XRegionPending:
+    """The ``shard_map`` twin of :func:`launch_xregion_cached`: the same
+    cross-region batch executed over EVERY device of ``mesh``, each region
+    image (or block, for a block-spread huge region) scanned on its owner
+    device and the partial aggregate states merged with the mesh collective
+    rules.  Implemented in ``parallel.mesh`` (where the collectives and the
+    merge table live); this wrapper keeps the scheduler's device backend a
+    single import site.  Raises ValueError on the same documented declines
+    as the single-device launcher, plus "no mesh merge rule"."""
+    from ..parallel.mesh import launch_xregion_sharded as _impl
+
+    return _impl(ev, caches, mesh)
 
 
 class _ChunkExecutor:

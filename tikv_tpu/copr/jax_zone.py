@@ -29,12 +29,15 @@ columnar siblings):
 Scope: zone layouts are built and keyed PER CACHE (one region image), so
 they serve the per-request warm path and the same-region fused batch
 (jax_eval.run_batch_cached probes them first).  The read scheduler's
-cross-region batches (scheduler.py → jax_eval.launch_xregion_cached) bypass
-zones: a cross-region program needs one shared geometry across images whose
-cluster permutations and tile statistics differ per region — batching
-zone-tiled execution across regions would need a shared tile classification
-pass and is future work; the scheduler's padding-budget shed keeps the
-bypass bounded to batches that actually profit from stacking.
+cross-region batches (scheduler.py → jax_eval.launch_xregion_cached) and
+the mesh-sharded warm launcher (parallel/mesh.py launch_xregion_sharded,
+docs/mesh_serving.md) bypass zones: a cross-region/sharded program needs
+one shared geometry across images whose cluster permutations and tile
+statistics differ per region — batching zone-tiled execution across
+regions (or tiling it per device shard) would need a shared tile
+classification pass and is future work; the scheduler's padding-budget
+shed keeps the bypass bounded to batches that actually profit from
+stacking.
 
 Exactness contract: REAL (f64) aggregate arguments are rejected (summation
 order would differ from the CPU oracle beyond the last ulp); everything else

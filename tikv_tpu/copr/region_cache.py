@@ -361,13 +361,27 @@ class RegionCacheStats:
 
 
 class RegionColumnCache:
-    """LRU of :class:`RegionImage` under a byte budget."""
+    """LRU of :class:`RegionImage` under a byte budget.
+
+    **Sharded mode** (``mesh`` with >1 device): every image is assigned an
+    OWNER device under a per-device byte budget — the whole image on the
+    least-loaded device normally, block-level round-robin for a single huge
+    region (one region bigger than a device's budget share).  The placement
+    is written onto each image's block cache as ``owner_devices`` (device id
+    per block); the mesh-sharded warm launcher
+    (``parallel.mesh.launch_xregion_sharded``) pins the slab stacks there, so
+    a cross-region batch runs with zero re-sharding — each device already
+    holds its shard.  Eviction/invalidation rebalances: images migrate from
+    the most- to the least-loaded device (pins rebuild lazily on the new
+    owner)."""
 
     def __init__(
         self,
         byte_budget: int = DEFAULT_BYTE_BUDGET,
         max_regions: int = DEFAULT_MAX_REGIONS,
         block_rows: int | None = None,
+        mesh=None,
+        per_device_budget: int | None = None,
     ):
         from .jax_eval import DEFAULT_BLOCK_ROWS
 
@@ -377,7 +391,25 @@ class RegionColumnCache:
         self._images: dict = {}  # key -> RegionImage, insertion = LRU order
         self._mu = threading.RLock()
         self.stats = RegionCacheStats()
+        self.devices: list = []
+        if mesh is not None and getattr(mesh, "size", 1) > 1:
+            try:
+                devs = list(np.asarray(mesh.devices).reshape(-1))
+            except Exception:  # noqa: BLE001 — a fake/broken mesh: unsharded
+                devs = []
+            if len(devs) > 1:
+                self.devices = devs
+        self.per_device_budget = (
+            per_device_budget
+            if per_device_budget is not None
+            else byte_budget // max(len(self.devices), 1)
+        )
+        self._device_bytes: dict[int, int] = {d.id: 0 for d in self.devices}
         _CACHES.add(self)
+
+    @property
+    def sharded(self) -> bool:
+        return bool(self.devices)
 
     # -- public ------------------------------------------------------------
 
@@ -443,6 +475,11 @@ class RegionColumnCache:
                 return self._build(key, epoch, snap, columns_info, ranges,
                                    start_ts, apply_index, stats)
             n = img.apply_delta(delta, apply_index, start_ts)
+            if self.devices:
+                # a structural repack can change the block count and bytes:
+                # refresh the placement so owner_devices stays block-aligned
+                self._unplace(img)
+                self._place(img)
             self.stats.deltas += 1
             self.stats.delta_rows += n
             self._count("delta")
@@ -455,13 +492,93 @@ class RegionColumnCache:
         with self._mu:
             for key in [k for k in self._images if k[0] == region_id]:
                 self._drop(key, reason=reason)
+            self._rebalance()
 
     def total_bytes(self) -> int:
         with self._mu:
             return sum(img.nbytes for img in self._images.values())
 
+    def placement(self) -> dict[int, int]:
+        """{device_id: pinned bytes} placement metadata (sharded mode)."""
+        with self._mu:
+            return dict(self._device_bytes)
+
+    def resident_block_caches(self) -> list:
+        """The resident images' block caches (benches / introspection —
+        feed to ``parallel.mesh.slab_assignment`` for the slab geometry)."""
+        with self._mu:
+            return [img.block_cache for img in self._images.values()]
+
     def __len__(self) -> int:
         return len(self._images)
+
+    # -- sharded placement ---------------------------------------------------
+
+    def _place(self, img) -> None:
+        """Assign owner devices to a freshly built/repacked image: whole
+        image to the least-loaded device, block-level round-robin when the
+        image alone exceeds the per-device budget (a single huge region must
+        spread, or one chip serves it while the rest idle)."""
+        if not self.devices:
+            return
+        bc = img.block_cache
+        n_blocks = len(bc.blocks)
+        if n_blocks == 0:
+            bc.owner_devices = []
+            img.placement_bytes = {}
+            return
+        per_block = img.nbytes // n_blocks
+        if img.nbytes > self.per_device_budget and n_blocks > 1:
+            order = sorted(self.devices, key=lambda d: self._device_bytes[d.id])
+            owners = [order[b % len(order)].id for b in range(n_blocks)]
+        else:
+            dev = min(self.devices, key=lambda d: self._device_bytes[d.id])
+            owners = [dev.id] * n_blocks
+        bc.owner_devices = owners
+        pb: dict[int, int] = {}
+        for did in owners:
+            pb[did] = pb.get(did, 0) + per_block
+        img.placement_bytes = pb
+        for did, b in pb.items():
+            self._device_bytes[did] += b
+
+    def _unplace(self, img) -> None:
+        for did, b in getattr(img, "placement_bytes", {}).items():
+            self._device_bytes[did] = max(0, self._device_bytes.get(did, 0) - b)
+        img.placement_bytes = {}
+        img.block_cache.owner_devices = None
+
+    def _rebalance(self) -> None:
+        """Shrink the device-load spread after an eviction/invalidation:
+        move the best-fitting whole image from the most- to the least-loaded
+        device while that strictly narrows the gap.  Only the placement
+        metadata moves — device pins drop and rebuild lazily on the new
+        owner at the next warm batch."""
+        if not self.devices or len(self._images) < 2:
+            return
+        for _ in range(len(self._images)):
+            hi = max(self.devices, key=lambda d: self._device_bytes[d.id])
+            lo = min(self.devices, key=lambda d: self._device_bytes[d.id])
+            gap = self._device_bytes[hi.id] - self._device_bytes[lo.id]
+            if gap <= 0:
+                return
+            cand = [
+                i for i in self._images.values()
+                if set(getattr(i, "placement_bytes", {})) == {hi.id}
+                and 0 < i.nbytes < gap
+            ]
+            if not cand:
+                return
+            img = min(cand, key=lambda i: abs(gap - 2 * i.nbytes))
+            self._unplace(img)
+            img.block_cache.drop_device()
+            img.block_cache.owner_devices = [lo.id] * len(img.block_cache.blocks)
+            img.placement_bytes = {lo.id: img.nbytes}
+            self._device_bytes[lo.id] += img.nbytes
+            # the migration moved placement bytes AFTER the drop path's
+            # last refresh — keep the per-device gauge truthful
+            self._gauge_bytes()
+        return
 
     # -- internals ---------------------------------------------------------
 
@@ -497,7 +614,10 @@ class RegionColumnCache:
             existing = self._images.get(key)
             if (existing is None or existing.epoch != epoch
                     or existing.apply_index <= apply_index):
+                if existing is not None:
+                    self._unplace(existing)
                 self._images[key] = img
+                self._place(img)
                 self._enforce_budget(keep=key)
             self.stats.misses += 1
             self._count("miss")
@@ -516,6 +636,7 @@ class RegionColumnCache:
         img = self._images.pop(key, None)
         if img is None:
             return
+        self._unplace(img)
         img.block_cache.drop_device()
         img.block_cache.blocks.clear()
         img.block_cache.filled = False
@@ -537,6 +658,7 @@ class RegionColumnCache:
             if victim is None:
                 break
             img = self._images.pop(victim)
+            self._unplace(img)
             img.block_cache.drop_device()
             img.block_cache.blocks.clear()
             img.block_cache.filled = False
@@ -547,6 +669,7 @@ class RegionColumnCache:
                 "tikv_coprocessor_region_cache_evict_total",
                 "Region column cache LRU/budget evictions",
             ).inc()
+        self._rebalance()
 
     def _count(self, outcome: str) -> None:
         from ..util.metrics import REGISTRY
@@ -575,3 +698,10 @@ class RegionColumnCache:
             "tikv_coprocessor_region_cache_bytes",
             "Host bytes held by resident region images",
         ).set(total)
+        if self.devices:
+            g = REGISTRY.gauge(
+                "tikv_coprocessor_region_cache_device_bytes",
+                "Bytes pinned per owner device (sharded placement)",
+            )
+            for d in self.devices:
+                g.set(self._device_bytes.get(d.id, 0), device=str(d.id))

@@ -15,6 +15,15 @@ DAG requests share **XLA dispatches**.
   ``region_cache.py``) is padded to a shared block geometry and stacked
   along a new leading region axis (``jax_eval.launch_xregion_cached``),
   with per-region row-count masks so padding never changes results.
+* With a multi-device mesh the scheduler is DEVICE-AWARE: the region cache
+  places images on owner devices, slots pack per owner, and the batch runs
+  as one ``shard_map`` program over device-local shards
+  (``jax_eval.launch_xregion_sharded`` → ``parallel/mesh.py``), partial
+  aggregate states merging over ICI.  Padding-shed then accounts for the
+  (devices × slabs) geometry — the slab axis rounds up to the mesh's
+  per-device maximum — and per-device occupancy is reported.  Double-
+  buffered prepare fills the NEXT batch's shards on their owner devices
+  while the current batch executes.
 * Requests over the SAME cached region view with different plans keep the
   old fused path (``jax_eval.run_batch_cached``), now living here instead
   of ``endpoint._try_fused_batch``.
@@ -482,9 +491,27 @@ class CoprReadScheduler:
 
     # -- execution groups ---------------------------------------------------
 
+    def _sharded_mesh(self, ev):
+        """The endpoint's mesh when this batch should run the SHARDED warm
+        launcher: >1 real device, MESH_SERVING gate open, and every
+        aggregate has a mesh merge rule (no rule → the single-device
+        xregion program, which needs none)."""
+        mesh = self.ep.mesh
+        if (mesh is None or getattr(mesh, "size", 1) <= 1
+                or getattr(mesh, "devices", None) is None
+                or not getattr(self.ep, "shard_cache", True)
+                or not self.ep._gate_ok("mesh")):
+            return None
+        from ..parallel.mesh import mesh_mergeable
+
+        return mesh if mesh_mergeable(ev.device_aggs) else None
+
     def _launch_xregion(self, sig: tuple, slots: list[_Slot], results, errors):
         """Resolve every slot's cache (host), shed what cannot batch, and
-        dispatch ONE cross-region program.  Returns the finalize closure."""
+        dispatch ONE cross-region program — over the mesh (one shard_map
+        program, slabs on their owner devices) when the endpoint has one,
+        else the single-device vmapped program.  Returns the finalize
+        closure."""
         live: list[_Slot] = []
         for slot in slots:
             ok = False
@@ -516,12 +543,20 @@ class CoprReadScheduler:
                 self._shed(prev, "aliased_image", results, errors)
             by_image[id(slot.cache)] = slot
         live = [s for s in live if by_image.get(id(s.cache)) is s]
-        live = self._shed_for_padding(live, results, errors)
+        if not live:
+            return None
+        ev = self._evaluator_for(sig, live[0].items[0].req.dag)
+        mesh = self._sharded_mesh(ev)
+        if mesh is not None:
+            live, device_load, sh_waste = self._shed_for_padding_sharded(
+                live, mesh, results, errors)
+        else:
+            live = self._shed_for_padding(live, results, errors)
+            device_load, sh_waste = None, 0.0
         if len(live) < 2:
             for slot in live:
                 self._shed(slot, "underfull", results, errors)
             return None
-        ev = self._evaluator_for(sig, live[0].items[0].req.dag)
         # cold-fills were answered (and counted) by their own handle_request
         # — the program serves the rest; occupancy counts the whole fan-in.
         # Counted over the FINAL live set: a filled slot shed above (alias /
@@ -532,10 +567,16 @@ class CoprReadScheduler:
             if getattr(it, "_filled_resp", None) is not None
         )
         n_reqs = max(n_batch - n_filled, 1)
-        waste = self._padding_waste(live)
+        kind = "xregion" if mesh is None else "xregion_sharded"
+        waste = self._padding_waste(live) if mesh is None else sh_waste
         t0 = time.perf_counter()
         try:
-            pending = jax_eval.launch_xregion_cached(ev, [s.cache for s in live])
+            if mesh is not None:
+                pending = jax_eval.launch_xregion_sharded(
+                    ev, [s.cache for s in live], mesh)
+            else:
+                pending = jax_eval.launch_xregion_cached(
+                    ev, [s.cache for s in live])
         except ValueError:
             # "not batchable" (empty blocks, unstable dictionaries) is a
             # documented decline, not a device failure — shed without
@@ -559,13 +600,16 @@ class CoprReadScheduler:
                 for slot in live:
                     self._shed(slot, "device_error", results, errors)
                 return
+            pull_dt = time.perf_counter() - t_fin
             # latency = this group's own host work (launch) + the blocking
             # pull (residual device time).  The gap between launch and
             # finalize is the NEXT group's prepare pass — double-buffered
             # overlap, not this batch's cost; attributing it here would
             # inflate the device-path percentiles with unrelated host work.
-            dt = (t_launched - t0) + (time.perf_counter() - t_fin)
-            self._batch_metrics("xregion", n_reqs, dt, waste, n_batch=n_batch)
+            dt = (t_launched - t0) + pull_dt
+            self._batch_metrics(kind, n_reqs, dt, waste, n_batch=n_batch)
+            if mesh is not None:
+                self._sharded_metrics(device_load, pull_dt)
             for slot, resp in zip(live, resps):
                 data = resp.encode()
                 from_cache = slot.outcome not in ("", "miss", "too_big")
@@ -573,7 +617,7 @@ class CoprReadScheduler:
                     if results[it.index] is not None:
                         continue  # the cold-fill already answered this one
                     r = CoprResponse(data, from_device=True, from_cache=from_cache)
-                    self._stamp(r, it, kind="xregion", occupancy=n_batch,
+                    self._stamp(r, it, kind=kind, occupancy=n_batch,
                                 waste=waste, total_s=dt / n_reqs)
                     results[it.index] = r
 
@@ -655,6 +699,68 @@ class CoprReadScheduler:
             live.remove(biggest)
             self._shed(biggest, "padding", results, errors)
         return live
+
+    # -- sharded (mesh) geometry --------------------------------------------
+
+    @staticmethod
+    def _device_load(slots: list[_Slot], mesh) -> dict[int, int]:
+        """Slabs per device for a prospective batch — the launcher's OWN
+        geometry (``parallel.mesh.device_slab_load``), so shed decisions
+        and occupancy metrics can never diverge from what launches."""
+        from ..parallel.mesh import device_slab_load
+
+        return device_slab_load([s.cache for s in slots], mesh)
+
+    @staticmethod
+    def _load_waste(load: dict[int, int]) -> float:
+        """Wasted fraction of the (devices × slabs) geometry.  Devices with
+        zero load are EXCLUDED: a 3-region batch on an 8-chip mesh leaves 5
+        chips idle by region count, which shedding regions can only worsen —
+        idle capacity shows in the per-device occupancy series instead.
+        Counted waste is slab-count IMBALANCE among loaded devices (the
+        regions-axis padding the slab axis rounds up to)."""
+        loaded = [v for v in load.values() if v > 0]
+        if not loaded:
+            return 0.0
+        return 1.0 - sum(loaded) / (len(loaded) * max(loaded))
+
+    def _padding_waste_sharded(self, slots: list[_Slot], mesh) -> float:
+        return self._load_waste(self._device_load(slots, mesh)) if slots else 0.0
+
+    def _shed_for_padding_sharded(self, slots, mesh, results, errors):
+        """Sharded-geometry padding shed: the largest region sheds while
+        the loaded-device slab imbalance exceeds the budget.  Returns
+        (live slots, final device load, final waste) — one assignment pass
+        per iteration, and callers reuse the final geometry instead of
+        recomputing it."""
+        live = list(slots)
+        load = self._device_load(live, mesh)
+        waste = self._load_waste(load)
+        while len(live) > 1 and waste > self.cfg.padding_budget:
+            biggest = max(live, key=lambda s: len(s.cache.blocks))
+            live.remove(biggest)
+            self._shed(biggest, "padding", results, errors)
+            load = self._device_load(live, mesh)
+            waste = self._load_waste(load)
+        return live, load, waste
+
+    def _sharded_metrics(self, device_load: dict[int, int], pull_dt: float) -> None:
+        """Per-device shard occupancy (used slabs / slab-axis size, idle
+        devices included) + the collective-merge/pull time of the batch."""
+        from ..util.metrics import REGISTRY
+
+        s = max(max(device_load.values()), 1) if device_load else 1
+        h = REGISTRY.histogram(
+            "tikv_coprocessor_sched_device_occupancy",
+            "Per-device slab occupancy of sharded cross-region batches",
+            buckets=(0.0, 0.125, 0.25, 0.5, 0.75, 0.9, 1.0),
+        )
+        for did, n in device_load.items():
+            h.observe(n / s, device=str(did))
+        REGISTRY.histogram(
+            "tikv_coprocessor_sharded_merge_seconds",
+            "Collective-merge + packed-pull time of sharded batches",
+        ).observe(pull_dt)
 
     def _per_request(self, it: _Item, results, errors, kind: str) -> None:
         """Serve one item on the per-request path, capturing its failure in

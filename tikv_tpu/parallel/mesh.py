@@ -28,17 +28,38 @@ import jax
 jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp  # noqa: E402
-from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
 
 from ..copr.dag import DagRequest
 from ..copr.jax_eval import (
     _NO_ROW,
     JaxDagEvaluator,
+    XRegionPending,
+    _build_cols,
+    _fused_step,
     _seg_extreme,
     _seg_sum,
     _topn_key_operands,
 )
 from ..copr.rpn import eval_rpn
+
+# shard_map moved to the jax top level (with ``check_vma``) after 0.4.x; on
+# 0.4.x it lives in jax.experimental with the replication check spelled
+# ``check_rep``.  One shim so every sharded program here compiles on both.
+if hasattr(jax, "shard_map"):
+    _SHARD_MAP, _SM_CHECK_KW = jax.shard_map, "check_vma"
+else:  # pragma: no cover - exercised on 0.4.x images
+    from jax.experimental.shard_map import shard_map as _SHARD_MAP
+
+    _SM_CHECK_KW = "check_rep"
+
+
+def _smap(mesh: Mesh, in_specs, out_specs, check: bool = True):
+    """Version-portable ``shard_map`` decorator."""
+    kw = {} if check else {_SM_CHECK_KW: False}
+    return partial(_SHARD_MAP, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, **kw)
+
 
 _KEY_SENTINEL = jnp.int64(2**62)  # empty group-dictionary slot (sorts last)
 
@@ -178,12 +199,7 @@ class ShardedDagEvaluator:
         )
         in_specs = (col_specs, null_specs, P("regions"), P("regions"), P(), state_spec)
 
-        @partial(
-            jax.shard_map,
-            mesh=self.mesh,
-            in_specs=in_specs,
-            out_specs=state_spec,
-        )
+        @_smap(self.mesh, in_specs, state_spec)
         def step(col_data, col_nulls, valid, gids, block_base, state):
             first_shard, carry_shards = state
             cols, active = _shard_active_cols(
@@ -327,17 +343,11 @@ class ShardedGroupedEvaluator:
         )
         in_specs = (col_specs, null_specs, P("regions"), P(), state_spec)
 
-        @partial(
-            jax.shard_map,
-            mesh=self.mesh,
-            in_specs=in_specs,
-            out_specs=state_spec,
-            # every output IS replicated — it flows through psum/pmin/pmax or
-            # all_gather before leaving — but the static varying-axis
-            # inference cannot see that through the scatter/searchsorted
-            # dictionary rebuild; the equality tests assert it dynamically
-            check_vma=False,
-        )
+        # every output IS replicated — it flows through psum/pmin/pmax or
+        # all_gather before leaving — but the static varying-axis
+        # inference cannot see that through the scatter/searchsorted
+        # dictionary rebuild; the equality tests assert it dynamically
+        @_smap(self.mesh, in_specs, state_spec, check=False)
         def step(col_data, col_nulls, valid, block_base, state):
             dict_keys, first, carries, overflow = state
             cols, active = _shard_active_cols(
@@ -514,12 +524,7 @@ class ShardedTopNEvaluator:
         state_spec = self._leaf_specs()
         in_specs = (col_specs, null_specs, P("regions"), P(), state_spec)
 
-        @partial(
-            jax.shard_map,
-            mesh=self.mesh,
-            in_specs=in_specs,
-            out_specs=state_spec,
-        )
+        @_smap(self.mesh, in_specs, state_spec)
         def step(col_data, col_nulls, valid, block_base, state):
             cols, active = _shard_active_cols(
                 device_cols, nullable, sel_rpns, col_data, col_nulls, valid, n_rows
@@ -561,16 +566,10 @@ class ShardedTopNEvaluator:
         state_spec = self._leaf_specs()
         out_spec = tuple(P() for _ in range(n_key_ops + 2 * n_payload))
 
-        @partial(
-            jax.shard_map,
-            mesh=self.mesh,
-            in_specs=(state_spec,),
-            out_specs=out_spec,
-            # outputs are replicated by construction (all_gather then a
-            # deterministic sort), which the static inference cannot prove
-            # through the index gathers; tests assert the values
-            check_vma=False,
-        )
+        # outputs are replicated by construction (all_gather then a
+        # deterministic sort), which the static inference cannot prove
+        # through the index gathers; tests assert the values
+        @_smap(self.mesh, (state_spec,), out_spec, check=False)
         def fin(state):
             gathered = [
                 jax.lax.all_gather(leaf, "regions", tiled=True) for leaf in state
@@ -634,6 +633,326 @@ class ShardedTopNEvaluator:
             "gidx": out[self.n_key_ops - 1][:live],
             "payload": payload,
         }
+
+
+# ---------------------------------------------------------------------------
+# Mesh-sharded warm serving: the shard_map twin of launch_xregion_cached
+# ---------------------------------------------------------------------------
+
+
+def mesh_mergeable(device_aggs) -> bool:
+    """True when every aggregate's carry has a mesh merge rule — the gate in
+    front of sharded warm serving (``first`` has none; those plans keep the
+    single-device path)."""
+    return all(da.op in _MERGE for da in device_aggs)
+
+
+_FLAT_MESHES: dict = {}
+
+
+def _flat_regions_mesh(mesh: Mesh) -> Mesh:
+    """A 1-D ``regions``-axis view over every device of ``mesh``.  The warm
+    sharded program has no use for the ``groups`` axis (its state is a small
+    replicated (R, capacity) carry), so slabs shard over ALL chips."""
+    devs = list(np.asarray(mesh.devices).reshape(-1))
+    key = tuple(d.id for d in devs)
+    m = _FLAT_MESHES.get(key)
+    if m is None:
+        m = _FLAT_MESHES[key] = Mesh(np.array(devs), axis_names=("regions",))
+        while len(_FLAT_MESHES) > 8:
+            _FLAT_MESHES.pop(next(iter(_FLAT_MESHES)))
+    return m
+
+
+_ZERO_SLABS: dict = {}
+
+
+def _zero_slab(dev, pad: int, n_rows: int, dtype):
+    """Cached per-device zero padding slabs (content is irrelevant — pad
+    slabs carry ``n_valid == 0``, so the validity mask excludes every row)."""
+    key = (dev.id, pad, n_rows, np.dtype(dtype).str)
+    z = _ZERO_SLABS.get(key)
+    if z is None:
+        z = _ZERO_SLABS[key] = jax.device_put(
+            np.zeros((pad, n_rows), dtype=dtype), dev)
+        while len(_ZERO_SLABS) > 64:
+            _ZERO_SLABS.pop(next(iter(_ZERO_SLABS)))
+    return z
+
+
+def _slab_pins(ev, cache, assign: dict, by_id: dict, ship, nullable):
+    """Per-owner-device pinned slab stacks for ONE region image.
+
+    ``assign``: device id -> ascending block indices.  Returns {device_id:
+    (data_tuple[(B_d, rows)] per ship col, nulls_tuple per nullable col)},
+    each leaf COMMITTED to its owner device.  Pinned on the cache under a
+    ``shardslab`` signature, so repeat batches pay zero transfer; a delta
+    apply drops the pins (cache.scatter_update treats the kind as opaque)
+    and they rebuild here from the updated host blocks."""
+    fp = tuple(sorted((did, tuple(bs)) for did, bs in assign.items()))
+    sig = ("shardslab", fp, tuple(ship), tuple(nullable), ev.block_rows)
+
+    def _canon(arr):
+        # one dtype per lane across every cache in a batch (the global
+        # sharded array needs uniform shards even from devices whose slabs
+        # came from different regions): f64 stays, everything else rides
+        # the int64 lanes the device step computes in anyway
+        arr = np.asarray(arr)
+        return arr.astype(np.int64, copy=False) if arr.dtype != np.float64 else arr
+
+    def build(_blk):
+        out = {}
+        for did, idxs in assign.items():
+            dev = by_id[did]
+            blocks = [cache.blocks[i] for i in idxs]
+            data = tuple(
+                jax.device_put(
+                    np.stack([_canon(ev._pad(b.cols[i].data)) for b in blocks]),
+                    dev,
+                )
+                for i in ship
+            )
+            nulls = tuple(
+                jax.device_put(
+                    np.stack([np.asarray(ev._pad(b.cols[i].nulls, True)) for b in blocks]),
+                    dev,
+                )
+                for i in nullable
+            )
+            out[did] = (data, nulls)
+        for leaf in jax.tree.leaves(out):
+            leaf.block_until_ready()
+        return out
+
+    return cache.device_arrays(cache.blocks[0], sig, build)
+
+
+def slab_assignment(caches, mesh) -> list[dict]:
+    """Per-cache {device_id: block indices} over the flat mesh: honors the
+    region cache's placement metadata (``owner_devices``, written by
+    RegionColumnCache in sharded mode) and falls back to whole-region
+    round-robin for caches without one (block caches, tests)."""
+    devices = list(np.asarray(mesh.devices).reshape(-1))
+    ids = {d.id for d in devices}
+    out = []
+    for r, cache in enumerate(caches):
+        owners = getattr(cache, "owner_devices", None)
+        if (owners is None or len(owners) != len(cache.blocks)
+                or any(o not in ids for o in owners)):
+            if len(caches) == 1:
+                # a lone unplaced cache (plain block cache, cache_version
+                # path): block-spread it — pinning a whole region on one
+                # device while N-1 idle defeats the sharded program
+                owners = [devices[b % len(devices)].id
+                          for b in range(len(cache.blocks))]
+            else:
+                owners = [devices[r % len(devices)].id] * len(cache.blocks)
+        assign: dict[int, list[int]] = {}
+        for b, did in enumerate(owners):
+            assign.setdefault(did, []).append(b)
+        out.append(assign)
+    return out
+
+
+def device_slab_load(caches, mesh) -> dict[int, int]:
+    """Slabs per device for a prospective batch, derived from
+    :func:`slab_assignment` — THE one fold shared by the scheduler's
+    padding-shed/occupancy metrics and the benches, so reported geometry
+    can never diverge from what the launcher dispatches."""
+    devices = list(np.asarray(mesh.devices).reshape(-1))
+    load = {d.id: 0 for d in devices}
+    for assign in slab_assignment(caches, mesh):
+        for did, idxs in assign.items():
+            load[did] += len(idxs)
+    return load
+
+
+def launch_xregion_sharded(ev: JaxDagEvaluator, caches, mesh: Mesh) -> XRegionPending:
+    """ONE aggregation plan over R cached region images as ONE ``shard_map``
+    program over EVERY device of ``mesh`` — the sharded twin of
+    ``jax_eval.launch_xregion_cached``.
+
+    Each (region, block) pair is a SLAB living on its owner device (the
+    region column cache's placement: whole regions normally, block-spread
+    for single huge regions).  Every device scans its local slabs with the
+    same fused block step as the single-device path — per-slab ``n_valid``
+    masks keep padding inert — accumulating partial states into a
+    region-slot-segmented carry (capacity R×C).  Partial states then merge
+    across devices with the ``_collective`` rules (`psum`/`pmin`/`pmax` over
+    ICI; bitwise via gather+fold), the exact merge semantics the sharded
+    evaluators above already use, and ONE packed pull serves every region.
+
+    Raises ValueError on documented declines (non-aggregation plan, an
+    aggregate with no mesh merge rule, unstable group dictionaries, empty
+    cache); callers fall back to the single-device warm path per request.
+    """
+    from ..copr.jax_eval import xregion_specs
+
+    _require_mesh_mergeable(ev.device_aggs)
+    specs, group_cols, capacity = xregion_specs(ev, caches)
+    flat = _flat_regions_mesh(mesh)
+    devices = list(np.asarray(flat.devices).reshape(-1))
+    by_id = {d.id: d for d in devices}
+    N = len(devices)
+    R = len(caches)
+    ship = tuple(ev._ship_cols(group_cols))
+    nullable = tuple(ev.nullable_cols)
+    n_rows = ev.block_rows
+
+    assigns = slab_assignment(caches, flat)
+    per_dev_slabs = {d.id: 0 for d in devices}
+    for assign in assigns:
+        for did, idxs in assign.items():
+            per_dev_slabs[did] += len(idxs)
+    S = max(1, max(per_dev_slabs.values()))
+
+    pins = [
+        _slab_pins(ev, c, a, by_id, ship, nullable)
+        for c, a in zip(caches, assigns)
+    ]
+    region_offsets = []
+    for cache in caches:
+        nv = np.array([b.n_valid for b in cache.blocks], dtype=np.int64)
+        region_offsets.append(np.concatenate([[0], np.cumsum(nv)[:-1]]).astype(np.int64))
+
+    # per-device shard assembly: concat each device's pinned slab stacks in
+    # region-major order (matching the metadata below), zero-pad to S slabs.
+    # All inputs are committed to the device, so the concat runs THERE —
+    # the host never touches row data on the warm path.
+    from ..copr.datatypes import EvalType
+
+    ship_dtypes = [
+        np.float64 if ev.schema[i][0] == EvalType.REAL else np.int64 for i in ship
+    ]
+    meta_region = np.zeros((N, S), dtype=np.int32)
+    meta_nv = np.zeros((N, S), dtype=np.int64)
+    meta_off = np.zeros((N, S), dtype=np.int64)
+    shard_data: list = []
+    shard_nulls: list = []
+    for di, dev in enumerate(devices):
+        did = dev.id
+        parts_d: list = [[] for _ in ship]
+        parts_n: list = [[] for _ in nullable]
+        si = 0
+        for r, cache in enumerate(caches):
+            idxs = assigns[r].get(did)
+            if not idxs:
+                continue
+            data, nulls = pins[r][did]
+            for j in range(len(ship)):
+                parts_d[j].append(data[j])
+            for j in range(len(nullable)):
+                parts_n[j].append(nulls[j])
+            for b in idxs:
+                meta_region[di, si] = r
+                meta_nv[di, si] = cache.blocks[b].n_valid
+                meta_off[di, si] = region_offsets[r][b]
+                si += 1
+        pad = S - si
+
+        def _cat(parts, dtype):
+            if pad:
+                parts = parts + [_zero_slab(dev, pad, n_rows, dtype)]
+            return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+        shard_data.append([_cat(parts_d[j], ship_dtypes[j]) for j in range(len(ship))])
+        shard_nulls.append([_cat(parts_n[j], np.bool_) for j in range(len(nullable))])
+
+    ns = NamedSharding(flat, P("regions"))
+    ns_rep = NamedSharding(flat, P())
+    col_data = tuple(
+        jax.make_array_from_single_device_arrays(
+            (N * S, n_rows), ns, [shard_data[di][j] for di in range(N)]
+        )
+        for j in range(len(ship))
+    )
+    col_nulls = tuple(
+        jax.make_array_from_single_device_arrays(
+            (N * S, n_rows), ns, [shard_nulls[di][j] for di in range(N)]
+        )
+        for j in range(len(nullable))
+    )
+    slab_region = jax.device_put(meta_region.reshape(N * S), ns)
+    n_valids = jax.device_put(meta_nv.reshape(N * S), ns)
+    offsets = jax.device_put(meta_off.reshape(N * S), ns)
+    dl_arr = jax.device_put(
+        np.array([s[1] for s in specs], dtype=np.int64).reshape(R, len(group_cols)),
+        ns_rep,
+    )
+
+    key = ("xshard", tuple(d.id for d in devices), S, R, capacity,
+           ship, nullable, len(group_cols))
+    fn = ev._agg_fn_cache.get(key)
+    if fn is None:
+        device_aggs = ev.device_aggs
+        sel_rpns = ev.sel_rpns
+        track_first = bool(ev.group_rpns)
+        cap_total = R * capacity
+        in_specs = (
+            tuple(P("regions") for _ in ship),
+            tuple(P("regions") for _ in nullable),
+            P("regions"), P("regions"), P("regions"), P(),
+        )
+
+        @_smap(flat, in_specs, (P(), P()))
+        def xfn(col_data, col_nulls, slab_region, n_valids, offsets, dl_arr):
+            state = (
+                jnp.full(cap_total, _NO_ROW, dtype=jnp.int64),
+                tuple(da.init_carry(cap_total) for da in device_aggs),
+            )
+
+            def body(st, xs):
+                cd, cn, r, nv, off = xs
+                cols = _build_cols(ship, nullable, cd, cn, n_rows)
+                local = jnp.zeros(n_rows, dtype=jnp.int64)
+                for k, gi in enumerate(group_cols):
+                    codes, gnulls = cols[gi]
+                    dlen = dl_arr[r, k]
+                    local = local * (dlen + 1) + jnp.where(gnulls, dlen, codes)
+                # region-slot-segmented gids: slab r's rows land in the
+                # [r*capacity, (r+1)*capacity) segment window, so ONE fused
+                # step accumulates every region's state side by side
+                gids = r.astype(jnp.int64) * capacity + local
+                return _fused_step(
+                    sel_rpns, device_aggs, cap_total, n_rows, cols, nv, gids,
+                    off, st, track_first=track_first,
+                ), None
+
+            state, _ = jax.lax.scan(
+                body, state, (col_data, col_nulls, slab_region, n_valids, offsets)
+            )
+            first, carries = state
+            # cross-device merge: a region's slabs may live on one device
+            # (others contribute identity) or spread across several (a
+            # block-sharded huge region) — the leaf-wise collective rules
+            # cover both
+            first = _collective("min", first, "regions")
+            merged = tuple(
+                tuple(
+                    _collective(kind, leaf, "regions")
+                    for kind, leaf in zip(_MERGE[da.op], c)
+                )
+                for da, c in zip(device_aggs, carries)
+            )
+            from ..copr.jax_eval import _pack_region_leaves
+
+            leaves = [first] + jax.tree.leaves(merged)
+            return _pack_region_leaves(leaves, R, capacity)  # (R, L*, cap)
+
+        fn = jax.jit(xfn)
+        ev._agg_fn_cache[key] = fn
+        xkeys = [k for k in ev._agg_fn_cache if isinstance(k, tuple)
+                 and k and k[0] == "xshard"]
+        while len(xkeys) > 16:
+            ev._agg_fn_cache.pop(xkeys.pop(0))
+
+    packed = fn(col_data, col_nulls, slab_region, n_valids, offsets, dl_arr)
+    return XRegionPending(ev, specs, capacity, packed, order=None)
+
+
+def run_xregion_sharded(ev: JaxDagEvaluator, caches, mesh: Mesh):
+    """launch + finalize in one step (tests / single-batch callers)."""
+    return launch_xregion_sharded(ev, caches, mesh).finalize()
 
 
 class MeshServingRunner:

@@ -93,6 +93,7 @@ class StoreServer:
         raft_engine: bool = True,
         encryption_master_key: str | None = None,
         sched_continuous: bool = False,
+        shard_cache: bool = True,
     ):
         self.pd = pd
         self.security = security
@@ -145,11 +146,20 @@ class StoreServer:
         self.resolved_ts.attach_store(self.store)
         self.raftkv = RaftKv(self.store, resolved_ts=self.resolved_ts)
         self.storage = Storage(engine=self.raftkv)
+        mesh = _default_mesh() if enable_device else None
         self.copr = Endpoint(
             self.raftkv, enable_device=enable_device,
-            mesh=_default_mesh() if enable_device else None,
+            mesh=mesh,
             feature_gate=self.feature_gate,
+            shard_cache=shard_cache,
         )
+        if mesh is not None and getattr(mesh, "size", 1) > 1:
+            rc = self.copr.region_cache
+            mode = ("sharded warm cache"
+                    if rc is not None and getattr(rc, "sharded", False)
+                    else "single-device warm cache")
+            print(f"[standalone] serving mesh {dict(mesh.shape)} ({mode})",
+                  file=sys.stderr)
         if sched_continuous:
             # continuous cross-region batching: unary coprocessor requests
             # from concurrent connections coalesce in the read scheduler's
@@ -418,6 +428,9 @@ def main(argv=None) -> int:
     ap.add_argument("--sched-continuous", action="store_true",
                     help="coalesce unary coprocessor requests across "
                          "connections in the read scheduler's priority lanes")
+    ap.add_argument("--no-shard-cache", action="store_true",
+                    help="keep the region column cache single-device even "
+                         "with a multi-chip mesh (sharded warm serving off)")
     ap.add_argument("--no-raft-engine", action="store_true",
                     help="keep the raft log in CF_RAFT instead of the segmented log engine")
     ap.add_argument("--ca-path", default="")
@@ -449,6 +462,7 @@ def main(argv=None) -> int:
         security=security, raft_engine=not args.no_raft_engine,
         encryption_master_key=args.encryption_master_key,
         sched_continuous=args.sched_continuous,
+        shard_cache=not args.no_shard_cache,
     )
     srv.start()
     srv.bootstrap_or_join(args.expect_stores)

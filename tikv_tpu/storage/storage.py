@@ -52,6 +52,16 @@ class Storage:
         self.scheduler = Scheduler(self.engine, self.cm)
         self._raw_latches = Latches(64)
 
+    @staticmethod
+    def _observe_batch(op: str, n: int) -> None:
+        from ..util.metrics import REGISTRY
+
+        REGISTRY.histogram(
+            "tikv_storage_batch_size",
+            "Keys per batched storage call, by op",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+        ).observe(n, op=op)
+
     # -- transactional reads ----------------------------------------------
 
     def get(
@@ -68,14 +78,20 @@ class Storage:
         return PointGetter(snap, ts, isolation, bypass_locks).get(k)
 
     def batch_get(self, keys: list[bytes], ts: int, ctx: dict | None = None, **kw) -> list[tuple[bytes, bytes]]:
+        """One snapshot, ONE PointGetter, one pass (mod.rs:270 batch_get) —
+        the old shape re-entered per key, building a fresh getter (fresh
+        Statistics, fresh isolation plumbing) for every key of the batch."""
         out = []
         snap = self.engine.snapshot(ctx)
+        bypass = kw.get("bypass_locks", frozenset())
+        getter = PointGetter(snap, ts, **kw)
         for key in keys:
             k = Key.from_raw(key)
-            self.cm.read_key_check(k, ts, kw.get("bypass_locks", frozenset()))
-            v = PointGetter(snap, ts, **kw).get(k)
+            self.cm.read_key_check(k, ts, bypass)
+            v = getter.get(k)
             if v is not None:
                 out.append((key, v))
+        self._observe_batch("batch_get", len(keys))
         return out
 
     def scan(
@@ -150,6 +166,7 @@ class Storage:
                 dec = _decode_raw_value(stored, now)
                 if dec is not None:
                     out.append((key, dec[0]))
+        self._observe_batch("raw_batch_get", len(keys))
         return out
 
     def raw_put(self, key: bytes, value: bytes, ctx: dict | None = None, ttl: int = 0) -> None:
@@ -163,6 +180,7 @@ class Storage:
         for k, v in pairs:
             wb.put_cf(CF_DEFAULT, _raw_key(k), _encode_raw_value(v, ttl, now))
         self.engine.write(ctx, wb)
+        self._observe_batch("raw_batch_put", len(pairs))
 
     def raw_delete(self, key: bytes, ctx: dict | None = None) -> None:
         wb = WriteBatch()
@@ -170,10 +188,13 @@ class Storage:
         self.engine.write(ctx, wb)
 
     def raw_batch_delete(self, keys: list[bytes], ctx: dict | None = None) -> None:
+        """ONE write batch for the whole key set — a single replicated write
+        (and a single engine commit) instead of one per key."""
         wb = WriteBatch()
         for k in keys:
             wb.delete_cf(CF_DEFAULT, _raw_key(k))
         self.engine.write(ctx, wb)
+        self._observe_batch("raw_batch_delete", len(keys))
 
     def raw_delete_range(self, start: bytes, end: bytes, ctx: dict | None = None) -> None:
         wb = WriteBatch()

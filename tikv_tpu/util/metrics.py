@@ -76,6 +76,11 @@ class Histogram(_Metric):
             self._sum[key] = self._sum.get(key, 0) + value
             self._n[key] = self._n.get(key, 0) + 1
 
+    def count(self, **labels) -> int:
+        """Observation count for a label set (the _count series)."""
+        with self._mu:
+            return self._n.get(tuple(sorted(labels.items())), 0)
+
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
         with self._mu:
