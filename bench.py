@@ -707,6 +707,149 @@ def _op_sharded_xregion(req, state):
     }
 
 
+def _op_mixed_rw(req, state):
+    """mixed_rw event (ISSUE 4): readers hammer a warm region WHILE writers
+    commit through the txn scheduler over a single-store raft group.
+
+    Two measurements on the same engine:
+
+    * write path — W single-key update txns (prewrite + commit) through the
+      scheduler, per-command (``group_commit_max=1``: one raft proposal per
+      command, today's shape) vs grouped (queued compatible commands
+      coalesce into one proposal).  The speedup is the propose→apply→ack
+      amortization of group commit.
+    * warm serving under writes — after every grouped write batch, one
+      coprocessor read of the region.  With write-through deltas the read
+      folds the buffered change into the resident image (outcome
+      ``wt_delta``/``hit``) instead of re-scanning CF_WRITE; the hit-rate
+      is warm outcomes / reads.  Every read is byte-checked against the
+      CPU pipeline over the same engine.
+    """
+    from tikv_tpu.copr.endpoint import CoprRequest, Endpoint
+    from tikv_tpu.copr.table import encode_row, record_key, record_range
+    from tikv_tpu.raft.cluster import FIRST_REGION_ID, Cluster
+    from tikv_tpu.storage.engine import CF_WRITE, WriteBatch
+    from tikv_tpu.storage.txn.commands import Commit, Prewrite
+    from tikv_tpu.storage.txn.scheduler import Scheduler
+    from tikv_tpu.storage.txn_types import Key, Mutation, Write, WriteType
+
+    rows = req.get("rows", 2048)
+    n_writes = req.get("writes", 64)  # txns per measured batch
+    rounds = req.get("rounds", 6)  # mixed read/write rounds
+    trials = req.get("trials", 3)
+    block_rows = 1 << max(10, (rows - 1).bit_length())
+
+    c = Cluster(1)
+    c.run()
+    kv = c.raftkv(1)
+    ctx = {"region_id": FIRST_REGION_ID}
+    # seed the table as ONE raft proposal (a bulk-load shape)
+    kvs = build_kvs(rows, seed=29)
+    wb = WriteBatch()
+    for rk, v in kvs:
+        wb.put_cf(CF_WRITE, Key.from_raw(rk).append_ts(20).encoded,
+                  Write(WriteType.PUT, 10, short_value=v).to_bytes())
+    kv.write(ctx, wb)
+    ep = Endpoint(kv, enable_device=True, block_rows=block_rows)
+    ep_cpu = Endpoint(kv, enable_device=False)
+    non_handle = _lineitem()[1:]
+    ts_state = {"ts": 1000}
+
+    def next_ts():
+        ts_state["ts"] += 1
+        return ts_state["ts"]
+
+    def commit_batch(sched, handles):
+        """W update txns: async-submit all prewrites, wait, then all
+        commits — the queue depth group commit feeds on."""
+        pending = []
+        for h in handles:
+            rk = record_key(TABLE_ID, int(h))
+            row = encode_row(non_handle,
+                             [int(h) % 50 + 1, 100000, 5, 9000, b"A", b"F"])
+            start = next_ts()
+            task = sched.submit(Prewrite(
+                [Mutation.put(Key.from_raw(rk), row)], rk, start_ts=start), ctx)
+            pending.append((rk, start, task))
+        for _rk, _start, t in pending:
+            t.done.wait(60)
+            if t.exc is not None:
+                raise t.exc
+        commits = [sched.submit(Commit([Key.from_raw(rk)], start, next_ts()), ctx)
+                   for rk, start, _t in pending]
+        for t in commits:
+            t.done.wait(60)
+            if t.exc is not None:
+                raise t.exc
+
+    rng = np.random.default_rng(31)
+
+    def measure(group_max):
+        sched = Scheduler(kv, pool_size=1, group_commit_max=group_max)
+        try:
+            ts = []
+            for _ in range(trials):
+                handles = rng.choice(rows, size=n_writes, replace=False)
+                t0 = time.perf_counter()
+                commit_batch(sched, handles)
+                ts.append(time.perf_counter() - t0)
+        finally:
+            sched.stop()
+        return ts
+
+    def read(ts):
+        req_ = CoprRequest(103, _filter_dag("scan", limit=2000),
+                           [record_range(TABLE_ID)], ts, context=dict(ctx))
+        return ep.handle_request(req_)
+
+    def read_cpu(ts):
+        req_ = CoprRequest(103, _filter_dag("scan", limit=2000),
+                           [record_range(TABLE_ID)], ts, context=dict(ctx))
+        return ep_cpu.handle_request(req_)
+
+    # warm the image + compile before timing anything
+    r0 = read(next_ts())
+    match = r0.data == read_cpu(ts_state["ts"]).data
+
+    percmd_ts = measure(1)
+    grouped_ts = measure(32)
+
+    # mixed phase: grouped writers + a reader per batch
+    sched = Scheduler(kv, pool_size=1, group_commit_max=32)
+    outcomes: list[str] = []
+    read_ts: list[float] = []
+    try:
+        for _ in range(rounds):
+            handles = rng.choice(rows, size=n_writes, replace=False)
+            commit_batch(sched, handles)
+            ts = next_ts()
+            t0 = time.perf_counter()
+            r = read(ts)
+            read_ts.append(time.perf_counter() - t0)
+            outcomes.append(r.metrics.get("region_cache", ""))
+            match &= r.data == read_cpu(ts).data
+    finally:
+        sched.stop()
+    warm = sum(1 for o in outcomes if o in ("wt_delta", "hit"))
+    st = ep.region_cache.stats
+    return {
+        "match": bool(match),
+        "rows": rows,
+        "writes_per_batch": n_writes,
+        "rounds": rounds,
+        "percmd_ts": [round(x, 4) for x in percmd_ts],
+        "grouped_ts": [round(x, 4) for x in grouped_ts],
+        "commits_per_s_percmd": n_writes / float(np.median(percmd_ts)),
+        "commits_per_s_grouped": n_writes / float(np.median(grouped_ts)),
+        "group_speedup": float(np.median(percmd_ts)) / float(np.median(grouped_ts)),
+        "warm_hit_rate": warm / max(len(outcomes), 1),
+        "outcomes": outcomes,
+        "read_rows_per_s": rows * len(read_ts) / max(sum(read_ts), 1e-9),
+        "scan_deltas": st.deltas,
+        "wt_deltas": st.wt_deltas,
+    }
+
+
 _OPS = {
     "build": _op_build,
     "warm": _op_warm,
@@ -718,6 +861,7 @@ _OPS = {
     "region_cache": _op_region_cache,
     "xregion": _op_xregion,
     "sharded_xregion": _op_sharded_xregion,
+    "mixed_rw": _op_mixed_rw,
 }
 
 
@@ -1184,6 +1328,32 @@ def main() -> None:
         except WorkerDied as e:
             results["xregion_error"] = str(e)[:200]
             _mark("xregion_error", err=str(e)[:120])
+
+    if os.environ.get("BENCH_MIXED_RW", "1") != "0":
+        # group-commit write path + warm serving under writes (ISSUE 4):
+        # runs in-parent on the CPU backend — it measures raft-proposal
+        # amortization and write-through cache behavior, not device compute.
+        # Auxiliary for infra failures; a byte mismatch is fatal.
+        try:
+            r = _op_mixed_rw({
+                "rows": int(os.environ.get("BENCH_MIXED_RW_ROWS", "2048")),
+                "writes": int(os.environ.get("BENCH_MIXED_RW_WRITES", "64")),
+            }, {})
+            if not r["match"]:
+                _fail("MIXED_RW_MISMATCH")
+            results["mixed_rw_group_speedup"] = r["group_speedup"]
+            results["mixed_rw_commits_per_s_percmd"] = r["commits_per_s_percmd"]
+            results["mixed_rw_commits_per_s_grouped"] = r["commits_per_s_grouped"]
+            results["mixed_rw_warm_hit_rate"] = r["warm_hit_rate"]
+            results["mixed_rw_read_rows_per_s"] = r["read_rows_per_s"]
+            results["mixed_rw_scan_deltas"] = r["scan_deltas"]
+            results["mixed_rw_wt_deltas"] = r["wt_deltas"]
+            _mark("mixed_rw", group_speedup=round(r["group_speedup"], 2),
+                  warm_hit_rate=round(r["warm_hit_rate"], 3),
+                  scan_deltas=r["scan_deltas"])
+        except Exception as e:  # noqa: BLE001
+            results["mixed_rw_error"] = str(e)[:200]
+            _mark("mixed_rw_error", err=str(e)[:120])
 
     if os.environ.get("BENCH_MVCC", "1") != "0":
         try:

@@ -19,6 +19,10 @@ regresses:
   in a subprocess (the virtual-device flag must precede jax init).  Fails
   on byte divergence or a speedup below the 1.5x floor; per-device
   occupancy is reported.
+* ``mixed_rw`` (ISSUE 4): writers commit through the txn scheduler over a
+  raft group while readers serve the warm region.  Fails on byte
+  divergence, a grouped-vs-per-command commit speedup below the 2x floor,
+  or a warm hit-rate under write load below 50%.
 
 Exit code 0 = healthy; 1 = regression.  One JSON line on stdout either way,
 so CI logs stay grep-able:
@@ -38,6 +42,8 @@ sys.path.insert(0, os.path.dirname(_HERE))
 MIN_SPEEDUP = 2.0
 MIN_XREGION_SPEEDUP = 2.0
 MIN_SHARDED_SPEEDUP = 1.5
+MIN_GROUP_SPEEDUP = 2.0
+MIN_WARM_HIT_RATE = 0.5
 SHARDED_DEVICES = 8
 
 
@@ -154,6 +160,28 @@ def main() -> int:
             ok = False
             out["sharded_xregion_regression"] = (
                 f"{sspeed:.2f}x < {MIN_SHARDED_SPEEDUP}x floor")
+
+    # group-commit write path + warm serving under writes (ISSUE 4)
+    rm = bench._op_mixed_rw({
+        "rows": int(os.environ.get("SMOKE_MIXED_RW_ROWS", "2048")),
+        "writes": int(os.environ.get("SMOKE_MIXED_RW_WRITES", "64")),
+        "trials": max(args.trials, 3),
+    }, {})
+    out["mixed_rw_match"] = bool(rm["match"])
+    ok = ok and rm["match"]
+    out["mixed_rw_group_speedup"] = round(rm["group_speedup"], 2)
+    out["mixed_rw_warm_hit_rate"] = round(rm["warm_hit_rate"], 3)
+    out["mixed_rw_scan_deltas"] = rm["scan_deltas"]
+    out["mixed_rw_commits_per_s_grouped"] = round(rm["commits_per_s_grouped"], 1)
+    if rm["group_speedup"] < MIN_GROUP_SPEEDUP:
+        ok = False
+        out["mixed_rw_group_regression"] = (
+            f"group commit {rm['group_speedup']:.2f}x < {MIN_GROUP_SPEEDUP}x floor")
+    if rm["warm_hit_rate"] < MIN_WARM_HIT_RATE:
+        ok = False
+        out["mixed_rw_hit_rate_regression"] = (
+            f"warm hit-rate {rm['warm_hit_rate']:.2f} < {MIN_WARM_HIT_RATE} "
+            f"under writes (outcomes: {rm['outcomes']})")
 
     out["ok"] = bool(ok)
     print(json.dumps(out))

@@ -36,6 +36,7 @@ LAZY_SERIES = {
     "tikv_coprocessor_sharded_merge_seconds",
     "tikv_coprocessor_mesh_cache_hit_total",
     "tikv_coprocessor_region_cache_total",
+    "tikv_coprocessor_region_cache_wt_lost_total",
     "tikv_coprocessor_region_cache_device_bytes",
     "tikv_storage_batch_size",
     "tikv_coprocessor_region_cache_delta_rows_total",

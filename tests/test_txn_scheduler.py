@@ -1,0 +1,296 @@
+"""Group-commit write path (ISSUE 4 tentpole) + latch wake-up chains.
+
+Contracts under test:
+
+* queued compatible prewrite/commit commands coalesce into ONE engine write
+  (the raft proposal the group amortizes), with results and persisted state
+  byte-identical to per-command execution
+* per-command errors inside a group fail only their own task
+* releasing a group-executed batch wakes every parked conflicting command —
+  FIFO per latch slot, no lost wake-ups, including overlapping multi-slot
+  commands
+* ``tikv_scheduler_too_busy_total`` / ``tikv_scheduler_group_size`` are real
+  REGISTRY metrics (satellite: SchedTooBusy used to bump only a stats dict)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from tikv_tpu.storage.kv import LocalEngine
+from tikv_tpu.storage.mvcc.txn import TxnLockNotFoundError
+from tikv_tpu.storage.storage import Storage
+from tikv_tpu.storage.txn.commands import Commit, Prewrite
+from tikv_tpu.storage.txn.latches import Latches
+from tikv_tpu.storage.txn.scheduler import Scheduler, SchedTooBusy
+from tikv_tpu.storage.txn_types import Key, Mutation
+from tikv_tpu.util.metrics import REGISTRY
+
+
+class CountingEngine(LocalEngine):
+    """LocalEngine that counts write() calls — each one stands in for a raft
+    propose→apply→ack round trip."""
+
+    def __init__(self):
+        super().__init__()
+        self.write_calls = 0
+
+    def write(self, ctx, batch):
+        self.write_calls += 1
+        return super().write(ctx, batch)
+
+
+class _Blocker:
+    """Non-groupable command that parks the (single) worker until released,
+    letting the test queue a deterministic backlog behind it.  ``started``
+    fires once the worker is actually inside process_write — tests MUST
+    wait on it before queueing (a sleep-based guess is flaky on a loaded
+    box and splits the group)."""
+
+    exclusive = False
+    groupable = False
+
+    def __init__(self, key=b"__blocker__"):
+        self.key = key
+        self.started = threading.Event()
+        self.release = threading.Event()
+
+    def latch_keys(self):
+        return [self.key]
+
+    def process_write(self, snapshot):
+        from tikv_tpu.storage.mvcc.txn import MvccTxn
+
+        self.started.set()
+        self.release.wait(10)
+        return MvccTxn(1), None
+
+
+def _prewrite(i, ts, key=None):
+    key = key if key is not None else b"k%03d" % i
+    return Prewrite([Mutation.put(Key.from_raw(key), b"v%d" % ts)], key, start_ts=ts)
+
+
+def _slot_distinct_keys(sched, n, prefix=b"k"):
+    """Keys whose ENCODED forms hash to n distinct latch slots — commands
+    latch ``Key.encoded``, and a slot collision would PARK the later command
+    (correct, but it splits the group and breaks exact engine-write-count
+    assertions; key hashing is seed-dependent)."""
+    keys, used = [], set()
+    i = 0
+    while len(keys) < n:
+        k = prefix + b"%04d" % i
+        i += 1
+        s = sched.latches.slot_ids([Key.from_raw(k).encoded])[0]
+        if s not in used:
+            used.add(s)
+            keys.append(k)
+    return keys
+
+
+def _commit(i, start, commit, key=None):
+    key = key if key is not None else b"k%03d" % i
+    return Commit([Key.from_raw(key)], start, commit)
+
+
+def test_group_commit_one_engine_write_for_queued_prewrites():
+    eng = CountingEngine()
+    sched = Scheduler(eng, pool_size=1, group_commit_max=32)
+    keys = _slot_distinct_keys(sched, 8)
+    blocker = _Blocker()
+    tb = sched.submit(blocker)
+    assert blocker.started.wait(10)  # worker parked on the blocker
+    tasks = [sched.submit(_prewrite(i, ts=10, key=k)) for i, k in enumerate(keys)]
+    before = eng.write_calls
+    blocker.release.set()
+    for t in tasks:
+        assert t.done.wait(10)
+        assert t.exc is None, t.exc
+    assert tb.done.wait(10)
+    # 8 prewrites, one grouped engine write
+    assert eng.write_calls - before == 1, eng.write_calls - before
+    # all 8 locks are really in the engine: commits succeed
+    blocker2 = _Blocker(b"__blocker2__")
+    tb2 = sched.submit(blocker2)
+    assert blocker2.started.wait(10)
+    commits = [sched.submit(_commit(i, 10, 20, key=k)) for i, k in enumerate(keys)]
+    before = eng.write_calls
+    blocker2.release.set()
+    for t in commits:
+        assert t.done.wait(10)
+        assert t.exc is None, t.exc
+    assert tb2.done.wait(10)
+    assert eng.write_calls - before == 1
+    sched.stop()
+    # committed values readable through the normal MVCC read path
+    storage = Storage(engine=eng)
+    for k in keys:
+        assert storage.get(k, 30) == b"v10"
+
+
+def test_group_commit_results_identical_to_per_command():
+    """Same workload through a grouping and a non-grouping scheduler must
+    leave byte-identical engine state."""
+
+    def run(group_max):
+        eng = CountingEngine()
+        sched = Scheduler(eng, pool_size=1, group_commit_max=group_max)
+        blocker = _Blocker()
+        sched.submit(blocker)
+        assert blocker.started.wait(10)
+        tasks = [sched.submit(_prewrite(i, ts=5)) for i in range(6)]
+        tasks += [sched.submit(_prewrite(i, ts=5, key=b"x%d" % i)) for i in range(3)]
+        blocker.release.set()
+        for t in tasks:
+            assert t.done.wait(10) and t.exc is None
+        c = [sched.submit(_commit(i, 5, 9)) for i in range(6)]
+        for t in c:
+            assert t.done.wait(10) and t.exc is None
+        sched.stop()
+        snap = eng.snapshot(None)
+        state = []
+        for cf in ("default", "lock", "write"):
+            state.extend((cf, k, v) for k, v in snap.scan_cf(cf, b"", b"\xff" * 20))
+        return state, eng.write_calls
+
+    grouped, grouped_writes = run(32)
+    solo, solo_writes = run(1)
+    assert grouped == solo
+    assert grouped_writes < solo_writes
+
+
+def test_group_member_error_does_not_poison_the_group():
+    """A commit with no lock (TxnLockNotFoundError) grouped with healthy
+    commands fails alone; the rest land."""
+    eng = CountingEngine()
+    sched = Scheduler(eng, pool_size=1, group_commit_max=32)
+    storage = Storage(engine=eng)
+    # prewrite k0..k3 the normal way
+    for i in range(4):
+        t = sched.submit(_prewrite(i, ts=7))
+        assert t.done.wait(10) and t.exc is None
+    blocker = _Blocker()
+    sched.submit(blocker)
+    assert blocker.started.wait(10)
+    good = [sched.submit(_commit(i, 7, 11)) for i in range(4)]
+    bad = sched.submit(Commit([Key.from_raw(b"never-prewritten")], 7, 11))
+    blocker.release.set()
+    for t in good:
+        assert t.done.wait(10)
+        assert t.exc is None, t.exc
+    assert bad.done.wait(10)
+    assert isinstance(bad.exc, TxnLockNotFoundError)
+    sched.stop()
+    for i in range(4):
+        assert storage.get(b"k%03d" % i, 20) == b"v7"
+
+
+def test_group_release_wakes_parked_commands_no_lost_wakeups():
+    """Commands parked behind group members must all wake when the group's
+    batch releases — and land their writes (a lost wake-up would hang the
+    done.wait below)."""
+    eng = CountingEngine()
+    sched = Scheduler(eng, pool_size=2, group_commit_max=32)
+    blocker = _Blocker()
+    sched.submit(blocker)
+    assert blocker.started.wait(10)
+    first = [sched.submit(_prewrite(i, ts=3)) for i in range(6)]
+    # conflicting second wave: same keys -> parked in the latch queues
+    second = [sched.submit(Commit([Key.from_raw(b"k%03d" % i)], 3, 4))
+              for i in range(6)]
+    blocker.release.set()
+    for t in first + second:
+        assert t.done.wait(10), "lost wake-up: task never ran"
+        assert t.exc is None, t.exc
+    sched.stop()
+    storage = Storage(engine=eng)
+    for i in range(6):
+        assert storage.get(b"k%03d" % i, 9) == b"v3"
+
+
+def test_latch_fifo_across_overlapping_multislot_commands():
+    """Chained multi-slot commands A(k1,k2), B(k2,k3), C(k3,k4): releases
+    must wake exactly the next-in-line once it holds EVERY slot — FIFO per
+    slot, no premature or duplicate wake-ups."""
+    lat = Latches(64)
+    ca, cb, cc = lat.gen_cid(), lat.gen_cid(), lat.gen_cid()
+    ga, sa = lat.acquire(ca, [b"k1", b"k2"], payload="A")
+    gb, sb = lat.acquire(cb, [b"k2", b"k3"], payload="B")
+    gc_, sc = lat.acquire(cc, [b"k3", b"k4"], payload="C")
+    assert ga and not gb
+    # C holds k4 and the k3 front (B queued behind nothing on k3? no: B
+    # enqueued on k3 first) — C is behind B on k3, so C is parked too
+    assert not gc_
+    assert lat.release(ca, sa) == ["B"]  # exactly B, exactly once
+    assert lat.release(cb, sb) == ["C"]
+    assert lat.release(cc, sc) == []
+
+
+def test_latch_fifo_interleaved_under_group_execution():
+    """Heavy interleaving through the real scheduler: per-key commit order
+    must equal submission order even when group commit batches writers."""
+    eng = CountingEngine()
+    sched = Scheduler(eng, pool_size=3, group_commit_max=8)
+    storage = Storage(engine=eng)
+    N = 12
+    blocker = _Blocker()
+    sched.submit(blocker)
+    assert blocker.started.wait(10)
+    tasks = []
+    for ts in range(1, N + 1):
+        # every command touches the shared hot key + a private key
+        key = b"hot"
+        m = [Mutation.put(Key.from_raw(key), b"w%03d" % ts),
+             Mutation.put(Key.from_raw(b"p%03d" % ts), b"x")]
+        tasks.append(sched.submit(Prewrite(m, key, start_ts=ts)))
+    blocker.release.set()
+    done = [t.done.wait(10) for t in tasks]
+    assert all(done)
+    # first prewrite wins the hot key; the rest see its lock (FIFO means
+    # exactly the submission-order head succeeded)
+    oks = [t for t in tasks if not t.result.get("errors")]
+    assert tasks[0] in oks
+    for t in tasks[1:]:
+        errs = t.result.get("errors") or []
+        assert errs, "later prewrite must have collided with the first lock"
+    sched.stop()
+    assert storage.scan_lock(None, None, 1 << 60)
+
+
+def test_too_busy_and_group_size_are_registry_metrics():
+    busy_before = REGISTRY.counter("tikv_scheduler_too_busy_total", "").get()
+    g_before = REGISTRY.histogram("tikv_scheduler_group_size", "").count()
+    eng = CountingEngine()
+    sched = Scheduler(eng, pool_size=1, pending_write_threshold=2,
+                      group_commit_max=32)
+    blocker = _Blocker()
+    sched.submit(blocker)
+    assert blocker.started.wait(10)
+    t1 = sched.submit(_prewrite(0, ts=2))
+    with pytest.raises(SchedTooBusy):
+        sched.submit(_prewrite(1, ts=2))
+    assert REGISTRY.counter(
+        "tikv_scheduler_too_busy_total", "").get() == busy_before + 1
+    blocker.release.set()
+    assert t1.done.wait(10)
+    sched.stop()
+    assert REGISTRY.histogram("tikv_scheduler_group_size", "").count() > g_before
+
+
+def test_group_commit_disabled_is_per_command():
+    eng = CountingEngine()
+    sched = Scheduler(eng, pool_size=1, group_commit_max=1)
+    keys = _slot_distinct_keys(sched, 5)
+    blocker = _Blocker()
+    sched.submit(blocker)
+    assert blocker.started.wait(10)
+    tasks = [sched.submit(_prewrite(i, ts=4, key=k)) for i, k in enumerate(keys)]
+    before = eng.write_calls
+    blocker.release.set()
+    for t in tasks:
+        assert t.done.wait(10) and t.exc is None
+    assert eng.write_calls - before == 5
+    sched.stop()

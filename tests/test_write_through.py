@@ -1,0 +1,413 @@
+"""Write-through region-cache deltas (ISSUE 4 tentpole).
+
+Acceptance contract: with write-through enabled, a warm read after N
+committed writes performs ZERO ``scan_delta`` CF_WRITE scans
+(counter-asserted via ``stats.deltas``) and responses stay byte-identical to
+the scan_delta and cold CPU paths.  A failpoint disabling apply-side
+emission (including a mid-batch toggle) must leave responses byte-identical
+through the scan_delta fallback.
+
+Unit tests drive :func:`notify_region_write` with exactly the op tuples the
+raft apply path emits; the ``raft`` tests run the whole pipeline — txn
+scheduler (group commit) → raft propose/apply → ``_exec_data_cmd`` emission
+→ warm coprocessor serve — over a real in-process cluster.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from copr_fixtures import PRODUCT_COLUMNS, TABLE_ID
+from fixtures import put_committed
+
+from tikv_tpu.copr.dag import Aggregation, DagRequest, Limit, Selection, TableScan
+from tikv_tpu.copr.aggr import AggDescriptor
+from tikv_tpu.copr.endpoint import CoprRequest, Endpoint
+from tikv_tpu.copr.region_cache import notify_region_write, notify_region_write_lost
+from tikv_tpu.copr.rpn import call, col, const_int
+from tikv_tpu.copr.rowv2 import encode_row_v2
+from tikv_tpu.copr.table import encode_row, record_key, record_range
+from tikv_tpu.storage.btree_engine import BTreeEngine
+from tikv_tpu.storage.engine import CF_DEFAULT, CF_LOCK, CF_WRITE, WriteBatch
+from tikv_tpu.storage.kv import LocalEngine
+from tikv_tpu.storage.txn_types import Key, Lock, LockType, Write, WriteType
+from tikv_tpu.util import failpoint
+
+NON_HANDLE = [c for c in PRODUCT_COLUMNS if not c.is_pk_handle]
+N_ROWS = 64
+REGION = 7
+
+
+def _engine(n=N_ROWS, v2=False):
+    eng = BTreeEngine()
+    enc = encode_row_v2 if v2 else encode_row
+    for i in range(n):
+        name = [b"apple", b"banana", b"cherry"][i % 3]
+        put_committed(eng, record_key(TABLE_ID, i),
+                      enc(NON_HANDLE, [name, i * 7 % 23, 100 + i]), 90, 100)
+    return eng
+
+
+def _scan_dag():
+    return DagRequest(executors=[TableScan(TABLE_ID, PRODUCT_COLUMNS), Limit(1 << 20)])
+
+
+def _sel_dag():
+    return DagRequest(executors=[
+        TableScan(TABLE_ID, PRODUCT_COLUMNS),
+        Selection([call("gt", col(2), const_int(5))]),
+    ])
+
+
+def _agg_dag():
+    aggs = [AggDescriptor("sum", col(2)), AggDescriptor("count", None)]
+    return DagRequest(executors=[
+        TableScan(TABLE_ID, PRODUCT_COLUMNS), Aggregation([col(1)], aggs),
+    ])
+
+
+def _req(dag, ts, apply_index, region_id=REGION):
+    return CoprRequest(
+        103, dag, [record_range(TABLE_ID)], ts,
+        context={"region_id": region_id, "region_epoch": (1, 1),
+                 "apply_index": apply_index},
+    )
+
+
+def _pair(eng, **kw):
+    warm = Endpoint(LocalEngine(eng), enable_device=True, **kw)
+    cold = Endpoint(LocalEngine(eng), enable_device=True, enable_region_cache=False)
+    return warm, cold
+
+
+def commit_ops(eng, raw_key, value, start_ts, commit_ts, force_default=False):
+    """Apply a committed write to ``eng`` and return the exact op tuples the
+    raft apply path would emit for it (value None = committed DELETE)."""
+    k = Key.from_raw(raw_key)
+    ops = []
+    if value is None:
+        w = Write(WriteType.DELETE, start_ts)
+    elif len(value) <= 255 and not force_default:
+        w = Write(WriteType.PUT, start_ts, short_value=value)
+    else:
+        w = Write(WriteType.PUT, start_ts)
+        ops.append(("put", CF_DEFAULT, k.append_ts(start_ts).encoded, value))
+    ops.append(("put", CF_WRITE, k.append_ts(commit_ts).encoded, w.to_bytes()))
+    ops.append(("delete", CF_LOCK, k.encoded, None))
+    wb = WriteBatch()
+    for op, cf, key, val in ops:
+        if op == "put":
+            wb.put_cf(cf, key, val)
+        else:
+            wb.delete_cf(cf, key)
+    eng.write(wb)
+    return ops
+
+
+def lock_ops(eng, raw_key, start_ts, value=b"x"):
+    """A prewrite's lock put (data rides the lock's short value)."""
+    k = Key.from_raw(raw_key)
+    lock = Lock(LockType.PUT, raw_key, start_ts, ttl=30000, short_value=value)
+    eng.put_cf(CF_LOCK, k.encoded, lock.to_bytes())
+    return [("put", CF_LOCK, k.encoded, lock.to_bytes())]
+
+
+@pytest.mark.parametrize("v2", [False, True], ids=["rowv1", "rowv2"])
+@pytest.mark.parametrize("mk_dag", [_scan_dag, _sel_dag, _agg_dag],
+                         ids=["scan", "selection", "aggregation"])
+def test_wt_delta_zero_scan_byte_identical(v2, mk_dag):
+    """N committed writes between reads: the warm read folds the buffered
+    write-through delta in — outcome 'wt_delta', stats.deltas stays 0 (not
+    one CF_WRITE scan) — and bytes match the cold decode exactly."""
+    eng = _engine(v2=v2)
+    warm, cold = _pair(eng)
+    r0 = warm.handle_request(_req(mk_dag(), 200, 3))
+    assert r0.metrics["region_cache"] == "miss"
+
+    enc = encode_row_v2 if v2 else encode_row
+    ops = []
+    ops += commit_ops(eng, record_key(TABLE_ID, 5),
+                      enc(NON_HANDLE, [b"durian", 999, 5]), 210, 220)
+    ops += commit_ops(eng, record_key(TABLE_ID, 11),
+                      enc(NON_HANDLE, [b"apple", 1000, 6]), 210, 220)
+    notify_region_write(REGION, ops, 4)
+
+    r1 = warm.handle_request(_req(mk_dag(), 300, 4))
+    assert r1.metrics["region_cache"] == "wt_delta"
+    assert r1.metrics["region_cache_delta_rows"] == 2
+    assert warm.region_cache.stats.deltas == 0, "scan_delta must not run"
+    assert warm.region_cache.stats.wt_deltas == 1
+    assert r1.data == cold.handle_request(_req(mk_dag(), 300, 4)).data
+    # the folded image keeps serving plain hits
+    r2 = warm.handle_request(_req(mk_dag(), 300, 4))
+    assert r2.metrics["region_cache"] == "hit"
+    assert r2.data == r1.data
+
+
+def test_wt_delta_insert_and_delete_structural():
+    eng = _engine()
+    warm, cold = _pair(eng)
+    warm.handle_request(_req(_scan_dag(), 200, 3))
+    ops = []
+    ops += commit_ops(eng, record_key(TABLE_ID, 500),
+                      encode_row(NON_HANDLE, [b"elderberry", 7, 1]), 210, 220)
+    ops += commit_ops(eng, record_key(TABLE_ID, 0), None, 210, 220)
+    notify_region_write(REGION, ops, 4)
+    r = warm.handle_request(_req(_scan_dag(), 300, 4))
+    assert r.metrics["region_cache"] == "wt_delta"
+    assert warm.region_cache.stats.deltas == 0
+    assert r.data == cold.handle_request(_req(_scan_dag(), 300, 4)).data
+
+
+def test_wt_delta_large_value_resolves_via_getter():
+    eng = _engine()
+    warm, cold = _pair(eng)
+    warm.handle_request(_req(_scan_dag(), 200, 3))
+    row = encode_row(NON_HANDLE, [b"fig", 77, 88])
+    ops = commit_ops(eng, record_key(TABLE_ID, 9), row, 210, 220,
+                     force_default=True)
+    notify_region_write(REGION, ops, 4,
+                        get_default=lambda k: eng.get_cf(CF_DEFAULT, k))
+    r = warm.handle_request(_req(_scan_dag(), 300, 4))
+    assert r.metrics["region_cache"] == "wt_delta"
+    assert warm.region_cache.stats.deltas == 0
+    assert r.data == cold.handle_request(_req(_scan_dag(), 300, 4)).data
+
+
+def test_wt_large_value_without_getter_degrades_to_scan_delta():
+    eng = _engine()
+    warm, cold = _pair(eng)
+    warm.handle_request(_req(_scan_dag(), 200, 3))
+    ops = commit_ops(eng, record_key(TABLE_ID, 9),
+                     encode_row(NON_HANDLE, [b"fig", 77, 88]), 210, 220,
+                     force_default=True)
+    notify_region_write(REGION, ops, 4)  # no get_default -> unparseable
+    r = warm.handle_request(_req(_scan_dag(), 300, 4))
+    assert r.metrics["region_cache"] == "delta"  # scan_delta fallback
+    assert warm.region_cache.stats.wt_lost == 1
+    assert r.data == cold.handle_request(_req(_scan_dag(), 300, 4)).data
+
+
+def test_wt_lock_blocks_reader_then_commit_serves():
+    """A prewrite's lock flows through write-through: the warm read re-scans
+    CF_LOCK and raises exactly like the scanners; the commit clears it and
+    the next read folds the value in."""
+    eng = _engine()
+    warm, cold = _pair(eng)
+    warm.handle_request(_req(_scan_dag(), 200, 3))
+    notify_region_write(REGION, lock_ops(eng, record_key(TABLE_ID, 4), 250), 4)
+    with pytest.raises(Exception, match="locked"):
+        warm.handle_request(_req(_scan_dag(), 300, 4))
+    with pytest.raises(Exception, match="locked"):
+        cold.handle_request(_req(_scan_dag(), 300, 4))
+    ops = commit_ops(eng, record_key(TABLE_ID, 4),
+                     encode_row(NON_HANDLE, [b"grape", 1, 2]), 250, 260)
+    notify_region_write(REGION, ops, 5)
+    r = warm.handle_request(_req(_scan_dag(), 300, 5))
+    assert r.metrics["region_cache"] == "wt_delta"
+    assert warm.region_cache.stats.deltas == 0
+    assert r.data == cold.handle_request(_req(_scan_dag(), 300, 5)).data
+
+
+def test_wt_lost_marker_forces_scan_delta_then_recovers():
+    """notify_region_write_lost (the emission-off path) drops the pending
+    chain: the next read repairs via scan_delta; once repaired, fresh
+    notifies resume the write-through path."""
+    eng = _engine()
+    warm, cold = _pair(eng)
+    warm.handle_request(_req(_scan_dag(), 200, 3))
+    ops = commit_ops(eng, record_key(TABLE_ID, 5),
+                     encode_row(NON_HANDLE, [b"durian", 9, 9]), 210, 220)
+    notify_region_write(REGION, ops, 4)
+    # a write of unknown content lands (emission disabled for it)
+    put_committed(eng, record_key(TABLE_ID, 6),
+                  encode_row(NON_HANDLE, [b"kiwi", 8, 8]), 230, 240)
+    notify_region_write_lost(REGION, 5)
+    r = warm.handle_request(_req(_scan_dag(), 300, 5))
+    assert r.metrics["region_cache"] == "delta"  # repair via scan_delta
+    assert r.data == cold.handle_request(_req(_scan_dag(), 300, 5)).data
+    # emission resumes: pendings restart cleanly after the repair
+    ops = commit_ops(eng, record_key(TABLE_ID, 7),
+                     encode_row(NON_HANDLE, [b"lime", 3, 3]), 250, 260)
+    notify_region_write(REGION, ops, 6)
+    r2 = warm.handle_request(_req(_scan_dag(), 400, 6))
+    assert r2.metrics["region_cache"] == "wt_delta"
+    assert r2.data == cold.handle_request(_req(_scan_dag(), 400, 6)).data
+
+
+def test_wt_image_built_mid_stream_never_splices_a_gap():
+    """A notify that predates the image's build snapshot must not seed a
+    pending chain (the image would replay a delta it already contains or
+    miss one it never saw) — the read repairs through scan_delta."""
+    eng = _engine()
+    warm, cold = _pair(eng)
+    # a write is notified BEFORE any image exists (watermark advances)
+    ops = commit_ops(eng, record_key(TABLE_ID, 5),
+                     encode_row(NON_HANDLE, [b"durian", 9, 9]), 110, 120)
+    notify_region_write(REGION, ops, 4)
+    # image builds from an OLDER snapshot identity (apply_index 3)
+    warm.handle_request(_req(_scan_dag(), 200, 3))
+    # next notify: watermark (4) is ahead of the image (3) -> no pending
+    ops = commit_ops(eng, record_key(TABLE_ID, 6),
+                     encode_row(NON_HANDLE, [b"kiwi", 8, 8]), 210, 220)
+    notify_region_write(REGION, ops, 5)
+    r = warm.handle_request(_req(_scan_dag(), 300, 5))
+    assert r.metrics["region_cache"] == "delta"
+    assert r.data == cold.handle_request(_req(_scan_dag(), 300, 5)).data
+
+
+def test_wt_disabled_cache_keeps_scan_delta_path():
+    from tikv_tpu.copr.region_cache import RegionColumnCache
+
+    eng = _engine()
+    rc = RegionColumnCache(write_through=False)
+    warm = Endpoint(LocalEngine(eng), enable_device=True, region_cache=rc)
+    cold = Endpoint(LocalEngine(eng), enable_device=True, enable_region_cache=False)
+    warm.handle_request(_req(_scan_dag(), 200, 3))
+    ops = commit_ops(eng, record_key(TABLE_ID, 5),
+                     encode_row(NON_HANDLE, [b"durian", 9, 9]), 210, 220)
+    notify_region_write(REGION, ops, 4)
+    r = warm.handle_request(_req(_scan_dag(), 300, 4))
+    assert r.metrics["region_cache"] == "delta"
+    assert rc.stats.wt_deltas == 0
+    assert r.data == cold.handle_request(_req(_scan_dag(), 300, 4)).data
+
+
+# ---------------------------------------------------------------------------
+# End-to-end over raft: txn scheduler (group commit) -> apply -> emission
+# ---------------------------------------------------------------------------
+
+
+def _raft_harness(n_rows=48):
+    """One-store cluster with a seeded record table, a warm endpoint and a
+    CPU oracle over the SAME raft engine."""
+    from tikv_tpu.raft.cluster import FIRST_REGION_ID, Cluster
+
+    c = Cluster(1)
+    c.run()
+    kv = c.raftkv(1)
+    wb = WriteBatch()
+    for i in range(n_rows):
+        k = Key.from_raw(record_key(TABLE_ID, i))
+        w = Write(WriteType.PUT, 90,
+                  short_value=encode_row(NON_HANDLE, [b"apple", i % 23, 100 + i]))
+        wb.put_cf(CF_WRITE, k.append_ts(100).encoded, w.to_bytes())
+    kv.write({"region_id": FIRST_REGION_ID}, wb)
+    warm = Endpoint(kv, enable_device=True)
+    cold = Endpoint(kv, enable_device=False)
+    return c, kv, warm, cold, FIRST_REGION_ID
+
+
+def _raft_req(dag, ts, region_id):
+    return CoprRequest(103, dag, [record_range(TABLE_ID)], ts,
+                       context={"region_id": region_id})
+
+
+def _commit_rows_via_scheduler(kv, region_id, rows, ts0, group=True):
+    """Prewrite+commit ``rows`` as single-key txns through the real txn
+    scheduler over raft — grouped into coalesced proposals by default."""
+    from tikv_tpu.storage.txn.commands import Commit, Prewrite
+    from tikv_tpu.storage.txn.scheduler import Scheduler
+    from tikv_tpu.storage.txn_types import Mutation
+
+    sched = Scheduler(kv, pool_size=1, group_commit_max=32 if group else 1)
+    ctx = {"region_id": region_id}
+    try:
+        tasks = []
+        for i, (handle, row) in enumerate(rows):
+            rk = record_key(TABLE_ID, handle)
+            tasks.append(sched.submit(Prewrite(
+                [Mutation.put(Key.from_raw(rk), row)], rk, start_ts=ts0 + i), ctx))
+        for t in tasks:
+            assert t.done.wait(30) and t.exc is None, t.exc
+            assert not (t.result or {}).get("errors"), t.result
+        tasks = []
+        for i, (handle, _row) in enumerate(rows):
+            rk = record_key(TABLE_ID, handle)
+            tasks.append(sched.submit(Commit(
+                [Key.from_raw(rk)], ts0 + i, ts0 + 1000 + i), ctx))
+        for t in tasks:
+            assert t.done.wait(30) and t.exc is None, t.exc
+    finally:
+        sched.stop()
+    return ts0 + 1000 + len(rows)  # a ts above every commit
+
+
+def test_raft_write_through_end_to_end():
+    """The full pipeline: group-committed txn writes through raft, apply-side
+    emission, warm serve with zero scan_delta — byte-identical to the CPU
+    pipeline over the same engine."""
+    c, kv, warm, cold, rid = _raft_harness()
+    r0 = warm.handle_request(_raft_req(_scan_dag(), 200, rid))
+    assert r0.metrics["region_cache"] == "miss"
+    assert r0.data == cold.handle_request(_raft_req(_scan_dag(), 200, rid)).data
+
+    rows = [(i, encode_row(NON_HANDLE, [b"banana", i, i])) for i in (3, 7, 11, 200)]
+    hi = _commit_rows_via_scheduler(kv, rid, rows, ts0=300)
+    r1 = warm.handle_request(_raft_req(_scan_dag(), hi + 10, rid))
+    assert r1.metrics["region_cache"] == "wt_delta"
+    assert warm.region_cache.stats.deltas == 0, \
+        "a warm read after committed writes must not scan CF_WRITE"
+    assert r1.data == cold.handle_request(_raft_req(_scan_dag(), hi + 10, rid)).data
+    # repeat read: plain hit, still byte-identical
+    r2 = warm.handle_request(_raft_req(_scan_dag(), hi + 10, rid))
+    assert r2.metrics["region_cache"] == "hit"
+    assert r2.data == r1.data
+
+
+def test_raft_failpoint_disables_emission_and_recovers_mid_batch():
+    """The ``apply_emit_write_delta`` failpoint turns emission off: responses
+    stay byte-identical through the scan_delta fallback, including a toggle
+    in the middle of a write sequence, and write-through resumes after."""
+    c, kv, warm, cold, rid = _raft_harness()
+    warm.handle_request(_raft_req(_scan_dag(), 200, rid))
+    try:
+        # batch 1 emitted, EMISSION OFF for batch 2, batch 3 emitted again
+        _commit_rows_via_scheduler(
+            kv, rid, [(1, encode_row(NON_HANDLE, [b"kiwi", 1, 1]))], ts0=300)
+        failpoint.cfg("apply_emit_write_delta", "return")
+        _commit_rows_via_scheduler(
+            kv, rid, [(2, encode_row(NON_HANDLE, [b"lime", 2, 2]))], ts0=2000)
+        failpoint.remove("apply_emit_write_delta")
+        hi = _commit_rows_via_scheduler(
+            kv, rid, [(3, encode_row(NON_HANDLE, [b"plum", 3, 3]))], ts0=4000)
+    finally:
+        failpoint.remove("apply_emit_write_delta")
+    r = warm.handle_request(_raft_req(_scan_dag(), hi + 10, rid))
+    # the lost batch forces the scan_delta repair — and bytes match exactly
+    assert r.metrics["region_cache"] == "delta"
+    assert warm.region_cache.stats.wt_lost >= 1
+    assert r.data == cold.handle_request(_raft_req(_scan_dag(), hi + 10, rid)).data
+    # after the repair, write-through takes over again
+    hi2 = _commit_rows_via_scheduler(
+        kv, rid, [(4, encode_row(NON_HANDLE, [b"pear", 4, 4]))], ts0=6000)
+    r2 = warm.handle_request(_raft_req(_scan_dag(), hi2 + 10, rid))
+    assert r2.metrics["region_cache"] == "wt_delta"
+    assert r2.data == cold.handle_request(_raft_req(_scan_dag(), hi2 + 10, rid)).data
+
+
+def test_raft_replica_replays_are_deduped():
+    """Three replicas apply every batch — three notifies per index.  The
+    watermark dedupes the replays and the warm path still serves exactly."""
+    from tikv_tpu.raft.cluster import FIRST_REGION_ID, Cluster
+
+    c = Cluster(3)
+    c.run()
+    kv = c.raftkv(1)
+    wb = WriteBatch()
+    for i in range(16):
+        k = Key.from_raw(record_key(TABLE_ID, i))
+        w = Write(WriteType.PUT, 90,
+                  short_value=encode_row(NON_HANDLE, [b"apple", i, 100 + i]))
+        wb.put_cf(CF_WRITE, k.append_ts(100).encoded, w.to_bytes())
+    kv.write({"region_id": FIRST_REGION_ID}, wb)
+    warm = Endpoint(kv, enable_device=True)
+    cold = Endpoint(kv, enable_device=False)
+    warm.handle_request(_raft_req(_scan_dag(), 200, FIRST_REGION_ID))
+    hi = _commit_rows_via_scheduler(
+        kv, FIRST_REGION_ID,
+        [(5, encode_row(NON_HANDLE, [b"mango", 5, 5]))], ts0=300)
+    r = warm.handle_request(_raft_req(_scan_dag(), hi + 10, FIRST_REGION_ID))
+    assert r.metrics["region_cache"] == "wt_delta"
+    assert warm.region_cache.stats.deltas == 0
+    assert r.data == cold.handle_request(_raft_req(_scan_dag(), hi + 10, FIRST_REGION_ID)).data

@@ -62,6 +62,7 @@ class Endpoint:
         sched_config=None,
         block_rows: int | None = None,
         shard_cache: bool = True,
+        write_through: bool = True,
     ):
         from .tracker import SlowLog
 
@@ -86,9 +87,17 @@ class Endpoint:
         elif enable_region_cache:
             from .region_cache import RegionColumnCache
 
+            # write_through=False is the kill switch for the raft-apply
+            # delta intake (docs/write_path.md): warm reads under writes
+            # then always repair through scan_delta
             self.region_cache = RegionColumnCache(
                 block_rows=block_rows,
                 mesh=mesh if shard_cache else None,
+                write_through=write_through,
+                # bind the cache to THIS engine's write-through stream now —
+                # a raft engine exposes its store engine's identity; a plain
+                # local engine binds None (direct notify callers, tests)
+                data_token=getattr(engine, "data_token", None),
             )
         else:
             self.region_cache = None
@@ -479,7 +488,10 @@ class Endpoint:
         the caller must supply a data version (apply index / resolved ts) in
         context["cache_version"]; without one, every request is cold (the
         reference's cop-cache likewise keys on region apply version,
-        cache.rs:10)."""
+        cache.rs:10).  Deliberately NOT defaulted from the snapshot's
+        apply_index: every ad-hoc start_ts would mint a fresh entry and
+        churn warm ones out of the shared LRU — the region column cache is
+        the apply_index-keyed layer (docs/write_path.md)."""
         version = (req.context or {}).get("cache_version")
         if version is None:
             return None

@@ -31,15 +31,29 @@ split / merge / conf change; the epoch in the key catches anything missed.
 Memory: LRU over images + a byte budget bound host AND device residency (a
 device pin costs about one host copy per pinned plan signature).
 
+Write-through deltas: the raft apply path
+(``raft/store.py`` ``_apply_run`` / ``_exec_data_cmd``) calls
+:func:`notify_region_write` with every committed data batch's ops and the
+entry's apply index.  The parsed delta (changed handles/values/commit_ts,
+deleted handles, lock touches) is buffered on the image as a PENDING delta;
+the next warm read folds it in under the manager lock and serves WITHOUT
+any CF_WRITE scan — ``scan_delta`` stays as the fallback whenever emission
+is off (``apply_emit_write_delta`` failpoint, config), an op is not
+vectorizable, or the pending chain has a gap (detected via the per-region
+notify watermark; see docs/write_path.md for the contract).
+
 Concurrency: cache resolution (lookup / build / delta apply) serializes
 under the manager lock, but the evaluator reads the image's blocks after
 ``serve`` returns — a delta applying concurrently with another request's
-read of the SAME image could tear that read.  Deltas only arrive with a
-newer ``apply_index``, so this needs a reader still in flight when the next
-raft apply's read lands; endpoints that serve a region from multiple
-threads should serialize per region (the raft apply path itself already
-is).  The wire paths currently pass no ``apply_index``, making the cache
-opt-in per deployment.
+read of the SAME image could tear that read.  Deltas mutate blocks only on
+the serve path (write-through emission merely buffers pending rows), so
+this needs a reader still in flight when a LATER read's fold-in lands;
+endpoints that serve a region from multiple threads should serialize per
+region.  ``apply_index`` is propagated end-to-end: ``RegionSnapshot``
+carries the peer's applied index, and the endpoint reads region identity,
+epoch and apply index straight off the snapshot — raft-backed deployments
+need no context plumbing (explicit context still wins for tests and
+embedded engines).
 """
 
 from __future__ import annotations
@@ -49,10 +63,10 @@ import weakref
 
 import numpy as np
 
-from ..storage.engine import CF_LOCK
+from ..storage.engine import CF_DEFAULT, CF_LOCK, CF_WRITE
 from ..storage.mvcc import Statistics
 from ..storage.mvcc.reader import _check_lock
-from ..storage.txn_types import Key
+from ..storage.txn_types import Key, Write, WriteType, append_ts, split_ts
 from .cache import ColumnBlockCache
 from .datatypes import Column, EvalType
 from .mvcc_batch import MvccBatchScanSource, scan_delta
@@ -61,6 +75,7 @@ from .table import RowBatchDecoder, decode_record_handles
 DEFAULT_BYTE_BUDGET = 256 << 20
 DEFAULT_MAX_REGIONS = 64
 _REBUILD_FRACTION = 0.25  # delta bigger than this fraction of rows ⇒ rebuild
+_TOKEN_UNSET = object()  # cache not yet bound to an engine's data_token
 
 _CACHES: "weakref.WeakSet[RegionColumnCache]" = weakref.WeakSet()
 
@@ -70,6 +85,92 @@ def notify_region_epoch_change(region_id: int, reason: str = "epoch") -> None:
     conf change) — every live cache drops its images of that region."""
     for c in list(_CACHES):
         c.invalidate_region(region_id, reason=reason)
+
+
+def notify_region_write(region_id: int, ops, apply_index: int,
+                        get_default=None, token=None) -> None:
+    """Write-through hook: a committed data batch applied to ``region_id``
+    at ``apply_index``.  ``ops`` are the batch's ``(op, cf, key, val)``
+    tuples in MVCC key space (pre data-prefix); ``get_default`` resolves a
+    ``CF_DEFAULT`` key for PUT records whose value is not inline;
+    ``token`` identifies the emitting engine (region ids are not
+    process-unique — each cache only accepts deltas from the engine it
+    serves).  Interested caches buffer the parsed delta on their images of
+    the region; warm reads fold it in without re-scanning CF_WRITE.  The
+    parse (which may read CF_DEFAULT) runs at most ONCE per notify and
+    outside every cache lock."""
+    memo: list = []
+
+    def parse_once():
+        if not memo:
+            memo.append(_parse_write_ops(ops, get_default))
+        return memo[0]
+
+    for c in list(_CACHES):
+        c.apply_write(region_id, parse_once, apply_index, token=token)
+
+
+def notify_region_write_lost(region_id: int, apply_index: int,
+                             token=None) -> None:
+    """Write-through hook for a data change of UNKNOWN content (emission
+    disabled, snapshot apply, merge catch-up): pending deltas are dropped
+    and the notify watermark advances, so reads fall back to ``scan_delta``
+    until a read's snapshot catches up past ``apply_index``."""
+    for c in list(_CACHES):
+        c.note_write_lost(region_id, apply_index, token=token)
+
+
+def _parse_write_ops(ops, get_default):
+    """Parse a committed batch's ops into ``(writes, lock_keys)`` —
+    ``writes`` = [(raw_key, commit_ts, value | None-for-delete)] in batch
+    order, ``lock_keys`` = raw keys whose CF_LOCK state changed.  Returns
+    None when any CF_WRITE op is not expressible as an incremental row
+    change (delete/delete_range on CF_WRITE, exotic records, a missing
+    CF_DEFAULT value) — the caller then degrades to the scan_delta path."""
+    writes: list[tuple[bytes, int, bytes | None]] = []
+    lock_keys: list[bytes] = []
+    for op, cf, key, val in ops:
+        if cf == CF_LOCK:
+            try:
+                lock_keys.append(Key.from_encoded(key).to_raw())
+            except Exception:  # noqa: BLE001 — undecodable lock key
+                return None
+            continue
+        if cf != CF_WRITE:
+            continue  # CF_DEFAULT rides along with its CF_WRITE record
+        if op != "put":
+            return None  # GC / collapse deletes: not an incremental change
+        try:
+            enc_user, cts = split_ts(key)
+            w = Write.from_bytes(val)
+            raw = Key.from_encoded(enc_user).to_raw()
+        except Exception:  # noqa: BLE001 — malformed record
+            return None
+        if w.write_type == WriteType.PUT:
+            if w.gc_fence is not None:
+                return None
+            v = w.short_value
+            if v is None:
+                try:
+                    v = get_default(append_ts(enc_user, w.start_ts)) if get_default else None
+                except Exception:  # noqa: BLE001 — a faulting engine read
+                    v = None  # must degrade, not propagate into apply
+                if v is None:
+                    return None
+            writes.append((raw, int(cts), v))
+        elif w.write_type == WriteType.DELETE:
+            writes.append((raw, int(cts), None))
+        # LOCK / ROLLBACK records change no visible row data: skip.  Their
+        # fingerprint drift is repaired by the scan_delta fallback if a
+        # reader ever diffs this range again.
+    return writes, lock_keys
+
+
+def _in_ranges(raw: bytes, ranges) -> bool:
+    for start, end in ranges:
+        if start <= raw < end:
+            return True
+    return False
 
 
 def _epoch_of(ctx_epoch) -> tuple[int, int] | None:
@@ -117,6 +218,17 @@ class RegionImage:
         self.nbytes = 0
         # bytes->code maps for dict-encoded columns, built on first delta
         self._dict_maps: dict[int, dict] = {}
+        # write-through pending delta (apply_write buffers; serve folds in):
+        # {"base", "apply_index", "changed": {handle: (value, cts)},
+        #  "deleted": set[handle], "max_ct"} or None
+        self.wt_pending: dict | None = None
+        # a write-through batch touched CF_LOCK in range: the next warm
+        # serve must re-scan locks even at an unchanged start_ts.  Cleared
+        # only when a lock-free scan ran on a snapshot at/after the batch
+        # that dirtied it (locks_dirty_at) — an older snapshot proves
+        # nothing about that batch's lock.
+        self.locks_dirty = False
+        self.locks_dirty_at = 0
 
     @property
     def n_rows(self) -> int:
@@ -148,6 +260,7 @@ class RegionImage:
         self.apply_index = apply_index
         self.snapshot_ts = start_ts
         self.max_commit_ts = max_commit_ts
+        self.wt_pending = None  # a rebuild reflects the engine directly
         self._recount()
 
     # -- delta -------------------------------------------------------------
@@ -343,18 +456,22 @@ class RegionImage:
 
 class RegionCacheStats:
     __slots__ = ("hits", "misses", "deltas", "delta_rows", "stale", "uncacheable",
-                 "evictions", "invalidations", "bytes_pinned")
+                 "evictions", "invalidations", "bytes_pinned",
+                 "wt_deltas", "wt_rows", "wt_lost")
 
     def __init__(self):
         self.hits = 0
         self.misses = 0
-        self.deltas = 0
+        self.deltas = 0      # scan_delta-path serves (CF_WRITE re-scans)
         self.delta_rows = 0
         self.stale = 0
         self.uncacheable = 0
         self.evictions = 0
         self.invalidations = 0
         self.bytes_pinned = 0
+        self.wt_deltas = 0   # write-through folds (zero CF_WRITE scans)
+        self.wt_rows = 0
+        self.wt_lost = 0     # emission gaps forcing a scan_delta repair
 
     def to_dict(self) -> dict:
         return {k: getattr(self, k) for k in self.__slots__}
@@ -382,6 +499,8 @@ class RegionColumnCache:
         block_rows: int | None = None,
         mesh=None,
         per_device_budget: int | None = None,
+        write_through: bool = True,
+        data_token: object = _TOKEN_UNSET,
     ):
         from .jax_eval import DEFAULT_BLOCK_ROWS
 
@@ -391,6 +510,26 @@ class RegionColumnCache:
         self._images: dict = {}  # key -> RegionImage, insertion = LRU order
         self._mu = threading.RLock()
         self.stats = RegionCacheStats()
+        # write-through delta intake (docs/write_path.md): per-region
+        # watermark of the highest apply index whose data change this cache
+        # has SEEN (as a parsed delta or a lost marker).  Pending deltas may
+        # only start on an image whose apply_index has caught up to the
+        # watermark — anything else means a missed batch, and missed batches
+        # must repair through scan_delta, never through a gapped pending.
+        self.write_through = write_through
+        self._wt_seen: dict[int, int] = {}
+        # engine identity this cache serves: notifies from any OTHER engine
+        # are dropped — region ids alone don't identify data in a process
+        # that hosts several stores or embedded endpoints.  Bound at
+        # construction when the owner knows its engine (Endpoint passes the
+        # engine's data_token; None for plain local engines); otherwise
+        # learned from the first served snapshot — late binding silently
+        # drops any notify racing the early serves (the watermark cannot
+        # see them), so a late-bound cache additionally refuses to START a
+        # pending chain for a region until one notify has been observed
+        # and a read has repaired past it (_merge_pending's prev>=0 gate).
+        self._wt_token = data_token
+        self._wt_late_bound = False
         self.devices: list = []
         if mesh is not None and getattr(mesh, "size", 1) > 1:
             try:
@@ -427,6 +566,13 @@ class RegionColumnCache:
         key = (region_id, tuple(ranges), schema_sig(columns_info))
         stats = statistics or Statistics()
         with self._mu:
+            if self._wt_token is _TOKEN_UNSET:
+                # bind to the engine behind the first served snapshot —
+                # from here on, only ITS write-through notifies are accepted.
+                # Notifies BEFORE this bind were dropped unseen, so pending
+                # creation stays gated until the stream re-anchors.
+                self._wt_token = getattr(snap, "data_token", None)
+                self._wt_late_bound = True
             img = self._images.get(key)
             if img is not None and img.epoch != epoch:
                 self._drop(key, reason="epoch")
@@ -456,12 +602,78 @@ class RegionColumnCache:
                 start_ts == img.snapshot_ts or img.max_commit_ts <= img.snapshot_ts
             )
             if fresh:
-                if start_ts > img.snapshot_ts:
-                    self._check_locks(snap, ranges, start_ts, stats)
-                    img.snapshot_ts = start_ts
+                if start_ts > img.snapshot_ts or img.locks_dirty:
+                    seen = self._check_locks(snap, ranges, start_ts, stats)
+                    if seen == 0 and apply_index >= img.locks_dirty_at:
+                        # this snapshot contains the dirtying batch and the
+                        # range is lock-free — safe to stop re-scanning.  An
+                        # OLDER snapshot seeing no locks proves nothing.
+                        img.locks_dirty = False
+                    img.snapshot_ts = max(img.snapshot_ts, start_ts)
                 self.stats.hits += 1
                 self._count("hit")
                 return img.block_cache, "hit", 0
+            pend = img.wt_pending
+            if (pend is not None
+                    and img.apply_index > apply_index):
+                # reader's snapshot predates the image: the scan_delta below
+                # would rewind the image under the pending chain's base —
+                # keep the pending for current readers, serve this one cold
+                self.stats.stale += 1
+                self._count("stale")
+                return None, "stale", 0
+            if (pend is not None
+                    and apply_index >= pend["apply_index"]
+                    and img.apply_index >= pend["base"]
+                    and img.max_commit_ts <= img.snapshot_ts
+                    and start_ts >= pend["max_ct"]):
+                # write-through fast path: every data batch between the
+                # image's state and the reader's snapshot is buffered here —
+                # fold it in and serve with ZERO CF_WRITE scans.  Locks are
+                # the one thing a buffered batch cannot prove absent, so a
+                # dirty lock state re-scans CF_LOCK (tiny) first.
+                if img.locks_dirty or start_ts > img.snapshot_ts:
+                    seen = self._check_locks(snap, ranges, start_ts, stats)
+                    if seen == 0 and apply_index >= img.locks_dirty_at:
+                        img.locks_dirty = False
+                n_touch = len(pend["changed"]) + len(pend["deleted"])
+                if n_touch == 0:
+                    # the batches touched nothing in this image's ranges
+                    # (another table/index in the region, lock-only traffic):
+                    # advance the version bookkeeping and serve a plain HIT —
+                    # no fold, no device re-placement churn
+                    img.apply_index = apply_index
+                    img.snapshot_ts = max(img.snapshot_ts, start_ts)
+                    img.max_commit_ts = max(img.max_commit_ts, pend["max_ct"])
+                    img.wt_pending = None
+                    self.stats.hits += 1
+                    self._count("hit")
+                    return img.block_cache, "hit", 0
+                if img.n_rows and n_touch > _REBUILD_FRACTION * img.n_rows:
+                    self._drop(key, reason="delta_too_big")
+                    return self._build(key, epoch, snap, columns_info, ranges,
+                                       start_ts, apply_index, stats)
+                handles = np.array(sorted(pend["changed"]), dtype=np.int64)
+                delta = {
+                    "changed_handles": handles,
+                    "changed_values": [pend["changed"][int(h)][0] for h in handles],
+                    "changed_commit_ts": np.array(
+                        [pend["changed"][int(h)][1] for h in handles], dtype=np.int64),
+                    "deleted_handles": np.array(sorted(pend["deleted"]), dtype=np.int64),
+                    "max_commit_ts": max(img.max_commit_ts, pend["max_ct"]),
+                }
+                n = img.apply_delta(delta, apply_index, start_ts)
+                img.wt_pending = None
+                if self.devices:
+                    self._unplace(img)
+                    self._place(img)
+                self.stats.wt_deltas += 1
+                self.stats.wt_rows += n
+                self._count("wt_delta")
+                self._count_delta_rows(n)
+                self._enforce_budget(keep=key)
+                self._gauge_bytes()
+                return img.block_cache, "wt_delta", n
             delta = scan_delta(snap, start_ts, ranges, img.handles,
                                img.row_commit_ts, statistics=stats)
             if delta is None:
@@ -475,6 +687,16 @@ class RegionColumnCache:
                 return self._build(key, epoch, snap, columns_info, ranges,
                                    start_ts, apply_index, stats)
             n = img.apply_delta(delta, apply_index, start_ts)
+            if apply_index >= img.locks_dirty_at:
+                # scan_delta lock-checked the ranges on a snapshot that
+                # contains the dirtying batch
+                img.locks_dirty = False
+            pend = img.wt_pending
+            if pend is not None and (pend["apply_index"] <= img.apply_index
+                                     or img.apply_index < pend["base"]):
+                # the scan repaired past the pending chain (or rewound under
+                # its base): replaying it would regress rows — drop it
+                img.wt_pending = None
             if self.devices:
                 # a structural repack can change the block count and bytes:
                 # refresh the placement so owner_devices stays block-aligned
@@ -492,7 +714,142 @@ class RegionColumnCache:
         with self._mu:
             for key in [k for k in self._images if k[0] == region_id]:
                 self._drop(key, reason=reason)
+            # the notify watermark dies with the images (dead region ids —
+            # merge sources, destroyed peers — must not leak an entry each);
+            # a live region's next notify re-seeds it before any new image
+            # can finish building
+            self._wt_seen.pop(region_id, None)
             self._rebalance()
+
+    # -- write-through intake (raft apply -> pending deltas) -----------------
+
+    def apply_write(self, region_id: int, parse_once, apply_index: int,
+                    token=None) -> None:
+        """Buffer a committed batch's row changes on every resident image of
+        ``region_id``.  Raft applies a region's entries in order on one
+        worker, so notifies arrive in apply-index order per region; an index
+        at or below the watermark is a replica's replay of a batch already
+        merged (identical ops by raft) and is skipped.  ``parse_once`` is
+        the notify's memoized op parser — invoked OUTSIDE the manager lock
+        (it may read CF_DEFAULT), at most once across every live cache."""
+        with self._mu:
+            if self._wt_token is _TOKEN_UNSET or token != self._wt_token:
+                return  # not this cache's engine (or cache never served yet)
+            prev = self._wt_seen.get(region_id, -1)
+            if apply_index <= prev:
+                return
+            # the watermark advances even with write_through off: flipping
+            # it back on must not let a pending start across unseen batches
+            self._wt_seen[region_id] = apply_index
+            if not self.write_through:
+                # an unbuffered batch gaps any surviving chain — drop it,
+                # or re-enabling would merge later batches into the gap
+                self._drop_pendings_locked(region_id)
+                return
+            if not any(k[0] == region_id for k in self._images):
+                return
+        parsed = parse_once()
+        with self._mu:
+            # images may have churned while parsing: re-list.  A freshly
+            # built image already containing this batch just replays it
+            # idempotently; the ``prev`` creation check below still blocks
+            # any image whose snapshot predates an unbuffered notify.
+            imgs = [img for k, img in self._images.items() if k[0] == region_id]
+            if not imgs:
+                return
+            if parsed is None:
+                # not expressible as row changes: pendings are now gapped
+                for img in imgs:
+                    img.wt_pending = None
+                self.stats.wt_lost += 1
+                self._count_wt_lost()
+                return
+            writes, lock_keys = parsed
+            for img in imgs:
+                self._merge_pending(img, writes, lock_keys, prev, apply_index)
+
+    def note_write_lost(self, region_id: int, apply_index: int,
+                        token=None) -> None:
+        """A data change of unknown content landed (emission off, raft
+        snapshot apply, merge catch-up, OR a notify that faulted after the
+        watermark already advanced): drop pendings unconditionally — a
+        dropped chain only costs a scan_delta repair, while a chain kept
+        across an unbuffered batch serves wrong rows forever — and advance
+        the watermark so no pending restarts until a read catches the image
+        up past ``apply_index``."""
+        with self._mu:
+            if self._wt_token is _TOKEN_UNSET or token != self._wt_token:
+                return
+            if apply_index > self._wt_seen.get(region_id, -1):
+                self._wt_seen[region_id] = apply_index
+            self._drop_pendings_locked(region_id)
+
+    def _drop_pendings_locked(self, region_id: int) -> None:
+        dropped = False
+        for k, img in self._images.items():
+            if k[0] == region_id and img.wt_pending is not None:
+                img.wt_pending = None
+                dropped = True
+        if dropped:
+            self.stats.wt_lost += 1
+            self._count_wt_lost()
+
+    def _merge_pending(self, img, writes, lock_keys, prev: int,
+                       apply_index: int) -> None:
+        ranges = img.key[1]
+        if any(_in_ranges(rk, ranges) for rk in lock_keys):
+            img.locks_dirty = True
+            img.locks_dirty_at = max(img.locks_dirty_at, apply_index)
+        pend = img.wt_pending
+        if pend is None:
+            if prev > img.apply_index or apply_index <= img.apply_index:
+                # a batch between the image's state and this one was never
+                # buffered (image built mid-stream, or emission was off):
+                # this image repairs through scan_delta, not a gapped chain
+                return
+            if self._wt_late_bound and prev < 0:
+                # first observed notify for this region on a LATE-bound
+                # cache: earlier notifies may have been dropped unseen
+                # while unbound, so this chain cannot anchor — the next
+                # read repairs via scan_delta, re-anchoring the stream
+                return
+            pend = img.wt_pending = {
+                "base": img.apply_index, "apply_index": apply_index,
+                "changed": {}, "deleted": set(), "max_ct": 0,
+            }
+        else:
+            pend["apply_index"] = apply_index
+        for raw, cts, v in writes:
+            if not _in_ranges(raw, ranges):
+                continue
+            if len(raw) != 19:
+                # non-record key inside a record range: not foldable
+                self._drop_pending_img(img)
+                return
+            try:
+                h = int(decode_record_handles([raw])[0])
+            except Exception:  # noqa: BLE001
+                self._drop_pending_img(img)
+                return
+            if v is None:
+                pend["changed"].pop(h, None)
+                pend["deleted"].add(h)
+            else:
+                pend["deleted"].discard(h)
+                pend["changed"][h] = (v, cts)
+            pend["max_ct"] = max(pend["max_ct"], cts)
+        if len(pend["changed"]) + len(pend["deleted"]) > max(1024, img.n_rows):
+            # pending outgrew the image: a rebuild will beat replaying it
+            self._drop_pending_img(img)
+
+    def _drop_pending_img(self, img) -> None:
+        """Drop ONE image's pending chain, keeping the wt_lost accounting in
+        step with every other drop path (the Grafana emission-gap series
+        must see these, or a rising scan_delta rate is undiagnosable)."""
+        if img.wt_pending is not None:
+            img.wt_pending = None
+            self.stats.wt_lost += 1
+            self._count_wt_lost()
 
     def total_bytes(self) -> int:
         with self._mu:
@@ -624,13 +981,18 @@ class RegionColumnCache:
             self._gauge_bytes()
         return img.block_cache, "miss", 0
 
-    def _check_locks(self, snap, ranges, ts, stats) -> None:
+    def _check_locks(self, snap, ranges, ts, stats) -> int:
+        """Raise on a blocking lock; return how many locks the ranges hold
+        (0 lets callers clear a dirty-lock flag)."""
+        seen = 0
         for start, end in ranges:
             enc_start = Key.from_raw(start).encoded
             enc_end = Key.from_raw(end).encoded
             for k, v in snap.scan_cf(CF_LOCK, enc_start, enc_end):
                 stats.lock.next += 1
+                seen += 1
                 _check_lock(v, Key.from_encoded(k).to_raw(), ts, frozenset())
+        return seen
 
     def _drop(self, key, reason: str) -> None:
         img = self._images.pop(key, None)
@@ -678,6 +1040,14 @@ class RegionColumnCache:
             "tikv_coprocessor_region_cache_total",
             "Region column cache lookups, by outcome",
         ).inc(outcome=outcome)
+
+    def _count_wt_lost(self) -> None:
+        from ..util.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "tikv_coprocessor_region_cache_wt_lost_total",
+            "Write-through emission gaps (pendings dropped; scan_delta repairs)",
+        ).inc()
 
     def _count_delta_rows(self, n: int) -> None:
         if not n:

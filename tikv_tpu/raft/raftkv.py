@@ -60,7 +60,7 @@ class _PrefixCursor(Cursor):
 
 class RegionSnapshot(Snapshot):
     def __init__(self, engine_snapshot: Snapshot, region: Region,
-                 apply_index: int | None = None):
+                 apply_index: int | None = None, data_token=None):
         self._snap = engine_snapshot
         self.region = region
         # data version this snapshot reflects (the peer's apply_index at
@@ -68,6 +68,11 @@ class RegionSnapshot(Snapshot):
         # (region epoch, apply_index) and reads both straight off the
         # snapshot, so serving paths need no extra context plumbing
         self.apply_index = apply_index
+        # identity of the underlying store engine: the region cache binds to
+        # the first token it serves and drops write-through notifies from
+        # any OTHER engine — region ids alone are not process-unique
+        # (embedded endpoints, multi-store test processes)
+        self.data_token = data_token
         self._lower = keys.data_key(region.start_key)
         self._upper = keys.data_end_key(region.end_key)
 
@@ -104,6 +109,14 @@ class RaftKv(Engine):
         # path gated by RegionReadProgress/resolved-ts)
         self.resolved_ts = resolved_ts
         self.propose_timeout = propose_timeout
+
+    @property
+    def data_token(self):
+        """Identity of the data this engine serves — delegates to the ONE
+        definition on the store (docs/write_path.md): RegionSnapshots stamp
+        it, apply-side write-through notifies carry it, and the region
+        column cache binds to it at construction."""
+        return self.store.data_token
 
     def _peer_for_ctx(self, ctx: dict | None):
         ctx = ctx or {}
@@ -149,8 +162,15 @@ class RaftKv(Engine):
             # serve a snapshot missing committed data
             if read_ts > resolved or peer.apply_index < required_idx:
                 raise RaftKv.DataNotReadyError(peer.region.id, read_ts, resolved)
+            # apply_index SAMPLED BEFORE the engine freeze: the snapshot may
+            # contain later applies, but must never claim an index whose data
+            # it lacks — the region cache stamps images with this index and a
+            # too-high claim would mark missing writes as present
+            # (docs/write_path.md apply_index contract)
+            applied = peer.apply_index
             return RegionSnapshot(self.store.engine.snapshot(), peer.region.clone(),
-                                  apply_index=peer.apply_index)
+                                  apply_index=applied,
+                                  data_token=self.data_token)
         if not peer.node.is_leader():
             if ctx.get("replica_read") and peer.peer_id not in peer.node.witnesses:
                 # replica read (read.rs replica-read + ReplicaReadLockChecker
@@ -165,8 +185,10 @@ class RaftKv(Engine):
         # (apply_index, not node.applied — the pipeline may still be writing),
         # reads skip the ReadIndex round entirely
         if peer.node.lease_valid() and peer.apply_index >= peer.node.commit:
+            applied = peer.apply_index  # before the freeze — see stale path
             return RegionSnapshot(self.store.engine.snapshot(), peer.region.clone(),
-                                  apply_index=peer.apply_index)
+                                  apply_index=applied,
+                                  data_token=self.data_token)
         return self._read_index_barrier(peer)
 
     def _read_index_barrier(self, peer) -> RegionSnapshot:
@@ -185,8 +207,10 @@ class RaftKv(Engine):
         self._pump_until(done, peer.region.id)
         if err:
             raise err[0]
+        applied = peer.apply_index  # before the freeze — see stale path
         return RegionSnapshot(self.store.engine.snapshot(), peer.region.clone(),
-                                  apply_index=peer.apply_index)
+                              apply_index=applied,
+                              data_token=self.data_token)
 
     def write(self, ctx: dict | None, batch: WriteBatch) -> None:
         peer = self._peer_for_ctx(ctx)

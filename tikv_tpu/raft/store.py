@@ -34,6 +34,18 @@ def _notify_region_cache(region_id: int, reason: str) -> None:
     except ImportError:
         return
     notify_region_epoch_change(region_id, reason=reason)
+
+
+def _notify_region_write_lost(region_id: int, apply_index: int,
+                              token=None) -> None:
+    """Region data changed by means the write-through path cannot express
+    (raft snapshot apply, emission disabled): caches drop pending deltas and
+    repair through scan_delta (docs/write_path.md)."""
+    try:
+        from ..copr.region_cache import notify_region_write_lost
+    except ImportError:
+        return
+    notify_region_write_lost(region_id, apply_index, token=token)
 from .core import Entry, Message, MsgType, RaftNode, Role
 from .core import Snapshot as RaftSnapshot
 from .region import EpochError, KeyNotInRegionError, NotLeaderError, Peer as RegionPeer, Region, RegionEpoch
@@ -687,6 +699,13 @@ class StorePeer:
             new_apply = max(self.apply_index, applied)
             wb.put_cf(CF_RAFT, keys.apply_state_key(self.region.id), codec.encode_u64(new_apply))
             eng.write(wb)
+            if executed:
+                # write-through delta BEFORE apply_index becomes visible: a
+                # snapshot carrying new_apply can then only be taken after
+                # the region cache buffered this batch (no gap window)
+                self._emit_write_delta(
+                    [op for _e, c in executed for op in c["ops"]], new_apply
+                )
             self.apply_index = new_apply
         elif wb.ops:
             eng.write(wb)
@@ -778,7 +797,7 @@ class StorePeer:
             # was down replays this entry (or receives it in a snapshot) and
             # converges without any side-channel file transfer
             if self.peer_id not in self.node.witnesses:
-                self._apply_ingest_sst(admin[1])
+                self._apply_ingest_sst(admin[1], apply_index=e.index)
             self._ack(e, {"ingest_sst": True, "applied_index": e.index}, None)
             return cmd
         fail_point("apply_before_exec")
@@ -787,11 +806,44 @@ class StorePeer:
             # data (raftstore witness feature); acking keeps apply advancing
             self._ack(e, {"applied_index": e.index}, None)
             return cmd
-        self._exec_data_cmd(cmd, self.region)
+        self._exec_data_cmd(cmd, self.region, apply_index=e.index)
         self._ack(e, {"applied_index": e.index}, None)
         return cmd
 
-    def _apply_ingest_sst(self, blob: bytes) -> None:
+    def _emit_write_delta(self, ops, apply_index: int) -> None:
+        """Write-through delta emission (ISSUE 4): after a committed data
+        batch is IN the engine — and before ``apply_index`` becomes visible
+        to new snapshots — hand the batch's ops to the coprocessor region
+        column cache, so warm reads under write load fold the change in
+        without re-scanning CF_WRITE.  The ``apply_emit_write_delta``
+        failpoint (and any emission failure) degrades to a lost-marker: the
+        cache then repairs through its scan_delta fallback, never through a
+        gapped delta chain."""
+        try:
+            from ..copr.region_cache import (
+                notify_region_write,
+                notify_region_write_lost,
+            )
+        except ImportError:
+            return
+        token = self.store.data_token  # matches RegionSnapshot.data_token
+        try:
+            fail_point("apply_emit_write_delta")
+        except Exception:  # noqa: BLE001 — emission off: content unknown
+            notify_region_write_lost(self.region.id, apply_index, token=token)
+            return
+        try:
+            notify_region_write(self.region.id, ops, apply_index,
+                                get_default=self._get_default_value,
+                                token=token)
+        except Exception:  # noqa: BLE001 — a cache-side fault must never
+            # break apply: degrade to the lost-marker (scan_delta repairs)
+            notify_region_write_lost(self.region.id, apply_index, token=token)
+
+    def _get_default_value(self, enc_key_with_ts: bytes) -> bytes | None:
+        return self.store.engine.get_cf(CF_DEFAULT, keys.data_key(enc_key_with_ts))
+
+    def _apply_ingest_sst(self, blob: bytes, apply_index: int | None = None) -> None:
         """Write the ingest payload — encoded (cf, key, value) entries, keys
         already in their final (rewritten) form — under the region prefix.
         Keys outside the region range are dropped identically on every
@@ -805,14 +857,21 @@ class StorePeer:
             wb.put_cf(cf, keys.data_key(key), val)
             ops.append(("put", cf, key, val))
         self.store.engine.write(wb)
+        if ops and apply_index is not None:
+            self._emit_write_delta(ops, apply_index)
         # apply observers (CDC, resolved-ts) must see ingested writes like
         # any other applied command — a change feed that silently misses an
         # imported batch is data loss downstream
         self.store.on_applied(self.region, {"ops": ops, "ingest_sst": True})
 
-    def _exec_data_cmd(self, cmd: dict, region: Region) -> None:
+    def _exec_data_cmd(self, cmd: dict, region: Region,
+                       apply_index: int | None = None) -> None:
         """Execute a data command's write ops against the engine (shared by
-        the normal apply path and commit-merge catch-up)."""
+        the normal apply path and commit-merge catch-up).  With an
+        ``apply_index``, the committed batch also flows into the region
+        column cache as a write-through delta — emission runs BEFORE the
+        caller advances the peer's visible apply_index, so a snapshot that
+        reports this index can only exist after its delta was buffered."""
         wb = WriteBatch()
         for op, cf, key, val in cmd["ops"]:
             dkey = keys.data_key(key)
@@ -823,6 +882,8 @@ class StorePeer:
             elif op == "delete_range":
                 wb.delete_range_cf(cf, dkey, keys.data_key(val))
         self.store.engine.write(wb)
+        if cmd["ops"] and apply_index is not None:
+            self._emit_write_delta(cmd["ops"], apply_index)
         self.store.on_applied(region, cmd)
 
     def _ack(self, e: Entry, result, err) -> None:
@@ -1274,6 +1335,10 @@ class StorePeer:
             wb2.put_cf(CF_RAFT, keys.raft_state_key(self.region.id), self._encode_raft_state())
         wb2.put_cf(CF_RAFT, keys.apply_state_key(self.region.id), codec.encode_u64(self.node.applied))
         eng.write(wb2)
+        # a snapshot replaces region data wholesale — no per-batch deltas
+        # exist for it, so pending write-through chains must not survive
+        _notify_region_write_lost(self.region.id, self.node.applied,
+                                  token=self.store.data_token)
         self.apply_index = max(self.apply_index, self.node.applied)
 
 
@@ -1436,6 +1501,14 @@ class Store:
         # inconsistent_regions for the debug service / operator
         self.consistency_hashes: dict[int, tuple[int, int]] = {}
         self.inconsistent_regions: dict[int, dict] = {}
+
+    @property
+    def data_token(self):
+        """THE identity of this store's data (docs/write_path.md): stamped
+        on RegionSnapshots, carried by write-through notifies, bound by the
+        region column cache.  One definition — a mismatch anywhere silently
+        drops every delta as foreign."""
+        return id(self.engine)
 
     def enable_apply_pipeline(self, workers: int = 2) -> None:
         """Apply committed data entries off the raft thread (apply.rs

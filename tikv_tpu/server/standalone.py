@@ -94,6 +94,8 @@ class StoreServer:
         encryption_master_key: str | None = None,
         sched_continuous: bool = False,
         shard_cache: bool = True,
+        group_commit: bool = True,
+        write_through: bool = True,
     ):
         self.pd = pd
         self.security = security
@@ -145,13 +147,18 @@ class StoreServer:
         )
         self.resolved_ts.attach_store(self.store)
         self.raftkv = RaftKv(self.store, resolved_ts=self.resolved_ts)
-        self.storage = Storage(engine=self.raftkv)
+        # group commit (docs/write_path.md): queued compatible prewrites /
+        # commits coalesce into one raft proposal; --no-group-commit reverts
+        # to one proposal per command
+        self.storage = Storage(engine=self.raftkv,
+                               group_commit_max=16 if group_commit else 1)
         mesh = _default_mesh() if enable_device else None
         self.copr = Endpoint(
             self.raftkv, enable_device=enable_device,
             mesh=mesh,
             feature_gate=self.feature_gate,
             shard_cache=shard_cache,
+            write_through=write_through,
         )
         if mesh is not None and getattr(mesh, "size", 1) > 1:
             rc = self.copr.region_cache
@@ -431,6 +438,12 @@ def main(argv=None) -> int:
     ap.add_argument("--no-shard-cache", action="store_true",
                     help="keep the region column cache single-device even "
                          "with a multi-chip mesh (sharded warm serving off)")
+    ap.add_argument("--no-group-commit", action="store_true",
+                    help="one raft proposal per txn command instead of "
+                         "coalescing queued prewrites/commits (write_path.md)")
+    ap.add_argument("--no-write-through", action="store_true",
+                    help="disable raft-apply delta emission into the region "
+                         "column cache (warm reads repair via scan_delta)")
     ap.add_argument("--no-raft-engine", action="store_true",
                     help="keep the raft log in CF_RAFT instead of the segmented log engine")
     ap.add_argument("--ca-path", default="")
@@ -463,6 +476,8 @@ def main(argv=None) -> int:
         encryption_master_key=args.encryption_master_key,
         sched_continuous=args.sched_continuous,
         shard_cache=not args.no_shard_cache,
+        group_commit=not args.no_group_commit,
+        write_through=not args.no_write_through,
     )
     srv.start()
     srv.bootstrap_or_join(args.expect_stores)

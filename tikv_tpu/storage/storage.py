@@ -46,10 +46,16 @@ def _decode_raw_value(stored: bytes, now: float) -> bytes | None:
 
 
 class Storage:
-    def __init__(self, engine: Engine | None = None, concurrency_manager: ConcurrencyManager | None = None):
+    def __init__(self, engine: Engine | None = None,
+                 concurrency_manager: ConcurrencyManager | None = None,
+                 group_commit_max: int = 16, sched_pool_size: int = 4):
         self.engine = engine or LocalEngine()
         self.cm = concurrency_manager or ConcurrencyManager()
-        self.scheduler = Scheduler(self.engine, self.cm)
+        # group_commit_max=1 disables write coalescing (docs/write_path.md):
+        # every txn command then pays its own engine write / raft proposal
+        self.scheduler = Scheduler(self.engine, self.cm,
+                                   pool_size=sched_pool_size,
+                                   group_commit_max=group_commit_max)
         self._raw_latches = Latches(64)
 
     @staticmethod

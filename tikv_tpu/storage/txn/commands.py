@@ -31,6 +31,14 @@ from ..txn_types import Key, Lock, Mutation, WriteType
 
 
 class Command:
+    # group commit eligibility (scheduler._collect_group_locked): a
+    # groupable command reads/writes ONLY its own latched keys, so any set
+    # of queued (latch-granted, hence key-disjoint) groupable commands
+    # composes into one snapshot + one engine WriteBatch with effects
+    # identical to back-to-back execution.  Range/scan commands
+    # (ResolveLock-without-keys, Flashback) must stay non-groupable.
+    groupable = False
+
     def latch_keys(self) -> list[bytes]:
         raise NotImplementedError
 
@@ -53,6 +61,8 @@ class Prewrite(Command):
     is_pessimistic: bool = False
     pessimistic_flags: list[bool] = field(default_factory=list)
     for_update_ts: int = 0
+
+    groupable = True  # touches only its latched keys (group commit)
 
     def latch_keys(self) -> list[bytes]:
         return [m.key.encoded for m in self.mutations]
@@ -92,6 +102,8 @@ class Commit(Command):
     keys: list[Key]
     start_ts: int
     commit_ts: int
+
+    groupable = True  # touches only its latched keys (group commit)
 
     def latch_keys(self) -> list[bytes]:
         return [k.encoded for k in self.keys]

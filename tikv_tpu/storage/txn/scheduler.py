@@ -14,6 +14,13 @@ chains, too_busy flow control):
   ``pending_write_threshold``, new normal-priority commands fail fast with
   ``SchedTooBusy`` (scheduler.rs too_busy → ServerIsBusy) instead of growing
   the queue without bound; high-priority commands bypass the check
+* GROUP COMMIT (docs/write_path.md): when a worker claims a groupable
+  command (prewrite / commit), it also claims every other queued groupable
+  command with the SAME engine context — queued tasks already hold their
+  (pairwise-disjoint) latches, so the group runs off one snapshot, folds
+  its mutations into ONE engine WriteBatch and pays ONE engine write (one
+  raft propose→apply→ack round trip instead of one per command), then
+  releases every member's latches in one sweep
 * ``run_command`` stays a synchronous facade (submit + wait) so every
   existing caller keeps its ordering guarantees
 """
@@ -27,11 +34,19 @@ from dataclasses import dataclass, field
 from ...util import error_code
 from ...util.failpoint import fail_point
 from ...util.metrics import REGISTRY
+from ..engine import WriteBatch
 from ..kv import Engine
 from .commands import Command
 
 _SCHED_COMMANDS = REGISTRY.counter(
     "tikv_scheduler_commands_total", "Txn commands by type and outcome")
+_SCHED_TOO_BUSY = REGISTRY.counter(
+    "tikv_scheduler_too_busy_total",
+    "Submissions rejected by write flow control (ServerIsBusy)")
+_SCHED_GROUP_SIZE = REGISTRY.histogram(
+    "tikv_scheduler_group_size",
+    "Commands per scheduler engine write (group commit)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128))
 from .latches import Latches
 
 SCHED_TOO_BUSY = error_code.define(
@@ -71,12 +86,16 @@ class Scheduler:
         latch_slots: int = 256,
         pool_size: int = 4,
         pending_write_threshold: int = 256,
+        group_commit_max: int = 16,
     ):
         self.engine = engine
         self.latches = Latches(latch_slots)
         self.cm = concurrency_manager
         self.pool_size = pool_size
         self.pending_write_threshold = pending_write_threshold
+        # group commit: max queued compatible commands coalesced into one
+        # engine write (1 disables — every command pays its own round trip)
+        self.group_commit_max = max(1, group_commit_max)
         self._mu = threading.Lock()
         self._ready = threading.Condition(self._mu)
         self._high: deque[_Task] = deque()
@@ -107,6 +126,7 @@ class Scheduler:
                 raise RuntimeError("scheduler is stopped")
             if not high and self._inflight >= self.pending_write_threshold:
                 self.stats["too_busy"] += 1
+                _SCHED_TOO_BUSY.inc()
                 raise SchedTooBusy(
                     f"{self._inflight} commands pending "
                     f"(threshold {self.pending_write_threshold})"
@@ -184,7 +204,40 @@ class Scheduler:
                 if task.claimed:  # shutdown already failed it
                     continue
                 task.claimed = True
-            self._execute(task)
+                group = self._collect_group_locked(task)
+            if group:
+                self._execute_group([task] + group)
+            else:
+                self._execute(task)
+
+    def _collect_group_locked(self, leader: _Task) -> list[_Task]:
+        """Claim queued commands compatible with ``leader`` for one group
+        commit (caller holds the scheduler lock).  Compatible = a groupable
+        command type (prewrite/commit) against the SAME engine context —
+        the one raft proposal the group folds into must route to one region.
+        Every queued task already owns its latches, and two tasks sharing a
+        latch slot can never be queued together, so group members touch
+        pairwise-disjoint keys and compose into one WriteBatch exactly as
+        they would execute back to back."""
+        if self.group_commit_max <= 1 or not getattr(leader.cmd, "groupable", False):
+            return []
+        picked: list[_Task] = []
+        for q in (self._high, self._normal):
+            if len(picked) + 1 >= self.group_commit_max:
+                break
+            kept: list[_Task] = []
+            while q and len(picked) + 1 < self.group_commit_max:
+                t = q.popleft()
+                if t.claimed:
+                    continue  # shutdown already failed it (worker-loop rule)
+                if getattr(t.cmd, "groupable", False) and t.ctx == leader.ctx:
+                    t.claimed = True
+                    picked.append(t)
+                else:
+                    kept.append(t)
+            for t in reversed(kept):  # unpicked keep their FIFO positions
+                q.appendleft(t)
+        return picked
 
     def _execute(self, task: _Task) -> None:
         try:
@@ -193,19 +246,73 @@ class Scheduler:
             txn, result = task.cmd.process_write(snapshot)
             fail_point("scheduler_before_write")
             if not txn.is_empty():
+                # observed per actual engine write: the histogram's count IS
+                # the raft-proposal rate, its mean the amortization factor
+                _SCHED_GROUP_SIZE.observe(1)
                 self.engine.write(task.ctx, txn.wb)
             task.result = result
         except BaseException as exc:  # surfaced to the submitting thread
             task.exc = exc
         finally:
-            woken = self.latches.release(task.cid, task.slots)
-            with self._mu:
-                self._inflight -= 1
-                self._tasks.discard(task)
-                self.stats["woken"] += len(woken)
-            for t in woken:
-                self._enqueue(t)
-            task.done.set()
+            self._finish(task)
+
+    def _execute_group(self, tasks: list[_Task]) -> None:
+        """Group commit: one snapshot, each command's process_write buffered,
+        ONE engine write for every mutation (scheduler.rs would pay one
+        propose→apply→ack round trip per command here).  Per-command errors
+        (lock conflicts, txn state) fail only their own task; a write
+        failure fails exactly the tasks whose mutations rode the batch."""
+        ctx = tasks[0].ctx
+        contributed: list[_Task] = []
+        try:
+            fail_point("scheduler_async_snapshot")
+            snapshot = self.engine.snapshot(ctx)
+        except BaseException as exc:
+            for t in tasks:
+                t.exc = exc
+        else:
+            wb = WriteBatch()
+            for t in tasks:
+                try:
+                    txn, result = t.cmd.process_write(snapshot)
+                    t.result = result
+                    if not txn.is_empty():
+                        contributed.append(t)
+                        wb.ops.extend(txn.wb.ops)
+                except BaseException as exc:
+                    t.exc = exc
+            try:
+                fail_point("scheduler_before_write")
+                if wb.ops:
+                    # commands whose mutations actually rode this ONE write
+                    _SCHED_GROUP_SIZE.observe(len(contributed))
+                    self.engine.write(ctx, wb)
+            except BaseException as exc:
+                for t in contributed:
+                    t.result = None
+                    t.exc = exc
+        # one release sweep for the whole group: K latch releases under a
+        # single latch-table lock round (latches.release_many)
+        woken = self.latches.release_many([(t.cid, t.slots) for t in tasks])
+        with self._mu:
+            self._inflight -= len(tasks)
+            for t in tasks:
+                self._tasks.discard(t)
+            self.stats["woken"] += len(woken)
+        for w in woken:
+            self._enqueue(w)
+        for t in tasks:
+            t.done.set()
+
+    def _finish(self, task: _Task) -> None:
+        woken = self.latches.release(task.cid, task.slots)
+        with self._mu:
+            self._inflight -= 1
+            self._tasks.discard(task)
+            self.stats["woken"] += len(woken)
+        for t in woken:
+            self._enqueue(t)
+        task.done.set()
 
     def _fail_task(self, task: _Task, exc: BaseException) -> None:
         with self._mu:
