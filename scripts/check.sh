@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# CI gate: project lint (incl. metric/failpoint drift) + a sanitize-enabled
+# concurrency smoke pass.  See docs/static_analysis.md.
+#
+#   scripts/check.sh            # lint + sanitize smoke
+#   scripts/check.sh --lint     # lint only (fast pre-commit hook)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== lint (blocking-under-lock, jit recompile, metric/failpoint drift) =="
+python scripts/lint.py tikv_tpu tests
+
+if [[ "${1:-}" == "--lint" ]]; then
+  exit 0
+fi
+
+echo "== sanitize smoke: concurrency hot paths under TIKV_TPU_SANITIZE=1 =="
+JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
+  tests/test_sanitizer.py tests/test_txn_scheduler.py tests/test_raftstore.py \
+  tests/test_copr_scheduler.py tests/test_write_through.py \
+  tests/test_worker_pool.py tests/test_fsm_system.py
+
+echo "check.sh: all gates green"

@@ -1,0 +1,19 @@
+#!/usr/bin/env python3
+"""Console entry for the project linter (see docs/static_analysis.md).
+
+    python scripts/lint.py tikv_tpu tests
+    python scripts/lint.py --list-rules
+
+Exits non-zero on any unwaived finding; waive in-line with
+``# lint: allow(rule) -- reason``.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from tikv_tpu.analysis.lint import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

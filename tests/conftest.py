@@ -16,3 +16,20 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+# Sanitize-enabled smoke pass (docs/static_analysis.md): running ANY test
+# selection with TIKV_TPU_SANITIZE=1 arms the lock-order sanitizer for every
+# wired subsystem and fails the session if a cycle was observed anywhere.
+if os.environ.get("TIKV_TPU_SANITIZE") == "1":
+    import pytest  # noqa: E402
+
+    @pytest.fixture(scope="session", autouse=True)
+    def _sanitizer_session_gate():
+        yield
+        from tikv_tpu.analysis import sanitizer
+
+        cycles = sanitizer.reports("lock-order-cycle")
+        assert not cycles, (
+            "lock-order inversions observed during the run:\n\n"
+            + "\n\n".join(r.format() for r in cycles)
+        )

@@ -54,6 +54,26 @@ def test_scheduler_failpoint_blocks_write_atomically():
     assert store.get(b"k", 30) == b"v"
 
 
+def test_scheduler_snapshot_failpoint_fails_command_cleanly():
+    """A fault at snapshot acquisition (scheduler_async_snapshot — before
+    any process_write runs) must fail the command, release its latches, and
+    leave the scheduler serviceable."""
+    store = Storage()
+    cfg("scheduler_async_snapshot", "return")
+    with pytest.raises(FailpointError):
+        store.sched_txn_command(
+            Prewrite([Mutation.put(Key.from_raw(b"k"), b"v")], b"k", 10)
+        )
+    teardown()
+    # the latch was released: the same key prewrites and commits fine
+    r = store.sched_txn_command(
+        Prewrite([Mutation.put(Key.from_raw(b"k"), b"v")], b"k", 10)
+    )
+    assert "errors" not in r
+    store.sched_txn_command(Commit([Key.from_raw(b"k")], 10, 20))
+    assert store.get(b"k", 30) == b"v"
+
+
 def test_pause_failpoint_creates_race_window():
     """pause holds a thread mid-command; writes resume when released."""
     store = Storage()

@@ -5,6 +5,7 @@ hot-FSM fairness, and the 1,000-regions-over-4-pollers bound
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -174,6 +175,10 @@ def test_store_cluster_many_regions_bounded_latency():
     from tikv_tpu.server.cluster import ServerCluster
 
     n_regions = 24
+    # wall-clock bounds scale under the lock-order sanitizer: instrumented
+    # acquisitions cost real time (TSan-style slowdown multiplier), and this
+    # cluster pays one per mailbox/store/scheduler lock round
+    slack = 3.0 if os.environ.get("TIKV_TPU_SANITIZE") == "1" else 1.0
     cluster = ServerCluster(3)
     try:
         cluster.start()
@@ -195,11 +200,13 @@ def test_store_cluster_many_regions_bounded_latency():
             for i in range(n_regions):
                 key = (b"k%03dw" % i) if i else b"a-w"
                 t0 = time.monotonic()
-                cluster.must_put(key + str(round_).encode(), b"v", timeout=10)
+                cluster.must_put(key + str(round_).encode(), b"v",
+                                 timeout=10 * slack)
                 lat.append(time.monotonic() - t0)
         wall = time.monotonic() - t_all
         lat.sort()
-        assert lat[int(len(lat) * 0.99)] < 5.0, f"p99 {lat[-1]:.2f}s, wall {wall:.1f}s"
+        assert lat[int(len(lat) * 0.99)] < 5.0 * slack, \
+            f"p99 {lat[-1]:.2f}s, wall {wall:.1f}s"
         for node in cluster.nodes.values():
             assert not node.node.thread_errors, node.node.thread_errors[:3]
     finally:

@@ -1,0 +1,278 @@
+"""Project linter (analysis/lint.py): each rule on synthetic modules, the
+waiver machinery, and the zero-findings gate on the real tree."""
+
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tikv_tpu.analysis import lint
+
+
+def _lint_src(tmp_path: Path, src: str, rel: str = "tikv_tpu/mod.py",
+              drift: bool = False, metrics: dict | None = None):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    if metrics:
+        mdir = tmp_path / "metrics"
+        mdir.mkdir(exist_ok=True)
+        for name, content in metrics.items():
+            (mdir / name).write_text(content)
+    return lint.run([str(p.parent)], root=tmp_path, drift=drift)
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# lock-blocking-call
+# ---------------------------------------------------------------------------
+
+def test_direct_blocking_under_lock(tmp_path):
+    active, _ = _lint_src(tmp_path, """
+        import threading, time
+        class C:
+            def __init__(self):
+                self._mu = threading.Lock()
+            def bad(self):
+                with self._mu:
+                    time.sleep(1)
+            def good(self):
+                time.sleep(1)
+                with self._mu:
+                    pass
+    """)
+    assert _rules(active) == ["lock-blocking-call"]
+    assert "time.sleep" in active[0].message
+
+
+def test_transitive_blocking_through_self_call(tmp_path):
+    active, _ = _lint_src(tmp_path, """
+        import threading
+        class C:
+            def __init__(self, engine):
+                self._mu = threading.Lock()
+                self.engine = engine
+            def _write_out(self):
+                self.engine.write(None)
+            def bad(self):
+                with self._mu:
+                    self._write_out()
+    """)
+    assert _rules(active) == ["lock-blocking-call"]
+    assert "_write_out" in active[0].message and "engine.write" in active[0].message
+
+
+def test_condition_wait_on_held_lock_is_fine_foreign_wait_is_not(tmp_path):
+    active, _ = _lint_src(tmp_path, """
+        import threading
+        class C:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._mu = threading.Lock()
+            def ok(self):
+                with self._cv:
+                    self._cv.wait(1.0)
+            def bad(self, ev):
+                with self._mu:
+                    ev.wait()
+    """)
+    assert len(active) == 1 and active[0].rule == "lock-blocking-call"
+    assert "ev.wait" in active[0].message
+
+
+def test_engine_round_trip_and_device_sync_under_lock(tmp_path):
+    active, _ = _lint_src(tmp_path, """
+        import threading
+        class C:
+            def __init__(self, engine):
+                self._latch_mu = threading.Lock()
+                self.engine = engine
+            def bad(self, arr):
+                with self._latch_mu:
+                    snap = self.engine.snapshot(None)
+                    arr.block_until_ready()
+    """)
+    assert _rules(active) == ["lock-blocking-call"] * 2
+
+
+def test_waiver_inline_and_above_with_reason(tmp_path):
+    active, waived = _lint_src(tmp_path, """
+        import threading, time
+        class C:
+            def __init__(self):
+                self._mu = threading.Lock()
+            def a(self):
+                with self._mu:
+                    time.sleep(1)  # lint: allow(lock-blocking-call) -- why
+            def b(self):
+                with self._mu:
+                    # lint: allow(lock-blocking-call) -- reason spanning
+                    # a second comment line does not break the reach
+                    time.sleep(1)
+            def c(self):
+                with self._mu:
+                    time.sleep(1)  # lint: allow(jit-nocache) -- wrong rule
+    """)
+    assert len(waived) == 2
+    assert _rules(active) == ["lock-blocking-call"]  # wrong-rule waiver
+
+
+def test_inline_waiver_does_not_leak_to_next_line(tmp_path):
+    """The trailing-comment form covers ONLY its own line: an unreviewed
+    violation directly below must keep its own finding."""
+    active, waived = _lint_src(tmp_path, """
+        import threading, time
+        class C:
+            def __init__(self, engine):
+                self._mu = threading.Lock()
+                self.engine = engine
+            def f(self, b):
+                with self._mu:
+                    time.sleep(1)  # lint: allow(lock-blocking-call) -- ok
+                    self.engine.write(b)
+    """)
+    assert len(waived) == 1 and "time.sleep" in waived[0].message
+    assert _rules(active) == ["lock-blocking-call"]
+    assert "engine.write" in active[0].message
+
+
+# ---------------------------------------------------------------------------
+# jit rules
+# ---------------------------------------------------------------------------
+
+def test_jit_nocache_flagged_cached_not(tmp_path):
+    active, _ = _lint_src(tmp_path, """
+        import jax
+        def hot(f):
+            return jax.jit(f)
+        def warm(f, cache, key):
+            fn = cache.get(key)
+            if fn is None:
+                fn = cache[key] = jax.jit(f)
+            return fn
+    """, rel="tikv_tpu/copr/dev.py")
+    assert _rules(active) == ["jit-nocache"]
+    assert "hot" in active[0].message
+
+
+def test_jit_static_args_and_shape_branch(tmp_path):
+    active, _ = _lint_src(tmp_path, """
+        import jax
+        def build(statics):  # cache word here would mask nothing below
+            def step(x):
+                if x.shape[0] > 4:
+                    return x
+                return x + 1
+            memo = jax.jit(step, static_argnums=statics)
+            return memo
+    """, rel="tikv_tpu/copr/dev2.py")
+    rules = set(_rules(active))
+    assert "jit-static-args" in rules
+    assert "jit-shape-branch" in rules
+
+
+def test_jit_host_sync_in_jitted_fn(tmp_path):
+    active, _ = _lint_src(tmp_path, """
+        import jax
+        def build_cache():
+            def step(x):
+                return x.item()
+            return jax.jit(step)
+    """, rel="tikv_tpu/copr/dev3.py")
+    assert _rules(active) == ["jit-host-sync"]
+
+
+# ---------------------------------------------------------------------------
+# drift passes
+# ---------------------------------------------------------------------------
+
+def test_metric_drift_both_directions(tmp_path):
+    active, _ = _lint_src(tmp_path, """
+        from ..util.metrics import REGISTRY
+        REGISTRY.counter("tikv_lint_used_total", "used")
+        REGISTRY.counter("tikv_lint_dead_total", "never charted")
+    """, drift=True, metrics={"dash.json": (
+        '{"panels": [{"targets": [{"expr": '
+        '"rate(tikv_lint_used_total[1m]) + rate(tikv_lint_ghost_total[1m])"'
+        '}]}]}'
+    )})
+    by_rule = {f.rule: f for f in active}
+    assert "metric-drift-dashboard" in by_rule
+    assert "tikv_lint_ghost_total" in by_rule["metric-drift-dashboard"].message
+    assert "metric-drift-code" in by_rule
+    assert "tikv_lint_dead_total" in by_rule["metric-drift-code"].message
+    assert len(active) == 2
+
+
+def test_histogram_series_suffixes_resolve(tmp_path):
+    active, _ = _lint_src(tmp_path, """
+        from ..util.metrics import REGISTRY
+        REGISTRY.histogram("tikv_lint_lat_seconds", "latency")
+    """, drift=True, metrics={"dash.json": (
+        '{"panels": [{"targets": [{"expr": '
+        '"histogram_quantile(0.99, rate(tikv_lint_lat_seconds_bucket[1m]))"}]}]}'
+    )})
+    assert active == []
+
+
+def test_failpoint_drift_both_directions(tmp_path):
+    root = tmp_path
+    src = root / "tikv_tpu" / "mod.py"
+    src.parent.mkdir(parents=True)
+    src.write_text(textwrap.dedent("""
+        from .util.failpoint import fail_point
+        def f():
+            fail_point("site_tested")
+            fail_point("site_untested")
+    """))
+    test = root / "tests" / "test_mod.py"
+    test.parent.mkdir()
+    test.write_text(textwrap.dedent("""
+        from tikv_tpu.util.failpoint import cfg, fail_point
+        def test_it():
+            cfg("site_tested", "return")
+            cfg("site_gone", "return")
+            cfg("local_site", "pause")
+            fail_point("local_site")
+    """))
+    active, _ = lint.run([str(src.parent), str(test.parent)], root=root, drift=True)
+    by_rule = {}
+    for f in active:
+        by_rule.setdefault(f.rule, []).append(f)
+    assert [f.message for f in by_rule["failpoint-drift-test"]] \
+        and "site_gone" in by_rule["failpoint-drift-test"][0].message
+    assert "site_untested" in by_rule["failpoint-drift-source"][0].message
+    assert len(active) == 2  # local_site + site_tested are both fine
+
+
+def test_raw_lock_direct_in_wired_module(tmp_path):
+    active, _ = _lint_src(tmp_path, """
+        import threading
+        class W:
+            def __init__(self):
+                self._cv = threading.Condition()
+    """, rel="tikv_tpu/util/worker.py")
+    assert _rules(active) == ["raw-lock-direct"]
+
+
+# ---------------------------------------------------------------------------
+# the gate
+# ---------------------------------------------------------------------------
+
+def test_real_tree_lints_clean():
+    """THE acceptance gate: the shipped tree has zero unwaived findings —
+    exactly what `python scripts/lint.py tikv_tpu tests` enforces in CI."""
+    root = Path(lint.__file__).resolve().parents[2]
+    active, waived = lint.run(["tikv_tpu", "tests"], root=root)
+    assert active == [], "\n" + "\n".join(f.format() for f in active)
+    # the waivers carry reasons (-- ...) — spot-check they exist at all
+    assert waived, "expected in-line waivers in the tree"
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    assert lint.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "lock-blocking-call" in out

@@ -1,0 +1,15 @@
+"""Static + runtime correctness tooling for the concurrent hot paths.
+
+Two halves (docs/static_analysis.md):
+
+* :mod:`.lint` — AST-based project linter: blocking calls under
+  cache/scheduler/latch locks, JAX recompile hazards, metric-name drift
+  between code and the Grafana dashboards, failpoint drift between tests
+  and source.  ``python scripts/lint.py tikv_tpu tests`` (console script
+  ``tikv-tpu-lint``) gates CI at zero unwaived findings.
+* :mod:`.sanitizer` — runtime lock-order race sanitizer: instrumented
+  Lock/RLock/Condition wrappers (enabled by ``TIKV_TPU_SANITIZE=1``) that
+  build a global lock-acquisition-order graph, report cycles (potential
+  deadlocks) with the stacks of both conflicting acquisitions, and flag
+  long holds and locks held across engine/device round trips.
+"""

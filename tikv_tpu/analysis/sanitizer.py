@@ -1,0 +1,547 @@
+"""Runtime lock-order race sanitizer (TSan/lockdep for the hot paths).
+
+The serving/write stack is deeply concurrent — latch table, txn scheduler,
+region column cache, coprocessor read scheduler, raft store, worker pools —
+and example-based tests cannot prove the absence of lock-order inversions.
+This module is the lockdep re-expression: instrumented ``Lock``/``RLock``/
+``Condition`` wrappers that
+
+* build a process-global **lock-acquisition-order graph** keyed by each
+  lock's *order key* (a stable per-subsystem name, so every ``Worker``
+  condition is one node, not thousands);
+* report **cycles** (potential deadlocks) the moment the closing edge is
+  observed, with the stacks of BOTH conflicting acquisitions — before any
+  thread actually deadlocks (detection is at acquisition *attempt*, and two
+  sequential threads A→B then B→A are enough, no timing window needed);
+* flag **long holds** (a lock held longer than ``TIKV_TPU_SANITIZE_HOLD_MS``)
+  and **locks held across engine/device round trips**
+  (:func:`note_blocking` call sites in ``raft/raftkv.py`` and the device
+  pull paths).
+
+Enabling: set ``TIKV_TPU_SANITIZE=1`` before process start (the factories
+read it when each lock is created), or wrap test code in
+``with sanitizer.force():``.  Disabled, the factories return plain
+``threading`` primitives — zero overhead on the hot paths.
+
+Env vars:
+
+=============================  =============================================
+``TIKV_TPU_SANITIZE``          ``1`` enables the instrumented wrappers
+``TIKV_TPU_SANITIZE_HOLD_MS``  long-hold threshold, default 500
+``TIKV_TPU_SANITIZE_FATAL``    ``1`` raises on a detected cycle instead of
+                               recording it (CI hard-stop mode)
+=============================  =============================================
+
+Reports accumulate in :func:`reports` (bounded, deduplicated) and are also
+emitted through ``logging`` at WARNING.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import sys
+import threading
+import time
+
+_log = logging.getLogger("tikv_tpu.sanitizer")
+
+_FORCED: bool | None = None  # force() override for tests
+_MAX_REPORTS = 256
+_STACK_LIMIT = 20
+
+
+def _enabled() -> bool:
+    if _FORCED is not None:
+        return _FORCED
+    return os.environ.get("TIKV_TPU_SANITIZE", "").lower() in ("1", "true", "on", "yes")
+
+
+_hold_cache: float | None = None
+
+
+def _hold_threshold_s() -> float:
+    # cached: this runs on EVERY release — an os.environ read + float parse
+    # there costs more than the rest of the release path combined.
+    # clear_reports() invalidates (tests monkeypatch the env per scenario).
+    global _hold_cache
+    if _hold_cache is None:
+        try:
+            _hold_cache = float(
+                os.environ.get("TIKV_TPU_SANITIZE_HOLD_MS", "500")) / 1000.0
+        except ValueError:
+            _hold_cache = 0.5
+    return _hold_cache
+
+
+def _fatal() -> bool:
+    return os.environ.get("TIKV_TPU_SANITIZE_FATAL", "") == "1"
+
+
+@contextlib.contextmanager
+def force(enabled: bool = True):
+    """Test hook: force the factories on (or off) regardless of the env.
+    Wrappers created inside keep tracking after exit — create the subsystem
+    under ``force()`` and exercise it anywhere."""
+    global _FORCED
+    prev = _FORCED
+    _FORCED = enabled
+    try:
+        yield
+    finally:
+        _FORCED = prev
+
+
+def _stack(skip: int = 2) -> tuple[str, ...]:
+    """Fast frame walk — no linecache I/O, safe on every acquire.  Leading
+    frames inside this module are dropped so user code tops the report."""
+    try:
+        f = sys._getframe(skip)
+    except ValueError:
+        return ()
+    while f is not None and f.f_code.co_filename == __file__:
+        f = f.f_back
+    out = []
+    while f is not None and len(out) < _STACK_LIMIT:
+        co = f.f_code
+        out.append(f"{co.co_filename}:{f.f_lineno} in {co.co_name}")
+        f = f.f_back
+    return tuple(out)
+
+
+def _site(skip: int = 2) -> tuple[str, ...]:
+    """One-frame acquire site: the cost the UNCONTENDED hot path pays on
+    every acquisition.  Full walks (:func:`_stack`) run only for nested
+    acquisitions and report emission — a raft cluster doing millions of
+    flat lock round trips must not pay a 20-frame walk each time."""
+    try:
+        f = sys._getframe(skip)
+    except ValueError:
+        return ()
+    while f is not None and f.f_code.co_filename == __file__:
+        f = f.f_back
+    if f is None:
+        return ()
+    co = f.f_code
+    return (f"{co.co_filename}:{f.f_lineno} in {co.co_name}",)
+
+
+class Report:
+    """One sanitizer finding."""
+
+    __slots__ = ("kind", "message", "stacks", "thread")
+
+    def __init__(self, kind: str, message: str,
+                 stacks: list[tuple[str, tuple[str, ...]]]):
+        self.kind = kind
+        self.message = message
+        self.stacks = stacks
+        self.thread = threading.current_thread().name
+
+    def format(self) -> str:
+        lines = [f"[{self.kind}] {self.message} (thread {self.thread})"]
+        for title, frames in self.stacks:
+            lines.append(f"  -- {title}:")
+            lines.extend(f"     {fr}" for fr in frames)
+        return "\n".join(lines)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<Report {self.kind}: {self.message}>"
+
+
+class _Edge:
+    __slots__ = ("held_stack", "acq_stack", "thread", "count")
+
+    def __init__(self, held_stack, acq_stack, thread):
+        self.held_stack = held_stack
+        self.acq_stack = acq_stack
+        self.thread = thread
+        self.count = 1
+
+
+class _Held:
+    __slots__ = ("lock", "t0", "stack", "depth")
+
+    def __init__(self, lock, t0, stack):
+        self.lock = lock
+        self.t0 = t0
+        self.stack = stack
+        self.depth = 1
+
+
+# all sanitizer bookkeeping is guarded by ONE plain (untracked) mutex; the
+# held-lists are thread-local so the common acquire touches _mu only to
+# record graph edges (i.e. only for nested acquisitions)
+_mu = threading.Lock()
+_edges: dict[str, dict[str, _Edge]] = {}
+_reports: list[Report] = []
+_seen: set = set()  # dedup keys for every report kind
+_tls = threading.local()
+
+
+def _held_list() -> list:
+    lst = getattr(_tls, "held", None)
+    if lst is None:
+        lst = _tls.held = []
+    return lst
+
+
+def _emit(report: Report) -> None:
+    with _mu:
+        # cycle/same-key reports bypass the cap: a flood of deduplicated
+        # long-hold reports must never displace the one report the CI gate
+        # exists to catch (cycles self-bound via node-set dedup anyway)
+        if (len(_reports) < _MAX_REPORTS
+                or report.kind in ("lock-order-cycle", "lock-order-same-key")):
+            _reports.append(report)
+    _log.warning("%s", report.format())
+
+
+def reports(kind: str | None = None) -> list[Report]:
+    with _mu:
+        snap = list(_reports)
+    return snap if kind is None else [r for r in snap if r.kind == kind]
+
+
+def clear_reports() -> None:
+    """Reset findings AND the order graph (tests isolate scenarios)."""
+    global _hold_cache
+    with _mu:
+        _reports.clear()
+        _seen.clear()
+        _edges.clear()
+        _hold_cache = None
+
+
+def snapshot_state():
+    """Copy the global graph/report state — pair with :func:`restore_state`
+    so a test can seed synthetic scenarios without erasing edges a
+    session-wide gate (tests/conftest.py) is accumulating."""
+    with _mu:
+        return (
+            {a: dict(bs) for a, bs in _edges.items()},
+            list(_reports),
+            set(_seen),
+        )
+
+
+def restore_state(state) -> None:
+    edges, reports_, seen = state
+    global _hold_cache
+    with _mu:
+        _edges.clear()
+        _edges.update({a: dict(bs) for a, bs in edges.items()})
+        _reports[:] = reports_
+        _seen.clear()
+        _seen.update(seen)
+        _hold_cache = None
+
+
+def lock_graph() -> dict[str, set[str]]:
+    """The observed acquisition-order graph: key -> keys acquired under it."""
+    with _mu:
+        return {a: set(bs) for a, bs in _edges.items()}
+
+
+def _find_path(src: str, dst: str) -> list[str] | None:
+    """DFS over _edges from src to dst (caller holds _mu)."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in _edges.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _record_acquire(lock: "_TrackedLock", stack: tuple[str, ...]) -> None:
+    """Called on the outermost acquisition ATTEMPT: add order edges from
+    every held lock and check each new edge for a closing cycle."""
+    held = _held_list()
+    if not held:
+        return
+    cycle_report = None
+    for h in held:
+        a, b = h.lock.order_key, lock.order_key
+        if a == b:
+            if h.lock is not lock:
+                key = ("same-key", a)
+                with _mu:
+                    if key in _seen:
+                        continue
+                    _seen.add(key)
+                _emit(Report(
+                    "lock-order-same-key",
+                    f"two distinct locks with order key {a!r} nested — "
+                    f"instances of one subsystem lock acquired inside each "
+                    f"other have no defined order",
+                    [(f"outer {a} ({h.lock.label or 'unnamed'}) acquired at", h.stack),
+                     (f"inner {b} ({lock.label or 'unnamed'}) acquired at", stack)],
+                ))
+            continue
+        with _mu:
+            row = _edges.setdefault(a, {})
+            edge = row.get(b)
+            if edge is not None:
+                edge.count += 1
+                continue
+            row[b] = _Edge(h.stack, stack, threading.current_thread().name)
+            path = _find_path(b, a)  # b ~> a plus the new a->b closes a cycle
+            if path is None:
+                continue
+            key = ("cycle", frozenset(path))
+            if key in _seen:
+                continue
+            _seen.add(key)
+            stacks = [
+                (f"this thread: {a} held at", h.stack),
+                (f"this thread: {b} acquired under {a} at", stack),
+            ]
+            for u, v in zip(path, path[1:]):
+                rev = _edges[u][v]
+                stacks.append((
+                    f"{rev.thread}: {v} acquired under {u} at "
+                    f"(with {u} held at the stack above it)",
+                    rev.held_stack + ("--- then acquired: ---",) + rev.acq_stack,
+                ))
+            cycle = " -> ".join([a, b] + path[1:])
+            cycle_report = Report(
+                "lock-order-cycle",
+                f"lock-order inversion: {cycle} — potential deadlock",
+                stacks,
+            )
+    if cycle_report is not None:
+        _emit(cycle_report)
+        if _fatal():
+            raise RuntimeError("sanitizer: " + cycle_report.message)
+
+
+def _push_held(lock: "_TrackedLock", stack: tuple[str, ...], depth: int = 1) -> _Held:
+    h = _Held(lock, time.monotonic(), stack)
+    h.depth = depth
+    _held_list().append(h)
+    return h
+
+
+def _find_held(lock: "_TrackedLock") -> _Held | None:
+    for h in reversed(_held_list()):
+        if h.lock is lock:
+            return h
+    return None
+
+
+def _pop_held(lock: "_TrackedLock") -> None:
+    h = _find_held(lock)
+    if h is None:
+        return  # release of a lock acquired before tracking (shouldn't happen)
+    h.depth -= 1
+    if h.depth > 0:
+        return
+    _held_list().remove(h)
+    dt = time.monotonic() - h.t0
+    if dt > _hold_threshold_s():
+        site = h.stack[0] if h.stack else "?"
+        key = ("long-hold", lock.order_key, site)
+        with _mu:
+            if key in _seen:
+                return
+            _seen.add(key)
+        _emit(Report(
+            "long-hold",
+            f"{lock.order_key} held for {dt * 1000:.0f}ms "
+            f"(threshold {_hold_threshold_s() * 1000:.0f}ms)",
+            [(f"{lock.order_key} acquired at", h.stack)],
+        ))
+
+
+def note_blocking(site: str) -> None:
+    """Declare a blocking boundary (engine write/snapshot round trip, device
+    sync/pull).  If the calling thread holds ANY sanitized lock here, that
+    lock is held across a stall — report it with both stacks.  Call sites
+    live in ``raft/raftkv.py``, ``copr/jax_eval.py``, ``copr/jax_zone.py``
+    and ``parallel/mesh.py``; the call is a no-op when the sanitizer is off
+    or nothing is held."""
+    if not _enabled():
+        return
+    held = getattr(_tls, "held", None)
+    if not held:
+        return
+    stack = _stack(2)
+    names = ", ".join(h.lock.order_key for h in held)
+    site_frame = stack[0] if stack else "?"
+    key = ("blocking", site, tuple(h.lock.order_key for h in held), site_frame)
+    with _mu:
+        if key in _seen:
+            return
+        _seen.add(key)
+    stacks = [(f"{h.lock.order_key} acquired at", h.stack) for h in held]
+    stacks.append((f"blocking call {site} at", stack))
+    _emit(Report(
+        "blocking-under-lock",
+        f"{site} entered while holding [{names}]",
+        stacks,
+    ))
+
+
+# ---------------------------------------------------------------------------
+# wrappers
+# ---------------------------------------------------------------------------
+
+class _TrackedLock:
+    """Instrumented lock.  ``order_key`` names the graph node (one per
+    subsystem lock class); ``label`` carries per-instance detail for
+    reports."""
+
+    _reentrant = False
+
+    def __init__(self, order_key: str, label: str | None = None, real=None):
+        self.order_key = order_key
+        self.label = label
+        self._real = real if real is not None else (
+            threading.RLock() if self._reentrant else threading.Lock()
+        )
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        h = _find_held(self) if self._reentrant else None
+        if h is not None:  # reentrant re-acquire: no new ordering event
+            got = self._real.acquire(blocking, timeout)
+            if got:
+                h.depth += 1
+            return got
+        if _held_list():
+            # nested acquisition: an ordering event worth a full stack.
+            # Edges record the *attempt* — a cycle is reported before this
+            # thread can actually park on the inverted lock.
+            stack = _stack(2)
+            _record_acquire(self, stack)
+        else:
+            stack = _site(2)  # flat fast path: one frame for hold reports
+        got = self._real.acquire(blocking, timeout)
+        if got:
+            _push_held(self, stack)
+        return got
+
+    def release(self) -> None:
+        self._real.release()
+        _pop_held(self)
+
+    def locked(self) -> bool:
+        return self._real.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        kind = "RLock" if self._reentrant else "Lock"
+        return f"<San{kind} {self.order_key} ({self.label or 'unnamed'})>"
+
+
+class _TrackedRLock(_TrackedLock):
+    _reentrant = True
+
+
+class _TrackedCondition:
+    """Condition over a tracked lock.  ``wait`` releases the lock — the
+    held-record is parked for the duration so hold-time and order tracking
+    stay truthful."""
+
+    def __init__(self, order_key: str, lock: _TrackedLock | None = None,
+                 label: str | None = None):
+        if lock is None:
+            lock = _TrackedRLock(order_key, label)
+        self._lock = lock
+        self._cond = threading.Condition(lock._real)
+
+    # lock facade ------------------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        return self._lock.acquire(blocking, timeout)
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self):
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._lock.release()
+
+    # condition facade --------------------------------------------------------
+    def wait(self, timeout: float | None = None) -> bool:
+        h = _find_held(self._lock)
+        depth = h.depth if h is not None else 1
+        if h is not None:
+            # the real Condition releases the lock for the wait: park the
+            # record (hold time restarts on wake — the wait is not a hold)
+            h.depth = 1
+            _pop_held(self._lock)
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            if h is not None:
+                _push_held(self._lock, _site(2), depth)
+
+    def wait_for(self, predicate, timeout: float | None = None):
+        result = predicate()
+        if result:
+            return result
+        endtime = None if timeout is None else time.monotonic() + timeout
+        while not result:
+            t = None if endtime is None else max(endtime - time.monotonic(), 0)
+            if t == 0:
+                break
+            self.wait(t)
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<SanCondition over {self._lock!r}>"
+
+
+# ---------------------------------------------------------------------------
+# factories — the ONLY api the wired modules use
+# ---------------------------------------------------------------------------
+
+def make_lock(order_key: str, label: str | None = None):
+    """A mutex participating in order tracking when sanitize is on, else a
+    plain ``threading.Lock``."""
+    if _enabled():
+        return _TrackedLock(order_key, label)
+    return threading.Lock()
+
+
+def make_rlock(order_key: str, label: str | None = None):
+    if _enabled():
+        return _TrackedRLock(order_key, label)
+    return threading.RLock()
+
+
+def make_condition(order_key: str, lock=None, label: str | None = None):
+    """A condition variable; pass ``lock`` (from :func:`make_lock`) to share
+    one mutex between direct ``with lock:`` sections and the condition —
+    tracking stays consistent across both."""
+    if isinstance(lock, _TrackedLock):
+        return _TrackedCondition(order_key, lock, label)
+    if _enabled() and lock is None:
+        return _TrackedCondition(order_key, None, label)
+    return threading.Condition(lock)
+
+
+def held_locks() -> list[str]:
+    """Order keys this thread currently holds (debugging/tests)."""
+    return [h.lock.order_key for h in getattr(_tls, "held", [])]

@@ -42,6 +42,7 @@ jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp  # noqa: E402
 
+from ..analysis.sanitizer import note_blocking
 from .aggr import AggDescriptor, AggState
 from .dag import (
     Aggregation,
@@ -999,6 +1000,7 @@ class JaxDagEvaluator:
         sig = ("nvoff", self.block_rows)
 
         def build(_blk):
+            note_blocking("device.pin:nvoff")
             nv = np.array([b.n_valid for b in blocks], dtype=np.int64)
             off = np.concatenate([[0], np.cumsum(nv)[:-1]]).astype(np.int64)
             return jax.block_until_ready((jnp.asarray(nv), jnp.asarray(off)))
@@ -1012,6 +1014,7 @@ class JaxDagEvaluator:
         sig = ("stacked", tuple(ship_cols), tuple(nullable), self.block_rows)
 
         def build(_blk):
+            note_blocking("device.pin:stacked")
             data = tuple(
                 jnp.stack([jnp.asarray(self._pad(b.cols[i].data)) for b in blocks])
                 for i in ship_cols

@@ -61,6 +61,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..analysis.sanitizer import note_blocking
 from .datatypes import EvalType
 from .rpn import RpnExpression, eval_rpn
 
@@ -340,6 +341,7 @@ class ZoneLayout:
             "cols": {i: jnp.asarray(a) for i, a in self.cols_np.items()},
             "nulls": {i: jnp.asarray(a) for i, a in self.nulls_np.items()},
         }
+        note_blocking("device.pin:zone_layout")
         for v in jax.tree.leaves(self.dev):
             v.block_until_ready()
         # classification needs only the per-tile stats; the full-size host

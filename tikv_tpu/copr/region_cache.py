@@ -58,11 +58,11 @@ embedded engines).
 
 from __future__ import annotations
 
-import threading
 import weakref
 
 import numpy as np
 
+from ..analysis.sanitizer import make_rlock
 from ..storage.engine import CF_DEFAULT, CF_LOCK, CF_WRITE
 from ..storage.mvcc import Statistics
 from ..storage.mvcc.reader import _check_lock
@@ -508,7 +508,7 @@ class RegionColumnCache:
         self.max_regions = max_regions
         self.block_rows = block_rows or DEFAULT_BLOCK_ROWS
         self._images: dict = {}  # key -> RegionImage, insertion = LRU order
-        self._mu = threading.RLock()
+        self._mu = make_rlock("copr.region_cache")
         self.stats = RegionCacheStats()
         # write-through delta intake (docs/write_path.md): per-region
         # watermark of the highest apply index whose data change this cache
@@ -674,6 +674,9 @@ class RegionColumnCache:
                 self._enforce_budget(keep=key)
                 self._gauge_bytes()
                 return img.block_cache, "wt_delta", n
+            # lint: allow(lock-blocking-call) -- the fold-in must be atomic
+            # with the image version bump (docs: Concurrency); the scan is
+            # bounded by the delta size, and cold BUILDS run outside the lock
             delta = scan_delta(snap, start_ts, ranges, img.handles,
                                img.row_commit_ts, statistics=stats)
             if delta is None:

@@ -56,6 +56,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from ..analysis.sanitizer import make_condition, make_lock
 from . import jax_eval
 from .dag import (
     Aggregation,
@@ -190,7 +191,7 @@ class CoprReadScheduler:
     def __init__(self, endpoint, config: SchedulerConfig | None = None):
         self.ep = endpoint
         self.cfg = config or SchedulerConfig()
-        self._mu = threading.Condition(threading.Lock())
+        self._mu = make_condition("copr.scheduler", make_lock("copr.scheduler"))
         self._queues: dict[str, list[_Item]] = {lane: [] for lane in LANES}
         self._running = False
         self._thread: threading.Thread | None = None
@@ -198,7 +199,7 @@ class CoprReadScheduler:
         # whole plan) and the compiled evaluator (endpoint._evaluator_for
         # keys on serialized plan bytes — ~1ms of wire encoding per lookup
         # that a batch of identical-signature requests should pay once)
-        self._memo_mu = threading.Lock()
+        self._memo_mu = make_lock("copr.scheduler.memo")
         self._supports: dict[tuple, bool] = {}
         self._evs: dict[tuple, object] = {}
 
@@ -287,30 +288,39 @@ class CoprReadScheduler:
                 while self._running and not any(self._queues.values()):
                     self._mu.wait(0.5)
                 if not self._running:
-                    # drain whatever is queued so no caller hangs forever
+                    # drain whatever is queued so no caller hangs forever —
+                    # but SERVE it below, outside the dispatcher lock: the
+                    # drain batch runs engine snapshots and device dispatch,
+                    # and holding _mu across those would stall every
+                    # execute() caller on a blocked enqueue re-check
                     batch = [it for lane in LANES for it in self._queues[lane]]
                     for lane in LANES:
                         self._queues[lane].clear()
                     self._gauge_depth()
-                    if batch:
-                        self._serve_ticketed(batch)
-                    return
-                # linger until the oldest item's lane deadline or max_batch
-                now = time.perf_counter()
-                deadline = min(
-                    it.enqueue_t + cfg.wait_for(lane)
-                    for lane in LANES
-                    for it in self._queues[lane]
-                )
-                total = sum(len(q) for q in self._queues.values())
-                if total < cfg.max_batch and now < deadline:
-                    self._mu.wait(min(deadline - now, 0.05))
-                    continue
-                batch = []
-                for lane in LANES:  # high lane drains first
-                    while self._queues[lane] and len(batch) < cfg.max_batch:
-                        batch.append(self._queues[lane].pop(0))
-                self._gauge_depth()
+                    stopping = True
+                else:
+                    stopping = False
+                if not stopping:
+                    # linger until the oldest item's lane deadline or max_batch
+                    now = time.perf_counter()
+                    deadline = min(
+                        it.enqueue_t + cfg.wait_for(lane)
+                        for lane in LANES
+                        for it in self._queues[lane]
+                    )
+                    total = sum(len(q) for q in self._queues.values())
+                    if total < cfg.max_batch and now < deadline:
+                        self._mu.wait(min(deadline - now, 0.05))
+                        continue
+                    batch = []
+                    for lane in LANES:  # high lane drains first
+                        while self._queues[lane] and len(batch) < cfg.max_batch:
+                            batch.append(self._queues[lane].pop(0))
+                    self._gauge_depth()
+            if stopping:
+                if batch:
+                    self._serve_ticketed(batch)
+                return
             if batch:
                 for it in batch:
                     self._observe_wait(it)

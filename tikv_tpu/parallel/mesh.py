@@ -30,6 +30,7 @@ jax.config.update("jax_enable_x64", True)
 import jax.numpy as jnp  # noqa: E402
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
 
+from ..analysis.sanitizer import note_blocking
 from ..copr.dag import DagRequest
 from ..copr.jax_eval import (
     _NO_ROW,
@@ -236,6 +237,8 @@ class ShardedDagEvaluator:
             new_first = jnp.minimum(new_first, my_bf)
             return (new_first, tuple(new_carries))
 
+        # lint: allow(jit-nocache) -- compiled ONCE per evaluator in
+        # __init__ (self._step/self._fin memoize the returned callable)
         return jax.jit(step)
 
     def init_state(self):
@@ -436,6 +439,8 @@ class ShardedGroupedEvaluator:
             new_first = jnp.minimum(first_remap, bf)
             return (new_dict, new_first, tuple(new_carries), new_over)
 
+        # lint: allow(jit-nocache) -- compiled ONCE per evaluator in
+        # __init__ (self._step/self._fin memoize the returned callable)
         return jax.jit(step)
 
     def init_state(self):
@@ -557,6 +562,8 @@ class ShardedTopNEvaluator:
                 out.append(jnp.concatenate([sn, bn])[top_idx])
             return tuple(out)
 
+        # lint: allow(jit-nocache) -- compiled ONCE per evaluator in
+        # __init__ (self._step/self._fin memoize the returned callable)
         return jax.jit(step)
 
     def _build_finalize(self):
@@ -586,6 +593,8 @@ class ShardedTopNEvaluator:
                 out.append(gathered[n_key_ops + 2 * j + 1][top_idx])
             return tuple(out)
 
+        # lint: allow(jit-nocache) -- compiled ONCE per evaluator in
+        # __init__ (self._step/self._fin memoize the returned callable)
         return jax.jit(fin)
 
     def init_state(self):
@@ -720,6 +729,7 @@ def _slab_pins(ev, cache, assign: dict, by_id: dict, ship, nullable):
                 for i in nullable
             )
             out[did] = (data, nulls)
+        note_blocking("device.pin:sharded_slabs")
         for leaf in jax.tree.leaves(out):
             leaf.block_until_ready()
         return out

@@ -23,6 +23,8 @@ import threading
 from collections import deque
 from typing import Callable
 
+from ..analysis.sanitizer import make_condition
+
 
 class ApplySystem:
     """N workers, per-region FIFO ordering, flush barriers."""
@@ -30,7 +32,10 @@ class ApplySystem:
     def __init__(self, workers: int = 2, name: str = "apply"):
         self.n = max(1, workers)
         self._queues: list[deque] = [deque() for _ in range(self.n)]
-        self._cvs = [threading.Condition() for _ in range(self.n)]
+        self._cvs = [
+            make_condition("raft.apply_system", label=f"{name}-{i}")
+            for i in range(self.n)
+        ]
         self._stop = False
         self._threads = []
         # faults escaping a task land here (the store surfaces them)

@@ -25,6 +25,8 @@ import queue
 import threading
 from typing import Callable, Hashable
 
+from ..analysis.sanitizer import make_lock
+
 _IDLE = 0
 _NOTIFIED = 1
 _CLOSED = 2
@@ -37,7 +39,7 @@ class Mailbox:
 
     def __init__(self, addr: Hashable):
         self.addr = addr
-        self._mu = threading.Lock()
+        self._mu = make_lock("raft.fsm.mailbox", label=repr(addr))
         self._queue: list = []
         self._state = _IDLE
 
@@ -50,7 +52,7 @@ class Router:
     """Address -> mailbox map plus the shared ready queue."""
 
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = make_lock("raft.fsm.router")
         self._mailboxes: dict[Hashable, Mailbox] = {CONTROL: Mailbox(CONTROL)}
         self.ready: queue.SimpleQueue[Mailbox] = queue.SimpleQueue()
 

@@ -17,6 +17,7 @@ import threading
 import time
 from typing import Callable
 
+from ..analysis.sanitizer import note_blocking
 from ..storage.engine import Cursor, Snapshot, WriteBatch
 from ..storage.kv import Engine
 from ..util import keys
@@ -195,6 +196,7 @@ class RaftKv(Engine):
         """ONE definition of the ReadIndex wait (leader slow path AND
         follower replica reads): block until the read point is applied
         locally, then snapshot."""
+        note_blocking("raftkv.read_index_barrier")
         done = threading.Event()
         err: list = []
 
@@ -213,6 +215,10 @@ class RaftKv(Engine):
                               data_token=self.data_token)
 
     def write(self, ctx: dict | None, batch: WriteBatch) -> None:
+        # one full propose -> replicate -> apply -> ack round trip: a caller
+        # holding any subsystem lock across this stalls every peer of that
+        # lock for a raft round (sanitizer flags exactly that)
+        note_blocking("raftkv.write")
         peer = self._peer_for_ctx(ctx)
         ops = []
         for op, cf, key, val in batch.ops:

@@ -18,6 +18,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable
 
+from ..analysis.sanitizer import make_lock, make_rlock
 from ..storage.btree_engine import BTreeEngine
 from ..util.failpoint import fail_point
 from ..storage.engine import CF_DEFAULT, CF_LOCK, CF_RAFT, CF_WRITE, WriteBatch
@@ -305,7 +306,7 @@ class ChannelTransport(Transport):
     def __init__(self):
         self.stores: dict[int, "Store"] = {}
         self.filters: list[Filter] = []
-        self._mu = threading.Lock()
+        self._mu = make_lock("raft.transport")
 
     def register(self, store: "Store") -> None:
         self.stores[store.store_id] = store
@@ -358,7 +359,7 @@ class StorePeer:
         # guards proposals / pending_reads / pending_read_states: proposers
         # run on service threads, acks on apply workers, reads on the raft
         # thread
-        self._cb_mu = threading.Lock()
+        self._cb_mu = make_lock("raft.peer.cb", label=f"region-{region.id}")
         self.pending_read_states: list[tuple[bytes, int]] = []
 
     # -- raft driving ------------------------------------------------------
@@ -1485,7 +1486,7 @@ class Store:
         self.peers: dict[int, StorePeer] = {}
         self._inbox: list[RaftMessage] = []
         self._compact_requested = threading.Event()
-        self._mu = threading.RLock()
+        self._mu = make_rlock("raft.store", label=f"store-{store_id}")
         self.split_observers: list[Callable] = []
         self.merge_observers: list[Callable] = []
         self.apply_observers: list[Callable] = []

@@ -81,6 +81,8 @@ class Profiler:
             if started_here:
                 tracemalloc.start()
                 # let in-flight work allocate so the snapshot isn't empty
+                # lint: allow(lock-blocking-call) -- _mu IS the one-profile-
+                # window-at-a-time gate; sleeping inside it is the feature
                 time.sleep(0.1)
             try:
                 snap = tracemalloc.take_snapshot()

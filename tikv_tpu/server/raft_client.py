@@ -78,6 +78,9 @@ class _StoreConn:
     def send_oneway(self, method: str, req) -> bool:
         """Fire-and-forget frame (req_id 0 = no response expected)."""
         with self.send_mu:
+            # lint: allow(lock-blocking-call) -- send_mu serializes exactly
+            # this store's conn: connect-then-send must be one critical
+            # section or two senders would race a half-open socket
             if not self._connect_locked():
                 return False
             try:

@@ -237,6 +237,9 @@ class Server:
                                     return  # consumer abandoned the stream
                                 payload = wire.dumps([req_id, {"stream": item}])
                                 with send_mu:
+                                    # lint: allow(lock-blocking-call) -- send_mu
+                                    # guards exactly this socket: frames from
+                                    # concurrent handlers must not interleave
                                     write_frame(conn, payload)
                         except OSError:
                             return  # client went away mid-stream
@@ -251,6 +254,8 @@ class Server:
                         payload = wire.dumps([req_id, resp])
                     with send_mu:
                         try:
+                            # lint: allow(lock-blocking-call) -- per-socket
+                            # frame serialization (same as the stream path)
                             write_frame(conn, payload)
                         except OSError:
                             pass
@@ -361,6 +366,8 @@ class Client:
             ev = threading.Event()
             self._pending[req_id] = ev
         with self._send_mu:
+            # lint: allow(lock-blocking-call) -- _send_mu exists to serialize
+            # frames on this client's one socket
             write_frame(self._sock, wire.dumps([req_id, method, request]))
         if not ev.wait(timeout):
             with self._mu:
@@ -388,6 +395,7 @@ class Client:
             q: queue.Queue = queue.Queue()
             self._streams[req_id] = q
         with self._send_mu:
+            # lint: allow(lock-blocking-call) -- per-socket frame serialization
             write_frame(self._sock, wire.dumps([req_id, method, request]))
         return self._stream_iter(method, req_id, q, timeout)
 
@@ -413,6 +421,8 @@ class Client:
                     # credit (oneway ack — no response expected)
                     try:
                         with self._send_mu:
+                            # lint: allow(lock-blocking-call) -- per-socket
+                            # frame serialization
                             write_frame(self._sock, wire.dumps(
                                 [0, "_stream_ack", {"id": req_id, "n": 1}]))
                     except OSError:
@@ -437,6 +447,8 @@ class Client:
                     self._streams.pop(req_id, None)
                 try:
                     with self._send_mu:
+                        # lint: allow(lock-blocking-call) -- per-socket frame
+                        # serialization
                         write_frame(self._sock, wire.dumps(
                             [0, "_stream_cancel", {"id": req_id}]))
                 except OSError:

@@ -8,8 +8,7 @@ can detect them before the persisted lock is visible, and every read advances
 
 from __future__ import annotations
 
-import threading
-
+from ..analysis.sanitizer import make_rlock
 from .mvcc.reader import KeyIsLockedError
 from .txn_types import Key, Lock
 
@@ -40,7 +39,7 @@ class KeyHandleGuard:
 
 class ConcurrencyManager:
     def __init__(self, latest_ts: int = 0):
-        self._mu = threading.RLock()
+        self._mu = make_rlock("txn.concurrency_manager")
         self._max_ts = latest_ts
         self._table: dict[bytes, Lock] = {}
 

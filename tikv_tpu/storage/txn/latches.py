@@ -17,6 +17,8 @@ import threading
 from collections import deque
 from dataclasses import dataclass
 
+from ...analysis.sanitizer import make_lock
+
 
 @dataclass
 class _Waiting:
@@ -31,7 +33,7 @@ class Latches:
     def __init__(self, size: int = 256):
         self.size = size
         self._slots: list[deque[int]] = [deque() for _ in range(size)]
-        self._mu = threading.Lock()
+        self._mu = make_lock("txn.latches")
         self._cids = itertools.count(1)
         self._waiting: dict[int, _Waiting] = {}
 

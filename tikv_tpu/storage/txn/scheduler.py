@@ -31,6 +31,7 @@ import threading
 from collections import deque
 from dataclasses import dataclass, field
 
+from ...analysis.sanitizer import make_condition, make_lock
 from ...util import error_code
 from ...util.failpoint import fail_point
 from ...util.metrics import REGISTRY
@@ -96,8 +97,8 @@ class Scheduler:
         # group commit: max queued compatible commands coalesced into one
         # engine write (1 disables — every command pays its own round trip)
         self.group_commit_max = max(1, group_commit_max)
-        self._mu = threading.Lock()
-        self._ready = threading.Condition(self._mu)
+        self._mu = make_lock("txn.scheduler")
+        self._ready = make_condition("txn.scheduler", self._mu)
         self._high: deque[_Task] = deque()
         self._normal: deque[_Task] = deque()
         self._inflight = 0  # submitted, not yet finished (queued or running)

@@ -25,6 +25,8 @@ import threading
 import time
 from collections import deque
 
+from ..analysis.sanitizer import make_condition
+
 
 class Runnable:
     """Task handler for a Worker (worker/mod.rs Runnable)."""
@@ -49,7 +51,7 @@ class Worker:
     def __init__(self, name: str, timer_interval: float | None = None):
         self.name = name
         self._queue: deque = deque()
-        self._cv = threading.Condition()
+        self._cv = make_condition("util.worker", label=name)
         self._runnable: Runnable | None = None
         self._thread: threading.Thread | None = None
         self._stopped = False
@@ -166,7 +168,7 @@ class UnifiedReadPool:
 
     def __init__(self, workers: int = 4, name: str = "unified-read-pool"):
         self._levels: tuple[deque, deque, deque] = (deque(), deque(), deque())
-        self._cv = threading.Condition()
+        self._cv = make_condition("util.read_pool", label=name)
         # group → (accumulated elapsed seconds, last activity monotonic time)
         self._group_elapsed: dict[object, tuple[float, float]] = {}
         self._stopped = False
