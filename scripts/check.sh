@@ -20,4 +20,8 @@ JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
   tests/test_copr_scheduler.py tests/test_write_through.py \
   tests/test_worker_pool.py tests/test_fsm_system.py
 
+echo "== chaos smoke: nemesis + retry/breaker fault paths under the sanitizer =="
+JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
+  -m 'not slow' tests/test_chaos_nemesis.py tests/test_retry_policy.py
+
 echo "check.sh: all gates green"

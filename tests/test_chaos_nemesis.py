@@ -1,0 +1,599 @@
+"""Chaos nemesis + self-healing serving plane (util/chaos.py, util/retry.py,
+copr/breaker.py, scheduler deadlines).
+
+The acceptance contract (ISSUE 6 / docs/robustness.md):
+
+* under seeded drop/delay/dup/reorder/partition/crash-restart schedules, NO
+  acknowledged write is lost and the cluster converges after ``heal()``;
+* warm (region-cache) reads stay byte-identical to the CPU oracle after
+  heal — including when chaos forces the PR-4 write-through watermark gap
+  repair;
+* a deadline-expired request is shed, counted, and never dispatched to the
+  device;
+* the device-path circuit breaker trips to the CPU fallback on repeated
+  injected faults and restores through a half-open probe, with
+  trip/probe/restore metrics.
+
+The fast seeded smoke runs in tier-1 (deterministic in-memory cluster);
+full nemesis schedules over real sockets are marked ``slow``.
+"""
+
+import time
+
+import pytest
+
+from copr_fixtures import PRODUCT_COLUMNS, TABLE_ID
+from fixtures import put_committed
+
+from tikv_tpu.copr.breaker import BreakerConfig, DeviceCircuitBreaker
+from tikv_tpu.copr.dag import Aggregation, DagRequest, Limit, TableScan
+from tikv_tpu.copr.aggr import AggDescriptor
+from tikv_tpu.copr.endpoint import CoprRequest, Endpoint
+from tikv_tpu.copr.scheduler import SchedulerConfig
+from tikv_tpu.copr.table import encode_row, record_key, record_range
+from tikv_tpu.raft.cluster import FIRST_REGION_ID, Cluster
+from tikv_tpu.storage.btree_engine import BTreeEngine
+from tikv_tpu.storage.engine import CF_WRITE, WriteBatch
+from tikv_tpu.storage.kv import LocalEngine
+from tikv_tpu.storage.txn_types import Key, Write, WriteType
+from tikv_tpu.util import failpoint, retry
+from tikv_tpu.util.chaos import Nemesis
+from tikv_tpu.util.metrics import REGISTRY
+from tikv_tpu.util.retry import DeadlineExceeded, ServerBusyError
+
+NON_HANDLE = [c for c in PRODUCT_COLUMNS if not c.is_pk_handle]
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoint.teardown()
+    yield
+    failpoint.teardown()
+
+
+# ---------------------------------------------------------------------------
+# tier-1: fast seeded chaos smoke (deterministic in-memory cluster)
+# ---------------------------------------------------------------------------
+
+def test_chaos_smoke_seeded():
+    """One compact scenario < 10s: message storm + partition + leader crash,
+    every acknowledged write survives heal and all live stores converge."""
+    c = Cluster(3)
+    c.run()
+    nem = Nemesis(c, seed=1234)
+    acked = {}
+    try:
+        # phase 1: lossy, slow, duplicating, reordering network
+        nem.drop(rate=0.25)
+        nem.delay(1, 3, rate=0.4)
+        nem.duplicate(rate=0.25)
+        nem.reorder(window=3)
+        for i in range(6):
+            c.must_put(b"storm-%d" % i, b"v%d" % i)
+            acked[b"storm-%d" % i] = b"v%d" % i
+            c.tick()
+        nem.heal()
+
+        # phase 2: isolate the leader; the majority side keeps accepting
+        leader_sid = c.wait_leader(FIRST_REGION_ID).store.store_id
+        others = [s for s in c.stores if s != leader_sid]
+        nem.partition({leader_sid}, others)
+        for _ in range(30):
+            c.tick()
+        c.must_put(b"minority-cut", b"still-writable")
+        acked[b"minority-cut"] = b"still-writable"
+        nem.heal()
+
+        # phase 3: crash the (possibly new) leader outright, write, restart
+        leader_sid = c.wait_leader(FIRST_REGION_ID).store.store_id
+        nem.crash(leader_sid)
+        for _ in range(20):
+            c.tick()
+        c.must_put(b"post-crash", b"alive")
+        acked[b"post-crash"] = b"alive"
+        nem.heal()
+
+        # convergence: every acknowledged write on every store
+        for _ in range(80):
+            c.tick()
+        for k, v in acked.items():
+            assert c.must_get(k) == v, k
+            for sid in c.stores:
+                assert c.get_on_store(sid, k) == v, (sid, k)
+        assert nem.stats["dropped"] > 0 and nem.stats["delivered_late"] > 0
+    finally:
+        nem.heal()
+        nem.close()
+
+
+def test_chaos_replay_is_deterministic():
+    """Same seed → identical injection decisions AND identical schedule
+    composition; a different seed diverges."""
+    def run(seed):
+        c = Cluster(3)
+        c.run()
+        nem = Nemesis(c, seed=seed)
+        nem.drop(rate=0.3)
+        nem.delay(1, 2, rate=0.5)
+        try:
+            for i in range(8):
+                c.must_put(b"d%d" % i, b"v")
+                c.tick()
+            return dict(nem.stats), nem.random_steps(6)
+        finally:
+            nem.heal()
+            nem.close()
+
+    a, b = run(99), run(99)
+    assert a == b
+    assert run(100)[1] != a[1]
+
+
+def test_disk_stall_failpoint_wedges_then_heals():
+    """disk_stall wedges the apply path through apply_before_exec; heal
+    lifts it and the stalled write completes (nothing lost)."""
+    import threading
+
+    c = Cluster(1)
+    c.run()
+    nem = Nemesis(c, seed=0)
+    try:
+        nem.disk_stall()  # hard pause until heal
+        done = threading.Event()
+
+        def writer():
+            c.must_put(b"stalled", b"w")
+            done.set()
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        assert not done.wait(0.3), "write completed through a stalled disk"
+        nem.heal()
+        # pump from THIS thread until the parked writer's proposal applies
+        deadline = time.monotonic() + 10
+        while not done.is_set() and time.monotonic() < deadline:
+            c.tick()
+            time.sleep(0.01)
+        assert done.is_set()
+        assert c.must_get(b"stalled") == b"w"
+    finally:
+        nem.heal()
+        nem.close()
+
+
+# ---------------------------------------------------------------------------
+# warm reads vs CPU oracle under chaos (the PR-4 gap repair, under faults)
+# ---------------------------------------------------------------------------
+
+def _seed_rows(kv, region_id, n=32):
+    wb = WriteBatch()
+    for i in range(n):
+        k = Key.from_raw(record_key(TABLE_ID, i))
+        w = Write(WriteType.PUT, 90,
+                  short_value=encode_row(NON_HANDLE, [b"apple", i % 23, 100 + i]))
+        wb.put_cf(CF_WRITE, k.append_ts(100).encoded, w.to_bytes())
+    kv.write({"region_id": region_id}, wb)
+
+
+def _commit_rows(kv, region_id, rows, ts0):
+    from tikv_tpu.storage.txn.commands import Commit, Prewrite
+    from tikv_tpu.storage.txn.scheduler import Scheduler
+    from tikv_tpu.storage.txn_types import Mutation
+
+    sched = Scheduler(kv, pool_size=1, group_commit_max=16)
+    ctx = {"region_id": region_id}
+    try:
+        for i, (handle, row) in enumerate(rows):
+            rk = record_key(TABLE_ID, handle)
+            t = sched.submit(Prewrite(
+                [Mutation.put(Key.from_raw(rk), row)], rk, start_ts=ts0 + i), ctx)
+            assert t.done.wait(30) and t.exc is None, t.exc
+            t = sched.submit(Commit(
+                [Key.from_raw(rk)], ts0 + i, ts0 + 500 + i), ctx)
+            assert t.done.wait(30) and t.exc is None, t.exc
+    finally:
+        sched.stop()
+    return ts0 + 500 + len(rows)
+
+
+def _scan_dag():
+    return DagRequest(executors=[TableScan(TABLE_ID, PRODUCT_COLUMNS), Limit(1 << 20)])
+
+
+def _rreq(dag, ts, region_id):
+    return CoprRequest(103, dag, [record_range(TABLE_ID)], ts,
+                       context={"region_id": region_id})
+
+
+def test_warm_reads_byte_identical_after_chaos_heal():
+    """Txn writes land through raft while the nemesis drops/delays/reorders
+    replication — after heal, warm region-cache serving matches the CPU
+    pipeline byte for byte, INCLUDING a chaos-forced write-through gap
+    (apply_emit_write_delta fault → wt_lost → scan_delta repair)."""
+    c = Cluster(3)
+    c.run()
+    kv = c.raftkv(1)
+    rid = FIRST_REGION_ID
+    _seed_rows(kv, rid)
+    warm = Endpoint(kv, enable_device=True)
+    cold = Endpoint(kv, enable_device=False)
+    nem = Nemesis(c, seed=77)
+    try:
+        r0 = warm.handle_request(_rreq(_scan_dag(), 200, rid))
+        assert r0.data == cold.handle_request(_rreq(_scan_dag(), 200, rid)).data
+
+        # delay/dup/reorder only: these faults stall and scramble delivery
+        # but still deliver eventually through the pump (the txn scheduler's
+        # worker is the only thread driving raft here — drop-faults need
+        # tick-driven retransmits, which phase 2 of the smoke test covers)
+        nem.delay(1, 2, rate=0.4)
+        nem.duplicate(rate=0.3)
+        nem.reorder(window=3)
+        hi = _commit_rows(kv, rid, [
+            (3, encode_row(NON_HANDLE, [b"banana", 3, 3])),
+            (40, encode_row(NON_HANDLE, [b"cherry", 4, 4])),
+        ], ts0=300)
+        # chaos also gaps the write-through chain mid-sequence: the next
+        # notify is lost, forcing the watermark repair path under real faults
+        failpoint.cfg("apply_emit_write_delta", "1*return")
+        hi = _commit_rows(kv, rid, [
+            (41, encode_row(NON_HANDLE, [b"durian", 5, 5])),
+        ], ts0=2000)
+        failpoint.remove("apply_emit_write_delta")
+        nem.heal()
+
+        r1 = warm.handle_request(_rreq(_scan_dag(), hi + 10, rid))
+        assert warm.region_cache.stats.wt_lost >= 1, \
+            "the injected emission gap must register as wt_lost"
+        assert r1.data == cold.handle_request(_rreq(_scan_dag(), hi + 10, rid)).data
+        # post-repair, write-through resumes and stays byte-identical
+        hi2 = _commit_rows(kv, rid, [
+            (42, encode_row(NON_HANDLE, [b"elder", 6, 6])),
+        ], ts0=4000)
+        r2 = warm.handle_request(_rreq(_scan_dag(), hi2 + 10, rid))
+        assert r2.data == cold.handle_request(_rreq(_scan_dag(), hi2 + 10, rid)).data
+    finally:
+        nem.heal()
+        nem.close()
+
+
+# ---------------------------------------------------------------------------
+# deadline propagation: expired work is shed, counted, never dispatched
+# ---------------------------------------------------------------------------
+
+COLS = PRODUCT_COLUMNS
+
+
+def _local_endpoint(n=64):
+    eng = BTreeEngine()
+    for i in range(n):
+        put_committed(eng, record_key(TABLE_ID, i),
+                      encode_row(NON_HANDLE, [b"apple", i % 23, 100 + i]), 90, 100)
+    return Endpoint(LocalEngine(eng), enable_device=True)
+
+
+def _agg_req(ts=200, deadline=None, region=1):
+    dag = DagRequest(executors=[
+        TableScan(TABLE_ID, COLS),
+        Aggregation([], [AggDescriptor("count", None)]),
+    ])
+    ctx = {"region_id": region, "region_epoch": (1, 1), "apply_index": 7}
+    if deadline is not None:
+        ctx["deadline"] = deadline
+    return CoprRequest(103, dag, [record_range(TABLE_ID)], ts, context=ctx)
+
+
+def test_deadline_expired_request_shed_counted_never_dispatched():
+    ep = _local_endpoint()
+    ep.handle_request(_agg_req())  # warm the plan + image
+    expired = [_agg_req(deadline=time.monotonic() - 0.5) for _ in range(3)]
+    shed_c = REGISTRY.counter("tikv_coprocessor_deadline_expired_total")
+    batches = REGISTRY.counter("tikv_coprocessor_sched_batches_total")
+    reqs_c = REGISTRY.counter("tikv_coprocessor_request_total")
+    before = shed_c.get(at="dispatch")
+    b_before = sum(batches._values.values())
+    r_before = sum(reqs_c._values.values())
+    with pytest.raises(DeadlineExceeded):
+        ep.handle_batch(expired)
+    assert shed_c.get(at="dispatch") == before + 3
+    assert sum(batches._values.values()) == b_before, \
+        "expired work must never form a device batch"
+    assert sum(reqs_c._values.values()) == r_before, \
+        "expired work must never be served at all"
+
+
+def test_deadline_live_requests_still_serve_and_mixed_batches_isolate():
+    """A live deadline serves normally; in a mixed batch only the expired
+    member errors (per-slot isolation through the scheduler)."""
+    ep = _local_endpoint()
+    cpu = Endpoint(LocalEngine(ep.engine.kv), enable_device=False)
+    want = cpu.handle_request(_agg_req()).data
+    r = ep.handle_request(_agg_req(deadline=time.monotonic() + 30))
+    assert r.data == want
+
+    from tikv_tpu.copr.scheduler import _Item
+    from tikv_tpu.util.retry import deadline_from_context
+
+    reqs = [_agg_req(deadline=time.monotonic() + 30),
+            _agg_req(deadline=time.monotonic() - 1),
+            _agg_req()]
+    items = [_Item(req=q, index=i, deadline=deadline_from_context(q.context))
+             for i, q in enumerate(reqs)]
+    results, errors = ep.scheduler._serve(items)
+    assert results[0] is not None and results[0].data == want
+    assert isinstance(errors[1], DeadlineExceeded) and results[1] is None
+    assert results[2] is not None and results[2].data == want
+
+
+def test_batch_with_expired_rider_keeps_sibling_responses():
+    """One expired rider must not poison the batch: siblings keep their
+    computed responses (no whole-batch per-slot re-serve), the expired slot
+    reports DeadlineExceeded and is never dispatched."""
+    ep = _local_endpoint()
+    cpu = Endpoint(LocalEngine(ep.engine.kv), enable_device=False)
+    want = cpu.handle_request(_agg_req()).data
+    ep.handle_request(_agg_req())  # warm the plan + image
+    reqs = [_agg_req(deadline=time.monotonic() + 30),
+            _agg_req(deadline=time.monotonic() - 1),
+            _agg_req()]
+    reqs_c = REGISTRY.counter("tikv_coprocessor_request_total")
+    r_before = sum(reqs_c._values.values())
+    results, errors = ep.handle_batch_errors(reqs)
+    assert errors[0] is None and results[0].data == want
+    assert isinstance(errors[1], DeadlineExceeded) and results[1] is None
+    assert errors[2] is None and results[2].data == want
+    # each live rider was served exactly once; a poisoned batch (whole-batch
+    # per-slot re-serve) would re-run them, and the expired slot must never
+    # be served at all
+    assert sum(reqs_c._values.values()) == r_before + 2
+
+
+def test_scheduler_execute_sheds_expired_on_admission():
+    ep = _local_endpoint()
+    ep.scheduler.start()
+    try:
+        with pytest.raises(DeadlineExceeded):
+            ep.scheduler.execute(_agg_req(deadline=time.monotonic() - 1))
+        r = ep.scheduler.execute(_agg_req(deadline=time.monotonic() + 30))
+        assert r.data  # live deadline still serves
+    finally:
+        ep.scheduler.stop()
+
+
+def test_busy_reject_carries_retry_after_honored_by_policy():
+    """Queue-full admission with busy_reject raises ServerIsBusy with a
+    retry-after hint; the shared retry policy sleeps at least that long."""
+    ep = _local_endpoint()
+    ep.scheduler.cfg = SchedulerConfig(max_queue=0, busy_reject=True,
+                                       busy_retry_after_s=0.2)
+    ep.scheduler.start()
+    try:
+        shed = REGISTRY.counter("tikv_coprocessor_sched_shed_total")
+        busy_before = shed.get(reason="busy_reject")
+        direct_before = shed.get(reason="queue_full")
+        with pytest.raises(ServerBusyError) as ei:
+            ep.scheduler.execute(_agg_req())
+        assert ei.value.retry_after_s == pytest.approx(0.2)
+        # a rejection is neither served nor direct: its own shed reason,
+        # NOT queue_full (which means "served on the caller's thread")
+        assert shed.get(reason="busy_reject") == busy_before + 1
+        assert shed.get(reason="queue_full") == direct_before
+
+        slept = []
+        attempts = [0]
+
+        def submit():
+            attempts[0] += 1
+            if attempts[0] == 1:
+                return ep.scheduler.execute(_agg_req())
+            # capacity came back (queue un-capped) on the retry
+            ep.scheduler.cfg = SchedulerConfig()
+            return ep.scheduler.execute(_agg_req())
+
+        r = retry.call(submit, site="test.busy", sleep=slept.append)
+        assert r.data
+        assert slept and slept[0] >= 0.2, "retry-after hint must be honored"
+    finally:
+        ep.scheduler.stop()
+
+
+# ---------------------------------------------------------------------------
+# device-path circuit breaker
+# ---------------------------------------------------------------------------
+
+def test_breaker_trips_unary_to_cpu_and_restores_via_probe(monkeypatch):
+    clk = [1000.0]
+    breaker = DeviceCircuitBreaker(
+        BreakerConfig(threshold=2, cooldown_s=5.0), clock=lambda: clk[0])
+    eng = BTreeEngine()
+    for i in range(32):
+        put_committed(eng, record_key(TABLE_ID, i),
+                      encode_row(NON_HANDLE, [b"apple", i % 23, 100 + i]), 90, 100)
+    ep = Endpoint(LocalEngine(eng), enable_device=True, breaker=breaker)
+    cpu = Endpoint(LocalEngine(eng), enable_device=False)
+    want = cpu.handle_request(_agg_req()).data
+
+    ev_c = REGISTRY.counter("tikv_coprocessor_breaker_event_total")
+    fb_c = REGISTRY.counter("tikv_coprocessor_path_fallback_total")
+    trips0 = ev_c.get(path="unary", event="trip")
+    probes0 = ev_c.get(path="unary", event="probe")
+    restores0 = ev_c.get(path="unary", event="restore")
+
+    import tikv_tpu.copr.jax_eval as je
+
+    real_run = je.JaxDagEvaluator.run
+
+    def boom(self, *a, **k):
+        raise RuntimeError("injected device fault")
+
+    monkeypatch.setattr(je.JaxDagEvaluator, "run", boom)
+    # two consecutive faults trip the path (each still served by CPU)
+    for _ in range(2):
+        r = ep.handle_request(_agg_req())
+        assert not r.from_device and r.data == want
+    assert breaker.state_of("unary") == "open"
+    assert ev_c.get(path="unary", event="trip") == trips0 + 1
+
+    # while open: CPU serves WITHOUT touching the (still broken) device
+    open_before = fb_c.get(path="unary", cause="breaker_open")
+    r = ep.handle_request(_agg_req())
+    assert not r.from_device and r.data == want
+    assert fb_c.get(path="unary", cause="breaker_open") == open_before + 1
+
+    # device "repaired"; cooldown elapses; the half-open probe restores
+    monkeypatch.setattr(je.JaxDagEvaluator, "run", real_run)
+    clk[0] += 10.0
+    r = ep.handle_request(_agg_req())
+    assert r.from_device and r.data == want
+    assert breaker.state_of("unary") == "closed"
+    assert ev_c.get(path="unary", event="probe") == probes0 + 1
+    assert ev_c.get(path="unary", event="restore") == restores0 + 1
+
+
+def test_breaker_failed_probe_reopens_with_longer_cooldown(monkeypatch):
+    clk = [0.0]
+    b = DeviceCircuitBreaker(
+        BreakerConfig(threshold=1, cooldown_s=2.0, cooldown_multiplier=2.0),
+        clock=lambda: clk[0])
+    b.record_failure("x")               # trip #1: cooldown 2s
+    assert not b.allow("x")
+    clk[0] = 2.5
+    assert b.allow("x")                 # half-open probe admitted
+    assert not b.allow("x")             # ...exactly one
+    b.record_failure("x")               # probe fails: trip #2, cooldown 4s
+    clk[0] = 5.0
+    assert not b.allow("x"), "cooldown must have doubled"
+    clk[0] = 7.0
+    assert b.allow("x")
+    b.record_success("x")
+    assert b.state_of("x") == "closed"
+    assert b.allow("x") and b.allow("x"), "closed path admits everyone"
+
+
+def test_breaker_trips_xregion_batches_to_per_request(monkeypatch):
+    """Repeated cross-region launch faults trip the xregion path: batches
+    shed to per-request serving (bytes still correct), and the breaker
+    holds the path open."""
+    ep = _local_endpoint()
+    ep.breaker = DeviceCircuitBreaker(BreakerConfig(threshold=2, cooldown_s=60.0))
+    cpu = Endpoint(LocalEngine(ep.engine.kv), enable_device=False)
+    # two regions, same plan → xregion batch shape
+    def reqs():
+        return [_agg_req(region=1), _agg_req(region=2)]
+
+    want = [cpu.handle_request(q).data for q in reqs()]
+    ep.handle_batch(reqs())  # warm the images so xregion actually launches
+
+    import tikv_tpu.copr.jax_eval as je
+
+    def boom(*a, **k):
+        raise RuntimeError("injected xregion fault")
+
+    monkeypatch.setattr(je, "launch_xregion_cached", boom)
+    for _ in range(2):
+        got = ep.handle_batch(reqs())
+        assert [g.data for g in got] == want  # per-request fallback serves
+    assert ep.breaker.state_of("xregion") == "open"
+    shed_c = REGISTRY.counter("tikv_coprocessor_sched_shed_total")
+    before = shed_c.get(reason="breaker_open")
+    got = ep.handle_batch(reqs())
+    assert [g.data for g in got] == want
+    assert shed_c.get(reason="breaker_open") >= before + 1, \
+        "an open breaker sheds the batch before launching"
+
+
+def test_zone_real_arg_decline_counted_per_cause():
+    """The VERDICT-weak-#6 case: a REAL aggregate argument declines the
+    zone path — now visible as path_fallback{path=zone, cause=real_arg}."""
+    from tikv_tpu.copr.rpn import col
+
+    eng = BTreeEngine()
+    for i in range(32):
+        put_committed(eng, record_key(TABLE_ID, i),
+                      encode_row(NON_HANDLE, [b"apple", i % 23, 100 + i]), 90, 100)
+    ep = Endpoint(LocalEngine(eng), enable_device=True)
+    # an explicit REAL aggregate argument: sum(cast_int_real(count)) — the
+    # device path takes it, the zone path must decline (float sum order)
+    from tikv_tpu.copr.rpn import call as rcall
+
+    dag = DagRequest(executors=[
+        TableScan(TABLE_ID, COLS),
+        Aggregation([col(1)], [AggDescriptor("sum", rcall("cast_int_real", col(2)))]),
+    ])
+    req = CoprRequest(103, dag, [record_range(TABLE_ID)], 200,
+                      context={"region_id": 1, "region_epoch": (1, 1), "apply_index": 7})
+    c = REGISTRY.counter("tikv_coprocessor_path_fallback_total")
+    before = c.get(path="zone", cause="real_arg")
+    ep.handle_request(req)  # warm fill
+    ep.handle_request(req)  # warm serve: zone probe runs and declines
+    assert c.get(path="zone", cause="real_arg") >= before + 1
+
+
+# ---------------------------------------------------------------------------
+# full nemesis schedules (slow: real sockets, wall-clock pacing)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_full_random_schedule_over_sockets():
+    """Seeded random nemesis schedule over the networked ServerCluster:
+    acked writes survive every step and the cluster converges post-heal."""
+    from tikv_tpu.pd.client import MockPd
+    from tikv_tpu.server.cluster import ServerCluster
+
+    c = ServerCluster(3, pd=MockPd())
+    c.run()
+    nem = Nemesis(c, seed=2024)
+    acked = {}
+    try:
+        steps = nem.random_steps(6)
+        for si, (op, kw) in enumerate(steps):
+            fault = nem.apply_step(op, kw)
+            for i in range(3):
+                k = b"s%d-%d" % (si, i)
+                try:
+                    c.must_put(k, b"v", timeout=20.0)
+                    acked[k] = b"v"
+                except Exception:
+                    pass  # unacked writes carry no guarantee
+            time.sleep(0.2)
+            if fault is not None:
+                nem.remove(fault)
+            # crash_restart steps toggle; make sure a crashed node returns
+        nem.heal()
+        time.sleep(0.5)
+        for k, v in acked.items():
+            assert c.must_get(k, timeout=20.0) == v, k
+        for k, v in acked.items():
+            for sid in c.nodes:
+                c.wait_get_on_store(sid, k, v, timeout=20.0)
+    finally:
+        nem.heal()
+        nem.close()
+        c.shutdown()
+
+
+@pytest.mark.slow
+def test_asymmetric_partition_over_sockets():
+    """The half-open link: leader's outbound cut while inbound flows — the
+    majority side recovers leadership and no acked write is lost."""
+    from tikv_tpu.pd.client import MockPd
+    from tikv_tpu.server.cluster import ServerCluster
+
+    c = ServerCluster(3, pd=MockPd())
+    c.run()
+    nem = Nemesis(c, seed=5)
+    try:
+        c.must_put(b"pre", b"1")
+        sid = c.wait_leader(FIRST_REGION_ID).store.store_id
+        others = [s for s in c.nodes if s != sid]
+        nem.partition({sid}, others, symmetric=False)
+        time.sleep(1.0)
+        c.must_put(b"during", b"2", timeout=20.0)
+        nem.heal()
+        for s in c.nodes:
+            c.wait_get_on_store(s, b"during", b"2", timeout=20.0)
+        assert c.must_get(b"pre", timeout=20.0) == b"1"
+    finally:
+        nem.heal()
+        nem.close()
+        c.shutdown()

@@ -92,6 +92,58 @@ def test_pause_failpoint_creates_race_window():
     t.join()
 
 
+def test_pause_wakes_on_notify_not_poll():
+    """A paused thread parks on the condition and wakes on the cfg/teardown
+    notify — release latency is notification-bound, not 10ms-poll-bound.
+
+    One release under a 10ms poll still wakes within ~10ms, so a single
+    sample cannot tell the implementations apart; 20 park/release cycles
+    can: polling costs ~5ms expected latency per cycle (~100ms total, up
+    to 200ms), notify wakes each cycle in well under a millisecond.  The
+    60ms budget below fails the polling implementation with huge margin
+    while leaving notify-wake ~10x headroom for scheduler noise."""
+    import time
+
+    total = 0.0
+    for i in range(20):
+        name = f"wake{i}"
+        cfg(name, "pause")
+        entered = threading.Event()
+        woke_at = []
+
+        def parked():
+            entered.set()
+            fail_point(name)
+            woke_at.append(time.monotonic())
+
+        t = threading.Thread(target=parked)
+        t.start()
+        assert entered.wait(2)
+        time.sleep(0.005)  # let the thread actually park inside the wait
+        released_at = time.monotonic()
+        failpoint.remove(name)
+        t.join(2)
+        assert not t.is_alive()
+        assert woke_at
+        total += woke_at[0] - released_at
+    assert total < 0.06, f"pause release latency poll-bound: {total:.3f}s/20"
+
+
+def test_list_active_shows_remaining_counts():
+    """Counted actions render their REMAINING budget so a test mid-schedule
+    can see how far the injection has progressed."""
+    cfg("cnt", "3*return")
+    assert failpoint.list_active() == {"cnt": "3*return"}
+    with pytest.raises(FailpointError):
+        fail_point("cnt")
+    assert failpoint.list_active() == {"cnt": "2*return"}
+    with pytest.raises(FailpointError):
+        fail_point("cnt")
+    with pytest.raises(FailpointError):
+        fail_point("cnt")
+    assert failpoint.list_active() == {}  # budget exhausted: point removed
+
+
 def test_coprocessor_failpoint_over_endpoint():
     import sys, os
 

@@ -35,6 +35,12 @@ LAZY_SERIES = {
     "tikv_coprocessor_sched_device_occupancy",
     "tikv_coprocessor_sharded_merge_seconds",
     "tikv_coprocessor_mesh_cache_hit_total",
+    "tikv_coprocessor_path_fallback_total",
+    "tikv_coprocessor_breaker_event_total",
+    "tikv_coprocessor_breaker_state",
+    "tikv_coprocessor_deadline_expired_total",
+    "tikv_chaos_injected_total",
+    "tikv_client_retry_total",
     "tikv_coprocessor_region_cache_total",
     "tikv_coprocessor_region_cache_wt_lost_total",
     "tikv_coprocessor_region_cache_device_bytes",

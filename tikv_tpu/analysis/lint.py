@@ -84,11 +84,14 @@ _SANITIZER_WIRED = {
     "tikv_tpu/storage/txn/latches.py",
     "tikv_tpu/storage/txn/scheduler.py",
     "tikv_tpu/storage/concurrency_manager.py",
+    "tikv_tpu/copr/breaker.py",
     "tikv_tpu/copr/region_cache.py",
     "tikv_tpu/copr/scheduler.py",
     "tikv_tpu/raft/store.py",
     "tikv_tpu/raft/batch_system.py",
     "tikv_tpu/raft/fsm_system.py",
+    "tikv_tpu/util/chaos.py",
+    "tikv_tpu/util/retry.py",
     "tikv_tpu/util/worker.py",
 }
 

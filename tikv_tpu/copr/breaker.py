@@ -1,0 +1,160 @@
+"""Device-path circuit breaker: trip a faulting serving path to its fallback.
+
+The coprocessor has five serving paths — zone full-tile, fused batch,
+cross-region (``xregion``), mesh-sharded, and the per-request unary device
+path — each with a slower-but-always-correct fallback (generic warm path,
+per-request serving, single-device launch, CPU pipeline).  A single device
+fault already falls back per request; what that does NOT protect against is
+a *persistently* wedged path (bad driver state, a compiler regression on one
+program shape, a flaky interconnect) re-paying the failure latency on every
+request forever.
+
+Classic breaker states per path (docs/robustness.md):
+
+* **closed** — healthy; failures below the threshold just count.
+* **open** — ``threshold`` consecutive failures tripped the path: every
+  ``allow()`` is refused (callers take their fallback immediately) until the
+  cooldown elapses.  Repeated trips grow the cooldown exponentially up to a
+  ceiling.
+* **half-open** — cooldown elapsed: exactly ONE caller is admitted as a
+  probe.  Success restores the path (closed, counters reset); failure
+  re-opens with a longer cooldown.
+
+Metrics: ``tikv_coprocessor_breaker_event_total{path,event}`` with
+``event ∈ {trip, probe, restore}`` and the state gauge
+``tikv_coprocessor_breaker_state{path}`` (0 closed / 1 open / 2 half-open).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ..analysis.sanitizer import make_lock
+
+PATHS = ("unary", "zone", "fused", "xregion", "mesh")
+
+_STATE_VALUE = {"closed": 0, "open": 1, "half_open": 2}
+
+
+@dataclass
+class _PathState:
+    state: str = "closed"
+    failures: int = 0       # consecutive failures while closed
+    trips: int = 0          # consecutive trips (drives cooldown growth)
+    open_until: float = 0.0
+    probing: bool = False   # a half-open probe is in flight
+
+
+@dataclass(frozen=True)
+class BreakerConfig:
+    threshold: int = 3          # consecutive failures that trip a path
+    cooldown_s: float = 5.0     # first-trip cooldown
+    cooldown_multiplier: float = 2.0
+    max_cooldown_s: float = 60.0
+
+
+class DeviceCircuitBreaker:
+    """Thread-safe per-path breaker shared by the endpoint, the read
+    scheduler, and the zone evaluator.  ``clock`` is injectable for tests."""
+
+    def __init__(self, config: BreakerConfig | None = None, clock=time.monotonic):
+        self.cfg = config or BreakerConfig()
+        self.clock = clock
+        self._mu = make_lock("copr.breaker")
+        self._paths: dict[str, _PathState] = {}
+
+    def _st(self, path: str) -> _PathState:
+        st = self._paths.get(path)
+        if st is None:
+            st = self._paths[path] = _PathState()
+        return st
+
+    def allow(self, path: str) -> bool:
+        """May this path serve now?  False = take the fallback.  When an
+        open path's cooldown has elapsed, the FIRST caller through becomes
+        the half-open probe (exactly one in flight)."""
+        with self._mu:
+            st = self._st(path)
+            if st.state == "closed":
+                return True
+            if st.state == "open" and self.clock() >= st.open_until:
+                st.state = "half_open"
+                self._gauge(path, st)
+            if st.state == "half_open" and not st.probing:
+                st.probing = True
+                self._event(path, "probe")
+                return True
+            return False
+
+    def record_success(self, path: str) -> None:
+        with self._mu:
+            st = self._st(path)
+            if st.state != "closed":
+                self._event(path, "restore")
+            st.state = "closed"
+            st.failures = 0
+            st.trips = 0
+            st.probing = False
+            self._gauge(path, st)
+
+    def release_probe(self, path: str) -> None:
+        """The admitted caller neither succeeded nor failed (a documented
+        decline took its fallback before the path actually ran): free the
+        half-open probe slot so the next caller can probe.  No-op when the
+        path is closed."""
+        with self._mu:
+            self._st(path).probing = False
+
+    def record_failure(self, path: str) -> None:
+        with self._mu:
+            st = self._st(path)
+            if st.state == "half_open":
+                # the probe failed: straight back to open, longer cooldown
+                st.probing = False
+                self._trip(path, st)
+                return
+            if st.state == "open":
+                return  # late failure from a pre-trip launch: already open
+            st.failures += 1
+            if st.failures >= self.cfg.threshold:
+                self._trip(path, st)
+            else:
+                self._gauge(path, st)
+
+    def state_of(self, path: str) -> str:
+        with self._mu:
+            st = self._st(path)
+            if st.state == "open" and self.clock() >= st.open_until:
+                return "half_open"
+            return st.state
+
+    def _trip(self, path: str, st: _PathState) -> None:
+        st.trips += 1
+        cooldown = min(
+            self.cfg.cooldown_s * (self.cfg.cooldown_multiplier ** (st.trips - 1)),
+            self.cfg.max_cooldown_s,
+        )
+        st.state = "open"
+        st.open_until = self.clock() + cooldown
+        st.failures = 0
+        self._event(path, "trip")
+        self._gauge(path, st)
+
+    # -- metrics (called under _mu: REGISTRY ops are lock-free-ish counters)
+
+    def _event(self, path: str, event: str) -> None:
+        from ..util.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "tikv_coprocessor_breaker_event_total",
+            "Device-path circuit breaker transitions, by path and event",
+        ).inc(path=path, event=event)
+
+    def _gauge(self, path: str, st: _PathState) -> None:
+        from ..util.metrics import REGISTRY
+
+        REGISTRY.gauge(
+            "tikv_coprocessor_breaker_state",
+            "Breaker state per device path (0 closed / 1 open / 2 half-open)",
+        ).set(_STATE_VALUE[st.state], path=path)

@@ -63,7 +63,10 @@ class Endpoint:
         block_rows: int | None = None,
         shard_cache: bool = True,
         write_through: bool = True,
+        breaker=None,
+        breaker_config=None,
     ):
+        from .breaker import DeviceCircuitBreaker
         from .tracker import SlowLog
 
         self.engine = engine
@@ -119,6 +122,12 @@ class Endpoint:
         # broken device shows up here instead of only as from_device=False
         self.device_fallbacks = 0
         self.last_device_error: str | None = None
+        # device-path circuit breaker (docs/robustness.md): repeated faults
+        # on a serving path (unary/zone/fused/xregion/mesh) trip THAT path
+        # to its fallback for a cooldown, with half-open probes — one flaky
+        # path stops re-paying its failure latency on every request.  The
+        # scheduler and the zone evaluator consult the same instance.
+        self.breaker = breaker or DeviceCircuitBreaker(breaker_config)
         # unified read scheduler (scheduler.py): cross-region continuous
         # batching over the region column cache.  handle_batch always routes
         # through it; start() turns on the continuous unary lanes.
@@ -132,6 +141,19 @@ class Endpoint:
         import time as _time
 
         from ..util.metrics import REGISTRY
+        from ..util.retry import DeadlineExceeded, deadline_from_context
+
+        # shed expired work at the LAST entry gate: every fallback route
+        # (scheduler direct serve, per-slot batch re-serve, scheduler-off
+        # unary service) funnels through here, so an expired request can
+        # never reach a snapshot or a device dispatch
+        dl = deadline_from_context(req.context)
+        if dl is not None and _time.monotonic() >= dl:
+            REGISTRY.counter(
+                "tikv_coprocessor_deadline_expired_total",
+                "Requests shed because their deadline expired, by detection point",
+            ).inc(at="endpoint")
+            raise DeadlineExceeded("deadline expired before serving")
 
         t0 = _time.perf_counter()
         resp = self._handle_request_inner(req)
@@ -171,6 +193,13 @@ class Endpoint:
         snap = self.engine.snapshot(req.context or None)
         tracker.on_snapshot_finished()
         use_device = self.device_enabled() and jax_eval.supports(req.dag)
+        if use_device and not self.breaker.allow("unary"):
+            # tripped: repeated unary device faults — serve straight off the
+            # CPU pipeline until a half-open probe restores the path
+            from .tracker import count_path_fallback
+
+            count_path_fallback("unary", "breaker_open")
+            use_device = False
         if use_device:
             cache = None
             try:
@@ -201,6 +230,7 @@ class Endpoint:
                 self.slow_log.observe(tracker)
                 from_cache = (cache is not None and cache.filled and src is None
                               and rc_outcome not in ("miss", "too_big"))
+                self.breaker.record_success("unary")
                 return CoprResponse(
                     resp.encode(), from_device=True,
                     from_cache=from_cache,
@@ -217,8 +247,12 @@ class Endpoint:
                     cache.blocks.clear()
                 self.device_fallbacks += 1
                 self.last_device_error = repr(exc)
+                self.breaker.record_failure("unary")
                 from ..util.metrics import REGISTRY
 
+                from .tracker import count_path_fallback
+
+                count_path_fallback("unary", "device_error")
                 REGISTRY.counter(
                     "tikv_coprocessor_device_fallback_total",
                     "Device-path failures that re-ran on the CPU pipeline",
@@ -340,6 +374,28 @@ class Endpoint:
             return self.scheduler.run_batch(reqs)
         return [self.handle_request(r) for r in reqs]
 
+    def handle_batch_errors(
+        self, reqs: list[CoprRequest]
+    ) -> tuple[list["CoprResponse | None"], list[BaseException | None]]:
+        """``handle_batch`` with per-slot error isolation: returns parallel
+        (results, errors) lists instead of raising on the first bad slot, so
+        the service layer keeps every computed response when one rider's
+        deadline expires in the queue (re-serving the whole batch would
+        double the device work the shed was meant to save)."""
+        if len(reqs) >= 2 and self.device_enabled() and self._gate_ok("batch"):
+            from ..util.failpoint import fail_point
+
+            fail_point("coprocessor_parse_request")
+            return self.scheduler.run_batch(reqs, return_errors=True)
+        results: list[CoprResponse | None] = [None] * len(reqs)
+        errors: list[BaseException | None] = [None] * len(reqs)
+        for i, r in enumerate(reqs):
+            try:
+                results[i] = self.handle_request(r)
+            except Exception as e:  # noqa: BLE001 — per-slot isolation
+                errors[i] = e
+        return results, errors
+
     def _evaluator_for(self, dag: DagRequest) -> "jax_eval.JaxDagEvaluator":
         """Reuse compiled evaluators across requests, keyed by plan bytes
         (each holds its jit caches — recompiling per request throws away the
@@ -351,9 +407,10 @@ class Endpoint:
         ev = self._evaluators.get(key)
         if ev is None:
             if self.block_rows is not None:
-                ev = jax_eval.JaxDagEvaluator(dag, block_rows=self.block_rows)
+                ev = jax_eval.JaxDagEvaluator(dag, block_rows=self.block_rows,
+                                              breaker=self.breaker)
             else:
-                ev = jax_eval.JaxDagEvaluator(dag)
+                ev = jax_eval.JaxDagEvaluator(dag, breaker=self.breaker)
             self._evaluators[key] = ev
             while len(self._evaluators) > 64:
                 self._evaluators.pop(next(iter(self._evaluators)))
@@ -382,15 +439,22 @@ class Endpoint:
         Returns the SelectResponse, or None on a documented decline — an
         aggregate with no mesh merge rule, unstable group dictionaries —
         which serves per-request on the single-device warm path.  Real
-        device failures propagate to the CPU-fallback handler like every
-        other device error."""
+        device failures count against the MESH breaker path and decline to
+        the single-device warm path (which can still serve the bytes) —
+        tripping every unary request to CPU for one bad collective would
+        throw away a working single-device fallback."""
         from ..parallel.mesh import mesh_mergeable
         from ..util.metrics import REGISTRY
         from . import jax_eval as _je
+        from .tracker import count_path_fallback
 
         if not self.shard_cache:
             return None
         if ev.plan.agg is None or not mesh_mergeable(ev.device_aggs):
+            count_path_fallback("mesh", "no_merge_rule")
+            return None
+        if not self.breaker.allow("mesh"):
+            count_path_fallback("mesh", "breaker_open")
             return None
         # A single-owner image still routes here on purpose: SPMD means the
         # other devices scan only zero-pad slabs (same wall time as the
@@ -401,7 +465,17 @@ class Endpoint:
             pending = _je.launch_xregion_sharded(ev, [cache], self.mesh)
             resp = pending.finalize()[0]
         except ValueError:
+            # documented decline (no merge rule surfaced late, empty blocks)
+            self.breaker.release_probe("mesh")
+            count_path_fallback("mesh", "ineligible")
             return None
+        except Exception as exc:  # noqa: BLE001 — single-device path serves
+            self.breaker.record_failure("mesh")
+            self.device_fallbacks += 1
+            self.last_device_error = repr(exc)
+            count_path_fallback("mesh", "device_error")
+            return None
+        self.breaker.record_success("mesh")
         REGISTRY.counter(
             "tikv_coprocessor_mesh_cache_hit_total",
             "Warm cached requests served mesh-sharded (replaces the PR-2 "

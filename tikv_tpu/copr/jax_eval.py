@@ -672,10 +672,15 @@ def _unpack_state(packed, state_template):
 class JaxDagEvaluator:
     """Run an eligible DAG over a scan source on the device."""
 
-    def __init__(self, dag: DagRequest, block_rows: int = DEFAULT_BLOCK_ROWS):
+    def __init__(self, dag: DagRequest, block_rows: int = DEFAULT_BLOCK_ROWS,
+                 breaker=None):
         self.dag = dag
         self.plan = _analyze(dag)
         self.block_rows = block_rows
+        # optional DeviceCircuitBreaker (copr/breaker.py): the zone path
+        # consults it before running and reports its outcome, so repeated
+        # zone faults trip to the generic warm path instead of re-crashing
+        self.breaker = breaker
         scan = self.plan.scan
         self.schema = [(c.ftype.eval_type, c.ftype.decimal) for c in scan.columns_info]
         self.decoder = (

@@ -382,24 +382,34 @@ class ZoneEvaluator:
     # -- eligibility -------------------------------------------------------
 
     def eligible(self, blocks):
+        from .tracker import count_path_fallback
+
         ev = self.ev
         if ev.plan.agg is None:
             return None
         stable = ev._stable_dict_group_cols(blocks)
         if stable is None:
+            count_path_fallback("zone", "unstable_group_dicts")
             return None
         group_cols, dicts = stable
         for da in ev.device_aggs:
             if da.op not in _ZONE_AGG_OPS:
+                count_path_fallback("zone", "agg_op")
                 return None
             if da.rpn is not None:
                 if da.rpn.eval_type == EvalType.REAL or da.input_type == EvalType.REAL:
-                    return None  # float sum order must match the CPU oracle
+                    # float sum order must match the CPU oracle — the
+                    # VERDICT-weak-#6 decline that used to be invisible
+                    count_path_fallback("zone", "real_arg")
+                    return None
                 for node in da.rpn.nodes:
                     if node.kind == "fn" and node.op not in _NULLSAFE_OPS:
+                        count_path_fallback("zone", "non_nullsafe_fn")
                         return None
                     if node.kind == "const" and node.value is None:
-                        return None  # NULL literal breaks the null-safety rule
+                        # NULL literal breaks the null-safety rule
+                        count_path_fallback("zone", "null_literal")
+                        return None
         return group_cols, dicts
 
     # -- per-query host classification -------------------------------------
@@ -637,15 +647,32 @@ class ZoneEvaluator:
         first run on a new accelerator) is caught, recorded, and remembered
         per cache: the fast layer must never take down a query the slower
         layers can serve, and must not retry a crash on every request."""
+        from .tracker import count_path_fallback
+
+        breaker = getattr(self.ev, "breaker", None)
+        if breaker is not None and not breaker.allow("zone"):
+            count_path_fallback("zone", "breaker_open")
+            return None
         try:
-            return self._try_run_inner(cache)
+            out = self._try_run_inner(cache)
+            if breaker is not None:
+                if out is not None:
+                    breaker.record_success("zone")
+                else:
+                    breaker.release_probe("zone")  # declined, didn't run
+            return out
         except Exception as exc:  # noqa: BLE001 — generic path always serves
             self.failed += 1
             self.last_error = repr(exc)
             self._declined.add(cache)
+            count_path_fallback("zone", "zone_error")
+            if breaker is not None:
+                breaker.record_failure("zone")
             return None
 
     def _try_run_inner(self, cache):
+        from .tracker import count_path_fallback
+
         ev = self.ev
         blocks = cache.blocks
         if cache in self._declined:
@@ -660,6 +687,7 @@ class ZoneEvaluator:
             # no conjunct classifiable → 100% partial tiles: don't pay for a
             # layout the fallback check would immediately discard
             self._declined.add(cache)
+            count_path_fallback("zone", "unclassifiable_selection")
             return None
         needed = self._referenced_cols()
         sort_col = None
@@ -672,6 +700,7 @@ class ZoneEvaluator:
         full, partial_idx = self._classify_tiles(layout)
         if layout.n_tiles and len(partial_idx) / layout.n_tiles > PARTIAL_FALLBACK:
             self._declined.add(cache)
+            count_path_fallback("zone", "partial_fraction")
             return None
         n_slots = layout.n_slots
         capacity = 1

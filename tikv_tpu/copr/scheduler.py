@@ -57,6 +57,7 @@ import time
 from dataclasses import dataclass, field
 
 from ..analysis.sanitizer import make_condition, make_lock
+from ..util.retry import DeadlineExceeded, ServerBusyError, deadline_from_context
 from . import jax_eval
 from .dag import (
     Aggregation,
@@ -85,6 +86,13 @@ class SchedulerConfig:
     max_wait_s: float = 0.004      # normal-lane linger before partial dispatch
     high_max_wait_s: float = 0.001
     low_max_wait_s: float = 0.02
+    # busy_reject=True turns queue-full admission into a ServerIsBusy-style
+    # REJECTION carrying a retry-after hint (honored by util.retry), instead
+    # of silently serving on the caller's thread — rejecting is the right
+    # call when the store is saturated: the direct path would add load
+    # exactly when there is none to spare
+    busy_reject: bool = False
+    busy_retry_after_s: float = 0.05
 
     def wait_for(self, lane: str) -> float:
         if lane == "high":
@@ -158,6 +166,9 @@ class _Item:
     ticket: "_Ticket | None" = None
     enqueue_t: float = 0.0
     sig: tuple | None = None  # plan signature, set once during grouping
+    # absolute monotonic deadline (context "deadline"/"timeout_ms", see
+    # util.retry.deadline_from_context); expired items shed BEFORE dispatch
+    deadline: float | None = None
 
 
 class _Ticket:
@@ -205,13 +216,22 @@ class CoprReadScheduler:
 
     # -- synchronous entry (endpoint.handle_batch / batch_coprocessor) -----
 
-    def run_batch(self, reqs: list[CoprRequest]) -> list[CoprResponse]:
-        items = [_Item(req=r, index=i, lane=_lane_of(r)) for i, r in enumerate(reqs)]
+    def run_batch(self, reqs: list[CoprRequest], *, return_errors: bool = False):
+        items = [
+            _Item(req=r, index=i, lane=_lane_of(r),
+                  deadline=deadline_from_context(r.context))
+            for i, r in enumerate(reqs)
+        ]
         results, errors = self._serve(items)
+        if return_errors:
+            # per-slot surface (service.coprocessor_batch): computed
+            # responses survive a sibling slot's failure — one expired
+            # deadline must not discard K-1 finished answers
+            return results, errors
         first = next((e for e in errors if e is not None), None)
         if first is not None:
             # the pre-scheduler handle_batch aborted on the first raising
-            # request; the service layer catches and re-serves per slot —
+            # request; callers of the raising surface re-serve per slot —
             # keep that contract for the synchronous surface
             raise first
         return results
@@ -247,13 +267,19 @@ class CoprReadScheduler:
         lane and wait for the batch that serves it.  Falls back to the
         direct path when the scheduler is stopped, the request is not
         batchable, or admission control sheds it."""
+        deadline = deadline_from_context(req.context)
+        if deadline is not None and time.monotonic() >= deadline:
+            # dead on arrival: admission control sheds it before it costs a
+            # queue slot, let alone a device dispatch
+            self._count_deadline("admission")
+            raise DeadlineExceeded("deadline expired before admission")
         if (not self._running or not self.ep._gate_ok("batch")
                 or not self._batchable(req)):
             # the BATCH_FUSION gate guards this path exactly like
             # handle_batch: a mixed-version cluster keeps fusion off
             return self.ep.handle_request(req)
         item = _Item(req=req, index=0, lane=_lane_of(req), ticket=_Ticket(),
-                     enqueue_t=time.perf_counter())
+                     enqueue_t=time.perf_counter(), deadline=deadline)
         with self._mu:
             # re-check under the lock: a stop() racing this enqueue drains
             # the queues once — anything appended after that drain would
@@ -261,6 +287,18 @@ class CoprReadScheduler:
             if not self._running:
                 do_direct = True
             elif sum(len(q) for q in self._queues.values()) >= self.cfg.max_queue:
+                if self.cfg.busy_reject:
+                    # ServerIsBusy with a drain hint: the retry policy
+                    # (util.retry) sleeps at least retry_after_s before the
+                    # request comes back — backpressure instead of serving
+                    # extra work on a saturated store.  Counted under its
+                    # own reason: "queue_full" means served on the direct
+                    # path, and a rejection is neither served nor direct
+                    self._count_shed("busy_reject")
+                    raise ServerBusyError(
+                        "coprocessor scheduler queue is full",
+                        retry_after_s=self.cfg.busy_retry_after_s,
+                    )
                 self._count_shed("queue_full")
                 do_direct = True
             else:
@@ -275,7 +313,11 @@ class CoprReadScheduler:
             raise TimeoutError("scheduler did not serve the request in time")
         if item.ticket.direct:
             # the dispatcher shed this request back: serve it on OUR thread
-            # so one slow per-request path cannot stall every lane
+            # so one slow per-request path cannot stall every lane — unless
+            # its deadline ran out while it waited
+            if deadline is not None and time.monotonic() >= deadline:
+                self._count_deadline("direct")
+                raise DeadlineExceeded("deadline expired before direct serve")
             return self.ep.handle_request(req)
         if item.ticket.error is not None:
             raise item.ticket.error
@@ -355,6 +397,18 @@ class CoprReadScheduler:
         failures per request instead of poisoning the whole batch."""
         results: list[CoprResponse | None] = [None] * len(items)
         errors: list[BaseException | None] = [None] * len(items)
+        # deadline shed FIRST: expired work must never reach grouping, let
+        # alone a device dispatch — the client has already given up, and a
+        # padded slot spent on it would tax every live rider in the batch
+        now = time.monotonic()
+        expired = [it for it in items
+                   if it.deadline is not None and now >= it.deadline]
+        for it in expired:
+            self._count_deadline("dispatch")
+            self._count_shed("deadline")
+            errors[it.index] = DeadlineExceeded("deadline expired in queue")
+        if expired:
+            items = [it for it in items if errors[it.index] is None]
         # group by plan signature, then by distinct region view within a sig
         by_sig: dict[tuple, dict[tuple, _Slot]] = {}
         rest = []
@@ -557,6 +611,22 @@ class CoprReadScheduler:
             return None
         ev = self._evaluator_for(sig, live[0].items[0].req.dag)
         mesh = self._sharded_mesh(ev)
+        breaker = self.ep.breaker
+        if mesh is not None and not breaker.allow("mesh"):
+            # mesh path tripped: degrade to the single-device cross-region
+            # program instead of losing batching entirely
+            from .tracker import count_path_fallback
+
+            count_path_fallback("mesh", "breaker_open")
+            mesh = None
+        if mesh is None and not breaker.allow("xregion"):
+            from .tracker import count_path_fallback
+
+            count_path_fallback("xregion", "breaker_open")
+            for slot in live:
+                self._shed(slot, "breaker_open", results, errors)
+            return None
+        path = "mesh" if mesh is not None else "xregion"
         if mesh is not None:
             live, device_load, sh_waste = self._shed_for_padding_sharded(
                 live, mesh, results, errors)
@@ -564,6 +634,7 @@ class CoprReadScheduler:
             live = self._shed_for_padding(live, results, errors)
             device_load, sh_waste = None, 0.0
         if len(live) < 2:
+            breaker.release_probe(path)  # nothing launched on this path
             for slot in live:
                 self._shed(slot, "underfull", results, errors)
             return None
@@ -591,11 +662,12 @@ class CoprReadScheduler:
             # "not batchable" (empty blocks, unstable dictionaries) is a
             # documented decline, not a device failure — shed without
             # polluting the fallback counter
+            breaker.release_probe(path)
             for slot in live:
                 self._shed(slot, "ineligible", results, errors)
             return None
         except Exception as exc:  # noqa: BLE001 — CPU pipeline is the oracle
-            self._device_failed(exc)
+            self._device_failed(exc, path)
             for slot in live:
                 self._shed(slot, "device_error", results, errors)
             return None
@@ -606,10 +678,11 @@ class CoprReadScheduler:
             try:
                 resps = pending.finalize()
             except Exception as exc:  # noqa: BLE001
-                self._device_failed(exc)
+                self._device_failed(exc, path)
                 for slot in live:
                     self._shed(slot, "device_error", results, errors)
                 return
+            self.ep.breaker.record_success(path)
             pull_dt = time.perf_counter() - t_fin
             # latency = this group's own host work (launch) + the blocking
             # pull (residual device time).  The gap between launch and
@@ -637,12 +710,19 @@ class CoprReadScheduler:
         """Same region view, K different plans: the fused batch inherited
         from endpoint._try_fused_batch (run_batch_cached fuses all K into
         one program over the shared cache)."""
+        if not self.ep.breaker.allow("fused"):
+            from .tracker import count_path_fallback
+
+            count_path_fallback("fused", "breaker_open")
+            self._shed(_Slot(items=items), "breaker_open", results, errors)
+            return None
         slot = _Slot(items=items)
         try:
             ok = self._resolve_slot(slot)
         except Exception:  # noqa: BLE001
             ok = False
         if not ok:
+            self.ep.breaker.release_probe("fused")
             self._shed(slot, "no_cache", results, errors)
             return None
         cache = slot.cache
@@ -653,6 +733,7 @@ class CoprReadScheduler:
             if resp is not None:
                 results[it.index] = resp
         if not todo:
+            self.ep.breaker.release_probe("fused")  # cold-fill served it all
             return None
         n_reqs = len(todo)
         # identical requests (same signature over this region view) share one
@@ -668,14 +749,16 @@ class CoprReadScheduler:
         except ValueError:
             # a documented decline (non-stable group dictionaries, empty
             # cache) — per-request path, no device-failure attribution
+            self.ep.breaker.release_probe("fused")
             self._shed(_Slot(items=todo), "ineligible", results, errors)
             return None
         except Exception as exc:  # noqa: BLE001
             # _resolve_slot guarantees a filled cache here, so there is no
             # partial fill to clean up (the cold-fill path owns that)
-            self._device_failed(exc)
+            self._device_failed(exc, "fused")
             self._shed(_Slot(items=todo), "device_error", results, errors)
             return None
+        self.ep.breaker.record_success("fused")
         dt = time.perf_counter() - t0
         self._batch_metrics("fused", n_reqs, dt, 0.0, n_batch=len(items))
         from_cache = slot.outcome not in ("", "miss", "too_big")
@@ -797,11 +880,14 @@ class CoprReadScheduler:
         for it in slot.items:
             self._per_request(it, results, errors, kind="shed:" + reason)
 
-    def _device_failed(self, exc: BaseException) -> None:
+    def _device_failed(self, exc: BaseException, path: str) -> None:
         from ..util.metrics import REGISTRY
+        from .tracker import count_path_fallback
 
         self.ep.device_fallbacks += 1
         self.ep.last_device_error = repr(exc)
+        self.ep.breaker.record_failure(path)
+        count_path_fallback(path, "device_error")
         REGISTRY.counter(
             "tikv_coprocessor_device_fallback_total",
             "Device-path failures that re-ran on the CPU pipeline",
@@ -865,6 +951,14 @@ class CoprReadScheduler:
             "tikv_coprocessor_sched_shed_total",
             "Requests shed to the per-request path, by reason",
         ).inc(reason=reason)
+
+    def _count_deadline(self, at: str) -> None:
+        from ..util.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "tikv_coprocessor_deadline_expired_total",
+            "Requests shed because their deadline expired, by detection point",
+        ).inc(at=at)
 
     def _gauge_depth(self) -> None:
         from ..util.metrics import REGISTRY

@@ -11,11 +11,23 @@ from __future__ import annotations
 import itertools
 
 from ..storage.engine import CF_DEFAULT, WriteBatch
+from ..util import retry
 from .raftkv import RaftKv, RegionSnapshot
 from .region import NotLeaderError, Peer as RegionPeer, Region, RegionEpoch
 from .store import ChannelTransport, RaftMessage, Store, StorePeer
 
 FIRST_REGION_ID = 1
+
+# the deterministic harness shares the ONE retry policy with the networked
+# cluster client, but "sleeping" means pumping ticks: wall-clock sleeps
+# would add nothing (no background threads move this cluster) and would
+# break determinism.  Attempts are bounded instead of deadline-bound.
+PUMP_RETRY = retry.RetryPolicy(
+    base_s=0.0, jitter=0.0, max_attempts=50,
+    # a proposal timeout here means quorum is gone and pumping cannot bring
+    # it back (nothing heals without the TEST acting) — fail fast-ish
+    class_attempts={"suspect": 8, "timeout": 2},
+)
 
 
 class Cluster:
@@ -140,28 +152,46 @@ class Cluster:
                 return p.region.id
         raise KeyError(key)
 
+    def _pump_retry(self, fn, site: str):
+        """Run a leader-routed op under the shared retry policy, with tick
+        pumping as the backoff action (NotLeader during churn re-routes to
+        the new leader after the pump elects one)."""
+        return retry.call(
+            fn, policy=PUMP_RETRY, site=site,
+            sleep=lambda _s: self.tick(),
+        )
+
     def must_put(self, key: bytes, value: bytes, cf: str = CF_DEFAULT) -> None:
-        region_id = self.region_for_key(key)
-        leader = self.wait_leader(region_id)
-        kv = self.raftkv(leader.store.store_id)
-        wb = WriteBatch()
-        wb.put_cf(cf, key, value)
-        kv.write({"region_id": region_id}, wb)
+        def attempt():
+            region_id = self.region_for_key(key)
+            leader = self.wait_leader(region_id)
+            kv = self.raftkv(leader.store.store_id)
+            wb = WriteBatch()
+            wb.put_cf(cf, key, value)
+            kv.write({"region_id": region_id}, wb)
+
+        self._pump_retry(attempt, "cluster.must_put")
 
     def must_delete(self, key: bytes, cf: str = CF_DEFAULT) -> None:
-        region_id = self.region_for_key(key)
-        leader = self.wait_leader(region_id)
-        kv = self.raftkv(leader.store.store_id)
-        wb = WriteBatch()
-        wb.delete_cf(cf, key)
-        kv.write({"region_id": region_id}, wb)
+        def attempt():
+            region_id = self.region_for_key(key)
+            leader = self.wait_leader(region_id)
+            kv = self.raftkv(leader.store.store_id)
+            wb = WriteBatch()
+            wb.delete_cf(cf, key)
+            kv.write({"region_id": region_id}, wb)
+
+        self._pump_retry(attempt, "cluster.must_delete")
 
     def must_get(self, key: bytes, cf: str = CF_DEFAULT) -> bytes | None:
-        region_id = self.region_for_key(key)
-        leader = self.wait_leader(region_id)
-        kv = self.raftkv(leader.store.store_id)
-        snap = kv.snapshot({"region_id": region_id})
-        return snap.get_cf(cf, key)
+        def attempt():
+            region_id = self.region_for_key(key)
+            leader = self.wait_leader(region_id)
+            kv = self.raftkv(leader.store.store_id)
+            snap = kv.snapshot({"region_id": region_id})
+            return snap.get_cf(cf, key)
+
+        return self._pump_retry(attempt, "cluster.must_get")
 
     def get_on_store(self, store_id: int, key: bytes, cf: str = CF_DEFAULT) -> bytes | None:
         """Read the store's local applied state directly (follower check)."""

@@ -21,16 +21,23 @@ import time
 
 from ..pd.client import MockPd
 from ..raft.raftkv import RaftKv
-from ..raft.region import NotLeaderError, Peer as RegionPeer, Region, RegionEpoch
+from ..raft.region import Peer as RegionPeer, Region, RegionEpoch
 from ..raft.store import StorePeer
 from ..storage.engine import CF_DEFAULT, WriteBatch
-from ..util import keys as keymod
+from ..util import keys as keymod, retry
 from .node import Node
 from .raft_client import RemoteTransport
 from .server import Server
 from .service import KvService
 
 FIRST_REGION_ID = 1
+
+# one policy for every leader-routed client loop in this harness (the
+# reference client's backoff discipline): NotLeader/Epoch/Timeout re-route
+# with exponential backoff + jitter; AssertionError/KeyError — the routing
+# races that the old loops swallowed wholesale — ride the bounded "suspect"
+# class and LOG on final failure instead of masking bugs silently
+CLIENT_RETRY = retry.RetryPolicy(base_s=0.05, max_s=0.5, jitter=0.3)
 
 
 class StoreNode:
@@ -166,34 +173,29 @@ class ServerCluster:
         return max(leaders, key=lambda p: p.node.term)
 
     def wait_leader(self, region_id: int, timeout: float = 10.0) -> StorePeer:
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            p = self.leader_peer(region_id)
-            if p is not None:
-                return p
-            time.sleep(0.02)
-        raise AssertionError(f"no leader for region {region_id} within {timeout}s")
+        return retry.wait_until(
+            lambda: self.leader_peer(region_id), timeout,
+            desc=f"leader for region {region_id}",
+        )
 
     def wait_applied_on(self, store_id: int, region_id: int, index: int, timeout: float = 10.0) -> None:
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            node = self.nodes[store_id]
-            p = node.store.peers.get(region_id)
-            if p is not None and p.node.applied >= index:
-                return
-            time.sleep(0.02)
-        raise AssertionError(f"store {store_id} region {region_id} never reached index {index}")
+        def applied():
+            p = self.nodes[store_id].store.peers.get(region_id)
+            return p is not None and p.node.applied >= index
+
+        retry.wait_until(
+            applied, timeout,
+            desc=f"store {store_id} region {region_id} applied index {index}",
+        )
 
     def get_on_store(self, store_id: int, key: bytes, cf: str = CF_DEFAULT) -> bytes | None:
         return self.nodes[store_id].store.engine.get_cf(cf, keymod.data_key(key))
 
     def wait_get_on_store(self, store_id: int, key: bytes, value: bytes, timeout: float = 10.0) -> None:
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            if self.get_on_store(store_id, key) == value:
-                return
-            time.sleep(0.02)
-        raise AssertionError(f"store {store_id} never saw {key!r}={value!r}")
+        retry.wait_until(
+            lambda: self.get_on_store(store_id, key) == value, timeout,
+            desc=f"store {store_id} sees {key!r}={value!r}",
+        )
 
     # -- KV (leader-routed, with NotLeader retry like a real client) --------
 
@@ -207,36 +209,30 @@ class ServerCluster:
         raise KeyError(key)
 
     def must_put(self, key: bytes, value: bytes, cf: str = CF_DEFAULT, timeout: float = 10.0) -> None:
-        deadline = time.monotonic() + timeout
-        last: Exception | None = None
-        while time.monotonic() < deadline:
-            try:
-                region_id = self.region_for_key(key)
-                leader = self.wait_leader(region_id, timeout=2.0)
-                kv = RaftKv(leader.store)
-                wb = WriteBatch()
-                wb.put_cf(cf, key, value)
-                kv.write({"region_id": region_id}, wb)
-                return
-            except (NotLeaderError, TimeoutError, AssertionError, KeyError) as e:
-                last = e
-                time.sleep(0.05)
-        raise AssertionError(f"must_put {key!r} failed: {last!r}")
+        """Leader-routed put with the shared retry policy: NotLeader/Epoch/
+        Timeout re-route freely; AssertionError/KeyError (routing races, but
+        also how a REAL bug would surface) ride the bounded suspect class."""
+        def attempt():
+            region_id = self.region_for_key(key)
+            leader = self.wait_leader(region_id, timeout=2.0)
+            kv = RaftKv(leader.store)
+            wb = WriteBatch()
+            wb.put_cf(cf, key, value)
+            kv.write({"region_id": region_id}, wb)
+
+        retry.call(attempt, policy=CLIENT_RETRY, timeout=timeout,
+                   site="server_cluster.must_put")
 
     def must_get(self, key: bytes, cf: str = CF_DEFAULT, timeout: float = 10.0) -> bytes | None:
-        deadline = time.monotonic() + timeout
-        last: Exception | None = None
-        while time.monotonic() < deadline:
-            try:
-                region_id = self.region_for_key(key)
-                leader = self.wait_leader(region_id, timeout=2.0)
-                kv = RaftKv(leader.store)
-                snap = kv.snapshot({"region_id": region_id})
-                return snap.get_cf(cf, key)
-            except (NotLeaderError, TimeoutError, AssertionError, KeyError) as e:
-                last = e
-                time.sleep(0.05)
-        raise AssertionError(f"must_get {key!r} failed: {last!r}")
+        def attempt():
+            region_id = self.region_for_key(key)
+            leader = self.wait_leader(region_id, timeout=2.0)
+            kv = RaftKv(leader.store)
+            snap = kv.snapshot({"region_id": region_id})
+            return snap.get_cf(cf, key)
+
+        return retry.call(attempt, policy=CLIENT_RETRY, timeout=timeout,
+                          site="server_cluster.must_get")
 
     # -- admin --------------------------------------------------------------
 
@@ -259,23 +255,23 @@ class ServerCluster:
         the log entry, so every replica (and any catching-up one) applies
         them (fsm/apply.rs exec_ingest_sst shape).  Retries leadership
         churn the way a real import client does (must_put discipline)."""
-        deadline = time.monotonic() + timeout
-        last: Exception | None = None
-        while time.monotonic() < deadline:
+        def attempt():
+            leader = self.wait_leader(region_id)
+            cmd = {
+                "epoch": (leader.region.epoch.conf_ver, leader.region.epoch.version),
+                "admin": ("ingest_sst", payload),
+            }
             try:
-                leader = self.wait_leader(region_id)
-                cmd = {
-                    "epoch": (leader.region.epoch.conf_ver, leader.region.epoch.version),
-                    "admin": ("ingest_sst", payload),
-                }
                 self._run_admin(leader, cmd, timeout=2.0)
-                return
-            except KeyError:
-                raise  # permanent: payload outside the region range
-            except Exception as e:  # NotLeader / Epoch / timeout: re-route
-                last = e
-                time.sleep(0.05)
-        raise TimeoutError(f"ingest_sst on region {region_id} never landed: {last}")
+            except KeyError as e:
+                # payload outside the region range: re-route the policy's
+                # default suspect classification to permanent — retrying a
+                # malformed import can never land it
+                e.retry_class = "permanent"
+                raise
+
+        retry.call(attempt, policy=CLIENT_RETRY, timeout=timeout,
+                   site="server_cluster.ingest_sst")
 
     def split_region(self, region_id: int, split_key: bytes) -> int:
         leader = self.wait_leader(region_id)
@@ -323,29 +319,31 @@ class ServerCluster:
         loaded cluster can replicate, livelocking the very catch-up the
         election needs."""
         peer = self.nodes[to_store].store.peers[region_id]
-        deadline = time.monotonic() + timeout
-        ordered_at = 0.0   # last ACCEPTED leader-side order (True return)
-        forced_at = 0.0    # last target-side forced campaign
-        while time.monotonic() < deadline:
+        pacing = {"ordered_at": 0.0,   # last ACCEPTED leader-side order
+                  "forced_at": 0.0}    # last target-side forced campaign
+
+        def step() -> bool:
             if peer.node.is_leader():
-                return
+                return True
             now = time.monotonic()
             cur = self.leader_peer(region_id)
             ordered = False
             if (cur is not None and cur.store.store_id != to_store
-                    and now - ordered_at > 1.0):
+                    and now - pacing["ordered_at"] > 1.0):
                 # leader-side order at most 1/s: TIMEOUT_NOW re-sent every
                 # loop tick would force-campaign (and term-bump) the target
                 # per delayed delivery, churning the very election it runs
                 ordered = cur.transfer_leader_to(peer.peer_id)
                 if ordered:
-                    ordered_at = now
-            if not ordered and now - max(ordered_at, forced_at) > 1.0:
+                    pacing["ordered_at"] = now
+            if not ordered and now - max(pacing.values()) > 1.0:
                 # the polite path is refused (learner target, or match never
                 # equals last_index under a concurrent writer) or there is
                 # no leader: fall back to the forced campaign — at a slow
                 # cadence so replication can still outrun the term bumps
                 peer.node.campaign()
-                forced_at = now
-            time.sleep(0.05)
-        raise AssertionError(f"store {to_store} never took region {region_id}")
+                pacing["forced_at"] = now
+            return False
+
+        retry.wait_until(step, timeout, interval=0.05,
+                         desc=f"store {to_store} takes region {region_id}")

@@ -23,10 +23,10 @@ from typing import Callable
 
 from ..raft import net as raft_net
 from ..raft.store import Filter, RaftMessage, Transport
+from ..util.retry import RECONNECT_POLICY
 from . import wire
 from .server import write_frame
 
-_BACKOFF_S = 0.5
 _MAX_BUFFERED = 4096
 
 
@@ -51,7 +51,19 @@ class _StoreConn:
         self.send_mu = threading.Lock()
         self.buf: list = []  # wire-encoded raft messages pending flush
         self.down_until = 0.0
+        # consecutive reconnect failures: drives the shared exponential
+        # policy (raft_client.rs:479's per-store backoff) — the first retry
+        # probes quickly after a leader restart, a persistently dead store
+        # decays toward the policy ceiling instead of being hammered twice a
+        # second forever
+        self.connect_failures = 0
         self.snap_inflight = False  # one snapshot transfer at a time per store
+
+    def _mark_down_locked(self) -> None:
+        self.connect_failures += 1
+        self.down_until = time.monotonic() + RECONNECT_POLICY.backoff(
+            self.connect_failures, self.owner.backoff_rng
+        )
 
     def _connect_locked(self) -> bool:
         if self.sock is not None:
@@ -61,7 +73,7 @@ class _StoreConn:
         addr = self.resolver(self.store_id)
         if addr is None:
             self.owner.dropped_unresolved += 1
-            self.down_until = time.monotonic() + _BACKOFF_S
+            self._mark_down_locked()
             return False
         try:
             sock = socket.create_connection((addr[0], addr[1]), timeout=2.0)
@@ -69,10 +81,11 @@ class _StoreConn:
                 sock = self.security.client_context().wrap_socket(sock)
             sock.settimeout(5.0)
             self.sock = sock
+            self.connect_failures = 0
             return True
         except OSError:
             self.sock = None
-            self.down_until = time.monotonic() + _BACKOFF_S
+            self._mark_down_locked()
             return False
 
     def send_oneway(self, method: str, req) -> bool:
@@ -92,7 +105,7 @@ class _StoreConn:
                 except OSError:
                     pass
                 self.sock = None
-                self.down_until = time.monotonic() + _BACKOFF_S
+                self._mark_down_locked()
                 return False
 
     def close(self) -> None:
@@ -117,6 +130,9 @@ class RaftClient:
         self.security = security
         self._conns: dict[int, _StoreConn] = {}
         self._mu = threading.Lock()
+        # jitters the shared reconnect policy so N stores probing one
+        # restarted peer don't reconnect in lockstep
+        self.backoff_rng = random.Random()
         # transfer ids must be unique across every sending store feeding one
         # receiver's assembler: start at a random 62-bit offset per client
         self._xfer_ids = itertools.count(random.getrandbits(62) | (1 << 62))

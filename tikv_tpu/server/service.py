@@ -59,6 +59,16 @@ def _err(e: Exception) -> dict:
         return {"not_leader": {"region_id": e.region_id, "leader_store": e.leader_store}}
     if isinstance(e, EpochError):
         return {"epoch_not_match": {}}
+    retry_after = getattr(e, "retry_after_s", None)
+    if retry_after is not None or type(e).__name__ in ("SchedTooBusy", "ServerBusyError"):
+        # ServerIsBusy shape: the retry-after hint survives the wire so the
+        # client-side retry policy can honor it (util.retry)
+        busy = {}
+        if retry_after is not None:
+            busy["retry_after_ms"] = int(retry_after * 1000)
+        return {"server_is_busy": busy}
+    if type(e).__name__ == "DeadlineExceeded":
+        return {"deadline_exceeded": {}}
     return {"other": str(e)}
 
 
@@ -929,12 +939,23 @@ class KvService:
         tp = req.get("tp", REQ_TYPE_DAG)
         if dag is None and tp != REQ_TYPE_CHECKSUM:
             raise ValueError("dag required for this request type")
+        context = req.get("context") or {}
+        if "timeout_ms" in context and "deadline" not in context:
+            # wire clients can't share our monotonic clock: their relative
+            # budget becomes an absolute deadline HERE, at parse time, so
+            # queue wait and execution all draw down the same budget
+            # (util.retry.deadline_from_context; the scheduler lanes shed
+            # expired work before dispatch)
+            from ..util.retry import deadline_from_context
+
+            context = dict(context)
+            context["deadline"] = deadline_from_context(context)
         return CoprRequest(
             tp=tp,
             dag=dag,
             ranges=[tuple(r) for r in req["ranges"]],
             start_ts=req["start_ts"],
-            context=req.get("context") or {},
+            context=context,
         )
 
     def coprocessor(self, req: dict) -> dict:
@@ -966,15 +987,29 @@ class KvService:
         device program; everything else answers per-request.  Response order
         matches request order; a bad sub-request fails ONLY its own slot."""
         assert self.copr is not None, "coprocessor endpoint not wired"
+        from ..util.retry import DeadlineExceeded
+
         subs = req.get("requests") or []
         try:
             creqs = [self._parse_copr_request(sub) for sub in subs]
-            resps = self.copr.handle_batch(creqs)
-            return {"responses": [
-                {"data": r.data, "from_device": r.from_device} for r in resps
-            ]}
-        except Exception:  # noqa: BLE001 — isolate the failure per slot
+            results, errors = self.copr.handle_batch_errors(creqs)
+        except Exception:  # noqa: BLE001 — a parse failure poisons nothing
             return {"responses": [self.coprocessor(sub) for sub in subs]}
+        out = []
+        for sub, r, e in zip(subs, results, errors):
+            if e is None and r is not None:
+                out.append({"data": r.data, "from_device": r.from_device})
+            elif isinstance(e, DeadlineExceeded):
+                # expired in queue: report it, never re-dispatch — the
+                # client already gave up on this slot
+                out.append({"error": _err(e)})
+            else:
+                # per-slot re-serve keeps the old isolation contract (and a
+                # batch-path device error may still succeed per-request);
+                # handle_request's entry gate sheds it cheaply if its
+                # deadline lapsed meanwhile
+                out.append(self.coprocessor(sub))
+        return {"responses": out}
 
     def coprocessor_stream(self, req: dict):
         """Streamed DAG execution (endpoint.rs:508-584): returns a GENERATOR

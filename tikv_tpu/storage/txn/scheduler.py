@@ -57,7 +57,13 @@ SCHED_TOO_BUSY = error_code.define(
 
 class SchedTooBusy(Exception):
     """Raised at submission when the scheduler is over its pending-write
-    threshold (the client should back off and retry — ServerIsBusy)."""
+    threshold (the client should back off and retry — ServerIsBusy).
+    ``retry_after_s`` hints when capacity is expected back; the shared
+    retry policy (``util.retry``) sleeps at least that long."""
+
+    def __init__(self, msg: str, retry_after_s: float | None = None):
+        self.retry_after_s = retry_after_s
+        super().__init__(msg)
 
 
 error_code.register(SchedTooBusy, SCHED_TOO_BUSY)
@@ -130,7 +136,10 @@ class Scheduler:
                 _SCHED_TOO_BUSY.inc()
                 raise SchedTooBusy(
                     f"{self._inflight} commands pending "
-                    f"(threshold {self.pending_write_threshold})"
+                    f"(threshold {self.pending_write_threshold})",
+                    # drain hint: pending work over worker parallelism, at a
+                    # nominal ~1ms per engine write round trip
+                    retry_after_s=0.001 * self._inflight / max(self.pool_size, 1),
                 )
             self._inflight += 1
             self._ensure_threads()

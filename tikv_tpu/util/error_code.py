@@ -82,6 +82,10 @@ STORAGE_PESSIMISTIC_LOCK_NOT_FOUND = define(
 )
 STORAGE_DEADLOCK = define("KV:Storage:Deadlock", "waits-for cycle detected")
 COPR_PLUGIN = define("KV:Coprocessor:Plugin", "coprocessor plugin failure")
+COPR_DEADLINE = define(
+    "KV:Coprocessor:DeadlineExceeded", "request deadline expired before serving"
+)
+SERVER_IS_BUSY = define("KV:Server:IsBusy", "server shed the request under load")
 ENGINE_FAILPOINT = define("KV:Engine:Failpoint", "injected failure")
 CLOUD_IO = define("KV:Cloud:Io", "external storage failure")
 
@@ -100,7 +104,10 @@ def register_builtin() -> None:
         TxnLockNotFoundError,
     )
     from .failpoint import FailpointError
+    from .retry import DeadlineExceeded, ServerBusyError
 
+    register(DeadlineExceeded, COPR_DEADLINE)
+    register(ServerBusyError, SERVER_IS_BUSY)
     register(NotLeaderError, RAFTSTORE_NOT_LEADER)
     register(EpochError, RAFTSTORE_EPOCH_NOT_MATCH)
     register(KeyNotInRegionError, RAFTSTORE_KEY_NOT_IN_REGION)

@@ -68,8 +68,13 @@ def teardown() -> None:
 
 
 def list_active() -> dict[str, str]:
+    """Active points, counted actions rendered with their REMAINING count
+    ("2*return" decays to "1*return" after one trigger) so tests can see how
+    far an injection schedule has progressed."""
     with _mu:
-        return {n: a for n, (a, _c) in _actions.items()}
+        return {
+            n: (a if c is None else f"{c}*{a}") for n, (a, c) in _actions.items()
+        }
 
 
 def fail_point(name: str) -> None:
@@ -92,7 +97,10 @@ def fail_point(name: str) -> None:
                 cur = _actions.get(name)
                 if cur is None or cur[0] != "pause":
                     return
-                _mu.wait(0.01)
+                # plain wait: cfg()/remove()/teardown() notify_all on every
+                # reconfiguration, so paused threads wake exactly when the
+                # window closes instead of polling at 10ms granularity
+                _mu.wait()
         if count is not None:
             if count <= 1:
                 _actions.pop(name, None)

@@ -1,0 +1,257 @@
+"""Shared backoff-retry policy for every cluster-facing client loop.
+
+Re-expression of the reference's client retry machinery (client-go's
+``retry/backoff.go`` and TiKV's own ``ServerIsBusy``/``NotLeader`` handling):
+one policy object — exponential backoff with jitter, bounded attempts,
+error-CLASS routing — replaces the divergent ad-hoc ``time.sleep`` loops that
+grew in ``server/cluster.py``, ``raft/cluster.py`` and the raft-client
+reconnect path.
+
+Error classes (routed by exception type NAME so util never imports the
+subsystems it serves):
+
+``not_leader`` / ``epoch``
+    Leadership moved / the region epoch is stale — always retryable; the
+    next attempt re-routes.
+``busy``
+    ``ServerIsBusy``-style load shedding (``SchedTooBusy``,
+    :class:`ServerBusyError`).  Retryable; when the exception carries a
+    ``retry_after_s`` hint the retrier sleeps AT LEAST that long — the
+    server knows its own drain time better than our backoff curve does.
+``timeout``
+    A bounded wait elapsed (no leader yet, admin command stalled).
+    Retryable: partitions heal and elections finish.
+``suspect``
+    ``AssertionError`` / ``KeyError`` — historically retried wholesale by
+    the cluster clients, which masked real bugs.  Still retryable (routing
+    races genuinely raise them) but under a SEPARATE, tighter attempt bound,
+    and the final failure is logged with the exception chain.
+``deadline``
+    :class:`DeadlineExceeded` — never retried: the caller's budget is gone.
+``permanent``
+    Everything unrouted.  Never retried.
+
+See ``docs/robustness.md``.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import time
+from dataclasses import dataclass, field
+
+logger = logging.getLogger("tikv_tpu.retry")
+
+
+class DeadlineExceeded(Exception):
+    """The request's deadline expired before it could be served.  Carried
+    end-to-end: admission control and the copr scheduler lanes raise it for
+    already-expired work instead of wasting a device dispatch."""
+
+
+class ServerBusyError(Exception):
+    """ServerIsBusy analog: the server shed this request under load.  The
+    optional ``retry_after_s`` hint tells clients when capacity is expected
+    back (honored by :class:`Retrier`)."""
+
+    def __init__(self, msg: str = "server is busy", retry_after_s: float | None = None):
+        self.retry_after_s = retry_after_s
+        super().__init__(msg)
+
+
+# exception type name -> error class (name-based: no subsystem imports; an
+# exception may override with an explicit ``retry_class`` attribute)
+ROUTES: dict[str, str] = {
+    "NotLeaderError": "not_leader",
+    "EpochError": "epoch",
+    "EpochNotMatchError": "epoch",
+    "SchedTooBusy": "busy",
+    "ServerBusyError": "busy",
+    "TimeoutError": "timeout",
+    "DeadlineExceeded": "deadline",
+    "AssertionError": "suspect",
+    "KeyError": "suspect",
+}
+
+RETRYABLE_CLASSES = {"not_leader", "epoch", "busy", "timeout", "suspect"}
+
+
+def classify(exc: BaseException) -> str:
+    """The error class an exception routes to (``permanent`` if unrouted)."""
+    override = getattr(exc, "retry_class", None)
+    if isinstance(override, str):
+        return override
+    for klass in type(exc).__mro__:
+        cls = ROUTES.get(klass.__name__)
+        if cls is not None:
+            return cls
+    return "permanent"
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with decorrelating jitter and bounded attempts.
+
+    ``max_attempts`` bounds the TOTAL failures absorbed (0 = unbounded, the
+    deadline is then the only stop); ``class_attempts`` tightens individual
+    classes — by default the ``suspect`` class (AssertionError/KeyError,
+    which can mask real bugs) gets a much shorter leash."""
+
+    base_s: float = 0.02
+    max_s: float = 1.0
+    multiplier: float = 2.0
+    jitter: float = 0.2          # +/- fraction of the computed backoff
+    max_attempts: int = 0        # 0 = unbounded (deadline-bound only)
+    class_attempts: dict = field(default_factory=lambda: {"suspect": 16})
+
+    def backoff(self, attempt: int, rng: random.Random | None = None) -> float:
+        """Sleep before retry number ``attempt`` (1-based)."""
+        raw = min(self.base_s * (self.multiplier ** max(attempt - 1, 0)),
+                  self.max_s)
+        # jitter AFTER the ceiling clamp: once the curve saturates, clamping
+        # a jittered value collapses every caller to exactly max_s — N
+        # stores probing one restarted peer would reconnect in lockstep,
+        # which is the scenario the jitter exists to break up
+        if self.jitter and rng is not None:
+            raw *= 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
+        return max(raw, 0.0)
+
+
+#: the project default: ~20ms..1s exponential, suspect errors capped at 16
+DEFAULT_POLICY = RetryPolicy()
+
+#: reconnect flavor for the raft client's per-store connections: quicker
+#: first probe than the old constant 0.5s, exponential toward a bounded
+#: ceiling so a dead store is probed, not hammered — and a restarted one is
+#: re-reached within one ceiling interval
+RECONNECT_POLICY = RetryPolicy(base_s=0.1, max_s=2.0, jitter=0.25)
+
+
+class Retrier:
+    """Per-operation retry state: feed it failures, it answers with the
+    sleep before the next attempt or ``None`` for "stop, re-raise".
+
+    ``deadline`` is absolute ``time.monotonic()`` seconds; sleeps are
+    clipped to the remaining budget and a spent budget stops retrying."""
+
+    def __init__(
+        self,
+        policy: RetryPolicy = DEFAULT_POLICY,
+        deadline: float | None = None,
+        rng: random.Random | None = None,
+        site: str = "",
+        clock=time.monotonic,
+    ):
+        self.policy = policy
+        self.deadline = deadline
+        self.rng = rng or random.Random()
+        self.site = site
+        self.clock = clock
+        self.attempts = 0
+        self.by_class: dict[str, int] = {}
+        self.last_exc: BaseException | None = None
+
+    def should_retry(self, exc: BaseException) -> float | None:
+        """None = give up (caller re-raises); else seconds to sleep."""
+        cls = classify(exc)
+        self.last_exc = exc
+        self.attempts += 1
+        self.by_class[cls] = self.by_class.get(cls, 0) + 1
+        self._count(cls)
+        if cls not in RETRYABLE_CLASSES:
+            return None
+        cap = self.policy.class_attempts.get(cls, 0)
+        if cap and self.by_class[cls] > cap:
+            if cls == "suspect":
+                logger.warning(
+                    "retry[%s]: giving up after %d suspect failures "
+                    "(AssertionError/KeyError may mask a real bug): %r",
+                    self.site, self.by_class[cls], exc,
+                )
+            return None
+        if self.policy.max_attempts and self.attempts >= self.policy.max_attempts:
+            return None
+        delay = self.policy.backoff(self.attempts, self.rng)
+        hint = getattr(exc, "retry_after_s", None)
+        if hint is not None:
+            # the server's own drain estimate dominates our curve
+            delay = max(delay, float(hint))
+        if self.deadline is not None:
+            remaining = self.deadline - self.clock()
+            if remaining <= 0:
+                return None
+            delay = min(delay, remaining)
+        return delay
+
+    def _count(self, cls: str) -> None:
+        from .metrics import REGISTRY
+
+        REGISTRY.counter(
+            "tikv_client_retry_total",
+            "Client retry-loop failures absorbed, by call site and error class",
+        ).inc(site=self.site or "unknown", error_class=cls)
+
+
+def call(
+    fn,
+    *,
+    policy: RetryPolicy = DEFAULT_POLICY,
+    timeout: float | None = None,
+    site: str = "",
+    sleep=time.sleep,
+    rng: random.Random | None = None,
+    clock=time.monotonic,
+):
+    """Run ``fn()`` under the retry policy until it succeeds, the error is
+    non-retryable, attempts exhaust, or ``timeout`` seconds elapse.  The
+    LAST exception re-raises — never a synthetic wrapper, so callers keep
+    matching on the real error types."""
+    deadline = None if timeout is None else clock() + timeout
+    r = Retrier(policy, deadline=deadline, rng=rng, site=site, clock=clock)
+    while True:
+        try:
+            return fn()
+        except Exception as exc:  # noqa: BLE001 — classify() routes
+            delay = r.should_retry(exc)
+            if delay is None:
+                raise
+            sleep(delay)
+
+
+def wait_until(
+    pred,
+    timeout: float,
+    interval: float = 0.02,
+    desc: str = "condition",
+    sleep=time.sleep,
+    clock=time.monotonic,
+):
+    """Poll ``pred()`` until it returns a truthy value; raise TimeoutError
+    after ``timeout`` seconds.  The ONE wait-for-condition loop the cluster
+    harnesses share (wait_leader / wait_applied / wait_get...)."""
+    deadline = clock() + timeout
+    while True:
+        v = pred()
+        if v:
+            return v
+        if clock() >= deadline:
+            raise TimeoutError(f"{desc} not reached within {timeout}s")
+        sleep(min(interval, max(deadline - clock(), 0.0)))
+
+
+def deadline_from_context(ctx: dict | None, clock=time.monotonic) -> float | None:
+    """Resolve a request context's deadline to absolute monotonic seconds.
+
+    Two spellings: ``deadline`` (absolute monotonic — in-process callers) and
+    ``timeout_ms`` (relative budget — wire clients can't share our clock;
+    the service layer stamps the absolute deadline at parse time)."""
+    if not ctx:
+        return None
+    d = ctx.get("deadline")
+    if d is not None:
+        return float(d)
+    t = ctx.get("timeout_ms")
+    if t is not None:
+        return clock() + float(t) / 1000.0
+    return None
