@@ -121,6 +121,10 @@ class _Cluster:
             # normal bootstrap; the CPU path stays on the short clock
             _wait_ready(p, timeout=300.0 if device_platform not in ("cpu", "", None) else 120.0)
         self._clients: dict[int, object] = {}
+        # region -> leader store, refreshed from NotLeader response hints
+        # (the client-go region-cache role): a hint re-routes the NEXT call
+        # immediately instead of re-polling pd.leaders on a sleep loop
+        self._route: dict[int, int] = {}
 
     def client_for_store(self, sid: int):
         c = self._clients.get(sid)
@@ -132,29 +136,49 @@ class _Cluster:
     def leader_client(self, region_id: int, timeout=30.0):
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            sid = self.pd.leaders.get(region_id)
+            # the route cache (NotLeader hints) answers before PD's
+            # heartbeat-lagged leader view
+            sid = self._route.get(region_id) or self.pd.leaders.get(region_id)
             if sid is not None:
                 return self.client_for_store(sid), sid
             time.sleep(0.1)
         raise RuntimeError(f"no leader reported for region {region_id}")
 
     def call_leader(self, region_id: int, method: str, req: dict, timeout=60.0):
-        """Leader-following call with NotLeader/epoch retry."""
+        """Leader-following call with NotLeader/epoch retry.  A NotLeader
+        response carrying a leader hint updates the route cache and re-routes
+        IMMEDIATELY — no sleep, no pd.leaders re-poll."""
         deadline = time.monotonic() + timeout
         last = None
+        hot_hops = 0
         while time.monotonic() < deadline:
             try:
-                c, _sid = self.leader_client(region_id)
+                c, sid = self.leader_client(region_id)
                 r = c.call(method, dict(req, context={"region_id": region_id}),
                            timeout=20.0)
             except (ConnectionError, TimeoutError, OSError, RuntimeError) as e:
                 last = e
+                self._route.pop(region_id, None)
+                hot_hops = 0
                 time.sleep(0.2)
                 continue
             if isinstance(r, dict) and (r.get("error") or r.get("errors")):
                 last = r
+                hint = ((r.get("error") or {}).get("not_leader") or {}).get("leader_store")
+                if hint and hint != sid:
+                    self._route[region_id] = hint
+                    # ONE sleepless re-route per backoff window: mid-election
+                    # two stores can hint at each other, and an unbounded hot
+                    # loop would hammer both until the deadline
+                    if hot_hops < 1:
+                        hot_hops += 1
+                        continue
+                else:
+                    self._route.pop(region_id, None)
+                hot_hops = 0
                 time.sleep(0.2)
                 continue
+            self._route[region_id] = sid
             return r
         raise RuntimeError(f"{method} on region {region_id} never succeeded: {last!r}")
 

@@ -13,6 +13,7 @@ takes over once the native engine lands).
     ctl.py --addr HOST:PORT scan-lock --max-ts TS
     ctl.py --addr HOST:PORT resolve-lock --start-ts TS [--commit-ts TS]
     ctl.py --addr HOST:PORT region-info|region-properties [--region R]
+    ctl.py --addr HOST:PORT read-progress [--region R]
     ctl.py --addr HOST:PORT bad-regions|all-regions
     ctl.py --status ADDR metrics|config
     ctl.py --status ADDR reconfig section.key=value ...
@@ -102,6 +103,14 @@ def main(argv=None) -> int:
         # SUPPRESS: a value given after the subcommand wins; otherwise the
         # parent-level --region (or its default) stays in effect
         sp.add_argument("--region", type=int, default=argparse.SUPPRESS)
+    sp = sub.add_parser(
+        "read-progress",
+        help="per-region (resolved_ts, required_apply_index) + store "
+             "safe_ts — why a follower refuses stale reads")
+    # its own dest: the parent --region default (1) must not narrow the
+    # default all-regions view
+    sp.add_argument("--region", type=int, dest="progress_region", default=None,
+                    help="narrow to one region (default: every region)")
     sub.add_parser("bad-regions")
     sub.add_parser("all-regions")
     sub.add_parser("metrics")
@@ -271,6 +280,11 @@ def main(argv=None) -> int:
                 "kv_resolve_lock",
                 {"start_version": args.start_ts, "commit_version": args.commit_ts, "context": ctx},
             )
+        elif args.cmd == "read-progress":
+            req = {}
+            if args.progress_region is not None:
+                req["region_id"] = args.progress_region
+            r = c.call("debug_read_progress", req)
         elif args.cmd == "region-info":
             r = c.call("debug_region_info", {"region_id": args.region})
         elif args.cmd == "region-properties":

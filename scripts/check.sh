@@ -24,4 +24,8 @@ echo "== chaos smoke: nemesis + retry/breaker fault paths under the sanitizer ==
 JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
   -m 'not slow' tests/test_chaos_nemesis.py tests/test_retry_policy.py
 
+echo "== follower-read chaos smoke: leader isolation + read ladder under the sanitizer =="
+JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
+  -m 'not slow' tests/test_follower_reads.py
+
 echo "check.sh: all gates green"

@@ -41,6 +41,11 @@ LAZY_SERIES = {
     "tikv_coprocessor_deadline_expired_total",
     "tikv_chaos_injected_total",
     "tikv_client_retry_total",
+    "tikv_resolved_ts_safe_ts_lag",
+    "tikv_read_forward_total",
+    "tikv_read_stale_serve_total",
+    "tikv_read_refuse_total",
+    "tikv_coprocessor_follower_read_total",
     "tikv_coprocessor_region_cache_total",
     "tikv_coprocessor_region_cache_wt_lost_total",
     "tikv_coprocessor_region_cache_device_bytes",

@@ -245,3 +245,21 @@ def test_deadlock_service_over_pb(store):
     cli.call("deadlock_detect", cu)
     resp = cli.call("deadlock_detect", det2)
     assert resp.entry is None  # edge 910->920 gone: no cycle
+
+
+def test_region_error_maps_data_not_ready_to_errorpb():
+    """The stale-read refusal survives the kvproto surface: a
+    ``data_not_ready`` dict becomes errorpb.DataIsNotReady with the
+    resolved watermark as safe_ts, and round-trips the wire encoding."""
+    from tikv_tpu.server.pb_gateway import _region_error
+
+    re = _region_error({"data_not_ready": {
+        "region_id": 3, "read_ts": 500, "resolved": 420}})
+    assert re is not None and re.data_is_not_ready is not None
+    assert re.data_is_not_ready.region_id == 3
+    assert re.data_is_not_ready.safe_ts == 420
+    back = kp.RegionError.decode(re.encode())
+    assert back.data_is_not_ready.safe_ts == 420
+    # the read plane's enriched refusal (safe_ts hint, resolved absent)
+    re = _region_error({"data_not_ready": {"region_id": 3, "safe_ts": 7}})
+    assert re.data_is_not_ready.safe_ts == 7

@@ -83,6 +83,55 @@ def test_sched_too_busy_carries_retry_after():
 
 
 # ---------------------------------------------------------------------------
+# data_not_ready: the watermark-aware class (ISSUE 7 bugfix satellite)
+# ---------------------------------------------------------------------------
+
+def _dnr(read_ts, resolved):
+    from tikv_tpu.raft.raftkv import RaftKv
+
+    return RaftKv.DataNotReadyError(1, read_ts, resolved)
+
+
+def test_data_not_ready_routes_retryable_not_permanent():
+    """The PR-7 bugfix: DataNotReadyError used to classify ``permanent``
+    and was never retried — now it is its own retryable class."""
+    assert classify(_dnr(100, 50)) == "data_not_ready"
+    r = Retrier(RetryPolicy(base_s=0.001, max_s=0.002, jitter=0.0), site="t")
+    assert r.should_retry(_dnr(100, 50)) is not None
+
+
+def test_data_not_ready_hint_derived_from_watermark_lag():
+    from tikv_tpu.util.retry import TSO_PHYSICAL_SHIFT, data_not_ready_hint
+
+    # logical test-clock lag: ~1ms per unit, capped
+    assert data_not_ready_hint(_dnr(120, 100)) == pytest.approx(0.02)
+    assert data_not_ready_hint(_dnr(10_000, 0)) == pytest.approx(0.1)
+    # physical TSO lag (ms << 18): converts exactly, capped at 1s
+    e = _dnr(2_000 << TSO_PHYSICAL_SHIFT, 1_750 << TSO_PHYSICAL_SHIFT)
+    assert data_not_ready_hint(e) == pytest.approx(0.25)
+    e = _dnr(60_000 << TSO_PHYSICAL_SHIFT, 0)
+    assert data_not_ready_hint(e) == pytest.approx(1.0)
+    # the retrier's sleep honors the derived hint over a tiny curve
+    r = Retrier(RetryPolicy(base_s=0.0001, max_s=0.0002, jitter=0.0), site="t")
+    assert r.should_retry(_dnr(120, 100)) >= 0.02
+
+
+def test_data_not_ready_call_loop_waits_then_succeeds():
+    calls = [0]
+
+    def fn():
+        calls[0] += 1
+        if calls[0] < 3:
+            raise _dnr(200, 100)  # watermark 100 behind
+        return "served"
+
+    slept = []
+    assert retry.call(fn, site="t", sleep=slept.append) == "served"
+    assert calls[0] == 3
+    assert all(s >= 0.1 for s in slept), "backoff must wait for the watermark"
+
+
+# ---------------------------------------------------------------------------
 # call(): the loop
 # ---------------------------------------------------------------------------
 

@@ -139,6 +139,62 @@ def test_resolver_watermark():
     assert r.resolve(50) == 200
 
 
+def test_resolver_retrack_same_key_newer_ts_drops_stale_heap_head():
+    """track -> untrack -> re-track of ONE key at a newer ts: the old heap
+    head is stale (locks_by_key moved on) and must not pin the watermark."""
+    r = Resolver(1)
+    r.track_lock(10, b"k")
+    r.untrack_lock(b"k")
+    r.track_lock(20, b"k")
+    # the (10, k) heap head is stale: the live lock is 20, so the watermark
+    # pins at 19, NOT 9
+    assert r.resolve(100) == 19
+    # re-track the SAME key even newer while the (20, k) entry still sits
+    # in the heap — again only the live registration counts
+    r.track_lock(40, b"k")
+    assert r.resolve(100) == 39
+    r.untrack_lock(b"k")
+    assert r.resolve(100) == 100
+
+
+def test_resolver_watermark_never_regresses_under_late_lock():
+    """A lock tracked BELOW the published watermark (late replay, observer
+    race) must not pull resolved_ts backwards — the max() keeps the
+    guarantee reads at/below the watermark rely on."""
+    r = Resolver(1)
+    assert r.resolve(100) == 100
+    r.track_lock(50, b"late")
+    assert r.resolve(200) == 100  # candidate 49 loses to the floor
+    r.untrack_lock(b"late")
+    assert r.resolve(200) == 200
+
+
+def test_min_resolved_ts_and_safe_ts_with_zero_regions():
+    from tikv_tpu.pd.client import MockPd
+
+    ep = ResolvedTsEndpoint(MockPd())
+    assert ep.min_resolved_ts() == 0
+    assert ep.safe_ts() == 0
+    assert ep.progress_snapshot() == {}
+    assert ep.progress_of(7) == (0, 0)
+
+
+def test_safe_ts_minimum_over_progress_and_resolver_fallback():
+    """safe_ts = min over known regions: disseminated pairs win where
+    present, a region with no pair falls back to its local resolver."""
+    from tikv_tpu.pd.client import MockPd
+
+    ep = ResolvedTsEndpoint(MockPd())
+    ep.resolver(1).resolve(30)          # local-only region: resolver floor
+    with ep._mu:
+        ep.read_progress[2] = (12, 4)   # disseminated pair
+    assert ep.progress_snapshot() == {1: (30, 0), 2: (12, 4)}
+    assert ep.safe_ts() == 12
+    with ep._mu:
+        ep.read_progress[2] = (45, 5)
+    assert ep.safe_ts() == 30
+
+
 def test_resolved_ts_over_cluster():
     from tikv_tpu.pd.client import MockPd
     from tikv_tpu.raft.cluster import FIRST_REGION_ID, Cluster

@@ -90,6 +90,8 @@ _SANITIZER_WIRED = {
     "tikv_tpu/raft/store.py",
     "tikv_tpu/raft/batch_system.py",
     "tikv_tpu/raft/fsm_system.py",
+    "tikv_tpu/sidecar/resolved_ts.py",
+    "tikv_tpu/server/read_plane.py",
     "tikv_tpu/util/chaos.py",
     "tikv_tpu/util/retry.py",
     "tikv_tpu/util/worker.py",

@@ -47,6 +47,22 @@ class CoprResponse:
     metrics: dict = field(default_factory=dict)  # tracker.rs phase breakdown
 
 
+def stale_read_ctx(req: CoprRequest) -> dict | None:
+    """Effective stale-read context for admission and snapshotting: the DAG
+    executes its MVCC read at ``req.start_ts``, so the watermark check must
+    cover start_ts even when the client declared a lower ``read_ts`` —
+    otherwise a lagging replica would admit a request whose scan then reads
+    above the watermark (a typed DataNotReady here, not a tripped pairing
+    invariant in the region cache)."""
+    ctx = req.context or None
+    if not ctx or not ctx.get("stale_read"):
+        return ctx
+    read_ts = ctx.get("read_ts")
+    if read_ts is None or int(read_ts) < req.start_ts:
+        ctx = dict(ctx, read_ts=req.start_ts)
+    return ctx
+
+
 class Endpoint:
     def __init__(
         self,
@@ -190,8 +206,12 @@ class Endpoint:
             for start, end in req.ranges:
                 self.cm.read_range_check(Key.from_raw(start), Key.from_raw(end), req.start_ts)
         tracker.on_schedule()
-        snap = self.engine.snapshot(req.context or None)
+        snap = self.engine.snapshot(stale_read_ctx(req))
         tracker.on_snapshot_finished()
+        # follower stale serving (docs/stale_reads.md): the snapshot itself
+        # says whether it came off the stale path — counted per serving
+        # path below so operators see read traffic scale with replicas
+        stale_snap = bool(getattr(snap, "stale", False))
         use_device = self.device_enabled() and jax_eval.supports(req.dag)
         if use_device and not self.breaker.allow("unary"):
             # tripped: repeated unary device faults — serve straight off the
@@ -231,6 +251,8 @@ class Endpoint:
                 from_cache = (cache is not None and cache.filled and src is None
                               and rc_outcome not in ("miss", "too_big"))
                 self.breaker.record_success("unary")
+                if stale_snap:
+                    self.count_follower_read("device")
                 return CoprResponse(
                     resp.encode(), from_device=True,
                     from_cache=from_cache,
@@ -262,7 +284,22 @@ class Endpoint:
         resp = BatchExecutorsRunner(req.dag, src).handle_request()
         m = tracker.on_finish(scanned_keys=stats.write.processed_keys, from_device=False)
         self.slow_log.observe(tracker)
+        if stale_snap:
+            self.count_follower_read("cpu")
         return CoprResponse(resp.encode(), from_device=False, metrics=m.to_dict())
+
+    @staticmethod
+    def count_follower_read(path: str) -> None:
+        """Follower/stale-served DAG requests, by serving path — the series
+        that shows coprocessor read traffic scaling with replica count
+        instead of leader count (docs/stale_reads.md)."""
+        from ..util.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "tikv_coprocessor_follower_read_total",
+            "DAG requests served off a stale-read (follower-eligible) "
+            "snapshot, by serving path",
+        ).inc(path=path)
 
     def _tracked(self, tracker, handler, req: CoprRequest) -> CoprResponse:
         resp = handler(req, tracker)
@@ -275,7 +312,7 @@ class Endpoint:
         CPU pipeline; the device path answers whole queries)."""
         if req.tp != REQ_TYPE_DAG:
             raise ValueError("streaming supports DAG requests only")
-        snap = self.engine.snapshot(req.context or None)
+        snap = self.engine.snapshot(stale_read_ctx(req))
         src = MvccScanSource(snap, req.start_ts, req.ranges, statistics=Statistics())
         # frames flush at whole response chunks — align the chunk size so
         # streams actually split at the requested granularity (on a copy:
@@ -296,7 +333,7 @@ class Endpoint:
 
         tracker = tracker or Tracker()
         tracker.on_schedule()
-        snap = self.engine.snapshot(req.context or None)
+        snap = self.engine.snapshot(stale_read_ctx(req))
         tracker.on_snapshot_finished()
         src = MvccBatchScanSource(snap, req.start_ts, req.ranges)
         executor = build_executors(req.dag, src)
@@ -339,7 +376,7 @@ class Endpoint:
 
         tracker = tracker or Tracker()
         tracker.on_schedule()
-        snap = self.engine.snapshot(req.context or None)
+        snap = self.engine.snapshot(stale_read_ctx(req))
         tracker.on_snapshot_finished()
         kvs = []
         for start, end in req.ranges:
@@ -549,6 +586,19 @@ class Endpoint:
         apply_index = getattr(snap, "apply_index", None)
         if apply_index is not None:
             context.setdefault("apply_index", apply_index)
+        rp = getattr(snap, "read_progress", None)
+        if rp is not None:
+            # RegionReadProgress pairing invariant (docs/stale_reads.md): a
+            # stale snapshot's claimed apply_index sits at/above the pair's
+            # required index (raftkv refuses otherwise) and the DAG reads
+            # at/below the paired watermark — which is exactly why the
+            # (region_id, epoch, apply_index) image key stays correct for
+            # follower warm serving: the image can never claim data the
+            # watermark hasn't covered
+            assert apply_index is not None and apply_index >= rp[1], \
+                f"stale snapshot apply_index {apply_index} below required {rp[1]}"
+            assert req.start_ts <= rp[0], \
+                f"stale DAG read at {req.start_ts} above resolved ts {rp[0]}"
         cache, outcome, delta_rows = self.region_cache.serve(
             snap, context, execs[0].columns_info, req.ranges, req.start_ts
         )

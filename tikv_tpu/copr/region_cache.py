@@ -26,6 +26,15 @@ deltas move.
   or an over-budget region serves through the existing per-request path —
   the cache only ever degrades to current behavior.
 
+Follower stale serving (docs/stale_reads.md): images built off STALE-read
+snapshots need no special handling — a stale snapshot's ``apply_index`` is
+guaranteed at/above the RegionReadProgress pair's required index and its
+reads sit at/below the paired watermark (``raftkv`` refuses otherwise, and
+``endpoint._region_cache_for`` asserts the pairing), so the
+``(region_id, epoch, apply_index)`` key already identifies exactly the data
+version the watermark covers.  Leader and follower images of one region
+therefore never alias to different bytes under one key.
+
 Invalidation: ``raft/store.py`` calls :func:`notify_region_epoch_change` on
 split / merge / conf change; the epoch in the key catches anything missed.
 Memory: LRU over images + a byte budget bound host AND device residency (a

@@ -68,7 +68,7 @@ from .dag import (
     TableScan,
     TopN,
 )
-from .endpoint import REQ_TYPE_DAG, CoprRequest, CoprResponse
+from .endpoint import REQ_TYPE_DAG, CoprRequest, CoprResponse, stale_read_ctx
 from .region_cache import _epoch_of, schema_sig
 from .rpn import ColumnRef, Constant, FuncCall
 from .sig_map import resolve_sig
@@ -273,6 +273,11 @@ class CoprReadScheduler:
             # queue slot, let alone a device dispatch
             self._count_deadline("admission")
             raise DeadlineExceeded("deadline expired before admission")
+        # stale-read admission (docs/stale_reads.md): a read_ts above this
+        # replica's RegionReadProgress raises DataNotReady HERE — before a
+        # queue slot, a snapshot, or any device dispatch — so the client's
+        # watermark-aware backoff starts immediately
+        self._check_stale_ready(req)
         if (not self._running or not self.ep._gate_ok("batch")
                 or not self._batchable(req)):
             # the BATCH_FUSION gate guards this path exactly like
@@ -389,6 +394,30 @@ class CoprReadScheduler:
                 it.ticket.resp = results[it.index]
             it.ticket.done.set()
 
+    def _check_stale_ready(self, req: CoprRequest, count: bool = True) -> None:
+        """Raise DataNotReady for a stale read this replica cannot admit —
+        exactly what the engine's snapshot would raise, but WITHOUT freezing
+        the engine (RaftKv.check_read_ready).  No-op on engines without the
+        probe (plain local engines) and on non-stale contexts."""
+        ctx = stale_read_ctx(req)
+        if not ctx or not ctx.get("stale_read"):
+            return
+        ready = getattr(self.ep.engine, "check_read_ready", None)
+        if ready is None:
+            return
+        try:
+            ready(ctx)
+        except Exception as exc:
+            if count:
+                # a witness/non-hosting replica refuses NotLeader — that is
+                # a routing problem, not watermark lag; keeping the reasons
+                # apart keeps the safe_ts-lag dashboards honest
+                if type(exc).__name__ == "DataNotReadyError":
+                    self._count_shed("data_not_ready")
+                else:
+                    self._count_shed("stale_not_leader")
+            raise
+
     # -- the scheduler core -------------------------------------------------
 
     def _serve(self, items: list[_Item]):
@@ -408,6 +437,17 @@ class CoprReadScheduler:
             self._count_shed("deadline")
             errors[it.index] = DeadlineExceeded("deadline expired in queue")
         if expired:
+            items = [it for it in items if errors[it.index] is None]
+        # stale-read admission at dispatch: a watermark-lagging item fails
+        # typed BEFORE grouping — it must never cost a padded batch slot
+        not_ready = []
+        for it in items:
+            try:
+                self._check_stale_ready(it.req)
+            except Exception as exc:  # noqa: BLE001 — DataNotReady/NotLeader
+                errors[it.index] = exc
+                not_ready.append(it)
+        if not_ready:
             items = [it for it in items if errors[it.index] is None]
         # group by plan signature, then by distinct region view within a sig
         by_sig: dict[tuple, dict[tuple, _Slot]] = {}
@@ -531,7 +571,7 @@ class CoprReadScheduler:
                 self.ep.cm.read_range_check(
                     Key.from_raw(start), Key.from_raw(end), req.start_ts
                 )
-        snap = self.ep.engine.snapshot(req.context or None)
+        snap = self.ep.engine.snapshot(stale_read_ctx(req))
         tracker = Tracker()
         cache, outcome = self.ep._region_cache_for(req, snap, tracker)
         if cache is None:
@@ -539,6 +579,10 @@ class CoprReadScheduler:
             outcome = ""
         if cache is None:
             return False
+        if getattr(snap, "stale", False):
+            # warm follower device serving: the slot's whole fan-in rides a
+            # stale-read snapshot (docs/stale_reads.md)
+            self.ep.count_follower_read("batch")
         if not cache.filled:
             # cold block cache: the first request fills it through the
             # normal per-request path (and keeps its own answer); the rest

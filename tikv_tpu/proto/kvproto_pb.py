@@ -124,6 +124,14 @@ class RaftEntryTooLarge(Kv):
     FIELDS = (U(1, "region_id"), U(2, "entry_size"))
 
 
+class DataIsNotReady(Kv):
+    """errorpb.DataIsNotReady: a follower stale read above the region's
+    resolved-ts watermark (docs/stale_reads.md); safe_ts tells the client
+    the highest ts this replica CAN serve."""
+
+    FIELDS = (U(1, "region_id"), U(2, "peer_id"), U(3, "safe_ts"))
+
+
 class RegionError(Kv):
     """errorpb.Error."""
 
@@ -137,6 +145,7 @@ class RegionError(Kv):
         M(7, "stale_command", lambda: StaleCommand),
         M(8, "store_not_match", lambda: StoreNotMatch),
         M(9, "raft_entry_too_large", lambda: RaftEntryTooLarge),
+        M(13, "data_is_not_ready", lambda: DataIsNotReady),
     )
 
 

@@ -74,6 +74,12 @@ class RegionSnapshot(Snapshot):
         # any OTHER engine — region ids alone are not process-unique
         # (embedded endpoints, multi-store test processes)
         self.data_token = data_token
+        # stale-read provenance (docs/stale_reads.md): the stale path stamps
+        # ``stale=True`` plus the RegionReadProgress pair it was admitted
+        # under, so serving layers can count follower-served reads and
+        # assert the pairing invariant (apply_index >= required index)
+        self.stale = False
+        self.read_progress: tuple[int, int] | None = None
         self._lower = keys.data_key(region.start_key)
         self._upper = keys.data_end_key(region.end_key)
 
@@ -142,36 +148,61 @@ class RaftKv(Engine):
                 f"region {region_id}: stale read at {read_ts} above resolved ts {resolved}"
             )
 
+    def _stale_ready(self, peer, ctx: dict) -> tuple[int, int]:
+        """ONE definition of stale-read admission (snapshot() and the copr
+        scheduler's ``check_read_ready`` probe): returns the region's
+        RegionReadProgress pair when this replica may serve ``read_ts``,
+        else raises NotLeader (witness) / DataNotReady (watermark or apply
+        lag).  Never touches the engine."""
+        # follower stale read: safe at/below the region's resolved-ts
+        # watermark on any DATA replica — witnesses store no data
+        if peer.peer_id in peer.node.witnesses:
+            raise NotLeaderError(peer.region.id, self.store.leader_store_of(peer.region.id))
+        if self.resolved_ts is None:
+            raise ValueError("stale reads need a resolved-ts endpoint")
+        read_ts = ctx.get("read_ts")
+        if read_ts is None:
+            raise ValueError("stale reads need read_ts in the context")
+        resolved, required_idx = self.resolved_ts.progress_of(peer.region.id)
+        # RegionReadProgress pairing: the watermark is only meaningful on
+        # a replica whose ENGINE contains at least the index it was
+        # computed at (apply_index — node.applied may run ahead of the
+        # apply pipeline) — a lagging follower must refuse rather than
+        # serve a snapshot missing committed data
+        if read_ts > resolved or peer.apply_index < required_idx:
+            raise RaftKv.DataNotReadyError(peer.region.id, read_ts, resolved)
+        return resolved, required_idx
+
+    def check_read_ready(self, ctx: dict | None) -> tuple[int, int] | None:
+        """Admission-time readiness probe: raises exactly what ``snapshot``
+        would raise for a stale read — NotLeader on a witness, DataNotReady
+        on a lagging watermark/apply — WITHOUT freezing the engine.  The
+        copr read scheduler calls this before a stale request costs a queue
+        slot, let alone a device dispatch (docs/stale_reads.md).  Returns
+        the (resolved_ts, required_apply_index) pair, or None for reads
+        that don't take the stale path."""
+        ctx = ctx or {}
+        if not ctx.get("stale_read"):
+            return None
+        return self._stale_ready(self._peer_for_ctx(ctx), ctx)
+
     def snapshot(self, ctx: dict | None = None) -> RegionSnapshot:
         peer = self._peer_for_ctx(ctx)
         ctx = ctx or {}
         if ctx.get("stale_read"):
-            # follower stale read: safe at/below the region's resolved-ts
-            # watermark on any DATA replica — witnesses store no data
-            if peer.peer_id in peer.node.witnesses:
-                raise NotLeaderError(peer.region.id, self.store.leader_store_of(peer.region.id))
-            if self.resolved_ts is None:
-                raise ValueError("stale reads need a resolved-ts endpoint")
-            read_ts = ctx.get("read_ts")
-            if read_ts is None:
-                raise ValueError("stale reads need read_ts in the context")
-            resolved, required_idx = self.resolved_ts.progress_of(peer.region.id)
-            # RegionReadProgress pairing: the watermark is only meaningful on
-            # a replica whose ENGINE contains at least the index it was
-            # computed at (apply_index — node.applied may run ahead of the
-            # apply pipeline) — a lagging follower must refuse rather than
-            # serve a snapshot missing committed data
-            if read_ts > resolved or peer.apply_index < required_idx:
-                raise RaftKv.DataNotReadyError(peer.region.id, read_ts, resolved)
+            resolved, required_idx = self._stale_ready(peer, ctx)
             # apply_index SAMPLED BEFORE the engine freeze: the snapshot may
             # contain later applies, but must never claim an index whose data
             # it lacks — the region cache stamps images with this index and a
             # too-high claim would mark missing writes as present
             # (docs/write_path.md apply_index contract)
             applied = peer.apply_index
-            return RegionSnapshot(self.store.engine.snapshot(), peer.region.clone(),
+            snap = RegionSnapshot(self.store.engine.snapshot(), peer.region.clone(),
                                   apply_index=applied,
                                   data_token=self.data_token)
+            snap.stale = True
+            snap.read_progress = (resolved, required_idx)
+            return snap
         if not peer.node.is_leader():
             if ctx.get("replica_read") and peer.peer_id not in peer.node.witnesses:
                 # replica read (read.rs replica-read + ReplicaReadLockChecker

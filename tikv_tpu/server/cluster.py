@@ -43,29 +43,50 @@ CLIENT_RETRY = retry.RetryPolicy(base_s=0.05, max_s=0.5, jitter=0.3)
 class StoreNode:
     """One store: engine + Store + raft loops + TCP server (a TiKVServer).
 
-    ``full_service`` additionally assembles the txn stack — RaftKv, Storage,
-    and a WaiterManager whose detector forwards wait-for edges to the
-    cluster's detector leader — so scenario tests can drive transactional
-    RPCs (pessimistic locks, deadlocks) across real stores."""
+    ``full_service`` additionally assembles the serving stack — RaftKv,
+    Storage, a coprocessor endpoint, the resolved-ts sidecar (check_leader
+    fan-out over the cluster's sockets), the read-degradation ladder
+    (``read_plane``), and a WaiterManager whose detector forwards wait-for
+    edges to the cluster's detector leader — so scenario tests can drive
+    transactional RPCs AND follower/forwarded reads across real stores."""
 
     def __init__(self, cluster: "ServerCluster", store_id: int, engine=None,
                  full_service: bool = False):
         self.cluster = cluster
+        self.full_service = full_service
         security = cluster.security
         self.transport = RemoteTransport(cluster.resolve, security=security)
         self.node = Node(cluster.pd, self.transport, store_id=store_id, engine=engine)
         self.store = self.node.store
+        self.read_plane = None
+        self.resolved_ts = None
         if full_service:
-            from ..storage.storage import Storage
+            from ..copr.endpoint import Endpoint
+            from ..sidecar.resolved_ts import ResolvedTsEndpoint
             from .lock_manager import DetectorHandle, WaiterManager
+            from .read_plane import ReadPlane
+            from ..storage.storage import Storage
 
-            self.raftkv = RaftKv(self.store)
+            self.read_plane = ReadPlane(
+                store=self.store, resolver=cluster.resolve, security=security,
+            )
+            self.resolved_ts = ResolvedTsEndpoint(
+                cluster.pd, store_id=store_id,
+                # the fan-out rides the read plane's peer-client pool
+                check_leader_send=lambda sid, payload: self.read_plane.call(
+                    sid, "raft_check_leader", payload, timeout=2.0),
+            )
+            self.resolved_ts.attach_store(self.store)
+            self.read_plane.resolved_ts = self.resolved_ts
+            self.raftkv = RaftKv(self.store, resolved_ts=self.resolved_ts)
             self.lock_manager = WaiterManager(
                 detector=DetectorHandle(self.store, cluster.resolve, security=security)
             )
             self.service = KvService(
                 Storage(engine=self.raftkv), raft_router=self.store,
+                copr=Endpoint(self.raftkv, enable_device=False),
                 lock_manager=self.lock_manager, pd=cluster.pd,
+                resolved_ts=self.resolved_ts, read_plane=self.read_plane,
             )
         else:
             self.lock_manager = None
@@ -85,6 +106,8 @@ class StoreNode:
         self.node.stop()
         self.server.stop()
         self.transport.close()
+        if self.read_plane is not None:
+            self.read_plane.close()
         if self.lock_manager is not None:
             self.lock_manager.close()
 
@@ -104,6 +127,10 @@ class ServerCluster:
         self.nodes: dict[int, StoreNode] = {}
         self._ids = itertools.count(5000)
         self._engines = engines or {}
+        # region -> leader store route cache, refreshed from NotLeader hints
+        # (the client-go region-cache role): must_put/must_get consult it
+        # before falling back to the wait_leader scan
+        self._route: dict[int, int] = {}
         for sid in range(1, n_stores + 1):
             self.nodes[sid] = StoreNode(self, sid, engine=self._engines.get(sid),
                                         full_service=full_service)
@@ -153,7 +180,8 @@ class ServerCluster:
         restart over a durable engine; fsm/store.rs init recovers peers)."""
         old = self.nodes[store_id]
         assert not old.running, f"store {store_id} still running"
-        node = StoreNode(self, store_id, engine=old.store.engine)
+        node = StoreNode(self, store_id, engine=old.store.engine,
+                         full_service=old.full_service)
         node.store.recover()
         self.nodes[store_id] = node
         node.start()
@@ -208,31 +236,117 @@ class ServerCluster:
                 return p.region.id
         raise KeyError(key)
 
+    def _routed_leader(self, region_id: int, timeout: float = 2.0) -> StorePeer:
+        """Leader lookup through the route cache: a cached NotLeader hint
+        answers without the all-store wait_leader poll; a stale entry drops
+        and falls back."""
+        sid = self._route.get(region_id)
+        if sid is not None:
+            node = self.nodes.get(sid)
+            if node is not None and node.running:
+                p = node.store.peers.get(region_id)
+                if p is not None and p.node.is_leader():
+                    return p
+            self._route.pop(region_id, None)
+        p = self.wait_leader(region_id, timeout=timeout)
+        self._route[region_id] = p.store.store_id
+        return p
+
+    def _note_not_leader(self, region_id: int, exc: Exception) -> None:
+        """NotLeader hints refresh the route cache instead of forcing the
+        next attempt back through wait_leader's full poll."""
+        from ..raft.region import NotLeaderError
+
+        if isinstance(exc, NotLeaderError) and exc.leader_store:
+            self._route[region_id] = exc.leader_store
+        else:
+            self._route.pop(region_id, None)
+
     def must_put(self, key: bytes, value: bytes, cf: str = CF_DEFAULT, timeout: float = 10.0) -> None:
         """Leader-routed put with the shared retry policy: NotLeader/Epoch/
         Timeout re-route freely; AssertionError/KeyError (routing races, but
         also how a REAL bug would surface) ride the bounded suspect class."""
         def attempt():
             region_id = self.region_for_key(key)
-            leader = self.wait_leader(region_id, timeout=2.0)
+            leader = self._routed_leader(region_id)
             kv = RaftKv(leader.store)
             wb = WriteBatch()
             wb.put_cf(cf, key, value)
-            kv.write({"region_id": region_id}, wb)
+            try:
+                kv.write({"region_id": region_id}, wb)
+            except Exception as e:  # noqa: BLE001 — hint + re-raise to retry
+                self._note_not_leader(region_id, e)
+                raise
 
         retry.call(attempt, policy=CLIENT_RETRY, timeout=timeout,
                    site="server_cluster.must_put")
 
-    def must_get(self, key: bytes, cf: str = CF_DEFAULT, timeout: float = 10.0) -> bytes | None:
+    def must_get(self, key: bytes, cf: str = CF_DEFAULT, timeout: float = 10.0,
+                 stale_fallback: bool = False,
+                 max_staleness: int | None = None) -> bytes | None:
+        """Leader-routed snapshot read.  ``stale_fallback=True`` opts into
+        the degraded mode (docs/stale_reads.md): when no leader is
+        reachable within the budget, serve from any replica at the freshest
+        RegionReadProgress watermark — bounded by ``max_staleness``
+        timestamp units behind the current TSO (unbounded when None)."""
         def attempt():
             region_id = self.region_for_key(key)
-            leader = self.wait_leader(region_id, timeout=2.0)
+            leader = self._routed_leader(region_id)
             kv = RaftKv(leader.store)
-            snap = kv.snapshot({"region_id": region_id})
+            try:
+                snap = kv.snapshot({"region_id": region_id})
+            except Exception as e:  # noqa: BLE001
+                self._note_not_leader(region_id, e)
+                raise
             return snap.get_cf(cf, key)
 
-        return retry.call(attempt, policy=CLIENT_RETRY, timeout=timeout,
-                          site="server_cluster.must_get")
+        try:
+            return retry.call(attempt, policy=CLIENT_RETRY, timeout=timeout,
+                              site="server_cluster.must_get")
+        except Exception:
+            if not stale_fallback:
+                raise
+            return self.stale_get(key, cf=cf, max_staleness=max_staleness)
+
+    def stale_get(self, key: bytes, cf: str = CF_DEFAULT,
+                  read_ts: int | None = None,
+                  max_staleness: int | None = None) -> bytes | None:
+        """Follower stale read: serve off ANY replica whose
+        RegionReadProgress admits ``read_ts`` (default: the freshest
+        watermark any live replica publishes).  ``max_staleness`` bounds
+        how far behind the current TSO that watermark may be."""
+        region_id = self.region_for_key(key)
+        nodes = [n for n in self.nodes.values()
+                 if n.running and n.resolved_ts is not None]
+        if not nodes:
+            raise RuntimeError("stale reads need full_service store nodes")
+        if read_ts is None:
+            read_ts = max(n.resolved_ts.progress_of(region_id)[0] for n in nodes)
+        if max_staleness is not None:
+            now = self.pd.get_tso()
+            if now - read_ts > max_staleness:
+                raise RaftKv.DataNotReadyError(region_id, now - max_staleness,
+                                                read_ts)
+        last: Exception | None = None
+        for node in nodes:
+            kv = RaftKv(node.store, resolved_ts=node.resolved_ts)
+            try:
+                snap = kv.snapshot({"region_id": region_id,
+                                    "stale_read": True, "read_ts": read_ts})
+                return snap.get_cf(cf, key)
+            except Exception as e:  # noqa: BLE001 — next replica may serve
+                last = e
+        raise last if last is not None else KeyError(key)
+
+    def advance_resolved_ts(self) -> dict[int, dict[int, int]]:
+        """One watermark advance round on every full-service store (the
+        standalone deployment's background loop, driven explicitly so tests
+        stay deterministic)."""
+        out: dict[int, dict[int, int]] = {}
+        for node in self.nodes.values():
+            if node.running and node.resolved_ts is not None:
+                out[node.store.store_id] = node.resolved_ts.advance_all()
+        return out
 
     # -- admin --------------------------------------------------------------
 

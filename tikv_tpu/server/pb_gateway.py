@@ -124,6 +124,18 @@ def _region_error(err: dict) -> kp.RegionError | None:
             message="region not found",
             region_not_found=kp.RegionNotFound(region_id=err["region_not_found"].get("region_id", 0)),
         )
+    if "data_not_ready" in err:
+        dnr = err["data_not_ready"]
+        # safe_ts on the wire = the highest ts this replica CAN serve: the
+        # refusal's resolved watermark (or the store floor hint when the
+        # read plane enriched the error) — what a kvproto client lowers its
+        # stale read_ts to (docs/stale_reads.md)
+        safe = dnr.get("resolved") or dnr.get("safe_ts") or 0
+        return kp.RegionError(
+            message="data is not ready",
+            data_is_not_ready=kp.DataIsNotReady(
+                region_id=dnr.get("region_id", 0) or 0, safe_ts=safe),
+        )
     return None
 
 

@@ -1,0 +1,347 @@
+"""Read-degradation ladder: leader → one-hop forward → follower stale read
+→ typed refusal with hints.
+
+Re-expression of the reference's read routing resilience (raftstore's
+forwarding of reads to the leader plus the stale-read path gated by
+RegionReadProgress — see docs/stale_reads.md for the safety argument): a
+store receiving a read for a region it does not lead should not just bounce
+a ``NotLeader`` back across the WAN.  Instead:
+
+1. **forward** the request ONE hop to the store it believes leads the
+   region.  The hop is loop-guarded by a ``forwarded`` context flag — a
+   forwarded request is never forwarded again, so two stores with stale
+   views of each other can never ping-pong a request between them.
+2. when the leader is **unreachable** (no route, connection error, timeout,
+   or the per-store forward breaker is open), serve locally as a follower
+   **stale read** iff the request permits it: the context carries
+   ``stale_read``/``stale_fallback`` and a ``read_ts`` at or below the
+   region's RegionReadProgress watermark (the engine enforces the pair:
+   ``read_ts <= resolved_ts`` AND ``apply_index >= required_apply_index``).
+3. else return the typed **refusal**: the ``not_leader`` /
+   ``data_not_ready`` error enriched with the freshest leader hint, the
+   store's ``safe_ts`` and the region's progress pair — so the client can
+   re-route, lower its read ts, or back off watermark-aware
+   (``util.retry``'s ``data_not_ready`` class).
+
+Every rung is counted per outcome (``tikv_read_forward_total``,
+``tikv_read_stale_serve_total``, ``tikv_read_refuse_total``) and charted on
+the raft dashboard next to the ``tikv_resolved_ts_safe_ts_lag`` gauge.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..analysis.sanitizer import make_lock
+from ..util.metrics import REGISTRY
+
+#: per-store forward breaker: first-failure cooldown and the exponential
+#: ceiling — a dead leader store costs one probe per cooldown, not one per
+#: read that lands here
+_BREAKER_BASE_S = 0.05
+_BREAKER_MAX_S = 2.0
+
+
+def _count_forward(outcome: str) -> None:
+    REGISTRY.counter(
+        "tikv_read_forward_total",
+        "One-hop read forwards attempted by the dispatch tier, by outcome",
+    ).inc(outcome=outcome)
+
+
+def _count_stale_serve(path: str, cause: str) -> None:
+    REGISTRY.counter(
+        "tikv_read_stale_serve_total",
+        "Reads served locally as follower stale reads by the dispatch "
+        "tier, by request family and degradation cause",
+    ).inc(path=path, cause=cause)
+
+
+def _count_refuse(cause: str) -> None:
+    REGISTRY.counter(
+        "tikv_read_refuse_total",
+        "Reads the dispatch tier refused with a typed hint-carrying error, "
+        "by cause",
+    ).inc(cause=cause)
+
+
+def _path_of(method: str) -> str:
+    return "copr" if method.startswith("coprocessor") else "kv"
+
+
+class ReadPlane:
+    """One store's read dispatch tier.
+
+    ``store`` (raft ``Store``) answers leadership lookups; ``resolved_ts``
+    (``ResolvedTsEndpoint``) provides the ``safe_ts``/progress hints;
+    ``resolver`` maps a store id to a socket address for the forward hop.
+    ``send`` overrides the wire transport entirely — tests inject a
+    callable ``(store_id, method, req, timeout) -> dict`` and never open a
+    socket."""
+
+    def __init__(self, store=None, resolved_ts=None, resolver=None,
+                 security=None, send=None, forward_timeout: float = 2.0):
+        self.store = store
+        self.store_id = getattr(store, "store_id", None)
+        self.resolved_ts = resolved_ts
+        self.resolver = resolver
+        self.security = security
+        self.forward_timeout = forward_timeout
+        self._send = send
+        self._mu = make_lock("server.read_plane")
+        self._clients: dict[int, object] = {}
+        # per-store forward breaker: (consecutive failures, down-until)
+        self._down: dict[int, tuple[int, float]] = {}
+
+    # -- transport ----------------------------------------------------------
+
+    def call(self, store_id: int, method: str, req: dict,
+             timeout: float | None = None):
+        """One RPC to a peer store (shared by the forward hop and the
+        resolved-ts check_leader fan-out).  Raises on transport failure."""
+        if self._send is not None:
+            return self._send(store_id, method, req,
+                              timeout or self.forward_timeout)
+        c = self._client(store_id)
+        if c is None:
+            raise ConnectionError(f"no route to store {store_id}")
+        try:
+            return c.call(method, req, timeout=timeout or self.forward_timeout)
+        except (ConnectionError, OSError, TimeoutError):
+            self._drop_client(store_id, c)
+            raise
+
+    def _client(self, store_id: int):
+        with self._mu:
+            c = self._clients.get(store_id)
+        if c is not None:
+            return c
+        if self.resolver is None:
+            return None
+        addr = self.resolver(store_id)
+        if addr is None:
+            return None
+        from .server import Client
+
+        # connect OUTSIDE the pool lock: a slow peer handshake must not
+        # stall forwards to healthy stores.  A racing connect wastes one
+        # socket; the loser closes.
+        c = Client(addr[0], addr[1], security=self.security)
+        with self._mu:
+            cur = self._clients.setdefault(store_id, c)
+        if cur is not c:
+            try:
+                c.close()
+            except OSError:
+                pass
+        return cur
+
+    def _drop_client(self, store_id: int, c) -> None:
+        with self._mu:
+            if self._clients.get(store_id) is c:
+                self._clients.pop(store_id, None)
+        try:
+            c.close()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        with self._mu:
+            clients, self._clients = list(self._clients.values()), {}
+        for c in clients:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    # -- per-store forward breaker ------------------------------------------
+
+    def _allow(self, store_id: int) -> bool:
+        now = time.monotonic()
+        with self._mu:
+            failures, until = self._down.get(store_id, (0, 0.0))
+            if failures == 0:
+                return True
+            if now < until:
+                return False
+            # half-open: exactly ONE caller probes per cooldown lapse —
+            # re-arm before releasing the lock so every concurrent read
+            # keeps degrading instead of all piling onto a still-dead
+            # store at once; the probe's outcome then clears or extends
+            self._down[store_id] = (failures, now + self.forward_timeout)
+            return True
+
+    def _record_failure(self, store_id: int) -> None:
+        now = time.monotonic()
+        with self._mu:
+            failures, _ = self._down.get(store_id, (0, 0.0))
+            failures += 1
+            cooldown = min(_BREAKER_BASE_S * (2.0 ** (failures - 1)),
+                           _BREAKER_MAX_S)
+            self._down[store_id] = (failures, now + cooldown)
+
+    def _record_success(self, store_id: int) -> None:
+        with self._mu:
+            self._down.pop(store_id, None)
+
+    # -- the ladder ---------------------------------------------------------
+
+    def degrade(self, service, method: str, req: dict, resp: dict, local):
+        """Run a locally-failed read down the ladder.  ``resp`` is the local
+        serve's region-error response; ``local`` re-serves the request
+        in-process (the stale rung)."""
+        err = resp.get("error") or {}
+        if "not_leader" in err:
+            return self._on_not_leader(method, req, resp, local)
+        if "data_not_ready" in err:
+            return self._on_data_not_ready(method, req, resp, local)
+        return resp
+
+    def _on_not_leader(self, method: str, req: dict, resp: dict, local):
+        ctx = req.get("context") or {}
+        nl = resp["error"]["not_leader"]
+        region_id = nl.get("region_id") or ctx.get("region_id")
+        if ctx.get("forwarded"):
+            # the loop guard: a forwarded request NEVER forwards again —
+            # whatever this store can serve locally is the end of its ladder
+            _count_forward("loop_guard")
+            return self._stale_fallback(method, req, resp, local, region_id,
+                                        cause="forwarded_not_leader")
+        served, resp = self._forward_rung(method, req, resp, region_id,
+                                          leader=nl.get("leader_store"))
+        if served is not None:
+            return served
+        return self._stale_fallback(method, req, resp, local, region_id,
+                                    cause="leader_unreachable")
+
+    def _on_data_not_ready(self, method: str, req: dict, resp: dict, local):
+        """A local stale read refused: this replica's watermark (or apply
+        index) lags the requested read_ts.  The leader's RegionReadProgress
+        is always current, so one forwarded hop can serve what we cannot —
+        else the refusal carries ``resolved`` + ``safe_ts`` and the client
+        backs off watermark-aware."""
+        ctx = req.get("context") or {}
+        dnr = resp["error"]["data_not_ready"]
+        region_id = dnr.get("region_id") or ctx.get("region_id")
+        if ctx.get("forwarded"):
+            _count_forward("loop_guard")
+        else:
+            served, resp = self._forward_rung(method, req, resp, region_id)
+            if served is not None:
+                return served
+        return self._refuse(resp, region_id, "data_not_ready")
+
+    def _forward_rung(self, method: str, req: dict, resp: dict, region_id,
+                      leader=None):
+        """ONE definition of the forward rung for both ladder entry points:
+        returns ``(served, resp)`` — ``served`` is the remote's final answer
+        (the ladder ends there), else None with ``resp`` possibly replaced
+        by the remote's region-error response (leadership moved again, or
+        its watermark lags: its hints are fresher than ours — degrade from
+        it, never hop again)."""
+        leader = leader or self._leader_of(region_id)
+        if leader is None or leader == self.store_id:
+            _count_forward("no_leader")
+            return None, resp
+        fresp = self._forward(leader, method, req)
+        if fresp is None:
+            return None, resp
+        ferr = fresp.get("error") if isinstance(fresp, dict) else None
+        if not (isinstance(ferr, dict)
+                and ({"not_leader", "data_not_ready"} & ferr.keys())):
+            _count_forward("ok")
+            return fresp, resp
+        _count_forward("remote_region_error")
+        return None, fresp
+
+    def _forward(self, leader: int, method: str, req: dict):
+        """The one-hop forward.  Returns the remote response, or None when
+        the hop could not complete (breaker open, no route, connection
+        failure, timeout) — each counted under its own outcome."""
+        if not self._allow(leader):
+            _count_forward("breaker_open")
+            return None
+        fctx = dict(req.get("context") or {})
+        fctx["forwarded"] = True
+        freq = dict(req)
+        freq["context"] = fctx
+        try:
+            r = self.call(leader, method, freq)
+        except TimeoutError:
+            self._record_failure(leader)
+            _count_forward("timeout")
+            return None
+        except Exception:  # noqa: BLE001 — no route / conn refused / reset
+            self._record_failure(leader)
+            _count_forward("error")
+            return None
+        self._record_success(leader)
+        return r
+
+    def _stale_fallback(self, method: str, req: dict, resp: dict, local,
+                        region_id, cause: str):
+        """The third rung: serve locally as a follower stale read iff the
+        request permits (``stale_read``/``stale_fallback`` + a read_ts the
+        engine admits against the RegionReadProgress pair)."""
+        ctx = req.get("context") or {}
+        permit = bool(ctx.get("stale_read") or ctx.get("stale_fallback"))
+        read_ts = ctx.get("read_ts")
+        # the snapshot ts the request already reads at: an MVCC read at
+        # ts V served off a replica whose watermark covers V is
+        # byte-identical to the leader's answer — "staleness" is only
+        # in which V the CLIENT chose.  A declared read_ts BELOW that V
+        # is clamped up (same as copr's stale_read_ctx / storage's
+        # _stale_snap_ctx): admission must cover the ts the MVCC pass
+        # actually reads at, or a lagging replica silently misses
+        # committed data
+        mvcc_ts = req.get("version") if "version" in req else req.get("start_ts")
+        if mvcc_ts is not None and (read_ts is None or int(read_ts) < int(mvcc_ts)):
+            read_ts = mvcc_ts
+        if not permit or read_ts is None:
+            return self._refuse(resp, region_id, "no_permit")
+        sctx = dict(ctx)
+        sctx["stale_read"] = True
+        sctx["read_ts"] = int(read_ts)
+        sctx.pop("replica_read", None)
+        sreq = dict(req)
+        sreq["context"] = sctx
+        r = local(sreq)
+        rerr = r.get("error") if isinstance(r, dict) else None
+        if not rerr:
+            _count_stale_serve(_path_of(method), cause)
+            return r
+        if isinstance(rerr, dict) and "data_not_ready" in rerr:
+            return self._refuse(r, region_id, "data_not_ready")
+        return self._refuse(resp, region_id, "stale_failed")
+
+    # -- refusal (typed, hint-carrying) --------------------------------------
+
+    def _refuse(self, resp: dict, region_id, cause: str) -> dict:
+        """Enrich the region error with everything the client needs to act:
+        the freshest leader hint, this store's ``safe_ts`` floor, and the
+        region's progress pair."""
+        _count_refuse(cause)
+        err = resp.get("error") if isinstance(resp, dict) else None
+        if not isinstance(err, dict):
+            return resp
+        hints: dict = {}
+        if self.resolved_ts is not None:
+            hints["safe_ts"] = self.resolved_ts.safe_ts()
+            if region_id is not None:
+                resolved, required = self.resolved_ts.progress_of(region_id)
+                hints["resolved_ts"] = resolved
+                hints["required_apply_index"] = required
+        leader = self._leader_of(region_id)
+        for key in ("not_leader", "data_not_ready"):
+            sub = err.get(key)
+            if isinstance(sub, dict):
+                for k, v in hints.items():
+                    sub.setdefault(k, v)
+                if sub.get("leader_store") is None and leader is not None:
+                    sub["leader_store"] = leader
+        return resp
+
+    def _leader_of(self, region_id) -> int | None:
+        if self.store is None or region_id is None:
+            return None
+        return self.store.leader_store_of(region_id)

@@ -59,6 +59,16 @@ def _err(e: Exception) -> dict:
         return {"not_leader": {"region_id": e.region_id, "leader_store": e.leader_store}}
     if isinstance(e, EpochError):
         return {"epoch_not_match": {}}
+    if type(e).__name__ == "DataNotReadyError":
+        # stale read above the replica's watermark (raftkv stale path): a
+        # TYPED refusal — the carried ``resolved`` ts drives the client's
+        # watermark-aware backoff (util.retry data_not_ready class) and the
+        # read plane's refusal hints ride the same dict
+        return {"data_not_ready": {
+            "region_id": getattr(e, "region_id", None),
+            "read_ts": getattr(e, "read_ts", None),
+            "resolved": getattr(e, "resolved", None),
+        }}
     retry_after = getattr(e, "retry_after_s", None)
     if retry_after is not None or type(e).__name__ in ("SchedTooBusy", "ServerBusyError"):
         # ServerIsBusy shape: the retry-after hint survives the wire so the
@@ -79,10 +89,15 @@ class KvService:
         self, storage: Storage, copr: Endpoint | None = None, copr_v2=None,
         resource_tags=None, debugger=None, cdc=None, pd=None, importer=None,
         raft_router=None, gc_worker=None, lock_manager=None, resolved_ts=None,
-        diagnostics=None, keys_rotator=None,
+        diagnostics=None, keys_rotator=None, read_plane=None,
     ):
         self.storage = storage
         self.copr = copr
+        # the read-degradation ladder (server/read_plane.py): wraps the read
+        # handlers so NotLeader/DataNotReady region errors forward one hop,
+        # degrade to follower stale serving, or refuse with hints.  None
+        # (embedded assemblies) keeps the old bounce-the-error behavior.
+        self.read_plane = read_plane
         self.copr_v2 = copr_v2
         self.resource_tags = resource_tags
         self.debugger = debugger
@@ -287,7 +302,26 @@ class KvService:
 
     # -- transactional KV ---------------------------------------------------
 
+    def _serve_read(self, method: str, req: dict, local) -> dict:
+        """Read-degradation ladder entry (docs/stale_reads.md): serve
+        locally; a NotLeader/DataNotReady region error hands the response
+        to the read plane, which forwards ONE hop to the leader (loop-
+        guarded by the ``forwarded`` ctx flag), degrades to a follower
+        stale read when the request permits, or returns the typed refusal
+        carrying the leader hint + this store's ``safe_ts``.  With no read
+        plane wired the behavior is exactly the pre-ladder one."""
+        resp = local(req)
+        if self.read_plane is None or not isinstance(resp, dict):
+            return resp
+        err = resp.get("error")
+        if not isinstance(err, dict) or not ({"not_leader", "data_not_ready"} & err.keys()):
+            return resp
+        return self.read_plane.degrade(self, method, req, resp, local)
+
     def kv_get(self, req: dict) -> dict:
+        return self._serve_read("kv_get", req, self._kv_get_local)
+
+    def _kv_get_local(self, req: dict) -> dict:
         try:
             v = self.storage.get(
                 req["key"], req["version"], req.get("context"),
@@ -298,6 +332,9 @@ class KvService:
             return {"error": _err(e)}
 
     def kv_batch_get(self, req: dict) -> dict:
+        return self._serve_read("kv_batch_get", req, self._kv_batch_get_local)
+
+    def _kv_batch_get_local(self, req: dict) -> dict:
         try:
             pairs = self.storage.batch_get(req["keys"], req["version"], req.get("context"))
             return {"pairs": [list(p) for p in pairs]}
@@ -305,6 +342,9 @@ class KvService:
             return {"error": _err(e)}
 
     def kv_scan(self, req: dict) -> dict:
+        return self._serve_read("kv_scan", req, self._kv_scan_local)
+
+    def _kv_scan_local(self, req: dict) -> dict:
         try:
             pairs = self.storage.scan(
                 req.get("start_key", b""),
@@ -863,10 +903,33 @@ class KvService:
 
     def get_store_safe_ts(self, req: dict) -> dict:
         """Minimum resolved-ts across this store's regions: the floor below
-        which any stale read on this store is safe (kv.rs:1034)."""
+        which any stale read on this store is safe (kv.rs:1034).  Uses the
+        RegionReadProgress view (safe_ts) so FOLLOWER stores — whose local
+        resolvers never advance — report the disseminated floor instead of
+        a frozen 0."""
         if self.resolved_ts is None:
             return {"safe_ts": 0}
-        return {"safe_ts": self.resolved_ts.min_resolved_ts()}
+        return {"safe_ts": self.resolved_ts.safe_ts()}
+
+    def debug_read_progress(self, req: dict) -> dict:
+        """Per-region RegionReadProgress pairs + the store safe_ts: the
+        stuck-follower debugging surface (ctl.py ``read-progress`` and the
+        status server's ``/debug/read_progress``).  Optional ``region_id``
+        narrows to one region."""
+        if self.resolved_ts is None:
+            return {"safe_ts": 0, "regions": {}}
+        rid = req.get("region_id")
+        snap = self.resolved_ts.progress_snapshot()
+        if rid is not None:
+            resolved, required = self.resolved_ts.progress_of(rid)
+            snap = {rid: (resolved, required)}
+        return {
+            "safe_ts": self.resolved_ts.safe_ts(),
+            "regions": {
+                r: {"resolved_ts": pair[0], "required_apply_index": pair[1]}
+                for r, pair in sorted(snap.items())
+            },
+        }
 
     def get_lock_wait_info(self, req: dict) -> dict:
         """Current pessimistic lock waits (kv.rs:1061): who waits on whom."""
@@ -968,7 +1031,15 @@ class KvService:
         blocking only until the batch that carries its request completes —
         the unified-read-pool serving shape with XLA dispatches as the
         shared resource.  With the scheduler stopped (the default), this is
-        the plain per-request path."""
+        the plain per-request path.
+
+        Routed through the read-degradation ladder: a DAG for a region this
+        store does not lead forwards one hop, then degrades to a follower
+        stale serve off the warm region column cache when the context
+        permits (docs/stale_reads.md)."""
+        return self._serve_read("coprocessor", req, self._coprocessor_local)
+
+    def _coprocessor_local(self, req: dict) -> dict:
         assert self.copr is not None, "coprocessor endpoint not wired"
         try:
             creq = self._parse_copr_request(req)

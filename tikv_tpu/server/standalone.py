@@ -147,6 +147,16 @@ class StoreServer:
         )
         self.resolved_ts.attach_store(self.store)
         self.raftkv = RaftKv(self.store, resolved_ts=self.resolved_ts)
+        # the read-degradation ladder (docs/stale_reads.md): reads for
+        # regions this store does not lead forward one hop to the leader,
+        # degrade to follower stale serving when it is unreachable, or
+        # refuse with leader + safe_ts hints
+        from .read_plane import ReadPlane
+
+        self.read_plane = ReadPlane(
+            store=self.store, resolved_ts=self.resolved_ts,
+            resolver=self._resolve, security=security,
+        )
         # group commit (docs/write_path.md): queued compatible prewrites /
         # commits coalesce into one raft proposal; --no-group-commit reverts
         # to one proposal per command
@@ -279,7 +289,10 @@ class StoreServer:
         )
         self.status_server = StatusServer(
             controller=self.config_controller,
-            security=security, memory_trace=self.memory_trace
+            security=security, memory_trace=self.memory_trace,
+            # stuck-follower debugging: per-region (resolved_ts,
+            # required_apply_index) + the store safe_ts floor over HTTP
+            read_progress=lambda: self.service.debug_read_progress({}),
         )
         self.service = KvService(
             self.storage,
@@ -293,6 +306,7 @@ class StoreServer:
             diagnostics=Diagnostics(),
             cdc=self.cdc,
             keys_rotator=self.rotate_data_keys if self.keys_mgr is not None else None,
+            read_plane=self.read_plane,
         )
         self.server = Server(self.service, host=host, port=port, security=security)
         self.recovered_peers = recovered
@@ -411,6 +425,7 @@ class StoreServer:
                 cl.close()
             except OSError:
                 pass
+        self.read_plane.close()
         self.node.stop()
         self.server.stop()
         self.status_server.stop()

@@ -21,12 +21,15 @@ from .profiler import Profiler
 
 class StatusServer:
     def __init__(self, controller: ConfigController | None = None, host="127.0.0.1", port=0, registry=None,
-                 security=None, memory_trace=None):
+                 security=None, memory_trace=None, read_progress=None):
         self.controller = controller
         self.security = security
         self.registry = registry or REGISTRY
         self.profiler = Profiler()
         self.memory_trace = memory_trace
+        # callable returning {"safe_ts", "regions": {rid: {resolved_ts,
+        # required_apply_index}}} — the stuck-follower stale-read surface
+        self.read_progress = read_progress
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -75,6 +78,14 @@ class StatusServer:
                         return
                     ctype = "application/octet-stream" if raw else "text/plain"
                     self._send(200, body, ctype)
+                elif url.path == "/debug/read_progress":
+                    # per-region RegionReadProgress + store safe_ts: why a
+                    # follower refuses stale reads (docs/stale_reads.md)
+                    if outer.read_progress is None:
+                        self._send(404, b"no resolved-ts endpoint wired")
+                        return
+                    self._send(200, json.dumps(outer.read_progress()).encode(),
+                               "application/json")
                 elif url.path == "/debug/memory":
                     # the store's memory-attribution tree (MemoryTrace)
                     if outer.memory_trace is None:

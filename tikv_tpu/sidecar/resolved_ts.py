@@ -11,7 +11,8 @@ stale reads and CDC's consistency guarantee.
 from __future__ import annotations
 
 import heapq
-import threading
+
+from ..analysis.sanitizer import make_lock
 
 
 class Resolver:
@@ -19,7 +20,7 @@ class Resolver:
 
     def __init__(self, region_id: int):
         self.region_id = region_id
-        self._mu = threading.Lock()
+        self._mu = make_lock("resolved_ts.resolver")
         self.locks_by_key: dict[bytes, int] = {}
         self._ts_heap: list[tuple[int, bytes]] = []
         self.resolved_ts = 0
@@ -65,7 +66,7 @@ class ResolvedTsEndpoint:
     def __init__(self, pd, store_id: int | None = None, check_leader_send=None,
                  feature_gate=None):
         self.pd = pd
-        self._mu = threading.Lock()
+        self._mu = make_lock("resolved_ts.endpoint")
         self.resolvers: dict[int, Resolver] = {}
         self.stores: list = []
         # region_id -> (resolved_ts, required_apply_index)
@@ -204,7 +205,21 @@ class ResolvedTsEndpoint:
             # confirmed pairs ride the NEXT round's check_leader RPCs out to
             # follower stores (their RegionReadProgress update)
             self._pending_progress = dict(progress_batch)
+        # staleness-risk gauge: how far the store's stale-read floor trails
+        # the TSO this round advanced toward.  Operators see the lag grow
+        # when a leader is unreachable or dissemination stalls BEFORE stale
+        # reads start refusing (docs/stale_reads.md)
+        self._gauge_safe_ts_lag(ts)
         return out
+
+    def _gauge_safe_ts_lag(self, now_ts: int) -> None:
+        from ..util.metrics import REGISTRY
+
+        REGISTRY.gauge(
+            "tikv_resolved_ts_safe_ts_lag",
+            "Store safe_ts lag behind the latest TSO (timestamp units): "
+            "staleness risk for follower reads on this store",
+        ).set(max(now_ts - self.safe_ts(), 0))
 
     def _gate_ok(self) -> bool:
         from ..pd.feature_gate import RESOLVED_TS_CHECK_LEADER
@@ -314,6 +329,39 @@ class ResolvedTsEndpoint:
     def progress_of(self, region_id: int) -> tuple[int, int]:
         with self._mu:
             return self.read_progress.get(region_id, (0, 0))
+
+    def progress_snapshot(self) -> dict[int, tuple[int, int]]:
+        """Every known region's RegionReadProgress pair — disseminated pairs
+        first, local resolver watermarks (required index 0) for regions with
+        no pair yet.  The stuck-follower debugging surface behind
+        ``ctl.py read-progress`` and ``/debug/read_progress``."""
+        with self._mu:
+            out = dict(self.read_progress)
+            for rid, r in self.resolvers.items():
+                out.setdefault(rid, (r.resolved_ts, 0))
+        return out
+
+    def safe_ts(self) -> int:
+        """Store-level stale-read floor (kv.rs:1034 get_store_safe_ts): the
+        minimum RegionReadProgress watermark across regions hosted on the
+        attached stores — on a follower store that is the DISSEMINATED
+        pair, which local resolvers never advance.  A hosted region with no
+        pair yet falls back to its local resolver watermark (the leader
+        store between advance rounds); 0 with no hosted regions."""
+        with self._mu:
+            progress = dict(self.read_progress)
+            resolvers = {rid: r.resolved_ts for rid, r in self.resolvers.items()}
+        rids: set[int] = set()
+        for store in self.stores:
+            rids.update(list(store.peers))
+        if not rids:
+            # detached endpoint (tests, embedded): every tracked region counts
+            rids = set(progress) | set(resolvers)
+        if not rids:
+            return 0
+        return min(
+            progress.get(rid, (resolvers.get(rid, 0), 0))[0] for rid in rids
+        )
 
     def min_resolved_ts(self) -> int:
         with self._mu:

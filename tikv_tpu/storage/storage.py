@@ -38,6 +38,21 @@ def _encode_raw_value(value: bytes, ttl_secs: int, now: float) -> bytes:
     return value + codec.encode_u64(expire)
 
 
+def _stale_snap_ctx(ctx: dict | None, ts: int) -> dict | None:
+    """Effective stale-read context for the engine snapshot: the MVCC read
+    executes at ``ts``, so the watermark admission must cover ``ts`` even
+    when the client declared a lower ``read_ts`` — otherwise a lagging
+    replica admits a read whose MVCC pass then reads above the watermark
+    and silently misses committed data (same clamp as the coprocessor's
+    ``stale_read_ctx``, docs/stale_reads.md)."""
+    if not ctx or not ctx.get("stale_read"):
+        return ctx
+    read_ts = ctx.get("read_ts")
+    if read_ts is None or int(read_ts) < ts:
+        ctx = dict(ctx, read_ts=ts)
+    return ctx
+
+
 def _decode_raw_value(stored: bytes, now: float) -> bytes | None:
     value, expire = stored[:-8], codec.decode_u64(stored, len(stored) - 8)
     if expire != _NO_TTL and expire <= int(now):
@@ -80,7 +95,7 @@ class Storage:
     ) -> bytes | None:
         k = Key.from_raw(key)
         self.cm.read_key_check(k, ts, bypass_locks)
-        snap = self.engine.snapshot(ctx)
+        snap = self.engine.snapshot(_stale_snap_ctx(ctx, ts))
         return PointGetter(snap, ts, isolation, bypass_locks).get(k)
 
     def batch_get(self, keys: list[bytes], ts: int, ctx: dict | None = None, **kw) -> list[tuple[bytes, bytes]]:
@@ -88,7 +103,7 @@ class Storage:
         the old shape re-entered per key, building a fresh getter (fresh
         Statistics, fresh isolation plumbing) for every key of the batch."""
         out = []
-        snap = self.engine.snapshot(ctx)
+        snap = self.engine.snapshot(_stale_snap_ctx(ctx, ts))
         bypass = kw.get("bypass_locks", frozenset())
         getter = PointGetter(snap, ts, **kw)
         for key in keys:
@@ -115,7 +130,7 @@ class Storage:
         ks = Key.from_raw(start) if start else None
         ke = Key.from_raw(end) if end is not None else None
         self.cm.read_range_check(ks, ke, ts, bypass_locks)
-        snap = self.engine.snapshot(ctx)
+        snap = self.engine.snapshot(_stale_snap_ctx(ctx, ts))
         cls = BackwardScanner if reverse else ForwardScanner
         scanner = cls(snap, ts, ks, ke, isolation, bypass_locks, key_only)
         out = []

@@ -21,6 +21,13 @@ subsystems it serves):
 ``timeout``
     A bounded wait elapsed (no leader yet, admin command stalled).
     Retryable: partitions heal and elections finish.
+``data_not_ready``
+    ``RaftKv.DataNotReadyError`` — a follower stale read above the region's
+    resolved-ts watermark (docs/stale_reads.md).  Retryable: the watermark
+    only ever advances.  The backoff is WATERMARK-AWARE: the exception
+    carries the ``resolved`` ts it was refused against, and the sleep grows
+    with the lag (``read_ts - resolved``) so a barely-behind replica is
+    re-probed quickly while a far-behind one is not hammered.
 ``suspect``
     ``AssertionError`` / ``KeyError`` — historically retried wholesale by
     the cluster clients, which masked real bugs.  Still retryable (routing
@@ -72,9 +79,35 @@ ROUTES: dict[str, str] = {
     "DeadlineExceeded": "deadline",
     "AssertionError": "suspect",
     "KeyError": "suspect",
+    # a stale read refused above the watermark is a WAIT, not a failure:
+    # before PR 7 this fell through to "permanent" and clients never
+    # retried a read the next advance round would have served
+    "DataNotReadyError": "data_not_ready",
 }
 
-RETRYABLE_CLASSES = {"not_leader", "epoch", "busy", "timeout", "suspect"}
+RETRYABLE_CLASSES = {"not_leader", "epoch", "busy", "timeout", "suspect",
+                     "data_not_ready"}
+
+#: physical TSO encoding (TiKV composes ms<<18 | logical); a lag with any
+#: bit at/above the shift is wall-clock milliseconds, a small integer lag is
+#: a logical test clock
+TSO_PHYSICAL_SHIFT = 18
+
+
+def data_not_ready_hint(exc: BaseException) -> float | None:
+    """A ``retry_after_s``-style sleep derived from the watermark lag the
+    refusal reported.  Physical TSO lags convert exactly (the watermark
+    trails real time, so the wait IS the lag); logical-clock lags (unit
+    test TSOs) pace at ~1ms per unit.  Both are capped — the exponential
+    curve still provides the long-tail growth."""
+    read_ts = getattr(exc, "read_ts", None)
+    resolved = getattr(exc, "resolved", None)
+    if read_ts is None or resolved is None:
+        return None
+    lag = max(int(read_ts) - int(resolved), 0)
+    if lag >> TSO_PHYSICAL_SHIFT:
+        return min((lag >> TSO_PHYSICAL_SHIFT) / 1000.0, 1.0)
+    return min(0.001 * lag, 0.1)
 
 
 def classify(exc: BaseException) -> str:
@@ -174,6 +207,10 @@ class Retrier:
             return None
         delay = self.policy.backoff(self.attempts, self.rng)
         hint = getattr(exc, "retry_after_s", None)
+        if hint is None and cls == "data_not_ready":
+            # no explicit hint: derive one from the watermark lag the
+            # refusal carried (the ``resolved`` ts on the exception)
+            hint = data_not_ready_hint(exc)
         if hint is not None:
             # the server's own drain estimate dominates our curve
             delay = max(delay, float(hint))
