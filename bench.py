@@ -662,6 +662,107 @@ def _op_xregion(req, state):
     }
 
 
+def _op_wire(req, state):
+    """wire event (docs/wire_path.md): SOCKET-level coalesced generic
+    serving vs per-request CPU serving over the same engine.
+
+    Two real TCP servers serve the xregion mixed workload to concurrent
+    client connections:
+
+    * **coalesced** — device endpoint with the read scheduler's continuous
+      lanes started (the standalone default): unary requests from many
+      connections coalesce into cross-region programs, identical requests
+      share a slot, responses ride the zero-copy frame writer.
+    * **per-request CPU** — enable_device=False endpoint, scheduler
+      stopped: every request runs the Python MVCC pipeline alone (the
+      pre-PR cluster serving shape, the frozen-28k-rows/s wall).
+
+    Responses must be byte-identical between the two modes; the speedup is
+    the bench_smoke cluster wire floor (relative, hardware-independent)."""
+    from tikv_tpu.copr.dag_wire import dag_to_wire
+    from tikv_tpu.copr.endpoint import Endpoint
+    from tikv_tpu.server.server import Client, Server
+    from tikv_tpu.server.service import KvService
+    from tikv_tpu.storage.kv import LocalEngine
+    from tikv_tpu.storage.storage import Storage
+    from tikv_tpu.util.metrics import REGISTRY
+
+    eng, block_rows, sweep, regions, rows_per, clients = _xregion_harness(req, seed=29)
+    trials = req.get("trials", 3)
+    reqs = [
+        {"dag": dag_to_wire(r.dag), "ranges": [list(t) for t in r.ranges],
+         "start_ts": r.start_ts, "context": dict(r.context)}
+        for r in sweep()
+    ]
+    n_conns = min(len(reqs), req.get("conns", 6))
+
+    def serve_all(addr):
+        conns = [Client(*addr) for _ in range(n_conns)]
+        results: list = [None] * len(reqs)
+        errs: list = []
+
+        def worker(ci):
+            try:
+                for i in range(ci, len(reqs), n_conns):
+                    results[i] = conns[ci].call("coprocessor", reqs[i],
+                                                timeout=300.0)
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(ci,))
+                   for ci in range(n_conns)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        for c in conns:
+            c.close()
+        if errs:
+            raise errs[0]
+        for r in results:
+            if not isinstance(r, dict) or r.get("error"):
+                raise RuntimeError(f"wire serving failed: {r}")
+        return [r["data"] for r in results], dt
+
+    def run_mode(enable_device: bool, continuous: bool):
+        ep = Endpoint(LocalEngine(eng), enable_device=enable_device,
+                      block_rows=block_rows)
+        svc = KvService(Storage(engine=LocalEngine(eng)), ep)
+        srv = Server(svc)
+        srv.start()
+        if continuous:
+            ep.scheduler.start()
+        try:
+            serve_all(srv.addr)  # warmup: cache fill + compile
+            datas = None
+            ts = []
+            for _ in range(trials):
+                datas, dt = serve_all(srv.addr)
+                ts.append(dt)
+            return datas, ts
+        finally:
+            ep.scheduler.stop()
+            srv.stop()
+
+    coalesce = REGISTRY.counter("tikv_wire_coalesce_total", "")
+    batched_before = coalesce.get(outcome="batched")
+    coal_datas, coal_ts = run_mode(True, True)
+    batched_delta = coalesce.get(outcome="batched") - batched_before
+    cpu_datas, cpu_ts = run_mode(False, False)
+    return {
+        "match": coal_datas == cpu_datas,
+        "requests": len(reqs),
+        "conns": n_conns,
+        "regions": regions,
+        "rows_per_region": rows_per,
+        "coalesced_ts": [round(x, 4) for x in coal_ts],
+        "per_request_ts": [round(x, 4) for x in cpu_ts],
+        "coalesced_batched": int(batched_delta),
+    }
+
+
 def _op_sharded_xregion(req, state):
     """sharded_xregion event (ISSUE 3): the SAME warm cross-region workload
     as ``xregion``, but over MESH-SHARDED region images — the scheduler
@@ -860,6 +961,7 @@ _OPS = {
     "filter": _op_filter,
     "region_cache": _op_region_cache,
     "xregion": _op_xregion,
+    "wire": _op_wire,
     "sharded_xregion": _op_sharded_xregion,
     "mixed_rw": _op_mixed_rw,
 }
@@ -929,7 +1031,19 @@ class WorkerDied(RuntimeError):
 
 
 class DeviceWorker:
-    """Parent-side handle on the persistent device worker."""
+    """Parent-side handle on the persistent device worker.
+
+    Wedge detection runs on its OWN monitor thread from the moment of
+    spawn, not only inside ``wait_ready``: the BENCH_r05 failure shape was a
+    worker that heartbeated ``init_wait`` for the full 900s budget while the
+    parent was busy building the CPU fixtures, then died with
+    ``init_budget_exhausted`` / ``device_cache_built s:0.0`` and no cause.
+    Now the verdict lands at BENCH_INIT_STALL (default 300s) of worker
+    uptime with zero progress — or at BENCH_INIT_STALL of heartbeat
+    SILENCE (backend init holding the GIL wedges even the heartbeat
+    thread) — whichever comes first, the worker is killed immediately with
+    a named cause in the event log, and the remaining init budget is never
+    burned."""
 
     def __init__(self, timeline: list):
         self.timeline = timeline
@@ -949,8 +1063,16 @@ class DeviceWorker:
         self.platform = None
         self._q: queue.Queue = queue.Queue()
         self._seq = 0
+        self._stall_s = float(os.environ.get("BENCH_INIT_STALL", "300"))
+        self._spawned_at = time.time()
+        self._last_msg = time.time()
+        self._ready_seen = False
+        self._wedged: str | None = None  # cause, set once by any detector
+        self._wedge_mu = threading.Lock()
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
+        self._monitor = threading.Thread(target=self._monitor_loop, daemon=True)
+        self._monitor.start()
 
     def _mark(self, ev, **kw):
         entry = {"t": round(time.time() - self.t0, 1), "ev": ev, **kw}
@@ -963,26 +1085,59 @@ class DeviceWorker:
             if not line.startswith("{"):
                 continue
             try:
-                self._q.put(json.loads(line))
+                msg = json.loads(line)
             except ValueError:
                 continue
+            self._last_msg = time.time()
+            if msg.get("ev") == "ready":
+                self._ready_seen = True
+            self._q.put(msg)
         self._q.put({"ev": "eof"})
+
+    def _declare_wedged(self, cause: str, **kw) -> None:
+        """Fail fast with a named cause: kill the worker (EOFs the pipe, so
+        any parked consumer wakes) and record the verdict exactly once."""
+        with self._wedge_mu:
+            if self._wedged is not None or self._ready_seen:
+                return
+            self._wedged = cause
+        self._mark("worker_wedged", cause=cause, stall_s=self._stall_s, **kw)
+        self.kill()
+
+    def _monitor_loop(self):
+        """Spawn-time wedge watchdog: fires even while the parent is busy
+        elsewhere (the r05 stall burned the budget precisely because
+        detection only ran inside wait_ready's drain loop)."""
+        while True:
+            time.sleep(5.0)
+            if self._ready_seen or self._wedged is not None:
+                return
+            if self.proc.poll() is not None:
+                return  # died: wait_ready's eof handling owns this verdict
+            now = time.time()
+            # a live init heartbeats every few seconds, so prolonged SILENCE
+            # (backend init holding the GIL) earns its verdict well before
+            # the uptime budget — with the same threshold the uptime check
+            # would always fire first and this cause could never be named
+            if now - self._last_msg >= min(self._stall_s, 60.0):
+                self._declare_wedged(
+                    "heartbeat_silent",
+                    silent_s=round(now - self._last_msg, 1))
+                return
+            if now - self._spawned_at >= self._stall_s:
+                self._declare_wedged(
+                    "backend_init_stall",
+                    worker_t=round(now - self._spawned_at, 1))
+                return
 
     def wait_ready(self, budget_s: float) -> str:
         """'ready' | 'died' (respawnable: init failed fast or slow) |
-        'timeout' (budget gone or worker wedged).
-
-        Wedge detection (the BENCH_r05 failure shape): a worker that only
-        ever heartbeats — backend init hung, zero progress — polled for the
-        FULL budget before the run demoted to CPU.  The heartbeats carry the
-        worker's own uptime; once that exceeds BENCH_INIT_STALL (default
-        300s) with nothing but init_wait events seen, the worker is declared
-        wedged and killed immediately: five rounds of evidence say a tunnel
-        that silent for that long never comes up, and the budget only exists
-        for inits that are *progressing slowly*, not stuck."""
-        stall_s = float(os.environ.get("BENCH_INIT_STALL", "300"))
+        'timeout' (budget gone or worker wedged — never respawned: the
+        monitor's cause says the backend hangs rather than fails)."""
         deadline = time.time() + budget_s
         while True:
+            if self._wedged is not None:
+                return "timeout"
             remaining = deadline - time.time()
             if remaining <= 0:
                 self._mark("init_budget_exhausted", budget_s=budget_s)
@@ -994,15 +1149,18 @@ class DeviceWorker:
             ev = msg.get("ev")
             if ev == "init_wait":
                 self._mark("worker_init_wait", worker_t=msg.get("t"))
-                if float(msg.get("t") or 0.0) >= stall_s:
-                    self._mark("worker_wedged", worker_t=msg.get("t"),
-                               stall_s=stall_s)
+                if float(msg.get("t") or 0.0) >= self._stall_s:
+                    # backstop for a monitor thread that could not run
+                    self._declare_wedged("backend_init_stall",
+                                         worker_t=msg.get("t"))
                     return "timeout"
             elif ev == "ready":
                 self.platform = msg.get("platform")
                 self._mark("ready", platform=self.platform, worker_t=msg.get("t"))
                 return "ready"
             elif ev == "eof":
+                if self._wedged is not None:
+                    return "timeout"  # our own kill, not a crash: no respawn
                 self._mark("worker_died_at_init", rc=self.proc.poll())
                 return "died"
 
@@ -1044,7 +1202,9 @@ class DeviceWorker:
                 self.proc.kill()
             except OSError:
                 pass
-        self._mark("worker_killed")
+        if not getattr(self, "_kill_marked", False):
+            self._kill_marked = True
+            self._mark("worker_killed")
 
 
 class LocalDevice:
@@ -1405,9 +1565,14 @@ def main() -> None:
                       "q1_device_cold_rows_per_s", "q1_device_round_ms",
                       "ycsb_e_p50_ms", "ycsb_e_p99_ms",
                       "q1_device_from_device", "q1_device_platform",
+                      "q1_wire_rows_per_s", "q1_wire_requests",
+                      "q1_owner_routed_rows_per_s", "q1_owner_routed_requests",
+                      "wire_stages", "device_owners",
                       "regions", "leader_stores"):
                 results[f"cluster_{k}"] = c.get(k)
             _mark("cluster_ok", q1=c.get("q1_pushdown_rows_per_s"),
+                  q1_wire=c.get("q1_wire_rows_per_s"),
+                  q1_owner=c.get("q1_owner_routed_rows_per_s"),
                   q1_dev=c.get("q1_device_rows_per_s"))
         except Exception as e:  # noqa: BLE001
             results["cluster_error"] = str(e)[:300]

@@ -23,6 +23,10 @@ regresses:
   raft group while readers serve the warm region.  Fails on byte
   divergence, a grouped-vs-per-command commit speedup below the 2x floor,
   or a warm hit-rate under write load below 50%.
+* ``wire`` (ISSUE 8): socket-level coalesced generic serving (continuous
+  scheduler lanes + zero-copy frames, the standalone default) vs
+  per-request CPU serving over real TCP connections.  Fails on byte
+  divergence, a speedup below the 5x floor, or zero batch-served requests.
 
 Exit code 0 = healthy; 1 = regression.  One JSON line on stdout either way,
 so CI logs stay grep-able:
@@ -44,6 +48,7 @@ MIN_XREGION_SPEEDUP = 2.0
 MIN_SHARDED_SPEEDUP = 1.5
 MIN_GROUP_SPEEDUP = 2.0
 MIN_WARM_HIT_RATE = 0.5
+MIN_WIRE_SPEEDUP = 5.0
 SHARDED_DEVICES = 8
 
 
@@ -139,6 +144,30 @@ def main() -> int:
         ok = False
         out["xregion_regression"] = (
             f"{xspeed:.2f}x < {MIN_XREGION_SPEEDUP}x floor")
+
+    # cluster wire floor (ISSUE 8): SOCKET-level coalesced generic serving
+    # must beat per-request CPU serving ≥5x — relative, so CI stays
+    # hardware-independent (docs/wire_path.md)
+    rw = bench._op_wire({
+        "regions": args.xregion_regions, "rows": args.xregion_rows,
+        "clients": 3, "trials": max(args.trials, 3),
+    }, {})
+    out["wire_match"] = bool(rw["match"])
+    ok = ok and rw["match"]
+    w_coal = float(np.median(rw["coalesced_ts"]))
+    w_per = float(np.median(rw["per_request_ts"]))
+    wspeed = w_per / w_coal
+    out["wire_requests"] = rw["requests"]
+    out["wire_speedup"] = round(wspeed, 2)
+    out["wire_coalesced_batched"] = rw["coalesced_batched"]
+    wire_regressions = []
+    if wspeed < MIN_WIRE_SPEEDUP:
+        wire_regressions.append(f"{wspeed:.2f}x < {MIN_WIRE_SPEEDUP}x floor")
+    if rw["coalesced_batched"] <= 0:
+        wire_regressions.append("no requests served out of coalesced batches")
+    if wire_regressions:
+        ok = False
+        out["wire_regression"] = "; ".join(wire_regressions)
 
     # mesh-sharded warm serving on the 8-virtual-device mesh (ISSUE 3)
     rs = _run_sharded(args)

@@ -1,0 +1,124 @@
+"""The bench device-worker wedge watchdog (ISSUE 8 satellite).
+
+BENCH_r05's failure shape: the worker heartbeated ``init_wait`` for the
+full 900s init budget while the parent built CPU fixtures, then died as
+``worker_killed`` / ``init_budget_exhausted`` with no cause and
+``device_cache_built s:0.0``.  The fix moves wedge detection onto a
+monitor thread that runs from spawn and kills the worker with a NAMED
+cause at BENCH_INIT_STALL seconds — these tests drive the monitor's
+verdict logic directly on a harness-free DeviceWorker instance (no real
+subprocess, no jax backend)."""
+
+import queue
+import threading
+import time
+
+import bench
+
+
+class _FakeProc:
+    """Just enough of subprocess.Popen for the monitor + kill paths."""
+
+    def __init__(self):
+        self.pid = -1  # os.killpg(-1, ...) raises OSError -> .kill() path
+        self.killed = threading.Event()
+
+    def poll(self):
+        return None  # "still running" — the wedge monitor's case
+
+    def kill(self):
+        self.killed.set()
+
+
+def _bare_worker(stall_s: float, *, spawned_ago: float = 0.0,
+                 silent_for: float = 0.0) -> bench.DeviceWorker:
+    """A DeviceWorker with the spawn side effects (subprocess, reader and
+    monitor threads) stripped: only the state the verdict logic reads."""
+    w = bench.DeviceWorker.__new__(bench.DeviceWorker)
+    now = time.time()
+    w.timeline = []
+    w.t0 = now
+    w.proc = _FakeProc()
+    w.platform = None
+    w._q = queue.Queue()
+    w._seq = 0
+    w._stall_s = stall_s
+    w._spawned_at = now - spawned_ago
+    w._last_msg = now - silent_for
+    w._ready_seen = False
+    w._wedged = None
+    w._wedge_mu = threading.Lock()
+    return w
+
+
+def _events(w):
+    return [e["ev"] for e in w.timeline]
+
+
+def test_monitor_declares_backend_init_stall():
+    """Zero progress for BENCH_INIT_STALL of worker uptime -> the monitor
+    kills the worker and records worker_wedged with the stall cause (one
+    5s monitor cycle; the r05 shape burned 900s here).  The heartbeat is
+    fresh, so the silence detector stays quiet and the verdict names the
+    uptime budget."""
+    w = _bare_worker(stall_s=20.0, spawned_ago=30.0)
+    t0 = time.monotonic()
+    w._monitor_loop()  # first cycle: age >= stall -> verdict, returns
+    assert time.monotonic() - t0 < 30.0
+    assert w._wedged == "backend_init_stall"
+    assert w.proc.killed.is_set()
+    ev = [e for e in w.timeline if e["ev"] == "worker_wedged"]
+    assert len(ev) == 1 and ev[0]["cause"] == "backend_init_stall"
+
+
+def test_monitor_declares_heartbeat_silence():
+    """A worker whose heartbeat went quiet (backend init holding the GIL)
+    wedges on SILENCE even though its uptime is under the stall budget."""
+    w = _bare_worker(stall_s=20.0, spawned_ago=0.0, silent_for=30.0)
+    w._monitor_loop()
+    assert w._wedged == "heartbeat_silent"
+    assert w.proc.killed.is_set()
+
+
+def test_ready_worker_never_wedges():
+    """The verdict is init-scoped: once ready has been seen, neither
+    detector may kill the worker (a slow OP is the op timeout's job)."""
+    w = _bare_worker(stall_s=1.0, spawned_ago=30.0, silent_for=30.0)
+    w._ready_seen = True
+    w._monitor_loop()
+    assert w._wedged is None
+    assert not w.proc.killed.is_set()
+    w._ready_seen = False
+    w._wedged = "backend_init_stall"  # already decided: at most one verdict
+    w._declare_wedged("heartbeat_silent")
+    assert w._wedged == "backend_init_stall"
+    assert not w.proc.killed.is_set()
+
+
+def test_wait_ready_returns_timeout_on_wedge_without_burning_budget():
+    """wait_ready surfaces the monitor's verdict immediately — the 900s
+    init budget is NOT burned, and the monitor-kill eof is not mistaken
+    for a respawnable worker death."""
+    w = _bare_worker(stall_s=1.0)
+    w._wedged = "backend_init_stall"
+    t0 = time.monotonic()
+    assert w.wait_ready(900.0) == "timeout"
+    assert time.monotonic() - t0 < 5.0
+    assert "init_budget_exhausted" not in _events(w)
+
+    w2 = _bare_worker(stall_s=1.0)
+    w2._wedged = "heartbeat_silent"
+    w2._q.put({"ev": "eof"})  # the kill EOFs the pipe
+    assert w2.wait_ready(900.0) == "timeout"
+    assert "worker_died_at_init" not in _events(w2)
+
+
+def test_wait_ready_backstop_wedges_on_stale_init_wait():
+    """Even if the monitor thread never ran, an init_wait heartbeat whose
+    own worker-side clock passed the stall budget triggers the verdict in
+    wait_ready's drain loop."""
+    w = _bare_worker(stall_s=2.0)
+    w._q.put({"ev": "init_wait", "t": 5.0})
+    assert w.wait_ready(900.0) == "timeout"
+    assert w._wedged == "backend_init_stall"
+    assert w.proc.killed.is_set()

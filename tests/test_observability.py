@@ -39,6 +39,9 @@ LAZY_SERIES = {
     "tikv_coprocessor_breaker_event_total",
     "tikv_coprocessor_breaker_state",
     "tikv_coprocessor_deadline_expired_total",
+    "tikv_wire_stage_seconds",
+    "tikv_wire_coalesce_total",
+    "tikv_copr_owner_forward_total",
     "tikv_chaos_injected_total",
     "tikv_client_retry_total",
     "tikv_resolved_ts_safe_ts_lag",

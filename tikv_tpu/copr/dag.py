@@ -212,7 +212,13 @@ def build_executors(dag: DagRequest, source: ScanSource, leaf: BatchExecutor | N
 class ResponseEncoder:
     """Row-exact chunk framer: a new chunk starts every ``chunk_rows`` rows,
     independent of producer batch boundaries — so the CPU and device paths
-    emit byte-identical framing for identical row streams."""
+    emit byte-identical framing for identical row streams.
+
+    Large batches encode through the vectorized column codec
+    (``datum_vec.encode_chunk_rows`` — numpy batch varints/fixed cells, one
+    ragged scatter per column); tiny batches and exotic column types keep
+    the scalar per-row loop.  Both paths emit identical bytes
+    (tests/test_wire_path.py)."""
 
     def __init__(self, chunk_rows: int):
         self.chunk_rows = chunk_rows
@@ -226,6 +232,25 @@ class ResponseEncoder:
             if output_offsets is None
             else [chunk.columns[i] for i in output_offsets]
         )
+        from . import datum_vec
+
+        n_rows = chunk.num_rows
+        if n_rows >= datum_vec.VEC_MIN_ROWS and datum_vec.supported(cols):
+            buf, row_ends = datum_vec.encode_chunk_rows(cols, chunk.logical_rows)
+            start_row, start_byte = 0, 0
+            take = self.chunk_rows - self._rows
+            while start_row + take <= n_rows:
+                end_byte = int(row_ends[start_row + take - 1])
+                self._cur += buf[start_byte:end_byte]
+                self.chunks.append(bytes(self._cur))
+                self._cur = bytearray()
+                self._rows = 0
+                start_row += take
+                start_byte = end_byte
+                take = self.chunk_rows
+            self._cur += buf[start_byte:]
+            self._rows += n_rows - start_row
+            return n_rows
         n = 0
         for row in chunk.logical_rows:
             self._cur += codec.encode_var_u64(len(cols))

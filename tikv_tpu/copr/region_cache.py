@@ -863,6 +863,17 @@ class RegionColumnCache:
             self.stats.wt_lost += 1
             self._count_wt_lost()
 
+    def warm_region_ids(self) -> list[int]:
+        """Region ids with a resident device image — the placement this
+        store advertises to PD each heartbeat so peers can forward
+        device-eligible DAGs to the owner (docs/wire_path.md)."""
+        with self._mu:
+            return sorted({k[0] for k in self._images})
+
+    def has_warm_region(self, region_id: int) -> bool:
+        with self._mu:
+            return any(k[0] == region_id for k in self._images)
+
     def total_bytes(self) -> int:
         with self._mu:
             return sum(img.nbytes for img in self._images.values())

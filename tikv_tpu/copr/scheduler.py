@@ -282,6 +282,7 @@ class CoprReadScheduler:
                 or not self._batchable(req)):
             # the BATCH_FUSION gate guards this path exactly like
             # handle_batch: a mixed-version cluster keeps fusion off
+            self._count_coalesce("bypass")
             return self.ep.handle_request(req)
         item = _Item(req=req, index=0, lane=_lane_of(req), ticket=_Ticket(),
                      enqueue_t=time.perf_counter(), deadline=deadline)
@@ -300,6 +301,7 @@ class CoprReadScheduler:
                     # own reason: "queue_full" means served on the direct
                     # path, and a rejection is neither served nor direct
                     self._count_shed("busy_reject")
+                    self._count_coalesce("busy_reject")
                     raise ServerBusyError(
                         "coprocessor scheduler queue is full",
                         retry_after_s=self.cfg.busy_retry_after_s,
@@ -312,6 +314,7 @@ class CoprReadScheduler:
                 self._gauge_depth()
                 self._mu.notify_all()
         if do_direct:
+            self._count_coalesce("queue_full")
             return self.ep.handle_request(req)
         item.ticket.done.wait(timeout)
         if not item.ticket.done.is_set():
@@ -323,9 +326,13 @@ class CoprReadScheduler:
             if deadline is not None and time.monotonic() >= deadline:
                 self._count_deadline("direct")
                 raise DeadlineExceeded("deadline expired before direct serve")
+            self._count_coalesce("direct")
             return self.ep.handle_request(req)
         if item.ticket.error is not None:
             raise item.ticket.error
+        # served out of a dispatcher micro-batch: the wire-path coalescing
+        # outcome the cluster bench floors on (docs/wire_path.md)
+        self._count_coalesce("batched")
         return item.ticket.resp
 
     def _dispatch_loop(self) -> None:
@@ -995,6 +1002,19 @@ class CoprReadScheduler:
             "tikv_coprocessor_sched_shed_total",
             "Requests shed to the per-request path, by reason",
         ).inc(reason=reason)
+
+    def _count_coalesce(self, outcome: str) -> None:
+        """Continuous-mode admission outcomes for wire-coalesced unary
+        requests: ``batched`` (served out of a dispatcher micro-batch),
+        ``direct`` (handed back to the caller's thread), ``bypass``
+        (scheduler off / plan not batchable), ``queue_full`` /
+        ``busy_reject`` (admission control)."""
+        from ..util.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "tikv_wire_coalesce_total",
+            "Server-side RPC coalescing admissions, by outcome",
+        ).inc(outcome=outcome)
 
     def _count_deadline(self, at: str) -> None:
         from ..util.metrics import REGISTRY

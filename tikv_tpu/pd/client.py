@@ -94,6 +94,11 @@ class MockPd(PdClient):
         # PD's operator-influence accounting)
         self._moves: dict[int, list] = {}
         self._move_linger = 10.0
+        # device-owner placement (docs/wire_path.md): region_id -> the store
+        # whose region column cache holds a warm device-resident image.
+        # Stores advertise their warm set each heartbeat; the full map rides
+        # back so every store can forward device-eligible DAGs to the owner
+        self.device_owners: dict[int, int] = {}
 
     # -- ids / tso ---------------------------------------------------------
 
@@ -342,6 +347,22 @@ class MockPd(PdClient):
         with self._mu:
             info = self.stores.get(store_id)
             return info.addr if info else None
+
+    def advertise_device_regions(self, store_id: int, region_ids) -> dict[int, int]:
+        """One store's current warm device-image placement (heartbeat
+        cadence): replaces every entry previously owned by ``store_id`` with
+        the advertised set and returns the WHOLE cluster map, so the caller
+        refreshes its owner route cache in the same round trip.  Ownership
+        conflicts resolve latest-writer-wins — a stale claim costs one
+        forwarded hop that still returns correct (CPU-served) bytes."""
+        rids = {int(r) for r in region_ids}
+        with self._mu:
+            for rid in [r for r, s in self.device_owners.items()
+                        if s == store_id and r not in rids]:
+                del self.device_owners[rid]
+            for rid in rids:
+                self.device_owners[rid] = store_id
+            return dict(self.device_owners)
 
     def store_heartbeat(self, store_id: int, stats: dict) -> dict:
         """Record liveness + stats; returns the cluster replication status

@@ -104,6 +104,11 @@ class PdService:
         self.pd.add_operator(req["region_id"], req["operator"])
         return {}
 
+    def pd_advertise_device_regions(self, req: dict) -> dict:
+        owners = self.pd.advertise_device_regions(
+            req["store_id"], req.get("regions") or ())
+        return {"owners": owners}
+
 
 class RemotePd(PdClient):
     """PdClient over the wire (pd_client's RpcClient with reconnect,
@@ -207,6 +212,12 @@ class RemotePd(PdClient):
 
     def update_gc_safe_point(self, ts: int) -> None:
         self._call("pd_update_gc_safe_point", {"ts": ts})
+
+    def advertise_device_regions(self, store_id: int, region_ids) -> dict[int, int]:
+        r = self._call("pd_advertise_device_regions",
+                       {"store_id": store_id, "regions": list(region_ids)})
+        owners = r.get("owners") if isinstance(r, dict) else None
+        return owners if isinstance(owners, dict) else {}
 
     def add_operator(self, region_id: int, op: dict) -> None:
         self._call("pd_add_operator", {"region_id": region_id, "operator": op})

@@ -338,6 +338,15 @@ class ServerCluster:
                 last = e
         raise last if last is not None else KeyError(key)
 
+    def set_device_owners(self, owners: dict[int, int]) -> None:
+        """Push a device-owner placement map (region -> store) into every
+        full-service node's read plane — the deterministic test-harness
+        stand-in for the standalone deployment's PD heartbeat advertisement
+        (docs/wire_path.md)."""
+        for node in self.nodes.values():
+            if node.read_plane is not None:
+                node.read_plane.set_device_owners(owners)
+
     def advance_resolved_ts(self) -> dict[int, dict[int, int]]:
         """One watermark advance round on every full-service store (the
         standalone deployment's background loop, driven explicitly so tests

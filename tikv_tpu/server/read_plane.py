@@ -65,6 +65,14 @@ def _count_refuse(cause: str) -> None:
     ).inc(cause=cause)
 
 
+def _count_owner_forward(outcome: str) -> None:
+    REGISTRY.counter(
+        "tikv_copr_owner_forward_total",
+        "Device-eligible DAGs forwarded to the store owning the warm "
+        "region image, by outcome",
+    ).inc(outcome=outcome)
+
+
 def _path_of(method: str) -> str:
     return "copr" if method.startswith("coprocessor") else "kv"
 
@@ -92,6 +100,61 @@ class ReadPlane:
         self._clients: dict[int, object] = {}
         # per-store forward breaker: (consecutive failures, down-until)
         self._down: dict[int, tuple[int, float]] = {}
+        # region -> device-owner store (docs/wire_path.md): the cluster map
+        # refreshed from PD each heartbeat (advertise_device_regions); a
+        # store receiving a device-eligible DAG whose warm image lives on
+        # another store forwards it there instead of serving cold locally
+        self._device_owners: dict[int, int] = {}
+
+    # -- device-owner placement ----------------------------------------------
+
+    def set_device_owners(self, owners: dict) -> None:
+        with self._mu:
+            self._device_owners = dict(owners)
+
+    def device_owner_of(self, region_id) -> int | None:
+        with self._mu:
+            return self._device_owners.get(region_id)
+
+    def device_owners(self) -> dict:
+        with self._mu:
+            return dict(self._device_owners)
+
+    def forward_device_owner(self, method: str, req: dict, owner: int):
+        """ONE hop to the device-owner store (loop-guarded by the same
+        ``forwarded`` flag as the leader hop, sharing the per-store forward
+        breaker).  The hop context adds ``stale_fallback`` so an owner that
+        does not LEAD the region can still serve off its warm image through
+        the follower stale rung.  Returns the owner's answer, or None when
+        the caller should serve locally (hop failed, or the owner itself
+        returned a region error — its serving is no better than ours)."""
+        if not self._allow(owner):
+            _count_owner_forward("breaker_open")
+            return None
+        fctx = dict(req.get("context") or {})
+        fctx["forwarded"] = True
+        fctx.setdefault("stale_fallback", True)
+        freq = dict(req)
+        freq["context"] = fctx
+        try:
+            r = self.call(owner, method, freq)
+        except TimeoutError:
+            self._record_failure(owner)
+            _count_owner_forward("timeout")
+            return None
+        except Exception:  # noqa: BLE001 — no route / conn refused / reset
+            self._record_failure(owner)
+            _count_owner_forward("error")
+            return None
+        self._record_success(owner)
+        err = r.get("error") if isinstance(r, dict) else None
+        if isinstance(err, dict):
+            # the owner refused (NotLeader chain exhausted, watermark lag,
+            # busy): local CPU serving still yields correct bytes
+            _count_owner_forward("remote_region_error")
+            return None
+        _count_owner_forward("ok")
+        return r
 
     # -- transport ----------------------------------------------------------
 

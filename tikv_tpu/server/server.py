@@ -10,6 +10,7 @@ commands don't block the socket reader.
 from __future__ import annotations
 
 import inspect
+import os
 import queue
 import socket
 import struct
@@ -31,6 +32,16 @@ GRPC_MSG_DURATION = REGISTRY.histogram(
     "tikv_grpc_msg_duration_seconds", "RPC handling latency, by method")
 GRPC_MSG_FAIL = REGISTRY.counter(
     "tikv_grpc_msg_fail_total", "RPCs that returned an error, by method")
+# per-stage wire-path breakdown (docs/wire_path.md): where a served frame's
+# time goes — decode (frame bytes -> request value), route (read/handler
+# pool queue wait), execute (service dispatch), encode (response value ->
+# socket).  THE profiling surface for the decode->endpoint->encode gap;
+# summarized by bench_cluster.py and the debug_wire_stages RPC.
+WIRE_STAGE = REGISTRY.histogram(
+    "tikv_wire_stage_seconds",
+    "Wire-path time per served frame, by stage",
+    buckets=(1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1, 5),
+)
 
 error_code.register_builtin()
 
@@ -77,6 +88,44 @@ def read_frame(sock: socket.socket) -> bytes | None:
 
 def write_frame(sock: socket.socket, payload: bytes) -> None:
     sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+try:
+    #: the kernel rejects a sendmsg with more iovecs than this (EMSGSIZE) —
+    #: a many-payload response (batch coprocessor) must gather in slices
+    _IOV_MAX = os.sysconf("SC_IOV_MAX")
+except (AttributeError, OSError, ValueError):
+    _IOV_MAX = 1024
+
+
+def write_frame_parts(sock: socket.socket, parts: list) -> None:
+    """One frame from a ``wire.dumps_parts`` buffer list: gather-write via
+    ``sendmsg`` so a large response payload (coprocessor chunk data) goes
+    header + passthrough buffers straight to the kernel — no single-buffer
+    concatenation copy.  TLS sockets (no sendmsg) fall back to a join."""
+    bufs = [memoryview(_LEN.pack(sum(len(p) for p in parts)))]
+    bufs += [p if isinstance(p, memoryview) else memoryview(p) for p in parts]
+    sendmsg = getattr(sock, "sendmsg", None)
+    if sendmsg is None:
+        sock.sendall(b"".join(bufs))
+        return
+    try:
+        sent = sendmsg(bufs[:_IOV_MAX])
+    except (NotImplementedError, OSError) as e:
+        if isinstance(e, OSError):
+            raise
+        sock.sendall(b"".join(bufs))  # ssl.SSLSocket raises NotImplementedError
+        return
+    # a partial gather write is legal: advance through the buffer list
+    while True:
+        while bufs and sent >= len(bufs[0]):
+            sent -= len(bufs[0])
+            bufs.pop(0)
+        if not bufs:
+            return
+        if sent:
+            bufs[0] = bufs[0][sent:]
+        sent = sendmsg(bufs[:_IOV_MAX])
 
 
 class Server:
@@ -164,7 +213,9 @@ class Server:
                 frame = read_frame(conn)
                 if frame is None:
                     return
+                t_dec = time.perf_counter()
                 req_id, method, request = wire.loads(frame)
+                WIRE_STAGE.observe(time.perf_counter() - t_dec, stage="decode")
 
                 if method == "_stream_ack":
                     sem = stream_credits.get(request.get("id"))
@@ -195,8 +246,13 @@ class Server:
                         pass
                     continue
 
-                def run(req_id=req_id, method=method, request=request):
+                t_submit = time.perf_counter()
+
+                def run(req_id=req_id, method=method, request=request,
+                        t_submit=t_submit):
                     t0 = time.perf_counter()
+                    # route = pool queue wait: submission to handler start
+                    WIRE_STAGE.observe(t0 - t_submit, stage="route")
                     try:
                         if method.startswith("pb/"):
                             # kvproto mode: request/response are protobuf
@@ -207,7 +263,9 @@ class Server:
                     except Exception as e:  # noqa: BLE001 — wire boundary
                         resp = {"error": {"other": repr(e), "code": error_code.code_of(e)}}
                     GRPC_MSG_TOTAL.inc(method=method)
-                    GRPC_MSG_DURATION.observe(time.perf_counter() - t0, method=method)
+                    t_done = time.perf_counter()
+                    GRPC_MSG_DURATION.observe(t_done - t0, method=method)
+                    WIRE_STAGE.observe(t_done - t0, stage="execute")
                     if isinstance(resp, dict) and resp.get("error"):
                         GRPC_MSG_FAIL.inc(method=method)
                     if inspect.isgenerator(resp):
@@ -235,12 +293,12 @@ class Server:
                                         return  # consumer gone; drop stream
                                 if req_id in stream_cancelled:
                                     return  # consumer abandoned the stream
-                                payload = wire.dumps([req_id, {"stream": item}])
+                                parts = wire.dumps_parts([req_id, {"stream": item}])
                                 with send_mu:
                                     # lint: allow(lock-blocking-call) -- send_mu
                                     # guards exactly this socket: frames from
                                     # concurrent handlers must not interleave
-                                    write_frame(conn, payload)
+                                    write_frame_parts(conn, parts)
                         except OSError:
                             return  # client went away mid-stream
                         except Exception as e:  # noqa: BLE001 — wire boundary
@@ -249,16 +307,22 @@ class Server:
                         finally:
                             stream_credits.pop(req_id, None)
                             stream_cancelled.discard(req_id)
-                        payload = wire.dumps([req_id, final])
-                    else:
-                        payload = wire.dumps([req_id, resp])
+                        resp = final
+                    # single-buffer response assembly: dumps_parts emits the
+                    # response's large bytes payloads (coprocessor chunk
+                    # data) as passthrough buffers and the frame writer
+                    # gather-writes them — no re-encoding copy of the data
+                    t_enc = time.perf_counter()
+                    parts = wire.dumps_parts([req_id, resp])
                     with send_mu:
                         try:
                             # lint: allow(lock-blocking-call) -- per-socket
                             # frame serialization (same as the stream path)
-                            write_frame(conn, payload)
+                            write_frame_parts(conn, parts)
                         except OSError:
                             pass
+                    WIRE_STAGE.observe(time.perf_counter() - t_enc,
+                                       stage="encode")
 
                 if method.removeprefix("pb/") in _READ_METHODS:
                     ctx, group = {}, id(conn)

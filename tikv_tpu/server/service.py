@@ -121,6 +121,16 @@ class KvService:
         # stores in one process (a poller on one store would degrade
         # cdc_events long-polls on unrelated stores to immediate returns).
         self._cdc_longpoll_slots = threading.Semaphore(2)
+        # wire-DAG parse memo: clients resend the same plan on every request
+        # of a workload, and dag_from_wire + executor descriptor construction
+        # was a fixed per-request tax on the wire path.  Keyed by the plan's
+        # canonical wire bytes; DagRequests are treated as immutable by every
+        # serving path (the streaming handler copies before re-framing).
+        self._dag_memo: dict[bytes, object] = {}
+        self._dag_memo_mu = threading.Lock()
+        # device-eligibility verdicts for owner routing, keyed by the memoized
+        # DagRequest object (id + identity check guards against reuse)
+        self._dag_eligible_memo: dict[int, tuple] = {}
 
     _HANDLER_PREFIXES = (
         "kv_", "raw_", "coprocessor", "mvcc_", "debug_", "cdc_", "import_", "raft_",
@@ -931,6 +941,30 @@ class KvService:
             },
         }
 
+    def debug_device_owners(self, req: dict) -> dict:
+        """This store's current view of device-owner placement (region ->
+        store), as advertised through PD (docs/wire_path.md)."""
+        rp = self.read_plane
+        return {"owners": rp.device_owners() if rp is not None else {}}
+
+    def debug_wire_stages(self, req: dict) -> dict:
+        """Per-stage wire-path summary (tikv_wire_stage_seconds): count and
+        accumulated seconds for decode/route/execute/encode — the RPC the
+        cluster bench scrapes to report where the wire path spends its time
+        (docs/wire_path.md)."""
+        from .server import WIRE_STAGE
+
+        stages = {}
+        for labels in WIRE_STAGE.label_sets():
+            stage = labels.get("stage")
+            if stage is None:
+                continue
+            stages[stage] = {
+                "count": WIRE_STAGE.count(stage=stage),
+                "seconds": WIRE_STAGE.total(stage=stage),
+            }
+        return {"stages": stages}
+
     def get_lock_wait_info(self, req: dict) -> dict:
         """Current pessimistic lock waits (kv.rs:1061): who waits on whom."""
         if self.lock_manager is None:
@@ -990,15 +1024,29 @@ class KvService:
     def diagnostics_server_info(self, req: dict) -> dict:
         return self._diag().server_info()
 
-    @staticmethod
-    def _parse_copr_request(req: dict) -> CoprRequest:
+    def _parse_dag_wire(self, dag: dict):
+        """Memoized wire-dict -> DagRequest parse (shared by the unary,
+        batch, and streaming handlers)."""
+        from . import wire
+        from ..copr.dag_wire import dag_from_wire
+
+        key = wire.dumps(dag)
+        with self._dag_memo_mu:
+            parsed = self._dag_memo.get(key)
+        if parsed is None:
+            parsed = dag_from_wire(dag)
+            with self._dag_memo_mu:
+                self._dag_memo[key] = parsed
+                while len(self._dag_memo) > 128:
+                    self._dag_memo.pop(next(iter(self._dag_memo)))
+        return parsed
+
+    def _parse_copr_request(self, req: dict) -> CoprRequest:
         """ONE definition of the coprocessor sub-request parse (unary and
         batch must accept identical payloads — including dag-less CHECKSUM)."""
         dag = req.get("dag")
         if isinstance(dag, dict):
-            from ..copr.dag_wire import dag_from_wire
-
-            dag = dag_from_wire(dag)
+            dag = self._parse_dag_wire(dag)
         tp = req.get("tp", REQ_TYPE_DAG)
         if dag is None and tp != REQ_TYPE_CHECKSUM:
             raise ValueError("dag required for this request type")
@@ -1036,8 +1084,70 @@ class KvService:
         Routed through the read-degradation ladder: a DAG for a region this
         store does not lead forwards one hop, then degrades to a follower
         stale serve off the warm region column cache when the context
-        permits (docs/stale_reads.md)."""
+        permits (docs/stale_reads.md).
+
+        Device-owner routing (docs/wire_path.md): a device-eligible DAG
+        whose region image is warm on ANOTHER store's cache forwards one
+        hop to that store instead of serving a cold local fallback —
+        placement advertised through PD, loop-guarded, breaker-protected."""
+        fwd = self._try_owner_forward(req)
+        if fwd is not None:
+            return fwd
         return self._serve_read("coprocessor", req, self._coprocessor_local)
+
+    def _try_owner_forward(self, req: dict) -> dict | None:
+        """The owner-routing gate: forward only when (1) the request has not
+        already hopped, (2) PD names another store as the region's warm
+        device owner, (3) this store cannot serve the region warm itself,
+        and (4) the plan is device-eligible — otherwise local serving is
+        already the best this cluster can do."""
+        rp = self.read_plane
+        if rp is None or self.copr is None:
+            return None
+        ctx = req.get("context") or {}
+        if ctx.get("forwarded"):
+            return None
+        region_id = ctx.get("region_id")
+        if region_id is None:
+            return None
+        owner = rp.device_owner_of(region_id)
+        if owner is None or owner == rp.store_id:
+            return None
+        rc = getattr(self.copr, "region_cache", None)
+        if (self.copr.device_enabled() and rc is not None
+                and rc.has_warm_region(region_id)):
+            return None  # warm here: a hop can only add latency
+        if not self._dag_device_eligible(req.get("dag")):
+            return None
+        return rp.forward_device_owner("coprocessor", req, owner)
+
+    def _dag_device_eligible(self, dag) -> bool:
+        """Cheap, memoized device-eligibility probe for owner routing —
+        deliberately independent of THIS store's enable_device switch (a
+        CPU-only store is exactly the one that benefits from forwarding)."""
+        from ..copr import jax_eval
+        from ..copr.dag import Aggregation
+
+        if isinstance(dag, dict):
+            try:
+                dag = self._parse_dag_wire(dag)
+            except Exception:  # noqa: BLE001 — malformed plans serve locally
+                return False
+        if dag is None:
+            return False
+        key = id(dag)
+        with self._dag_memo_mu:
+            hit = self._dag_eligible_memo.get(key)
+        if hit is not None and hit[0] is dag:
+            return hit[1]
+        ok = (any(isinstance(e, Aggregation) for e in dag.executors)
+              and jax_eval.supports(dag))
+        with self._dag_memo_mu:
+            self._dag_eligible_memo[key] = (dag, ok)
+            while len(self._dag_eligible_memo) > 256:
+                self._dag_eligible_memo.pop(
+                    next(iter(self._dag_eligible_memo)))
+        return ok
 
     def _coprocessor_local(self, req: dict) -> dict:
         assert self.copr is not None, "coprocessor endpoint not wired"
@@ -1094,9 +1204,7 @@ class KvService:
         try:
             dag = req.get("dag")
             if isinstance(dag, dict):
-                from ..copr.dag_wire import dag_from_wire
-
-                dag = dag_from_wire(dag)
+                dag = self._parse_dag_wire(dag)
             if dag is None:
                 return {"error": {"other": "dag required"}}
             creq = CoprRequest(

@@ -92,7 +92,7 @@ class StoreServer:
         security=None,
         raft_engine: bool = True,
         encryption_master_key: str | None = None,
-        sched_continuous: bool = False,
+        sched_continuous: bool = True,
         shard_cache: bool = True,
         group_commit: bool = True,
         write_through: bool = True,
@@ -178,9 +178,12 @@ class StoreServer:
             print(f"[standalone] serving mesh {dict(mesh.shape)} ({mode})",
                   file=sys.stderr)
         if sched_continuous:
-            # continuous cross-region batching: unary coprocessor requests
-            # from concurrent connections coalesce in the read scheduler's
-            # priority lanes (service.coprocessor routes through it)
+            # continuous cross-region batching — ON BY DEFAULT since the
+            # wire-path PR: unary coprocessor requests from concurrent
+            # connections coalesce in the read scheduler's priority lanes
+            # (service.coprocessor routes through it); same-plan-signature
+            # requests across regions ride one vmapped device program and
+            # identical requests share a slot (docs/wire_path.md)
             self.copr.scheduler.start()
         self.gc_worker = GcWorker(self.raftkv)
         # wait-for edges route to the cluster detector leader (region 1's
@@ -223,6 +226,11 @@ class StoreServer:
 
         _sync_cluster_version()
         self.node.heartbeat_hooks.append(_sync_cluster_version)
+        # device-owner placement (docs/wire_path.md): advertise this store's
+        # warm region images to PD each heartbeat and refresh the read
+        # plane's owner route map from the response — the forwarding tier's
+        # view of where every region's device image lives
+        self.node.heartbeat_hooks.append(self._advertise_device_placement)
         self.node.heartbeat_hooks.append(lambda: self.cdc.reap_idle())
         from ..util.metrics import REGISTRY
 
@@ -310,6 +318,19 @@ class StoreServer:
         )
         self.server = Server(self.service, host=host, port=port, security=security)
         self.recovered_peers = recovered
+
+    def _advertise_device_placement(self) -> None:
+        rc = self.copr.region_cache
+        regions: list[int] = []
+        if rc is not None and self.copr.device_enabled():
+            regions = rc.warm_region_ids()
+        try:
+            owners = self.pd.advertise_device_regions(
+                self.store.store_id, regions)
+        except Exception:  # noqa: BLE001 — PD briefly unreachable
+            return
+        if isinstance(owners, dict):
+            self.read_plane.set_device_owners(owners)
 
     def _publish_engine_metrics(self) -> None:
         from ..util.metrics import REGISTRY
@@ -448,8 +469,12 @@ def main(argv=None) -> int:
     ap.add_argument("--expect-stores", type=int, default=1)
     ap.add_argument("--enable-device", action="store_true")
     ap.add_argument("--sched-continuous", action="store_true",
-                    help="coalesce unary coprocessor requests across "
-                         "connections in the read scheduler's priority lanes")
+                    help="deprecated no-op: continuous coalescing is the "
+                         "default (see --no-sched-continuous)")
+    ap.add_argument("--no-sched-continuous", action="store_true",
+                    help="serve unary coprocessor requests per-request "
+                         "instead of coalescing them across connections in "
+                         "the read scheduler's priority lanes")
     ap.add_argument("--no-shard-cache", action="store_true",
                     help="keep the region column cache single-device even "
                          "with a multi-chip mesh (sharded warm serving off)")
@@ -489,7 +514,7 @@ def main(argv=None) -> int:
         host=args.host, port=args.port, enable_device=args.enable_device,
         security=security, raft_engine=not args.no_raft_engine,
         encryption_master_key=args.encryption_master_key,
-        sched_continuous=args.sched_continuous,
+        sched_continuous=not args.no_sched_continuous,
         shard_cache=not args.no_shard_cache,
         group_commit=not args.no_group_commit,
         write_through=not args.no_write_through,

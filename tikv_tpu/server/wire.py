@@ -3,7 +3,22 @@
 The reference speaks protobuf (kvproto) over gRPC; this framework's control
 plane speaks a compact tagged encoding over TCP frames.  Supported values:
 None, bool, int (signed 64), float, bytes, str, list, tuple, dict.  Safe to
-decode untrusted bytes (no code execution, bounded recursion).
+decode untrusted bytes (no code execution, bounded nesting).
+
+The hot serving path is **zero-copy for large payloads** in both directions:
+
+* :func:`dumps_parts` encodes a value into a list of buffers whose
+  concatenation equals :func:`dumps` — but any ``bytes``-like payload of
+  ``PASSTHROUGH_MIN`` bytes or more rides as its OWN buffer (a memoryview of
+  the caller's object, never copied).  The server's frame writer hands the
+  part list straight to ``socket.sendmsg`` (gather write), so a coprocessor
+  response's chunk data crosses from the endpoint to the kernel with zero
+  re-encoding copies.
+* :func:`loads` accepts ``bytes``/``bytearray``/``memoryview`` input and
+  walks it by offset (iterative containers, no per-element recursion for the
+  encode side); with ``bytes_view=True`` payloads of ``PASSTHROUGH_MIN``
+  bytes or more decode as read-only memoryviews into the frame instead of
+  copies (opt-in: the default keeps the plain-``bytes`` contract).
 """
 
 from __future__ import annotations
@@ -13,60 +28,98 @@ from ..util import codec
 _NONE, _TRUE, _FALSE, _INT, _FLOAT, _BYTES, _STR, _LIST, _DICT, _TUPLE = range(10)
 _MAX_DEPTH = 32
 
+#: bytes payloads at/above this size pass through as their own buffer
+#: (dumps_parts) or decode as a memoryview (loads(bytes_view=True)).  Below
+#: it, the copy is cheaper than the scatter/gather bookkeeping.
+PASSTHROUGH_MIN = 2048
+
+_BYTES_TYPES = (bytes, bytearray, memoryview)
+
 
 def dumps(obj) -> bytes:
     out = bytearray()
-    _enc(out, obj, 0)
+    _encode(out, obj, None)
     return bytes(out)
 
 
-def _enc(out: bytearray, obj, depth: int) -> None:
-    if depth > _MAX_DEPTH:
-        raise ValueError("wire value too deep")
-    if obj is None:
-        out.append(_NONE)
-    elif obj is True:
-        out.append(_TRUE)
-    elif obj is False:
-        out.append(_FALSE)
-    elif isinstance(obj, int):
-        out.append(_INT)
-        out += codec.encode_var_i64(obj)
-    elif isinstance(obj, float):
-        out.append(_FLOAT)
-        out += codec.encode_f64(obj)
-    elif isinstance(obj, bytes):
-        out.append(_BYTES)
-        out += codec.encode_var_u64(len(obj))
-        out += obj
-    elif isinstance(obj, str):
-        b = obj.encode()
-        out.append(_STR)
-        out += codec.encode_var_u64(len(b))
-        out += b
-    elif isinstance(obj, (list, tuple)):
-        out.append(_LIST if isinstance(obj, list) else _TUPLE)
-        out += codec.encode_var_u64(len(obj))
-        for v in obj:
-            _enc(out, v, depth + 1)
-    elif isinstance(obj, dict):
-        out.append(_DICT)
-        out += codec.encode_var_u64(len(obj))
-        for k, v in obj.items():
-            _enc(out, k, depth + 1)
-            _enc(out, v, depth + 1)
-    else:
-        raise TypeError(f"not wire-encodable: {type(obj)}")
+def dumps_parts(obj) -> list:
+    """Encode into a list of buffers; ``b"".join(map(bytes, parts))`` is
+    byte-identical to ``dumps(obj)``.  Large bytes payloads become their own
+    memoryview part — the caller's buffer, not a copy."""
+    parts: list = []
+    out = bytearray()
+    _encode(out, obj, parts)
+    if out:
+        parts.append(bytes(out))
+    return parts
 
 
-def loads(b: bytes):
-    v, off = _dec(b, 0, 0)
+def _encode(out: bytearray, root, parts: list | None) -> None:
+    # explicit stack instead of per-element recursion: a 64k-row scan
+    # response is a list of tens of thousands of pairs, and Python call
+    # frames per element were the top line of the encode profile
+    stack: list = [(root, 0)]
+    while stack:
+        obj, depth = stack.pop()
+        if depth > _MAX_DEPTH:
+            raise ValueError("wire value too deep")
+        if obj is None:
+            out.append(_NONE)
+        elif obj is True:
+            out.append(_TRUE)
+        elif obj is False:
+            out.append(_FALSE)
+        elif isinstance(obj, int):
+            out.append(_INT)
+            out += codec.encode_var_i64(obj)
+        elif isinstance(obj, float):
+            out.append(_FLOAT)
+            out += codec.encode_f64(obj)
+        elif isinstance(obj, _BYTES_TYPES):
+            n = len(obj)
+            out.append(_BYTES)
+            out += codec.encode_var_u64(n)
+            if parts is not None and n >= PASSTHROUGH_MIN:
+                # flush the accumulated header and pass the payload through
+                # as the caller's own buffer — zero copies on this path
+                parts.append(bytes(out))
+                out.clear()
+                parts.append(obj if isinstance(obj, memoryview)
+                             else memoryview(obj))
+            else:
+                out += obj
+        elif isinstance(obj, str):
+            b = obj.encode()
+            out.append(_STR)
+            out += codec.encode_var_u64(len(b))
+            out += b
+        elif isinstance(obj, (list, tuple)):
+            out.append(_LIST if isinstance(obj, list) else _TUPLE)
+            out += codec.encode_var_u64(len(obj))
+            d = depth + 1
+            for v in reversed(obj):
+                stack.append((v, d))
+        elif isinstance(obj, dict):
+            out.append(_DICT)
+            out += codec.encode_var_u64(len(obj))
+            d = depth + 1
+            for k, v in reversed(list(obj.items())):
+                stack.append((v, d))
+                stack.append((k, d))
+        else:
+            raise TypeError(f"not wire-encodable: {type(obj)}")
+
+
+def loads(b, bytes_view: bool = False):
+    if isinstance(b, bytearray) or (bytes_view and isinstance(b, bytes)):
+        b = memoryview(b)
+    v, off = _dec(b, 0, 0, bytes_view)
     if off != len(b):
         raise ValueError("trailing bytes")
     return v
 
 
-def _dec(b: bytes, off: int, depth: int):
+def _dec(b, off: int, depth: int, bytes_view: bool = False):
     if depth > _MAX_DEPTH:
         raise ValueError("wire value too deep")
     tag = b[off]
@@ -86,20 +139,30 @@ def _dec(b: bytes, off: int, depth: int):
         raw = b[off : off + n]
         if len(raw) != n:
             raise ValueError("truncated")
-        return (raw if tag == _BYTES else raw.decode()), off + n
+        if tag == _STR:
+            return (str(raw, "utf-8") if isinstance(raw, memoryview)
+                    else raw.decode()), off + n
+        if isinstance(raw, memoryview):
+            # large payloads stay views into the frame (zero-copy decode);
+            # small ones materialize — a dict full of tiny views would pin
+            # the whole frame for the life of every key
+            if bytes_view and n >= PASSTHROUGH_MIN:
+                return raw.toreadonly(), off + n
+            return bytes(raw), off + n
+        return raw, off + n
     if tag in (_LIST, _TUPLE):
         n, off = codec.decode_var_u64(b, off)
         items = []
         for _ in range(n):
-            v, off = _dec(b, off, depth + 1)
+            v, off = _dec(b, off, depth + 1, bytes_view)
             items.append(v)
         return (items if tag == _LIST else tuple(items)), off
     if tag == _DICT:
         n, off = codec.decode_var_u64(b, off)
         d = {}
         for _ in range(n):
-            k, off = _dec(b, off, depth + 1)
-            v, off = _dec(b, off, depth + 1)
+            k, off = _dec(b, off, depth + 1, bytes_view)
+            v, off = _dec(b, off, depth + 1, bytes_view)
             d[k] = v
         return d, off
     raise ValueError(f"bad wire tag {tag}")

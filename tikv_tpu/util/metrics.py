@@ -81,6 +81,16 @@ class Histogram(_Metric):
         with self._mu:
             return self._n.get(tuple(sorted(labels.items())), 0)
 
+    def total(self, **labels) -> float:
+        """Accumulated observed value for a label set (the _sum series)."""
+        with self._mu:
+            return self._sum.get(tuple(sorted(labels.items())), 0.0)
+
+    def label_sets(self) -> list[dict]:
+        """The label sets observed so far (debug summaries)."""
+        with self._mu:
+            return [dict(key) for key in self._n]
+
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
         with self._mu:
