@@ -14,6 +14,8 @@ takes over once the native engine lands).
     ctl.py --addr HOST:PORT resolve-lock --start-ts TS [--commit-ts TS]
     ctl.py --addr HOST:PORT region-info|region-properties [--region R]
     ctl.py --addr HOST:PORT read-progress [--region R]
+    ctl.py --addr HOST:PORT integrity
+    ctl.py --addr HOST:PORT consistency-check [--trigger] [--region R]
     ctl.py --addr HOST:PORT bad-regions|all-regions
     ctl.py --status ADDR metrics|config
     ctl.py --status ADDR reconfig section.key=value ...
@@ -111,6 +113,21 @@ def main(argv=None) -> int:
     # default all-regions view
     sp.add_argument("--region", type=int, dest="progress_region", default=None,
                     help="narrow to one region (default: every region)")
+    sub.add_parser(
+        "integrity",
+        help="derived-plane integrity view: per-region image fingerprints "
+             "+ apply points, quarantine ledger, scrubber progress, "
+             "shadow-read sample/mismatch counts (docs/integrity.md)")
+    sp = sub.add_parser(
+        "consistency-check",
+        help="raft consistency-check surface: recorded per-region hashes "
+             "and divergences; --trigger proposes a fresh compute_hash "
+             "round on every led region (results land asynchronously — "
+             "re-run without --trigger to read them)")
+    sp.add_argument("--trigger", action="store_true",
+                    help="schedule a new round instead of reading results")
+    sp.add_argument("--region", type=int, dest="cc_region", default=None,
+                    help="narrow --trigger to one region")
     sub.add_parser("bad-regions")
     sub.add_parser("all-regions")
     sub.add_parser("metrics")
@@ -289,6 +306,16 @@ def main(argv=None) -> int:
             r = c.call("debug_region_info", {"region_id": args.region})
         elif args.cmd == "region-properties":
             r = c.call("debug_region_properties", {"region_id": args.region})
+        elif args.cmd == "integrity":
+            r = c.call("debug_integrity", {})
+        elif args.cmd == "consistency-check":
+            if args.trigger:
+                req = {}
+                if args.cc_region is not None:
+                    req["region_id"] = args.cc_region
+                r = c.call("debug_consistency_check", req)
+            else:
+                r = c.call("debug_consistency", {})
         elif args.cmd == "bad-regions":
             r = c.call("debug_bad_regions", {})
         elif args.cmd == "all-regions":

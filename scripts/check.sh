@@ -28,4 +28,8 @@ echo "== follower-read chaos smoke: leader isolation + read ladder under the san
 JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
   -m 'not slow' tests/test_follower_reads.py
 
+echo "== integrity smoke: SDC scrubber + shadow reads + corruption chaos under the sanitizer =="
+JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
+  -m 'not slow' tests/test_integrity.py
+
 echo "check.sh: all gates green"

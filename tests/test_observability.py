@@ -51,6 +51,12 @@ LAZY_SERIES = {
     "tikv_coprocessor_follower_read_total",
     "tikv_coprocessor_region_cache_total",
     "tikv_coprocessor_region_cache_wt_lost_total",
+    "tikv_coprocessor_integrity_mismatch_total",
+    "tikv_coprocessor_integrity_quarantine_total",
+    "tikv_coprocessor_integrity_scrub_total",
+    "tikv_coprocessor_shadow_read_total",
+    "tikv_coprocessor_checksum_total",
+    "tikv_raft_consistency_check_total",
     "tikv_coprocessor_region_cache_device_bytes",
     "tikv_storage_batch_size",
     "tikv_coprocessor_region_cache_delta_rows_total",

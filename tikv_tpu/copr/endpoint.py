@@ -81,6 +81,7 @@ class Endpoint:
         write_through: bool = True,
         breaker=None,
         breaker_config=None,
+        shadow_sample: int | None = None,
     ):
         from .breaker import DeviceCircuitBreaker
         from .tracker import SlowLog
@@ -150,6 +151,17 @@ class Endpoint:
         from .scheduler import CoprReadScheduler
 
         self.scheduler = CoprReadScheduler(self, sched_config)
+        # integrity plane (docs/integrity.md): deterministic shadow-read
+        # sampling of warm device serves (default 1/256, TIKV_TPU_SHADOW_SAMPLE
+        # env; 0 = off, 1 = verify every warm serve) + the SDC scrubber —
+        # constructed unstarted; standalone servers start the cadence
+        from .integrity import IntegrityScrubber, ShadowSampler
+
+        self.shadow = ShadowSampler(shadow_sample)
+        self.scrubber = (
+            IntegrityScrubber(self.region_cache, engine)
+            if self.region_cache is not None else None
+        )
 
     def handle_request(self, req: CoprRequest) -> CoprResponse:
         """Instrumented entry: every path (device, CPU fallback, analyze,
@@ -245,20 +257,38 @@ class Endpoint:
                     resp = self._run_sharded_cached(ev, cache)
                 if resp is None:
                     resp = ev.run(src, cache=cache)
+                data = resp.encode()
+                from_device = True
+                # shadow-read verification (docs/integrity.md): a sampled
+                # warm image-backed serve re-executes on the CPU oracle and
+                # byte-compares — a mismatch quarantines the image and the
+                # CPU bytes serve, so a sampled request never returns
+                # corrupted derived state
+                if (rc_outcome in ("hit", "delta", "wt_delta")
+                        and self.shadow.pick("unary")):
+                    fixed = self.shadow_compare(req, snap, data, "unary")
+                    if fixed is not None:
+                        data = fixed
+                        from_device = False
                 scanned = src.stats.write.processed_keys if src is not None else 0
-                m = tracker.on_finish(scanned_keys=scanned, from_device=True)
+                m = tracker.on_finish(scanned_keys=scanned, from_device=from_device)
                 self.slow_log.observe(tracker)
-                from_cache = (cache is not None and cache.filled and src is None
+                from_cache = (from_device
+                              and cache is not None and cache.filled and src is None
                               and rc_outcome not in ("miss", "too_big"))
                 self.breaker.record_success("unary")
                 if stale_snap:
-                    self.count_follower_read("device")
+                    self.count_follower_read("device" if from_device else "cpu")
                 return CoprResponse(
-                    resp.encode(), from_device=True,
+                    data, from_device=from_device,
                     from_cache=from_cache,
                     metrics=m.to_dict(),
                 )
             except Exception as exc:
+                from .integrity import IntegrityMismatch
+
+                if isinstance(exc, IntegrityMismatch):
+                    raise  # TIKV_TPU_INTEGRITY_FATAL: surface, never mask
                 # device/runtime failure (compiler, tunnel, OOM): the CPU
                 # pipeline is the correctness oracle and always available —
                 # re-run there off the same immutable snapshot rather than
@@ -287,6 +317,63 @@ class Endpoint:
         if stale_snap:
             self.count_follower_read("cpu")
         return CoprResponse(resp.encode(), from_device=False, metrics=m.to_dict())
+
+    def _cpu_bytes(self, req: CoprRequest, snap) -> bytes:
+        """The CPU-oracle answer to ``req`` off ``snap`` — the byte-identity
+        ground truth every device path is held to."""
+        stats = Statistics()
+        src = MvccScanSource(snap, req.start_ts, req.ranges, statistics=stats)
+        return BatchExecutorsRunner(req.dag, src).handle_request().encode()
+
+    def shadow_compare(self, req: CoprRequest, snap, device_data: bytes,
+                       path: str) -> bytes | None:
+        """Shadow-read verification core (docs/integrity.md): re-execute a
+        sampled warm serve on the CPU oracle off the SAME snapshot and byte
+        compare.  Returns None on a match (or an inconclusive oracle error);
+        on mismatch the backing image is quarantined, the mismatch counts
+        under stage=shadow_read, and the CPU bytes return for the caller to
+        serve — zero wrong bytes reach the sampled client."""
+        from .integrity import IntegrityMismatch, count_mismatch, integrity_fatal
+
+        try:
+            cpu = self._cpu_bytes(req, snap)
+        except Exception:  # noqa: BLE001 — locks/races: inconclusive, not bad
+            self.shadow.note(path, "error")
+            return None
+        if cpu == device_data:
+            self.shadow.note(path, "ok")
+            return None
+        self.shadow.note(path, "mismatch")
+        count_mismatch("shadow_read")
+        region_id = (req.context or {}).get("region_id")
+        if region_id is None:
+            region = getattr(snap, "region", None)
+            region_id = getattr(region, "id", None)
+        if self.region_cache is not None and region_id is not None:
+            self.region_cache.quarantine_region(
+                region_id, ranges=req.ranges, stage="shadow_read",
+                detail={"path": path},
+            )
+        if integrity_fatal():
+            raise IntegrityMismatch(
+                f"shadow read mismatch on region {region_id} path={path}"
+            )
+        return cpu
+
+    def integrity_snapshot(self) -> dict:
+        """The /debug/integrity + ``ctl.py integrity`` view: per-image
+        fingerprints, the quarantine ledger, scrubber cadence/progress, and
+        shadow-read sample/mismatch counts."""
+        rc = self.region_cache
+        out = {
+            "enabled": rc is not None,
+            "shadow": self.shadow.snapshot(),
+            "scrubber": self.scrubber.snapshot() if self.scrubber is not None else None,
+        }
+        if rc is not None:
+            out["fingerprints"] = rc.image_fingerprints()
+            out["quarantine"] = list(rc.quarantine_ledger)
+        return out
 
     @staticmethod
     def count_follower_read(path: str) -> None:
@@ -368,23 +455,44 @@ class Endpoint:
         """MVCC-consistent checksum: the logical rows visible at start_ts
         (checksum.rs scans through the snapshot store), so large values in
         CF_DEFAULT are covered and replicas with different physical version
-        histories but identical logical data agree."""
+        histories but identical logical data agree.
+
+        Warm path (docs/integrity.md): a resident region image of exactly
+        these ranges carries the XOR-folded per-row crc64 — byte-identical
+        to this scan's answer by construction — so ADMIN CHECKSUM over warm
+        data costs zero engine reads; anything else falls back to the
+        CPU-oracle scan."""
         from . import analyze as az
         from ..storage.mvcc import ForwardScanner
         from ..storage.txn_types import Key
+        from ..util.metrics import REGISTRY
         from .tracker import Tracker
 
         tracker = tracker or Tracker()
         tracker.on_schedule()
         snap = self.engine.snapshot(stale_read_ctx(req))
         tracker.on_snapshot_finished()
-        kvs = []
-        for start, end in req.ranges:
-            kvs.extend(
-                ForwardScanner(snap, req.start_ts, Key.from_raw(start), Key.from_raw(end))
+        warm = None
+        if self.region_cache is not None:
+            warm = self.region_cache.checksum_serve(
+                snap, self._snap_context(req, snap), req.ranges, req.start_ts
             )
-        r = az.checksum_range(kvs)
-        tracker.metrics.scanned_keys = r["total_kvs"]
+        if warm is not None:
+            checksum, total_kvs, total_bytes = warm
+            r = {"checksum": checksum, "total_kvs": total_kvs,
+                 "total_bytes": total_bytes}
+        else:
+            kvs = []
+            for start, end in req.ranges:
+                kvs.extend(
+                    ForwardScanner(snap, req.start_ts, Key.from_raw(start), Key.from_raw(end))
+                )
+            r = az.checksum_range(kvs)
+            tracker.metrics.scanned_keys = r["total_kvs"]
+        REGISTRY.counter(
+            "tikv_coprocessor_checksum_total",
+            "Coprocessor Checksum (tp=105) requests, by serving path",
+        ).inc(path="warm" if warm is not None else "cold")
         from ..util import codec as c
 
         out = (
@@ -392,7 +500,7 @@ class Endpoint:
             + c.encode_var_u64(r["total_kvs"])
             + c.encode_var_u64(r["total_bytes"])
         )
-        return CoprResponse(out)
+        return CoprResponse(out, from_cache=warm is not None)
 
     def handle_batch(self, reqs: list[CoprRequest]) -> list["CoprResponse"]:
         """K coprocessor requests answered together (the batch_coprocessor /
@@ -573,19 +681,8 @@ class Endpoint:
         execs = req.dag.executors if req.dag is not None else []
         if not execs or type(execs[0]) is not TableScan:
             return None, ""
-        # a raft RegionSnapshot carries its own identity and data version —
-        # serving paths need no context plumbing; explicit context still wins
-        # (tests, embedded use over plain engines)
-        context = dict(req.context or {})
-        region = getattr(snap, "region", None)
-        if region is not None:
-            context.setdefault("region_id", region.id)
-            context.setdefault(
-                "region_epoch", (region.epoch.conf_ver, region.epoch.version)
-            )
-        apply_index = getattr(snap, "apply_index", None)
-        if apply_index is not None:
-            context.setdefault("apply_index", apply_index)
+        context = self._snap_context(req, snap)
+        apply_index = context.get("apply_index")
         rp = getattr(snap, "read_progress", None)
         if rp is not None:
             # RegionReadProgress pairing invariant (docs/stale_reads.md): a
@@ -606,6 +703,24 @@ class Endpoint:
             tracker.metrics.region_cache = outcome
             tracker.metrics.region_cache_delta_rows = delta_rows
         return cache, outcome
+
+    @staticmethod
+    def _snap_context(req: CoprRequest, snap) -> dict:
+        """The request context enriched from the snapshot: a raft
+        RegionSnapshot carries its own identity and data version — serving
+        paths need no context plumbing; explicit context still wins (tests,
+        embedded use over plain engines)."""
+        context = dict(req.context or {})
+        region = getattr(snap, "region", None)
+        if region is not None:
+            context.setdefault("region_id", region.id)
+            context.setdefault(
+                "region_epoch", (region.epoch.conf_ver, region.epoch.version)
+            )
+        apply_index = getattr(snap, "apply_index", None)
+        if apply_index is not None:
+            context.setdefault("apply_index", apply_index)
+        return context
 
     def _block_cache_for(self, req: CoprRequest):
         """Decoded-block cache, valid only while the region data is unchanged:

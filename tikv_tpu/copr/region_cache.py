@@ -76,10 +76,11 @@ from ..storage.engine import CF_DEFAULT, CF_LOCK, CF_WRITE
 from ..storage.mvcc import Statistics
 from ..storage.mvcc.reader import _check_lock
 from ..storage.txn_types import Key, Write, WriteType, append_ts, split_ts
+from . import integrity as _integrity
 from .cache import ColumnBlockCache
 from .datatypes import Column, EvalType
 from .mvcc_batch import MvccBatchScanSource, scan_delta
-from .table import RowBatchDecoder, decode_record_handles
+from .table import RowBatchDecoder, decode_record_handles, decode_record_key, record_key
 
 DEFAULT_BYTE_BUDGET = 256 << 20
 DEFAULT_MAX_REGIONS = 64
@@ -238,6 +239,18 @@ class RegionImage:
         # nothing about that batch's lock.
         self.locks_dirty = False
         self.locks_dirty_at = 0
+        # integrity fingerprint (docs/integrity.md): one crc64 per row over
+        # the RAW (key, value) chain — byte-identical to the coprocessor
+        # Checksum entry — plus a commit_ts-mixed variant, both folded
+        # incrementally by every delta apply.  fp_valid=False (multi-table
+        # ranges, unhashable delta keys) disables the whole plane for this
+        # image: the scrubber reports it unverifiable, checksum serves cold.
+        self.fp_valid = False
+        self.table_id: int | None = None
+        self.row_fp = np.empty(0, dtype=np.uint64)
+        self.row_nbytes = np.empty(0, dtype=np.int64)
+        self.fp_value = 0      # fold(row_fp): the warm Checksum answer
+        self.fp_integrity = 0  # fold(mix_fp(row_fp, row_commit_ts))
 
     @property
     def n_rows(self) -> int:
@@ -255,9 +268,11 @@ class RegionImage:
     # -- build -------------------------------------------------------------
 
     def fill(self, handles: np.ndarray, values: list[bytes], cts: np.ndarray,
-             max_commit_ts: int, apply_index: int, start_ts: int) -> None:
+             max_commit_ts: int, apply_index: int, start_ts: int,
+             raw_keys: list[bytes] | None = None) -> None:
         self.handles = handles
         self.row_commit_ts = cts
+        self._init_fingerprint(handles, values, raw_keys)
         cache = self.block_cache
         cache.blocks.clear()
         br = self.block_rows
@@ -271,6 +286,74 @@ class RegionImage:
         self.max_commit_ts = max_commit_ts
         self.wt_pending = None  # a rebuild reflects the engine directly
         self._recount()
+
+    # -- integrity fingerprint ---------------------------------------------
+
+    def _init_fingerprint(self, handles, values, raw_keys) -> None:
+        """Compute the per-row integrity hashes at build time.  Delta folds
+        reconstruct raw keys from (table_id, handle), so a single-table
+        range is required — raw record keys ARE (table_id, handle) encoded,
+        making the reconstruction exact."""
+        self.fp_valid = False
+        self.table_id = None
+        try:
+            if raw_keys is None:
+                self.table_id = self._table_id_from_ranges()
+                if self.table_id is None:
+                    return
+                raw_keys = [record_key(self.table_id, int(h)) for h in handles]
+            elif len(raw_keys):
+                tid_first = decode_record_key(raw_keys[0])[0]
+                # keys are sorted: same first/last table prefix = one table
+                if decode_record_key(raw_keys[-1])[0] != tid_first:
+                    return
+                self.table_id = tid_first
+            else:
+                self.table_id = self._table_id_from_ranges()
+            self.row_fp = _integrity.row_checksums(raw_keys, values)
+            self.row_nbytes = np.fromiter(
+                (len(k) + len(v) for k, v in zip(raw_keys, values)),
+                dtype=np.int64, count=len(values),
+            )
+        except Exception:  # noqa: BLE001 — exotic keys: plane off, serve on
+            self.row_fp = np.empty(0, dtype=np.uint64)
+            self.row_nbytes = np.empty(0, dtype=np.int64)
+            self.fp_value = self.fp_integrity = 0
+            return
+        self.fp_valid = True
+        self._refold()
+
+    def _table_id_from_ranges(self) -> int | None:
+        from ..util import codec as _codec
+
+        tids = set()
+        for start, _end in self.key[1]:
+            if len(start) < 9 or start[:1] != b"t":
+                return None
+            tids.add(_codec.decode_i64(start, 1))
+        return tids.pop() if len(tids) == 1 else None
+
+    def _refold(self) -> None:
+        self.fp_value = _integrity.fold(self.row_fp)
+        self.fp_integrity = _integrity.fold(
+            _integrity.mix_fp(self.row_fp, self.row_commit_ts)
+        )
+
+    def _invalidate_fp(self) -> None:
+        """An unhashable delta landed: the fingerprint plane turns off for
+        this image (it would otherwise drift silently)."""
+        self.fp_valid = False
+        self.row_fp = np.empty(0, dtype=np.uint64)
+        self.row_nbytes = np.empty(0, dtype=np.int64)
+        self.fp_value = self.fp_integrity = 0
+
+    def checksum_parts(self) -> tuple[int, int, int] | None:
+        """(checksum, total_kvs, total_bytes) exactly as the CPU-oracle
+        Checksum scan would answer over this image's rows, or None when the
+        fingerprint plane is off for this image."""
+        if not self.fp_valid:
+            return None
+        return self.fp_value, self.n_rows, int(self.row_nbytes.sum())
 
     # -- delta -------------------------------------------------------------
 
@@ -290,10 +373,37 @@ class RegionImage:
             cols = (
                 self.decoder.decode(ch, delta["changed_values"]) if len(ch) else None
             )
+            # fingerprint fold (docs/integrity.md): hash the delta rows off
+            # the RAW value chain before decode touches them — the fold
+            # tracks what the image will CONTAIN, the scrubber's oracle says
+            # what it SHOULD contain
+            new_fp = new_nb = None
+            if self.fp_valid:
+                try:
+                    dkeys = [record_key(self.table_id, int(h)) for h in ch]
+                    new_fp = _integrity.row_checksums(dkeys, delta["changed_values"])
+                    new_nb = np.fromiter(
+                        (len(k) + len(v)
+                         for k, v in zip(dkeys, delta["changed_values"])),
+                        dtype=np.int64, count=len(ch),
+                    )
+                except Exception:  # noqa: BLE001 — unhashable: plane off
+                    self._invalidate_fp()
             if in_place:
+                if self.fp_valid:
+                    cts_new = np.asarray(delta["changed_commit_ts"], dtype=np.int64)
+                    old_fp = self.row_fp[pos]
+                    old_mix = _integrity.mix_fp(old_fp, self.row_commit_ts[pos])
+                    self.fp_value ^= _integrity.fold(old_fp) ^ _integrity.fold(new_fp)
+                    self.fp_integrity ^= _integrity.fold(old_mix) ^ _integrity.fold(
+                        _integrity.mix_fp(new_fp, cts_new)
+                    )
+                    self.row_fp[pos] = new_fp
+                    self.row_nbytes[pos] = new_nb
                 self._apply_updates(pos, cols, ch, delta["changed_commit_ts"])
             else:
-                self._apply_structural(ch, cols, delta["changed_commit_ts"], dh)
+                self._apply_structural(ch, cols, delta["changed_commit_ts"], dh,
+                                       new_fp, new_nb)
         self.apply_index = apply_index
         self.snapshot_ts = start_ts
         self.max_commit_ts = delta["max_commit_ts"]
@@ -363,9 +473,18 @@ class RegionImage:
         self.row_commit_ts[pos] = cts
         self.block_cache.scatter_update(updates)
 
-    def _apply_structural(self, ch: np.ndarray, cols, cts: np.ndarray, dh: np.ndarray) -> None:
+    def _apply_structural(self, ch: np.ndarray, cols, cts: np.ndarray, dh: np.ndarray,
+                          new_fp: np.ndarray | None = None,
+                          new_nb: np.ndarray | None = None) -> None:
         """Inserts and/or deletes: repack host blocks from the resident
-        columns (no KV decode) and drop device pins to rebuild lazily."""
+        columns (no KV decode) and drop device pins to rebuild lazily.
+        ``new_fp``/``new_nb`` are the changed rows' integrity hashes/sizes —
+        mirrored through the same delete/update/insert index math as
+        ``row_commit_ts`` so the fingerprint arrays stay row-aligned."""
+        if self.fp_valid and new_fp is None and len(ch):
+            self._invalidate_fp()
+        fp = self.row_fp if self.fp_valid else None
+        nb = self.row_nbytes if self.fp_valid else None
         blocks = self.block_cache.blocks
         n_old = self.n_rows
         # global view of each column, preserving dictionary codes
@@ -394,6 +513,9 @@ class RegionImage:
             sel = np.flatnonzero(keep)
             handles = handles[sel]
             row_cts = row_cts[sel]
+            if fp is not None:
+                fp = fp[sel]
+                nb = nb[sel]
             gdata = [d[sel] for d in gdata]
             gnulls = [nl[sel] for nl in gnulls]
         if len(ch):
@@ -425,12 +547,20 @@ class RegionImage:
             if len(upd_idx):
                 row_cts = row_cts.copy()
                 row_cts[pos_c[upd_idx]] = cts[upd_idx]
+                if fp is not None:
+                    fp = fp.copy()
+                    nb = nb.copy()
+                    fp[pos_c[upd_idx]] = new_fp[upd_idx]
+                    nb[pos_c[upd_idx]] = new_nb[upd_idx]
             ins_idx = np.flatnonzero(~np.asarray(is_upd))
             if len(ins_idx):
                 ins_h = ch[ins_idx]
                 ins_at = np.searchsorted(handles, ins_h)
                 handles = np.insert(handles, ins_at, ins_h)
                 row_cts = np.insert(row_cts, ins_at, cts[ins_idx])
+                if fp is not None:
+                    fp = np.insert(fp, ins_at, new_fp[ins_idx])
+                    nb = np.insert(nb, ins_at, new_nb[ins_idx])
                 for ci in range(len(self.schema)):
                     ivals = np.array(
                         [new_vals[ci][int(i)] for i in ins_idx], dtype=gdata[ci].dtype
@@ -441,6 +571,12 @@ class RegionImage:
                     )
         self.handles = handles
         self.row_commit_ts = row_cts
+        if fp is not None:
+            self.row_fp = fp
+            self.row_nbytes = nb
+            # the repack is already O(n): a vectorized re-fold is simpler
+            # than incrementally retiring the deleted rows' contributions
+            self._refold()
         # re-chunk into blocks (views over the global arrays) and drop pins
         templates = [blocks[0].cols[ci] if blocks else None for ci in range(len(self.schema))]
         self.block_cache.blocks.clear()
@@ -519,6 +655,10 @@ class RegionColumnCache:
         self._images: dict = {}  # key -> RegionImage, insertion = LRU order
         self._mu = make_rlock("copr.region_cache")
         self.stats = RegionCacheStats()
+        # quarantine ledger (docs/integrity.md): every image invalidated by
+        # an integrity mismatch leaves an entry here — the operator's
+        # forensic trail behind tikv_coprocessor_integrity_quarantine_total
+        self.quarantine_ledger: list[dict] = []
         # write-through delta intake (docs/write_path.md): per-region
         # watermark of the highest apply index whose data change this cache
         # has SEEN (as a parsed delta or a lost marker).  Pending deltas may
@@ -607,18 +747,8 @@ class RegionColumnCache:
                 self.stats.stale += 1
                 self._count("stale")
                 return None, "stale", 0
-            fresh = apply_index == img.apply_index and (
-                start_ts == img.snapshot_ts or img.max_commit_ts <= img.snapshot_ts
-            )
-            if fresh:
-                if start_ts > img.snapshot_ts or img.locks_dirty:
-                    seen = self._check_locks(snap, ranges, start_ts, stats)
-                    if seen == 0 and apply_index >= img.locks_dirty_at:
-                        # this snapshot contains the dirtying batch and the
-                        # range is lock-free — safe to stop re-scanning.  An
-                        # OLDER snapshot seeing no locks proves nothing.
-                        img.locks_dirty = False
-                    img.snapshot_ts = max(img.snapshot_ts, start_ts)
+            if self._hit_fresh_locked(img, apply_index, start_ts, snap,
+                                      ranges, stats):
                 self.stats.hits += 1
                 self._count("hit")
                 return img.block_cache, "hit", 0
@@ -721,6 +851,103 @@ class RegionColumnCache:
             self._enforce_budget(keep=key)
             self._gauge_bytes()
             return img.block_cache, "delta", n
+
+    # -- integrity plane (docs/integrity.md) ---------------------------------
+
+    def quarantine_image(self, key, stage: str, detail: dict | None = None):
+        """Quarantine ONE image: ledger entry + invalidation (counted under
+        its own reason so dashboards separate corruption from churn).  The
+        rebuild happens on the next serve — or eagerly by the scrubber.
+        Safe to call with the manager lock held (it is reentrant)."""
+        import time as _time
+
+        with self._mu:
+            img = self._images.get(key)
+            if img is None:
+                return None
+            entry = {
+                "time": _time.time(),
+                "region_id": key[0],
+                "key_id": _integrity.image_key_id(key),
+                "ranges": [(s.hex(), e.hex()) for s, e in key[1]],
+                "stage": stage,
+                "epoch": list(img.epoch),
+                "apply_index": img.apply_index,
+                "snapshot_ts": img.snapshot_ts,
+                "rows": img.n_rows,
+                "fingerprint": img.fp_integrity if img.fp_valid else None,
+            }
+            if detail:
+                entry.update(detail)
+            self.quarantine_ledger.append(entry)
+            del self.quarantine_ledger[:-256]
+            self._drop(key, reason="quarantine")
+        _integrity.count_quarantine(stage)
+        return entry
+
+    def quarantine_region(self, region_id: int, ranges=None, stage: str = "scrub",
+                          detail: dict | None = None) -> list:
+        """Quarantine every image of ``region_id`` (narrowed to one range
+        set when ``ranges`` is given) — the shadow-read mismatch path."""
+        with self._mu:
+            keys = [
+                k for k in self._images
+                if k[0] == region_id and (ranges is None or k[1] == tuple(ranges))
+            ]
+            return [self.quarantine_image(k, stage, detail) for k in keys]
+
+    def image_fingerprints(self) -> list[dict]:
+        """Per-image integrity view for the debug surface: fingerprint,
+        apply point, and write-through pending state of every resident
+        image."""
+        with self._mu:
+            out = []
+            for key, img in self._images.items():
+                out.append({
+                    "region_id": key[0],
+                    "key_id": _integrity.image_key_id(key),
+                    "epoch": list(img.epoch),
+                    "apply_index": img.apply_index,
+                    "snapshot_ts": img.snapshot_ts,
+                    "rows": img.n_rows,
+                    "fp_valid": img.fp_valid,
+                    "fingerprint": img.fp_integrity if img.fp_valid else None,
+                    "checksum": img.fp_value if img.fp_valid else None,
+                    "pending": img.wt_pending is not None,
+                })
+            return out
+
+    def checksum_serve(self, snap, context: dict, ranges, start_ts: int):
+        """Answer a coprocessor Checksum (tp=105) off a warm image
+        fingerprint: returns (checksum, total_kvs, total_bytes) when an
+        image of exactly these ranges is fresh for (apply_index, start_ts),
+        else None (the CPU-oracle scan serves).  The per-row hash is the
+        checksum_range entry by construction, so warm and cold answers are
+        byte-identical.  Locks are the one thing the fingerprint cannot
+        prove absent — a dirty/newer-ts serve re-scans CF_LOCK exactly like
+        the hit path (and raises KeyIsLocked exactly like the oracle scan
+        would)."""
+        region_id = (context or {}).get("region_id")
+        epoch = _epoch_of((context or {}).get("region_epoch"))
+        apply_index = (context or {}).get("apply_index")
+        if region_id is None or epoch is None or apply_index is None:
+            return None
+        rkey = tuple(ranges)
+        stats = Statistics()
+        with self._mu:
+            for key, img in self._images.items():
+                if key[0] != region_id or key[1] != rkey:
+                    continue
+                if img.epoch != epoch or not img.fp_valid:
+                    continue
+                # the hit path's exact freshness + stale-guard + lock rules
+                # (ONE definition — _hit_fresh_locked — so the warm
+                # Checksum path can never drift from what a served hit
+                # would have answered)
+                if self._hit_fresh_locked(img, apply_index, start_ts, snap,
+                                          ranges, stats):
+                    return img.checksum_parts()
+        return None
 
     def invalidate_region(self, region_id: int, reason: str = "epoch") -> None:
         with self._mu:
@@ -983,7 +1210,7 @@ class RegionColumnCache:
             return None, "uncacheable", 0
         img = RegionImage(key, epoch, list(columns_info), self.block_rows)
         img.fill(handles, values, src.row_commit_ts, src.max_commit_ts,
-                 apply_index, start_ts)
+                 apply_index, start_ts, raw_keys=keys)
         if img.nbytes > self.byte_budget:
             self.stats.uncacheable += 1
             self._count("too_big")
@@ -1003,6 +1230,32 @@ class RegionColumnCache:
             self._count("miss")
             self._gauge_bytes()
         return img.block_cache, "miss", 0
+
+    def _hit_fresh_locked(self, img, apply_index, start_ts, snap, ranges,
+                          stats) -> bool:
+        """ONE definition of hit-path freshness (serve()'s hits AND the
+        warm Checksum path): True iff the image may serve ``start_ts``
+        as-is at ``apply_index``.  Re-scans CF_LOCK when it must (raising
+        on a blocking lock, exactly like the oracle scan would) and
+        maintains ``locks_dirty`` / ``snapshot_ts`` like a served hit.
+        Caller holds the manager lock."""
+        if start_ts < img.snapshot_ts:
+            # the image may contain rows committed above this reader's ts —
+            # only a fresh scan can answer below the image's snapshot
+            return False
+        if not (apply_index == img.apply_index and (
+                start_ts == img.snapshot_ts
+                or img.max_commit_ts <= img.snapshot_ts)):
+            return False
+        if start_ts > img.snapshot_ts or img.locks_dirty:
+            seen = self._check_locks(snap, ranges, start_ts, stats)
+            if seen == 0 and apply_index >= img.locks_dirty_at:
+                # this snapshot contains the dirtying batch and the range is
+                # lock-free — safe to stop re-scanning.  An OLDER snapshot
+                # seeing no locks proves nothing.
+                img.locks_dirty = False
+            img.snapshot_ts = max(img.snapshot_ts, start_ts)
+        return True
 
     def _check_locks(self, snap, ranges, ts, stats) -> int:
         """Raise on a blocking lock; return how many locks the ranges hold

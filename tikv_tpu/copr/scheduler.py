@@ -194,6 +194,10 @@ class _Slot:
     items: list = field(default_factory=list)
     cache: object = None
     outcome: str = ""
+    # shadow-read sampling (docs/integrity.md): set at resolve time to the
+    # slot's snapshot when the sampler picks this warm serve — the finalize
+    # pass then byte-compares the device answer against the CPU oracle
+    shadow_snap: object = None
 
 
 class CoprReadScheduler:
@@ -602,6 +606,9 @@ class CoprReadScheduler:
                 return False
         slot.cache = cache
         slot.outcome = outcome
+        if (outcome in ("hit", "delta", "wt_delta")
+                and self.ep.shadow.pick("batch")):
+            slot.shadow_snap = snap
         return True
 
     # -- execution groups ---------------------------------------------------
@@ -746,11 +753,21 @@ class CoprReadScheduler:
                 self._sharded_metrics(device_load, pull_dt)
             for slot, resp in zip(live, resps):
                 data = resp.encode()
-                from_cache = slot.outcome not in ("", "miss", "too_big")
+                from_device = True
+                if slot.shadow_snap is not None:
+                    # sampled slot: CPU-oracle byte compare; a mismatch
+                    # quarantines the image and this slot serves the oracle
+                    fixed = self.ep.shadow_compare(
+                        slot.items[0].req, slot.shadow_snap, data, "batch")
+                    if fixed is not None:
+                        data = fixed
+                        from_device = False
+                from_cache = from_device and slot.outcome not in ("", "miss", "too_big")
                 for it in slot.items:
                     if results[it.index] is not None:
                         continue  # the cold-fill already answered this one
-                    r = CoprResponse(data, from_device=True, from_cache=from_cache)
+                    r = CoprResponse(data, from_device=from_device,
+                                     from_cache=from_cache)
                     self._stamp(r, it, kind=kind, occupancy=n_batch,
                                 waste=waste, total_s=dt / n_reqs)
                     results[it.index] = r
@@ -812,6 +829,24 @@ class CoprReadScheduler:
         self.ep.breaker.record_success("fused")
         dt = time.perf_counter() - t0
         self._batch_metrics("fused", n_reqs, dt, 0.0, n_batch=len(items))
+        if slot.shadow_snap is not None:
+            groups = list(uniq.values())
+            fixed = self.ep.shadow_compare(groups[0][0].req, slot.shadow_snap,
+                                           resps[0].encode(), "batch")
+            if fixed is not None:
+                # the SHARED image is corrupt (and quarantined): the probe's
+                # signature group serves the oracle bytes already in hand;
+                # the other groups — whose oracle answers were never
+                # computed — re-execute per-request over the rebuilt state
+                for it in groups[0]:
+                    r = CoprResponse(fixed, from_device=False)
+                    self._stamp(r, it, kind="fused", occupancy=n_reqs,
+                                total_s=dt / n_reqs)
+                    results[it.index] = r
+                for group in groups[1:]:
+                    for it in group:
+                        self._per_request(it, results, errors, kind="shadow")
+                return None
         from_cache = slot.outcome not in ("", "miss", "too_big")
         for group, resp in zip(uniq.values(), resps):
             data = resp.encode()

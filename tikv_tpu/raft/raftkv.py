@@ -186,6 +186,21 @@ class RaftKv(Engine):
             return None
         return self._stale_ready(self._peer_for_ctx(ctx), ctx)
 
+    def local_snapshot(self, region_id: int) -> RegionSnapshot:
+        """A PROTOCOL-FREE snapshot of this store's local apply state for
+        ``region_id`` — no lease, no ReadIndex, works on followers.  Not
+        linearizable; exists for the integrity scrubber (docs/integrity.md),
+        which verifies derived images against the LOCAL engine at a pinned
+        apply index — exactly what this returns.  Never serve client reads
+        off it."""
+        peer = self.store.peers.get(region_id)
+        if peer is None:
+            raise NotLeaderError(region_id, None)
+        applied = peer.apply_index  # before the freeze — see stale path
+        return RegionSnapshot(self.store.engine.snapshot(), peer.region.clone(),
+                              apply_index=applied,
+                              data_token=self.data_token)
+
     def snapshot(self, ctx: dict | None = None) -> RegionSnapshot:
         peer = self._peer_for_ctx(ctx)
         ctx = ctx or {}

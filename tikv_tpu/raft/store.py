@@ -47,6 +47,18 @@ def _notify_region_write_lost(region_id: int, apply_index: int,
     except ImportError:
         return
     notify_region_write_lost(region_id, apply_index, token=token)
+
+
+def _count_consistency(result: str) -> None:
+    """Consistency-check observability (docs/integrity.md): compute_hash
+    applies count ``compute``, verify_hash applies count ``match`` or
+    ``mismatch`` — the series the divergence alert fires on."""
+    from ..util.metrics import REGISTRY
+
+    REGISTRY.counter(
+        "tikv_raft_consistency_check_total",
+        "Raft consistency-check applies, by result",
+    ).inc(result=result)
 from .core import Entry, Message, MsgType, RaftNode, Role
 from .core import Snapshot as RaftSnapshot
 from .region import EpochError, KeyNotInRegionError, NotLeaderError, Peer as RegionPeer, Region, RegionEpoch
@@ -96,6 +108,18 @@ def encode_cmd(cmd: dict) -> bytes:
         out.append(6)
         out += codec.encode_var_u64(admin[1])  # apply index of the hash
         out += codec.encode_var_u64(admin[2])  # expected hash
+        # derived-plane image fingerprints (docs/integrity.md): replicas
+        # cross-check their device images against the leader's at the same
+        # apply index — sorted so the entry bytes stay deterministic
+        fps = admin[3] if len(admin) > 3 and admin[3] else {}
+        out += codec.encode_var_u64(len(fps))
+        for kid in sorted(fps):
+            rec = fps[kid]
+            out += codec.encode_compact_bytes(kid.encode())
+            out += codec.encode_var_u64(max(int(rec["apply_index"]), 0))
+            out += codec.encode_var_u64(max(int(rec["snapshot_ts"]), 0))
+            out += codec.encode_var_u64(max(int(rec["max_commit_ts"]), 0))
+            out += codec.encode_var_u64(int(rec["fingerprint"]))
     elif admin[0] == "prepare_merge":
         out.append(3)
         out += codec.encode_var_u64(admin[1])  # target region id
@@ -167,7 +191,18 @@ def decode_cmd(b: bytes) -> dict:
     elif kind == 6:
         idx, off = codec.decode_var_u64(b, off)
         h, off = codec.decode_var_u64(b, off)
-        cmd["admin"] = ("verify_hash", idx, h)
+        fps: dict = {}
+        if off < len(b):  # pre-integrity-plane log entries carry no payload
+            n, off = codec.decode_var_u64(b, off)
+            for _ in range(n):
+                kid, off = codec.decode_compact_bytes(b, off)
+                ai, off = codec.decode_var_u64(b, off)
+                sts, off = codec.decode_var_u64(b, off)
+                mct, off = codec.decode_var_u64(b, off)
+                fp, off = codec.decode_var_u64(b, off)
+                fps[kid.decode()] = {"apply_index": ai, "snapshot_ts": sts,
+                                     "max_commit_ts": mct, "fingerprint": fp}
+        cmd["admin"] = ("verify_hash", idx, h, fps)
     elif kind == 3:
         tid, off = codec.decode_var_u64(b, off)
         cmd["admin"] = ("prepare_merge", tid)
@@ -778,7 +813,8 @@ class StorePeer:
             return cmd
         if admin is not None and admin[0] == "verify_hash":
             if self.peer_id not in self.node.witnesses:
-                self._apply_verify_hash(admin[1], admin[2])
+                self._apply_verify_hash(
+                    admin[1], admin[2], admin[3] if len(admin) > 3 else None)
             self._ack(e, {"verify_hash": True}, None)
             return cmd
         if admin is not None and admin[0] == "prepare_merge":
@@ -947,24 +983,57 @@ class StorePeer:
         """Every replica hashes its region data at this entry's apply point
         (ConsistencyCheckObserver).  The LEADER follows up by replicating
         its own hash in a verify_hash entry, so replicas compare against
-        the leader at the exact same index."""
+        the leader at the exact same index.
+
+        Integrity ride-along (docs/integrity.md): the same apply point is
+        the perfect pin for the DERIVED plane — every replica scrubs its
+        resident device images of this region against its own engine here,
+        and the leader's verify_hash additionally carries its image
+        fingerprints so replicas holding an image at the same apply index
+        literally cross-check device-image hashes alongside the mvcc hash."""
         h = self._region_hash()
         self.store.consistency_hashes[self.region.id] = (e.index, h)
+        _count_consistency("compute")
+        img_fps: dict = {}
+        try:
+            from ..copr import integrity as _copr_integrity
+            from .raftkv import RegionSnapshot
+
+            snap = RegionSnapshot(
+                self.store.engine.snapshot(), self.region.clone(),
+                apply_index=e.index, data_token=self.store.data_token,
+            )
+            _copr_integrity.scrub_region_on_consistency_check(
+                self.region.id, self.store.data_token, snap)
+            img_fps = _copr_integrity.region_image_fingerprints(
+                self.region.id, self.store.data_token)
+        except Exception as exc:  # noqa: BLE001 — the derived plane must
+            # never poison raft apply; the scrubber re-covers it.  But a
+            # FATAL-mode mismatch must not vanish silently either: log it
+            # (the quarantine + mismatch counters already fired inside
+            # verify_image before the raise)
+            from ..copr.integrity import IntegrityMismatch
+
+            if isinstance(exc, IntegrityMismatch):
+                _LOG.error("fatal integrity mismatch at consistency check",
+                           region=self.region.id, error=repr(exc))
         if self.node.is_leader():
             self.propose_cmd(
                 {
                     "epoch": (self.region.epoch.conf_ver, self.region.epoch.version),
                     "ops": [],
-                    "admin": ("verify_hash", e.index, h),
+                    "admin": ("verify_hash", e.index, h, img_fps),
                 },
                 lambda r: None,
             )
 
-    def _apply_verify_hash(self, index: int, expected: int) -> None:
+    def _apply_verify_hash(self, index: int, expected: int,
+                           image_fps: dict | None = None) -> None:
         rec = self.store.consistency_hashes.get(self.region.id)
         if rec is None or rec[0] != index:
             return  # this replica joined after the compute entry (snapshot)
         if rec[1] != expected:
+            _count_consistency("mismatch")
             # divergence: the reference panics the store; we record the
             # region as inconsistent and surface it via the debug service
             self.store.inconsistent_regions[self.region.id] = {
@@ -972,6 +1041,22 @@ class StorePeer:
                 "local_hash": rec[1],
                 "leader_hash": expected,
             }
+        else:
+            _count_consistency("match")
+        if image_fps:
+            # derived-plane replica cross-check: local images pinned at the
+            # leader's recorded apply index compare fingerprints; divergence
+            # quarantines the LOCAL image (the mvcc hash above adjudicates
+            # the region — the derived plane just rebuilds)
+            try:
+                from ..copr import integrity as _copr_integrity
+
+                _copr_integrity.cross_check_image_fps(
+                    self.region.id, self.store.data_token, image_fps)
+            except Exception as exc:  # noqa: BLE001 — never poison apply,
+                # but never let a fatal-mode signal vanish unlogged either
+                _LOG.error("image fingerprint cross-check failed",
+                           region=self.region.id, error=repr(exc))
 
     def schedule_consistency_check(self, cb: Callable | None = None) -> None:
         """Leader-side: replicate a compute_hash point (the periodic

@@ -211,6 +211,31 @@ class KvService:
             "inconsistent": dict(router.inconsistent_regions),
         }
 
+    def debug_consistency_check(self, req: dict) -> dict:
+        """Trigger a consistency-check round NOW (``ctl.py
+        consistency-check --trigger``): propose compute_hash on every led
+        region (or just ``region_id``).  The round completes asynchronously
+        through raft apply; poll ``debug_consistency`` for results."""
+        router = self._router()
+        rid = req.get("region_id")
+        scheduled = []
+        for region_id, peer in list(router.peers.items()):
+            if rid is not None and region_id != rid:
+                continue
+            if peer.node.is_leader():
+                peer.schedule_consistency_check()
+                scheduled.append(region_id)
+        return {"scheduled": sorted(scheduled)}
+
+    def debug_integrity(self, req: dict) -> dict:
+        """Integrity-plane state (docs/integrity.md; ``ctl.py integrity``
+        and the status server's ``/debug/integrity``): per-region image
+        fingerprints + apply points, the quarantine ledger, scrubber
+        cadence/progress, and shadow-read sample/mismatch counts."""
+        if self.copr is None:
+            return {"error": {"other": "coprocessor endpoint not wired"}}
+        return self.copr.integrity_snapshot()
+
     # -- ImportSST service (sst_service.rs: download + ingest) --------------
 
     def _importer(self):

@@ -96,6 +96,8 @@ class StoreServer:
         shard_cache: bool = True,
         group_commit: bool = True,
         write_through: bool = True,
+        integrity_scrub_interval: float = 10.0,
+        shadow_sample: int | None = None,
     ):
         self.pd = pd
         self.security = security
@@ -169,7 +171,13 @@ class StoreServer:
             feature_gate=self.feature_gate,
             shard_cache=shard_cache,
             write_through=write_through,
+            shadow_sample=shadow_sample,
         )
+        # integrity plane (docs/integrity.md): the SDC scrubber verifies
+        # warm images against the engine on a cadence; <=0 disables.
+        # Shadow-read sampling is always on at its configured rate.
+        if self.copr.scrubber is not None and integrity_scrub_interval > 0:
+            self.copr.scrubber.start(integrity_scrub_interval)
         if mesh is not None and getattr(mesh, "size", 1) > 1:
             rc = self.copr.region_cache
             mode = ("sharded warm cache"
@@ -301,6 +309,9 @@ class StoreServer:
             # stuck-follower debugging: per-region (resolved_ts,
             # required_apply_index) + the store safe_ts floor over HTTP
             read_progress=lambda: self.service.debug_read_progress({}),
+            # derived-plane integrity: fingerprints, quarantine ledger,
+            # scrubber + shadow-read state (docs/integrity.md)
+            integrity=lambda: self.service.debug_integrity({}),
         )
         self.service = KvService(
             self.storage,
@@ -434,6 +445,8 @@ class StoreServer:
         raise TimeoutError("cluster never formed")
 
     def stop(self) -> None:
+        if self.copr.scrubber is not None:
+            self.copr.scrubber.stop()
         self.copr.scheduler.stop()
         self._ttl_stop.set()
         self._rts_stop.set()
@@ -484,6 +497,13 @@ def main(argv=None) -> int:
     ap.add_argument("--no-write-through", action="store_true",
                     help="disable raft-apply delta emission into the region "
                          "column cache (warm reads repair via scan_delta)")
+    ap.add_argument("--integrity-scrub-interval", type=float, default=10.0,
+                    help="seconds between SDC scrubber rounds over warm "
+                         "region images (docs/integrity.md); <=0 disables")
+    ap.add_argument("--shadow-sample", type=int, default=None,
+                    help="shadow-read 1-in-N sampling of warm device serves "
+                         "(default 256 or TIKV_TPU_SHADOW_SAMPLE; 0 "
+                         "disables, 1 verifies every warm serve)")
     ap.add_argument("--no-raft-engine", action="store_true",
                     help="keep the raft log in CF_RAFT instead of the segmented log engine")
     ap.add_argument("--ca-path", default="")
@@ -518,6 +538,8 @@ def main(argv=None) -> int:
         shard_cache=not args.no_shard_cache,
         group_commit=not args.no_group_commit,
         write_through=not args.no_write_through,
+        integrity_scrub_interval=args.integrity_scrub_interval,
+        shadow_sample=args.shadow_sample,
     )
     srv.start()
     srv.bootstrap_or_join(args.expect_stores)

@@ -21,7 +21,8 @@ from .profiler import Profiler
 
 class StatusServer:
     def __init__(self, controller: ConfigController | None = None, host="127.0.0.1", port=0, registry=None,
-                 security=None, memory_trace=None, read_progress=None):
+                 security=None, memory_trace=None, read_progress=None,
+                 integrity=None):
         self.controller = controller
         self.security = security
         self.registry = registry or REGISTRY
@@ -30,6 +31,9 @@ class StatusServer:
         # callable returning {"safe_ts", "regions": {rid: {resolved_ts,
         # required_apply_index}}} — the stuck-follower stale-read surface
         self.read_progress = read_progress
+        # callable returning the integrity-plane view (docs/integrity.md):
+        # image fingerprints, quarantine ledger, scrubber + shadow state
+        self.integrity = integrity
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -85,6 +89,14 @@ class StatusServer:
                         self._send(404, b"no resolved-ts endpoint wired")
                         return
                     self._send(200, json.dumps(outer.read_progress()).encode(),
+                               "application/json")
+                elif url.path == "/debug/integrity":
+                    # derived-plane integrity: fingerprints, quarantine
+                    # ledger, scrubber + shadow-read state (docs/integrity.md)
+                    if outer.integrity is None:
+                        self._send(404, b"no integrity surface wired")
+                        return
+                    self._send(200, json.dumps(outer.integrity()).encode(),
                                "application/json")
                 elif url.path == "/debug/memory":
                     # the store's memory-attribution tree (MemoryTrace)

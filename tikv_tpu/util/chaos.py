@@ -321,6 +321,25 @@ class Nemesis:
             self._crashed.discard(store_id)
         self.adapter.restart(store_id)
 
+    def corrupt_image(self, cache, region_id: int | None = None,
+                      mode: str | None = None, bits: int = 1):
+        """Silent-data-corruption fault (docs/integrity.md): flip bits in a
+        warm region image's DERIVED state — decoded cached block columns
+        (``mode="block"``: the post-decode plane the device serves, caught
+        by shadow reads and the deep scrub) or a buffered write-through
+        pending delta (``mode="pending"``: a bad fold input, caught by the
+        fingerprint-vs-oracle hash scrub).  Direct-injection like
+        :meth:`disk_stall` — it targets a cache, not the transport — so it
+        composes with any transport schedule.  Seeded off the nemesis rng;
+        returns a description of what was corrupted, or None when nothing
+        matched."""
+        _count("corrupt_image")
+        info = corrupt_image(cache, self.rng, region_id=region_id,
+                             mode=mode, bits=bits)
+        if info is not None:
+            self.stats["corrupted"] = self.stats.get("corrupted", 0) + 1
+        return info
+
     def disk_stall(self, ms: float | None = None, count: int | None = None) -> None:
         """Wedge the apply path through the existing ``apply_before_exec``
         failpoint: ``ms`` → every apply sleeps that long (slow disk);
@@ -524,6 +543,81 @@ class Nemesis:
                     self._mu.wait(min(wait, 0.05))
                     continue
             self._deliver_due(time.monotonic())
+
+
+def corrupt_image(cache, rng, region_id: int | None = None,
+                  mode: str | None = None, bits: int = 1):
+    """Flip bits in a resident region image (SDC injection core; see
+    :meth:`Nemesis.corrupt_image`).  Mutates under the cache's manager lock
+    and drops the image's device pins so the next warm serve re-pins the
+    corrupted host state — modelling decode/fold/device corruption that the
+    serving path would actually return."""
+    import numpy as np
+
+    with cache._mu:
+        imgs = [(k, img) for k, img in cache._images.items()
+                if region_id is None or k[0] == region_id]
+        if not imgs:
+            return None
+        key, img = imgs[rng.randrange(len(imgs))]
+        has_pending = bool(img.wt_pending and img.wt_pending["changed"])
+        if mode is None:
+            mode = "pending" if has_pending and rng.random() < 0.5 else "block"
+        if mode == "pending":
+            if not has_pending:
+                return None
+            pend = img.wt_pending
+            handles = sorted(pend["changed"])
+            h = handles[rng.randrange(len(handles))]
+            v, cts = pend["changed"][h]
+            if not v:
+                return None
+            ba = bytearray(v)
+            for _ in range(max(bits, 1)):
+                i = rng.randrange(len(ba))
+                ba[i] ^= 1 << rng.randrange(8)
+            pend["changed"][h] = (bytes(ba), cts)
+            return {"mode": "pending", "region_id": key[0], "handle": int(h)}
+        blocks = img.block_cache.blocks
+        if not blocks:
+            return None
+        for _ in range(64):  # retry until a corruptible cell is found
+            bi = rng.randrange(len(blocks))
+            blk = blocks[bi]
+            if blk.n_valid == 0:
+                continue
+            ci = rng.randrange(len(blk.cols))
+            col = blk.cols[ci]
+            r = rng.randrange(blk.n_valid)
+            if bool(np.asarray(col.nulls)[r]):
+                continue
+            data = col.data
+            if col.is_dict_encoded:
+                dlen = len(col.dictionary)
+                if dlen < 2:
+                    continue
+                data[r] = (int(data[r]) + 1 + rng.randrange(dlen - 1)) % dlen
+            elif isinstance(data, np.ndarray) and data.dtype == object:
+                v = data[r]
+                if not isinstance(v, (bytes, bytearray)) or len(v) == 0:
+                    continue
+                ba = bytearray(v)
+                i = rng.randrange(len(ba))
+                ba[i] ^= 1 << rng.randrange(8)
+                data[r] = bytes(ba)
+            else:
+                arr = np.asarray(data)
+                if arr.dtype.itemsize != 8:
+                    continue
+                # bit-flip through a u64 view (int64 and float64 alike);
+                # bit 63 excluded so int corruption stays value-level, not
+                # a sign explosion that might overflow downstream casts
+                arr.view(np.uint64)[r] ^= np.uint64(1) << np.uint64(
+                    rng.randrange(63))
+            img.block_cache.drop_device()
+            return {"mode": "block", "region_id": key[0], "block": bi,
+                    "column": ci, "row": r}
+        return None
 
 
 def _fset(v) -> frozenset | None:
