@@ -547,6 +547,88 @@ def _op_region_cache(req, state):
     return out
 
 
+def _op_scan_compressed(req, state):
+    """scan_compressed + warm-capacity event (docs/compressed_columns.md):
+    the SAME engine region served three ways — cold (region cache off),
+    warm DECODED-resident (--no-column-encoding behavior), warm
+    ENCODED-resident (the default) — proving byte-identity and measuring
+    warm throughput over encoded pins.  The capacity half fills as many
+    region images as fit one fixed byte budget with encoding off vs on:
+    the resident-region ratio IS the density win the HBM budget buys."""
+    from tikv_tpu.copr.endpoint import CoprRequest, Endpoint
+    from tikv_tpu.copr.region_cache import RegionColumnCache
+    from tikv_tpu.copr.table import record_range
+    from tikv_tpu.storage.btree_engine import BTreeEngine
+    from tikv_tpu.storage.engine import CF_WRITE
+    from tikv_tpu.storage.kv import LocalEngine
+    from tikv_tpu.storage.txn_types import Key, Write, WriteType
+
+    n = req["rows"]
+    trials = req.get("trials", 3)
+    kvs = build_kvs(n, seed=13)
+    eng = BTreeEngine()
+    eng.bulk_load(CF_WRITE, [
+        (Key.from_raw(rk).append_ts(20).encoded,
+         Write(WriteType.PUT, 10, short_value=v).to_bytes())
+        for rk, v in kvs
+    ])
+    le = LocalEngine(eng)
+    ep_cold = Endpoint(le, enable_device=True, enable_region_cache=False)
+    ep_dec = Endpoint(le, enable_device=True, encode_columns=False)
+    ep_enc = Endpoint(le, enable_device=True)
+
+    limit = req.get("limit", 10_000)
+
+    def mk(kind, region_id=1):
+        return CoprRequest(103, _filter_dag(kind, limit=limit),
+                           [record_range(TABLE_ID)], 100,
+                           context={"region_id": region_id,
+                                    "region_epoch": (1, 1), "apply_index": 7})
+
+    out = {"match": True}
+    for kind in ("scan", "selection"):
+        oracle = ep_cold.handle_request(mk(kind)).data
+        out["match"] &= ep_dec.handle_request(mk(kind)).data == oracle
+        out["match"] &= ep_enc.handle_request(mk(kind)).data == oracle
+        enc_ts, dec_ts = [], []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            rd = ep_dec.handle_request(mk(kind))
+            dec_ts.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            re_ = ep_enc.handle_request(mk(kind))
+            enc_ts.append(time.perf_counter() - t0)
+            out["match"] &= rd.data == oracle and re_.data == oracle
+        out[kind] = {"encoded_ts": enc_ts, "decoded_ts": dec_ts,
+                     "outcome": re_.metrics.get("region_cache")}
+    [img_dec] = ep_dec.region_cache._images.values()
+    [img_enc] = ep_enc.region_cache._images.values()
+    out["decoded_image_bytes"] = img_dec.nbytes
+    out["encoded_image_bytes"] = img_enc.nbytes
+    out["compression_ratio"] = (
+        img_enc.block_cache.nbytes_decoded() / max(img_enc.block_cache.nbytes(), 1)
+    )
+    out["encodings"] = sorted(set(img_enc.encodings.values()))
+
+    # warm capacity at ONE byte budget: how many regions stay resident
+    budget = img_dec.nbytes * req.get("budget_regions", 3)
+    regions = req.get("regions", 12)
+    resident = {}
+    for label, encode in (("decoded", False), ("encoded", True)):
+        rc = RegionColumnCache(byte_budget=budget, max_regions=4 * regions,
+                               encode_columns=encode)
+        ep = Endpoint(le, enable_device=True, region_cache=rc)
+        for rid in range(1, regions + 1):
+            ep.handle_request(mk("scan", region_id=rid))
+        resident[label] = len(rc)
+    out["budget_bytes"] = budget
+    out["regions_offered"] = regions
+    out["regions_resident_decoded"] = resident["decoded"]
+    out["regions_resident_encoded"] = resident["encoded"]
+    out["warm_capacity_ratio"] = resident["encoded"] / max(resident["decoded"], 1)
+    return out
+
+
 def _xregion_q6(cut: int):
     """A Q6-shaped selection+aggregation (no group-by): the dispatch-bound
     serving shape where cross-region batching pays off on every backend."""
@@ -960,6 +1042,7 @@ _OPS = {
     "topn": _op_topn,
     "filter": _op_filter,
     "region_cache": _op_region_cache,
+    "scan_compressed": _op_scan_compressed,
     "xregion": _op_xregion,
     "wire": _op_wire,
     "sharded_xregion": _op_sharded_xregion,
@@ -1514,6 +1597,30 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             results["mixed_rw_error"] = str(e)[:200]
             _mark("mixed_rw_error", err=str(e)[:120])
+
+    if os.environ.get("BENCH_COMPRESSED", "1") != "0":
+        # compressed device-resident columns (ISSUE 10): byte-identity of
+        # encoded-resident serving + the warm-capacity multiplier at one
+        # fixed byte budget.  In-parent on CPU — it measures residency
+        # accounting and encode/decode correctness, not device compute.
+        try:
+            r = _op_scan_compressed({
+                "rows": int(os.environ.get("BENCH_COMPRESSED_ROWS", "20000")),
+            }, {})
+            if not r["match"]:
+                _fail("COMPRESSED_MISMATCH")
+            results["compressed_ratio"] = r["compression_ratio"]
+            results["compressed_warm_capacity_ratio"] = r["warm_capacity_ratio"]
+            results["compressed_regions_resident"] = [
+                r["regions_resident_decoded"], r["regions_resident_encoded"]]
+            results["compressed_encodings"] = r["encodings"]
+            _mark("scan_compressed",
+                  ratio=round(r["compression_ratio"], 2),
+                  capacity=round(r["warm_capacity_ratio"], 2),
+                  encodings=r["encodings"])
+        except Exception as e:  # noqa: BLE001
+            results["compressed_error"] = str(e)[:200]
+            _mark("compressed_error", err=str(e)[:120])
 
     if os.environ.get("BENCH_MVCC", "1") != "0":
         try:

@@ -27,6 +27,10 @@ regresses:
   scheduler lanes + zero-copy frames, the standalone default) vs
   per-request CPU serving over real TCP connections.  Fails on byte
   divergence, a speedup below the 5x floor, or zero batch-served requests.
+* ``compressed`` (ISSUE 10): encoded device-resident columns
+  (docs/compressed_columns.md) — byte-identity of encoded serving vs the
+  CPU oracle, and the warm-capacity multiplier at one fixed byte budget.
+  Fails on byte divergence or under 2x regions resident encoded-vs-decoded.
 
 Exit code 0 = healthy; 1 = regression.  One JSON line on stdout either way,
 so CI logs stay grep-able:
@@ -49,6 +53,7 @@ MIN_SHARDED_SPEEDUP = 1.5
 MIN_GROUP_SPEEDUP = 2.0
 MIN_WARM_HIT_RATE = 0.5
 MIN_WIRE_SPEEDUP = 5.0
+MIN_COMPRESSED_CAPACITY = 2.0
 SHARDED_DEVICES = 8
 
 
@@ -189,6 +194,25 @@ def main() -> int:
             ok = False
             out["sharded_xregion_regression"] = (
                 f"{sspeed:.2f}x < {MIN_SHARDED_SPEEDUP}x floor")
+
+    # compressed device-resident columns (ISSUE 10): encoded serving must
+    # be byte-identical AND keep ≥2x the regions warm at one byte budget
+    rc = bench._op_scan_compressed({
+        "rows": int(os.environ.get("SMOKE_COMPRESSED_ROWS", "16000")),
+        "trials": max(args.trials, 3),
+    }, {})
+    out["compressed_match"] = bool(rc["match"])
+    ok = ok and rc["match"]
+    out["compressed_ratio"] = round(float(rc["compression_ratio"]), 2)
+    out["compressed_capacity_ratio"] = round(float(rc["warm_capacity_ratio"]), 2)
+    out["compressed_regions_resident"] = [
+        rc["regions_resident_decoded"], rc["regions_resident_encoded"]]
+    out["compressed_encodings"] = rc["encodings"]
+    if rc["warm_capacity_ratio"] < MIN_COMPRESSED_CAPACITY:
+        ok = False
+        out["compressed_regression"] = (
+            f"{rc['warm_capacity_ratio']:.2f}x warm regions < "
+            f"{MIN_COMPRESSED_CAPACITY}x floor at equal budget")
 
     # group-commit write path + warm serving under writes (ISSUE 4)
     rm = bench._op_mixed_rw({

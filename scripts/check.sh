@@ -32,4 +32,8 @@ echo "== integrity smoke: SDC scrubber + shadow reads + corruption chaos under t
 JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
   -m 'not slow' tests/test_integrity.py
 
+echo "== compressed-columns smoke: encoded residency, delta demotions, code-space rewrites under the sanitizer =="
+JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
+  -m 'not slow' tests/test_encoding.py tests/test_compressed_columns.py
+
 echo "check.sh: all gates green"

@@ -63,6 +63,13 @@ LAZY_SERIES = {
     "tikv_coprocessor_region_cache_evict_total",
     "tikv_coprocessor_region_cache_invalidate_total",
     "tikv_coprocessor_region_cache_bytes",
+    "tikv_coprocessor_region_cache_compression_ratio",
+    "tikv_coprocessor_region_cache_device_pinned_bytes",
+    "tikv_coprocessor_encoding_total",
+    "tikv_coprocessor_encoding_demote_total",
+    "tikv_coprocessor_encoded_path_total",
+    "tikv_coprocessor_encoded_decline_total",
+    "tikv_coprocessor_encoded_rewrite_total",
     "tikv_gcworker_gc_tasks_total",
     "tikv_memory_usage_bytes",
     "tikv_raftstore_proposal_total",

@@ -183,7 +183,11 @@ def test_lru_eviction_under_byte_budget():
     """Three regions under a budget that fits ~one image: LRU evicts, the
     endpoint keeps answering correctly, and nothing OOMs."""
     eng = _engine(n=128)
-    small = RegionColumnCache(byte_budget=1 << 14, max_regions=8)
+    # decoded residency: this test pins the LRU/budget mechanics — with
+    # column encoding on (the default) all three images FIT the budget,
+    # which is the capacity win tests/test_compressed_columns.py asserts
+    small = RegionColumnCache(byte_budget=1 << 14, max_regions=8,
+                              encode_columns=False)
     warm = Endpoint(LocalEngine(eng), enable_device=True, region_cache=small)
     cold = Endpoint(LocalEngine(eng), enable_device=True, enable_region_cache=False)
     for rid in (1, 2, 3):

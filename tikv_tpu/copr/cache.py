@@ -47,6 +47,10 @@ class ColumnBlockCache:
         # pins).  parallel.mesh.launch_xregion_sharded reads this to pin
         # each slab on its owner.
         self.owner_devices: list[int] | None = None
+        # bumped whenever column encodings change (fill-time encode, delta
+        # demotion, code-lane widening) — the device-plan memo and the
+        # encoded pin signatures key on it (copr/encoding.py)
+        self.enc_version = 0
         self._mu = threading.Lock()
 
     def add(self, cols, n_valid: int) -> None:
@@ -78,16 +82,38 @@ class ColumnBlockCache:
             return block.device[sig]
 
     def nbytes(self) -> int:
-        """Host-side byte footprint of the decoded blocks (device pins cost
-        about the same again per pinned signature; budgets use this figure)."""
+        """RESIDENT byte footprint of the blocks — encoded bytes for
+        encoded columns (docs/compressed_columns.md), the decoded-array
+        footprint otherwise.  Budgets and gauges use this figure: encoded
+        images cost what their payload costs, which is what multiplies
+        warm capacity under a fixed byte budget."""
+        from .encoding import column_nbytes
+
+        return sum(column_nbytes(c) for b in self.blocks for c in b.cols)
+
+    def nbytes_decoded(self) -> int:
+        """What the blocks WOULD cost fully decoded — the numerator of the
+        compression-ratio gauge."""
+        from .encoding import column_decoded_nbytes
+
+        return sum(column_decoded_nbytes(c) for b in self.blocks for c in b.cols)
+
+    def device_nbytes(self) -> int:
+        """TRUE bytes currently pinned on devices for this cache, summed
+        over every pinned signature's arrays (zone layouts report their
+        ``dev`` tree).  This is the figure behind
+        ``tikv_coprocessor_region_cache_device_pinned_bytes`` — with
+        encoded residency it reflects the narrow/encoded payloads actually
+        in HBM, not a host-side proxy."""
+        import jax
+
         total = 0
-        for b in self.blocks:
-            for c in b.cols:
-                data = np.asarray(c.data)
-                total += data.nbytes if data.dtype != object else 32 * len(data)
-                total += np.asarray(c.nulls).nbytes
-                if c.dictionary is not None:
-                    total += 64 * len(c.dictionary)
+        with self._mu:
+            for b in self.blocks:
+                for entry in b.device.values():
+                    tree = getattr(entry, "dev", entry)
+                    for leaf in jax.tree.leaves(tree):
+                        total += int(getattr(leaf, "nbytes", 0) or 0)
         return total
 
     def drop_device(self) -> None:
@@ -116,7 +142,14 @@ class ColumnBlockCache:
                     kind = sig[0]
                     if kind == "nvoff":
                         continue  # in-place updates never change row counts
-                    if kind == "stacked":
+                    if kind in ("stackedenc", "blockenc"):
+                        # encoded pins hold narrow/run payloads: a decoded-
+                        # domain scatter cannot patch them in place (the
+                        # ref/run structure lives in the encoding) — drop,
+                        # and the next serve re-pins from the updated host
+                        # payload (which try_patch/demote kept truthful)
+                        blk.device.pop(sig)
+                    elif kind == "stacked":
                         blk.device[sig] = self._patch_stacked(blk.device[sig], sig, updates)
                     elif isinstance(kind, tuple):
                         if upd is None:

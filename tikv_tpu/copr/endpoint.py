@@ -79,6 +79,7 @@ class Endpoint:
         block_rows: int | None = None,
         shard_cache: bool = True,
         write_through: bool = True,
+        encode_columns: bool = True,
         breaker=None,
         breaker_config=None,
         shadow_sample: int | None = None,
@@ -114,6 +115,10 @@ class Endpoint:
                 block_rows=block_rows,
                 mesh=mesh if shard_cache else None,
                 write_through=write_through,
+                # compressed residency (docs/compressed_columns.md): images
+                # encode at fill and the budget counts ENCODED bytes —
+                # encode_columns=False is the kill switch
+                encode_columns=encode_columns,
                 # bind the cache to THIS engine's write-through stream now —
                 # a raft engine exposes its store engine's identity; a plain
                 # local engine binds None (direct notify callers, tests)
@@ -309,6 +314,9 @@ class Endpoint:
                     "tikv_coprocessor_device_fallback_total",
                     "Device-path failures that re-ran on the CPU pipeline",
                 ).inc()
+        resp = self._try_dict_rewrite(req, snap, tracker, stale_snap)
+        if resp is not None:
+            return resp
         stats = Statistics()
         src = MvccScanSource(snap, req.start_ts, req.ranges, statistics=stats)
         resp = BatchExecutorsRunner(req.dag, src).handle_request()
@@ -317,6 +325,77 @@ class Endpoint:
         if stale_snap:
             self.count_follower_read("cpu")
         return CoprResponse(resp.encode(), from_device=False, metrics=m.to_dict())
+
+    def _try_dict_rewrite(self, req: CoprRequest, snap, tracker, stale_snap):
+        """Dictionary code-space serving rung (docs/compressed_columns.md):
+        a DAG whose ONLY device blocker is bytes predicates over
+        dictionary-resident columns rewrites those predicates into the warm
+        image's code space (equality/IN through the bytes→code map, ranges
+        through searchsorted ranks on a SORTED dictionary) and serves on
+        the device — no string ever materializes.  Declines — cold region,
+        unstable/unsorted dictionary, a plan shape the rewrite can't cover —
+        are counted per-cause and fall to the CPU pipeline; served bytes
+        ride the same shadow-read sampling as every warm device serve."""
+        from . import encoding as _encoding
+
+        if (self.region_cache is None or not self.device_enabled()
+                or not _encoding.dict_rewrite_probe(req.dag)):
+            return None
+        if not self.breaker.allow("unary"):
+            from .tracker import count_path_fallback
+
+            count_path_fallback("unary", "breaker_open")
+            return None
+        try:
+            cache, rc_outcome = self._region_cache_for(req, snap, tracker)
+            if cache is None or not cache.filled or not cache.blocks:
+                _encoding.count_rewrite("cold")
+                _encoding.count_decline("rewrite", "cold_region")
+                self.breaker.release_probe("unary")
+                return None
+            new_dag, info = _encoding.rewrite_dag_for_dict(req.dag, cache.blocks)
+            if new_dag is None or not jax_eval.supports(new_dag):
+                _encoding.count_rewrite("declined")
+                _encoding.count_decline(
+                    "rewrite",
+                    info if isinstance(info, str) else "unsupported_plan")
+                self.breaker.release_probe("unary")
+                return None
+            ev = self._evaluator_for(new_dag)
+            resp = ev.run(None, cache=cache)
+            data = resp.encode()
+            from_device = True
+            if (rc_outcome in ("hit", "delta", "wt_delta")
+                    and self.shadow.pick("unary")):
+                fixed = self.shadow_compare(req, snap, data, "unary")
+                if fixed is not None:
+                    data = fixed
+                    from_device = False
+            _encoding.count_rewrite("served")
+            m = tracker.on_finish(scanned_keys=0, from_device=from_device)
+            self.slow_log.observe(tracker)
+            self.breaker.record_success("unary")
+            if stale_snap:
+                self.count_follower_read("device" if from_device else "cpu")
+            return CoprResponse(
+                data, from_device=from_device,
+                # first-touch builds are NOT cache hits — same rule as the
+                # main unary path's from_cache accounting
+                from_cache=from_device and rc_outcome not in ("miss", "too_big"),
+                metrics=m.to_dict())
+        except Exception as exc:  # noqa: BLE001 — CPU pipeline always serves
+            from .integrity import IntegrityMismatch
+
+            if isinstance(exc, IntegrityMismatch):
+                raise  # TIKV_TPU_INTEGRITY_FATAL: surface, never mask
+            self.device_fallbacks += 1
+            self.last_device_error = repr(exc)
+            self.breaker.record_failure("unary")
+            from .tracker import count_path_fallback
+
+            count_path_fallback("unary", "device_error")
+            _encoding.count_rewrite("error")
+            return None
 
     def _cpu_bytes(self, req: CoprRequest, snap) -> bytes:
         """The CPU-oracle answer to ``req`` off ``snap`` — the byte-identity

@@ -355,8 +355,31 @@ def verify_image(cache, key, snap, deep: bool = True, stage: str = "scrub") -> d
 
 def _deep_compare(img, o_handles, o_cols, o_cts) -> list[str]:
     """Compare the image's DECODED plane (what serves) against the
-    pre-decoded oracle rows.  Caller holds the cache lock; the decode
-    itself already happened outside it — only vectorized compares here."""
+    pre-decoded oracle rows.  Caller holds the cache lock; the oracle-side
+    decode already happened outside it — only vectorized compares (plus,
+    for compressed-resident columns, a fresh vectorized decode of the
+    ENCODED payload: materialized decode caches are purged first, so a
+    bit flip in the encoded bytes — the form the device actually serves —
+    can never hide behind a stale host decode;
+    docs/compressed_columns.md)."""
+    from .encoding import EncodedColumn
+
+    def _purge():
+        for b in img.block_cache.blocks:
+            for c in b.cols:
+                if isinstance(c, EncodedColumn):
+                    c.purge_decoded()
+
+    _purge()
+    try:
+        return _deep_compare_inner(img, o_handles, o_cols, o_cts)
+    finally:
+        # the compare itself re-materialized the caches: drop them again so
+        # a scrubbed image resumes costing its ENCODED bytes
+        _purge()
+
+
+def _deep_compare_inner(img, o_handles, o_cols, o_cts) -> list[str]:
     if not np.array_equal(o_handles, img.handles):
         return ["handles"]
     if o_cts is not None and not np.array_equal(

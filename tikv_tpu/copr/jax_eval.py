@@ -65,6 +65,7 @@ DEFAULT_BLOCK_ROWS = 1 << 16
 _GROUP_CAPACITY_START = 1024
 _NO_ROW = 1 << 62  # first-active-row sentinel: "no row of this group survived"
 _ZERO_GIDS: dict[int, np.ndarray] = {}
+_MISSING_PLAN = object()  # sentinel: _stacked_device resolves the plan itself
 
 _DEVICE_AGG_OPS = {
     "count", "sum", "avg", "min", "max", "var_pop",
@@ -396,11 +397,30 @@ def _seg_bitop(x, gids, capacity: int, op: str):
     return out.reshape(blocks * _ONEHOT_CAPACITY_MAX)[:capacity]
 
 
-def _build_cols(ship_cols, nullable, col_data, col_nulls, n_rows):
-    """Column map for eval_rpn: NOT NULL columns get a folded constant mask."""
+def _build_cols(ship_cols, nullable, col_data, col_nulls, n_rows, enc=None,
+                refs=None):
+    """Column map for eval_rpn: NOT NULL columns get a folded constant mask.
+
+    ``enc`` (static per-ship-col encoding descriptors from
+    ``copr/encoding.py``) turns this into THE in-kernel decode point shared
+    by every device program: bitpacked lanes widen ``+ refs[j]`` (refs are
+    dynamic, so images with different value ranges share one executable),
+    narrowed dict codes widen, RLE runs expand through one searchsorted
+    gather — HBM holds the encoded payloads, everything downstream sees
+    exact int64/f64 lanes."""
     no_nulls = jnp.zeros(n_rows, dtype=bool)
     nullmap = dict(zip(nullable, col_nulls))
-    return {i: (col_data[j], nullmap.get(i, no_nulls)) for j, i in enumerate(ship_cols)}
+    if enc is None:
+        return {i: (col_data[j], nullmap.get(i, no_nulls)) for j, i in enumerate(ship_cols)}
+    from .kernels import decode_device_column
+
+    cols = {}
+    for j, i in enumerate(ship_cols):
+        cols[i] = decode_device_column(
+            jnp, enc[j], col_data[j], nullmap.get(i, no_nulls),
+            None if refs is None else refs[j], n_rows,
+        )
+    return cols
 
 
 def _mixed_radix_gids(cols, group_cols, dict_lens, n_rows):
@@ -729,29 +749,32 @@ class JaxDagEvaluator:
             if not (scan.columns_info[i].ftype.flag & NOT_NULL_FLAG)
         ]
         self._capacity = _GROUP_CAPACITY_START if self.group_rpns else 1
-        self._mask_fn_cache = None
         self._agg_fn_cache: dict[int, object] = {}
 
     # -- jit construction --------------------------------------------------
 
-    def _build_mask_fn(self):
-        if self._mask_fn_cache is not None:
-            return self._mask_fn_cache
+    def _build_mask_fn(self, enc=None):
+        key = ("mask", enc)
+        cached = self._agg_fn_cache.get(key)
+        if cached is not None:
+            return cached
         sel_rpns = self.sel_rpns
         device_cols = self.device_cols
         nullable = self.nullable_cols
         n_rows = self.block_rows
 
-        def mask_fn(col_data, col_nulls, valid):
-            cols = _build_cols(device_cols, nullable, col_data, col_nulls, n_rows)
+        def mask_fn(col_data, col_nulls, valid, refs):
+            cols = _build_cols(device_cols, nullable, col_data, col_nulls,
+                               n_rows, enc, refs)
             active = valid
             for rpn in sel_rpns:
                 d, nl = eval_rpn(rpn, cols, n_rows, xp=jnp)
                 active = active & (d != 0) & ~nl
             return active
 
-        self._mask_fn_cache = jax.jit(mask_fn)
-        return self._mask_fn_cache
+        fn = jax.jit(mask_fn)
+        self._agg_fn_cache[key] = fn
+        return fn
 
     def _build_agg_fn(self, capacity: int):
         """One fused device step per block: selection predicates, aggregate
@@ -780,12 +803,12 @@ class JaxDagEvaluator:
         self._agg_fn_cache[capacity] = fn
         return fn
 
-    def _build_scan_fn(self, capacity: int, n_blocks: int):
+    def _build_scan_fn(self, capacity: int, n_blocks: int, enc=None):
         """Whole-query device program for the warm-cache path: one jit call
         lax.scans the fused block step over ALL resident blocks — a single
         host→device round trip per query, which is what makes the TPU path
         latency-proof behind a high-RTT tunnel."""
-        key = ("scan", capacity, n_blocks)
+        key = ("scan", capacity, n_blocks, enc)
         cached = self._agg_fn_cache.get(key)
         if cached is not None:
             return cached
@@ -796,7 +819,7 @@ class JaxDagEvaluator:
         n_rows = self.block_rows
         track_first = bool(self.group_rpns)
 
-        def scan_fn(col_data, col_nulls, n_valids, gids, offsets):
+        def scan_fn(col_data, col_nulls, n_valids, gids, offsets, refs):
             state = (
                 jnp.full(capacity, _NO_ROW, dtype=jnp.int64),
                 tuple(da.init_carry(capacity) for da in device_aggs),
@@ -804,7 +827,7 @@ class JaxDagEvaluator:
 
             def body(st, xs):
                 cd, cn, nv, g, off = xs
-                cols = _build_cols(device_cols, nullable, cd, cn, n_rows)
+                cols = _build_cols(device_cols, nullable, cd, cn, n_rows, enc, refs)
                 return _fused_step(sel_rpns, device_aggs, capacity, n_rows, cols, nv, g, off, st,
                                    track_first=track_first), None
 
@@ -817,11 +840,11 @@ class JaxDagEvaluator:
         self._agg_fn_cache[key] = fn
         return fn
 
-    def _build_scan_fn_coded(self, dict_lens: tuple, capacity: int, n_blocks: int, group_cols: list):
+    def _build_scan_fn_coded(self, dict_lens: tuple, capacity: int, n_blocks: int, group_cols: list, enc=None):
         """Warm-path whole-query program where group ids are computed ON the
         device from resident dictionary codes (stable dictionaries): zero
         per-row host→device traffic per query."""
-        key = ("scancoded", dict_lens, capacity, n_blocks)
+        key = ("scancoded", dict_lens, capacity, n_blocks, enc)
         cached = self._agg_fn_cache.get(key)
         if cached is not None:
             return cached
@@ -832,7 +855,7 @@ class JaxDagEvaluator:
         n_rows = self.block_rows
         track_first = bool(self.group_rpns)
 
-        def scan_fn(col_data, col_nulls, n_valids, offsets):
+        def scan_fn(col_data, col_nulls, n_valids, offsets, refs):
             state = (
                 jnp.full(capacity, _NO_ROW, dtype=jnp.int64),
                 tuple(da.init_carry(capacity) for da in device_aggs),
@@ -840,7 +863,7 @@ class JaxDagEvaluator:
 
             def body(st, xs):
                 cd, cn, nv, off = xs
-                cols = _build_cols(ship_cols, nullable, cd, cn, n_rows)
+                cols = _build_cols(ship_cols, nullable, cd, cn, n_rows, enc, refs)
                 gids = _mixed_radix_gids(cols, group_cols, dict_lens, n_rows)
                 return _fused_step(sel_rpns, device_aggs, capacity, n_rows, cols, nv, gids, off, st,
                                    track_first=track_first), None
@@ -870,7 +893,6 @@ class JaxDagEvaluator:
             if not (scan.columns_info[i].ftype.flag & NOT_NULL_FLAG)
         ]
         # derived jit caches keyed on the column set are now stale
-        self._mask_fn_cache = None
         self._agg_fn_cache = {}
 
     def _stable_dict_group_cols(self, blocks):
@@ -928,10 +950,10 @@ class JaxDagEvaluator:
             while capacity < n_slots:
                 capacity *= 2
             ship = self._ship_cols(group_cols)
-            col_data, col_nulls = self._stacked_device(cache, blocks, ship)
+            col_data, col_nulls, refs, enc = self._stacked_device(cache, blocks, ship)
             nv_dev, off_dev = self._nvoff_device(cache, blocks)
-            scan_fn = self._build_scan_fn_coded(dict_lens, capacity, n_blocks, group_cols)
-            packed = scan_fn(col_data, col_nulls, nv_dev, off_dev)
+            scan_fn = self._build_scan_fn_coded(dict_lens, capacity, n_blocks, group_cols, enc)
+            packed = scan_fn(col_data, col_nulls, nv_dev, off_dev, refs)
             state_np = _unpack_state(packed, self._host_state_template())
 
             def key_of(slot: int) -> tuple:
@@ -956,10 +978,10 @@ class JaxDagEvaluator:
         while capacity < n_slots:
             capacity *= 2
 
-        col_data, col_nulls = self._stacked_device(cache, blocks, self.device_cols)
+        col_data, col_nulls, refs, enc = self._stacked_device(cache, blocks, self.device_cols)
         nv_dev, off_dev = self._nvoff_device(cache, blocks)
-        scan_fn = self._build_scan_fn(capacity, n_blocks)
-        packed = scan_fn(col_data, col_nulls, nv_dev, all_gids, off_dev)
+        scan_fn = self._build_scan_fn(capacity, n_blocks, enc)
+        packed = scan_fn(col_data, col_nulls, nv_dev, all_gids, off_dev, refs)
         state_np = _unpack_state(packed, self._host_state_template())
         return self._finalize_agg(state_np, n_slots, lambda r: groups.rows[r])
 
@@ -1012,25 +1034,54 @@ class JaxDagEvaluator:
 
         return cache.device_arrays(blocks[0], sig, build)
 
-    def _stacked_device(self, cache, blocks, ship_cols, nullable_cols=None):
-        """(B, n_rows)-stacked device arrays for the given columns, pinned in
-        the cache so later queries reuse them without any transfer."""
+    def _stacked_device(self, cache, blocks, ship_cols, nullable_cols=None,
+                        plan=_MISSING_PLAN):
+        """(B, n_rows)-stacked device arrays for the given columns, pinned
+        in the cache so later queries reuse them without any transfer.
+
+        Returns ``(data, nulls, refs, enc)``: with an encoding plan
+        (``copr/encoding.py``) the pinned arrays are the ENCODED payloads
+        (narrow lanes, run pairs) plus the dynamic frame-of-reference
+        vector, and ``enc`` is the static descriptor tuple callers bake
+        into their jit keys; plain images pin exactly as before
+        (``refs``/``enc`` = None)."""
+        from . import encoding as _encoding
+
         nullable = self.nullable_cols if nullable_cols is None else nullable_cols
-        sig = ("stacked", tuple(ship_cols), tuple(nullable), self.block_rows)
+        if plan is _MISSING_PLAN:
+            plan = _encoding.device_plan(cache, ship_cols, nullable)
+        if plan is None:
+            sig = ("stacked", tuple(ship_cols), tuple(nullable), self.block_rows)
 
-        def build(_blk):
-            note_blocking("device.pin:stacked")
-            data = tuple(
-                jnp.stack([jnp.asarray(self._pad(b.cols[i].data)) for b in blocks])
-                for i in ship_cols
-            )
-            nulls = tuple(
-                jnp.stack([jnp.asarray(self._pad(b.cols[i].nulls, True)) for b in blocks])
-                for i in nullable
-            )
-            return jax.block_until_ready((data, nulls))
+            def build(_blk):
+                note_blocking("device.pin:stacked")
+                # decoded_data/nulls: a decode-SHIP of an encoded image
+                # (cross-region signature mismatch) must not leave a full
+                # decode cached on the column — the budget counts encoded
+                data = tuple(
+                    jnp.stack([jnp.asarray(self._pad(_encoding.decoded_data(b.cols[i]))) for b in blocks])
+                    for i in ship_cols
+                )
+                nulls = tuple(
+                    jnp.stack([jnp.asarray(self._pad(_encoding.decoded_nulls(b.cols[i]), True)) for b in blocks])
+                    for i in nullable
+                )
+                return jax.block_until_ready((data, nulls))
 
-        return cache.device_arrays(blocks[0], sig, build)
+            data, nulls = cache.device_arrays(blocks[0], sig, build)
+            return data, nulls, None, None
+        sig = ("stackedenc", tuple(ship_cols), tuple(nullable),
+               self.block_rows, plan.sig, plan.null_sig)
+
+        def build_enc(_blk):
+            note_blocking("device.pin:stacked_encoded")
+            data, nulls, refs = _encoding.stack_block_payloads(
+                blocks, ship_cols, nullable, plan, self.block_rows)
+            entry = jax.tree.map(jnp.asarray, (tuple(data), tuple(nulls), refs))
+            return jax.block_until_ready(entry)
+
+        data, nulls, refs = cache.device_arrays(blocks[0], sig, build_enc)
+        return data, nulls, refs, plan.sig
 
     # -- host loop ---------------------------------------------------------
 
@@ -1068,21 +1119,52 @@ class JaxDagEvaluator:
         yield from cache
 
     def _device_block(self, cols, n_valid):
-        """(col_data, col_nulls) device-ready arrays; served from the block
-        cache's HBM-pinned entries when a cache is active."""
+        """(col_data, col_nulls, refs, enc) device-ready arrays; served
+        from the block cache's HBM-pinned entries when a cache is active —
+        as ENCODED payloads (narrow lanes / runs) when the image is encoded
+        (copr/encoding.py), so per-block warm serving pins encoded HBM
+        too."""
+        from . import encoding as _encoding
+
         cache = getattr(self, "_cache", None)
         build = lambda blk: (
             [jnp.asarray(self._pad(blk.cols[i].data)) for i in self.device_cols],
             [jnp.asarray(self._pad(blk.cols[i].nulls, True)) for i in self.nullable_cols],
         )
         if cache is not None and cache.filled:
+            plan = _encoding.device_plan(cache, self.device_cols, self.nullable_cols)
             for blk in cache.blocks:
                 if blk.cols is cols:
-                    sig = (tuple(self.device_cols), tuple(self.nullable_cols), self.block_rows)
-                    return cache.device_arrays(blk, sig, build)
+                    if plan is None:
+                        sig = (tuple(self.device_cols), tuple(self.nullable_cols), self.block_rows)
+                        d, nl = cache.device_arrays(blk, sig, build)
+                        return d, nl, None, None
+                    sig = ("blockenc", tuple(self.device_cols),
+                           tuple(self.nullable_cols), self.block_rows,
+                           plan.sig, plan.null_sig)
+
+                    def build_enc(blk):
+                        note_blocking("device.pin:block_encoded")
+                        br = self.block_rows
+                        data = []
+                        for j, i in enumerate(self.device_cols):
+                            p = _encoding.block_payload(blk.cols[i], br)
+                            data.append(
+                                (jnp.asarray(p[0]), jnp.asarray(p[1]))
+                                if plan.sig[j][0] == "rle" else jnp.asarray(p)
+                            )
+                        nulls = [
+                            jnp.asarray(_encoding.block_null_payload(blk.cols[i], br))
+                            for i in self.nullable_cols
+                        ]
+                        return jax.block_until_ready(
+                            (data, nulls, jnp.asarray(plan.refs)))
+
+                    d, nl, refs = cache.device_arrays(blk, sig, build_enc)
+                    return d, nl, refs, plan.sig
         col_data = [self._pad(cols[i].data) for i in self.device_cols]
         col_nulls = [self._pad(cols[i].nulls, True) for i in self.nullable_cols]
-        return col_data, col_nulls
+        return col_data, col_nulls, None, None
 
     def _decode_blocks(self, source: ScanSource):
         """Yield (columns, n_valid) blocks of exactly block_rows rows (padded)."""
@@ -1181,7 +1263,19 @@ class JaxDagEvaluator:
         offset = 0
 
         for cols, n_valid in self._blocks(source):
-            col_data, col_nulls = self._device_block(cols, n_valid)
+            # cold/COP-cache blocks are always decoded (only region images
+            # encode, and those route through _run_aggregated_cached); if an
+            # encoded image ever lands here, ship it decoded — this block
+            # step compiles without the in-kernel decode
+            col_data, col_nulls, _refs, _enc = self._device_block(cols, n_valid)
+            if _enc is not None:
+                # unreachable today: run() routes every filled cache to
+                # _run_aggregated_cached and only region images encode —
+                # but this block step compiles WITHOUT the in-kernel
+                # decode, so silently feeding it narrow lanes would be
+                # wrong math; fail loudly and let the endpoint's CPU
+                # fallback serve
+                raise RuntimeError("encoded image reached the cold block path")
             if self.group_rpns:
                 gids_np, n_groups = self._assign_gids(cols, n_valid, groups)
                 if n_groups > capacity:
@@ -1309,8 +1403,8 @@ class JaxDagEvaluator:
             dts += [_np_dtype(self.schema[ci][0]), np.bool_]
         return dts
 
-    def _build_topn_fn(self, k: int):
-        key = ("topn", k)
+    def _build_topn_fn(self, k: int, enc=None):
+        key = ("topn", k, enc)
         cached = self._agg_fn_cache.get(key)
         if cached is not None:
             return cached
@@ -1321,8 +1415,9 @@ class JaxDagEvaluator:
         n_rows = self.block_rows
         payload_cols = list(range(len(self.schema)))
 
-        def step(col_data, col_nulls, n_valid, state):
-            cols = _build_cols(device_cols, nullable, col_data, col_nulls, n_rows)
+        def step(col_data, col_nulls, n_valid, state, refs):
+            cols = _build_cols(device_cols, nullable, col_data, col_nulls,
+                               n_rows, enc, refs)
             return _topn_step(
                 sel_rpns, order_rpns, payload_cols, k, n_rows, cols, n_valid, state
             )
@@ -1344,7 +1439,6 @@ class JaxDagEvaluator:
         if k == 0:
             enc = ResponseEncoder(self.dag.chunk_rows)
             return SelectResponse(chunks=enc.finish())
-        step = self._build_topn_fn(k)
         dtypes = self._topn_state_dtypes()
         jdt = {np.float64: jnp.float64, np.bool_: jnp.bool_}
         state = tuple(
@@ -1356,6 +1450,7 @@ class JaxDagEvaluator:
             ci for ci, (et, _f) in enumerate(self.schema) if et == EvalType.BYTES
         ]
         payload_dicts: dict[int, np.ndarray] = {}
+        step = None
         for cols, n_valid in self._blocks(source):
             for ci in bytes_cols:
                 # BYTES payloads ride as dictionary codes; every block must
@@ -1369,8 +1464,13 @@ class JaxDagEvaluator:
                     len(seen) != len(d) or any(a != b for a, b in zip(seen, d))
                 ):
                     raise ValueError(f"TopN BYTES payload column {ci}: unstable dictionary")
-            col_data, col_nulls = self._device_block(cols, n_valid)
-            state = step(col_data, col_nulls, n_valid, state)
+            col_data, col_nulls, refs, enc_sig = self._device_block(cols, n_valid)
+            if step is None:
+                # the encoding signature is uniform across one source's
+                # blocks (images encode image-wide), so the first block
+                # fixes the compiled program
+                step = self._build_topn_fn(k, enc_sig)
+            state = step(col_data, col_nulls, n_valid, state, refs)
         pack_key = ("packtopn", k)
         pack_fn = self._agg_fn_cache.get(pack_key)
         if pack_fn is None:
@@ -1397,9 +1497,11 @@ class JaxDagEvaluator:
     def _run_scan_filter(self, source: ScanSource) -> SelectResponse:
         """TableScan → Selection? → Limit?: device computes the row mask,
         host compacts + encodes (row encoding is host work either way)."""
+        from . import encoding as _encoding
+
         remaining = self.plan.limit.limit if self.plan.limit else None
         sel_rpns = self.sel_rpns
-        mask_jit = self._build_mask_fn()
+        mask_jit = None
         enc = ResponseEncoder(self.dag.chunk_rows)
         for cols, n_valid in self._blocks(source):
             valid = np.zeros(self.block_rows, dtype=bool)
@@ -1407,15 +1509,21 @@ class JaxDagEvaluator:
             if sel_rpns:
                 # served from the block cache's HBM-pinned arrays when one is
                 # active — warm selections ship only the valid mask per block
-                col_data, col_nulls = self._device_block(cols, n_valid)
-                mask = np.asarray(mask_jit(col_data, col_nulls, valid))
+                # (encoded images ship their narrow/run payloads and decode
+                # in-kernel; the output below gathers ONLY surviving rows
+                # through the encodings — late materialization)
+                col_data, col_nulls, refs, enc_sig = self._device_block(cols, n_valid)
+                if mask_jit is None:
+                    mask_jit = self._build_mask_fn(enc_sig)
+                mask = np.asarray(mask_jit(col_data, col_nulls, valid, refs))
             else:
                 mask = valid
             logical = np.flatnonzero(mask[: n_valid])
             if remaining is not None:
                 logical = logical[:remaining]
                 remaining -= len(logical)
-            chunk = Chunk(cols, logical)
+            out_cols, logical = _encoding.late_materialize_chunk(cols, logical)
+            chunk = Chunk(out_cols, logical)
             enc.add_chunk(chunk, self.dag.output_offsets)
             if remaining is not None and remaining <= 0:
                 break
@@ -1493,7 +1601,11 @@ def run_batch_cached(evaluators: list["JaxDagEvaluator"], cache) -> list[SelectR
     ship = sorted(ship)
     base = evaluators[0]
     nullable = sorted(set().union(*[set(ev.nullable_cols) for ev in evaluators]))
-    col_data, col_nulls = base._stacked_device(cache, blocks, ship, nullable)
+    col_data, col_nulls, refs, enc = base._stacked_device(cache, blocks, ship, nullable)
+    if enc is not None:
+        from . import encoding as _encoding
+
+        _encoding.count_path("fused", "encoded")
     n_rows = base.block_rows
 
     key = (
@@ -1501,13 +1613,14 @@ def run_batch_cached(evaluators: list["JaxDagEvaluator"], cache) -> list[SelectR
         n_blocks,
         tuple(ship),
         n_rows,
+        enc,
         # dict radices and capacities are baked into the compiled program —
         # a cache whose dictionaries grew must compile a fresh program
         tuple((spec[3], spec[4]) for spec in specs),
     )
     fn = _BATCH_FN_CACHE.get(key)
     if fn is None:
-        def batch_fn(col_data, col_nulls, n_valids, offsets):
+        def batch_fn(col_data, col_nulls, n_valids, offsets, refs):
             states = tuple(
                 (
                     jnp.full(capacity, _NO_ROW, dtype=jnp.int64),
@@ -1518,7 +1631,7 @@ def run_batch_cached(evaluators: list["JaxDagEvaluator"], cache) -> list[SelectR
 
             def body(sts, xs):
                 cd, cn, nv, off = xs
-                cols = _build_cols(ship, nullable, cd, cn, n_rows)
+                cols = _build_cols(ship, nullable, cd, cn, n_rows, enc, refs)
                 new_sts = []
                 for (ev, group_cols, _dicts, dict_lens, capacity, _ns), st in zip(specs, sts):
                     gids = _mixed_radix_gids(cols, group_cols, dict_lens, n_rows)
@@ -1550,7 +1663,7 @@ def run_batch_cached(evaluators: list["JaxDagEvaluator"], cache) -> list[SelectR
             _BATCH_FN_CACHE.pop(next(iter(_BATCH_FN_CACHE)))
 
     nv_dev, off_dev = base._nvoff_device(cache, blocks)
-    int_m, flt_m = fn(col_data, col_nulls, nv_dev, off_dev)
+    int_m, flt_m = fn(col_data, col_nulls, nv_dev, off_dev, refs)
     int_np = np.asarray(int_m)
     flt_np = np.asarray(flt_m) if flt_m.shape[0] else None
     out = []
@@ -1706,10 +1819,19 @@ def launch_xregion_cached(ev: "JaxDagEvaluator", caches) -> XRegionPending:
     batchable (non-aggregation plan, unstable group dictionaries, empty
     cache); the scheduler sheds those to the per-request path.
     """
+    from . import encoding as _encoding
+
     specs, group_cols, capacity = xregion_specs(ev, caches)
     ship = ev._ship_cols(group_cols)
     nullable = ev.nullable_cols
     n_rows = ev.block_rows
+    # encoded residency (copr/encoding.py): the vmapped program stacks
+    # per-region pinned arrays, so every region must carry the SAME
+    # encoding signature — batch_plan decides (and counts) encoded vs
+    # decode-ship; the descriptors ride the jit key, the per-region
+    # frame-of-reference vectors ride as a dynamic (R, n_ship) input
+    plans = _encoding.batch_plan(caches, ship, nullable, "xregion")
+    enc = plans[0].sig if plans else None
     # canonicalize region order by block count: the compiled program's cache
     # key is the block-count tuple, so (2,3) and (3,2) must not compile two
     # programs — batches differing only in arrival order share one
@@ -1718,6 +1840,8 @@ def launch_xregion_cached(ev: "JaxDagEvaluator", caches) -> XRegionPending:
                    reverse=True)
     caches = [caches[i] for i in order]
     specs = [specs[i] for i in order]
+    if plans:
+        plans = [plans[i] for i in order]
     n_blocks = tuple(len(c.blocks) for c in caches)
     B = max(n_blocks)
     # per-region inputs are the caches' ALREADY-PINNED device arrays (the
@@ -1725,15 +1849,20 @@ def launch_xregion_cached(ev: "JaxDagEvaluator", caches) -> XRegionPending:
     # scatter_update / drop_device) — zero per-row host→device traffic, and
     # no cross-cache pin that could go stale behind a region's back
     region_inputs = []
-    for cache in caches:
-        data, nulls = ev._stacked_device(cache, cache.blocks, ship)
+    for r, cache in enumerate(caches):
+        data, nulls, _refs, _e = ev._stacked_device(
+            cache, cache.blocks, ship,
+            plan=plans[r] if plans else None,
+        )
         nv, off = ev._nvoff_device(cache, cache.blocks)
         region_inputs.append((data, nulls, nv, off))
     dl_arr = np.array([s[1] for s in specs], dtype=np.int64).reshape(
         len(caches), len(group_cols)
     )
+    refs_arr = (np.stack([np.asarray(p.refs) for p in plans])
+                if plans else np.zeros((len(caches), len(ship)), dtype=np.int64))
 
-    key = ("xregion", n_blocks, capacity, tuple(ship), tuple(nullable))
+    key = ("xregion", n_blocks, capacity, tuple(ship), tuple(nullable), enc)
     fn = ev._agg_fn_cache.get(key)
     if fn is None:
         device_aggs = ev.device_aggs
@@ -1746,11 +1875,11 @@ def launch_xregion_cached(ev: "JaxDagEvaluator", caches) -> XRegionPending:
                 return a
             return jnp.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
 
-        def xregion_fn(region_inputs, dl_arr):
+        def xregion_fn(region_inputs, dl_arr, refs_arr):
             padded = [jax.tree.map(pad_b, ri) for ri in region_inputs]
             stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *padded)
 
-            def one_region(ri, dlens):
+            def one_region(ri, dlens, refs_r):
                 cd_r, cn_r, nv_r, off_r = ri
                 state = (
                     jnp.full(capacity, _NO_ROW, dtype=jnp.int64),
@@ -1759,7 +1888,7 @@ def launch_xregion_cached(ev: "JaxDagEvaluator", caches) -> XRegionPending:
 
                 def body(st, xs):
                     cd, cn, nv, off = xs
-                    cols = _build_cols(ship, nullable, cd, cn, n_rows)
+                    cols = _build_cols(ship, nullable, cd, cn, n_rows, enc, refs_r)
                     if group_cols:
                         gids = jnp.zeros(n_rows, dtype=jnp.int64)
                         for k, gi in enumerate(group_cols):
@@ -1776,7 +1905,7 @@ def launch_xregion_cached(ev: "JaxDagEvaluator", caches) -> XRegionPending:
                 state, _ = jax.lax.scan(body, state, (cd_r, cn_r, nv_r, off_r))
                 return _pack_state(state)
 
-            return jax.vmap(one_region)(stacked, dl_arr)
+            return jax.vmap(one_region)(stacked, dl_arr, refs_arr)
 
         fn = jax.jit(xregion_fn)
         ev._agg_fn_cache[key] = fn
@@ -1788,7 +1917,7 @@ def launch_xregion_cached(ev: "JaxDagEvaluator", caches) -> XRegionPending:
         while len(xkeys) > 16:
             ev._agg_fn_cache.pop(xkeys.pop(0))
 
-    packed = fn(tuple(region_inputs), dl_arr)
+    packed = fn(tuple(region_inputs), dl_arr, refs_arr)
     return XRegionPending(ev, specs, capacity, packed, order)
 
 

@@ -347,6 +347,14 @@ class ZoneLayout:
         # classification needs only the per-tile stats; the full-size host
         # copies just fed the device pins — at bench scale they are GBs
         del self.cols_np, self.nulls_np, self.valid, self.ridx
+        # encoded-resident images (docs/compressed_columns.md): the gathers
+        # above materialized their decode caches — drop them, or the image
+        # holds encoded payload + full decode while the budget counts only
+        # the former
+        for blk in blocks:
+            for c in blk.cols:
+                if hasattr(c, "purge_decoded"):
+                    c.purge_decoded()
 
 
 

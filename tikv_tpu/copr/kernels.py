@@ -1155,3 +1155,47 @@ from . import mysql_time as _mysql_time  # noqa: E402,F401
 # catalog extension (conversion / control / string / time / json / misc
 # breadth) — also self-registering
 from . import kernels_ext as _kernels_ext  # noqa: E402,F401
+
+
+# ---------------------------------------------------------------------------
+# encoded-column device decode (docs/compressed_columns.md)
+# ---------------------------------------------------------------------------
+# The region column cache keeps blocks device-resident in ENCODED form
+# (copr/encoding.py: bitpacked narrow lanes, RLE runs, narrowed dictionary
+# codes).  These helpers are the ONE in-kernel decode used by every device
+# program (jax_eval._build_cols, the mesh slab step): HBM holds the encoded
+# payload, the first ops of the compiled program widen/expand in registers,
+# and everything downstream (RPN kernels above, segment reductions) sees
+# exact int64/f64 lanes — byte-identical to evaluating the decoded image.
+
+
+def decode_device_column(xp, desc, payload, nulls, ref, n_rows: int):
+    """(data, nulls) int64/f64 lanes for ONE shipped column.
+
+    ``desc`` is the static encoding descriptor baked into the compiled
+    program's cache key; ``ref`` is the DYNAMIC frame-of-reference scalar
+    (bitpack), so images whose value ranges differ still share one
+    executable; ``payload`` is the pinned array — narrow lanes for
+    plain/bp/code, an (run_values, run_ends) pair for rle."""
+    kind = desc[0]
+    if kind == "plain":
+        return payload, nulls
+    if kind == "bp":
+        data = payload.astype(xp.int64)
+        if ref is not None:
+            data = data + ref
+        return data, nulls
+    if kind == "code":
+        return payload.astype(xp.int64), nulls
+    if kind == "rle":
+        run_values, run_ends = payload
+        k_cap = desc[1]
+        rows = xp.arange(n_rows, dtype=xp.int64)
+        idx = xp.clip(
+            xp.searchsorted(run_ends, rows, side="right"), 0, k_cap - 1
+        )
+        data = run_values[idx].astype(xp.int64)
+        if nulls.shape[0] != n_rows:  # run-shaped null payload
+            nulls = nulls[idx]
+        return data, nulls
+    raise AssertionError(f"unknown encoding descriptor {desc!r}")

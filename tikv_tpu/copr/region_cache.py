@@ -76,6 +76,7 @@ from ..storage.engine import CF_DEFAULT, CF_LOCK, CF_WRITE
 from ..storage.mvcc import Statistics
 from ..storage.mvcc.reader import _check_lock
 from ..storage.txn_types import Key, Write, WriteType, append_ts, split_ts
+from . import encoding as _encoding
 from . import integrity as _integrity
 from .cache import ColumnBlockCache
 from .datatypes import Column, EvalType
@@ -226,6 +227,10 @@ class RegionImage:
         self.block_cache = ColumnBlockCache(key=key)
         self.decoder = RowBatchDecoder(schema)
         self.nbytes = 0
+        # compressed residency (docs/compressed_columns.md): whether fill
+        # ran the encoding stats pass, and which columns it encoded
+        self.encode_enabled = False
+        self.encodings: dict[int, str] = {}
         # bytes->code maps for dict-encoded columns, built on first delta
         self._dict_maps: dict[int, dict] = {}
         # write-through pending delta (apply_write buffers; serve folds in):
@@ -269,7 +274,7 @@ class RegionImage:
 
     def fill(self, handles: np.ndarray, values: list[bytes], cts: np.ndarray,
              max_commit_ts: int, apply_index: int, start_ts: int,
-             raw_keys: list[bytes] | None = None) -> None:
+             raw_keys: list[bytes] | None = None, encode: bool = False) -> None:
         self.handles = handles
         self.row_commit_ts = cts
         self._init_fingerprint(handles, values, raw_keys)
@@ -281,6 +286,15 @@ class RegionImage:
             cols = self.decoder.decode(handles[s:e], values[s:e])
             cache.add(cols, e - s)
         cache.filled = True
+        # fill-time stats pass (docs/compressed_columns.md): eligible
+        # columns become ENCODED residents — dict codes narrowed, runs
+        # collapsed to RLE, narrow ranges bitpacked — and the recount below
+        # accounts the budget in ENCODED bytes, which is what multiplies
+        # warm capacity.  Fingerprints above hash the LOGICAL rows, so the
+        # integrity plane cross-checks encoded and decoded images alike.
+        self.encode_enabled = bool(encode)
+        if encode:
+            self.encodings = _encoding.encode_blocks(cache, self.schema)
         self.apply_index = apply_index
         self.snapshot_ts = start_ts
         self.max_commit_ts = max_commit_ts
@@ -433,12 +447,18 @@ class RegionImage:
         nl = bool(np.asarray(col.nulls)[r])
         image_col = blocks[0].cols[ci] if blocks else None
         dict_encoded = image_col is not None and image_col.is_dict_encoded
-        obj_col = (
-            image_col.data.dtype == object
-            if image_col is not None and isinstance(image_col.data, np.ndarray)
-            else self.schema[ci].ftype.eval_type in (EvalType.BYTES, EvalType.JSON)
-            and not dict_encoded
-        )
+        if isinstance(image_col, _encoding.EncodedColumn):
+            # int-family lanes by construction — and the ``.data`` probe
+            # below would permanently cache a full decode the encoded byte
+            # budget never accounted for
+            obj_col = False
+        else:
+            obj_col = (
+                image_col.data.dtype == object
+                if image_col is not None and isinstance(image_col.data, np.ndarray)
+                else self.schema[ci].ftype.eval_type in (EvalType.BYTES, EvalType.JSON)
+                and not dict_encoded
+            )
         if nl:
             return (b"" if obj_col and not dict_encoded else 0), True
         v = col.decoded().data[r] if col.is_dict_encoded else col.data[r]
@@ -447,10 +467,21 @@ class RegionImage:
         return v, False
 
     def _apply_updates(self, pos: np.ndarray, cols, ch: np.ndarray, cts: np.ndarray) -> None:
-        """In-place row updates: mutate host arrays, scatter device pins."""
+        """In-place row updates: mutate host arrays (patching encoded
+        payloads where the encoding survives — docs/compressed_columns.md),
+        scatter device pins."""
         blocks = self.block_cache.blocks
         offsets = self._offsets()
         bi_arr = np.searchsorted(offsets, pos, side="right") - 1
+        # any in-place update to an RLE column breaks its runs: demote it
+        # image-wide up front (decode-on-next-serve), so the assignments
+        # below land on plain decoded arrays
+        for ci in range(len(self.schema)):
+            if self.schema[ci].is_pk_handle:
+                continue
+            c0 = blocks[0].cols[ci] if blocks else None
+            if isinstance(c0, _encoding.EncodedColumn) and c0.kind == "rle":
+                _encoding.demote_column(self.block_cache, ci, "inplace_update")
         updates: dict[int, tuple[np.ndarray, dict]] = {}
         for bi in np.unique(bi_arr):
             sel = np.flatnonzero(bi_arr == bi)
@@ -460,14 +491,37 @@ class RegionImage:
                 if self.schema[ci].is_pk_handle:
                     continue  # handles are the row identity — never change
                 image_col = blocks[int(bi)].cols[ci]
-                vals = np.empty(len(sel), dtype=np.asarray(image_col.data).dtype)
+                vals = np.empty(len(sel), dtype=_encoding.host_dtype(image_col))
                 nls = np.zeros(len(sel), dtype=bool)
                 for j, si in enumerate(sel):
                     v, nl = self._delta_cell(ci, blocks, col, int(si))
                     vals[j] = v
                     nls[j] = nl
-                image_col.data[rows] = vals
-                image_col.nulls[rows] = nls
+                if isinstance(image_col, _encoding.EncodedColumn):
+                    if not image_col.try_patch(rows, vals, nls):
+                        # the new values don't fit the narrow lanes: demote
+                        # the column image-wide and write decoded
+                        _encoding.demote_column(
+                            self.block_cache, ci, "value_range")
+                        image_col = blocks[int(bi)].cols[ci]
+                        image_col.data[rows] = vals.astype(
+                            image_col.data.dtype, copy=False)
+                        image_col.nulls[rows] = nls
+                else:
+                    d = np.asarray(image_col.data)
+                    if (image_col.dictionary is not None and d.dtype != object
+                            and d.dtype.kind in "iu" and d.dtype.itemsize < 8
+                            and len(vals)
+                            and _encoding.ensure_code_capacity(
+                                blocks, ci, int(vals.max()))):
+                        # narrowed code lanes widened (a delta grew the
+                        # dictionary past them) — pins rebuild from host
+                        self.block_cache.enc_version += 1
+                        self.block_cache.drop_device()
+                        image_col = blocks[int(bi)].cols[ci]
+                    image_col.data[rows] = vals.astype(
+                        np.asarray(image_col.data).dtype, copy=False)
+                    image_col.nulls[rows] = nls
                 per_col[ci] = (vals, nls)
             updates[int(bi)] = (rows, per_col)
         self.row_commit_ts[pos] = cts
@@ -491,7 +545,14 @@ class RegionImage:
         gdata, gnulls = [], []
         for ci in range(len(self.schema)):
             if blocks:
-                gdata.append(np.concatenate([np.asarray(b.cols[ci].data) for b in blocks]))
+                g = np.concatenate([np.asarray(b.cols[ci].data) for b in blocks])
+                if (blocks[0].cols[ci].dictionary is not None
+                        and g.dtype != object and g.dtype.kind in "iu"
+                        and g.dtype.itemsize < 8):
+                    # narrowed code lanes widen for the repack math (new
+                    # codes may exceed them); re-encode below re-narrows
+                    g = g.astype(np.int64)
+                gdata.append(g)
                 gnulls.append(np.concatenate([np.asarray(b.cols[ci].nulls) for b in blocks]))
             else:
                 et = self.schema[ci].ftype.eval_type
@@ -596,6 +657,11 @@ class RegionImage:
                 ))
             self.block_cache.add(bcols, e - s)
         self.block_cache.filled = True
+        if self.encode_enabled:
+            # structural repacks re-run the stats pass: the rebuilt plain
+            # blocks re-encode from fresh value ranges/runs (no KV decode —
+            # the repack above already stayed on resident columns)
+            self.encodings = _encoding.encode_blocks(self.block_cache, self.schema)
         self.block_cache.drop_device()
 
 
@@ -646,12 +712,18 @@ class RegionColumnCache:
         per_device_budget: int | None = None,
         write_through: bool = True,
         data_token: object = _TOKEN_UNSET,
+        encode_columns: bool = True,
     ):
         from .jax_eval import DEFAULT_BLOCK_ROWS
 
         self.byte_budget = byte_budget
         self.max_regions = max_regions
         self.block_rows = block_rows or DEFAULT_BLOCK_ROWS
+        # compressed residency (docs/compressed_columns.md): fill runs the
+        # encoding stats pass and the byte budget accounts ENCODED bytes —
+        # encode_columns=False is the kill switch (decoded residency, PR-9
+        # behavior exactly)
+        self.encode_columns = encode_columns
         self._images: dict = {}  # key -> RegionImage, insertion = LRU order
         self._mu = make_rlock("copr.region_cache")
         self.stats = RegionCacheStats()
@@ -811,7 +883,7 @@ class RegionColumnCache:
                 self._count("wt_delta")
                 self._count_delta_rows(n)
                 self._enforce_budget(keep=key)
-                self._gauge_bytes()
+                self._gauge_bytes(full=False)
                 return img.block_cache, "wt_delta", n
             # lint: allow(lock-blocking-call) -- the fold-in must be atomic
             # with the image version bump (docs: Concurrency); the scan is
@@ -849,7 +921,7 @@ class RegionColumnCache:
             self._count("delta")
             self._count_delta_rows(n)
             self._enforce_budget(keep=key)
-            self._gauge_bytes()
+            self._gauge_bytes(full=False)
             return img.block_cache, "delta", n
 
     # -- integrity plane (docs/integrity.md) ---------------------------------
@@ -1093,8 +1165,11 @@ class RegionColumnCache:
     def warm_region_ids(self) -> list[int]:
         """Region ids with a resident device image — the placement this
         store advertises to PD each heartbeat so peers can forward
-        device-eligible DAGs to the owner (docs/wire_path.md)."""
+        device-eligible DAGs to the owner (docs/wire_path.md).  Doubles as
+        the byte-gauge heartbeat: pure-hit traffic never re-gauges on the
+        serve path, so the pinned-HBM/compression gauges refresh here."""
         with self._mu:
+            self._gauge_bytes()
             return sorted({k[0] for k in self._images})
 
     def has_warm_region(self, region_id: int) -> bool:
@@ -1184,7 +1259,7 @@ class RegionColumnCache:
             self._device_bytes[lo.id] += img.nbytes
             # the migration moved placement bytes AFTER the drop path's
             # last refresh — keep the per-device gauge truthful
-            self._gauge_bytes()
+            self._gauge_bytes(full=False)
         return
 
     # -- internals ---------------------------------------------------------
@@ -1210,7 +1285,8 @@ class RegionColumnCache:
             return None, "uncacheable", 0
         img = RegionImage(key, epoch, list(columns_info), self.block_rows)
         img.fill(handles, values, src.row_commit_ts, src.max_commit_ts,
-                 apply_index, start_ts, raw_keys=keys)
+                 apply_index, start_ts, raw_keys=keys,
+                 encode=self.encode_columns)
         if img.nbytes > self.byte_budget:
             self.stats.uncacheable += 1
             self._count("too_big")
@@ -1335,15 +1411,39 @@ class RegionColumnCache:
             "Rows re-decoded by incremental delta applies",
         ).inc(n)
 
-    def _gauge_bytes(self) -> None:
+    def _gauge_bytes(self, full: bool = True) -> None:
         total = sum(i.nbytes for i in self._images.values())
         self.stats.bytes_pinned = total
         from ..util.metrics import REGISTRY
 
         REGISTRY.gauge(
             "tikv_coprocessor_region_cache_bytes",
-            "Host bytes held by resident region images",
+            "Resident (encoded) bytes held by region images",
         ).set(total)
+        # compressed-residency observability (docs/compressed_columns.md):
+        # the ratio the budget win rides on, and the TRUE bytes pinned in
+        # HBM right now (summed over every image's device signatures — with
+        # encoded residency these are the narrow/encoded payloads, not a
+        # host-side proxy).  These walk every image's columns and pin trees,
+        # so delta/wt_delta applies (the write hot path, under this lock)
+        # pass full=False and the heartbeat/build/drop paths refresh them.
+        if full:
+            decoded = sum(
+                i.block_cache.nbytes_decoded() for i in self._images.values()
+            )
+            resident = sum(
+                i.block_cache.nbytes() for i in self._images.values()
+            )
+            REGISTRY.gauge(
+                "tikv_coprocessor_region_cache_compression_ratio",
+                "Decoded-vs-resident byte ratio of the warm column blocks",
+            ).set(decoded / resident if resident else 1.0)
+            REGISTRY.gauge(
+                "tikv_coprocessor_region_cache_device_pinned_bytes",
+                "True bytes currently pinned on devices by region images",
+            ).set(sum(
+                i.block_cache.device_nbytes() for i in self._images.values()
+            ))
         if self.devices:
             g = REGISTRY.gauge(
                 "tikv_coprocessor_region_cache_device_bytes",

@@ -710,6 +710,12 @@ class CoprReadScheduler:
         waste = self._padding_waste(live) if mesh is None else sh_waste
         t0 = time.perf_counter()
         try:
+            # the batch's region images carry their ENCODING DESCRIPTORS on
+            # the block caches (copr/encoding.py) alongside the dict
+            # radices: the launchers read them to ship encoded HBM payloads
+            # when every region agrees on one signature, and decode-ship
+            # (counted per-cause) when not — sharded and fused paths stay
+            # eligible for compressed-resident regions either way
             if mesh is not None:
                 pending = jax_eval.launch_xregion_sharded(
                     ev, [s.cache for s in live], mesh)

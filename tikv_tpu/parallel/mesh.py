@@ -689,7 +689,8 @@ def _zero_slab(dev, pad: int, n_rows: int, dtype):
     return z
 
 
-def _slab_pins(ev, cache, assign: dict, by_id: dict, ship, nullable):
+def _slab_pins(ev, cache, assign: dict, by_id: dict, ship, nullable,
+               plan=None):
     """Per-owner-device pinned slab stacks for ONE region image.
 
     ``assign``: device id -> ascending block indices.  Returns {device_id:
@@ -697,37 +698,59 @@ def _slab_pins(ev, cache, assign: dict, by_id: dict, ship, nullable):
     each leaf COMMITTED to its owner device.  Pinned on the cache under a
     ``shardslab`` signature, so repeat batches pay zero transfer; a delta
     apply drops the pins (cache.scatter_update treats the kind as opaque)
-    and they rebuild here from the updated host blocks."""
+    and they rebuild here from the updated host blocks.
+
+    With an encoding ``plan`` (copr/encoding.py — every cache in the batch
+    carries the same signature, RLE excluded), bitpacked/narrow-code lanes
+    pin AS-IS: the devices hold the encoded HBM bytes and the shard_map
+    program widens in-kernel with the per-region frame-of-reference row."""
     fp = tuple(sorted((did, tuple(bs)) for did, bs in assign.items()))
-    sig = ("shardslab", fp, tuple(ship), tuple(nullable), ev.block_rows)
+    enc = None if plan is None else plan.sig
+    sig = ("shardslab", fp, tuple(ship), tuple(nullable), ev.block_rows, enc)
 
     def _canon(arr):
         # one dtype per lane across every cache in a batch (the global
         # sharded array needs uniform shards even from devices whose slabs
         # came from different regions): f64 stays, everything else rides
-        # the int64 lanes the device step computes in anyway
+        # the int64 lanes the device step computes in anyway — except
+        # encoded lanes, whose narrow dtype IS uniform by plan signature
         arr = np.asarray(arr)
         return arr.astype(np.int64, copy=False) if arr.dtype != np.float64 else arr
+
+    from ..copr import encoding as _encoding
 
     def build(_blk):
         out = {}
         for did, idxs in assign.items():
             dev = by_id[did]
             blocks = [cache.blocks[i] for i in idxs]
-            data = tuple(
-                jax.device_put(
-                    np.stack([_canon(ev._pad(b.cols[i].data)) for b in blocks]),
-                    dev,
+            if plan is not None:
+                # ONE stacked-payload assembly (encoding.stack_block_payloads,
+                # shared with jax_eval._stacked_device); RLE is excluded on
+                # this path so every leaf is a plain (B, rows) array
+                data_np, nulls_np, _refs = _encoding.stack_block_payloads(
+                    blocks, ship, nullable, plan, ev.block_rows)
+                data = tuple(jax.device_put(a, dev) for a in data_np)
+                nulls = tuple(jax.device_put(a, dev) for a in nulls_np)
+            else:
+                # decoded_data/nulls: a decode-ship of an encoded image must
+                # not leave a full decode cached (the budget counts encoded)
+                data = tuple(
+                    jax.device_put(
+                        np.stack([_canon(ev._pad(_encoding.decoded_data(b.cols[i])))
+                                  for b in blocks]),
+                        dev,
+                    )
+                    for i in ship
                 )
-                for i in ship
-            )
-            nulls = tuple(
-                jax.device_put(
-                    np.stack([np.asarray(ev._pad(b.cols[i].nulls, True)) for b in blocks]),
-                    dev,
+                nulls = tuple(
+                    jax.device_put(
+                        np.stack([np.asarray(ev._pad(_encoding.decoded_nulls(b.cols[i]), True))
+                                  for b in blocks]),
+                        dev,
+                    )
+                    for i in nullable
                 )
-                for i in nullable
-            )
             out[did] = (data, nulls)
         note_blocking("device.pin:sharded_slabs")
         for leaf in jax.tree.leaves(out):
@@ -816,9 +839,20 @@ def launch_xregion_sharded(ev: JaxDagEvaluator, caches, mesh: Mesh) -> XRegionPe
             per_dev_slabs[did] += len(idxs)
     S = max(1, max(per_dev_slabs.values()))
 
+    # encoded residency (copr/encoding.py): slab stacks mix blocks of
+    # several regions on one device, so the whole batch must agree on one
+    # encoding signature and RLE is excluded (run capacities differ per
+    # image) — batch_plan decides and counts the decode-ship declines
+    from ..copr import encoding as _encoding
+
+    plans = _encoding.batch_plan(caches, list(ship), list(nullable),
+                                 "mesh_sharded", allow_rle=False)
+    enc = plans[0].sig if plans else None
+
     pins = [
-        _slab_pins(ev, c, a, by_id, ship, nullable)
-        for c, a in zip(caches, assigns)
+        _slab_pins(ev, c, a, by_id, ship, nullable,
+                   plan=plans[r] if plans else None)
+        for r, (c, a) in enumerate(zip(caches, assigns))
     ]
     region_offsets = []
     for cache in caches:
@@ -834,6 +868,12 @@ def launch_xregion_sharded(ev: JaxDagEvaluator, caches, mesh: Mesh) -> XRegionPe
     ship_dtypes = [
         np.float64 if ev.schema[i][0] == EvalType.REAL else np.int64 for i in ship
     ]
+    if enc is not None:
+        # encoded lanes keep their narrow dtype (zero-pad slabs must match)
+        ship_dtypes = [
+            np.dtype(enc[j][1]) if enc[j][0] in ("bp", "code") else ship_dtypes[j]
+            for j in range(len(ship))
+        ]
     meta_region = np.zeros((N, S), dtype=np.int32)
     meta_nv = np.zeros((N, S), dtype=np.int64)
     meta_off = np.zeros((N, S), dtype=np.int64)
@@ -889,9 +929,14 @@ def launch_xregion_sharded(ev: JaxDagEvaluator, caches, mesh: Mesh) -> XRegionPe
         np.array([s[1] for s in specs], dtype=np.int64).reshape(R, len(group_cols)),
         ns_rep,
     )
+    ref_arr = jax.device_put(
+        (np.stack([np.asarray(p.refs) for p in plans])
+         if plans else np.zeros((R, len(ship)), dtype=np.int64)),
+        ns_rep,
+    )
 
     key = ("xshard", tuple(d.id for d in devices), S, R, capacity,
-           ship, nullable, len(group_cols))
+           ship, nullable, len(group_cols), enc)
     fn = ev._agg_fn_cache.get(key)
     if fn is None:
         device_aggs = ev.device_aggs
@@ -901,11 +946,12 @@ def launch_xregion_sharded(ev: JaxDagEvaluator, caches, mesh: Mesh) -> XRegionPe
         in_specs = (
             tuple(P("regions") for _ in ship),
             tuple(P("regions") for _ in nullable),
-            P("regions"), P("regions"), P("regions"), P(),
+            P("regions"), P("regions"), P("regions"), P(), P(),
         )
 
         @_smap(flat, in_specs, (P(), P()))
-        def xfn(col_data, col_nulls, slab_region, n_valids, offsets, dl_arr):
+        def xfn(col_data, col_nulls, slab_region, n_valids, offsets, dl_arr,
+                ref_arr):
             state = (
                 jnp.full(cap_total, _NO_ROW, dtype=jnp.int64),
                 tuple(da.init_carry(cap_total) for da in device_aggs),
@@ -913,7 +959,10 @@ def launch_xregion_sharded(ev: JaxDagEvaluator, caches, mesh: Mesh) -> XRegionPe
 
             def body(st, xs):
                 cd, cn, r, nv, off = xs
-                cols = _build_cols(ship, nullable, cd, cn, n_rows)
+                # per-slab in-kernel decode: the slab's region row of the
+                # frame-of-reference matrix widens its bitpacked lanes
+                cols = _build_cols(ship, nullable, cd, cn, n_rows, enc,
+                                   None if enc is None else ref_arr[r])
                 local = jnp.zeros(n_rows, dtype=jnp.int64)
                 for k, gi in enumerate(group_cols):
                     codes, gnulls = cols[gi]
@@ -956,7 +1005,8 @@ def launch_xregion_sharded(ev: JaxDagEvaluator, caches, mesh: Mesh) -> XRegionPe
         while len(xkeys) > 16:
             ev._agg_fn_cache.pop(xkeys.pop(0))
 
-    packed = fn(col_data, col_nulls, slab_region, n_valids, offsets, dl_arr)
+    packed = fn(col_data, col_nulls, slab_region, n_valids, offsets, dl_arr,
+                ref_arr)
     return XRegionPending(ev, specs, capacity, packed, order=None)
 
 

@@ -96,6 +96,7 @@ class StoreServer:
         shard_cache: bool = True,
         group_commit: bool = True,
         write_through: bool = True,
+        encode_columns: bool = True,
         integrity_scrub_interval: float = 10.0,
         shadow_sample: int | None = None,
     ):
@@ -171,6 +172,7 @@ class StoreServer:
             feature_gate=self.feature_gate,
             shard_cache=shard_cache,
             write_through=write_through,
+            encode_columns=encode_columns,
             shadow_sample=shadow_sample,
         )
         # integrity plane (docs/integrity.md): the SDC scrubber verifies
@@ -494,6 +496,10 @@ def main(argv=None) -> int:
     ap.add_argument("--no-group-commit", action="store_true",
                     help="one raft proposal per txn command instead of "
                          "coalescing queued prewrites/commits (write_path.md)")
+    ap.add_argument("--no-column-encoding", action="store_true",
+                    help="keep region images device-resident DECODED "
+                         "(docs/compressed_columns.md kill switch; budgets "
+                         "then account decoded bytes)")
     ap.add_argument("--no-write-through", action="store_true",
                     help="disable raft-apply delta emission into the region "
                          "column cache (warm reads repair via scan_delta)")
@@ -538,6 +544,7 @@ def main(argv=None) -> int:
         shard_cache=not args.no_shard_cache,
         group_commit=not args.no_group_commit,
         write_through=not args.no_write_through,
+        encode_columns=not args.no_column_encoding,
         integrity_scrub_interval=args.integrity_scrub_interval,
         shadow_sample=args.shadow_sample,
     )

@@ -326,7 +326,10 @@ class Nemesis:
         """Silent-data-corruption fault (docs/integrity.md): flip bits in a
         warm region image's DERIVED state — decoded cached block columns
         (``mode="block"``: the post-decode plane the device serves, caught
-        by shadow reads and the deep scrub) or a buffered write-through
+        by shadow reads and the deep scrub), the ENCODED payload of a
+        compressed-resident column (``mode="encoded"``: bitpacked lanes /
+        RLE run values, docs/compressed_columns.md — proves detection
+        covers the encoded plane), or a buffered write-through
         pending delta (``mode="pending"``: a bad fold input, caught by the
         fingerprint-vs-oracle hash scrub).  Direct-injection like
         :meth:`disk_stall` — it targets a cache, not the transport — so it
@@ -581,6 +584,8 @@ def corrupt_image(cache, rng, region_id: int | None = None,
         blocks = img.block_cache.blocks
         if not blocks:
             return None
+        from ..copr.encoding import EncodedColumn
+
         for _ in range(64):  # retry until a corruptible cell is found
             bi = rng.randrange(len(blocks))
             blk = blocks[bi]
@@ -588,9 +593,31 @@ def corrupt_image(cache, rng, region_id: int | None = None,
                 continue
             ci = rng.randrange(len(blk.cols))
             col = blk.cols[ci]
+            if mode == "encoded" and not isinstance(col, EncodedColumn):
+                continue
             r = rng.randrange(blk.n_valid)
             if bool(np.asarray(col.nulls)[r]):
                 continue
+            if isinstance(col, EncodedColumn):
+                # flip the ENCODED payload bytes — the resident form the
+                # device actually serves (docs/compressed_columns.md); the
+                # materialized decode cache is purged so host consumers
+                # (deep scrub, late-materialize gathers) see the flip too
+                if col.kind == "bp":
+                    arr = col.packed
+                    arr[r] ^= np.asarray(
+                        1 << rng.randrange(max(arr.dtype.itemsize * 8 - 1, 1)),
+                        dtype=arr.dtype)
+                else:
+                    run = int(np.searchsorted(col.run_ends, r, side="right"))
+                    col.run_values[run] ^= np.int64(1) << np.int64(
+                        rng.randrange(63))
+                col.purge_decoded()
+                img.block_cache.drop_device()
+                # mode="block" over an encoded column IS an encoded flip —
+                # the payload is that column's resident block plane
+                return {"mode": mode, "region_id": key[0], "block": bi,
+                        "column": ci, "row": r, "kind": col.kind}
             data = col.data
             if col.is_dict_encoded:
                 dlen = len(col.dictionary)
