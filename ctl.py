@@ -128,6 +128,19 @@ def main(argv=None) -> int:
                     help="schedule a new round instead of reading results")
     sp.add_argument("--region", type=int, dest="cc_region", default=None,
                     help="narrow --trigger to one region")
+    sp = sub.add_parser(
+        "trace",
+        help="distributed tracing surface (docs/tracing.md): `trace list` "
+             "shows recent+slow traces (--addr), `trace show --trace-id T` "
+             "renders one trace's timeline (--addr), `trace set-sample-rate "
+             "R` reconfigures head sampling online (--status)")
+    sp.add_argument("action", choices=["list", "show", "set-sample-rate"])
+    sp.add_argument("rate", nargs="?", type=float, default=None,
+                    help="sample rate in [0,1] for set-sample-rate")
+    sp.add_argument("--trace-id", default=None, help="trace id for show")
+    sp.add_argument("--limit", type=int, default=20)
+    sp.add_argument("--slow", action="store_true",
+                    help="list only the slow/promoted ring")
     sub.add_parser("bad-regions")
     sub.add_parser("all-regions")
     sub.add_parser("metrics")
@@ -251,6 +264,26 @@ def main(argv=None) -> int:
             if rlog is not None:
                 rlog.close()
 
+    if args.cmd == "trace" and args.action == "set-sample-rate":
+        # runtime knob through the online-config controller (POST /config)
+        if not args.status:
+            print("--status required for set-sample-rate", file=sys.stderr)
+            return 2
+        if args.rate is None:
+            print("usage: trace set-sample-rate RATE", file=sys.stderr)
+            return 2
+        req = urllib.request.Request(
+            f"http://{args.status}/config",
+            data=json.dumps({"trace.sample_rate": args.rate}).encode(),
+            method="POST")
+        try:
+            print(urllib.request.urlopen(req).read().decode())
+        except urllib.error.HTTPError as e:
+            print(f"set-sample-rate rejected: {e.read().decode()}",
+                  file=sys.stderr)
+            return 1
+        return 0
+
     if args.cmd in ("metrics", "config", "reconfig"):
         if not args.status:
             print("--status required", file=sys.stderr)
@@ -297,6 +330,29 @@ def main(argv=None) -> int:
                 "kv_resolve_lock",
                 {"start_version": args.start_ts, "commit_version": args.commit_ts, "context": ctx},
             )
+        elif args.cmd == "trace":
+            from tikv_tpu.util.trace import timeline
+
+            if args.action == "show":
+                if not args.trace_id:
+                    print("trace show requires --trace-id", file=sys.stderr)
+                    return 2
+                r = c.call("debug_traces", {"trace_id": args.trace_id})
+                if "timeline" in r:
+                    print(r["timeline"])
+                    return 0
+            else:  # list
+                r = c.call("debug_traces", {"limit": args.limit})
+                if "error" not in r:
+                    rings = ("slow",) if args.slow else ("slow", "recent")
+                    print(f"sample_rate={r['sample_rate']} "
+                          f"slow_threshold_s={r['slow_threshold_s']} "
+                          f"live={r['live']}")
+                    for ring in rings:
+                        print(f"-- {ring} ({len(r[ring])}) --")
+                        for t in reversed(r[ring]):
+                            print(timeline(t))
+                    return 0
         elif args.cmd == "read-progress":
             req = {}
             if args.progress_region is not None:

@@ -32,6 +32,10 @@ echo "== integrity smoke: SDC scrubber + shadow reads + corruption chaos under t
 JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
   -m 'not slow' tests/test_integrity.py
 
+echo "== trace smoke: sampled request end-to-end span tree under the sanitizer =="
+JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
+  -m 'not slow' tests/test_tracing.py
+
 echo "== compressed-columns smoke: encoded residency, delta demotions, code-space rewrites under the sanitizer =="
 JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
   -m 'not slow' tests/test_encoding.py tests/test_compressed_columns.py

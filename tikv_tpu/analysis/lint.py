@@ -96,6 +96,7 @@ _SANITIZER_WIRED = {
     "tikv_tpu/server/read_plane.py",
     "tikv_tpu/util/chaos.py",
     "tikv_tpu/util/retry.py",
+    "tikv_tpu/util/trace.py",
     "tikv_tpu/util/worker.py",
 }
 

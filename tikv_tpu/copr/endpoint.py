@@ -15,6 +15,7 @@ from dataclasses import dataclass, field
 
 from ..storage.kv import Engine
 from ..storage.mvcc import Statistics
+from ..util import trace
 from . import jax_eval
 from .cache import ColumnBlockCache, CopCache
 from .dag import BatchExecutorsRunner, DagRequest, SelectResponse
@@ -189,8 +190,19 @@ class Endpoint:
             raise DeadlineExceeded("deadline expired before serving")
 
         t0 = _time.perf_counter()
-        resp = self._handle_request_inner(req)
-        md = resp.metrics or {}
+        with trace.span("copr.handle", tp=req.tp,
+                        region=(req.context or {}).get("region_id")) as sp:
+            resp = self._handle_request_inner(req)
+            md = resp.metrics or {}
+            if sp:
+                # the tracker's phase breakdown rides the request's span so
+                # the slow log and the trace tell one story (docs/tracing.md)
+                sp.tag(from_device=resp.from_device,
+                       from_cache=resp.from_cache,
+                       **{k: md[k] for k in
+                          ("schedule_wait_ms", "snapshot_ms", "handle_ms",
+                           "total_ms", "scanned_keys", "region_cache")
+                          if k in md})
         REGISTRY.counter(
             "tikv_coprocessor_request_total", "Coprocessor requests, by type/path"
         ).inc(tp=str(req.tp), path="device" if resp.from_device else "cpu")
@@ -223,7 +235,8 @@ class Endpoint:
             for start, end in req.ranges:
                 self.cm.read_range_check(Key.from_raw(start), Key.from_raw(end), req.start_ts)
         tracker.on_schedule()
-        snap = self.engine.snapshot(stale_read_ctx(req))
+        with trace.span("copr.snapshot"):
+            snap = self.engine.snapshot(stale_read_ctx(req))
         tracker.on_snapshot_finished()
         # follower stale serving (docs/stale_reads.md): the snapshot itself
         # says whether it came off the stale path — counted per serving
@@ -305,6 +318,9 @@ class Endpoint:
                 self.device_fallbacks += 1
                 self.last_device_error = repr(exc)
                 self.breaker.record_failure("unary")
+                cur = trace.current()
+                if cur is not None:
+                    cur.tag(device_fallback=repr(exc))
                 from ..util.metrics import REGISTRY
 
                 from .tracker import count_path_fallback
@@ -319,7 +335,8 @@ class Endpoint:
             return resp
         stats = Statistics()
         src = MvccScanSource(snap, req.start_ts, req.ranges, statistics=stats)
-        resp = BatchExecutorsRunner(req.dag, src).handle_request()
+        with trace.span("copr.cpu"):
+            resp = BatchExecutorsRunner(req.dag, src).handle_request()
         m = tracker.on_finish(scanned_keys=stats.write.processed_keys, from_device=False)
         self.slow_log.observe(tracker)
         if stale_snap:

@@ -43,6 +43,7 @@ jax.config.update("jax_enable_x64", True)
 import jax.numpy as jnp  # noqa: E402
 
 from ..analysis.sanitizer import note_blocking
+from ..util import trace
 from .aggr import AggDescriptor, AggState
 from .dag import (
     Aggregation,
@@ -1087,14 +1088,27 @@ class JaxDagEvaluator:
 
     def run(self, source: ScanSource, cache: "ColumnBlockCache | None" = None) -> SelectResponse:
         self._cache = cache
+        # first run of an evaluator traces+compiles its XLA programs; later
+        # runs reuse the jit caches — the tag separates compile cost from
+        # steady-state execute+pull in the trace timeline (docs/tracing.md)
+        first = not getattr(self, "_trace_ran", False)
+        self._trace_ran = True
+        if self.plan.agg is not None:
+            path = "agg_cached" if (cache is not None and cache.filled
+                                    and cache.blocks) else "agg"
+        elif self.topn_rpns:
+            path = "topn"
+        else:
+            path = "scan"
         try:
-            if self.plan.agg is not None:
-                if cache is not None and cache.filled and cache.blocks:
-                    return self._run_aggregated_cached(cache)
-                return self._run_aggregated(source)
-            if self.topn_rpns:
-                return self._run_topn(source)
-            return self._run_scan_filter(source)
+            with trace.span("device.run", path=path, first_call=first):
+                if self.plan.agg is not None:
+                    if cache is not None and cache.filled and cache.blocks:
+                        return self._run_aggregated_cached(cache)
+                    return self._run_aggregated(source)
+                if self.topn_rpns:
+                    return self._run_topn(source)
+                return self._run_scan_filter(source)
         finally:
             self._cache = None
 
@@ -1723,8 +1737,9 @@ class XRegionPending:
         byte-identical to per-request serving."""
         ev = self._ev
         int_m, flt_m = self._packed
-        int_np = np.asarray(int_m)
-        flt_np = np.asarray(flt_m) if flt_m.shape[1] else None
+        with trace.span("device.pull", regions=len(self._specs)):
+            int_np = np.asarray(int_m)
+            flt_np = np.asarray(flt_m) if flt_m.shape[1] else None
         template = ev._host_state_template()
         out = []
         for r, (dicts, dict_lens, n_slots) in enumerate(self._specs):
@@ -1917,7 +1932,11 @@ def launch_xregion_cached(ev: "JaxDagEvaluator", caches) -> XRegionPending:
         while len(xkeys) > 16:
             ev._agg_fn_cache.pop(xkeys.pop(0))
 
-    packed = fn(tuple(region_inputs), dl_arr, refs_arr)
+    # the async dispatch itself; the encoded-path decision batch_plan made
+    # (and counted) rides the trace as a tag (docs/tracing.md)
+    with trace.span("device.launch", kind="xregion", regions=len(caches),
+                    encoding="encoded" if plans else "decoded"):
+        packed = fn(tuple(region_inputs), dl_arr, refs_arr)
     return XRegionPending(ev, specs, capacity, packed, order)
 
 

@@ -57,6 +57,7 @@ import time
 from dataclasses import dataclass, field
 
 from ..analysis.sanitizer import make_condition, make_lock
+from ..util import trace
 from ..util.retry import DeadlineExceeded, ServerBusyError, deadline_from_context
 from . import jax_eval
 from .dag import (
@@ -169,6 +170,11 @@ class _Item:
     # absolute monotonic deadline (context "deadline"/"timeout_ms", see
     # util.retry.deadline_from_context); expired items shed BEFORE dispatch
     deadline: float | None = None
+    # trace handoff (docs/tracing.md): the submitting thread's span context,
+    # so dispatcher-side work lands in the request's own trace; batch_ref
+    # names the shared device-dispatch span the item coalesced into
+    trace_ctx: dict | None = None
+    batch_ref: str | None = None
 
 
 class _Ticket:
@@ -221,9 +227,10 @@ class CoprReadScheduler:
     # -- synchronous entry (endpoint.handle_batch / batch_coprocessor) -----
 
     def run_batch(self, reqs: list[CoprRequest], *, return_errors: bool = False):
+        tctx = trace.current_context()
         items = [
             _Item(req=r, index=i, lane=_lane_of(r),
-                  deadline=deadline_from_context(r.context))
+                  deadline=deadline_from_context(r.context), trace_ctx=tctx)
             for i, r in enumerate(reqs)
         ]
         results, errors = self._serve(items)
@@ -290,54 +297,68 @@ class CoprReadScheduler:
             return self.ep.handle_request(req)
         item = _Item(req=req, index=0, lane=_lane_of(req), ticket=_Ticket(),
                      enqueue_t=time.perf_counter(), deadline=deadline)
-        with self._mu:
-            # re-check under the lock: a stop() racing this enqueue drains
-            # the queues once — anything appended after that drain would
-            # never be served and the caller would block forever
-            if not self._running:
-                do_direct = True
-            elif sum(len(q) for q in self._queues.values()) >= self.cfg.max_queue:
-                if self.cfg.busy_reject:
-                    # ServerIsBusy with a drain hint: the retry policy
-                    # (util.retry) sleeps at least retry_after_s before the
-                    # request comes back — backpressure instead of serving
-                    # extra work on a saturated store.  Counted under its
-                    # own reason: "queue_full" means served on the direct
-                    # path, and a rejection is neither served nor direct
-                    self._count_shed("busy_reject")
-                    self._count_coalesce("busy_reject")
-                    raise ServerBusyError(
-                        "coprocessor scheduler queue is full",
-                        retry_after_s=self.cfg.busy_retry_after_s,
-                    )
-                self._count_shed("queue_full")
-                do_direct = True
-            else:
-                do_direct = False
-                self._queues[item.lane].append(item)
-                self._gauge_depth()
-                self._mu.notify_all()
-        if do_direct:
-            self._count_coalesce("queue_full")
-            return self.ep.handle_request(req)
-        item.ticket.done.wait(timeout)
-        if not item.ticket.done.is_set():
-            raise TimeoutError("scheduler did not serve the request in time")
-        if item.ticket.direct:
-            # the dispatcher shed this request back: serve it on OUR thread
-            # so one slow per-request path cannot stall every lane — unless
-            # its deadline ran out while it waited
-            if deadline is not None and time.monotonic() >= deadline:
-                self._count_deadline("direct")
-                raise DeadlineExceeded("deadline expired before direct serve")
-            self._count_coalesce("direct")
-            return self.ep.handle_request(req)
-        if item.ticket.error is not None:
-            raise item.ticket.error
-        # served out of a dispatcher micro-batch: the wire-path coalescing
-        # outcome the cluster bench floors on (docs/wire_path.md)
-        self._count_coalesce("batched")
-        return item.ticket.resp
+        # queue-lane span (docs/tracing.md): covers enqueue→batch-completion
+        # on the submitting thread; the dispatcher stamps dispatcher-side
+        # spans into this trace via the captured context
+        with trace.span("sched.queue", lane=item.lane) as sp:
+            item.trace_ctx = sp.context if sp else None
+            with self._mu:
+                # re-check under the lock: a stop() racing this enqueue drains
+                # the queues once — anything appended after that drain would
+                # never be served and the caller would block forever
+                if not self._running:
+                    do_direct = True
+                elif sum(len(q) for q in self._queues.values()) >= self.cfg.max_queue:
+                    if self.cfg.busy_reject:
+                        # ServerIsBusy with a drain hint: the retry policy
+                        # (util.retry) sleeps at least retry_after_s before the
+                        # request comes back — backpressure instead of serving
+                        # extra work on a saturated store.  Counted under its
+                        # own reason: "queue_full" means served on the direct
+                        # path, and a rejection is neither served nor direct
+                        self._count_shed("busy_reject")
+                        self._count_coalesce("busy_reject")
+                        sp.tag(outcome="busy_reject")
+                        raise ServerBusyError(
+                            "coprocessor scheduler queue is full",
+                            retry_after_s=self.cfg.busy_retry_after_s,
+                        )
+                    self._count_shed("queue_full")
+                    do_direct = True
+                else:
+                    do_direct = False
+                    self._queues[item.lane].append(item)
+                    self._gauge_depth()
+                    self._mu.notify_all()
+            if do_direct:
+                self._count_coalesce("queue_full")
+                sp.tag(outcome="queue_full")
+                return self.ep.handle_request(req)
+            item.ticket.done.wait(timeout)
+            if not item.ticket.done.is_set():
+                sp.tag(outcome="timeout")
+                raise TimeoutError("scheduler did not serve the request in time")
+            if item.ticket.direct:
+                # the dispatcher shed this request back: serve it on OUR thread
+                # so one slow per-request path cannot stall every lane — unless
+                # its deadline ran out while it waited
+                if deadline is not None and time.monotonic() >= deadline:
+                    self._count_deadline("direct")
+                    sp.tag(outcome="deadline")
+                    raise DeadlineExceeded("deadline expired before direct serve")
+                self._count_coalesce("direct")
+                sp.tag(outcome="direct")
+                return self.ep.handle_request(req)
+            if item.ticket.error is not None:
+                sp.tag(outcome="error")
+                raise item.ticket.error
+            # served out of a dispatcher micro-batch: the wire-path coalescing
+            # outcome the cluster bench floors on (docs/wire_path.md)
+            self._count_coalesce("batched")
+            sp.tag(outcome="batched")
+            if item.batch_ref is not None:
+                sp.link("batched_into", item.batch_ref)
+            return item.ticket.resp
 
     def _dispatch_loop(self) -> None:
         cfg = self.cfg
@@ -599,7 +620,8 @@ class CoprReadScheduler:
             # normal per-request path (and keeps its own answer); the rest
             # of the slot then serves from the filled blocks
             filler = slot.items[0]
-            resp = self.ep.handle_request(filler.req)
+            with trace.attach(filler.trace_ctx):
+                resp = self.ep.handle_request(filler.req)
             self._stamp(resp, filler, kind="fill", occupancy=1)
             filler._filled_resp = resp  # type: ignore[attr-defined]
             if not cache.filled or not cache.blocks:
@@ -708,6 +730,18 @@ class CoprReadScheduler:
         n_reqs = max(n_batch - n_filled, 1)
         kind = "xregion" if mesh is None else "xregion_sharded"
         waste = self._padding_waste(live) if mesh is None else sh_waste
+        # fan-in linkage (docs/tracing.md): ONE device-dispatch span — its
+        # own one-span trace naming every participating parent trace — and
+        # each rider links back to it.  A shared dispatch can't be a child
+        # of N parents; this is the honest shape for shared-slot serving.
+        riders = [it for s in live for it in s.items]
+        bsp = trace.fanin_span(
+            "sched.device_dispatch", [it.trace_ctx for it in riders],
+            kind=kind, regions=len(live), occupancy=len(riders))
+        if bsp:
+            ref = f"{bsp.rec.trace_id}:{bsp.span_id}"
+            for it in riders:
+                it.batch_ref = ref
         t0 = time.perf_counter()
         try:
             # the batch's region images carry their ENCODING DESCRIPTORS on
@@ -716,22 +750,25 @@ class CoprReadScheduler:
             # when every region agrees on one signature, and decode-ship
             # (counted per-cause) when not — sharded and fused paths stay
             # eligible for compressed-resident regions either way
-            if mesh is not None:
-                pending = jax_eval.launch_xregion_sharded(
-                    ev, [s.cache for s in live], mesh)
-            else:
-                pending = jax_eval.launch_xregion_cached(
-                    ev, [s.cache for s in live])
+            with bsp.active():
+                if mesh is not None:
+                    pending = jax_eval.launch_xregion_sharded(
+                        ev, [s.cache for s in live], mesh)
+                else:
+                    pending = jax_eval.launch_xregion_cached(
+                        ev, [s.cache for s in live])
         except ValueError:
             # "not batchable" (empty blocks, unstable dictionaries) is a
             # documented decline, not a device failure — shed without
             # polluting the fallback counter
             breaker.release_probe(path)
+            bsp.tag(outcome="ineligible").finish()
             for slot in live:
                 self._shed(slot, "ineligible", results, errors)
             return None
         except Exception as exc:  # noqa: BLE001 — CPU pipeline is the oracle
             self._device_failed(exc, path)
+            bsp.tag(outcome="device_error").finish()
             for slot in live:
                 self._shed(slot, "device_error", results, errors)
             return None
@@ -740,9 +777,11 @@ class CoprReadScheduler:
         def finalize(results, errors):
             t_fin = time.perf_counter()
             try:
-                resps = pending.finalize()
+                with bsp.active():
+                    resps = pending.finalize()
             except Exception as exc:  # noqa: BLE001
                 self._device_failed(exc, path)
+                bsp.tag(outcome="device_error").finish()
                 for slot in live:
                     self._shed(slot, "device_error", results, errors)
                 return
@@ -755,6 +794,18 @@ class CoprReadScheduler:
             # inflate the device-path percentiles with unrelated host work.
             dt = (t_launched - t0) + pull_dt
             self._batch_metrics(kind, n_reqs, dt, waste, n_batch=n_batch)
+            if bsp:
+                bsp.tag(outcome="ok", launch_ms=round((t_launched - t0) * 1e3, 3),
+                        pull_ms=round(pull_dt * 1e3, 3))
+                bsp.finish()
+                # each rider's trace gets a span for the shared dispatch it
+                # rode, linked to the dispatch span's own trace
+                for it in riders:
+                    # batch_ref was already stamped at fanin-span creation
+                    trace.remote_span(it.trace_ctx, "sched.batched",
+                                      start=t0, end=t_fin + pull_dt,
+                                      batched_into=ref, kind=kind,
+                                      occupancy=n_batch)
             if mesh is not None:
                 self._sharded_metrics(device_load, pull_dt)
             for slot, resp in zip(live, resps):
@@ -815,6 +866,9 @@ class CoprReadScheduler:
         uniq: dict[tuple, list[_Item]] = {}
         for it in todo:
             uniq.setdefault(it.sig, []).append(it)
+        bsp = trace.fanin_span(
+            "sched.device_dispatch", [it.trace_ctx for it in todo],
+            kind="fused", plans=len(uniq), occupancy=len(todo))
         t0 = time.perf_counter()
         try:
             evs = [self._evaluator_for(sig, group[0].req.dag)
@@ -824,16 +878,26 @@ class CoprReadScheduler:
             # a documented decline (non-stable group dictionaries, empty
             # cache) — per-request path, no device-failure attribution
             self.ep.breaker.release_probe("fused")
+            bsp.tag(outcome="ineligible").finish()
             self._shed(_Slot(items=todo), "ineligible", results, errors)
             return None
         except Exception as exc:  # noqa: BLE001
             # _resolve_slot guarantees a filled cache here, so there is no
             # partial fill to clean up (the cold-fill path owns that)
             self._device_failed(exc, "fused")
+            bsp.tag(outcome="device_error").finish()
             self._shed(_Slot(items=todo), "device_error", results, errors)
             return None
         self.ep.breaker.record_success("fused")
         dt = time.perf_counter() - t0
+        if bsp:
+            ref = f"{bsp.rec.trace_id}:{bsp.span_id}"
+            bsp.tag(outcome="ok").finish()
+            for it in todo:
+                trace.remote_span(it.trace_ctx, "sched.batched", start=t0,
+                                  end=t0 + dt, batched_into=ref,
+                                  kind="fused", occupancy=n_reqs)
+                it.batch_ref = ref
         self._batch_metrics("fused", n_reqs, dt, 0.0, n_batch=len(items))
         if slot.shadow_snap is not None:
             groups = list(uniq.values())
@@ -960,7 +1024,10 @@ class CoprReadScheduler:
             it.ticket.done.set()
             return
         try:
-            resp = self.ep.handle_request(it.req)
+            # explicit pool-boundary handoff: the dispatcher serves this on
+            # the rider's behalf, so its spans land in the rider's trace
+            with trace.attach(it.trace_ctx):
+                resp = self.ep.handle_request(it.req)
         except BaseException as exc:  # noqa: BLE001 — delivered per item
             errors[it.index] = exc
             return

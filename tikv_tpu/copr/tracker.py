@@ -14,6 +14,8 @@ import json
 import time
 from dataclasses import dataclass, field
 
+from ..util import trace
+
 
 @dataclass
 class TrackedMetrics:
@@ -82,10 +84,14 @@ def stamp_sched(md: dict | None, lane: str, kind: str, occupancy: int,
 
 
 class Tracker:
-    """Phase stopwatch for one request."""
+    """Phase stopwatch for one request.  Captures the active trace id at
+    construction so slow-log entries pivot straight to their trace
+    (docs/tracing.md): ``/debug/traces`` + ``ctl.py trace show`` answer
+    "WHERE was this slow request slow" for any logged tag."""
 
     def __init__(self, req_tag: str = ""):
         self.req_tag = req_tag
+        self.trace_id = trace.current_trace_id()
         self.metrics = TrackedMetrics()
         self._created = time.perf_counter()
         self._phase_start = self._created
@@ -125,7 +131,17 @@ class SlowLog:
     def observe(self, tracker: Tracker) -> bool:
         if tracker.metrics.total_s < self.threshold_s:
             return False
-        entry = {"tag": tracker.req_tag, **tracker.metrics.to_dict()}
+        extra = {}
+        if getattr(tracker, "trace_id", None):
+            extra["trace_id"] = tracker.trace_id
+        return self.record(tracker.req_tag,
+                           {**tracker.metrics.to_dict(), **extra})
+
+    def record(self, tag: str, fields: dict) -> bool:
+        """Append one slow entry unconditionally — the generic sink the
+        txn scheduler's write slow-log shares with the coprocessor path
+        (same ring, same JSON-line file format)."""
+        entry = {"tag": tag, **fields}
         with self._mu:
             self.entries.append(entry)
             if len(self.entries) > self.capacity:

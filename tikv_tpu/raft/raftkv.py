@@ -275,13 +275,29 @@ class RaftKv(Engine):
         }
         done = threading.Event()
         result: list = []
+        # propose→apply span handle (docs/tracing.md): begun at propose on
+        # the caller's thread, FINISHED inside the write callback — which
+        # fires on the apply pipeline's thread, so the span's duration is
+        # the true replicate+apply time, not the caller's ack-wait.  The
+        # tracer lock is a leaf: finishing under apply locks is safe.
+        from ..util import trace
+
+        sp = trace.begin("raft.propose_apply", region=peer.region.id,
+                         ops=len(ops))
 
         def cb(r):
+            sp.finish()
             result.append(r)
             done.set()
 
-        peer.propose_cmd(cmd, cb)
-        self._pump_until(done, peer.region.id)
+        try:
+            peer.propose_cmd(cmd, cb)
+            self._pump_until(done, peer.region.id)
+        finally:
+            if not done.is_set():
+                # timeout/propose failure: the callback will never fire —
+                # close the handle so the trace record cannot leak open
+                sp.tag(error="propose_incomplete").finish()
         r = result[0]
         if isinstance(r, Exception):
             raise r

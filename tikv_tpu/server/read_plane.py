@@ -33,6 +33,7 @@ from __future__ import annotations
 import time
 
 from ..analysis.sanitizer import make_lock
+from ..util import trace
 from ..util.metrics import REGISTRY
 
 #: per-store forward breaker: first-failure cooldown and the exponential
@@ -136,25 +137,34 @@ class ReadPlane:
         fctx.setdefault("stale_fallback", True)
         freq = dict(req)
         freq["context"] = fctx
-        try:
-            r = self.call(owner, method, freq)
-        except TimeoutError:
-            self._record_failure(owner)
-            _count_owner_forward("timeout")
-            return None
-        except Exception:  # noqa: BLE001 — no route / conn refused / reset
-            self._record_failure(owner)
-            _count_owner_forward("error")
-            return None
-        self._record_success(owner)
-        err = r.get("error") if isinstance(r, dict) else None
-        if isinstance(err, dict):
-            # the owner refused (NotLeader chain exhausted, watermark lag,
-            # busy): local CPU serving still yields correct bytes
-            _count_owner_forward("remote_region_error")
-            return None
-        _count_owner_forward("ok")
-        return r
+        with trace.span("ladder.owner_forward", target_store=owner,
+                        store=self.store_id or "") as sp:
+            # propagate the trace across the hop: the owner's RPC span
+            # parents onto THIS forward span (the current span here)
+            trace.inject(fctx)
+            try:
+                r = self.call(owner, method, freq)
+            except TimeoutError:
+                self._record_failure(owner)
+                _count_owner_forward("timeout")
+                sp.tag(outcome="timeout")
+                return None
+            except Exception:  # noqa: BLE001 — no route / conn refused / reset
+                self._record_failure(owner)
+                _count_owner_forward("error")
+                sp.tag(outcome="error")
+                return None
+            self._record_success(owner)
+            err = r.get("error") if isinstance(r, dict) else None
+            if isinstance(err, dict):
+                # the owner refused (NotLeader chain exhausted, watermark lag,
+                # busy): local CPU serving still yields correct bytes
+                _count_owner_forward("remote_region_error")
+                sp.tag(outcome="remote_region_error")
+                return None
+            _count_owner_forward("ok")
+            sp.tag(outcome="ok")
+            return r
 
     # -- transport ----------------------------------------------------------
 
@@ -328,18 +338,26 @@ class ReadPlane:
         fctx["forwarded"] = True
         freq = dict(req)
         freq["context"] = fctx
-        try:
-            r = self.call(leader, method, freq)
-        except TimeoutError:
-            self._record_failure(leader)
-            _count_forward("timeout")
-            return None
-        except Exception:  # noqa: BLE001 — no route / conn refused / reset
-            self._record_failure(leader)
-            _count_forward("error")
-            return None
-        self._record_success(leader)
-        return r
+        with trace.span("ladder.forward", target_store=leader,
+                        store=self.store_id or "") as sp:
+            # the hop rides the SAME trace (docs/tracing.md): the leader's
+            # RPC span parents onto this forward span (the current span)
+            trace.inject(fctx)
+            try:
+                r = self.call(leader, method, freq)
+            except TimeoutError:
+                self._record_failure(leader)
+                _count_forward("timeout")
+                sp.tag(outcome="timeout")
+                return None
+            except Exception:  # noqa: BLE001 — no route / conn refused / reset
+                self._record_failure(leader)
+                _count_forward("error")
+                sp.tag(outcome="error")
+                return None
+            self._record_success(leader)
+            sp.tag(outcome="ok")
+            return r
 
     def _stale_fallback(self, method: str, req: dict, resp: dict, local,
                         region_id, cause: str):
@@ -368,11 +386,15 @@ class ReadPlane:
         sctx.pop("replica_read", None)
         sreq = dict(req)
         sreq["context"] = sctx
-        r = local(sreq)
-        rerr = r.get("error") if isinstance(r, dict) else None
-        if not rerr:
-            _count_stale_serve(_path_of(method), cause)
-            return r
+        with trace.span("ladder.stale_serve", cause=cause,
+                        store=self.store_id or "") as sp:
+            r = local(sreq)
+            rerr = r.get("error") if isinstance(r, dict) else None
+            if not rerr:
+                _count_stale_serve(_path_of(method), cause)
+                sp.tag(outcome="served")
+                return r
+            sp.tag(outcome="refused")
         if isinstance(rerr, dict) and "data_not_ready" in rerr:
             return self._refuse(r, region_id, "data_not_ready")
         return self._refuse(resp, region_id, "stale_failed")
@@ -384,6 +406,10 @@ class ReadPlane:
         the freshest leader hint, this store's ``safe_ts`` floor, and the
         region's progress pair."""
         _count_refuse(cause)
+        cur = trace.current()
+        if cur is not None:
+            # refusal leaves a mark on the trace even though no rung served
+            cur.tag(ladder_refused=cause)
         err = resp.get("error") if isinstance(resp, dict) else None
         if not isinstance(err, dict):
             return resp

@@ -18,7 +18,7 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
-from ..util import error_code
+from ..util import error_code, trace
 from ..util.metrics import REGISTRY
 from ..util.worker import TaskPriority, UnifiedReadPool
 from . import wire
@@ -162,6 +162,27 @@ class Server:
                 self._pb_gateway_inst = PbGateway(self.service)
             return self._pb_gateway_inst
 
+    def _trace_root(self, method: str, request, t_dec: float, t_dec_end: float):
+        """The request's root span, spanning decode→encode (docs/tracing.md):
+        joins the trace the request context carries (forwarded hops and
+        client-held traces propagate over the wire as plain context keys) or
+        head-samples a fresh one.  The frame-decode stage — measured before
+        any span could exist — lands as an explicitly-timed child."""
+        ctx = None
+        if isinstance(request, dict):
+            c = request.get("context")
+            if isinstance(c, dict) and c.get("trace_id"):
+                ctx = c
+        if ctx is None and not trace.enabled():
+            return trace.NOOP
+        root = trace.start_trace(
+            f"rpc.{method}", ctx=ctx, start=t_dec, method=method,
+            store=getattr(getattr(self.service, "read_plane", None),
+                          "store_id", None) or "")
+        if root:
+            root.record("wire.decode", t_dec, t_dec_end)
+        return root
+
     @property
     def read_pool(self) -> UnifiedReadPool:
         with self._read_pool_mu:
@@ -215,7 +236,8 @@ class Server:
                     return
                 t_dec = time.perf_counter()
                 req_id, method, request = wire.loads(frame)
-                WIRE_STAGE.observe(time.perf_counter() - t_dec, stage="decode")
+                t_dec_end = time.perf_counter()
+                WIRE_STAGE.observe(t_dec_end - t_dec, stage="decode")
 
                 if method == "_stream_ack":
                     sem = stream_credits.get(request.get("id"))
@@ -247,19 +269,33 @@ class Server:
                     continue
 
                 t_submit = time.perf_counter()
+                # request-root span (docs/tracing.md): joins the trace the
+                # context carries (forwarded hops, client-initiated traces)
+                # or head-samples a fresh one; the wire stages land as child
+                # spans mirroring the WIRE_STAGE histogram.  One branch and
+                # no allocation when tracing is off and no ctx carries a
+                # trace id.
+                root = self._trace_root(method, request, t_dec, t_dec_end)
 
                 def run(req_id=req_id, method=method, request=request,
-                        t_submit=t_submit):
+                        t_submit=t_submit, root=root, t_dec_end=t_dec_end):
                     t0 = time.perf_counter()
                     # route = pool queue wait: submission to handler start
                     WIRE_STAGE.observe(t0 - t_submit, stage="route")
+                    if root:
+                        # the span tiles the root exactly: decode-end to
+                        # handler start is ALL routing overhead (trace/
+                        # closure bookkeeping + pool queue wait), so the
+                        # stage spans account for the whole request
+                        root.record("wire.route", t_dec_end, t0)
                     try:
-                        if method.startswith("pb/"):
-                            # kvproto mode: request/response are protobuf
-                            # bytes (pb_gateway), framing unchanged
-                            resp = self._pb_gateway().handle(method[3:], request)
-                        else:
-                            resp = self.service.dispatch(method, request)
+                        with root.active(), trace.span("wire.execute"):
+                            if method.startswith("pb/"):
+                                # kvproto mode: request/response are protobuf
+                                # bytes (pb_gateway), framing unchanged
+                                resp = self._pb_gateway().handle(method[3:], request)
+                            else:
+                                resp = self.service.dispatch(method, request)
                     except Exception as e:  # noqa: BLE001 — wire boundary
                         resp = {"error": {"other": repr(e), "code": error_code.code_of(e)}}
                     GRPC_MSG_TOTAL.inc(method=method)
@@ -268,6 +304,14 @@ class Server:
                     WIRE_STAGE.observe(t_done - t0, stage="execute")
                     if isinstance(resp, dict) and resp.get("error"):
                         GRPC_MSG_FAIL.inc(method=method)
+                    if inspect.isgenerator(resp) and root:
+                        # streaming responses finish the root HERE: the
+                        # per-frame credit loop below has early-return paths
+                        # (consumer gone/cancelled) that must not leak an
+                        # open trace record
+                        root.tag(streaming=True)
+                        root.finish()
+                        root = trace.NOOP
                     if inspect.isgenerator(resp):
                         # server-streaming response (endpoint.rs:508): one
                         # wire frame per yielded item, same req_id, closed by
@@ -321,8 +365,13 @@ class Server:
                             write_frame_parts(conn, parts)
                         except OSError:
                             pass
-                    WIRE_STAGE.observe(time.perf_counter() - t_enc,
-                                       stage="encode")
+                    t_enc_end = time.perf_counter()
+                    WIRE_STAGE.observe(t_enc_end - t_enc, stage="encode")
+                    if root:
+                        # execute-end to send-done: response assembly +
+                        # frame write (tiles the root, see wire.route)
+                        root.record("wire.encode", t_done, t_enc_end)
+                        root.finish(end=t_enc_end)
 
                 if method.removeprefix("pb/") in _READ_METHODS:
                     ctx, group = {}, id(conn)
@@ -347,11 +396,13 @@ class Server:
                     try:
                         self.read_pool.submit(run, group=group, priority=prio)
                     except RuntimeError:  # pool/server stopped mid-shutdown
+                        root.finish()
                         return
                 else:
                     try:
                         self._pool.submit(run)
                     except RuntimeError:  # executor shut down mid-frame
+                        root.finish()
                         return
         except (ConnectionError, ValueError, OSError):
             pass

@@ -990,6 +990,20 @@ class KvService:
             }
         return {"stages": stages}
 
+    def debug_traces(self, req: dict) -> dict:
+        """Recent + slow traces from the process tracer (docs/tracing.md):
+        the ``ctl.py trace`` surface.  ``trace_id`` narrows to one trace;
+        ``limit`` bounds the rings returned."""
+        from ..util import trace
+
+        tid = req.get("trace_id")
+        if tid:
+            t = trace.TRACER.get(tid)
+            if t is None:
+                return {"error": {"other": f"trace {tid!r} not found"}}
+            return {"trace": t, "timeline": trace.timeline(t)}
+        return trace.snapshot(limit=int(req.get("limit", 20)))
+
     def get_lock_wait_info(self, req: dict) -> dict:
         """Current pessimistic lock waits (kv.rs:1061): who waits on whom."""
         if self.lock_manager is None:

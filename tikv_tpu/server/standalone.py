@@ -294,10 +294,18 @@ class StoreServer:
         # /debug/pprof/*, /debug/memory (the attribution tree above)
         from .status_server import StatusServer
 
-        from ..util.config import ConfigController, CoprocessorConfig, TikvConfig
+        from ..util import trace
+        from ..util.config import (
+            ConfigController, CoprocessorConfig, TikvConfig, TraceConfig,
+        )
 
         self.config_controller = ConfigController(
-            TikvConfig(coprocessor=CoprocessorConfig(enable_device=enable_device))
+            TikvConfig(
+                coprocessor=CoprocessorConfig(enable_device=enable_device),
+                # reflect the live tracer (env-seeded) so /config reads true
+                trace=TraceConfig(sample_rate=trace.sample_rate(),
+                                  slow_threshold_s=trace.slow_threshold()),
+            )
         )
         # online device knob: POST /config {"coprocessor.enable_device": x}
         self.config_controller.register(
@@ -305,6 +313,16 @@ class StoreServer:
             lambda changed: self.copr.set_enable_device(changed["enable_device"])
             if "enable_device" in changed else None,
         )
+        # online tracing knobs (docs/tracing.md): POST /config
+        # {"trace.sample_rate": r} — the ctl.py `trace set-sample-rate` path
+
+        def _trace_changed(changed: dict) -> None:
+            if "sample_rate" in changed:
+                trace.set_sample_rate(changed["sample_rate"])
+            if "slow_threshold_s" in changed:
+                trace.set_slow_threshold(changed["slow_threshold_s"])
+
+        self.config_controller.register("trace", _trace_changed)
         self.status_server = StatusServer(
             controller=self.config_controller,
             security=security, memory_trace=self.memory_trace,
@@ -510,6 +528,10 @@ def main(argv=None) -> int:
                     help="shadow-read 1-in-N sampling of warm device serves "
                          "(default 256 or TIKV_TPU_SHADOW_SAMPLE; 0 "
                          "disables, 1 verifies every warm serve)")
+    ap.add_argument("--trace-sample", type=float, default=None,
+                    help="distributed-tracing head sample rate in [0,1] "
+                         "(default 0.01 or TIKV_TPU_TRACE_SAMPLE; 0 turns "
+                         "the tracing plane off; docs/tracing.md)")
     ap.add_argument("--no-raft-engine", action="store_true",
                     help="keep the raft log in CF_RAFT instead of the segmented log engine")
     ap.add_argument("--ca-path", default="")
@@ -525,6 +547,10 @@ def main(argv=None) -> int:
     from ..util import logger as slog
     from .security import SecurityConfig
 
+    if args.trace_sample is not None:
+        from ..util import trace as _trace
+
+        _trace.set_sample_rate(args.trace_sample)
     slog.set_redact_info_log(args.redact_info_log)
     security = SecurityConfig(
         ca_path=args.ca_path, cert_path=args.cert_path, key_path=args.key_path

@@ -58,6 +58,44 @@ class StatusServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _serve_traces(self, url):
+                from ..util import trace
+
+                q = parse_qs(url.query)
+                tid = q.get("trace_id", [None])[0]
+                as_json = q.get("format", [""])[0] == "json"
+                try:
+                    limit = int(q.get("limit", ["20"])[0])
+                except ValueError:
+                    self._send(400, b"limit must be an integer")
+                    return
+                if tid:
+                    t = trace.TRACER.get(tid)
+                    if t is None:
+                        self._send(404, f"trace {tid} not found".encode())
+                        return
+                    if as_json:
+                        self._send(200, json.dumps(t).encode(),
+                                   "application/json")
+                    else:
+                        self._send(200, trace.timeline(t).encode())
+                    return
+                snap = trace.snapshot(limit=limit)
+                if as_json:
+                    self._send(200, json.dumps(snap).encode(),
+                               "application/json")
+                    return
+                lines = [
+                    f"sample_rate={snap['sample_rate']} "
+                    f"slow_threshold_s={snap['slow_threshold_s']} "
+                    f"live={snap['live']}",
+                ]
+                for ring in ("slow", "recent"):
+                    lines.append(f"-- {ring} ({len(snap[ring])}) --")
+                    for t in reversed(snap[ring]):
+                        lines.append(trace.timeline(t))
+                self._send(200, "\n".join(lines).encode())
+
             def do_GET(self):
                 url = urlparse(self.path)
                 if url.path == "/metrics":
@@ -82,6 +120,11 @@ class StatusServer:
                         return
                     ctype = "application/octet-stream" if raw else "text/plain"
                     self._send(200, body, ctype)
+                elif url.path == "/debug/traces":
+                    # recent + slow request traces (docs/tracing.md): the
+                    # indented-timeline text view by default, the raw trace
+                    # dicts with ?format=json, one trace with ?trace_id=
+                    self._serve_traces(url)
                 elif url.path == "/debug/read_progress":
                     # per-region RegionReadProgress + store safe_ts: why a
                     # follower refuses stale reads (docs/stale_reads.md)

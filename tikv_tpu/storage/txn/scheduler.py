@@ -31,8 +31,10 @@ import threading
 from collections import deque
 from dataclasses import dataclass, field
 
+import time
+
 from ...analysis.sanitizer import make_condition, make_lock
-from ...util import error_code
+from ...util import error_code, trace
 from ...util.failpoint import fail_point
 from ...util.metrics import REGISTRY
 from ..engine import WriteBatch
@@ -83,6 +85,11 @@ class _Task:
     # a worker's _execute or shutdown's _fail_task gets the task first; the
     # loser must not touch latches/_inflight again
     claimed: bool = False
+    # write-path observability (docs/tracing.md): submission time anchors
+    # the latch/queue-wait phase; trace_ctx hands the submitter's span to
+    # the worker thread that executes the command
+    submit_t: float = 0.0
+    trace_ctx: dict | None = None
 
 
 class Scheduler:
@@ -94,9 +101,20 @@ class Scheduler:
         pool_size: int = 4,
         pending_write_threshold: int = 256,
         group_commit_max: int = 16,
+        slow_log=None,
     ):
         self.engine = engine
         self.latches = Latches(latch_slots)
+        # write-path slow log (docs/tracing.md): slow txn commands land in
+        # the same JSON-line sink shape as the coprocessor's SlowLog, with
+        # the latch-wait / process / propose→apply phase breakdown and the
+        # request's trace id.  copr.tracker imports only stdlib, so the
+        # lazy import cannot cycle back into storage.
+        if slow_log is None:
+            from ...copr.tracker import SlowLog
+
+            slow_log = SlowLog()
+        self.slow_log = slow_log
         self.cm = concurrency_manager
         self.pool_size = pool_size
         self.pending_write_threshold = pending_write_threshold
@@ -147,6 +165,8 @@ class Scheduler:
         try:
             cid = self.latches.gen_cid()
             task = _Task(cmd, ctx, cid, high)
+            task.submit_t = time.perf_counter()
+            task.trace_ctx = trace.current_context()
             # slots go on the task BEFORE the latch table sees it: a parked
             # task can be woken and executed the moment acquire publishes it,
             # and release() needs task.slots populated by then
@@ -250,20 +270,32 @@ class Scheduler:
         return picked
 
     def _execute(self, task: _Task) -> None:
+        t_claim = time.perf_counter()
+        propose_s = 0.0
         try:
-            fail_point("scheduler_async_snapshot")
-            snapshot = self.engine.snapshot(task.ctx)
-            txn, result = task.cmd.process_write(snapshot)
-            fail_point("scheduler_before_write")
-            if not txn.is_empty():
-                # observed per actual engine write: the histogram's count IS
-                # the raft-proposal rate, its mean the amortization factor
-                _SCHED_GROUP_SIZE.observe(1)
-                self.engine.write(task.ctx, txn.wb)
+            # pool-boundary handoff: worker-side phases land in the
+            # submitting request's trace (docs/tracing.md)
+            with trace.attach(task.trace_ctx):
+                trace.record("txn.latch_wait", task.submit_t, t_claim,
+                             cmd=type(task.cmd).__name__)
+                fail_point("scheduler_async_snapshot")
+                with trace.span("txn.process_write"):
+                    snapshot = self.engine.snapshot(task.ctx)
+                    txn, result = task.cmd.process_write(snapshot)
+                t_proc = time.perf_counter()
+                fail_point("scheduler_before_write")
+                if not txn.is_empty():
+                    # observed per actual engine write: the histogram's count
+                    # IS the raft-proposal rate, its mean the amortization
+                    # factor
+                    _SCHED_GROUP_SIZE.observe(1)
+                    self.engine.write(task.ctx, txn.wb)
+                    propose_s = time.perf_counter() - t_proc
             task.result = result
         except BaseException as exc:  # surfaced to the submitting thread
             task.exc = exc
         finally:
+            self._observe_slow([task], t_claim, propose_s, group=1)
             self._finish(task)
 
     def _execute_group(self, tasks: list[_Task]) -> None:
@@ -274,33 +306,48 @@ class Scheduler:
         failure fails exactly the tasks whose mutations rode the batch."""
         ctx = tasks[0].ctx
         contributed: list[_Task] = []
-        try:
-            fail_point("scheduler_async_snapshot")
-            snapshot = self.engine.snapshot(ctx)
-        except BaseException as exc:
+        t_claim = time.perf_counter()
+        propose_s = 0.0
+        # group-commit fold phases ride the LEADER's trace (the group's
+        # other members link the shared write via their slow-log entries):
+        # one fold span, one propose→apply span, N latch-wait records
+        with trace.attach(tasks[0].trace_ctx):
             for t in tasks:
-                t.exc = exc
-        else:
-            wb = WriteBatch()
-            for t in tasks:
-                try:
-                    txn, result = t.cmd.process_write(snapshot)
-                    t.result = result
-                    if not txn.is_empty():
-                        contributed.append(t)
-                        wb.ops.extend(txn.wb.ops)
-                except BaseException as exc:
-                    t.exc = exc
+                trace.remote_span(t.trace_ctx, "txn.latch_wait",
+                                  start=t.submit_t, end=t_claim,
+                                  cmd=type(t.cmd).__name__,
+                                  group_size=len(tasks))
             try:
-                fail_point("scheduler_before_write")
-                if wb.ops:
-                    # commands whose mutations actually rode this ONE write
-                    _SCHED_GROUP_SIZE.observe(len(contributed))
-                    self.engine.write(ctx, wb)
+                fail_point("scheduler_async_snapshot")
+                snapshot = self.engine.snapshot(ctx)
             except BaseException as exc:
-                for t in contributed:
-                    t.result = None
+                for t in tasks:
                     t.exc = exc
+            else:
+                wb = WriteBatch()
+                with trace.span("txn.group_fold", group_size=len(tasks)):
+                    for t in tasks:
+                        try:
+                            txn, result = t.cmd.process_write(snapshot)
+                            t.result = result
+                            if not txn.is_empty():
+                                contributed.append(t)
+                                wb.ops.extend(txn.wb.ops)
+                        except BaseException as exc:
+                            t.exc = exc
+                t_proc = time.perf_counter()
+                try:
+                    fail_point("scheduler_before_write")
+                    if wb.ops:
+                        # commands whose mutations actually rode this ONE write
+                        _SCHED_GROUP_SIZE.observe(len(contributed))
+                        self.engine.write(ctx, wb)
+                        propose_s = time.perf_counter() - t_proc
+                except BaseException as exc:
+                    for t in contributed:
+                        t.result = None
+                        t.exc = exc
+        self._observe_slow(tasks, t_claim, propose_s, group=len(tasks))
         # one release sweep for the whole group: K latch releases under a
         # single latch-table lock round (latches.release_many)
         woken = self.latches.release_many([(t.cid, t.slots) for t in tasks])
@@ -313,6 +360,34 @@ class Scheduler:
             self._enqueue(w)
         for t in tasks:
             t.done.set()
+
+    def _observe_slow(self, tasks: list[_Task], t_claim: float,
+                      propose_s: float, group: int) -> None:
+        """Slow-log parity for writes (docs/tracing.md): any command whose
+        end-to-end latency crosses the sink's threshold records its phase
+        breakdown — latch/queue wait, process_write, raft propose→apply —
+        plus its trace id, in the same JSON-line shape as the coprocessor
+        slow log."""
+        now = time.perf_counter()
+        threshold = self.slow_log.threshold_s
+        for t in tasks:
+            if t.submit_t <= 0.0:
+                continue
+            total = now - t.submit_t
+            if total < threshold:
+                continue
+            wait = max(t_claim - t.submit_t, 0.0)
+            fields = {
+                "latch_wait_ms": round(wait * 1000, 3),
+                "process_ms": round(max(total - wait - propose_s, 0.0) * 1000, 3),
+                "propose_apply_ms": round(propose_s * 1000, 3),
+                "total_ms": round(total * 1000, 3),
+                "group_size": group,
+                "status": "error" if t.exc is not None else "done",
+            }
+            if t.trace_ctx and t.trace_ctx.get("trace_id"):
+                fields["trace_id"] = t.trace_ctx["trace_id"]
+            self.slow_log.record(f"txn {type(t.cmd).__name__}", fields)
 
     def _finish(self, task: _Task) -> None:
         woken = self.latches.release(task.cid, task.slots)

@@ -106,6 +106,16 @@ class ServerConfig:
 
 
 @dataclass
+class TraceConfig:
+    """trace.* — the distributed tracing plane (docs/tracing.md).  Both
+    knobs reconfigure online through the ConfigController (``ctl.py trace
+    set-sample-rate`` POSTs here)."""
+
+    sample_rate: float = 0.01
+    slow_threshold_s: float = 0.3
+
+
+@dataclass
 class SecuritySection:
     """security.* (components/security/src/lib.rs SecurityConfig)."""
 
@@ -125,6 +135,7 @@ class TikvConfig:
     readpool: ReadPoolConfig = field(default_factory=ReadPoolConfig)
     gc: GcConfig = field(default_factory=GcConfig)
     security: SecuritySection = field(default_factory=SecuritySection)
+    trace: TraceConfig = field(default_factory=TraceConfig)
 
     def apply_security(self):
         """Make the [security] section take effect process-wide: returns the
@@ -159,6 +170,10 @@ class TikvConfig:
             raise ValueError("storage.scheduler_concurrency must be positive")
         if self.coprocessor.region_split_keys > self.coprocessor.region_max_keys:
             raise ValueError("region_split_keys must be <= region_max_keys")
+        if not 0.0 <= self.trace.sample_rate <= 1.0:
+            raise ValueError("trace.sample_rate must be in [0, 1]")
+        if self.trace.slow_threshold_s < 0:
+            raise ValueError("trace.slow_threshold_s must be >= 0")
 
     def to_dict(self) -> dict:
         return asdict(self)

@@ -103,6 +103,16 @@ class StructuredLogger:
     def _emit(self, level: int, event: str, kv: dict) -> None:
         _ensure_configured()
         if self._log.isEnabledFor(level):
+            # log↔trace correlation (docs/tracing.md): lines emitted under
+            # an active span carry its trace_id, so diagnostics.search_log
+            # pivots from a trace straight to its log lines (and back, via
+            # the slow log's trace ids).  One thread-local read when no
+            # trace is active.
+            from . import trace
+
+            tid = trace.current_trace_id()
+            if tid is not None and "trace_id" not in kv:
+                kv = {**kv, "trace_id": tid}
             self._log.log(level, event, extra={"kv": kv})
 
     def debug(self, event: str, **kv) -> None:
