@@ -1371,20 +1371,47 @@ def main() -> None:
     # with UNAVAILABLE after a long stall; a dead worker is respawned (JAX
     # caches the init failure per-process) until the budget is spent
     backend = "cpu_fallback"
+    # backend-probe attestation (ROADMAP bench gap): the probe — the
+    # worker subprocess's backend init, timeout-guarded by the wedge
+    # monitor — earns a NAMED verdict (ok / timeout / error) recorded in
+    # the bench JSON and the observatory counter, so a cpu_fallback run
+    # caused by a wedged probe is distinguishable from a cpu-only host
+    probe = {"verdict": "skipped", "cause": "forced_cpu"}
     if worker is not None:
+        t_probe = worker.t0
         deadline = worker.t0 + budget_s
         while True:
             outcome = worker.wait_ready(max(deadline - time.time(), 60.0))
             if outcome == "ready":
                 backend = worker.platform or "unknown"
+                probe = {"verdict": "ok", "platform": backend,
+                         "elapsed_s": round(time.time() - t_probe, 1)}
                 break
+            wedge_cause = worker._wedged
+            rc = worker.proc.poll()
             worker.kill()
             if outcome == "died" and time.time() < deadline:
                 worker = DeviceWorker(timeline)
                 worker.t0 = deadline - budget_s  # keep the global deadline
                 continue
+            if outcome == "timeout":
+                probe = {"verdict": "timeout",
+                         "cause": wedge_cause or "init_budget_exhausted",
+                         "elapsed_s": round(time.time() - t_probe, 1)}
+            else:
+                probe = {"verdict": "error", "cause": "worker_died",
+                         "rc": rc,
+                         "elapsed_s": round(time.time() - t_probe, 1)}
             worker = None
             break
+    timeline.append({"t": round(time.time() - t0, 1),
+                     "ev": "backend_probe", **probe})
+    try:
+        from tikv_tpu.copr.observatory import count_backend_probe
+
+        count_backend_probe(probe["verdict"])
+    except Exception:  # noqa: BLE001 — attestation must not fail the bench
+        pass
     dev = worker if worker is not None else LocalDevice()
     if isinstance(dev, LocalDevice):
         print("bench: device backend unrecoverable — running on CPU", file=sys.stderr)
@@ -1693,6 +1720,7 @@ def main() -> None:
         "cold_rows": n_cold,
         "block_rows": block_rows,
         "backend": backend,
+        "backend_probe": probe,
         "build_s": round(build_s, 2),
         "warm_geo_speedup": round(geo, 3),
         **{k: (round(v, 1) if isinstance(v, float) else v) for k, v in results.items()},

@@ -141,6 +141,19 @@ def main(argv=None) -> int:
     sp.add_argument("--limit", type=int, default=20)
     sp.add_argument("--slow", action="store_true",
                     help="list only the slow/promoted ring")
+    sp = sub.add_parser(
+        "observatory",
+        help="performance observatory (docs/observatory.md): `observatory "
+             "top` lists (sig, path) rows by time spent like a live "
+             "profiler; `observatory sig <SIG>` shows one plan signature's "
+             "per-path cost profiles with exemplar trace ids; `observatory "
+             "compiles` dumps the device compile ledger")
+    sp.add_argument("action", choices=["top", "sig", "compiles"])
+    sp.add_argument("sig", nargs="?", default=None,
+                    help="plan signature id for `observatory sig`")
+    sp.add_argument("--limit", type=int, default=20)
+    sp.add_argument("--json", action="store_true",
+                    help="raw JSON instead of the text rendering")
     sub.add_parser("bad-regions")
     sub.add_parser("all-regions")
     sub.add_parser("metrics")
@@ -352,6 +365,42 @@ def main(argv=None) -> int:
                         print(f"-- {ring} ({len(r[ring])}) --")
                         for t in reversed(r[ring]):
                             print(timeline(t))
+                    return 0
+        elif args.cmd == "observatory":
+            from tikv_tpu.copr.observatory import format_sig, format_top
+
+            if args.action == "top":
+                r = c.call("debug_observatory", {"top": True,
+                                                 "limit": args.limit})
+                if "error" not in r and not args.json:
+                    print(format_top(r["top"]))
+                    return 0
+            elif args.action == "sig":
+                if not args.sig:
+                    print("observatory sig requires a SIG id", file=sys.stderr)
+                    return 2
+                r = c.call("debug_observatory", {"sig": args.sig})
+                if "error" not in r and not args.json:
+                    entry = r.get("sigs", {}).get(args.sig)
+                    if entry is None:
+                        print(f"sig {args.sig} not profiled", file=sys.stderr)
+                        return 1
+                    print(format_sig(args.sig, entry))
+                    return 0
+            else:  # compiles
+                r = c.call("debug_observatory", {})
+                if "error" not in r and not args.json:
+                    comp = r["compiles"]
+                    print(f"compile events ({len(comp['events'])}), "
+                          f"executable caches: {comp['executable_cache_sizes']}")
+                    for ev in comp["events"][-args.limit:]:
+                        extra = "".join(
+                            f" {k}={ev[k]}" for k in
+                            ("cache_size", "flops", "bytes_accessed")
+                            if k in ev)
+                        print(f"  [{ev['t']:9.3f}s] {ev['site']:<22} "
+                              f"path={ev['path']:<8} sig={ev['sig']} "
+                              f"wall={ev['wall_s'] * 1e3:.1f}ms{extra}")
                     return 0
         elif args.cmd == "read-progress":
             req = {}

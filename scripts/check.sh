@@ -36,6 +36,10 @@ echo "== trace smoke: sampled request end-to-end span tree under the sanitizer =
 JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
   -m 'not slow' tests/test_tracing.py
 
+echo "== observatory smoke: per-sig path profiles, compile ledger, exemplars, floor gate under the sanitizer =="
+JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
+  -m 'not slow' tests/test_observatory.py
+
 echo "== compressed-columns smoke: encoded residency, delta demotions, code-space rewrites under the sanitizer =="
 JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
   -m 'not slow' tests/test_encoding.py tests/test_compressed_columns.py

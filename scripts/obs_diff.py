@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Observatory floor gate: per-plan-signature rows/s regression detector.
+
+The observatory measures what every serving path delivers per plan
+signature (docs/observatory.md).  This gate turns those measurements into
+a live, per-query-shape regression detector — the BENCH_*.json trajectory,
+but keyed by plan shape instead of one blessed benchmark query:
+
+    # snapshot today's measured throughput as the floor
+    python scripts/obs_diff.py --write-floor --current snap.json --floor floor.json
+    python scripts/obs_diff.py --write-floor --addr HOST:PORT --floor floor.json
+
+    # gate: fail (exit 1) if any (sig, path) dropped >2x below its floor
+    python scripts/obs_diff.py --floor floor.json --current snap.json
+    python scripts/obs_diff.py --floor floor.json --addr HOST:PORT
+
+``--current`` takes an observatory snapshot JSON (``debug_observatory`` /
+``GET /debug/observatory?format=json`` output, or a ``floor()`` dict);
+``--addr`` scrapes a live store over the debug RPC.  A (sig, path) present
+in the floor but absent (or under ``--min-count``) in the current run is
+reported as missing — a warning, not a failure, unless ``--strict``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from tikv_tpu.copr.observatory import floor_diff  # noqa: E402
+
+
+def _load_current(args) -> dict:
+    if args.current:
+        with open(args.current) as f:
+            return json.load(f)
+    from tikv_tpu.server.server import Client
+
+    host, port = args.addr.rsplit(":", 1)
+    c = Client(host, int(port))
+    try:
+        return c.call("debug_observatory", {"floor": True,
+                                            "min_count": args.min_count})
+    finally:
+        c.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="obs_diff")
+    ap.add_argument("--floor", required=True,
+                    help="floor JSON (written by --write-floor or "
+                         "Observatory.write_floor)")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--current", help="current observatory snapshot JSON")
+    src.add_argument("--addr", help="live store RPC address host:port")
+    ap.add_argument("--write-floor", action="store_true",
+                    help="write the current measurements AS the floor "
+                         "instead of diffing")
+    ap.add_argument("--ratio", type=float, default=2.0,
+                    help="max tolerated rows/s drop factor (default 2.0)")
+    ap.add_argument("--min-count", type=int, default=3,
+                    help="min window observations for a comparable profile")
+    ap.add_argument("--strict", action="store_true",
+                    help="missing (sig, path) profiles fail the gate too")
+    args = ap.parse_args(argv)
+
+    current = _load_current(args)
+    if args.write_floor:
+        # normalize whatever shape we got into the floor shape
+        sigs = {}
+        for s, entry in (current.get("sigs") or {}).items():
+            paths = entry.get("paths", entry)
+            out = {}
+            for pk, v in paths.items():
+                if not isinstance(v, dict) or "rows_per_s" not in v:
+                    continue
+                if v.get("count", 0) >= args.min_count and v["rows_per_s"] > 0:
+                    out[pk] = {"rows_per_s": v["rows_per_s"],
+                               "p95_ms": v.get("p95_ms"),
+                               "count": v["count"],
+                               "desc": v.get("desc", entry.get("desc", ""))}
+            if out:
+                sigs[s] = out
+        import time
+
+        floor = {"version": 1, "written_at": time.time(), "sigs": sigs}
+        with open(args.floor, "w") as f:
+            json.dump(floor, f, indent=2, sort_keys=True)
+        n = sum(len(p) for p in sigs.values())
+        print(f"obs_diff: floor written to {args.floor} "
+              f"({len(sigs)} sigs, {n} profiles)")
+        return 0
+
+    with open(args.floor) as f:
+        floor = json.load(f)
+    verdict = floor_diff(floor, current, ratio=args.ratio,
+                         min_count=args.min_count)
+    for m in verdict["missing"]:
+        print(f"obs_diff: missing profile {m} (floor has it, current run "
+              f"does not)", file=sys.stderr)
+    for r in verdict["regressions"]:
+        print(f"obs_diff: REGRESSION {r['sig']}/{r['path']} "
+              f"({r['desc']}): {r['rows_per_s']:.1f} rows/s vs floor "
+              f"{r['floor_rows_per_s']:.1f} ({r['drop']}x drop "
+              f"> {verdict['ratio']}x)", file=sys.stderr)
+    ok = verdict["ok"] and (not args.strict or not verdict["missing"])
+    print(f"obs_diff: {'ok' if ok else 'FAIL'} — checked "
+          f"{verdict['checked']} profiles, "
+          f"{len(verdict['regressions'])} regressions, "
+          f"{len(verdict['missing'])} missing")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,6 +67,17 @@ LAZY_SERIES = {
     "tikv_coprocessor_region_cache_bytes",
     "tikv_coprocessor_region_cache_compression_ratio",
     "tikv_coprocessor_region_cache_device_pinned_bytes",
+    "tikv_observatory_serve_total",
+    "tikv_observatory_serve_seconds",
+    "tikv_observatory_rows_total",
+    "tikv_observatory_decline_total",
+    "tikv_observatory_compile_total",
+    "tikv_observatory_compile_seconds",
+    "tikv_observatory_pinned_hbm_bytes",
+    "tikv_observatory_pinned_hbm_watermark_bytes",
+    "tikv_observatory_sigs",
+    "tikv_observatory_evicted_sigs",
+    "tikv_observatory_backend_probe_total",
     "tikv_coprocessor_encoding_total",
     "tikv_coprocessor_encoding_demote_total",
     "tikv_coprocessor_encoded_path_total",

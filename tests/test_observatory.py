@@ -1,0 +1,554 @@
+"""Performance observatory (copr/observatory.py, docs/observatory.md):
+bounded per-sig path cost profiles, the device compile ledger, exemplar
+trace resolution, HBM watermarks, and the obs_diff floor gate.
+
+Run under TIKV_TPU_SANITIZE=1 by scripts/check.sh — the report hot path
+must share no lock with serving."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from copr_fixtures import TABLE_ID as PRODUCT_TABLE  # noqa: F401 (path setup)
+from tikv_tpu.copr import observatory as obs
+from tikv_tpu.copr.aggr import AggDescriptor
+from tikv_tpu.copr.dag import Aggregation, DagRequest, Selection, TableScan
+from tikv_tpu.copr.datatypes import ColumnInfo, FieldType
+from tikv_tpu.copr.endpoint import CoprRequest, Endpoint
+from tikv_tpu.copr.rpn import call, col, const_int
+from tikv_tpu.copr.table import encode_row, record_key
+from tikv_tpu.storage.btree_engine import BTreeEngine
+from tikv_tpu.storage.engine import CF_WRITE
+from tikv_tpu.storage.kv import LocalEngine
+from tikv_tpu.storage.txn_types import Key, Write, WriteType
+from tikv_tpu.util import trace
+from tikv_tpu.util.failpoint import cfg
+from tikv_tpu.util.metrics import REGISTRY, Histogram
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TABLE_ID = 91
+
+COLS = [
+    ColumnInfo(1, FieldType.int64(), is_pk_handle=True),
+    ColumnInfo(2, FieldType.int64()),
+    ColumnInfo(3, FieldType.int64()),
+]
+
+
+def _engine(n: int, seed: int = 0) -> BTreeEngine:
+    rng = np.random.default_rng(seed)
+    a = rng.integers(0, 50, n)
+    b = rng.integers(0, 100000, n)
+    eng = BTreeEngine()
+    items = []
+    for i in range(n):
+        rk = record_key(TABLE_ID, i)
+        val = encode_row(COLS[1:], [int(a[i]), int(b[i])])
+        items.append((Key.from_raw(rk).append_ts(20).encoded,
+                      Write(WriteType.PUT, 10, short_value=val).to_bytes()))
+    eng.bulk_load(CF_WRITE, items)
+    return eng
+
+
+def _sum_dag(cut: int = 40) -> DagRequest:
+    return DagRequest(executors=[
+        TableScan(TABLE_ID, COLS),
+        Selection([call("lt", col(1), const_int(cut))]),
+        Aggregation([], [AggDescriptor("sum", col(2)),
+                         AggDescriptor("count", None)]),
+    ])
+
+
+def _region_req(region: int, rows_per: int, dag: DagRequest,
+                apply_index: int = 7) -> CoprRequest:
+    lo = record_key(TABLE_ID, region * rows_per)
+    hi = record_key(TABLE_ID, (region + 1) * rows_per)
+    return CoprRequest(103, dag, [(lo, hi)], 100, context={
+        "region_id": region + 1, "region_epoch": (1, 1),
+        "apply_index": apply_index,
+    })
+
+
+ROWS_PER = 400
+N_REGIONS = 4
+
+
+@pytest.fixture(autouse=True)
+def _fresh_observatory():
+    obs.OBSERVATORY.reset()
+    yield
+    obs.OBSERVATORY.reset()
+
+
+@pytest.fixture
+def sampled_traces():
+    old = trace.sample_rate()
+    trace.set_sample_rate(1.0)
+    yield
+    trace.set_sample_rate(old)
+
+
+# ---------------------------------------------------------------------------
+# Histogram.percentile (satellite: bucket-interpolated accessor)
+# ---------------------------------------------------------------------------
+
+def test_histogram_percentile_interpolates_within_bucket():
+    h = Histogram("t_pct", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 1.5, 3.0):
+        h.observe(v)
+    # rank 2 of 4 lands in the (1, 2] bucket (cum 1 before, 2 inside)
+    p50 = h.percentile(0.5)
+    assert 1.0 <= p50 <= 2.0
+    # 100th percentile of in-range data interpolates to the last bucket hit
+    assert h.percentile(1.0) == pytest.approx(4.0)
+
+
+def test_histogram_percentile_edge_buckets():
+    h = Histogram("t_pct_edge", buckets=(1.0, 2.0))
+    h.observe(0.25)  # first bucket: lower bound is 0
+    assert 0.0 <= h.percentile(0.5) <= 1.0
+    h2 = Histogram("t_pct_inf", buckets=(1.0, 2.0))
+    h2.observe(100.0)  # overflow bucket clamps to the last finite bound
+    assert h2.percentile(0.99) == pytest.approx(2.0)
+
+
+def test_histogram_percentile_empty_and_labels():
+    h = Histogram("t_pct_empty", buckets=(1.0,))
+    assert h.percentile(0.5) == 0.0
+    h.observe(0.5, lane="a")
+    assert h.percentile(0.5, lane="b") == 0.0
+    assert 0.0 < h.percentile(0.5, lane="a") <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# recorder bounds + windows
+# ---------------------------------------------------------------------------
+
+def test_bounded_memory_under_sig_churn():
+    o = obs.Observatory(window_s=60.0, max_sigs=8, enabled=True)
+    for i in range(100):
+        o.record_serve(f"sig{i:03d}", "unary", 0.001, rows=10)
+    snap = o.snapshot()
+    assert snap["live_sigs"] <= 8
+    assert snap["evicted_sigs"] == 100 - snap["live_sigs"]
+    # the survivors are the most recently used
+    assert "sig099" in snap["sigs"] and "sig000" not in snap["sigs"]
+
+
+def test_window_roll_drops_old_observations():
+    o = obs.Observatory(window_s=0.03, max_sigs=8, enabled=True)
+    o.record_serve("s", "unary", 1.0, rows=1)  # old, slow
+    time.sleep(0.04)
+    for _ in range(obs.N_WINDOWS):
+        o.record_serve("s", "unary", 0.001, rows=1)
+        time.sleep(0.04)
+    v = o.snapshot()["sigs"]["s"]["paths"]["unary|plain"]
+    # the 1s outlier rolled out of the retained windows; lifetime totals keep it
+    assert v["count"] == obs.N_WINDOWS
+    assert v["total_count"] == obs.N_WINDOWS + 1
+    assert v["p99_ms"] < 100.0
+    assert v["time_spent_s"] > 1.0  # lifetime time spent still counts the outlier
+
+
+def test_profile_percentiles_and_axes():
+    o = obs.Observatory(window_s=60.0, enabled=True)
+    for ms in (1, 1, 1, 1, 1, 1, 1, 1, 1, 50):
+        o.record_serve("s", "xregion", ms / 1000.0, rows=100, occupancy=4,
+                       queue_wait_s=0.002, padding_waste=0.25)
+    v = o.snapshot()["sigs"]["s"]["paths"]["xregion|plain"]
+    assert v["count"] == 10
+    assert v["p50_ms"] < 5.0 < v["p99_ms"]
+    assert v["mean_occupancy"] == pytest.approx(4.0)
+    assert v["padding_waste"] == pytest.approx(0.25)
+    assert v["queue_wait_ms_mean"] == pytest.approx(2.0, rel=0.01)
+    assert v["rows_per_s"] > 0
+
+
+def test_declines_recorded_per_sig_and_cause():
+    o = obs.Observatory(window_s=60.0, enabled=True)
+    o.record_serve("s", "xregion", 0.001, rows=1)
+    o.record_decline("s", "xregion", "padding")
+    o.record_decline("s", "xregion", "padding")
+    o.record_decline("s", "xregion", "no_cache")
+    v = o.snapshot()["sigs"]["s"]["paths"]["xregion|plain"]
+    assert v["declines"] == {"padding": 2, "no_cache": 1}
+
+
+def test_kill_switch_disables_recording():
+    o = obs.Observatory(enabled=False)
+    o.record_serve("s", "unary", 0.001, rows=1)
+    o.record_compile("site", "unary", 0.1, sig="s")
+    snap = o.snapshot()
+    assert snap["enabled"] is False
+    assert not snap["sigs"] and not snap["compiles"]["events"]
+
+
+# ---------------------------------------------------------------------------
+# compile ledger (jit boundary)
+# ---------------------------------------------------------------------------
+
+def test_compile_ledger_first_call_vs_cached(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    o = obs.Observatory(window_s=60.0, enabled=True)
+    monkeypatch.setattr(obs, "OBSERVATORY", o)
+
+    fn = obs.timed_jit(jax.jit(lambda x: x * 2 + 1), "test.site", "unary",
+                       "sigX")
+    fn(jnp.ones(8))
+    fn(jnp.ones(8))  # cached executable: no new event
+    events = o.snapshot()["compiles"]["events"]
+    assert len(events) == 1
+    ev = events[0]
+    assert ev["site"] == "test.site" and ev["path"] == "unary"
+    assert ev["sig"] == "sigX" and ev["wall_s"] > 0
+    assert ev["cache_size"] == 1
+    fn(jnp.ones(16))  # new shape: recompile, second event
+    events = o.snapshot()["compiles"]["events"]
+    assert len(events) == 2 and events[1]["cache_size"] == 2
+    agg = o.snapshot()["compiles"]["by_sig_path"]["sigX|unary"]
+    assert agg["count"] == 2
+    sizes = o.snapshot()["compiles"]["executable_cache_sizes"]
+    assert sizes["test.site"] == 2
+
+
+def test_compile_ledger_xla_cost_analysis(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    o = obs.Observatory(window_s=60.0, enabled=True)
+    o.xla_analysis = True
+    monkeypatch.setattr(obs, "OBSERVATORY", o)
+    fn = obs.timed_jit(jax.jit(lambda x: x @ x), "test.mm", "unary", "sigY")
+    fn(jnp.ones((8, 8)))
+    ev = o.snapshot()["compiles"]["events"][0]
+    # the CPU backend exposes cost_analysis: flops/bytes land in the ledger
+    assert ev.get("flops", 0) > 0
+    assert ev.get("bytes_accessed", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# HBM watermarks
+# ---------------------------------------------------------------------------
+
+def test_hbm_watermark_movement(monkeypatch):
+    from tikv_tpu.copr.cache import ColumnBlockCache
+
+    o = obs.Observatory(window_s=60.0, enabled=True)
+    monkeypatch.setattr(obs, "OBSERVATORY", o)
+    cache = ColumnBlockCache()
+    cache.add([None], 16)
+    blk = cache.blocks[0]
+    arr = np.zeros(1024, dtype=np.int64)  # 8192 bytes
+    cache.device_arrays(blk, ("blockenc", 1), lambda b: (arr,))
+    cache.device_arrays(blk, ("zone_layout", 2), lambda b: (arr, arr))
+    snap = o.snapshot()["hbm"]
+    assert snap["unary"]["bytes"] == arr.nbytes
+    assert snap["zone"]["bytes"] == 2 * arr.nbytes
+    # a repeat hit pins nothing new
+    cache.device_arrays(blk, ("blockenc", 1), lambda b: (arr,))
+    assert o.snapshot()["hbm"]["unary"]["bytes"] == arr.nbytes
+    cache.drop_device()
+    snap = o.snapshot()["hbm"]
+    assert snap["unary"]["bytes"] == 0 and snap["zone"]["bytes"] == 0
+    # the high-water mark survives the unpin
+    assert snap["unary"]["watermark_bytes"] == arr.nbytes
+    assert snap["zone"]["watermark_bytes"] == 2 * arr.nbytes
+
+
+def test_clear_blocks_unpins_with_accounting(monkeypatch):
+    """Discarding blocks must release their pinned bytes from the HBM
+    gauges — a raw blocks.clear() (the old repack/failure-cleanup shape)
+    would strand them at the watermark forever."""
+    from tikv_tpu.copr.cache import ColumnBlockCache
+
+    o = obs.Observatory(window_s=60.0, enabled=True)
+    monkeypatch.setattr(obs, "OBSERVATORY", o)
+    cache = ColumnBlockCache()
+    cache.add([None], 16)
+    arr = np.zeros(128, dtype=np.int64)
+    cache.device_arrays(cache.blocks[0], ("blockenc", 1), lambda b: (arr,))
+    assert o.snapshot()["hbm"]["unary"]["bytes"] == arr.nbytes
+    cache.clear_blocks()
+    snap = o.snapshot()["hbm"]
+    assert snap["unary"]["bytes"] == 0
+    assert snap["unary"]["watermark_bytes"] == arr.nbytes
+    assert not cache.blocks
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance: one sig on >=3 paths, exemplars resolve, compiles ledgered
+# ---------------------------------------------------------------------------
+
+def test_same_sig_three_paths_profiles_exemplars_compiles(sampled_traces):
+    eng = _engine(ROWS_PER * N_REGIONS, seed=5)
+    dev = Endpoint(LocalEngine(eng), enable_device=True, block_rows=512)
+    cpu = Endpoint(LocalEngine(eng), enable_device=False)
+    dag = _sum_dag()
+    sig_id, _desc = obs.dag_sig(dag)
+
+    # path 1: unary warm serving (zone may take it — also a distinct path)
+    for _ in range(3):
+        with trace.start_trace("client.unary"):
+            dev.handle_request(_region_req(0, ROWS_PER, dag))
+    # path 2: the scheduler's cross-region batch (same sig, 4 regions)
+    with trace.start_trace("client.batch"):
+        resps = dev.handle_batch(
+            [_region_req(r, ROWS_PER, dag) for r in range(N_REGIONS)])
+    # path 3: the CPU pipeline (device disabled endpoint, same plan)
+    with trace.start_trace("client.cpu"):
+        cpu_resp = cpu.handle_request(_region_req(1, ROWS_PER, dag))
+    assert resps[1].data == cpu_resp.data  # byte identity across paths
+
+    via_rpc = {"sigs": obs.OBSERVATORY.snapshot(sig=sig_id)["sigs"]}
+    entry = via_rpc["sigs"][sig_id]
+    paths = {pk.split("|")[0] for pk in entry["paths"]}
+    assert {"xregion", "cpu"} <= paths and len(paths) >= 3, paths
+    for pk, v in entry["paths"].items():
+        assert v["count"] >= 1
+        assert v["time_spent_s"] > 0
+        # every per-path profile carries >=1 exemplar that RESOLVES to a
+        # live trace (docs/tracing.md)
+        assert v["exemplar_traces"], f"no exemplar on {pk}"
+        assert any(trace.TRACER.get(t) is not None
+                   for t in v["exemplar_traces"]), pk
+    # measured costs differ across paths (cpu vs device batch)
+    lats = {pk.split("|")[0]: v["mean_ms"] for pk, v in entry["paths"].items()}
+    assert len(set(lats.values())) > 1
+    # every compile that occurred is in the ledger with its sig and path
+    events = obs.OBSERVATORY.snapshot()["compiles"]["events"]
+    assert events, "no compile events recorded"
+    for ev in events:
+        assert ev["site"] and ev["path"] and "sig" in ev and ev["wall_s"] > 0
+    assert any(ev["sig"] == sig_id for ev in events)
+    # rows flowed: warm serves attribute the image's rows
+    assert any(v["rows"] > 0 for v in entry["paths"].values())
+
+
+def test_slow_log_carries_path_and_plan_sig():
+    eng = _engine(ROWS_PER)
+    ep = Endpoint(LocalEngine(eng), enable_device=True, block_rows=512)
+    ep.slow_log.threshold_s = 0.0
+    dag = _sum_dag()
+    sig_id, _ = obs.dag_sig(dag)
+    ep.handle_request(_region_req(0, ROWS_PER, dag))
+    entry = ep.slow_log.tail(1)[0]
+    assert entry["plan_sig"] == sig_id
+    assert entry["path"] in ("unary", "zone", "mesh", "cpu")
+
+
+def test_txn_slow_log_carries_path_and_sig():
+    from tikv_tpu.pd.client import MockPd
+    from tikv_tpu.storage.storage import Storage
+    from tikv_tpu.storage.txn.commands import Commit, Prewrite
+    from tikv_tpu.storage.txn_types import Mutation
+
+    storage = Storage()
+    storage.scheduler.slow_log.threshold_s = 0.0
+    pd = MockPd()
+    ts = pd.get_tso()
+    storage.sched_txn_command(
+        Prewrite([Mutation.put(Key.from_raw(b"ok"), b"v")], b"ok", ts), None)
+    storage.sched_txn_command(Commit([Key.from_raw(b"ok")], ts, pd.get_tso()),
+                              None)
+    entries = storage.scheduler.slow_log.tail(10)
+    assert entries
+    for e in entries:
+        assert e["path"] in ("txn", "txn_group")
+        assert e["plan_sig"].startswith("txn:")
+
+
+# ---------------------------------------------------------------------------
+# floor gate: clean pass, seeded regression fails (failpoint-slowed path)
+# ---------------------------------------------------------------------------
+
+def _serve_n(ep, dag, n):
+    for _ in range(n):
+        ep.handle_request(_region_req(0, ROWS_PER, dag))
+
+
+def test_floor_diff_pass_and_seeded_regression(tmp_path):
+    eng = _engine(ROWS_PER)
+    ep = Endpoint(LocalEngine(eng), enable_device=True, block_rows=512)
+    dag = _sum_dag()
+    _serve_n(ep, dag, 2)  # warm: compile + fill outside the floor window
+    obs.OBSERVATORY.reset()
+    _serve_n(ep, dag, 4)
+    floor_path = str(tmp_path / "floor.json")
+    floor = obs.OBSERVATORY.write_floor(floor_path, min_count=3)
+    assert floor["sigs"], "floor captured no profiles"
+
+    # clean run: same serving speed passes the gate
+    obs.OBSERVATORY.reset()
+    _serve_n(ep, dag, 4)
+    clean = obs.OBSERVATORY.snapshot()
+    verdict = obs.floor_diff(floor, clean, ratio=2.0, min_count=3)
+    assert verdict["ok"], verdict
+    assert verdict["checked"] >= 1
+
+    # seeded regression: a failpoint-slowed serve path drops rows/s >2x
+    obs.OBSERVATORY.reset()
+    cfg("coprocessor_serve", "sleep(60)")
+    try:
+        _serve_n(ep, dag, 4)
+    finally:
+        cfg("coprocessor_serve", "off")
+    slow = obs.OBSERVATORY.snapshot()
+    verdict = obs.floor_diff(floor, slow, ratio=2.0, min_count=3)
+    assert not verdict["ok"], verdict
+    assert verdict["regressions"]
+    reg_paths = {r["path"] for r in verdict["regressions"]}
+    assert any(pk in reg_paths for pk in floor["sigs"][next(iter(floor["sigs"]))])
+
+    # the script-level gate (scripts/obs_diff.py) agrees on both verdicts
+    clean_path = str(tmp_path / "clean.json")
+    slow_path = str(tmp_path / "slow.json")
+    json.dump(clean, open(clean_path, "w"))
+    json.dump(slow, open(slow_path, "w"))
+    script = os.path.join(REPO, "scripts", "obs_diff.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ok = subprocess.run([sys.executable, script, "--floor", floor_path,
+                         "--current", clean_path], capture_output=True,
+                        text=True, env=env)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = subprocess.run([sys.executable, script, "--floor", floor_path,
+                          "--current", slow_path], capture_output=True,
+                         text=True, env=env)
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert "REGRESSION" in bad.stderr
+    # --write-floor normalizes a snapshot into the floor shape
+    wf = subprocess.run([sys.executable, script, "--floor",
+                         str(tmp_path / "f2.json"), "--current", clean_path,
+                         "--write-floor"], capture_output=True, text=True,
+                        env=env)
+    assert wf.returncode == 0, wf.stdout + wf.stderr
+    assert json.load(open(tmp_path / "f2.json"))["sigs"]
+
+
+# ---------------------------------------------------------------------------
+# surfaces: RPC + HTTP
+# ---------------------------------------------------------------------------
+
+def test_debug_observatory_rpc_and_http(capsys):
+    import urllib.request
+
+    from tikv_tpu.server.server import Client, Server
+    from tikv_tpu.server.service import KvService
+    from tikv_tpu.server.status_server import StatusServer
+    from tikv_tpu.storage.storage import Storage
+
+    eng = _engine(ROWS_PER)
+    ep = Endpoint(LocalEngine(eng), enable_device=True, block_rows=512)
+    dag = _sum_dag()
+    sig_id, _ = obs.dag_sig(dag)
+    _serve_n(ep, dag, 2)
+
+    svc = KvService(Storage(), ep)
+    srv = Server(svc)
+    srv.start()
+    c = Client(*srv.addr)
+    try:
+        snap = c.call("debug_observatory", {})
+        assert sig_id in snap["sigs"]
+        top = c.call("debug_observatory", {"top": True, "limit": 5})
+        assert top["top"] and top["top"][0]["sig"]
+        one = c.call("debug_observatory", {"sig": sig_id})
+        assert list(one["sigs"]) == [sig_id]
+        fl = c.call("debug_observatory", {"floor": True, "min_count": 1})
+        assert sig_id in fl["sigs"]
+        # the ctl surface renders all three actions off the same RPC
+        sys.path.insert(0, REPO)
+        try:
+            import ctl
+        finally:
+            sys.path.pop(0)
+        addr = f"{srv.addr[0]}:{srv.addr[1]}"
+        assert ctl.main(["--addr", addr, "observatory", "top"]) == 0
+        out = capsys.readouterr().out
+        assert "SIG" in out and sig_id in out
+        assert ctl.main(["--addr", addr, "observatory", "sig", sig_id]) == 0
+        out = capsys.readouterr().out
+        assert sig_id in out and "p95" in out
+        assert ctl.main(["--addr", addr, "observatory", "compiles"]) == 0
+        out = capsys.readouterr().out
+        assert "compile events" in out
+    finally:
+        c.close()
+        srv.stop()
+
+    ss = StatusServer()
+    ss.start()
+    try:
+        host, port = ss.addr
+        base = f"http://{host}:{port}"
+        body = urllib.request.urlopen(f"{base}/debug/observatory").read()
+        assert b"SIG" in body and sig_id.encode() in body
+        js = json.loads(urllib.request.urlopen(
+            f"{base}/debug/observatory?format=json").read())
+        assert sig_id in js["sigs"]
+        one = urllib.request.urlopen(
+            f"{base}/debug/observatory?sig={sig_id}").read()
+        assert sig_id.encode() in one
+    finally:
+        ss.stop()
+
+
+def test_metrics_series_move():
+    eng = _engine(ROWS_PER)
+    ep = Endpoint(LocalEngine(eng), enable_device=True, block_rows=512)
+    _serve_n(ep, _sum_dag(), 2)
+    text = REGISTRY.render()
+    for series in ("tikv_observatory_serve_total",
+                   "tikv_observatory_serve_seconds",
+                   "tikv_observatory_compile_total",
+                   "tikv_observatory_pinned_hbm_bytes"):
+        assert series in text, series
+
+
+# ---------------------------------------------------------------------------
+# concurrency: report hot path is lock-clean under the sanitizer
+# ---------------------------------------------------------------------------
+
+def test_concurrent_record_and_snapshot_clean():
+    o = obs.Observatory(window_s=0.05, max_sigs=16, enabled=True)
+    stop = threading.Event()
+    errs = []
+
+    def writer(k):
+        i = 0
+        while not stop.is_set():
+            try:
+                o.record_serve(f"sig{(k + i) % 24}", "unary", 0.001, rows=5,
+                               trace_id=f"t{i}")
+                o.record_decline(f"sig{(k + i) % 24}", "xregion", "padding")
+                o.record_compile(f"site{k}", "unary", 0.01, sig=f"sig{k}",
+                                 cache_size=i)
+                o.note_pin("blockenc", 64)
+                o.note_pin("blockenc", -64)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+                return
+            i += 1
+
+    threads = [threading.Thread(target=writer, args=(k,)) for k in range(4)]
+    for t in threads:
+        t.start()
+    t_end = time.monotonic() + 0.5
+    while time.monotonic() < t_end:
+        snap = o.snapshot()
+        assert snap["live_sigs"] <= 16
+        o.top(5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errs
+    snap = o.snapshot()
+    assert snap["live_sigs"] + snap["evicted_sigs"] > 0

@@ -28,6 +28,24 @@ import numpy as np
 _MAX_DEVICE_SIGS = 6
 
 
+def _pin_kind(sig: tuple) -> str:
+    """Pin-signature family for the observatory's HBM watermarks: named
+    kinds lead their sig tuple ("stackedenc", "blockenc", "nvoff",
+    "zone_layout", "shardslab"); the plain stacked pin leads with its
+    column tuple."""
+    return sig[0] if sig and isinstance(sig[0], str) else "stacked"
+
+
+def _entry_nbytes(entry) -> int:
+    """Device bytes of one pinned entry (zone layouts report their ``dev``
+    tree) — the same figure device_nbytes() sums."""
+    import jax
+
+    tree = getattr(entry, "dev", entry)
+    return sum(int(getattr(leaf, "nbytes", 0) or 0)
+               for leaf in jax.tree.leaves(tree))
+
+
 @dataclass
 class _Block:
     cols: list  # list[Column] (host)
@@ -66,7 +84,9 @@ class ColumnBlockCache:
     def device_arrays(self, block: _Block, sig: tuple, build) -> tuple:
         """Per-block device arrays for a plan signature, pinned on first use.
         Bounded per block: each distinct signature pins a full copy, so old
-        signatures are dropped LRU-style once _MAX_DEVICE_SIGS accumulate."""
+        signatures are dropped LRU-style once _MAX_DEVICE_SIGS accumulate.
+        Pin/unpin byte deltas feed the observatory's per-path HBM
+        watermarks (docs/observatory.md)."""
         with self._mu:
             hit = block.device.get(sig)
             if hit is not None:
@@ -76,10 +96,21 @@ class ColumnBlockCache:
                 return hit
         built = build(block)
         with self._mu:
+            added = sig not in block.device
             block.device.setdefault(sig, built)
+            dropped = []
             while len(block.device) > _MAX_DEVICE_SIGS:
-                block.device.pop(next(iter(block.device)))
-            return block.device[sig]
+                old_sig = next(iter(block.device))
+                dropped.append((old_sig, block.device.pop(old_sig)))
+            out = block.device[sig]
+        if added or dropped:
+            from .observatory import OBSERVATORY
+
+            if added:
+                OBSERVATORY.note_pin(_pin_kind(sig), _entry_nbytes(built))
+            for old_sig, entry in dropped:
+                OBSERVATORY.note_pin(_pin_kind(old_sig), -_entry_nbytes(entry))
+        return out
 
     def nbytes(self) -> int:
         """RESIDENT byte footprint of the blocks — encoded bytes for
@@ -116,12 +147,30 @@ class ColumnBlockCache:
                         total += int(getattr(leaf, "nbytes", 0) or 0)
         return total
 
+    def clear_blocks(self) -> None:
+        """Drop every block AND its pinned device copies.  The one correct
+        way to discard blocks: a raw ``blocks.clear()`` would strand the
+        pinned entries' bytes in the observatory's HBM gauges forever
+        (the arrays themselves are freed by GC; the accounting is not)."""
+        self.drop_device()
+        self.blocks.clear()
+
     def drop_device(self) -> None:
         """Unpin every device copy; host blocks stay.  The next query
         re-transfers from host (no decode)."""
         with self._mu:
+            dropped = [
+                (sig, entry)
+                for b in self.blocks
+                for sig, entry in b.device.items()
+            ]
             for b in self.blocks:
                 b.device.clear()
+        if dropped:
+            from .observatory import OBSERVATORY
+
+            for sig, entry in dropped:
+                OBSERVATORY.note_pin(_pin_kind(sig), -_entry_nbytes(entry))
 
     def scatter_update(self, updates: dict) -> None:
         """Patch pinned device arrays in place after an in-place host update.

@@ -235,6 +235,11 @@ class Endpoint:
             for start, end in req.ranges:
                 self.cm.read_range_check(Key.from_raw(start), Key.from_raw(end), req.start_ts)
         tracker.on_schedule()
+        # chaos/regression hook INSIDE the tracked window (the parse
+        # failpoint above fires before the tracker starts): a seeded
+        # sleep here inflates measured serve latency — what the
+        # observatory floor gate's regression test injects
+        fail_point("coprocessor_serve")
         with trace.span("copr.snapshot"):
             snap = self.engine.snapshot(stale_read_ctx(req))
         tracker.on_snapshot_finished()
@@ -252,6 +257,7 @@ class Endpoint:
             use_device = False
         if use_device:
             cache = None
+            ev = None
             try:
                 cache, rc_outcome = self._region_cache_for(req, snap, tracker)
                 if cache is None:
@@ -290,6 +296,13 @@ class Endpoint:
                         from_device = False
                 scanned = src.stats.write.processed_keys if src is not None else 0
                 m = tracker.on_finish(scanned_keys=scanned, from_device=from_device)
+                rows = (cache.total_rows
+                        if cache is not None and cache.filled and src is None
+                        else scanned)
+                self._record_obs(req, tracker,
+                                 getattr(resp, "_obs_path", "unary"),
+                                 getattr(resp, "_obs_encoding", "plain"),
+                                 rows, ev=ev)
                 self.slow_log.observe(tracker)
                 from_cache = (from_device
                               and cache is not None and cache.filled and src is None
@@ -313,8 +326,10 @@ class Endpoint:
                 # surfacing an accelerator error to the client
                 if cache is not None and not cache.filled:
                     # a partially-filled block cache would double-append on
-                    # the next request and serve wrong data forever
-                    cache.blocks.clear()
+                    # the next request and serve wrong data forever; the
+                    # failed run may have pinned arrays — clear WITH the
+                    # observatory's pin accounting
+                    cache.clear_blocks()
                 self.device_fallbacks += 1
                 self.last_device_error = repr(exc)
                 self.breaker.record_failure("unary")
@@ -323,9 +338,12 @@ class Endpoint:
                     cur.tag(device_fallback=repr(exc))
                 from ..util.metrics import REGISTRY
 
+                from . import observatory as _obs
                 from .tracker import count_path_fallback
 
                 count_path_fallback("unary", "device_error")
+                _obs.OBSERVATORY.record_decline(
+                    getattr(ev, "obs_sig", None), "unary", "device_error")
                 REGISTRY.counter(
                     "tikv_coprocessor_device_fallback_total",
                     "Device-path failures that re-ran on the CPU pipeline",
@@ -338,6 +356,8 @@ class Endpoint:
         with trace.span("copr.cpu"):
             resp = BatchExecutorsRunner(req.dag, src).handle_request()
         m = tracker.on_finish(scanned_keys=stats.write.processed_keys, from_device=False)
+        self._record_obs(req, tracker, "cpu", "plain",
+                         stats.write.processed_keys)
         self.slow_log.observe(tracker)
         if stale_snap:
             self.count_follower_read("cpu")
@@ -390,6 +410,11 @@ class Endpoint:
                     from_device = False
             _encoding.count_rewrite("served")
             m = tracker.on_finish(scanned_keys=0, from_device=from_device)
+            # the rewrite rung serves over resident code lanes — encoded by
+            # construction; the sig recorded is the ORIGINAL plan's (what
+            # the client sent), not the rewritten one
+            self._record_obs(req, tracker, "unary", "encoded",
+                             cache.total_rows)
             self.slow_log.observe(tracker)
             self.breaker.record_success("unary")
             if stale_snap:
@@ -413,6 +438,33 @@ class Endpoint:
             count_path_fallback("unary", "device_error")
             _encoding.count_rewrite("error")
             return None
+
+    def _record_obs(self, req: CoprRequest, tracker, path: str,
+                    encoding: str, rows: int, ev=None) -> None:
+        """Report one served request into the performance observatory
+        (docs/observatory.md) and stamp the serving path + plan sig onto
+        the tracker so the slow log pivots into ``ctl.py observatory sig``.
+        Must run BEFORE ``slow_log.observe``."""
+        from . import observatory as _obs
+
+        if not _obs.OBSERVATORY.enabled:
+            # kill switch: skip even the dag_sig walk — a disabled
+            # observatory must cost the hot path nothing
+            return
+        sig = getattr(ev, "obs_sig", "") if ev is not None else ""
+        desc = getattr(ev, "obs_desc", "") if ev is not None else ""
+        if not sig:
+            try:
+                sig, desc = _obs.dag_sig(req.dag)
+            except Exception:  # noqa: BLE001 — profiling must not fail serving
+                return
+        tracker.metrics.serve_path = path
+        tracker.metrics.plan_sig = sig
+        m = tracker.metrics
+        _obs.OBSERVATORY.record_serve(
+            sig, path, m.total_s, rows=rows, encoding=encoding,
+            queue_wait_s=m.schedule_wait_s, trace_id=tracker.trace_id,
+            desc=desc)
 
     def _cpu_bytes(self, req: CoprRequest, snap) -> bytes:
         """The CPU-oracle answer to ``req`` off ``snap`` — the byte-identity
@@ -717,6 +769,7 @@ class Endpoint:
             count_path_fallback("mesh", "device_error")
             return None
         self.breaker.record_success("mesh")
+        resp._obs_path = "mesh"  # observatory path marker
         REGISTRY.counter(
             "tikv_coprocessor_mesh_cache_hit_total",
             "Warm cached requests served mesh-sharded (replaces the PR-2 "

@@ -44,6 +44,7 @@ import jax.numpy as jnp  # noqa: E402
 
 from ..analysis.sanitizer import note_blocking
 from ..util import trace
+from . import observatory as _obs
 from .aggr import AggDescriptor, AggState
 from .dag import (
     Aggregation,
@@ -698,6 +699,11 @@ class JaxDagEvaluator:
         self.dag = dag
         self.plan = _analyze(dag)
         self.block_rows = block_rows
+        # observatory profile key (docs/observatory.md): the scheduler's
+        # plan-signature normalization, so profiles and micro-batches key
+        # identically; compile events at this evaluator's jit boundaries
+        # carry the sig into the device-cost ledger
+        self.obs_sig, self.obs_desc = _obs.dag_sig(dag)
         # optional DeviceCircuitBreaker (copr/breaker.py): the zone path
         # consults it before running and reports its outcome, so repeated
         # zone faults trip to the generic warm path instead of re-crashing
@@ -773,7 +779,8 @@ class JaxDagEvaluator:
                 active = active & (d != 0) & ~nl
             return active
 
-        fn = jax.jit(mask_fn)
+        fn = _obs.timed_jit(jax.jit(mask_fn), "jax_eval.mask", "unary",
+                            self.obs_sig)
         self._agg_fn_cache[key] = fn
         return fn
 
@@ -800,7 +807,8 @@ class JaxDagEvaluator:
                 track_first=track_first,
             )
 
-        fn = jax.jit(agg_fn, donate_argnums=(5,))
+        fn = _obs.timed_jit(jax.jit(agg_fn, donate_argnums=(5,)),
+                            "jax_eval.agg_step", "unary", self.obs_sig)
         self._agg_fn_cache[capacity] = fn
         return fn
 
@@ -837,7 +845,8 @@ class JaxDagEvaluator:
             # latency per device→host pull, so finalize must pull once
             return _pack_state(state)
 
-        fn = jax.jit(scan_fn)
+        fn = _obs.timed_jit(jax.jit(scan_fn), "jax_eval.scan", "unary",
+                            self.obs_sig)
         self._agg_fn_cache[key] = fn
         return fn
 
@@ -872,7 +881,8 @@ class JaxDagEvaluator:
             state, _ = jax.lax.scan(body, state, (col_data, col_nulls, n_valids, offsets))
             return _pack_state(state)
 
-        fn = jax.jit(scan_fn)
+        fn = _obs.timed_jit(jax.jit(scan_fn), "jax_eval.scan_coded", "unary",
+                            self.obs_sig)
         self._agg_fn_cache[key] = fn
         return fn
 
@@ -938,6 +948,9 @@ class JaxDagEvaluator:
 
         zone_resp = self._try_zone(cache)
         if zone_resp is not None:
+            # observatory path marker (docs/observatory.md): the endpoint
+            # reads which warm rung actually served, per response
+            zone_resp._obs_path = "zone"
             return zone_resp
 
         stable = self._stable_dict_group_cols(blocks)
@@ -966,7 +979,9 @@ class JaxDagEvaluator:
                     parts.append(None if c == dl else bytes(d[c]))
                 return tuple(reversed(parts))
 
-            return self._finalize_agg(state_np, n_slots, key_of)
+            resp = self._finalize_agg(state_np, n_slots, key_of)
+            resp._obs_encoding = "encoded" if enc else "plain"
+            return resp
 
         groups = GroupDict()
         all_gids = np.zeros((n_blocks, self.block_rows), dtype=np.int32)
@@ -984,7 +999,9 @@ class JaxDagEvaluator:
         scan_fn = self._build_scan_fn(capacity, n_blocks, enc)
         packed = scan_fn(col_data, col_nulls, nv_dev, all_gids, off_dev, refs)
         state_np = _unpack_state(packed, self._host_state_template())
-        return self._finalize_agg(state_np, n_slots, lambda r: groups.rows[r])
+        resp = self._finalize_agg(state_np, n_slots, lambda r: groups.rows[r])
+        resp._obs_encoding = "encoded" if enc else "plain"
+        return resp
 
     def _try_zone(self, cache) -> SelectResponse | None:
         """ONE definition of the zone-path protocol: probe, run, finalize.
@@ -1317,7 +1334,8 @@ class JaxDagEvaluator:
         pack_key = ("pack", capacity)
         pack_fn = self._agg_fn_cache.get(pack_key)
         if pack_fn is None:
-            pack_fn = jax.jit(_pack_state)
+            pack_fn = _obs.timed_jit(jax.jit(_pack_state), "jax_eval.pack",
+                                     "unary", self.obs_sig)
             self._agg_fn_cache[pack_key] = pack_fn
         state_np = _unpack_state(pack_fn(state), state)
         return self._finalize_agg(state_np, n_slots, lambda r: groups.rows[r])
@@ -1436,7 +1454,8 @@ class JaxDagEvaluator:
                 sel_rpns, order_rpns, payload_cols, k, n_rows, cols, n_valid, state
             )
 
-        fn = jax.jit(step, donate_argnums=(3,))
+        fn = _obs.timed_jit(jax.jit(step, donate_argnums=(3,)),
+                            "jax_eval.topn", "unary", self.obs_sig)
         self._agg_fn_cache[key] = fn
         return fn
 
@@ -1488,9 +1507,9 @@ class JaxDagEvaluator:
         pack_key = ("packtopn", k)
         pack_fn = self._agg_fn_cache.get(pack_key)
         if pack_fn is None:
-            pack_fn = self._agg_fn_cache[pack_key] = jax.jit(
-                lambda st: _pack_leaves(list(st))
-            )
+            pack_fn = self._agg_fn_cache[pack_key] = _obs.timed_jit(
+                jax.jit(lambda st: _pack_leaves(list(st))),
+                "jax_eval.pack_topn", "unary", self.obs_sig)
         leaves = _unpack_leaves(pack_fn(state), dtypes)
         rank = leaves[0]
         n_out = int((rank == 0).sum())
@@ -1671,7 +1690,8 @@ def run_batch_cached(evaluators: list["JaxDagEvaluator"], cache) -> list[SelectR
             flt_m = jnp.stack(flts) if flts else jnp.zeros((0, max_cap), dtype=jnp.float64)
             return int_m, flt_m
 
-        fn = jax.jit(batch_fn)
+        fn = _obs.timed_jit(jax.jit(batch_fn), "jax_eval.fused_batch",
+                            "fused", base.obs_sig)
         _BATCH_FN_CACHE[key] = fn
         while len(_BATCH_FN_CACHE) > _BATCH_FN_CACHE_MAX:
             _BATCH_FN_CACHE.pop(next(iter(_BATCH_FN_CACHE)))
@@ -1922,7 +1942,8 @@ def launch_xregion_cached(ev: "JaxDagEvaluator", caches) -> XRegionPending:
 
             return jax.vmap(one_region)(stacked, dl_arr, refs_arr)
 
-        fn = jax.jit(xregion_fn)
+        fn = _obs.timed_jit(jax.jit(xregion_fn), "jax_eval.xregion",
+                            "xregion", ev.obs_sig)
         ev._agg_fn_cache[key] = fn
         # block-count compositions drift (deltas, splits): bound the
         # executables retained for this plan so compile churn cannot grow
@@ -1937,7 +1958,10 @@ def launch_xregion_cached(ev: "JaxDagEvaluator", caches) -> XRegionPending:
     with trace.span("device.launch", kind="xregion", regions=len(caches),
                     encoding="encoded" if plans else "decoded"):
         packed = fn(tuple(region_inputs), dl_arr, refs_arr)
-    return XRegionPending(ev, specs, capacity, packed, order)
+    pending = XRegionPending(ev, specs, capacity, packed, order)
+    # observatory encoding label for the riders' profiles
+    pending.obs_encoding = "encoded" if plans else "plain"
+    return pending
 
 
 def run_xregion_cached(ev: "JaxDagEvaluator", caches) -> list[SelectResponse]:

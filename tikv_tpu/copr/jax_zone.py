@@ -62,6 +62,7 @@ import jax
 import jax.numpy as jnp
 
 from ..analysis.sanitizer import note_blocking
+from . import observatory as _obs
 from .datatypes import EvalType
 from .rpn import RpnExpression, eval_rpn
 
@@ -568,7 +569,10 @@ class ZoneEvaluator:
                 first = jnp.full(capacity, _NO_ROW_J, dtype=jnp.int64)
             return first, tuple(carries)
 
-        return _fn_cache_put(fns, key, jax.jit(fn))
+        return _fn_cache_put(
+            fns, key,
+            _obs.timed_jit(jax.jit(fn), "jax_zone.full", "zone",
+                           self.ev.obs_sig))
 
     def _partial_fn(self, layout, capacity, pcap):
         """Gathered partial tiles: full row-level RPN evaluation over a
@@ -645,7 +649,10 @@ class ZoneEvaluator:
                 first = jnp.full(capacity, _NO_ROW_J, dtype=jnp.int64)
             return first, tuple(carries)
 
-        return _fn_cache_put(fns, key, jax.jit(fn))
+        return _fn_cache_put(
+            fns, key,
+            _obs.timed_jit(jax.jit(fn), "jax_zone.partial", "zone",
+                           self.ev.obs_sig))
 
     # -- merge + run -------------------------------------------------------
 

@@ -279,7 +279,7 @@ class RegionImage:
         self.row_commit_ts = cts
         self._init_fingerprint(handles, values, raw_keys)
         cache = self.block_cache
-        cache.blocks.clear()
+        cache.clear_blocks()
         br = self.block_rows
         for s in range(0, len(values), br):
             e = min(s + br, len(values))
@@ -640,7 +640,7 @@ class RegionImage:
             self._refold()
         # re-chunk into blocks (views over the global arrays) and drop pins
         templates = [blocks[0].cols[ci] if blocks else None for ci in range(len(self.schema))]
-        self.block_cache.blocks.clear()
+        self.block_cache.clear_blocks()  # drops pins WITH accounting
         br = self.block_rows
         n = len(handles)
         for s in range(0, n, br):
@@ -1351,8 +1351,7 @@ class RegionColumnCache:
         if img is None:
             return
         self._unplace(img)
-        img.block_cache.drop_device()
-        img.block_cache.blocks.clear()
+        img.block_cache.clear_blocks()
         img.block_cache.filled = False
         self.stats.invalidations += 1
         from ..util.metrics import REGISTRY
@@ -1373,8 +1372,7 @@ class RegionColumnCache:
                 break
             img = self._images.pop(victim)
             self._unplace(img)
-            img.block_cache.drop_device()
-            img.block_cache.blocks.clear()
+            img.block_cache.clear_blocks()
             img.block_cache.filled = False
             self.stats.evictions += 1
             from ..util.metrics import REGISTRY

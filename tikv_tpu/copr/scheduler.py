@@ -58,6 +58,7 @@ from dataclasses import dataclass, field
 
 from ..analysis.sanitizer import make_condition, make_lock
 from ..util import trace
+from . import observatory as _obs
 from ..util.retry import DeadlineExceeded, ServerBusyError, deadline_from_context
 from . import jax_eval
 from .dag import (
@@ -716,7 +717,7 @@ class CoprReadScheduler:
         if len(live) < 2:
             breaker.release_probe(path)  # nothing launched on this path
             for slot in live:
-                self._shed(slot, "underfull", results, errors)
+                self._shed(slot, "underfull", results, errors, path=path)
             return None
         # cold-fills were answered (and counted) by their own handle_request
         # — the program serves the rest; occupancy counts the whole fan-in.
@@ -764,13 +765,13 @@ class CoprReadScheduler:
             breaker.release_probe(path)
             bsp.tag(outcome="ineligible").finish()
             for slot in live:
-                self._shed(slot, "ineligible", results, errors)
+                self._shed(slot, "ineligible", results, errors, path=path)
             return None
         except Exception as exc:  # noqa: BLE001 — CPU pipeline is the oracle
             self._device_failed(exc, path)
             bsp.tag(outcome="device_error").finish()
             for slot in live:
-                self._shed(slot, "device_error", results, errors)
+                self._shed(slot, "device_error", results, errors, path=path)
             return None
         t_launched = time.perf_counter()
 
@@ -783,7 +784,8 @@ class CoprReadScheduler:
                 self._device_failed(exc, path)
                 bsp.tag(outcome="device_error").finish()
                 for slot in live:
-                    self._shed(slot, "device_error", results, errors)
+                    self._shed(slot, "device_error", results, errors,
+                               path=path)
                 return
             self.ep.breaker.record_success(path)
             pull_dt = time.perf_counter() - t_fin
@@ -808,6 +810,21 @@ class CoprReadScheduler:
                                       occupancy=n_batch)
             if mesh is not None:
                 self._sharded_metrics(device_load, pull_dt)
+            # observatory profiles (docs/observatory.md): every rider the
+            # program answered records its attributed share on the batch
+            # path, with the queue wait it actually paid and the dispatch
+            # trace as its exemplar
+            obs_path = "mesh" if mesh is not None else "xregion"
+            obs_enc = getattr(pending, "obs_encoding", "plain")
+            for slot in live:
+                rows = slot.cache.total_rows if slot.cache is not None else 0
+                for it in slot.items:
+                    if results[it.index] is not None:
+                        continue  # cold-fill: recorded by its handle_request
+                    self._record_obs(
+                        it, ev, obs_path, dt / n_reqs, rows=rows,
+                        encoding=obs_enc, occupancy=n_batch, waste=waste,
+                        dispatch_t=t0)
             for slot, resp in zip(live, resps):
                 data = resp.encode()
                 from_device = True
@@ -839,7 +856,8 @@ class CoprReadScheduler:
             from .tracker import count_path_fallback
 
             count_path_fallback("fused", "breaker_open")
-            self._shed(_Slot(items=items), "breaker_open", results, errors)
+            self._shed(_Slot(items=items), "breaker_open", results, errors,
+                       path="fused")
             return None
         slot = _Slot(items=items)
         try:
@@ -848,7 +866,7 @@ class CoprReadScheduler:
             ok = False
         if not ok:
             self.ep.breaker.release_probe("fused")
-            self._shed(slot, "no_cache", results, errors)
+            self._shed(slot, "no_cache", results, errors, path="fused")
             return None
         cache = slot.cache
         # the filler (cold cache) already answered slot.items[0]
@@ -879,14 +897,16 @@ class CoprReadScheduler:
             # cache) — per-request path, no device-failure attribution
             self.ep.breaker.release_probe("fused")
             bsp.tag(outcome="ineligible").finish()
-            self._shed(_Slot(items=todo), "ineligible", results, errors)
+            self._shed(_Slot(items=todo), "ineligible", results, errors,
+                       path="fused")
             return None
         except Exception as exc:  # noqa: BLE001
             # _resolve_slot guarantees a filled cache here, so there is no
             # partial fill to clean up (the cold-fill path owns that)
             self._device_failed(exc, "fused")
             bsp.tag(outcome="device_error").finish()
-            self._shed(_Slot(items=todo), "device_error", results, errors)
+            self._shed(_Slot(items=todo), "device_error", results, errors,
+                       path="fused")
             return None
         self.ep.breaker.record_success("fused")
         dt = time.perf_counter() - t0
@@ -899,6 +919,19 @@ class CoprReadScheduler:
                                   kind="fused", occupancy=n_reqs)
                 it.batch_ref = ref
         self._batch_metrics("fused", n_reqs, dt, 0.0, n_batch=len(items))
+        # observatory profiles: each rider's plan records its share of the
+        # fused dispatch under its OWN signature (docs/observatory.md).
+        # Recorded AFTER the shadow verdict: on a mismatch the non-probe
+        # groups re-execute per-request (which records them on the path
+        # that actually serves) — recording them here too would double
+        # count and skew the fused rows/s floors.
+        rows = cache.total_rows if cache is not None else 0
+
+        def _rec_fused(group, g_ev):
+            for it in group:
+                self._record_obs(it, g_ev, "fused", dt / n_reqs, rows=rows,
+                                 occupancy=n_reqs, dispatch_t=t0)
+
         if slot.shadow_snap is not None:
             groups = list(uniq.values())
             fixed = self.ep.shadow_compare(groups[0][0].req, slot.shadow_snap,
@@ -908,6 +941,7 @@ class CoprReadScheduler:
                 # signature group serves the oracle bytes already in hand;
                 # the other groups — whose oracle answers were never
                 # computed — re-execute per-request over the rebuilt state
+                _rec_fused(groups[0], evs[0])
                 for it in groups[0]:
                     r = CoprResponse(fixed, from_device=False)
                     self._stamp(r, it, kind="fused", occupancy=n_reqs,
@@ -917,6 +951,8 @@ class CoprReadScheduler:
                     for it in group:
                         self._per_request(it, results, errors, kind="shadow")
                 return None
+        for group, g_ev in zip(uniq.values(), evs):
+            _rec_fused(group, g_ev)
         from_cache = slot.outcome not in ("", "miss", "too_big")
         for group, resp in zip(uniq.values(), resps):
             data = resp.encode()
@@ -988,7 +1024,7 @@ class CoprReadScheduler:
         while len(live) > 1 and waste > self.cfg.padding_budget:
             biggest = max(live, key=lambda s: len(s.cache.blocks))
             live.remove(biggest)
-            self._shed(biggest, "padding", results, errors)
+            self._shed(biggest, "padding", results, errors, path="mesh")
             load = self._device_load(live, mesh)
             waste = self._load_waste(load)
         return live, load, waste
@@ -1034,8 +1070,34 @@ class CoprReadScheduler:
         self._stamp(resp, it, kind=kind, occupancy=1)
         results[it.index] = resp
 
-    def _shed(self, slot: _Slot, reason: str, results, errors) -> None:
+    def _record_obs(self, it: _Item, ev, path: str, latency_s: float, *,
+                    rows: int = 0, encoding: str = "plain",
+                    occupancy: int = 1, waste: float | None = None,
+                    dispatch_t: float | None = None) -> None:
+        """One batch-served rider into the observatory: attributed latency
+        share, the queue wait it actually paid, and its own trace id as the
+        profile exemplar (docs/observatory.md)."""
+        if not _obs.OBSERVATORY.enabled:
+            return
+        sig = getattr(ev, "obs_sig", "")
+        if not sig and it.sig is not None:
+            sig = _obs.sig_id(it.sig)
+        qwait = (max(dispatch_t - it.enqueue_t, 0.0)
+                 if dispatch_t is not None and it.enqueue_t else 0.0)
+        _obs.OBSERVATORY.record_serve(
+            sig, path, latency_s, rows=rows, encoding=encoding,
+            occupancy=occupancy, queue_wait_s=qwait, padding_waste=waste,
+            trace_id=(it.trace_ctx or {}).get("trace_id"),
+            desc=getattr(ev, "obs_desc", ""))
+
+    def _shed(self, slot: _Slot, reason: str, results, errors,
+              path: str = "xregion") -> None:
         self._count_shed(reason)
+        it0 = slot.items[0] if slot.items else None
+        _obs.OBSERVATORY.record_decline(
+            _obs.sig_id(it0.sig) if it0 is not None and it0.sig is not None
+            else None,
+            path, reason)
         for it in slot.items:
             self._per_request(it, results, errors, kind="shed:" + reason)
 

@@ -30,6 +30,12 @@ class TrackedMetrics:
     # rows the incremental delta apply re-decoded
     region_cache: str = ""
     region_cache_delta_rows: int = 0
+    # observatory cross-link (docs/observatory.md): which serving path
+    # answered (zone / unary / fused / xregion / mesh / cpu) and the plan
+    # signature id — a slow-log entry pivots into ``ctl.py observatory sig
+    # <sig>`` the same way its trace_id pivots into ``ctl.py trace show``
+    serve_path: str = ""
+    plan_sig: str = ""
 
     def to_dict(self) -> dict:
         d = {
@@ -43,6 +49,10 @@ class TrackedMetrics:
         if self.region_cache:
             d["region_cache"] = self.region_cache
             d["region_cache_delta_rows"] = self.region_cache_delta_rows
+        if self.serve_path:
+            d["path"] = self.serve_path
+        if self.plan_sig:
+            d["plan_sig"] = self.plan_sig
         return d
 
 

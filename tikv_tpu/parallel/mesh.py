@@ -31,6 +31,7 @@ import jax.numpy as jnp  # noqa: E402
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
 
 from ..analysis.sanitizer import note_blocking
+from ..copr import observatory as _obs
 from ..copr.dag import DagRequest
 from ..copr.jax_eval import (
     _NO_ROW,
@@ -239,7 +240,8 @@ class ShardedDagEvaluator:
 
         # lint: allow(jit-nocache) -- compiled ONCE per evaluator in
         # __init__ (self._step/self._fin memoize the returned callable)
-        return jax.jit(step)
+        return _obs.timed_jit(jax.jit(step), "mesh.agg_step", "mesh",
+                              self.ev.obs_sig)
 
     def init_state(self):
         gshard = self.capacity // self.n_groups
@@ -441,7 +443,8 @@ class ShardedGroupedEvaluator:
 
         # lint: allow(jit-nocache) -- compiled ONCE per evaluator in
         # __init__ (self._step/self._fin memoize the returned callable)
-        return jax.jit(step)
+        return _obs.timed_jit(jax.jit(step), "mesh.grouped_step", "mesh",
+                              self.ev.obs_sig)
 
     def init_state(self):
         dict_keys = jnp.full(self.capacity, _KEY_SENTINEL, dtype=jnp.int64)
@@ -564,7 +567,8 @@ class ShardedTopNEvaluator:
 
         # lint: allow(jit-nocache) -- compiled ONCE per evaluator in
         # __init__ (self._step/self._fin memoize the returned callable)
-        return jax.jit(step)
+        return _obs.timed_jit(jax.jit(step), "mesh.topn_step", "mesh",
+                              self.ev.obs_sig)
 
     def _build_finalize(self):
         k = self.k
@@ -595,7 +599,8 @@ class ShardedTopNEvaluator:
 
         # lint: allow(jit-nocache) -- compiled ONCE per evaluator in
         # __init__ (self._step/self._fin memoize the returned callable)
-        return jax.jit(fin)
+        return _obs.timed_jit(jax.jit(fin), "mesh.topn_fin", "mesh",
+                              self.ev.obs_sig)
 
     def init_state(self):
         from ..copr.jax_eval import _np_dtype
@@ -998,7 +1003,7 @@ def launch_xregion_sharded(ev: JaxDagEvaluator, caches, mesh: Mesh) -> XRegionPe
             leaves = [first] + jax.tree.leaves(merged)
             return _pack_region_leaves(leaves, R, capacity)  # (R, L*, cap)
 
-        fn = jax.jit(xfn)
+        fn = _obs.timed_jit(jax.jit(xfn), "mesh.xshard", "mesh", ev.obs_sig)
         ev._agg_fn_cache[key] = fn
         xkeys = [k for k in ev._agg_fn_cache if isinstance(k, tuple)
                  and k and k[0] == "xshard"]
@@ -1007,7 +1012,10 @@ def launch_xregion_sharded(ev: JaxDagEvaluator, caches, mesh: Mesh) -> XRegionPe
 
     packed = fn(col_data, col_nulls, slab_region, n_valids, offsets, dl_arr,
                 ref_arr)
-    return XRegionPending(ev, specs, capacity, packed, order=None)
+    pending = XRegionPending(ev, specs, capacity, packed, order=None)
+    # observatory encoding label for the riders' profiles
+    pending.obs_encoding = "encoded" if plans else "plain"
+    return pending
 
 
 def run_xregion_sharded(ev: JaxDagEvaluator, caches, mesh: Mesh):
@@ -1046,6 +1054,10 @@ class MeshServingRunner:
         self.total_rows = self.sharded.total_rows
         # decode/gid/finalize machinery at super-block granularity
         self.decode_ev = JaxDagEvaluator(dag, block_rows=self.total_rows)
+        # observatory profile key: cold mesh serves record under the same
+        # plan sig as every other path (docs/observatory.md)
+        self.obs_sig = self.decode_ev.obs_sig
+        self.obs_desc = self.decode_ev.obs_desc
 
     def _grow(self, state, n_groups: int):
         from ..copr.jax_eval import _grow_carry
@@ -1095,4 +1107,6 @@ class MeshServingRunner:
             block_base += total
         n_slots = len(groups) if ev.group_rpns else 1
         state_np = jax.tree.map(np.asarray, state)
-        return ev._finalize_agg(state_np, n_slots, lambda r: groups.rows[r])
+        resp = ev._finalize_agg(state_np, n_slots, lambda r: groups.rows[r])
+        resp._obs_path = "mesh"  # observatory path marker
+        return resp

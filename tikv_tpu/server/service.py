@@ -990,6 +990,23 @@ class KvService:
             }
         return {"stages": stages}
 
+    def debug_observatory(self, req: dict) -> dict:
+        """Performance-observatory state (docs/observatory.md; ``ctl.py
+        observatory`` and the status server's ``/debug/observatory``):
+        per-plan-signature path cost profiles, the compile ledger, and the
+        pinned-HBM watermarks.  ``sig`` narrows to one signature; ``top``
+        returns the time-spent leaderboard instead of the full snapshot;
+        ``floor`` returns the per-sig rows/s baselines obs_diff.py gates
+        on."""
+        from ..copr import observatory as obs
+
+        if req.get("top"):
+            return {"top": obs.OBSERVATORY.top(int(req.get("limit", 20)))}
+        if req.get("floor"):
+            return obs.OBSERVATORY.floor(
+                min_count=int(req.get("min_count", 3)))
+        return obs.OBSERVATORY.snapshot(sig=req.get("sig"))
+
     def debug_traces(self, req: dict) -> dict:
         """Recent + slow traces from the process tracer (docs/tracing.md):
         the ``ctl.py trace`` surface.  ``trace_id`` narrows to one trace;

@@ -96,6 +96,39 @@ class StatusServer:
                         lines.append(trace.timeline(t))
                 self._send(200, "\n".join(lines).encode())
 
+            def _serve_observatory(self, url):
+                from ..copr import observatory as obs
+
+                q = parse_qs(url.query)
+                sig = q.get("sig", [None])[0]
+                as_json = q.get("format", [""])[0] == "json"
+                try:
+                    limit = int(q.get("limit", ["20"])[0])
+                except ValueError:
+                    self._send(400, b"limit must be an integer")
+                    return
+                snap = obs.OBSERVATORY.snapshot(sig=sig)
+                if as_json:
+                    self._send(200, json.dumps(snap).encode(),
+                               "application/json")
+                    return
+                if sig:
+                    entry = snap["sigs"].get(sig)
+                    if entry is None:
+                        self._send(404, f"sig {sig} not profiled".encode())
+                        return
+                    body = obs.format_sig(sig, entry)
+                else:
+                    comp = snap["compiles"]
+                    body = "\n".join([
+                        f"observatory: sigs={snap['live_sigs']} "
+                        f"evicted={snap['evicted_sigs']} "
+                        f"window={snap['window_s']}s x{snap['n_windows']} "
+                        f"compiles={len(comp['events'])}",
+                        obs.format_top(obs.OBSERVATORY.top(limit)),
+                    ])
+                self._send(200, body.encode())
+
             def do_GET(self):
                 url = urlparse(self.path)
                 if url.path == "/metrics":
@@ -133,6 +166,12 @@ class StatusServer:
                         return
                     self._send(200, json.dumps(outer.read_progress()).encode(),
                                "application/json")
+                elif url.path == "/debug/observatory":
+                    # performance observatory (docs/observatory.md): per-sig
+                    # path cost profiles + the compile ledger + HBM
+                    # watermarks.  ?sig= narrows, ?format=json for the raw
+                    # snapshot, default text = profiler-style top
+                    self._serve_observatory(url)
                 elif url.path == "/debug/integrity":
                     # derived-plane integrity: fingerprints, quarantine
                     # ledger, scrubber + shadow-read state (docs/integrity.md)

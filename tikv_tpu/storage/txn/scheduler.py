@@ -384,6 +384,11 @@ class Scheduler:
                 "total_ms": round(total * 1000, 3),
                 "group_size": group,
                 "status": "error" if t.exc is not None else "done",
+                # observatory-parity fields (docs/observatory.md): the write
+                # path's serving shape and command signature, so copr and
+                # txn slow-log entries carry the same pivot keys
+                "path": "txn_group" if group > 1 else "txn",
+                "plan_sig": f"txn:{type(t.cmd).__name__}",
             }
             if t.trace_ctx and t.trace_ctx.get("trace_id"):
                 fields["trace_id"] = t.trace_ctx["trace_id"]

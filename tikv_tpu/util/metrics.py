@@ -81,6 +81,18 @@ class Histogram(_Metric):
         with self._mu:
             return self._n.get(tuple(sorted(labels.items())), 0)
 
+    def percentile(self, q: float, **labels) -> float:
+        """Bucket-interpolated percentile for a label set (``q`` in [0, 1]):
+        the same estimate PromQL's histogram_quantile computes, locally.
+        Returns 0.0 for an empty histogram; observations past the last
+        finite bucket clamp to that bucket's bound (the +Inf bucket has no
+        upper edge to interpolate toward)."""
+        key = tuple(sorted(labels.items()))
+        with self._mu:
+            counts = list(self._counts.get(key, ()))
+            n = self._n.get(key, 0)
+        return percentile_from_buckets(self.buckets, counts, n, q)
+
     def total(self, **labels) -> float:
         """Accumulated observed value for a label set (the _sum series)."""
         with self._mu:
@@ -108,6 +120,29 @@ class Histogram(_Metric):
             lines.append(f"{self.name}_sum{_fmt_labels(key)} {_s}")
             lines.append(f"{self.name}_count{_fmt_labels(key)} {_n}")
         return "\n".join(lines)
+
+
+def percentile_from_buckets(buckets, counts, n: int, q: float) -> float:
+    """Shared bucket-interpolation core behind :meth:`Histogram.percentile`
+    and the observatory's windowed p50/p95/p99 accessors
+    (copr/observatory.py): ``buckets`` are the finite upper bounds,
+    ``counts`` the per-bucket (non-cumulative) counts with the +Inf
+    overflow last, ``n`` the total observation count."""
+    if n <= 0 or not counts:
+        return 0.0
+    q = min(max(q, 0.0), 1.0)
+    rank = q * n
+    cum = 0.0
+    lower = 0.0
+    for i, b in enumerate(buckets):
+        c = counts[i] if i < len(counts) else 0
+        if cum + c >= rank and c > 0:
+            frac = (rank - cum) / c
+            return lower + (b - lower) * frac
+        cum += c
+        lower = b
+    # rank lands in the +Inf bucket: clamp to the last finite bound
+    return float(buckets[-1]) if buckets else 0.0
 
 
 def _fmt_labels(key: tuple, **extra) -> str:
