@@ -536,6 +536,107 @@ def run(rows: int = 60_000, scan_seconds: float = 8.0, scan_len: int = 50,
         out["q1_wire_rows_per_s"] = round(
             rows * (total_reqs / max(len(regions), 1)) / wire_dt, 1)
 
+        # ---- TypeChunk wire serving (docs/wire_path.md) -------------------
+        # The same sustained Q1 workload with the per-request chunk opt-in:
+        # responses come back as column slabs (encode_type + data_parts),
+        # decoded against the sent plan and merged to the same oracle groups
+        from tikv_tpu.copr.dag import (
+            ENC_TYPE_CHUNK,
+            decode_wire_response,
+            response_data,
+        )
+
+        chunk_dag = q1_dag()
+        chunk_dag.encode_type = ENC_TYPE_CHUNK
+        wire_dag_chunk = dag_to_wire(chunk_dag)
+        chunk_req = dict(wire_req, dag=wire_dag_chunk)
+
+        def q1_chunk_retry(conn_cache, sid, rid, timeout=30.0, attempts=8):
+            last = None
+            for i in range(attempts):
+                c = conn_cache.get(sid)
+                if c is None:
+                    addr = cluster.pd.get_store_addr(sid)
+                    c = conn_cache[sid] = cluster.Client(addr[0], addr[1])
+                r = c.call("coprocessor",
+                           dict(chunk_req, context={"region_id": rid}),
+                           timeout=timeout)
+                err = r.get("error")
+                if not err:
+                    return r
+                if not any(k in err for k in _TRANSIENT_REFUSALS):
+                    raise RuntimeError(str(err))
+                last = err
+                time.sleep(0.05 * (i + 1))
+            raise RuntimeError(
+                f"transient refusal persisted after {attempts} attempts "
+                f"(store {sid}, region {rid}): {last}")
+
+        chunk_counts: dict[int, int] = {rid: 0 for rid in regions}
+        chunk_count_mu = threading.Lock()
+        chunk_samples: dict[int, dict] = {}
+        chunk_errs: list = []
+        chunk_secs = float(os.environ.get("BENCH_CLUSTER_WIRE_SECONDS", "6"))
+        # warmup one chunk request per region (negotiation + encoder path)
+        warm_chunk: dict[int, object] = {}
+        for rid in regions:
+            q1_chunk_retry(warm_chunk, leaders[rid], rid, timeout=120.0)
+        for c in warm_chunk.values():
+            try:
+                c.close()
+            except OSError:
+                pass
+        chunk_stop = time.monotonic() + chunk_secs
+
+        def chunk_worker(rid: int):
+            conns: dict[int, object] = {}
+            served = 0
+            try:
+                while time.monotonic() < chunk_stop:
+                    r = q1_chunk_retry(conns, leaders[rid], rid)
+                    if not r.get("encode_type"):
+                        raise AssertionError(
+                            f"region {rid}: chunk opt-in answered datum")
+                    prev = chunk_samples.setdefault(rid, r)
+                    if response_data(prev) != response_data(r):
+                        raise AssertionError(
+                            f"region {rid}: chunk response bytes drifted")
+                    served += 1
+            except Exception as exc:  # noqa: BLE001
+                chunk_errs.append(exc)
+            finally:
+                with chunk_count_mu:
+                    chunk_counts[rid] += served
+                for c in conns.values():
+                    try:
+                        c.close()
+                    except OSError:
+                        pass
+
+        t0 = time.perf_counter()
+        cts = [threading.Thread(target=chunk_worker, args=(rid,))
+               for rid in regions for _ in range(clients_per_region)]
+        for t in cts:
+            t.start()
+        for t in cts:
+            t.join()
+        chunk_dt = time.perf_counter() - t0
+        if chunk_errs:
+            raise chunk_errs[0]
+        merged_chunk: dict[tuple, list] = {}
+        for rid, resp in chunk_samples.items():
+            for row in decode_wire_response(resp, chunk_dag).iter_rows():
+                key = (row[4], row[5])
+                acc = merged_chunk.setdefault(key, [0, 0])
+                acc[0] += int(row[0])
+                acc[1] += int(row[3])
+        if merged_chunk != merged:
+            raise AssertionError("TypeChunk wire serving merge differs from oracle")
+        chunk_total = sum(chunk_counts.values())
+        out["q1_wire_chunk_requests"] = chunk_total
+        out["q1_wire_chunk_rows_per_s"] = round(
+            rows * (chunk_total / max(len(regions), 1)) / chunk_dt, 1)
+
         # ---- Q1 via the device store -------------------------------------
         # One accelerator per deployment: every region's device-eligible DAG
         # routes to the store that owns it, using follower replica reads

@@ -27,6 +27,10 @@ regresses:
   scheduler lanes + zero-copy frames, the standalone default) vs
   per-request CPU serving over real TCP connections.  Fails on byte
   divergence, a speedup below the 5x floor, or zero batch-served requests.
+* ``wire_chunk`` (ISSUE 14): TypeChunk column-slab responses vs datum rows
+  on the SAME socket workload (6 client connections, client decode
+  included) — fails on value divergence, a chunk-vs-datum speedup below
+  the 3x floor, or zero TypeChunk-served responses.
 * ``compressed`` (ISSUE 10): encoded device-resident columns
   (docs/compressed_columns.md) — byte-identity of encoded serving vs the
   CPU oracle, and the warm-capacity multiplier at one fixed byte budget.
@@ -53,6 +57,7 @@ MIN_SHARDED_SPEEDUP = 1.5
 MIN_GROUP_SPEEDUP = 2.0
 MIN_WARM_HIT_RATE = 0.5
 MIN_WIRE_SPEEDUP = 5.0
+MIN_WIRE_CHUNK_SPEEDUP = 3.0
 MIN_COMPRESSED_CAPACITY = 2.0
 SHARDED_DEVICES = 8
 
@@ -173,6 +178,32 @@ def main() -> int:
     if wire_regressions:
         ok = False
         out["wire_regression"] = "; ".join(wire_regressions)
+
+    # columnar chunk wire floor (ISSUE 14): the SAME socket workload (6
+    # client connections) served TypeChunk must beat the datum wire path
+    # ≥3x end-to-end INCLUDING the client decode — shipping column slabs to
+    # the client is the contract (docs/wire_path.md)
+    rk = bench._op_wire_chunk({
+        "regions": 4, "rows": args.xregion_rows,
+        "trials": max(args.trials, 3),
+    }, {})
+    out["wire_chunk_match"] = bool(rk["match"])
+    ok = ok and rk["match"]
+    k_datum = float(np.median(rk["datum_ts"]))
+    k_chunk = float(np.median(rk["chunk_ts"]))
+    kspeed = k_datum / k_chunk
+    out["wire_chunk_requests"] = rk["requests"]
+    out["wire_chunk_speedup"] = round(kspeed, 2)
+    out["wire_chunk_served"] = rk["chunk_served"]
+    chunk_regressions = []
+    if kspeed < MIN_WIRE_CHUNK_SPEEDUP:
+        chunk_regressions.append(
+            f"{kspeed:.2f}x < {MIN_WIRE_CHUNK_SPEEDUP}x floor")
+    if rk["chunk_served"] <= 0:
+        chunk_regressions.append("no responses served TypeChunk")
+    if chunk_regressions:
+        ok = False
+        out["wire_chunk_regression"] = "; ".join(chunk_regressions)
 
     # mesh-sharded warm serving on the 8-virtual-device mesh (ISSUE 3)
     rs = _run_sharded(args)

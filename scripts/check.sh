@@ -44,4 +44,8 @@ echo "== compressed-columns smoke: encoded residency, delta demotions, code-spac
 JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
   -m 'not slow' tests/test_encoding.py tests/test_compressed_columns.py
 
+echo "== chunk-wire smoke: TypeChunk negotiation, differential byte-identity, zero-copy parts under the sanitizer =="
+JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
+  -m 'not slow' tests/test_chunk_codec.py tests/test_chunk_wire.py
+
 echo "check.sh: all gates green"

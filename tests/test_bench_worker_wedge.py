@@ -113,6 +113,38 @@ def test_wait_ready_returns_timeout_on_wedge_without_burning_budget():
     assert "worker_died_at_init" not in _events(w2)
 
 
+def test_init_wait_heartbeats_coalesce_into_one_timeline_event():
+    """BENCH_r05 logged one worker_init_wait event every 10s for 900s — 90
+    near-identical lines drowning the JSON tail.  Repeats now fold into a
+    SINGLE timeline entry carrying first_t/last_t/count, and the eventual
+    ready/backend_probe verdicts are untouched."""
+    w = _bare_worker(stall_s=900.0)
+    for t in (10.0, 20.0, 30.0, 40.0):
+        w._q.put({"ev": "init_wait", "t": t})
+    w._q.put({"ev": "ready", "platform": "cpu", "t": 45.0})
+    assert w.wait_ready(900.0) == "ready"
+    waits = [e for e in w.timeline if e["ev"] == "worker_init_wait"]
+    assert len(waits) == 1
+    assert waits[0]["first_t"] == 10.0
+    assert waits[0]["last_t"] == 40.0
+    assert waits[0]["count"] == 4
+    # the ready verdict still lands as its own event
+    assert _events(w).count("ready") == 1
+
+
+def test_init_wait_coalescing_keeps_stall_backstop():
+    """Folding the heartbeat spam must not disable wait_ready's stale-
+    heartbeat backstop: a beat whose worker clock passed the stall budget
+    still earns the named wedge verdict."""
+    w = _bare_worker(stall_s=2.0)
+    w._q.put({"ev": "init_wait", "t": 1.0})
+    w._q.put({"ev": "init_wait", "t": 5.0})
+    assert w.wait_ready(900.0) == "timeout"
+    assert w._wedged == "backend_init_stall"
+    waits = [e for e in w.timeline if e["ev"] == "worker_init_wait"]
+    assert len(waits) == 1 and waits[0]["count"] == 2
+
+
 def test_wait_ready_backstop_wedges_on_stale_init_wait():
     """Even if the monitor thread never ran, an init_wait heartbeat whose
     own worker-side clock passed the stall budget triggers the verdict in

@@ -1,0 +1,247 @@
+"""chunk_codec round-trip property tests (ISSUE 14 satellite).
+
+The codec predates any direct coverage: every FieldTypeTp (including
+FLOAT's 4-byte cells and the 40-byte decimal struct), null bitmaps at
+rows % 8 ∈ {0..7}, empty and all-null columns, var-len offset
+monotonicity — for BOTH builders (the append-oriented ChunkColumn and the
+vectorized ``encode_np_column`` the serving plane uses), which must emit
+identical bytes.
+"""
+
+from __future__ import annotations
+
+import random
+import struct
+
+import numpy as np
+import pytest
+
+from tikv_tpu.copr import chunk_codec as cc
+from tikv_tpu.copr.chunk_codec import (
+    DECIMAL_STRUCT_SIZE,
+    ChunkColumn,
+    column_values,
+    decode_chunk,
+    decode_column,
+    encode_chunk,
+    encode_np_column,
+)
+from tikv_tpu.copr.datatypes import EvalType, FieldType, FieldTypeTp, UNSIGNED_FLAG
+
+
+def _rand_value(rng: random.Random, ft: FieldType):
+    et = ft.eval_type
+    if et == EvalType.INT:
+        if ft.is_unsigned:
+            return rng.randrange(0, 2**64)
+        return rng.randrange(-2**63, 2**63)
+    if et == EvalType.REAL:
+        v = rng.uniform(-1e9, 1e9)
+        return struct.unpack("<f", struct.pack("<f", v))[0] if cc.fixed_len(ft) == 4 else v
+    if et == EvalType.DECIMAL:
+        return (rng.randrange(-10**17, 10**17), ft.decimal)
+    if et == EvalType.DATETIME:
+        return rng.randrange(0, 2**62)
+    if et == EvalType.DURATION:
+        return rng.randrange(-10**12, 10**12)
+    if et == EvalType.ENUM:
+        return rng.randrange(1, len(ft.elems) + 1)
+    # BYTES / JSON / SET payloads ride raw
+    return bytes(rng.randrange(256) for _ in range(rng.randrange(0, 24)))
+
+
+_ALL_TPS = [
+    FieldType(FieldTypeTp.TINY),
+    FieldType(FieldTypeTp.SHORT),
+    FieldType(FieldTypeTp.INT24),
+    FieldType(FieldTypeTp.LONG),
+    FieldType(FieldTypeTp.LONGLONG),
+    FieldType(FieldTypeTp.LONGLONG, UNSIGNED_FLAG),
+    FieldType(FieldTypeTp.FLOAT),
+    FieldType(FieldTypeTp.DOUBLE),
+    FieldType(FieldTypeTp.NEW_DECIMAL, decimal=2),
+    FieldType(FieldTypeTp.NEW_DECIMAL, decimal=0),
+    FieldType(FieldTypeTp.NEW_DECIMAL, decimal=11),
+    FieldType(FieldTypeTp.DATE),
+    FieldType(FieldTypeTp.DATETIME),
+    FieldType(FieldTypeTp.TIMESTAMP),
+    FieldType(FieldTypeTp.DURATION),
+    FieldType(FieldTypeTp.BLOB),
+    FieldType(FieldTypeTp.VAR_STRING),
+    FieldType(FieldTypeTp.STRING),
+    FieldType(FieldTypeTp.JSON),
+    FieldType.enum_type([b"a", b"bb", b"ccc"]),
+    FieldType(FieldTypeTp.SET, elems=(b"x", b"y")),
+]
+
+
+@pytest.mark.parametrize("ft", _ALL_TPS, ids=lambda ft: f"{ft.tp.name}{'u' if ft.is_unsigned else ''}d{ft.decimal}")
+@pytest.mark.parametrize("n", [0, 1, 5, 7, 8, 9, 15, 16, 17, 100])
+def test_roundtrip_every_field_type(ft, n):
+    """Append n values (null density ~1/3), encode, decode, compare —
+    covering every rows%8 bitmap remainder, empty, and var-len offsets."""
+    rng = random.Random(n * 1000 + int(ft.tp))
+    col = ChunkColumn(ft)
+    want = []
+    for _ in range(n):
+        if rng.random() < 0.33:
+            col.append_null()
+            want.append(None)
+        else:
+            v = _rand_value(rng, ft)
+            col.append(v)
+            want.append(v)
+    blob = col.encode()
+    out, pos = decode_column(blob, 0, ft)
+    assert pos == len(blob)
+    got = column_values(out)
+    for w, g in zip(want, got):
+        if w is None:
+            assert g is None
+        elif ft.eval_type == EvalType.REAL:
+            assert g == pytest.approx(w)
+        elif ft.eval_type == EvalType.ENUM:
+            assert g == w  # chunk enum decodes the u64 index
+        elif ft.eval_type in (EvalType.BYTES, EvalType.JSON) or ft.tp == FieldTypeTp.SET:
+            assert bytes(g) == bytes(w)
+        else:
+            assert g == w
+    # var-len offsets are monotone and end at the data length
+    if not col.fixed:
+        assert out.offsets[0] == 0
+        assert all(a <= b for a, b in zip(out.offsets, out.offsets[1:]))
+        assert out.offsets[-1] == len(out.data)
+
+
+def test_all_null_column_roundtrip():
+    ft = FieldType(FieldTypeTp.LONGLONG)
+    col = ChunkColumn(ft)
+    for _ in range(11):
+        col.append_null()
+    out, _ = decode_column(col.encode(), 0, ft)
+    assert column_values(out) == [None] * 11
+    assert out.null_cnt == 11
+
+
+def test_no_null_column_omits_bitmap():
+    ft = FieldType(FieldTypeTp.LONGLONG)
+    col = ChunkColumn(ft)
+    for i in range(9):
+        col.append(i)
+    blob = col.encode()
+    # header + 9 * 8 cell bytes, NO bitmap when null_cnt == 0
+    assert len(blob) == 8 + 9 * 8
+    out, _ = decode_column(blob, 0, ft)
+    assert column_values(out) == list(range(9))
+
+
+@pytest.mark.parametrize("ft", [
+    FieldType(FieldTypeTp.LONGLONG),
+    FieldType(FieldTypeTp.DOUBLE),
+    FieldType(FieldTypeTp.DURATION),
+    FieldType(FieldTypeTp.DATETIME),
+    FieldType(FieldTypeTp.NEW_DECIMAL, decimal=2),
+    FieldType(FieldTypeTp.NEW_DECIMAL, decimal=13),
+    FieldType(FieldTypeTp.VAR_STRING),
+    FieldType(FieldTypeTp.JSON),
+], ids=lambda ft: f"{ft.tp.name}d{ft.decimal}")
+@pytest.mark.parametrize("n", [0, 1, 7, 8, 9, 64, 257])
+def test_vectorized_encode_byte_identical(ft, n):
+    """encode_np_column (the serving-plane encoder) emits the EXACT bytes
+    the append builder does for the same logical values."""
+    rng = np.random.default_rng(n + int(ft.tp))
+    et = ft.eval_type
+    nulls = rng.random(n) < 0.3
+    if et == EvalType.INT:
+        data = rng.integers(-2**62, 2**62, n)
+    elif et == EvalType.REAL:
+        data = rng.standard_normal(n)
+    elif et == EvalType.DECIMAL:
+        data = rng.integers(-10**17, 10**17, n)
+    elif et in (EvalType.DATETIME,):
+        data = rng.integers(0, 2**62, n)
+    elif et == EvalType.DURATION:
+        data = rng.integers(-10**12, 10**12, n)
+    else:
+        data = np.empty(n, object)
+        for i in range(n):
+            data[i] = bytes(rng.integers(0, 255, rng.integers(0, 20)).astype(np.uint8))
+    col = ChunkColumn(ft)
+    for i in range(n):
+        if nulls[i]:
+            col.append_null()
+        elif et == EvalType.DECIMAL:
+            col.append((int(data[i]), ft.decimal))
+        elif et == EvalType.REAL:
+            col.append(float(data[i]))
+        elif et in (EvalType.BYTES, EvalType.JSON):
+            col.append(data[i])
+        else:
+            col.append(int(data[i]))
+    assert encode_np_column(ft, data, nulls) == col.encode()
+
+
+def test_vectorized_encode_dictionary_column():
+    """Dictionary-coded BYTES columns encode through the dictionary — the
+    same bytes a decoded (materialized) column produces."""
+    ft = FieldType(FieldTypeTp.VAR_STRING)
+    d = np.array([b"apple", b"banana", b"cherry"], dtype=object)
+    codes = np.array([0, 2, 1, 1, 0], dtype=np.int64)
+    nulls = np.array([False, False, True, False, False])
+    want = encode_np_column(ft, d[codes], nulls)
+    assert encode_np_column(ft, codes, nulls, dictionary=d) == want
+
+
+def test_decimal_cells_vectorized_identity_and_roundtrip():
+    rng = np.random.default_rng(7)
+    for frac in range(0, cc.MAX_VEC_DECIMAL_FRAC + 1):
+        vals = np.concatenate([
+            rng.integers(-10**18, 10**18, 100),
+            np.array([0, 1, -1, 9, 10**17, -(2**63), 2**63 - 1], np.int64),
+        ]).astype(np.int64)
+        cells = cc.encode_decimal_cells(vals, frac)
+        for i, v in enumerate(vals):
+            assert cells[i].tobytes() == cc.encode_decimal_cell(int(v), frac)
+        assert np.array_equal(cc.decode_decimal_cells(cells, frac), vals)
+    with pytest.raises(ValueError):
+        cc.encode_decimal_cells(np.zeros(1, np.int64), cc.MAX_VEC_DECIMAL_FRAC + 1)
+
+
+def test_column_numpy_matches_column_values():
+    rng = np.random.default_rng(3)
+    n = 41
+    for ft, data in [
+        (FieldType(FieldTypeTp.LONGLONG), rng.integers(-2**62, 2**62, n)),
+        (FieldType(FieldTypeTp.DOUBLE), rng.standard_normal(n)),
+        (FieldType(FieldTypeTp.NEW_DECIMAL, decimal=4), rng.integers(-10**15, 10**15, n)),
+        (FieldType(FieldTypeTp.DATETIME), rng.integers(0, 2**62, n)),
+    ]:
+        nulls = rng.random(n) < 0.25
+        col, _ = decode_column(encode_np_column(ft, data, nulls), 0, ft)
+        vec, vn = cc.column_numpy(col)
+        assert np.array_equal(vn, nulls)
+        scalar = column_values(col)
+        for i in range(n):
+            if nulls[i]:
+                assert scalar[i] is None
+            elif ft.eval_type == EvalType.DECIMAL:
+                assert scalar[i] == (int(vec[i]), ft.decimal)
+            else:
+                assert scalar[i] == pytest.approx(vec[i])
+
+
+def test_multi_column_chunk_roundtrip_and_truncation_guards():
+    fts = [FieldType(FieldTypeTp.LONGLONG), FieldType(FieldTypeTp.VAR_STRING)]
+    cols = []
+    for ft in fts:
+        c = ChunkColumn(ft)
+        for i in range(5):
+            c.append(i if ft.eval_type == EvalType.INT else b"v%d" % i)
+        cols.append(c)
+    blob = encode_chunk(cols)
+    back = decode_chunk(blob, fts)
+    assert [column_values(c) for c in back] == [column_values(c) for c in cols]
+    with pytest.raises(ValueError):
+        decode_chunk(blob + b"\x00", fts)  # trailing bytes
+    with pytest.raises(ValueError):
+        decode_chunk(blob[:-1], fts)  # truncated cell data

@@ -1,0 +1,599 @@
+"""Columnar chunk wire format end-to-end (ISSUE 14, docs/wire_path.md
+"Columnar chunk responses").
+
+* differential byte-identity: TypeChunk responses decode to EXACTLY the
+  datum-path rows (the CPU oracle) for every executor shape
+  (scan/selection/agg/topN) × both row formats (rowv1/rowv2) × both
+  residencies (encoded/decoded region images), including streamed frames
+  and multi-region batched frames;
+* negotiation: datum stays the default, unsupported field types decline to
+  datum with a counted cause — never an error — and the service parse memo
+  keys datum and chunk variants of one plan separately;
+* zero-copy egress: each encoded column slab ≥ PASSTHROUGH_MIN rides the
+  response frame as its OWN memoryview part through ``wire.dumps_parts``;
+* scheduler: chunk and datum riders never share a response slot, and
+  socket-coalesced chunk serving matches serial chunk serving and the
+  oracle.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from copr_fixtures import PRODUCT_COLUMNS, TABLE_ID, product_engine
+from fixtures import put_committed
+
+from tikv_tpu.copr import chunk_codec
+from tikv_tpu.copr.aggr import AggDescriptor
+from tikv_tpu.copr.dag import (
+    ENC_TYPE_CHUNK,
+    ENC_TYPE_DATUM,
+    Aggregation,
+    DagRequest,
+    Selection,
+    SelectResponse,
+    TableScan,
+    TopN,
+    chunk_output_field_types,
+    datum_twin,
+    decode_wire_response,
+    negotiate_encode_type,
+    response_data,
+)
+from tikv_tpu.copr.dag_wire import dag_to_wire
+from tikv_tpu.copr.datatypes import ColumnInfo, FieldType
+from tikv_tpu.copr.endpoint import CoprRequest, Endpoint, resolve_encode_type
+from tikv_tpu.copr.region_cache import RegionColumnCache
+from tikv_tpu.copr.rpn import call as rpn_call, col, const_int
+from tikv_tpu.copr.table import encode_row, record_key, record_range
+from tikv_tpu.copr.rowv2 import encode_row_v2
+from tikv_tpu.server import wire
+from tikv_tpu.server.server import Client, Server
+from tikv_tpu.server.service import KvService
+from tikv_tpu.storage.btree_engine import BTreeEngine
+from tikv_tpu.storage.kv import LocalEngine
+from tikv_tpu.storage.storage import Storage
+from tikv_tpu.util.metrics import REGISTRY
+
+CHUNK_C = REGISTRY.counter("tikv_wire_chunk_total")
+
+_COLUMNS = [
+    ColumnInfo(1, FieldType.int64(), is_pk_handle=True),
+    ColumnInfo(2, FieldType.varchar()),
+    ColumnInfo(3, FieldType.int64()),
+    ColumnInfo(4, FieldType.decimal_type(2)),
+]
+
+
+def _rows(n: int):
+    rng = np.random.default_rng(5)
+    out = []
+    for i in range(n):
+        name = None if rng.random() < 0.1 else b"item-%d" % (i % 7)
+        cnt = None if rng.random() < 0.1 else int(rng.integers(-500, 500))
+        price = None if rng.random() < 0.1 else int(rng.integers(0, 10**6))
+        out.append((i, name, cnt, price))
+    return out
+
+
+def _engine(rows, v2: bool) -> BTreeEngine:
+    eng = BTreeEngine()
+    non_handle = _COLUMNS[1:]
+    for rid, name, cnt, price in rows:
+        raw = (encode_row_v2(non_handle, [name, cnt, price]) if v2
+               else encode_row(non_handle, [name, cnt, price]))
+        put_committed(eng, record_key(TABLE_ID, rid), raw, 90, 100)
+    return eng
+
+
+def _plans():
+    return {
+        "scan": [TableScan(TABLE_ID, _COLUMNS)],
+        "selection": [TableScan(TABLE_ID, _COLUMNS),
+                      Selection([rpn_call("lt", col(2), const_int(100))])],
+        "agg": [TableScan(TABLE_ID, _COLUMNS),
+                Aggregation([col(1)], [AggDescriptor("sum", col(2)),
+                                       AggDescriptor("count", None)])],
+        "topn": [TableScan(TABLE_ID, _COLUMNS), TopN([(col(2), True)], 9)],
+    }
+
+
+def _req(execs, enc, **ctx):
+    return CoprRequest(
+        103, DagRequest(executors=list(execs), encode_type=enc),
+        [record_range(TABLE_ID)], 150,
+        context={"region_id": 1, **ctx})
+
+
+# ---------------------------------------------------------------------------
+# differential byte-identity: executors × row formats × residency
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("v2", [False, True], ids=["rowv1", "rowv2"])
+@pytest.mark.parametrize("device", [False, True], ids=["cpu", "device"])
+def test_chunk_rows_equal_datum_oracle_every_executor(v2, device):
+    eng = LocalEngine(_engine(_rows(300), v2))
+    ep = Endpoint(eng, enable_device=device)
+    ep_oracle = Endpoint(eng, enable_device=False)
+    for name, execs in _plans().items():
+        rd = ep_oracle.handle_request(_req(execs, ENC_TYPE_DATUM))
+        rc = ep.handle_request(_req(execs, ENC_TYPE_CHUNK))
+        assert rc.encode_type == ENC_TYPE_CHUNK, name
+        dag_c = DagRequest(executors=list(execs), encode_type=ENC_TYPE_CHUNK)
+        rows_c = decode_wire_response(
+            {"data_parts": rc.data_parts or [rc.data], "encode_type": 1},
+            dag_c).iter_rows()
+        rows_d = SelectResponse.decode(rd.data).iter_rows()
+        assert rows_c == rows_d, name
+
+
+def test_chunk_identity_encoded_and_decoded_residency():
+    """Warm region images in BOTH residencies (compressed encoded columns
+    and plain decoded) serve chunk responses identical to the datum oracle
+    — the EncodedColumn.take late-materialization path included."""
+    # low-cardinality name column → sorted dictionary; narrow cnt → bitpack
+    eng = BTreeEngine()
+    non_handle = _COLUMNS[1:]
+    rng = np.random.default_rng(11)
+    for i in range(400):
+        vals = [b"n%d" % (i % 5), int(rng.integers(0, 50)),
+                int(rng.integers(0, 1000))]
+        put_committed(eng, record_key(TABLE_ID, i),
+                      encode_row(non_handle, vals), 90, 100)
+    oracle_ep = Endpoint(LocalEngine(eng), enable_device=False)
+    for encode_columns in (True, False):
+        ep = Endpoint(LocalEngine(eng), enable_device=True,
+                      region_cache=RegionColumnCache(
+                          encode_columns=encode_columns))
+        for name, execs in _plans().items():
+            ctx = {"region_epoch": (1, 1), "apply_index": 7}
+            ep.handle_request(_req(execs, ENC_TYPE_CHUNK, **ctx))  # fill
+            rc = ep.handle_request(_req(execs, ENC_TYPE_CHUNK, **ctx))
+            rd = oracle_ep.handle_request(_req(execs, ENC_TYPE_DATUM))
+            dag_c = DagRequest(executors=list(execs),
+                               encode_type=ENC_TYPE_CHUNK)
+            rows_c = decode_wire_response(
+                {"data_parts": rc.data_parts or [rc.data], "encode_type": 1},
+                dag_c).iter_rows()
+            assert rows_c == SelectResponse.decode(rd.data).iter_rows(), (
+                name, encode_columns)
+        if encode_columns:
+            [img] = ep.region_cache._images.values()
+            assert img.encodings, "fixture must actually encode columns"
+
+
+def test_device_and_cpu_chunk_bytes_identical():
+    """The chunk byte-identity contract mirrors the datum one: device and
+    CPU pipelines emit the same chunk bytes for the same plan."""
+    eng = LocalEngine(_engine(_rows(200), False))
+    ep_dev = Endpoint(eng, enable_device=True)
+    ep_cpu = Endpoint(eng, enable_device=False)
+    for name, execs in _plans().items():
+        a = ep_dev.handle_request(_req(execs, ENC_TYPE_CHUNK))
+        b = ep_cpu.handle_request(_req(execs, ENC_TYPE_CHUNK))
+        assert a.data == b.data, name
+
+
+# ---------------------------------------------------------------------------
+# negotiation: defaults, declines, memo
+# ---------------------------------------------------------------------------
+
+
+def test_datum_stays_default():
+    ep = Endpoint(LocalEngine(product_engine()), enable_device=False)
+    r = ep.handle_request(_req([TableScan(TABLE_ID, PRODUCT_COLUMNS)],
+                               ENC_TYPE_DATUM))
+    assert r.encode_type == ENC_TYPE_DATUM
+    assert r.data_parts is not None  # frame parts exist either way
+    # and the wire dict for a datum response has data, not parts
+    svc = KvService(Storage(engine=LocalEngine(product_engine())),
+                    Endpoint(LocalEngine(product_engine()),
+                             enable_device=False))
+    out = svc.coprocessor({"dag": dag_to_wire(DagRequest(
+        executors=[TableScan(TABLE_ID, PRODUCT_COLUMNS)])),
+        "ranges": [list(record_range(TABLE_ID))], "start_ts": 150})
+    assert "data" in out and "encode_type" not in out
+
+
+def test_unsupported_field_type_declines_to_datum_with_cause():
+    enum_cols = [
+        ColumnInfo(1, FieldType.int64(), is_pk_handle=True),
+        ColumnInfo(2, FieldType.enum_type([b"a", b"b"])),
+    ]
+    dag = DagRequest(executors=[TableScan(TABLE_ID, enum_cols)],
+                     encode_type=ENC_TYPE_CHUNK)
+    eff, cause = negotiate_encode_type(dag)
+    assert cause == "field_type"
+    assert eff.encode_type == ENC_TYPE_DATUM
+    assert eff.executors is dag.executors  # the twin shares the plan
+    # request-level: downgrade in place + counted once
+    before = CHUNK_C.get(outcome="decline", cause="field_type")
+    req = CoprRequest(103, dag, [record_range(TABLE_ID)], 150,
+                      context={"region_id": 1})
+    resolve_encode_type(req)
+    resolve_encode_type(req)  # idempotent: the marker stops double counting
+    assert req.dag.encode_type == ENC_TYPE_DATUM
+    assert req.context["chunk_declined"] == "field_type"
+    assert CHUNK_C.get(outcome="decline", cause="field_type") == before + 1
+    # and a declined request SERVES (datum bytes), never errors
+    eng = BTreeEngine()
+    put_committed(eng, record_key(TABLE_ID, 1),
+                  encode_row(enum_cols[1:], [1]), 90, 100)
+    ep = Endpoint(LocalEngine(eng), enable_device=False)
+    r = ep.handle_request(CoprRequest(
+        103, DagRequest(executors=[TableScan(TABLE_ID, enum_cols)],
+                        encode_type=ENC_TYPE_CHUNK),
+        [record_range(TABLE_ID)], 150, context={"region_id": 1}))
+    assert r.encode_type == ENC_TYPE_DATUM
+    assert SelectResponse.decode(r.data).iter_rows()
+
+
+def test_wide_decimal_declines():
+    cols = [ColumnInfo(1, FieldType.int64(), is_pk_handle=True),
+            ColumnInfo(2, FieldType.decimal_type(25))]
+    dag = DagRequest(executors=[TableScan(TABLE_ID, cols)],
+                     encode_type=ENC_TYPE_CHUNK)
+    assert chunk_output_field_types(dag) is None
+    _eff, cause = negotiate_encode_type(dag)
+    assert cause == "field_type"
+
+
+def test_empty_output_offsets_decline_instead_of_error():
+    """output_offsets=[] (zero output columns) has no chunk representation
+    (no column to carry the row count) — it must decline to datum, and the
+    declined request must SERVE (review finding: _emit used to IndexError)."""
+    dag = DagRequest(executors=[TableScan(TABLE_ID, _COLUMNS)],
+                     output_offsets=[], encode_type=ENC_TYPE_CHUNK)
+    assert chunk_output_field_types(dag) is None
+    _eff, cause = negotiate_encode_type(dag)
+    assert cause == "field_type"
+    ep = Endpoint(LocalEngine(_engine(_rows(20), False)), enable_device=False)
+    r = ep.handle_request(CoprRequest(
+        103, DagRequest(executors=[TableScan(TABLE_ID, _COLUMNS)],
+                        output_offsets=[], encode_type=ENC_TYPE_CHUNK),
+        [record_range(TABLE_ID)], 150, context={"region_id": 1}))
+    assert r.encode_type == ENC_TYPE_DATUM
+    assert len(SelectResponse.decode(r.data).iter_rows()) == 20
+
+
+def test_dict_rewrite_rung_declines_chunk_requests():
+    """Review finding: the code-space rewrite rung flips a dict bytes
+    column's declared type to LONGLONG, so a chunk response encoded off the
+    REWRITTEN schema would ship raw dictionary codes the client cannot
+    decode against the plan it sent.  Chunk-negotiated requests must skip
+    the rung (counted decline) and still serve byte-correct chunk rows
+    through the CPU pipeline — identical to the datum oracle's values."""
+    from tikv_tpu.copr import encoding as _encoding
+
+    eng = BTreeEngine()
+    non_handle = _COLUMNS[1:]
+    for i in range(200):
+        put_committed(eng, record_key(TABLE_ID, i),
+                      encode_row(non_handle, [b"n%d" % (i % 4), i % 50, i]),
+                      90, 100)
+    ep = Endpoint(LocalEngine(eng), enable_device=True,
+                  region_cache=RegionColumnCache(encode_columns=True))
+    execs = [TableScan(TABLE_ID, _COLUMNS),
+             Selection([rpn_call("eq", col(1), _bytes_const(b"n2"))])]
+    ctx = {"region_epoch": (1, 1), "apply_index": 7}
+    # warm the image so the rewrite rung is reachable at all
+    ep.handle_request(_req(execs, ENC_TYPE_DATUM, **ctx))
+    decline_c = REGISTRY.counter("tikv_coprocessor_encoded_decline_total")
+    before_decline = decline_c.get(path="rewrite", cause="chunk_encoding")
+    rd = ep.handle_request(_req(execs, ENC_TYPE_DATUM, **ctx))
+    rc = ep.handle_request(_req(execs, ENC_TYPE_CHUNK, **ctx))
+    assert rc.encode_type == ENC_TYPE_CHUNK
+    assert decline_c.get(path="rewrite", cause="chunk_encoding") \
+        > before_decline
+    dag_c = DagRequest(executors=list(execs), encode_type=ENC_TYPE_CHUNK)
+    rows_c = decode_wire_response(
+        {"data_parts": rc.data_parts or [rc.data], "encode_type": 1},
+        dag_c).iter_rows()
+    rows_d = SelectResponse.decode(rd.data).iter_rows()
+    assert rows_c == rows_d
+    assert rows_c and all(isinstance(r[1], bytes) for r in rows_c), \
+        "the bytes column must decode as bytes, not dictionary codes"
+
+
+def _bytes_const(v: bytes):
+    from tikv_tpu.copr.datatypes import EvalType
+    from tikv_tpu.copr.rpn import Constant
+
+    return Constant(v, EvalType.BYTES)
+
+
+def test_parse_memo_keys_datum_and_chunk_separately():
+    svc = KvService(Storage(engine=LocalEngine(product_engine())),
+                    Endpoint(LocalEngine(product_engine()),
+                             enable_device=False))
+    plain = DagRequest(executors=[TableScan(TABLE_ID, PRODUCT_COLUMNS)])
+    chunky = DagRequest(executors=[TableScan(TABLE_ID, PRODUCT_COLUMNS)],
+                        encode_type=ENC_TYPE_CHUNK)
+    a = svc._parse_dag_wire(dag_to_wire(plain))
+    b = svc._parse_dag_wire(dag_to_wire(chunky))
+    assert a is not b
+    assert a.encode_type == ENC_TYPE_DATUM
+    assert b.encode_type == ENC_TYPE_CHUNK
+    # repeat parses hit their own memo entries
+    assert svc._parse_dag_wire(dag_to_wire(plain)) is a
+    assert svc._parse_dag_wire(dag_to_wire(chunky)) is b
+    # the datum twin of the chunk plan serializes to the plain plan's bytes
+    assert dag_to_wire(datum_twin(chunky)) == dag_to_wire(plain)
+
+
+# ---------------------------------------------------------------------------
+# zero-copy egress
+# ---------------------------------------------------------------------------
+
+
+def test_chunk_column_slab_is_own_frame_part():
+    """A ≥PASSTHROUGH_MIN column slab must pass through ``dumps_parts`` as
+    its own memoryview over the ENCODER'S buffer — the whole reason the
+    response ships ``data_parts``."""
+    rows = [(i, b"x" * 40, i, i * 100) for i in range(200)]
+    ep = Endpoint(LocalEngine(_engine(rows, False)), enable_device=False)
+    r = ep.handle_request(_req([TableScan(TABLE_ID, _COLUMNS)],
+                               ENC_TYPE_CHUNK))
+    big = [p for p in r.data_parts if len(p) >= wire.PASSTHROUGH_MIN]
+    assert big, "fixture must produce at least one large column slab"
+    resp_dict = {"data_parts": r.data_parts, "encode_type": 1}
+    parts = wire.dumps_parts([7, resp_dict])
+    views = [p for p in parts if isinstance(p, memoryview)]
+    for slab in big:
+        assert any(v.obj is slab for v in views), \
+            "column slab was copied instead of passed through"
+    # and the parts join back to the canonical encode() bytes
+    joined = wire.loads(b"".join(bytes(p) for p in parts))
+    assert response_data(joined[1]) == r.data
+
+
+# ---------------------------------------------------------------------------
+# streaming
+# ---------------------------------------------------------------------------
+
+
+def test_streaming_chunk_frames_match_unary_rows():
+    eng = LocalEngine(_engine(_rows(300), False))
+    ep = Endpoint(eng, enable_device=False)
+    execs = [TableScan(TABLE_ID, _COLUMNS)]
+    dag_c = DagRequest(executors=execs, encode_type=ENC_TYPE_CHUNK)
+    frames = list(ep.handle_streaming_request(
+        _req(execs, ENC_TYPE_CHUNK), rows_per_stream=64))
+    assert len(frames) > 1, "stream must actually split into frames"
+    rows = []
+    fts = chunk_output_field_types(dag_c)
+    for f in frames:
+        assert f.encode_type == ENC_TYPE_CHUNK
+        sr = SelectResponse.decode(
+            b"".join(bytes(p) for p in f.data_parts), encode_type=1)
+        rows.extend(sr.iter_rows(field_types=fts))
+    unary = ep.handle_request(_req(execs, ENC_TYPE_DATUM))
+    assert rows == SelectResponse.decode(unary.data).iter_rows()
+
+
+def test_socket_stream_chunk_frames():
+    eng = LocalEngine(_engine(_rows(256), False))
+    svc = KvService(Storage(engine=eng), Endpoint(eng, enable_device=False))
+    srv = Server(svc)
+    srv.start()
+    try:
+        c = Client(*srv.addr)
+        dag_c = DagRequest(executors=[TableScan(TABLE_ID, _COLUMNS)],
+                           encode_type=ENC_TYPE_CHUNK)
+        items = list(c.call_stream("coprocessor_stream", {
+            "dag": dag_to_wire(dag_c),
+            "ranges": [list(record_range(TABLE_ID))],
+            "start_ts": 150, "rows_per_stream": 64,
+        }))
+        assert len(items) > 1
+        rows = []
+        for it in items:
+            assert it.get("encode_type") == 1
+            rows.extend(decode_wire_response(it, dag_c).iter_rows())
+        c.close()
+        ep = Endpoint(eng, enable_device=False)
+        unary = ep.handle_request(_req([TableScan(TABLE_ID, _COLUMNS)],
+                                       ENC_TYPE_DATUM))
+        assert rows == SelectResponse.decode(unary.data).iter_rows()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# multi-region batched frames + scheduler
+# ---------------------------------------------------------------------------
+
+
+def _regioned_engine(regions: int, rows_per: int):
+    eng = BTreeEngine()
+    rng = np.random.default_rng(13)
+    non_handle = _COLUMNS[1:]
+    for i in range(regions * rows_per):
+        vals = [b"n%d" % (i % 5), int(rng.integers(0, 100)),
+                int(rng.integers(0, 100000))]
+        put_committed(eng, record_key(TABLE_ID, i),
+                      encode_row(non_handle, vals), 90, 100)
+    return eng
+
+
+def _region_sub(dag_wire_dict, r: int, rows_per: int, **ctx):
+    lo = record_key(TABLE_ID, r * rows_per)
+    hi = record_key(TABLE_ID, (r + 1) * rows_per)
+    return {"dag": dag_wire_dict, "ranges": [[lo, hi]], "start_ts": 150,
+            "context": {"region_id": r + 1, "region_epoch": (1, 1),
+                        "apply_index": 7, **ctx}}
+
+
+def _batch_agg(enc):
+    return DagRequest(executors=[
+        TableScan(TABLE_ID, _COLUMNS),
+        Selection([rpn_call("lt", col(2), const_int(60))]),
+        Aggregation([], [AggDescriptor("sum", col(2)),
+                         AggDescriptor("count", None)]),
+    ], encode_type=enc)
+
+
+def test_multi_region_batch_single_frame_chunk_payloads():
+    """coprocessor_batch: all regions answered in ONE frame with per-region
+    chunk payloads (the scheduler's vmapped cross-region batch behind it),
+    and per-region error isolation — an expired rider reports typed while
+    its siblings keep their chunk payloads."""
+    regions, rows_per = 4, 200
+    eng = LocalEngine(_regioned_engine(regions, rows_per))
+    ep = Endpoint(eng, enable_device=True)
+    svc = KvService(Storage(engine=eng), ep)
+    srv = Server(svc)
+    srv.start()
+    try:
+        c = Client(*srv.addr)
+        wire_dag = dag_to_wire(_batch_agg(ENC_TYPE_CHUNK))
+        subs = [_region_sub(wire_dag, r, rows_per) for r in range(regions)]
+        c.call("coprocessor_batch", {"requests": subs}, timeout=60.0)  # warm
+        batches = REGISTRY.counter("tikv_coprocessor_sched_batches_total")
+        before = batches.get(kind="xregion")
+        r = c.call("coprocessor_batch", {"requests": subs}, timeout=60.0)
+        assert batches.get(kind="xregion") > before, \
+            "warm same-sig regions must ride ONE vmapped batch"
+        assert len(r["responses"]) == regions
+        dag_c = _batch_agg(ENC_TYPE_CHUNK)
+        oracle_ep = Endpoint(eng, enable_device=False)
+        for i, sub in enumerate(r["responses"]):
+            assert sub.get("encode_type") == 1, sub.keys()
+            rows_c = decode_wire_response(sub, dag_c).iter_rows()
+            od = oracle_ep.handle_request(CoprRequest(
+                103, _batch_agg(ENC_TYPE_DATUM),
+                [tuple(rng) for rng in subs[i]["ranges"]], 150,
+                context=dict(subs[i]["context"])))
+            assert rows_c == SelectResponse.decode(od.data).iter_rows(), i
+        # per-region error isolation: one rider expired in queue
+        dead = [_region_sub(wire_dag, r, rows_per) for r in range(regions)]
+        dead[1]["context"]["timeout_ms"] = 0
+        r = c.call("coprocessor_batch", {"requests": dead}, timeout=60.0)
+        assert r["responses"][1].get("error", {}).get("deadline_exceeded") is not None
+        for i in (0, 2, 3):
+            assert r["responses"][i].get("encode_type") == 1
+            assert decode_wire_response(r["responses"][i], dag_c).iter_rows()
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_scheduler_never_shares_slot_across_encodings():
+    """Identical plan + region + start_ts in BOTH encodings through one
+    run_batch: responses must come back in their own encodings (a shared
+    slot would hand one encoding's bytes to the other's rider)."""
+    regions, rows_per = 2, 150
+    eng = LocalEngine(_regioned_engine(regions, rows_per))
+    ep = Endpoint(eng, enable_device=True)
+    reqs = []
+    for enc in (ENC_TYPE_DATUM, ENC_TYPE_CHUNK):
+        for r in range(regions):
+            lo = record_key(TABLE_ID, r * rows_per)
+            hi = record_key(TABLE_ID, (r + 1) * rows_per)
+            reqs.append(CoprRequest(103, _batch_agg(enc), [(lo, hi)], 150,
+                                    context={"region_id": r + 1,
+                                             "region_epoch": (1, 1),
+                                             "apply_index": 7}))
+    ep.handle_batch(list(reqs))  # warm
+    results = ep.handle_batch(list(reqs))
+    dag_c = _batch_agg(ENC_TYPE_CHUNK)
+    for i, r in enumerate(results):
+        want_chunk = i >= regions
+        assert (r.encode_type == ENC_TYPE_CHUNK) == want_chunk, i
+    # pairwise value identity across encodings per region
+    for r in range(regions):
+        rows_d = SelectResponse.decode(results[r].data).iter_rows()
+        rows_c = decode_wire_response(
+            {"data_parts": results[regions + r].data_parts
+             or [results[regions + r].data], "encode_type": 1},
+            dag_c).iter_rows()
+        assert rows_d == rows_c
+
+
+def test_socket_coalesced_chunk_matches_serial_and_counts():
+    """Concurrent chunk requests through the continuous lanes: responses
+    byte-match serial chunk serving, and tikv_wire_chunk_total counts the
+    served outcome."""
+    regions, rows_per = 3, 150
+    eng = LocalEngine(_regioned_engine(regions, rows_per))
+    ep = Endpoint(eng, enable_device=True)
+    svc = KvService(Storage(engine=eng), ep)
+    srv = Server(svc)
+    srv.start()
+    ep.scheduler.start()
+    try:
+        wire_dag = dag_to_wire(_batch_agg(ENC_TYPE_CHUNK))
+        reqs = [_region_sub(wire_dag, r, rows_per)
+                for r in range(regions) for _ in range(3)]
+        before = CHUNK_C.get(outcome="chunk", cause="")
+        conns = [Client(*srv.addr) for _ in range(3)]
+        results: list = [None] * len(reqs)
+        errs: list = []
+
+        def worker(ci):
+            try:
+                for i in range(ci, len(reqs), len(conns)):
+                    results[i] = conns[ci].call("coprocessor", reqs[i],
+                                                timeout=120.0)
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        ts = [threading.Thread(target=worker, args=(ci,))
+              for ci in range(len(conns))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for c in conns:
+            c.close()
+        assert not errs, errs
+        assert CHUNK_C.get(outcome="chunk", cause="") - before == len(reqs)
+        ep.scheduler.stop()
+        for i, r in enumerate(reqs):
+            assert results[i].get("encode_type") == 1
+            serial = svc.coprocessor(dict(r))
+            assert response_data(results[i]) == response_data(serial), i
+    finally:
+        ep.scheduler.stop()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# cluster client helper
+# ---------------------------------------------------------------------------
+
+
+def test_server_cluster_chunk_opt_in():
+    from tikv_tpu.pd.client import MockPd
+    from tikv_tpu.raft.raftkv import RaftKv
+    from tikv_tpu.server.cluster import ServerCluster
+    from tikv_tpu.storage.engine import CF_WRITE, WriteBatch
+    from tikv_tpu.storage.txn_types import Key, Write, WriteType
+
+    c = ServerCluster(1, pd=MockPd(), full_service=True)
+    try:
+        c.run()
+        leader = c.wait_leader(1)
+        wb = WriteBatch()
+        non_handle = [ci for ci in PRODUCT_COLUMNS if not ci.is_pk_handle]
+        for i in range(24):
+            k = Key.from_raw(record_key(TABLE_ID, i))
+            w = Write(WriteType.PUT, 90,
+                      short_value=encode_row(non_handle,
+                                             [b"apple", i % 23, 100 + i]))
+            wb.put_cf(CF_WRITE, k.append_ts(100).encoded, w.to_bytes())
+        RaftKv(leader.store).write({"region_id": 1}, wb)
+        dag = DagRequest(executors=[TableScan(TABLE_ID, PRODUCT_COLUMNS)])
+        ranges = [record_range(TABLE_ID)]
+        rows_d = c.coprocessor_rows(1, dag, ranges, 150,
+                                    context={"region_id": 1})
+        rows_c = c.coprocessor_rows(1, dag, ranges, 150, chunk=True,
+                                    context={"region_id": 1})
+        assert len(rows_d) == 24
+        assert rows_d == rows_c
+    finally:
+        c.shutdown()

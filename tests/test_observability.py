@@ -41,6 +41,7 @@ LAZY_SERIES = {
     "tikv_coprocessor_deadline_expired_total",
     "tikv_wire_stage_seconds",
     "tikv_wire_coalesce_total",
+    "tikv_wire_chunk_total",
     "tikv_trace_total",
     "tikv_trace_ring_traces",
     "tikv_copr_owner_forward_total",

@@ -283,6 +283,200 @@ def encode_chunk(columns: list[ChunkColumn]) -> bytes:
     return b"".join(c.encode() for c in columns)
 
 
+# ---------------------------------------------------------------------------
+# vectorized column assembly (the serving-plane encoder, docs/wire_path.md)
+#
+# The append-oriented ChunkColumn above mirrors the reference builder; the
+# wire serving plane encodes whole numpy columns at once — null bitmap via
+# packbits, fixed cells as one dtype view, end-offsets as one cumsum — with
+# bytes identical to appending each value through ChunkColumn (enforced by
+# tests/test_chunk_codec.py).
+# ---------------------------------------------------------------------------
+
+_POW10 = np.array([10 ** k for k in range(20)], dtype=np.uint64)
+_WORD = np.uint64(10 ** _DIGITS_PER_WORD)
+
+#: widest decimal scale the vectorized struct builder covers (two base-1e9
+#: frac words); the serving plane declines wider scales to the datum codec
+MAX_VEC_DECIMAL_FRAC = 18
+
+
+def encode_decimal_cells(unscaled: np.ndarray, frac: int) -> np.ndarray:
+    """(n,) int64 fixed-point values -> (n, 40) uint8 Decimal structs,
+    byte-identical to ``encode_decimal_cell(int(v), frac)`` per row."""
+    if not 0 <= frac <= MAX_VEC_DECIMAL_FRAC:
+        raise ValueError(f"vectorized decimal frac out of range: {frac}")
+    a = np.ascontiguousarray(unscaled, dtype=np.int64)
+    n = len(a)
+    u = a.view(np.uint64)
+    neg = a < 0
+    mag = np.where(neg, ~u + np.uint64(1), u)  # |v| (2**63 fits uint64)
+    ipart = mag // _POW10[frac]
+    fpart = mag - ipart * _POW10[frac]
+    # integer digit count: exact uint64 compares, no float log10
+    ndig = np.searchsorted(_POW10[1:], ipart, side="right") + (ipart > 0)
+    int_cnt = np.maximum(ndig, 1) if frac == 0 else ndig
+    # integer words, grouped from the right (≤3 words for 19 digits)
+    iw = np.stack([ipart // (_WORD * _WORD),
+                   (ipart // _WORD) % _WORD, ipart % _WORD], axis=1)
+    nw = (int_cnt + _DIGITS_PER_WORD - 1) // _DIGITS_PER_WORD
+    # frac words, grouped from the left and padded right with zeros
+    nfw = (frac + _DIGITS_PER_WORD - 1) // _DIGITS_PER_WORD
+    if frac == 0:
+        fw = np.zeros((n, 0), dtype=np.uint64)
+    elif frac <= _DIGITS_PER_WORD:
+        fw = (fpart * _POW10[_DIGITS_PER_WORD - frac])[:, None]
+    else:
+        hi = fpart // _POW10[frac - _DIGITS_PER_WORD]
+        lo = (fpart % _POW10[frac - _DIGITS_PER_WORD]) * _POW10[
+            2 * _DIGITS_PER_WORD - frac]
+        fw = np.stack([hi, lo], axis=1)
+    words = np.zeros((n, _WORD_BUF_LEN), dtype="<u4")
+    for k in (0, 1, 2, 3):  # nw ∈ {0..3}: bounded cases, not per-row python
+        m = nw == k
+        if not m.any():
+            continue
+        if k:
+            words[np.ix_(m, range(k))] = iw[m][:, 3 - k:]
+        if nfw:
+            words[np.ix_(m, range(k, k + nfw))] = fw[m]
+    cells = np.empty((n, DECIMAL_STRUCT_SIZE), dtype=np.uint8)
+    cells[:, 0] = int_cnt
+    cells[:, 1] = frac
+    cells[:, 2] = frac
+    cells[:, 3] = neg
+    cells[:, 4:] = words.view(np.uint8).reshape(n, 4 * _WORD_BUF_LEN)
+    return cells
+
+
+def decode_decimal_cells(cells: np.ndarray, frac: int) -> np.ndarray:
+    """(n, 40) uint8 Decimal structs -> (n,) int64 unscaled values — the
+    vectorized inverse of :func:`encode_decimal_cells` for the constant
+    per-column ``frac`` the serving plane encodes with (cell frac_cnt ==
+    column frac).  Value-identical to ``decode_decimal_cell`` per row."""
+    if not 0 <= frac <= MAX_VEC_DECIMAL_FRAC:
+        raise ValueError(f"vectorized decimal frac out of range: {frac}")
+    cells = np.ascontiguousarray(cells, dtype=np.uint8).reshape(
+        -1, DECIMAL_STRUCT_SIZE)
+    n = len(cells)
+    int_cnt = cells[:, 0].astype(np.int64)
+    neg = cells[:, 3] != 0
+    words = cells[:, 4:].view("<u4").reshape(n, _WORD_BUF_LEN).astype(np.uint64)
+    nw = (int_cnt + _DIGITS_PER_WORD - 1) // _DIGITS_PER_WORD
+    nfw = (frac + _DIGITS_PER_WORD - 1) // _DIGITS_PER_WORD
+    ipart = np.zeros(n, dtype=np.uint64)
+    fpart = np.zeros(n, dtype=np.uint64)
+    for k in (0, 1, 2, 3):  # int-word count ∈ {0..3}: bounded cases
+        m = nw == k
+        if not m.any():
+            continue
+        acc = np.zeros(int(m.sum()), dtype=np.uint64)
+        for j in range(k):
+            acc = acc * _WORD + words[m, j]
+        ipart[m] = acc
+        if nfw >= 1:
+            f0 = words[m, k]
+            if frac <= _DIGITS_PER_WORD:
+                fpart[m] = f0 // _POW10[_DIGITS_PER_WORD - frac]
+            else:
+                fpart[m] = (f0 * _POW10[frac - _DIGITS_PER_WORD]
+                            + words[m, k + 1]
+                            // _POW10[2 * _DIGITS_PER_WORD - frac])
+    mag = ipart * _POW10[frac] + fpart
+    return np.where(neg, ~mag + np.uint64(1), mag).view(np.int64)
+
+
+def _null_bitmap(nulls: np.ndarray) -> bytes:
+    """LSB-first bitmap, bit=1 ⇒ NOT null — packbits pads the tail with 0
+    exactly like the append builder leaves unset bits."""
+    return np.packbits(~nulls, bitorder="little").tobytes()
+
+
+def encode_np_column(ft: FieldType, data: np.ndarray, nulls: np.ndarray,
+                     dictionary: np.ndarray | None = None) -> bytes:
+    """One whole column -> its chunk wire bytes, vectorized.
+
+    ``data``/``nulls`` are the Column arrays (already row-selected — callers
+    late-materialize through ``Column.take`` / ``EncodedColumn.take`` first,
+    so encoded-resident columns decode only surviving rows).  Byte-identical
+    to a ChunkColumn built by appending ``datum_at``-domain values row by
+    row."""
+    nulls = np.asarray(nulls, dtype=bool)
+    n = len(nulls)
+    null_cnt = int(nulls.sum())
+    parts = [struct.pack("<II", n, null_cnt)]
+    if null_cnt:
+        parts.append(_null_bitmap(nulls))
+    et = ft.eval_type
+    if et in (EvalType.INT, EvalType.DURATION, EvalType.DATETIME):
+        cells = np.ascontiguousarray(data, dtype=np.int64)
+        if null_cnt:
+            cells = np.where(nulls, 0, cells)
+        # two's-complement little-endian: identical bytes for the signed
+        # (<q) and packed-u64 (<Q) scalar appends
+        parts.append(cells.astype("<i8").tobytes())
+    elif et == EvalType.REAL:
+        dt = "<f4" if fixed_len(ft) == 4 else "<f8"
+        cells = np.ascontiguousarray(data, dtype=np.float64)
+        if null_cnt:
+            cells = np.where(nulls, 0.0, cells)
+        parts.append(cells.astype(dt).tobytes())
+    elif et == EvalType.DECIMAL:
+        vals = np.ascontiguousarray(data, dtype=np.int64)
+        if null_cnt:
+            vals = np.where(nulls, 0, vals)
+        cells = encode_decimal_cells(vals, ft.decimal)
+        if null_cnt:
+            cells[nulls] = 0  # null struct cells are all-zero padding
+        parts.append(cells.tobytes())
+    elif et in (EvalType.BYTES, EvalType.JSON):
+        vals = data if dictionary is None else dictionary[data]
+        if null_cnt:
+            lens = np.fromiter(
+                (0 if null else len(v) for v, null in zip(vals, nulls)),
+                np.int64, n)
+            payload = b"".join(
+                b"" if null else bytes(v) for v, null in zip(vals, nulls))
+        else:
+            lens = np.fromiter((len(v) for v in vals), np.int64, n)
+            payload = b"".join(bytes(v) for v in vals)
+        offsets = np.zeros(n + 1, dtype="<i8")
+        np.cumsum(lens, out=offsets[1:])
+        parts.append(offsets.tobytes())
+        parts.append(payload)
+    else:
+        raise ValueError(f"chunk wire encode unsupported for {et}")
+    return b"".join(parts)
+
+
+def column_numpy(col: ChunkColumn):
+    """Vectorized client-side decode: ``(data, nulls)`` numpy arrays for
+    the fixed-width numeric types — decimals decode to their UNSCALED int64
+    (the frac is the column's ``ft.decimal``) — and ``(list-of-bytes,
+    nulls)`` for var-len.  Value-identical to :func:`column_values` row by
+    row (None/tuple substitution is the caller's when needed)."""
+    n = col.rows
+    nb = (n + 7) // 8
+    bits = np.unpackbits(
+        np.frombuffer(bytes(col.bitmap[:nb]), np.uint8), bitorder="little")[:n]
+    nulls = bits == 0
+    et = col.ft.eval_type
+    raw = bytes(col.data)
+    if et == EvalType.INT:
+        return np.frombuffer(raw, "<u8" if col.ft.is_unsigned else "<i8"), nulls
+    if et == EvalType.DATETIME:
+        return np.frombuffer(raw, "<u8"), nulls
+    if et == EvalType.DURATION:
+        return np.frombuffer(raw, "<i8"), nulls
+    if et == EvalType.REAL:
+        return np.frombuffer(raw, "<f4" if col.fixed == 4 else "<f8"), nulls
+    if et == EvalType.DECIMAL:
+        cells = np.frombuffer(raw, np.uint8).reshape(n, DECIMAL_STRUCT_SIZE)
+        return decode_decimal_cells(cells, col.ft.decimal), nulls
+    offs = col.offsets
+    return [raw[offs[i]:offs[i + 1]] for i in range(n)], nulls
+
+
 def decode_chunk(buf: bytes, field_types: list[FieldType]) -> list[ChunkColumn]:
     pos = 0
     cols = []

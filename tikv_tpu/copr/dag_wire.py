@@ -71,7 +71,12 @@ def dag_to_wire(dag: DagRequest) -> dict:
             execs.append({"t": "limit", "limit": e.limit})
         else:
             raise TypeError(e)
-    return {"executors": execs, "output_offsets": dag.output_offsets, "chunk_rows": dag.chunk_rows}
+    d = {"executors": execs, "output_offsets": dag.output_offsets, "chunk_rows": dag.chunk_rows}
+    if dag.encode_type:
+        # emitted only when non-default so pre-chunk plan bytes (and every
+        # memo/evaluator key derived from them) are unchanged
+        d["encode_type"] = dag.encode_type
+    return d
 
 
 def dag_from_wire(d: dict) -> DagRequest:
@@ -98,4 +103,6 @@ def dag_from_wire(d: dict) -> DagRequest:
             execs.append(Limit(e["limit"]))
         else:
             raise ValueError(t)
-    return DagRequest(executors=execs, output_offsets=d.get("output_offsets"), chunk_rows=d.get("chunk_rows", 1024))
+    return DagRequest(executors=execs, output_offsets=d.get("output_offsets"),
+                      chunk_rows=d.get("chunk_rows", 1024),
+                      encode_type=d.get("encode_type", 0))

@@ -946,6 +946,13 @@ def rewrite_dag_for_dict(dag, blocks):
     new_scan = TableScan(scan.table_id, new_cols)
     new_execs = [new_scan] + execs[1:]
     new_execs[sel_pos] = Selection(new_conds)
+    # deliberately NOT propagating encode_type: the rewritten plan's STATIC
+    # schema lies about the runtime columns (a rewritten bytes column is
+    # declared LONGLONG while the served column still materializes bytes
+    # through its dictionary), which the value-driven datum encoder never
+    # reads but the schema-driven chunk encoder would — so the rewrite rung
+    # is datum-only and the endpoint declines it for chunk-negotiated
+    # requests (endpoint._try_dict_rewrite)
     return DagRequest(
         executors=new_execs,
         output_offsets=dag.output_offsets,

@@ -18,7 +18,13 @@ from ..storage.mvcc import Statistics
 from ..util import trace
 from . import jax_eval
 from .cache import ColumnBlockCache, CopCache
-from .dag import BatchExecutorsRunner, DagRequest, SelectResponse
+from .dag import (
+    ENC_TYPE_CHUNK,
+    BatchExecutorsRunner,
+    DagRequest,
+    SelectResponse,
+    negotiate_encode_type,
+)
 from .executors import MvccScanSource
 from .mvcc_batch import MvccBatchScanSource
 
@@ -27,6 +33,12 @@ REQ_TYPE_ANALYZE = 104
 REQ_TYPE_CHECKSUM = 105
 
 _MESH_UNCHECKED = object()  # sentinel: DAG not yet probed for mesh eligibility
+
+# server.py's wire-stage buckets (tikv_wire_stage_seconds): the coprocessor
+# response-encode observation below must create the series with the SAME
+# bucket layout when the endpoint runs before the TCP server imports
+_WIRE_STAGE_BUCKETS = (1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1,
+                      0.5, 1, 5)
 
 
 @dataclass
@@ -40,12 +52,61 @@ class CoprRequest:
     context: dict = field(default_factory=dict)  # region_id, epoch...
 
 
-@dataclass
 class CoprResponse:
-    data: bytes
-    from_device: bool = False
-    from_cache: bool = False
-    metrics: dict = field(default_factory=dict)  # tracker.rs phase breakdown
+    """coppb.Response equivalent.
+
+    ``data`` is the canonical payload bytes (every in-process consumer and
+    byte-identity compare).  TypeChunk responses additionally carry
+    ``data_parts`` — the unjoined buffer list from
+    ``SelectResponse.encode_parts`` — and ``data`` joins LAZILY, so the
+    wire path ships each large column slab as its own ``sendmsg`` iovec
+    without ever paying the join (docs/wire_path.md)."""
+
+    __slots__ = ("_data", "data_parts", "encode_type", "from_device",
+                 "from_cache", "metrics")
+
+    def __init__(self, data: bytes | None = None, from_device: bool = False,
+                 from_cache: bool = False, metrics: dict | None = None,
+                 data_parts: list | None = None, encode_type: int = 0):
+        assert data is not None or data_parts is not None
+        self._data = data
+        self.data_parts = data_parts
+        self.encode_type = encode_type
+        self.from_device = from_device
+        self.from_cache = from_cache
+        self.metrics = metrics if metrics is not None else {}
+
+    @property
+    def data(self) -> bytes:
+        if self._data is None:
+            self._data = b"".join(bytes(p) for p in self.data_parts)
+        return self._data
+
+
+def resolve_encode_type(req: CoprRequest) -> None:
+    """Entry-gate encoding negotiation: a TypeChunk request whose plan
+    cannot chunk-encode downgrades IN PLACE to its datum twin — a datum
+    response with a counted cause, never an error.  Idempotent (the twin's
+    encode_type is datum), called at every serving entry (service parse,
+    endpoint unary/batch, scheduler admission) so no path can reach an
+    evaluator with an unsupported chunk plan."""
+    dag = req.dag
+    if dag is None or dag.encode_type != ENC_TYPE_CHUNK:
+        return
+    eff, cause = negotiate_encode_type(dag)
+    if cause is None:
+        return
+    req.dag = eff
+    ctx = req.context if req.context is not None else {}
+    req.context = ctx
+    if "chunk_declined" not in ctx:
+        ctx["chunk_declined"] = cause
+        from ..util.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "tikv_wire_chunk_total",
+            "TypeChunk response negotiation, by outcome (cause on declines)",
+        ).inc(outcome="decline", cause=cause)
 
 
 def stale_read_ctx(req: CoprRequest) -> dict | None:
@@ -169,6 +230,25 @@ class Endpoint:
             if self.region_cache is not None else None
         )
 
+    def _encode_response(self, resp: SelectResponse):
+        """SelectResponse -> (frame parts, encode_type): the one response
+        serialization point of the device/CPU unary paths, timed into the
+        wire-stage histogram (stage=copr_encode) so response assembly stays
+        attributable next to decode/route/execute/encode
+        (docs/wire_path.md)."""
+        import time as _time
+
+        from ..util.metrics import REGISTRY
+
+        t0 = _time.perf_counter()
+        parts = resp.encode_parts()
+        REGISTRY.histogram(
+            "tikv_wire_stage_seconds",
+            "Wire-path time per served frame, by stage",
+            buckets=_WIRE_STAGE_BUCKETS,
+        ).observe(_time.perf_counter() - t0, stage="copr_encode")
+        return parts, resp.encode_type
+
     def handle_request(self, req: CoprRequest) -> CoprResponse:
         """Instrumented entry: every path (device, CPU fallback, analyze,
         checksum) lands in tikv_coprocessor_request_* exactly once."""
@@ -176,6 +256,8 @@ class Endpoint:
 
         from ..util.metrics import REGISTRY
         from ..util.retry import DeadlineExceeded, deadline_from_context
+
+        resolve_encode_type(req)
 
         # shed expired work at the LAST entry gate: every fallback route
         # (scheduler direct serve, per-slot batch re-serve, scheduler-off
@@ -281,18 +363,22 @@ class Endpoint:
                     resp = self._run_sharded_cached(ev, cache)
                 if resp is None:
                     resp = ev.run(src, cache=cache)
-                data = resp.encode()
+                parts, enc_tp = self._encode_response(resp)
+                data = None
                 from_device = True
                 # shadow-read verification (docs/integrity.md): a sampled
                 # warm image-backed serve re-executes on the CPU oracle and
                 # byte-compares — a mismatch quarantines the image and the
                 # CPU bytes serve, so a sampled request never returns
-                # corrupted derived state
+                # corrupted derived state.  The oracle runs the SAME
+                # negotiated encoding (req.dag carries it), so chunk
+                # responses byte-compare chunk bytes.
                 if (rc_outcome in ("hit", "delta", "wt_delta")
                         and self.shadow.pick("unary")):
-                    fixed = self.shadow_compare(req, snap, data, "unary")
+                    fixed = self.shadow_compare(
+                        req, snap, b"".join(bytes(p) for p in parts), "unary")
                     if fixed is not None:
-                        data = fixed
+                        data, parts = fixed, None
                         from_device = False
                 scanned = src.stats.write.processed_keys if src is not None else 0
                 m = tracker.on_finish(scanned_keys=scanned, from_device=from_device)
@@ -314,6 +400,7 @@ class Endpoint:
                     data, from_device=from_device,
                     from_cache=from_cache,
                     metrics=m.to_dict(),
+                    data_parts=parts, encode_type=enc_tp,
                 )
             except Exception as exc:
                 from .integrity import IntegrityMismatch
@@ -361,7 +448,9 @@ class Endpoint:
         self.slow_log.observe(tracker)
         if stale_snap:
             self.count_follower_read("cpu")
-        return CoprResponse(resp.encode(), from_device=False, metrics=m.to_dict())
+        parts, enc_tp = self._encode_response(resp)
+        return CoprResponse(None, from_device=False, metrics=m.to_dict(),
+                            data_parts=parts, encode_type=enc_tp)
 
     def _try_dict_rewrite(self, req: CoprRequest, snap, tracker, stale_snap):
         """Dictionary code-space serving rung (docs/compressed_columns.md):
@@ -377,6 +466,16 @@ class Endpoint:
 
         if (self.region_cache is None or not self.device_enabled()
                 or not _encoding.dict_rewrite_probe(req.dag)):
+            return None
+        if req.dag.encode_type == ENC_TYPE_CHUNK:
+            # the rewrite rung is DATUM-ONLY: the rewritten plan's schema
+            # declares a dict column LONGLONG while the served column still
+            # carries bytes, and the schema-driven chunk encoder would emit
+            # raw dictionary codes a client decoding against its own plan
+            # cannot read (the oracle would then false-quarantine a healthy
+            # image on the shadow mismatch).  The CPU pipeline below serves
+            # the chunk bytes correctly.
+            _encoding.count_decline("rewrite", "chunk_encoding")
             return None
         if not self.breaker.allow("unary"):
             from .tracker import count_path_fallback
@@ -400,13 +499,15 @@ class Endpoint:
                 return None
             ev = self._evaluator_for(new_dag)
             resp = ev.run(None, cache=cache)
-            data = resp.encode()
+            parts, enc_tp = self._encode_response(resp)
+            data = None
             from_device = True
             if (rc_outcome in ("hit", "delta", "wt_delta")
                     and self.shadow.pick("unary")):
-                fixed = self.shadow_compare(req, snap, data, "unary")
+                fixed = self.shadow_compare(
+                    req, snap, b"".join(bytes(p) for p in parts), "unary")
                 if fixed is not None:
-                    data = fixed
+                    data, parts = fixed, None
                     from_device = False
             _encoding.count_rewrite("served")
             m = tracker.on_finish(scanned_keys=0, from_device=from_device)
@@ -424,7 +525,7 @@ class Endpoint:
                 # first-touch builds are NOT cache hits — same rule as the
                 # main unary path's from_cache accounting
                 from_cache=from_device and rc_outcome not in ("miss", "too_big"),
-                metrics=m.to_dict())
+                metrics=m.to_dict(), data_parts=parts, encode_type=enc_tp)
         except Exception as exc:  # noqa: BLE001 — CPU pipeline always serves
             from .integrity import IntegrityMismatch
 
@@ -547,19 +648,25 @@ class Endpoint:
         CPU pipeline; the device path answers whole queries)."""
         if req.tp != REQ_TYPE_DAG:
             raise ValueError("streaming supports DAG requests only")
+        resolve_encode_type(req)
         snap = self.engine.snapshot(stale_read_ctx(req))
         src = MvccScanSource(snap, req.start_ts, req.ranges, statistics=Statistics())
         # frames flush at whole response chunks — align the chunk size so
         # streams actually split at the requested granularity (on a copy:
-        # the caller's DagRequest framing must not change)
+        # the caller's DagRequest framing must not change).  The copy keeps
+        # the negotiated encoding: large TypeChunk results stream as
+        # column-slab frames on the same flush cadence.
         dag = DagRequest(
             executors=req.dag.executors,
             output_offsets=req.dag.output_offsets,
             chunk_rows=min(req.dag.chunk_rows, rows_per_stream),
+            encode_type=req.dag.encode_type,
         )
         runner = BatchExecutorsRunner(dag, src)
         for resp in runner.handle_streaming_request(rows_per_stream):
-            yield CoprResponse(resp.encode(), from_device=False)
+            parts, enc_tp = self._encode_response(resp)
+            yield CoprResponse(None, from_device=False, data_parts=parts,
+                               encode_type=enc_tp)
 
     def _handle_analyze(self, req: CoprRequest, tracker=None) -> CoprResponse:
         from . import analyze as az
@@ -660,6 +767,8 @@ class Endpoint:
         the old way (jax_eval.run_batch_cached).  Anything ineligible falls
         back to per-request handling; responses are byte-identical either
         way."""
+        for r in reqs:
+            resolve_encode_type(r)
         if len(reqs) >= 2 and self.device_enabled() and self._gate_ok("batch"):
             from ..util.failpoint import fail_point
 
@@ -675,6 +784,8 @@ class Endpoint:
         the service layer keeps every computed response when one rider's
         deadline expires in the queue (re-serving the whole batch would
         double the device work the shed was meant to save)."""
+        for r in reqs:
+            resolve_encode_type(r)
         if len(reqs) >= 2 and self.device_enabled() and self._gate_ok("batch"):
             from ..util.failpoint import fail_point
 

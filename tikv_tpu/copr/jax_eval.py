@@ -51,8 +51,8 @@ from .dag import (
     DagRequest,
     IndexScan,
     Limit,
-    ResponseEncoder,
     SelectResponse,
+    make_response_encoder,
     Selection,
     TableScan,
     TopN,
@@ -1362,9 +1362,9 @@ class JaxDagEvaluator:
         chunk = Chunk.full(out_cols)
         # post-agg TopN / Limit are tiny — run them via the CPU executors
         chunk = self._post_agg(chunk)
-        enc = ResponseEncoder(self.dag.chunk_rows)
+        enc = make_response_encoder(self.dag)
         enc.add_chunk(chunk, self.dag.output_offsets)
-        return SelectResponse(chunks=enc.finish())
+        return enc.to_response()
 
     def _assign_gids(self, cols, n_valid: int, groups: GroupDict):
         from .executors import _coded_group_parts, cols_for_eval
@@ -1470,8 +1470,7 @@ class JaxDagEvaluator:
         if self.plan.limit is not None:
             k = min(k, self.plan.limit.limit)
         if k == 0:
-            enc = ResponseEncoder(self.dag.chunk_rows)
-            return SelectResponse(chunks=enc.finish())
+            return make_response_encoder(self.dag).to_response()
         dtypes = self._topn_state_dtypes()
         jdt = {np.float64: jnp.float64, np.bool_: jnp.bool_}
         state = tuple(
@@ -1521,9 +1520,9 @@ class JaxDagEvaluator:
             out_cols.append(
                 Column(et, data, nulls.astype(bool), frac, payload_dicts.get(ci))
             )
-        enc = ResponseEncoder(self.dag.chunk_rows)
+        enc = make_response_encoder(self.dag)
         enc.add_chunk(Chunk.full(out_cols), self.dag.output_offsets)
-        return SelectResponse(chunks=enc.finish())
+        return enc.to_response()
 
     # -- selection-only pipeline ------------------------------------------
 
@@ -1535,7 +1534,7 @@ class JaxDagEvaluator:
         remaining = self.plan.limit.limit if self.plan.limit else None
         sel_rpns = self.sel_rpns
         mask_jit = None
-        enc = ResponseEncoder(self.dag.chunk_rows)
+        enc = make_response_encoder(self.dag)
         for cols, n_valid in self._blocks(source):
             valid = np.zeros(self.block_rows, dtype=bool)
             valid[:n_valid] = True
@@ -1560,7 +1559,7 @@ class JaxDagEvaluator:
             enc.add_chunk(chunk, self.dag.output_offsets)
             if remaining is not None and remaining <= 0:
                 break
-        return SelectResponse(chunks=enc.finish())
+        return enc.to_response()
 
 
 _BATCH_FN_CACHE: dict = {}

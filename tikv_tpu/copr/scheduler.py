@@ -70,7 +70,13 @@ from .dag import (
     TableScan,
     TopN,
 )
-from .endpoint import REQ_TYPE_DAG, CoprRequest, CoprResponse, stale_read_ctx
+from .endpoint import (
+    REQ_TYPE_DAG,
+    CoprRequest,
+    CoprResponse,
+    resolve_encode_type,
+    stale_read_ctx,
+)
 from .region_cache import _epoch_of, schema_sig
 from .rpn import ColumnRef, Constant, FuncCall
 from .sig_map import resolve_sig
@@ -156,7 +162,11 @@ def plan_signature(dag: DagRequest) -> tuple:
             parts.append(("limit", ex.limit))
         else:
             parts.append((type(ex).__name__,))
-    parts.append(("out", tuple(dag.output_offsets or ()), dag.chunk_rows))
+    # encode_type is part of the slot identity: identical requests share one
+    # slot's RESPONSE BYTES, and a datum and a chunk request with the same
+    # plan must never share those (mirrors the service parse-memo rule)
+    parts.append(("out", tuple(dag.output_offsets or ()), dag.chunk_rows,
+                  dag.encode_type))
     return tuple(parts)
 
 
@@ -228,6 +238,8 @@ class CoprReadScheduler:
     # -- synchronous entry (endpoint.handle_batch / batch_coprocessor) -----
 
     def run_batch(self, reqs: list[CoprRequest], *, return_errors: bool = False):
+        for r in reqs:
+            resolve_encode_type(r)
         tctx = trace.current_context()
         items = [
             _Item(req=r, index=i, lane=_lane_of(r),
@@ -279,6 +291,9 @@ class CoprReadScheduler:
         lane and wait for the batch that serves it.  Falls back to the
         direct path when the scheduler is stopped, the request is not
         batchable, or admission control sheds it."""
+        # encoding negotiation BEFORE admission: an unsupported chunk plan
+        # must batch (and key) as its datum twin, never reach an evaluator
+        resolve_encode_type(req)
         deadline = deadline_from_context(req.context)
         if deadline is not None and time.monotonic() >= deadline:
             # dead on arrival: admission control sheds it before it costs a
@@ -826,22 +841,28 @@ class CoprReadScheduler:
                         encoding=obs_enc, occupancy=n_batch, waste=waste,
                         dispatch_t=t0)
             for slot, resp in zip(live, resps):
-                data = resp.encode()
+                # per-region chunk payloads: every rider of this slot shares
+                # the SAME unjoined column-slab parts, so one multi-response
+                # frame gather-writes each region's slabs once
+                parts, enc_tp = self.ep._encode_response(resp)
+                data = None
                 from_device = True
                 if slot.shadow_snap is not None:
                     # sampled slot: CPU-oracle byte compare; a mismatch
                     # quarantines the image and this slot serves the oracle
                     fixed = self.ep.shadow_compare(
-                        slot.items[0].req, slot.shadow_snap, data, "batch")
+                        slot.items[0].req, slot.shadow_snap,
+                        b"".join(bytes(p) for p in parts), "batch")
                     if fixed is not None:
-                        data = fixed
+                        data, parts = fixed, None
                         from_device = False
                 from_cache = from_device and slot.outcome not in ("", "miss", "too_big")
                 for it in slot.items:
                     if results[it.index] is not None:
                         continue  # the cold-fill already answered this one
                     r = CoprResponse(data, from_device=from_device,
-                                     from_cache=from_cache)
+                                     from_cache=from_cache,
+                                     data_parts=parts, encode_type=enc_tp)
                     self._stamp(r, it, kind=kind, occupancy=n_batch,
                                 waste=waste, total_s=dt / n_reqs)
                     results[it.index] = r
@@ -943,7 +964,8 @@ class CoprReadScheduler:
                 # computed — re-execute per-request over the rebuilt state
                 _rec_fused(groups[0], evs[0])
                 for it in groups[0]:
-                    r = CoprResponse(fixed, from_device=False)
+                    r = CoprResponse(fixed, from_device=False,
+                                     encode_type=resps[0].encode_type)
                     self._stamp(r, it, kind="fused", occupancy=n_reqs,
                                 total_s=dt / n_reqs)
                     results[it.index] = r
@@ -955,9 +977,10 @@ class CoprReadScheduler:
             _rec_fused(group, g_ev)
         from_cache = slot.outcome not in ("", "miss", "too_big")
         for group, resp in zip(uniq.values(), resps):
-            data = resp.encode()
+            parts, enc_tp = self.ep._encode_response(resp)
             for it in group:
-                r = CoprResponse(data, from_device=True, from_cache=from_cache)
+                r = CoprResponse(None, from_device=True, from_cache=from_cache,
+                                 data_parts=parts, encode_type=enc_tp)
                 self._stamp(r, it, kind="fused", occupancy=n_reqs,
                             total_s=dt / n_reqs)
                 results[it.index] = r

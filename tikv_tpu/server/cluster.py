@@ -338,6 +338,38 @@ class ServerCluster:
                 last = e
         raise last if last is not None else KeyError(key)
 
+    def coprocessor_rows(self, store_id: int, dag, ranges, start_ts: int,
+                         chunk: bool = False, context: dict | None = None,
+                         timeout: float = 30.0) -> list[list]:
+        """Socket coprocessor call against one store with per-request
+        TypeChunk opt-in (docs/wire_path.md "Columnar chunk responses"):
+        ``chunk=True`` asks for column-slab responses (``encode_type`` +
+        ``data_parts`` on the wire) and decodes them against the sent plan;
+        the datum path stays the default.  Returns decoded rows either way
+        — value-identical across encodings by the differential contract."""
+        from dataclasses import replace
+
+        from ..copr import dag as dag_mod
+        from ..copr.dag_wire import dag_to_wire
+        from .server import Client
+
+        if chunk and dag.encode_type != dag_mod.ENC_TYPE_CHUNK:
+            dag = replace(dag, encode_type=dag_mod.ENC_TYPE_CHUNK)
+        addr = self.addrs[store_id]
+        client = Client(*addr)
+        try:
+            r = client.call("coprocessor", {
+                "dag": dag_to_wire(dag),
+                "ranges": [list(rng) for rng in ranges],
+                "start_ts": start_ts,
+                "context": dict(context or {}),
+            }, timeout=timeout)
+        finally:
+            client.close()
+        if isinstance(r, dict) and r.get("error"):
+            raise RuntimeError(f"coprocessor failed: {r['error']}")
+        return dag_mod.decode_wire_response(r, dag).iter_rows()
+
     def set_device_owners(self, owners: dict[int, int]) -> None:
         """Push a device-owner placement map (region -> store) into every
         full-service node's read plane — the deterministic test-harness

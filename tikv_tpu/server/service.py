@@ -1082,7 +1082,14 @@ class KvService:
 
     def _parse_dag_wire(self, dag: dict):
         """Memoized wire-dict -> DagRequest parse (shared by the unary,
-        batch, and streaming handlers)."""
+        batch, and streaming handlers).
+
+        The key is the plan's canonical wire bytes, which INCLUDE
+        ``encode_type`` (dag_wire emits it whenever non-default): a datum
+        and a TypeChunk request with identical executor bytes parse to
+        distinct DagRequest objects, so a cached parse can never pin the
+        wrong response encoder onto the other encoding's requests
+        (tests/test_chunk_wire.py)."""
         from . import wire
         from ..copr.dag_wire import dag_from_wire
 
@@ -1205,6 +1212,44 @@ class KvService:
                     next(iter(self._dag_eligible_memo)))
         return ok
 
+    @staticmethod
+    def _requested_chunk(req: dict) -> bool:
+        """Did THIS wire request opt into TypeChunk?  (The parsed dag may
+        already be the downgraded datum twin, so read the raw request.)"""
+        dag = req.get("dag") if isinstance(req, dict) else None
+        if isinstance(dag, dict):
+            return dag.get("encode_type", 0) == 1
+        return getattr(dag, "encode_type", 0) == 1
+
+    @staticmethod
+    def _copr_resp_dict(r, requested_chunk: bool, declined: bool) -> dict:
+        """One coprocessor sub-response as a wire dict.  TypeChunk
+        responses ship ``data_parts`` — the unjoined column slabs, each
+        ≥PASSTHROUGH_MIN riding the frame as its own memoryview part
+        through the ``sendmsg`` gather write — plus ``encode_type`` so the
+        client picks the decoder.  Outcomes land in
+        ``tikv_wire_chunk_total`` (declines were counted, with their cause,
+        at negotiation time)."""
+        out: dict = {"from_device": r.from_device}
+        if r.encode_type:
+            out["encode_type"] = r.encode_type
+            out["data_parts"] = (r.data_parts if r.data_parts is not None
+                                 else [r.data])
+            outcome = "chunk"
+        else:
+            out["data"] = r.data
+            outcome = None if (not requested_chunk or declined) \
+                else "datum_fallback"
+        if requested_chunk and outcome is not None:
+            from ..util.metrics import REGISTRY
+
+            REGISTRY.counter(
+                "tikv_wire_chunk_total",
+                "TypeChunk response negotiation, by outcome (cause on "
+                "declines)",
+            ).inc(outcome=outcome, cause="")
+        return out
+
     def _coprocessor_local(self, req: dict) -> dict:
         assert self.copr is not None, "coprocessor endpoint not wired"
         try:
@@ -1214,7 +1259,9 @@ class KvService:
                 r = sched.execute(creq)
             else:
                 r = self.copr.handle_request(creq)
-            return {"data": r.data, "from_device": r.from_device}
+            return self._copr_resp_dict(
+                r, self._requested_chunk(req),
+                bool((creq.context or {}).get("chunk_declined")))
         except Exception as e:  # noqa: BLE001
             return {"error": _err(e)}
 
@@ -1233,9 +1280,15 @@ class KvService:
         except Exception:  # noqa: BLE001 — a parse failure poisons nothing
             return {"responses": [self.coprocessor(sub) for sub in subs]}
         out = []
-        for sub, r, e in zip(subs, results, errors):
+        for sub, r, e, creq in zip(subs, results, errors, creqs):
             if e is None and r is not None:
-                out.append({"data": r.data, "from_device": r.from_device})
+                # per-region payloads (chunk or datum) answer in THIS one
+                # frame — the scheduler's vmapped cross-region batch rides
+                # back to the wire client as a single multi-response frame
+                # with per-region error isolation (docs/wire_path.md)
+                out.append(self._copr_resp_dict(
+                    r, self._requested_chunk(sub),
+                    bool((creq.context or {}).get("chunk_declined"))))
             elif isinstance(e, DeadlineExceeded):
                 # expired in queue: report it, never re-dispatch — the
                 # client already gave up on this slot
@@ -1274,8 +1327,12 @@ class KvService:
         except Exception as e:  # noqa: BLE001
             return {"error": _err(e)}
 
+        requested_chunk = self._requested_chunk(req)
+
         def frames():
             for r in self.copr.handle_streaming_request(creq, rows_per_stream):
-                yield {"data": r.data}
+                yield self._copr_resp_dict(
+                    r, requested_chunk,
+                    bool((creq.context or {}).get("chunk_declined")))
 
         return frames()
