@@ -1195,6 +1195,120 @@ def _op_mixed_rw(req, state):
     }
 
 
+def _op_overload(req, state):
+    """overload event (docs/robustness.md "Overload control plane"):
+    well-behaved-tenant throughput retention at saturation.
+
+    One device endpoint with continuous scheduler lanes and per-tenant
+    quotas: a ``victim`` tenant runs the cross-region sweep sequentially
+    (baseline), then re-runs it while a ``hot`` tenant floods identical
+    device-eligible work from ``flood_threads`` threads at many times its
+    quota.  Reported: victim throughput retention (loaded / baseline),
+    victim failures (must be 0 — quotas shed the HOT tenant, not the
+    victim), and how much hot overage was shed."""
+    import itertools as _it
+
+    from tikv_tpu.copr.endpoint import Endpoint
+    from tikv_tpu.copr.overload import (
+        OverloadConfig, OverloadControl, TenantQuota,
+    )
+    from tikv_tpu.copr.scheduler import SchedulerConfig
+    from tikv_tpu.storage.kv import LocalEngine
+    from tikv_tpu.util.metrics import REGISTRY
+
+    eng, block_rows, sweep, regions, rows_per, clients = _xregion_harness(
+        req, seed=43)
+    trials = req.get("trials", 3)
+    flood_threads = req.get("flood_threads", 3)
+    ep = Endpoint(LocalEngine(eng), enable_device=True, block_rows=block_rows,
+                  sched_config=SchedulerConfig(max_queue=64, busy_reject=True))
+    ep.overload = OverloadControl(
+        OverloadConfig(
+            tenants={"hot": TenantQuota(requests_per_s=20.0, burst_s=0.5,
+                                        max_priority="low")},
+            max_priority="normal", max_wait_s=0.002, adaptive=False,
+        ),
+        region_cache=ep.region_cache)
+    admission = REGISTRY.counter("tikv_overload_admission_total", "")
+
+    def tag(q, tenant, ts):
+        q.context = dict(q.context, tenant=tenant)
+        q.start_ts = ts
+        return q
+
+    ep.scheduler.start()
+    try:
+        for _ in range(2):  # warm images + compile
+            for q in sweep():
+                ep.handle_request(tag(q, "victim", 100))
+        base_ts, load_ts, failures = [], [], 0
+        for _ in range(trials):
+            reqs = [tag(q, "victim", 100) for q in sweep()]
+            t0 = time.perf_counter()
+            for q in reqs:
+                ep.scheduler.execute(q)
+            base_ts.append(time.perf_counter() - t0)
+        shed0 = admission.get(tenant="hot", outcome="shed", where="sched")
+        stop = threading.Event()
+        hot_sent = _it.count()
+        # paced flood: ~hot_qps submissions/s (25x the 20 rps quota) — a
+        # real client herd, not a GIL-burning spin loop (the floor measures
+        # the ADMISSION policy's fairness, not Python thread contention)
+        interval = flood_threads / float(req.get("hot_qps", 500.0))
+
+        def flood():
+            while not stop.is_set():
+                try:
+                    ep.scheduler.execute(tag(sweep()[0], "hot", 100))
+                except Exception:  # noqa: BLE001 — shed IS the mechanism
+                    pass
+                next(hot_sent)
+                stop.wait(interval)
+
+        hot = [threading.Thread(target=flood, daemon=True)
+               for _ in range(flood_threads)]
+        for t in hot:
+            t.start()
+        try:
+            # one unmeasured sweep under flood: the hot burst drains and
+            # the admission plane reaches steady state before timing
+            for q in sweep():
+                try:
+                    ep.scheduler.execute(tag(q, "victim", 100))
+                except Exception:  # noqa: BLE001
+                    failures += 1
+            for _ in range(trials):
+                reqs = [tag(q, "victim", 100) for q in sweep()]
+                t0 = time.perf_counter()
+                for q in reqs:
+                    try:
+                        ep.scheduler.execute(q)
+                    except Exception:  # noqa: BLE001 — victim must not shed
+                        failures += 1
+                load_ts.append(time.perf_counter() - t0)
+        finally:
+            stop.set()
+            for t in hot:
+                t.join(timeout=5.0)
+        hot_shed = admission.get(tenant="hot", outcome="shed",
+                                 where="sched") - shed0
+        base = float(np.median(base_ts))
+        load = float(np.median(load_ts))
+        return {
+            "regions": regions,
+            "rows_per_region": rows_per,
+            "requests_per_sweep": len(sweep()),
+            "baseline_ts": [round(x, 4) for x in base_ts],
+            "loaded_ts": [round(x, 4) for x in load_ts],
+            "retention": round(base / load, 3) if load else 0.0,
+            "victim_failures": failures,
+            "hot_submitted": next(hot_sent),
+            "hot_shed": int(hot_shed),
+        }
+    finally:
+        ep.scheduler.stop()
+
+
 _OPS = {
     "build": _op_build,
     "warm": _op_warm,
@@ -1210,6 +1324,7 @@ _OPS = {
     "wire_chunk": _op_wire_chunk,
     "sharded_xregion": _op_sharded_xregion,
     "mixed_rw": _op_mixed_rw,
+    "overload": _op_overload,
 }
 
 
@@ -1833,6 +1948,28 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             results["compressed_error"] = str(e)[:200]
             _mark("compressed_error", err=str(e)[:120])
+
+    if os.environ.get("BENCH_OVERLOAD", "1") != "0":
+        # overload control plane (ISSUE 15): well-behaved-tenant throughput
+        # retention while a hot tenant floods past its quota.  In-parent on
+        # CPU — it measures admission policy, not device compute.
+        try:
+            r = _op_overload({
+                "regions": 4,
+                "rows": int(os.environ.get("BENCH_OVERLOAD_ROWS", "16000")),
+                "clients": 2,
+            }, {})
+            if r["victim_failures"]:
+                _fail("OVERLOAD_VICTIM_FAILURES")
+            results["overload_retention"] = r["retention"]
+            results["overload_hot_shed"] = r["hot_shed"]
+            results["overload_hot_submitted"] = r["hot_submitted"]
+            _mark("overload", retention=round(r["retention"], 3),
+                  hot_shed=r["hot_shed"],
+                  victim_failures=r["victim_failures"])
+        except Exception as e:  # noqa: BLE001
+            results["overload_error"] = str(e)[:200]
+            _mark("overload_error", err=str(e)[:120])
 
     if os.environ.get("BENCH_MVCC", "1") != "0":
         try:

@@ -114,6 +114,12 @@ def main(argv=None) -> int:
     sp.add_argument("--region", type=int, dest="progress_region", default=None,
                     help="narrow to one region (default: every region)")
     sub.add_parser(
+        "overload",
+        help="overload-control view (docs/robustness.md): per-tenant "
+             "bucket levels + effective rates, defer/shed counts, the "
+             "adaptive controller's scale and evidence, and HBM partition "
+             "occupancy")
+    sub.add_parser(
         "integrity",
         help="derived-plane integrity view: per-region image fingerprints "
              "+ apply points, quarantine ledger, scrubber progress, "
@@ -413,6 +419,8 @@ def main(argv=None) -> int:
             r = c.call("debug_region_properties", {"region_id": args.region})
         elif args.cmd == "integrity":
             r = c.call("debug_integrity", {})
+        elif args.cmd == "overload":
+            r = c.call("debug_overload", {})
         elif args.cmd == "consistency-check":
             if args.trigger:
                 req = {}

@@ -59,6 +59,7 @@ MIN_WARM_HIT_RATE = 0.5
 MIN_WIRE_SPEEDUP = 5.0
 MIN_WIRE_CHUNK_SPEEDUP = 3.0
 MIN_COMPRESSED_CAPACITY = 2.0
+MIN_OVERLOAD_RETENTION = 0.5
 SHARDED_DEVICES = 8
 
 
@@ -244,6 +245,32 @@ def main() -> int:
         out["compressed_regression"] = (
             f"{rc['warm_capacity_ratio']:.2f}x warm regions < "
             f"{MIN_COMPRESSED_CAPACITY}x floor at equal budget")
+
+    # overload control plane (ISSUE 15): a hot tenant saturating the
+    # scheduler must not cost the well-behaved tenant more than half its
+    # throughput, and must never fail one of its reads — per-tenant quotas
+    # shed the flood, not the victim (docs/robustness.md "Overload")
+    ro = bench._op_overload({
+        "regions": 4,
+        "rows": int(os.environ.get("SMOKE_OVERLOAD_ROWS", "8000")),
+        "clients": 2, "trials": max(args.trials, 3),
+    }, {})
+    out["overload_retention"] = round(float(ro["retention"]), 3)
+    out["overload_victim_failures"] = ro["victim_failures"]
+    out["overload_hot_shed"] = ro["hot_shed"]
+    overload_regressions = []
+    if ro["victim_failures"]:
+        overload_regressions.append(
+            f"{ro['victim_failures']} victim reads failed under flood")
+    if ro["retention"] < MIN_OVERLOAD_RETENTION:
+        overload_regressions.append(
+            f"victim retention {ro['retention']:.2f} < "
+            f"{MIN_OVERLOAD_RETENTION} floor")
+    if ro["hot_shed"] <= 0:
+        overload_regressions.append("hot tenant overage was never shed")
+    if overload_regressions:
+        ok = False
+        out["overload_regression"] = "; ".join(overload_regressions)
 
     # group-commit write path + warm serving under writes (ISSUE 4)
     rm = bench._op_mixed_rw({

@@ -48,4 +48,8 @@ echo "== chunk-wire smoke: TypeChunk negotiation, differential byte-identity, ze
 JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
   -m 'not slow' tests/test_chunk_codec.py tests/test_chunk_wire.py
 
+echo "== overload smoke: tenant quotas, adaptive admission, hot-tenant flood continuity under the sanitizer =="
+JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
+  -m 'not slow' tests/test_overload.py
+
 echo "check.sh: all gates green"

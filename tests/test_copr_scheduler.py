@@ -468,6 +468,86 @@ def test_continuous_mode_isolates_per_request_errors(engines, monkeypatch):
             _region_req(r, ROWS_PER, _sum_dag(37))).data
 
 
+def test_concurrent_queue_full_and_busy_reject_exactly_once(engines):
+    """ISSUE 15 satellite: concurrent execute() callers racing a FULL
+    queue each get exactly ONE typed outcome — batched/direct serve with
+    correct bytes, or a busy rejection — with no lost wakeups (every call
+    returns) and no double-counted sheds (the busy counter moves once per
+    observed rejection)."""
+    from tikv_tpu.util import failpoint
+    from tikv_tpu.util.retry import ServerBusyError
+
+    dev, cpu = engines
+    sched = dev.scheduler
+    old_cfg = sched.cfg
+    rq = lambda: _region_req(0, ROWS_PER, _sum_dag(21))
+    want = cpu.handle_request(rq()).data
+    dev.handle_request(rq())  # warm image + compile
+    shed = REGISTRY.counter("tikv_coprocessor_sched_shed_total")
+    coalesce = REGISTRY.counter("tikv_wire_coalesce_total")
+    N_THREADS, N_CALLS = 8, 6
+
+    def drive():
+        outcomes: list[str] = []
+        mu = threading.Lock()
+
+        def worker():
+            for _ in range(N_CALLS):
+                try:
+                    r = sched.execute(rq(), timeout=30.0)
+                    out = "served" if r.data == want else "wrong"
+                except ServerBusyError:
+                    out = "busy"
+                with mu:
+                    outcomes.append(out)
+
+        threads = [threading.Thread(target=worker) for _ in range(N_THREADS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60.0)
+        return outcomes
+
+    # --- busy_reject: rejections are typed, counted exactly once ---
+    sched.cfg = SchedulerConfig(max_queue=2, busy_reject=True)
+    failpoint.cfg("sched_dispatch", "sleep(10)")  # keep the queue racing
+    sched.start()
+    try:
+        busy0 = shed.get(reason="busy_reject")
+        cbusy0 = coalesce.get(outcome="busy_reject")
+        outcomes = drive()
+        assert len(outcomes) == N_THREADS * N_CALLS, "a caller lost its wakeup"
+        assert "wrong" not in outcomes
+        n_busy = outcomes.count("busy")
+        assert n_busy > 0, "the race never hit the full queue"
+        assert shed.get(reason="busy_reject") == busy0 + n_busy, \
+            "each rejection must count exactly once"
+        assert coalesce.get(outcome="busy_reject") == cbusy0 + n_busy
+    finally:
+        failpoint.remove("sched_dispatch")
+        sched.stop()
+
+    # --- queue_full (busy_reject off): every racing caller is SERVED ---
+    sched.cfg = SchedulerConfig(max_queue=1)
+    failpoint.cfg("sched_dispatch", "sleep(10)")
+    sched.start()
+    try:
+        qf0 = shed.get(reason="queue_full")
+        cqf0 = coalesce.get(outcome="queue_full")
+        outcomes = drive()
+        assert len(outcomes) == N_THREADS * N_CALLS
+        assert set(outcomes) == {"served"}, \
+            "queue_full without busy_reject serves on the caller's thread"
+        n_qf = shed.get(reason="queue_full") - qf0
+        assert n_qf > 0, "the race never hit the full queue"
+        assert coalesce.get(outcome="queue_full") == cqf0 + n_qf, \
+            "direct-path sheds must count once on each series"
+    finally:
+        failpoint.remove("sched_dispatch")
+        sched.stop()
+        sched.cfg = old_cfg
+
+
 def test_scheduler_stop_drains_queue(engines):
     dev, _cpu = engines
     sched = dev.scheduler

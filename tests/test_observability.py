@@ -84,6 +84,14 @@ LAZY_SERIES = {
     "tikv_coprocessor_encoded_path_total",
     "tikv_coprocessor_encoded_decline_total",
     "tikv_coprocessor_encoded_rewrite_total",
+    "tikv_overload_admission_total",
+    "tikv_overload_demote_total",
+    "tikv_overload_bucket_level",
+    "tikv_overload_effective_scale",
+    "tikv_overload_controller_total",
+    "tikv_overload_hbm_bytes",
+    "tikv_overload_hbm_evict_total",
+    "tikv_overload_device_block_total",
     "tikv_gcworker_gc_tasks_total",
     "tikv_memory_usage_bytes",
     "tikv_raftstore_proposal_total",

@@ -82,6 +82,62 @@ def test_sched_too_busy_carries_retry_after():
     assert r.should_retry(e) >= 0.125
 
 
+def test_busy_hint_dominates_backoff_under_concurrent_callers():
+    """ISSUE 15 regression: every ``busy``-class shed — scheduler
+    busy_reject, tenant-quota shed, txn SchedTooBusy — carries a NON-ZERO
+    ``retry_after_s``, and with many callers retrying concurrently the
+    hint dominates each caller's early backoff curve (the server's drain
+    estimate, not the client's tiny base_s, paces the herd)."""
+    import threading
+
+    hint = 0.2
+    policy = RetryPolicy(base_s=0.001, max_s=2.0, jitter=0.2)
+    sleeps_by_caller: dict[int, list[float]] = {}
+    mu = threading.Lock()
+
+    def caller(idx: int):
+        r = Retrier(policy, site="busy_herd")
+        mine = []
+        # first 6 attempts: the curve (0.001..0.032 * jitter) sits far
+        # below the hint — every sleep must be >= the hint anyway
+        for _ in range(6):
+            d = r.should_retry(ServerBusyError("full", retry_after_s=hint))
+            assert d is not None
+            mine.append(d)
+        with mu:
+            sleeps_by_caller[idx] = mine
+
+    threads = [threading.Thread(target=caller, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10.0)
+    assert len(sleeps_by_caller) == 8
+    for mine in sleeps_by_caller.values():
+        assert all(d >= hint for d in mine), mine
+    # the hint floor: even a zero-configured busy knob yields > 0 on the
+    # wire (scheduler floors at 1ms; SchedTooBusy floors its drain hint)
+    from tikv_tpu.storage.txn.scheduler import Scheduler
+    from tikv_tpu.storage.kv import LocalEngine
+    from tikv_tpu.storage.btree_engine import BTreeEngine
+    from tikv_tpu.storage.txn import commands as cmds
+    from tikv_tpu.storage.txn_types import Key, Mutation
+
+    sched = Scheduler(LocalEngine(BTreeEngine()), pool_size=64,
+                      pending_write_threshold=1)
+    try:
+        with sched._mu:
+            sched._inflight = 1  # at threshold: next submit is too busy
+        with pytest.raises(SchedTooBusy) as ei:
+            sched.submit(cmds.Prewrite(
+                [Mutation.put(Key.from_raw(b"k"), b"v")], b"k", 10))
+        assert ei.value.retry_after_s >= 0.001
+    finally:
+        with sched._mu:
+            sched._inflight = 0
+        sched.stop()
+
+
 # ---------------------------------------------------------------------------
 # data_not_ready: the watermark-aware class (ISSUE 7 bugfix satellite)
 # ---------------------------------------------------------------------------

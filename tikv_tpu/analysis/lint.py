@@ -88,6 +88,7 @@ _SANITIZER_WIRED = {
     "tikv_tpu/copr/encoding.py",
     "tikv_tpu/copr/integrity.py",
     "tikv_tpu/copr/observatory.py",
+    "tikv_tpu/copr/overload.py",
     "tikv_tpu/copr/region_cache.py",
     "tikv_tpu/copr/scheduler.py",
     "tikv_tpu/raft/store.py",

@@ -145,6 +145,8 @@ class Endpoint:
         breaker=None,
         breaker_config=None,
         shadow_sample: int | None = None,
+        overload=None,
+        overload_config=None,
     ):
         from .breaker import DeviceCircuitBreaker
         from .tracker import SlowLog
@@ -229,6 +231,19 @@ class Endpoint:
             IntegrityScrubber(self.region_cache, engine)
             if self.region_cache is not None else None
         )
+        # overload control plane (docs/robustness.md "Overload"): per-tenant
+        # quota admission + lane clamping in the scheduler, HBM partitions
+        # in the region cache, CPU fallback on the memory-pressure ladder's
+        # last rung.  None = no admission policy (historical behavior).
+        if overload is not None:
+            self.overload = overload
+        elif overload_config is not None:
+            from .overload import OverloadControl
+
+            self.overload = OverloadControl(
+                overload_config, region_cache=self.region_cache)
+        else:
+            self.overload = None
 
     def _encode_response(self, resp: SelectResponse):
         """SelectResponse -> (frame parts, encode_type): the one response
@@ -330,6 +345,16 @@ class Endpoint:
         # path below so operators see read traffic scale with replicas
         stale_snap = bool(getattr(snap, "stale", False))
         use_device = self.device_enabled() and jax_eval.supports(req.dag)
+        if use_device and self.overload is not None \
+                and not self.overload.allow_device(req.context):
+            # memory-pressure degradation ladder, last rung (overload.py):
+            # this tenant's HBM partition would not fit even after eviction
+            # and pin demotion — serve its work on the CPU pipeline until
+            # the cooldown lifts, leaving other tenants' warm sets alone
+            from .tracker import count_path_fallback
+
+            count_path_fallback("unary", "tenant_pressure")
+            use_device = False
         if use_device and not self.breaker.allow("unary"):
             # tripped: repeated unary device faults — serve straight off the
             # CPU pipeline until a half-open probe restores the path
@@ -608,6 +633,14 @@ class Endpoint:
                 f"shadow read mismatch on region {region_id} path={path}"
             )
         return cpu
+
+    def overload_snapshot(self) -> dict:
+        """The /debug/overload + ``ctl.py overload`` view: per-tenant
+        bucket levels and effective rates, shed/defer counts, adaptive
+        controller state, and HBM partition occupancy."""
+        if self.overload is None:
+            return {"enabled": False, "wired": False}
+        return self.overload.snapshot()
 
     def integrity_snapshot(self) -> dict:
         """The /debug/integrity + ``ctl.py integrity`` view: per-image

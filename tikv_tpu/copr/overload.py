@@ -1,0 +1,615 @@
+"""Overload control plane: per-tenant quotas, adaptive admission, degradation.
+
+The reference survives saturation because admission is explicit: a
+``QuotaLimiter`` meters each user's reads, front-end flow control turns a
+full scheduler into typed ``ServerIsBusy`` backpressure, and the read pool's
+priority lanes are a *server-side* policy, not a client-declared free-for-all
+(``src/read_pool.rs``, ``quota_limiter``).  This module is that policy tier
+for the device serving plane (docs/robustness.md "Overload control plane"):
+
+* **Tenant identity** — requests carry ``tenant`` in their context
+  (:func:`tenant_of`; absent = the ``default`` tenant).  Every admission
+  decision, priority clamp, and HBM partition keys on it.
+* **Per-tenant token buckets** (:class:`QuotaLimiter`) — requests/s and
+  read-bytes/s refill at configured rates (runtime-tunable through POST
+  /config ``overload.*``).  Over-quota work is DEFERRED for a bounded wait
+  when the bucket refills soon, else SHED as :class:`ServerBusyError` whose
+  ``retry_after_s`` is the bucket's ACTUAL refill deficit — clients back off
+  proportionally to how far over budget the tenant is, not by a constant.
+  Read bytes are charged **post-serve** (response size is unknown at
+  admission); the bucket then runs a deficit that defers/sheds the tenant's
+  NEXT admissions — the GCRA-style debt shape.
+* **Priority clamping** — a tenant's maximum lane is configuration
+  (per-tenant ceiling, global default), never the client-declared
+  ``priority``; demotions are counted.  The scheduler clamps even with
+  overload disabled (``SchedulerConfig.max_priority``).
+* **Adaptive admission** (:class:`AdaptiveController`) — samples queue
+  depth, lane wait, and the observatory's per-(sig, path) p99 against its
+  learned floor each window, and tightens/relaxes one ``scale`` factor in
+  ``[min_scale, 1]``.  The scale multiplies every bucket's effective rate
+  AND shrinks the scheduler's effective queue cap, turning the static
+  ``busy_reject`` boolean into evidence-based shedding.  Every decision is
+  counted (``tikv_overload_controller_total{action}``).
+* **Memory-pressure degradation** — the region column cache partitions its
+  byte budget per tenant (``RegionColumnCache.set_tenant_budgets``; the
+  default tenant owns the remainder pool) and degrades an over-budget
+  tenant down a ladder: evict ITS coldest images → demote ITS pins to host
+  → CPU-fallback ITS device paths for a cooldown — never another tenant's
+  warm set.  :meth:`OverloadControl.allow_device` is the serving-path gate.
+
+Bounds: at most ``MAX_TENANTS`` live tenant states (LRU).  The limiter and
+controller each own ONE leaf lock; nothing is called under them (the defer
+sleep runs outside) — the module is in the lint's ``_SANITIZER_WIRED`` set.
+
+Kill switch: ``OverloadConfig(enabled=False)`` (the default everywhere an
+operator has not opted in) makes every admission a no-op.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ..analysis.sanitizer import make_lock
+from ..util.retry import ServerBusyError
+
+DEFAULT_TENANT = "default"
+
+#: lane order shared with the scheduler (high drains first); rank 0 is the
+#: highest priority, so "clamp to ceiling" moves a lane DOWN the table
+LANES = ("high", "normal", "low")
+_LANE_RANK = {lane: i for i, lane in enumerate(LANES)}
+
+MAX_TENANTS = 64
+#: floor under every busy hint: a zero retry_after would collapse the
+#: client's hint-dominated backoff to its raw curve (docs/robustness.md)
+MIN_RETRY_AFTER_S = 0.001
+
+
+def tenant_of(context) -> str:
+    """The request's tenant identity (``context["tenant"]``; default
+    tenant otherwise).  Values are stringified — metric labels and dict
+    keys must be stable."""
+    t = (context or {}).get("tenant")
+    return str(t) if t else DEFAULT_TENANT
+
+
+def clamp_lane(lane: str, ceiling: str | None) -> str:
+    """The effective lane under a ceiling: a request may always ask for a
+    LOWER priority than its ceiling, never a higher one."""
+    if ceiling is None or ceiling not in _LANE_RANK:
+        return lane
+    if _LANE_RANK.get(lane, 1) < _LANE_RANK[ceiling]:
+        return ceiling
+    return lane
+
+
+def count_demotion(tenant: str, lane: str) -> None:
+    """One client-declared priority clamped down to its ceiling."""
+    from ..util.metrics import REGISTRY
+
+    REGISTRY.counter(
+        "tikv_overload_demote_total",
+        "Client-declared priorities clamped to the configured ceiling, "
+        "by tenant and effective lane",
+    ).inc(tenant=tenant, lane=lane)
+
+
+@dataclass
+class TenantQuota:
+    """One tenant's budget.  Rate 0 = unlimited for that resource."""
+
+    requests_per_s: float = 0.0
+    read_bytes_per_s: float = 0.0
+    #: bucket capacity = rate * burst_s (at least one token): how much a
+    #: tenant may burst above its steady rate after an idle period
+    burst_s: float = 1.0
+    #: per-tenant lane ceiling; None inherits the global default
+    max_priority: str | None = None
+
+
+@dataclass
+class OverloadConfig:
+    """The control plane's knobs (POST /config ``overload.*`` reconfigures
+    the scalar ones online; per-tenant quotas via :meth:`set_quota`)."""
+
+    enabled: bool = True
+    default_quota: TenantQuota = field(default_factory=TenantQuota)
+    tenants: dict = field(default_factory=dict)  # tenant -> TenantQuota
+    #: global lane ceiling for client-declared priorities ("high" = allow)
+    max_priority: str = "high"
+    #: bounded defer: over-quota work whose bucket refills within this wait
+    #: sleeps instead of shedding (the reference's front-end flow control
+    #: smooths short bursts the same way)
+    max_wait_s: float = 0.02
+    adaptive: bool = True
+    window_s: float = 1.0
+    min_scale: float = 0.1
+    #: queue-fullness fractions the controller tightens/relaxes at
+    queue_high_frac: float = 0.75
+    queue_low_frac: float = 0.25
+    #: observatory evidence: a profiled p99 this multiple over its learned
+    #: floor is pressure (docs/observatory.md)
+    p99_ratio: float = 3.0
+    #: per-tenant HBM partition byte budgets pushed onto the region cache
+    tenant_hbm_budgets: dict = field(default_factory=dict)
+
+
+class _Bucket:
+    """Token bucket holding only its level; rates come from the quota at
+    every call, so runtime rate changes apply without bucket surgery."""
+
+    __slots__ = ("level", "last", "primed")
+
+    def __init__(self):
+        self.level = 0.0
+        self.last = 0.0
+        self.primed = False
+
+    def _refill(self, rate: float, burst_s: float, now: float) -> None:
+        cap = max(rate * burst_s, 1.0)
+        if not self.primed:
+            # first sight of this bucket: a fresh tenant starts with its
+            # full burst allowance, not an empty bucket
+            self.level = cap
+            self.primed = True
+        else:
+            self.level = min(cap, self.level + (now - self.last) * rate)
+        self.last = now
+
+    def take(self, rate: float, burst_s: float, n: float, now: float) -> float:
+        """0.0 = admitted (``n`` tokens debited); else seconds until the
+        bucket holds ``n`` tokens at the CURRENT rate — the actual refill
+        deficit, which is exactly the honest ``retry_after_s`` hint."""
+        if rate <= 0:
+            return 0.0  # unlimited resource
+        self._refill(rate, burst_s, now)
+        if self.level >= n:
+            self.level -= n
+            return 0.0
+        return (n - self.level) / rate
+
+    def charge(self, rate: float, burst_s: float, n: float, now: float) -> None:
+        """Post-serve debit (read bytes): the level may go NEGATIVE — the
+        debt surfaces as a deficit on the tenant's next admission."""
+        if rate <= 0 or n <= 0:
+            return
+        self._refill(rate, burst_s, now)
+        self.level -= n
+
+
+class _TenantState:
+    __slots__ = ("req", "nbytes", "admitted", "deferred", "shed")
+
+    def __init__(self):
+        self.req = _Bucket()
+        self.nbytes = _Bucket()
+        self.admitted = 0
+        self.deferred = 0
+        self.shed = 0
+
+
+class QuotaLimiter:
+    """Per-tenant token buckets over one leaf lock.  ``probe`` answers in
+    refill-deficit seconds; the facade (:class:`OverloadControl`) turns a
+    deficit into a bounded defer or a typed shed."""
+
+    def __init__(self, config: OverloadConfig, clock=time.monotonic):
+        self.cfg = config
+        self.clock = clock
+        self._mu = make_lock("copr.overload")
+        self._tenants: dict[str, _TenantState] = {}
+
+    def quota_for(self, tenant: str) -> TenantQuota:
+        return self.cfg.tenants.get(tenant, self.cfg.default_quota)
+
+    def set_quota(self, tenant: str, quota: TenantQuota) -> None:
+        """Runtime per-tenant override (the POST /config scalars retune the
+        DEFAULT quota; named tenants are set here or at construction)."""
+        with self._mu:
+            self.cfg.tenants[tenant] = quota
+
+    def lane_ceiling(self, tenant: str) -> str:
+        q = self.quota_for(tenant)
+        return q.max_priority or self.cfg.max_priority
+
+    def probe(self, tenant: str, scale: float = 1.0) -> float:
+        """One request admission attempt: 0.0 = admitted, else the refill
+        deficit in seconds.  The BYTE bucket is probed first with n=0 (a
+        post-serve debt defers before it costs a request token); only an
+        admitted probe debits the request bucket."""
+        q = self.quota_for(tenant)
+        now = self.clock()
+        with self._mu:
+            st = self._state_locked(tenant)
+            wait = st.nbytes.take(q.read_bytes_per_s * scale, q.burst_s, 0.0, now)
+            if wait > 0:
+                return wait
+            return st.req.take(q.requests_per_s * scale, q.burst_s, 1.0, now)
+
+    def charge_bytes(self, tenant: str, n: int, scale: float = 1.0) -> None:
+        if n <= 0:
+            return
+        q = self.quota_for(tenant)
+        if q.read_bytes_per_s <= 0:
+            return
+        now = self.clock()
+        with self._mu:
+            self._state_locked(tenant).nbytes.charge(
+                q.read_bytes_per_s * scale, q.burst_s, float(n), now)
+
+    def note(self, tenant: str, outcome: str) -> None:
+        with self._mu:
+            st = self._state_locked(tenant)
+            if outcome == "admit":
+                st.admitted += 1
+            elif outcome == "defer":
+                st.deferred += 1
+            else:
+                st.shed += 1
+
+    def _state_locked(self, tenant: str) -> _TenantState:
+        st = self._tenants.pop(tenant, None)
+        if st is None:
+            st = _TenantState()
+            while len(self._tenants) >= MAX_TENANTS:
+                self._tenants.pop(next(iter(self._tenants)))
+        self._tenants[tenant] = st  # reinsert = LRU touch
+        return st
+
+    def snapshot(self, scale: float = 1.0) -> dict:
+        """Per-tenant bucket levels, effective rates, and admission counts
+        (``/debug/overload``, ``ctl.py overload``).  Gauges the bucket
+        levels as it goes — the debug surface doubles as the heartbeat."""
+        from ..util.metrics import REGISTRY
+
+        level_g = REGISTRY.gauge(
+            "tikv_overload_bucket_level",
+            "Current token-bucket level, by tenant and resource",
+        )
+        out = {}
+        now = self.clock()
+        with self._mu:
+            for tenant, st in self._tenants.items():
+                q = self.quota_for(tenant)
+                # refill-to-now so the reported level is current, not the
+                # level at the tenant's last admission
+                if q.requests_per_s > 0:
+                    st.req._refill(q.requests_per_s * scale, q.burst_s, now)
+                if q.read_bytes_per_s > 0:
+                    st.nbytes._refill(q.read_bytes_per_s * scale, q.burst_s, now)
+                out[tenant] = {
+                    "requests_per_s": q.requests_per_s,
+                    "read_bytes_per_s": q.read_bytes_per_s,
+                    "effective_requests_per_s": round(q.requests_per_s * scale, 3),
+                    "effective_read_bytes_per_s": round(
+                        q.read_bytes_per_s * scale, 3),
+                    "max_priority": q.max_priority or self.cfg.max_priority,
+                    "request_tokens": round(st.req.level, 3),
+                    "byte_tokens": round(st.nbytes.level, 3),
+                    "admitted": st.admitted,
+                    "deferred": st.deferred,
+                    "shed": st.shed,
+                }
+                level_g.set(st.req.level, tenant=tenant, resource="requests")
+                level_g.set(st.nbytes.level, tenant=tenant, resource="bytes")
+        return out
+
+
+class AdaptiveController:
+    """Evidence-based admission tightening (docs/robustness.md).
+
+    Each ``window_s`` the controller folds three signals — mean queue
+    fullness, worst sampled lane wait, and the observatory's per-(sig,
+    path) p99 against the lowest p99 it has ever seen for that key (the
+    learned floor) — into one decision: ``tighten`` halves the scale,
+    ``relax`` grows it back toward 1.0, ``hold`` leaves it.  The scale
+    multiplies every bucket's effective rate and shrinks the scheduler's
+    effective queue cap (:meth:`queue_cap`), so shedding starts when the
+    evidence says the store is saturated, not when a static boolean does."""
+
+    def __init__(self, config: OverloadConfig, clock=time.monotonic):
+        self.cfg = config
+        self.clock = clock
+        self._mu = make_lock("copr.overload.controller")
+        self.scale = 1.0
+        self._q: list[float] = []
+        self._w: list[float] = []
+        self._last_tick = clock()
+        # (sig, path, encoding) -> lowest p99_ms ever profiled: the floor
+        # current windows are judged against
+        self._p99_floor: dict[tuple, float] = {}
+        self.actions = {"tighten": 0, "relax": 0, "hold": 0}
+        self.last_evidence: dict = {}
+
+    def note_queue(self, depth: int, cap: int) -> None:
+        now = self.clock()
+        with self._mu:
+            self._q.append(depth / max(cap, 1))
+            if len(self._q) > 4096:
+                del self._q[:-2048]
+            due = now - self._last_tick >= self.cfg.window_s
+            if due:
+                self._last_tick = now
+        if due:
+            self._tick()
+
+    def note_wait(self, wait_s: float) -> None:
+        with self._mu:
+            self._w.append(wait_s)
+            if len(self._w) > 4096:
+                del self._w[:-2048]
+
+    def queue_cap(self, cap: int) -> int:
+        """The scheduler's EFFECTIVE queue threshold under pressure: the
+        configured cap scaled down with the bucket rates, so backpressure
+        starts before the hard queue bound."""
+        if self.scale >= 1.0:
+            return cap
+        return max(1, int(cap * self.scale))
+
+    @property
+    def pressure(self) -> bool:
+        return self.scale < 1.0
+
+    def _tick(self) -> None:
+        # observatory read OUTSIDE the controller lock (its lock is a leaf
+        # of its own; nesting ours over it would be fine, but not needed)
+        p99_bad, p99_detail = self._obs_pressure()
+        with self._mu:
+            q, self._q = self._q, []
+            w, self._w = self._w, []
+            q_frac = sum(q) / len(q) if q else 0.0
+            wait_bad = bool(w) and max(w) > max(self.cfg.max_wait_s, 0.01) * 4
+            if q_frac >= self.cfg.queue_high_frac or wait_bad or p99_bad:
+                action = "tighten"
+                self.scale = max(self.cfg.min_scale, self.scale * 0.5)
+            elif q_frac <= self.cfg.queue_low_frac and not p99_bad:
+                action = "relax" if self.scale < 1.0 else "hold"
+                self.scale = min(1.0, max(self.scale * 1.5, self.scale + 0.05))
+            else:
+                action = "hold"
+            self.actions[action] += 1
+            self.last_evidence = {
+                "queue_frac": round(q_frac, 3),
+                "queue_samples": len(q),
+                "wait_pressure": wait_bad,
+                "p99_pressure": p99_bad,
+                "p99_detail": p99_detail,
+                "scale": round(self.scale, 3),
+            }
+        from ..util.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "tikv_overload_controller_total",
+            "Adaptive admission controller decisions, by action",
+        ).inc(action=action)
+        REGISTRY.gauge(
+            "tikv_overload_effective_scale",
+            "Adaptive scale applied to bucket rates and the queue cap",
+        ).set(self.scale)
+
+    def _obs_pressure(self) -> tuple[bool, dict | None]:
+        """Observatory p99-vs-floor evidence: the floor is the lowest p99
+        this controller has seen for a (sig, path, encoding); a current
+        p99 more than ``p99_ratio`` over it is saturation showing up in
+        tail latency (docs/observatory.md)."""
+        from . import observatory as _obs
+
+        if not _obs.OBSERVATORY.enabled:
+            return False, None
+        try:
+            rows = _obs.OBSERVATORY.top(8)
+        except Exception:  # noqa: BLE001 — evidence, not a dependency
+            return False, None
+        worst = None
+        with self._mu:
+            for r in rows:
+                if r.get("count", 0) < 8 or not r.get("p99_ms"):
+                    continue
+                key = (r["sig"], r["path"], r["encoding"])
+                floor = self._p99_floor.get(key)
+                if floor is None or r["p99_ms"] < floor:
+                    if floor is None and len(self._p99_floor) >= MAX_TENANTS:
+                        self._p99_floor.pop(next(iter(self._p99_floor)))
+                    self._p99_floor[key] = r["p99_ms"]
+                elif r["p99_ms"] > self.cfg.p99_ratio * floor:
+                    worst = {"sig": r["sig"], "path": r["path"],
+                             "p99_ms": r["p99_ms"], "floor_ms": floor}
+                    break
+        return worst is not None, worst
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "scale": round(self.scale, 3),
+                "actions": dict(self.actions),
+                "last_evidence": dict(self.last_evidence),
+                "p99_floors": {
+                    "|".join(map(str, k)): v
+                    for k, v in self._p99_floor.items()
+                },
+            }
+
+
+class OverloadControl:
+    """The facade the serving plane consults: one per endpoint/store,
+    wired into the scheduler's admission, the service's read entries, and
+    the region cache's tenant partitions."""
+
+    def __init__(self, config: OverloadConfig | None = None,
+                 region_cache=None, clock=time.monotonic, sleep=time.sleep):
+        self.cfg = config or OverloadConfig()
+        self.clock = clock
+        self._sleep = sleep
+        self.limiter = QuotaLimiter(self.cfg, clock=clock)
+        self.controller = AdaptiveController(self.cfg, clock=clock)
+        self.region_cache = region_cache
+        if region_cache is not None and self.cfg.tenant_hbm_budgets:
+            region_cache.set_tenant_budgets(dict(self.cfg.tenant_hbm_budgets))
+
+    @property
+    def enabled(self) -> bool:
+        return self.cfg.enabled
+
+    def scale(self) -> float:
+        return self.controller.scale if self.cfg.adaptive else 1.0
+
+    # -- admission ----------------------------------------------------------
+
+    def admit(self, context: dict | None, *, where: str = "copr",
+              wait: bool = True) -> str:
+        """Gate one request.  Admitted → returns the tenant (stamping an
+        idempotence marker so nested serving layers charge ONE token, not
+        one per layer).  Over quota → bounded defer when the bucket refills
+        within ``max_wait_s`` (and the request's own deadline), else a
+        typed :class:`ServerBusyError` whose ``retry_after_s`` is the
+        bucket's actual refill deficit."""
+        tenant = tenant_of(context)
+        if not self.cfg.enabled:
+            return tenant
+        if isinstance(context, dict) and context.get("_overload_admitted"):
+            # a nested serving layer (service -> scheduler) already charged
+            # this request exactly one token
+            return tenant
+        wait_s = self.limiter.probe(tenant, self.scale())
+        if wait_s <= 0:
+            self._count(tenant, "admit", where)
+            self._stamp(context)
+            return tenant
+        if wait and wait_s <= self.cfg.max_wait_s \
+                and self._deadline_allows(context, wait_s):
+            # bounded defer: the bucket refills within the wait budget —
+            # smooth the burst instead of bouncing it to the client
+            self._count(tenant, "defer", where)
+            self.limiter.note(tenant, "defer")
+            self._sleep(wait_s)
+            wait_s = self.limiter.probe(tenant, self.scale())
+            if wait_s <= 0:
+                self._stamp(context)
+                return tenant
+            # racing callers drained the refill: fall through to shed with
+            # the NEW deficit (still the honest hint)
+        self._count(tenant, "shed", where)
+        self.limiter.note(tenant, "shed")
+        raise ServerBusyError(
+            f"tenant {tenant!r} over quota",
+            retry_after_s=max(wait_s, MIN_RETRY_AFTER_S),
+        )
+
+    @staticmethod
+    def _stamp(context) -> None:
+        """Admission idempotence marker: stamped only on SUCCESS, so a
+        shed request retried with the same context dict is re-gated."""
+        if isinstance(context, dict):
+            context["_overload_admitted"] = True
+
+    def note_bytes(self, context: dict | None, nbytes: int) -> None:
+        """Post-serve read-byte charge: debits the tenant's byte bucket
+        (possibly into debt — the deficit gates its next admission)."""
+        if not self.cfg.enabled or nbytes <= 0:
+            return
+        self.limiter.charge_bytes(tenant_of(context), nbytes, self.scale())
+
+    def _deadline_allows(self, context, wait_s: float) -> bool:
+        from ..util.retry import deadline_from_context
+
+        dl = deadline_from_context(context)
+        return dl is None or time.monotonic() + wait_s < dl
+
+    def _count(self, tenant: str, outcome: str, where: str) -> None:
+        from ..util.metrics import REGISTRY
+
+        if outcome == "admit":
+            self.limiter.note(tenant, "admit")
+        REGISTRY.counter(
+            "tikv_overload_admission_total",
+            "Per-tenant quota admission outcomes, by entry point",
+        ).inc(tenant=tenant, outcome=outcome, where=where)
+
+    # -- priority clamping ----------------------------------------------------
+
+    def lane_ceiling(self, context: dict | None) -> str | None:
+        """The tenant's lane ceiling, or None when overload is disabled
+        (the scheduler's global ``max_priority`` still applies then)."""
+        if not self.cfg.enabled:
+            return None
+        return self.limiter.lane_ceiling(tenant_of(context))
+
+    # -- memory-pressure ladder ----------------------------------------------
+
+    def allow_device(self, context: dict | None) -> bool:
+        """False while the tenant sits on the degradation ladder's last
+        rung (CPU fallback): its HBM partition could not be brought under
+        budget by eviction or pin demotion (region_cache.py)."""
+        if not self.cfg.enabled or self.region_cache is None:
+            return True
+        return self.region_cache.device_allowed(tenant_of(context))
+
+    # -- scheduler feedback ----------------------------------------------------
+
+    def note_queue(self, depth: int, cap: int) -> None:
+        if self.cfg.enabled and self.cfg.adaptive:
+            self.controller.note_queue(depth, cap)
+
+    def note_wait(self, wait_s: float) -> None:
+        if self.cfg.enabled and self.cfg.adaptive:
+            self.controller.note_wait(wait_s)
+
+    def queue_cap(self, cap: int) -> int:
+        if self.cfg.enabled and self.cfg.adaptive:
+            return self.controller.queue_cap(cap)
+        return cap
+
+    def pressure_reject(self) -> bool:
+        """True when the controller's evidence says shed-with-hint beats
+        direct-path serving — the adaptive replacement for the static
+        ``busy_reject`` boolean."""
+        return self.cfg.enabled and self.cfg.adaptive and self.controller.pressure
+
+    # -- ops ------------------------------------------------------------------
+
+    def set_quota(self, tenant: str, quota: TenantQuota) -> None:
+        self.limiter.set_quota(tenant, quota)
+
+    def reconfigure(self, changed: dict) -> None:
+        """Online reconfig (POST /config ``overload.*`` via the
+        ConfigController): scalar knobs land here; the default quota's
+        rates retune live because buckets read rates per call."""
+        dq = self.cfg.default_quota
+        for key, value in changed.items():
+            if key == "requests_per_s":
+                dq.requests_per_s = float(value)
+            elif key == "read_bytes_per_s":
+                dq.read_bytes_per_s = float(value)
+            elif key == "burst_s":
+                dq.burst_s = float(value)
+            elif key == "enabled":
+                self.cfg.enabled = bool(value)
+            elif key == "max_wait_s":
+                self.cfg.max_wait_s = float(value)
+            elif key == "max_priority":
+                self.cfg.max_priority = str(value)
+            elif key == "adaptive":
+                self.cfg.adaptive = bool(value)
+            elif key == "min_scale":
+                self.cfg.min_scale = float(value)
+            elif key == "window_s":
+                self.cfg.window_s = float(value)
+
+    def snapshot(self) -> dict:
+        """The ``/debug/overload`` + ``ctl.py overload`` view: per-tenant
+        bucket levels and effective rates, shed/defer counts, controller
+        state, and HBM partition occupancy."""
+        out = {
+            "enabled": self.cfg.enabled,
+            "adaptive": self.cfg.adaptive,
+            "max_wait_s": self.cfg.max_wait_s,
+            "max_priority": self.cfg.max_priority,
+            "scale": round(self.scale(), 3),
+            "tenants": self.limiter.snapshot(self.scale()),
+            "controller": self.controller.snapshot(),
+        }
+        if self.region_cache is not None:
+            out["hbm"] = self.region_cache.tenant_occupancy()
+        return out

@@ -67,6 +67,7 @@ embedded engines).
 
 from __future__ import annotations
 
+import time
 import weakref
 
 import numpy as np
@@ -219,6 +220,10 @@ class RegionImage:
         self.epoch = epoch
         self.schema = schema
         self.block_rows = block_rows
+        # overload plane (docs/robustness.md "Overload"): the tenant whose
+        # request built this image — HBM partition accounting and the
+        # memory-pressure ladder key on it
+        self.tenant = "default"
         self.apply_index = -1
         self.snapshot_ts = -1
         self.max_commit_ts = 0
@@ -751,6 +756,16 @@ class RegionColumnCache:
         # and a read has repaired past it (_merge_pending's prev>=0 gate).
         self._wt_token = data_token
         self._wt_late_bound = False
+        # per-tenant HBM partitions (docs/robustness.md "Overload"): byte
+        # budgets splitting the global budget per tenant; the default
+        # tenant owns the remainder pool.  An over-budget tenant degrades
+        # down the pressure ladder (_enforce_tenant_budgets): evict ITS
+        # coldest images → demote ITS pins to host → CPU-fallback ITS
+        # device paths for a cooldown — never another tenant's warm set.
+        self._tenant_budgets: dict[str, int] = {}
+        self._device_blocked: dict[str, float] = {}
+        self.device_block_cooldown_s = 2.0
+        self._clock = time.monotonic
         self.devices: list = []
         if mesh is not None and getattr(mesh, "size", 1) > 1:
             try:
@@ -784,6 +799,7 @@ class RegionColumnCache:
         apply_index = (context or {}).get("apply_index")
         if region_id is None or epoch is None or apply_index is None:
             return None, "off", 0
+        tenant = str((context or {}).get("tenant") or "default")
         key = (region_id, tuple(ranges), schema_sig(columns_info))
         stats = statistics or Statistics()
         with self._mu:
@@ -808,7 +824,7 @@ class RegionColumnCache:
             # regions.  A concurrent build of the same key wastes one build;
             # the insert below keeps whichever image is newest.
             return self._build(key, epoch, snap, columns_info, ranges,
-                               start_ts, apply_index, stats)
+                               start_ts, apply_index, stats, tenant=tenant)
         with self._mu:
             if self._images.get(key) is not img or img.epoch != epoch:
                 # raced with an invalidation between lookup and here
@@ -863,7 +879,8 @@ class RegionColumnCache:
                 if img.n_rows and n_touch > _REBUILD_FRACTION * img.n_rows:
                     self._drop(key, reason="delta_too_big")
                     return self._build(key, epoch, snap, columns_info, ranges,
-                                       start_ts, apply_index, stats)
+                                       start_ts, apply_index, stats,
+                                       tenant=tenant)
                 handles = np.array(sorted(pend["changed"]), dtype=np.int64)
                 delta = {
                     "changed_handles": handles,
@@ -899,7 +916,8 @@ class RegionColumnCache:
             if img.n_rows and n_touch > _REBUILD_FRACTION * img.n_rows:
                 self._drop(key, reason="delta_too_big")
                 return self._build(key, epoch, snap, columns_info, ranges,
-                                   start_ts, apply_index, stats)
+                                   start_ts, apply_index, stats,
+                                   tenant=tenant)
             n = img.apply_delta(delta, apply_index, start_ts)
             if apply_index >= img.locks_dirty_at:
                 # scan_delta lock-checked the ranges on a snapshot that
@@ -1162,6 +1180,139 @@ class RegionColumnCache:
             self.stats.wt_lost += 1
             self._count_wt_lost()
 
+    # -- per-tenant HBM partitions (docs/robustness.md "Overload") -----------
+
+    def set_tenant_budgets(self, budgets: dict[str, int]) -> None:
+        """Partition the byte budget per tenant.  Tenants absent from the
+        map share the remainder pool with the default tenant (explicitly
+        listing ``default`` pins its pool too).  Enforcement runs now —
+        shrinking a partition degrades its tenant immediately."""
+        with self._mu:
+            self._tenant_budgets = {str(t): int(b) for t, b in budgets.items()}
+            self._enforce_tenant_budgets(keep=None)
+            self._gauge_bytes()
+
+    def resize_budget(self, byte_budget: int) -> None:
+        """Online global-budget change (``Nemesis.memory_squeeze`` and ops
+        reconfig): enforcement runs immediately under the new bound."""
+        with self._mu:
+            self.byte_budget = int(byte_budget)
+            self._enforce_budget(keep=None)
+            self._gauge_bytes()
+
+    def tenant_budget(self, tenant: str) -> int | None:
+        """The tenant's partition bytes, or None = unbounded (only the
+        global budget applies).  The default tenant's implicit budget is
+        the remainder after every explicit partition."""
+        b = self._tenant_budgets.get(tenant)
+        if b is not None:
+            return b
+        if tenant == "default" and self._tenant_budgets:
+            explicit = sum(v for t, v in self._tenant_budgets.items()
+                           if t != "default")
+            return max(self.byte_budget - explicit, 0)
+        return None
+
+    def device_allowed(self, tenant: str) -> bool:
+        """False while the tenant sits on the pressure ladder's last rung
+        (CPU fallback); the block lifts itself after the cooldown."""
+        with self._mu:
+            until = self._device_blocked.get(tenant)
+            if until is None:
+                return True
+            if self._clock() >= until:
+                self._device_blocked.pop(tenant, None)
+                return True
+            return False
+
+    def tenant_occupancy(self) -> dict:
+        """Per-tenant partition view for ``/debug/overload``: resident
+        bytes vs budget, image count, and any active device block."""
+        with self._mu:
+            per: dict[str, dict] = {}
+            now = self._clock()
+            for img in self._images.values():
+                e = per.setdefault(img.tenant, {"bytes": 0, "images": 0})
+                e["bytes"] += img.nbytes
+                e["images"] += 1
+            for tenant in set(per) | set(self._tenant_budgets) \
+                    | set(self._device_blocked):
+                e = per.setdefault(tenant, {"bytes": 0, "images": 0})
+                e["budget"] = self.tenant_budget(tenant)
+                until = self._device_blocked.get(tenant)
+                e["device_blocked_s"] = (
+                    round(max(until - now, 0.0), 3) if until is not None
+                    and until > now else 0.0)
+            return per
+
+    def _tenant_bytes_locked(self, tenant: str) -> int:
+        return sum(img.nbytes for img in self._images.values()
+                   if img.tenant == tenant)
+
+    def _enforce_tenant_budgets(self, keep) -> None:
+        """The memory-pressure degradation ladder, per over-budget tenant
+        (caller holds the manager lock):
+
+        1. evict the tenant's COLDEST images (LRU order) — never another
+           tenant's, never the image being served (``keep``);
+        2. still over (only ``keep`` / a single over-sized image remains):
+           demote the tenant's device pins to host — HBM frees, the host
+           copy keeps serving through a rebuild-on-demand pin;
+        3. still over: CPU-fallback the tenant's device paths for a
+           cooldown (``device_allowed``), so it stops re-pinning what its
+           partition cannot hold.  Other tenants' warm sets are untouched
+           at every rung."""
+        if not self._tenant_budgets:
+            return
+        from ..util.metrics import REGISTRY
+
+        evict_c = REGISTRY.counter(
+            "tikv_overload_hbm_evict_total",
+            "Per-tenant HBM-partition pressure actions, by ladder step",
+        )
+        tenants = {img.tenant for img in self._images.values()}
+        for tenant in sorted(tenants):
+            budget = self.tenant_budget(tenant)
+            if budget is None:
+                continue
+            if self._tenant_bytes_locked(tenant) <= budget:
+                continue
+            # rung 1: evict the tenant's own coldest images — sparing its
+            # HOTTEST one (and the image being served): a tenant keeps one
+            # warm image and the later rungs handle the case where that
+            # single image alone exceeds the partition
+            mine = [k for k, img in self._images.items()
+                    if img.tenant == tenant]
+            hottest = mine[-1] if mine else None
+            for key in mine:
+                if key == keep or key == hottest:
+                    continue
+                if self._tenant_bytes_locked(tenant) <= budget:
+                    break
+                self._drop(key, reason="tenant_budget")
+                evict_c.inc(tenant=tenant, step="evict")
+            if self._tenant_bytes_locked(tenant) <= budget:
+                continue
+            # rung 2: demote remaining device pins to host
+            demoted = False
+            for img in self._images.values():
+                if img.tenant == tenant:
+                    img.block_cache.drop_device()
+                    demoted = True
+            if demoted:
+                evict_c.inc(tenant=tenant, step="demote")
+            # rung 3: the host-resident set alone is over the partition —
+            # block the tenant's device serving for a cooldown so it stops
+            # rebuilding pins its budget cannot hold
+            self._device_blocked[tenant] = (
+                self._clock() + self.device_block_cooldown_s)
+            evict_c.inc(tenant=tenant, step="cpu_block")
+            REGISTRY.counter(
+                "tikv_overload_device_block_total",
+                "Tenants pushed to the pressure ladder's CPU-fallback rung",
+            ).inc(tenant=tenant)
+        self._rebalance()
+
     def warm_region_ids(self) -> list[int]:
         """Region ids with a resident device image — the placement this
         store advertises to PD each heartbeat so peers can forward
@@ -1265,7 +1416,7 @@ class RegionColumnCache:
     # -- internals ---------------------------------------------------------
 
     def _build(self, key, epoch, snap, columns_info, ranges, start_ts,
-               apply_index, stats):
+               apply_index, stats, tenant: str = "default"):
         """Build an image for ``key`` (expensive part lock-free) and insert
         it.  Safe to call with or without the manager lock held (the lock is
         reentrant); a racing build of the same key keeps whichever image
@@ -1284,6 +1435,7 @@ class RegionColumnCache:
             self._count("uncacheable")
             return None, "uncacheable", 0
         img = RegionImage(key, epoch, list(columns_info), self.block_rows)
+        img.tenant = tenant
         img.fill(handles, values, src.row_commit_ts, src.max_commit_ts,
                  apply_index, start_ts, raw_keys=keys,
                  encode=self.encode_columns)
@@ -1363,11 +1515,14 @@ class RegionColumnCache:
         self._gauge_bytes()
 
     def _enforce_budget(self, keep) -> None:
+        # per-tenant partitions first: an over-budget tenant degrades down
+        # its own ladder before global pressure evicts ANYONE
+        self._enforce_tenant_budgets(keep)
         while len(self._images) > self.max_regions or (
             sum(i.nbytes for i in self._images.values()) > self.byte_budget
             and len(self._images) > 1
         ):
-            victim = next((k for k in self._images if k != keep), None)
+            victim = self._pick_victim_locked(keep)
             if victim is None:
                 break
             img = self._images.pop(victim)
@@ -1382,6 +1537,20 @@ class RegionColumnCache:
                 "Region column cache LRU/budget evictions",
             ).inc()
         self._rebalance()
+
+    def _pick_victim_locked(self, keep):
+        """Global-budget eviction victim: prefer images of tenants over
+        their OWN partition (a hot tenant's global pressure must land on
+        its warm set, not a well-behaved sibling's), else plain LRU."""
+        if self._tenant_budgets:
+            for k, img in self._images.items():
+                if k == keep:
+                    continue
+                budget = self.tenant_budget(img.tenant)
+                if budget is not None \
+                        and self._tenant_bytes_locked(img.tenant) > budget:
+                    return k
+        return next((k for k in self._images if k != keep), None)
 
     def _count(self, outcome: str) -> None:
         from ..util.metrics import REGISTRY
@@ -1442,6 +1611,16 @@ class RegionColumnCache:
             ).set(sum(
                 i.block_cache.device_nbytes() for i in self._images.values()
             ))
+            if self._tenant_budgets:
+                per: dict[str, int] = {}
+                for img in self._images.values():
+                    per[img.tenant] = per.get(img.tenant, 0) + img.nbytes
+                g = REGISTRY.gauge(
+                    "tikv_overload_hbm_bytes",
+                    "Resident bytes per tenant HBM partition",
+                )
+                for tenant in set(per) | set(self._tenant_budgets):
+                    g.set(per.get(tenant, 0), tenant=tenant)
         if self.devices:
             g = REGISTRY.gauge(
                 "tikv_coprocessor_region_cache_device_bytes",

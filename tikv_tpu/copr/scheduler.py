@@ -59,6 +59,7 @@ from dataclasses import dataclass, field
 from ..analysis.sanitizer import make_condition, make_lock
 from ..util import trace
 from . import observatory as _obs
+from . import overload as _overload
 from ..util.retry import DeadlineExceeded, ServerBusyError, deadline_from_context
 from . import jax_eval
 from .dag import (
@@ -101,6 +102,12 @@ class SchedulerConfig:
     # exactly when there is none to spare
     busy_reject: bool = False
     busy_retry_after_s: float = 0.05
+    # lane ceiling for client-declared priorities — SERVER policy, applied
+    # even with no overload control wired (docs/robustness.md "Overload"):
+    # "high" admits every declared lane (historical behavior); "normal"
+    # stops clients from jumping the high lane.  A wired OverloadControl's
+    # per-tenant ceilings clamp further.
+    max_priority: str = "high"
 
     def wait_for(self, lane: str) -> float:
         if lane == "high":
@@ -113,6 +120,24 @@ class SchedulerConfig:
 def _lane_of(req: CoprRequest) -> str:
     lane = (req.context or {}).get("priority", "normal")
     return lane if lane in LANES else "normal"
+
+
+def _clamped_lane(req: CoprRequest, cfg: SchedulerConfig, overload) -> str:
+    """The request's EFFECTIVE lane: the client-declared priority clamped
+    to the global ceiling (``cfg.max_priority``) and, with an overload
+    control wired, the tenant's configured ceiling — the client never
+    picks a higher lane than policy grants it.  Demotions are counted per
+    tenant (tikv_overload_demote_total)."""
+    lane = _lane_of(req)
+    ceiling = cfg.max_priority
+    if overload is not None:
+        tc = overload.lane_ceiling(req.context)
+        if tc is not None:
+            ceiling = _overload.clamp_lane(ceiling, tc)
+    eff = _overload.clamp_lane(lane, ceiling)
+    if eff != lane:
+        _overload.count_demotion(_overload.tenant_of(req.context), eff)
+    return eff
 
 
 def _expr_sig(e):
@@ -241,12 +266,32 @@ class CoprReadScheduler:
         for r in reqs:
             resolve_encode_type(r)
         tctx = trace.current_context()
+        # per-tenant quota admission (docs/robustness.md "Overload"): an
+        # over-quota rider fails ITS slot typed (ServerBusyError with the
+        # bucket's refill deficit) without deferring — a synchronous batch
+        # must not sleep per rider — and siblings keep their responses
+        ov = getattr(self.ep, "overload", None)
+        results: list[CoprResponse | None] = [None] * len(reqs)
+        errors: list[BaseException | None] = [None] * len(reqs)
+        live: list[tuple[int, CoprRequest]] = []
+        for i, r in enumerate(reqs):
+            if ov is not None:
+                try:
+                    ov.admit(r.context, where="batch", wait=False)
+                except ServerBusyError as exc:
+                    self._count_shed("tenant_quota")
+                    errors[i] = exc
+                    continue
+            live.append((i, r))
         items = [
-            _Item(req=r, index=i, lane=_lane_of(r),
+            _Item(req=r, index=j, lane=_clamped_lane(r, self.cfg, ov),
                   deadline=deadline_from_context(r.context), trace_ctx=tctx)
-            for i, r in enumerate(reqs)
+            for j, (_i, r) in enumerate(live)
         ]
-        results, errors = self._serve(items)
+        sub_results, sub_errors = self._serve(items)
+        for (i, _r), res, err in zip(live, sub_results, sub_errors):
+            results[i] = res
+            errors[i] = err
         if return_errors:
             # per-slot surface (service.coprocessor_batch): computed
             # responses survive a sibling slot's failure — one expired
@@ -305,47 +350,79 @@ class CoprReadScheduler:
         # queue slot, a snapshot, or any device dispatch — so the client's
         # watermark-aware backoff starts immediately
         self._check_stale_ready(req)
+        # per-tenant quota admission (docs/robustness.md "Overload"): over-
+        # quota work defers a bounded wait on THIS caller's thread, then
+        # sheds typed with the bucket's refill deficit as retry_after_s —
+        # before it can cost a queue slot, a snapshot, or a device dispatch
+        ov = getattr(self.ep, "overload", None)
+        if ov is not None:
+            try:
+                ov.admit(req.context, where="sched")
+            except ServerBusyError:
+                self._count_shed("tenant_quota")
+                raise
         if (not self._running or not self.ep._gate_ok("batch")
                 or not self._batchable(req)):
             # the BATCH_FUSION gate guards this path exactly like
             # handle_batch: a mixed-version cluster keeps fusion off
             self._count_coalesce("bypass")
             return self.ep.handle_request(req)
-        item = _Item(req=req, index=0, lane=_lane_of(req), ticket=_Ticket(),
+        item = _Item(req=req, index=0, lane=_clamped_lane(req, self.cfg, ov),
+                     ticket=_Ticket(),
                      enqueue_t=time.perf_counter(), deadline=deadline)
         # queue-lane span (docs/tracing.md): covers enqueue→batch-completion
         # on the submitting thread; the dispatcher stamps dispatcher-side
         # spans into this trace via the captured context
         with trace.span("sched.queue", lane=item.lane) as sp:
             item.trace_ctx = sp.context if sp else None
+            depth = 0
             with self._mu:
                 # re-check under the lock: a stop() racing this enqueue drains
                 # the queues once — anything appended after that drain would
                 # never be served and the caller would block forever
+                depth = sum(len(q) for q in self._queues.values())
+                # under adaptive pressure the EFFECTIVE cap shrinks with the
+                # controller's scale, and queue-full becomes a busy-typed
+                # rejection even with the static busy_reject off — evidence-
+                # based shedding (docs/robustness.md "Overload")
+                cap = ov.queue_cap(self.cfg.max_queue) if ov is not None \
+                    else self.cfg.max_queue
+                busy = False
                 if not self._running:
                     do_direct = True
-                elif sum(len(q) for q in self._queues.values()) >= self.cfg.max_queue:
-                    if self.cfg.busy_reject:
+                elif depth >= cap:
+                    if self.cfg.busy_reject or (
+                            ov is not None and ov.pressure_reject()):
                         # ServerIsBusy with a drain hint: the retry policy
                         # (util.retry) sleeps at least retry_after_s before the
                         # request comes back — backpressure instead of serving
                         # extra work on a saturated store.  Counted under its
                         # own reason: "queue_full" means served on the direct
                         # path, and a rejection is neither served nor direct
-                        self._count_shed("busy_reject")
-                        self._count_coalesce("busy_reject")
-                        sp.tag(outcome="busy_reject")
-                        raise ServerBusyError(
-                            "coprocessor scheduler queue is full",
-                            retry_after_s=self.cfg.busy_retry_after_s,
-                        )
-                    self._count_shed("queue_full")
-                    do_direct = True
+                        busy = True
+                        do_direct = False
+                    else:
+                        self._count_shed("queue_full")
+                        do_direct = True
                 else:
                     do_direct = False
                     self._queues[item.lane].append(item)
                     self._gauge_depth()
                     self._mu.notify_all()
+            if ov is not None:
+                # controller feed (outside the dispatcher lock): queue
+                # fullness is the adaptive controller's primary evidence
+                ov.note_queue(depth, self.cfg.max_queue)
+            if busy:
+                self._count_shed("busy_reject")
+                self._count_coalesce("busy_reject")
+                sp.tag(outcome="busy_reject")
+                # the hint floor keeps the busy class's backoff hint-
+                # dominated even when the knob is set to 0
+                raise ServerBusyError(
+                    "coprocessor scheduler queue is full",
+                    retry_after_s=max(self.cfg.busy_retry_after_s, 0.001),
+                )
             if do_direct:
                 self._count_coalesce("queue_full")
                 sp.tag(outcome="queue_full")
@@ -422,6 +499,12 @@ class CoprReadScheduler:
                 self._serve_ticketed(batch)
 
     def _serve_ticketed(self, batch: list[_Item]) -> None:
+        from ..util.failpoint import fail_point
+
+        # chaos/regression hook on the DISPATCHER thread: a seeded sleep
+        # here paces batch service so overload tests can saturate the
+        # bounded queue deterministically (tests/test_overload.py)
+        fail_point("sched_dispatch")
         for i, it in enumerate(batch):
             it.index = i
         try:
@@ -566,6 +649,13 @@ class CoprReadScheduler:
         if (req.tp != REQ_TYPE_DAG or req.dag is None
                 or not self.ep.device_enabled()
                 or not any(isinstance(e, Aggregation) for e in req.dag.executors)):
+            return None
+        ov = getattr(self.ep, "overload", None)
+        if ov is not None and not ov.allow_device(req.context):
+            # memory-pressure ladder, last rung (docs/robustness.md): the
+            # tenant's HBM partition would not fit even after eviction and
+            # pin demotion — its work must not join a device batch (the
+            # per-request path CPU-falls-back for the same reason)
             return None
         sig = plan_signature(req.dag)
         ok = self._supports.get(sig)
@@ -1230,7 +1320,13 @@ class CoprReadScheduler:
     def _observe_wait(self, it: _Item) -> None:
         from ..util.metrics import REGISTRY
 
+        wait = time.perf_counter() - it.enqueue_t
         REGISTRY.histogram(
             "tikv_coprocessor_sched_lane_wait_seconds",
             "Queue wait before dispatch, by priority lane",
-        ).observe(time.perf_counter() - it.enqueue_t, lane=it.lane)
+        ).observe(wait, lane=it.lane)
+        ov = getattr(self.ep, "overload", None)
+        if ov is not None:
+            # adaptive-controller evidence: sampled lane waits say whether
+            # admitted work is actually draining (docs/robustness.md)
+            ov.note_wait(wait)

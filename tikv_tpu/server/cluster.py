@@ -70,6 +70,7 @@ class StoreNode:
             self.read_plane = ReadPlane(
                 store=self.store, resolver=cluster.resolve, security=security,
             )
+            copr_kwargs = {"enable_device": False, **cluster.copr_kwargs}
             self.resolved_ts = ResolvedTsEndpoint(
                 cluster.pd, store_id=store_id,
                 # the fan-out rides the read plane's peer-client pool
@@ -82,9 +83,21 @@ class StoreNode:
             self.lock_manager = WaiterManager(
                 detector=DetectorHandle(self.store, cluster.resolve, security=security)
             )
+            copr = Endpoint(self.raftkv, **copr_kwargs)
+            if cluster.overload_config is not None:
+                # overload control plane (docs/robustness.md "Overload"):
+                # the standalone StoreServer wiring, mirrored so scenario
+                # tests drive per-tenant admission over real sockets.  The
+                # config object is SHARED across nodes on purpose — one
+                # runtime toggle flips the whole cluster.
+                from ..copr.overload import OverloadControl
+
+                copr.overload = OverloadControl(
+                    cluster.overload_config,
+                    region_cache=copr.region_cache)
             self.service = KvService(
                 Storage(engine=self.raftkv), raft_router=self.store,
-                copr=Endpoint(self.raftkv, enable_device=False),
+                copr=copr,
                 lock_manager=self.lock_manager, pd=cluster.pd,
                 resolved_ts=self.resolved_ts, read_plane=self.read_plane,
             )
@@ -98,11 +111,16 @@ class StoreNode:
         self.server.start()
         self.cluster.addrs[self.store.store_id] = self.server.addr
         self.node.start(tick_interval=0.02, heartbeat_interval=0.2)
+        if self.full_service and self.cluster.sched_continuous:
+            # continuous coalescing lanes, the standalone default shape
+            self.service.copr.scheduler.start()
         self.running = True
 
     def stop(self) -> None:
         self.running = False
         self.cluster.addrs.pop(self.store.store_id, None)
+        if self.full_service:
+            self.service.copr.scheduler.stop()
         self.node.stop()
         self.server.stop()
         self.transport.close()
@@ -120,8 +138,18 @@ class ServerCluster:
         engines: dict | None = None,
         security=None,
         full_service: bool = False,
+        copr_kwargs: dict | None = None,
+        overload_config=None,
+        sched_continuous: bool = False,
     ):
         self.security = security
+        # full_service endpoint assembly knobs: extra Endpoint kwargs (e.g.
+        # enable_device / sched_config), an OverloadConfig for the per-node
+        # OverloadControl, and whether to run the continuous scheduler
+        # lanes — the standalone StoreServer shape for scenario tests
+        self.copr_kwargs = copr_kwargs or {}
+        self.overload_config = overload_config
+        self.sched_continuous = sched_continuous
         self.pd = pd or MockPd()
         self.addrs: dict[int, tuple[str, int]] = {}
         self.nodes: dict[int, StoreNode] = {}

@@ -89,10 +89,16 @@ class KvService:
         self, storage: Storage, copr: Endpoint | None = None, copr_v2=None,
         resource_tags=None, debugger=None, cdc=None, pd=None, importer=None,
         raft_router=None, gc_worker=None, lock_manager=None, resolved_ts=None,
-        diagnostics=None, keys_rotator=None, read_plane=None,
+        diagnostics=None, keys_rotator=None, read_plane=None, overload=None,
     ):
         self.storage = storage
         self.copr = copr
+        # overload control plane (docs/robustness.md "Overload"): per-tenant
+        # quota admission on the read entries — over-quota work defers a
+        # bounded wait then sheds as ServerIsBusy with a refill-deficit
+        # retry_after hint.  None (the default) gates nothing.
+        self.overload = overload if overload is not None \
+            else getattr(copr, "overload", None)
         # the read-degradation ladder (server/read_plane.py): wraps the read
         # handlers so NotLeader/DataNotReady region errors forward one hop,
         # degrade to follower stale serving, or refuse with hints.  None
@@ -353,8 +359,45 @@ class KvService:
             return resp
         return self.read_plane.degrade(self, method, req, resp, local)
 
+    def _admit_overload(self, req: dict, where: str) -> dict | None:
+        """Per-tenant quota gate on a read entry: None = admitted (possibly
+        after a bounded defer), else the typed ServerIsBusy error dict with
+        ``retry_after_ms`` riding the wire (docs/robustness.md).
+
+        This is the WIRE BOUNDARY: a client-supplied admission marker is
+        stripped before admitting — `_overload_admitted` is an in-process
+        nesting contract (service -> scheduler), never a client claim — and
+        a missing context is materialized onto the request so the stamp
+        reaches the nested layers (otherwise the scheduler would charge a
+        second token against a fresh dict)."""
+        ov = self.overload
+        if ov is None:
+            return None
+        ctx = req.get("context")
+        if not isinstance(ctx, dict):
+            ctx = req["context"] = {}
+        ctx.pop("_overload_admitted", None)
+        try:
+            ov.admit(ctx, where=where)
+        except Exception as e:  # noqa: BLE001 — ServerBusyError, typed
+            return {"error": _err(e)}
+        return None
+
+    def _note_read_bytes(self, req: dict, nbytes: int) -> None:
+        """Post-serve read-byte charge against the tenant's byte bucket
+        (response size is unknown at admission; the debt gates the
+        tenant's NEXT admission)."""
+        if self.overload is not None and nbytes:
+            self.overload.note_bytes(req.get("context"), nbytes)
+
     def kv_get(self, req: dict) -> dict:
-        return self._serve_read("kv_get", req, self._kv_get_local)
+        busy = self._admit_overload(req, "kv")
+        if busy is not None:
+            return busy
+        resp = self._serve_read("kv_get", req, self._kv_get_local)
+        if isinstance(resp, dict) and resp.get("value"):
+            self._note_read_bytes(req, len(resp["value"]))
+        return resp
 
     def _kv_get_local(self, req: dict) -> dict:
         try:
@@ -367,7 +410,14 @@ class KvService:
             return {"error": _err(e)}
 
     def kv_batch_get(self, req: dict) -> dict:
-        return self._serve_read("kv_batch_get", req, self._kv_batch_get_local)
+        busy = self._admit_overload(req, "kv")
+        if busy is not None:
+            return busy
+        resp = self._serve_read("kv_batch_get", req, self._kv_batch_get_local)
+        if isinstance(resp, dict) and resp.get("pairs"):
+            self._note_read_bytes(req, sum(
+                len(p[1]) for p in resp["pairs"] if p and p[1]))
+        return resp
 
     def _kv_batch_get_local(self, req: dict) -> dict:
         try:
@@ -377,7 +427,14 @@ class KvService:
             return {"error": _err(e)}
 
     def kv_scan(self, req: dict) -> dict:
-        return self._serve_read("kv_scan", req, self._kv_scan_local)
+        busy = self._admit_overload(req, "kv")
+        if busy is not None:
+            return busy
+        resp = self._serve_read("kv_scan", req, self._kv_scan_local)
+        if isinstance(resp, dict) and resp.get("pairs"):
+            self._note_read_bytes(req, sum(
+                len(p[0]) + len(p[1]) for p in resp["pairs"] if p and p[1]))
+        return resp
 
     def _kv_scan_local(self, req: dict) -> dict:
         try:
@@ -1007,6 +1064,18 @@ class KvService:
                 min_count=int(req.get("min_count", 3)))
         return obs.OBSERVATORY.snapshot(sig=req.get("sig"))
 
+    def debug_overload(self, req: dict) -> dict:
+        """Overload-control state (docs/robustness.md "Overload"; ``ctl.py
+        overload`` and the status server's ``/debug/overload``): per-tenant
+        bucket levels + effective rates, shed/defer counts, the adaptive
+        controller's scale and evidence, and HBM partition occupancy."""
+        ov = self.overload
+        if ov is None and self.copr is not None:
+            ov = self.copr.overload
+        if ov is None:
+            return {"enabled": False, "wired": False}
+        return ov.snapshot()
+
     def debug_traces(self, req: dict) -> dict:
         """Recent + slow traces from the process tracer (docs/tracing.md):
         the ``ctl.py trace`` surface.  ``trace_id`` narrows to one trace;
@@ -1153,6 +1222,9 @@ class KvService:
         whose region image is warm on ANOTHER store's cache forwards one
         hop to that store instead of serving a cold local fallback —
         placement advertised through PD, loop-guarded, breaker-protected."""
+        busy = self._admit_overload(req, "copr")
+        if busy is not None:
+            return busy
         fwd = self._try_owner_forward(req)
         if fwd is not None:
             return fwd
@@ -1250,6 +1322,15 @@ class KvService:
             ).inc(outcome=outcome, cause="")
         return out
 
+    @staticmethod
+    def _copr_resp_nbytes(r) -> int:
+        """Response payload size WITHOUT forcing the lazy data_parts join
+        (the zero-copy wire path's whole point)."""
+        if r.data_parts is not None:
+            return sum(p.nbytes if isinstance(p, memoryview) else len(p)
+                       for p in r.data_parts)
+        return len(r.data)
+
     def _coprocessor_local(self, req: dict) -> dict:
         assert self.copr is not None, "coprocessor endpoint not wired"
         try:
@@ -1259,6 +1340,7 @@ class KvService:
                 r = sched.execute(creq)
             else:
                 r = self.copr.handle_request(creq)
+            self._note_read_bytes(req, self._copr_resp_nbytes(r))
             return self._copr_resp_dict(
                 r, self._requested_chunk(req),
                 bool((creq.context or {}).get("chunk_declined")))
@@ -1274,6 +1356,44 @@ class KvService:
         from ..util.retry import DeadlineExceeded
 
         subs = req.get("requests") or []
+        # quota admission per sub-request at the WIRE BOUNDARY (no defer —
+        # a synchronous batch must not sleep per rider): client-supplied
+        # markers stripped, missing contexts materialized, over-quota slots
+        # answer ServerIsBusy typed with the refill-deficit hint while
+        # siblings serve normally
+        out_by_idx: dict[int, dict] = {}
+        if self.overload is not None:
+            for i, sub in enumerate(subs):
+                ctx = sub.get("context")
+                if not isinstance(ctx, dict):
+                    ctx = sub["context"] = {}
+                ctx.pop("_overload_admitted", None)
+                try:
+                    self.overload.admit(ctx, where="batch", wait=False)
+                except Exception as e:  # noqa: BLE001 — ServerBusyError
+                    out_by_idx[i] = {"error": _err(e)}
+            if out_by_idx:
+                live = [(i, sub) for i, sub in enumerate(subs)
+                        if i not in out_by_idx]
+                try:
+                    creqs = [self._parse_copr_request(s) for _i, s in live]
+                    results, errors = self.copr.handle_batch_errors(creqs)
+                except Exception:  # noqa: BLE001 — parse poisons nothing
+                    merged = [out_by_idx.get(i) or self.coprocessor(sub)
+                              for i, sub in enumerate(subs)]
+                    return {"responses": merged}
+                served = {}
+                for (i, sub), r, e, creq in zip(live, results, errors, creqs):
+                    if e is None and r is not None:
+                        served[i] = self._copr_resp_dict(
+                            r, self._requested_chunk(sub),
+                            bool((creq.context or {}).get("chunk_declined")))
+                    elif isinstance(e, DeadlineExceeded):
+                        served[i] = {"error": _err(e)}
+                    else:
+                        served[i] = self.coprocessor(sub)
+                return {"responses": [out_by_idx.get(i) or served[i]
+                                      for i in range(len(subs))]}
         try:
             creqs = [self._parse_copr_request(sub) for sub in subs]
             results, errors = self.copr.handle_batch_errors(creqs)
@@ -1310,6 +1430,9 @@ class KvService:
         Validation errors before the first frame return a plain error dict
         (the unary shape)."""
         assert self.copr is not None, "coprocessor endpoint not wired"
+        busy = self._admit_overload(req, "stream")
+        if busy is not None:
+            return busy
         try:
             dag = req.get("dag")
             if isinstance(dag, dict):

@@ -99,6 +99,10 @@ class StoreServer:
         encode_columns: bool = True,
         integrity_scrub_interval: float = 10.0,
         shadow_sample: int | None = None,
+        overload: bool = False,
+        overload_rps: float = 0.0,
+        overload_read_bps: float = 0.0,
+        overload_max_priority: str = "high",
     ):
         self.pd = pd
         self.security = security
@@ -175,6 +179,27 @@ class StoreServer:
             encode_columns=encode_columns,
             shadow_sample=shadow_sample,
         )
+        # overload control plane (docs/robustness.md "Overload"): always
+        # CONSTRUCTED — so POST /config overload.enabled=true turns it on
+        # at runtime — but disabled unless the operator opted in.  Quota
+        # defaults come from the CLI/config; per-tenant overrides land via
+        # OverloadControl.set_quota.
+        from ..copr.overload import (
+            OverloadConfig as _OvConfig, OverloadControl, TenantQuota,
+        )
+
+        self.overload = OverloadControl(
+            _OvConfig(
+                enabled=overload,
+                default_quota=TenantQuota(
+                    requests_per_s=overload_rps,
+                    read_bytes_per_s=overload_read_bps,
+                ),
+                max_priority=overload_max_priority,
+            ),
+            region_cache=self.copr.region_cache,
+        )
+        self.copr.overload = self.overload
         # integrity plane (docs/integrity.md): the SDC scrubber verifies
         # warm images against the engine on a cadence; <=0 disables.
         # Shadow-read sampling is always on at its configured rate.
@@ -296,7 +321,8 @@ class StoreServer:
 
         from ..util import trace
         from ..util.config import (
-            ConfigController, CoprocessorConfig, TikvConfig, TraceConfig,
+            ConfigController, CoprocessorConfig, OverloadSection, TikvConfig,
+            TraceConfig,
         )
 
         self.config_controller = ConfigController(
@@ -305,8 +331,17 @@ class StoreServer:
                 # reflect the live tracer (env-seeded) so /config reads true
                 trace=TraceConfig(sample_rate=trace.sample_rate(),
                                   slow_threshold_s=trace.slow_threshold()),
+                overload=OverloadSection(
+                    enabled=overload, requests_per_s=overload_rps,
+                    read_bytes_per_s=overload_read_bps,
+                    max_priority=overload_max_priority),
             )
         )
+        # online overload knobs (docs/robustness.md "Overload"): POST
+        # /config {"overload.enabled": true, "overload.requests_per_s": N}
+        # — quota rates retune live, admission flips on/off at runtime
+        self.config_controller.register(
+            "overload", self.overload.reconfigure)
         # online device knob: POST /config {"coprocessor.enable_device": x}
         self.config_controller.register(
             "coprocessor",
@@ -332,6 +367,9 @@ class StoreServer:
             # derived-plane integrity: fingerprints, quarantine ledger,
             # scrubber + shadow-read state (docs/integrity.md)
             integrity=lambda: self.service.debug_integrity({}),
+            # overload control plane: per-tenant buckets, controller scale,
+            # HBM partition occupancy (docs/robustness.md "Overload")
+            overload=lambda: self.service.debug_overload({}),
         )
         self.service = KvService(
             self.storage,
@@ -346,6 +384,7 @@ class StoreServer:
             cdc=self.cdc,
             keys_rotator=self.rotate_data_keys if self.keys_mgr is not None else None,
             read_plane=self.read_plane,
+            overload=self.overload,
         )
         self.server = Server(self.service, host=host, port=port, security=security)
         self.recovered_peers = recovered
@@ -524,6 +563,17 @@ def main(argv=None) -> int:
     ap.add_argument("--integrity-scrub-interval", type=float, default=10.0,
                     help="seconds between SDC scrubber rounds over warm "
                          "region images (docs/integrity.md); <=0 disables")
+    ap.add_argument("--overload", action="store_true",
+                    help="enable the overload control plane: per-tenant "
+                         "quota admission, priority clamping, adaptive "
+                         "shedding (docs/robustness.md)")
+    ap.add_argument("--overload-rps", type=float, default=0.0,
+                    help="default-tenant requests/s quota (0 = unlimited)")
+    ap.add_argument("--overload-read-bps", type=float, default=0.0,
+                    help="default-tenant read-bytes/s quota (0 = unlimited)")
+    ap.add_argument("--overload-max-priority", default="high",
+                    choices=["high", "normal", "low"],
+                    help="lane ceiling for client-declared priorities")
     ap.add_argument("--shadow-sample", type=int, default=None,
                     help="shadow-read 1-in-N sampling of warm device serves "
                          "(default 256 or TIKV_TPU_SHADOW_SAMPLE; 0 "
@@ -573,6 +623,10 @@ def main(argv=None) -> int:
         encode_columns=not args.no_column_encoding,
         integrity_scrub_interval=args.integrity_scrub_interval,
         shadow_sample=args.shadow_sample,
+        overload=args.overload,
+        overload_rps=args.overload_rps,
+        overload_read_bps=args.overload_read_bps,
+        overload_max_priority=args.overload_max_priority,
     )
     srv.start()
     srv.bootstrap_or_join(args.expect_stores)

@@ -22,7 +22,7 @@ from .profiler import Profiler
 class StatusServer:
     def __init__(self, controller: ConfigController | None = None, host="127.0.0.1", port=0, registry=None,
                  security=None, memory_trace=None, read_progress=None,
-                 integrity=None):
+                 integrity=None, overload=None):
         self.controller = controller
         self.security = security
         self.registry = registry or REGISTRY
@@ -34,6 +34,9 @@ class StatusServer:
         # callable returning the integrity-plane view (docs/integrity.md):
         # image fingerprints, quarantine ledger, scrubber + shadow state
         self.integrity = integrity
+        # callable returning the overload-control view (docs/robustness.md
+        # "Overload"): tenant buckets, controller scale, HBM partitions
+        self.overload = overload
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -179,6 +182,15 @@ class StatusServer:
                         self._send(404, b"no integrity surface wired")
                         return
                     self._send(200, json.dumps(outer.integrity()).encode(),
+                               "application/json")
+                elif url.path == "/debug/overload":
+                    # overload control plane: per-tenant bucket levels +
+                    # effective rates, shed/defer counts, adaptive scale,
+                    # HBM partition occupancy (docs/robustness.md)
+                    if outer.overload is None:
+                        self._send(404, b"no overload control wired")
+                        return
+                    self._send(200, json.dumps(outer.overload()).encode(),
                                "application/json")
                 elif url.path == "/debug/memory":
                     # the store's memory-attribution tree (MemoryTrace)

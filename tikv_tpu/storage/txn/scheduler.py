@@ -156,8 +156,12 @@ class Scheduler:
                     f"{self._inflight} commands pending "
                     f"(threshold {self.pending_write_threshold})",
                     # drain hint: pending work over worker parallelism, at a
-                    # nominal ~1ms per engine write round trip
-                    retry_after_s=0.001 * self._inflight / max(self.pool_size, 1),
+                    # nominal ~1ms per engine write round trip — floored at
+                    # 1ms so the busy class's backoff stays hint-dominated
+                    # (util.retry; docs/robustness.md "Overload")
+                    retry_after_s=max(
+                        0.001 * self._inflight / max(self.pool_size, 1),
+                        0.001),
                 )
             self._inflight += 1
             self._ensure_threads()

@@ -212,7 +212,34 @@ class _ServerAdapter:
             self._attached.append(tr)
 
 
+class _NullAdapter:
+    """No transport: a nemesis over ``cluster=None`` injects LOAD-shaped
+    faults only (hot_tenant / slow_consumer / memory_squeeze) — useful for
+    single-endpoint overload scenarios with no raft plane at all."""
+
+    realtime = True
+
+    def attach(self, filt) -> None:
+        pass
+
+    def detach(self, filt) -> None:
+        pass
+
+    def store_ids(self) -> list[int]:
+        return []
+
+    def reinject(self, to_store: int, rmsg) -> None:
+        pass
+
+    def crash(self, store_id: int) -> None:
+        raise ValueError("no cluster attached to this nemesis")
+
+    restart = crash
+
+
 def _adapter_for(cluster):
+    if cluster is None:
+        return _NullAdapter()
     if hasattr(cluster, "nodes"):
         return _ServerAdapter(cluster)
     if hasattr(cluster, "transport"):
@@ -241,6 +268,11 @@ class Nemesis:
         self._step = 0              # logical clock (channel mode)
         self._crashed: set[int] = set()
         self._stalled: str | None = None
+        # load-shaped faults (docs/robustness.md "Overload"): seeded flood
+        # threads + squeezed cache budgets, all undone by heal()
+        self._load_stop = threading.Event()
+        self._load_threads: list[threading.Thread] = []
+        self._squeezed: list[tuple[object, int, dict]] = []
         self._closed = False
         self._deliverer: threading.Thread | None = None
         self._filter = _NemesisFilter(self)
@@ -343,6 +375,80 @@ class Nemesis:
             self.stats["corrupted"] = self.stats.get("corrupted", 0) + 1
         return info
 
+    # -- load-shaped faults (docs/robustness.md "Overload") ------------------
+
+    def hot_tenant(self, submit, qps: float = 200.0, tenant: str = "hot",
+                   threads: int = 2, hold_s: float = 0.0,
+                   fault: str = "hot_tenant") -> None:
+        """One tenant floods the serving plane: seeded threads call
+        ``submit(i, tenant)`` at ~``qps`` total until :meth:`heal` (every
+        outcome — served, shed, error — is counted, never raised; the
+        overload plane under test decides which it is).  Pacing draws from
+        a per-thread rng DERIVED from the nemesis seed, so the schedule
+        replays while live threads stay independent."""
+        import random
+
+        _count(fault)
+        self.stats.setdefault(f"{fault}_requests", 0)
+        self.stats.setdefault(f"{fault}_errors", 0)
+        interval = threads / max(qps, 0.001)
+        stop = self._load_stop
+
+        def flood(idx: int):
+            rng = random.Random(f"{self.seed}:{fault}:{idx}")
+            i = 0
+            while not stop.is_set():
+                try:
+                    submit(i, tenant)
+                except Exception:  # noqa: BLE001 — shed/busy IS the point
+                    with self._mu:
+                        self.stats[f"{fault}_errors"] += 1
+                else:
+                    with self._mu:
+                        self.stats[f"{fault}_requests"] += 1
+                i += 1
+                if hold_s:
+                    # slow consumer: sit on the response/stream slot before
+                    # asking for more — the client that drains too slowly
+                    stop.wait(hold_s)
+                stop.wait(interval * rng.uniform(0.5, 1.5))
+
+        for idx in range(max(threads, 1)):
+            t = threading.Thread(target=flood, args=(idx,), daemon=True,
+                                 name=f"chaos-{fault}-{idx}")
+            with self._mu:
+                self._load_threads.append(t)
+            t.start()
+
+    def slow_consumer(self, submit, qps: float = 20.0, hold_s: float = 0.05,
+                      tenant: str = "slow", threads: int = 1) -> None:
+        """A tenant that consumes responses slowly: each ``submit`` is
+        followed by a ``hold_s`` pause modelling a client sitting on its
+        response before requesting more (the stream-backpressure shape)."""
+        self.hot_tenant(submit, qps=qps, tenant=tenant, threads=threads,
+                        hold_s=hold_s, fault="slow_consumer")
+
+    def memory_squeeze(self, cache, fraction: float = 0.5) -> None:
+        """Shrink a region column cache's byte budget (and every tenant
+        partition) to ``fraction`` of its current value — memory pressure
+        without traffic.  Enforcement (and the per-tenant degradation
+        ladder) runs immediately; :meth:`heal` restores the budgets."""
+        _count("memory_squeeze")
+        with self._mu:
+            self._squeezed.append((cache, cache.byte_budget,
+                                   dict(cache._tenant_budgets)))
+            self.stats["squeezed"] = self.stats.get("squeezed", 0) + 1
+        cache.set_tenant_budgets({
+            t: max(int(b * fraction), 1)
+            for t, b in cache._tenant_budgets.items()
+        })
+        cache.resize_budget(max(int(cache.byte_budget * fraction), 1))
+
+    def _stop_load_locked(self):
+        threads, self._load_threads = self._load_threads, []
+        squeezed, self._squeezed = self._squeezed, []
+        return threads, squeezed
+
     def disk_stall(self, ms: float | None = None, count: int | None = None) -> None:
         """Wedge the apply path through the existing ``apply_before_exec``
         failpoint: ``ms`` → every apply sleeps that long (slow disk);
@@ -375,6 +481,20 @@ class Nemesis:
             self._crashed.clear()
             stalled = self._stalled
             self._stalled = None
+            # load faults end with everything else: flood threads stop,
+            # squeezed budgets restore
+            threads, squeezed = self._stop_load_locked()
+            stop_evt = self._load_stop
+            self._load_stop = threading.Event()
+        stop_evt.set()
+        for t in threads:
+            t.join(timeout=2.0)
+        # restore NEWEST-first: stacked squeezes of one cache snapshot the
+        # already-squeezed budgets, so the earliest (true original)
+        # snapshot must win
+        for cache, byte_budget, tenant_budgets in reversed(squeezed):
+            cache.set_tenant_budgets(tenant_budgets)
+            cache.resize_budget(byte_budget)
         if stalled is not None:
             failpoint.remove(stalled)
         self._deliver_due(float("inf"))
@@ -385,6 +505,10 @@ class Nemesis:
         with self._mu:
             self._closed = True
             self._mu.notify_all()
+            threads, _squeezed = self._stop_load_locked()
+        self._load_stop.set()
+        for t in threads:
+            t.join(timeout=2.0)
         self.adapter.detach(self._filter)
         if self._deliverer is not None:
             self._deliverer.join(timeout=2.0)

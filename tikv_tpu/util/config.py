@@ -116,6 +116,24 @@ class TraceConfig:
 
 
 @dataclass
+class OverloadSection:
+    """overload.* — the overload control plane (docs/robustness.md
+    "Overload").  Every scalar reconfigures online through the
+    ConfigController; rates are the DEFAULT tenant quota (0 = unlimited),
+    per-tenant overrides go through ``OverloadControl.set_quota``."""
+
+    enabled: bool = False
+    requests_per_s: float = 0.0
+    read_bytes_per_s: float = 0.0
+    burst_s: float = 1.0
+    max_wait_s: float = 0.02
+    max_priority: str = "high"
+    adaptive: bool = True
+    min_scale: float = 0.1
+    window_s: float = 1.0
+
+
+@dataclass
 class SecuritySection:
     """security.* (components/security/src/lib.rs SecurityConfig)."""
 
@@ -136,6 +154,7 @@ class TikvConfig:
     gc: GcConfig = field(default_factory=GcConfig)
     security: SecuritySection = field(default_factory=SecuritySection)
     trace: TraceConfig = field(default_factory=TraceConfig)
+    overload: OverloadSection = field(default_factory=OverloadSection)
 
     def apply_security(self):
         """Make the [security] section take effect process-wide: returns the
@@ -174,6 +193,16 @@ class TikvConfig:
             raise ValueError("trace.sample_rate must be in [0, 1]")
         if self.trace.slow_threshold_s < 0:
             raise ValueError("trace.slow_threshold_s must be >= 0")
+        ov = self.overload
+        if ov.max_priority not in ("high", "normal", "low"):
+            raise ValueError("overload.max_priority must be high|normal|low")
+        if ov.requests_per_s < 0 or ov.read_bytes_per_s < 0:
+            raise ValueError("overload rates must be >= 0 (0 = unlimited)")
+        if not 0.0 < ov.min_scale <= 1.0:
+            raise ValueError("overload.min_scale must be in (0, 1]")
+        if ov.burst_s <= 0 or ov.window_s <= 0 or ov.max_wait_s < 0:
+            raise ValueError(
+                "overload.burst_s/window_s must be > 0, max_wait_s >= 0")
 
     def to_dict(self) -> dict:
         return asdict(self)
