@@ -629,6 +629,84 @@ def _op_scan_compressed(req, state):
     return out
 
 
+def _op_scan_pruned(req, state):
+    """scan_pruned event (docs/zone_maps.md): a selective pk-range scan and
+    a Limit-bearing scan over ONE warm region, timed with zone-map pruning
+    on vs force-disabled through the kill switch.  Handles are clustered, so
+    per-block handle zones are tight and a range predicate prunes ~90% of
+    the blocks; the unpruned runs dispatch every block.  Every serve is
+    byte-checked against the CPU oracle — a divergence is a correctness
+    failure, not noise."""
+    from tikv_tpu.copr import zone_maps
+    from tikv_tpu.copr.dag import DagRequest, Limit, Selection, TableScan
+    from tikv_tpu.copr.endpoint import CoprRequest, Endpoint
+    from tikv_tpu.copr.rpn import call, col, const_int
+    from tikv_tpu.copr.table import record_range
+    from tikv_tpu.storage.btree_engine import BTreeEngine
+    from tikv_tpu.storage.engine import CF_WRITE
+    from tikv_tpu.storage.kv import LocalEngine
+    from tikv_tpu.storage.txn_types import Key, Write, WriteType
+    from tikv_tpu.util.metrics import REGISTRY
+
+    n = req["rows"]
+    trials = req.get("trials", 3)
+    kvs = build_kvs(n, seed=17)
+    eng = BTreeEngine()
+    eng.bulk_load(CF_WRITE, [
+        (Key.from_raw(rk).append_ts(20).encoded,
+         Write(WriteType.PUT, 10, short_value=v).to_bytes())
+        for rk, v in kvs
+    ])
+    le = LocalEngine(eng)
+    # enough blocks that per-block dispatch (what pruning saves) dominates
+    # the request's fixed costs
+    block_rows = req.get("block_rows", max(512, n // 64))
+    ep_warm = Endpoint(le, enable_device=True, block_rows=block_rows)
+    ep_cpu = Endpoint(le, enable_device=False, enable_region_cache=False)
+
+    cut = n - max(n // 100, 1)
+
+    def sel():
+        return Selection([call("ge", col(0), const_int(cut))])
+
+    dags = {
+        "selective": DagRequest(executors=[
+            TableScan(TABLE_ID, _lineitem()), sel(), Limit(1 << 20)]),
+        "limit": DagRequest(executors=[
+            TableScan(TABLE_ID, _lineitem()), sel(), Limit(32)]),
+    }
+
+    def mk(dag):
+        return CoprRequest(103, dag, [record_range(TABLE_ID)], 100,
+                           context={"region_id": 1, "region_epoch": (1, 1),
+                                    "apply_index": 7})
+
+    out = {"match": True, "block_rows": block_rows}
+    try:
+        for name, dag in dags.items():
+            oracle = ep_cpu.handle_request(mk(dag)).data
+            ep_warm.handle_request(mk(dag))  # fill + compile
+            pruned_ts, unpruned_ts = [], []
+            for _ in range(trials):
+                zone_maps.set_enabled(False)
+                t0 = time.perf_counter()
+                ru = ep_warm.handle_request(mk(dag))
+                unpruned_ts.append(time.perf_counter() - t0)
+                zone_maps.set_enabled(True)
+                t0 = time.perf_counter()
+                rp = ep_warm.handle_request(mk(dag))
+                pruned_ts.append(time.perf_counter() - t0)
+                out["match"] &= rp.data == oracle and ru.data == oracle
+            out[name] = {"pruned_ts": pruned_ts, "unpruned_ts": unpruned_ts,
+                         "from_device": bool(rp.from_device)}
+    finally:
+        zone_maps.set_enabled(None)
+    c = REGISTRY.counter("tikv_coprocessor_zone_prune_total", "")
+    out["blocks_pruned"] = int(c.get(path="unary", outcome="pruned"))
+    out["blocks_examined"] = int(c.get(path="unary", outcome="examined"))
+    return out
+
+
 def _xregion_q6(cut: int):
     """A Q6-shaped selection+aggregation (no group-by): the dispatch-bound
     serving shape where cross-region batching pays off on every backend."""
@@ -1319,6 +1397,7 @@ _OPS = {
     "filter": _op_filter,
     "region_cache": _op_region_cache,
     "scan_compressed": _op_scan_compressed,
+    "scan_pruned": _op_scan_pruned,
     "xregion": _op_xregion,
     "wire": _op_wire,
     "wire_chunk": _op_wire_chunk,
@@ -1948,6 +2027,31 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             results["compressed_error"] = str(e)[:200]
             _mark("compressed_error", err=str(e)[:120])
+
+    if os.environ.get("BENCH_PRUNED", "1") != "0":
+        # zone-map pruned execution (ISSUE 16): selective and Limit-bearing
+        # scans with block pruning on vs kill-switched off, byte-checked
+        # against the CPU oracle.  In-parent on CPU — it measures how many
+        # block dispatches the zones save, not device compute.
+        try:
+            r = _op_scan_pruned({
+                "rows": int(os.environ.get("BENCH_PRUNED_ROWS", "60000")),
+            }, {})
+            if not r["match"]:
+                _fail("PRUNED_MISMATCH")
+            for name in ("selective", "limit"):
+                p = float(np.median(r[name]["pruned_ts"]))
+                u = float(np.median(r[name]["unpruned_ts"]))
+                results[f"scan_pruned_{name}_speedup"] = round(u / p, 2)
+            results["scan_pruned_blocks"] = [
+                r["blocks_pruned"], r["blocks_examined"]]
+            _mark("scan_pruned",
+                  selective=results["scan_pruned_selective_speedup"],
+                  limit=results["scan_pruned_limit_speedup"],
+                  blocks=results["scan_pruned_blocks"])
+        except Exception as e:  # noqa: BLE001
+            results["scan_pruned_error"] = str(e)[:200]
+            _mark("scan_pruned_error", err=str(e)[:120])
 
     if os.environ.get("BENCH_OVERLOAD", "1") != "0":
         # overload control plane (ISSUE 15): well-behaved-tenant throughput

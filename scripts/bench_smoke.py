@@ -35,6 +35,11 @@ regresses:
   (docs/compressed_columns.md) — byte-identity of encoded serving vs the
   CPU oracle, and the warm-capacity multiplier at one fixed byte budget.
   Fails on byte divergence or under 2x regions resident encoded-vs-decoded.
+* ``scan_pruned`` (ISSUE 16): zone-map pruned execution
+  (docs/zone_maps.md) — a selective pk-range scan and a Limit-bearing scan
+  over a warm region, pruning on vs kill-switched off.  Fails on byte
+  divergence from the CPU oracle, a speedup below the 2x floor, or zero
+  blocks ever pruned.
 
 Exit code 0 = healthy; 1 = regression.  One JSON line on stdout either way,
 so CI logs stay grep-able:
@@ -59,6 +64,7 @@ MIN_WARM_HIT_RATE = 0.5
 MIN_WIRE_SPEEDUP = 5.0
 MIN_WIRE_CHUNK_SPEEDUP = 3.0
 MIN_COMPRESSED_CAPACITY = 2.0
+MIN_PRUNED_SPEEDUP = 2.0
 MIN_OVERLOAD_RETENTION = 0.5
 SHARDED_DEVICES = 8
 
@@ -245,6 +251,32 @@ def main() -> int:
         out["compressed_regression"] = (
             f"{rc['warm_capacity_ratio']:.2f}x warm regions < "
             f"{MIN_COMPRESSED_CAPACITY}x floor at equal budget")
+
+    # zone-map pruned execution (ISSUE 16): a selective pk-range scan and a
+    # Limit-bearing scan over a warm region must serve ≥2x faster with
+    # block pruning on than with the kill switch thrown — byte-identical to
+    # the CPU oracle either way (docs/zone_maps.md)
+    rp = bench._op_scan_pruned({
+        "rows": int(os.environ.get("SMOKE_PRUNED_ROWS", "60000")),
+        "trials": max(args.trials, 3),
+    }, {})
+    out["pruned_match"] = bool(rp["match"])
+    ok = ok and rp["match"]
+    pruned_regressions = []
+    for name in ("selective", "limit"):
+        p = float(np.median(rp[name]["pruned_ts"]))
+        u = float(np.median(rp[name]["unpruned_ts"]))
+        pspeed = u / p
+        out[f"pruned_{name}_speedup"] = round(pspeed, 2)
+        if pspeed < MIN_PRUNED_SPEEDUP:
+            pruned_regressions.append(
+                f"{name} {pspeed:.2f}x < {MIN_PRUNED_SPEEDUP}x floor")
+    out["pruned_blocks"] = [rp["blocks_pruned"], rp["blocks_examined"]]
+    if rp["blocks_pruned"] <= 0:
+        pruned_regressions.append("no blocks were ever pruned")
+    if pruned_regressions:
+        ok = False
+        out["pruned_regression"] = "; ".join(pruned_regressions)
 
     # overload control plane (ISSUE 15): a hot tenant saturating the
     # scheduler must not cost the well-behaved tenant more than half its

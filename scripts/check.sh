@@ -48,6 +48,10 @@ echo "== chunk-wire smoke: TypeChunk negotiation, differential byte-identity, ze
 JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
   -m 'not slow' tests/test_chunk_codec.py tests/test_chunk_wire.py
 
+echo "== zone-map smoke: prune soundness, fold widening, early exits, pruned byte-identity under the sanitizer =="
+JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
+  -m 'not slow' tests/test_zone_maps.py
+
 echo "== overload smoke: tenant quotas, adaptive admission, hot-tenant flood continuity under the sanitizer =="
 JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
   -m 'not slow' tests/test_overload.py

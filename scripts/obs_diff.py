@@ -82,6 +82,9 @@ def main(argv=None) -> int:
                                "p95_ms": v.get("p95_ms"),
                                "count": v["count"],
                                "desc": v.get("desc", entry.get("desc", ""))}
+                    if v.get("pruned_fraction") is not None:
+                        # zone-map pruning floor (docs/zone_maps.md)
+                        out[pk]["pruned_fraction"] = v["pruned_fraction"]
             if out:
                 sigs[s] = out
         import time
@@ -102,6 +105,12 @@ def main(argv=None) -> int:
         print(f"obs_diff: missing profile {m} (floor has it, current run "
               f"does not)", file=sys.stderr)
     for r in verdict["regressions"]:
+        if r.get("kind") == "pruning":
+            print(f"obs_diff: PRUNING REGRESSION {r['sig']}/{r['path']} "
+                  f"({r['desc']}): pruned fraction "
+                  f"{r['pruned_fraction']:.3f} vs floor "
+                  f"{r['floor_pruned_fraction']:.3f}", file=sys.stderr)
+            continue
         print(f"obs_diff: REGRESSION {r['sig']}/{r['path']} "
               f"({r['desc']}): {r['rows_per_s']:.1f} rows/s vs floor "
               f"{r['floor_rows_per_s']:.1f} ({r['drop']}x drop "

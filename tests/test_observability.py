@@ -84,6 +84,7 @@ LAZY_SERIES = {
     "tikv_coprocessor_encoded_path_total",
     "tikv_coprocessor_encoded_decline_total",
     "tikv_coprocessor_encoded_rewrite_total",
+    "tikv_coprocessor_zone_prune_total",
     "tikv_overload_admission_total",
     "tikv_overload_demote_total",
     "tikv_overload_bucket_level",

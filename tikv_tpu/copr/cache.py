@@ -51,6 +51,9 @@ class _Block:
     cols: list  # list[Column] (host)
     n_valid: int
     device: dict = field(default_factory=dict)  # (cols-sig) -> (data, nulls) jnp lists
+    # per-column prune statistics, built lazily by zone_maps.ensure_zones;
+    # None = not built yet (fresh fills / structural repacks start here)
+    zones: dict | None = None
 
 
 class ColumnBlockCache:
@@ -184,9 +187,16 @@ class ColumnBlockCache:
         (zone layouts, mesh ``shardslab`` stacks; nvoff is kept — row counts
         are unchanged) is dropped so it rebuilds from the updated host
         blocks on its owner device."""
+        from . import zone_maps as _zm
+
         with self._mu:
             for bi, blk in enumerate(self.blocks):
                 upd = updates.get(bi)
+                if upd is not None and blk.zones is not None:
+                    # widen the block's zone map with the incoming values —
+                    # stale-but-sound maintenance (docs/zone_maps.md); the
+                    # host columns already hold these values
+                    _zm.fold_update(blk.zones, upd[1])
                 for sig in list(blk.device):
                     kind = sig[0]
                     if kind == "nvoff":

@@ -437,6 +437,14 @@ def encode_blocks(cache, schema) -> dict:
         count_encoded(kind)
     if changed:
         cache.enc_version = getattr(cache, "enc_version", 0) + 1
+    # fill/repack-time zone maps (docs/zone_maps.md): the stats pass above
+    # already bounded every encoded column, so attaching the prunable
+    # per-block zones here is nearly free — and fresh (non-stale) by
+    # construction.  Plain images build theirs lazily on first prune.
+    from . import zone_maps as _zm
+
+    for b in blocks:
+        b.zones = _zm.build_block_zones(b.cols, b.n_valid)
     return changed
 
 

@@ -344,7 +344,21 @@ class Endpoint:
         # says whether it came off the stale path — counted per serving
         # path below so operators see read traffic scale with replicas
         stale_snap = bool(getattr(snap, "stale", False))
-        use_device = self.device_enabled() and jax_eval.supports(req.dag)
+        use_device = False
+        if self.device_enabled():
+            decline = jax_eval.decline_cause(req.dag)
+            use_device = decline is None
+            if decline is not None:
+                from .dag import Limit, TopN
+
+                if any(isinstance(e, (Limit, TopN))
+                       for e in req.dag.executors[1:]):
+                    # Limit/TopN plans never fall to the CPU silently: the
+                    # early-exit tiling work (docs/zone_maps.md) made them
+                    # device-eligible, so a decline is a named, counted event
+                    from . import encoding as _encoding
+
+                    _encoding.count_decline("device_plan", decline)
         if use_device and self.overload is not None \
                 and not self.overload.allow_device(req.context):
             # memory-pressure degradation ladder, last rung (overload.py):
@@ -413,7 +427,7 @@ class Endpoint:
                 self._record_obs(req, tracker,
                                  getattr(resp, "_obs_path", "unary"),
                                  getattr(resp, "_obs_encoding", "plain"),
-                                 rows, ev=ev)
+                                 rows, ev=ev, resp=resp)
                 self.slow_log.observe(tracker)
                 from_cache = (from_device
                               and cache is not None and cache.filled and src is None
@@ -540,7 +554,7 @@ class Endpoint:
             # construction; the sig recorded is the ORIGINAL plan's (what
             # the client sent), not the rewritten one
             self._record_obs(req, tracker, "unary", "encoded",
-                             cache.total_rows)
+                             cache.total_rows, resp=resp)
             self.slow_log.observe(tracker)
             self.breaker.record_success("unary")
             if stale_snap:
@@ -566,7 +580,7 @@ class Endpoint:
             return None
 
     def _record_obs(self, req: CoprRequest, tracker, path: str,
-                    encoding: str, rows: int, ev=None) -> None:
+                    encoding: str, rows: int, ev=None, resp=None) -> None:
         """Report one served request into the performance observatory
         (docs/observatory.md) and stamp the serving path + plan sig onto
         the tracker so the slow log pivots into ``ctl.py observatory sig``.
@@ -587,10 +601,12 @@ class Endpoint:
         tracker.metrics.serve_path = path
         tracker.metrics.plan_sig = sig
         m = tracker.metrics
+        # zone-map pruning effectiveness rides the profile (docs/zone_maps.md)
+        prune = getattr(resp, "_obs_prune", None) or (0, 0)
         _obs.OBSERVATORY.record_serve(
             sig, path, m.total_s, rows=rows, encoding=encoding,
             queue_wait_s=m.schedule_wait_s, trace_id=tracker.trace_id,
-            desc=desc)
+            desc=desc, blocks_examined=prune[0], blocks_pruned=prune[1])
 
     def _cpu_bytes(self, req: CoprRequest, snap) -> bytes:
         """The CPU-oracle answer to ``req`` off ``snap`` — the byte-identity

@@ -90,8 +90,25 @@ def supports(dag: DagRequest) -> bool:
         return False
 
 
+def decline_cause(dag: DagRequest) -> str | None:
+    """None when the DAG is device-eligible, else a bounded-cardinality
+    cause slug — the named half of :func:`supports`, so limit-bearing
+    plans that stay on the CPU are never a silent fallback (the endpoint
+    counts these under ``tikv_coprocessor_encoded_decline_total``
+    path="device_plan")."""
+    try:
+        _analyze(dag)
+        return None
+    except _Unsupported as exc:
+        return exc.cause
+    except ValueError:
+        return "expr_compile"
+
+
 class _Unsupported(Exception):
-    pass
+    def __init__(self, msg: str, cause: str = "plan_shape"):
+        super().__init__(msg)
+        self.cause = cause
 
 
 @dataclass
@@ -106,7 +123,7 @@ class _Plan:
 def _analyze(dag: DagRequest) -> _Plan:
     execs = list(dag.executors)
     if not execs or not isinstance(execs[0], (TableScan, IndexScan)):
-        raise _Unsupported("leaf must be a scan")
+        raise _Unsupported("leaf must be a scan", "leaf_not_scan")
     scan = execs[0]
     rest = execs[1:]
     plan = _Plan(scan, None, None, None, None)
@@ -124,18 +141,19 @@ def _analyze(dag: DagRequest) -> _Plan:
             plan.limit = e
             stage = 3
         else:
-            raise _Unsupported(f"executor {type(e).__name__} not device-routable here")
+            raise _Unsupported(f"executor {type(e).__name__} not device-routable here",
+                               "executor_shape")
     schema = [(c.ftype.eval_type, c.ftype.decimal) for c in scan.columns_info]
     for et, _ in schema:
         if et not in _DEVICE_EVAL_TYPES and et not in (EvalType.BYTES, EvalType.JSON):
             # BYTES/JSON columns may exist in the schema (group keys are
             # dictionary-encoded host-side); _check_rpn_device rejects them
             # inside device expressions
-            raise _Unsupported(f"column type {et}")
+            raise _Unsupported(f"column type {et}", "column_type")
         if isinstance(scan, IndexScan) and et not in _DEVICE_EVAL_TYPES:
             # index entries decode through datum lists (object arrays), so
             # BYTES never arrives dictionary-coded on this leaf
-            raise _Unsupported(f"index column type {et}")
+            raise _Unsupported(f"index column type {et}", "index_column_type")
     if plan.selection is not None:
         for cond in plan.selection.conditions:
             rpn = compile_expr(cond, schema)
@@ -168,10 +186,11 @@ def _analyze(dag: DagRequest) -> _Plan:
                     for g in plan.agg.group_by
                 )
             if not ok:
-                raise _Unsupported("streamed agg not sorted by group key")
+                raise _Unsupported("streamed agg not sorted by group key",
+                                   "streamed_agg_order")
         for a in plan.agg.agg_funcs:
             if a.op not in _DEVICE_AGG_OPS:
-                raise _Unsupported(f"aggregate {a.op}")
+                raise _Unsupported(f"aggregate {a.op}", "agg_op")
             if a.expr is not None:
                 rpn = compile_expr(a.expr, schema)
                 _check_rpn_device(rpn, schema)
@@ -185,24 +204,26 @@ def _analyze(dag: DagRequest) -> _Plan:
         # (decoded back to bytes host-side at finalize; non-dict layouts
         # raise at run time and take the CPU fallback)
         if plan.topn.limit > _TOPN_DEVICE_MAX:
-            raise _Unsupported(f"TopN limit {plan.topn.limit} too large for device")
+            raise _Unsupported(f"TopN limit {plan.topn.limit} too large for device",
+                               "topn_limit_too_large")
         for et, _ in schema:
             if et not in _DEVICE_EVAL_TYPES and not (
                 et == EvalType.BYTES and isinstance(scan, TableScan)
             ):
-                raise _Unsupported(f"TopN payload column type {et}")
+                raise _Unsupported(f"TopN payload column type {et}",
+                                   "topn_payload_type")
         for expr, _desc in plan.topn.order_by:
             rpn = compile_expr(expr, schema)
             _check_rpn_device(rpn, schema)
             if rpn.eval_type not in _DEVICE_EVAL_TYPES:
-                raise _Unsupported(f"TopN key type {rpn.eval_type}")
+                raise _Unsupported(f"TopN key type {rpn.eval_type}", "topn_key_type")
     return plan
 
 
 def _check_rpn_device(rpn: RpnExpression, schema) -> None:
     for node in rpn.nodes:
         if node.eval_type == EvalType.BYTES or node.eval_type == EvalType.JSON:
-            raise _Unsupported("bytes in device expression")
+            raise _Unsupported("bytes in device expression", "bytes_predicate")
 
 
 # ---------------------------------------------------------------------------
@@ -456,6 +477,37 @@ def _fused_step(sel_rpns, device_aggs, capacity, n_rows, cols, n_valid, gids, of
     ridx = jnp.where(active, offset + jnp.arange(n_rows, dtype=jnp.int64), _NO_ROW)
     block_first = _seg_extreme(ridx, gids, capacity, True, _NO_ROW)
     return (jnp.minimum(first_row, block_first), new_carries)
+
+
+def _masked_nv(blocks, keep):
+    """Survivor-count n_valid vector (docs/zone_maps.md): pruned blocks
+    carry 0 valid rows, so the fixed-shape programs mask them out entirely
+    while every compile key stays unchanged."""
+    nv = np.fromiter(
+        (b.n_valid if keep[bi] else 0 for bi, b in enumerate(blocks)),
+        dtype=np.int64, count=len(blocks))
+    return jnp.asarray(nv)
+
+
+def _batch_prune_keep(evaluators, cache):
+    """Fused-batch keep mask: the batch shares one block stream, so a block
+    is masked out only when EVERY rider's zone maps prune it.  Returns
+    (keep | None, (examined, pruned)) like the unary ``_prune_keep``."""
+    from . import zone_maps as _zm
+
+    if not _zm.enabled() or not cache.blocks:
+        return None, (0, 0)
+    keep = None
+    for ev in evaluators:
+        if not ev.sel_rpns:
+            return None, (0, 0)
+        m = _zm.prune_blocks(cache, ev.sel_rpns, path="fused")
+        if m is None:
+            return None, (0, 0)
+        keep = m if keep is None else (keep | m)
+    if keep.all():
+        return None, (0, 0)
+    return keep, (len(cache.blocks), int((~keep).sum()))
 
 
 class _DeviceAgg:
@@ -953,6 +1005,12 @@ class JaxDagEvaluator:
             zone_resp._obs_path = "zone"
             return zone_resp
 
+        # zone-map pruning (docs/zone_maps.md): the stacked programs keep
+        # their compile keys — survivor counts ship through the dynamic
+        # ``n_valids`` geometry they already consume, so a pruned block's
+        # rows are all invalid and contribute to no aggregate or group
+        keep, prune_stats = self._prune_keep(cache, "unary")
+
         stable = self._stable_dict_group_cols(blocks)
         if stable is not None:
             group_cols, dicts = stable
@@ -966,6 +1024,8 @@ class JaxDagEvaluator:
             ship = self._ship_cols(group_cols)
             col_data, col_nulls, refs, enc = self._stacked_device(cache, blocks, ship)
             nv_dev, off_dev = self._nvoff_device(cache, blocks)
+            if keep is not None:
+                nv_dev = _masked_nv(blocks, keep)
             scan_fn = self._build_scan_fn_coded(dict_lens, capacity, n_blocks, group_cols, enc)
             packed = scan_fn(col_data, col_nulls, nv_dev, off_dev, refs)
             state_np = _unpack_state(packed, self._host_state_template())
@@ -981,12 +1041,17 @@ class JaxDagEvaluator:
 
             resp = self._finalize_agg(state_np, n_slots, key_of)
             resp._obs_encoding = "encoded" if enc else "plain"
+            if prune_stats[0]:
+                resp._obs_prune = prune_stats
             return resp
 
         groups = GroupDict()
         all_gids = np.zeros((n_blocks, self.block_rows), dtype=np.int32)
         for bi, blk in enumerate(blocks):
-            if self.group_rpns:
+            if self.group_rpns and (keep is None or keep[bi]):
+                # pruned blocks skip host gid assignment too: none of their
+                # rows can be active, and groups they alone would introduce
+                # stay empty and drop at finalize either way
                 gids_np, _ = self._assign_gids(blk.cols, blk.n_valid, groups)
                 all_gids[bi] = gids_np
         n_slots = len(groups) if self.group_rpns else 1
@@ -996,11 +1061,15 @@ class JaxDagEvaluator:
 
         col_data, col_nulls, refs, enc = self._stacked_device(cache, blocks, self.device_cols)
         nv_dev, off_dev = self._nvoff_device(cache, blocks)
+        if keep is not None:
+            nv_dev = _masked_nv(blocks, keep)
         scan_fn = self._build_scan_fn(capacity, n_blocks, enc)
         packed = scan_fn(col_data, col_nulls, nv_dev, all_gids, off_dev, refs)
         state_np = _unpack_state(packed, self._host_state_template())
         resp = self._finalize_agg(state_np, n_slots, lambda r: groups.rows[r])
         resp._obs_encoding = "encoded" if enc else "plain"
+        if prune_stats[0]:
+            resp._obs_prune = prune_stats
         return resp
 
     def _try_zone(self, cache) -> SelectResponse | None:
@@ -1039,6 +1108,20 @@ class JaxDagEvaluator:
             np.zeros(0, dtype=np.int64),
             tuple(da.host_template() for da in self.device_aggs),
         )
+
+    def _prune_keep(self, cache, path: str):
+        """(keep_mask | None, (examined, pruned)) for a warm cache under
+        this plan's selection conjuncts (copr/zone_maps.py) — the prune
+        planner sitting between ``encoding.device_plan`` and the
+        launchers.  None keep means "prune proved nothing": callers run
+        their exact pre-zone-map path."""
+        from . import zone_maps as _zm
+
+        if cache is None or not getattr(cache, "filled", False) or not cache.blocks:
+            return None, (0, 0)
+        stats = _zm.PruneStats()
+        keep = _zm.prune_blocks(cache, self.sel_rpns, path=path, stats=stats)
+        return keep, (stats.examined, stats.pruned)
 
     def _nvoff_device(self, cache, blocks):
         """Per-cache pinned n_valids / offsets device arrays."""
@@ -1483,7 +1566,32 @@ class JaxDagEvaluator:
         ]
         payload_dicts: dict[int, np.ndarray] = {}
         step = None
-        for cols, n_valid in self._blocks(source):
+        cache = getattr(self, "_cache", None)
+        keep, prune_stats = self._prune_keep(cache, "unary")
+        # zone-order early exit (docs/zone_maps.md): with no selection and a
+        # bare-column first sort key, zone bounds alone can prove which
+        # blocks may still contribute to the top-k — the rest never launch.
+        # Blocks stay in STREAM order (tie-breaks are stream-ordered), only
+        # provably-dominated ones drop out, so the bytes cannot change.
+        if (cache is not None and cache.filled and cache.blocks
+                and not self.sel_rpns):
+            from . import zone_maps as _zm
+
+            rpn0, desc0 = self.topn_rpns[0]
+            if (_zm.enabled() and len(rpn0.nodes) == 1
+                    and rpn0.nodes[0].kind == "col"
+                    and _zm.ensure_zones(cache)):
+                base = (keep if keep is not None
+                        else np.ones(len(cache.blocks), dtype=bool))
+                cut = _zm.topn_cutoff_order(
+                    cache.blocks, base, rpn0.nodes[0].index, bool(desc0), k)
+                exited = int((base & ~cut).sum()) if cut is not None else 0
+                if exited:
+                    keep = cut
+                    _zm.count_prune("unary", "early_exit", exited)
+                    prune_stats = (prune_stats[0] or len(cache.blocks),
+                                   prune_stats[1] + exited)
+        for bi, (cols, n_valid) in enumerate(self._blocks(source)):
             for ci in bytes_cols:
                 # BYTES payloads ride as dictionary codes; every block must
                 # agree on the dictionary or the codes are meaningless (the
@@ -1496,6 +1604,8 @@ class JaxDagEvaluator:
                     len(seen) != len(d) or any(a != b for a, b in zip(seen, d))
                 ):
                     raise ValueError(f"TopN BYTES payload column {ci}: unstable dictionary")
+            if keep is not None and not keep[bi]:
+                continue  # zone-pruned / dominated: contributes no top-k row
             col_data, col_nulls, refs, enc_sig = self._device_block(cols, n_valid)
             if step is None:
                 # the encoding signature is uniform across one source's
@@ -1522,7 +1632,10 @@ class JaxDagEvaluator:
             )
         enc = make_response_encoder(self.dag)
         enc.add_chunk(Chunk.full(out_cols), self.dag.output_offsets)
-        return enc.to_response()
+        resp = enc.to_response()
+        if prune_stats[0]:
+            resp._obs_prune = prune_stats
+        return resp
 
     # -- selection-only pipeline ------------------------------------------
 
@@ -1534,8 +1647,17 @@ class JaxDagEvaluator:
         remaining = self.plan.limit.limit if self.plan.limit else None
         sel_rpns = self.sel_rpns
         mask_jit = None
+        # zone-map pruning (docs/zone_maps.md): blocks whose zones prove no
+        # row can pass the conjuncts are skipped before any device dispatch
+        # — they contribute zero rows to the stream, so the response bytes
+        # are identical; with a Limit the loop also reaches its early break
+        # having touched only qualifying blocks
+        keep, prune_stats = self._prune_keep(getattr(self, "_cache", None),
+                                             "unary")
         enc = make_response_encoder(self.dag)
-        for cols, n_valid in self._blocks(source):
+        for bi, (cols, n_valid) in enumerate(self._blocks(source)):
+            if keep is not None and not keep[bi]:
+                continue
             valid = np.zeros(self.block_rows, dtype=bool)
             valid[:n_valid] = True
             if sel_rpns:
@@ -1559,7 +1681,10 @@ class JaxDagEvaluator:
             enc.add_chunk(chunk, self.dag.output_offsets)
             if remaining is not None and remaining <= 0:
                 break
-        return enc.to_response()
+        resp = enc.to_response()
+        if prune_stats[0]:
+            resp._obs_prune = prune_stats
+        return resp
 
 
 _BATCH_FN_CACHE: dict = {}
@@ -1696,6 +1821,12 @@ def run_batch_cached(evaluators: list["JaxDagEvaluator"], cache) -> list[SelectR
             _BATCH_FN_CACHE.pop(next(iter(_BATCH_FN_CACHE)))
 
     nv_dev, off_dev = base._nvoff_device(cache, blocks)
+    keep, prune_stats = _batch_prune_keep(evaluators, cache)
+    if keep is not None:
+        # survivor-count geometry: masked blocks ship n_valid == 0, so the
+        # fused step's validity masks exclude every one of their rows while
+        # the compiled program and its pins stay byte-for-byte identical
+        nv_dev = _masked_nv(blocks, keep)
     int_m, flt_m = fn(col_data, col_nulls, nv_dev, off_dev, refs)
     int_np = np.asarray(int_m)
     flt_np = np.asarray(flt_m) if flt_m.shape[0] else None
@@ -1725,6 +1856,9 @@ def run_batch_cached(evaluators: list["JaxDagEvaluator"], cache) -> list[SelectR
             return tuple(reversed(parts))
 
         out.append(ev._finalize_agg(state_np, n_slots, key_of))
+    if prune_stats[0]:
+        for resp in out:
+            resp._obs_prune = prune_stats
     return out
 
 
@@ -1740,7 +1874,7 @@ class XRegionPending:
     then calls :meth:`finalize` — double-buffering without threads."""
 
     def __init__(self, ev: "JaxDagEvaluator", specs, capacity: int, packed,
-                 order=None):
+                 order=None, prunes=None):
         self._ev = ev
         self._specs = specs  # [(dicts, dict_lens, n_slots)] per EXECUTED region
         self._capacity = capacity
@@ -1748,6 +1882,9 @@ class XRegionPending:
         # executed-position -> caller-position (launch sorts regions by
         # block count to canonicalize the compile key)
         self._order = order
+        # per-executed-region (blocks_examined, blocks_pruned) zone-map
+        # stats; finalize stamps them on the responses for the observatory
+        self._prunes = prunes
 
     def finalize(self) -> list[SelectResponse]:
         """Pull the packed states (one transfer per dtype matrix for the
@@ -1775,7 +1912,10 @@ class XRegionPending:
                     parts.append(None if c == dl else bytes(d[c]))
                 return tuple(reversed(parts))
 
-            out.append(ev._finalize_agg(state_np, n_slots, key_of))
+            resp = ev._finalize_agg(state_np, n_slots, key_of)
+            if self._prunes is not None and self._prunes[r][0]:
+                resp._obs_prune = self._prunes[r]
+            out.append(resp)
         if self._order is not None:
             restored = [None] * len(out)
             for pos, i in enumerate(self._order):
@@ -1882,13 +2022,25 @@ def launch_xregion_cached(ev: "JaxDagEvaluator", caches) -> XRegionPending:
     # same pins the per-request warm path uses, kept fresh by delta
     # scatter_update / drop_device) — zero per-row host→device traffic, and
     # no cross-cache pin that could go stale behind a region's back
+    from . import zone_maps as _zm
+
     region_inputs = []
+    prunes = []  # (examined, pruned) per executed region, for the riders' obs
     for r, cache in enumerate(caches):
         data, nulls, _refs, _e = ev._stacked_device(
             cache, cache.blocks, ship,
             plan=plans[r] if plans else None,
         )
         nv, off = ev._nvoff_device(cache, cache.blocks)
+        # zone-map pruning (docs/zone_maps.md): masked blocks ship
+        # n_valid == 0 through the dynamic nv input, so the vmapped program
+        # skips their rows without perturbing the shared compile key
+        pstats = _zm.PruneStats()
+        keep = _zm.prune_blocks(cache, ev.sel_rpns, path="xregion",
+                                stats=pstats)
+        if keep is not None:
+            nv = _masked_nv(cache.blocks, keep)
+        prunes.append((pstats.examined, pstats.pruned))
         region_inputs.append((data, nulls, nv, off))
     dl_arr = np.array([s[1] for s in specs], dtype=np.int64).reshape(
         len(caches), len(group_cols)
@@ -1957,7 +2109,7 @@ def launch_xregion_cached(ev: "JaxDagEvaluator", caches) -> XRegionPending:
     with trace.span("device.launch", kind="xregion", regions=len(caches),
                     encoding="encoded" if plans else "decoded"):
         packed = fn(tuple(region_inputs), dl_arr, refs_arr)
-    pending = XRegionPending(ev, specs, capacity, packed, order)
+    pending = XRegionPending(ev, specs, capacity, packed, order, prunes)
     # observatory encoding label for the riders' profiles
     pending.obs_encoding = "encoded" if plans else "plain"
     return pending

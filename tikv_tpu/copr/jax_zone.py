@@ -473,6 +473,12 @@ class ZoneEvaluator:
                 forced |= layout.zone_has_null[ci]
         full = status_full & ~status_empty & ~forced
         partial = ~full & ~status_empty
+        # the tile-grained twin of the block-grained zone_maps counter:
+        # proved-empty tiles are pruned work, same metric family
+        from .zone_maps import count_prune
+
+        count_prune("zone", "examined", T)
+        count_prune("zone", "pruned", int(status_empty.sum()))
         return full, np.flatnonzero(partial).astype(np.int32)
 
     def _referenced_cols(self):

@@ -129,7 +129,8 @@ class _Window:
     sampled trace ids of the window."""
 
     __slots__ = ("start", "count", "lat_sum", "rows", "occ_sum", "waste_sum",
-                 "waste_n", "qwait_sum", "buckets", "exemplars")
+                 "waste_n", "qwait_sum", "blk_exam", "blk_pruned", "buckets",
+                 "exemplars")
 
     def __init__(self, start: float):
         self.start = start
@@ -140,16 +141,20 @@ class _Window:
         self.waste_sum = 0.0
         self.waste_n = 0
         self.qwait_sum = 0.0
+        self.blk_exam = 0
+        self.blk_pruned = 0
         self.buckets = [0] * (len(BUCKETS) + 1)
         self.exemplars: list[tuple[float, str]] = []
 
     def add(self, latency_s, rows, occupancy, queue_wait_s, padding_waste,
-            trace_id) -> None:
+            trace_id, blocks_examined=0, blocks_pruned=0) -> None:
         self.count += 1
         self.lat_sum += latency_s
         self.rows += rows
         self.occ_sum += occupancy
         self.qwait_sum += queue_wait_s
+        self.blk_exam += blocks_examined
+        self.blk_pruned += blocks_pruned
         if padding_waste is not None:
             self.waste_sum += padding_waste
             self.waste_n += 1
@@ -195,12 +200,14 @@ class _Profile:
         return w
 
     def add(self, now, latency_s, rows, occupancy, queue_wait_s,
-            padding_waste, trace_id) -> None:
+            padding_waste, trace_id, blocks_examined=0,
+            blocks_pruned=0) -> None:
         self.total_count += 1
         self.total_lat += latency_s
         self.total_rows += rows
         self._current(now).add(latency_s, rows, occupancy, queue_wait_s,
-                               padding_waste, trace_id)
+                               padding_waste, trace_id,
+                               blocks_examined, blocks_pruned)
 
     def decline(self, cause: str) -> None:
         if cause in self.declines or len(self.declines) < _MAX_DECLINE_CAUSES:
@@ -212,7 +219,7 @@ class _Profile:
         """Aggregate the retained windows into the reportable profile."""
         counts = [0] * (len(BUCKETS) + 1)
         n = lat = rows = occ = qwait = waste = 0.0
-        waste_n = 0
+        waste_n = blk_exam = blk_pruned = 0
         exemplars: list[tuple[float, str]] = []
         for w in self.windows:
             for i, c in enumerate(w.buckets):
@@ -224,6 +231,8 @@ class _Profile:
             qwait += w.qwait_sum
             waste += w.waste_sum
             waste_n += w.waste_n
+            blk_exam += w.blk_exam
+            blk_pruned += w.blk_pruned
             exemplars.extend(w.exemplars)
         exemplars.sort(reverse=True)
         pct = lambda q: percentile_from_buckets(BUCKETS, counts, int(n), q)
@@ -241,6 +250,12 @@ class _Profile:
             "mean_ms": round(lat / n * 1e3, 4) if n else 0.0,
             "mean_occupancy": round(occ / n, 3) if n else 0.0,
             "padding_waste": round(waste / waste_n, 4) if waste_n else None,
+            # zone-map pruning effectiveness (docs/zone_maps.md): blocks the
+            # serve paths examined vs proved empty and skipped/masked
+            "blocks_examined": blk_exam,
+            "blocks_pruned": blk_pruned,
+            "pruned_fraction": (round(blk_pruned / blk_exam, 4)
+                                if blk_exam else None),
             "queue_wait_ms_mean": round(qwait / n * 1e3, 4) if n else 0.0,
             "declines": dict(self.declines),
             "exemplar_traces": [tid for _lat, tid in exemplars[:_MAX_EXEMPLARS]],
@@ -292,7 +307,9 @@ class Observatory:
                      rows: int = 0, encoding: str = "plain",
                      occupancy: int = 1, queue_wait_s: float = 0.0,
                      padding_waste: float | None = None,
-                     trace_id: str | None = None, desc: str = "") -> None:
+                     trace_id: str | None = None, desc: str = "",
+                     blocks_examined: int = 0,
+                     blocks_pruned: int = 0) -> None:
         """One served request on ``path`` under plan signature ``sig``.
         ``latency_s`` is the request's attributed share for batch-served
         riders (the scheduler's per-request share), the tracked total for
@@ -306,7 +323,7 @@ class Observatory:
             if prof is None:
                 prof = entry.paths[(path, encoding)] = _Profile(self.window_s, now)
             prof.add(now, latency_s, rows, occupancy, queue_wait_s,
-                     padding_waste, trace_id)
+                     padding_waste, trace_id, blocks_examined, blocks_pruned)
         REGISTRY.counter(
             "tikv_observatory_serve_total",
             "Requests recorded by the performance observatory, by path",
@@ -524,6 +541,9 @@ class Observatory:
                         "count": v["count"],
                         "desc": entry["desc"],
                     }
+                    if v.get("pruned_fraction") is not None:
+                        # zone-map effectiveness floor (docs/zone_maps.md)
+                        paths[pk]["pruned_fraction"] = v["pruned_fraction"]
             if paths:
                 sigs[s] = paths
         return {"version": 1, "written_at": time.time(), "sigs": sigs}
@@ -583,6 +603,23 @@ def floor_diff(floor: dict, current: dict, ratio: float = 2.0,
                     "floor_rows_per_s": base_r,
                     "rows_per_s": cur_r,
                     "drop": round(base_r / max(cur_r, 1e-12), 2),
+                })
+            # zone-map pruning regression (docs/zone_maps.md): a plan whose
+            # floor recorded meaningful pruning must keep pruning — a sharp
+            # drop means zones stopped proving emptiness (a maintenance bug
+            # or an eligibility regression), even when rows/s still passes
+            # because the serve got cheaper elsewhere
+            base_pf = base.get("pruned_fraction")
+            cur_pf = cur.get("pruned_fraction")
+            if (base_pf is not None and base_pf >= 0.05
+                    and (cur_pf or 0.0) < base_pf / ratio):
+                regressions.append({
+                    "sig": s,
+                    "path": pk,
+                    "desc": base.get("desc", ""),
+                    "kind": "pruning",
+                    "floor_pruned_fraction": base_pf,
+                    "pruned_fraction": cur_pf or 0.0,
                 })
     return {
         "ok": not regressions,

@@ -835,7 +835,7 @@ class CoprReadScheduler:
         )
         n_reqs = max(n_batch - n_filled, 1)
         kind = "xregion" if mesh is None else "xregion_sharded"
-        waste = self._padding_waste(live) if mesh is None else sh_waste
+        waste = self._padding_waste(live, ev=ev) if mesh is None else sh_waste
         # fan-in linkage (docs/tracing.md): ONE device-dispatch span — its
         # own one-span trace naming every participating parent trace — and
         # each rider links back to it.  A shared dispatch can't be a child
@@ -921,7 +921,7 @@ class CoprReadScheduler:
             # trace as its exemplar
             obs_path = "mesh" if mesh is not None else "xregion"
             obs_enc = getattr(pending, "obs_encoding", "plain")
-            for slot in live:
+            for slot, resp in zip(live, resps):
                 rows = slot.cache.total_rows if slot.cache is not None else 0
                 for it in slot.items:
                     if results[it.index] is not None:
@@ -929,7 +929,7 @@ class CoprReadScheduler:
                     self._record_obs(
                         it, ev, obs_path, dt / n_reqs, rows=rows,
                         encoding=obs_enc, occupancy=n_batch, waste=waste,
-                        dispatch_t=t0)
+                        dispatch_t=t0, resp=resp)
             for slot, resp in zip(live, resps):
                 # per-region chunk payloads: every rider of this slot shares
                 # the SAME unjoined column-slab parts, so one multi-response
@@ -1038,10 +1038,10 @@ class CoprReadScheduler:
         # count and skew the fused rows/s floors.
         rows = cache.total_rows if cache is not None else 0
 
-        def _rec_fused(group, g_ev):
+        def _rec_fused(group, g_ev, g_resp=None):
             for it in group:
                 self._record_obs(it, g_ev, "fused", dt / n_reqs, rows=rows,
-                                 occupancy=n_reqs, dispatch_t=t0)
+                                 occupancy=n_reqs, dispatch_t=t0, resp=g_resp)
 
         if slot.shadow_snap is not None:
             groups = list(uniq.values())
@@ -1052,7 +1052,7 @@ class CoprReadScheduler:
                 # signature group serves the oracle bytes already in hand;
                 # the other groups — whose oracle answers were never
                 # computed — re-execute per-request over the rebuilt state
-                _rec_fused(groups[0], evs[0])
+                _rec_fused(groups[0], evs[0], resps[0])
                 for it in groups[0]:
                     r = CoprResponse(fixed, from_device=False,
                                      encode_type=resps[0].encode_type)
@@ -1063,8 +1063,8 @@ class CoprReadScheduler:
                     for it in group:
                         self._per_request(it, results, errors, kind="shadow")
                 return None
-        for group, g_ev in zip(uniq.values(), evs):
-            _rec_fused(group, g_ev)
+        for group, g_ev, g_resp in zip(uniq.values(), evs, resps):
+            _rec_fused(group, g_ev, g_resp)
         from_cache = slot.outcome not in ("", "miss", "too_big")
         for group, resp in zip(uniq.values(), resps):
             parts, enc_tp = self.ep._encode_response(resp)
@@ -1079,11 +1079,24 @@ class CoprReadScheduler:
     # -- admission ----------------------------------------------------------
 
     @staticmethod
-    def _padding_waste(slots: list[_Slot]) -> float:
+    def _padding_waste(slots: list[_Slot], ev=None) -> float:
         if not slots:
             return 0.0
         counts = [len(s.cache.blocks) for s in slots]
         b = max(counts)
+        if ev is not None:
+            # zone-aware effective waste (docs/zone_maps.md): a pruned block
+            # ships n_valid == 0 and scans as padding, so the batch's useful
+            # fraction is its SURVIVOR count — the reported waste says so.
+            # The shed predicate stays on raw block counts (no ev): pruning
+            # never changes the padded shapes, so shedding can't recover it.
+            from . import zone_maps as _zm
+
+            counts = [
+                int(keep.sum()) if (keep := _zm.prune_blocks(
+                    s.cache, ev.sel_rpns, count=False)) is not None else c
+                for s, c in zip(slots, counts)
+            ]
         return 1.0 - sum(counts) / (len(counts) * b)
 
     def _shed_for_padding(self, slots: list[_Slot], results, errors) -> list[_Slot]:
@@ -1186,7 +1199,7 @@ class CoprReadScheduler:
     def _record_obs(self, it: _Item, ev, path: str, latency_s: float, *,
                     rows: int = 0, encoding: str = "plain",
                     occupancy: int = 1, waste: float | None = None,
-                    dispatch_t: float | None = None) -> None:
+                    dispatch_t: float | None = None, resp=None) -> None:
         """One batch-served rider into the observatory: attributed latency
         share, the queue wait it actually paid, and its own trace id as the
         profile exemplar (docs/observatory.md)."""
@@ -1197,11 +1210,13 @@ class CoprReadScheduler:
             sig = _obs.sig_id(it.sig)
         qwait = (max(dispatch_t - it.enqueue_t, 0.0)
                  if dispatch_t is not None and it.enqueue_t else 0.0)
+        prune = getattr(resp, "_obs_prune", None) or (0, 0)
         _obs.OBSERVATORY.record_serve(
             sig, path, latency_s, rows=rows, encoding=encoding,
             occupancy=occupancy, queue_wait_s=qwait, padding_waste=waste,
             trace_id=(it.trace_ctx or {}).get("trace_id"),
-            desc=getattr(ev, "obs_desc", ""))
+            desc=getattr(ev, "obs_desc", ""),
+            blocks_examined=prune[0], blocks_pruned=prune[1])
 
     def _shed(self, slot: _Slot, reason: str, results, errors,
               path: str = "xregion") -> None:

@@ -859,6 +859,20 @@ def launch_xregion_sharded(ev: JaxDagEvaluator, caches, mesh: Mesh) -> XRegionPe
                    plan=plans[r] if plans else None)
         for r, (c, a) in enumerate(zip(caches, assigns))
     ]
+    # zone-map pruning (docs/zone_maps.md): a pruned slab ships with
+    # n_valid == 0 in the metadata, so its owner device scans it as pure
+    # padding — the compile key, slab placement, and row offsets (global
+    # row ids for first-row tracking) are untouched
+    from ..copr import zone_maps as _zm
+
+    region_keeps = []
+    region_prunes = []
+    for cache in caches:
+        ps = _zm.PruneStats()
+        region_keeps.append(
+            _zm.prune_blocks(cache, ev.sel_rpns, path="mesh", stats=ps))
+        region_prunes.append((ps.examined, ps.pruned))
+
     region_offsets = []
     for cache in caches:
         nv = np.array([b.n_valid for b in cache.blocks], dtype=np.int64)
@@ -898,9 +912,12 @@ def launch_xregion_sharded(ev: JaxDagEvaluator, caches, mesh: Mesh) -> XRegionPe
                 parts_d[j].append(data[j])
             for j in range(len(nullable)):
                 parts_n[j].append(nulls[j])
+            keep_r = region_keeps[r]
             for b in idxs:
                 meta_region[di, si] = r
-                meta_nv[di, si] = cache.blocks[b].n_valid
+                meta_nv[di, si] = (
+                    0 if keep_r is not None and not keep_r[b]
+                    else cache.blocks[b].n_valid)
                 meta_off[di, si] = region_offsets[r][b]
                 si += 1
         pad = S - si
@@ -1012,7 +1029,8 @@ def launch_xregion_sharded(ev: JaxDagEvaluator, caches, mesh: Mesh) -> XRegionPe
 
     packed = fn(col_data, col_nulls, slab_region, n_valids, offsets, dl_arr,
                 ref_arr)
-    pending = XRegionPending(ev, specs, capacity, packed, order=None)
+    pending = XRegionPending(ev, specs, capacity, packed, order=None,
+                             prunes=region_prunes)
     # observatory encoding label for the riders' profiles
     pending.obs_encoding = "encoded" if plans else "plain"
     return pending

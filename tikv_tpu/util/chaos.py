@@ -737,6 +737,7 @@ def corrupt_image(cache, rng, region_id: int | None = None,
                     col.run_values[run] ^= np.int64(1) << np.int64(
                         rng.randrange(63))
                 col.purge_decoded()
+                blk.zones = None  # zone maps rebuild from the flipped bytes
                 img.block_cache.drop_device()
                 # mode="block" over an encoded column IS an encoded flip —
                 # the payload is that column's resident block plane
@@ -765,6 +766,7 @@ def corrupt_image(cache, rng, region_id: int | None = None,
                 # a sign explosion that might overflow downstream casts
                 arr.view(np.uint64)[r] ^= np.uint64(1) << np.uint64(
                     rng.randrange(63))
+            blk.zones = None  # zone maps rebuild from the flipped bytes
             img.block_cache.drop_device()
             return {"mode": "block", "region_id": key[0], "block": bi,
                     "column": ci, "row": r}
