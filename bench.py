@@ -1387,6 +1387,152 @@ def _op_overload(req, state):
         ep.scheduler.stop()
 
 
+def _op_cost_router(req, state):
+    """cost_router event (docs/cost_router.md): the self-tuning dispatch
+    loop.  Mixed workload of three plan signatures over small regions
+    under a deliberately oversized block geometry: both Q6 selections stay
+    far faster on the device even padded, but the Q1 group-by pays the
+    whole padded tile per serve and the CPU pipeline beats it.  The static
+    ladder sends all three to the device; the cost router learns per-sig
+    path costs from the observatory and routes Q1 to the CPU.  Reported:
+    router-on vs router-off aggregate throughput (floor >= 1.2x), byte
+    identity of EVERY routed response vs the CPU oracle, the chosen-path
+    distribution, and the geometry tuner's end state once it is let loose
+    on block_rows (one change in flight, warmup-discarded judgment,
+    automatic revert on floor regression)."""
+    from tikv_tpu.copr import observatory as _obs
+    from tikv_tpu.copr.costmodel import (
+        CostRouter, GeometryTuner, RouterConfig, TunerConfig,
+    )
+    from tikv_tpu.copr.endpoint import CoprRequest, Endpoint
+    from tikv_tpu.copr.table import record_key
+    from tikv_tpu.storage.btree_engine import BTreeEngine
+    from tikv_tpu.storage.engine import CF_WRITE
+    from tikv_tpu.storage.kv import LocalEngine
+    from tikv_tpu.storage.txn_types import Key, Write, WriteType
+
+    # the event measures the router LEARNING from its own warm rounds:
+    # plan signatures don't key on table size or geometry, so earlier
+    # bench ops serving the same Q1/Q6 shapes at different block
+    # geometry would leak warm (and here-misleading) path profiles into
+    # the process-global observatory
+    _obs.OBSERVATORY.reset()
+
+    regions = req.get("regions", 2)
+    rows_per = req.get("rows", 2048) // regions
+    trials = req.get("trials", 3)
+    block_rows = req.get("block_rows", 1 << 18)
+    kvs = build_kvs(regions * rows_per, seed=31)
+    eng = BTreeEngine()
+    eng.bulk_load(CF_WRITE, [
+        (Key.from_raw(rk).append_ts(20).encoded,
+         Write(WriteType.PUT, 10, short_value=v).to_bytes())
+        for rk, v in kvs
+    ])
+    dags = [lambda: _xregion_q6(10500), lambda: _xregion_q6(9000), q1_dag]
+    sig_ids = {_obs.dag_sig(d())[0] for d in dags}
+
+    def mk(region, dag_fn):
+        lo = record_key(TABLE_ID, region * rows_per)
+        hi = record_key(TABLE_ID, (region + 1) * rows_per)
+        return CoprRequest(103, dag_fn(), [(lo, hi)], 100,
+                           context={"region_id": region + 1,
+                                    "region_epoch": (1, 1), "apply_index": 7})
+
+    def sweep():
+        return [mk(r, d) for d in dags for r in range(regions)]
+
+    ep_off = Endpoint(LocalEngine(eng), enable_device=True,
+                      block_rows=block_rows,
+                      cost_router=CostRouter(enabled=False))
+    ep_on = Endpoint(LocalEngine(eng), enable_device=True,
+                     block_rows=block_rows,
+                     cost_router=CostRouter(config=RouterConfig(
+                         seed=req.get("seed", 11), epsilon=0.05,
+                         cold_probe_rate=0.05, min_count=3)))
+    ep_cpu = Endpoint(LocalEngine(eng), enable_device=False)
+
+    # warm images + compiles on both device endpoints AND run the oracle:
+    # the observatory is process-global and keyed by plan signature, so the
+    # oracle's serves ARE the cpu-path profiles the router prices against
+    for _ in range(3):
+        for q in sweep():
+            ep_off.handle_request(q)
+        for q in sweep():
+            ep_cpu.handle_request(q)
+        for q in sweep():
+            ep_on.handle_request(q)
+    oracle = [ep_cpu.handle_request(q).data for q in sweep()]
+    routed = [ep_on.handle_request(q).data for q in sweep()]
+    serial = [ep_off.handle_request(q).data for q in sweep()]
+    match = (all(r == o for r, o in zip(routed, oracle))
+             and all(s == o for s, o in zip(serial, oracle)))
+
+    off_ts, on_ts = [], []
+    for _ in range(trials):
+        reqs = sweep()
+        t0 = time.perf_counter()
+        for q in reqs:
+            ep_off.handle_request(q)
+        off_ts.append(time.perf_counter() - t0)
+        reqs = sweep()
+        t0 = time.perf_counter()
+        for q in reqs:
+            ep_on.handle_request(q)
+        on_ts.append(time.perf_counter() - t0)
+    sweep_rows = len(sweep()) * rows_per
+    off = float(np.median(off_ts))
+    on = float(np.median(on_ts))
+
+    # chosen-path distribution for OUR three signatures (the observatory
+    # carries every sig served in this process)
+    dist: dict = {}
+    for s, entry in _obs.OBSERVATORY.snapshot()["sigs"].items():
+        if s not in sig_ids:
+            continue
+        for k, v in entry.get("routes", {}).items():
+            dist[k] = dist.get(k, 0) + v
+
+    # geometry auto-tuning: hand the router-on endpoint's block geometry to
+    # the tuner and let the control loop walk it down from the deliberately
+    # bad initial value, one change in flight
+    tuner = GeometryTuner(config=TunerConfig(
+        min_serves=req.get("tuner_min_serves", 12), warmup_ticks=1))
+    tuner.register("coprocessor.block_rows",
+                   lambda: ep_on.block_rows,
+                   lambda v: ep_on.set_block_rows(int(v)),
+                   1 << 12, block_rows, integer=True)
+    initial_br = ep_on.block_rows
+    target_br = req.get("tuner_target", 1 << 14)
+    for _ in range(req.get("tuner_ticks", 30)):
+        for _ in range(3):
+            for q in sweep():
+                ep_on.handle_request(q)
+        tuner.tick()
+        if ep_on.block_rows <= target_br:
+            break
+    tuned = [ep_on.handle_request(q).data for q in sweep()]
+    match = match and all(t == o for t, o in zip(tuned, oracle))
+    tsnap = tuner.snapshot()
+    return {
+        "regions": regions,
+        "rows_per_region": rows_per,
+        "block_rows": block_rows,
+        "match": bool(match),
+        "off_ts": [round(x, 4) for x in off_ts],
+        "on_ts": [round(x, 4) for x in on_ts],
+        "speedup": round(off / on, 3) if on else 0.0,
+        "rows_per_s_off": round(sweep_rows / off, 1) if off else 0.0,
+        "rows_per_s_on": round(sweep_rows / on, 1) if on else 0.0,
+        "route_dist": dist,
+        "router": ep_on.cost_router.snapshot()["decisions_by_reason"],
+        "tuner_initial_block_rows": initial_br,
+        "tuner_final_block_rows": ep_on.block_rows,
+        "tuner_counts": tsnap["counts"],
+        "tuner_history": tsnap["history"][-8:],
+    }
+
+
 _OPS = {
     "build": _op_build,
     "warm": _op_warm,
@@ -1404,6 +1550,7 @@ _OPS = {
     "sharded_xregion": _op_sharded_xregion,
     "mixed_rw": _op_mixed_rw,
     "overload": _op_overload,
+    "cost_router": _op_cost_router,
 }
 
 
@@ -2074,6 +2221,33 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             results["overload_error"] = str(e)[:200]
             _mark("overload_error", err=str(e)[:120])
+
+    if os.environ.get("BENCH_COST_ROUTER", "1") != "0":
+        # cost-based path routing (ISSUE 17): mixed plan shapes where the
+        # static ladder picks a measurably-worse path for one of them; the
+        # router must win >= 1.2x aggregate with byte identity, and the
+        # geometry tuner must walk the deliberately bad block_rows down.
+        # In-parent on CPU — it measures dispatch policy, not device compute.
+        try:
+            r = _op_cost_router({
+                "regions": 2,
+                "rows": int(os.environ.get("BENCH_COST_ROUTER_ROWS", "2048")),
+            }, {})
+            if not r["match"]:
+                _fail("COST_ROUTER_MISMATCH")
+            results["cost_router_speedup"] = r["speedup"]
+            results["cost_router_route_dist"] = r["route_dist"]
+            results["cost_router_tuner_final_block_rows"] = \
+                r["tuner_final_block_rows"]
+            results["cost_router_tuner_counts"] = r["tuner_counts"]
+            _mark("cost_router", speedup=r["speedup"],
+                  rows_per_s_on=r["rows_per_s_on"],
+                  rows_per_s_off=r["rows_per_s_off"],
+                  tuner_final_block_rows=r["tuner_final_block_rows"],
+                  tuner_counts=r["tuner_counts"])
+        except Exception as e:  # noqa: BLE001
+            results["cost_router_error"] = str(e)[:200]
+            _mark("cost_router_error", err=str(e)[:120])
 
     if os.environ.get("BENCH_MVCC", "1") != "0":
         try:

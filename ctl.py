@@ -120,6 +120,11 @@ def main(argv=None) -> int:
              "adaptive controller's scale and evidence, and HBM partition "
              "occupancy")
     sub.add_parser(
+        "cost-router",
+        help="cost-based path router + geometry tuner view "
+             "(docs/cost_router.md): per-sig decision counts by reason, "
+             "recent routing decisions, and the tuner's knob history")
+    sub.add_parser(
         "integrity",
         help="derived-plane integrity view: per-region image fingerprints "
              "+ apply points, quarantine ledger, scrubber progress, "
@@ -421,6 +426,8 @@ def main(argv=None) -> int:
             r = c.call("debug_integrity", {})
         elif args.cmd == "overload":
             r = c.call("debug_overload", {})
+        elif args.cmd == "cost-router":
+            r = c.call("debug_cost_router", {})
         elif args.cmd == "consistency-check":
             if args.trigger:
                 req = {}

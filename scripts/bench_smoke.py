@@ -19,6 +19,13 @@ regresses:
   in a subprocess (the virtual-device flag must precede jax init).  Fails
   on byte divergence or a speedup below the 1.5x floor; per-device
   occupancy is reported.
+* ``cost_router`` (ISSUE 17): cost-based path routing + geometry
+  auto-tuning (docs/cost_router.md) — a mixed three-signature workload
+  where the static ladder sends one group-by shape to a badly padded
+  device tile the CPU pipeline beats.  Fails on byte divergence of any
+  routed response vs the CPU oracle, a router-on vs router-off aggregate
+  speedup below the 1.2x floor, or a geometry tuner that never walks the
+  deliberately bad block_rows down.
 * ``mixed_rw`` (ISSUE 4): writers commit through the txn scheduler over a
   raft group while readers serve the warm region.  Fails on byte
   divergence, a grouped-vs-per-command commit speedup below the 2x floor,
@@ -66,6 +73,7 @@ MIN_WIRE_CHUNK_SPEEDUP = 3.0
 MIN_COMPRESSED_CAPACITY = 2.0
 MIN_PRUNED_SPEEDUP = 2.0
 MIN_OVERLOAD_RETENTION = 0.5
+MIN_COST_ROUTER_SPEEDUP = 1.2
 SHARDED_DEVICES = 8
 
 
@@ -303,6 +311,36 @@ def main() -> int:
     if overload_regressions:
         ok = False
         out["overload_regression"] = "; ".join(overload_regressions)
+
+    # cost-based path routing + geometry auto-tuning (ISSUE 17): the
+    # router must beat the static ladder on the mixed workload where the
+    # ladder demonstrably picks a worse path, byte-identically, and the
+    # tuner must fix the deliberately bad block geometry
+    rr = bench._op_cost_router({
+        "regions": 2,
+        "rows": int(os.environ.get("SMOKE_COST_ROUTER_ROWS", "2048")),
+        "trials": max(args.trials, 3),
+    }, {})
+    out["cost_router_match"] = bool(rr["match"])
+    ok = ok and rr["match"]
+    out["cost_router_speedup"] = round(float(rr["speedup"]), 2)
+    out["cost_router_route_dist"] = rr["route_dist"]
+    out["cost_router_tuner_final_block_rows"] = rr["tuner_final_block_rows"]
+    out["cost_router_tuner_counts"] = rr["tuner_counts"]
+    router_regressions = []
+    if rr["speedup"] < MIN_COST_ROUTER_SPEEDUP:
+        router_regressions.append(
+            f"router-on {rr['speedup']:.2f}x < {MIN_COST_ROUTER_SPEEDUP}x floor")
+    if rr["tuner_final_block_rows"] >= rr["tuner_initial_block_rows"]:
+        router_regressions.append(
+            f"tuner never improved block_rows "
+            f"({rr['tuner_initial_block_rows']} -> "
+            f"{rr['tuner_final_block_rows']})")
+    if rr["tuner_counts"].get("keep", 0) < 1:
+        router_regressions.append("tuner kept no geometry move")
+    if router_regressions:
+        ok = False
+        out["cost_router_regression"] = "; ".join(router_regressions)
 
     # group-commit write path + warm serving under writes (ISSUE 4)
     rm = bench._op_mixed_rw({

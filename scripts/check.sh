@@ -56,4 +56,8 @@ echo "== overload smoke: tenant quotas, adaptive admission, hot-tenant flood con
 JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
   -m 'not slow' tests/test_overload.py
 
+echo "== cost-router smoke: measured routing, explore bounds, kill-switch identity, tuner convergence under the sanitizer =="
+JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
+  -m 'not slow' tests/test_cost_router.py
+
 echo "check.sh: all gates green"

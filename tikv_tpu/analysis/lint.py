@@ -85,6 +85,7 @@ _SANITIZER_WIRED = {
     "tikv_tpu/storage/txn/scheduler.py",
     "tikv_tpu/storage/concurrency_manager.py",
     "tikv_tpu/copr/breaker.py",
+    "tikv_tpu/copr/costmodel.py",
     "tikv_tpu/copr/encoding.py",
     "tikv_tpu/copr/integrity.py",
     "tikv_tpu/copr/observatory.py",

@@ -129,6 +129,35 @@ def count_rewrite(outcome: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# eligibility → candidate set (the cost router's input)
+# ---------------------------------------------------------------------------
+
+def candidate_paths(dag, *, device_ok: bool, mesh_ok: bool) -> list[str]:
+    """The eligible execution paths for ``dag``, in STATIC-LADDER order —
+    head = what today's rules pick, so a cold/killed cost router choosing
+    ``candidates[0]`` IS the pre-router behavior (docs/cost_router.md).
+
+    ``device_ok`` is the admission verdict (plan eligibility AND overload
+    AND breaker — the endpoint computes it before routing); ``mesh_ok`` is
+    whether the sharded mesh launcher would serve this request.  Zone
+    stays a *candidate* for any aggregation plan: its evaluator still
+    probes data-shape eligibility at run time and falls through to unary,
+    so routing to "zone" means "try the zone rung", exactly like the
+    static ladder does."""
+    if not device_ok:
+        return ["cpu"]
+    from .dag import Aggregation
+
+    paths: list[str] = []
+    if mesh_ok:
+        paths.append("mesh")
+    if any(isinstance(e, Aggregation) for e in dag.executors):
+        paths.append("zone")
+    paths.extend(("unary", "cpu"))
+    return paths
+
+
+# ---------------------------------------------------------------------------
 # EncodedColumn — a lazy-decoding Column variant
 # ---------------------------------------------------------------------------
 

@@ -147,6 +147,7 @@ class Endpoint:
         shadow_sample: int | None = None,
         overload=None,
         overload_config=None,
+        cost_router=None,
     ):
         from .breaker import DeviceCircuitBreaker
         from .tracker import SlowLog
@@ -244,6 +245,22 @@ class Endpoint:
                 overload_config, region_cache=self.region_cache)
         else:
             self.overload = None
+        # cost-based path router (docs/cost_router.md): picks the cheapest
+        # measured path per plan signature, bounded explore, strict static
+        # fallback.  None (the library default) means the static ladder
+        # stands untouched; the standalone server wires a default-on router
+        # (kill switch: TIKV_TPU_COST_ROUTER=0 / --no-cost-router — the
+        # router still answers, with reason="kill_switch" and the static
+        # head, byte- and path-identical to the pre-router ladder).
+        self.cost_router = cost_router
+        if cost_router is not None and cost_router.delta_sink is None:
+            # chosen-vs-best deltas feed the overload AdaptiveController so
+            # admission tightening and path choice share evidence (PR 15)
+            cost_router.delta_sink = self._note_route_delta
+        # geometry auto-tuner attach point: the standalone server parks its
+        # GeometryTuner here so /debug/cost_router shows tuner state next
+        # to the decisions it reacted to
+        self.geometry_tuner = None
 
     def _encode_response(self, resp: SelectResponse):
         """SelectResponse -> (frame parts, encode_type): the one response
@@ -376,6 +393,20 @@ class Endpoint:
 
             count_path_fallback("unary", "breaker_open")
             use_device = False
+        # cost-based routing (docs/cost_router.md) AFTER the admission
+        # gates: overload and breaker verdicts are overrides, not cost
+        # preferences — the router only picks among paths admission allows
+        route = None
+        if use_device:
+            route = self._route_for(req)
+            if route is not None and route.path == "cpu":
+                # measured: the host wins this plan shape (Tailwind-style
+                # routing around the accelerator), or a budgeted cold
+                # probe keeping the CPU profile fresh
+                from .tracker import count_path_fallback
+
+                count_path_fallback("unary", "cost_route")
+                use_device = False
         if use_device:
             cache = None
             ev = None
@@ -398,10 +429,20 @@ class Endpoint:
                 if cache is None or not cache.filled:
                     src = MvccBatchScanSource(snap, req.start_ts, req.ranges)
                 resp = None
-                if src is None and self._mesh_would_serve(req.dag):
+                want_mesh = route is None or route.path == "mesh"
+                if src is None and want_mesh and self._mesh_would_serve(req.dag):
                     resp = self._run_sharded_cached(ev, cache)
                 if resp is None:
-                    resp = ev.run(src, cache=cache)
+                    # routed zone/unary steer the evaluator's rung choice;
+                    # set/cleared around run — a concurrent mis-read only
+                    # picks a different byte-identical warm rung
+                    ev.route_hint = (route.path if route is not None
+                                     and route.path in ("zone", "unary")
+                                     else None)
+                    try:
+                        resp = ev.run(src, cache=cache)
+                    finally:
+                        ev.route_hint = None
                 parts, enc_tp = self._encode_response(resp)
                 data = None
                 from_device = True
@@ -875,6 +916,57 @@ class Endpoint:
     def set_enable_device(self, on: bool) -> None:
         """Online toggle (POST /config coprocessor.enable_device)."""
         self.enable_device = bool(on)
+
+    def set_block_rows(self, n: int) -> None:
+        """Online geometry change (POST /config coprocessor.block_rows /
+        the auto-tuner).  Evaluators pad every block to block_rows and warm
+        images were built at the old geometry, so both are dropped: the
+        next serve rebuilds at the new size.  Bounds are enforced by
+        TikvConfig.validate before this is ever called."""
+        n = int(n)
+        if n == self.block_rows:
+            return
+        self.block_rows = n
+        self._evaluators.clear()
+        self._mesh_runners.clear()
+        if self.region_cache is not None:
+            self.region_cache.block_rows = n
+            for rid in list(self.region_cache.warm_region_ids()):
+                self.region_cache.invalidate_region(rid, reason="geometry")
+
+    def _route_for(self, req: CoprRequest):
+        """Consult the cost router for this request's execution path
+        (docs/cost_router.md).  None means routing is unavailable (sig
+        walk failed) — the static ladder stands."""
+        router = self.cost_router
+        if router is None:
+            return None
+        from . import encoding as _encoding
+        from . import observatory as _obs
+
+        try:
+            sig, desc = _obs.dag_sig(req.dag)
+        except Exception:  # noqa: BLE001 — routing must not fail serving
+            return None
+        cands = _encoding.candidate_paths(
+            req.dag, device_ok=True,
+            mesh_ok=self._mesh_would_serve(req.dag))
+        return router.route(sig, cands, desc=desc)
+
+    def _note_route_delta(self, delta_ms: float, best_ms: float | None) -> None:
+        if self.overload is not None:
+            self.overload.note_route_delta(delta_ms, best_ms)
+
+    def cost_router_snapshot(self) -> dict:
+        """The ``/debug/cost_router`` + ``ctl.py cost-router`` view: router
+        decision counts/ring and the geometry tuner's knobs, in-flight
+        change, and keep/revert history."""
+        if self.cost_router is None:
+            return {"enabled": False, "wired": False}
+        out = {"router": self.cost_router.snapshot()}
+        if self.geometry_tuner is not None:
+            out["tuner"] = self.geometry_tuner.snapshot()
+        return out
 
     def _gate_ok(self, what: str) -> bool:
         if self.feature_gate is None:

@@ -760,6 +760,11 @@ class JaxDagEvaluator:
         # consults it before running and reports its outcome, so repeated
         # zone faults trip to the generic warm path instead of re-crashing
         self.breaker = breaker
+        # cost-router steering (docs/cost_router.md): "unary" skips the
+        # zone probe for this run; set/cleared around run() by the
+        # endpoint — a concurrent mis-read only picks a different
+        # byte-identical warm rung
+        self.route_hint: str | None = None
         scan = self.plan.scan
         self.schema = [(c.ftype.eval_type, c.ftype.decimal) for c in scan.columns_info]
         self.decoder = (
@@ -998,7 +1003,7 @@ class JaxDagEvaluator:
         blocks = cache.blocks
         n_blocks = len(blocks)
 
-        zone_resp = self._try_zone(cache)
+        zone_resp = None if self.route_hint == "unary" else self._try_zone(cache)
         if zone_resp is not None:
             # observatory path marker (docs/observatory.md): the endpoint
             # reads which warm rung actually served, per response

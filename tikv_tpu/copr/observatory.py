@@ -263,12 +263,14 @@ class _Profile:
 
 
 class _SigEntry:
-    __slots__ = ("desc", "paths", "last_used")
+    __slots__ = ("desc", "paths", "last_used", "routes")
 
     def __init__(self, desc: str, now: float):
         self.desc = desc
         self.paths: dict[tuple[str, str], _Profile] = {}
         self.last_used = now
+        # cost-router decisions for this sig: (path, reason) -> count
+        self.routes: dict[tuple[str, str], int] = {}
 
 
 class Observatory:
@@ -367,6 +369,79 @@ class Observatory:
             "tikv_observatory_decline_total",
             "Path declines/sheds recorded by the observatory, by path and cause",
         ).inc(path=path, cause=cause)
+
+    def record_route(self, sig: str, path: str, reason: str,
+                     desc: str = "") -> None:
+        """One cost-router decision for ``sig`` (docs/cost_router.md):
+        which path won and why (measured / explore / cold / static_fallback
+        / kill_switch).  Kept per-sig so ``format_sig`` shows decisions next
+        to the measured profiles they came from."""
+        if not self.enabled or not sig:
+            return
+        now = time.monotonic()
+        with self._mu:
+            entry = self._touch_locked(sig, desc, now)
+            key = (path, reason)
+            entry.routes[key] = entry.routes.get(key, 0) + 1
+
+    def path_costs(self, sig: str, amortize_floor: int = 1) -> dict[str, dict]:
+        """Per-path cost view for the router: merge this sig's encodings
+        per path label (highest window count wins — the encoding actually
+        serving now), and fold the compile ledger's amortized cost in.
+        ``cost_ms`` is the router's scalar: windowed p50 latency (the
+        median is robust to the compile-laden first serve, which would
+        otherwise double-count compile — it is already in the ledger) plus
+        the sig's compile wall time amortized over its lifetime serves —
+        ``amortize_floor`` caps the penalty for freshly compiled paths by
+        assuming at least that many serves will share the compile (without
+        it a just-compiled device path prices above the CPU pipeline until
+        enough traffic has drained, and explore-rate trickle never
+        un-sticks it)."""
+        with self._mu:
+            entry = self._sigs.get(sig)
+            views: dict[str, dict] = {}
+            if entry is not None:
+                for (p, _e), prof in entry.paths.items():
+                    v = prof.view()
+                    if p in views and views[p]["count"] >= v["count"]:
+                        continue
+                    views[p] = v
+            agg = {p: dict(a) for (s, p), a in self._compile_agg.items()
+                   if s == sig}
+        out: dict[str, dict] = {}
+        for p, v in views.items():
+            compile_ms = 0.0
+            a = agg.get(p)
+            if a and v["total_count"]:
+                compile_ms = (a["wall_s"] * 1e3
+                              / max(v["total_count"], amortize_floor))
+            out[p] = {
+                "count": v["count"],
+                "total_count": v["total_count"],
+                "mean_ms": v["mean_ms"],
+                "p50_ms": v["p50_ms"],
+                "p95_ms": v["p95_ms"],
+                "rows_per_s": v["rows_per_s"],
+                "queue_wait_ms_mean": v["queue_wait_ms_mean"],
+                "mean_occupancy": v["mean_occupancy"],
+                "compile_amortized_ms": round(compile_ms, 4),
+                "cost_ms": round(v["p50_ms"] + compile_ms, 4),
+            }
+        return out
+
+    def totals(self) -> dict:
+        """Lifetime aggregate across every live sig/path — the geometry
+        tuner's throughput probe: deltas of (rows, busy seconds, serves)
+        between ticks are robust to window aging, unlike windowed rates."""
+        with self._mu:
+            count = rows = 0
+            lat = 0.0
+            for entry in self._sigs.values():
+                for prof in entry.paths.values():
+                    count += prof.total_count
+                    rows += prof.total_rows
+                    lat += prof.total_lat
+        return {"serves": count, "rows": rows, "busy_s": round(lat, 6)}
 
     def _touch_locked(self, sig: str, desc: str, now: float) -> _SigEntry:
         entry = self._sigs.pop(sig, None)
@@ -470,6 +545,10 @@ class Observatory:
                         for (p, e), prof in entry.paths.items()
                     },
                 }
+                if entry.routes:
+                    sigs[s]["routes"] = {
+                        f"{p}|{r}": n for (p, r), n in entry.routes.items()
+                    }
             compiles = list(self._compiles) if sig is None else [
                 ev for ev in self._compiles if ev.get("sig") == sig]
             compile_agg = {
@@ -729,6 +808,10 @@ def format_sig(sig: str, entry: dict) -> str:
             lines.append(f"    declines: {v['declines']}")
         if v.get("exemplar_traces"):
             lines.append(f"    exemplars: {', '.join(v['exemplar_traces'])}")
+    routes = entry.get("routes")
+    if routes:
+        pairs = ", ".join(f"{k}={n}" for k, n in sorted(routes.items()))
+        lines.append(f"  routes: {pairs}")
     return "\n".join(lines)
 
 

@@ -131,6 +131,10 @@ class OverloadConfig:
     #: observatory evidence: a profiled p99 this multiple over its learned
     #: floor is pressure (docs/observatory.md)
     p99_ratio: float = 3.0
+    #: cost-router evidence (docs/cost_router.md): chosen-vs-best path
+    #: deltas summing past this fraction of the best-path cost in one
+    #: window is pressure — serving is persistently off its cheapest path
+    route_waste_ratio: float = 0.5
     #: per-tenant HBM partition byte budgets pushed onto the region cache
     tenant_hbm_budgets: dict = field(default_factory=dict)
 
@@ -319,6 +323,9 @@ class AdaptiveController:
         # (sig, path, encoding) -> lowest p99_ms ever profiled: the floor
         # current windows are judged against
         self._p99_floor: dict[tuple, float] = {}
+        # cost-router chosen-vs-best evidence accumulated this window:
+        # [delta_ms sum, best_ms sum, samples] (docs/cost_router.md)
+        self._route = [0.0, 0.0, 0]
         self.actions = {"tighten": 0, "relax": 0, "hold": 0}
         self.last_evidence: dict = {}
 
@@ -340,6 +347,15 @@ class AdaptiveController:
             if len(self._w) > 4096:
                 del self._w[:-2048]
 
+    def note_route_delta(self, delta_ms: float, best_ms: float | None) -> None:
+        """One cost-router decision's chosen-vs-best gap: overload
+        tightening and path choice share evidence — persistent routing
+        waste reads as saturation just like tail latency does."""
+        with self._mu:
+            self._route[0] += max(delta_ms, 0.0)
+            self._route[1] += max(best_ms or 0.0, 0.0)
+            self._route[2] += 1
+
     def queue_cap(self, cap: int) -> int:
         """The scheduler's EFFECTIVE queue threshold under pressure: the
         configured cap scaled down with the bucket rates, so backpressure
@@ -359,12 +375,19 @@ class AdaptiveController:
         with self._mu:
             q, self._q = self._q, []
             w, self._w = self._w, []
+            rt, self._route = self._route, [0.0, 0.0, 0]
             q_frac = sum(q) / len(q) if q else 0.0
             wait_bad = bool(w) and max(w) > max(self.cfg.max_wait_s, 0.01) * 4
+            # route waste alone signals "wrong path", not saturation — it
+            # only contributes evidence when queues back it up, vetoing the
+            # relax branch instead of forcing a tighten
+            route_bad = (rt[2] >= 8 and rt[1] > 0
+                         and rt[0] > self.cfg.route_waste_ratio * rt[1])
             if q_frac >= self.cfg.queue_high_frac or wait_bad or p99_bad:
                 action = "tighten"
                 self.scale = max(self.cfg.min_scale, self.scale * 0.5)
-            elif q_frac <= self.cfg.queue_low_frac and not p99_bad:
+            elif (q_frac <= self.cfg.queue_low_frac and not p99_bad
+                    and not route_bad):
                 action = "relax" if self.scale < 1.0 else "hold"
                 self.scale = min(1.0, max(self.scale * 1.5, self.scale + 0.05))
             else:
@@ -376,6 +399,9 @@ class AdaptiveController:
                 "wait_pressure": wait_bad,
                 "p99_pressure": p99_bad,
                 "p99_detail": p99_detail,
+                "route_pressure": route_bad,
+                "route_waste": (round(rt[0] / rt[1], 3) if rt[1] else 0.0),
+                "route_samples": rt[2],
                 "scale": round(self.scale, 3),
             }
         from ..util.metrics import REGISTRY
@@ -556,6 +582,10 @@ class OverloadControl:
         if self.cfg.enabled and self.cfg.adaptive:
             self.controller.note_wait(wait_s)
 
+    def note_route_delta(self, delta_ms: float, best_ms: float | None) -> None:
+        if self.cfg.enabled and self.cfg.adaptive:
+            self.controller.note_route_delta(delta_ms, best_ms)
+
     def queue_cap(self, cap: int) -> int:
         if self.cfg.enabled and self.cfg.adaptive:
             return self.controller.queue_cap(cap)
@@ -596,6 +626,8 @@ class OverloadControl:
                 self.cfg.min_scale = float(value)
             elif key == "window_s":
                 self.cfg.window_s = float(value)
+            elif key == "route_waste_ratio":
+                self.cfg.route_waste_ratio = float(value)
 
     def snapshot(self) -> dict:
         """The ``/debug/overload`` + ``ctl.py overload`` view: per-tenant

@@ -260,6 +260,20 @@ class CoprReadScheduler:
         self._supports: dict[tuple, bool] = {}
         self._evs: dict[tuple, object] = {}
 
+    def reconfigure(self, changed: dict) -> None:
+        """Online scheduler geometry (POST /config ``coprocessor.*`` via
+        the ConfigController, and the geometry auto-tuner): the per-lane
+        linger windows.  Values were validated by ``TikvConfig.validate``
+        before dispatch; lanes read ``cfg.wait_for`` per pass, so changes
+        apply on the next dispatch decision."""
+        for key, value in changed.items():
+            if key == "max_wait_s":
+                self.cfg.max_wait_s = float(value)
+            elif key == "high_max_wait_s":
+                self.cfg.high_max_wait_s = float(value)
+            elif key == "low_max_wait_s":
+                self.cfg.low_max_wait_s = float(value)
+
     # -- synchronous entry (endpoint.handle_batch / batch_coprocessor) -----
 
     def run_batch(self, reqs: list[CoprRequest], *, return_errors: bool = False):
@@ -596,6 +610,13 @@ class CoprReadScheduler:
         leftovers: list[_Item] = []
         for sig, slots in by_sig.items():
             if len(slots) >= 2:
+                if not self._route_batch(sig):
+                    # cost-routed (docs/cost_router.md): the measured
+                    # per-request path beats the cross-region batch for
+                    # this plan shape — serve the slots directly
+                    for slot in slots.values():
+                        rest.extend(slot.items)
+                    continue
                 slot_list = list(slots.values())
                 for s in range(0, len(slot_list), self.cfg.max_batch):
                     exec_groups.append(("xregion", sig,
@@ -637,6 +658,28 @@ class CoprReadScheduler:
         for it in rest:
             self._per_request(it, results, errors, kind="direct")
         return results, errors
+
+    def _route_batch(self, sig: tuple) -> bool:
+        """Cost-route one sig's micro-batch (docs/cost_router.md):
+        measured "xregion" against a synthetic "direct" = the best
+        per-request path this sig has profiles for.  True keeps the batch
+        (the static choice, and the kill-switch/cold answer); False sends
+        the slots to per-request serving."""
+        router = getattr(self.ep, "cost_router", None)
+        if router is None or not router.enabled:
+            return True  # killed router must cost the dispatch loop nothing
+        from . import observatory as _obs
+
+        sid = _obs.sig_id(sig)
+        costs = router.obs.path_costs(sid)
+        table = {}
+        if "xregion" in costs:
+            table["xregion"] = costs["xregion"]
+        direct = [c for p, c in costs.items() if p != "xregion"]
+        if direct:
+            table["direct"] = min(direct, key=lambda c: c["cost_ms"])
+        d = router.route(sid, ["xregion", "direct"], costs=table)
+        return d.path != "direct"
 
     # -- eligibility & keying ----------------------------------------------
 

@@ -1076,6 +1076,16 @@ class KvService:
             return {"enabled": False, "wired": False}
         return ov.snapshot()
 
+    def debug_cost_router(self, req: dict) -> dict:
+        """Cost-router + geometry-tuner state (docs/cost_router.md;
+        ``ctl.py cost-router`` and the status server's
+        ``/debug/cost_router``): decision counts by reason, the recent
+        decision ring, and the tuner's knobs / in-flight change /
+        keep-revert history."""
+        if self.copr is None:
+            return {"enabled": False, "wired": False}
+        return self.copr.cost_router_snapshot()
+
     def debug_traces(self, req: dict) -> dict:
         """Recent + slow traces from the process tracer (docs/tracing.md):
         the ``ctl.py trace`` surface.  ``trace_id`` narrows to one trace;

@@ -103,6 +103,7 @@ class StoreServer:
         overload_rps: float = 0.0,
         overload_read_bps: float = 0.0,
         overload_max_priority: str = "high",
+        cost_router: bool = True,
     ):
         self.pd = pd
         self.security = security
@@ -170,6 +171,10 @@ class StoreServer:
         self.storage = Storage(engine=self.raftkv,
                                group_commit_max=16 if group_commit else 1)
         mesh = _default_mesh() if enable_device else None
+        # cost-based path routing (docs/cost_router.md): --no-cost-router
+        # forces the kill switch regardless of TIKV_TPU_COST_ROUTER
+        from ..copr.costmodel import CostRouter, GeometryTuner
+
         self.copr = Endpoint(
             self.raftkv, enable_device=enable_device,
             mesh=mesh,
@@ -178,6 +183,8 @@ class StoreServer:
             write_through=write_through,
             encode_columns=encode_columns,
             shadow_sample=shadow_sample,
+            cost_router=(CostRouter() if cost_router
+                         else CostRouter(enabled=False)),
         )
         # overload control plane (docs/robustness.md "Overload"): always
         # CONSTRUCTED — so POST /config overload.enabled=true turns it on
@@ -342,12 +349,55 @@ class StoreServer:
         # — quota rates retune live, admission flips on/off at runtime
         self.config_controller.register(
             "overload", self.overload.reconfigure)
-        # online device knob: POST /config {"coprocessor.enable_device": x}
-        self.config_controller.register(
-            "coprocessor",
-            lambda changed: self.copr.set_enable_device(changed["enable_device"])
-            if "enable_device" in changed else None,
-        )
+        # online coprocessor knobs: POST /config {"coprocessor.enable_device":
+        # x, "coprocessor.block_rows": n, "coprocessor.max_wait_s": s} —
+        # device toggle, block geometry (drops evaluators + warm images so
+        # the next serve rebuilds at the new size), and the scheduler's
+        # per-lane linger windows (docs/cost_router.md)
+
+        def _copr_changed(changed: dict) -> None:
+            if "enable_device" in changed:
+                self.copr.set_enable_device(changed["enable_device"])
+            if "block_rows" in changed:
+                self.copr.set_block_rows(changed["block_rows"])
+            waits = {k: v for k, v in changed.items()
+                     if k in ("max_wait_s", "high_max_wait_s",
+                              "low_max_wait_s")}
+            if waits:
+                self.copr.scheduler.reconfigure(waits)
+
+        self.config_controller.register("coprocessor", _copr_changed)
+        # geometry auto-tuner (docs/cost_router.md): hill-climbs block_rows
+        # and the normal-lane linger from measured throughput — ONE change
+        # in flight, applied through the SAME validated POST /config path
+        # operators use, auto-reverted on a throughput floor regression
+        tuner = GeometryTuner(enabled=self.copr.cost_router.enabled
+                              and enable_device)
+        tuner.register(
+            "coprocessor.block_rows",
+            lambda: self.config_controller.config.coprocessor.block_rows,
+            lambda v: self.config_controller.update(
+                {"coprocessor.block_rows": int(v)}),
+            1 << 8, 1 << 20, integer=True)
+        tuner.register(
+            "coprocessor.max_wait_s",
+            lambda: self.config_controller.config.coprocessor.max_wait_s,
+            lambda v: self.config_controller.update(
+                {"coprocessor.max_wait_s": float(v)}),
+            0.0005, 0.05)
+        self.copr.geometry_tuner = tuner
+        self._tuner_stop = threading.Event()
+
+        def _tuner_loop(interval=float(os.environ.get(
+                "TIKV_TPU_TUNER_INTERVAL", "30"))):
+            while not self._tuner_stop.wait(interval):
+                try:
+                    self.copr.geometry_tuner.tick()
+                except Exception:  # noqa: BLE001 — next tick retries
+                    pass
+
+        self._tuner_thread = threading.Thread(target=_tuner_loop, daemon=True,
+                                              name="geometry-tuner")
         # online tracing knobs (docs/tracing.md): POST /config
         # {"trace.sample_rate": r} — the ctl.py `trace set-sample-rate` path
 
@@ -370,6 +420,9 @@ class StoreServer:
             # overload control plane: per-tenant buckets, controller scale,
             # HBM partition occupancy (docs/robustness.md "Overload")
             overload=lambda: self.service.debug_overload({}),
+            # cost-router decisions + geometry tuner state
+            # (docs/cost_router.md)
+            cost_router=lambda: self.service.debug_cost_router({}),
         )
         self.service = KvService(
             self.storage,
@@ -473,6 +526,7 @@ class StoreServer:
         self.status_server.start()
         self._ttl_thread.start()
         self._rts_thread.start()
+        self._tuner_thread.start()
         self.pd.put_store(self.store.store_id, addr=self.server.addr)
         self.node.start()
 
@@ -509,6 +563,7 @@ class StoreServer:
         self.copr.scheduler.stop()
         self._ttl_stop.set()
         self._rts_stop.set()
+        self._tuner_stop.set()
         # the advance thread inserts into _peer_clients: join it BEFORE
         # closing/iterating the clients
         if self._rts_thread.is_alive():
@@ -582,6 +637,11 @@ def main(argv=None) -> int:
                     help="distributed-tracing head sample rate in [0,1] "
                          "(default 0.01 or TIKV_TPU_TRACE_SAMPLE; 0 turns "
                          "the tracing plane off; docs/tracing.md)")
+    ap.add_argument("--no-cost-router", action="store_true",
+                    help="kill switch for cost-based path routing + the "
+                         "geometry auto-tuner: serve with the static rule "
+                         "ladder exactly (docs/cost_router.md; equivalent "
+                         "to TIKV_TPU_COST_ROUTER=0)")
     ap.add_argument("--no-raft-engine", action="store_true",
                     help="keep the raft log in CF_RAFT instead of the segmented log engine")
     ap.add_argument("--ca-path", default="")
@@ -627,6 +687,7 @@ def main(argv=None) -> int:
         overload_rps=args.overload_rps,
         overload_read_bps=args.overload_read_bps,
         overload_max_priority=args.overload_max_priority,
+        cost_router=not args.no_cost_router,
     )
     srv.start()
     srv.bootstrap_or_join(args.expect_stores)

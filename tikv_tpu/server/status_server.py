@@ -22,7 +22,7 @@ from .profiler import Profiler
 class StatusServer:
     def __init__(self, controller: ConfigController | None = None, host="127.0.0.1", port=0, registry=None,
                  security=None, memory_trace=None, read_progress=None,
-                 integrity=None, overload=None):
+                 integrity=None, overload=None, cost_router=None):
         self.controller = controller
         self.security = security
         self.registry = registry or REGISTRY
@@ -37,6 +37,9 @@ class StatusServer:
         # callable returning the overload-control view (docs/robustness.md
         # "Overload"): tenant buckets, controller scale, HBM partitions
         self.overload = overload
+        # callable returning the cost-router + geometry-tuner view
+        # (docs/cost_router.md): decision counts/ring, tuner history
+        self.cost_router = cost_router
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -130,7 +133,25 @@ class StatusServer:
                         f"compiles={len(comp['events'])}",
                         obs.format_top(obs.OBSERVATORY.top(limit)),
                     ])
+                    declines = self._decline_lines()
+                    if declines:
+                        body += "\n-- device-plan declines --\n" + "\n".join(declines)
                 self._send(200, body.encode())
+
+            @staticmethod
+            def _decline_lines() -> list[str]:
+                # per-cause device-plan decline counts, next to the path
+                # profiles: why the encoded path keeps falling back matters
+                # when reading the cost router's cold/explore decisions
+                c = outer.registry.counter(
+                    "tikv_coprocessor_encoded_decline_total",
+                    "Encoded-path declines (decode-ship / CPU), by path and cause")
+                with c._mu:
+                    items = sorted(c._values.items())
+                return [
+                    "  " + " ".join(f"{k}={v}" for k, v in key) + f": {int(n)}"
+                    for key, n in items
+                ]
 
             def do_GET(self):
                 url = urlparse(self.path)
@@ -191,6 +212,15 @@ class StatusServer:
                         self._send(404, b"no overload control wired")
                         return
                     self._send(200, json.dumps(outer.overload()).encode(),
+                               "application/json")
+                elif url.path == "/debug/cost_router":
+                    # cost-based path router + geometry tuner: per-sig
+                    # decision counts, recent decisions, tuner knob history
+                    # (docs/cost_router.md)
+                    if outer.cost_router is None:
+                        self._send(404, b"no cost router wired")
+                        return
+                    self._send(200, json.dumps(outer.cost_router()).encode(),
                                "application/json")
                 elif url.path == "/debug/memory":
                     # the store's memory-attribution tree (MemoryTrace)

@@ -75,6 +75,12 @@ class CoprocessorConfig:
     region_split_keys: int = 960000
     region_max_keys: int = 1440000
     cache_entries: int = 64
+    # scheduler per-lane linger windows (docs/copr_scheduler.md) — online
+    # through POST /config, and the geometry auto-tuner's hill-climb knobs
+    # (docs/cost_router.md)
+    max_wait_s: float = 0.004
+    high_max_wait_s: float = 0.001
+    low_max_wait_s: float = 0.02
 
 
 @dataclass
@@ -185,6 +191,14 @@ class TikvConfig:
             raise ValueError("security.redact_info_log must be off|on|marker")
         if self.coprocessor.block_rows <= 0 or self.coprocessor.block_rows & (self.coprocessor.block_rows - 1):
             raise ValueError("coprocessor.block_rows must be a power of two")
+        if not (1 << 8) <= self.coprocessor.block_rows <= (1 << 20):
+            # the auto-tuner's hill-climb bounds double as operator sanity
+            raise ValueError(
+                "coprocessor.block_rows must be in [2^8, 2^20]")
+        for name in ("max_wait_s", "high_max_wait_s", "low_max_wait_s"):
+            v = getattr(self.coprocessor, name)
+            if not 0.0 < v <= 1.0:
+                raise ValueError(f"coprocessor.{name} must be in (0, 1.0]")
         if self.storage.scheduler_concurrency <= 0:
             raise ValueError("storage.scheduler_concurrency must be positive")
         if self.coprocessor.region_split_keys > self.coprocessor.region_max_keys:
