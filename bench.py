@@ -707,6 +707,94 @@ def _op_scan_pruned(req, state):
     return out
 
 
+def _op_join(req, state):
+    """join event (docs/device_join.md): an equi-join of a probe region
+    against a second warm build region, served on the device rank and hash
+    paths (forced via the path override) vs the CPU join pipeline.  Keys
+    are low-cardinality dict strings so BOTH device paths are feasible on
+    one fixture; build-side multiplicity is fixed at 4 so the output stays
+    ~2x the probe rows.  Every serve is byte-checked against the CPU
+    oracle — a divergence is a correctness failure, not noise."""
+    from tikv_tpu.copr import jax_join
+    from tikv_tpu.copr.dag import DagRequest, Join, TableScan
+    from tikv_tpu.copr.datatypes import ColumnInfo, FieldType
+    from tikv_tpu.copr.endpoint import CoprRequest, Endpoint
+    from tikv_tpu.copr.table import encode_row, record_key, record_range
+    from tikv_tpu.storage.btree_engine import BTreeEngine
+    from tikv_tpu.storage.engine import CF_WRITE
+    from tikv_tpu.storage.kv import LocalEngine
+    from tikv_tpu.storage.txn_types import Key, Write, WriteType
+    from tikv_tpu.util.metrics import REGISTRY
+
+    n = req["rows"]
+    trials = req.get("trials", 3)
+    distinct = max(64, n // 16)          # dict-eligible on both images
+    nb = 4 * distinct                    # build multiplicity = 4
+    pool = [b"k%06d" % i for i in range(2 * distinct)]  # half match
+    cols = [ColumnInfo(1, FieldType.int64(), is_pk_handle=True),
+            ColumnInfo(2, FieldType.varchar()),
+            ColumnInfo(3, FieldType.int64())]
+    rng = np.random.default_rng(23)
+
+    def rows_for(tid, count, keys):
+        picks = rng.integers(0, len(keys), size=count)
+        pay = rng.integers(0, 1 << 20, size=count)
+        return [
+            (Key.from_raw(record_key(tid, i)).append_ts(20).encoded,
+             Write(WriteType.PUT, 10, short_value=encode_row(
+                 cols[1:], [keys[int(picks[i])], int(pay[i])])).to_bytes())
+            for i in range(count)
+        ]
+
+    probe_tid, build_tid = TABLE_ID, TABLE_ID + 1
+    eng = BTreeEngine()
+    eng.bulk_load(CF_WRITE, rows_for(probe_tid, n, pool) +
+                  rows_for(build_tid, nb, pool[:distinct]))
+    le = LocalEngine(eng)
+    ep_warm = Endpoint(le, enable_device=True)
+    ep_cpu = Endpoint(le, enable_device=False, enable_region_cache=False)
+
+    def mk():
+        dag = DagRequest(executors=[
+            TableScan(probe_tid, cols),
+            Join([TableScan(build_tid, cols)], [record_range(build_tid)],
+                 1, 1, join_type="inner",
+                 build_context={"region_id": 2, "region_epoch": (1, 1),
+                                "apply_index": 7}),
+        ])
+        return CoprRequest(103, dag, [record_range(probe_tid)], 100,
+                           context={"region_id": 1, "region_epoch": (1, 1),
+                                    "apply_index": 7})
+
+    oracle = ep_cpu.handle_request(mk()).data
+    out = {"match": True, "probe_rows": n, "build_rows": nb}
+    ts = {"rank": [], "hash": [], "cpu": []}
+    try:
+        for path in ("rank", "hash"):   # fill images + compile both paths
+            jax_join.set_path_override(path)
+            r = ep_warm.handle_request(mk())
+            out["match"] &= r.data == oracle and r.from_device
+        for _ in range(trials):
+            for path in ("rank", "hash"):
+                jax_join.set_path_override(path)
+                t0 = time.perf_counter()
+                r = ep_warm.handle_request(mk())
+                ts[path].append(time.perf_counter() - t0)
+                out["match"] &= r.data == oracle and r.from_device
+            t0 = time.perf_counter()
+            rc = ep_cpu.handle_request(mk())
+            ts["cpu"].append(time.perf_counter() - t0)
+            out["match"] &= rc.data == oracle
+    finally:
+        jax_join.set_path_override(None)
+    c = REGISTRY.counter("tikv_coprocessor_join_total", "")
+    out["served"] = {p: int(c.get(path=p, outcome="served"))
+                    for p in ("rank", "hash")}
+    for p, v in ts.items():
+        out[f"{p}_ts"] = [round(x, 4) for x in v]
+    return out
+
+
 def _xregion_q6(cut: int):
     """A Q6-shaped selection+aggregation (no group-by): the dispatch-bound
     serving shape where cross-region batching pays off on every backend."""
@@ -1544,6 +1632,7 @@ _OPS = {
     "region_cache": _op_region_cache,
     "scan_compressed": _op_scan_compressed,
     "scan_pruned": _op_scan_pruned,
+    "join": _op_join,
     "xregion": _op_xregion,
     "wire": _op_wire,
     "wire_chunk": _op_wire_chunk,
@@ -2199,6 +2288,29 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             results["scan_pruned_error"] = str(e)[:200]
             _mark("scan_pruned_error", err=str(e)[:120])
+
+    if os.environ.get("BENCH_JOIN", "1") != "0":
+        # device-resident join (ISSUE 18): rank/hash device joins over two
+        # warm region images vs the CPU join pipeline, byte-checked per
+        # trial.  In-parent on CPU — it measures the join serving path,
+        # not device compute.
+        try:
+            r = _op_join({
+                "rows": int(os.environ.get("BENCH_JOIN_ROWS", "40000")),
+            }, {})
+            if not r["match"]:
+                _fail("JOIN_MISMATCH")
+            cpu = float(np.median(r["cpu_ts"]))
+            for p in ("rank", "hash"):
+                results[f"join_{p}_speedup"] = round(
+                    cpu / float(np.median(r[f"{p}_ts"])), 2)
+            results["join_served"] = r["served"]
+            _mark("join", rank=results["join_rank_speedup"],
+                  hash=results["join_hash_speedup"],
+                  probe_rows=r["probe_rows"], build_rows=r["build_rows"])
+        except Exception as e:  # noqa: BLE001
+            results["join_error"] = str(e)[:200]
+            _mark("join_error", err=str(e)[:120])
 
     if os.environ.get("BENCH_OVERLOAD", "1") != "0":
         # overload control plane (ISSUE 15): well-behaved-tenant throughput

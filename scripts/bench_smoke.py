@@ -26,6 +26,11 @@ regresses:
   routed response vs the CPU oracle, a router-on vs router-off aggregate
   speedup below the 1.2x floor, or a geometry tuner that never walks the
   deliberately bad block_rows down.
+* ``join`` (ISSUE 18): device-resident join (docs/device_join.md) — an
+  equi-join of a probe region against a second warm build region on the
+  rank and hash device paths vs the CPU join pipeline, byte-checked per
+  trial.  Fails on byte divergence, a rank-vs-CPU speedup below the 2x
+  floor, or zero device-served joins.
 * ``mixed_rw`` (ISSUE 4): writers commit through the txn scheduler over a
   raft group while readers serve the warm region.  Fails on byte
   divergence, a grouped-vs-per-command commit speedup below the 2x floor,
@@ -74,6 +79,7 @@ MIN_COMPRESSED_CAPACITY = 2.0
 MIN_PRUNED_SPEEDUP = 2.0
 MIN_OVERLOAD_RETENTION = 0.5
 MIN_COST_ROUTER_SPEEDUP = 1.2
+MIN_JOIN_SPEEDUP = 2.0
 SHARDED_DEVICES = 8
 
 
@@ -341,6 +347,32 @@ def main() -> int:
     if router_regressions:
         ok = False
         out["cost_router_regression"] = "; ".join(router_regressions)
+
+    # device-resident join (ISSUE 18): the rank path over two warm images
+    # must beat the CPU join pipeline ≥2x with byte identity every trial;
+    # the hash path is reported (no floor — int-keyed probes pay the same
+    # kernels but a different table build)
+    rj = bench._op_join({
+        "rows": int(os.environ.get("SMOKE_JOIN_ROWS", "20000")),
+        "trials": max(args.trials, 3),
+    }, {})
+    out["join_match"] = bool(rj["match"])
+    ok = ok and rj["match"]
+    j_cpu = float(np.median(rj["cpu_ts"]))
+    jspeed = j_cpu / float(np.median(rj["rank_ts"]))
+    out["join_rank_speedup"] = round(jspeed, 2)
+    out["join_hash_speedup"] = round(
+        j_cpu / float(np.median(rj["hash_ts"])), 2)
+    out["join_served"] = rj["served"]
+    join_regressions = []
+    if jspeed < MIN_JOIN_SPEEDUP:
+        join_regressions.append(
+            f"rank {jspeed:.2f}x < {MIN_JOIN_SPEEDUP}x floor")
+    if rj["served"]["rank"] <= 0 or rj["served"]["hash"] <= 0:
+        join_regressions.append("a device join path never served")
+    if join_regressions:
+        ok = False
+        out["join_regression"] = "; ".join(join_regressions)
 
     # group-commit write path + warm serving under writes (ISSUE 4)
     rm = bench._op_mixed_rw({

@@ -60,4 +60,8 @@ echo "== cost-router smoke: measured routing, explore bounds, kill-switch identi
 JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
   -m 'not slow' tests/test_cost_router.py
 
+echo "== device-join smoke: rank/hash join differential pool, no-decode survivors, decline causes under the sanitizer =="
+JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
+  -m 'not slow' tests/test_device_join.py
+
 echo "check.sh: all gates green"

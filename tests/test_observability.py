@@ -85,6 +85,7 @@ LAZY_SERIES = {
     "tikv_coprocessor_encoded_decline_total",
     "tikv_coprocessor_encoded_rewrite_total",
     "tikv_coprocessor_zone_prune_total",
+    "tikv_coprocessor_join_total",
     "tikv_coprocessor_cost_route_total",
     "tikv_coprocessor_cost_route_delta_ms_total",
     "tikv_coprocessor_geometry_tune_total",

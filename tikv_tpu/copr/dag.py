@@ -4,7 +4,8 @@ Re-expression of tipb's ``DagRequest``/executor descriptors and the
 ``BatchExecutorsRunner`` (``tidb_query_executors/src/runner.rs:41``):
 
 * descriptors (dataclasses standing in for the tipb protos) describe the
-  executor chain: scan leaf → selection → aggregation/topN → limit
+  executor chain: scan leaf → selection/join/projection → aggregation/topN
+  → limit (joins carry their build-side chain inline — docs/device_join.md)
 * ``build_executors`` (runner.rs:150) assembles the chain
 * ``handle_request`` (runner.rs:399) drives ``next_batch`` with the 32→×2→1024
   growing batch size and encodes output rows into datum-encoded chunks
@@ -32,7 +33,9 @@ from .executors import (
     BatchExecutor,
     BatchHashAggregationExecutor,
     BatchIndexScanExecutor,
+    BatchJoinExecutor,
     BatchLimitExecutor,
+    BatchProjectionExecutor,
     BatchSelectionExecutor,
     BatchSimpleAggregationExecutor,
     BatchStreamAggregationExecutor,
@@ -84,7 +87,41 @@ class Limit:
     limit: int
 
 
-ExecutorDescriptor = TableScan | IndexScan | Selection | Aggregation | TopN | Limit
+@dataclass
+class Projection:
+    """Expression list over the child schema (tipb::Projection equivalent).
+
+    Output columns are the evaluated expressions in order — the schema the
+    downstream chain (and the response encoder) sees is
+    ``[(expr.eval_type, expr.frac), ...]``."""
+
+    exprs: list[Expr]
+
+
+@dataclass
+class Join:
+    """Equi-join against a second executor chain (tipb::Join equivalent).
+
+    The enclosing chain below this descriptor is the PROBE side; ``build``
+    is the build side's own chain (a TableScan leaf plus optional
+    Selections) scanned over ``build_ranges``.  Output schema is the probe
+    schema followed by the build schema.  ``left_key``/``right_key`` are
+    column offsets into the probe/build child schemas; ``join_type`` is
+    ``"inner"`` or ``"left"`` (left-outer: unmatched probe rows emit build
+    NULLs).  ``build_context`` optionally carries the build region's
+    identity (region_id/region_epoch/apply_index) so the device rung can
+    resolve the build side's warm image (docs/device_join.md)."""
+
+    build: list
+    build_ranges: list[tuple[bytes, bytes]]
+    left_key: int
+    right_key: int
+    join_type: str = "inner"
+    build_context: dict | None = None
+
+
+ExecutorDescriptor = (TableScan | IndexScan | Selection | Aggregation | TopN
+                      | Limit | Projection | Join)
 
 
 #: response encodings (tipb EncodeType): datum rows are the default and the
@@ -247,8 +284,11 @@ class SelectResponse:
 
 def check_supported(dag: DagRequest) -> None:
     """Raise ValueError for plans the batch pipeline cannot run
-    (runner.rs:75 check_supported; Join/Projection/Exchange unsupported there
-    too — they are TiDB/TiFlash-side operators)."""
+    (runner.rs:75 check_supported).  Since the device-join work
+    (docs/device_join.md) Join and Projection ARE coprocessor-side here —
+    inner/left-outer equi-joins carry their build chain inline, Projections
+    evaluate the scalar expression surface — so only Exchange (and other
+    TiDB/TiFlash-only operators) remains out of the matrix."""
     if not dag.executors:
         raise ValueError("empty executor list")
     if not isinstance(dag.executors[0], (TableScan, IndexScan)):
@@ -256,13 +296,71 @@ def check_supported(dag: DagRequest) -> None:
     for e in dag.executors[1:]:
         if isinstance(e, (TableScan, IndexScan)):
             raise ValueError("scan executor must be the leaf")
-        if not isinstance(e, (Selection, Aggregation, TopN, Limit)):
+        if isinstance(e, Join):
+            _check_join(e)
+        elif not isinstance(e, (Selection, Aggregation, TopN, Limit,
+                                Projection)):
             raise ValueError(f"unsupported executor {type(e).__name__}")
 
 
-def build_executors(dag: DagRequest, source: ScanSource, leaf: BatchExecutor | None = None) -> BatchExecutor:
+def _check_join(j: Join) -> None:
+    """Validate one Join descriptor's build chain: its own scan leaf plus
+    optional Selections — no nested joins, no aggregates (the reference
+    pushes only simple build sides to storage)."""
+    if j.join_type not in ("inner", "left"):
+        raise ValueError(f"unsupported join type {j.join_type!r}")
+    if not j.build or not isinstance(j.build[0], TableScan):
+        raise ValueError("join build chain must start with a TableScan")
+    for e in j.build[1:]:
+        if not isinstance(e, Selection):
+            raise ValueError(
+                f"unsupported build-side executor {type(e).__name__}")
+
+
+def _attach(ex: BatchExecutor, desc, source: ScanSource | None,
+            build_leaf: BatchExecutor | None = None) -> BatchExecutor:
+    """Chain one non-leaf descriptor onto ``ex`` — the single
+    descriptor→executor mapping the probe chain, join build chains and the
+    device join rung's downstream finisher all share."""
+    if isinstance(desc, Selection):
+        return BatchSelectionExecutor(ex, desc.conditions)
+    if isinstance(desc, Aggregation):
+        if not desc.group_by:
+            return BatchSimpleAggregationExecutor(ex, desc.agg_funcs)
+        if desc.streamed:
+            return BatchStreamAggregationExecutor(ex, desc.group_by, desc.agg_funcs)
+        return BatchHashAggregationExecutor(ex, desc.group_by, desc.agg_funcs)
+    if isinstance(desc, TopN):
+        return BatchTopNExecutor(ex, desc.order_by, desc.limit)
+    if isinstance(desc, Limit):
+        return BatchLimitExecutor(ex, desc.limit)
+    if isinstance(desc, Projection):
+        return BatchProjectionExecutor(ex, desc.exprs)
+    if isinstance(desc, Join):
+        if build_leaf is not None:
+            build_ex = build_leaf
+        else:
+            b_src = (source.fork(desc.build_ranges)
+                     if source is not None else None)
+            build_ex = BatchTableScanExecutor(b_src, desc.build[0].columns_info)
+        for b in desc.build[1:]:
+            build_ex = _attach(build_ex, b, None)
+        return BatchJoinExecutor(ex, build_ex, desc.left_key, desc.right_key,
+                                 desc.join_type)
+    raise AssertionError(desc)
+
+
+def build_executors(dag: DagRequest, source: ScanSource,
+                    leaf: BatchExecutor | None = None,
+                    build_leaf: BatchExecutor | None = None) -> BatchExecutor:
     """runner.rs:150 build_executors equivalent.  ``leaf`` overrides the scan
-    executor (e.g. CachedBlocksExecutor for the warm block-cache path)."""
+    executor (e.g. CachedBlocksExecutor for the warm block-cache path);
+    ``build_leaf`` likewise overrides a Join descriptor's build-side scan.
+    Without an override, a Join's build side scans a ``source.fork`` over
+    its own ranges — the same snapshot, so both sides of the join read one
+    consistent view.  Construction never touches the sources (drains are
+    deferred to the first next_batch), so schema-only walks with
+    ``source=None`` stay valid for plans with joins."""
     check_supported(dag)
     head = dag.executors[0]
     if leaf is not None:
@@ -275,21 +373,7 @@ def build_executors(dag: DagRequest, source: ScanSource, leaf: BatchExecutor | N
         prefix_len = len(index_range(head.table_id, head.index_id)[0])
         ex = BatchIndexScanExecutor(source, head.columns_info, prefix_len)
     for desc in dag.executors[1:]:
-        if isinstance(desc, Selection):
-            ex = BatchSelectionExecutor(ex, desc.conditions)
-        elif isinstance(desc, Aggregation):
-            if not desc.group_by:
-                ex = BatchSimpleAggregationExecutor(ex, desc.agg_funcs)
-            elif desc.streamed:
-                ex = BatchStreamAggregationExecutor(ex, desc.group_by, desc.agg_funcs)
-            else:
-                ex = BatchHashAggregationExecutor(ex, desc.group_by, desc.agg_funcs)
-        elif isinstance(desc, TopN):
-            ex = BatchTopNExecutor(ex, desc.order_by, desc.limit)
-        elif isinstance(desc, Limit):
-            ex = BatchLimitExecutor(ex, desc.limit)
-        else:
-            raise AssertionError(desc)
+        ex = _attach(ex, desc, source, build_leaf=build_leaf)
     return ex
 
 

@@ -3,7 +3,10 @@
 from __future__ import annotations
 
 from .aggr import AggDescriptor
-from .dag import Aggregation, DagRequest, IndexScan, Limit, Selection, TableScan, TopN
+from .dag import (
+    Aggregation, DagRequest, IndexScan, Join, Limit, Projection, Selection,
+    TableScan, TopN,
+)
 from .datatypes import ColumnInfo, EvalType, FieldType, FieldTypeTp
 from .rpn import ColumnRef, Constant, FuncCall
 
@@ -46,31 +49,42 @@ def _col_info_from_wire(d: dict) -> ColumnInfo:
     )
 
 
+def _exec_to_wire(e) -> dict:
+    if isinstance(e, TableScan):
+        return {"t": "table_scan", "table_id": e.table_id,
+                "cols": [_col_info_to_wire(c) for c in e.columns_info]}
+    if isinstance(e, IndexScan):
+        return {"t": "index_scan", "table_id": e.table_id, "index_id": e.index_id,
+                "cols": [_col_info_to_wire(c) for c in e.columns_info]}
+    if isinstance(e, Selection):
+        return {"t": "selection", "conds": [expr_to_wire(c) for c in e.conditions]}
+    if isinstance(e, Aggregation):
+        return {
+            "t": "agg",
+            "group_by": [expr_to_wire(g) for g in e.group_by],
+            "aggs": [{"op": a.op, "expr": expr_to_wire(a.expr) if a.expr else None} for a in e.agg_funcs],
+            "streamed": e.streamed,
+        }
+    if isinstance(e, TopN):
+        return {"t": "topn", "limit": e.limit,
+                "order_by": [[expr_to_wire(x), desc] for x, desc in e.order_by]}
+    if isinstance(e, Limit):
+        return {"t": "limit", "limit": e.limit}
+    if isinstance(e, Projection):
+        return {"t": "projection", "exprs": [expr_to_wire(x) for x in e.exprs]}
+    if isinstance(e, Join):
+        d = {"t": "join", "join_type": e.join_type,
+             "left_key": e.left_key, "right_key": e.right_key,
+             "build": [_exec_to_wire(b) for b in e.build],
+             "build_ranges": [[s, x] for s, x in e.build_ranges]}
+        if e.build_context is not None:
+            d["build_context"] = dict(e.build_context)
+        return d
+    raise TypeError(e)
+
+
 def dag_to_wire(dag: DagRequest) -> dict:
-    execs = []
-    for e in dag.executors:
-        if isinstance(e, TableScan):
-            execs.append({"t": "table_scan", "table_id": e.table_id,
-                          "cols": [_col_info_to_wire(c) for c in e.columns_info]})
-        elif isinstance(e, IndexScan):
-            execs.append({"t": "index_scan", "table_id": e.table_id, "index_id": e.index_id,
-                          "cols": [_col_info_to_wire(c) for c in e.columns_info]})
-        elif isinstance(e, Selection):
-            execs.append({"t": "selection", "conds": [expr_to_wire(c) for c in e.conditions]})
-        elif isinstance(e, Aggregation):
-            execs.append({
-                "t": "agg",
-                "group_by": [expr_to_wire(g) for g in e.group_by],
-                "aggs": [{"op": a.op, "expr": expr_to_wire(a.expr) if a.expr else None} for a in e.agg_funcs],
-                "streamed": e.streamed,
-            })
-        elif isinstance(e, TopN):
-            execs.append({"t": "topn", "limit": e.limit,
-                          "order_by": [[expr_to_wire(x), desc] for x, desc in e.order_by]})
-        elif isinstance(e, Limit):
-            execs.append({"t": "limit", "limit": e.limit})
-        else:
-            raise TypeError(e)
+    execs = [_exec_to_wire(e) for e in dag.executors]
     d = {"executors": execs, "output_offsets": dag.output_offsets, "chunk_rows": dag.chunk_rows}
     if dag.encode_type:
         # emitted only when non-default so pre-chunk plan bytes (and every
@@ -79,30 +93,42 @@ def dag_to_wire(dag: DagRequest) -> dict:
     return d
 
 
+def _exec_from_wire(e: dict):
+    t = e["t"]
+    if t == "table_scan":
+        return TableScan(e["table_id"], [_col_info_from_wire(c) for c in e["cols"]])
+    if t == "index_scan":
+        return IndexScan(e["table_id"], e["index_id"], [_col_info_from_wire(c) for c in e["cols"]])
+    if t == "selection":
+        return Selection([expr_from_wire(c) for c in e["conds"]])
+    if t == "agg":
+        return Aggregation(
+            [expr_from_wire(g) for g in e["group_by"]],
+            [AggDescriptor(a["op"], expr_from_wire(a["expr"]) if a["expr"] else None) for a in e["aggs"]],
+            streamed=e.get("streamed", False),
+        )
+    if t == "topn":
+        return TopN([(expr_from_wire(x), desc) for x, desc in e["order_by"]], e["limit"])
+    if t == "limit":
+        return Limit(e["limit"])
+    if t == "projection":
+        return Projection([expr_from_wire(x) for x in e["exprs"]])
+    if t == "join":
+        ctx = e.get("build_context")
+        if ctx is not None and "region_epoch" in ctx:
+            ctx = dict(ctx, region_epoch=tuple(ctx["region_epoch"]))
+        return Join(
+            [_exec_from_wire(b) for b in e["build"]],
+            [(s, x) for s, x in e["build_ranges"]],
+            e["left_key"], e["right_key"],
+            join_type=e.get("join_type", "inner"),
+            build_context=ctx,
+        )
+    raise ValueError(t)
+
+
 def dag_from_wire(d: dict) -> DagRequest:
-    execs = []
-    for e in d["executors"]:
-        t = e["t"]
-        if t == "table_scan":
-            execs.append(TableScan(e["table_id"], [_col_info_from_wire(c) for c in e["cols"]]))
-        elif t == "index_scan":
-            execs.append(IndexScan(e["table_id"], e["index_id"], [_col_info_from_wire(c) for c in e["cols"]]))
-        elif t == "selection":
-            execs.append(Selection([expr_from_wire(c) for c in e["conds"]]))
-        elif t == "agg":
-            execs.append(
-                Aggregation(
-                    [expr_from_wire(g) for g in e["group_by"]],
-                    [AggDescriptor(a["op"], expr_from_wire(a["expr"]) if a["expr"] else None) for a in e["aggs"]],
-                    streamed=e.get("streamed", False),
-                )
-            )
-        elif t == "topn":
-            execs.append(TopN([(expr_from_wire(x), desc) for x, desc in e["order_by"]], e["limit"]))
-        elif t == "limit":
-            execs.append(Limit(e["limit"]))
-        else:
-            raise ValueError(t)
+    execs = [_exec_from_wire(e) for e in d["executors"]]
     return DagRequest(executors=execs, output_offsets=d.get("output_offsets"),
                       chunk_rows=d.get("chunk_rows", 1024),
                       encode_type=d.get("encode_type", 0))

@@ -128,6 +128,18 @@ def count_rewrite(outcome: str) -> None:
     ).inc(outcome=outcome)
 
 
+def count_join(path: str, outcome: str) -> None:
+    """Join serving outcomes by path (rank / hash / cpu): served, declined,
+    error — the device-join twin of count_rewrite (docs/device_join.md);
+    per-cause decline detail rides count_decline(path="join", cause)."""
+    from ..util.metrics import REGISTRY
+
+    REGISTRY.counter(
+        "tikv_coprocessor_join_total",
+        "Coprocessor join serves by path and outcome",
+    ).inc(path=path, outcome=outcome)
+
+
 # ---------------------------------------------------------------------------
 # eligibility → candidate set (the cost router's input)
 # ---------------------------------------------------------------------------
@@ -146,8 +158,15 @@ def candidate_paths(dag, *, device_ok: bool, mesh_ok: bool) -> list[str]:
     static ladder does."""
     if not device_ok:
         return ["cpu"]
-    from .dag import Aggregation
+    from .dag import Aggregation, Join
 
+    if any(isinstance(e, Join) for e in dag.executors):
+        # join plans route among the device-join rung's two kernels and the
+        # CPU oracle (docs/device_join.md): rank (sorted-dict code space)
+        # leads the static ladder, hash (open-addressing over int lanes)
+        # second — each is "try the rung", with per-cause counted declines
+        # falling through to the next, exactly like zone/unary
+        return ["rank", "hash", "cpu"]
     paths: list[str] = []
     if mesh_ok:
         paths.append("mesh")

@@ -366,13 +366,15 @@ class Endpoint:
             decline = jax_eval.decline_cause(req.dag)
             use_device = decline is None
             if decline is not None:
-                from .dag import Limit, TopN
+                from .dag import Join, Limit, Projection, TopN
 
-                if any(isinstance(e, (Limit, TopN))
+                if any(isinstance(e, (Limit, TopN, Join, Projection))
                        for e in req.dag.executors[1:]):
                     # Limit/TopN plans never fall to the CPU silently: the
                     # early-exit tiling work (docs/zone_maps.md) made them
-                    # device-eligible, so a decline is a named, counted event
+                    # device-eligible, so a decline is a named, counted
+                    # event; Join/Projection plans likewise (the join rung
+                    # below may still serve them — docs/device_join.md)
                     from . import encoding as _encoding
 
                     _encoding.count_decline("device_plan", decline)
@@ -515,6 +517,9 @@ class Endpoint:
                     "tikv_coprocessor_device_fallback_total",
                     "Device-path failures that re-ran on the CPU pipeline",
                 ).inc()
+        resp = self._try_device_join(req, snap, tracker, stale_snap)
+        if resp is not None:
+            return resp
         resp = self._try_dict_rewrite(req, snap, tracker, stale_snap)
         if resp is not None:
             return resp
@@ -531,6 +536,145 @@ class Endpoint:
         parts, enc_tp = self._encode_response(resp)
         return CoprResponse(None, from_device=False, metrics=m.to_dict(),
                             data_parts=parts, encode_type=enc_tp)
+
+    def _build_cache_for(self, req: CoprRequest, snap, join):
+        """Resolve a Join's build-side region image.  The build context
+        (region id / epoch / apply index) rides the Join descriptor — the
+        probe snapshot cannot vouch for a DIFFERENT region's identity, so
+        a missing context is a named decline, never a guess."""
+        ctx = join.build_context
+        if ctx is None:
+            return None, "no_build_context"
+        context = dict(ctx)
+        if req.context and "tenant" in req.context:
+            # one request, one tenant: the build image bills the same
+            # HBM partition as the probe's
+            context.setdefault("tenant", req.context["tenant"])
+        cache, outcome, _delta = self.region_cache.serve(
+            snap, context, join.build[0].columns_info, join.build_ranges,
+            req.start_ts)
+        return cache, outcome
+
+    def _try_device_join(self, req: CoprRequest, snap, tracker, stale_snap):
+        """Device join rung (docs/device_join.md): a ``[TableScan, Join,
+        ...]`` plan whose probe AND build region images are warm serves as
+        ONE dispatch over both images — rank-space joins over shared
+        sorted dictionaries, radix-hash joins over int key lanes — with
+        payload columns late-materialized only for surviving row pairs.
+        Every shape the kernels cannot cover (outer joins, filtered probe
+        sides, unsorted dictionaries, exotic key types) is a per-cause
+        counted decline to the CPU oracle, never a silent fallback."""
+        from . import encoding as _encoding
+        from . import observatory as _obs
+        from .dag import Join
+
+        dag = req.dag
+        if (self.region_cache is None or not self.device_enabled()
+                or dag is None
+                or not any(isinstance(e, Join) for e in dag.executors)):
+            return None
+
+        def declined(cause: str):
+            _encoding.count_join("device", "declined")
+            _encoding.count_decline("join", cause)
+            try:
+                sig, _desc = _obs.dag_sig(dag)
+            except Exception:  # noqa: BLE001 — profiling must not fail serving
+                sig = None
+            _obs.OBSERVATORY.record_decline(sig, "join", cause)
+            return None
+
+        from . import jax_join as _jax_join
+
+        try:
+            _probe_scan, join, _rest = _jax_join.analyze_plan(dag)
+        except _jax_join.JoinDecline as d:
+            return declined(d.cause)
+        if self.overload is not None \
+                and not self.overload.allow_device(req.context):
+            from .tracker import count_path_fallback
+
+            count_path_fallback("unary", "tenant_pressure")
+            return None
+        if not self.breaker.allow("unary"):
+            from .tracker import count_path_fallback
+
+            count_path_fallback("unary", "breaker_open")
+            return None
+        # cost routing among the join ladder (docs/cost_router.md):
+        # candidate_paths declares rank/hash/cpu for join plans, so the
+        # router prices the measured rank vs hash vs CPU profiles
+        route = self._route_for(req)
+        prefer = (route.path if route is not None
+                  and route.path in ("rank", "hash", "cpu") else None)
+        if prefer == "cpu":
+            from .tracker import count_path_fallback
+
+            count_path_fallback("unary", "cost_route")
+            _encoding.count_join("cpu", "routed")
+            self.breaker.release_probe("unary")
+            return None
+        try:
+            probe_cache, rc_outcome = self._region_cache_for(req, snap, tracker)
+            if (probe_cache is None or not probe_cache.filled
+                    or not probe_cache.blocks):
+                self.breaker.release_probe("unary")
+                return declined("probe_cold")
+            build_cache, b_outcome = self._build_cache_for(req, snap, join)
+            if b_outcome == "no_build_context":
+                self.breaker.release_probe("unary")
+                return declined("no_build_context")
+            if (build_cache is None or not build_cache.filled
+                    or not build_cache.blocks):
+                self.breaker.release_probe("unary")
+                return declined("build_cold")
+            try:
+                resp, path, stats = _jax_join.serve(
+                    dag, probe_cache, build_cache, prefer=prefer)
+            except _jax_join.JoinDecline as d:
+                self.breaker.release_probe("unary")
+                return declined(d.cause)
+            parts, enc_tp = self._encode_response(resp)
+            data = None
+            from_device = True
+            warm = ("hit", "delta", "wt_delta")
+            if ((rc_outcome in warm or b_outcome in warm)
+                    and self.shadow.pick("unary")):
+                fixed = self.shadow_compare(
+                    req, snap, b"".join(bytes(p) for p in parts), "unary")
+                if fixed is not None:
+                    data, parts = fixed, None
+                    from_device = False
+            _encoding.count_join(path, "served")
+            m = tracker.on_finish(scanned_keys=0, from_device=from_device)
+            resp._obs_join = (stats["build_rows"], stats["probe_rows"],
+                              stats["out_rows"])
+            self._record_obs(req, tracker, path, "encoded",
+                             stats["probe_rows"] + stats["build_rows"],
+                             resp=resp)
+            self.slow_log.observe(tracker)
+            self.breaker.record_success("unary")
+            if stale_snap:
+                self.count_follower_read("device" if from_device else "cpu")
+            cold = ("miss", "too_big")
+            return CoprResponse(
+                data, from_device=from_device,
+                from_cache=(from_device and rc_outcome not in cold
+                            and b_outcome not in cold),
+                metrics=m.to_dict(), data_parts=parts, encode_type=enc_tp)
+        except Exception as exc:  # noqa: BLE001 — CPU pipeline always serves
+            from .integrity import IntegrityMismatch
+
+            if isinstance(exc, IntegrityMismatch):
+                raise  # TIKV_TPU_INTEGRITY_FATAL: surface, never mask
+            self.device_fallbacks += 1
+            self.last_device_error = repr(exc)
+            self.breaker.record_failure("unary")
+            from .tracker import count_path_fallback
+
+            count_path_fallback("unary", "device_error")
+            _encoding.count_join("device", "error")
+            return None
 
     def _try_dict_rewrite(self, req: CoprRequest, snap, tracker, stale_snap):
         """Dictionary code-space serving rung (docs/compressed_columns.md):
@@ -644,10 +788,14 @@ class Endpoint:
         m = tracker.metrics
         # zone-map pruning effectiveness rides the profile (docs/zone_maps.md)
         prune = getattr(resp, "_obs_prune", None) or (0, 0)
+        # device-join magnitudes ride it too (docs/device_join.md)
+        jn = getattr(resp, "_obs_join", None) or (0, 0, 0)
         _obs.OBSERVATORY.record_serve(
             sig, path, m.total_s, rows=rows, encoding=encoding,
             queue_wait_s=m.schedule_wait_s, trace_id=tracker.trace_id,
-            desc=desc, blocks_examined=prune[0], blocks_pruned=prune[1])
+            desc=desc, blocks_examined=prune[0], blocks_pruned=prune[1],
+            join_build_rows=jn[0], join_probe_rows=jn[1],
+            join_out_rows=jn[2])
 
     def _cpu_bytes(self, req: CoprRequest, snap) -> bytes:
         """The CPU-oracle answer to ``req`` off ``snap`` — the byte-identity

@@ -78,6 +78,13 @@ class ScanSource:
         """Returns (keys, values, drained)."""
         raise NotImplementedError
 
+    def fork(self, ranges: list[tuple[bytes, bytes]]) -> "ScanSource":
+        """A sibling source over different ranges off the SAME underlying
+        view — how a Join descriptor's build side scans the build table
+        consistently with the probe scan (docs/device_join.md)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} cannot fork a build-side scan")
+
 
 class MvccScanSource(ScanSource):
     """MVCC snapshot scan over raw-key ranges (SnapshotStore + RangesScanner)."""
@@ -92,6 +99,9 @@ class MvccScanSource(ScanSource):
     ):
         from ..storage.txn_types import Key
 
+        self._snapshot = snapshot
+        self._ts = ts
+        self._scan_kwargs = scan_kwargs
         self.stats = statistics or Statistics()
         self._iters = [
             iter(
@@ -121,6 +131,12 @@ class MvccScanSource(ScanSource):
                 self._cur += 1
         return keys, vals, self._cur >= len(self._iters)
 
+    def fork(self, ranges: list[tuple[bytes, bytes]]) -> "MvccScanSource":
+        # same snapshot + read ts: the join's two sides see one consistent
+        # view; scan statistics accumulate into the request's one ledger
+        return MvccScanSource(self._snapshot, self._ts, ranges,
+                              statistics=self.stats, **self._scan_kwargs)
+
 
 class FixtureScanSource(ScanSource):
     """In-memory (key, value) fixture — test/bench leaf without MVCC."""
@@ -133,6 +149,11 @@ class FixtureScanSource(ScanSource):
         chunk = self.items[self.pos : self.pos + n]
         self.pos += len(chunk)
         return [k for k, _ in chunk], [v for _, v in chunk], self.pos >= len(self.items)
+
+    def fork(self, ranges: list[tuple[bytes, bytes]]) -> "FixtureScanSource":
+        return FixtureScanSource(
+            [(k, v) for k, v in self.items
+             if any(s <= k < e for s, e in ranges)])
 
 
 # ---------------------------------------------------------------------------
@@ -258,6 +279,216 @@ class BatchSelectionExecutor(BatchExecutor):
             keep &= (np.asarray(data) != 0) & ~np.asarray(nulls)
         logical = chunk.logical_rows[keep[chunk.logical_rows]]
         return BatchExecuteResult(Chunk(chunk.columns, logical), r.is_drained)
+
+
+# ---------------------------------------------------------------------------
+# Projection + Join (the CPU oracle half of docs/device_join.md)
+# ---------------------------------------------------------------------------
+
+class BatchProjectionExecutor(BatchExecutor):
+    """Evaluate an expression list over the child rows (tipb::Projection):
+    output columns are the expressions in order, physically compacted.
+    Reuses the same RPN/kernels scalar surface as Selection, so the device
+    paths share its differential target by construction."""
+
+    def __init__(self, child: BatchExecutor, exprs: list[Expr]):
+        self.child = child
+        self._child_schema = child.schema()
+        self.exprs = [compile_expr(e, self._child_schema) for e in exprs]
+        if not self.exprs:
+            raise ValueError("projection needs at least one expression")
+        self._needed = set()
+        for rpn in self.exprs:
+            self._needed |= rpn.referenced_columns()
+
+    def schema(self) -> list[tuple[EvalType, int]]:
+        return [(r.eval_type, r.frac) for r in self.exprs]
+
+    def next_batch(self, scan_rows: int) -> BatchExecuteResult:
+        r = self.child.next_batch(scan_rows)
+        chunk = r.chunk
+        if chunk.num_rows == 0:
+            return BatchExecuteResult(Chunk.full([]), r.is_drained)
+        n = len(chunk.columns[0]) if chunk.columns else 0
+        logical = chunk.logical_rows
+        cols = cols_for_eval(chunk.columns, self._needed)
+        out = []
+        for rpn in self.exprs:
+            data, nulls = eval_rpn(rpn, cols, n)
+            out.append(Column(rpn.eval_type, np.asarray(data)[logical],
+                              np.asarray(nulls)[logical], rpn.frac))
+        return BatchExecuteResult(Chunk.full(out), r.is_drained)
+
+
+# join keys are compared by VALUE (dictionary columns decode through their
+# dictionaries), so shared-dict, disjoint-dict and plain columns all join
+# consistently; NULL keys never match (SQL equi-join semantics)
+_JOINABLE_KEY_TYPES = frozenset({
+    EvalType.INT, EvalType.BYTES, EvalType.REAL, EvalType.DECIMAL,
+    EvalType.DATETIME, EvalType.DURATION,
+})
+
+
+def _join_key_values(col: Column) -> list:
+    """Hashable per-row key values for a (compacted, plain) column — None
+    for NULL rows."""
+    c = col.decoded() if col.is_dict_encoded else col
+    data = np.asarray(c.data)
+    nulls = np.asarray(c.nulls)
+    out = []
+    for i in range(len(data)):
+        if nulls[i]:
+            out.append(None)
+            continue
+        v = data[i]
+        if isinstance(v, np.generic):
+            v = v.item()
+        if isinstance(v, (bytes, bytearray)):
+            v = bytes(v)
+        out.append(v)
+    return out
+
+
+def _concat_build_columns(parts: list[Column], et: EvalType,
+                          frac: int) -> Column:
+    """Concatenate the build side's per-batch compacted columns into one.
+    Dictionary codes only concatenate when every part shares the SAME
+    dictionary object; otherwise values materialize first."""
+    if not parts:
+        return Column.from_values(et, [], frac)
+    if len(parts) == 1:
+        return parts[0]
+    d = parts[0].dictionary
+    if any(p.dictionary is not d for p in parts):
+        parts = [p.decoded() if p.is_dict_encoded else p for p in parts]
+        d = None
+    data = np.concatenate([np.asarray(p.data) for p in parts])
+    nulls = np.concatenate([np.asarray(p.nulls) for p in parts])
+    return Column(et, data, nulls, frac, d)
+
+
+class BatchJoinExecutor(BatchExecutor):
+    """Equi-join the child (probe) rows against a fully drained build chain
+    (tipb::Join, inner + left-outer).
+
+    Output row order is deterministic — probe stream order, with each probe
+    row's matches in build-row order — which is exactly the order the device
+    rank/hash kernels reproduce, so the two paths byte-compare at the wire
+    (docs/device_join.md)."""
+
+    def __init__(self, probe: BatchExecutor, build: BatchExecutor,
+                 left_key: int, right_key: int, join_type: str = "inner"):
+        self.probe = probe
+        self.build = build
+        self.left_key = left_key
+        self.right_key = right_key
+        self.join_type = join_type
+        self._pschema = probe.schema()
+        self._bschema = build.schema()
+        if not 0 <= left_key < len(self._pschema):
+            raise ValueError(f"join left key offset {left_key} out of range")
+        if not 0 <= right_key < len(self._bschema):
+            raise ValueError(f"join right key offset {right_key} out of range")
+        for et in (self._pschema[left_key][0], self._bschema[right_key][0]):
+            if et not in _JOINABLE_KEY_TYPES:
+                raise ValueError(f"unsupported join key type {et}")
+        self._table: dict | None = None  # key value -> build row id array
+        self._bcols: list[Column] | None = None
+
+    def schema(self) -> list[tuple[EvalType, int]]:
+        return self._pschema + self._bschema
+
+    def _ensure_build(self) -> None:
+        if self._table is not None:
+            return
+        per_col: list[list[Column]] = [[] for _ in self._bschema]
+        batch = BATCH_INITIAL_SIZE
+        while True:
+            r = self.build.next_batch(batch)
+            if r.chunk.num_rows:
+                cc = r.chunk.compact()
+                for i, col in enumerate(cc.columns):
+                    per_col[i].append(col)
+            if r.is_drained:
+                break
+            batch = min(batch * BATCH_GROW_FACTOR, BATCH_MAX_SIZE)
+        self._bcols = [
+            _concat_build_columns(parts, et, frac)
+            for parts, (et, frac) in zip(per_col, self._bschema)
+        ]
+        table: dict = {}
+        for i, k in enumerate(_join_key_values(self._bcols[self.right_key])):
+            if k is not None:
+                table.setdefault(k, []).append(i)
+        self._table = {k: np.asarray(v, dtype=np.int64)
+                       for k, v in table.items()}
+
+    def _gather_build(self, bidx: np.ndarray) -> list[Column]:
+        missing = bidx < 0
+        if not missing.any():
+            return [c.take(bidx) for c in self._bcols]
+        n_build = len(self._bcols[0]) if self._bcols else 0
+        if n_build == 0:
+            return [Column.from_values(et, [None] * len(bidx), frac)
+                    for et, frac in self._bschema]
+        safe = np.where(missing, 0, bidx)
+        out = []
+        for c, (et, frac) in zip(self._bcols, self._bschema):
+            g = c.take(safe)
+            nulls = np.asarray(g.nulls).copy()
+            nulls[missing] = True
+            out.append(Column(et, g.data, nulls, frac, g.dictionary))
+        return out
+
+    def next_batch(self, scan_rows: int) -> BatchExecuteResult:
+        self._ensure_build()
+        r = self.probe.next_batch(scan_rows)
+        chunk = r.chunk
+        if chunk.num_rows == 0:
+            return BatchExecuteResult(Chunk.full([]), r.is_drained)
+        pc = chunk.compact()
+        keys = _join_key_values(pc.columns[self.left_key])
+        probe_parts: list[np.ndarray] = []
+        build_parts: list[np.ndarray] = []
+        left = self.join_type == "left"
+        for i, k in enumerate(keys):
+            rows = self._table.get(k) if k is not None else None
+            if rows is not None:
+                probe_parts.append(np.full(len(rows), i, dtype=np.int64))
+                build_parts.append(rows)
+            elif left:
+                probe_parts.append(np.array([i], dtype=np.int64))
+                build_parts.append(np.array([-1], dtype=np.int64))
+        if not probe_parts:
+            return BatchExecuteResult(Chunk.full([]), r.is_drained)
+        pidx = np.concatenate(probe_parts)
+        bidx = np.concatenate(build_parts)
+        out = [c.take(pidx) for c in pc.columns]
+        out.extend(self._gather_build(bidx))
+        return BatchExecuteResult(Chunk.full(out), r.is_drained)
+
+
+class ChunkFeedExecutor(BatchExecutor):
+    """Leaf replaying prepared compact chunks — the device join rung's
+    bridge into the CPU executor chain for descriptors ABOVE the Join
+    (shared code keeps the finishing stages byte-identical by
+    construction)."""
+
+    def __init__(self, schema: list[tuple[EvalType, int]],
+                 chunks: list[Chunk]):
+        self._schema = schema
+        self._chunks = chunks
+        self._idx = 0
+
+    def schema(self) -> list[tuple[EvalType, int]]:
+        return self._schema
+
+    def next_batch(self, scan_rows: int) -> BatchExecuteResult:
+        if self._idx >= len(self._chunks):
+            return BatchExecuteResult(Chunk.full([]), True)
+        c = self._chunks[self._idx]
+        self._idx += 1
+        return BatchExecuteResult(c, self._idx >= len(self._chunks))
 
 
 # ---------------------------------------------------------------------------

@@ -141,6 +141,17 @@ def _analyze(dag: DagRequest) -> _Plan:
             plan.limit = e
             stage = 3
         else:
+            from .dag import Join, Projection
+
+            if isinstance(e, Join):
+                # joins route through the dedicated device-join rung
+                # (jax_join.py / docs/device_join.md), never this plan shape
+                raise _Unsupported("join executors serve via the join rung",
+                                   "join_executor")
+            if isinstance(e, Projection):
+                raise _Unsupported(
+                    "projection executors serve via the join rung or CPU",
+                    "projection_executor")
             raise _Unsupported(f"executor {type(e).__name__} not device-routable here",
                                "executor_shape")
     schema = [(c.ftype.eval_type, c.ftype.decimal) for c in scan.columns_info]

@@ -1,0 +1,563 @@
+"""Device-resident equi-join over warm compressed region images.
+
+The join rung (docs/device_join.md) serves a ``[TableScan, Join, ...]``
+plan when BOTH region images are warm, without decoding rows that do not
+survive the join:
+
+* **rank path** — both key columns are dictionary-encoded.  The probe
+  side's codes are remapped into the build side's code space at plan time
+  (``np.searchsorted`` over the SORTED build dictionary objects; identity
+  when the images share one dictionary object), then the device joins the
+  integer code lanes directly with two ``searchsorted`` calls over the
+  stable-sorted build codes.  No string ever materializes.
+* **hash path** — plain int-family key lanes.  The build side's unique
+  keys pack into a power-of-two open-addressing table host-side; the
+  table arrays ride as DYNAMIC jit inputs, so compile keys churn only
+  with the power-of-two shape buckets, never with table content.  The
+  device probes with a vectorized linear-probe ``lax.while_loop``.
+
+Both kernels return per-probe-row ``(start, count)`` group spans into one
+stable-sorted build order (ascending key, build-row order within equal
+keys — exactly the CPU ``BatchJoinExecutor``'s match order), so pair
+expansion and payload gather are one shared host path: surviving row
+pairs late-materialize through ``Column.take`` / ``EncodedColumn.take``
+only.  Zone maps (docs/zone_maps.md) prune build/probe blocks whose key
+ranges cannot intersect BEFORE any key lane decodes.
+
+Everything here is a named decline away from the CPU oracle: any plan or
+data shape the device cannot serve raises :class:`JoinDecline`, the
+endpoint counts the cause, and the CPU pipeline serves the bytes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..analysis.sanitizer import note_blocking
+from . import jax_eval as _jax_eval  # noqa: F401 — x64 config side effect
+from . import zone_maps
+from .dag import (
+    DagRequest, ExecSummary, Join, TableScan, make_response_encoder, _attach,
+)
+from .datatypes import Chunk, Column, EvalType
+from .executors import (
+    BATCH_GROW_FACTOR, BATCH_INITIAL_SIZE, BATCH_MAX_SIZE, ChunkFeedExecutor,
+)
+
+# int-family eval types whose decoded lanes are exact int64 join keys; REAL
+# and DECIMAL stay on the CPU oracle (bit-cast floats and mixed-frac
+# decimals have no lane-equality story worth the risk)
+_INT_KEYS = frozenset({EvalType.INT, EvalType.DATETIME, EvalType.DURATION})
+
+_MULT = 0x9E3779B97F4A7C15      # Fibonacci hashing multiplier (mod 2**64)
+_EMPTY = -(1 << 63)             # open-addressing empty-slot sentinel
+_MISS = np.int64(-1)            # rank-path "no such code" / NULL key
+
+PATHS = ("rank", "hash")
+
+# test/bench hook: force one device path regardless of preference ladder
+_PATH_OVERRIDE: str | None = None
+
+
+def set_path_override(path: str | None) -> None:
+    """Force the rank or hash path (tests/bench); None restores routing."""
+    assert path in (None, "rank", "hash"), path
+    global _PATH_OVERRIDE
+    _PATH_OVERRIDE = path
+
+
+class JoinDecline(Exception):
+    """A named reason the device join rung cannot serve this request.
+
+    The endpoint counts ``cause`` under the ``join`` decline path and
+    falls to the CPU pipeline — never silent, never wrong bytes."""
+
+    def __init__(self, cause: str):
+        super().__init__(cause)
+        self.cause = cause
+
+
+# ---------------------------------------------------------------------------
+# plan eligibility
+# ---------------------------------------------------------------------------
+
+def analyze_plan(dag: DagRequest):
+    """(probe_scan, join, downstream) for a device-joinable plan.
+
+    The rung serves exactly ``[TableScan, Join, *downstream]`` inner
+    joins with a bare build-side scan; everything else raises a named
+    :class:`JoinDecline` (outer joins decline to the CPU oracle — its
+    NULL-extension is the byte contract, per the issue: never silent)."""
+    execs = dag.executors
+    joins = [i for i, e in enumerate(execs) if isinstance(e, Join)]
+    if len(joins) != 1:
+        raise JoinDecline("multi_join" if joins else "not_join_plan")
+    if not isinstance(execs[0], TableScan):
+        raise JoinDecline("leaf_not_table_scan")
+    if joins[0] != 1:
+        # a Selection (or worse) below the join: the probe lanes served
+        # off the image would disagree with the filtered CPU probe stream
+        raise JoinDecline("probe_selection")
+    join = execs[1]
+    if join.join_type != "inner":
+        raise JoinDecline("outer_join")
+    if len(join.build) != 1:
+        raise JoinDecline("build_selection")
+    return execs[0], join, list(execs[2:])
+
+
+# ---------------------------------------------------------------------------
+# key lanes
+# ---------------------------------------------------------------------------
+
+class _Side:
+    """One side's key-lane view over a warm image's blocks."""
+
+    __slots__ = ("blocks", "kind", "dictionary", "keep", "n_rows")
+
+    def __init__(self, cache, key_idx: int, label: str):
+        self.blocks = list(cache.blocks)
+        if not self.blocks:
+            raise JoinDecline(f"{label}_empty_image")
+        self.n_rows = sum(b.n_valid for b in self.blocks)
+        kcols = []
+        for blk in self.blocks:
+            if key_idx >= len(blk.cols):
+                raise JoinDecline("key_offset")
+            kcols.append(blk.cols[key_idx])
+        first = kcols[0]
+        if first.dictionary is not None:
+            if first.eval_type != EvalType.BYTES:
+                raise JoinDecline("key_type")  # ENUM/SET code semantics
+            if any(c.dictionary is not first.dictionary for c in kcols):
+                raise JoinDecline("unstable_dictionary")
+            self.kind, self.dictionary = "dict", first.dictionary
+        elif first.eval_type in _INT_KEYS:
+            if any(c.dictionary is not None for c in kcols):
+                raise JoinDecline("unstable_dictionary")
+            self.kind, self.dictionary = "int", None
+        else:
+            raise JoinDecline("key_type")
+        self.keep = np.ones(len(self.blocks), dtype=bool)
+
+    def key_lane(self, blk, key_idx: int):
+        """(int64 values-or-codes, valid mask) for one block's key column,
+        decoding WITHOUT populating the column's resident cache."""
+        from . import encoding as _encoding
+
+        col = blk.cols[key_idx]
+        nv = blk.n_valid
+        data = np.asarray(_encoding.decoded_data(col))[:nv]
+        if data.dtype == object:
+            raise JoinDecline("key_type")
+        nulls = np.asarray(_encoding.decoded_nulls(col))[:nv]
+        return data.astype(np.int64, copy=True), ~nulls
+
+
+def _remap_for(probe: _Side, build: _Side) -> np.ndarray | None:
+    """Probe-code → build-code remap array (None = shared dictionary, the
+    identity).  Requires a SORTED build dictionary; codes of probe values
+    absent from the build side map to ``_MISS``."""
+    if probe.dictionary is build.dictionary:
+        return None
+    from . import encoding as _encoding
+
+    if not _encoding._dict_map_for(build.dictionary)[1]:
+        raise JoinDecline("dict_unsorted")
+    bd = np.asarray(build.dictionary, dtype=object)
+    pd = np.asarray(probe.dictionary, dtype=object)
+    if len(bd) == 0:
+        return np.full(len(pd), _MISS, dtype=np.int64)
+    pos = np.searchsorted(bd, pd)
+    posc = np.minimum(pos, len(bd) - 1)
+    hit = np.array([bd[p] == v for p, v in zip(posc, pd)], dtype=bool)
+    return np.where(hit, posc, _MISS).astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# zone-map block pruning (before any key lane decodes)
+# ---------------------------------------------------------------------------
+
+def _zone_intervals(side: _Side, key_idx: int):
+    """Per-block key interval from the block zones: ``(lo, hi)``,
+    ``None`` (unknown — keep, and poison the side's global bound), or
+    ``"empty"`` (no live keys: prunable outright for an inner join)."""
+    out = []
+    for blk in side.blocks:
+        z = (blk.zones or {}).get(key_idx)
+        if z is None:
+            out.append(None)
+        elif z.lo is None:
+            out.append("empty")
+        else:
+            out.append((z.lo, z.hi))
+    return out
+
+
+def _map_interval(iv, remap: np.ndarray | None, probe_sorted: bool):
+    """A probe-side code interval carried into build code space.  The
+    remap is monotone only over a sorted probe dictionary; otherwise the
+    interval is unknowable and pruning stands down for it."""
+    if iv is None or iv == "empty" or remap is None:
+        return iv
+    if not probe_sorted:
+        return None
+    lo, hi = int(iv[0]), int(iv[1])
+    live = remap[lo:hi + 1]
+    live = live[live >= 0]
+    if live.size == 0:
+        return "empty"
+    return (int(live.min()), int(live.max()))
+
+
+def _global_bound(ivs):
+    """(lo, hi) over kept blocks, or None when any interval is unknown
+    (an unknown block could hold anything — no pruning against it)."""
+    lo = hi = None
+    for iv in ivs:
+        if iv == "empty":
+            continue
+        if iv is None:
+            return None
+        lo = iv[0] if lo is None else min(lo, iv[0])
+        hi = iv[1] if hi is None else max(hi, iv[1])
+    return None if lo is None else (lo, hi)
+
+
+def _prune_side(side: _Side, ivs, other_bound) -> None:
+    for i, iv in enumerate(ivs):
+        if iv == "empty":
+            side.keep[i] = False
+        elif (iv is not None and other_bound is not None
+                and (iv[1] < other_bound[0] or iv[0] > other_bound[1])):
+            side.keep[i] = False
+
+
+def _zone_prune(probe: _Side, build: _Side, join: Join, remap: np.ndarray | None,
+                probe_cache, build_cache) -> tuple[int, int]:
+    """Drop blocks whose key ranges cannot intersect the other side.
+    Widening-only folds keep stale zones a superset of the data, so a
+    non-intersection proof stays a proof.  Returns (examined, pruned)."""
+    if not zone_maps.enabled():
+        return (0, 0)
+    ok_p = zone_maps.ensure_zones(probe_cache)
+    ok_b = zone_maps.ensure_zones(build_cache)
+    if not (ok_p and ok_b):
+        return (0, 0)
+    p_ivs = _zone_intervals(probe, join.left_key)
+    b_ivs = _zone_intervals(build, join.right_key)
+    if remap is not None:
+        from . import encoding as _encoding
+
+        p_sorted = _encoding._dict_map_for(probe.dictionary)[1]
+        p_ivs = [_map_interval(iv, remap, p_sorted) for iv in p_ivs]
+    _prune_side(probe, p_ivs, _global_bound(b_ivs))
+    _prune_side(build, b_ivs, _global_bound(p_ivs))
+    examined = len(probe.blocks) + len(build.blocks)
+    pruned = int((~probe.keep).sum()) + int((~build.keep).sum())
+    zone_maps.count_prune("join", "examined", examined)
+    zone_maps.count_prune("join", "pruned", pruned)
+    return (examined, pruned)
+
+
+# ---------------------------------------------------------------------------
+# device kernels
+# ---------------------------------------------------------------------------
+
+def _rank_probe(sorted_keys, probe):
+    """Group span per probe key over the stable-sorted build codes.
+    ``_MISS`` probes (NULL / unmapped) land before every real code; the
+    INT64_MAX shape padding lands after — both span zero rows."""
+    lo = jnp.searchsorted(sorted_keys, probe, side="left")
+    hi = jnp.searchsorted(sorted_keys, probe, side="right")
+    return lo, hi - lo
+
+
+def _hash_probe(table_keys, table_starts, table_counts, probe):
+    """Vectorized linear probe of the open-addressing table.  Table size
+    is a power of two, load factor ≤ 0.5 — every probe chain terminates
+    at a match or an empty slot.  The table arrays are dynamic inputs;
+    only their power-of-two SHAPES key the compile cache."""
+    size = table_keys.shape[0]
+    shift = jnp.uint64(64 - (int(size).bit_length() - 1))
+    h = (probe.astype(jnp.uint64) * jnp.uint64(_MULT)) >> shift
+    mask = jnp.int64(size - 1)
+
+    def cond(st):
+        return jnp.any(st[3])
+
+    def body(st):
+        slot, starts, counts, active = st
+        k = table_keys[slot]
+        found = active & (k == probe) & (probe != jnp.int64(_EMPTY))
+        starts = jnp.where(found, table_starts[slot], starts)
+        counts = jnp.where(found, table_counts[slot], counts)
+        active = active & ~found & (k != jnp.int64(_EMPTY))
+        slot = jnp.where(active, (slot + 1) & mask, slot)
+        return slot, starts, counts, active
+
+    n = probe.shape[0]
+    init = (h.astype(jnp.int64), jnp.zeros(n, jnp.int64),
+            jnp.zeros(n, jnp.int64), jnp.ones(n, jnp.bool_))
+    _, starts, counts, _ = jax.lax.while_loop(cond, body, init)
+    return starts, counts
+
+
+_KERNELS: dict[str, object] = {}
+
+
+def _kernel(path: str):
+    fn = _KERNELS.get(path)
+    if fn is None:
+        from . import observatory as _obs
+
+        # lint: allow(jit-nocache) -- compiled once per path and memoized
+        # in _KERNELS; inputs are pow-2 shape buckets so retraces quantize
+        raw = jax.jit(_rank_probe if path == "rank" else _hash_probe)
+        fn = _obs.timed_jit(raw, f"jax_join.{path}", path)
+        _KERNELS[path] = fn
+    return fn
+
+
+def _pow2_pad(a: np.ndarray, fill: int) -> np.ndarray:
+    """Shape-bucket padding: compile keys quantize to powers of two."""
+    n = len(a)
+    m = 1 << max(3, (max(n, 1) - 1).bit_length())
+    if m == n:
+        return a
+    out = np.full(m, fill, dtype=np.int64)
+    out[:n] = a
+    return out
+
+
+def _build_hash_table(ukeys, ustarts, ucounts):
+    """Pack unique build keys into the open-addressing table host-side.
+    Vectorized round-based insertion: each round claims every first
+    contender of a free slot, losers step to their next slot.  Slots only
+    ever flip empty→occupied, so every slot a key stepped past stays
+    occupied — the device's probe-until-empty walk is sound."""
+    if np.any(ukeys == _EMPTY):
+        raise JoinDecline("sentinel_key")
+    size = 8
+    while size < 2 * len(ukeys):
+        size <<= 1
+    shift = np.uint64(64 - (size.bit_length() - 1))
+    tk = np.full(size, _EMPTY, dtype=np.int64)
+    ts = np.zeros(size, dtype=np.int64)
+    tc = np.zeros(size, dtype=np.int64)
+    slots = ((ukeys.astype(np.uint64) * np.uint64(_MULT)) >> shift).astype(np.int64)
+    pending = np.arange(len(ukeys))
+    while pending.size:
+        s = slots[pending]
+        order = np.argsort(s, kind="stable")
+        so = s[order]
+        lead = np.ones(so.size, dtype=bool)
+        lead[1:] = so[1:] != so[:-1]
+        cand = order[lead]
+        win = cand[tk[s[cand]] == _EMPTY]
+        idx = pending[win]
+        tk[s[win]] = ukeys[idx]
+        ts[s[win]] = ustarts[idx]
+        tc[s[win]] = ucounts[idx]
+        placed = np.zeros(pending.size, dtype=bool)
+        placed[win] = True
+        pending = pending[~placed]
+        slots[pending] = (slots[pending] + 1) & (size - 1)
+    return tk, ts, tc
+
+
+# ---------------------------------------------------------------------------
+# pair expansion + late materialization
+# ---------------------------------------------------------------------------
+
+def _gather_build(build: _Side, bschema, bids: np.ndarray) -> list[Column]:
+    """Build-side output columns for the surviving pairs: per-block
+    ``take`` decodes ONLY the selected rows (``EncodedColumn.take`` is
+    the late-materialize gather); dictionary payloads stay codes when
+    every block shares one dictionary object, else survivors decode."""
+    k = len(bids)
+    sels = []
+    gbase = 0
+    for blk in build.blocks:
+        m = (bids >= gbase) & (bids < gbase + blk.n_valid)
+        pos = np.flatnonzero(m)
+        if pos.size:
+            sels.append((blk, pos, bids[pos] - gbase))
+        gbase += blk.n_valid
+    out = []
+    for j, (et, frac) in enumerate(bschema):
+        d0 = build.blocks[0].cols[j].dictionary
+        shared = d0 is not None and all(
+            b.cols[j].dictionary is d0 for b in build.blocks)
+        vals = None
+        nulls = np.zeros(k, dtype=bool)
+        for blk, pos, local in sels:
+            piece = blk.cols[j].take(local)
+            if piece.dictionary is not None and not shared:
+                piece = piece.decoded()
+                if piece.dictionary is not None:
+                    raise JoinDecline("payload_dict")
+            pdata = np.asarray(piece.data)
+            if vals is None:
+                vals = np.zeros(k, dtype=pdata.dtype)
+            vals[pos] = pdata
+            nulls[pos] = np.asarray(piece.nulls)
+        if vals is None:
+            vals = np.zeros(k, dtype=object if et == EvalType.BYTES else np.int64)
+        return_dict = d0 if shared else None
+        out.append(Column(et, vals, nulls, frac, dictionary=return_dict))
+    return out
+
+
+def _expand_pairs(starts, counts, sorted_ids):
+    """(probe concat index, build global row id) per surviving pair, in
+    the CPU oracle's order: probe stream order, build-row order within
+    one probe row's matches."""
+    counts = counts.astype(np.int64)
+    total = int(counts.sum())
+    if total == 0:
+        return None, None
+    pidx = np.repeat(np.arange(len(counts), dtype=np.int64), counts)
+    offs = (np.arange(total, dtype=np.int64)
+            - np.repeat(np.cumsum(counts) - counts, counts))
+    bpos = np.repeat(starts.astype(np.int64), counts) + offs
+    return pidx, sorted_ids[bpos]
+
+
+# ---------------------------------------------------------------------------
+# serve
+# ---------------------------------------------------------------------------
+
+def serve(dag: DagRequest, probe_cache, build_cache,
+          prefer: str | None = None):
+    """Run a warm two-image join plan on the device.
+
+    Returns ``(SelectResponse, path, stats)`` where ``stats`` carries the
+    observatory's build/probe/output row counts and the zone-prune pair;
+    raises :class:`JoinDecline` (named cause, CPU serves) on any shape
+    the kernels do not cover.  Byte identity with the CPU oracle holds
+    because match ORDER is reproduced exactly and the downstream
+    descriptors run through the very same executor code over the joined
+    chunks."""
+    probe_scan, join, downstream = analyze_plan(dag)
+    probe = _Side(probe_cache, join.left_key, "probe")
+    build = _Side(build_cache, join.right_key, "build")
+    if probe.kind != build.kind:
+        raise JoinDecline("key_form_mismatch")
+    if probe.kind == "int":
+        p_et = probe.blocks[0].cols[join.left_key].eval_type
+        b_et = build.blocks[0].cols[join.right_key].eval_type
+        if p_et != b_et:
+            raise JoinDecline("key_form_mismatch")
+
+    remap = _remap_for(probe, build) if probe.kind == "dict" else None
+    feasible = ("rank", "hash") if probe.kind == "dict" else ("hash",)
+    path = _PATH_OVERRIDE or prefer
+    if path not in feasible:
+        path = feasible[0]
+
+    examined, pruned = _zone_prune(probe, build, join, remap,
+                                   probe_cache, build_cache)
+
+    # build lanes: concat kept blocks, global row ids, stable sort by key
+    bkeys, bids = [], []
+    gbase = 0
+    for i, blk in enumerate(build.blocks):
+        if build.keep[i]:
+            k, valid = build.key_lane(blk, join.right_key)
+            bkeys.append(k[valid])
+            bids.append(gbase + np.flatnonzero(valid))
+        gbase += blk.n_valid
+    bkeys = np.concatenate(bkeys) if bkeys else np.empty(0, dtype=np.int64)
+    bids = np.concatenate(bids) if bids else np.empty(0, dtype=np.int64)
+    perm = np.argsort(bkeys, kind="stable")
+    sorted_keys = bkeys[perm]
+    sorted_ids = bids[perm]
+
+    # probe lanes: concat kept blocks in stream order, NULLs to the miss
+    # sentinel, dict codes remapped into build code space
+    miss = _MISS if path == "rank" else np.int64(_EMPTY)
+    parts = []            # (block, concat base, n_valid)
+    pkeys = []
+    cb = 0
+    for i, blk in enumerate(probe.blocks):
+        if not probe.keep[i]:
+            continue
+        k, valid = probe.key_lane(blk, join.left_key)
+        if remap is not None:
+            if len(remap) == 0:
+                valid = np.zeros(len(k), dtype=bool)
+            else:
+                k = np.where(valid,
+                             remap[np.clip(k, 0, len(remap) - 1)], k)
+                valid = valid & (k != _MISS)
+        k[~valid] = miss
+        parts.append((blk, cb, blk.n_valid))
+        pkeys.append(k)
+        cb += blk.n_valid
+    n_probe = cb
+    pkeys = np.concatenate(pkeys) if pkeys else np.empty(0, dtype=np.int64)
+
+    stats = {"build_rows": build.n_rows, "probe_rows": probe.n_rows,
+             "out_rows": 0, "prune": (examined, pruned)}
+    if n_probe and len(sorted_keys):
+        probe_dev = _pow2_pad(pkeys, miss)
+        if path == "rank":
+            starts, counts = _kernel("rank")(
+                _pow2_pad(sorted_keys, np.iinfo(np.int64).max), probe_dev)
+        else:
+            lead = np.ones(len(sorted_keys), dtype=bool)
+            lead[1:] = sorted_keys[1:] != sorted_keys[:-1]
+            ustarts = np.flatnonzero(lead).astype(np.int64)
+            ucounts = np.diff(np.append(ustarts, len(sorted_keys)))
+            tk, ts, tc = _build_hash_table(sorted_keys[ustarts], ustarts,
+                                           ucounts)
+            starts, counts = _kernel("hash")(tk, ts, tc, probe_dev)
+        note_blocking("device.join:pull")
+        starts = np.asarray(starts)[:n_probe]
+        counts = np.asarray(counts)[:n_probe]
+        pidx, out_bids = _expand_pairs(starts, counts, sorted_ids)
+    else:
+        pidx = out_bids = None
+
+    pschema = [(c.ftype.eval_type, c.ftype.decimal)
+               for c in probe_scan.columns_info]
+    bschema = [(c.ftype.eval_type, c.ftype.decimal)
+               for c in join.build[0].columns_info]
+    chunks = []
+    if pidx is not None:
+        stats["out_rows"] = len(pidx)
+        for blk, base, nv in parts:
+            lo = np.searchsorted(pidx, base, side="left")
+            hi = np.searchsorted(pidx, base + nv, side="left")
+            if lo == hi:
+                continue
+            local = pidx[lo:hi] - base
+            cols = [c.take(local) for c in blk.cols]
+            cols += _gather_build(build, bschema, out_bids[lo:hi])
+            chunks.append(Chunk.full(cols))
+
+    # downstream descriptors finish on the SAME CPU executors the oracle
+    # runs — shared code is the byte-identity argument, not a twin
+    ex = ChunkFeedExecutor(pschema + bschema, chunks)
+    for desc in downstream:
+        ex = _attach(ex, desc, None)
+    enc = make_response_encoder(dag)
+    summary = ExecSummary()
+    batch = BATCH_INITIAL_SIZE
+    while True:
+        r = ex.next_batch(batch)
+        summary.num_iterations += 1
+        if r.chunk.num_rows:
+            enc.add_chunk(r.chunk, dag.output_offsets)
+            summary.num_produced_rows += r.chunk.num_rows
+        if r.is_drained:
+            break
+        if batch < BATCH_MAX_SIZE:
+            batch = min(batch * BATCH_GROW_FACTOR, BATCH_MAX_SIZE)
+    resp = enc.to_response(exec_summaries=[summary])
+    resp._obs_prune = (examined, pruned)
+    return resp, path, stats

@@ -96,6 +96,13 @@ class MvccBatchScanSource(ScanSource):
         self._resolved: tuple[list[bytes], list[bytes]] | None = None
         self._pos = 0
 
+    def fork(self, ranges: list[tuple[bytes, bytes]]) -> "MvccBatchScanSource":
+        # join build-side sibling: same snapshot/ts, own ranges; version
+        # recording stays off — only the probe side's image is delta-tracked
+        return MvccBatchScanSource(self.snap, self.ts, ranges,
+                                   statistics=self.stats,
+                                   bypass_locks=self.bypass_locks)
+
     def _resolve_all(self) -> tuple[list[bytes], list[bytes]]:
         keys_out: list[bytes] = []
         vals_out: list[bytes] = []

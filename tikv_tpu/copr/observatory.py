@@ -118,6 +118,10 @@ def _describe(sig: tuple) -> str:
             parts.append(f"topn({p[1]})")
         elif k == "limit":
             parts.append(f"limit({p[1]})")
+        elif k == "proj":
+            parts.append(f"proj[{len(p[1])}]")
+        elif k == "join":
+            parts.append(f"join({p[1]};k{p[2]}=k{p[3]};b={_describe(p[4])})")
         elif k != "out":
             parts.append(str(k))
     return "|".join(parts)
@@ -129,7 +133,8 @@ class _Window:
     sampled trace ids of the window."""
 
     __slots__ = ("start", "count", "lat_sum", "rows", "occ_sum", "waste_sum",
-                 "waste_n", "qwait_sum", "blk_exam", "blk_pruned", "buckets",
+                 "waste_n", "qwait_sum", "blk_exam", "blk_pruned",
+                 "join_build", "join_probe", "join_out", "buckets",
                  "exemplars")
 
     def __init__(self, start: float):
@@ -143,11 +148,15 @@ class _Window:
         self.qwait_sum = 0.0
         self.blk_exam = 0
         self.blk_pruned = 0
+        self.join_build = 0
+        self.join_probe = 0
+        self.join_out = 0
         self.buckets = [0] * (len(BUCKETS) + 1)
         self.exemplars: list[tuple[float, str]] = []
 
     def add(self, latency_s, rows, occupancy, queue_wait_s, padding_waste,
-            trace_id, blocks_examined=0, blocks_pruned=0) -> None:
+            trace_id, blocks_examined=0, blocks_pruned=0,
+            join_build_rows=0, join_probe_rows=0, join_out_rows=0) -> None:
         self.count += 1
         self.lat_sum += latency_s
         self.rows += rows
@@ -155,6 +164,9 @@ class _Window:
         self.qwait_sum += queue_wait_s
         self.blk_exam += blocks_examined
         self.blk_pruned += blocks_pruned
+        self.join_build += join_build_rows
+        self.join_probe += join_probe_rows
+        self.join_out += join_out_rows
         if padding_waste is not None:
             self.waste_sum += padding_waste
             self.waste_n += 1
@@ -201,13 +213,16 @@ class _Profile:
 
     def add(self, now, latency_s, rows, occupancy, queue_wait_s,
             padding_waste, trace_id, blocks_examined=0,
-            blocks_pruned=0) -> None:
+            blocks_pruned=0, join_build_rows=0, join_probe_rows=0,
+            join_out_rows=0) -> None:
         self.total_count += 1
         self.total_lat += latency_s
         self.total_rows += rows
         self._current(now).add(latency_s, rows, occupancy, queue_wait_s,
                                padding_waste, trace_id,
-                               blocks_examined, blocks_pruned)
+                               blocks_examined, blocks_pruned,
+                               join_build_rows, join_probe_rows,
+                               join_out_rows)
 
     def decline(self, cause: str) -> None:
         if cause in self.declines or len(self.declines) < _MAX_DECLINE_CAUSES:
@@ -220,6 +235,7 @@ class _Profile:
         counts = [0] * (len(BUCKETS) + 1)
         n = lat = rows = occ = qwait = waste = 0.0
         waste_n = blk_exam = blk_pruned = 0
+        j_build = j_probe = j_out = 0
         exemplars: list[tuple[float, str]] = []
         for w in self.windows:
             for i, c in enumerate(w.buckets):
@@ -233,6 +249,9 @@ class _Profile:
             waste_n += w.waste_n
             blk_exam += w.blk_exam
             blk_pruned += w.blk_pruned
+            j_build += w.join_build
+            j_probe += w.join_probe
+            j_out += w.join_out
             exemplars.extend(w.exemplars)
         exemplars.sort(reverse=True)
         pct = lambda q: percentile_from_buckets(BUCKETS, counts, int(n), q)
@@ -256,6 +275,14 @@ class _Profile:
             "blocks_pruned": blk_pruned,
             "pruned_fraction": (round(blk_pruned / blk_exam, 4)
                                 if blk_exam else None),
+            # device join profile (docs/device_join.md): per-sig build and
+            # probe magnitudes plus output selectivity (out rows per probe
+            # row) — what the cost router's join pricing keys on
+            "join_build_rows": j_build,
+            "join_probe_rows": j_probe,
+            "join_out_rows": j_out,
+            "join_selectivity": (round(j_out / j_probe, 4)
+                                 if j_probe else None),
             "queue_wait_ms_mean": round(qwait / n * 1e3, 4) if n else 0.0,
             "declines": dict(self.declines),
             "exemplar_traces": [tid for _lat, tid in exemplars[:_MAX_EXEMPLARS]],
@@ -311,7 +338,10 @@ class Observatory:
                      padding_waste: float | None = None,
                      trace_id: str | None = None, desc: str = "",
                      blocks_examined: int = 0,
-                     blocks_pruned: int = 0) -> None:
+                     blocks_pruned: int = 0,
+                     join_build_rows: int = 0,
+                     join_probe_rows: int = 0,
+                     join_out_rows: int = 0) -> None:
         """One served request on ``path`` under plan signature ``sig``.
         ``latency_s`` is the request's attributed share for batch-served
         riders (the scheduler's per-request share), the tracked total for
@@ -325,7 +355,8 @@ class Observatory:
             if prof is None:
                 prof = entry.paths[(path, encoding)] = _Profile(self.window_s, now)
             prof.add(now, latency_s, rows, occupancy, queue_wait_s,
-                     padding_waste, trace_id, blocks_examined, blocks_pruned)
+                     padding_waste, trace_id, blocks_examined, blocks_pruned,
+                     join_build_rows, join_probe_rows, join_out_rows)
         REGISTRY.counter(
             "tikv_observatory_serve_total",
             "Requests recorded by the performance observatory, by path",
@@ -804,6 +835,11 @@ def format_sig(sig: str, entry: dict) -> str:
             f"occ={v['mean_occupancy']} qwait={v['queue_wait_ms_mean']}ms"
             + (f" waste={v['padding_waste']}"
                if v.get("padding_waste") is not None else ""))
+        if v.get("join_probe_rows"):
+            lines.append(
+                f"    join: build={v['join_build_rows']} "
+                f"probe={v['join_probe_rows']} out={v['join_out_rows']} "
+                f"selectivity={v['join_selectivity']}")
         if v.get("declines"):
             lines.append(f"    declines: {v['declines']}")
         if v.get("exemplar_traces"):

@@ -66,7 +66,9 @@ from .dag import (
     Aggregation,
     DagRequest,
     IndexScan,
+    Join,
     Limit,
+    Projection,
     Selection,
     TableScan,
     TopN,
@@ -163,30 +165,40 @@ def _expr_sig(e):
     return ("?", repr(e))
 
 
+def _exec_sig(ex) -> tuple:
+    """One executor descriptor's shape key.  A Join recurses into its
+    build chain but deliberately EXCLUDES the build ranges and region
+    context — those vary per request without changing the compiled
+    program shape, exactly like the probe ranges."""
+    if isinstance(ex, TableScan):
+        return ("tablescan", ex.table_id, schema_sig(ex.columns_info))
+    if isinstance(ex, IndexScan):
+        return ("indexscan", ex.table_id, ex.index_id,
+                schema_sig(ex.columns_info))
+    if isinstance(ex, Selection):
+        return ("sel", tuple(_expr_sig(c) for c in ex.conditions))
+    if isinstance(ex, Aggregation):
+        return ("agg", bool(ex.streamed),
+                tuple(_expr_sig(g) for g in ex.group_by),
+                tuple((a.op, _expr_sig(a.expr)) for a in ex.agg_funcs))
+    if isinstance(ex, TopN):
+        return ("topn", ex.limit,
+                tuple((_expr_sig(e), bool(d)) for e, d in ex.order_by))
+    if isinstance(ex, Limit):
+        return ("limit", ex.limit)
+    if isinstance(ex, Projection):
+        return ("proj", tuple(_expr_sig(e) for e in ex.exprs))
+    if isinstance(ex, Join):
+        return ("join", ex.join_type, ex.left_key, ex.right_key,
+                tuple(_exec_sig(b) for b in ex.build))
+    return (type(ex).__name__,)
+
+
 def plan_signature(dag: DagRequest) -> tuple:
     """The micro-batch key: two DAGs with equal signatures compile to the
     same device program shape, so their executions can share one dispatch
     (over different region images)."""
-    parts = []
-    for ex in dag.executors:
-        if isinstance(ex, TableScan):
-            parts.append(("tablescan", ex.table_id, schema_sig(ex.columns_info)))
-        elif isinstance(ex, IndexScan):
-            parts.append(("indexscan", ex.table_id, ex.index_id,
-                          schema_sig(ex.columns_info)))
-        elif isinstance(ex, Selection):
-            parts.append(("sel", tuple(_expr_sig(c) for c in ex.conditions)))
-        elif isinstance(ex, Aggregation):
-            parts.append(("agg", bool(ex.streamed),
-                          tuple(_expr_sig(g) for g in ex.group_by),
-                          tuple((a.op, _expr_sig(a.expr)) for a in ex.agg_funcs)))
-        elif isinstance(ex, TopN):
-            parts.append(("topn", ex.limit,
-                          tuple((_expr_sig(e), bool(d)) for e, d in ex.order_by)))
-        elif isinstance(ex, Limit):
-            parts.append(("limit", ex.limit))
-        else:
-            parts.append((type(ex).__name__,))
+    parts = [_exec_sig(ex) for ex in dag.executors]
     # encode_type is part of the slot identity: identical requests share one
     # slot's RESPONSE BYTES, and a datum and a chunk request with the same
     # plan must never share those (mirrors the service parse-memo rule)

@@ -255,7 +255,10 @@ class RowBatchDecoder:
         if blen <= 8:
             padded = np.zeros((n, 8), dtype=np.uint8)
             padded[:, :blen] = raw
-            keys = padded.view(np.uint64).reshape(n)
+            # big-endian packing: uint64 numeric order == lexicographic
+            # bytes order, so the dictionary comes out SORTED — rank joins
+            # and code-space range rewrites key on that
+            keys = padded.view(np.uint64).reshape(n).byteswap()
             cached = self._dict_cache.get(col_id)
             if cached is not None:
                 sorted_keys, values = cached
@@ -265,7 +268,7 @@ class RowBatchDecoder:
                     return pos_c.astype(np.int64), values
             uk, codes = np.unique(keys, return_inverse=True)
             values = np.empty(len(uk), dtype=object)
-            kb = uk.view(np.uint8).reshape(len(uk), 8)
+            kb = uk.byteswap().view(np.uint8).reshape(len(uk), 8)
             for j in range(len(uk)):
                 values[j] = kb[j, :blen].tobytes()
             self._dict_cache[col_id] = (uk, values)
