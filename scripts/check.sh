@@ -7,7 +7,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== lint (blocking-under-lock, jit recompile, metric/failpoint drift) =="
+echo "== lint (blocking-under-lock, jit recompile, metric/failpoint drift, buffer aliasing) =="
 python scripts/lint.py tikv_tpu tests
 
 if [[ "${1:-}" == "--lint" ]]; then
@@ -63,5 +63,10 @@ JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
 echo "== device-join smoke: rank/hash join differential pool, no-decode survivors, decline causes under the sanitizer =="
 JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
   -m 'not slow' tests/test_device_join.py
+
+echo "== bufsan smoke: zero-copy exposure ledger over chunk wire + warm serve + wt folds under the sanitizer =="
+JAX_PLATFORMS=cpu TIKV_TPU_SANITIZE=1 python -m pytest -q -p no:cacheprovider \
+  -m 'not slow' tests/test_bufsan.py tests/test_chunk_wire.py \
+  tests/test_write_through.py
 
 echo "check.sh: all gates green"

@@ -33,3 +33,10 @@ if os.environ.get("TIKV_TPU_SANITIZE") == "1":
             "lock-order inversions observed during the run:\n\n"
             + "\n\n".join(r.format() for r in cycles)
         )
+        from tikv_tpu.analysis import bufsan
+
+        violations = bufsan.reports()
+        assert not violations, (
+            "buffer mutations while exposed observed during the run:\n\n"
+            + "\n\n".join(r.format() for r in violations)
+        )

@@ -351,6 +351,26 @@ def test_chunk_column_slab_is_own_frame_part():
     assert response_data(joined[1]) == r.data
 
 
+def test_bytes_view_payloads_are_read_only():
+    """``loads(bytes_view=True)`` hands out memoryviews that alias the
+    shared frame buffer: the read-only contract (docs/wire_path.md
+    §zero-copy) is enforced, not advisory — writing through one raises."""
+    payload = bytes(range(256)) * 16  # ≥ PASSTHROUGH_MIN
+    frame = wire.dumps({"data": payload, "small": b"tiny"})
+    out = wire.loads(bytearray(frame), bytes_view=True)
+    mv = out["data"]
+    assert isinstance(mv, memoryview) and mv.readonly
+    with pytest.raises(TypeError):
+        mv[0] = 0
+    with pytest.raises(TypeError):
+        mv[1:3] = b"xx"
+    assert bytes(mv) == payload
+    # below-threshold payloads keep the plain-bytes contract
+    assert out["small"] == b"tiny"
+    # default mode never hands out views at all
+    assert isinstance(wire.loads(frame)["data"], bytes)
+
+
 # ---------------------------------------------------------------------------
 # streaming
 # ---------------------------------------------------------------------------

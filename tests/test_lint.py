@@ -259,6 +259,155 @@ def test_raw_lock_direct_in_wired_module(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# buffer-inplace-export
+# ---------------------------------------------------------------------------
+
+def test_inplace_after_export_flagged(tmp_path):
+    active, _ = _lint_src(tmp_path, """
+        from tikv_tpu.server.wire import dumps_parts
+        def bad(arr):
+            parts = dumps_parts(arr)
+            arr[0:4] = 0
+            return parts
+        def also_bad(arr):
+            parts = dumps_parts(arr)
+            arr += 1
+            return parts
+        def good_fill_then_export(arr):
+            arr[0] = 1
+            return dumps_parts(arr)
+    """)
+    assert _rules(active) == ["buffer-inplace-export"] * 2
+    assert "flowed to the zero-copy export" in active[0].message
+
+
+def test_inplace_sort_and_copyto_flagged(tmp_path):
+    active, _ = _lint_src(tmp_path, """
+        import numpy as np
+        from tikv_tpu.analysis import bufsan
+        def bad(arr, other):
+            bufsan.export("wire_part", arr)
+            np.copyto(arr, other)
+        def bad2(arr):
+            bufsan.export("wire_part", arr)
+            arr.sort()
+    """)
+    assert _rules(active) == ["buffer-inplace-export"] * 2
+
+
+def test_inplace_transitive_through_local_call(tmp_path):
+    """Taint follows a positional arg into a local function whose body
+    exports that parameter."""
+    active, _ = _lint_src(tmp_path, """
+        from tikv_tpu.server.wire import dumps_parts
+        def send(buf):
+            return dumps_parts(buf)
+        def bad(arr):
+            p = send(arr)
+            arr[3] = 9
+            return p
+        def good(arr):
+            arr[3] = 9
+            return send(arr)
+    """)
+    assert _rules(active) == ["buffer-inplace-export"]
+
+
+def test_inplace_export_waivable(tmp_path):
+    active, waived = _lint_src(tmp_path, """
+        from tikv_tpu.server.wire import dumps_parts
+        def deliberate(arr):
+            parts = dumps_parts(arr)
+            # lint: allow(buffer-inplace-export) -- strike test fixture
+            arr[0] = 1
+            return parts
+    """)
+    assert active == []
+    assert _rules(waived) == ["buffer-inplace-export"]
+
+
+# ---------------------------------------------------------------------------
+# buffer-export-unregistered
+# ---------------------------------------------------------------------------
+
+def test_boundary_without_bufsan_flagged(tmp_path):
+    active, _ = _lint_src(tmp_path, """
+        def dumps_parts(obj):
+            return [obj]
+    """, rel="tikv_tpu/server/wire.py")
+    assert _rules(active) == ["buffer-export-unregistered"]
+    assert "dumps_parts" in active[0].message
+
+
+def test_boundary_routed_through_bufsan_clean(tmp_path):
+    """Transitive reach counts: the boundary may delegate registration to
+    a same-module helper."""
+    active, _ = _lint_src(tmp_path, """
+        from tikv_tpu.analysis import bufsan as _bufsan
+        def _register(o):
+            _bufsan.export("wire_part", o)
+        def dumps_parts(obj):
+            _register(obj)
+            return [obj]
+    """, rel="tikv_tpu/server/wire.py")
+    assert active == []
+
+
+def test_boundary_rule_scoped_to_named_files(tmp_path):
+    """A dumps_parts defined elsewhere is not an exposure boundary."""
+    active, _ = _lint_src(tmp_path, """
+        def dumps_parts(obj):
+            return [obj]
+    """, rel="tikv_tpu/other.py")
+    assert active == []
+
+
+# ---------------------------------------------------------------------------
+# view-escape
+# ---------------------------------------------------------------------------
+
+def test_view_escape_flagged(tmp_path):
+    active, _ = _lint_src(tmp_path, """
+        class Cache:
+            def get_block(self):
+                return self._buf[2:10]
+            def expose(self):
+                return memoryview(self.raw)
+    """)
+    assert _rules(active) == ["view-escape"] * 2
+
+
+def test_view_escape_copies_and_private_clean(tmp_path):
+    active, _ = _lint_src(tmp_path, """
+        from tikv_tpu.analysis import bufsan
+        class Cache:
+            def copied(self):
+                return self._buf[2:10].copy()
+            def frozen(self):
+                return memoryview(self.raw).toreadonly()
+            def _internal(self):
+                return self._buf[2:10]
+            def registered(self):
+                bufsan.export("wire_part", self._buf)
+                return self._buf[2:10]
+            def not_a_buffer(self):
+                return self.items[2:10]
+    """)
+    assert active == []
+
+
+def test_view_escape_waivable(tmp_path):
+    active, waived = _lint_src(tmp_path, """
+        class Row:
+            def cell(self):
+                # lint: allow(view-escape) -- raw is bytes, slices copy
+                return self.raw[2:10]
+    """)
+    assert active == []
+    assert _rules(waived) == ["view-escape"]
+
+
+# ---------------------------------------------------------------------------
 # the gate
 # ---------------------------------------------------------------------------
 

@@ -20,6 +20,7 @@ import tikv_tpu.storage.txn.scheduler  # noqa: F401,E402
 
 # series registered lazily at first use (counters created inside handlers)
 LAZY_SERIES = {
+    "tikv_bufsan_total",
     "tikv_coprocessor_request_total",
     "tikv_coprocessor_request_duration_seconds",
     "tikv_coprocessor_device_fallback_total",

@@ -38,6 +38,23 @@ Rules (docs/static_analysis.md has the full catalog and waiver syntax):
     A sanitizer-wired module creating ``threading.Lock/RLock/Condition``
     directly instead of through ``analysis.sanitizer.make_*`` — the lock
     would silently escape order tracking.
+``buffer-inplace-export``
+    An in-place numpy mutation (``x[...] = v``, ``+=``, ``np.copyto``,
+    ``.sort()``/``.fill()``) on a name that flows into
+    ``wire.dumps_parts`` / ``bufsan.export`` in the same function (directly
+    or through a same-module call) — the zero-copy wire path holds that
+    buffer until the send completes, so a later in-place write corrupts
+    frames already handed to the kernel.
+``buffer-export-unregistered``
+    An exposure-boundary function (``dumps_parts``, ``write_frame_parts``,
+    ``encode_parts``, the device-pin cache entry points) that doesn't route
+    through ``analysis.bufsan`` export/release — the buffer would cross the
+    zero-copy boundary invisible to the runtime sanitizer.
+``view-escape``
+    A public method returning a ``memoryview`` or slice of a cache-resident
+    buffer attribute without ``.copy()``/``.tobytes()``/``.toreadonly()``
+    or bufsan export registration: the caller holds an aliasing view into
+    state a later fold mutates in place.
 
 Waivers: ``# lint: allow(rule-name[, rule2]) -- reason`` on the flagged
 line or the line directly above it.  Every waiver should carry a reason.
@@ -85,6 +102,10 @@ _SANITIZER_WIRED = {
     "tikv_tpu/storage/txn/scheduler.py",
     "tikv_tpu/storage/concurrency_manager.py",
     "tikv_tpu/copr/breaker.py",
+    "tikv_tpu/copr/cache.py",
+    "tikv_tpu/copr/dag.py",
+    "tikv_tpu/copr/endpoint.py",
+    "tikv_tpu/copr/jax_join.py",
     "tikv_tpu/copr/costmodel.py",
     "tikv_tpu/copr/encoding.py",
     "tikv_tpu/copr/integrity.py",
@@ -97,6 +118,7 @@ _SANITIZER_WIRED = {
     "tikv_tpu/raft/fsm_system.py",
     "tikv_tpu/sidecar/resolved_ts.py",
     "tikv_tpu/server/read_plane.py",
+    "tikv_tpu/server/wire.py",
     "tikv_tpu/util/chaos.py",
     "tikv_tpu/util/retry.py",
     "tikv_tpu/util/trace.py",
@@ -105,6 +127,27 @@ _SANITIZER_WIRED = {
 
 # files whose functions count as "device code" for the jit rules
 _DEVICE_FILES = ("copr/jax_eval.py", "copr/jax_zone.py", "parallel/mesh.py")
+
+# exposure-boundary functions that MUST route through analysis.bufsan
+# (export at the boundary, release at the completion point) — the runtime
+# half of the zero-copy contract (docs/static_analysis.md §bufsan)
+_BUFSAN_BOUNDARY = {
+    "tikv_tpu/server/wire.py": ("dumps_parts",),
+    "tikv_tpu/server/server.py": ("write_frame_parts",),
+    "tikv_tpu/copr/dag.py": ("encode_parts",),
+    "tikv_tpu/copr/cache.py": ("device_arrays", "drop_device", "scatter_update"),
+}
+_BUFSAN_MODULES = ("bufsan", "_bufsan")
+_BUFSAN_CALLS = {"export", "release", "release_parts", "note_mutation",
+                 "verify_all"}
+# in-place ndarray methods for the buffer-inplace-export rule (``.clear()``
+# etc. would drown the rule in dict/list noise)
+_INPLACE_METHODS = {"sort", "fill", "partition", "byteswap"}
+# attribute names that smell like a shared buffer for the view-escape rule
+_BUF_NAME_RE = re.compile(
+    r"(^|_)(data|buf|buffer|raw|bytes|payload|arr|array|nulls|packed|slab|"
+    r"frame|view|blob|chunk)s?\d*$"
+)
 
 _METRIC_REF_RE = re.compile(r"\btikv_[a-z0-9_]+")
 _HISTO_SUFFIXES = ("_bucket", "_sum", "_count")
@@ -120,6 +163,12 @@ RULES = {
     "failpoint-drift-test": "test configures an unknown failpoint",
     "failpoint-drift-source": "failpoint site never exercised by tests",
     "raw-lock-direct": "wired module bypasses analysis.sanitizer lock factories",
+    "buffer-inplace-export": "in-place mutation of a buffer that flows to the "
+                             "zero-copy wire boundary",
+    "buffer-export-unregistered": "exposure-boundary function bypasses "
+                                  "analysis.bufsan export/release",
+    "view-escape": "public method returns an aliasing view of a "
+                   "cache-resident buffer",
 }
 
 
@@ -449,6 +498,207 @@ class _FileLint(ast.NodeVisitor):
         low = src.lower()
         return any(tok in low for tok in ("cache", "memo", "_fns", "lru"))
 
+    # -- bufsan rules -------------------------------------------------------
+
+    @staticmethod
+    def _bufsan_call_name(call: ast.Call) -> str | None:
+        """``bufsan.export`` / ``_bufsan.release_parts`` etc., else None."""
+        chain = _attr_chain(call.func)
+        if (len(chain) >= 2 and chain[-2] in _BUFSAN_MODULES
+                and chain[-1] in _BUFSAN_CALLS):
+            return chain[-1]
+        return None
+
+    def _bufsan_reach(self) -> set[str]:
+        """Qualnames that touch analysis.bufsan, directly or through a
+        same-class/same-module call (same fixpoint shape as blocking)."""
+        reach = {
+            q for q, info in self.funcs.items()
+            if any(isinstance(n, ast.Call) and self._bufsan_call_name(n)
+                   for n in ast.walk(info.node))
+        }
+        changed = True
+        while changed:
+            changed = False
+            for q, info in self.funcs.items():
+                if q in reach:
+                    continue
+                for kind, name in info.calls:
+                    callee = self._resolve(info, kind, name)
+                    if callee is not None and callee.qualname in reach:
+                        reach.add(q)
+                        changed = True
+                        break
+        return reach
+
+    @staticmethod
+    def _sink_args(call: ast.Call) -> list[ast.AST]:
+        """Buffer-valued arguments of a direct export sink: the payload of
+        ``dumps_parts(obj)`` or ``bufsan.export(kind, buf, ...)``."""
+        chain = _attr_chain(call.func)
+        if chain[-1:] == ["dumps_parts"] and call.args:
+            return [call.args[0]]
+        if (len(chain) >= 2 and chain[-2] in _BUFSAN_MODULES
+                and chain[-1] == "export" and len(call.args) >= 2):
+            return [call.args[1]]
+        return []
+
+    @staticmethod
+    def _buf_key(node: ast.AST) -> str | None:
+        """Taint key for a buffer expression: a dotted name chain, or the
+        chain inside a trivial wrapper (``memoryview(x)``)."""
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "memoryview" and node.args):
+            node = node.args[0]
+        if not isinstance(node, (ast.Name, ast.Attribute)):
+            return None
+        chain = _attr_chain(node)
+        if not chain or chain == ["self"] or chain[0] == "?":
+            return None
+        return ".".join(chain)
+
+    def check_bufsan(self) -> None:
+        if not self.relpath.startswith("tikv_tpu/"):
+            return
+        reach = self._bufsan_reach()
+        self._check_export_unregistered(reach)
+        self._check_inplace_export()
+        self._check_view_escape(reach)
+
+    def _check_export_unregistered(self, reach: set[str]) -> None:
+        for fname in _BUFSAN_BOUNDARY.get(self.relpath, ()):
+            for q, info in self.funcs.items():
+                if q != fname and not q.endswith(f".{fname}"):
+                    continue
+                if q in reach:
+                    continue
+                self.findings.append(Finding(
+                    self.path, info.node.lineno, "buffer-export-unregistered",
+                    f"{q}() is an exposure boundary but never routes through "
+                    f"analysis.bufsan export/release — buffers cross the "
+                    f"zero-copy plane invisible to the sanitizer",
+                ))
+
+    def _check_inplace_export(self) -> None:
+        # param indices each local function exports (fixpoint over direct
+        # sinks, so taint follows ``f(buf)`` into f's own dumps_parts call)
+        exported_params: dict[str, set[int]] = {q: set() for q in self.funcs}
+        changed = True
+        while changed:
+            changed = False
+            for q, info in self.funcs.items():
+                params = [a.arg for a in info.node.args.args]
+                keys = self._exported_keys(info, exported_params)
+                for i, p in enumerate(params):
+                    if p in keys and i not in exported_params[q]:
+                        exported_params[q].add(i)
+                        changed = True
+        for info in self.funcs.values():
+            exported = self._exported_keys(info, exported_params)
+            if not exported:
+                continue
+            self._scan_mutations(info, exported)
+
+    def _exported_keys(self, info: _FuncInfo,
+                       exported_params: dict[str, set[int]]) -> dict[str, int]:
+        """key -> line of the earliest export of that name inside ``info``:
+        direct sink args plus positional args handed to local callees at
+        positions those callees export."""
+        out: dict[str, int] = {}
+
+        def note(node: ast.AST, line: int) -> None:
+            key = self._buf_key(node)
+            if key is not None and (key not in out or line < out[key]):
+                out[key] = line
+
+        for call in ast.walk(info.node):
+            if not isinstance(call, ast.Call):
+                continue
+            for arg in self._sink_args(call):
+                note(arg, call.lineno)
+            chain = _attr_chain(call.func)
+            callee, bound = None, 0
+            if len(chain) == 2 and chain[0] == "self":
+                callee = self._resolve(info, "self", chain[1])
+                bound = 1  # callee's args.args leads with self
+            elif len(chain) == 1:
+                callee = self._resolve(info, "bare", chain[0])
+            if callee is not None:
+                for i in exported_params.get(callee.qualname, ()):
+                    j = i - bound
+                    if 0 <= j < len(call.args):
+                        note(call.args[j], call.lineno)
+        return out
+
+    def _scan_mutations(self, info: _FuncInfo, exported: dict[str, int]) -> None:
+        """Flag in-place writes that land AFTER a name was exported — the
+        window where the wire/pin layer may still hold the buffer."""
+        def flag(line: int, key: str, what: str) -> None:
+            exp_line = exported.get(key)
+            if exp_line is None or line <= exp_line:
+                return  # untainted, or fill-before-export (safe ordering)
+            self.findings.append(Finding(
+                self.path, line, "buffer-inplace-export",
+                f"{what} after {key} flowed to the zero-copy export on line "
+                f"{exp_line} — the wire/pin layer may still hold this "
+                f"buffer; copy before export or defer the write",
+            ))
+
+        for n in ast.walk(info.node):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Subscript):
+                        key = self._buf_key(t.value)
+                        if key:
+                            flag(n.lineno, key, f"{key}[...] = assignment")
+            elif isinstance(n, ast.AugAssign):
+                t = n.target
+                base = t.value if isinstance(t, ast.Subscript) else t
+                key = self._buf_key(base)
+                if key:
+                    flag(n.lineno, key, f"augmented assignment to {key}")
+            elif isinstance(n, ast.Call):
+                chain = _attr_chain(n.func)
+                if chain[-1:] == ["copyto"] and n.args:
+                    key = self._buf_key(n.args[0])
+                    if key:
+                        flag(n.lineno, key, f"np.copyto into {key}")
+                elif (len(chain) >= 2 and chain[-1] in _INPLACE_METHODS):
+                    key = ".".join(chain[:-1])
+                    if chain[0] not in ("?",):
+                        flag(n.lineno, key, f"in-place .{chain[-1]}() on {key}")
+
+    def _check_view_escape(self, reach: set[str]) -> None:
+        for q, info in self.funcs.items():
+            name = q.rsplit(".", 1)[-1]
+            if info.cls is None or name.startswith("_"):
+                continue
+            if q in reach:
+                continue  # exposure is registered with bufsan
+            for n in ast.walk(info.node):
+                if not isinstance(n, ast.Return) or n.value is None:
+                    continue
+                v = n.value
+                what = None
+                if (isinstance(v, ast.Call) and isinstance(v.func, ast.Name)
+                        and v.func.id == "memoryview" and v.args):
+                    base = _attr_chain(v.args[0])
+                    if base and base[0] == "self":
+                        what = f"memoryview({'.'.join(base)})"
+                elif isinstance(v, ast.Subscript) and isinstance(v.slice, ast.Slice):
+                    base = _attr_chain(v.value)
+                    if (base and base[0] == "self"
+                            and any(_BUF_NAME_RE.search(p) for p in base[1:])):
+                        what = f"slice of {'.'.join(base)}"
+                if what is not None:
+                    self.findings.append(Finding(
+                        self.path, n.lineno, "view-escape",
+                        f"{q}() returns {what} — an aliasing view of "
+                        f"cache-resident state; .copy()/.tobytes() it, return "
+                        f".toreadonly(), or register the exposure with "
+                        f"bufsan.export",
+                    ))
+
     # -- blocking-under-lock ------------------------------------------------
 
     def propagate_blocking(self) -> None:
@@ -650,6 +900,7 @@ def run(paths: list[str], root: Path | None = None,
         fl.propagate_blocking()
         fl.check_with_regions()
         fl.check_jit()
+        fl.check_bufsan()
         file_lints.append(fl)
         waiver_maps[str(path)] = _waivers_for(src.splitlines())
         # nested lock withs walk the same call once per enclosing region —

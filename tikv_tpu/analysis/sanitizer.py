@@ -58,6 +58,12 @@ def _enabled() -> bool:
     return os.environ.get("TIKV_TPU_SANITIZE", "").lower() in ("1", "true", "on", "yes")
 
 
+def enabled() -> bool:
+    """Public switch probe — the buffer-exposure sanitizer (bufsan) and
+    other per-call instrumentation share this one gate."""
+    return _enabled()
+
+
 _hold_cache: float | None = None
 
 
@@ -193,7 +199,8 @@ def _emit(report: Report) -> None:
         # long-hold reports must never displace the one report the CI gate
         # exists to catch (cycles self-bound via node-set dedup anyway)
         if (len(_reports) < _MAX_REPORTS
-                or report.kind in ("lock-order-cycle", "lock-order-same-key")):
+                or report.kind in ("lock-order-cycle", "lock-order-same-key",
+                                   "buffer-mutation-while-exposed")):
             _reports.append(report)
     _log.warning("%s", report.format())
 

@@ -17,10 +17,12 @@ version (apply index / max commit ts), so any write produces a new key.
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass, field
 
 import numpy as np
+
+from ..analysis import bufsan as _bufsan
+from ..analysis.sanitizer import make_lock
 
 
 # per-block pinned signatures: stacked + nvoff + zone layout + sharded slab
@@ -72,7 +74,7 @@ class ColumnBlockCache:
         # demotion, code-lane widening) — the device-plan memo and the
         # encoded pin signatures key on it (copr/encoding.py)
         self.enc_version = 0
-        self._mu = threading.Lock()
+        self._mu = make_lock("copr.block_cache")
 
     def add(self, cols, n_valid: int) -> None:
         self.blocks.append(_Block(cols, n_valid))
@@ -111,8 +113,13 @@ class ColumnBlockCache:
 
             if added:
                 OBSERVATORY.note_pin(_pin_kind(sig), _entry_nbytes(built))
+                # pins are exposures: the host arrays behind them must only
+                # change through scatter_update (which re-registers); a pin
+                # whose sample fails at drop took a bypass write
+                _bufsan.export("device_pin", built, site="cache.device_arrays")
             for old_sig, entry in dropped:
                 OBSERVATORY.note_pin(_pin_kind(old_sig), -_entry_nbytes(entry))
+                _bufsan.release(entry, site="cache.device_arrays.lru")
         return out
 
     def nbytes(self) -> int:
@@ -174,6 +181,7 @@ class ColumnBlockCache:
 
             for sig, entry in dropped:
                 OBSERVATORY.note_pin(_pin_kind(sig), -_entry_nbytes(entry))
+                _bufsan.release(entry, site="cache.drop_device")
 
     def scatter_update(self, updates: dict) -> None:
         """Patch pinned device arrays in place after an in-place host update.
@@ -189,6 +197,7 @@ class ColumnBlockCache:
         blocks on its owner device."""
         from . import zone_maps as _zm
 
+        released, repinned = [], []
         with self._mu:
             for bi, blk in enumerate(self.blocks):
                 upd = updates.get(bi)
@@ -207,15 +216,27 @@ class ColumnBlockCache:
                         # ref/run structure lives in the encoding) — drop,
                         # and the next serve re-pins from the updated host
                         # payload (which try_patch/demote kept truthful)
-                        blk.device.pop(sig)
+                        released.append(blk.device.pop(sig))
                     elif kind == "stacked":
-                        blk.device[sig] = self._patch_stacked(blk.device[sig], sig, updates)
+                        old = blk.device[sig]
+                        blk.device[sig] = self._patch_stacked(old, sig, updates)
+                        repinned.append((old, blk.device[sig]))
                     elif isinstance(kind, tuple):
                         if upd is None:
                             continue
-                        blk.device[sig] = self._patch_block(blk.device[sig], sig, upd)
+                        old = blk.device[sig]
+                        blk.device[sig] = self._patch_block(old, sig, upd)
+                        repinned.append((old, blk.device[sig]))
                     else:
-                        blk.device.pop(sig)
+                        released.append(blk.device.pop(sig))
+        # the mutation choke point for pins: scatter IS the coordinated
+        # host-mutate-then-patch path, so patched pins re-register (new
+        # sample) and dropped pins release-verify (docs/static_analysis.md)
+        for entry in released:
+            _bufsan.release(entry, site="cache.scatter_update")
+        for old, new in repinned:
+            _bufsan.release(old, site="cache.scatter_update")
+            _bufsan.export("device_pin", new, site="cache.scatter_update")
 
     @staticmethod
     def _patch_stacked(entry, sig, updates):
@@ -260,7 +281,7 @@ class CopCache:
         self.max_entries = max_entries
         self._entries: dict = {}
         self._order: list = []
-        self._mu = threading.Lock()
+        self._mu = make_lock("copr.cop_cache")
 
     def get_or_create(self, key) -> ColumnBlockCache:
         with self._mu:

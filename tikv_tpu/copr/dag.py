@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..analysis import bufsan as _bufsan
 from ..server.wire import PASSTHROUGH_MIN as PART_MIN
 from ..util import codec
 from . import datum as datum_mod
@@ -208,11 +209,16 @@ class SelectResponse:
             head += codec.encode_var_u64(sum(len(c) for c in cols))
             for c in cols:
                 # column slabs worth a gather iovec ride as their own part
-                # (wire.PASSTHROUGH_MIN); small ones fold into the header
+                # (wire.PASSTHROUGH_MIN); small ones fold into the header.
+                # From here the slab is an exposure: it must stay bit-stable
+                # until the frame writer's send completes (bufsan tracks the
+                # window under TIKV_TPU_SANITIZE=1)
                 if len(c) >= PART_MIN:
                     if head:
                         parts.append(bytes(head))
                         head = bytearray()
+                    _bufsan.export("encode_parts", c,
+                                   site="dag.SelectResponse.encode_parts")
                     parts.append(c)
                 else:
                     head += c

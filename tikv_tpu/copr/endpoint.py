@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from ..analysis import bufsan as _bufsan
 from ..storage.kv import Engine
 from ..storage.mvcc import Statistics
 from ..util import trace
@@ -814,11 +815,18 @@ class Endpoint:
         serve — zero wrong bytes reach the sampled client."""
         from .integrity import IntegrityMismatch, count_mismatch, integrity_fatal
 
+        # the device answer is an exposure: it is held across the oracle
+        # re-execution, and a concurrent fold mutating its backing buffer
+        # would turn a true mismatch into a phantom (or mask one)
+        _bufsan.export("shadow_read", device_data, site="endpoint.shadow_compare")
         try:
-            cpu = self._cpu_bytes(req, snap)
-        except Exception:  # noqa: BLE001 — locks/races: inconclusive, not bad
-            self.shadow.note(path, "error")
-            return None
+            try:
+                cpu = self._cpu_bytes(req, snap)
+            except Exception:  # noqa: BLE001 — locks/races: inconclusive, not bad
+                self.shadow.note(path, "error")
+                return None
+        finally:
+            _bufsan.release(device_data, site="endpoint.shadow_compare")
         if cpu == device_data:
             self.shadow.note(path, "ok")
             return None

@@ -72,6 +72,7 @@ import weakref
 
 import numpy as np
 
+from ..analysis import bufsan as _bufsan
 from ..analysis.sanitizer import make_rlock
 from ..storage.engine import CF_DEFAULT, CF_LOCK, CF_WRITE
 from ..storage.mvcc import Statistics
@@ -478,6 +479,23 @@ class RegionImage:
         blocks = self.block_cache.blocks
         offsets = self._offsets()
         bi_arr = np.searchsorted(offsets, pos, side="right") - 1
+        if _bufsan.enabled():
+            # mutation choke point: the fold is about to write these host
+            # arrays in place — any of them still exposed (wire part mid
+            # sendmsg, shadow-read snapshot) is a violation.  Encoded
+            # columns list their payload arrays, never ``.data`` (the
+            # property would cache a full decode).
+            bufs: list = [self.row_commit_ts]
+            for bi in np.unique(bi_arr):
+                for col in blocks[int(bi)].cols:
+                    if isinstance(col, _encoding.EncodedColumn):
+                        bufs.extend(a for a in (col.packed, col.run_values,
+                                                col.run_ends, col.run_nulls)
+                                    if a is not None)
+                    else:
+                        bufs.append(col.data)
+                        bufs.append(col.nulls)
+            _bufsan.note_mutation(bufs, site="region_cache._apply_updates")
         # any in-place update to an RLE column breaks its runs: demote it
         # image-wide up front (decode-on-next-serve), so the assignments
         # below land on plain decoded arrays
@@ -540,6 +558,11 @@ class RegionImage:
         ``new_fp``/``new_nb`` are the changed rows' integrity hashes/sizes —
         mirrored through the same delete/update/insert index math as
         ``row_commit_ts`` so the fingerprint arrays stay row-aligned."""
+        # repacks build NEW arrays (concatenate copies) so exposed buffers
+        # are never written — but the old image is about to be replaced, so
+        # sweep the ledger once: anything already corrupted reports here
+        # with its export stack instead of at a far-away release
+        _bufsan.verify_all(site="region_cache._apply_structural")
         if self.fp_valid and new_fp is None and len(ch):
             self._invalidate_fp()
         fp = self.row_fp if self.fp_valid else None

@@ -186,6 +186,8 @@ class RowSliceV2:
                 hi = mid
         if lo < len(self.non_null_ids) and self.non_null_ids[lo] == col_id:
             start = self.offsets[lo - 1] if lo else 0
+            # lint: allow(view-escape) -- self.raw is bytes (immutable): the
+            # slice is a copy by construction, no aliasing view can escape
             return self.raw[self.values_start + start : self.values_start + self.offsets[lo]]
         if col_id in self.null_ids:
             return None

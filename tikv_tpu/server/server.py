@@ -18,6 +18,7 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
+from ..analysis import bufsan as _bufsan
 from ..util import error_code, trace
 from ..util.metrics import REGISTRY
 from ..util.worker import TaskPriority, UnifiedReadPool
@@ -102,30 +103,38 @@ def write_frame_parts(sock: socket.socket, parts: list) -> None:
     """One frame from a ``wire.dumps_parts`` buffer list: gather-write via
     ``sendmsg`` so a large response payload (coprocessor chunk data) goes
     header + passthrough buffers straight to the kernel — no single-buffer
-    concatenation copy.  TLS sockets (no sendmsg) fall back to a join."""
-    bufs = [memoryview(_LEN.pack(sum(len(p) for p in parts)))]
-    bufs += [p if isinstance(p, memoryview) else memoryview(p) for p in parts]
-    sendmsg = getattr(sock, "sendmsg", None)
-    if sendmsg is None:
-        sock.sendall(b"".join(bufs))
-        return
+    concatenation copy.  TLS sockets (no sendmsg) fall back to a join.
+
+    This is the RELEASE boundary of the zero-copy exposure window: once the
+    send completes (or the socket dies), the passthrough buffers are no
+    longer aliased by the kernel, and bufsan verifies each one's sample
+    against its ``dumps_parts`` registration."""
     try:
-        sent = sendmsg(bufs[:_IOV_MAX])
-    except (NotImplementedError, OSError) as e:
-        if isinstance(e, OSError):
-            raise
-        sock.sendall(b"".join(bufs))  # ssl.SSLSocket raises NotImplementedError
-        return
-    # a partial gather write is legal: advance through the buffer list
-    while True:
-        while bufs and sent >= len(bufs[0]):
-            sent -= len(bufs[0])
-            bufs.pop(0)
-        if not bufs:
+        bufs = [memoryview(_LEN.pack(sum(len(p) for p in parts)))]
+        bufs += [p if isinstance(p, memoryview) else memoryview(p) for p in parts]
+        sendmsg = getattr(sock, "sendmsg", None)
+        if sendmsg is None:
+            sock.sendall(b"".join(bufs))
             return
-        if sent:
-            bufs[0] = bufs[0][sent:]
-        sent = sendmsg(bufs[:_IOV_MAX])
+        try:
+            sent = sendmsg(bufs[:_IOV_MAX])
+        except (NotImplementedError, OSError) as e:
+            if isinstance(e, OSError):
+                raise
+            sock.sendall(b"".join(bufs))  # ssl.SSLSocket raises NotImplementedError
+            return
+        # a partial gather write is legal: advance through the buffer list
+        while True:
+            while bufs and sent >= len(bufs[0]):
+                sent -= len(bufs[0])
+                bufs.pop(0)
+            if not bufs:
+                return
+            if sent:
+                bufs[0] = bufs[0][sent:]
+            sent = sendmsg(bufs[:_IOV_MAX])
+    finally:
+        _bufsan.release_parts(parts, site="server.write_frame_parts")
 
 
 class Server:

@@ -17,12 +17,16 @@ The hot serving path is **zero-copy for large payloads** in both directions:
 * :func:`loads` accepts ``bytes``/``bytearray``/``memoryview`` input and
   walks it by offset (iterative containers, no per-element recursion for the
   encode side); with ``bytes_view=True`` payloads of ``PASSTHROUGH_MIN``
-  bytes or more decode as read-only memoryviews into the frame instead of
-  copies (opt-in: the default keeps the plain-``bytes`` contract).
+  bytes or more decode as **read-only** memoryviews into the frame instead
+  of copies (opt-in: the default keeps the plain-``bytes`` contract).
+  Read-only is a contract, not a convention: the views alias the shared
+  frame buffer, so writing through one raises ``TypeError``
+  (``memoryview.toreadonly``) — see docs/wire_path.md §zero-copy.
 """
 
 from __future__ import annotations
 
+from ..analysis import bufsan as _bufsan
 from ..util import codec
 
 _NONE, _TRUE, _FALSE, _INT, _FLOAT, _BYTES, _STR, _LIST, _DICT, _TUPLE = range(10)
@@ -81,11 +85,15 @@ def _encode(out: bytearray, root, parts: list | None) -> None:
             out += codec.encode_var_u64(n)
             if parts is not None and n >= PASSTHROUGH_MIN:
                 # flush the accumulated header and pass the payload through
-                # as the caller's own buffer — zero copies on this path
+                # as the caller's own buffer — zero copies on this path.
+                # The buffer is EXPOSED from here until the frame writer's
+                # send completes: it must stay bit-stable (bufsan verifies
+                # under TIKV_TPU_SANITIZE=1; write_frame_parts releases)
                 parts.append(bytes(out))
                 out.clear()
-                parts.append(obj if isinstance(obj, memoryview)
-                             else memoryview(obj))
+                part = obj if isinstance(obj, memoryview) else memoryview(obj)
+                _bufsan.export("wire_part", part, site="wire.dumps_parts")
+                parts.append(part)
             else:
                 out += obj
         elif isinstance(obj, str):
